@@ -1,0 +1,14 @@
+"""spark_rapids_tpu — TPU-native accelerator with the capabilities of the RAPIDS
+Accelerator for Apache Spark (see ARCHITECTURE.md / SURVEY.md)."""
+
+__version__ = "0.1.0"
+
+import jax as _jax
+
+# LONG/DOUBLE are core SQL types; the framework is unusable with 32-bit-only math.
+# (On TPU, f64 lowers to XLA's emulation; the planner can demote DOUBLE compute to f32
+# when spark.rapids.tpu.f64.emulation=false.)
+_jax.config.update("jax_enable_x64", True)
+
+from . import types  # noqa: F401
+from .config import TpuConf, get_default_conf  # noqa: F401

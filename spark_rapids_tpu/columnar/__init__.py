@@ -1,0 +1,4 @@
+from .column import Column, from_arrow, from_numpy, make_column, to_arrow  # noqa: F401
+from .batch import (Schema, ColumnarBatch, batch_from_arrow, batch_from_dict,  # noqa: F401
+                    batch_to_arrow, empty_batch)
+from .padding import row_bucket, width_bucket, LANE  # noqa: F401

@@ -1,0 +1,264 @@
+"""Device column vectors.
+
+TPU-native counterpart of the reference's `GpuColumnVector.java` (Spark ColumnVector over
+a cudf device column, conversions at `GpuColumnVector.java:637,669`): here a `Column` is a
+pytree of JAX device arrays — a data buffer plus a validity mask — padded to a capacity
+bucket (see padding.py). Strings use the fixed-width byte-matrix layout
+(ARCHITECTURE.md #3) instead of cudf's offset+chars, because rectangular byte data maps
+onto the VPU; conversion to/from Arrow offset+chars happens at the host boundary.
+
+Semantics contract:
+  * every array's leading dim is the batch *capacity*; rows >= the batch's logical
+    `num_rows` are padding whose data AND validity contents are unspecified — kernels
+    must mask with the batch row-mask wherever padding could leak into results;
+  * `validity[i]` True means row i is non-null;
+  * data values under null rows are unspecified (like Arrow), kernels must not rely.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..config import get_default_conf
+from ..errors import StringWidthExceeded
+from .padding import row_bucket, width_bucket
+
+
+def _checked_width(max_len: int) -> int:
+    w = width_bucket(max_len)
+    limit = get_default_conf().string_max_width
+    if w > limit:
+        raise StringWidthExceeded(max_len, limit)
+    return w
+
+__all__ = ["Column", "make_column", "from_numpy", "from_arrow", "to_arrow"]
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Column:
+    """A device column: data + validity (+ lengths for strings).
+
+    dtype is static (pytree aux); arrays are leaves. For STRING columns `data` is
+    uint8[cap, width] and `lengths` is int32[cap]; otherwise `lengths` is None and
+    `data` is dtype[cap].
+    """
+
+    dtype: T.DataType
+    data: jnp.ndarray
+    validity: jnp.ndarray
+    lengths: Optional[jnp.ndarray] = None
+
+    # -- pytree ---------------------------------------------------------------
+    def tree_flatten(self):
+        if self.lengths is None:
+            return (self.data, self.validity), (self.dtype, False)
+        return (self.data, self.validity, self.lengths), (self.dtype, True)
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        dtype, has_len = aux
+        if has_len:
+            data, validity, lengths = leaves
+            return cls(dtype, data, validity, lengths)
+        data, validity = leaves
+        return cls(dtype, data, validity, None)
+
+    # -- shape ----------------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return int(self.data.shape[0])
+
+    @property
+    def is_string(self) -> bool:
+        return isinstance(self.dtype, T.StringType)
+
+    @property
+    def string_width(self) -> int:
+        assert self.is_string
+        return int(self.data.shape[1])
+
+    def device_memory_size(self) -> int:
+        n = self.data.size * self.data.dtype.itemsize + self.validity.size
+        if self.lengths is not None:
+            n += self.lengths.size * 4
+        return n
+
+    # -- construction helpers -------------------------------------------------
+    def with_validity(self, validity: jnp.ndarray) -> "Column":
+        return Column(self.dtype, self.data, validity, self.lengths)
+
+    def repadded(self, new_cap: int) -> "Column":
+        """Grow/shrink capacity (host-side op; used by coalesce/re-bucketing)."""
+        cap = self.capacity
+        if new_cap == cap:
+            return self
+
+        def fit(a):
+            if new_cap > cap:
+                pad = [(0, new_cap - cap)] + [(0, 0)] * (a.ndim - 1)
+                return jnp.pad(a, pad)
+            return a[:new_cap]
+
+        return Column(self.dtype, fit(self.data), fit(self.validity),
+                      None if self.lengths is None else fit(self.lengths))
+
+    # -- host boundary --------------------------------------------------------
+    def to_numpy(self, num_rows: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Return (values, valid_mask) sliced to the logical row count. String
+        columns return an object array of Python str."""
+        valid = np.asarray(self.validity[:num_rows])
+        if self.is_string:
+            chars = np.asarray(self.data[:num_rows])
+            lens = np.asarray(self.lengths[:num_rows])
+            out = np.empty(num_rows, dtype=object)
+            for i in range(num_rows):
+                out[i] = bytes(chars[i, :lens[i]]).decode("utf-8", "replace") \
+                    if valid[i] else None
+            return out, valid
+        return np.asarray(self.data[:num_rows]), valid
+
+
+def make_column(dtype: T.DataType, data, validity, lengths=None) -> Column:
+    return Column(dtype, data, validity, lengths)
+
+
+def _pad_to(arr: np.ndarray, cap: int) -> np.ndarray:
+    if arr.shape[0] == cap:
+        return arr
+    pad = [(0, cap - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, pad)
+
+
+def from_numpy(dtype: T.DataType, values: np.ndarray,
+               valid: Optional[np.ndarray] = None,
+               capacity: Optional[int] = None) -> Tuple[Column, int]:
+    """Build a device Column from host values; returns (column, num_rows)."""
+    n = len(values)
+    cap = capacity or row_bucket(n)
+    if valid is None:
+        valid = np.ones(n, dtype=bool)
+    valid = _pad_to(np.asarray(valid, dtype=bool), cap)
+
+    if isinstance(dtype, T.StringType):
+        lens = np.zeros(n, dtype=np.int32)
+        enc = []
+        for i, v in enumerate(values):
+            b = v.encode("utf-8") if isinstance(v, str) else (v or b"")
+            enc.append(b)
+            lens[i] = len(b)
+        w = _checked_width(int(lens.max()) if n else 1)
+        chars = np.zeros((cap, w), dtype=np.uint8)
+        for i, b in enumerate(enc):
+            chars[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
+        return Column(dtype, jnp.asarray(chars), jnp.asarray(valid),
+                      jnp.asarray(_pad_to(lens, cap))), n
+
+    npdt = dtype.np_dtype
+    if npdt is None:
+        raise TypeError(f"cannot build device column for {dtype}")
+    vals = _pad_to(np.ascontiguousarray(values, dtype=npdt), cap)
+    return Column(dtype, jnp.asarray(vals), jnp.asarray(valid)), n
+
+
+def from_arrow(arr, capacity: Optional[int] = None) -> Tuple[Column, int]:
+    """Arrow array -> device Column. Vectorized offset+chars -> byte-matrix repack
+    for strings (host boundary; native/ carries the C++ fast path)."""
+    import pyarrow as pa
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    dtype = T.from_arrow(arr.type)
+    n = len(arr)
+    cap = capacity or row_bucket(n)
+    valid = np.ones(n, dtype=bool) if arr.null_count == 0 else \
+        np.asarray(arr.is_valid())
+
+    if isinstance(dtype, T.StringType):
+        arr = arr.cast(pa.large_string()) if pa.types.is_string(arr.type) else arr
+        buffers = arr.buffers()
+        offsets = np.frombuffer(buffers[1], dtype=np.int64,
+                                count=n + 1, offset=arr.offset * 8)
+        databuf = np.frombuffer(buffers[2], dtype=np.uint8) if buffers[2] else \
+            np.zeros(0, np.uint8)
+        lens = np.diff(offsets).astype(np.int32)
+        # null slots may carry garbage lengths in theory; normalize to 0
+        lens = np.where(valid, lens, 0).astype(np.int32)
+        w = _checked_width(int(lens.max()) if n and lens.size else 1)
+        chars = np.zeros((cap, w), dtype=np.uint8)
+        if n:
+            row_id = np.repeat(np.arange(n), lens)
+            if row_id.size:
+                out_starts = np.concatenate(([0], np.cumsum(lens)[:-1]))
+                within = np.arange(row_id.size) - np.repeat(out_starts, lens)
+                src = np.repeat(offsets[:-1], lens) + within
+                chars[row_id, within] = databuf[src]
+        return Column(dtype, jnp.asarray(chars),
+                      jnp.asarray(_pad_to(valid, cap)),
+                      jnp.asarray(_pad_to(lens, cap))), n
+
+    npdt = dtype.np_dtype
+    if npdt is None:
+        if dtype.is_nested:
+            raise TypeError(f"nested arrow type not yet device-backed: {arr.type}")
+        raise TypeError(
+            f"type not yet device-backed: {arr.type} "
+            "(wide decimal >18 digits needs limb support; binary needs the string "
+            "byte-matrix path)")
+    if isinstance(dtype, T.DecimalType):
+        vals = np.array([int(v.as_py().scaleb(dtype.scale)) if v.is_valid else 0
+                         for v in arr], dtype=np.int64)
+    elif isinstance(dtype, (T.TimestampType, T.DateType)):
+        ints = arr.cast(pa.int64() if isinstance(dtype, T.TimestampType)
+                        else pa.int32())
+        # fill nulls BEFORE to_numpy: a nullable int array otherwise converts via
+        # float64, silently corrupting values beyond 2^53
+        vals = ints.fill_null(0).to_numpy(zero_copy_only=False)
+    elif arr.null_count:
+        zero = False if isinstance(dtype, T.BooleanType) else 0
+        vals = arr.fill_null(zero).to_numpy(zero_copy_only=False)
+    else:
+        vals = arr.to_numpy(zero_copy_only=False)
+    vals = np.ascontiguousarray(vals)
+    # float conversions can still carry NaN under null slots; zero them
+    if np.issubdtype(vals.dtype, np.floating) and not valid.all():
+        vals = np.where(valid, vals, 0.0)
+    vals = _pad_to(vals.astype(npdt, copy=False), cap)
+    return Column(dtype, jnp.asarray(vals), jnp.asarray(_pad_to(valid, cap))), n
+
+
+def to_arrow(col: Column, num_rows: int):
+    """Device Column -> Arrow array (host boundary)."""
+    import pyarrow as pa
+    valid = np.asarray(col.validity[:num_rows])
+    mask = ~valid
+    if col.is_string:
+        chars = np.asarray(col.data[:num_rows])
+        lens = np.asarray(col.lengths[:num_rows]).astype(np.int64)
+        lens = np.where(valid, lens, 0)
+        w = chars.shape[1] if chars.ndim == 2 else 0
+        if num_rows and w:
+            keep = np.arange(w)[None, :] < lens[:, None]
+            flat = chars[keep]
+        else:
+            flat = np.zeros(0, np.uint8)
+        offsets = np.concatenate(([0], np.cumsum(lens)))
+        return pa.Array.from_buffers(
+            pa.large_string(), num_rows,
+            [pa.py_buffer(np.packbits(valid, bitorder="little").tobytes()),
+             pa.py_buffer(offsets.astype(np.int64).tobytes()),
+             pa.py_buffer(flat.tobytes())],
+            null_count=int(mask.sum())).cast(pa.string())
+    vals = np.asarray(col.data[:num_rows])
+    at = T.to_arrow(col.dtype)
+    if isinstance(col.dtype, T.DecimalType):
+        import decimal as _d
+        py = [(_d.Decimal(int(v)).scaleb(-col.dtype.scale) if m else None)
+              for v, m in zip(vals, valid)]
+        return pa.array(py, type=at)
+    return pa.array(vals, type=at, mask=mask if mask.any() else None)

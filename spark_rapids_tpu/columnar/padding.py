@@ -1,0 +1,40 @@
+"""Bucketed-padding discipline.
+
+XLA compiles one program per shape, so device batches are padded to a small set of
+capacity buckets; the logical row count travels as a traced scalar. This keeps the number
+of distinct compiled programs logarithmic in batch-size range (the reference has no such
+concern — CUDA kernels take runtime sizes — making this the first genuinely TPU-specific
+design point, see ARCHITECTURE.md #1)."""
+
+from __future__ import annotations
+
+from ..config import get_default_conf
+
+LANE = 128
+
+
+def row_bucket(n: int, min_rows: int = 0) -> int:
+    """Smallest capacity bucket >= n: buckets start at max(minRows, LANE) and grow by
+    spark.rapids.tpu.padding.growth (lane-aligned), default 2x."""
+    conf = get_default_conf()
+    if min_rows <= 0:
+        min_rows = conf.get("spark.rapids.tpu.padding.minRows")
+    growth = max(1.25, conf.get("spark.rapids.tpu.padding.growth"))
+    cap = max(min_rows, LANE)
+    while cap < n:
+        cap = ((int(cap * growth) + LANE - 1) // LANE) * LANE
+    return cap
+
+
+def width_bucket(w: int) -> int:
+    """String byte-matrix width bucket: multiples of 8 up to a lane, then powers of two
+    (keeps the trailing dim friendly to (8,128) tiling without exploding memory for
+    short strings)."""
+    if w <= 8:
+        return 8
+    if w <= LANE:
+        return (w + 7) & ~7
+    cap = LANE
+    while cap < w:
+        cap <<= 1
+    return cap

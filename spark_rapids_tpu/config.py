@@ -1,0 +1,336 @@
+"""Typed configuration registry.
+
+Design mirrors the reference's `RapidsConf.scala` (ConfBuilder/ConfEntry, reference
+`sql-plugin/.../RapidsConf.scala:120-307`; registry `:310+`; docs generation
+`RapidsConf.help` `:1874`): every knob is a declared, typed `ConfEntry` with a doc string,
+default, optional value-check, `internal` and `startup_only` flags; `TpuConf` wraps a plain
+dict of user settings and exposes typed accessors; `generate_docs()` emits
+`docs/configs.md`. Per-operator and per-expression enable keys are auto-registered by the
+planning layer (`spark.rapids.sql.exec.*` / `.expression.*`), as in the reference.
+
+Key namespace intentionally matches the reference (`spark.rapids.*`) so that reference
+users' configs translate 1:1; TPU-specific keys live under `spark.rapids.tpu.*`.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+__all__ = ["ConfEntry", "TpuConf", "register", "entries", "generate_docs", "get_default_conf"]
+
+_REGISTRY: Dict[str, "ConfEntry"] = {}
+_LOCK = threading.Lock()
+
+_SIZE_RE = re.compile(r"^(\d+(?:\.\d+)?)\s*([kmgt]?i?b?)$", re.IGNORECASE)
+_SIZE_MULT = {
+    "": 1, "b": 1,
+    "k": 1 << 10, "kb": 1 << 10, "kib": 1 << 10,
+    "m": 1 << 20, "mb": 1 << 20, "mib": 1 << 20,
+    "g": 1 << 30, "gb": 1 << 30, "gib": 1 << 30,
+    "t": 1 << 40, "tb": 1 << 40, "tib": 1 << 40,
+}
+
+
+def parse_bytes(v) -> int:
+    if isinstance(v, (int, float)):
+        return int(v)
+    m = _SIZE_RE.match(str(v).strip())
+    if not m:
+        raise ValueError(f"cannot parse byte size: {v!r}")
+    return int(float(m.group(1)) * _SIZE_MULT[m.group(2).lower()])
+
+
+def _convert(value: Any, typ: str) -> Any:
+    if typ == "bool":
+        if isinstance(value, bool):
+            return value
+        return str(value).strip().lower() in ("true", "1", "yes")
+    if typ == "int":
+        return int(value)
+    if typ == "double":
+        return float(value)
+    if typ == "bytes":
+        return parse_bytes(value)
+    return str(value)
+
+
+class ConfEntry:
+    def __init__(self, key: str, typ: str, default: Any, doc: str,
+                 internal: bool = False, startup_only: bool = False,
+                 check_values: Optional[Sequence[Any]] = None,
+                 checker: Optional[Callable[[Any], bool]] = None):
+        self.key = key
+        self.typ = typ
+        self.default = default
+        self.doc = doc
+        self.internal = internal
+        self.startup_only = startup_only
+        self.check_values = tuple(check_values) if check_values else None
+        self.checker = checker
+
+    def convert(self, raw: Any) -> Any:
+        v = _convert(raw, self.typ)
+        if self.check_values is not None and v not in self.check_values:
+            raise ValueError(
+                f"{self.key}={v!r} not in allowed values {self.check_values}")
+        if self.checker is not None and not self.checker(v):
+            raise ValueError(f"{self.key}={v!r} failed validation")
+        return v
+
+
+def register(key: str, typ: str, default: Any, doc: str, **kw) -> ConfEntry:
+    with _LOCK:
+        if key in _REGISTRY:
+            return _REGISTRY[key]
+        e = ConfEntry(key, typ, default, doc, **kw)
+        _REGISTRY[key] = e
+        return e
+
+
+def entries() -> Dict[str, ConfEntry]:
+    return dict(_REGISTRY)
+
+
+# --------------------------------------------------------------------------------------
+# Core key registry. Names follow the reference where a counterpart exists.
+# --------------------------------------------------------------------------------------
+
+register("spark.rapids.sql.enabled", "bool", True,
+         "Enable the TPU columnar rewrite of SQL physical plans.")
+register("spark.rapids.sql.mode", "string", "executeOnGPU",
+         "executeOnGPU runs converted plans on TPU; explainOnly only tags and reports "
+         "what would run on TPU without converting.",
+         check_values=("executeOnGPU", "explainOnly"))
+register("spark.rapids.sql.explain", "string", "NONE",
+         "Explain output for the plan rewrite: NONE, NOT_ON_GPU (only fallback reasons), "
+         "ALL.", check_values=("NONE", "NOT_ON_GPU", "ALL"))
+register("spark.rapids.sql.batchSizeBytes", "bytes", 1 << 30,
+         "Target device batch size for coalescing (reference default 1GiB).")
+register("spark.rapids.sql.batchSizeRows", "int", 1 << 20,
+         "Target max rows per device batch.")
+register("spark.rapids.sql.concurrentGpuTasks", "int", 2,
+         "Number of tasks admitted concurrently to the TPU (GpuSemaphore analog).")
+register("spark.rapids.sql.metrics.level", "string", "MODERATE",
+         "Operator metric verbosity: ESSENTIAL, MODERATE, DEBUG.",
+         check_values=("ESSENTIAL", "MODERATE", "DEBUG"))
+register("spark.rapids.sql.castFloatToString.enabled", "bool", True,
+         "Enable float->string cast (Spark-format float printing on host path).")
+register("spark.rapids.sql.castStringToFloat.enabled", "bool", True,
+         "Enable string->float cast.")
+register("spark.rapids.sql.improvedFloatOps.enabled", "bool", True,
+         "Allow float ops whose results may differ from CPU Spark in ULPs.")
+register("spark.rapids.sql.variableFloatAgg.enabled", "bool", True,
+         "Allow float aggregation (non-deterministic ordering => non-bit-identical sums).")
+register("spark.rapids.sql.hasNans", "bool", True,
+         "Assume float data may contain NaNs (affects agg/join support).")
+register("spark.rapids.sql.ansi.enabled", "bool", False,
+         "ANSI mode: overflow/invalid-cast raise instead of null/wrap.")
+register("spark.rapids.sql.tieredProject.enabled", "bool", True,
+         "Evaluate projection as tiers of common subexpressions.")
+register("spark.rapids.sql.stableSort.enabled", "bool", True,
+         "Use stable device sort (required for Spark-identical ordering ties).")
+register("spark.rapids.sql.test.enabled", "bool", False,
+         "Strict test mode: any CPU fallback in a converted plan raises.")
+register("spark.rapids.sql.test.injectRetryOOM", "int", 0,
+         "Fault injection: force a RetryOOM on the Nth tracked device allocation "
+         "(reference RapidsConf.scala:1250).", internal=True)
+register("spark.rapids.sql.test.injectSplitAndRetryOOM", "int", 0,
+         "Fault injection: force a SplitAndRetryOOM on the Nth tracked allocation.",
+         internal=True)
+
+# Memory runtime --------------------------------------------------------------------
+register("spark.rapids.memory.gpu.allocFraction", "double", 0.9,
+         "Fraction of per-chip HBM given to the arena budget "
+         "(reference GpuDeviceManager.computeRmmPoolSize).")
+register("spark.rapids.memory.gpu.minAllocFraction", "double", 0.25,
+         "Minimum HBM fraction; startup fails below this.")
+register("spark.rapids.memory.gpu.maxAllocFraction", "double", 1.0,
+         "Maximum HBM fraction allowed.")
+register("spark.rapids.memory.gpu.reserve", "bytes", 640 << 20,
+         "HBM held back from the arena for XLA scratch/fragmentation.")
+register("spark.rapids.memory.host.spillStorageSize", "bytes", 1 << 30,
+         "Host-RAM spill store capacity before overflowing to disk.")
+register("spark.rapids.memory.host.pageablePool.enabled", "bool", True,
+         "Allow pageable host fallback when the pinned staging pool is exhausted.")
+register("spark.rapids.memory.pinnedPool.size", "bytes", 0,
+         "Pinned host staging pool for device transfers (0 = disabled).")
+register("spark.rapids.memory.gpu.oomDumpDir", "string", "",
+         "If set, dump allocator state to this dir on unrecoverable OOM.")
+register("spark.rapids.memory.gpu.state.debug", "string", "",
+         "Log allocator state on OOM: stdout/stderr/path.", internal=True)
+
+# Shuffle ---------------------------------------------------------------------------
+register("spark.rapids.shuffle.mode", "string", "MULTITHREADED",
+         "MULTITHREADED: host-serialized threaded shuffle (reference default); "
+         "ICI: device-resident collective all-to-all exchange over the mesh "
+         "(UCX-mode analog); CACHE_ONLY: device-resident local-only cache.",
+         check_values=("MULTITHREADED", "ICI", "CACHE_ONLY"))
+register("spark.rapids.shuffle.multiThreaded.writer.threads", "int", 4,
+         "Threads parallelizing shuffle serialization/compression/IO on write.")
+register("spark.rapids.shuffle.multiThreaded.reader.threads", "int", 4,
+         "Threads parallelizing shuffle fetch/decompression on read.")
+register("spark.rapids.shuffle.compression.codec", "string", "zstd",
+         "Batch compression codec for shuffle buffers: none, zstd, lz4xla (native).",
+         check_values=("none", "zstd", "lz4xla"))
+register("spark.rapids.shuffle.ici.chunkBytes", "bytes", 64 << 20,
+         "Per-step all-to-all chunk size over ICI.")
+
+# I/O -------------------------------------------------------------------------------
+register("spark.rapids.sql.format.parquet.enabled", "bool", True,
+         "Enable TPU parquet scan/write.")
+register("spark.rapids.sql.format.parquet.reader.type", "string", "AUTO",
+         "Reader strategy: AUTO, PERFILE, COALESCING, MULTITHREADED "
+         "(reference GpuParquetScan three strategies).",
+         check_values=("AUTO", "PERFILE", "COALESCING", "MULTITHREADED"))
+register("spark.rapids.sql.format.parquet.multiThreadedRead.numThreads", "int", 20,
+         "Global multi-file reader pool size (reference MultiFileReaderThreadPool).")
+register("spark.rapids.sql.format.parquet.multiThreadedRead.maxNumFilesParallel", "int",
+         2147483647, "Max files fetched in parallel per task.")
+register("spark.rapids.sql.format.orc.enabled", "bool", True, "Enable TPU ORC scan.")
+register("spark.rapids.sql.format.csv.enabled", "bool", True, "Enable TPU CSV scan.")
+register("spark.rapids.sql.format.json.enabled", "bool", True, "Enable TPU JSON scan.")
+register("spark.rapids.sql.format.avro.enabled", "bool", False,
+         "Enable TPU Avro scan (requires host avro decoder; gated off when absent).")
+register("spark.rapids.cloudSchemes", "string", "s3,s3a,s3n,wasbs,gs,abfs,abfss",
+         "URI schemes treated as cloud stores; selects MULTITHREADED reader under AUTO.")
+
+# Planning --------------------------------------------------------------------------
+register("spark.rapids.sql.optimizer.enabled", "bool", False,
+         "Cost-based optimizer: may move plan sections back to CPU to avoid "
+         "transition thrash (reference CostBasedOptimizer).")
+register("spark.rapids.sql.optimizer.cpuExecCost", "double", 1.0,
+         "Relative per-row CPU operator cost.", internal=True)
+register("spark.rapids.sql.optimizer.gpuExecCost", "double", 0.3,
+         "Relative per-row TPU operator cost.", internal=True)
+register("spark.rapids.sql.optimizer.transitionCost", "double", 10.0,
+         "Relative per-row cost of a CPU<->TPU transition.", internal=True)
+register("spark.rapids.sql.incompatibleOps.enabled", "bool", True,
+         "Allow ops marked incompat (minor semantic differences) on TPU.")
+register("spark.rapids.sql.incompatibleDateFormats.enabled", "bool", False,
+         "Allow date formats with known corner-case differences.")
+register("spark.rapids.sql.regexp.enabled", "bool", True,
+         "Enable regular-expression offload via the transpiler (falls back per-pattern).")
+
+# TPU-specific ----------------------------------------------------------------------
+register("spark.rapids.tpu.device.ordinal", "int", -1,
+         "Which local TPU device to bind (-1 = first).", startup_only=True)
+register("spark.rapids.tpu.padding.minRows", "int", 128,
+         "Minimum padded row bucket (lane-aligned).")
+register("spark.rapids.tpu.padding.growth", "double", 2.0,
+         "Row bucket growth factor (powers of this between min and max).")
+register("spark.rapids.tpu.string.maxWidth", "int", 8192,
+         "Max per-batch string width for the fixed-width byte-matrix layout; longer "
+         "strings fall the batch back to host processing.")
+register("spark.rapids.tpu.f64.emulation", "bool", True,
+         "Keep float64 math exact (XLA f64 on TPU); if false, DOUBLE computes as f32.")
+register("spark.rapids.tpu.mesh.shape", "string", "",
+         "Logical device mesh as 'name=N,name=M' (empty = single device).",
+         startup_only=True)
+
+
+class TpuConf:
+    """Instance view over a settings dict, with typed accessors (reference
+    `RapidsConf(conf)` `RapidsConf.scala:1973`)."""
+
+    def __init__(self, settings: Optional[Dict[str, Any]] = None):
+        self._settings: Dict[str, Any] = dict(settings or {})
+        # environment overrides, dots->underscores upper (SPARK_RAPIDS_SQL_ENABLED...)
+        for key, entry in _REGISTRY.items():
+            env = key.upper().replace(".", "_")
+            if env in os.environ and key not in self._settings:
+                self._settings[key] = os.environ[env]
+
+    def get(self, key: str) -> Any:
+        e = _REGISTRY.get(key)
+        if e is None:
+            # unregistered keys pass through raw (operator enable keys register lazily)
+            return self._settings.get(key)
+        if key in self._settings:
+            return e.convert(self._settings[key])
+        return e.default
+
+    def set(self, key: str, value: Any) -> "TpuConf":
+        self._settings[key] = value
+        return self
+
+    def get_bool(self, key: str, default: bool = True) -> bool:
+        v = self.get(key)
+        return default if v is None else _convert(v, "bool")
+
+    # Frequently used typed views ----------------------------------------------------
+    @property
+    def is_sql_enabled(self) -> bool:
+        return self.get("spark.rapids.sql.enabled")
+
+    @property
+    def is_test_enabled(self) -> bool:
+        return self.get("spark.rapids.sql.test.enabled")
+
+    @property
+    def explain(self) -> str:
+        return self.get("spark.rapids.sql.explain")
+
+    @property
+    def is_ansi(self) -> bool:
+        return self.get("spark.rapids.sql.ansi.enabled")
+
+    @property
+    def batch_size_bytes(self) -> int:
+        return self.get("spark.rapids.sql.batchSizeBytes")
+
+    @property
+    def batch_size_rows(self) -> int:
+        return self.get("spark.rapids.sql.batchSizeRows")
+
+    @property
+    def concurrent_tpu_tasks(self) -> int:
+        return self.get("spark.rapids.sql.concurrentGpuTasks")
+
+    @property
+    def shuffle_mode(self) -> str:
+        return self.get("spark.rapids.shuffle.mode")
+
+    @property
+    def string_max_width(self) -> int:
+        return self.get("spark.rapids.tpu.string.maxWidth")
+
+    def is_operator_enabled(self, key: str, incompat: bool = False,
+                            disabled_by_default: bool = False) -> bool:
+        v = self._settings.get(key)
+        if v is not None:
+            return _convert(v, "bool")
+        if disabled_by_default:
+            return False
+        if incompat:
+            return self.get("spark.rapids.sql.incompatibleOps.enabled")
+        return True
+
+
+_default_conf: Optional[TpuConf] = None
+
+
+def get_default_conf() -> TpuConf:
+    global _default_conf
+    if _default_conf is None:
+        _default_conf = TpuConf()
+    return _default_conf
+
+
+def generate_docs() -> str:
+    """Emit docs/configs.md content (reference RapidsConf.help)."""
+    lines: List[str] = [
+        "# Configuration\n",
+        "All configuration keys, their defaults and meaning. Generated by "
+        "`spark_rapids_tpu.config.generate_docs()`.\n",
+        "| Key | Default | Meaning |", "|---|---|---|",
+    ]
+    for key in sorted(_REGISTRY):
+        e = _REGISTRY[key]
+        if e.internal:
+            continue
+        doc = e.doc.replace("|", "\\|")
+        lines.append(f"| `{key}` | {e.default!r} | {doc} |")
+    return "\n".join(lines) + "\n"

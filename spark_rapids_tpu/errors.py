@@ -1,0 +1,36 @@
+"""Framework exception types.
+
+RetryOOM / SplitAndRetryOOM mirror the reference's per-thread retry exceptions raised by
+RmmSpark (`RmmRapidsRetryIterator.scala:28-120` handles them); here they are raised by the
+host-side budget tracker pre-flight instead of the allocator callback (ARCHITECTURE.md #6).
+"""
+
+from __future__ import annotations
+
+
+class RapidsTpuError(Exception):
+    """Base class for framework errors."""
+
+
+class RetryOOM(RapidsTpuError):
+    """Device memory pressure: block, spill, and retry the idempotent step."""
+
+
+class SplitAndRetryOOM(RapidsTpuError):
+    """Device memory pressure too high for retry alone: split the input and retry."""
+
+
+class CpuFallbackRequired(RapidsTpuError):
+    """A batch/op cannot execute on device; the planner/exec must take the host path."""
+
+
+class StringWidthExceeded(CpuFallbackRequired):
+    """A string batch exceeds spark.rapids.tpu.string.maxWidth for the fixed-width
+    byte-matrix device layout; process this batch on host."""
+
+    def __init__(self, width: int, limit: int):
+        super().__init__(
+            f"string batch max byte length {width} exceeds device layout limit "
+            f"{limit} (spark.rapids.tpu.string.maxWidth)")
+        self.width = width
+        self.limit = limit

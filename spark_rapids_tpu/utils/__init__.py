@@ -1,0 +1,1 @@
+from . import arm, metrics, tracing  # noqa: F401

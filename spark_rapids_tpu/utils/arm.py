@@ -1,0 +1,60 @@
+"""Resource management idioms (reference `Arm.scala`: withResource/closeOnExcept).
+
+JAX arrays are GC-managed so device memory does not need explicit close, but spill
+handles, host staging buffers, file readers and native allocations do. Everything
+closable in this codebase implements `.close()`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterable, TypeVar
+
+T = TypeVar("T")
+
+
+def with_resource(resource, fn):
+    """Run `fn(resource)`, always closing the resource (even on error)."""
+    try:
+        return fn(resource)
+    finally:
+        _close(resource)
+
+
+def close_on_except(resource, fn):
+    """Run `fn(resource)`; close the resource only if `fn` raises."""
+    try:
+        return fn(resource)
+    except BaseException:
+        _close(resource)
+        raise
+
+
+@contextlib.contextmanager
+def closing(resource):
+    try:
+        yield resource
+    finally:
+        _close(resource)
+
+
+def close_all(resources: Iterable) -> None:
+    err = None
+    for r in resources:
+        try:
+            _close(r)
+        except BaseException as e:  # keep closing the rest
+            err = err or e
+    if err is not None:
+        raise err
+
+
+def _close(r) -> None:
+    if r is None:
+        return
+    if isinstance(r, (list, tuple)):
+        close_all(r)
+        return
+    close = getattr(r, "close", None)
+    if close is not None:
+        close()

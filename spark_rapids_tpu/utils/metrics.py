@@ -1,0 +1,133 @@
+"""Operator metrics (reference `GpuMetric`/`GpuExec.scala:42-150` and
+`GpuTaskMetrics.scala`).
+
+Levels ESSENTIAL < MODERATE < DEBUG; an exec creates metrics at declared levels and the
+session's metrics level filters which are live (dead metrics are no-ops). Timers are
+wall-clock nanoseconds (reference createNanoTimingMetric)."""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict
+
+ESSENTIAL = 0
+MODERATE = 1
+DEBUG = 2
+
+_LEVELS = {"ESSENTIAL": ESSENTIAL, "MODERATE": MODERATE, "DEBUG": DEBUG}
+
+# canonical metric names, mirroring GpuMetric companion object constants
+NUM_OUTPUT_ROWS = "numOutputRows"
+NUM_OUTPUT_BATCHES = "numOutputBatches"
+NUM_INPUT_ROWS = "numInputRows"
+NUM_INPUT_BATCHES = "numInputBatches"
+OP_TIME = "opTime"
+COLLECT_TIME = "collectTime"
+CONCAT_TIME = "concatTime"
+SORT_TIME = "sortTime"
+AGG_TIME = "computeAggTime"
+JOIN_TIME = "joinTime"
+FILTER_TIME = "filterTime"
+BUILD_TIME = "buildTime"
+STREAM_TIME = "streamTime"
+SPILL_TIME = "spillTime"
+READ_TIME = "readTime"
+WRITE_TIME = "writeTime"
+SEMAPHORE_WAIT_TIME = "semaphoreWaitTime"
+PEAK_DEVICE_MEMORY = "peakDevMemory"
+NUM_PARTITIONS = "numPartitions"
+
+
+class Metric:
+    __slots__ = ("name", "level", "_value", "_lock", "live")
+
+    def __init__(self, name: str, level: int = MODERATE, live: bool = True):
+        self.name = name
+        self.level = level
+        self.live = live
+        self._value = 0
+        self._lock = threading.Lock()
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+    def add(self, v: int) -> None:
+        if self.live:
+            with self._lock:
+                self._value += int(v)
+
+    def set(self, v: int) -> None:
+        if self.live:
+            with self._lock:
+                self._value = int(v)
+
+    def set_max(self, v: int) -> None:
+        if self.live:
+            with self._lock:
+                self._value = max(self._value, int(v))
+
+    @contextlib.contextmanager
+    def timed(self):
+        if not self.live:
+            yield
+            return
+        t0 = time.monotonic_ns()
+        try:
+            yield
+        finally:
+            self.add(time.monotonic_ns() - t0)
+
+
+NOOP = Metric("noop", live=False)
+
+
+class MetricsSet:
+    """Per-exec metric dictionary filtered by the session metrics level."""
+
+    def __init__(self, session_level: str = "MODERATE"):
+        self._max_level = _LEVELS[session_level]
+        self._metrics: Dict[str, Metric] = {}
+
+    def create(self, name: str, level: int = MODERATE) -> Metric:
+        if name in self._metrics:
+            return self._metrics[name]
+        m = Metric(name, level, live=(level <= self._max_level))
+        self._metrics[name] = m
+        return m
+
+    def __getitem__(self, name: str) -> Metric:
+        return self._metrics.get(name, NOOP)
+
+    def snapshot(self) -> Dict[str, int]:
+        return {k: m.value for k, m in self._metrics.items() if m.live}
+
+
+class TaskMetrics:
+    """Task-level accumulators (reference GpuTaskMetrics): spill/retry wall time and
+    counts, aggregated across operators within a task."""
+
+    _tls = threading.local()
+
+    def __init__(self):
+        self.semaphore_wait_ns = 0
+        self.retry_count = 0
+        self.split_retry_count = 0
+        self.retry_block_ns = 0
+        self.spill_to_host_ns = 0
+        self.spill_to_disk_ns = 0
+        self.read_spill_ns = 0
+
+    @classmethod
+    def get(cls) -> "TaskMetrics":
+        tm = getattr(cls._tls, "metrics", None)
+        if tm is None:
+            tm = TaskMetrics()
+            cls._tls.metrics = tm
+        return tm
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._tls.metrics = TaskMetrics()

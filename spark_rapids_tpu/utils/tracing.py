@@ -1,0 +1,42 @@
+"""Tracing ranges (reference `NvtxWithMetrics.scala`; NVTX → jax.profiler).
+
+`trace_range` wraps operator regions in `jax.profiler.TraceAnnotation` so xprof captures
+per-operator timelines the way Nsight consumed NVTX ranges, and optionally feeds a timing
+metric at the same time."""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+try:
+    import jax.profiler as _profiler
+    _HAVE_PROFILER = True
+except Exception:  # pragma: no cover
+    _profiler = None
+    _HAVE_PROFILER = False
+
+
+@contextlib.contextmanager
+def trace_range(name: str, metric=None):
+    t0 = time.monotonic_ns() if metric is not None else 0
+    if _HAVE_PROFILER:
+        with _profiler.TraceAnnotation(name):
+            yield
+    else:  # pragma: no cover
+        yield
+    if metric is not None:
+        metric.add(time.monotonic_ns() - t0)
+
+
+def start_profile(logdir: str) -> None:
+    """Start an xprof trace (reference docs/dev/nvtx_profiling.md workflow)."""
+    if not _HAVE_PROFILER:  # pragma: no cover
+        raise RuntimeError("jax.profiler unavailable in this environment")
+    _profiler.start_trace(logdir)
+
+
+def stop_profile() -> None:
+    if not _HAVE_PROFILER:  # pragma: no cover
+        raise RuntimeError("jax.profiler unavailable in this environment")
+    _profiler.stop_trace()

@@ -1,0 +1,22 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh so sharding and
+collective paths are exercised without TPU hardware (the driver separately dry-runs the
+multi-chip path; bench.py runs on the real chip)."""
+
+import os
+
+# Must be set before jax initializes. Forced (not setdefault): the session may point
+# JAX_PLATFORMS at real TPU hardware, but tests always run on the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

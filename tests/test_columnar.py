@@ -1,0 +1,127 @@
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import (
+    batch_from_arrow, batch_from_dict, batch_to_arrow, from_arrow, row_bucket,
+    to_arrow, width_bucket)
+
+
+def test_row_bucket():
+    assert row_bucket(0) == 128
+    assert row_bucket(1) == 128
+    assert row_bucket(128) == 128
+    assert row_bucket(129) == 256
+    assert row_bucket(1000) == 1024
+
+
+def test_width_bucket():
+    assert width_bucket(1) == 8
+    assert width_bucket(9) == 16
+    assert width_bucket(128) == 128
+    assert width_bucket(129) == 256
+
+
+@pytest.mark.parametrize("at,vals", [
+    (pa.int32(), [1, 2, None, 4]),
+    (pa.int64(), [10, None, -3, 2**62]),
+    (pa.float64(), [1.5, None, float("nan"), -0.0]),
+    (pa.bool_(), [True, None, False, True]),
+    (pa.int8(), [1, -1, None, 127]),
+])
+def test_primitive_arrow_roundtrip(at, vals):
+    arr = pa.array(vals, type=at)
+    col, n = from_arrow(arr)
+    assert n == len(vals)
+    assert col.capacity == 128
+    back = to_arrow(col, n)
+    for got, want in zip(back.to_pylist(), arr.to_pylist()):
+        if isinstance(want, float) and want != want:
+            assert got != got  # NaN round-trips as NaN
+        else:
+            assert got == want
+
+
+def test_string_arrow_roundtrip():
+    vals = ["hello", None, "", "wörld", "a" * 300, "x"]
+    arr = pa.array(vals, type=pa.string())
+    col, n = from_arrow(arr)
+    assert col.is_string
+    assert col.string_width == 512  # 300 utf8 bytes -> bucket 512
+    back = to_arrow(col, n)
+    assert back.to_pylist() == vals
+
+
+def test_batch_roundtrip():
+    tbl = pa.table({
+        "a": pa.array([1, 2, None, 4], type=pa.int64()),
+        "b": pa.array(["x", "yy", None, "zzzz"]),
+        "c": pa.array([1.0, 2.5, 3.5, None], type=pa.float64()),
+    })
+    b = batch_from_arrow(tbl)
+    assert b.row_count() == 4
+    assert b.capacity == 128
+    assert np.asarray(b.row_mask()).sum() == 4
+    out = batch_to_arrow(b)
+    assert out.equals(tbl)
+
+
+def test_batch_from_dict_infer():
+    b = batch_from_dict({"i": [1, None, 3], "s": ["a", "b", None],
+                         "f": np.array([1.0, 2.0, 3.0])})
+    assert b.schema.types == (T.LONG, T.STRING, T.DOUBLE)
+    t = batch_to_arrow(b)
+    assert t.column("i").to_pylist() == [1, None, 3]
+    assert t.column("s").to_pylist() == ["a", "b", None]
+
+
+def test_repadded():
+    b = batch_from_dict({"a": np.arange(10, dtype=np.int64)})
+    big = b.repadded(256)
+    assert big.capacity == 256
+    assert big.row_count() == 10
+    t = batch_to_arrow(big)
+    assert t.column("a").to_pylist() == list(range(10))
+
+
+def test_decimal_roundtrip():
+    from decimal import Decimal
+    arr = pa.array([None, Decimal("1.23"), Decimal("-99999.99")],
+                   type=pa.decimal128(10, 2))
+    col, n = from_arrow(arr)
+    assert col.dtype == T.DecimalType(10, 2)
+    back = to_arrow(col, n)
+    assert back.to_pylist() == arr.to_pylist()
+
+
+def test_date_timestamp_roundtrip():
+    d = pa.array([0, 19000, None], type=pa.date32())
+    ts = pa.array([0, 1700000000_000000, None], type=pa.timestamp("us", tz="UTC"))
+    cd, n = from_arrow(d)
+    ct, _ = from_arrow(ts)
+    assert cd.dtype == T.DATE and ct.dtype == T.TIMESTAMP
+    assert to_arrow(cd, n).to_pylist() == d.to_pylist()
+    assert to_arrow(ct, n).to_pylist() == ts.to_pylist()
+
+
+def test_int64_nulls_precision():
+    # regression: nullable int64 must not round-trip through float64
+    arr = pa.array([2**62 + 1, None, 5], type=pa.int64())
+    col, n = from_arrow(arr)
+    assert to_arrow(col, n).to_pylist() == [2**62 + 1, None, 5]
+
+
+def test_string_width_limit():
+    from spark_rapids_tpu.errors import StringWidthExceeded
+    from spark_rapids_tpu.config import get_default_conf
+    limit = get_default_conf().string_max_width
+    with pytest.raises(StringWidthExceeded):
+        from_arrow(pa.array(["x" * (limit + 1)]))
+
+
+def test_unsupported_scalar_type_message():
+    from decimal import Decimal
+    arr = pa.array([Decimal("1")], type=pa.decimal128(20, 2))
+    with pytest.raises(TypeError, match="wide decimal"):
+        from_arrow(arr)
