@@ -1,0 +1,2 @@
+from .hostbatch import (HostBatch, host_batch_from_arrow, host_batch_to_arrow,  # noqa: F401
+                        host_vec_from_arrow)

@@ -1,0 +1,120 @@
+"""Host (CPU-engine) columnar batches.
+
+Mirrors the reference's host-side vectors (`RapidsHostColumnVector.java`,
+`RapidsHostColumnVectorCore.java`): same logical layout as the device columns (data +
+validity + byte-matrix strings) but numpy arrays at EXACT logical length — no padding,
+no traced counts. The CPU engine evaluates the same xp-generic expression kernels over
+these, making it the differential-testing peer that CPU Spark is in the reference's
+harness (SURVEY.md §4)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .. import types as T
+from ..columnar.batch import Schema
+from ..columnar.padding import width_bucket
+from ..expr.base import Vec
+
+__all__ = ["HostBatch", "host_batch_from_arrow", "host_batch_to_arrow", "host_vec_from_arrow"]
+
+
+@dataclasses.dataclass
+class HostBatch:
+    schema: Schema
+    vecs: List[Vec]
+    num_rows: int
+
+    def vec(self, i: int) -> Vec:
+        return self.vecs[i]
+
+
+def host_vec_from_arrow(arr) -> Vec:
+    import pyarrow as pa
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    dtype = T.from_arrow(arr.type)
+    n = len(arr)
+    valid = np.ones(n, dtype=bool) if arr.null_count == 0 else \
+        np.asarray(arr.is_valid())
+    if isinstance(dtype, T.StringType):
+        la = arr.cast(pa.large_string())
+        buffers = la.buffers()
+        offsets = np.frombuffer(buffers[1], dtype=np.int64, count=n + 1,
+                                offset=la.offset * 8)
+        databuf = np.frombuffer(buffers[2], dtype=np.uint8) if buffers[2] else \
+            np.zeros(0, np.uint8)
+        lens = np.where(valid, np.diff(offsets), 0).astype(np.int32)
+        w = width_bucket(int(lens.max()) if n and lens.size else 1)
+        chars = np.zeros((n, w), dtype=np.uint8)
+        if n:
+            row_id = np.repeat(np.arange(n), lens)
+            if row_id.size:
+                out_starts = np.concatenate(([0], np.cumsum(lens)[:-1]))
+                within = np.arange(row_id.size) - np.repeat(out_starts, lens)
+                src = np.repeat(offsets[:-1], lens) + within
+                chars[row_id, within] = databuf[src]
+        return Vec(dtype, chars, valid, lens)
+    npdt = dtype.np_dtype
+    if npdt is None:
+        raise TypeError(f"type not host-vec-backed: {arr.type}")
+    if isinstance(dtype, T.DecimalType):
+        vals = np.array([int(v.as_py().scaleb(dtype.scale)) if v.is_valid else 0
+                         for v in arr], dtype=np.int64)
+    elif isinstance(dtype, (T.TimestampType, T.DateType)):
+        ints = arr.cast(pa.int64() if isinstance(dtype, T.TimestampType)
+                        else pa.int32())
+        vals = ints.fill_null(0).to_numpy(zero_copy_only=False)
+    elif arr.null_count:
+        zero = False if isinstance(dtype, T.BooleanType) else 0
+        vals = arr.fill_null(zero).to_numpy(zero_copy_only=False)
+    else:
+        vals = arr.to_numpy(zero_copy_only=False)
+    if np.issubdtype(np.asarray(vals).dtype, np.floating) and not valid.all():
+        vals = np.where(valid, vals, 0.0)
+    return Vec(dtype, np.ascontiguousarray(vals).astype(npdt, copy=False), valid)
+
+
+def host_batch_from_arrow(table) -> HostBatch:
+    vecs = [host_vec_from_arrow(table.column(n)) for n in table.schema.names]
+    return HostBatch(Schema.from_arrow(table.schema), vecs, table.num_rows)
+
+
+def host_vec_to_arrow(v: Vec, num_rows: Optional[int] = None):
+    import pyarrow as pa
+    n = num_rows if num_rows is not None else v.validity.shape[0]
+    valid = np.asarray(v.validity[:n]).astype(bool)
+    mask = ~valid
+    if v.is_string:
+        chars = np.asarray(v.data[:n])
+        lens = np.where(valid, np.asarray(v.lengths[:n]), 0).astype(np.int64)
+        w = chars.shape[1] if chars.ndim == 2 else 0
+        if n and w:
+            keep = np.arange(w)[None, :] < lens[:, None]
+            flat = chars[keep]
+        else:
+            flat = np.zeros(0, np.uint8)
+        offsets = np.concatenate(([0], np.cumsum(lens)))
+        return pa.Array.from_buffers(
+            pa.large_string(), n,
+            [pa.py_buffer(np.packbits(valid, bitorder="little").tobytes()),
+             pa.py_buffer(offsets.astype(np.int64).tobytes()),
+             pa.py_buffer(flat.tobytes())],
+            null_count=int(mask.sum())).cast(pa.string())
+    vals = np.asarray(v.data[:n])
+    at = T.to_arrow(v.dtype)
+    if isinstance(v.dtype, T.DecimalType):
+        import decimal as _d
+        py = [(_d.Decimal(int(x)).scaleb(-v.dtype.scale) if m else None)
+              for x, m in zip(vals, valid)]
+        return pa.array(py, type=at)
+    return pa.array(vals, type=at, mask=mask if mask.any() else None)
+
+
+def host_batch_to_arrow(b: HostBatch):
+    import pyarrow as pa
+    arrays = [host_vec_to_arrow(v, b.num_rows) for v in b.vecs]
+    return pa.table(arrays, schema=b.schema.to_arrow())

@@ -1,0 +1,32 @@
+from .base import (Expression, LeafExpression, Literal, AttributeReference,  # noqa: F401
+                   BoundReference, Alias, Vec, EvalContext, bind_references,
+                   output_name)
+from .arithmetic import (Add, Subtract, Multiply, Divide, IntegralDivide,  # noqa: F401
+                         Remainder, Pmod, UnaryMinus, Abs)
+from .predicates import (EqualTo, EqualNullSafe, LessThan, LessThanOrEqual,  # noqa: F401
+                         GreaterThan, GreaterThanOrEqual, And, Or, Not, In)
+from .nullexprs import IsNull, IsNotNull, IsNaN, Coalesce, NaNvl  # noqa: F401
+from .conditional import If, CaseWhen, Least, Greatest  # noqa: F401
+from .math_ import (Sqrt, Exp, Log, Log10, Log2, Pow, Floor, Ceil, Round,  # noqa: F401
+                    Signum, Sin, Cos, Tan, Asin, Acos, Atan, Sinh, Cosh, Tanh,
+                    Cbrt, ToDegrees, ToRadians)
+from .bitwise import (BitwiseAnd, BitwiseOr, BitwiseXor, BitwiseNot,  # noqa: F401
+                      ShiftLeft, ShiftRight, ShiftRightUnsigned)
+from .strings import (Length, Upper, Lower, Substring, Concat, StartsWith,  # noqa: F401
+                      EndsWith, Contains, StringTrim, StringTrimLeft,
+                      StringTrimRight)
+from .datetime_ import (Year, Month, DayOfMonth, Quarter, DayOfWeek, WeekDay,  # noqa: F401
+                        DayOfYear, Hour, Minute, Second, DateAdd, DateSub,
+                        DateDiff, UnixTimestampFromTs)
+from .hashing import Murmur3Hash, hash_vecs  # noqa: F401
+from .cast import Cast, device_supported as cast_device_supported  # noqa: F401
+from .aggregates import (AggregateFunction, Sum, Count, Min, Max, Average,  # noqa: F401
+                         First, Last, CountDistinct)
+
+
+def col(name):  # convenience constructors for tests / DataFrame API
+    return AttributeReference(name)
+
+
+def lit(value, dtype=None):
+    return Literal(value, dtype)

@@ -1,0 +1,178 @@
+"""Aggregate function expressions (reference `AggregateFunctions.scala`: GpuSum,
+GpuCount, GpuMin, GpuMax, GpuAverage, GpuFirst, GpuLast...).
+
+Like the reference, each aggregate declares its partial (update) and final (merge)
+semantics; the hash-aggregate exec lowers them to sort-based segmented reductions on
+device (ops/segmented.py). `Sum` on integrals widens to LONG; `Average` carries a
+(sum, count) pair through the partial phase — the same buffer layout the reference
+uses for its partial aggregates."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from .. import types as T
+from .base import Expression
+
+__all__ = ["AggregateFunction", "Sum", "Count", "Min", "Max", "Average", "First",
+           "Last", "CountDistinct"]
+
+
+class AggregateFunction(Expression):
+    """Declarative aggregate: the exec consumes these descriptors."""
+
+    # segmented-reduce op names used in the update phase, one per partial buffer
+    update_ops: List[str] = []
+    # ops merging partial buffers across batches/partitions
+    merge_ops: List[str] = []
+
+    def __init__(self, child: Optional[Expression] = None):
+        super().__init__([] if child is None else [child])
+
+    @property
+    def child(self) -> Optional[Expression]:
+        return self.children[0] if self.children else None
+
+    # types of the partial aggregation buffers
+    def partial_types(self) -> List[T.DataType]:
+        raise NotImplementedError
+
+    # produce the final value from partial buffers (array-level, xp-generic)
+    def evaluate_final(self, xp, partials, counts):
+        raise NotImplementedError
+
+    @property
+    def nullable(self):
+        return True
+
+
+class Sum(AggregateFunction):
+    update_ops = ["sum"]
+    merge_ops = ["sum"]
+
+    @property
+    def data_type(self):
+        ct = self.child.data_type
+        if T.is_integral(ct):
+            return T.LONG
+        if isinstance(ct, T.DecimalType):
+            return T.DecimalType.bounded(ct.precision + 10, ct.scale)
+        return T.DOUBLE
+
+    def partial_types(self):
+        return [self.data_type]
+
+    def evaluate_final(self, xp, partials, counts):
+        return partials[0]
+
+
+class Count(AggregateFunction):
+    """count(expr) or count(*) (child None)."""
+    update_ops = ["count"]
+    merge_ops = ["sum"]
+
+    @property
+    def data_type(self):
+        return T.LONG
+
+    @property
+    def nullable(self):
+        return False
+
+    def partial_types(self):
+        return [T.LONG]
+
+    def evaluate_final(self, xp, partials, counts):
+        return partials[0]
+
+
+class Min(AggregateFunction):
+    update_ops = ["min"]
+    merge_ops = ["min"]
+
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+    def partial_types(self):
+        return [self.data_type]
+
+    def evaluate_final(self, xp, partials, counts):
+        return partials[0]
+
+
+class Max(AggregateFunction):
+    update_ops = ["max"]
+    merge_ops = ["max"]
+
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+    def partial_types(self):
+        return [self.data_type]
+
+    def evaluate_final(self, xp, partials, counts):
+        return partials[0]
+
+
+class Average(AggregateFunction):
+    update_ops = ["sum", "count"]
+    merge_ops = ["sum", "sum"]
+
+    @property
+    def data_type(self):
+        ct = self.child.data_type
+        if isinstance(ct, T.DecimalType):
+            return T.DecimalType.bounded(ct.precision + 4, ct.scale + 4)
+        return T.DOUBLE
+
+    def partial_types(self):
+        return [T.DOUBLE, T.LONG]
+
+    def evaluate_final(self, xp, partials, counts):
+        s, c = partials
+        return xp.where(c > 0, s / xp.maximum(c, 1), np.float64(0.0))
+
+
+class First(AggregateFunction):
+    def __init__(self, child, ignore_nulls: bool = False):
+        super().__init__(child)
+        self.ignore_nulls = ignore_nulls
+
+    update_ops = ["first"]
+    merge_ops = ["first"]
+
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+    def partial_types(self):
+        return [self.data_type]
+
+    def evaluate_final(self, xp, partials, counts):
+        return partials[0]
+
+
+class Last(First):
+    update_ops = ["last"]
+    merge_ops = ["last"]
+
+
+class CountDistinct(AggregateFunction):
+    """count(distinct x): planner rewrites into dedup + count (reference handles via
+    Spark's two-phase distinct rewrite); marked here for the API surface."""
+    update_ops = ["count_distinct"]
+    merge_ops = ["sum"]
+
+    @property
+    def data_type(self):
+        return T.LONG
+
+    def partial_types(self):
+        return [T.LONG]
+
+    def evaluate_final(self, xp, partials, counts):
+        return partials[0]

@@ -1,0 +1,204 @@
+"""Arithmetic expressions with Spark/Java semantics.
+
+Reference: `org/apache/spark/sql/rapids/arithmetic.scala` (GpuAdd/GpuSubtract/GpuMultiply/
+GpuDivide/GpuIntegralDivide/GpuRemainder/GpuPmod/GpuUnaryMinus/GpuAbs). Semantics notes:
+  * integral +,-,* wrap (Java two's complement) in non-ANSI mode;
+  * Divide always yields DOUBLE (inputs implicitly cast); x/0 -> null (non-ANSI);
+  * IntegralDivide / Remainder / Pmod truncate toward zero (Java), unlike numpy's
+    floor semantics — implemented explicitly;
+  * ANSI overflow/zero-division raising is implemented on the CPU engine and marked
+    has_side_effects for planning; the TPU engine tags ANSI arithmetic unsupported in
+    this round (planner falls back), matching the reference's per-op tagging approach.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import types as T
+from .base import Expression, EvalContext, Vec, and_validity
+
+__all__ = ["Add", "Subtract", "Multiply", "Divide", "IntegralDivide", "Remainder",
+           "Pmod", "UnaryMinus", "Abs", "cast_data", "promote_args"]
+
+
+def cast_data(xp, vec: Vec, dt: T.DataType) -> Vec:
+    """Backend-generic numeric dtype change (no semantic checks — used for implicit
+    widening only; the full checked matrix lives in cast.py)."""
+    if vec.dtype == dt:
+        return vec
+    return Vec(dt, vec.data.astype(dt.np_dtype), vec.validity)
+
+
+def promote_args(xp, left: Vec, right: Vec):
+    dt = T.numeric_promote(left.dtype, right.dtype)
+    return cast_data(xp, left, dt), cast_data(xp, right, dt), dt
+
+
+class BinaryExpression(Expression):
+    def __init__(self, left: Expression, right: Expression):
+        super().__init__([left, right])
+
+    @property
+    def left(self):
+        return self.children[0]
+
+    @property
+    def right(self):
+        return self.children[1]
+
+
+class BinaryArithmetic(BinaryExpression):
+    @property
+    def data_type(self) -> T.DataType:
+        return T.numeric_promote(self.left.data_type, self.right.data_type)
+
+    def _compute(self, ctx: EvalContext, l: Vec, r: Vec) -> Vec:
+        l, r, dt = promote_args(ctx.xp, l, r)
+        validity = and_validity(ctx.xp, l.validity, r.validity)
+        data = self._op(ctx.xp, l.data, r.data)
+        return Vec(dt, data.astype(dt.np_dtype, copy=False), validity)
+
+    def _op(self, xp, a, b):
+        raise NotImplementedError
+
+
+class Add(BinaryArithmetic):
+    def _op(self, xp, a, b):
+        return a + b
+
+
+class Subtract(BinaryArithmetic):
+    def _op(self, xp, a, b):
+        return a - b
+
+
+class Multiply(BinaryArithmetic):
+    def _op(self, xp, a, b):
+        return a * b
+
+
+class Divide(BinaryExpression):
+    """Spark Divide: result DOUBLE, x/0 -> null (non-ANSI)."""
+
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+    @property
+    def nullable(self):
+        return True
+
+    def _compute(self, ctx: EvalContext, l: Vec, r: Vec) -> Vec:
+        xp = ctx.xp
+        a = l.data.astype(np.float64)
+        b = r.data.astype(np.float64)
+        zero = b == 0.0
+        validity = and_validity(xp, l.validity, r.validity) & ~zero
+        if ctx.xp is np:
+            with np.errstate(divide="ignore", invalid="ignore"):
+                data = np.where(zero, 0.0, a / b)
+        else:
+            data = xp.where(zero, 0.0, a / xp.where(zero, 1.0, b))
+        return Vec(T.DOUBLE, data, validity)
+
+
+def _trunc_div(xp, a, b):
+    """Java integer division: truncates toward zero; INT_MIN / -1 wraps to INT_MIN.
+    No abs() — abs(INT_MIN) overflows; derive from floor division + remainder."""
+    safe_b = xp.where(b == -1, 1, b)  # avoid INT_MIN // -1 overflow inside //
+    q = a // safe_b
+    r = a - q * safe_b
+    q = q + ((r != 0) & ((a < 0) != (b < 0)))
+    return xp.where(b == -1, -a, q)  # -INT_MIN wraps to INT_MIN, matching Java
+
+
+class IntegralDivide(BinaryExpression):
+    """`div` operator: LONG result, truncation toward zero, /0 -> null."""
+
+    @property
+    def data_type(self):
+        return T.LONG
+
+    @property
+    def nullable(self):
+        return True
+
+    def _compute(self, ctx: EvalContext, l: Vec, r: Vec) -> Vec:
+        xp = ctx.xp
+        a = l.data.astype(np.int64)
+        b = r.data.astype(np.int64)
+        zero = b == 0
+        validity = and_validity(xp, l.validity, r.validity) & ~zero
+        safe_b = xp.where(zero, 1, b)
+        data = _trunc_div(xp, a, safe_b)
+        return Vec(T.LONG, xp.where(zero, 0, data), validity)
+
+
+class Remainder(BinaryArithmetic):
+    """Java %: sign follows dividend; x%0 -> null."""
+
+    @property
+    def nullable(self):
+        return True
+
+    def _compute(self, ctx: EvalContext, l: Vec, r: Vec) -> Vec:
+        xp = ctx.xp
+        l, r, dt = promote_args(xp, l, r)
+        zero = r.data == 0 if not T.is_floating(dt) else r.data == 0.0
+        validity = and_validity(xp, l.validity, r.validity) & ~zero
+        if T.is_floating(dt):
+            data = xp.where(zero, 0.0, xp.fmod(l.data, xp.where(zero, 1.0, r.data)))
+        else:
+            b = xp.where(zero, 1, r.data)
+            data = l.data - b * _trunc_div(xp, l.data, b)
+        return Vec(dt, data.astype(dt.np_dtype, copy=False), validity)
+
+
+class Pmod(BinaryArithmetic):
+    """Positive modulus."""
+
+    @property
+    def nullable(self):
+        return True
+
+    def _compute(self, ctx: EvalContext, l: Vec, r: Vec) -> Vec:
+        xp = ctx.xp
+        l, r, dt = promote_args(xp, l, r)
+        zero = r.data == 0 if not T.is_floating(dt) else r.data == 0.0
+        validity = and_validity(xp, l.validity, r.validity) & ~zero
+        if T.is_floating(dt):
+            b = xp.where(zero, 1.0, r.data)
+            m = xp.fmod(l.data, b)
+            data = xp.where(m < 0, xp.fmod(m + b, b), m)
+            data = xp.where(zero, 0.0, data)
+        else:
+            b = xp.where(zero, 1, r.data)
+            m = l.data - b * _trunc_div(xp, l.data, b)
+            data = xp.where(m < 0, m + xp.abs(b), m)
+        return Vec(dt, data.astype(dt.np_dtype, copy=False), validity)
+
+
+class UnaryMinus(Expression):
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def _compute(self, ctx, c: Vec) -> Vec:
+        return Vec(c.dtype, (-c.data).astype(c.dtype.np_dtype, copy=False),
+                   c.validity)
+
+
+class Abs(Expression):
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def _compute(self, ctx, c: Vec) -> Vec:
+        return Vec(c.dtype, ctx.xp.abs(c.data), c.validity)

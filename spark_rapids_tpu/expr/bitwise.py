@@ -1,0 +1,76 @@
+"""Bitwise expressions (reference GpuBitwiseAnd/Or/Xor/Not, GpuShiftLeft/Right/
+RightUnsigned in arithmetic.scala / mathExpressions.scala). Shift semantics follow
+Java: shift amount masked by 31/63 depending on operand width."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import types as T
+from .base import Expression, EvalContext, Vec, and_validity
+from .arithmetic import BinaryArithmetic
+
+__all__ = ["BitwiseAnd", "BitwiseOr", "BitwiseXor", "BitwiseNot", "ShiftLeft",
+           "ShiftRight", "ShiftRightUnsigned"]
+
+
+class BitwiseAnd(BinaryArithmetic):
+    def _op(self, xp, a, b):
+        return a & b
+
+
+class BitwiseOr(BinaryArithmetic):
+    def _op(self, xp, a, b):
+        return a | b
+
+
+class BitwiseXor(BinaryArithmetic):
+    def _op(self, xp, a, b):
+        return a ^ b
+
+
+class BitwiseNot(Expression):
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def _compute(self, ctx, c: Vec) -> Vec:
+        return Vec(c.dtype, ~c.data, c.validity)
+
+
+class _Shift(Expression):
+    def __init__(self, value, amount):
+        super().__init__([value, amount])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def _mask(self, dt):
+        return 63 if isinstance(dt, T.LongType) else 31
+
+    def _compute(self, ctx: EvalContext, v: Vec, s: Vec) -> Vec:
+        xp = ctx.xp
+        amt = (s.data.astype(np.int32) & self._mask(v.dtype))
+        data = self._op(xp, v.data, amt, v.dtype)
+        return Vec(v.dtype, data.astype(v.dtype.np_dtype, copy=False),
+                   and_validity(xp, v.validity, s.validity))
+
+
+class ShiftLeft(_Shift):
+    def _op(self, xp, a, amt, dt):
+        return a << amt
+
+
+class ShiftRight(_Shift):
+    def _op(self, xp, a, amt, dt):
+        return a >> amt  # arithmetic shift on signed ints (Java >>)
+
+
+class ShiftRightUnsigned(_Shift):
+    def _op(self, xp, a, amt, dt):
+        udt = np.uint64 if isinstance(dt, T.LongType) else np.uint32
+        return (a.astype(udt) >> amt.astype(udt)).astype(dt.np_dtype)

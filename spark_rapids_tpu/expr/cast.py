@@ -1,0 +1,358 @@
+"""Cast expression — Spark-exact cast matrix (reference `GpuCast.scala` 1,567 lines +
+`CastChecks` `TypeChecks.scala:1341`).
+
+Round-1 device coverage (the planner consults `device_supported`):
+  numeric<->numeric (Java narrowing: integral wraps, float->int clamps w/ NaN->0),
+  bool<->numeric, numeric->string (integral on device; float->string is host-assisted),
+  string->integral/bool (trimmed, sign, invalid -> null), date->string, string->date
+  (ISO), date<->timestamp, timestamp<->long, decimal(<=18) rescale.
+ANSI raise-on-overflow is CPU-engine only this round; the planner tags ANSI casts for
+fallback the way the reference gates ansiEnabled corner cases."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import types as T
+from .base import Expression, EvalContext, Vec
+from .datetime_ import civil_from_days, days_from_civil
+
+__all__ = ["Cast", "device_supported"]
+
+_US_PER_DAY = 86_400_000_000
+_INT_BOUNDS = {
+    np.dtype(np.int8): (-128, 127),
+    np.dtype(np.int16): (-32768, 32767),
+    np.dtype(np.int32): (-2**31, 2**31 - 1),
+    np.dtype(np.int64): (-2**63, 2**63 - 1),
+}
+
+
+def device_supported(src: T.DataType, dst: T.DataType) -> bool:
+    if src == dst:
+        return True
+    num = (T.BooleanType, T.ByteType, T.ShortType, T.IntegerType, T.LongType,
+           T.FloatType, T.DoubleType)
+    if isinstance(src, num) and isinstance(dst, num):
+        return True
+    if isinstance(src, num) and isinstance(dst, T.StringType):
+        return not T.is_floating(src)  # float->string formatting is host-assisted
+    if isinstance(src, T.StringType):
+        return isinstance(dst, (T.ByteType, T.ShortType, T.IntegerType,
+                                T.LongType, T.BooleanType, T.DateType))
+    if isinstance(src, T.DateType):
+        return isinstance(dst, (T.StringType, T.TimestampType, T.IntegerType))
+    if isinstance(src, T.TimestampType):
+        return isinstance(dst, (T.DateType, T.LongType))
+    if isinstance(src, T.LongType) and isinstance(dst, T.TimestampType):
+        return True
+    if isinstance(src, T.DecimalType) and isinstance(dst, T.DecimalType):
+        return src.precision <= 18 and dst.precision <= 18
+    if isinstance(src, num) and isinstance(dst, T.DecimalType):
+        return dst.precision <= 18 and not T.is_floating(src)
+    if isinstance(src, T.DecimalType) and isinstance(dst, num):
+        return src.precision <= 18
+    return False
+
+
+class Cast(Expression):
+    def __init__(self, child: Expression, to: T.DataType, ansi: bool = False):
+        super().__init__([child])
+        self.to = to
+        self.ansi = ansi
+
+    @property
+    def data_type(self):
+        return self.to
+
+    @property
+    def nullable(self):
+        return True  # many casts can produce null from non-null input
+
+    def _compute(self, ctx: EvalContext, c: Vec) -> Vec:
+        src, dst = c.dtype, self.to
+        if src == dst:
+            return c
+        xp = ctx.xp
+        if isinstance(dst, T.StringType):
+            return _to_string(xp, c)
+        if isinstance(src, T.StringType):
+            return _from_string(xp, c, dst, self.ansi)
+        if isinstance(src, T.DateType) and isinstance(dst, T.TimestampType):
+            return Vec(dst, c.data.astype(np.int64) * _US_PER_DAY, c.validity)
+        if isinstance(src, T.TimestampType) and isinstance(dst, T.DateType):
+            return Vec(dst, (c.data // _US_PER_DAY).astype(np.int32), c.validity)
+        if isinstance(src, T.TimestampType) and isinstance(dst, T.LongType):
+            return Vec(dst, c.data // 1_000_000, c.validity)
+        if isinstance(src, T.LongType) and isinstance(dst, T.TimestampType):
+            return Vec(dst, c.data * 1_000_000, c.validity)
+        if isinstance(src, T.DecimalType) or isinstance(dst, T.DecimalType):
+            return _decimal_cast(xp, c, dst, self.ansi)
+        return _numeric_cast(xp, c, dst)
+
+    def __repr__(self):
+        return f"cast({self.children[0]!r} as {self.to.simple_string()})"
+
+
+def _numeric_cast(xp, c: Vec, dst: T.DataType) -> Vec:
+    sd, dd = c.dtype, dst
+    a = c.data
+    if isinstance(dd, T.BooleanType):
+        return Vec(dst, a != 0, c.validity)
+    if isinstance(sd, T.BooleanType):
+        return Vec(dst, a.astype(dd.np_dtype), c.validity)
+    if T.is_floating(sd) and T.is_integral(dd):
+        # Java (long)(double): NaN -> 0, clamp to bounds, truncate toward zero.
+        # float(2^63-1) rounds UP to 2^63, so clipping to float(hi) then converting
+        # wraps to INT64_MIN — compare against the exact power-of-two bound instead.
+        lo, hi = _INT_BOUNDS[dd.np_dtype]
+        upper = np.float64(float(hi) + 1.0)  # 2^7/2^15/2^31/2^63, all exact
+        t = xp.trunc(a.astype(np.float64))
+        t = xp.where(xp.isnan(a), 0.0, t)
+        pos_ovf = t >= upper
+        neg_ovf = t < -upper  # t == -upper (== lo) is exactly representable/valid
+        safe = xp.where(pos_ovf | neg_ovf, 0.0, t)
+        i = safe.astype(np.int64)
+        i = xp.where(pos_ovf, hi, xp.where(neg_ovf, lo, i))
+        return Vec(dst, i.astype(dd.np_dtype), c.validity)
+    # integral narrowing wraps (Java); widening and int<->float direct
+    return Vec(dst, a.astype(dd.np_dtype), c.validity)
+
+
+def _digits_to_matrix(xp, value_i64, width: int):
+    """Render signed integers into a byte matrix (right-aligned digits computed by
+    repeated division, then left-shifted into place via gather)."""
+    neg = value_i64 < 0
+    # magnitude digit extraction; abs of INT64_MIN overflows, handle via uint64
+    mag = xp.where(neg, (-(value_i64 + 1)).astype(np.uint64) + np.uint64(1),
+                   value_i64.astype(np.uint64))
+    n = value_i64.shape[0]
+    digs = []
+    rem = mag
+    for _ in range(width):
+        digs.append((rem % np.uint64(10)).astype(np.uint8) + np.uint8(ord("0")))
+        rem = rem // np.uint64(10)
+    # digs[k] = digit at 10^k; significant count via integer threshold compares
+    mat = xp.stack(digs[::-1], axis=1)  # most-significant first, width cols
+    ndig = xp.ones(n, dtype=np.int32)
+    for k in range(1, 20):
+        ndig = ndig + (mag >= np.uint64(10 ** k)).astype(np.int32)
+    total = ndig + neg.astype(np.int32)
+    j = xp.arange(width, dtype=np.int32)[None, :]
+    # output j: '-' at j=0 if neg; digit index = width - ndig + (j - neg)
+    src_idx = xp.clip(width - ndig[:, None] + j - neg.astype(np.int32)[:, None],
+                      0, width - 1)
+    shifted = xp.take_along_axis(mat, src_idx, axis=1)
+    out = xp.where((j == 0) & neg[:, None], np.uint8(ord("-")), shifted)
+    out = xp.where(j < total[:, None], out, np.uint8(0))
+    return out, total
+
+
+def _to_string(xp, c: Vec) -> Vec:
+    sd = c.dtype
+    if isinstance(sd, T.BooleanType):
+        w = 8
+        true_row = np.zeros(w, np.uint8)
+        true_row[:4] = np.frombuffer(b"true", np.uint8)
+        false_row = np.zeros(w, np.uint8)
+        false_row[:5] = np.frombuffer(b"false", np.uint8)
+        data = xp.where(c.data[:, None], xp.asarray(true_row), xp.asarray(false_row))
+        lens = xp.where(c.data, 4, 5).astype(np.int32)
+        return Vec(T.STRING, data, c.validity, lens)
+    if T.is_integral(sd):
+        out, total = _digits_to_matrix(xp, c.data.astype(np.int64), 24)
+        return Vec(T.STRING, out, c.validity, total.astype(np.int32))
+    if isinstance(sd, T.DateType):
+        y, m, d = civil_from_days(xp, c.data)
+        w = 16
+        n = c.data.shape[0]
+        out = xp.zeros((n, w), dtype=np.uint8)
+        cols = []
+        # YYYY-MM-DD ; supports years 0..9999 (wider years host-fallback)
+        vals = [y // 1000 % 10, y // 100 % 10, y // 10 % 10, y % 10,
+                None, m // 10, m % 10, None, d // 10, d % 10]
+        for v in vals:
+            if v is None:
+                cols.append(xp.full((n,), np.uint8(ord("-")), dtype=np.uint8))
+            else:
+                cols.append(v.astype(np.uint8) + np.uint8(ord("0")))
+        data = xp.stack(cols, axis=1)
+        data = xp.pad(data, ((0, 0), (0, w - 10)))
+        return Vec(T.STRING, data, c.validity,
+                   xp.full((n,), 10, dtype=np.int32))
+    if T.is_floating(sd) and xp is np:
+        # CPU engine: Java-compatible float formatting via repr-ish path
+        n = c.data.shape[0]
+        strs = [_java_double_str(float(v), isinstance(sd, T.FloatType))
+                for v in c.data]
+        from ..columnar.padding import width_bucket
+        lens = np.array([len(s) for s in strs], dtype=np.int32)
+        w = width_bucket(int(lens.max()) if n else 1)
+        out = np.zeros((n, w), dtype=np.uint8)
+        for i, s in enumerate(strs):
+            out[i, :len(s)] = np.frombuffer(s.encode(), np.uint8)
+        return Vec(T.STRING, out, c.validity, lens)
+    raise TypeError(f"cast {sd} -> string not device-supported")
+
+
+def _java_double_str(v: float, is_float: bool) -> str:
+    import math
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "Infinity" if v > 0 else "-Infinity"
+    if v == int(v) and abs(v) < 1e7:
+        return f"{int(v)}.0"
+    r = repr(np.float32(v).item() if is_float else v)
+    if "e" in r:
+        m, e = r.split("e")
+        ei = int(e)
+        if "." not in m:
+            m += ".0"
+        return f"{m}E{ei}" if ei < 0 else f"{m}E{ei}"
+    return r
+
+
+def _from_string(xp, c: Vec, dst: T.DataType, ansi: bool) -> Vec:
+    chars, lengths = c.data, c.lengths
+    n, w = chars.shape
+    j = xp.arange(w, dtype=np.int32)[None, :]
+    in_row = j < lengths[:, None]
+    # trim ASCII whitespace
+    is_ws = (chars <= 0x20) & in_row
+    content = in_row & ~is_ws
+    any_c = xp.any(content, axis=1)
+    first = xp.argmax(content, axis=1).astype(np.int32)
+    last = (w - 1 - xp.argmax(content[:, ::-1], axis=1)).astype(np.int32)
+
+    if isinstance(dst, T.BooleanType):
+        return _parse_bool(xp, c, first, last, any_c)
+    if isinstance(dst, T.DateType):
+        return _parse_date(xp, c, first, last, any_c)
+
+    # integral parse: [+-]?digits, Java Long.parseLong-style overflow detection
+    # (accumulate NEGATIVE so Long.MIN_VALUE parses; overflow -> null, not wrap)
+    neg = (xp.take_along_axis(chars, first[:, None], axis=1)[:, 0]
+           == np.uint8(ord("-")))
+    plus = (xp.take_along_axis(chars, first[:, None], axis=1)[:, 0]
+            == np.uint8(ord("+")))
+    dstart = first + (neg | plus).astype(np.int32)
+    in_num = (j >= dstart[:, None]) & (j <= last[:, None])
+    digit = chars - np.uint8(ord("0"))
+    is_digit = (digit <= 9) & in_num
+    valid_num = any_c & xp.all(~in_num | is_digit, axis=1) & (last >= dstart)
+    limit = xp.where(neg, np.int64(-2 ** 63), np.int64(-(2 ** 63 - 1)))
+    multmin = np.int64(-922337203685477580)  # trunc(limit / 10), same both signs
+    acc = xp.zeros(n, dtype=np.int64)
+    ovf = xp.zeros(n, dtype=bool)
+    for k in range(w):
+        active = in_num[:, k] & valid_num
+        d = digit[:, k].astype(np.int64)
+        ovf = ovf | (active & (acc < multmin))
+        acc10 = acc * 10
+        ovf = ovf | (active & (acc10 < limit + d))
+        acc = xp.where(active, acc10 - d, acc)
+    signed = xp.where(neg, acc, -acc)
+
+    lo, hi = _INT_BOUNDS[dst.np_dtype]
+    in_range = (signed >= lo) & (signed <= hi) & ~ovf
+    validity = c.validity & valid_num & in_range
+    return Vec(dst, xp.where(in_range, signed, 0).astype(dst.np_dtype), validity)
+
+
+def _parse_bool(xp, c: Vec, first, last, any_c):
+    """Accepts true/false/t/f/yes/no/y/n/1/0 (Spark StringUtils.isTrueString)."""
+    chars, n = c.data, c.data.shape[0]
+    ln = last - first + 1
+
+    def word_is(word: bytes):
+        m = ln == len(word)
+        for i, b in enumerate(word):
+            ch = xp.take_along_axis(
+                chars, xp.clip(first + i, 0, chars.shape[1] - 1)[:, None],
+                axis=1)[:, 0]
+            lower = xp.where((ch >= 65) & (ch <= 90), ch + np.uint8(32), ch)
+            m = m & (lower == np.uint8(b))
+        return m
+
+    t = word_is(b"true") | word_is(b"t") | word_is(b"yes") | word_is(b"y") | \
+        word_is(b"1")
+    f = word_is(b"false") | word_is(b"f") | word_is(b"no") | word_is(b"n") | \
+        word_is(b"0")
+    return Vec(T.BOOLEAN, t, c.validity & any_c & (t | f))
+
+
+def _parse_date(xp, c: Vec, first, last, any_c):
+    """ISO yyyy-MM-dd (also yyyy-M-d); invalid -> null."""
+    chars = c.data
+    n, w = chars.shape
+
+    def at(i):
+        return xp.take_along_axis(chars, xp.clip(i, 0, w - 1)[:, None],
+                                  axis=1)[:, 0]
+
+    # find the two dashes
+    j = xp.arange(w, dtype=np.int32)[None, :]
+    in_tok = (j >= first[:, None]) & (j <= last[:, None])
+    dash = (chars == np.uint8(ord("-"))) & in_tok
+    # exclude a leading sign position
+    dash = dash & (j != first[:, None])
+    ndash = xp.sum(dash, axis=1)
+    d1 = xp.argmax(dash, axis=1).astype(np.int32)
+    dash2 = dash & (j > d1[:, None])
+    d2 = xp.argmax(dash2, axis=1).astype(np.int32)
+
+    def parse_num(lo, hi):
+        ok = hi >= lo
+        acc = xp.zeros(n, dtype=np.int64)
+        good = ok
+        for k in range(w):
+            inside = (k >= lo) & (k <= hi)
+            dig = chars[:, k] - np.uint8(ord("0"))
+            good = good & (~inside | (dig <= 9))
+            acc = xp.where(inside & good, acc * 10 + dig.astype(np.int64), acc)
+        return acc, good
+
+    y, gy = parse_num(first, d1 - 1)
+    m, gm = parse_num(d1 + 1, d2 - 1)
+    d, gd = parse_num(d2 + 1, last)
+    ok = any_c & (ndash == 2) & gy & gm & gd & \
+        (m >= 1) & (m <= 12) & (d >= 1) & (d <= 31) & (y >= 1) & (y <= 9999)
+    days = days_from_civil(xp, xp.where(ok, y, 1970), xp.where(ok, m, 1),
+                           xp.where(ok, d, 1))
+    # reject day overflow for the month (roundtrip check)
+    y2, m2, d2c = civil_from_days(xp, days)
+    ok = ok & (y2.astype(np.int64) == y) & (m2.astype(np.int64) == m) & \
+        (d2c.astype(np.int64) == d)
+    return Vec(T.DATE, days.astype(np.int32), c.validity & ok)
+
+
+def _decimal_cast(xp, c: Vec, dst: T.DataType, ansi: bool) -> Vec:
+    src = c.dtype
+    if isinstance(src, T.DecimalType) and isinstance(dst, T.DecimalType):
+        shift = dst.scale - src.scale
+        a = c.data.astype(np.int64)
+        if shift >= 0:
+            scaled = a * (10 ** shift)
+        else:
+            p = 10 ** (-shift)
+            # HALF_UP rescale
+            q = xp.abs(a) // p
+            r = xp.abs(a) % p
+            q = q + (r * 2 >= p)
+            scaled = xp.where(a < 0, -q, q)
+        limit = 10 ** dst.precision
+        validity = c.validity & (xp.abs(scaled) < limit)
+        return Vec(dst, scaled, validity)
+    if isinstance(dst, T.DecimalType):  # integral -> decimal
+        a = c.data.astype(np.int64) * (10 ** dst.scale)
+        limit = 10 ** dst.precision
+        return Vec(dst, a, c.validity & (xp.abs(a) < limit))
+    # decimal -> numeric
+    a = c.data.astype(np.float64) / (10 ** src.scale)
+    if T.is_floating(dst):
+        return Vec(dst, a.astype(dst.np_dtype), c.validity)
+    t = xp.trunc(a).astype(np.int64)
+    lo, hi = _INT_BOUNDS[dst.np_dtype]
+    return Vec(dst, xp.clip(t, lo, hi).astype(dst.np_dtype),
+               c.validity & (t >= lo) & (t <= hi))

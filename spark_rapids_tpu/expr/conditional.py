@@ -1,0 +1,120 @@
+"""Conditional expressions (reference `conditionalExpressions.scala`: GpuIf,
+GpuCaseWhen; `GpuLeast`/`GpuGreatest` from arithmetic.scala)."""
+
+from __future__ import annotations
+
+from .. import types as T
+from .base import Expression, EvalContext, Vec
+
+__all__ = ["If", "CaseWhen", "Least", "Greatest"]
+
+
+def _select(xp, cond, then_v: Vec, else_v: Vec) -> Vec:
+    """cond: bool data array (already null-resolved to False)."""
+    if then_v.is_string:
+        from .strings import pad_common_width
+        td, ed = pad_common_width(xp, then_v, else_v)
+        return Vec(then_v.dtype,
+                   xp.where(cond[:, None], td, ed),
+                   xp.where(cond, then_v.validity, else_v.validity),
+                   xp.where(cond, then_v.lengths, else_v.lengths))
+    dt = then_v.dtype if not isinstance(then_v.dtype, T.NullType) else else_v.dtype
+    ed = else_v.data.astype(then_v.data.dtype) if else_v.data.dtype != \
+        then_v.data.dtype else else_v.data
+    return Vec(dt, xp.where(cond, then_v.data, ed),
+               xp.where(cond, then_v.validity, else_v.validity))
+
+
+class If(Expression):
+    def __init__(self, pred, then_expr, else_expr):
+        super().__init__([pred, then_expr, else_expr])
+
+    @property
+    def data_type(self):
+        return self.children[1].data_type
+
+    @property
+    def nullable(self):
+        return self.children[1].nullable or self.children[2].nullable
+
+    def _compute(self, ctx: EvalContext, p: Vec, t: Vec, e: Vec) -> Vec:
+        cond = p.data & p.validity  # null predicate -> else branch
+        return _select(ctx.xp, cond, t, e)
+
+
+class CaseWhen(Expression):
+    """CASE WHEN c1 THEN v1 [WHEN c2 THEN v2 ...] [ELSE ve] END.
+    branches: list of (cond_expr, value_expr); else_expr optional (null default)."""
+
+    def __init__(self, branches, else_expr=None):
+        from .base import Literal
+        self.branches = list(branches)
+        if else_expr is None:
+            else_expr = Literal(None, self.branches[0][1].data_type)
+        flat = []
+        for c, v in self.branches:
+            flat += [c, v]
+        flat.append(else_expr)
+        super().__init__(flat)
+
+    @property
+    def data_type(self):
+        return self.branches[0][1].data_type
+
+    @property
+    def nullable(self):
+        return True
+
+    def _compute(self, ctx: EvalContext, *vecs: Vec) -> Vec:
+        xp = ctx.xp
+        out = vecs[-1]  # else
+        # fold right-to-left so earlier branches win
+        for i in range(len(self.branches) - 1, -1, -1):
+            c, v = vecs[2 * i], vecs[2 * i + 1]
+            cond = c.data & c.validity
+            out = _select(xp, cond, v, out)
+        return out
+
+
+class _MinMaxN(Expression):
+    """least/greatest: ignores nulls (null only if all null); Spark NaN ordering."""
+
+    _take_left_float = None  # overridden
+
+    def __init__(self, *children):
+        super().__init__(list(children))
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def _compute(self, ctx: EvalContext, *vecs: Vec) -> Vec:
+        xp = ctx.xp
+        out = vecs[0]
+        for v in vecs[1:]:
+            a, b = out.data, v.data.astype(out.data.dtype)
+            if T.is_floating(out.dtype):
+                better = self._cmp_float(xp, a, b)
+            else:
+                better = self._cmp(xp, a, b)
+            take_a = (better & out.validity & v.validity) | \
+                (out.validity & ~v.validity)
+            data = xp.where(take_a, a, b)
+            out = Vec(out.dtype, data, out.validity | v.validity)
+        return out
+
+
+class Least(_MinMaxN):
+    def _cmp(self, xp, a, b):
+        return a <= b
+
+    def _cmp_float(self, xp, a, b):
+        return (a <= b) | xp.isnan(b)
+
+
+class Greatest(_MinMaxN):
+    def _cmp(self, xp, a, b):
+        return a >= b
+
+    def _cmp_float(self, xp, a, b):
+        return (a >= b) | xp.isnan(a)

@@ -1,0 +1,211 @@
+"""Date/time expressions (reference `datetimeExpressions.scala`: GpuYear, GpuMonth,
+GpuDayOfMonth, GpuHour, GpuMinute, GpuSecond, GpuDateAdd/Sub/Diff, GpuQuarter,
+GpuDayOfWeek/Year...).
+
+Dates are int32 days since epoch; timestamps int64 microseconds UTC (Spark session
+timezone must be UTC, which the plugin bootstrap enforces like the reference's
+`RapidsPluginUtils.fixupConfigs` timezone check `Plugin.scala:110-161`). Civil-date
+decomposition uses the days-from-civil algorithm (Howard Hinnant's public-domain
+formulation) on integer vectors — branch-free, so it maps cleanly onto the VPU."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import types as T
+from .base import Expression, EvalContext, Vec, and_validity
+
+__all__ = ["Year", "Month", "DayOfMonth", "Quarter", "DayOfWeek", "WeekDay",
+           "DayOfYear", "Hour", "Minute", "Second", "DateAdd", "DateSub",
+           "DateDiff", "UnixTimestampFromTs", "civil_from_days"]
+
+_US_PER_DAY = 86_400_000_000
+_US_PER_HOUR = 3_600_000_000
+_US_PER_MIN = 60_000_000
+_US_PER_SEC = 1_000_000
+
+
+def civil_from_days(xp, z):
+    """days since 1970-01-01 -> (year, month [1-12], day [1-31]); int vectors."""
+    z = z.astype(np.int64) + 719468
+    era = xp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097                                   # [0, 146096]
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)          # [0, 365]
+    mp = (5 * doy + 2) // 153                                # [0, 11]
+    d = doy - (153 * mp + 2) // 5 + 1                        # [1, 31]
+    m = xp.where(mp < 10, mp + 3, mp - 9)                    # [1, 12]
+    year = y + (m <= 2)
+    return year.astype(np.int32), m.astype(np.int32), d.astype(np.int32)
+
+
+def _floor_div(xp, a, b):
+    return a // b  # both numpy and jnp floor-divide toward -inf for ints
+
+
+def _ts_to_days(xp, us):
+    return _floor_div(xp, us, _US_PER_DAY)
+
+
+class _DatePart(Expression):
+    part = "year"
+
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def _compute(self, ctx: EvalContext, c: Vec) -> Vec:
+        xp = ctx.xp
+        days = c.data if isinstance(c.dtype, T.DateType) else \
+            _ts_to_days(xp, c.data)
+        y, m, d = civil_from_days(xp, days)
+        out = {"year": y, "month": m, "day": d}[self.part] if self.part in \
+            ("year", "month", "day") else self._derive(xp, days, y, m, d)
+        return Vec(T.INT, out.astype(np.int32), c.validity)
+
+    def _derive(self, xp, days, y, m, d):
+        raise NotImplementedError
+
+
+class Year(_DatePart):
+    part = "year"
+
+
+class Month(_DatePart):
+    part = "month"
+
+
+class DayOfMonth(_DatePart):
+    part = "day"
+
+
+class Quarter(_DatePart):
+    part = "quarter"
+
+    def _derive(self, xp, days, y, m, d):
+        return (m - 1) // 3 + 1
+
+
+class DayOfWeek(_DatePart):
+    """Spark dayofweek: 1 = Sunday ... 7 = Saturday."""
+    part = "dow"
+
+    def _derive(self, xp, days, y, m, d):
+        return (days + 4) % 7 + 1  # 1970-01-01 was a Thursday
+
+
+class WeekDay(_DatePart):
+    """Spark weekday: 0 = Monday ... 6 = Sunday."""
+    part = "weekday"
+
+    def _derive(self, xp, days, y, m, d):
+        return (days + 3) % 7
+
+
+class DayOfYear(_DatePart):
+    part = "doy"
+
+    def _derive(self, xp, days, y, m, d):
+        jan1 = days_from_civil(xp, y, xp.ones_like(m), xp.ones_like(d))
+        return (days - jan1 + 1).astype(np.int32)
+
+
+def days_from_civil(xp, y, m, d):
+    """(year, month, day) -> days since epoch (inverse of civil_from_days)."""
+    y = y.astype(np.int64) - (m <= 2)
+    era = xp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    mp = xp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return (era * 146097 + doe - 719468).astype(np.int64)
+
+
+class _TimePart(Expression):
+    divisor, modulus = 1, 24
+
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def _compute(self, ctx: EvalContext, c: Vec) -> Vec:
+        xp = ctx.xp
+        within_day = c.data - _ts_to_days(xp, c.data) * _US_PER_DAY
+        out = (within_day // self.divisor) % self.modulus
+        return Vec(T.INT, out.astype(np.int32), c.validity)
+
+
+class Hour(_TimePart):
+    divisor, modulus = _US_PER_HOUR, 24
+
+
+class Minute(_TimePart):
+    divisor, modulus = _US_PER_MIN, 60
+
+
+class Second(_TimePart):
+    divisor, modulus = _US_PER_SEC, 60
+
+
+class DateAdd(Expression):
+    def __init__(self, date, delta):
+        super().__init__([date, delta])
+
+    @property
+    def data_type(self):
+        return T.DATE
+
+    def _compute(self, ctx, d: Vec, k: Vec) -> Vec:
+        xp = ctx.xp
+        data = (d.data.astype(np.int64) + k.data.astype(np.int64)).astype(np.int32)
+        return Vec(T.DATE, data, and_validity(xp, d.validity, k.validity))
+
+
+class DateSub(Expression):
+    def __init__(self, date, delta):
+        super().__init__([date, delta])
+
+    @property
+    def data_type(self):
+        return T.DATE
+
+    def _compute(self, ctx, d: Vec, k: Vec) -> Vec:
+        xp = ctx.xp
+        data = (d.data.astype(np.int64) - k.data.astype(np.int64)).astype(np.int32)
+        return Vec(T.DATE, data, and_validity(xp, d.validity, k.validity))
+
+
+class DateDiff(Expression):
+    def __init__(self, end, start):
+        super().__init__([end, start])
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def _compute(self, ctx, e: Vec, s: Vec) -> Vec:
+        xp = ctx.xp
+        return Vec(T.INT, (e.data - s.data).astype(np.int32),
+                   and_validity(xp, e.validity, s.validity))
+
+
+class UnixTimestampFromTs(Expression):
+    """to_unix_timestamp on a TIMESTAMP input (seconds, floored)."""
+
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.LONG
+
+    def _compute(self, ctx, c: Vec) -> Vec:
+        xp = ctx.xp
+        return Vec(T.LONG, _floor_div(xp, c.data, _US_PER_SEC), c.validity)

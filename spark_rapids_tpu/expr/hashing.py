@@ -1,0 +1,193 @@
+"""Spark-exact Murmur3 hashing (reference `HashFunctions.scala` GpuMurmur3Hash; the
+bit-exact semantics live in spark-rapids-jni's murmur hash kernels).
+
+Spark's Murmur3 variant (org.apache.spark.unsafe.hash.Murmur3_x86_32) differs from
+canonical murmur3 in tail handling: each trailing byte is mixed as its own
+sign-extended int block. All arithmetic is uint32 with wraparound, vectorized over
+rows; strings loop over the (static) byte-matrix width. Used by hash partitioning
+(GpuHashPartitioningBase analog) and hash joins, so exactness here is what makes
+shuffle placement match CPU Spark."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import types as T
+from .base import Expression, EvalContext, Vec
+
+__all__ = ["Murmur3Hash", "hash_vec", "hash_vecs"]
+
+_C1 = np.uint32(0xcc9e2d51)
+_C2 = np.uint32(0x1b873593)
+_M5 = np.uint32(0xe6546b64)
+_F1 = np.uint32(0x85ebca6b)
+_F2 = np.uint32(0xc2b2ae35)
+
+
+def _u32(xp, x):
+    return x.astype(np.uint32)
+
+
+def _rotl(xp, x, r):
+    return (x << np.uint32(r)) | (x >> np.uint32(32 - r))
+
+
+def _mix_k1(xp, k1):
+    k1 = k1 * _C1
+    k1 = _rotl(xp, k1, 15)
+    return k1 * _C2
+
+
+def _mix_h1(xp, h1, k1):
+    h1 = h1 ^ k1
+    h1 = _rotl(xp, h1, 13)
+    return h1 * np.uint32(5) + _M5
+
+
+def _fmix(xp, h1, length):
+    h1 = h1 ^ length
+    h1 = h1 ^ (h1 >> np.uint32(16))
+    h1 = h1 * _F1
+    h1 = h1 ^ (h1 >> np.uint32(13))
+    h1 = h1 * _F2
+    return h1 ^ (h1 >> np.uint32(16))
+
+
+def _hash_int(xp, v_u32, seed_u32):
+    h1 = _mix_h1(xp, seed_u32, _mix_k1(xp, v_u32))
+    return _fmix(xp, h1, np.uint32(4))
+
+
+def _hash_long(xp, v_i64, seed_u32):
+    u = v_i64.astype(np.uint64)
+    low = _u32(xp, u & np.uint64(0xFFFFFFFF))
+    high = _u32(xp, u >> np.uint64(32))
+    h1 = _mix_h1(xp, seed_u32, _mix_k1(xp, low))
+    h1 = _mix_h1(xp, h1, _mix_k1(xp, high))
+    return _fmix(xp, h1, np.uint32(8))
+
+
+def _hash_string(xp, chars, lengths, seed_u32):
+    n, w = chars.shape
+    h1 = seed_u32
+    lens = lengths.astype(np.int32)
+    # 4-byte words, little-endian, for positions fully below len - len%4
+    aligned = lens - (lens % 4)
+    u = chars.astype(np.uint32)
+    for i in range(w // 4):
+        base = 4 * i
+        word = (u[:, base] | (u[:, base + 1] << np.uint32(8))
+                | (u[:, base + 2] << np.uint32(16))
+                | (u[:, base + 3] << np.uint32(24)))
+        active = base + 4 <= aligned
+        h1 = xp.where(active, _mix_h1(xp, h1, _mix_k1(xp, word)), h1)
+    # tail: each remaining byte as its own sign-extended block (Spark variant)
+    signed = chars.astype(np.int8).astype(np.int32).astype(np.uint32)
+    for p in range(w):
+        active = (p >= aligned) & (p < lens)
+        h1 = xp.where(active, _mix_h1(xp, h1, _mix_k1(xp, signed[:, p])), h1)
+    return _fmix(xp, h1, lens.astype(np.uint32))
+
+
+def hash_vec(xp, v: Vec, seed_u32):
+    """Hash one column into uint32; null rows pass the seed through (Spark)."""
+    dt = v.dtype
+    if isinstance(dt, T.StringType):
+        h = _hash_string(xp, v.data, v.lengths, seed_u32)
+    elif isinstance(dt, T.BooleanType):
+        h = _hash_int(xp, v.data.astype(np.int32).astype(np.uint32), seed_u32)
+    elif isinstance(dt, (T.ByteType, T.ShortType, T.IntegerType, T.DateType)):
+        h = _hash_int(xp, v.data.astype(np.int32).astype(np.uint32), seed_u32)
+    elif isinstance(dt, (T.LongType, T.TimestampType)):
+        h = _hash_long(xp, v.data.astype(np.int64), seed_u32)
+    elif isinstance(dt, T.FloatType):
+        f = v.data.astype(np.float32)
+        f = xp.where(f == 0.0, 0.0, f).astype(np.float32)  # -0.0 -> 0.0
+        bits = f.view(np.int32) if xp is np else _bitcast(xp, f, np.int32)
+        h = _hash_int(xp, bits.astype(np.uint32), seed_u32)
+    elif isinstance(dt, T.DoubleType):
+        f = v.data.astype(np.float64)
+        f = xp.where(f == 0.0, 0.0, f)
+        bits = f.view(np.int64) if xp is np else _double_bits(xp, f)
+        h = _hash_long(xp, bits, seed_u32)
+    elif isinstance(dt, T.DecimalType) and dt.precision <= 18:
+        h = _hash_long(xp, v.data.astype(np.int64), seed_u32)
+    else:
+        raise TypeError(f"murmur3 unsupported for {dt}")
+    return xp.where(v.validity, h, seed_u32)
+
+
+def _bitcast(xp, arr, to):
+    import jax
+    return jax.lax.bitcast_convert_type(arr, to)
+
+
+def _double_bits(xp, f):
+    """Java Double.doubleToLongBits computed arithmetically (canonical NaN).
+
+    The TPU backend's x64 rewrite cannot lower 64-bit bitcasts (and frexp/signbit
+    lower through them), so the IEEE-754 fields are reconstructed with compares and
+    exact power-of-two multiplies only.
+
+    KNOWN INCOMPAT (covered by spark.rapids.sql.improvedFloatOps.enabled, mirroring
+    the reference's float corner-case gating): the TPU backend emulates f64 as f32
+    pairs, so (a) subnormals flush to zero, (b) magnitudes beyond float32's exponent
+    range (|x| >~ 1e38) and mantissas needing >48 bits do not hash bit-identically
+    to CPU Spark. int64 emulation is exact, so integral/string/decimal hashes are
+    bit-identical. Long-term fix (later round): store DOUBLE columns as int64 bit
+    patterns (exact at rest), decoding to float only for arithmetic."""
+    # NOT signbit(): jnp.signbit on f64 lowers through a 64-bit bitcast, which the
+    # TPU x64 rewrite rejects. f < 0 is enough: callers normalize -0.0 to 0.0 first
+    # (Spark hash semantics require that anyway).
+    sign = xp.where(f < 0, np.int64(-2 ** 63), np.int64(0))
+    absf = xp.abs(f)
+    is_small = absf < np.float64(2.0 ** -1022)  # zero (and flushed subnormals)
+    is_inf = xp.isinf(f)
+    is_nan = xp.isnan(f)
+    # Normalize into [1, 2) by exact power-of-two multiplies, accumulating the
+    # exponent — jnp.frexp/signbit lower through 64-bit bitcasts the TPU x64
+    # rewrite rejects, so this is plain compares/multiplies only.
+    x = xp.where(is_small | is_inf | is_nan, np.float64(1.0), absf)
+    e = xp.zeros(f.shape, dtype=np.int64)
+    for k in (512, 256, 128, 64, 32, 16, 8, 4, 2, 1):
+        up = x >= np.float64(2.0) ** k
+        x = xp.where(up, x * np.float64(2.0) ** -k, x)
+        e = e + xp.where(up, np.int64(k), np.int64(0))
+        down = x < np.float64(2.0) ** (1 - k)
+        x = xp.where(down, x * np.float64(2.0) ** k, x)
+        e = e - xp.where(down, np.int64(k), np.int64(0))
+    # x in [1, 2): mantissa fraction is exact (Sterbenz subtraction, exact scale)
+    mant = ((x - 1.0) * np.float64(2.0 ** 52)).astype(np.int64)
+    bits = ((e + 1023) << np.int64(52)) | mant
+    bits = xp.where(is_small, np.int64(0), bits)
+    bits = xp.where(is_inf, np.int64(0x7FF0000000000000), bits)
+    bits = sign | bits
+    return xp.where(is_nan, np.int64(0x7FF8000000000000), bits)
+
+
+def hash_vecs(xp, vecs, seed: int = 42):
+    """Row hash across columns: int32 result (Spark Murmur3Hash expression)."""
+    n = vecs[0].validity.shape[0]
+    h = xp.full((n,), np.uint32(seed), dtype=np.uint32)
+    for v in vecs:
+        h = hash_vec(xp, v, h)
+    return h.astype(np.int32)
+
+
+class Murmur3Hash(Expression):
+    def __init__(self, *children, seed: int = 42):
+        super().__init__(list(children))
+        self.seed = seed
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    @property
+    def nullable(self):
+        return False
+
+    def _compute(self, ctx: EvalContext, *vecs: Vec) -> Vec:
+        xp = ctx.xp
+        data = hash_vecs(xp, list(vecs), self.seed)
+        return Vec(T.INT, data, xp.ones(data.shape[0], dtype=bool))

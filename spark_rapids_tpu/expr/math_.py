@@ -1,0 +1,219 @@
+"""Math expressions (reference `mathExpressions.scala`: GpuSqrt, GpuPow, GpuExp,
+GpuLog, GpuFloor, GpuCeil, GpuRound, trig...). Spark notes:
+  * log/sqrt of invalid input -> null (Spark returns null, not NaN, for log(<=0));
+  * Round is HALF_UP (away from zero), not banker's rounding;
+  * Floor/Ceil on integral types are identity; on double -> LONG result."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import types as T
+from .base import Expression, EvalContext, Vec, and_validity
+
+__all__ = ["Sqrt", "Exp", "Log", "Log10", "Log2", "Pow", "Floor", "Ceil", "Round",
+           "Signum", "Sin", "Cos", "Tan", "Asin", "Acos", "Atan", "Sinh", "Cosh",
+           "Tanh", "Cbrt", "ToDegrees", "ToRadians"]
+
+
+class UnaryMath(Expression):
+    """double -> double elementwise."""
+
+    null_domain = None  # optional fn(xp, a) -> bool mask of invalid inputs
+
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+    def _compute(self, ctx: EvalContext, c: Vec) -> Vec:
+        xp = ctx.xp
+        a = c.data.astype(np.float64)
+        validity = c.validity
+        if self.null_domain is not None:
+            bad = self.null_domain(xp, a)
+            validity = validity & ~bad
+            a = xp.where(bad, 1.0, a)
+        if xp is np:
+            with np.errstate(all="ignore"):
+                data = self._op(xp, a)
+        else:
+            data = self._op(xp, a)
+        return Vec(T.DOUBLE, data, validity)
+
+    def _op(self, xp, a):
+        raise NotImplementedError
+
+
+class Sqrt(UnaryMath):
+    def _op(self, xp, a):
+        return xp.sqrt(a)  # sqrt(<0) -> NaN, matching Spark
+
+
+class Exp(UnaryMath):
+    def _op(self, xp, a):
+        return xp.exp(a)
+
+
+class Log(UnaryMath):
+    null_domain = staticmethod(lambda xp, a: a <= 0.0)
+
+    def _op(self, xp, a):
+        return xp.log(a)
+
+
+class Log10(UnaryMath):
+    null_domain = staticmethod(lambda xp, a: a <= 0.0)
+
+    def _op(self, xp, a):
+        return xp.log10(a)
+
+
+class Log2(UnaryMath):
+    null_domain = staticmethod(lambda xp, a: a <= 0.0)
+
+    def _op(self, xp, a):
+        return xp.log2(a)
+
+
+class Sin(UnaryMath):
+    def _op(self, xp, a):
+        return xp.sin(a)
+
+
+class Cos(UnaryMath):
+    def _op(self, xp, a):
+        return xp.cos(a)
+
+
+class Tan(UnaryMath):
+    def _op(self, xp, a):
+        return xp.tan(a)
+
+
+class Asin(UnaryMath):
+    def _op(self, xp, a):
+        return xp.arcsin(a)
+
+
+class Acos(UnaryMath):
+    def _op(self, xp, a):
+        return xp.arccos(a)
+
+
+class Atan(UnaryMath):
+    def _op(self, xp, a):
+        return xp.arctan(a)
+
+
+class Sinh(UnaryMath):
+    def _op(self, xp, a):
+        return xp.sinh(a)
+
+
+class Cosh(UnaryMath):
+    def _op(self, xp, a):
+        return xp.cosh(a)
+
+
+class Tanh(UnaryMath):
+    def _op(self, xp, a):
+        return xp.tanh(a)
+
+
+class Cbrt(UnaryMath):
+    def _op(self, xp, a):
+        return xp.cbrt(a)
+
+
+class ToDegrees(UnaryMath):
+    def _op(self, xp, a):
+        return xp.degrees(a)
+
+
+class ToRadians(UnaryMath):
+    def _op(self, xp, a):
+        return xp.radians(a)
+
+
+class Signum(UnaryMath):
+    def _op(self, xp, a):
+        return xp.sign(a)
+
+
+class Pow(Expression):
+    def __init__(self, left, right):
+        super().__init__([left, right])
+
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+    def _compute(self, ctx: EvalContext, l: Vec, r: Vec) -> Vec:
+        xp = ctx.xp
+        a = l.data.astype(np.float64)
+        b = r.data.astype(np.float64)
+        if xp is np:
+            with np.errstate(all="ignore"):
+                data = np.power(a, b)
+        else:
+            data = xp.power(a, b)
+        return Vec(T.DOUBLE, data, and_validity(xp, l.validity, r.validity))
+
+
+class Floor(Expression):
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type if T.is_integral(
+            self.children[0].data_type) else T.LONG
+
+    def _compute(self, ctx, c: Vec) -> Vec:
+        xp = ctx.xp
+        if T.is_integral(c.dtype):
+            return c
+        return Vec(T.LONG, xp.floor(c.data).astype(np.int64), c.validity)
+
+
+class Ceil(Expression):
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type if T.is_integral(
+            self.children[0].data_type) else T.LONG
+
+    def _compute(self, ctx, c: Vec) -> Vec:
+        xp = ctx.xp
+        if T.is_integral(c.dtype):
+            return c
+        return Vec(T.LONG, xp.ceil(c.data).astype(np.int64), c.validity)
+
+
+class Round(Expression):
+    """round(x, d) HALF_UP — Spark rounds away from zero on ties, unlike
+    numpy/XLA round-half-even, so implement via floor(|x|*10^d + 0.5)."""
+
+    def __init__(self, child, scale: int = 0):
+        super().__init__([child])
+        self.scale = scale
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def _compute(self, ctx, c: Vec) -> Vec:
+        xp = ctx.xp
+        if T.is_integral(c.dtype) and self.scale >= 0:
+            return c
+        p = 10.0 ** self.scale
+        a = c.data.astype(np.float64)
+        rounded = xp.sign(a) * xp.floor(xp.abs(a) * p + 0.5) / p
+        if T.is_integral(c.dtype):
+            return Vec(c.dtype, rounded.astype(c.dtype.np_dtype), c.validity)
+        return Vec(c.dtype, rounded.astype(c.dtype.np_dtype), c.validity)

@@ -1,0 +1,111 @@
+"""Null-handling expressions (reference `nullExpressions.scala`: GpuIsNull/GpuIsNotNull/
+GpuCoalesce/GpuNaNvl/GpuIsNaN/GpuNvl...)."""
+
+from __future__ import annotations
+
+from .. import types as T
+from .base import Expression, EvalContext, Vec
+
+__all__ = ["IsNull", "IsNotNull", "IsNaN", "Coalesce", "NaNvl"]
+
+
+class IsNull(Expression):
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    @property
+    def nullable(self):
+        return False
+
+    def _compute(self, ctx, c: Vec) -> Vec:
+        xp = ctx.xp
+        return Vec(T.BOOLEAN, ~c.validity, xp.ones(c.validity.shape[0], dtype=bool))
+
+
+class IsNotNull(Expression):
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    @property
+    def nullable(self):
+        return False
+
+    def _compute(self, ctx, c: Vec) -> Vec:
+        xp = ctx.xp
+        return Vec(T.BOOLEAN, c.validity.copy() if xp.__name__ == "numpy"
+                   else c.validity, xp.ones(c.validity.shape[0], dtype=bool))
+
+
+class IsNaN(Expression):
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    @property
+    def nullable(self):
+        return False
+
+    def _compute(self, ctx, c: Vec) -> Vec:
+        xp = ctx.xp
+        data = xp.isnan(c.data) & c.validity
+        return Vec(T.BOOLEAN, data, xp.ones(data.shape[0], dtype=bool))
+
+
+class Coalesce(Expression):
+    """First non-null argument."""
+
+    def __init__(self, *children):
+        super().__init__(list(children))
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    @property
+    def nullable(self):
+        return all(c.nullable for c in self.children)
+
+    def _compute(self, ctx: EvalContext, *vecs: Vec) -> Vec:
+        xp = ctx.xp
+        out = vecs[0]
+        for v in vecs[1:]:
+            take_out = out.validity
+            if out.is_string:
+                from .strings import pad_common_width
+                od, vd = pad_common_width(xp, out, v)
+                data = xp.where(take_out[:, None], od, vd)
+                lens = xp.where(take_out, out.lengths, v.lengths)
+                out = Vec(out.dtype, data, out.validity | v.validity, lens)
+            else:
+                data = xp.where(take_out, out.data, v.data.astype(out.data.dtype))
+                out = Vec(out.dtype, data, out.validity | v.validity)
+        return out
+
+
+class NaNvl(Expression):
+    """nanvl(a, b): b where a is NaN else a."""
+
+    def __init__(self, left, right):
+        super().__init__([left, right])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def _compute(self, ctx, l: Vec, r: Vec) -> Vec:
+        xp = ctx.xp
+        nan = xp.isnan(l.data)
+        data = xp.where(nan, r.data.astype(l.data.dtype), l.data)
+        validity = xp.where(nan, r.validity, l.validity)
+        return Vec(l.dtype, data, validity)

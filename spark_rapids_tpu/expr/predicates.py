@@ -1,0 +1,215 @@
+"""Comparison and boolean predicates with Spark semantics.
+
+Reference: `org/apache/spark/sql/rapids/predicates.scala` + GpuEqualTo etc. in
+`GpuOverrides.scala:1600-1800` region. Semantics:
+  * NaN equals NaN and sorts greater than everything (Spark ordering semantics);
+  * And/Or use Kleene three-valued logic (false && null = false, true || null = true);
+  * strings compare bytewise-lexicographic on the padded matrix (zero padding sorts
+    a prefix before its extensions, matching UTF-8 byte order);
+  * EqualNullSafe (<=>) never returns null.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import types as T
+from .base import Expression, EvalContext, Vec, and_validity
+from .arithmetic import BinaryExpression, promote_args
+
+__all__ = ["EqualTo", "EqualNullSafe", "LessThan", "LessThanOrEqual", "GreaterThan",
+           "GreaterThanOrEqual", "And", "Or", "Not", "In", "string_compare",
+           "string_equal"]
+
+
+def string_equal(xp, a: Vec, b: Vec):
+    from .strings import pad_common_width
+    da, db = pad_common_width(xp, a, b)
+    return xp.all(da == db, axis=1) & (a.lengths == b.lengths)
+
+
+def string_compare(xp, a: Vec, b: Vec):
+    """Return int array: -1/0/1 lexicographic byte comparison. Equal byte images
+    (including zero padding) tie-break on length so strings with trailing NUL bytes
+    still order after their prefix (UTF8String.compareTo semantics)."""
+    from .strings import pad_common_width
+    da, db = pad_common_width(xp, a, b)
+    # first differing byte decides; zero-padded tails make prefix < extension
+    lt = (da < db)
+    gt = (da > db)
+    diff = lt | gt
+    first = xp.argmax(diff, axis=1)
+    any_diff = xp.any(diff, axis=1)
+    idx = xp.arange(da.shape[0])
+    a_byte = da[idx, first]
+    b_byte = db[idx, first]
+    cmp = xp.where(a_byte < b_byte, -1, 1)
+    len_cmp = xp.where(a.lengths < b.lengths, -1,
+                       xp.where(a.lengths > b.lengths, 1, 0))
+    return xp.where(any_diff, cmp, len_cmp)
+
+
+class BinaryComparison(BinaryExpression):
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def _compute(self, ctx: EvalContext, l: Vec, r: Vec) -> Vec:
+        xp = ctx.xp
+        validity = and_validity(xp, l.validity, r.validity)
+        if l.is_string:
+            data = self._cmp_string(xp, l, r)
+        elif T.is_numeric(l.dtype) or T.is_numeric(r.dtype):
+            l2, r2, dt = promote_args(xp, l, r)
+            if T.is_floating(dt):
+                data = self._cmp_float(xp, l2.data, r2.data)
+            else:
+                data = self._cmp(xp, l2.data, r2.data)
+        else:
+            data = self._cmp(xp, l.data, r.data)
+        return Vec(T.BOOLEAN, data, validity)
+
+    # float comparisons with Spark NaN ordering (NaN == NaN, NaN greatest)
+    def _cmp_float(self, xp, a, b):
+        return self._cmp(xp, a, b)
+
+    def _cmp_string(self, xp, l, r):
+        raise NotImplementedError
+
+
+class EqualTo(BinaryComparison):
+    def _cmp(self, xp, a, b):
+        return a == b
+
+    def _cmp_float(self, xp, a, b):
+        return (a == b) | (xp.isnan(a) & xp.isnan(b))
+
+    def _cmp_string(self, xp, l, r):
+        return string_equal(xp, l, r)
+
+
+class LessThan(BinaryComparison):
+    def _cmp(self, xp, a, b):
+        return a < b
+
+    def _cmp_float(self, xp, a, b):
+        return (a < b) | (~xp.isnan(a) & xp.isnan(b))
+
+    def _cmp_string(self, xp, l, r):
+        return string_compare(xp, l, r) < 0
+
+
+class LessThanOrEqual(BinaryComparison):
+    def _cmp(self, xp, a, b):
+        return a <= b
+
+    def _cmp_float(self, xp, a, b):
+        return (a <= b) | xp.isnan(b)
+
+    def _cmp_string(self, xp, l, r):
+        return string_compare(xp, l, r) <= 0
+
+
+class GreaterThan(BinaryComparison):
+    def _cmp(self, xp, a, b):
+        return a > b
+
+    def _cmp_float(self, xp, a, b):
+        return (a > b) | (xp.isnan(a) & ~xp.isnan(b))
+
+    def _cmp_string(self, xp, l, r):
+        return string_compare(xp, l, r) > 0
+
+
+class GreaterThanOrEqual(BinaryComparison):
+    def _cmp(self, xp, a, b):
+        return a >= b
+
+    def _cmp_float(self, xp, a, b):
+        return (a >= b) | xp.isnan(a)
+
+    def _cmp_string(self, xp, l, r):
+        return string_compare(xp, l, r) >= 0
+
+
+class EqualNullSafe(BinaryComparison):
+    """<=>: null <=> null is true; never returns null."""
+
+    @property
+    def nullable(self):
+        return False
+
+    def _compute(self, ctx: EvalContext, l: Vec, r: Vec) -> Vec:
+        xp = ctx.xp
+        eq = EqualTo(self.left, self.right)._compute(ctx, l, r)
+        both_null = ~l.validity & ~r.validity
+        both_valid = l.validity & r.validity
+        data = (both_valid & eq.data) | both_null
+        return Vec(T.BOOLEAN, data, xp.ones(data.shape[0], dtype=bool))
+
+
+class And(BinaryExpression):
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def _compute(self, ctx: EvalContext, l: Vec, r: Vec) -> Vec:
+        # Kleene: false if either side is known false; null if unknown remains
+        known_false = (l.validity & ~l.data) | (r.validity & ~r.data)
+        data = l.data & r.data
+        validity = (l.validity & r.validity) | known_false
+        return Vec(T.BOOLEAN, data & ~known_false, validity)
+
+
+class Or(BinaryExpression):
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def _compute(self, ctx: EvalContext, l: Vec, r: Vec) -> Vec:
+        known_true = (l.validity & l.data) | (r.validity & r.data)
+        data = l.data | r.data
+        validity = (l.validity & r.validity) | known_true
+        return Vec(T.BOOLEAN, data | known_true, validity)
+
+
+class Not(Expression):
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def _compute(self, ctx, c: Vec) -> Vec:
+        return Vec(T.BOOLEAN, ~c.data, c.validity)
+
+
+class In(Expression):
+    """value IN (literals...). Null semantics: null if value is null, or if no match
+    and the list contains a null."""
+
+    def __init__(self, value: Expression, items):
+        super().__init__([value])
+        self.items = list(items)
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def _compute(self, ctx: EvalContext, v: Vec) -> Vec:
+        xp = ctx.xp
+        has_null_item = any(i is None for i in self.items)
+        matched = xp.zeros(v.validity.shape[0], dtype=bool)
+        from .base import Literal
+        for item in self.items:
+            if item is None:
+                continue
+            lit = Literal(item, v.dtype if not v.is_string else T.STRING)
+            lv = lit._compute(ctx)
+            if v.is_string:
+                matched = matched | string_equal(xp, v, lv)
+            else:
+                matched = matched | (v.data == lv.data.astype(v.data.dtype))
+        validity = v.validity & (matched | (not has_null_item))
+        return Vec(T.BOOLEAN, matched, validity)

@@ -1,0 +1,262 @@
+"""String expressions over the fixed-width byte-matrix layout.
+
+Reference: `stringFunctions.scala` (GpuLength, GpuUpper/GpuLower, GpuSubstring,
+GpuConcat, GpuStartsWith/GpuEndsWith/GpuContains, GpuStringTrim*), which lower to
+cuStrings kernels over offset+chars. Here every op is a rank-2 vector op over
+`uint8[n, w]` + `int32 lengths` — the layout chosen so the VPU (8x128 lanes) sees
+rectangular data (ARCHITECTURE.md #3):
+
+  * character (code-point) positions derive from the UTF-8 continuation-byte mask
+    ((b & 0xC0) != 0x80), so Length/Substring are character-correct for all of UTF-8;
+  * per-row variable slicing (substring/trim/concat) is take_along_axis with a
+    computed index matrix — a gather, which XLA lowers well on TPU;
+  * upper/lower handle ASCII on device; non-ASCII case mapping is tagged incompat by
+    the planner (the reference similarly documents locale-sensitive corner cases).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import types as T
+from .base import Expression, EvalContext, Vec, and_validity
+
+__all__ = ["pad_common_width", "Length", "Upper", "Lower", "Substring", "Concat",
+           "StartsWith", "EndsWith", "Contains", "StringTrim", "StringTrimLeft",
+           "StringTrimRight"]
+
+
+def pad_common_width(xp, a: Vec, b: Vec):
+    wa, wb = a.data.shape[1], b.data.shape[1]
+    w = max(wa, wb)
+    da = a.data if wa == w else xp.pad(a.data, ((0, 0), (0, w - wa)))
+    db = b.data if wb == w else xp.pad(b.data, ((0, 0), (0, w - wb)))
+    return da, db
+
+
+def _is_char_start(xp, chars):
+    return (chars & 0xC0) != 0x80
+
+
+def _pos_mask(xp, chars, lengths):
+    """bool[n, w]: byte position is within the row's length."""
+    w = chars.shape[1]
+    return xp.arange(w, dtype=xp.int32)[None, :] < lengths[:, None]
+
+
+class StringUnary(Expression):
+    def __init__(self, child):
+        super().__init__([child])
+
+
+class Length(StringUnary):
+    """Character (code point) count."""
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def _compute(self, ctx: EvalContext, c: Vec) -> Vec:
+        xp = ctx.xp
+        starts = _is_char_start(xp, c.data) & _pos_mask(xp, c.data, c.lengths)
+        return Vec(T.INT, xp.sum(starts, axis=1).astype(np.int32), c.validity)
+
+
+class _AsciiCase(StringUnary):
+    lo, hi, delta = 0, 0, 0
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _compute(self, ctx: EvalContext, c: Vec) -> Vec:
+        xp = ctx.xp
+        conv = (c.data >= self.lo) & (c.data <= self.hi)
+        data = xp.where(conv, c.data + np.uint8(self.delta), c.data)
+        return Vec(T.STRING, data, c.validity, c.lengths)
+
+
+class Upper(_AsciiCase):
+    lo, hi, delta = ord("a"), ord("z"), 256 - 32  # uint8 wraps: -32
+
+
+class Lower(_AsciiCase):
+    lo, hi, delta = ord("A"), ord("Z"), 32
+
+
+class Substring(Expression):
+    """substring(str, pos, len): 1-based, character-based; negative pos counts from
+    the end (Spark semantics)."""
+
+    def __init__(self, child, pos: Expression, length: Expression):
+        super().__init__([child, pos, length])
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _compute(self, ctx: EvalContext, c: Vec, pos_v: Vec, len_v: Vec) -> Vec:
+        xp = ctx.xp
+        chars, lengths = c.data, c.lengths
+        n, w = chars.shape
+        in_row = _pos_mask(xp, chars, lengths)
+        starts = _is_char_start(xp, chars) & in_row
+        nchars = xp.sum(starts, axis=1).astype(np.int32)
+        # char index of each byte (0-based)
+        char_id = xp.cumsum(starts.astype(np.int32), axis=1) - 1
+
+        pos = pos_v.data.astype(np.int32)
+        slen = xp.maximum(len_v.data.astype(np.int32), 0)
+        # Spark: pos>0 -> 1-based from start; pos<0 -> from end; pos==0 -> start.
+        # end = start + len is computed BEFORE clamping start, so a window that
+        # begins before the string start is shortened, not shifted
+        # (substring('Spark SQL', -10, 5) = 'Spar').
+        raw_start = xp.where(pos > 0, pos - 1,
+                             xp.where(pos < 0, nchars + pos, 0))
+        end_char = xp.clip(raw_start + slen, 0, nchars)
+        start_char = xp.clip(raw_start, 0, nchars)
+
+        # byte offset of char k = number of bytes with char_id < k (within length)
+        def byte_offset(k):
+            return xp.sum(in_row & (char_id < k[:, None]), axis=1).astype(np.int32)
+
+        b0 = byte_offset(start_char)
+        b1 = byte_offset(end_char)
+        out_len = xp.maximum(b1 - b0, 0)
+        idx = xp.minimum(b0[:, None] + xp.arange(w, dtype=np.int32)[None, :], w - 1)
+        data = xp.take_along_axis(chars, idx, axis=1)
+        keep = xp.arange(w, dtype=np.int32)[None, :] < out_len[:, None]
+        data = xp.where(keep, data, np.uint8(0))
+        validity = and_validity(xp, c.validity, pos_v.validity, len_v.validity)
+        return Vec(T.STRING, data, validity, out_len)
+
+
+class Concat(Expression):
+    """concat(s1, s2, ...): null if any input null."""
+
+    def __init__(self, *children):
+        super().__init__(list(children))
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _compute(self, ctx: EvalContext, *vecs: Vec) -> Vec:
+        xp = ctx.xp
+        out = vecs[0]
+        for v in vecs[1:]:
+            w1, w2 = out.data.shape[1], v.data.shape[1]
+            from ..columnar.padding import width_bucket
+            w = width_bucket(w1 + w2)
+            both = xp.pad(xp.concatenate([out.data, v.data], axis=1),
+                          ((0, 0), (0, w - w1 - w2)))
+            j = xp.arange(w, dtype=np.int32)[None, :]
+            l1 = out.lengths[:, None]
+            idx = xp.where(j < l1, xp.minimum(j, w1 - 1),
+                           xp.minimum(w1 + (j - l1), w1 + w2 - 1))
+            data = xp.take_along_axis(both, idx, axis=1)
+            new_len = out.lengths + v.lengths
+            keep = j < new_len[:, None]
+            data = xp.where(keep, data, np.uint8(0))
+            out = Vec(T.STRING, data, out.validity & v.validity, new_len)
+        return out
+
+
+class _PatternPredicate(Expression):
+    """Binary string predicate where the right side is typically a literal; works
+    for column patterns too (loops over the pattern width, a static bound)."""
+
+    def __init__(self, left, right):
+        super().__init__([left, right])
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+
+class StartsWith(_PatternPredicate):
+    def _compute(self, ctx: EvalContext, s: Vec, p: Vec) -> Vec:
+        xp = ctx.xp
+        ds, dp = pad_common_width(xp, s, p)
+        w = ds.shape[1]
+        j = xp.arange(w, dtype=np.int32)[None, :]
+        in_p = j < p.lengths[:, None]
+        ok = xp.all(~in_p | (ds == dp), axis=1) & (s.lengths >= p.lengths)
+        return Vec(T.BOOLEAN, ok, and_validity(xp, s.validity, p.validity))
+
+
+class EndsWith(_PatternPredicate):
+    def _compute(self, ctx: EvalContext, s: Vec, p: Vec) -> Vec:
+        xp = ctx.xp
+        ds, dp = pad_common_width(xp, s, p)
+        w = ds.shape[1]
+        j = xp.arange(w, dtype=np.int32)[None, :]
+        shift = (s.lengths - p.lengths)[:, None]
+        idx = xp.clip(j + shift, 0, w - 1)
+        tail = xp.take_along_axis(ds, idx, axis=1)
+        in_p = j < p.lengths[:, None]
+        ok = xp.all(~in_p | (tail == dp), axis=1) & (s.lengths >= p.lengths)
+        return Vec(T.BOOLEAN, ok, and_validity(xp, s.validity, p.validity))
+
+
+class Contains(_PatternPredicate):
+    def _compute(self, ctx: EvalContext, s: Vec, p: Vec) -> Vec:
+        xp = ctx.xp
+        ds, dp = pad_common_width(xp, s, p)
+        n, w = ds.shape
+        j = xp.arange(w, dtype=np.int32)[None, :]
+        # match[i, k] = pattern matches at shift k; built by a static loop over
+        # shift amounts using rolled compares (O(w) vector ops)
+        ok = xp.zeros(n, dtype=bool)
+        for k in range(w):
+            valid_shift = (p.lengths + k) <= s.lengths
+            idx = xp.clip(j + k, 0, w - 1)
+            window = xp.take_along_axis(ds, idx, axis=1)
+            in_p = j < p.lengths[:, None]
+            m = xp.all(~in_p | (window == dp), axis=1) & valid_shift
+            ok = ok | m
+        return Vec(T.BOOLEAN, ok, and_validity(xp, s.validity, p.validity))
+
+
+class _Trim(StringUnary):
+    trim_left = True
+    trim_right = True
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _compute(self, ctx: EvalContext, c: Vec) -> Vec:
+        xp = ctx.xp
+        chars, lengths = c.data, c.lengths
+        n, w = chars.shape
+        j = xp.arange(w, dtype=np.int32)[None, :]
+        in_row = j < lengths[:, None]
+        is_space = (chars == 0x20) & in_row
+        nonspace = in_row & ~is_space
+        any_ns = xp.any(nonspace, axis=1)
+        first_ns = xp.argmax(nonspace, axis=1).astype(np.int32)
+        # last non-space: argmax over reversed axis
+        last_ns = (w - 1 - xp.argmax(nonspace[:, ::-1], axis=1)).astype(np.int32)
+        start = xp.where(any_ns, first_ns if self.trim_left else 0, 0)
+        end = xp.where(any_ns,
+                       (last_ns + 1) if self.trim_right else lengths,
+                       0)
+        out_len = xp.maximum(end - start, 0)
+        idx = xp.minimum(start[:, None] + j, w - 1)
+        data = xp.take_along_axis(chars, idx, axis=1)
+        keep = j < out_len[:, None]
+        data = xp.where(keep, data, np.uint8(0))
+        return Vec(T.STRING, data, c.validity, out_len)
+
+
+class StringTrim(_Trim):
+    pass
+
+
+class StringTrimLeft(_Trim):
+    trim_right = False
+
+
+class StringTrimRight(_Trim):
+    trim_left = False

@@ -1,0 +1,65 @@
+"""CPU-vs-TPU differential harness — the analog of the reference's
+`assert_gpu_and_cpu_are_equal_collect` (`integration_tests/.../asserts.py:261-536`):
+the same expression/plan is evaluated by the CPU engine (numpy, exact-length) and the
+device engine (jax.numpy under jit, padded batches, traced row count) and results are
+compared exactly (or approximately for floats where reduction order differs)."""
+
+import math
+
+import jax
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.columnar import batch_from_arrow
+from spark_rapids_tpu.columnar.column import to_arrow as col_to_arrow
+from spark_rapids_tpu.cpu.hostbatch import (host_batch_from_arrow,
+                                            host_vec_to_arrow)
+from spark_rapids_tpu.expr.base import EvalContext, Vec, bind_references
+
+
+def eval_cpu(expr_factory, table: pa.Table):
+    hb = host_batch_from_arrow(table)
+    expr = bind_references(expr_factory(), hb.schema)
+    ctx = EvalContext(np, row_mask=np.ones(hb.num_rows, dtype=bool))
+    out = expr.eval(ctx, hb.vecs)
+    return host_vec_to_arrow(out, hb.num_rows)
+
+
+def eval_tpu(expr_factory, table: pa.Table):
+    import jax.numpy as jnp
+    batch = batch_from_arrow(table)
+    hb_schema = batch.schema
+    expr = bind_references(expr_factory(), hb_schema)
+
+    def fn(b):
+        ctx = EvalContext(jnp, row_mask=b.row_mask())
+        vecs = [Vec.from_column(c) for c in b.columns]
+        return expr.eval(ctx, vecs).to_column()
+
+    col = jax.jit(fn)(batch)
+    return col_to_arrow(col, batch.row_count())
+
+
+def assert_arrays_equal(cpu, tpu, approx=False):
+    cl, tl = cpu.to_pylist(), tpu.to_pylist()
+    assert len(cl) == len(tl), f"length {len(cl)} vs {len(tl)}"
+    for i, (a, b) in enumerate(zip(cl, tl)):
+        if a is None or b is None:
+            assert a is None and b is None, f"row {i}: {a!r} vs {b!r}"
+        elif isinstance(a, float):
+            if math.isnan(a) or math.isnan(b):
+                assert math.isnan(a) and math.isnan(b), f"row {i}: {a!r} vs {b!r}"
+            elif approx:
+                assert a == b or abs(a - b) <= 1e-6 * max(abs(a), abs(b)), \
+                    f"row {i}: {a!r} vs {b!r}"
+            else:
+                assert a == b, f"row {i}: {a!r} vs {b!r}"
+        else:
+            assert a == b, f"row {i}: {a!r} vs {b!r}"
+
+
+def assert_cpu_tpu_equal(expr_factory, table: pa.Table, approx=False):
+    cpu = eval_cpu(expr_factory, table)
+    tpu = eval_tpu(expr_factory, table)
+    assert_arrays_equal(cpu, tpu, approx=approx)
+    return cpu

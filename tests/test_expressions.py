@@ -1,0 +1,417 @@
+"""Expression semantics tests: CPU-vs-TPU differential + handwritten Spark-semantic
+expectations (the reference's CastOpSuite / arithmetic suites model)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr import (
+    Abs, Add, And, Cast, CaseWhen, Coalesce, Concat, Contains, DateAdd, DateDiff,
+    DayOfMonth, DayOfWeek, Divide, EndsWith, EqualNullSafe, EqualTo, Greatest, Hour,
+    If, In, IntegralDivide, IsNaN, IsNotNull, IsNull, Least, Length, LessThan,
+    Literal, Lower, Minute, Month, Murmur3Hash, Not, Or, Pmod, Remainder, Round,
+    Second, ShiftLeft, ShiftRight, ShiftRightUnsigned, StartsWith, StringTrim,
+    Substring, Upper, Year, col, lit)
+from harness import assert_cpu_tpu_equal, eval_cpu
+
+I = lambda *v: pa.array(v, type=pa.int32())
+L = lambda *v: pa.array(v, type=pa.int64())
+D = lambda *v: pa.array(v, type=pa.float64())
+S = lambda *v: pa.array(v, type=pa.string())
+B = lambda *v: pa.array(v, type=pa.bool_())
+
+
+def t(**cols):
+    return pa.table(dict(cols))
+
+
+class TestArithmetic:
+    def test_add_promote(self):
+        out = assert_cpu_tpu_equal(lambda: Add(col("a"), col("b")),
+                                   t(a=I(1, None, 3), b=L(10, 20, None)))
+        assert out.to_pylist() == [11, None, None]
+        assert out.type == pa.int64()
+
+    def test_int_overflow_wraps(self):
+        out = assert_cpu_tpu_equal(lambda: Add(col("a"), col("a")),
+                                   t(a=L(2**62, -5)))
+        assert out.to_pylist() == [-2**63, -10]
+
+    def test_divide_by_zero_null(self):
+        out = assert_cpu_tpu_equal(lambda: Divide(col("a"), col("b")),
+                                   t(a=I(10, 7, None), b=I(0, 2, 3)))
+        assert out.to_pylist() == [None, 3.5, None]
+
+    def test_integral_divide_trunc(self):
+        out = assert_cpu_tpu_equal(lambda: IntegralDivide(col("a"), col("b")),
+                                   t(a=I(7, -7, 7, -7, 5), b=I(2, 2, -2, -2, 0)))
+        assert out.to_pylist() == [3, -3, -3, 3, None]
+
+    def test_remainder_java_sign(self):
+        out = assert_cpu_tpu_equal(lambda: Remainder(col("a"), col("b")),
+                                   t(a=I(7, -7, 7, -7), b=I(3, 3, -3, -3)))
+        assert out.to_pylist() == [1, -1, 1, -1]  # sign follows dividend
+
+    def test_pmod(self):
+        out = assert_cpu_tpu_equal(lambda: Pmod(col("a"), col("b")),
+                                   t(a=I(7, -7), b=I(3, 3)))
+        assert out.to_pylist() == [1, 2]
+
+    def test_float_remainder(self):
+        out = assert_cpu_tpu_equal(lambda: Remainder(col("a"), col("b")),
+                                   t(a=D(5.5, -5.5), b=D(2.0, 2.0)))
+        assert out.to_pylist() == [1.5, -1.5]
+
+    def test_abs(self):
+        out = assert_cpu_tpu_equal(lambda: Abs(col("a")), t(a=I(-3, 4, None)))
+        assert out.to_pylist() == [3, 4, None]
+
+
+class TestPredicates:
+    def test_compare_nan_semantics(self):
+        nan = float("nan")
+        tbl = t(a=D(1.0, nan, nan, 2.0), b=D(nan, nan, 1.0, 2.0))
+        assert assert_cpu_tpu_equal(
+            lambda: EqualTo(col("a"), col("b")), tbl).to_pylist() == \
+            [False, True, False, True]
+        assert assert_cpu_tpu_equal(
+            lambda: LessThan(col("a"), col("b")), tbl).to_pylist() == \
+            [True, False, False, False]
+
+    def test_string_compare(self):
+        tbl = t(a=S("apple", "b", "abc", "", None),
+                b=S("apricot", "a", "abc", "x", "y"))
+        assert assert_cpu_tpu_equal(
+            lambda: LessThan(col("a"), col("b")), tbl).to_pylist() == \
+            [True, False, False, True, None]
+        assert assert_cpu_tpu_equal(
+            lambda: EqualTo(col("a"), col("b")), tbl).to_pylist() == \
+            [False, False, True, False, None]
+
+    def test_kleene_and_or(self):
+        tbl = t(a=B(True, True, False, None, None),
+                b=B(None, False, None, None, False))
+        assert assert_cpu_tpu_equal(lambda: And(col("a"), col("b")), tbl) \
+            .to_pylist() == [None, False, False, None, False]
+        assert assert_cpu_tpu_equal(lambda: Or(col("a"), col("b")), tbl) \
+            .to_pylist() == [True, True, None, None, None]
+
+    def test_null_safe_equal(self):
+        tbl = t(a=I(1, None, None, 2), b=I(1, None, 3, 5))
+        assert assert_cpu_tpu_equal(
+            lambda: EqualNullSafe(col("a"), col("b")), tbl).to_pylist() == \
+            [True, True, False, False]
+
+    def test_in(self):
+        tbl = t(a=I(1, 2, 3, None))
+        assert assert_cpu_tpu_equal(lambda: In(col("a"), [1, 3]), tbl) \
+            .to_pylist() == [True, False, True, None]
+        assert assert_cpu_tpu_equal(lambda: In(col("a"), [1, None]), tbl) \
+            .to_pylist() == [True, None, None, None]
+
+    def test_not(self):
+        assert assert_cpu_tpu_equal(lambda: Not(col("a")),
+                                    t(a=B(True, False, None))).to_pylist() == \
+            [False, True, None]
+
+
+class TestConditional:
+    def test_if(self):
+        tbl = t(c=B(True, False, None), a=I(1, 2, 3), b=I(10, 20, 30))
+        assert assert_cpu_tpu_equal(lambda: If(col("c"), col("a"), col("b")),
+                                    tbl).to_pylist() == [1, 20, 30]
+
+    def test_case_when(self):
+        tbl = t(x=I(1, 5, 15, None))
+        assert assert_cpu_tpu_equal(
+            lambda: CaseWhen([(LessThan(col("x"), lit(3)), lit(100)),
+                              (LessThan(col("x"), lit(10)), lit(200))],
+                             lit(300)), tbl).to_pylist() == [100, 200, 300, 300]
+
+    def test_coalesce(self):
+        tbl = t(a=I(None, 2, None), b=I(1, 5, None))
+        assert assert_cpu_tpu_equal(lambda: Coalesce(col("a"), col("b")), tbl) \
+            .to_pylist() == [1, 2, None]
+
+    def test_coalesce_strings(self):
+        tbl = t(a=S(None, "x", None), b=S("fallback", "y", None))
+        assert assert_cpu_tpu_equal(lambda: Coalesce(col("a"), col("b")), tbl) \
+            .to_pylist() == ["fallback", "x", None]
+
+    def test_least_greatest(self):
+        tbl = t(a=I(1, None, 5), b=I(3, 2, None))
+        assert assert_cpu_tpu_equal(lambda: Least(col("a"), col("b")), tbl) \
+            .to_pylist() == [1, 2, 5]
+        assert assert_cpu_tpu_equal(lambda: Greatest(col("a"), col("b")), tbl) \
+            .to_pylist() == [3, 2, 5]
+
+
+class TestNullExprs:
+    def test_is_null(self):
+        tbl = t(a=I(1, None))
+        assert assert_cpu_tpu_equal(lambda: IsNull(col("a")), tbl).to_pylist() \
+            == [False, True]
+        assert assert_cpu_tpu_equal(lambda: IsNotNull(col("a")), tbl) \
+            .to_pylist() == [True, False]
+
+    def test_is_nan(self):
+        tbl = t(a=D(1.0, float("nan"), None))
+        assert assert_cpu_tpu_equal(lambda: IsNaN(col("a")), tbl).to_pylist() \
+            == [False, True, False]
+
+
+class TestStrings:
+    def test_length_chars(self):
+        tbl = t(s=S("hello", "", "日本語", "a🎉b", None))
+        assert assert_cpu_tpu_equal(lambda: Length(col("s")), tbl).to_pylist() \
+            == [5, 0, 3, 3, None]
+
+    def test_upper_lower(self):
+        tbl = t(s=S("MiXeD", "abc", None))
+        assert assert_cpu_tpu_equal(lambda: Upper(col("s")), tbl).to_pylist() \
+            == ["MIXED", "ABC", None]
+        assert assert_cpu_tpu_equal(lambda: Lower(col("s")), tbl).to_pylist() \
+            == ["mixed", "abc", None]
+
+    def test_substring(self):
+        tbl = t(s=S("hello world", "ab", "日本語テキスト", ""))
+        assert assert_cpu_tpu_equal(
+            lambda: Substring(col("s"), lit(1), lit(5)), tbl).to_pylist() == \
+            ["hello", "ab", "日本語テキ", ""]
+        # Spark: start=len+pos may be <0; end=start+len computed before clamping,
+        # so substring('ab', -3, 2) = 'a' (window shortened, not shifted)
+        assert assert_cpu_tpu_equal(
+            lambda: Substring(col("s"), lit(-3), lit(2)), tbl).to_pylist() == \
+            ["rl", "a", "キス", ""]
+        assert assert_cpu_tpu_equal(
+            lambda: Substring(col("s"), lit(7), lit(100)), tbl).to_pylist() == \
+            ["world", "", "ト", ""]
+
+    def test_concat(self):
+        tbl = t(a=S("foo", "", None), b=S("bar", "x", "y"))
+        assert assert_cpu_tpu_equal(lambda: Concat(col("a"), col("b")), tbl) \
+            .to_pylist() == ["foobar", "x", None]
+
+    def test_starts_ends_contains(self):
+        tbl = t(s=S("hello world", "world", "hell", None))
+        assert assert_cpu_tpu_equal(
+            lambda: StartsWith(col("s"), lit("hell")), tbl).to_pylist() == \
+            [True, False, True, None]
+        assert assert_cpu_tpu_equal(
+            lambda: EndsWith(col("s"), lit("world")), tbl).to_pylist() == \
+            [True, True, False, None]
+        assert assert_cpu_tpu_equal(
+            lambda: Contains(col("s"), lit("o w")), tbl).to_pylist() == \
+            [True, False, False, None]
+        assert assert_cpu_tpu_equal(
+            lambda: Contains(col("s"), lit("")), tbl).to_pylist() == \
+            [True, True, True, None]
+
+    def test_trim(self):
+        tbl = t(s=S("  hi  ", "hi", "   ", ""))
+        assert assert_cpu_tpu_equal(lambda: StringTrim(col("s")), tbl) \
+            .to_pylist() == ["hi", "hi", "", ""]
+
+
+class TestDatetime:
+    def test_date_parts(self):
+        # 2023-11-14 = 19675 days; 1970-01-01; 2000-02-29
+        tbl = pa.table({"d": pa.array([19675, 0, 11016, None], type=pa.date32())})
+        assert assert_cpu_tpu_equal(lambda: Year(col("d")), tbl).to_pylist() == \
+            [2023, 1970, 2000, None]
+        assert assert_cpu_tpu_equal(lambda: Month(col("d")), tbl).to_pylist() == \
+            [11, 1, 2, None]
+        assert assert_cpu_tpu_equal(lambda: DayOfMonth(col("d")), tbl) \
+            .to_pylist() == [14, 1, 29, None]
+        assert assert_cpu_tpu_equal(lambda: DayOfWeek(col("d")), tbl) \
+            .to_pylist() == [3, 5, 3, None]  # Tue=3, Thu=5, Tue=3
+
+    def test_negative_days(self):
+        tbl = pa.table({"d": pa.array([-1, -365], type=pa.date32())})
+        assert assert_cpu_tpu_equal(lambda: Year(col("d")), tbl).to_pylist() == \
+            [1969, 1969]
+        assert assert_cpu_tpu_equal(lambda: Month(col("d")), tbl).to_pylist() == \
+            [12, 1]
+
+    def test_time_parts(self):
+        us = 1_700_000_000_000_000  # 2023-11-14T22:13:20Z
+        tbl = pa.table({"ts": pa.array([us, 0, -1_000_000],
+                                       type=pa.timestamp("us", tz="UTC"))})
+        assert assert_cpu_tpu_equal(lambda: Hour(col("ts")), tbl).to_pylist() == \
+            [22, 0, 23]
+        assert assert_cpu_tpu_equal(lambda: Minute(col("ts")), tbl).to_pylist() \
+            == [13, 0, 59]
+        assert assert_cpu_tpu_equal(lambda: Second(col("ts")), tbl).to_pylist() \
+            == [20, 0, 59]
+
+    def test_date_add_diff(self):
+        tbl = pa.table({"d": pa.array([19675, 0], type=pa.date32()),
+                        "k": I(10, -10)})
+        assert assert_cpu_tpu_equal(lambda: DateAdd(col("d"), col("k")), tbl) \
+            .to_pylist()[0].isoformat() == "2023-11-24"
+
+
+class TestCast:
+    def test_long_to_int_wraps(self):
+        tbl = t(a=L(2**31, -2**31 - 1, 5))
+        out = assert_cpu_tpu_equal(lambda: Cast(col("a"), T.INT), tbl)
+        assert out.to_pylist() == [-2**31, 2**31 - 1, 5]
+
+    def test_double_to_int_java(self):
+        tbl = t(a=D(1.9, -1.9, float("nan"), 1e20, -1e20))
+        out = assert_cpu_tpu_equal(lambda: Cast(col("a"), T.INT), tbl)
+        assert out.to_pylist() == [1, -1, 0, 2**31 - 1, -2**31]
+
+    def test_int_to_string(self):
+        tbl = t(a=L(0, -1, 1234567890123, -2**63, None))
+        out = assert_cpu_tpu_equal(lambda: Cast(col("a"), T.STRING), tbl)
+        assert out.to_pylist() == ["0", "-1", "1234567890123",
+                                   "-9223372036854775808", None]
+
+    def test_bool_to_string(self):
+        out = assert_cpu_tpu_equal(lambda: Cast(col("a"), T.STRING),
+                                   t(a=B(True, False, None)))
+        assert out.to_pylist() == ["true", "false", None]
+
+    def test_string_to_int(self):
+        tbl = t(s=S(" 42 ", "-7", "+13", "abc", "12.5", "", None,
+                    "99999999999999999999"))
+        out = assert_cpu_tpu_equal(lambda: Cast(col("s"), T.INT), tbl)
+        assert out.to_pylist() == [42, -7, 13, None, None, None, None, None]
+
+    def test_string_to_bool(self):
+        tbl = t(s=S("true", "FALSE", "t", "no", "1", "maybe", None))
+        out = assert_cpu_tpu_equal(lambda: Cast(col("s"), T.BOOLEAN), tbl)
+        assert out.to_pylist() == [True, False, True, False, True, None, None]
+
+    def test_date_to_string(self):
+        tbl = pa.table({"d": pa.array([19675, 0, 11016], type=pa.date32())})
+        out = assert_cpu_tpu_equal(lambda: Cast(col("d"), T.STRING), tbl)
+        assert out.to_pylist() == ["2023-11-14", "1970-01-01", "2000-02-29"]
+
+    def test_string_to_date(self):
+        tbl = t(s=S("2023-11-14", "1970-01-01", "2000-02-29", "2001-02-29",
+                    "not a date", "2023-13-01", None))
+        out = assert_cpu_tpu_equal(lambda: Cast(col("s"), T.DATE), tbl)
+        assert [d.isoformat() if d else None for d in out.to_pylist()] == \
+            ["2023-11-14", "1970-01-01", "2000-02-29", None, None, None, None]
+
+    def test_ts_date_roundtrip(self):
+        tbl = pa.table({"ts": pa.array([1_700_000_000_000_000, -1],
+                                       type=pa.timestamp("us", tz="UTC"))})
+        out = assert_cpu_tpu_equal(lambda: Cast(col("ts"), T.DATE), tbl)
+        assert [d.isoformat() for d in out.to_pylist()] == \
+            ["2023-11-14", "1969-12-31"]
+
+
+def _py_spark_murmur3_int(v, seed):
+    """Independent scalar reimplementation of Murmur3_x86_32.hashInt."""
+    M = 0xFFFFFFFF
+
+    def rotl(x, r):
+        return ((x << r) | (x >> (32 - r))) & M
+
+    def mixk1(k1):
+        k1 = (k1 * 0xcc9e2d51) & M
+        k1 = rotl(k1, 15)
+        return (k1 * 0x1b873593) & M
+
+    def mixh1(h1, k1):
+        h1 ^= k1
+        h1 = rotl(h1, 13)
+        return (h1 * 5 + 0xe6546b64) & M
+
+    h1 = mixh1(seed & M, mixk1(v & M))
+    h1 ^= 4
+    h1 ^= h1 >> 16
+    h1 = (h1 * 0x85ebca6b) & M
+    h1 ^= h1 >> 13
+    h1 = (h1 * 0xc2b2ae35) & M
+    h1 ^= h1 >> 16
+    return h1 - (1 << 32) if h1 >= (1 << 31) else h1
+
+
+class TestHash:
+    def test_murmur3_int_matches_reference_impl(self):
+        vals = [0, 1, -1, 42, 2**31 - 1, -2**31]
+        tbl = t(a=pa.array(vals, type=pa.int32()))
+        out = assert_cpu_tpu_equal(lambda: Murmur3Hash(col("a")), tbl)
+        assert out.to_pylist() == [_py_spark_murmur3_int(v, 42) for v in vals]
+
+    def test_murmur3_null_passthrough(self):
+        # null column passes seed through: hash(null) == seed mixed with nothing
+        tbl = t(a=I(None, None))
+        out = assert_cpu_tpu_equal(lambda: Murmur3Hash(col("a")), tbl)
+        assert out.to_pylist() == [42, 42]
+
+    def test_murmur3_string_cpu_tpu(self):
+        tbl = t(s=S("", "a", "abcd", "abcde", "hello world, this is long",
+                    None, "日本語"))
+        out = assert_cpu_tpu_equal(lambda: Murmur3Hash(col("s")), tbl)
+        assert out.to_pylist()[5] == 42  # null row -> seed
+
+    def test_murmur3_multi_column(self):
+        tbl = t(a=I(1, 2), s=S("x", None), d=D(1.5, -0.0))
+        assert_cpu_tpu_equal(lambda: Murmur3Hash(col("a"), col("s"), col("d")),
+                             tbl)
+
+
+class TestMathExprs:
+    def test_log_domain(self):
+        from spark_rapids_tpu.expr import Log
+        tbl = t(a=D(1.0, 0.0, -1.0, None))
+        out = assert_cpu_tpu_equal(lambda: Log(col("a")), tbl)
+        assert out.to_pylist() == [0.0, None, None, None]
+
+    def test_round_half_up(self):
+        tbl = t(a=D(2.5, 3.5, -2.5, 1.25))
+        out = assert_cpu_tpu_equal(lambda: Round(col("a"), 0), tbl)
+        assert out.to_pylist() == [3.0, 4.0, -3.0, 1.0]
+
+    def test_shifts(self):
+        tbl = t(a=I(8, -8), k=I(1, 1))
+        assert assert_cpu_tpu_equal(lambda: ShiftLeft(col("a"), col("k")), tbl) \
+            .to_pylist() == [16, -16]
+        assert assert_cpu_tpu_equal(lambda: ShiftRight(col("a"), col("k")), tbl) \
+            .to_pylist() == [4, -4]
+        assert assert_cpu_tpu_equal(
+            lambda: ShiftRightUnsigned(col("a"), col("k")), tbl).to_pylist() == \
+            [4, 2147483644]
+
+
+class TestDoubleBits:
+    def test_murmur3_double_edge_values(self):
+        # NOTE: subnormals (e.g. 5e-324) excluded — XLA flushes f64 subnormals to
+        # zero on device (documented incompat in hashing._double_bits)
+        vals = [0.0, -0.0, 1.5, -1.5, float("inf"), float("-inf"),
+                float("nan"), 2.2250738585072014e-308, 1e308, None]
+        tbl = t(a=pa.array(vals, type=pa.float64()))
+        assert_cpu_tpu_equal(lambda: Murmur3Hash(col("a")), tbl)
+
+
+class TestReviewRegressions:
+    def test_trunc_div_int_min(self):
+        tbl = t(a=L(-2**63, -2**63, -2**31), b=L(3, -1, 3))
+        assert assert_cpu_tpu_equal(lambda: IntegralDivide(col("a"), col("b")),
+                                    tbl).to_pylist() == \
+            [-3074457345618258602, -2**63, -715827882]
+        assert assert_cpu_tpu_equal(lambda: Remainder(col("a"), col("b")), tbl) \
+            .to_pylist() == [-2, 0, -2]
+
+    def test_string_to_long_overflow_null(self):
+        tbl = t(s=S("99999999999999999999", "9223372036854775807",
+                    "-9223372036854775808", "9223372036854775808",
+                    "-9223372036854775809"))
+        out = assert_cpu_tpu_equal(lambda: Cast(col("s"), T.LONG), tbl)
+        assert out.to_pylist() == [None, 2**63 - 1, -2**63, None, None]
+
+    def test_double_to_long_bounds(self):
+        tbl = t(a=D(1e20, -1e20, 9.3e18, float("nan")))
+        out = assert_cpu_tpu_equal(lambda: Cast(col("a"), T.LONG), tbl)
+        assert out.to_pylist() == [2**63 - 1, -2**63, 2**63 - 1, 0]
+
+    def test_string_nul_ordering(self):
+        tbl = t(a=S("a", "a\x00", "a"), b=S("a\x00", "a", "ab"))
+        assert assert_cpu_tpu_equal(lambda: LessThan(col("a"), col("b")), tbl) \
+            .to_pylist() == [True, False, True]
