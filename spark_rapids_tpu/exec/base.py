@@ -1,0 +1,88 @@
+"""TPU exec operator base (reference `GpuExec.scala:179-315`: metrics plumbing +
+internalDoExecuteColumnar).
+
+Execution model: an exec produces an iterator of device `ColumnarBatch`es per
+partition. Device compute happens in jit-compiled kernels created once per exec
+instance; XLA's compile cache makes repeat shapes cheap, and the bucketed padding
+keeps the shape set small. Host code between kernels handles iteration, coalescing
+decisions, and spill/retry control flow — mirroring how reference operators are host
+Scala around cudf kernel launches."""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar.batch import ColumnarBatch, Schema
+from ..config import TpuConf, get_default_conf
+from ..expr.base import EvalContext, Vec
+from ..utils import metrics as M
+from ..utils.tracing import trace_range
+
+
+class TpuExec:
+    def __init__(self, children: Sequence["TpuExec"], conf: TpuConf = None):
+        self.children = list(children)
+        self.conf = conf or get_default_conf()
+        self.metrics = M.MetricsSet(self.conf.get("spark.rapids.sql.metrics.level"))
+        self.num_output_rows = self.metrics.create(M.NUM_OUTPUT_ROWS, M.ESSENTIAL)
+        self.num_output_batches = self.metrics.create(M.NUM_OUTPUT_BATCHES,
+                                                      M.MODERATE)
+        self.op_time = self.metrics.create(M.OP_TIME, M.MODERATE)
+
+    @property
+    def output(self) -> Schema:
+        raise NotImplementedError
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        """Produce output batches (single-partition stream; exchange operators
+        introduce partitioned streams)."""
+        with trace_range(self.name):
+            yield from self.do_execute()
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        raise NotImplementedError
+
+    def _count_output(self, batch: ColumnarBatch) -> ColumnarBatch:
+        self.num_output_batches.add(1)
+        return batch
+
+    def tree_string(self, indent: int = 0) -> str:
+        s = "  " * indent + f"{self.name}{self._arg_string()}\n"
+        for c in self.children:
+            s += c.tree_string(indent + 1)
+        return s
+
+    def _arg_string(self) -> str:
+        return ""
+
+
+class UnaryTpuExec(TpuExec):
+    @property
+    def child(self) -> TpuExec:
+        return self.children[0]
+
+    @property
+    def output(self) -> Schema:
+        return self.child.output
+
+
+def device_ctx(batch: ColumnarBatch, conf: TpuConf = None) -> EvalContext:
+    return EvalContext(jnp, row_mask=batch.row_mask(),
+                       ansi=(conf or get_default_conf()).is_ansi, conf=conf)
+
+
+def batch_vecs(batch: ColumnarBatch) -> List[Vec]:
+    return [Vec.from_column(c) for c in batch.columns]
+
+
+def vecs_to_batch(schema: Schema, vecs: Sequence[Vec], num_rows) -> ColumnarBatch:
+    return ColumnarBatch(schema, tuple(v.to_column() for v in vecs),
+                         jnp.asarray(num_rows, dtype=jnp.int32))

@@ -1,0 +1,103 @@
+"""Batch concatenation and coalescing (reference `GpuCoalesceBatches.scala`:
+goals TargetSize / RequireSingleBatch `:107-238`, iterator `:247-717`).
+
+Concatenation must drop inter-batch padding: concat all padded columns, then
+stable-compact on the concatenated live-row mask, then slice to the output bucket.
+One fused kernel per (input shapes, out_cap) signature."""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterator, List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar.batch import ColumnarBatch, Schema
+from ..columnar.padding import row_bucket
+from ..expr.base import Vec
+from ..ops.rowops import compact_vecs
+from ..utils import metrics as M
+from .base import TpuExec, UnaryTpuExec, batch_vecs, vecs_to_batch
+
+
+class CoalesceGoal:
+    pass
+
+
+class TargetSize(CoalesceGoal):
+    def __init__(self, bytes_target: int):
+        self.bytes_target = bytes_target
+
+
+class RequireSingleBatch(CoalesceGoal):
+    pass
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _concat_kernel(batches: List[ColumnarBatch], out_cap: int) -> ColumnarBatch:
+    schema = batches[0].schema
+    ncols = len(schema.types)
+    masks = jnp.concatenate([b.row_mask() for b in batches])
+    out_vecs = []
+    cols_by_i = [[Vec.from_column(b.columns[i]) for b in batches]
+                 for i in range(ncols)]
+    merged: List[Vec] = []
+    for i in range(ncols):
+        vs = cols_by_i[i]
+        if vs[0].is_string:
+            w = max(v.data.shape[1] for v in vs)
+            data = jnp.concatenate(
+                [jnp.pad(v.data, ((0, 0), (0, w - v.data.shape[1])))
+                 for v in vs])
+            merged.append(Vec(vs[0].dtype, data,
+                              jnp.concatenate([v.validity for v in vs]),
+                              jnp.concatenate([v.lengths for v in vs])))
+        else:
+            merged.append(Vec(vs[0].dtype,
+                              jnp.concatenate([v.data for v in vs]),
+                              jnp.concatenate([v.validity for v in vs])))
+    compacted, total = compact_vecs(jnp, merged, masks)
+    for v in compacted:
+        out_vecs.append(Vec(
+            v.dtype, v.data[:out_cap], v.validity[:out_cap],
+            None if v.lengths is None else v.lengths[:out_cap]))
+    return vecs_to_batch(schema, out_vecs, total)
+
+
+def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
+    """Concatenate device batches (host decides the output bucket)."""
+    batches = list(batches)
+    if len(batches) == 1:
+        return batches[0]
+    total = sum(b.row_count() for b in batches)
+    out_cap = row_bucket(total)
+    return _concat_kernel(batches, out_cap)
+
+
+class TpuCoalesceBatchesExec(UnaryTpuExec):
+    def __init__(self, child: TpuExec, goal: CoalesceGoal = None, conf=None):
+        super().__init__([child], conf)
+        self.goal = goal or TargetSize(self.conf.batch_size_bytes)
+        self.concat_time = self.metrics.create(M.CONCAT_TIME, M.MODERATE)
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        pending: List[ColumnarBatch] = []
+        pending_bytes = 0
+        target = None if isinstance(self.goal, RequireSingleBatch) else \
+            self.goal.bytes_target
+        for b in self.child.execute():
+            pending.append(b)
+            pending_bytes += b.device_memory_size()
+            if target is not None and pending_bytes >= target:
+                yield self._emit(pending)
+                pending, pending_bytes = [], 0
+        if pending:
+            yield self._emit(pending)
+
+    def _emit(self, pending: List[ColumnarBatch]) -> ColumnarBatch:
+        with self.concat_time.timed():
+            out = concat_batches(pending)
+        self.num_output_rows.add(out.row_count())
+        return self._count_output(out)

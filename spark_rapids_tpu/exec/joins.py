@@ -1,0 +1,267 @@
+"""Hash join exec (reference `GpuHashJoin.doJoin` `GpuHashJoin.scala:950`,
+`GpuShuffledHashJoinExec.scala`, gather-map composition `JoinGatherer.scala:54-641`).
+
+TPU lowering (ARCHITECTURE.md #4): equi-joins run as hash-sorted probe —
+  1. hash the build-side keys (Spark murmur3), sort build rows by hash;
+  2. per probe row, locate the candidate range via searchsorted(left/right);
+  3. expand matches into (probe_idx, build_idx) pairs at a host-chosen output
+     capacity (the JoinGatherer chunking analog: counts are computed on device,
+     summed, synced once to pick the bucket — data-dependent sizes never reach XLA);
+  4. gather both sides, verify true key equality (hash collisions + null keys),
+     compact away false positives.
+Left/right/full outer rows are emitted via the unmatched masks; semi/anti reduce the
+counts instead of expanding. Build side defaults to the right child like the
+reference's GpuShuffledHashJoinExec with BuildRight."""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterator, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..columnar.batch import ColumnarBatch, Schema
+from ..columnar.padding import row_bucket
+from ..expr.base import Expression, Vec, bind_references
+from ..expr.hashing import hash_vecs
+from ..expr.predicates import string_equal
+from ..ops.rowops import compact_vecs, gather_vecs
+from ..utils import metrics as M
+from .base import TpuExec, batch_vecs, device_ctx, vecs_to_batch
+from .coalesce import concat_batches
+
+
+def _keys_valid(xp, keys: List[Vec]):
+    ok = None
+    for k in keys:
+        ok = k.validity if ok is None else (ok & k.validity)
+    return ok
+
+
+def _keys_equal(xp, a: List[Vec], b: List[Vec]):
+    eq = None
+    for ka, kb in zip(a, b):
+        if ka.is_string:
+            e = string_equal(xp, ka, kb)
+        elif T.is_floating(ka.dtype):
+            e = (ka.data == kb.data) | (xp.isnan(ka.data) & xp.isnan(kb.data))
+        else:
+            e = ka.data == kb.data
+        eq = e if eq is None else (eq & e)
+    return eq
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _probe_counts(probe: ColumnarBatch, build: ColumnarBatch,
+                  probe_key_ix: Tuple[int, ...], build_key_ix: Tuple[int, ...]):
+    """Phase 1: per-probe candidate counts (by hash range) + sorted build order."""
+    xp = jnp
+    pvecs = batch_vecs(probe)
+    bvecs = batch_vecs(build)
+    pkeys = [pvecs[i] for i in probe_key_ix]
+    bkeys = [bvecs[i] for i in build_key_ix]
+    pmask = probe.row_mask()
+    bmask = build.row_mask()
+    pvalid = _keys_valid(xp, pkeys) & pmask
+    bvalid = _keys_valid(xp, bkeys) & bmask
+
+    ph = hash_vecs(xp, pkeys).astype(np.int64)
+    bh = hash_vecs(xp, bkeys).astype(np.int64)
+    # exile invalid build rows to a hash bucket no valid probe can hit
+    bh = xp.where(bvalid, bh, np.int64(2 ** 62))
+    order = xp.argsort(bh)
+    bh_sorted = bh[order]
+    lo = xp.searchsorted(bh_sorted, ph, side="left")
+    hi = xp.searchsorted(bh_sorted, ph, side="right")
+    counts = xp.where(pvalid, hi - lo, 0).astype(np.int32)
+    return counts, lo.astype(np.int32), order.astype(np.int32), pvalid, bvalid
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5))
+def _expand_join(probe: ColumnarBatch, build: ColumnarBatch,
+                 probe_key_ix: Tuple[int, ...], build_key_ix: Tuple[int, ...],
+                 out_cap: int, join_type: str):
+    """Phase 2: expand candidate ranges to pairs, equality-check, compact; attach
+    outer rows. Returns (out_batch)."""
+    xp = jnp
+    counts, lo, order, pvalid, bvalid = _probe_counts(
+        probe, build, probe_key_ix, build_key_ix)
+    pvecs = batch_vecs(probe)
+    bvecs = batch_vecs(build)
+    pkeys = [pvecs[i] for i in probe_key_ix]
+    bkeys = [bvecs[i] for i in build_key_ix]
+    pmask = probe.row_mask()
+    pcap = probe.capacity
+    bcap = build.capacity
+
+    outer_left = join_type in ("left", "full")
+    # unmatched probe rows still emit one row in outer joins
+    slot_counts = xp.maximum(counts, 1) if outer_left else counts
+    slot_counts = xp.where(pmask, slot_counts, 0)
+    offsets = xp.cumsum(slot_counts)
+    total = offsets[-1] if pcap > 0 else xp.asarray(0, np.int32)
+    j = xp.arange(out_cap, dtype=np.int32)
+    live = j < total
+    # probe row for output slot j
+    pi = xp.searchsorted(offsets, j, side="right").astype(np.int32)
+    pi = xp.clip(pi, 0, pcap - 1)
+    base = xp.where(pi > 0, offsets[xp.maximum(pi - 1, 0)], 0)
+    k = j - base
+    has_match = counts[pi] > 0
+    bidx_sorted = xp.clip(lo[pi] + k, 0, bcap - 1)
+    bi = order[bidx_sorted]
+
+    # true equality check (hash collision + sentinel guard)
+    gp = gather_vecs(xp, pkeys, pi)
+    gb = gather_vecs(xp, bkeys, bi)
+    eq = _keys_equal(xp, gp, gb) & pvalid[pi] & bvalid[bi] & (k < counts[pi])
+    keep = live & (eq | (outer_left & ~has_match & (k == 0)))
+    matched = eq & live
+
+    # build matched flags for right/full outer (scatter-or: value False where not
+    # matched, so redirecting those slots is harmless)
+    bmatched = xp.zeros(bcap, dtype=bool)
+    if join_type in ("right", "full"):
+        bmatched = bmatched.at[xp.where(matched, bi, bcap - 1)].max(matched)
+
+    left_out = gather_vecs(xp, pvecs, pi)
+    right_out = gather_vecs(xp, bvecs, bi)
+    # null out the right side where no match (outer fill)
+    right_out = [Vec(v.dtype, v.data, v.validity & matched, v.lengths)
+                 for v in right_out] if join_type in ("left", "full") else right_out
+
+    if join_type in ("semi", "anti"):
+        # one output row per qualifying probe row
+        any_match = xp.zeros(pcap, dtype=bool)
+        any_match = any_match.at[xp.where(matched, pi, pcap - 1)].max(matched)
+        want = any_match if join_type == "semi" else (~any_match & pmask)
+        out_vecs, n = compact_vecs(xp, pvecs, want & pmask)
+        return out_vecs, n, bmatched
+
+    out_vecs = left_out + right_out
+    compacted, n = compact_vecs(xp, out_vecs, keep)
+    return compacted, n, bmatched
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _unmatched_build(build: ColumnarBatch, ncols_left: int, bmatched):
+    """full/right outer: build rows never matched -> rows with null left side."""
+    xp = jnp
+    bvecs = batch_vecs(build)
+    want = build.row_mask() & ~bmatched
+    compacted, n = compact_vecs(xp, bvecs, want)
+    return compacted, n
+
+
+class TpuShuffledHashJoinExec(TpuExec):
+    def __init__(self, left: TpuExec, right: TpuExec,
+                 left_keys: Sequence[Expression],
+                 right_keys: Sequence[Expression],
+                 join_type: str = "inner", conf=None):
+        super().__init__([left, right], conf)
+        self.join_type = join_type
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        lo, ro = left.output, right.output
+        if join_type in ("semi", "anti"):
+            self._schema = lo
+        else:
+            self._schema = Schema(lo.names + ro.names, lo.types + ro.types)
+        self.join_time = self.metrics.create(M.JOIN_TIME, M.ESSENTIAL)
+        self.build_time = self.metrics.create(M.BUILD_TIME, M.MODERATE)
+        # keys must be simple column refs after planning; planner projects
+        # complex keys into columns first (reference does the same)
+        self._lk_ix = tuple(self._key_ordinal(e, left.output)
+                            for e in self.left_keys)
+        self._rk_ix = tuple(self._key_ordinal(e, right.output)
+                            for e in self.right_keys)
+
+    @staticmethod
+    def _key_ordinal(e: Expression, schema: Schema) -> int:
+        from ..expr.base import AttributeReference, BoundReference
+        b = bind_references(e, schema)
+        if isinstance(b, BoundReference):
+            return b.ordinal
+        raise ValueError("join keys must be column references after planning")
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        with self.build_time.timed():
+            build_batches = list(self.children[1].execute())
+            if not build_batches and self.join_type in ("inner", "right", "semi"):
+                return
+            build = concat_batches(build_batches) if build_batches else None
+        stream = list(self.children[0].execute())
+        if not stream:
+            if build is not None and self.join_type in ("right", "full"):
+                yield self._right_only(build)
+            return
+        probe = concat_batches(stream)
+        if build is None:
+            from ..columnar.batch import empty_batch
+            build = empty_batch(self.children[1].output, 1)
+
+        with self.join_time.timed():
+            counts, lo, order, pvalid, bvalid = _probe_counts(
+                probe, build, self._lk_ix, self._rk_ix)
+            outer_left = self.join_type in ("left", "full")
+            slot = jnp.where(probe.row_mask(),
+                             jnp.maximum(counts, 1) if outer_left else counts, 0)
+            total = int(jnp.sum(slot))
+            if self.join_type in ("semi", "anti"):
+                out_cap = max(row_bucket(max(total, 1)), probe.capacity)
+            else:
+                out_cap = row_bucket(max(total, 1))
+            out_vecs, n, bmatched = _expand_join(
+                probe, build, self._lk_ix, self._rk_ix, out_cap, self.join_type)
+            out = vecs_to_batch(
+                self._schema if self.join_type not in ("semi", "anti")
+                else self._schema, out_vecs, n)
+        self.num_output_rows.add(out.row_count())
+        yield self._count_output(out)
+
+        if self.join_type in ("right", "full"):
+            extra = self._unmatched_batch(build, bmatched)
+            if extra is not None:
+                self.num_output_rows.add(extra.row_count())
+                yield self._count_output(extra)
+
+    def _unmatched_batch(self, build, bmatched):
+        rvecs, n = _unmatched_build(build, len(self.children[0].output.types),
+                                    bmatched)
+        if int(n) == 0:
+            return None
+        return self._null_left_batch(rvecs, n, build.capacity)
+
+    def _right_only(self, build: ColumnarBatch) -> ColumnarBatch:
+        rvecs = batch_vecs(build)
+        return self._null_left_batch(rvecs, build.num_rows, build.capacity)
+
+    def _null_left_batch(self, rvecs: List[Vec], n, cap: int) -> ColumnarBatch:
+        from ..columnar.batch import empty_batch
+        lschema = self.children[0].output
+        lvecs = []
+        for dt in lschema.types:
+            if isinstance(dt, T.StringType):
+                lvecs.append(Vec(dt, jnp.zeros((cap, 8), jnp.uint8),
+                                 jnp.zeros(cap, bool),
+                                 jnp.zeros(cap, jnp.int32)))
+            else:
+                lvecs.append(Vec(dt, jnp.zeros(cap, dt.np_dtype),
+                                 jnp.zeros(cap, bool)))
+        return vecs_to_batch(self._schema, lvecs + rvecs, n)
+
+    def _arg_string(self):
+        return f"[{self.join_type}, keys={[repr(e) for e in self.left_keys]}]"
+
+
+class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):
+    """Broadcast variant (reference GpuBroadcastHashJoinExecBase): identical device
+    join; the build child is a broadcast exchange that replicates the build table
+    (in-process in local mode; all_gather over the mesh in distributed mode)."""

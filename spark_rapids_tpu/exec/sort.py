@@ -1,0 +1,71 @@
+"""Sort exec (reference `GpuSortExec.scala:83`; out-of-core iterator `:239`).
+
+Round-1 modes: per-batch sort and single-batch (coalesce-then-sort) full sort.
+The out-of-core merge path (spillable pending set) follows once the spill catalog
+lands; its seam is `sort_single_batch` below, which is the in-core building block
+the reference's GpuOutOfCoreSortIterator also uses."""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar.batch import ColumnarBatch
+from ..expr.base import Expression, Vec, bind_references
+from ..ops.rowops import gather_vecs, lexsort_indices, sort_keys_for
+from ..utils import metrics as M
+from .base import TpuExec, UnaryTpuExec, batch_vecs, device_ctx, vecs_to_batch
+from .coalesce import concat_batches
+
+
+class TpuSortExec(UnaryTpuExec):
+    def __init__(self, orders: Sequence[Tuple[Expression, bool, bool]],
+                 child: TpuExec, conf=None, each_batch: bool = False):
+        """orders: (expr, ascending, nulls_first). each_batch: sort within each
+        batch only (reference sortEachBatch, used below windows)."""
+        super().__init__([child], conf)
+        self.orders = list(orders)
+        self.each_batch = each_batch
+        self._bound = [(bind_references(e, child.output), a, nf)
+                       for e, a, nf in self.orders]
+        self.sort_time = self.metrics.create(M.SORT_TIME, M.MODERATE)
+        bound = self._bound
+
+        @jax.jit
+        def kernel(batch: ColumnarBatch):
+            ctx = device_ctx(batch, self.conf)
+            vecs = batch_vecs(batch)
+            mask = batch.row_mask()
+            groups = [[(~mask).astype(np.int8)]]  # padding rows last
+            for e, asc, nf in bound:
+                groups.append(sort_keys_for(jnp, e.eval(ctx, vecs), asc, nf))
+            order = lexsort_indices(jnp, groups, batch.capacity)
+            out = gather_vecs(jnp, vecs, order)
+            return vecs_to_batch(batch.schema, out, batch.num_rows)
+
+        self._kernel = kernel
+
+    def sort_single_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
+        with self.sort_time.timed():
+            return self._kernel(batch)
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        if self.each_batch:
+            for b in self.child.execute():
+                out = self.sort_single_batch(b)
+                self.num_output_rows.add(out.row_count())
+                yield self._count_output(out)
+            return
+        batches = list(self.child.execute())
+        if not batches:
+            return
+        merged = concat_batches(batches)
+        out = self.sort_single_batch(merged)
+        self.num_output_rows.add(out.row_count())
+        yield self._count_output(out)
+
+    def _arg_string(self):
+        return f"[{[(repr(e), a, nf) for e, a, nf in self.orders]}]"

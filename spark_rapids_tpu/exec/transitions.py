@@ -1,0 +1,103 @@
+"""Host<->device transition operators (reference `GpuTransitionOverrides.scala`:
+GpuRowToColumnarExec / GpuColumnarToRowExec / HostColumnarToGpu placement `:50-120`).
+
+In this engine both sides are columnar, so the transitions are host-batch <-> device-
+batch bridges: `TpuFromCpuExec` lifts a CPU subtree's output onto the device (the
+HostColumnarToGpu analog); `CpuFromTpuExec` runs a device subtree and hands host
+batches to a CPU parent (the GpuColumnarToRowExec analog)."""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..columnar.batch import ColumnarBatch, Schema
+from ..columnar.column import Column
+from ..columnar.padding import row_bucket, width_bucket
+from ..cpu.hostbatch import HostBatch
+from ..expr.base import Vec
+from .base import TpuExec, batch_vecs
+
+
+def host_batch_to_device(hb: HostBatch) -> ColumnarBatch:
+    n = hb.num_rows
+    cap = row_bucket(n)
+    cols = []
+    for v in hb.vecs:
+        valid = np.zeros(cap, dtype=bool)
+        valid[:n] = v.validity
+        if v.is_string:
+            w = width_bucket(max(v.data.shape[1], 1))
+            data = np.zeros((cap, w), dtype=np.uint8)
+            data[:n, :v.data.shape[1]] = v.data
+            lens = np.zeros(cap, dtype=np.int32)
+            lens[:n] = v.lengths
+            cols.append(Column(v.dtype, jnp.asarray(data), jnp.asarray(valid),
+                               jnp.asarray(lens)))
+        else:
+            data = np.zeros(cap, dtype=v.data.dtype)
+            data[:n] = v.data
+            cols.append(Column(v.dtype, jnp.asarray(data), jnp.asarray(valid)))
+    return ColumnarBatch(hb.schema, tuple(cols), jnp.asarray(n, jnp.int32))
+
+
+def device_batch_to_host(b: ColumnarBatch) -> HostBatch:
+    n = b.row_count()
+    vecs = []
+    for c in b.columns:
+        valid = np.asarray(c.validity[:n])
+        if c.is_string:
+            vecs.append(Vec(c.dtype, np.asarray(c.data[:n]), valid,
+                            np.asarray(c.lengths[:n])))
+        else:
+            vecs.append(Vec(c.dtype, np.asarray(c.data[:n]), valid))
+    return HostBatch(b.schema, vecs, n)
+
+
+class TpuFromCpuExec(TpuExec):
+    """Device exec over a CPU subtree's output."""
+
+    def __init__(self, cpu_plan, conf=None):
+        super().__init__([], conf)
+        self.cpu_plan = cpu_plan
+
+    @property
+    def output(self) -> Schema:
+        return self.cpu_plan.output
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        for hb in self.cpu_plan.execute_cpu():
+            b = host_batch_to_device(hb)
+            self.num_output_rows.add(hb.num_rows)
+            yield self._count_output(b)
+
+    def tree_string(self, indent: int = 0) -> str:
+        return ("  " * indent + "TpuFromCpuExec\n"
+                + self.cpu_plan.tree_string(indent + 1))
+
+
+class CpuFromTpuExec:
+    """CPU plan node over a device subtree's output (duck-typed PhysicalPlan)."""
+
+    def __init__(self, tpu_exec: TpuExec):
+        self.tpu_exec = tpu_exec
+        self.children: List = []
+
+    @property
+    def output(self) -> Schema:
+        return self.tpu_exec.output
+
+    @property
+    def name(self) -> str:
+        return "CpuFromTpuExec"
+
+    def execute_cpu(self) -> Iterator[HostBatch]:
+        for b in self.tpu_exec.execute():
+            yield device_batch_to_host(b)
+
+    def tree_string(self, indent: int = 0) -> str:
+        return ("  " * indent + "CpuFromTpuExec\n"
+                + self.tpu_exec.tree_string(indent + 1))

@@ -18,6 +18,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, List, Optional, Sequence, Tuple
 
+import jax
 import numpy as np
 
 from .. import types as T
@@ -28,13 +29,27 @@ __all__ = ["Vec", "EvalContext", "Expression", "LeafExpression", "Literal",
            "all_valid", "and_validity"]
 
 
+@jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class Vec:
-    """Backend-generic column value: arrays are np.ndarray or jnp tracers."""
+    """Backend-generic column value: arrays are np.ndarray or jnp tracers.
+    Registered as a pytree so jitted kernels can take/return Vecs directly."""
     dtype: T.DataType
     data: Any
     validity: Any
     lengths: Any = None
+
+    def tree_flatten(self):
+        if self.lengths is None:
+            return (self.data, self.validity), (self.dtype, False)
+        return (self.data, self.validity, self.lengths), (self.dtype, True)
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        dtype, has_len = aux
+        if has_len:
+            return cls(dtype, leaves[0], leaves[1], leaves[2])
+        return cls(dtype, leaves[0], leaves[1], None)
 
     @property
     def is_string(self) -> bool:
@@ -115,8 +130,9 @@ class Expression:
     # --- tree utilities -------------------------------------------------------
     def transform_up(self, fn) -> "Expression":
         new_children = [c.transform_up(fn) for c in self.children]
-        node = self.with_children(new_children) if new_children != self.children \
-            else self
+        unchanged = len(new_children) == len(self.children) and \
+            all(a is b for a, b in zip(new_children, self.children))
+        node = self if unchanged else self.with_children(new_children)
         return fn(node)
 
     def with_children(self, children: Sequence["Expression"]) -> "Expression":
@@ -135,6 +151,118 @@ class Expression:
         if not self.children:
             return self.name
         return f"{self.name}({', '.join(map(repr, self.children))})"
+
+    # --- operator sugar for the DataFrame frontend ---------------------------
+    @staticmethod
+    def _wrap(v) -> "Expression":
+        return v if isinstance(v, Expression) else Literal(v)
+
+    def __add__(self, o):
+        from .arithmetic import Add
+        return Add(self, self._wrap(o))
+
+    def __sub__(self, o):
+        from .arithmetic import Subtract
+        return Subtract(self, self._wrap(o))
+
+    def __mul__(self, o):
+        from .arithmetic import Multiply
+        return Multiply(self, self._wrap(o))
+
+    def __truediv__(self, o):
+        from .arithmetic import Divide
+        return Divide(self, self._wrap(o))
+
+    def __mod__(self, o):
+        from .arithmetic import Remainder
+        return Remainder(self, self._wrap(o))
+
+    def __neg__(self):
+        from .arithmetic import UnaryMinus
+        return UnaryMinus(self)
+
+    def __eq__(self, o):  # type: ignore[override]
+        from .predicates import EqualTo
+        return EqualTo(self, self._wrap(o))
+
+    def __ne__(self, o):  # type: ignore[override]
+        from .predicates import EqualTo, Not
+        return Not(EqualTo(self, self._wrap(o)))
+
+    def __lt__(self, o):
+        from .predicates import LessThan
+        return LessThan(self, self._wrap(o))
+
+    def __le__(self, o):
+        from .predicates import LessThanOrEqual
+        return LessThanOrEqual(self, self._wrap(o))
+
+    def __gt__(self, o):
+        from .predicates import GreaterThan
+        return GreaterThan(self, self._wrap(o))
+
+    def __ge__(self, o):
+        from .predicates import GreaterThanOrEqual
+        return GreaterThanOrEqual(self, self._wrap(o))
+
+    def __and__(self, o):
+        from .predicates import And
+        return And(self, self._wrap(o))
+
+    def __or__(self, o):
+        from .predicates import Or
+        return Or(self, self._wrap(o))
+
+    def __invert__(self):
+        from .predicates import Not
+        return Not(self)
+
+    # literal-on-the-left forms (1 - col, 2 * col, ...)
+    def __radd__(self, o):
+        return self._wrap(o).__add__(self)
+
+    def __rsub__(self, o):
+        return self._wrap(o).__sub__(self)
+
+    def __rmul__(self, o):
+        return self._wrap(o).__mul__(self)
+
+    def __rtruediv__(self, o):
+        return self._wrap(o).__truediv__(self)
+
+    def __rmod__(self, o):
+        return self._wrap(o).__mod__(self)
+
+    def __rand__(self, o):
+        return self._wrap(o).__and__(self)
+
+    def __ror__(self, o):
+        return self._wrap(o).__or__(self)
+
+    def __bool__(self):
+        # `==` returns an Expression, so `and`/`or`/`in`/`if` over expressions
+        # would silently drop conditions; fail loudly (PySpark Column behavior)
+        raise ValueError(
+            "Cannot convert an Expression to a bool. Use '&' for AND, '|' for "
+            "OR, '~' for NOT when building conditions.")
+
+    def __hash__(self):
+        return id(self)
+
+    def alias(self, name: str) -> "Expression":
+        return Alias(self, name)
+
+    def cast(self, dt) -> "Expression":
+        from .cast import Cast
+        return Cast(self, dt)
+
+    def is_null(self):
+        from .nullexprs import IsNull
+        return IsNull(self)
+
+    def is_not_null(self):
+        from .nullexprs import IsNotNull
+        return IsNotNull(self)
 
 
 class LeafExpression(Expression):

@@ -48,18 +48,31 @@ class CaseWhen(Expression):
 
     def __init__(self, branches, else_expr=None):
         from .base import Literal
-        self.branches = list(branches)
+        branches = list(branches)
         if else_expr is None:
-            else_expr = Literal(None, self.branches[0][1].data_type)
+            else_expr = Literal(None, branches[0][1].data_type)
         flat = []
-        for c, v in self.branches:
+        for c, v in branches:
             flat += [c, v]
         flat.append(else_expr)
         super().__init__(flat)
 
     @property
+    def branches(self):
+        """(cond, value) pairs derived from children so rebinding via
+        with_children cannot leave stale copies."""
+        return [(self.children[2 * i], self.children[2 * i + 1])
+                for i in range((len(self.children) - 1) // 2)]
+
+    @property
+    def else_expr(self):
+        return self.children[-1]
+
+    @property
     def data_type(self):
-        return self.branches[0][1].data_type
+        # children layout: [c0, v0, c1, v1, ..., else]; use children (not
+        # self.branches) so rebinding via with_children stays consistent
+        return self.children[1].data_type
 
     @property
     def nullable(self):
@@ -68,8 +81,9 @@ class CaseWhen(Expression):
     def _compute(self, ctx: EvalContext, *vecs: Vec) -> Vec:
         xp = ctx.xp
         out = vecs[-1]  # else
+        nbranches = (len(self.children) - 1) // 2
         # fold right-to-left so earlier branches win
-        for i in range(len(self.branches) - 1, -1, -1):
+        for i in range(nbranches - 1, -1, -1):
             c, v = vecs[2 * i], vecs[2 * i + 1]
             cond = c.data & c.validity
             out = _select(xp, cond, v, out)

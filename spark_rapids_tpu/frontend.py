@@ -1,0 +1,98 @@
+"""DataFrame frontend — builds CPU physical plans (the Catalyst stand-in's user
+API). Thin by design: the interesting machinery is the plan rewrite underneath,
+exactly as in the reference where user code is ordinary Spark SQL."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+from .expr.aggregates import AggregateFunction
+from .expr.base import AttributeReference, Expression, output_name
+from .plan import nodes as N
+
+
+def _as_expr(e: Union[str, Expression]) -> Expression:
+    return AttributeReference(e) if isinstance(e, str) else e
+
+
+class GroupedData:
+    def __init__(self, df: "DataFrame", keys: Sequence[Expression]):
+        self.df = df
+        self.keys = [_as_expr(k) for k in keys]
+
+    def agg(self, **named_aggs: AggregateFunction) -> "DataFrame":
+        aggs = [N.AggExpr(f, name) for name, f in named_aggs.items()]
+        return DataFrame(self.df.session,
+                         N.CpuHashAggregateExec(self.keys, aggs, self.df.plan))
+
+
+class DataFrame:
+    def __init__(self, session, plan: N.PhysicalPlan):
+        self.session = session
+        self.plan = plan
+
+    @property
+    def schema(self):
+        return self.plan.output
+
+    def __getitem__(self, name: str) -> Expression:
+        i = self.plan.output.index_of(name)
+        return AttributeReference(name, self.plan.output.types[i])
+
+    def select(self, *exprs: Union[str, Expression]) -> "DataFrame":
+        return DataFrame(self.session,
+                         N.CpuProjectExec([_as_expr(e) for e in exprs],
+                                          self.plan))
+
+    def filter(self, condition: Expression) -> "DataFrame":
+        return DataFrame(self.session, N.CpuFilterExec(condition, self.plan))
+
+    where = filter
+
+    def group_by(self, *keys: Union[str, Expression]) -> GroupedData:
+        return GroupedData(self, [_as_expr(k) for k in keys])
+
+    def agg(self, **named_aggs: AggregateFunction) -> "DataFrame":
+        return GroupedData(self, []).agg(**named_aggs)
+
+    def join(self, other: "DataFrame", on: Union[str, Sequence[str]],
+             how: str = "inner") -> "DataFrame":
+        keys = [on] if isinstance(on, str) else list(on)
+        lk = [_as_expr(k) for k in keys]
+        rk = [_as_expr(k) for k in keys]
+        return DataFrame(self.session,
+                         N.CpuHashJoinExec(self.plan, other.plan, lk, rk, how))
+
+    def sort(self, *orders, ascending: bool = True,
+             nulls_first: Optional[bool] = None) -> "DataFrame":
+        specs = []
+        for o in orders:
+            if isinstance(o, tuple):
+                e, asc, nf = o
+                specs.append((_as_expr(e), asc, nf))
+            else:
+                nf = nulls_first if nulls_first is not None else ascending
+                specs.append((_as_expr(o), ascending, nf))
+        return DataFrame(self.session, N.CpuSortExec(specs, self.plan))
+
+    order_by = sort
+
+    def limit(self, n: int, offset: int = 0) -> "DataFrame":
+        return DataFrame(self.session, N.CpuLimitExec(n, self.plan, offset))
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(self.session, N.CpuUnionExec([self.plan, other.plan]))
+
+    def collect(self):
+        """Execute and return a pyarrow Table."""
+        return self.session.execute_plan(self.plan)
+
+    def collect_cpu(self):
+        """Execute on the CPU engine only (differential-testing helper)."""
+        return self.session.execute_plan(self.plan, use_device=False)
+
+    def explain(self) -> str:
+        return self.session.explain_plan(self.plan)
+
+    def __repr__(self):
+        return f"DataFrame({self.schema})\n{self.plan.tree_string()}"

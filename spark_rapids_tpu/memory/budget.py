@@ -1,0 +1,80 @@
+"""Device memory budget tracker — the allocator-side half of the OOM-retry design
+(reference: RMM alloc-failure callback -> `DeviceMemoryEventHandler.scala:38` spill
+loop; per-thread `RetryOOM`/`SplitAndRetryOOM` from RmmSpark JNI).
+
+XLA owns the real allocator, so instead of a failure callback this tracker does
+pre-flight accounting: operators `reserve()` their estimated working set before
+launching a kernel; when the budget would be exceeded the tracker first asks the
+spill framework to free tiers, then raises RetryOOM/SplitAndRetryOOM for the
+`with_retry` loop (memory/retry.py). Fault-injection counters implement
+spark.rapids.sql.test.injectRetryOOM (reference RapidsConf.scala:1250)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..config import TpuConf, get_default_conf
+from ..errors import RetryOOM, SplitAndRetryOOM
+
+
+class MemoryBudget:
+    _instance: Optional["MemoryBudget"] = None
+
+    def __init__(self, total: int, conf: TpuConf):
+        self.total = total
+        self.used = 0
+        self._lock = threading.Lock()
+        self._alloc_count = 0
+        self.inject_retry_at = conf.get("spark.rapids.sql.test.injectRetryOOM")
+        self.inject_split_at = conf.get(
+            "spark.rapids.sql.test.injectSplitAndRetryOOM")
+
+    @classmethod
+    def initialize(cls, total: int, conf: Optional[TpuConf] = None) -> None:
+        cls._instance = MemoryBudget(total, conf or get_default_conf())
+
+    @classmethod
+    def get(cls) -> "MemoryBudget":
+        if cls._instance is None:
+            cls.initialize(_unlimited := 1 << 62)
+        return cls._instance
+
+    # ------------------------------------------------------------------
+    def reserve(self, nbytes: int) -> None:
+        """Pre-flight reservation; raises RetryOOM / SplitAndRetryOOM under
+        pressure (after attempting synchronous spill)."""
+        with self._lock:
+            self._alloc_count += 1
+            n = self._alloc_count
+            if self.inject_retry_at and n == self.inject_retry_at:
+                raise RetryOOM("injected RetryOOM")
+            if self.inject_split_at and n == self.inject_split_at:
+                raise SplitAndRetryOOM("injected SplitAndRetryOOM")
+            if self.used + nbytes <= self.total:
+                self.used += nbytes
+                return
+        # pressure: try to spill synchronously, then re-check
+        from .catalog import BufferCatalog
+        freed = BufferCatalog.get().synchronous_spill(nbytes)
+        with self._lock:
+            if self.used + nbytes <= self.total:
+                self.used += nbytes
+                return
+            if freed > 0:
+                raise RetryOOM(
+                    f"device memory pressure: need {nbytes}, "
+                    f"used {self.used}/{self.total} (spilled {freed})")
+            raise SplitAndRetryOOM(
+                f"device memory exhausted: need {nbytes}, "
+                f"used {self.used}/{self.total}, nothing left to spill")
+
+    def release(self, nbytes: int) -> None:
+        with self._lock:
+            self.used = max(0, self.used - nbytes)
+
+    def reset_injection(self, retry_at: int = 0, split_at: int = 0) -> None:
+        with self._lock:
+            self._alloc_count = 0
+            self.inject_retry_at = retry_at
+            self.inject_split_at = split_at

@@ -1,0 +1,192 @@
+"""Tiered spill framework (reference `RapidsBufferCatalog.scala`: handle
+indirection `makeNewHandle` `:121`, `addBuffer` `:210`, `acquireBuffer` `:354`,
+`synchronousSpill` `:445`; stores `RapidsBufferStore.scala`,
+`Rapids{Device,Host,Disk}Store.scala`; priorities `SpillPriorities.scala`;
+StorageTier `RapidsBuffer.scala:53`).
+
+Tiers: DEVICE (jax arrays in HBM) -> HOST (numpy in RAM) -> DISK (npz files).
+Spilling a device buffer copies arrays to host and DROPS the device reference — XLA
+frees HBM when the last reference dies, so "spill" here is reference surgery plus
+budget release. Re-acquiring materializes back up the tiers and re-reserves
+budget."""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+from enum import IntEnum
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..columnar.batch import ColumnarBatch, Schema
+from ..columnar.column import Column
+from ..utils.metrics import TaskMetrics
+
+
+class StorageTier(IntEnum):
+    DEVICE = 0
+    HOST = 1
+    DISK = 2
+
+
+class SpillPriority:
+    ACTIVE_BATCH = 100          # being processed; spill last
+    ACTIVE_ON_DECK = 50
+    BUFFERED = 0                # shuffle/broadcast buffers
+    SPILL_FIRST = -100
+
+
+class _Entry:
+    __slots__ = ("handle", "tier", "device_batch", "host_arrays", "disk_path",
+                 "schema", "num_rows", "nbytes", "priority", "lock")
+
+    def __init__(self, handle: int, batch: ColumnarBatch, nbytes: int,
+                 priority: int):
+        self.handle = handle
+        self.tier = StorageTier.DEVICE
+        self.device_batch: Optional[ColumnarBatch] = batch
+        self.host_arrays: Optional[List] = None
+        self.disk_path: Optional[str] = None
+        self.schema = batch.schema
+        self.num_rows = batch.row_count()
+        self.nbytes = nbytes
+        self.priority = priority
+        self.lock = threading.Lock()
+
+
+class BufferCatalog:
+    _instance: Optional["BufferCatalog"] = None
+
+    def __init__(self, spill_dir: Optional[str] = None,
+                 host_limit: int = 1 << 30):
+        self._entries: Dict[int, _Entry] = {}
+        self._next_handle = 0
+        self._lock = threading.Lock()
+        self._spill_dir = spill_dir or tempfile.mkdtemp(prefix="srtpu_spill_")
+        self.host_limit = host_limit
+        self.host_used = 0
+
+    @classmethod
+    def get(cls) -> "BufferCatalog":
+        if cls._instance is None:
+            from ..config import get_default_conf
+            cls._instance = BufferCatalog(
+                host_limit=get_default_conf().get(
+                    "spark.rapids.memory.host.spillStorageSize"))
+        return cls._instance
+
+    # ------------------------------------------------------------------
+    def add_batch(self, batch: ColumnarBatch,
+                  priority: int = SpillPriority.BUFFERED) -> int:
+        nbytes = batch.device_memory_size()
+        with self._lock:
+            h = self._next_handle
+            self._next_handle += 1
+            self._entries[h] = _Entry(h, batch, nbytes, priority)
+        return h
+
+    def acquire_batch(self, handle: int) -> ColumnarBatch:
+        """Materialize back on device (unspilling through tiers if needed)."""
+        e = self._entries[handle]
+        with e.lock:
+            if e.tier == StorageTier.DEVICE:
+                return e.device_batch
+            t0 = time.monotonic_ns()
+            if e.tier == StorageTier.DISK:
+                self._disk_to_host(e)
+            batch = self._host_to_device(e)
+            TaskMetrics.get().read_spill_ns += time.monotonic_ns() - t0
+            e.device_batch = batch
+            e.host_arrays = None
+            e.tier = StorageTier.DEVICE
+            return batch
+
+    def remove(self, handle: int) -> None:
+        with self._lock:
+            e = self._entries.pop(handle, None)
+        if e is not None:
+            if e.disk_path and os.path.exists(e.disk_path):
+                os.unlink(e.disk_path)
+            if e.tier == StorageTier.HOST:
+                self.host_used -= e.nbytes
+
+    def tier_of(self, handle: int) -> StorageTier:
+        return self._entries[handle].tier
+
+    # ------------------------------------------------------------------
+    def synchronous_spill(self, need_bytes: int) -> int:
+        """Spill device buffers (lowest priority first) until need_bytes freed or
+        nothing left (DeviceMemoryEventHandler loop analog)."""
+        candidates = sorted(
+            [e for e in list(self._entries.values())
+             if e.tier == StorageTier.DEVICE],
+            key=lambda e: e.priority)
+        freed = 0
+        for e in candidates:
+            if freed >= need_bytes:
+                break
+            freed += self._spill_entry(e)
+        return freed
+
+    def _spill_entry(self, e: _Entry) -> int:
+        with e.lock:
+            if e.tier != StorageTier.DEVICE:
+                return 0
+            t0 = time.monotonic_ns()
+            batch = e.device_batch
+            arrays: List[Tuple] = []
+            for c in batch.columns:
+                arrays.append((np.asarray(c.data), np.asarray(c.validity),
+                               None if c.lengths is None
+                               else np.asarray(c.lengths)))
+            e.host_arrays = arrays
+            e.device_batch = None  # drop device refs -> XLA frees HBM
+            e.tier = StorageTier.HOST
+            self.host_used += e.nbytes
+            TaskMetrics.get().spill_to_host_ns += time.monotonic_ns() - t0
+            from .budget import MemoryBudget
+            MemoryBudget.get().release(e.nbytes)
+            if self.host_used > self.host_limit:
+                self._host_to_disk(e)
+            return e.nbytes
+
+    def _host_to_disk(self, e: _Entry) -> None:
+        t0 = time.monotonic_ns()
+        path = os.path.join(self._spill_dir, f"buf{e.handle}.npz")
+        payload = {}
+        for i, (data, valid, lens) in enumerate(e.host_arrays):
+            payload[f"d{i}"] = data
+            payload[f"v{i}"] = valid
+            if lens is not None:
+                payload[f"l{i}"] = lens
+        np.savez(path, **payload)
+        e.disk_path = path
+        e.host_arrays = None
+        e.tier = StorageTier.DISK
+        self.host_used -= e.nbytes
+        TaskMetrics.get().spill_to_disk_ns += time.monotonic_ns() - t0
+
+    def _disk_to_host(self, e: _Entry) -> None:
+        z = np.load(e.disk_path)
+        arrays = []
+        for i in range(len(e.schema.types)):
+            arrays.append((z[f"d{i}"], z[f"v{i}"],
+                           z[f"l{i}"] if f"l{i}" in z else None))
+        e.host_arrays = arrays
+        e.tier = StorageTier.HOST
+        os.unlink(e.disk_path)
+        e.disk_path = None
+
+    def _host_to_device(self, e: _Entry) -> ColumnarBatch:
+        import jax.numpy as jnp
+        from .budget import MemoryBudget
+        MemoryBudget.get().reserve(e.nbytes)
+        cols = []
+        for dt, (data, valid, lens) in zip(e.schema.types, e.host_arrays):
+            cols.append(Column(dt, jnp.asarray(data), jnp.asarray(valid),
+                               None if lens is None else jnp.asarray(lens)))
+        return ColumnarBatch(e.schema, tuple(cols),
+                             jnp.asarray(e.num_rows, dtype=jnp.int32))

@@ -1,0 +1,75 @@
+"""Device manager (reference `GpuDeviceManager.scala`: initializeGpuAndMemory
+`:128`, pool sizing `computeRmmPoolSize` `:192`, rmm init `:247-343`).
+
+Binds the TPU device, computes the HBM budget for columnar data (fraction of the
+chip's HBM minus reserve, like the RMM pool sizing), and owns process-wide
+singletons: the memory budget tracker and the admission semaphore. XLA owns the
+actual allocator; our budget tracker does pre-flight accounting so memory pressure
+raises host-side RetryOOM before kernels launch (ARCHITECTURE.md #6)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..config import TpuConf, get_default_conf
+
+_DEFAULT_HBM = 16 << 30  # v5e has 16 GiB/chip; used when the backend won't say
+
+
+class DeviceManager:
+    _lock = threading.Lock()
+    _initialized = False
+    device = None
+    hbm_total = 0
+    budget_bytes = 0
+
+    @classmethod
+    def initialize(cls, conf: Optional[TpuConf] = None) -> None:
+        with cls._lock:
+            if cls._initialized:
+                return
+            conf = conf or get_default_conf()
+            import jax
+            devices = jax.devices()
+            ordinal = conf.get("spark.rapids.tpu.device.ordinal")
+            cls.device = devices[ordinal if ordinal >= 0 else 0]
+            cls.hbm_total = cls._query_hbm(cls.device)
+            frac = conf.get("spark.rapids.memory.gpu.allocFraction")
+            max_frac = conf.get("spark.rapids.memory.gpu.maxAllocFraction")
+            min_frac = conf.get("spark.rapids.memory.gpu.minAllocFraction")
+            reserve = conf.get("spark.rapids.memory.gpu.reserve")
+            frac = min(frac, max_frac)
+            budget = int(cls.hbm_total * frac) - reserve
+            if budget < int(cls.hbm_total * min_frac):
+                raise RuntimeError(
+                    f"HBM budget {budget} below minAllocFraction "
+                    f"({min_frac} of {cls.hbm_total}); adjust "
+                    "spark.rapids.memory.gpu.* settings")
+            cls.budget_bytes = budget
+            from .budget import MemoryBudget
+            MemoryBudget.initialize(budget, conf)
+            from .semaphore import TpuSemaphore
+            TpuSemaphore.initialize(conf.concurrent_tpu_tasks)
+            cls._initialized = True
+
+    @staticmethod
+    def _query_hbm(device) -> int:
+        # memory_stats() can HANG (not raise) on the axon tunnel backend —
+        # measured 2026-07; only query it on backends known to answer.
+        platform = getattr(device, "platform", "")
+        if platform not in ("cpu", "gpu", "tpu"):
+            return _DEFAULT_HBM
+        try:
+            stats = device.memory_stats()
+            if stats and "bytes_limit" in stats:
+                return int(stats["bytes_limit"])
+        except Exception:
+            pass
+        return _DEFAULT_HBM
+
+    @classmethod
+    def shutdown(cls) -> None:
+        with cls._lock:
+            cls._initialized = False
+            cls.device = None

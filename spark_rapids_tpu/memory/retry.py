@@ -1,0 +1,74 @@
+"""OOM retry framework (reference `RmmRapidsRetryIterator.scala:28-120`:
+withRetry / withRetryNoSplit; `CheckpointRestore` `:614`).
+
+`with_retry(input, fn, split_fn)`: run the idempotent `fn`; on RetryOOM, wait for
+memory pressure to clear (the budget tracker already attempted synchronous spill)
+and re-run; on SplitAndRetryOOM, split the input in half and process both halves —
+the engine's memory-pressure elasticity, identical control flow to the reference."""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Iterator, List, TypeVar
+
+from ..errors import RetryOOM, SplitAndRetryOOM
+from ..utils.metrics import TaskMetrics
+
+A = TypeVar("A")
+R = TypeVar("R")
+
+MAX_RETRIES = 8
+
+
+def split_batch_halves(spillable):
+    """Default splitter for SpillableColumnarBatch inputs: two halves."""
+    from ..exec.base import batch_vecs, vecs_to_batch
+    from ..expr.base import Vec
+    from .spillable import SpillableColumnarBatch
+    batch = spillable.get_batch()
+    n = batch.row_count()
+    if n < 2:
+        raise SplitAndRetryOOM("cannot split a batch with < 2 rows")
+    half = n // 2
+    outs = []
+    for lo, hi in ((0, half), (half, n)):
+        vecs = []
+        for v in batch_vecs(batch):
+            vecs.append(Vec(v.dtype, v.data[lo:hi], v.validity[lo:hi],
+                            None if v.lengths is None else v.lengths[lo:hi]))
+        outs.append(SpillableColumnarBatch(
+            vecs_to_batch(batch.schema, vecs, hi - lo)))
+    spillable.close()
+    return outs
+
+
+def with_retry(value: A, fn: Callable[[A], R],
+               split_fn: Callable[[A], List[A]] = None) -> Iterator[R]:
+    """Yield fn(x) for x in the (possibly split) inputs."""
+    pending: List[A] = [value]
+    while pending:
+        x = pending.pop(0)
+        attempts = 0
+        while True:
+            try:
+                yield fn(x)
+                break
+            except RetryOOM:
+                attempts += 1
+                TaskMetrics.get().retry_count += 1
+                if attempts > MAX_RETRIES:
+                    raise
+                t0 = time.monotonic_ns()
+                time.sleep(min(0.001 * (2 ** attempts), 0.25))
+                TaskMetrics.get().retry_block_ns += time.monotonic_ns() - t0
+            except SplitAndRetryOOM:
+                TaskMetrics.get().split_retry_count += 1
+                if split_fn is None:
+                    raise
+                halves = split_fn(x)
+                pending = halves + pending
+                break
+
+
+def with_retry_no_split(value: A, fn: Callable[[A], R]) -> R:
+    return next(with_retry(value, fn))

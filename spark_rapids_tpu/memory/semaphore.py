@@ -1,0 +1,55 @@
+"""Admission semaphore (reference `GpuSemaphore.scala`: acquireIfNecessary `:67,125`,
+completeTask `:173`).
+
+Limits how many tasks may have live device batches simultaneously
+(spark.rapids.sql.concurrentGpuTasks). Same role as the reference; per-thread
+reentrancy so an operator chain acquires once per task."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ..utils.metrics import TaskMetrics
+
+
+class TpuSemaphore:
+    _instance: Optional["TpuSemaphore"] = None
+
+    def __init__(self, permits: int):
+        self.permits = permits
+        self._sem = threading.BoundedSemaphore(permits)
+        self._held = threading.local()
+
+    @classmethod
+    def initialize(cls, permits: int) -> None:
+        if cls._instance is None or cls._instance.permits != permits:
+            cls._instance = TpuSemaphore(permits)
+
+    @classmethod
+    def get(cls) -> "TpuSemaphore":
+        if cls._instance is None:
+            cls.initialize(2)
+        return cls._instance
+
+    def acquire_if_necessary(self) -> None:
+        if getattr(self._held, "count", 0) > 0:
+            self._held.count += 1
+            return
+        t0 = time.monotonic_ns()
+        self._sem.acquire()
+        TaskMetrics.get().semaphore_wait_ns += time.monotonic_ns() - t0
+        self._held.count = 1
+
+    def release_if_held(self) -> None:
+        count = getattr(self._held, "count", 0)
+        if count > 1:
+            self._held.count -= 1
+        elif count == 1:
+            self._held.count = 0
+            self._sem.release()
+
+    def complete_task(self) -> None:
+        while getattr(self._held, "count", 0) > 0:
+            self.release_if_held()

@@ -1,0 +1,43 @@
+"""SpillableColumnarBatch (reference `SpillableColumnarBatch.scala:28,64,110`):
+wraps a batch in a catalog handle so it can spill while not actively in use;
+materialization re-acquires the semaphore."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..columnar.batch import ColumnarBatch
+from .catalog import BufferCatalog, SpillPriority
+from .semaphore import TpuSemaphore
+
+
+class SpillableColumnarBatch:
+    def __init__(self, batch: ColumnarBatch,
+                 priority: int = SpillPriority.ACTIVE_ON_DECK):
+        self._catalog = BufferCatalog.get()
+        self._handle: Optional[int] = self._catalog.add_batch(batch, priority)
+        self.num_rows = batch.row_count()
+        self.size_bytes = batch.device_memory_size()
+
+    def get_batch(self) -> ColumnarBatch:
+        if self._handle is None:
+            raise ValueError("spillable batch already closed")
+        TpuSemaphore.get().acquire_if_necessary()
+        return self._catalog.acquire_batch(self._handle)
+
+    @property
+    def spilled(self) -> bool:
+        from .catalog import StorageTier
+        return self._handle is not None and \
+            self._catalog.tier_of(self._handle) != StorageTier.DEVICE
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._catalog.remove(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

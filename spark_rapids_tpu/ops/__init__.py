@@ -1,0 +1,3 @@
+from .rowops import (gather_vecs, compact_vecs, sort_batch_vecs,  # noqa: F401
+                     sort_keys_for, lexsort_indices, group_ids_from_sorted,
+                     segment_reduce)

@@ -1,0 +1,166 @@
+"""Row-level device kernels shared by exec operators: stable compaction, gather,
+multi-key sorting, segmented reduction.
+
+These are the TPU counterparts of libcudf's gather/scatter/sort/groupby kernels (the
+reference's L0, consumed via `ai.rapids.cudf.Table` JNI). All are xp-generic where
+practical so the CPU engine shares semantics; the sort/segment ops use jax-specific
+primitives (lexsort/segment_sum) with numpy equivalents behind the same signature.
+
+Design notes (ARCHITECTURE.md #4):
+  * compaction keeps the padded capacity and returns a new logical count — a stable
+    argsort on the keep-mask, which XLA lowers to a single sort+gather;
+  * multi-key sort builds a key list per SortOrder (null indicator + transformed
+    data) and lexsorts; descending integer keys use bitwise-not (no INT_MIN
+    overflow), descending floats negate, strings contribute their byte columns;
+  * grouping = sort by keys + boundary detection + segment_{sum,min,max} with the
+    static capacity as num_segments.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import types as T
+from ..expr.base import Vec
+
+BIG_I32 = np.int32(2 ** 31 - 1)
+
+
+def _take(xp, arr, idx):
+    if arr.ndim == 1:
+        return arr[idx]
+    return arr[idx, :]
+
+
+def gather_vecs(xp, vecs: Sequence[Vec], idx) -> List[Vec]:
+    """Gather rows by index across columns (JoinGatherer analog)."""
+    out = []
+    for v in vecs:
+        out.append(Vec(v.dtype, _take(xp, v.data, idx), v.validity[idx],
+                       None if v.lengths is None else v.lengths[idx]))
+    return out
+
+
+def compact_vecs(xp, vecs: Sequence[Vec], keep_mask) -> Tuple[List[Vec], any]:
+    """Stable-move rows where keep_mask (bool[cap]) to the front; returns
+    (columns, new_count). Padding tail contents are unspecified."""
+    order = xp.argsort(~keep_mask, stable=True)
+    new_count = xp.sum(keep_mask).astype(np.int32)
+    return gather_vecs(xp, vecs, order), new_count
+
+
+def sort_keys_for(xp, v: Vec, ascending: bool, nulls_first: bool) -> List:
+    """Build lexsort key arrays for one SortOrder over a column, MOST-significant
+    first: [null-position, (nan-position), value keys...]."""
+    dt = v.dtype
+    null_key = (~v.validity if nulls_first else v.validity).astype(np.int8)
+    keys: List = [null_key]
+    if v.is_string:
+        lens = v.lengths.astype(np.int32)
+        if ascending:
+            keys.extend(v.data[:, b] for b in range(v.data.shape[1]))
+            keys.append(lens)  # trailing-NUL tiebreak (cf. string_compare)
+        else:
+            keys.extend(np.uint8(255) - v.data[:, b]
+                        for b in range(v.data.shape[1]))
+            keys.append(~lens)
+    elif T.is_floating(dt):
+        nan = xp.isnan(v.data)
+        zero = dt.np_dtype.type(0)
+        if ascending:
+            keys.append(nan.astype(np.int8))     # NaN sorts greatest
+            keys.append(xp.where(nan, zero, v.data))
+        else:
+            keys.append((~nan).astype(np.int8))  # NaN first when descending
+            keys.append(xp.where(nan, zero, -v.data))
+    else:
+        data = v.data
+        if isinstance(dt, T.BooleanType):
+            data = data.astype(np.int8)
+        keys.append(data if ascending else ~data)
+    return keys
+
+
+def lexsort_indices(xp, key_groups: Sequence[List], cap: int):
+    """keys given MOST-significant first; returns stable sort permutation."""
+    flat: List = []
+    for grp in key_groups:
+        flat.extend(grp)
+    if xp is np:
+        return np.lexsort(tuple(flat[::-1]))
+    import jax.numpy as jnp
+    return jnp.lexsort(tuple(flat[::-1]))
+
+
+def sort_batch_vecs(xp, vecs: Sequence[Vec], sort_cols: Sequence[int],
+                    ascending: Sequence[bool], nulls_first: Sequence[bool],
+                    row_mask) -> List[Vec]:
+    """Sort all columns by the given sort orders; padding rows sort last."""
+    groups = [[(~row_mask).astype(np.int8)]]  # padding after everything
+    for ci, asc, nf in zip(sort_cols, ascending, nulls_first):
+        groups.append(sort_keys_for(xp, vecs[ci], asc, nf))
+    order = lexsort_indices(xp, groups, row_mask.shape[0])
+    return gather_vecs(xp, vecs, order)
+
+
+def group_ids_from_sorted(xp, key_vecs: Sequence[Vec], row_mask):
+    """After sorting by keys, compute (group_id[cap], num_groups, starts_mask).
+    Padding rows get group_id == cap-1 sentinel region handled by callers via
+    row_mask."""
+    n = row_mask.shape[0]
+    change = xp.zeros(n, dtype=bool)
+    for v in key_vecs:
+        if v.is_string:
+            d = v.data
+            neq = xp.any(d[1:] != d[:-1], axis=1) | (v.lengths[1:] != v.lengths[:-1])
+        else:
+            neq = v.data[1:] != v.data[:-1]
+        neq = neq | (v.validity[1:] != v.validity[:-1])
+        change = change | xp.concatenate(
+            [xp.zeros(1, dtype=bool), neq])
+    starts = change | (xp.arange(n) == 0)
+    starts = starts & row_mask
+    # rows beyond the live region belong to no group
+    gid = xp.cumsum(starts.astype(np.int32)) - 1
+    gid = xp.where(row_mask, gid, n - 1)
+    num_groups = xp.sum(starts).astype(np.int32)
+    return gid, num_groups, starts
+
+
+def segment_reduce(xp, op: str, data, gid, cap: int, valid=None):
+    """Segmented reduction over rows with group ids. Invalid rows are excluded
+    (null-skipping aggregate semantics). Returns per-group array of length cap."""
+    import jax
+    if valid is None:
+        valid = xp.ones(data.shape[0], dtype=bool)
+    if op == "count":
+        ones = valid.astype(np.int64)
+        return jax.ops.segment_sum(ones, gid, num_segments=cap) if xp is not np \
+            else np.bincount(gid, weights=ones, minlength=cap).astype(np.int64)
+    if op == "sum":
+        contrib = xp.where(valid, data, data.dtype.type(0))
+        if xp is np:
+            out = np.zeros(cap, dtype=data.dtype)
+            np.add.at(out, gid, contrib)
+            return out
+        return jax.ops.segment_sum(contrib, gid, num_segments=cap)
+    if op in ("min", "max"):
+        if np.issubdtype(data.dtype, np.floating):
+            neutral = data.dtype.type(np.inf if op == "min" else -np.inf)
+        else:
+            info = np.iinfo(data.dtype) if data.dtype != np.bool_ else None
+            if info is None:
+                neutral = np.bool_(True) if op == "min" else np.bool_(False)
+            else:
+                neutral = data.dtype.type(info.max if op == "min" else info.min)
+        contrib = xp.where(valid, data, neutral)
+        if xp is np:
+            out = np.full(cap, neutral, dtype=data.dtype)
+            fn = np.minimum if op == "min" else np.maximum
+            getattr(fn, "at")(out, gid, contrib)
+            return out
+        seg = jax.ops.segment_min if op == "min" else jax.ops.segment_max
+        return seg(contrib, gid, num_segments=cap)
+    raise ValueError(f"unknown segmented op {op}")

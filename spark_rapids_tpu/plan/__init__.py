@@ -1,0 +1,3 @@
+from . import nodes  # noqa: F401
+from .typesig import TypeSig  # noqa: F401
+from .overrides import Overrides  # noqa: F401

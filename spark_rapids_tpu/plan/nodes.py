@@ -1,0 +1,570 @@
+"""CPU physical plan — the host engine this framework accelerates.
+
+In the reference, Spark Catalyst produces a CPU physical plan and the plugin's
+`GpuOverrides` rewrites it (`GpuOverrides.scala:4235-4266`). pyspark is absent in this
+environment, so this module is the Catalyst stand-in: a physical plan node tree with a
+CPU interpreter carrying Spark execution semantics. `plan/overrides.py` treats these
+nodes exactly as the reference treats `SparkPlan` nodes — wrap, tag, convert to
+`exec/` TPU operators, or leave on CPU (fallback).
+
+The CPU interpreter deliberately uses DIFFERENT algorithms from the TPU engine
+(dict/unique-based grouping and joins vs. the device's sort-segmented kernels) so the
+differential harness has an independent oracle, like CPU Spark is for the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import types as T
+from ..columnar.batch import Schema
+from ..cpu.hostbatch import HostBatch
+from ..expr.base import (Alias, AttributeReference, BoundReference, EvalContext,
+                         Expression, Vec, bind_references, output_name)
+from ..expr.aggregates import AggregateFunction, Average, Count
+
+
+class PhysicalPlan:
+    """Base CPU plan node."""
+
+    def __init__(self, children: Sequence["PhysicalPlan"]):
+        self.children = list(children)
+
+    @property
+    def output(self) -> Schema:
+        raise NotImplementedError
+
+    def execute_cpu(self) -> Iterator[HostBatch]:
+        raise NotImplementedError
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def tree_string(self, indent: int = 0) -> str:
+        s = "  " * indent + f"{self.name}{self._arg_string()}\n"
+        for c in self.children:
+            s += c.tree_string(indent + 1)
+        return s
+
+    def _arg_string(self) -> str:
+        return ""
+
+
+def _ctx(n: int) -> EvalContext:
+    return EvalContext(np, row_mask=np.ones(n, dtype=bool))
+
+
+def _concat_host(batches: List[HostBatch], schema: Schema) -> HostBatch:
+    """Concatenate host batches (CPU engine collects whole partitions)."""
+    if len(batches) == 1:
+        return batches[0]
+    if not batches:
+        return HostBatch(schema, [_empty_vec(t) for t in schema.types], 0)
+    vecs = []
+    for i, dt in enumerate(schema.types):
+        cols = [b.vecs[i] for b in batches]
+        if isinstance(dt, T.StringType):
+            w = max(c.data.shape[1] for c in cols)
+            data = np.concatenate(
+                [np.pad(c.data, ((0, 0), (0, w - c.data.shape[1])))
+                 for c in cols])
+            vecs.append(Vec(dt, data, np.concatenate([c.validity for c in cols]),
+                            np.concatenate([c.lengths for c in cols])))
+        else:
+            vecs.append(Vec(dt, np.concatenate([c.data for c in cols]),
+                            np.concatenate([c.validity for c in cols])))
+    return HostBatch(schema, vecs, sum(b.num_rows for b in batches))
+
+
+def _empty_vec(dt: T.DataType) -> Vec:
+    if isinstance(dt, T.StringType):
+        return Vec(dt, np.zeros((0, 8), np.uint8), np.zeros(0, bool),
+                   np.zeros(0, np.int32))
+    return Vec(dt, np.zeros(0, dt.np_dtype or np.int32), np.zeros(0, bool))
+
+
+class CpuScanExec(PhysicalPlan):
+    """In-memory Arrow table scan (file scans live in io/ and produce this shape)."""
+
+    def __init__(self, table, label: str = "memory"):
+        super().__init__([])
+        self.table = table
+        self.label = label
+        self._schema = Schema.from_arrow(table.schema)
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def execute_cpu(self):
+        from ..cpu.hostbatch import host_batch_from_arrow
+        yield host_batch_from_arrow(self.table)
+
+    def _arg_string(self):
+        return f"[{self.label}, {self.table.num_rows} rows]"
+
+
+class CpuProjectExec(PhysicalPlan):
+    def __init__(self, exprs: Sequence[Expression], child: PhysicalPlan):
+        super().__init__([child])
+        self.exprs = list(exprs)
+        self._bound = [bind_references(e, child.output) for e in self.exprs]
+        names = tuple(output_name(e, f"col{i}") for i, e in enumerate(self.exprs))
+        self._schema = Schema(names, tuple(e.data_type for e in self._bound))
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def execute_cpu(self):
+        for b in self.children[0].execute_cpu():
+            ctx = _ctx(b.num_rows)
+            vecs = [e.eval(ctx, b.vecs) for e in self._bound]
+            yield HostBatch(self._schema, vecs, b.num_rows)
+
+    def _arg_string(self):
+        return f"[{', '.join(map(repr, self.exprs))}]"
+
+
+class CpuFilterExec(PhysicalPlan):
+    def __init__(self, condition: Expression, child: PhysicalPlan):
+        super().__init__([child])
+        self.condition = condition
+        self._bound = bind_references(condition, child.output)
+
+    @property
+    def output(self) -> Schema:
+        return self.children[0].output
+
+    def execute_cpu(self):
+        for b in self.children[0].execute_cpu():
+            ctx = _ctx(b.num_rows)
+            pred = self._bound.eval(ctx, b.vecs)
+            keep = pred.data & pred.validity
+            vecs = [Vec(v.dtype, v.data[keep], v.validity[keep],
+                        None if v.lengths is None else v.lengths[keep])
+                    for v in b.vecs]
+            yield HostBatch(self.output, vecs, int(keep.sum()))
+
+    def _arg_string(self):
+        return f"[{self.condition!r}]"
+
+
+@dataclasses.dataclass
+class AggExpr:
+    func: AggregateFunction
+    name: str
+
+
+class CpuHashAggregateExec(PhysicalPlan):
+    """Dict-based grouping (np.unique over packed key rows) — intentionally a
+    different algorithm from the device's sort-segmented reduction."""
+
+    def __init__(self, group_exprs: Sequence[Expression],
+                 aggs: Sequence[AggExpr], child: PhysicalPlan):
+        super().__init__([child])
+        self.group_exprs = list(group_exprs)
+        self.aggs = list(aggs)
+        self._bound_groups = [bind_references(e, child.output)
+                              for e in self.group_exprs]
+        self._bound_aggs = []
+        for a in self.aggs:
+            f = a.func
+            if f.child is not None:
+                f = f.with_children([bind_references(f.child, child.output)])
+            self._bound_aggs.append(AggExpr(f, a.name))
+        names = tuple([output_name(e, f"k{i}")
+                       for i, e in enumerate(self.group_exprs)] +
+                      [a.name for a in self.aggs])
+        tps = tuple([e.data_type for e in self._bound_groups] +
+                    [a.func.data_type for a in self._bound_aggs])
+        self._schema = Schema(names, tps)
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def execute_cpu(self):
+        child_batches = list(self.children[0].execute_cpu())
+        b = _concat_host(child_batches, self.children[0].output)
+        n = b.num_rows
+        ctx = _ctx(n)
+        keys = [e.eval(ctx, b.vecs) for e in self._bound_groups]
+        gid, groups_index = _cpu_group_ids(keys, n)
+        ng = len(groups_index)
+        out_vecs: List[Vec] = []
+        for k in keys:
+            out_vecs.append(Vec(k.dtype, _take_np(k.data, groups_index),
+                                k.validity[groups_index],
+                                None if k.lengths is None
+                                else k.lengths[groups_index]))
+        for a in self._bound_aggs:
+            out_vecs.append(_cpu_agg(a.func, ctx, b, gid, ng))
+        yield HostBatch(self._schema, out_vecs, ng)
+
+    def _arg_string(self):
+        return (f"[keys={[repr(e) for e in self.group_exprs]}, "
+                f"aggs={[a.name for a in self.aggs]}]")
+
+
+def _take_np(arr, idx):
+    return arr[idx] if arr.ndim == 1 else arr[idx, :]
+
+
+def _key_bytes(keys: List[Vec], n: int) -> np.ndarray:
+    """Pack key columns into fixed-width row bytes for np.unique grouping."""
+    parts = []
+    for k in keys:
+        parts.append(k.validity.astype(np.uint8).reshape(n, 1))
+        if k.is_string:
+            parts.append(np.where(k.validity[:, None], k.data, 0))
+            parts.append(k.lengths.astype(np.int32).view(np.uint8).reshape(n, -1))
+        else:
+            data = k.data
+            if np.issubdtype(data.dtype, np.floating):
+                # canonicalize NaN and -0.0 so grouping matches Spark equality
+                data = np.where(np.isnan(data), np.float64(np.nan), data)
+                data = np.where(data == 0.0, 0.0, data).astype(k.data.dtype)
+            clean = np.where(k.validity, data, data.dtype.type(0))
+            parts.append(np.ascontiguousarray(clean).view(np.uint8)
+                         .reshape(n, -1))
+    return np.concatenate(parts, axis=1) if parts else np.zeros((n, 1), np.uint8)
+
+
+def _cpu_group_ids(keys: List[Vec], n: int):
+    if not keys:
+        return np.zeros(n, dtype=np.int64), np.zeros(1 if n >= 0 else 0,
+                                                     dtype=np.int64)[:1]
+    rows = _key_bytes(keys, n)
+    packed = rows.view([("", rows.dtype)] * rows.shape[1]).ravel()
+    _, first_idx, inv = np.unique(packed, return_index=True, return_inverse=True)
+    # renumber groups by first appearance to keep deterministic order
+    order = np.argsort(first_idx, kind="stable")
+    remap = np.empty_like(order)
+    remap[order] = np.arange(len(order))
+    gid = remap[inv]
+    return gid, first_idx[order]
+
+
+def _cpu_agg(func: AggregateFunction, ctx, b: HostBatch, gid, ng) -> Vec:
+    n = b.num_rows
+    if func.child is None:  # count(*)
+        data = np.bincount(gid, minlength=ng).astype(np.int64)
+        return Vec(T.LONG, data, np.ones(ng, dtype=bool))
+    v = func.child.eval(ctx, b.vecs)
+    out_t = func.data_type
+    if isinstance(func, Count):
+        data = np.bincount(gid, weights=v.validity.astype(np.float64),
+                           minlength=ng).astype(np.int64)
+        return Vec(T.LONG, data, np.ones(ng, dtype=bool))
+    valid_any = np.zeros(ng, dtype=bool)
+    np.logical_or.at(valid_any, gid, v.validity)
+    name = type(func).__name__
+    if name in ("Sum", "Average"):
+        acc_t = np.float64 if T.is_floating(v.dtype) or name == "Average" \
+            else np.int64
+        contrib = np.where(v.validity, v.data, 0).astype(acc_t)
+        s = np.zeros(ng, dtype=acc_t)
+        np.add.at(s, gid, contrib)
+        if name == "Sum":
+            return Vec(out_t, s.astype(out_t.np_dtype), valid_any)
+        cnt = np.bincount(gid, weights=v.validity.astype(np.float64),
+                          minlength=ng)
+        avg = np.divide(s, np.maximum(cnt, 1))
+        return Vec(out_t, avg.astype(out_t.np_dtype), valid_any)
+    if name in ("Min", "Max"):
+        if v.is_string:
+            # simple per-group loop (CPU oracle; strings rarely huge here)
+            out_data = np.zeros((ng, v.data.shape[1]), np.uint8)
+            out_len = np.zeros(ng, np.int32)
+            seen = np.zeros(ng, dtype=bool)
+            for i in range(n):
+                if not v.validity[i]:
+                    continue
+                g = gid[i]
+                s_bytes = bytes(v.data[i, :v.lengths[i]])
+                if not seen[g]:
+                    best = s_bytes
+                else:
+                    cur = bytes(out_data[g, :out_len[g]])
+                    best = (min if name == "Min" else max)(cur, s_bytes)
+                out_data[g, :] = 0
+                out_data[g, :len(best)] = np.frombuffer(best, np.uint8)
+                out_len[g] = len(best)
+                seen[g] = True
+            return Vec(v.dtype, out_data, seen, out_len)
+        if np.issubdtype(v.data.dtype, np.floating):
+            neutral = v.data.dtype.type(np.inf if name == "Min" else -np.inf)
+        elif v.data.dtype == np.bool_:
+            neutral = np.bool_(name == "Min")
+        else:
+            info = np.iinfo(v.data.dtype)
+            neutral = v.data.dtype.type(info.max if name == "Min" else info.min)
+        contrib = np.where(v.validity, v.data, neutral)
+        out = np.full(ng, neutral, dtype=v.data.dtype)
+        (np.minimum if name == "Min" else np.maximum).at(out, gid, contrib)
+        return Vec(v.dtype, out, valid_any)
+    if name in ("First", "Last"):
+        idx = np.arange(n)
+        sel = np.where(v.validity if func.ignore_nulls else np.ones(n, bool),
+                       idx, -1)
+        out_idx = np.full(ng, -1, dtype=np.int64)
+        if name == "First":
+            for i in range(n - 1, -1, -1):
+                if sel[i] >= 0:
+                    out_idx[gid[i]] = sel[i]
+        else:
+            for i in range(n):
+                if sel[i] >= 0:
+                    out_idx[gid[i]] = sel[i]
+        got = out_idx >= 0
+        safe = np.where(got, out_idx, 0)
+        return Vec(v.dtype, _take_np(v.data, safe),
+                   v.validity[safe] & got,
+                   None if v.lengths is None else v.lengths[safe])
+    raise NotImplementedError(name)
+
+
+class CpuHashJoinExec(PhysicalPlan):
+    """CPU oracle join: pandas merge on key frames (independent of device path)."""
+
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan,
+                 left_keys: Sequence[Expression], right_keys: Sequence[Expression],
+                 join_type: str = "inner"):
+        super().__init__([left, right])
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.join_type = join_type
+        self._bl = [bind_references(e, left.output) for e in self.left_keys]
+        self._br = [bind_references(e, right.output) for e in self.right_keys]
+        lo, ro = left.output, right.output
+        if join_type in ("semi", "anti"):
+            self._schema = lo
+        else:
+            self._schema = Schema(lo.names + ro.names, lo.types + ro.types)
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def execute_cpu(self):
+        from ..cpu.hostbatch import host_batch_to_arrow, host_batch_from_arrow
+        left = _concat_host(list(self.children[0].execute_cpu()),
+                            self.children[0].output)
+        right = _concat_host(list(self.children[1].execute_cpu()),
+                             self.children[1].output)
+        lk = _key_bytes([e.eval(_ctx(left.num_rows), left.vecs)
+                         for e in self._bl], left.num_rows)
+        rk = _key_bytes([e.eval(_ctx(right.num_rows), right.vecs)
+                         for e in self._br], right.num_rows)
+        # null keys never match (standard equi-join): a key row is joinable only
+        # if every key's validity byte is 1
+        lvalid = _all_keys_valid([e.eval(_ctx(left.num_rows), left.vecs)
+                                  for e in self._bl], left.num_rows)
+        rvalid = _all_keys_valid([e.eval(_ctx(right.num_rows), right.vecs)
+                                  for e in self._br], right.num_rows)
+        lmap: dict = {}
+        for i in np.nonzero(lvalid)[0]:
+            lmap.setdefault(lk[i].tobytes(), []).append(i)
+        rmap: dict = {}
+        for i in np.nonzero(rvalid)[0]:
+            rmap.setdefault(rk[i].tobytes(), []).append(i)
+
+        li, ri = [], []
+        jt = self.join_type
+        if jt in ("inner", "left", "right", "full"):
+            matched_r = set()
+            for i in range(left.num_rows):
+                key = lk[i].tobytes() if lvalid[i] else None
+                rs = rmap.get(key, []) if key is not None else []
+                if rs:
+                    for r in rs:
+                        li.append(i)
+                        ri.append(r)
+                        matched_r.add(r)
+                elif jt in ("left", "full"):
+                    li.append(i)
+                    ri.append(-1)
+            if jt in ("right", "full"):
+                for r in range(right.num_rows):
+                    if r not in matched_r:
+                        li.append(-1)
+                        ri.append(r)
+        elif jt == "semi":
+            for i in range(left.num_rows):
+                if lvalid[i] and lk[i].tobytes() in rmap:
+                    li.append(i)
+        elif jt == "anti":
+            for i in range(left.num_rows):
+                if not (lvalid[i] and lk[i].tobytes() in rmap):
+                    li.append(i)
+        else:
+            raise ValueError(jt)
+        li = np.array(li, dtype=np.int64)
+        ri = np.array(ri, dtype=np.int64)
+        out_vecs = _gather_side(left, li) if jt in ("semi", "anti") else \
+            _gather_side(left, li) + _gather_side(right, ri)
+        yield HostBatch(self._schema, out_vecs, len(li))
+
+    def _arg_string(self):
+        return f"[{self.join_type}, keys={[repr(e) for e in self.left_keys]}]"
+
+
+def _all_keys_valid(keys: List[Vec], n: int) -> np.ndarray:
+    ok = np.ones(n, dtype=bool)
+    for k in keys:
+        ok &= k.validity
+    return ok
+
+
+def _gather_side(b: HostBatch, idx: np.ndarray) -> List[Vec]:
+    """Gather with -1 meaning null row (outer join padding)."""
+    missing = idx < 0
+    safe = np.where(missing, 0, idx)
+    out = []
+    for v in b.vecs:
+        out.append(Vec(v.dtype, _take_np(v.data, safe),
+                       v.validity[safe] & ~missing,
+                       None if v.lengths is None else v.lengths[safe]))
+    return out
+
+
+class CpuSortExec(PhysicalPlan):
+    def __init__(self, orders: Sequence[Tuple[Expression, bool, bool]],
+                 child: PhysicalPlan):
+        """orders: (expr, ascending, nulls_first)."""
+        super().__init__([child])
+        self.orders = list(orders)
+        self._bound = [(bind_references(e, child.output), a, nf)
+                       for e, a, nf in self.orders]
+
+    @property
+    def output(self) -> Schema:
+        return self.children[0].output
+
+    def execute_cpu(self):
+        from ..ops.rowops import sort_keys_for, lexsort_indices
+        b = _concat_host(list(self.children[0].execute_cpu()),
+                         self.children[0].output)
+        ctx = _ctx(b.num_rows)
+        groups = []
+        for e, asc, nf in self._bound:
+            groups.append(sort_keys_for(np, e.eval(ctx, b.vecs), asc, nf))
+        order = lexsort_indices(np, groups, b.num_rows)
+        vecs = [Vec(v.dtype, _take_np(v.data, order), v.validity[order],
+                    None if v.lengths is None else v.lengths[order])
+                for v in b.vecs]
+        yield HostBatch(self.output, vecs, b.num_rows)
+
+    def _arg_string(self):
+        return f"[{[(repr(e), a, nf) for e, a, nf in self.orders]}]"
+
+
+class CpuLimitExec(PhysicalPlan):
+    def __init__(self, limit: int, child: PhysicalPlan, offset: int = 0):
+        super().__init__([child])
+        self.limit = limit
+        self.offset = offset
+
+    @property
+    def output(self) -> Schema:
+        return self.children[0].output
+
+    def execute_cpu(self):
+        remaining = self.limit
+        skip = self.offset
+        for b in self.children[0].execute_cpu():
+            if remaining <= 0:
+                break
+            start = min(skip, b.num_rows)
+            skip -= start
+            take = min(remaining, b.num_rows - start)
+            sl = slice(start, start + take)
+            vecs = [Vec(v.dtype, v.data[sl], v.validity[sl],
+                        None if v.lengths is None else v.lengths[sl])
+                    for v in b.vecs]
+            remaining -= take
+            yield HostBatch(self.output, vecs, take)
+
+    def _arg_string(self):
+        return f"[{self.limit}]"
+
+
+class CpuUnionExec(PhysicalPlan):
+    def __init__(self, children: Sequence[PhysicalPlan]):
+        super().__init__(children)
+
+    @property
+    def output(self) -> Schema:
+        return self.children[0].output
+
+    def execute_cpu(self):
+        for c in self.children:
+            yield from c.execute_cpu()
+
+
+class CpuRangeExec(PhysicalPlan):
+    def __init__(self, start: int, end: int, step: int = 1):
+        super().__init__([])
+        self.start, self.end, self.step = start, end, step
+        self._schema = Schema(("id",), (T.LONG,))
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def execute_cpu(self):
+        data = np.arange(self.start, self.end, self.step, dtype=np.int64)
+        yield HostBatch(self._schema,
+                        [Vec(T.LONG, data, np.ones(len(data), bool))],
+                        len(data))
+
+    def _arg_string(self):
+        return f"[{self.start}, {self.end}, {self.step}]"
+
+
+class CpuExpandExec(PhysicalPlan):
+    """Multiple projections per input row (rollup/cube building block)."""
+
+    def __init__(self, projections: Sequence[Sequence[Expression]],
+                 names: Sequence[str], child: PhysicalPlan):
+        super().__init__([child])
+        self.projections = [list(p) for p in projections]
+        self._bound = [[bind_references(e, child.output) for e in p]
+                       for p in self.projections]
+        tps = tuple(e.data_type for e in self._bound[0])
+        self._schema = Schema(tuple(names), tps)
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def execute_cpu(self):
+        for b in self.children[0].execute_cpu():
+            ctx = _ctx(b.num_rows)
+            for proj in self._bound:
+                vecs = [e.eval(ctx, b.vecs) for e in proj]
+                yield HostBatch(self._schema, vecs, b.num_rows)
+
+
+class CpuShuffleExchangeExec(PhysicalPlan):
+    """Partitioned exchange boundary. CPU engine is single-stream so this is a
+    pass-through marker; the TPU conversion lowers it to the shuffle manager."""
+
+    def __init__(self, partitioning, child: PhysicalPlan):
+        super().__init__([child])
+        self.partitioning = partitioning
+
+    @property
+    def output(self) -> Schema:
+        return self.children[0].output
+
+    def execute_cpu(self):
+        yield from self.children[0].execute_cpu()
+
+    def _arg_string(self):
+        return f"[{self.partitioning}]"

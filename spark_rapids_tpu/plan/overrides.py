@@ -1,0 +1,353 @@
+"""Plan rewrite: CPU physical plan -> TPU operators with tagging/fallback.
+
+Reference: `GpuOverrides.scala` — rule registries (expr rules `:866-3475`, exec rules
+`:3641-4016`), wrapPlan/tag/convert (`:3633,:4036,:4363`), explain output
+(`explainPotentialGpuPlan` `:4116`), per-op enable confs auto-registered per rule.
+Mirrored here at reduced scale: each rule carries a TypeSig, an auto-registered
+`spark.rapids.sql.{expression,exec}.*` conf key, optional extra tagging, and a
+convert function. Conversion is per-subtree with host<->device transitions inserted
+at boundaries (`GpuTransitionOverrides` analog lives in exec/transitions.py)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Tuple, Type
+
+from .. import config as C
+from .. import types as T
+from ..config import TpuConf
+from ..expr import base as EB
+from ..expr import (arithmetic as EA, bitwise as EW, cast as EC,
+                    conditional as ECO, datetime_ as ED, hashing as EH,
+                    math_ as EM, nullexprs as EN, predicates as EP,
+                    strings as ES)
+from ..expr.aggregates import (AggregateFunction, Average, Count, First, Last,
+                               Max, Min, Sum)
+from .meta import ExprMeta, PlanMeta
+from .typesig import TypeSig
+from . import nodes as N
+
+# ----------------------------------------------------------------------------
+# Expression rules
+# ----------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ExprRule:
+    cls: Type
+    sig: TypeSig
+    conf_key: str
+    incompat: bool = False
+    disabled: bool = False
+    tag_fn: Optional[Callable[[ExprMeta], None]] = None
+
+
+_EXPR_RULES: Dict[Type, ExprRule] = {}
+
+
+def expr_rule(cls: Type, sig: TypeSig, incompat: bool = False,
+              disabled: bool = False, tag_fn=None, doc: str = "") -> None:
+    key = f"spark.rapids.sql.expression.{cls.__name__}"
+    C.register(key, "bool", not disabled,
+               doc or f"Enable TPU execution of expression {cls.__name__}.")
+    _EXPR_RULES[cls] = ExprRule(cls, sig, key, incompat, disabled, tag_fn)
+
+
+def _tag_cast(meta: ExprMeta) -> None:
+    e: EC.Cast = meta.expr
+    try:
+        src = e.children[0].data_type
+    except Exception:
+        return
+    if not EC.device_supported(src, e.to):
+        meta.will_not_work(
+            f"cast {src.simple_string()} -> {e.to.simple_string()} is not "
+            "supported on TPU")
+    if meta.conf.is_ansi:
+        meta.will_not_work("ANSI-mode cast is not supported on TPU yet")
+
+
+def _tag_ansi_arith(meta: ExprMeta) -> None:
+    if meta.conf.is_ansi:
+        meta.will_not_work(
+            f"{meta.expr.name} in ANSI mode is not supported on TPU yet")
+
+
+_basic = TypeSig.all_basic()
+_num = TypeSig.numeric()
+_bool = TypeSig((T.BooleanType,))
+_str = TypeSig((T.StringType,))
+_int = TypeSig((T.IntegerType,))
+_dbl = TypeSig((T.DoubleType,))
+
+for cls in (EB.Literal, EB.AttributeReference, EB.BoundReference, EB.Alias):
+    expr_rule(cls, _basic)
+for cls in (EA.Add, EA.Subtract, EA.Multiply):
+    expr_rule(cls, _num, tag_fn=_tag_ansi_arith)
+for cls in (EA.Divide, EA.IntegralDivide, EA.Remainder, EA.Pmod):
+    expr_rule(cls, _num, tag_fn=_tag_ansi_arith)
+for cls in (EA.UnaryMinus, EA.Abs):
+    expr_rule(cls, _num, tag_fn=_tag_ansi_arith)
+for cls in (EP.EqualTo, EP.EqualNullSafe, EP.LessThan, EP.LessThanOrEqual,
+            EP.GreaterThan, EP.GreaterThanOrEqual):
+    expr_rule(cls, _bool)
+for cls in (EP.And, EP.Or, EP.Not, EP.In):
+    expr_rule(cls, _bool)
+for cls in (EN.IsNull, EN.IsNotNull, EN.IsNaN):
+    expr_rule(cls, _bool)
+for cls in (EN.Coalesce, EN.NaNvl, ECO.If, ECO.CaseWhen, ECO.Least,
+            ECO.Greatest):
+    expr_rule(cls, _basic)
+for cls in (EM.Sqrt, EM.Exp, EM.Log, EM.Log10, EM.Log2, EM.Pow, EM.Signum,
+            EM.Sin, EM.Cos, EM.Tan, EM.Asin, EM.Acos, EM.Atan, EM.Sinh,
+            EM.Cosh, EM.Tanh, EM.Cbrt, EM.ToDegrees, EM.ToRadians):
+    expr_rule(cls, _dbl, incompat=True,
+              doc="Transcendental results may differ from the JVM in ULPs "
+                  "(reference marks the same ops incompat).")
+for cls in (EM.Floor, EM.Ceil, EM.Round):
+    expr_rule(cls, _num)
+for cls in (EW.BitwiseAnd, EW.BitwiseOr, EW.BitwiseXor, EW.BitwiseNot,
+            EW.ShiftLeft, EW.ShiftRight, EW.ShiftRightUnsigned):
+    expr_rule(cls, TypeSig.integral())
+expr_rule(ES.Length, _int)
+for cls in (ES.Upper, ES.Lower):
+    expr_rule(cls, _str, incompat=True,
+              doc="ASCII-only case mapping on device (non-ASCII passes through "
+                  "unchanged); reference notes similar locale corner cases.")
+for cls in (ES.Substring, ES.Concat, ES.StringTrim, ES.StringTrimLeft,
+            ES.StringTrimRight):
+    expr_rule(cls, _str)
+for cls in (ES.StartsWith, ES.EndsWith, ES.Contains):
+    expr_rule(cls, _bool)
+for cls in (ED.Year, ED.Month, ED.DayOfMonth, ED.Quarter, ED.DayOfWeek,
+            ED.WeekDay, ED.DayOfYear, ED.Hour, ED.Minute, ED.Second,
+            ED.DateDiff):
+    expr_rule(cls, _int)
+expr_rule(ED.DateAdd, TypeSig((T.DateType,)))
+expr_rule(ED.DateSub, TypeSig((T.DateType,)))
+expr_rule(ED.UnixTimestampFromTs, TypeSig((T.LongType,)))
+expr_rule(EH.Murmur3Hash, _int)
+expr_rule(EC.Cast, _basic, tag_fn=_tag_cast)
+for cls in (Sum, Count, Min, Max, Average, First, Last):
+    expr_rule(cls, _basic)
+
+
+def lookup_expr_rule(expr: EB.Expression, conf: TpuConf) -> ExprMeta:
+    rule = _EXPR_RULES.get(type(expr))
+    return ExprMeta(expr, conf, rule)
+
+
+# ----------------------------------------------------------------------------
+# Exec rules
+# ----------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ExecRule:
+    cls: Type
+    sig: TypeSig
+    conf_key: str
+    incompat: bool = False
+    disabled: bool = False
+    tag_fn: Optional[Callable[[PlanMeta], None]] = None
+    expr_fn: Optional[Callable[[PlanMeta], None]] = None
+    convert_fn: Optional[Callable] = None
+
+
+_EXEC_RULES: Dict[Type, ExecRule] = {}
+
+
+def exec_rule(cls: Type, sig: TypeSig, convert_fn, tag_fn=None, expr_fn=None,
+              incompat: bool = False, disabled: bool = False,
+              doc: str = "") -> None:
+    key = f"spark.rapids.sql.exec.{cls.__name__.replace('Cpu', 'Tpu')}"
+    C.register(key, "bool", not disabled,
+               doc or f"Enable TPU execution of {cls.__name__}.")
+    _EXEC_RULES[cls] = ExecRule(cls, sig, key, incompat, disabled, tag_fn,
+                                expr_fn, convert_fn)
+
+
+# NOTE: metas tag the BOUND expression copies (the nodes bind in __init__) so
+# data_type is resolvable during tagging.
+
+def _exprs_project(m: PlanMeta):
+    for e in m.plan._bound:
+        m.add_expr(e)
+
+
+def _exprs_filter(m: PlanMeta):
+    m.add_expr(m.plan._bound)
+
+
+def _exprs_agg(m: PlanMeta):
+    for e in m.plan._bound_groups:
+        m.add_expr(e)
+    for a in m.plan._bound_aggs:
+        m.add_expr(a.func)
+
+
+def _exprs_join(m: PlanMeta):
+    for e in m.plan._bl + m.plan._br:
+        m.add_expr(e)
+
+
+def _exprs_sort(m: PlanMeta):
+    for e, _, _ in m.plan._bound:
+        m.add_expr(e)
+
+
+def _exprs_expand(m: PlanMeta):
+    for p in m.plan._bound:
+        for e in p:
+            m.add_expr(e)
+
+
+def _tag_join(m: PlanMeta):
+    from ..expr.base import AttributeReference
+    for e in m.plan.left_keys + m.plan.right_keys:
+        if not isinstance(e, AttributeReference):
+            m.will_not_work("join keys must be column references "
+                            "(project them first)")
+    if m.plan.join_type not in ("inner", "left", "right", "full", "semi",
+                                "anti"):
+        m.will_not_work(f"join type {m.plan.join_type} not supported on TPU")
+
+
+def _c_scan(plan, children, conf):
+    from ..exec.basic import TpuScanExec
+    return TpuScanExec(plan.table, conf)
+
+
+def _c_project(plan, children, conf):
+    from ..exec.basic import TpuProjectExec
+    return TpuProjectExec(plan.exprs, children[0], conf)
+
+
+def _c_filter(plan, children, conf):
+    from ..exec.basic import TpuFilterExec
+    return TpuFilterExec(plan.condition, children[0], conf)
+
+
+def _c_agg(plan, children, conf):
+    from ..exec.aggregate import TpuHashAggregateExec
+    return TpuHashAggregateExec(plan.group_exprs, plan.aggs, children[0], conf)
+
+
+def _c_join(plan, children, conf):
+    from ..exec.joins import TpuShuffledHashJoinExec
+    return TpuShuffledHashJoinExec(children[0], children[1], plan.left_keys,
+                                   plan.right_keys, plan.join_type, conf)
+
+
+def _c_sort(plan, children, conf):
+    from ..exec.sort import TpuSortExec
+    return TpuSortExec(plan.orders, children[0], conf)
+
+
+def _c_limit(plan, children, conf):
+    from ..exec.basic import TpuLimitExec
+    return TpuLimitExec(plan.limit, children[0], plan.offset, conf)
+
+
+def _c_union(plan, children, conf):
+    from ..exec.basic import TpuUnionExec
+    return TpuUnionExec(children, conf)
+
+
+def _c_range(plan, children, conf):
+    from ..exec.basic import TpuRangeExec
+    return TpuRangeExec(plan.start, plan.end, plan.step, conf)
+
+
+def _c_expand(plan, children, conf):
+    from ..exec.basic import TpuExpandExec
+    return TpuExpandExec(plan.projections, plan.output.names, children[0], conf)
+
+
+def _c_exchange(plan, children, conf):
+    from ..exec.coalesce import TpuCoalesceBatchesExec
+    # local mode: the exchange boundary becomes a coalesce; the shuffle manager
+    # lowers this to partitioned exchange in distributed plans (shuffle/)
+    return TpuCoalesceBatchesExec(children[0], conf=conf)
+
+
+exec_rule(N.CpuScanExec, TypeSig.all_basic(), _c_scan)
+exec_rule(N.CpuProjectExec, TypeSig.all_basic(), _c_project,
+          expr_fn=_exprs_project)
+exec_rule(N.CpuFilterExec, TypeSig.all_basic(), _c_filter,
+          expr_fn=_exprs_filter)
+exec_rule(N.CpuHashAggregateExec, TypeSig.all_basic(), _c_agg,
+          expr_fn=_exprs_agg)
+exec_rule(N.CpuHashJoinExec, TypeSig.all_basic(), _c_join, tag_fn=_tag_join,
+          expr_fn=_exprs_join)
+exec_rule(N.CpuSortExec, TypeSig.orderable(), _c_sort, expr_fn=_exprs_sort)
+exec_rule(N.CpuLimitExec, TypeSig.all_basic(), _c_limit)
+exec_rule(N.CpuUnionExec, TypeSig.all_basic(), _c_union)
+exec_rule(N.CpuRangeExec, TypeSig.all_basic(), _c_range)
+exec_rule(N.CpuExpandExec, TypeSig.all_basic(), _c_expand,
+          expr_fn=_exprs_expand)
+exec_rule(N.CpuShuffleExchangeExec, TypeSig.all_basic(), _c_exchange)
+
+
+# ----------------------------------------------------------------------------
+# apply
+# ----------------------------------------------------------------------------
+
+
+class Overrides:
+    """Entry point (reference GpuOverrides.apply / applyOverrides)."""
+
+    def __init__(self, conf: TpuConf):
+        self.conf = conf
+        self.explain_log: List[str] = []
+
+    def apply(self, plan: N.PhysicalPlan):
+        """Returns either a TpuExec (fully/partially converted, device root) or a
+        CPU PhysicalPlan with converted subtrees bridged back to host."""
+        if not self.conf.is_sql_enabled:
+            return plan
+        result, meta = self._convert(plan)
+        explain = self.conf.explain
+        if explain != "NONE":
+            lines = meta.explain_lines()
+            if explain == "ALL" or any(l.lstrip().startswith("!")
+                                       for l in lines):
+                self.explain_log.extend(lines)
+        if self.conf.get("spark.rapids.sql.mode") == "explainOnly":
+            return plan
+        return result
+
+    def _convert(self, plan: N.PhysicalPlan):
+        from ..exec.transitions import CpuFromTpuExec, TpuFromCpuExec
+        from ..exec.base import TpuExec
+
+        rule = _EXEC_RULES.get(type(plan))
+        meta = PlanMeta(plan, self.conf, rule)
+        converted_children = []
+        for c in plan.children:
+            cc, cm = self._convert(c)
+            converted_children.append(cc)
+            meta.child_metas.append(cm)
+        if rule is not None and rule.expr_fn is not None:
+            rule.expr_fn(meta)
+        meta.tag_for_device()
+
+        if self.conf.is_test_enabled and not meta.can_run_on_device:
+            raise AssertionError(
+                "spark.rapids.sql.test.enabled: plan node fell back to CPU: "
+                + "; ".join(meta.reasons))
+
+        if meta.can_run_on_device:
+            device_children = [
+                c if isinstance(c, TpuExec) else TpuFromCpuExec(c, self.conf)
+                for c in converted_children]
+            return rule.convert_fn(plan, device_children, self.conf), meta
+        # stay on CPU; bridge any device children back to host
+        host_children = [
+            c if not isinstance(c, TpuExec) else CpuFromTpuExec(c)
+            for c in converted_children]
+        plan.children = host_children
+        return plan, meta
+
+    def explain_string(self) -> str:
+        return "\n".join(self.explain_log)

@@ -1,0 +1,73 @@
+"""Type-support signatures (reference `TypeChecks.scala`: TypeSig `:171`,
+ExprChecks `:1121`, ExecChecks `:996`; also generates docs/supported_ops.md via
+SupportedOpsDocs `:1752` — see generate_supported_ops_docs below).
+
+A TypeSig declares which data types an operator/expression supports on device in a
+given context; tagging compares against it and records human-readable reasons."""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Set, Type
+
+from .. import types as T
+
+_ALL_BASIC = (T.BooleanType, T.ByteType, T.ShortType, T.IntegerType, T.LongType,
+              T.FloatType, T.DoubleType, T.StringType, T.DateType,
+              T.TimestampType, T.NullType)
+
+
+class TypeSig:
+    def __init__(self, classes: Iterable[Type] = (), decimal_max: int = 0,
+                 notes: str = ""):
+        self.classes: Set[Type] = set(classes)
+        self.decimal_max = decimal_max
+        self.notes = notes
+
+    @staticmethod
+    def all_basic() -> "TypeSig":
+        return TypeSig(_ALL_BASIC, decimal_max=18)
+
+    @staticmethod
+    def numeric() -> "TypeSig":
+        return TypeSig((T.ByteType, T.ShortType, T.IntegerType, T.LongType,
+                        T.FloatType, T.DoubleType), decimal_max=18)
+
+    @staticmethod
+    def integral() -> "TypeSig":
+        return TypeSig((T.ByteType, T.ShortType, T.IntegerType, T.LongType))
+
+    @staticmethod
+    def orderable() -> "TypeSig":
+        return TypeSig(_ALL_BASIC, decimal_max=18)
+
+    @staticmethod
+    def comparable() -> "TypeSig":
+        return TypeSig(_ALL_BASIC, decimal_max=18)
+
+    def plus(self, *classes: Type) -> "TypeSig":
+        s = TypeSig(self.classes | set(classes), self.decimal_max, self.notes)
+        return s
+
+    def minus(self, *classes: Type) -> "TypeSig":
+        return TypeSig(self.classes - set(classes), self.decimal_max, self.notes)
+
+    def support_reason(self, dt: T.DataType) -> Optional[str]:
+        """None if supported; else the reason string."""
+        if isinstance(dt, T.DecimalType):
+            if self.decimal_max <= 0:
+                return f"{dt.simple_string()} is not supported"
+            if dt.precision > self.decimal_max:
+                return (f"{dt.simple_string()} exceeds max supported precision "
+                        f"{self.decimal_max}")
+            return None
+        if dt.is_nested:
+            return f"nested type {dt.simple_string()} is not supported yet"
+        if type(dt) in self.classes:
+            return None
+        return f"{dt.simple_string()} is not supported"
+
+    def type_names(self) -> str:
+        names = sorted(c().simple_string() for c in self.classes)
+        if self.decimal_max:
+            names.append(f"decimal(<= {self.decimal_max})")
+        return ", ".join(names)

@@ -1,0 +1,114 @@
+"""Session bootstrap — driver/executor lifecycle (reference `Plugin.scala`:
+RapidsDriverPlugin `:222` / RapidsExecutorPlugin `:275`; config fixup `:110-161`;
+device init via `GpuDeviceManager.initializeGpuAndMemory`).
+
+`TpuSession` is the user entry point: holds the conf, owns device initialization
+(memory budget, admission semaphore), builds CPU plans via the DataFrame frontend,
+rewrites them through `plan.Overrides`, and executes. `explain` mirrors
+spark.rapids.sql.explain output."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .config import TpuConf
+from .plan.nodes import PhysicalPlan
+from .plan.overrides import Overrides
+
+
+class TpuSession:
+    _active: Optional["TpuSession"] = None
+
+    def __init__(self, conf: Optional[Dict] = None):
+        self.conf = TpuConf(conf)
+        self._device_initialized = False
+        TpuSession._active = self
+
+    # ------------------------------------------------------------------ device
+    def initialize_device(self) -> None:
+        """Executor-side init (GpuDeviceManager.initializeGpuAndMemory analog):
+        binds the device, sizes the memory budget, creates the semaphore."""
+        if self._device_initialized:
+            return
+        from .memory.device_manager import DeviceManager
+        DeviceManager.initialize(self.conf)
+        self._device_initialized = True
+
+    # ----------------------------------------------------------------- queries
+    def from_arrow(self, table, label: str = "memory"):
+        from .frontend import DataFrame
+        from .plan.nodes import CpuScanExec
+        return DataFrame(self, CpuScanExec(table, label))
+
+    def range(self, start: int, end: Optional[int] = None, step: int = 1):
+        from .frontend import DataFrame
+        from .plan.nodes import CpuRangeExec
+        if end is None:
+            start, end = 0, start
+        return DataFrame(self, CpuRangeExec(start, end, step))
+
+    def read_parquet(self, *paths, **options):
+        from .frontend import DataFrame
+        from .io.parquet import parquet_scan_plan
+        return DataFrame(self, parquet_scan_plan(list(paths), self.conf,
+                                                 **options))
+
+    def read_csv(self, *paths, **options):
+        from .frontend import DataFrame
+        from .io.csv import csv_scan_plan
+        return DataFrame(self, csv_scan_plan(list(paths), self.conf, **options))
+
+    def read_json(self, *paths, **options):
+        from .frontend import DataFrame
+        from .io.json_ import json_scan_plan
+        return DataFrame(self, json_scan_plan(list(paths), self.conf,
+                                              **options))
+
+    def read_orc(self, *paths, **options):
+        from .frontend import DataFrame
+        from .io.orc import orc_scan_plan
+        return DataFrame(self, orc_scan_plan(list(paths), self.conf, **options))
+
+    # --------------------------------------------------------------- execution
+    def execute_plan(self, plan: PhysicalPlan, use_device: Optional[bool] = None):
+        """Run a CPU plan through the override rewrite and execute; returns a
+        pyarrow Table."""
+        import pyarrow as pa
+        from .cpu.hostbatch import host_batch_to_arrow
+        from .exec.base import TpuExec
+        from .exec.transitions import device_batch_to_host
+        from .plan.nodes import _concat_host
+
+        enabled = self.conf.is_sql_enabled if use_device is None else use_device
+        if enabled:
+            self.initialize_device()
+            ov = Overrides(self.conf)
+            result = ov.apply(plan)
+            self._last_explain = ov.explain_string()
+            if self._last_explain:
+                print(self._last_explain)
+        else:
+            result = plan
+
+        if isinstance(result, TpuExec):
+            host_batches = [device_batch_to_host(b) for b in result.execute()]
+        else:
+            host_batches = list(result.execute_cpu())
+        merged = _concat_host(host_batches, plan.output)
+        return host_batch_to_arrow(merged)
+
+    def explain_plan(self, plan: PhysicalPlan) -> str:
+        ov = Overrides(self.conf)
+        saved = self.conf.get("spark.rapids.sql.explain")
+        self.conf.set("spark.rapids.sql.explain", "ALL")
+        try:
+            ov.apply(plan)
+        finally:
+            self.conf.set("spark.rapids.sql.explain", saved)
+        return ov.explain_string()
+
+    @classmethod
+    def active(cls) -> "TpuSession":
+        if cls._active is None:
+            cls._active = TpuSession()
+        return cls._active

@@ -415,3 +415,18 @@ class TestReviewRegressions:
         tbl = t(a=S("a", "a\x00", "a"), b=S("a\x00", "a", "ab"))
         assert assert_cpu_tpu_equal(lambda: LessThan(col("a"), col("b")), tbl) \
             .to_pylist() == [True, False, True]
+
+
+class TestOperatorSugar:
+    def test_bool_context_raises(self):
+        with pytest.raises(ValueError, match="Cannot convert"):
+            bool(col("a") == 1)
+        with pytest.raises(ValueError, match="Cannot convert"):
+            (col("a") == 1) and (col("b") == 2)
+
+    def test_reflected_operators(self):
+        tbl = t(a=L(10, 20))
+        out = assert_cpu_tpu_equal(lambda: 1 - col("a"), tbl)
+        assert out.to_pylist() == [-9, -19]
+        out = assert_cpu_tpu_equal(lambda: 100 / col("a"), tbl)
+        assert out.to_pylist() == [10.0, 5.0]

@@ -1,0 +1,185 @@
+"""Memory runtime tests (reference suites: RapidsDeviceMemoryStoreSuite,
+RapidsHostMemoryStoreSuite, RapidsDiskStoreSuite, DeviceMemoryEventHandlerSuite,
+GpuSemaphoreSuite, *RetrySuite with RmmSpark OOM injection)."""
+
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar import batch_from_arrow, batch_to_arrow
+from spark_rapids_tpu.errors import RetryOOM, SplitAndRetryOOM
+from spark_rapids_tpu.memory.budget import MemoryBudget
+from spark_rapids_tpu.memory.catalog import BufferCatalog, StorageTier
+from spark_rapids_tpu.memory.retry import (split_batch_halves, with_retry,
+                                           with_retry_no_split)
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+from spark_rapids_tpu.memory.spillable import SpillableColumnarBatch
+
+
+def _batch(n=100):
+    return batch_from_arrow(pa.table({
+        "a": pa.array(np.arange(n, dtype=np.int64)),
+        "s": pa.array([f"row{i}" for i in range(n)]),
+    }))
+
+
+class TestSpillCatalog:
+    def test_spill_to_host_and_back(self):
+        cat = BufferCatalog(host_limit=1 << 30)
+        b = _batch()
+        h = cat.add_batch(b)
+        assert cat.tier_of(h) == StorageTier.DEVICE
+        freed = cat.synchronous_spill(1)
+        assert freed > 0
+        assert cat.tier_of(h) == StorageTier.HOST
+        back = cat.acquire_batch(h)
+        assert cat.tier_of(h) == StorageTier.DEVICE
+        assert batch_to_arrow(back).equals(batch_to_arrow(b))
+        cat.remove(h)
+
+    def test_spill_overflows_to_disk(self):
+        cat = BufferCatalog(host_limit=1)  # anything overflows
+        b = _batch()
+        h = cat.add_batch(b)
+        cat.synchronous_spill(1)
+        assert cat.tier_of(h) == StorageTier.DISK
+        back = cat.acquire_batch(h)
+        assert batch_to_arrow(back).column("s").to_pylist() == \
+            [f"row{i}" for i in range(100)]
+        cat.remove(h)
+
+    def test_spill_priority_order(self):
+        from spark_rapids_tpu.memory.catalog import SpillPriority
+        cat = BufferCatalog(host_limit=1 << 30)
+        low = cat.add_batch(_batch(), SpillPriority.SPILL_FIRST)
+        high = cat.add_batch(_batch(), SpillPriority.ACTIVE_BATCH)
+        cat.synchronous_spill(1)  # needs little; should take the low one only
+        assert cat.tier_of(low) == StorageTier.HOST
+        assert cat.tier_of(high) == StorageTier.DEVICE
+
+
+class TestSpillableBatch:
+    def test_roundtrip(self):
+        sb = SpillableColumnarBatch(_batch(50))
+        assert sb.num_rows == 50
+        got = sb.get_batch()
+        assert got.row_count() == 50
+        sb.close()
+        with pytest.raises(ValueError):
+            sb.get_batch()
+
+
+class TestRetry:
+    def test_retry_oom_then_success(self):
+        calls = {"n": 0}
+
+        def fn(x):
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise RetryOOM("pressure")
+            return x * 2
+
+        assert with_retry_no_split(21, fn) == 42
+        assert calls["n"] == 3
+
+    def test_retry_gives_up(self):
+        def fn(x):
+            raise RetryOOM("always")
+
+        with pytest.raises(RetryOOM):
+            list(with_retry(1, fn))
+
+    def test_split_and_retry(self):
+        split_log = []
+
+        def fn(sb):
+            if sb.num_rows > 25:
+                raise SplitAndRetryOOM("too big")
+            return sb.get_batch().row_count()
+
+        def split(sb):
+            halves = split_batch_halves(sb)
+            split_log.append(len(halves))
+            return halves
+
+        sb = SpillableColumnarBatch(_batch(100))
+        out = list(with_retry(sb, fn, split))
+        assert sum(out) == 100
+        assert len(out) == 4  # 100 -> 50+50 -> 25*4
+        assert all(x == 2 for x in split_log)
+
+    def test_injection_via_budget(self):
+        MemoryBudget.initialize(1 << 40)
+        MemoryBudget.get().reset_injection(retry_at=1)
+        with pytest.raises(RetryOOM, match="injected"):
+            MemoryBudget.get().reserve(1024)
+        # next allocation succeeds
+        MemoryBudget.get().reserve(1024)
+        MemoryBudget.get().release(1024)
+        MemoryBudget.get().reset_injection()
+
+
+class TestBudget:
+    def test_exhaustion_raises_split(self):
+        MemoryBudget.initialize(1000)
+        BufferCatalog._instance = BufferCatalog()  # empty catalog: nothing to spill
+        b = MemoryBudget.get()
+        b.reserve(900)
+        with pytest.raises(SplitAndRetryOOM):
+            b.reserve(500)
+        b.release(900)
+        MemoryBudget.initialize(1 << 40)
+
+    def test_pressure_spills_catalog(self):
+        MemoryBudget.initialize(1 << 40)
+        cat = BufferCatalog(host_limit=1 << 30)
+        BufferCatalog._instance = cat
+        batch = _batch()
+        h = cat.add_batch(batch)
+        size = batch.device_memory_size()
+        MemoryBudget.initialize(size + 100)
+        MemoryBudget.get().reserve(size)  # budget accounted for the batch
+        # next reservation triggers synchronous spill of the catalog entry and
+        # then SUCCEEDS (spill freed enough; RetryOOM only when still short)
+        MemoryBudget.get().reserve(size)
+        assert cat.tier_of(h) == StorageTier.HOST
+        MemoryBudget.initialize(1 << 40)
+        BufferCatalog._instance = None
+
+
+class TestSemaphore:
+    def test_limits_concurrency(self):
+        TpuSemaphore._instance = None
+        TpuSemaphore.initialize(2)
+        sem = TpuSemaphore.get()
+        active = []
+        peak = []
+        lock = threading.Lock()
+
+        def task():
+            sem.acquire_if_necessary()
+            with lock:
+                active.append(1)
+                peak.append(len(active))
+            import time
+            time.sleep(0.02)
+            with lock:
+                active.pop()
+            sem.complete_task()
+
+        threads = [threading.Thread(target=task) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert max(peak) <= 2
+
+    def test_reentrant(self):
+        TpuSemaphore._instance = None
+        TpuSemaphore.initialize(1)
+        sem = TpuSemaphore.get()
+        sem.acquire_if_necessary()
+        sem.acquire_if_necessary()  # same thread: no deadlock
+        sem.complete_task()

@@ -1,0 +1,221 @@
+"""End-to-end query differential tests (the reference's SparkQueryCompareTestSuite
+model: same query on CPU engine and TPU engine, compare results)."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr import (Average, Count, Divide, First, Last, Max, Min,
+                                   Murmur3Hash, Sum, col, lit)
+from spark_rapids_tpu.plugin import TpuSession
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession({"spark.rapids.sql.enabled": True,
+                       "spark.rapids.sql.explain": "NONE"})
+
+
+def assert_same(df, sort_by=None, approx_cols=()):
+    """Run on both engines; compare (row-order-insensitive unless sorted)."""
+    tpu = df.collect()
+    cpu = df.collect_cpu()
+    assert tpu.schema.equals(cpu.schema), f"{tpu.schema} != {cpu.schema}"
+    if sort_by:
+        keys = [(k, "ascending") for k in sort_by]
+        tpu = tpu.sort_by(keys)
+        cpu = cpu.sort_by(keys)
+    assert tpu.num_rows == cpu.num_rows, f"{tpu.num_rows} != {cpu.num_rows}"
+    for name in tpu.schema.names:
+        a, b = tpu.column(name).to_pylist(), cpu.column(name).to_pylist()
+        for i, (x, y) in enumerate(zip(a, b)):
+            if x is None or y is None:
+                assert x is None and y is None, f"{name}[{i}]: {x!r} vs {y!r}"
+            elif isinstance(x, float) and name in approx_cols:
+                assert x == y or abs(x - y) <= 1e-9 * max(abs(x), abs(y), 1.0), \
+                    f"{name}[{i}]: {x!r} vs {y!r}"
+            elif isinstance(x, float) and (x != x or y != y):
+                assert x != x and y != y, f"{name}[{i}]: {x!r} vs {y!r}"
+            else:
+                assert x == y, f"{name}[{i}]: {x!r} vs {y!r}"
+    return tpu
+
+
+def make_table(rng, n=1000, null_frac=0.1):
+    ids = rng.integers(0, 50, n)
+    vals = rng.normal(0, 100, n)
+    cats = np.array(["alpha", "beta", "gamma", "delta", None], dtype=object)[
+        rng.integers(0, 5, n)]
+    nulls = rng.random(n) < null_frac
+    return pa.table({
+        "id": pa.array(np.where(nulls, 0, ids), type=pa.int64(),
+                       mask=nulls),
+        "val": pa.array(vals, type=pa.float64()),
+        "cat": pa.array(list(cats)),
+        "small": pa.array(rng.integers(-100, 100, n), type=pa.int32()),
+    })
+
+
+class TestBasicQueries:
+    def test_project_filter(self, session, rng):
+        df = session.from_arrow(make_table(rng))
+        q = df.filter(col("small") > 0).select(
+            (col("id") * 2).alias("id2"),
+            (col("val") + col("small")).alias("v"),
+            col("cat"))
+        assert_same(q, sort_by=["id2", "v"])
+
+    def test_filter_all_rows(self, session, rng):
+        df = session.from_arrow(make_table(rng, n=64))
+        assert_same(df.filter(lit(True)), sort_by=["id", "val"])
+        out = assert_same(df.filter(lit(False)))
+        assert out.num_rows == 0
+
+    def test_range_and_limit(self, session):
+        q = session.range(0, 1000, 3).limit(17)
+        out = assert_same(q)
+        assert out.column("id").to_pylist() == list(range(0, 51, 3))
+
+    def test_union(self, session, rng):
+        a = session.from_arrow(make_table(rng, n=100))
+        b = session.from_arrow(make_table(rng, n=200))
+        assert_same(a.union(b), sort_by=["id", "val", "small"])
+
+
+class TestAggregateQueries:
+    def test_group_by_agg(self, session, rng):
+        df = session.from_arrow(make_table(rng))
+        q = df.group_by("id").agg(
+            n=Count(col("val")),
+            total=Sum(col("small")),
+            lo=Min(col("val")),
+            hi=Max(col("val")),
+            avg=Average(col("val")),
+        )
+        assert_same(q, sort_by=["id"], approx_cols=("total", "avg"))
+
+    def test_group_by_string_key(self, session, rng):
+        df = session.from_arrow(make_table(rng))
+        q = df.group_by("cat").agg(n=Count(col("id")),
+                                   mx=Max(col("small")))
+        assert_same(q, sort_by=["cat"])
+
+    def test_global_agg(self, session, rng):
+        df = session.from_arrow(make_table(rng, n=500))
+        q = df.agg(n=Count(col("val")), s=Sum(col("small")),
+                   mn=Min(col("small")), mx=Max(col("small")))
+        assert_same(q)
+
+    def test_global_agg_empty_input(self, session, rng):
+        df = session.from_arrow(make_table(rng, n=50))
+        q = df.filter(lit(False)).agg(n=Count(col("val")),
+                                      s=Sum(col("small")))
+        out = assert_same(q)
+        assert out.to_pylist() == [{"n": 0, "s": None}]
+
+    def test_count_star(self, session, rng):
+        df = session.from_arrow(make_table(rng, n=300))
+        q = df.group_by("cat").agg(n=Count())
+        assert_same(q, sort_by=["cat"])
+
+    def test_min_max_string(self, session, rng):
+        df = session.from_arrow(make_table(rng))
+        q = df.group_by("id").agg(lo=Min(col("cat")), hi=Max(col("cat")))
+        assert_same(q, sort_by=["id"])
+
+    def test_first_last(self, session, rng):
+        # first/last are order-dependent; sort first so both engines agree
+        df = session.from_arrow(make_table(rng, n=200)) \
+            .sort("val").group_by("id") \
+            .agg(f=First(col("small")), l=Last(col("small")))
+        assert_same(df, sort_by=["id"])
+
+
+class TestSortQueries:
+    def test_sort_multi_key(self, session, rng):
+        df = session.from_arrow(make_table(rng, n=300))
+        q = df.sort(("cat", True, True), ("val", False, False))
+        tpu = q.collect()
+        cpu = q.collect_cpu()
+        assert tpu.equals(cpu) or tpu.to_pylist() == cpu.to_pylist()
+
+    def test_sort_nulls_positions(self, session, rng):
+        df = session.from_arrow(make_table(rng, n=100))
+        for asc, nf in [(True, True), (True, False), (False, True),
+                        (False, False)]:
+            q = df.sort(("id", asc, nf), ("val", True, True))
+            tpu, cpu = q.collect(), q.collect_cpu()
+            assert tpu.column("id").to_pylist() == cpu.column("id").to_pylist()
+
+
+class TestJoinQueries:
+    def _tables(self, session, rng):
+        left = session.from_arrow(make_table(rng, n=400))
+        dim = pa.table({
+            "id": pa.array(list(range(0, 40)) + [None], type=pa.int64()),
+            "name": pa.array([f"name_{i}" for i in range(40)] + [None]),
+        })
+        right = session.from_arrow(dim)
+        return left, right
+
+    @pytest.mark.parametrize("how", ["inner", "left", "right", "full", "semi",
+                                     "anti"])
+    def test_join_types(self, session, rng, how):
+        left, right = self._tables(session, rng)
+        q = left.join(right, on="id", how=how)
+        sort_cols = ["id", "val"] if how in ("semi", "anti") else None
+        tpu = q.collect()
+        cpu = q.collect_cpu()
+        assert tpu.num_rows == cpu.num_rows, f"{how}: row count"
+        # order-insensitive multiset comparison
+        def key(t):
+            return sorted(map(str, t.to_pylist()))
+        assert key(tpu) == key(cpu), f"{how}: rows differ"
+
+    def test_join_duplicate_keys(self, session, rng):
+        a = session.from_arrow(pa.table({
+            "k": pa.array([1, 1, 2, 3, None], type=pa.int64()),
+            "x": pa.array([10, 11, 20, 30, 40], type=pa.int64())}))
+        b = session.from_arrow(pa.table({
+            "k": pa.array([1, 1, 1, 2, None], type=pa.int64()),
+            "y": pa.array([100, 101, 102, 200, 300], type=pa.int64())}))
+        q = a.join(b, on="k", how="inner")
+        tpu, cpu = q.collect(), q.collect_cpu()
+        assert tpu.num_rows == cpu.num_rows == 7  # 2*3 + 1
+
+    def test_join_then_agg(self, session, rng):
+        left, right = self._tables(session, rng)
+        q = left.join(right, on="id", how="inner") \
+            .group_by("name").agg(n=Count(), s=Sum(col("small")))
+        assert_same(q, sort_by=["name"])
+
+
+class TestFallback:
+    def test_explain_reports_fallback(self, session, rng):
+        # DOUBLE -> STRING cast is not device-supported -> node falls back
+        df = session.from_arrow(make_table(rng, n=64)).select(
+            col("val").cast(T.STRING).alias("s"))
+        explain = df.explain()
+        assert "cast double -> string is not supported" in explain
+        # and the query still runs correctly via CPU fallback
+        tpu, cpu = df.collect(), df.collect_cpu()
+        assert tpu.equals(cpu)
+
+    def test_disable_expression_conf(self, rng):
+        s = TpuSession({"spark.rapids.sql.expression.Length": "false",
+                        "spark.rapids.sql.explain": "NONE"})
+        df = s.from_arrow(pa.table({"s": pa.array(["ab", "xyz"])}))
+        from spark_rapids_tpu.expr import Length
+        q = df.select(Length(col("s")).alias("n"))
+        explain = q.explain()
+        assert "Length" in explain and "disabled" in explain
+        assert q.collect().column("n").to_pylist() == [2, 3]
+
+    def test_strict_mode_raises(self, rng):
+        s = TpuSession({"spark.rapids.sql.test.enabled": True})
+        df = s.from_arrow(pa.table({"v": pa.array([1.5])}))
+        q = df.select(col("v").cast(T.STRING))
+        with pytest.raises(AssertionError, match="fell back"):
+            q.collect()
