@@ -87,6 +87,24 @@ class DataFrame:
         """Execute and return a pyarrow Table."""
         return self.session.execute_plan(self.plan)
 
+    def write_parquet(self, path: str, partition_by=None, mode: str = "error",
+                      **options):
+        from .io.writer import write_table
+        return write_table(self.collect(), path, "parquet", partition_by,
+                           mode, **options)
+
+    def write_orc(self, path: str, partition_by=None, mode: str = "error",
+                  **options):
+        from .io.writer import write_table
+        return write_table(self.collect(), path, "orc", partition_by, mode,
+                           **options)
+
+    def write_csv(self, path: str, partition_by=None, mode: str = "error",
+                  **options):
+        from .io.writer import write_table
+        return write_table(self.collect(), path, "csv", partition_by, mode,
+                           **options)
+
     def collect_cpu(self):
         """Execute on the CPU engine only (differential-testing helper)."""
         return self.session.execute_plan(self.plan, use_device=False)

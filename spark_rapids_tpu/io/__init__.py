@@ -1,0 +1,7 @@
+from .multifile import FileBatchIterator, choose_reader_type, reader_thread_pool  # noqa: F401
+from .scanbase import CpuFileScanExec, make_tpu_file_scan  # noqa: F401
+from .parquet import CpuParquetScanExec, parquet_scan_plan  # noqa: F401
+from .csv import CpuCsvScanExec, csv_scan_plan  # noqa: F401
+from .json_ import CpuJsonScanExec, json_scan_plan  # noqa: F401
+from .orc import CpuOrcScanExec, orc_scan_plan  # noqa: F401
+from .writer import write_table, WriteStats  # noqa: F401

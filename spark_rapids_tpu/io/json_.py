@@ -1,0 +1,41 @@
+"""JSON-lines scan (reference JSON reader under `catalyst/json/rapids` +
+`GpuTextBasedPartitionReader`). Host path: pyarrow JSON reader."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import pyarrow as pa
+import pyarrow.json as pajson
+
+from ..columnar.batch import Schema
+from ..config import TpuConf
+from .scanbase import CpuFileScanExec
+
+
+class CpuJsonScanExec(CpuFileScanExec):
+    format_name = "json"
+
+    def _infer_schema(self) -> Schema:
+        if "schema" in self.options:
+            return self.options["schema"]
+        return Schema.from_arrow(pajson.read_json(self.paths[0]).schema)
+
+    def decode_file(self, path: str) -> pa.Table:
+        parse = None
+        if "schema" in self.options:
+            from .. import types as T
+            s = self.options["schema"]
+            explicit = pa.schema([pa.field(n, T.to_arrow(t))
+                                  for n, t in zip(s.names, s.types)])
+            parse = pajson.ParseOptions(explicit_schema=explicit)
+        t = pajson.read_json(path, parse_options=parse)
+        if self.columns:
+            t = t.select(self.columns)
+        return t
+
+
+def json_scan_plan(paths: Sequence[str], conf: TpuConf, **options):
+    if not conf.get("spark.rapids.sql.format.json.enabled"):
+        raise ValueError("json scan disabled by conf")
+    return CpuJsonScanExec(paths, conf, **options)

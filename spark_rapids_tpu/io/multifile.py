@@ -1,0 +1,120 @@
+"""Multi-file reader framework (reference `GpuMultiFileReader.scala`: global
+thread pool `MultiFileReaderThreadPool` `:133`, cloud reader base `:450`,
+coalescing base `:937`; reader-type selection by scheme via CLOUD_SCHEMES).
+
+Three strategies, as in the reference's Parquet/ORC/Avro scans
+(`GpuParquetScan.scala:941,1128`):
+  PERFILE       one file -> decode -> device transfer at a time;
+  COALESCING    stitch many small files' host tables into one device transfer;
+  MULTITHREADED background threads prefetch+decode files, overlapping host I/O
+                with device compute (the cloud-object-store strategy).
+Host decode is Arrow (the SURVEY.md §7 stage-4 plan: host decode first, device
+decode for hot encodings later)."""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import threading
+from typing import Callable, Iterator, List, Optional, Sequence
+from urllib.parse import urlparse
+
+import pyarrow as pa
+
+from ..config import TpuConf, get_default_conf
+
+_pool_lock = threading.Lock()
+_pool: Optional[cf.ThreadPoolExecutor] = None
+
+
+def reader_thread_pool(num_threads: int) -> cf.ThreadPoolExecutor:
+    """Process-wide reader pool (MultiFileReaderThreadPool analog)."""
+    global _pool
+    with _pool_lock:
+        if _pool is None:
+            _pool = cf.ThreadPoolExecutor(
+                max_workers=num_threads, thread_name_prefix="multifile-reader")
+        return _pool
+
+
+def _reader_type_key(format_name: str) -> str:
+    # per-format reader-type keys (reference has parquet/orc/avro variants);
+    # registered lazily so new formats get a key automatically
+    from .. import config as C
+    key = f"spark.rapids.sql.format.{format_name}.reader.type"
+    C.register(key, "string", "AUTO",
+               f"Reader strategy for {format_name}: AUTO, PERFILE, COALESCING, "
+               "MULTITHREADED.",
+               check_values=("AUTO", "PERFILE", "COALESCING", "MULTITHREADED"))
+    return key
+
+
+def choose_reader_type(paths: Sequence[str], conf: TpuConf,
+                       format_name: str = "parquet") -> str:
+    rt = conf.get(_reader_type_key(format_name))
+    if rt != "AUTO":
+        return rt
+    cloud = set(s.strip() for s in
+                conf.get("spark.rapids.cloudSchemes").split(","))
+    for p in paths:
+        scheme = urlparse(str(p)).scheme
+        if scheme in cloud:
+            return "MULTITHREADED"
+    if len(paths) > 1:
+        return "COALESCING"
+    return "PERFILE"
+
+
+class FileBatchIterator:
+    """Iterate host Arrow tables across files under a reader strategy;
+    `decode_fn(path) -> pa.Table` is format-specific."""
+
+    def __init__(self, paths: Sequence[str], decode_fn: Callable,
+                 conf: TpuConf = None, batch_rows: Optional[int] = None,
+                 format_name: str = "parquet"):
+        self.paths = list(paths)
+        self.decode_fn = decode_fn
+        self.conf = conf or get_default_conf()
+        self.reader_type = choose_reader_type(self.paths, self.conf,
+                                              format_name)
+        self.batch_rows = batch_rows or self.conf.batch_size_rows
+
+    def __iter__(self) -> Iterator[pa.Table]:
+        if not self.paths:
+            return
+        if self.reader_type == "PERFILE":
+            for p in self.paths:
+                yield from self._slices(self.decode_fn(p))
+        elif self.reader_type == "COALESCING":
+            tables = [self.decode_fn(p) for p in self.paths]
+            non_empty = [t for t in tables if t.num_rows]
+            if not non_empty:
+                yield tables[0]  # preserve schema for the all-empty case
+            else:
+                merged = pa.concat_tables(non_empty) if len(non_empty) > 1 \
+                    else non_empty[0]
+                yield from self._slices(merged)
+        else:  # MULTITHREADED
+            threads = self.conf.get(
+                "spark.rapids.sql.format.parquet.multiThreadedRead.numThreads")
+            max_par = self.conf.get("spark.rapids.sql.format.parquet."
+                                    "multiThreadedRead.maxNumFilesParallel")
+            pool = reader_thread_pool(threads)
+            pending: List[cf.Future] = []
+            idx = 0
+            # keep up to max_par fetches in flight, yield in submit order
+            while idx < len(self.paths) or pending:
+                while idx < len(self.paths) and len(pending) < max(max_par, 1):
+                    pending.append(pool.submit(self.decode_fn,
+                                               self.paths[idx]))
+                    idx += 1
+                fut = pending.pop(0)
+                yield from self._slices(fut.result())
+
+    def _slices(self, table: pa.Table) -> Iterator[pa.Table]:
+        n = table.num_rows
+        if n == 0:
+            yield table
+            return
+        step = self.batch_rows
+        for off in range(0, n, step):
+            yield table.slice(off, min(step, n - off))

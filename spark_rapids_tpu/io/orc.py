@@ -1,0 +1,34 @@
+"""ORC scan (reference `GpuOrcScan.scala` ~2.7k LoC, same strategy pattern as
+Parquet). Host path: pyarrow ORC reader."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import pyarrow as pa
+
+from ..columnar.batch import Schema
+from ..config import TpuConf
+from .scanbase import CpuFileScanExec
+
+
+class CpuOrcScanExec(CpuFileScanExec):
+    format_name = "orc"
+
+    def _infer_schema(self) -> Schema:
+        from pyarrow import orc
+        f = orc.ORCFile(self.paths[0])
+        schema = f.schema
+        if self.columns:
+            schema = pa.schema([schema.field(c) for c in self.columns])
+        return Schema.from_arrow(schema)
+
+    def decode_file(self, path: str) -> pa.Table:
+        from pyarrow import orc
+        return orc.read_table(path, columns=self.columns)
+
+
+def orc_scan_plan(paths: Sequence[str], conf: TpuConf, **options):
+    if not conf.get("spark.rapids.sql.format.orc.enabled"):
+        raise ValueError("orc scan disabled by conf")
+    return CpuOrcScanExec(paths, conf, **options)

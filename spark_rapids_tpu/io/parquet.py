@@ -1,0 +1,40 @@
+"""Parquet scan (reference `GpuParquetScan.scala` 2,598 LoC: footer parse/clip,
+predicate pushdown, PERFILE/COALESCING/MULTITHREADED strategies, chunked reader).
+
+Host path: pyarrow footer parse + column-chunk decode with row-group pruning via
+`filters` (the predicate-pushdown seam). Device decode of PLAIN/DICT/RLE pages is
+the planned native/Pallas optimization (SURVEY.md §7 hard-parts list)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from ..columnar.batch import Schema
+from ..config import TpuConf
+from .scanbase import CpuFileScanExec
+
+
+class CpuParquetScanExec(CpuFileScanExec):
+    format_name = "parquet"
+
+    def _infer_schema(self) -> Schema:
+        f = pq.ParquetFile(self.paths[0])
+        schema = f.schema_arrow
+        if self.columns:
+            schema = pa.schema([schema.field(c) for c in self.columns])
+        return Schema.from_arrow(schema)
+
+    def decode_file(self, path: str) -> pa.Table:
+        # timestamp normalization + pruning applied in scanbase._postprocess
+        filters = self.options.get("filters")
+        return pq.read_table(path, columns=self.columns, filters=filters,
+                             use_threads=False)
+
+
+def parquet_scan_plan(paths: Sequence[str], conf: TpuConf, **options):
+    if not conf.get("spark.rapids.sql.format.parquet.enabled"):
+        raise ValueError("parquet scan disabled by conf")
+    return CpuParquetScanExec(paths, conf, **options)

@@ -1,0 +1,120 @@
+"""File scan plan nodes + TPU scan exec shared across formats.
+
+Reference counterparts: `GpuFileSourceScanExec.scala` (exec), format readers
+(`GpuParquetScan.scala`, `GpuOrcScan.scala`, `GpuCSVScan.scala`, JSON under
+`catalyst/json/rapids`). Host decode is Arrow; device transfer per batch. Column
+pruning is pushed into the decode; row-group/predicate pushdown where the format
+library supports it (parquet filters)."""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Optional, Sequence
+
+import pyarrow as pa
+
+from ..columnar.batch import Schema
+from ..config import TpuConf, get_default_conf
+from ..cpu.hostbatch import HostBatch, host_batch_from_arrow
+from ..plan.nodes import PhysicalPlan
+from .multifile import FileBatchIterator
+
+
+class CpuFileScanExec(PhysicalPlan):
+    """CPU plan node for a file scan; format subclasses provide decode_fn and the
+    schema. The TPU conversion wraps the same iterator with device transfer."""
+
+    format_name = "file"
+
+    def __init__(self, paths: Sequence[str], conf: TpuConf = None,
+                 columns: Optional[List[str]] = None, **options):
+        super().__init__([])
+        self.paths = [str(p) for p in paths]
+        self.conf = conf or get_default_conf()
+        self.columns = columns
+        self.options = options
+        schema = self._infer_schema()
+        if columns and list(schema.names) != list(columns):
+            # prune the declared schema too, not just the data — downstream
+            # expression binding uses plan.output ordinals
+            idx = [schema.names.index(c) for c in columns]
+            schema = Schema(tuple(schema.names[i] for i in idx),
+                            tuple(schema.types[i] for i in idx))
+        self._schema = schema
+
+    # -- format hooks ---------------------------------------------------------
+    def _infer_schema(self) -> Schema:
+        raise NotImplementedError
+
+    def decode_file(self, path: str) -> pa.Table:
+        raise NotImplementedError
+
+    # -------------------------------------------------------------------------
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def _postprocess(self, t: pa.Table) -> pa.Table:
+        """Shared post-decode fixups for ALL formats: column pruning (so the
+        data always matches self.output) and Spark timestamp normalization
+        (us/UTC) — format decoders may skip either."""
+        if self.columns and t.schema.names != list(self.columns):
+            t = t.select([c for c in self.columns if c in t.schema.names])
+        return normalize_timestamps(t)
+
+    def host_tables(self) -> Iterator[pa.Table]:
+        for t in FileBatchIterator(self.paths, self.decode_file, self.conf,
+                                   format_name=self.format_name):
+            yield self._postprocess(t)
+
+    def execute_cpu(self) -> Iterator[HostBatch]:
+        for t in self.host_tables():
+            yield host_batch_from_arrow(t)
+
+    def _arg_string(self):
+        return f"[{self.format_name}, {len(self.paths)} files]"
+
+
+def normalize_timestamps(t: pa.Table) -> pa.Table:
+    """Any-unit/any-tz timestamps -> us/UTC (Spark TimestampType semantics)."""
+    new_cols = []
+    changed = False
+    for f in t.schema:
+        col = t.column(f.name)
+        if pa.types.is_timestamp(f.type) and (f.type.unit != "us"
+                                              or f.type.tz != "UTC"):
+            col = col.cast(pa.timestamp("us", tz="UTC"))
+            changed = True
+        new_cols.append(col)
+    if not changed:
+        return t
+    return pa.table(new_cols, names=t.schema.names)
+
+
+from ..exec.base import TpuExec as _TpuExec  # noqa: E402
+
+
+class TpuFileScanExec(_TpuExec):
+    """Device exec over a file scan (GpuFileSourceScanExec analog)."""
+
+    def __init__(self, plan: CpuFileScanExec, conf: TpuConf):
+        super().__init__([], conf)
+        self.cpu_scan = plan
+
+    @property
+    def output(self) -> Schema:
+        return self.cpu_scan.output
+
+    @property
+    def name(self):
+        return f"TpuFileScanExec({self.cpu_scan.format_name})"
+
+    def do_execute(self):
+        from ..columnar.batch import batch_from_arrow
+        for t in self.cpu_scan.host_tables():
+            b = batch_from_arrow(t)
+            self.num_output_rows.add(t.num_rows)
+            yield self._count_output(b)
+
+
+def make_tpu_file_scan(plan: CpuFileScanExec, conf: TpuConf) -> TpuFileScanExec:
+    return TpuFileScanExec(plan, conf)

@@ -1,0 +1,101 @@
+"""Columnar writers (reference `ColumnarOutputWriter.scala`,
+`GpuParquetFileFormat.scala`, `GpuOrcFileFormat.scala`, dynamic-partition write
+`GpuFileFormatDataWriter.scala`, stats `BasicColumnarWriteStatsTracker.scala`).
+
+Device batches come down as Arrow tables at the host boundary and are encoded by
+pyarrow; dynamic partitioning splits by partition-column values and writes
+`key=value/` directories (Spark layout)."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import uuid
+from typing import Iterator, List, Optional, Sequence
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+
+@dataclasses.dataclass
+class WriteStats:
+    """BasicColumnarWriteStatsTracker analog."""
+    num_files: int = 0
+    num_rows: int = 0
+    num_bytes: int = 0
+    partitions: Optional[List[str]] = None
+
+    def record(self, path: str, rows: int):
+        self.num_files += 1
+        self.num_rows += rows
+        try:
+            self.num_bytes += os.path.getsize(path)
+        except OSError:
+            pass
+
+
+def _write_one(table: pa.Table, path: str, fmt: str, **options) -> None:
+    if fmt == "parquet":
+        pq.write_table(table, path,
+                       compression=options.get("compression", "snappy"))
+    elif fmt == "orc":
+        from pyarrow import orc
+        orc.write_table(table, path)
+    elif fmt == "csv":
+        import pyarrow.csv as pacsv
+        pacsv.write_csv(table, path)
+    else:
+        raise ValueError(f"unknown write format {fmt}")
+
+
+_MODES = ("error", "overwrite", "append", "ignore")
+
+
+def write_table(table: pa.Table, path: str, fmt: str = "parquet",
+                partition_by: Optional[Sequence[str]] = None,
+                mode: str = "error", **options) -> WriteStats:
+    if mode not in _MODES:
+        raise ValueError(f"unknown write mode {mode!r}; one of {_MODES}")
+    stats = WriteStats(partitions=[])
+    exists = os.path.exists(path)
+    non_empty = exists and (not os.path.isdir(path) or os.listdir(path))
+    if non_empty:
+        if mode == "error":
+            raise FileExistsError(f"path exists: {path} (mode=error)")
+        if mode == "ignore":
+            return stats
+        if mode == "overwrite":
+            import shutil
+            if os.path.isdir(path):
+                shutil.rmtree(path)
+            else:
+                os.unlink(path)
+    ext = {"parquet": "parquet", "orc": "orc", "csv": "csv"}[fmt]
+    if not partition_by:
+        os.makedirs(path, exist_ok=True)
+        out = os.path.join(path, f"part-{uuid.uuid4().hex[:12]}.{ext}")
+        _write_one(table, out, fmt, **options)
+        stats.record(out, table.num_rows)
+        return stats
+    # dynamic partition write via pyarrow.dataset (hive layout incl. the
+    # __HIVE_DEFAULT_PARTITION__ null convention Spark uses)
+    import pyarrow.dataset as pads
+    part_schema = pa.schema([table.schema.field(k) for k in partition_by])
+    written: List[str] = []
+
+    def visitor(f):
+        written.append(f.path)
+
+    pads.write_dataset(
+        table, path, format=fmt,
+        partitioning=pads.partitioning(part_schema, flavor="hive"),
+        basename_template=f"part-{uuid.uuid4().hex[:8]}-{{i}}.{ext}",
+        existing_data_behavior="overwrite_or_ignore",
+        file_visitor=visitor)
+    for p in written:
+        stats.record(p, 0)
+        rel = os.path.relpath(os.path.dirname(p), path)
+        if rel != "." and rel not in stats.partitions:
+            stats.partitions.append(rel)
+    stats.num_rows = table.num_rows
+    return stats

@@ -271,6 +271,22 @@ def _c_exchange(plan, children, conf):
     return TpuCoalesceBatchesExec(children[0], conf=conf)
 
 
+def _c_file_scan(plan, children, conf):
+    from ..io.scanbase import make_tpu_file_scan
+    return make_tpu_file_scan(plan, conf)
+
+
+def _register_file_scan_rules():
+    from ..io.scanbase import CpuFileScanExec
+    from ..io.parquet import CpuParquetScanExec
+    from ..io.csv import CpuCsvScanExec
+    from ..io.json_ import CpuJsonScanExec
+    from ..io.orc import CpuOrcScanExec
+    for cls in (CpuParquetScanExec, CpuCsvScanExec, CpuJsonScanExec,
+                CpuOrcScanExec):
+        exec_rule(cls, TypeSig.all_basic(), _c_file_scan)
+
+
 exec_rule(N.CpuScanExec, TypeSig.all_basic(), _c_scan)
 exec_rule(N.CpuProjectExec, TypeSig.all_basic(), _c_project,
           expr_fn=_exprs_project)
@@ -287,6 +303,7 @@ exec_rule(N.CpuRangeExec, TypeSig.all_basic(), _c_range)
 exec_rule(N.CpuExpandExec, TypeSig.all_basic(), _c_expand,
           expr_fn=_exprs_expand)
 exec_rule(N.CpuShuffleExchangeExec, TypeSig.all_basic(), _c_exchange)
+_register_file_scan_rules()
 
 
 # ----------------------------------------------------------------------------

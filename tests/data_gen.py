@@ -1,0 +1,136 @@
+"""Composable random data generators — the differential harness's fuzzer
+(reference `integration_tests/src/main/python/data_gen.py`: seeded composable
+generators for every Spark type, the de-facto fuzzer of the project)."""
+
+from __future__ import annotations
+
+import string
+from typing import List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+
+class DataGen:
+    def __init__(self, arrow_type, nullable: bool = True,
+                 null_frac: float = 0.1):
+        self.arrow_type = arrow_type
+        self.nullable = nullable
+        self.null_frac = null_frac if nullable else 0.0
+
+    def generate(self, rng: np.random.Generator, n: int) -> pa.Array:
+        vals = self._values(rng, n)
+        if self.null_frac > 0:
+            mask = rng.random(n) < self.null_frac
+            return pa.array(vals, type=self.arrow_type, mask=mask)
+        return pa.array(vals, type=self.arrow_type)
+
+    def _values(self, rng, n):
+        raise NotImplementedError
+
+
+class IntGen(DataGen):
+    def __init__(self, bits: int = 64, lo=None, hi=None, **kw):
+        t = {8: pa.int8(), 16: pa.int16(), 32: pa.int32(), 64: pa.int64()}[bits]
+        super().__init__(t, **kw)
+        info_lo = -(2 ** (bits - 1))
+        info_hi = 2 ** (bits - 1) - 1
+        self.lo = info_lo if lo is None else lo
+        self.hi = info_hi if hi is None else hi
+        self.bits = bits
+        self.edge = [self.lo, self.hi, 0, -1, 1]
+
+    def _values(self, rng, n):
+        vals = rng.integers(self.lo, self.hi, n, dtype=np.int64,
+                            endpoint=True)
+        # sprinkle edge cases (reference gens include boundary values)
+        for i in range(min(len(self.edge), n)):
+            if rng.random() < 0.5:
+                vals[rng.integers(0, n)] = self.edge[i]
+        return vals.astype({8: np.int8, 16: np.int16, 32: np.int32,
+                            64: np.int64}[self.bits])
+
+
+class BooleanGen(DataGen):
+    def __init__(self, **kw):
+        super().__init__(pa.bool_(), **kw)
+
+    def _values(self, rng, n):
+        return rng.integers(0, 2, n).astype(bool)
+
+
+class FloatGen(DataGen):
+    def __init__(self, bits: int = 64, with_special: bool = True, **kw):
+        super().__init__(pa.float32() if bits == 32 else pa.float64(), **kw)
+        self.bits = bits
+        self.with_special = with_special
+
+    def _values(self, rng, n):
+        vals = rng.normal(0, 1e6, n)
+        if self.with_special and n >= 8:
+            for v in (np.nan, np.inf, -np.inf, 0.0, -0.0):
+                vals[rng.integers(0, n)] = v
+        return vals.astype(np.float32 if self.bits == 32 else np.float64)
+
+
+class StringGen(DataGen):
+    def __init__(self, max_len: int = 20, charset: str = None,
+                 with_unicode: bool = True, **kw):
+        super().__init__(pa.string(), **kw)
+        self.max_len = max_len
+        self.charset = charset or (string.ascii_letters + string.digits + " ")
+        self.with_unicode = with_unicode
+
+    def _values(self, rng, n):
+        out = []
+        chars = list(self.charset)
+        for _ in range(n):
+            ln = int(rng.integers(0, self.max_len + 1))
+            s = "".join(rng.choice(chars) for _ in range(ln))
+            out.append(s)
+        if self.with_unicode and n >= 4:
+            out[int(rng.integers(0, n))] = "日本語テキスト"
+            out[int(rng.integers(0, n))] = "🎉émoji"
+            out[int(rng.integers(0, n))] = ""
+        return out
+
+
+class DateGen(DataGen):
+    def __init__(self, **kw):
+        super().__init__(pa.date32(), **kw)
+
+    def _values(self, rng, n):
+        return rng.integers(-25000, 25000, n).astype(np.int32)
+
+
+class TimestampGen(DataGen):
+    def __init__(self, **kw):
+        super().__init__(pa.timestamp("us", tz="UTC"), **kw)
+
+    def _values(self, rng, n):
+        return rng.integers(-2**40, 2**44, n).astype(np.int64)
+
+
+class DecimalGen(DataGen):
+    def __init__(self, precision: int = 10, scale: int = 2, **kw):
+        super().__init__(pa.decimal128(precision, scale), **kw)
+        self.precision, self.scale = precision, scale
+
+    def _values(self, rng, n):
+        import decimal
+        limit = 10 ** self.precision - 1
+        unscaled = rng.integers(-limit, limit, n, endpoint=True)
+        return [decimal.Decimal(int(u)).scaleb(-self.scale) for u in unscaled]
+
+
+def gen_table(rng: np.random.Generator, gens: List[Tuple[str, DataGen]],
+              n: int = 1024) -> pa.Table:
+    return pa.table({name: g.generate(rng, n) for name, g in gens})
+
+
+# standard generator sets (reference's *_gens lists)
+def basic_gens():
+    return [("b", BooleanGen()), ("i8", IntGen(8)), ("i16", IntGen(16)),
+            ("i32", IntGen(32)), ("i64", IntGen(64)), ("f32", FloatGen(32)),
+            ("f64", FloatGen(64)), ("s", StringGen()), ("d", DateGen()),
+            ("ts", TimestampGen())]

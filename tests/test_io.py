@@ -1,0 +1,221 @@
+"""IO tests: format scans under the three reader strategies + writers
+(reference: parquet/orc/csv tests in integration_tests)."""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from data_gen import basic_gens, gen_table
+from spark_rapids_tpu.expr import Count, Sum, col
+from spark_rapids_tpu.plugin import TpuSession
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession({"spark.rapids.sql.explain": "NONE"})
+
+
+@pytest.fixture
+def gen_tbl(rng):
+    return gen_table(rng, basic_gens(), n=500)
+
+
+def _assert_df_equal(df, expected: pa.Table, sort_col="i64"):
+    got = df.collect()
+    got_cpu = df.collect_cpu()
+    key = [(sort_col, "ascending"), ("f64", "ascending")]
+    for t in (got, got_cpu):
+        assert t.num_rows == expected.num_rows
+    gs = got.sort_by(key)
+    es = expected.sort_by(key)
+    for name in expected.schema.names:
+        a, b = gs.column(name).to_pylist(), es.column(name).to_pylist()
+        for x, y in zip(a, b):
+            if isinstance(x, float) and x != x:
+                assert y != y
+            else:
+                assert x == y, f"{name}: {x!r} != {y!r}"
+
+
+class TestParquet:
+    def test_single_file_roundtrip(self, session, gen_tbl, tmp_path):
+        p = str(tmp_path / "t.parquet")
+        pq.write_table(gen_tbl, p)
+        df = session.read_parquet(p)
+        _assert_df_equal(df, gen_tbl)
+
+    def test_multi_file_coalescing(self, session, gen_tbl, tmp_path):
+        paths = []
+        for i in range(4):
+            p = str(tmp_path / f"t{i}.parquet")
+            pq.write_table(gen_tbl.slice(i * 125, 125), p)
+            paths.append(p)
+        from spark_rapids_tpu.io.multifile import choose_reader_type
+        assert choose_reader_type(paths, session.conf) == "COALESCING"
+        df = session.read_parquet(*paths)
+        _assert_df_equal(df, gen_tbl)
+
+    def test_multithreaded_reader(self, session, gen_tbl, tmp_path):
+        session.conf.set("spark.rapids.sql.format.parquet.reader.type",
+                         "MULTITHREADED")
+        try:
+            paths = []
+            for i in range(4):
+                p = str(tmp_path / f"m{i}.parquet")
+                pq.write_table(gen_tbl.slice(i * 125, 125), p)
+                paths.append(p)
+            df = session.read_parquet(*paths)
+            _assert_df_equal(df, gen_tbl)
+        finally:
+            session.conf.set("spark.rapids.sql.format.parquet.reader.type",
+                             "AUTO")
+
+    def test_column_pruning(self, session, gen_tbl, tmp_path):
+        p = str(tmp_path / "t.parquet")
+        pq.write_table(gen_tbl, p)
+        df = session.read_parquet(p, columns=["i64", "s"])
+        out = df.collect()
+        assert out.schema.names == ["i64", "s"]
+
+    def test_predicate_pushdown(self, session, tmp_path):
+        t = pa.table({"a": pa.array(range(1000), type=pa.int64())})
+        p = str(tmp_path / "t.parquet")
+        pq.write_table(t, p, row_group_size=100)
+        df = session.read_parquet(p, filters=[("a", "<", 150)])
+        out = df.collect()
+        assert out.num_rows <= 200  # row-group pruned
+        assert max(out.column("a").to_pylist()) < 200
+
+    def test_scan_then_query(self, session, gen_tbl, tmp_path):
+        p = str(tmp_path / "t.parquet")
+        pq.write_table(gen_tbl, p)
+        q = session.read_parquet(p).filter(col("i32") > 0) \
+            .group_by("b").agg(n=Count(), s=Sum(col("i64")))
+        tpu = q.collect().sort_by([("b", "ascending")])
+        cpu = q.collect_cpu().sort_by([("b", "ascending")])
+        assert tpu.equals(cpu)
+
+
+class TestCsvJson:
+    def test_csv_roundtrip(self, session, tmp_path):
+        t = pa.table({"a": pa.array([1, 2, None], type=pa.int64()),
+                      "s": pa.array(["x", None, "z"])})
+        p = str(tmp_path / "t.csv")
+        import pyarrow.csv as pacsv
+        pacsv.write_csv(t, p)
+        df = session.read_csv(p)
+        out = df.collect()
+        assert out.column("a").to_pylist() == [1, 2, None]
+
+    def test_json_roundtrip(self, session, tmp_path):
+        p = str(tmp_path / "t.jsonl")
+        with open(p, "w") as f:
+            f.write('{"a": 1, "s": "x"}\n{"a": null, "s": "y"}\n')
+        df = session.read_json(p)
+        out = df.collect()
+        assert out.column("a").to_pylist() == [1, None]
+        assert out.column("s").to_pylist() == ["x", "y"]
+
+
+class TestOrc:
+    def test_orc_roundtrip(self, session, tmp_path):
+        t = pa.table({"a": pa.array([1, None, 3], type=pa.int64()),
+                      "s": pa.array(["x", "y", None])})
+        p = str(tmp_path / "t.orc")
+        from pyarrow import orc
+        orc.write_table(t, p)
+        df = session.read_orc(p)
+        out = df.collect()
+        assert out.column("a").to_pylist() == [1, None, 3]
+
+
+class TestWriter:
+    def test_write_parquet_roundtrip(self, session, gen_tbl, tmp_path):
+        df = session.from_arrow(gen_tbl)
+        out_dir = str(tmp_path / "out")
+        stats = df.write_parquet(out_dir)
+        assert stats.num_files == 1 and stats.num_rows == 500
+        back = session.read_parquet(
+            *[os.path.join(out_dir, f) for f in os.listdir(out_dir)])
+        assert back.collect().num_rows == 500
+
+    def test_partitioned_write(self, session, tmp_path):
+        t = pa.table({"k": pa.array(["a", "b", "a", None]),
+                      "v": pa.array([1, 2, 3, 4], type=pa.int64())})
+        out_dir = str(tmp_path / "part")
+        stats = session.from_arrow(t).write_parquet(out_dir,
+                                                    partition_by=["k"])
+        assert stats.num_files == 3
+        assert sorted(os.listdir(out_dir)) == \
+            ["k=__HIVE_DEFAULT_PARTITION__", "k=a", "k=b"]
+        sub = pq.read_table(os.path.join(out_dir, "k=a"))
+        assert sorted(sub.column("v").to_pylist()) == [1, 3]
+
+    def test_write_mode_error(self, session, tmp_path):
+        t = pa.table({"v": pa.array([1], type=pa.int64())})
+        out_dir = str(tmp_path / "dup")
+        session.from_arrow(t).write_parquet(out_dir)
+        with pytest.raises(FileExistsError):
+            session.from_arrow(t).write_parquet(out_dir)
+        session.from_arrow(t).write_parquet(out_dir, mode="overwrite")
+
+
+class TestReviewRegressions:
+    def test_csv_pruning_and_schema(self, session, tmp_path):
+        import pyarrow.csv as pacsv
+        t = pa.table({"a": pa.array([1, 2], type=pa.int64()),
+                      "b": pa.array(["x", "y"]),
+                      "c": pa.array([1.5, 2.5], type=pa.float64())})
+        p = str(tmp_path / "t.csv")
+        pacsv.write_csv(t, p)
+        out = session.read_csv(p, columns=["a"]).collect()
+        assert out.schema.names == ["a"]
+        assert out.column("a").to_pylist() == [1, 2]
+
+    def test_csv_headerless_schema(self, session, tmp_path):
+        from spark_rapids_tpu.columnar import Schema
+        from spark_rapids_tpu import types as T
+        p = str(tmp_path / "nh.csv")
+        with open(p, "w") as f:
+            f.write("007,foo\n042,bar\n")
+        schema = Schema(("code", "name"), (T.STRING, T.STRING))
+        out = session.read_csv(p, header=False, schema=schema).collect()
+        assert out.column("code").to_pylist() == ["007", "042"]  # stays string
+
+    def test_csv_timestamp_normalized(self, session, tmp_path):
+        p = str(tmp_path / "ts.csv")
+        with open(p, "w") as f:
+            f.write("ts\n2023-11-14T22:13:20Z\n")
+        out = session.read_csv(p).collect()
+        v = out.column("ts").to_pylist()[0]
+        assert v.year == 2023 and v.hour == 22 and v.second == 20
+
+    def test_coalescing_all_empty(self, session, tmp_path):
+        t = pa.table({"a": pa.array([], type=pa.int64())})
+        paths = []
+        for i in range(2):
+            p = str(tmp_path / f"e{i}.parquet")
+            pq.write_table(t, p)
+            paths.append(p)
+        out = session.read_parquet(*paths).collect()
+        assert out.num_rows == 0 and out.schema.names == ["a"]
+
+    def test_write_mode_ignore_and_bad_mode(self, session, tmp_path):
+        t = pa.table({"v": pa.array([1], type=pa.int64())})
+        out_dir = str(tmp_path / "ig")
+        session.from_arrow(t).write_parquet(out_dir)
+        stats = session.from_arrow(t).write_parquet(out_dir, mode="ignore")
+        assert stats.num_files == 0
+        with pytest.raises(ValueError, match="unknown write mode"):
+            session.from_arrow(t).write_parquet(out_dir, mode="overwite")
+
+    def test_per_format_reader_type_key(self, session):
+        session.conf.set("spark.rapids.sql.format.orc.reader.type", "PERFILE")
+        from spark_rapids_tpu.io.multifile import choose_reader_type
+        assert choose_reader_type(["a.orc", "b.orc"], session.conf,
+                                  "orc") == "PERFILE"
+        assert choose_reader_type(["a.pq", "b.pq"], session.conf,
+                                  "parquet") == "COALESCING"
