@@ -83,6 +83,50 @@ class DataFrame:
     def union(self, other: "DataFrame") -> "DataFrame":
         return DataFrame(self.session, N.CpuUnionExec([self.plan, other.plan]))
 
+    def repartition(self, num_partitions: int,
+                    *keys: Union[str, Expression]) -> "DataFrame":
+        """Partitioned exchange: hash by keys, or round-robin with no keys
+        (matching Spark's df.repartition). Non-column key expressions are
+        projected into temp columns around the exchange, like Spark's planner
+        does before hash partitioning."""
+        if not keys:
+            spec = N.RoundRobinPartitionSpec(num_partitions)
+            return DataFrame(self.session,
+                             N.CpuShuffleExchangeExec(spec, self.plan))
+        key_exprs = [_as_expr(k) for k in keys]
+        if all(isinstance(k, AttributeReference) for k in key_exprs):
+            spec = N.HashPartitionSpec(key_exprs, num_partitions)
+            return DataFrame(self.session,
+                             N.CpuShuffleExchangeExec(spec, self.plan))
+        from .expr.base import Alias
+        orig = [AttributeReference(n) for n in self.schema.names]
+        tmp_names, proj = [], list(orig)
+        for i, k in enumerate(key_exprs):
+            if isinstance(k, AttributeReference):
+                tmp_names.append(k.col_name)
+            else:
+                name = f"__part_key_{i}"
+                tmp_names.append(name)
+                proj.append(Alias(k, name))
+        pre = N.CpuProjectExec(proj, self.plan)
+        spec = N.HashPartitionSpec([AttributeReference(n) for n in tmp_names],
+                                   num_partitions)
+        exch = N.CpuShuffleExchangeExec(spec, pre)
+        post = N.CpuProjectExec(orig, exch)
+        return DataFrame(self.session, post)
+
+    def repartition_by_range(self, num_partitions: int,
+                             key: Union[str, Expression],
+                             ascending: bool = True) -> "DataFrame":
+        spec = N.RangePartitionSpec(_as_expr(key), num_partitions, ascending,
+                                    nulls_first=ascending)
+        return DataFrame(self.session, N.CpuShuffleExchangeExec(spec,
+                                                                self.plan))
+
+    def coalesce_partitions(self) -> "DataFrame":
+        return DataFrame(self.session, N.CpuShuffleExchangeExec(None,
+                                                                self.plan))
+
     def collect(self):
         """Execute and return a pyarrow Table."""
         return self.session.execute_plan(self.plan)

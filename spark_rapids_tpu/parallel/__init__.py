@@ -1,0 +1,26 @@
+"""Distributed execution layer — the TPU-native counterpart of the reference's
+shuffle & transport stack (SURVEY.md §2.7).
+
+Where the reference moves partitioned batches between executor JVMs over UCX/RDMA
+(`shuffle-plugin/.../UCX.scala`) or host-serialized Spark shuffle
+(`RapidsShuffleInternalManagerBase.scala`), this layer moves them between TPU chips
+with XLA collectives over ICI: partitioned exchange is a `shard_map`-wrapped
+`lax.all_to_all` over a `jax.sharding.Mesh`; broadcast replication is `all_gather`.
+Variable partition sizes ride the fixed-capacity slot discipline (pad-and-slice,
+ARCHITECTURE.md #1) so everything stays statically shaped for XLA.
+"""
+
+from .partitioning import (HashPartitioning, RangePartitioning,
+                           RoundRobinPartitioning, SinglePartitioning,
+                           TpuPartitioning)
+from .mesh import make_mesh, mesh_devices
+from .collective import (all_to_all_exchange, broadcast_all_gather,
+                         bucketize_by_partition, compact_received)
+
+__all__ = [
+    "TpuPartitioning", "HashPartitioning", "RangePartitioning",
+    "RoundRobinPartitioning", "SinglePartitioning",
+    "make_mesh", "mesh_devices",
+    "all_to_all_exchange", "broadcast_all_gather", "bucketize_by_partition",
+    "compact_received",
+]

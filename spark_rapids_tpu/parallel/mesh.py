@@ -1,0 +1,40 @@
+"""Device mesh construction.
+
+The reference discovers peers through executor heartbeats with the driver
+(`RapidsShuffleHeartbeatManager.scala`, `Plugin.scala:227-239`) because executors are
+independent JVMs. On TPU the topology is declared, not discovered: a
+`jax.sharding.Mesh` over the slice's chips, with ICI links between neighbours. One
+1-D "shuffle" axis covers partitioned exchange (all-to-all) and broadcast
+(all_gather); multi-host slices extend the same mesh over DCN transparently via
+jax.distributed — the collective compiles identically.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+SHUFFLE_AXIS = "shuffle"
+
+
+def mesh_devices(n_devices: Optional[int] = None) -> Sequence[jax.Device]:
+    devs = jax.devices()
+    if n_devices is None:
+        return devs
+    if n_devices > len(devs):
+        raise ValueError(
+            f"requested {n_devices} devices, only {len(devs)} present "
+            f"(hint: tests use xla_force_host_platform_device_count)")
+    return devs[:n_devices]
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              axis: str = SHUFFLE_AXIS) -> Mesh:
+    """1-D mesh over the slice for partitioned exchange. On a real pod the device
+    order from jax.devices() follows the physical torus so neighbouring mesh
+    positions are ICI neighbours."""
+    devs = mesh_devices(n_devices)
+    return Mesh(np.array(devs), (axis,))

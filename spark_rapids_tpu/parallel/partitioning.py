@@ -1,0 +1,163 @@
+"""TPU partitioners — per-row partition-id kernels.
+
+Counterpart of the reference's GPU partitioners (`GpuHashPartitioningBase.scala`,
+`GpuRangePartitioner.scala`, `GpuRoundRobinPartitioning.scala`,
+`GpuSinglePartitioning.scala`; registered at `GpuOverrides.scala:3536-3575`). Each
+partitioner maps a device batch to an int32 partition id per row; the exchange layer
+then either slices locally (host path) or buckets + all-to-alls (ICI path).
+
+Hash placement must match CPU Spark exactly (same rows land in the same partition)
+— that is what makes differential testing of distributed plans possible — so
+HashPartitioning uses the Spark-exact Murmur3 from expr/hashing.py with Spark's
+seed 42 and pmod semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .. import types as T
+from ..columnar.batch import ColumnarBatch
+from ..expr.base import EvalContext, Expression, Vec, bind_references
+from ..expr.hashing import hash_vecs
+
+__all__ = ["TpuPartitioning", "HashPartitioning", "RangePartitioning",
+           "RoundRobinPartitioning", "SinglePartitioning"]
+
+
+class TpuPartitioning:
+    """Base partitioner: produce int32 partition ids for every row of a batch.
+
+    Rows past the logical count (padding) get id -1 so downstream bucketing can
+    drop them without consulting the row mask again."""
+
+    num_partitions: int
+
+    def partition_ids(self, xp, vecs: Sequence[Vec], row_mask):
+        raise NotImplementedError
+
+    def ids_for_batch(self, xp, batch: ColumnarBatch):
+        vecs = [Vec.from_column(c) for c in batch.columns]
+        mask = batch.row_mask()
+        pid = self.partition_ids(xp, vecs, mask)
+        return xp.where(mask, pid, xp.asarray(-1, np.int32))
+
+
+def _pmod(xp, h, n: int):
+    """Spark pmod: ((h % n) + n) % n on int32."""
+    r = h.astype(np.int32) % np.int32(n)
+    return ((r + np.int32(n)) % np.int32(n)).astype(np.int32)
+
+
+@dataclasses.dataclass
+class HashPartitioning(TpuPartitioning):
+    """pmod(murmur3(keys, seed=42), n) — `GpuHashPartitioningBase.scala` semantics
+    (which mirror Spark's HashPartitioning over Murmur3Hash(exprs, 42))."""
+
+    key_ordinals: Sequence[int]
+    num_partitions: int
+
+    def partition_ids(self, xp, vecs, row_mask):
+        keys = [vecs[i] for i in self.key_ordinals]
+        h = hash_vecs(xp, keys, np.uint32(42))
+        return _pmod(xp, h.astype(np.int32), self.num_partitions)
+
+    @staticmethod
+    def from_exprs(keys: Sequence[Expression], schema, num_partitions: int
+                   ) -> "HashPartitioning":
+        from ..expr.base import BoundReference
+        ords = []
+        for k in keys:
+            b = bind_references(k, schema)
+            if not isinstance(b, BoundReference):
+                raise ValueError("partition keys must be column references "
+                                 "after planning (planner projects first)")
+            ords.append(b.ordinal)
+        return HashPartitioning(tuple(ords), num_partitions)
+
+    def __repr__(self):
+        return f"hashpartitioning({list(self.key_ordinals)}, {self.num_partitions})"
+
+
+@dataclasses.dataclass
+class RangePartitioning(TpuPartitioning):
+    """Range partitioning against precomputed bounds (`GpuRangePartitioner.scala`:
+    bounds come from driver-side sampling, the device does a vectorized
+    searchsorted). Single sort column, ascending/descending + nulls-first, which
+    covers Spark's common ORDER BY exchange."""
+
+    ordinal: int
+    bounds: np.ndarray          # ascending upper bounds, len n_parts - 1
+    ascending: bool = True
+    nulls_first: bool = True
+
+    def __post_init__(self):
+        self.num_partitions = len(self.bounds) + 1
+
+    def partition_ids(self, xp, vecs, row_mask):
+        v = vecs[self.ordinal]
+        if v.is_string:
+            raise TypeError("range partitioning on STRING is not supported on "
+                            "device (planner falls back to CPU)")
+        data = v.data
+        bounds = xp.asarray(self.bounds)
+        pid = xp.searchsorted(bounds, data, side="right").astype(np.int32)
+        if not self.ascending:
+            pid = np.int32(self.num_partitions - 1) - pid
+        null_pid = np.int32(0 if self.nulls_first else self.num_partitions - 1)
+        return xp.where(v.validity, pid, null_pid)
+
+    @staticmethod
+    def from_sample(vec_np: Vec, ordinal: int, num_partitions: int,
+                    ascending: bool = True, nulls_first: bool = True
+                    ) -> "RangePartitioning":
+        """Driver-side bound computation from a host sample (the reference samples
+        via Spark's RangePartitioner then evaluates bounds on device)."""
+        if vec_np.is_string:
+            raise TypeError("range partitioning on STRING is not supported on "
+                            "device (planner falls back to CPU)")
+        data = np.asarray(vec_np.data)[np.asarray(vec_np.validity)]
+        if data.size == 0:
+            bounds = np.zeros(max(num_partitions - 1, 0), dtype=data.dtype)
+        else:
+            qs = np.linspace(0, 1, num_partitions + 1)[1:-1]
+            bounds = np.asarray(np.quantile(data, qs, method="nearest"),
+                                dtype=data.dtype)
+        return RangePartitioning(ordinal, bounds, ascending, nulls_first)
+
+    def __repr__(self):
+        return f"rangepartitioning(col{self.ordinal}, {self.num_partitions})"
+
+
+@dataclasses.dataclass
+class RoundRobinPartitioning(TpuPartitioning):
+    """(start + row_position) % n (`GpuRoundRobinPartitioning.scala`). start is
+    chosen per input partition like Spark picks a random start per task; fixed
+    here for determinism under the differential harness."""
+
+    num_partitions: int
+    start: int = 0
+
+    def partition_ids(self, xp, vecs, row_mask):
+        n = row_mask.shape[0]
+        pos = xp.arange(n, dtype=np.int32)
+        return (np.int32(self.start) + pos) % np.int32(self.num_partitions)
+
+    def __repr__(self):
+        return f"roundrobin({self.num_partitions})"
+
+
+@dataclasses.dataclass
+class SinglePartitioning(TpuPartitioning):
+    """Everything to partition 0 (`GpuSinglePartitioning.scala`)."""
+
+    num_partitions: int = 1
+
+    def partition_ids(self, xp, vecs, row_mask):
+        return xp.zeros(row_mask.shape[0], np.int32)
+
+    def __repr__(self):
+        return "singlepartitioning"

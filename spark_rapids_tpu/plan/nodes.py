@@ -551,6 +551,44 @@ class CpuExpandExec(PhysicalPlan):
                 yield HostBatch(self._schema, vecs, b.num_rows)
 
 
+@dataclasses.dataclass
+class HashPartitionSpec:
+    """Plan-level partitioning descriptors (Spark's Partitioning expressions).
+    Lowered to device partitioners by exec/exchange.make_partitioner."""
+    keys: List[Any]
+    num_partitions: int
+
+    def __repr__(self):
+        return f"hashpartitioning({self.keys}, {self.num_partitions})"
+
+
+@dataclasses.dataclass
+class RangePartitionSpec:
+    key: Any
+    num_partitions: int
+    ascending: bool = True
+    nulls_first: bool = True
+
+    def __repr__(self):
+        return f"rangepartitioning({self.key}, {self.num_partitions})"
+
+
+@dataclasses.dataclass
+class RoundRobinPartitionSpec:
+    num_partitions: int
+
+    def __repr__(self):
+        return f"roundrobinpartitioning({self.num_partitions})"
+
+
+@dataclasses.dataclass
+class SinglePartitionSpec:
+    num_partitions: int = 1
+
+    def __repr__(self):
+        return "singlepartitioning"
+
+
 class CpuShuffleExchangeExec(PhysicalPlan):
     """Partitioned exchange boundary. CPU engine is single-stream so this is a
     pass-through marker; the TPU conversion lowers it to the shuffle manager."""

@@ -264,11 +264,35 @@ def _c_expand(plan, children, conf):
     return TpuExpandExec(plan.projections, plan.output.names, children[0], conf)
 
 
+def _tag_exchange(m: PlanMeta):
+    from .. import types as T
+    from ..expr.base import AttributeReference
+    spec = m.plan.partitioning
+    if spec is None:
+        return
+    if isinstance(spec, N.RangePartitionSpec):
+        if not isinstance(spec.key, AttributeReference):
+            m.will_not_work("range partition key must be a column reference")
+            return
+        schema = m.plan.children[0].output
+        if isinstance(schema.types[schema.index_of(spec.key.col_name)],
+                      T.StringType):
+            m.will_not_work("range partitioning on STRING not supported on "
+                            "device")
+    elif isinstance(spec, N.HashPartitionSpec):
+        for k in spec.keys:
+            if not isinstance(k, AttributeReference):
+                m.will_not_work("hash partition keys must be column "
+                                "references (project them first)")
+
+
 def _c_exchange(plan, children, conf):
     from ..exec.coalesce import TpuCoalesceBatchesExec
-    # local mode: the exchange boundary becomes a coalesce; the shuffle manager
-    # lowers this to partitioned exchange in distributed plans (shuffle/)
-    return TpuCoalesceBatchesExec(children[0], conf=conf)
+    from ..exec.exchange import TpuShuffleExchangeExec
+    if plan.partitioning is None:
+        # bare exchange boundary: becomes a coalesce locally
+        return TpuCoalesceBatchesExec(children[0], conf=conf)
+    return TpuShuffleExchangeExec(plan.partitioning, children[0], conf=conf)
 
 
 def _c_file_scan(plan, children, conf):
@@ -302,7 +326,8 @@ exec_rule(N.CpuUnionExec, TypeSig.all_basic(), _c_union)
 exec_rule(N.CpuRangeExec, TypeSig.all_basic(), _c_range)
 exec_rule(N.CpuExpandExec, TypeSig.all_basic(), _c_expand,
           expr_fn=_exprs_expand)
-exec_rule(N.CpuShuffleExchangeExec, TypeSig.all_basic(), _c_exchange)
+exec_rule(N.CpuShuffleExchangeExec, TypeSig.all_basic(), _c_exchange,
+          tag_fn=_tag_exchange)
 _register_file_scan_rules()
 
 

@@ -35,6 +35,9 @@ STREAM_TIME = "streamTime"
 SPILL_TIME = "spillTime"
 READ_TIME = "readTime"
 WRITE_TIME = "writeTime"
+PARTITION_TIME = "partitionTime"
+WINDOW_TIME = "windowTime"
+BROADCAST_TIME = "broadcastTime"
 SEMAPHORE_WAIT_TIME = "semaphoreWaitTime"
 PEAK_DEVICE_MEMORY = "peakDevMemory"
 NUM_PARTITIONS = "numPartitions"
