@@ -58,11 +58,10 @@ def make_partitioner(spec, schema: Schema,
         if sample_batch is None:
             raise ValueError("range partitioning needs a sample batch")
         col = sample_batch.columns[b.ordinal]
-        vec = Vec(col.dtype, np.asarray(col.data), np.asarray(col.validity),
-                  None if col.lengths is None else np.asarray(col.lengths))
         n = int(sample_batch.row_count())
-        vec = Vec(vec.dtype, vec.data[:n], vec.validity[:n],
-                  None if vec.lengths is None else vec.lengths[:n])
+        v = Vec.from_column(col)
+        vec = Vec(v.dtype, np.asarray(v.data)[:n], np.asarray(v.validity)[:n],
+                  None if v.lengths is None else np.asarray(v.lengths)[:n])
         return RangePartitioning.from_sample(vec, b.ordinal,
                                              spec.num_partitions,
                                              spec.ascending, spec.nulls_first)
@@ -90,8 +89,10 @@ class TpuShuffleExchangeExec(UnaryTpuExec):
         n_parts = part.num_partitions
         with self.partition_time.timed():
             pid = part.ids_for_batch(jnp, batch)
-            slices = [_slice_partition(batch, pid, p) for p in range(n_parts)]
-        for out in slices:
+        # lazy per-partition slicing bounds live memory at input + one slice
+        for p in range(n_parts):
+            with self.partition_time.timed():
+                out = _slice_partition(batch, pid, p)
             if int(out.row_count()) == 0 and n_parts > 1:
                 continue
             self.num_output_rows.add(out.row_count())

@@ -89,6 +89,9 @@ class DataFrame:
         (matching Spark's df.repartition). Non-column key expressions are
         projected into temp columns around the exchange, like Spark's planner
         does before hash partitioning."""
+        if num_partitions < 1:
+            raise ValueError(
+                f"num_partitions must be positive, got {num_partitions}")
         if not keys:
             spec = N.RoundRobinPartitionSpec(num_partitions)
             return DataFrame(self.session,
@@ -118,6 +121,9 @@ class DataFrame:
     def repartition_by_range(self, num_partitions: int,
                              key: Union[str, Expression],
                              ascending: bool = True) -> "DataFrame":
+        if num_partitions < 1:
+            raise ValueError(
+                f"num_partitions must be positive, got {num_partitions}")
         spec = N.RangePartitionSpec(_as_expr(key), num_partitions, ascending,
                                     nulls_first=ascending)
         return DataFrame(self.session, N.CpuShuffleExchangeExec(spec,

@@ -61,9 +61,12 @@ def bucketize_by_partition(leaves: Sequence[Any], pid, ndev: int,
                            slot_cap: int):
     """Group rows by destination into [ndev, slot_cap, ...] slot buffers.
 
-    pid is int32[cap] with -1 marking padding rows. Returns (slotted_leaves,
-    send_counts[int32[ndev]]). Rows beyond slot_cap for one destination drop
-    (callers choose slot_cap to make that impossible or detect via counts)."""
+    pid is int32[cap] with -1 marking padding rows and values REQUIRED to be in
+    [-1, ndev): a partitioner built for more partitions than mesh devices would
+    silently lose its out-of-range rows here, so callers must size the
+    partitioner to the mesh. Returns (slotted_leaves, send_counts[int32[ndev]]).
+    Rows beyond slot_cap for one destination drop (callers choose slot_cap to
+    make that impossible or detect via counts)."""
     cap = pid.shape[0]
     valid = pid >= 0
     key = jnp.where(valid, pid, ndev)
