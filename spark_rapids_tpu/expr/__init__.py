@@ -22,6 +22,9 @@ from .hashing import Murmur3Hash, hash_vecs  # noqa: F401
 from .cast import Cast, device_supported as cast_device_supported  # noqa: F401
 from .aggregates import (AggregateFunction, Sum, Count, Min, Max, Average,  # noqa: F401
                          First, Last, CountDistinct)
+from .windowexprs import (RowFrame, RangeFrame, WindowFunction, RowNumber,  # noqa: F401
+                          Rank, DenseRank, PercentRank, CumeDist, NTile, Lead,
+                          Lag, WindowAggregate)
 
 
 def col(name):  # convenience constructors for tests / DataFrame API

@@ -83,6 +83,33 @@ class DataFrame:
     def union(self, other: "DataFrame") -> "DataFrame":
         return DataFrame(self.session, N.CpuUnionExec([self.plan, other.plan]))
 
+    def window(self, partition_by=(), order_by=(),
+               **named_fns) -> "DataFrame":
+        """Append window-function columns. `partition_by`: columns/exprs;
+        `order_by`: columns or (expr, ascending, nulls_first) tuples; named
+        values are WindowFunction instances or AggregateFunctions (wrapped in
+        the Spark-default frame). Output rows come back sorted by
+        (partition, order)."""
+        from .expr.windowexprs import WindowAggregate, WindowFunction
+        part = [_as_expr(p) for p in partition_by]
+        orders = []
+        for o in order_by:
+            if isinstance(o, tuple):
+                e, asc, nf = o
+                orders.append((_as_expr(e), asc, nf))
+            else:
+                orders.append((_as_expr(o), True, True))
+        fns = []
+        for name, f in named_fns.items():
+            if isinstance(f, AggregateFunction):
+                f = WindowAggregate(f)
+            if not isinstance(f, WindowFunction):
+                raise TypeError(f"{name}: expected a window/aggregate "
+                                f"function, got {type(f).__name__}")
+            fns.append((f, name))
+        return DataFrame(self.session,
+                         N.CpuWindowExec(fns, part, orders, self.plan))
+
     def repartition(self, num_partitions: int,
                     *keys: Union[str, Expression]) -> "DataFrame":
         """Partitioned exchange: hash by keys, or round-robin with no keys

@@ -105,21 +105,32 @@ def sort_batch_vecs(xp, vecs: Sequence[Vec], sort_cols: Sequence[int],
     return gather_vecs(xp, vecs, order)
 
 
+def key_change_flags(xp, key_vecs: Sequence[Vec], n: int):
+    """True at rows whose key values differ from the previous row (row 0 is
+    False). Spark equality semantics: two nulls are equal (garbage data under
+    null slots must not split groups), two NaNs are equal."""
+    change = xp.zeros(n, dtype=bool)
+    for v in key_vecs:
+        both_valid = v.validity[1:] & v.validity[:-1]
+        if v.is_string:
+            d = v.data
+            neq = xp.any(d[1:] != d[:-1], axis=1) | \
+                (v.lengths[1:] != v.lengths[:-1])
+        else:
+            neq = v.data[1:] != v.data[:-1]
+            if np.issubdtype(np.dtype(v.data.dtype), np.floating):
+                neq = neq & ~(xp.isnan(v.data[1:]) & xp.isnan(v.data[:-1]))
+        neq = (neq & both_valid) | (v.validity[1:] != v.validity[:-1])
+        change = change | xp.concatenate([xp.zeros(1, dtype=bool), neq])
+    return change
+
+
 def group_ids_from_sorted(xp, key_vecs: Sequence[Vec], row_mask):
     """After sorting by keys, compute (group_id[cap], num_groups, starts_mask).
     Padding rows get group_id == cap-1 sentinel region handled by callers via
     row_mask."""
     n = row_mask.shape[0]
-    change = xp.zeros(n, dtype=bool)
-    for v in key_vecs:
-        if v.is_string:
-            d = v.data
-            neq = xp.any(d[1:] != d[:-1], axis=1) | (v.lengths[1:] != v.lengths[:-1])
-        else:
-            neq = v.data[1:] != v.data[:-1]
-        neq = neq | (v.validity[1:] != v.validity[:-1])
-        change = change | xp.concatenate(
-            [xp.zeros(1, dtype=bool), neq])
+    change = key_change_flags(xp, key_vecs, n)
     starts = change | (xp.arange(n) == 0)
     starts = starts & row_mask
     # rows beyond the live region belong to no group

@@ -551,6 +551,302 @@ class CpuExpandExec(PhysicalPlan):
                 yield HostBatch(self._schema, vecs, b.num_rows)
 
 
+class CpuWindowExec(PhysicalPlan):
+    """CPU oracle for window functions: sort by (partition, order), then brute-
+    force per-partition loops. Deliberately O(n*frame) python/numpy — an
+    independent oracle for the device's scan-based kernels (the role CPU Spark
+    plays for `GpuWindowExec.scala`)."""
+
+    def __init__(self, window_exprs: Sequence[Tuple[Any, str]],
+                 partition_spec: Sequence[Expression],
+                 order_spec: Sequence[Tuple[Expression, bool, bool]],
+                 child: PhysicalPlan):
+        super().__init__([child])
+        self.window_exprs = list(window_exprs)
+        self.partition_spec = list(partition_spec)
+        self.order_spec = list(order_spec)
+        self._bound_part = [bind_references(e, child.output)
+                            for e in self.partition_spec]
+        self._bound_order = [(bind_references(e, child.output), a, nf)
+                             for e, a, nf in self.order_spec]
+        from ..expr.windowexprs import WindowAggregate, bind_window_fn
+        self._bound_fns = [(bind_window_fn(f, child.output), name)
+                           for f, name in self.window_exprs]
+        for f, name in self._bound_fns:
+            if isinstance(f, WindowAggregate) and f.func.child is not None \
+                    and type(f.func).__name__ in ("Sum", "Average") \
+                    and isinstance(f.func.child.data_type, T.StringType):
+                raise TypeError(
+                    f"window column {name}: {type(f.func).__name__} over "
+                    "STRING is invalid")
+        co = child.output
+        names = co.names + tuple(n for _, n in self.window_exprs)
+        tps = co.types + tuple(f.data_type for f, _ in self._bound_fns)
+        self._schema = Schema(names, tps)
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def execute_cpu(self):
+        from ..ops.rowops import gather_vecs, lexsort_indices, sort_keys_for
+        b = _concat_host(list(self.children[0].execute_cpu()),
+                         self.children[0].output)
+        n = b.num_rows
+        ctx = _ctx(n)
+        part_vecs = [e.eval(ctx, b.vecs) for e in self._bound_part]
+        order_vecs = [(e.eval(ctx, b.vecs), a, nf)
+                      for e, a, nf in self._bound_order]
+        groups = [sort_keys_for(np, v, True, True) for v in part_vecs]
+        groups += [sort_keys_for(np, v, a, nf) for v, a, nf in order_vecs]
+        perm = lexsort_indices(np, groups, n) if groups else np.arange(n)
+        svecs = gather_vecs(np, b.vecs, perm)
+        sorder_vecs = gather_vecs(np, [v for v, _, _ in order_vecs], perm)
+        spart = _key_bytes(gather_vecs(np, part_vecs, perm), n)
+        sorder = _key_bytes(sorder_vecs, n)
+
+        # partition boundaries
+        part_start = np.ones(n, dtype=bool)
+        if n:
+            part_start[1:] = np.any(spart[1:] != spart[:-1], axis=1) \
+                if spart.shape[1] else False
+            part_start[0] = True
+        peer_start = part_start.copy()
+        if n and sorder.shape[1]:
+            peer_start[1:] |= np.any(sorder[1:] != sorder[:-1], axis=1)
+        starts = np.nonzero(part_start)[0]
+        bounds = list(starts) + [n]
+
+        out_vecs = list(svecs)
+        sctx = _ctx(n)
+        for fn, name in self._bound_fns:
+            out_vecs.append(self._eval_fn(fn, sctx, svecs, n, bounds,
+                                          peer_start, sorder_vecs))
+        yield HostBatch(self._schema, out_vecs, n)
+
+    def _eval_fn(self, fn, ctx, svecs, n, bounds, peer_start,
+                 sorder_vecs) -> Vec:
+        from ..expr.windowexprs import (CumeDist, DenseRank, Lag, Lead, NTile,
+                                        PercentRank, RangeFrame, Rank,
+                                        RowFrame, RowNumber, WindowAggregate,
+                                        default_frame)
+        parts = [(bounds[i], bounds[i + 1]) for i in range(len(bounds) - 1)]
+        if isinstance(fn, RowNumber):
+            data = np.zeros(n, np.int32)
+            for lo, hi in parts:
+                data[lo:hi] = np.arange(1, hi - lo + 1)
+            return Vec(T.INT, data, np.ones(n, bool))
+        if isinstance(fn, (Rank, DenseRank, PercentRank, CumeDist)):
+            rank = np.zeros(n, np.int64)
+            dense = np.zeros(n, np.int64)
+            cnt = np.zeros(n, np.int64)
+            peer_cnt = np.zeros(n, np.int64)
+            for lo, hi in parts:
+                r = d = 0
+                for i in range(lo, hi):
+                    if peer_start[i] or i == lo:
+                        r = i - lo + 1
+                        d += 1
+                    rank[i] = r
+                    dense[i] = d
+                cnt[lo:hi] = hi - lo
+                # rows <= last peer of i (for cume_dist)
+                j = lo
+                while j < hi:
+                    k = j + 1
+                    while k < hi and not peer_start[k]:
+                        k += 1
+                    peer_cnt[j:k] = k - lo
+                    j = k
+            if isinstance(fn, Rank):
+                return Vec(T.INT, rank.astype(np.int32), np.ones(n, bool))
+            if isinstance(fn, DenseRank):
+                return Vec(T.INT, dense.astype(np.int32), np.ones(n, bool))
+            if isinstance(fn, PercentRank):
+                denom = np.maximum(cnt - 1, 1)
+                out = np.where(cnt > 1, (rank - 1) / denom, 0.0)
+                return Vec(T.DOUBLE, out.astype(np.float64), np.ones(n, bool))
+            return Vec(T.DOUBLE, (peer_cnt / np.maximum(cnt, 1))
+                       .astype(np.float64), np.ones(n, bool))
+        if isinstance(fn, NTile):
+            data = np.zeros(n, np.int32)
+            for lo, hi in parts:
+                c = hi - lo
+                q, r = divmod(c, fn.buckets)
+                for i in range(lo, hi):
+                    rn = i - lo  # 0-based
+                    if q == 0:
+                        data[i] = rn + 1
+                    elif rn < r * (q + 1):
+                        data[i] = rn // (q + 1) + 1
+                    else:
+                        data[i] = r + (rn - r * (q + 1)) // q + 1
+            return Vec(T.INT, data, np.ones(n, bool))
+        if isinstance(fn, (Lead, Lag)):
+            v = fn.children[0].eval(ctx, svecs)
+            off = fn.offset if isinstance(fn, Lead) else -fn.offset
+            idx = np.arange(n) + off
+            part_id = np.cumsum(np.isin(np.arange(n), bounds[:-1])) - 1
+            in_range = (idx >= 0) & (idx < n)
+            safe = np.where(in_range, idx, 0)
+            same = in_range & (part_id[safe] == part_id)
+            data = _take_np(v.data, safe)
+            valid = v.validity[safe] & same
+            lens = None if v.lengths is None else v.lengths[safe]
+            if fn.default is not None:
+                from .. import types as TT
+                dv = fn.default
+                if isinstance(v.dtype, TT.StringType):
+                    enc = dv.encode("utf-8")
+                    w = max(v.data.shape[1], len(enc))
+                    if w > v.data.shape[1]:
+                        data = np.pad(data, ((0, 0), (0, w - v.data.shape[1])))
+                    drow = np.zeros(w, np.uint8)
+                    drow[:len(enc)] = np.frombuffer(enc, np.uint8)
+                    data = np.where(same[:, None], data, drow)
+                    lens = np.where(same, lens, len(enc)).astype(np.int32)
+                else:
+                    data = np.where(same, data, v.data.dtype.type(dv))
+                valid = np.where(same, valid, True)
+            return Vec(v.dtype, data, valid, lens)
+        if isinstance(fn, WindowAggregate):
+            frame = fn.frame or default_frame(bool(self.order_spec))
+            func = fn.func
+            child = func.child
+            v = child.eval(ctx, svecs) if child is not None else None
+            out_t = func.data_type
+            out_np = out_t.np_dtype
+            data = np.zeros(n, out_np)
+            valid = np.zeros(n, bool)
+            # string scratch only when the RESULT is a string (min/max/first/
+            # last over strings) — Count over a string column yields LONG
+            slens = sdata = None
+            if v is not None and v.is_string and isinstance(out_t, T.StringType):
+                sdata = np.zeros((n, v.data.shape[1]), np.uint8)
+                slens = np.zeros(n, np.int32)
+            is_count = type(func).__name__ == "Count"
+            for lo, hi in parts:
+                for i in range(lo, hi):
+                    flo, fhi = _cpu_frame_bounds(
+                        frame, i, lo, hi, peer_start, sorder_vecs,
+                        self.order_spec)
+                    if fhi < flo:
+                        if is_count:  # COUNT over an empty frame is 0
+                            valid[i] = True
+                        continue
+                    sl = slice(flo, fhi + 1)
+                    r = _cpu_window_agg(func, v, sl)
+                    if r is None:
+                        continue
+                    valid[i] = True
+                    if sdata is not None and isinstance(r, bytes):
+                        sdata[i, :len(r)] = np.frombuffer(r, np.uint8)
+                        slens[i] = len(r)
+                    else:
+                        data[i] = r
+            if sdata is not None:
+                return Vec(v.dtype, sdata, valid, slens)
+            return Vec(out_t, data, valid)
+        raise NotImplementedError(type(fn).__name__)
+
+    def _arg_string(self):
+        return (f"[{[n for _, n in self.window_exprs]}, "
+                f"part={[repr(e) for e in self.partition_spec]}]")
+
+
+def _cpu_frame_bounds(frame, i, lo, hi, peer_start, sorder_vecs, order_spec):
+    """Inclusive (start, end) row indices of the frame for row i."""
+    from ..expr.windowexprs import RangeFrame, RowFrame
+    if isinstance(frame, RowFrame):
+        flo = lo if frame.lower is None else max(lo, i + frame.lower)
+        fhi = hi - 1 if frame.upper is None else min(hi - 1, i + frame.upper)
+        return flo, fhi
+    assert isinstance(frame, RangeFrame)
+    if frame.lower is None and frame.upper is None:
+        return lo, hi - 1
+    if frame.lower is None and frame.upper == 0:
+        # UNBOUNDED PRECEDING .. CURRENT ROW: through the last peer of row i
+        k = i + 1
+        while k < hi and not peer_start[k]:
+            k += 1
+        return lo, k - 1
+    # value-offset range frame: rows whose single numeric order key lies in
+    # [key(i)+lower, key(i)+upper] (Spark restricts these to one order column)
+    if len(sorder_vecs) != 1:
+        raise NotImplementedError(
+            "value-offset RANGE frames require exactly one order column")
+    key = sorder_vecs[0]
+    if key.is_string:
+        raise NotImplementedError(
+            "value-offset RANGE frames need a numeric order column")
+    _, ascending, _ = order_spec[0]
+    if not key.validity[i]:
+        # a null current row frames exactly its null peer group
+        k = i + 1
+        while k < hi and not peer_start[k]:
+            k += 1
+        j = i
+        while j > lo and not peer_start[j]:
+            j -= 1
+        return j, k - 1
+    # frame includes rows at sort-axis delta in [lower, upper]; for descending
+    # order the sort axis is the negated key, so key(j) in [cur-upper, cur-lo]
+    cur = key.data[i]
+    if ascending:
+        lo_v = -np.inf if frame.lower is None else cur + frame.lower
+        hi_v = np.inf if frame.upper is None else cur + frame.upper
+    else:
+        lo_v = -np.inf if frame.upper is None else cur - frame.upper
+        hi_v = np.inf if frame.lower is None else cur - frame.lower
+    flo, fhi = hi, lo - 1  # empty unless a row matches
+    for j in range(lo, hi):
+        if not key.validity[j]:
+            continue
+        v = key.data[j]
+        if lo_v <= v <= hi_v:
+            flo = min(flo, j)
+            fhi = max(fhi, j)
+    return flo, fhi
+
+
+def _cpu_window_agg(func, v, sl):
+    """Aggregate v[sl] (null-skipping; First/Last respect nulls, Spark default);
+    returns python scalar / bytes / None."""
+    name = type(func).__name__
+    if v is None:  # count(*)
+        return sl.stop - sl.start
+    valid = v.validity[sl]
+    if name == "Count":
+        return int(valid.sum())
+    if name in ("First", "Last"):
+        j = sl.start if name == "First" else sl.stop - 1
+        if not v.validity[j]:
+            return None
+        if v.is_string:
+            return bytes(v.data[j, :v.lengths[j]])
+        return v.data[j]
+    if not valid.any():
+        return None
+    if v.is_string:
+        vals = [bytes(v.data[j, :v.lengths[j]])
+                for j in range(sl.start, sl.stop) if v.validity[j]]
+        if name == "Min":
+            return min(vals)
+        if name == "Max":
+            return max(vals)
+        raise NotImplementedError(f"{name} over strings")
+    vals = v.data[sl][valid]
+    if name == "Sum":
+        return vals.sum()
+    if name == "Min":
+        return vals.min()
+    if name == "Max":
+        return vals.max()
+    if name == "Average":
+        return float(vals.astype(np.float64).mean())
+    raise NotImplementedError(name)
+
+
 @dataclasses.dataclass
 class HashPartitionSpec:
     """Plan-level partitioning descriptors (Spark's Partitioning expressions).

@@ -132,6 +132,48 @@ for cls in (Sum, Count, Min, Max, Average, First, Last):
     expr_rule(cls, _basic)
 
 
+def _tag_window_agg(meta: ExprMeta) -> None:
+    from ..expr import windowexprs as WX
+    e: WX.WindowAggregate = meta.expr
+    name = type(e.func).__name__
+    if name not in ("Sum", "Count", "Min", "Max", "Average", "First", "Last"):
+        meta.will_not_work(f"{name} is not supported over a window on TPU")
+        return
+    frame = e.frame
+    if isinstance(frame, WX.RangeFrame) and not (
+            frame.lower is None and frame.upper in (0, None)):
+        meta.will_not_work(
+            "only RANGE UNBOUNDED PRECEDING..CURRENT ROW/UNBOUNDED FOLLOWING "
+            "is supported on TPU (value-offset range frames run on CPU)")
+    bounded = isinstance(frame, WX.RowFrame) and not (
+        frame.lower is None and frame.upper in (0, None))
+    if bounded and name in ("Min", "Max"):
+        meta.will_not_work("bounded-frame MIN/MAX runs on CPU "
+                           "(needs a sliding extremum kernel)")
+    child = e.func.child
+    if child is not None and name in ("Min", "Max"):
+        try:
+            if isinstance(child.data_type, T.StringType):
+                meta.will_not_work(
+                    f"window {name} over STRING runs on CPU")
+        except ValueError:
+            pass
+    if getattr(e.func, "ignore_nulls", False) and name in ("First", "Last"):
+        meta.will_not_work(
+            "IGNORE NULLS First/Last over a window runs on CPU")
+
+
+def _register_window_exprs():
+    from ..expr import windowexprs as WX
+    for cls in (WX.RowNumber, WX.Rank, WX.DenseRank, WX.PercentRank,
+                WX.CumeDist, WX.NTile, WX.Lead, WX.Lag):
+        expr_rule(cls, _basic)
+    expr_rule(WX.WindowAggregate, _basic, tag_fn=_tag_window_agg)
+
+
+_register_window_exprs()
+
+
 def lookup_expr_rule(expr: EB.Expression, conf: TpuConf) -> ExprMeta:
     rule = _EXPR_RULES.get(type(expr))
     return ExprMeta(expr, conf, rule)
@@ -264,6 +306,29 @@ def _c_expand(plan, children, conf):
     return TpuExpandExec(plan.projections, plan.output.names, children[0], conf)
 
 
+def _exprs_window(m: PlanMeta):
+    for e in m.plan._bound_part:
+        m.add_expr(e)
+    for e, _, _ in m.plan._bound_order:
+        m.add_expr(e)
+    for f, _ in m.plan._bound_fns:
+        m.add_expr(f)
+
+
+def _tag_window(m: PlanMeta):
+    from ..expr import windowexprs as WX
+    has_order = bool(m.plan.order_spec)
+    for f, name in m.plan._bound_fns:
+        if f.requires_order and not has_order:
+            m.will_not_work(f"window function {name} requires an ORDER BY")
+
+
+def _c_window(plan, children, conf):
+    from ..exec.window import TpuWindowExec
+    return TpuWindowExec(plan.window_exprs, plan.partition_spec,
+                         plan.order_spec, children[0], conf)
+
+
 def _tag_exchange(m: PlanMeta):
     from .. import types as T
     from ..expr.base import AttributeReference
@@ -328,6 +393,8 @@ exec_rule(N.CpuExpandExec, TypeSig.all_basic(), _c_expand,
           expr_fn=_exprs_expand)
 exec_rule(N.CpuShuffleExchangeExec, TypeSig.all_basic(), _c_exchange,
           tag_fn=_tag_exchange)
+exec_rule(N.CpuWindowExec, TypeSig.all_basic(), _c_window,
+          tag_fn=_tag_window, expr_fn=_exprs_window)
 _register_file_scan_rules()
 
 

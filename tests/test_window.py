@@ -1,0 +1,257 @@
+"""Window-function differential tests (reference coverage model:
+`integration_tests/src/main/python/window_function_test.py` — each case runs on
+the CPU oracle and the TPU engine and must agree exactly)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.expr import (Average, Count, CumeDist, DenseRank, First,
+                                   Lag, Last, Lead, Max, Min, NTile,
+                                   PercentRank, Rank, RowFrame, RowNumber, Sum,
+                                   WindowAggregate, col)
+from spark_rapids_tpu.plugin import TpuSession
+
+from test_queries import assert_same
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession({"spark.rapids.sql.enabled": True,
+                       "spark.rapids.sql.explain": "NONE"})
+
+
+def window_table(rng, n=500, null_frac=0.15):
+    groups = rng.integers(0, 12, n)
+    ts = rng.integers(0, 40, n)  # deliberately has ties -> peer groups
+    vals = rng.normal(0, 50, n).round(3)
+    nulls = rng.random(n) < null_frac
+    cats = np.array(["aa", "bb", "cc", None], dtype=object)[
+        rng.integers(0, 4, n)]
+    return pa.table({
+        "g": pa.array(groups, type=pa.int32()),
+        "ts": pa.array(ts, type=pa.int64()),
+        "v": pa.array(np.where(nulls, 0.0, vals), type=pa.float64(),
+                      mask=nulls),
+        "i": pa.array(rng.integers(-1000, 1000, n), type=pa.int32()),
+        "s": pa.array(list(cats)),
+    })
+
+
+SORT = ["g", "ts", "i", "v"]
+
+
+class TestRankFamily:
+    def test_row_number(self, session, rng):
+        df = session.from_arrow(window_table(rng))
+        q = df.window(partition_by=["g"], order_by=["ts", "i"],
+                      rn=RowNumber())
+        assert_same(q, sort_by=SORT)
+
+    def test_rank_dense_rank(self, session, rng):
+        df = session.from_arrow(window_table(rng))
+        q = df.window(partition_by=["g"], order_by=["ts"],
+                      rk=Rank(), drk=DenseRank())
+        assert_same(q, sort_by=SORT)
+
+    def test_percent_rank_cume_dist(self, session, rng):
+        df = session.from_arrow(window_table(rng))
+        q = df.window(partition_by=["g"], order_by=["ts"],
+                      pr=PercentRank(), cd=CumeDist())
+        assert_same(q, sort_by=SORT)
+
+    def test_ntile(self, session, rng):
+        df = session.from_arrow(window_table(rng))
+        q = df.window(partition_by=["g"], order_by=["ts", "i"],
+                      n3=NTile(3), n7=NTile(7), n100=NTile(100))
+        assert_same(q, sort_by=SORT)
+
+    def test_rank_desc_nulls(self, session, rng):
+        df = session.from_arrow(window_table(rng))
+        q = df.window(partition_by=["g"],
+                      order_by=[(col("v"), False, False)],
+                      rk=Rank(), rn=RowNumber())
+        assert_same(q, sort_by=SORT)
+
+    def test_no_partition(self, session, rng):
+        df = session.from_arrow(window_table(rng, n=100))
+        q = df.window(order_by=["ts", "i"], rn=RowNumber(), rk=Rank())
+        assert_same(q, sort_by=SORT)
+
+
+class TestLeadLag:
+    def test_lead_lag(self, session, rng):
+        df = session.from_arrow(window_table(rng))
+        q = df.window(partition_by=["g"], order_by=["ts", "i"],
+                      ld=Lead(col("v")), lg=Lag(col("v")),
+                      ld3=Lead(col("i"), 3), lg2=Lag(col("i"), 2))
+        assert_same(q, sort_by=SORT)
+
+    def test_lead_lag_default(self, session, rng):
+        df = session.from_arrow(window_table(rng))
+        q = df.window(partition_by=["g"], order_by=["ts", "i"],
+                      ld=Lead(col("i"), 1, default=-999),
+                      lg=Lag(col("i"), 2, default=42))
+        assert_same(q, sort_by=SORT)
+
+    def test_lead_lag_strings(self, session, rng):
+        df = session.from_arrow(window_table(rng))
+        q = df.window(partition_by=["g"], order_by=["ts", "i"],
+                      ld=Lead(col("s")), lg=Lag(col("s"), 1, default="zz"))
+        assert_same(q, sort_by=SORT)
+
+
+class TestWindowAggregates:
+    def test_unbounded_aggs(self, session, rng):
+        df = session.from_arrow(window_table(rng))
+        q = df.window(
+            partition_by=["g"],
+            ws=WindowAggregate(Sum(col("v"))),
+            c=WindowAggregate(Count(col("v"))),
+            mn=WindowAggregate(Min(col("i"))),
+            mx=WindowAggregate(Max(col("i"))),
+            av=WindowAggregate(Average(col("v"))))
+        assert_same(q, sort_by=SORT, approx_cols=("ws", "av"))
+
+    def test_running_rows(self, session, rng):
+        df = session.from_arrow(window_table(rng))
+        frame = RowFrame(None, 0)
+        q = df.window(
+            partition_by=["g"], order_by=["ts", "i"],
+            rs=WindowAggregate(Sum(col("i")), frame),
+            rc=WindowAggregate(Count(col("v")), frame),
+            rmn=WindowAggregate(Min(col("v")), frame),
+            rmx=WindowAggregate(Max(col("v")), frame))
+        assert_same(q, sort_by=SORT)
+
+    def test_default_range_frame(self, session, rng):
+        # Spark default: RANGE UNBOUNDED PRECEDING..CURRENT ROW includes peers
+        df = session.from_arrow(window_table(rng))
+        q = df.window(partition_by=["g"], order_by=["ts"],
+                      rs=Sum(col("i")), rc=Count(col("i")))
+        assert_same(q, sort_by=SORT)
+
+    def test_bounded_rows(self, session, rng):
+        df = session.from_arrow(window_table(rng))
+        q = df.window(
+            partition_by=["g"], order_by=["ts", "i"],
+            w1=WindowAggregate(Sum(col("i")), RowFrame(-2, 2)),
+            w2=WindowAggregate(Count(col("v")), RowFrame(-1, 0)),
+            w3=WindowAggregate(Average(col("i")), RowFrame(0, 3)),
+            w4=WindowAggregate(Sum(col("i")), RowFrame(1, 5)))
+        assert_same(q, sort_by=SORT, approx_cols=("w3",))
+
+    def test_first_last(self, session, rng):
+        df = session.from_arrow(window_table(rng))
+        q = df.window(
+            partition_by=["g"], order_by=["ts", "i"],
+            f=WindowAggregate(First(col("v"))),
+            l=WindowAggregate(Last(col("v")), RowFrame(None, None)),
+            fs=WindowAggregate(First(col("s")), RowFrame(-1, 1)))
+        assert_same(q, sort_by=SORT)
+
+    def test_all_null_partitions(self, session):
+        t = pa.table({
+            "g": pa.array([1, 1, 1, 2, 2], type=pa.int32()),
+            "ts": pa.array([1, 2, 3, 1, 2], type=pa.int64()),
+            "v": pa.array([None, None, None, 1.5, None],
+                          type=pa.float64()),
+        })
+        df = session.from_arrow(t)
+        q = df.window(partition_by=["g"], order_by=["ts"],
+                      s=Sum(col("v")), mn=Min(col("v")),
+                      c=Count(col("v")), av=Average(col("v")))
+        assert_same(q, sort_by=["g", "ts"])
+
+    def test_single_row_partitions(self, session):
+        t = pa.table({
+            "g": pa.array(list(range(8)), type=pa.int32()),
+            "ts": pa.array([0] * 8, type=pa.int64()),
+            "v": pa.array([float(x) for x in range(8)]),
+        })
+        df = session.from_arrow(t)
+        q = df.window(partition_by=["g"], order_by=["ts"],
+                      rn=RowNumber(), rk=Rank(), pr=PercentRank(),
+                      s=Sum(col("v")))
+        assert_same(q, sort_by=["g"])
+
+
+class TestRangeValueFrames:
+    def test_value_offset_range_cpu_fallback(self, session):
+        # value-offset RANGE frames run on the CPU engine (tagged fallback);
+        # verify the oracle computes true peer-value windows, not running sums
+        t = pa.table({
+            "g": pa.array([1, 1, 1, 1], type=pa.int32()),
+            "ts": pa.array([1, 2, 3, 4], type=pa.int64()),
+            "v": pa.array([1.0, 2.0, 3.0, 4.0]),
+        })
+        from spark_rapids_tpu.expr import RangeFrame
+        df = session.from_arrow(t)
+        q = df.window(partition_by=["g"], order_by=["ts"],
+                      s=WindowAggregate(Sum(col("v")), RangeFrame(0, 0)),
+                      s2=WindowAggregate(Sum(col("v")), RangeFrame(-1, 1)))
+        out = q.collect_cpu()
+        assert out.column("s").to_pylist() == [1.0, 2.0, 3.0, 4.0]
+        assert out.column("s2").to_pylist() == [3.0, 6.0, 9.0, 7.0]
+        assert "range frames run on CPU" in q.explain()
+
+    def test_count_empty_frame_is_zero(self, session):
+        t = pa.table({
+            "g": pa.array([1, 1, 1], type=pa.int32()),
+            "ts": pa.array([1, 2, 3], type=pa.int64()),
+            "v": pa.array([1.0, 2.0, 3.0]),
+        })
+        df = session.from_arrow(t)
+        q = df.window(partition_by=["g"], order_by=["ts"],
+                      c=WindowAggregate(Count(col("v")), RowFrame(1, 5)),
+                      s=WindowAggregate(Sum(col("v")), RowFrame(1, 5)))
+        out = assert_same(q, sort_by=["ts"])
+        assert out.column("c").to_pylist() == [2, 1, 0]
+        assert out.column("s").to_pylist() == [5.0, 3.0, None]
+
+    def test_sum_over_string_raises(self, session, rng):
+        df = session.from_arrow(window_table(rng, n=20))
+        with pytest.raises(TypeError, match="over\nSTRING|STRING"):
+            df.window(partition_by=["g"], x=WindowAggregate(Sum(col("s"))))
+
+
+class TestNullKeys:
+    def test_count_over_string_column(self, session, rng):
+        df = session.from_arrow(window_table(rng, n=100))
+        q = df.window(partition_by=["g"], order_by=["ts", "i"],
+                      c=WindowAggregate(Count(col("s"))))
+        assert_same(q, sort_by=SORT)
+
+    def test_null_partition_keys_from_expression(self, session):
+        # nullable computed partition key: garbage under null slots must not
+        # split the null partition on device
+        t = pa.table({
+            "a": pa.array([1, None, None, 2, None], type=pa.int64()),
+            "b": pa.array([10, 20, 30, 40, 50], type=pa.int64()),
+            "ts": pa.array([1, 2, 3, 4, 5], type=pa.int64()),
+        })
+        df = session.from_arrow(t)
+        q = df.window(partition_by=[(col("a") * 0)], order_by=["ts"],
+                      rn=RowNumber(), s=Sum(col("b")))
+        assert_same(q, sort_by=["ts"])
+        out = q.collect()
+        # the three a-null rows form ONE partition
+        by_ts = dict(zip(out.column("ts").to_pylist(),
+                         out.column("rn").to_pylist()))
+        assert [by_ts[t] for t in (2, 3, 5)] == [1, 2, 3]
+
+
+class TestWindowFallback:
+    def test_rank_without_order_falls_back(self, session, rng):
+        df = session.from_arrow(window_table(rng, n=50))
+        q = df.window(partition_by=["g"], rk=Rank())
+        # must still produce correct results via CPU fallback
+        assert_same(q, sort_by=SORT)
+        assert "requires an ORDER BY" in q.explain()
+
+    def test_bounded_min_falls_back(self, session, rng):
+        df = session.from_arrow(window_table(rng, n=50))
+        q = df.window(partition_by=["g"], order_by=["ts", "i"],
+                      m=WindowAggregate(Min(col("i")), RowFrame(-1, 1)))
+        assert_same(q, sort_by=SORT)
+        assert "MIN/MAX" in q.explain()
