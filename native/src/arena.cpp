@@ -1,0 +1,148 @@
+// Host staging arena allocator.
+//
+// Role: the reference's RMM arena owns device memory and drives spill via
+// alloc-failure callbacks (GpuDeviceManager.scala:247-343,
+// DeviceMemoryEventHandler.scala). On TPU, XLA owns HBM, so the native arena's
+// job is the HOST side: a pinned-staging-pool analog for shuffle/spill/infeed
+// buffers with the same failure-callback seam — on exhaustion it invokes a
+// registered callback (python: spill host buffers / shrink) and retries.
+//
+// Design: one mmap'd slab, first-fit free list with coalescing on free.
+// Thread-safe via a single mutex (allocation here is not the hot path — the
+// buffers are large and long-lived).
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+
+#include <sys/mman.h>
+
+namespace {
+
+struct Arena {
+  uint8_t* base = nullptr;
+  int64_t size = 0;
+  // free list: offset -> length, coalesced
+  std::map<int64_t, int64_t> free_list;
+  // live allocations: offset -> length
+  std::map<int64_t, int64_t> live;
+  int64_t in_use = 0;
+  int64_t peak = 0;
+  std::mutex mu;
+};
+
+Arena g_arena;
+typedef int32_t (*oom_cb_t)(int64_t needed);
+oom_cb_t g_oom_cb = nullptr;
+
+int64_t align_up(int64_t v, int64_t a) { return (v + a - 1) & ~(a - 1); }
+
+void* try_alloc_locked(int64_t n) {
+  for (auto it = g_arena.free_list.begin(); it != g_arena.free_list.end();
+       ++it) {
+    if (it->second >= n) {
+      int64_t off = it->first;
+      int64_t len = it->second;
+      g_arena.free_list.erase(it);
+      if (len > n) g_arena.free_list[off + n] = len - n;
+      g_arena.live[off] = n;
+      g_arena.in_use += n;
+      if (g_arena.in_use > g_arena.peak) g_arena.peak = g_arena.in_use;
+      return g_arena.base + off;
+    }
+  }
+  return nullptr;
+}
+
+}  // namespace
+
+extern "C" {
+
+int32_t srtpu_arena_init(int64_t size) {
+  std::lock_guard<std::mutex> lock(g_arena.mu);
+  if (g_arena.base != nullptr) return -1;  // already initialized
+  void* p = mmap(nullptr, static_cast<size_t>(size), PROT_READ | PROT_WRITE,
+                 MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+  if (p == MAP_FAILED) return -2;
+  g_arena.base = static_cast<uint8_t*>(p);
+  g_arena.size = size;
+  g_arena.free_list[0] = size;
+  g_arena.in_use = 0;
+  g_arena.peak = 0;
+  return 0;
+}
+
+void srtpu_arena_set_oom_callback(oom_cb_t cb) { g_oom_cb = cb; }
+
+void* srtpu_arena_alloc(int64_t n) {
+  n = align_up(n, 64);
+  for (int attempt = 0; attempt < 3; ++attempt) {
+    {
+      std::lock_guard<std::mutex> lock(g_arena.mu);
+      if (g_arena.base == nullptr) return nullptr;
+      void* p = try_alloc_locked(n);
+      if (p != nullptr) return p;
+    }
+    // exhausted: give the host a chance to free staging buffers (the
+    // DeviceMemoryEventHandler retry-loop seam, host flavored)
+    if (g_oom_cb == nullptr || g_oom_cb(n) == 0) break;
+  }
+  return nullptr;
+}
+
+void srtpu_arena_free(void* p) {
+  std::lock_guard<std::mutex> lock(g_arena.mu);
+  if (g_arena.base == nullptr || p == nullptr) return;
+  int64_t off = static_cast<uint8_t*>(p) - g_arena.base;
+  auto it = g_arena.live.find(off);
+  if (it == g_arena.live.end()) return;
+  int64_t len = it->second;
+  g_arena.live.erase(it);
+  g_arena.in_use -= len;
+  // insert + coalesce with neighbors
+  auto ins = g_arena.free_list.emplace(off, len).first;
+  if (ins != g_arena.free_list.begin()) {
+    auto prev = std::prev(ins);
+    if (prev->first + prev->second == ins->first) {
+      prev->second += ins->second;
+      g_arena.free_list.erase(ins);
+      ins = prev;
+    }
+  }
+  auto next = std::next(ins);
+  if (next != g_arena.free_list.end() &&
+      ins->first + ins->second == next->first) {
+    ins->second += next->second;
+    g_arena.free_list.erase(next);
+  }
+}
+
+int64_t srtpu_arena_in_use() {
+  std::lock_guard<std::mutex> lock(g_arena.mu);
+  return g_arena.in_use;
+}
+
+int64_t srtpu_arena_peak() {
+  std::lock_guard<std::mutex> lock(g_arena.mu);
+  return g_arena.peak;
+}
+
+int64_t srtpu_arena_capacity() {
+  std::lock_guard<std::mutex> lock(g_arena.mu);
+  return g_arena.size;
+}
+
+void srtpu_arena_destroy() {
+  std::lock_guard<std::mutex> lock(g_arena.mu);
+  if (g_arena.base != nullptr) {
+    munmap(g_arena.base, static_cast<size_t>(g_arena.size));
+    g_arena.base = nullptr;
+    g_arena.size = 0;
+    g_arena.free_list.clear();
+    g_arena.live.clear();
+    g_arena.in_use = 0;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,157 @@
+// LZ4 block-format codec (compress + decompress), host side.
+//
+// Role: the reference shuffles/spills GPU buffers through nvcomp's device LZ4
+// (NvcompLZ4CompressionCodec.scala:25). TPUs have no device codec library, so
+// compression runs on host writer threads between D2H and the block store /
+// wire; this is a from-scratch implementation of the standard LZ4 block format
+// (token | literals | 2B offset | match), greedy with a 4-byte hash chain —
+// not a copy of any existing codec source.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr int kMinMatch = 4;
+constexpr int kLastLiterals = 5;   // spec: final 5 bytes must be literals
+constexpr int kMatchGuard = 12;    // spec: no match starts in last 12 bytes
+constexpr int kHashBits = 16;
+
+inline uint32_t read32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+inline uint32_t hash4(uint32_t v) {
+  return (v * 2654435761u) >> (32 - kHashBits);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Worst-case compressed size for n input bytes.
+int64_t srtpu_lz4_compress_bound(int64_t n) {
+  return n + n / 255 + 16;
+}
+
+// Returns compressed size, or -1 if dst_cap is too small.
+int64_t srtpu_lz4_compress(const uint8_t* src, int64_t n, uint8_t* dst,
+                           int64_t dst_cap) {
+  uint8_t* op = dst;
+  uint8_t* const op_end = dst + dst_cap;
+  const uint8_t* ip = src;
+  const uint8_t* anchor = src;
+  const uint8_t* const iend = src + n;
+  const uint8_t* const match_limit = iend - kLastLiterals;
+  const uint8_t* const guard = n >= kMatchGuard ? iend - kMatchGuard : src;
+
+  int32_t table[1 << kHashBits];
+  for (int i = 0; i < (1 << kHashBits); ++i) table[i] = -1;
+
+  auto emit = [&](const uint8_t* lit_start, int64_t lit_len,
+                  int32_t offset, int64_t match_len) -> bool {
+    // token + extended literal length
+    int64_t need = 1 + lit_len / 255 + 1 + lit_len + (offset ? 2 : 0) +
+                   (match_len >= 15 ? match_len / 255 + 1 : 0) + 8;
+    if (op + need > op_end) return false;
+    uint8_t* token = op++;
+    int64_t ll = lit_len;
+    if (ll >= 15) {
+      *token = 15 << 4;
+      ll -= 15;
+      while (ll >= 255) { *op++ = 255; ll -= 255; }
+      *op++ = static_cast<uint8_t>(ll);
+    } else {
+      *token = static_cast<uint8_t>(ll << 4);
+    }
+    std::memcpy(op, lit_start, lit_len);
+    op += lit_len;
+    if (offset == 0) return true;  // final literal-only sequence
+    *op++ = static_cast<uint8_t>(offset & 0xff);
+    *op++ = static_cast<uint8_t>(offset >> 8);
+    int64_t ml = match_len - kMinMatch;
+    if (ml >= 15) {
+      *token |= 15;
+      ml -= 15;
+      while (ml >= 255) { *op++ = 255; ml -= 255; }
+      *op++ = static_cast<uint8_t>(ml);
+    } else {
+      *token |= static_cast<uint8_t>(ml);
+    }
+    return true;
+  };
+
+  if (n >= kMatchGuard + kLastLiterals) {
+    while (ip < guard) {
+      uint32_t h = hash4(read32(ip));
+      int32_t cand = table[h];
+      table[h] = static_cast<int32_t>(ip - src);
+      if (cand >= 0 && (ip - src) - cand <= 65535 &&
+          read32(src + cand) == read32(ip)) {
+        const uint8_t* m = src + cand;
+        const uint8_t* p = ip + kMinMatch;
+        const uint8_t* q = m + kMinMatch;
+        while (p < match_limit && *p == *q) { ++p; ++q; }
+        int64_t match_len = p - ip;
+        if (!emit(anchor, ip - anchor,
+                  static_cast<int32_t>(ip - m), match_len))
+          return -1;
+        ip += match_len;
+        anchor = ip;
+      } else {
+        ++ip;
+      }
+    }
+  }
+  if (!emit(anchor, iend - anchor, 0, 0)) return -1;
+  return op - dst;
+}
+
+// Returns decompressed size (== expected n), or -1 on malformed input.
+int64_t srtpu_lz4_decompress(const uint8_t* src, int64_t src_len, uint8_t* dst,
+                             int64_t n) {
+  const uint8_t* ip = src;
+  const uint8_t* const iend = src + src_len;
+  uint8_t* op = dst;
+  uint8_t* const oend = dst + n;
+
+  while (ip < iend) {
+    uint8_t token = *ip++;
+    int64_t lit = token >> 4;
+    if (lit == 15) {
+      uint8_t b;
+      do {
+        if (ip >= iend) return -1;
+        b = *ip++;
+        lit += b;
+      } while (b == 255);
+    }
+    if (ip + lit > iend || op + lit > oend) return -1;
+    std::memcpy(op, ip, lit);
+    ip += lit;
+    op += lit;
+    if (ip >= iend) break;  // final sequence has no match part
+    if (ip + 2 > iend) return -1;
+    int64_t offset = ip[0] | (ip[1] << 8);
+    ip += 2;
+    if (offset == 0 || op - dst < offset) return -1;
+    int64_t ml = (token & 15) + kMinMatch;
+    if ((token & 15) == 15) {
+      uint8_t b;
+      do {
+        if (ip >= iend) return -1;
+        b = *ip++;
+        ml += b;
+      } while (b == 255);
+    }
+    if (op + ml > oend) return -1;
+    const uint8_t* m = op - offset;
+    for (int64_t i = 0; i < ml; ++i) op[i] = m[i];  // overlap-safe
+    op += ml;
+  }
+  return op - dst;
+}
+
+}  // extern "C"

@@ -1,0 +1,59 @@
+// String layout conversion kernels, host side.
+//
+// Role: Arrow carries strings as offsets+chars; the device layout is a
+// fixed-width byte matrix (uint8[n, width] + int32 lengths) — see
+// columnar/column.py and ARCHITECTURE.md #3. This conversion happens at every
+// host<->device boundary (scan decode, shuffle read, python UDF transfer), the
+// same hot spot the reference covers with cudf's JNI row/column kernels, so it
+// gets a native implementation (the numpy fallback does the identical
+// transform with fancy indexing).
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// offsets[n+1] (int64, arrow large_string convention) + chars -> matrix.
+// matrix must be zeroed, n*width bytes; lengths out int32[n].
+// Returns 0, or -1 if any string exceeds width (caller rebuckets).
+int32_t srtpu_offsets_to_matrix(const uint8_t* chars, const int64_t* offsets,
+                                int64_t n, int64_t width, uint8_t* matrix,
+                                int32_t* lengths) {
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t len = offsets[i + 1] - offsets[i];
+    if (len > width) return -1;
+    lengths[i] = static_cast<int32_t>(len);
+    if (len > 0)
+      std::memcpy(matrix + i * width, chars + offsets[i],
+                  static_cast<size_t>(len));
+  }
+  return 0;
+}
+
+// matrix + lengths -> offsets[n+1] + packed chars. chars_out must hold
+// sum(lengths) bytes (caller computes via srtpu_sum_lengths). Returns bytes
+// written.
+int64_t srtpu_matrix_to_offsets(const uint8_t* matrix, const int32_t* lengths,
+                                int64_t n, int64_t width, uint8_t* chars_out,
+                                int64_t* offsets_out) {
+  int64_t at = 0;
+  offsets_out[0] = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t len = lengths[i];
+    if (len > 0) {
+      std::memcpy(chars_out + at, matrix + i * width,
+                  static_cast<size_t>(len));
+      at += len;
+    }
+    offsets_out[i + 1] = at;
+  }
+  return at;
+}
+
+int64_t srtpu_sum_lengths(const int32_t* lengths, int64_t n) {
+  int64_t s = 0;
+  for (int64_t i = 0; i < n; ++i) s += lengths[i];
+  return s;
+}
+
+}  // extern "C"

@@ -186,18 +186,28 @@ def from_arrow(arr, capacity: Optional[int] = None) -> Tuple[Column, int]:
                                 count=n + 1, offset=arr.offset * 8)
         databuf = np.frombuffer(buffers[2], dtype=np.uint8) if buffers[2] else \
             np.zeros(0, np.uint8)
-        lens = np.diff(offsets).astype(np.int32)
+        lens_raw = np.diff(offsets).astype(np.int32)
         # null slots may carry garbage lengths in theory; normalize to 0
-        lens = np.where(valid, lens, 0).astype(np.int32)
+        lens = np.where(valid, lens_raw, 0).astype(np.int32)
         w = _checked_width(int(lens.max()) if n and lens.size else 1)
+        from ..native import runtime as _native
         chars = np.zeros((cap, w), dtype=np.uint8)
-        if n:
-            row_id = np.repeat(np.arange(n), lens)
-            if row_id.size:
-                out_starts = np.concatenate(([0], np.cumsum(lens)[:-1]))
-                within = np.arange(row_id.size) - np.repeat(out_starts, lens)
-                src = np.repeat(offsets[:-1], lens) + within
-                chars[row_id, within] = databuf[src]
+        # native path requires every raw slot (incl. nulls) to fit the width
+        native = _native.offsets_to_matrix(databuf, offsets, w, out=chars) \
+            if n and _native.available() and int(lens_raw.max()) <= w \
+            else None
+        if native is not None:
+            if not valid.all():  # nulls are sparse: zero just those rows
+                chars[:n][~valid] = 0
+        else:
+            if n:
+                row_id = np.repeat(np.arange(n), lens)
+                if row_id.size:
+                    out_starts = np.concatenate(([0], np.cumsum(lens)[:-1]))
+                    within = np.arange(row_id.size) - np.repeat(out_starts,
+                                                                lens)
+                    src = np.repeat(offsets[:-1], lens) + within
+                    chars[row_id, within] = databuf[src]
         return Column(dtype, jnp.asarray(chars),
                       jnp.asarray(_pad_to(valid, cap)),
                       jnp.asarray(_pad_to(lens, cap))), n

@@ -89,7 +89,12 @@ class TpuShuffleExchangeExec(UnaryTpuExec):
         n_parts = part.num_partitions
         with self.partition_time.timed():
             pid = part.ids_for_batch(jnp, batch)
-        # lazy per-partition slicing bounds live memory at input + one slice
+        mode = self.conf.get("spark.rapids.shuffle.mode")
+        if mode in ("MULTITHREADED", "CACHE_ONLY") and n_parts > 1:
+            yield from self._shuffle_via_manager(batch, pid, n_parts, mode)
+            return
+        # ICI mode in-process: device-resident slicing (the distributed data
+        # plane is the compiled all_to_all in parallel/collective.py)
         for p in range(n_parts):
             with self.partition_time.timed():
                 out = _slice_partition(batch, pid, p)
@@ -97,6 +102,40 @@ class TpuShuffleExchangeExec(UnaryTpuExec):
                 continue
             self.num_output_rows.add(out.row_count())
             yield self._count_output(out)
+
+    def _shuffle_via_manager(self, batch, pid, n_parts, mode):
+        """Write every partition through the shuffle manager (serialize/
+        compress on writer threads or device-resident cache), then read each
+        reduce partition back — the full reference write/read path
+        (`RapidsShuffleInternalManagerBase` getWriter/getReader), in-process."""
+        from ..shuffle.manager import TpuShuffleManager, next_shuffle_id
+        mgr = TpuShuffleManager.get(self.conf)
+        codec = self.conf.get("spark.rapids.shuffle.compression.codec")
+        sid = next_shuffle_id()
+        writer = mgr.get_writer(sid, map_id=0, mode=mode, codec=codec)
+        try:
+            try:
+                for p in range(n_parts):
+                    with self.partition_time.timed():
+                        out = _slice_partition(batch, pid, p)
+                    if int(out.row_count()) == 0:
+                        continue
+                    writer.write(p, out)
+            finally:
+                # drain in-flight writer futures BEFORE any unregister — a
+                # late store.put after cleanup would leak blocks forever in
+                # the process-singleton store
+                writer.close()
+            # release=True drops each partition's blocks as they are consumed,
+            # bounding block-store retention to one partition at a time
+            for p in range(n_parts):
+                for b in mgr.read_partition(sid, p, mode=mode, release=True):
+                    if int(b.row_count()) == 0:
+                        continue
+                    self.num_output_rows.add(b.row_count())
+                    yield self._count_output(b)
+        finally:
+            mgr.unregister_shuffle(sid)
 
     def _arg_string(self):
         return f"[{self.spec}]"

@@ -1,0 +1,7 @@
+"""Native C++ host runtime bindings (reference SURVEY.md §2.9: the roles RMM /
+spark-rapids-jni / nvcomp play are host-side here — arena accounting, string
+repack fast paths, block compression). See native/ at the repo root for the C++
+sources and Makefile; runtime.py loads the built library via ctypes and every
+caller must degrade gracefully when it is absent."""
+
+from . import runtime  # noqa: F401

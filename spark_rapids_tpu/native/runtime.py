@@ -1,0 +1,209 @@
+"""ctypes bindings to the native host runtime (native/build/libsrtpu.so).
+
+Loads lazily and degrades gracefully: every entry point has a numpy fallback at
+its call site, so the framework is fully functional without the .so — the
+native paths are the performance tier (the reference has the same shape: Scala
+logic above, libcudf/RMM/nvcomp below, except its native tier is mandatory).
+
+Build: `make -C native` at the repo root (g++, no external deps)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+_LOCK = threading.Lock()
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+# explicit env override beats the discovered in-repo build
+_SO_PATHS = (
+    os.environ.get("SRTPU_NATIVE_LIB", ""),
+    os.path.join(_REPO_ROOT, "native", "build", "libsrtpu.so"),
+)
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    with _LOCK:
+        if _TRIED:
+            return _LIB
+        _TRIED = True
+        for p in _SO_PATHS:
+            if p and os.path.exists(p):
+                try:
+                    lib = ctypes.CDLL(p)
+                except OSError:
+                    continue
+                _bind(lib)
+                _LIB = lib
+                break
+        return _LIB
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.srtpu_lz4_compress_bound.restype = ctypes.c_int64
+    lib.srtpu_lz4_compress_bound.argtypes = [ctypes.c_int64]
+    lib.srtpu_lz4_compress.restype = ctypes.c_int64
+    lib.srtpu_lz4_compress.argtypes = [u8p, ctypes.c_int64, u8p,
+                                       ctypes.c_int64]
+    lib.srtpu_lz4_decompress.restype = ctypes.c_int64
+    lib.srtpu_lz4_decompress.argtypes = [u8p, ctypes.c_int64, u8p,
+                                         ctypes.c_int64]
+    lib.srtpu_offsets_to_matrix.restype = ctypes.c_int32
+    lib.srtpu_offsets_to_matrix.argtypes = [u8p, i64p, ctypes.c_int64,
+                                            ctypes.c_int64, u8p, i32p]
+    lib.srtpu_matrix_to_offsets.restype = ctypes.c_int64
+    lib.srtpu_matrix_to_offsets.argtypes = [u8p, i32p, ctypes.c_int64,
+                                            ctypes.c_int64, u8p, i64p]
+    lib.srtpu_sum_lengths.restype = ctypes.c_int64
+    lib.srtpu_sum_lengths.argtypes = [i32p, ctypes.c_int64]
+    lib.srtpu_arena_init.restype = ctypes.c_int32
+    lib.srtpu_arena_init.argtypes = [ctypes.c_int64]
+    lib.srtpu_arena_alloc.restype = ctypes.c_void_p
+    lib.srtpu_arena_alloc.argtypes = [ctypes.c_int64]
+    lib.srtpu_arena_free.restype = None
+    lib.srtpu_arena_free.argtypes = [ctypes.c_void_p]
+    lib.srtpu_arena_in_use.restype = ctypes.c_int64
+    lib.srtpu_arena_peak.restype = ctypes.c_int64
+    lib.srtpu_arena_capacity.restype = ctypes.c_int64
+    lib.srtpu_arena_destroy.restype = None
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _u8(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+# -- LZ4 block codec ---------------------------------------------------------
+
+def lz4_compress(data: bytes) -> bytes:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native runtime not built (make -C native)")
+    src = np.frombuffer(data, np.uint8)
+    bound = lib.srtpu_lz4_compress_bound(len(data))
+    dst = np.empty(bound, np.uint8)
+    n = lib.srtpu_lz4_compress(_u8(src), len(data), _u8(dst), bound)
+    if n < 0:
+        raise RuntimeError("lz4 compression failed")
+    return dst[:n].tobytes()
+
+
+def lz4_decompress(data: bytes, uncompressed_len: int) -> bytes:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native runtime not built (make -C native)")
+    src = np.frombuffer(data, np.uint8)
+    dst = np.empty(uncompressed_len, np.uint8)
+    n = lib.srtpu_lz4_decompress(_u8(src), len(data), _u8(dst),
+                                 uncompressed_len)
+    if n != uncompressed_len:
+        raise RuntimeError(f"lz4 decompression failed ({n})")
+    return dst.tobytes()
+
+
+# -- string repack -----------------------------------------------------------
+
+def offsets_to_matrix(chars: np.ndarray, offsets: np.ndarray, width: int,
+                      out: Optional[np.ndarray] = None) -> Optional[tuple]:
+    """Arrow offsets+chars -> (matrix uint8[n,width], lengths int32[n]);
+    None when the native lib is absent (caller uses the numpy path).
+    `out` (zeroed, C-contiguous, >= n rows of `width`) lets the caller supply
+    the destination (e.g. a capacity-padded device staging buffer) so the
+    repack writes in place with no extra allocation."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(offsets) - 1
+    offsets = np.ascontiguousarray(offsets, np.int64)
+    chars = np.ascontiguousarray(chars, np.uint8)
+    if out is None:
+        matrix = np.zeros((n, width), np.uint8)
+    else:
+        assert out.flags["C_CONTIGUOUS"] and out.shape[0] >= n \
+            and out.shape[1] == width
+        matrix = out[:n]
+    lengths = np.zeros(n, np.int32)
+    rc = lib.srtpu_offsets_to_matrix(
+        _u8(chars), offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n, width, _u8(matrix),
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    if rc != 0:
+        raise ValueError("string exceeds matrix width")
+    return matrix, lengths
+
+
+def matrix_to_offsets(matrix: np.ndarray,
+                      lengths: np.ndarray) -> Optional[tuple]:
+    """(matrix, lengths) -> (offsets int64[n+1], chars uint8[total]);
+    None when the native lib is absent."""
+    lib = _load()
+    if lib is None:
+        return None
+    n, width = matrix.shape
+    matrix = np.ascontiguousarray(matrix, np.uint8)
+    lengths = np.ascontiguousarray(lengths, np.int32)
+    lp = lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+    total = lib.srtpu_sum_lengths(lp, n)
+    chars = np.empty(total, np.uint8)
+    offsets = np.empty(n + 1, np.int64)
+    lib.srtpu_matrix_to_offsets(
+        _u8(matrix), lp, n, width, _u8(chars),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    return offsets, chars
+
+
+# -- host staging arena ------------------------------------------------------
+
+class HostArena:
+    """Python view over the native staging arena (pinned-pool analog)."""
+
+    def __init__(self, size: int):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native runtime not built (make -C native)")
+        rc = lib.srtpu_arena_init(size)
+        if rc == -1:
+            raise RuntimeError(
+                "host arena already initialized (one process-wide arena; "
+                "destroy() the existing one first)")
+        if rc == -2:
+            raise MemoryError(f"cannot map {size} byte host arena")
+        self._lib = lib
+
+    def alloc(self, n: int) -> int:
+        p = self._lib.srtpu_arena_alloc(n)
+        if not p:
+            raise MemoryError(f"host arena exhausted allocating {n} bytes")
+        return p
+
+    def free(self, p: int) -> None:
+        self._lib.srtpu_arena_free(p)
+
+    @property
+    def in_use(self) -> int:
+        return self._lib.srtpu_arena_in_use()
+
+    @property
+    def peak(self) -> int:
+        return self._lib.srtpu_arena_peak()
+
+    @property
+    def capacity(self) -> int:
+        return self._lib.srtpu_arena_capacity()
+
+    def destroy(self) -> None:
+        self._lib.srtpu_arena_destroy()

@@ -1,0 +1,17 @@
+"""Shuffle & broadcast transport layer (reference SURVEY.md §2.7).
+
+Data plane options: host multithreaded serialize/compress (manager.py), ICI
+collective all_to_all (parallel/collective.py), device-resident cache
+(manager.py CACHE_ONLY via the spillable BufferCatalog). Control plane:
+TableMeta framing (metadata.py), pull-based client/server transport
+(transport.py), peer discovery heartbeats (heartbeat.py)."""
+
+from .metadata import TableMeta, ColumnMeta, encode_meta, decode_meta  # noqa: F401
+from .serializer import (serialize_batch, deserialize_table,  # noqa: F401
+                         concat_host_tables, HostTable)
+from .codec import get_codec  # noqa: F401
+from .transport import (BlockId, BlockRange, WindowedBlockIterator,  # noqa: F401
+                        BounceBufferManager, ShuffleClient, ShuffleServer,
+                        LocalTransport, ShuffleTransport, ClientConnection)
+from .heartbeat import HeartbeatManager, PeerInfo  # noqa: F401
+from .manager import TpuShuffleManager, ShuffleBlockStore  # noqa: F401

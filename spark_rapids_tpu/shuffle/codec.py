@@ -1,0 +1,84 @@
+"""Shuffle/spill buffer compression codecs.
+
+Reference: `TableCompressionCodec.scala:41-98` (codec SPI),
+`NvcompLZ4CompressionCodec.scala` (nvcomp device LZ4), `CopyCompressionCodec.scala`.
+On TPU there is no device-side codec library; compression runs on the host between
+D2H and the block store / wire (the multithreaded shuffle pipelines it across
+writer threads, so it overlaps with device compute like nvcomp overlaps with
+kernels). `lz4xla` is served by the native C++ runtime when built (native/), and
+reports unavailable otherwise."""
+
+from __future__ import annotations
+
+from typing import Dict
+
+
+class Codec:
+    name = "none"
+
+    def compress(self, data: bytes) -> bytes:
+        raise NotImplementedError
+
+    def decompress(self, data: bytes, uncompressed_len: int) -> bytes:
+        raise NotImplementedError
+
+
+class CopyCodec(Codec):
+    name = "none"
+
+    def compress(self, data: bytes) -> bytes:
+        return data
+
+    def decompress(self, data: bytes, uncompressed_len: int) -> bytes:
+        return data
+
+
+class ZstdCodec(Codec):
+    name = "zstd"
+
+    def __init__(self, level: int = 1):
+        import zstandard
+        self._c = zstandard.ZstdCompressor(level=level)
+        self._d = zstandard.ZstdDecompressor()
+
+    def compress(self, data: bytes) -> bytes:
+        return self._c.compress(data)
+
+    def decompress(self, data: bytes, uncompressed_len: int) -> bytes:
+        return self._d.decompress(data, max_output_size=uncompressed_len)
+
+
+class NativeLz4Codec(Codec):
+    """LZ4 block codec from the native runtime (native/libsrtpu.so)."""
+
+    name = "lz4xla"
+
+    def __init__(self):
+        from ..native import runtime
+        if not runtime.available():
+            raise RuntimeError(
+                "lz4xla codec needs the native runtime; build native/ first "
+                "or use spark.rapids.shuffle.compression.codec=zstd")
+        self._rt = runtime
+
+    def compress(self, data: bytes) -> bytes:
+        return self._rt.lz4_compress(data)
+
+    def decompress(self, data: bytes, uncompressed_len: int) -> bytes:
+        return self._rt.lz4_decompress(data, uncompressed_len)
+
+
+_CACHE: Dict[str, Codec] = {}
+
+
+def get_codec(name: str) -> Codec:
+    if name not in _CACHE:
+        if name == "none":
+            _CACHE[name] = CopyCodec()
+        elif name == "zstd":
+            _CACHE[name] = ZstdCodec()
+        elif name == "lz4xla":
+            _CACHE[name] = NativeLz4Codec()
+        else:
+            raise ValueError(f"unknown shuffle codec {name!r}")
+    return _CACHE[name]

@@ -1,0 +1,75 @@
+"""Executor heartbeat registry for the peer-to-peer shuffle.
+
+Reference: `RapidsShuffleHeartbeatManager.scala` (driver side) + the executor
+heartbeat in `Plugin.scala:227-239`: executors register with the driver to learn
+which peers run the accelerated shuffle, and keep heartbeating so dead peers age
+out. Same design here for the DCN/host transport path (ICI collectives don't
+need it — mesh membership is static under XLA)."""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+@dataclasses.dataclass
+class PeerInfo:
+    executor_id: str
+    endpoint: str          # transport address (opaque to the registry)
+    last_seen: float
+    registration_order: int
+
+
+class HeartbeatManager:
+    """Driver-side registry. Executors call register_executor once and
+    executor_heartbeat periodically; both return all CURRENT peers so a new
+    executor learns existing ones and existing ones learn newcomers
+    (the reference returns incremental updates; full-list is simpler and the
+    peer counts here are mesh-sized, not thousand-node)."""
+
+    def __init__(self, expiry_seconds: float = 60.0,
+                 clock=time.monotonic):
+        self._peers: Dict[str, PeerInfo] = {}
+        self._order = 0
+        self._expiry = expiry_seconds
+        self._clock = clock
+        self._lock = threading.Lock()
+
+    def register_executor(self, executor_id: str,
+                          endpoint: str) -> List[PeerInfo]:
+        with self._lock:
+            self._expire_locked()
+            self._peers[executor_id] = PeerInfo(executor_id, endpoint,
+                                                self._clock(), self._order)
+            self._order += 1
+            return self._others_locked(executor_id)
+
+    def executor_heartbeat(self, executor_id: str) -> List[PeerInfo]:
+        with self._lock:
+            self._expire_locked()
+            p = self._peers.get(executor_id)
+            if p is None:
+                raise KeyError(
+                    f"executor {executor_id} heartbeat before registration")
+            p.last_seen = self._clock()
+            return self._others_locked(executor_id)
+
+    def known_peers(self) -> List[PeerInfo]:
+        with self._lock:
+            self._expire_locked()
+            return sorted(self._peers.values(),
+                          key=lambda p: p.registration_order)
+
+    def _others_locked(self, executor_id: str) -> List[PeerInfo]:
+        return sorted((p for p in self._peers.values()
+                       if p.executor_id != executor_id),
+                      key=lambda p: p.registration_order)
+
+    def _expire_locked(self) -> None:
+        now = self._clock()
+        dead = [k for k, p in self._peers.items()
+                if now - p.last_seen > self._expiry]
+        for k in dead:
+            del self._peers[k]

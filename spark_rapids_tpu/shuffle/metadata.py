@@ -1,0 +1,106 @@
+"""Shuffle wire metadata.
+
+Reference: the FlatBuffers schemas under `sql-plugin/src/main/format/`
+(`ShuffleCommon.fbs` TableMeta/BufferMeta; built by `MetaUtils.scala`). The role
+is identical — a compact self-describing header that lets a peer reconstruct a
+columnar table from raw bytes without a handshake about shape — but the encoding
+here is a little-endian struct layout instead of flatbuffers (no codegen step,
+and python reads it zero-copy with memoryview slices).
+
+Layout (all little-endian):
+  magic "SRTM" | u16 version | u16 codec_id | u32 num_rows | u32 num_cols |
+  u64 uncompressed_len | u64 compressed_len |
+  per column: u16 name_len | name utf8 | u16 type_len | type utf8 |
+              u32 string_width | u64 data_len | u64 validity_len | u64 lens_len
+
+Buffer payload order per column: data, validity, lengths — concatenated across
+columns in column order. This is the TPU analog of the packed contiguous-split
+buffer the reference ships (`GpuPackedTableColumn`/`MetaUtils`)."""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import List, Tuple
+
+from .. import types as T
+
+MAGIC = b"SRTM"
+VERSION = 1
+
+CODEC_IDS = {"none": 0, "zstd": 1, "lz4xla": 2}
+CODEC_NAMES = {v: k for k, v in CODEC_IDS.items()}
+
+
+@dataclasses.dataclass
+class ColumnMeta:
+    name: str
+    dtype: T.DataType
+    string_width: int  # 0 for non-strings
+    data_len: int
+    validity_len: int
+    lens_len: int
+
+
+@dataclasses.dataclass
+class TableMeta:
+    num_rows: int
+    codec: str
+    uncompressed_len: int
+    compressed_len: int
+    columns: List[ColumnMeta]
+
+    @property
+    def num_cols(self) -> int:
+        return len(self.columns)
+
+    def payload_len(self) -> int:
+        return sum(c.data_len + c.validity_len + c.lens_len
+                   for c in self.columns)
+
+
+_HEAD = struct.Struct("<4sHHII QQ")
+
+
+def encode_meta(meta: TableMeta) -> bytes:
+    out = [_HEAD.pack(MAGIC, VERSION, CODEC_IDS[meta.codec], meta.num_rows,
+                      meta.num_cols, meta.uncompressed_len,
+                      meta.compressed_len)]
+    for c in meta.columns:
+        nb = c.name.encode("utf-8")
+        tb = c.dtype.simple_string().encode("utf-8")
+        out.append(struct.pack("<H", len(nb)))
+        out.append(nb)
+        out.append(struct.pack("<H", len(tb)))
+        out.append(tb)
+        out.append(struct.pack("<IQQQ", c.string_width, c.data_len,
+                               c.validity_len, c.lens_len))
+    return b"".join(out)
+
+
+def decode_meta(buf: bytes, offset: int = 0) -> Tuple[TableMeta, int]:
+    """Returns (meta, bytes_consumed_from_offset)."""
+    view = memoryview(buf)
+    magic, version, codec_id, num_rows, num_cols, ulen, clen = \
+        _HEAD.unpack_from(view, offset)
+    if magic != MAGIC:
+        raise ValueError(f"bad shuffle metadata magic {magic!r}")
+    if version != VERSION:
+        raise ValueError(f"unsupported shuffle metadata version {version}")
+    pos = offset + _HEAD.size
+    cols = []
+    for _ in range(num_cols):
+        (nlen,) = struct.unpack_from("<H", view, pos)
+        pos += 2
+        name = bytes(view[pos:pos + nlen]).decode("utf-8")
+        pos += nlen
+        (tlen,) = struct.unpack_from("<H", view, pos)
+        pos += 2
+        tname = bytes(view[pos:pos + tlen]).decode("utf-8")
+        pos += tlen
+        width, dlen, vlen, llen = struct.unpack_from("<IQQQ", view, pos)
+        pos += struct.calcsize("<IQQQ")
+        cols.append(ColumnMeta(name, T.parse_type(tname), width, dlen, vlen,
+                               llen))
+    return TableMeta(num_rows, CODEC_NAMES[codec_id], ulen, clen, cols), \
+        pos - offset

@@ -1,0 +1,304 @@
+"""Shuffle transport SPI — client/server state machines with windowed transfers.
+
+Reference: `shuffle/RapidsShuffleTransport.scala:303` (SPI),
+`RapidsShuffleClient.scala:89` / `RapidsShuffleServer.scala:70` (state machines),
+`BufferSendState`/`BufferReceiveState` windowed sends through bounce buffers,
+`WindowedBlockIterator.scala`, `BounceBufferManager.scala`. The UCX concrete
+implementation (RDMA) is replaced on TPU by ICI collectives for the data plane
+(parallel/collective.py); THIS module keeps the reference's pull-based
+control-plane design for the host/DCN path and for mocked-transport testing —
+the same two-round-trip protocol: metadata request (what blocks exist, their
+TableMeta) then transfer request (stream the bytes through windows)."""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from .metadata import TableMeta, decode_meta
+
+__all__ = ["BlockId", "BlockRange", "WindowedBlockIterator",
+           "BounceBufferManager", "BounceBuffer", "ClientConnection",
+           "ShuffleTransport", "ShuffleServer", "ShuffleClient",
+           "LocalTransport"]
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockId:
+    """One shuffle block: output of (shuffle_id, map_id) for reduce_id."""
+    shuffle_id: int
+    map_id: int
+    reduce_id: int
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockRange:
+    """A contiguous byte range of one block (a window may split blocks)."""
+    block: BlockId
+    offset: int
+    length: int
+    total_length: int
+
+    @property
+    def is_final(self) -> bool:
+        return self.offset + self.length == self.total_length
+
+
+class WindowedBlockIterator:
+    """Split a sequence of (block, length) into bounce-buffer-sized windows
+    (`WindowedBlockIterator.scala` analog). Each window is a list of
+    BlockRanges whose lengths sum to <= window_bytes; blocks larger than one
+    window span several windows."""
+
+    def __init__(self, blocks: Sequence[Tuple[BlockId, int]],
+                 window_bytes: int):
+        if window_bytes <= 0:
+            raise ValueError("window_bytes must be positive")
+        self._blocks = list(blocks)
+        self._window = window_bytes
+        self._bi = 0      # current block
+        self._off = 0     # offset within current block
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> List[BlockRange]:
+        if self._bi >= len(self._blocks):
+            raise StopIteration
+        remaining = self._window
+        out: List[BlockRange] = []
+        while remaining > 0 and self._bi < len(self._blocks):
+            block, total = self._blocks[self._bi]
+            take = min(remaining, total - self._off)
+            if take > 0:
+                out.append(BlockRange(block, self._off, take, total))
+                self._off += take
+                remaining -= take
+            if self._off >= total:
+                self._bi += 1
+                self._off = 0
+        return out
+
+
+class BounceBuffer:
+    """One fixed-size staging buffer (pinned-host analog)."""
+
+    def __init__(self, manager: "BounceBufferManager", idx: int, size: int):
+        self._manager = manager
+        self.idx = idx
+        self.buf = bytearray(size)
+
+    def close(self) -> None:
+        self._manager._release(self)
+
+
+class BounceBufferManager:
+    """Fixed pool of staging buffers; acquire blocks until one frees
+    (`BounceBufferManager.scala` analog — backpressure for windowed sends)."""
+
+    def __init__(self, count: int, buf_size: int):
+        self._size = buf_size
+        self._free: List[BounceBuffer] = [
+            BounceBuffer(self, i, buf_size) for i in range(count)]
+        self._cond = threading.Condition()
+        self.num_total = count
+
+    @property
+    def buffer_size(self) -> int:
+        return self._size
+
+    def acquire(self, timeout: Optional[float] = None) -> BounceBuffer:
+        with self._cond:
+            while not self._free:
+                if not self._cond.wait(timeout):
+                    raise TimeoutError("no bounce buffer available")
+            return self._free.pop()
+
+    def _release(self, b: BounceBuffer) -> None:
+        with self._cond:
+            self._free.append(b)
+            self._cond.notify()
+
+    @property
+    def num_free(self) -> int:
+        with self._cond:
+            return len(self._free)
+
+
+# ---------------------------------------------------------------------------
+# SPI
+# ---------------------------------------------------------------------------
+
+
+class ClientConnection:
+    """One logical connection to a peer executor."""
+
+    def list_blocks(self, shuffle_id: int, reduce_id: int) -> List[BlockId]:
+        """Ask the peer which blocks it holds for one reduce partition."""
+        raise NotImplementedError
+
+    def request_metadata(self, block_ids: Sequence[BlockId]
+                         ) -> List[Tuple[BlockId, TableMeta, int]]:
+        """Returns (block, table_meta, total_bytes) for each id the peer has."""
+        raise NotImplementedError
+
+    def fetch_range(self, r: BlockRange) -> bytes:
+        """Pull one block byte-range (a bounce-buffer window's worth)."""
+        raise NotImplementedError
+
+
+class ShuffleTransport:
+    def connect(self, peer_executor_id: str) -> ClientConnection:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+class ShuffleServer:
+    """Serves local shuffle blocks to peers (RapidsShuffleServer analog: the
+    send side of the pull protocol; windowing happens client-side here since
+    the local 'wire' is a function call)."""
+
+    def __init__(self, executor_id: str,
+                 block_resolver: Callable[[BlockId], Optional[bytes]],
+                 block_lister: Optional[Callable[[int, int],
+                                                 List[BlockId]]] = None):
+        self.executor_id = executor_id
+        self._resolve = block_resolver
+        self._list = block_lister
+
+    def handle_list_blocks(self, shuffle_id: int,
+                           reduce_id: int) -> List[BlockId]:
+        if self._list is None:
+            return []
+        return self._list(shuffle_id, reduce_id)
+
+    def handle_metadata_request(self, block_ids: Sequence[BlockId]
+                                ) -> List[Tuple[BlockId, TableMeta, int]]:
+        out = []
+        for bid in block_ids:
+            data = self._resolve(bid)
+            if data is None:
+                continue
+            meta, _ = decode_meta(data)
+            out.append((bid, meta, len(data)))
+        return out
+
+    def handle_fetch(self, r: BlockRange) -> bytes:
+        data = self._resolve(r.block)
+        if data is None:
+            raise KeyError(f"unknown shuffle block {r.block}")
+        return bytes(memoryview(data)[r.offset:r.offset + r.length])
+
+
+class ShuffleClient:
+    """Pull-based fetch state machine (RapidsShuffleClient analog).
+
+    fetch_blocks: metadata round trip -> windowed transfers through bounce
+    buffers -> per-block reassembly -> completion callback per block. Errors
+    surface per-block through the handler, like the reference's
+    RapidsShuffleFetchHandler."""
+
+    def __init__(self, connection: ClientConnection,
+                 bounce_buffers: BounceBufferManager):
+        self._conn = connection
+        self._bounce = bounce_buffers
+
+    def fetch_partition(self, shuffle_id: int, reduce_id: int,
+                        on_block: Callable[[BlockId, bytes], None],
+                        on_error: Optional[Callable[[BlockId, Exception],
+                                                    None]] = None) -> int:
+        """Discover and fetch every block the peer holds for one reduce
+        partition (list round trip + fetch_blocks)."""
+        wanted = self._conn.list_blocks(shuffle_id, reduce_id)
+        if not wanted:
+            return 0
+        return self.fetch_blocks(wanted, on_block, on_error)
+
+    def fetch_blocks(self, block_ids: Sequence[BlockId],
+                     on_block: Callable[[BlockId, bytes], None],
+                     on_error: Optional[Callable[[BlockId, Exception],
+                                                 None]] = None) -> int:
+        """Fetch all blocks; invokes on_block(block, full_bytes) as each block
+        completes. Returns the number of blocks successfully fetched."""
+        metas = self._conn.request_metadata(block_ids)
+        # a requested block the peer no longer holds is a FAILURE, not a
+        # silent omission — dropped rows would corrupt query results
+        present = {bid for bid, _, _ in metas}
+        for bid in block_ids:
+            if bid not in present:
+                err = KeyError(f"peer no longer holds shuffle block {bid}")
+                if on_error is not None:
+                    on_error(bid, err)
+                else:
+                    raise err
+        pending: Dict[BlockId, bytearray] = {}
+        failed: set = set()
+        ok = 0
+        windows = WindowedBlockIterator(
+            [(bid, total) for bid, _, total in metas],
+            self._bounce.buffer_size)
+        for window in windows:
+            bb = self._bounce.acquire()
+            try:
+                for r in window:
+                    if r.block in failed:
+                        continue  # a lost prefix poisons the whole block
+                    try:
+                        chunk = self._conn.fetch_range(r)
+                        if len(chunk) != r.length:
+                            raise IOError(
+                                f"short read for {r.block}: "
+                                f"{len(chunk)} != {r.length}")
+                        # stage through the bounce buffer like a real DMA
+                        bb.buf[:len(chunk)] = chunk
+                        acc = pending.setdefault(r.block, bytearray())
+                        acc.extend(bb.buf[:len(chunk)])
+                        if r.is_final:
+                            on_block(r.block, bytes(acc))
+                            del pending[r.block]
+                            ok += 1
+                    except Exception as e:  # noqa: BLE001 - per-block errors
+                        pending.pop(r.block, None)
+                        failed.add(r.block)
+                        if on_error is not None:
+                            on_error(r.block, e)
+                        else:
+                            raise
+            finally:
+                bb.close()
+        return ok
+
+
+class LocalTransport(ShuffleTransport):
+    """In-process transport: peers are ShuffleServers registered by executor id
+    (the role RapidsShuffleTestHelper's mocked transport plays in the
+    reference's suite, and the single-host fast path in production)."""
+
+    def __init__(self):
+        self._servers: Dict[str, ShuffleServer] = {}
+
+    def register(self, server: ShuffleServer) -> None:
+        self._servers[server.executor_id] = server
+
+    def connect(self, peer_executor_id: str) -> ClientConnection:
+        server = self._servers.get(peer_executor_id)
+        if server is None:
+            raise ConnectionError(f"unknown peer {peer_executor_id}")
+        return _LocalConnection(server)
+
+
+class _LocalConnection(ClientConnection):
+    def __init__(self, server: ShuffleServer):
+        self._server = server
+
+    def list_blocks(self, shuffle_id: int, reduce_id: int):
+        return self._server.handle_list_blocks(shuffle_id, reduce_id)
+
+    def request_metadata(self, block_ids):
+        return self._server.handle_metadata_request(block_ids)
+
+    def fetch_range(self, r: BlockRange) -> bytes:
+        return self._server.handle_fetch(r)

@@ -247,6 +247,24 @@ NULL = NullType()
 
 _INTEGRAL_ORDER = {ByteType: 0, ShortType: 1, IntegerType: 2, LongType: 3}
 
+_SIMPLE_NAMES = {
+    "boolean": BOOLEAN, "byte": BYTE, "short": SHORT, "integer": INT,
+    "long": LONG, "float": FLOAT, "double": DOUBLE, "string": STRING,
+    "binary": BINARY, "date": DATE, "timestamp": TIMESTAMP, "void": NULL,
+}
+
+
+def parse_type(s: str) -> DataType:
+    """Inverse of simple_string() for flat types (wire metadata / test specs).
+    Nested types are not wire-serialized (they are not device-backed yet)."""
+    s = s.strip()
+    if s in _SIMPLE_NAMES:
+        return _SIMPLE_NAMES[s]
+    if s.startswith("decimal(") and s.endswith(")"):
+        p, sc = s[len("decimal("):-1].split(",")
+        return DecimalType(int(p), int(sc))
+    raise ValueError(f"cannot parse type string {s!r}")
+
 
 def is_integral(dt: DataType) -> bool:
     return isinstance(dt, IntegralType)

@@ -1,0 +1,99 @@
+"""Native host runtime tests (native/ C++ via ctypes: LZ4 block codec, string
+repack, staging arena). Reference roles: nvcomp / cudf JNI row-col kernels /
+RMM+pinned pool (SURVEY.md §2.9)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.native import runtime
+
+pytestmark = pytest.mark.skipif(not runtime.available(),
+                                reason="native lib not built (make -C native)")
+
+
+class TestLz4:
+    @pytest.mark.parametrize("n", [0, 1, 11, 12, 13, 17, 64, 4096, 1 << 20])
+    def test_sizes(self, rng, n):
+        data = rng.bytes(n)
+        assert runtime.lz4_decompress(runtime.lz4_compress(data), n) == data
+
+    def test_highly_compressible(self):
+        data = b"\x00" * (1 << 20)
+        c = runtime.lz4_compress(data)
+        assert len(c) < len(data) // 100
+        assert runtime.lz4_decompress(c, len(data)) == data
+
+    def test_repeating_pattern(self, rng):
+        data = bytes(rng.integers(0, 3, 100, dtype=np.uint8)) * 1000
+        c = runtime.lz4_compress(data)
+        assert len(c) < len(data) // 4
+        assert runtime.lz4_decompress(c, len(data)) == data
+
+    def test_long_match_lengths(self):
+        # matches > 255+19 exercise the extended match-length encoding
+        data = b"abcd" * 5000 + b"tail-literals"
+        c = runtime.lz4_compress(data)
+        assert runtime.lz4_decompress(c, len(data)) == data
+
+    def test_corrupt_input_rejected(self, rng):
+        data = rng.bytes(1000)
+        c = runtime.lz4_compress(data)
+        with pytest.raises(RuntimeError):
+            runtime.lz4_decompress(c[:-5], 1000)  # truncated stream
+        with pytest.raises(RuntimeError):
+            runtime.lz4_decompress(c, 999)  # output-size mismatch
+
+
+class TestStringRepack:
+    def test_round_trip(self):
+        strings = [b"", b"a", b"hello", b"x" * 31, b""]
+        offsets = np.zeros(len(strings) + 1, np.int64)
+        for i, s in enumerate(strings):
+            offsets[i + 1] = offsets[i] + len(s)
+        chars = np.frombuffer(b"".join(strings), np.uint8)
+        m, l = runtime.offsets_to_matrix(chars, offsets, 32)
+        assert m.shape == (5, 32)
+        assert list(l) == [len(s) for s in strings]
+        o2, c2 = runtime.matrix_to_offsets(m, l)
+        assert list(o2) == list(offsets)
+        assert c2.tobytes() == b"".join(strings)
+
+    def test_width_overflow_rejected(self):
+        offsets = np.array([0, 10], np.int64)
+        chars = np.frombuffer(b"0123456789", np.uint8)
+        with pytest.raises(ValueError):
+            runtime.offsets_to_matrix(chars, offsets, 4)
+
+
+class TestHostArena:
+    def test_alloc_free_coalesce(self):
+        a = runtime.HostArena(1 << 20)
+        try:
+            ps = [a.alloc(1 << 10) for _ in range(100)]
+            assert a.in_use >= 100 << 10
+            for p in ps:
+                a.free(p)
+            assert a.in_use == 0
+            # after freeing everything, one max-size alloc must succeed
+            # (free-list coalescing check)
+            big = a.alloc((1 << 20) - (1 << 10))
+            a.free(big)
+        finally:
+            a.destroy()
+
+    def test_exhaustion_raises(self):
+        a = runtime.HostArena(1 << 16)
+        try:
+            a.alloc(1 << 15)
+            with pytest.raises(MemoryError):
+                a.alloc(1 << 16)
+        finally:
+            a.destroy()
+
+    def test_double_init_rejected(self):
+        a = runtime.HostArena(1 << 16)
+        try:
+            with pytest.raises(RuntimeError, match="already initialized"):
+                runtime.HostArena(1 << 16)
+        finally:
+            a.destroy()
