@@ -25,6 +25,8 @@ from .aggregates import (AggregateFunction, Sum, Count, Min, Max, Average,  # no
 from .windowexprs import (RowFrame, RangeFrame, WindowFunction, RowNumber,  # noqa: F401
                           Rank, DenseRank, PercentRank, CumeDist, NTile, Lead,
                           Lag, WindowAggregate)
+from .regex import (RLike, Like, RegExpReplace, RegExpExtract,  # noqa: F401
+                    device_supported_pattern)
 
 
 def col(name):  # convenience constructors for tests / DataFrame API

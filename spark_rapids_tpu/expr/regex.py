@@ -1,0 +1,785 @@
+"""Regular-expression support: Java-regex parser + device transpiler.
+
+Reference: `RegexParser.scala:1-1931` (Pratt parser for Java regex syntax),
+`RegexComplexityEstimator.scala`, `GpuRegExpReplaceMeta.scala`. The reference
+transpiles Java regex to the cuDF regex dialect and falls back per-pattern;
+there is no device regex library on TPU, so the transpiler here targets a
+**bit-parallel Shift-And NFA** executed directly on the byte matrix: each
+pattern becomes ≤63 NFA items (byte classes with optional/repeat flags), the
+whole column advances one character per step with pure bitwise vector ops —
+`w` steps of O(n) work, no data-dependent control flow, ideal XLA shape.
+
+Supported on device (after expansion): literals, escapes (\\d \\w \\s \\D \\W
+\\S \\t \\n \\r \\xHH \\.), classes `[...]` with ranges/negation/predefineds,
+`.`, anchors `^ $ \\A \\z`, quantifiers `? * + {m} {m,} {m,n}` (lazy variants
+accepted — acceptance-equivalent), non-capturing/capturing groups expanded by
+alternative distribution, top-level and group alternation. Unsupported →
+`RegexUnsupportedError` → the planner keeps the expression on CPU (python
+`re`), mirroring the reference's transpile-or-fallback.
+
+Semantics note (documented incompat, like the reference's regexp caveats): the
+device machine is BYTE-level. For ASCII subjects it is exact; for non-ASCII
+UTF-8 subjects, `.` and negated classes match individual bytes, so counted
+quantifiers over multi-byte characters can differ from the JVM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import types as T
+from .base import Expression, EvalContext, Literal, Vec, and_validity
+
+__all__ = ["RegexUnsupportedError", "parse_regex", "compile_device_plan",
+           "RLike", "Like", "RegExpReplace", "RegExpExtract",
+           "device_supported_pattern"]
+
+
+class RegexUnsupportedError(ValueError):
+    """Pattern uses a construct the device machine cannot express."""
+
+
+# ---------------------------------------------------------------------------
+# AST
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class RxNode:
+    pass
+
+
+@dataclasses.dataclass
+class RxClass(RxNode):
+    """A byte class: bool[256] acceptance table."""
+    table: np.ndarray  # bool[256]
+
+
+@dataclasses.dataclass
+class RxSeq(RxNode):
+    parts: List[RxNode]
+
+
+@dataclasses.dataclass
+class RxAlt(RxNode):
+    options: List[RxNode]
+
+
+@dataclasses.dataclass
+class RxRepeat(RxNode):
+    child: RxNode
+    min_count: int
+    max_count: Optional[int]  # None = unbounded
+
+
+@dataclasses.dataclass
+class RxAnchor(RxNode):
+    kind: str  # "start" | "end"
+
+
+# ---------------------------------------------------------------------------
+# Parser (Java regex subset; reference RegexParser.scala parses the same
+# grammar before transpiling to the cuDF dialect)
+# ---------------------------------------------------------------------------
+
+
+def _class_of(chars: str) -> np.ndarray:
+    t = np.zeros(256, dtype=bool)
+    for c in chars:
+        t[ord(c)] = True
+    return t
+
+
+def _class_range(lo: int, hi: int) -> np.ndarray:
+    t = np.zeros(256, dtype=bool)
+    t[lo:hi + 1] = True
+    return t
+
+
+_DIGIT = _class_range(ord("0"), ord("9"))
+_WORD = _class_range(ord("a"), ord("z")) | _class_range(ord("A"), ord("Z")) \
+    | _DIGIT | _class_of("_")
+_SPACE = _class_of(" \t\n\x0b\f\r")
+# Java '.' matches any char except line terminators; byte-level here
+_DOT = ~_class_of("\n\r")
+
+
+class _Parser:
+    def __init__(self, pattern: str):
+        self.p = pattern
+        self.i = 0
+
+    def error(self, msg: str) -> RegexUnsupportedError:
+        return RegexUnsupportedError(
+            f"regex {self.p!r} at {self.i}: {msg}")
+
+    def peek(self) -> Optional[str]:
+        return self.p[self.i] if self.i < len(self.p) else None
+
+    def next(self) -> str:
+        c = self.p[self.i]
+        self.i += 1
+        return c
+
+    def parse(self) -> RxNode:
+        node = self.parse_alt()
+        if self.i != len(self.p):
+            raise self.error(f"unexpected {self.p[self.i]!r}")
+        return node
+
+    def parse_alt(self) -> RxNode:
+        options = [self.parse_seq()]
+        while self.peek() == "|":
+            self.next()
+            options.append(self.parse_seq())
+        return options[0] if len(options) == 1 else RxAlt(options)
+
+    def parse_seq(self) -> RxNode:
+        parts: List[RxNode] = []
+        while True:
+            c = self.peek()
+            if c is None or c in "|)":
+                break
+            parts.append(self.parse_quantified())
+        return RxSeq(parts)
+
+    def parse_quantified(self) -> RxNode:
+        atom = self.parse_atom()
+        c = self.peek()
+        if c in ("*", "+", "?"):
+            self.next()
+            if isinstance(atom, RxAnchor):
+                raise self.error("quantifier on anchor")
+            lo, hi = {"*": (0, None), "+": (1, None), "?": (0, 1)}[c]
+            self._eat_lazy()
+            return RxRepeat(atom, lo, hi)
+        if c == "{":
+            save = self.i
+            self.next()
+            spec = ""
+            while self.peek() is not None and self.peek() != "}":
+                spec += self.next()
+            if self.peek() != "}":
+                self.i = save  # Java treats unclosed '{' as literal
+                return atom
+            self.next()
+            import re as _re
+            m = _re.fullmatch(r"(\d+)(,(\d*)?)?", spec)
+            if not m:
+                self.i = save
+                return atom
+            lo = int(m.group(1))
+            hi = lo if m.group(2) is None else (
+                int(m.group(3)) if m.group(3) else None)
+            if hi is not None and hi < lo:
+                raise self.error(f"bad repetition {{{spec}}}")
+            if isinstance(atom, RxAnchor):
+                raise self.error("quantifier on anchor")
+            self._eat_lazy()
+            return RxRepeat(atom, lo, hi)
+        return atom
+
+    def _eat_lazy(self) -> None:
+        # lazy/possessive markers don't change ACCEPTANCE; possessive (*+)
+        # does, so reject it
+        if self.peek() == "?":
+            self.next()
+        elif self.peek() == "+":
+            raise self.error("possessive quantifiers are not supported")
+
+    def parse_atom(self) -> RxNode:
+        c = self.next()
+        if c == "(":
+            if self.peek() == "?":
+                self.next()
+                q = self.peek()
+                if q == ":":
+                    self.next()
+                else:
+                    raise self.error(
+                        "lookaround / inline flags are not supported")
+            inner = self.parse_alt()
+            if self.peek() != ")":
+                raise self.error("unclosed group")
+            self.next()
+            return inner
+        if c == "[":
+            return self.parse_class()
+        if c == "^":
+            return RxAnchor("start")
+        if c == "$":
+            return RxAnchor("end")
+        if c == ".":
+            return RxClass(_DOT.copy())
+        if c == "\\":
+            return self.parse_escape(in_class=False)
+        if c in "*+?":
+            raise self.error(f"dangling {c!r}")
+        # '{' not opening a valid repetition is a literal brace (Java behavior)
+        return RxClass(_class_of(c))
+
+    def parse_escape(self, in_class: bool) -> RxNode:
+        if self.peek() is None:
+            raise self.error("trailing backslash")
+        c = self.next()
+        simple = {"d": _DIGIT, "D": ~_DIGIT, "w": _WORD, "W": ~_WORD,
+                  "s": _SPACE, "S": ~_SPACE}
+        if c in simple:
+            return RxClass(simple[c].copy())
+        if c == "t":
+            return RxClass(_class_of("\t"))
+        if c == "n":
+            return RxClass(_class_of("\n"))
+        if c == "r":
+            return RxClass(_class_of("\r"))
+        if c == "f":
+            return RxClass(_class_of("\f"))
+        if c == "0":
+            return RxClass(_class_of("\0"))
+        if c == "x":
+            h = ""
+            for _ in range(2):
+                if self.peek() is None or self.peek() not in \
+                        "0123456789abcdefABCDEF":
+                    raise self.error("bad \\x escape")
+                h += self.next()
+            return RxClass(_class_range(int(h, 16), int(h, 16)))
+        if c == "A" and not in_class:
+            return RxAnchor("start")
+        if c in ("z", "Z") and not in_class:
+            return RxAnchor("endz")  # absolute end (\Z ~ \z: no terminators)
+        if c in "bBG":
+            raise self.error(f"\\{c} is not supported")
+        if c.isdigit():
+            raise self.error("backreferences are not supported")
+        if c == "p" or c == "P":
+            raise self.error("unicode property classes are not supported")
+        if not c.isalnum():
+            return RxClass(_class_of(c))  # escaped metachar
+        raise self.error(f"unknown escape \\{c}")
+
+    def parse_class(self) -> RxNode:
+        negate = False
+        if self.peek() == "^":
+            self.next()
+            negate = True
+        table = np.zeros(256, dtype=bool)
+        first = True
+        while True:
+            c = self.peek()
+            if c is None:
+                raise self.error("unclosed character class")
+            if c == "]" and not first:
+                self.next()
+                break
+            first = False
+            c = self.next()
+            if c == "\\":
+                node = self.parse_escape(in_class=True)
+                if not isinstance(node, RxClass):
+                    raise self.error("bad escape in class")
+                sub = node.table
+                if self.peek() == "-" and self.i + 1 < len(self.p) and \
+                        self.p[self.i + 1] != "]":
+                    raise self.error("range from escape class")
+                table |= sub
+                continue
+            lo = ord(c)
+            if self.peek() == "-" and self.i + 1 < len(self.p) and \
+                    self.p[self.i + 1] != "]":
+                self.next()
+                hic = self.next()
+                if hic == "\\":
+                    node = self.parse_escape(in_class=True)
+                    raise self.error("range to escape class")
+                hi = ord(hic)
+                if hi < lo:
+                    raise self.error("inverted class range")
+                if hi > 255 or lo > 255:
+                    raise self.error("non-latin1 class range on device")
+                table |= _class_range(lo, hi)
+            else:
+                if lo > 255:
+                    raise self.error("non-latin1 literal in class on device")
+                table[lo] = True
+        if negate:
+            table = ~table
+        return RxClass(table)
+
+
+def parse_regex(pattern: str) -> RxNode:
+    for ch in pattern:
+        if ord(ch) > 127:
+            raise RegexUnsupportedError(
+                "non-ASCII pattern characters need byte-sequence expansion")
+    return _Parser(pattern).parse()
+
+
+# ---------------------------------------------------------------------------
+# Transpiler: AST -> linear item sequences for the Shift-And machine
+# ---------------------------------------------------------------------------
+
+MAX_ITEMS = 62       # +1 start bit must fit a uint64
+MAX_ALTERNATIVES = 16
+
+
+@dataclasses.dataclass
+class _Item:
+    table: np.ndarray   # bool[256]
+    optional: bool = False
+    repeat: bool = False
+
+
+@dataclasses.dataclass
+class _LinearAlt:
+    items: List[_Item]
+    anchored_start: bool = False
+    # None = unanchored; "dollar" = $ (end, or before a final \n, Java-style);
+    # "abs" = \z/\Z (absolute end; also used by LIKE)
+    anchored_end: Optional[str] = None
+
+    @property
+    def nullable(self) -> bool:
+        return all(i.optional for i in self.items)
+
+
+@dataclasses.dataclass
+class DevicePlan:
+    """Compiled device regex: one Shift-And machine per alternative."""
+    alternatives: List[_LinearAlt]
+    pattern: str
+
+
+def _distribute(node: RxNode) -> List[List[RxNode]]:
+    """Flatten alternation/groups into alternative flat sequences of
+    RxClass/RxRepeat(RxClass)/RxAnchor atoms (cross-product expansion)."""
+    if isinstance(node, RxClass) or isinstance(node, RxAnchor):
+        return [[node]]
+    if isinstance(node, RxAlt):
+        out: List[List[RxNode]] = []
+        for opt in node.options:
+            out.extend(_distribute(opt))
+            if len(out) > MAX_ALTERNATIVES:
+                raise RegexUnsupportedError("too many alternatives")
+        return out
+    if isinstance(node, RxSeq):
+        seqs: List[List[RxNode]] = [[]]
+        for part in node.parts:
+            subs = _distribute(part)
+            seqs = [s + sub for s in seqs for sub in subs]
+            if len(seqs) > MAX_ALTERNATIVES:
+                raise RegexUnsupportedError("too many alternatives")
+        return seqs
+    if isinstance(node, RxRepeat):
+        child_alts = _distribute(node.child)
+        # single-class repeats stay symbolic (self-loop in the machine);
+        # rebuild on the DISTRIBUTED class so '(a)+' (group around a class)
+        # carries the RxClass child _linearize expects
+        if len(child_alts) == 1 and len(child_alts[0]) == 1 and \
+                isinstance(child_alts[0][0], RxClass):
+            return [[RxRepeat(child_alts[0][0], node.min_count,
+                              node.max_count)]]
+        # group repeats expand by count (bounded only)
+        if node.max_count is None:
+            raise RegexUnsupportedError(
+                "unbounded repetition of a group is not supported on device")
+        if any(isinstance(a, RxAnchor) for alt in child_alts for a in alt):
+            raise RegexUnsupportedError("anchor inside a repeated group")
+        out = []
+        for count in range(node.min_count, node.max_count + 1):
+            if count == 0:
+                out.append([])
+                continue
+            pools = [child_alts] * count
+            expanded: List[List[RxNode]] = [[]]
+            for pool in pools:
+                expanded = [e + alt for e in expanded for alt in pool]
+                if len(expanded) > MAX_ALTERNATIVES:
+                    raise RegexUnsupportedError("group repetition too wide")
+            out.extend(expanded)
+            if len(out) > MAX_ALTERNATIVES:
+                raise RegexUnsupportedError("group repetition too wide")
+        return out
+    raise RegexUnsupportedError(f"unsupported node {type(node).__name__}")
+
+
+def _linearize(seq: List[RxNode]) -> _LinearAlt:
+    alt = _LinearAlt(items=[])
+    for i, node in enumerate(seq):
+        if isinstance(node, RxAnchor):
+            if node.kind == "start":
+                if i != 0:
+                    raise RegexUnsupportedError("^ not at pattern start")
+                alt.anchored_start = True
+            else:
+                if i != len(seq) - 1:
+                    raise RegexUnsupportedError("$ not at pattern end")
+                alt.anchored_end = "dollar" if node.kind == "end" else "abs"
+            continue
+        if isinstance(node, RxClass):
+            alt.items.append(_Item(node.table))
+            continue
+        assert isinstance(node, RxRepeat) and isinstance(node.child, RxClass)
+        t = node.child.table
+        lo, hi = node.min_count, node.max_count
+        for _ in range(lo):
+            alt.items.append(_Item(t))
+        if hi is None:
+            if lo == 0:
+                alt.items.append(_Item(t, optional=True, repeat=True))  # *
+            else:
+                alt.items[-1] = _Item(t, repeat=True)  # + (last of the run)
+        else:
+            for _ in range(hi - lo):
+                alt.items.append(_Item(t, optional=True))
+        if len(alt.items) > MAX_ITEMS:
+            raise RegexUnsupportedError("pattern expands past device limit")
+    if len(alt.items) > MAX_ITEMS:
+        raise RegexUnsupportedError("pattern expands past device limit")
+    return alt
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=256)
+def _try_compile(pattern: str):
+    """(plan|None, reason|None) — compiled once per pattern per process; every
+    consumer (expression init, planner tag) shares this cache so the
+    cross-product expansion cost is paid once."""
+    try:
+        ast = parse_regex(pattern)
+        alts = [_linearize(seq) for seq in _distribute(ast)]
+        return DevicePlan(alts, pattern), None
+    except RegexUnsupportedError as e:
+        return None, str(e)
+
+
+def compile_device_plan(pattern: str) -> DevicePlan:
+    plan, reason = _try_compile(pattern)
+    if plan is None:
+        raise RegexUnsupportedError(reason)
+    return plan
+
+
+def device_supported_pattern(pattern: str) -> Optional[str]:
+    """None if the pattern compiles for the device; else the reason string
+    (the planner's tag message, like the reference's transpiler check)."""
+    return _try_compile(pattern)[1]
+
+
+# ---------------------------------------------------------------------------
+# Device execution: vectorized Shift-And over the byte matrix
+# ---------------------------------------------------------------------------
+
+
+def _machine_masks(alt: _LinearAlt):
+    """Build (cls_table uint64[256], opt_mask, rep_mask, accept_bit, m).
+    Bit 0 = virtual start; item i occupies bit i+1."""
+    m = len(alt.items)
+    dt = np.uint64
+    cls = np.zeros(256, dtype=dt)
+    opt = dt(0)
+    rep = dt(0)
+    for i, item in enumerate(alt.items):
+        bit = dt(1) << dt(i + 1)
+        cls[item.table] |= bit
+        if item.optional:
+            opt |= bit
+        if item.repeat:
+            rep |= bit
+    return cls, opt, rep, m
+
+
+def _eclose(xp, D, opt, max_run: int):
+    """Epsilon-closure over optional items: bit i activates bit i+1 while
+    item i+1 is optional (static loop bounded by the longest optional run)."""
+    one = np.uint64(1)
+    for _ in range(max_run):
+        D = D | ((D << one) & opt)
+    return D
+
+
+def match_plan(xp, plan: DevicePlan, chars, lengths):
+    """bool[n]: does the pattern match (java Matcher.find semantics) each row.
+    Pure vector ops: w steps of table-lookup + bitwise updates."""
+    n, w = chars.shape
+    matched = xp.zeros(n, dtype=bool)
+    # Java $ also matches just before a FINAL line terminator; byte-level we
+    # honor a final \n (the \r / \r\n cases are documented divergence)
+    last_idx = xp.clip(lengths - 1, 0, w - 1)
+    last_byte = xp.take_along_axis(chars, last_idx[:, None],
+                                   axis=1)[:, 0]
+    eff_len = xp.where((lengths > 0) & (last_byte == ord("\n")),
+                       lengths - 1, lengths)
+
+    for alt in plan.alternatives:
+        def end_ok(pos):
+            # may a match END at integer position pos (0..w)?
+            if alt.anchored_end is None:
+                return pos <= lengths
+            if alt.anchored_end == "abs":
+                return pos == lengths
+            return (pos == lengths) | (pos == eff_len)
+
+        cls_np, opt, rep, m = _machine_masks(alt)
+        if m == 0 or alt.nullable:
+            # zero-length match exists at every position; with anchors it
+            # must sit at an allowed start AND end position
+            if alt.anchored_start and alt.anchored_end:
+                ok = end_ok(0)
+            else:
+                ok = xp.ones(n, dtype=bool)  # some position always works
+            matched = matched | ok
+            if m == 0:
+                continue
+        max_opt_run = _longest_optional_run(alt)
+        cls = xp.asarray(cls_np)
+        accept_bit = np.uint64(1) << np.uint64(m)
+        start_bit = np.uint64(1)
+        zero = np.uint64(0)
+        one = np.uint64(1)
+
+        D = xp.zeros(n, dtype=np.uint64)
+        # position 0: start state active (anchored or not); zero-length
+        # acceptance here covers nullable patterns on empty strings
+        A = _eclose(xp, xp.full(n, start_bit, dtype=np.uint64), opt,
+                    max_opt_run)
+        alt_matched = ((A & accept_bit) != zero) & end_ok(0)
+        for j in range(w):  # j is static: the loop unrolls into the XLA graph
+            cj = cls[chars[:, j]]
+            inject = (not alt.anchored_start) or j == 0
+            pre = (D | start_bit) if inject else D
+            pre = _eclose(xp, pre, opt, max_opt_run)
+            consumed = ((pre << one) & cj) | (D & rep & cj)
+            D = xp.where(j < lengths, consumed, D)
+            A = _eclose(xp, D, opt, max_opt_run)
+            hit = ((A & accept_bit) != zero) & (j < lengths) & end_ok(j + 1)
+            alt_matched = alt_matched | hit
+        matched = matched | alt_matched
+    return matched
+
+
+def _longest_optional_run(alt: _LinearAlt) -> int:
+    run = best = 0
+    for item in alt.items:
+        run = run + 1 if item.optional else 0
+        best = max(best, run)
+    return best
+
+
+# ---------------------------------------------------------------------------
+# Expressions
+# ---------------------------------------------------------------------------
+
+
+def _pattern_literal(expr: Expression) -> Optional[str]:
+    if isinstance(expr, Literal) and isinstance(expr.value, str):
+        return expr.value
+    return None
+
+
+def _decode_rows(v: Vec):
+    """CPU-side: byte matrix -> list of python str (None for nulls)."""
+    n = v.data.shape[0]
+    out = []
+    for i in range(n):
+        if not v.validity[i]:
+            out.append(None)
+        else:
+            out.append(bytes(np.asarray(v.data[i, :v.lengths[i]]))
+                       .decode("utf-8", "replace"))
+    return out
+
+
+class RLike(Expression):
+    """str RLIKE pattern (java Matcher.find). Device: Shift-And machine; CPU
+    oracle: python re.search (independent implementation)."""
+
+    def __init__(self, child: Expression, pattern: Expression):
+        super().__init__([child, pattern])
+        self.pattern = _pattern_literal(pattern)
+        self._plan: Optional[DevicePlan] = None
+        self.device_reason: Optional[str] = None
+        if self.pattern is None:
+            self.device_reason = "pattern must be a string literal"
+        else:
+            self._plan, self.device_reason = _try_compile(self.pattern)
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def _compute(self, ctx: EvalContext, s: Vec, p: Vec) -> Vec:
+        if not ctx.is_device:
+            import re
+            rows = _decode_rows(s)
+            rx = re.compile(self.pattern)
+            data = np.array([bool(rx.search(r)) if r is not None else False
+                             for r in rows])
+            return Vec(T.BOOLEAN, data, s.validity.copy())
+        if self._plan is None:
+            raise RuntimeError(
+                f"pattern {self.pattern!r} is not device-compilable "
+                "(planner should have kept this on CPU)")
+        ok = match_plan(ctx.xp, self._plan, s.data, s.lengths)
+        return Vec(T.BOOLEAN, ok, s.validity)
+
+    def __repr__(self):
+        return f"RLike({self.children[0]!r}, {self.pattern!r})"
+
+
+def like_pattern_to_regex(pattern: str, escape: str = "\\") -> str:
+    """SQL LIKE -> regex: % = .*, _ = ., escape char protects both."""
+    out = ["^"]
+    i = 0
+    while i < len(pattern):
+        c = pattern[i]
+        if c == escape and i + 1 < len(pattern):
+            nxt = pattern[i + 1]
+            out.append("\\" + nxt if not nxt.isalnum() else nxt)
+            i += 2
+            continue
+        if c == "%":
+            out.append(".*")
+        elif c == "_":
+            out.append(".")
+        elif not c.isalnum():
+            out.append("\\" + c)
+        else:
+            out.append(c)
+        i += 1
+    out.append("$")
+    return "".join(out)
+
+
+class Like(Expression):
+    """SQL LIKE — translated to an anchored regex machine (with `.`
+    broadened to line terminators too, per LIKE semantics)."""
+
+    def __init__(self, child: Expression, pattern: Expression,
+                 escape: str = "\\"):
+        super().__init__([child, pattern])
+        self.escape = escape
+        self.pattern = _pattern_literal(pattern)
+        self.regex = None if self.pattern is None else \
+            like_pattern_to_regex(self.pattern, escape)
+        self._plan: Optional[DevicePlan] = None
+        self.device_reason: Optional[str] = None
+        if self.regex is None:
+            self.device_reason = "pattern must be a string literal"
+        else:
+            plan, self.device_reason = _try_compile(self.regex)
+            if plan is not None:
+                import copy
+                plan = copy.deepcopy(plan)  # cached plans are shared: copy
+                for alt in plan.alternatives:
+                    # LIKE is an exact whole-string match: absolute end, and
+                    # '%'/'_' (-> '.') cross line terminators too
+                    alt.anchored_end = "abs"
+                    for item in alt.items:
+                        if (item.table == _DOT).all():
+                            item.table[:] = True
+                self._plan = plan
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def _compute(self, ctx: EvalContext, s: Vec, p: Vec) -> Vec:
+        if not ctx.is_device:
+            import re
+            # fullmatch: a '$'-anchored re.match would also accept a value
+            # with a trailing newline, which SQL LIKE must not
+            rx = re.compile(self.regex, re.DOTALL)
+            rows = _decode_rows(s)
+            data = np.array([bool(rx.fullmatch(r)) if r is not None else False
+                             for r in rows])
+            return Vec(T.BOOLEAN, data, s.validity.copy())
+        if self._plan is None:
+            raise RuntimeError(f"LIKE {self.pattern!r} not device-compilable")
+        ok = match_plan(ctx.xp, self._plan, s.data, s.lengths)
+        return Vec(T.BOOLEAN, ok, s.validity)
+
+    def __repr__(self):
+        return f"Like({self.children[0]!r}, {self.pattern!r})"
+
+
+class RegExpReplace(Expression):
+    """regexp_replace — CPU implementation (the reference needed a full
+    transpiler + cuDF replace kernels; here the planner tags it to CPU; a
+    Pallas byte-rewrite kernel is the future device path)."""
+
+    def __init__(self, child: Expression, pattern: Expression,
+                 replacement: Expression):
+        super().__init__([child, pattern, replacement])
+        self.pattern = _pattern_literal(pattern)
+        self.replacement = _pattern_literal(replacement)
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _compute(self, ctx: EvalContext, s: Vec, p: Vec, r: Vec) -> Vec:
+        import re
+        # java-style group refs $1 -> python \1
+        repl = re.sub(r"\$(\d+)", r"\\\1", self.replacement)
+        rx = re.compile(self.pattern)
+        rows = _decode_rows(s)
+        out = [rx.sub(repl, row) if row is not None else None for row in rows]
+        return _strings_to_vec(ctx.xp, out, s.validity)
+
+    def __repr__(self):
+        return f"RegExpReplace({self.children[0]!r}, {self.pattern!r})"
+
+
+class RegExpExtract(Expression):
+    """regexp_extract(str, pattern, idx) — CPU implementation (see
+    RegExpReplace); returns '' when there is no match, like Spark."""
+
+    def __init__(self, child: Expression, pattern: Expression,
+                 idx: int = 1):
+        super().__init__([child, pattern])
+        self.pattern = _pattern_literal(pattern)
+        self.idx = idx
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _compute(self, ctx: EvalContext, s: Vec, p: Vec) -> Vec:
+        import re
+        rx = re.compile(self.pattern)
+        rows = _decode_rows(s)
+        out = []
+        for row in rows:
+            if row is None:
+                out.append(None)
+                continue
+            m = rx.search(row)
+            if m is None:
+                out.append("")
+            else:
+                g = m.group(self.idx) if self.idx <= (rx.groups or 0) else None
+                out.append(g if g is not None else "")
+        return _strings_to_vec(ctx.xp, out, s.validity)
+
+    def __repr__(self):
+        return f"RegExpExtract({self.children[0]!r}, {self.pattern!r})"
+
+
+def _strings_to_vec(xp, rows: List[Optional[str]], validity) -> Vec:
+    from ..columnar.padding import width_bucket
+    enc = [r.encode("utf-8") if r is not None else b"" for r in rows]
+    w = width_bucket(max((len(b) for b in enc), default=1) or 1)
+    n = len(enc)
+    data = np.zeros((n, w), np.uint8)
+    lens = np.zeros(n, np.int32)
+    for i, b in enumerate(enc):
+        data[i, :len(b)] = np.frombuffer(b, np.uint8)
+        lens[i] = len(b)
+    return Vec(T.STRING, xp.asarray(data), validity,
+               xp.asarray(lens))

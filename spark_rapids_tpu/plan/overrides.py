@@ -163,6 +163,37 @@ def _tag_window_agg(meta: ExprMeta) -> None:
             "IGNORE NULLS First/Last over a window runs on CPU")
 
 
+def _tag_regex(meta: ExprMeta) -> None:
+    e = meta.expr
+    if not meta.conf.get("spark.rapids.sql.regexp.enabled"):
+        meta.will_not_work("regular expressions are disabled via "
+                           "spark.rapids.sql.regexp.enabled")
+        return
+    if e.device_reason is not None:
+        meta.will_not_work(
+            f"{e.name} pattern is not supported on TPU: {e.device_reason}")
+
+
+def _tag_regex_cpu_only(meta: ExprMeta) -> None:
+    meta.will_not_work(
+        f"{meta.expr.name} runs on CPU (device byte-rewrite kernel pending)")
+
+
+def _register_regex_exprs():
+    from ..expr import regex as RX
+    for cls in (RX.RLike, RX.Like):
+        expr_rule(cls, _bool, incompat=True, tag_fn=_tag_regex,
+                  doc="Byte-level regex machine: exact for ASCII subjects; "
+                      "counted quantifiers over multi-byte UTF-8 characters "
+                      "can differ from the JVM (reference marks regexp "
+                      "incompat similarly).")
+    for cls in (RX.RegExpReplace, RX.RegExpExtract):
+        expr_rule(cls, _str, tag_fn=_tag_regex_cpu_only)
+
+
+_register_regex_exprs()
+
+
 def _register_window_exprs():
     from ..expr import windowexprs as WX
     for cls in (WX.RowNumber, WX.Rank, WX.DenseRank, WX.PercentRank,

@@ -1,0 +1,219 @@
+"""Regex transpiler + Shift-And machine tests.
+
+Reference coverage model: `RegexParserSuite` / `regexp_test.py` — every
+device-compiled pattern is checked against an independent oracle (python `re`,
+the role cuDF-vs-CPU-Spark plays in the reference). The device machine runs
+under jit on the virtual device; the CPU engine path uses `re` directly, so
+`assert_cpu_tpu_equal`-style comparison validates the machine itself."""
+
+import re
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar import batch_from_arrow
+from spark_rapids_tpu.expr import col, lit
+from spark_rapids_tpu.expr.regex import (RegexUnsupportedError, Like, RLike,
+                                         RegExpExtract, RegExpReplace,
+                                         compile_device_plan,
+                                         device_supported_pattern,
+                                         like_pattern_to_regex, match_plan)
+
+from harness import assert_cpu_tpu_equal, eval_cpu, eval_tpu
+
+SUBJECTS = [
+    "", "a", "b", "ab", "ba", "aab", "abb", "aabb", "abc", "abcabc",
+    "hello world", "  spaces  ", "123", "a1b2c3", "999-4444", "12-3456",
+    "foo@bar.com", "not an email", "2023-01-15", "99/12/31",
+    "aaaaaaaaab", "xyzzy", "line1\nline2", "tab\there", "CAPS", "MiXeD",
+    "a.b", "a*b", "[bracket]", "(paren)", "x" * 60, "ab" * 25, None,
+]
+
+PATTERNS = [
+    # literals and anchors
+    "abc", "^abc", "abc$", "^abc$", "^$", "a",
+    # classes
+    "[abc]", "[^abc]", "[a-z]+", "[A-Z]", "[0-9]{3}", "[a-zA-Z0-9]+",
+    # predefined classes
+    r"\d+", r"\D+", r"\w+", r"\W", r"\s", r"\S+",
+    # quantifiers
+    "a*b", "a+b", "a?b", "ab{2}", "a{2,}b", "a{1,3}b", "colou?r",
+    "x{0,2}y",
+    # dot
+    "a.c", "a.*c", "^.+$", "...",
+    # alternation and groups
+    "abc|xyz", "^(foo|bar)$", "(ab)+c" if False else "(ab){1,3}c",
+    "(a|b)c", "a(b|c)d", "(?:ab|cd)+e" if False else "(?:ab|cd){1,2}e",
+    # escapes
+    r"a\.b", r"\(paren\)", r"\d{3}-\d{4}", r"\d{2}/\d{2}/\d{2}",
+    r"[\d\s]+", r"\x61+",
+    # lazy quantifiers (acceptance-equivalent)
+    "a+?b", "a*?b",
+]
+
+
+def subjects_table():
+    return pa.table({"s": pa.array(SUBJECTS, type=pa.string())})
+
+
+def oracle(pattern, subjects, mode="search"):
+    rx = re.compile(pattern)
+    out = []
+    for s in subjects:
+        if s is None:
+            out.append(None)
+        elif mode == "search":
+            out.append(bool(rx.search(s)))
+        else:
+            out.append(bool(rx.fullmatch(s)))
+    return out
+
+
+class TestDeviceMachine:
+    @pytest.mark.parametrize("pattern", PATTERNS)
+    def test_vs_python_re(self, pattern):
+        assert device_supported_pattern(pattern) is None, pattern
+        t = subjects_table()
+        cpu = eval_cpu(lambda: RLike(col("s"), lit(pattern)), t)
+        tpu = eval_tpu(lambda: RLike(col("s"), lit(pattern)), t)
+        expected = oracle(pattern, SUBJECTS)
+        assert cpu.to_pylist() == expected, f"CPU path wrong for {pattern!r}"
+        assert tpu.to_pylist() == expected, f"device machine wrong for {pattern!r}"
+
+    def test_long_subject_beyond_pattern(self):
+        subjects = ["a" * 40 + "b", "b" + "a" * 50, "c" * 55 + "ab"]
+        t = pa.table({"s": pa.array(subjects)})
+        for pattern in ["a+b$", "^ba+$", "ab$", "^c+ab$"]:
+            tpu = eval_tpu(lambda: RLike(col("s"), lit(pattern)), t)
+            assert tpu.to_pylist() == oracle(pattern, subjects), pattern
+
+
+class TestUnsupportedPatterns:
+    @pytest.mark.parametrize("pattern", [
+        r"(a)\1",          # backreference
+        r"(?=abc)",        # lookahead
+        r"(?<=a)b",        # lookbehind
+        r"\bword\b",       # word boundary
+        r"a*+",            # possessive
+        r"\p{Alpha}+",     # unicode property
+        "(ab)+",           # unbounded group repeat
+        "(a|b|c|d|e)(f|g|h|i|j)(k|l|m|n|o)",  # alternative explosion
+        "x{1,500}",        # expands past device item limit
+    ])
+    def test_rejected_with_reason(self, pattern):
+        reason = device_supported_pattern(pattern)
+        assert reason is not None, pattern
+
+    def test_planner_tags_unsupported_to_cpu(self):
+        from spark_rapids_tpu.config import TpuConf
+        from spark_rapids_tpu.plan.overrides import lookup_expr_rule
+        conf = TpuConf({})
+        e = RLike(col("s"), lit(r"(a)\1"))
+        m = lookup_expr_rule(e, conf)
+        m.tag_for_device(None)
+        assert any("not supported on TPU" in r for r in m.reasons)
+        e2 = RLike(col("s"), lit("abc"))
+        m2 = lookup_expr_rule(e2, conf)
+        m2.tag_for_device(None)
+        assert m2.can_run_on_device
+
+
+class TestLike:
+    def test_translation(self):
+        assert like_pattern_to_regex("abc%") == "^abc.*$"
+        assert like_pattern_to_regex("a_c") == "^a.c$"
+        assert like_pattern_to_regex("100\\%") == "^100\\%$"
+        assert like_pattern_to_regex("a.b") == "^a\\.b$"
+
+    @pytest.mark.parametrize("pattern", ["abc", "a%", "%b", "%ll%", "a_c",
+                                         "_b_", "%", "", "he__o%"])
+    def test_like_vs_oracle(self, pattern):
+        t = subjects_table()
+        rx = re.compile(like_pattern_to_regex(pattern), re.DOTALL)
+        expected = [None if s is None else bool(rx.match(s))
+                    for s in SUBJECTS]
+        cpu = eval_cpu(lambda: Like(col("s"), lit(pattern)), t)
+        tpu = eval_tpu(lambda: Like(col("s"), lit(pattern)), t)
+        assert cpu.to_pylist() == expected
+        assert tpu.to_pylist() == expected
+
+
+class TestReplaceExtract:
+    def test_replace(self):
+        t = pa.table({"s": pa.array(["a1b2", "nodigits", None, "33"])})
+        out = eval_cpu(lambda: RegExpReplace(col("s"), lit(r"\d+"),
+                                             lit("#")), t)
+        assert out.to_pylist() == ["a#b#", "nodigits", None, "#"]
+
+    def test_replace_group_ref(self):
+        t = pa.table({"s": pa.array(["john smith", "ada lovelace"])})
+        out = eval_cpu(lambda: RegExpReplace(col("s"), lit(r"(\w+) (\w+)"),
+                                             lit("$2 $1")), t)
+        assert out.to_pylist() == ["smith john", "lovelace ada"]
+
+    def test_extract(self):
+        t = pa.table({"s": pa.array(["2023-01-15", "no date", None])})
+        out = eval_cpu(lambda: RegExpExtract(col("s"),
+                                             lit(r"(\d+)-(\d+)-(\d+)"), 2), t)
+        assert out.to_pylist() == ["01", "", None]
+
+
+class TestFuzzRegressions:
+    """Cases surfaced by differential fuzzing against python re."""
+
+    @pytest.mark.parametrize("pattern", ["(a)+", r"(\d)*", "(x)?y"])
+    def test_grouped_single_class_repeats_compile(self, pattern):
+        assert device_supported_pattern(pattern) is None
+        subjects = ["aaa", "b", "", "123", "xy", "y"]
+        t = pa.table({"s": pa.array(subjects)})
+        tpu = eval_tpu(lambda: RLike(col("s"), lit(pattern)), t)
+        assert tpu.to_pylist() == oracle(pattern, subjects), pattern
+
+    @pytest.mark.parametrize("pattern", ["a?$", "[ab]*$", r"\d{0,2}$",
+                                         "b*$", "^a*$"])
+    def test_nullable_end_anchored(self, pattern):
+        subjects = ["bc", "", "a", "ba", "xyz", "99"]
+        t = pa.table({"s": pa.array(subjects)})
+        tpu = eval_tpu(lambda: RLike(col("s"), lit(pattern)), t)
+        assert tpu.to_pylist() == oracle(pattern, subjects), pattern
+
+    def test_dollar_matches_before_final_newline(self):
+        subjects = ["a", "a\n", "a\nb", "ab\n", "\n"]
+        t = pa.table({"s": pa.array(subjects)})
+        tpu = eval_tpu(lambda: RLike(col("s"), lit("a$")), t)
+        # python re '$' (no MULTILINE): end or before a final \n — same rule
+        # the device machine implements
+        assert tpu.to_pylist() == oracle("a$", subjects)
+
+    def test_bad_hex_escape_is_fallback_not_crash(self):
+        reason = device_supported_pattern(r"\xZZ")
+        assert reason is not None and "escape" in reason
+
+    def test_like_rejects_trailing_newline(self):
+        subjects = ["a", "a\n"]
+        t = pa.table({"s": pa.array(subjects)})
+        cpu = eval_cpu(lambda: Like(col("s"), lit("a")), t)
+        tpu = eval_tpu(lambda: Like(col("s"), lit("a")), t)
+        assert cpu.to_pylist() == [True, False]
+        assert tpu.to_pylist() == [True, False]
+
+
+class TestParserEdges:
+    def test_unclosed_class(self):
+        with pytest.raises(RegexUnsupportedError):
+            compile_device_plan("[abc")
+
+    def test_literal_open_brace(self):
+        # Java treats '{x' as a literal brace
+        assert device_supported_pattern("a{x}") is None
+        subjects = ["a{x}", "a", "ax"]
+        t = pa.table({"s": pa.array(subjects)})
+        tpu = eval_tpu(lambda: RLike(col("s"), lit("a\\{x\\}")), t)
+        assert tpu.to_pylist() == [True, False, False]
+
+    def test_class_with_metachars(self):
+        subjects = ["a.b", "axb", "a]b"]
+        t = pa.table({"s": pa.array(subjects)})
+        tpu = eval_tpu(lambda: RLike(col("s"), lit(r"a[.\]]b")), t)
+        assert tpu.to_pylist() == [True, False, True]
