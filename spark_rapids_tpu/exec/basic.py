@@ -57,14 +57,24 @@ class TpuProjectExec(UnaryTpuExec):
         self._schema = Schema(names, tuple(e.data_type for e in self._bound))
         bound = self._bound
 
-        @jax.jit
         def kernel(batch: ColumnarBatch):
             ctx = device_ctx(batch, self.conf)
             vecs = batch_vecs(batch)
             outs = [e.eval(ctx, vecs) for e in bound]
             return vecs_to_batch(self._schema, outs, batch.num_rows)
 
-        self._kernel = kernel
+        # a projection containing a host black box (pandas UDF) cannot be
+        # traced: run it eagerly — jnp ops still execute on device, and the
+        # UDF sees concrete arrays at the host hop. This is the in-process
+        # equivalent of the reference splitting ArrowEvalPython into its own
+        # exec (GpuArrowEvalPythonExec.scala:235).
+        self._kernel = kernel if self._has_host_black_box() else \
+            jax.jit(kernel)
+
+    def _has_host_black_box(self) -> bool:
+        from ..udf.pandas_udf import PandasUDF
+        return any(e.collect(lambda x: isinstance(x, PandasUDF))
+                   for e in self._bound)
 
     @property
     def output(self) -> Schema:

@@ -194,6 +194,21 @@ def _register_regex_exprs():
 _register_regex_exprs()
 
 
+def _register_udf_exprs():
+    from ..udf.pandas_udf import PandasUDF
+    from ..udf.spi import ColumnarUDFExpr
+    expr_rule(ColumnarUDFExpr, _basic,
+              doc="User columnar UDF (TpuUDF SPI, RapidsUDF.java analog): "
+                  "runs inside device kernels.")
+    expr_rule(PandasUDF, _basic, incompat=True,
+              doc="Arrow/pandas UDF: host round trip around the python "
+                  "function (GpuArrowEvalPythonExec analog); the projection "
+                  "containing it runs eagerly, not fused.")
+
+
+_register_udf_exprs()
+
+
 def _register_window_exprs():
     from ..expr import windowexprs as WX
     for cls in (WX.RowNumber, WX.Rank, WX.DenseRank, WX.PercentRank,
@@ -470,6 +485,17 @@ class Overrides:
             meta.child_metas.append(cm)
         if rule is not None and rule.expr_fn is not None:
             rule.expr_fn(meta)
+        if rule is not None and not isinstance(plan, N.CpuProjectExec):
+            # a pandas UDF is a host black box: only TpuProjectExec knows to
+            # run its kernel eagerly (GpuArrowEvalPythonExec analog); any
+            # other exec would trace it inside jit and crash
+            from ..udf.pandas_udf import PandasUDF
+            for em in meta.expr_metas:
+                if em.expr.collect(lambda x: isinstance(x, PandasUDF)):
+                    meta.will_not_work(
+                        "pandas UDFs are only supported in projections on "
+                        "TPU (project the UDF into a column first)")
+                    break
         meta.tag_for_device()
 
         if self.conf.is_test_enabled and not meta.can_run_on_device:

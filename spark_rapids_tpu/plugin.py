@@ -97,6 +97,43 @@ class TpuSession:
         merged = _concat_host(host_batches, plan.output)
         return host_batch_to_arrow(merged)
 
+    def execute_plan_device_batches(self, plan: PhysicalPlan):
+        """Run a plan fully on the TPU engine and return the DEVICE batches
+        (no D2H) — the ColumnarRdd/ML-handoff path (`ColumnarRdd.scala:42`).
+        Raises if any plan section fell back to CPU (a host hop would defeat
+        the zero-copy contract)."""
+        from .exec.base import TpuExec
+        from .exec.transitions import TpuFromCpuExec
+        self.initialize_device()
+        ov = Overrides(self.conf)
+        saved = self.conf.get("spark.rapids.sql.explain")
+        self.conf.set("spark.rapids.sql.explain", "ALL")
+        try:
+            result = ov.apply(plan)
+        finally:
+            self.conf.set("spark.rapids.sql.explain", saved)
+
+        def has_cpu_section(node) -> bool:
+            if isinstance(node, TpuFromCpuExec):
+                return True
+            return any(has_cpu_section(c) for c in node.children)
+
+        if not isinstance(result, TpuExec) or has_cpu_section(result):
+            raise RuntimeError(
+                "plan did not fully convert to TPU execution; zero-copy "
+                "device handoff needs an all-device plan:\n"
+                + ov.explain_string())
+        return list(result.execute())
+
+    def from_device_batch(self, batch):
+        """Wrap an existing device batch as a DataFrame source (inverse
+        ML handoff; see udf/columnar_rdd.py)."""
+        from .exec.transitions import device_batch_to_host
+        from .cpu.hostbatch import host_batch_to_arrow
+        return self.from_arrow(
+            host_batch_to_arrow(device_batch_to_host(batch)),
+            label="device-handoff")
+
     def explain_plan(self, plan: PhysicalPlan) -> str:
         ov = Overrides(self.conf)
         saved = self.conf.get("spark.rapids.sql.explain")

@@ -1,0 +1,20 @@
+"""UDF & ML integration layer (reference SURVEY.md §2.8).
+
+Four pieces, mirroring the reference:
+  * compiler.py — python-function -> Expression compiler (the role
+    `udf-compiler/` plays for Scala bytecode -> Catalyst): a compiled UDF
+    becomes an ordinary expression tree, planned and executed on device like
+    any built-in.
+  * spi.py — TpuUDF SPI (`RapidsUDF.java` analog): users hand-write a
+    device-columnar implementation and get device execution.
+  * pandas_udf.py — Arrow-based pandas UDFs (`GpuArrowEvalPythonExec.scala`
+    analog): host round trip with a batch queue; the worker pool limit plays
+    the PythonWorkerSemaphore role.
+  * columnar_rdd.py — zero-copy DataFrame <-> JAX arrays handoff
+    (`ColumnarRdd.scala:42` / ML-integration analog).
+"""
+
+from .compiler import UdfCompileError, compile_udf, python_udf_to_expr  # noqa: F401
+from .spi import TpuUDF, ColumnarUDFExpr  # noqa: F401
+from .pandas_udf import PandasUDF, pandas_udf  # noqa: F401
+from .columnar_rdd import to_jax, from_jax  # noqa: F401

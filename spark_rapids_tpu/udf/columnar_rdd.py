@@ -1,0 +1,76 @@
+"""Zero-copy DataFrame <-> JAX handoff for ML.
+
+Reference: `ColumnarRdd.scala:42` / `InternalColumnarRddConverter.scala` /
+`GpuBringBackToHost.scala` export a DataFrame as `RDD[cudf.Table]` so XGBoost
+consumes device memory without a host round trip (doc
+`docs/additional-functionality/ml-integration.md`). Here the batches already
+hold jax arrays in HBM, so the handoff is literally the arrays: `to_jax`
+executes the plan on device and returns the device columns (no D2H), and
+`from_jax` wraps arrays back into a DataFrame source."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .. import types as T
+
+__all__ = ["to_jax", "from_jax"]
+
+
+def to_jax(df) -> Dict[str, Tuple]:
+    """Execute `df` on the TPU engine and return
+    {column: (data, validity[, lengths])} of DEVICE arrays, sliced info kept
+    as (arrays, num_rows) — arrays stay padded (capacity) with `num_rows`
+    live rows, ready to feed a jax model without leaving HBM."""
+    batches = df.session.execute_plan_device_batches(df.plan)
+    from ..exec.coalesce import concat_batches
+    batch = concat_batches(batches)
+    out: Dict[str, Tuple] = {"__num_rows__": int(batch.row_count())}
+    for name, col in zip(batch.schema.names, batch.columns):
+        if col.lengths is None:
+            out[name] = (col.data, col.validity)
+        else:
+            out[name] = (col.data, col.validity, col.lengths)
+    return out
+
+
+def from_jax(session, arrays: Dict[str, Tuple], num_rows: Optional[int] = None):
+    """Wrap device arrays back into a DataFrame (inverse handoff; this
+    direction materializes through the host scan source — the export path
+    `to_jax` is the zero-copy one). `arrays` maps column name ->
+    (data, validity) jax arrays; all leading dims must match. Types are
+    inferred from array dtypes."""
+    import jax.numpy as jnp
+    from ..columnar.batch import ColumnarBatch, Schema
+    from ..columnar.column import Column
+    items = [(k, v) for k, v in arrays.items() if k != "__num_rows__"]
+    if num_rows is None:
+        num_rows = arrays.get("__num_rows__")
+    if num_rows is None:
+        raise ValueError("num_rows required (or a __num_rows__ key)")
+    names, tps, cols = [], [], []
+    for name, parts in items:
+        data, validity = parts[0], parts[1]
+        lengths = parts[2] if len(parts) > 2 else None
+        if lengths is not None:
+            dt = T.STRING
+        else:
+            dt = _dtype_from_np(np.dtype(data.dtype))
+        names.append(name)
+        tps.append(dt)
+        cols.append(Column(dt, data, validity, lengths))
+    batch = ColumnarBatch(Schema(tuple(names), tuple(tps)), tuple(cols),
+                          jnp.asarray(num_rows, dtype=jnp.int32))
+    return session.from_device_batch(batch)
+
+
+def _dtype_from_np(npdt: np.dtype) -> T.DataType:
+    table = {np.dtype(np.bool_): T.BOOLEAN, np.dtype(np.int8): T.BYTE,
+             np.dtype(np.int16): T.SHORT, np.dtype(np.int32): T.INT,
+             np.dtype(np.int64): T.LONG, np.dtype(np.float32): T.FLOAT,
+             np.dtype(np.float64): T.DOUBLE}
+    if npdt not in table:
+        raise TypeError(f"no SQL type for array dtype {npdt}")
+    return table[npdt]

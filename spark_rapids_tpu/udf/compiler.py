@@ -1,0 +1,322 @@
+"""Python UDF -> Expression compiler.
+
+Reference: `udf-compiler/` (2,353 LoC) decompiles simple Scala-UDF JVM
+bytecode into Catalyst expressions (`CFG.scala`, `Instruction.scala`,
+`CatalystExpressionBuilder.scala`), so the UDF stops being a black box and is
+planned/fused like a built-in. The TPU analog works on the Python AST instead
+of JVM bytecode — same idea, friendlier source: a restricted subset of Python
+(arithmetic, comparisons, boolean logic, conditionals, math calls, string
+methods) is translated into this framework's expression IR. Anything outside
+the subset raises `UdfCompileError` and the caller falls back to a pandas UDF
+(host round trip), exactly like the reference falls back to the row-based
+black-box UDF when decompilation fails.
+
+Semantics note: the produced expression has SPARK semantics (e.g. `%` maps to
+`pmod`, matching Python's sign rule for positive divisors; integer `/` is
+float division in both languages).
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .. import types as T
+from ..expr import arithmetic as EA
+from ..expr import conditional as ECO
+from ..expr import math_ as EM
+from ..expr import predicates as EP
+from ..expr import strings as ES
+from ..expr.base import Expression, Literal
+
+__all__ = ["UdfCompileError", "python_udf_to_expr", "compile_udf"]
+
+
+class UdfCompileError(ValueError):
+    """The function uses Python outside the compilable subset."""
+
+
+_BINOPS = {
+    ast.Add: EA.Add,
+    ast.Sub: EA.Subtract,
+    ast.Mult: EA.Multiply,
+    ast.Div: EA.Divide,
+    ast.FloorDiv: EA.IntegralDivide,
+    ast.Mod: EA.Pmod,          # python sign rule == pmod for divisor > 0
+    ast.Pow: EM.Pow,
+}
+
+_CMPOPS = {
+    ast.Eq: EP.EqualTo,
+    ast.NotEq: lambda a, b: EP.Not(EP.EqualTo(a, b)),
+    ast.Lt: EP.LessThan,
+    ast.LtE: EP.LessThanOrEqual,
+    ast.Gt: EP.GreaterThan,
+    ast.GtE: EP.GreaterThanOrEqual,
+}
+
+_MATH_CALLS = {
+    "sqrt": EM.Sqrt, "exp": EM.Exp, "log": EM.Log, "log10": EM.Log10,
+    "log2": EM.Log2, "sin": EM.Sin, "cos": EM.Cos, "tan": EM.Tan,
+    "asin": EM.Asin, "acos": EM.Acos, "atan": EM.Atan, "sinh": EM.Sinh,
+    "cosh": EM.Cosh, "tanh": EM.Tanh, "floor": EM.Floor, "ceil": EM.Ceil,
+    "degrees": EM.ToDegrees, "radians": EM.ToRadians,
+}
+
+_STR_METHODS = {
+    "upper": ES.Upper, "lower": ES.Lower, "strip": ES.StringTrim,
+    "lstrip": ES.StringTrimLeft, "rstrip": ES.StringTrimRight,
+}
+
+_STR_METHODS_2 = {
+    "startswith": ES.StartsWith, "endswith": ES.EndsWith,
+}
+
+
+class _Translator:
+    def __init__(self, env: Dict[str, Expression], fn_name: str):
+        self.env = dict(env)
+        self.fn_name = fn_name
+
+    def fail(self, node, msg: str):
+        raise UdfCompileError(
+            f"udf {self.fn_name}: line {getattr(node, 'lineno', '?')}: {msg}")
+
+    # -- statements ---------------------------------------------------------
+    def run_body(self, body: List[ast.stmt]) -> Expression:
+        """Translate a statement list to the expression it returns. Supports
+        straight-line assignments and fully-returning if/elif/else trees (the
+        CFG shapes the reference's bytecode decompiler accepts)."""
+        for i, stmt in enumerate(body):
+            if isinstance(stmt, ast.Return):
+                if stmt.value is None:
+                    self.fail(stmt, "bare return (must return a value)")
+                return self.expr(stmt.value)
+            if isinstance(stmt, ast.Assign):
+                if len(stmt.targets) != 1 or \
+                        not isinstance(stmt.targets[0], ast.Name):
+                    self.fail(stmt, "only simple single-name assignment")
+                self.env[stmt.targets[0].id] = self.expr(stmt.value)
+                continue
+            if isinstance(stmt, ast.AugAssign):
+                if not isinstance(stmt.target, ast.Name):
+                    self.fail(stmt, "only simple augmented assignment")
+                name = stmt.target.id
+                if name not in self.env:
+                    self.fail(stmt, f"augmented assign to unbound {name!r}")
+                cls = _BINOPS.get(type(stmt.op))
+                if cls is None:
+                    self.fail(stmt, "unsupported augmented operator")
+                self.env[name] = cls(self.env[name], self.expr(stmt.value))
+                continue
+            if isinstance(stmt, ast.Import):
+                if all(a.name == "math" and a.asname is None
+                       for a in stmt.names):
+                    continue  # `import math` inside the body is fine
+                self.fail(stmt, "only `import math` is allowed in a udf")
+            if isinstance(stmt, ast.If):
+                cond = self.expr(stmt.test)
+                # the else path is the explicit orelse plus the fallthrough
+                # continuation (unreachable statements after a returning else
+                # are harmless)
+                else_body = stmt.orelse + body[i + 1:]
+                if not else_body:
+                    self.fail(stmt, "if-branch with no else and no "
+                                    "following statements")
+                if _always_returns(stmt.body):
+                    then_t = _Translator(self.env, self.fn_name)
+                    then_e = then_t.run_body(stmt.body)
+                    else_t = _Translator(self.env, self.fn_name)
+                    else_e = else_t.run_body(else_body)
+                    return ECO.If(cond, then_e, else_e)
+                self.fail(stmt, "if-branches must return (no fallthrough "
+                                "merges; restructure as expressions)")
+            self.fail(stmt, f"unsupported statement {type(stmt).__name__}")
+        self.fail(body[-1] if body else ast.Pass(),
+                  "function body never returns")
+
+    # -- expressions --------------------------------------------------------
+    def expr(self, node: ast.expr) -> Expression:
+        if isinstance(node, ast.Constant):
+            if node.value is None or isinstance(node.value,
+                                                (bool, int, float, str)):
+                return Literal(node.value)
+            self.fail(node, f"unsupported constant {node.value!r}")
+        if isinstance(node, ast.Name):
+            if node.id in self.env:
+                return self.env[node.id]
+            self.fail(node, f"unbound name {node.id!r}")
+        if isinstance(node, ast.BinOp):
+            cls = _BINOPS.get(type(node.op))
+            if cls is None:
+                self.fail(node, f"operator {type(node.op).__name__}")
+            left, right = self.expr(node.left), self.expr(node.right)
+            if isinstance(node.op, ast.Add) and _is_stringy(left, right):
+                return ES.Concat(left, right)
+            return cls(left, right)
+        if isinstance(node, ast.UnaryOp):
+            if isinstance(node.op, ast.USub):
+                return EA.UnaryMinus(self.expr(node.operand))
+            if isinstance(node.op, ast.Not):
+                return EP.Not(self.expr(node.operand))
+            self.fail(node, f"unary {type(node.op).__name__}")
+        if isinstance(node, ast.BoolOp):
+            cls = EP.And if isinstance(node.op, ast.And) else EP.Or
+            out = self.expr(node.values[0])
+            for v in node.values[1:]:
+                out = cls(out, self.expr(v))
+            return out
+        if isinstance(node, ast.Compare):
+            parts = []
+            left = self.expr(node.left)
+            for op, comp in zip(node.ops, node.comparators):
+                right = self.expr(comp)
+                if isinstance(op, (ast.In, ast.NotIn)):
+                    # needle must not be an obviously-non-string literal;
+                    # unresolved column types defer to binding-time checks
+                    if isinstance(node.left, ast.Constant) and \
+                            not isinstance(node.left.value, str):
+                        self.fail(node, "`in` only supported for strings")
+                    e = ES.Contains(right, left)  # 'x' in s => Contains(s,x)
+                    parts.append(EP.Not(e) if isinstance(op, ast.NotIn)
+                                 else e)
+                else:
+                    cls = _CMPOPS.get(type(op))
+                    if cls is None:
+                        self.fail(node, f"comparison {type(op).__name__}")
+                    parts.append(cls(left, right))
+                left = right
+            out = parts[0]
+            for p in parts[1:]:
+                out = EP.And(out, p)
+            return out
+        if isinstance(node, ast.IfExp):
+            return ECO.If(self.expr(node.test), self.expr(node.body),
+                          self.expr(node.orelse))
+        if isinstance(node, ast.Call):
+            return self.call(node)
+        if isinstance(node, ast.Subscript):
+            self.fail(node, "subscripts are not compilable")
+        self.fail(node, f"unsupported expression {type(node).__name__}")
+
+    def call(self, node: ast.Call) -> Expression:
+        if node.keywords:
+            self.fail(node, "keyword arguments are not compilable")
+        args = [self.expr(a) for a in node.args]
+        f = node.func
+        if isinstance(f, ast.Name):
+            name = f.id
+            if name == "abs" and len(args) == 1:
+                return EA.Abs(args[0])
+            if name == "len" and len(args) == 1:
+                return ES.Length(args[0])
+            if name == "min" and len(args) >= 2:
+                return ECO.Least(*args)
+            if name == "max" and len(args) >= 2:
+                return ECO.Greatest(*args)
+            if name == "round" and len(args) in (1, 2):
+                if len(args) == 1:
+                    return EM.Round(args[0], 0)
+                sc = node.args[1]
+                if not (isinstance(sc, ast.Constant)
+                        and isinstance(sc.value, int)):
+                    self.fail(node, "round() scale must be an int literal")
+                return EM.Round(args[0], sc.value)
+            if name == "float" and len(args) == 1:
+                from ..expr.cast import Cast
+                return Cast(args[0], T.DOUBLE)
+            if name == "int" and len(args) == 1:
+                from ..expr.cast import Cast
+                return Cast(args[0], T.LONG)
+            if name == "str" and len(args) == 1:
+                from ..expr.cast import Cast
+                return Cast(args[0], T.STRING)
+            self.fail(node, f"call to {name!r} is not compilable")
+        if isinstance(f, ast.Attribute):
+            # math.xxx(arg) or string_expr.method(...)
+            if isinstance(f.value, ast.Name) and f.value.id == "math":
+                cls = _MATH_CALLS.get(f.attr)
+                if cls is not None and len(args) == 1:
+                    return cls(args[0])
+                if f.attr == "pow" and len(args) == 2:
+                    return EM.Pow(*args)
+                self.fail(node, f"math.{f.attr} is not compilable")
+            recv = self.expr(f.value)
+            if f.attr in _STR_METHODS and not args:
+                return _STR_METHODS[f.attr](recv)
+            if f.attr in _STR_METHODS_2 and len(args) == 1:
+                return _STR_METHODS_2[f.attr](recv, args[0])
+            self.fail(node, f"method .{f.attr}() is not compilable")
+        self.fail(node, "unsupported call form")
+
+
+def _always_returns(body: List[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, ast.Return):
+            return True
+        if isinstance(stmt, ast.If) and stmt.orelse and \
+                _always_returns(stmt.body) and _always_returns(stmt.orelse):
+            return True
+    return False
+
+
+def _is_stringy(*exprs: Expression) -> bool:
+    for e in exprs:
+        try:
+            if isinstance(e.data_type, T.StringType):
+                return True
+        except Exception:
+            pass
+    return False
+
+
+def python_udf_to_expr(fn: Callable,
+                       args: Sequence[Expression]) -> Expression:
+    """Compile fn(*args) into an expression tree, or raise UdfCompileError."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError) as e:
+        raise UdfCompileError(f"cannot get source of {fn!r}: {e}")
+    tree = ast.parse(src)
+    fdefs = [n for n in ast.walk(tree) if isinstance(n, ast.FunctionDef)]
+    if isinstance(tree.body[0], ast.FunctionDef):
+        fdef = tree.body[0]
+    elif fdefs:
+        fdef = fdefs[0]
+    else:
+        # lambda source: grab the Lambda node
+        lambdas = [n for n in ast.walk(tree) if isinstance(n, ast.Lambda)]
+        if not lambdas:
+            raise UdfCompileError(f"no function definition found in {src!r}")
+        lam = lambdas[0]
+        params = [a.arg for a in lam.args.args]
+        if len(params) != len(args):
+            raise UdfCompileError(
+                f"lambda takes {len(params)} args, given {len(args)}")
+        tr = _Translator(dict(zip(params, args)), "<lambda>")
+        return tr.expr(lam.body)
+    params = [a.arg for a in fdef.args.args]
+    if fdef.args.vararg or fdef.args.kwarg or fdef.args.kwonlyargs:
+        raise UdfCompileError("*args/**kwargs are not compilable")
+    if len(params) != len(args):
+        raise UdfCompileError(
+            f"{fn.__name__} takes {len(params)} args, given {len(args)}")
+    tr = _Translator(dict(zip(params, args)), fdef.name)
+    return tr.run_body(fdef.body)
+
+
+def compile_udf(fn: Callable):
+    """Decorator: use as `@compile_udf`; calling the result with column
+    expressions yields the compiled expression tree (or raises). The
+    uncompiled python function stays available as `.fn` for the pandas
+    fallback path."""
+
+    @functools.wraps(fn)
+    def wrapper(*args: Expression) -> Expression:
+        return python_udf_to_expr(fn, args)
+
+    wrapper.fn = fn
+    return wrapper

@@ -1,0 +1,166 @@
+"""Arrow-based pandas UDFs.
+
+Reference: `GpuArrowEvalPythonExec.scala:235` + `BatchQueue` (`:174`),
+`PythonWorkerSemaphore.scala`, worker-side RMM init (`python/rapids/
+daemon.py`, `worker.py`). The reference crosses JVM -> forked python workers
+over Arrow IPC; this framework already IS python, so the "worker" is an
+in-process thread pool bounded by a semaphore (the PythonWorkerSemaphore
+role), and the Arrow hop becomes device->host conversion around the user
+function. The expression works on both engines: the CPU engine calls the
+function on exact-length pandas data; the device path pulls the batch to
+host, runs the function, and pushes the result back padded."""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from .. import types as T
+from ..config import get_default_conf
+from ..expr.base import Expression, EvalContext, Vec
+
+__all__ = ["PandasUDF", "pandas_udf", "PythonWorkerSemaphore"]
+
+
+class PythonWorkerSemaphore:
+    """Bounds concurrent python UDF evaluations (PythonWorkerSemaphore.scala:
+    limits how many workers share the device)."""
+
+    _instance: Optional["PythonWorkerSemaphore"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, permits: int):
+        self._sem = threading.Semaphore(permits)
+        self.permits = permits
+
+    @classmethod
+    def get(cls, permits: Optional[int] = None) -> "PythonWorkerSemaphore":
+        """Process-wide semaphore sized by the caller's conf; resized (when
+        idle-compatible) if a session with a different limit comes along."""
+        if permits is None:
+            permits = get_default_conf().get(
+                "spark.rapids.sql.concurrentGpuTasks")
+        with cls._lock:
+            if cls._instance is None or cls._instance.permits != permits:
+                cls._instance = PythonWorkerSemaphore(permits)
+            return cls._instance
+
+    def __enter__(self):
+        self._sem.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self._sem.release()
+
+
+class PandasUDF(Expression):
+    """fn receives one pandas Series per argument (nulls as NaN/None) and must
+    return a Series/array of the declared return type."""
+
+    def __init__(self, fn: Callable, return_type: T.DataType,
+                 children: Sequence[Expression]):
+        super().__init__(list(children))
+        self.fn = fn
+        self._dtype = return_type
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self._dtype
+
+    deterministic = False  # black box: keep the planner conservative
+
+    def _compute(self, ctx: EvalContext, *vecs: Vec) -> Vec:
+        import jax
+        if ctx.is_device and isinstance(vecs[0].data, jax.core.Tracer):
+            raise RuntimeError(
+                "PandasUDF cannot run inside a jitted kernel; the exec "
+                "evaluates it at the host boundary (planner arranges this)")
+        import pandas as pd
+        n = int(np.asarray(vecs[0].validity).shape[0]) if ctx.row_mask is None \
+            else int(np.asarray(ctx.row_mask).sum())
+        series = [pd.Series(_vec_to_host(v, n),
+                            dtype=object if v.is_string else None)
+                  for v in vecs]
+        permits = ctx.conf.get("spark.rapids.sql.concurrentGpuTasks") \
+            if ctx.conf is not None else None
+        with PythonWorkerSemaphore.get(permits):
+            out = self.fn(*series)
+        return _host_to_vec(ctx.xp, np.asarray(pd.Series(out)), self._dtype,
+                            vecs[0].validity, n)
+
+    def __repr__(self):
+        return f"PandasUDF:{getattr(self.fn, '__name__', '<fn>')}" \
+               f"({', '.join(map(repr, self.children))})"
+
+
+def pandas_udf(return_type: T.DataType):
+    """Decorator: `@pandas_udf(T.DOUBLE)` then call with column exprs."""
+
+    def deco(fn: Callable):
+        def wrapper(*args: Expression) -> PandasUDF:
+            return PandasUDF(fn, return_type, list(args))
+
+        wrapper.fn = fn
+        return wrapper
+
+    return deco
+
+
+def _vec_to_host(v: Vec, n: int):
+    valid = np.asarray(v.validity)[:n]
+    if v.is_string:
+        chars = np.asarray(v.data)[:n]
+        lens = np.asarray(v.lengths)[:n]
+        return [bytes(chars[i, :lens[i]]).decode("utf-8", "replace")
+                if valid[i] else None for i in range(n)]
+    data = np.asarray(v.data)[:n]
+    if np.issubdtype(data.dtype, np.floating):
+        return np.where(valid, data, np.nan)
+    if valid.all():
+        return data
+    out = data.astype(object)
+    out[~valid] = None
+    return out
+
+
+def _host_to_vec(xp, arr: np.ndarray, dtype: T.DataType, validity_like,
+                 n: int) -> Vec:
+    cap = np.asarray(validity_like).shape[0]
+    if isinstance(dtype, T.StringType):
+        from ..columnar.padding import width_bucket
+        enc = [x.encode("utf-8") if isinstance(x, str) else None for x in arr]
+        w = width_bucket(max((len(b) for b in enc if b is not None),
+                             default=1) or 1)
+        data = np.zeros((cap, w), np.uint8)
+        lens = np.zeros(cap, np.int32)
+        valid = np.zeros(cap, bool)
+        for i, b in enumerate(enc):
+            if b is None:
+                continue
+            data[i, :len(b)] = np.frombuffer(b, np.uint8)
+            lens[i] = len(b)
+            valid[i] = True
+        return Vec(dtype, xp.asarray(data), xp.asarray(valid),
+                   xp.asarray(lens))
+    npdt = dtype.np_dtype
+    if arr.dtype == object:
+        valid_n = np.array([x is not None and x == x for x in arr])
+        vals = np.array([x if (x is not None and x == x) else 0
+                         for x in arr]).astype(npdt)
+    elif np.issubdtype(arr.dtype, np.floating) and \
+            not np.issubdtype(npdt, np.floating):
+        valid_n = ~np.isnan(arr)
+        vals = np.where(valid_n, arr, 0).astype(npdt)
+    else:
+        valid_n = np.ones(len(arr), bool)
+        if np.issubdtype(arr.dtype, np.floating):
+            valid_n = ~np.isnan(arr) if not np.issubdtype(npdt, np.floating) \
+                else valid_n
+        vals = arr.astype(npdt)
+    data = np.zeros(cap, npdt)
+    valid = np.zeros(cap, bool)
+    data[:n] = vals[:n]
+    valid[:n] = valid_n[:n]
+    return Vec(dtype, xp.asarray(data), xp.asarray(valid))

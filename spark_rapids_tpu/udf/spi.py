@@ -1,0 +1,65 @@
+"""Columnar UDF SPI.
+
+Reference: `RapidsUDF.java` — users implement `evaluateColumnar(ColumnVector
+...)` to get native-speed UDF execution instead of the row-based black box
+(`GpuUserDefinedFunction.scala`, doc `docs/additional-functionality/
+rapids-udfs.md`). The TPU analog: subclass `TpuUDF` and implement
+`evaluate_columnar(xp, *vecs) -> Vec` with array ops — it runs inside the
+jitted kernels on device, AND serves as its own CPU differential peer (xp is
+numpy on the CPU engine)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from .. import types as T
+from ..expr.base import Expression, EvalContext, Vec
+
+__all__ = ["TpuUDF", "ColumnarUDFExpr"]
+
+
+class TpuUDF:
+    """User-implemented columnar UDF: declare the return type and implement
+    the computation xp-generically (jnp under jit on device, numpy on the
+    CPU engine)."""
+
+    #: the Spark return type of the UDF
+    return_type: T.DataType = T.DOUBLE
+    #: is the result row-for-row deterministic (affects planning)
+    deterministic: bool = True
+
+    def evaluate_columnar(self, xp, *vecs: Vec) -> Vec:
+        raise NotImplementedError
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def __call__(self, *args: Expression) -> "ColumnarUDFExpr":
+        return ColumnarUDFExpr(self, list(args))
+
+
+class ColumnarUDFExpr(Expression):
+    """Expression node wrapping a TpuUDF (GpuUserDefinedFunction analog)."""
+
+    def __init__(self, udf: TpuUDF, children: Sequence[Expression]):
+        super().__init__(list(children))
+        self.udf = udf
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.udf.return_type
+
+    @property
+    def deterministic(self) -> bool:  # type: ignore[override]
+        return self.udf.deterministic
+
+    def _compute(self, ctx: EvalContext, *vecs: Vec) -> Vec:
+        out = self.udf.evaluate_columnar(ctx.xp, *vecs)
+        if not isinstance(out, Vec):
+            raise TypeError(
+                f"TpuUDF {self.udf.name}.evaluate_columnar must return a Vec")
+        return out
+
+    def __repr__(self):
+        return f"{self.udf.name}({', '.join(map(repr, self.children))})"

@@ -1,0 +1,232 @@
+"""UDF layer tests (reference model: `udf-compiler` suites +
+`integration_tests/.../udf_test.py` / `udf_cudf_test.py`)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr import col, lit
+from spark_rapids_tpu.expr.base import Vec
+from spark_rapids_tpu.plugin import TpuSession
+from spark_rapids_tpu.udf import (ColumnarUDFExpr, PandasUDF, TpuUDF,
+                                  UdfCompileError, compile_udf, from_jax,
+                                  pandas_udf, python_udf_to_expr, to_jax)
+
+from test_queries import assert_same
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession({"spark.rapids.sql.enabled": True,
+                       "spark.rapids.sql.explain": "NONE"})
+
+
+def udf_table(rng, n=300):
+    nulls = rng.random(n) < 0.15
+    return pa.table({
+        "x": pa.array(np.where(nulls, 0, rng.integers(-100, 100, n)),
+                      type=pa.int64(), mask=nulls),
+        "y": pa.array(rng.normal(0, 10, n), type=pa.float64()),
+        "s": pa.array([["ab", "Hello World", "  pad  ", "zz"][i]
+                       for i in rng.integers(0, 4, n)]),
+    })
+
+
+class TestUdfCompiler:
+    def test_arithmetic(self, session, rng):
+        @compile_udf
+        def f(x, y):
+            return x * 2 + y / 3 - 1
+
+        df = session.from_arrow(udf_table(rng))
+        q = df.select(col("x"), f(col("x"), col("y")).alias("r"))
+        assert_same(q, sort_by=["x", "r"], approx_cols=("r",))
+
+    def test_conditionals(self, session, rng):
+        @compile_udf
+        def f(x):
+            if x > 50:
+                return 2 * x
+            elif x < -50:
+                return -x
+            else:
+                return 0
+
+        df = session.from_arrow(udf_table(rng))
+        q = df.select(col("x"), f(col("x")).alias("r"))
+        assert_same(q, sort_by=["x", "r"])
+
+    def test_assignments_and_ternary(self, session, rng):
+        @compile_udf
+        def f(x, y):
+            a = x + 1
+            a += 2
+            b = a * a
+            return b if y > 0 else -b
+
+        df = session.from_arrow(udf_table(rng))
+        q = df.select(col("x"), f(col("x"), col("y")).alias("r"))
+        assert_same(q, sort_by=["x", "r"])
+
+    def test_math_and_builtins(self, session, rng):
+        @compile_udf
+        def f(x, y):
+            import math
+            return math.sqrt(abs(x)) + min(x, 10) + max(y, 0.0)
+
+        df = session.from_arrow(udf_table(rng))
+        q = df.select(col("x"), f(col("x"), col("y")).alias("r"))
+        assert_same(q, sort_by=["x", "r"], approx_cols=("r",))
+
+    def test_string_methods(self, session, rng):
+        @compile_udf
+        def f(s):
+            return s.strip().upper()
+
+        df = session.from_arrow(udf_table(rng))
+        q = df.select(col("s"), f(col("s")).alias("r"))
+        assert_same(q, sort_by=["s", "r"])
+
+    def test_string_predicates(self, session, rng):
+        @compile_udf
+        def f(s):
+            return s.startswith("H") or "z" in s
+
+        df = session.from_arrow(udf_table(rng))
+        q = df.select(col("s"), f(col("s")).alias("r"))
+        assert_same(q, sort_by=["s", "r"])
+
+    def test_lambda(self):
+        e = python_udf_to_expr(lambda x: x + 1, [col("a")])
+        assert "Add" in type(e).__name__
+
+    def test_comparison_chain(self, session, rng):
+        @compile_udf
+        def f(x):
+            return 0 < x < 50
+
+        df = session.from_arrow(udf_table(rng))
+        q = df.select(col("x"), f(col("x")).alias("r"))
+        assert_same(q, sort_by=["x", "r"])
+
+    @pytest.mark.parametrize("bad", [
+        lambda: compile_udf(lambda x: [x])(col("a")),          # list
+        lambda: compile_udf(lambda x: x[0])(col("a")),         # subscript
+        lambda: compile_udf(lambda x: print(x))(col("a")),     # call
+    ])
+    def test_uncompilable_raises(self, bad):
+        with pytest.raises(UdfCompileError):
+            bad()
+
+    def test_loop_rejected(self):
+        def f(x):
+            total = x
+            for i in range(3):
+                total = total + i
+            return total
+
+        with pytest.raises(UdfCompileError, match="For"):
+            python_udf_to_expr(f, [col("a")])
+
+
+class TestUdfCompilerRegressions:
+    def test_else_branch_with_fallthrough(self, session, rng):
+        @compile_udf
+        def g(x):
+            if x > 0:
+                return 1.0
+            else:
+                y = 2.0
+            return y
+
+        df = session.from_arrow(udf_table(rng, n=50))
+        q = df.select(col("x"), g(col("x")).alias("r"))
+        assert_same(q, sort_by=["x", "r"])
+
+    def test_pandas_udf_in_filter_falls_back(self, session, rng):
+        @pandas_udf(T.DOUBLE)
+        def ident(y):
+            return y
+
+        df = session.from_arrow(udf_table(rng, n=50))
+        q = df.filter(ident(col("y")) > lit(0.0))
+        out = q.collect()  # must not crash: planner keeps the filter on CPU
+        assert all(v > 0 for v in out.column("y").to_pylist())
+        assert "only supported in projections" in q.explain()
+
+
+class TestColumnarUdfSpi:
+    def test_device_columnar_udf(self, session, rng):
+        class Clamp(TpuUDF):
+            return_type = T.DOUBLE
+
+            def evaluate_columnar(self, xp, v):
+                return Vec(T.DOUBLE, xp.clip(v.data, -5.0, 5.0), v.validity)
+
+        df = session.from_arrow(udf_table(rng))
+        q = df.select(col("y"), Clamp()(col("y")).alias("r"))
+        out = assert_same(q, sort_by=["y", "r"])
+        vals = [v for v in out.column("r").to_pylist() if v is not None]
+        assert all(-5.0 <= v <= 5.0 for v in vals)
+        # it planned onto the device (no fallback reasons)
+        assert "!" not in q.explain().split("\n")[0]
+
+
+class TestPandasUdf:
+    def test_pandas_udf_roundtrip(self, session, rng):
+        @pandas_udf(T.DOUBLE)
+        def plus_mean(x):
+            return x + x.mean()
+
+        df = session.from_arrow(udf_table(rng, n=100))
+        q = df.select(col("y"), plus_mean(col("y")).alias("r"))
+        tpu = q.collect()
+        ys = np.array([v for v in tpu.column("y").to_pylist()])
+        rs = np.array([v for v in tpu.column("r").to_pylist()])
+        assert np.allclose(rs, ys + ys.mean(), rtol=1e-9)
+
+    def test_pandas_udf_nulls(self, session):
+        t = pa.table({"x": pa.array([1, None, 3], type=pa.int64())})
+
+        @pandas_udf(T.LONG)
+        def double(x):
+            return x * 2
+
+        df = session.from_arrow(t)
+        out = df.select(double(col("x")).alias("r")).collect()
+        assert out.column("r").to_pylist() == [2, None, 6]
+
+    def test_pandas_udf_strings(self, session):
+        t = pa.table({"s": pa.array(["a", None, "cc"])})
+
+        @pandas_udf(T.STRING)
+        def shout(s):
+            return s.map(lambda v: v.upper() + "!" if v is not None else None)
+
+        df = session.from_arrow(t)
+        out = df.select(shout(col("s")).alias("r")).collect()
+        assert out.column("r").to_pylist() == ["A!", None, "CC!"]
+
+
+class TestJaxHandoff:
+    def test_to_jax_zero_copy(self, session, rng):
+        df = session.from_arrow(udf_table(rng, n=200)) \
+            .select(col("x"), col("y"))
+        arrays = to_jax(df)
+        assert arrays["__num_rows__"] == 200
+        data, validity = arrays["y"]
+        import jax.numpy as jnp
+        assert isinstance(data, jnp.ndarray)
+        # feed straight into jax compute without leaving the device
+        total = float(jnp.sum(jnp.where(validity, data, 0.0)))
+        expected = sum(v for v in df.collect().column("y").to_pylist()
+                       if v is not None)
+        assert abs(total - expected) < 1e-6 * max(abs(expected), 1.0)
+
+    def test_round_trip(self, session, rng):
+        df = session.from_arrow(udf_table(rng, n=64)).select(col("x"))
+        arrays = to_jax(df)
+        df2 = from_jax(session, arrays)
+        assert df2.collect().column("x").to_pylist() == \
+            df.collect().column("x").to_pylist()
