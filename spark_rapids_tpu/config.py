@@ -178,6 +178,10 @@ register("spark.rapids.shuffle.compression.codec", "string", "zstd",
 register("spark.rapids.shuffle.ici.chunkBytes", "bytes", 64 << 20,
          "Per-step all-to-all chunk size over ICI.")
 
+register("spark.rapids.sql.join.subPartition.rows", "int", 4 << 20,
+         "Build sides larger than this hash-split into key-aligned "
+         "sub-partitions joined pairwise (GpuSubPartitionHashJoin analog).")
+
 # I/O -------------------------------------------------------------------------------
 register("spark.rapids.sql.format.parquet.enabled", "bool", True,
          "Enable TPU parquet scan/write.")

@@ -38,6 +38,15 @@ def _sorted_by_keys(xp, key_vecs: List[Vec], all_vecs: List[Vec], row_mask):
 
 
 class TpuHashAggregateExec(UnaryTpuExec):
+    """Modes: complete (raw->final), partial (raw->partial buffers),
+    final (partial->final). Multi-batch inputs aggregate per batch, park the
+    results as spillable batches, and merge pairwise under the OOM-retry
+    framework (GpuHashAggregateIterator's merge passes). The reference's
+    sort-based re-aggregation FALLBACK has no separate code path here: the
+    primary algorithm already IS sort+segmented-reduce, so high-cardinality
+    inputs degrade smoothly (merges stop shrinking but never overflow a hash
+    table); memory pressure is absorbed by spill/split-retry instead."""
+
     def __init__(self, group_exprs: Sequence[Expression],
                  aggs: Sequence[AggExpr], child: TpuExec, conf=None,
                  mode: str = "complete"):
@@ -71,18 +80,35 @@ class TpuHashAggregateExec(UnaryTpuExec):
                 tuple(knames + [a.name for a in self._bound_aggs]),
                 tuple(ktypes + [a.func.data_type for a in self._bound_aggs]))
 
-        self._kernel = jax.jit(self._make_kernel())
+        # the partial-buffer schema (inter-batch/exchange wire layout)
+        pnames, ptps = list(knames), list(ktypes)
+        for a in self._bound_aggs:
+            for j, pt in enumerate(a.func.partial_types()):
+                pnames.append(f"{a.name}__p{j}")
+                ptps.append(pt)
+        self._partial_schema = Schema(tuple(pnames), tuple(ptps))
+
+        raw_in = mode in ("complete", "partial")
+        self._kernel = jax.jit(self._make_kernel(
+            input_partial=not raw_in,
+            output_partial=(mode == "partial")))
+        # multi-batch machinery: raw->partial for the first pass,
+        # partial->partial for merge passes, partial->final to finish
+        self._partial_kernel = jax.jit(self._make_kernel(False, True)) \
+            if raw_in else None
+        self._merge_kernel = jax.jit(self._make_kernel(True, True))
+        self._final_kernel = jax.jit(self._make_kernel(True, False)) \
+            if mode != "partial" else None
 
     @property
     def output(self) -> Schema:
         return self._schema
 
     # ------------------------------------------------------------------
-    def _make_kernel(self):
+    def _make_kernel(self, input_partial: bool, output_partial: bool):
         bound_groups = self._bound_groups
         bound_aggs = self._bound_aggs
-        mode = self.mode
-        schema = self._schema
+        out_schema = self._partial_schema if output_partial else self._schema
 
         def kernel(batch: ColumnarBatch):
             xp = jnp
@@ -90,12 +116,14 @@ class TpuHashAggregateExec(UnaryTpuExec):
             vecs = batch_vecs(batch)
             mask = batch.row_mask()
             cap = batch.capacity
-            keys = [e.eval(ctx, vecs) for e in bound_groups]
+            nk = len(bound_groups)
+            if input_partial:
+                # partial layout: key columns first, then buffers
+                keys = list(vecs[:nk])
+            else:
+                keys = [e.eval(ctx, vecs) for e in bound_groups]
 
-            # inputs to aggregate: for final mode these are partial buffers laid
-            # out after the keys in the child schema
-            if mode == "final":
-                nk = len(bound_groups)
+            if input_partial:
                 buf_vecs: List[List[Vec]] = []
                 off = nk
                 for a in bound_aggs:
@@ -136,18 +164,19 @@ class TpuHashAggregateExec(UnaryTpuExec):
             bi = 0
             for a in bound_aggs:
                 out_vecs.extend(self._agg_one(xp, a.func, sbufs, bi, gid, cap,
-                                              sorted_mask))
-                bi += len(a.func.partial_types())
-            return vecs_to_batch(schema, out_vecs, ng)
+                                              sorted_mask, input_partial,
+                                              output_partial))
+                bi += len(a.func.partial_types()) if input_partial else 1
+            return vecs_to_batch(out_schema, out_vecs, ng)
 
         return kernel
 
     def _agg_one(self, xp, func: AggregateFunction, sbufs: List[Vec], bi: int,
-                 gid, cap: int, row_mask) -> List[Vec]:
-        """Produce output vecs for one aggregate (list of partial buffers in
-        partial mode, single final value otherwise)."""
-        mode = self.mode
-        merging = mode == "final"
+                 gid, cap: int, row_mask, input_partial: bool,
+                 output_partial: bool) -> List[Vec]:
+        """Produce output vecs for one aggregate (list of partial buffers when
+        output_partial, single final value otherwise)."""
+        merging = input_partial
 
         def seg(op, v: Vec, acc_dtype=None):
             valid = v.validity & row_mask
@@ -174,7 +203,7 @@ class TpuHashAggregateExec(UnaryTpuExec):
                 s, sv = seg("sum", v, np.float64)
                 valid = v.validity & row_mask
                 c = segment_reduce(xp, "count", v.data, gid, cap, valid)
-            if mode == "partial":
+            if output_partial:
                 return [Vec(T.DOUBLE, s, c > 0),
                         Vec(T.LONG, c.astype(np.int64),
                             xp.ones(cap, dtype=bool))]
@@ -185,7 +214,7 @@ class TpuHashAggregateExec(UnaryTpuExec):
             out_t = func.data_type if not merging else v.dtype
             acc = np.float64 if T.is_floating(out_t) else np.int64
             data, has = seg("sum", v, acc)
-            return [Vec(func.data_type if mode != "partial" else
+            return [Vec(func.data_type if not output_partial else
                         func.partial_types()[0],
                         data.astype(func.data_type.np_dtype), has)]
         if isinstance(func, (Min, Max)):
@@ -241,11 +270,61 @@ class TpuHashAggregateExec(UnaryTpuExec):
         batches = list(self.child.execute())
         if not batches:
             return
-        merged = concat_batches(batches)
+        if len(batches) == 1:
+            with self.agg_time.timed():
+                out = self._kernel(batches[0])
+            self.num_output_rows.add(out.row_count())
+            yield self._count_output(out)
+            return
+        yield from self._multi_batch(batches)
+
+    def _multi_batch(self, batches: List[ColumnarBatch]
+                     ) -> Iterator[ColumnarBatch]:
+        """Aggregate each batch, park results spillable, merge pairwise under
+        the OOM-retry framework (GpuHashAggregateIterator merge passes)."""
+        from ..memory.budget import MemoryBudget
+        from ..memory.retry import split_batch_halves, with_retry
+        from ..memory.spillable import SpillableColumnarBatch
+
+        def first_pass(b: ColumnarBatch) -> ColumnarBatch:
+            MemoryBudget.get().reserve(0)  # pre-flight / injection point
+            if self.mode == "final":
+                return b  # child already produced partial buffers
+            return self._partial_kernel(b)
+
+        pending: List[SpillableColumnarBatch] = []
         with self.agg_time.timed():
-            out = self._kernel(merged)
-        self.num_output_rows.add(out.row_count())
-        yield self._count_output(out)
+            for b in batches:
+                for out in with_retry(SpillableColumnarBatch(b),
+                                      lambda sp: first_pass(sp.get_batch()),
+                                      split_batch_halves):
+                    pending.append(SpillableColumnarBatch(out))
+
+            def merge_pair(sp: SpillableColumnarBatch) -> ColumnarBatch:
+                b = sp.get_batch()
+                MemoryBudget.get().reserve(b.device_memory_size())
+                try:
+                    return self._merge_kernel(b)
+                finally:
+                    MemoryBudget.get().release(b.device_memory_size())
+
+            while len(pending) > 1:
+                a = pending.pop(0)
+                c = pending.pop(0)
+                pair = concat_batches([a.get_batch(), c.get_batch()])
+                a.close()
+                c.close()
+                for out in with_retry(SpillableColumnarBatch(pair),
+                                      merge_pair, split_batch_halves):
+                    pending.append(SpillableColumnarBatch(out))
+
+            last = pending.pop()
+            result = last.get_batch()
+            last.close()
+            if self.mode != "partial":
+                result = self._final_kernel(result)
+        self.num_output_rows.add(result.row_count())
+        yield self._count_output(result)
 
     def _arg_string(self):
         return (f"[{self.mode}, keys={[repr(e) for e in self.group_exprs]}, "

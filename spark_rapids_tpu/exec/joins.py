@@ -207,6 +207,14 @@ class TpuShuffledHashJoinExec(TpuExec):
             from ..columnar.batch import empty_batch
             build = empty_batch(self.children[1].output, 1)
 
+        threshold = self.conf.get("spark.rapids.sql.join.subPartition.rows")
+        if int(build.row_count()) > threshold:
+            yield from self._sub_partition_join(probe, build, threshold)
+            return
+        yield from self._join_pair(probe, build)
+
+    def _join_pair(self, probe: ColumnarBatch,
+                   build: ColumnarBatch) -> Iterator[ColumnarBatch]:
         with self.join_time.timed():
             counts, lo, order, pvalid, bvalid = _probe_counts(
                 probe, build, self._lk_ix, self._rk_ix)
@@ -231,6 +239,34 @@ class TpuShuffledHashJoinExec(TpuExec):
             if extra is not None:
                 self.num_output_rows.add(extra.row_count())
                 yield self._count_output(extra)
+
+    def _sub_partition_join(self, probe: ColumnarBatch, build: ColumnarBatch,
+                            threshold: int) -> Iterator[ColumnarBatch]:
+        """Oversized build side (GpuSubPartitionHashJoin.scala analog): hash
+        both sides into P key-aligned sub-partitions and join pairwise —
+        matching keys land in the same sub-partition, so pair joins compose
+        exactly (including outer/semi/anti, which are per-key-group). Each
+        pair's working set is ~1/P of the whole, parked spillable between
+        pairs."""
+        from ..memory.spillable import SpillableColumnarBatch
+        n_build = int(build.row_count())
+        p = 1
+        while n_build // p > threshold and p < 64:
+            p *= 2
+        probe_parts = _hash_split(probe, self._lk_ix, p)
+        build_parts = _hash_split(build, self._rk_ix, p)
+        pairs = [(SpillableColumnarBatch(pb), SpillableColumnarBatch(bb))
+                 for pb, bb in zip(probe_parts, build_parts)]
+        for sp_probe, sp_build in pairs:
+            pb = sp_probe.get_batch()
+            bb = sp_build.get_batch()
+            if int(pb.row_count()) == 0 and int(bb.row_count()) == 0:
+                sp_probe.close()
+                sp_build.close()
+                continue
+            yield from self._join_pair(pb, bb)
+            sp_probe.close()
+            sp_build.close()
 
     def _unmatched_batch(self, build, bmatched):
         rvecs, n = _unmatched_build(build, len(self.children[0].output.types),
@@ -259,6 +295,22 @@ class TpuShuffledHashJoinExec(TpuExec):
 
     def _arg_string(self):
         return f"[{self.join_type}, keys={[repr(e) for e in self.left_keys]}]"
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _hash_pid(batch: ColumnarBatch, key_ix: Tuple[int, ...], p: int):
+    vecs = batch_vecs(batch)
+    keys = [vecs[i] for i in key_ix]
+    h = hash_vecs(jnp, keys).astype(jnp.uint32)
+    return jnp.where(batch.row_mask(), (h % p).astype(jnp.int32),
+                     jnp.int32(-1))
+
+
+def _hash_split(batch: ColumnarBatch, key_ix: Tuple[int, ...],
+                p: int) -> List[ColumnarBatch]:
+    from .exchange import _slice_partition
+    pid = _hash_pid(batch, key_ix, p)
+    return [_slice_partition(batch, pid, q) for q in range(p)]
 
 
 class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):

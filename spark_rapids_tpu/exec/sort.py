@@ -1,19 +1,25 @@
 """Sort exec (reference `GpuSortExec.scala:83`; out-of-core iterator `:239`).
 
-Round-1 modes: per-batch sort and single-batch (coalesce-then-sort) full sort.
-The out-of-core merge path (spillable pending set) follows once the spill catalog
-lands; its seam is `sort_single_batch` below, which is the in-core building block
-the reference's GpuOutOfCoreSortIterator also uses."""
+Three modes, mirroring the reference: per-batch sort, single-batch
+(coalesce-then-sort), and **out-of-core**: each input batch is sorted on
+device into a run and parked spillable (the pending set); the merge phase is
+host-orchestrated — only the SORT KEYS of each run come to the host, a global
+numpy lexsort merges the key streams, and the device assembles each output
+chunk by gathering the chunk's rows from the (re-acquired) runs and ordering
+them by their global position. Device residency is bounded to one run plus
+one chunk; payloads never visit the host."""
 
 from __future__ import annotations
 
+import functools
 from typing import Iterator, List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..columnar.batch import ColumnarBatch
+from .. import types as T
+from ..columnar.batch import ColumnarBatch, Schema
 from ..expr.base import Expression, Vec, bind_references
 from ..ops.rowops import gather_vecs, lexsort_indices, sort_keys_for
 from ..utils import metrics as M
@@ -62,10 +68,118 @@ class TpuSortExec(UnaryTpuExec):
         batches = list(self.child.execute())
         if not batches:
             return
+        total = sum(int(b.row_count()) for b in batches)
+        if len(batches) > 1 and total > self.conf.batch_size_rows:
+            yield from self._out_of_core(batches)
+            return
         merged = concat_batches(batches)
         out = self.sort_single_batch(merged)
         self.num_output_rows.add(out.row_count())
         yield self._count_output(out)
 
+    # -- out-of-core merge path (GpuOutOfCoreSortIterator analog) ----------
+    def _host_key_groups(self, batch: ColumnarBatch) -> List[np.ndarray]:
+        """D2H the sort-key arrays of a (sorted) run, host-comparable form."""
+        ctx = device_ctx(batch, self.conf)
+        vecs = batch_vecs(batch)
+        n = int(batch.row_count())
+        flat: List[np.ndarray] = []
+        for e, asc, nf in self._bound:
+            v = e.eval(ctx, vecs)
+            hv = Vec(v.dtype, np.asarray(v.data)[:n],
+                     np.asarray(v.validity)[:n],
+                     None if v.lengths is None else np.asarray(v.lengths)[:n])
+            flat.extend(np.asarray(k)[:n] if np.ndim(k) else k
+                        for k in sort_keys_for(np, hv, asc, nf))
+        return flat
+
+    def _out_of_core(self, batches: List[ColumnarBatch]
+                     ) -> Iterator[ColumnarBatch]:
+        from ..memory.spillable import SpillableColumnarBatch
+        # phase 1: device-sort each batch into a run; park spillable
+        runs: List[SpillableColumnarBatch] = []
+        host_keys: List[List[np.ndarray]] = []
+        with self.sort_time.timed():
+            for b in batches:
+                sorted_b = self.sort_single_batch(b)
+                host_keys.append(self._host_key_groups(sorted_b))
+                runs.append(SpillableColumnarBatch(sorted_b))
+
+            # phase 2: host merge of the key streams (keys only; payload
+            # stays on device inside the spill catalog)
+            run_id = np.concatenate([np.full(len(k[0]), i, np.int32)
+                                     for i, k in enumerate(host_keys)])
+            row_id = np.concatenate([np.arange(len(k[0]), dtype=np.int32)
+                                     for k in host_keys])
+            merged_keys = [np.concatenate([host_keys[i][g]
+                                           for i in range(len(runs))])
+                           for g in range(len(host_keys[0]))]
+            # least-significant first for np.lexsort; run/row ids as the
+            # final tiebreak keep the merge stable across runs
+            order = np.lexsort(tuple([row_id, run_id] + merged_keys[::-1]))
+
+        chunk_rows = self.conf.batch_size_rows
+        try:
+            for at in range(0, len(order), chunk_rows):
+                chunk = order[at:at + chunk_rows]
+                with self.sort_time.timed():
+                    out = self._assemble_chunk(runs, run_id, row_id, chunk)
+                self.num_output_rows.add(out.row_count())
+                yield self._count_output(out)
+        finally:
+            for r in runs:
+                r.close()
+
+    def _assemble_chunk(self, runs, run_id, row_id, chunk) -> ColumnarBatch:
+        """Gather the chunk's rows per run, tag each with its position in the
+        chunk, concat, and device-sort by position (exact global order)."""
+        from ..columnar.padding import row_bucket
+        pieces: List[ColumnarBatch] = []
+        pos_in_chunk = np.arange(len(chunk), dtype=np.int64)
+        schema = self.child.output
+        pos_schema = Schema(schema.names + ("__pos__",),
+                            schema.types + (T.LONG,))
+        for i, run in enumerate(runs):
+            sel = run_id[chunk] == i
+            if not sel.any():
+                continue
+            rows = row_id[chunk][sel]
+            pos = pos_in_chunk[sel]
+            cap = row_bucket(len(rows))
+            idx = np.zeros(cap, np.int32)
+            idx[:len(rows)] = rows
+            posv = np.zeros(cap, np.int64)
+            posv[:len(rows)] = pos
+            batch = run.get_batch()
+            piece = _gather_rows_with_pos(batch, jnp.asarray(idx),
+                                          jnp.asarray(posv),
+                                          jnp.asarray(len(rows),
+                                                      dtype=jnp.int32),
+                                          pos_schema)
+            pieces.append(piece)
+        merged = concat_batches(pieces)
+        ordered = _sort_by_pos(merged)
+        # drop the __pos__ column
+        return vecs_to_batch(schema, batch_vecs(ordered)[:-1],
+                             merged.num_rows)
+
     def _arg_string(self):
         return f"[{[(repr(e), a, nf) for e, a, nf in self.orders]}]"
+
+
+@functools.partial(jax.jit, static_argnums=(4,))
+def _gather_rows_with_pos(batch: ColumnarBatch, idx, pos, count,
+                          pos_schema: Schema):
+    vecs = gather_vecs(jnp, batch_vecs(batch), idx)
+    vecs.append(Vec(T.LONG, pos, jnp.ones(idx.shape[0], bool)))
+    return vecs_to_batch(pos_schema, vecs, count)
+
+
+@jax.jit
+def _sort_by_pos(batch: ColumnarBatch) -> ColumnarBatch:
+    vecs = batch_vecs(batch)
+    mask = batch.row_mask()
+    pos = jnp.where(mask, vecs[-1].data, jnp.int64(2 ** 62))
+    order = jnp.argsort(pos)
+    return vecs_to_batch(batch.schema, gather_vecs(jnp, vecs, order),
+                         batch.num_rows)

@@ -23,6 +23,16 @@ def assert_same(df, sort_by=None, approx_cols=()):
     tpu = df.collect()
     cpu = df.collect_cpu()
     assert tpu.schema.equals(cpu.schema), f"{tpu.schema} != {cpu.schema}"
+    if len(set(tpu.schema.names)) != len(tpu.schema.names):
+        # joins can emit duplicate column names; uniquify identically on
+        # both sides so arrow sort/column lookups work
+        seen = {}
+        uniq = []
+        for n in tpu.schema.names:
+            seen[n] = seen.get(n, 0) + 1
+            uniq.append(n if seen[n] == 1 else f"{n}__dup{seen[n]}")
+        tpu = tpu.rename_columns(uniq)
+        cpu = cpu.rename_columns(uniq)
     if sort_by:
         keys = [(k, "ascending") for k in sort_by]
         tpu = tpu.sort_by(keys)
