@@ -17,11 +17,22 @@ TPU-native choices (measured on chip, see commit history):
     (lax.scan) and D2H forces completion; per-step = (total - noop) / K.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+Hardening (round-1 failure mode): the axon TPU backend can fail at init
+(UNAVAILABLE) or hang indefinitely in make_c_api_client. The parent process
+therefore runs the measurement in a CHILD subprocess under a watchdog timeout,
+retries on failure/timeout with backoff, and on final failure prints a single
+parseable {"metric": ..., "error": ...} JSON line instead of a traceback —
+one round must never lose its perf evidence to a transient backend error
+(reference bar: fail fast + loud, Plugin.scala:365-389,436-459).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -85,6 +96,15 @@ def _force(x):
     return np.asarray(x)
 
 
+ATTEMPTS = 3
+# First compile via the tunnel is ~20-40s and the measured section is seconds;
+# a healthy run fits in ~2 min. A hung backend init eats the whole window, so
+# keep it tight — 3 attempts must stay well under the driver's round budget.
+ATTEMPT_TIMEOUT_S = 180
+_CHILD_ENV = "SPARK_RAPIDS_TPU_BENCH_CHILD"
+_MARK = "@BENCH_RESULT@"
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -122,7 +142,7 @@ def main():
 
     speedup = t_cpu / t_tpu
     gbps = N_FACT * BYTES_PER_ROW / t_tpu / 1e9
-    print(json.dumps({
+    print(_MARK + json.dumps({
         "metric": "scan_join_agg_speedup_vs_cpu",
         "value": round(speedup, 3),
         "unit": "x",
@@ -131,8 +151,45 @@ def main():
                    "tpu_step_s": round(t_tpu, 5), "cpu_s": round(t_cpu, 5),
                    "scan_gbps": round(gbps, 3), "rows": N_FACT,
                    "rpc_overhead_s": round(overhead, 4)},
-    }))
+    }), flush=True)
+
+
+def supervise() -> int:
+    """Run main() in a child under a watchdog; retry; emit error JSON if all fail."""
+    errors = []
+    for attempt in range(1, ATTEMPTS + 1):
+        env = dict(os.environ, **{_CHILD_ENV: "1"})
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                capture_output=True, text=True, timeout=ATTEMPT_TIMEOUT_S,
+                env=env)
+        except subprocess.TimeoutExpired:
+            errors.append(f"attempt {attempt}: timeout after "
+                          f"{ATTEMPT_TIMEOUT_S}s (backend init hang?)")
+            continue
+        for line in proc.stdout.splitlines():
+            if line.startswith(_MARK):
+                print(line[len(_MARK):], flush=True)
+                return 0
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-3:]
+        errors.append(f"attempt {attempt}: rc={proc.returncode} "
+                      + " | ".join(tail))
+        if attempt < ATTEMPTS:
+            time.sleep(5 * attempt)
+    print(json.dumps({
+        "metric": "scan_join_agg_speedup_vs_cpu",
+        "value": None,
+        "unit": "x",
+        "vs_baseline": None,
+        "error": f"all {ATTEMPTS} attempts failed",
+        "detail": {"attempts": errors},
+    }), flush=True)
+    return 1
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get(_CHILD_ENV):
+        main()
+    else:
+        sys.exit(supervise())

@@ -177,6 +177,10 @@ register("spark.rapids.shuffle.compression.codec", "string", "zstd",
          check_values=("none", "zstd", "lz4xla"))
 register("spark.rapids.shuffle.ici.chunkBytes", "bytes", 64 << 20,
          "Per-step all-to-all chunk size over ICI.")
+register("spark.rapids.shuffle.ici.slotRows", "int", 0,
+         "Per-destination slot rows for the ICI all-to-all (0 = auto: the "
+         "per-device capacity, which can never overflow). Smaller values bound "
+         "skew memory; overflow is detected on device and retried larger.")
 
 register("spark.rapids.sql.join.subPartition.rows", "int", 4 << 20,
          "Build sides larger than this hash-split into key-aligned "

@@ -49,19 +49,28 @@ class TpuHashAggregateExec(UnaryTpuExec):
 
     def __init__(self, group_exprs: Sequence[Expression],
                  aggs: Sequence[AggExpr], child: TpuExec, conf=None,
-                 mode: str = "complete"):
+                 mode: str = "complete", agg_bind_schema: Schema = None,
+                 partitioned_input: bool = False):
         super().__init__([child], conf)
         assert mode in ("complete", "partial", "final")
         self.mode = mode
         self.group_exprs = list(group_exprs)
         self.aggs = list(aggs)
-        self._bound_groups = [bind_references(e, child.output)
+        # final mode consumes partial buffers positionally — group/agg exprs
+        # reference the ORIGINAL input schema (pre-partial), so a final exec
+        # whose child carries the partial wire layout binds against the
+        # original schema passed by the distribution pass
+        bind_schema = agg_bind_schema or child.output
+        # partitioned_input: child is a key-exchange, so groups are disjoint
+        # across input batches and final aggregation runs per batch (per shard)
+        self.partitioned_input = partitioned_input
+        self._bound_groups = [bind_references(e, bind_schema)
                               for e in self.group_exprs]
         self._bound_aggs = []
         for a in self.aggs:
             f = a.func
             if f.child is not None:
-                f = f.with_children([bind_references(f.child, child.output)])
+                f = f.with_children([bind_references(f.child, bind_schema)])
             self._bound_aggs.append(AggExpr(f, a.name))
         self.agg_time = self.metrics.create(M.AGG_TIME, M.MODERATE)
 
@@ -269,6 +278,29 @@ class TpuHashAggregateExec(UnaryTpuExec):
     def do_execute(self) -> Iterator[ColumnarBatch]:
         batches = list(self.child.execute())
         if not batches:
+            return
+        if self.mode == "partial":
+            # map-side aggregation: one partial batch per input batch (shard),
+            # feeding the exchange — no cross-batch merge here (that is the
+            # final side's job), matching the reference's partial-agg tasks
+            with self.agg_time.timed():
+                for b in batches:
+                    if len(batches) > 1 and int(b.row_count()) == 0:
+                        continue
+                    out = self._kernel(b)
+                    self.num_output_rows.add(out.row_count())
+                    yield self._count_output(out)
+            return
+        if self.partitioned_input and self.mode == "final" and self.group_exprs:
+            # key-partitioned input: groups are disjoint across batches, so
+            # each shard finalizes independently (per-shard reduce side)
+            with self.agg_time.timed():
+                for b in batches:
+                    if int(b.row_count()) == 0:
+                        continue
+                    out = self._kernel(b)
+                    self.num_output_rows.add(out.row_count())
+                    yield self._count_output(out)
             return
         if len(batches) == 1:
             with self.agg_time.timed():

@@ -34,6 +34,9 @@ from .coalesce import concat_batches
 
 __all__ = ["TpuShuffleExchangeExec", "make_partitioner"]
 
+# process-wide count of executed mesh collectives (test/observability hook)
+MESH_EXCHANGES = 0
+
 
 def make_partitioner(spec, schema: Schema,
                      sample_batch: Optional[ColumnarBatch] = None
@@ -82,6 +85,21 @@ class TpuShuffleExchangeExec(UnaryTpuExec):
 
     def do_execute(self) -> Iterator[ColumnarBatch]:
         batches = list(self.child.execute())
+        mode = self.conf.get("spark.rapids.shuffle.mode")
+        if mode == "ICI":
+            from ..parallel.mesh import mesh_from_conf
+            mesh = mesh_from_conf(self.conf)
+            if mesh is not None and self.spec.num_partitions == mesh.size:
+                # mesh mode always yields exactly ndev batches (empties
+                # included) — downstream zipped execs rely on the alignment
+                if not batches:
+                    from ..columnar.batch import empty_batch
+                    for _ in range(mesh.size):
+                        yield self._count_output(
+                            empty_batch(self.child.output, 1))
+                    return
+                yield from self._exchange_via_mesh(batches, mesh)
+                return
         if not batches:
             return
         batch = concat_batches(batches)
@@ -89,7 +107,6 @@ class TpuShuffleExchangeExec(UnaryTpuExec):
         n_parts = part.num_partitions
         with self.partition_time.timed():
             pid = part.ids_for_batch(jnp, batch)
-        mode = self.conf.get("spark.rapids.shuffle.mode")
         if mode in ("MULTITHREADED", "CACHE_ONLY") and n_parts > 1:
             yield from self._shuffle_via_manager(batch, pid, n_parts, mode)
             return
@@ -136,6 +153,77 @@ class TpuShuffleExchangeExec(UnaryTpuExec):
                     yield self._count_output(b)
         finally:
             mgr.unregister_shuffle(sid)
+
+    def _exchange_via_mesh(self, batches: List[ColumnarBatch],
+                           mesh) -> Iterator[ColumnarBatch]:
+        """Distributed data plane: rows move between mesh devices in ONE
+        compiled lax.all_to_all (parallel/collective.py) — the planned-query
+        integration of the ICI shuffle, replacing the reference's UCX p2p
+        transport fed by `GpuShuffleExchangeExecBase.scala:262`. Yields exactly
+        ndev batches, one per device partition, empties included so downstream
+        zipped execs stay positionally aligned. Slot overflow is detected ON
+        DEVICE and retried with a doubled slot_cap — rows are never dropped."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..columnar.column import Column
+        from ..columnar.padding import row_bucket
+        from ..parallel.collective import build_exchange_fn
+        from ..parallel.mesh import SHUFFLE_AXIS
+
+        ndev = mesh.size
+        batch = concat_batches(batches)
+        total = int(batch.row_count())
+        cap = row_bucket(max((total + ndev - 1) // ndev, 1))
+        g = batch.repadded(ndev * cap)
+        part = make_partitioner(self.spec, self.child.output, batch)
+        with self.partition_time.timed():
+            pid = part.ids_for_batch(jnp, g)
+
+        leaves = []
+        has_lengths = []
+        for c in g.columns:
+            leaves.append(c.data)
+            leaves.append(c.validity)
+            has_lengths.append(c.lengths is not None)
+            if c.lengths is not None:
+                leaves.append(c.lengths)
+        sh = NamedSharding(mesh, P(SHUFFLE_AXIS))
+        leaves = [jax.device_put(l, sh) for l in leaves]
+        pid = jax.device_put(pid.astype(jnp.int32), sh)
+
+        conf_slot = self.conf.get("spark.rapids.shuffle.ici.slotRows")
+        slot_cap = min(conf_slot, cap) if conf_slot > 0 else cap
+        while True:
+            fn = build_exchange_fn(mesh, ndev, slot_cap=slot_cap)
+            with self.partition_time.timed():
+                out_leaves, counts, overflowed = fn(leaves, pid)
+            if not bool(overflowed):
+                break
+            # a skewed partition overflowed the bounded slot: grow and rerun
+            # (slot_cap == cap can never overflow, so this terminates)
+            slot_cap = min(slot_cap * 2, cap)
+        global MESH_EXCHANGES
+        MESH_EXCHANGES += 1
+
+        counts = np.asarray(counts)
+        out_cap = ndev * slot_cap
+        for p in range(ndev):
+            lo = p * out_cap
+            cols = []
+            i = 0
+            for ci, c in enumerate(g.columns):
+                data = out_leaves[i][lo:lo + out_cap]
+                i += 1
+                validity = out_leaves[i][lo:lo + out_cap]
+                i += 1
+                lengths = None
+                if has_lengths[ci]:
+                    lengths = out_leaves[i][lo:lo + out_cap]
+                    i += 1
+                cols.append(Column(c.dtype, data, validity, lengths))
+            out = ColumnarBatch(batch.schema, tuple(cols),
+                                jnp.asarray(counts[p], jnp.int32))
+            self.num_output_rows.add(int(counts[p]))
+            yield self._count_output(out)
 
     def _arg_string(self):
         return f"[{self.spec}]"

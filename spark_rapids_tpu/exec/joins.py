@@ -165,6 +165,10 @@ class TpuShuffledHashJoinExec(TpuExec):
         self.join_type = join_type
         self.left_keys = list(left_keys)
         self.right_keys = list(right_keys)
+        # set by the distribution pass (exec/requirements.py) when both
+        # children are co-partitioned key-exchanges: join batch p with batch p
+        # instead of concatenating the streams (per-shard join)
+        self.zip_partitions = False
         lo, ro = left.output, right.output
         if join_type in ("semi", "anti"):
             self._schema = lo
@@ -192,6 +196,9 @@ class TpuShuffledHashJoinExec(TpuExec):
         return self._schema
 
     def do_execute(self) -> Iterator[ColumnarBatch]:
+        if self.zip_partitions:
+            yield from self._zipped_execute()
+            return
         with self.build_time.timed():
             build_batches = list(self.children[1].execute())
             if not build_batches and self.join_type in ("inner", "right", "semi"):
@@ -212,6 +219,32 @@ class TpuShuffledHashJoinExec(TpuExec):
             yield from self._sub_partition_join(probe, build, threshold)
             return
         yield from self._join_pair(probe, build)
+
+    def _zipped_execute(self) -> Iterator[ColumnarBatch]:
+        """Co-partitioned per-shard join: children are key-exchanges over the
+        same mesh, so matching keys land in the same positional batch — join
+        batch p with batch p (the distributed engine's shard-local join,
+        `GpuShuffledHashJoinExec.scala:151` fed by the exchange)."""
+        with self.build_time.timed():
+            build_stream = list(self.children[1].execute())
+        probe_stream = list(self.children[0].execute())
+        if len(probe_stream) != len(build_stream):
+            raise RuntimeError(
+                "zip_partitions requires positionally-aligned exchange "
+                f"outputs, got {len(probe_stream)} vs {len(build_stream)}")
+        threshold = self.conf.get("spark.rapids.sql.join.subPartition.rows")
+        for probe, build in zip(probe_stream, build_stream):
+            n_probe, n_build = int(probe.row_count()), int(build.row_count())
+            if n_build == 0 and self.join_type in ("inner", "right", "semi"):
+                continue
+            if n_probe == 0:
+                if n_build and self.join_type in ("right", "full"):
+                    yield self._right_only(build)
+                continue
+            if n_build > threshold:
+                yield from self._sub_partition_join(probe, build, threshold)
+            else:
+                yield from self._join_pair(probe, build)
 
     def _join_pair(self, probe: ColumnarBatch,
                    build: ColumnarBatch) -> Iterator[ColumnarBatch]:

@@ -1,0 +1,89 @@
+"""Distribution requirements pass — the EnsureRequirements analog that makes
+planned queries ride the mesh.
+
+Spark inserts shuffle exchanges to satisfy operator distribution requirements
+(child distribution of joins/aggregates); the reference then swaps those for
+`GpuShuffleExchangeExecBase` feeding `GpuShuffledHashJoinExec`
+(`GpuShuffleExchangeExecBase.scala:152,262` -> `GpuShuffledHashJoinExec.scala:151`).
+This repo's frontend builds plans without exchanges (local mode needs none), so
+when a mesh is active this pass rewrites the CONVERTED device plan:
+
+  * join children are wrapped in hash key-exchanges sized to the mesh and the
+    join zips co-partitioned batches (per-shard join);
+  * grouped aggregates split into partial -> key-exchange -> final, with the
+    final side reducing per shard (groups are disjoint across partitions).
+
+The exchange exec lowers those key-exchanges to ONE compiled lax.all_to_all
+over the mesh (exec/exchange.py _exchange_via_mesh), so distributed execution
+is what the PLANNER emits — not a hand-built demo program.
+"""
+
+from __future__ import annotations
+
+from ..config import TpuConf
+from .base import TpuExec
+
+__all__ = ["ensure_distribution"]
+
+
+def ensure_distribution(root: TpuExec, conf: TpuConf) -> TpuExec:
+    """Rewrite a device plan for mesh execution. No-op unless a mesh is active
+    and the shuffle mode is ICI."""
+    if conf.get("spark.rapids.shuffle.mode") != "ICI":
+        return root
+    from ..parallel.mesh import mesh_from_conf
+    mesh = mesh_from_conf(conf)
+    if mesh is None:
+        return root
+    return _rewrite(root, conf, mesh.size)
+
+
+def _rewrite(node: TpuExec, conf: TpuConf, ndev: int) -> TpuExec:
+    from .aggregate import TpuHashAggregateExec
+    from .joins import TpuBroadcastHashJoinExec, TpuShuffledHashJoinExec
+
+    node.children = [_rewrite(c, conf, ndev) for c in node.children]
+
+    if (isinstance(node, TpuShuffledHashJoinExec)
+            and not isinstance(node, TpuBroadcastHashJoinExec)):
+        node.children = [
+            _key_exchange(node.left_keys, node.children[0], conf, ndev),
+            _key_exchange(node.right_keys, node.children[1], conf, ndev),
+        ]
+        node.zip_partitions = True
+        return node
+
+    if (isinstance(node, TpuHashAggregateExec) and node.mode == "complete"
+            and node.group_exprs):
+        child = node.children[0]
+        partial = TpuHashAggregateExec(node.group_exprs, node.aggs, child,
+                                       conf, mode="partial")
+        nk = len(node.group_exprs)
+        from ..expr.base import AttributeReference
+        key_refs = [AttributeReference(n) for n in partial.output.names[:nk]]
+        exchange = _key_exchange(key_refs, partial, conf, ndev)
+        return TpuHashAggregateExec(node.group_exprs, node.aggs, exchange,
+                                    conf, mode="final",
+                                    agg_bind_schema=child.output,
+                                    partitioned_input=True)
+    return node
+
+
+def _key_exchange(keys, child: TpuExec, conf: TpuConf, ndev: int) -> TpuExec:
+    """Wrap `child` in a hash key-exchange over the mesh, unless it already is
+    one on the same keys (reuse the existing co-partitioning)."""
+    from ..expr.base import AttributeReference
+    from ..plan.nodes import HashPartitionSpec
+    from .exchange import TpuShuffleExchangeExec
+
+    if (isinstance(child, TpuShuffleExchangeExec)
+            and isinstance(child.spec, HashPartitionSpec)
+            and child.spec.num_partitions == ndev
+            and len(child.spec.keys) == len(keys)
+            and all(isinstance(a, AttributeReference)
+                    and isinstance(b, AttributeReference)
+                    and a.col_name == b.col_name
+                    for a, b in zip(child.spec.keys, keys))):
+        return child
+    return TpuShuffleExchangeExec(HashPartitionSpec(list(keys), ndev), child,
+                                  conf)

@@ -12,9 +12,12 @@ precisely because sizes are dynamic there).
 Shapes: a device's local shard is a set of leaf arrays with leading dim `cap`
 (rows past the logical count are padding). Bucketing produces `[ndev, slot_cap]`
 leading dims; all_to_all swaps the leading device axis; compaction restores a
-single `[ndev * slot_cap]` local shard + count. Overflowing a slot (more than
-slot_cap rows for one destination) drops rows, so callers size slot_cap = cap
-(always safe: a device holds at most cap rows total) unless they can bound skew.
+single `[ndev * slot_cap]` local shard + count. slot_cap = cap is always safe (a
+device holds at most cap rows total); smaller slot_caps bound skew but can
+overflow a slot, so the exchange computes an ON-DEVICE overflow flag (psum over
+the mesh) that host callers MUST check — the engine retries with a doubled
+slot_cap rather than ever dropping rows (the reference can never drop shuffle
+rows either).
 """
 
 from __future__ import annotations
@@ -64,9 +67,10 @@ def bucketize_by_partition(leaves: Sequence[Any], pid, ndev: int,
     pid is int32[cap] with -1 marking padding rows and values REQUIRED to be in
     [-1, ndev): a partitioner built for more partitions than mesh devices would
     silently lose its out-of-range rows here, so callers must size the
-    partitioner to the mesh. Returns (slotted_leaves, send_counts[int32[ndev]]).
-    Rows beyond slot_cap for one destination drop (callers choose slot_cap to
-    make that impossible or detect via counts)."""
+    partitioner to the mesh. Returns (slotted_leaves, send_counts[int32[ndev]],
+    overflowed bool[]). Rows beyond slot_cap for one destination do not fit in
+    the slot buffers; `overflowed` reports that so callers can retry with a
+    larger slot_cap (never silently proceed on overflow)."""
     cap = pid.shape[0]
     valid = pid >= 0
     key = jnp.where(valid, pid, ndev)
@@ -86,7 +90,8 @@ def bucketize_by_partition(leaves: Sequence[Any], pid, ndev: int,
         .reshape((ndev, slot_cap) + leaf.shape[1:])
         for leaf in leaves
     ]
-    return slotted, jnp.minimum(counts, slot_cap)
+    overflowed = jnp.any(counts > slot_cap)
+    return slotted, jnp.minimum(counts, slot_cap), overflowed
 
 
 def compact_received(leaves: Sequence[Any], recv_counts):
@@ -112,18 +117,24 @@ def all_to_all_exchange(leaves: Sequence[Any], pid, ndev: int,
                         axis: str = SHUFFLE_AXIS):
     """Full partitioned exchange for one device's shard; call under shard_map.
 
-    bucket -> lax.all_to_all over ICI -> compact. Returns (leaves', total) where
-    leaves' have leading dim ndev * slot_cap and `total` is the live row count
-    on this device after the exchange."""
+    bucket -> lax.all_to_all over ICI -> compact. Returns (leaves', total,
+    overflowed) where leaves' have leading dim ndev * slot_cap, `total` is the
+    live row count on this device after the exchange, and `overflowed` is a
+    mesh-global bool (psum'd) that is True iff ANY device overflowed a slot —
+    host callers must check it and retry with a larger slot_cap (rows are never
+    silently dropped)."""
     cap = pid.shape[0]
     slot_cap = slot_cap or cap
-    slotted, send_counts = bucketize_by_partition(leaves, pid, ndev, slot_cap)
+    slotted, send_counts, local_ov = bucketize_by_partition(
+        leaves, pid, ndev, slot_cap)
     recv = [jax.lax.all_to_all(s, axis, split_axis=0, concat_axis=0,
                                tiled=False)
             for s in slotted]
     recv_counts = jax.lax.all_to_all(send_counts, axis, split_axis=0,
                                      concat_axis=0, tiled=True)
-    return compact_received(recv, recv_counts)
+    overflowed = jax.lax.psum(local_ov.astype(jnp.int32), axis) > 0
+    out, total = compact_received(recv, recv_counts)
+    return out, total, overflowed
 
 
 def broadcast_all_gather(leaves: Sequence[Any], count, ndev: int,
@@ -143,23 +154,27 @@ def broadcast_all_gather(leaves: Sequence[Any], count, ndev: int,
 # jit-compiled exchange entry
 # ---------------------------------------------------------------------------
 
+@functools.lru_cache(maxsize=64)
 def build_exchange_fn(mesh: Mesh, ndev: int, slot_cap: Optional[int] = None,
                       axis: str = SHUFFLE_AXIS) -> Callable:
-    """Compile a partitioned-exchange program over `mesh`.
+    """Compile a partitioned-exchange program over `mesh`. Memoized per
+    (mesh, ndev, slot_cap, axis): a fresh jax.jit closure per call would
+    retrace/recompile the collective on every exchange execution.
 
     Returned fn: (leaves: list of [ndev*cap, ...] globally-sharded arrays,
     pid: int32[ndev*cap] sharded alike) -> (exchanged leaves sharded alike with
     per-device leading dim ndev*slot_cap, counts int32[ndev] = live rows per
-    device). The per-leaf sharding is rows-split along the mesh axis; XLA lowers
-    the inner all_to_all to ICI transfers."""
+    device, overflowed bool[] replicated). The per-leaf sharding is rows-split
+    along the mesh axis; XLA lowers the inner all_to_all to ICI transfers.
+    Callers MUST check `overflowed` and retry with a larger slot_cap."""
 
     def step(leaves, pid):
-        out, total = all_to_all_exchange(leaves, pid, ndev, slot_cap, axis)
-        return out, total[None]
+        out, total, ov = all_to_all_exchange(leaves, pid, ndev, slot_cap, axis)
+        return out, total[None], ov
 
     sharded = shard_map(
         step, mesh,
         in_specs=(P(axis), P(axis)),
-        out_specs=(P(axis), P(axis)),
+        out_specs=(P(axis), P(axis), P()),
     )
     return jax.jit(sharded)

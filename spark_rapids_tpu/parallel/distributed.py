@@ -75,10 +75,10 @@ def make_distributed_query_step(mesh: Mesh, ndev: int, n_groups: int,
         dcnt = dim_count[0]
         # ---- shuffle: hash-exchange both sides by join key over ICI
         fpid = _pids(fact_key, fcnt, ndev)
-        (fact_key2, fact_grp2, fact_val2), fn_total = all_to_all_exchange(
+        (fact_key2, fact_grp2, fact_val2), fn_total, _ = all_to_all_exchange(
             [fact_key, fact_grp, fact_val], fpid, ndev, axis=axis)
         dpid = _pids(dim_key, dcnt, ndev)
-        (dim_key2, dim_weight2), dn_total = all_to_all_exchange(
+        (dim_key2, dim_weight2), dn_total, _ = all_to_all_exchange(
             [dim_key, dim_weight], dpid, ndev, axis=axis)
 
         # ---- co-partitioned inner join (fact x dim on key), MXU-shaped:

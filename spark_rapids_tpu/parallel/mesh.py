@@ -38,3 +38,24 @@ def make_mesh(n_devices: Optional[int] = None,
     positions are ICI neighbours."""
     devs = mesh_devices(n_devices)
     return Mesh(np.array(devs), (axis,))
+
+
+_CONF_MESH: dict = {}
+
+
+def mesh_from_conf(conf) -> Optional[Mesh]:
+    """The session's active mesh, from `spark.rapids.tpu.mesh.shape`
+    ('shuffle=8' or just '8'; empty/1 = single device, no mesh). The engine
+    routes planned exchanges through ICI collectives when a mesh is active
+    (plan-driven distributed execution, not a hand-built program). Cached per
+    shape — Mesh identity matters for jax's compilation cache."""
+    shape = (conf.get("spark.rapids.tpu.mesh.shape") or "").strip()
+    if not shape:
+        return None
+    part = shape.split(",")[0].strip()
+    n = int(part.split("=")[-1])
+    if n <= 1:
+        return None
+    if shape not in _CONF_MESH:
+        _CONF_MESH[shape] = make_mesh(n)
+    return _CONF_MESH[shape]

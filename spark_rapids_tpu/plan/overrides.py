@@ -470,6 +470,10 @@ class Overrides:
                 self.explain_log.extend(lines)
         if self.conf.get("spark.rapids.sql.mode") == "explainOnly":
             return plan
+        from ..exec.base import TpuExec
+        if isinstance(result, TpuExec):
+            from ..exec.requirements import ensure_distribution
+            result = ensure_distribution(result, self.conf)
         return result
 
     def _convert(self, plan: N.PhysicalPlan):

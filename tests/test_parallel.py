@@ -73,8 +73,9 @@ def test_bucketize_then_compact_roundtrip(rng):
     data = rng.integers(0, 10_000, size=cap)
     pid_np = rng.integers(0, 4, size=cap).astype(np.int32)
     pid_np[n:] = -1
-    slotted, counts = bucketize_by_partition(
+    slotted, counts, overflowed = bucketize_by_partition(
         [jnp.asarray(data)], jnp.asarray(pid_np), 4, cap)
+    assert not bool(overflowed)
     counts = np.asarray(counts)
     for d in range(4):
         want = np.sort(data[:n][pid_np[:n] == d])
@@ -123,9 +124,11 @@ def test_all_to_all_exchange_8dev(rng):
         np, [_vec_i64(key)], np.ones(total_rows, bool))).astype(np.int32)
 
     fn = build_exchange_fn(mesh, NDEV)
-    leaves, counts = fn([_global_sharded(mesh, jnp.asarray(data)),
-                         _global_sharded(mesh, jnp.asarray(key))],
-                        _global_sharded(mesh, jnp.asarray(pid)))
+    leaves, counts, overflowed = fn(
+        [_global_sharded(mesh, jnp.asarray(data)),
+         _global_sharded(mesh, jnp.asarray(key))],
+        _global_sharded(mesh, jnp.asarray(pid)))
+    assert not bool(overflowed)
     counts = np.asarray(counts)
     assert counts.sum() == total_rows
     out_data = np.asarray(leaves[0]).reshape(NDEV, -1)
