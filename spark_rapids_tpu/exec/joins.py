@@ -203,22 +203,98 @@ class TpuShuffledHashJoinExec(TpuExec):
             build_batches = list(self.children[1].execute())
             if not build_batches and self.join_type in ("inner", "right", "semi"):
                 return
-            build = concat_batches(build_batches) if build_batches else None
-        stream = list(self.children[0].execute())
-        if not stream:
-            if build is not None and self.join_type in ("right", "full"):
-                yield self._right_only(build)
-            return
-        probe = concat_batches(stream)
-        if build is None:
-            from ..columnar.batch import empty_batch
-            build = empty_batch(self.children[1].output, 1)
+            if build_batches:
+                build = concat_batches(build_batches)
+            else:
+                from ..columnar.batch import empty_batch
+                build = empty_batch(self.children[1].output, 1)
+            del build_batches
 
         threshold = self.conf.get("spark.rapids.sql.join.subPartition.rows")
         if int(build.row_count()) > threshold:
-            yield from self._sub_partition_join(probe, build, threshold)
-            return
-        yield from self._join_pair(probe, build)
+            yield from self._streamed_sub_partition(build, threshold)
+        else:
+            yield from self._streamed_join(build)
+
+    def _streamed_join(self, build: ColumnarBatch) -> Iterator[ColumnarBatch]:
+        """Stream probe batches against the built table (`GpuHashJoin.doJoin`
+        `GpuHashJoin.scala:950`): only one probe batch is device-resident at a
+        time; the build side parks spillable between batches and the per-batch
+        join runs under the OOM-retry seam (split halves the probe batch)."""
+        from ..memory.retry import split_batch_halves, with_retry
+        from ..memory.spillable import SpillableColumnarBatch
+        sp_build = SpillableColumnarBatch(build)
+        del build
+        bmatched = None
+        try:
+            for probe in self.children[0].execute():
+                if int(probe.row_count()) == 0:
+                    continue
+
+                def run(sp_probe):
+                    b = sp_build.get_batch()
+                    p = sp_probe.get_batch()
+                    res = self._join_pair_core(p, b)
+                    sp_probe.close()
+                    return res
+
+                for out, bm in with_retry(SpillableColumnarBatch(probe), run,
+                                          split_batch_halves):
+                    if bm is not None:
+                        bmatched = bm if bmatched is None else (bmatched | bm)
+                    if int(out.row_count()) > 0:
+                        self.num_output_rows.add(out.row_count())
+                        yield self._count_output(out)
+            if self.join_type in ("right", "full"):
+                extra = self._unmatched_batch(sp_build.get_batch(), bmatched)
+                if extra is not None:
+                    self.num_output_rows.add(extra.row_count())
+                    yield self._count_output(extra)
+        finally:
+            sp_build.close()
+
+    def _streamed_sub_partition(self, build: ColumnarBatch,
+                                threshold: int) -> Iterator[ColumnarBatch]:
+        """Oversized build side with a streamed probe
+        (`GpuSubPartitionHashJoin.scala` analog): hash-split the build ONCE
+        into P spillable key-aligned sub-partitions; each probe batch is split
+        the same way and joined part-to-part. Matching keys land in the same
+        part, so per-part joins compose exactly; right/full unmatched flags
+        accumulate per part across the whole probe stream."""
+        from ..memory.spillable import SpillableColumnarBatch
+        n_build = int(build.row_count())
+        p = 1
+        while n_build // p > threshold and p < 64:
+            p *= 2
+        build_parts = [SpillableColumnarBatch(bb)
+                       for bb in _hash_split(build, self._rk_ix, p)]
+        del build
+        bmatched = [None] * p
+        try:
+            for probe in self.children[0].execute():
+                if int(probe.row_count()) == 0:
+                    continue
+                for i, pp in enumerate(_hash_split(probe, self._lk_ix, p)):
+                    if int(pp.row_count()) == 0:
+                        continue  # unmatched build rows surface at the end
+                    bb = build_parts[i].get_batch()
+                    out, bm = self._join_pair_core(pp, bb)
+                    if bm is not None:
+                        bmatched[i] = bm if bmatched[i] is None \
+                            else (bmatched[i] | bm)
+                    if int(out.row_count()) > 0:
+                        self.num_output_rows.add(out.row_count())
+                        yield self._count_output(out)
+            if self.join_type in ("right", "full"):
+                for i in range(p):
+                    extra = self._unmatched_batch(build_parts[i].get_batch(),
+                                                  bmatched[i])
+                    if extra is not None:
+                        self.num_output_rows.add(extra.row_count())
+                        yield self._count_output(extra)
+        finally:
+            for sp in build_parts:
+                sp.close()
 
     def _zipped_execute(self) -> Iterator[ColumnarBatch]:
         """Co-partitioned per-shard join: children are key-exchanges over the
@@ -246,8 +322,10 @@ class TpuShuffledHashJoinExec(TpuExec):
             else:
                 yield from self._join_pair(probe, build)
 
-    def _join_pair(self, probe: ColumnarBatch,
-                   build: ColumnarBatch) -> Iterator[ColumnarBatch]:
+    def _join_pair_core(self, probe: ColumnarBatch, build: ColumnarBatch):
+        """One probe batch vs the built table. Returns (out_batch, bmatched)
+        where bmatched is the device build-row matched mask (None unless
+        right/full) — callers accumulate it across the probe stream."""
         with self.join_time.timed():
             counts, lo, order, pvalid, bvalid = _probe_counts(
                 probe, build, self._lk_ix, self._rk_ix)
@@ -261,9 +339,17 @@ class TpuShuffledHashJoinExec(TpuExec):
                 out_cap = row_bucket(max(total, 1))
             out_vecs, n, bmatched = _expand_join(
                 probe, build, self._lk_ix, self._rk_ix, out_cap, self.join_type)
-            out = vecs_to_batch(
-                self._schema if self.join_type not in ("semi", "anti")
-                else self._schema, out_vecs, n)
+            out = vecs_to_batch(self._schema, out_vecs, n)
+        if self.join_type not in ("right", "full"):
+            bmatched = None
+        return out, bmatched
+
+    def _join_pair(self, probe: ColumnarBatch,
+                   build: ColumnarBatch) -> Iterator[ColumnarBatch]:
+        """Join one disjoint (probe, build) pair and emit its unmatched build
+        rows immediately — correct only when this build slice meets no other
+        probe rows (zipped per-shard and sub-partition pair joins)."""
+        out, bmatched = self._join_pair_core(probe, build)
         self.num_output_rows.add(out.row_count())
         yield self._count_output(out)
 
@@ -302,6 +388,8 @@ class TpuShuffledHashJoinExec(TpuExec):
             sp_build.close()
 
     def _unmatched_batch(self, build, bmatched):
+        if bmatched is None:  # no probe batch ever touched this build slice
+            bmatched = jnp.zeros(build.capacity, dtype=bool)
         rvecs, n = _unmatched_build(build, len(self.children[0].output.types),
                                     bmatched)
         if int(n) == 0:

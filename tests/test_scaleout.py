@@ -112,6 +112,59 @@ class TestSubPartitionJoin:
         assert_same(q, sort_by=sort_cols)
 
 
+class TestStreamedProbeJoin:
+    """The probe side of a join must stream: one probe batch on device at a
+    time against a parked build table (GpuHashJoin.doJoin model), never a
+    concat of the whole stream side."""
+
+    @pytest.mark.parametrize("how", ["inner", "left", "right", "full",
+                                     "semi", "anti"])
+    def test_streamed_probe_residency(self, small_batch_session, rng, how,
+                                      monkeypatch):
+        from spark_rapids_tpu.exec.joins import TpuShuffledHashJoinExec
+        probe_caps = []
+        orig = TpuShuffledHashJoinExec._join_pair_core
+
+        def spy(self, probe, build):
+            probe_caps.append(int(probe.capacity))
+            return orig(self, probe, build)
+
+        monkeypatch.setattr(TpuShuffledHashJoinExec, "_join_pair_core", spy)
+        # stream side 20x the batch target; build side small
+        left = small_batch_session.from_arrow(big_table(rng, n=4000))
+        right = small_batch_session.from_arrow(
+            big_table(rng, n=300).rename_columns(["k", "v2", "i2", "s2"]))
+        q = left.join(right, on="k", how=how)
+        sort_cols = ["k", "i", "v"] if how in ("semi", "anti") else \
+            ["k", "i", "v", "i2", "v2"]
+        assert_same(q, sort_by=sort_cols)
+        assert probe_caps, "join never ran through _join_pair_core"
+        # peak probe residency stays O(batch target), not O(stream side)
+        assert max(probe_caps) < 1024, probe_caps
+        assert len(probe_caps) >= 10  # genuinely streamed, batch by batch
+
+    def test_streamed_sub_partition_residency(self, rng, monkeypatch):
+        from spark_rapids_tpu.exec.joins import TpuShuffledHashJoinExec
+        sess = TpuSession({"spark.rapids.sql.enabled": True,
+                           "spark.rapids.sql.explain": "NONE",
+                           "spark.rapids.sql.batchSizeRows": 200,
+                           "spark.rapids.sql.join.subPartition.rows": 100})
+        probe_caps = []
+        orig = TpuShuffledHashJoinExec._join_pair_core
+
+        def spy(self, probe, build):
+            probe_caps.append(int(probe.capacity))
+            return orig(self, probe, build)
+
+        monkeypatch.setattr(TpuShuffledHashJoinExec, "_join_pair_core", spy)
+        left = sess.from_arrow(big_table(rng, n=2000))
+        right = sess.from_arrow(
+            big_table(rng, n=600).rename_columns(["k", "v2", "i2", "s2"]))
+        q = left.join(right, on="k", how="full")
+        assert_same(q, sort_by=["k", "i", "v", "i2", "v2"])
+        assert probe_caps and max(probe_caps) < 1024, probe_caps
+
+
 class TestRetryIntegration:
     def test_injected_split_retry_in_aggregate(self, small_batch_session,
                                                rng):
