@@ -58,6 +58,19 @@ class Schema:
         return f"Schema({inner})"
 
 
+def join_output_schema(left: Schema, right: Schema, join_type: str) -> Schema:
+    """Output schema of a join — the ONE definition shared by the CPU oracle
+    and both device join execs so they can never drift. semi/anti project the
+    left side; existence appends the bool `exists` flag; everything else
+    (inner/cross/left/right/full) is the combined row."""
+    if join_type in ("semi", "anti"):
+        return left
+    if join_type == "existence":
+        return Schema(left.names + ("exists",),
+                      left.types + (T.BooleanType(),))
+    return Schema(left.names + right.names, left.types + right.types)
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class ColumnarBatch:

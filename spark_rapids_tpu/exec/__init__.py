@@ -5,5 +5,6 @@ from .coalesce import TpuCoalesceBatchesExec, concat_batches, TargetSize, \
     RequireSingleBatch  # noqa: F401
 from .aggregate import TpuHashAggregateExec  # noqa: F401
 from .sort import TpuSortExec  # noqa: F401
-from .joins import TpuShuffledHashJoinExec, TpuBroadcastHashJoinExec  # noqa: F401
+from .joins import (TpuShuffledHashJoinExec, TpuBroadcastHashJoinExec,  # noqa: F401
+                    TpuNestedLoopJoinExec)
 from .transitions import TpuFromCpuExec, CpuFromTpuExec  # noqa: F401

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import types as T
-from ..columnar.batch import ColumnarBatch, Schema
+from ..columnar.batch import ColumnarBatch, Schema, join_output_schema
 from ..columnar.padding import row_bucket
 from ..expr.base import Expression, Vec, bind_references
 from ..expr.hashing import hash_vecs
@@ -32,6 +32,22 @@ from ..ops.rowops import compact_vecs, gather_vecs
 from ..utils import metrics as M
 from .base import TpuExec, batch_vecs, device_ctx, vecs_to_batch
 from .coalesce import concat_batches
+
+
+class _StaticExpr:
+    """Identity-keyed wrapper so a bound Expression can ride as a jit static
+    argument: Expression overloads __eq__/__gt__/… to BUILD expression trees,
+    which breaks jax's static-argument hashing."""
+    __slots__ = ("expr",)
+
+    def __init__(self, expr):
+        self.expr = expr
+
+    def __hash__(self):
+        return id(self.expr)
+
+    def __eq__(self, other):
+        return isinstance(other, _StaticExpr) and other.expr is self.expr
 
 
 def _keys_valid(xp, keys: List[Vec]):
@@ -80,12 +96,13 @@ def _probe_counts(probe: ColumnarBatch, build: ColumnarBatch,
     return counts, lo.astype(np.int32), order.astype(np.int32), pvalid, bvalid
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5))
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6))
 def _expand_join(probe: ColumnarBatch, build: ColumnarBatch,
                  probe_key_ix: Tuple[int, ...], build_key_ix: Tuple[int, ...],
-                 out_cap: int, join_type: str):
-    """Phase 2: expand candidate ranges to pairs, equality-check, compact; attach
-    outer rows. Returns (out_batch)."""
+                 out_cap: int, join_type: str, condition=None):
+    """Phase 2: expand candidate ranges to pairs, equality-check (plus the
+    optional non-equi join condition evaluated on the gathered pair), compact;
+    attach outer rows. Returns (out_vecs, n, bmatched)."""
     xp = jnp
     counts, lo, order, pvalid, bvalid = _probe_counts(
         probe, build, probe_key_ix, build_key_ix)
@@ -110,7 +127,6 @@ def _expand_join(probe: ColumnarBatch, build: ColumnarBatch,
     pi = xp.clip(pi, 0, pcap - 1)
     base = xp.where(pi > 0, offsets[xp.maximum(pi - 1, 0)], 0)
     k = j - base
-    has_match = counts[pi] > 0
     bidx_sorted = xp.clip(lo[pi] + k, 0, bcap - 1)
     bi = order[bidx_sorted]
 
@@ -118,8 +134,22 @@ def _expand_join(probe: ColumnarBatch, build: ColumnarBatch,
     gp = gather_vecs(xp, pkeys, pi)
     gb = gather_vecs(xp, bkeys, bi)
     eq = _keys_equal(xp, gp, gb) & pvalid[pi] & bvalid[bi] & (k < counts[pi])
-    keep = live & (eq | (outer_left & ~has_match & (k == 0)))
+
+    left_out = gather_vecs(xp, pvecs, pi)
+    right_out = gather_vecs(xp, bvecs, bi)
+
+    if condition is not None:
+        # join condition over the combined row; NULL counts as no-match
+        from ..expr.base import EvalContext
+        cvec = condition.expr.eval(EvalContext(xp), left_out + right_out)
+        eq = eq & cvec.data.astype(bool) & cvec.validity
+
     matched = eq & live
+    # per-probe-row "any true match" — candidate ranges can be pure hash
+    # collisions, so counts[pi] > 0 alone must NOT suppress the outer null row
+    pmatched = xp.zeros(pcap, dtype=bool)
+    pmatched = pmatched.at[xp.where(matched, pi, pcap - 1)].max(matched)
+    keep = live & (matched | (outer_left & ~pmatched[pi] & (k == 0)))
 
     # build matched flags for right/full outer (scatter-or: value False where not
     # matched, so redirecting those slots is harmless)
@@ -127,17 +157,18 @@ def _expand_join(probe: ColumnarBatch, build: ColumnarBatch,
     if join_type in ("right", "full"):
         bmatched = bmatched.at[xp.where(matched, bi, bcap - 1)].max(matched)
 
-    left_out = gather_vecs(xp, pvecs, pi)
-    right_out = gather_vecs(xp, bvecs, bi)
     # null out the right side where no match (outer fill)
     right_out = [Vec(v.dtype, v.data, v.validity & matched, v.lengths)
                  for v in right_out] if join_type in ("left", "full") else right_out
 
-    if join_type in ("semi", "anti"):
-        # one output row per qualifying probe row
-        any_match = xp.zeros(pcap, dtype=bool)
-        any_match = any_match.at[xp.where(matched, pi, pcap - 1)].max(matched)
-        want = any_match if join_type == "semi" else (~any_match & pmask)
+    if join_type in ("semi", "anti", "existence"):
+        if join_type == "existence":
+            # all live probe rows, plus the exists flag column
+            exists = Vec(T.BooleanType(), pmatched,
+                         xp.ones(pcap, dtype=bool))
+            out_vecs, n = compact_vecs(xp, pvecs + [exists], pmask)
+            return out_vecs, n, bmatched
+        want = pmatched if join_type == "semi" else (~pmatched & pmask)
         out_vecs, n = compact_vecs(xp, pvecs, want & pmask)
         return out_vecs, n, bmatched
 
@@ -160,7 +191,8 @@ class TpuShuffledHashJoinExec(TpuExec):
     def __init__(self, left: TpuExec, right: TpuExec,
                  left_keys: Sequence[Expression],
                  right_keys: Sequence[Expression],
-                 join_type: str = "inner", conf=None):
+                 join_type: str = "inner", conf=None,
+                 condition: Expression = None):
         super().__init__([left, right], conf)
         self.join_type = join_type
         self.left_keys = list(left_keys)
@@ -170,10 +202,13 @@ class TpuShuffledHashJoinExec(TpuExec):
         # instead of concatenating the streams (per-shard join)
         self.zip_partitions = False
         lo, ro = left.output, right.output
-        if join_type in ("semi", "anti"):
-            self._schema = lo
-        else:
-            self._schema = Schema(lo.names + ro.names, lo.types + ro.types)
+        self._schema = join_output_schema(lo, ro, join_type)
+        # optional non-equi condition over the combined (left ++ right) row
+        # (reference: condition joins filtered post-gather, GpuHashJoin.scala)
+        self.condition = condition
+        self._bcond = None if condition is None else _StaticExpr(
+            bind_references(condition,
+                            Schema(lo.names + ro.names, lo.types + ro.types)))
         self.join_time = self.metrics.create(M.JOIN_TIME, M.ESSENTIAL)
         self.build_time = self.metrics.create(M.BUILD_TIME, M.MODERATE)
         # keys must be simple column refs after planning; planner projects
@@ -238,13 +273,17 @@ class TpuShuffledHashJoinExec(TpuExec):
                     sp_probe.close()
                     return res
 
-                for out, bm in with_retry(SpillableColumnarBatch(probe), run,
-                                          split_batch_halves):
-                    if bm is not None:
-                        bmatched = bm if bmatched is None else (bmatched | bm)
-                    if int(out.row_count()) > 0:
-                        self.num_output_rows.add(out.row_count())
-                        yield self._count_output(out)
+                sp = SpillableColumnarBatch(probe)
+                try:
+                    for out, bm in with_retry(sp, run, split_batch_halves):
+                        if bm is not None:
+                            bmatched = bm if bmatched is None \
+                                else (bmatched | bm)
+                        if int(out.row_count()) > 0:
+                            self.num_output_rows.add(out.row_count())
+                            yield self._count_output(out)
+                finally:
+                    sp.close()  # no-op on the success path (run closed it)
             if self.join_type in ("right", "full"):
                 extra = self._unmatched_batch(sp_build.get_batch(), bmatched)
                 if extra is not None:
@@ -333,12 +372,13 @@ class TpuShuffledHashJoinExec(TpuExec):
             slot = jnp.where(probe.row_mask(),
                              jnp.maximum(counts, 1) if outer_left else counts, 0)
             total = int(jnp.sum(slot))
-            if self.join_type in ("semi", "anti"):
+            if self.join_type in ("semi", "anti", "existence"):
                 out_cap = max(row_bucket(max(total, 1)), probe.capacity)
             else:
                 out_cap = row_bucket(max(total, 1))
             out_vecs, n, bmatched = _expand_join(
-                probe, build, self._lk_ix, self._rk_ix, out_cap, self.join_type)
+                probe, build, self._lk_ix, self._rk_ix, out_cap,
+                self.join_type, self._bcond)
             out = vecs_to_batch(self._schema, out_vecs, n)
         if self.join_type not in ("right", "full"):
             bmatched = None
@@ -401,17 +441,7 @@ class TpuShuffledHashJoinExec(TpuExec):
         return self._null_left_batch(rvecs, build.num_rows, build.capacity)
 
     def _null_left_batch(self, rvecs: List[Vec], n, cap: int) -> ColumnarBatch:
-        from ..columnar.batch import empty_batch
-        lschema = self.children[0].output
-        lvecs = []
-        for dt in lschema.types:
-            if isinstance(dt, T.StringType):
-                lvecs.append(Vec(dt, jnp.zeros((cap, 8), jnp.uint8),
-                                 jnp.zeros(cap, bool),
-                                 jnp.zeros(cap, jnp.int32)))
-            else:
-                lvecs.append(Vec(dt, jnp.zeros(cap, dt.np_dtype),
-                                 jnp.zeros(cap, bool)))
+        lvecs = _null_vecs(self.children[0].output, cap)
         return vecs_to_batch(self._schema, lvecs + rvecs, n)
 
     def _arg_string(self):
@@ -432,6 +462,212 @@ def _hash_split(batch: ColumnarBatch, key_ix: Tuple[int, ...],
     from .exchange import _slice_partition
     pid = _hash_pid(batch, key_ix, p)
     return [_slice_partition(batch, pid, q) for q in range(p)]
+
+
+def _slice_rows(batch: ColumnarBatch, lo: int, hi: int) -> ColumnarBatch:
+    """Host-slice a device batch to rows [lo, hi); logical count clamps."""
+    n = int(batch.row_count())
+    vecs = [Vec(v.dtype, v.data[lo:hi], v.validity[lo:hi],
+                None if v.lengths is None else v.lengths[lo:hi])
+            for v in batch_vecs(batch)]
+    return vecs_to_batch(batch.schema, vecs, max(0, min(n - lo, hi - lo)))
+
+
+def _null_vecs(schema: Schema, cap: int) -> List[Vec]:
+    """All-null columns for one side of an outer join at the given capacity."""
+    vecs = []
+    for dt in schema.types:
+        if isinstance(dt, T.StringType):
+            vecs.append(Vec(dt, jnp.zeros((cap, 8), jnp.uint8),
+                            jnp.zeros(cap, bool), jnp.zeros(cap, jnp.int32)))
+        else:
+            vecs.append(Vec(dt, jnp.zeros(cap, dt.np_dtype),
+                            jnp.zeros(cap, bool)))
+    return vecs
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _nl_matched(probe: ColumnarBatch, bchunk: ColumnarBatch, cond):
+    """All-pairs tile: matched mask over the P x C grid (flattened row-major),
+    plus per-probe-row / per-build-row any-match and the total."""
+    xp = jnp
+    P, C = probe.capacity, bchunk.capacity
+    pi = xp.repeat(xp.arange(P, dtype=np.int32), C)
+    bi = xp.tile(xp.arange(C, dtype=np.int32), P)
+    m = probe.row_mask()[pi] & bchunk.row_mask()[bi]
+    if cond is not None:
+        from ..expr.base import EvalContext
+        gp = gather_vecs(xp, batch_vecs(probe), pi)
+        gb = gather_vecs(xp, batch_vecs(bchunk), bi)
+        cv = cond.expr.eval(EvalContext(xp), gp + gb)
+        m = m & cv.data.astype(bool) & cv.validity
+    grid = m.reshape(P, C)
+    return m, grid.any(axis=1), grid.any(axis=0), xp.sum(m).astype(np.int32)
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _nl_expand(probe: ColumnarBatch, bchunk: ColumnarBatch, out_cap: int,
+               matched):
+    """Gather the surviving pairs of an all-pairs tile into output columns."""
+    xp = jnp
+    P, C = probe.capacity, bchunk.capacity
+    pi = xp.repeat(xp.arange(P, dtype=np.int32), C)
+    bi = xp.tile(xp.arange(C, dtype=np.int32), P)
+    order = xp.argsort(~matched, stable=True)[:out_cap]
+    n = xp.sum(matched).astype(np.int32)
+    left_out = gather_vecs(xp, batch_vecs(probe), pi[order])
+    right_out = gather_vecs(xp, batch_vecs(bchunk), bi[order])
+    return left_out + right_out, n
+
+
+@jax.jit
+def _compact_rows(batch: ColumnarBatch, want):
+    return compact_vecs(jnp, batch_vecs(batch), want & batch.row_mask())
+
+
+class TpuNestedLoopJoinExec(TpuExec):
+    """Nested-loop / cartesian join (reference
+    `GpuBroadcastNestedLoopJoinExecBase.scala:1`, `GpuCartesianProductExec.scala:1`,
+    ExistenceJoin in `GpuHashJoin.scala`): every probe row meets every build row,
+    filtered by an optional condition. TPU shape: the build (right) side is
+    materialized once (broadcast analog) and host-sliced into fixed-capacity
+    chunks; each streamed probe batch is joined against each chunk as a bounded
+    P x C all-pairs tile, so XLA only ever sees static tile shapes. Matched
+    flags accumulate per probe batch (left/semi/anti/existence) and per build
+    chunk across the stream (right/full)."""
+
+    TILE_BUDGET = 1 << 20   # max pairs per tile
+    PROBE_TILE_ROWS = 4096  # probe rows per tile; C = TILE_BUDGET / this
+
+    def __init__(self, left: TpuExec, right: TpuExec,
+                 condition: Expression = None, join_type: str = "inner",
+                 conf=None):
+        super().__init__([left, right], conf)
+        self.join_type = "inner" if join_type == "cross" else join_type
+        self.condition = condition
+        lo, ro = left.output, right.output
+        combined = Schema(lo.names + ro.names, lo.types + ro.types)
+        self._schema = join_output_schema(lo, ro, self.join_type)
+        self._bcond = None if condition is None else _StaticExpr(
+            bind_references(condition, combined))
+        self.join_time = self.metrics.create(M.JOIN_TIME, M.ESSENTIAL)
+        self.build_time = self.metrics.create(M.BUILD_TIME, M.MODERATE)
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        from ..columnar.batch import empty_batch
+        from ..memory.spillable import SpillableColumnarBatch
+        with self.build_time.timed():
+            build_batches = list(self.children[1].execute())
+            if not build_batches and self.join_type in ("inner", "right", "semi"):
+                return
+            build = concat_batches(build_batches) if build_batches else \
+                empty_batch(self.children[1].output, 1)
+            del build_batches
+        chunks = [SpillableColumnarBatch(c) for c in self._slice_build(build)]
+        del build
+        bmatched = [None] * len(chunks)
+        jt = self.join_type
+        pt = self.PROBE_TILE_ROWS
+        try:
+            for whole_probe in self.children[0].execute():
+                if int(whole_probe.row_count()) == 0:
+                    continue
+                # tile the probe side too: each row-slice is an independent
+                # probe unit (tails are per-row, rows are disjoint), keeping
+                # every P x C tile within TILE_BUDGET regardless of how the
+                # upstream coalesce sized the batch
+                pcap = whole_probe.capacity
+                probes = [whole_probe] if pcap <= pt else \
+                    [_slice_rows(whole_probe, lo, min(lo + pt, pcap))
+                     for lo in range(0, pcap, pt)]
+                for probe in probes:
+                    pmatched = None
+                    for ci, sp in enumerate(chunks):
+                        bchunk = sp.get_batch()
+                        with self.join_time.timed():
+                            m, pm, bm, total = _nl_matched(probe, bchunk,
+                                                           self._bcond)
+                            pmatched = pm if pmatched is None \
+                                else (pmatched | pm)
+                            if jt in ("right", "full"):
+                                bmatched[ci] = bm if bmatched[ci] is None \
+                                    else (bmatched[ci] | bm)
+                            if jt in ("semi", "anti", "existence"):
+                                continue  # only flags needed
+                            n_total = int(total)
+                            if n_total == 0:
+                                continue
+                            out_vecs, n = _nl_expand(probe, bchunk,
+                                                     row_bucket(n_total), m)
+                        yield self._emit(vecs_to_batch(self._schema,
+                                                       out_vecs, n))
+                    yield from self._emit_probe_tail(probe, pmatched)
+            if jt in ("right", "full"):
+                for ci, sp in enumerate(chunks):
+                    extra = self._unmatched_chunk(sp.get_batch(), bmatched[ci])
+                    if extra is not None:
+                        yield self._emit(extra)
+        finally:
+            for sp in chunks:
+                sp.close()
+
+    def _slice_build(self, build: ColumnarBatch) -> List[ColumnarBatch]:
+        """Host-slice the build table into capacity-C chunks; C is sized so a
+        PROBE_TILE_ROWS x C tile stays within TILE_BUDGET pairs."""
+        bcap = build.capacity
+        c = max(1, min(bcap, self.TILE_BUDGET // self.PROBE_TILE_ROWS))
+        return [_slice_rows(build, lo, min(lo + c, bcap))
+                for lo in range(0, max(bcap, 1), c)]
+
+    def _emit_probe_tail(self, probe: ColumnarBatch,
+                         pmatched) -> Iterator[ColumnarBatch]:
+        """Per-probe-batch epilogue once every build chunk was seen."""
+        xp = jnp
+        jt = self.join_type
+        pcap = probe.capacity
+        if pmatched is None:
+            pmatched = xp.zeros(pcap, dtype=bool)
+        if jt in ("left", "full"):
+            vecs, n = _compact_rows(probe, ~pmatched)
+            if int(n) == 0:
+                return
+            rschema = self.children[1].output
+            yield self._emit(vecs_to_batch(
+                self._schema, vecs + _null_vecs(rschema, pcap), n))
+        elif jt in ("semi", "anti"):
+            want = pmatched if jt == "semi" else ~pmatched
+            vecs, n = _compact_rows(probe, want)
+            if int(n) == 0:
+                return
+            yield self._emit(vecs_to_batch(self._schema, vecs, n))
+        elif jt == "existence":
+            exists = Vec(T.BooleanType(), pmatched, xp.ones(pcap, dtype=bool))
+            vecs, n = compact_vecs(xp, batch_vecs(probe) + [exists],
+                                   probe.row_mask())
+            yield self._emit(vecs_to_batch(self._schema, vecs, n))
+
+    def _unmatched_chunk(self, bchunk: ColumnarBatch, bmatched):
+        xp = jnp
+        if bmatched is None:
+            bmatched = xp.zeros(bchunk.capacity, dtype=bool)
+        vecs, n = _compact_rows(bchunk, ~bmatched)
+        if int(n) == 0:
+            return None
+        lschema = self.children[0].output
+        return vecs_to_batch(self._schema,
+                             _null_vecs(lschema, bchunk.capacity) + vecs, n)
+
+    def _emit(self, out: ColumnarBatch) -> ColumnarBatch:
+        self.num_output_rows.add(out.row_count())
+        return self._count_output(out)
+
+    def _arg_string(self):
+        cond = "" if self.condition is None else f", cond={self.condition!r}"
+        return f"[{self.join_type}{cond}]"
 
 
 class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):

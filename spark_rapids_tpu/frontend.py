@@ -55,13 +55,22 @@ class DataFrame:
     def agg(self, **named_aggs: AggregateFunction) -> "DataFrame":
         return GroupedData(self, []).agg(**named_aggs)
 
-    def join(self, other: "DataFrame", on: Union[str, Sequence[str]],
-             how: str = "inner") -> "DataFrame":
-        keys = [on] if isinstance(on, str) else list(on)
+    def join(self, other: "DataFrame", on: Union[str, Sequence[str], None] = None,
+             how: str = "inner", condition=None) -> "DataFrame":
+        """Equi join on `on` keys (optionally with an extra `condition`
+        predicate over the combined row), or — with no keys — a cartesian /
+        pure-condition nested loop join. `how` additionally accepts "cross"
+        and "existence" (left rows + bool `exists` column)."""
+        keys = [] if on is None else \
+            ([on] if isinstance(on, str) else list(on))
         lk = [_as_expr(k) for k in keys]
         rk = [_as_expr(k) for k in keys]
         return DataFrame(self.session,
-                         N.CpuHashJoinExec(self.plan, other.plan, lk, rk, how))
+                         N.CpuHashJoinExec(self.plan, other.plan, lk, rk, how,
+                                           condition=condition))
+
+    def cross_join(self, other: "DataFrame") -> "DataFrame":
+        return self.join(other, how="cross")
 
     def sort(self, *orders, ascending: bool = True,
              nulls_first: Optional[bool] = None) -> "DataFrame":

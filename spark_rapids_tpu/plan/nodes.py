@@ -217,6 +217,8 @@ def _take_np(arr, idx):
 
 def _key_bytes(keys: List[Vec], n: int) -> np.ndarray:
     """Pack key columns into fixed-width row bytes for np.unique grouping."""
+    if n == 0:
+        return np.zeros((0, 1), np.uint8)
     parts = []
     for k in keys:
         parts.append(k.validity.astype(np.uint8).reshape(n, 1))
@@ -330,78 +332,96 @@ def _cpu_agg(func: AggregateFunction, ctx, b: HostBatch, gid, ng) -> Vec:
 
 
 class CpuHashJoinExec(PhysicalPlan):
-    """CPU oracle join: pandas merge on key frames (independent of device path)."""
+    """CPU oracle join (independent of the device path). Covers equi joins with
+    an optional extra condition, pure condition / cartesian joins (no keys), and
+    join types inner/cross/left/right/full/semi/anti/existence. Reference
+    semantics: GpuHashJoin.scala, GpuBroadcastNestedLoopJoinExecBase.scala,
+    GpuCartesianProductExec.scala, ExistenceJoin handling."""
 
     def __init__(self, left: PhysicalPlan, right: PhysicalPlan,
                  left_keys: Sequence[Expression], right_keys: Sequence[Expression],
-                 join_type: str = "inner"):
+                 join_type: str = "inner", condition: Expression = None):
         super().__init__([left, right])
         self.left_keys = list(left_keys)
         self.right_keys = list(right_keys)
-        self.join_type = join_type
+        self.join_type = "inner" if join_type == "cross" else join_type
+        self.condition = condition
         self._bl = [bind_references(e, left.output) for e in self.left_keys]
         self._br = [bind_references(e, right.output) for e in self.right_keys]
         lo, ro = left.output, right.output
-        if join_type in ("semi", "anti"):
-            self._schema = lo
-        else:
-            self._schema = Schema(lo.names + ro.names, lo.types + ro.types)
+        combined = Schema(lo.names + ro.names, lo.types + ro.types)
+        self._bcond = None if condition is None else \
+            bind_references(condition, combined)
+        from ..columnar.batch import join_output_schema
+        self._schema = join_output_schema(lo, ro, self.join_type)
 
     @property
     def output(self) -> Schema:
         return self._schema
 
+    def _candidate_pairs(self, left, right):
+        """(li, ri) int64 arrays of key-equal candidate pairs; all pairs when
+        keyless (cartesian / pure-condition join)."""
+        nl, nr = left.num_rows, right.num_rows
+        if not self._bl:
+            return (np.repeat(np.arange(nl, dtype=np.int64), nr),
+                    np.tile(np.arange(nr, dtype=np.int64), nl))
+        lk = _key_bytes([e.eval(_ctx(nl), left.vecs) for e in self._bl], nl)
+        rk = _key_bytes([e.eval(_ctx(nr), right.vecs) for e in self._br], nr)
+        # null keys never match (standard equi-join): a key row is joinable only
+        # if every key's validity byte is 1
+        lvalid = _all_keys_valid([e.eval(_ctx(nl), left.vecs)
+                                  for e in self._bl], nl)
+        rvalid = _all_keys_valid([e.eval(_ctx(nr), right.vecs)
+                                  for e in self._br], nr)
+        rmap: dict = {}
+        for r in np.nonzero(rvalid)[0]:
+            rmap.setdefault(rk[r].tobytes(), []).append(r)
+        li, ri = [], []
+        for i in np.nonzero(lvalid)[0]:
+            for r in rmap.get(lk[i].tobytes(), ()):
+                li.append(i)
+                ri.append(r)
+        return (np.array(li, dtype=np.int64), np.array(ri, dtype=np.int64))
+
     def execute_cpu(self):
-        from ..cpu.hostbatch import host_batch_to_arrow, host_batch_from_arrow
         left = _concat_host(list(self.children[0].execute_cpu()),
                             self.children[0].output)
         right = _concat_host(list(self.children[1].execute_cpu()),
                              self.children[1].output)
-        lk = _key_bytes([e.eval(_ctx(left.num_rows), left.vecs)
-                         for e in self._bl], left.num_rows)
-        rk = _key_bytes([e.eval(_ctx(right.num_rows), right.vecs)
-                         for e in self._br], right.num_rows)
-        # null keys never match (standard equi-join): a key row is joinable only
-        # if every key's validity byte is 1
-        lvalid = _all_keys_valid([e.eval(_ctx(left.num_rows), left.vecs)
-                                  for e in self._bl], left.num_rows)
-        rvalid = _all_keys_valid([e.eval(_ctx(right.num_rows), right.vecs)
-                                  for e in self._br], right.num_rows)
-        lmap: dict = {}
-        for i in np.nonzero(lvalid)[0]:
-            lmap.setdefault(lk[i].tobytes(), []).append(i)
-        rmap: dict = {}
-        for i in np.nonzero(rvalid)[0]:
-            rmap.setdefault(rk[i].tobytes(), []).append(i)
+        nl, nr = left.num_rows, right.num_rows
+        li0, ri0 = self._candidate_pairs(left, right)
+        if self._bcond is not None and len(li0):
+            pair_vecs = _gather_side(left, li0) + _gather_side(right, ri0)
+            cv = self._bcond.eval(_ctx(len(li0)), pair_vecs)
+            ok = np.asarray(cv.data, dtype=bool) & np.asarray(cv.validity)
+            li0, ri0 = li0[ok], ri0[ok]
 
-        li, ri = [], []
         jt = self.join_type
-        if jt in ("inner", "left", "right", "full"):
-            matched_r = set()
-            for i in range(left.num_rows):
-                key = lk[i].tobytes() if lvalid[i] else None
-                rs = rmap.get(key, []) if key is not None else []
-                if rs:
-                    for r in rs:
-                        li.append(i)
-                        ri.append(r)
-                        matched_r.add(r)
-                elif jt in ("left", "full"):
+        matched_l = np.zeros(nl, dtype=bool)
+        matched_l[li0] = True
+        li, ri = list(li0), list(ri0)
+        if jt == "inner":
+            pass
+        elif jt in ("left", "full", "right"):
+            if jt in ("left", "full"):
+                for i in np.nonzero(~matched_l)[0]:
                     li.append(i)
                     ri.append(-1)
             if jt in ("right", "full"):
-                for r in range(right.num_rows):
-                    if r not in matched_r:
-                        li.append(-1)
-                        ri.append(r)
+                matched_r = np.zeros(nr, dtype=bool)
+                matched_r[ri0] = True
+                for r in np.nonzero(~matched_r)[0]:
+                    li.append(-1)
+                    ri.append(r)
         elif jt == "semi":
-            for i in range(left.num_rows):
-                if lvalid[i] and lk[i].tobytes() in rmap:
-                    li.append(i)
+            li = list(np.nonzero(matched_l)[0])
         elif jt == "anti":
-            for i in range(left.num_rows):
-                if not (lvalid[i] and lk[i].tobytes() in rmap):
-                    li.append(i)
+            li = list(np.nonzero(~matched_l)[0])
+        elif jt == "existence":
+            exists = Vec(T.BooleanType(), matched_l, np.ones(nl, dtype=bool))
+            yield HostBatch(self._schema, list(left.vecs) + [exists], nl)
+            return
         else:
             raise ValueError(jt)
         li = np.array(li, dtype=np.int64)
@@ -411,7 +431,9 @@ class CpuHashJoinExec(PhysicalPlan):
         yield HostBatch(self._schema, out_vecs, len(li))
 
     def _arg_string(self):
-        return f"[{self.join_type}, keys={[repr(e) for e in self.left_keys]}]"
+        cond = "" if self.condition is None else f", cond={self.condition!r}"
+        return f"[{self.join_type}, keys={[repr(e) for e in self.left_keys]}" \
+               f"{cond}]"
 
 
 def _all_keys_valid(keys: List[Vec], n: int) -> np.ndarray:
@@ -427,6 +449,14 @@ def _gather_side(b: HostBatch, idx: np.ndarray) -> List[Vec]:
     safe = np.where(missing, 0, idx)
     out = []
     for v in b.vecs:
+        if v.data.shape[0] == 0:
+            # empty side of an outer join: every requested row is the null pad
+            n = len(idx)
+            data = np.zeros((n,) + v.data.shape[1:], dtype=v.data.dtype)
+            out.append(Vec(v.dtype, data, np.zeros(n, dtype=bool),
+                           None if v.lengths is None
+                           else np.zeros(n, dtype=np.int32)))
+            continue
         out.append(Vec(v.dtype, _take_np(v.data, safe),
                        v.validity[safe] & ~missing,
                        None if v.lengths is None else v.lengths[safe]))

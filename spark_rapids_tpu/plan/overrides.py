@@ -277,6 +277,8 @@ def _exprs_agg(m: PlanMeta):
 def _exprs_join(m: PlanMeta):
     for e in m.plan._bl + m.plan._br:
         m.add_expr(e)
+    if m.plan._bcond is not None:
+        m.add_expr(m.plan._bcond)
 
 
 def _exprs_sort(m: PlanMeta):
@@ -297,7 +299,7 @@ def _tag_join(m: PlanMeta):
             m.will_not_work("join keys must be column references "
                             "(project them first)")
     if m.plan.join_type not in ("inner", "left", "right", "full", "semi",
-                                "anti"):
+                                "anti", "existence"):
         m.will_not_work(f"join type {m.plan.join_type} not supported on TPU")
 
 
@@ -322,9 +324,14 @@ def _c_agg(plan, children, conf):
 
 
 def _c_join(plan, children, conf):
-    from ..exec.joins import TpuShuffledHashJoinExec
+    from ..exec.joins import TpuNestedLoopJoinExec, TpuShuffledHashJoinExec
+    if not plan.left_keys:
+        # keyless: cartesian product / pure-condition nested loop join
+        return TpuNestedLoopJoinExec(children[0], children[1], plan.condition,
+                                     plan.join_type, conf)
     return TpuShuffledHashJoinExec(children[0], children[1], plan.left_keys,
-                                   plan.right_keys, plan.join_type, conf)
+                                   plan.right_keys, plan.join_type, conf,
+                                   condition=plan.condition)
 
 
 def _c_sort(plan, children, conf):

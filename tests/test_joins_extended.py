@@ -1,0 +1,124 @@
+"""Extended join forms: condition (non-equi) joins, cartesian / nested loop,
+and existence joins — differential CPU-vs-TPU (reference:
+GpuBroadcastNestedLoopJoinExecBase.scala, GpuCartesianProductExec.scala,
+condition handling in GpuHashJoin.scala, ExistenceJoin)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.expr import col, lit
+from spark_rapids_tpu.plugin import TpuSession
+
+from test_queries import assert_same
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession({"spark.rapids.sql.enabled": True,
+                       "spark.rapids.sql.explain": "NONE"})
+
+
+def left_table(rng, n=400):
+    nulls = rng.random(n) < 0.1
+    return pa.table({
+        "k": pa.array(np.where(nulls, 0, rng.integers(0, 25, n)),
+                      type=pa.int64(), mask=nulls),
+        "a": pa.array(rng.integers(-50, 50, n), type=pa.int32()),
+        "x": pa.array(rng.normal(0, 10, n).round(3), type=pa.float64()),
+    })
+
+
+def right_table(rng, n=300):
+    nulls = rng.random(n) < 0.1
+    return pa.table({
+        "k": pa.array(np.where(nulls, 0, rng.integers(0, 25, n)),
+                      type=pa.int64(), mask=nulls),
+        "b": pa.array(rng.integers(-50, 50, n), type=pa.int32()),
+        "y": pa.array(rng.normal(0, 10, n).round(3), type=pa.float64()),
+    })
+
+
+ALL_TYPES = ["inner", "left", "right", "full", "semi", "anti", "existence"]
+
+
+def _sort_cols(how):
+    if how in ("semi", "anti"):
+        return ["k", "a", "x"]
+    if how == "existence":
+        return ["k", "a", "x", "exists"]
+    return ["k", "a", "x", "b", "y"]
+
+
+class TestConditionHashJoin:
+    @pytest.mark.parametrize("how", ALL_TYPES)
+    def test_equi_with_condition(self, session, rng, how):
+        left = session.from_arrow(left_table(rng))
+        right = session.from_arrow(right_table(rng))
+        q = left.join(right, on="k", how=how, condition=col("a") > col("b"))
+        assert_same(q, sort_by=_sort_cols(how))
+
+    def test_condition_null_is_no_match(self, session):
+        # condition evaluating to NULL must behave as false
+        lt = pa.table({"k": pa.array([1, 2], type=pa.int64()),
+                       "a": pa.array([None, 5], type=pa.int32())})
+        rt = pa.table({"k": pa.array([1, 2], type=pa.int64()),
+                       "b": pa.array([0, None], type=pa.int32())})
+        left, right = session.from_arrow(lt), session.from_arrow(rt)
+        q = left.join(right, on="k", how="left", condition=col("a") > col("b"))
+        assert_same(q, sort_by=["k"])
+
+
+class TestExistenceHashJoin:
+    def test_existence_basic(self, session, rng):
+        left = session.from_arrow(left_table(rng, n=250))
+        right = session.from_arrow(right_table(rng, n=150))
+        q = left.join(right, on="k", how="existence")
+        assert_same(q, sort_by=["k", "a", "x", "exists"])
+
+    def test_existence_empty_build(self, session, rng):
+        left = session.from_arrow(left_table(rng, n=50))
+        right = session.from_arrow(right_table(rng, n=150)) \
+            .filter(col("b") > lit(10**6))
+        q = left.join(right, on="k", how="existence")
+        assert_same(q, sort_by=["k", "a", "x", "exists"])
+
+
+class TestNestedLoopJoin:
+    def test_cross_join(self, session, rng):
+        left = session.from_arrow(left_table(rng, n=60))
+        right = session.from_arrow(right_table(rng, n=45))
+        q = left.cross_join(right)
+        assert_same(q, sort_by=["k", "a", "x", "b", "y"])
+
+    @pytest.mark.parametrize("how", ALL_TYPES)
+    def test_pure_condition_join(self, session, rng, how):
+        left = session.from_arrow(left_table(rng, n=80))
+        right = session.from_arrow(right_table(rng, n=70))
+        q = left.join(right, how=how, condition=col("a") == col("b"))
+        assert_same(q, sort_by=_sort_cols(how))
+
+    def test_non_equi_range_condition(self, session, rng):
+        left = session.from_arrow(left_table(rng, n=90))
+        right = session.from_arrow(right_table(rng, n=60))
+        q = left.join(right, how="inner",
+                      condition=(col("a") > col("b")) &
+                                (col("x") < col("y")))
+        assert_same(q, sort_by=["k", "a", "x", "b", "y"])
+
+    def test_empty_sides(self, session, rng):
+        left = session.from_arrow(left_table(rng, n=40))
+        empty = session.from_arrow(right_table(rng, n=30)) \
+            .filter(col("b") > lit(10**6))
+        for how in ("inner", "left", "semi", "anti", "full"):
+            q = left.join(empty, how=how, condition=col("a") > col("b"))
+            assert_same(q, sort_by=_sort_cols(how))
+
+    def test_streams_probe_batches(self, rng):
+        sess = TpuSession({"spark.rapids.sql.enabled": True,
+                           "spark.rapids.sql.explain": "NONE",
+                           "spark.rapids.sql.batchSizeRows": 64})
+        left = sess.from_arrow(left_table(rng, n=300))
+        right = sess.from_arrow(right_table(rng, n=40))
+        q = left.join(right, how="full", condition=col("a") > col("b"))
+        assert_same(q, sort_by=["k", "a", "x", "b", "y"])
