@@ -205,13 +205,8 @@ def batch_to_arrow(batch: ColumnarBatch):
 
 
 def empty_batch(schema: Schema, capacity: int = 0) -> ColumnarBatch:
+    from ..expr.base import zero_vec
     cap = row_bucket(max(capacity, 1))
-    cols = []
-    for dt in schema.types:
-        if isinstance(dt, T.StringType):
-            cols.append(Column(dt, jnp.zeros((cap, 8), jnp.uint8),
-                               jnp.zeros(cap, bool), jnp.zeros(cap, jnp.int32)))
-        else:
-            cols.append(Column(dt, jnp.zeros(cap, dt.np_dtype),
-                               jnp.zeros(cap, bool)))
-    return ColumnarBatch(schema, tuple(cols), jnp.asarray(0, jnp.int32))
+    cols = tuple(zero_vec(jnp, dt, (cap,)).to_column()
+                 for dt in schema.types)
+    return ColumnarBatch(schema, cols, jnp.asarray(0, jnp.int32))

@@ -43,32 +43,46 @@ __all__ = ["Column", "make_column", "from_numpy", "from_arrow", "to_arrow"]
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class Column:
-    """A device column: data + validity (+ lengths for strings).
+    """A device column: data + validity (+ lengths for strings, + children for
+    nested types).
 
     dtype is static (pytree aux); arrays are leaves. For STRING columns `data` is
     uint8[cap, width] and `lengths` is int32[cap]; otherwise `lengths` is None and
     `data` is dtype[cap].
+
+    Nested types (fixed-fanout layout, the string byte-matrix generalized):
+      * array<elem>: `data` is int32[cap] per-row element counts; `children` is
+        (elem_column,) whose arrays carry leading dims [cap, K] where K is the
+        fanout bucket (width_bucket of the max list size);
+      * struct<fields>: `data` is bool[cap] (a placeholder mirroring validity);
+        `children` holds one column per field with leading dim [cap].
+    Child leading dims always start with the parent capacity, so row-wise ops
+    (gather/slice/compact/concat) apply uniformly down the tree.
     """
 
     dtype: T.DataType
     data: jnp.ndarray
     validity: jnp.ndarray
     lengths: Optional[jnp.ndarray] = None
+    children: Optional[Tuple["Column", ...]] = None
 
     # -- pytree ---------------------------------------------------------------
     def tree_flatten(self):
-        if self.lengths is None:
-            return (self.data, self.validity), (self.dtype, False)
-        return (self.data, self.validity, self.lengths), (self.dtype, True)
+        leaves = [self.data, self.validity]
+        has_len = self.lengths is not None
+        if has_len:
+            leaves.append(self.lengths)
+        kids = tuple(self.children) if self.children else ()
+        leaves.extend(kids)
+        return tuple(leaves), (self.dtype, has_len, len(kids))
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
-        dtype, has_len = aux
-        if has_len:
-            data, validity, lengths = leaves
-            return cls(dtype, data, validity, lengths)
-        data, validity = leaves
-        return cls(dtype, data, validity, None)
+        dtype, has_len, nk = aux
+        i = 3 if has_len else 2
+        lengths = leaves[2] if has_len else None
+        kids = tuple(leaves[i:i + nk]) if nk else None
+        return cls(dtype, leaves[0], leaves[1], lengths, kids)
 
     # -- shape ----------------------------------------------------------------
     @property
@@ -88,11 +102,14 @@ class Column:
         n = self.data.size * self.data.dtype.itemsize + self.validity.size
         if self.lengths is not None:
             n += self.lengths.size * 4
+        for c in (self.children or ()):
+            n += c.device_memory_size()
         return n
 
     # -- construction helpers -------------------------------------------------
     def with_validity(self, validity: jnp.ndarray) -> "Column":
-        return Column(self.dtype, self.data, validity, self.lengths)
+        return Column(self.dtype, self.data, validity, self.lengths,
+                      self.children)
 
     def repadded(self, new_cap: int) -> "Column":
         """Grow/shrink capacity (host-side op; used by coalesce/re-bucketing)."""
@@ -107,7 +124,9 @@ class Column:
             return a[:new_cap]
 
         return Column(self.dtype, fit(self.data), fit(self.validity),
-                      None if self.lengths is None else fit(self.lengths))
+                      None if self.lengths is None else fit(self.lengths),
+                      None if self.children is None else tuple(
+                          c.repadded(new_cap) for c in self.children))
 
     # -- host boundary --------------------------------------------------------
     def to_numpy(self, num_rows: int) -> Tuple[np.ndarray, np.ndarray]:
@@ -215,7 +234,17 @@ def from_arrow(arr, capacity: Optional[int] = None) -> Tuple[Column, int]:
     npdt = dtype.np_dtype
     if npdt is None:
         if dtype.is_nested:
-            raise TypeError(f"nested arrow type not yet device-backed: {arr.type}")
+            if isinstance(dtype, T.MapType):
+                raise TypeError(f"map type not yet device-backed: {arr.type}")
+            # array/struct: build the exact-length host form, then pad the
+            # leading dim of every buffer to the capacity bucket and ship
+            from ..cpu.hostbatch import host_vec_from_arrow, vec_map_arrays
+            hv = host_vec_from_arrow(arr)
+
+            def pad_ship(leaf):
+                return jnp.asarray(_pad_to(np.asarray(leaf), cap))
+
+            return vec_map_arrays(hv, pad_ship).to_column(), n
         raise TypeError(
             f"type not yet device-backed: {arr.type} "
             "(wide decimal >18 digits needs limb support; binary needs the string "
@@ -245,6 +274,12 @@ def from_arrow(arr, capacity: Optional[int] = None) -> Tuple[Column, int]:
 def to_arrow(col: Column, num_rows: int):
     """Device Column -> Arrow array (host boundary)."""
     import pyarrow as pa
+    if col.children is not None:
+        from ..cpu.hostbatch import host_vec_to_arrow, vec_map_arrays
+        from ..expr.base import Vec
+        hv = vec_map_arrays(Vec.from_column(col),
+                            lambda a: np.asarray(a)[:num_rows])
+        return host_vec_to_arrow(hv, num_rows)
     valid = np.asarray(col.validity[:num_rows])
     mask = ~valid
     if col.is_string:

@@ -32,6 +32,9 @@ class HostBatch:
         return self.vecs[i]
 
 
+from ..expr.base import vec_map_arrays  # noqa: F401  (canonical home)
+
+
 def host_vec_from_arrow(arr) -> Vec:
     import pyarrow as pa
     if isinstance(arr, pa.ChunkedArray):
@@ -40,6 +43,34 @@ def host_vec_from_arrow(arr) -> Vec:
     n = len(arr)
     valid = np.ones(n, dtype=bool) if arr.null_count == 0 else \
         np.asarray(arr.is_valid())
+    if isinstance(dtype, T.ArrayType):
+        # fixed-fanout layout: per-row size vector + [n, K] element matrix
+        la = arr.cast(pa.large_list(arr.type.value_type))
+        offs = np.frombuffer(la.buffers()[1], dtype=np.int64, count=n + 1,
+                             offset=la.offset * 8)
+        lens_raw = np.diff(offs)
+        lens = np.where(valid, lens_raw, 0).astype(np.int32)
+        k = width_bucket(int(lens.max())) if n and lens.size else 8
+        child = host_vec_from_arrow(la.values)
+        row_id = np.repeat(np.arange(n), lens)
+        within = (np.arange(row_id.size) -
+                  np.repeat(np.concatenate(([0], np.cumsum(lens)[:-1])), lens)) \
+            if n else np.zeros(0, np.int64)
+        src = np.repeat(offs[:-1], lens) + within if n else \
+            np.zeros(0, np.int64)
+
+        def scatter(leaf):
+            out = np.zeros((n, k) + leaf.shape[1:], dtype=leaf.dtype)
+            if row_id.size:
+                out[row_id, within] = leaf[src]
+            return out
+
+        elem = vec_map_arrays(child, scatter)
+        return Vec(dtype, lens, valid, None, (elem,))
+    if isinstance(dtype, T.StructType):
+        kids = tuple(host_vec_from_arrow(arr.field(i))
+                     for i in range(arr.type.num_fields))
+        return Vec(dtype, valid.copy(), valid, None, kids)
     if isinstance(dtype, T.StringType):
         la = arr.cast(pa.large_string())
         buffers = la.buffers()
@@ -88,6 +119,33 @@ def host_vec_to_arrow(v: Vec, num_rows: Optional[int] = None):
     n = num_rows if num_rows is not None else v.validity.shape[0]
     valid = np.asarray(v.validity[:n]).astype(bool)
     mask = ~valid
+    if isinstance(v.dtype, T.ArrayType):
+        lens = np.where(valid, np.asarray(v.data[:n]), 0).astype(np.int64)
+        elem = v.children[0]
+        k = elem.data.shape[1] if elem.data.ndim >= 2 else 0
+        keep = (np.arange(k)[None, :] < lens[:, None]) if n and k else \
+            np.zeros((n, k), dtype=bool)
+
+        def flatten(leaf):
+            return np.asarray(leaf[:n])[keep]
+
+        flat = vec_map_arrays(elem, flatten)
+        values = host_vec_to_arrow(flat, int(lens.sum()))
+        offsets = np.concatenate(([0], np.cumsum(lens)))
+        out = pa.LargeListArray.from_arrays(offsets, values)
+        if mask.any():
+            # stamp the null bitmap on (from_arrays has no mask for lists)
+            out = pa.Array.from_buffers(
+                out.type, n,
+                [pa.py_buffer(np.packbits(valid, bitorder="little").tobytes()),
+                 out.buffers()[1]],
+                null_count=int(mask.sum()), children=[values])
+        return out.cast(pa.list_(out.type.value_type))
+    if isinstance(v.dtype, T.StructType):
+        fields = [host_vec_to_arrow(c, n) for c in v.children]
+        return pa.StructArray.from_arrays(
+            fields, names=[f.name for f in v.dtype.fields],
+            mask=pa.array(mask))
     if v.is_string:
         chars = np.asarray(v.data[:n])
         lens = np.where(valid, np.asarray(v.lengths[:n]), 0).astype(np.int64)

@@ -64,6 +64,22 @@ class TpuExec:
         return ""
 
 
+class StaticExpr:
+    """Identity-keyed wrapper so a bound Expression can ride as a jit static
+    argument: Expression overloads __eq__/__gt__/… to BUILD expression trees,
+    which breaks jax's static-argument hashing."""
+    __slots__ = ("expr",)
+
+    def __init__(self, expr):
+        self.expr = expr
+
+    def __hash__(self):
+        return id(self.expr)
+
+    def __eq__(self, other):
+        return isinstance(other, StaticExpr) and other.expr is self.expr
+
+
 class UnaryTpuExec(TpuExec):
     @property
     def child(self) -> TpuExec:

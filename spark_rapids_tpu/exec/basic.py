@@ -223,9 +223,7 @@ class TpuLimitExec(UnaryTpuExec):
                 out = ColumnarBatch(b.schema, b.columns,
                                     jnp.asarray(take, jnp.int32))
             else:
-                sliced = [Vec(v.dtype,
-                              v.data[start:], v.validity[start:],
-                              None if v.lengths is None else v.lengths[start:])
+                sliced = [v.slice_rows(start, None)
                           for v in batch_vecs(b)]
                 out = vecs_to_batch(b.schema, sliced, take)
             remaining -= take

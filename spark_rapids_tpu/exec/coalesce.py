@@ -35,34 +35,44 @@ class RequireSingleBatch(CoalesceGoal):
     pass
 
 
+def _concat_padded(arrs: List) -> jnp.ndarray:
+    """Concatenate along axis 0, padding every trailing dim to the max across
+    inputs — one rule covering string widths, array fanouts, and any nesting
+    of the two."""
+    nd = arrs[0].ndim
+    if nd == 1:
+        return jnp.concatenate(arrs)
+    tgt = tuple(max(a.shape[d] for a in arrs) for d in range(1, nd))
+    padded = [jnp.pad(a, [(0, 0)] + [(0, t - a.shape[d + 1])
+                                     for d, t in enumerate(tgt)])
+              for a in arrs]
+    return jnp.concatenate(padded)
+
+
+def _concat_vec_group(vs: List[Vec]) -> Vec:
+    """Concatenate the same column across batches, recursing children. Every
+    buffer gets the padded concat: child validity/lengths share the fanout
+    dims of data, and fanout buckets can differ per batch."""
+    kids = None
+    if vs[0].children is not None:
+        kids = tuple(_concat_vec_group([v.children[i] for v in vs])
+                     for i in range(len(vs[0].children)))
+    return Vec(vs[0].dtype, _concat_padded([v.data for v in vs]),
+               _concat_padded([v.validity for v in vs]),
+               None if vs[0].lengths is None
+               else _concat_padded([v.lengths for v in vs]), kids)
+
+
 @functools.partial(jax.jit, static_argnums=(1,))
 def _concat_kernel(batches: List[ColumnarBatch], out_cap: int) -> ColumnarBatch:
     schema = batches[0].schema
     ncols = len(schema.types)
     masks = jnp.concatenate([b.row_mask() for b in batches])
-    out_vecs = []
     cols_by_i = [[Vec.from_column(b.columns[i]) for b in batches]
                  for i in range(ncols)]
-    merged: List[Vec] = []
-    for i in range(ncols):
-        vs = cols_by_i[i]
-        if vs[0].is_string:
-            w = max(v.data.shape[1] for v in vs)
-            data = jnp.concatenate(
-                [jnp.pad(v.data, ((0, 0), (0, w - v.data.shape[1])))
-                 for v in vs])
-            merged.append(Vec(vs[0].dtype, data,
-                              jnp.concatenate([v.validity for v in vs]),
-                              jnp.concatenate([v.lengths for v in vs])))
-        else:
-            merged.append(Vec(vs[0].dtype,
-                              jnp.concatenate([v.data for v in vs]),
-                              jnp.concatenate([v.validity for v in vs])))
+    merged = [_concat_vec_group(cols_by_i[i]) for i in range(ncols)]
     compacted, total = compact_vecs(jnp, merged, masks)
-    for v in compacted:
-        out_vecs.append(Vec(
-            v.dtype, v.data[:out_cap], v.validity[:out_cap],
-            None if v.lengths is None else v.lengths[:out_cap]))
+    out_vecs = [v.slice_rows(0, out_cap) for v in compacted]
     return vecs_to_batch(schema, out_vecs, total)
 
 

@@ -1,0 +1,104 @@
+"""Generate exec — explode/posexplode (+outer) on device (reference
+`GpuGenerateExec.scala:1`).
+
+TPU lowering: like the join expansion, the data-dependent output size is
+bucketed on host — phase 1 computes per-row slot counts and their sum on
+device, one sync picks the output capacity bucket, phase 2 expands with a
+static output shape: output slot j maps to child row pi via searchsorted over
+the slot-count prefix sum, and to element pos k = j - base[pi]."""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..columnar.batch import ColumnarBatch, Schema
+from ..columnar.padding import row_bucket
+from ..expr.base import (Expression, Vec, bind_references,
+                         vec_map_arrays as _map_elem)
+from ..expr.collections import Explode
+from ..utils import metrics as M
+from .base import (StaticExpr as _StaticExpr, TpuExec, UnaryTpuExec,
+                   batch_vecs, vecs_to_batch)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _gen_counts(batch: ColumnarBatch, gen, outer: bool):
+    from ..expr.base import EvalContext
+    xp = jnp
+    arr = gen.expr.children[0].eval(EvalContext(xp), batch_vecs(batch))
+    sizes = xp.where(arr.validity & batch.row_mask(), arr.data, 0) \
+        .astype(np.int32)
+    slots = xp.maximum(sizes, 1) if outer else sizes
+    slots = xp.where(batch.row_mask(), slots, 0)
+    return sizes, slots, xp.sum(slots).astype(np.int32)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+def _gen_expand(batch: ColumnarBatch, gen, out_cap: int, outer: bool,
+                position: bool):
+    from ..expr.base import EvalContext
+    xp = jnp
+    arr = gen.expr.children[0].eval(EvalContext(xp), batch_vecs(batch))
+    elem = arr.children[0]
+    k = elem.data.shape[1]
+    sizes, slots, total = _gen_counts(batch, gen, outer)
+    cap = batch.capacity
+    offsets = xp.cumsum(slots)
+    j = xp.arange(out_cap, dtype=np.int32)
+    live = j < total
+    pi = xp.searchsorted(offsets, j, side="right").astype(np.int32)
+    pi = xp.clip(pi, 0, cap - 1)
+    base = xp.where(pi > 0, offsets[xp.maximum(pi - 1, 0)], 0)
+    pos = j - base
+    out_vecs = [v.gather(xp, pi) for v in batch_vecs(batch)]
+    extra = []
+    elem_live = live & (pos < sizes[pi])  # outer's filler row stays null
+    if position:
+        # pos is NULL on the outer filler row too (Spark GenerateExec joins
+        # the generator null row, nulling every generator column)
+        extra.append(Vec(T.INT, pos, elem_live))
+    safe = xp.minimum(pos, max(k - 1, 0))
+    col = _map_elem(elem, lambda a: a[pi, safe])
+    extra.append(Vec(col.dtype, col.data, col.validity & elem_live,
+                     col.lengths, col.children))
+    return out_vecs + extra, total
+
+
+class TpuGenerateExec(UnaryTpuExec):
+    def __init__(self, generator: Explode, child: TpuExec, conf=None):
+        super().__init__([child], conf)
+        self.generator = generator
+        self._bound = _StaticExpr(bind_references(generator, child.output))
+        co = child.output
+        gen_out = self._bound.expr.generator_output()
+        self._schema = Schema(co.names + tuple(n for n, _ in gen_out),
+                              co.types + tuple(t for _, t in gen_out))
+        self.gen_time = self.metrics.create(M.OP_TIME, M.MODERATE)
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        g = self._bound.expr
+        for b in self.child.execute():
+            with self.gen_time.timed():
+                _, _, total = _gen_counts(b, self._bound, g.outer)
+                n_total = int(total)
+                if n_total == 0:
+                    continue
+                out_vecs, n = _gen_expand(b, self._bound,
+                                          row_bucket(n_total), g.outer,
+                                          g.position)
+                out = vecs_to_batch(self._schema, out_vecs, n)
+            self.num_output_rows.add(out.row_count())
+            yield self._count_output(out)
+
+    def _arg_string(self):
+        return f"[{self.generator!r}]"

@@ -30,24 +30,9 @@ from ..expr.hashing import hash_vecs
 from ..expr.predicates import string_equal
 from ..ops.rowops import compact_vecs, gather_vecs
 from ..utils import metrics as M
-from .base import TpuExec, batch_vecs, device_ctx, vecs_to_batch
+from .base import (StaticExpr as _StaticExpr, TpuExec, batch_vecs,
+                   device_ctx, vecs_to_batch)
 from .coalesce import concat_batches
-
-
-class _StaticExpr:
-    """Identity-keyed wrapper so a bound Expression can ride as a jit static
-    argument: Expression overloads __eq__/__gt__/… to BUILD expression trees,
-    which breaks jax's static-argument hashing."""
-    __slots__ = ("expr",)
-
-    def __init__(self, expr):
-        self.expr = expr
-
-    def __hash__(self):
-        return id(self.expr)
-
-    def __eq__(self, other):
-        return isinstance(other, _StaticExpr) and other.expr is self.expr
 
 
 def _keys_valid(xp, keys: List[Vec]):
@@ -158,7 +143,8 @@ def _expand_join(probe: ColumnarBatch, build: ColumnarBatch,
         bmatched = bmatched.at[xp.where(matched, bi, bcap - 1)].max(matched)
 
     # null out the right side where no match (outer fill)
-    right_out = [Vec(v.dtype, v.data, v.validity & matched, v.lengths)
+    right_out = [Vec(v.dtype, v.data, v.validity & matched, v.lengths,
+                     v.children)
                  for v in right_out] if join_type in ("left", "full") else right_out
 
     if join_type in ("semi", "anti", "existence"):
@@ -467,23 +453,14 @@ def _hash_split(batch: ColumnarBatch, key_ix: Tuple[int, ...],
 def _slice_rows(batch: ColumnarBatch, lo: int, hi: int) -> ColumnarBatch:
     """Host-slice a device batch to rows [lo, hi); logical count clamps."""
     n = int(batch.row_count())
-    vecs = [Vec(v.dtype, v.data[lo:hi], v.validity[lo:hi],
-                None if v.lengths is None else v.lengths[lo:hi])
-            for v in batch_vecs(batch)]
+    vecs = [v.slice_rows(lo, hi) for v in batch_vecs(batch)]
     return vecs_to_batch(batch.schema, vecs, max(0, min(n - lo, hi - lo)))
 
 
 def _null_vecs(schema: Schema, cap: int) -> List[Vec]:
     """All-null columns for one side of an outer join at the given capacity."""
-    vecs = []
-    for dt in schema.types:
-        if isinstance(dt, T.StringType):
-            vecs.append(Vec(dt, jnp.zeros((cap, 8), jnp.uint8),
-                            jnp.zeros(cap, bool), jnp.zeros(cap, jnp.int32)))
-        else:
-            vecs.append(Vec(dt, jnp.zeros(cap, dt.np_dtype),
-                            jnp.zeros(cap, bool)))
-    return vecs
+    from ..expr.base import zero_vec
+    return [zero_vec(jnp, dt, (cap,)) for dt in schema.types]
 
 
 @functools.partial(jax.jit, static_argnums=(2,))

@@ -27,6 +27,16 @@ def host_batch_to_device(hb: HostBatch) -> ColumnarBatch:
     cap = row_bucket(n)
     cols = []
     for v in hb.vecs:
+        if v.is_nested:
+            from ..cpu.hostbatch import vec_map_arrays
+
+            def pad_ship(a):
+                a = np.asarray(a)
+                pad = [(0, cap - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+                return jnp.asarray(np.pad(a, pad))
+
+            cols.append(vec_map_arrays(v, pad_ship).to_column())
+            continue
         valid = np.zeros(cap, dtype=bool)
         valid[:n] = v.validity
         if v.is_string:
@@ -48,6 +58,11 @@ def device_batch_to_host(b: ColumnarBatch) -> HostBatch:
     n = b.row_count()
     vecs = []
     for c in b.columns:
+        if c.children is not None:
+            from ..cpu.hostbatch import vec_map_arrays
+            vecs.append(vec_map_arrays(Vec.from_column(c),
+                                       lambda a: np.asarray(a)[:n]))
+            continue
         valid = np.asarray(c.validity[:n])
         if c.is_string:
             vecs.append(Vec(c.dtype, np.asarray(c.data[:n]), valid,

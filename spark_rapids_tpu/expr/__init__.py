@@ -27,6 +27,9 @@ from .windowexprs import (RowFrame, RangeFrame, WindowFunction, RowNumber,  # no
                           Lag, WindowAggregate)
 from .regex import (RLike, Like, RegExpReplace, RegExpExtract,  # noqa: F401
                     device_supported_pattern)
+from .collections import (Size, GetArrayItem, ElementAt, ArrayContains,  # noqa: F401
+                          CreateArray, CreateNamedStruct, GetStructField,
+                          Explode)
 
 
 def col(name):  # convenience constructors for tests / DataFrame API

@@ -33,37 +33,104 @@ __all__ = ["Vec", "EvalContext", "Expression", "LeafExpression", "Literal",
 @dataclasses.dataclass
 class Vec:
     """Backend-generic column value: arrays are np.ndarray or jnp tracers.
-    Registered as a pytree so jitted kernels can take/return Vecs directly."""
+    Registered as a pytree so jitted kernels can take/return Vecs directly.
+
+    Nested layout (one design shared with Column — see columnar/column.py):
+      * array<elem>:  data = int32 per-row element count, lengths = None,
+        children = (elem Vec,) whose arrays have leading dims [cap, K]
+        (K = fanout bucket) — the fixed-fanout analog of the string
+        byte-matrix;
+      * struct<...>:  data = bool placeholder (mirror of validity),
+        children = one Vec per field with leading dim [cap].
+    Every child array's leading dim equals the parent capacity, so row-wise
+    gather/slice/compact apply uniformly down the tree."""
     dtype: T.DataType
     data: Any
     validity: Any
     lengths: Any = None
+    children: Any = None  # tuple of child Vecs for nested types
 
     def tree_flatten(self):
-        if self.lengths is None:
-            return (self.data, self.validity), (self.dtype, False)
-        return (self.data, self.validity, self.lengths), (self.dtype, True)
+        leaves = [self.data, self.validity]
+        has_len = self.lengths is not None
+        if has_len:
+            leaves.append(self.lengths)
+        kids = tuple(self.children) if self.children else ()
+        leaves.extend(kids)
+        return tuple(leaves), (self.dtype, has_len, len(kids))
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
-        dtype, has_len = aux
-        if has_len:
-            return cls(dtype, leaves[0], leaves[1], leaves[2])
-        return cls(dtype, leaves[0], leaves[1], None)
+        dtype, has_len, nk = aux
+        i = 3 if has_len else 2
+        lengths = leaves[2] if has_len else None
+        kids = tuple(leaves[i:i + nk]) if nk else None
+        return cls(dtype, leaves[0], leaves[1], lengths, kids)
 
     @property
     def is_string(self) -> bool:
         return isinstance(self.dtype, T.StringType)
 
+    @property
+    def is_nested(self) -> bool:
+        return self.children is not None
+
     @staticmethod
     def from_column(col: Column) -> "Vec":
-        return Vec(col.dtype, col.data, col.validity, col.lengths)
+        kids = None if col.children is None else tuple(
+            Vec.from_column(c) for c in col.children)
+        return Vec(col.dtype, col.data, col.validity, col.lengths, kids)
 
     def to_column(self) -> Column:
         import jax.numpy as jnp
+        kids = None if self.children is None else tuple(
+            c.to_column() for c in self.children)
         return Column(self.dtype, jnp.asarray(self.data),
                       jnp.asarray(self.validity),
-                      None if self.lengths is None else jnp.asarray(self.lengths))
+                      None if self.lengths is None else jnp.asarray(self.lengths),
+                      kids)
+
+    # -- uniform row-wise structural ops (recurse through children) ----------
+    def gather(self, xp, idx) -> "Vec":
+        """Gather rows by index along axis 0, down the tree."""
+        return Vec(self.dtype, self.data[idx], self.validity[idx],
+                   None if self.lengths is None else self.lengths[idx],
+                   None if self.children is None else tuple(
+                       c.gather(xp, idx) for c in self.children))
+
+    def slice_rows(self, lo, hi) -> "Vec":
+        """Slice rows [lo, hi) along axis 0, down the tree."""
+        return Vec(self.dtype, self.data[lo:hi], self.validity[lo:hi],
+                   None if self.lengths is None else self.lengths[lo:hi],
+                   None if self.children is None else tuple(
+                       c.slice_rows(lo, hi) for c in self.children))
+
+
+def vec_map_arrays(v: Vec, fn) -> Vec:
+    """Apply fn to every array buffer of a Vec, recursing through children.
+    fn must preserve the invariant that all buffers share the leading dim."""
+    return Vec(v.dtype, fn(v.data), fn(v.validity),
+               None if v.lengths is None else fn(v.lengths),
+               None if v.children is None else tuple(
+                   vec_map_arrays(c, fn) for c in v.children))
+
+
+def zero_vec(xp, dt: T.DataType, shape: tuple) -> Vec:
+    """All-null Vec of any (possibly nested) dtype with the given leading
+    shape — (cap,) at top level, (cap, K) inside an array, etc. The ONE
+    definition of the empty/null column layout (minimal string width 8,
+    minimal array fanout 8)."""
+    validity = xp.zeros(shape, dtype=bool)
+    if isinstance(dt, T.StringType):
+        return Vec(dt, xp.zeros(shape + (8,), dtype=xp.uint8), validity,
+                   xp.zeros(shape, dtype=xp.int32))
+    if isinstance(dt, T.ArrayType):
+        return Vec(dt, xp.zeros(shape, dtype=xp.int32), validity, None,
+                   (zero_vec(xp, dt.element_type, shape + (8,)),))
+    if isinstance(dt, T.StructType):
+        return Vec(dt, xp.zeros(shape, dtype=bool), validity, None,
+                   tuple(zero_vec(xp, f.data_type, shape) for f in dt.fields))
+    return Vec(dt, xp.zeros(shape, dtype=dt.np_dtype or np.int32), validity)
 
 
 @dataclasses.dataclass

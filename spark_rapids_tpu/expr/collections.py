@@ -1,0 +1,276 @@
+"""Collection (array/struct) expressions over the fixed-fanout nested layout
+(reference: `complexTypeExtractors.scala:1` GetArrayItem/GetStructField/ElementAt,
+`complexTypeCreator.scala:1` CreateArray/CreateNamedStruct,
+`collectionOperations.scala:1` Size/ArrayContains).
+
+Layout recap (expr/base.py Vec): an array column's `data` is the per-row element
+count; `children[0]` holds the element buffers with leading dims [n, K]. A struct
+column's `children` are its field columns at leading dim [n]."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .. import types as T
+from ..columnar.padding import width_bucket
+from .base import EvalContext, Expression, Vec, vec_map_arrays as _map_elem
+
+
+class Size(Expression):
+    """size(array). Spark legacy semantics (default): size(NULL) = -1."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    @property
+    def nullable(self):
+        return False
+
+    def _compute(self, ctx: EvalContext, arr: Vec) -> Vec:
+        xp = ctx.xp
+        data = xp.where(arr.validity, arr.data, -1).astype(np.int32)
+        return Vec(T.INT, data, xp.ones(data.shape[0], dtype=bool))
+
+
+class GetArrayItem(Expression):
+    """array[i] — 0-based; null when index OOB, index null, or array null."""
+
+    def __init__(self, child: Expression, ordinal: Expression):
+        super().__init__([child, ordinal])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type.element_type
+
+    @property
+    def nullable(self):
+        return True
+
+    def _compute(self, ctx: EvalContext, arr: Vec, idx: Vec) -> Vec:
+        xp = ctx.xp
+        elem = arr.children[0]
+        n = arr.data.shape[0]
+        k = elem.data.shape[1]
+        i = idx.data.astype(np.int32)
+        ok = arr.validity & idx.validity & (i >= 0) & (i < arr.data)
+        safe = xp.clip(i, 0, max(k - 1, 0))
+        rows = xp.arange(n)
+        out = _map_elem(elem, lambda a: a[rows, safe])
+        return Vec(out.dtype, out.data, out.validity & ok, out.lengths,
+                   out.children)
+
+
+class ElementAt(Expression):
+    """element_at(array, i) — 1-based; negative counts from the end."""
+
+    def __init__(self, child: Expression, ordinal: Expression):
+        super().__init__([child, ordinal])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type.element_type
+
+    @property
+    def nullable(self):
+        return True
+
+    def _compute(self, ctx: EvalContext, arr: Vec, idx: Vec) -> Vec:
+        xp = ctx.xp
+        elem = arr.children[0]
+        n = arr.data.shape[0]
+        k = elem.data.shape[1]
+        i = idx.data.astype(np.int32)
+        size = arr.data.astype(np.int32)
+        eff = xp.where(i > 0, i - 1, size + i)
+        ok = arr.validity & idx.validity & (i != 0) & \
+            (eff >= 0) & (eff < size)
+        safe = xp.clip(eff, 0, max(k - 1, 0))
+        rows = xp.arange(n)
+        out = _map_elem(elem, lambda a: a[rows, safe])
+        return Vec(out.dtype, out.data, out.validity & ok, out.lengths,
+                   out.children)
+
+
+class ArrayContains(Expression):
+    """array_contains(array, value): true if found; null if the array is null,
+    the value is null, or the value is absent but the array holds a null."""
+
+    def __init__(self, child: Expression, value: Expression):
+        super().__init__([child, value])
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    @property
+    def nullable(self):
+        return True
+
+    def _compute(self, ctx: EvalContext, arr: Vec, val: Vec) -> Vec:
+        xp = ctx.xp
+        elem = arr.children[0]
+        k = elem.data.shape[1]
+        size = arr.data.astype(np.int32)
+        slot_live = xp.arange(k)[None, :] < size[:, None]
+        if T.is_floating(elem.dtype):
+            eq = (elem.data == val.data[:, None]) | \
+                (xp.isnan(elem.data) & xp.isnan(val.data)[:, None])
+        else:
+            eq = elem.data == val.data[:, None]
+        hit = slot_live & elem.validity & eq
+        found = hit.any(axis=1)
+        has_null_elem = (slot_live & ~elem.validity).any(axis=1)
+        validity = arr.validity & val.validity & (found | ~has_null_elem)
+        return Vec(T.BOOLEAN, found, validity)
+
+
+class CreateArray(Expression):
+    """array(e1, e2, ...) of same-typed elements."""
+
+    def __init__(self, children: Sequence[Expression]):
+        super().__init__(list(children))
+
+    @property
+    def data_type(self):
+        et = self.children[0].data_type if self.children else T.NULL
+        return T.ArrayType(et)
+
+    @property
+    def nullable(self):
+        return False
+
+    def _compute(self, ctx: EvalContext, *elems: Vec) -> Vec:
+        xp = ctx.xp
+        nelem = len(elems)
+        n = elems[0].data.shape[0]
+        k = width_bucket(nelem)
+        first = elems[0]
+        if first.is_nested:
+            raise NotImplementedError(
+                "array() of nested elements is not supported")
+
+        if first.is_string:
+            w = max(e.data.shape[1] for e in elems)
+            data = xp.zeros((n, k, w), dtype=xp.uint8)
+            lens = xp.zeros((n, k), dtype=xp.int32)
+            validity = xp.zeros((n, k), dtype=bool)
+            for j, e in enumerate(elems):
+                data = data.at[:, j, :e.data.shape[1]].set(e.data) \
+                    if hasattr(data, "at") else _np_set3(data, j, e.data)
+                lens = _set_col(xp, lens, j, e.lengths)
+                validity = _set_col(xp, validity, j, e.validity)
+            child = Vec(first.dtype, data, validity, lens)
+        else:
+            data = xp.zeros((n, k), dtype=first.data.dtype)
+            validity = xp.zeros((n, k), dtype=bool)
+            for j, e in enumerate(elems):
+                data = _set_col(xp, data, j, e.data)
+                validity = _set_col(xp, validity, j, e.validity)
+            child = Vec(first.dtype, data, validity)
+        sizes = xp.full(n, nelem, dtype=xp.int32)
+        return Vec(self.data_type, sizes, xp.ones(n, dtype=bool), None,
+                   (child,))
+
+
+def _set_col(xp, mat, j, col):
+    if hasattr(mat, "at"):  # jax
+        return mat.at[:, j].set(col)
+    mat[:, j] = col  # numpy (CPU engine)
+    return mat
+
+
+def _np_set3(mat, j, rows):
+    mat[:, j, :rows.shape[1]] = rows
+    return mat
+
+
+class Explode(Expression):
+    """Generator marker: explode(array) -> one row per element (reference
+    `GpuGenerateExec.scala:1`). Evaluated by the Generate execs, not row-wise;
+    `position` adds the pos column (posexplode), `outer` keeps empty/null
+    arrays as a single null row (explode_outer)."""
+
+    def __init__(self, child: Expression, position: bool = False,
+                 outer: bool = False):
+        super().__init__([child])
+        self.position = position
+        self.outer = outer
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type.element_type
+
+    def generator_output(self):
+        """[(name, dtype)] appended to the child schema by Generate."""
+        out = []
+        if self.position:
+            out.append(("pos", T.INT))
+        out.append(("col", self.data_type))
+        return out
+
+    def __repr__(self):
+        kind = "posexplode" if self.position else "explode"
+        return f"{kind}{'_outer' if self.outer else ''}({self.children[0]!r})"
+
+
+class GetStructField(Expression):
+    """struct.field by ordinal or name (name resolves against the child's
+    struct type once references are bound)."""
+
+    def __init__(self, child: Expression, ordinal: Optional[int] = None,
+                 name: Optional[str] = None):
+        super().__init__([child])
+        assert ordinal is not None or name is not None
+        self.ordinal = ordinal
+        self.field_name = name
+
+    def _ord(self) -> int:
+        if self.ordinal is not None:
+            return self.ordinal
+        return self.children[0].data_type.field_names().index(self.field_name)
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type.fields[self._ord()].data_type
+
+    @property
+    def nullable(self):
+        return True
+
+    def _compute(self, ctx: EvalContext, sv: Vec) -> Vec:
+        f = sv.children[self._ord()]
+        return Vec(f.dtype, f.data, f.validity & sv.validity, f.lengths,
+                   f.children)
+
+    def __repr__(self):
+        return f"{self.children[0]!r}.{self.field_name or self.ordinal}"
+
+
+class CreateNamedStruct(Expression):
+    """named_struct(name1, e1, name2, e2, ...)."""
+
+    def __init__(self, names: Sequence[str], values: Sequence[Expression]):
+        super().__init__(list(values))
+        self.names = list(names)
+
+    @property
+    def data_type(self):
+        return T.StructType(tuple(
+            T.StructField(nm, v.data_type, v.nullable)
+            for nm, v in zip(self.names, self.children)))
+
+    @property
+    def nullable(self):
+        return False
+
+    def _compute(self, ctx: EvalContext, *fields: Vec) -> Vec:
+        xp = ctx.xp
+        n = fields[0].data.shape[0]
+        ones = xp.ones(n, dtype=bool)
+        return Vec(self.data_type, ones, ones, None, tuple(fields))

@@ -39,10 +39,12 @@ class DataFrame:
         i = self.plan.output.index_of(name)
         return AttributeReference(name, self.plan.output.types[i])
 
-    def select(self, *exprs: Union[str, Expression]) -> "DataFrame":
-        return DataFrame(self.session,
-                         N.CpuProjectExec([_as_expr(e) for e in exprs],
-                                          self.plan))
+    def select(self, *exprs: Union[str, Expression],
+               **named: Expression) -> "DataFrame":
+        from .expr.base import Alias
+        projs = [_as_expr(e) for e in exprs]
+        projs.extend(Alias(_as_expr(e), nm) for nm, e in named.items())
+        return DataFrame(self.session, N.CpuProjectExec(projs, self.plan))
 
     def filter(self, condition: Expression) -> "DataFrame":
         return DataFrame(self.session, N.CpuFilterExec(condition, self.plan))
@@ -71,6 +73,15 @@ class DataFrame:
 
     def cross_join(self, other: "DataFrame") -> "DataFrame":
         return self.join(other, how="cross")
+
+    def explode(self, column, outer: bool = False,
+                position: bool = False) -> "DataFrame":
+        """Append explode(column) rows: one output row per array element
+        (child columns retained, exploded element as `col`, plus `pos` when
+        position=True; outer=True keeps null/empty arrays as one null row)."""
+        from .expr.collections import Explode
+        gen = Explode(_as_expr(column), position=position, outer=outer)
+        return DataFrame(self.session, N.CpuGenerateExec(gen, self.plan))
 
     def sort(self, *orders, ascending: bool = True,
              nulls_first: Optional[bool] = None) -> "DataFrame":

@@ -41,7 +41,7 @@ class SpillPriority:
 
 class _Entry:
     __slots__ = ("handle", "tier", "device_batch", "host_arrays", "disk_path",
-                 "schema", "num_rows", "nbytes", "priority", "lock")
+                 "schema", "num_rows", "nbytes", "priority", "lock", "treedef")
 
     def __init__(self, handle: int, batch: ColumnarBatch, nbytes: int,
                  priority: int):
@@ -50,6 +50,7 @@ class _Entry:
         self.device_batch: Optional[ColumnarBatch] = batch
         self.host_arrays: Optional[List] = None
         self.disk_path: Optional[str] = None
+        self.treedef = None
         self.schema = batch.schema
         self.num_rows = batch.row_count()
         self.nbytes = nbytes
@@ -132,17 +133,16 @@ class BufferCatalog:
         return freed
 
     def _spill_entry(self, e: _Entry) -> int:
+        import jax
         with e.lock:
             if e.tier != StorageTier.DEVICE:
                 return 0
             t0 = time.monotonic_ns()
             batch = e.device_batch
-            arrays: List[Tuple] = []
-            for c in batch.columns:
-                arrays.append((np.asarray(c.data), np.asarray(c.validity),
-                               None if c.lengths is None
-                               else np.asarray(c.lengths)))
-            e.host_arrays = arrays
+            # the batch is a pytree: flattening covers every buffer including
+            # nested children and the traced row count
+            leaves, e.treedef = jax.tree_util.tree_flatten(batch)
+            e.host_arrays = [np.asarray(x) for x in leaves]
             e.device_batch = None  # drop device refs -> XLA frees HBM
             e.tier = StorageTier.HOST
             self.host_used += e.nbytes
@@ -156,13 +156,7 @@ class BufferCatalog:
     def _host_to_disk(self, e: _Entry) -> None:
         t0 = time.monotonic_ns()
         path = os.path.join(self._spill_dir, f"buf{e.handle}.npz")
-        payload = {}
-        for i, (data, valid, lens) in enumerate(e.host_arrays):
-            payload[f"d{i}"] = data
-            payload[f"v{i}"] = valid
-            if lens is not None:
-                payload[f"l{i}"] = lens
-        np.savez(path, **payload)
+        np.savez(path, **{f"a{i}": a for i, a in enumerate(e.host_arrays)})
         e.disk_path = path
         e.host_arrays = None
         e.tier = StorageTier.DISK
@@ -171,22 +165,15 @@ class BufferCatalog:
 
     def _disk_to_host(self, e: _Entry) -> None:
         z = np.load(e.disk_path)
-        arrays = []
-        for i in range(len(e.schema.types)):
-            arrays.append((z[f"d{i}"], z[f"v{i}"],
-                           z[f"l{i}"] if f"l{i}" in z else None))
-        e.host_arrays = arrays
+        e.host_arrays = [z[f"a{i}"] for i in range(len(z.files))]
         e.tier = StorageTier.HOST
         os.unlink(e.disk_path)
         e.disk_path = None
 
     def _host_to_device(self, e: _Entry) -> ColumnarBatch:
+        import jax
         import jax.numpy as jnp
         from .budget import MemoryBudget
         MemoryBudget.get().reserve(e.nbytes)
-        cols = []
-        for dt, (data, valid, lens) in zip(e.schema.types, e.host_arrays):
-            cols.append(Column(dt, jnp.asarray(data), jnp.asarray(valid),
-                               None if lens is None else jnp.asarray(lens)))
-        return ColumnarBatch(e.schema, tuple(cols),
-                             jnp.asarray(e.num_rows, dtype=jnp.int32))
+        return jax.tree_util.tree_unflatten(
+            e.treedef, [jnp.asarray(a) for a in e.host_arrays])

@@ -32,10 +32,7 @@ def split_batch_halves(spillable):
     half = n // 2
     outs = []
     for lo, hi in ((0, half), (half, n)):
-        vecs = []
-        for v in batch_vecs(batch):
-            vecs.append(Vec(v.dtype, v.data[lo:hi], v.validity[lo:hi],
-                            None if v.lengths is None else v.lengths[lo:hi]))
+        vecs = [v.slice_rows(lo, hi) for v in batch_vecs(batch)]
         outs.append(SpillableColumnarBatch(
             vecs_to_batch(batch.schema, vecs, hi - lo)))
     spillable.close()

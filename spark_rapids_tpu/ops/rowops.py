@@ -29,18 +29,13 @@ BIG_I32 = np.int32(2 ** 31 - 1)
 
 
 def _take(xp, arr, idx):
-    if arr.ndim == 1:
-        return arr[idx]
-    return arr[idx, :]
+    return arr[idx]
 
 
 def gather_vecs(xp, vecs: Sequence[Vec], idx) -> List[Vec]:
-    """Gather rows by index across columns (JoinGatherer analog)."""
-    out = []
-    for v in vecs:
-        out.append(Vec(v.dtype, _take(xp, v.data, idx), v.validity[idx],
-                       None if v.lengths is None else v.lengths[idx]))
-    return out
+    """Gather rows by index across columns (JoinGatherer analog); recurses
+    through nested children."""
+    return [v.gather(xp, idx) for v in vecs]
 
 
 def compact_vecs(xp, vecs: Sequence[Vec], keep_mask) -> Tuple[List[Vec], any]:

@@ -58,33 +58,44 @@ def _ctx(n: int) -> EvalContext:
     return EvalContext(np, row_mask=np.ones(n, dtype=bool))
 
 
+def _concat_np_padded(arrs: List[np.ndarray]) -> np.ndarray:
+    """Concat along axis 0, padding trailing dims (string width / array fanout)
+    to the max across inputs."""
+    nd = arrs[0].ndim
+    if nd == 1:
+        return np.concatenate(arrs)
+    tgt = tuple(max(a.shape[d] for a in arrs) for d in range(1, nd))
+    return np.concatenate(
+        [np.pad(a, [(0, 0)] + [(0, t - a.shape[d + 1])
+                               for d, t in enumerate(tgt)]) for a in arrs])
+
+
+def _concat_vecs(cols: List[Vec]) -> Vec:
+    # every buffer gets the padded concat: child validity/lengths share the
+    # fanout dims of data, and fanout buckets can differ per batch
+    kids = None if cols[0].children is None else tuple(
+        _concat_vecs([c.children[i] for c in cols])
+        for i in range(len(cols[0].children)))
+    return Vec(cols[0].dtype, _concat_np_padded([c.data for c in cols]),
+               _concat_np_padded([c.validity for c in cols]),
+               None if cols[0].lengths is None
+               else _concat_np_padded([c.lengths for c in cols]), kids)
+
+
 def _concat_host(batches: List[HostBatch], schema: Schema) -> HostBatch:
     """Concatenate host batches (CPU engine collects whole partitions)."""
     if len(batches) == 1:
         return batches[0]
     if not batches:
         return HostBatch(schema, [_empty_vec(t) for t in schema.types], 0)
-    vecs = []
-    for i, dt in enumerate(schema.types):
-        cols = [b.vecs[i] for b in batches]
-        if isinstance(dt, T.StringType):
-            w = max(c.data.shape[1] for c in cols)
-            data = np.concatenate(
-                [np.pad(c.data, ((0, 0), (0, w - c.data.shape[1])))
-                 for c in cols])
-            vecs.append(Vec(dt, data, np.concatenate([c.validity for c in cols]),
-                            np.concatenate([c.lengths for c in cols])))
-        else:
-            vecs.append(Vec(dt, np.concatenate([c.data for c in cols]),
-                            np.concatenate([c.validity for c in cols])))
+    vecs = [_concat_vecs([b.vecs[i] for b in batches])
+            for i in range(len(schema.types))]
     return HostBatch(schema, vecs, sum(b.num_rows for b in batches))
 
 
-def _empty_vec(dt: T.DataType) -> Vec:
-    if isinstance(dt, T.StringType):
-        return Vec(dt, np.zeros((0, 8), np.uint8), np.zeros(0, bool),
-                   np.zeros(0, np.int32))
-    return Vec(dt, np.zeros(0, dt.np_dtype or np.int32), np.zeros(0, bool))
+def _empty_vec(dt: T.DataType, shape: tuple = (0,)) -> Vec:
+    from ..expr.base import zero_vec
+    return zero_vec(np, dt, shape)
 
 
 class CpuScanExec(PhysicalPlan):
@@ -144,11 +155,9 @@ class CpuFilterExec(PhysicalPlan):
         for b in self.children[0].execute_cpu():
             ctx = _ctx(b.num_rows)
             pred = self._bound.eval(ctx, b.vecs)
-            keep = pred.data & pred.validity
-            vecs = [Vec(v.dtype, v.data[keep], v.validity[keep],
-                        None if v.lengths is None else v.lengths[keep])
-                    for v in b.vecs]
-            yield HostBatch(self.output, vecs, int(keep.sum()))
+            keep = np.nonzero(pred.data & pred.validity)[0]
+            vecs = [v.gather(np, keep) for v in b.vecs]
+            yield HostBatch(self.output, vecs, len(keep))
 
     def _arg_string(self):
         return f"[{self.condition!r}]"
@@ -196,12 +205,7 @@ class CpuHashAggregateExec(PhysicalPlan):
         keys = [e.eval(ctx, b.vecs) for e in self._bound_groups]
         gid, groups_index = _cpu_group_ids(keys, n)
         ng = len(groups_index)
-        out_vecs: List[Vec] = []
-        for k in keys:
-            out_vecs.append(Vec(k.dtype, _take_np(k.data, groups_index),
-                                k.validity[groups_index],
-                                None if k.lengths is None
-                                else k.lengths[groups_index]))
+        out_vecs: List[Vec] = [k.gather(np, groups_index) for k in keys]
         for a in self._bound_aggs:
             out_vecs.append(_cpu_agg(a.func, ctx, b, gid, ng))
         yield HostBatch(self._schema, out_vecs, ng)
@@ -216,24 +220,44 @@ def _take_np(arr, idx):
 
 
 def _key_bytes(keys: List[Vec], n: int) -> np.ndarray:
-    """Pack key columns into fixed-width row bytes for np.unique grouping."""
+    """Pack key columns into fixed-width row bytes for np.unique grouping.
+    Recurses through nested children, zeroing garbage beyond live slots so
+    equal values pack to equal bytes regardless of padding contents."""
     if n == 0:
         return np.zeros((0, 1), np.uint8)
-    parts = []
-    for k in keys:
-        parts.append(k.validity.astype(np.uint8).reshape(n, 1))
-        if k.is_string:
-            parts.append(np.where(k.validity[:, None], k.data, 0))
-            parts.append(k.lengths.astype(np.int32).view(np.uint8).reshape(n, -1))
+    parts: List[np.ndarray] = []
+
+    def emit(arr):
+        parts.append(np.ascontiguousarray(arr).view(np.uint8).reshape(n, -1))
+
+    def rec(v: Vec, live: np.ndarray):
+        val = v.validity & live
+        emit(val.astype(np.uint8))
+        if isinstance(v.dtype, T.ArrayType):
+            sizes = np.where(val, v.data, 0).astype(np.int32)
+            emit(sizes)
+            k = v.children[0].data.shape[v.data.ndim]
+            slot_live = val[..., None] & (np.arange(k) < sizes[..., None])
+            rec(v.children[0], slot_live)
+        elif isinstance(v.dtype, T.StructType):
+            for c in v.children:
+                rec(c, val)
+        elif v.is_string:
+            lens = np.where(val, v.lengths, 0).astype(np.int32)
+            emit(lens)
+            w = v.data.shape[-1]
+            col_live = val[..., None] & (np.arange(w) < lens[..., None])
+            emit(np.where(col_live, v.data, 0))
         else:
-            data = k.data
+            data = v.data
             if np.issubdtype(data.dtype, np.floating):
                 # canonicalize NaN and -0.0 so grouping matches Spark equality
                 data = np.where(np.isnan(data), np.float64(np.nan), data)
-                data = np.where(data == 0.0, 0.0, data).astype(k.data.dtype)
-            clean = np.where(k.validity, data, data.dtype.type(0))
-            parts.append(np.ascontiguousarray(clean).view(np.uint8)
-                         .reshape(n, -1))
+                data = np.where(data == 0.0, 0.0, data).astype(v.data.dtype)
+            emit(np.where(val, data, data.dtype.type(0)))
+
+    for key in keys:
+        rec(key, np.ones(n, dtype=bool))
     return np.concatenate(parts, axis=1) if parts else np.zeros((n, 1), np.uint8)
 
 
@@ -329,6 +353,56 @@ def _cpu_agg(func: AggregateFunction, ctx, b: HostBatch, gid, ng) -> Vec:
                    v.validity[safe] & got,
                    None if v.lengths is None else v.lengths[safe])
     raise NotImplementedError(name)
+
+
+class CpuGenerateExec(PhysicalPlan):
+    """CPU oracle for Generate (explode/posexplode, optionally _outer):
+    child rows replicated per array element, generator columns appended
+    (reference GenerateExec / GpuGenerateExec.scala)."""
+
+    def __init__(self, generator, child: PhysicalPlan):
+        from ..expr.collections import Explode
+        super().__init__([child])
+        assert isinstance(generator, Explode)
+        self.generator = generator
+        self._bound = bind_references(generator, child.output)
+        co = child.output
+        gen_out = self._bound.generator_output()
+        self._schema = Schema(co.names + tuple(n for n, _ in gen_out),
+                              co.types + tuple(t for _, t in gen_out))
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def execute_cpu(self):
+        from ..cpu.hostbatch import vec_map_arrays
+        outer = self._bound.outer
+        for b in self.children[0].execute_cpu():
+            n = b.num_rows
+            arr = self._bound.children[0].eval(_ctx(n), b.vecs)
+            elem = arr.children[0]
+            k = elem.data.shape[1]
+            sizes = np.where(arr.validity, arr.data, 0).astype(np.int64)
+            slots = np.maximum(sizes, 1) if outer else sizes
+            total = int(slots.sum())
+            row_id = np.repeat(np.arange(n), slots)
+            base = np.concatenate(([0], np.cumsum(slots)[:-1]))
+            pos = np.arange(total) - np.repeat(base, slots)
+            out_vecs = [v.gather(np, row_id) for v in b.vecs]
+            live = pos < sizes[row_id]  # outer's filler row stays null
+            if self._bound.position:
+                # pos is NULL on the outer filler row too (Spark joins the
+                # generator null row, nulling every generator column)
+                out_vecs.append(Vec(T.INT, pos.astype(np.int32), live.copy()))
+            safe = np.minimum(pos, max(k - 1, 0))
+            col = vec_map_arrays(elem, lambda a: a[row_id, safe])
+            col = Vec(col.dtype, col.data, col.validity & live, col.lengths,
+                      col.children)
+            yield HostBatch(self._schema, out_vecs + [col], total)
+
+    def _arg_string(self):
+        return f"[{self.generator!r}]"
 
 
 class CpuHashJoinExec(PhysicalPlan):
@@ -451,15 +525,12 @@ def _gather_side(b: HostBatch, idx: np.ndarray) -> List[Vec]:
     for v in b.vecs:
         if v.data.shape[0] == 0:
             # empty side of an outer join: every requested row is the null pad
-            n = len(idx)
-            data = np.zeros((n,) + v.data.shape[1:], dtype=v.data.dtype)
-            out.append(Vec(v.dtype, data, np.zeros(n, dtype=bool),
-                           None if v.lengths is None
-                           else np.zeros(n, dtype=np.int32)))
+            ev = _empty_vec(v.dtype, (len(idx),))
+            out.append(ev)
             continue
-        out.append(Vec(v.dtype, _take_np(v.data, safe),
-                       v.validity[safe] & ~missing,
-                       None if v.lengths is None else v.lengths[safe]))
+        g = v.gather(np, safe)
+        out.append(Vec(g.dtype, g.data, g.validity & ~missing, g.lengths,
+                       g.children))
     return out
 
 
@@ -485,9 +556,7 @@ class CpuSortExec(PhysicalPlan):
         for e, asc, nf in self._bound:
             groups.append(sort_keys_for(np, e.eval(ctx, b.vecs), asc, nf))
         order = lexsort_indices(np, groups, b.num_rows)
-        vecs = [Vec(v.dtype, _take_np(v.data, order), v.validity[order],
-                    None if v.lengths is None else v.lengths[order])
-                for v in b.vecs]
+        vecs = [v.gather(np, order) for v in b.vecs]
         yield HostBatch(self.output, vecs, b.num_rows)
 
     def _arg_string(self):
@@ -513,10 +582,7 @@ class CpuLimitExec(PhysicalPlan):
             start = min(skip, b.num_rows)
             skip -= start
             take = min(remaining, b.num_rows - start)
-            sl = slice(start, start + take)
-            vecs = [Vec(v.dtype, v.data[sl], v.validity[sl],
-                        None if v.lengths is None else v.lengths[sl])
-                    for v in b.vecs]
+            vecs = [v.slice_rows(start, start + take) for v in b.vecs]
             remaining -= take
             yield HostBatch(self.output, vecs, take)
 

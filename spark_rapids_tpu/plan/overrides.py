@@ -81,7 +81,7 @@ _int = TypeSig((T.IntegerType,))
 _dbl = TypeSig((T.DoubleType,))
 
 for cls in (EB.Literal, EB.AttributeReference, EB.BoundReference, EB.Alias):
-    expr_rule(cls, _basic)
+    expr_rule(cls, TypeSig.all_with_nested())
 for cls in (EA.Add, EA.Subtract, EA.Multiply):
     expr_rule(cls, _num, tag_fn=_tag_ansi_arith)
 for cls in (EA.Divide, EA.IntegralDivide, EA.Remainder, EA.Pmod):
@@ -128,6 +128,38 @@ expr_rule(ED.DateSub, TypeSig((T.DateType,)))
 expr_rule(ED.UnixTimestampFromTs, TypeSig((T.LongType,)))
 expr_rule(EH.Murmur3Hash, _int)
 expr_rule(EC.Cast, _basic, tag_fn=_tag_cast)
+
+# collection / nested-type expressions (complexTypeExtractors.scala,
+# complexTypeCreator.scala, collectionOperations.scala)
+from ..expr import collections as ECL  # noqa: E402
+
+_nested = TypeSig.all_with_nested()
+
+
+def _tag_array_contains(meta: ExprMeta) -> None:
+    et = meta.expr.children[0].data_type.element_type
+    if isinstance(et, (T.StringType, T.ArrayType, T.StructType, T.MapType)):
+        meta.will_not_work(
+            f"array_contains over {et.simple_string()} elements is not "
+            "supported on TPU")
+
+
+def _tag_create_array(meta: ExprMeta) -> None:
+    for c in meta.expr.children:
+        try:
+            if c.data_type.is_nested:
+                meta.will_not_work("array() of nested elements is not "
+                                   "supported on TPU")
+        except Exception:
+            pass
+
+
+expr_rule(ECL.Size, _int)
+for cls in (ECL.GetArrayItem, ECL.ElementAt, ECL.GetStructField,
+            ECL.CreateNamedStruct, ECL.Explode):
+    expr_rule(cls, _nested)
+expr_rule(ECL.CreateArray, _nested, tag_fn=_tag_create_array)
+expr_rule(ECL.ArrayContains, _bool, tag_fn=_tag_array_contains)
 for cls in (Sum, Count, Min, Max, Average, First, Last):
     expr_rule(cls, _basic)
 
@@ -301,6 +333,12 @@ def _tag_join(m: PlanMeta):
     if m.plan.join_type not in ("inner", "left", "right", "full", "semi",
                                 "anti", "existence"):
         m.will_not_work(f"join type {m.plan.join_type} not supported on TPU")
+    for e in m.plan._bl + m.plan._br:
+        try:
+            if e.data_type.is_nested:
+                m.will_not_work("nested types cannot be join keys on TPU")
+        except Exception:
+            pass
 
 
 def _c_scan(plan, children, conf):
@@ -332,6 +370,15 @@ def _c_join(plan, children, conf):
     return TpuShuffledHashJoinExec(children[0], children[1], plan.left_keys,
                                    plan.right_keys, plan.join_type, conf,
                                    condition=plan.condition)
+
+
+def _c_generate(plan, children, conf):
+    from ..exec.generate import TpuGenerateExec
+    return TpuGenerateExec(plan.generator, children[0], conf)
+
+
+def _exprs_generate(m: PlanMeta):
+    m.add_expr(m.plan._bound)
 
 
 def _c_sort(plan, children, conf):
@@ -429,18 +476,20 @@ def _register_file_scan_rules():
         exec_rule(cls, TypeSig.all_basic(), _c_file_scan)
 
 
-exec_rule(N.CpuScanExec, TypeSig.all_basic(), _c_scan)
-exec_rule(N.CpuProjectExec, TypeSig.all_basic(), _c_project,
+exec_rule(N.CpuScanExec, TypeSig.all_with_nested(), _c_scan)
+exec_rule(N.CpuProjectExec, TypeSig.all_with_nested(), _c_project,
           expr_fn=_exprs_project)
-exec_rule(N.CpuFilterExec, TypeSig.all_basic(), _c_filter,
+exec_rule(N.CpuFilterExec, TypeSig.all_with_nested(), _c_filter,
           expr_fn=_exprs_filter)
 exec_rule(N.CpuHashAggregateExec, TypeSig.all_basic(), _c_agg,
           expr_fn=_exprs_agg)
-exec_rule(N.CpuHashJoinExec, TypeSig.all_basic(), _c_join, tag_fn=_tag_join,
-          expr_fn=_exprs_join)
+exec_rule(N.CpuHashJoinExec, TypeSig.all_with_nested(), _c_join,
+          tag_fn=_tag_join, expr_fn=_exprs_join)
 exec_rule(N.CpuSortExec, TypeSig.orderable(), _c_sort, expr_fn=_exprs_sort)
-exec_rule(N.CpuLimitExec, TypeSig.all_basic(), _c_limit)
-exec_rule(N.CpuUnionExec, TypeSig.all_basic(), _c_union)
+exec_rule(N.CpuLimitExec, TypeSig.all_with_nested(), _c_limit)
+exec_rule(N.CpuUnionExec, TypeSig.all_with_nested(), _c_union)
+exec_rule(N.CpuGenerateExec, TypeSig.all_with_nested(), _c_generate,
+          expr_fn=_exprs_generate)
 exec_rule(N.CpuRangeExec, TypeSig.all_basic(), _c_range)
 exec_rule(N.CpuExpandExec, TypeSig.all_basic(), _c_expand,
           expr_fn=_exprs_expand)

@@ -28,6 +28,12 @@ class TypeSig:
         return TypeSig(_ALL_BASIC, decimal_max=18)
 
     @staticmethod
+    def all_with_nested() -> "TypeSig":
+        """Basic types plus array/struct (recursively checked)."""
+        return TypeSig(_ALL_BASIC + (T.ArrayType, T.StructType),
+                       decimal_max=18)
+
+    @staticmethod
     def numeric() -> "TypeSig":
         return TypeSig((T.ByteType, T.ShortType, T.IntegerType, T.LongType,
                         T.FloatType, T.DoubleType), decimal_max=18)
@@ -52,7 +58,9 @@ class TypeSig:
         return TypeSig(self.classes - set(classes), self.decimal_max, self.notes)
 
     def support_reason(self, dt: T.DataType) -> Optional[str]:
-        """None if supported; else the reason string."""
+        """None if supported; else the reason string. Nested types are allowed
+        only when their class is in the sig AND every element/field type is
+        itself supported (recursive, like the reference's TypeSig nesting)."""
         if isinstance(dt, T.DecimalType):
             if self.decimal_max <= 0:
                 return f"{dt.simple_string()} is not supported"
@@ -60,8 +68,20 @@ class TypeSig:
                 return (f"{dt.simple_string()} exceeds max supported precision "
                         f"{self.decimal_max}")
             return None
-        if dt.is_nested:
-            return f"nested type {dt.simple_string()} is not supported yet"
+        if isinstance(dt, T.ArrayType):
+            if T.ArrayType not in self.classes:
+                return f"nested type {dt.simple_string()} is not supported yet"
+            return self.support_reason(dt.element_type)
+        if isinstance(dt, T.StructType):
+            if T.StructType not in self.classes:
+                return f"nested type {dt.simple_string()} is not supported yet"
+            for f in dt.fields:
+                r = self.support_reason(f.data_type)
+                if r:
+                    return r
+            return None
+        if isinstance(dt, T.MapType):
+            return f"map type {dt.simple_string()} is not supported yet"
         if type(dt) in self.classes:
             return None
         return f"{dt.simple_string()} is not supported"

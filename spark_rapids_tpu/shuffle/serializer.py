@@ -38,6 +38,10 @@ def serialize_batch(batch: ColumnarBatch, codec_name: str = "none") -> bytes:
     cols: List[ColumnMeta] = []
     parts: List[bytes] = []
     for name, col in zip(batch.schema.names, batch.columns):
+        if col.children is not None:
+            raise NotImplementedError(
+                "nested columns are not yet supported by the host shuffle "
+                "serializer (the planner keeps nested data off exchanges)")
         data = np.ascontiguousarray(np.asarray(col.data)[:n])
         valid = np.ascontiguousarray(np.asarray(col.validity)[:n])
         lens = None if col.lengths is None else \

@@ -123,6 +123,35 @@ class DecimalGen(DataGen):
         return [decimal.Decimal(int(u)).scaleb(-self.scale) for u in unscaled]
 
 
+class ArrayGen(DataGen):
+    """Random lists of a child generator's values (nested fuzzing)."""
+
+    def __init__(self, child: DataGen, max_len: int = 6, **kw):
+        super().__init__(pa.list_(child.arrow_type), **kw)
+        self.child = child
+        self.max_len = max_len
+
+    def _values(self, rng, n):
+        out = []
+        for _ in range(n):
+            m = int(rng.integers(0, self.max_len + 1))
+            out.append(self.child.generate(rng, m).to_pylist())
+        return out
+
+
+class StructGen(DataGen):
+    """Random structs from named child generators."""
+
+    def __init__(self, fields: List[Tuple[str, DataGen]], **kw):
+        super().__init__(pa.struct([(nm, g.arrow_type) for nm, g in fields]),
+                         **kw)
+        self.fields = fields
+
+    def _values(self, rng, n):
+        cols = {nm: g.generate(rng, n).to_pylist() for nm, g in self.fields}
+        return [{nm: cols[nm][i] for nm, _ in self.fields} for i in range(n)]
+
+
 def gen_table(rng: np.random.Generator, gens: List[Tuple[str, DataGen]],
               n: int = 1024) -> pa.Table:
     return pa.table({name: g.generate(rng, n) for name, g in gens})
