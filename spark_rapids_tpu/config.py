@@ -128,6 +128,8 @@ register("spark.rapids.sql.hasNans", "bool", True,
          "Assume float data may contain NaNs (affects agg/join support).")
 register("spark.rapids.sql.ansi.enabled", "bool", False,
          "ANSI mode: overflow/invalid-cast raise instead of null/wrap.")
+register("spark.sql.ansi.enabled", "bool", False,
+         "Host Spark's ANSI switch (honored like the rapids-namespace key).")
 register("spark.rapids.sql.tieredProject.enabled", "bool", True,
          "Evaluate projection as tiers of common subexpressions.")
 register("spark.rapids.sql.stableSort.enabled", "bool", True,
@@ -283,7 +285,8 @@ class TpuConf:
 
     @property
     def is_ansi(self) -> bool:
-        return self.get("spark.rapids.sql.ansi.enabled")
+        return self.get("spark.rapids.sql.ansi.enabled") or \
+            self.get("spark.sql.ansi.enabled")
 
     @property
     def batch_size_bytes(self) -> int:

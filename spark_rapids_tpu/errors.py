@@ -34,3 +34,12 @@ class StringWidthExceeded(CpuFallbackRequired):
             f"{limit} (spark.rapids.tpu.string.maxWidth)")
         self.width = width
         self.limit = limit
+
+
+class AnsiViolation(RapidsTpuError):
+    """Spark ANSI-mode runtime error (ArithmeticException analog): integral
+    overflow, division by zero, or cast overflow under spark.sql.ansi.enabled."""
+
+    def __init__(self, message: str):
+        super().__init__(message)
+        self.message = message

@@ -19,14 +19,23 @@ import numpy as np
 from .. import types as T
 from ..columnar.batch import ColumnarBatch, Schema
 from ..expr.base import Expression, Vec, bind_references, output_name
-from ..expr.aggregates import (AggregateFunction, Average, Count, First, Last,
-                               Max, Min, Sum)
+from ..expr.aggregates import (AggregateFunction, ApproximatePercentile,
+                               Average, CollectList, CollectSet, Count, First,
+                               Last, Max, Min, Sum, _VarianceFamily)
 from ..ops.rowops import (compact_vecs, gather_vecs, group_ids_from_sorted,
                           lexsort_indices, segment_reduce, sort_keys_for)
 from ..plan.nodes import AggExpr
 from ..utils import metrics as M
 from .base import TpuExec, UnaryTpuExec, batch_vecs, device_ctx, vecs_to_batch
 from .coalesce import concat_batches
+
+
+def _vals_equal(xp, v: Vec, shift: int):
+    """row i equals row i-shift in a sorted value vec (bool[cap-shift])."""
+    if v.is_string:
+        return (v.data[shift:] == v.data[:-shift]).all(axis=1) & \
+            (v.lengths[shift:] == v.lengths[:-shift])
+    return v.data[shift:] == v.data[:-shift]
 
 
 def _sorted_by_keys(xp, key_vecs: List[Vec], all_vecs: List[Vec], row_mask):
@@ -73,6 +82,8 @@ class TpuHashAggregateExec(UnaryTpuExec):
                 f = f.with_children([bind_references(f.child, bind_schema)])
             self._bound_aggs.append(AggExpr(f, a.name))
         self.agg_time = self.metrics.create(M.AGG_TIME, M.MODERATE)
+        self._sp_maxes_jit = None
+        self._sp_kernel_jit: dict = {}
 
         knames = [output_name(e, f"k{i}") for i, e in enumerate(self.group_exprs)]
         ktypes = [e.data_type for e in self._bound_groups]
@@ -233,6 +244,34 @@ class TpuHashAggregateExec(UnaryTpuExec):
                 return [self._minmax_string(xp, op, v, gid, cap, row_mask)]
             data, has = seg(op, v)
             return [Vec(v.dtype, data.astype(v.dtype.np_dtype), has)]
+        if isinstance(func, _VarianceFamily):
+            if merging:
+                s, _ = seg("sum", sbufs[bi], np.float64)
+                s2, _ = seg("sum", sbufs[bi + 1], np.float64)
+                c, _ = seg("sum", sbufs[bi + 2], np.int64)
+                c = c.astype(np.int64)
+            else:
+                v = sbufs[bi]
+                x = v.data.astype(np.float64)
+                s, _ = seg("sum", Vec(T.DOUBLE, x, v.validity), np.float64)
+                s2, _ = seg("sum", Vec(T.DOUBLE, x * x, v.validity),
+                            np.float64)
+                c = segment_reduce(xp, "count", x, gid, cap,
+                                   v.validity & row_mask).astype(np.int64)
+            if output_partial:
+                return [Vec(T.DOUBLE, s, c > 0), Vec(T.DOUBLE, s2, c > 0),
+                        Vec(T.LONG, c, xp.ones(cap, dtype=bool))]
+            cf = c.astype(np.float64)
+            mean = s / xp.maximum(cf, 1.0)
+            m2 = xp.maximum(s2 - cf * mean * mean, 0.0)
+            if func.sample:
+                var = m2 / xp.maximum(cf - 1.0, 1.0)
+                has = c > 1
+            else:
+                var = m2 / xp.maximum(cf, 1.0)
+                has = c > 0
+            out = xp.sqrt(var) if func.sqrt else var
+            return [Vec(T.DOUBLE, out, has)]
         if isinstance(func, (First, Last)):
             v = sbufs[bi]
             is_first = isinstance(func, First) and not isinstance(func, Last)
@@ -275,9 +314,180 @@ class TpuHashAggregateExec(UnaryTpuExec):
         return Vec(v.dtype, res.data, has, res.lengths)
 
     # ------------------------------------------------------------------
+    # single-pass aggregates (collect_list/collect_set/approx_percentile):
+    # output fanout is data-dependent, so the exec concatenates the input,
+    # measures per-group counts on device, picks a static fanout bucket with
+    # one host sync, and runs a dedicated kernel (the join-expansion shape)
+    def _has_single_pass(self) -> bool:
+        return any(a.func.single_pass for a in self._bound_aggs)
+
+    def _single_pass_execute(self, batches) -> Iterator[ColumnarBatch]:
+        from ..columnar.padding import width_bucket
+        with self.agg_time.timed():
+            b = concat_batches(batches) if len(batches) > 1 else batches[0]
+            # jit caches live on the instance so they die with the exec (a
+            # module-level cache keyed by self would pin every exec forever)
+            if self._sp_maxes_jit is None:
+                self._sp_maxes_jit = jax.jit(self._sp_group_maxes)
+            maxes = self._sp_maxes_jit(b)
+            ks = tuple(
+                width_bucket(max(int(m), 1)) if isinstance(
+                    a.func, (CollectList, CollectSet)) else
+                width_bucket(max(len(a.func.percentages), 1))
+                for a, m in zip(
+                    [a for a in self._bound_aggs if a.func.single_pass],
+                    maxes))
+            kern = self._sp_kernel_jit.get(ks)
+            if kern is None:
+                import functools
+                kern = jax.jit(functools.partial(self._sp_kernel, ks=ks))
+                self._sp_kernel_jit[ks] = kern
+            out = kern(b)
+        self.num_output_rows.add(out.row_count())
+        yield self._count_output(out)
+
+    def _sp_group_maxes(self, batch: ColumnarBatch):
+        """Phase 1: max per-group valid count for each single-pass aggregate
+        (host picks the fanout bucket from these)."""
+        xp = jnp
+        _, svals, gid, ng, starts, smask = self._sp_prepare(xp, batch)
+        cap = batch.capacity
+        out = []
+        for a, v in zip(self._bound_aggs, svals):
+            if not a.func.single_pass:
+                continue
+            data = v.data if v.data.ndim == 1 else v.lengths
+            counts = segment_reduce(xp, "count", data, gid, cap,
+                                    v.validity & smask)
+            out.append(xp.max(counts).astype(np.int32))
+        return tuple(out)
+
+    def _sp_kernel(self, batch: ColumnarBatch, ks: tuple):
+        """Phase 2: full output kernel with static fanout buckets per
+        single-pass aggregate; normal aggregates ride along."""
+        xp = jnp
+        skeys, svals, gid, ng, starts, smask = self._sp_prepare(xp, batch)
+        cap = batch.capacity
+        out_vecs: List[Vec] = []
+        if skeys:
+            reps, _ = compact_vecs(xp, skeys, starts)
+            out_vecs.extend(reps)
+        ki = 0
+        for a, v in zip(self._bound_aggs, svals):
+            if a.func.single_pass:
+                out_vecs.extend(self._sp_agg_one(xp, a.func, v, gid, cap,
+                                                 smask, ks[ki]))
+                ki += 1
+            else:
+                buf = [v] if v is not None else \
+                    [Vec(T.LONG, xp.ones(cap, dtype=np.int64), smask)]
+                out_vecs.extend(self._agg_one(xp, a.func, buf, 0, gid, cap,
+                                              smask, False, False))
+        return vecs_to_batch(self._schema, out_vecs, ng)
+
+    def _sp_prepare(self, xp, batch: ColumnarBatch):
+        """Evaluate keys + agg children and sort everything by the keys; the
+        shared front half of both single-pass kernels."""
+        ctx = device_ctx(batch, self.conf)
+        vecs = batch_vecs(batch)
+        mask = batch.row_mask()
+        cap = batch.capacity
+        keys = [e.eval(ctx, vecs) for e in self._bound_groups]
+        vals = [a.func.child.eval(ctx, vecs) if a.func.child is not None
+                else None for a in self._bound_aggs]
+        present = [v for v in vals if v is not None]
+        if keys:
+            all_vecs = keys + present
+            sorted_vecs, sorted_mask, _ = _sorted_by_keys(xp, keys, all_vecs,
+                                                          mask)
+            skeys = sorted_vecs[:len(keys)]
+            rest = iter(sorted_vecs[len(keys):])
+            svals = [None if v is None else next(rest) for v in vals]
+            gid, ng, starts = group_ids_from_sorted(xp, skeys, sorted_mask)
+        else:
+            skeys, svals, sorted_mask = [], vals, mask
+            gid = xp.zeros(cap, dtype=np.int32)
+            ng = xp.asarray(1, dtype=np.int32)
+            starts = xp.arange(cap) == 0
+        return skeys, svals, gid, ng, starts, sorted_mask
+
+    def _sp_agg_one(self, xp, func, v: Vec, gid, cap, row_mask, k: int):
+        """One single-pass aggregate over key-sorted rows: re-sort its rows by
+        (gid, validity, value) and build the per-group result."""
+        valid = v.validity & row_mask
+        groups = [[gid.astype(np.int32)], [(~valid).astype(np.int8)]]
+        groups.append(sort_keys_for(xp, v, True, False)[1:])
+        order = lexsort_indices(xp, groups, cap)
+        sv = gather_vecs(xp, [v], order)[0]
+        sgid = gid[order]
+        svalid = valid[order]
+
+        counts = segment_reduce(xp, "count", sv.data if sv.data.ndim == 1
+                                else sv.lengths, sgid, cap, svalid) \
+            .astype(np.int32)
+        if isinstance(func, CollectSet):
+            prev_same = xp.concatenate(
+                [xp.zeros(1, dtype=bool),
+                 (sgid[1:] == sgid[:-1]) & _vals_equal(xp, sv, 1)])
+            svalid = svalid & ~prev_same
+            counts = segment_reduce(
+                xp, "count", sv.data if sv.data.ndim == 1 else sv.lengths,
+                sgid, cap, svalid).astype(np.int32)
+        if isinstance(func, (CollectList, CollectSet)):
+            # rank of each kept row within its group (segmented cumsum)
+            cs = xp.cumsum(svalid.astype(np.int32))
+            base = segment_reduce(
+                xp, "min", xp.where(svalid, cs - 1,
+                                    np.int32(2**31 - 1)).astype(np.int64),
+                sgid, cap, xp.ones(cap, dtype=bool)).astype(np.int32)
+            rank = cs - 1 - base[sgid]
+            # invalid rows scatter out of bounds and are DROPPED (mode=drop) —
+            # scatter-set keeps negative values intact (a scatter-max over a
+            # zero init would clamp them)
+            rows = xp.where(svalid, sgid, cap).astype(np.int32)
+            cols = xp.clip(xp.where(svalid, rank, 0), 0, k - 1)
+
+            def scatter(leaf):
+                out = xp.zeros((cap, k) + leaf.shape[1:], dtype=leaf.dtype)
+                return out.at[rows, cols].set(leaf, mode="drop")
+
+            from ..expr.base import vec_map_arrays
+            elem = vec_map_arrays(
+                Vec(sv.dtype, sv.data, svalid, sv.lengths, sv.children),
+                scatter)
+            sizes = counts
+            return [Vec(func.data_type, sizes, xp.ones(cap, dtype=bool),
+                        None, (elem,))]
+        # approx_percentile: nearest-rank selection over the sorted values
+        first_pos = segment_reduce(
+            xp, "min", xp.where(svalid, xp.arange(cap, dtype=np.int64),
+                                np.int64(cap)), sgid, cap,
+            xp.ones(cap, dtype=bool))
+        vals = sv.data.astype(np.float64)
+        outs = []
+        for q in func.percentages:
+            idx = first_pos + xp.round(q * xp.maximum(counts - 1, 0)
+                                       ).astype(np.int64)
+            safe = xp.clip(idx, 0, cap - 1)
+            outs.append(vals[safe])
+        has = counts > 0
+        if func.scalar:
+            return [Vec(T.DOUBLE, outs[0], has)]
+        elem_data = xp.stack(outs, axis=1)
+        elem_data = xp.pad(elem_data,
+                           ((0, 0), (0, k - len(outs))))
+        elem = Vec(T.DOUBLE, elem_data,
+                   xp.broadcast_to(has[:, None], (cap, k)))
+        sizes = xp.where(has, len(outs), 0).astype(np.int32)
+        return [Vec(func.data_type, sizes, has, None, (elem,))]
+
+    # ------------------------------------------------------------------
     def do_execute(self) -> Iterator[ColumnarBatch]:
         batches = list(self.child.execute())
         if not batches:
+            return
+        if self._has_single_pass():
+            yield from self._single_pass_execute(batches)
             return
         if self.mode == "partial":
             # map-side aggregation: one partial batch per input batch (shard),

@@ -91,8 +91,26 @@ class UnaryTpuExec(TpuExec):
 
 
 def device_ctx(batch: ColumnarBatch, conf: TpuConf = None) -> EvalContext:
-    return EvalContext(jnp, row_mask=batch.row_mask(),
-                       ansi=(conf or get_default_conf()).is_ansi, conf=conf)
+    ansi = (conf or get_default_conf()).is_ansi
+    return EvalContext(jnp, row_mask=batch.row_mask(), ansi=ansi, conf=conf,
+                       errors=[] if ansi else None)
+
+
+def kernel_errors(ctx: EvalContext, msgs_box: list):
+    """Extract the traced ANSI error flags from a kernel's context for return;
+    messages land in msgs_box (stable across retraces: they depend only on the
+    expression tree)."""
+    entries = ctx.errors or ()
+    msgs_box[:] = [m for _, m in entries]
+    return tuple(f for f, _ in entries)
+
+
+def raise_kernel_errors(flags, msgs_box: list) -> None:
+    """Host-side: raise the first ANSI violation a kernel reported."""
+    for f, m in zip(flags, msgs_box):
+        if bool(f):
+            from ..errors import AnsiViolation
+            raise AnsiViolation(m)
 
 
 def batch_vecs(batch: ColumnarBatch) -> List[Vec]:

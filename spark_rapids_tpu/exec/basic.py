@@ -57,11 +57,16 @@ class TpuProjectExec(UnaryTpuExec):
         self._schema = Schema(names, tuple(e.data_type for e in self._bound))
         bound = self._bound
 
+        self._err_msgs: list = []
+        msgs_box = self._err_msgs
+
         def kernel(batch: ColumnarBatch):
+            from .base import kernel_errors
             ctx = device_ctx(batch, self.conf)
             vecs = batch_vecs(batch)
             outs = [e.eval(ctx, vecs) for e in bound]
-            return vecs_to_batch(self._schema, outs, batch.num_rows)
+            return vecs_to_batch(self._schema, outs, batch.num_rows), \
+                kernel_errors(ctx, msgs_box)
 
         # a projection containing a host black box (pandas UDF) cannot be
         # traced: run it eagerly — jnp ops still execute on device, and the
@@ -81,9 +86,11 @@ class TpuProjectExec(UnaryTpuExec):
         return self._schema
 
     def do_execute(self):
+        from .base import raise_kernel_errors
         for b in self.child.execute():
             with self.op_time.timed():
-                out = self._kernel(b)
+                out, errs = self._kernel(b)
+            raise_kernel_errors(errs, self._err_msgs)
             self.num_output_rows.add(b.row_count())
             yield self._count_output(out)
 
@@ -98,21 +105,28 @@ class TpuFilterExec(UnaryTpuExec):
         self._bound = bind_references(condition, child.output)
         bound = self._bound
 
+        self._err_msgs: list = []
+        msgs_box = self._err_msgs
+
         @jax.jit
         def kernel(batch: ColumnarBatch):
+            from .base import kernel_errors
             ctx = device_ctx(batch, self.conf)
             vecs = batch_vecs(batch)
             pred = bound.eval(ctx, vecs)
             keep = pred.data & pred.validity & batch.row_mask()
             out_vecs, new_n = compact_vecs(jnp, vecs, keep)
-            return vecs_to_batch(batch.schema, out_vecs, new_n)
+            return vecs_to_batch(batch.schema, out_vecs, new_n), \
+                kernel_errors(ctx, msgs_box)
 
         self._kernel = kernel
 
     def do_execute(self):
+        from .base import raise_kernel_errors
         for b in self.child.execute():
             with self.op_time.timed():
-                out = self._kernel(b)
+                out, errs = self._kernel(b)
+            raise_kernel_errors(errs, self._err_msgs)
             self.num_output_rows.add(out.row_count())
             yield self._count_output(out)
 

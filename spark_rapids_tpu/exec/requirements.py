@@ -54,7 +54,10 @@ def _rewrite(node: TpuExec, conf: TpuConf, ndev: int) -> TpuExec:
         return node
 
     if (isinstance(node, TpuHashAggregateExec) and node.mode == "complete"
-            and node.group_exprs):
+            and node.group_exprs
+            # single-pass aggs (collect/percentile) have no mergeable partial
+            # form; they stay a local complete aggregation
+            and not any(a.func.single_pass for a in node.aggs)):
         child = node.children[0]
         partial = TpuHashAggregateExec(node.group_exprs, node.aggs, child,
                                        conf, mode="partial")

@@ -21,7 +21,9 @@ from .datetime_ import (Year, Month, DayOfMonth, Quarter, DayOfWeek, WeekDay,  #
 from .hashing import Murmur3Hash, hash_vecs  # noqa: F401
 from .cast import Cast, device_supported as cast_device_supported  # noqa: F401
 from .aggregates import (AggregateFunction, Sum, Count, Min, Max, Average,  # noqa: F401
-                         First, Last, CountDistinct)
+                         First, Last, CountDistinct, VariancePop,
+                         VarianceSamp, StddevPop, StddevSamp, CollectList,
+                         CollectSet, ApproximatePercentile)
 from .windowexprs import (RowFrame, RangeFrame, WindowFunction, RowNumber,  # noqa: F401
                           Rank, DenseRank, PercentRank, CumeDist, NTile, Lead,
                           Lag, WindowAggregate)
@@ -29,7 +31,16 @@ from .regex import (RLike, Like, RegExpReplace, RegExpExtract,  # noqa: F401
                     device_supported_pattern)
 from .collections import (Size, GetArrayItem, ElementAt, ArrayContains,  # noqa: F401
                           CreateArray, CreateNamedStruct, GetStructField,
-                          Explode)
+                          Explode, ArrayMin, ArrayMax, SortArray)
+from .strings_ext import (StringRepeat, StringLPad, StringRPad,  # noqa: F401
+                          StringLocate, StringInstr, StringReplace,
+                          StringTranslate, StringReverse, ConcatWs,
+                          SubstringIndex, InitCap, Ascii, Chr, Left, Right,
+                          StringSpace, BitLength, OctetLength, FindInSet)
+from .math_ import (Atan2, Hypot, Logarithm, Expm1, Log1p, Rint, Cot,  # noqa: F401
+                    BRound)
+from .datetime_ import (LastDay, AddMonths, MonthsBetween, TruncDate,  # noqa: F401
+                        NextDay)
 
 
 def col(name):  # convenience constructors for tests / DataFrame API

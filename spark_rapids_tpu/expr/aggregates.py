@@ -17,11 +17,16 @@ from .. import types as T
 from .base import Expression
 
 __all__ = ["AggregateFunction", "Sum", "Count", "Min", "Max", "Average", "First",
-           "Last", "CountDistinct"]
+           "Last", "CountDistinct", "VariancePop", "VarianceSamp",
+           "StddevPop", "StddevSamp", "CollectList", "CollectSet",
+           "ApproximatePercentile"]
 
 
 class AggregateFunction(Expression):
     """Declarative aggregate: the exec consumes these descriptors."""
+
+    # data-dependent output fanout: the exec must run its single-pass path
+    single_pass = False
 
     # segmented-reduce op names used in the update phase, one per partial buffer
     update_ops: List[str] = []
@@ -176,3 +181,77 @@ class CountDistinct(AggregateFunction):
 
     def evaluate_final(self, xp, partials, counts):
         return partials[0]
+
+
+class _VarianceFamily(AggregateFunction):
+    """var_pop/var_samp/stddev_pop/stddev_samp via (sum, sum-of-squares,
+    count) partials (reference AggregateFunctions.scala CentralMomentAgg —
+    the reference carries (n, avg, m2); the moment form here merges by plain
+    sums, which the differential harness compares approximately)."""
+    update_ops = ["sum", "sumsq", "count"]
+    merge_ops = ["sum", "sum", "sum"]
+    sample = False
+    sqrt = False
+
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+    def partial_types(self):
+        return [T.DOUBLE, T.DOUBLE, T.LONG]
+
+
+class VariancePop(_VarianceFamily):
+    pass
+
+
+class VarianceSamp(_VarianceFamily):
+    sample = True
+
+
+class StddevPop(_VarianceFamily):
+    sqrt = True
+
+
+class StddevSamp(_VarianceFamily):
+    sample = True
+    sqrt = True
+
+
+class CollectList(AggregateFunction):
+    """collect_list: gathers non-null values per group into an array.
+    Single-pass only (the output fanout is data-dependent, so the exec runs
+    a dedicated two-phase kernel over the concatenated input)."""
+    single_pass = True
+
+    @property
+    def data_type(self):
+        return T.ArrayType(self.child.data_type)
+
+    def partial_types(self):
+        return [self.data_type]
+
+
+class CollectSet(CollectList):
+    """collect_set: distinct non-null values per group."""
+
+
+class ApproximatePercentile(AggregateFunction):
+    """approx_percentile(col, percentage[, accuracy]): nearest-rank element
+    selection over the group-sorted values (an exact percentile — a valid
+    refinement of the reference's t-digest approximation; both engines use
+    the same rank rule round(q * (n-1)))."""
+    single_pass = True
+
+    def __init__(self, child, percentages, accuracy: int = 10000):
+        super().__init__(child)
+        self.scalar = not isinstance(percentages, (list, tuple))
+        self.percentages = [percentages] if self.scalar else list(percentages)
+        self.accuracy = accuracy
+
+    @property
+    def data_type(self):
+        return T.DOUBLE if self.scalar else T.ArrayType(T.DOUBLE)
+
+    def partial_types(self):
+        return [self.data_type]

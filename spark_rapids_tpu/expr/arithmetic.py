@@ -16,7 +16,20 @@ from __future__ import annotations
 import numpy as np
 
 from .. import types as T
-from .base import Expression, EvalContext, Vec, and_validity
+from .base import Expression, EvalContext, Vec, and_validity, ansi_raise
+
+
+def _overflow_msg(dt: T.DataType) -> str:
+    name = {8: "tinyint", 16: "smallint"}.get(
+        (dt.np_dtype.itemsize * 8) if dt.np_dtype else 64)
+    if isinstance(dt, T.LongType):
+        return "[ARITHMETIC_OVERFLOW] long overflow"
+    if isinstance(dt, T.IntegerType):
+        return "[ARITHMETIC_OVERFLOW] integer overflow"
+    return f"[ARITHMETIC_OVERFLOW] {name or dt.simple_string()} overflow"
+
+
+_DIV_ZERO = "[DIVIDE_BY_ZERO] Division by zero"
 
 __all__ = ["Add", "Subtract", "Multiply", "Divide", "IntegralDivide", "Remainder",
            "Pmod", "UnaryMinus", "Abs", "cast_data", "promote_args"]
@@ -57,9 +70,16 @@ class BinaryArithmetic(BinaryExpression):
         l, r, dt = promote_args(ctx.xp, l, r)
         validity = and_validity(ctx.xp, l.validity, r.validity)
         data = self._op(ctx.xp, l.data, r.data)
-        return Vec(dt, data.astype(dt.np_dtype, copy=False), validity)
+        data = data.astype(dt.np_dtype, copy=False)
+        if ctx.ansi and T.is_integral(dt):
+            bad = self._overflowed(ctx.xp, l.data, r.data, data) & validity
+            ansi_raise(ctx, bad, _overflow_msg(dt))
+        return Vec(dt, data, validity)
 
     def _op(self, xp, a, b):
+        raise NotImplementedError
+
+    def _overflowed(self, xp, a, b, res):
         raise NotImplementedError
 
 
@@ -67,15 +87,30 @@ class Add(BinaryArithmetic):
     def _op(self, xp, a, b):
         return a + b
 
+    def _overflowed(self, xp, a, b, res):
+        # sign trick: overflow iff operands share a sign the result lost
+        return ((a ^ res) & (b ^ res)) < 0
+
 
 class Subtract(BinaryArithmetic):
     def _op(self, xp, a, b):
         return a - b
 
+    def _overflowed(self, xp, a, b, res):
+        return ((a ^ b) & (a ^ res)) < 0
+
 
 class Multiply(BinaryArithmetic):
     def _op(self, xp, a, b):
         return a * b
+
+    def _overflowed(self, xp, a, b, res):
+        # recover a from the wrapped product by truncating division; any
+        # mismatch means the true product left the type's range
+        mn = np.iinfo(res.dtype).min
+        q = _trunc_div(xp, res, xp.where(b == 0, 1, b))
+        return ((b != 0) & (q != a)) | ((a == mn) & (b == -1)) | \
+            ((b == mn) & (a == -1))
 
 
 class Divide(BinaryExpression):
@@ -94,7 +129,10 @@ class Divide(BinaryExpression):
         a = l.data.astype(np.float64)
         b = r.data.astype(np.float64)
         zero = b == 0.0
-        validity = and_validity(xp, l.validity, r.validity) & ~zero
+        both = and_validity(xp, l.validity, r.validity)
+        if ctx.ansi:
+            ansi_raise(ctx, zero & both, _DIV_ZERO)
+        validity = both & ~zero
         if ctx.xp is np:
             with np.errstate(divide="ignore", invalid="ignore"):
                 data = np.where(zero, 0.0, a / b)
@@ -129,7 +167,13 @@ class IntegralDivide(BinaryExpression):
         a = l.data.astype(np.int64)
         b = r.data.astype(np.int64)
         zero = b == 0
-        validity = and_validity(xp, l.validity, r.validity) & ~zero
+        both = and_validity(xp, l.validity, r.validity)
+        if ctx.ansi:
+            ansi_raise(ctx, zero & both, _DIV_ZERO)
+            mn = np.int64(-2**63)
+            ansi_raise(ctx, (a == mn) & (b == -1) & both,
+                       "[ARITHMETIC_OVERFLOW] long overflow")
+        validity = both & ~zero
         safe_b = xp.where(zero, 1, b)
         data = _trunc_div(xp, a, safe_b)
         return Vec(T.LONG, xp.where(zero, 0, data), validity)
@@ -146,7 +190,10 @@ class Remainder(BinaryArithmetic):
         xp = ctx.xp
         l, r, dt = promote_args(xp, l, r)
         zero = r.data == 0 if not T.is_floating(dt) else r.data == 0.0
-        validity = and_validity(xp, l.validity, r.validity) & ~zero
+        both = and_validity(xp, l.validity, r.validity)
+        if ctx.ansi:
+            ansi_raise(ctx, zero & both, _DIV_ZERO)
+        validity = both & ~zero
         if T.is_floating(dt):
             data = xp.where(zero, 0.0, xp.fmod(l.data, xp.where(zero, 1.0, r.data)))
         else:
@@ -166,7 +213,10 @@ class Pmod(BinaryArithmetic):
         xp = ctx.xp
         l, r, dt = promote_args(xp, l, r)
         zero = r.data == 0 if not T.is_floating(dt) else r.data == 0.0
-        validity = and_validity(xp, l.validity, r.validity) & ~zero
+        both = and_validity(xp, l.validity, r.validity)
+        if ctx.ansi:
+            ansi_raise(ctx, zero & both, _DIV_ZERO)
+        validity = both & ~zero
         if T.is_floating(dt):
             b = xp.where(zero, 1.0, r.data)
             m = xp.fmod(l.data, b)
@@ -188,6 +238,9 @@ class UnaryMinus(Expression):
         return self.children[0].data_type
 
     def _compute(self, ctx, c: Vec) -> Vec:
+        if ctx.ansi and T.is_integral(c.dtype):
+            mn = np.iinfo(c.dtype.np_dtype).min
+            ansi_raise(ctx, (c.data == mn) & c.validity, _overflow_msg(c.dtype))
         return Vec(c.dtype, (-c.data).astype(c.dtype.np_dtype, copy=False),
                    c.validity)
 
@@ -201,4 +254,7 @@ class Abs(Expression):
         return self.children[0].data_type
 
     def _compute(self, ctx, c: Vec) -> Vec:
+        if ctx.ansi and T.is_integral(c.dtype):
+            mn = np.iinfo(c.dtype.np_dtype).min
+            ansi_raise(ctx, (c.data == mn) & c.validity, _overflow_msg(c.dtype))
         return Vec(c.dtype, ctx.xp.abs(c.data), c.validity)

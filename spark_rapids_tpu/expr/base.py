@@ -137,15 +137,33 @@ def zero_vec(xp, dt: T.DataType, shape: tuple) -> Vec:
 class EvalContext:
     """xp: the array namespace (numpy | jax.numpy). ansi: ANSI SQL mode.
     row_mask: bool[n] live-row mask (None on the CPU engine where arrays are exact
-    length). Expressions needing whole-column reasoning (aggs) use row_mask."""
+    length). Expressions needing whole-column reasoning (aggs) use row_mask.
+    errors: under ANSI on device, a list of (traced bool, message) pairs the
+    enclosing kernel returns so the exec can raise host-side (XLA can't raise
+    mid-kernel; the CPU engine raises eagerly instead)."""
     xp: Any
     ansi: bool = False
     row_mask: Any = None
     conf: Any = None
+    errors: Any = None
 
     @property
     def is_device(self) -> bool:
         return self.xp is not np
+
+
+def ansi_raise(ctx: EvalContext, flag, message: str) -> None:
+    """Report an ANSI runtime error condition for the rows where `flag` is
+    true. Device: append a reduced traced flag to ctx.errors (the exec raises
+    after the kernel). Host (CPU oracle): raise immediately, like Spark."""
+    if ctx.row_mask is not None:
+        flag = flag & ctx.row_mask
+    if ctx.is_device:
+        if ctx.errors is not None:
+            ctx.errors.append((ctx.xp.any(flag), message))
+    elif np.any(flag):
+        from ..errors import AnsiViolation
+        raise AnsiViolation(message)
 
 
 def all_valid(xp, n_like) -> Any:

@@ -88,14 +88,16 @@ class Cast(Expression):
             return Vec(dst, c.data * 1_000_000, c.validity)
         if isinstance(src, T.DecimalType) or isinstance(dst, T.DecimalType):
             return _decimal_cast(xp, c, dst, self.ansi)
-        return _numeric_cast(xp, c, dst)
+        return _numeric_cast(xp, c, dst, ctx)
 
     def __repr__(self):
         return f"cast({self.children[0]!r} as {self.to.simple_string()})"
 
 
-def _numeric_cast(xp, c: Vec, dst: T.DataType) -> Vec:
+def _numeric_cast(xp, c: Vec, dst: T.DataType, ctx=None) -> Vec:
+    from .base import ansi_raise
     sd, dd = c.dtype, dst
+    ansi = ctx is not None and ctx.ansi
     a = c.data
     if isinstance(dd, T.BooleanType):
         return Vec(dst, a != 0, c.validity)
@@ -108,14 +110,26 @@ def _numeric_cast(xp, c: Vec, dst: T.DataType) -> Vec:
         lo, hi = _INT_BOUNDS[dd.np_dtype]
         upper = np.float64(float(hi) + 1.0)  # 2^7/2^15/2^31/2^63, all exact
         t = xp.trunc(a.astype(np.float64))
-        t = xp.where(xp.isnan(a), 0.0, t)
+        nan = xp.isnan(a)
+        t = xp.where(nan, 0.0, t)
         pos_ovf = t >= upper
         neg_ovf = t < -upper  # t == -upper (== lo) is exactly representable/valid
+        if ansi:
+            ansi_raise(ctx, (pos_ovf | neg_ovf | nan) & c.validity,
+                       f"[CAST_OVERFLOW] casting {sd.simple_string()} to "
+                       f"{dd.simple_string()} causes overflow")
         safe = xp.where(pos_ovf | neg_ovf, 0.0, t)
         i = safe.astype(np.int64)
         i = xp.where(pos_ovf, hi, xp.where(neg_ovf, lo, i))
         return Vec(dst, i.astype(dd.np_dtype), c.validity)
-    # integral narrowing wraps (Java); widening and int<->float direct
+    if ansi and T.is_integral(sd) and T.is_integral(dd) and \
+            dd.np_dtype.itemsize < sd.np_dtype.itemsize:
+        lo, hi = _INT_BOUNDS[dd.np_dtype]
+        bad = ((a < lo) | (a > hi)) & c.validity
+        ansi_raise(ctx, bad,
+                   f"[CAST_OVERFLOW] casting {sd.simple_string()} to "
+                   f"{dd.simple_string()} causes overflow")
+    # integral narrowing wraps (Java, non-ANSI); widening and int<->float direct
     return Vec(dst, a.astype(dd.np_dtype), c.validity)
 
 

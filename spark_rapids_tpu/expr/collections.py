@@ -274,3 +274,92 @@ class CreateNamedStruct(Expression):
         n = fields[0].data.shape[0]
         ones = xp.ones(n, dtype=bool)
         return Vec(self.data_type, ones, ones, None, tuple(fields))
+
+
+def _float_sort_bits(xp, data):
+    """IEEE-754 total-order key: for non-negative bit patterns the bits are
+    already monotone; for negatives flip the magnitude bits. -inf maps most
+    negative, NaN (0x7ff8...) largest — Spark float ordering."""
+    wide = data.astype(np.float64)
+    if xp is np:
+        bits = np.ascontiguousarray(wide).view(np.int64)
+    else:
+        from jax import lax
+        bits = lax.bitcast_convert_type(wide, np.int64)
+    return xp.where(bits >= 0, bits, bits ^ np.int64(0x7FFFFFFFFFFFFFFF))
+
+
+def _elem_sort_key(xp, elem: Vec):
+    if T.is_floating(elem.dtype):
+        return _float_sort_bits(xp, elem.data)
+    if isinstance(elem.dtype, T.BooleanType):
+        return elem.data.astype(np.int64)
+    return elem.data.astype(np.int64)
+
+
+class _ArrayMinMax(Expression):
+    is_min = True
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type.element_type
+
+    def _compute(self, ctx: EvalContext, arr: Vec) -> Vec:
+        xp = ctx.xp
+        elem = arr.children[0]
+        k = elem.data.shape[1]
+        live = (xp.arange(k)[None, :] < arr.data[:, None]) & elem.validity
+        key = _elem_sort_key(xp, elem)
+        sentinel = np.int64(2**63 - 1) if self.is_min else np.int64(-2**63)
+        key = xp.where(live, key, sentinel)
+        pick = xp.argmin(key, axis=1) if self.is_min else \
+            xp.argmax(key, axis=1)
+        rows = xp.arange(arr.data.shape[0])
+        data = elem.data[rows, pick]
+        has = live.any(axis=1)
+        out = Vec(elem.dtype, data, arr.validity & has, None if
+                  elem.lengths is None else elem.lengths[rows, pick])
+        return out
+
+
+class ArrayMin(_ArrayMinMax):
+    is_min = True
+
+
+class ArrayMax(_ArrayMinMax):
+    is_min = False
+
+
+class SortArray(Expression):
+    """sort_array(arr[, asc]): sorts elements; nulls first when ascending,
+    last when descending (Spark semantics). Primitive elements."""
+
+    def __init__(self, child: Expression, ascending: bool = True):
+        super().__init__([child])
+        self.ascending = ascending
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def _compute(self, ctx: EvalContext, arr: Vec) -> Vec:
+        xp = ctx.xp
+        elem = arr.children[0]
+        n, k = elem.data.shape[0], elem.data.shape[1]
+        live = xp.arange(k)[None, :] < arr.data[:, None]
+        key = _elem_sort_key(xp, elem)
+        if not self.ascending:
+            key = ~key  # reverse order without negation overflow
+        null_key = np.int64(-2**63) if self.ascending else np.int64(2**63 - 2)
+        key = xp.where(elem.validity, key, null_key)
+        key = xp.where(live, key, np.int64(2**63 - 1))  # dead slots last
+        order = xp.argsort(key, axis=1, stable=True)
+        data = xp.take_along_axis(elem.data, order, axis=1)
+        validity = xp.take_along_axis(elem.validity, order, axis=1)
+        out_elem = Vec(elem.dtype, data, validity,
+                       None if elem.lengths is None else
+                       xp.take_along_axis(elem.lengths, order, axis=1))
+        return Vec(arr.dtype, arr.data, arr.validity, None, (out_elem,))

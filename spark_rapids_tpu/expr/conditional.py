@@ -1,12 +1,30 @@
 """Conditional expressions (reference `conditionalExpressions.scala`: GpuIf,
-GpuCaseWhen; `GpuLeast`/`GpuGreatest` from arithmetic.scala)."""
+GpuCaseWhen; `GpuLeast`/`GpuGreatest` from arithmetic.scala).
+
+ANSI lazy-branch semantics: Spark guarantees IF/CASE WHEN only evaluate the
+taken branch, so a guarded division (CASE WHEN d <> 0 THEN x/d END) must not
+raise for the guarded rows. Vectorized evaluation computes every branch, so
+the branch context narrows `row_mask` to the rows the branch is taken for —
+`ansi_raise` masks its error flags with row_mask, suppressing errors from
+untaken rows on both engines (the reference handles the same problem with
+side-effect-aware GpuIf/GpuCaseWhen)."""
 
 from __future__ import annotations
+
+import dataclasses
 
 from .. import types as T
 from .base import Expression, EvalContext, Vec
 
 __all__ = ["If", "CaseWhen", "Least", "Greatest"]
+
+
+def _branch_ctx(ctx: EvalContext, branch_mask) -> EvalContext:
+    """Context for evaluating a conditionally-taken branch under ANSI."""
+    if not ctx.ansi:
+        return ctx
+    rm = branch_mask if ctx.row_mask is None else (ctx.row_mask & branch_mask)
+    return dataclasses.replace(ctx, row_mask=rm)
 
 
 def _select(xp, cond, then_v: Vec, else_v: Vec) -> Vec:
@@ -37,8 +55,11 @@ class If(Expression):
     def nullable(self):
         return self.children[1].nullable or self.children[2].nullable
 
-    def _compute(self, ctx: EvalContext, p: Vec, t: Vec, e: Vec) -> Vec:
+    def eval(self, ctx: EvalContext, batch_vecs) -> Vec:
+        p = self.children[0].eval(ctx, batch_vecs)
         cond = p.data & p.validity  # null predicate -> else branch
+        t = self.children[1].eval(_branch_ctx(ctx, cond), batch_vecs)
+        e = self.children[2].eval(_branch_ctx(ctx, ~cond), batch_vecs)
         return _select(ctx.xp, cond, t, e)
 
 
@@ -78,15 +99,27 @@ class CaseWhen(Expression):
     def nullable(self):
         return True
 
-    def _compute(self, ctx: EvalContext, *vecs: Vec) -> Vec:
+    def eval(self, ctx: EvalContext, batch_vecs) -> Vec:
         xp = ctx.xp
-        out = vecs[-1]  # else
         nbranches = (len(self.children) - 1) // 2
+        conds = []
+        taken_before = None  # rows already claimed by an earlier branch
+        for i in range(nbranches):
+            c = self.children[2 * i].eval(ctx, batch_vecs)
+            cond = c.data & c.validity
+            eff = cond if taken_before is None else (cond & ~taken_before)
+            conds.append((cond, eff))
+            taken_before = cond if taken_before is None else \
+                (taken_before | cond)
+        vals = [self.children[2 * i + 1].eval(_branch_ctx(ctx, conds[i][1]),
+                                              batch_vecs)
+                for i in range(nbranches)]
+        out = self.children[-1].eval(
+            _branch_ctx(ctx, ~taken_before) if taken_before is not None
+            else ctx, batch_vecs)
         # fold right-to-left so earlier branches win
         for i in range(nbranches - 1, -1, -1):
-            c, v = vecs[2 * i], vecs[2 * i + 1]
-            cond = c.data & c.validity
-            out = _select(xp, cond, v, out)
+            out = _select(xp, conds[i][0], vals[i], out)
         return out
 
 

@@ -15,7 +15,7 @@ import numpy as np
 from .. import types as T
 from .base import Expression, EvalContext, Vec, and_validity
 
-__all__ = ["Year", "Month", "DayOfMonth", "Quarter", "DayOfWeek", "WeekDay",
+__all__ = ["LastDay", "AddMonths", "MonthsBetween", "TruncDate", "NextDay", "Year", "Month", "DayOfMonth", "Quarter", "DayOfWeek", "WeekDay",
            "DayOfYear", "Hour", "Minute", "Second", "DateAdd", "DateSub",
            "DateDiff", "UnixTimestampFromTs", "civil_from_days"]
 
@@ -209,3 +209,166 @@ class UnixTimestampFromTs(Expression):
     def _compute(self, ctx, c: Vec) -> Vec:
         xp = ctx.xp
         return Vec(T.LONG, _floor_div(xp, c.data, _US_PER_SEC), c.validity)
+
+
+def days_from_civil(xp, y, m, d):
+    """(year, month, day) -> days since epoch (Howard Hinnant's algorithm,
+    the inverse of civil_from_days)."""
+    y = y.astype(np.int64) - (m <= 2)
+    era = xp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    mp = xp.where(m > 2, m - 3, m + 9).astype(np.int64)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return (era * 146097 + doe - 719468).astype(np.int64)
+
+
+def _days_in_month(xp, y, m):
+    ny = xp.where(m == 12, y + 1, y)
+    nm = xp.where(m == 12, 1, m + 1)
+    return (days_from_civil(xp, ny, nm, xp.ones_like(m)) -
+            days_from_civil(xp, y, m, xp.ones_like(m))).astype(np.int32)
+
+
+class LastDay(Expression):
+    """last_day(date): last day of the date's month."""
+
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.DATE
+
+    def _compute(self, ctx, c: Vec) -> Vec:
+        xp = ctx.xp
+        y, m, _ = civil_from_days(xp, c.data)
+        first = days_from_civil(xp, y, m, xp.ones_like(m))
+        return Vec(T.DATE, (first + _days_in_month(xp, y, m) - 1)
+                   .astype(np.int32), c.validity)
+
+
+class AddMonths(Expression):
+    """add_months(date, n): day clamps to the target month's last day."""
+
+    def __init__(self, date, months):
+        super().__init__([date, months])
+
+    @property
+    def data_type(self):
+        return T.DATE
+
+    def _compute(self, ctx, d: Vec, n: Vec) -> Vec:
+        xp = ctx.xp
+        y, m, day = civil_from_days(xp, d.data)
+        total = y.astype(np.int64) * 12 + (m - 1) + n.data.astype(np.int64)
+        ny = total // 12
+        nm = (total % 12 + 1).astype(np.int32)
+        nd = xp.minimum(day, _days_in_month(xp, ny, nm))
+        out = days_from_civil(xp, ny, nm, nd).astype(np.int32)
+        return Vec(T.DATE, out, and_validity(xp, d.validity, n.validity))
+
+
+class MonthsBetween(Expression):
+    """months_between(ts1, ts2[, roundOff]): whole months when both are the
+    same day-of-month or both last days; otherwise months + (d1-d2)/31 with
+    the time-of-day folded into the day fraction (Spark semantics)."""
+
+    def __init__(self, end, start, round_off: bool = True):
+        super().__init__([end, start])
+        self.round_off = round_off
+
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+    def _compute(self, ctx, a: Vec, b: Vec) -> Vec:
+        xp = ctx.xp
+
+        def parts(v: Vec):
+            if isinstance(v.dtype, T.DateType):
+                days = v.data.astype(np.int64)
+                tod = xp.zeros_like(days)
+            else:
+                days = _floor_div(xp, v.data, _US_PER_DAY)
+                tod = v.data - days * _US_PER_DAY
+            y, m, d = civil_from_days(xp, days)
+            return y.astype(np.int64), m.astype(np.int64), \
+                d.astype(np.int64), tod
+
+        y1, m1, d1, t1 = parts(a)
+        y2, m2, d2, t2 = parts(b)
+        months = (y1 - y2) * 12 + (m1 - m2)
+        last1 = d1 == _days_in_month(xp, y1, m1.astype(np.int32))
+        last2 = d2 == _days_in_month(xp, y2, m2.astype(np.int32))
+        whole = (d1 == d2) | (last1 & last2)
+        sec1 = d1 * 86400 + t1 // 1_000_000
+        sec2 = d2 * 86400 + t2 // 1_000_000
+        frac = (sec1 - sec2).astype(np.float64) / (31.0 * 86400.0)
+        out = xp.where(whole, months.astype(np.float64),
+                       months.astype(np.float64) + frac)
+        if self.round_off:
+            out = xp.round(out * 1e8) / 1e8
+        return Vec(T.DOUBLE, out, and_validity(xp, a.validity, b.validity))
+
+
+class TruncDate(Expression):
+    """trunc(date, fmt) with literal fmt: YEAR/YYYY/YY, QUARTER, MONTH/MM/MON,
+    WEEK (Monday)."""
+
+    def __init__(self, date, fmt: str):
+        super().__init__([date])
+        self.fmt = fmt.upper()
+
+    @property
+    def data_type(self):
+        return T.DATE
+
+    def _compute(self, ctx, c: Vec) -> Vec:
+        xp = ctx.xp
+        y, m, _d = civil_from_days(xp, c.data)
+        one = xp.ones_like(m)
+        f = self.fmt
+        if f in ("YEAR", "YYYY", "YY"):
+            out = days_from_civil(xp, y, one, one)
+        elif f in ("MONTH", "MM", "MON"):
+            out = days_from_civil(xp, y, m, one)
+        elif f == "QUARTER":
+            qm = ((m - 1) // 3) * 3 + 1
+            out = days_from_civil(xp, y, qm, one)
+        elif f == "WEEK":
+            # Monday-start week: epoch day 0 = Thursday (dow 3, Mon=0)
+            days = c.data.astype(np.int64)
+            dow = (days + 3) % 7
+            out = days - dow
+        else:  # Spark: invalid trunc format -> null column, not an error
+            return Vec(T.DATE, xp.zeros_like(c.data),
+                       xp.zeros(c.data.shape[0], dtype=bool))
+        return Vec(T.DATE, out.astype(np.int32), c.validity)
+
+
+class NextDay(Expression):
+    """next_day(date, dayOfWeek literal): first date later than the input
+    that falls on the given weekday."""
+
+    _DOW = {"MO": 0, "TU": 1, "WE": 2, "TH": 3, "FR": 4, "SA": 5, "SU": 6}
+
+    def __init__(self, date, day_name: str):
+        super().__init__([date])
+        self.day_name = day_name
+        self.target = self._DOW.get(day_name.strip().upper()[:2])
+
+    @property
+    def data_type(self):
+        return T.DATE
+
+    def _compute(self, ctx, c: Vec) -> Vec:
+        xp = ctx.xp
+        if self.target is None:  # Spark: invalid day name -> null
+            return Vec(T.DATE, xp.zeros_like(c.data),
+                       xp.zeros(c.data.shape[0], dtype=bool))
+        days = c.data.astype(np.int64)
+        dow = (days + 3) % 7  # Mon=0
+        delta = (self.target - dow) % 7
+        delta = xp.where(delta == 0, 7, delta)
+        return Vec(T.DATE, (days + delta).astype(np.int32), c.validity)

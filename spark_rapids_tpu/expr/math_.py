@@ -11,7 +11,7 @@ import numpy as np
 from .. import types as T
 from .base import Expression, EvalContext, Vec, and_validity
 
-__all__ = ["Sqrt", "Exp", "Log", "Log10", "Log2", "Pow", "Floor", "Ceil", "Round",
+__all__ = ["Atan2", "Hypot", "Logarithm", "Expm1", "Log1p", "Rint", "Cot", "BRound", "Sqrt", "Exp", "Log", "Log10", "Log2", "Pow", "Floor", "Ceil", "Round",
            "Signum", "Sin", "Cos", "Tan", "Asin", "Acos", "Atan", "Sinh", "Cosh",
            "Tanh", "Cbrt", "ToDegrees", "ToRadians"]
 
@@ -216,4 +216,103 @@ class Round(Expression):
         rounded = xp.sign(a) * xp.floor(xp.abs(a) * p + 0.5) / p
         if T.is_integral(c.dtype):
             return Vec(c.dtype, rounded.astype(c.dtype.np_dtype), c.validity)
+        return Vec(c.dtype, rounded.astype(c.dtype.np_dtype), c.validity)
+
+
+class _BinaryMath(Expression):
+    """(double, double) -> double elementwise."""
+
+    def __init__(self, left, right):
+        super().__init__([left, right])
+
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+    def _compute(self, ctx: EvalContext, l: Vec, r: Vec) -> Vec:
+        xp = ctx.xp
+        a = l.data.astype(np.float64)
+        b = r.data.astype(np.float64)
+        if xp is np:
+            with np.errstate(all="ignore"):
+                data = self._op(xp, a, b)
+        else:
+            data = self._op(xp, a, b)
+        return Vec(T.DOUBLE, data, and_validity(xp, l.validity, r.validity))
+
+    def _op(self, xp, a, b):
+        raise NotImplementedError
+
+
+class Atan2(_BinaryMath):
+    def _op(self, xp, a, b):
+        return xp.arctan2(a, b)
+
+
+class Hypot(_BinaryMath):
+    def _op(self, xp, a, b):
+        return xp.hypot(a, b)
+
+
+class Logarithm(_BinaryMath):
+    """log(base, x): null for x <= 0 or base <= 0 (Spark null-on-domain)."""
+
+    def _compute(self, ctx: EvalContext, l: Vec, r: Vec) -> Vec:
+        xp = ctx.xp
+        base = l.data.astype(np.float64)
+        x = r.data.astype(np.float64)
+        bad = (x <= 0) | (base <= 0) | (base == 1.0)
+        safe_b = xp.where(bad, 2.0, base)
+        safe_x = xp.where(bad, 1.0, x)
+        if xp is np:
+            with np.errstate(all="ignore"):
+                data = np.log(safe_x) / np.log(safe_b)
+        else:
+            data = xp.log(safe_x) / xp.log(safe_b)
+        return Vec(T.DOUBLE, data,
+                   and_validity(xp, l.validity, r.validity) & ~bad)
+
+
+class Expm1(UnaryMath):
+    def _op(self, xp, a):
+        return xp.expm1(a)
+
+
+class Log1p(UnaryMath):
+    null_domain = staticmethod(lambda xp, a: a <= -1.0)
+
+    def _op(self, xp, a):
+        return xp.log1p(a)
+
+
+class Rint(UnaryMath):
+    """rint: round half to even, double -> double (JVM Math.rint)."""
+
+    def _op(self, xp, a):
+        return xp.round(a)
+
+
+class Cot(UnaryMath):
+    def _op(self, xp, a):
+        return 1.0 / xp.tan(a)
+
+
+class BRound(Expression):
+    """bround(x, d): HALF_EVEN (banker's) rounding, Spark's ROUND_HALF_EVEN."""
+
+    def __init__(self, child, scale: int = 0):
+        super().__init__([child])
+        self.scale = scale
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def _compute(self, ctx, c: Vec) -> Vec:
+        xp = ctx.xp
+        if T.is_integral(c.dtype) and self.scale >= 0:
+            return c
+        p = 10.0 ** self.scale
+        a = c.data.astype(np.float64)
+        rounded = xp.round(a * p) / p  # numpy/XLA round IS half-even
         return Vec(c.dtype, rounded.astype(c.dtype.np_dtype), c.validity)

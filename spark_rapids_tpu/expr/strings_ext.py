@@ -1,0 +1,714 @@
+"""Extended string expressions over the byte-matrix layout (reference
+`stringFunctions.scala`: GpuStringRepeat, GpuStringLPad/RPad, GpuStringLocate,
+GpuStringReplace, GpuStringTranslate, GpuStringReverse, GpuConcatWs,
+GpuSubstringIndex, GpuInitCap, GpuAscii, GpuChr, GpuLeft/Right, BitLength,
+OctetLength, GpuFindInSet).
+
+Shape discipline: output widths must be static under jit, so ops whose output
+width depends on runtime values (repeat/lpad/rpad/space/replace) require
+literal size arguments — the planner tags non-literal forms back to CPU, the
+same trade the reference makes where cuStrings lacks a kernel."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import types as T
+from ..columnar.padding import width_bucket
+from .base import (EvalContext, Expression, Literal, Vec, and_validity)
+from .strings import (Substring, _is_char_start, _pos_mask, pad_common_width)
+
+__all__ = ["StringRepeat", "StringLPad", "StringRPad", "StringLocate",
+           "StringInstr", "StringReplace", "StringTranslate", "StringReverse",
+           "ConcatWs", "SubstringIndex", "InitCap", "Ascii", "Chr", "Left",
+           "Right", "StringSpace", "BitLength", "OctetLength", "FindInSet"]
+
+
+def _lit_int(e: Expression):
+    return e.value if isinstance(e, Literal) and e.value is not None else None
+
+
+def _lit_str(e: Expression):
+    return e.value if isinstance(e, Literal) and isinstance(e.value, str) \
+        else None
+
+
+def _row_gather(xp, chars, idx, keep):
+    """take_along_axis + zero the dead tail."""
+    data = xp.take_along_axis(chars, idx, axis=1)
+    return xp.where(keep, data, np.uint8(0))
+
+
+class StringRepeat(Expression):
+    """repeat(str, n) — n must be a literal (static output width)."""
+
+    def __init__(self, child: Expression, times: Expression):
+        super().__init__([child, times])
+        self.times = _lit_int(times)
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _compute(self, ctx: EvalContext, c: Vec, tv: Vec) -> Vec:
+        xp = ctx.xp
+        times = max(int(self.times), 0) if self.times is not None else 0
+        n, w = c.data.shape
+        if times == 0:
+            return Vec(T.STRING, xp.zeros((n, 8), dtype=xp.uint8),
+                       and_validity(xp, c.validity, tv.validity),
+                       xp.zeros(n, dtype=xp.int32))
+        ow = width_bucket(w * times)
+        j = xp.arange(ow, dtype=np.int32)[None, :]
+        lens = c.lengths[:, None]
+        src = xp.where(lens > 0, j % xp.maximum(lens, 1), 0)
+        out_len = (c.lengths * times).astype(np.int32)
+        idx = xp.minimum(src, w - 1).astype(np.int32)
+        pad = xp.pad(c.data, ((0, 0), (0, ow - w))) if ow > w else c.data
+        data = _row_gather(xp, pad, xp.minimum(idx, ow - 1),
+                           j < out_len[:, None])
+        return Vec(T.STRING, data,
+                   and_validity(xp, c.validity, tv.validity), out_len)
+
+
+class _Pad(Expression):
+    """lpad/rpad(str, len, pad) — len and pad literal; pad must be ASCII so
+    byte positions equal char positions in the fill."""
+    left = True
+
+    def __init__(self, child: Expression, length: Expression,
+                 pad: Expression = None):
+        pad = pad if pad is not None else Literal(" ")
+        super().__init__([child, length, pad])
+        self.target = _lit_int(length)
+        self.pad = _lit_str(pad)
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _compute(self, ctx: EvalContext, c: Vec, lv: Vec, pv: Vec) -> Vec:
+        xp = ctx.xp
+        tgt = max(int(self.target), 0)
+        pad = (self.pad or "").encode("utf-8")
+        n, w = c.data.shape
+        ow = width_bucket(max(tgt * 4, w, 1))  # target chars may be 4-byte
+        # char-aware prefix of str up to tgt chars (truncation path)
+        starts = _is_char_start(xp, c.data) & _pos_mask(xp, c.data, c.lengths)
+        nchars = xp.sum(starts, axis=1).astype(np.int32)
+        char_id = xp.cumsum(starts.astype(np.int32), axis=1) - 1
+        in_row = _pos_mask(xp, c.data, c.lengths)
+        keep_bytes = xp.sum(in_row & (char_id < tgt), axis=1).astype(np.int32)
+        str_bytes = xp.where(nchars > tgt, keep_bytes, c.lengths)
+        str_chars = xp.minimum(nchars, tgt)
+        pad_chars = xp.maximum(tgt - str_chars, 0)
+        # pad is ASCII: pad bytes == pad chars; empty pad pads nothing
+        pad_bytes = pad_chars if len(pad) else xp.zeros(n, dtype=np.int32)
+        out_len = (str_bytes + pad_bytes).astype(np.int32)
+
+        j = xp.arange(ow, dtype=np.int32)[None, :]
+        spad = xp.pad(c.data, ((0, 0), (0, ow - w))) if ow > w else c.data
+        if len(pad):
+            pat = np.frombuffer(pad, dtype=np.uint8)
+            pad_row = xp.asarray(pat)
+        if self.left:
+            # first pad_bytes slots from the cycled pad, then the string
+            is_pad = j < pad_bytes[:, None]
+            src_str = xp.clip(j - pad_bytes[:, None], 0, ow - 1)
+            data = xp.take_along_axis(spad, src_str, axis=1)
+            if len(pad):
+                pidx = (j % len(pad)).astype(np.int32)
+                fill = pad_row[pidx]
+                fill = xp.broadcast_to(fill, (n, ow))
+                # cycle must restart at the pad boundary, position within pad
+                pidx2 = (j % len(pad))
+                data = xp.where(is_pad, fill, data)
+        else:
+            is_pad = (j >= str_bytes[:, None])
+            data = xp.take_along_axis(spad, xp.minimum(j, ow - 1), axis=1)
+            if len(pad):
+                rel = xp.clip(j - str_bytes[:, None], 0, ow - 1)
+                fill = pad_row[(rel % len(pad)).astype(np.int32)]
+                data = xp.where(is_pad, fill, data)
+        data = xp.where(j < out_len[:, None], data, np.uint8(0))
+        validity = and_validity(xp, c.validity, lv.validity, pv.validity)
+        return Vec(T.STRING, data, validity, out_len)
+
+
+class StringLPad(_Pad):
+    left = True
+
+
+class StringRPad(_Pad):
+    left = False
+
+
+def _find_first(xp, s: Vec, p: Vec, from_byte):
+    """Byte index of the first occurrence of p in s at/after from_byte per
+    row; -1 if absent. Static loop over shifts (Contains-style)."""
+    ds, dp = pad_common_width(xp, s, p)
+    n, w = ds.shape
+    j = xp.arange(w, dtype=np.int32)[None, :]
+    in_p = j < p.lengths[:, None]
+    best = xp.full(n, -1, dtype=np.int32)
+    for k in range(w - 1, -1, -1):
+        idx = xp.clip(j + k, 0, w - 1)
+        window = xp.take_along_axis(ds, idx, axis=1)
+        m = xp.all(~in_p | (window == dp), axis=1)
+        m = m & ((p.lengths + k) <= s.lengths) & (k >= from_byte)
+        best = xp.where(m, k, best)
+    return best
+
+
+def _byte_to_char(xp, s: Vec, byte_pos):
+    """Char index of a byte position (positions past the end clamp)."""
+    starts = _is_char_start(xp, s.data) & _pos_mask(xp, s.data, s.lengths)
+    j = xp.arange(s.data.shape[1], dtype=np.int32)[None, :]
+    return xp.sum(starts & (j < byte_pos[:, None]), axis=1).astype(np.int32)
+
+
+def _char_to_byte(xp, s: Vec, char_pos):
+    starts = _is_char_start(xp, s.data) & _pos_mask(xp, s.data, s.lengths)
+    char_id = xp.cumsum(starts.astype(np.int32), axis=1) - 1
+    in_row = _pos_mask(xp, s.data, s.lengths)
+    return xp.sum(in_row & (char_id < char_pos[:, None]), axis=1) \
+        .astype(np.int32)
+
+
+class StringLocate(Expression):
+    """locate(substr, str[, start]) — 1-based char position, 0 if absent.
+    start <= 0 returns 0 (Spark); null substr/str -> null."""
+
+    def __init__(self, substr: Expression, string: Expression,
+                 start: Expression = None):
+        super().__init__([substr, string,
+                          start if start is not None else Literal(1)])
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def _compute(self, ctx: EvalContext, p: Vec, s: Vec, st: Vec) -> Vec:
+        xp = ctx.xp
+        start = st.data.astype(np.int32)
+        from_byte = _char_to_byte(xp, s, xp.maximum(start - 1, 0))
+        pos = _find_first(xp, s, p, from_byte)
+        char_pos = _byte_to_char(xp, s, xp.maximum(pos, 0)) + 1
+        found = (pos >= 0) & (start > 0)
+        # Spark: empty substr -> start (when within bounds)
+        out = xp.where(found, char_pos, 0).astype(np.int32)
+        validity = and_validity(xp, p.validity, s.validity, st.validity)
+        return Vec(T.INT, out, validity)
+
+
+class StringInstr(StringLocate):
+    """instr(str, substr) = locate(substr, str, 1) — note swapped args."""
+
+    def __init__(self, string: Expression, substr: Expression):
+        super().__init__(substr, string, Literal(1))
+
+
+class StringReplace(Expression):
+    """replace(str, search, replace) — search/replace literal; non-empty
+    search. Greedy non-overlapping replacement left to right."""
+
+    def __init__(self, child: Expression, search: Expression,
+                 replacement: Expression = None):
+        replacement = replacement if replacement is not None else Literal("")
+        super().__init__([child, search, replacement])
+        self.search = _lit_str(search)
+        self.replacement = _lit_str(replacement)
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _compute(self, ctx: EvalContext, c: Vec, sv: Vec, rv: Vec) -> Vec:
+        xp = ctx.xp
+        sb = (self.search or "").encode("utf-8")
+        rb = (self.replacement or "").encode("utf-8")
+        n, w = c.data.shape
+        validity = and_validity(xp, c.validity, sv.validity, rv.validity)
+        if not sb:  # Spark: empty search returns the string unchanged
+            return Vec(T.STRING, c.data, validity, c.lengths)
+        slen, rlen = len(sb), len(rb)
+        grow = max(1, -(-rlen // slen))  # ceil
+        ow = width_bucket(min(w * grow, max(w, 8) * grow))
+        j = xp.arange(w, dtype=np.int32)[None, :]
+        pat = xp.asarray(np.frombuffer(sb, dtype=np.uint8))
+        # match[i, k]: pattern present at byte k (may overlap)
+        m = xp.ones((n, w), dtype=bool)
+        for t in range(slen):
+            idx = xp.minimum(j + t, w - 1)
+            m = m & (xp.take_along_axis(c.data, idx, axis=1) == pat[t])
+        m = m & ((j + slen) <= c.lengths[:, None])
+        # greedy non-overlapping selection: scan over byte positions
+        sel_cols = []
+        nxt = xp.zeros(n, dtype=np.int32)
+        for k in range(w):
+            ok = m[:, k] & (k >= nxt)
+            sel_cols.append(ok)
+            nxt = xp.where(ok, k + slen, nxt)
+        sel = xp.stack(sel_cols, axis=1)  # selected match starts
+        # prior selected matches strictly before byte position
+        csel = xp.cumsum(sel.astype(np.int32), axis=1)
+        before = csel - sel.astype(np.int32)  # matches starting < j
+        # a byte is consumed if inside any selected match
+        consumed = xp.zeros((n, w), dtype=bool)
+        for t in range(slen):
+            idx = xp.clip(j - t, 0, w - 1)
+            consumed = consumed | (xp.take_along_axis(sel, idx, axis=1) &
+                                   (j - t >= 0))
+        in_len = _pos_mask(xp, c.data, c.lengths)
+        nmatch = csel[:, -1]
+        out_len = (c.lengths + nmatch * (rlen - slen)).astype(np.int32)
+        # scatter kept bytes
+        dest_keep = j + before * (rlen - slen)
+        out = xp.zeros((n, ow), dtype=xp.uint8)
+        rows = xp.broadcast_to(xp.arange(n, dtype=np.int32)[:, None], (n, w))
+        keep = in_len & ~consumed
+        dk = xp.where(keep, dest_keep, ow - 1).astype(np.int32)
+        dk = xp.clip(dk, 0, ow - 1)
+        out = out.at[rows, dk].max(xp.where(keep, c.data, np.uint8(0))) \
+            if hasattr(out, "at") else _np_scatter(out, rows, dk, c.data, keep)
+        # scatter replacement bytes at each selected start
+        if rlen:
+            rpat = xp.asarray(np.frombuffer(rb, dtype=np.uint8))
+            dest_m = j + before * (rlen - slen)
+            for t in range(rlen):
+                dm = xp.where(sel, dest_m + t, ow - 1).astype(np.int32)
+                dm = xp.clip(dm, 0, ow - 1)
+                val = xp.where(sel, rpat[t], np.uint8(0))
+                out = out.at[rows, dm].max(val) if hasattr(out, "at") \
+                    else _np_scatter(out, rows, dm, None, sel, fill=rpat[t])
+        jo = xp.arange(ow, dtype=np.int32)[None, :]
+        out = xp.where(jo < out_len[:, None], out, np.uint8(0))
+        return Vec(T.STRING, out, validity, out_len)
+
+
+def _np_scatter(out, rows, cols, data, mask, fill=None):
+    src = np.where(mask, data if fill is None else fill, 0).astype(np.uint8)
+    np.maximum.at(out, (rows, cols), src)
+    return out
+
+
+class StringTranslate(Expression):
+    """translate(str, from, to) — from/to literal ASCII; chars in `from`
+    beyond len(to) are deleted."""
+
+    def __init__(self, child: Expression, matching: Expression,
+                 replace: Expression):
+        super().__init__([child, matching, replace])
+        self.matching = _lit_str(matching)
+        self.replace = _lit_str(replace)
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _compute(self, ctx: EvalContext, c: Vec, mv: Vec, rv: Vec) -> Vec:
+        xp = ctx.xp
+        frm = (self.matching or "").encode("utf-8")
+        to = (self.replace or "").encode("utf-8")
+        lut = np.arange(256, dtype=np.int32)  # identity; -1 = delete
+        seen = set()
+        for i, b in enumerate(frm):
+            if b in seen:
+                continue
+            seen.add(b)
+            lut[b] = to[i] if i < len(to) else -1
+        lut_dev = xp.asarray(lut)
+        n, w = c.data.shape
+        mapped = lut_dev[c.data.astype(np.int32)]
+        in_row = _pos_mask(xp, c.data, c.lengths)
+        keep = in_row & (mapped >= 0)
+        # row-wise stable compaction of kept bytes
+        j = xp.arange(w, dtype=np.int32)[None, :]
+        order = xp.argsort(xp.where(keep, j, w + j), axis=1, stable=True)
+        data = xp.take_along_axis(
+            xp.where(keep, mapped, 0).astype(xp.uint8), order, axis=1)
+        out_len = xp.sum(keep, axis=1).astype(np.int32)
+        data = xp.where(j < out_len[:, None], data, np.uint8(0))
+        validity = and_validity(xp, c.validity, mv.validity, rv.validity)
+        return Vec(T.STRING, data, validity, out_len)
+
+
+class StringReverse(Expression):
+    """reverse(str) — character-aware (UTF-8 sequences stay intact)."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _compute(self, ctx: EvalContext, c: Vec) -> Vec:
+        xp = ctx.xp
+        n, w = c.data.shape
+        in_row = _pos_mask(xp, c.data, c.lengths)
+        starts = _is_char_start(xp, c.data) & in_row
+        nchars = xp.sum(starts, axis=1).astype(np.int32)
+        char_id = xp.cumsum(starts.astype(np.int32), axis=1) - 1
+        j = xp.arange(w, dtype=np.int32)[None, :]
+        # byte offset within its char = j - (start byte of this char),
+        # where the char start per position is a running max of start marks
+        start_pos = xp.where(starts, j, -1)
+        char_start = _cummax(xp, start_pos)
+        within = j - char_start
+        new_char = xp.where(in_row, nchars[:, None] - 1 - char_id, w)
+        sort_key = xp.where(in_row, new_char * w + within, w * w + j)
+        order = xp.argsort(sort_key, axis=1, stable=True)
+        data = xp.take_along_axis(c.data, order, axis=1)
+        data = xp.where(j < c.lengths[:, None], data, np.uint8(0))
+        return Vec(T.STRING, data, c.validity, c.lengths)
+
+
+def _cummax(xp, a):
+    if hasattr(xp, "lax") or xp.__name__.startswith("jax"):
+        import jax.lax as lax
+        return lax.cummax(a, axis=1)
+    return np.maximum.accumulate(a, axis=1)
+
+
+class ConcatWs(Expression):
+    """concat_ws(sep, s1, s2, ...) — literal sep; null inputs are skipped
+    (unlike concat). Null sep -> null."""
+
+    def __init__(self, sep: Expression, *children: Expression):
+        super().__init__([sep, *children])
+        self.sep = _lit_str(sep)
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    @property
+    def nullable(self):
+        return self.children[0].nullable
+
+    def _compute(self, ctx: EvalContext, sep: Vec, *vecs: Vec) -> Vec:
+        xp = ctx.xp
+        sb = (self.sep or "").encode("utf-8")
+        n = sep.data.shape[0]
+        out = Vec(T.STRING, xp.zeros((n, 8), dtype=xp.uint8),
+                  xp.ones(n, dtype=bool), xp.zeros(n, dtype=np.int32))
+        started = xp.zeros(n, dtype=bool)
+        srow = xp.asarray(np.frombuffer(sb, dtype=np.uint8)) if sb else None
+        for v in vecs:
+            eff = xp.where(v.validity, v.lengths, 0).astype(np.int32)
+            sep_eff = xp.where(started & v.validity & (len(sb) > 0),
+                               len(sb), 0).astype(np.int32)
+            out = _append(xp, out, srow, sep_eff, v, eff)
+            started = started | (v.validity)
+        return Vec(T.STRING, out.data, sep.validity, out.lengths)
+
+
+def _append(xp, out: Vec, sep_row, sep_len, v: Vec, v_len) -> Vec:
+    """out ++ sep[:sep_len] ++ v[:v_len] per row (lengths may be 0)."""
+    w1 = out.data.shape[1]
+    w2 = 0 if sep_row is None else sep_row.shape[0]
+    w3 = v.data.shape[1]
+    ow = width_bucket(w1 + w2 + w3)
+    n = out.data.shape[0]
+    j = xp.arange(ow, dtype=np.int32)[None, :]
+    l1 = out.lengths[:, None]
+    l2 = sep_len[:, None]
+    new_len = out.lengths + sep_len + v_len
+    in1 = j < l1
+    in2 = ~in1 & (j < l1 + l2)
+    pad1 = xp.pad(out.data, ((0, 0), (0, ow - w1))) if ow > w1 else out.data
+    data = xp.take_along_axis(pad1, xp.minimum(j, ow - 1), axis=1)
+    if sep_row is not None:
+        sidx = xp.clip(j - l1, 0, w2 - 1).astype(np.int32)
+        data = xp.where(in2, sep_row[sidx], data)
+    vpad = xp.pad(v.data, ((0, 0), (0, ow - w3))) if ow > w3 else v.data
+    vidx = xp.clip(j - l1 - l2, 0, ow - 1).astype(np.int32)
+    data = xp.where(~in1 & ~in2, xp.take_along_axis(vpad, vidx, axis=1), data)
+    data = xp.where(j < new_len[:, None], data, np.uint8(0))
+    return Vec(T.STRING, data, out.validity, new_len.astype(np.int32))
+
+
+class SubstringIndex(Expression):
+    """substring_index(str, delim, count) — literal delim and count."""
+
+    def __init__(self, child: Expression, delim: Expression,
+                 count: Expression):
+        super().__init__([child, delim, count])
+        self.delim = _lit_str(delim)
+        self.count = _lit_int(count)
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _compute(self, ctx: EvalContext, c: Vec, dv: Vec, cv: Vec) -> Vec:
+        xp = ctx.xp
+        db = (self.delim or "").encode("utf-8")
+        cnt = int(self.count or 0)
+        n, w = c.data.shape
+        validity = and_validity(xp, c.validity, dv.validity, cv.validity)
+        if not db or cnt == 0:
+            return Vec(T.STRING, xp.zeros((n, 8), dtype=xp.uint8), validity,
+                       xp.zeros(n, dtype=np.int32))
+        dlen = len(db)
+        pat = xp.asarray(np.frombuffer(db, dtype=np.uint8))
+        j = xp.arange(w, dtype=np.int32)[None, :]
+        m = xp.ones((n, w), dtype=bool)
+        for t in range(dlen):
+            idx = xp.minimum(j + t, w - 1)
+            m = m & (xp.take_along_axis(c.data, idx, axis=1) == pat[t])
+        m = m & ((j + dlen) <= c.lengths[:, None])
+        # non-overlapping occurrences, left to right
+        sel_cols = []
+        nxt = xp.zeros(n, dtype=np.int32)
+        for k in range(w):
+            ok = m[:, k] & (k >= nxt)
+            sel_cols.append(ok)
+            nxt = xp.where(ok, k + dlen, nxt)
+        sel = xp.stack(sel_cols, axis=1)
+        occ = xp.cumsum(sel.astype(np.int32), axis=1)
+        total = occ[:, -1]
+        if cnt > 0:
+            # bytes before the cnt-th occurrence (whole string if fewer)
+            kth = sel & (occ == cnt)
+            has = xp.any(kth, axis=1)
+            cut = xp.argmax(kth, axis=1).astype(np.int32)
+            out_len = xp.where(has, cut, c.lengths).astype(np.int32)
+            data = xp.where(j < out_len[:, None], c.data, np.uint8(0))
+            return Vec(T.STRING, data, validity, out_len)
+        # cnt < 0: bytes after the |cnt|-th occurrence from the right —
+        # the boundary is occurrence (total + cnt + 1), 1-based from the left
+        want = total + cnt + 1
+        kth = sel & (occ == xp.maximum(want, 0)[:, None])
+        has = (want >= 1)
+        start = xp.where(has,
+                         xp.argmax(kth, axis=1).astype(np.int32) + dlen, 0)
+        out_len = xp.maximum(c.lengths - start, 0).astype(np.int32)
+        idx = xp.minimum(start[:, None] + j, w - 1)
+        data = _row_gather(xp, c.data, idx, j < out_len[:, None])
+        return Vec(T.STRING, data, validity, out_len)
+
+
+class InitCap(Expression):
+    """initcap: first letter of each space-separated word uppercased, rest
+    lowercased (ASCII mapping, like Upper/Lower)."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _compute(self, ctx: EvalContext, c: Vec) -> Vec:
+        xp = ctx.xp
+        n, w = c.data.shape
+        prev = xp.pad(c.data[:, :-1], ((0, 0), (1, 0)),
+                      constant_values=0x20)
+        word_start = prev == 0x20
+        lower = (c.data >= ord("a")) & (c.data <= ord("z"))
+        upper = (c.data >= ord("A")) & (c.data <= ord("Z"))
+        up = xp.where(word_start & lower, c.data - np.uint8(32), c.data)
+        data = xp.where(~word_start & upper, up + np.uint8(32), up)
+        return Vec(T.STRING, data, c.validity, c.lengths)
+
+
+class Ascii(Expression):
+    """ascii(str): code point of the first character (0 for empty)."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def _compute(self, ctx: EvalContext, c: Vec) -> Vec:
+        xp = ctx.xp
+        b0 = c.data[:, 0].astype(np.int32)
+        w = c.data.shape[1]
+
+        def byte(i):
+            return c.data[:, min(i, w - 1)].astype(np.int32) & 0x3F
+
+        one = b0
+        two = ((b0 & 0x1F) << 6) | byte(1)
+        three = ((b0 & 0x0F) << 12) | (byte(1) << 6) | byte(2)
+        four = ((b0 & 0x07) << 18) | (byte(1) << 12) | (byte(2) << 6) | byte(3)
+        cp = xp.where(b0 < 0x80, one,
+                      xp.where(b0 < 0xE0, two,
+                               xp.where(b0 < 0xF0, three, four)))
+        cp = xp.where(c.lengths > 0, cp, 0).astype(np.int32)
+        return Vec(T.INT, cp, c.validity)
+
+
+class Chr(Expression):
+    """chr(n): character with code point n % 256 (empty for n <= 0 after
+    mod); 128..255 encode as 2-byte UTF-8 like the JVM."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _compute(self, ctx: EvalContext, c: Vec) -> Vec:
+        xp = ctx.xp
+        n = c.data.shape[0]
+        code = (c.data.astype(np.int64) % 256).astype(np.int32)
+        neg = c.data.astype(np.int64) < 0
+        code = xp.where(neg, 0, code)
+        two = code >= 0x80
+        b0 = xp.where(two, 0xC0 | (code >> 6), code).astype(xp.uint8)
+        b1 = xp.where(two, 0x80 | (code & 0x3F), 0).astype(xp.uint8)
+        data = xp.zeros((n, 8), dtype=xp.uint8)
+        data = data.at[:, 0].set(b0) if hasattr(data, "at") else \
+            _np_setcol(data, 0, b0)
+        data = data.at[:, 1].set(b1) if hasattr(data, "at") else \
+            _np_setcol(data, 1, b1)
+        lens = xp.where(code == 0, 0, xp.where(two, 2, 1)).astype(np.int32)
+        data = xp.where(xp.arange(8)[None, :] < lens[:, None], data,
+                        np.uint8(0))
+        return Vec(T.STRING, data, c.validity, lens)
+
+
+def _np_setcol(mat, j, col):
+    mat[:, j] = col
+    return mat
+
+
+class Left(Expression):
+    """left(str, n) = substring(str, 1, n)."""
+
+    def __init__(self, child: Expression, length: Expression):
+        super().__init__([child, length])
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _compute(self, ctx: EvalContext, c: Vec, lv: Vec) -> Vec:
+        xp = ctx.xp
+        ones = Vec(T.INT, xp.ones(c.data.shape[0], dtype=np.int32),
+                   xp.ones(c.data.shape[0], dtype=bool))
+        return Substring._compute(self, ctx, c, ones, lv)
+
+
+class Right(Expression):
+    """right(str, n) = substring(str, -n, n); n <= 0 -> empty."""
+
+    def __init__(self, child: Expression, length: Expression):
+        super().__init__([child, length])
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _compute(self, ctx: EvalContext, c: Vec, lv: Vec) -> Vec:
+        xp = ctx.xp
+        nlen = xp.maximum(lv.data.astype(np.int32), 0)
+        pos = Vec(T.INT, -nlen, lv.validity)
+        ln = Vec(T.INT, nlen, lv.validity)
+        out = Substring._compute(self, ctx, c, pos, ln)
+        # n == 0 -> empty (substring(s, 0, 0) is already empty); n<0 clamped
+        return out
+
+
+class StringSpace(Expression):
+    """space(n) — n literal (static output width)."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+        self.count = _lit_int(child)
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _compute(self, ctx: EvalContext, c: Vec) -> Vec:
+        xp = ctx.xp
+        n = c.data.shape[0]
+        cnt = max(int(self.count or 0), 0)
+        w = width_bucket(max(cnt, 1))
+        data = xp.full((n, w), np.uint8(0x20))
+        j = xp.arange(w, dtype=np.int32)[None, :]
+        lens = xp.full(n, cnt, dtype=np.int32)
+        data = xp.where(j < lens[:, None], data, np.uint8(0))
+        return Vec(T.STRING, data, c.validity, lens)
+
+
+class BitLength(Expression):
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def _compute(self, ctx: EvalContext, c: Vec) -> Vec:
+        return Vec(T.INT, (c.lengths * 8).astype(np.int32), c.validity)
+
+
+class OctetLength(Expression):
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def _compute(self, ctx: EvalContext, c: Vec) -> Vec:
+        return Vec(T.INT, c.lengths.astype(np.int32), c.validity)
+
+
+class FindInSet(Expression):
+    """find_in_set(str, strlist) — 1-based index of str in the comma-
+    separated strlist; 0 if absent or str contains a comma."""
+
+    def __init__(self, child: Expression, str_list: Expression):
+        super().__init__([child, str_list])
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def _compute(self, ctx: EvalContext, s: Vec, lst: Vec) -> Vec:
+        xp = ctx.xp
+        ds, dl = pad_common_width(xp, s, lst)
+        n, w = dl.shape
+        j = xp.arange(w, dtype=np.int32)[None, :]
+        in_list = j < lst.lengths[:, None]
+        is_comma = (dl == ord(",")) & in_list
+        # element id per byte position = number of commas before it
+        elem_id = xp.cumsum(is_comma.astype(np.int32), axis=1) - \
+            is_comma.astype(np.int32)
+        # element start positions: position 0 or right after a comma
+        prev_comma = xp.pad(is_comma[:, :-1], ((0, 0), (1, 0)),
+                            constant_values=True)
+        has_comma_in_s = xp.any((ds == ord(",")) &
+                                (j < s.lengths[:, None]), axis=1)
+        # compare element [start, start+len) with s at each element start
+        found = xp.zeros(n, dtype=np.int32)
+        slen = s.lengths
+        for k in range(w):
+            start_here = prev_comma[:, k] & (k <= lst.lengths)
+            # element ends at next comma or end of list
+            # length check: next slen bytes equal s AND the byte after is
+            # a comma or the end
+            idx = xp.clip(j + k, 0, w - 1)
+            window = xp.take_along_axis(dl, idx, axis=1)
+            in_s = j < slen[:, None]
+            eq = xp.all(~in_s | (window == ds), axis=1)
+            end_pos = k + slen
+            at_end = (end_pos == lst.lengths)
+            ecol = xp.clip(end_pos, 0, w - 1)
+            next_is_comma = xp.take_along_axis(
+                dl, ecol[:, None], axis=1)[:, 0] == ord(",")
+            ok = start_here & eq & (at_end | (next_is_comma &
+                                              (end_pos < lst.lengths)))
+            eid = elem_id[:, k] + 1
+            found = xp.where(ok & (found == 0), eid, found)
+        found = xp.where(has_comma_in_s, 0, found).astype(np.int32)
+        return Vec(T.INT, found, and_validity(xp, s.validity, lst.validity))

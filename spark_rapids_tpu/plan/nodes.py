@@ -54,8 +54,23 @@ class PhysicalPlan:
         return ""
 
 
+# set by the session before CPU execution (plugin.execute_plan): the oracle
+# raises ANSI violations eagerly during eval, like Spark's interpreted path.
+# Thread-local so concurrent sessions with different ANSI settings don't
+# corrupt each other (execute_plan materializes eagerly, so within a thread
+# the flag covers the whole consumption).
+import threading
+
+_TLS = threading.local()
+
+
+def set_ansi_mode(ansi: bool) -> None:
+    _TLS.ansi = ansi
+
+
 def _ctx(n: int) -> EvalContext:
-    return EvalContext(np, row_mask=np.ones(n, dtype=bool))
+    return EvalContext(np, ansi=getattr(_TLS, "ansi", False),
+                       row_mask=np.ones(n, dtype=bool))
 
 
 def _concat_np_padded(arrs: List[np.ndarray]) -> np.ndarray:
@@ -219,6 +234,14 @@ def _take_np(arr, idx):
     return arr[idx] if arr.ndim == 1 else arr[idx, :]
 
 
+def _scalar_of(v: Vec, i: int):
+    """Python value of row i of a primitive/string host Vec (oracle helper)."""
+    if v.is_string:
+        return bytes(v.data[i, :v.lengths[i]]).decode("utf-8", "replace")
+    val = v.data[i]
+    return val.item() if hasattr(val, "item") else val
+
+
 def _key_bytes(keys: List[Vec], n: int) -> np.ndarray:
     """Pack key columns into fixed-width row bytes for np.unique grouping.
     Recurses through nested children, zeroing garbage beyond live slots so
@@ -290,6 +313,51 @@ def _cpu_agg(func: AggregateFunction, ctx, b: HostBatch, gid, ng) -> Vec:
     valid_any = np.zeros(ng, dtype=bool)
     np.logical_or.at(valid_any, gid, v.validity)
     name = type(func).__name__
+    if name in ("VariancePop", "VarianceSamp", "StddevPop", "StddevSamp"):
+        out = np.zeros(ng, dtype=np.float64)
+        has = np.zeros(ng, dtype=bool)
+        x = v.data.astype(np.float64)
+        for g in range(ng):
+            sel = (gid == g) & v.validity
+            c = int(sel.sum())
+            if c == 0 or (func.sample and c < 2):
+                continue
+            has[g] = True
+            out[g] = np.var(x[sel], ddof=1 if func.sample else 0)
+        if func.sqrt:
+            out = np.sqrt(out)
+        return Vec(T.DOUBLE, out, has)
+    if name in ("CollectList", "CollectSet"):
+        from ..columnar.padding import width_bucket
+        lists = []
+        for g in range(ng):
+            sel = (gid == g) & v.validity
+            vals = [_scalar_of(v, i) for i in np.nonzero(sel)[0]]
+            if name == "CollectSet":
+                vals = sorted(set(vals))
+            else:
+                vals = sorted(vals)  # both engines emit value-sorted arrays
+            lists.append(vals)
+        import pyarrow as pa
+        from ..cpu.hostbatch import host_vec_from_arrow
+        arr = pa.array(lists, type=T.to_arrow(func.data_type))
+        return host_vec_from_arrow(arr)
+    if name == "ApproximatePercentile":
+        x = v.data.astype(np.float64)
+        rows = []
+        for g in range(ng):
+            sel = (gid == g) & v.validity
+            vals = np.sort(x[sel])
+            if len(vals) == 0:
+                rows.append(None)
+                continue
+            picks = [float(vals[int(round(q * (len(vals) - 1)))])
+                     for q in func.percentages]
+            rows.append(picks[0] if func.scalar else picks)
+        import pyarrow as pa
+        from ..cpu.hostbatch import host_vec_from_arrow
+        return host_vec_from_arrow(
+            pa.array(rows, type=T.to_arrow(func.data_type)))
     if name in ("Sum", "Average"):
         acc_t = np.float64 if T.is_floating(v.dtype) or name == "Average" \
             else np.int64

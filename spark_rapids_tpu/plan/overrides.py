@@ -64,13 +64,38 @@ def _tag_cast(meta: ExprMeta) -> None:
             f"cast {src.simple_string()} -> {e.to.simple_string()} is not "
             "supported on TPU")
     if meta.conf.is_ansi:
-        meta.will_not_work("ANSI-mode cast is not supported on TPU yet")
+        # numeric<->numeric ANSI casts report overflow via the kernel error
+        # flags; everything else (string parse, decimal) still falls back
+        def plain_numeric(dt):
+            return T.is_integral(dt) or T.is_floating(dt) or \
+                isinstance(dt, T.BooleanType)
+        if not (plain_numeric(src) and plain_numeric(e.to)):
+            meta.will_not_work(
+                "ANSI-mode cast beyond plain numeric types is not supported "
+                "on TPU yet")
 
 
-def _tag_ansi_arith(meta: ExprMeta) -> None:
-    if meta.conf.is_ansi:
-        meta.will_not_work(
-            f"{meta.expr.name} in ANSI mode is not supported on TPU yet")
+# ANSI arithmetic raises host-side from error flags the project/filter
+# kernels return; contexts whose kernels don't plumb the flags (agg, sort,
+# window, generate, join conditions) fall back instead (see _ansi_context_tag)
+_ANSI_RISKY = (EA.Add, EA.Subtract, EA.Multiply, EA.Divide,
+               EA.IntegralDivide, EA.Remainder, EA.Pmod, EA.UnaryMinus,
+               EA.Abs, EC.Cast)
+
+
+def _ansi_context_tag(label, exprs_of):
+    def tag(m: PlanMeta) -> None:
+        if not m.conf.is_ansi:
+            return
+        for e in exprs_of(m.plan):
+            if e is None:
+                continue
+            if e.collect(lambda x: isinstance(x, _ANSI_RISKY)):
+                m.will_not_work(
+                    f"ANSI-mode arithmetic inside {label} is not plumbed for "
+                    "error surfacing on TPU (runs on CPU)")
+                return
+    return tag
 
 
 _basic = TypeSig.all_basic()
@@ -83,11 +108,11 @@ _dbl = TypeSig((T.DoubleType,))
 for cls in (EB.Literal, EB.AttributeReference, EB.BoundReference, EB.Alias):
     expr_rule(cls, TypeSig.all_with_nested())
 for cls in (EA.Add, EA.Subtract, EA.Multiply):
-    expr_rule(cls, _num, tag_fn=_tag_ansi_arith)
+    expr_rule(cls, _num)
 for cls in (EA.Divide, EA.IntegralDivide, EA.Remainder, EA.Pmod):
-    expr_rule(cls, _num, tag_fn=_tag_ansi_arith)
+    expr_rule(cls, _num)
 for cls in (EA.UnaryMinus, EA.Abs):
-    expr_rule(cls, _num, tag_fn=_tag_ansi_arith)
+    expr_rule(cls, _num)
 for cls in (EP.EqualTo, EP.EqualNullSafe, EP.LessThan, EP.LessThanOrEqual,
             EP.GreaterThan, EP.GreaterThanOrEqual):
     expr_rule(cls, _bool)
@@ -160,8 +185,135 @@ for cls in (ECL.GetArrayItem, ECL.ElementAt, ECL.GetStructField,
     expr_rule(cls, _nested)
 expr_rule(ECL.CreateArray, _nested, tag_fn=_tag_create_array)
 expr_rule(ECL.ArrayContains, _bool, tag_fn=_tag_array_contains)
+
+
+def _tag_array_ordering(meta: ExprMeta) -> None:
+    et = meta.expr.children[0].data_type.element_type
+    if isinstance(et, (T.StringType, T.ArrayType, T.StructType, T.MapType,
+                       T.DecimalType)):
+        meta.will_not_work(
+            f"{meta.expr.name} over {et.simple_string()} elements is not "
+            "supported on TPU")
+
+
+for cls in (ECL.ArrayMin, ECL.ArrayMax):
+    expr_rule(cls, TypeSig.all_basic(), tag_fn=_tag_array_ordering)
+expr_rule(ECL.SortArray, _nested, tag_fn=_tag_array_ordering)
+
+# extended string surface (stringFunctions.scala breadth push)
+from ..expr import strings_ext as ESX  # noqa: E402
+
+
+def _lit_tag(attr, what):
+    def tag(meta: ExprMeta) -> None:
+        if getattr(meta.expr, attr, None) is None:
+            meta.will_not_work(
+                f"{meta.expr.name} requires a literal {what} on TPU "
+                "(static output width)")
+    return tag
+
+
+def _tag_pad(meta: ExprMeta) -> None:
+    if meta.expr.target is None:
+        meta.will_not_work("lpad/rpad requires a literal length on TPU")
+        return
+    if meta.expr.pad is None:
+        meta.will_not_work("lpad/rpad requires a literal pad string on TPU")
+        return
+    if any(ord(ch) > 127 for ch in meta.expr.pad):
+        meta.will_not_work("non-ASCII pad strings are not supported on TPU")
+
+
+def _tag_translate(meta: ExprMeta) -> None:
+    if meta.expr.matching is None or meta.expr.replace is None:
+        meta.will_not_work("translate requires literal from/to strings on TPU")
+        return
+    if any(ord(ch) > 127 for ch in meta.expr.matching + meta.expr.replace):
+        meta.will_not_work("non-ASCII translate arguments are not supported "
+                           "on TPU")
+
+
+def _tag_replace(meta: ExprMeta) -> None:
+    if meta.expr.search is None or meta.expr.replacement is None:
+        meta.will_not_work("replace requires literal search/replacement "
+                           "strings on TPU")
+
+
+def _tag_substring_index(meta: ExprMeta) -> None:
+    if meta.expr.delim is None or meta.expr.count is None:
+        meta.will_not_work("substring_index requires literal delimiter/count "
+                           "on TPU")
+
+
+expr_rule(ESX.StringRepeat, _str, tag_fn=_lit_tag("times", "repeat count"))
+expr_rule(ESX.StringLPad, _str, tag_fn=_tag_pad)
+expr_rule(ESX.StringRPad, _str, tag_fn=_tag_pad)
+expr_rule(ESX.StringLocate, _int)
+expr_rule(ESX.StringInstr, _int)
+expr_rule(ESX.StringReplace, _str, tag_fn=_tag_replace)
+expr_rule(ESX.StringTranslate, _str, tag_fn=_tag_translate)
+expr_rule(ESX.StringReverse, _str)
+expr_rule(ESX.ConcatWs, _str, tag_fn=_lit_tag("sep", "separator"))
+expr_rule(ESX.SubstringIndex, _str, tag_fn=_tag_substring_index)
+expr_rule(ESX.InitCap, _str, incompat=True,
+          doc="ASCII-only case mapping on device, like Upper/Lower.")
+expr_rule(ESX.Ascii, _int)
+expr_rule(ESX.Chr, _str)
+expr_rule(ESX.Left, _str)
+expr_rule(ESX.Right, _str)
+expr_rule(ESX.StringSpace, _str, tag_fn=_lit_tag("count", "count"))
+expr_rule(ESX.BitLength, _int)
+expr_rule(ESX.OctetLength, _int)
+expr_rule(ESX.FindInSet, _int)
+
+# extended math (mathExpressions.scala breadth)
+for cls in (EM.Atan2, EM.Hypot, EM.Logarithm, EM.Expm1, EM.Log1p, EM.Rint,
+            EM.Cot):
+    expr_rule(cls, _dbl, incompat=True,
+              doc="Transcendental results may differ from the JVM in ULPs.")
+expr_rule(EM.BRound, _num)
+
+# extended datetime (datetimeExpressions.scala breadth)
+expr_rule(ED.LastDay, TypeSig((T.DateType,)))
+expr_rule(ED.AddMonths, TypeSig((T.DateType,)))
+expr_rule(ED.MonthsBetween, _dbl)
+expr_rule(ED.TruncDate, TypeSig((T.DateType,)))
+expr_rule(ED.NextDay, TypeSig((T.DateType,)))
 for cls in (Sum, Count, Min, Max, Average, First, Last):
     expr_rule(cls, _basic)
+
+from ..expr.aggregates import (ApproximatePercentile, CollectList,  # noqa: E402
+                               CollectSet, StddevPop, StddevSamp, VariancePop,
+                               VarianceSamp)
+
+for cls in (VariancePop, VarianceSamp, StddevPop, StddevSamp):
+    expr_rule(cls, _dbl, incompat=True,
+              doc="Moment-form variance (sum/sumsq/count partials) can differ "
+                  "from the JVM's Welford updates in low ULPs.")
+
+
+def _tag_collect(meta: ExprMeta) -> None:
+    try:
+        ct = meta.expr.child.data_type
+    except Exception:
+        return
+    if ct.is_nested:
+        meta.will_not_work("collect of nested values is not supported on TPU")
+
+
+def _tag_percentile(meta: ExprMeta) -> None:
+    try:
+        ct = meta.expr.child.data_type
+    except Exception:
+        return
+    if not (T.is_integral(ct) or T.is_floating(ct)):
+        meta.will_not_work("approx_percentile needs a numeric input on TPU")
+
+
+for cls in (CollectList, CollectSet):
+    expr_rule(cls, TypeSig.all_with_nested(), tag_fn=_tag_collect)
+expr_rule(ApproximatePercentile, TypeSig.all_with_nested(),
+          tag_fn=_tag_percentile)
 
 
 def _tag_window_agg(meta: ExprMeta) -> None:
@@ -324,7 +476,12 @@ def _exprs_expand(m: PlanMeta):
             m.add_expr(e)
 
 
+_join_cond_ansi = _ansi_context_tag("join conditions",
+                                    lambda p: [p._bcond])
+
+
 def _tag_join(m: PlanMeta):
+    _join_cond_ansi(m)
     from ..expr.base import AttributeReference
     for e in m.plan.left_keys + m.plan.right_keys:
         if not isinstance(e, AttributeReference):
@@ -417,6 +574,9 @@ def _exprs_window(m: PlanMeta):
 
 def _tag_window(m: PlanMeta):
     from ..expr import windowexprs as WX
+    _ansi_context_tag("window", lambda p: [
+        f.children[0] if f.children else None
+        for f, _ in p._bound_fns])(m)
     has_order = bool(m.plan.order_spec)
     for f, name in m.plan._bound_fns:
         if f.requires_order and not has_order:
@@ -481,15 +641,44 @@ exec_rule(N.CpuProjectExec, TypeSig.all_with_nested(), _c_project,
           expr_fn=_exprs_project)
 exec_rule(N.CpuFilterExec, TypeSig.all_with_nested(), _c_filter,
           expr_fn=_exprs_filter)
-exec_rule(N.CpuHashAggregateExec, TypeSig.all_basic(), _c_agg,
-          expr_fn=_exprs_agg)
+_agg_ansi = _ansi_context_tag(
+    "aggregation", lambda p: list(p._bound_groups) +
+    [a.func.child for a in p._bound_aggs])
+
+
+def _tag_agg(m: PlanMeta) -> None:
+    _agg_ansi(m)
+    # nested types may only appear as collect_* OUTPUTS; nested group keys
+    # and nested aggregate inputs stay on CPU
+    for e in m.plan._bound_groups:
+        try:
+            if e.data_type.is_nested:
+                m.will_not_work("nested group-by keys are not supported "
+                                "on TPU")
+        except Exception:
+            pass
+    for a in m.plan._bound_aggs:
+        try:
+            if a.func.child is not None and a.func.child.data_type.is_nested:
+                m.will_not_work("nested aggregate inputs are not supported "
+                                "on TPU")
+        except Exception:
+            pass
+
+
+exec_rule(N.CpuHashAggregateExec, TypeSig.all_with_nested(), _c_agg,
+          expr_fn=_exprs_agg, tag_fn=_tag_agg)
 exec_rule(N.CpuHashJoinExec, TypeSig.all_with_nested(), _c_join,
           tag_fn=_tag_join, expr_fn=_exprs_join)
-exec_rule(N.CpuSortExec, TypeSig.orderable(), _c_sort, expr_fn=_exprs_sort)
+_sort_ansi = _ansi_context_tag("sort keys",
+                               lambda p: [e for e, _, _ in p._bound])
+exec_rule(N.CpuSortExec, TypeSig.orderable(), _c_sort, expr_fn=_exprs_sort,
+          tag_fn=_sort_ansi)
 exec_rule(N.CpuLimitExec, TypeSig.all_with_nested(), _c_limit)
 exec_rule(N.CpuUnionExec, TypeSig.all_with_nested(), _c_union)
+_gen_ansi = _ansi_context_tag("generate", lambda p: [p._bound])
 exec_rule(N.CpuGenerateExec, TypeSig.all_with_nested(), _c_generate,
-          expr_fn=_exprs_generate)
+          expr_fn=_exprs_generate, tag_fn=_gen_ansi)
 exec_rule(N.CpuRangeExec, TypeSig.all_basic(), _c_range)
 exec_rule(N.CpuExpandExec, TypeSig.all_basic(), _c_expand,
           expr_fn=_exprs_expand)

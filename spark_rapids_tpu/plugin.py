@@ -79,6 +79,8 @@ class TpuSession:
         from .exec.transitions import device_batch_to_host
         from .plan.nodes import _concat_host
 
+        from .plan import nodes as _nodes
+        _nodes.set_ansi_mode(self.conf.is_ansi)
         enabled = self.conf.is_sql_enabled if use_device is None else use_device
         if enabled:
             self.initialize_device()

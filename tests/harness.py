@@ -40,22 +40,29 @@ def eval_tpu(expr_factory, table: pa.Table):
     return col_to_arrow(col, batch.row_count())
 
 
+def _values_equal(a, b, approx=False):
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, float) and isinstance(b, float):
+        if math.isnan(a) or math.isnan(b):
+            return math.isnan(a) and math.isnan(b)
+        if approx:
+            return a == b or abs(a - b) <= 1e-6 * max(abs(a), abs(b))
+        return a == b
+    if isinstance(a, list) and isinstance(b, list):
+        return len(a) == len(b) and all(
+            _values_equal(x, y, approx) for x, y in zip(a, b))
+    if isinstance(a, dict) and isinstance(b, dict):
+        return a.keys() == b.keys() and all(
+            _values_equal(a[k], b[k], approx) for k in a)
+    return a == b
+
+
 def assert_arrays_equal(cpu, tpu, approx=False):
     cl, tl = cpu.to_pylist(), tpu.to_pylist()
     assert len(cl) == len(tl), f"length {len(cl)} vs {len(tl)}"
     for i, (a, b) in enumerate(zip(cl, tl)):
-        if a is None or b is None:
-            assert a is None and b is None, f"row {i}: {a!r} vs {b!r}"
-        elif isinstance(a, float):
-            if math.isnan(a) or math.isnan(b):
-                assert math.isnan(a) and math.isnan(b), f"row {i}: {a!r} vs {b!r}"
-            elif approx:
-                assert a == b or abs(a - b) <= 1e-6 * max(abs(a), abs(b)), \
-                    f"row {i}: {a!r} vs {b!r}"
-            else:
-                assert a == b, f"row {i}: {a!r} vs {b!r}"
-        else:
-            assert a == b, f"row {i}: {a!r} vs {b!r}"
+        assert _values_equal(a, b, approx), f"row {i}: {a!r} vs {b!r}"
 
 
 def assert_cpu_tpu_equal(expr_factory, table: pa.Table, approx=False):

@@ -1,0 +1,175 @@
+"""ANSI mode: Spark-exact overflow / division-by-zero / cast-overflow errors
+on BOTH engines — the device raises host-side from kernel error flags, the
+CPU oracle raises eagerly (reference: AnsiCastOpSuite, arithmetic ANSI
+tagging in GpuOverrides)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.errors import AnsiViolation
+from spark_rapids_tpu.expr import (Abs, Add, Cast, Divide, IntegralDivide,
+                                   Multiply, Pmod, Remainder, Subtract, Sum,
+                                   UnaryMinus, col, lit)
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.plugin import TpuSession
+
+
+@pytest.fixture(scope="module")
+def ansi_session():
+    return TpuSession({"spark.rapids.sql.enabled": True,
+                       "spark.rapids.sql.explain": "NONE",
+                       "spark.sql.ansi.enabled": True})
+
+
+L = lambda *v: pa.array(v, type=pa.int64())
+I = lambda *v: pa.array(v, type=pa.int32())
+D = lambda *v: pa.array(v, type=pa.float64())
+
+
+def _raises_both(session, q):
+    with pytest.raises(AnsiViolation):
+        q.collect()
+    with pytest.raises(AnsiViolation):
+        q.collect_cpu()
+
+
+class TestAnsiArithmetic:
+    def test_add_long_overflow_raises(self, ansi_session):
+        df = ansi_session.from_arrow(pa.table({"a": L(2**62, 1)}))
+        _raises_both(ansi_session, df.select(x=Add(col("a"), col("a"))))
+
+    def test_subtract_overflow_raises(self, ansi_session):
+        df = ansi_session.from_arrow(pa.table({"a": L(-2**63, 0)}))
+        _raises_both(ansi_session, df.select(x=Subtract(col("a"), lit(1))))
+
+    def test_multiply_overflow_raises(self, ansi_session):
+        df = ansi_session.from_arrow(pa.table({"a": L(2**32, 3)}))
+        _raises_both(ansi_session, df.select(x=Multiply(col("a"), col("a"))))
+
+    def test_no_overflow_ok_and_exact(self, ansi_session):
+        df = ansi_session.from_arrow(pa.table({"a": L(2**61, -5, None)}))
+        q = df.select(x=Add(col("a"), lit(1)))
+        assert q.collect().column("x").to_pylist() == \
+            q.collect_cpu().column("x").to_pylist() == [2**61 + 1, -4, None]
+
+    def test_null_inputs_do_not_raise(self, ansi_session):
+        # overflow pattern sits under a NULL: no error (Spark skips nulls)
+        df = ansi_session.from_arrow(pa.table(
+            {"a": pa.array([2**62, None], type=pa.int64()),
+             "b": pa.array([None, 2**62], type=pa.int64())}))
+        q = df.select(x=Add(col("a"), col("b")))
+        assert q.collect().column("x").to_pylist() == [None, None]
+
+    def test_divide_by_zero_raises(self, ansi_session):
+        df = ansi_session.from_arrow(pa.table({"a": D(1.0, 2.0),
+                                               "b": D(2.0, 0.0)}))
+        _raises_both(ansi_session, df.select(x=Divide(col("a"), col("b"))))
+
+    def test_integral_divide_by_zero_and_overflow(self, ansi_session):
+        df = ansi_session.from_arrow(pa.table({"a": L(5), "b": L(0)}))
+        _raises_both(ansi_session,
+                     df.select(x=IntegralDivide(col("a"), col("b"))))
+        df = ansi_session.from_arrow(pa.table({"a": L(-2**63), "b": L(-1)}))
+        _raises_both(ansi_session,
+                     df.select(x=IntegralDivide(col("a"), col("b"))))
+
+    def test_remainder_pmod_by_zero(self, ansi_session):
+        df = ansi_session.from_arrow(pa.table({"a": L(5), "b": L(0)}))
+        _raises_both(ansi_session, df.select(x=Remainder(col("a"), col("b"))))
+        _raises_both(ansi_session, df.select(x=Pmod(col("a"), col("b"))))
+
+    def test_unary_minus_abs_min_value(self, ansi_session):
+        df = ansi_session.from_arrow(pa.table({"a": L(-2**63)}))
+        _raises_both(ansi_session, df.select(x=UnaryMinus(col("a"))))
+        _raises_both(ansi_session, df.select(x=Abs(col("a"))))
+
+    def test_filter_condition_raises(self, ansi_session):
+        df = ansi_session.from_arrow(pa.table({"a": L(2**62)}))
+        q = df.filter(Add(col("a"), col("a")) > lit(0))
+        _raises_both(ansi_session, q)
+
+
+class TestAnsiCast:
+    def test_float_to_int_overflow_raises(self, ansi_session):
+        df = ansi_session.from_arrow(pa.table({"a": D(1e20, 1.0)}))
+        _raises_both(ansi_session,
+                     df.select(x=Cast(col("a"), T.INT)))
+
+    def test_nan_to_int_raises(self, ansi_session):
+        df = ansi_session.from_arrow(pa.table({"a": D(float("nan"))}))
+        _raises_both(ansi_session, df.select(x=Cast(col("a"), T.LONG)))
+
+    def test_long_to_int_narrowing_overflow(self, ansi_session):
+        df = ansi_session.from_arrow(pa.table({"a": L(2**40, 7)}))
+        _raises_both(ansi_session, df.select(x=Cast(col("a"), T.INT)))
+
+    def test_in_range_casts_ok(self, ansi_session):
+        df = ansi_session.from_arrow(pa.table({"a": D(1.9, -2.9, None)}))
+        q = df.select(x=Cast(col("a"), T.INT))
+        assert q.collect().column("x").to_pylist() == \
+            q.collect_cpu().column("x").to_pylist() == [1, -2, None]
+
+
+class TestAnsiLazyBranches:
+    def test_guarded_division_in_if_does_not_raise(self, ansi_session):
+        from spark_rapids_tpu.expr import If, EqualTo
+        df = ansi_session.from_arrow(pa.table({"x": L(10, 10),
+                                               "d": L(0, 2)}))
+        q = df.select(r=If(EqualTo(col("d"), lit(0)), lit(None, T.DOUBLE),
+                           Divide(col("x"), col("d"))))
+        assert q.collect().column("r").to_pylist() == \
+            q.collect_cpu().column("r").to_pylist() == [None, 5.0]
+
+    def test_guarded_overflow_in_case_when_does_not_raise(self, ansi_session):
+        from spark_rapids_tpu.expr import CaseWhen, LessThan
+        df = ansi_session.from_arrow(pa.table({"a": L(2**62, 5)}))
+        q = df.select(r=CaseWhen(
+            [(LessThan(col("a"), lit(100)), Add(col("a"), col("a")))],
+            lit(-1, T.LONG)))
+        assert q.collect().column("r").to_pylist() == [-1, 10]
+
+    def test_unguarded_branch_still_raises(self, ansi_session):
+        from spark_rapids_tpu.expr import If, EqualTo
+        df = ansi_session.from_arrow(pa.table({"x": L(10), "d": L(0)}))
+        q = df.select(r=If(EqualTo(col("d"), lit(99)),
+                           lit(None, T.DOUBLE), Divide(col("x"), col("d"))))
+        _raises_both(ansi_session, q)
+
+    def test_trunc_invalid_format_is_null(self, ansi_session):
+        import datetime as dt
+        from spark_rapids_tpu.expr import TruncDate
+        df = ansi_session.from_arrow(pa.table(
+            {"d": pa.array([dt.date(2020, 5, 15)], type=pa.date32())}))
+        q = df.select(r=TruncDate(col("d"), "DD"))
+        assert q.collect().column("r").to_pylist() == [None]
+
+    def test_ansi_cast_in_agg_falls_back(self, ansi_session):
+        # Cast is ANSI-risky: inside an aggregation it must fall back (the
+        # agg kernel does not surface error flags) yet stay correct
+        df = ansi_session.from_arrow(pa.table({"k": I(1, 1),
+                                               "a": L(5, 6)}))
+        q = df.group_by("k").agg(s=Sum(Cast(col("a"), T.INT)))
+        assert q.collect().column("s").to_pylist() == [11]
+        df2 = ansi_session.from_arrow(pa.table({"k": I(1), "a": L(2**40)}))
+        q2 = df2.group_by("k").agg(s=Sum(Cast(col("a"), T.INT)))
+        with pytest.raises(AnsiViolation):
+            q2.collect()
+
+
+class TestAnsiContextFallback:
+    def test_agg_with_arithmetic_falls_back_but_correct(self, ansi_session):
+        # arithmetic inside an aggregation is not plumbed for device error
+        # flags: the planner keeps it on CPU, results still correct
+        df = ansi_session.from_arrow(pa.table({"k": I(1, 1, 2),
+                                               "a": L(1, 2, 3)}))
+        q = df.group_by("k").agg(s=Sum(Add(col("a"), lit(1))))
+        tpu = q.collect().sort_by("k")
+        assert tpu.column("s").to_pylist() == [5, 4]
+
+    def test_agg_arithmetic_raises_on_cpu_path(self, ansi_session):
+        df = ansi_session.from_arrow(pa.table({"k": I(1, 1), "a": L(2**62,
+                                                                    2**62)}))
+        q = df.group_by("k").agg(s=Sum(Add(col("a"), col("a"))))
+        with pytest.raises(AnsiViolation):
+            q.collect()  # falls back to the CPU path, which raises eagerly

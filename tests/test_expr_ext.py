@@ -1,0 +1,285 @@
+"""Extended expression surface: string breadth, math, datetime, array ops —
+CPU-vs-TPU differential plus handwritten Spark-semantic expectations
+(values cross-checked against Spark 3.x behavior)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.expr import (
+    AddMonths, ArrayMax, ArrayMin, Ascii, Atan2, BitLength, BRound, Chr,
+    ConcatWs, Cot, Expm1, FindInSet, Hypot, InitCap, LastDay, Left, Log1p,
+    Logarithm, MonthsBetween, NextDay, OctetLength, Right, Rint, SortArray,
+    StringInstr, StringLocate, StringLPad, StringRepeat, StringReplace,
+    StringReverse, StringRPad, StringSpace, SubstringIndex, StringTranslate,
+    TruncDate, col, lit)
+
+from harness import assert_cpu_tpu_equal
+
+S = lambda *v: pa.array(v, type=pa.string())
+I = lambda *v: pa.array(v, type=pa.int32())
+D = lambda *v: pa.array(v, type=pa.float64())
+
+
+def t(**cols):
+    return pa.table(dict(cols))
+
+
+def dates(*v):
+    return pa.array(v, type=pa.date32())
+
+
+class TestStringBreadth:
+    def test_repeat(self):
+        out = assert_cpu_tpu_equal(
+            lambda: StringRepeat(col("s"), lit(3)),
+            t(s=S("ab", "", None, "xyz")))
+        assert out.to_pylist() == ["ababab", "", None, "xyzxyzxyz"]
+
+    def test_lpad_rpad(self):
+        out = assert_cpu_tpu_equal(
+            lambda: StringLPad(col("s"), lit(5), lit("*-")),
+            t(s=S("ab", "abcdef", "", None)))
+        assert out.to_pylist() == ["*-*ab", "abcde", "*-*-*", None]
+        out = assert_cpu_tpu_equal(
+            lambda: StringRPad(col("s"), lit(5), lit("xy")),
+            t(s=S("ab", "abcdef", "")))
+        assert out.to_pylist() == ["abxyx", "abcde", "xyxyx"]
+
+    def test_lpad_utf8_truncation(self):
+        out = assert_cpu_tpu_equal(
+            lambda: StringLPad(col("s"), lit(3), lit(".")),
+            t(s=S("héllo", "é")))
+        assert out.to_pylist() == ["hél", "..é"]
+
+    def test_locate_instr(self):
+        out = assert_cpu_tpu_equal(
+            lambda: StringLocate(lit("bar"), col("s"), lit(1)),
+            t(s=S("foobarbar", "foo", None, "barbar")))
+        assert out.to_pylist() == [4, 0, None, 1]
+        out = assert_cpu_tpu_equal(
+            lambda: StringLocate(lit("bar"), col("s"), lit(5)),
+            t(s=S("foobarbar", "barbar")))
+        assert out.to_pylist() == [7, 0]
+        out = assert_cpu_tpu_equal(
+            lambda: StringInstr(col("s"), lit("ar")),
+            t(s=S("foobar", "xx")))
+        assert out.to_pylist() == [5, 0]
+
+    def test_locate_utf8_positions(self):
+        out = assert_cpu_tpu_equal(
+            lambda: StringLocate(lit("ll"), col("s"), lit(1)),
+            t(s=S("héllo")))
+        assert out.to_pylist() == [3]  # char positions, not bytes
+
+    def test_replace(self):
+        out = assert_cpu_tpu_equal(
+            lambda: StringReplace(col("s"), lit("ab"), lit("XYZ")),
+            t(s=S("ababab", "xabx", "", None, "aab")))
+        assert out.to_pylist() == ["XYZXYZXYZ", "xXYZx", "", None, "aXYZ"]
+
+    def test_replace_delete(self):
+        out = assert_cpu_tpu_equal(
+            lambda: StringReplace(col("s"), lit("aa"), lit("")),
+            t(s=S("aaaa", "baaab", "aaa")))
+        assert out.to_pylist() == ["", "bab", "a"]  # java semantics: scan resumes AFTER each match
+
+    def test_translate(self):
+        out = assert_cpu_tpu_equal(
+            lambda: StringTranslate(col("s"), lit("abc"), lit("xy")),
+            t(s=S("aabbcc", "cab", None)))
+        assert out.to_pylist() == ["xxyy", "xy", None]
+
+    def test_reverse(self):
+        out = assert_cpu_tpu_equal(
+            lambda: StringReverse(col("s")),
+            t(s=S("abc", "", None, "héllo")))
+        assert out.to_pylist() == ["cba", "", None, "olléh"]
+
+    def test_concat_ws_skips_nulls(self):
+        out = assert_cpu_tpu_equal(
+            lambda: ConcatWs(lit(","), col("a"), col("b"), col("c")),
+            t(a=S("x", None, None), b=S("y", "q", None), c=S(None, "r", None)))
+        assert out.to_pylist() == ["x,y", "q,r", ""]
+
+    def test_substring_index(self):
+        out = assert_cpu_tpu_equal(
+            lambda: SubstringIndex(col("s"), lit("."), lit(2)),
+            t(s=S("a.b.c.d", "abc", "", None)))
+        assert out.to_pylist() == ["a.b", "abc", "", None]
+        out = assert_cpu_tpu_equal(
+            lambda: SubstringIndex(col("s"), lit("."), lit(-2)),
+            t(s=S("a.b.c.d", "abc")))
+        assert out.to_pylist() == ["c.d", "abc"]
+
+    def test_initcap(self):
+        out = assert_cpu_tpu_equal(
+            lambda: InitCap(col("s")),
+            t(s=S("spark sql", "SPARK  SQL", "x", None)))
+        assert out.to_pylist() == ["Spark Sql", "Spark  Sql", "X", None]
+
+    def test_ascii_chr(self):
+        out = assert_cpu_tpu_equal(
+            lambda: Ascii(col("s")), t(s=S("A", "", "abc", "é", None)))
+        assert out.to_pylist() == [65, 0, 97, 233, None]
+        out = assert_cpu_tpu_equal(
+            lambda: Chr(col("n")), t(n=pa.array([65, 97, 0, 256 + 66, 233],
+                                                type=pa.int64())))
+        assert out.to_pylist() == ["A", "a", "", "B", "é"]
+
+    def test_left_right(self):
+        out = assert_cpu_tpu_equal(
+            lambda: Left(col("s"), lit(3)), t(s=S("abcdef", "ab", None, "")))
+        assert out.to_pylist() == ["abc", "ab", None, ""]
+        out = assert_cpu_tpu_equal(
+            lambda: Right(col("s"), lit(3)), t(s=S("abcdef", "ab", None, "")))
+        assert out.to_pylist() == ["def", "ab", None, ""]
+
+    def test_space_bit_octet(self):
+        out = assert_cpu_tpu_equal(lambda: StringSpace(lit(4)),
+                                   t(s=S("x", "y")))
+        assert out.to_pylist() == ["    ", "    "]
+        out = assert_cpu_tpu_equal(lambda: BitLength(col("s")),
+                                   t(s=S("abc", "", "é", None)))
+        assert out.to_pylist() == [24, 0, 16, None]
+        out = assert_cpu_tpu_equal(lambda: OctetLength(col("s")),
+                                   t(s=S("abc", "é", None)))
+        assert out.to_pylist() == [3, 2, None]
+
+    def test_find_in_set(self):
+        out = assert_cpu_tpu_equal(
+            lambda: FindInSet(col("s"), lit("ab,cd,ef")),
+            t(s=S("cd", "ab", "ef", "x", "", "a,b", None)))
+        assert out.to_pylist() == [2, 1, 3, 0, 0, 0, None]
+
+    def test_find_in_set_empty_element(self):
+        out = assert_cpu_tpu_equal(
+            lambda: FindInSet(col("s"), lit("ab,,cd")),
+            t(s=S("", "cd")))
+        assert out.to_pylist() == [2, 3]
+
+
+class TestMathBreadth:
+    def test_atan2_hypot(self):
+        out = assert_cpu_tpu_equal(lambda: Atan2(col("a"), col("b")),
+                                   t(a=D(1.0, 0.0, None), b=D(1.0, -1.0, 2.0)))
+        exp = [np.arctan2(1.0, 1.0), np.arctan2(0.0, -1.0), None]
+        got = out.to_pylist()
+        assert got[2] is None and \
+            np.allclose(got[:2], exp[:2], rtol=1e-12)
+        out = assert_cpu_tpu_equal(lambda: Hypot(col("a"), col("b")),
+                                   t(a=D(3.0, 5.0), b=D(4.0, 12.0)))
+        assert np.allclose(out.to_pylist(), [5.0, 13.0], rtol=1e-12)
+
+    def test_logarithm_domain(self):
+        out = assert_cpu_tpu_equal(lambda: Logarithm(lit(2.0), col("x")),
+                                   t(x=D(8.0, 0.0, -1.0, None)))
+        got = out.to_pylist()
+        assert abs(got[0] - 3.0) < 1e-12
+        assert got[1] is None and got[2] is None and got[3] is None
+
+    def test_expm1_log1p_rint_cot(self):
+        out = assert_cpu_tpu_equal(lambda: Expm1(col("x")), t(x=D(0.0, 1.0)),
+                                   approx=True)
+        assert np.allclose(out.to_pylist(), [0.0, np.expm1(1.0)], rtol=1e-12)
+        out = assert_cpu_tpu_equal(lambda: Log1p(col("x")),
+                                   t(x=D(0.0, -2.0)))
+        assert out.to_pylist()[1] is None
+        out = assert_cpu_tpu_equal(lambda: Rint(col("x")),
+                                   t(x=D(2.5, 3.5, -2.5)))
+        assert out.to_pylist() == [2.0, 4.0, -2.0]  # half-even
+        out = assert_cpu_tpu_equal(lambda: Cot(col("x")), t(x=D(1.0)),
+                                   approx=True)
+        assert np.allclose(out.to_pylist(), [1 / np.tan(1.0)], rtol=1e-12)
+
+    def test_bround_half_even(self):
+        out = assert_cpu_tpu_equal(lambda: BRound(col("x"), 0),
+                                   t(x=D(2.5, 3.5, -2.5, 1.25)))
+        assert out.to_pylist() == [2.0, 4.0, -2.0, 1.0]
+        out = assert_cpu_tpu_equal(lambda: BRound(col("x"), 1),
+                                   t(x=D(1.25, 1.35)), approx=True)
+        got = out.to_pylist()
+        assert abs(got[0] - 1.2) < 1e-9 and abs(got[1] - 1.4) < 1e-9
+
+
+class TestDatetimeBreadth:
+    def test_last_day(self):
+        import datetime as dt
+        out = assert_cpu_tpu_equal(
+            lambda: LastDay(col("d")),
+            t(d=dates(dt.date(2020, 2, 10), dt.date(2021, 2, 1),
+                      dt.date(2020, 12, 31), None)))
+        assert out.to_pylist() == [dt.date(2020, 2, 29), dt.date(2021, 2, 28),
+                                   dt.date(2020, 12, 31), None]
+
+    def test_add_months_clamps(self):
+        import datetime as dt
+        out = assert_cpu_tpu_equal(
+            lambda: AddMonths(col("d"), lit(1)),
+            t(d=dates(dt.date(2020, 1, 31), dt.date(2020, 2, 29), None)))
+        assert out.to_pylist() == [dt.date(2020, 2, 29),
+                                   dt.date(2020, 3, 29), None]
+
+    def test_months_between(self):
+        import datetime as dt
+        out = assert_cpu_tpu_equal(
+            lambda: MonthsBetween(col("a"), col("b")),
+            t(a=dates(dt.date(2020, 3, 31), dt.date(2020, 3, 15)),
+              b=dates(dt.date(2020, 1, 31), dt.date(2020, 1, 15))))
+        assert out.to_pylist() == [2.0, 2.0]
+        out = assert_cpu_tpu_equal(
+            lambda: MonthsBetween(col("a"), col("b")),
+            t(a=dates(dt.date(2020, 2, 1)), b=dates(dt.date(2020, 1, 10))))
+        assert abs(out.to_pylist()[0] - (1 + (1 - 10) / 31.0)) < 1e-8
+
+    def test_trunc_date(self):
+        import datetime as dt
+        d = dates(dt.date(2020, 5, 15), dt.date(2020, 11, 3), None)
+        for fmt, exp in [("YEAR", [dt.date(2020, 1, 1), dt.date(2020, 1, 1),
+                                   None]),
+                         ("MM", [dt.date(2020, 5, 1), dt.date(2020, 11, 1),
+                                 None]),
+                         ("QUARTER", [dt.date(2020, 4, 1),
+                                      dt.date(2020, 10, 1), None]),
+                         ("WEEK", [dt.date(2020, 5, 11),
+                                   dt.date(2020, 11, 2), None])]:
+            out = assert_cpu_tpu_equal(lambda: TruncDate(col("d"), fmt),
+                                       t(d=d))
+            assert out.to_pylist() == exp, fmt
+
+    def test_next_day(self):
+        import datetime as dt
+        out = assert_cpu_tpu_equal(
+            lambda: NextDay(col("d"), "MON"),
+            # 2020-05-15 is a Friday; next Monday = 05-18
+            t(d=dates(dt.date(2020, 5, 15), dt.date(2020, 5, 18))))
+        assert out.to_pylist() == [dt.date(2020, 5, 18),
+                                   dt.date(2020, 5, 25)]
+
+
+class TestArrayOps:
+    def arr(self, *v):
+        return pa.array(v, type=pa.list_(pa.int64()))
+
+    def test_array_min_max(self):
+        data = self.arr([3, 1, 2], [], None, [5, None, -7])
+        out = assert_cpu_tpu_equal(lambda: ArrayMin(col("a")), t(a=data))
+        assert out.to_pylist() == [1, None, None, -7]
+        out = assert_cpu_tpu_equal(lambda: ArrayMax(col("a")), t(a=data))
+        assert out.to_pylist() == [3, None, None, 5]
+
+    def test_sort_array(self):
+        data = self.arr([3, 1, None, 2], [], None)
+        out = assert_cpu_tpu_equal(lambda: SortArray(col("a")), t(a=data))
+        assert out.to_pylist() == [[None, 1, 2, 3], [], None]
+        out = assert_cpu_tpu_equal(lambda: SortArray(col("a"), False),
+                                   t(a=data))
+        assert out.to_pylist() == [[3, 2, 1, None], [], None]
+
+    def test_sort_array_floats_nan(self):
+        data = pa.array([[2.5, float("nan"), -1.0, float("inf")]],
+                        type=pa.list_(pa.float64()))
+        out = assert_cpu_tpu_equal(lambda: SortArray(col("a")), t(a=data))
+        got = out.to_pylist()[0]
+        assert got[0] == -1.0 and got[1] == 2.5 and got[2] == float("inf") \
+            and got[3] != got[3]  # NaN sorts largest
