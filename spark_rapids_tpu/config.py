@@ -208,6 +208,9 @@ register("spark.rapids.cloudSchemes", "string", "s3,s3a,s3n,wasbs,gs,abfs,abfss"
          "URI schemes treated as cloud stores; selects MULTITHREADED reader under AUTO.")
 
 # Planning --------------------------------------------------------------------------
+register("spark.rapids.sql.adaptive.enabled", "bool", False,
+         "AQE analog: materialize each exchange stage, observe its row count, "
+         "and re-run the override planning (and CBO) on the remaining plan.")
 register("spark.rapids.sql.optimizer.enabled", "bool", False,
          "Cost-based optimizer: may move plan sections back to CPU to avoid "
          "transition thrash (reference CostBasedOptimizer).")

@@ -188,15 +188,19 @@ class TpuExpandExec(UnaryTpuExec):
         tps = tuple(e.data_type for e in self._bound[0])
         self._schema = Schema(tuple(names), tps)
         bound = self._bound
+        self._err_msgs: list = []
+        msgs_box = self._err_msgs
 
         @jax.jit
         def kernel(batch: ColumnarBatch):
+            from .base import kernel_errors
             ctx = device_ctx(batch, self.conf)
             vecs = batch_vecs(batch)
-            return [vecs_to_batch(self._schema,
+            outs = [vecs_to_batch(self._schema,
                                   [e.eval(ctx, vecs) for e in proj],
                                   batch.num_rows)
                     for proj in bound]
+            return outs, kernel_errors(ctx, msgs_box)
 
         self._kernel = kernel
 
@@ -205,9 +209,11 @@ class TpuExpandExec(UnaryTpuExec):
         return self._schema
 
     def do_execute(self):
+        from .base import raise_kernel_errors
         for b in self.child.execute():
             with self.op_time.timed():
-                outs = self._kernel(b)
+                outs, errs = self._kernel(b)
+            raise_kernel_errors(errs, self._err_msgs)
             for out in outs:
                 self.num_output_rows.add(out.row_count())
                 yield self._count_output(out)

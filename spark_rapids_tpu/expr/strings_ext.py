@@ -118,10 +118,7 @@ class _Pad(Expression):
             data = xp.take_along_axis(spad, src_str, axis=1)
             if len(pad):
                 pidx = (j % len(pad)).astype(np.int32)
-                fill = pad_row[pidx]
-                fill = xp.broadcast_to(fill, (n, ow))
-                # cycle must restart at the pad boundary, position within pad
-                pidx2 = (j % len(pad))
+                fill = xp.broadcast_to(pad_row[pidx], (n, ow))
                 data = xp.where(is_pad, fill, data)
         else:
             is_pad = (j >= str_bytes[:, None])

@@ -1,0 +1,52 @@
+"""Adaptive query execution analog (reference: AQE query stages re-planned
+per exchange, `GpuTransitionOverrides.optimizeAdaptiveTransitions`
+`GpuTransitionOverrides.scala:80`, `GpuCustomShuffleReaderExec.scala`).
+
+Spark's AQE materializes each shuffle stage, observes its statistics, and
+re-optimizes the remaining plan. The analog here: execute the deepest
+exchange's child as its own query stage, replace it with an in-memory scan
+carrying the OBSERVED rows, and re-run the override planning (including the
+cost-based optimizer, whose row estimates are now exact at that boundary).
+Loop until no unstaged exchange remains."""
+
+from __future__ import annotations
+
+import copy
+
+from . import nodes as N
+
+__all__ = ["adaptive_execute"]
+
+
+def _clone_plan(plan):
+    """Shallow-clone every node with fresh children lists so staging never
+    mutates the caller-owned logical plan (bound expressions, schemas, and
+    source tables are immutable and safely shared)."""
+    node = copy.copy(plan)
+    node.children = [_clone_plan(c) for c in plan.children]
+    return node
+
+
+def _find_deepest_exchange(plan, staged: set):
+    """Deepest exchange not yet materialized (children contain none)."""
+    for c in plan.children:
+        found = _find_deepest_exchange(c, staged)
+        if found is not None:
+            return found
+    if isinstance(plan, N.CpuShuffleExchangeExec) and id(plan) not in staged:
+        return plan
+    return None
+
+
+def adaptive_execute(session, plan, use_device=None):
+    """Stage-at-a-time execution; returns the final pyarrow Table."""
+    plan = _clone_plan(plan)
+    staged: set = set()
+    while True:
+        exch = _find_deepest_exchange(plan, staged)
+        if exch is None:
+            return session._execute_rewritten(plan, use_device)
+        stage_result = session._execute_rewritten(exch.children[0],
+                                                  use_device)
+        exch.children = [N.CpuScanExec(stage_result, label="query-stage")]
+        staged.add(id(exch))

@@ -1,0 +1,105 @@
+"""Cost-based optimizer (reference `CostBasedOptimizer.scala`:
+CostBasedOptimizer `:54`, CpuCostModel `:284`, GpuCostModel `:334`).
+
+Decides, over the tagged meta tree, whether sections that COULD run on device
+should stay on CPU because transition costs would dominate — the classic case
+being a cheap tail stranded between a forced-CPU operator and the host
+collect, which would otherwise bounce host -> device -> host for nothing.
+
+Model: per-row operator costs (cpuExecCost / gpuExecCost) plus a per-row
+CPU<->TPU boundary cost (transitionCost), over static row estimates (exact at
+in-memory scans, heuristic elsewhere — the AQE re-plan in plan/adaptive.py
+replaces executed stages with materialized scans, making these exact).
+Optimal placement via dynamic programming: each node's best cost is computed
+for both placements, then a top-down walk fixes the cheaper side; nodes placed
+on CPU despite being device-capable get a cost-prevention tag, exactly the
+reference's `costPreventsRunningOnGpu`."""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Tuple
+
+from ..config import TpuConf
+from . import nodes as N
+from .meta import PlanMeta
+
+__all__ = ["optimize", "row_estimate"]
+
+_COST_REASON = ("the cost-based optimizer kept this on CPU "
+                "(transition cost dominates the device speedup)")
+
+
+def _estimate_from(plan, kids) -> float:
+    """Cardinality of one node given its children's estimates."""
+    if isinstance(plan, N.CpuScanExec):
+        return float(plan.table.num_rows)
+    if isinstance(plan, N.CpuRangeExec):
+        return float(max(0, (plan.end - plan.start) // max(plan.step, 1)))
+    if not kids:
+        return 1000.0
+    if isinstance(plan, N.CpuFilterExec):
+        return kids[0] * 0.5
+    if isinstance(plan, N.CpuLimitExec):
+        return float(min(plan.limit, kids[0]))
+    if isinstance(plan, N.CpuUnionExec):
+        return float(sum(kids))
+    if isinstance(plan, N.CpuHashAggregateExec):
+        return max(kids[0] / 8.0, 1.0) if plan.group_exprs else 1.0
+    if isinstance(plan, N.CpuHashJoinExec):
+        if not plan.left_keys:  # cartesian / nested loop
+            return kids[0] * kids[1]
+        return float(max(kids))
+    if isinstance(plan, N.CpuGenerateExec):
+        return kids[0] * 4.0
+    return kids[0]
+
+
+def row_estimate(plan) -> float:
+    """Heuristic output cardinality (exact for in-memory scans)."""
+    return _estimate_from(plan, [row_estimate(c) for c in plan.children])
+
+
+def optimize(root: PlanMeta, conf: TpuConf) -> None:
+    """Mark device-capable nodes as cost-prevented where CPU placement is
+    cheaper. The root's parent is the host (results are collected)."""
+    cpu_w = conf.get("spark.rapids.sql.optimizer.cpuExecCost")
+    tpu_w = conf.get("spark.rapids.sql.optimizer.gpuExecCost")
+    trans_w = conf.get("spark.rapids.sql.optimizer.transitionCost")
+
+    memo: Dict[int, Tuple[float, float, float]] = {}
+
+    def costs(m: PlanMeta) -> Tuple[float, float]:
+        """(best cost with this node on CPU, best cost with it on TPU)."""
+        key = id(m)
+        if key in memo:
+            c = memo[key]
+            return c[0], c[1]
+        # child rows come from the memo entries costs(c) populates, so the
+        # whole cost pass stays O(n) in plan size
+        kids = [(costs(c), memo[id(c)][2]) for c in m.child_metas]
+        rows = _estimate_from(m.plan, [memo[id(c)][2]
+                                       for c in m.child_metas])
+        cpu = cpu_w * rows + sum(
+            min(cc, tc + trans_w * cr) for (cc, tc), cr in kids)
+        if m.can_run_on_device:
+            tpu = tpu_w * rows + sum(
+                min(tc, cc + trans_w * cr) for (cc, tc), cr in kids)
+        else:
+            tpu = math.inf
+        memo[key] = (cpu, tpu, rows)
+        return cpu, tpu
+
+    def place(m: PlanMeta, parent_on_tpu: bool) -> None:
+        cpu, tpu, rows = memo[id(m)]
+        boundary = trans_w * rows
+        cost_if_cpu = cpu + (boundary if parent_on_tpu else 0.0)
+        cost_if_tpu = tpu + (0.0 if parent_on_tpu else boundary)
+        on_tpu = cost_if_tpu < cost_if_cpu
+        if not on_tpu and m.can_run_on_device:
+            m.will_not_work(_COST_REASON)
+        for c in m.child_metas:
+            place(c, on_tpu)
+
+    costs(root)
+    place(root, parent_on_tpu=False)

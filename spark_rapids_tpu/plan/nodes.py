@@ -361,6 +361,16 @@ def _cpu_agg(func: AggregateFunction, ctx, b: HostBatch, gid, ng) -> Vec:
     if name in ("Sum", "Average"):
         acc_t = np.float64 if T.is_floating(v.dtype) or name == "Average" \
             else np.int64
+        if name == "Sum" and ctx.ansi and acc_t is np.int64:
+            # exact accumulator-overflow detection via python ints (Spark
+            # ANSI: SUM over BIGINT raises instead of wrapping)
+            sums = [0] * ng
+            for i in np.nonzero(v.validity)[0]:
+                sums[gid[i]] += int(v.data[i])
+            if any(x < -2**63 or x > 2**63 - 1 for x in sums):
+                from ..errors import AnsiViolation
+                raise AnsiViolation("[ARITHMETIC_OVERFLOW] long overflow")
+            return Vec(out_t, np.array(sums, dtype=np.int64), valid_any)
         contrib = np.where(v.validity, v.data, 0).astype(acc_t)
         s = np.zeros(ng, dtype=acc_t)
         np.add.at(s, gid, contrib)

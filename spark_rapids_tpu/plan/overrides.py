@@ -648,6 +648,17 @@ _agg_ansi = _ansi_context_tag(
 
 def _tag_agg(m: PlanMeta) -> None:
     _agg_ansi(m)
+    if m.conf.is_ansi:
+        # the ACCUMULATION itself can overflow under ANSI (SUM over BIGINT);
+        # the aggregation kernel doesn't surface error flags, so fall back —
+        # the CPU oracle detects accumulator overflow exactly
+        for a in m.plan._bound_aggs:
+            if isinstance(a.func, Sum) and T.is_integral(a.func.data_type):
+                m.will_not_work(
+                    "ANSI-mode integral SUM can overflow during "
+                    "accumulation; not plumbed for error surfacing on TPU "
+                    "(runs on CPU)")
+                break
     # nested types may only appear as collect_* OUTPUTS; nested group keys
     # and nested aggregate inputs stay on CPU
     for e in m.plan._bound_groups:
@@ -706,7 +717,11 @@ class Overrides:
         CPU PhysicalPlan with converted subtrees bridged back to host."""
         if not self.conf.is_sql_enabled:
             return plan
-        result, meta = self._convert(plan)
+        meta = self._tag_tree(plan)
+        if self.conf.get("spark.rapids.sql.optimizer.enabled"):
+            from .cbo import optimize
+            optimize(meta, self.conf)
+        result = self._convert_tagged(plan, meta)
         explain = self.conf.explain
         if explain != "NONE":
             lines = meta.explain_lines()
@@ -721,17 +736,14 @@ class Overrides:
             result = ensure_distribution(result, self.conf)
         return result
 
-    def _convert(self, plan: N.PhysicalPlan):
-        from ..exec.transitions import CpuFromTpuExec, TpuFromCpuExec
-        from ..exec.base import TpuExec
-
+    def _tag_tree(self, plan: N.PhysicalPlan) -> PlanMeta:
+        """Phase 1 (wrapAndTagPlan analog): build the meta mirror tree and tag
+        every node, WITHOUT converting — so cross-tree passes (CBO) can see
+        the full tagging picture first."""
         rule = _EXEC_RULES.get(type(plan))
         meta = PlanMeta(plan, self.conf, rule)
-        converted_children = []
         for c in plan.children:
-            cc, cm = self._convert(c)
-            converted_children.append(cc)
-            meta.child_metas.append(cm)
+            meta.child_metas.append(self._tag_tree(c))
         if rule is not None and rule.expr_fn is not None:
             rule.expr_fn(meta)
         if rule is not None and not isinstance(plan, N.CpuProjectExec):
@@ -746,23 +758,31 @@ class Overrides:
                         "TPU (project the UDF into a column first)")
                     break
         meta.tag_for_device()
-
         if self.conf.is_test_enabled and not meta.can_run_on_device:
             raise AssertionError(
                 "spark.rapids.sql.test.enabled: plan node fell back to CPU: "
                 + "; ".join(meta.reasons))
+        return meta
 
+    def _convert_tagged(self, plan: N.PhysicalPlan, meta: PlanMeta):
+        """Phase 2 (convertIfNeeded analog): convert per the (possibly
+        CBO-adjusted) tags, bridging CPU<->TPU boundaries."""
+        from ..exec.transitions import CpuFromTpuExec, TpuFromCpuExec
+        from ..exec.base import TpuExec
+
+        converted_children = [self._convert_tagged(c, cm) for c, cm in
+                              zip(plan.children, meta.child_metas)]
         if meta.can_run_on_device:
             device_children = [
                 c if isinstance(c, TpuExec) else TpuFromCpuExec(c, self.conf)
                 for c in converted_children]
-            return rule.convert_fn(plan, device_children, self.conf), meta
+            return meta.rule.convert_fn(plan, device_children, self.conf)
         # stay on CPU; bridge any device children back to host
         host_children = [
             c if not isinstance(c, TpuExec) else CpuFromTpuExec(c)
             for c in converted_children]
         plan.children = host_children
-        return plan, meta
+        return plan
 
     def explain_string(self) -> str:
         return "\n".join(self.explain_log)

@@ -82,6 +82,22 @@ class TpuSession:
         from .plan import nodes as _nodes
         _nodes.set_ansi_mode(self.conf.is_ansi)
         enabled = self.conf.is_sql_enabled if use_device is None else use_device
+        if enabled and self.conf.get("spark.rapids.sql.adaptive.enabled"):
+            from .plan.adaptive import adaptive_execute
+            return adaptive_execute(self, plan, use_device=enabled)
+        return self._execute_rewritten(plan, enabled)
+
+    def _execute_rewritten(self, plan: PhysicalPlan,
+                           use_device: Optional[bool] = None):
+        """Plan-rewrite + run one (sub)plan; returns a pyarrow Table. The
+        adaptive loop calls this once per query stage."""
+        from .cpu.hostbatch import host_batch_to_arrow
+        from .exec.base import TpuExec
+        from .exec.transitions import device_batch_to_host
+        from .plan.nodes import _concat_host
+
+        enabled = self.conf.is_sql_enabled if use_device is None else \
+            use_device
         if enabled:
             self.initialize_device()
             ov = Overrides(self.conf)

@@ -157,6 +157,35 @@ class TestAnsiLazyBranches:
             q2.collect()
 
 
+class TestAnsiMoreContexts:
+    def test_ansi_sum_accumulator_overflow_raises(self, ansi_session):
+        mx = 2**63 - 1
+        df = ansi_session.from_arrow(pa.table(
+            {"k": I(1, 1), "a": L(mx, mx)}))
+        q = df.group_by("k").agg(s=Sum(col("a")))
+        with pytest.raises(AnsiViolation):
+            q.collect()
+        with pytest.raises(AnsiViolation):
+            q.collect_cpu()
+
+    def test_ansi_sum_no_overflow_ok(self, ansi_session):
+        df = ansi_session.from_arrow(pa.table({"k": I(1, 1), "a": L(5, 7)}))
+        q = df.group_by("k").agg(s=Sum(col("a")))
+        assert q.collect().column("s").to_pylist() == [12]
+
+    def test_expand_surfaces_ansi_errors(self, ansi_session):
+        # grouping sets expansion evaluates projections on device; its kernel
+        # must surface ANSI flags like project does
+        from spark_rapids_tpu.plan.nodes import CpuExpandExec
+        from spark_rapids_tpu.frontend import DataFrame
+        df = ansi_session.from_arrow(pa.table({"a": L(10), "d": L(0)}))
+        plan = CpuExpandExec([[col("a"), Divide(col("a"), col("d"))],
+                              [col("a"), lit(0.0)]],
+                             ["a", "r"], df.plan)
+        q = DataFrame(ansi_session, plan)
+        _raises_both(ansi_session, q)
+
+
 class TestAnsiContextFallback:
     def test_agg_with_arithmetic_falls_back_but_correct(self, ansi_session):
         # arithmetic inside an aggregation is not plumbed for device error
