@@ -56,10 +56,17 @@ def make_data(seed: int = 0):
 
 
 def tpu_many_steps():
-    """One program running the query step K_STEPS times (amortizes tunnel RPC)."""
+    """One program running the query step K_STEPS times (amortizes tunnel RPC).
+
+    The grouped aggregation runs through the Pallas MXU segmented-sum kernel
+    (ops/pallas_segsum.py): XLA's f64 segment_sum lowers to an emulated-f64
+    scatter-add measured at 0.300s/step for this shape; the Pallas kernel does
+    the same reduction in 0.019s/step at ~1e-9 relative error (one-hot MXU
+    matmuls on a hi/lo split, per-chunk f32 partials combined in f64)."""
     import jax
     import jax.numpy as jnp
     import spark_rapids_tpu  # noqa: F401  (x64 on)
+    from spark_rapids_tpu.ops.pallas_segsum import segment_sum_f64
 
     @jax.jit
     def many(fact_key, fact_grp, fact_val, dim_key, dim_w):
@@ -71,8 +78,7 @@ def tpu_many_steps():
             w = tw[fact_key]
             matched = tm[fact_key] & keep
             contrib = jnp.where(matched, fact_val * w, 0.0)
-            sums = jax.ops.segment_sum(contrib, fact_grp,
-                                       num_segments=N_GROUPS)
+            sums = segment_sum_f64(contrib, fact_grp, N_GROUPS)
             rows = jnp.sum(matched).astype(jnp.int64)
             return (acc[0] + sums, acc[1] + rows), None
 

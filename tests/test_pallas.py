@@ -1,0 +1,71 @@
+"""Pallas segmented-sum kernel (ops/pallas_segsum.py) — correctness on the
+CPU mesh via interpret mode; the real-chip speed numbers live in bench.py
+and the kernel module docstring."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.ops.pallas_segsum import (MAX_SEGMENTS, segment_sum_f64)
+
+
+def _check(rng, n, g, dist):
+    ids = rng.integers(0, g, n).astype(np.int32)
+    vals = dist(n)
+    out = np.asarray(segment_sum_f64(jnp.asarray(vals), jnp.asarray(ids), g))
+    exact = np.bincount(ids, weights=vals, minlength=g)
+    # error scale: f32 ulp of per-chunk partials against the group's L1 mass
+    mass = np.bincount(ids, weights=np.abs(vals), minlength=g) + 1.0
+    assert np.all(np.abs(out - exact) <= 1e-6 * mass), \
+        float(np.max(np.abs(out - exact) / mass))
+
+
+class TestPallasSegmentSum:
+    def test_positive_values(self, rng):
+        _check(rng, 50_000, 1024, lambda n: rng.uniform(0.5, 1.5, n))
+
+    def test_signed_values_cancellation(self, rng):
+        _check(rng, 30_000, 700, lambda n: rng.normal(0, 100, n))
+
+    def test_small_and_ragged_sizes(self, rng):
+        _check(rng, 1, 1, lambda n: rng.uniform(size=n))
+        _check(rng, 2049, 3, lambda n: rng.uniform(size=n))
+        _check(rng, 4096, 128, lambda n: rng.uniform(size=n))
+
+    def test_out_of_range_ids_ignored(self, rng):
+        ids = np.array([0, 1, -1, 5], dtype=np.int32)
+        vals = np.array([1.0, 2.0, 99.0, 77.0])
+        out = np.asarray(segment_sum_f64(jnp.asarray(vals),
+                                         jnp.asarray(ids), 4))
+        assert out.tolist() == [1.0, 2.0, 0.0, 0.0]
+
+    def test_rejects_oversized_segment_count(self, rng):
+        with pytest.raises(ValueError):
+            segment_sum_f64(jnp.zeros(8), jnp.zeros(8, jnp.int32),
+                            MAX_SEGMENTS + 1)
+
+    def test_beyond_f32_range_values(self, rng):
+        # one huge value must not poison other segments (hi-split overflow)
+        vals = np.array([1e300, 1.0, 2.0, 3.0])
+        ids = np.array([0, 1, 1, 2], dtype=np.int32)
+        out = np.asarray(segment_sum_f64(jnp.asarray(vals),
+                                         jnp.asarray(ids), 4))
+        assert out[0] == 1e300 and out[1] == 3.0 and out[2] == 3.0 \
+            and out[3] == 0.0, out
+
+    def test_int64_ids_beyond_int32_dropped(self, rng):
+        ids = np.array([2**32 + 2, 0], dtype=np.int64)
+        vals = np.array([5.0, 1.0])
+        out = np.asarray(segment_sum_f64(jnp.asarray(vals),
+                                         jnp.asarray(ids), 4))
+        assert out.tolist() == [1.0, 0.0, 0.0, 0.0], out
+
+    def test_wide_dynamic_range(self, rng):
+        # hi/lo split must keep big+small contributions
+        vals = np.concatenate([np.full(100, 1e12), np.full(100, 1e-3)])
+        ids = np.zeros(200, dtype=np.int32)
+        out = np.asarray(segment_sum_f64(jnp.asarray(vals),
+                                         jnp.asarray(ids), 1))
+        exact = vals.sum()
+        assert abs(out[0] - exact) <= 1e-6 * np.abs(vals).sum()
