@@ -199,6 +199,10 @@ register("spark.rapids.sql.format.parquet.multiThreadedRead.numThreads", "int", 
          "Global multi-file reader pool size (reference MultiFileReaderThreadPool).")
 register("spark.rapids.sql.format.parquet.multiThreadedRead.maxNumFilesParallel", "int",
          2147483647, "Max files fetched in parallel per task.")
+register("spark.rapids.sql.format.parquet.deviceDecode.enabled", "bool", True,
+         "Decode PLAIN-encoded flat numeric parquet pages on device (RLE "
+         "def-level expansion + byte bitcast); unsupported chunks fall back "
+         "to the pyarrow host path per file.")
 register("spark.rapids.sql.format.orc.enabled", "bool", True, "Enable TPU ORC scan.")
 register("spark.rapids.sql.format.csv.enabled", "bool", True, "Enable TPU CSV scan.")
 register("spark.rapids.sql.format.json.enabled", "bool", True, "Enable TPU JSON scan.")

@@ -1,0 +1,456 @@
+"""Device-side Parquet decode (reference `GpuParquetScan.scala:1600,1796,2461`:
+the reference's scan performance comes from copying RAW column chunks to a
+buffer and decoding whole pages on the accelerator).
+
+TPU shape of the same idea, first encodings (PLAIN values + RLE/bit-packed
+definition levels, the hot pair for flat numeric data):
+
+  host (cheap, control-plane):
+    * footer via pyarrow metadata: row groups, chunk offsets, codecs;
+    * page headers via a minimal Thrift compact-protocol parser;
+    * page decompression (snappy/gzip/zstd via pyarrow) — byte plumbing only;
+    * RLE run STRUCTURE scan: the def-level stream is split into a small
+      per-run table (kind, output offset, count, value, bit offset) without
+      expanding any values.
+  device (the actual data work):
+    * def-level expansion: output row -> run via searchsorted over the run
+      table, bit-packed runs unpacked with vector shifts — the values
+      never exist row-wise on the host;
+    * PLAIN values: the raw little-endian byte buffer is shipped once and
+      bitcast to int32/int64/float32/float64 lanes on device;
+    * null scatter: non-null values land at their row slots via the
+      rank = cumsum(defined) gather (same shape as the join expansion).
+
+Anything else (dictionary pages, byte arrays, v2 pages, unsupported codecs)
+raises DeviceDecodeUnsupported and the scan falls back to the pyarrow host
+path per file — the reference's per-op fallback discipline applied to IO."""
+
+from __future__ import annotations
+
+import functools
+import struct
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from .. import types as T
+from ..columnar.padding import row_bucket
+
+__all__ = ["DeviceDecodeUnsupported", "device_decode_file"]
+
+
+class DeviceDecodeUnsupported(Exception):
+    pass
+
+
+# ----------------------------------------------------------------------------
+# Thrift compact protocol (just enough for parquet PageHeader)
+# ----------------------------------------------------------------------------
+
+def _varint(buf: memoryview, pos: int) -> Tuple[int, int]:
+    out = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def _zigzag(v: int) -> int:
+    return (v >> 1) ^ -(v & 1)
+
+
+def _skip_field(buf, pos, ftype):
+    if ftype in (1, 2):  # bool true/false encoded in the field header
+        return pos
+    if ftype == 3:
+        return pos + 1
+    if ftype in (4, 5, 6):
+        _, pos = _varint(buf, pos)
+        return pos
+    if ftype == 7:
+        return pos + 8
+    if ftype == 8:
+        n, pos = _varint(buf, pos)
+        return pos + n
+    if ftype == 9:  # list
+        head = buf[pos]
+        pos += 1
+        n = head >> 4
+        etype = head & 0x0F
+        if n == 15:
+            n, pos = _varint(buf, pos)
+        for _ in range(n):
+            pos = _skip_field(buf, pos, etype)
+        return pos
+    if ftype == 12:  # struct
+        return _skip_struct(buf, pos)
+    raise DeviceDecodeUnsupported(f"thrift type {ftype}")
+
+
+def _skip_struct(buf, pos):
+    fid = 0
+    while True:
+        head = buf[pos]
+        pos += 1
+        if head == 0:
+            return pos
+        delta = head >> 4
+        ftype = head & 0x0F
+        fid = fid + delta if delta else _zigzag(_varint(buf, pos)[0])
+        if not delta:
+            _, pos = _varint(buf, pos)
+        pos = _skip_field(buf, pos, ftype)
+
+
+def _read_struct_fields(buf, pos):
+    """Yields (field_id, field_type, value_or_None, new_pos); i32/i64 decoded."""
+    fid = 0
+    while True:
+        head = buf[pos]
+        pos += 1
+        if head == 0:
+            yield None, None, None, pos
+            return
+        delta = head >> 4
+        ftype = head & 0x0F
+        if delta:
+            fid += delta
+        else:
+            raw, pos = _varint(buf, pos)
+            fid = _zigzag(raw)
+        if ftype in (4, 5, 6):
+            raw, pos = _varint(buf, pos)
+            yield fid, ftype, _zigzag(raw), pos
+        elif ftype in (1, 2):
+            yield fid, ftype, ftype == 1, pos
+        else:
+            start = pos
+            pos = _skip_field(buf, pos, ftype)
+            yield fid, ftype, (start, pos), pos
+
+
+class _PageHeader:
+    __slots__ = ("type", "uncompressed", "compressed", "num_values",
+                 "encoding", "def_encoding", "header_len")
+
+
+def _parse_page_header(buf: memoryview, pos: int) -> _PageHeader:
+    h = _PageHeader()
+    start = pos
+    h.type = h.uncompressed = h.compressed = None
+    h.num_values = h.encoding = h.def_encoding = None
+    for fid, ftype, val, pos in _read_struct_fields(buf, pos):
+        if fid is None:
+            break
+        if fid == 1:
+            h.type = val
+        elif fid == 2:
+            h.uncompressed = val
+        elif fid == 3:
+            h.compressed = val
+        elif fid in (5, 7) and ftype == 12:
+            span = val  # (start, end) of the nested header struct
+            sub_pos = span[0]
+            for sfid, sftype, sval, sub_pos in _read_struct_fields(buf,
+                                                                   sub_pos):
+                if sfid is None:
+                    break
+                if sfid == 1:
+                    h.num_values = sval
+                elif sfid == 2:
+                    h.encoding = sval
+                elif sfid == 3:
+                    h.def_encoding = sval
+    h.header_len = pos - start
+    return h
+
+
+# ----------------------------------------------------------------------------
+# RLE/bit-packed hybrid: host structure scan (no value expansion)
+# ----------------------------------------------------------------------------
+
+def _rle_runs(payload: memoryview, num_values: int):
+    """Split a 1-bit RLE/bit-packed hybrid stream into a run table.
+    Returns (kinds u8 [R] 0=rle 1=packed, counts i64, values u8, bitoffs i64)
+    where bitoffs indexes into the packed byte blob for packed runs."""
+    kinds: List[int] = []
+    counts: List[int] = []
+    values: List[int] = []
+    bitoffs: List[int] = []
+    packed = bytearray()
+    pos, out = 0, 0
+    while out < num_values and pos < len(payload):
+        header, pos = _varint(payload, pos)
+        if header & 1:  # bit-packed group: (header>>1)*8 values, 1 bit each
+            n = (header >> 1) * 8
+            nbytes = header >> 1
+            kinds.append(1)
+            counts.append(min(n, num_values - out))
+            values.append(0)
+            bitoffs.append(len(packed) * 8)
+            packed.extend(payload[pos:pos + nbytes])
+            pos += nbytes
+            out += counts[-1]
+        else:  # RLE run of header>>1 copies of a 1-byte value
+            n = header >> 1
+            v = payload[pos]
+            pos += 1
+            kinds.append(0)
+            counts.append(min(n, num_values - out))
+            values.append(v & 1)
+            bitoffs.append(0)
+            out += counts[-1]
+    if out < num_values:
+        raise DeviceDecodeUnsupported("truncated def-level stream")
+    if not packed:
+        packed = bytearray(1)
+    return (np.array(kinds, np.uint8), np.array(counts, np.int64),
+            np.array(values, np.uint8), np.array(bitoffs, np.int64),
+            np.frombuffer(bytes(packed), np.uint8))
+
+
+# ----------------------------------------------------------------------------
+# Device kernels
+# ----------------------------------------------------------------------------
+
+@functools.partial(__import__("jax").jit, static_argnums=(5,))
+def _expand_def_levels(kinds, counts, values, bitoffs, packed, cap: int):
+    """Run table -> bool[cap] defined mask, entirely on device."""
+    import jax.numpy as jnp
+    ends = jnp.cumsum(counts)
+    j = jnp.arange(cap, dtype=jnp.int64)
+    run = jnp.searchsorted(ends, j, side="right")
+    run = jnp.clip(run, 0, counts.shape[0] - 1)
+    base = jnp.where(run > 0, ends[jnp.maximum(run - 1, 0)], 0)
+    within = j - base
+    bitpos = bitoffs[run] + within
+    byte = packed[jnp.clip(bitpos // 8, 0, packed.shape[0] - 1)]
+    bit = (byte >> (bitpos % 8).astype(jnp.uint8)) & 1
+    lvl = jnp.where(kinds[run] == 1, bit, values[run])
+    total = ends[-1]
+    return (lvl == 1) & (j < total)
+
+
+@functools.partial(__import__("jax").jit, static_argnums=(2, 3))
+def _scatter_plain(raw_bytes, defined, np_dtype_name: str, cap: int):
+    """PLAIN value bytes + defined mask -> (data[cap], validity[cap]).
+    Non-null values are stored back-to-back; row r reads value rank[r].
+    raw_bytes is host-padded so `cap` values are always addressable."""
+    import jax.numpy as jnp
+    from jax import lax
+    dt = np.dtype(np_dtype_name)
+    if np_dtype_name == "bool":
+        idx = jnp.arange(cap)
+        byte = raw_bytes[idx // 8]
+        vals = ((byte >> (idx % 8).astype(jnp.uint8)) & 1).astype(jnp.bool_)
+    else:
+        vals = lax.bitcast_convert_type(
+            raw_bytes[:cap * dt.itemsize].reshape(cap, dt.itemsize), dt)
+    rank = jnp.cumsum(defined.astype(jnp.int32)) - 1
+    safe = jnp.clip(rank, 0, cap - 1)
+    data = vals[safe]
+    return jnp.where(defined, data, jnp.zeros((), dt)), defined
+
+
+# ----------------------------------------------------------------------------
+# Host orchestration
+# ----------------------------------------------------------------------------
+
+_PHYS_TO_NP = {
+    "BOOLEAN": "bool",
+    "INT32": "int32",
+    "INT64": "int64",
+    "FLOAT": "float32",
+    "DOUBLE": "float64",
+}
+
+# parquet "LZ4" is the legacy Hadoop-framed variant, which pyarrow's
+# lz4-frame codec cannot decode — deliberately NOT mapped (falls back)
+_CODEC = {"SNAPPY": "snappy", "GZIP": "gzip", "ZSTD": "zstd"}
+
+
+def _decompress(data: bytes, codec: str, size: int) -> bytes:
+    import pyarrow as pa
+    if codec == "UNCOMPRESSED":
+        return data
+    name = _CODEC.get(codec)
+    if name is None:
+        raise DeviceDecodeUnsupported(f"codec {codec}")
+    return pa.decompress(data, decompressed_size=size, codec=name)
+
+
+def _defined_count(part) -> int:
+    """Non-null count of one page's def-level run table (host, tiny)."""
+    kinds, counts, values, bitoffs, packed = part
+    bits = np.unpackbits(packed, bitorder="little")
+    total = 0
+    for k, c, v, bo in zip(kinds, counts, values, bitoffs):
+        if k == 0:
+            total += int(c) if v == 1 else 0
+        else:
+            total += int(bits[bo:bo + c].sum())
+    return total
+
+
+def _decode_chunk(buf: bytes, col_meta, optional: bool):
+    """One column chunk -> (raw value bytes, def-level run table or None,
+    num_values)."""
+    phys = col_meta.physical_type
+    if phys not in _PHYS_TO_NP:
+        raise DeviceDecodeUnsupported(f"physical type {phys}")
+    is_bool = phys == "BOOLEAN"
+    mv = memoryview(buf)
+    pos = 0
+    values = bytearray()
+    bool_bits: List[np.ndarray] = []
+    run_parts = []
+    total = 0
+    while pos < len(mv):
+        h = _parse_page_header(mv, pos)
+        if h.type is None or h.compressed is None or h.uncompressed is None:
+            raise DeviceDecodeUnsupported("unparseable page header")
+        pos += h.header_len
+        payload = _decompress(bytes(mv[pos:pos + h.compressed]),
+                              col_meta.compression, h.uncompressed)
+        pos += h.compressed
+        if h.type == 2:  # dictionary page -> fall back (DICT data follows)
+            raise DeviceDecodeUnsupported("dictionary-encoded chunk")
+        if h.type != 0:  # only v1 data pages
+            raise DeviceDecodeUnsupported(f"page type {h.type}")
+        if h.encoding != 0:  # PLAIN
+            raise DeviceDecodeUnsupported(f"value encoding {h.encoding}")
+        body = memoryview(payload)
+        if optional:
+            if h.def_encoding != 3:  # RLE
+                raise DeviceDecodeUnsupported(
+                    f"def-level encoding {h.def_encoding}")
+            (dlen,) = struct.unpack_from("<i", body, 0)
+            run_parts.append(_rle_runs(body[4:4 + dlen], h.num_values))
+            page_vals = body[4 + dlen:]
+        else:
+            page_vals = body
+        if is_bool:
+            # every page's bit-packing restarts at a byte boundary; a byte
+            # concat would misalign any page whose non-null count % 8 != 0 —
+            # repack into one contiguous bitstream on host
+            ndef = _defined_count(run_parts[-1]) if optional \
+                else h.num_values
+            bits = np.unpackbits(np.frombuffer(page_vals, np.uint8),
+                                 bitorder="little")[:ndef]
+            bool_bits.append(bits)
+        else:
+            values.extend(page_vals)
+        total += h.num_values
+    if is_bool:
+        values = bytearray(np.packbits(
+            np.concatenate(bool_bits) if bool_bits
+            else np.zeros(0, np.uint8), bitorder="little").tobytes())
+    return bytes(values), run_parts, total
+
+
+def _merge_runs(run_parts):
+    kinds = np.concatenate([p[0] for p in run_parts])
+    counts = np.concatenate([p[1] for p in run_parts])
+    values = np.concatenate([p[2] for p in run_parts])
+    packed_lens = [p[4].shape[0] for p in run_parts]
+    offs = np.concatenate(([0], np.cumsum(packed_lens)[:-1]))
+    bitoffs = np.concatenate([p[3] + o * 8
+                              for p, o in zip(run_parts, offs)])
+    packed = np.concatenate([p[4] for p in run_parts])
+    return kinds, counts, values, bitoffs, packed
+
+
+_OK_ENCODINGS = {"PLAIN", "RLE", "BIT_PACKED"}
+
+
+def file_supported(path: str, schema) -> None:
+    """Footer-only supportability check — raises DeviceDecodeUnsupported
+    BEFORE any page bytes are read, so the caller can choose the host path
+    without decoding anything twice."""
+    import pyarrow.parquet as pq
+    meta = pq.ParquetFile(path).metadata
+    pq_schema = meta.schema
+    col_index = {pq_schema.column(i).path: i
+                 for i in range(len(pq_schema))}
+    for name, dt in zip(schema.names, schema.types):
+        if name not in col_index:
+            raise DeviceDecodeUnsupported(f"column {name} not flat")
+        if not isinstance(dt, (T.BooleanType, T.IntegerType, T.LongType,
+                               T.FloatType, T.DoubleType, T.DateType)):
+            raise DeviceDecodeUnsupported(f"logical type {dt}")
+        ci = col_index[name]
+        pqcol = pq_schema.column(ci)
+        if pqcol.max_repetition_level > 0:
+            raise DeviceDecodeUnsupported("repeated column")
+        for rg in range(meta.num_row_groups):
+            cm = meta.row_group(rg).column(ci)
+            if cm.physical_type not in _PHYS_TO_NP:
+                raise DeviceDecodeUnsupported(cm.physical_type)
+            if cm.compression != "UNCOMPRESSED" and \
+                    cm.compression not in _CODEC:
+                raise DeviceDecodeUnsupported(f"codec {cm.compression}")
+            if cm.dictionary_page_offset is not None:
+                raise DeviceDecodeUnsupported("dictionary-encoded chunk")
+            if not set(cm.encodings) <= _OK_ENCODINGS:
+                raise DeviceDecodeUnsupported(f"encodings {cm.encodings}")
+
+
+def device_decode_file(path: str, schema, conf) -> Iterator:
+    """Yield one device ColumnarBatch per row group, decoding on the TPU.
+    Call file_supported() first: after the footer check passes, page-level
+    surprises raise (with a conf hint) rather than falling back mid-stream."""
+    import jax.numpy as jnp
+    import pyarrow.parquet as pq
+    from ..columnar.batch import ColumnarBatch
+    from ..columnar.column import Column
+
+    pf = pq.ParquetFile(path)
+    meta = pf.metadata
+    pq_schema = meta.schema
+    col_index = {pq_schema.column(i).path: i
+                 for i in range(len(pq_schema))}
+
+    with open(path, "rb") as f:
+        for rg in range(meta.num_row_groups):
+            rgm = meta.row_group(rg)
+            nrows = rgm.num_rows
+            cap = row_bucket(nrows)
+            cols = []
+            for name, dt in zip(schema.names, schema.types):
+                ci = col_index[name]
+                cm = rgm.column(ci)
+                pqcol = pq_schema.column(ci)
+                optional = pqcol.max_definition_level > 0
+                if pqcol.max_repetition_level > 0:
+                    raise DeviceDecodeUnsupported("repeated column")
+                start = cm.dictionary_page_offset or cm.data_page_offset
+                f.seek(start)
+                buf = f.read(cm.total_compressed_size)
+                raw, run_parts, nvals = _decode_chunk(buf, cm, optional)
+                if nvals != nrows:
+                    raise DeviceDecodeUnsupported("page/row-group mismatch")
+                raw_dev = jnp.asarray(np.frombuffer(raw, np.uint8))
+                if optional:
+                    kinds, counts, values, bitoffs, packed = \
+                        _merge_runs(run_parts)
+                    defined = _expand_def_levels(
+                        jnp.asarray(kinds), jnp.asarray(counts),
+                        jnp.asarray(values), jnp.asarray(bitoffs),
+                        jnp.asarray(packed), cap)
+                else:
+                    defined = jnp.arange(cap) < nrows
+                npname = _PHYS_TO_NP[cm.physical_type]
+                pad = cap * np.dtype(npname).itemsize + 8
+                if raw_dev.shape[0] < pad:
+                    raw_dev = jnp.pad(raw_dev, (0, pad - raw_dev.shape[0]))
+                data, validity = _scatter_plain(raw_dev, defined, npname, cap)
+                if isinstance(dt, T.DateType):
+                    data = data.astype(jnp.int32)
+                elif data.dtype != dt.np_dtype:
+                    data = data.astype(dt.np_dtype)
+                cols.append(Column(dt, data, validity))
+            yield ColumnarBatch(schema, tuple(cols),
+                                jnp.asarray(nrows, jnp.int32))

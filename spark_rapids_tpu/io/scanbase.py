@@ -110,10 +110,38 @@ class TpuFileScanExec(_TpuExec):
 
     def do_execute(self):
         from ..columnar.batch import batch_from_arrow
+        if self.cpu_scan.format_name == "parquet" and \
+                self.conf.get(
+                    "spark.rapids.sql.format.parquet.deviceDecode.enabled"):
+            done = yield from self._try_device_decode()
+            if done:
+                return
         for t in self.cpu_scan.host_tables():
             b = batch_from_arrow(t)
             self.num_output_rows.add(t.num_rows)
             yield self._count_output(b)
+
+    def _try_device_decode(self):
+        """Device parquet decode, streamed one row group at a time. The
+        supportability decision is made up front from footers alone (no page
+        reads, nothing decoded twice); only then do batches flow. Returns
+        True when it produced the scan."""
+        from .parquet_device import (DeviceDecodeUnsupported,
+                                     device_decode_file, file_supported)
+        scan = self.cpu_scan
+        if scan.options.get("filters"):
+            return False  # row-group pruning stays on the pyarrow path
+        try:
+            for path in scan.paths:
+                file_supported(path, scan.output)
+        except (DeviceDecodeUnsupported, OSError, KeyError, IndexError,
+                AttributeError):
+            return False
+        for path in scan.paths:
+            for b in device_decode_file(path, scan.output, self.conf):
+                self.num_output_rows.add(b.row_count())
+                yield self._count_output(b)
+        return True
 
 
 def make_tpu_file_scan(plan: CpuFileScanExec, conf: TpuConf) -> TpuFileScanExec:

@@ -135,15 +135,16 @@ def segment_sum_f64(values, segment_ids, num_segments: int):
     # wrap onto a valid segment
     in_range = (segment_ids >= 0) & (segment_ids < num_segments)
     ids = jnp.where(in_range, segment_ids, -1).astype(jnp.int32)
-    # values beyond f32 range would turn into inf in the hi split and poison
-    # every segment in their chunk (inf * 0.0 = NaN in the one-hot matmul):
-    # run the kernel on the f32-clamped value and correct the (rare) residual
-    # through the exact scatter path only when one exists (lax.cond skips the
-    # expensive branch at runtime otherwise)
+    # values beyond f32 range (or NaN) would poison every segment in their
+    # chunk through the one-hot matmul (inf*0.0 = NaN, NaN*0.0 = NaN): run
+    # the kernel on a finite f32-clamped value and route the (rare) residual
+    # through the exact scatter path, taken at runtime only when one exists
+    # (lax.cond skips the expensive branch otherwise). NaN rows become
+    # residual NaN, which segment_sum confines to their own segment.
     f32max = jnp.float64(3.4028234663852886e38)
-    clamped = jnp.clip(v64, -f32max, f32max)
-    clamped = jnp.where(jnp.isnan(v64), v64, clamped)  # NaN stays NaN
-    residual = jnp.where(jnp.isnan(v64), 0.0, v64 - clamped)
+    nan = jnp.isnan(v64)
+    clamped = jnp.clip(jnp.where(nan, 0.0, v64), -f32max, f32max)
+    residual = jnp.where(nan, v64, v64 - clamped)
     correction = jax.lax.cond(
         jnp.any(residual != 0.0),
         lambda: jax.ops.segment_sum(

@@ -61,6 +61,13 @@ class TestPallasSegmentSum:
                                          jnp.asarray(ids), 4))
         assert out.tolist() == [1.0, 0.0, 0.0, 0.0], out
 
+    def test_nan_confined_to_its_segment(self, rng):
+        vals = np.array([np.nan, 1.0, 2.0])
+        ids = np.array([0, 1, 2], dtype=np.int32)
+        out = np.asarray(segment_sum_f64(jnp.asarray(vals),
+                                         jnp.asarray(ids), 3))
+        assert np.isnan(out[0]) and out[1] == 1.0 and out[2] == 2.0, out
+
     def test_wide_dynamic_range(self, rng):
         # hi/lo split must keep big+small contributions
         vals = np.concatenate([np.full(100, 1e12), np.full(100, 1e-3)])

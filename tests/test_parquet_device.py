@@ -1,0 +1,145 @@
+"""Device parquet decode (io/parquet_device.py): PLAIN values + RLE/bit-packed
+definition levels decoded on device, differential against pyarrow on
+generated files (reference GpuParquetScan device decode)."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.plugin import TpuSession
+
+
+@pytest.fixture()
+def session():
+    return TpuSession({"spark.rapids.sql.enabled": True,
+                       "spark.rapids.sql.explain": "NONE"})
+
+
+def plain_table(rng, n=5000, nulls=True):
+    def mk(vals):
+        if not nulls:
+            return pa.array(vals)
+        mask = rng.random(n) < 0.2
+        return pa.array(vals, mask=mask)
+    return pa.table({
+        "i": mk(rng.integers(-2**31, 2**31 - 1, n).astype(np.int32)),
+        "l": mk(rng.integers(-2**62, 2**62, n)),
+        "f": mk(rng.normal(0, 1e3, n).astype(np.float32)),
+        "d": mk(rng.normal(0, 1e6, n)),
+        "b": mk(rng.integers(0, 2, n).astype(bool)),
+    })
+
+
+def write_plain(tmp_path, t, name="t.parquet", **kw):
+    path = str(tmp_path / name)
+    pq.write_table(t, path, use_dictionary=False, compression=kw.pop(
+        "compression", "snappy"), **kw)
+    return path
+
+
+def _used_device_decode(session, path):
+    from spark_rapids_tpu.plan.overrides import Overrides
+    from spark_rapids_tpu.io.scanbase import TpuFileScanExec
+    df = session.read_parquet(path)
+    session.initialize_device()
+    ov = Overrides(session.conf)
+    result = ov.apply(df.plan)
+    assert isinstance(result, TpuFileScanExec)
+    gen = result._try_device_decode()
+    try:
+        first = next(gen)
+    except StopIteration as s:
+        return bool(s.value), None
+    return True, first
+
+
+class TestDeviceParquetDecode:
+    @pytest.mark.parametrize("compression", ["snappy", "none", "zstd"])
+    def test_plain_roundtrip(self, session, rng, tmp_path, compression):
+        t = plain_table(rng)
+        path = write_plain(tmp_path, t, compression=compression)
+        df = session.read_parquet(path)
+        tpu = df.collect()
+        assert tpu.num_rows == t.num_rows
+        exact = pq.read_table(path)
+        for name in t.schema.names:
+            a = tpu.column(name).to_pylist()
+            b = exact.column(name).to_pylist()
+            assert a == b or all(
+                (x is None and y is None) or x == y or
+                (isinstance(x, float) and abs(x - y) < 1e-12)
+                for x, y in zip(a, b)), name
+
+    def test_device_path_actually_used(self, session, rng, tmp_path):
+        path = write_plain(tmp_path, plain_table(rng, n=800))
+        used, first = _used_device_decode(session, path)
+        assert used and first is not None
+
+    def test_no_nulls_required_like(self, session, rng, tmp_path):
+        t = plain_table(rng, n=1200, nulls=False)
+        path = write_plain(tmp_path, t)
+        df = session.read_parquet(path)
+        assert df.collect().equals(pq.read_table(path))
+
+    def test_multiple_row_groups(self, session, rng, tmp_path):
+        t = plain_table(rng, n=4000)
+        path = write_plain(tmp_path, t, row_group_size=700)
+        df = session.read_parquet(path)
+        out = df.collect()
+        exact = pq.read_table(path)
+        assert out.column("l").to_pylist() == exact.column("l").to_pylist()
+        assert out.column("i").to_pylist() == exact.column("i").to_pylist()
+
+    def test_dictionary_files_fall_back(self, session, rng, tmp_path):
+        t = plain_table(rng, n=500)
+        path = str(tmp_path / "dict.parquet")
+        pq.write_table(t, path, use_dictionary=True)
+        used, _ = _used_device_decode(session, path)
+        assert not used  # clean fallback, and results still correct:
+        df = session.read_parquet(path)
+        assert df.collect().num_rows == 500
+
+    def test_string_columns_fall_back(self, session, rng, tmp_path):
+        t = pa.table({"s": pa.array(["a", "bb", None, "ccc"])})
+        path = write_plain(tmp_path, t)
+        used, _ = _used_device_decode(session, path)
+        assert not used
+        df = session.read_parquet(path)
+        assert df.collect().column("s").to_pylist() == ["a", "bb", None,
+                                                        "ccc"]
+
+    def test_bool_across_many_small_pages(self, session, rng, tmp_path):
+        # page bit-packing restarts per page: misalignment regression test
+        n = 4000
+        mask = rng.random(n) < 0.3
+        t = pa.table({"b": pa.array(rng.integers(0, 2, n).astype(bool),
+                                    mask=mask),
+                      "l": pa.array(rng.integers(0, 10, n))})
+        path = str(tmp_path / "b.parquet")
+        pq.write_table(t, path, use_dictionary=False, data_page_size=100)
+        used, _ = _used_device_decode(session, path)
+        assert used
+        df = session.read_parquet(path)
+        assert df.collect().column("b").to_pylist() == \
+            pq.read_table(path).column("b").to_pylist()
+
+    def test_lz4_files_fall_back_cleanly(self, session, rng, tmp_path):
+        t = plain_table(rng, n=300)
+        path = str(tmp_path / "lz4.parquet")
+        pq.write_table(t, path, use_dictionary=False, compression="lz4")
+        used, _ = _used_device_decode(session, path)
+        assert not used
+        df = session.read_parquet(path)
+        assert df.collect().num_rows == 300  # host path still works
+
+    def test_query_over_device_decoded_scan(self, session, rng, tmp_path):
+        from spark_rapids_tpu.expr import Count, Sum, col
+        t = plain_table(rng, n=3000)
+        path = write_plain(tmp_path, t)
+        df = session.read_parquet(path)
+        q = df.group_by("b").agg(c=Count(col("l")), s=Sum(col("i")))
+        tpu = q.collect().sort_by([("b", "ascending")])
+        cpu = q.collect_cpu().sort_by([("b", "ascending")])
+        assert tpu.column("c").to_pylist() == cpu.column("c").to_pylist()
+        assert tpu.column("s").to_pylist() == cpu.column("s").to_pylist()
