@@ -1,0 +1,1 @@
+"""Ecosystem datasources (reference L8: delta-lake/, iceberg/)."""

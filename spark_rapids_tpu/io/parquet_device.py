@@ -313,15 +313,16 @@ def _decode_chunk(buf: bytes, col_meta, optional: bool):
         if h.type is None or h.compressed is None or h.uncompressed is None:
             raise DeviceDecodeUnsupported("unparseable page header")
         pos += h.header_len
-        payload = _decompress(bytes(mv[pos:pos + h.compressed]),
-                              col_meta.compression, h.uncompressed)
-        pos += h.compressed
         if h.type == 2:  # dictionary page -> fall back (DICT data follows)
             raise DeviceDecodeUnsupported("dictionary-encoded chunk")
-        if h.type != 0:  # only v1 data pages
+        if h.type != 0:  # only v1 data pages; a v2 body is NOT fully
+            # compressed, so it must be rejected BEFORE decompression
             raise DeviceDecodeUnsupported(f"page type {h.type}")
         if h.encoding != 0:  # PLAIN
             raise DeviceDecodeUnsupported(f"value encoding {h.encoding}")
+        payload = _decompress(bytes(mv[pos:pos + h.compressed]),
+                              col_meta.compression, h.uncompressed)
+        pos += h.compressed
         body = memoryview(payload)
         if optional:
             if h.def_encoding != 3:  # RLE
@@ -366,12 +367,14 @@ def _merge_runs(run_parts):
 _OK_ENCODINGS = {"PLAIN", "RLE", "BIT_PACKED"}
 
 
-def file_supported(path: str, schema) -> None:
+def file_supported(path: str, schema):
     """Footer-only supportability check — raises DeviceDecodeUnsupported
     BEFORE any page bytes are read, so the caller can choose the host path
-    without decoding anything twice."""
+    without decoding anything twice. Returns the parsed ParquetFile so the
+    decode pass doesn't re-parse the footer."""
     import pyarrow.parquet as pq
-    meta = pq.ParquetFile(path).metadata
+    pf = pq.ParquetFile(path)
+    meta = pf.metadata
     pq_schema = meta.schema
     col_index = {pq_schema.column(i).path: i
                  for i in range(len(pq_schema))}
@@ -396,18 +399,18 @@ def file_supported(path: str, schema) -> None:
                 raise DeviceDecodeUnsupported("dictionary-encoded chunk")
             if not set(cm.encodings) <= _OK_ENCODINGS:
                 raise DeviceDecodeUnsupported(f"encodings {cm.encodings}")
+    return pf
 
 
-def device_decode_file(path: str, schema, conf) -> Iterator:
-    """Yield one device ColumnarBatch per row group, decoding on the TPU.
-    Call file_supported() first: after the footer check passes, page-level
-    surprises raise (with a conf hint) rather than falling back mid-stream."""
+def device_decode_file(pf, path: str, schema) -> Iterator:
+    """Yield (device ColumnarBatch, host row count) per row group, decoding
+    on the TPU. `pf` is the ParquetFile file_supported() already parsed;
+    page-level surprises the footer can't reveal (e.g. v2 pages) raise
+    DeviceDecodeUnsupported for the caller's per-file fallback."""
     import jax.numpy as jnp
-    import pyarrow.parquet as pq
     from ..columnar.batch import ColumnarBatch
     from ..columnar.column import Column
 
-    pf = pq.ParquetFile(path)
     meta = pf.metadata
     pq_schema = meta.schema
     col_index = {pq_schema.column(i).path: i
@@ -433,14 +436,14 @@ def device_decode_file(path: str, schema, conf) -> Iterator:
                 if nvals != nrows:
                     raise DeviceDecodeUnsupported("page/row-group mismatch")
                 raw_dev = jnp.asarray(np.frombuffer(raw, np.uint8))
-                if optional:
+                if optional and run_parts:
                     kinds, counts, values, bitoffs, packed = \
                         _merge_runs(run_parts)
                     defined = _expand_def_levels(
                         jnp.asarray(kinds), jnp.asarray(counts),
                         jnp.asarray(values), jnp.asarray(bitoffs),
                         jnp.asarray(packed), cap)
-                else:
+                else:  # required column, or a 0-row row group (no pages)
                     defined = jnp.arange(cap) < nrows
                 npname = _PHYS_TO_NP[cm.physical_type]
                 pad = cap * np.dtype(npname).itemsize + 8
@@ -453,4 +456,4 @@ def device_decode_file(path: str, schema, conf) -> Iterator:
                     data = data.astype(dt.np_dtype)
                 cols.append(Column(dt, data, validity))
             yield ColumnarBatch(schema, tuple(cols),
-                                jnp.asarray(nrows, jnp.int32))
+                                jnp.asarray(nrows, jnp.int32)), nrows

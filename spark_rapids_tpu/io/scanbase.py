@@ -8,6 +8,7 @@ library supports it (parquet filters)."""
 
 from __future__ import annotations
 
+from struct import error as struct_error
 from typing import Callable, Iterator, List, Optional, Sequence
 
 import pyarrow as pa
@@ -111,37 +112,41 @@ class TpuFileScanExec(_TpuExec):
     def do_execute(self):
         from ..columnar.batch import batch_from_arrow
         if self.cpu_scan.format_name == "parquet" and \
+                not self.cpu_scan.options.get("filters") and \
                 self.conf.get(
                     "spark.rapids.sql.format.parquet.deviceDecode.enabled"):
-            done = yield from self._try_device_decode()
-            if done:
-                return
+            yield from self._parquet_batches()
+            return
         for t in self.cpu_scan.host_tables():
             b = batch_from_arrow(t)
             self.num_output_rows.add(t.num_rows)
             yield self._count_output(b)
 
-    def _try_device_decode(self):
-        """Device parquet decode, streamed one row group at a time. The
-        supportability decision is made up front from footers alone (no page
-        reads, nothing decoded twice); only then do batches flow. Returns
-        True when it produced the scan."""
+    def _host_decode_one(self, path: str):
+        from ..columnar.batch import batch_from_arrow
+        t = self.cpu_scan._postprocess(self.cpu_scan.decode_file(path))
+        return batch_from_arrow(t), t.num_rows
+
+    def _parquet_batches(self):
+        """Per-file device decode with per-file host fallback: the footer
+        gates cheaply up front (its ParquetFile is reused by the decode), a
+        file's batches are materialized before yielding so a page-level
+        surprise (e.g. v2 pages the footer can't reveal) falls just THAT
+        file back to pyarrow — never a crash, never a double decode of a
+        successful file."""
         from .parquet_device import (DeviceDecodeUnsupported,
                                      device_decode_file, file_supported)
         scan = self.cpu_scan
-        if scan.options.get("filters"):
-            return False  # row-group pruning stays on the pyarrow path
-        try:
-            for path in scan.paths:
-                file_supported(path, scan.output)
-        except (DeviceDecodeUnsupported, OSError, KeyError, IndexError,
-                AttributeError):
-            return False
         for path in scan.paths:
-            for b in device_decode_file(path, scan.output, self.conf):
-                self.num_output_rows.add(b.row_count())
+            try:
+                pf = file_supported(path, scan.output)
+                file_batches = list(device_decode_file(pf, path, scan.output))
+            except (DeviceDecodeUnsupported, OSError, KeyError, IndexError,
+                    AttributeError, ValueError, struct_error):
+                file_batches = [self._host_decode_one(path)]
+            for b, nrows in file_batches:
+                self.num_output_rows.add(nrows)
                 yield self._count_output(b)
-        return True
 
 
 def make_tpu_file_scan(plan: CpuFileScanExec, conf: TpuConf) -> TpuFileScanExec:

@@ -39,19 +39,16 @@ def write_plain(tmp_path, t, name="t.parquet", **kw):
 
 
 def _used_device_decode(session, path):
-    from spark_rapids_tpu.plan.overrides import Overrides
-    from spark_rapids_tpu.io.scanbase import TpuFileScanExec
+    from spark_rapids_tpu.io.parquet_device import (
+        DeviceDecodeUnsupported, device_decode_file, file_supported)
     df = session.read_parquet(path)
     session.initialize_device()
-    ov = Overrides(session.conf)
-    result = ov.apply(df.plan)
-    assert isinstance(result, TpuFileScanExec)
-    gen = result._try_device_decode()
     try:
-        first = next(gen)
-    except StopIteration as s:
-        return bool(s.value), None
-    return True, first
+        pf = file_supported(path, df.plan.output)
+        batches = list(device_decode_file(pf, path, df.plan.output))
+    except Exception:
+        return False, None
+    return True, batches[0][0] if batches else None
 
 
 class TestDeviceParquetDecode:
@@ -132,6 +129,23 @@ class TestDeviceParquetDecode:
         assert not used
         df = session.read_parquet(path)
         assert df.collect().num_rows == 300  # host path still works
+
+    def test_v2_pages_fall_back_cleanly(self, session, rng, tmp_path):
+        t = plain_table(rng, n=400)
+        path = str(tmp_path / "v2.parquet")
+        pq.write_table(t, path, use_dictionary=False,
+                       data_page_version="2.0")
+        df = session.read_parquet(path)  # must not crash
+        got = df.collect()
+        assert got.column("l").to_pylist() == \
+            pq.read_table(path).column("l").to_pylist()
+
+    def test_empty_file(self, session, tmp_path):
+        t = pa.table({"i": pa.array([], type=pa.int32())})
+        path = str(tmp_path / "empty.parquet")
+        pq.write_table(t, path, use_dictionary=False)
+        df = session.read_parquet(path)
+        assert df.collect().num_rows == 0
 
     def test_query_over_device_decoded_scan(self, session, rng, tmp_path):
         from spark_rapids_tpu.expr import Count, Sum, col
