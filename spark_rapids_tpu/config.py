@@ -206,8 +206,9 @@ register("spark.rapids.sql.format.parquet.deviceDecode.enabled", "bool", True,
 register("spark.rapids.sql.format.orc.enabled", "bool", True, "Enable TPU ORC scan.")
 register("spark.rapids.sql.format.csv.enabled", "bool", True, "Enable TPU CSV scan.")
 register("spark.rapids.sql.format.json.enabled", "bool", True, "Enable TPU JSON scan.")
-register("spark.rapids.sql.format.avro.enabled", "bool", False,
-         "Enable TPU Avro scan (requires host avro decoder; gated off when absent).")
+register("spark.rapids.sql.format.avro.enabled", "bool", True,
+         "Enable TPU Avro scan (built-in host object-container-file decoder, "
+         "io/avro.py; null + deflate codecs).")
 register("spark.rapids.cloudSchemes", "string", "s3,s3a,s3n,wasbs,gs,abfs,abfss",
          "URI schemes treated as cloud stores; selects MULTITHREADED reader under AUTO.")
 

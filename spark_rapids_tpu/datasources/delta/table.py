@@ -84,9 +84,16 @@ class DeltaTable:
         return sorted(out)
 
     def active_files(self, version: Optional[int] = None) -> List[str]:
-        """Replay add/remove actions up to `version` (inclusive)."""
+        """Replay add/remove actions up to `version` (inclusive). A version
+        that was never committed raises (Delta's VersionNotFoundException)
+        rather than silently clamping to the nearest snapshot."""
+        versions = self._versions()
+        if version is not None and version not in versions:
+            raise ValueError(
+                f"version {version} does not exist (available: "
+                f"{versions[0]}..{versions[-1]})")
         live: Dict[str, bool] = {}
-        for v in self._versions():
+        for v in versions:
             if version is not None and v > version:
                 break
             with open(os.path.join(self.log_dir, _commit_name(v))) as f:
@@ -121,19 +128,29 @@ class DeltaTable:
 
     # ------------------------------------------------------------- DML
     def delete(self, condition: Expression) -> int:
-        """DELETE FROM t WHERE condition; returns rows deleted."""
-        from ...expr import Not
-        before = self.read()
-        kept = self.to_df().filter(Not(condition)).collect()
-        self._rewrite(kept, op="DELETE")
+        """DELETE FROM t WHERE condition; returns rows deleted. SQL DELETE
+        semantics: only rows where the condition is TRUE go — a NULL
+        condition keeps the row (hence the coalesce before negating)."""
+        from ...expr import Coalesce, Not, lit
+        snap_v = self.version
+        before = self.read(snap_v)
+        df = self.session.from_arrow(before, label="delta")
+        kept = df.filter(Not(Coalesce(condition, lit(False)))).collect()
+        self._rewrite(kept, op="DELETE", read_version=snap_v)
         return before.num_rows - kept.num_rows
 
     def update(self, set_exprs: Dict[str, Expression],
                condition: Expression = None) -> int:
         """UPDATE t SET col = expr [WHERE condition]; returns rows updated."""
         from ...expr import If, col
-        df = self.to_df()
-        schema = self.read().schema
+        snap_v = self.version
+        current = self.read(snap_v)
+        schema = current.schema
+        unknown = set(set_exprs) - set(schema.names)
+        if unknown:
+            raise KeyError(f"UPDATE SET references non-existent column(s): "
+                           f"{sorted(unknown)}")
+        df = self.session.from_arrow(current, label="delta")
         projs = {}
         for name in schema.names:
             if name in set_exprs:
@@ -143,14 +160,18 @@ class DeltaTable:
                 projs[name] = new
             else:
                 projs[name] = col(name)
-        out = df.select(**projs).collect()
-        self._rewrite(out.cast(schema), op="UPDATE")
         if condition is None:
+            out = df.select(**projs).collect()
+            self._rewrite(out.cast(schema), op="UPDATE", read_version=snap_v)
             return out.num_rows
+        # single pass: the match marker rides the same projection
+        out = df.select(__upd=condition, **projs).collect()
         import pyarrow.compute as pc
-        marked = df.select(c=condition).collect()
-        return int(pc.sum(pc.fill_null(marked.column("c"), False)).as_py()
-                   or 0)
+        updated = int(pc.sum(pc.fill_null(out.column("__upd"), False))
+                      .as_py() or 0)
+        self._rewrite(out.select(schema.names).cast(schema), op="UPDATE",
+                      read_version=snap_v)
+        return updated
 
     def merge(self, source, on: Expression,
               when_matched_update: Optional[Dict[str, Expression]] = None,
@@ -169,7 +190,9 @@ class DeltaTable:
         from ...expr import Count, If, IsNotNull, Not, col, lit
         if when_matched_update and when_matched_delete:
             raise ValueError("choose update OR delete for the matched branch")
-        tgt_schema = self.read().schema
+        snap_v = self.version
+        current = self.read(snap_v)
+        tgt_schema = current.schema
         names = list(tgt_schema.names)
 
         # source with prefixed columns (collision-free combined row), plus an
@@ -180,9 +203,10 @@ class DeltaTable:
             [_SRC_PREFIX + n for n in src_tbl.schema.names])
         probe_name = _SRC_PREFIX + "__matched"
         src_prefixed = src_prefixed.append_column(
-            probe_name, pa.array([True] * src_tbl.num_rows))
+            probe_name, pa.array([True] * src_tbl.num_rows,
+                                 type=pa.bool_()))
         sdf = self.session.from_arrow(src_prefixed, label="merge-source")
-        tdf = self.to_df()
+        tdf = self.session.from_arrow(current, label="delta")
 
         # Delta error: a target row matched by multiple source rows is
         # ambiguous when a matched action exists
@@ -203,20 +227,28 @@ class DeltaTable:
             n_matched = 0
 
         # matched transform: LEFT join keeps every target row exactly once
-        joined = tdf.join(sdf, how="left", condition=on)
-        matched = IsNotNull(col(probe_name))
-        projs = {}
-        for name in names:
-            if when_matched_update and name in when_matched_update:
-                projs[name] = If(matched, when_matched_update[name],
-                                 col(name))
-            else:
-                projs[name] = col(name)
-        kept_df = self.session.from_arrow(
-            joined.select(__m=matched, **projs).collect(), label="merge-t")
-        if when_matched_delete:
-            kept_df = kept_df.filter(Not(col("__m")))
-        kept = kept_df.select(*names).collect()
+        # (the multiple-match check above guarantees <=1 source match). With
+        # NO matched action the join is skipped entirely — an insert-only
+        # MERGE must leave target rows untouched, and the left join would
+        # duplicate a target row matched by multiple source rows (legal when
+        # no matched clause exists).
+        if when_matched_update or when_matched_delete:
+            joined = tdf.join(sdf, how="left", condition=on)
+            matched = IsNotNull(col(probe_name))
+            projs = {}
+            for name in names:
+                if when_matched_update and name in when_matched_update:
+                    projs[name] = If(matched, when_matched_update[name],
+                                     col(name))
+                else:
+                    projs[name] = col(name)
+            kept_df = self.session.from_arrow(
+                joined.select(__m=matched, **projs).collect(), label="merge-t")
+            if when_matched_delete:
+                kept_df = kept_df.filter(Not(col("__m")))
+            kept = kept_df.select(*names).collect()
+        else:
+            kept = current
 
         inserted = 0
         parts = [kept.cast(tgt_schema)]
@@ -227,15 +259,23 @@ class DeltaTable:
             inserted = ins.num_rows
             parts.append(ins.cast(tgt_schema))
         result = pa.concat_tables(parts)
-        self._rewrite(result, op="MERGE")
+        self._rewrite(result, op="MERGE", read_version=snap_v)
         deleted = (n_matched if when_matched_delete else 0)
         return {"updated": n_matched if when_matched_update else 0,
                 "deleted": deleted, "inserted": inserted}
 
     # ------------------------------------------------------------- commit
-    def _rewrite(self, table: pa.Table, op: str) -> None:
-        """Full-rewrite transaction: remove all active files, add new parts."""
-        old = [os.path.relpath(f, self.path) for f in self.active_files()]
+    def _rewrite(self, table: pa.Table, op: str,
+                 read_version: Optional[int] = None) -> None:
+        """Full-rewrite transaction: remove the files of the snapshot the DML
+        READ, add new parts, and stake read_version+1 — so a commit that
+        landed between a DML's read and its write makes the O_EXCL stake
+        fail with DeltaConcurrentModification instead of silently clobbering
+        the interleaved commit (lost update)."""
+        if read_version is None:
+            read_version = self.version
+        old = [os.path.relpath(f, self.path)
+               for f in self.active_files(read_version)]
         fname = f"part-{uuid.uuid4().hex}.parquet"
         pq.write_table(table, os.path.join(self.path, fname))
         actions = [{"commitInfo": {"operation": op,
@@ -243,7 +283,7 @@ class DeltaTable:
         actions += [{"remove": {"path": p, "dataChange": True}} for p in old]
         actions.append({"add": {"path": fname, "size": os.path.getsize(
             os.path.join(self.path, fname)), "dataChange": True}})
-        _write_commit(self.log_dir, self.version + 1, actions)
+        _write_commit(self.log_dir, read_version + 1, actions)
 
 
 def _commit_name(v: int) -> str:

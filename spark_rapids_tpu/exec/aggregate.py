@@ -485,7 +485,14 @@ class TpuHashAggregateExec(UnaryTpuExec):
     def do_execute(self) -> Iterator[ColumnarBatch]:
         batches = list(self.child.execute())
         if not batches:
-            return
+            if self.group_exprs or self.mode == "partial":
+                # grouped agg over empty input is empty; a partial side may
+                # also emit nothing (the final side synthesizes the row)
+                return
+            # GLOBAL aggregate over zero input batches must still emit its
+            # one row (Spark: SELECT count(*) over empty input = 0) — run
+            # the kernel over a synthesized empty batch
+            batches = [self._empty_input_batch()]
         if self._has_single_pass():
             yield from self._single_pass_execute(batches)
             return
@@ -519,6 +526,17 @@ class TpuHashAggregateExec(UnaryTpuExec):
             yield self._count_output(out)
             return
         yield from self._multi_batch(batches)
+
+    def _empty_input_batch(self) -> ColumnarBatch:
+        """A 0-row device batch matching the child's output schema."""
+        import pyarrow as pa
+        from .. import types as T
+        from ..columnar.batch import batch_from_arrow
+        schema = self.child.output
+        t = pa.table(
+            [pa.array([], type=T.to_arrow(dt)) for dt in schema.types],
+            names=list(schema.names))
+        return batch_from_arrow(t)
 
     def _multi_batch(self, batches: List[ColumnarBatch]
                      ) -> Iterator[ColumnarBatch]:

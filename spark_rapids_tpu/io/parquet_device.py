@@ -36,7 +36,8 @@ import numpy as np
 from .. import types as T
 from ..columnar.padding import row_bucket
 
-__all__ = ["DeviceDecodeUnsupported", "device_decode_file"]
+__all__ = ["DeviceDecodeUnsupported", "decode_row_group",
+           "device_decode_file", "file_supported"]
 
 
 class DeviceDecodeUnsupported(Exception):
@@ -297,7 +298,17 @@ def _defined_count(part) -> int:
 
 def _decode_chunk(buf: bytes, col_meta, optional: bool):
     """One column chunk -> (raw value bytes, def-level run table or None,
-    num_values)."""
+    num_values). Malformed page streams surface as DeviceDecodeUnsupported
+    (not raw IndexError/struct.error) so callers can keep a NARROW fallback
+    net — a genuine code bug elsewhere must not be silently swallowed into
+    the host path."""
+    try:
+        return _decode_chunk_inner(buf, col_meta, optional)
+    except (IndexError, struct.error) as e:
+        raise DeviceDecodeUnsupported(f"malformed page stream: {e}") from e
+
+
+def _decode_chunk_inner(buf: bytes, col_meta, optional: bool):
     phys = col_meta.physical_type
     if phys not in _PHYS_TO_NP:
         raise DeviceDecodeUnsupported(f"physical type {phys}")
@@ -402,11 +413,15 @@ def file_supported(path: str, schema):
     return pf
 
 
-def device_decode_file(pf, path: str, schema) -> Iterator:
-    """Yield (device ColumnarBatch, host row count) per row group, decoding
-    on the TPU. `pf` is the ParquetFile file_supported() already parsed;
-    page-level surprises the footer can't reveal (e.g. v2 pages) raise
-    DeviceDecodeUnsupported for the caller's per-file fallback."""
+def decode_row_group(pf, f, rg: int, schema):
+    """Decode ONE row group on the TPU -> (device ColumnarBatch, row count).
+    `pf` is a parsed ParquetFile whose supportability file_supported()
+    already vouched for; `f` is an open binary handle on the same file.
+    Page-level surprises the footer can't reveal
+    (e.g. v2 pages) raise DeviceDecodeUnsupported so the caller can fall just
+    THIS row group back to the host (pf.read_row_group) — per-row-group
+    granularity keeps the stream lazy (one device batch live at a time, the
+    reference's chunked-reader discipline) with no double decode."""
     import jax.numpy as jnp
     from ..columnar.batch import ColumnarBatch
     from ..columnar.column import Column
@@ -415,45 +430,48 @@ def device_decode_file(pf, path: str, schema) -> Iterator:
     pq_schema = meta.schema
     col_index = {pq_schema.column(i).path: i
                  for i in range(len(pq_schema))}
+    rgm = meta.row_group(rg)
+    nrows = rgm.num_rows
+    cap = row_bucket(nrows)
+    cols = []
+    for name, dt in zip(schema.names, schema.types):
+        ci = col_index[name]
+        cm = rgm.column(ci)
+        pqcol = pq_schema.column(ci)
+        optional = pqcol.max_definition_level > 0
+        if pqcol.max_repetition_level > 0:
+            raise DeviceDecodeUnsupported("repeated column")
+        start = cm.dictionary_page_offset or cm.data_page_offset
+        f.seek(start)
+        buf = f.read(cm.total_compressed_size)
+        raw, run_parts, nvals = _decode_chunk(buf, cm, optional)
+        if nvals != nrows:
+            raise DeviceDecodeUnsupported("page/row-group mismatch")
+        raw_dev = jnp.asarray(np.frombuffer(raw, np.uint8))
+        if optional and run_parts:
+            kinds, counts, values, bitoffs, packed = _merge_runs(run_parts)
+            defined = _expand_def_levels(
+                jnp.asarray(kinds), jnp.asarray(counts),
+                jnp.asarray(values), jnp.asarray(bitoffs),
+                jnp.asarray(packed), cap)
+        else:  # required column, or a 0-row row group (no pages)
+            defined = jnp.arange(cap) < nrows
+        npname = _PHYS_TO_NP[cm.physical_type]
+        pad = cap * np.dtype(npname).itemsize + 8
+        if raw_dev.shape[0] < pad:
+            raw_dev = jnp.pad(raw_dev, (0, pad - raw_dev.shape[0]))
+        data, validity = _scatter_plain(raw_dev, defined, npname, cap)
+        if isinstance(dt, T.DateType):
+            data = data.astype(jnp.int32)
+        elif data.dtype != dt.np_dtype:
+            data = data.astype(dt.np_dtype)
+        cols.append(Column(dt, data, validity))
+    return ColumnarBatch(schema, tuple(cols),
+                         jnp.asarray(nrows, jnp.int32)), nrows
 
+
+def device_decode_file(pf, path: str, schema) -> Iterator:
+    """Yield (device ColumnarBatch, row count) per row group, streaming."""
     with open(path, "rb") as f:
-        for rg in range(meta.num_row_groups):
-            rgm = meta.row_group(rg)
-            nrows = rgm.num_rows
-            cap = row_bucket(nrows)
-            cols = []
-            for name, dt in zip(schema.names, schema.types):
-                ci = col_index[name]
-                cm = rgm.column(ci)
-                pqcol = pq_schema.column(ci)
-                optional = pqcol.max_definition_level > 0
-                if pqcol.max_repetition_level > 0:
-                    raise DeviceDecodeUnsupported("repeated column")
-                start = cm.dictionary_page_offset or cm.data_page_offset
-                f.seek(start)
-                buf = f.read(cm.total_compressed_size)
-                raw, run_parts, nvals = _decode_chunk(buf, cm, optional)
-                if nvals != nrows:
-                    raise DeviceDecodeUnsupported("page/row-group mismatch")
-                raw_dev = jnp.asarray(np.frombuffer(raw, np.uint8))
-                if optional and run_parts:
-                    kinds, counts, values, bitoffs, packed = \
-                        _merge_runs(run_parts)
-                    defined = _expand_def_levels(
-                        jnp.asarray(kinds), jnp.asarray(counts),
-                        jnp.asarray(values), jnp.asarray(bitoffs),
-                        jnp.asarray(packed), cap)
-                else:  # required column, or a 0-row row group (no pages)
-                    defined = jnp.arange(cap) < nrows
-                npname = _PHYS_TO_NP[cm.physical_type]
-                pad = cap * np.dtype(npname).itemsize + 8
-                if raw_dev.shape[0] < pad:
-                    raw_dev = jnp.pad(raw_dev, (0, pad - raw_dev.shape[0]))
-                data, validity = _scatter_plain(raw_dev, defined, npname, cap)
-                if isinstance(dt, T.DateType):
-                    data = data.astype(jnp.int32)
-                elif data.dtype != dt.np_dtype:
-                    data = data.astype(dt.np_dtype)
-                cols.append(Column(dt, data, validity))
-            yield ColumnarBatch(schema, tuple(cols),
-                                jnp.asarray(nrows, jnp.int32)), nrows
+        for rg in range(pf.metadata.num_row_groups):
+            yield decode_row_group(pf, f, rg, schema)

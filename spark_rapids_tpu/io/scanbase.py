@@ -122,31 +122,87 @@ class TpuFileScanExec(_TpuExec):
             self.num_output_rows.add(t.num_rows)
             yield self._count_output(b)
 
-    def _host_decode_one(self, path: str):
+    def _host_file_batches(self, path: str):
+        """Host decode of ONE file through FileBatchIterator so batchSizeRows
+        slicing still applies (a multi-GB file must not become one batch)."""
         from ..columnar.batch import batch_from_arrow
-        t = self.cpu_scan._postprocess(self.cpu_scan.decode_file(path))
-        return batch_from_arrow(t), t.num_rows
+        scan = self.cpu_scan
+        for t in FileBatchIterator([path], scan.decode_file, scan.conf,
+                                   format_name=scan.format_name):
+            t = scan._postprocess(t)
+            yield batch_from_arrow(t), t.num_rows
 
     def _parquet_batches(self):
-        """Per-file device decode with per-file host fallback: the footer
-        gates cheaply up front (its ParquetFile is reused by the decode), a
-        file's batches are materialized before yielding so a page-level
-        surprise (e.g. v2 pages the footer can't reveal) falls just THAT
-        file back to pyarrow — never a crash, never a double decode of a
-        successful file."""
+        """Device decode per ROW GROUP with per-row-group host fallback.
+
+        The footer gates each file cheaply up front (its ParquetFile is
+        reused by the decode). Supported files stream one row group at a
+        time — one device batch live at once — and a page-level surprise the
+        footer can't reveal (e.g. v2 pages) falls just THAT row group back
+        to pyarrow (pf.read_row_group), so nothing is ever decoded twice or
+        yielded twice. If NO file passes the footer check, the whole scan
+        delegates to the plain host path, preserving the COALESCING /
+        MULTITHREADED multi-file strategies. The fallback net is narrow by
+        design: only DeviceDecodeUnsupported (incl. malformed page streams,
+        wrapped in parquet_device) and I/O errors — a genuine code bug in
+        the decoder must crash, not silently degrade to the host path."""
+        from ..columnar.batch import batch_from_arrow
         from .parquet_device import (DeviceDecodeUnsupported,
-                                     device_decode_file, file_supported)
+                                     decode_row_group, file_supported)
         scan = self.cpu_scan
-        for path in scan.paths:
+
+        import pyarrow.parquet as pq
+        scan_names = list(scan.output.names)
+
+        def check(path) -> bool:
+            """Footer support sweep, run ONCE per file; only a flag is kept,
+            so no fd outlives its file (a scan over more files than
+            ulimit -n must not exhaust descriptors)."""
             try:
                 pf = file_supported(path, scan.output)
-                file_batches = list(device_decode_file(pf, path, scan.output))
-            except (DeviceDecodeUnsupported, OSError, KeyError, IndexError,
-                    AttributeError, ValueError, struct_error):
-                file_batches = [self._host_decode_one(path)]
-            for b, nrows in file_batches:
-                self.num_output_rows.add(nrows)
+            except (DeviceDecodeUnsupported, OSError, struct_error):
+                return False
+            close = getattr(pf, "close", None)
+            if close is not None:
+                close()
+            return True
+
+        supported = {p for p in scan.paths if check(p)}
+        if not supported:
+            # nothing is device-decodable: the plain host path keeps the
+            # COALESCING / MULTITHREADED multi-file strategies
+            for t in scan.host_tables():
+                b = batch_from_arrow(t)
+                self.num_output_rows.add(t.num_rows)
                 yield self._count_output(b)
+            return
+        for path in scan.paths:
+            if path not in supported:
+                for b, nrows in self._host_file_batches(path):
+                    self.num_output_rows.add(nrows)
+                    yield self._count_output(b)
+                continue
+            # re-open WITHOUT re-running the support sweep (the flag above
+            # answered that); if the file changed on disk since, the decode
+            # raises DeviceDecodeUnsupported and falls back per row group
+            pf = pq.ParquetFile(path)
+            try:
+                with open(path, "rb") as f:
+                    for rg in range(pf.metadata.num_row_groups):
+                        try:
+                            b, nrows = decode_row_group(pf, f, rg,
+                                                        scan.output)
+                        except (DeviceDecodeUnsupported, OSError,
+                                struct_error):
+                            t = scan._postprocess(pf.read_row_group(
+                                rg, columns=scan_names))
+                            b, nrows = batch_from_arrow(t), t.num_rows
+                        self.num_output_rows.add(nrows)
+                        yield self._count_output(b)
+            finally:
+                close = getattr(pf, "close", None)
+                if close is not None:
+                    close()
 
 
 def make_tpu_file_scan(plan: CpuFileScanExec, conf: TpuConf) -> TpuFileScanExec:

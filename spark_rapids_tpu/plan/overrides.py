@@ -625,15 +625,35 @@ def _c_file_scan(plan, children, conf):
     return make_tpu_file_scan(plan, conf)
 
 
+_file_scan_rules_registered = False
+
+
 def _register_file_scan_rules():
-    from ..io.scanbase import CpuFileScanExec
+    """Register scan exec rules for every io format. Lazy + idempotent: when a
+    user imports an io module directly, io.scanbase's import of plan.nodes
+    lands here mid-cycle before CpuFileScanExec exists — in that case skip and
+    re-run at first rule lookup (Overrides.apply)."""
+    global _file_scan_rules_registered
+    if _file_scan_rules_registered:
+        return
+    import sys
+    scanbase = sys.modules.get("spark_rapids_tpu.io.scanbase")
+    if scanbase is not None and not hasattr(scanbase, "CpuFileScanExec"):
+        # mid-import cycle (an io module triggered the plan import before
+        # scanbase finished defining its classes); retried at first rule
+        # lookup. A genuine ImportError in an io module must NOT be swallowed
+        # here — it would silently degrade every format to the CPU path — so
+        # outside this window the imports below fail loudly.
+        return
     from ..io.parquet import CpuParquetScanExec
     from ..io.csv import CpuCsvScanExec
     from ..io.json_ import CpuJsonScanExec
     from ..io.orc import CpuOrcScanExec
+    from ..io.avro import CpuAvroScanExec
     for cls in (CpuParquetScanExec, CpuCsvScanExec, CpuJsonScanExec,
-                CpuOrcScanExec):
+                CpuOrcScanExec, CpuAvroScanExec):
         exec_rule(cls, TypeSig.all_basic(), _c_file_scan)
+    _file_scan_rules_registered = True
 
 
 exec_rule(N.CpuScanExec, TypeSig.all_with_nested(), _c_scan)
@@ -740,6 +760,7 @@ class Overrides:
         """Phase 1 (wrapAndTagPlan analog): build the meta mirror tree and tag
         every node, WITHOUT converting — so cross-tree passes (CBO) can see
         the full tagging picture first."""
+        _register_file_scan_rules()  # lazy retry if module import was cyclic
         rule = _EXEC_RULES.get(type(plan))
         meta = PlanMeta(plan, self.conf, rule)
         for c in plan.children:

@@ -69,6 +69,12 @@ class TpuSession:
         from .io.orc import orc_scan_plan
         return DataFrame(self, orc_scan_plan(list(paths), self.conf, **options))
 
+    def read_avro(self, *paths, **options):
+        from .frontend import DataFrame
+        from .io.avro import avro_scan_plan
+        return DataFrame(self, avro_scan_plan(list(paths), self.conf,
+                                              **options))
+
     # --------------------------------------------------------------- execution
     def execute_plan(self, plan: PhysicalPlan, use_device: Optional[bool] = None):
         """Run a CPU plan through the override rewrite and execute; returns a

@@ -167,3 +167,70 @@ class TestMerge:
         for r in t.to_pylist():
             expect = 999 if 10 <= r["id"] < 20 else r["v"]
             assert got[r["id"]] == expect
+
+
+class TestDmlSemantics:
+    """Regression tests for SQL-exact DML corner cases."""
+
+    def test_delete_null_condition_keeps_row(self, session, tmp_path):
+        # DELETE only removes rows where the condition is TRUE; NULL keeps
+        t = pa.table({"id": pa.array([1, 2, 3], type=pa.int64()),
+                      "v": pa.array([5, None, -5], type=pa.int64())})
+        dt = DeltaTable.create(session, tmp_path / "t", t)
+        deleted = dt.delete(col("v") > lit(0))
+        assert deleted == 1
+        assert sort_py(dt.read()) == [
+            {"id": 2, "v": None}, {"id": 3, "v": -5}]
+
+    def test_update_null_condition_keeps_value(self, session, tmp_path):
+        t = pa.table({"id": pa.array([1, 2, 3], type=pa.int64()),
+                      "v": pa.array([5, None, -5], type=pa.int64())})
+        dt = DeltaTable.create(session, tmp_path / "t", t)
+        updated = dt.update({"id": lit(0)}, condition=col("v") > lit(0))
+        assert updated == 1
+        got = {r["v"]: r["id"] for r in dt.read().to_pylist()}
+        assert got[5] == 0 and got[None] == 2 and got[-5] == 3
+
+    def test_update_unknown_column_raises(self, session, rng, tmp_path):
+        dt = DeltaTable.create(session, tmp_path / "t", base_table(rng, 10))
+        before_version = dt.version
+        with pytest.raises(KeyError, match="bogus"):
+            dt.update({"bogus": lit(9)})
+        assert dt.version == before_version  # no no-op commit
+
+    def test_insert_only_merge_no_duplicates(self, session, tmp_path):
+        # multiple source matches are LEGAL with no matched clause, and the
+        # matched target row must appear exactly once afterwards
+        t = pa.table({"id": pa.array([1, 2, 3], type=pa.int64()),
+                      "v": pa.array([10, 20, 30], type=pa.int64())})
+        dt = DeltaTable.create(session, tmp_path / "t", t)
+        srct = pa.table({"id": pa.array([3, 3, 4], type=pa.int64()),
+                         "v": pa.array([99, 98, 40], type=pa.int64())})
+        stats = dt.merge(srct, on=col("id") == src("id"),
+                         when_not_matched_insert={"id": src("id"),
+                                                  "v": src("v")})
+        # both id=4 source rows? no - only id=4 is unmatched, inserted once
+        assert stats["inserted"] == 1
+        got = sort_py(dt.read())
+        assert got == [{"id": 1, "v": 10}, {"id": 2, "v": 20},
+                       {"id": 3, "v": 30}, {"id": 4, "v": 40}]
+
+    def test_merge_empty_source_noop(self, session, rng, tmp_path):
+        t = base_table(rng, 20)
+        dt = DeltaTable.create(session, tmp_path / "t", t)
+        empty = t.slice(0, 0).rename_columns(["id", "v", "w"])
+        stats = dt.merge(empty, on=col("id") == src("id"),
+                         when_matched_update={"v": src("v")},
+                         when_not_matched_insert={"id": src("id"),
+                                                  "v": src("v"),
+                                                  "w": src("w")})
+        assert stats == {"updated": 0, "deleted": 0, "inserted": 0}
+        assert sort_py(dt.read()) == sort_py(t)
+
+    def test_read_nonexistent_version_raises(self, session, rng, tmp_path):
+        dt = DeltaTable.create(session, tmp_path / "t", base_table(rng, 10))
+        dt.delete(col("id") < lit(5))  # version 1
+        with pytest.raises(ValueError, match="version 99"):
+            dt.read(version=99)
+        with pytest.raises(ValueError, match="version -5"):
+            dt.read(version=-5)
