@@ -64,6 +64,155 @@ def _running_sum(contrib, seg_start_idx):
     return c - base
 
 
+def _range_minmax(op, acc, lo, hi, cap):
+    """Per-row extremum over arbitrary inclusive index windows [lo, hi] via a
+    sparse table (range-minimum query): O(n log n) build of log-levels
+    m[k][i] = op over acc[i .. i+2^k-1], O(1) two-gather query per row.
+    This is the sliding-extremum kernel bounded-frame MIN/MAX needs — prefix
+    differences (the sum/count trick) don't apply to extrema. Caller
+    guarantees hi >= lo on queried rows (mask empty frames outside)."""
+    levels = [acc]
+    k = 1
+    while (1 << k) <= cap:
+        prev = levels[-1]
+        half = 1 << (k - 1)
+        idx2 = jnp.minimum(jnp.arange(cap) + half, cap - 1)
+        levels.append(op(prev, prev[idx2]))
+        k += 1
+    m = jnp.stack(levels)  # [L, cap]
+    ln = jnp.maximum(hi - lo + 1, 1).astype(jnp.int64)
+    j = (63 - jax.lax.clz(ln)).astype(jnp.int32)  # floor(log2(len))
+    right = jnp.clip(hi - (jnp.int64(1) << j.astype(jnp.int64)) + 1,
+                     0, cap - 1).astype(jnp.int32)
+    lo_s = jnp.clip(lo, 0, cap - 1)
+    return op(m[j, lo_s], m[j, right])
+
+
+def _lex_less(a_data, a_len, b_data, b_len):
+    """Per-row unsigned-byte lexicographic a < b over [n, W] byte matrices.
+    Rows are zero-padded past their length, so a shorter prefix compares
+    smaller at the first padding byte (strings containing NUL tie-break by
+    length, matching the zero-padded storage)."""
+    neq = a_data != b_data
+    any_neq = jnp.any(neq, axis=1)
+    fd = jnp.argmax(neq, axis=1)
+    r = jnp.arange(a_data.shape[0])
+    return jnp.where(any_neq, a_data[r, fd] < b_data[r, fd], a_len < b_len)
+
+
+def _seg_scan_str(part_start, data, lens, is_min):
+    """Segmented running lexicographic min/max over a string byte matrix."""
+
+    def combine(x, y):
+        xf, xa, xl = x
+        yf, ya, yl = y
+        better = _lex_less(ya, yl, xa, xl) if is_min else \
+            _lex_less(xa, xl, ya, yl)
+        pick_y = yf | better
+        return (xf | yf,
+                jnp.where(pick_y[:, None], ya, xa),
+                jnp.where(pick_y, yl, xl))
+
+    _, out_d, out_l = jax.lax.associative_scan(
+        combine, (part_start, data, lens))
+    return out_d, out_l
+
+
+def _search_value_range(env, frame, key: Vec, ascending: bool,
+                        nulls_first: bool):
+    """Per-row inclusive [lo, hi] row indices of a value-offset RANGE frame.
+
+    Rows are sorted by (partition, order key); on the sort axis the frame of
+    row i is the run of rows whose key lies in [key_i+lower, key_i+upper]
+    (descending order negates the key, which reduces to the same formula —
+    the reference evaluates these with cudf range-window kernels, here it is
+    a vectorized lexicographic binary search over (segment id, key)).
+    NULL-key rows never enter a value interval; a NULL current row frames
+    exactly its null peer group (Spark semantics, mirrored from the CPU
+    oracle in plan/nodes.py:_cpu_frame_bounds)."""
+    cap = env.cap
+    valid = key.validity & env.mask
+    # widen BEFORE negating: negating in a narrow dtype wraps at its minimum
+    # (e.g. -INT32_MIN == INT32_MIN in int32), breaking axis monotonicity
+    kd = key.data
+    if jnp.issubdtype(kd.dtype, jnp.integer):
+        kd = kd.astype(jnp.int64)
+    else:
+        kd = kd.astype(jnp.float64)
+    if not ascending:
+        kd = -kd
+    # after negation the on-axis key is ascending within a segment — EXCEPT
+    # at null rows, whose raw bytes are garbage. Replace them with the
+    # extreme matching their SORTED position so (gid, kd) stays monotone for
+    # the binary search; the [first_valid, last_valid] clamp below then
+    # drops them from every frame. NOTE the engine's sort convention
+    # (ops/rowops.py sort_keys_for): null_key = ~validity when nulls_first,
+    # which places null rows at the END of the run — so nulls_first=True
+    # means the LARGEST sentinel here.
+    nulls_at_end = nulls_first
+    in_frame = valid  # rows eligible to appear in any value frame
+    if jnp.issubdtype(kd.dtype, jnp.integer):
+        info = np.iinfo(np.int64)
+        kmin, kmax = jnp.int64(info.min), jnp.int64(info.max)
+        kd = jnp.where(valid, kd, kmax if nulls_at_end else kmin)
+        lo_t = kd + jnp.int64(frame.lower) if frame.lower is not None \
+            else jnp.full(cap, kmin)
+        hi_t = kd + jnp.int64(frame.upper) if frame.upper is not None \
+            else jnp.full(cap, kmax)
+    else:
+        kd = jnp.where(valid, kd, jnp.inf if nulls_at_end else -jnp.inf)
+        # targets first, from the UNPINNED key: a NaN current row must get
+        # an empty frame (CPU oracle: NaN fails every comparison), which the
+        # NaN-propagated targets below become ([+inf, -inf])
+        lo_t = kd + frame.lower if frame.lower is not None \
+            else jnp.full(cap, -jnp.inf)
+        hi_t = kd + frame.upper if frame.upper is not None \
+            else jnp.full(cap, jnp.inf)
+        lo_t = jnp.where(jnp.isnan(lo_t), jnp.inf, lo_t)
+        hi_t = jnp.where(jnp.isnan(hi_t), -jnp.inf, hi_t)
+        # NaN keys sort to one end (greatest ascending, first descending =
+        # start of the negated axis) and never satisfy a value interval —
+        # pin them to that end's infinity for axis monotonicity and exclude
+        # them from the eligible run
+        isnan = jnp.isnan(kd)
+        kd = jnp.where(isnan, jnp.inf if ascending else -jnp.inf, kd)
+        in_frame = in_frame & ~isnan
+    n32 = env.n32
+    first_valid = jax.ops.segment_min(
+        jnp.where(in_frame, n32, env.cap), env.gid,
+        num_segments=cap)[env.gid]
+    last_valid = jax.ops.segment_max(
+        jnp.where(in_frame, n32, -1), env.gid, num_segments=cap)[env.gid]
+
+    gid = env.gid
+
+    def search(target, strict: bool):
+        """First index idx with (gid, key)[idx] lexicographically at/after
+        (gid_i, target): >= for strict=False, > for strict=True."""
+        lo_b = jnp.zeros(cap, jnp.int32)
+        hi_b = jnp.full(cap, cap, jnp.int32)
+        for _ in range(int(cap).bit_length()):
+            mid = (lo_b + hi_b) // 2
+            ms = jnp.clip(mid, 0, cap - 1)
+            g = gid[ms]
+            v = kd[ms]
+            if strict:
+                after = (g > gid) | ((g == gid) & (v > target))
+            else:
+                after = (g > gid) | ((g == gid) & (v >= target))
+            after = after & (mid < cap)
+            hi_b = jnp.where(after, mid, hi_b)
+            lo_b = jnp.where(after, lo_b, mid + 1)
+        return lo_b
+
+    flo = jnp.maximum(search(lo_t, strict=False), first_valid)
+    fhi = jnp.minimum(search(hi_t, strict=True) - 1, last_valid)
+    # NULL current row: frame = its null peer group
+    flo = jnp.where(valid, flo, env.peer_start_idx)
+    fhi = jnp.where(valid, fhi, env.peer_end_idx)
+    return flo, fhi
+
+
 class TpuWindowExec(UnaryTpuExec):
     def __init__(self, window_exprs: Sequence[Tuple[WindowFunction, str]],
                  partition_spec: Sequence[Expression],
@@ -127,7 +276,9 @@ class TpuWindowExec(UnaryTpuExec):
 
             env = _WinEnv(ctx, svecs, mask, cap, n32, part_start, gid,
                           seg_start_idx, seg_end_idx, cnt, peer_start, pgid,
-                          peer_start_idx, peer_end_idx, has_order)
+                          peer_start_idx, peer_end_idx, has_order,
+                          sorder_keyvecs=sorder,
+                          order_spec=[(a, nf) for _, a, nf in bound_order])
             out = list(svecs)
             for fn, _ in bound_fns:
                 out.append(_eval_device(fn, env))
@@ -159,7 +310,8 @@ class TpuWindowExec(UnaryTpuExec):
 class _WinEnv:
     def __init__(self, ctx, svecs, mask, cap, n32, part_start, gid,
                  seg_start_idx, seg_end_idx, cnt, peer_start, pgid,
-                 peer_start_idx, peer_end_idx, has_order):
+                 peer_start_idx, peer_end_idx, has_order,
+                 sorder_keyvecs=(), order_spec=()):
         self.ctx = ctx
         self.svecs = svecs
         self.mask = mask
@@ -175,6 +327,8 @@ class _WinEnv:
         self.peer_start_idx = peer_start_idx
         self.peer_end_idx = peer_end_idx
         self.has_order = has_order
+        self.sorder_keyvecs = list(sorder_keyvecs)  # sorted order-key Vecs
+        self.order_spec = list(order_spec)          # [(ascending, nulls_first)]
 
 
 def _eval_device(fn: WindowFunction, env: _WinEnv) -> Vec:
@@ -272,18 +426,28 @@ def _eval_device_agg(fn: WindowAggregate, env: _WinEnv) -> Vec:
         return Vec(v.dtype, data, v.validity[safe] & ~empty & env.mask[safe],
                    None if v.lengths is None else v.lengths[safe])
 
+    is_string = v is not None and v.is_string
+
     # accumulation dtype + contribution vector
     if name == "Count":
         acc = valid.astype(jnp.int64)
-        zero = jnp.int64(0)
     elif name in ("Sum", "Average"):
         acc_np = out_t.np_dtype if name == "Sum" else np.dtype(np.float64)
         acc = jnp.where(valid, v.data, v.data.dtype.type(0)).astype(acc_np)
-        zero = acc_np.type(0)
-    elif name in ("Min", "Max"):
+    elif name in ("Min", "Max") and not is_string:
         op = name.lower()
         neutral = _neutral(op, v.data.dtype)
         acc = jnp.where(valid, v.data, neutral)
+    elif name in ("Min", "Max"):
+        # string min/max: neutralize invalid rows so the lex scan skips them
+        # (min -> 0xFF row, lex-greater than any utf-8; max -> empty row)
+        w = v.data.shape[1]
+        if name == "Min":
+            sdat = jnp.where(valid[:, None], v.data, jnp.uint8(0xFF))
+            slen = jnp.where(valid, v.lengths, w).astype(jnp.int32)
+        else:
+            sdat = jnp.where(valid[:, None], v.data, jnp.uint8(0))
+            slen = jnp.where(valid, v.lengths, 0).astype(jnp.int32)
     else:
         raise NotImplementedError(f"{name} over a window")
 
@@ -294,6 +458,11 @@ def _eval_device_agg(fn: WindowAggregate, env: _WinEnv) -> Vec:
         if name == "Count":
             return Vec(T.LONG, vcount_all, jnp.ones(env.cap, bool))
         if name in ("Min", "Max"):
+            if is_string:
+                run_d, run_l = _seg_scan_str(env.part_start, sdat, slen,
+                                             name == "Min")
+                e = env.seg_end_idx
+                return Vec(v.dtype, run_d[e], vcount_all > 0, run_l[e])
             seg = jax.ops.segment_min if name == "Min" else jax.ops.segment_max
             out = seg(acc, env.gid, num_segments=env.cap)[env.gid]
             return Vec(v.dtype, out, vcount_all > 0)
@@ -306,6 +475,14 @@ def _eval_device_agg(fn: WindowAggregate, env: _WinEnv) -> Vec:
 
     if running_rows or running_range:
         run_cnt = _running_sum(valid.astype(jnp.int64), env.seg_start_idx)
+        if name in ("Min", "Max") and is_string:
+            run_d, run_l = _seg_scan_str(env.part_start, sdat, slen,
+                                         name == "Min")
+            if running_range:
+                run_d = run_d[env.peer_end_idx]
+                run_l = run_l[env.peer_end_idx]
+                run_cnt = run_cnt[env.peer_end_idx]
+            return Vec(v.dtype, run_d, run_cnt > 0, run_l)
         if name in ("Min", "Max"):
             op = jnp.minimum if name == "Min" else jnp.maximum
             run = _seg_scan(op, env.part_start, acc)
@@ -326,21 +503,23 @@ def _eval_device_agg(fn: WindowAggregate, env: _WinEnv) -> Vec:
         dt = v.dtype if name in ("Min", "Max") else out_t
         return Vec(dt, run, run_cnt > 0)
 
-    # bounded ROW frame: prefix-sum differences (sum/count/avg only — the
-    # planner tags min/max bounded frames onto the CPU)
-    assert isinstance(frame, RowFrame)
-    if name in ("Min", "Max"):
-        raise NotImplementedError("bounded-frame min/max runs on CPU")
+    # bounded ROW frame or value-offset RANGE frame: per-row [lo, hi] index
+    # windows — prefix-sum differences for sum/count/avg, sparse-table range
+    # queries for min/max (the planner keeps bounded STRING min/max on CPU)
     lo, hi = _frame_bounds(frame, env)
     empty = hi < lo
     lo_s = jnp.clip(lo, 0, env.cap - 1)
     hi_s = jnp.clip(hi, 0, env.cap - 1)
-    p_acc = jnp.cumsum(acc)
     p_cnt = jnp.cumsum(valid.astype(jnp.int64))
-    wsum = p_acc[hi_s] - p_acc[lo_s] + acc[lo_s]
     wcnt = p_cnt[hi_s] - p_cnt[lo_s] + valid[lo_s].astype(jnp.int64)
-    wsum = jnp.where(empty, 0, wsum)
     wcnt = jnp.where(empty, 0, wcnt)
+    if name in ("Min", "Max"):
+        op = jnp.minimum if name == "Min" else jnp.maximum
+        out = _range_minmax(op, acc, lo_s, hi_s, env.cap)
+        return Vec(v.dtype, out, (wcnt > 0) & ~empty)
+    p_acc = jnp.cumsum(acc)
+    wsum = p_acc[hi_s] - p_acc[lo_s] + acc[lo_s]
+    wsum = jnp.where(empty, 0, wsum)
     if name == "Count":
         return Vec(T.LONG, wcnt, jnp.ones(env.cap, bool))
     if name == "Average":
@@ -360,4 +539,9 @@ def _frame_bounds(frame, env: _WinEnv):
     assert isinstance(frame, RangeFrame)
     if frame.lower is None and frame.upper is None:
         return env.seg_start_idx, env.seg_end_idx
-    return env.seg_start_idx, env.peer_end_idx
+    if frame.lower is None and frame.upper == 0:
+        return env.seg_start_idx, env.peer_end_idx
+    # value-offset RANGE frame (planner guarantees one numeric order column)
+    ascending, nulls_first = env.order_spec[0]
+    return _search_value_range(env, frame, env.sorder_keyvecs[0],
+                               ascending, nulls_first)

@@ -355,9 +355,20 @@ def read_avro_table(path: str) -> pa.Table:
 
 
 def infer_avro_schema(path: str) -> pa.Schema:
+    # read the header incrementally: most headers fit in 1 MiB, but a wide
+    # schema's metadata can exceed any fixed prefix — grow until it parses
+    size = 1 << 20
     with open(path, "rb") as f:
-        head = f.read(1 << 20)
-    schema, _codec, _sync, _pos = read_header(head)
+        while True:
+            f.seek(0)
+            head = f.read(size)
+            try:
+                schema, _codec, _sync, _pos = read_header(head)
+                break
+            except AvroError:
+                if len(head) < size:  # whole file read and still bad
+                    raise
+                size *= 4
     if not (isinstance(schema, dict) and schema.get("type") == "record"):
         raise AvroError("top-level avro schema must be a record")
     named: dict = {}

@@ -280,7 +280,11 @@ def _decompress(data: bytes, codec: str, size: int) -> bytes:
     name = _CODEC.get(codec)
     if name is None:
         raise DeviceDecodeUnsupported(f"codec {codec}")
-    return pa.decompress(data, decompressed_size=size, codec=name)
+    try:
+        return pa.decompress(data, decompressed_size=size, codec=name)
+    except (pa.ArrowInvalid, ValueError, OSError) as e:
+        # corrupt compressed page: a documented fallback mode, not a crash
+        raise DeviceDecodeUnsupported(f"decompress failed: {e}") from e
 
 
 def _defined_count(part) -> int:
@@ -435,7 +439,10 @@ def decode_row_group(pf, f, rg: int, schema):
     cap = row_bucket(nrows)
     cols = []
     for name, dt in zip(schema.names, schema.types):
-        ci = col_index[name]
+        ci = col_index.get(name)
+        if ci is None:
+            # file changed on disk since the footer support check
+            raise DeviceDecodeUnsupported(f"column {name} missing from file")
         cm = rgm.column(ci)
         pqcol = pq_schema.column(ci)
         optional = pqcol.max_definition_level > 0

@@ -324,22 +324,19 @@ def _tag_window_agg(meta: ExprMeta) -> None:
         meta.will_not_work(f"{name} is not supported over a window on TPU")
         return
     frame = e.frame
-    if isinstance(frame, WX.RangeFrame) and not (
-            frame.lower is None and frame.upper in (0, None)):
-        meta.will_not_work(
-            "only RANGE UNBOUNDED PRECEDING..CURRENT ROW/UNBOUNDED FOLLOWING "
-            "is supported on TPU (value-offset range frames run on CPU)")
-    bounded = isinstance(frame, WX.RowFrame) and not (
+    value_range = isinstance(frame, WX.RangeFrame) and not (
         frame.lower is None and frame.upper in (0, None))
-    if bounded and name in ("Min", "Max"):
-        meta.will_not_work("bounded-frame MIN/MAX runs on CPU "
-                           "(needs a sliding extremum kernel)")
+    bounded = value_range or (isinstance(frame, WX.RowFrame) and not (
+        frame.lower is None and frame.upper in (0, None)))
     child = e.func.child
-    if child is not None and name in ("Min", "Max"):
+    if child is not None and name in ("Min", "Max") and bounded:
+        # running/unbounded string min/max rides the segmented lex scan;
+        # arbitrary index windows would need a sparse table of byte
+        # matrices — stays on CPU
         try:
             if isinstance(child.data_type, T.StringType):
                 meta.will_not_work(
-                    f"window {name} over STRING runs on CPU")
+                    f"bounded-frame window {name} over STRING runs on CPU")
         except ValueError:
             pass
     if getattr(e.func, "ignore_nulls", False) and name in ("First", "Last"):
@@ -581,6 +578,21 @@ def _tag_window(m: PlanMeta):
     for f, name in m.plan._bound_fns:
         if f.requires_order and not has_order:
             m.will_not_work(f"window function {name} requires an ORDER BY")
+        if isinstance(f, WX.WindowAggregate) and \
+                isinstance(f.frame, WX.RangeFrame) and not (
+                    f.frame.lower is None and f.frame.upper in (0, None)):
+            # value-offset RANGE frames: Spark restricts these to a single
+            # orderable numeric order column; the device binary search
+            # additionally needs a sortable numeric axis
+            if len(m.plan.order_spec) != 1:
+                m.will_not_work("value-offset RANGE frames require exactly "
+                                "one order column")
+                continue
+            key_t = m.plan._bound_order[0][0].data_type
+            if not (T.is_numeric(key_t) or
+                    isinstance(key_t, (T.DateType, T.TimestampType))):
+                m.will_not_work("value-offset RANGE frames need a numeric "
+                                "order column")
 
 
 def _c_window(plan, children, conf):

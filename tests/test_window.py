@@ -177,9 +177,9 @@ class TestWindowAggregates:
 
 
 class TestRangeValueFrames:
-    def test_value_offset_range_cpu_fallback(self, session):
-        # value-offset RANGE frames run on the CPU engine (tagged fallback);
-        # verify the oracle computes true peer-value windows, not running sums
+    def test_value_offset_range_on_device(self, session):
+        # value-offset RANGE frames run ON DEVICE (binary-searched bounds);
+        # verify true peer-value windows, not running sums, vs hand oracle
         t = pa.table({
             "g": pa.array([1, 1, 1, 1], type=pa.int32()),
             "ts": pa.array([1, 2, 3, 4], type=pa.int64()),
@@ -190,10 +190,50 @@ class TestRangeValueFrames:
         q = df.window(partition_by=["g"], order_by=["ts"],
                       s=WindowAggregate(Sum(col("v")), RangeFrame(0, 0)),
                       s2=WindowAggregate(Sum(col("v")), RangeFrame(-1, 1)))
-        out = q.collect_cpu()
+        assert "range frames" not in q.explain()
+        out = assert_same(q, sort_by=["ts"])
         assert out.column("s").to_pylist() == [1.0, 2.0, 3.0, 4.0]
         assert out.column("s2").to_pylist() == [3.0, 6.0, 9.0, 7.0]
-        assert "range frames run on CPU" in q.explain()
+
+    def test_value_range_fuzz(self, session, rng):
+        # value gaps, duplicate keys, nulls in order key and value, desc
+        from spark_rapids_tpu.expr import Max, Min, RangeFrame
+        n = 300
+        key_nulls = rng.random(n) < 0.1
+        t = pa.table({
+            "g": pa.array(rng.integers(0, 8, n), type=pa.int32()),
+            "k": pa.array(rng.integers(0, 60, n), type=pa.int64(),
+                          mask=key_nulls),
+            "v": pa.array(np.where(rng.random(n) < 0.15, None,
+                                   rng.normal(0, 10, n).round(2)),
+                          type=pa.float64()),
+        })
+        df = session.from_arrow(t)
+        q = df.window(partition_by=["g"], order_by=["k"],
+                      s=WindowAggregate(Sum(col("v")), RangeFrame(-5, 5)),
+                      c=WindowAggregate(Count(col("v")), RangeFrame(-3, 0)),
+                      mn=WindowAggregate(Min(col("v")), RangeFrame(0, 10)),
+                      a=WindowAggregate(Average(col("v")),
+                                        RangeFrame(None, 4)),
+                      mx=WindowAggregate(Max(col("v")), RangeFrame(-7, None)))
+        # prefix-difference sums reorder float additions vs the CPU loop
+        assert_same(q, sort_by=["g", "k", "v"], approx_cols=("s", "a"))
+
+    def test_value_range_descending_float(self, session, rng):
+        from spark_rapids_tpu.expr import Min, RangeFrame
+        n = 200
+        t = pa.table({
+            "g": pa.array(rng.integers(0, 5, n), type=pa.int32()),
+            "k": pa.array(rng.normal(0, 3, n).round(1), type=pa.float64()),
+            "v": pa.array(rng.integers(-50, 50, n), type=pa.int64()),
+        })
+        df = session.from_arrow(t)
+        q = df.window(partition_by=["g"],
+                      order_by=[(col("k"), False, False)],
+                      s=WindowAggregate(Sum(col("v")), RangeFrame(-2.0, 2.0)),
+                      mn=WindowAggregate(Min(col("v")),
+                                         RangeFrame(-1.5, 0.0)))
+        assert_same(q, sort_by=["g", "k", "v"])
 
     def test_count_empty_frame_is_zero(self, session):
         t = pa.table({
@@ -249,9 +289,89 @@ class TestWindowFallback:
         assert_same(q, sort_by=SORT)
         assert "requires an ORDER BY" in q.explain()
 
-    def test_bounded_min_falls_back(self, session, rng):
-        df = session.from_arrow(window_table(rng, n=50))
+    def test_bounded_minmax_on_device(self, session, rng):
+        # bounded-frame MIN/MAX rides the sparse-table range query on device
+        df = session.from_arrow(window_table(rng, n=400))
         q = df.window(partition_by=["g"], order_by=["ts", "i"],
-                      m=WindowAggregate(Min(col("i")), RowFrame(-1, 1)))
+                      m=WindowAggregate(Min(col("i")), RowFrame(-1, 1)),
+                      mx=WindowAggregate(Max(col("v")), RowFrame(-3, 0)),
+                      m2=WindowAggregate(Min(col("v")), RowFrame(0, 7)),
+                      me=WindowAggregate(Max(col("i")), RowFrame(2, 4)))
+        assert "MIN/MAX" not in q.explain()
         assert_same(q, sort_by=SORT)
-        assert "MIN/MAX" in q.explain()
+
+    def test_string_minmax_on_device(self, session, rng):
+        # unbounded + running string min/max ride the segmented lex scan
+        from spark_rapids_tpu.expr import RangeFrame
+        df = session.from_arrow(window_table(rng, n=300))
+        q = df.window(partition_by=["g"], order_by=["ts", "i"],
+                      mn=WindowAggregate(Min(col("s")),
+                                         RowFrame(None, None)),
+                      mx=WindowAggregate(Max(col("s")),
+                                         RowFrame(None, None)),
+                      rmn=WindowAggregate(Min(col("s")), RowFrame(None, 0)),
+                      rmx=WindowAggregate(Max(col("s")),
+                                          RangeFrame(None, 0)))
+        assert "STRING" not in q.explain()
+        assert_same(q, sort_by=SORT)
+
+    def test_bounded_string_minmax_falls_back(self, session, rng):
+        df = session.from_arrow(window_table(rng, n=60))
+        q = df.window(partition_by=["g"], order_by=["ts", "i"],
+                      m=WindowAggregate(Min(col("s")), RowFrame(-1, 1)))
+        assert_same(q, sort_by=SORT)
+        assert "STRING" in q.explain()
+
+
+class TestValueRangeEdges:
+    def test_nan_order_keys(self, session, rng):
+        from spark_rapids_tpu.expr import Min, RangeFrame
+        n = 120
+        k = rng.normal(0, 5, n).round(1)
+        k[rng.random(n) < 0.1] = np.nan
+        t = pa.table({
+            "g": pa.array(rng.integers(0, 4, n), type=pa.int32()),
+            "k": pa.array(k, type=pa.float64()),
+            "v": pa.array(rng.integers(0, 100, n), type=pa.int64()),
+        })
+        df = session.from_arrow(t)
+        for asc in (True, False):
+            q = df.window(partition_by=["g"],
+                          order_by=[(col("k"), asc, True)],
+                          s=WindowAggregate(Sum(col("v")),
+                                            RangeFrame(-2.0, 2.0)),
+                          mn=WindowAggregate(Min(col("v")),
+                                            RangeFrame(None, 1.0)))
+            assert_same(q, sort_by=["g", "k", "v"])
+
+    def test_first_last_value_range(self, session, rng):
+        from spark_rapids_tpu.expr import RangeFrame
+        n = 150
+        t = pa.table({
+            "g": pa.array(rng.integers(0, 5, n), type=pa.int32()),
+            "k": pa.array(rng.integers(0, 30, n), type=pa.int64()),
+            "v": pa.array(np.where(rng.random(n) < 0.2, None,
+                                   rng.integers(0, 99, n)),
+                          type=pa.int64()),
+        })
+        df = session.from_arrow(t)
+        q = df.window(partition_by=["g"], order_by=["k"],
+                      f=WindowAggregate(First(col("v")), RangeFrame(-4, 4)),
+                      l=WindowAggregate(Last(col("v")), RangeFrame(-4, 4)))
+        assert_same(q, sort_by=["g", "k", "v"])
+
+    def test_nulls_first_false_value_range(self, session, rng):
+        from spark_rapids_tpu.expr import RangeFrame
+        n = 100
+        key_nulls = rng.random(n) < 0.15
+        t = pa.table({
+            "g": pa.array(rng.integers(0, 3, n), type=pa.int32()),
+            "k": pa.array(rng.integers(0, 20, n), type=pa.int64(),
+                          mask=key_nulls),
+            "v": pa.array(rng.integers(0, 50, n), type=pa.int64()),
+        })
+        df = session.from_arrow(t)
+        q = df.window(partition_by=["g"],
+                      order_by=[(col("k"), True, False)],
+                      c=WindowAggregate(Count(col("v")), RangeFrame(-3, 3)))
+        assert_same(q, sort_by=["g", "k", "v"])
