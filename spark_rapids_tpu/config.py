@@ -188,6 +188,11 @@ register("spark.rapids.sql.join.subPartition.rows", "int", 4 << 20,
          "Build sides larger than this hash-split into key-aligned "
          "sub-partitions joined pairwise (GpuSubPartitionHashJoin analog).")
 
+register("spark.rapids.sql.autoBroadcastJoinThreshold", "int", 10 << 20,
+         "Build sides estimated at or below this many bytes join via a "
+         "host-serialized broadcast exchange (GpuBroadcastExchangeExec "
+         "analog) instead of a shuffled join; -1 disables broadcast joins.")
+
 # I/O -------------------------------------------------------------------------------
 register("spark.rapids.sql.format.parquet.enabled", "bool", True,
          "Enable TPU parquet scan/write.")

@@ -1,0 +1,71 @@
+"""Broadcast exchange (reference `GpuBroadcastExchangeExec.scala:94,320`:
+`SerializeConcatHostBuffersDeserializeBatch` builds the broadcast table on
+device, serializes it to HOST buffers once, and every consumer re-materializes
+it on its device).
+
+TPU shape of the same idea: the child executes exactly once (across ALL
+consumers — `ReusedExchangeExec` semantics come free from instance caching);
+the result is framed through the shuffle serializer into one host blob, the
+device copy is dropped, and each `do_execute()` deserializes the blob into a
+fresh device batch via a single H2D transfer. The host blob — not a live
+device array — is the canonical broadcast payload, exactly like the
+reference's host-buffer broadcast, which keeps the (possibly many) consumers
+from pinning device memory between uses and makes the payload what a
+multi-host driver would ship over DCN."""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterator, Optional
+
+from ..columnar.batch import ColumnarBatch, Schema
+from ..utils import metrics as M
+from .base import TpuExec, UnaryTpuExec
+from .coalesce import concat_batches
+
+__all__ = ["TpuBroadcastExchangeExec"]
+
+
+class TpuBroadcastExchangeExec(UnaryTpuExec):
+    def __init__(self, child: TpuExec, conf=None):
+        super().__init__([child], conf)
+        self._blob: Optional[bytes] = None
+        self._empty = False
+        self._lock = threading.Lock()
+        self.collect_time = self.metrics.create(M.COLLECT_TIME, M.ESSENTIAL)
+        self.build_time = self.metrics.create(M.BUILD_TIME, M.MODERATE)
+        self.data_size = self.metrics.create(M.DATA_SIZE, M.ESSENTIAL)
+
+    @property
+    def output(self) -> Schema:
+        return self.child.output
+
+    def _materialize_blob(self) -> None:
+        from ..shuffle.serializer import serialize_batch
+        with self._lock:
+            if self._blob is not None or self._empty:
+                return
+            with self.collect_time.timed():
+                batches = list(self.child.execute())
+            if not batches:
+                self._empty = True
+                return
+            with self.build_time.timed():
+                batch = concat_batches(batches)
+                del batches
+                codec = self.conf.get("spark.rapids.shuffle.compression.codec")
+                self._blob = serialize_batch(batch, codec)
+            self.data_size.add(len(self._blob))
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        self._materialize_blob()
+        if self._empty:
+            return
+        from ..shuffle.serializer import concat_host_tables, deserialize_table
+        table, _ = deserialize_table(self._blob)
+        out = concat_host_tables([table])
+        self.num_output_rows.add(out.row_count())
+        yield self._count_output(out)
+
+    def _arg_string(self):
+        return "[host-serialized]"

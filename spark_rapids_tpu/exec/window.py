@@ -33,7 +33,8 @@ from ..expr.base import Expression, Vec, bind_references
 from ..expr.windowexprs import (CumeDist, DenseRank, Lag, Lead, NTile,
                                 PercentRank, RangeFrame, Rank, RowFrame,
                                 RowNumber, WindowAggregate, WindowFunction,
-                                bind_window_fn, default_frame)
+                                bind_window_fn, default_frame,
+                                is_value_range_frame)
 from ..ops.rowops import (gather_vecs, key_change_flags, lexsort_indices,
                           sort_keys_for)
 from ..utils import metrics as M
@@ -537,10 +538,10 @@ def _frame_bounds(frame, env: _WinEnv):
             jnp.minimum(env.seg_end_idx, env.n32 + frame.upper)
         return lo, hi
     assert isinstance(frame, RangeFrame)
-    if frame.lower is None and frame.upper is None:
-        return env.seg_start_idx, env.seg_end_idx
-    if frame.lower is None and frame.upper == 0:
-        return env.seg_start_idx, env.peer_end_idx
+    if not is_value_range_frame(frame):
+        if frame.lower is None and frame.upper is None:
+            return env.seg_start_idx, env.seg_end_idx
+        return env.seg_start_idx, env.peer_end_idx  # UNBOUNDED..CURRENT ROW
     # value-offset RANGE frame (planner guarantees one numeric order column)
     ascending, nulls_first = env.order_spec[0]
     return _search_value_range(env, frame, env.sorder_keyvecs[0],

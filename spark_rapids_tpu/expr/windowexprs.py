@@ -57,6 +57,15 @@ def default_frame(has_order: bool):
     return RangeFrame(None, 0) if has_order else RangeFrame(None, None)
 
 
+def is_value_range_frame(frame) -> bool:
+    """True for RANGE frames with value offsets — i.e. anything beyond the
+    positional UNBOUNDED..CURRENT ROW / UNBOUNDED..UNBOUNDED forms. The
+    planner's tagging and the device kernel's frame dispatch both key off
+    this single predicate so they cannot drift."""
+    return isinstance(frame, RangeFrame) and not (
+        frame.lower is None and frame.upper in (0, None))
+
+
 class WindowFunction(Expression):
     """Marker base: evaluated by the window exec, not by expression eval."""
 

@@ -324,10 +324,9 @@ def _tag_window_agg(meta: ExprMeta) -> None:
         meta.will_not_work(f"{name} is not supported over a window on TPU")
         return
     frame = e.frame
-    value_range = isinstance(frame, WX.RangeFrame) and not (
-        frame.lower is None and frame.upper in (0, None))
-    bounded = value_range or (isinstance(frame, WX.RowFrame) and not (
-        frame.lower is None and frame.upper in (0, None)))
+    bounded = WX.is_value_range_frame(frame) or (
+        isinstance(frame, WX.RowFrame) and not (
+            frame.lower is None and frame.upper in (0, None)))
     child = e.func.child
     if child is not None and name in ("Min", "Max") and bounded:
         # running/unbounded string min/max rides the segmented lex scan;
@@ -515,12 +514,48 @@ def _c_agg(plan, children, conf):
     return TpuHashAggregateExec(plan.group_exprs, plan.aggs, children[0], conf)
 
 
+def _estimated_bytes(plan) -> float:
+    """Heuristic output size in bytes: CBO cardinality x schema row width."""
+    from .cbo import row_estimate
+    width = 0
+    for dt in plan.output.types:
+        npdt = getattr(dt, "np_dtype", None)
+        width += 20 if npdt is None else npdt.itemsize + 1  # +validity
+    return row_estimate(plan) * max(width, 1)
+
+
+# join types whose BUILD (right) side may be replicated: every probe shard
+# sees the full build table and no output depends on build-side match
+# bookkeeping being global (right/full outer would emit unmatched build rows
+# once PER SHARD if the build side were replicated — Spark broadcasts the
+# other side for those, which this engine's fixed build-right layout doesn't
+# support, so they stay shuffled)
+_BROADCASTABLE = ("inner", "cross", "left", "semi", "anti", "existence")
+
+
 def _c_join(plan, children, conf):
-    from ..exec.joins import TpuNestedLoopJoinExec, TpuShuffledHashJoinExec
+    from ..exec.broadcast import TpuBroadcastExchangeExec
+    from ..exec.joins import (TpuBroadcastHashJoinExec, TpuNestedLoopJoinExec,
+                              TpuShuffledHashJoinExec)
+    threshold = conf.get("spark.rapids.sql.autoBroadcastJoinThreshold")
+    no_nested = not any(getattr(dt, "is_nested", False)
+                        for dt in plan.children[1].output.types)
+    small_build = (threshold >= 0 and no_nested and
+                   _estimated_bytes(plan.children[1]) <= threshold and
+                   plan.join_type in _BROADCASTABLE)
     if not plan.left_keys:
-        # keyless: cartesian product / pure-condition nested loop join
-        return TpuNestedLoopJoinExec(children[0], children[1], plan.condition,
+        # keyless: cartesian product / pure-condition nested loop join; a
+        # small build side rides the broadcast exchange (the reference's
+        # GpuBroadcastNestedLoopJoinExec vs GpuCartesianProductExec split)
+        build = TpuBroadcastExchangeExec(children[1], conf) if small_build \
+            else children[1]
+        return TpuNestedLoopJoinExec(children[0], build, plan.condition,
                                      plan.join_type, conf)
+    if small_build:
+        return TpuBroadcastHashJoinExec(
+            children[0], TpuBroadcastExchangeExec(children[1], conf),
+            plan.left_keys, plan.right_keys, plan.join_type, conf,
+            condition=plan.condition)
     return TpuShuffledHashJoinExec(children[0], children[1], plan.left_keys,
                                    plan.right_keys, plan.join_type, conf,
                                    condition=plan.condition)
@@ -579,8 +614,7 @@ def _tag_window(m: PlanMeta):
         if f.requires_order and not has_order:
             m.will_not_work(f"window function {name} requires an ORDER BY")
         if isinstance(f, WX.WindowAggregate) and \
-                isinstance(f.frame, WX.RangeFrame) and not (
-                    f.frame.lower is None and f.frame.upper in (0, None)):
+                WX.is_value_range_frame(f.frame):
             # value-offset RANGE frames: Spark restricts these to a single
             # orderable numeric order column; the device binary search
             # additionally needs a sortable numeric axis
@@ -588,7 +622,12 @@ def _tag_window(m: PlanMeta):
                 m.will_not_work("value-offset RANGE frames require exactly "
                                 "one order column")
                 continue
-            key_t = m.plan._bound_order[0][0].data_type
+            try:
+                key_t = m.plan._bound_order[0][0].data_type
+            except ValueError:
+                m.will_not_work("value-offset RANGE frame order key could "
+                                "not be resolved")
+                continue
             if not (T.is_numeric(key_t) or
                     isinstance(key_t, (T.DateType, T.TimestampType))):
                 m.will_not_work("value-offset RANGE frames need a numeric "

@@ -38,6 +38,7 @@ WRITE_TIME = "writeTime"
 PARTITION_TIME = "partitionTime"
 WINDOW_TIME = "windowTime"
 BROADCAST_TIME = "broadcastTime"
+DATA_SIZE = "dataSize"
 SEMAPHORE_WAIT_TIME = "semaphoreWaitTime"
 PEAK_DEVICE_MEMORY = "peakDevMemory"
 NUM_PARTITIONS = "numPartitions"

@@ -22,9 +22,13 @@ NDEV = 8
 
 @pytest.fixture(scope="module")
 def session():
+    # broadcast joins are disabled here ON PURPOSE: these tests pin the
+    # shuffled-exchange path (small dims would otherwise broadcast and skip
+    # the mesh collective); TestMeshBroadcastJoin covers the broadcast path
     return TpuSession({"spark.rapids.sql.enabled": True,
                        "spark.rapids.sql.explain": "NONE",
                        "spark.rapids.shuffle.mode": "ICI",
+                       "spark.rapids.sql.autoBroadcastJoinThreshold": -1,
                        "spark.rapids.tpu.mesh.shape": f"shuffle={NDEV}"})
 
 
@@ -115,3 +119,29 @@ class TestOverflowRetry:
         np.testing.assert_allclose(
             out.column("s").to_pylist()[0],
             float(np.sum(t.column("val").to_numpy())), rtol=1e-9)
+
+
+class TestMeshBroadcastJoin:
+    """Broadcast joins in mesh mode: the build side replicates (no mesh
+    exchange needed for the join itself); a grouped agg downstream still
+    rides the collective."""
+
+    @pytest.fixture(scope="class")
+    def bsession(self):
+        return TpuSession({"spark.rapids.sql.enabled": True,
+                           "spark.rapids.sql.explain": "NONE",
+                           "spark.rapids.shuffle.mode": "ICI",
+                           "spark.rapids.tpu.mesh.shape": f"shuffle={NDEV}"})
+
+    def test_broadcast_join_groupby_on_mesh(self, bsession, rng):
+        from spark_rapids_tpu.plan.overrides import Overrides
+        fact = bsession.from_arrow(make_table(rng, n=800))
+        dim = bsession.from_arrow(make_dim(rng))
+        q = (fact.join(dim, on="id", how="inner")
+                 .group_by("tag").agg(s=Sum(col("val") * col("w")),
+                                      c=Count(lit(1))))
+        tree = Overrides(bsession.conf).apply(q.plan).tree_string()
+        assert "TpuBroadcastExchangeExec" in tree
+        before = EX.MESH_EXCHANGES
+        assert_same(q, sort_by=["tag"], approx_cols=("s",))
+        assert EX.MESH_EXCHANGES > before  # the groupby exchange still rode ICI
