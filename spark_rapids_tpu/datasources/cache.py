@@ -1,0 +1,160 @@
+"""Cached-relation serializer (`df.cache()`) — the
+`ParquetCachedBatchSerializer.scala:221` analog: cached data is stored as
+COMPRESSED PARQUET bytes, not live device arrays, so a big cache costs host
+RAM at parquet compression ratios instead of pinning HBM, and re-reading it
+rides the same decode machinery as a parquet scan.
+
+TPU-native twist: blobs are written PLAIN-encoded (no dictionary pages), the
+exact encoding `io/parquet_device.py` decodes ON DEVICE — so a cache hit is
+host-bytes -> TPU decode, mirroring the reference where both encode and
+decode of cached batches run on the GPU. Anything the device decoder cannot
+handle (strings, nested) falls back to pyarrow per blob, like the scan path.
+"""
+
+from __future__ import annotations
+
+import io
+import threading
+from typing import Iterator, List, Optional
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from ..columnar.batch import Schema
+from ..config import register
+from ..plan.nodes import PhysicalPlan
+
+register("spark.rapids.sql.cache.compression", "string", "zstd",
+         "Parquet compression codec for cached batches "
+         "(ParquetCachedBatchSerializer analog).",
+         check_values=("none", "snappy", "zstd", "gzip"))
+
+
+class CachedRelation:
+    """Immutable parquet-bytes snapshot of a query result."""
+
+    def __init__(self, blobs: List[bytes], schema: Schema, num_rows: int):
+        self.blobs = blobs
+        self.schema = schema
+        self.num_rows = num_rows
+
+    @property
+    def size_bytes(self) -> int:
+        return sum(len(b) for b in self.blobs)
+
+
+def encode_table(table: pa.Table, codec: str) -> bytes:
+    buf = io.BytesIO()
+    # one row group per blob: the device decode path emits one batch per
+    # group, and cached batches are already batch-sized
+    pq.write_table(table, buf, use_dictionary=False,
+                   row_group_size=max(table.num_rows, 1),
+                   compression=None if codec == "none" else codec)
+    return buf.getvalue()
+
+
+def decode_blob(blob: bytes) -> pa.Table:
+    return pq.read_table(io.BytesIO(blob))
+
+
+class CpuCachedExec(PhysicalPlan):
+    """Plan node holding the cache state. The SAME node object persists
+    across collects (it lives in df.plan), so whichever engine materializes
+    first feeds every later execution on either engine — Spark's
+    InMemoryRelation sharing, without the storage-level zoo."""
+
+    def __init__(self, child: PhysicalPlan, codec: str = "zstd"):
+        super().__init__([child])
+        self.codec = codec
+        self.relation: Optional[CachedRelation] = None
+        self.lock = threading.Lock()
+
+    @property
+    def output(self) -> Schema:
+        return self.children[0].output
+
+    def unpersist(self) -> None:
+        with self.lock:
+            self.relation = None
+
+    def store_tables(self, tables: List[pa.Table]) -> None:
+        with self.lock:
+            if self.relation is not None:
+                return
+            blobs = [encode_table(t, self.codec) for t in tables if t.num_rows]
+            if not blobs and tables:
+                blobs = [encode_table(tables[0], self.codec)]
+            self.relation = CachedRelation(
+                blobs, self.output, sum(t.num_rows for t in tables))
+
+    def execute_cpu(self):
+        from ..cpu.hostbatch import host_batch_from_arrow, host_batch_to_arrow
+        if self.relation is None:
+            tables = [host_batch_to_arrow(b)
+                      for b in self.children[0].execute_cpu()]
+            self.store_tables(tables)
+        for blob in self.relation.blobs:
+            yield host_batch_from_arrow(decode_blob(blob))
+
+    def _arg_string(self):
+        state = "materialized" if self.relation is not None else "lazy"
+        return f"[{state}, codec={self.codec}]"
+
+
+from ..exec.base import TpuExec as _TpuExec  # noqa: E402
+
+
+class TpuInMemoryTableScanExec(_TpuExec):
+    """Device exec over a cached relation (GpuInMemoryTableScanExec analog).
+    First execution materializes THROUGH the device child plan (encode from
+    device results); later executions decode the parquet blobs straight onto
+    the device where the encodings allow."""
+
+    def __init__(self, plan: CpuCachedExec, child: _TpuExec, conf):
+        super().__init__([child], conf)
+        self.cpu_node = plan
+
+    @property
+    def output(self) -> Schema:
+        return self.cpu_node.output
+
+    def do_execute(self):
+        from ..columnar.batch import batch_from_arrow, batch_to_arrow
+        node = self.cpu_node
+        if node.relation is None:
+            tables = []
+            for b in self.children[0].execute():
+                t = batch_to_arrow(b)
+                tables.append(t)
+                self.num_output_rows.add(t.num_rows)
+                yield self._count_output(b)
+            node.store_tables(tables)
+            return
+        for blob in node.relation.blobs:
+            b, nrows = self._decode_device(blob)
+            self.num_output_rows.add(nrows)
+            yield self._count_output(b)
+
+    def _decode_device(self, blob: bytes):
+        from ..columnar.batch import batch_from_arrow
+        from ..io.parquet_device import (DeviceDecodeUnsupported,
+                                         decode_row_group, file_supported)
+        from ..io.scanbase import normalize_timestamps
+        from struct import error as struct_error
+        if self.conf.get("spark.rapids.sql.format.parquet.deviceDecode."
+                         "enabled"):
+            try:
+                pf = file_supported(io.BytesIO(blob), self.output)
+                # encode_table writes exactly one row group per blob; check
+                # BEFORE decoding so an unexpected multi-group blob costs a
+                # host decode, never device work thrown away
+                if pf.metadata.num_row_groups == 1:
+                    return decode_row_group(pf, io.BytesIO(blob), 0,
+                                            self.output)
+            except (DeviceDecodeUnsupported, OSError, struct_error):
+                pass
+        t = normalize_timestamps(decode_blob(blob))
+        return batch_from_arrow(t), t.num_rows
+
+    def _arg_string(self):
+        return self.cpu_node._arg_string()

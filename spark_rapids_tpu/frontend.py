@@ -202,6 +202,23 @@ class DataFrame:
         return write_table(self.collect(), path, "csv", partition_by, mode,
                            **options)
 
+    def cache(self) -> "DataFrame":
+        """Cache this query's result as compressed parquet batches
+        (ParquetCachedBatchSerializer analog): the first execution
+        materializes, later executions on either engine decode the cached
+        blobs (device decode where the encoding allows)."""
+        from .datasources.cache import CpuCachedExec
+        if isinstance(self.plan, CpuCachedExec):
+            return self
+        codec = self.session.conf.get("spark.rapids.sql.cache.compression")
+        return DataFrame(self.session, CpuCachedExec(self.plan, codec))
+
+    def unpersist(self) -> "DataFrame":
+        from .datasources.cache import CpuCachedExec
+        if isinstance(self.plan, CpuCachedExec):
+            self.plan.unpersist()
+        return self
+
     def collect_cpu(self):
         """Execute on the CPU engine only (differential-testing helper)."""
         return self.session.execute_plan(self.plan, use_device=False)

@@ -676,26 +676,30 @@ def _c_file_scan(plan, children, conf):
     return make_tpu_file_scan(plan, conf)
 
 
-_file_scan_rules_registered = False
+def _lazy_rule_group(sentinel_module: str, sentinel_class: str, register_fn):
+    """Idempotent registration of exec rules for PhysicalPlan subclasses that
+    live OUTSIDE plan/ (io formats, datasources). Those modules import
+    plan.nodes, so importing one of them directly re-enters this module
+    mid-cycle, before the subclass exists — detected via the sentinel
+    (module in sys.modules but class not yet defined) and retried at first
+    rule lookup (Overrides.apply). A genuine ImportError in the target
+    module must NOT be swallowed: it would silently degrade those plan nodes
+    to the CPU path, so outside the mid-cycle window imports fail loudly."""
+    state = {"done": False}
+
+    def ensure():
+        if state["done"]:
+            return
+        import sys
+        mod = sys.modules.get(sentinel_module)
+        if mod is not None and not hasattr(mod, sentinel_class):
+            return  # mid-import cycle; retried at first rule lookup
+        register_fn()
+        state["done"] = True
+    return ensure
 
 
-def _register_file_scan_rules():
-    """Register scan exec rules for every io format. Lazy + idempotent: when a
-    user imports an io module directly, io.scanbase's import of plan.nodes
-    lands here mid-cycle before CpuFileScanExec exists — in that case skip and
-    re-run at first rule lookup (Overrides.apply)."""
-    global _file_scan_rules_registered
-    if _file_scan_rules_registered:
-        return
-    import sys
-    scanbase = sys.modules.get("spark_rapids_tpu.io.scanbase")
-    if scanbase is not None and not hasattr(scanbase, "CpuFileScanExec"):
-        # mid-import cycle (an io module triggered the plan import before
-        # scanbase finished defining its classes); retried at first rule
-        # lookup. A genuine ImportError in an io module must NOT be swallowed
-        # here — it would silently degrade every format to the CPU path — so
-        # outside this window the imports below fail loudly.
-        return
+def _do_register_file_scans():
     from ..io.parquet import CpuParquetScanExec
     from ..io.csv import CpuCsvScanExec
     from ..io.json_ import CpuJsonScanExec
@@ -704,7 +708,11 @@ def _register_file_scan_rules():
     for cls in (CpuParquetScanExec, CpuCsvScanExec, CpuJsonScanExec,
                 CpuOrcScanExec, CpuAvroScanExec):
         exec_rule(cls, TypeSig.all_basic(), _c_file_scan)
-    _file_scan_rules_registered = True
+
+
+_register_file_scan_rules = _lazy_rule_group(
+    "spark_rapids_tpu.io.scanbase", "CpuFileScanExec",
+    _do_register_file_scans)
 
 
 exec_rule(N.CpuScanExec, TypeSig.all_with_nested(), _c_scan)
@@ -768,6 +776,22 @@ exec_rule(N.CpuShuffleExchangeExec, TypeSig.all_basic(), _c_exchange,
           tag_fn=_tag_exchange)
 exec_rule(N.CpuWindowExec, TypeSig.all_basic(), _c_window,
           tag_fn=_tag_window, expr_fn=_exprs_window)
+
+
+def _c_cached(plan, children, conf):
+    from ..datasources.cache import TpuInMemoryTableScanExec
+    return TpuInMemoryTableScanExec(plan, children[0], conf)
+
+
+def _do_register_cache():
+    from ..datasources.cache import CpuCachedExec
+    exec_rule(CpuCachedExec, TypeSig.all_with_nested(), _c_cached)
+
+
+_register_cache_rule = _lazy_rule_group(
+    "spark_rapids_tpu.datasources.cache", "CpuCachedExec", _do_register_cache)
+
+_register_cache_rule()
 _register_file_scan_rules()
 
 
@@ -812,6 +836,7 @@ class Overrides:
         every node, WITHOUT converting — so cross-tree passes (CBO) can see
         the full tagging picture first."""
         _register_file_scan_rules()  # lazy retry if module import was cyclic
+        _register_cache_rule()
         rule = _EXEC_RULES.get(type(plan))
         meta = PlanMeta(plan, self.conf, rule)
         for c in plan.children:
