@@ -211,6 +211,9 @@ register("spark.rapids.sql.format.parquet.deviceDecode.enabled", "bool", True,
 register("spark.rapids.sql.format.orc.enabled", "bool", True, "Enable TPU ORC scan.")
 register("spark.rapids.sql.format.csv.enabled", "bool", True, "Enable TPU CSV scan.")
 register("spark.rapids.sql.format.json.enabled", "bool", True, "Enable TPU JSON scan.")
+register("spark.rapids.sql.format.iceberg.enabled", "bool", True,
+         "Enable iceberg table scans (metadata walked natively, data files "
+         "ride the TPU parquet scan; row-level deletes unsupported).")
 register("spark.rapids.sql.format.avro.enabled", "bool", True,
          "Enable TPU Avro scan (built-in host object-container-file decoder, "
          "io/avro.py; null + deflate codecs).")

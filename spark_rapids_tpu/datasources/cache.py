@@ -77,23 +77,25 @@ class CpuCachedExec(PhysicalPlan):
         with self.lock:
             self.relation = None
 
-    def store_tables(self, tables: List[pa.Table]) -> None:
+    def store_tables(self, tables: List[pa.Table]) -> CachedRelation:
         with self.lock:
             if self.relation is not None:
-                return
+                return self.relation
             blobs = [encode_table(t, self.codec) for t in tables if t.num_rows]
             if not blobs and tables:
                 blobs = [encode_table(tables[0], self.codec)]
             self.relation = CachedRelation(
                 blobs, self.output, sum(t.num_rows for t in tables))
+            return self.relation
 
     def execute_cpu(self):
         from ..cpu.hostbatch import host_batch_from_arrow, host_batch_to_arrow
-        if self.relation is None:
+        rel = self.relation  # snapshot: concurrent unpersist() must not crash
+        if rel is None:
             tables = [host_batch_to_arrow(b)
                       for b in self.children[0].execute_cpu()]
-            self.store_tables(tables)
-        for blob in self.relation.blobs:
+            rel = self.store_tables(tables)
+        for blob in rel.blobs:
             yield host_batch_from_arrow(decode_blob(blob))
 
     def _arg_string(self):
@@ -119,9 +121,10 @@ class TpuInMemoryTableScanExec(_TpuExec):
         return self.cpu_node.output
 
     def do_execute(self):
-        from ..columnar.batch import batch_from_arrow, batch_to_arrow
+        from ..columnar.batch import batch_to_arrow
         node = self.cpu_node
-        if node.relation is None:
+        rel = node.relation  # snapshot: concurrent unpersist() must not crash
+        if rel is None:
             tables = []
             for b in self.children[0].execute():
                 t = batch_to_arrow(b)
@@ -130,7 +133,7 @@ class TpuInMemoryTableScanExec(_TpuExec):
                 yield self._count_output(b)
             node.store_tables(tables)
             return
-        for blob in node.relation.blobs:
+        for blob in rel.blobs:
             b, nrows = self._decode_device(blob)
             self.num_output_rows.add(nrows)
             yield self._count_output(b)

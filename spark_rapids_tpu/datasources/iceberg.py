@@ -1,0 +1,225 @@
+"""Iceberg table read support (reference `sql-plugin/.../iceberg/` — 6k LoC
+of forked reader classes; here the table format is implemented directly
+against the Iceberg spec and the data files ride the existing parquet scan,
+device decode included).
+
+Layout walked (Iceberg spec v1/v2):
+  <table>/metadata/vN.metadata.json   (or version-hint.text naming N)
+    -> snapshots[] each with a manifest-list AVRO file
+      -> manifest list entries: manifest_path (+ content kind in v2)
+        -> manifest AVRO files: entries of (status, data_file record)
+          -> live parquet data files
+
+The manifest plumbing reuses io/avro.py (the from-scratch OCF reader), so no
+Iceberg or Avro library is needed. Row-level deletes (v2 position/equality
+delete files) are detected and rejected with a clear error — the reference
+likewise tags delete-bearing scans unsupported. Time travel by snapshot id
+or timestamp rides the snapshot log."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+from .. import types as T
+from ..columnar.batch import Schema
+
+__all__ = ["IcebergTable", "IcebergError", "IcebergDeletesUnsupported"]
+
+
+class IcebergError(ValueError):
+    pass
+
+
+class IcebergDeletesUnsupported(IcebergError):
+    pass
+
+
+def _field_type(t) -> T.DataType:
+    """Iceberg schema type (JSON) -> engine type."""
+    if isinstance(t, str):
+        prim = {
+            "boolean": T.BOOLEAN, "int": T.INT, "long": T.LONG,
+            "float": T.FLOAT, "double": T.DOUBLE, "date": T.DATE,
+            "timestamp": T.TIMESTAMP, "timestamptz": T.TIMESTAMP,
+            "string": T.STRING, "binary": T.BINARY, "uuid": T.STRING,
+        }
+        if t in prim:
+            return prim[t]
+        if t.startswith("decimal("):
+            p, s = t[len("decimal("):-1].split(",")
+            return T.DecimalType(int(p), int(s))
+        raise IcebergError(f"unsupported iceberg type {t!r}")
+    kind = t.get("type")
+    if kind == "struct":
+        return T.StructType([
+            T.StructField(f["name"], _field_type(f["type"]))
+            for f in t["fields"]])
+    if kind == "list":
+        return T.ArrayType(_field_type(t["element"]))
+    if kind == "map":
+        return T.MapType(_field_type(t["key"]), _field_type(t["value"]))
+    raise IcebergError(f"unsupported iceberg type {t!r}")
+
+
+def _schema_from_metadata(meta: dict) -> Schema:
+    schemas = meta.get("schemas")
+    if schemas:
+        sid = meta.get("current-schema-id", 0)
+        sch = next((s for s in schemas if s.get("schema-id") == sid),
+                   schemas[-1])
+    else:
+        sch = meta["schema"]  # v1 single-schema form
+    names, types = [], []
+    for f in sch["fields"]:
+        names.append(f["name"])
+        types.append(_field_type(f["type"]))
+    return Schema(tuple(names), tuple(types))
+
+
+class IcebergTable:
+    """A read-only view of an Iceberg table rooted at `path`."""
+
+    def __init__(self, session, path: str):
+        self.session = session
+        self.path = str(path)
+        self.meta_dir = os.path.join(self.path, "metadata")
+        if not os.path.isdir(self.meta_dir):
+            raise FileNotFoundError(f"not an iceberg table: {path}")
+        self.metadata = self._load_metadata()
+        self.schema = _schema_from_metadata(self.metadata)
+
+    # -------------------------------------------------------------- metadata
+    def _load_metadata(self) -> dict:
+        hint = os.path.join(self.meta_dir, "version-hint.text")
+        candidates: List[str] = []
+        if os.path.exists(hint):
+            with open(hint) as f:
+                v = f.read().strip()
+            candidates.append(os.path.join(self.meta_dir,
+                                           f"v{v}.metadata.json"))
+        versions = sorted(
+            (f for f in os.listdir(self.meta_dir)
+             if f.endswith(".metadata.json")),
+            key=lambda f: _version_ordinal(f))
+        candidates.extend(os.path.join(self.meta_dir, f)
+                          for f in reversed(versions))
+        for c in candidates:
+            if os.path.exists(c):
+                with open(c) as f:
+                    return json.load(f)
+        raise IcebergError(f"no metadata.json under {self.meta_dir}")
+
+    @property
+    def snapshots(self) -> List[dict]:
+        return self.metadata.get("snapshots", [])
+
+    def current_snapshot(self) -> Optional[dict]:
+        sid = self.metadata.get("current-snapshot-id")
+        if sid in (None, -1):
+            return None
+        return self._snapshot_by_id(sid)
+
+    def _snapshot_by_id(self, sid: int) -> dict:
+        for s in self.snapshots:
+            if s.get("snapshot-id") == sid:
+                return s
+        raise IcebergError(f"snapshot {sid} not found")
+
+    def snapshot_as_of(self, timestamp_ms: int) -> dict:
+        """Latest snapshot with timestamp-ms <= the given time."""
+        eligible = [s for s in self.snapshots
+                    if s.get("timestamp-ms", 0) <= timestamp_ms]
+        if not eligible:
+            raise IcebergError(
+                f"no snapshot at or before timestamp {timestamp_ms}")
+        return max(eligible, key=lambda s: s.get("timestamp-ms", 0))
+
+    # ------------------------------------------------------------- planning
+    def _resolve_path(self, p: str) -> str:
+        """Manifest/data paths may be absolute URIs from another filesystem;
+        re-root anything containing the table name onto the local root."""
+        if os.path.exists(p):
+            return p
+        for scheme in ("file://",):
+            if p.startswith(scheme):
+                q = p[len(scheme):]
+                if os.path.exists(q):
+                    return q
+        # re-root by the table directory name
+        base = os.path.basename(self.path.rstrip("/"))
+        if f"/{base}/" in p:
+            rel = p.split(f"/{base}/", 1)[1]
+            q = os.path.join(self.path, rel)
+            if os.path.exists(q):
+                return q
+        raise IcebergError(f"cannot resolve file {p!r}")
+
+    def data_files(self, snapshot_id: Optional[int] = None,
+                   as_of_timestamp_ms: Optional[int] = None) -> List[str]:
+        """Live parquet data files of the chosen snapshot. Raises
+        IcebergDeletesUnsupported when the snapshot carries row-level delete
+        files (the scan would return resurrected rows otherwise)."""
+        from ..io.avro import read_avro_table
+        if snapshot_id is not None:
+            snap = self._snapshot_by_id(snapshot_id)
+        elif as_of_timestamp_ms is not None:
+            snap = self.snapshot_as_of(as_of_timestamp_ms)
+        else:
+            snap = self.current_snapshot()
+        if snap is None:
+            return []
+        mlist_path = self._resolve_path(snap["manifest-list"])
+        mlist = read_avro_table(mlist_path).to_pylist()
+        files: List[str] = []
+        for m in mlist:
+            if m.get("content", 0) == 1:  # v2 delete manifest
+                raise IcebergDeletesUnsupported(
+                    "iceberg row-level deletes are not supported "
+                    "(delete manifest present)")
+            mpath = self._resolve_path(m["manifest_path"])
+            for entry in read_avro_table(mpath).to_pylist():
+                if entry.get("status", 0) == 2:  # DELETED entry
+                    continue
+                df = entry["data_file"]
+                if df.get("content", 0) != 0:  # v2 delete data file
+                    raise IcebergDeletesUnsupported(
+                        "iceberg row-level deletes are not supported")
+                fmt = str(df.get("file_format", "PARQUET")).upper()
+                if fmt != "PARQUET":
+                    raise IcebergError(
+                        f"iceberg data file format {fmt} not supported")
+                files.append(self._resolve_path(df["file_path"]))
+        return files
+
+    # -------------------------------------------------------------- reading
+    def scan_plan(self, columns=None, snapshot_id=None,
+                  as_of_timestamp_ms=None):
+        from ..io.parquet import parquet_scan_plan
+        files = self.data_files(snapshot_id, as_of_timestamp_ms)
+        if not files:
+            from ..plan.nodes import CpuScanExec
+            import pyarrow as pa
+            empty = pa.table(
+                [pa.array([], type=T.to_arrow(dt)) for dt in self.schema.types],
+                names=list(self.schema.names))
+            if columns:
+                empty = empty.select(columns)
+            return CpuScanExec(empty, "iceberg-empty")
+        return parquet_scan_plan(files, self.session.conf, columns=columns)
+
+    def to_df(self, columns=None, snapshot_id=None, as_of_timestamp_ms=None):
+        from ..frontend import DataFrame
+        return DataFrame(self.session,
+                         self.scan_plan(columns, snapshot_id,
+                                        as_of_timestamp_ms))
+
+
+def _version_ordinal(fname: str) -> int:
+    """v12.metadata.json -> 12; 00003-uuid.metadata.json -> 3."""
+    stem = fname[:-len(".metadata.json")]
+    if stem.startswith("v") and stem[1:].isdigit():
+        return int(stem[1:])
+    head = stem.split("-", 1)[0]
+    return int(head) if head.isdigit() else -1

@@ -75,6 +75,15 @@ class TpuSession:
         return DataFrame(self, avro_scan_plan(list(paths), self.conf,
                                               **options))
 
+    def read_iceberg(self, path, columns=None, snapshot_id=None,
+                     as_of_timestamp_ms=None):
+        from .datasources.iceberg import IcebergTable
+        if not self.conf.get("spark.rapids.sql.format.iceberg.enabled"):
+            raise ValueError("iceberg scan disabled by conf "
+                             "(spark.rapids.sql.format.iceberg.enabled)")
+        return IcebergTable(self, path).to_df(
+            columns, snapshot_id, as_of_timestamp_ms)
+
     # --------------------------------------------------------------- execution
     def execute_plan(self, plan: PhysicalPlan, use_device: Optional[bool] = None):
         """Run a CPU plan through the override rewrite and execute; returns a
