@@ -86,7 +86,7 @@ class IcebergTable:
         self.path = str(path)
         self.meta_dir = os.path.join(self.path, "metadata")
         if not os.path.isdir(self.meta_dir):
-            raise FileNotFoundError(f"not an iceberg table: {path}")
+            raise IcebergError(f"not an iceberg table: {path}")
         self.metadata = self._load_metadata()
         self.schema = _schema_from_metadata(self.metadata)
 
@@ -142,11 +142,10 @@ class IcebergTable:
         re-root anything containing the table name onto the local root."""
         if os.path.exists(p):
             return p
-        for scheme in ("file://",):
-            if p.startswith(scheme):
-                q = p[len(scheme):]
-                if os.path.exists(q):
-                    return q
+        if p.startswith("file://"):  # only local URIs; s3://gs:// etc. fall
+            q = p[len("file://"):]   # through to the re-rooting heuristic
+            if os.path.exists(q):
+                return q
         # re-root by the table directory name
         base = os.path.basename(self.path.rstrip("/"))
         if f"/{base}/" in p:
@@ -194,10 +193,27 @@ class IcebergTable:
         return files
 
     # -------------------------------------------------------------- reading
+    def _check_schema_evolution(self, files: List[str]) -> None:
+        """Data files are resolved by parquet column NAME, not Iceberg field
+        id — correct only while file schemas match the table schema. Detect
+        renamed/added columns (old files carrying old names) and reject
+        loudly, the same unsupported-tagging discipline as deletes."""
+        import pyarrow.parquet as pq
+        want = set(self.schema.names)
+        for f in files:
+            got = set(pq.read_schema(f).names)
+            if got != want:
+                raise IcebergError(
+                    "schema-evolved iceberg table: data file "
+                    f"{os.path.basename(f)} has columns {sorted(got)} but "
+                    f"the table schema has {sorted(want)} (field-id "
+                    "resolution is not supported)")
+
     def scan_plan(self, columns=None, snapshot_id=None,
                   as_of_timestamp_ms=None):
         from ..io.parquet import parquet_scan_plan
         files = self.data_files(snapshot_id, as_of_timestamp_ms)
+        self._check_schema_evolution(files)
         if not files:
             from ..plan.nodes import CpuScanExec
             import pyarrow as pa

@@ -1,0 +1,168 @@
+"""Mortgage ETL app — the benchmark-as-test analog of the reference's
+`integration_tests/.../tests/mortgage/MortgageSpark.scala` (FannieMae-style
+performance + acquisition pipeline). The data is synthetic with the same
+relational shape; every stage is expressed through the engine's frontend so
+the whole app exercises scans, expressions, joins (incl. a broadcast dim
+join), grouped aggregation, windows, and case-when labeling end to end."""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.expr import (Average, CaseWhen, Count, If, Max, Min,
+                                   Sum, col, lit)
+
+SELLERS = ["ACME BANK", "acme bank inc", "BIG LENDER CO", "big lender",
+           "HOME FUNDS", "home funds llc", "OTHER"]
+# canonical name mapping (reference NameMapping table)
+NAME_MAP = {
+    "ACME BANK": "Acme", "acme bank inc": "Acme",
+    "BIG LENDER CO": "BigLender", "big lender": "BigLender",
+    "HOME FUNDS": "HomeFunds", "home funds llc": "HomeFunds",
+    "OTHER": "Other",
+}
+
+
+def gen_performance(rng, n_loans=120, periods=18) -> pa.Table:
+    """Monthly performance rows per loan: balance decay + delinquency walk."""
+    loan_ids = np.repeat(np.arange(1, n_loans + 1, dtype=np.int64), periods)
+    month = np.tile(np.arange(periods, dtype=np.int32), n_loans)
+    year = 2018 + month // 12
+    period = year * 100 + (month % 12) + 1  # yyyymm
+    upb0 = rng.uniform(50_000, 500_000, n_loans)
+    upb = np.repeat(upb0, periods) * (1 - 0.01 * month / periods)
+    # delinquency status random walk, clipped at 0
+    steps = rng.integers(-1, 2, n_loans * periods)
+    dlq = np.maximum(np.add.accumulate(
+        steps.reshape(n_loans, periods), axis=1), 0).reshape(-1)
+    dlq = np.minimum(dlq, 9).astype(np.int32)
+    rate = np.repeat(rng.uniform(2.5, 7.5, n_loans).round(3), periods)
+    servicer = np.array(SELLERS, dtype=object)[
+        np.repeat(rng.integers(0, len(SELLERS), n_loans), periods)]
+    nulls = rng.random(n_loans * periods) < 0.02
+    return pa.table({
+        "loan_id": pa.array(loan_ids),
+        "period": pa.array(period.astype(np.int32)),
+        "servicer": pa.array(list(servicer)),
+        "interest_rate": pa.array(np.where(nulls, 0.0, rate), mask=nulls),
+        "upb": pa.array(upb.round(2)),
+        "loan_age": pa.array(month),
+        "dlq_status": pa.array(dlq),
+    })
+
+
+def gen_acquisition(rng, n_loans=120) -> pa.Table:
+    ids = np.arange(1, n_loans + 1, dtype=np.int64)
+    return pa.table({
+        "loan_id": pa.array(ids),
+        "seller_name": pa.array(
+            [SELLERS[i] for i in rng.integers(0, len(SELLERS), n_loans)]),
+        "orig_rate": pa.array(rng.uniform(2.5, 7.5, n_loans).round(3)),
+        "orig_upb": pa.array(rng.uniform(50_000, 500_000,
+                                         n_loans).round(2)),
+        "orig_term": pa.array(
+            np.array([180, 240, 360])[rng.integers(0, 3, n_loans)]
+            .astype(np.int32)),
+        "credit_score": pa.array(
+            rng.integers(550, 820, n_loans).astype(np.int32)),
+    })
+
+
+def name_mapping_table() -> pa.Table:
+    return pa.table({
+        "from_name": pa.array(list(NAME_MAP.keys())),
+        "to_name": pa.array(list(NAME_MAP.values())),
+    })
+
+
+def prepare_performance(perf):
+    """Derive quarter + delinquency buckets (CreatePerformanceDelinquency
+    prepare stage)."""
+    quarter = (col("period") % lit(100) + lit(2)) / lit(3)
+    return perf.select(
+        "loan_id", "period", "servicer", "interest_rate", "upb",
+        "loan_age", "dlq_status",
+        q=Cast_int(quarter),
+        ever_30=If(col("dlq_status") >= lit(1), lit(1), lit(0)),
+        ever_90=If(col("dlq_status") >= lit(3), lit(1), lit(0)),
+        ever_180=If(col("dlq_status") >= lit(6), lit(1), lit(0)),
+    )
+
+
+def Cast_int(e):
+    from spark_rapids_tpu.expr import Cast
+    from spark_rapids_tpu import types as T
+    return Cast(e, T.INT)
+
+
+def loan_delinquency(perf_prepared):
+    """Per-loan delinquency summary (CreatePerformanceDelinquency apply)."""
+    return (perf_prepared.group_by("loan_id").agg(
+        months=Count(col("period")),
+        max_dlq=Max(col("dlq_status")),
+        ever_30=Max(col("ever_30")),
+        ever_90=Max(col("ever_90")),
+        ever_180=Max(col("ever_180")),
+        min_upb=Min(col("upb")),
+        avg_rate=Average(col("interest_rate")),
+    ))
+
+
+def clean_acquisition(session, acq):
+    """Canonicalize seller names via the small mapping dim (NameMapping) —
+    a broadcast join by construction."""
+    mapping = session.from_arrow(name_mapping_table(), label="name-map")
+    joined = acq.join(mapping, condition=col("seller_name") == col("from_name"),
+                      how="left")
+    return joined.select(
+        "loan_id", "orig_rate", "orig_upb", "orig_term", "credit_score",
+        seller=CoalesceStr(col("to_name"), lit("Unknown")))
+
+
+def CoalesceStr(a, b):
+    from spark_rapids_tpu.expr import Coalesce
+    return Coalesce(a, b)
+
+
+def mortgage_etl(session, perf, acq):
+    """Full pipeline (Run.csv analog): performance summary x acquisition,
+    risk labeling."""
+    summary = loan_delinquency(prepare_performance(perf))
+    acq_clean = clean_acquisition(session, acq)
+    joined = summary.join(acq_clean, on="loan_id", how="inner")
+    return joined.select(
+        "loan_id", "months", "max_dlq", "ever_30", "ever_90", "ever_180",
+        "min_upb", "avg_rate", "orig_rate", "orig_upb", "orig_term",
+        "credit_score", "seller",
+        rate_spread=col("avg_rate") - col("orig_rate"),
+        risk=CaseWhen(
+            [(col("ever_180") == lit(1), lit("severe")),
+             (col("ever_90") == lit(1), lit("high")),
+             (col("ever_30") == lit(1), lit("watch"))],
+            lit("performing")),
+    )
+
+
+def simple_aggregates(session, perf):
+    """SimpleAggregates analog: servicer-level portfolio stats."""
+    p = prepare_performance(perf)
+    return p.group_by("servicer").agg(
+        loans=Count(col("loan_id")),
+        avg_upb=Average(col("upb")),
+        total_upb=Sum(col("upb")),
+        worst=Max(col("dlq_status")),
+        d30=Sum(col("ever_30")),
+        d90=Sum(col("ever_90")),
+    )
+
+
+def aggregates_with_join(session, perf, acq):
+    """AggregatesWithJoin analog: per-seller risk after the full ETL."""
+    etl = mortgage_etl(session, perf, acq)
+    return etl.group_by("seller", "risk").agg(
+        n=Count(col("loan_id")),
+        avg_score=Average(col("credit_score")),
+        spread=Average(col("rate_spread")),
+        upb=Sum(col("orig_upb")),
+    )

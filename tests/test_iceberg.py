@@ -247,3 +247,22 @@ class TestIcebergRead:
         tbl = IcebergTable(session, str(tmp_path / "t"))
         with pytest.raises(IcebergError, match="snapshot 99"):
             tbl.data_files(snapshot_id=99)
+
+    def test_schema_evolution_rejected(self, session, rng, tmp_path):
+        # a data file written under an older schema (renamed column) must be
+        # rejected loudly, not silently mis-resolved by name
+        b = TableBuilder(tmp_path / "t")
+        old = pa.table({
+            "id": pa.array(rng.integers(0, 100, 20), type=pa.int64()),
+            "v_old": pa.array(rng.normal(0, 1, 20), type=pa.float64()),
+            "tag": pa.array(["x"] * 20),
+        })
+        m = b.manifest([(1, b.write_data_file(old))], "m1")
+        b.snapshot([m], 10, 1000)
+        b.commit()
+        with pytest.raises(IcebergError, match="schema-evolved"):
+            session.read_iceberg(str(tmp_path / "t"))
+
+    def test_not_a_table_raises_iceberg_error(self, session, tmp_path):
+        with pytest.raises(IcebergError, match="not an iceberg table"):
+            IcebergTable(session, str(tmp_path / "nope"))

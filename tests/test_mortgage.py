@@ -1,0 +1,86 @@
+"""Benchmark-as-test: the mortgage ETL app (reference
+`MortgageSpark.scala` + `mortgage_test.py`) run differentially on both
+engines, from in-memory tables and from CSV/parquet files on disk."""
+
+import os
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.plugin import TpuSession
+
+from apps.mortgage import (aggregates_with_join, gen_acquisition,
+                           gen_performance, mortgage_etl, simple_aggregates)
+from test_queries import assert_same
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession({"spark.rapids.sql.enabled": True,
+                       "spark.rapids.sql.explain": "NONE"})
+
+
+@pytest.fixture(scope="module")
+def data():
+    import numpy as np
+    rng = np.random.default_rng(42)
+    return gen_performance(rng), gen_acquisition(rng)
+
+
+class TestMortgageEtl:
+    def test_full_etl(self, session, data):
+        perf, acq = data
+        q = mortgage_etl(session, session.from_arrow(perf),
+                         session.from_arrow(acq))
+        out = assert_same(q, sort_by=["loan_id"],
+                          approx_cols=("avg_rate", "rate_spread"))
+        assert out.num_rows == acq.num_rows  # every loan summarized
+        assert set(out.column("risk").to_pylist()) <= {
+            "severe", "high", "watch", "performing"}
+
+    def test_simple_aggregates(self, session, data):
+        perf, _ = data
+        q = simple_aggregates(session, session.from_arrow(perf))
+        assert_same(q, sort_by=["servicer"],
+                    approx_cols=("avg_upb", "total_upb"))
+
+    def test_aggregates_with_join(self, session, data):
+        perf, acq = data
+        q = aggregates_with_join(session, session.from_arrow(perf),
+                                 session.from_arrow(acq))
+        assert_same(q, sort_by=["seller", "risk"],
+                    approx_cols=("avg_score", "spread", "upb"))
+
+    def test_etl_from_parquet_files(self, session, data, tmp_path):
+        perf, acq = data
+        pp = str(tmp_path / "perf.parquet")
+        ap = str(tmp_path / "acq.parquet")
+        pq.write_table(perf, pp, use_dictionary=False)
+        pq.write_table(acq, ap, use_dictionary=False)
+        q = mortgage_etl(session, session.read_parquet(pp),
+                         session.read_parquet(ap))
+        assert_same(q, sort_by=["loan_id"],
+                    approx_cols=("avg_rate", "rate_spread"))
+
+    def test_etl_from_csv_files(self, session, data, tmp_path):
+        import pyarrow.csv as pacsv
+        perf, acq = data
+        pp = str(tmp_path / "perf.csv")
+        ap = str(tmp_path / "acq.csv")
+        pacsv.write_csv(perf, pp)
+        pacsv.write_csv(acq, ap)
+        q = mortgage_etl(session, session.read_csv(pp),
+                         session.read_csv(ap))
+        assert_same(q, sort_by=["loan_id"],
+                    approx_cols=("avg_rate", "rate_spread", "min_upb",
+                                 "orig_upb"))
+
+    def test_etl_fully_on_device(self, session, data):
+        """The whole app must stay on the engine — no CPU fallback
+        (ExecutionPlanCaptureCallback-style assertion via explain)."""
+        perf, acq = data
+        q = mortgage_etl(session, session.from_arrow(perf),
+                         session.from_arrow(acq))
+        explain = q.explain()
+        assert "will not run on" not in explain.lower(), explain

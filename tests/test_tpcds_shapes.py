@@ -1,0 +1,184 @@
+"""TPC-DS-shaped multi-operator query corpus (BASELINE workload #2's shape
+at test scale): a star schema — store_sales fact with date/item/store/
+customer dims — and report-style queries mirroring the classic q3/q7/q42/
+q55/q68/q96 patterns, each run differentially on both engines."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.expr import (Average, CaseWhen, Count, If, Max, Min,
+                                   Sum, col, lit)
+from spark_rapids_tpu.plugin import TpuSession
+
+from test_queries import assert_same
+
+N_DATES = 365
+N_ITEMS = 60
+N_STORES = 8
+N_CUSTOMERS = 150
+N_SALES = 4000
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession({"spark.rapids.sql.enabled": True,
+                       "spark.rapids.sql.explain": "NONE"})
+
+
+@pytest.fixture(scope="module")
+def star(session):
+    rng = np.random.default_rng(7)
+    date_dim = pa.table({
+        "d_date_sk": pa.array(np.arange(N_DATES, dtype=np.int64)),
+        "d_year": pa.array((2020 + np.arange(N_DATES) // 365)
+                           .astype(np.int32)),
+        "d_moy": pa.array((np.arange(N_DATES) % 365 // 31 + 1)
+                          .astype(np.int32)),
+        "d_dow": pa.array((np.arange(N_DATES) % 7).astype(np.int32)),
+    })
+    item = pa.table({
+        "i_item_sk": pa.array(np.arange(N_ITEMS, dtype=np.int64)),
+        "i_brand": pa.array([f"brand{i % 9}" for i in range(N_ITEMS)]),
+        "i_category": pa.array([f"cat{i % 5}" for i in range(N_ITEMS)]),
+        "i_price": pa.array(rng.uniform(1, 200, N_ITEMS).round(2)),
+    })
+    store = pa.table({
+        "s_store_sk": pa.array(np.arange(N_STORES, dtype=np.int64)),
+        "s_state": pa.array([f"ST{i % 3}" for i in range(N_STORES)]),
+    })
+    customer = pa.table({
+        "c_customer_sk": pa.array(np.arange(N_CUSTOMERS, dtype=np.int64)),
+        "c_band": pa.array((np.arange(N_CUSTOMERS) % 10).astype(np.int32)),
+    })
+    nulls = rng.random(N_SALES) < 0.03
+    store_sales = pa.table({
+        "ss_sold_date_sk": pa.array(
+            rng.integers(0, N_DATES, N_SALES).astype(np.int64)),
+        "ss_item_sk": pa.array(
+            rng.integers(0, N_ITEMS, N_SALES).astype(np.int64)),
+        "ss_store_sk": pa.array(
+            rng.integers(0, N_STORES, N_SALES).astype(np.int64)),
+        "ss_customer_sk": pa.array(
+            rng.integers(0, N_CUSTOMERS, N_SALES).astype(np.int64)),
+        "ss_quantity": pa.array(
+            rng.integers(1, 20, N_SALES).astype(np.int32)),
+        "ss_sales_price": pa.array(
+            np.where(nulls, 0.0, rng.uniform(1, 250, N_SALES).round(2)),
+            mask=nulls),
+    })
+    return {k: session.from_arrow(v, label=k) for k, v in {
+        "date_dim": date_dim, "item": item, "store": store,
+        "customer": customer, "store_sales": store_sales}.items()}
+
+
+class TestTpcdsShapes:
+    def test_q3_shape(self, session, star):
+        """Brand report over a date-filtered fact (q3/q42/q52/q55 family)."""
+        q = (star["store_sales"]
+             .join(star["date_dim"],
+                   condition=col("ss_sold_date_sk") == col("d_date_sk"),
+                   how="inner")
+             .filter(col("d_moy") == lit(11))
+             .join(star["item"],
+                   condition=col("ss_item_sk") == col("i_item_sk"),
+                   how="inner")
+             .group_by("d_year", "i_brand")
+             .agg(sum_agg=Sum(col("ss_sales_price"))))
+        assert_same(q, sort_by=["d_year", "i_brand"], approx_cols=("sum_agg",))
+
+    def test_q7_shape(self, session, star):
+        """Multi-dim star join with per-category averages (q7 family)."""
+        q = (star["store_sales"]
+             .join(star["item"],
+                   condition=col("ss_item_sk") == col("i_item_sk"),
+                   how="inner")
+             .join(star["store"],
+                   condition=col("ss_store_sk") == col("s_store_sk"),
+                   how="inner")
+             .filter(col("s_state") == lit("ST1"))
+             .group_by("i_category")
+             .agg(q=Average(col("ss_quantity")),
+                  p=Average(col("ss_sales_price")),
+                  n=Count(lit(1))))
+        assert_same(q, sort_by=["i_category"], approx_cols=("q", "p"))
+
+    def test_q68_shape(self, session, star):
+        """Customer-level rollup with a post-join window rank (q68-ish)."""
+        from spark_rapids_tpu.expr import RowNumber
+        per_cust = (star["store_sales"]
+                    .join(star["customer"],
+                          condition=col("ss_customer_sk")
+                          == col("c_customer_sk"), how="inner")
+                    .group_by("c_customer_sk", "c_band")
+                    .agg(spend=Sum(col("ss_sales_price")),
+                         qty=Sum(col("ss_quantity"))))
+        q = per_cust.window(partition_by=["c_band"],
+                            order_by=[(col("spend"), False, False)],
+                            rnk=RowNumber())
+        out = assert_same(q, sort_by=["c_band", "c_customer_sk"],
+                          approx_cols=("spend",))
+        assert out.num_rows > 0
+
+    def test_q96_shape(self, session, star):
+        """Selective count over a chain of joins (q96 family)."""
+        q = (star["store_sales"]
+             .join(star["date_dim"],
+                   condition=col("ss_sold_date_sk") == col("d_date_sk"),
+                   how="inner")
+             .filter((col("d_dow") == lit(6)) & (col("ss_quantity")
+                                                 > lit(10)))
+             .join(star["store"],
+                   condition=col("ss_store_sk") == col("s_store_sk"),
+                   how="inner")
+             .agg(cnt=Count(lit(1))))
+        assert_same(q)
+
+    def test_q19_shape_semi_anti(self, session, star):
+        """Semi/anti forms over the star (exists / not-exists rewrites)."""
+        nov_dates = star["date_dim"].filter(col("d_moy") == lit(11))
+        sold_nov = star["store_sales"].join(
+            nov_dates, condition=col("ss_sold_date_sk") == col("d_date_sk"),
+            how="semi")
+        q = (sold_nov.group_by("ss_store_sk")
+             .agg(n=Count(lit(1)), s=Sum(col("ss_sales_price"))))
+        assert_same(q, sort_by=["ss_store_sk"], approx_cols=("s",))
+        never_nov = star["item"].join(
+            star["store_sales"].join(
+                nov_dates,
+                condition=col("ss_sold_date_sk") == col("d_date_sk"),
+                how="semi"),
+            condition=col("i_item_sk") == col("ss_item_sk"), how="anti")
+        q2 = never_nov.agg(n=Count(lit(1)))
+        assert_same(q2)
+
+    def test_q36_shape_case_rollup(self, session, star):
+        """Margin classification with CASE buckets (q36-ish rollup)."""
+        q = (star["store_sales"]
+             .join(star["item"],
+                   condition=col("ss_item_sk") == col("i_item_sk"),
+                   how="inner")
+             .select("i_category", "ss_quantity",
+                     margin=col("ss_sales_price") - col("i_price"),
+                     bucket=CaseWhen(
+                         [(col("ss_sales_price") > lit(200), lit("lux")),
+                          (col("ss_sales_price") > lit(50), lit("mid"))],
+                         lit("base")))
+             .group_by("i_category", "bucket")
+             .agg(m=Average(col("margin")), n=Count(lit(1)),
+                  hi=Max(col("margin")), lo=Min(col("margin"))))
+        assert_same(q, sort_by=["i_category", "bucket"],
+                    approx_cols=("m", "hi", "lo"))
+
+    def test_q65_shape_join_of_aggregates(self, session, star):
+        """Join of two aggregate subqueries (q65 family)."""
+        per_store_item = (star["store_sales"]
+                          .group_by("ss_store_sk", "ss_item_sk")
+                          .agg(rev=Sum(col("ss_sales_price"))))
+        per_store = (per_store_item.group_by("ss_store_sk")
+                     .agg(avg_rev=Average(col("rev"))))
+        q = (per_store_item
+             .join(per_store, on="ss_store_sk", how="inner")
+             .filter(col("rev") > col("avg_rev"))
+             .agg(n=Count(lit(1)), tot=Sum(col("rev"))))
+        assert_same(q, approx_cols=("tot",))
