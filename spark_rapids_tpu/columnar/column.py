@@ -274,6 +274,8 @@ def from_arrow(arr, capacity: Optional[int] = None) -> Tuple[Column, int]:
 def to_arrow(col: Column, num_rows: int):
     """Device Column -> Arrow array (host boundary)."""
     import pyarrow as pa
+    if isinstance(col.dtype, T.NullType):
+        return pa.nulls(num_rows)
     if col.children is not None:
         from ..cpu.hostbatch import host_vec_to_arrow, vec_map_arrays
         from ..expr.base import Vec

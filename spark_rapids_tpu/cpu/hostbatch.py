@@ -119,6 +119,8 @@ def host_vec_to_arrow(v: Vec, num_rows: Optional[int] = None):
     n = num_rows if num_rows is not None else v.validity.shape[0]
     valid = np.asarray(v.validity[:n]).astype(bool)
     mask = ~valid
+    if isinstance(v.dtype, T.NullType):
+        return pa.nulls(n)
     if isinstance(v.dtype, T.ArrayType):
         lens = np.where(valid, np.asarray(v.data[:n]), 0).astype(np.int64)
         elem = v.children[0]

@@ -46,6 +46,27 @@ def _sorted_by_keys(xp, key_vecs: List[Vec], all_vecs: List[Vec], row_mask):
     return gather_vecs(xp, all_vecs, order), row_mask[order], order
 
 
+def _seg_sum(xp, data, gid, cap: int):
+    """Segmented sum supporting 1D and 2D (rows along axis 0) inputs."""
+    import jax
+    if xp is np:
+        out = np.zeros((cap,) + data.shape[1:], dtype=data.dtype)
+        np.add.at(out, gid, data)
+        return out
+    return jax.ops.segment_sum(data, gid, num_segments=cap)
+
+
+def _seg_minmax_2d(xp, op: str, data, gid, cap: int, neutral):
+    """Segmented min/max over a 2D matrix (invalid rows pre-neutralized)."""
+    import jax
+    if xp is np:
+        out = np.full((cap, data.shape[1]), neutral, dtype=data.dtype)
+        (np.minimum if op == "min" else np.maximum).at(out, gid, data)
+        return out
+    f = jax.ops.segment_min if op == "min" else jax.ops.segment_max
+    return f(data, gid, num_segments=cap)
+
+
 class TpuHashAggregateExec(UnaryTpuExec):
     """Modes: complete (raw->final), partial (raw->partial buffers),
     final (partial->final). Multi-batch inputs aggregate per batch, park the
@@ -272,6 +293,85 @@ class TpuHashAggregateExec(UnaryTpuExec):
                 has = c > 0
             out = xp.sqrt(var) if func.sqrt else var
             return [Vec(T.DOUBLE, out, has)]
+        from ..expr.aggregates import (BoolAnd, BoolOr, CountIf,
+                                       _BitAgg, _MomentFamily)
+        if isinstance(func, CountIf):
+            v = sbufs[bi]
+            if merging:
+                data, _ = seg("sum", v, np.int64)
+            else:
+                hit = v.validity & row_mask & v.data.astype(bool)
+                data = _seg_sum(xp, hit.astype(np.int64), gid, cap)
+            return [Vec(T.LONG, data.astype(np.int64),
+                        xp.ones(cap, dtype=bool))]
+        if isinstance(func, (BoolAnd, BoolOr)):
+            is_and = isinstance(func, BoolAnd)
+            v = sbufs[bi]
+            valid = v.validity & row_mask
+            contrib = xp.where(valid, v.data.astype(np.int8),
+                               np.int8(1 if is_and else 0))
+            out = segment_reduce(xp, "min" if is_and else "max", contrib,
+                                 gid, cap, row_mask)
+            has = _seg_sum(xp, valid.astype(np.int64), gid, cap) > 0
+            return [Vec(T.BOOLEAN, out.astype(bool), has)]
+        if isinstance(func, _BitAgg):
+            v = sbufs[bi]
+            valid = v.validity & row_mask
+            nbits = v.data.dtype.itemsize * 8
+            x = v.data.astype(np.int64)
+            shifts = xp.arange(nbits, dtype=np.int64)[None, :]
+            bits = ((x[:, None] >> shifts) & 1).astype(np.int8)
+            if func.op == "and":
+                bits = xp.where(valid[:, None], bits, np.int8(1))
+                red = _seg_minmax_2d(xp, "min", bits, gid, cap, np.int8(1))
+            elif func.op == "or":
+                bits = xp.where(valid[:, None], bits, np.int8(0))
+                red = _seg_minmax_2d(xp, "max", bits, gid, cap, np.int8(0))
+            else:  # xor = per-bit parity
+                bits = xp.where(valid[:, None], bits, np.int8(0))
+                red = _seg_sum(xp, bits.astype(np.int64), gid, cap) & 1
+            val = (red.astype(np.int64) << shifts).sum(axis=1)
+            has = _seg_sum(xp, valid.astype(np.int64), gid, cap) > 0
+            return [Vec(func.data_type,
+                        val.astype(func.data_type.np_dtype), has)]
+        if isinstance(func, _MomentFamily):
+            if merging:
+                s1, _ = seg("sum", sbufs[bi], np.float64)
+                s2, _ = seg("sum", sbufs[bi + 1], np.float64)
+                s3, _ = seg("sum", sbufs[bi + 2], np.float64)
+                s4, _ = seg("sum", sbufs[bi + 3], np.float64)
+                c, _ = seg("sum", sbufs[bi + 4], np.int64)
+                c = c.astype(np.int64)
+            else:
+                v = sbufs[bi]
+                x = v.data.astype(np.float64)
+                vv = v.validity
+                pows = []
+                for p in (1, 2, 3, 4):
+                    pows.append(seg("sum", Vec(T.DOUBLE, x ** p, vv),
+                                    np.float64)[0])
+                s1, s2, s3, s4 = pows
+                c = _seg_sum(xp, (vv & row_mask).astype(np.int64), gid,
+                             cap)
+            if output_partial:
+                ones = xp.ones(cap, dtype=bool)
+                return [Vec(T.DOUBLE, s1, c > 0), Vec(T.DOUBLE, s2, c > 0),
+                        Vec(T.DOUBLE, s3, c > 0), Vec(T.DOUBLE, s4, c > 0),
+                        Vec(T.LONG, c, ones)]
+            cf = xp.maximum(c.astype(np.float64), 1.0)
+            mu = s1 / cf
+            m2 = s2 - cf * mu * mu
+            m3 = s3 - 3 * mu * s2 + 2 * cf * mu ** 3
+            m4 = s4 - 4 * mu * s3 + 6 * mu * mu * s2 - 3 * cf * mu ** 4
+            from ..expr.aggregates import Skewness as _Skew
+            zero_var = m2 <= 0
+            safe_m2 = xp.where(zero_var, 1.0, m2)
+            if isinstance(func, _Skew):
+                out = xp.sqrt(cf) * m3 / safe_m2 ** 1.5
+            else:
+                out = cf * m4 / (safe_m2 * safe_m2) - 3.0
+            out = xp.where(zero_var, np.nan, out)
+            return [Vec(T.DOUBLE, out, c > 0)]
         if isinstance(func, (First, Last)):
             v = sbufs[bi]
             is_first = isinstance(func, First) and not isinstance(func, Last)

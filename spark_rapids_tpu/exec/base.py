@@ -92,8 +92,11 @@ class UnaryTpuExec(TpuExec):
 
 def device_ctx(batch: ColumnarBatch, conf: TpuConf = None) -> EvalContext:
     ansi = (conf or get_default_conf()).is_ansi
+    # errors is ALWAYS a list on device: raising can't happen mid-kernel, so
+    # both ANSI violations and unconditional signals (raise_error/
+    # assert_true) ride the same traced-flag channel; empty list = free
     return EvalContext(jnp, row_mask=batch.row_mask(), ansi=ansi, conf=conf,
-                       errors=[] if ansi else None)
+                       errors=[])
 
 
 def kernel_errors(ctx: EvalContext, msgs_box: list):

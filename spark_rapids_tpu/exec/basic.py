@@ -60,9 +60,10 @@ class TpuProjectExec(UnaryTpuExec):
         self._err_msgs: list = []
         msgs_box = self._err_msgs
 
-        def kernel(batch: ColumnarBatch):
+        def kernel(batch: ColumnarBatch, row_offset):
             from .base import kernel_errors
             ctx = device_ctx(batch, self.conf)
+            ctx.partition_row_offset = row_offset
             vecs = batch_vecs(batch)
             outs = [e.eval(ctx, vecs) for e in bound]
             return vecs_to_batch(self._schema, outs, batch.num_rows), \
@@ -87,9 +88,13 @@ class TpuProjectExec(UnaryTpuExec):
 
     def do_execute(self):
         from .base import raise_kernel_errors
+        # cumulative live-row offset across the batch stream (traced scalar:
+        # a fresh offset must not retrace the kernel)
+        offset = jnp.asarray(0, jnp.int64)
         for b in self.child.execute():
             with self.op_time.timed():
-                out, errs = self._kernel(b)
+                out, errs = self._kernel(b, offset)
+            offset = offset + jnp.asarray(b.row_count(), jnp.int64)
             raise_kernel_errors(errs, self._err_msgs)
             self.num_output_rows.add(b.row_count())
             yield self._count_output(out)

@@ -23,7 +23,21 @@ from .cast import Cast, device_supported as cast_device_supported  # noqa: F401
 from .aggregates import (AggregateFunction, Sum, Count, Min, Max, Average,  # noqa: F401
                          First, Last, CountDistinct, VariancePop,
                          VarianceSamp, StddevPop, StddevSamp, CollectList,
-                         CollectSet, ApproximatePercentile)
+                         CollectSet, ApproximatePercentile, CountIf,
+                         BoolAnd, BoolOr, BitAndAgg, BitOrAgg, BitXorAgg,
+                         Skewness, Kurtosis)
+from .collections_ext import (ArrayPosition, ArrayRemove, ArrayDistinct,  # noqa: F401
+                              ArrayRepeat, Slice, Reverse, ArraysOverlap,
+                              ArrayUnion, ArrayIntersect, ArrayExcept,
+                              ArrayJoin, Flatten)
+from .misc import (SparkPartitionID, InputFileName, RaiseError, AssertTrue,  # noqa: F401
+                   Pi, Euler, WidthBucket, Sequence,
+                   MonotonicallyIncreasingID)
+from .strings_more import (Overlay, Levenshtein, SoundEx, FormatNumber,  # noqa: F401
+                           Empty2Null, Conv)
+from .datetime_ import (WeekOfYear, DayName, MonthName, TimestampSeconds,  # noqa: F401
+                        TimestampMillis, TimestampMicros, DateFromUnixDate,
+                        UnixDate, MakeDate, TruncTimestamp)
 from .windowexprs import (RowFrame, RangeFrame, WindowFunction, RowNumber,  # noqa: F401
                           Rank, DenseRank, PercentRank, CumeDist, NTile, Lead,
                           Lag, WindowAggregate)

@@ -255,3 +255,80 @@ class ApproximatePercentile(AggregateFunction):
 
     def partial_types(self):
         return [self.data_type]
+
+
+class CountIf(AggregateFunction):
+    """count_if(predicate): rows where the predicate is true."""
+
+    @property
+    def data_type(self):
+        return T.LONG
+
+    def partial_types(self):
+        return [T.LONG]
+
+
+class BoolAnd(AggregateFunction):
+    """bool_and / every."""
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def partial_types(self):
+        return [T.BOOLEAN]
+
+
+class BoolOr(AggregateFunction):
+    """bool_or / any / some."""
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def partial_types(self):
+        return [T.BOOLEAN]
+
+
+class _BitAgg(AggregateFunction):
+    """bit_and/bit_or/bit_xor over integral inputs."""
+
+    op = "and"
+
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+    def partial_types(self):
+        return [self.data_type]
+
+
+class BitAndAgg(_BitAgg):
+    op = "and"
+
+
+class BitOrAgg(_BitAgg):
+    op = "or"
+
+
+class BitXorAgg(_BitAgg):
+    op = "xor"
+
+
+class _MomentFamily(AggregateFunction):
+    """skewness / kurtosis via raw power sums s1..s4 + count partials."""
+
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+    def partial_types(self):
+        return [T.DOUBLE, T.DOUBLE, T.DOUBLE, T.DOUBLE, T.LONG]
+
+
+class Skewness(_MomentFamily):
+    pass
+
+
+class Kurtosis(_MomentFamily):
+    pass

@@ -146,6 +146,11 @@ class EvalContext:
     row_mask: Any = None
     conf: Any = None
     errors: Any = None
+    # per-partition identity for SparkPartitionID / MonotonicallyIncreasingID:
+    # the executing exec sets these (Project threads a cumulative live-row
+    # offset, possibly a traced scalar, across its batch stream)
+    partition_id: Any = 0
+    partition_row_offset: Any = 0
 
     @property
     def is_device(self) -> bool:

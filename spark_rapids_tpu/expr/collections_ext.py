@@ -1,0 +1,421 @@
+"""Array set/positional operations over the fixed-fanout layout (reference
+`collectionOperations.scala:1`: GpuArrayPosition, GpuArrayRemove,
+GpuArrayDistinct-ish via GpuArrayUnion/Intersect/Except, GpuArraysOverlap,
+GpuSlice, GpuArrayRepeat, GpuReverse, GpuArrayJoin, GpuFlatten).
+
+All operate on PRIMITIVE element types (the planner tags string/nested
+elements to CPU except where noted); within-row compaction is expressed as a
+stable per-row argsort of a keep mask — the same dense trick the join and
+filter kernels use, so everything stays static-shaped under jit."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .. import types as T
+from .base import EvalContext, Expression, Literal, Vec
+
+__all__ = ["ArrayPosition", "ArrayRemove", "ArrayDistinct", "ArrayRepeat",
+           "Slice", "Reverse", "ArraysOverlap", "ArrayUnion",
+           "ArrayIntersect", "ArrayExcept", "ArrayJoin", "Flatten"]
+
+
+def _live(xp, arr: Vec):
+    k = arr.children[0].data.shape[1]
+    return xp.arange(k)[None, :] < arr.data.astype(np.int32)[:, None]
+
+
+def _eq_val(xp, elem: Vec, val: Vec):
+    """elem[i,k] == val[i] for primitives (NaN equals NaN, Spark array ops)."""
+    if T.is_floating(elem.dtype):
+        return (elem.data == val.data[:, None]) | \
+            (xp.isnan(elem.data) & xp.isnan(val.data)[:, None])
+    return elem.data == val.data[:, None]
+
+
+def _pairwise_eq(xp, ea: Vec, la, eb: Vec, lb, null_equal: bool):
+    """eq[i, j, k] = a-elem j equals b-elem k (dead slots never equal).
+    la/lb: live masks."""
+    a, b = ea.data, eb.data
+    eq = a[:, :, None] == b[:, None, :]
+    if T.is_floating(ea.dtype):
+        eq = eq | (xp.isnan(a)[:, :, None] & xp.isnan(b)[:, None, :])
+    av, bv = ea.validity, eb.validity
+    both_valid = av[:, :, None] & bv[:, None, :]
+    eq = eq & both_valid
+    if null_equal:
+        eq = eq | (~av[:, :, None] & ~bv[:, None, :])
+    return eq & la[:, :, None] & lb[:, None, :]
+
+
+def _compact(xp, elem: Vec, keep, counts_dtype=np.int32):
+    """Stable within-row compaction of kept slots -> (new elem Vec, counts)."""
+    k = elem.data.shape[1]
+    order = xp.argsort(~keep, axis=1, stable=True)  # kept slots first
+    def g(a):
+        return xp.take_along_axis(a, order, axis=1)
+    new_counts = keep.sum(axis=1).astype(counts_dtype)
+    live = xp.arange(k)[None, :] < new_counts[:, None]
+    data = xp.where(live, g(elem.data), xp.zeros((), elem.data.dtype))
+    validity = g(elem.validity) & live
+    lengths = None if elem.lengths is None else g(elem.lengths)
+    out = Vec(elem.dtype, data, validity, lengths,
+              None if elem.children is None else tuple(
+                  _gather_child(xp, c, order) for c in elem.children))
+    return out, new_counts
+
+
+def _gather_child(xp, c: Vec, order):
+    return Vec(c.dtype, xp.take_along_axis(c.data, order, axis=1),
+               xp.take_along_axis(c.validity, order, axis=1),
+               None if c.lengths is None else
+               xp.take_along_axis(c.lengths, order, axis=1))
+
+
+class ArrayPosition(Expression):
+    """array_position(arr, val): 1-based first match, 0 when absent; null when
+    arr or val is null."""
+
+    def __init__(self, child: Expression, value: Expression):
+        super().__init__([child, value])
+
+    @property
+    def data_type(self):
+        return T.LONG
+
+    def _compute(self, ctx: EvalContext, arr: Vec, val: Vec) -> Vec:
+        xp = ctx.xp
+        elem = arr.children[0]
+        k = elem.data.shape[1]
+        hit = _live(xp, arr) & elem.validity & _eq_val(xp, elem, val)
+        first = xp.argmax(hit, axis=1)
+        pos = xp.where(hit.any(axis=1), first + 1, 0).astype(np.int64)
+        return Vec(T.LONG, pos, arr.validity & val.validity)
+
+
+class ArrayRemove(Expression):
+    """array_remove(arr, val): drops elements equal to val (nulls kept — a
+    null never equals); null val -> null result (Spark)."""
+
+    def __init__(self, child: Expression, value: Expression):
+        super().__init__([child, value])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def _compute(self, ctx: EvalContext, arr: Vec, val: Vec) -> Vec:
+        xp = ctx.xp
+        elem = arr.children[0]
+        live = _live(xp, arr)
+        match = elem.validity & _eq_val(xp, elem, val)
+        out_elem, counts = _compact(xp, elem, live & ~match)
+        return Vec(arr.dtype, counts, arr.validity & val.validity, None,
+                   (out_elem,))
+
+
+class ArrayDistinct(Expression):
+    """array_distinct(arr): first occurrence kept (nulls deduped too)."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def _compute(self, ctx: EvalContext, arr: Vec) -> Vec:
+        xp = ctx.xp
+        elem = arr.children[0]
+        live = _live(xp, arr)
+        eq = _pairwise_eq(xp, elem, live, elem, live, null_equal=True)
+        k = elem.data.shape[1]
+        earlier = xp.tril(xp.ones((k, k), dtype=bool), k=-1)
+        dup = (eq & earlier[None, :, :]).any(axis=2)
+        out_elem, counts = _compact(xp, elem, live & ~dup)
+        return Vec(arr.dtype, counts, arr.validity, None, (out_elem,))
+
+
+class ArrayRepeat(Expression):
+    """array_repeat(elem, n) — literal n (static fanout)."""
+
+    def __init__(self, child: Expression, times: Expression):
+        super().__init__([child, times])
+        self.times = times.value if isinstance(times, Literal) else None
+
+    @property
+    def data_type(self):
+        return T.ArrayType(self.children[0].data_type)
+
+    def _compute(self, ctx: EvalContext, v: Vec, times: Vec) -> Vec:
+        xp = ctx.xp
+        n = v.data.shape[0]
+        k = max(int(self.times or 0), 1)
+        rep = lambda a: xp.repeat(a[:, None], k, axis=1)
+        elem = Vec(v.dtype, rep(v.data), rep(v.validity),
+                   None if v.lengths is None else rep(v.lengths))
+        counts = xp.full(n, max(int(self.times or 0), 0), dtype=np.int32)
+        return Vec(T.ArrayType(v.dtype), counts, times.validity, None,
+                   (elem,))
+
+
+class Slice(Expression):
+    """slice(arr, start, length): 1-based start, negative counts from the
+    end; ANSI-free semantics (errors -> null handled by planner tag)."""
+
+    def __init__(self, child: Expression, start: Expression,
+                 length: Expression):
+        super().__init__([child, start, length])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def _compute(self, ctx: EvalContext, arr: Vec, start: Vec,
+                 length: Vec) -> Vec:
+        xp = ctx.xp
+        elem = arr.children[0]
+        k = elem.data.shape[1]
+        size = arr.data.astype(np.int64)
+        st = start.data.astype(np.int64)
+        ln = xp.maximum(length.data.astype(np.int64), 0)
+        # 1-based; negative start counts from the end; start=0 is invalid;
+        # a negative start reaching before the array yields EMPTY (Spark)
+        begin0 = xp.where(st > 0, st - 1, size + st)
+        bad = (st == 0) | ~start.validity | ~length.validity | \
+            (length.data.astype(np.int64) < 0)
+        before_start = begin0 < 0
+        begin0 = xp.clip(begin0, 0, size)
+        take = xp.clip(xp.minimum(ln, size - begin0), 0, k)
+        take = xp.where(before_start, 0, take)
+        j = xp.arange(k, dtype=np.int64)[None, :]
+        src = xp.clip(begin0[:, None] + j, 0, k - 1).astype(np.int32)
+        keep = j < take[:, None]
+        def g(a, zero):
+            out = xp.take_along_axis(a, src, axis=1)
+            return xp.where(keep, out, zero)
+        data = g(elem.data, xp.zeros((), elem.data.dtype))
+        validity = g(elem.validity, False)
+        lengths = None if elem.lengths is None else \
+            g(elem.lengths, np.int32(0))
+        return Vec(arr.dtype, take.astype(np.int32),
+                   arr.validity & ~bad, None,
+                   (Vec(elem.dtype, data, validity, lengths),))
+
+
+class Reverse(Expression):
+    """reverse(array) — elementwise row reversal of the live prefix.
+    (reverse(string) is StringReverse; the frontend dispatches by type.)"""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def _compute(self, ctx: EvalContext, arr: Vec) -> Vec:
+        xp = ctx.xp
+        elem = arr.children[0]
+        k = elem.data.shape[1]
+        size = arr.data.astype(np.int64)
+        j = xp.arange(k, dtype=np.int64)[None, :]
+        src = xp.clip(size[:, None] - 1 - j, 0, k - 1).astype(np.int32)
+        live = j < size[:, None]
+        def g(a, zero):
+            out = xp.take_along_axis(a, src, axis=1)
+            return xp.where(live, out, zero)
+        out_elem = Vec(elem.dtype, g(elem.data, xp.zeros((), elem.data.dtype)),
+                       g(elem.validity, False),
+                       None if elem.lengths is None else
+                       g(elem.lengths, np.int32(0)))
+        return Vec(arr.dtype, arr.data, arr.validity, None, (out_elem,))
+
+
+class ArraysOverlap(Expression):
+    """arrays_overlap(a, b): true on a common non-null element; else null if
+    either side holds a null; else false."""
+
+    def __init__(self, left: Expression, right: Expression):
+        super().__init__([left, right])
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def _compute(self, ctx: EvalContext, a: Vec, b: Vec) -> Vec:
+        xp = ctx.xp
+        ea, eb = a.children[0], b.children[0]
+        la, lb = _live(xp, a), _live(xp, b)
+        eq = _pairwise_eq(xp, ea, la, eb, lb, null_equal=False)
+        common = eq.any(axis=(1, 2))
+        has_null = (la & ~ea.validity).any(axis=1) | \
+            (lb & ~eb.validity).any(axis=1)
+        # the null-because-of-nulls case requires BOTH sides non-empty
+        # (an empty side can never overlap -> plain false, Spark)
+        both_non_empty = (a.data.astype(np.int64) > 0) & \
+            (b.data.astype(np.int64) > 0)
+        validity = a.validity & b.validity & \
+            (common | ~(has_null & both_non_empty))
+        return Vec(T.BOOLEAN, common, validity)
+
+
+class _ArraySetOp(Expression):
+    def __init__(self, left: Expression, right: Expression):
+        super().__init__([left, right])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+
+class ArrayUnion(_ArraySetOp):
+    """array_union(a, b): distinct elements of a ++ b, first-seen order."""
+
+    def _compute(self, ctx: EvalContext, a: Vec, b: Vec) -> Vec:
+        xp = ctx.xp
+        ea, eb = a.children[0], b.children[0]
+        ka, kb = ea.data.shape[1], eb.data.shape[1]
+        cat = Vec(ea.dtype,
+                  xp.concatenate([ea.data, eb.data], axis=1),
+                  xp.concatenate([ea.validity, eb.validity], axis=1),
+                  None if ea.lengths is None else
+                  xp.concatenate([ea.lengths, eb.lengths], axis=1))
+        j = xp.arange(ka + kb, dtype=np.int64)[None, :]
+        live = (j < a.data.astype(np.int64)[:, None]) | \
+            ((j >= ka) & (j - ka < b.data.astype(np.int64)[:, None]))
+        eq = _pairwise_eq(xp, cat, live, cat, live, null_equal=True)
+        earlier = xp.tril(xp.ones((ka + kb, ka + kb), dtype=bool), k=-1)
+        dup = (eq & earlier[None, :, :]).any(axis=2)
+        out_elem, counts = _compact(xp, cat, live & ~dup)
+        return Vec(a.dtype, counts, a.validity & b.validity, None,
+                   (out_elem,))
+
+
+class ArrayIntersect(_ArraySetOp):
+    """array_intersect(a, b): distinct elements of a also present in b."""
+
+    def _compute(self, ctx: EvalContext, a: Vec, b: Vec) -> Vec:
+        xp = ctx.xp
+        ea, eb = a.children[0], b.children[0]
+        la, lb = _live(xp, a), _live(xp, b)
+        in_b = _pairwise_eq(xp, ea, la, eb, lb, null_equal=True).any(axis=2)
+        eq_aa = _pairwise_eq(xp, ea, la, ea, la, null_equal=True)
+        ka = ea.data.shape[1]
+        earlier = xp.tril(xp.ones((ka, ka), dtype=bool), k=-1)
+        dup = (eq_aa & earlier[None, :, :]).any(axis=2)
+        out_elem, counts = _compact(xp, ea, la & in_b & ~dup)
+        return Vec(a.dtype, counts, a.validity & b.validity, None,
+                   (out_elem,))
+
+
+class ArrayExcept(_ArraySetOp):
+    """array_except(a, b): distinct elements of a absent from b."""
+
+    def _compute(self, ctx: EvalContext, a: Vec, b: Vec) -> Vec:
+        xp = ctx.xp
+        ea, eb = a.children[0], b.children[0]
+        la, lb = _live(xp, a), _live(xp, b)
+        in_b = _pairwise_eq(xp, ea, la, eb, lb, null_equal=True).any(axis=2)
+        eq_aa = _pairwise_eq(xp, ea, la, ea, la, null_equal=True)
+        ka = ea.data.shape[1]
+        earlier = xp.tril(xp.ones((ka, ka), dtype=bool), k=-1)
+        dup = (eq_aa & earlier[None, :, :]).any(axis=2)
+        out_elem, counts = _compact(xp, ea, la & ~in_b & ~dup)
+        return Vec(a.dtype, counts, a.validity & b.validity, None,
+                   (out_elem,))
+
+
+class ArrayJoin(Expression):
+    """array_join(arr<string>, delim[, null_replacement]) — literal delim;
+    nulls skipped unless a replacement is given (Spark)."""
+
+    def __init__(self, child: Expression, delim: Expression,
+                 null_replacement: Optional[Expression] = None):
+        kids = [child, delim]
+        if null_replacement is not None:
+            kids.append(null_replacement)
+        super().__init__(kids)
+        self.delim = delim.value if isinstance(delim, Literal) else None
+        self.null_repl = (null_replacement.value
+                          if isinstance(null_replacement, Literal) else None)
+        self.has_repl = null_replacement is not None
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _compute(self, ctx: EvalContext, arr: Vec, delim: Vec,
+                 *rest: Vec) -> Vec:
+        from .strings_ext import _append
+        xp = ctx.xp
+        elem = arr.children[0]
+        k = elem.data.shape[1]
+        n = arr.data.shape[0]
+        live = _live(xp, arr)
+        sb = (self.delim or "").encode("utf-8")
+        srow = xp.asarray(np.frombuffer(sb, dtype=np.uint8)) if sb else None
+        rb = None
+        if self.has_repl:
+            rb = (self.null_repl or "").encode("utf-8")
+        out = Vec(T.STRING, xp.zeros((n, 8), dtype=xp.uint8),
+                  xp.ones(n, dtype=bool), xp.zeros(n, dtype=np.int32))
+        started = xp.zeros(n, dtype=bool)
+        for kk in range(k):
+            sl = live[:, kk]
+            v_valid = elem.validity[:, kk]
+            use = sl & (v_valid | self.has_repl)
+            vdat = elem.data[:, kk, :]
+            vlen = elem.lengths[:, kk].astype(np.int32)
+            if self.has_repl and rb:
+                rrow = np.zeros(max(vdat.shape[1], len(rb)), np.uint8)
+                rrow[:len(rb)] = np.frombuffer(rb, np.uint8)
+                if len(rb) > vdat.shape[1]:
+                    vdat = xp.pad(vdat, ((0, 0), (0, len(rb) - vdat.shape[1])))
+                vdat = xp.where(v_valid[:, None], vdat,
+                                xp.asarray(rrow[:vdat.shape[1]]))
+                vlen = xp.where(v_valid, vlen, len(rb)).astype(np.int32)
+            eff = xp.where(use, vlen, 0).astype(np.int32)
+            sep_eff = xp.where(started & use & (len(sb) > 0),
+                               len(sb), 0).astype(np.int32)
+            piece = Vec(T.STRING, vdat, use, vlen)
+            out = _append(xp, out, srow, sep_eff, piece, eff)
+            started = started | use
+        return Vec(T.STRING, out.data, arr.validity & delim.validity,
+                   out.lengths)
+
+
+class Flatten(Expression):
+    """flatten(array<array<T>>) -> array<T> (concatenates inner arrays;
+    null inner array -> null result, Spark)."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type.element_type
+
+    def _compute(self, ctx: EvalContext, arr: Vec) -> Vec:
+        xp = ctx.xp
+        outer = arr.children[0]          # counts [n, K_out], children: inner
+        inner = outer.children[0]        # data [n, K_out, K_in]
+        n, ko = outer.data.shape
+        ki = inner.data.shape[2]
+        live_o = _live(xp, arr)
+        has_null_inner = (live_o & ~outer.validity).any(axis=1)
+        inner_counts = xp.where(live_o & outer.validity,
+                                outer.data, 0).astype(np.int64)
+        total = inner_counts.sum(axis=1)
+        # flatten [n, K_out, K_in] -> [n, K_out*K_in], compact live slots
+        j_in = xp.arange(ki, dtype=np.int64)[None, None, :]
+        live_i = j_in < inner_counts[:, :, None]
+        flat = lambda a: a.reshape(n, ko * ki)
+        keep = flat(live_i)
+        elem2 = Vec(inner.dtype, flat(inner.data), flat(inner.validity),
+                    None if inner.lengths is None else flat(inner.lengths))
+        out_elem, counts = _compact(xp, elem2, keep)
+        return Vec(self.data_type, total.astype(np.int32),
+                   arr.validity & ~has_null_inner, None, (out_elem,))

@@ -372,3 +372,183 @@ class NextDay(Expression):
         delta = (self.target - dow) % 7
         delta = xp.where(delta == 0, 7, delta)
         return Vec(T.DATE, (days + delta).astype(np.int32), c.validity)
+
+
+class WeekOfYear(_DatePart):
+    """ISO-8601 week number (1..53), Spark weekofyear."""
+
+    part = "weekofyear"  # NOT the base default "year" — must hit _derive
+
+    def _derive(self, xp, days, y, m, d):
+        # ISO week: Thursday of the current week determines the ISO year;
+        # week = (doy_of_that_thursday - 1) // 7 + 1
+        dd = days.astype(np.int64)
+        dow = (dd + 3) % 7  # Monday=0
+        thursday = dd - dow + 3
+        ty, tm, td = civil_from_days(xp, thursday)
+        jan1 = days_from_civil(xp, ty, xp.ones_like(tm), xp.ones_like(td))
+        return ((thursday - jan1) // 7 + 1).astype(np.int32)
+
+
+_DAY_NAMES = ["Mon", "Tue", "Wed", "Thu", "Fri", "Sat", "Sun"]
+_MONTH_NAMES = ["Jan", "Feb", "Mar", "Apr", "May", "Jun", "Jul", "Aug",
+                "Sep", "Oct", "Nov", "Dec"]
+
+
+class _NameLookup(Expression):
+    """date -> short name string via a small [k, 3] byte table gather."""
+
+    names: list = []
+
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _index(self, xp, days):
+        raise NotImplementedError
+
+    def _compute(self, ctx, c: Vec) -> Vec:
+        xp = ctx.xp
+        table = np.zeros((len(self.names), 8), np.uint8)
+        for i, nm in enumerate(self.names):
+            b = nm.encode()
+            table[i, :len(b)] = np.frombuffer(b, np.uint8)
+        ix = self._index(xp, c.data)
+        data = xp.asarray(table)[ix]
+        lens = xp.full(c.data.shape[0], 3, dtype=np.int32)
+        return Vec(T.STRING, data, c.validity, lens)
+
+
+class DayName(_NameLookup):
+    names = _DAY_NAMES
+
+    def _index(self, xp, days):
+        return ((days.astype(np.int64) + 3) % 7).astype(np.int32)
+
+
+class MonthName(_NameLookup):
+    names = _MONTH_NAMES
+
+    def _index(self, xp, days):
+        _y, m, _d = civil_from_days(xp, days)
+        return (m - 1).astype(np.int32)
+
+
+class _EpochToTimestamp(Expression):
+    """timestamp_seconds/millis/micros(long) -> timestamp (us)."""
+
+    scale = 1
+
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.TIMESTAMP
+
+    def _compute(self, ctx, c: Vec) -> Vec:
+        us = c.data.astype(np.int64) * self.scale
+        return Vec(T.TIMESTAMP, us, c.validity)
+
+
+class TimestampSeconds(_EpochToTimestamp):
+    scale = 1_000_000
+
+
+class TimestampMillis(_EpochToTimestamp):
+    scale = 1_000
+
+
+class TimestampMicros(_EpochToTimestamp):
+    scale = 1
+
+
+class DateFromUnixDate(Expression):
+    """date_from_unix_date(int days) -> date."""
+
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.DATE
+
+    def _compute(self, ctx, c: Vec) -> Vec:
+        return Vec(T.DATE, c.data.astype(np.int32), c.validity)
+
+
+class UnixDate(Expression):
+    """unix_date(date) -> int days since epoch."""
+
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def _compute(self, ctx, c: Vec) -> Vec:
+        return Vec(T.INT, c.data.astype(np.int32), c.validity)
+
+
+class MakeDate(Expression):
+    """make_date(y, m, d): null on out-of-range components (non-ANSI)."""
+
+    def __init__(self, year, month, day):
+        super().__init__([year, month, day])
+
+    @property
+    def data_type(self):
+        return T.DATE
+
+    def _compute(self, ctx, y: Vec, m: Vec, d: Vec) -> Vec:
+        xp = ctx.xp
+        yy = y.data.astype(np.int64)
+        mm = m.data.astype(np.int64)
+        dd = d.data.astype(np.int64)
+        ok = ((mm >= 1) & (mm <= 12) & (dd >= 1) &
+              (dd <= _days_in_month(xp, yy, mm)) &
+              (yy >= 1) & (yy <= 9999))
+        days = days_from_civil(xp, xp.where(ok, yy, 2000),
+                               xp.where(ok, mm, 1), xp.where(ok, dd, 1))
+        valid = y.validity & m.validity & d.validity & ok
+        return Vec(T.DATE, days.astype(np.int32), valid)
+
+
+class TruncTimestamp(Expression):
+    """date_trunc(fmt, ts) with literal fmt: YEAR/QUARTER/MONTH/WEEK/DAY/
+    HOUR/MINUTE/SECOND (timestamps are us since epoch, UTC)."""
+
+    _US = {"MICROSECOND": 1, "MILLISECOND": 1_000, "SECOND": 1_000_000,
+           "MINUTE": 60_000_000, "HOUR": 3_600_000_000,
+           "DAY": 86_400_000_000, "DD": 86_400_000_000}
+
+    def __init__(self, fmt: str, child):
+        super().__init__([child])
+        self.fmt = fmt.upper()
+
+    @property
+    def data_type(self):
+        return T.TIMESTAMP
+
+    def _compute(self, ctx, c: Vec) -> Vec:
+        xp = ctx.xp
+        us = c.data.astype(np.int64)
+        f = self.fmt
+        if f in self._US:
+            step = self._US[f]
+            out = _floor_div(xp, us, step) * step
+        elif f in ("YEAR", "YYYY", "YY", "MONTH", "MM", "MON", "QUARTER",
+                   "WEEK"):
+            days = _ts_to_days(xp, us)
+            dv = Vec(T.DATE, days.astype(np.int32), c.validity)
+            out_days = TruncDate(self.children[0], f)._compute(ctx, dv)
+            out = out_days.data.astype(np.int64) * 86_400_000_000
+            return Vec(T.TIMESTAMP, out, c.validity & out_days.validity)
+        else:  # invalid format -> null (Spark)
+            return Vec(T.TIMESTAMP, xp.zeros_like(us),
+                       xp.zeros(us.shape[0], dtype=bool))
+        return Vec(T.TIMESTAMP, out, c.validity)

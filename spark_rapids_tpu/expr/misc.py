@@ -1,0 +1,251 @@
+"""Misc expressions (reference `GpuOverrides.scala` misc rules:
+GpuSparkPartitionID, GpuInputFileName, GpuRaiseError, GpuAssertTrue-ish,
+GpuWidthBucket, GpuSequence, GpuMonotonicallyIncreasingID, Pi/E literals).
+
+raise_error / assert_true ride the kernel error channel (exec/base.py
+device_ctx): XLA cannot raise mid-kernel, so the expression appends a traced
+flag and the enclosing Project/Filter exec raises host-side after the kernel
+returns — the planner restricts side-effect expressions to those execs."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .. import types as T
+from .base import (EvalContext, Expression, LeafExpression, Literal, Vec,
+                   ansi_raise)
+
+__all__ = ["SparkPartitionID", "InputFileName", "RaiseError", "AssertTrue",
+           "Pi", "Euler", "WidthBucket", "Sequence",
+           "MonotonicallyIncreasingID"]
+
+
+class SparkPartitionID(LeafExpression):
+    """spark_partition_id(): the engine executes one logical partition per
+    process, so this is the ctx partition ordinal (0 unless an exec sets
+    it) — same contract as the reference's per-task constant."""
+
+    has_side_effects = False
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    @property
+    def nullable(self):
+        return False
+
+    def _compute(self, ctx: EvalContext) -> Vec:
+        n = ctx.row_mask.shape[0] if ctx.row_mask is not None else 1
+        pid = getattr(ctx, "partition_id", 0) or 0
+        xp = ctx.xp
+        return Vec(T.INT, xp.full(n, pid, dtype=np.int32),
+                   xp.ones(n, dtype=bool))
+
+
+class MonotonicallyIncreasingID(LeafExpression):
+    """monotonically_increasing_id(): (partition << 33) + row ordinal within
+    the partition; single-partition engine -> plain row ordinal per batch
+    stream (the exec's batch offset rides ctx.partition_row_offset)."""
+
+    @property
+    def data_type(self):
+        return T.LONG
+
+    @property
+    def nullable(self):
+        return False
+
+    def _compute(self, ctx: EvalContext) -> Vec:
+        xp = ctx.xp
+        mask = ctx.row_mask
+        n = mask.shape[0] if mask is not None else 1
+        if mask is None:
+            mask = xp.ones(n, dtype=bool)
+        # ordinal among LIVE rows + the exec-threaded cumulative offset
+        # (offset may be a traced scalar — no host conversion here)
+        ordinal = xp.cumsum(mask.astype(np.int64)) - 1
+        pid = int(ctx.partition_id or 0)
+        ids = (pid << 33) + ctx.partition_row_offset + ordinal
+        return Vec(T.LONG, ids.astype(np.int64), xp.ones(n, dtype=bool))
+
+
+class InputFileName(LeafExpression):
+    """input_file_name(): empty string outside a file-scan task (Spark
+    contract); scans don't thread the path into expression context yet."""
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _compute(self, ctx: EvalContext) -> Vec:
+        xp = ctx.xp
+        n = ctx.row_mask.shape[0] if ctx.row_mask is not None else 1
+        return Vec(T.STRING, xp.zeros((n, 8), dtype=xp.uint8),
+                   xp.ones(n, dtype=bool), xp.zeros(n, dtype=np.int32))
+
+
+class RaiseError(Expression):
+    """raise_error(msg literal): errors as soon as any live row evaluates."""
+
+    has_side_effects = True
+
+    def __init__(self, message: Expression):
+        super().__init__([message])
+        self.message = message.value if isinstance(message, Literal) else None
+
+    @property
+    def data_type(self):
+        return T.NULL
+
+    def _compute(self, ctx: EvalContext, _msg: Vec) -> Vec:
+        xp = ctx.xp
+        n = ctx.row_mask.shape[0] if ctx.row_mask is not None \
+            else _msg.data.shape[0]
+        live = ctx.row_mask if ctx.row_mask is not None \
+            else xp.ones(n, dtype=bool)
+        ansi_raise(ctx, live, f"[USER_RAISED_EXCEPTION] "
+                   f"{self.message or ''}")
+        return Vec(T.NULL, xp.zeros(n, dtype=bool),
+                   xp.zeros(n, dtype=bool))
+
+
+class AssertTrue(Expression):
+    """assert_true(cond[, msg]): null when cond holds, errors otherwise."""
+
+    has_side_effects = True
+
+    def __init__(self, condition: Expression, message: Expression = None):
+        kids = [condition] + ([message] if message is not None else [])
+        super().__init__(kids)
+        self.message = message.value if isinstance(message, Literal) else None
+
+    @property
+    def data_type(self):
+        return T.NULL
+
+    def _compute(self, ctx: EvalContext, cond: Vec, *rest: Vec) -> Vec:
+        xp = ctx.xp
+        n = cond.data.shape[0]
+        live = ctx.row_mask if ctx.row_mask is not None \
+            else xp.ones(n, dtype=bool)
+        ok = cond.validity & cond.data.astype(bool)
+        msg = self.message or "assertion failed"
+        ansi_raise(ctx, live & ~ok, f"[USER_RAISED_EXCEPTION] {msg}")
+        return Vec(T.NULL, xp.zeros(n, dtype=bool), xp.zeros(n, dtype=bool))
+
+
+class Pi(LeafExpression):
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+    @property
+    def nullable(self):
+        return False
+
+    def _compute(self, ctx: EvalContext) -> Vec:
+        xp = ctx.xp
+        n = ctx.row_mask.shape[0] if ctx.row_mask is not None else 1
+        return Vec(T.DOUBLE, xp.full(n, math.pi, dtype=np.float64),
+                   xp.ones(n, dtype=bool))
+
+
+class Euler(LeafExpression):
+    """e()"""
+
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+    @property
+    def nullable(self):
+        return False
+
+    def _compute(self, ctx: EvalContext) -> Vec:
+        xp = ctx.xp
+        n = ctx.row_mask.shape[0] if ctx.row_mask is not None else 1
+        return Vec(T.DOUBLE, xp.full(n, math.e, dtype=np.float64),
+                   xp.ones(n, dtype=bool))
+
+
+class WidthBucket(Expression):
+    """width_bucket(v, lo, hi, nb): 1-based bucket over [lo, hi); v < lo ->
+    0, v >= hi -> nb+1; reversed bounds mirror; null/invalid nb -> null."""
+
+    def __init__(self, value, lo, hi, num_buckets):
+        super().__init__([value, lo, hi, num_buckets])
+
+    @property
+    def data_type(self):
+        return T.LONG
+
+    def _compute(self, ctx: EvalContext, v: Vec, lo: Vec, hi: Vec,
+                 nb: Vec) -> Vec:
+        xp = ctx.xp
+        x = v.data.astype(np.float64)
+        a = lo.data.astype(np.float64)
+        b = hi.data.astype(np.float64)
+        n = xp.maximum(nb.data.astype(np.int64), 1)
+        width = (b - a) / n
+        safe_w = xp.where(width == 0, 1.0, width)
+        up = xp.floor((x - a) / safe_w).astype(np.int64) + 1
+        fwd = xp.where(x < a, 0, xp.where(x >= b, n + 1,
+                                          xp.clip(up, 1, n)))
+        down = xp.floor((a - x) / xp.where(safe_w == 0, 1.0,
+                                           -safe_w)).astype(np.int64) + 1
+        rev = xp.where(x > a, 0, xp.where(x <= b, n + 1,
+                                          xp.clip(down, 1, n)))
+        data = xp.where(a < b, fwd, rev)
+        valid = (v.validity & lo.validity & hi.validity & nb.validity &
+                 (nb.data.astype(np.int64) > 0) & (a != b) &
+                 ~xp.isnan(x) & ~xp.isnan(a) & ~xp.isnan(b))
+        return Vec(T.LONG, xp.where(valid, data, 0), valid)
+
+
+class Sequence(Expression):
+    """sequence(start, stop[, step]) over integral inputs — literal bounds
+    (static fanout under jit); the planner tags non-literal forms to CPU."""
+
+    def __init__(self, start: Expression, stop: Expression,
+                 step: Expression = None):
+        kids = [start, stop] + ([step] if step is not None else [])
+        super().__init__(kids)
+        self.lit_bounds = all(isinstance(k, Literal) for k in kids)
+        if self.lit_bounds:
+            s = start.value
+            e = stop.value
+            st = step.value if step is not None else (1 if e >= s else -1)
+            self._max_len = 0 if st == 0 else \
+                max(0, (e - s) // st + 1 if (e - s) * st >= 0 else 0)
+        else:
+            self._max_len = None
+
+    @property
+    def data_type(self):
+        return T.ArrayType(T.LONG)
+
+    def _compute(self, ctx: EvalContext, start: Vec, stop: Vec,
+                 *rest: Vec) -> Vec:
+        xp = ctx.xp
+        n = start.data.shape[0]
+        k = max(int(self._max_len or 0), 1)
+        s = start.data.astype(np.int64)
+        e = stop.data.astype(np.int64)
+        if rest:
+            st = rest[0].data.astype(np.int64)
+            st_valid = rest[0].validity & (rest[0].data != 0)
+        else:
+            st = xp.where(e >= s, 1, -1).astype(np.int64)
+            st_valid = xp.ones(n, dtype=bool)
+        j = xp.arange(k, dtype=np.int64)[None, :]
+        vals = s[:, None] + j * st[:, None]
+        count = xp.where((e - s) * st >= 0,
+                         (e - s) // xp.where(st == 0, 1, st) + 1, 0)
+        count = xp.clip(count, 0, k).astype(np.int32)
+        live = j < count[:, None]
+        elem = Vec(T.LONG, xp.where(live, vals, 0), live)
+        return Vec(T.ArrayType(T.LONG), count,
+                   start.validity & stop.validity & st_valid, None, (elem,))

@@ -1,0 +1,343 @@
+"""More string expressions over the byte-matrix layout (reference
+`stringFunctions.scala`: GpuOverlay-ish via GpuStringReplace machinery,
+GpuLevenshtein, GpuSoundex, GpuFormatNumber, GpuConv, Empty2Null).
+
+Levenshtein uses the prefix-min linearization of the DP recurrence: for each
+input row i, e[j] = min(prev[j]+1, prev[j-1]+cost) and dp[j] =
+j + cummin(e[j]-j) — the horizontal dependency becomes a cumulative min, so
+one O(W) vector step per DP row and everything stays jit-friendly."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import types as T
+from ..columnar.padding import width_bucket
+from .base import EvalContext, Expression, Literal, Vec, and_validity
+
+__all__ = ["Overlay", "Levenshtein", "SoundEx", "FormatNumber",
+           "Empty2Null", "Conv"]
+
+
+class Overlay(Expression):
+    """overlay(input, replace, pos[, len]): splice `replace` into `input` at
+    1-based pos, consuming `len` input chars (default = length of replace).
+    Byte semantics (ASCII-safe, like the reference's byte kernels)."""
+
+    def __init__(self, child, replace, pos, length=None):
+        kids = [child, replace, pos] + ([length] if length is not None
+                                        else [])
+        super().__init__(kids)
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _compute(self, ctx: EvalContext, s: Vec, r: Vec, pos: Vec,
+                 *rest: Vec) -> Vec:
+        xp = ctx.xp
+        n, w_in = s.data.shape
+        w_rep = r.data.shape[1]
+        ow = width_bucket(w_in + w_rep)
+        sl = s.lengths.astype(np.int64)
+        rl = r.lengths.astype(np.int64)
+        p0 = xp.clip(pos.data.astype(np.int64) - 1, 0, sl)
+        consumed = rest[0].data.astype(np.int64) if rest else rl
+        consumed = xp.clip(consumed, 0, sl - p0)
+        tail_start = p0 + consumed
+        out_len = p0 + rl + (sl - tail_start)
+        j = xp.arange(ow, dtype=np.int64)[None, :]
+        in_head = j < p0[:, None]
+        in_rep = ~in_head & (j < (p0 + rl)[:, None])
+        pad_s = xp.pad(s.data, ((0, 0), (0, ow - w_in))) if ow > w_in \
+            else s.data
+        pad_r = xp.pad(r.data, ((0, 0), (0, ow - w_rep))) if ow > w_rep \
+            else r.data
+        head = xp.take_along_axis(pad_s, xp.minimum(j, ow - 1), axis=1)
+        rep = xp.take_along_axis(
+            pad_r, xp.clip(j - p0[:, None], 0, ow - 1), axis=1)
+        tail_idx = xp.clip(j - (p0 + rl)[:, None] + tail_start[:, None],
+                           0, ow - 1)
+        tail = xp.take_along_axis(pad_s, tail_idx, axis=1)
+        data = xp.where(in_head, head, xp.where(in_rep, rep, tail))
+        live = j < out_len[:, None]
+        data = xp.where(live, data, np.uint8(0))
+        valid = s.validity & r.validity & pos.validity
+        if rest:
+            valid = valid & rest[0].validity
+        return Vec(T.STRING, data, valid,
+                   xp.clip(out_len, 0, ow).astype(np.int32))
+
+
+class Levenshtein(Expression):
+    """levenshtein(a, b) -> int edit distance (byte-level)."""
+
+    def __init__(self, left, right):
+        super().__init__([left, right])
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def _compute(self, ctx: EvalContext, a: Vec, b: Vec) -> Vec:
+        xp = ctx.xp
+        n, wa = a.data.shape
+        wb = b.data.shape[1]
+        la = a.lengths.astype(np.int64)
+        lb = b.lengths.astype(np.int64)
+        big = np.int64(1 << 20)
+        jj = xp.arange(wb + 1, dtype=np.int64)[None, :]
+        # dp over b-prefix length j; positions beyond lb are pinned high so
+        # the final gather at j = lb is unaffected by them
+        dp = xp.where(jj <= lb[:, None], jj, big) * xp.ones((n, 1), np.int64)
+        for i in range(wa):
+            ai = a.data[:, i][:, None]
+            cost = xp.where(
+                (jj[:, 1:] <= lb[:, None]) & (ai == b.data[:, :wb]), 0, 1)
+            prev_shift = dp[:, :-1]  # dp[i-1][j-1]
+            e = xp.minimum(dp[:, 1:] + 1, prev_shift + cost)
+            first = dp[:, :1] + 1  # dp[i][0] = i+1
+            em = xp.concatenate([first, e], axis=1) - jj
+            if xp is np:
+                run = np.minimum.accumulate(em, axis=1)
+            else:
+                import jax
+                run = jax.lax.associative_scan(jax.numpy.minimum, em, axis=1)
+            new_dp = run + jj
+            # rows where i >= la keep the previous dp (their string ended)
+            keep = (i < la)[:, None]
+            dp = xp.where(keep, new_dp, dp)
+        out = xp.take_along_axis(dp, lb[:, None], axis=1)[:, 0]
+        return Vec(T.INT, out.astype(np.int32),
+                   and_validity(xp, a.validity, b.validity))
+
+
+class SoundEx(Expression):
+    """soundex(str): classic 4-char code (letter + 3 digits)."""
+
+    _CODE = np.zeros(256, np.uint8)
+    for letters, digit in (("BFPV", 1), ("CGJKQSXZ", 2), ("DT", 3),
+                           ("L", 4), ("MN", 5), ("R", 6)):
+        for ch in letters:
+            _CODE[ord(ch)] = digit
+            _CODE[ord(ch.lower())] = digit
+    _HW = np.zeros(256, bool)
+    for ch in "HWhw":
+        _HW[ord(ch)] = True
+    _ALPHA = np.zeros(256, bool)
+    for o in range(ord("A"), ord("Z") + 1):
+        _ALPHA[o] = True
+        _ALPHA[o + 32] = True
+
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _compute(self, ctx: EvalContext, s: Vec) -> Vec:
+        xp = ctx.xp
+        n, w = s.data.shape
+        live = xp.arange(w)[None, :] < s.lengths[:, None]
+        code = xp.asarray(self._CODE)[s.data]
+        is_hw = xp.asarray(self._HW)[s.data]
+        alpha = xp.asarray(self._ALPHA)[s.data] & live
+        first_alpha = s.data[:, 0]
+        starts_alpha = alpha[:, 0] if w > 0 else xp.zeros(n, bool)
+        # Spark: non-letter first char -> input returned unchanged; keep
+        # that path simple by marking such rows and passing them through
+        # previous effective code: skip H/W (code carries over THROUGH them)
+        prev = xp.zeros(n, np.uint8)
+        first_code = code[:, 0]
+        digits = []
+        prev = first_code
+        for i in range(1, w):
+            c = code[:, i]
+            ok = alpha[:, i] & (c > 0) & (c != prev)
+            digits.append(xp.where(ok, c, 0))
+            # prev carries through H/W, resets on vowels (code 0, non-HW)
+            prev = xp.where(is_hw[:, i] | ~alpha[:, i], prev, c)
+        if digits:
+            dmat = xp.stack(digits, axis=1)  # [n, w-1]
+            nonzero = dmat > 0
+            order = xp.argsort(~nonzero, axis=1, stable=True)
+            packed = xp.take_along_axis(dmat, order[:, :3], axis=1) \
+                if dmat.shape[1] >= 3 else xp.pad(
+                    xp.take_along_axis(dmat, order, axis=1),
+                    ((0, 0), (0, 3 - dmat.shape[1])))
+        else:
+            packed = xp.zeros((n, 3), np.uint8)
+        upper_first = xp.where((first_alpha >= 97) & (first_alpha <= 122),
+                               first_alpha - 32, first_alpha)
+        out = xp.concatenate([upper_first[:, None],
+                              packed + ord("0")], axis=1).astype(xp.uint8)
+        ow = width_bucket(max(4, w))
+        out = xp.pad(out, ((0, 0), (0, ow - 4)))
+        # non-letter-initial rows: Spark returns the input unchanged
+        pad_in = xp.pad(s.data, ((0, 0), (0, ow - w))) if ow > w else s.data
+        data = xp.where(starts_alpha[:, None], out, pad_in)
+        lens = xp.where(starts_alpha, 4, s.lengths).astype(np.int32)
+        return Vec(T.STRING, data, s.validity, lens)
+
+
+class Empty2Null(Expression):
+    """empty2null(str): '' -> NULL (used by file writers for partitions)."""
+
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _compute(self, ctx: EvalContext, s: Vec) -> Vec:
+        return Vec(T.STRING, s.data, s.validity & (s.lengths > 0), s.lengths)
+
+
+class FormatNumber(Expression):
+    """format_number(x, d literal): fixed d decimals with thousands
+    separators (HALF_UP rounding like Spark/Java DecimalFormat)."""
+
+    def __init__(self, child, decimals):
+        super().__init__([child, decimals])
+        self.d = decimals.value if isinstance(decimals, Literal) else None
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _compute(self, ctx: EvalContext, v: Vec, _d: Vec) -> Vec:
+        xp = ctx.xp
+        d = max(int(self.d or 0), 0)
+        n = v.data.shape[0]
+        x = v.data.astype(np.float64)
+        neg = x < 0
+        ax = xp.abs(x)
+        scaled = xp.floor(ax * (10.0 ** d) + 0.5)  # HALF_UP on |x|
+        int_part = xp.floor(scaled / (10.0 ** d)).astype(np.int64)
+        frac_part = (scaled - int_part.astype(np.float64) *
+                     (10.0 ** d)).astype(np.int64)
+        # digits of the integer part (max 19), with grouping every 3
+        max_digits = 19
+        n_groups = (max_digits + 2) // 3
+        width = 1 + max_digits + (n_groups - 1) + 1 + d  # sign+digits+commas+.
+        ow = width_bucket(width)
+        digs = []
+        rem = int_part
+        for _ in range(max_digits):
+            digs.append((rem % 10).astype(np.uint8))
+            rem = rem // 10
+        dmat = xp.stack(digs[::-1], axis=1)  # most-significant first
+        ndig = xp.maximum(
+            max_digits - _leading_zeros(xp, dmat, max_digits), 1)
+        # assemble per-row bytes right-to-left into a fixed buffer
+        out = xp.zeros((n, ow), dtype=xp.uint8)
+        lens = xp.zeros(n, dtype=np.int64)
+        # fractional digits
+        if d:
+            fdigs = []
+            frem = frac_part
+            for _ in range(d):
+                fdigs.append((frem % 10).astype(np.uint8))
+                frem = frem // 10
+            fmat = xp.stack(fdigs[::-1], axis=1)
+        # build as python-level assembly via index math (static widths):
+        # layout: [sign][int digits with commas][.(d>0)][frac digits]
+        n_commas = xp.maximum((ndig - 1) // 3, 0)
+        int_w = ndig + n_commas
+        total = (neg.astype(np.int64) + int_w +
+                 ((1 + d) if d else 0))
+        j = xp.arange(ow, dtype=np.int64)[None, :]
+        sign_here = neg[:, None] & (j == 0)
+        int_start = neg.astype(np.int64)[:, None]
+        k = j - int_start  # position within the int-with-commas zone
+        in_int = (k >= 0) & (k < int_w[:, None])
+        # within the zone, counting from the RIGHT: r = int_w-1-k; commas at
+        # r % 4 == 3 (groups of 3 digits + comma)
+        r = int_w[:, None] - 1 - k
+        is_comma = in_int & (r % 4 == 3)
+        digit_ord = xp.where(is_comma, 0, r - r // 4)  # digit index from right
+        src = xp.clip(max_digits - 1 - digit_ord, 0, max_digits - 1)
+        int_digit = xp.take_along_axis(dmat.astype(np.int64), src, axis=1)
+        ch = xp.where(is_comma, ord(","), int_digit + ord("0"))
+        data = xp.where(in_int, ch, 0)
+        data = xp.where(sign_here, ord("-"), data)
+        if d:
+            dot_pos = int_start + int_w[:, None]
+            is_dot = j == dot_pos
+            in_frac = (j > dot_pos) & (j <= dot_pos + d)
+            fsrc = xp.clip(j - dot_pos - 1, 0, d - 1)
+            fdigit = xp.take_along_axis(fmat.astype(np.int64), fsrc, axis=1)
+            data = xp.where(is_dot, ord("."), data)
+            data = xp.where(in_frac, fdigit + ord("0"), data)
+        data = xp.where(j < total[:, None], data, 0).astype(xp.uint8)
+        bad = xp.isnan(x) | xp.isinf(x) | \
+            (ax >= 10.0 ** (max_digits - 1))
+        return Vec(T.STRING, data, v.validity & ~bad,
+                   total.astype(np.int32))
+
+
+def _leading_zeros(xp, dmat, k):
+    nz = dmat > 0
+    any_nz = nz.any(axis=1)
+    first = xp.argmax(nz, axis=1)
+    return xp.where(any_nz, first, k - 1).astype(np.int64)
+
+
+class Conv(Expression):
+    """conv(num_str, from_base, to_base) — literal bases in 2..36; negative
+    inputs unsupported (tagged). Parses the string in from_base, formats in
+    to_base (uppercase digits, Spark)."""
+
+    def __init__(self, child, from_base, to_base):
+        super().__init__([child, from_base, to_base])
+        self.fb = from_base.value if isinstance(from_base, Literal) else None
+        self.tb = to_base.value if isinstance(to_base, Literal) else None
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _compute(self, ctx: EvalContext, s: Vec, _f: Vec, _t: Vec) -> Vec:
+        xp = ctx.xp
+        fb = int(self.fb)
+        tb = int(self.tb)
+        n, w = s.data.shape
+        # char -> digit value (255 = invalid)
+        lut = np.full(256, 255, np.uint8)
+        for i in range(10):
+            lut[ord("0") + i] = i
+        for i in range(26):
+            lut[ord("A") + i] = 10 + i
+            lut[ord("a") + i] = 10 + i
+        dv = xp.asarray(lut)[s.data].astype(np.int64)
+        live = xp.arange(w)[None, :] < s.lengths[:, None]
+        ok_digit = (dv < fb) & live
+        # Spark stops at the first invalid digit; empty prefix -> null
+        bad_seen = xp.cumsum((~ok_digit & live).astype(np.int32), axis=1) > 0
+        use = ok_digit & ~bad_seen
+        n_used = use.sum(axis=1)
+        # value = sum over used digits with positional weights (left-aligned)
+        idx = xp.cumsum(use.astype(np.int64), axis=1)
+        weight_pow = n_used[:, None] - idx  # exponent per used digit
+        wgt = xp.where(use, fb ** xp.clip(weight_pow, 0, 63), 0)
+        val = (dv * wgt).sum(axis=1)
+        # format in to_base
+        max_out = 64  # enough for base 2 of u64
+        digs = []
+        rem = val
+        for _ in range(max_out):
+            digs.append((rem % tb).astype(np.int64))
+            rem = rem // tb
+        dmat = xp.stack(digs[::-1], axis=1)
+        nd = xp.maximum(max_out - _leading_zeros(xp, dmat, max_out), 1)
+        ow = width_bucket(max_out)
+        j = xp.arange(ow, dtype=np.int64)[None, :]
+        src = xp.clip(max_out - nd[:, None] + j, 0, max_out - 1)
+        out_digit = xp.take_along_axis(dmat, src, axis=1)
+        ch = xp.where(out_digit < 10, out_digit + ord("0"),
+                      out_digit - 10 + ord("A"))
+        data = xp.where(j < nd[:, None], ch, 0).astype(xp.uint8)
+        valid = s.validity & (n_used > 0)
+        return Vec(T.STRING, data, valid, nd.astype(np.int32))

@@ -147,8 +147,11 @@ class CpuProjectExec(PhysicalPlan):
         return self._schema
 
     def execute_cpu(self):
+        offset = 0
         for b in self.children[0].execute_cpu():
             ctx = _ctx(b.num_rows)
+            ctx.partition_row_offset = offset
+            offset += b.num_rows
             vecs = [e.eval(ctx, b.vecs) for e in self._bound]
             yield HostBatch(self._schema, vecs, b.num_rows)
 
@@ -313,6 +316,54 @@ def _cpu_agg(func: AggregateFunction, ctx, b: HostBatch, gid, ng) -> Vec:
     valid_any = np.zeros(ng, dtype=bool)
     np.logical_or.at(valid_any, gid, v.validity)
     name = type(func).__name__
+    if name == "CountIf":
+        hit = v.validity & v.data.astype(bool)
+        data = np.bincount(gid, weights=hit.astype(np.float64),
+                           minlength=ng).astype(np.int64)
+        return Vec(T.LONG, data, np.ones(ng, dtype=bool))
+    if name in ("BoolAnd", "BoolOr"):
+        out = np.zeros(ng, dtype=bool)
+        for g in range(ng):
+            sel = (gid == g) & v.validity
+            vals = v.data[sel].astype(bool)
+            if len(vals):
+                out[g] = vals.all() if name == "BoolAnd" else vals.any()
+        return Vec(T.BOOLEAN, out, valid_any)
+    if name in ("BitAndAgg", "BitOrAgg", "BitXorAgg"):
+        out = np.zeros(ng, dtype=np.int64)
+        for g in range(ng):
+            sel = (gid == g) & v.validity
+            vals = [int(x) for x in v.data[sel]]
+            if not vals:
+                continue
+            acc = vals[0]
+            for x in vals[1:]:
+                acc = (acc & x if name == "BitAndAgg" else
+                       acc | x if name == "BitOrAgg" else acc ^ x)
+            out[g] = acc
+        return Vec(out_t, out.astype(out_t.np_dtype), valid_any)
+    if name in ("Skewness", "Kurtosis"):
+        out = np.zeros(ng, dtype=np.float64)
+        has = np.zeros(ng, dtype=bool)
+        x = v.data.astype(np.float64)
+        for g in range(ng):
+            sel = (gid == g) & v.validity
+            vals = x[sel]
+            c = len(vals)
+            if c == 0:
+                continue
+            has[g] = True
+            mu = vals.mean()
+            m2 = ((vals - mu) ** 2).sum()
+            if m2 <= 0:
+                out[g] = np.nan
+            elif name == "Skewness":
+                m3 = ((vals - mu) ** 3).sum()
+                out[g] = np.sqrt(c) * m3 / m2 ** 1.5
+            else:
+                m4 = ((vals - mu) ** 4).sum()
+                out[g] = c * m4 / (m2 * m2) - 3.0
+        return Vec(T.DOUBLE, out, has)
     if name in ("VariancePop", "VarianceSamp", "StddevPop", "StddevSamp"):
         out = np.zeros(ng, dtype=np.float64)
         has = np.zeros(ng, dtype=bool)

@@ -315,6 +315,137 @@ for cls in (CollectList, CollectSet):
 expr_rule(ApproximatePercentile, TypeSig.all_with_nested(),
           tag_fn=_tag_percentile)
 
+# --------------------------------------------------------------------------
+# breadth push: misc / datetime tail / more strings / array set ops / new
+# aggregates (GpuOverrides.scala rule families)
+# --------------------------------------------------------------------------
+from ..expr import collections_ext as ECE  # noqa: E402
+from ..expr import misc as EMI  # noqa: E402
+from ..expr import strings_more as ESM  # noqa: E402
+from ..expr.aggregates import (BitAndAgg, BitOrAgg, BitXorAgg, BoolAnd,  # noqa: E402
+                               BoolOr, CountIf, Kurtosis, Skewness)
+from ..expr.base import Literal as _Lit  # noqa: E402
+
+
+def _tag_literal_args(attr_names, what):
+    def tag(meta: ExprMeta) -> None:
+        for a in attr_names:
+            if getattr(meta.expr, a, None) is None:
+                meta.will_not_work(
+                    f"{what} requires literal {a} on TPU (static shapes)")
+                return
+    return tag
+
+
+def _tag_primitive_elems(meta: ExprMeta) -> None:
+    for c in meta.expr.children:
+        try:
+            dt = c.data_type
+        except ValueError:
+            continue
+        if isinstance(dt, T.ArrayType):
+            et = dt.element_type
+            if et.is_nested or isinstance(et, T.StringType):
+                meta.will_not_work(
+                    f"{meta.expr.name} over {et.simple_string()} elements "
+                    "is not supported on TPU")
+                return
+
+
+def _tag_string_elems(meta: ExprMeta) -> None:
+    try:
+        et = meta.expr.children[0].data_type.element_type
+    except Exception:
+        return
+    if not isinstance(et, T.StringType):
+        meta.will_not_work("array_join needs array<string>")
+
+
+# misc
+expr_rule(EMI.SparkPartitionID, _int)
+expr_rule(EMI.MonotonicallyIncreasingID, TypeSig((T.LongType,)))
+expr_rule(EMI.InputFileName, _str)
+expr_rule(EMI.RaiseError, TypeSig.all_basic())
+expr_rule(EMI.AssertTrue, TypeSig.all_basic())
+expr_rule(EMI.Pi, _dbl)
+expr_rule(EMI.Euler, _dbl)
+expr_rule(EMI.WidthBucket, _num)
+expr_rule(EMI.Sequence, TypeSig.all_with_nested(),
+          tag_fn=_tag_literal_args(("_max_len",), "sequence"))
+
+# datetime tail
+expr_rule(ED.WeekOfYear, _int)
+expr_rule(ED.DayName, _str)
+expr_rule(ED.MonthName, _str)
+expr_rule(ED.TimestampSeconds, TypeSig((T.TimestampType,)))
+expr_rule(ED.TimestampMillis, TypeSig((T.TimestampType,)))
+expr_rule(ED.TimestampMicros, TypeSig((T.TimestampType,)))
+expr_rule(ED.DateFromUnixDate, TypeSig((T.DateType,)))
+expr_rule(ED.UnixDate, _int)
+expr_rule(ED.MakeDate, TypeSig((T.DateType,)))
+expr_rule(ED.TruncTimestamp, TypeSig((T.TimestampType,)))
+
+# more strings
+expr_rule(ESM.Overlay, _str)
+expr_rule(ESM.Levenshtein, _int)
+expr_rule(ESM.SoundEx, _str)
+expr_rule(ESM.Empty2Null, _str)
+expr_rule(ESM.FormatNumber, _str,
+          tag_fn=_tag_literal_args(("d",), "format_number"),
+          doc="Enable format_number; |values| at int64 scale or beyond "
+              "return null (19+ digit JVM DecimalFormat not reproduced).")
+expr_rule(ESM.Conv, _str, tag_fn=_tag_literal_args(("fb", "tb"), "conv"))
+
+# array breadth
+expr_rule(ECE.ArrayPosition, TypeSig.all_with_nested(),
+          tag_fn=_tag_primitive_elems)
+expr_rule(ECE.ArrayRemove, TypeSig.all_with_nested(),
+          tag_fn=_tag_primitive_elems)
+expr_rule(ECE.ArrayDistinct, TypeSig.all_with_nested(),
+          tag_fn=_tag_primitive_elems)
+expr_rule(ECE.ArraysOverlap, TypeSig.all_with_nested(),
+          tag_fn=_tag_primitive_elems)
+expr_rule(ECE.ArrayUnion, TypeSig.all_with_nested(),
+          tag_fn=_tag_primitive_elems)
+expr_rule(ECE.ArrayIntersect, TypeSig.all_with_nested(),
+          tag_fn=_tag_primitive_elems)
+expr_rule(ECE.ArrayExcept, TypeSig.all_with_nested(),
+          tag_fn=_tag_primitive_elems)
+expr_rule(ECE.Slice, TypeSig.all_with_nested())
+expr_rule(ECE.Reverse, TypeSig.all_with_nested())
+expr_rule(ECE.Flatten, TypeSig.all_with_nested())
+
+
+def _tag_array_repeat(meta: ExprMeta) -> None:
+    if meta.expr.times is None:
+        meta.will_not_work("array_repeat requires a literal count on TPU")
+
+
+expr_rule(ECE.ArrayRepeat, TypeSig.all_with_nested(),
+          tag_fn=_tag_array_repeat)
+def _tag_array_join(meta: ExprMeta) -> None:
+    _tag_string_elems(meta)
+    e = meta.expr
+    if e.delim is None or (e.has_repl and e.null_repl is None):
+        meta.will_not_work(
+            "array_join requires literal delimiter/null_replacement on TPU")
+
+
+expr_rule(ECE.ArrayJoin, TypeSig.all_with_nested(),
+          tag_fn=_tag_array_join)
+
+# new aggregates
+expr_rule(CountIf, TypeSig((T.LongType,)))
+expr_rule(BoolAnd, _bool)
+expr_rule(BoolOr, _bool)
+for cls in (BitAndAgg, BitOrAgg, BitXorAgg):
+    expr_rule(cls, TypeSig((T.ByteType, T.ShortType, T.IntegerType,
+                            T.LongType)))
+for cls in (Skewness, Kurtosis):
+    expr_rule(cls, _dbl, incompat=True,
+              doc="Moment-form (power sums) can differ from the JVM's "
+                  "streaming updates in low ULPs.")
+
 
 def _tag_window_agg(meta: ExprMeta) -> None:
     from ..expr import windowexprs as WX
@@ -853,6 +984,26 @@ class Overrides:
                     meta.will_not_work(
                         "pandas UDFs are only supported in projections on "
                         "TPU (project the UDF into a column first)")
+                    break
+        if rule is not None and not isinstance(
+                plan, (N.CpuProjectExec, N.CpuFilterExec)):
+            # side-effect expressions (raise_error/assert_true) append traced
+            # error flags only Project/Filter kernels plumb back to the host
+            for em in meta.expr_metas:
+                if em.expr.collect(lambda x: x.has_side_effects):
+                    meta.will_not_work(
+                        "side-effect expressions are only supported in "
+                        "projections and filters on TPU")
+                    break
+        if rule is not None and not isinstance(plan, N.CpuProjectExec):
+            # monotonically_increasing_id needs the cumulative row offset
+            # only the Project execs thread across their batch stream
+            from ..expr.misc import MonotonicallyIncreasingID as _MIID
+            for em in meta.expr_metas:
+                if em.expr.collect(lambda x: isinstance(x, _MIID)):
+                    meta.will_not_work(
+                        "monotonically_increasing_id is only supported in "
+                        "projections")
                     break
         meta.tag_for_device()
         if self.conf.is_test_enabled and not meta.can_run_on_device:

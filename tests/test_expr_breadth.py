@@ -1,0 +1,444 @@
+"""Differential tests for the breadth-push expressions (misc, datetime tail,
+more strings, array set ops, new aggregates) — device vs CPU engine plus
+hand-computed oracles for the tricky semantics."""
+
+import datetime as dtlib
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.expr import (ArrayDistinct, ArrayExcept, ArrayIntersect,
+                                   ArrayJoin, ArrayPosition, ArrayRemove,
+                                   ArrayRepeat, ArraysOverlap, ArrayUnion,
+                                   AssertTrue, BitAndAgg, BitOrAgg, BitXorAgg,
+                                   BoolAnd, BoolOr, Conv, Count, CountIf,
+                                   CreateArray, DayName, Euler, Empty2Null,
+                                   Flatten, FormatNumber, Kurtosis,
+                                   Levenshtein, Literal, MakeDate, MonthName,
+                                   Overlay, Pi, RaiseError, Reverse, Sequence,
+                                   Skewness, Slice, SoundEx, SparkPartitionID,
+                                   TimestampMillis, TimestampSeconds,
+                                   TruncTimestamp, UnixDate, WeekOfYear,
+                                   WidthBucket, col, lit)
+from spark_rapids_tpu.plugin import TpuSession
+
+from test_queries import assert_same
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession({"spark.rapids.sql.enabled": True,
+                       "spark.rapids.sql.explain": "NONE"})
+
+
+def arr_df(session, rows, typ=pa.int64()):
+    t = pa.table({"a": pa.array(rows, type=pa.list_(typ)),
+                  "i": pa.array(range(len(rows)), type=pa.int64())})
+    return session.from_arrow(t)
+
+
+class TestArrayOps:
+    ROWS = [[1, 2, 2, 3], [], None, [5, None, 5], [7], [None, None],
+            [2, 4, 6, 8]]
+
+    def test_position_remove_distinct(self, session):
+        df = arr_df(session, self.ROWS)
+        q = df.select("i",
+                      p=ArrayPosition(col("a"), lit(2)),
+                      r=ArrayRemove(col("a"), lit(2)),
+                      d=ArrayDistinct(col("a")))
+        out = assert_same(q, sort_by=["i"])
+        assert out.column("p").to_pylist() == [2, 0, None, 0, 0, 0, 1]
+        assert out.column("r").to_pylist() == [
+            [1, 3], [], None, [5, None, 5], [7], [None, None], [4, 6, 8]]
+        assert out.column("d").to_pylist() == [
+            [1, 2, 3], [], None, [5, None], [7], [None], [2, 4, 6, 8]]
+
+    def test_set_ops(self, session):
+        t = pa.table({
+            "a": pa.array([[1, 2, 3], [1, 1], None, [None, 1]],
+                          type=pa.list_(pa.int64())),
+            "b": pa.array([[2, 4], [1], [1], [None]],
+                          type=pa.list_(pa.int64())),
+            "i": pa.array(range(4), type=pa.int64()),
+        })
+        df = session.from_arrow(t)
+        q = df.select("i",
+                      u=ArrayUnion(col("a"), col("b")),
+                      n=ArrayIntersect(col("a"), col("b")),
+                      e=ArrayExcept(col("a"), col("b")),
+                      o=ArraysOverlap(col("a"), col("b")))
+        out = assert_same(q, sort_by=["i"])
+        assert out.column("u").to_pylist() == [
+            [1, 2, 3, 4], [1], None, [None, 1]]
+        assert out.column("n").to_pylist() == [[2], [1], None, [None]]
+        assert out.column("e").to_pylist() == [[1, 3], [], None, [1]]
+        assert out.column("o").to_pylist() == [True, True, None, None]
+
+    def test_slice_reverse_repeat(self, session):
+        df = arr_df(session, self.ROWS)
+        q = df.select("i",
+                      s=Slice(col("a"), lit(2), lit(2)),
+                      sn=Slice(col("a"), lit(-2), lit(2)),
+                      rv=Reverse(col("a")),
+                      rp=ArrayRepeat(col("i"), lit(3)))
+        out = assert_same(q, sort_by=["i"])
+        assert out.column("s").to_pylist() == [
+            [2, 2], [], None, [None, 5], [], [None], [4, 6]]
+        assert out.column("rv").to_pylist() == [
+            [3, 2, 2, 1], [], None, [5, None, 5], [7], [None, None],
+            [8, 6, 4, 2]]
+        assert out.column("rp").to_pylist()[0] == [0, 0, 0]
+
+    def test_flatten(self, session):
+        t = pa.table({
+            "a": pa.array([[[1, 2], [3]], [[], [4]], [None, [5]], None],
+                          type=pa.list_(pa.list_(pa.int64()))),
+            "i": pa.array(range(4), type=pa.int64()),
+        })
+        df = session.from_arrow(t)
+        q = df.select("i", f=Flatten(col("a")))
+        out = assert_same(q, sort_by=["i"])
+        assert out.column("f").to_pylist() == [[1, 2, 3], [4], None, None]
+
+    def test_array_join(self, session):
+        t = pa.table({
+            "a": pa.array([["x", "y"], ["x", None, "z"], [], None],
+                          type=pa.list_(pa.string())),
+            "i": pa.array(range(4), type=pa.int64()),
+        })
+        df = session.from_arrow(t)
+        q = df.select("i", j=ArrayJoin(col("a"), lit(",")),
+                      jr=ArrayJoin(col("a"), lit("-"), lit("NUL")))
+        out = assert_same(q, sort_by=["i"])
+        assert out.column("j").to_pylist() == ["x,y", "x,z", "", None]
+        assert out.column("jr").to_pylist() == ["x-y", "x-NUL-z", "", None]
+
+
+class TestMisc:
+    def test_partition_id_and_constants(self, session, rng):
+        t = pa.table({"x": pa.array(rng.normal(0, 1, 20))})
+        df = session.from_arrow(t)
+        q = df.select(p=SparkPartitionID(), pi=Pi(), e=Euler())
+        out = assert_same(q)
+        assert set(out.column("p").to_pylist()) == {0}
+        assert abs(out.column("pi").to_pylist()[0] - np.pi) < 1e-15
+
+    def test_width_bucket(self, session):
+        t = pa.table({"v": pa.array([-1.0, 0.0, 2.5, 9.99, 10.0, 15.0,
+                                     None])})
+        df = session.from_arrow(t)
+        q = df.select("v", b=WidthBucket(col("v"), lit(0.0), lit(10.0),
+                                         lit(5)))
+        out = assert_same(q, sort_by=["v"])
+        got = dict(zip(out.column("v").to_pylist(),
+                       out.column("b").to_pylist()))
+        assert got[-1.0] == 0 and got[0.0] == 1 and got[2.5] == 2
+        assert got[9.99] == 5 and got[10.0] == 6 and got[15.0] == 6
+        assert got[None] is None
+
+    def test_sequence(self, session):
+        t = pa.table({"i": pa.array(range(3), type=pa.int64())})
+        df = session.from_arrow(t)
+        q = df.select("i", s=Sequence(lit(1), lit(5)),
+                      sd=Sequence(lit(10), lit(4), lit(-3)))
+        out = assert_same(q, sort_by=["i"])
+        assert out.column("s").to_pylist()[0] == [1, 2, 3, 4, 5]
+        assert out.column("sd").to_pylist()[0] == [10, 7, 4]
+
+    def test_raise_error_fires(self, session):
+        t = pa.table({"x": pa.array([1, 2], type=pa.int64())})
+        df = session.from_arrow(t)
+        q = df.select(e=RaiseError(lit("boom")))
+        with pytest.raises(Exception, match="boom"):
+            q.collect()
+        with pytest.raises(Exception, match="boom"):
+            q.collect_cpu()
+
+    def test_assert_true(self, session):
+        t = pa.table({"x": pa.array([1, 2, 3], type=pa.int64())})
+        df = session.from_arrow(t)
+        ok = df.select(a=AssertTrue(col("x") > lit(0)))
+        assert ok.collect().column("a").to_pylist() == [None] * 3
+        bad = df.select(a=AssertTrue(col("x") > lit(2), lit("too small")))
+        with pytest.raises(Exception, match="too small"):
+            bad.collect()
+
+
+class TestDatetimeTail:
+    def make_dates(self, session):
+        days = [0, 1, 365, 11323, 19000, -1, None]  # epoch-day ints
+        t = pa.table({"d": pa.array(
+            [None if d is None else dtlib.date(1970, 1, 1)
+             + dtlib.timedelta(days=d) for d in days], type=pa.date32())})
+        return session.from_arrow(t)
+
+    def test_week_names(self, session):
+        df = self.make_dates(session)
+        q = df.select("d", w=WeekOfYear(col("d")), dn=DayName(col("d")),
+                      mn=MonthName(col("d")))
+        out = assert_same(q, sort_by=["d"])
+        by_d = {str(d): (w, dn, mn) for d, w, dn, mn in zip(
+            out.column("d").to_pylist(), out.column("w").to_pylist(),
+            out.column("dn").to_pylist(), out.column("mn").to_pylist())}
+        # 1970-01-01 was a Thursday, ISO week 1
+        assert by_d["1970-01-01"] == (1, "Thu", "Jan")
+        assert by_d["2001-01-01"][1] == "Mon"  # epoch day 11323
+
+    def test_iso_week_against_python(self, session, rng):
+        days = rng.integers(-3000, 25000, 200)
+        t = pa.table({"d": pa.array(
+            [dtlib.date(1970, 1, 1) + dtlib.timedelta(days=int(x))
+             for x in days], type=pa.date32())})
+        df = session.from_arrow(t)
+        out = assert_same(df.select("d", w=WeekOfYear(col("d"))),
+                          sort_by=["d"])
+        for d, w in zip(out.column("d").to_pylist(),
+                        out.column("w").to_pylist()):
+            assert w == d.isocalendar()[1], d
+
+    def test_epoch_conversions(self, session):
+        t = pa.table({"s": pa.array([0, 1_600_000_000, None],
+                                    type=pa.int64())})
+        df = session.from_arrow(t)
+        q = df.select(ts=TimestampSeconds(col("s")),
+                      tm=TimestampMillis(col("s")))
+        out = assert_same(q)
+        vals = out.column("ts").to_pylist()
+        assert vals[0] is not None
+
+    def test_make_date_unix_date(self, session):
+        t = pa.table({"y": pa.array([2020, 2021, 2020, None],
+                                    type=pa.int32()),
+                      "m": pa.array([2, 13, 2, 1], type=pa.int32()),
+                      "d": pa.array([29, 1, 30, 1], type=pa.int32())})
+        df = session.from_arrow(t)
+        q = df.select(md=MakeDate(col("y"), col("m"), col("d")))
+        out = assert_same(q)
+        vals = out.column("md").to_pylist()
+        assert dtlib.date(2020, 2, 29) in vals
+        assert vals.count(None) == 3  # bad month, Feb 30, null year
+
+    def test_trunc_timestamp(self, session):
+        base = 1_700_000_000_123_456  # us
+        t = pa.table({"ts": pa.array([base], type=pa.timestamp("us",
+                                                               tz="UTC"))})
+        df = session.from_arrow(t)
+        q = df.select(h=TruncTimestamp("HOUR", col("ts")),
+                      dy=TruncTimestamp("DAY", col("ts")),
+                      mo=TruncTimestamp("MONTH", col("ts")))
+        out = assert_same(q)
+        h = out.column("h").to_pylist()[0]
+        assert h.minute == 0 and h.second == 0 and h.microsecond == 0
+
+
+class TestStringsMore:
+    def test_overlay(self, session):
+        t = pa.table({"s": pa.array(["Spark SQL", "abcdef", "", None])})
+        df = session.from_arrow(t)
+        q = df.select("s", o=Overlay(col("s"), lit("_"), lit(6)),
+                      o2=Overlay(col("s"), lit("XX"), lit(2), lit(3)))
+        out = assert_same(q, sort_by=["s"])
+        got = dict(zip(out.column("s").to_pylist(),
+                       out.column("o").to_pylist()))
+        assert got["Spark SQL"] == "Spark_SQL"
+        got2 = dict(zip(out.column("s").to_pylist(),
+                        out.column("o2").to_pylist()))
+        assert got2["abcdef"] == "aXXef"
+
+    def test_levenshtein(self, session):
+        pairs = [("kitten", "sitting", 3), ("", "abc", 3), ("abc", "", 3),
+                 ("same", "same", 0), ("flaw", "lawn", 2), ("a", "b", 1)]
+        t = pa.table({"a": pa.array([p[0] for p in pairs]),
+                      "b": pa.array([p[1] for p in pairs])})
+        df = session.from_arrow(t)
+        out = assert_same(df.select("a", "b",
+                                    d=Levenshtein(col("a"), col("b"))),
+                          sort_by=["a", "b"])
+        got = {(a, b): d for a, b, d in zip(out.column("a").to_pylist(),
+                                            out.column("b").to_pylist(),
+                                            out.column("d").to_pylist())}
+        for a, b, want in pairs:
+            assert got[(a, b)] == want, (a, b)
+
+    def test_soundex(self, session):
+        cases = [("Robert", "R163"), ("Rupert", "R163"),
+                 ("Ashcraft", "A261"), ("Tymczak", "T522"),
+                 ("Pfister", "P236"), ("Miller", "M460"), ("", ""),
+                 ("123", "123")]
+        t = pa.table({"s": pa.array([c[0] for c in cases])})
+        df = session.from_arrow(t)
+        out = assert_same(df.select("s", x=SoundEx(col("s"))),
+                          sort_by=["s"])
+        got = dict(zip(out.column("s").to_pylist(),
+                       out.column("x").to_pylist()))
+        for s, want in cases:
+            assert got[s] == want, s
+
+    def test_format_number(self, session):
+        t = pa.table({"v": pa.array([1234567.891, 0.5, -4536.1, 0.0,
+                                     None])})
+        df = session.from_arrow(t)
+        out = assert_same(df.select("v", f=FormatNumber(col("v"), lit(2))),
+                          sort_by=["v"])
+        got = dict(zip(out.column("v").to_pylist(),
+                       out.column("f").to_pylist()))
+        assert got[1234567.891] == "1,234,567.89"
+        assert got[0.5] == "0.50"
+        assert got[-4536.1] == "-4,536.10"
+        assert got[0.0] == "0.00"
+        assert got[None] is None
+
+    def test_conv(self, session):
+        t = pa.table({"s": pa.array(["100", "ff", "1010", "zz", ""])})
+        df = session.from_arrow(t)
+        out = assert_same(
+            df.select("s", h=Conv(col("s"), lit(16), lit(10)),
+                      b=Conv(col("s"), lit(2), lit(16))),
+            sort_by=["s"])
+        got = dict(zip(out.column("s").to_pylist(),
+                       out.column("h").to_pylist()))
+        assert got["ff"] == "255"
+        assert got["100"] == "256"
+        gb = dict(zip(out.column("s").to_pylist(),
+                      out.column("b").to_pylist()))
+        assert gb["1010"] == "A"
+
+    def test_empty2null(self, session):
+        t = pa.table({"s": pa.array(["x", "", None, "y"])})
+        df = session.from_arrow(t)
+        out = assert_same(df.select(e=Empty2Null(col("s"))))
+        assert sorted(out.column("e").to_pylist(), key=str) == \
+            sorted(["x", None, None, "y"], key=str)
+
+
+class TestNewAggregates:
+    def agg_df(self, session, rng, n=300):
+        t = pa.table({
+            "g": pa.array(rng.integers(0, 6, n), type=pa.int32()),
+            "b": pa.array(np.where(rng.random(n) < 0.1, None,
+                                   rng.random(n) < 0.5), type=pa.bool_()),
+            "x": pa.array(rng.integers(0, 255, n), type=pa.int64()),
+            "v": pa.array(np.where(rng.random(n) < 0.1, None,
+                                   rng.normal(0, 2, n).round(3)),
+                          type=pa.float64()),
+        })
+        return session.from_arrow(t), t
+
+    def test_count_if_bool_aggs(self, session, rng):
+        df, t = self.agg_df(session, rng)
+        q = df.group_by("g").agg(ci=CountIf(col("b")),
+                                 ba=BoolAnd(col("b")),
+                                 bo=BoolOr(col("b")),
+                                 n=Count(col("b")))
+        assert_same(q, sort_by=["g"])
+
+    def test_bit_aggs(self, session, rng):
+        df, t = self.agg_df(session, rng)
+        q = df.group_by("g").agg(a=BitAndAgg(col("x")),
+                                 o=BitOrAgg(col("x")),
+                                 x=BitXorAgg(col("x")))
+        out = assert_same(q, sort_by=["g"])
+        # oracle for group 0
+        import numpy as _np
+        g = t.column("g").to_numpy()
+        x = t.column("x").to_numpy()
+        vals = [int(v) for v in x[g == 0]]
+        acc_a, acc_o, acc_x = vals[0], vals[0], vals[0]
+        for v in vals[1:]:
+            acc_a &= v
+            acc_o |= v
+            acc_x ^= v
+        row0 = out.to_pylist()[0]
+        assert (row0["a"], row0["o"], row0["x"]) == (acc_a, acc_o, acc_x)
+
+    def test_moments(self, session, rng):
+        df, t = self.agg_df(session, rng)
+        q = df.group_by("g").agg(sk=Skewness(col("v")),
+                                 ku=Kurtosis(col("v")))
+        out = assert_same(q, sort_by=["g"], approx_cols=("sk", "ku"))
+        # scipy-free oracle for one group
+        g = t.column("g").to_numpy()
+        v = t.column("v").to_numpy(zero_copy_only=False)
+        sel = (g == 0) & ~pa.compute.is_null(t.column("v")).to_numpy(
+            zero_copy_only=False)
+        vals = v[sel].astype(float)
+        mu = vals.mean()
+        m2 = ((vals - mu) ** 2).sum()
+        m3 = ((vals - mu) ** 3).sum()
+        want_sk = np.sqrt(len(vals)) * m3 / m2 ** 1.5
+        got_sk = out.column("sk").to_pylist()[0]
+        assert abs(got_sk - want_sk) < 1e-9
+
+    def test_moments_distributed(self, rng):
+        # partial/final split must reconstitute identical moments
+        s = TpuSession({"spark.rapids.sql.enabled": True,
+                        "spark.rapids.sql.explain": "NONE",
+                        "spark.rapids.shuffle.mode": "ICI",
+                        "spark.rapids.tpu.mesh.shape": "shuffle=8"})
+        df, _t = self.agg_df(s, rng, n=500)
+        q = df.group_by("g").agg(sk=Skewness(col("v")),
+                                 ci=CountIf(col("b")),
+                                 bo=BitOrAgg(col("x")))
+        assert_same(q, sort_by=["g"], approx_cols=("sk",))
+
+
+class TestReviewRegressions:
+    def test_device_placement_of_breadth_exprs(self, session, rng):
+        """The breadth expressions must actually RUN on device (sig checks
+        compare the OUTPUT type; a wrong sig silently falls back)."""
+        from spark_rapids_tpu.expr import WeekOfYear, Levenshtein, CountIf
+        t = pa.table({
+            "d": pa.array([dtlib.date(2020, 5, 9)], type=pa.date32()),
+            "a": pa.array(["abc"]), "b": pa.array(["abd"]),
+            "f": pa.array([True]),
+        })
+        df = session.from_arrow(t)
+        q = df.select(w=WeekOfYear(col("d")), l=Levenshtein(col("a"),
+                                                            col("b")))
+        assert "not supported" not in q.explain()
+        q2 = df.group_by().agg(c=CountIf(col("f")))
+        assert "not supported" not in q2.explain()
+        assert q2.collect().column("c").to_pylist() == [1]
+
+    def test_monotonic_id_unique_across_batches(self, rng):
+        s = TpuSession({"spark.rapids.sql.enabled": True,
+                        "spark.rapids.sql.explain": "NONE",
+                        "spark.rapids.sql.batchSizeRows": 64})
+        from spark_rapids_tpu.expr import MonotonicallyIncreasingID
+        n = 300  # several 64-row batches
+        t = pa.table({"x": pa.array(np.arange(n), type=pa.int64())})
+        df = s.from_arrow(t)
+        out = df.select("x", id=MonotonicallyIncreasingID()).collect()
+        ids = out.column("id").to_pylist()
+        assert len(set(ids)) == n  # unique across batches
+        cpu = df.select("x", id=MonotonicallyIncreasingID()).collect_cpu()
+        assert sorted(ids) == sorted(cpu.column("id").to_pylist())
+
+    def test_slice_negative_beyond_start_empty(self, session):
+        t = pa.table({"a": pa.array([[1, 2, 3]], type=pa.list_(pa.int64()))})
+        df = session.from_arrow(t)
+        out = assert_same(df.select(s=Slice(col("a"), lit(-5), lit(2))))
+        assert out.column("s").to_pylist() == [[]]
+
+    def test_arrays_overlap_empty_side(self, session):
+        t = pa.table({
+            "a": pa.array([[], [1]], type=pa.list_(pa.int64())),
+            "b": pa.array([[None], [None]], type=pa.list_(pa.int64())),
+        })
+        df = session.from_arrow(t)
+        out = assert_same(df.select(o=ArraysOverlap(col("a"), col("b"))),
+                          sort_by=None)
+        assert out.column("o").to_pylist() == [False, None]
+
+    def test_trunc_timestamp_dd(self, session):
+        t = pa.table({"ts": pa.array([1_700_000_000_123_456],
+                                     type=pa.timestamp("us", tz="UTC"))})
+        df = session.from_arrow(t)
+        out = assert_same(df.select(d=TruncTimestamp("DD", col("ts")),
+                                    ms=TruncTimestamp("MILLISECOND",
+                                                      col("ts"))))
+        d = out.column("d").to_pylist()[0]
+        assert d.hour == 0 and d.minute == 0
+        assert out.column("ms").to_pylist()[0].microsecond % 1000 == 0
