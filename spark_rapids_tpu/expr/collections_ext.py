@@ -50,28 +50,36 @@ def _pairwise_eq(xp, ea: Vec, la, eb: Vec, lb, null_equal: bool):
     return eq & la[:, :, None] & lb[:, None, :]
 
 
+def _slot_take(xp, a, idx2d):
+    """take_along_axis over slot axis 1 for arrays of any rank >= 2 (string
+    byte matrices are [n, k, w]; nested children go deeper)."""
+    if a.ndim == 2:
+        return xp.take_along_axis(a, idx2d, axis=1)
+    idx = idx2d.reshape(idx2d.shape + (1,) * (a.ndim - 2))
+    idx = xp.broadcast_to(idx, idx2d.shape + a.shape[2:])
+    return xp.take_along_axis(a, idx, axis=1)
+
+
+def _gather_slots(xp, v: Vec, idx2d, live) -> Vec:
+    """Gather element slots by per-row indices, zeroing dead slots; recurses
+    into children so string and nested elements ride along."""
+    def z(a):
+        out = _slot_take(xp, a, idx2d)
+        keep = live.reshape(live.shape + (1,) * (out.ndim - 2))
+        return xp.where(keep, out, xp.zeros((), out.dtype))
+    return Vec(v.dtype, z(v.data), _slot_take(xp, v.validity, idx2d) & live,
+               None if v.lengths is None else z(v.lengths),
+               None if v.children is None else tuple(
+                   _gather_slots(xp, c, idx2d, live) for c in v.children))
+
+
 def _compact(xp, elem: Vec, keep, counts_dtype=np.int32):
     """Stable within-row compaction of kept slots -> (new elem Vec, counts)."""
     k = elem.data.shape[1]
     order = xp.argsort(~keep, axis=1, stable=True)  # kept slots first
-    def g(a):
-        return xp.take_along_axis(a, order, axis=1)
     new_counts = keep.sum(axis=1).astype(counts_dtype)
     live = xp.arange(k)[None, :] < new_counts[:, None]
-    data = xp.where(live, g(elem.data), xp.zeros((), elem.data.dtype))
-    validity = g(elem.validity) & live
-    lengths = None if elem.lengths is None else g(elem.lengths)
-    out = Vec(elem.dtype, data, validity, lengths,
-              None if elem.children is None else tuple(
-                  _gather_child(xp, c, order) for c in elem.children))
-    return out, new_counts
-
-
-def _gather_child(xp, c: Vec, order):
-    return Vec(c.dtype, xp.take_along_axis(c.data, order, axis=1),
-               xp.take_along_axis(c.validity, order, axis=1),
-               None if c.lengths is None else
-               xp.take_along_axis(c.lengths, order, axis=1))
+    return _gather_slots(xp, elem, order, live), new_counts
 
 
 class ArrayPosition(Expression):
@@ -143,7 +151,10 @@ class ArrayRepeat(Expression):
 
     def __init__(self, child: Expression, times: Expression):
         super().__init__([child, times])
-        self.times = times.value if isinstance(times, Literal) else None
+        if not isinstance(times, Literal) or times.value is None:
+            raise ValueError("array_repeat requires a literal count "
+                             "(static fanout on both engines)")
+        self.times = times.value
 
     @property
     def data_type(self):
@@ -193,16 +204,9 @@ class Slice(Expression):
         j = xp.arange(k, dtype=np.int64)[None, :]
         src = xp.clip(begin0[:, None] + j, 0, k - 1).astype(np.int32)
         keep = j < take[:, None]
-        def g(a, zero):
-            out = xp.take_along_axis(a, src, axis=1)
-            return xp.where(keep, out, zero)
-        data = g(elem.data, xp.zeros((), elem.data.dtype))
-        validity = g(elem.validity, False)
-        lengths = None if elem.lengths is None else \
-            g(elem.lengths, np.int32(0))
+        out_elem = _gather_slots(xp, elem, src, keep)
         return Vec(arr.dtype, take.astype(np.int32),
-                   arr.validity & ~bad, None,
-                   (Vec(elem.dtype, data, validity, lengths),))
+                   arr.validity & ~bad, None, (out_elem,))
 
 
 class Reverse(Expression):
@@ -224,13 +228,7 @@ class Reverse(Expression):
         j = xp.arange(k, dtype=np.int64)[None, :]
         src = xp.clip(size[:, None] - 1 - j, 0, k - 1).astype(np.int32)
         live = j < size[:, None]
-        def g(a, zero):
-            out = xp.take_along_axis(a, src, axis=1)
-            return xp.where(live, out, zero)
-        out_elem = Vec(elem.dtype, g(elem.data, xp.zeros((), elem.data.dtype)),
-                       g(elem.validity, False),
-                       None if elem.lengths is None else
-                       g(elem.lengths, np.int32(0)))
+        out_elem = _gather_slots(xp, elem, src, live)
         return Vec(arr.dtype, arr.data, arr.validity, None, (out_elem,))
 
 
@@ -338,9 +336,16 @@ class ArrayJoin(Expression):
         if null_replacement is not None:
             kids.append(null_replacement)
         super().__init__(kids)
-        self.delim = delim.value if isinstance(delim, Literal) else None
-        self.null_repl = (null_replacement.value
-                          if isinstance(null_replacement, Literal) else None)
+        if not isinstance(delim, Literal) or delim.value is None:
+            raise ValueError("array_join requires a literal delimiter")
+        if null_replacement is not None and (
+                not isinstance(null_replacement, Literal)
+                or null_replacement.value is None):
+            raise ValueError("array_join requires a literal "
+                             "null_replacement")
+        self.delim = delim.value
+        self.null_repl = (None if null_replacement is None
+                          else null_replacement.value)
         self.has_repl = null_replacement is not None
 
     @property
@@ -409,13 +414,20 @@ class Flatten(Expression):
         inner_counts = xp.where(live_o & outer.validity,
                                 outer.data, 0).astype(np.int64)
         total = inner_counts.sum(axis=1)
-        # flatten [n, K_out, K_in] -> [n, K_out*K_in], compact live slots
+        # flatten [n, K_out, K_in, ...] -> [n, K_out*K_in, ...], compact live
         j_in = xp.arange(ki, dtype=np.int64)[None, None, :]
         live_i = j_in < inner_counts[:, :, None]
-        flat = lambda a: a.reshape(n, ko * ki)
+
+        def flat(a):
+            return a.reshape((n, ko * ki) + a.shape[3:])
+
+        def flat_vec(v: Vec) -> Vec:
+            return Vec(v.dtype, flat(v.data), flat(v.validity),
+                       None if v.lengths is None else flat(v.lengths),
+                       None if v.children is None else tuple(
+                           flat_vec(c) for c in v.children))
+
         keep = flat(live_i)
-        elem2 = Vec(inner.dtype, flat(inner.data), flat(inner.validity),
-                    None if inner.lengths is None else flat(inner.lengths))
-        out_elem, counts = _compact(xp, elem2, keep)
+        out_elem, counts = _compact(xp, flat_vec(inner), keep)
         return Vec(self.data_type, total.astype(np.int32),
                    arr.validity & ~has_null_inner, None, (out_elem,))

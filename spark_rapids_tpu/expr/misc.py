@@ -207,13 +207,16 @@ class WidthBucket(Expression):
 
 class Sequence(Expression):
     """sequence(start, stop[, step]) over integral inputs — literal bounds
-    (static fanout under jit); the planner tags non-literal forms to CPU."""
+    required (static fanout on BOTH engines; non-literal raises at build)."""
 
     def __init__(self, start: Expression, stop: Expression,
                  step: Expression = None):
         kids = [start, stop] + ([step] if step is not None else [])
         super().__init__(kids)
         self.lit_bounds = all(isinstance(k, Literal) for k in kids)
+        if not self.lit_bounds:
+            raise ValueError("sequence requires literal bounds "
+                             "(static fanout on both engines)")
         if self.lit_bounds:
             s = start.value
             e = stop.value

@@ -201,7 +201,10 @@ class FormatNumber(Expression):
 
     def __init__(self, child, decimals):
         super().__init__([child, decimals])
-        self.d = decimals.value if isinstance(decimals, Literal) else None
+        if not isinstance(decimals, Literal) or decimals.value is None:
+            raise ValueError("format_number requires a literal decimal "
+                             "count (static output width on both engines)")
+        self.d = decimals.value
 
     @property
     def data_type(self):
@@ -292,8 +295,12 @@ class Conv(Expression):
 
     def __init__(self, child, from_base, to_base):
         super().__init__([child, from_base, to_base])
-        self.fb = from_base.value if isinstance(from_base, Literal) else None
-        self.tb = to_base.value if isinstance(to_base, Literal) else None
+        fb = from_base.value if isinstance(from_base, Literal) else None
+        tb = to_base.value if isinstance(to_base, Literal) else None
+        if fb is None or tb is None or not (2 <= fb <= 36 and 2 <= tb <= 36):
+            raise ValueError("conv requires literal bases in 2..36")
+        self.fb = fb
+        self.tb = tb
 
     @property
     def data_type(self):

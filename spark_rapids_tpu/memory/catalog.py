@@ -41,10 +41,13 @@ class SpillPriority:
 
 class _Entry:
     __slots__ = ("handle", "tier", "device_batch", "host_arrays", "disk_path",
-                 "schema", "num_rows", "nbytes", "priority", "lock", "treedef")
+                 "schema", "num_rows", "nbytes", "priority", "lock", "treedef",
+                 "created_at", "label")
 
     def __init__(self, handle: int, batch: ColumnarBatch, nbytes: int,
-                 priority: int):
+                 priority: int, label: str = ""):
+        self.created_at = time.monotonic()
+        self.label = label
         self.handle = handle
         self.tier = StorageTier.DEVICE
         self.device_batch: Optional[ColumnarBatch] = batch
@@ -81,12 +84,13 @@ class BufferCatalog:
 
     # ------------------------------------------------------------------
     def add_batch(self, batch: ColumnarBatch,
-                  priority: int = SpillPriority.BUFFERED) -> int:
+                  priority: int = SpillPriority.BUFFERED,
+                  label: str = "") -> int:
         nbytes = batch.device_memory_size()
         with self._lock:
             h = self._next_handle
             self._next_handle += 1
-            self._entries[h] = _Entry(h, batch, nbytes, priority)
+            self._entries[h] = _Entry(h, batch, nbytes, priority, label)
         return h
 
     def acquire_batch(self, handle: int) -> ColumnarBatch:
@@ -116,6 +120,45 @@ class BufferCatalog:
 
     def tier_of(self, handle: int) -> StorageTier:
         return self._entries[handle].tier
+
+    # ---------------------------------------------------- observability
+    def debug_dump(self) -> str:
+        """Human-readable live-buffer state (the RMM state-dump analog,
+        SPARK_RMM_STATE_DEBUG / GpuDeviceManager rmmDebugLocation): one line
+        per live handle with tier, size, age and priority — what you read
+        when an OOM or leak needs explaining."""
+        now = time.monotonic()
+        with self._lock:
+            entries = list(self._entries.values())
+        lines = [f"BufferCatalog: {len(entries)} live handles, "
+                 f"host_used={self.host_used}/{self.host_limit}B"]
+        per_tier: Dict[StorageTier, int] = {}
+        for e in sorted(entries, key=lambda e: -e.nbytes):
+            per_tier[e.tier] = per_tier.get(e.tier, 0) + e.nbytes
+            lines.append(
+                f"  handle={e.handle} tier={e.tier.name} bytes={e.nbytes} "
+                f"rows={int(e.num_rows)} age={now - e.created_at:.1f}s "
+                f"prio={e.priority}"
+                + (f" label={e.label}" if e.label else ""))
+        for t, b in sorted(per_tier.items()):
+            lines.append(f"  total[{t.name}]={b}B")
+        return "\n".join(lines)
+
+    def leak_report(self, older_than_s: float = 0.0) -> List[dict]:
+        """Handles alive longer than `older_than_s` — a non-empty result at
+        the end of a query usually means a SpillableColumnarBatch was never
+        closed (the MemoryCleaner refcount-leak-log analog)."""
+        now = time.monotonic()
+        with self._lock:
+            return [{"handle": e.handle, "tier": e.tier.name,
+                     "nbytes": e.nbytes, "age_s": now - e.created_at,
+                     "label": e.label}
+                    for e in self._entries.values()
+                    if now - e.created_at >= older_than_s]
+
+    @property
+    def live_count(self) -> int:
+        return len(self._entries)
 
     # ------------------------------------------------------------------
     def synchronous_spill(self, need_bytes: int) -> int:

@@ -327,16 +327,6 @@ from ..expr.aggregates import (BitAndAgg, BitOrAgg, BitXorAgg, BoolAnd,  # noqa:
 from ..expr.base import Literal as _Lit  # noqa: E402
 
 
-def _tag_literal_args(attr_names, what):
-    def tag(meta: ExprMeta) -> None:
-        for a in attr_names:
-            if getattr(meta.expr, a, None) is None:
-                meta.will_not_work(
-                    f"{what} requires literal {a} on TPU (static shapes)")
-                return
-    return tag
-
-
 def _tag_primitive_elems(meta: ExprMeta) -> None:
     for c in meta.expr.children:
         try:
@@ -370,8 +360,7 @@ expr_rule(EMI.AssertTrue, TypeSig.all_basic())
 expr_rule(EMI.Pi, _dbl)
 expr_rule(EMI.Euler, _dbl)
 expr_rule(EMI.WidthBucket, _num)
-expr_rule(EMI.Sequence, TypeSig.all_with_nested(),
-          tag_fn=_tag_literal_args(("_max_len",), "sequence"))
+expr_rule(EMI.Sequence, TypeSig.all_with_nested())
 
 # datetime tail
 expr_rule(ED.WeekOfYear, _int)
@@ -391,10 +380,9 @@ expr_rule(ESM.Levenshtein, _int)
 expr_rule(ESM.SoundEx, _str)
 expr_rule(ESM.Empty2Null, _str)
 expr_rule(ESM.FormatNumber, _str,
-          tag_fn=_tag_literal_args(("d",), "format_number"),
           doc="Enable format_number; |values| at int64 scale or beyond "
               "return null (19+ digit JVM DecimalFormat not reproduced).")
-expr_rule(ESM.Conv, _str, tag_fn=_tag_literal_args(("fb", "tb"), "conv"))
+expr_rule(ESM.Conv, _str)
 
 # array breadth
 expr_rule(ECE.ArrayPosition, TypeSig.all_with_nested(),
@@ -416,23 +404,9 @@ expr_rule(ECE.Reverse, TypeSig.all_with_nested())
 expr_rule(ECE.Flatten, TypeSig.all_with_nested())
 
 
-def _tag_array_repeat(meta: ExprMeta) -> None:
-    if meta.expr.times is None:
-        meta.will_not_work("array_repeat requires a literal count on TPU")
-
-
-expr_rule(ECE.ArrayRepeat, TypeSig.all_with_nested(),
-          tag_fn=_tag_array_repeat)
-def _tag_array_join(meta: ExprMeta) -> None:
-    _tag_string_elems(meta)
-    e = meta.expr
-    if e.delim is None or (e.has_repl and e.null_repl is None):
-        meta.will_not_work(
-            "array_join requires literal delimiter/null_replacement on TPU")
-
-
+expr_rule(ECE.ArrayRepeat, TypeSig.all_with_nested())
 expr_rule(ECE.ArrayJoin, TypeSig.all_with_nested(),
-          tag_fn=_tag_array_join)
+          tag_fn=_tag_string_elems)
 
 # new aggregates
 expr_rule(CountIf, TypeSig((T.LongType,)))

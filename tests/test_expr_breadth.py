@@ -442,3 +442,42 @@ class TestReviewRegressions:
         d = out.column("d").to_pylist()[0]
         assert d.hour == 0 and d.minute == 0
         assert out.column("ms").to_pylist()[0].microsecond % 1000 == 0
+
+
+class TestStringElementArrays:
+    def test_slice_reverse_string_elements(self, session):
+        t = pa.table({
+            "a": pa.array([["aa", "b", None, "ccc"], [], ["zz"]],
+                          type=pa.list_(pa.string())),
+            "i": pa.array(range(3), type=pa.int64()),
+        })
+        df = session.from_arrow(t)
+        q = df.select("i", r=Reverse(col("a")),
+                      s=Slice(col("a"), lit(2), lit(2)))
+        out = assert_same(q, sort_by=["i"])
+        assert out.column("r").to_pylist() == [
+            ["ccc", None, "b", "aa"], [], ["zz"]]
+        assert out.column("s").to_pylist() == [["b", None], [], []]
+
+    def test_flatten_string_elements(self, session):
+        t = pa.table({
+            "a": pa.array([[["x", "yy"], ["z"]], [[]]],
+                          type=pa.list_(pa.list_(pa.string()))),
+            "i": pa.array(range(2), type=pa.int64()),
+        })
+        df = session.from_arrow(t)
+        out = assert_same(df.select("i", f=Flatten(col("a"))),
+                          sort_by=["i"])
+        assert out.column("f").to_pylist() == [["x", "yy", "z"], []]
+
+    def test_literal_required_raises_at_build(self, session):
+        with pytest.raises(ValueError, match="literal"):
+            Sequence(col("x"), lit(5))
+        with pytest.raises(ValueError, match="literal"):
+            FormatNumber(col("x"), col("d"))
+        with pytest.raises(ValueError, match="conv"):
+            Conv(col("s"), lit(40), lit(10))
+        with pytest.raises(ValueError, match="literal"):
+            ArrayRepeat(col("x"), col("n"))
+        with pytest.raises(ValueError, match="literal"):
+            ArrayJoin(col("a"), col("d"))
