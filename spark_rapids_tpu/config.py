@@ -153,6 +153,11 @@ register("spark.rapids.memory.gpu.maxAllocFraction", "double", 1.0,
          "Maximum HBM fraction allowed.")
 register("spark.rapids.memory.gpu.reserve", "bytes", 640 << 20,
          "HBM held back from the arena for XLA scratch/fragmentation.")
+register("spark.rapids.memory.spill.compression.codec", "string", "zstd",
+         "Codec for host-spilled device batches (TableCompressionCodec "
+         "analog): none, zstd, or lz4xla (needs the native runtime). Host "
+         "accounting uses the compressed size.",
+         check_values=("none", "zstd", "lz4xla"))
 register("spark.rapids.memory.host.spillStorageSize", "bytes", 1 << 30,
          "Host-RAM spill store capacity before overflowing to disk.")
 register("spark.rapids.memory.host.pageablePool.enabled", "bool", True,

@@ -257,3 +257,43 @@ class TpuLimitExec(UnaryTpuExec):
 
     def _arg_string(self):
         return f"[{self.limit}]"
+
+
+class TpuSampleExec(UnaryTpuExec):
+    """Deterministic Bernoulli sample (GpuSampleExec analog); the row
+    decision hashes the GLOBAL row ordinal, threaded across batches as a
+    traced offset like the Project exec's monotonic-id plumbing."""
+
+    def __init__(self, fraction: float, seed: int, child: TpuExec, conf=None):
+        super().__init__([child], conf)
+        self.fraction = float(fraction)
+        self.seed = int(seed)
+        frac, seed_v = self.fraction, self.seed
+
+        @jax.jit
+        def kernel(batch: ColumnarBatch, row_offset):
+            from ..ops.rowops import sample_mask
+            vecs = batch_vecs(batch)
+            cap = batch.capacity
+            keep = sample_mask(jnp, cap, row_offset, frac, seed_v) & \
+                batch.row_mask()
+            out_vecs, new_n = compact_vecs(jnp, vecs, keep)
+            return vecs_to_batch(batch.schema, out_vecs, new_n)
+
+        self._kernel = kernel
+
+    @property
+    def output(self) -> Schema:
+        return self.child.output
+
+    def do_execute(self):
+        offset = jnp.asarray(0, jnp.int64)
+        for b in self.child.execute():
+            with self.op_time.timed():
+                out = self._kernel(b, offset)
+            offset = offset + jnp.asarray(b.row_count(), jnp.int64)
+            self.num_output_rows.add(out.row_count())
+            yield self._count_output(out)
+
+    def _arg_string(self):
+        return f"[fraction={self.fraction}, seed={self.seed}]"

@@ -67,7 +67,9 @@ def _gather_slots(xp, v: Vec, idx2d, live) -> Vec:
         out = _slot_take(xp, a, idx2d)
         keep = live.reshape(live.shape + (1,) * (out.ndim - 2))
         return xp.where(keep, out, xp.zeros((), out.dtype))
-    return Vec(v.dtype, z(v.data), _slot_take(xp, v.validity, idx2d) & live,
+    # z() zeroes dead slots, which for validity IS False — and it rank-
+    # adjusts the mask, so deeper children (array<array<...>>) work too
+    return Vec(v.dtype, z(v.data), z(v.validity),
                None if v.lengths is None else z(v.lengths),
                None if v.children is None else tuple(
                    _gather_slots(xp, c, idx2d, live) for c in v.children))

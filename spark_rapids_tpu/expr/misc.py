@@ -213,18 +213,15 @@ class Sequence(Expression):
                  step: Expression = None):
         kids = [start, stop] + ([step] if step is not None else [])
         super().__init__(kids)
-        self.lit_bounds = all(isinstance(k, Literal) for k in kids)
-        if not self.lit_bounds:
-            raise ValueError("sequence requires literal bounds "
+        if not all(isinstance(k, Literal) and k.value is not None
+                   for k in kids):
+            raise ValueError("sequence requires literal non-null bounds "
                              "(static fanout on both engines)")
-        if self.lit_bounds:
-            s = start.value
-            e = stop.value
-            st = step.value if step is not None else (1 if e >= s else -1)
-            self._max_len = 0 if st == 0 else \
-                max(0, (e - s) // st + 1 if (e - s) * st >= 0 else 0)
-        else:
-            self._max_len = None
+        s = start.value
+        e = stop.value
+        st = step.value if step is not None else (1 if e >= s else -1)
+        self._max_len = 0 if st == 0 else \
+            max(0, (e - s) // st + 1 if (e - s) * st >= 0 else 0)
 
     @property
     def data_type(self):
@@ -234,7 +231,7 @@ class Sequence(Expression):
                  *rest: Vec) -> Vec:
         xp = ctx.xp
         n = start.data.shape[0]
-        k = max(int(self._max_len or 0), 1)
+        k = max(int(self._max_len), 1)
         s = start.data.astype(np.int64)
         e = stop.data.astype(np.int64)
         if rest:

@@ -97,6 +97,12 @@ class DataFrame:
 
     order_by = sort
 
+    def sample(self, fraction: float, seed: int = 42) -> "DataFrame":
+        """Deterministic Bernoulli sample without replacement
+        (GpuSampleExec analog; both engines pick identical rows per seed)."""
+        return DataFrame(self.session,
+                         N.CpuSampleExec(fraction, seed, self.plan))
+
     def limit(self, n: int, offset: int = 0) -> "DataFrame":
         return DataFrame(self.session, N.CpuLimitExec(n, self.plan, offset))
 

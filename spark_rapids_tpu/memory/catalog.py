@@ -42,7 +42,7 @@ class SpillPriority:
 class _Entry:
     __slots__ = ("handle", "tier", "device_batch", "host_arrays", "disk_path",
                  "schema", "num_rows", "nbytes", "priority", "lock", "treedef",
-                 "created_at", "label")
+                 "created_at", "label", "host_blobs", "host_bytes")
 
     def __init__(self, handle: int, batch: ColumnarBatch, nbytes: int,
                  priority: int, label: str = ""):
@@ -52,6 +52,8 @@ class _Entry:
         self.tier = StorageTier.DEVICE
         self.device_batch: Optional[ColumnarBatch] = batch
         self.host_arrays: Optional[List] = None
+        self.host_blobs: Optional[List] = None  # compressed representation
+        self.host_bytes = 0  # actual host footprint (compressed when so)
         self.disk_path: Optional[str] = None
         self.treedef = None
         self.schema = batch.schema
@@ -65,12 +67,18 @@ class BufferCatalog:
     _instance: Optional["BufferCatalog"] = None
 
     def __init__(self, spill_dir: Optional[str] = None,
-                 host_limit: int = 1 << 30):
+                 host_limit: int = 1 << 30,
+                 spill_codec: Optional[str] = None):
         self._entries: Dict[int, _Entry] = {}
         self._next_handle = 0
         self._lock = threading.Lock()
         self._spill_dir = spill_dir or tempfile.mkdtemp(prefix="srtpu_spill_")
         self.host_limit = host_limit
+        if spill_codec is None:
+            from ..config import get_default_conf
+            spill_codec = get_default_conf().get(
+                "spark.rapids.memory.spill.compression.codec")
+        self.spill_codec = spill_codec
         self.host_used = 0
 
     @classmethod
@@ -106,6 +114,9 @@ class BufferCatalog:
             TaskMetrics.get().read_spill_ns += time.monotonic_ns() - t0
             e.device_batch = batch
             e.host_arrays = None
+            e.host_blobs = None
+            self.host_used -= e.host_bytes
+            e.host_bytes = 0
             e.tier = StorageTier.DEVICE
             return batch
 
@@ -116,7 +127,7 @@ class BufferCatalog:
             if e.disk_path and os.path.exists(e.disk_path):
                 os.unlink(e.disk_path)
             if e.tier == StorageTier.HOST:
-                self.host_used -= e.nbytes
+                self.host_used -= e.host_bytes
 
     def tier_of(self, handle: int) -> StorageTier:
         return self._entries[handle].tier
@@ -185,10 +196,25 @@ class BufferCatalog:
             # the batch is a pytree: flattening covers every buffer including
             # nested children and the traced row count
             leaves, e.treedef = jax.tree_util.tree_flatten(batch)
-            e.host_arrays = [np.asarray(x) for x in leaves]
+            host = [np.asarray(x) for x in leaves]
+            if self.spill_codec != "none":
+                # compressed device-batch representation for spill (reference
+                # TableCompressionCodec over shuffle/spill buffers): leaves
+                # are stored as codec blobs, host accounting uses the
+                # COMPRESSED size so more batches fit under the host limit
+                from ..shuffle.codec import get_codec
+                codec = get_codec(self.spill_codec)
+                e.host_blobs = [
+                    (a.dtype.str, a.shape, codec.compress(
+                        np.ascontiguousarray(a).tobytes()), a.nbytes)
+                    for a in host]
+                e.host_bytes = sum(len(b[2]) for b in e.host_blobs)
+            else:
+                e.host_arrays = host
+                e.host_bytes = e.nbytes
             e.device_batch = None  # drop device refs -> XLA frees HBM
             e.tier = StorageTier.HOST
-            self.host_used += e.nbytes
+            self.host_used += e.host_bytes
             TaskMetrics.get().spill_to_host_ns += time.monotonic_ns() - t0
             from .budget import MemoryBudget
             MemoryBudget.get().release(e.nbytes)
@@ -197,26 +223,50 @@ class BufferCatalog:
             return e.nbytes
 
     def _host_to_disk(self, e: _Entry) -> None:
+        import pickle
         t0 = time.monotonic_ns()
-        path = os.path.join(self._spill_dir, f"buf{e.handle}.npz")
-        np.savez(path, **{f"a{i}": a for i, a in enumerate(e.host_arrays)})
+        path = os.path.join(self._spill_dir, f"buf{e.handle}.spill")
+        payload = ("blobs", e.host_blobs) if e.host_blobs is not None \
+            else ("arrays", e.host_arrays)
+        with open(path, "wb") as f:
+            pickle.dump(payload, f, protocol=4)
         e.disk_path = path
         e.host_arrays = None
+        e.host_blobs = None
         e.tier = StorageTier.DISK
-        self.host_used -= e.nbytes
+        self.host_used -= e.host_bytes
         TaskMetrics.get().spill_to_disk_ns += time.monotonic_ns() - t0
 
     def _disk_to_host(self, e: _Entry) -> None:
-        z = np.load(e.disk_path)
-        e.host_arrays = [z[f"a{i}"] for i in range(len(z.files))]
+        import pickle
+        with open(e.disk_path, "rb") as f:
+            kind, payload = pickle.load(f)
+        if kind == "blobs":
+            e.host_blobs = payload
+        else:
+            e.host_arrays = payload
         e.tier = StorageTier.HOST
+        self.host_used += e.host_bytes
         os.unlink(e.disk_path)
         e.disk_path = None
+
+    def _host_leaves(self, e: _Entry) -> List[np.ndarray]:
+        if e.host_arrays is not None:
+            return e.host_arrays
+        from ..shuffle.codec import get_codec
+        codec = get_codec(self.spill_codec)
+        out = []
+        for dt, shape, blob, raw_len in e.host_blobs:
+            raw = codec.decompress(blob, raw_len)
+            out.append(np.frombuffer(raw, dtype=np.dtype(dt)).reshape(shape))
+        return out
 
     def _host_to_device(self, e: _Entry) -> ColumnarBatch:
         import jax
         import jax.numpy as jnp
         from .budget import MemoryBudget
         MemoryBudget.get().reserve(e.nbytes)
+        leaves = self._host_leaves(e)
+        e.host_blobs = None
         return jax.tree_util.tree_unflatten(
-            e.treedef, [jnp.asarray(a) for a in e.host_arrays])
+            e.treedef, [jnp.asarray(a) for a in leaves])

@@ -170,3 +170,23 @@ def segment_reduce(xp, op: str, data, gid, cap: int, valid=None):
         seg = jax.ops.segment_min if op == "min" else jax.ops.segment_max
         return seg(contrib, gid, num_segments=cap)
     raise ValueError(f"unknown segmented op {op}")
+
+
+def sample_mask(xp, n: int, row_offset, fraction: float, seed: int):
+    """Deterministic Bernoulli sample mask over global row ordinals
+    (GpuSampleExec analog). splitmix64 of (offset+i) ^ f(seed) -> uniform
+    [0,1) — identical bits on numpy and jax, so both engines select the
+    SAME rows for a given seed (the differential harness depends on it)."""
+    mask64 = np.uint64(0xFFFFFFFFFFFFFFFF)
+    idx = xp.arange(n, dtype=np.uint64) + xp.asarray(row_offset,
+                                                     dtype=np.uint64)
+    # pre-mix the seed with PYTHON ints (numpy scalar multiply warns on wrap)
+    seed_mix = ((seed & 0xFFFFFFFFFFFFFFFF) * 0x9E3779B97F4A7C15) \
+        & 0xFFFFFFFFFFFFFFFF
+    z = idx ^ np.uint64(seed_mix)
+    z = (z + np.uint64(0x9E3779B97F4A7C15)) & mask64
+    z = ((z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)) & mask64
+    z = ((z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)) & mask64
+    z = z ^ (z >> np.uint64(31))
+    u = (z >> np.uint64(11)).astype(np.float64) * (2.0 ** -53)
+    return u < fraction

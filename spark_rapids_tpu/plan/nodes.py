@@ -692,6 +692,44 @@ class CpuSortExec(PhysicalPlan):
         return f"[{[(repr(e), a, nf) for e, a, nf in self.orders]}]"
 
 
+class CpuSampleExec(PhysicalPlan):
+    """Bernoulli sample without replacement (GpuSampleExec analog): a
+    deterministic splitmix64 hash of the GLOBAL row ordinal decides each row,
+    so device and CPU engines select identical rows for a given seed."""
+
+    def __init__(self, fraction: float, seed: int, child: PhysicalPlan):
+        super().__init__([child])
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError(f"sample fraction must be in [0, 1]: {fraction}")
+        self.fraction = float(fraction)
+        self.seed = int(seed)
+
+    @property
+    def output(self) -> Schema:
+        return self.children[0].output
+
+    def execute_cpu(self):
+        from ..ops.rowops import sample_mask
+        offset = 0
+        for b in self.children[0].execute_cpu():
+            keep = sample_mask(np, b.num_rows, offset, self.fraction,
+                               self.seed)
+            offset += b.num_rows
+            idx = np.nonzero(keep)[0]
+            vecs = [_gather_host_vec(v, idx) for v in b.vecs]
+            yield HostBatch(self.output, vecs, len(idx))
+
+    def _arg_string(self):
+        return f"[fraction={self.fraction}, seed={self.seed}]"
+
+
+def _gather_host_vec(v: Vec, idx) -> Vec:
+    return Vec(v.dtype, _take_np(v.data, idx), v.validity[idx],
+               None if v.lengths is None else v.lengths[idx],
+               None if v.children is None else tuple(
+                   _gather_host_vec(c, idx) for c in v.children))
+
+
 class CpuLimitExec(PhysicalPlan):
     def __init__(self, limit: int, child: PhysicalPlan, offset: int = 0):
         super().__init__([child])

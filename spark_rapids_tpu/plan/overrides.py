@@ -685,6 +685,11 @@ def _c_limit(plan, children, conf):
     return TpuLimitExec(plan.limit, children[0], plan.offset, conf)
 
 
+def _c_sample(plan, children, conf):
+    from ..exec.basic import TpuSampleExec
+    return TpuSampleExec(plan.fraction, plan.seed, children[0], conf)
+
+
 def _c_union(plan, children, conf):
     from ..exec.basic import TpuUnionExec
     return TpuUnionExec(children, conf)
@@ -870,6 +875,7 @@ _sort_ansi = _ansi_context_tag("sort keys",
 exec_rule(N.CpuSortExec, TypeSig.orderable(), _c_sort, expr_fn=_exprs_sort,
           tag_fn=_sort_ansi)
 exec_rule(N.CpuLimitExec, TypeSig.all_with_nested(), _c_limit)
+exec_rule(N.CpuSampleExec, TypeSig.all_with_nested(), _c_sample)
 exec_rule(N.CpuUnionExec, TypeSig.all_with_nested(), _c_union)
 _gen_ansi = _ansi_context_tag("generate", lambda p: [p._bound])
 exec_rule(N.CpuGenerateExec, TypeSig.all_with_nested(), _c_generate,

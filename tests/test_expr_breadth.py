@@ -481,3 +481,24 @@ class TestStringElementArrays:
             ArrayRepeat(col("x"), col("n"))
         with pytest.raises(ValueError, match="literal"):
             ArrayJoin(col("a"), col("d"))
+
+
+class TestDeepNestedArrayOps:
+    def test_reverse_slice_nested_arrays(self, session):
+        t = pa.table({
+            "a": pa.array([[[1, 2], [3]], [[4], [], [5, 6]]],
+                          type=pa.list_(pa.list_(pa.int64()))),
+            "i": pa.array(range(2), type=pa.int64()),
+        })
+        df = session.from_arrow(t)
+        out = assert_same(df.select("i", r=Reverse(col("a")),
+                                    s=Slice(col("a"), lit(1), lit(2))),
+                          sort_by=["i"])
+        assert out.column("r").to_pylist() == [
+            [[3], [1, 2]], [[5, 6], [], [4]]]
+        assert out.column("s").to_pylist() == [
+            [[1, 2], [3]], [[4], []]]
+
+    def test_sequence_null_literal_raises(self, session):
+        with pytest.raises(ValueError, match="literal"):
+            Sequence(lit(None), lit(5))

@@ -229,3 +229,35 @@ class TestFallback:
         q = df.select(col("v").cast(T.STRING))
         with pytest.raises(AssertionError, match="fell back"):
             q.collect()
+
+
+class TestSample:
+    def test_sample_differential(self, session, rng):
+        df = session.from_arrow(make_table(rng, n=2000))
+        q = df.sample(0.3, seed=7)
+        out = assert_same(q, sort_by=["id", "val"])
+        assert 0.2 < out.num_rows / 2000 < 0.4
+
+    def test_sample_deterministic_and_batch_invariant(self, rng):
+        t = make_table(rng, n=1000)
+        small = TpuSession({"spark.rapids.sql.explain": "NONE",
+                            "spark.rapids.sql.batchSizeRows": 64})
+        big = TpuSession({"spark.rapids.sql.explain": "NONE",
+                          "spark.rapids.sql.batchSizeRows": 100000})
+        key = [("id", "ascending"), ("val", "ascending")]
+        a = small.from_arrow(t).sample(0.5, seed=3).collect().sort_by(key)
+        b = big.from_arrow(t).sample(0.5, seed=3).collect().sort_by(key)
+        assert a.equals(b)  # global-ordinal hashing is batch-size invariant
+
+    def test_sample_edge_fractions(self, session, rng):
+        df = session.from_arrow(make_table(rng, n=100))
+        assert df.sample(0.0).collect().num_rows == 0
+        assert df.sample(1.0).collect().num_rows == 100
+        with pytest.raises(ValueError):
+            df.sample(1.5)
+
+    def test_sample_then_agg(self, session, rng):
+        from spark_rapids_tpu.expr import Count, lit
+        df = session.from_arrow(make_table(rng, n=500))
+        q = df.sample(0.4, seed=11).group_by("cat").agg(n=Count(lit(1)))
+        assert_same(q, sort_by=["cat"])
