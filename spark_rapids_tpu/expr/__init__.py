@@ -33,6 +33,8 @@ from .collections_ext import (ArrayPosition, ArrayRemove, ArrayDistinct,  # noqa
 from .misc import (SparkPartitionID, InputFileName, RaiseError, AssertTrue,  # noqa: F401
                    Pi, Euler, WidthBucket, Sequence,
                    MonotonicallyIncreasingID)
+from .json_ import (GetJsonObject, JsonTuple, JsonToStructs,  # noqa: F401
+                    parse_json_path)
 from .strings_more import (Overlay, Levenshtein, SoundEx, FormatNumber,  # noqa: F401
                            Empty2Null, Conv)
 from .datetime_ import (WeekOfYear, DayName, MonthName, TimestampSeconds,  # noqa: F401

@@ -243,6 +243,37 @@ def _from_string(xp, c: Vec, dst: T.DataType, ansi: bool) -> Vec:
         return _parse_bool(xp, c, first, last, any_c)
     if isinstance(dst, T.DateType):
         return _parse_date(xp, c, first, last, any_c)
+    if T.is_floating(dst):
+        # host-only parse (device_supported gates the device path off):
+        # Spark semantics — trim, case-insensitive Infinity/NaN, invalid
+        # -> null (non-ANSI)
+        if xp is not np:
+            raise NotImplementedError(
+                "string -> float parse is host-only (planner tags it)")
+        out = np.zeros(n, dtype=dst.np_dtype)
+        ok = np.zeros(n, dtype=bool)
+        cv = np.asarray(c.validity)
+        for i in range(n):
+            if not cv[i] or not any_c[i]:
+                continue
+            s = bytes(np.asarray(chars[i, first[i]:last[i] + 1])) \
+                .decode("utf-8", "replace").strip()
+            if "_" in s:  # PEP 515 groupings parse in python, not in Spark
+                continue
+            low = s.lower()
+            try:
+                if low in ("inf", "+inf", "infinity", "+infinity"):
+                    out[i] = np.inf
+                elif low in ("-inf", "-infinity"):
+                    out[i] = -np.inf
+                elif low == "nan":
+                    out[i] = np.nan
+                else:
+                    out[i] = dst.np_dtype.type(float(s))
+                ok[i] = True
+            except (ValueError, OverflowError):
+                ok[i] = False
+        return Vec(dst, out, ok & cv)
 
     # integral parse: [+-]?digits, Java Long.parseLong-style overflow detection
     # (accumulate NEGATIVE so Long.MIN_VALUE parses; overflow -> null, not wrap)

@@ -1,0 +1,346 @@
+"""JSON expressions over the byte-matrix layout (reference
+`GpuGetJsonObject.scala`, `GpuJsonToStructs.scala`, GetJsonObject/JsonTuple/
+JsonToStructs rules in `GpuOverrides.scala`).
+
+The scanner is fully vectorized over [n, W] byte matrices — the TPU shape of
+cuDF's JSON tokenizer:
+  * escape detection: run length of immediately-preceding backslashes via a
+    cumulative max of last-non-backslash positions (odd run = escaped);
+  * string interior: exclusive parity of unescaped quotes;
+  * nesting level: inclusive cumsum of non-string braces/brackets minus
+    closes (a '{' sits AT its content level, its '}' back at the parent);
+  * key lookup: shifted byte compares of the quoted key pattern, gated on
+    being an opening quote at the container's level inside its span;
+  * value span: first non-string delimiter back at container level.
+
+Known divergence (documented like the reference's getJsonObject caveats):
+string results are returned raw — backslash escape sequences are NOT
+decoded. Paths are literal `$.key[i].key2` chains."""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Tuple, Union
+
+import numpy as np
+
+from .. import types as T
+from ..columnar.padding import width_bucket
+from .base import EvalContext, Expression, Literal, Vec
+
+__all__ = ["GetJsonObject", "JsonTuple", "JsonToStructs", "parse_json_path"]
+
+_WS = (ord(" "), ord("\t"), ord("\n"), ord("\r"))
+_BIG = np.int32(1 << 30)
+
+
+def parse_json_path(path: str) -> List[Union[str, int]]:
+    """'$.a.b[2].c' -> ['a', 'b', 2, 'c']. Raises on unsupported forms
+    (wildcards, quoted keys, recursive descent)."""
+    if not path.startswith("$"):
+        raise ValueError(f"json path must start with '$': {path!r}")
+    rest = path[1:]
+    segs: List[Union[str, int]] = []
+    pat = re.compile(r"\.([A-Za-z_][A-Za-z0-9_\-]*)|\[(\d+)\]")
+    pos = 0
+    while pos < len(rest):
+        m = pat.match(rest, pos)
+        if m is None:
+            raise ValueError(f"unsupported json path segment at "
+                             f"{rest[pos:]!r} (literal keys/indexes only)")
+        if m.group(1) is not None:
+            segs.append(m.group(1))
+        else:
+            segs.append(int(m.group(2)))
+        pos = m.end()
+    if not segs:
+        raise ValueError("json path needs at least one segment")
+    return segs
+
+
+def _cummax(xp, a):
+    if xp is np:
+        return np.maximum.accumulate(a, axis=1)
+    import jax
+    return jax.lax.cummax(a, axis=1)
+
+
+def _structure(xp, b, lens):
+    """-> (in_str, level, quote_open, ws) structural masks, each [n, W]."""
+    n, w = b.shape
+    idx = xp.arange(w, dtype=np.int32)[None, :]
+    live = idx < lens[:, None]
+    b = xp.where(live, b, np.uint8(0))
+    is_bs = b == ord("\\")
+    last_non_bs = _cummax(xp, xp.where(~is_bs, idx, np.int32(-1)))
+    # backslash run ending just before i: (i-1) - last_non_bs[i-1]
+    prev_last = xp.concatenate(
+        [xp.full((n, 1), -1, np.int32), last_non_bs[:, :-1]], axis=1)
+    prev_run = (idx - 1) - prev_last
+    escaped = (prev_run % 2) == 1
+    quote = (b == ord('"')) & ~escaped
+    q_excl = xp.cumsum(quote.astype(np.int32), axis=1) - quote
+    in_str = (q_excl % 2) == 1  # True INSIDE a string incl. its closing quote
+    opener = ((b == ord("{")) | (b == ord("["))) & ~in_str & ~quote
+    closer = ((b == ord("}")) | (b == ord("]"))) & ~in_str & ~quote
+    level = xp.cumsum(opener.astype(np.int32), axis=1) - \
+        xp.cumsum(closer.astype(np.int32), axis=1)
+    ws = ((b == _WS[0]) | (b == _WS[1]) | (b == _WS[2]) | (b == _WS[3]))
+    quote_open = quote & ~in_str
+    return b, live, in_str, level, quote_open, ws, quote
+
+
+def _next_non_ws(xp, ws, live, w):
+    """next_non_ws[i] = smallest j >= i with a live non-ws byte (else BIG)."""
+    idx = xp.arange(w, dtype=np.int32)[None, :]
+    cand = xp.where(~ws & live, idx, _BIG)
+    # suffix min
+    rev = cand[:, ::-1]
+    run = _cummax(xp, -rev)[:, ::-1]
+    return -run
+
+
+def _first_at_least(xp, cond, start):
+    """smallest index j with cond[., j] and j >= start[.] (else BIG)."""
+    w = cond.shape[1]
+    idx = xp.arange(w, dtype=np.int32)[None, :]
+    masked = xp.where(cond & (idx >= start[:, None]), idx, _BIG)
+    return masked.min(axis=1)
+
+
+def _json_value_spans(xp, s: Vec, segs: List[Union[str, int]],
+                      structure=None):
+    """Per-row (start, end_exclusive, valid) of the value at the json path;
+    also (is_quoted) so callers can strip string quotes. `structure` lets a
+    multi-field caller (from_json) reuse one structural scan across fields."""
+    if structure is None:
+        structure = _structure(xp, s.data, s.lengths.astype(np.int32))
+    b, live, in_str, level, quote_open, ws, uq = structure
+    n, w = b.shape
+    idx = xp.arange(w, dtype=np.int32)[None, :]
+    nnw = _next_non_ws(xp, ws, live, w)
+    # a quote opens a KEY (not a string value) iff the previous non-ws char
+    # is '{' or ',' — a value's opening quote follows ':' or '[' instead
+    prev_nnw = _cummax(xp, xp.where(~ws & live & ~xp.zeros_like(ws), idx,
+                                    np.int32(-1)))
+    prev_before = xp.concatenate(
+        [xp.full((n, 1), -1, np.int32), prev_nnw[:, :-1]], axis=1)
+    prev_ch = xp.take_along_axis(b, xp.clip(prev_before, 0, w - 1), axis=1)
+    key_quote = quote_open & ((prev_ch == ord("{")) | (prev_ch == ord(",")) |
+                              (prev_before < 0))
+
+    def char_at(pos):
+        safe = xp.clip(pos, 0, w - 1)
+        return xp.take_along_axis(b, safe[:, None], axis=1)[:, 0], pos < w
+
+    # current container span + its content level
+    first = nnw[:, 0]
+    c_start = first
+    c_end = s.lengths.astype(np.int32)
+    ok = s.validity
+    # content level of the root container = 1 (inclusive level at '{')
+    target_level = xp.ones(n, dtype=np.int32)
+
+    delim = ((b == ord(",")) | (b == ord("}")) | (b == ord("]"))) & ~in_str
+
+    vs = c_start
+    ve = c_end
+    for seg in segs:
+        if isinstance(seg, str):
+            opener_ch, _ = char_at(vs)
+            ok = ok & (opener_ch == ord("{"))
+            pat = b'"' + seg.encode("utf-8") + b'"'
+            plen = len(pat)
+            match = key_quote & (level == target_level[:, None])
+            for j, pb in enumerate(pat):
+                col = xp.clip(idx + j, 0, w - 1)
+                match = match & (xp.take_along_axis(b, col, axis=1) == pb) \
+                    & (idx + j < w)
+            # also gate into the container span
+            match = match & (idx > vs[:, None]) & (idx < ve[:, None])
+            kpos = _first_at_least(xp, match, vs)
+            found = kpos < _BIG
+            close_q = kpos + plen - 1
+            colon_pos = xp.take_along_axis(
+                nnw, xp.clip(close_q + 1, 0, w - 1)[:, None], axis=1)[:, 0]
+            colon_ch, _ = char_at(colon_pos)
+            found = found & (colon_ch == ord(":"))
+            new_vs = xp.take_along_axis(
+                nnw, xp.clip(colon_pos + 1, 0, w - 1)[:, None], axis=1)[:, 0]
+            # delimiters that terminate a value at content level L show level
+            # L for ',' and L-1 for the closing brace (inclusive counting)
+            term = delim & ((level == target_level[:, None]) |
+                            (level == (target_level - 1)[:, None]))
+            new_ve = _first_at_least(xp, term, new_vs)
+            ok = ok & found & (new_vs < _BIG) & (new_ve < _BIG)
+            vs = xp.where(ok, new_vs, 0)
+            ve = xp.where(ok, new_ve, 0)
+        else:  # array index
+            opener_ch, _ = char_at(vs)
+            ok = ok & (opener_ch == ord("["))
+            # inclusive level counting: the '[' itself already sits at its
+            # content level, which target_level tracks (= level at vs)
+            arr_level = target_level
+            # element separators: commas AT content level
+            commas = (b == ord(",")) & ~in_str & \
+                (level == arr_level[:, None])
+            commas = commas & (idx > vs[:, None]) & (idx < ve[:, None])
+            # k-th element start: after the k-th comma (or '[' for k=0)
+            if seg == 0:
+                elem_after = vs + 1
+            else:
+                ccum = xp.cumsum(commas.astype(np.int32), axis=1)
+                gate = commas & (ccum == seg)
+                kth_comma = _first_at_least(xp, gate, vs)
+                ok = ok & (kth_comma < _BIG)
+                elem_after = xp.where(kth_comma < _BIG, kth_comma + 1, 0)
+            new_vs = xp.take_along_axis(
+                nnw, xp.clip(elem_after, 0, w - 1)[:, None], axis=1)[:, 0]
+            term = delim & ((level == arr_level[:, None]) |
+                            (level == (arr_level - 1)[:, None]))
+            new_ve = _first_at_least(xp, term, new_vs)
+            # empty array / index past end: new_vs lands on ']'
+            vch, _ = char_at(new_vs)
+            ok = ok & (new_vs < _BIG) & (new_ve < _BIG) & (vch != ord("]"))
+            vs = xp.where(ok, new_vs, 0)
+            ve = xp.where(ok, new_ve, 0)
+        # the next KEY segment looks inside this value: its content level is
+        # the level AT vs + 1 when the value opens a container; compute from
+        # the level mask directly
+        lvl_vs = xp.take_along_axis(level, xp.clip(vs, 0, w - 1)[:, None],
+                                    axis=1)[:, 0]
+        target_level = lvl_vs
+
+    start_ch, _ = char_at(vs)
+    is_quoted = start_ch == ord('"')
+    is_container = (start_ch == ord("{")) | (start_ch == ord("["))
+    # quoted values run exactly to their closing quote: an UNESCAPED quote
+    # with odd exclusive parity (an ESCAPED interior quote must not close)
+    closing = uq & in_str
+    close_q = _first_at_least(xp, closing, vs + 1)
+    q_end = xp.minimum(close_q + 1, ve)
+    ve = xp.where(is_quoted & (close_q < _BIG), q_end, ve)
+    # container values INCLUDE their matching closer: first '}'/']' whose
+    # inclusive level equals the level at vs - 1... with inclusive counting
+    # the matching closer of a container at level L shows level L - 1
+    lvl_vs = xp.take_along_axis(level, xp.clip(vs, 0, w - 1)[:, None],
+                                axis=1)[:, 0]
+    closer = ((b == ord("}")) | (b == ord("]"))) & ~in_str
+    match_close = closer & (level == (lvl_vs - 1)[:, None])
+    cpos = _first_at_least(xp, match_close, vs + 1)
+    ve = xp.where(is_container & (cpos < _BIG), cpos + 1, ve)
+    # unquoted scalars: trim trailing whitespace (last non-ws inside span)
+    inside = (idx >= vs[:, None]) & (idx < ve[:, None]) & ~ws & live
+    last_inside = xp.max(xp.where(inside, idx, np.int32(-1)), axis=1)
+    ve = xp.where(last_inside >= 0, last_inside + 1, vs)
+    ok = ok & (ve > vs)
+    return vs, ve, ok, is_quoted
+
+
+def _extract_span(xp, s: Vec, vs, ve, ok, is_quoted, strip_quotes: bool):
+    """Gather [vs, ve) per row into a fresh string vec; optionally strip the
+    surrounding quotes of quoted values; JSON null literal -> null."""
+    b = s.data
+    n, w = b.shape
+    strip = is_quoted & strip_quotes
+    vs2 = xp.where(strip, vs + 1, vs)
+    ve2 = xp.where(strip, ve - 1, ve)
+    out_len = xp.clip(ve2 - vs2, 0, w).astype(np.int32)
+    ow = width_bucket(max(int(w), 8))
+    j = xp.arange(ow, dtype=np.int32)[None, :]
+    src = xp.clip(vs2[:, None] + j, 0, w - 1)
+    take = xp.take_along_axis(
+        xp.pad(b, ((0, 0), (0, max(0, ow - w)))) if ow > w else b,
+        xp.clip(src, 0, max(w, ow) - 1), axis=1)
+    live_out = j < out_len[:, None]
+    data = xp.where(live_out, take, np.uint8(0)).astype(xp.uint8)
+    # unquoted literal null -> SQL NULL
+    is_null_lit = (~is_quoted & (out_len == 4) &
+                   (data[:, 0] == ord("n")) & (data[:, 1] == ord("u")) &
+                   (data[:, 2] == ord("l")) & (data[:, 3] == ord("l")))
+    valid = ok & ~is_null_lit
+    return Vec(T.STRING, data, valid, xp.where(valid, out_len, 0))
+
+
+class GetJsonObject(Expression):
+    """get_json_object(json, '$.path') — literal path."""
+
+    def __init__(self, child: Expression, path: Expression):
+        super().__init__([child, path])
+        if not isinstance(path, Literal) or not isinstance(path.value, str):
+            raise ValueError("get_json_object requires a literal path")
+        self.path = path.value
+        self.segs = parse_json_path(self.path)
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _compute(self, ctx: EvalContext, s: Vec, _p: Vec) -> Vec:
+        xp = ctx.xp
+        vs, ve, ok, is_quoted = _json_value_spans(xp, s, self.segs)
+        return _extract_span(xp, s, vs, ve, ok & s.validity, is_quoted,
+                             strip_quotes=True)
+
+
+class JsonTuple(Expression):
+    """json_tuple field extraction for ONE key (the frontend expands
+    json_tuple(j, k1, k2) into one JsonTuple per key, like Spark's generator
+    flattening)."""
+
+    def __init__(self, child: Expression, key: Expression):
+        super().__init__([child, key])
+        if not isinstance(key, Literal) or not isinstance(key.value, str):
+            raise ValueError("json_tuple requires literal keys")
+        self.key = key.value
+        self.segs: List[Union[str, int]] = [self.key]
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _compute(self, ctx: EvalContext, s: Vec, _k: Vec) -> Vec:
+        xp = ctx.xp
+        vs, ve, ok, is_quoted = _json_value_spans(xp, s, self.segs)
+        return _extract_span(xp, s, vs, ve, ok & s.validity, is_quoted,
+                             strip_quotes=True)
+
+
+class JsonToStructs(Expression):
+    """from_json(json, schema) for FLAT structs of primitives: each field is
+    a top-level extraction composed with the engine's string casts — fields
+    whose parse-cast isn't device-supported tag the expression to CPU (the
+    planner checks), mirroring the reference's per-type JsonToStructs gates."""
+
+    def __init__(self, child: Expression, schema: T.StructType):
+        super().__init__([child])
+        if not isinstance(schema, T.StructType):
+            raise ValueError("from_json requires a struct schema")
+        for f in schema.fields:
+            if f.data_type.is_nested:
+                raise ValueError(
+                    "from_json supports flat structs of primitives only")
+        self.schema = schema
+
+    @property
+    def data_type(self):
+        return self.schema
+
+    def _compute(self, ctx: EvalContext, s: Vec) -> Vec:
+        from .cast import Cast
+        xp = ctx.xp
+        # one structural scan shared by every field extraction
+        structure = _structure(xp, s.data, s.lengths.astype(np.int32))
+        kids = []
+        for f in self.schema.fields:
+            vs, ve, ok, is_quoted = _json_value_spans(xp, s, [f.name],
+                                                      structure)
+            raw = _extract_span(xp, s, vs, ve, ok & s.validity, is_quoted,
+                                strip_quotes=True)
+            if isinstance(f.data_type, T.StringType):
+                kids.append(raw)
+            else:
+                cast = Cast(self.children[0], f.data_type)
+                kids.append(cast._compute(ctx, raw))
+        n = s.data.shape[0]
+        return Vec(self.schema, s.validity, s.validity, None, tuple(kids))

@@ -408,6 +408,28 @@ expr_rule(ECE.ArrayRepeat, TypeSig.all_with_nested())
 expr_rule(ECE.ArrayJoin, TypeSig.all_with_nested(),
           tag_fn=_tag_string_elems)
 
+# JSON (GpuGetJsonObject.scala, GpuJsonToStructs.scala)
+from ..expr import json_ as EJ  # noqa: E402
+
+
+def _tag_from_json(meta: ExprMeta) -> None:
+    from ..expr.cast import device_supported
+    for f in meta.expr.schema.fields:
+        if not isinstance(f.data_type, T.StringType) and \
+                not device_supported(T.STRING, f.data_type):
+            meta.will_not_work(
+                f"from_json field {f.name}: string -> "
+                f"{f.data_type.simple_string()} parse runs on CPU")
+            return
+
+
+expr_rule(EJ.GetJsonObject, _str,
+          doc="Enable get_json_object (literal paths; escape sequences in "
+              "string results are returned raw, not decoded).")
+expr_rule(EJ.JsonTuple, _str)
+expr_rule(EJ.JsonToStructs, TypeSig.all_with_nested(),
+          tag_fn=_tag_from_json)
+
 # new aggregates
 expr_rule(CountIf, TypeSig((T.LongType,)))
 expr_rule(BoolAnd, _bool)
