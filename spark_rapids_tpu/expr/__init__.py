@@ -39,7 +39,8 @@ from .strings_more import (Overlay, Levenshtein, SoundEx, FormatNumber,  # noqa:
                            Empty2Null, Conv)
 from .datetime_ import (WeekOfYear, DayName, MonthName, TimestampSeconds,  # noqa: F401
                         TimestampMillis, TimestampMicros, DateFromUnixDate,
-                        UnixDate, MakeDate, TruncTimestamp)
+                        UnixDate, MakeDate, TruncTimestamp, DateFormat,
+                        FromUnixTime, ToUnixTimestamp, UnixTimestamp)
 from .windowexprs import (RowFrame, RangeFrame, WindowFunction, RowNumber,  # noqa: F401
                           Rank, DenseRank, PercentRank, CumeDist, NTile, Lead,
                           Lag, WindowAggregate)

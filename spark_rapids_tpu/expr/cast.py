@@ -260,6 +260,11 @@ def _from_string(xp, c: Vec, dst: T.DataType, ansi: bool) -> Vec:
                 .decode("utf-8", "replace").strip()
             if "_" in s:  # PEP 515 groupings parse in python, not in Spark
                 continue
+            # Java Double.parseDouble grammar extras: trailing d/D/f/F
+            # suffix and hex-float literals
+            if s and s[-1] in "dDfF" and not s[-1:].isdigit() and \
+                    "x" not in s.lower() and any(ch.isdigit() for ch in s):
+                s = s[:-1]
             low = s.lower()
             try:
                 if low in ("inf", "+inf", "infinity", "+infinity"):
@@ -268,6 +273,8 @@ def _from_string(xp, c: Vec, dst: T.DataType, ansi: bool) -> Vec:
                     out[i] = -np.inf
                 elif low == "nan":
                     out[i] = np.nan
+                elif low.startswith(("0x", "-0x", "+0x")):
+                    out[i] = dst.np_dtype.type(float.fromhex(s))
                 else:
                     out[i] = dst.np_dtype.type(float(s))
                 ok[i] = True

@@ -552,3 +552,173 @@ class TruncTimestamp(Expression):
             return Vec(T.TIMESTAMP, xp.zeros_like(us),
                        xp.zeros(us.shape[0], dtype=bool))
         return Vec(T.TIMESTAMP, out, c.validity)
+
+
+# ---------------------------------------------------------------------------
+# string <-> datetime bridge (GpuDateFormatClass / GpuFromUnixTime /
+# GpuToUnixTimestamp in datetimeExpressions.scala). Patterns are compiled to
+# FIXED byte offsets (yyyy/MM/dd/HH/mm/ss + literal separators), so both
+# formatting and parsing are pure vector ops over the byte matrix — the
+# planner rejects non-fixed-width patterns, matching the reference's
+# "incompatible date formats" tagging.
+# ---------------------------------------------------------------------------
+
+_PAT_TOKENS = ("yyyy", "MM", "dd", "HH", "mm", "ss")
+
+
+def compile_dt_pattern(fmt: str):
+    """-> list of (token|'lit', byte_offset, text). Raises on unsupported
+    (variable-width) pattern pieces."""
+    out = []
+    pos = 0
+    off = 0
+    while pos < len(fmt):
+        for tok in _PAT_TOKENS:
+            if fmt.startswith(tok, pos):
+                out.append((tok, off, tok))
+                off += len(tok)
+                pos += len(tok)
+                break
+        else:
+            ch = fmt[pos]
+            if ch.isalpha():
+                raise ValueError(
+                    f"unsupported datetime pattern token at {fmt[pos:]!r} "
+                    "(fixed-width yyyy/MM/dd/HH/mm/ss + literals only)")
+            out.append(("lit", off, ch))
+            off += len(ch.encode("utf-8"))
+            pos += 1
+    return out, off
+
+
+class _PatternExpr(Expression):
+    def __init__(self, child, fmt: str):
+        super().__init__([child])
+        self.fmt = fmt
+        self.parts, self.width = compile_dt_pattern(fmt)
+
+
+def _ts_components(xp, us):
+    """us since epoch -> (y, M, d, H, m, s) int vectors (UTC)."""
+    days = _ts_to_days(xp, us)
+    y, M, d = civil_from_days(xp, days)
+    rem = us - days.astype(np.int64) * _US_PER_DAY
+    secs = rem // 1_000_000
+    return (y.astype(np.int64), M.astype(np.int64), d.astype(np.int64),
+            secs // 3600, (secs // 60) % 60, secs % 60)
+
+
+class DateFormat(_PatternExpr):
+    """date_format(ts|date, 'yyyy-MM-dd ...') with a literal fixed pattern."""
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _compute(self, ctx, c: Vec) -> Vec:
+        xp = ctx.xp
+        us = c.data.astype(np.int64) * (_US_PER_DAY if
+                                        isinstance(c.dtype, T.DateType)
+                                        else 1)
+        y, M, d, H, m, s = _ts_components(xp, us)
+        comp = {"yyyy": y, "MM": M, "dd": d, "HH": H, "mm": m, "ss": s}
+        n = c.data.shape[0]
+        # the fixed 4-digit writer only represents years 0..9999; outside
+        # that range the result is null (same guard as the date->string
+        # cast), never a silently-wrapped y % 10000
+        year_ok = (y >= 0) & (y <= 9999)
+        from ..columnar.padding import width_bucket
+        ow = width_bucket(max(self.width, 8))
+        data = xp.zeros((n, ow), dtype=xp.uint8)
+        for tok, off, text in self.parts:
+            if tok == "lit":
+                bs = text.encode("utf-8")
+                for k, byte in enumerate(bs):
+                    data = data.at[:, off + k].set(np.uint8(byte)) \
+                        if xp is not np else _np_setcol(data, off + k, byte)
+            else:
+                v = comp[tok]
+                for k in range(len(tok) - 1, -1, -1):
+                    digit = (v % 10).astype(np.uint8) + np.uint8(ord("0"))
+                    if xp is np:
+                        data[:, off + k] = digit
+                    else:
+                        data = data.at[:, off + k].set(digit)
+                    v = v // 10
+        lens = xp.full(n, self.width, dtype=np.int32)
+        return Vec(T.STRING, data, c.validity & year_ok, lens)
+
+
+def _np_setcol(data, col, byte):
+    data[:, col] = np.uint8(byte)
+    return data
+
+
+class FromUnixTime(_PatternExpr):
+    """from_unixtime(seconds[, fmt]) -> formatted string (UTC)."""
+
+    def __init__(self, child, fmt: str = "yyyy-MM-dd HH:mm:ss"):
+        super().__init__(child, fmt)
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _compute(self, ctx, c: Vec) -> Vec:
+        us = Vec(T.TIMESTAMP, c.data.astype(np.int64) * 1_000_000,
+                 c.validity)
+        return DateFormat(self.children[0], self.fmt)._compute(ctx, us)
+
+
+class ToUnixTimestamp(_PatternExpr):
+    """to_unix_timestamp(str[, fmt]) -> seconds since epoch; malformed
+    strings -> null (non-ANSI)."""
+
+    def __init__(self, child, fmt: str = "yyyy-MM-dd HH:mm:ss"):
+        super().__init__(child, fmt)
+
+    @property
+    def data_type(self):
+        return T.LONG
+
+    def _compute(self, ctx, c: Vec) -> Vec:
+        xp = ctx.xp
+        n, w = c.data.shape
+        b = c.data
+        ok = c.validity & (c.lengths == self.width)
+        comp = {t: xp.zeros(n, dtype=np.int64)
+                for t in ("yyyy", "MM", "dd", "HH", "mm", "ss")}
+        for tok, off, text in self.parts:
+            if off >= w:
+                ok = ok & False
+                continue
+            if tok == "lit":
+                for k, byte in enumerate(text.encode("utf-8")):
+                    if off + k < w:
+                        ok = ok & (b[:, off + k] == byte)
+            else:
+                acc = xp.zeros(n, dtype=np.int64)
+                for k in range(len(tok)):
+                    if off + k < w:
+                        digit = b[:, off + k].astype(np.int64) - ord("0")
+                        ok = ok & (digit >= 0) & (digit <= 9)
+                        acc = acc * 10 + digit
+                comp[tok] = acc
+        present = {t for t, _, _ in self.parts if t != "lit"}
+        # missing components default like Spark: year 1970, month/day 1
+        y = comp["yyyy"] if "yyyy" in present else \
+            xp.full(n, 1970, dtype=np.int64)
+        M = comp["MM"] if "MM" in present else xp.ones(n, dtype=np.int64)
+        d = comp["dd"] if "dd" in present else xp.ones(n, dtype=np.int64)
+        ok = ok & (M >= 1) & (M <= 12) & (d >= 1)
+        ok = ok & (d <= _days_in_month(xp, y, xp.clip(M, 1, 12)))
+        days = days_from_civil(xp, xp.where(ok, y, 2000),
+                               xp.where(ok, M, 1), xp.where(ok, d, 1))
+        ok = ok & (comp["HH"] < 24) & (comp["mm"] < 60) & (comp["ss"] < 60)
+        secs = days * 86400 + comp["HH"] * 3600 + comp["mm"] * 60 + \
+            comp["ss"]
+        return Vec(T.LONG, secs, ok)
+
+
+class UnixTimestamp(ToUnixTimestamp):
+    """unix_timestamp(str[, fmt]) — alias of to_unix_timestamp."""

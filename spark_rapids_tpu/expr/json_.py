@@ -13,9 +13,13 @@ cuDF's JSON tokenizer:
     being an opening quote at the container's level inside its span;
   * value span: first non-string delimiter back at container level.
 
-Known divergence (documented like the reference's getJsonObject caveats):
-string results are returned raw — backslash escape sequences are NOT
-decoded. Paths are literal `$.key[i].key2` chains."""
+Known divergences (documented like the reference's getJsonObject caveats):
+  * string results are returned raw — backslash escape sequences are NOT
+    decoded;
+  * container values (objects/arrays) are returned as the RAW input span
+    with original spacing, where Spark re-serializes compactly
+    ('[10, 20, 30]' here vs '[10,20,30]' in Spark).
+Paths are literal `$.key[i].key2` chains."""
 
 from __future__ import annotations
 
@@ -121,8 +125,7 @@ def _json_value_spans(xp, s: Vec, segs: List[Union[str, int]],
     nnw = _next_non_ws(xp, ws, live, w)
     # a quote opens a KEY (not a string value) iff the previous non-ws char
     # is '{' or ',' — a value's opening quote follows ':' or '[' instead
-    prev_nnw = _cummax(xp, xp.where(~ws & live & ~xp.zeros_like(ws), idx,
-                                    np.int32(-1)))
+    prev_nnw = _cummax(xp, xp.where(~ws & live, idx, np.int32(-1)))
     prev_before = xp.concatenate(
         [xp.full((n, 1), -1, np.int32), prev_nnw[:, :-1]], axis=1)
     prev_ch = xp.take_along_axis(b, xp.clip(prev_before, 0, w - 1), axis=1)

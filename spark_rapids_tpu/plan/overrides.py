@@ -373,6 +373,12 @@ expr_rule(ED.DateFromUnixDate, TypeSig((T.DateType,)))
 expr_rule(ED.UnixDate, _int)
 expr_rule(ED.MakeDate, TypeSig((T.DateType,)))
 expr_rule(ED.TruncTimestamp, TypeSig((T.TimestampType,)))
+expr_rule(ED.DateFormat, _str,
+          doc="Enable date_format (fixed-width yyyy/MM/dd/HH/mm/ss "
+              "patterns; UTC).")
+expr_rule(ED.FromUnixTime, _str)
+expr_rule(ED.ToUnixTimestamp, TypeSig((T.LongType,)))
+expr_rule(ED.UnixTimestamp, TypeSig((T.LongType,)))
 
 # more strings
 expr_rule(ESM.Overlay, _str)

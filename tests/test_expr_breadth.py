@@ -502,3 +502,64 @@ class TestDeepNestedArrayOps:
     def test_sequence_null_literal_raises(self, session):
         with pytest.raises(ValueError, match="literal"):
             Sequence(lit(None), lit(5))
+
+
+class TestDatetimeStringBridge:
+    def test_date_format_roundtrip(self, session, rng):
+        from spark_rapids_tpu.expr import (DateFormat, FromUnixTime,
+                                           ToUnixTimestamp)
+        secs = rng.integers(0, 2_000_000_000, 100)
+        t = pa.table({"s": pa.array(secs, type=pa.int64()),
+                      "i": pa.array(range(100), type=pa.int64())})
+        df = session.from_arrow(t)
+        q = df.select("i", "s",
+                      f=FromUnixTime(col("s")),
+                      back=ToUnixTimestamp(FromUnixTime(col("s"))))
+        out = assert_same(q, sort_by=["i"])
+        import datetime as dtl
+        rows = out.sort_by([("i", "ascending")])
+        for sec, fstr, back in zip(rows.column("s").to_pylist(),
+                                   rows.column("f").to_pylist(),
+                                   rows.column("back").to_pylist()):
+            want = dtl.datetime.fromtimestamp(
+                sec, dtl.timezone.utc).strftime("%Y-%m-%d %H:%M:%S")
+            assert fstr == want
+            assert back == sec
+
+    def test_date_format_patterns(self, session):
+        from spark_rapids_tpu.expr import DateFormat
+        import datetime as dtl
+        t = pa.table({"d": pa.array([dtl.date(2024, 3, 7),
+                                     dtl.date(1999, 12, 31)],
+                                    type=pa.date32())})
+        df = session.from_arrow(t)
+        out = assert_same(df.select(a=DateFormat(col("d"), "yyyy/MM/dd"),
+                                    b=DateFormat(col("d"), "dd-MM-yyyy")))
+        assert sorted(out.column("a").to_pylist()) == ["1999/12/31",
+                                                       "2024/03/07"]
+        assert sorted(out.column("b").to_pylist()) == ["07-03-2024",
+                                                       "31-12-1999"]
+
+    def test_unix_timestamp_malformed_null(self, session):
+        from spark_rapids_tpu.expr import ToUnixTimestamp
+        t = pa.table({"s": pa.array(["2024-01-01 00:00:00",
+                                     "2024-13-01 00:00:00",
+                                     "2024-02-30 00:00:00",
+                                     "not a date", None,
+                                     "2024-01-01 25:00:00"])})
+        df = session.from_arrow(t)
+        out = assert_same(df.select("s", u=ToUnixTimestamp(col("s"))),
+                          sort_by=["s"])
+        got = dict(zip(out.column("s").to_pylist(),
+                       out.column("u").to_pylist()))
+        assert got["2024-01-01 00:00:00"] == 1704067200
+        assert got["2024-13-01 00:00:00"] is None
+        assert got["2024-02-30 00:00:00"] is None
+        assert got["not a date"] is None
+        assert got[None] is None
+        assert got["2024-01-01 25:00:00"] is None
+
+    def test_bad_pattern_raises(self):
+        from spark_rapids_tpu.expr import DateFormat
+        with pytest.raises(ValueError, match="pattern"):
+            DateFormat(col("d"), "MMM d, yyyy")  # variable-width month name

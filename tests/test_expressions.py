@@ -430,3 +430,22 @@ class TestOperatorSugar:
         assert out.to_pylist() == [-9, -19]
         out = assert_cpu_tpu_equal(lambda: 100 / col("a"), tbl)
         assert out.to_pylist() == [10.0, 5.0]
+
+
+class TestDocsGeneration:
+    def test_supported_ops_docs_cover_registry(self):
+        from spark_rapids_tpu.plan import overrides as O
+        from spark_rapids_tpu.plan.typesig import generate_supported_ops_docs
+        md = generate_supported_ops_docs()
+        for cls in O._EXPR_RULES:
+            assert f"`{cls.__name__}`" in md, cls
+        for cls in O._EXEC_RULES:
+            assert f"`{cls.__name__}`" in md, cls
+
+    def test_config_docs_cover_registry(self):
+        from spark_rapids_tpu import config as C
+        md = C.generate_docs()
+        for k, e in C.entries().items():
+            if getattr(e, "internal", False):
+                continue  # internal keys are excluded from docs by design
+            assert k in md, k
