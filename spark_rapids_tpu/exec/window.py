@@ -389,9 +389,37 @@ def _eval_device(fn: WindowFunction, env: _WinEnv) -> Vec:
                 data = jnp.where(same, data, v.data.dtype.type(fn.default))
             valid = jnp.where(same, valid, True)
         return Vec(v.dtype, data, valid, lens)
+    from ..expr.windowexprs import NthValue
+    if isinstance(fn, NthValue):
+        return _eval_device_nth(fn, env)
     if isinstance(fn, WindowAggregate):
         return _eval_device_agg(fn, env)
     raise NotImplementedError(type(fn).__name__)
+
+
+def _eval_device_nth(fn, env: _WinEnv) -> Vec:
+    """nth_value: frame bounds + (for IGNORE NULLS) a searchsorted over the
+    global prefix count of valid rows — the n-th valid index in [lo, hi] is
+    where cumsum(valid) first reaches count_before(lo) + n."""
+    v = fn.children[0].eval(env.ctx, env.svecs)
+    frame = fn.frame or default_frame(env.has_order)
+    lo, hi = _frame_bounds(frame, env)
+    valid_rows = v.validity & env.mask
+    if fn.ignore_nulls:
+        p = jnp.cumsum(valid_rows.astype(jnp.int64))  # inclusive
+        before = jnp.where(lo > 0, p[jnp.maximum(lo - 1, 0)], 0)
+        target = before + fn.n
+        j = jnp.searchsorted(p, target, side="left").astype(jnp.int32)
+        got = (j < env.cap) & (j <= hi) & (p[jnp.clip(j, 0, env.cap - 1)]
+                                           == target)
+    else:
+        j = lo + fn.n - 1
+        got = (j <= hi) & (j >= lo)
+    safe = jnp.clip(j, 0, env.cap - 1)
+    data = v.data[safe] if v.data.ndim == 1 else v.data[safe, :]
+    valid = v.validity[safe] & got & (hi >= lo)
+    return Vec(v.dtype, data, valid,
+               None if v.lengths is None else v.lengths[safe])
 
 
 def _neutral(op: str, dtype):
@@ -418,13 +446,25 @@ def _eval_device_agg(fn: WindowAggregate, env: _WinEnv) -> Vec:
         frame.upper == 0 and not unbounded
 
     if name in ("First", "Last"):
-        # frame-boundary gather (respect-nulls semantics)
         lo, hi = _frame_bounds(frame, env)
-        j = lo if name == "First" else hi
         empty = hi < lo
+        if getattr(func, "ignore_nulls", False):
+            # first/last VALID index in [lo, hi] via the global prefix count
+            # of valid rows (cumsum is monotone, so searchsorted finds the
+            # rank boundary in O(log n) per row)
+            vr = v.validity & env.mask
+            p = jnp.cumsum(vr.astype(jnp.int64))
+            before = jnp.where(lo > 0, p[jnp.maximum(lo - 1, 0)], 0)
+            in_frame = p[jnp.clip(hi, 0, env.cap - 1)] - before
+            target = before + 1 if name == "First" else before + in_frame
+            j = jnp.searchsorted(p, target, side="left").astype(jnp.int32)
+            got = (in_frame > 0) & ~empty
+        else:
+            j = lo if name == "First" else hi
+            got = ~empty
         safe = jnp.clip(j, 0, env.cap - 1)
         data = v.data[safe] if v.data.ndim == 1 else v.data[safe, :]
-        return Vec(v.dtype, data, v.validity[safe] & ~empty & env.mask[safe],
+        return Vec(v.dtype, data, v.validity[safe] & got & env.mask[safe],
                    None if v.lengths is None else v.lengths[safe])
 
     is_string = v is not None and v.is_string

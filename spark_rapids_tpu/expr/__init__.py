@@ -43,7 +43,7 @@ from .datetime_ import (WeekOfYear, DayName, MonthName, TimestampSeconds,  # noq
                         FromUnixTime, ToUnixTimestamp, UnixTimestamp)
 from .windowexprs import (RowFrame, RangeFrame, WindowFunction, RowNumber,  # noqa: F401
                           Rank, DenseRank, PercentRank, CumeDist, NTile, Lead,
-                          Lag, WindowAggregate)
+                          Lag, WindowAggregate, NthValue)
 from .regex import (RLike, Like, RegExpReplace, RegExpExtract,  # noqa: F401
                     device_supported_pattern)
 from .collections import (Size, GetArrayItem, ElementAt, ArrayContains,  # noqa: F401

@@ -260,12 +260,15 @@ def _from_string(xp, c: Vec, dst: T.DataType, ansi: bool) -> Vec:
                 .decode("utf-8", "replace").strip()
             if "_" in s:  # PEP 515 groupings parse in python, not in Spark
                 continue
-            # Java Double.parseDouble grammar extras: trailing d/D/f/F
-            # suffix and hex-float literals
-            if s and s[-1] in "dDfF" and not s[-1:].isdigit() and \
-                    "x" not in s.lower() and any(ch.isdigit() for ch in s):
+            # Java Double.parseDouble grammar extras: a trailing d/D/f/F
+            # suffix on numeric literals (NOT on NaN/Infinity words) and
+            # hex floats, which REQUIRE a binary 'p' exponent
+            if s and s[-1] in "dDfF" and \
+                    any(ch.isdigit() for ch in s[:-1]):
                 s = s[:-1]
             low = s.lower()
+            if low.lstrip("+-").startswith("0x") and "p" not in low:
+                continue  # Java hex floats need the p exponent
             try:
                 if low in ("inf", "+inf", "infinity", "+infinity"):
                     out[i] = np.inf

@@ -573,6 +573,21 @@ def compile_dt_pattern(fmt: str):
     pos = 0
     off = 0
     while pos < len(fmt):
+        if fmt[pos] == "'":
+            # Spark/Java quoting: '...' is a literal run, '' a literal quote
+            if fmt.startswith("''", pos):
+                out.append(("lit", off, "'"))
+                off += 1
+                pos += 2
+                continue
+            end = fmt.find("'", pos + 1)
+            if end < 0:
+                raise ValueError(f"unterminated quote in pattern {fmt!r}")
+            for ch in fmt[pos + 1:end]:
+                out.append(("lit", off, ch))
+                off += len(ch.encode("utf-8"))
+            pos = end + 1
+            continue
         for tok in _PAT_TOKENS:
             if fmt.startswith(tok, pos):
                 out.append((tok, off, tok))

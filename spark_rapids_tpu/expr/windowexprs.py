@@ -26,7 +26,7 @@ from .. import types as T
 from .aggregates import AggregateFunction
 from .base import Expression
 
-__all__ = ["RowFrame", "RangeFrame", "default_frame", "WindowFunction",
+__all__ = ["RowFrame", "RangeFrame", "default_frame", "WindowFunction", "NthValue",
            "RowNumber", "Rank", "DenseRank", "PercentRank", "CumeDist", "NTile",
            "Lead", "Lag", "WindowAggregate"]
 
@@ -185,6 +185,30 @@ class Lead(_OffsetFunction):
 
 class Lag(_OffsetFunction):
     pass
+
+
+class NthValue(WindowFunction):
+    """nth_value(col, n[, ignore_nulls]) over the window frame (1-based);
+    null when the frame has fewer than n (valid) rows."""
+
+    requires_order = True
+
+    def __init__(self, child, n: int, ignore_nulls: bool = False,
+                 frame=None):
+        super().__init__([child])
+        if not isinstance(n, int) or n < 1:
+            raise ValueError("nth_value offset must be a positive int")
+        self.n = n
+        self.ignore_nulls = ignore_nulls
+        self.frame = frame
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def __repr__(self):
+        extra = ", ignore_nulls" if self.ignore_nulls else ""
+        return f"nth_value({self.children[0]!r}, {self.n}{extra})"
 
 
 class WindowAggregate(WindowFunction):

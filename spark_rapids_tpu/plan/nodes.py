@@ -889,7 +889,8 @@ class CpuWindowExec(PhysicalPlan):
 
     def _eval_fn(self, fn, ctx, svecs, n, bounds, peer_start,
                  sorder_vecs) -> Vec:
-        from ..expr.windowexprs import (CumeDist, DenseRank, Lag, Lead, NTile,
+        from ..expr.windowexprs import (CumeDist, DenseRank, Lag, Lead,
+                                        NthValue, NTile,
                                         PercentRank, RangeFrame, Rank,
                                         RowFrame, RowNumber, WindowAggregate,
                                         default_frame)
@@ -972,6 +973,42 @@ class CpuWindowExec(PhysicalPlan):
                     data = np.where(same, data, v.data.dtype.type(dv))
                 valid = np.where(same, valid, True)
             return Vec(v.dtype, data, valid, lens)
+        if isinstance(fn, NthValue):
+            frame = fn.frame or default_frame(bool(self.order_spec))
+            v = fn.children[0].eval(ctx, svecs)
+            data = np.zeros(n, v.data.dtype) if v.lengths is None else None
+            sdata = (np.zeros((n, v.data.shape[1]), np.uint8)
+                     if v.lengths is not None else None)
+            slens = np.zeros(n, np.int32) if v.lengths is not None else None
+            valid = np.zeros(n, bool)
+            for lo, hi in parts:
+                for i in range(lo, hi):
+                    flo, fhi = _cpu_frame_bounds(
+                        frame, i, lo, hi, peer_start, sorder_vecs,
+                        self.order_spec)
+                    if fhi < flo:
+                        continue
+                    if fn.ignore_nulls:
+                        cand = [j for j in range(flo, fhi + 1)
+                                if v.validity[j]]
+                        if len(cand) < fn.n:
+                            continue
+                        j = cand[fn.n - 1]
+                    else:
+                        j = flo + fn.n - 1
+                        if j > fhi:
+                            continue
+                        if not v.validity[j]:
+                            continue
+                    valid[i] = True
+                    if sdata is not None:
+                        slens[i] = v.lengths[j]
+                        sdata[i, :] = v.data[j, :]
+                    else:
+                        data[i] = v.data[j]
+            if sdata is not None:
+                return Vec(v.dtype, sdata, valid, slens)
+            return Vec(v.dtype, data, valid)
         if isinstance(fn, WindowAggregate):
             frame = fn.frame or default_frame(bool(self.order_spec))
             func = fn.func
@@ -1082,7 +1119,13 @@ def _cpu_window_agg(func, v, sl):
     if name == "Count":
         return int(valid.sum())
     if name in ("First", "Last"):
-        j = sl.start if name == "First" else sl.stop - 1
+        if getattr(func, "ignore_nulls", False):
+            idxs = [k for k in range(sl.start, sl.stop) if v.validity[k]]
+            if not idxs:
+                return None
+            j = idxs[0] if name == "First" else idxs[-1]
+        else:
+            j = sl.start if name == "First" else sl.stop - 1
         if not v.validity[j]:
             return None
         if v.is_string:

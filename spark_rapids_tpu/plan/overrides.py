@@ -471,9 +471,6 @@ def _tag_window_agg(meta: ExprMeta) -> None:
                     f"bounded-frame window {name} over STRING runs on CPU")
         except ValueError:
             pass
-    if getattr(e.func, "ignore_nulls", False) and name in ("First", "Last"):
-        meta.will_not_work(
-            "IGNORE NULLS First/Last over a window runs on CPU")
 
 
 def _tag_regex(meta: ExprMeta) -> None:
@@ -528,6 +525,7 @@ def _register_window_exprs():
                 WX.CumeDist, WX.NTile, WX.Lead, WX.Lag):
         expr_rule(cls, _basic)
     expr_rule(WX.WindowAggregate, _basic, tag_fn=_tag_window_agg)
+    expr_rule(WX.NthValue, _basic)
 
 
 _register_window_exprs()
@@ -751,7 +749,7 @@ def _tag_window(m: PlanMeta):
     for f, name in m.plan._bound_fns:
         if f.requires_order and not has_order:
             m.will_not_work(f"window function {name} requires an ORDER BY")
-        if isinstance(f, WX.WindowAggregate) and \
+        if isinstance(f, (WX.WindowAggregate, WX.NthValue)) and \
                 WX.is_value_range_frame(f.frame):
             # value-offset RANGE frames: Spark restricts these to a single
             # orderable numeric order column; the device binary search

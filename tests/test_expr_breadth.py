@@ -563,3 +563,36 @@ class TestDatetimeStringBridge:
         from spark_rapids_tpu.expr import DateFormat
         with pytest.raises(ValueError, match="pattern"):
             DateFormat(col("d"), "MMM d, yyyy")  # variable-width month name
+
+
+class TestCastAndPatternEdges:
+    def test_hex_float_grammar(self, session):
+        from spark_rapids_tpu.expr import Cast
+        from spark_rapids_tpu import types as TT
+        t = pa.table({"s": pa.array(["0x1p3", "0x1f", "0x1p3d", "123d",
+                                     "nand", "infinityf", "Infinity"])})
+        df = session.from_arrow(t)
+        out = df.select("s", d=Cast(col("s"), TT.DOUBLE)).collect_cpu()
+        got = dict(zip(out.column("s").to_pylist(),
+                       out.column("d").to_pylist()))
+        assert got["0x1p3"] == 8.0
+        assert got["0x1f"] is None      # hex needs the p exponent (Java)
+        assert got["0x1p3d"] == 8.0     # suffix strips on hex too
+        assert got["123d"] == 123.0
+        assert got["nand"] is None      # no suffix on NaN/Infinity words
+        assert got["infinityf"] is None
+        assert got["Infinity"] == float("inf")
+
+    def test_quoted_pattern_literals(self, session):
+        from spark_rapids_tpu.expr import DateFormat, ToUnixTimestamp
+        import datetime as dtl
+        t = pa.table({"d": pa.array([dtl.date(2024, 3, 7)],
+                                    type=pa.date32())})
+        df = session.from_arrow(t)
+        out = assert_same(df.select(
+            a=DateFormat(col("d"), "yyyy'T'MM"),
+            b=DateFormat(col("d"), "yyyy''MM")))
+        assert out.column("a").to_pylist() == ["2024T03"]
+        assert out.column("b").to_pylist() == ["2024'03"]
+        with pytest.raises(ValueError, match="unterminated"):
+            DateFormat(col("d"), "yyyy'oops")

@@ -375,3 +375,47 @@ class TestValueRangeEdges:
                       order_by=[(col("k"), True, False)],
                       c=WindowAggregate(Count(col("v")), RangeFrame(-3, 3)))
         assert_same(q, sort_by=["g", "k", "v"])
+
+
+class TestNthValueAndIgnoreNulls:
+    def test_nth_value(self, session, rng):
+        from spark_rapids_tpu.expr import NthValue
+        df = session.from_arrow(window_table(rng, n=300))
+        q = df.window(partition_by=["g"], order_by=["ts", "i"],
+                      n1=NthValue(col("v"), 1),
+                      n3=NthValue(col("v"), 3),
+                      n2f=NthValue(col("v"), 2, frame=RowFrame(-2, 2)),
+                      big=NthValue(col("v"), 500))
+        out = assert_same(q, sort_by=SORT)
+        assert out.column("big").to_pylist() == [None] * out.num_rows
+
+    def test_nth_value_ignore_nulls(self, session, rng):
+        from spark_rapids_tpu.expr import NthValue
+        df = session.from_arrow(window_table(rng, n=250, null_frac=0.4))
+        q = df.window(partition_by=["g"], order_by=["ts", "i"],
+                      n2=NthValue(col("v"), 2, ignore_nulls=True),
+                      n1=NthValue(col("v"), 1, ignore_nulls=True,
+                                  frame=RowFrame(None, None)))
+        assert_same(q, sort_by=SORT)
+
+    def test_first_last_ignore_nulls(self, session, rng):
+        df = session.from_arrow(window_table(rng, n=250, null_frac=0.4))
+        q = df.window(
+            partition_by=["g"], order_by=["ts", "i"],
+            f=WindowAggregate(First(col("v"), ignore_nulls=True),
+                              RowFrame(None, None)),
+            l=WindowAggregate(Last(col("v"), ignore_nulls=True),
+                              RowFrame(None, None)),
+            fb=WindowAggregate(First(col("v"), ignore_nulls=True),
+                               RowFrame(-2, 2)),
+            lb=WindowAggregate(Last(col("v"), ignore_nulls=True),
+                               RowFrame(-3, 0)))
+        assert "IGNORE NULLS" not in q.explain()
+        assert_same(q, sort_by=SORT)
+
+    def test_first_last_ignore_nulls_strings(self, session, rng):
+        df = session.from_arrow(window_table(rng, n=120, null_frac=0.3))
+        q = df.window(partition_by=["g"], order_by=["ts", "i"],
+                      f=WindowAggregate(First(col("s"), ignore_nulls=True),
+                                        RowFrame(None, None)))
+        assert_same(q, sort_by=SORT)
