@@ -574,19 +574,30 @@ def compile_dt_pattern(fmt: str):
     off = 0
     while pos < len(fmt):
         if fmt[pos] == "'":
-            # Spark/Java quoting: '...' is a literal run, '' a literal quote
+            # Spark/Java quoting: '...' is a literal run; '' is a literal
+            # quote both outside AND INSIDE a quoted run
             if fmt.startswith("''", pos):
                 out.append(("lit", off, "'"))
                 off += 1
                 pos += 2
                 continue
-            end = fmt.find("'", pos + 1)
-            if end < 0:
+            pos += 1  # consume opening quote
+            closed = False
+            while pos < len(fmt):
+                if fmt[pos] == "'":
+                    if fmt.startswith("''", pos):  # escaped quote in run
+                        out.append(("lit", off, "'"))
+                        off += 1
+                        pos += 2
+                        continue
+                    pos += 1  # closing quote
+                    closed = True
+                    break
+                out.append(("lit", off, fmt[pos]))
+                off += len(fmt[pos].encode("utf-8"))
+                pos += 1
+            if not closed:
                 raise ValueError(f"unterminated quote in pattern {fmt!r}")
-            for ch in fmt[pos + 1:end]:
-                out.append(("lit", off, ch))
-                off += len(ch.encode("utf-8"))
-            pos = end + 1
             continue
         for tok in _PAT_TOKENS:
             if fmt.startswith(tok, pos):

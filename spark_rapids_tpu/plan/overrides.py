@@ -841,8 +841,9 @@ def _do_register_file_scans():
     from ..io.json_ import CpuJsonScanExec
     from ..io.orc import CpuOrcScanExec
     from ..io.avro import CpuAvroScanExec
+    from ..io.hive_text import CpuHiveTextScanExec
     for cls in (CpuParquetScanExec, CpuCsvScanExec, CpuJsonScanExec,
-                CpuOrcScanExec, CpuAvroScanExec):
+                CpuOrcScanExec, CpuAvroScanExec, CpuHiveTextScanExec):
         exec_rule(cls, TypeSig.all_basic(), _c_file_scan)
 
 

@@ -75,6 +75,13 @@ class TpuSession:
         return DataFrame(self, avro_scan_plan(list(paths), self.conf,
                                               **options))
 
+    def read_hive_text(self, *paths, **options):
+        """Hive delimited-text table scan (requires schema=Schema(...))."""
+        from .frontend import DataFrame
+        from .io.hive_text import hive_text_scan_plan
+        return DataFrame(self, hive_text_scan_plan(list(paths), self.conf,
+                                                   **options))
+
     def read_iceberg(self, path, columns=None, snapshot_id=None,
                      as_of_timestamp_ms=None):
         from .datasources.iceberg import IcebergTable

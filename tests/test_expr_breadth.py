@@ -596,3 +596,17 @@ class TestCastAndPatternEdges:
         assert out.column("b").to_pylist() == ["2024'03"]
         with pytest.raises(ValueError, match="unterminated"):
             DateFormat(col("d"), "yyyy'oops")
+
+    def test_escaped_quote_inside_quoted_run(self, session):
+        from spark_rapids_tpu.expr import DateFormat
+        from spark_rapids_tpu.expr.datetime_ import compile_dt_pattern
+        parts, width = compile_dt_pattern("yyyy' o''clock'")
+        lits = "".join(t for k, _, t in parts if k == "lit")
+        assert lits == " o'clock" and width == 4 + len(" o'clock")
+        import datetime as dtl
+        t = pa.table({"d": pa.array([dtl.date(2024, 1, 1)],
+                                    type=pa.date32())})
+        df = session.from_arrow(t)
+        out = assert_same(df.select(a=DateFormat(col("d"),
+                                                 "yyyy' o''clock'")))
+        assert out.column("a").to_pylist() == ["2024 o'clock"]

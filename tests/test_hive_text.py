@@ -1,0 +1,99 @@
+r"""Hive delimited-text scan tests (reference hive/rapids
+GpuHiveTableScanExec: LazySimpleSerDe defaults - \x01 delimiters, \N nulls,
+no header)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.expr import Count, Sum, col, lit
+from spark_rapids_tpu.plugin import TpuSession
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession({"spark.rapids.sql.explain": "NONE"})
+
+
+SCHEMA = Schema(("id", "name", "score"), (T.LONG, T.STRING, T.DOUBLE))
+
+
+def write_hive(path, rows, delim="\x01"):
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(delim.join(r"\N" if v is None else str(v)
+                               for v in r) + "\n")
+
+
+ROWS = [(1, "alice", 3.5), (2, None, 1.25), (3, "b\x02c", None),
+        (4, "comma,quote\"x", 9.0)]
+
+
+class TestHiveText:
+    def test_roundtrip_default_serde(self, session, tmp_path):
+        p = str(tmp_path / "t.txt")
+        write_hive(p, ROWS)
+        df = session.read_hive_text(p, schema=SCHEMA)
+        got = df.collect().sort_by([("id", "ascending")]).to_pylist()
+        assert got[0] == {"id": 1, "name": "alice", "score": 3.5}
+        assert got[1]["name"] is None
+        assert got[2]["score"] is None
+        assert got[3]["name"] == 'comma,quote"x'  # no quoting in hive text
+        cpu = df.collect_cpu().sort_by([("id", "ascending")]).to_pylist()
+        assert got == cpu
+
+    def test_custom_delimiter_and_query(self, session, tmp_path):
+        p = str(tmp_path / "t.tsv")
+        write_hive(p, ROWS, delim="\t")
+        df = session.read_hive_text(p, schema=SCHEMA, sep="\t")
+        out = (df.filter(col("id") > lit(1))
+                 .agg(n=Count(lit(1)), s=Sum(col("score")))).collect()
+        assert out.column("n").to_pylist() == [3]
+        assert out.column("s").to_pylist() == [10.25]
+
+    def test_schema_required(self, session, tmp_path):
+        p = str(tmp_path / "t.txt")
+        write_hive(p, ROWS)
+        with pytest.raises(ValueError, match="schema"):
+            session.read_hive_text(p)
+
+    def test_nested_rejected(self, session, tmp_path):
+        p = str(tmp_path / "t.txt")
+        write_hive(p, ROWS)
+        nested = Schema(("a",), (T.ArrayType(T.LONG),))
+        with pytest.raises(ValueError, match="nested"):
+            session.read_hive_text(p, schema=nested)
+
+    def test_multifile(self, session, tmp_path):
+        paths = []
+        for i in range(3):
+            p = str(tmp_path / f"part{i}.txt")
+            write_hive(p, [(i * 10 + j, f"r{j}", float(j))
+                           for j in range(5)])
+            paths.append(p)
+        df = session.read_hive_text(*paths, schema=SCHEMA)
+        assert df.collect().num_rows == 15
+
+    def test_disabled_by_conf(self, tmp_path):
+        s = TpuSession({"spark.rapids.sql.format.hiveText.enabled": False,
+                        "spark.rapids.sql.explain": "NONE"})
+        p = str(tmp_path / "t.txt")
+        write_hive(p, ROWS)
+        with pytest.raises(ValueError, match="disabled"):
+            s.read_hive_text(p, schema=SCHEMA)
+
+    def test_malformed_cells_become_null(self, session, tmp_path):
+        # LazySimpleSerDe: unparseable primitive cells -> NULL, not a crash
+        p = str(tmp_path / "dirty.txt")
+        with open(p, "w") as f:
+            f.write("1\x01abc\x012.5\n")      # name col fine, others...
+            f.write("oops\x01bob\x01xyz\n")   # bad long, bad double
+            f.write("3\x01carol\x01\n")       # empty double cell
+        df = session.read_hive_text(p, schema=SCHEMA)
+        got = df.collect_cpu().to_pylist()
+        assert got[0]["id"] == 1 and got[0]["score"] == 2.5
+        assert got[1]["id"] is None and got[1]["score"] is None
+        assert got[1]["name"] == "bob"
+        assert got[2]["score"] is None
