@@ -201,6 +201,10 @@ register("spark.rapids.sql.autoBroadcastJoinThreshold", "int", 10 << 20,
 # I/O -------------------------------------------------------------------------------
 register("spark.rapids.sql.format.parquet.enabled", "bool", True,
          "Enable TPU parquet scan/write.")
+register("spark.rapids.sql.format.parquet.deviceWrite.enabled", "bool", True,
+         "Encode parquet writes on device (PLAIN pages; value compaction + "
+         "byte marshalling run on TPU, host writes thrift framing). Falls "
+         "back to the host writer for strings/nested/partitioned writes.")
 register("spark.rapids.sql.format.parquet.reader.type", "string", "AUTO",
          "Reader strategy: AUTO, PERFILE, COALESCING, MULTITHREADED "
          "(reference GpuParquetScan three strategies).",

@@ -20,6 +20,13 @@ class SplitAndRetryOOM(RapidsTpuError):
     """Device memory pressure too high for retry alone: split the input and retry."""
 
 
+class PlanNotFullyOnDevice(RapidsTpuError):
+    """A zero-copy device handoff was requested but the plan has CPU
+    sections; callers may fall back to host execution. Deliberately NOT a
+    RuntimeError subclass so genuine runtime failures (XlaRuntimeError IS
+    a RuntimeError) can never masquerade as this signal."""
+
+
 class CpuFallbackRequired(RapidsTpuError):
     """A batch/op cannot execute on device; the planner/exec must take the host path."""
 

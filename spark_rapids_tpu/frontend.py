@@ -193,6 +193,25 @@ class DataFrame:
     def write_parquet(self, path: str, partition_by=None, mode: str = "error",
                       **options):
         from .io.writer import write_table
+        conf = self.session.conf
+        codec = (options.get("compression") or "snappy").upper()
+        if (not partition_by and
+                codec in ("SNAPPY", "ZSTD", "UNCOMPRESSED", "NONE") and
+                conf.get(
+                    "spark.rapids.sql.format.parquet.deviceWrite.enabled")):
+            from .errors import PlanNotFullyOnDevice
+            from .io.parquet_device_write import schema_supported
+            from .io.writer import write_device_parquet
+            if schema_supported(self.schema):
+                try:
+                    batches = self.session.execute_plan_device_batches(
+                        self.plan)
+                except PlanNotFullyOnDevice:
+                    pass  # CPU sections in the plan: host write below
+                else:
+                    return write_device_parquet(
+                        batches, self.schema, path, mode,
+                        codec="UNCOMPRESSED" if codec == "NONE" else codec)
         return write_table(self.collect(), path, "parquet", partition_by,
                            mode, **options)
 

@@ -12,7 +12,6 @@ from __future__ import annotations
 from typing import Sequence
 
 import pyarrow as pa
-import pyarrow.csv as pacsv
 
 from .. import types as T
 from ..columnar.batch import Schema
@@ -37,34 +36,40 @@ class CpuHiveTextScanExec(CpuFileScanExec):
                                  "delimiters) are not supported")
         super().__init__(paths, conf, columns, **options)
 
-    def _read_opts(self):
-        schema = self.options["schema"]
-        delim = self.options.get("sep", "\x01")
-        read = pacsv.ReadOptions(column_names=list(schema.names))
-        parse = pacsv.ParseOptions(delimiter=delim, quote_char=False,
-                                   escape_char=False)
-        # read EVERYTHING as strings: LazySimpleSerDe returns NULL for
-        # unparseable primitive cells, so typed parsing happens afterwards
-        # through the engine's Spark-semantics string casts (invalid -> null)
-        conv = pacsv.ConvertOptions(
-            null_values=[r"\N"], strings_can_be_null=True,
-            quoted_strings_can_be_null=False,
-            column_types={n: pa.string() for n in schema.names})
-        return read, parse, conv
-
     def _infer_schema(self) -> Schema:
         return self.options["schema"]
 
     def decode_file(self, path: str) -> pa.Table:
+        """Serde-faithful line parse: split on the raw delimiter byte with
+        NO quoting, pad short rows with NULL and drop extra trailing fields
+        (LazySimpleSerDe), then type every cell through the engine's
+        Spark-semantics string casts (unparseable -> NULL)."""
         import numpy as np
         from ..cpu.hostbatch import (host_batch_from_arrow,
                                      host_vec_to_arrow)
         from ..expr.base import EvalContext
         from ..expr.cast import Cast
-        read, parse, conv = self._read_opts()
-        raw = pacsv.read_csv(path, read_options=read, parse_options=parse,
-                             convert_options=conv)
         schema = self.options["schema"]
+        delim = self.options.get("sep", "\x01")
+        ncols = len(schema.names)
+        with open(path, "rb") as f:
+            data = f.read()
+        db = delim.encode("utf-8")
+        cols: list = [[] for _ in range(ncols)]
+        for line in data.split(b"\n"):
+            if not line:
+                continue
+            if line.endswith(b"\r"):
+                line = line[:-1]
+            fields = line.split(db)
+            for i in range(ncols):
+                cell = fields[i] if i < len(fields) else None
+                if cell is None or cell == b"\\N":
+                    cols[i].append(None)
+                else:
+                    cols[i].append(cell.decode("utf-8", "replace"))
+        raw = pa.table([pa.array(c, type=pa.string()) for c in cols],
+                       names=list(schema.names))
         hb = host_batch_from_arrow(raw)
         ctx = EvalContext(np, row_mask=np.ones(raw.num_rows, dtype=bool))
         arrays = []

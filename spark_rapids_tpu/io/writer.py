@@ -51,25 +51,50 @@ def _write_one(table: pa.Table, path: str, fmt: str, **options) -> None:
 _MODES = ("error", "overwrite", "append", "ignore")
 
 
-def write_table(table: pa.Table, path: str, fmt: str = "parquet",
-                partition_by: Optional[Sequence[str]] = None,
-                mode: str = "error", **options) -> WriteStats:
+def _prepare_output_path(path: str, mode: str) -> bool:
+    """Shared mode/exists handling for every writer. Returns False when the
+    write should be skipped (mode=ignore on existing output)."""
     if mode not in _MODES:
         raise ValueError(f"unknown write mode {mode!r}; one of {_MODES}")
-    stats = WriteStats(partitions=[])
     exists = os.path.exists(path)
     non_empty = exists and (not os.path.isdir(path) or os.listdir(path))
     if non_empty:
         if mode == "error":
             raise FileExistsError(f"path exists: {path} (mode=error)")
         if mode == "ignore":
-            return stats
+            return False
         if mode == "overwrite":
             import shutil
             if os.path.isdir(path):
                 shutil.rmtree(path)
             else:
                 os.unlink(path)
+    return True
+
+
+def write_device_parquet(batches, schema, path: str, mode: str = "error",
+                         codec: str = "SNAPPY") -> WriteStats:
+    """Write DEVICE batches straight to parquet via the device encoder —
+    no arrow materialization (the GPU-writer path, GpuParquetFileFormat)."""
+    from .parquet_device_write import device_encode_table
+    stats = WriteStats(partitions=[])
+    if not _prepare_output_path(path, mode):
+        return stats
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{uuid.uuid4().hex[:12]}.parquet")
+    blob = device_encode_table(batches, schema, codec=codec)
+    with open(out, "wb") as f:
+        f.write(blob)
+    stats.record(out, sum(int(b.row_count()) for b in batches))
+    return stats
+
+
+def write_table(table: pa.Table, path: str, fmt: str = "parquet",
+                partition_by: Optional[Sequence[str]] = None,
+                mode: str = "error", **options) -> WriteStats:
+    stats = WriteStats(partitions=[])
+    if not _prepare_output_path(path, mode):
+        return stats
     ext = {"parquet": "parquet", "orc": "orc", "csv": "csv"}[fmt]
     if not partition_by:
         os.makedirs(path, exist_ok=True)

@@ -159,7 +159,8 @@ class TpuSession:
             return any(has_cpu_section(c) for c in node.children)
 
         if not isinstance(result, TpuExec) or has_cpu_section(result):
-            raise RuntimeError(
+            from .errors import PlanNotFullyOnDevice
+            raise PlanNotFullyOnDevice(
                 "plan did not fully convert to TPU execution; zero-copy "
                 "device handoff needs an all-device plan:\n"
                 + ov.explain_string())

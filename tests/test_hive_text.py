@@ -97,3 +97,17 @@ class TestHiveText:
         assert got[1]["id"] is None and got[1]["score"] is None
         assert got[1]["name"] == "bob"
         assert got[2]["score"] is None
+
+    def test_ragged_rows_pad_null(self, session, tmp_path):
+        # LazySimpleSerDe: short rows pad missing trailing cols with NULL,
+        # extra trailing fields are dropped
+        p = str(tmp_path / "ragged.txt")
+        with open(p, "w") as f:
+            f.write("2\x01bob\n")                      # missing score
+            f.write("3\x01carol\x011.5\x01extra\n")    # extra field
+            f.write("4\n")                             # only id
+        df = session.read_hive_text(p, schema=SCHEMA)
+        got = df.collect_cpu().to_pylist()
+        assert got[0] == {"id": 2, "name": "bob", "score": None}
+        assert got[1] == {"id": 3, "name": "carol", "score": 1.5}
+        assert got[2] == {"id": 4, "name": None, "score": None}
