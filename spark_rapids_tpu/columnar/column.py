@@ -231,6 +231,17 @@ def from_arrow(arr, capacity: Optional[int] = None) -> Tuple[Column, int]:
                       jnp.asarray(_pad_to(valid, cap)),
                       jnp.asarray(_pad_to(lens, cap))), n
 
+    if isinstance(dtype, T.DecimalType) and \
+            dtype.precision > T.DecimalType.MAX_LONG_DIGITS:
+        # decimal128: two int64 limbs per row, [cap, 2] (see expr/decimal128)
+        from ..expr.decimal128 import split_int
+        limbs = np.zeros((n, 2), np.int64)
+        for i, v in enumerate(arr):
+            if v.is_valid:
+                limbs[i] = split_int(int(v.as_py().scaleb(dtype.scale)))
+        limbs = _pad_to(limbs, cap)
+        return Column(dtype, jnp.asarray(limbs),
+                      jnp.asarray(_pad_to(valid, cap))), n
     npdt = dtype.np_dtype
     if npdt is None:
         if dtype.is_nested:
@@ -247,8 +258,7 @@ def from_arrow(arr, capacity: Optional[int] = None) -> Tuple[Column, int]:
             return vec_map_arrays(hv, pad_ship).to_column(), n
         raise TypeError(
             f"type not yet device-backed: {arr.type} "
-            "(wide decimal >18 digits needs limb support; binary needs the string "
-            "byte-matrix path)")
+            "(binary needs the string byte-matrix path)")
     if isinstance(dtype, T.DecimalType):
         vals = np.array([int(v.as_py().scaleb(dtype.scale)) if v.is_valid else 0
                          for v in arr], dtype=np.int64)
@@ -305,6 +315,12 @@ def to_arrow(col: Column, num_rows: int):
     at = T.to_arrow(col.dtype)
     if isinstance(col.dtype, T.DecimalType):
         import decimal as _d
+        if col.dtype.precision > T.DecimalType.MAX_LONG_DIGITS:
+            from ..expr.decimal128 import join_int
+            py = [(_d.Decimal(join_int(int(v[0]), int(v[1])))
+                   .scaleb(-col.dtype.scale) if m else None)
+                  for v, m in zip(vals, valid)]
+            return pa.array(py, type=at)
         py = [(_d.Decimal(int(v)).scaleb(-col.dtype.scale) if m else None)
               for v, m in zip(vals, valid)]
         return pa.array(py, type=at)

@@ -89,6 +89,14 @@ def host_vec_from_arrow(arr) -> Vec:
                 src = np.repeat(offsets[:-1], lens) + within
                 chars[row_id, within] = databuf[src]
         return Vec(dtype, chars, valid, lens)
+    if isinstance(dtype, T.DecimalType) and \
+            dtype.precision > T.DecimalType.MAX_LONG_DIGITS:
+        from ..expr.decimal128 import split_int
+        limbs = np.zeros((n, 2), np.int64)
+        for i, v in enumerate(arr):
+            if v.is_valid:
+                limbs[i] = split_int(int(v.as_py().scaleb(dtype.scale)))
+        return Vec(dtype, limbs, valid)
     npdt = dtype.np_dtype
     if npdt is None:
         raise TypeError(f"type not host-vec-backed: {arr.type}")
@@ -168,6 +176,12 @@ def host_vec_to_arrow(v: Vec, num_rows: Optional[int] = None):
     at = T.to_arrow(v.dtype)
     if isinstance(v.dtype, T.DecimalType):
         import decimal as _d
+        if v.dtype.precision > T.DecimalType.MAX_LONG_DIGITS:
+            from ..expr.decimal128 import join_int
+            py = [(_d.Decimal(join_int(int(x[0]), int(x[1])))
+                   .scaleb(-v.dtype.scale) if m else None)
+                  for x, m in zip(vals, valid)]
+            return pa.array(py, type=at)
         py = [(_d.Decimal(int(x)).scaleb(-v.dtype.scale) if m else None)
               for x, m in zip(vals, valid)]
         return pa.array(py, type=at)

@@ -35,6 +35,8 @@ def _vals_equal(xp, v: Vec, shift: int):
     if v.is_string:
         return (v.data[shift:] == v.data[:-shift]).all(axis=1) & \
             (v.lengths[shift:] == v.lengths[:-shift])
+    if v.data.ndim == 2:  # decimal128 limb pairs
+        return (v.data[shift:] == v.data[:-shift]).all(axis=1)
     return v.data[shift:] == v.data[:-shift]
 
 
@@ -251,7 +253,12 @@ class TpuHashAggregateExec(UnaryTpuExec):
             avg = s / xp.maximum(c, 1)
             return [Vec(T.DOUBLE, avg, c > 0)]
         if isinstance(func, Sum):
+            from ..expr.decimal128 import is_dec128
             v = sbufs[bi]
+            if isinstance(func.data_type, T.DecimalType) and \
+                    (is_dec128(func.data_type) or is_dec128(v.dtype)):
+                return [self._sum_dec128(xp, func, v, gid, cap, row_mask,
+                                         output_partial)]
             out_t = func.data_type if not merging else v.dtype
             acc = np.float64 if T.is_floating(out_t) else np.int64
             data, has = seg("sum", v, acc)
@@ -259,10 +266,13 @@ class TpuHashAggregateExec(UnaryTpuExec):
                         func.partial_types()[0],
                         data.astype(func.data_type.np_dtype), has)]
         if isinstance(func, (Min, Max)):
+            from ..expr.decimal128 import is_dec128
             op = "min" if isinstance(func, Min) else "max"
             v = sbufs[bi]
             if v.is_string:
                 return [self._minmax_string(xp, op, v, gid, cap, row_mask)]
+            if is_dec128(v.dtype):
+                return [self._minmax_dec128(xp, op, v, gid, cap, row_mask)]
             data, has = seg(op, v)
             return [Vec(v.dtype, data.astype(v.dtype.np_dtype), has)]
         if isinstance(func, _VarianceFamily):
@@ -387,6 +397,52 @@ class TpuHashAggregateExec(UnaryTpuExec):
             out = gather_vecs(xp, [v], safe)[0]
             return [Vec(out.dtype, out.data, out.validity & got, out.lengths)]
         raise NotImplementedError(type(func).__name__)
+
+    def _minmax_dec128(self, xp, op: str, v: Vec, gid, cap: int,
+                       row_mask) -> Vec:
+        """128-bit extremum in two ordered passes: segment-extreme of the
+        high limb, then of the unsigned low order among rows matching it —
+        (ext_hi, ext_lo) IS the extreme value."""
+        from ..expr.decimal128 import _s, _u
+        valid = v.validity & row_mask
+        hi = v.data[:, 0]
+        lo_key = _s(xp, _u(xp, v.data[:, 1]) ^ np.uint64(1 << 63))
+        info = np.iinfo(np.int64)
+        neutral = info.max if op == "min" else info.min
+        hi_m = xp.where(valid, hi, neutral)
+        h_ext = segment_reduce(xp, op, hi_m, gid, cap, row_mask)
+        cand = valid & (hi == h_ext[gid])
+        lo_m = xp.where(cand, lo_key, neutral)
+        l_ext = segment_reduce(xp, op, lo_m, gid, cap, row_mask)
+        out_lo = _s(xp, _u(xp, l_ext) ^ np.uint64(1 << 63))
+        has = _seg_sum(xp, valid.astype(np.int64), gid, cap) > 0
+        data = xp.stack([h_ext, out_lo], axis=1)
+        return Vec(v.dtype, data, has)
+
+    def _sum_dec128(self, xp, func, v: Vec, gid, cap: int, row_mask,
+                    output_partial: bool) -> Vec:
+        """Decimal128 SUM via carry-free chunk sums (decimal128.sum_chunks):
+        three independent segment-sums reconstruct the 128-bit total.
+        Partial buffers carry the same decimal type, so merge passes rerun
+        the identical kernel. Overflow past precision -> null (Spark)."""
+        from ..expr.decimal128 import (in_bounds, is_dec128, pack_limbs,
+                                       sum_chunks, sum_recombine,
+                                       widen_operand)
+        valid = v.validity & row_mask
+        hi, lo = widen_operand(xp, v)
+        hi = xp.where(valid, hi, np.int64(0))
+        lo = xp.where(valid, lo, np.int64(0))
+        c0, c1, c2 = sum_chunks(xp, hi, lo)
+        s0 = _seg_sum(xp, c0, gid, cap)
+        s1 = _seg_sum(xp, c1, gid, cap)
+        s2 = _seg_sum(xp, c2, gid, cap)
+        shi, slo = sum_recombine(xp, s0, s1, s2)
+        out_t = func.data_type
+        ok = in_bounds(xp, shi, slo, out_t.precision)
+        has = _seg_sum(xp, valid.astype(np.int64), gid, cap) > 0
+        if is_dec128(out_t):
+            return Vec(out_t, pack_limbs(xp, shi, slo), has & ok)
+        return Vec(out_t, slo.astype(np.int64), has & ok)
 
     def _minmax_string(self, xp, op: str, v: Vec, gid, cap: int, row_mask) -> Vec:
         """min/max over strings: segmented argmin via ordering keys is complex;

@@ -20,6 +20,8 @@ from .base import Expression, EvalContext, Vec, and_validity, ansi_raise
 
 
 def _overflow_msg(dt: T.DataType) -> str:
+    if isinstance(dt, T.DecimalType):
+        return f"[ARITHMETIC_OVERFLOW] {dt.simple_string()} overflow"
     name = {8: "tinyint", 16: "smallint"}.get(
         (dt.np_dtype.itemsize * 8) if dt.np_dtype else 64)
     if isinstance(dt, T.LongType):
@@ -64,9 +66,41 @@ class BinaryExpression(Expression):
 class BinaryArithmetic(BinaryExpression):
     @property
     def data_type(self) -> T.DataType:
-        return T.numeric_promote(self.left.data_type, self.right.data_type)
+        lt, rt = self.left.data_type, self.right.data_type
+        if isinstance(lt, T.DecimalType) and isinstance(rt, T.DecimalType) \
+                and type(self) in (Add, Subtract):
+            from .decimal128 import add_result_type
+            return add_result_type(lt, rt)
+        return T.numeric_promote(lt, rt)
+
+    def _decimal_addsub(self, ctx: EvalContext, l: Vec, r: Vec) -> Vec:
+        """Decimal +/- via 128-bit limbs: rescale to the result scale, add
+        (negating the rhs for subtract), overflow -> null (non-ANSI) or
+        raise (ANSI), matching Spark's checked decimal arithmetic."""
+        from .decimal128 import (add128, in_bounds, is_dec128, neg128,
+                                 pack_limbs, rescale_up, widen_operand)
+        xp = ctx.xp
+        out_t = self.data_type
+        lhi, llo = widen_operand(xp, l)
+        rhi, rlo = widen_operand(xp, r)
+        lhi, llo = rescale_up(xp, lhi, llo, out_t.scale - l.dtype.scale)
+        rhi, rlo = rescale_up(xp, rhi, rlo, out_t.scale - r.dtype.scale)
+        if isinstance(self, Subtract):
+            rhi, rlo = neg128(xp, rhi, rlo)
+        hi, lo = add128(xp, lhi, llo, rhi, rlo)
+        ok = in_bounds(xp, hi, lo, out_t.precision)
+        validity = and_validity(xp, l.validity, r.validity)
+        if ctx.ansi:
+            ansi_raise(ctx, ~ok & validity, _overflow_msg(out_t))
+        if is_dec128(out_t):
+            return Vec(out_t, pack_limbs(xp, hi, lo), validity & ok)
+        return Vec(out_t, lo.astype(np.int64), validity & ok)
 
     def _compute(self, ctx: EvalContext, l: Vec, r: Vec) -> Vec:
+        if isinstance(l.dtype, T.DecimalType) and \
+                isinstance(r.dtype, T.DecimalType) and \
+                type(self) in (Add, Subtract):
+            return self._decimal_addsub(ctx, l, r)
         l, r, dt = promote_args(ctx.xp, l, r)
         validity = and_validity(ctx.xp, l.validity, r.validity)
         data = self._op(ctx.xp, l.data, r.data)
@@ -238,6 +272,10 @@ class UnaryMinus(Expression):
         return self.children[0].data_type
 
     def _compute(self, ctx, c: Vec) -> Vec:
+        from .decimal128 import is_dec128, neg128, pack_limbs
+        if is_dec128(c.dtype):
+            hi, lo = neg128(ctx.xp, c.data[:, 0], c.data[:, 1])
+            return Vec(c.dtype, pack_limbs(ctx.xp, hi, lo), c.validity)
         if ctx.ansi and T.is_integral(c.dtype):
             mn = np.iinfo(c.dtype.np_dtype).min
             ansi_raise(ctx, (c.data == mn) & c.validity, _overflow_msg(c.dtype))
@@ -254,6 +292,15 @@ class Abs(Expression):
         return self.children[0].data_type
 
     def _compute(self, ctx, c: Vec) -> Vec:
+        from .decimal128 import is_dec128, neg128, pack_limbs
+        if is_dec128(c.dtype):
+            xp = ctx.xp
+            hi, lo = c.data[:, 0], c.data[:, 1]
+            nhi, nlo = neg128(xp, hi, lo)
+            neg = hi < 0
+            out = pack_limbs(xp, xp.where(neg, nhi, hi),
+                             xp.where(neg, nlo, lo))
+            return Vec(c.dtype, out, c.validity)
         if ctx.ansi and T.is_integral(c.dtype):
             mn = np.iinfo(c.dtype.np_dtype).min
             ansi_raise(ctx, (c.data == mn) & c.validity, _overflow_msg(c.dtype))

@@ -130,6 +130,9 @@ def zero_vec(xp, dt: T.DataType, shape: tuple) -> Vec:
     if isinstance(dt, T.StructType):
         return Vec(dt, xp.zeros(shape, dtype=bool), validity, None,
                    tuple(zero_vec(xp, f.data_type, shape) for f in dt.fields))
+    if isinstance(dt, T.DecimalType) and \
+            dt.precision > T.DecimalType.MAX_LONG_DIGITS:
+        return Vec(dt, xp.zeros(shape + (2,), dtype=np.int64), validity)
     return Vec(dt, xp.zeros(shape, dtype=dt.np_dtype or np.int32), validity)
 
 
@@ -384,6 +387,10 @@ class Literal(LeafExpression):
             if isinstance(dt, T.StringType):
                 return Vec(dt, xp.zeros((n, 8), dtype=xp.uint8),
                            xp.zeros(n, dtype=bool), xp.zeros(n, dtype=xp.int32))
+            if isinstance(dt, T.DecimalType) and \
+                    dt.precision > T.DecimalType.MAX_LONG_DIGITS:
+                return Vec(dt, xp.zeros((n, 2), dtype=np.int64),
+                           xp.zeros(n, dtype=bool))
             npdt = dt.np_dtype or np.dtype(np.int32)
             return Vec(dt, xp.zeros(n, dtype=npdt), xp.zeros(n, dtype=bool))
         if isinstance(dt, T.StringType):
@@ -400,6 +407,12 @@ class Literal(LeafExpression):
             import decimal as _d
             if isinstance(v, _d.Decimal):
                 v = int(v.scaleb(dt.scale))
+            if dt.precision > T.DecimalType.MAX_LONG_DIGITS:
+                from .decimal128 import split_int
+                hi, lo = split_int(int(v))
+                row = np.array([hi, lo], dtype=np.int64)
+                data = xp.broadcast_to(xp.asarray(row), (n, 2))
+                return Vec(dt, data, xp.ones(n, dtype=bool))
         data = xp.full((n,), v, dtype=dt.np_dtype)
         return Vec(dt, data, xp.ones(n, dtype=bool))
 

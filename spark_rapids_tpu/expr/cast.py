@@ -47,11 +47,11 @@ def device_supported(src: T.DataType, dst: T.DataType) -> bool:
     if isinstance(src, T.LongType) and isinstance(dst, T.TimestampType):
         return True
     if isinstance(src, T.DecimalType) and isinstance(dst, T.DecimalType):
-        return src.precision <= 18 and dst.precision <= 18
+        return True  # incl. 128-bit rescale via limb pow10 mul/div
     if isinstance(src, num) and isinstance(dst, T.DecimalType):
-        return dst.precision <= 18 and not T.is_floating(src)
+        return not T.is_floating(src)
     if isinstance(src, T.DecimalType) and isinstance(dst, num):
-        return src.precision <= 18
+        return True
     return False
 
 
@@ -384,6 +384,10 @@ def _parse_date(xp, c: Vec, first, last, any_c):
 
 def _decimal_cast(xp, c: Vec, dst: T.DataType, ansi: bool) -> Vec:
     src = c.dtype
+    from .decimal128 import is_dec128
+    if (isinstance(src, T.DecimalType) and is_dec128(src)) or \
+            (isinstance(dst, T.DecimalType) and is_dec128(dst)):
+        return _decimal128_cast(xp, c, dst)
     if isinstance(src, T.DecimalType) and isinstance(dst, T.DecimalType):
         shift = dst.scale - src.scale
         a = c.data.astype(np.int64)
@@ -411,3 +415,40 @@ def _decimal_cast(xp, c: Vec, dst: T.DataType, ansi: bool) -> Vec:
     lo, hi = _INT_BOUNDS[dst.np_dtype]
     return Vec(dst, xp.clip(t, lo, hi).astype(dst.np_dtype),
                c.validity & (t >= lo) & (t <= hi))
+
+
+def _decimal128_cast(xp, c: Vec, dst: T.DataType) -> Vec:
+    """Casts touching a >18-digit decimal: rescale via limb pow10 mul/div
+    (HALF_UP), overflow -> null; integral sources widen through limbs."""
+    from .decimal128 import (div_pow10_half_up, in_bounds, is_dec128,
+                             pack_limbs, rescale_up, widen_operand)
+    src = c.dtype
+    if isinstance(src, T.DecimalType) and isinstance(dst, T.DecimalType):
+        hi, lo = widen_operand(xp, c)
+        shift = dst.scale - src.scale
+        if shift >= 0:
+            hi, lo = rescale_up(xp, hi, lo, shift)
+        else:
+            hi, lo = div_pow10_half_up(xp, hi, lo, -shift)
+        ok = in_bounds(xp, hi, lo, dst.precision)
+        if is_dec128(dst):
+            return Vec(dst, pack_limbs(xp, hi, lo), c.validity & ok)
+        return Vec(dst, lo.astype(np.int64), c.validity & ok)
+    if isinstance(dst, T.DecimalType):  # integral -> decimal128
+        lo = c.data.astype(np.int64)
+        hi = xp.where(lo < 0, np.int64(-1), np.int64(0))
+        hi, lo = rescale_up(xp, hi, lo, dst.scale)
+        ok = in_bounds(xp, hi, lo, dst.precision)
+        return Vec(dst, pack_limbs(xp, hi, lo), c.validity & ok)
+    # decimal128 -> numeric: via float64 (lossy, same contract as dec64)
+    hi, lo = widen_operand(xp, c)
+    from .decimal128 import _u
+    val = hi.astype(np.float64) * (2.0 ** 64) + \
+        _u(xp, lo).astype(np.float64)
+    a = val / (10 ** src.scale)
+    if T.is_floating(dst):
+        return Vec(dst, a.astype(dst.np_dtype), c.validity)
+    t = xp.trunc(a).astype(np.int64)
+    lo_b, hi_b = _INT_BOUNDS[dst.np_dtype]
+    return Vec(dst, xp.clip(t, lo_b, hi_b).astype(dst.np_dtype),
+               c.validity & (t >= lo_b) & (t <= hi_b))

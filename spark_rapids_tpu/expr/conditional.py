@@ -39,7 +39,8 @@ def _select(xp, cond, then_v: Vec, else_v: Vec) -> Vec:
     dt = then_v.dtype if not isinstance(then_v.dtype, T.NullType) else else_v.dtype
     ed = else_v.data.astype(then_v.data.dtype) if else_v.data.dtype != \
         then_v.data.dtype else else_v.data
-    return Vec(dt, xp.where(cond, then_v.data, ed),
+    c = cond if then_v.data.ndim == 1 else cond[:, None]  # dec128 limbs
+    return Vec(dt, xp.where(c, then_v.data, ed),
                xp.where(cond, then_v.validity, else_v.validity))
 
 

@@ -1,0 +1,264 @@
+"""Decimal128 limb arithmetic (reference: `decimalExpressions.scala` +
+spark-rapids-jni's decimal128 kernels — SURVEY lists Spark-exact decimal128
+as the first 'hard part').
+
+Representation: a decimal column with precision > 18 carries its unscaled
+128-bit integer as TWO int64 limbs in `data[n, 2]` — column 0 the signed
+high limb (bits 64..127), column 1 the low limb's BIT PATTERN (bits 0..63,
+interpreted unsigned). This is the same rank-2 shape strings use, so the
+generic row machinery (gather, compaction, selection, spill, key packing)
+moves decimal128 columns without modification; only VALUE semantics (adds,
+compares, rescales, reductions) live here.
+
+All helpers are xp-generic (numpy | jax.numpy) and run under jit with x64
+enabled. Sum aggregation avoids carry chains entirely: each value splits
+into three <=2^43 signed chunks, segment-summed independently (no overflow
+for < 2^20 rows), then recombined in limb arithmetic — parallel-friendly,
+unlike a sequential carry propagation."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from .. import types as T
+
+__all__ = ["is_dec128", "split_int", "join_int", "add128", "neg128",
+           "cmp_keys", "mul_pow10", "div_pow10_half_up", "in_bounds",
+           "SUM_CHUNK_BITS"]
+
+_U64 = np.uint64
+_MASK32 = np.uint64(0xFFFFFFFF)
+SUM_CHUNK_BITS = 43
+
+
+def is_dec128(dt) -> bool:
+    return isinstance(dt, T.DecimalType) and \
+        dt.precision > T.DecimalType.MAX_LONG_DIGITS
+
+
+def split_int(v: int) -> Tuple[int, int]:
+    """python int -> (hi signed, lo bit-pattern as signed int64)."""
+    u = v & ((1 << 128) - 1)
+    lo = u & ((1 << 64) - 1)
+    hi = (u >> 64) & ((1 << 64) - 1)
+    def s64(x):
+        return x - (1 << 64) if x >= (1 << 63) else x
+    return s64(hi), s64(lo)
+
+
+def join_int(hi: int, lo: int) -> int:
+    """(hi signed, lo bit-pattern) -> python int."""
+    u = ((hi & ((1 << 64) - 1)) << 64) | (lo & ((1 << 64) - 1))
+    return u - (1 << 128) if u >= (1 << 127) else u
+
+
+def _u(xp, x):
+    return x.astype(np.uint64)
+
+
+def _s(xp, x):
+    return x.astype(np.int64)
+
+
+def add128(xp, ahi, alo, bhi, blo):
+    """(ahi, alo) + (bhi, blo) -> (hi, lo), wrapping at 128 bits."""
+    lo = _u(xp, alo) + _u(xp, blo)
+    carry = (lo < _u(xp, alo)).astype(np.uint64)
+    hi = _u(xp, ahi) + _u(xp, bhi) + carry
+    return _s(xp, hi), _s(xp, lo)
+
+
+def neg128(xp, hi, lo):
+    nlo = _u(xp, ~lo) + _U64(1)
+    carry = (nlo == 0).astype(np.uint64)
+    nhi = _u(xp, ~hi) + carry
+    return _s(xp, nhi), _s(xp, nlo)
+
+
+def cmp_keys(xp, hi, lo):
+    """Sort keys: (hi, lo-as-unsigned-order-in-signed-space). Two-key
+    lexicographic ascending sort == signed 128-bit ascending order."""
+    lo_key = _s(xp, _u(xp, lo) ^ _U64(1 << 63))
+    return hi, lo_key
+
+
+def lt128(xp, ahi, alo, bhi, blo):
+    """signed (ahi,alo) < (bhi,blo)."""
+    alo_k = _u(xp, alo)
+    blo_k = _u(xp, blo)
+    return (ahi < bhi) | ((ahi == bhi) & (alo_k < blo_k))
+
+
+def eq128(xp, ahi, alo, bhi, blo):
+    return (ahi == bhi) & (alo == blo)
+
+
+def _split32(xp, hi, lo):
+    """128-bit -> 4 unsigned 32-bit limbs (as uint64 arrays), LSB first."""
+    lo_u = _u(xp, lo)
+    hi_u = _u(xp, hi)
+    return (lo_u & _MASK32, lo_u >> np.uint64(32),
+            hi_u & _MASK32, hi_u >> np.uint64(32))
+
+
+def _join32(xp, l0, l1, l2, l3):
+    lo = (l0 & _MASK32) | ((l1 & _MASK32) << np.uint64(32))
+    hi = (l2 & _MASK32) | ((l3 & _MASK32) << np.uint64(32))
+    return _s(xp, hi), _s(xp, lo)
+
+
+def _mul_u64(xp, hi, lo, m: int):
+    """(hi, lo) * unsigned 64-bit constant m, wrapping at 128 bits."""
+    m0 = np.uint64(m & 0xFFFFFFFF)
+    m1 = np.uint64((m >> 32) & 0xFFFFFFFF)
+    l0, l1, l2, l3 = _split32(xp, hi, lo)
+    # schoolbook partial products; each limb < 2^32 so products fit u64.
+    # NOTE p[k] can reach ~2^65 conceptually only past column 3, which we
+    # discard (wrap at 128 bits); within kept columns every sum fits u64
+    p0 = l0 * m0
+    p1 = l0 * m1 + l1 * m0
+    p2 = l1 * m1 + l2 * m0
+    p3 = l2 * m1 + l3 * m0
+    cols = [p0 & _MASK32,
+            (p0 >> np.uint64(32)) + (p1 & _MASK32),
+            (p1 >> np.uint64(32)) + (p2 & _MASK32),
+            (p2 >> np.uint64(32)) + (p3 & _MASK32)]
+    res = []
+    carry = np.uint64(0) * l0
+    for k in range(4):
+        acc = cols[k] + carry
+        res.append(acc & _MASK32)
+        carry = acc >> np.uint64(32)
+    return _join32(xp, *res)
+
+
+def mul_pow10(xp, hi, lo, k: int):
+    """(hi, lo) * 10^k, wrapping (caller bounds-checks)."""
+    while k > 0:
+        step = min(k, 19)
+        hi, lo = _mul_u64(xp, hi, lo, 10 ** step)
+        k -= step
+    return hi, lo
+
+
+def _divmod_u32(xp, limbs, d: int):
+    """Unsigned 128-bit (4x32 limbs, LSB first) // uint32 d -> (limbs, rem).
+    Long division, MSB first; remainders stay < 2^32 so each step fits u64."""
+    du = np.uint64(d)
+    q = [None] * 4
+    rem = np.uint64(0) * limbs[0]
+    for k in (3, 2, 1, 0):
+        acc = (rem << np.uint64(32)) | limbs[k]
+        q[k] = acc // du
+        rem = acc % du
+    return q, rem
+
+
+def div_pow10_half_up(xp, hi, lo, k: int):
+    """(hi, lo) / 10^k with HALF_UP rounding on the magnitude (Spark
+    decimal rescale semantics)."""
+    neg = hi < 0
+    mhi, mlo = neg128(xp, hi, lo)
+    mhi = xp.where(neg, mhi, hi)
+    mlo = xp.where(neg, mlo, lo)
+    # HALF_UP on base 10 is decided solely by the MOST significant dropped
+    # digit: drop k-1 digits, then one more capturing that digit
+    limbs = list(_split32(xp, mhi, mlo))
+    if k > 0:
+        # drop k-1 digits, then one more capturing that digit
+        for _ in range(k - 1):
+            limbs, _ = _divmod_u32(xp, limbs, 10)
+        limbs, first_dropped = _divmod_u32(xp, limbs, 10)
+        round_up = first_dropped >= np.uint64(5)
+        qhi, qlo = _join32(xp, *limbs)
+        inc_hi, inc_lo = add128(xp, qhi, qlo,
+                                xp.zeros_like(qhi),
+                                xp.ones_like(qlo))
+        qhi = xp.where(round_up, inc_hi, qhi)
+        qlo = xp.where(round_up, inc_lo, qlo)
+    else:
+        qhi, qlo = _join32(xp, *limbs)
+    nhi, nlo = neg128(xp, qhi, qlo)
+    out_hi = xp.where(neg, nhi, qhi)
+    out_lo = xp.where(neg, nlo, qlo)
+    return out_hi, out_lo
+
+
+def in_bounds(xp, hi, lo, precision: int):
+    """|value| <= 10^precision - 1 (Spark overflow check)."""
+    bound = 10 ** precision - 1
+    bhi, blo = split_int(bound)
+    bhi_a = xp.full(hi.shape, bhi, dtype=np.int64)
+    blo_a = xp.full(hi.shape, blo, dtype=np.int64)
+    neg = hi < 0
+    mhi, mlo = neg128(xp, hi, lo)
+    mhi = xp.where(neg, mhi, hi)
+    mlo = xp.where(neg, mlo, lo)
+    gt = lt128(xp, bhi_a, blo_a, mhi, mlo)
+    return ~gt
+
+
+def widen_operand(xp, v):
+    """A decimal Vec's (hi, lo) limbs: dec128 data is [n,2]; dec64 int64
+    data sign-extends into a high limb."""
+    if v.data.ndim == 2:
+        return v.data[:, 0], v.data[:, 1]
+    lo = v.data.astype(np.int64)
+    hi = xp.where(lo < 0, np.int64(-1), np.int64(0))
+    return hi, lo
+
+
+def pack_limbs(xp, hi, lo):
+    return xp.stack([hi, lo], axis=1)
+
+
+def add_result_type(a, b) -> "T.DecimalType":
+    """Spark decimal +/- result: scale max(s1,s2), precision
+    max(p1-s1, p2-s2) + scale + 1, capped at 38."""
+    s = max(a.scale, b.scale)
+    p = max(a.precision - a.scale, b.precision - b.scale) + s + 1
+    return T.DecimalType(min(p, T.DecimalType.MAX_PRECISION), min(s, 38))
+
+
+def rescale_up(xp, hi, lo, k: int):
+    """Multiply by 10^k (k >= 0) — exact while in bounds."""
+    if k == 0:
+        return hi, lo
+    return mul_pow10(xp, hi, lo, k)
+
+
+def sum_chunks(xp, hi, lo):
+    """128-bit -> three int64 chunks (bits 0:43, 43:86, 86:128-signed) whose
+    independent sums reconstruct the total without carry chains."""
+    lo_u = _u(xp, lo)
+    hi_u = _u(xp, hi)
+    mask43 = np.uint64((1 << 43) - 1)
+    c0 = _s(xp, lo_u & mask43)
+    c1 = _s(xp, ((lo_u >> np.uint64(43)) |
+                 ((hi_u & np.uint64((1 << 22) - 1)) << np.uint64(21)))
+            & mask43)
+    c2 = hi >> np.int64(22)  # arithmetic shift: signed top 42 bits
+    return c0, c1, c2
+
+
+def sum_recombine(xp, s0, s1, s2):
+    """Inverse of sum_chunks after summation: s0 + (s1 << 43) + (s2 << 86)
+    in 128-bit limbs (each s fits int64)."""
+    zero = xp.zeros_like(s0)
+    h0 = xp.where(s0 < 0, np.int64(-1), np.int64(0))
+    acc_hi, acc_lo = h0, s0
+    # s1 << 43 spans bits 43..106
+    s1u = _u(xp, s1)
+    part_lo = _s(xp, s1u << np.uint64(43))
+    part_hi = _s(xp, s1u >> np.uint64(21))
+    # sign-extend the shifted value's high limb for negative s1
+    part_hi = xp.where(s1 < 0, _s(xp, _u(xp, part_hi)
+                                  | (~np.uint64(0) << np.uint64(43))),
+                       part_hi)
+    acc_hi, acc_lo = add128(xp, acc_hi, acc_lo, part_hi, part_lo)
+    # s2 << 86: entirely within the high limb (shift 22)
+    part2_hi = _s(xp, _u(xp, s2) << np.uint64(22))
+    acc_hi, acc_lo = add128(xp, acc_hi, acc_lo, part2_hi, zero)
+    return acc_hi, acc_lo

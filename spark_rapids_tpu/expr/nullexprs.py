@@ -88,7 +88,8 @@ class Coalesce(Expression):
                 lens = xp.where(take_out, out.lengths, v.lengths)
                 out = Vec(out.dtype, data, out.validity | v.validity, lens)
             else:
-                data = xp.where(take_out, out.data, v.data.astype(out.data.dtype))
+                c = take_out if out.data.ndim == 1 else take_out[:, None]
+                data = xp.where(c, out.data, v.data.astype(out.data.dtype))
                 out = Vec(out.dtype, data, out.validity | v.validity)
         return out
 

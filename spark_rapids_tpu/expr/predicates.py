@@ -57,6 +57,9 @@ class BinaryComparison(BinaryExpression):
     def _compute(self, ctx: EvalContext, l: Vec, r: Vec) -> Vec:
         xp = ctx.xp
         validity = and_validity(xp, l.validity, r.validity)
+        if isinstance(l.dtype, T.DecimalType) or \
+                isinstance(r.dtype, T.DecimalType):
+            return Vec(T.BOOLEAN, self._cmp_decimal(xp, l, r), validity)
         if l.is_string:
             data = self._cmp_string(xp, l, r)
         elif T.is_numeric(l.dtype) or T.is_numeric(r.dtype):
@@ -73,11 +76,35 @@ class BinaryComparison(BinaryExpression):
     def _cmp_float(self, xp, a, b):
         return self._cmp(xp, a, b)
 
+    def _cmp_decimal(self, xp, l: Vec, r: Vec):
+        """Decimal comparison after rescaling both sides to the common
+        scale; wide operands compare via 128-bit limb order."""
+        from .decimal128 import lt128, eq128, rescale_up, widen_operand
+        if not (isinstance(l.dtype, T.DecimalType) and
+                isinstance(r.dtype, T.DecimalType)):
+            raise NotImplementedError(
+                "decimal vs non-decimal comparison needs an explicit cast")
+        s = max(l.dtype.scale, r.dtype.scale)
+        lhi, llo = widen_operand(xp, l)
+        rhi, rlo = widen_operand(xp, r)
+        lhi, llo = rescale_up(xp, lhi, llo, s - l.dtype.scale)
+        rhi, rlo = rescale_up(xp, rhi, rlo, s - r.dtype.scale)
+        lt = lt128(xp, lhi, llo, rhi, rlo)
+        gt = lt128(xp, rhi, rlo, lhi, llo)
+        eq = eq128(xp, lhi, llo, rhi, rlo)
+        return self._from_ordering(xp, lt, gt, eq)
+
+    def _from_ordering(self, xp, lt, gt, eq):
+        raise NotImplementedError
+
     def _cmp_string(self, xp, l, r):
         raise NotImplementedError
 
 
 class EqualTo(BinaryComparison):
+    def _from_ordering(self, xp, lt, gt, eq):
+        return eq
+
     def _cmp(self, xp, a, b):
         return a == b
 
@@ -89,6 +116,9 @@ class EqualTo(BinaryComparison):
 
 
 class LessThan(BinaryComparison):
+    def _from_ordering(self, xp, lt, gt, eq):
+        return lt
+
     def _cmp(self, xp, a, b):
         return a < b
 
@@ -100,6 +130,9 @@ class LessThan(BinaryComparison):
 
 
 class LessThanOrEqual(BinaryComparison):
+    def _from_ordering(self, xp, lt, gt, eq):
+        return lt | eq
+
     def _cmp(self, xp, a, b):
         return a <= b
 
@@ -111,6 +144,9 @@ class LessThanOrEqual(BinaryComparison):
 
 
 class GreaterThan(BinaryComparison):
+    def _from_ordering(self, xp, lt, gt, eq):
+        return gt
+
     def _cmp(self, xp, a, b):
         return a > b
 
@@ -122,6 +158,9 @@ class GreaterThan(BinaryComparison):
 
 
 class GreaterThanOrEqual(BinaryComparison):
+    def _from_ordering(self, xp, lt, gt, eq):
+        return gt | eq
+
     def _cmp(self, xp, a, b):
         return a >= b
 

@@ -61,6 +61,14 @@ def sort_keys_for(xp, v: Vec, ascending: bool, nulls_first: bool) -> List:
             keys.extend(np.uint8(255) - v.data[:, b]
                         for b in range(v.data.shape[1]))
             keys.append(~lens)
+    elif isinstance(dt, T.DecimalType) and \
+            dt.precision > T.DecimalType.MAX_LONG_DIGITS:
+        from ..expr.decimal128 import cmp_keys
+        hi_k, lo_k = cmp_keys(xp, v.data[:, 0], v.data[:, 1])
+        if ascending:
+            keys.extend([hi_k, lo_k])
+        else:
+            keys.extend([~hi_k, ~lo_k])
     elif T.is_floating(dt):
         nan = xp.isnan(v.data)
         zero = dt.np_dtype.type(0)
@@ -111,6 +119,8 @@ def key_change_flags(xp, key_vecs: Sequence[Vec], n: int):
             d = v.data
             neq = xp.any(d[1:] != d[:-1], axis=1) | \
                 (v.lengths[1:] != v.lengths[:-1])
+        elif v.data.ndim == 2:  # decimal128 limb pairs
+            neq = xp.any(v.data[1:] != v.data[:-1], axis=1)
         else:
             neq = v.data[1:] != v.data[:-1]
             if np.issubdtype(np.dtype(v.data.dtype), np.floating):

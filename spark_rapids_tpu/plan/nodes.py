@@ -409,6 +409,45 @@ def _cpu_agg(func: AggregateFunction, ctx, b: HostBatch, gid, ng) -> Vec:
         from ..cpu.hostbatch import host_vec_from_arrow
         return host_vec_from_arrow(
             pa.array(rows, type=T.to_arrow(func.data_type)))
+    if name == "Sum" and isinstance(out_t, T.DecimalType) and (
+            out_t.precision > T.DecimalType.MAX_LONG_DIGITS or
+            v.data.ndim == 2):
+        # decimal128 oracle: exact python-int accumulation
+        from ..expr.decimal128 import join_int, split_int
+        sums = [0] * ng
+        for i in np.nonzero(v.validity)[0]:
+            if v.data.ndim == 2:
+                sums[gid[i]] += join_int(int(v.data[i, 0]),
+                                         int(v.data[i, 1]))
+            else:
+                sums[gid[i]] += int(v.data[i])
+        bound = 10 ** out_t.precision - 1
+        ok = np.array([abs(s) <= bound for s in sums])
+        if out_t.precision > T.DecimalType.MAX_LONG_DIGITS:
+            limbs = np.zeros((ng, 2), np.int64)
+            for g, s in enumerate(sums):
+                if ok[g]:
+                    limbs[g] = split_int(s)
+            return Vec(out_t, limbs, valid_any & ok)
+        return Vec(out_t, np.array([s if o else 0
+                                    for s, o in zip(sums, ok)], np.int64),
+                   valid_any & ok)
+    if name in ("Min", "Max") and v.data.ndim == 2 and not v.is_string:
+        from ..expr.decimal128 import join_int, split_int
+        best = [None] * ng
+        for i in np.nonzero(v.validity)[0]:
+            x = join_int(int(v.data[i, 0]), int(v.data[i, 1]))
+            g = gid[i]
+            if best[g] is None or (x < best[g] if name == "Min"
+                                   else x > best[g]):
+                best[g] = x
+        limbs = np.zeros((ng, 2), np.int64)
+        has = np.zeros(ng, bool)
+        for g, x in enumerate(best):
+            if x is not None:
+                has[g] = True
+                limbs[g] = split_int(x)
+        return Vec(v.dtype, limbs, has)
     if name in ("Sum", "Average"):
         acc_t = np.float64 if T.is_floating(v.dtype) or name == "Average" \
             else np.int64

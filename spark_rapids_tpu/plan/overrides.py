@@ -99,20 +99,24 @@ def _ansi_context_tag(label, exprs_of):
 
 
 _basic = TypeSig.all_basic()
+_basic38 = TypeSig.all_basic(decimal_max=38)
+_nested38 = TypeSig.all_with_nested(decimal_max=38)
 _num = TypeSig.numeric()
+_num38 = TypeSig.numeric(decimal_max=38)
 _bool = TypeSig((T.BooleanType,))
 _str = TypeSig((T.StringType,))
 _int = TypeSig((T.IntegerType,))
 _dbl = TypeSig((T.DoubleType,))
 
 for cls in (EB.Literal, EB.AttributeReference, EB.BoundReference, EB.Alias):
-    expr_rule(cls, TypeSig.all_with_nested())
-for cls in (EA.Add, EA.Subtract, EA.Multiply):
-    expr_rule(cls, _num)
+    expr_rule(cls, _nested38)
+for cls in (EA.Add, EA.Subtract):
+    expr_rule(cls, _num38)  # decimal +/- via 128-bit limb kernels
+expr_rule(EA.Multiply, _num)
 for cls in (EA.Divide, EA.IntegralDivide, EA.Remainder, EA.Pmod):
     expr_rule(cls, _num)
 for cls in (EA.UnaryMinus, EA.Abs):
-    expr_rule(cls, _num)
+    expr_rule(cls, _num38)
 for cls in (EP.EqualTo, EP.EqualNullSafe, EP.LessThan, EP.LessThanOrEqual,
             EP.GreaterThan, EP.GreaterThanOrEqual):
     expr_rule(cls, _bool)
@@ -120,8 +124,9 @@ for cls in (EP.And, EP.Or, EP.Not, EP.In):
     expr_rule(cls, _bool)
 for cls in (EN.IsNull, EN.IsNotNull, EN.IsNaN):
     expr_rule(cls, _bool)
-for cls in (EN.Coalesce, EN.NaNvl, ECO.If, ECO.CaseWhen, ECO.Least,
-            ECO.Greatest):
+for cls in (EN.Coalesce, ECO.If, ECO.CaseWhen):
+    expr_rule(cls, _basic38)
+for cls in (EN.NaNvl, ECO.Least, ECO.Greatest):
     expr_rule(cls, _basic)
 for cls in (EM.Sqrt, EM.Exp, EM.Log, EM.Log10, EM.Log2, EM.Pow, EM.Signum,
             EM.Sin, EM.Cos, EM.Tan, EM.Asin, EM.Acos, EM.Atan, EM.Sinh,
@@ -152,7 +157,7 @@ expr_rule(ED.DateAdd, TypeSig((T.DateType,)))
 expr_rule(ED.DateSub, TypeSig((T.DateType,)))
 expr_rule(ED.UnixTimestampFromTs, TypeSig((T.LongType,)))
 expr_rule(EH.Murmur3Hash, _int)
-expr_rule(EC.Cast, _basic, tag_fn=_tag_cast)
+expr_rule(EC.Cast, _basic38, tag_fn=_tag_cast)
 
 # collection / nested-type expressions (complexTypeExtractors.scala,
 # complexTypeCreator.scala, collectionOperations.scala)
@@ -280,7 +285,7 @@ expr_rule(ED.MonthsBetween, _dbl)
 expr_rule(ED.TruncDate, TypeSig((T.DateType,)))
 expr_rule(ED.NextDay, TypeSig((T.DateType,)))
 for cls in (Sum, Count, Min, Max, Average, First, Last):
-    expr_rule(cls, _basic)
+    expr_rule(cls, _basic38)
 
 from ..expr.aggregates import (ApproximatePercentile, CollectList,  # noqa: E402
                                CollectSet, StddevPop, StddevSamp, VariancePop,
@@ -852,10 +857,10 @@ _register_file_scan_rules = _lazy_rule_group(
     _do_register_file_scans)
 
 
-exec_rule(N.CpuScanExec, TypeSig.all_with_nested(), _c_scan)
-exec_rule(N.CpuProjectExec, TypeSig.all_with_nested(), _c_project,
+exec_rule(N.CpuScanExec, _nested38, _c_scan)
+exec_rule(N.CpuProjectExec, _nested38, _c_project,
           expr_fn=_exprs_project)
-exec_rule(N.CpuFilterExec, TypeSig.all_with_nested(), _c_filter,
+exec_rule(N.CpuFilterExec, _nested38, _c_filter,
           expr_fn=_exprs_filter)
 _agg_ansi = _ansi_context_tag(
     "aggregation", lambda p: list(p._bound_groups) +
@@ -893,17 +898,17 @@ def _tag_agg(m: PlanMeta) -> None:
             pass
 
 
-exec_rule(N.CpuHashAggregateExec, TypeSig.all_with_nested(), _c_agg,
+exec_rule(N.CpuHashAggregateExec, _nested38, _c_agg,
           expr_fn=_exprs_agg, tag_fn=_tag_agg)
 exec_rule(N.CpuHashJoinExec, TypeSig.all_with_nested(), _c_join,
           tag_fn=_tag_join, expr_fn=_exprs_join)
 _sort_ansi = _ansi_context_tag("sort keys",
                                lambda p: [e for e, _, _ in p._bound])
-exec_rule(N.CpuSortExec, TypeSig.orderable(), _c_sort, expr_fn=_exprs_sort,
+exec_rule(N.CpuSortExec, TypeSig.orderable(decimal_max=38), _c_sort, expr_fn=_exprs_sort,
           tag_fn=_sort_ansi)
-exec_rule(N.CpuLimitExec, TypeSig.all_with_nested(), _c_limit)
-exec_rule(N.CpuSampleExec, TypeSig.all_with_nested(), _c_sample)
-exec_rule(N.CpuUnionExec, TypeSig.all_with_nested(), _c_union)
+exec_rule(N.CpuLimitExec, _nested38, _c_limit)
+exec_rule(N.CpuSampleExec, _nested38, _c_sample)
+exec_rule(N.CpuUnionExec, _nested38, _c_union)
 _gen_ansi = _ansi_context_tag("generate", lambda p: [p._bound])
 exec_rule(N.CpuGenerateExec, TypeSig.all_with_nested(), _c_generate,
           expr_fn=_exprs_generate, tag_fn=_gen_ansi)

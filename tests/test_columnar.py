@@ -120,8 +120,18 @@ def test_string_width_limit():
         from_arrow(pa.array(["x" * (limit + 1)]))
 
 
-def test_unsupported_scalar_type_message():
+def test_wide_decimal_now_device_backed():
+    # precision > 18 rides the two-limb [n, 2] representation
     from decimal import Decimal
-    arr = pa.array([Decimal("1")], type=pa.decimal128(20, 2))
-    with pytest.raises(TypeError, match="wide decimal"):
+    arr = pa.array([Decimal("123456789012345678.90"), None],
+                   type=pa.decimal128(20, 2))
+    col, n = from_arrow(arr)
+    assert col.data.shape[1] == 2
+    from spark_rapids_tpu.columnar.column import to_arrow
+    assert to_arrow(col, n).equals(arr)
+
+
+def test_unsupported_scalar_type_message():
+    arr = pa.array([b"ab"], type=pa.binary())
+    with pytest.raises(TypeError, match="binary"):
         from_arrow(arr)

@@ -1,0 +1,166 @@
+"""Decimal128 (precision > 18) tests — two-limb device representation vs
+the CPU engine and python-Decimal hand oracles (reference:
+decimalExpressions.scala + spark-rapids-jni decimal128 kernels)."""
+
+import decimal
+import random
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+decimal.getcontext().prec = 60
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr import (Cast, Count, First, Last, Max, Min, Sum,
+                                   col, lit)
+from spark_rapids_tpu.plugin import TpuSession
+
+from test_queries import assert_same
+
+D = decimal.Decimal
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession({"spark.rapids.sql.enabled": True,
+                       "spark.rapids.sql.explain": "NONE"})
+
+
+def dec_table(seed=3, n=400, digits=30, scale=3, null_frac=0.1):
+    rnd = random.Random(seed)
+    vals = [None if rnd.random() < null_frac else
+            D(rnd.randint(-(10 ** digits), 10 ** digits)).scaleb(-scale)
+            for _ in range(n)]
+    return pa.table({
+        "d": pa.array(vals, type=pa.decimal128(digits + scale, scale)),
+        "g": pa.array([i % 7 for i in range(n)], type=pa.int32()),
+        "i": pa.array(range(n), type=pa.int64()),
+    }), vals
+
+
+class TestDecimal128:
+    def test_roundtrip_and_placement(self, session):
+        t, _ = dec_table()
+        df = session.from_arrow(t)
+        q = df.select("i", "d")
+        assert "not supported" not in q.explain()  # runs ON device
+        out = assert_same(q, sort_by=["i"])
+        assert out.column("d").to_pylist() == t.column("d").to_pylist()
+
+    def test_group_aggregates_vs_python(self, session):
+        t, vals = dec_table()
+        df = session.from_arrow(t)
+        q = df.group_by("g").agg(s=Sum(col("d")), mn=Min(col("d")),
+                                 mx=Max(col("d")), c=Count(col("d")))
+        out = assert_same(q, sort_by=["g"])
+        rows = out.sort_by([("g", "ascending")]).to_pylist()
+        for g in range(7):
+            sel = [v for i, v in enumerate(vals) if i % 7 == g
+                   and v is not None]
+            assert rows[g]["s"] == sum(sel)
+            assert rows[g]["mn"] == min(sel)
+            assert rows[g]["mx"] == max(sel)
+            assert rows[g]["c"] == len(sel)
+
+    def test_add_subtract_overflow_null(self, session):
+        big = D(10 ** 37)
+        t = pa.table({"a": pa.array([big, -big, D(1)],
+                                    type=pa.decimal128(38, 0)),
+                      "b": pa.array([big, -big, D(2)],
+                                    type=pa.decimal128(38, 0))})
+        df = session.from_arrow(t)
+        q = df.select(s=col("a") + col("b"), d=col("a") - col("b"))
+        out = assert_same(q)
+        got = sorted(out.column("s").to_pylist(), key=str)
+        # 2e37 fits in precision 38; 1+2=3 fits
+        assert D(2 * 10 ** 37) in got and D(-2 * 10 ** 37) in got
+        assert D(3) in got
+
+    def test_mixed_scale_add(self, session):
+        t = pa.table({
+            "a": pa.array([D("1.50"), D("-2.25")],
+                          type=pa.decimal128(25, 2)),
+            "b": pa.array([D("0.125"), D("10.000")],
+                          type=pa.decimal128(30, 3)),
+        })
+        df = session.from_arrow(t)
+        out = assert_same(df.select(s=col("a") + col("b")))
+        assert sorted(out.column("s").to_pylist()) == [D("1.625"),
+                                                      D("7.750")]
+
+    def test_comparisons_and_filter(self, session):
+        t, vals = dec_table(seed=9)
+        df = session.from_arrow(t)
+        zero = lit(D(0), T.DecimalType(33, 3))
+        q = df.filter(col("d") > zero)
+        want = sum(1 for v in vals if v is not None and v > 0)
+        assert q.collect().num_rows == q.collect_cpu().num_rows == want
+
+    def test_sort_order(self, session):
+        t, vals = dec_table(seed=5, n=200)
+        df = session.from_arrow(t)
+        out = df.select("d", "i").sort("d").collect()
+        got = [v for v in out.column("d").to_pylist() if v is not None]
+        assert got == sorted(got)
+
+    def test_rescale_casts_half_up(self, session):
+        vals = [D("1.235"), D("-1.235"), D("99999999999999999999999.995"),
+                D("0.004"), None]
+        t = pa.table({"d": pa.array(vals, type=pa.decimal128(26, 3))})
+        df = session.from_arrow(t)
+        q = df.select(up=Cast(col("d"), T.DecimalType(30, 5)),
+                      down=Cast(col("d"), T.DecimalType(26, 2)))
+        out = assert_same(q)
+        ups = out.column("up").to_pylist()
+        downs = out.column("down").to_pylist()
+        for v, u, dn in zip(vals, ups, downs):
+            if v is None:
+                assert u is None and dn is None
+                continue
+            assert u == v.quantize(D("0.00001"))
+            assert dn == v.quantize(D("0.01"),
+                                    rounding=decimal.ROUND_HALF_UP)
+
+    def test_cast_overflow_to_narrow_null(self, session):
+        t = pa.table({"d": pa.array([D(10 ** 25), D(5)],
+                                    type=pa.decimal128(30, 0))})
+        df = session.from_arrow(t)
+        out = assert_same(df.select(x=Cast(col("d"), T.DecimalType(20, 1))))
+        got = out.column("x").to_pylist()
+        assert None in got and D("5.0") in got
+
+    def test_sum_widens_to_128(self, session):
+        # dec64 input whose SUM type is decimal(28) -> limb accumulation
+        rnd = random.Random(11)
+        vals = [D(rnd.randint(-(10 ** 17), 10 ** 17)) for _ in range(500)]
+        t = pa.table({"d": pa.array(vals, type=pa.decimal128(18, 0)),
+                      "g": pa.array([0] * 500, type=pa.int32())})
+        df = session.from_arrow(t)
+        out = assert_same(df.group_by("g").agg(s=Sum(col("d"))))
+        assert out.column("s").to_pylist() == [sum(vals)]
+
+    def test_first_last_if_coalesce(self, session):
+        from spark_rapids_tpu.expr import Coalesce, If
+        t, vals = dec_table(seed=7, n=100)
+        df = session.from_arrow(t)
+        zero = lit(D(0), T.DecimalType(33, 3))
+        q = df.select("i", c=Coalesce(col("d"), zero),
+                      f=If(col("d") > zero, col("d"), zero))
+        assert_same(q, sort_by=["i"])
+
+    def test_distributed_dec128_agg(self):
+        s = TpuSession({"spark.rapids.sql.enabled": True,
+                        "spark.rapids.sql.explain": "NONE",
+                        "spark.rapids.shuffle.mode": "ICI",
+                        "spark.rapids.tpu.mesh.shape": "shuffle=8",
+                        "spark.rapids.sql.autoBroadcastJoinThreshold": -1})
+        t, vals = dec_table(seed=13, n=600)
+        df = s.from_arrow(t)
+        q = df.group_by("g").agg(sm=Sum(col("d")), mn=Min(col("d")))
+        out = assert_same(q, sort_by=["g"])
+        rows = out.sort_by([("g", "ascending")]).to_pylist()
+        for g in range(7):
+            sel = [v for i, v in enumerate(vals) if i % 7 == g
+                   and v is not None]
+            assert rows[g]["sm"] == sum(sel)
