@@ -74,21 +74,46 @@ class BinaryArithmetic(BinaryExpression):
         return T.numeric_promote(lt, rt)
 
     def _decimal_addsub(self, ctx: EvalContext, l: Vec, r: Vec) -> Vec:
-        """Decimal +/- via 128-bit limbs: rescale to the result scale, add
-        (negating the rhs for subtract), overflow -> null (non-ANSI) or
-        raise (ANSI), matching Spark's checked decimal arithmetic."""
+        """Decimal +/- computed EXACTLY in 256-bit limbs (the JVM uses
+        unbounded BigDecimal intermediates): rescale both operands to the
+        max input scale, add (negating the rhs for subtract), HALF_UP
+        round down to the adjusted result scale, then overflow -> null
+        (non-ANSI) or raise (ANSI). The wide intermediate is what makes
+        the rescale exact — a 128-bit rescale can wrap back into bounds
+        and return silently wrong values."""
         from .decimal128 import (add128, in_bounds, is_dec128, neg128,
-                                 pack_limbs, rescale_up, widen_operand)
+                                 pack_limbs, rescale_up, wide_add,
+                                 wide_div_pow10_half_up, wide_from128,
+                                 wide_mul_pow10, wide_neg, wide_to128,
+                                 widen_operand)
         xp = ctx.xp
         out_t = self.data_type
+        s_max = max(l.dtype.scale, r.dtype.scale)
+        k_l = s_max - l.dtype.scale
+        k_r = s_max - r.dtype.scale
         lhi, llo = widen_operand(xp, l)
         rhi, rlo = widen_operand(xp, r)
-        lhi, llo = rescale_up(xp, lhi, llo, out_t.scale - l.dtype.scale)
-        rhi, rlo = rescale_up(xp, rhi, rlo, out_t.scale - r.dtype.scale)
-        if isinstance(self, Subtract):
-            rhi, rlo = neg128(xp, rhi, rlo)
-        hi, lo = add128(xp, lhi, llo, rhi, rlo)
-        ok = in_bounds(xp, hi, lo, out_t.precision)
+        if out_t.scale == s_max and l.dtype.precision + k_l <= 38 \
+                and r.dtype.precision + k_r <= 38:
+            # 128-bit fast path (the common case): rescaled operands stay
+            # < 10^38 so the pow10 multiply cannot wrap, and a SUM that
+            # wraps 2^127 lands at magnitude >= 2^128 - 2*10^38 > 10^38,
+            # which in_bounds rejects — exact without the 8-limb chain
+            lhi, llo = rescale_up(xp, lhi, llo, k_l)
+            rhi, rlo = rescale_up(xp, rhi, rlo, k_r)
+            if isinstance(self, Subtract):
+                rhi, rlo = neg128(xp, rhi, rlo)
+            hi, lo = add128(xp, lhi, llo, rhi, rlo)
+            ok = in_bounds(xp, hi, lo, out_t.precision)
+        else:
+            wl = wide_mul_pow10(xp, wide_from128(xp, lhi, llo), k_l)
+            wr = wide_mul_pow10(xp, wide_from128(xp, rhi, rlo), k_r)
+            if isinstance(self, Subtract):
+                wr = wide_neg(xp, wr)
+            ws = wide_add(xp, wl, wr)
+            ws = wide_div_pow10_half_up(xp, ws, s_max - out_t.scale)
+            hi, lo, fits = wide_to128(xp, ws)
+            ok = fits & in_bounds(xp, hi, lo, out_t.precision)
         validity = and_validity(xp, l.validity, r.validity)
         if ctx.ansi:
             ansi_raise(ctx, ~ok & validity, _overflow_msg(out_t))

@@ -392,7 +392,12 @@ def _decimal_cast(xp, c: Vec, dst: T.DataType, ansi: bool) -> Vec:
         shift = dst.scale - src.scale
         a = c.data.astype(np.int64)
         if shift >= 0:
-            scaled = a * (10 ** shift)
+            # bound-check BEFORE the multiply: int64 wrap could alias back
+            # under the post-hoc limit check (same hazard as dec128)
+            head = 10 ** max(dst.precision - shift, 0)
+            ok = xp.abs(a) < head
+            scaled = xp.where(ok, a, 0) * (10 ** shift)
+            return Vec(dst, scaled, c.validity & ok)
         else:
             p = 10 ** (-shift)
             # HALF_UP rescale
@@ -404,9 +409,13 @@ def _decimal_cast(xp, c: Vec, dst: T.DataType, ansi: bool) -> Vec:
         validity = c.validity & (xp.abs(scaled) < limit)
         return Vec(dst, scaled, validity)
     if isinstance(dst, T.DecimalType):  # integral -> decimal
-        a = c.data.astype(np.int64) * (10 ** dst.scale)
-        limit = 10 ** dst.precision
-        return Vec(dst, a, c.validity & (xp.abs(a) < limit))
+        a = c.data.astype(np.int64)
+        # bound-check BEFORE the multiply (int64 wrap aliasing); abs of
+        # int64-min wraps negative, so reject it explicitly
+        head = 10 ** max(dst.precision - dst.scale, 0)
+        ok = (xp.abs(a) < head) & (a != np.int64(-2 ** 63))
+        scaled = xp.where(ok, a, 0) * (10 ** dst.scale)
+        return Vec(dst, scaled, c.validity & ok)
     # decimal -> numeric
     a = c.data.astype(np.float64) / (10 ** src.scale)
     if T.is_floating(dst):
@@ -421,24 +430,32 @@ def _decimal128_cast(xp, c: Vec, dst: T.DataType) -> Vec:
     """Casts touching a >18-digit decimal: rescale via limb pow10 mul/div
     (HALF_UP), overflow -> null; integral sources widen through limbs."""
     from .decimal128 import (div_pow10_half_up, in_bounds, is_dec128,
-                             pack_limbs, rescale_up, widen_operand)
+                             pack_limbs, wide_from128, wide_mul_pow10,
+                             wide_to128, widen_operand)
     src = c.dtype
     if isinstance(src, T.DecimalType) and isinstance(dst, T.DecimalType):
         hi, lo = widen_operand(xp, c)
         shift = dst.scale - src.scale
+        fits = None
         if shift >= 0:
-            hi, lo = rescale_up(xp, hi, lo, shift)
+            # exact 256-bit upscale: a 128-bit pow10 multiply can wrap
+            # back into bounds and pass the precision check (advisor)
+            w = wide_mul_pow10(xp, wide_from128(xp, hi, lo), shift)
+            hi, lo, fits = wide_to128(xp, w)
         else:
             hi, lo = div_pow10_half_up(xp, hi, lo, -shift)
         ok = in_bounds(xp, hi, lo, dst.precision)
+        if fits is not None:
+            ok = ok & fits
         if is_dec128(dst):
             return Vec(dst, pack_limbs(xp, hi, lo), c.validity & ok)
         return Vec(dst, lo.astype(np.int64), c.validity & ok)
     if isinstance(dst, T.DecimalType):  # integral -> decimal128
         lo = c.data.astype(np.int64)
         hi = xp.where(lo < 0, np.int64(-1), np.int64(0))
-        hi, lo = rescale_up(xp, hi, lo, dst.scale)
-        ok = in_bounds(xp, hi, lo, dst.precision)
+        w = wide_mul_pow10(xp, wide_from128(xp, hi, lo), dst.scale)
+        hi, lo, fits = wide_to128(xp, w)
+        ok = fits & in_bounds(xp, hi, lo, dst.precision)
         return Vec(dst, pack_limbs(xp, hi, lo), c.validity & ok)
     # decimal128 -> numeric: via float64 (lossy, same contract as dec64)
     hi, lo = widen_operand(xp, c)

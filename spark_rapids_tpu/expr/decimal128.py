@@ -196,7 +196,8 @@ def in_bounds(xp, hi, lo, precision: int):
     mhi, mlo = neg128(xp, hi, lo)
     mhi = xp.where(neg, mhi, hi)
     mlo = xp.where(neg, mlo, lo)
-    gt = lt128(xp, bhi_a, blo_a, mhi, mlo)
+    # -2^127 is its own negation: magnitude stays negative -> out of bounds
+    gt = lt128(xp, bhi_a, blo_a, mhi, mlo) | (mhi < 0)
     return ~gt
 
 
@@ -214,19 +215,150 @@ def pack_limbs(xp, hi, lo):
     return xp.stack([hi, lo], axis=1)
 
 
+def adjust_precision_scale(p: int, s: int) -> "T.DecimalType":
+    """Spark DecimalType.adjustPrecisionScale (allowPrecisionLoss=true,
+    `DecimalType.scala`): when the ideal precision exceeds 38, keep the
+    integral digits and give fractional digits whatever is left, but never
+    fewer than min(s, 6)."""
+    if p <= T.DecimalType.MAX_PRECISION:
+        return T.DecimalType(p, s)
+    int_digits = p - s
+    min_scale = min(s, 6)
+    adjusted = max(T.DecimalType.MAX_PRECISION - int_digits, min_scale)
+    return T.DecimalType(T.DecimalType.MAX_PRECISION, adjusted)
+
+
 def add_result_type(a, b) -> "T.DecimalType":
-    """Spark decimal +/- result: scale max(s1,s2), precision
-    max(p1-s1, p2-s2) + scale + 1, capped at 38."""
+    """Spark decimal +/- result: ideal scale max(s1,s2), ideal precision
+    max(p1-s1, p2-s2) + scale + 1, then adjustPrecisionScale."""
     s = max(a.scale, b.scale)
     p = max(a.precision - a.scale, b.precision - b.scale) + s + 1
-    return T.DecimalType(min(p, T.DecimalType.MAX_PRECISION), min(s, 38))
+    return adjust_precision_scale(p, s)
 
 
 def rescale_up(xp, hi, lo, k: int):
-    """Multiply by 10^k (k >= 0) — exact while in bounds."""
+    """Multiply by 10^k (k >= 0), WRAPPING at 128 bits. Callers must prove
+    no wrap (operand precision + k <= 38) or use the wide_* 256-bit path —
+    an unguarded call can alias out-of-range values back into bounds."""
     if k == 0:
         return hi, lo
     return mul_pow10(xp, hi, lo, k)
+
+
+# ---------------------------------------------------------------------------
+# 256-bit "wide" arithmetic: 8 x 32-bit limbs (LSB first, each held in a
+# uint64 array so every partial product / carry fits the lane). The JVM
+# computes decimal intermediates in unbounded BigDecimal; rescaling a
+# 38-digit value by up to 38 more digits needs up to ~10^76 < 2^253, so a
+# 256-bit two's-complement intermediate makes add/sub/cast/compare EXACT,
+# with overflow detected on the narrowing back to 128 bits instead of
+# silently wrapping (round-2 advisor finding).
+# ---------------------------------------------------------------------------
+
+_WIDE_N = 8
+
+
+def wide_from128(xp, hi, lo):
+    """Sign-extend a 128-bit (hi, lo) value into 8 u32 limbs."""
+    l0, l1, l2, l3 = _split32(xp, hi, lo)
+    ext = xp.where(hi < 0, _MASK32, np.uint64(0))
+    return [l0, l1, l2, l3, ext, ext, ext, ext]
+
+
+def wide_add(xp, a, b):
+    out = []
+    carry = xp.zeros_like(a[0])
+    for k in range(_WIDE_N):
+        acc = a[k] + b[k] + carry
+        out.append(acc & _MASK32)
+        carry = acc >> np.uint64(32)
+    return out
+
+
+def wide_neg(xp, a):
+    out = []
+    carry = xp.ones_like(a[0])
+    for k in range(_WIDE_N):
+        acc = (~a[k] & _MASK32) + carry
+        out.append(acc & _MASK32)
+        carry = acc >> np.uint64(32)
+    return out
+
+
+def wide_is_neg(xp, a):
+    return (a[_WIDE_N - 1] >> np.uint64(31)) != 0
+
+
+def _wide_mul_small(xp, a, m: int):
+    """a * m for m < 2^32, wrapping at 256 bits."""
+    mu = np.uint64(m)
+    out = []
+    carry = xp.zeros_like(a[0])
+    for k in range(_WIDE_N):
+        acc = a[k] * mu + carry
+        out.append(acc & _MASK32)
+        carry = acc >> np.uint64(32)
+    return out
+
+
+def wide_mul_pow10(xp, a, k: int):
+    """a * 10^k in steps of 10^9 (each step's multiplier fits u32)."""
+    while k > 0:
+        step = min(k, 9)
+        a = _wide_mul_small(xp, a, 10 ** step)
+        k -= step
+    return a
+
+
+def _wide_divmod_small(xp, a, d: int):
+    """Unsigned a // d (d < 2^32) via MSB-first long division."""
+    du = np.uint64(d)
+    q = [None] * _WIDE_N
+    rem = xp.zeros_like(a[0])
+    for k in range(_WIDE_N - 1, -1, -1):
+        acc = (rem << np.uint64(32)) | a[k]
+        q[k] = acc // du
+        rem = acc % du
+    return q, rem
+
+
+def wide_div_pow10_half_up(xp, a, k: int):
+    """a / 10^k with HALF_UP rounding on the magnitude (Spark rescale)."""
+    if k <= 0:
+        return a
+    neg = wide_is_neg(xp, a)
+    mag = wide_neg(xp, a)
+    mag = [xp.where(neg, m, v) for m, v in zip(mag, a)]
+    drop = k - 1
+    while drop > 0:  # drop all but the most significant discarded digit
+        step = min(drop, 9)
+        mag, _ = _wide_divmod_small(xp, mag, 10 ** step)
+        drop -= step
+    mag, first_dropped = _wide_divmod_small(xp, mag, 10)
+    round_up = first_dropped >= np.uint64(5)
+    one = [xp.where(round_up, np.uint64(1), np.uint64(0))] + \
+        [xp.zeros_like(mag[0])] * (_WIDE_N - 1)
+    mag = wide_add(xp, mag, one)
+    nmag = wide_neg(xp, mag)
+    return [xp.where(neg, n, m) for n, m in zip(nmag, mag)]
+
+
+def wide_to128(xp, a):
+    """Narrow to 128 bits: (hi, lo, fits) where fits is False on rows whose
+    value does not fit a signed 128-bit integer."""
+    hi, lo = _join32(xp, a[0], a[1], a[2], a[3])
+    ext = xp.where(hi < 0, _MASK32, np.uint64(0))
+    fits = (a[4] == ext) & (a[5] == ext) & (a[6] == ext) & (a[7] == ext)
+    return hi, lo, fits
+
+
+def wide_cmp(xp, a, b):
+    """(lt, eq) for signed 256-bit operands."""
+    diff = wide_add(xp, a, wide_neg(xp, b))
+    eq = (a[0] == b[0])
+    for k in range(1, _WIDE_N):
+        eq = eq & (a[k] == b[k])
+    return wide_is_neg(xp, diff), eq
 
 
 def sum_chunks(xp, hi, lo):

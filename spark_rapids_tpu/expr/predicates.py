@@ -79,19 +79,32 @@ class BinaryComparison(BinaryExpression):
     def _cmp_decimal(self, xp, l: Vec, r: Vec):
         """Decimal comparison after rescaling both sides to the common
         scale; wide operands compare via 128-bit limb order."""
-        from .decimal128 import lt128, eq128, rescale_up, widen_operand
+        from .decimal128 import (eq128, lt128, rescale_up, wide_cmp,
+                                 wide_from128, wide_mul_pow10,
+                                 widen_operand)
         if not (isinstance(l.dtype, T.DecimalType) and
                 isinstance(r.dtype, T.DecimalType)):
             raise NotImplementedError(
                 "decimal vs non-decimal comparison needs an explicit cast")
         s = max(l.dtype.scale, r.dtype.scale)
+        k_l = s - l.dtype.scale
+        k_r = s - r.dtype.scale
         lhi, llo = widen_operand(xp, l)
         rhi, rlo = widen_operand(xp, r)
-        lhi, llo = rescale_up(xp, lhi, llo, s - l.dtype.scale)
-        rhi, rlo = rescale_up(xp, rhi, rlo, s - r.dtype.scale)
-        lt = lt128(xp, lhi, llo, rhi, rlo)
-        gt = lt128(xp, rhi, rlo, lhi, llo)
-        eq = eq128(xp, lhi, llo, rhi, rlo)
+        if l.dtype.precision + k_l <= 38 and r.dtype.precision + k_r <= 38:
+            # 128-bit fast path: rescaled operands provably fit, no wrap
+            lhi, llo = rescale_up(xp, lhi, llo, k_l)
+            rhi, rlo = rescale_up(xp, rhi, rlo, k_r)
+            lt = lt128(xp, lhi, llo, rhi, rlo)
+            gt = lt128(xp, rhi, rlo, lhi, llo)
+            eq = eq128(xp, lhi, llo, rhi, rlo)
+        else:
+            # exact 256-bit compare: a 128-bit rescale of a 38-digit
+            # operand wraps and misorders (advisor wrap hazard)
+            wl = wide_mul_pow10(xp, wide_from128(xp, lhi, llo), k_l)
+            wr = wide_mul_pow10(xp, wide_from128(xp, rhi, rlo), k_r)
+            lt, eq = wide_cmp(xp, wl, wr)
+            gt = ~(lt | eq)
         return self._from_ordering(xp, lt, gt, eq)
 
     def _from_ordering(self, xp, lt, gt, eq):

@@ -56,9 +56,12 @@ class CpuHiveTextScanExec(CpuFileScanExec):
             data = f.read()
         db = delim.encode("utf-8")
         cols: list = [[] for _ in range(ncols)]
-        for line in data.split(b"\n"):
-            if not line:
-                continue
+        chunks = data.split(b"\n")
+        if chunks and not chunks[-1]:
+            chunks.pop()  # trailing newline, not a row
+        for line in chunks:
+            # interior empty lines ARE rows for LazySimpleSerDe: first
+            # column empty-string (or NULL after cast), the rest NULL
             if line.endswith(b"\r"):
                 line = line[:-1]
             fields = line.split(db)

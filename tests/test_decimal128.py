@@ -164,3 +164,106 @@ class TestDecimal128:
             sel = [v for i, v in enumerate(vals) if i % 7 == g
                    and v is not None]
             assert rows[g]["sm"] == sum(sel)
+
+
+class TestWideExactness:
+    """Round-3 advisor regressions: 128-bit rescale wrap aliasing, Spark's
+    allowPrecisionLoss result type, exact wide compares, -2^127 bound."""
+
+    def test_addsub_rescale_no_wrap_alias(self, session):
+        # dec(38,0) + dec(38,10): types as (38,6) under adjustPrecisionScale
+        # and values up to 10^31 stay EXACT (the old 128-bit rescale wrapped
+        # 34028236692093846346337460743 into ~-0.177 with validity=true)
+        big = [D(34028236692093846346337460743), D(10) ** 30,
+               D(-(10 ** 28)), D(7)]
+        t = pa.table({
+            "a": pa.array(big, type=pa.decimal128(38, 0)),
+            "b": pa.array([D("0.5"), D(0), D("0.0000000001"), D("-7")],
+                          type=pa.decimal128(38, 10)),
+            "i": pa.array(range(4), type=pa.int64()),
+        })
+        df = session.from_arrow(t)
+        q = df.select("i", s=col("a") + col("b"), d=col("a") - col("b"))
+        out = assert_same(q, sort_by=["i"]).sort_by([("i", "ascending")])
+        st = out.schema.field("s").type
+        assert (st.precision, st.scale) == (38, 6)
+        got = out.to_pylist()
+        assert got[0]["s"] == D("34028236692093846346337460743.5")
+        assert got[1]["s"] == D(10) ** 30
+        assert got[2]["s"] == D(-(10 ** 28))  # 1e-10 rounds away at scale 6
+        assert got[3]["s"] == D(0) and got[3]["d"] == D(14)
+
+    def test_addsub_true_overflow_still_nulls(self, session):
+        mx = D(10) ** 37 * 9  # 9e37, near the 38-digit cap
+        t = pa.table({"a": pa.array([mx, mx], type=pa.decimal128(38, 0)),
+                      "b": pa.array([mx, -mx], type=pa.decimal128(38, 0)),
+                      "i": pa.array([0, 1], type=pa.int64())})
+        df = session.from_arrow(t)
+        out = assert_same(df.select("i", s=col("a") + col("b")),
+                          sort_by=["i"]).sort_by([("i", "ascending")])
+        got = out.to_pylist()
+        assert got[0]["s"] is None      # 1.8e38 overflows (38,0)
+        assert got[1]["s"] == D(0)
+
+    def test_compare_wide_scale_gap_exact(self, session):
+        # comparing dec(38,0) vs dec(38,10) forces a 10-digit rescale that
+        # wrapped in 128 bits and misordered huge values
+        a = [D(10) ** 30, D(34028236692093846346337460743), D(-(10 ** 29))]
+        b = [D("0.5"), D("1.5"), D("0.5")]
+        t = pa.table({"a": pa.array(a, type=pa.decimal128(38, 0)),
+                      "b": pa.array(b, type=pa.decimal128(38, 10)),
+                      "i": pa.array(range(3), type=pa.int64())})
+        df = session.from_arrow(t)
+        out = assert_same(df.select("i", gt=col("a") > col("b"),
+                                    lt=col("a") < col("b")),
+                          sort_by=["i"]).sort_by([("i", "ascending")])
+        got = out.to_pylist()
+        assert [g["gt"] for g in got] == [True, True, False]
+        assert [g["lt"] for g in got] == [False, False, True]
+
+    def test_cast_upscale_no_wrap_alias(self, session):
+        # dec(38,0) -> dec(38,10): values >= 10^28 must null (true overflow),
+        # never alias back into bounds through a wrapped multiply
+        vals = [D(34028236692093846346337460743), D(10) ** 27, D(5)]
+        t = pa.table({"d": pa.array(vals, type=pa.decimal128(38, 0)),
+                      "i": pa.array(range(3), type=pa.int64())})
+        df = session.from_arrow(t)
+        q = df.select("i", c=Cast(col("d"), T.DecimalType(38, 10)))
+        out = assert_same(q, sort_by=["i"]).sort_by([("i", "ascending")])
+        got = out.to_pylist()
+        assert got[0]["c"] is None
+        assert got[1]["c"] == D(10) ** 27
+        assert got[2]["c"] == D(5)
+
+    def test_adjust_precision_scale_unit(self):
+        from spark_rapids_tpu.expr.decimal128 import (add_result_type,
+                                                      adjust_precision_scale)
+        r = add_result_type(T.DecimalType(38, 0), T.DecimalType(38, 10))
+        assert (r.precision, r.scale) == (38, 6)
+        r = add_result_type(T.DecimalType(10, 2), T.DecimalType(12, 4))
+        assert (r.precision, r.scale) == (13, 4)  # no adjustment needed
+        r = adjust_precision_scale(77, 38)
+        assert (r.precision, r.scale) == (38, 6)
+        r = adjust_precision_scale(40, 3)
+        assert (r.precision, r.scale) == (38, 3)  # min_scale=3 floor holds
+
+    def test_in_bounds_int128_min(self):
+        from spark_rapids_tpu.expr.decimal128 import in_bounds, split_int
+        hi, lo = split_int(-(2 ** 127))
+        ok = in_bounds(np, np.array([hi], np.int64),
+                       np.array([lo], np.int64), 38)
+        assert not bool(ok[0])
+
+    def test_integral_to_dec64_cast_no_wrap(self, session):
+        # CAST(1844674408L AS DECIMAL(18,10)): 1844674408 * 10^10 wraps
+        # int64 to 6290448384 which passed the old post-hoc bound check
+        t = pa.table({"v": pa.array([1844674408, 12345678, -(2 ** 63)],
+                                    type=pa.int64()),
+                      "i": pa.array(range(3), type=pa.int64())})
+        df = session.from_arrow(t)
+        q = df.select("i", c=Cast(col("v"), T.DecimalType(18, 10)))
+        out = assert_same(q, sort_by=["i"]).sort_by([("i", "ascending")])
+        got = out.to_pylist()
+        assert got[0]["c"] is None          # 1.8e9 needs 10 int digits > 8
+        assert got[1]["c"] == D(12345678)
+        assert got[2]["c"] is None          # int64-min: abs() wraps

@@ -111,3 +111,21 @@ class TestHiveText:
         assert got[0] == {"id": 2, "name": "bob", "score": None}
         assert got[1] == {"id": 3, "name": "carol", "score": 1.5}
         assert got[2] == {"id": 4, "name": None, "score": None}
+
+    def test_interior_empty_lines_are_rows(self, session, tmp_path):
+        # LazySimpleSerDe emits a row for an empty line: first column is
+        # the empty string (NULL after a numeric cast), the rest NULL.
+        # Only the final empty chunk from a trailing newline is skipped.
+        p = str(tmp_path / "blank.txt")
+        with open(p, "w") as f:
+            f.write("1\x01alice\x012.5\n")
+            f.write("\n")
+            f.write("2\x01bob\x011.0\n")   # trailing newline: no extra row
+        df = session.read_hive_text(p, schema=SCHEMA)
+        got = df.collect_cpu().to_pylist()
+        assert len(got) == 3
+        assert got[1] == {"id": None, "name": None, "score": None}
+        str_schema = Schema(("a", "b"), (T.STRING, T.STRING))
+        df2 = session.read_hive_text(p, schema=str_schema)
+        got2 = df2.collect_cpu().to_pylist()
+        assert got2[1] == {"a": "", "b": None}  # empty string, not NULL
