@@ -111,8 +111,31 @@ _CHILD_ENV = "SPARK_RAPIDS_TPU_BENCH_CHILD"
 _MARK = "@BENCH_RESULT@"
 
 
-def main():
+_CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          ".jax_cache")
+
+
+def _enable_compilation_cache():
+    """Persist compiled programs across processes/rounds: a warm bench run
+    skips the ~20-40s tunnel compile, so a healthy attempt completes in
+    seconds (round-2 verdict item 1a)."""
     import jax
+    jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    try:
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass  # older jax: default is fine
+
+
+def main():
+    _enable_compilation_cache()
+    import jax
+    # test hook: SPARK_RAPIDS_TPU_BENCH_PLATFORM=cpu forces the platform
+    # (the axon plugin overrides JAX_PLATFORMS, so env alone is not enough)
+    plat = os.environ.get("SPARK_RAPIDS_TPU_BENCH_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
     import jax.numpy as jnp
 
     data = make_data()
@@ -160,9 +183,49 @@ def main():
     }), flush=True)
 
 
+PROBE_TIMEOUT_S = 35
+PROBE_ATTEMPTS = 2
+
+
+def probe_backend() -> "tuple[bool, str]":
+    """~30s-bounded subprocess probe of the device backend BEFORE burning a
+    full attempt window: a dead tunnel costs 2x35s, not 3x180s (round-2
+    verdict item 1b). Returns (ok, detail)."""
+    plat = os.environ.get("SPARK_RAPIDS_TPU_BENCH_PLATFORM")
+    cfg = (f"jax.config.update('jax_platforms', {plat!r}); " if plat else "")
+    code = f"import jax; {cfg}print(jax.devices()[0])"
+    last = ""
+    for i in range(1, PROBE_ATTEMPTS + 1):
+        try:
+            proc = subprocess.run([sys.executable, "-c", code],
+                                  capture_output=True, text=True,
+                                  timeout=PROBE_TIMEOUT_S)
+        except subprocess.TimeoutExpired:
+            last = (f"probe {i}: no backend response in {PROBE_TIMEOUT_S}s "
+                    "(wedged tunnel)")
+            continue
+        if proc.returncode == 0 and proc.stdout.strip():
+            return True, proc.stdout.strip().splitlines()[-1]
+        tail = (proc.stderr or "").strip().splitlines()[-1:] or ["<no output>"]
+        last = f"probe {i}: rc={proc.returncode} {tail[0]}"
+    return False, last
+
+
 def supervise() -> int:
-    """Run main() in a child under a watchdog; retry; emit error JSON if all fail."""
-    errors = []
+    """Probe the backend, then run main() in a child under a watchdog;
+    retry; emit error JSON if all fail."""
+    ok, detail = probe_backend()
+    if not ok:
+        print(json.dumps({
+            "metric": "scan_join_agg_speedup_vs_cpu",
+            "value": None,
+            "unit": "x",
+            "vs_baseline": None,
+            "error": f"backend probe failed, skipping attempts: {detail}",
+            "detail": {"probe": detail},
+        }), flush=True)
+        return 1
+    errors = [f"probe ok: {detail}"]
     for attempt in range(1, ATTEMPTS + 1):
         env = dict(os.environ, **{_CHILD_ENV: "1"})
         try:

@@ -252,6 +252,12 @@ register("spark.rapids.sql.regexp.enabled", "bool", True,
 # TPU-specific ----------------------------------------------------------------------
 register("spark.rapids.tpu.device.ordinal", "int", -1,
          "Which local TPU device to bind (-1 = first).", startup_only=True)
+register("spark.rapids.tpu.device.startupTimeoutSec", "double", 60.0,
+         "Deadline (seconds) for the FIRST backend touch (device enumeration / "
+         "client init). A wedged device runtime raises DeviceStartupError with "
+         "diagnostics instead of hanging the query indefinitely (the reference "
+         "inspects and fail-fasts executor startup, Plugin.scala:436-459). "
+         "<= 0 disables the guard.", startup_only=True)
 register("spark.rapids.tpu.padding.minRows", "int", 128,
          "Minimum padded row bucket (lane-aligned).")
 register("spark.rapids.tpu.padding.growth", "double", 2.0,

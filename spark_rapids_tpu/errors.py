@@ -43,6 +43,18 @@ class StringWidthExceeded(CpuFallbackRequired):
         self.limit = limit
 
 
+class DeviceStartupError(RapidsTpuError):
+    """The device backend failed or HUNG during first touch (client init /
+    device enumeration). Fatal for device execution: raised with diagnostics
+    within the configured deadline instead of blocking the query forever —
+    the analog of the reference's executor-startup inspection + fail-fast
+    (`Plugin.scala:436-459`). The session can still run CPU-engine plans."""
+
+    def __init__(self, message: str, diagnostics: dict | None = None):
+        super().__init__(message)
+        self.diagnostics = diagnostics or {}
+
+
 class AnsiViolation(RapidsTpuError):
     """Spark ANSI-mode runtime error (ArithmeticException analog): integral
     overflow, division by zero, or cast overflow under spark.sql.ansi.enabled."""

@@ -9,12 +9,22 @@ raises host-side RetryOOM before kernels launch (ARCHITECTURE.md #6)."""
 
 from __future__ import annotations
 
+import os
 import threading
+import time
 from typing import Optional
 
 from ..config import TpuConf, get_default_conf
+from ..errors import DeviceStartupError
 
 _DEFAULT_HBM = 16 << 30  # v5e has 16 GiB/chip; used when the backend won't say
+
+
+def _backend_touch():
+    """The first backend touch — client init + device enumeration. Split out
+    so tests can substitute a hanging/failing backend."""
+    import jax
+    return jax.devices()
 
 
 class DeviceManager:
@@ -23,6 +33,57 @@ class DeviceManager:
     device = None
     hbm_total = 0
     budget_bytes = 0
+    # observed fatal startup failure, remembered so every later query fails
+    # fast instead of re-arming a fresh deadline against a wedged runtime
+    _startup_error: Optional[DeviceStartupError] = None
+
+    @classmethod
+    def _first_touch(cls, conf: TpuConf):
+        """Enumerate devices under a deadline. The axon/TPU runtime can HANG
+        (not raise) inside client init when its tunnel is wedged — observed
+        repeatedly on this hardware; a query must fail in seconds with a
+        typed error, not block forever (`Plugin.scala:436-459` analog)."""
+        if cls._startup_error is not None:
+            raise cls._startup_error
+        timeout = conf.get("spark.rapids.tpu.device.startupTimeoutSec")
+        if timeout is None or timeout <= 0:
+            return _backend_touch()
+        result: dict = {}
+
+        def touch():
+            try:
+                result["devices"] = _backend_touch()
+            except Exception as exc:  # noqa: BLE001 — re-raised typed below
+                result["error"] = exc
+
+        t0 = time.monotonic()
+        worker = threading.Thread(target=touch, daemon=True,
+                                  name="tpu-backend-first-touch")
+        worker.start()
+        worker.join(timeout)
+        diags = {
+            "elapsed_s": round(time.monotonic() - t0, 2),
+            "timeout_s": timeout,
+            "jax_platforms_env": os.environ.get("JAX_PLATFORMS", ""),
+            "xla_flags": os.environ.get("XLA_FLAGS", ""),
+        }
+        if worker.is_alive():
+            err = DeviceStartupError(
+                "TPU backend did not respond within "
+                f"{timeout:g}s of first touch (client init / device "
+                "enumeration hang — wedged device tunnel?). Device "
+                f"execution disabled for this process. Diagnostics: {diags}",
+                diagnostics=diags)
+            cls._startup_error = err
+            raise err
+        if "error" in result:
+            diags["cause"] = repr(result["error"])
+            err = DeviceStartupError(
+                f"TPU backend failed at first touch: {result['error']}. "
+                f"Diagnostics: {diags}", diagnostics=diags)
+            cls._startup_error = err
+            raise err from result["error"]
+        return result["devices"]
 
     @classmethod
     def initialize(cls, conf: Optional[TpuConf] = None) -> None:
@@ -30,8 +91,7 @@ class DeviceManager:
             if cls._initialized:
                 return
             conf = conf or get_default_conf()
-            import jax
-            devices = jax.devices()
+            devices = cls._first_touch(conf)
             ordinal = conf.get("spark.rapids.tpu.device.ordinal")
             cls.device = devices[ordinal if ordinal >= 0 else 0]
             cls.hbm_total = cls._query_hbm(cls.device)
@@ -73,3 +133,4 @@ class DeviceManager:
         with cls._lock:
             cls._initialized = False
             cls.device = None
+            cls._startup_error = None
