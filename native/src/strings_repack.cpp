@@ -56,4 +56,28 @@ int64_t srtpu_sum_lengths(const int32_t* lengths, int64_t n) {
   return s;
 }
 
+// Parquet PLAIN BYTE_ARRAY stream: n values of (u32 little-endian length,
+// bytes). Emits each value's data start offset and length; returns the max
+// length, or -1 if the stream is truncated. This serial prefix walk is the
+// one part of BYTE_ARRAY decode that cannot vectorize (each length's
+// position depends on all previous lengths) — the device does the actual
+// bytes->matrix gather from these offsets.
+int64_t srtpu_byte_array_scan(const uint8_t* blob, int64_t blob_len,
+                              int64_t n, int64_t* starts_out,
+                              int32_t* lens_out) {
+  int64_t pos = 0, max_len = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    if (pos + 4 > blob_len) return -1;
+    uint32_t len;
+    std::memcpy(&len, blob + pos, 4);
+    pos += 4;
+    if (pos + len > blob_len) return -1;
+    starts_out[i] = pos;
+    lens_out[i] = static_cast<int32_t>(len);
+    if (len > max_len) max_len = len;
+    pos += len;
+  }
+  return max_len;
+}
+
 }  // extern "C"

@@ -2,28 +2,37 @@
 the reference's scan performance comes from copying RAW column chunks to a
 buffer and decoding whole pages on the accelerator).
 
-TPU shape of the same idea, first encodings (PLAIN values + RLE/bit-packed
-definition levels, the hot pair for flat numeric data):
+TPU shape of the same idea — PLAIN + DICT (RLE_DICTIONARY/PLAIN_DICTIONARY)
+values, RLE/bit-packed definition levels, and BYTE_ARRAY strings, i.e. the
+encodings default pyarrow/Spark output actually uses:
 
   host (cheap, control-plane):
     * footer via pyarrow metadata: row groups, chunk offsets, codecs;
     * page headers via a minimal Thrift compact-protocol parser;
     * page decompression (snappy/gzip/zstd via pyarrow) — byte plumbing only;
-    * RLE run STRUCTURE scan: the def-level stream is split into a small
-      per-run table (kind, output offset, count, value, bit offset) without
-      expanding any values.
+    * RLE run STRUCTURE scan: def-level and dictionary-index streams split
+      into small per-run tables (kind, count, value, bit offset) without
+      expanding any values;
+    * BYTE_ARRAY offset scan: the serial (u32 len, bytes)* prefix walk
+      (native C++, srtpu_byte_array_scan) — each length's position depends
+      on all previous lengths, the one genuinely sequential step.
   device (the actual data work):
-    * def-level expansion: output row -> run via searchsorted over the run
-      table, bit-packed runs unpacked with vector shifts — the values
-      never exist row-wise on the host;
+    * def-level + index expansion: output slot -> run via searchsorted over
+      the run table, bit-packed runs unpacked with vector shifts (1-bit def
+      levels, up-to-32-bit dictionary indices) — values never exist
+      row-wise on the host;
     * PLAIN values: the raw little-endian byte buffer is shipped once and
-      bitcast to int32/int64/float32/float64 lanes on device;
+      viewed as int32/int64/float32/float64 lanes;
+    * DICT values: dictionary gather by expanded indices;
+    * BYTE_ARRAY: every value span gathered out of the shipped page/dict
+      blobs into the byte-matrix string layout (uint8[cap, width]);
     * null scatter: non-null values land at their row slots via the
       rank = cumsum(defined) gather (same shape as the join expansion).
 
-Anything else (dictionary pages, byte arrays, v2 pages, unsupported codecs)
-raises DeviceDecodeUnsupported and the scan falls back to the pyarrow host
-path per file — the reference's per-op fallback discipline applied to IO."""
+Anything else (v2 pages, FIXED_LEN_BYTE_ARRAY/INT96, unsupported codecs,
+over-wide strings) raises DeviceDecodeUnsupported and the scan falls back to
+the pyarrow host path per row group — the reference's per-op fallback
+discipline applied to IO."""
 
 from __future__ import annotations
 
@@ -173,43 +182,54 @@ def _parse_page_header(buf: memoryview, pos: int) -> _PageHeader:
 # RLE/bit-packed hybrid: host structure scan (no value expansion)
 # ----------------------------------------------------------------------------
 
-def _rle_runs(payload: memoryview, num_values: int):
-    """Split a 1-bit RLE/bit-packed hybrid stream into a run table.
-    Returns (kinds u8 [R] 0=rle 1=packed, counts i64, values u8, bitoffs i64)
-    where bitoffs indexes into the packed byte blob for packed runs."""
+def _rle_runs(payload: memoryview, num_values: int, bit_width: int = 1):
+    """Split an RLE/bit-packed hybrid stream into a run table.
+    Returns (kinds u8 [R] 0=rle 1=packed, counts i64, values u32, bitoffs i64)
+    where bitoffs indexes into the packed byte blob for packed runs.
+    bit_width=1 is the def-level stream; dictionary index streams carry
+    their width in the page payload's first byte (up to 32 bits)."""
+    vbytes = (bit_width + 7) // 8
     kinds: List[int] = []
     counts: List[int] = []
     values: List[int] = []
     bitoffs: List[int] = []
     packed = bytearray()
     pos, out = 0, 0
+    vmask = (1 << bit_width) - 1
     while out < num_values and pos < len(payload):
         header, pos = _varint(payload, pos)
-        if header & 1:  # bit-packed group: (header>>1)*8 values, 1 bit each
+        if header & 1:  # bit-packed group: (header>>1)*8 values
             n = (header >> 1) * 8
-            nbytes = header >> 1
+            nbytes = (header >> 1) * bit_width
+            kept = min(n, num_values - out)
+            # short slices must NOT silently read as zeros (silent
+            # corruption); the stream is malformed -> host fallback
+            if pos + (kept * bit_width + 7) // 8 > len(payload):
+                raise DeviceDecodeUnsupported("truncated RLE stream")
             kinds.append(1)
-            counts.append(min(n, num_values - out))
+            counts.append(kept)
             values.append(0)
             bitoffs.append(len(packed) * 8)
             packed.extend(payload[pos:pos + nbytes])
             pos += nbytes
-            out += counts[-1]
-        else:  # RLE run of header>>1 copies of a 1-byte value
+            out += kept
+        else:  # RLE run of header>>1 copies of a vbytes-wide LE value
             n = header >> 1
-            v = payload[pos]
-            pos += 1
+            if pos + vbytes > len(payload):
+                raise DeviceDecodeUnsupported("truncated RLE stream")
+            v = int.from_bytes(bytes(payload[pos:pos + vbytes]), "little")
+            pos += vbytes
             kinds.append(0)
             counts.append(min(n, num_values - out))
-            values.append(v & 1)
+            values.append(v & vmask)
             bitoffs.append(0)
             out += counts[-1]
     if out < num_values:
-        raise DeviceDecodeUnsupported("truncated def-level stream")
+        raise DeviceDecodeUnsupported("truncated RLE stream")
     if not packed:
         packed = bytearray(1)
     return (np.array(kinds, np.uint8), np.array(counts, np.int64),
-            np.array(values, np.uint8), np.array(bitoffs, np.int64),
+            np.array(values, np.uint32), np.array(bitoffs, np.int64),
             np.frombuffer(bytes(packed), np.uint8))
 
 
@@ -235,25 +255,59 @@ def _expand_def_levels(kinds, counts, values, bitoffs, packed, cap: int):
     return (lvl == 1) & (j < total)
 
 
-@functools.partial(__import__("jax").jit, static_argnums=(2, 3))
-def _scatter_plain(raw_bytes, defined, np_dtype_name: str, cap: int):
-    """PLAIN value bytes + defined mask -> (data[cap], validity[cap]).
-    Non-null values are stored back-to-back; row r reads value rank[r].
-    raw_bytes is host-padded so `cap` values are always addressable."""
+@functools.partial(__import__("jax").jit, static_argnums=(5, 6))
+def _expand_rle_u32(kinds, counts, values, bitoffs, packed, cap: int,
+                    bw: int):
+    """Run table -> u32[cap] values (dictionary indices), on device.
+    Multi-bit generalization of _expand_def_levels: each output slot
+    gathers a (bw+7)/8+1-byte window and shifts its value out."""
     import jax.numpy as jnp
-    from jax import lax
-    dt = np.dtype(np_dtype_name)
-    if np_dtype_name == "bool":
-        idx = jnp.arange(cap)
-        byte = raw_bytes[idx // 8]
-        vals = ((byte >> (idx % 8).astype(jnp.uint8)) & 1).astype(jnp.bool_)
-    else:
-        vals = lax.bitcast_convert_type(
-            raw_bytes[:cap * dt.itemsize].reshape(cap, dt.itemsize), dt)
+    ends = jnp.cumsum(counts)
+    j = jnp.arange(cap, dtype=jnp.int64)
+    run = jnp.clip(jnp.searchsorted(ends, j, side="right"),
+                   0, counts.shape[0] - 1)
+    base = jnp.where(run > 0, ends[jnp.maximum(run - 1, 0)], 0)
+    within = j - base
+    bitpos = bitoffs[run] + within * bw
+    b0 = bitpos // 8
+    window = jnp.zeros(cap, jnp.uint64)
+    for k in range((bw + 7) // 8 + 1):  # bw bits at offset<=7 span this many
+        byte = packed[jnp.clip(b0 + k, 0, packed.shape[0] - 1)]
+        window = window | (byte.astype(jnp.uint64) << jnp.uint64(8 * k))
+    sh = (bitpos % 8).astype(jnp.uint64)
+    pv = ((window >> sh) & jnp.uint64((1 << bw) - 1)).astype(jnp.uint32)
+    out = jnp.where(kinds[run] == 1, pv, values[run])
+    return jnp.where(j < ends[-1], out, 0)
+
+
+@__import__("jax").jit
+def _scatter_values(vals, defined):
+    """Dense non-null values (padded to cap) + defined mask -> row slots."""
+    import jax.numpy as jnp
+    rank = jnp.cumsum(defined.astype(jnp.int32)) - 1
+    safe = jnp.clip(rank, 0, vals.shape[0] - 1)
+    data = vals[safe]
+    return jnp.where(defined, data, jnp.zeros((), vals.dtype)), defined
+
+
+@functools.partial(__import__("jax").jit, static_argnums=(4,))
+def _gather_strings(blob, starts, lens, defined, width: int):
+    """Device bytes->matrix: row r reads value rank[r]'s span out of the
+    page/dict blob into the fixed-width byte-matrix string layout
+    (data uint8[cap, width] + lengths int32[cap]). The variable-length
+    stream never exists row-wise on the host — only the serial offset
+    scan (native byte_array_scan) ran there."""
+    import jax.numpy as jnp
+    cap = defined.shape[0]
     rank = jnp.cumsum(defined.astype(jnp.int32)) - 1
     safe = jnp.clip(rank, 0, cap - 1)
-    data = vals[safe]
-    return jnp.where(defined, data, jnp.zeros((), dt)), defined
+    st = starts[safe]
+    ln = jnp.where(defined, lens[safe], 0).astype(jnp.int32)
+    j = jnp.arange(width)
+    idx = st[:, None] + j[None, :]
+    mat = blob[jnp.clip(idx, 0, blob.shape[0] - 1)]
+    keep = (j[None, :] < ln[:, None]) & defined[:, None]
+    return jnp.where(keep, mat, 0).astype(jnp.uint8), ln
 
 
 # ----------------------------------------------------------------------------
@@ -300,71 +354,105 @@ def _defined_count(part) -> int:
     return total
 
 
-def _decode_chunk(buf: bytes, col_meta, optional: bool):
-    """One column chunk -> (raw value bytes, def-level run table or None,
-    num_values). Malformed page streams surface as DeviceDecodeUnsupported
-    (not raw IndexError/struct.error) so callers can keep a NARROW fallback
-    net — a genuine code bug elsewhere must not be silently swallowed into
-    the host path."""
+class _Page:
+    """One data page's decoded control plane: def-level run table (None for
+    required columns), non-null count, and either a PLAIN value byte blob
+    or a dictionary-index run table."""
+    __slots__ = ("num_values", "ndef", "runs", "kind", "payload", "bw")
+
+
+class _Chunk:
+    __slots__ = ("pages", "dict_raw", "dict_count", "total")
+
+
+def _decode_chunk(buf: bytes, col_meta, optional: bool) -> _Chunk:
+    """One column chunk -> _Chunk page descriptors. Malformed page streams
+    surface as DeviceDecodeUnsupported (not raw IndexError/struct.error) so
+    callers can keep a NARROW fallback net — a genuine code bug elsewhere
+    must not be silently swallowed into the host path."""
     try:
         return _decode_chunk_inner(buf, col_meta, optional)
     except (IndexError, struct.error) as e:
         raise DeviceDecodeUnsupported(f"malformed page stream: {e}") from e
 
 
-def _decode_chunk_inner(buf: bytes, col_meta, optional: bool):
+def _decode_chunk_inner(buf: bytes, col_meta, optional: bool) -> _Chunk:
     phys = col_meta.physical_type
-    if phys not in _PHYS_TO_NP:
+    if phys not in _PHYS_TO_NP and phys != "BYTE_ARRAY":
         raise DeviceDecodeUnsupported(f"physical type {phys}")
     is_bool = phys == "BOOLEAN"
     mv = memoryview(buf)
     pos = 0
-    values = bytearray()
-    bool_bits: List[np.ndarray] = []
-    run_parts = []
-    total = 0
+    chunk = _Chunk()
+    chunk.pages = []
+    chunk.dict_raw = None
+    chunk.dict_count = 0
+    chunk.total = 0
     while pos < len(mv):
         h = _parse_page_header(mv, pos)
         if h.type is None or h.compressed is None or h.uncompressed is None:
             raise DeviceDecodeUnsupported("unparseable page header")
         pos += h.header_len
-        if h.type == 2:  # dictionary page -> fall back (DICT data follows)
-            raise DeviceDecodeUnsupported("dictionary-encoded chunk")
+        if h.type == 2:  # dictionary page: PLAIN-encoded distinct values
+            if chunk.pages or chunk.dict_raw is not None:
+                raise DeviceDecodeUnsupported("out-of-order dictionary page")
+            if h.encoding not in (0, 2):  # PLAIN / PLAIN_DICTIONARY
+                raise DeviceDecodeUnsupported(
+                    f"dict page encoding {h.encoding}")
+            chunk.dict_raw = _decompress(bytes(mv[pos:pos + h.compressed]),
+                                         col_meta.compression,
+                                         h.uncompressed)
+            chunk.dict_count = h.num_values or 0
+            pos += h.compressed
+            continue
         if h.type != 0:  # only v1 data pages; a v2 body is NOT fully
             # compressed, so it must be rejected BEFORE decompression
             raise DeviceDecodeUnsupported(f"page type {h.type}")
-        if h.encoding != 0:  # PLAIN
-            raise DeviceDecodeUnsupported(f"value encoding {h.encoding}")
         payload = _decompress(bytes(mv[pos:pos + h.compressed]),
                               col_meta.compression, h.uncompressed)
         pos += h.compressed
         body = memoryview(payload)
+        p = _Page()
+        p.num_values = h.num_values
         if optional:
             if h.def_encoding != 3:  # RLE
                 raise DeviceDecodeUnsupported(
                     f"def-level encoding {h.def_encoding}")
             (dlen,) = struct.unpack_from("<i", body, 0)
-            run_parts.append(_rle_runs(body[4:4 + dlen], h.num_values))
+            p.runs = _rle_runs(body[4:4 + dlen], h.num_values)
             page_vals = body[4 + dlen:]
+            p.ndef = _defined_count(p.runs)
         else:
+            p.runs = None
             page_vals = body
-        if is_bool:
-            # every page's bit-packing restarts at a byte boundary; a byte
-            # concat would misalign any page whose non-null count % 8 != 0 —
-            # repack into one contiguous bitstream on host
-            ndef = _defined_count(run_parts[-1]) if optional \
-                else h.num_values
-            bits = np.unpackbits(np.frombuffer(page_vals, np.uint8),
-                                 bitorder="little")[:ndef]
-            bool_bits.append(bits)
+            p.ndef = h.num_values
+        if h.encoding == 0:  # PLAIN
+            p.kind = "plain"
+            p.bw = 0
+            if is_bool:
+                # page bit-packing restarts at a byte boundary per page; a
+                # byte concat would misalign — keep unpacked 0/1 bytes
+                if len(page_vals) * 8 < p.ndef:
+                    raise DeviceDecodeUnsupported("truncated bool page")
+                p.payload = np.unpackbits(
+                    np.frombuffer(page_vals, np.uint8),
+                    bitorder="little")[:p.ndef]
+            else:
+                p.payload = bytes(page_vals)
+        elif h.encoding in (2, 8):  # PLAIN_DICTIONARY / RLE_DICTIONARY
+            if chunk.dict_raw is None:
+                raise DeviceDecodeUnsupported("dict page missing")
+            p.kind = "dict"
+            p.bw = page_vals[0] if len(page_vals) else 0
+            if p.bw > 32:
+                raise DeviceDecodeUnsupported(f"index bit width {p.bw}")
+            p.payload = _rle_runs(page_vals[1:], p.ndef, p.bw) \
+                if p.bw and p.ndef else None
         else:
-            values.extend(page_vals)
-        total += h.num_values
-    if is_bool:
-        values = bytearray(np.packbits(
-            np.concatenate(bool_bits) if bool_bits
-            else np.zeros(0, np.uint8), bitorder="little").tobytes())
-    return bytes(values), run_parts, total
+            raise DeviceDecodeUnsupported(f"value encoding {h.encoding}")
+        chunk.pages.append(p)
+        chunk.total += h.num_values
+    return chunk
 
 
 def _merge_runs(run_parts):
@@ -379,7 +467,18 @@ def _merge_runs(run_parts):
     return kinds, counts, values, bitoffs, packed
 
 
-_OK_ENCODINGS = {"PLAIN", "RLE", "BIT_PACKED"}
+_OK_ENCODINGS = {"PLAIN", "RLE", "BIT_PACKED", "PLAIN_DICTIONARY",
+                 "RLE_DICTIONARY"}
+
+_EXPECTED_PHYS = {
+    T.BooleanType: ("BOOLEAN",),
+    T.IntegerType: ("INT32",),
+    T.LongType: ("INT64",),
+    T.FloatType: ("FLOAT",),
+    T.DoubleType: ("DOUBLE",),
+    T.DateType: ("INT32",),
+    T.StringType: ("BYTE_ARRAY",),
+}
 
 
 def file_supported(path: str, schema):
@@ -396,8 +495,8 @@ def file_supported(path: str, schema):
     for name, dt in zip(schema.names, schema.types):
         if name not in col_index:
             raise DeviceDecodeUnsupported(f"column {name} not flat")
-        if not isinstance(dt, (T.BooleanType, T.IntegerType, T.LongType,
-                               T.FloatType, T.DoubleType, T.DateType)):
+        ok_phys = _EXPECTED_PHYS.get(type(dt))
+        if ok_phys is None:
             raise DeviceDecodeUnsupported(f"logical type {dt}")
         ci = col_index[name]
         pqcol = pq_schema.column(ci)
@@ -405,13 +504,12 @@ def file_supported(path: str, schema):
             raise DeviceDecodeUnsupported("repeated column")
         for rg in range(meta.num_row_groups):
             cm = meta.row_group(rg).column(ci)
-            if cm.physical_type not in _PHYS_TO_NP:
-                raise DeviceDecodeUnsupported(cm.physical_type)
+            if cm.physical_type not in ok_phys:
+                raise DeviceDecodeUnsupported(
+                    f"{cm.physical_type} for {dt}")
             if cm.compression != "UNCOMPRESSED" and \
                     cm.compression not in _CODEC:
                 raise DeviceDecodeUnsupported(f"codec {cm.compression}")
-            if cm.dictionary_page_offset is not None:
-                raise DeviceDecodeUnsupported("dictionary-encoded chunk")
             if not set(cm.encodings) <= _OK_ENCODINGS:
                 raise DeviceDecodeUnsupported(f"encodings {cm.encodings}")
     return pf
@@ -451,10 +549,10 @@ def decode_row_group(pf, f, rg: int, schema):
         start = cm.dictionary_page_offset or cm.data_page_offset
         f.seek(start)
         buf = f.read(cm.total_compressed_size)
-        raw, run_parts, nvals = _decode_chunk(buf, cm, optional)
-        if nvals != nrows:
+        chunk = _decode_chunk(buf, cm, optional)
+        if chunk.total != nrows:
             raise DeviceDecodeUnsupported("page/row-group mismatch")
-        raw_dev = jnp.asarray(np.frombuffer(raw, np.uint8))
+        run_parts = [p.runs for p in chunk.pages if p.runs is not None]
         if optional and run_parts:
             kinds, counts, values, bitoffs, packed = _merge_runs(run_parts)
             defined = _expand_def_levels(
@@ -463,18 +561,176 @@ def decode_row_group(pf, f, rg: int, schema):
                 jnp.asarray(packed), cap)
         else:  # required column, or a 0-row row group (no pages)
             defined = jnp.arange(cap) < nrows
-        npname = _PHYS_TO_NP[cm.physical_type]
-        pad = cap * np.dtype(npname).itemsize + 8
-        if raw_dev.shape[0] < pad:
-            raw_dev = jnp.pad(raw_dev, (0, pad - raw_dev.shape[0]))
-        data, validity = _scatter_plain(raw_dev, defined, npname, cap)
-        if isinstance(dt, T.DateType):
-            data = data.astype(jnp.int32)
-        elif data.dtype != dt.np_dtype:
-            data = data.astype(dt.np_dtype)
-        cols.append(Column(dt, data, validity))
+        if cm.physical_type == "BYTE_ARRAY":
+            cols.append(_assemble_strings(chunk, dt, defined, cap))
+        else:
+            cols.append(_assemble_fixed(chunk, cm.physical_type, dt,
+                                        defined, cap))
     return ColumnarBatch(schema, tuple(cols),
                          jnp.asarray(nrows, jnp.int32)), nrows
+
+
+def _expand_indices(page: _Page, dict_count: int):
+    """One dict-encoded page's index stream -> u32 device values [ndef]."""
+    import jax.numpy as jnp
+    if page.bw == 0 or page.payload is None:
+        return jnp.zeros(page.ndef, jnp.uint32)
+    kinds, counts, values, bitoffs, packed = page.payload
+    idx = _expand_rle_u32(jnp.asarray(kinds), jnp.asarray(counts),
+                          jnp.asarray(values), jnp.asarray(bitoffs),
+                          jnp.asarray(packed), row_bucket(page.ndef),
+                          int(page.bw))[:page.ndef]
+    return jnp.clip(idx, 0, max(dict_count - 1, 0))
+
+
+def _assemble_fixed(chunk: _Chunk, phys: str, dt, defined, cap: int):
+    """Fixed-width column: per-page non-null value streams (PLAIN bitcast
+    or dictionary gather) concatenated in page order, then scattered to row
+    slots by null rank. All-PLAIN chunks ship ONE host buffer."""
+    import jax.numpy as jnp
+    from ..columnar.column import Column
+    npname = _PHYS_TO_NP[phys]
+    np_dt = np.dtype(npname)
+    is_bool = phys == "BOOLEAN"
+    dict_vals = None
+    if chunk.dict_raw is not None and chunk.dict_count:
+        try:
+            dict_vals = jnp.asarray(np.frombuffer(
+                chunk.dict_raw, np_dt, count=chunk.dict_count))
+        except ValueError as e:  # short dict blob: malformed, not a crash
+            raise DeviceDecodeUnsupported(f"truncated dict page: {e}") from e
+    parts = []
+    host_run: List[np.ndarray] = []  # coalesce consecutive host parts
+
+    def flush_host():
+        if host_run:
+            parts.append(jnp.asarray(np.concatenate(host_run)))
+            host_run.clear()
+
+    for p in chunk.pages:
+        if p.kind == "plain":
+            if is_bool:
+                host_run.append(p.payload.astype(np.bool_))
+            else:
+                try:
+                    host_run.append(np.frombuffer(p.payload, np_dt,
+                                                  count=p.ndef))
+                except ValueError as e:  # short value payload
+                    raise DeviceDecodeUnsupported(
+                        f"truncated value page: {e}") from e
+        else:
+            if dict_vals is None:
+                raise DeviceDecodeUnsupported("dict page missing values")
+            flush_host()
+            vals = dict_vals[_expand_indices(p, chunk.dict_count)]
+            parts.append(vals.astype(np.bool_) if is_bool else vals)
+    flush_host()
+    if parts:
+        vals = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+    else:
+        vals = jnp.zeros(0, np.bool_ if is_bool else np_dt)
+    if vals.shape[0] < cap:
+        vals = jnp.pad(vals, (0, cap - vals.shape[0]))
+    data, validity = _scatter_values(vals[:cap], defined)
+    if isinstance(dt, T.DateType):
+        data = data.astype(jnp.int32)
+    elif data.dtype != dt.np_dtype:
+        data = data.astype(dt.np_dtype)
+    return Column(dt, data, validity)
+
+
+def _assemble_strings(chunk: _Chunk, dt, defined, cap: int):
+    """BYTE_ARRAY column -> byte-matrix string layout. Host does only the
+    serial (len, bytes)* offset scans (native byte_array_scan); the device
+    gathers every value span out of the shipped page/dict blobs into
+    uint8[cap, width] (+ int32 lengths) — reference decodes strings on
+    device too (`GpuParquetScan.scala:1796` via libcudf)."""
+    import jax.numpy as jnp
+    from ..columnar.column import Column
+    from ..config import get_default_conf
+    from ..native import runtime as _native
+
+    # pass 1: lay out the device blob — plain page payloads in page order,
+    # dictionary values (if any) at the end
+    plain_bases = {}
+    base = 0
+    for i, p in enumerate(chunk.pages):
+        if p.kind == "plain":
+            plain_bases[i] = base
+            base += len(p.payload)
+    dict_base = base
+    blob_np_parts = [np.frombuffer(p.payload, np.uint8)
+                     for p in chunk.pages if p.kind == "plain"]
+    max_len = 1
+    dict_starts = dict_lens = None
+    if any(p.kind == "dict" for p in chunk.pages):
+        if chunk.dict_raw is None:
+            raise DeviceDecodeUnsupported("dict page missing values")
+        dict_blob = np.frombuffer(chunk.dict_raw, np.uint8)
+        try:
+            dst, dln, dmx = _native.byte_array_scan(dict_blob,
+                                                    chunk.dict_count)
+        except ValueError as e:
+            raise DeviceDecodeUnsupported(str(e)) from e
+        blob_np_parts.append(dict_blob)
+        dict_starts = jnp.asarray(dst + dict_base)
+        dict_lens = jnp.asarray(dln)
+        max_len = max(max_len, dmx)
+
+    # pass 2: per-value (start, len) streams in page order; consecutive
+    # plain pages coalesce into ONE host concat + transfer (many tiny
+    # pages must not become many tiny H2D copies)
+    st_parts, ln_parts = [], []
+    st_run: List[np.ndarray] = []
+    ln_run: List[np.ndarray] = []
+
+    def flush_host():
+        if st_run:
+            st_parts.append(jnp.asarray(np.concatenate(st_run)))
+            ln_parts.append(jnp.asarray(np.concatenate(ln_run)))
+            st_run.clear()
+            ln_run.clear()
+
+    for i, p in enumerate(chunk.pages):
+        if p.ndef == 0:
+            continue
+        if p.kind == "plain":
+            pl = np.frombuffer(p.payload, np.uint8)
+            try:
+                st, ln, mx = _native.byte_array_scan(pl, p.ndef)
+            except ValueError as e:
+                raise DeviceDecodeUnsupported(str(e)) from e
+            max_len = max(max_len, mx)
+            st_run.append(st + plain_bases[i])
+            ln_run.append(ln)
+        else:
+            flush_host()
+            idx = _expand_indices(p, chunk.dict_count)
+            st_parts.append(dict_starts[idx])
+            ln_parts.append(dict_lens[idx])
+    flush_host()
+
+    from ..columnar.padding import width_bucket
+    width = width_bucket(max_len)
+    if width > get_default_conf().string_max_width:
+        raise DeviceDecodeUnsupported(
+            f"string width {max_len} exceeds device layout limit")
+    if st_parts:
+        starts = st_parts[0] if len(st_parts) == 1 else \
+            jnp.concatenate(st_parts)
+        lens = ln_parts[0] if len(ln_parts) == 1 else \
+            jnp.concatenate(ln_parts)
+    else:
+        starts = jnp.zeros(0, jnp.int64)
+        lens = jnp.zeros(0, jnp.int32)
+    if starts.shape[0] < cap:
+        starts = jnp.pad(starts, (0, cap - starts.shape[0]))
+        lens = jnp.pad(lens, (0, cap - lens.shape[0]))
+    blob = jnp.asarray(np.concatenate(blob_np_parts) if blob_np_parts
+                       else np.zeros(1, np.uint8))
+    matrix, lengths = _gather_strings(blob, starts[:cap], lens[:cap],
+                                      defined, width)
+    return Column(dt, matrix, defined, lengths)
 
 
 def device_decode_file(pf, path: str, schema) -> Iterator:

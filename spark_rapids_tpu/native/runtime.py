@@ -67,6 +67,9 @@ def _bind(lib: ctypes.CDLL) -> None:
                                             ctypes.c_int64, u8p, i64p]
     lib.srtpu_sum_lengths.restype = ctypes.c_int64
     lib.srtpu_sum_lengths.argtypes = [i32p, ctypes.c_int64]
+    lib.srtpu_byte_array_scan.restype = ctypes.c_int64
+    lib.srtpu_byte_array_scan.argtypes = [u8p, ctypes.c_int64,
+                                          ctypes.c_int64, i64p, i32p]
     lib.srtpu_arena_init.restype = ctypes.c_int32
     lib.srtpu_arena_init.argtypes = [ctypes.c_int64]
     lib.srtpu_arena_alloc.restype = ctypes.c_void_p
@@ -144,6 +147,39 @@ def offsets_to_matrix(chars: np.ndarray, offsets: np.ndarray, width: int,
     if rc != 0:
         raise ValueError("string exceeds matrix width")
     return matrix, lengths
+
+
+def byte_array_scan(blob: np.ndarray, n: int) -> tuple:
+    """Parquet PLAIN BYTE_ARRAY stream -> (starts int64[n], lens int32[n],
+    max_len). The serial (u32 len, bytes)* prefix walk — native when built,
+    numpy/python loop otherwise. Raises ValueError on a truncated stream."""
+    starts = np.empty(n, np.int64)
+    lens = np.empty(n, np.int32)
+    blob = np.ascontiguousarray(blob, np.uint8)
+    lib = _load()
+    if lib is not None:
+        mx = lib.srtpu_byte_array_scan(
+            _u8(blob), blob.shape[0], n,
+            starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if mx < 0:
+            raise ValueError("truncated BYTE_ARRAY stream")
+        return starts, lens, int(mx)
+    view = blob.view()
+    pos, total, mx = 0, blob.shape[0], 0
+    for i in range(n):
+        if pos + 4 > total:
+            raise ValueError("truncated BYTE_ARRAY stream")
+        ln = int(view[pos]) | (int(view[pos + 1]) << 8) | \
+            (int(view[pos + 2]) << 16) | (int(view[pos + 3]) << 24)
+        pos += 4
+        if pos + ln > total:
+            raise ValueError("truncated BYTE_ARRAY stream")
+        starts[i] = pos
+        lens[i] = ln
+        mx = max(mx, ln)
+        pos += ln
+    return starts, lens, mx
 
 
 def matrix_to_offsets(matrix: np.ndarray,

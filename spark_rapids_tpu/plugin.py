@@ -131,7 +131,18 @@ class TpuSession:
             result = plan
 
         if isinstance(result, TpuExec):
-            host_batches = [device_batch_to_host(b) for b in result.execute()]
+            from .errors import CpuFallbackRequired
+            try:
+                host_batches = [device_batch_to_host(b)
+                                for b in result.execute()]
+            except CpuFallbackRequired:
+                # the device layout cannot represent this data (e.g. a
+                # string wider than the byte-matrix limit surfacing
+                # mid-stream): re-run the stage on the host engine — plan
+                # sources are idempotent, so a from-scratch CPU pass is
+                # safe (the reference's whole-plan willNotWork fallback,
+                # applied at runtime)
+                host_batches = list(plan.execute_cpu())
         else:
             host_batches = list(result.execute_cpu())
         merged = _concat_host(host_batches, plan.output)

@@ -88,23 +88,87 @@ class TestDeviceParquetDecode:
         assert out.column("l").to_pylist() == exact.column("l").to_pylist()
         assert out.column("i").to_pylist() == exact.column("i").to_pylist()
 
-    def test_dictionary_files_fall_back(self, session, rng, tmp_path):
+    def test_dictionary_files_take_device_path(self, session, rng,
+                                               tmp_path):
+        # round-2 verdict item 3 INVERTED: default pyarrow output
+        # (dictionary-encoded) now decodes on device
         t = plain_table(rng, n=500)
         path = str(tmp_path / "dict.parquet")
         pq.write_table(t, path, use_dictionary=True)
-        used, _ = _used_device_decode(session, path)
-        assert not used  # clean fallback, and results still correct:
+        used, first = _used_device_decode(session, path)
+        assert used and first is not None
         df = session.read_parquet(path)
-        assert df.collect().num_rows == 500
+        got = df.collect()
+        exact = pq.read_table(path)
+        for name in t.schema.names:
+            assert got.column(name).to_pylist() == \
+                exact.column(name).to_pylist(), name
 
-    def test_string_columns_fall_back(self, session, rng, tmp_path):
-        t = pa.table({"s": pa.array(["a", "bb", None, "ccc"])})
+    def test_plain_strings_take_device_path(self, session, rng, tmp_path):
+        t = pa.table({"s": pa.array(["a", "bb", None, "ccc", "", None,
+                                     "ünïcødé 字", "x" * 100])})
         path = write_plain(tmp_path, t)
         used, _ = _used_device_decode(session, path)
-        assert not used
+        assert used
         df = session.read_parquet(path)
-        assert df.collect().column("s").to_pylist() == ["a", "bb", None,
-                                                        "ccc"]
+        assert df.collect().column("s").to_pylist() == \
+            t.column("s").to_pylist()
+
+    def test_dict_strings_take_device_path(self, session, rng, tmp_path):
+        n = 3000
+        words = ["alpha", "beta", "gamma", "δδδ", "", "longer-value-here"]
+        vals = [None if rng.random() < 0.15 else
+                words[int(rng.integers(0, len(words)))] for _ in range(n)]
+        t = pa.table({"s": pa.array(vals, type=pa.string()),
+                      "l": pa.array(rng.integers(0, 50, n))})
+        path = str(tmp_path / "ds.parquet")
+        pq.write_table(t, path, use_dictionary=True)
+        used, _ = _used_device_decode(session, path)
+        assert used
+        df = session.read_parquet(path)
+        got = df.collect()
+        assert got.column("s").to_pylist() == vals
+        assert got.column("l").to_pylist() == t.column("l").to_pylist()
+
+    def test_dict_to_plain_spill_pages(self, session, rng, tmp_path):
+        # parquet writers fall back to PLAIN mid-chunk once the dictionary
+        # outgrows its limit: chunks carry BOTH dict and plain data pages
+        n = 6000
+        vals = ["s%08d" % int(v) for v in rng.integers(0, n, n)]
+        t = pa.table({"s": pa.array(vals)})
+        path = str(tmp_path / "spill.parquet")
+        pq.write_table(t, path, use_dictionary=True,
+                       dictionary_pagesize_limit=1024, data_page_size=2048)
+        df = session.read_parquet(path)
+        assert df.collect().column("s").to_pylist() == vals
+
+    def test_dict_many_small_pages_with_nulls(self, session, rng,
+                                              tmp_path):
+        n = 4000
+        base = rng.integers(0, 40, n)
+        mask = rng.random(n) < 0.25
+        t = pa.table({"v": pa.array(base * 1000, mask=mask),
+                      "f": pa.array(base.astype(np.float64) / 3,
+                                    mask=~mask)})
+        path = str(tmp_path / "dsmall.parquet")
+        pq.write_table(t, path, use_dictionary=True, data_page_size=300)
+        used, _ = _used_device_decode(session, path)
+        assert used
+        got = session.read_parquet(path).collect()
+        exact = pq.read_table(path)
+        assert got.column("v").to_pylist() == exact.column("v").to_pylist()
+        assert got.column("f").to_pylist() == exact.column("f").to_pylist()
+
+    def test_overwide_strings_fall_back(self, session, rng, tmp_path):
+        # exceeds spark.rapids.tpu.string.maxWidth: the DEVICE-planned
+        # query must still answer (runtime CpuFallbackRequired -> host
+        # re-run), not crash with StringWidthExceeded
+        wide = "w" * 20000
+        t = pa.table({"s": pa.array(["a", wide, "b"])})
+        path = write_plain(tmp_path, t)
+        df = session.read_parquet(path)
+        assert df.collect().column("s").to_pylist() == ["a", wide, "b"]
+        assert df.collect_cpu().column("s").to_pylist() == ["a", wide, "b"]
 
     def test_bool_across_many_small_pages(self, session, rng, tmp_path):
         # page bit-packing restarts per page: misalignment regression test
