@@ -245,10 +245,8 @@ def from_arrow(arr, capacity: Optional[int] = None) -> Tuple[Column, int]:
     npdt = dtype.np_dtype
     if npdt is None:
         if dtype.is_nested:
-            if isinstance(dtype, T.MapType):
-                raise TypeError(f"map type not yet device-backed: {arr.type}")
-            # array/struct: build the exact-length host form, then pad the
-            # leading dim of every buffer to the capacity bucket and ship
+            # array/struct/map: build the exact-length host form, then pad
+            # the leading dim of every buffer to the capacity bucket and ship
             from ..cpu.hostbatch import host_vec_from_arrow, vec_map_arrays
             hv = host_vec_from_arrow(arr)
 

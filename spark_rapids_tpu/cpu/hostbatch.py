@@ -48,25 +48,20 @@ def host_vec_from_arrow(arr) -> Vec:
         la = arr.cast(pa.large_list(arr.type.value_type))
         offs = np.frombuffer(la.buffers()[1], dtype=np.int64, count=n + 1,
                              offset=la.offset * 8)
-        lens_raw = np.diff(offs)
-        lens = np.where(valid, lens_raw, 0).astype(np.int32)
-        k = width_bucket(int(lens.max())) if n and lens.size else 8
-        child = host_vec_from_arrow(la.values)
-        row_id = np.repeat(np.arange(n), lens)
-        within = (np.arange(row_id.size) -
-                  np.repeat(np.concatenate(([0], np.cumsum(lens)[:-1])), lens)) \
-            if n else np.zeros(0, np.int64)
-        src = np.repeat(offs[:-1], lens) + within if n else \
-            np.zeros(0, np.int64)
-
-        def scatter(leaf):
-            out = np.zeros((n, k) + leaf.shape[1:], dtype=leaf.dtype)
-            if row_id.size:
-                out[row_id, within] = leaf[src]
-            return out
-
-        elem = vec_map_arrays(child, scatter)
+        lens, scatter = _fanout_scatter(n, valid, offs)
+        elem = vec_map_arrays(host_vec_from_arrow(la.values), scatter)
         return Vec(dtype, lens, valid, None, (elem,))
+    if isinstance(dtype, T.MapType):
+        # map layout = array layout with (keys, values) children: per-row
+        # entry count + [n, K] parallel key/value matrices.
+        # MapArray.offsets is already windowed to [n+1]; keys/items are the
+        # full child arrays the offsets index into (verified behavior)
+        offs = np.asarray(arr.offsets, dtype=np.int64)
+        lens, scatter = _fanout_scatter(n, valid, offs)
+        return Vec(dtype, lens, valid, None,
+                   (vec_map_arrays(host_vec_from_arrow(arr.keys), scatter),
+                    vec_map_arrays(host_vec_from_arrow(arr.items),
+                                   scatter)))
     if isinstance(dtype, T.StructType):
         kids = tuple(host_vec_from_arrow(arr.field(i))
                      for i in range(arr.type.num_fields))
@@ -117,6 +112,29 @@ def host_vec_from_arrow(arr) -> Vec:
     return Vec(dtype, np.ascontiguousarray(vals).astype(npdt, copy=False), valid)
 
 
+def _fanout_scatter(n: int, valid: np.ndarray, offs: np.ndarray):
+    """Shared offsets->fixed-fanout machinery for list-shaped layouts
+    (arrays and maps): per-row lengths plus a closure scattering any flat
+    child buffer into its [n, K] slot matrix."""
+    lens_raw = offs[1:] - offs[:-1]
+    lens = np.where(valid, lens_raw, 0).astype(np.int32)
+    k = width_bucket(int(lens.max())) if n and lens.size else 8
+    row_id = np.repeat(np.arange(n), lens)
+    within = (np.arange(row_id.size) -
+              np.repeat(np.concatenate(([0], np.cumsum(lens)[:-1])), lens)) \
+        if n else np.zeros(0, np.int64)
+    src = np.repeat(offs[:-1], lens) + within if n else \
+        np.zeros(0, np.int64)
+
+    def scatter(leaf):
+        out = np.zeros((n, k) + leaf.shape[1:], dtype=leaf.dtype)
+        if row_id.size:
+            out[row_id, within] = leaf[src]
+        return out
+
+    return lens, scatter
+
+
 def host_batch_from_arrow(table) -> HostBatch:
     vecs = [host_vec_from_arrow(table.column(n)) for n in table.schema.names]
     return HostBatch(Schema.from_arrow(table.schema), vecs, table.num_rows)
@@ -151,6 +169,30 @@ def host_vec_to_arrow(v: Vec, num_rows: Optional[int] = None):
                  out.buffers()[1]],
                 null_count=int(mask.sum()), children=[values])
         return out.cast(pa.list_(out.type.value_type))
+    if isinstance(v.dtype, T.MapType):
+        lens = np.where(valid, np.asarray(v.data[:n]), 0).astype(np.int64)
+        keys_m, items_m = v.children
+        k = keys_m.validity.shape[1] if keys_m.validity.ndim >= 2 else 0
+        keep = (np.arange(k)[None, :] < lens[:, None]) if n and k else \
+            np.zeros((n, k), dtype=bool)
+
+        def flatten(leaf):
+            return np.asarray(leaf[:n])[keep]
+
+        total = int(lens.sum())
+        keys_a = host_vec_to_arrow(vec_map_arrays(keys_m, flatten), total)
+        items_a = host_vec_to_arrow(vec_map_arrays(items_m, flatten), total)
+        offsets = np.concatenate(([0], np.cumsum(lens))).astype(np.int32)
+        out = pa.MapArray.from_arrays(offsets, keys_a, items_a)
+        if mask.any():
+            out = pa.Array.from_buffers(
+                out.type, n,
+                [pa.py_buffer(np.packbits(valid,
+                                          bitorder="little").tobytes()),
+                 out.buffers()[1]],
+                null_count=int(mask.sum()),
+                children=[out.values])
+        return out
     if isinstance(v.dtype, T.StructType):
         fields = [host_vec_to_arrow(c, n) for c in v.children]
         return pa.StructArray.from_arrays(

@@ -79,7 +79,8 @@ class TpuProjectExec(UnaryTpuExec):
 
     def _has_host_black_box(self) -> bool:
         from ..udf.pandas_udf import PandasUDF
-        return any(e.collect(lambda x: isinstance(x, PandasUDF))
+        return any(e.collect(lambda x: isinstance(x, PandasUDF) or
+                             getattr(x, "needs_eager", False))
                    for e in self._bound)
 
     @property

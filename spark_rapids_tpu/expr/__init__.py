@@ -46,6 +46,8 @@ from .windowexprs import (RowFrame, RangeFrame, WindowFunction, RowNumber,  # no
                           Lag, WindowAggregate, NthValue)
 from .regex import (RLike, Like, RegExpReplace, RegExpExtract,  # noqa: F401
                     device_supported_pattern)
+from .maps import (MapKeys, MapValues, MapEntries, GetMapValue,  # noqa: F401
+                   CreateMap, MapFromArrays, MapConcat, StringToMap)
 from .collections import (Size, GetArrayItem, ElementAt, ArrayContains,  # noqa: F401
                           CreateArray, CreateNamedStruct, GetStructField,
                           Explode, ArrayMin, ArrayMax, SortArray)

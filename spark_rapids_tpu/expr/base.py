@@ -127,6 +127,13 @@ def zero_vec(xp, dt: T.DataType, shape: tuple) -> Vec:
     if isinstance(dt, T.ArrayType):
         return Vec(dt, xp.zeros(shape, dtype=xp.int32), validity, None,
                    (zero_vec(xp, dt.element_type, shape + (8,)),))
+    if isinstance(dt, T.MapType):
+        # map<k,v> rides the array layout: per-row entry count + parallel
+        # key/value children at [*, K] (structurally array<struct<k,v>>,
+        # the same shape Arrow and Spark give maps)
+        return Vec(dt, xp.zeros(shape, dtype=xp.int32), validity, None,
+                   (zero_vec(xp, dt.key_type, shape + (8,)),
+                    zero_vec(xp, dt.value_type, shape + (8,))))
     if isinstance(dt, T.StructType):
         return Vec(dt, xp.zeros(shape, dtype=bool), validity, None,
                    tuple(zero_vec(xp, f.data_type, shape) for f in dt.fields))

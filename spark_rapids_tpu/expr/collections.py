@@ -67,20 +67,33 @@ class GetArrayItem(Expression):
 
 
 class ElementAt(Expression):
-    """element_at(array, i) — 1-based; negative counts from the end."""
+    """element_at(array, i) — 1-based; negative counts from the end.
+    element_at(map, key) — lookup; ANSI raises on a missing key."""
 
     def __init__(self, child: Expression, ordinal: Expression):
         super().__init__([child, ordinal])
 
     @property
     def data_type(self):
-        return self.children[0].data_type.element_type
+        ct = self.children[0].data_type
+        if isinstance(ct, T.MapType):
+            return ct.value_type
+        return ct.element_type
 
     @property
     def nullable(self):
         return True
 
+    @property
+    def has_side_effects(self) -> bool:
+        # the map form raises on a missing key under ANSI; only
+        # Project/Filter kernels plumb traced error flags back to the host
+        return isinstance(self.children[0].data_type, T.MapType)
+
     def _compute(self, ctx: EvalContext, arr: Vec, idx: Vec) -> Vec:
+        if isinstance(arr.dtype, T.MapType):
+            from .maps import map_lookup
+            return map_lookup(ctx, arr, idx, ansi_missing=ctx.ansi)
         xp = ctx.xp
         elem = arr.children[0]
         n = arr.data.shape[0]
@@ -147,47 +160,13 @@ class CreateArray(Expression):
 
     def _compute(self, ctx: EvalContext, *elems: Vec) -> Vec:
         xp = ctx.xp
+        from .maps import _stack_slots  # one slot-stacking implementation
         nelem = len(elems)
         n = elems[0].data.shape[0]
-        k = width_bucket(nelem)
-        first = elems[0]
-        if first.is_nested:
-            raise NotImplementedError(
-                "array() of nested elements is not supported")
-
-        if first.is_string:
-            w = max(e.data.shape[1] for e in elems)
-            data = xp.zeros((n, k, w), dtype=xp.uint8)
-            lens = xp.zeros((n, k), dtype=xp.int32)
-            validity = xp.zeros((n, k), dtype=bool)
-            for j, e in enumerate(elems):
-                data = data.at[:, j, :e.data.shape[1]].set(e.data) \
-                    if hasattr(data, "at") else _np_set3(data, j, e.data)
-                lens = _set_col(xp, lens, j, e.lengths)
-                validity = _set_col(xp, validity, j, e.validity)
-            child = Vec(first.dtype, data, validity, lens)
-        else:
-            data = xp.zeros((n, k), dtype=first.data.dtype)
-            validity = xp.zeros((n, k), dtype=bool)
-            for j, e in enumerate(elems):
-                data = _set_col(xp, data, j, e.data)
-                validity = _set_col(xp, validity, j, e.validity)
-            child = Vec(first.dtype, data, validity)
+        child = _stack_slots(xp, elems, width_bucket(nelem))
         sizes = xp.full(n, nelem, dtype=xp.int32)
         return Vec(self.data_type, sizes, xp.ones(n, dtype=bool), None,
                    (child,))
-
-
-def _set_col(xp, mat, j, col):
-    if hasattr(mat, "at"):  # jax
-        return mat.at[:, j].set(col)
-    mat[:, j] = col  # numpy (CPU engine)
-    return mat
-
-
-def _np_set3(mat, j, rows):
-    mat[:, j, :rows.shape[1]] = rows
-    return mat
 
 
 class Explode(Expression):

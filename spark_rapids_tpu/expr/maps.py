@@ -1,0 +1,591 @@
+"""Map expressions over the fixed-fanout nested layout (reference:
+`GpuOverrides.scala:3416` CreateMap, `:2423` GetMapValue, `:2442,2455`
+MapKeys/MapValues, `:2468` MapEntries, `:2482` StringToMap,
+`complexTypeExtractors.scala` GetMapValueUtil, `collectionOperations.scala`
+MapConcat/MapFromArrays).
+
+Layout recap (expr/base.py Vec): a map column's `data` is the per-row entry
+count; `children` = (keys Vec, values Vec) with leading dims [n, K] —
+structurally array<struct<k,v>>, the shape Arrow and Spark give maps, so all
+row-wise machinery (gather/compact/spill/shuffle) applies unchanged.
+
+Error semantics follow Spark's defaults: null map keys always raise
+([NULL_MAP_KEY]), duplicate keys raise under the default EXCEPTION dedup
+policy ([DUPLICATED_MAP_KEY]), and element_at on a missing key raises only
+under ANSI ([MAP_KEY_DOES_NOT_EXIST]). Duplicate detection compares keys
+via two independent 64-bit polynomial hashes for strings (exact planes for
+every other type): a false positive needs a 2^-128 double collision."""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from .. import types as T
+from ..columnar.padding import width_bucket
+from ..errors import CpuFallbackRequired
+from .base import (EvalContext, Expression, Vec, and_validity, ansi_raise,
+                   vec_map_arrays as _map_elem)
+
+__all__ = ["MapKeys", "MapValues", "MapEntries", "GetMapValue", "CreateMap",
+           "MapFromArrays", "MapConcat", "StringToMap", "map_lookup",
+           "slot_probe_eq"]
+
+_NULL_KEY = "[NULL_MAP_KEY] Cannot use null as map key"
+_DUP_KEY = ("[DUPLICATED_MAP_KEY] Duplicate map key was found, please check "
+            "the input data")
+
+
+def _pad_last(xp, a, w: int):
+    if a.shape[-1] == w:
+        return a
+    pad = [(0, 0)] * (a.ndim - 1) + [(0, w - a.shape[-1])]
+    return xp.pad(a, pad)
+
+
+def slot_probe_eq(xp, elem: Vec, probe: Vec):
+    """Element slots [n, K, ...] vs a per-row probe [n, ...] -> bool[n, K].
+    Spark map-key equality: floats use normalized semantics (NaN == NaN)."""
+    if elem.is_string:
+        w = max(elem.data.shape[2], probe.data.shape[1])
+        da = _pad_last(xp, elem.data, w)
+        db = _pad_last(xp, probe.data, w)
+        return xp.all(da == db[:, None, :], axis=2) & \
+            (elem.lengths == probe.lengths[:, None])
+    if elem.data.ndim == 3:  # decimal128 limbs [n, K, 2]
+        return xp.all(elem.data == probe.data[:, None, :], axis=2)
+    if T.is_floating(elem.dtype):
+        return (elem.data == probe.data[:, None]) | \
+            (xp.isnan(elem.data) & xp.isnan(probe.data)[:, None])
+    return elem.data == probe.data[:, None]
+
+
+def _pair_eq(xp, a: Vec, b: Vec):
+    """Row-wise equality of two same-typed [n] Vecs (for dup-key checks)."""
+    if a.is_string:
+        from .predicates import string_equal
+        return string_equal(xp, a, b)
+    if a.data.ndim == 2:  # decimal128
+        return xp.all(a.data == b.data, axis=1)
+    if T.is_floating(a.dtype):
+        return (a.data == b.data) | (xp.isnan(a.data) & xp.isnan(b.data))
+    return a.data == b.data
+
+
+def _key_planes(xp, keys: Vec) -> List:
+    """[n, K] arrays whose joint slot-equality equals key equality — exact
+    for fixed-width types, double-64-bit-hash for strings."""
+    if keys.is_string:
+        data = keys.data.astype(np.uint64)
+        w = data.shape[2]
+        planes = []
+        for mult in (np.uint64(1099511628211), np.uint64(6364136223846793005)):
+            powers = xp.asarray(
+                np.array([int(pow(int(mult), c, 1 << 64)) for c in range(w)],
+                         dtype=np.uint64))
+            h = (data * powers[None, None, :]).sum(axis=2)
+            planes.append(h * mult + keys.lengths.astype(np.uint64))
+        return planes
+    if keys.data.ndim == 3:  # decimal128 limbs
+        return [keys.data[:, :, 0], keys.data[:, :, 1]]
+    if T.is_floating(keys.dtype):
+        # normalize NaN and -0.0 so equal-by-Spark keys share a bit image
+        d = keys.data
+        d = xp.where(xp.isnan(d), xp.full((), np.nan, d.dtype), d)
+        d = xp.where(d == 0, xp.zeros((), d.dtype), d)
+        if xp is np:
+            bits = np.ascontiguousarray(d.astype(np.float64)).view(np.int64)
+        else:
+            from jax import lax
+            bits = lax.bitcast_convert_type(d.astype(np.float64), np.int64)
+        return [bits]
+    return [keys.data]
+
+
+def _check_dup_keys(ctx: EvalContext, keys: Vec, counts, validity) -> None:
+    """Raise [DUPLICATED_MAP_KEY] where two live slots hold equal keys."""
+    xp = ctx.xp
+    k = keys.validity.shape[1]
+    if k > 256:
+        raise CpuFallbackRequired(
+            f"map dup-key check over fanout {k} exceeds the device "
+            "pairwise budget")
+    planes = _key_planes(xp, keys)
+    live = xp.arange(k)[None, :] < counts[:, None]
+    eq = None
+    for p in planes:
+        e = p[:, :, None] == p[:, None, :]
+        eq = e if eq is None else (eq & e)
+    pair_live = live[:, :, None] & live[:, None, :]
+    upper = xp.asarray(np.triu(np.ones((k, k), dtype=bool), 1))
+    dup = (eq & pair_live & upper[None, :, :]).any(axis=(1, 2))
+    ansi_raise(ctx, dup & validity, _DUP_KEY)
+
+
+def map_lookup(ctx: EvalContext, mp: Vec, key: Vec,
+               ansi_missing: bool) -> Vec:
+    """map[key] / element_at(map, key): first matching live slot's value;
+    null when missing (ANSI element_at raises instead)."""
+    xp = ctx.xp
+    keys, values = mp.children
+    n = mp.data.shape[0]
+    k = keys.validity.shape[1]
+    live = xp.arange(k)[None, :] < mp.data[:, None]
+    hit = live & slot_probe_eq(xp, keys, key)
+    found = hit.any(axis=1)
+    pick = xp.argmax(hit, axis=1)
+    rows = xp.arange(n)
+    out = _map_elem(values, lambda a: a[rows, pick])
+    ok = mp.validity & key.validity
+    if ansi_missing:
+        ansi_raise(ctx, ok & ~found,
+                   "[MAP_KEY_DOES_NOT_EXIST] Key does not exist in the map")
+    return Vec(out.dtype, out.data, out.validity & ok & found, out.lengths,
+               out.children)
+
+
+class MapKeys(Expression):
+    """map_keys(m) -> array of keys (no nulls among elements)."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        mt = self.children[0].data_type
+        return T.ArrayType(mt.key_type, contains_null=False)
+
+    def _compute(self, ctx: EvalContext, mp: Vec) -> Vec:
+        return Vec(self.data_type, mp.data, mp.validity, None,
+                   (mp.children[0],))
+
+
+class MapValues(Expression):
+    """map_values(m) -> array of values."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        mt = self.children[0].data_type
+        return T.ArrayType(mt.value_type, contains_null=True)
+
+    def _compute(self, ctx: EvalContext, mp: Vec) -> Vec:
+        return Vec(self.data_type, mp.data, mp.validity, None,
+                   (mp.children[1],))
+
+
+class MapEntries(Expression):
+    """map_entries(m) -> array<struct<key,value>>."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        mt = self.children[0].data_type
+        return T.ArrayType(T.StructType((
+            T.StructField("key", mt.key_type, False),
+            T.StructField("value", mt.value_type, True))))
+
+    def _compute(self, ctx: EvalContext, mp: Vec) -> Vec:
+        xp = ctx.xp
+        keys, values = mp.children
+        ones = xp.ones(keys.validity.shape, dtype=bool)
+        st = self.data_type.element_type
+        entry = Vec(st, ones, ones, None, (keys, values))
+        return Vec(self.data_type, mp.data, mp.validity, None, (entry,))
+
+
+class GetMapValue(Expression):
+    """m[key] — null when the key is absent (post-3.0 Spark never raises
+    here; element_at is the ANSI-raising form)."""
+
+    def __init__(self, child: Expression, key: Expression):
+        super().__init__([child, key])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type.value_type
+
+    @property
+    def nullable(self):
+        return True
+
+    def _compute(self, ctx: EvalContext, mp: Vec, key: Vec) -> Vec:
+        return map_lookup(ctx, mp, key, ansi_missing=False)
+
+
+class CreateMap(Expression):
+    """map(k1, v1, k2, v2, ...). Null keys raise; duplicate keys raise
+    (default EXCEPTION dedup policy)."""
+
+    def __init__(self, children: Sequence[Expression]):
+        assert len(children) % 2 == 0
+        super().__init__(list(children))
+
+    @property
+    def data_type(self):
+        if not self.children:
+            # Spark types the empty map() as map<string,string>
+            return T.MapType(T.STRING, T.STRING)
+        return T.MapType(self.children[0].data_type,
+                         self.children[1].data_type)
+
+    @property
+    def nullable(self):
+        return False
+
+    @property
+    def has_side_effects(self) -> bool:
+        return True  # null/dup key errors
+
+    def _compute(self, ctx: EvalContext, *kv: Vec) -> Vec:
+        xp = ctx.xp
+        if not kv:  # SELECT map() -> empty map per row
+            from .base import zero_vec
+            n = ctx.row_mask.shape[0] if ctx.row_mask is not None else 1
+            empty = zero_vec(xp, self.data_type, (n,))
+            return Vec(self.data_type, empty.data, xp.ones(n, dtype=bool),
+                       None, empty.children)
+        keys = kv[0::2]
+        vals = kv[1::2]
+        npairs = len(keys)
+        n = kv[0].data.shape[0]
+        k = width_bucket(npairs)
+        null_key = xp.zeros(n, dtype=bool)
+        for kvec in keys:
+            null_key = null_key | ~kvec.validity
+        ansi_raise(ctx, null_key, _NULL_KEY)
+        dup = xp.zeros(n, dtype=bool)
+        for i in range(npairs):
+            for j in range(i + 1, npairs):
+                dup = dup | _pair_eq(xp, keys[i], keys[j])
+        ansi_raise(ctx, dup, _DUP_KEY)
+        key_child = _stack_slots(xp, keys, k)
+        val_child = _stack_slots(xp, vals, k)
+        sizes = xp.full(n, npairs, dtype=np.int32)
+        return Vec(self.data_type, sizes, xp.ones(n, dtype=bool), None,
+                   (key_child, val_child))
+
+
+def _set_slot(xp, mat, j, val):
+    if hasattr(mat, "at"):
+        return mat.at[:, j].set(val)
+    mat[:, j] = val
+    return mat
+
+
+def _stack_slots(xp, elems: Sequence[Vec], k: int) -> Vec:
+    """[n] Vecs -> one [n, K] element Vec (generalizes CreateArray's build
+    to strings and decimal128)."""
+    first = elems[0]
+    n = first.data.shape[0]
+    if first.is_nested:
+        raise CpuFallbackRequired("map() of nested key/value exprs")
+    if first.is_string:
+        w = max(e.data.shape[1] for e in elems)
+        data = xp.zeros((n, k, w), dtype=np.uint8)
+        lens = xp.zeros((n, k), dtype=np.int32)
+        validity = xp.zeros((n, k), dtype=bool)
+        for j, e in enumerate(elems):
+            ed = _pad_last(xp, e.data, w)
+            if hasattr(data, "at"):
+                data = data.at[:, j, :].set(ed)
+            else:
+                data[:, j, :] = ed
+            lens = _set_slot(xp, lens, j, e.lengths)
+            validity = _set_slot(xp, validity, j, e.validity)
+        return Vec(first.dtype, data, validity, lens)
+    if first.data.ndim == 2:  # decimal128 limbs
+        data = xp.zeros((n, k, 2), dtype=np.int64)
+        validity = xp.zeros((n, k), dtype=bool)
+        for j, e in enumerate(elems):
+            if hasattr(data, "at"):
+                data = data.at[:, j, :].set(e.data)
+            else:
+                data[:, j, :] = e.data
+            validity = _set_slot(xp, validity, j, e.validity)
+        return Vec(first.dtype, data, validity)
+    data = xp.zeros((n, k), dtype=first.data.dtype)
+    validity = xp.zeros((n, k), dtype=bool)
+    for j, e in enumerate(elems):
+        data = _set_slot(xp, data, j, e.data)
+        validity = _set_slot(xp, validity, j, e.validity)
+    return Vec(first.dtype, data, validity)
+
+
+def _grow_fanout(xp, elem: Vec, k: int) -> Vec:
+    cur = elem.validity.shape[1]
+    if cur == k:
+        return elem
+
+    def grow(a):
+        pad = [(0, 0), (0, k - cur)] + [(0, 0)] * (a.ndim - 2)
+        return xp.pad(a, pad)
+
+    return _map_elem(elem, grow)
+
+
+class MapFromArrays(Expression):
+    """map_from_arrays(keys_array, values_array)."""
+
+    def __init__(self, keys: Expression, values: Expression):
+        super().__init__([keys, values])
+
+    @property
+    def data_type(self):
+        return T.MapType(self.children[0].data_type.element_type,
+                         self.children[1].data_type.element_type)
+
+    @property
+    def has_side_effects(self) -> bool:
+        return True
+
+    def _compute(self, ctx: EvalContext, ka: Vec, va: Vec) -> Vec:
+        xp = ctx.xp
+        keys = ka.children[0]
+        vals = va.children[0]
+        validity = and_validity(xp, ka.validity, va.validity)
+        mismatch = (ka.data != va.data) & validity
+        ansi_raise(ctx, mismatch,
+                   "The key array and value array of MapData must have the "
+                   "same length")
+        k = keys.validity.shape[1]
+        live = xp.arange(k)[None, :] < ka.data[:, None]
+        null_key = (live & ~keys.validity).any(axis=1) & validity
+        ansi_raise(ctx, null_key, _NULL_KEY)
+        _check_dup_keys(ctx, keys, ka.data, validity)
+        kw = vals.validity.shape[1]
+        if kw != k:  # align fanout buckets
+            target = max(k, kw)
+            keys = _grow_fanout(xp, keys, target)
+            vals = _grow_fanout(xp, vals, target)
+        counts = xp.where(validity, ka.data, 0).astype(np.int32)
+        return Vec(self.data_type, counts, validity, None, (keys, vals))
+
+
+class MapConcat(Expression):
+    """map_concat(m1, m2, ...): entry concatenation; duplicate keys raise
+    (default EXCEPTION dedup policy)."""
+
+    def __init__(self, children: Sequence[Expression]):
+        super().__init__(list(children))
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    @property
+    def has_side_effects(self) -> bool:
+        return True
+
+    def _compute(self, ctx: EvalContext, *maps: Vec) -> Vec:
+        xp = ctx.xp
+        n = maps[0].data.shape[0]
+        total_k = sum(m.children[0].validity.shape[1] for m in maps)
+        k = width_bucket(total_k)
+        validity = maps[0].validity
+        for m in maps[1:]:
+            validity = and_validity(xp, validity, m.validity)
+        counts = xp.zeros(n, dtype=np.int32)
+        keys_cat = _concat_fanout(xp, [m.children[0] for m in maps], k)
+        vals_cat = _concat_fanout(xp, [m.children[1] for m in maps], k)
+        live_cat = xp.zeros((n, k), dtype=bool)
+        off = 0
+        for m in maps:
+            mk = m.children[0].validity.shape[1]
+            sl = xp.arange(mk)[None, :] < m.data[:, None]
+            if hasattr(live_cat, "at"):
+                live_cat = live_cat.at[:, off:off + mk].set(sl)
+            else:
+                live_cat[:, off:off + mk] = sl
+            counts = counts + m.data.astype(np.int32)
+            off += mk
+        # stable compaction: live slots to the front, original order kept
+        order = xp.argsort(
+            xp.where(live_cat, 0, 1) * (2 * k) + xp.arange(k)[None, :],
+            axis=1)
+
+        def take(a):
+            if a.ndim == 2:
+                return xp.take_along_axis(a, order, axis=1)
+            return xp.take_along_axis(
+                a, order.reshape(order.shape + (1,) * (a.ndim - 2)), axis=1)
+
+        keys_c = _map_elem(keys_cat, take)
+        vals_c = _map_elem(vals_cat, take)
+        counts = xp.where(validity, counts, 0)
+        _check_dup_keys(ctx, keys_c, counts, validity)
+        return Vec(self.data_type, counts, validity, None, (keys_c, vals_c))
+
+
+def _concat_fanout(xp, elems: Sequence[Vec], k: int) -> Vec:
+    """Concatenate element Vecs along the slot axis, padding to k slots."""
+    first = elems[0]
+
+    def cat(getter):
+        out = xp.concatenate([getter(e) for e in elems], axis=1)
+        if out.shape[1] < k:
+            pad = [(0, 0), (0, k - out.shape[1])] + \
+                [(0, 0)] * (out.ndim - 2)
+            out = xp.pad(out, pad)
+        return out
+
+    if first.is_string:
+        w = max(e.data.shape[2] for e in elems)
+        return Vec(first.dtype, cat(lambda e: _pad_last(xp, e.data, w)),
+                   cat(lambda e: e.validity), cat(lambda e: e.lengths))
+    return Vec(first.dtype, cat(lambda e: e.data),
+               cat(lambda e: e.validity))
+
+
+class StringToMap(Expression):
+    """str_to_map(text, pairDelim, keyValueDelim) with literal single-byte
+    ASCII delimiters (the planner tags anything else to CPU; the reference
+    similarly restricts to literal non-regex delimiters,
+    `GpuOverrides.scala:2482`). Needs eager evaluation: the output fanout
+    is the observed max pair count, a data-dependent bucket."""
+
+    def __init__(self, child: Expression, pair_delim: str = ",",
+                 kv_delim: str = ":"):
+        super().__init__([child])
+        self.pair_delim = pair_delim
+        self.kv_delim = kv_delim
+
+    @property
+    def data_type(self):
+        return T.MapType(T.STRING, T.STRING)
+
+    @property
+    def has_side_effects(self) -> bool:
+        return True  # duplicate-key errors
+
+    @property
+    def needs_eager(self) -> bool:
+        return True
+
+    def _compute(self, ctx: EvalContext, sv: Vec) -> Vec:
+        xp = ctx.xp
+        n, w = sv.data.shape
+        if len(self.pair_delim) != 1 or len(self.kv_delim) != 1 or \
+                ord(self.pair_delim) > 127 or ord(self.kv_delim) > 127:
+            # the planner tags this off device; the CPU oracle still needs
+            # full semantics for multi-char delimiters
+            if xp is not np:
+                raise CpuFallbackRequired(
+                    "str_to_map with non-single-byte delimiters")
+            return self._compute_host(ctx, sv)
+        pd = np.uint8(ord(self.pair_delim))
+        kd = np.uint8(ord(self.kv_delim))
+        pos32 = xp.arange(w, dtype=np.int32)[None, :]
+        live = pos32 < sv.lengths[:, None]
+        is_pd = (sv.data == pd) & live
+        npairs = xp.where(sv.validity,
+                          is_pd.sum(axis=1).astype(np.int32) + 1, 0)
+        k = width_bucket(max(int(npairs.max()) if n else 1, 1))
+        big = np.int32(w + 1)
+        # pair index of every char (exclusive cumsum of pair delimiters)
+        pc = xp.cumsum(is_pd.astype(np.int32), axis=1) - \
+            is_pd.astype(np.int32)
+        # p-th pair delimiter position per row -> pair boundaries
+        dpos = xp.where(is_pd, pos32, big)
+        dsorted = xp.sort(dpos, axis=1)[:, :k]
+        if dsorted.shape[1] < k:
+            dsorted = xp.pad(dsorted, ((0, 0), (0, k - dsorted.shape[1])),
+                             constant_values=big)
+        lens32 = sv.lengths[:, None].astype(np.int32)
+        ends = xp.minimum(dsorted, lens32)
+        starts = xp.concatenate(
+            [xp.zeros((n, 1), np.int32), dsorted[:, :k - 1] + 1], axis=1)
+        starts = xp.minimum(starts, lens32)
+        pair_live = xp.arange(k, dtype=np.int32)[None, :] < npairs[:, None]
+        # first kv delimiter within each pair: scatter-min char positions
+        # into their pair slot
+        is_kd = (sv.data == kd) & live
+        kv_val = xp.where(is_kd, pos32, big)
+        kvpos = xp.full((n, k), big, dtype=np.int32)
+        rows2 = xp.broadcast_to(xp.arange(n)[:, None], (n, w))
+        pc_c = xp.clip(pc, 0, k - 1)
+        if hasattr(kvpos, "at"):
+            kvpos = kvpos.at[rows2, pc_c].min(kv_val)
+        else:
+            np.minimum.at(kvpos, (rows2, pc_c), kv_val)
+        has_kv = kvpos < ends
+        key_start = starts
+        key_end = xp.where(has_kv, xp.minimum(kvpos, ends), ends)
+        val_start = xp.where(has_kv, kvpos + 1, ends)
+        val_end = ends
+        key_child = _extract_spans(xp, sv.data, key_start, key_end,
+                                   pair_live)
+        val_child = _extract_spans(xp, sv.data, val_start, val_end,
+                                   pair_live & has_kv)
+        _check_dup_keys(ctx, key_child, npairs, sv.validity)
+        return Vec(self.data_type, npairs, sv.validity, None,
+                   (key_child, val_child))
+
+
+    def _compute_host(self, ctx: EvalContext, sv: Vec) -> Vec:
+        """Row-at-a-time host semantics (CPU engine only): literal — not
+        regex — delimiter split, like the device path."""
+        xp = ctx.xp
+        n = sv.data.shape[0]
+        keys_rows, vals_rows = [], []
+        for i in range(n):
+            if not bool(sv.validity[i]):
+                keys_rows.append([])
+                vals_rows.append([])
+                continue
+            s = bytes(np.asarray(sv.data[i, :int(sv.lengths[i])])).decode(
+                "utf-8", "replace")
+            ks, vs = [], []
+            for pair in s.split(self.pair_delim):
+                k, sep, v = pair.partition(self.kv_delim)
+                ks.append(k)
+                vs.append(v if sep else None)
+            keys_rows.append(ks)
+            vals_rows.append(vs)
+        counts = np.array([len(k) for k in keys_rows], np.int32)
+        counts = np.where(np.asarray(sv.validity), counts, 0)
+        k = width_bucket(max(int(counts.max()) if n else 1, 1))
+
+        def build(rows, nullable):
+            wmax = max((len(x.encode()) for r in rows for x in r
+                        if x is not None), default=1)
+            wb = width_bucket(max(wmax, 1))
+            data = np.zeros((n, k, wb), np.uint8)
+            lens = np.zeros((n, k), np.int32)
+            valid = np.zeros((n, k), bool)
+            for i, r in enumerate(rows):
+                for j, x in enumerate(r):
+                    if x is None:
+                        continue
+                    b = x.encode()
+                    data[i, j, :len(b)] = np.frombuffer(b, np.uint8)
+                    lens[i, j] = len(b)
+                    valid[i, j] = True
+            return Vec(T.STRING, data, valid, lens)
+
+        key_child = build(keys_rows, False)
+        val_child = build(vals_rows, True)
+        _check_dup_keys(ctx, key_child, counts, np.asarray(sv.validity))
+        return Vec(self.data_type, counts, np.asarray(sv.validity), None,
+                   (key_child, val_child))
+
+
+def _extract_spans(xp, chars, start, end, valid):
+    """chars [n, W] + per-slot [n, K] spans -> string element Vec [n, K]."""
+    n, w = chars.shape
+    lens = xp.maximum(end - start, 0).astype(np.int32)
+    wout = width_bucket(max(int(lens.max()) if n else 1, 1))
+    j = xp.arange(wout, dtype=np.int32)[None, None, :]
+    src = start[:, :, None] + j
+    k = start.shape[1]
+    gathered = xp.take_along_axis(
+        xp.broadcast_to(chars[:, None, :], (n, k, w)),
+        xp.clip(src, 0, w - 1).astype(np.int32), axis=2)
+    keep = (j < lens[:, :, None]) & valid[:, :, None]
+    data = xp.where(keep, gathered, np.uint8(0)).astype(np.uint8)
+    return Vec(T.STRING, data, valid, xp.where(valid, lens, 0))

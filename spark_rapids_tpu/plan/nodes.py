@@ -238,7 +238,12 @@ def _take_np(arr, idx):
 
 
 def _scalar_of(v: Vec, i: int):
-    """Python value of row i of a primitive/string host Vec (oracle helper)."""
+    """Python value of row i of a host Vec (oracle helper). Nested rows
+    (array/struct/map) round-trip through the arrow converter so e.g.
+    collect_list over nested values yields real python structures."""
+    if v.children is not None:
+        from ..cpu.hostbatch import host_vec_to_arrow
+        return host_vec_to_arrow(v.slice_rows(i, i + 1), 1).to_pylist()[0]
     if v.is_string:
         return bytes(v.data[i, :v.lengths[i]]).decode("utf-8", "replace")
     val = v.data[i]

@@ -205,6 +205,37 @@ for cls in (ECL.ArrayMin, ECL.ArrayMax):
     expr_rule(cls, TypeSig.all_basic(), tag_fn=_tag_array_ordering)
 expr_rule(ECL.SortArray, _nested, tag_fn=_tag_array_ordering)
 
+# map expressions (GpuOverrides.scala:3416 CreateMap, :2423 GetMapValue,
+# :2442-2482 MapKeys/MapValues/MapEntries/StringToMap, collectionOperations
+# MapConcat/MapFromArrays)
+from ..expr import maps as EMP  # noqa: E402
+
+
+def _tag_string_to_map(meta: ExprMeta) -> None:
+    e = meta.expr
+    for d, what in ((e.pair_delim, "pair delimiter"),
+                    (e.kv_delim, "key/value delimiter")):
+        if not isinstance(d, str) or len(d) != 1 or ord(d) > 127:
+            meta.will_not_work(
+                f"str_to_map requires a literal single-byte ASCII {what} "
+                "on TPU (the reference likewise rejects regex delimiters)")
+
+
+def _tag_create_map(meta: ExprMeta) -> None:
+    kv = meta.expr.children
+    kts = {c.data_type for c in kv[0::2]}
+    vts = {c.data_type for c in kv[1::2]}
+    if len(kts) > 1 or len(vts) > 1:
+        meta.will_not_work("map() requires uniform key and value types on "
+                           "TPU (no implicit coercion)")
+
+
+for cls in (EMP.MapKeys, EMP.MapValues, EMP.MapEntries, EMP.GetMapValue,
+            EMP.MapFromArrays, EMP.MapConcat):
+    expr_rule(cls, _nested)
+expr_rule(EMP.CreateMap, _nested, tag_fn=_tag_create_map)
+expr_rule(EMP.StringToMap, _nested, tag_fn=_tag_string_to_map)
+
 # extended string surface (stringFunctions.scala breadth push)
 from ..expr import strings_ext as ESX  # noqa: E402
 
@@ -987,15 +1018,19 @@ class Overrides:
         if rule is not None and rule.expr_fn is not None:
             rule.expr_fn(meta)
         if rule is not None and not isinstance(plan, N.CpuProjectExec):
-            # a pandas UDF is a host black box: only TpuProjectExec knows to
-            # run its kernel eagerly (GpuArrowEvalPythonExec analog); any
-            # other exec would trace it inside jit and crash
+            # a pandas UDF is a host black box, and needs_eager exprs
+            # (data-dependent output fanout, e.g. str_to_map) cannot be
+            # traced: only TpuProjectExec knows to run its kernel eagerly
+            # (GpuArrowEvalPythonExec analog); any other exec would trace
+            # them inside jit and crash
             from ..udf.pandas_udf import PandasUDF
             for em in meta.expr_metas:
-                if em.expr.collect(lambda x: isinstance(x, PandasUDF)):
+                if em.expr.collect(lambda x: isinstance(x, PandasUDF) or
+                                   getattr(x, "needs_eager", False)):
                     meta.will_not_work(
-                        "pandas UDFs are only supported in projections on "
-                        "TPU (project the UDF into a column first)")
+                        "host-eager expressions (pandas UDFs, str_to_map) "
+                        "are only supported in projections on TPU (project "
+                        "into a column first)")
                     break
         if rule is not None and not isinstance(
                 plan, (N.CpuProjectExec, N.CpuFilterExec)):
