@@ -48,6 +48,10 @@ from .regex import (RLike, Like, RegExpReplace, RegExpExtract,  # noqa: F401
                     device_supported_pattern)
 from .maps import (MapKeys, MapValues, MapEntries, GetMapValue,  # noqa: F401
                    CreateMap, MapFromArrays, MapConcat, StringToMap)
+from .higher_order import (NamedLambdaVariable, ArrayTransform,  # noqa: F401
+                           ArrayFilter, ArrayExists, ArrayForAll,
+                           ArrayAggregate, ZipWith, TransformKeys,
+                           TransformValues, MapFilter)
 from .collections import (Size, GetArrayItem, ElementAt, ArrayContains,  # noqa: F401
                           CreateArray, CreateNamedStruct, GetStructField,
                           Explode, ArrayMin, ArrayMax, SortArray)

@@ -1,0 +1,551 @@
+"""Higher-order functions: lambdas over array/map elements (reference
+`higherOrderFunctions.scala:1`, registrations `GpuOverrides.scala:2629-2810`
+ArrayTransform/ArrayExists/ArrayFilter/ArrayAggregate/TransformKeys/
+TransformValues/MapFilter/ZipWith).
+
+TPU shape of lambda evaluation: the fixed-fanout layout stores elements as
+[n, K] matrices, so a lambda body evaluates ONCE over the flattened
+[n*K] element space — every elementwise kernel works unchanged on the
+bigger batch, no per-row loop exists, and XLA sees one fused program.
+Captured outer columns broadcast into the element space ([n] -> [n, K] ->
+[n*K]); XLA dead-code-eliminates the broadcasts of columns the body never
+references. array_aggregate is the one genuinely sequential form: it
+unrolls over the K slot axis updating an [n]-shaped accumulator.
+
+Lambda variables are leaf expressions bound by the enclosing HOF right
+before the body evaluates (no global scope — nested lambdas each bind
+their own variables)."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from .. import types as T
+from .base import (EvalContext, Expression, Vec, ansi_raise,
+                   vec_map_arrays as _map_arrays)
+
+__all__ = ["NamedLambdaVariable", "ArrayTransform", "ArrayFilter",
+           "ArrayExists", "ArrayForAll", "ArrayAggregate", "ZipWith",
+           "TransformKeys", "TransformValues", "MapFilter"]
+
+
+class NamedLambdaVariable(Expression):
+    """A lambda parameter: a leaf whose value the enclosing HOF injects
+    (`NamedLambdaVariable` in Spark). Never appears in a plan without its
+    binding HOF ancestor."""
+
+    def __init__(self, name: str, dtype: Optional[T.DataType] = None,
+                 nullable: bool = True):
+        super().__init__([])
+        self.var_name = name
+        self._dtype = dtype  # None until the HOF's inputs are resolved
+        self._nullable = nullable
+        self._bound_vec: Optional[Vec] = None
+
+    @property
+    def data_type(self):
+        if self._dtype is None:
+            raise ValueError(
+                f"lambda variable {self.var_name} used before its "
+                "higher-order function's inputs were resolved")
+        return self._dtype
+
+    @property
+    def nullable(self):
+        return self._nullable
+
+    def _compute(self, ctx: EvalContext) -> Vec:
+        if self._bound_vec is None:
+            raise RuntimeError(
+                f"lambda variable {self.var_name} evaluated outside its "
+                "binding higher-order function")
+        return self._bound_vec
+
+    def __repr__(self):
+        return self.var_name
+
+
+def _flatten_elem(elem: Vec) -> Vec:
+    """[n, K, ...] element Vec -> [n*K, ...] Vec."""
+    return _map_arrays(elem, lambda a: a.reshape((-1,) + a.shape[2:]))
+
+
+def _unflatten_elem(v: Vec, n: int, k: int) -> Vec:
+    return _map_arrays(v, lambda a: a.reshape((n, k) + a.shape[1:]))
+
+
+def _expand_batch(xp, batch_vecs, k: int, used):
+    """Broadcast captured outer columns [n, ...] into the flattened element
+    space [n*K, ...] so references line up with lambda variables. Only the
+    ordinals the body actually references expand — the numpy CPU engine
+    has no DCE, so eager expansion of every column would materialize K
+    copies of unrelated (possibly wide string) buffers per HOF eval."""
+    def expand(a):
+        rep = xp.repeat(a[:, None, ...], k, axis=1)
+        return rep.reshape((-1,) + a.shape[1:])
+
+    return [_map_arrays(v, expand) if i in used else None
+            for i, v in enumerate(batch_vecs)]
+
+
+def _elem_ctx(ctx: EvalContext, xp, flat_live, k: int):
+    """Child context whose row_mask marks live element slots AND inherits
+    the enclosing mask (a HOF under an untaken IF/CASE branch must not
+    raise that branch's ANSI errors)."""
+    import dataclasses
+    mask = flat_live if ctx.row_mask is None else \
+        (flat_live & xp.repeat(ctx.row_mask, k))
+    return dataclasses.replace(ctx, row_mask=mask)
+
+
+class HigherOrderFunction(Expression):
+    """Common machinery: build lambda variables from a python callable at
+    construction (the Column-DSL style the frontend exposes), evaluate the
+    body in the flattened element space at eval time."""
+
+    def _eval_body(self, ctx: EvalContext, batch_vecs, body: Expression,
+                   bindings, k: int, flat_live):
+        from .base import BoundReference
+        xp = ctx.xp
+
+        def expand(a):
+            rep = xp.repeat(a[:, None, ...], k, axis=1)
+            return rep.reshape((-1,) + a.shape[1:])
+
+        # OUTER lambda variables referenced inside this body (nested
+        # lambdas): currently bound at the enclosing element-space length,
+        # they must broadcast into THIS body's element space exactly like
+        # captured batch columns do
+        own = {id(v) for v, _ in bindings}
+        outer = [v for v in body.collect(
+                     lambda x: isinstance(x, NamedLambdaVariable))
+                 if id(v) not in own and v._bound_vec is not None]
+        saved = [(v, v._bound_vec) for v in outer]
+        for var, vec in bindings:
+            var._bound_vec = vec
+        for v, vec in saved:
+            v._bound_vec = _map_arrays(vec, expand)
+        try:
+            sub = _elem_ctx(ctx, xp, flat_live, k)
+            used = {r.ordinal for r in
+                    body.collect(lambda x: isinstance(x, BoundReference))}
+            expanded = _expand_batch(ctx.xp, batch_vecs, k, used)
+            return body.eval(sub, expanded)
+        finally:
+            for var, _ in bindings:
+                var._bound_vec = None
+            for v, vec in saved:
+                v._bound_vec = vec
+
+    # Lambda variable types derive from input expressions that are only
+    # resolved after reference binding (col("a") has no dtype at
+    # construction). _var_specs maps each variable to a derivation from
+    # the CURRENT node; refresh happens before any type/eval access. The
+    # variables are shared between pre-/post-binding copies of the node,
+    # so refreshing from the bound copy fixes every reference.
+    _var_specs = ()
+
+    def _refresh_vars(self) -> None:
+        for var, derive in self._var_specs:
+            try:
+                var._dtype = derive(self)
+            except ValueError:
+                pass  # inputs still unresolved; next refresh will retry
+
+    @property
+    def data_type(self):
+        self._refresh_vars()
+        return self._out_type()
+
+    # HOFs orchestrate their own child evaluation (the lambda body must not
+    # evaluate as an ordinary child against un-flattened inputs)
+    def eval(self, ctx: EvalContext, batch_vecs) -> Vec:
+        self._refresh_vars()
+        inputs = [c.eval(ctx, batch_vecs) for c in self.input_exprs()]
+        return self._compute_hof(ctx, batch_vecs, *inputs)
+
+    def input_exprs(self):
+        return [self.children[0]]
+
+    @property
+    def body(self) -> Expression:
+        """The lambda body — ALWAYS read through children so reference
+        binding (which rebuilds the children list on a copy) is seen."""
+        return self.children[1]
+
+    def _live(self, xp, arr: Vec):
+        k = arr.children[0].validity.shape[1]
+        return xp.arange(k)[None, :] < arr.data[:, None]
+
+
+class ArrayTransform(HigherOrderFunction):
+    """transform(arr, x -> body) / transform(arr, (x, i) -> body)."""
+
+    def __init__(self, child: Expression, fn: Callable):
+        import inspect
+        self.var = NamedLambdaVariable("x")
+        self.idx_var = NamedLambdaVariable("i", T.INT, nullable=False)
+        self.with_index = len(inspect.signature(fn).parameters) >= 2
+        body = fn(self.var, self.idx_var) if self.with_index else \
+            fn(self.var)
+        super().__init__([child, body])
+        self._var_specs = ((self.var,
+                            lambda s: s.children[0].data_type.element_type),)
+
+    def _out_type(self):
+        return T.ArrayType(self.body.data_type)
+
+    def _compute_hof(self, ctx: EvalContext, batch_vecs, arr: Vec) -> Vec:
+        xp = ctx.xp
+        elem = arr.children[0]
+        n, k = elem.validity.shape[0], elem.validity.shape[1]
+        live = self._live(xp, arr)
+        flat = _flatten_elem(elem)
+        bindings = [(self.var, flat)]
+        if self.with_index:
+            idx = xp.broadcast_to(xp.arange(k, dtype=np.int32)[None, :],
+                                  (n, k)).reshape(-1)
+            bindings.append((self.idx_var,
+                             Vec(T.INT, idx, xp.ones(n * k, dtype=bool))))
+        out = self._eval_body(ctx, batch_vecs, self.body, bindings, k,
+                              live.reshape(-1))
+        return Vec(self.data_type, arr.data, arr.validity, None,
+                   (_unflatten_elem(out, n, k),))
+
+
+class _ArrayPredicateHOF(HigherOrderFunction):
+    """Shared exists/forall: evaluate a boolean body per element, reduce
+    with Spark's three-valued logic."""
+
+    def __init__(self, child: Expression, fn: Callable):
+        self.var = NamedLambdaVariable("x")
+        super().__init__([child, fn(self.var)])
+        self._var_specs = ((self.var,
+                            lambda s: s.children[0].data_type.element_type),)
+
+    def _out_type(self):
+        return T.BOOLEAN
+
+    @property
+    def nullable(self):
+        return True
+
+    def _bools(self, ctx, batch_vecs, arr: Vec):
+        xp = ctx.xp
+        elem = arr.children[0]
+        n, k = elem.validity.shape[0], elem.validity.shape[1]
+        live = self._live(xp, arr)
+        out = self._eval_body(ctx, batch_vecs, self.body,
+                              [(self.var, _flatten_elem(elem))], k,
+                              live.reshape(-1))
+        val = out.data.reshape(n, k)
+        valid = out.validity.reshape(n, k)
+        return live, val, valid
+
+
+class ArrayExists(_ArrayPredicateHOF):
+    """exists(arr, x -> pred): TRUE if any element satisfies; else NULL if
+    any predicate result was null; else FALSE."""
+
+    def _compute_hof(self, ctx, batch_vecs, arr: Vec) -> Vec:
+        xp = ctx.xp
+        live, val, valid = self._bools(ctx, batch_vecs, arr)
+        any_true = (live & valid & val).any(axis=1)
+        any_null = (live & ~valid).any(axis=1)
+        return Vec(T.BOOLEAN, any_true,
+                   arr.validity & (any_true | ~any_null))
+
+
+class ArrayForAll(_ArrayPredicateHOF):
+    """forall(arr, x -> pred): FALSE if any element fails; else NULL if any
+    predicate result was null; else TRUE."""
+
+    def _compute_hof(self, ctx, batch_vecs, arr: Vec) -> Vec:
+        xp = ctx.xp
+        live, val, valid = self._bools(ctx, batch_vecs, arr)
+        any_false = (live & valid & ~val).any(axis=1)
+        any_null = (live & ~valid).any(axis=1)
+        return Vec(T.BOOLEAN, ~any_false,
+                   arr.validity & (any_false | ~any_null))
+
+
+def _compact_slots(xp, elem: Vec, keep, live):
+    """One-Vec wrapper over maps.compact_slots (the canonical stable
+    slot compaction)."""
+    from .maps import compact_slots
+    outs, counts = compact_slots(xp, [elem], keep, live)
+    return outs[0], counts
+
+
+class ArrayFilter(HigherOrderFunction):
+    """filter(arr, x -> pred): keeps elements whose predicate is TRUE
+    (null predicate results drop the element, like Spark)."""
+
+    def __init__(self, child: Expression, fn: Callable):
+        self.var = NamedLambdaVariable("x")
+        super().__init__([child, fn(self.var)])
+        self._var_specs = ((self.var,
+                            lambda s: s.children[0].data_type.element_type),)
+
+    def _out_type(self):
+        return self.children[0].data_type
+
+    def _compute_hof(self, ctx, batch_vecs, arr: Vec) -> Vec:
+        xp = ctx.xp
+        elem = arr.children[0]
+        n, k = elem.validity.shape[0], elem.validity.shape[1]
+        live = self._live(xp, arr)
+        out = self._eval_body(ctx, batch_vecs, self.body,
+                              [(self.var, _flatten_elem(elem))], k,
+                              live.reshape(-1))
+        keep = (out.data & out.validity).reshape(n, k)
+        new_elem, counts = _compact_slots(xp, elem, keep, live)
+        return Vec(self.data_type, counts, arr.validity, None, (new_elem,))
+
+
+class ArrayAggregate(HigherOrderFunction):
+    """aggregate(arr, zero, (acc, x) -> merge[, acc -> finish]): the one
+    sequential HOF — unrolls over the K slot axis with an [n]-shaped
+    accumulator (K is a static bucket, so the unroll is trace-time)."""
+
+    def __init__(self, child: Expression, zero: Expression,
+                 merge: Callable, finish: Optional[Callable] = None):
+        self.acc_var = NamedLambdaVariable("acc")
+        self.elem_var = NamedLambdaVariable("x")
+        kids = [child, zero, merge(self.acc_var, self.elem_var)]
+        if finish is not None:
+            self.fin_var = NamedLambdaVariable("acc")
+            kids.append(finish(self.fin_var))
+        else:
+            self.fin_var = None
+        self.has_finish = finish is not None
+        super().__init__(kids)
+        specs = [(self.elem_var,
+                  lambda s: s.children[0].data_type.element_type),
+                 (self.acc_var, lambda s: s.children[1].data_type)]
+        if self.fin_var is not None:
+            specs.append((self.fin_var, lambda s: s.children[1].data_type))
+        self._var_specs = tuple(specs)
+
+    def input_exprs(self):
+        return [self.children[0], self.children[1]]
+
+    @property
+    def merge_body(self) -> Expression:
+        return self.children[2]
+
+    @property
+    def finish_body(self):
+        return self.children[3] if self.has_finish else None
+
+    def _out_type(self):
+        return self.finish_body.data_type if self.has_finish \
+            else self.merge_body.data_type
+
+    @property
+    def nullable(self):
+        return True
+
+    def _compute_hof(self, ctx, batch_vecs, arr: Vec, acc: Vec) -> Vec:
+        xp = ctx.xp
+        elem = arr.children[0]
+        n, k = elem.validity.shape[0], elem.validity.shape[1]
+        live = self._live(xp, arr)
+        for j in range(k):
+            slot = _map_arrays(elem, lambda a: a[:, j])
+            self.acc_var._bound_vec = acc
+            self.elem_var._bound_vec = slot
+            try:
+                sub = _elem_ctx(ctx, xp, live[:, j], 1)
+                merged = self.merge_body.eval(sub, batch_vecs)
+            finally:
+                self.acc_var._bound_vec = None
+                self.elem_var._bound_vec = None
+            # rows whose array is shorter than j keep the old accumulator
+            sel = live[:, j]
+
+            def pick(new_a, old_a):
+                shaped = sel.reshape((-1,) + (1,) * (new_a.ndim - 1))
+                return xp.where(shaped, new_a, old_a)
+
+            acc = _zip_vecs(merged, acc, pick)
+        if self.finish_body is not None:
+            self.fin_var._bound_vec = acc
+            try:
+                acc = self.finish_body.eval(ctx, batch_vecs)
+            finally:
+                self.fin_var._bound_vec = None
+        return Vec(acc.dtype, acc.data, acc.validity & arr.validity,
+                   acc.lengths, acc.children)
+
+
+def _zip_vecs(a: Vec, b: Vec, fn) -> Vec:
+    """Combine two same-typed Vecs leaf-wise (shapes may differ in string
+    width: pad to common width first)."""
+    if a.is_string and b.is_string and a.data.shape[-1] != b.data.shape[-1]:
+        import jax.numpy as jnp
+        w = max(a.data.shape[-1], b.data.shape[-1])
+
+        def padw(v):
+            xp = np if isinstance(v.data, np.ndarray) else jnp
+            pad = [(0, 0)] * (v.data.ndim - 1) + \
+                [(0, w - v.data.shape[-1])]
+            return Vec(v.dtype, xp.pad(v.data, pad), v.validity, v.lengths)
+
+        a, b = padw(a), padw(b)
+    kids = None
+    if a.children is not None:
+        kids = tuple(_zip_vecs(ca, cb, fn)
+                     for ca, cb in zip(a.children, b.children))
+    return Vec(a.dtype, fn(a.data, b.data), fn(a.validity, b.validity),
+               None if a.lengths is None else fn(a.lengths, b.lengths),
+               kids)
+
+
+class ZipWith(HigherOrderFunction):
+    """zip_with(left, right, (x, y) -> body): zips to the LONGER array;
+    missing elements read as null."""
+
+    def __init__(self, left: Expression, right: Expression, fn: Callable):
+        self.xvar = NamedLambdaVariable("x")
+        self.yvar = NamedLambdaVariable("y")
+        super().__init__([left, right, fn(self.xvar, self.yvar)])
+        self._var_specs = (
+            (self.xvar, lambda s: s.children[0].data_type.element_type),
+            (self.yvar, lambda s: s.children[1].data_type.element_type))
+
+    def input_exprs(self):
+        return [self.children[0], self.children[1]]
+
+    @property
+    def body(self) -> Expression:
+        return self.children[2]
+
+    def _out_type(self):
+        return T.ArrayType(self.body.data_type)
+
+    def _compute_hof(self, ctx, batch_vecs, la: Vec, ra: Vec) -> Vec:
+        xp = ctx.xp
+        le, re = la.children[0], ra.children[0]
+        k = max(le.validity.shape[1], re.validity.shape[1])
+        from .maps import _grow_fanout
+        le = _grow_fanout(xp, le, k)
+        re = _grow_fanout(xp, re, k)
+        n = le.validity.shape[0]
+        counts = xp.maximum(la.data, ra.data).astype(np.int32)
+        live = xp.arange(k)[None, :] < counts[:, None]
+        l_live = xp.arange(k)[None, :] < la.data[:, None]
+        r_live = xp.arange(k)[None, :] < ra.data[:, None]
+        # out-of-range side reads as null
+        le = Vec(le.dtype, le.data, le.validity & l_live, le.lengths,
+                 le.children)
+        re = Vec(re.dtype, re.data, re.validity & r_live, re.lengths,
+                 re.children)
+        out = self._eval_body(ctx, batch_vecs, self.body,
+                              [(self.xvar, _flatten_elem(le)),
+                               (self.yvar, _flatten_elem(re))], k,
+                              live.reshape(-1))
+        validity = la.validity & ra.validity
+        return Vec(self.data_type, xp.where(validity, counts, 0), validity,
+                   None, (_unflatten_elem(out, n, k),))
+
+
+class TransformKeys(HigherOrderFunction):
+    """transform_keys(m, (k, v) -> body): new keys, same values; null or
+    duplicate transformed keys raise (Spark semantics)."""
+
+    def __init__(self, child: Expression, fn: Callable):
+        self.kvar = NamedLambdaVariable("k", nullable=False)
+        self.vvar = NamedLambdaVariable("v")
+        super().__init__([child, fn(self.kvar, self.vvar)])
+        self._var_specs = (
+            (self.kvar, lambda s: s.children[0].data_type.key_type),
+            (self.vvar, lambda s: s.children[0].data_type.value_type))
+
+    def _out_type(self):
+        mt = self.children[0].data_type
+        return T.MapType(self.body.data_type, mt.value_type)
+
+    @property
+    def has_side_effects(self) -> bool:
+        return True
+
+    def _compute_hof(self, ctx, batch_vecs, mp: Vec) -> Vec:
+        xp = ctx.xp
+        keys, values = mp.children
+        n, k = keys.validity.shape[0], keys.validity.shape[1]
+        live = self._live(xp, mp)
+        out = self._eval_body(ctx, batch_vecs, self.body,
+                              [(self.kvar, _flatten_elem(keys)),
+                               (self.vvar, _flatten_elem(values))], k,
+                              live.reshape(-1))
+        new_keys = _unflatten_elem(out, n, k)
+        from .maps import _NULL_KEY, _check_dup_keys
+        null_key = (live & ~new_keys.validity).any(axis=1) & mp.validity
+        ansi_raise(ctx, null_key, _NULL_KEY)
+        counts = xp.where(mp.validity, mp.data, 0).astype(np.int32)
+        _check_dup_keys(ctx, new_keys, counts, mp.validity)
+        return Vec(self.data_type, mp.data, mp.validity, None,
+                   (new_keys, values))
+
+
+class TransformValues(HigherOrderFunction):
+    """transform_values(m, (k, v) -> body): same keys, new values."""
+
+    def __init__(self, child: Expression, fn: Callable):
+        self.kvar = NamedLambdaVariable("k", nullable=False)
+        self.vvar = NamedLambdaVariable("v")
+        super().__init__([child, fn(self.kvar, self.vvar)])
+        self._var_specs = (
+            (self.kvar, lambda s: s.children[0].data_type.key_type),
+            (self.vvar, lambda s: s.children[0].data_type.value_type))
+
+    def _out_type(self):
+        mt = self.children[0].data_type
+        return T.MapType(mt.key_type, self.body.data_type)
+
+    def _compute_hof(self, ctx, batch_vecs, mp: Vec) -> Vec:
+        xp = ctx.xp
+        keys, values = mp.children
+        n, k = keys.validity.shape[0], keys.validity.shape[1]
+        live = self._live(xp, mp)
+        out = self._eval_body(ctx, batch_vecs, self.body,
+                              [(self.kvar, _flatten_elem(keys)),
+                               (self.vvar, _flatten_elem(values))], k,
+                              live.reshape(-1))
+        return Vec(self.data_type, mp.data, mp.validity, None,
+                   (keys, _unflatten_elem(out, n, k)))
+
+
+class MapFilter(HigherOrderFunction):
+    """map_filter(m, (k, v) -> pred): keeps entries whose predicate is
+    TRUE."""
+
+    def __init__(self, child: Expression, fn: Callable):
+        self.kvar = NamedLambdaVariable("k", nullable=False)
+        self.vvar = NamedLambdaVariable("v")
+        super().__init__([child, fn(self.kvar, self.vvar)])
+        self._var_specs = (
+            (self.kvar, lambda s: s.children[0].data_type.key_type),
+            (self.vvar, lambda s: s.children[0].data_type.value_type))
+
+    def _out_type(self):
+        return self.children[0].data_type
+
+    def _compute_hof(self, ctx, batch_vecs, mp: Vec) -> Vec:
+        xp = ctx.xp
+        keys, values = mp.children
+        n, k = keys.validity.shape[0], keys.validity.shape[1]
+        live = self._live(xp, mp)
+        out = self._eval_body(ctx, batch_vecs, self.body,
+                              [(self.kvar, _flatten_elem(keys)),
+                               (self.vvar, _flatten_elem(values))], k,
+                              live.reshape(-1))
+        keep = (out.data & out.validity).reshape(n, k)
+        from .maps import compact_slots
+        (new_keys, new_vals), counts = compact_slots(
+            xp, [keys, values], keep, live)
+        return Vec(self.data_type, counts, mp.validity, None,
+                   (new_keys, new_vals))

@@ -30,7 +30,7 @@ from .base import (EvalContext, Expression, Vec, and_validity, ansi_raise,
 
 __all__ = ["MapKeys", "MapValues", "MapEntries", "GetMapValue", "CreateMap",
            "MapFromArrays", "MapConcat", "StringToMap", "map_lookup",
-           "slot_probe_eq"]
+           "slot_probe_eq", "compact_slots"]
 
 _NULL_KEY = "[NULL_MAP_KEY] Cannot use null as map key"
 _DUP_KEY = ("[DUPLICATED_MAP_KEY] Duplicate map key was found, please check "
@@ -245,11 +245,7 @@ class CreateMap(Expression):
     def _compute(self, ctx: EvalContext, *kv: Vec) -> Vec:
         xp = ctx.xp
         if not kv:  # SELECT map() -> empty map per row
-            from .base import zero_vec
-            n = ctx.row_mask.shape[0] if ctx.row_mask is not None else 1
-            empty = zero_vec(xp, self.data_type, (n,))
-            return Vec(self.data_type, empty.data, xp.ones(n, dtype=bool),
-                       None, empty.children)
+            return _empty_map_vec(ctx, self.data_type)
         keys = kv[0::2]
         vals = kv[1::2]
         npairs = len(keys)
@@ -279,42 +275,69 @@ def _set_slot(xp, mat, j, val):
 
 
 def _stack_slots(xp, elems: Sequence[Vec], k: int) -> Vec:
-    """[n] Vecs -> one [n, K] element Vec (generalizes CreateArray's build
-    to strings and decimal128)."""
-    first = elems[0]
-    n = first.data.shape[0]
-    if first.is_nested:
-        raise CpuFallbackRequired("map() of nested key/value exprs")
-    if first.is_string:
-        w = max(e.data.shape[1] for e in elems)
-        data = xp.zeros((n, k, w), dtype=np.uint8)
-        lens = xp.zeros((n, k), dtype=np.int32)
-        validity = xp.zeros((n, k), dtype=bool)
-        for j, e in enumerate(elems):
-            ed = _pad_last(xp, e.data, w)
-            if hasattr(data, "at"):
-                data = data.at[:, j, :].set(ed)
-            else:
-                data[:, j, :] = ed
-            lens = _set_slot(xp, lens, j, e.lengths)
-            validity = _set_slot(xp, validity, j, e.validity)
-        return Vec(first.dtype, data, validity, lens)
-    if first.data.ndim == 2:  # decimal128 limbs
-        data = xp.zeros((n, k, 2), dtype=np.int64)
-        validity = xp.zeros((n, k), dtype=bool)
-        for j, e in enumerate(elems):
-            if hasattr(data, "at"):
-                data = data.at[:, j, :].set(e.data)
-            else:
-                data[:, j, :] = e.data
-            validity = _set_slot(xp, validity, j, e.validity)
-        return Vec(first.dtype, data, validity)
-    data = xp.zeros((n, k), dtype=first.data.dtype)
-    validity = xp.zeros((n, k), dtype=bool)
-    for j, e in enumerate(elems):
-        data = _set_slot(xp, data, j, e.data)
-        validity = _set_slot(xp, validity, j, e.validity)
-    return Vec(first.dtype, data, validity)
+    """[n] Vecs -> one [n, K] element Vec, recursively: every leaf's
+    trailing dims align to the max across inputs (string widths, nested
+    fanouts), then stack along a new slot axis and pad to K slots. Works
+    for any element type incl. nested (arrays/structs as map values)."""
+
+    def stack(arrs):
+        nd = arrs[0].ndim
+        target = tuple(max(a.shape[i] for a in arrs)
+                       for i in range(1, nd))
+        padded = []
+        for a in arrs:
+            pads = [(0, 0)] + [(0, t - s)
+                               for s, t in zip(a.shape[1:], target)]
+            padded.append(xp.pad(a, pads) if any(p[1] for p in pads)
+                          else a)
+        out = xp.stack(padded, axis=1)  # [n, len(elems), ...]
+        if out.shape[1] < k:
+            pads = [(0, 0), (0, k - out.shape[1])] + [(0, 0)] * (nd - 1)
+            out = xp.pad(out, pads)
+        return out
+
+    def rec(vecs):
+        kids = None
+        if vecs[0].children is not None:
+            kids = tuple(rec([v.children[ci] for v in vecs])
+                         for ci in range(len(vecs[0].children)))
+        return Vec(vecs[0].dtype, stack([v.data for v in vecs]),
+                   stack([v.validity for v in vecs]),
+                   None if vecs[0].lengths is None else
+                   stack([v.lengths for v in vecs]), kids)
+
+    return rec(list(elems))
+
+
+def _empty_map_vec(ctx: EvalContext, dtype) -> Vec:
+    """All-rows-empty (but valid) map Vec — shared by the zero-argument
+    map() and map_concat() forms."""
+    from .base import zero_vec
+    xp = ctx.xp
+    n = ctx.row_mask.shape[0] if ctx.row_mask is not None else 1
+    empty = zero_vec(xp, dtype, (n,))
+    return Vec(dtype, empty.data, xp.ones(n, dtype=bool), None,
+               empty.children)
+
+
+def compact_slots(xp, elems, keep, live):
+    """Stable per-row compaction of kept slots to the front for one or
+    more parallel [n, K] element Vecs (filter/map_filter/map_concat core):
+    ONE argsort by (dropped, slot) ordering shared by all of them.
+    Returns ([compacted...], new_counts)."""
+    k = keep.shape[1]
+    drop_key = xp.where(live & keep, 0, 1) * (2 * k) + \
+        xp.arange(k)[None, :]
+    order = xp.argsort(drop_key, axis=1)
+
+    def take(a):
+        if a.ndim == 2:
+            return xp.take_along_axis(a, order, axis=1)
+        return xp.take_along_axis(
+            a, order.reshape(order.shape + (1,) * (a.ndim - 2)), axis=1)
+
+    outs = [_map_elem(e, take) for e in elems]
+    return outs, (live & keep).sum(axis=1).astype(np.int32)
 
 
 def _grow_fanout(xp, elem: Vec, k: int) -> Vec:
@@ -376,6 +399,9 @@ class MapConcat(Expression):
 
     @property
     def data_type(self):
+        if not self.children:
+            # Spark types the empty map_concat() as map<string,string>
+            return T.MapType(T.STRING, T.STRING)
         return self.children[0].data_type
 
     @property
@@ -384,6 +410,8 @@ class MapConcat(Expression):
 
     def _compute(self, ctx: EvalContext, *maps: Vec) -> Vec:
         xp = ctx.xp
+        if not maps:  # SELECT map_concat() -> empty map per row
+            return _empty_map_vec(ctx, self.data_type)
         n = maps[0].data.shape[0]
         total_k = sum(m.children[0].validity.shape[1] for m in maps)
         k = width_bucket(total_k)
@@ -404,19 +432,8 @@ class MapConcat(Expression):
                 live_cat[:, off:off + mk] = sl
             counts = counts + m.data.astype(np.int32)
             off += mk
-        # stable compaction: live slots to the front, original order kept
-        order = xp.argsort(
-            xp.where(live_cat, 0, 1) * (2 * k) + xp.arange(k)[None, :],
-            axis=1)
-
-        def take(a):
-            if a.ndim == 2:
-                return xp.take_along_axis(a, order, axis=1)
-            return xp.take_along_axis(
-                a, order.reshape(order.shape + (1,) * (a.ndim - 2)), axis=1)
-
-        keys_c = _map_elem(keys_cat, take)
-        vals_c = _map_elem(vals_cat, take)
+        (keys_c, vals_c), _ = compact_slots(
+            xp, [keys_cat, vals_cat], live_cat, xp.ones_like(live_cat))
         counts = xp.where(validity, counts, 0)
         _check_dup_keys(ctx, keys_c, counts, validity)
         return Vec(self.data_type, counts, validity, None, (keys_c, vals_c))

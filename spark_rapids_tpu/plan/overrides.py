@@ -228,6 +228,9 @@ def _tag_create_map(meta: ExprMeta) -> None:
     if len(kts) > 1 or len(vts) > 1:
         meta.will_not_work("map() requires uniform key and value types on "
                            "TPU (no implicit coercion)")
+    if any(t.is_nested for t in kts | vts):
+        meta.will_not_work("map() of nested key/value exprs is not "
+                           "supported on TPU")
 
 
 for cls in (EMP.MapKeys, EMP.MapValues, EMP.MapEntries, EMP.GetMapValue,
@@ -235,6 +238,17 @@ for cls in (EMP.MapKeys, EMP.MapValues, EMP.MapEntries, EMP.GetMapValue,
     expr_rule(cls, _nested)
 expr_rule(EMP.CreateMap, _nested, tag_fn=_tag_create_map)
 expr_rule(EMP.StringToMap, _nested, tag_fn=_tag_string_to_map)
+
+# higher-order functions (higherOrderFunctions.scala,
+# GpuOverrides.scala:2629-2810): lambdas evaluate over the flattened
+# [n*K] element space of the fixed-fanout layout
+from ..expr import higher_order as EHO  # noqa: E402
+
+for cls in (EHO.NamedLambdaVariable, EHO.ArrayTransform, EHO.ArrayFilter,
+            EHO.ArrayExists, EHO.ArrayForAll, EHO.ArrayAggregate,
+            EHO.ZipWith, EHO.TransformKeys, EHO.TransformValues,
+            EHO.MapFilter):
+    expr_rule(cls, _nested38)
 
 # extended string surface (stringFunctions.scala breadth push)
 from ..expr import strings_ext as ESX  # noqa: E402

@@ -1,0 +1,304 @@
+"""Higher-order functions: lambdas over the flattened element space
+(reference higherOrderFunctions.scala, GpuOverrides.scala:2629-2810).
+Differential device-vs-CPU plus python oracles, incl. nested lambdas,
+captured outer columns, and Spark null semantics."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.errors import AnsiViolation
+from spark_rapids_tpu.expr import (ArrayAggregate, ArrayExists, ArrayFilter,
+                                   ArrayForAll, ArrayTransform, MapFilter,
+                                   Size, TransformKeys, TransformValues,
+                                   ZipWith, col, lit)
+from spark_rapids_tpu.plugin import TpuSession
+
+from test_queries import assert_same
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession({"spark.rapids.sql.enabled": True,
+                       "spark.rapids.sql.explain": "NONE"})
+
+
+def arr_table(n=200, seed=3):
+    rng = np.random.default_rng(seed)
+    arrs = []
+    for _ in range(n):
+        r = rng.random()
+        if r < 0.1:
+            arrs.append(None)
+        elif r < 0.18:
+            arrs.append([])
+        else:
+            arrs.append([None if rng.random() < 0.12 else
+                         int(rng.integers(-50, 50))
+                         for _ in range(rng.integers(1, 7))])
+    return pa.table({
+        "a": pa.array(arrs, type=pa.list_(pa.int64())),
+        "y": pa.array([int(v) for v in rng.integers(1, 10, n)],
+                      type=pa.int64()),
+        "i": pa.array(range(n), type=pa.int64()),
+    }), arrs
+
+
+class TestTransform:
+    def test_basic(self, session):
+        t, arrs = arr_table()
+        df = session.from_arrow(t)
+        q = df.select("i", o=ArrayTransform(col("a"),
+                                            lambda x: x * lit(2)))
+        out = assert_same(q, sort_by=["i"]).sort_by([("i", "ascending")])
+        for got, a in zip(out.column("o").to_pylist(), arrs):
+            want = None if a is None else [None if v is None else v * 2
+                                           for v in a]
+            assert got == want
+
+    def test_with_index_and_capture(self, session):
+        t, arrs = arr_table(seed=5)
+        ys = t.column("y").to_pylist()
+        df = session.from_arrow(t)
+        q = df.select("i", o=ArrayTransform(
+            col("a"), lambda x, i: x + i * col("y")))
+        out = assert_same(q, sort_by=["i"]).sort_by([("i", "ascending")])
+        for got, a, y in zip(out.column("o").to_pylist(), arrs, ys):
+            want = None if a is None else [
+                None if v is None else v + j * y for j, v in enumerate(a)]
+            assert got == want
+
+    def test_nested_lambda(self, session):
+        # transform over array<array<int>>: inner lambda inside outer
+        arrs = [[[1, 2], [3]], None, [[], [4, None]]]
+        t = pa.table({"a": pa.array(arrs,
+                                    pa.list_(pa.list_(pa.int64()))),
+                      "i": pa.array(range(3), type=pa.int64())})
+        df = session.from_arrow(t)
+        q = df.select("i", o=ArrayTransform(
+            col("a"),
+            lambda inner: ArrayTransform(inner, lambda x: x + lit(10))))
+        out = assert_same(q, sort_by=["i"]).sort_by([("i", "ascending")])
+        got = out.column("o").to_pylist()
+        assert got[0] == [[11, 12], [13]]
+        assert got[1] is None
+        assert got[2] == [[], [14, None]]
+
+    def test_string_result(self, session):
+        from spark_rapids_tpu.expr import Concat
+        arrs = [["ab", None, "c"], [], None]
+        t = pa.table({"a": pa.array(arrs, pa.list_(pa.string())),
+                      "i": pa.array(range(3), type=pa.int64())})
+        df = session.from_arrow(t)
+        q = df.select("i", o=ArrayTransform(
+            col("a"), lambda x: Concat(x, lit("!"))))
+        out = assert_same(q, sort_by=["i"]).sort_by([("i", "ascending")])
+        got = out.column("o").to_pylist()
+        assert got[0] == ["ab!", None, "c!"]
+        assert got[1] == [] and got[2] is None
+
+
+class TestPredicates:
+    def test_exists_three_valued(self, session):
+        arrs = [[1, 2, 3], [None, 1], [None, 5], [], None, [None]]
+        t = pa.table({"a": pa.array(arrs, pa.list_(pa.int64())),
+                      "i": pa.array(range(6), type=pa.int64())})
+        df = session.from_arrow(t)
+        q = df.select("i", e=ArrayExists(col("a"), lambda x: x > lit(2)),
+                      f=ArrayForAll(col("a"), lambda x: x > lit(0)))
+        out = assert_same(q, sort_by=["i"]).sort_by([("i", "ascending")])
+        rows = out.to_pylist()
+        # exists(x>2): [T,F,..], row1: none true, has null -> NULL
+        assert [r["e"] for r in rows] == [True, None, True, False, None,
+                                          None]
+        # forall(x>0): row0 all>0 T; row1 has 1>0 but null -> NULL;
+        # row2 5>0, null -> NULL; [] -> T; null arr -> NULL; [None]->NULL
+        assert [r["f"] for r in rows] == [True, None, None, True, None,
+                                          None]
+
+    def test_filter(self, session):
+        t, arrs = arr_table(seed=7)
+        df = session.from_arrow(t)
+        q = df.select("i", o=ArrayFilter(col("a"), lambda x: x % lit(2) ==
+                                         lit(0)))
+        out = assert_same(q, sort_by=["i"]).sort_by([("i", "ascending")])
+        for got, a in zip(out.column("o").to_pylist(), arrs):
+            want = None if a is None else [v for v in a
+                                           if v is not None and v % 2 == 0]
+            assert got == want
+
+
+class TestAggregateAndZip:
+    def test_aggregate_sum(self, session):
+        t, arrs = arr_table(seed=9)
+        df = session.from_arrow(t)
+        q = df.select("i", s=ArrayAggregate(
+            col("a"), lit(0, T.LONG), lambda acc, x: acc + x))
+        out = assert_same(q, sort_by=["i"]).sort_by([("i", "ascending")])
+        for got, a in zip(out.column("s").to_pylist(), arrs):
+            if a is None:
+                assert got is None
+            elif any(v is None for v in a):
+                assert got is None  # null element poisons the + chain
+            else:
+                assert got == sum(a)
+
+    def test_aggregate_with_finish(self, session):
+        arrs = [[1, 2, 3], [], [10]]
+        t = pa.table({"a": pa.array(arrs, pa.list_(pa.int64())),
+                      "i": pa.array(range(3), type=pa.int64())})
+        df = session.from_arrow(t)
+        q = df.select("i", s=ArrayAggregate(
+            col("a"), lit(0, T.LONG), lambda acc, x: acc + x,
+            lambda acc: acc * lit(10)))
+        out = assert_same(q, sort_by=["i"]).sort_by([("i", "ascending")])
+        assert out.column("s").to_pylist() == [60, 0, 100]
+
+    def test_zip_with(self, session):
+        la = [[1, 2, 3], [1], None, [5]]
+        ra = [[10, 20], [7, 8], [1], None]
+        t = pa.table({"l": pa.array(la, pa.list_(pa.int64())),
+                      "r": pa.array(ra, pa.list_(pa.int64())),
+                      "i": pa.array(range(4), type=pa.int64())})
+        df = session.from_arrow(t)
+        q = df.select("i", z=ZipWith(col("l"), col("r"),
+                                     lambda x, y: x + y))
+        out = assert_same(q, sort_by=["i"]).sort_by([("i", "ascending")])
+        got = out.column("z").to_pylist()
+        assert got[0] == [11, 22, None]  # zips to the longer side
+        assert got[1] == [8, None]
+        assert got[2] is None and got[3] is None
+
+
+class TestMapHofs:
+    MT = pa.map_(pa.string(), pa.int64())
+
+    def table(self):
+        maps = [{"a": 1, "b": 2}, None, {"c": None, "d": 4}, {}]
+        return pa.table({"m": pa.array(maps, self.MT),
+                         "i": pa.array(range(4), type=pa.int64())}), maps
+
+    def test_transform_values(self, session):
+        t, maps = self.table()
+        df = session.from_arrow(t)
+        q = df.select("i", o=TransformValues(col("m"),
+                                             lambda k, v: v * lit(10)))
+        out = assert_same(q, sort_by=["i"]).sort_by([("i", "ascending")])
+        got = out.column("o").to_pylist()
+        assert got[0] == [("a", 10), ("b", 20)]
+        assert got[1] is None
+        assert got[2] == [("c", None), ("d", 40)]
+        assert got[3] == []
+
+    def test_transform_keys(self, session):
+        from spark_rapids_tpu.expr import Concat
+        t, maps = self.table()
+        df = session.from_arrow(t)
+        q = df.select("i", o=TransformKeys(
+            col("m"), lambda k, v: Concat(k, lit("_"))))
+        out = assert_same(q, sort_by=["i"]).sort_by([("i", "ascending")])
+        got = out.column("o").to_pylist()
+        assert got[0] == [("a_", 1), ("b_", 2)]
+
+    def test_transform_keys_dup_raises(self, session):
+        t, _ = self.table()
+        df = session.from_arrow(t).select(
+            o=TransformKeys(col("m"), lambda k, v: lit("same")))
+        with pytest.raises(AnsiViolation, match="DUPLICATED_MAP_KEY"):
+            df.collect()
+        with pytest.raises(AnsiViolation, match="DUPLICATED_MAP_KEY"):
+            df.collect_cpu()
+
+    def test_map_filter(self, session):
+        t, maps = self.table()
+        df = session.from_arrow(t)
+        q = df.select("i", o=MapFilter(col("m"), lambda k, v: v > lit(1)))
+        out = assert_same(q, sort_by=["i"]).sort_by([("i", "ascending")])
+        got = out.column("o").to_pylist()
+        assert got[0] == [("b", 2)]
+        assert got[1] is None
+        assert got[2] == [("d", 4)]  # null predicate drops the entry
+        assert got[3] == []
+
+    def test_chained_hof_pipeline(self, session):
+        # exercise HOF composition end-to-end: filter then transform then
+        # size, mixed with an ordinary filter on the result
+        t, arrs = arr_table(seed=13)
+        df = session.from_arrow(t)
+        q = (df.select("i", o=ArrayTransform(
+                ArrayFilter(col("a"), lambda x: x > lit(0)),
+                lambda x: x * x))
+               .select("i", "o", n=Size(col("o")))
+               .filter(col("n") > lit(1)))
+        out = assert_same(q, sort_by=["i"]).sort_by([("i", "ascending")])
+        for r in out.to_pylist():
+            a = arrs[r["i"]]
+            want = [v * v for v in a if v is not None and v > 0]
+            assert r["o"] == want and len(want) > 1
+
+
+class TestReviewRegressions:
+    def test_hof_under_untaken_ansi_branch(self):
+        # a HOF inside an IF branch taken for zero rows must not raise
+        # that branch's ANSI errors (row_mask inheritance through the
+        # flattened element space)
+        from spark_rapids_tpu.expr import If, IntegralDivide
+        s = TpuSession({"spark.rapids.sql.enabled": True,
+                        "spark.rapids.sql.explain": "NONE",
+                        "spark.sql.ansi.enabled": True})
+        t = pa.table({"a": pa.array([[1, 2]], pa.list_(pa.int64()))})
+        df = s.from_arrow(t).select(o=If(
+            lit(False),
+            Size(ArrayTransform(col("a"),
+                                lambda x: IntegralDivide(x, lit(0)))),
+            Size(col("a"))))
+        assert df.collect().column("o").to_pylist() == [2]
+        assert df.collect_cpu().column("o").to_pylist() == [2]
+
+    def test_empty_map_concat(self, session):
+        from spark_rapids_tpu.expr import MapConcat
+        t = pa.table({"i": pa.array(range(2), type=pa.int64())})
+        df = session.from_arrow(t)
+        out = assert_same(df.select("i", m=MapConcat([])), sort_by=["i"])
+        assert out.sort_by([("i", "ascending")]).column("m").to_pylist() \
+            == [[], []]
+
+    def test_create_map_nested_values_fall_back(self, session):
+        # map() of nested exprs: tagged off device, host path must answer
+        from spark_rapids_tpu.expr import CreateArray, CreateMap
+        t = pa.table({"a": pa.array([1, 2], type=pa.int64())})
+        df = session.from_arrow(t).select(
+            m=CreateMap([lit("k"), CreateArray([col("a")])]))
+        got = df.collect_cpu().column("m").to_pylist()
+        assert got == [[("k", [1])], [("k", [2])]]
+
+    def test_nested_lambda_outer_var_capture(self, session):
+        # inner body references the OUTER lambda variable: it must
+        # broadcast into the inner element space like captured columns
+        from spark_rapids_tpu.expr import GetArrayItem
+        arrs = [[[1, 2], [10]], [[5]], None]
+        t = pa.table({"a": pa.array(arrs, pa.list_(pa.list_(pa.int64()))),
+                      "i": pa.array(range(3), type=pa.int64())})
+        df = session.from_arrow(t)
+        q = df.select("i", o=ArrayTransform(
+            col("a"),
+            lambda row: ArrayTransform(
+                row, lambda x: x + GetArrayItem(row, lit(0, T.INT)))))
+        out = assert_same(q, sort_by=["i"]).sort_by([("i", "ascending")])
+        got = out.column("o").to_pylist()
+        assert got[0] == [[2, 3], [20]]   # + row[0] (1 then 10)
+        assert got[1] == [[10]]
+        assert got[2] is None
+
+    def test_aggregate_unresolved_zero_column(self, session):
+        # zero expr as an unresolved column: acc typing defers to binding
+        arrs = [[1, 2], [3]]
+        t = pa.table({"a": pa.array(arrs, pa.list_(pa.int64())),
+                      "z": pa.array([100, 200], type=pa.int64()),
+                      "i": pa.array(range(2), type=pa.int64())})
+        df = session.from_arrow(t)
+        q = df.select("i", s=ArrayAggregate(col("a"), col("z"),
+                                            lambda acc, x: acc + x))
+        out = assert_same(q, sort_by=["i"]).sort_by([("i", "ascending")])
+        assert out.column("s").to_pylist() == [103, 203]
