@@ -250,6 +250,15 @@ register("spark.rapids.sql.regexp.enabled", "bool", True,
          "Enable regular-expression offload via the transpiler (falls back per-pattern).")
 
 # TPU-specific ----------------------------------------------------------------------
+register("spark.rapids.sql.dynamicFilePruning.enabled", "bool", True,
+         "Prune probe-side parquet files/row groups of a broadcast hash "
+         "join using the build side's distinct keys against footer min/max "
+         "statistics (the GpuSubqueryBroadcastExec / dynamic partition "
+         "pruning analog at file granularity).")
+register("spark.rapids.sql.topK.enabled", "bool", True,
+         "Rewrite limit-over-sort into a top-k exec (per-batch k-select + "
+         "running merge) instead of a full out-of-core sort "
+         "(TakeOrderedAndProjectExec analog, GpuOverrides.scala:3705).")
 register("spark.rapids.tpu.device.ordinal", "int", -1,
          "Which local TPU device to bind (-1 = first).", startup_only=True)
 register("spark.rapids.tpu.device.startupTimeoutSec", "double", 60.0,

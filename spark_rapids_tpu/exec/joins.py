@@ -203,6 +203,11 @@ class TpuShuffledHashJoinExec(TpuExec):
                             for e in self.left_keys)
         self._rk_ix = tuple(self._key_ordinal(e, right.output)
                             for e in self.right_keys)
+        # (build key ordinal, DynamicKeyFilter) pairs wired by the planner
+        # for probe-side scan pruning (GpuSubqueryBroadcastExec analog):
+        # filled with the build side's distinct keys right after build
+        # materialization, strictly before the probe stream is pulled
+        self.dpp_filters: list = []
 
     @staticmethod
     def _key_ordinal(e: Expression, schema: Schema) -> int:
@@ -230,6 +235,15 @@ class TpuShuffledHashJoinExec(TpuExec):
                 from ..columnar.batch import empty_batch
                 build = empty_batch(self.children[1].output, 1)
             del build_batches
+
+        if self.dpp_filters:
+            n_build = int(build.row_count())
+            for ordinal, filt in self.dpp_filters:
+                vals, valid = build.columns[ordinal].to_numpy(n_build)
+                if vals.dtype == object:  # strings
+                    filt.set_values([v for v, ok in zip(vals, valid) if ok])
+                else:
+                    filt.set_values(vals[valid])
 
         threshold = self.conf.get("spark.rapids.sql.join.subPartition.rows")
         if int(build.row_count()) > threshold:

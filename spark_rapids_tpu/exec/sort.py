@@ -183,3 +183,71 @@ def _sort_by_pos(batch: ColumnarBatch) -> ColumnarBatch:
     order = jnp.argsort(pos)
     return vecs_to_batch(batch.schema, gather_vecs(jnp, vecs, order),
                          batch.num_rows)
+
+
+class TpuTopKExec(UnaryTpuExec):
+    """TakeOrderedAndProjectExec analog (`GpuOverrides.scala:3705`,
+    `GpuTakeOrderedAndProject`): ORDER BY + LIMIT k without a full
+    out-of-core sort. Each input batch sorts on device and keeps its first
+    k rows; a running candidate batch of <= k rows merges with every
+    batch's winners, so device residency is one input batch plus O(k) and
+    host sees nothing. Offset slices the final candidates."""
+
+    def __init__(self, orders: Sequence[Tuple[Expression, bool, bool]],
+                 limit: int, child: TpuExec, conf=None, offset: int = 0):
+        super().__init__([child], conf)
+        self.orders = list(orders)
+        self.limit = limit
+        self.offset = offset
+        self._k = limit + offset
+        self._bound = [(bind_references(e, child.output), a, nf)
+                       for e, a, nf in self.orders]
+        self.sort_time = self.metrics.create(M.SORT_TIME, M.MODERATE)
+        bound = self._bound
+        from ..columnar.padding import row_bucket
+        kcap = row_bucket(max(self._k, 1))
+        k = self._k
+
+        @jax.jit
+        def topk(batch: ColumnarBatch):
+            ctx = device_ctx(batch, self.conf)
+            vecs = batch_vecs(batch)
+            mask = batch.row_mask()
+            groups = [[(~mask).astype(np.int8)]]  # padding rows last
+            for e, asc, nf in bound:
+                groups.append(sort_keys_for(jnp, e.eval(ctx, vecs), asc,
+                                            nf))
+            order = lexsort_indices(jnp, groups, batch.capacity)
+            take = order[:kcap] if kcap <= batch.capacity else jnp.pad(
+                order, (0, kcap - batch.capacity))
+            out = gather_vecs(jnp, vecs, take)
+            new_n = jnp.minimum(batch.num_rows, k)
+            return vecs_to_batch(batch.schema, out, new_n)
+
+        self._topk = topk
+
+    @property
+    def output(self) -> Schema:
+        return self.child.output
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        run = None
+        for b in self.child.execute():
+            with self.sort_time.timed():
+                top = self._topk(b)
+                run = top if run is None else \
+                    self._topk(concat_batches([run, top]))
+        if run is None:
+            return
+        if self.offset:
+            n = run.row_count()
+            start = min(self.offset, n)
+            take = max(min(self.limit, n - start), 0)
+            sliced = [v.slice_rows(start, None) for v in batch_vecs(run)]
+            run = vecs_to_batch(run.schema, sliced, take)
+        self.num_output_rows.add(run.row_count())
+        yield self._count_output(run)
+
+    def _arg_string(self):
+        return f"[k={self.limit}, offset={self.offset}, " \
+               f"orders={len(self.orders)}]"

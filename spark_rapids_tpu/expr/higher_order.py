@@ -76,16 +76,23 @@ def _unflatten_elem(v: Vec, n: int, k: int) -> Vec:
     return _map_arrays(v, lambda a: a.reshape((n, k) + a.shape[1:]))
 
 
+def _slot_broadcaster(xp, k: int):
+    """The ONE broadcast-into-element-space rule: [n, ...] -> [n*K, ...]
+    (row-major repeat along a new slot axis then flatten)."""
+    def expand(a):
+        rep = xp.repeat(a[:, None, ...], k, axis=1)
+        return rep.reshape((-1,) + a.shape[1:])
+
+    return expand
+
+
 def _expand_batch(xp, batch_vecs, k: int, used):
     """Broadcast captured outer columns [n, ...] into the flattened element
     space [n*K, ...] so references line up with lambda variables. Only the
     ordinals the body actually references expand — the numpy CPU engine
     has no DCE, so eager expansion of every column would materialize K
     copies of unrelated (possibly wide string) buffers per HOF eval."""
-    def expand(a):
-        rep = xp.repeat(a[:, None, ...], k, axis=1)
-        return rep.reshape((-1,) + a.shape[1:])
-
+    expand = _slot_broadcaster(xp, k)
     return [_map_arrays(v, expand) if i in used else None
             for i, v in enumerate(batch_vecs)]
 
@@ -109,11 +116,7 @@ class HigherOrderFunction(Expression):
                    bindings, k: int, flat_live):
         from .base import BoundReference
         xp = ctx.xp
-
-        def expand(a):
-            rep = xp.repeat(a[:, None, ...], k, axis=1)
-            return rep.reshape((-1,) + a.shape[1:])
-
+        expand = _slot_broadcaster(xp, k)
         # OUTER lambda variables referenced inside this body (nested
         # lambdas): currently bound at the enclosing element-space length,
         # they must broadcast into THIS body's element space exactly like
@@ -381,27 +384,37 @@ class ArrayAggregate(HigherOrderFunction):
                    acc.lengths, acc.children)
 
 
+def _align_pair(x, y):
+    """Pad two arrays' trailing dims to their elementwise max (string
+    widths, nested fanout buckets) so leaf-wise combination broadcasts."""
+    if x.shape[1:] == y.shape[1:]:
+        return x, y
+    import jax.numpy as jnp
+
+    def pad(a, target):
+        xp = np if isinstance(a, np.ndarray) else jnp
+        pads = [(0, 0)] + [(0, t - s) for s, t in zip(a.shape[1:], target)]
+        return xp.pad(a, pads) if any(p[1] for p in pads) else a
+
+    target = tuple(max(s, t) for s, t in zip(x.shape[1:], y.shape[1:]))
+    return pad(x, target), pad(y, target)
+
+
 def _zip_vecs(a: Vec, b: Vec, fn) -> Vec:
-    """Combine two same-typed Vecs leaf-wise (shapes may differ in string
-    width: pad to common width first)."""
-    if a.is_string and b.is_string and a.data.shape[-1] != b.data.shape[-1]:
-        import jax.numpy as jnp
-        w = max(a.data.shape[-1], b.data.shape[-1])
-
-        def padw(v):
-            xp = np if isinstance(v.data, np.ndarray) else jnp
-            pad = [(0, 0)] * (v.data.ndim - 1) + \
-                [(0, w - v.data.shape[-1])]
-            return Vec(v.dtype, xp.pad(v.data, pad), v.validity, v.lengths)
-
-        a, b = padw(a), padw(b)
+    """Combine two same-typed Vecs leaf-wise, aligning every leaf's
+    trailing dims first (string widths AND nested fanout buckets — an
+    array-typed accumulator may cross a fanout bucket between steps)."""
     kids = None
     if a.children is not None:
         kids = tuple(_zip_vecs(ca, cb, fn)
                      for ca, cb in zip(a.children, b.children))
-    return Vec(a.dtype, fn(a.data, b.data), fn(a.validity, b.validity),
-               None if a.lengths is None else fn(a.lengths, b.lengths),
-               kids)
+    da, db = _align_pair(a.data, b.data)
+    va, vb = _align_pair(a.validity, b.validity)
+    lens = None
+    if a.lengths is not None:
+        la, lb = _align_pair(a.lengths, b.lengths)
+        lens = fn(la, lb)
+    return Vec(a.dtype, fn(da, db), fn(va, vb), lens, kids)
 
 
 class ZipWith(HigherOrderFunction):

@@ -1,0 +1,145 @@
+"""Dynamic file/row-group pruning from build-side join keys — the engine's
+shape of dynamic partition pruning (reference `GpuSubqueryBroadcastExec.scala:1`
++ `DynamicPruningExpression` handling in `GpuFileSourceScanExec`).
+
+The reference reuses a broadcast build side to prune the probe scan's
+PARTITIONS before reading them. This engine's scans are file lists (no
+hive partition directories yet), but parquet footers carry exact per-column
+row-group min/max statistics — so the same broadcast keys prune at file
+AND row-group granularity: a chunk whose [min, max] cannot contain any
+build key never gets read or decoded. The planner wires a DynamicKeyFilter
+between a broadcast hash join and any probe-side parquet scan the join key
+is a direct column of; the join fills the filter with the build side's
+distinct keys after materializing the (already needed) broadcast table,
+strictly before the probe stream is pulled."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["DynamicKeyFilter", "prune_parquet_paths", "row_group_overlaps"]
+
+
+class DynamicKeyFilter:
+    """Runtime pruning values for one scan column. `values` is filled by
+    the join (numpy array for numerics/dates, list of str for strings)
+    after build-side materialization; until then the filter prunes
+    nothing (ready() is False)."""
+
+    def __init__(self, column: str):
+        self.column = column
+        self.values = None
+
+    def ready(self) -> bool:
+        return self.values is not None
+
+    def set_values(self, values) -> None:
+        if len(values) == 0:
+            self.values = []
+            return
+        if isinstance(values[0], (str, bytes)):
+            self.values = sorted({v.decode("utf-8", "replace")
+                                  if isinstance(v, bytes) else v
+                                  for v in values})
+        else:
+            self.values = np.unique(np.asarray(values))
+
+    # -- overlap tests --------------------------------------------------------
+    def _range_has_key(self, mn, mx) -> bool:
+        vals = self.values
+        if len(vals) == 0:
+            return False
+        try:
+            if isinstance(vals, list):  # strings: sorted python list
+                import bisect
+                i = bisect.bisect_left(vals, mn)
+                return i < len(vals) and vals[i] <= mx
+            mn = np.asarray(mn).astype(vals.dtype)
+            mx = np.asarray(mx).astype(vals.dtype)
+            i = int(np.searchsorted(vals, mn, side="left"))
+            return i < len(vals) and vals[i] <= mx
+        except (TypeError, ValueError):
+            return True  # incomparable stats: cannot prune
+
+
+def _stat_bounds(cm, column_phys_type):
+    st = cm.statistics
+    if st is None or not st.has_min_max:
+        return None
+    return st.min, st.max
+
+
+def row_group_overlaps(meta, ci: int, rg: int,
+                       filt: DynamicKeyFilter) -> bool:
+    """True if row group rg MIGHT contain one of the filter's keys (i.e.
+    must be read). Missing or unreadable statistics always read — pruning
+    is an optimization, never a correctness gate."""
+    try:
+        cm = meta.row_group(rg).column(ci)
+        b = _stat_bounds(cm, cm.physical_type)
+        if b is None:
+            return True
+        return filt._range_has_key(b[0], b[1])
+    except Exception:
+        return True
+
+
+def schema_col_index(meta) -> dict:
+    """Footer schema column-path -> ordinal map (shared by file- and
+    row-group-level pruning)."""
+    sch = meta.schema
+    return {sch.column(i).path: i for i in range(len(sch))}
+
+
+def prune_parquet_paths(paths: Sequence[str],
+                        filters: List[DynamicKeyFilter]
+                        ) -> Tuple[List[str], int]:
+    """Drop files no ready filter's keys can appear in (per footer stats).
+    Returns (kept_paths, pruned_count). Errors reading a footer keep the
+    file — pruning is an optimization, never a correctness gate."""
+    import pyarrow.parquet as pq
+    active = [f for f in filters if f.ready()]
+    if not active:
+        return list(paths), 0
+    kept = []
+    for p in paths:
+        try:
+            meta = pq.ParquetFile(p).metadata
+            col_index = schema_col_index(meta)
+            keep = True
+            for f in active:
+                ci = col_index.get(f.column)
+                if ci is None:
+                    continue
+                if not any(row_group_overlaps(meta, ci, rg, f)
+                           for rg in range(meta.num_row_groups)):
+                    keep = False
+                    break
+        except Exception:
+            keep = True
+        if keep:
+            kept.append(p)
+    return kept, len(paths) - len(kept)
+
+
+def row_group_filter(meta, col_index: dict,
+                     filters: List[DynamicKeyFilter]
+                     ) -> Optional[set]:
+    """Set of row-group ordinals to READ for one file (None = all).
+    Any error keeps every row group — optimization, not a gate."""
+    try:
+        active = [(f, col_index.get(f.column)) for f in filters
+                  if f.ready()]
+        active = [(f, ci) for f, ci in active if ci is not None]
+        if not active:
+            return None
+        keep = set()
+        for rg in range(meta.num_row_groups):
+            if all(row_group_overlaps(meta, ci, rg, f)
+                   for f, ci in active):
+                keep.add(rg)
+        return keep
+    except Exception:
+        return None

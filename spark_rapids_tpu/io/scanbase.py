@@ -62,8 +62,10 @@ class CpuFileScanExec(PhysicalPlan):
             t = t.select([c for c in self.columns if c in t.schema.names])
         return normalize_timestamps(t)
 
-    def host_tables(self) -> Iterator[pa.Table]:
-        for t in FileBatchIterator(self.paths, self.decode_file, self.conf,
+    def host_tables(self, paths: Optional[Sequence[str]] = None
+                    ) -> Iterator[pa.Table]:
+        for t in FileBatchIterator(self.paths if paths is None else paths,
+                                   self.decode_file, self.conf,
                                    format_name=self.format_name):
             yield self._postprocess(t)
 
@@ -100,6 +102,11 @@ class TpuFileScanExec(_TpuExec):
     def __init__(self, plan: CpuFileScanExec, conf: TpuConf):
         super().__init__([], conf)
         self.cpu_scan = plan
+        # DynamicKeyFilter list wired in by the planner (DPP analog); the
+        # broadcast join fills values before this exec's stream is pulled
+        self.dynamic_filters: list = []
+        from ..utils import metrics as M
+        self.files_pruned = self.metrics.create("filesPruned", M.MODERATE)
 
     @property
     def output(self) -> Schema:
@@ -109,6 +116,19 @@ class TpuFileScanExec(_TpuExec):
     def name(self):
         return f"TpuFileScanExec({self.cpu_scan.format_name})"
 
+    def _effective_paths(self):
+        """Apply ready dynamic filters to the file list (parquet footers);
+        other formats pass through untouched."""
+        paths = self.cpu_scan.paths
+        if not self.dynamic_filters or \
+                self.cpu_scan.format_name != "parquet":
+            return paths
+        from .dynamic_pruning import prune_parquet_paths
+        kept, pruned = prune_parquet_paths(paths, self.dynamic_filters)
+        if pruned:
+            self.files_pruned.add(pruned)
+        return kept
+
     def do_execute(self):
         from ..columnar.batch import batch_from_arrow
         if self.cpu_scan.format_name == "parquet" and \
@@ -117,7 +137,7 @@ class TpuFileScanExec(_TpuExec):
                     "spark.rapids.sql.format.parquet.deviceDecode.enabled"):
             yield from self._parquet_batches()
             return
-        for t in self.cpu_scan.host_tables():
+        for t in self.cpu_scan.host_tables(self._effective_paths()):
             b = batch_from_arrow(t)
             self.num_output_rows.add(t.num_rows)
             yield self._count_output(b)
@@ -167,16 +187,18 @@ class TpuFileScanExec(_TpuExec):
                 close()
             return True
 
-        supported = {p for p in scan.paths if check(p)}
+        paths = self._effective_paths()
+        supported = {p for p in paths if check(p)}
         if not supported:
             # nothing is device-decodable: the plain host path keeps the
             # COALESCING / MULTITHREADED multi-file strategies
-            for t in scan.host_tables():
+            for t in scan.host_tables(paths):
                 b = batch_from_arrow(t)
                 self.num_output_rows.add(t.num_rows)
                 yield self._count_output(b)
             return
-        for path in scan.paths:
+        from .dynamic_pruning import row_group_filter
+        for path in paths:
             if path not in supported:
                 for b, nrows in self._host_file_batches(path):
                     self.num_output_rows.add(nrows)
@@ -187,8 +209,15 @@ class TpuFileScanExec(_TpuExec):
             # raises DeviceDecodeUnsupported and falls back per row group
             pf = pq.ParquetFile(path)
             try:
+                meta = pf.metadata
+                from .dynamic_pruning import schema_col_index
+                keep_rgs = row_group_filter(meta, schema_col_index(meta),
+                                            self.dynamic_filters) \
+                    if self.dynamic_filters else None
                 with open(path, "rb") as f:
                     for rg in range(pf.metadata.num_row_groups):
+                        if keep_rgs is not None and rg not in keep_rgs:
+                            continue  # stats prove no build key in range
                         try:
                             b, nrows = decode_row_group(pf, f, rg,
                                                         scan.output)

@@ -733,13 +733,67 @@ def _c_join(plan, children, conf):
         return TpuNestedLoopJoinExec(children[0], build, plan.condition,
                                      plan.join_type, conf)
     if small_build:
-        return TpuBroadcastHashJoinExec(
+        join = TpuBroadcastHashJoinExec(
             children[0], TpuBroadcastExchangeExec(children[1], conf),
             plan.left_keys, plan.right_keys, plan.join_type, conf,
             condition=plan.condition)
+        _wire_dynamic_pruning(join, plan, conf)
+        return join
     return TpuShuffledHashJoinExec(children[0], children[1], plan.left_keys,
                                    plan.right_keys, plan.join_type, conf,
                                    condition=plan.condition)
+
+
+# join types where a probe row WITHOUT a build match never reaches the
+# output, so pruning probe files by build keys cannot change results
+# (left/anti/existence emit unmatched probe rows — never prune those)
+_DPP_SAFE = ("inner", "semi")
+
+
+def _dpp_scan_for_column(node, colname):
+    """Descend column-preserving execs from the probe root to a parquet
+    scan that provides `colname` unchanged (the conservative leg of the
+    reference's DynamicPruningExpression plumbing)."""
+    from ..exec.basic import TpuFilterExec, TpuProjectExec
+    from ..exec.coalesce import TpuCoalesceBatchesExec
+    from ..io.scanbase import TpuFileScanExec
+    if isinstance(node, TpuFileScanExec):
+        return (node, colname) if (node.cpu_scan.format_name == "parquet"
+                                   and colname in node.output.names) \
+            else None
+    if isinstance(node, (TpuFilterExec, TpuCoalesceBatchesExec)):
+        return _dpp_scan_for_column(node.children[0], colname)
+    if isinstance(node, TpuProjectExec):
+        from ..expr.base import Alias, AttributeReference
+        for e in node.exprs:
+            src = e.children[0] if isinstance(e, Alias) else e
+            name = e.alias if isinstance(e, Alias) else \
+                getattr(e, "col_name", None)
+            if name == colname and isinstance(src, AttributeReference):
+                return _dpp_scan_for_column(node.children[0], src.col_name)
+        return None
+    return None
+
+
+def _wire_dynamic_pruning(join, plan, conf) -> None:
+    """Attach DynamicKeyFilters between a broadcast hash join and probe
+    parquet scans its keys are direct columns of."""
+    if not conf.get("spark.rapids.sql.dynamicFilePruning.enabled"):
+        return
+    if plan.join_type not in _DPP_SAFE:
+        return
+    from ..expr.base import AttributeReference
+    from ..io.dynamic_pruning import DynamicKeyFilter
+    for i, lk in enumerate(plan.left_keys):
+        if not isinstance(lk, AttributeReference):
+            continue
+        res = _dpp_scan_for_column(join.children[0], lk.col_name)
+        if res is None:
+            continue
+        scan, scan_col = res
+        filt = DynamicKeyFilter(scan_col)
+        scan.dynamic_filters.append(filt)
+        join.dpp_filters.append((join._rk_ix[i], filt))
 
 
 def _c_generate(plan, children, conf):
@@ -758,6 +812,15 @@ def _c_sort(plan, children, conf):
 
 def _c_limit(plan, children, conf):
     from ..exec.basic import TpuLimitExec
+    from ..exec.sort import TpuSortExec, TpuTopKExec
+    child = children[0]
+    # LIMIT over ORDER BY -> top-k (TakeOrderedAndProjectExec analog,
+    # GpuOverrides.scala:3705): per-batch k-select + running merge
+    # replaces the full out-of-core sort
+    if conf.get("spark.rapids.sql.topK.enabled") and \
+            isinstance(child, TpuSortExec) and not child.each_batch:
+        return TpuTopKExec(child.orders, plan.limit, child.child, conf,
+                           plan.offset)
     return TpuLimitExec(plan.limit, children[0], plan.offset, conf)
 
 

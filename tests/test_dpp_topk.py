@@ -1,0 +1,200 @@
+"""Dynamic file/row-group pruning (GpuSubqueryBroadcastExec / DPP analog)
+and top-k (TakeOrderedAndProjectExec analog)."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.expr import Count, Sum, col, lit
+from spark_rapids_tpu.plugin import TpuSession
+
+from test_queries import assert_same
+
+
+@pytest.fixture()
+def session():
+    return TpuSession({"spark.rapids.sql.enabled": True,
+                       "spark.rapids.sql.explain": "NONE"})
+
+
+def write_fact_files(tmp_path, nfiles=6, rows=400):
+    """Each file covers a DISJOINT key range [f*1000, f*1000+rows)."""
+    paths = []
+    rng = np.random.default_rng(11)
+    for f in range(nfiles):
+        keys = np.arange(f * 1000, f * 1000 + rows, dtype=np.int64)
+        t = pa.table({"k": keys,
+                      "v": rng.normal(size=rows)})
+        p = str(tmp_path / f"fact{f}.parquet")
+        pq.write_table(t, p)
+        paths.append(p)
+    return paths
+
+
+def find_scans(node):
+    from spark_rapids_tpu.io.scanbase import TpuFileScanExec
+    out = [node] if isinstance(node, TpuFileScanExec) else []
+    for c in getattr(node, "children", []):
+        out.extend(find_scans(c))
+    return out
+
+
+class TestDynamicFilePruning:
+    def _joined_plan(self, session, tmp_path, join_type="inner"):
+        paths = write_fact_files(tmp_path)
+        fact = session.read_parquet(*paths)
+        # dim keys hit ONLY files 1 and 4
+        dim = session.from_arrow(pa.table({
+            "k": pa.array([1005, 1010, 4100], type=pa.int64()),
+            "w": pa.array([1.0, 2.0, 3.0])}))
+        return fact.join(dim, on="k", how=join_type)
+
+    def test_files_and_row_groups_pruned(self, session, tmp_path):
+        df = self._joined_plan(session, tmp_path)
+        session.initialize_device()
+        from spark_rapids_tpu.plan.overrides import Overrides
+        ov = Overrides(session.conf)
+        result = ov.apply(df.plan)
+        scans = find_scans(result)
+        assert scans and scans[0].dynamic_filters, "DPP filter not wired"
+        batches = list(result.execute())
+        total = sum(int(b.row_count()) for b in batches)
+        assert total == 3
+        assert scans[0].files_pruned.value >= 4  # only 2 of 6 files match
+
+    def test_results_match_cpu(self, session, tmp_path):
+        df = self._joined_plan(session, tmp_path)
+        q = df.agg(n=Count(lit(1)), s=Sum(col("w")))
+        out = q.collect()
+        cpu = q.collect_cpu()
+        assert out.column("n").to_pylist() == cpu.column("n").to_pylist() \
+            == [3]
+        assert out.column("s").to_pylist() == [6.0]
+
+    def test_left_join_not_pruned(self, session, tmp_path):
+        # left outer emits unmatched probe rows: pruning would be wrong
+        df = self._joined_plan(session, tmp_path, join_type="left")
+        session.initialize_device()
+        from spark_rapids_tpu.plan.overrides import Overrides
+        ov = Overrides(session.conf)
+        result = ov.apply(df.plan)
+        for scan in find_scans(result):
+            assert not scan.dynamic_filters
+        assert df.collect().num_rows == 6 * 400
+
+    def test_disabled_by_conf(self, tmp_path):
+        s = TpuSession({"spark.rapids.sql.enabled": True,
+                        "spark.rapids.sql.explain": "NONE",
+                        "spark.rapids.sql.dynamicFilePruning.enabled":
+                            False})
+        df = self._joined_plan(s, tmp_path)
+        s.initialize_device()
+        from spark_rapids_tpu.plan.overrides import Overrides
+        ov = Overrides(s.conf)
+        result = ov.apply(df.plan)
+        for scan in find_scans(result):
+            assert not scan.dynamic_filters
+
+    def test_string_keys_pruned(self, session, tmp_path):
+        paths = []
+        for f, names in enumerate([["alpha", "apple"], ["beta", "bird"],
+                                   ["zeta", "zoo"]]):
+            t = pa.table({"k": pa.array(names * 50),
+                          "v": pa.array(range(100), type=pa.int64())})
+            p = str(tmp_path / f"s{f}.parquet")
+            pq.write_table(t, p)
+            paths.append(p)
+        fact = session.read_parquet(*paths)
+        dim = session.from_arrow(pa.table({
+            "k": pa.array(["beta"]), "w": pa.array([1],
+                                                   type=pa.int64())}))
+        df = fact.join(dim, on="k", how="inner")
+        session.initialize_device()
+        from spark_rapids_tpu.plan.overrides import Overrides
+        ov = Overrides(session.conf)
+        result = ov.apply(df.plan)
+        scans = find_scans(result)
+        batches = list(result.execute())
+        assert sum(int(b.row_count()) for b in batches) == 50
+        assert scans[0].files_pruned.value == 2
+
+
+class TestTopK:
+    def _table(self, tmp_path, n=5000, with_nulls=True):
+        rng = np.random.default_rng(3)
+        vals = rng.integers(-10**6, 10**6, n)
+        mask = (rng.random(n) < 0.1) if with_nulls else np.zeros(n, bool)
+        t = pa.table({"v": pa.array(vals, mask=mask),
+                      "tag": pa.array(rng.integers(0, 50, n)),
+                      "i": pa.array(range(n), type=pa.int64())})
+        p = str(tmp_path / "topk.parquet")
+        pq.write_table(t, p, row_group_size=700)  # multi-batch stream
+        return p, t
+
+    def test_topk_matches_sort_limit(self, session, tmp_path):
+        p, t = self._table(tmp_path)
+        df = session.read_parquet(p)
+        for asc in (True, False):
+            q = df.sort("v", ascending=asc).limit(25)
+            out = q.collect()
+            cpu = q.collect_cpu()
+            assert out.column("v").to_pylist() == \
+                cpu.column("v").to_pylist()
+            assert out.column("i").to_pylist() == \
+                cpu.column("i").to_pylist()
+
+    def test_topk_exec_actually_used(self, session, tmp_path):
+        p, _ = self._table(tmp_path)
+        df = session.read_parquet(p).sort("v").limit(10)
+        session.initialize_device()
+        from spark_rapids_tpu.exec.sort import TpuSortExec, TpuTopKExec
+        from spark_rapids_tpu.plan.overrides import Overrides
+        ov = Overrides(session.conf)
+        result = ov.apply(df.plan)
+
+        def find(node, cls):
+            got = [node] if isinstance(node, cls) else []
+            for c in getattr(node, "children", []):
+                got.extend(find(c, cls))
+            return got
+
+        assert find(result, TpuTopKExec)
+        assert not find(result, TpuSortExec)
+
+    def test_topk_with_offset(self, session, tmp_path):
+        p, _ = self._table(tmp_path, n=1000, with_nulls=False)
+        df = session.read_parquet(p)
+        q = df.sort("v").limit(7, offset=5)
+        out = q.collect().column("v").to_pylist()
+        cpu = q.collect_cpu().column("v").to_pylist()
+        assert out == cpu and len(out) == 7
+
+    def test_limit_larger_than_input(self, session, tmp_path):
+        p, t = self._table(tmp_path, n=40, with_nulls=False)
+        df = session.read_parquet(p)
+        q = df.sort("v", ascending=False).limit(500)
+        out = q.collect()
+        assert out.num_rows == 40
+        assert out.column("v").to_pylist() == \
+            sorted(t.column("v").to_pylist(), reverse=True)
+
+    def test_disabled_falls_back_to_sort(self, tmp_path):
+        s = TpuSession({"spark.rapids.sql.enabled": True,
+                        "spark.rapids.sql.explain": "NONE",
+                        "spark.rapids.sql.topK.enabled": False})
+        p, _ = self._table(tmp_path, n=300)
+        df = s.read_parquet(p).sort("v").limit(5)
+        s.initialize_device()
+        from spark_rapids_tpu.exec.sort import TpuTopKExec
+        from spark_rapids_tpu.plan.overrides import Overrides
+        result = Overrides(s.conf).apply(df.plan)
+
+        def find(node):
+            got = [node] if isinstance(node, TpuTopKExec) else []
+            for c in getattr(node, "children", []):
+                got.extend(find(c))
+            return got
+
+        assert not find(result)
+        assert df.collect().num_rows == 5
