@@ -170,6 +170,14 @@ register("spark.rapids.memory.gpu.state.debug", "string", "",
          "Log allocator state on OOM: stdout/stderr/path.", internal=True)
 
 # Shuffle ---------------------------------------------------------------------------
+register("spark.rapids.shuffle.hostStoreSize", "bytes", 1 << 30,
+         "Host-memory budget for the MULTITHREADED shuffle block store; "
+         "blocks beyond it overflow (FIFO) to files under "
+         "spark.rapids.shuffle.spillPath (RapidsDiskBlockManager analog) "
+         "so a shuffle larger than host RAM completes.")
+register("spark.rapids.shuffle.spillPath", "string", "",
+         "Directory for overflowed shuffle blocks (empty = a fresh temp "
+         "dir per manager).")
 register("spark.rapids.shuffle.mode", "string", "MULTITHREADED",
          "MULTITHREADED: host-serialized threaded shuffle (reference default); "
          "ICI: device-resident collective all-to-all exchange over the mesh "

@@ -339,16 +339,31 @@ class TpuShuffledHashJoinExec(TpuExec):
         """Co-partitioned per-shard join: children are key-exchanges over the
         same mesh, so matching keys land in the same positional batch — join
         batch p with batch p (the distributed engine's shard-local join,
-        `GpuShuffledHashJoinExec.scala:151` fed by the exchange)."""
-        with self.build_time.timed():
-            build_stream = list(self.children[1].execute())
-        probe_stream = list(self.children[0].execute())
-        if len(probe_stream) != len(build_stream):
-            raise RuntimeError(
-                "zip_partitions requires positionally-aligned exchange "
-                f"outputs, got {len(probe_stream)} vs {len(build_stream)}")
+        `GpuShuffledHashJoinExec.scala:151` fed by the exchange). Shards
+        stream INCREMENTALLY: one probe + one build batch device-resident
+        at a time, never both whole exchange outputs (peak residency would
+        otherwise be the entire exchange per chip)."""
+        import itertools
+        _END = object()
         threshold = self.conf.get("spark.rapids.sql.join.subPartition.rows")
-        for probe, build in zip(probe_stream, build_stream):
+        probe_it = self.children[0].execute()
+
+        def timed_build():
+            it = self.children[1].execute()
+            while True:
+                with self.build_time.timed():
+                    b = next(it, _END)
+                if b is _END:
+                    return
+                yield b
+
+        build_it = timed_build()
+        for probe, build in itertools.zip_longest(probe_it, build_it,
+                                                  fillvalue=_END):
+            if probe is _END or build is _END:
+                raise RuntimeError(
+                    "zip_partitions requires positionally-aligned exchange "
+                    "outputs (one stream ended early)")
             n_probe, n_build = int(probe.row_count()), int(build.row_count())
             if n_build == 0 and self.join_type in ("inner", "right", "semi"):
                 continue

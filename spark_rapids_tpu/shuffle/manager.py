@@ -41,41 +41,140 @@ def next_shuffle_id() -> int:
 
 
 class ShuffleBlockStore:
-    """Local serialized-block store (the Spark shuffle-file analog; in-memory
-    with the spill path handled upstream by serialization size limits)."""
+    """Local serialized-block store with a DISK TIER (reference
+    `RapidsDiskBlockManager.scala:1` + shuffle files): blocks live in host
+    memory up to `spark.rapids.shuffle.hostStoreSize`; beyond that the
+    oldest in-memory blocks overflow to files in a spill directory, so a
+    shuffle bigger than host RAM completes instead of dying. Reads check
+    memory first, then disk; removals unlink."""
 
-    def __init__(self):
-        self._blocks: Dict[BlockId, bytes] = {}
+    def __init__(self, host_budget: int = 1 << 30,
+                 spill_dir: Optional[str] = None):
+        self._blocks: Dict[BlockId, bytes] = {}  # insertion-ordered
+        self._on_disk: Dict[BlockId, str] = {}
+        self._mem_bytes = 0
+        self._budget = host_budget
+        self._dir = spill_dir
+        self._owns_dir = False  # created a temp dir we must clean up
         self._lock = threading.Lock()
 
-    def put(self, bid: BlockId, data: bytes) -> None:
+    def close(self) -> None:
+        """Unlink spilled blocks and remove a temp dir this store made."""
         with self._lock:
+            for bid in list(self._on_disk):
+                self._unlink(bid)
+            if self._owns_dir and self._dir is not None:
+                import shutil
+                shutil.rmtree(self._dir, ignore_errors=True)
+                self._dir = None
+                self._owns_dir = False
+
+    def _ensure_dir(self) -> str:
+        if self._dir is None:
+            import tempfile
+            self._dir = tempfile.mkdtemp(prefix="srtpu-shuffle-")
+            self._owns_dir = True
+        else:
+            import os
+            os.makedirs(self._dir, exist_ok=True)
+        return self._dir
+
+    def _disk_path(self, bid: BlockId) -> str:
+        import os
+        return os.path.join(
+            self._ensure_dir(),
+            f"s{bid.shuffle_id}_m{bid.map_id}_r{bid.reduce_id}.blk")
+
+    def put(self, bid: BlockId, data: bytes) -> None:
+        evict = []
+        with self._lock:
+            old = self._blocks.pop(bid, None)
+            if old is not None:  # overwrite (e.g. retried map task)
+                self._mem_bytes -= len(old)
+            self._unlink(bid)  # drop any stale spilled copy
             self._blocks[bid] = data
+            self._mem_bytes += len(data)
+            # FIFO overflow: the oldest blocks go to disk first; collect
+            # the evictees here but do the file I/O OUTSIDE the lock so
+            # concurrent writers/readers don't stall behind disk writes
+            while self._mem_bytes > self._budget and len(self._blocks) > 1:
+                old_bid, old_data = next(iter(self._blocks.items()))
+                evict.append((old_bid, old_data))
+                del self._blocks[old_bid]
+                self._mem_bytes -= len(old_data)
+        for old_bid, old_data in evict:
+            path = self._disk_path(old_bid)
+            with open(path, "wb") as f:
+                f.write(old_data)
+            with self._lock:
+                self._on_disk[old_bid] = path
 
     def get(self, bid: BlockId) -> Optional[bytes]:
         with self._lock:
-            return self._blocks.get(bid)
+            data = self._blocks.get(bid)
+            if data is not None:
+                return data
+            path = self._on_disk.get(bid)
+        if path is None:
+            return None
+        try:
+            with open(path, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None  # concurrently removed: same contract as memory
+
+    def _unlink(self, bid: BlockId) -> None:
+        path = self._on_disk.pop(bid, None)
+        if path is not None:
+            import os
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
 
     def remove(self, bid: BlockId) -> None:
         with self._lock:
-            self._blocks.pop(bid, None)
+            data = self._blocks.pop(bid, None)
+            if data is not None:
+                self._mem_bytes -= len(data)
+            self._unlink(bid)
 
     def remove_shuffle(self, shuffle_id: int) -> None:
         with self._lock:
             for k in [k for k in self._blocks if k.shuffle_id == shuffle_id]:
+                self._mem_bytes -= len(self._blocks[k])
                 del self._blocks[k]
+            for k in [k for k in self._on_disk
+                      if k.shuffle_id == shuffle_id]:
+                self._unlink(k)
 
     def blocks_for_reduce(self, shuffle_id: int,
                           reduce_id: int) -> List[BlockId]:
         with self._lock:
-            return sorted((k for k in self._blocks
+            all_ids = set(self._blocks) | set(self._on_disk)
+            return sorted((k for k in all_ids
                            if k.shuffle_id == shuffle_id
                            and k.reduce_id == reduce_id),
                           key=lambda k: k.map_id)
 
     def total_bytes(self) -> int:
         with self._lock:
-            return sum(len(v) for v in self._blocks.values())
+            import os
+            disk = 0
+            for p in self._on_disk.values():
+                try:
+                    disk += os.path.getsize(p)
+                except OSError:
+                    pass
+            return self._mem_bytes + disk
+
+    def mem_bytes(self) -> int:
+        with self._lock:
+            return self._mem_bytes
+
+    def disk_block_count(self) -> int:
+        with self._lock:
+            return len(self._on_disk)
 
 
 class _MultithreadedWriter:
@@ -138,7 +237,10 @@ class TpuShuffleManager:
         self.codec_name = self.conf.get(
             "spark.rapids.shuffle.compression.codec")
         self.executor_id = executor_id
-        self.block_store = ShuffleBlockStore()
+        self.block_store = ShuffleBlockStore(
+            host_budget=self.conf.get("spark.rapids.shuffle.hostStoreSize"),
+            spill_dir=self.conf.get("spark.rapids.shuffle.spillPath")
+            or None)
         nw = self.conf.get("spark.rapids.shuffle.multiThreaded.writer.threads")
         nr = self.conf.get("spark.rapids.shuffle.multiThreaded.reader.threads")
         self.writer_pool = ThreadPoolExecutor(
@@ -232,3 +334,4 @@ class TpuShuffleManager:
         self.writer_pool.shutdown(wait=True)
         self.reader_pool.shutdown(wait=True)
         self.transport.shutdown()
+        self.block_store.close()

@@ -325,3 +325,59 @@ class TestShuffleManager:
             assert batch_to_arrow(out[0]).equals(pa.concat_tables(tables))
         finally:
             mgr.shutdown()
+
+
+class TestShuffleDiskTier:
+    def test_overflow_to_disk_and_back(self, rng, tmp_path):
+        # budget far below the shuffle size: most blocks must land on disk
+        # and reads must still reassemble exactly (RapidsDiskBlockManager
+        # analog)
+        conf = TpuConf({"spark.rapids.shuffle.mode": "MULTITHREADED",
+                        "spark.rapids.shuffle.hostStoreSize": 4096,
+                        "spark.rapids.shuffle.spillPath": str(tmp_path),
+                        "spark.rapids.shuffle.compression.codec": "none"})
+        mgr = TpuShuffleManager(conf)
+        try:
+            tables = [sample_table(rng, 500) for _ in range(6)]
+            sid = next_shuffle_id()
+            for m, t in enumerate(tables):
+                w = mgr.get_writer(sid, map_id=m)
+                w.write(0, batch_from_arrow(t))
+                w.close()
+            assert mgr.block_store.disk_block_count() >= 4
+            assert mgr.block_store.mem_bytes() <= 4096 or \
+                len(tables) == mgr.block_store.disk_block_count() + 1
+            out = list(mgr.read_partition(sid, 0))
+            got = pa.concat_tables(batch_to_arrow(b) for b in out)
+            assert got.equals(pa.concat_tables(tables))
+            mgr.unregister_shuffle(sid)
+            assert mgr.block_store.total_bytes() == 0
+            import os
+            assert not [f for f in os.listdir(tmp_path)
+                        if f.endswith(".blk")]
+        finally:
+            mgr.shutdown()
+
+    def test_query_shuffle_over_tiny_budget(self, rng):
+        # end-to-end repartition whose blocks exceed the configured host
+        # store: the disk tier must keep the query green and exact.
+        # Exchange uses the process-singleton manager whose FIRST caller's
+        # conf wins — reset around so the tiny budget actually applies and
+        # does not leak into later tests.
+        from spark_rapids_tpu.plugin import TpuSession
+        TpuShuffleManager.reset()
+        try:
+            sess = TpuSession({"spark.rapids.sql.enabled": True,
+                               "spark.rapids.sql.explain": "NONE",
+                               "spark.rapids.shuffle.hostStoreSize": 2048})
+            t = sample_table(rng, 2000)
+            df = sess.from_arrow(t).repartition(8, "a")
+            out = df.collect()
+            mgr = TpuShuffleManager.get(sess.conf)
+            assert mgr.block_store._budget == 2048
+            keys = [(k, "ascending") for k in ("a", "b")]
+            assert out.sort_by(keys).equals(
+                pa.Table.from_arrays(t.columns, names=t.column_names)
+                .sort_by(keys))
+        finally:
+            TpuShuffleManager.reset()
