@@ -62,6 +62,68 @@ class CpuFileScanExec(PhysicalPlan):
             t = t.select([c for c in self.columns if c in t.schema.names])
         return normalize_timestamps(t)
 
+    # -- footer statistics (CBO seam; reference CostBasedOptimizer reads
+    # Spark's relation stats, this engine reads the format footers) -------
+    def footer_row_count(self) -> Optional[int]:
+        """EXACT total row count from file metadata when the format is
+        cheap to ask (parquet/orc footers); None otherwise. Cached."""
+        if not hasattr(self, "_footer_rows"):
+            self._footer_rows = self._read_footer_rows()
+        return self._footer_rows
+
+    def _footer_metas(self):
+        """Parsed parquet FileMetaData per path, read ONCE (row-count and
+        column-stats both consume it); None on any failure."""
+        if not hasattr(self, "_footer_meta_cache"):
+            try:
+                import pyarrow.parquet as pq
+                self._footer_meta_cache = [pq.ParquetFile(p).metadata
+                                           for p in self.paths]
+            except Exception:
+                self._footer_meta_cache = None
+        return self._footer_meta_cache
+
+    def _read_footer_rows(self) -> Optional[int]:
+        try:
+            if self.format_name == "parquet":
+                metas = self._footer_metas()
+                return None if metas is None else \
+                    sum(m.num_rows for m in metas)
+            if self.format_name == "orc":
+                from pyarrow import orc
+                return sum(orc.ORCFile(p).nrows for p in self.paths)
+        except Exception:
+            return None
+        return None
+
+    def column_stats(self) -> dict:
+        """{column: (min, max)} merged across files/row groups from parquet
+        footer statistics (empty for other formats / missing stats).
+        Cached; errors yield no stats — estimation only."""
+        if hasattr(self, "_col_stats"):
+            return self._col_stats
+        stats: dict = {}
+        try:
+            if self.format_name == "parquet":
+                for meta in (self._footer_metas() or ()):
+                    sch = meta.schema
+                    for i in range(len(sch)):
+                        name = sch.column(i).path
+                        for rg in range(meta.num_row_groups):
+                            st = meta.row_group(rg).column(i).statistics
+                            if st is None or not st.has_min_max:
+                                continue
+                            cur = stats.get(name)
+                            if cur is None:
+                                stats[name] = (st.min, st.max)
+                            else:
+                                stats[name] = (min(cur[0], st.min),
+                                               max(cur[1], st.max))
+        except Exception:
+            stats = {}
+        self._col_stats = stats
+        return stats
+
     def host_tables(self, paths: Optional[Sequence[str]] = None
                     ) -> Iterator[pa.Table]:
         for t in FileBatchIterator(self.paths if paths is None else paths,

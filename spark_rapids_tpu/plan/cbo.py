@@ -30,15 +30,75 @@ _COST_REASON = ("the cost-based optimizer kept this on CPU "
                 "(transition cost dominates the device speedup)")
 
 
+def _selectivity(cond, stats: dict) -> float:
+    """Predicate selectivity from footer column min/max (uniform
+    assumption, like Spark's FilterEstimation); 0.5 when unknowable."""
+    from ..expr import predicates as P
+    from ..expr.base import AttributeReference, Literal
+
+    def attr_lit(e):
+        a, b = e.children
+        if isinstance(a, AttributeReference) and isinstance(b, Literal):
+            return a, b.value, False
+        if isinstance(b, AttributeReference) and isinstance(a, Literal):
+            return b, a.value, True
+        return None
+
+    if isinstance(cond, P.And):
+        return _selectivity(cond.children[0], stats) * \
+            _selectivity(cond.children[1], stats)
+    if isinstance(cond, P.Or):
+        s1 = _selectivity(cond.children[0], stats)
+        s2 = _selectivity(cond.children[1], stats)
+        return s1 + s2 - s1 * s2
+    if isinstance(cond, P.Not):
+        return 1.0 - _selectivity(cond.children[0], stats)
+    if isinstance(cond, (P.LessThan, P.LessThanOrEqual, P.GreaterThan,
+                         P.GreaterThanOrEqual, P.EqualTo)):
+        al = attr_lit(cond)
+        if al is None:
+            return 0.5
+        attr, v, flipped = al
+        rng = stats.get(attr.col_name)
+        try:
+            if rng is None:
+                return 0.5
+            mn, mx = float(rng[0]), float(rng[1])
+            v = float(v)
+        except (TypeError, ValueError):
+            return 0.5
+        if isinstance(cond, P.EqualTo):
+            return 0.05 if mn <= v <= mx else 0.0
+        frac_below = 1.0 if v >= mx else 0.0 if v <= mn else \
+            (v - mn) / (mx - mn)
+        less = isinstance(cond, (P.LessThan, P.LessThanOrEqual))
+        if flipped:  # lit OP attr reverses the direction
+            less = not less
+        return frac_below if less else 1.0 - frac_below
+    return 0.5
+
+
 def _estimate_from(plan, kids) -> float:
-    """Cardinality of one node given its children's estimates."""
+    """Cardinality of one node given its children's estimates — EXACT at
+    in-memory scans and (via footers) file scans; footer min/max drives
+    filter selectivity directly over a scan (CostBasedOptimizer.scala:284
+    keeps per-op row counts the same way)."""
+    from ..io.scanbase import CpuFileScanExec
     if isinstance(plan, N.CpuScanExec):
         return float(plan.table.num_rows)
     if isinstance(plan, N.CpuRangeExec):
         return float(max(0, (plan.end - plan.start) // max(plan.step, 1)))
+    if isinstance(plan, CpuFileScanExec):
+        nrows = plan.footer_row_count()
+        return float(nrows) if nrows is not None \
+            else 1000.0 * max(len(plan.paths), 1)
     if not kids:
         return 1000.0
     if isinstance(plan, N.CpuFilterExec):
+        child = plan.children[0]
+        if isinstance(child, CpuFileScanExec):
+            sel = _selectivity(plan.condition, child.column_stats())
+            return kids[0] * max(min(sel, 1.0), 0.0)
         return kids[0] * 0.5
     if isinstance(plan, N.CpuLimitExec):
         return float(min(plan.limit, kids[0]))

@@ -52,6 +52,11 @@ class ShuffleBlockStore:
                  spill_dir: Optional[str] = None):
         self._blocks: Dict[BlockId, bytes] = {}  # insertion-ordered
         self._on_disk: Dict[BlockId, str] = {}
+        # evictees whose disk write is in flight: still readable from here
+        # so eviction is never a visibility gap, and a concurrent remove()
+        # marks them dead (the finishing writer then deletes its file)
+        self._spilling: Dict[BlockId, bytes] = {}
+        self._read_cache: Optional[Tuple[BlockId, bytes]] = None
         self._mem_bytes = 0
         self._budget = host_budget
         self._dir = spill_dir
@@ -70,14 +75,15 @@ class ShuffleBlockStore:
                 self._owns_dir = False
 
     def _ensure_dir(self) -> str:
-        if self._dir is None:
-            import tempfile
-            self._dir = tempfile.mkdtemp(prefix="srtpu-shuffle-")
-            self._owns_dir = True
-        else:
-            import os
-            os.makedirs(self._dir, exist_ok=True)
-        return self._dir
+        import os
+        with self._lock:  # two concurrent evictors must share ONE dir
+            if self._dir is None:
+                import tempfile
+                self._dir = tempfile.mkdtemp(prefix="srtpu-shuffle-")
+                self._owns_dir = True
+            d = self._dir
+        os.makedirs(d, exist_ok=True)
+        return d
 
     def _disk_path(self, bid: BlockId) -> str:
         import os
@@ -86,20 +92,24 @@ class ShuffleBlockStore:
             f"s{bid.shuffle_id}_m{bid.map_id}_r{bid.reduce_id}.blk")
 
     def put(self, bid: BlockId, data: bytes) -> None:
+        import os
         evict = []
         with self._lock:
             old = self._blocks.pop(bid, None)
             if old is not None:  # overwrite (e.g. retried map task)
                 self._mem_bytes -= len(old)
+            self._spilling.pop(bid, None)
             self._unlink(bid)  # drop any stale spilled copy
             self._blocks[bid] = data
             self._mem_bytes += len(data)
-            # FIFO overflow: the oldest blocks go to disk first; collect
-            # the evictees here but do the file I/O OUTSIDE the lock so
-            # concurrent writers/readers don't stall behind disk writes
+            # FIFO overflow: the oldest blocks go to disk first; the file
+            # I/O happens OUTSIDE the lock (writers/readers must not stall
+            # behind disk writes) with the evictee parked readable in
+            # _spilling until its file is registered
             while self._mem_bytes > self._budget and len(self._blocks) > 1:
                 old_bid, old_data = next(iter(self._blocks.items()))
                 evict.append((old_bid, old_data))
+                self._spilling[old_bid] = old_data
                 del self._blocks[old_bid]
                 self._mem_bytes -= len(old_data)
         for old_bid, old_data in evict:
@@ -107,21 +117,41 @@ class ShuffleBlockStore:
             with open(path, "wb") as f:
                 f.write(old_data)
             with self._lock:
-                self._on_disk[old_bid] = path
+                if self._spilling.pop(old_bid, None) is not None:
+                    self._on_disk[old_bid] = path
+                else:
+                    # removed (or re-put) while the write was in flight:
+                    # this file must not resurrect the block
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
 
     def get(self, bid: BlockId) -> Optional[bytes]:
         with self._lock:
             data = self._blocks.get(bid)
+            if data is None:
+                data = self._spilling.get(bid)
             if data is not None:
                 return data
+            if self._read_cache is not None and \
+                    self._read_cache[0] == bid:
+                # bounce-buffer fetches resolve the same block once per
+                # window; without this a spilled 1GB block would re-read
+                # its whole file per 4MB window
+                return self._read_cache[1]
             path = self._on_disk.get(bid)
         if path is None:
             return None
         try:
             with open(path, "rb") as f:
-                return f.read()
+                data = f.read()
         except FileNotFoundError:
             return None  # concurrently removed: same contract as memory
+        with self._lock:
+            if bid in self._on_disk:  # not removed while reading
+                self._read_cache = (bid, data)
+        return data
 
     def _unlink(self, bid: BlockId) -> None:
         path = self._on_disk.pop(bid, None)
@@ -137,6 +167,9 @@ class ShuffleBlockStore:
             data = self._blocks.pop(bid, None)
             if data is not None:
                 self._mem_bytes -= len(data)
+            self._spilling.pop(bid, None)  # kills an in-flight eviction
+            if self._read_cache is not None and self._read_cache[0] == bid:
+                self._read_cache = None
             self._unlink(bid)
 
     def remove_shuffle(self, shuffle_id: int) -> None:
@@ -144,6 +177,12 @@ class ShuffleBlockStore:
             for k in [k for k in self._blocks if k.shuffle_id == shuffle_id]:
                 self._mem_bytes -= len(self._blocks[k])
                 del self._blocks[k]
+            for k in [k for k in self._spilling
+                      if k.shuffle_id == shuffle_id]:
+                del self._spilling[k]
+            if self._read_cache is not None and \
+                    self._read_cache[0].shuffle_id == shuffle_id:
+                self._read_cache = None
             for k in [k for k in self._on_disk
                       if k.shuffle_id == shuffle_id]:
                 self._unlink(k)
@@ -151,7 +190,8 @@ class ShuffleBlockStore:
     def blocks_for_reduce(self, shuffle_id: int,
                           reduce_id: int) -> List[BlockId]:
         with self._lock:
-            all_ids = set(self._blocks) | set(self._on_disk)
+            all_ids = set(self._blocks) | set(self._on_disk) | \
+                set(self._spilling)
             return sorted((k for k in all_ids
                            if k.shuffle_id == shuffle_id
                            and k.reduce_id == reduce_id),
@@ -166,7 +206,8 @@ class ShuffleBlockStore:
                     disk += os.path.getsize(p)
                 except OSError:
                     pass
-            return self._mem_bytes + disk
+            spilling = sum(len(v) for v in self._spilling.values())
+            return self._mem_bytes + spilling + disk
 
     def mem_bytes(self) -> int:
         with self._lock:

@@ -17,8 +17,12 @@ from test_queries import assert_same
 
 @pytest.fixture(scope="module")
 def session():
+    # AQE + CBO on: the corpus is the newest planning code's end-to-end
+    # coverage (round-2 verdict weak item #6)
     return TpuSession({"spark.rapids.sql.enabled": True,
-                       "spark.rapids.sql.explain": "NONE"})
+                       "spark.rapids.sql.explain": "NONE",
+                       "spark.rapids.sql.adaptive.enabled": True,
+                       "spark.rapids.sql.optimizer.enabled": True})
 
 
 @pytest.fixture(scope="module")
