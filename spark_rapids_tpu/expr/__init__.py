@@ -48,6 +48,9 @@ from .regex import (RLike, Like, RegExpReplace, RegExpExtract,  # noqa: F401
                     device_supported_pattern)
 from .maps import (MapKeys, MapValues, MapEntries, GetMapValue,  # noqa: F401
                    CreateMap, MapFromArrays, MapConcat, StringToMap)
+from .hashing_ext import (Md5, Sha1, Sha2, Crc32, XxHash64,  # noqa: F401
+                          HiveHash)
+from .splits import StringSplit, RegExpExtractAll, ArraysZip  # noqa: F401
 from .higher_order import (NamedLambdaVariable, ArrayTransform,  # noqa: F401
                            ArrayFilter, ArrayExists, ArrayForAll,
                            ArrayAggregate, ZipWith, TransformKeys,

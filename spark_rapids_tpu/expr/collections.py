@@ -169,6 +169,28 @@ class CreateArray(Expression):
                    (child,))
 
 
+class NullLike(Expression):
+    """An all-null column with the SAME type as its reference child —
+    typed padding for generators like stack() where the slot type is only
+    known after reference binding."""
+
+    def __init__(self, ref: Expression):
+        super().__init__([ref])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    @property
+    def nullable(self):
+        return True
+
+    def _compute(self, ctx: EvalContext, v: Vec) -> Vec:
+        xp = ctx.xp
+        return Vec(v.dtype, v.data, xp.zeros_like(v.validity), v.lengths,
+                   v.children)
+
+
 class Explode(Expression):
     """Generator marker: explode(array) -> one row per element (reference
     `GpuGenerateExec.scala:1`). Evaluated by the Generate execs, not row-wise;

@@ -1,0 +1,686 @@
+"""Digest/checksum expression family (reference `GpuOverrides.scala:2322`
+Md5, `hashFunctions` Sha1/Sha2/Crc32/XxHash64/HiveHash; bit-exact kernels
+live in spark-rapids-jni's hash kernels).
+
+TPU shape: every hash runs VECTORIZED over the row axis. Block ciphers
+(MD5/SHA) absorb the byte-matrix in fixed 64-byte blocks under a
+`lax.fori_loop` — rows with fewer blocks simply stop updating their
+state (masked select), so one compiled program serves every row length.
+Padding (0x80 terminator + message length) is scattered into per-row
+positions up front. Byte folds (CRC32, HiveHash strings, XXH64 tails)
+loop the static width with the lane masked by j < len. The numpy CPU
+engine runs the identical arithmetic with python loops — same spec, two
+backends, as everywhere else in expr/."""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from .. import types as T
+from ..columnar.padding import width_bucket
+from .base import EvalContext, Expression, Vec, all_valid
+
+__all__ = ["Md5", "Sha1", "Sha2", "Crc32", "XxHash64", "HiveHash"]
+
+_U32 = np.uint32
+_U64 = np.uint64
+
+
+# ---------------------------------------------------------------------------
+# shared scaffolding
+# ---------------------------------------------------------------------------
+
+def _string_bytes(v: Vec):
+    """(data uint8[n, W], lens int32[n]) of a string/binary Vec."""
+    return v.data, v.lengths
+
+
+def _padded_message(xp, data, lens, length_le: bool):
+    """Message matrix with MD5/SHA padding scattered per row: 0x80 after
+    the content, zeros, and the 8-byte bit length in the row's OWN final
+    block (little-endian for MD5, big-endian for SHA)."""
+    n, w = data.shape
+    pw = ((w + 8) // 64 + 1) * 64  # every row's padded length fits
+    pos = xp.arange(pw, dtype=np.int32)[None, :]
+    lens32 = lens[:, None].astype(np.int32)
+    if w == pw:
+        msg = data
+    else:
+        msg = xp.concatenate(
+            [data, xp.zeros((n, pw - w), np.uint8)], axis=1)
+    msg = xp.where(pos < lens32, msg, 0).astype(np.uint8)
+    msg = xp.where(pos == lens32, np.uint8(0x80), msg)
+    # per-row final-block length field: bytes at pad_start .. pad_start+7
+    nblocks = (lens.astype(np.int64) + 8) // 64 + 1
+    pad_start = (nblocks * 64 - 8)[:, None]
+    bitlen = (lens.astype(np.int64) * 8)[:, None]
+    k = pos - pad_start
+    in_len = (k >= 0) & (k < 8)
+    shift = xp.clip(k if length_le else 7 - k, 0, 7).astype(np.int64) * 8
+    lb = ((bitlen >> shift) & 0xFF).astype(np.uint8)
+    msg = xp.where(in_len, lb, msg)
+    return msg, nblocks, pw // 64
+
+
+def _blocks_fold(xp, msg, nblocks, total_blocks: int, state, compress):
+    """Run `compress(state, block_words_getter, b)` over every 64-byte
+    block, keeping each row's state frozen once its own blocks are done.
+    state is a tuple of [n] arrays."""
+    for b in range(total_blocks):  # static unroll: small (W/64 + 1)
+        new_state = compress(state, b)
+        live = (b < nblocks)
+        state = tuple(xp.where(live, ns, s)
+                      for ns, s in zip(new_state, state))
+    return state
+
+
+def _hex_vec(xp, byte_cols: List, validity) -> Vec:
+    """List of [n] uint8 arrays -> lowercase-hex string Vec."""
+    n = byte_cols[0].shape[0]
+    w = len(byte_cols) * 2
+    cols = []
+    for bc in byte_cols:
+        hi = (bc >> np.uint8(4)).astype(np.uint8)
+        lo = (bc & np.uint8(0x0F)).astype(np.uint8)
+        for nib in (hi, lo):
+            cols.append(xp.where(nib < 10, nib + np.uint8(ord("0")),
+                                 nib - np.uint8(10) + np.uint8(ord("a"))))
+    data = xp.stack(cols, axis=1).astype(np.uint8)
+    lens = xp.full(n, w, dtype=np.int32)
+    return Vec(T.STRING, data, validity, lens)
+
+
+def _u32_words_le(msg, xp, b):
+    blk = msg[:, b * 64:(b + 1) * 64].astype(np.uint32)
+    return [blk[:, j * 4] | (blk[:, j * 4 + 1] << _U32(8))
+            | (blk[:, j * 4 + 2] << _U32(16))
+            | (blk[:, j * 4 + 3] << _U32(24)) for j in range(16)]
+
+
+def _u32_words_be(msg, xp, b):
+    blk = msg[:, b * 64:(b + 1) * 64].astype(np.uint32)
+    return [(blk[:, j * 4] << _U32(24)) | (blk[:, j * 4 + 1] << _U32(16))
+            | (blk[:, j * 4 + 2] << _U32(8)) | blk[:, j * 4 + 3]
+            for j in range(16)]
+
+
+def _rotl32(x, r):
+    if isinstance(r, (int, np.integer)):  # static shift
+        return (x << _U32(r)) | (x >> _U32(32 - int(r)))
+    r32 = r.astype(np.uint32)  # traced/array shift (fori_loop rounds)
+    return (x << r32) | (x >> (_U32(32) - r32))
+
+
+def _rotr32(x, r):
+    return (x >> _U32(r)) | (x << _U32(32 - r))
+
+
+# ---------------------------------------------------------------------------
+# MD5
+# ---------------------------------------------------------------------------
+
+_MD5_S = [7, 12, 17, 22] * 4 + [5, 9, 14, 20] * 4 + \
+    [4, 11, 16, 23] * 4 + [6, 10, 15, 21] * 4
+_MD5_K = [int(abs(np.floor(np.float64(2 ** 32) *
+                           np.abs(np.sin(np.float64(i + 1))))))
+          & 0xFFFFFFFF for i in range(64)]
+
+
+_MD5_G = [i for i in range(16)] + [(5 * i + 1) % 16 for i in range(16, 32)] \
+    + [(3 * i + 5) % 16 for i in range(32, 48)] \
+    + [(7 * i) % 16 for i in range(48, 64)]
+
+
+def _md5_round(xp, i, a, bb, c, d, M, k_i, g_i, s_i):
+    """One MD5 round, the quarter's boolean function selected branchlessly
+    — shared by the compiled fori_loop (jnp) and the python loop (numpy)."""
+    f0 = (bb & c) | (~bb & d)
+    f1 = (d & bb) | (~d & c)
+    f2 = bb ^ c ^ d
+    f3 = c ^ (bb | ~d)
+    q = i // 16
+    f = xp.where(q == 0, f0, xp.where(q == 1, f1,
+                                      xp.where(q == 2, f2, f3)))
+    rot = a + f + k_i + M[g_i]
+    nb = bb + _rotl32(rot, s_i)
+    return d, nb, bb, c  # (a, b, c, d) for the next round
+
+
+def _md5_digest(xp, data, lens):
+    msg, nblocks, total = _padded_message(xp, data, lens, length_le=True)
+    n = data.shape[0]
+    a0 = xp.full(n, 0x67452301, np.uint32)
+    b0 = xp.full(n, 0xefcdab89, np.uint32)
+    c0 = xp.full(n, 0x98badcfe, np.uint32)
+    d0 = xp.full(n, 0x10325476, np.uint32)
+    K = xp.asarray(np.array(_MD5_K, np.uint32))
+    G = xp.asarray(np.array(_MD5_G, np.int32))
+    S = xp.asarray(np.array(_MD5_S, np.uint32))
+
+    def compress(state, b):
+        A, B, C, D = state
+        M = xp.stack(_u32_words_le(msg, xp, b))  # [16, n]
+        if xp is np:
+            a, bb, c, d = A, B, C, D
+            for i in range(64):
+                a, bb, c, d = _md5_round(np, np.int32(i), a, bb, c, d, M,
+                                         K[i], int(G[i]), S[i])
+        else:
+            from jax import lax
+
+            def body(i, st):
+                a, bb, c, d = st
+                return _md5_round(xp, i, a, bb, c, d, M, K[i], G[i], S[i])
+
+            a, bb, c, d = lax.fori_loop(0, 64, body, (A, B, C, D))
+        return (A + a, B + bb, C + c, D + d)
+
+    A, B, C, D = _blocks_fold(xp, msg, nblocks, total,
+                              (a0, b0, c0, d0), compress)
+    out = []
+    for word in (A, B, C, D):  # little-endian byte order
+        for k in range(4):
+            out.append(((word >> _U32(8 * k)) & _U32(0xFF)).astype(np.uint8))
+    return out
+
+
+class Md5(Expression):
+    """md5(string) -> 32-char lowercase hex (GpuOverrides.scala:2322)."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _compute(self, ctx: EvalContext, s: Vec) -> Vec:
+        data, lens = _string_bytes(s)
+        return _hex_vec(ctx.xp, _md5_digest(ctx.xp, data, lens),
+                        s.validity)
+
+
+# ---------------------------------------------------------------------------
+# SHA-1 / SHA-2 (224/256)
+# ---------------------------------------------------------------------------
+
+def _sha1_digest(xp, data, lens):
+    msg, nblocks, total = _padded_message(xp, data, lens, length_le=False)
+    n = data.shape[0]
+    h = [xp.full(n, v, np.uint32) for v in
+         (0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476, 0xC3D2E1F0)]
+
+    KS = xp.asarray(np.array([0x5A827999, 0x6ED9EBA1, 0x8F1BBCDC,
+                              0xCA62C1D6], np.uint32))
+
+    def round1(i, a, bb, c, d, e, w_i):
+        f0 = (bb & c) | (~bb & d)
+        f1 = bb ^ c ^ d
+        f2 = (bb & c) | (bb & d) | (c & d)
+        q = i // 20
+        f = xp.where(q == 0, f0, xp.where(q == 1, f1,
+                                          xp.where(q == 2, f2, f1)))
+        tmp = _rotl32(a, 5) + f + e + KS[q] + w_i
+        return tmp, a, _rotl32(bb, 30), c, d
+
+    def compress(state, b):
+        h0, h1, h2, h3, h4 = state
+        w = _u32_words_be(msg, xp, b)
+        for i in range(16, 80):  # schedule: 64 cheap xors, unrolled
+            w.append(_rotl32(w[i - 3] ^ w[i - 8] ^ w[i - 14] ^ w[i - 16],
+                             1))
+        if xp is np:
+            a, bb, c, d, e = h0, h1, h2, h3, h4
+            for i in range(80):
+                a, bb, c, d, e = round1(np.int32(i), a, bb, c, d, e, w[i])
+        else:
+            from jax import lax
+            W = xp.stack(w)  # [80, n]
+
+            def body(i, st):
+                a, bb, c, d, e = st
+                return round1(i, a, bb, c, d, e, W[i])
+
+            a, bb, c, d, e = lax.fori_loop(0, 80, body,
+                                           (h0, h1, h2, h3, h4))
+        return (h0 + a, h1 + bb, h2 + c, h3 + d, h4 + e)
+
+    out_words = _blocks_fold(xp, msg, nblocks, total, tuple(h), compress)
+    out = []
+    for word in out_words:  # big-endian byte order
+        for k in (3, 2, 1, 0):
+            out.append(((word >> _U32(8 * k)) & _U32(0xFF)).astype(np.uint8))
+    return out
+
+
+_SHA256_K = [
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2]
+
+_SHA224_H = (0xc1059ed8, 0x367cd507, 0x3070dd17, 0xf70e5939,
+             0xffc00b31, 0x68581511, 0x64f98fa7, 0xbefa4fa4)
+_SHA256_H = (0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+             0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19)
+
+
+def _sha2_digest(xp, data, lens, init, out_words: int):
+    msg, nblocks, total = _padded_message(xp, data, lens, length_le=False)
+    n = data.shape[0]
+    h = [xp.full(n, v, np.uint32) for v in init]
+
+    KT = xp.asarray(np.array(_SHA256_K, np.uint32))
+
+    def round256(a, bb, c, d, e, f, g, hh, k_i, w_i):
+        S1 = _rotr32(e, 6) ^ _rotr32(e, 11) ^ _rotr32(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = hh + S1 + ch + k_i + w_i
+        S0 = _rotr32(a, 2) ^ _rotr32(a, 13) ^ _rotr32(a, 22)
+        t2 = S0 + ((a & bb) ^ (a & c) ^ (bb & c))
+        return t1 + t2, a, bb, c, d + t1, e, f, g
+
+    def compress(state, b):
+        w = _u32_words_be(msg, xp, b)
+        for i in range(16, 64):  # schedule unrolled: cheap shifts/xors
+            s0 = _rotr32(w[i - 15], 7) ^ _rotr32(w[i - 15], 18) ^ \
+                (w[i - 15] >> _U32(3))
+            s1 = _rotr32(w[i - 2], 17) ^ _rotr32(w[i - 2], 19) ^ \
+                (w[i - 2] >> _U32(10))
+            w.append(w[i - 16] + s0 + w[i - 7] + s1)
+        if xp is np:
+            a, bb, c, d, e, f, g, hh = state
+            for i in range(64):
+                a, bb, c, d, e, f, g, hh = round256(
+                    a, bb, c, d, e, f, g, hh, KT[i], w[i])
+        else:
+            from jax import lax
+            W = xp.stack(w)  # [64, n]
+
+            def body(i, st):
+                return round256(*st, KT[i], W[i])
+
+            a, bb, c, d, e, f, g, hh = lax.fori_loop(0, 64, body, state)
+        return tuple(s + v for s, v in
+                     zip(state, (a, bb, c, d, e, f, g, hh)))
+
+    out_state = _blocks_fold(xp, msg, nblocks, total, tuple(h), compress)
+    out = []
+    for word in out_state[:out_words]:
+        for k in (3, 2, 1, 0):
+            out.append(((word >> _U32(8 * k)) & _U32(0xFF)).astype(np.uint8))
+    return out
+
+
+class Sha1(Expression):
+    """sha1/sha(string) -> 40-char hex."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _compute(self, ctx: EvalContext, s: Vec) -> Vec:
+        data, lens = _string_bytes(s)
+        return _hex_vec(ctx.xp, _sha1_digest(ctx.xp, data, lens),
+                        s.validity)
+
+
+class Sha2(Expression):
+    """sha2(string, bits) for bits in (0, 224, 256) — 0 means 256, like
+    Spark. 384/512 need 64-bit words (tagged to CPU)."""
+
+    def __init__(self, child: Expression, bits: int = 256):
+        super().__init__([child])
+        self.bits = int(bits)
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    @property
+    def nullable(self):
+        return True
+
+    def _compute(self, ctx: EvalContext, s: Vec) -> Vec:
+        xp = ctx.xp
+        data, lens = _string_bytes(s)
+        bits = self.bits or 256
+        if bits == 224:
+            out = _sha2_digest(xp, data, lens, _SHA224_H, 7)
+        elif bits == 256:
+            out = _sha2_digest(xp, data, lens, _SHA256_H, 8)
+        elif bits in (384, 512):
+            # 64-bit-word variants: host hashlib on the CPU engine, the
+            # planner tags them off device
+            from ..errors import CpuFallbackRequired
+            if xp is not np:
+                raise CpuFallbackRequired("sha2 384/512 runs on CPU")
+            import hashlib
+            n = data.shape[0]
+            outs = []
+            for i in range(n):
+                b = bytes(np.asarray(data[i, :int(lens[i])]))
+                h = hashlib.sha384(b) if bits == 384 else hashlib.sha512(b)
+                outs.append(h.hexdigest())
+            w = width_bucket(bits // 4)
+            dm = np.zeros((n, w), np.uint8)
+            lv = np.zeros(n, np.int32)
+            for i, hx in enumerate(outs):
+                eb = hx.encode()
+                dm[i, :len(eb)] = np.frombuffer(eb, np.uint8)
+                lv[i] = len(eb)
+            return Vec(T.STRING, dm, s.validity, lv)
+        else:  # invalid bit width -> null (Spark semantics)
+            n = data.shape[0]
+            return Vec(T.STRING, xp.zeros((n, 8), np.uint8),
+                       xp.zeros(n, dtype=bool),
+                       xp.zeros(n, np.int32))
+        return _hex_vec(xp, out, s.validity)
+
+
+# ---------------------------------------------------------------------------
+# CRC32
+# ---------------------------------------------------------------------------
+
+def _crc32_table() -> np.ndarray:
+    tbl = np.zeros(256, np.uint32)
+    for i in range(256):
+        c = np.uint32(i)
+        for _ in range(8):
+            c = np.uint32(0xEDB88320) ^ (c >> np.uint32(1)) \
+                if c & np.uint32(1) else c >> np.uint32(1)
+        tbl[i] = c
+    return tbl
+
+
+_CRC_TABLE = _crc32_table()
+
+
+class Crc32(Expression):
+    """crc32(string/binary) -> LONG (IEEE CRC-32, like Spark/zlib)."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.LONG
+
+    def _compute(self, ctx: EvalContext, s: Vec) -> Vec:
+        xp = ctx.xp
+        data, lens = _string_bytes(s)
+        n, w = data.shape
+        tbl = xp.asarray(_CRC_TABLE)
+        crc = xp.full(n, 0xFFFFFFFF, np.uint32)
+        for j in range(w):  # static width; lane masked by length
+            idx = ((crc ^ data[:, j].astype(np.uint32))
+                   & _U32(0xFF)).astype(np.int32)
+            nxt = tbl[idx] ^ (crc >> _U32(8))
+            crc = xp.where(j < lens, nxt, crc)
+        crc = crc ^ _U32(0xFFFFFFFF)
+        return Vec(T.LONG, crc.astype(np.int64), s.validity)
+
+
+# ---------------------------------------------------------------------------
+# XXH64 (Spark XxHash64: seed 42, children chained)
+# ---------------------------------------------------------------------------
+
+_P1 = _U64(0x9E3779B185EBCA87)
+_P2 = _U64(0xC2B2AE3D27D4EB4F)
+_P3 = _U64(0x165667B19E3779F9)
+_P4 = _U64(0x85EBCA77C2B2AE63)
+_P5 = _U64(0x27D4EB2F165667C5)
+
+
+def _rotl64(x, r):
+    return (x << _U64(r)) | (x >> _U64(64 - r))
+
+
+def _xxh_avalanche(h):
+    h = h ^ (h >> _U64(33))
+    h = h * _P2
+    h = h ^ (h >> _U64(29))
+    h = h * _P3
+    return h ^ (h >> _U64(32))
+
+
+def _xxh64_u64(xp, v_u64, seed_u64):
+    """XXH64 of ONE 8-byte little-endian value (Spark's fixed-width path,
+    `XXH64.hashLong`)."""
+    h = seed_u64 + _P5 + _U64(8)
+    k1 = v_u64 * _P2
+    k1 = _rotl64(k1, 31)
+    k1 = k1 * _P1
+    h = h ^ k1
+    h = _rotl64(h, 27) * _P1 + _P4
+    return _xxh_avalanche(h)
+
+
+def _xxh64_int(xp, v_u32, seed_u64):
+    """XXH64 of one 4-byte value (`XXH64.hashInt`)."""
+    h = seed_u64 + _P5 + _U64(4)
+    h = h ^ (v_u32.astype(np.uint64) * _P1)
+    h = _rotl64(h, 23) * _P2 + _P3
+    return _xxh_avalanche(h)
+
+
+def _xxh64_bytes(xp, data, lens, seed_u64):
+    """XXH64 over variable-length rows of a byte matrix (XXH64.hashBytes):
+    31-byte-plus rows run the 4-accumulator stripe loop; tails mix 8-, 4-
+    then 1-byte chunks — all masked by each row's length."""
+    n, w = data.shape
+    lens64 = lens.astype(np.int64)
+
+    def u64_at(j):  # little-endian 8 bytes from column j (static j)
+        acc = xp.zeros(n, np.uint64)
+        for k in range(8):
+            c = data[:, j + k].astype(np.uint64) if j + k < w else \
+                xp.zeros(n, np.uint64)
+            acc = acc | (c << _U64(8 * k))
+        return acc
+
+    def u32_at(j):
+        acc = xp.zeros(n, np.uint64)
+        for k in range(4):
+            c = data[:, j + k].astype(np.uint64) if j + k < w else \
+                xp.zeros(n, np.uint64)
+            acc = acc | (c << _U64(8 * k))
+        return acc
+
+    nstripes = (w // 32) + 1
+    v1 = seed_u64 + _P1 + _P2
+    v2 = seed_u64 + _P2
+    v3 = seed_u64 + _U64(0)
+    v4 = seed_u64 - _P1
+    any_stripe = lens64 >= 32
+    for s in range(nstripes):
+        base = s * 32
+        live = (base + 32) <= lens64
+
+        def lane(v, off, _base=base, _live=live):
+            nv = v + u64_at(_base + off) * _P2
+            nv = _rotl64(nv, 31) * _P1
+            return xp.where(_live, nv, v)
+
+        v1 = lane(v1, 0)
+        v2 = lane(v2, 8)
+        v3 = lane(v3, 16)
+        v4 = lane(v4, 24)
+    hs = _rotl64(v1, 1) + _rotl64(v2, 7) + _rotl64(v3, 12) + \
+        _rotl64(v4, 18)
+
+    def merge(h, v):
+        k = v * _P2
+        k = _rotl64(k, 31) * _P1
+        h = h ^ k
+        return h * _P1 + _P4
+
+    hs = merge(merge(merge(merge(hs, v1), v2), v3), v4)
+    h = xp.where(any_stripe, hs, seed_u64 + _P5)
+    h = h + lens64.astype(np.uint64)
+    # tail: from (len // 32) * 32, first 8-byte chunks, then 4, then 1s
+    tail_start = (lens64 // 32) * 32
+    for j8 in range(w // 8 + 1):
+        pos = j8 * 8
+        live = (pos + 8 <= lens64) & (pos >= tail_start)
+        k1 = u64_at(pos) * _P2
+        k1 = _rotl64(k1, 31) * _P1
+        nh = (_rotl64(h ^ k1, 27)) * _P1 + _P4
+        h = xp.where(live, nh, h)
+    eight_end = tail_start + ((lens64 - tail_start) // 8) * 8
+    for j4 in range(w // 4 + 1):
+        pos = j4 * 4
+        live = (pos == eight_end) & (pos + 4 <= lens64)
+        nh = _rotl64(h ^ (u32_at(pos) * _P1), 23) * _P2 + _P3
+        h = xp.where(live, nh, h)
+    four_end = eight_end + \
+        xp.where((eight_end + 4) <= lens64, 4, 0).astype(np.int64)
+    for j in range(w):
+        live = (j >= four_end) & (j < lens64)
+        k = data[:, j].astype(np.uint64) * _P5
+        nh = _rotl64(h ^ k, 11) * _P1
+        h = xp.where(live, nh, h)
+    return _xxh_avalanche(h)
+
+
+class XxHash64(Expression):
+    """xxhash64(cols..., seed 42): children chained left-to-right, each
+    non-null value hashed with the running hash as seed (Spark
+    `XxHash64`); nulls leave the hash unchanged."""
+
+    def __init__(self, children: Sequence[Expression], seed: int = 42):
+        super().__init__(list(children))
+        self.seed = seed
+
+    @property
+    def data_type(self):
+        return T.LONG
+
+    @property
+    def nullable(self):
+        return False
+
+    def _compute(self, ctx: EvalContext, *cols: Vec) -> Vec:
+        xp = ctx.xp
+        n = cols[0].data.shape[0] if cols else 1
+        h = xp.full(n, np.uint64(self.seed), np.uint64)
+        for v in cols:
+            h = xp.where(v.validity, _hash_one_xxh(xp, v, h), h)
+        return Vec(T.LONG, h.astype(np.int64), all_valid(xp, h))
+
+
+def _hash_one_xxh(xp, v: Vec, seed):
+    if v.is_string:
+        return _xxh64_bytes(xp, v.data, v.lengths, seed)
+    dt = v.dtype
+    if isinstance(dt, T.BooleanType):
+        return _xxh64_int(xp, v.data.astype(np.uint32), seed)
+    if T.is_integral(dt) or isinstance(dt, (T.DateType, T.TimestampType)):
+        if isinstance(dt, (T.ByteType, T.ShortType, T.IntegerType,
+                           T.DateType)):
+            return _xxh64_int(xp, v.data.astype(np.int32).astype(np.uint32),
+                              seed)
+        return _xxh64_u64(xp, v.data.astype(np.int64).astype(np.uint64),
+                          seed)
+    if T.is_floating(dt):
+        # Spark normalizes -0.0 and hashes the IEEE bits of the declared
+        # width (float stays 4 bytes, double 8)
+        d = v.data
+        d = xp.where(d == 0, xp.zeros((), d.dtype), d)
+        if isinstance(dt, T.FloatType):
+            if xp is np:
+                bits = np.ascontiguousarray(d.astype(np.float32)) \
+                    .view(np.uint32)
+            else:
+                from jax import lax
+                bits = lax.bitcast_convert_type(d.astype(np.float32),
+                                                np.uint32)
+            return _xxh64_int(xp, bits, seed)
+        if xp is np:
+            bits = np.ascontiguousarray(d.astype(np.float64)).view(np.uint64)
+        else:
+            from jax import lax
+            bits = lax.bitcast_convert_type(d.astype(np.float64), np.uint64)
+        return _xxh64_u64(xp, bits, seed)
+    raise NotImplementedError(f"xxhash64 over {dt}")
+
+
+# ---------------------------------------------------------------------------
+# HiveHash
+# ---------------------------------------------------------------------------
+
+class HiveHash(Expression):
+    """hive-hash(cols...): 31*acc + field hash per child (HiveHasher);
+    ints hash to themselves, longs fold high^low, strings run the
+    31-polynomial over bytes, null fields contribute 0."""
+
+    def __init__(self, children: Sequence[Expression]):
+        super().__init__(list(children))
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    @property
+    def nullable(self):
+        return False
+
+    def _compute(self, ctx: EvalContext, *cols: Vec) -> Vec:
+        xp = ctx.xp
+        n = cols[0].data.shape[0] if cols else 1
+        acc = xp.zeros(n, np.int32)
+        for v in cols:
+            fh = xp.where(v.validity, _hive_hash_one(xp, v),
+                          np.int32(0)).astype(np.int32)
+            acc = (acc * np.int32(31) + fh).astype(np.int32)
+        return Vec(T.INT, acc, all_valid(xp, acc))
+
+
+def _hive_hash_one(xp, v: Vec):
+    dt = v.dtype
+    if v.is_string:
+        n, w = v.data.shape
+        h = xp.zeros(n, np.int32)
+        for j in range(w):
+            nh = (h * np.int32(31)
+                  + v.data[:, j].astype(np.int8).astype(np.int32)) \
+                .astype(np.int32)
+            h = xp.where(j < v.lengths, nh, h)
+        return h
+    if isinstance(dt, T.BooleanType):
+        return xp.where(v.data, np.int32(1), np.int32(0))
+    if isinstance(dt, (T.ByteType, T.ShortType, T.IntegerType, T.DateType)):
+        return v.data.astype(np.int32)
+    if isinstance(dt, (T.LongType, T.TimestampType)):
+        x = v.data.astype(np.int64)
+        return (x ^ ((x.astype(np.uint64) >> np.uint64(32))
+                     .astype(np.int64))).astype(np.int32)
+    if isinstance(dt, T.FloatType):
+        d = xp.where(v.data == 0, xp.zeros((), v.data.dtype), v.data)
+        if xp is np:
+            return np.ascontiguousarray(d.astype(np.float32)) \
+                .view(np.int32)
+        from jax import lax
+        return lax.bitcast_convert_type(d.astype(np.float32), np.int32)
+    if isinstance(dt, T.DoubleType):
+        d = xp.where(v.data == 0, xp.zeros((), v.data.dtype), v.data)
+        if xp is np:
+            bits = np.ascontiguousarray(d.astype(np.float64)).view(np.int64)
+        else:
+            from jax import lax
+            bits = lax.bitcast_convert_type(d.astype(np.float64), np.int64)
+        return (bits ^ ((bits.astype(np.uint64) >> np.uint64(32))
+                        .astype(np.int64))).astype(np.int32)
+    raise NotImplementedError(f"hive hash over {dt}")

@@ -1,0 +1,230 @@
+"""split / regexp_extract_all / arrays_zip (reference
+`GpuOverrides.scala:2385` StringSplit, regexp_extract_all under
+`GpuRegExpExtractAll`, ArraysZip in `collectionOperations.scala`).
+
+StringSplit shares the byte-matrix span machinery with str_to_map: pair
+boundaries come from a vectorized delimiter scan, spans gather on device.
+The device path takes literal single-byte ASCII delimiters (the planner
+tags regex patterns to CPU, like the reference's regex transpiler
+rejections); the CPU engine implements the full regex semantics row-wise."""
+
+from __future__ import annotations
+
+import re
+from typing import List, Sequence
+
+import numpy as np
+
+from .. import types as T
+from ..columnar.padding import width_bucket
+from ..errors import CpuFallbackRequired
+from .base import EvalContext, Expression, Vec
+from .maps import _extract_spans, _grow_fanout
+
+__all__ = ["StringSplit", "RegExpExtractAll", "ArraysZip"]
+
+_REGEX_META = set(".^$*+?()[]{}|\\")
+
+
+def is_literal_pattern(p: str) -> bool:
+    return isinstance(p, str) and not any(ch in _REGEX_META for ch in p)
+
+
+class StringSplit(Expression):
+    """split(str, pattern[, limit]) -> array<string>. Device path:
+    literal single-byte delimiter; limit -1 keeps every part (Spark's
+    default), limit > 0 caps the parts with the LAST part carrying the
+    unsplit remainder, limit 0 drops trailing empty parts (Java split)."""
+
+    def __init__(self, child: Expression, pattern: str, limit: int = -1):
+        super().__init__([child])
+        self.pattern = pattern
+        self.limit = int(limit)
+
+    @property
+    def data_type(self):
+        return T.ArrayType(T.STRING, contains_null=False)
+
+    @property
+    def needs_eager(self) -> bool:
+        return True  # data-dependent output fanout
+
+    def _compute(self, ctx: EvalContext, sv: Vec) -> Vec:
+        xp = ctx.xp
+        device_ok = is_literal_pattern(self.pattern) and \
+            len(self.pattern) == 1 and ord(self.pattern) < 128
+        if not device_ok:
+            if xp is not np:
+                raise CpuFallbackRequired(
+                    "split with a regex/multi-byte pattern")
+            return self._compute_host(ctx, sv)
+        n, w = sv.data.shape
+        d = np.uint8(ord(self.pattern))
+        pos32 = xp.arange(w, dtype=np.int32)[None, :]
+        live = pos32 < sv.lengths[:, None]
+        is_d = (sv.data == d) & live
+        nsplits = is_d.sum(axis=1).astype(np.int32)
+        nparts = nsplits + 1
+        if self.limit > 0:
+            nparts = xp.minimum(nparts, np.int32(self.limit))
+        valid_parts = xp.where(sv.validity, nparts, 0)
+        k = width_bucket(max(int(valid_parts.max()) if n else 1, 1))
+        big = np.int32(w + 1)
+        dpos = xp.where(is_d, pos32, big)
+        dsorted = xp.sort(dpos, axis=1)[:, :k]
+        if dsorted.shape[1] < k:
+            dsorted = xp.pad(dsorted, ((0, 0), (0, k - dsorted.shape[1])),
+                             constant_values=big)
+        lens32 = sv.lengths[:, None].astype(np.int32)
+        ends = xp.minimum(dsorted, lens32)
+        starts = xp.concatenate(
+            [xp.zeros((n, 1), np.int32), dsorted[:, :k - 1] + 1], axis=1)
+        starts = xp.minimum(starts, lens32)
+        # the capped final part swallows the remainder (limit > 0)
+        last_ix = (nparts - 1)[:, None]
+        part_ix = xp.arange(k, dtype=np.int32)[None, :]
+        if self.limit > 0:
+            ends = xp.where(part_ix == last_ix, lens32, ends)
+        part_live = part_ix < nparts[:, None]
+        child = _extract_spans(xp, sv.data, starts, ends, part_live)
+        counts = valid_parts
+        if self.limit == 0:
+            # Java split(limit=0): drop trailing EMPTY parts
+            nonempty = child.lengths > 0
+            idx = xp.where(part_live & nonempty, part_ix + 1, 0)
+            counts = xp.where(sv.validity,
+                              idx.max(axis=1).astype(np.int32), 0)
+            counts = xp.where(sv.validity & (sv.lengths == 0),
+                              np.int32(1), counts)
+        return Vec(self.data_type, counts, sv.validity, None, (child,))
+
+    def _compute_host(self, ctx: EvalContext, sv: Vec) -> Vec:
+        """CPU engine: full java-regex-ish semantics via re.split."""
+        n = sv.data.shape[0]
+        rx = re.compile(self.pattern)
+        limit = self.limit
+        rows: List[List[str]] = []
+        for i in range(n):
+            if not bool(sv.validity[i]):
+                rows.append([])
+                continue
+            s = bytes(np.asarray(
+                sv.data[i, :int(sv.lengths[i])])).decode("utf-8", "replace")
+            if limit > 0:
+                parts = rx.split(s, maxsplit=limit - 1)
+            else:
+                parts = rx.split(s)
+                if limit == 0:
+                    while parts and parts[-1] == "":
+                        parts.pop()
+                    if not parts:
+                        parts = [""] if s == "" else parts
+            rows.append(parts)
+        return _string_rows_to_array_vec(np, rows, np.asarray(sv.validity),
+                                         self.data_type)
+
+
+def _string_rows_to_array_vec(xp, rows: List[List[str]], validity,
+                              out_type) -> Vec:
+    n = len(rows)
+    counts = np.array([len(r) for r in rows], np.int32)
+    k = width_bucket(max(int(counts.max()) if n else 1, 1))
+    enc = [[p.encode() for p in r] for r in rows]
+    wmax = max((len(b) for r in enc for b in r), default=1)
+    w = width_bucket(max(wmax, 1))
+    data = np.zeros((n, k, w), np.uint8)
+    lens = np.zeros((n, k), np.int32)
+    valid = np.zeros((n, k), bool)
+    for i, r in enumerate(enc):
+        for j, b in enumerate(r):
+            data[i, j, :len(b)] = np.frombuffer(b, np.uint8)
+            lens[i, j] = len(b)
+            valid[i, j] = True
+    child = Vec(T.STRING, data, valid, lens)
+    return Vec(out_type, np.where(validity, counts, 0), validity, None,
+               (child,))
+
+
+class RegExpExtractAll(Expression):
+    """regexp_extract_all(str, pattern, idx) -> array<string> (CPU
+    implementation, like RegExpExtract — the planner tags it off
+    device)."""
+
+    def __init__(self, child: Expression, pattern, idx: int = 1):
+        super().__init__([child])
+        from .regex import _pattern_literal
+        self.pattern = _pattern_literal(pattern) \
+            if not isinstance(pattern, str) else pattern
+        self.idx = int(idx)
+
+    @property
+    def data_type(self):
+        return T.ArrayType(T.STRING, contains_null=False)
+
+    @property
+    def needs_eager(self) -> bool:
+        return True
+
+    def _compute(self, ctx: EvalContext, sv: Vec) -> Vec:
+        if ctx.xp is not np:
+            raise CpuFallbackRequired("regexp_extract_all runs on CPU")
+        return self._host(sv)
+
+    def _host(self, sv: Vec) -> Vec:
+        rx = re.compile(self.pattern)
+        n = sv.data.shape[0]
+        rows: List[List[str]] = []
+        for i in range(n):
+            if not bool(sv.validity[i]):
+                rows.append([])
+                continue
+            s = bytes(np.asarray(
+                sv.data[i, :int(sv.lengths[i])])).decode("utf-8", "replace")
+            out = []
+            for m in rx.finditer(s):
+                g = m.group(self.idx) if self.idx <= (rx.groups or 0) \
+                    else None
+                out.append(g if g is not None else "")
+            rows.append(out)
+        return _string_rows_to_array_vec(np, rows, np.asarray(sv.validity),
+                                         self.data_type)
+
+
+class ArraysZip(Expression):
+    """arrays_zip(a1, a2, ...) -> array<struct<...>>: element i of the
+    output holds field j = a_j[i] (null past a_j's end); output length is
+    the LONGEST input."""
+
+    def __init__(self, children: Sequence[Expression],
+                 names: Sequence[str] = ()):
+        super().__init__(list(children))
+        self.names = list(names) or [str(i) for i in
+                                     range(len(self.children))]
+
+    @property
+    def data_type(self):
+        return T.ArrayType(T.StructType(tuple(
+            T.StructField(nm, c.data_type.element_type, True)
+            for nm, c in zip(self.names, self.children))))
+
+    def _compute(self, ctx: EvalContext, *arrs: Vec) -> Vec:
+        xp = ctx.xp
+        n = arrs[0].data.shape[0]
+        k = max(a.children[0].validity.shape[1] for a in arrs)
+        validity = arrs[0].validity
+        for a in arrs[1:]:
+            validity = validity & a.validity
+        counts = arrs[0].data.astype(np.int32)
+        for a in arrs[1:]:
+            counts = xp.maximum(counts, a.data.astype(np.int32))
+        fields = []
+        for a in arrs:
+            e = _grow_fanout(xp, a.children[0], k)
+            in_range = xp.arange(k)[None, :] < a.data[:, None]
+            fields.append(Vec(e.dtype, e.data, e.validity & in_range,
+                              e.lengths, e.children))
+        ones = xp.ones((n, k), dtype=bool)
+        entry = Vec(self.data_type.element_type, ones, ones, None,
+                    tuple(fields))
+        return Vec(self.data_type, xp.where(validity, counts, 0), validity,
+                   None, (entry,))

@@ -74,6 +74,52 @@ class DataFrame:
     def cross_join(self, other: "DataFrame") -> "DataFrame":
         return self.join(other, how="cross")
 
+    def stack(self, n: int, *exprs, prefix: str = "col") -> "DataFrame":
+        """stack(n, e1, ..., em): n output rows per input row with
+        ceil(m/n) generated columns (Spark's Stack generator,
+        `GpuOverrides.scala` Stack). Lowered onto the existing generate
+        machinery: explode of an n-slot array of structs, then a
+        flattening projection — no dedicated exec needed."""
+        import math
+        from .expr.base import Alias
+        from .expr.collections import (CreateArray, CreateNamedStruct,
+                                       Explode, GetStructField, NullLike)
+        m = len(exprs)
+
+        def resolved(e):
+            e = _as_expr(e)
+            if isinstance(e, AttributeReference):
+                try:
+                    e.data_type
+                except ValueError:  # untyped col(...): the schema knows
+                    i = self.plan.output.index_of(e.col_name)
+                    return AttributeReference(e.col_name,
+                                              self.plan.output.types[i])
+            return e
+
+        es = [resolved(e) for e in exprs]
+        ncols = max(math.ceil(m / max(n, 1)), 1)
+        names = [f"{prefix}{c}" for c in range(ncols)]
+        rows = []
+        for r in range(n):
+            fields = []
+            for c in range(ncols):
+                i = r * ncols + c
+                fields.append(es[i] if i < m else NullLike(es[c]))
+            rows.append(CreateNamedStruct(names, fields))
+        gen = Explode(CreateArray(rows))
+        exploded = DataFrame(self.session,
+                             N.CpuGenerateExec(gen, self.plan))
+        # the generated struct is the LAST column: bind by ordinal so a
+        # pre-existing column literally named "col" cannot shadow it
+        from .expr.base import BoundReference
+        struct_ref = BoundReference(len(self.plan.output.names),
+                                    rows[0].data_type)
+        keep = [nm for nm in self.plan.output.names]
+        flat = [Alias(GetStructField(struct_ref, c), names[c])
+                for c in range(ncols)]
+        return exploded.select(*keep, *[f for f in flat])
+
     def explode(self, column, outer: bool = False,
                 position: bool = False) -> "DataFrame":
         """Append explode(column) rows: one output row per array element

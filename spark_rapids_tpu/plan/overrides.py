@@ -185,6 +185,7 @@ def _tag_create_array(meta: ExprMeta) -> None:
 
 
 expr_rule(ECL.Size, _int)
+expr_rule(ECL.NullLike, _nested38)
 for cls in (ECL.GetArrayItem, ECL.ElementAt, ECL.GetStructField,
             ECL.CreateNamedStruct, ECL.Explode):
     expr_rule(cls, _nested)
@@ -238,6 +239,40 @@ for cls in (EMP.MapKeys, EMP.MapValues, EMP.MapEntries, EMP.GetMapValue,
     expr_rule(cls, _nested)
 expr_rule(EMP.CreateMap, _nested, tag_fn=_tag_create_map)
 expr_rule(EMP.StringToMap, _nested, tag_fn=_tag_string_to_map)
+
+# digest/checksum family (GpuOverrides.scala:2322 Md5, hashFunctions) and
+# split/extract-all/arrays_zip (GpuOverrides.scala:2385 StringSplit)
+from ..expr import hashing_ext as EHX  # noqa: E402
+from ..expr import splits as ESP  # noqa: E402
+
+_long_sig = TypeSig((T.LongType,))
+
+def _tag_sha2_bits(meta: ExprMeta) -> None:
+    if meta.expr.bits in (384, 512):
+        meta.will_not_work("sha2 384/512 needs 64-bit words (CPU only)")
+
+
+for cls in (EHX.Md5, EHX.Sha1):
+    expr_rule(cls, _str)
+expr_rule(EHX.Sha2, _str, tag_fn=_tag_sha2_bits)
+expr_rule(EHX.Crc32, _long_sig)
+expr_rule(EHX.XxHash64, _long_sig)
+expr_rule(EHX.HiveHash, _int)
+
+
+def _tag_string_split(meta: ExprMeta) -> None:
+    p = meta.expr.pattern
+    if not (ESP.is_literal_pattern(p) and len(p) == 1 and ord(p) < 128):
+        meta.will_not_work(
+            "split requires a literal single-byte ASCII delimiter on TPU "
+            "(the reference rejects unsupported regex the same way)")
+
+
+expr_rule(ESP.StringSplit, _nested, tag_fn=_tag_string_split)
+expr_rule(ESP.RegExpExtractAll, _nested,
+          tag_fn=lambda m: m.will_not_work(
+              "regexp_extract_all runs on CPU (regex extraction)"))
+expr_rule(ESP.ArraysZip, _nested)
 
 # higher-order functions (higherOrderFunctions.scala,
 # GpuOverrides.scala:2629-2810): lambdas evaluate over the flattened

@@ -99,6 +99,8 @@ class ShuffleBlockStore:
             if old is not None:  # overwrite (e.g. retried map task)
                 self._mem_bytes -= len(old)
             self._spilling.pop(bid, None)
+            if self._read_cache is not None and self._read_cache[0] == bid:
+                self._read_cache = None  # never serve pre-overwrite bytes
             self._unlink(bid)  # drop any stale spilled copy
             self._blocks[bid] = data
             self._mem_bytes += len(data)
@@ -117,8 +119,15 @@ class ShuffleBlockStore:
             with open(path, "wb") as f:
                 f.write(old_data)
             with self._lock:
-                if self._spilling.pop(old_bid, None) is not None:
+                # claim ONLY our own parked bytes: a re-put + re-evict can
+                # park a NEWER payload under the same id — identity check
+                # keeps writer generations from stealing each other's entry
+                if self._spilling.get(old_bid) is old_data:
+                    del self._spilling[old_bid]
                     self._on_disk[old_bid] = path
+                    if self._read_cache is not None and \
+                            self._read_cache[0] == old_bid:
+                        self._read_cache = None
                 else:
                     # removed (or re-put) while the write was in flight:
                     # this file must not resurrect the block
@@ -149,7 +158,9 @@ class ShuffleBlockStore:
         except FileNotFoundError:
             return None  # concurrently removed: same contract as memory
         with self._lock:
-            if bid in self._on_disk:  # not removed while reading
+            # cache only if THIS path is still the registered file (a
+            # concurrent re-put may have replaced the spill file)
+            if self._on_disk.get(bid) == path:
                 self._read_cache = (bid, data)
         return data
 

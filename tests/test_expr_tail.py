@@ -1,0 +1,210 @@
+"""Round-3 expression tail: digests (md5/sha1/sha2/crc32), xxhash64,
+hive hash, split, regexp_extract_all, arrays_zip, stack. Differential
+device-vs-CPU plus python-library oracles."""
+
+import hashlib
+import zlib
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr import (ArraysZip, Crc32, HiveHash, Md5,
+                                   RegExpExtractAll, Sha1, Sha2,
+                                   StringSplit, XxHash64, col, lit)
+from spark_rapids_tpu.plugin import TpuSession
+
+from test_queries import assert_same
+
+STRS = ["", "abc", "hello world", "ünïcødé", "a" * 55, "b" * 56,
+        "c" * 64, None, "The quick brown fox jumps over the lazy dog",
+        "x" * 200]
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession({"spark.rapids.sql.enabled": True,
+                       "spark.rapids.sql.explain": "NONE"})
+
+
+@pytest.fixture(scope="module")
+def str_df(session):
+    t = pa.table({"s": pa.array(STRS),
+                  "i": pa.array(range(len(STRS)), type=pa.int64())})
+    return session.from_arrow(t)
+
+
+class TestDigests:
+    def test_md5_sha1_sha256(self, str_df):
+        q = str_df.select("i", m=Md5(col("s")), s1=Sha1(col("s")),
+                          s2=Sha2(col("s"), 256))
+        out = assert_same(q, sort_by=["i"]).sort_by([("i", "ascending")])
+        for r, s in zip(out.to_pylist(), STRS):
+            if s is None:
+                assert r["m"] is None and r["s1"] is None
+                continue
+            b = s.encode()
+            assert r["m"] == hashlib.md5(b).hexdigest()
+            assert r["s1"] == hashlib.sha1(b).hexdigest()
+            assert r["s2"] == hashlib.sha256(b).hexdigest()
+
+    def test_sha2_variants(self, str_df):
+        q = str_df.select("i", a=Sha2(col("s"), 224),
+                          z=Sha2(col("s"), 0), bad=Sha2(col("s"), 100))
+        out = assert_same(q, sort_by=["i"]).sort_by([("i", "ascending")])
+        for r, s in zip(out.to_pylist(), STRS):
+            if s is None:
+                continue
+            b = s.encode()
+            assert r["a"] == hashlib.sha224(b).hexdigest()
+            assert r["z"] == hashlib.sha256(b).hexdigest()  # 0 -> 256
+            assert r["bad"] is None
+
+    def test_sha2_512_cpu_fallback(self, str_df):
+        q = str_df.select("i", h=Sha2(col("s"), 512))
+        got = q.collect()  # device plan falls back cleanly
+        for r, s in zip(got.sort_by([("i", "ascending")]).to_pylist(),
+                        STRS):
+            if s is not None:
+                assert r["h"] == hashlib.sha512(s.encode()).hexdigest()
+
+    def test_crc32(self, str_df):
+        q = str_df.select("i", c=Crc32(col("s")))
+        out = assert_same(q, sort_by=["i"]).sort_by([("i", "ascending")])
+        for r, s in zip(out.to_pylist(), STRS):
+            if s is not None:
+                assert r["c"] == zlib.crc32(s.encode())
+
+
+class TestRowHashes:
+    def test_xxhash64_strings_known_vectors(self, session):
+        # canonical XXH64 with seed 0 via direct kernel use is validated
+        # in-module; here: engine-level chaining with Spark's seed 42
+        t = pa.table({"s": pa.array(["", "abc", None, "xyz" * 40]),
+                      "v": pa.array([1, 2, 3, None], type=pa.int64()),
+                      "i": pa.array(range(4), type=pa.int64())})
+        df = session.from_arrow(t)
+        q = df.select("i", h=XxHash64([col("s"), col("v")]),
+                      hs=XxHash64([col("s")]))
+        out = assert_same(q, sort_by=["i"]).sort_by([("i", "ascending")])
+        rows = out.to_pylist()
+        assert len({r["h"] for r in rows}) == 4  # all distinct
+        # null child leaves the running hash unchanged:
+        q2 = df.select("i", a=XxHash64([col("v")]))
+        o2 = assert_same(q2, sort_by=["i"]).sort_by([("i", "ascending")])
+        assert o2.to_pylist()[3]["a"] == 42  # both inputs null -> seed
+
+    def test_hive_hash(self, session):
+        t = pa.table({"s": pa.array(["abc", "", None]),
+                      "n": pa.array([123, -5, 7], type=pa.int32()),
+                      "l": pa.array([2 ** 40, 1, None], type=pa.int64()),
+                      "i": pa.array(range(3), type=pa.int64())})
+        df = session.from_arrow(t)
+        q = df.select("i", h=HiveHash([col("s"), col("n"), col("l")]),
+                      hs=HiveHash([col("s")]))
+        out = assert_same(q, sort_by=["i"]).sort_by([("i", "ascending")])
+        rows = out.to_pylist()
+
+        def java_str_hash(s):
+            h = 0
+            for ch in s.encode():
+                h = (h * 31 + (ch if ch < 128 else ch - 256)) & 0xFFFFFFFF
+            return h - (1 << 32) if h >= (1 << 31) else h
+
+        assert rows[0]["hs"] == java_str_hash("abc")
+        assert rows[1]["hs"] == 0
+        lv = 2 ** 40
+        want0 = ((java_str_hash("abc") * 31 + 123) * 31 +
+                 ((lv ^ (lv >> 32)) & 0xFFFFFFFF))
+        want0 &= 0xFFFFFFFF
+        if want0 >= 1 << 31:
+            want0 -= 1 << 32
+        assert rows[0]["h"] == want0
+
+
+class TestSplitAndZip:
+    def test_split_basic(self, session):
+        vals = ["a,b,c", "", None, ",", "x,,y,", "nosep"]
+        t = pa.table({"s": pa.array(vals),
+                      "i": pa.array(range(len(vals)), type=pa.int64())})
+        df = session.from_arrow(t)
+        q = df.select("i", p=StringSplit(col("s"), ","))
+        out = assert_same(q, sort_by=["i"]).sort_by([("i", "ascending")])
+        got = out.column("p").to_pylist()
+        assert got[0] == ["a", "b", "c"]
+        assert got[1] == [""]
+        assert got[2] is None
+        assert got[3] == ["", ""]
+        assert got[4] == ["x", "", "y", ""]  # limit -1 keeps trailing ""
+        assert got[5] == ["nosep"]
+
+    def test_split_limits(self, session):
+        t = pa.table({"s": pa.array(["a:b:c:d", "q:", "z"]),
+                      "i": pa.array(range(3), type=pa.int64())})
+        df = session.from_arrow(t)
+        q = df.select("i", two=StringSplit(col("s"), ":", 2),
+                      zero=StringSplit(col("s"), ":", 0))
+        out = assert_same(q, sort_by=["i"]).sort_by([("i", "ascending")])
+        rows = out.to_pylist()
+        assert rows[0]["two"] == ["a", "b:c:d"]  # remainder in last part
+        assert rows[1]["two"] == ["q", ""]
+        assert rows[0]["zero"] == ["a", "b", "c", "d"]
+        assert rows[1]["zero"] == ["q"]  # limit 0 drops trailing empty
+
+    def test_split_regex_falls_back(self, session):
+        t = pa.table({"s": pa.array(["a1b22c333d"])})
+        df = session.from_arrow(t).select(p=StringSplit(col("s"), r"\d+"))
+        got = df.collect()  # planner tags it off; host regex answers
+        assert got.column("p").to_pylist() == [["a", "b", "c", "d"]]
+
+    def test_regexp_extract_all(self, session):
+        t = pa.table({"s": pa.array(["a1b22c333", "none", None]),
+                      "i": pa.array(range(3), type=pa.int64())})
+        df = session.from_arrow(t)
+        q = df.select("i", m=RegExpExtractAll(col("s"), r"(\d+)", 1))
+        out = q.collect().sort_by([("i", "ascending")])
+        got = out.column("m").to_pylist()
+        assert got[0] == ["1", "22", "333"]
+        assert got[1] == []
+        assert got[2] is None
+
+    def test_arrays_zip(self, session):
+        la = [[1, 2, 3], [5], None]
+        ra = [["x", "y"], ["p", "q"], ["z"]]
+        t = pa.table({"a": pa.array(la, pa.list_(pa.int64())),
+                      "b": pa.array(ra, pa.list_(pa.string())),
+                      "i": pa.array(range(3), type=pa.int64())})
+        df = session.from_arrow(t)
+        q = df.select("i", z=ArraysZip([col("a"), col("b")],
+                                       names=["a", "b"]))
+        out = assert_same(q, sort_by=["i"]).sort_by([("i", "ascending")])
+        got = out.column("z").to_pylist()
+        assert got[0] == [{"a": 1, "b": "x"}, {"a": 2, "b": "y"},
+                          {"a": 3, "b": None}]
+        assert got[1] == [{"a": 5, "b": "p"}, {"a": None, "b": "q"}]
+        assert got[2] is None
+
+
+class TestStack:
+    def test_stack_basic(self, session):
+        t = pa.table({"a": pa.array([1, 2], type=pa.int64()),
+                      "b": pa.array([10, 20], type=pa.int64()),
+                      "c": pa.array([100, 200], type=pa.int64())})
+        df = session.from_arrow(t)
+        q = df.stack(3, col("a"), col("b"), col("c"))
+        out = assert_same(q, sort_by=["col0"])
+        vals = sorted(out.column("col0").to_pylist())
+        assert vals == [1, 2, 10, 20, 100, 200] or \
+            vals == sorted([1, 10, 100, 2, 20, 200])
+
+    def test_stack_two_cols_with_padding(self, session):
+        t = pa.table({"a": pa.array([7], type=pa.int64())})
+        df = session.from_arrow(t)
+        # stack(2, 1,2,3): rows (1,2), (3,NULL)
+        q = df.stack(2, lit(1, T.LONG), lit(2, T.LONG), lit(3, T.LONG))
+        out = assert_same(q, sort_by=["col0"]).sort_by(
+            [("col0", "ascending")])
+        rows = out.to_pylist()
+        assert [(r["col0"], r["col1"]) for r in rows] == \
+            [(1, 2), (3, None)]
