@@ -103,6 +103,14 @@ class StringSplit(Expression):
         n = sv.data.shape[0]
         rx = re.compile(self.pattern)
         limit = self.limit
+
+        def drop_groups(parts):
+            # re.split interleaves captured groups at positions
+            # 1..groups, groups+2..: Java/Spark split never emits them
+            if rx.groups:
+                return parts[:: rx.groups + 1]
+            return parts
+
         rows: List[List[str]] = []
         for i in range(n):
             if not bool(sv.validity[i]):
@@ -111,9 +119,9 @@ class StringSplit(Expression):
             s = bytes(np.asarray(
                 sv.data[i, :int(sv.lengths[i])])).decode("utf-8", "replace")
             if limit > 0:
-                parts = rx.split(s, maxsplit=limit - 1)
+                parts = drop_groups(rx.split(s, maxsplit=limit - 1))
             else:
-                parts = rx.split(s)
+                parts = drop_groups(rx.split(s))
                 if limit == 0:
                     while parts and parts[-1] == "":
                         parts.pop()

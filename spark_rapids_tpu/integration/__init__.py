@@ -1,0 +1,1 @@
+from .spark_plan import translate_spark_plan  # noqa: F401

@@ -1,0 +1,338 @@
+"""Spark physical-plan adapter — the integration seam with a REAL Spark
+session (reference: the plugin intercepts executed plans inside the JVM,
+`Plugin.scala:222`, `GpuOverrides.scala:4239-4266`).
+
+This engine is standalone, so the seam is serialized plans: Spark's
+`df.queryExecution.executedPlan.toJSON` (TreeNode.toJSON — a stable,
+versioned facility of Catalyst: pre-order node array, each node carrying
+`class`, `num-children`, and its fields, with expression trees nested in
+the same shape) translates into `plan/nodes.py` and runs through the
+override rewrite like any native plan.
+
+HONEST GAP: this image has no pyspark/JVM, so there is no live Py4J or
+Spark Connect listener here — the adapter is exercised against committed
+toJSON fixtures (tests/fixtures/spark_plans/) whose shape follows the
+TreeNode.toJSON contract. Wiring it to a live session is a transport
+concern (ship the JSON over any channel); the translation below is the
+load-bearing part.
+
+Supported nodes: FileSourceScanExec (parquet), ProjectExec, FilterExec,
+HashAggregateExec (partial/final pairs collapse into one engine
+aggregate), SortMergeJoin/ShuffledHashJoin/BroadcastHashJoinExec,
+SortExec, TakeOrderedAndProjectExec, *LimitExec, ShuffleExchangeExec /
+AdaptiveSparkPlan / WholeStageCodegen / InputAdapter / ReusedExchange
+(transparent). Unknown nodes raise UnsupportedSparkPlan with the class
+name, mirroring the reference's explain-style honesty."""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .. import types as T
+from ..plan import nodes as N
+
+__all__ = ["translate_spark_plan", "UnsupportedSparkPlan"]
+
+
+class UnsupportedSparkPlan(Exception):
+    pass
+
+
+def _cls(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+# ---------------------------------------------------------------------------
+# tree reconstruction: toJSON is a PRE-ORDER array with num-children links
+# ---------------------------------------------------------------------------
+
+class _Node:
+    __slots__ = ("cls", "fields", "children")
+
+    def __init__(self, cls: str, fields: dict):
+        self.cls = cls
+        self.fields = fields
+        self.children: List["_Node"] = []
+
+
+def _build_tree(arr: List[dict]) -> _Node:
+    pos = [0]
+
+    def rec() -> _Node:
+        raw = arr[pos[0]]
+        pos[0] += 1
+        node = _Node(_cls(raw["class"]), raw)
+        for _ in range(int(raw.get("num-children", 0))):
+            node.children.append(rec())
+        return node
+
+    root = rec()
+    return root
+
+
+def _expr_tree(v) -> Optional[_Node]:
+    """Expression fields hold a nested toJSON array (often wrapped in an
+    extra list level)."""
+    if v is None:
+        return None
+    if isinstance(v, list):
+        if not v:
+            return None
+        if isinstance(v[0], dict):
+            return _build_tree(v)
+        return _expr_tree(v[0])
+    return None
+
+
+def _expr_list(v) -> List[_Node]:
+    """A field holding a LIST of expression trees."""
+    if not isinstance(v, list):
+        return []
+    out = []
+    for item in v:
+        t = _expr_tree(item if isinstance(item, list) else [item])
+        if t is not None:
+            out.append(t)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# type + expression translation
+# ---------------------------------------------------------------------------
+
+_TYPES = {
+    "boolean": T.BOOLEAN, "byte": T.BYTE, "short": T.SHORT,
+    "integer": T.INT, "long": T.LONG, "float": T.FLOAT,
+    "double": T.DOUBLE, "string": T.STRING, "date": T.DATE,
+    "timestamp": T.TIMESTAMP, "null": T.NULL,
+}
+
+_DEC_RE = re.compile(r"decimal\((\d+),(\d+)\)")
+
+
+def _data_type(s) -> T.DataType:
+    if isinstance(s, dict):  # struct/array/map json form — not needed yet
+        raise UnsupportedSparkPlan(f"nested dataType {s}")
+    m = _DEC_RE.match(str(s))
+    if m:
+        return T.DecimalType(int(m.group(1)), int(m.group(2)))
+    dt = _TYPES.get(str(s))
+    if dt is None:
+        raise UnsupportedSparkPlan(f"dataType {s}")
+    return dt
+
+
+def _literal_value(node: _Node):
+    v = node.fields.get("value")
+    dt = _data_type(node.fields.get("dataType"))
+    if v is None:  # JSON null IS the null literal; the STRING "null" is
+        return None, dt  # a genuine four-character payload
+    if isinstance(dt, (T.ByteType, T.ShortType, T.IntegerType, T.LongType)):
+        return int(v), dt
+    if isinstance(dt, (T.FloatType, T.DoubleType)):
+        return float(v), dt
+    if isinstance(dt, T.BooleanType):
+        return str(v).lower() == "true", dt
+    if isinstance(dt, T.DecimalType):
+        import decimal
+        return decimal.Decimal(str(v)), dt
+    return str(v), dt
+
+
+def _translate_expr(node: _Node):
+    from ..expr import base as EB
+    from ..expr import (arithmetic as EA, cast as EC, nullexprs as EN,
+                        predicates as EP)
+    c = node.cls
+    kids = node.children
+    if c == "AttributeReference":
+        return EB.AttributeReference(node.fields["name"],
+                                     _data_type(node.fields["dataType"]))
+    if c == "Literal":
+        v, dt = _literal_value(node)
+        return EB.Literal(v, dt)
+    if c == "Alias":
+        return EB.Alias(_translate_expr(kids[0]), node.fields["name"])
+    if c == "Cast":
+        return EC.Cast(_translate_expr(kids[0]),
+                       _data_type(node.fields["dataType"]))
+    binops = {"Add": EA.Add, "Subtract": EA.Subtract,
+              "Multiply": EA.Multiply, "Divide": EA.Divide,
+              "Remainder": EA.Remainder, "EqualTo": EP.EqualTo,
+              "LessThan": EP.LessThan, "LessThanOrEqual": EP.LessThanOrEqual,
+              "GreaterThan": EP.GreaterThan,
+              "GreaterThanOrEqual": EP.GreaterThanOrEqual,
+              "And": EP.And, "Or": EP.Or}
+    if c in binops:
+        return binops[c](_translate_expr(kids[0]), _translate_expr(kids[1]))
+    if c == "Not":
+        return EP.Not(_translate_expr(kids[0]))
+    if c == "IsNotNull":
+        return EN.IsNotNull(_translate_expr(kids[0]))
+    if c == "IsNull":
+        return EN.IsNull(_translate_expr(kids[0]))
+    raise UnsupportedSparkPlan(f"expression {c}")
+
+
+def _translate_agg_fn(node: _Node):
+    """AggregateExpression(aggregateFunction=...) -> engine aggregate."""
+    from ..expr import aggregates as AG
+    if node.cls == "AggregateExpression":
+        fn = _expr_tree(node.fields.get("aggregateFunction"))
+        if fn is None and node.children:
+            fn = node.children[0]
+        return _translate_agg_fn(fn)
+    fns = {"Sum": AG.Sum, "Min": AG.Min, "Max": AG.Max,
+           "Average": AG.Average, "Count": AG.Count,
+           "First": AG.First, "Last": AG.Last}
+    if node.cls in fns:
+        return fns[node.cls](_translate_expr(node.children[0]))
+    raise UnsupportedSparkPlan(f"aggregate {node.cls}")
+
+
+# ---------------------------------------------------------------------------
+# plan translation
+# ---------------------------------------------------------------------------
+
+_TRANSPARENT = {"WholeStageCodegenExec", "InputAdapter",
+                "AdaptiveSparkPlanExec", "ReusedExchangeExec",
+                "ShuffleExchangeExec", "BroadcastExchangeExec",
+                "ColumnarToRowExec", "RowToColumnarExec",
+                "ShuffleQueryStageExec", "BroadcastQueryStageExec"}
+
+
+def _join_type(s: str) -> str:
+    s = str(s).strip().lower().replace("outer", "").strip()
+    return {"inner": "inner", "left": "left", "right": "right",
+            "full": "full", "leftsemi": "semi", "leftanti": "anti",
+            "cross": "cross"}.get(s.replace(" ", ""), s)
+
+
+def translate_spark_plan(plan_json, conf,
+                         path_overrides: Optional[Dict[str, Sequence[str]]]
+                         = None) -> N.PhysicalPlan:
+    """Spark executedPlan.toJSON (string or parsed list) -> engine plan.
+    `path_overrides` remaps relation identifiers/locations to local files
+    (a real deployment reads the scan's own `location` field)."""
+    arr = json.loads(plan_json) if isinstance(plan_json, str) else plan_json
+    root = _build_tree(arr)
+    return _translate(root, conf, path_overrides or {})
+
+
+def _translate(node: _Node, conf, paths: Dict[str, Sequence[str]]
+               ) -> N.PhysicalPlan:
+    c = node.cls
+    if c == "CollectLimitExec" and node.children:
+        # keep the limit semantics rather than skipping it
+        child = _translate(node.children[0], conf, paths)
+        return N.CpuLimitExec(int(node.fields.get("limit", 0)), child)
+    if c in _TRANSPARENT and node.children:
+        return _translate(node.children[0], conf, paths)
+    if c == "FileSourceScanExec":
+        return _scan(node, conf, paths)
+    if c == "ProjectExec":
+        child = _translate(node.children[0], conf, paths)
+        projs = [_translate_expr(e)
+                 for e in _expr_list(node.fields.get("projectList"))]
+        return N.CpuProjectExec(projs, child)
+    if c == "FilterExec":
+        child = _translate(node.children[0], conf, paths)
+        cond = _translate_expr(_expr_tree(node.fields.get("condition")))
+        return N.CpuFilterExec(cond, child)
+    if c == "HashAggregateExec":
+        return _aggregate(node, conf, paths)
+    if c in ("SortMergeJoinExec", "ShuffledHashJoinExec",
+             "BroadcastHashJoinExec"):
+        left = _translate(node.children[0], conf, paths)
+        right = _translate(node.children[1], conf, paths)
+        lk = [_translate_expr(e)
+              for e in _expr_list(node.fields.get("leftKeys"))]
+        rk = [_translate_expr(e)
+              for e in _expr_list(node.fields.get("rightKeys"))]
+        cond = _expr_tree(node.fields.get("condition"))
+        return N.CpuHashJoinExec(
+            left, right, lk, rk, _join_type(node.fields.get("joinType")),
+            condition=None if cond is None else _translate_expr(cond))
+    if c == "SortExec":
+        child = _translate(node.children[0], conf, paths)
+        orders = _sort_orders(node)
+        return N.CpuSortExec(orders, child)
+    if c == "TakeOrderedAndProjectExec":
+        child = _translate(node.children[0], conf, paths)
+        orders = _sort_orders(node)
+        limit = int(node.fields.get("limit", 0))
+        plan = N.CpuLimitExec(limit, N.CpuSortExec(orders, child))
+        projs = _expr_list(node.fields.get("projectList"))
+        if projs:
+            plan = N.CpuProjectExec([_translate_expr(e) for e in projs],
+                                    plan)
+        return plan
+    if c in ("LocalLimitExec", "GlobalLimitExec"):
+        child = _translate(node.children[0], conf, paths)
+        return N.CpuLimitExec(int(node.fields.get("limit", 0)), child)
+    raise UnsupportedSparkPlan(f"plan node {c}")
+
+
+def _sort_orders(node: _Node) -> List[Tuple[Any, bool, bool]]:
+    orders = []
+    for so in _expr_list(node.fields.get("sortOrder")):
+        # SortOrder(child, direction, nullOrdering)
+        e = _translate_expr(so.children[0])
+        asc = "Asc" in str(so.fields.get("direction", "Ascending"))
+        nf = "First" in str(so.fields.get("nullOrdering",
+                                          "NullsFirst" if asc
+                                          else "NullsLast"))
+        orders.append((e, asc, nf))
+    return orders
+
+
+def _scan(node: _Node, conf, paths: Dict[str, Sequence[str]]):
+    from ..io.parquet import parquet_scan_plan
+    f = node.fields
+    fmt = str(f.get("relation", f.get("fileFormat", "parquet"))).lower()
+    # output schema from the scan's output attribute list
+    columns = [e.fields["name"] for e in _expr_list(f.get("output"))
+               if e.cls == "AttributeReference"]
+    ident = f.get("tableIdentifier") or f.get("location") or "scan"
+    local = paths.get(str(ident)) or paths.get("*")
+    if local is None:
+        raise UnsupportedSparkPlan(
+            f"no local path mapping for relation {ident!r}")
+    if "parquet" not in fmt and "hadoopfsrelation" not in fmt:
+        raise UnsupportedSparkPlan(f"scan format {fmt}")
+    return parquet_scan_plan(list(local), conf, columns=columns or None)
+
+
+def _aggregate(node: _Node, conf, paths: Dict[str, Sequence[str]]):
+    """Partial/Final HashAggregate pairs collapse: the engine's aggregate
+    handles partial/final split itself (the exchange between them is
+    transparent here, like the override rewrite re-plans distribution)."""
+    f = node.fields
+    child_node = node.children[0]
+    # descend through the partial half + exchanges to the true input
+    probe = child_node
+    while probe.cls in _TRANSPARENT and probe.children:
+        probe = probe.children[0]
+    if probe.cls == "HashAggregateExec":
+        inner = probe
+        probe2 = inner.children[0]
+        child = _translate(probe2, conf, paths)
+    else:
+        child = _translate(child_node, conf, paths)
+    keys = [_translate_expr(e)
+            for e in _expr_list(f.get("groupingExpressions"))]
+    aggs = []
+    for i, ae in enumerate(_expr_list(f.get("aggregateExpressions"))):
+        fn = _translate_agg_fn(ae)
+        aggs.append(N.AggExpr(fn, f"agg{i}"))
+    # result names from resultExpressions' aliases when present
+    names = [e.fields.get("name") for e in
+             _expr_list(f.get("resultExpressions"))
+             if e.cls == "Alias"]
+    if len(names) == len(aggs):  # only an unambiguous 1:1 mapping renames
+        for i, nm in enumerate(names):
+            if nm:
+                aggs[i] = N.AggExpr(aggs[i].func, nm)
+    return N.CpuHashAggregateExec(keys, aggs, child)

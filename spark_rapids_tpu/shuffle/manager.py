@@ -61,6 +61,9 @@ class ShuffleBlockStore:
         self._budget = host_budget
         self._dir = spill_dir
         self._owns_dir = False  # created a temp dir we must clean up
+        self._gen = 0  # spill-file generation: every write gets a fresh
+        # path, so a path captured before a re-put can never alias the
+        # re-put's new file (read-cache ABA)
         self._lock = threading.Lock()
 
     def close(self) -> None:
@@ -87,9 +90,12 @@ class ShuffleBlockStore:
 
     def _disk_path(self, bid: BlockId) -> str:
         import os
+        with self._lock:
+            self._gen += 1
+            g = self._gen
         return os.path.join(
             self._ensure_dir(),
-            f"s{bid.shuffle_id}_m{bid.map_id}_r{bid.reduce_id}.blk")
+            f"s{bid.shuffle_id}_m{bid.map_id}_r{bid.reduce_id}_g{g}.blk")
 
     def put(self, bid: BlockId, data: bytes) -> None:
         import os
