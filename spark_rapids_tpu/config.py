@@ -221,6 +221,12 @@ register("spark.rapids.sql.format.parquet.multiThreadedRead.numThreads", "int", 
          "Global multi-file reader pool size (reference MultiFileReaderThreadPool).")
 register("spark.rapids.sql.format.parquet.multiThreadedRead.maxNumFilesParallel", "int",
          2147483647, "Max files fetched in parallel per task.")
+register("spark.rapids.sql.format.csv.deviceDecode.enabled", "bool", True,
+         "Parse unquoted CSV on device: host frames line boundaries, the "
+         "device gathers rows into the byte matrix, splits fields, and "
+         "types them through the device cast kernels "
+         "(GpuTextBasedPartitionReader analog). Quoted files and "
+         "unsupported shapes keep the host reader.")
 register("spark.rapids.sql.format.parquet.deviceDecode.enabled", "bool", True,
          "Decode PLAIN-encoded flat numeric parquet pages on device (RLE "
          "def-level expansion + byte bitcast); unsupported chunks fall back "

@@ -1,0 +1,180 @@
+"""Device-side CSV line parse — the text-format spike of the reference's
+GPU text decode (`GpuTextBasedPartitionReader.scala:1`,
+`GpuCSVScan.scala`: host frames lines, device parses fields and types).
+
+TPU shape, composed entirely from kernels the engine already has:
+
+  host (control plane): read the file bytes once; newline scan (a single
+  vectorized np.where) yields per-row start/length — the only row-wise
+  host work. Files containing the quote character fall back to the host
+  reader (quoted-field state machines are inherently sequential; the
+  reference restricts GPU CSV similarly).
+  device: the raw blob ships ONCE; a byte-matrix gather lifts rows into
+  [R, W] (the parquet string gather), the delimiter-position sort from
+  split() finds field boundaries, span extraction yields one string
+  column per field, and the engine's own device cast matrix types them
+  (Spark-grammar string->int/double/bool/date parsing) — so the typed
+  columns never exist row-wise on the host.
+
+Unsupported shapes (quotes, multi-byte separators, over-wide rows) raise
+DeviceDecodeUnsupported and the scan keeps the pyarrow host path."""
+
+from __future__ import annotations
+
+from typing import Iterator, Tuple
+
+import numpy as np
+
+from .. import types as T
+from ..columnar.padding import row_bucket, width_bucket
+from .parquet_device import DeviceDecodeUnsupported
+
+__all__ = ["device_decode_csv_file", "csv_device_supported"]
+
+
+def csv_device_supported(scan) -> bool:
+    sep = scan.options.get("sep", ",")
+    if len(sep) != 1 or ord(sep) > 127:
+        return False
+    if scan.options.get("schema") is None:
+        return False  # typed output needs a declared schema
+    for dt in scan.options["schema"].types:
+        if not isinstance(dt, (T.StringType, T.BooleanType, T.ByteType,
+                               T.ShortType, T.IntegerType, T.LongType,
+                               T.FloatType, T.DoubleType, T.DateType)):
+            return False
+    return True
+
+
+def device_decode_csv_file(scan, path: str
+                           ) -> Iterator[Tuple[object, int]]:
+    """Yield (device ColumnarBatch, nrows) for one file, parsing fields
+    and types on device. Raises DeviceDecodeUnsupported for shapes the
+    vectorized parser can't honor (caller keeps the host path)."""
+    import jax.numpy as jnp
+    from ..columnar.batch import ColumnarBatch
+    from ..columnar.column import Column
+    from ..config import get_default_conf
+    from ..expr.base import EvalContext, Vec
+    from ..expr.cast import Cast
+    from ..expr.maps import _extract_spans
+    from ..io.parquet_device import _gather_strings
+
+    schema = scan.options["schema"]
+    sep = np.uint8(ord(scan.options.get("sep", ",")))
+    quote = np.uint8(ord(scan.options.get("quote", '"')))
+    header = scan.options.get("header", True)
+
+    blob = np.fromfile(path, np.uint8)
+    if (blob == quote).any():
+        raise DeviceDecodeUnsupported("quoted CSV falls back to host")
+    # host newline scan: the single sequential-ish step, fully vectorized
+    nl = np.flatnonzero(blob == np.uint8(ord("\n")))
+    row_starts = np.concatenate(([0], nl + 1)).astype(np.int64)
+    row_ends = np.concatenate((nl, [blob.shape[0]])).astype(np.int64)
+    # strip \r BEFORE the empty filter so a blank CRLF line drops like
+    # the host reader's ignore_empty_lines (not a phantom all-null row)
+    if row_ends.size:
+        safe_e = np.maximum(row_ends - 1, 0)
+        cr = (blob[np.minimum(safe_e, blob.size - 1)]
+              == np.uint8(ord("\r"))) & (row_ends > row_starts)
+        row_ends = row_ends - cr.astype(np.int64)
+    keep = row_starts < row_ends  # empty lines + trailing-\n chunk
+    row_starts, row_ends = row_starts[keep], row_ends[keep]
+    if header and row_starts.size:
+        row_starts, row_ends = row_starts[1:], row_ends[1:]
+    total_rows = int(row_starts.size)
+    if total_rows == 0:
+        return
+    conf = get_default_conf()
+    chunk_rows = max(int(conf.get("spark.rapids.sql.batchSizeRows")), 1)
+    blob_dev = jnp.asarray(blob if blob.size else np.zeros(1, np.uint8))
+    for at in range(0, total_rows, chunk_rows):
+        yield _decode_rows(scan, schema, blob_dev, blob,
+                           row_starts[at:at + chunk_rows],
+                           row_ends[at:at + chunk_rows], sep)
+
+
+def _decode_rows(scan, schema, blob_dev, blob, row_starts, row_ends, sep):
+    import jax.numpy as jnp
+    from ..columnar.batch import ColumnarBatch
+    from ..columnar.column import Column
+    from ..config import get_default_conf
+    from ..expr.base import EvalContext, Vec
+    from ..expr.cast import Cast
+    from ..expr.maps import _extract_spans
+    from ..io.parquet_device import _gather_strings
+
+    nrows = int(row_starts.size)
+    lens = (row_ends - row_starts).astype(np.int32)
+    w = width_bucket(max(int(lens.max()), 1))
+    if w > get_default_conf().string_max_width:
+        raise DeviceDecodeUnsupported("row wider than the device layout")
+    cap = row_bucket(nrows)
+    starts_d = jnp.asarray(np.pad(row_starts, (0, cap - nrows)))
+    lens_d = jnp.asarray(np.pad(lens, (0, cap - nrows)))
+    defined = jnp.arange(cap) < nrows
+    rows_mx, row_lens = _gather_strings(blob_dev, starts_d, lens_d,
+                                        defined, w)
+
+    # field boundaries: delimiter-position sort per row (split() kernel)
+    ncols = len(schema.names)
+    k = width_bucket(ncols)
+    pos32 = jnp.arange(w, dtype=np.int32)[None, :]
+    live = pos32 < row_lens[:, None]
+    is_d = (rows_mx == sep) & live
+    big = np.int32(w + 1)
+    dpos = jnp.where(is_d, pos32, big)
+    dsorted = jnp.sort(dpos, axis=1)[:, :k]
+    if dsorted.shape[1] < k:
+        dsorted = jnp.pad(dsorted, ((0, 0), (0, k - dsorted.shape[1])),
+                          constant_values=big)
+    lens32 = row_lens[:, None].astype(np.int32)
+    ends = jnp.minimum(dsorted, lens32)
+    fstarts = jnp.concatenate(
+        [jnp.zeros((cap, 1), np.int32), dsorted[:, :k - 1] + 1], axis=1)
+    fstarts = jnp.minimum(fstarts, lens32)
+    nfields = is_d.sum(axis=1).astype(np.int32) + 1
+    field_live = (jnp.arange(k, dtype=np.int32)[None, :]
+                  < nfields[:, None]) & defined[:, None]
+    fields = _extract_spans(jnp, rows_mx, fstarts, ends, field_live)
+
+    # one string Vec per SELECTED schema column (pruned columns never
+    # pay the null-marker compare or the cast kernels)
+    null_markers = scan.options.get("null_values", ["", "null", "NULL"])
+    ctx = EvalContext(jnp, row_mask=defined)
+    out_schema = scan.output
+    selected = [list(schema.names).index(nm) for nm in out_schema.names]
+    cols = []
+    from ..expr.base import BoundReference
+
+    for ci in selected:
+        dt = schema.types[ci]
+        if ci >= k:
+            raise DeviceDecodeUnsupported("schema wider than field bucket")
+        sv = Vec(T.STRING, fields.data[:, ci], fields.validity[:, ci],
+                 fields.lengths[:, ci])
+        # null markers: empty always; literal markers byte-compare
+        is_null = jnp.zeros(cap, bool)
+        for mk in null_markers:
+            mb = mk.encode()
+            if len(mb) > sv.data.shape[1]:
+                continue
+            eq = sv.lengths == len(mb)
+            for j, byte in enumerate(mb):
+                eq = eq & (sv.data[:, j] == np.uint8(byte))
+            is_null = is_null | eq
+        validity = sv.validity & ~is_null
+        if isinstance(dt, T.StringType):
+            out = Vec(dt, sv.data, validity, sv.lengths)
+        else:
+            ref = BoundReference(0, T.STRING)
+            cast = Cast(ref, dt)
+            typed = cast.eval(ctx, [Vec(T.STRING, sv.data, validity,
+                                        sv.lengths)])
+            out = Vec(dt, typed.data, typed.validity & validity,
+                      typed.lengths)
+        cols.append(Column(out.dtype, out.data, out.validity, out.lengths))
+    batch = ColumnarBatch(out_schema, tuple(cols),
+                          jnp.asarray(nrows, jnp.int32))
+    return batch, nrows

@@ -199,10 +199,36 @@ class TpuFileScanExec(_TpuExec):
                     "spark.rapids.sql.format.parquet.deviceDecode.enabled"):
             yield from self._parquet_batches()
             return
+        if self.cpu_scan.format_name == "csv" and self.conf.get(
+                "spark.rapids.sql.format.csv.deviceDecode.enabled"):
+            from .csv_device import csv_device_supported
+            if csv_device_supported(self.cpu_scan):
+                yield from self._csv_device_batches()
+                return
         for t in self.cpu_scan.host_tables(self._effective_paths()):
             b = batch_from_arrow(t)
             self.num_output_rows.add(t.num_rows)
             yield self._count_output(b)
+
+    def _csv_device_batches(self):
+        """Device CSV parse with PER-FILE host fallback: every failure
+        mode raises before a file yields (its batch materializes at file
+        end), so a fallen-back file host-decodes exactly once and nothing
+        double-yields."""
+        from .csv_device import device_decode_csv_file
+        from .parquet_device import DeviceDecodeUnsupported
+        scan = self.cpu_scan
+        for path in scan.paths:
+            try:
+                batches = list(device_decode_csv_file(scan, path))
+            except (DeviceDecodeUnsupported, OSError):
+                for b, nrows in self._host_file_batches(path):
+                    self.num_output_rows.add(nrows)
+                    yield self._count_output(b)
+                continue
+            for b, nrows in batches:
+                self.num_output_rows.add(nrows)
+                yield self._count_output(b)
 
     def _host_file_batches(self, path: str):
         """Host decode of ONE file through FileBatchIterator so batchSizeRows
