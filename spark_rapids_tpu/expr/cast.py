@@ -437,7 +437,9 @@ def _parse_float_device(xp, c: Vec, first, last, any_c, dst):
     scale2 = pw[extra]
     m = mant.astype(np.float64)
     val = xp.where(dexp >= 0, m * scale, m / scale / scale2)
-    val = xp.where(dexp >= 0, xp.where(dexp > 308, xp.inf, val),
+    # overflow to inf only with a NONZERO mantissa ("0e999" is 0.0)
+    val = xp.where(dexp >= 0,
+                   xp.where((dexp > 308) & (mant > 0), xp.inf, val),
                    xp.where(dexp < -360, 0.0, val))
     val = xp.where(neg, -val, val)
     word = is_nan | is_inf

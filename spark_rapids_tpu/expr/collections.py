@@ -284,9 +284,9 @@ def _float_sort_bits(xp, data):
     wide = data.astype(np.float64)
     if xp is np:
         bits = np.ascontiguousarray(wide).view(np.int64)
-    else:
-        from jax import lax
-        bits = lax.bitcast_convert_type(wide, np.int64)
+    else:  # 64-bit bitcast does not lower on TPU (see hashing.py)
+        from .hashing import _double_bits
+        bits = _double_bits(xp, wide)
     return xp.where(bits >= 0, bits, bits ^ np.int64(0x7FFFFFFFFFFFFFFF))
 
 

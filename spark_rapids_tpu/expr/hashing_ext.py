@@ -611,8 +611,10 @@ def _hash_one_xxh(xp, v: Vec, seed):
         if xp is np:
             bits = np.ascontiguousarray(d.astype(np.float64)).view(np.uint64)
         else:
-            from jax import lax
-            bits = lax.bitcast_convert_type(d.astype(np.float64), np.uint64)
+            # 64-bit bitcast does not lower on the TPU x64 rewrite:
+            # reconstruct the IEEE fields arithmetically (hashing.py)
+            from .hashing import _double_bits
+            bits = _double_bits(xp, d.astype(np.float64)).astype(np.uint64)
         return _xxh64_u64(xp, bits, seed)
     raise NotImplementedError(f"xxhash64 over {dt}")
 
@@ -678,9 +680,9 @@ def _hive_hash_one(xp, v: Vec):
         d = xp.where(v.data == 0, xp.zeros((), v.data.dtype), v.data)
         if xp is np:
             bits = np.ascontiguousarray(d.astype(np.float64)).view(np.int64)
-        else:
-            from jax import lax
-            bits = lax.bitcast_convert_type(d.astype(np.float64), np.int64)
+        else:  # 64-bit bitcast does not lower on TPU (see hashing.py)
+            from .hashing import _double_bits
+            bits = _double_bits(xp, d.astype(np.float64))
         return (bits ^ ((bits.astype(np.uint64) >> np.uint64(32))
                         .astype(np.int64))).astype(np.int32)
     raise NotImplementedError(f"hive hash over {dt}")

@@ -96,9 +96,9 @@ def _key_planes(xp, keys: Vec) -> List:
         d = xp.where(d == 0, xp.zeros((), d.dtype), d)
         if xp is np:
             bits = np.ascontiguousarray(d.astype(np.float64)).view(np.int64)
-        else:
-            from jax import lax
-            bits = lax.bitcast_convert_type(d.astype(np.float64), np.int64)
+        else:  # 64-bit bitcast does not lower on TPU (see hashing.py)
+            from .hashing import _double_bits
+            bits = _double_bits(xp, d.astype(np.float64))
         return [bits]
     return [keys.data]
 

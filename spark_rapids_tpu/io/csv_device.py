@@ -17,7 +17,13 @@ TPU shape, composed entirely from kernels the engine already has:
   columns never exist row-wise on the host.
 
 Unsupported shapes (quotes, multi-byte separators, over-wide rows) raise
-DeviceDecodeUnsupported and the scan keeps the pyarrow host path."""
+DeviceDecodeUnsupported BEFORE the first batch yields, so the scan keeps
+the pyarrow host path per file and chunks stream one at a time.
+
+Ragged rows follow Spark's default PERMISSIVE semantics on device
+(missing trailing fields null, extra fields dropped); the pyarrow host
+fallback is stricter and errors on them — a documented divergence for
+malformed input only."""
 
 from __future__ import annotations
 
@@ -52,13 +58,7 @@ def device_decode_csv_file(scan, path: str
     and types on device. Raises DeviceDecodeUnsupported for shapes the
     vectorized parser can't honor (caller keeps the host path)."""
     import jax.numpy as jnp
-    from ..columnar.batch import ColumnarBatch
-    from ..columnar.column import Column
     from ..config import get_default_conf
-    from ..expr.base import EvalContext, Vec
-    from ..expr.cast import Cast
-    from ..expr.maps import _extract_spans
-    from ..io.parquet_device import _gather_strings
 
     schema = scan.options["schema"]
     sep = np.uint8(ord(scan.options.get("sep", ",")))
@@ -66,6 +66,8 @@ def device_decode_csv_file(scan, path: str
     header = scan.options.get("header", True)
 
     blob = np.fromfile(path, np.uint8)
+    if blob.size == 0:
+        return  # empty file: zero rows
     if (blob == quote).any():
         raise DeviceDecodeUnsupported("quoted CSV falls back to host")
     # host newline scan: the single sequential-ish step, fully vectorized
@@ -87,15 +89,20 @@ def device_decode_csv_file(scan, path: str
     if total_rows == 0:
         return
     conf = get_default_conf()
+    # EVERY fallback condition validates here, before the first yield, so
+    # the caller can stream chunks without materializing the whole file
+    max_len = int((row_ends - row_starts).max()) if total_rows else 1
+    if width_bucket(max(max_len, 1)) > conf.string_max_width:
+        raise DeviceDecodeUnsupported("row wider than the device layout")
     chunk_rows = max(int(conf.get("spark.rapids.sql.batchSizeRows")), 1)
-    blob_dev = jnp.asarray(blob if blob.size else np.zeros(1, np.uint8))
+    blob_dev = jnp.asarray(blob)
     for at in range(0, total_rows, chunk_rows):
-        yield _decode_rows(scan, schema, blob_dev, blob,
+        yield _decode_rows(scan, schema,
                            row_starts[at:at + chunk_rows],
-                           row_ends[at:at + chunk_rows], sep)
+                           row_ends[at:at + chunk_rows], blob_dev, sep)
 
 
-def _decode_rows(scan, schema, blob_dev, blob, row_starts, row_ends, sep):
+def _decode_rows(scan, schema, row_starts, row_ends, blob_dev, sep):
     import jax.numpy as jnp
     from ..columnar.batch import ColumnarBatch
     from ..columnar.column import Column
@@ -108,8 +115,6 @@ def _decode_rows(scan, schema, blob_dev, blob, row_starts, row_ends, sep):
     nrows = int(row_starts.size)
     lens = (row_ends - row_starts).astype(np.int32)
     w = width_bucket(max(int(lens.max()), 1))
-    if w > get_default_conf().string_max_width:
-        raise DeviceDecodeUnsupported("row wider than the device layout")
     cap = row_bucket(nrows)
     starts_d = jnp.asarray(np.pad(row_starts, (0, cap - nrows)))
     lens_d = jnp.asarray(np.pad(lens, (0, cap - nrows)))
@@ -150,8 +155,6 @@ def _decode_rows(scan, schema, blob_dev, blob, row_starts, row_ends, sep):
 
     for ci in selected:
         dt = schema.types[ci]
-        if ci >= k:
-            raise DeviceDecodeUnsupported("schema wider than field bucket")
         sv = Vec(T.STRING, fields.data[:, ci], fields.validity[:, ci],
                  fields.lengths[:, ci])
         # null markers: empty always; literal markers byte-compare

@@ -211,22 +211,28 @@ class TpuFileScanExec(_TpuExec):
             yield self._count_output(b)
 
     def _csv_device_batches(self):
-        """Device CSV parse with PER-FILE host fallback: every failure
-        mode raises before a file yields (its batch materializes at file
-        end), so a fallen-back file host-decodes exactly once and nothing
-        double-yields."""
+        """Device CSV parse with PER-FILE host fallback: every fallback
+        condition validates before the generator's FIRST yield, so pulling
+        one chunk decides the path and the rest stream one batch at a
+        time (no whole-file materialization, no double-yield)."""
         from .csv_device import device_decode_csv_file
         from .parquet_device import DeviceDecodeUnsupported
         scan = self.cpu_scan
         for path in scan.paths:
+            gen = device_decode_csv_file(scan, path)
             try:
-                batches = list(device_decode_csv_file(scan, path))
+                first = next(gen, None)
             except (DeviceDecodeUnsupported, OSError):
                 for b, nrows in self._host_file_batches(path):
                     self.num_output_rows.add(nrows)
                     yield self._count_output(b)
                 continue
-            for b, nrows in batches:
+            if first is None:
+                continue  # empty file
+            b, nrows = first
+            self.num_output_rows.add(nrows)
+            yield self._count_output(b)
+            for b, nrows in gen:
                 self.num_output_rows.add(nrows)
                 yield self._count_output(b)
 

@@ -424,3 +424,19 @@ class TestCsvDeviceDecode:
         # device (never a wrong magnitude)
         assert got[1] == 0.0
         assert got[2] == 0.001234567890123
+
+    def test_empty_file_and_zero_exponent(self, tmp_path):
+        from spark_rapids_tpu.plugin import TpuSession
+        from spark_rapids_tpu.columnar.batch import Schema
+        from spark_rapids_tpu import types as T
+        s = TpuSession({"spark.rapids.sql.enabled": True,
+                        "spark.rapids.sql.explain": "NONE"})
+        pe = self._write(tmp_path, "", name="empty.csv")
+        df = s.read_csv(pe, schema=self._schema(), header=False)
+        assert df.collect().num_rows == 0
+        pz = self._write(tmp_path, "0e999\n1e400\n", name="z.csv")
+        sch = Schema(("v",), (T.DOUBLE,))
+        got = s.read_csv(pz, schema=sch, header=False).collect()
+        vals = got.column("v").to_pylist()
+        assert vals[0] == 0.0          # zero mantissa never overflows
+        assert vals[1] == float("inf")
