@@ -264,6 +264,25 @@ class DeltaTable:
         return {"updated": n_matched if when_matched_update else 0,
                 "deleted": deleted, "inserted": inserted}
 
+    def optimize_zorder(self, columns, bits: int = 16) -> dict:
+        """OPTIMIZE ZORDER BY (reference `zorder/ZOrderRules.scala` +
+        delta's OptimizeTableCommand): rows re-cluster by the morton key
+        of the given columns (computed on the device engine) and the
+        snapshot rewrites in that order, so later scans of range-filtered
+        z columns touch fewer row groups (footer min/max tighten)."""
+        from .zorder import zorder_indices
+        columns = list(columns)  # consume a one-shot iterable ONCE
+        snap_v = self.version
+        t = self.read(snap_v)
+        missing = [c for c in columns if c not in t.schema.names]
+        if missing:
+            raise ValueError(f"zorder columns not in table: {missing}")
+        if t.num_rows:
+            order = zorder_indices(self.session, t, columns, bits)
+            t = t.take(order)
+        self._rewrite(t, op="OPTIMIZE", read_version=snap_v)
+        return {"rows": t.num_rows, "zorder_by": columns}
+
     # ------------------------------------------------------------- commit
     def _rewrite(self, table: pa.Table, op: str,
                  read_version: Optional[int] = None) -> None:

@@ -135,3 +135,61 @@ def test_unsupported_scalar_type_message():
     arr = pa.array([b"ab"], type=pa.binary())
     with pytest.raises(TypeError, match="binary"):
         from_arrow(arr)
+
+
+class TestStringRebucket:
+    def test_coalesce_narrows_width_after_filter(self):
+        """Round-3: one long string widens the whole column; after a
+        filter drops it, the coalesce point must narrow the byte matrix
+        back down (width-cliff healing)."""
+        import pyarrow as pa
+        from spark_rapids_tpu.exec.coalesce import (TpuCoalesceBatchesExec,
+                                                    RequireSingleBatch)
+        from spark_rapids_tpu.expr import col, lit
+        from spark_rapids_tpu.plan.overrides import Overrides
+        from spark_rapids_tpu.plugin import TpuSession
+        s = TpuSession({"spark.rapids.sql.enabled": True,
+                        "spark.rapids.sql.explain": "NONE"})
+        vals = ["short"] * 50 + ["w" * 3000] + ["tiny"] * 50
+        t = pa.table({"s": pa.array(vals),
+                      "i": pa.array(range(len(vals)), type=pa.int64())})
+        df = s.from_arrow(t).filter(col("i") != lit(50))
+        s.initialize_device()
+        result = Overrides(s.conf).apply(df.plan)
+        coal = TpuCoalesceBatchesExec(result, RequireSingleBatch(),
+                                      s.conf)
+        out = list(coal.execute())
+        assert len(out) == 1
+        scol = out[0].columns[out[0].schema.names.index("s")]
+        assert scol.data.shape[-1] <= 8  # narrowed from the 4096 bucket
+        # data survives intact
+        from spark_rapids_tpu.columnar.batch import batch_to_arrow
+        back = batch_to_arrow(out[0])
+        assert back.column("s").to_pylist() == \
+            [v for i, v in enumerate(vals) if i != 50]
+
+    def test_nested_string_width_rebucket(self):
+        """Strings inside arrays/structs heal too (slot-mask recursion)."""
+        import numpy as np
+        import pyarrow as pa
+        from spark_rapids_tpu.columnar.batch import batch_from_arrow, \
+            batch_to_arrow
+        from spark_rapids_tpu.exec.coalesce import rebucket_string_widths
+        arrs = [["short", "tiny"]] * 20
+        t = pa.table({"a": pa.array(arrs, pa.list_(pa.string()))})
+        b = batch_from_arrow(t)
+        # simulate a stale wide layout with garbage padding lengths
+        import jax.numpy as jnp
+        from spark_rapids_tpu.columnar.column import Column
+        col = b.columns[0]
+        elem = col.children[0]
+        wide = jnp.pad(elem.data, ((0, 0), (0, 0), (0, 2048 - 8)))
+        lens = elem.lengths.at[-1, -1].set(2000)  # padding garbage
+        elem2 = Column(elem.dtype, wide, elem.validity, lens)
+        from spark_rapids_tpu.columnar.batch import ColumnarBatch
+        b2 = ColumnarBatch(b.schema, (Column(col.dtype, col.data,
+                                             col.validity, None,
+                                             (elem2,)),), b.num_rows)
+        out = rebucket_string_widths(b2)
+        assert out.columns[0].children[0].data.shape[-1] <= 8
+        assert batch_to_arrow(out).column("a").to_pylist() == arrs
