@@ -264,24 +264,30 @@ class DeltaTable:
         return {"updated": n_matched if when_matched_update else 0,
                 "deleted": deleted, "inserted": inserted}
 
-    def optimize_zorder(self, columns, bits: int = 16) -> dict:
+    def optimize_zorder(self, columns, bits: int = 16,
+                        curve: str = "zorder") -> dict:
         """OPTIMIZE ZORDER BY (reference `zorder/ZOrderRules.scala` +
         delta's OptimizeTableCommand): rows re-cluster by the morton key
         of the given columns (computed on the device engine) and the
         snapshot rewrites in that order, so later scans of range-filtered
         z columns touch fewer row groups (footer min/max tighten)."""
-        from .zorder import zorder_indices
+        from .zorder import CURVES, zorder_indices
+        if curve not in CURVES:
+            raise ValueError(f"unknown clustering curve {curve!r} "
+                             f"(valid: {sorted(CURVES)})")
         columns = list(columns)  # consume a one-shot iterable ONCE
+        if not columns:
+            raise ValueError("OPTIMIZE ZORDER needs at least one column")
         snap_v = self.version
         t = self.read(snap_v)
         missing = [c for c in columns if c not in t.schema.names]
         if missing:
             raise ValueError(f"zorder columns not in table: {missing}")
         if t.num_rows:
-            order = zorder_indices(self.session, t, columns, bits)
+            order = zorder_indices(self.session, t, columns, bits, curve)
             t = t.take(order)
         self._rewrite(t, op="OPTIMIZE", read_version=snap_v)
-        return {"rows": t.num_rows, "zorder_by": columns}
+        return {"rows": t.num_rows, "zorder_by": columns, "curve": curve}
 
     # ------------------------------------------------------------- commit
     def _rewrite(self, table: pa.Table, op: str,

@@ -7,8 +7,9 @@ the ranks' bits interleave into one morton key, and the table sorts by
 it. Here the same three steps run on device: rank via double-argsort
 (ties keep file order — stable), bit interleave as a static unrolled
 shift/or loop (bits * ncols <= 63), and the engine's device sort orders
-the rewrite. Hilbert indexing (the reference's alternative curve) is not
-implemented yet — morton/z-order is what OPTIMIZE ZORDER defaults to."""
+the rewrite. HilbertLongIndex provides the reference's alternative curve
+(Skilling transform, validated against a scalar oracle + the unit-step
+property); morton/z-order stays the OPTIMIZE default."""
 
 from __future__ import annotations
 
@@ -19,7 +20,8 @@ import numpy as np
 from ... import types as T
 from ...expr.base import EvalContext, Expression, Vec
 
-__all__ = ["InterleaveBits", "zorder_indices"]
+__all__ = ["CURVES", "InterleaveBits", "HilbertLongIndex",
+           "zorder_indices"]
 
 
 class InterleaveBits(Expression):
@@ -31,7 +33,7 @@ class InterleaveBits(Expression):
     def __init__(self, children: Sequence[Expression], bits: int = 16):
         super().__init__(list(children))
         k = max(len(self.children), 1)
-        self.bits = min(int(bits), 63 // k)
+        self.bits = max(min(int(bits), 63 // k), 1)
 
     @property
     def data_type(self):
@@ -77,10 +79,67 @@ class InterleaveBits(Expression):
         return xp.clip(scaled, 0, (1 << self.bits) - 1)
 
 
+class HilbertLongIndex(InterleaveBits):
+    """hilbert_index(c1, ..., ck): the reference's alternative clustering
+    curve (`GpuHilbertLongIndex.scala:33`). Ranks normalize exactly like
+    InterleaveBits; the coordinates then map through Skilling's transpose
+    transform (vectorized — every branch is a masked select, loops are
+    static over bits) before interleaving, giving the locality-preserving
+    Hilbert order whose successive cells are always unit steps."""
+
+    def _compute(self, ctx: EvalContext, *cols: Vec) -> Vec:
+        xp = ctx.xp
+        n = cols[0].data.shape[0] if cols else 1
+        mask = ctx.row_mask
+        X = [self._rank(xp, v, mask, n) for v in cols]
+        k = len(X)
+        b = self.bits
+        M = np.int64(1 << (b - 1))
+        # Skilling: axes -> transpose (inverse undo)
+        Q = int(M)
+        while Q > 1:
+            P = np.int64(Q - 1)
+            for i in range(k):
+                cond = (X[i] & np.int64(Q)) != 0
+                if i == 0:  # swap with self is a no-op: invert or keep
+                    X[0] = xp.where(cond, X[0] ^ P, X[0])
+                    continue
+                t = (X[0] ^ X[i]) & P  # from the ORIGINAL pair
+                X0 = X[0]
+                X[0] = xp.where(cond, X0 ^ P, X0 ^ t)
+                X[i] = xp.where(cond, X[i], X[i] ^ t)
+            Q >>= 1
+        # Gray encode
+        for i in range(1, k):
+            X[i] = X[i] ^ X[i - 1]
+        t = xp.zeros(n, np.int64)
+        Q = int(M)
+        while Q > 1:
+            t = xp.where((X[k - 1] & np.int64(Q)) != 0,
+                         t ^ np.int64(Q - 1), t)
+            Q >>= 1
+        for i in range(k):
+            X[i] = X[i] ^ t
+        # transpose -> index: bit b of axis i lands at b*k + (k-1-i),
+        # axis 0 most significant within each bit plane
+        out = xp.zeros(n, np.int64)
+        for bit in range(b):
+            for i in range(k):
+                v = (X[i] >> np.int64(bit)) & np.int64(1)
+                out = out | (v << np.int64(bit * k + (k - 1 - i)))
+        return Vec(T.LONG, out, xp.ones(n, dtype=bool))
+
+
+# the single source of valid clustering curves (table.py validates
+# against these keys)
+CURVES = {"zorder": InterleaveBits, "hilbert": HilbertLongIndex}
+
+
 def zorder_indices(session, table, columns: Sequence[str],
-                   bits: int = 16) -> np.ndarray:
-    """Row ordering for OPTIMIZE ZORDER BY: morton keys computed on the
-    device engine, returned as a host permutation."""
+                   bits: int = 16, curve: str = "zorder") -> np.ndarray:
+    """Row ordering for OPTIMIZE ZORDER BY: morton ("zorder") or hilbert
+    curve keys computed on the device engine, returned as a host
+    permutation."""
     import jax.numpy as jnp
     from ...columnar.batch import batch_from_arrow
     from ...expr.base import BoundReference
@@ -90,7 +149,7 @@ def zorder_indices(session, table, columns: Sequence[str],
     for c in columns:
         i = names.index(c)
         refs.append(BoundReference(i, T.from_arrow(table.schema.types[i])))
-    expr = InterleaveBits(refs, bits=bits)
+    expr = CURVES[curve](refs, bits=bits)
     from ...exec.base import batch_vecs
     ctx = EvalContext(jnp, row_mask=batch.row_mask())
     z = expr.eval(ctx, batch_vecs(batch))

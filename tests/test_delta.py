@@ -289,3 +289,68 @@ class TestZOrder:
         order = np.argsort(zs)
         assert list(np.asarray(a.data)[order][:1]) == [0]
         assert len(set(zs)) == 4
+
+    def test_hilbert_curve_unit_steps_and_optimize(self, tmp_path):
+        """HilbertLongIndex (GpuHilbertLongIndex analog): exact Skilling
+        transform — over a full grid, successive curve positions are unit
+        steps in exactly one coordinate (the property morton lacks), and
+        OPTIMIZE accepts curve='hilbert'."""
+        import numpy as np
+        import jax.numpy as jnp
+        import pyarrow as pa
+        from spark_rapids_tpu import types as T
+        from spark_rapids_tpu.datasources.delta.table import DeltaTable
+        from spark_rapids_tpu.datasources.delta.zorder import \
+            HilbertLongIndex
+        from spark_rapids_tpu.expr.base import (BoundReference, EvalContext,
+                                                Vec)
+        from spark_rapids_tpu.plugin import TpuSession
+
+        class RawHilbert(HilbertLongIndex):
+            def _rank(self, xp, v, mask, n):
+                return v.data.astype(np.int64)
+
+        b = 3
+        g = np.arange(1 << b)
+        coords = np.stack(np.meshgrid(g, g, indexing="ij"),
+                          axis=-1).reshape(-1, 2)
+        n = coords.shape[0]
+        vecs = [Vec(T.LONG, jnp.asarray(coords[:, i].astype(np.int64)),
+                    jnp.ones(n, bool)) for i in range(2)]
+        e = RawHilbert([BoundReference(i, T.LONG) for i in range(2)],
+                       bits=b)
+        z = np.asarray(e.eval(EvalContext(jnp, row_mask=jnp.ones(n, bool)),
+                              vecs).data)
+        assert len(set(z.tolist())) == n  # bijection over the grid
+        pts = coords[np.argsort(z)]
+        steps = np.abs(np.diff(pts, axis=0)).sum(axis=1)
+        assert (steps == 1).all()  # the Hilbert property
+
+        s = TpuSession({"spark.rapids.sql.enabled": True,
+                        "spark.rapids.sql.explain": "NONE"})
+        rng = np.random.default_rng(7)
+        t = pa.table({"x": pa.array(rng.integers(0, 99, 500)
+                                    .astype(np.int64)),
+                      "y": pa.array(rng.integers(0, 99, 500)
+                                    .astype(np.int64))})
+        dt = DeltaTable.create(s, str(tmp_path / "h"), t)
+        out = dt.optimize_zorder(["x", "y"], curve="hilbert")
+        assert out["curve"] == "hilbert" and out["rows"] == 500
+        keys = [("x", "ascending"), ("y", "ascending")]
+        assert dt.read().sort_by(keys).equals(t.sort_by(keys))
+
+    def test_zorder_rejects_empty_and_bad_args(self, tmp_path):
+        import pyarrow as pa
+        import pytest as _pt
+        from spark_rapids_tpu.datasources.delta.table import DeltaTable
+        from spark_rapids_tpu.plugin import TpuSession
+        s = TpuSession({"spark.rapids.sql.explain": "NONE"})
+        t = pa.table({"x": pa.array(range(10), type=pa.int64())})
+        dt = DeltaTable.create(s, str(tmp_path / "e"), t)
+        with _pt.raises(ValueError, match="at least one column"):
+            dt.optimize_zorder([])
+        with _pt.raises(ValueError, match="unknown clustering curve"):
+            dt.optimize_zorder(["x"], curve="peano")
+        # bits floor: degenerate bits never crash, table survives intact
+        dt.optimize_zorder(["x"], bits=0, curve="hilbert")
+        assert dt.read().num_rows == 10
