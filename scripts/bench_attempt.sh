@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# Round-3 verdict item 1a: run bench.py, write the raw outcome as an
+# auditable attempt log, and COMMIT it, whether the tunnel is up or not.
+# A dead tunnel yields a spaced committed outage log instead of a silent
+# null at round end.
+# Usage: scripts/bench_attempt.sh [round-tag]   (default r04)
+set -u
+cd "$(dirname "$0")/.."
+TAG="${1:-r04}"
+TS="$(date -u +%Y%m%dT%H%M%SZ)"
+OUT="BENCH_local_${TAG}_${TS}.json"
+ERRF="$(mktemp)"
+trap 'rm -f "$ERRF"' EXIT
+START="$(date -u +%s)"
+# bench.py bounds itself: 2x35s probe on a dead tunnel, else <=3x300s attempts.
+STDOUT="$(timeout 1000 python bench.py 2>"$ERRF")"
+RC=$?
+END="$(date -u +%s)"
+STDERR_TAIL="$(tail -c 2000 "$ERRF" | tr '\n' ' ' | sed 's/"/\x27/g')"
+LINE="$(printf '%s\n' "$STDOUT" | grep '^{' | tail -n 1 || true)"
+if [ -z "$LINE" ]; then
+  LINE="{\"metric\": \"scan_join_agg_speedup_vs_cpu\", \"value\": null, \"error\": \"no JSON line (rc=$RC)\"}"
+fi
+python - "$OUT" "$TS" "$RC" "$((END-START))" "$STDERR_TAIL" <<'EOF' "$LINE"
+import json, sys
+out, ts, rc, dur, errtail = sys.argv[1:6]
+line = sys.argv[6]
+try:
+    payload = json.loads(line)
+except Exception as e:
+    payload = {"metric": "scan_join_agg_speedup_vs_cpu", "value": None,
+               "error": f"unparseable bench stdout: {e}", "raw": line[:2000]}
+payload["attempt"] = {"ts_utc": ts, "rc": int(rc), "wall_s": int(dur),
+                      "stderr_tail": errtail[-1500:]}
+with open(out, "w") as f:
+    json.dump(payload, f, indent=1)
+print(out)
+EOF
+# Commit the artifact so a workspace reset cannot lose the evidence trail.
+VALUE="$(python -c "import json,sys; print(json.load(open(sys.argv[1])).get('value'))" "$OUT" 2>/dev/null || echo '?')"
+git add "$OUT" >/dev/null 2>&1 && \
+  git commit -q -m "bench attempt ${TS}: value=${VALUE}
+
+No-Verification-Needed: perf-attempt artifact log" >/dev/null 2>&1 || true
