@@ -12,8 +12,9 @@ OUT="BENCH_local_${TAG}_${TS}.json"
 ERRF="$(mktemp)"
 trap 'rm -f "$ERRF"' EXIT
 START="$(date -u +%s)"
-# bench.py bounds itself: 2x35s probe on a dead tunnel, else <=3x300s attempts.
-STDOUT="$(timeout 1000 python bench.py 2>"$ERRF")"
+# bench.py bounds itself: 2x35s probes + <=3x300s attempts + backoff = ~990s
+# worst case; 1200 leaves the supervisor room to print its error JSON.
+STDOUT="$(timeout 1200 python bench.py 2>"$ERRF")"
 RC=$?
 END="$(date -u +%s)"
 STDERR_TAIL="$(tail -c 2000 "$ERRF" | tr '\n' ' ' | sed 's/"/\x27/g')"

@@ -273,6 +273,12 @@ register("spark.rapids.sql.topK.enabled", "bool", True,
          "Rewrite limit-over-sort into a top-k exec (per-batch k-select + "
          "running merge) instead of a full out-of-core sort "
          "(TakeOrderedAndProjectExec analog, GpuOverrides.scala:3705).")
+register("spark.rapids.sql.topK.threshold", "int", 10000,
+         "Largest LIMIT+OFFSET rewritten into the top-k exec (the "
+         "spark.sql.execution.topKSortFallbackThreshold analog). Above it "
+         "the planner keeps sort+limit: top-k holds an O(k) candidate "
+         "batch device-resident and re-sorts ~2k rows per input batch, "
+         "losing the out-of-core sort's spill behavior at large k.")
 register("spark.rapids.tpu.device.ordinal", "int", -1,
          "Which local TPU device to bind (-1 = first).", startup_only=True)
 register("spark.rapids.tpu.device.startupTimeoutSec", "double", 60.0,

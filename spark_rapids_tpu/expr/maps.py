@@ -104,22 +104,35 @@ def _key_planes(xp, keys: Vec) -> List:
 
 
 def _check_dup_keys(ctx: EvalContext, keys: Vec, counts, validity) -> None:
-    """Raise [DUPLICATED_MAP_KEY] where two live slots hold equal keys."""
+    """Raise [DUPLICATED_MAP_KEY] where two live slots hold equal keys.
+
+    Sort-based O(n*k log k): each row's key planes are lexsorted along the
+    slot axis (live slots FIRST among equal values, so a dead slot that
+    happens to hold an equal bit pattern can never separate two live
+    duplicates), then adjacent live pairs with all planes equal flag a
+    duplicate. No [n,k,k] pairwise tile exists on either engine, so there
+    is no device fanout cap and no memory cliff at large n*k (round-3
+    advisor finding: the old pairwise raised CpuFallbackRequired on the
+    host engine too, crashing legal wide-map queries mid-fallback)."""
     xp = ctx.xp
     k = keys.validity.shape[1]
-    if k > 256:
-        raise CpuFallbackRequired(
-            f"map dup-key check over fanout {k} exceeds the device "
-            "pairwise budget")
     planes = _key_planes(xp, keys)
     live = xp.arange(k)[None, :] < counts[:, None]
-    eq = None
+    # lexsort keys least->most significant: live-first tiebreak, then
+    # planes reversed so planes[0] is primary
+    order = xp.lexsort(
+        tuple([(~live).astype(np.int32)] + list(reversed(planes))), axis=-1)
+
+    def g(a):
+        return xp.take_along_axis(a, order, axis=1)
+
+    live_s = g(live)
+    eq_adj = None
     for p in planes:
-        e = p[:, :, None] == p[:, None, :]
-        eq = e if eq is None else (eq & e)
-    pair_live = live[:, :, None] & live[:, None, :]
-    upper = xp.asarray(np.triu(np.ones((k, k), dtype=bool), 1))
-    dup = (eq & pair_live & upper[None, :, :]).any(axis=(1, 2))
+        ps = g(p)
+        e = ps[:, 1:] == ps[:, :-1]
+        eq_adj = e if eq_adj is None else (eq_adj & e)
+    dup = (eq_adj & live_s[:, 1:] & live_s[:, :-1]).any(axis=1)
     ansi_raise(ctx, dup & validity, _DUP_KEY)
 
 

@@ -736,6 +736,21 @@ class RegExpReplace(Expression):
         return f"RegExpReplace({self.children[0]!r}, {self.pattern!r})"
 
 
+def check_group_index(pattern: str, idx: int) -> None:
+    """Spark's RegExpExtractBase.checkGroupIndex: an out-of-range group
+    index is an IllegalArgumentException, not an empty-string result
+    (reference `stringFunctions.scala` GpuRegExpExtract semantics)."""
+    import re
+    groups = re.compile(pattern).groups
+    if idx < 0:
+        raise ValueError(
+            "The specified group index cannot be less than zero")
+    if idx > groups:
+        raise ValueError(
+            f"Regex group count is {groups}, but the specified group "
+            f"index is {idx}")
+
+
 class RegExpExtract(Expression):
     """regexp_extract(str, pattern, idx) — CPU implementation (see
     RegExpReplace); returns '' when there is no match, like Spark."""
@@ -745,6 +760,7 @@ class RegExpExtract(Expression):
         super().__init__([child, pattern])
         self.pattern = _pattern_literal(pattern)
         self.idx = idx
+        check_group_index(self.pattern, self.idx)
 
     @property
     def data_type(self):

@@ -160,10 +160,11 @@ class RegExpExtractAll(Expression):
 
     def __init__(self, child: Expression, pattern, idx: int = 1):
         super().__init__([child])
-        from .regex import _pattern_literal
+        from .regex import _pattern_literal, check_group_index
         self.pattern = _pattern_literal(pattern) \
             if not isinstance(pattern, str) else pattern
         self.idx = int(idx)
+        check_group_index(self.pattern, self.idx)
 
     @property
     def data_type(self):

@@ -44,7 +44,15 @@ class DynamicKeyFilter:
                                   if isinstance(v, bytes) else v
                                   for v in values})
         else:
-            self.values = np.unique(np.asarray(values))
+            arr = np.asarray(values)
+            # defense-in-depth behind the planner's int/float/string key
+            # gate: a multi-dim array (decimal128 limbs) or non-numeric
+            # dtype cannot be compared against footer stats — stay
+            # not-ready and prune nothing rather than prune wrongly
+            if arr.ndim != 1 or arr.dtype.kind not in "iuf":
+                self.values = None
+                return
+            self.values = np.unique(arr)
 
     # -- overlap tests --------------------------------------------------------
     def _range_has_key(self, mn, mx) -> bool:

@@ -819,6 +819,7 @@ def _wire_dynamic_pruning(join, plan, conf) -> None:
         return
     from ..expr.base import AttributeReference
     from ..io.dynamic_pruning import DynamicKeyFilter
+    from .. import types as T
     for i, lk in enumerate(plan.left_keys):
         if not isinstance(lk, AttributeReference):
             continue
@@ -826,6 +827,15 @@ def _wire_dynamic_pruning(join, plan, conf) -> None:
         if res is None:
             continue
         scan, scan_col = res
+        # Only key types whose parquet footer min/max compare reliably in
+        # the value domain: int/float/string. Decimal (limb pairs),
+        # timestamp/date (logical-type units), and anything nested would
+        # need domain-aware stat decoding — wrong pruning DROPS ROWS, so
+        # the gate is an allowlist, not try/except on the cast path.
+        ci = scan.output.names.index(scan_col)
+        dt = scan.output.types[ci]
+        if not (T.is_integral(dt) or T.is_floating(dt) or dt == T.STRING):
+            continue
         filt = DynamicKeyFilter(scan_col)
         scan.dynamic_filters.append(filt)
         join.dpp_filters.append((join._rk_ix[i], filt))
@@ -853,7 +863,9 @@ def _c_limit(plan, children, conf):
     # GpuOverrides.scala:3705): per-batch k-select + running merge
     # replaces the full out-of-core sort
     if conf.get("spark.rapids.sql.topK.enabled") and \
-            isinstance(child, TpuSortExec) and not child.each_batch:
+            isinstance(child, TpuSortExec) and not child.each_batch and \
+            plan.limit + plan.offset <= \
+            conf.get("spark.rapids.sql.topK.threshold"):
         return TpuTopKExec(child.orders, plan.limit, child.child, conf,
                            plan.offset)
     return TpuLimitExec(plan.limit, children[0], plan.offset, conf)

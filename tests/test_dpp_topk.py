@@ -198,3 +198,58 @@ class TestTopK:
 
         assert not find(result)
         assert df.collect().num_rows == 5
+
+
+class TestAdviceR3Regressions:
+    def test_topk_threshold_keeps_sort_plan(self, tmp_path):
+        # advisor r3: unbounded k kept an O(k) candidate batch resident and
+        # lost the out-of-core sort's spill path; above the threshold the
+        # planner must keep sort+limit (topKSortFallbackThreshold analog)
+        s = TpuSession({"spark.rapids.sql.enabled": True,
+                        "spark.rapids.sql.explain": "NONE",
+                        "spark.rapids.sql.topK.threshold": 20})
+        rng = np.random.default_rng(5)
+        t = pa.table({"v": pa.array(rng.integers(0, 10**6, 300)),
+                      "i": pa.array(range(300), type=pa.int64())})
+        p = str(tmp_path / "thr.parquet")
+        pq.write_table(t, p, row_group_size=64)
+        s.initialize_device()
+        from spark_rapids_tpu.exec.sort import TpuSortExec, TpuTopKExec
+        from spark_rapids_tpu.plan.overrides import Overrides
+
+        def find(node, cls):
+            got = [node] if isinstance(node, cls) else []
+            for c in getattr(node, "children", []):
+                got.extend(find(c, cls))
+            return got
+
+        over = s.read_parquet(p).sort("v").limit(25)   # 25 > 20
+        plan = Overrides(s.conf).apply(over.plan)
+        assert not find(plan, TpuTopKExec)
+        assert find(plan, TpuSortExec)
+        assert over.collect().column("v").to_pylist() == \
+            over.collect_cpu().column("v").to_pylist()
+
+        under = s.read_parquet(p).sort("v").limit(15, offset=4)  # 19 <= 20
+        plan = Overrides(s.conf).apply(under.plan)
+        assert find(plan, TpuTopKExec)
+
+    def test_dpp_skips_timestamp_keys(self, session, tmp_path):
+        # advisor r3: footer stats for timestamp/date/decimal keys do not
+        # compare reliably in the value domain — the planner must not wire
+        # a filter for them (wrong pruning drops rows)
+        base = np.datetime64("2023-01-01T00:00:00", "us")
+        ts = base + np.arange(400).astype("timedelta64[s]")
+        t = pa.table({"k": pa.array(ts), "v": pa.array(np.arange(400.0))})
+        p = str(tmp_path / "ts.parquet")
+        pq.write_table(t, p)
+        fact = session.read_parquet(p)
+        dim = session.from_arrow(pa.table({
+            "k": pa.array(ts[:3]), "w": pa.array([1.0, 2.0, 3.0])}))
+        df = fact.join(dim, on="k", how="inner")
+        session.initialize_device()
+        from spark_rapids_tpu.plan.overrides import Overrides
+        result = Overrides(session.conf).apply(df.plan)
+        for scan in find_scans(result):
+            assert not scan.dynamic_filters
+        assert df.collect().num_rows == 3

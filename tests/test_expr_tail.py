@@ -208,3 +208,27 @@ class TestStack:
         rows = out.to_pylist()
         assert [(r["col0"], r["col1"]) for r in rows] == \
             [(1, 2), (3, None)]
+
+
+class TestGroupIndexValidation:
+    # advisor r3: Spark raises IllegalArgumentException for an out-of-range
+    # regex group index (RegExpExtractBase.checkGroupIndex); silently
+    # returning "" diverged from the parity contract
+    def test_extract_all_idx_too_large(self):
+        with pytest.raises(ValueError, match="group count is 1.*index is 2"):
+            RegExpExtractAll(col("s"), r"(\d+)", 2)
+
+    def test_extract_all_negative_idx(self):
+        with pytest.raises(ValueError, match="less than zero"):
+            RegExpExtractAll(col("s"), r"(\d+)", -1)
+
+    def test_extract_idx_too_large(self):
+        from spark_rapids_tpu.expr.regex import RegExpExtract
+        with pytest.raises(ValueError, match="group count is 0.*index is 1"):
+            RegExpExtract(col("s"), lit(r"\d+"), 1)
+
+    def test_zero_idx_whole_match_ok(self, session):
+        t = pa.table({"s": pa.array(["a1b22"])})
+        df = session.from_arrow(t)
+        q = df.select(m=RegExpExtractAll(col("s"), r"\d+", 0))
+        assert q.collect().column("m").to_pylist() == [["1", "22"]]

@@ -364,3 +364,28 @@ class TestReviewRegressions:
         assert rows[0]["sa"] == ["short", "x"]
         assert rows[1]["sa"] == ["a-much-longer-string", None]
         assert rows[0]["da"] == [D("1.5"), D("1.5")]
+
+
+class TestAdviceR3Regressions:
+    def test_wide_map_completes_on_host(self, session):
+        # advisor r3 (medium): the >256-fanout dup-check guard is a DEVICE
+        # budget; the host engine must complete the check itself or the
+        # CpuFallbackRequired it raises re-raises inside its own fallback
+        wide = ",".join(f"k{i}:{i}" for i in range(300))
+        t = pa.table({"s": pa.array([wide, "a:1"]),
+                      "i": pa.array(range(2), type=pa.int64())})
+        df = session.from_arrow(t)
+        q = df.select("i", m=StringToMap(col("s")))
+        for out in (q.collect(), q.collect_cpu()):
+            got = out.sort_by([("i", "ascending")]).column("m").to_pylist()
+            assert len(got[0]) == 300
+            assert got[1] == [("a", "1")]
+
+    def test_wide_map_duplicate_still_raises_on_host(self, session):
+        wide = ",".join(f"k{i}:{i}" for i in range(300)) + ",k7:dup"
+        t = pa.table({"s": pa.array([wide])})
+        df = session.from_arrow(t).select(m=StringToMap(col("s")))
+        with pytest.raises(AnsiViolation, match="DUPLICATED_MAP_KEY"):
+            df.collect_cpu()
+        with pytest.raises(AnsiViolation, match="DUPLICATED_MAP_KEY"):
+            df.collect()
