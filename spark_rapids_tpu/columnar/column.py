@@ -25,17 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import types as T
-from ..config import get_default_conf
-from ..errors import StringWidthExceeded
 from .padding import row_bucket, width_bucket
-
-
-def _checked_width(max_len: int) -> int:
-    w = width_bucket(max_len)
-    limit = get_default_conf().string_max_width
-    if w > limit:
-        raise StringWidthExceeded(max_len, limit)
-    return w
 
 __all__ = ["Column", "make_column", "from_numpy", "from_arrow", "to_arrow"]
 
@@ -65,6 +55,10 @@ class Column:
     validity: jnp.ndarray
     lengths: Optional[jnp.ndarray] = None
     children: Optional[Tuple["Column", ...]] = None
+    # long-string layout (columnar/strings.py): (blob uint8[B],
+    # tail_start int32[cap]). blob is row-UNALIGNED — structural row ops
+    # gather tail_start and pass the blob through untouched.
+    overflow: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None
 
     # -- pytree ---------------------------------------------------------------
     def tree_flatten(self):
@@ -74,15 +68,19 @@ class Column:
             leaves.append(self.lengths)
         kids = tuple(self.children) if self.children else ()
         leaves.extend(kids)
-        return tuple(leaves), (self.dtype, has_len, len(kids))
+        has_ovf = self.overflow is not None
+        if has_ovf:
+            leaves.extend(self.overflow)
+        return tuple(leaves), (self.dtype, has_len, len(kids), has_ovf)
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
-        dtype, has_len, nk = aux
+        dtype, has_len, nk, has_ovf = aux
         i = 3 if has_len else 2
         lengths = leaves[2] if has_len else None
         kids = tuple(leaves[i:i + nk]) if nk else None
-        return cls(dtype, leaves[0], leaves[1], lengths, kids)
+        ovf = (leaves[i + nk], leaves[i + nk + 1]) if has_ovf else None
+        return cls(dtype, leaves[0], leaves[1], lengths, kids, ovf)
 
     # -- shape ----------------------------------------------------------------
     @property
@@ -102,6 +100,8 @@ class Column:
         n = self.data.size * self.data.dtype.itemsize + self.validity.size
         if self.lengths is not None:
             n += self.lengths.size * 4
+        if self.overflow is not None:
+            n += self.overflow[0].size + self.overflow[1].size * 4
         for c in (self.children or ()):
             n += c.device_memory_size()
         return n
@@ -109,7 +109,7 @@ class Column:
     # -- construction helpers -------------------------------------------------
     def with_validity(self, validity: jnp.ndarray) -> "Column":
         return Column(self.dtype, self.data, validity, self.lengths,
-                      self.children)
+                      self.children, self.overflow)
 
     def repadded(self, new_cap: int) -> "Column":
         """Grow/shrink capacity (host-side op; used by coalesce/re-bucketing)."""
@@ -126,7 +126,9 @@ class Column:
         return Column(self.dtype, fit(self.data), fit(self.validity),
                       None if self.lengths is None else fit(self.lengths),
                       None if self.children is None else tuple(
-                          c.repadded(new_cap) for c in self.children))
+                          c.repadded(new_cap) for c in self.children),
+                      None if self.overflow is None else
+                      (self.overflow[0], fit(self.overflow[1])))
 
     # -- host boundary --------------------------------------------------------
     def to_numpy(self, num_rows: int) -> Tuple[np.ndarray, np.ndarray]:
@@ -134,11 +136,14 @@ class Column:
         columns return an object array of Python str."""
         valid = np.asarray(self.validity[:num_rows])
         if self.is_string:
-            chars = np.asarray(self.data[:num_rows])
-            lens = np.asarray(self.lengths[:num_rows])
+            from .strings import flatten_live_bytes
+            flat, lens = flatten_live_bytes(self.data, self.lengths,
+                                            self.overflow, valid, num_rows)
+            offs = np.concatenate(([0], np.cumsum(lens, dtype=np.int64)))
             out = np.empty(num_rows, dtype=object)
+            buf = flat.tobytes()
             for i in range(num_rows):
-                out[i] = bytes(chars[i, :lens[i]]).decode("utf-8", "replace") \
+                out[i] = buf[offs[i]:offs[i + 1]].decode("utf-8", "replace") \
                     if valid[i] else None
             return out, valid
         return np.asarray(self.data[:num_rows]), valid
@@ -172,12 +177,15 @@ def from_numpy(dtype: T.DataType, values: np.ndarray,
             b = v.encode("utf-8") if isinstance(v, str) else (v or b"")
             enc.append(b)
             lens[i] = len(b)
-        w = _checked_width(int(lens.max()) if n else 1)
-        chars = np.zeros((cap, w), dtype=np.uint8)
-        for i, b in enumerate(enc):
-            chars[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
-        return Column(dtype, jnp.asarray(chars), jnp.asarray(valid),
-                      jnp.asarray(_pad_to(lens, cap))), n
+        from .strings import build_string_leaves
+        databuf = np.frombuffer(b"".join(enc), np.uint8) if enc else \
+            np.zeros(0, np.uint8)
+        offsets = np.concatenate(([0], np.cumsum(lens, dtype=np.int64)))
+        head, lens_p, ovf = build_string_leaves(databuf, offsets, lens, cap)
+        return Column(dtype, jnp.asarray(head), jnp.asarray(valid),
+                      jnp.asarray(lens_p), None,
+                      None if ovf is None else
+                      (jnp.asarray(ovf[0]), jnp.asarray(ovf[1]))), n
 
     npdt = dtype.np_dtype
     if npdt is None:
@@ -208,28 +216,39 @@ def from_arrow(arr, capacity: Optional[int] = None) -> Tuple[Column, int]:
         lens_raw = np.diff(offsets).astype(np.int32)
         # null slots may carry garbage lengths in theory; normalize to 0
         lens = np.where(valid, lens_raw, 0).astype(np.int32)
-        w = _checked_width(int(lens.max()) if n and lens.size else 1)
-        from ..native import runtime as _native
-        chars = np.zeros((cap, w), dtype=np.uint8)
-        # native path requires every raw slot (incl. nulls) to fit the width
-        native = _native.offsets_to_matrix(databuf, offsets, w, out=chars) \
-            if n and _native.available() and int(lens_raw.max()) <= w \
-            else None
-        if native is not None:
-            if not valid.all():  # nulls are sparse: zero just those rows
-                chars[:n][~valid] = 0
-        else:
-            if n:
-                row_id = np.repeat(np.arange(n), lens)
-                if row_id.size:
-                    out_starts = np.concatenate(([0], np.cumsum(lens)[:-1]))
-                    within = np.arange(row_id.size) - np.repeat(out_starts,
-                                                                lens)
-                    src = np.repeat(offsets[:-1], lens) + within
-                    chars[row_id, within] = databuf[src]
-        return Column(dtype, jnp.asarray(chars),
+        from .strings import build_string_leaves, head_width
+        mx = int(lens.max()) if n and lens.size else 0
+        if mx <= head_width():
+            w = width_bucket(max(mx, 1))
+            from ..native import runtime as _native
+            chars = np.zeros((cap, w), dtype=np.uint8)
+            # native path requires every raw slot (incl. nulls) to fit
+            native = _native.offsets_to_matrix(databuf, offsets, w,
+                                               out=chars) \
+                if n and _native.available() and int(lens_raw.max()) <= w \
+                else None
+            if native is not None:
+                if not valid.all():  # nulls are sparse: zero just those rows
+                    chars[:n][~valid] = 0
+            else:
+                if n:
+                    row_id = np.repeat(np.arange(n), lens)
+                    if row_id.size:
+                        out_starts = np.concatenate(([0],
+                                                     np.cumsum(lens)[:-1]))
+                        within = np.arange(row_id.size) - np.repeat(
+                            out_starts, lens)
+                        src = np.repeat(offsets[:-1], lens) + within
+                        chars[row_id, within] = databuf[src]
+            return Column(dtype, jnp.asarray(chars),
+                          jnp.asarray(_pad_to(valid, cap)),
+                          jnp.asarray(_pad_to(lens, cap))), n
+        # long strings: chunked head+blob layout, no cap x width blow-up
+        head, lens_p, ovf = build_string_leaves(databuf, offsets, lens, cap)
+        return Column(dtype, jnp.asarray(head),
                       jnp.asarray(_pad_to(valid, cap)),
-                      jnp.asarray(_pad_to(lens, cap))), n
+                      jnp.asarray(lens_p), None,
+                      (jnp.asarray(ovf[0]), jnp.asarray(ovf[1]))), n
 
     if isinstance(dtype, T.DecimalType) and \
             dtype.precision > T.DecimalType.MAX_LONG_DIGITS:
@@ -293,15 +312,10 @@ def to_arrow(col: Column, num_rows: int):
     valid = np.asarray(col.validity[:num_rows])
     mask = ~valid
     if col.is_string:
-        chars = np.asarray(col.data[:num_rows])
-        lens = np.asarray(col.lengths[:num_rows]).astype(np.int64)
-        lens = np.where(valid, lens, 0)
-        w = chars.shape[1] if chars.ndim == 2 else 0
-        if num_rows and w:
-            keep = np.arange(w)[None, :] < lens[:, None]
-            flat = chars[keep]
-        else:
-            flat = np.zeros(0, np.uint8)
+        from .strings import flatten_live_bytes
+        flat, lens32 = flatten_live_bytes(col.data, col.lengths,
+                                          col.overflow, valid, num_rows)
+        lens = lens32.astype(np.int64)
         offsets = np.concatenate(([0], np.cumsum(lens)))
         return pa.Array.from_buffers(
             pa.large_string(), num_rows,

@@ -1,0 +1,218 @@
+"""Chunked long-string device layout: head byte-matrix + shared tail blob.
+
+The fixed-width byte matrix (column.py) pays `cap x width` bytes where width
+is the bucket of the LONGEST value — one 8KB string widens every row's slot
+(the round-2/3 "width cliff"; the reference never has it because libcudf
+strings are offset+data, consumed throughout `stringFunctions.scala:1`).
+
+This module is the TPU-shaped offset+data equivalent:
+
+  head:       uint8[cap, W0]  — first W0 bytes of every row (W0 = the
+              `spark.rapids.tpu.string.headWidth` bucket, default 256).
+              Rectangular: every existing elementwise/VPU kernel shape.
+  blob:       uint8[B]        — tail bytes (beyond W0) of all rows,
+              concatenated in row order; B is a capacity bucket. The blob is
+              SHARED and append-only within a batch lineage.
+  tail_start: int32[cap]      — row-aligned offset of each row's tail in the
+              blob (undefined where lengths <= W0). Row-wise structural ops
+              (filter compact, join gather, sort reorder, slice) gather
+              tail_start exactly like any other row buffer and leave the
+              blob untouched — a row move is O(1) regardless of string size.
+  lengths:    int32[cap]      — FULL byte length (head + tail), same buffer
+              the flat layout uses.
+
+A column with `overflow=(blob, tail_start)` is a "long-string" column. Ops
+that only move rows work unchanged; ops that must see all bytes either
+assemble on host (CPU engine / host boundary) or raise CpuFallbackRequired
+(device engine, per-op fallback — the same discipline the scan paths use).
+The blob carries dead bytes after filters; `compact_tails` garbage-collects
+at coalesce points, and a batch whose live rows all fit the head width heals
+back to the plain flat layout (exec/coalesce.rebucket_string_widths).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..config import get_default_conf
+from .padding import width_bucket
+
+__all__ = ["head_width", "blob_bucket", "build_string_leaves",
+           "assemble_matrix", "compact_tails", "tails_from_matrix",
+           "flatten_live_bytes"]
+
+
+def head_width(conf=None) -> int:
+    conf = conf or get_default_conf()
+    return width_bucket(int(conf.get("spark.rapids.tpu.string.headWidth")))
+
+
+def blob_bucket(nbytes: int) -> int:
+    """Blob capacity bucket: 1KB chunks, power-of-two chunk counts — the
+    fixed-size-chunk allocation granularity of the layout."""
+    chunks = max(1, -(-nbytes // 1024))
+    p = 1
+    while p < chunks:
+        p *= 2
+    return p * 1024
+
+
+def build_string_leaves(
+        databuf: np.ndarray, offsets: np.ndarray, lens: np.ndarray,
+        cap: int, conf=None,
+) -> Tuple[np.ndarray, np.ndarray, Optional[Tuple[np.ndarray, np.ndarray]]]:
+    """Arrow-style (flat bytes, int64 offsets[n+1], int32 lens[n]) -> layout
+    leaves (head[cap, W], lengths[cap], overflow|None). Used by the host
+    boundary (from_arrow), the shuffle deserializer, and tests.
+
+    Short columns (max len <= head width) produce the plain flat layout
+    (overflow None) at the exact width bucket — byte-identical to the
+    historical behavior, so short strings pay nothing."""
+    n = len(lens)
+    mx = int(lens.max()) if n else 0
+    hw = head_width(conf)
+    w = width_bucket(max(mx, 1))
+    lens = np.ascontiguousarray(lens, dtype=np.int32)
+
+    def matrix(width, clamp):
+        chars = np.zeros((cap, width), dtype=np.uint8)
+        if n:
+            eff = np.minimum(lens, clamp) if clamp else lens
+            row_id = np.repeat(np.arange(n), eff)
+            if row_id.size:
+                starts = np.concatenate(([0], np.cumsum(eff)[:-1]))
+                within = np.arange(row_id.size) - np.repeat(starts, eff)
+                src = np.repeat(np.asarray(offsets[:-1], np.int64), eff) \
+                    + within
+                chars[row_id, within] = databuf[src]
+        return chars
+
+    if mx <= hw:
+        return matrix(w, None), _pad_rows(lens, cap), None
+
+    head = matrix(hw, hw)
+    tail_lens = np.maximum(lens - hw, 0).astype(np.int64)
+    total = int(tail_lens.sum())
+    blob = np.zeros(blob_bucket(total), np.uint8)
+    tail_start = np.zeros(cap, np.int32)
+    starts = np.concatenate(([0], np.cumsum(tail_lens)[:-1]))
+    tail_start[:n] = starts.astype(np.int32)
+    row_id = np.repeat(np.arange(n), tail_lens)
+    if row_id.size:
+        within = np.arange(row_id.size) - np.repeat(starts, tail_lens)
+        src = np.repeat(np.asarray(offsets[:-1], np.int64) + hw, tail_lens) \
+            + within
+        blob[np.repeat(starts, tail_lens) + within] = databuf[src]
+    return head, _pad_rows(lens, cap), (blob, tail_start)
+
+
+def _pad_rows(a: np.ndarray, cap: int) -> np.ndarray:
+    if a.shape[0] == cap:
+        return a
+    return np.pad(a, (0, cap - a.shape[0]))
+
+
+def assemble_matrix(head: np.ndarray, lengths: np.ndarray,
+                    overflow, num_rows: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side: (full byte matrix [num_rows, maxw], lengths[num_rows]).
+    The per-op fallback materialization — only ever called on host paths
+    (to_arrow / CPU assembly); device ops that need it fall back instead."""
+    head = np.asarray(head)[:num_rows]
+    lens = np.asarray(lengths)[:num_rows].astype(np.int32)
+    if overflow is None:
+        return head, lens
+    blob = np.asarray(overflow[0])
+    tail_start = np.asarray(overflow[1])[:num_rows].astype(np.int64)
+    hw = head.shape[1]
+    mx = int(lens.max()) if num_rows else 0
+    out = np.zeros((num_rows, max(mx, hw)), np.uint8)
+    out[:, :hw] = head
+    tail_lens = np.maximum(lens - hw, 0).astype(np.int64)
+    row_id = np.repeat(np.arange(num_rows), tail_lens)
+    if row_id.size:
+        starts = np.repeat(tail_start, tail_lens)
+        within = np.arange(row_id.size) - np.repeat(
+            np.concatenate(([0], np.cumsum(tail_lens)[:-1])), tail_lens)
+        out[row_id, hw + within] = blob[starts + within]
+    return out, lens
+
+
+def flatten_live_bytes(data, lengths, overflow, valid,
+                       num_rows: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side: exact concatenated live bytes + per-row lengths, with NO
+    dense [n, maxw] intermediate for overflow columns (head rows and blob
+    spans are scattered straight into the output). The one implementation
+    behind to_arrow, the shuffle varlen wire, and host transitions."""
+    n = num_rows
+    lens = np.asarray(lengths)[:n].astype(np.int32)
+    if valid is not None:
+        lens = np.where(np.asarray(valid)[:n], lens, 0)
+    head = np.asarray(data)[:n]
+    hw = head.shape[1] if head.ndim == 2 else 0
+    if overflow is None:
+        if not (n and hw):
+            return np.zeros(0, np.uint8), lens
+        keep = np.arange(hw)[None, :] < lens[:, None]
+        return head[keep], lens
+    blob = np.asarray(overflow[0])
+    tail_start = np.asarray(overflow[1])[:n].astype(np.int64)
+    head_lens = np.minimum(lens, hw).astype(np.int64)
+    tail_lens = (lens - head_lens).astype(np.int64)
+    out = np.zeros(int(lens.sum()), np.uint8)
+    out_off = np.concatenate(([0], np.cumsum(lens, dtype=np.int64)[:-1]))
+    hrow = np.repeat(np.arange(n), head_lens)
+    if hrow.size:
+        hstarts = np.concatenate(([0], np.cumsum(head_lens)[:-1]))
+        hwithin = np.arange(hrow.size) - np.repeat(hstarts, head_lens)
+        out[np.repeat(out_off, head_lens) + hwithin] = head[hrow, hwithin]
+    trow = np.repeat(np.arange(n), tail_lens)
+    if trow.size:
+        tstarts = np.concatenate(([0], np.cumsum(tail_lens)[:-1]))
+        twithin = np.arange(trow.size) - np.repeat(tstarts, tail_lens)
+        src = np.repeat(tail_start, tail_lens) + twithin
+        out[np.repeat(out_off + head_lens, tail_lens) + twithin] = blob[src]
+    return out, lens
+
+
+def compact_tails(lengths: np.ndarray, overflow, live: np.ndarray,
+                  hw: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Host-side blob GC: rebuild the blob holding only live rows' tails.
+    Returns new (blob, tail_start) with tail_start aligned to the SAME row
+    capacity. Caller decides when (coalesce points, serializer)."""
+    lens = np.asarray(lengths)
+    blob = np.asarray(overflow[0])
+    tail_start = np.asarray(overflow[1]).astype(np.int64)
+    cap = lens.shape[0]
+    tail_lens = np.where(np.asarray(live),
+                         np.maximum(lens.astype(np.int64) - hw, 0), 0)
+    total = int(tail_lens.sum())
+    new_blob = np.zeros(blob_bucket(total), np.uint8)
+    new_start = np.zeros(cap, np.int32)
+    starts = np.concatenate(([0], np.cumsum(tail_lens)[:-1]))
+    new_start[:] = starts.astype(np.int32)
+    row_id = np.repeat(np.arange(cap), tail_lens)
+    if row_id.size:
+        within = np.arange(row_id.size) - np.repeat(starts, tail_lens)
+        src = np.repeat(tail_start, tail_lens) + within
+        new_blob[np.repeat(starts, tail_lens) + within] = blob[src]
+    return new_blob, new_start
+
+
+def tails_from_matrix(data, w0: int):
+    """Jit-safe: convert a wide flat matrix [cap, W] (W > w0) into overflow
+    form WITHOUT host sync: head = data[:, :w0], blob = the rectangular tail
+    region flattened (each row's tail slot is (W - w0) wide, so tail_start
+    is a static stride — dead bytes beyond each row's true tail are padding
+    the blob GC reclaims later). Works under tracing (static shapes only).
+
+    Returns (head, blob, tail_start)."""
+    import jax.numpy as jnp
+    xp = jnp if not isinstance(data, np.ndarray) else np
+    cap, w = data.shape
+    stride = w - w0
+    head = data[:, :w0]
+    blob = data[:, w0:].reshape(cap * stride)
+    tail_start = (xp.arange(cap, dtype=np.int32) * np.int32(stride))
+    return head, blob, tail_start

@@ -279,6 +279,14 @@ register("spark.rapids.sql.topK.threshold", "int", 10000,
          "the planner keeps sort+limit: top-k holds an O(k) candidate "
          "batch device-resident and re-sorts ~2k rows per input batch, "
          "losing the out-of-core sort's spill behavior at large k.")
+register("spark.rapids.tpu.string.headWidth", "int", 256,
+         "Head width (bytes) of the chunked long-string device layout: "
+         "strings longer than this keep their first headWidth bytes in the "
+         "rectangular byte matrix and the rest in a shared tail blob with "
+         "per-row (offset) spans, so ONE long value no longer widens the "
+         "whole column to cap x width (the libcudf offset+data strings "
+         "analog). Byte-inspecting kernels on such columns fall back per "
+         "op; row-moving ops (filter/join/sort gathers) stay on device.")
 register("spark.rapids.tpu.device.ordinal", "int", -1,
          "Which local TPU device to bind (-1 = first).", startup_only=True)
 register("spark.rapids.tpu.device.startupTimeoutSec", "double", 60.0,

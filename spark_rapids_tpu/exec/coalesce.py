@@ -52,15 +52,59 @@ def _concat_padded(arrs: List) -> jnp.ndarray:
 def _concat_vec_group(vs: List[Vec]) -> Vec:
     """Concatenate the same column across batches, recursing children. Every
     buffer gets the padded concat: child validity/lengths share the fanout
-    dims of data, and fanout buckets can differ per batch."""
+    dims of data, and fanout buckets can differ per batch. String columns
+    where ANY input carries the long-string overflow layout concatenate in
+    overflow form (jit-safe: blob concat + static tail_start offsets)."""
     kids = None
     if vs[0].children is not None:
         kids = tuple(_concat_vec_group([v.children[i] for v in vs])
                      for i in range(len(vs[0].children)))
+    if any(v.overflow is not None for v in vs):
+        return _concat_overflow_strings(vs)
     return Vec(vs[0].dtype, _concat_padded([v.data for v in vs]),
                _concat_padded([v.validity for v in vs]),
                None if vs[0].lengths is None
                else _concat_padded([v.lengths for v in vs]), kids)
+
+
+def _concat_overflow_strings(vs: List[Vec]) -> Vec:
+    """Concat string columns in the head+blob layout (columnar/strings.py).
+    Inputs mix three shapes, all handled statically (traceable):
+      * overflow inputs: head [cap, hw_i], blob, tail_start;
+      * flat inputs with width <= target head width: no tail;
+      * flat inputs WIDER than the head (an expression built a wide
+        matrix): head = data[:, :hw], tail = the rectangular remainder
+        flattened (strided blob; dead bytes reclaimed by the coalesce GC).
+    tail_start offsets shift by the running blob size — static, so the
+    whole thing lives inside the concat kernel."""
+    from ..columnar.strings import tails_from_matrix
+
+    hw = max(v.data.shape[1] for v in vs if v.overflow is not None)
+    heads, lens, valids, starts, blobs = [], [], [], [], []
+    blob_off = 0
+    for v in vs:
+        cap = v.data.shape[0]
+        if v.overflow is not None:
+            h = v.data
+            if h.shape[1] < hw:
+                h = jnp.pad(h, [(0, 0), (0, hw - h.shape[1])])
+            blob, ts = v.overflow
+        elif v.data.shape[1] <= hw:
+            h = jnp.pad(v.data, [(0, 0), (0, hw - v.data.shape[1])])
+            blob = jnp.zeros(0, jnp.uint8)
+            ts = jnp.zeros(cap, jnp.int32)
+        else:
+            h, blob, ts = tails_from_matrix(v.data, hw)
+        heads.append(h)
+        valids.append(v.validity)
+        lens.append(v.lengths)
+        starts.append(ts.astype(jnp.int32) + np.int32(blob_off))
+        blobs.append(blob)
+        blob_off += int(blob.shape[0])
+    return Vec(vs[0].dtype, jnp.concatenate(heads),
+               jnp.concatenate(valids), jnp.concatenate(lens), None,
+               (jnp.concatenate(blobs) if blob_off else
+                jnp.zeros(0, jnp.uint8), jnp.concatenate(starts)))
 
 
 @functools.partial(jax.jit, static_argnums=(1,))
@@ -104,6 +148,46 @@ def rebucket_string_widths(batch: ColumnarBatch) -> ColumnarBatch:
     def shrink(col: Column, live) -> Column:
         data = col.data
         lengths = col.lengths
+        overflow = col.overflow
+        if overflow is not None:
+            # long-string healing/GC (one scalar sync, like the width
+            # re-bucketing below): if every live row now fits the head,
+            # drop the overflow entirely — the column returns to the plain
+            # flat layout and full device kernel coverage; otherwise
+            # garbage-collect dead tail bytes when the blob is less than
+            # half live (host repack: coalesce is the sanctioned
+            # host-sync point)
+            from ..columnar.strings import blob_bucket, compact_tails
+            hw = data.shape[-1]
+            eff = lengths if live is None else \
+                jnp.where(live, lengths, np.int32(0))
+            mx = int(jnp.max(eff)) if lengths.size else 0
+            if mx <= hw:
+                # heal to the plain flat layout, then narrow the head to
+                # the live max like any flat column
+                lengths = jnp.minimum(lengths, np.int32(hw))
+                new_w = width_bucket(max(mx, 1))
+                if new_w < hw:
+                    data = data[..., :new_w]
+                    lengths = jnp.minimum(lengths, np.int32(new_w))
+                return Column(col.dtype, data, col.validity, lengths,
+                              col.children, None)
+            else:
+                live_np = None if live is None else np.asarray(live)
+                eff_np = np.asarray(eff)
+                live_tail = int(np.maximum(
+                    eff_np.astype(np.int64) - hw, 0).sum())
+                if blob_bucket(live_tail) * 2 <= int(overflow[0].shape[0]):
+                    blob2, ts2 = compact_tails(
+                        eff_np, (np.asarray(overflow[0]),
+                                 np.asarray(overflow[1])),
+                        np.ones(eff_np.shape[0], bool) if live_np is None
+                        else live_np, hw)
+                    overflow = (jnp.asarray(blob2), jnp.asarray(ts2))
+            if (overflow is col.overflow and lengths is col.lengths):
+                return col
+            return Column(col.dtype, data, col.validity, lengths,
+                          col.children, overflow)
         if lengths is not None and data.ndim >= 2:
             eff = lengths if live is None else \
                 jnp.where(live, lengths, np.int32(0))

@@ -163,6 +163,14 @@ class TpuShuffleExchangeExec(UnaryTpuExec):
         ndev batches, one per device partition, empties included so downstream
         zipped execs stay positionally aligned. Slot overflow is detected ON
         DEVICE and retried with a doubled slot_cap — rows are never dropped."""
+        from ..errors import CpuFallbackRequired
+        for b in batches:
+            for c in b.columns:
+                if c.overflow is not None:
+                    # the collective moves row-aligned leaves; a shared
+                    # long-string blob is not row-sliceable across devices
+                    raise CpuFallbackRequired(
+                        "mesh exchange over a long-string overflow column")
         from jax.sharding import NamedSharding, PartitionSpec as P
         from ..columnar.column import Column
         from ..columnar.padding import row_bucket

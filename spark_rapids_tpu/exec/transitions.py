@@ -40,13 +40,25 @@ def host_batch_to_device(hb: HostBatch) -> ColumnarBatch:
         valid = np.zeros(cap, dtype=bool)
         valid[:n] = v.validity
         if v.is_string:
-            w = width_bucket(max(v.data.shape[1], 1))
-            data = np.zeros((cap, w), dtype=np.uint8)
-            data[:n, :v.data.shape[1]] = v.data
-            lens = np.zeros(cap, dtype=np.int32)
-            lens[:n] = v.lengths
-            cols.append(Column(v.dtype, jnp.asarray(data), jnp.asarray(valid),
-                               jnp.asarray(lens)))
+            from ..columnar.strings import build_string_leaves, head_width
+            if v.data.shape[1] <= head_width():
+                w = width_bucket(max(v.data.shape[1], 1))
+                data = np.zeros((cap, w), dtype=np.uint8)
+                data[:n, :v.data.shape[1]] = v.data
+                lens = np.zeros(cap, dtype=np.int32)
+                lens[:n] = v.lengths
+                cols.append(Column(v.dtype, jnp.asarray(data),
+                                   jnp.asarray(valid), jnp.asarray(lens)))
+                continue
+            # long strings ship in the head+blob layout, not cap x width
+            from ..columnar.strings import flatten_live_bytes
+            flat, l = flatten_live_bytes(v.data, v.lengths, None, None, n)
+            offsets = np.concatenate(([0], np.cumsum(l, dtype=np.int64)))
+            head, lens_p, ovf = build_string_leaves(flat, offsets, l, cap)
+            cols.append(Column(v.dtype, jnp.asarray(head),
+                               jnp.asarray(valid), jnp.asarray(lens_p), None,
+                               None if ovf is None else
+                               (jnp.asarray(ovf[0]), jnp.asarray(ovf[1]))))
         else:
             data = np.zeros(cap, dtype=v.data.dtype)
             data[:n] = v.data
@@ -65,8 +77,9 @@ def device_batch_to_host(b: ColumnarBatch) -> HostBatch:
             continue
         valid = np.asarray(c.validity[:n])
         if c.is_string:
-            vecs.append(Vec(c.dtype, np.asarray(c.data[:n]), valid,
-                            np.asarray(c.lengths[:n])))
+            from ..columnar.strings import assemble_matrix
+            mat, lens = assemble_matrix(c.data, c.lengths, c.overflow, n)
+            vecs.append(Vec(c.dtype, mat, valid, lens))
         else:
             vecs.append(Vec(c.dtype, np.asarray(c.data[:n]), valid))
     return HostBatch(b.schema, vecs, n)

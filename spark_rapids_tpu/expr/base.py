@@ -26,7 +26,7 @@ from ..columnar.column import Column
 
 __all__ = ["Vec", "EvalContext", "Expression", "LeafExpression", "Literal",
            "AttributeReference", "BoundReference", "Alias", "bind_references",
-           "all_valid", "and_validity"]
+           "all_valid", "and_validity", "require_flat_strings"]
 
 
 @jax.tree_util.register_pytree_node_class
@@ -49,6 +49,11 @@ class Vec:
     validity: Any
     lengths: Any = None
     children: Any = None  # tuple of child Vecs for nested types
+    # long-string layout (columnar/strings.py): (blob, tail_start). The
+    # blob is row-UNALIGNED: row-wise structural ops gather tail_start and
+    # pass the blob through; byte-inspecting kernels must go through
+    # require_flat_strings (per-op fallback).
+    overflow: Any = None
 
     def tree_flatten(self):
         leaves = [self.data, self.validity]
@@ -57,15 +62,19 @@ class Vec:
             leaves.append(self.lengths)
         kids = tuple(self.children) if self.children else ()
         leaves.extend(kids)
-        return tuple(leaves), (self.dtype, has_len, len(kids))
+        has_ovf = self.overflow is not None
+        if has_ovf:
+            leaves.extend(self.overflow)
+        return tuple(leaves), (self.dtype, has_len, len(kids), has_ovf)
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
-        dtype, has_len, nk = aux
+        dtype, has_len, nk, has_ovf = aux
         i = 3 if has_len else 2
         lengths = leaves[2] if has_len else None
         kids = tuple(leaves[i:i + nk]) if nk else None
-        return cls(dtype, leaves[0], leaves[1], lengths, kids)
+        ovf = (leaves[i + nk], leaves[i + nk + 1]) if has_ovf else None
+        return cls(dtype, leaves[0], leaves[1], lengths, kids, ovf)
 
     @property
     def is_string(self) -> bool:
@@ -79,7 +88,8 @@ class Vec:
     def from_column(col: Column) -> "Vec":
         kids = None if col.children is None else tuple(
             Vec.from_column(c) for c in col.children)
-        return Vec(col.dtype, col.data, col.validity, col.lengths, kids)
+        return Vec(col.dtype, col.data, col.validity, col.lengths, kids,
+                   col.overflow)
 
     def to_column(self) -> Column:
         import jax.numpy as jnp
@@ -88,31 +98,60 @@ class Vec:
         return Column(self.dtype, jnp.asarray(self.data),
                       jnp.asarray(self.validity),
                       None if self.lengths is None else jnp.asarray(self.lengths),
-                      kids)
+                      kids,
+                      None if self.overflow is None else
+                      (jnp.asarray(self.overflow[0]),
+                       jnp.asarray(self.overflow[1])))
 
     # -- uniform row-wise structural ops (recurse through children) ----------
     def gather(self, xp, idx) -> "Vec":
-        """Gather rows by index along axis 0, down the tree."""
+        """Gather rows by index along axis 0, down the tree. A long-string
+        blob is shared/row-unaligned: the row move gathers only the
+        tail_start pointers — O(1) per row regardless of string size."""
         return Vec(self.dtype, self.data[idx], self.validity[idx],
                    None if self.lengths is None else self.lengths[idx],
                    None if self.children is None else tuple(
-                       c.gather(xp, idx) for c in self.children))
+                       c.gather(xp, idx) for c in self.children),
+                   None if self.overflow is None else
+                   (self.overflow[0], self.overflow[1][idx]))
 
     def slice_rows(self, lo, hi) -> "Vec":
         """Slice rows [lo, hi) along axis 0, down the tree."""
         return Vec(self.dtype, self.data[lo:hi], self.validity[lo:hi],
                    None if self.lengths is None else self.lengths[lo:hi],
                    None if self.children is None else tuple(
-                       c.slice_rows(lo, hi) for c in self.children))
+                       c.slice_rows(lo, hi) for c in self.children),
+                   None if self.overflow is None else
+                   (self.overflow[0], self.overflow[1][lo:hi]))
 
 
-def vec_map_arrays(v: Vec, fn) -> Vec:
-    """Apply fn to every array buffer of a Vec, recursing through children.
-    fn must preserve the invariant that all buffers share the leading dim."""
+def vec_map_arrays(v: Vec, fn, blob_fn=None) -> Vec:
+    """Apply fn to every ROW-ALIGNED array buffer of a Vec, recursing through
+    children. fn must preserve the invariant that those buffers share the
+    leading dim. A long-string overflow blob is NOT row-aligned: it gets
+    blob_fn (default: passed through untouched); callers doing
+    backend/device conversion must supply blob_fn explicitly."""
     return Vec(v.dtype, fn(v.data), fn(v.validity),
                None if v.lengths is None else fn(v.lengths),
                None if v.children is None else tuple(
-                   vec_map_arrays(c, fn) for c in v.children))
+                   vec_map_arrays(c, fn, blob_fn) for c in v.children),
+               None if v.overflow is None else
+               ((blob_fn or (lambda a: a))(v.overflow[0]),
+                fn(v.overflow[1])))
+
+
+def require_flat_strings(v: Vec, op: str) -> Vec:
+    """Per-op gate for kernels that must see ALL string bytes: a long-string
+    column (overflow layout) cannot feed a byte-matrix kernel. Device
+    engines raise CpuFallbackRequired (the stage re-runs on the host, where
+    exact-length matrices exist) — the reference's per-op fallback
+    discipline applied to the strings layout."""
+    if v.overflow is None:
+        return v
+    from ..errors import CpuFallbackRequired
+    raise CpuFallbackRequired(
+        f"{op} needs full string bytes; column uses the long-string "
+        "overflow layout")
 
 
 def zero_vec(xp, dt: T.DataType, shape: tuple) -> Vec:
@@ -218,10 +257,19 @@ class Expression:
     deterministic = True
     # does this expression have side effects under ANSI (div-by-zero raise etc.)
     has_side_effects = False
+    # can this expression's kernel consume the long-string overflow layout
+    # (head+blob, columnar/strings.py)? Default False: byte-matrix kernels
+    # would silently truncate at the head width, so eval() gates them into
+    # the per-op fallback. Whitelist kernels that only read lengths/validity.
+    accepts_long_strings = False
 
     # --- evaluation -----------------------------------------------------------
     def eval(self, ctx: EvalContext, batch_vecs: Sequence[Vec]) -> Vec:
         child_results = [c.eval(ctx, batch_vecs) for c in self.children]
+        if not self.accepts_long_strings:
+            for v in child_results:
+                if isinstance(v, Vec) and v.overflow is not None:
+                    require_flat_strings(v, self.name)
         return self._compute(ctx, *child_results)
 
     def _compute(self, ctx: EvalContext, *children: Vec) -> Vec:

@@ -167,6 +167,10 @@ def _double_bits(xp, f):
 
 def hash_vecs(xp, vecs, seed: int = 42):
     """Row hash across columns: int32 result (Spark Murmur3Hash expression)."""
+    from .base import require_flat_strings
+    for v in vecs:
+        if getattr(v, "overflow", None) is not None:
+            require_flat_strings(v, "hash over string")
     n = vecs[0].validity.shape[0]
     h = xp.full((n,), np.uint32(seed), dtype=np.uint32)
     for v in vecs:

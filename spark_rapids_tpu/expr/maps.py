@@ -76,6 +76,8 @@ def _pair_eq(xp, a: Vec, b: Vec):
 def _key_planes(xp, keys: Vec) -> List:
     """[n, K] arrays whose joint slot-equality equals key equality — exact
     for fixed-width types, double-64-bit-hash for strings."""
+    from .base import require_flat_strings
+    require_flat_strings(keys, "map key equality")
     if keys.is_string:
         data = keys.data.astype(np.uint64)
         w = data.shape[2]

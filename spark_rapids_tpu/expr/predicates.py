@@ -23,7 +23,10 @@ __all__ = ["EqualTo", "EqualNullSafe", "LessThan", "LessThanOrEqual", "GreaterTh
 
 
 def string_equal(xp, a: Vec, b: Vec):
+    from .base import require_flat_strings
     from .strings import pad_common_width
+    require_flat_strings(a, "string equality")
+    require_flat_strings(b, "string equality")
     da, db = pad_common_width(xp, a, b)
     return xp.all(da == db, axis=1) & (a.lengths == b.lengths)
 
@@ -32,7 +35,10 @@ def string_compare(xp, a: Vec, b: Vec):
     """Return int array: -1/0/1 lexicographic byte comparison. Equal byte images
     (including zero padding) tie-break on length so strings with trailing NUL bytes
     still order after their prefix (UTF8String.compareTo semantics)."""
+    from .base import require_flat_strings
     from .strings import pad_common_width
+    require_flat_strings(a, "string comparison")
+    require_flat_strings(b, "string comparison")
     da, db = pad_common_width(xp, a, b)
     # first differing byte decides; zero-padded tails make prefix < extension
     lt = (da < db)

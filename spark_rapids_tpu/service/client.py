@@ -1,0 +1,99 @@
+"""Worker-process client for the device-owner service.
+
+Every call is deadline-bounded: a wedged service (the axon failure mode —
+accepts connections but never answers, or never comes up) surfaces as
+DeviceStartupError within `spark.rapids.tpu.device.startupTimeoutSec`
+instead of hanging the worker, reusing the round-3 fail-fast contract
+(`errors.py` DeviceStartupError; reference `Plugin.scala:436-459`)."""
+
+from __future__ import annotations
+
+import socket
+import time
+from typing import Dict, Optional, Sequence
+
+from ..errors import DeviceStartupError
+from .protocol import ipc_to_table, recv_msg, send_msg
+
+__all__ = ["TpuServiceClient"]
+
+
+class TpuServiceClient:
+    def __init__(self, socket_path: str, deadline_s: float = 60.0):
+        self.socket_path = socket_path
+        self.deadline_s = deadline_s
+        self._sock: Optional[socket.socket] = None
+
+    # ------------------------------------------------------------------
+    def connect(self, retry_interval: float = 0.05) -> "TpuServiceClient":
+        """Connect + liveness ping under the deadline."""
+        t0 = time.monotonic()
+        last = "never attempted"
+        while time.monotonic() - t0 < self.deadline_s:
+            try:
+                s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                s.settimeout(max(self.deadline_s -
+                                 (time.monotonic() - t0), 0.05))
+                s.connect(self.socket_path)
+                self._sock = s
+                rep = self._request({"op": "ping"})[0]
+                if rep.get("ok"):
+                    return self
+                last = f"ping not ok: {rep}"
+            except DeviceStartupError:
+                raise
+            except (OSError, ConnectionError) as e:
+                last = f"{type(e).__name__}: {e}"
+                self._sock = None
+                time.sleep(retry_interval)
+        raise DeviceStartupError(
+            f"device service at {self.socket_path} not answering within "
+            f"{self.deadline_s}s ({last})")
+
+    def close(self) -> None:
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+
+    def __enter__(self):
+        return self.connect()
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ------------------------------------------------------------------
+    def _request(self, header: dict, body: bytes = b""):
+        if self._sock is None:
+            raise DeviceStartupError("client not connected")
+        self._sock.settimeout(self.deadline_s)
+        try:
+            send_msg(self._sock, header, body)
+            return recv_msg(self._sock)
+        except socket.timeout:
+            raise DeviceStartupError(
+                f"device service did not answer {header.get('op')!r} "
+                f"within {self.deadline_s}s (wedged service)")
+
+    # ------------------------------------------------------------------
+    def acquire(self, timeout: Optional[float] = None) -> int:
+        """Block until admitted; returns the global admission order."""
+        rep, _ = self._request({"op": "acquire", "timeout": timeout})
+        if not rep.get("ok"):
+            raise TimeoutError(rep.get("error", "admission failed"))
+        return rep["order"]
+
+    def release(self) -> None:
+        self._request({"op": "release"})
+
+    def run_plan(self, plan_json, paths: Optional[Dict[str, Sequence[str]]]
+                 = None, use_device: bool = True):
+        """Submit a Spark executedPlan.toJSON; returns a pyarrow Table."""
+        rep, body = self._request({"op": "run_plan", "plan": plan_json,
+                                   "paths": paths or {},
+                                   "use_device": use_device})
+        if not rep.get("ok"):
+            raise RuntimeError(rep.get("unsupported") or rep.get("error"))
+        return ipc_to_table(body)
+
+    def shutdown(self) -> None:
+        self._request({"op": "shutdown"})

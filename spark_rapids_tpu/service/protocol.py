@@ -1,0 +1,68 @@
+"""Wire protocol for the device-owner service: length-framed JSON header +
+optional binary body (Arrow IPC stream) over a stream socket.
+
+Frame layout (little-endian):
+    u32 header_len | header (UTF-8 JSON) | u64 body_len | body bytes
+
+Kept deliberately dumb — the interesting contracts (admission FIFO, plan
+translation, Arrow batch ABI) live above it, and any transport that can
+move these two buffers (TCP, shared memory ring, Spark RPC) can replace
+the socket without touching either end's logic."""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Optional, Tuple
+
+_HDR = struct.Struct("<I")
+_BODY = struct.Struct("<Q")
+MAX_HEADER = 64 * 1024 * 1024
+MAX_BODY = 1 << 40
+
+
+def send_msg(sock: socket.socket, header: dict,
+             body: bytes = b"") -> None:
+    hb = json.dumps(header).encode("utf-8")
+    sock.sendall(_HDR.pack(len(hb)) + hb + _BODY.pack(len(body)))
+    if body:
+        sock.sendall(body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        c = sock.recv(min(n - got, 1 << 20))
+        if not c:
+            raise ConnectionError("peer closed mid-frame")
+        chunks.append(c)
+        got += len(c)
+    return b"".join(chunks)
+
+
+def recv_msg(sock: socket.socket) -> Tuple[dict, bytes]:
+    (hlen,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    if hlen > MAX_HEADER:
+        raise ConnectionError(f"header too large: {hlen}")
+    header = json.loads(_recv_exact(sock, hlen).decode("utf-8"))
+    (blen,) = _BODY.unpack(_recv_exact(sock, _BODY.size))
+    if blen > MAX_BODY:
+        raise ConnectionError(f"body too large: {blen}")
+    body = _recv_exact(sock, blen) if blen else b""
+    return header, body
+
+
+def table_to_ipc(table) -> bytes:
+    import pyarrow as pa
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, table.schema) as w:
+        w.write_table(table)
+    return sink.getvalue().to_pybytes()
+
+
+def ipc_to_table(buf: bytes):
+    import pyarrow as pa
+    with pa.ipc.open_stream(pa.BufferReader(buf)) as r:
+        return r.read_all()

@@ -1,0 +1,201 @@
+"""Device-owner service process (SURVEY §7: one-TPU-service-per-host).
+
+Owns the backend in ONE process and serves worker processes over a
+unix-domain socket:
+
+  ping      -> liveness + device identity (clients' fail-fast probe)
+  acquire   -> blocks until a cross-process admission token is granted
+               (FIFO; `spark.rapids.sql.concurrentGpuTasks` tokens — the
+               GpuSemaphore analog across process boundaries,
+               `GpuSemaphore.scala:67,125`); reply carries the global
+               admission sequence number so tests can assert ordering
+  release   -> returns the token (also implicit on disconnect, so a dead
+               worker can never leak admission capacity)
+  run_plan  -> Spark executedPlan.toJSON + path overrides, executed through
+               translate_spark_plan -> Overrides -> engine; result returns
+               as an Arrow IPC stream body. This op is the LIVE transport
+               seam: any external Spark can ship its executed plan here
+               with no code changes on this side.
+  shutdown  -> stop serving (tests; production uses process supervision)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import threading
+from typing import Dict, Optional
+
+from .protocol import ipc_to_table, recv_msg, send_msg, table_to_ipc
+
+__all__ = ["TpuDeviceService"]
+
+
+class _Admission:
+    """FIFO cross-process admission semaphore state (server side)."""
+
+    def __init__(self, tokens: int):
+        self.tokens = tokens
+        self.cv = threading.Condition()
+        self.queue = []          # ticket ids, FIFO
+        self.holders = set()     # ticket ids currently admitted
+        self.order = 0           # global admission sequence
+        self.next_ticket = 0
+
+    def acquire(self, timeout: Optional[float] = None) -> Optional[int]:
+        """Block until admitted; returns the admission sequence number."""
+        with self.cv:
+            me = self.next_ticket
+            self.next_ticket += 1
+            self.queue.append(me)
+            ok = self.cv.wait_for(
+                lambda: self.queue[0] == me and
+                len(self.holders) < self.tokens, timeout)
+            if not ok:
+                self.queue.remove(me)
+                self.cv.notify_all()  # unblock whoever is now at the head
+                return None
+            self.queue.pop(0)
+            self.holders.add(me)
+            self.order += 1
+            self.cv.notify_all()
+            return self.order
+
+    def release_one(self, count: int = 1) -> None:
+        with self.cv:
+            for _ in range(count):
+                if self.holders:
+                    self.holders.pop()
+            self.cv.notify_all()
+
+
+class TpuDeviceService:
+    def __init__(self, conf: Optional[Dict] = None,
+                 socket_path: str = "/tmp/spark_rapids_tpu.sock"):
+        from ..plugin import TpuSession
+        base = {"spark.rapids.sql.enabled": True,
+                "spark.rapids.sql.explain": "NONE"}
+        base.update(conf or {})
+        self.session = TpuSession(base)
+        self.socket_path = socket_path
+        self.admission = _Admission(self.session.conf.concurrent_tpu_tasks)
+        self._stop = threading.Event()
+        self._exec_lock = threading.Lock()
+        self._listener: Optional[socket.socket] = None
+
+    # ------------------------------------------------------------------
+    def serve_forever(self) -> None:
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        self.session.initialize_device()
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        srv.bind(self.socket_path)
+        srv.listen(64)
+        srv.settimeout(0.5)
+        self._listener = srv
+        try:
+            while not self._stop.is_set():
+                try:
+                    conn, _ = srv.accept()
+                except socket.timeout:
+                    continue
+                threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True).start()
+        finally:
+            srv.close()
+            if os.path.exists(self.socket_path):
+                os.unlink(self.socket_path)
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        held = 0
+        try:
+            while True:
+                try:
+                    header, body = recv_msg(conn)
+                except (ConnectionError, OSError):
+                    return
+                op = header.get("op")
+                if op == "ping":
+                    send_msg(conn, {"ok": True,
+                                    "device": self._device_name()})
+                elif op == "acquire":
+                    seq = self.admission.acquire(
+                        timeout=header.get("timeout"))
+                    if seq is None:
+                        send_msg(conn, {"ok": False,
+                                        "error": "admission timeout"})
+                    else:
+                        held += 1
+                        send_msg(conn, {"ok": True, "order": seq})
+                elif op == "release":
+                    if held:
+                        self.admission.release_one()
+                        held -= 1
+                    send_msg(conn, {"ok": True})
+                elif op == "run_plan":
+                    self._run_plan(conn, header)
+                elif op == "shutdown":
+                    send_msg(conn, {"ok": True})
+                    self._stop.set()
+                    return
+                else:
+                    send_msg(conn, {"ok": False,
+                                    "error": f"unknown op {op!r}"})
+        finally:
+            # a worker that dies holding tokens must not leak capacity
+            if held:
+                self.admission.release_one(held)
+            conn.close()
+
+    # ------------------------------------------------------------------
+    def _device_name(self) -> str:
+        try:
+            import jax
+            return str(jax.devices()[0])
+        except Exception as e:  # pragma: no cover
+            return f"<no device: {e}>"
+
+    def _run_plan(self, conn: socket.socket, header: dict) -> None:
+        from ..integration.spark_plan import (UnsupportedSparkPlan,
+                                              translate_spark_plan)
+        try:
+            plan = translate_spark_plan(header["plan"], self.session.conf,
+                                        header.get("paths") or {})
+            use_device = bool(header.get("use_device", True))
+            with self._exec_lock:
+                table = self.session.execute_plan(plan,
+                                                  use_device=use_device)
+            send_msg(conn, {"ok": True, "num_rows": table.num_rows},
+                     table_to_ipc(table))
+        except UnsupportedSparkPlan as e:
+            send_msg(conn, {"ok": False, "unsupported": str(e)})
+        except Exception as e:
+            send_msg(conn, {"ok": False,
+                            "error": f"{type(e).__name__}: {e}"})
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--socket", default="/tmp/spark_rapids_tpu.sock")
+    ap.add_argument("--conf", action="append", default=[],
+                    metavar="K=V")
+    ap.add_argument("--platform", default=None,
+                    help="force jax platform (tests: cpu)")
+    args = ap.parse_args(argv)
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+    conf = {}
+    for kv in args.conf:
+        k, _, v = kv.partition("=")
+        conf[k] = json.loads(v) if v and v[0] in "[{0123456789tf-" else v
+    svc = TpuDeviceService(conf, args.socket)
+    svc.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

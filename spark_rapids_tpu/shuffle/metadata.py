@@ -27,6 +27,11 @@ from .. import types as T
 
 MAGIC = b"SRTM"
 VERSION = 1
+# string_width sentinel: the column's string bytes are EXACT varlen
+# (lengths + concatenated bytes, no padding) instead of a padded matrix —
+# used for long-string overflow columns so the wire never carries the
+# cap x width blow-up
+VARLEN_WIDTH = 0xFFFFFFFF
 
 CODEC_IDS = {"none": 0, "zstd": 1, "lz4xla": 2}
 CODEC_NAMES = {v: k for k, v in CODEC_IDS.items()}
@@ -36,7 +41,7 @@ CODEC_NAMES = {v: k for k, v in CODEC_IDS.items()}
 class ColumnMeta:
     name: str
     dtype: T.DataType
-    string_width: int  # 0 for non-strings
+    string_width: int  # 0 for non-strings; VARLEN_WIDTH = varlen encoding
     data_len: int
     validity_len: int
     lens_len: int

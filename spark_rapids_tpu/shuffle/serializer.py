@@ -21,7 +21,8 @@ from ..columnar.batch import ColumnarBatch, Schema
 from ..columnar.column import Column
 from ..columnar.padding import row_bucket, width_bucket
 from .codec import get_codec
-from .metadata import ColumnMeta, TableMeta, decode_meta, encode_meta
+from .metadata import (VARLEN_WIDTH, ColumnMeta, TableMeta, decode_meta,
+                       encode_meta)
 
 
 @dataclasses.dataclass
@@ -42,8 +43,22 @@ def serialize_batch(batch: ColumnarBatch, codec_name: str = "none") -> bytes:
             raise NotImplementedError(
                 "nested columns are not yet supported by the host shuffle "
                 "serializer (the planner keeps nested data off exchanges)")
-        data = np.ascontiguousarray(np.asarray(col.data)[:n])
         valid = np.ascontiguousarray(np.asarray(col.validity)[:n])
+        if col.overflow is not None:
+            # long-string column: exact varlen on the wire (lengths +
+            # concatenated live bytes) — never the cap x width matrix,
+            # not even as a host intermediate
+            from ..columnar.strings import flatten_live_bytes
+            flat, lens = flatten_live_bytes(col.data, col.lengths,
+                                            col.overflow, valid, n)
+            db = flat.tobytes()
+            vb = np.packbits(valid, bitorder="little").tobytes()
+            lb = lens.tobytes()
+            cols.append(ColumnMeta(name, col.dtype, VARLEN_WIDTH, len(db),
+                                   len(vb), len(lb)))
+            parts.extend((db, vb, lb))
+            continue
+        data = np.ascontiguousarray(np.asarray(col.data)[:n])
         lens = None if col.lengths is None else \
             np.ascontiguousarray(np.asarray(col.lengths)[:n].astype(np.int32))
         db, vb = data.tobytes(), np.packbits(valid, bitorder="little").tobytes()
@@ -75,9 +90,14 @@ def deserialize_table(buf: bytes, offset: int = 0) -> Tuple[HostTable, int]:
         names.append(c.name)
         tps.append(c.dtype)
         if isinstance(c.dtype, T.StringType):
-            data = np.frombuffer(view, np.uint8, count=c.data_len,
-                                 offset=pos).reshape(n, c.string_width) \
-                if n else np.zeros((0, max(c.string_width, 1)), np.uint8)
+            if c.string_width == VARLEN_WIDTH:
+                # varlen: 1-D exact bytes; lens (below) frame the rows
+                data = np.frombuffer(view, np.uint8, count=c.data_len,
+                                     offset=pos)
+            else:
+                data = np.frombuffer(view, np.uint8, count=c.data_len,
+                                     offset=pos).reshape(n, c.string_width) \
+                    if n else np.zeros((0, max(c.string_width, 1)), np.uint8)
         else:
             npdt = c.dtype.np_dtype
             data = np.frombuffer(view, npdt, count=c.data_len // npdt.itemsize,
@@ -97,6 +117,39 @@ def deserialize_table(buf: bytes, offset: int = 0) -> Tuple[HostTable, int]:
     return HostTable(schema, arrays, n), head_len + meta.compressed_len
 
 
+def _concat_varlen_strings(dt, tables, i: int, cap: int) -> Column:
+    """Receive-side concat when any chunk used the varlen wire encoding:
+    unify every chunk to (flat bytes, lens), concatenate, and rebuild the
+    device layout — head+blob when long strings crossed the wire, plain
+    flat otherwise (columnar/strings.build_string_leaves decides)."""
+    import jax.numpy as jnp
+    from ..columnar.strings import build_string_leaves
+    flats, lens_all, valid_all = [], [], []
+    for t in tables:
+        d, v, l = t.arrays[i]
+        l = np.zeros(t.num_rows, np.int32) if l is None else \
+            np.asarray(l, np.int32)
+        if d.ndim == 2:  # matrix chunk -> live bytes
+            from ..columnar.strings import flatten_live_bytes
+            flat, l = flatten_live_bytes(d, l, None, None, t.num_rows)
+            flats.append(flat)
+        else:
+            flats.append(np.asarray(d))
+        lens_all.append(l)
+        valid_all.append(np.asarray(v, bool))
+    lens = np.concatenate(lens_all) if lens_all else np.zeros(0, np.int32)
+    databuf = np.concatenate(flats) if flats else np.zeros(0, np.uint8)
+    offsets = np.concatenate(([0], np.cumsum(lens, dtype=np.int64)))
+    head, lens_p, ovf = build_string_leaves(databuf, offsets, lens, cap)
+    valid = np.zeros(cap, bool)
+    vcat = np.concatenate(valid_all) if valid_all else np.zeros(0, bool)
+    valid[:vcat.shape[0]] = vcat
+    return Column(dt, jnp.asarray(head), jnp.asarray(valid),
+                  jnp.asarray(lens_p), None,
+                  None if ovf is None else
+                  (jnp.asarray(ovf[0]), jnp.asarray(ovf[1])))
+
+
 def concat_host_tables(tables: Sequence[HostTable]) -> ColumnarBatch:
     """Host-concat many decoded tables, then upload ONCE
     (GpuShuffleCoalesceExec / HostConcatResultUtil analog)."""
@@ -109,6 +162,11 @@ def concat_host_tables(tables: Sequence[HostTable]) -> ColumnarBatch:
     cols = []
     for i, dt in enumerate(schema.types):
         if isinstance(dt, T.StringType):
+            # varlen chunks (incl. zero-row ones) are 1-D; the matrix
+            # path below would index shape[1] on them
+            if any(t.arrays[i][0].ndim == 1 for t in tables):
+                cols.append(_concat_varlen_strings(dt, tables, i, cap))
+                continue
             w = width_bucket(max(max((t.arrays[i][0].shape[1]
                                       for t in tables), default=1), 1))
             data = np.zeros((cap, w), np.uint8)

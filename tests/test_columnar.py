@@ -48,9 +48,21 @@ def test_string_arrow_roundtrip():
     arr = pa.array(vals, type=pa.string())
     col, n = from_arrow(arr)
     assert col.is_string
-    assert col.string_width == 512  # 300 utf8 bytes -> bucket 512
+    # 300 utf8 bytes > headWidth(256): chunked layout — head stays at the
+    # head bucket, the tail rides the blob (no cap x 512 matrix)
+    assert col.string_width == 256
+    assert col.overflow is not None
     back = to_arrow(col, n)
     assert back.to_pylist() == vals
+
+
+def test_string_arrow_roundtrip_short_flat():
+    vals = ["hello", None, "", "wörld", "a" * 200, "x"]
+    arr = pa.array(vals, type=pa.string())
+    col, n = from_arrow(arr)
+    assert col.string_width == 256  # 200 utf8 bytes -> bucket 256, flat
+    assert col.overflow is None
+    assert to_arrow(col, n).to_pylist() == vals
 
 
 def test_batch_roundtrip():
@@ -112,12 +124,17 @@ def test_int64_nulls_precision():
     assert to_arrow(col, n).to_pylist() == [2**62 + 1, None, 5]
 
 
-def test_string_width_limit():
-    from spark_rapids_tpu.errors import StringWidthExceeded
+def test_string_beyond_old_width_limit_now_builds():
+    # the pre-round-4 layout raised StringWidthExceeded past maxWidth; the
+    # chunked layout has no construction cliff — the giant value lands in
+    # the tail blob and round-trips exactly
     from spark_rapids_tpu.config import get_default_conf
     limit = get_default_conf().string_max_width
-    with pytest.raises(StringWidthExceeded):
-        from_arrow(pa.array(["x" * (limit + 1)]))
+    vals = ["x" * (limit + 1), "small"]
+    col, n = from_arrow(pa.array(vals))
+    assert col.overflow is not None
+    assert col.string_width <= 256
+    assert to_arrow(col, n).to_pylist() == vals
 
 
 def test_wide_decimal_now_device_backed():

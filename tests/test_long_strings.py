@@ -1,0 +1,221 @@
+"""Chunked long-string device layout (columnar/strings.py): head byte-matrix
++ shared tail blob + row-aligned spans. The round-3 verdict's acceptance: a
+1MB string traverses scan -> filter -> join -> collect WITHOUT the cap x
+width blow-up or StringWidthExceeded, with a peak-bytes assertion
+(reference: libcudf offset+data strings, `stringFunctions.scala:1`)."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import from_arrow, to_arrow
+from spark_rapids_tpu.expr import Count, Length, Sum, col, lit
+from spark_rapids_tpu.plugin import TpuSession
+
+
+@pytest.fixture()
+def session():
+    return TpuSession({"spark.rapids.sql.enabled": True,
+                       "spark.rapids.sql.explain": "NONE"})
+
+
+BIG = "x" * (1 << 20) + "END"          # ~1MB
+MED = "m" * 5000                       # > head width, < 8KB
+
+
+def mixed_strings(n=40, big_at=(3, 17)):
+    vals = [f"short-{i}" for i in range(n)]
+    for i in big_at:
+        vals[i] = BIG
+    vals[7] = MED
+    vals[11] = None
+    return vals
+
+
+class TestLayout:
+    def test_roundtrip_exact(self):
+        arr = pa.array(mixed_strings())
+        col_, n = from_arrow(arr)
+        assert col_.overflow is not None
+        # head stays at the configured bucket, not the 1MB width
+        assert col_.data.shape[1] <= 256
+        back = to_arrow(col_, n)
+        assert back.to_pylist() == arr.to_pylist()
+
+    def test_short_columns_unchanged(self):
+        arr = pa.array(["a", "bb", None, "ccc"])
+        col_, n = from_arrow(arr)
+        assert col_.overflow is None  # plain flat layout, zero overhead
+        assert to_arrow(col_, n).to_pylist() == arr.to_pylist()
+
+    def test_peak_bytes_bounded(self):
+        vals = mixed_strings(n=1000)
+        raw = sum(len(v.encode()) for v in vals if v is not None)
+        col_, n = from_arrow(pa.array(vals))
+        # the flat layout would hold cap x 1MB-bucket ~ 1GB; the chunked
+        # layout stays within a small factor of the raw bytes
+        assert col_.device_memory_size() < 4 * raw
+        assert col_.device_memory_size() < 16 * (1 << 20)
+
+
+class TestEngineTraversal:
+    def _fact(self, tmp_path, n=64):
+        vals = mixed_strings(n)
+        t = pa.table({
+            "k": pa.array(np.arange(n) % 8, type=pa.int64()),
+            "v": pa.array(np.arange(n, dtype=np.float64)),
+            "s": pa.array(vals),
+        })
+        p = str(tmp_path / "long.parquet")
+        pq.write_table(t, p)
+        return p, t
+
+    def test_scan_filter_join_collect(self, session, tmp_path):
+        """The acceptance query: the 1MB string is carried (gathered,
+        joined, collected) but never byte-inspected on device."""
+        p, t = self._fact(tmp_path)
+        fact = session.read_parquet(p)
+        dim = session.from_arrow(pa.table({
+            "k": pa.array([1, 3, 5], type=pa.int64()),
+            "w": pa.array([1.0, 2.0, 3.0])}))
+        q = fact.filter(col("v") < 40).join(dim, on="k", how="inner")
+        out = q.collect().sort_by([("v", "ascending")])
+        cpu = q.collect_cpu().sort_by([("v", "ascending")])
+        assert out.column("s").to_pylist() == cpu.column("s").to_pylist()
+        # the big strings actually survived the traversal
+        joined = out.column("s").to_pylist()
+        src = t.column("s").to_pylist()
+        assert any(s == BIG for s in joined) or not any(
+            src[i] == BIG and (i % 8) in (1, 3, 5) and i < 40
+            for i in range(len(src)))
+
+    def test_peak_device_bytes_during_query(self, session, tmp_path):
+        p, _ = self._fact(tmp_path, n=256)
+        fact = session.read_parquet(p)
+        q = fact.filter(col("v") < 100)
+        from spark_rapids_tpu.plan.overrides import Overrides
+        session.initialize_device()
+        result = Overrides(session.conf).apply(q.plan)
+        peak = 0
+        for b in result.execute():
+            peak = max(peak, b.device_memory_size())
+        # flat layout would be >= cap x 1MB-bucket per batch (>256MB)
+        assert 0 < peak < 16 * (1 << 20)
+
+    def test_byte_op_falls_back_but_answers(self, session, tmp_path):
+        """A byte-inspecting op (substring-ish Length) over the long
+        column must still ANSWER via the per-op fallback path."""
+        p, t = self._fact(tmp_path)
+        fact = session.read_parquet(p)
+        q = fact.select("v", ln=Length(col("s")))
+        out = q.collect().sort_by([("v", "ascending")])
+        cpu = q.collect_cpu().sort_by([("v", "ascending")])
+        assert out.column("ln").to_pylist() == cpu.column("ln").to_pylist()
+
+    def test_groupby_on_other_key_carries_sum(self, session, tmp_path):
+        p, _ = self._fact(tmp_path)
+        fact = session.read_parquet(p)
+        q = (fact.filter(col("v") >= 0).group_by("k")
+             .agg(n=Count(col("s")), sv=Sum(col("v"))))
+        out = q.collect().sort_by([("k", "ascending")])
+        cpu = q.collect_cpu().sort_by([("k", "ascending")])
+        assert out.column("n").to_pylist() == cpu.column("n").to_pylist()
+
+    def test_sort_on_long_string_falls_back(self, session, tmp_path):
+        p, _ = self._fact(tmp_path, n=32)
+        q = session.read_parquet(p).sort("s")
+        out = q.collect()
+        cpu = q.collect_cpu()
+        assert out.column("s").to_pylist() == cpu.column("s").to_pylist()
+
+
+class TestCoalesceHealing:
+    def test_filter_drops_long_rows_then_heals(self, session):
+        """After the filter removes every long row, the coalesce healing
+        drops the overflow and the column returns to the flat layout."""
+        n = 200
+        vals = [BIG if i < 3 else f"s{i}" for i in range(n)]
+        t = pa.table({"i": pa.array(range(n), type=pa.int64()),
+                      "s": pa.array(vals)})
+        df = session.from_arrow(t).filter(col("i") >= 3)
+        from spark_rapids_tpu.plan.overrides import Overrides
+        session.initialize_device()
+        result = Overrides(session.conf).apply(df.plan)
+        from spark_rapids_tpu.exec.coalesce import rebucket_string_widths
+        for b in result.execute():
+            healed = rebucket_string_widths(b)
+            si = b.schema.names.index("s")
+            assert healed.columns[si].overflow is None
+            assert healed.columns[si].data.shape[1] <= 8
+
+    def test_blob_gc_compacts(self):
+        from spark_rapids_tpu.columnar.strings import compact_tails
+        lens = np.array([300, 10, 500], np.int32)
+        blob = np.zeros(4096, np.uint8)
+        blob[0:44] = 1    # row0 tail (300-256)
+        blob[44:288] = 2  # row2 tail (500-256)
+        ts = np.array([0, 0, 44], np.int32)
+        live = np.array([False, True, True])
+        blob2, ts2 = compact_tails(lens, (blob, ts), live, 256)
+        assert blob2.shape[0] < blob.shape[0] or blob2.shape[0] == 1024
+        # row2's tail preserved at its new offset
+        got = blob2[ts2[2]:ts2[2] + 244]
+        assert (got == 2).all()
+
+
+class TestShuffleWire:
+    def test_serialize_roundtrip_varlen(self, session):
+        from spark_rapids_tpu.shuffle.serializer import (
+            concat_host_tables, deserialize_table, serialize_batch)
+        from spark_rapids_tpu.columnar.batch import batch_from_arrow
+        vals = mixed_strings(24)
+        t = pa.table({"s": pa.array(vals),
+                      "i": pa.array(range(24), type=pa.int64())})
+        session.initialize_device()
+        b = batch_from_arrow(t)
+        blob = serialize_batch(b, "zstd")
+        # wire size must be near the raw bytes, not cap x width
+        raw = sum(len(v.encode()) for v in vals if v is not None)
+        assert len(blob) < 2 * raw + 65536
+        ht, consumed = deserialize_table(blob)
+        assert consumed == len(blob)
+        out = concat_host_tables([ht, ht])
+        got = to_arrow(out.columns[0], int(out.row_count())).to_pylist()
+        assert got == vals + vals
+
+
+class TestReviewRegressions:
+    def test_conditional_over_long_string_answers(self, session):
+        # If/CaseWhen override Expression.eval and skip its gate; the
+        # pad_common_width choke point must still stop silent truncation
+        from spark_rapids_tpu.expr import If
+        n = 20
+        vals = [BIG if i == 2 else f"s{i}" for i in range(n)]
+        t = pa.table({"v": pa.array(np.arange(n, dtype=np.float64)),
+                      "s": pa.array(vals)})
+        df = session.from_arrow(t)
+        q = df.select("v", out=If(col("v") > 1.0, col("s"), lit("tiny")))
+        out = q.collect().sort_by([("v", "ascending")])
+        cpu = q.collect_cpu().sort_by([("v", "ascending")])
+        got = out.column("out").to_pylist()
+        assert got == cpu.column("out").to_pylist()
+        assert got[2] == BIG  # not truncated at the head width
+
+    def test_empty_varlen_chunk_concat(self, session):
+        from spark_rapids_tpu.columnar.batch import batch_from_arrow
+        from spark_rapids_tpu.shuffle.serializer import (
+            concat_host_tables, deserialize_table, serialize_batch)
+        session.initialize_device()
+        vals8 = [BIG if i == 2 else f"s{i}" for i in range(8)]
+        full = batch_from_arrow(pa.table({"s": pa.array(vals8)}))
+        # zero-row batch whose column still carries the blob
+        import jax.numpy as jnp
+        import dataclasses
+        empty = dataclasses.replace(full, num_rows=jnp.asarray(0, jnp.int32))
+        ht_e, _ = deserialize_table(serialize_batch(empty))
+        ht_f, _ = deserialize_table(serialize_batch(full))
+        out = concat_host_tables([ht_e, ht_f])
+        got = to_arrow(out.columns[0], int(out.row_count())).to_pylist()
+        assert got == vals8
