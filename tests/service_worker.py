@@ -1,0 +1,68 @@
+"""Worker-process entry for the device-owner service tests.
+
+Runs in its OWN OS process (launched by tests/test_service.py): connects
+to the service socket, contends for cross-process admission, optionally
+holds its token until the orchestrating test allows release, optionally
+submits a Spark-plan JSON, and reports what happened as one JSON line on
+stdout. Mirrors how a Spark executor process would use the service
+(reference: tasks blocking on GpuSemaphore.scala:67 before touching the
+device)."""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from spark_rapids_tpu.service import TpuServiceClient  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--socket", required=True)
+    ap.add_argument("--name", required=True)
+    ap.add_argument("--deadline", type=float, default=30.0)
+    ap.add_argument("--enter-marker", default=None,
+                    help="file to create just before calling acquire")
+    ap.add_argument("--held-marker", default=None,
+                    help="file to create once admitted")
+    ap.add_argument("--hold-until", default=None,
+                    help="file to wait for before releasing")
+    ap.add_argument("--plan", default=None, help="plan JSON file")
+    ap.add_argument("--paths", default=None, help="ident->paths JSON")
+    args = ap.parse_args()
+
+    out = {"name": args.name}
+    with TpuServiceClient(args.socket, deadline_s=args.deadline) as cli:
+        out["t_enter_acquire"] = time.time()
+        if args.enter_marker:
+            with open(args.enter_marker, "w") as f:
+                f.write(args.name)
+        out["order"] = cli.acquire(timeout=args.deadline)
+        out["t_acquired"] = time.time()
+        if args.held_marker:
+            with open(args.held_marker, "w") as f:
+                f.write(json.dumps(out))
+        if args.hold_until:
+            t0 = time.time()
+            while not os.path.exists(args.hold_until):
+                if time.time() - t0 > args.deadline:
+                    raise TimeoutError("hold-until file never appeared")
+                time.sleep(0.01)
+        if args.plan:
+            with open(args.plan) as f:
+                plan_json = f.read()
+            paths = json.loads(args.paths) if args.paths else {}
+            table = cli.run_plan(plan_json, paths)
+            out["num_rows"] = table.num_rows
+            out["columns"] = table.schema.names
+        cli.release()
+        out["t_released"] = time.time()
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
