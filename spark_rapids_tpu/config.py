@@ -232,6 +232,12 @@ register("spark.rapids.sql.format.parquet.deviceDecode.enabled", "bool", True,
          "def-level expansion + byte bitcast); unsupported chunks fall back "
          "to the pyarrow host path per file.")
 register("spark.rapids.sql.format.orc.enabled", "bool", True, "Enable TPU ORC scan.")
+register("spark.rapids.sql.format.orc.deviceDecode.enabled", "bool", True,
+         "Decode flat ORC stripes on device: RLEv2 runs expand via "
+         "searchsorted run tables with big-endian bit-window unpacking, "
+         "present streams bit-unpack msb-first, strings gather from the "
+         "stripe blob (GpuOrcScan analog). Unsupported stripes fall back "
+         "to the pyarrow host path per stripe.")
 register("spark.rapids.sql.format.csv.enabled", "bool", True, "Enable TPU CSV scan.")
 register("spark.rapids.sql.format.json.enabled", "bool", True, "Enable TPU JSON scan.")
 register("spark.rapids.sql.format.iceberg.enabled", "bool", True,

@@ -1,0 +1,925 @@
+"""Device-side ORC decode (reference `GpuOrcScan.scala:826,1081,1750`: the
+reference copies raw stripe streams to the accelerator and decodes whole
+stripes there; ~2.7k LoC following the same strategy pattern as its
+Parquet scan).
+
+TPU shape of the same split as `parquet_device.py` — the serial,
+byte-walking control plane stays on the host; every O(rows) expansion runs
+on the device:
+
+  host (cheap, control-plane):
+    * postscript/footer/stripe-footer via a minimal protobuf wire parser;
+    * compressed-stream deframing (3-byte block headers; zlib "deflate"
+      blocks via zlib, snappy via pyarrow using the block's own varint
+      length prefix; lz4/zstd raw blocks don't self-describe -> host);
+    * RLEv2 run STRUCTURE scan: SHORT_REPEAT -> repeat run, fixed-delta
+      DELTA -> arithmetic run, DIRECT -> bit-packed run (bytes shipped
+      packed), PATCHED_BASE / variable-delta -> host-decoded literal runs
+      (their varint/patch walks are inherently serial) appended to a small
+      aux array — values are never expanded row-wise on the host;
+    * present/boolean byte-RLE run scan (runs, not bits);
+    * string LENGTH streams expanded host-side (tiny) -> offsets by cumsum.
+  device (the actual data work):
+    * RLEv2 expansion: output slot -> run via searchsorted over the run
+      table; repeat/arith runs computed, packed runs unpacked with
+      big-endian 64-bit gather windows + vector shifts, zigzag undone with
+      vector ops;
+    * present bits: byte runs expanded and bit-unpacked msb-first;
+    * FLOAT/DOUBLE: raw little-endian stream shipped once, viewed as lanes;
+    * strings: value spans gathered from the shipped data/dictionary blob
+      into the byte-matrix layout (shared `_gather_strings`);
+    * null scatter by rank = cumsum(present) (shared `_scatter_values`).
+
+Anything else (RLEv1 DIRECT encoding, timestamps/decimals/nested, exotic
+codecs, over-wide strings) raises DeviceDecodeUnsupported and the scan
+falls back to the pyarrow host path PER STRIPE — the per-row-group
+fallback discipline of the parquet path applied to ORC's stripe unit."""
+
+from __future__ import annotations
+
+import functools
+import struct
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from .. import types as T
+from ..columnar.padding import row_bucket
+from .parquet_device import (DeviceDecodeUnsupported, _gather_strings,
+                             _scatter_values)
+
+__all__ = ["OrcFileInfo", "decode_stripe", "device_decode_file",
+           "file_supported"]
+
+
+# ----------------------------------------------------------------------------
+# Protobuf wire parser (just enough for the ORC metadata messages)
+# ----------------------------------------------------------------------------
+
+def _pb_varint(buf, pos: int) -> Tuple[int, int]:
+    out = shift = 0
+    while True:
+        if pos >= len(buf):
+            raise DeviceDecodeUnsupported("truncated protobuf varint")
+        b = buf[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def _pb_fields(buf) -> Iterator[Tuple[int, int, object]]:
+    """Yield (field_no, wire_type, value) over a protobuf message body."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = _pb_varint(buf, pos)
+        fno, wt = key >> 3, key & 7
+        if wt == 0:
+            v, pos = _pb_varint(buf, pos)
+        elif wt == 2:
+            ln, pos = _pb_varint(buf, pos)
+            v = bytes(buf[pos:pos + ln])
+            pos += ln
+        elif wt == 5:
+            v = buf[pos:pos + 4]
+            pos += 4
+        elif wt == 1:
+            v = buf[pos:pos + 8]
+            pos += 8
+        else:
+            raise DeviceDecodeUnsupported(f"protobuf wire type {wt}")
+        yield fno, wt, v
+
+
+def _pb_packed_u32(v: bytes) -> List[int]:
+    out = []
+    pos = 0
+    while pos < len(v):
+        x, pos = _pb_varint(v, pos)
+        out.append(x)
+    return out
+
+
+# ----------------------------------------------------------------------------
+# File metadata
+# ----------------------------------------------------------------------------
+
+# orc_proto Type.Kind values
+_K_BOOLEAN, _K_BYTE, _K_SHORT, _K_INT, _K_LONG = 0, 1, 2, 3, 4
+_K_FLOAT, _K_DOUBLE, _K_STRING, _K_DATE = 5, 6, 7, 15
+_K_VARCHAR, _K_CHAR = 16, 17
+
+_KIND_FOR_DT = {
+    T.BooleanType: (_K_BOOLEAN,),
+    T.ByteType: (_K_BYTE,),
+    T.ShortType: (_K_SHORT,),
+    T.IntegerType: (_K_INT,),
+    T.LongType: (_K_LONG,),
+    T.FloatType: (_K_FLOAT,),
+    T.DoubleType: (_K_DOUBLE,),
+    T.StringType: (_K_STRING, _K_VARCHAR, _K_CHAR),
+    T.DateType: (_K_DATE,),
+}
+
+# CompressionKind
+_COMP_NONE, _COMP_ZLIB, _COMP_SNAPPY = 0, 1, 2
+_COMP_LZO, _COMP_LZ4, _COMP_ZSTD = 3, 4, 5
+
+# Stream.Kind
+_S_PRESENT, _S_DATA, _S_LENGTH, _S_DICT_DATA, _S_SECONDARY = 0, 1, 2, 3, 5
+
+# ColumnEncoding.Kind
+_E_DIRECT, _E_DICT, _E_DIRECT_V2, _E_DICT_V2 = 0, 1, 2, 3
+
+
+@dataclass
+class _Stripe:
+    offset: int
+    index_len: int
+    data_len: int
+    footer_len: int
+    num_rows: int
+
+
+@dataclass
+class OrcFileInfo:
+    path: str
+    compression: int
+    block_size: int
+    stripes: List[_Stripe]
+    col_ids: Dict[str, int]       # flat field name -> ORC column id
+    col_kinds: Dict[int, int]     # ORC column id -> Type.Kind
+    num_rows: int
+
+
+def _parse_footer(raw: bytes) -> OrcFileInfo:
+    """Parse postscript + footer from a buffer holding the file TAIL
+    (all offsets are end-relative)."""
+    if len(raw) < 16:
+        raise DeviceDecodeUnsupported("not an ORC file")
+    ps_len = raw[-1]
+    ps = raw[len(raw) - 1 - ps_len:len(raw) - 1]
+    footer_len = comp = block = 0
+    magic = b""
+    for fno, _, v in _pb_fields(ps):
+        if fno == 1:
+            footer_len = v
+        elif fno == 2:
+            comp = v
+        elif fno == 3:
+            block = v
+        elif fno == 8000:
+            magic = v
+    if magic != b"ORC":
+        raise DeviceDecodeUnsupported("postscript magic missing")
+    foot = raw[len(raw) - 1 - ps_len - footer_len:len(raw) - 1 - ps_len]
+    foot = _deframe(foot, comp, block)
+    stripes: List[_Stripe] = []
+    types: List[Tuple[int, List[int], List[str]]] = []
+    num_rows = 0
+    for fno, _, v in _pb_fields(foot):
+        if fno == 3:
+            s = {1: 0, 2: 0, 3: 0, 4: 0, 5: 0}
+            for f2, _, v2 in _pb_fields(v):
+                s[f2] = v2
+            stripes.append(_Stripe(s[1], s[2], s[3], s[4], s[5]))
+        elif fno == 4:
+            kind = 0
+            subs: List[int] = []
+            names: List[str] = []
+            for f2, _, v2 in _pb_fields(v):
+                if f2 == 1:
+                    kind = v2
+                elif f2 == 2:
+                    subs = _pb_packed_u32(v2)
+                elif f2 == 3:
+                    names.append(v2.decode("utf-8"))
+            types.append((kind, subs, names))
+        elif fno == 6:
+            num_rows = v
+    if not types or types[0][0] != 12:  # root must be a STRUCT
+        raise DeviceDecodeUnsupported("root type is not a struct")
+    root_kind, subs, names = types[0]
+    col_ids = {nm: cid for nm, cid in zip(names, subs)}
+    col_kinds = {cid: types[cid][0] for cid in subs if cid < len(types)}
+    return OrcFileInfo("", comp, block, stripes, col_ids, col_kinds,
+                       num_rows)
+
+
+def file_supported(path: str, schema) -> OrcFileInfo:
+    """Footer-only supportability check — raises DeviceDecodeUnsupported
+    BEFORE any stripe bytes are decoded. Returns the parsed footer so the
+    decode pass doesn't re-parse it."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, 2)
+            size = f.tell()
+            tail = min(size, 256 * 1024)
+            f.seek(size - tail)
+            raw_tail = f.read(tail)
+            if not raw_tail:
+                raise DeviceDecodeUnsupported("empty file")
+            ps_len = raw_tail[-1]
+            # postscript declares the footer length; re-read if the guess
+            # didn't cover it
+            need = ps_len + 1
+            for fno, _, v in _pb_fields(
+                    raw_tail[len(raw_tail) - 1 - ps_len:
+                             len(raw_tail) - 1]):
+                if fno == 1:
+                    need += v
+            if need > tail:
+                if need > size:
+                    raise DeviceDecodeUnsupported("footer exceeds file")
+                f.seek(size - need)
+                raw_tail = f.read(need)
+            info = _parse_footer(raw_tail)
+    except (OSError, struct.error, IndexError, KeyError) as e:
+        raise DeviceDecodeUnsupported(f"footer read failed: {e}") from e
+    info.path = path
+    # NONE/ZLIB/SNAPPY decode here (snappy blocks carry their uncompressed
+    # length as a varint prefix); lz4/zstd raw blocks don't self-describe a
+    # size pyarrow will accept, so those files take the host path honestly
+    if info.compression not in (_COMP_NONE, _COMP_ZLIB, _COMP_SNAPPY):
+        raise DeviceDecodeUnsupported(f"compression {info.compression}")
+    for name, dt in zip(schema.names, schema.types):
+        cid = info.col_ids.get(name)
+        if cid is None:
+            raise DeviceDecodeUnsupported(f"column {name} not flat")
+        ok = _KIND_FOR_DT.get(type(dt))
+        if ok is None:
+            raise DeviceDecodeUnsupported(f"logical type {dt}")
+        if info.col_kinds.get(cid) not in ok:
+            raise DeviceDecodeUnsupported(
+                f"ORC kind {info.col_kinds.get(cid)} for {dt}")
+    return info
+
+
+# ----------------------------------------------------------------------------
+# Compressed stream deframing (3-byte block headers)
+# ----------------------------------------------------------------------------
+
+def _deframe(buf: bytes, comp: int, block_size: int) -> bytes:
+    if comp == _COMP_NONE:
+        return buf
+    out = bytearray()
+    pos, n = 0, len(buf)
+    while pos < n:
+        if pos + 3 > n:
+            raise DeviceDecodeUnsupported("truncated compression header")
+        h = buf[pos] | (buf[pos + 1] << 8) | (buf[pos + 2] << 16)
+        pos += 3
+        ln = h >> 1
+        chunk = buf[pos:pos + ln]
+        if len(chunk) < ln:
+            raise DeviceDecodeUnsupported("truncated compression block")
+        pos += ln
+        if h & 1:  # original (stored) block
+            out += chunk
+        elif comp == _COMP_ZLIB:
+            try:
+                out += zlib.decompress(chunk, -15)  # raw deflate
+            except zlib.error as e:
+                raise DeviceDecodeUnsupported(f"zlib: {e}") from e
+        elif comp == _COMP_SNAPPY:
+            # raw snappy blocks prefix their uncompressed length as a
+            # varint; a block never decompresses past compressionBlockSize
+            usize, _ = _pb_varint(chunk, 0)
+            if block_size and usize > block_size:
+                raise DeviceDecodeUnsupported(
+                    f"snappy block claims {usize} > block size")
+            import pyarrow as pa
+            try:
+                out += pa.decompress(chunk, decompressed_size=usize,
+                                     codec="snappy")
+            except Exception as e:
+                raise DeviceDecodeUnsupported(f"snappy: {e}") from e
+        else:
+            raise DeviceDecodeUnsupported(f"compression {comp}")
+    return bytes(out)
+
+
+# ----------------------------------------------------------------------------
+# Byte-RLE (present streams, boolean/byte data) -> run table
+# ----------------------------------------------------------------------------
+
+def _byte_rle_runs(buf: bytes, max_bytes: int):
+    """Scan ORC byte-RLE into (kinds u8 0=repeat 1=literal, counts i64,
+    values u8, offs i64, blob u8[...]) without expanding repeats."""
+    kinds: List[int] = []
+    counts: List[int] = []
+    values: List[int] = []
+    offs: List[int] = []
+    blob = bytearray()
+    pos, total = 0, 0
+    n = len(buf)
+    while total < max_bytes and pos < n:
+        c = buf[pos]
+        pos += 1
+        if c < 128:  # run of c+3 copies of the next byte
+            if pos >= n:
+                raise DeviceDecodeUnsupported("truncated byte RLE")
+            kinds.append(0)
+            counts.append(c + 3)
+            values.append(buf[pos])
+            offs.append(0)
+            pos += 1
+            total += c + 3
+        else:  # 256-c literal bytes
+            ln = 256 - c
+            if pos + ln > n:
+                raise DeviceDecodeUnsupported("truncated byte RLE")
+            kinds.append(1)
+            counts.append(ln)
+            values.append(0)
+            offs.append(len(blob))
+            blob += buf[pos:pos + ln]
+            pos += ln
+            total += ln
+    if total < max_bytes:
+        raise DeviceDecodeUnsupported("short byte-RLE stream")
+    if not blob:
+        blob = bytearray(1)
+    return (np.array(kinds, np.uint8), np.array(counts, np.int64),
+            np.array(values, np.uint8), np.array(offs, np.int64),
+            np.frombuffer(bytes(blob), np.uint8))
+
+
+_POPCOUNT = np.unpackbits(np.arange(256, dtype=np.uint8)[:, None],
+                          axis=1).sum(axis=1).astype(np.int64)
+
+
+def _present_ndef(runs, nrows: int) -> int:
+    """Non-null count from the present RUN table in O(runs + literal
+    bytes): popcount-per-byte-value for repeat runs, table-lookup popcount
+    over literal slices — the bit stream is never expanded row-wise."""
+    kinds, counts, values, offs, blob = runs
+    nbytes = (nrows + 7) // 8
+    rem = nrows - (nbytes - 1) * 8  # valid bits in the final byte (1..8)
+    ndef = 0
+    seen = 0
+    last_byte = 0
+    for k, c, v, o in zip(kinds, counts, values, offs):
+        if seen >= nbytes:
+            break
+        take = min(int(c), nbytes - seen)
+        if k == 0:
+            ndef += int(_POPCOUNT[v]) * take
+            lb = int(v)
+        else:
+            sl = blob[o:o + take]
+            ndef += int(_POPCOUNT[sl].sum())
+            lb = int(sl[-1]) if take else 0
+        seen += take
+        if seen == nbytes:
+            last_byte = lb
+    if rem < 8:  # drop the final byte's padding bits
+        ndef -= int(_POPCOUNT[last_byte & ((1 << (8 - rem)) - 1)])
+    return ndef
+
+
+# ----------------------------------------------------------------------------
+# RLEv2 -> run table
+# ----------------------------------------------------------------------------
+
+def _decode_width(code: int) -> int:
+    if code <= 23:
+        return code + 1
+    return {24: 26, 25: 28, 26: 30, 27: 32,
+            28: 40, 29: 48, 30: 56, 31: 64}[code]
+
+
+def _closest_fixed_bits(n: int) -> int:
+    """Round a bit width UP to the nearest width the readers use."""
+    if n <= 24:
+        return max(n, 1)
+    for w in (26, 28, 30, 32, 40, 48, 56, 64):
+        if n <= w:
+            return w
+    return 64
+
+
+def _svarint(buf, pos: int) -> Tuple[int, int]:
+    v, pos = _pb_varint(buf, pos)
+    return (v >> 1) ^ -(v & 1), pos
+
+
+def _unpack_be_host(buf: bytes, count: int, width: int) -> np.ndarray:
+    """Host big-endian bit unpack (PATCHED_BASE / variable-delta literal
+    runs only — both already require a serial host walk)."""
+    if width == 0:
+        return np.zeros(count, np.int64)
+    arr = np.frombuffer(buf, np.uint8)
+    if arr.size * 8 < count * width:
+        raise DeviceDecodeUnsupported("truncated packed run")
+    w = np.unpackbits(arr)[:count * width] \
+        .reshape(count, width).astype(np.uint64)
+    weights = (np.uint64(1) << np.arange(width - 1, -1, -1,
+                                         dtype=np.uint64))
+    return (w * weights).sum(axis=1, dtype=np.uint64).view(np.int64)
+
+
+class _RunTable:
+    """Accumulates RLEv2 runs: kind 0=repeat(base) 1=arith(base,step)
+    2=packed(offs:bit,width) 3=literal(offs into aux)."""
+
+    def __init__(self):
+        self.kinds: List[int] = []
+        self.counts: List[int] = []
+        self.base: List[int] = []
+        self.step: List[int] = []
+        self.offs: List[int] = []
+        self.width: List[int] = []
+        self.packed = bytearray()
+        self.aux: List[np.ndarray] = []
+        self.aux_len = 0
+        self.total = 0
+
+    def add(self, kind, count, base=0, step=0, offs=0, width=0):
+        self.kinds.append(kind)
+        self.counts.append(count)
+        self.base.append(base)
+        self.step.append(step)
+        self.offs.append(offs)
+        self.width.append(width)
+        self.total += count
+
+    def add_literal(self, vals: np.ndarray):
+        self.add(3, len(vals), offs=self.aux_len)
+        self.aux.append(vals.astype(np.int64))
+        self.aux_len += len(vals)
+
+    def arrays(self):
+        aux = (np.concatenate(self.aux) if self.aux
+               else np.zeros(1, np.int64))
+        packed = (np.frombuffer(bytes(self.packed), np.uint8)
+                  if self.packed else np.zeros(1, np.uint8))
+        return (np.array(self.kinds, np.uint8),
+                np.array(self.counts, np.int64),
+                np.array(self.base, np.int64),
+                np.array(self.step, np.int64),
+                np.array(self.offs, np.int64),
+                np.array(self.width, np.uint8),
+                packed, aux)
+
+
+def _rlev2_runs(buf: bytes, num_values: int, signed: bool) -> _RunTable:
+    """Scan an RLEv2 stream into a run table without expanding values.
+    Big-endian bit-packed DIRECT payloads are carried packed (device
+    unpacks); PATCHED_BASE and variable-delta runs host-decode into the
+    aux literal array (their byte walks are serial by construction)."""
+    rt = _RunTable()
+    pos, n = 0, len(buf)
+    while rt.total < num_values and pos < n:
+        b0 = buf[pos]
+        enc = b0 >> 6
+        if enc == 0:  # SHORT_REPEAT
+            nbytes = ((b0 >> 3) & 7) + 1
+            cnt = (b0 & 7) + 3
+            if pos + 1 + nbytes > n:
+                raise DeviceDecodeUnsupported("truncated SHORT_REPEAT")
+            v = int.from_bytes(buf[pos + 1:pos + 1 + nbytes], "big")
+            if signed:
+                v = (v >> 1) ^ -(v & 1)
+            rt.add(0, cnt, base=v)
+            pos += 1 + nbytes
+        elif enc == 1:  # DIRECT
+            if pos + 2 > n:
+                raise DeviceDecodeUnsupported("truncated DIRECT header")
+            width = _decode_width((b0 >> 1) & 0x1F)
+            cnt = ((b0 & 1) << 8 | buf[pos + 1]) + 1
+            nbytes = (cnt * width + 7) // 8
+            if pos + 2 + nbytes > n:
+                raise DeviceDecodeUnsupported("truncated DIRECT run")
+            rt.add(2, cnt, offs=len(rt.packed) * 8, width=width)
+            rt.packed += buf[pos + 2:pos + 2 + nbytes]
+            pos += 2 + nbytes
+        elif enc == 3:  # DELTA
+            if pos + 2 > n:
+                raise DeviceDecodeUnsupported("truncated DELTA header")
+            wcode = (b0 >> 1) & 0x1F
+            cnt = ((b0 & 1) << 8 | buf[pos + 1]) + 1
+            p = pos + 2
+            if signed:
+                base, p = _svarint(buf, p)
+            else:
+                base, p = _pb_varint(buf, p)
+            db, p = _svarint(buf, p)
+            if wcode == 0:  # fixed delta: v_i = base + i*db
+                rt.add(1, cnt, base=base, step=db)
+            elif cnt < 2:
+                raise DeviceDecodeUnsupported(
+                    "DELTA run shorter than 2 with literal deltas")
+            else:
+                width = _decode_width(wcode)
+                nbytes = ((cnt - 2) * width + 7) // 8
+                if p + nbytes > n:
+                    raise DeviceDecodeUnsupported("truncated DELTA run")
+                deltas = _unpack_be_host(buf[p:p + nbytes], cnt - 2,
+                                         width).astype(np.int64)
+                sign = 1 if db >= 0 else -1
+                vals = np.empty(cnt, np.int64)
+                vals[0] = base
+                vals[1] = base + db
+                np.cumsum(sign * deltas, out=deltas)
+                vals[2:] = base + db + deltas
+                rt.add_literal(vals)
+                p += nbytes
+            pos = p
+        else:  # PATCHED_BASE
+            if pos + 4 > n:
+                raise DeviceDecodeUnsupported("truncated PATCHED header")
+            width = _decode_width((b0 >> 1) & 0x1F)
+            cnt = ((b0 & 1) << 8 | buf[pos + 1]) + 1
+            b2, b3 = buf[pos + 2], buf[pos + 3]
+            bw = ((b2 >> 5) & 7) + 1
+            pw = _decode_width(b2 & 0x1F)
+            pgw = ((b3 >> 5) & 7) + 1
+            pl = b3 & 0x1F
+            p = pos + 4
+            if p + bw > n:
+                raise DeviceDecodeUnsupported("truncated PATCHED base")
+            base = int.from_bytes(buf[p:p + bw], "big")
+            sign_mask = 1 << (bw * 8 - 1)
+            if base & sign_mask:
+                base = -(base & (sign_mask - 1))
+            p += bw
+            nbytes = (cnt * width + 7) // 8
+            vals = _unpack_be_host(buf[p:p + nbytes], cnt,
+                                   width).astype(np.int64)
+            p += nbytes
+            # patch entries: (gap:pgw bits | patch:pw bits) bit-packed at
+            # the closest fixed width >= pgw+pw (the readers' contract)
+            ew = _closest_fixed_bits(pgw + pw)
+            nbytes = (pl * ew + 7) // 8
+            if p + nbytes > n:
+                raise DeviceDecodeUnsupported("truncated patch list")
+            entries = _unpack_be_host(buf[p:p + nbytes], pl,
+                                      ew).view(np.uint64)
+            p += nbytes
+            idx = 0
+            pmask = (1 << pw) - 1
+            for e in entries:
+                gap = int(e) >> pw
+                patch = int(e) & pmask
+                idx += gap  # gaps accumulate; a (gap=255, patch=0)
+                if patch == 0:  # entry is a pure continuation marker
+                    continue
+                if idx < cnt:
+                    vals[idx] |= patch << width
+            rt.add_literal(base + vals)
+            pos = p
+    if rt.total < num_values:
+        raise DeviceDecodeUnsupported("short RLEv2 stream")
+    return rt
+
+
+def _expand_runs_host(rt: _RunTable, num_values: int,
+                      signed: bool) -> np.ndarray:
+    """Host mirror of the device RLEv2 expansion — used ONLY for tiny
+    metadata streams (string lengths, dictionary lengths) whose values
+    feed host cumsum offsets, mirroring parquet's native offset scan."""
+    kinds, counts, base, step, offs, width, packed, aux = rt.arrays()
+    parts: List[np.ndarray] = []
+    for i in range(len(kinds)):
+        c = int(counts[i])
+        k = int(kinds[i])
+        if k == 0:
+            parts.append(np.full(c, base[i], np.int64))
+        elif k == 1:
+            parts.append(base[i] + step[i] * np.arange(c, dtype=np.int64))
+        elif k == 3:
+            parts.append(aux[offs[i]:offs[i] + c])
+        else:
+            w = int(width[i])
+            bitoff = int(offs[i])
+            assert bitoff % 8 == 0  # packed runs start byte-aligned
+            raw = packed[bitoff // 8: bitoff // 8 + (c * w + 7) // 8]
+            vals = _unpack_be_host(raw.tobytes(), c, w)
+            if signed:
+                u = vals.view(np.uint64)
+                vals = ((u >> np.uint64(1)) ^
+                        (np.uint64(0) - (u & np.uint64(1)))).view(np.int64)
+            parts.append(vals)
+    out = (np.concatenate(parts) if parts else np.zeros(0, np.int64))
+    return out[:num_values]
+
+
+# ----------------------------------------------------------------------------
+# Device kernels
+# ----------------------------------------------------------------------------
+
+@functools.partial(__import__("jax").jit, static_argnums=(8, 9))
+def _expand_rlev2_device(kinds, counts, base, step, offs, width, packed,
+                         aux, cap: int, signed: bool):
+    """Run table -> i64[cap] values, entirely on device: searchsorted run
+    lookup; repeat/arith computed; DIRECT runs unpacked from the big-endian
+    bit stream with 8-byte gather windows; zigzag undone with vector ops."""
+    import jax
+    import jax.numpy as jnp
+    ends = jnp.cumsum(counts)
+    j = jnp.arange(cap, dtype=jnp.int64)
+    run = jnp.clip(jnp.searchsorted(ends, j, side="right"),
+                   0, counts.shape[0] - 1)
+    within = j - (ends[run] - counts[run])
+    # repeat (step==0) and arithmetic runs
+    va = base[run] + within * step[run]
+    # literal runs
+    vl = aux[jnp.clip(offs[run] + within, 0, aux.shape[0] - 1)]
+    # packed runs: big-endian window gather. ORC widths are 1..30 bits or
+    # byte multiples (32/40/48/56/64); sh<=7 and W<=56 fit an 8-byte
+    # window, W=64 runs are byte-aligned (sh=0) so the window is exact.
+    W = width[run].astype(jnp.uint64)
+    bitpos = offs[run] + within * width[run].astype(jnp.int64)
+    b0 = bitpos // 8
+    window = jnp.zeros(cap, jnp.uint64)
+    for k in range(8):
+        byte = packed[jnp.clip(b0 + k, 0, packed.shape[0] - 1)]
+        window = window | (byte.astype(jnp.uint64)
+                           << jnp.uint64(8 * (7 - k)))
+    sh = (bitpos % 8).astype(jnp.uint64)
+    shift = jnp.uint64(64) - sh - W
+    shift = jnp.where(W >= 64, jnp.uint64(0), shift)
+    pv = window >> shift
+    mask = jnp.where(W >= 64, ~jnp.uint64(0),
+                     (jnp.uint64(1) << jnp.minimum(W, jnp.uint64(63)))
+                     - jnp.uint64(1))
+    pv = pv & mask
+    if signed:
+        pv = (pv >> jnp.uint64(1)) ^ (jnp.uint64(0) -
+                                      (pv & jnp.uint64(1)))
+    pvs = jax.lax.bitcast_convert_type(pv, jnp.int64)
+    v = jnp.where(kinds[run] == 2, pvs,
+                  jnp.where(kinds[run] == 3, vl, va))
+    return jnp.where(j < ends[-1], v, 0)
+
+
+@functools.partial(__import__("jax").jit, static_argnums=(5,))
+def _expand_present_device(kinds, counts, values, offs, blob, cap: int):
+    """Byte-RLE run table -> bool[cap] present mask on device. Row j reads
+    bit 7-(j%8) of stream byte j//8, msb-first per the ORC spec."""
+    import jax.numpy as jnp
+    ends = jnp.cumsum(counts)  # ends in BYTES
+    j = jnp.arange(cap, dtype=jnp.int64)
+    bi = j // 8
+    run = jnp.clip(jnp.searchsorted(ends, bi, side="right"),
+                   0, counts.shape[0] - 1)
+    within = bi - (ends[run] - counts[run])
+    byte = jnp.where(kinds[run] == 0, values[run],
+                     blob[jnp.clip(offs[run] + within, 0,
+                                   blob.shape[0] - 1)])
+    bit = (byte >> (7 - (j % 8)).astype(jnp.uint8)) & 1
+    return (bit == 1) & (bi < ends[-1])
+
+
+@functools.partial(__import__("jax").jit, static_argnums=(5,))
+def _expand_bytes_device(kinds, counts, values, offs, blob, cap: int):
+    """Byte-RLE run table -> u8[cap] values on device (BYTE columns)."""
+    import jax.numpy as jnp
+    ends = jnp.cumsum(counts)
+    j = jnp.arange(cap, dtype=jnp.int64)
+    run = jnp.clip(jnp.searchsorted(ends, j, side="right"),
+                   0, counts.shape[0] - 1)
+    within = j - (ends[run] - counts[run])
+    byte = jnp.where(kinds[run] == 0, values[run],
+                     blob[jnp.clip(offs[run] + within, 0,
+                                   blob.shape[0] - 1)])
+    return jnp.where(j < ends[-1], byte, 0)
+
+
+# ----------------------------------------------------------------------------
+# Stripe decode
+# ----------------------------------------------------------------------------
+
+@dataclass
+class _ColStreams:
+    encoding: int = _E_DIRECT
+    dict_size: int = 0
+    streams: Dict[int, bytes] = field(default_factory=dict)
+
+
+def _read_stripe_streams(info: OrcFileInfo, f, st: _Stripe,
+                         want_cols) -> Dict[int, _ColStreams]:
+    """Read + deframe the stripe footer and the wanted columns' streams."""
+    f.seek(st.offset + st.index_len + st.data_len)
+    sf_raw = _deframe(f.read(st.footer_len), info.compression,
+                      info.block_size)
+    streams: List[Tuple[int, int, int]] = []  # (kind, col, length)
+    encodings: List[Tuple[int, int]] = []
+    for fno, _, v in _pb_fields(sf_raw):
+        if fno == 1:
+            s = {1: 0, 2: 0, 3: 0}
+            for f2, _, v2 in _pb_fields(v):
+                s[f2] = v2
+            streams.append((s[1], s[2], s[3]))
+        elif fno == 2:
+            e = {1: 0, 2: 0}
+            for f2, _, v2 in _pb_fields(v):
+                e[f2] = v2
+            encodings.append((e[1], e[2]))
+    cols: Dict[int, _ColStreams] = {}
+    for cid in want_cols:
+        cs = _ColStreams()
+        if cid < len(encodings):
+            cs.encoding, cs.dict_size = encodings[cid]
+        cols[cid] = cs
+    pos = st.offset
+    for kind, col, length in streams:
+        if col in cols and kind in (_S_PRESENT, _S_DATA, _S_LENGTH,
+                                    _S_DICT_DATA) \
+                and pos >= st.offset + st.index_len:
+            f.seek(pos)
+            cols[col].streams[kind] = _deframe(
+                f.read(length), info.compression, info.block_size)
+        pos += length
+    return cols
+
+
+def _defined_and_count(cs: _ColStreams, nrows: int, cap: int):
+    """(device bool[cap] mask, non-null count) from the PRESENT stream."""
+    import jax.numpy as jnp
+    present = cs.streams.get(_S_PRESENT)
+    if present is None:
+        return jnp.arange(cap) < nrows, nrows
+    runs = _byte_rle_runs(present, (nrows + 7) // 8)
+    ndef = _present_ndef(runs, nrows)
+    defined = _expand_present_device(
+        jnp.asarray(runs[0]), jnp.asarray(runs[1]), jnp.asarray(runs[2]),
+        jnp.asarray(runs[3]), jnp.asarray(runs[4]), cap)
+    defined = defined & (jnp.arange(cap) < nrows)
+    return defined, ndef
+
+
+def _rlev2_device_from_buf(buf: bytes, count: int, signed: bool):
+    """Scan an RLEv2 stream (host) and expand it on device -> i64."""
+    import jax.numpy as jnp
+    if count == 0:  # all-null column: no runs to expand
+        return jnp.zeros(1, jnp.int64)
+    rt = _rlev2_runs(buf, count, signed)
+    arrs = [jnp.asarray(a) for a in rt.arrays()]
+    return _expand_rlev2_device(*arrs, row_bucket(count), signed)[:count]
+
+
+def _int_values_device(cs: _ColStreams, ndef: int, signed: bool):
+    if cs.encoding != _E_DIRECT_V2:
+        raise DeviceDecodeUnsupported(f"integer encoding {cs.encoding}")
+    data = cs.streams.get(_S_DATA)
+    if data is None:
+        raise DeviceDecodeUnsupported("missing DATA stream")
+    return _rlev2_device_from_buf(data, ndef, signed)
+
+
+def decode_stripe(info: OrcFileInfo, f, si: int, schema):
+    """Decode ONE stripe on the TPU -> (device ColumnarBatch, row count).
+    Encoding surprises the footer can't reveal (RLEv1 integer runs,
+    missing streams) raise DeviceDecodeUnsupported so the caller falls
+    just THIS stripe back to the host reader — per-stripe granularity,
+    the parquet path's per-row-group discipline."""
+    import jax.numpy as jnp
+    from ..columnar.batch import ColumnarBatch
+    from ..columnar.column import Column
+    from ..columnar.padding import width_bucket
+    from ..config import get_default_conf
+
+    st = info.stripes[si]
+    nrows = st.num_rows
+    cap = row_bucket(nrows)
+    want = {info.col_ids[name] for name in schema.names}
+    cols_streams = _read_stripe_streams(info, f, st, want)
+    out_cols = []
+    for name, dt in zip(schema.names, schema.types):
+        cid = info.col_ids[name]
+        kind = info.col_kinds[cid]
+        cs = cols_streams[cid]
+        defined, ndef = _defined_and_count(cs, nrows, cap)
+        if kind in (_K_SHORT, _K_INT, _K_LONG, _K_DATE):
+            vals = _int_values_device(cs, ndef, signed=True)
+            if vals.shape[0] < cap:
+                vals = jnp.pad(vals, (0, cap - vals.shape[0]))
+            data, validity = _scatter_values(vals[:cap], defined)
+            out_cols.append(Column(dt, data.astype(dt.np_dtype), validity))
+        elif kind in (_K_FLOAT, _K_DOUBLE):
+            raw = cs.streams.get(_S_DATA)
+            if raw is None:
+                raise DeviceDecodeUnsupported("missing DATA stream")
+            npdt = np.float32 if kind == _K_FLOAT else np.float64
+            try:
+                host = np.frombuffer(raw, npdt, count=ndef)
+            except ValueError as e:
+                raise DeviceDecodeUnsupported(
+                    f"short float stream: {e}") from e
+            vals = jnp.asarray(host)
+            if vals.shape[0] < cap:
+                vals = jnp.pad(vals, (0, cap - vals.shape[0]))
+            data, validity = _scatter_values(vals[:cap], defined)
+            out_cols.append(Column(dt, data.astype(dt.np_dtype), validity))
+        elif kind == _K_BOOLEAN:
+            raw = cs.streams.get(_S_DATA)
+            if raw is None:
+                raise DeviceDecodeUnsupported("missing DATA stream")
+            if ndef == 0:
+                vals = jnp.zeros(1, bool)
+            else:
+                runs = _byte_rle_runs(raw, (ndef + 7) // 8)
+                bits = _expand_present_device(
+                    jnp.asarray(runs[0]), jnp.asarray(runs[1]),
+                    jnp.asarray(runs[2]), jnp.asarray(runs[3]),
+                    jnp.asarray(runs[4]), row_bucket(ndef))
+                vals = bits[:ndef]
+            if vals.shape[0] < cap:
+                vals = jnp.pad(vals, (0, cap - vals.shape[0]))
+            data, validity = _scatter_values(vals[:cap], defined)
+            out_cols.append(Column(dt, data, validity))
+        elif kind == _K_BYTE:
+            raw = cs.streams.get(_S_DATA)
+            if raw is None:
+                raise DeviceDecodeUnsupported("missing DATA stream")
+            if ndef == 0:
+                vals = jnp.zeros(1, jnp.uint8)
+            else:
+                runs = _byte_rle_runs(raw, ndef)
+                vals = _expand_bytes_device(
+                    jnp.asarray(runs[0]), jnp.asarray(runs[1]),
+                    jnp.asarray(runs[2]), jnp.asarray(runs[3]),
+                    jnp.asarray(runs[4]), row_bucket(ndef))
+                vals = jnp.asarray(vals, jnp.uint8)[:ndef]
+            if vals.shape[0] < cap:
+                vals = jnp.pad(vals, (0, cap - vals.shape[0]))
+            data, validity = _scatter_values(vals[:cap], defined)
+            out_cols.append(Column(dt, data.astype(jnp.int8), validity))
+        elif kind in (_K_STRING, _K_VARCHAR, _K_CHAR):
+            out_cols.append(_assemble_strings_orc(
+                cs, dt, defined, ndef, cap, width_bucket,
+                get_default_conf().string_max_width))
+        else:
+            raise DeviceDecodeUnsupported(f"ORC kind {kind}")
+    return ColumnarBatch(schema, tuple(out_cols),
+                         jnp.asarray(nrows, jnp.int32)), nrows
+
+
+def _assemble_strings_orc(cs: _ColStreams, dt, defined, ndef: int,
+                          cap: int, width_bucket, max_width: int):
+    """STRING column -> byte-matrix layout. DIRECT_V2: LENGTH lengths
+    (host, tiny) -> cumsum offsets, device gathers spans from the DATA
+    blob. DICTIONARY_V2: indices expand on device, dictionary offsets on
+    host, device gathers from the dictionary blob. Mirrors the parquet
+    `_assemble_strings` split exactly."""
+    import jax.numpy as jnp
+    from ..columnar.column import Column
+
+    if cs.encoding == _E_DIRECT_V2:
+        blob_raw = cs.streams.get(_S_DATA, b"")
+        lens_raw = cs.streams.get(_S_LENGTH)
+        if lens_raw is None:
+            raise DeviceDecodeUnsupported("missing LENGTH stream")
+        lens = _expand_runs_host(_rlev2_runs(lens_raw, ndef, False),
+                                 ndef, False)
+        starts = np.zeros(ndef, np.int64)
+        if ndef:
+            np.cumsum(lens[:-1], out=starts[1:])
+        max_len = int(lens.max()) if ndef else 0
+        st_dev = jnp.asarray(starts)
+        ln_dev = jnp.asarray(lens.astype(np.int32))
+    elif cs.encoding == _E_DICT_V2:
+        blob_raw = cs.streams.get(_S_DICT_DATA, b"")
+        lens_raw = cs.streams.get(_S_LENGTH)
+        data = cs.streams.get(_S_DATA)
+        if lens_raw is None or data is None:
+            raise DeviceDecodeUnsupported("missing dictionary streams")
+        dcount = cs.dict_size
+        dlens = _expand_runs_host(_rlev2_runs(lens_raw, dcount, False),
+                                  dcount, False)
+        dstarts = np.zeros(dcount, np.int64)
+        if dcount:
+            np.cumsum(dlens[:-1], out=dstarts[1:])
+        max_len = int(dlens.max()) if dcount else 0
+        idx = _rlev2_device_from_buf(data, ndef, signed=False)
+        idx = jnp.clip(idx, 0, max(dcount - 1, 0))
+        st_dev = jnp.asarray(dstarts)[idx]
+        ln_dev = jnp.asarray(dlens.astype(np.int32))[idx]
+    else:
+        raise DeviceDecodeUnsupported(f"string encoding {cs.encoding}")
+
+    width = width_bucket(max(max_len, 1))
+    if width > max_width:
+        raise DeviceDecodeUnsupported(
+            f"string width {max_len} exceeds device layout limit")
+    if st_dev.shape[0] < cap:
+        st_dev = jnp.pad(st_dev, (0, cap - st_dev.shape[0]))
+        ln_dev = jnp.pad(ln_dev, (0, cap - ln_dev.shape[0]))
+    blob = jnp.asarray(np.frombuffer(blob_raw, np.uint8)
+                       if blob_raw else np.zeros(1, np.uint8))
+    matrix, lengths = _gather_strings(blob, st_dev[:cap], ln_dev[:cap],
+                                      defined, width)
+    return Column(dt, matrix, defined, lengths)
+
+
+def device_decode_file(info: OrcFileInfo, path: str, schema) -> Iterator:
+    """Yield (device ColumnarBatch, row count) per stripe, streaming."""
+    with open(path, "rb") as f:
+        for si in range(len(info.stripes)):
+            yield decode_stripe(info, f, si, schema)

@@ -199,6 +199,10 @@ class TpuFileScanExec(_TpuExec):
                     "spark.rapids.sql.format.parquet.deviceDecode.enabled"):
             yield from self._parquet_batches()
             return
+        if self.cpu_scan.format_name == "orc" and self.conf.get(
+                "spark.rapids.sql.format.orc.deviceDecode.enabled"):
+            yield from self._orc_batches()
+            return
         if self.cpu_scan.format_name == "csv" and self.conf.get(
                 "spark.rapids.sql.format.csv.deviceDecode.enabled"):
             from .csv_device import csv_device_supported
@@ -245,6 +249,41 @@ class TpuFileScanExec(_TpuExec):
                                    format_name=scan.format_name):
             t = scan._postprocess(t)
             yield batch_from_arrow(t), t.num_rows
+
+    def _orc_batches(self):
+        """Device decode per STRIPE with per-stripe host fallback —
+        the parquet path's per-row-group discipline applied to ORC's
+        stripe unit. Footer-gated per file; a stripe-level surprise
+        (RLEv1 runs, missing streams, over-wide strings) falls just THAT
+        stripe back to pyarrow's read_stripe."""
+        from ..columnar.batch import batch_from_arrow
+        from .orc_device import (DeviceDecodeUnsupported, decode_stripe,
+                                 file_supported)
+        scan = self.cpu_scan
+        for path in scan.paths:
+            try:
+                info = file_supported(path, scan.output)
+            except (DeviceDecodeUnsupported, OSError, struct_error):
+                for b, nrows in self._host_file_batches(path):
+                    self.num_output_rows.add(nrows)
+                    yield self._count_output(b)
+                continue
+            from pyarrow import orc as pa_orc
+            ofile = None
+            with open(path, "rb") as f:
+                for si in range(len(info.stripes)):
+                    try:
+                        b, nrows = decode_stripe(info, f, si, scan.output)
+                    except (DeviceDecodeUnsupported, OSError,
+                            struct_error):
+                        if ofile is None:
+                            ofile = pa_orc.ORCFile(path)
+                        t = scan._postprocess(pa.Table.from_batches(
+                            [ofile.read_stripe(
+                                si, columns=list(scan.output.names))]))
+                        b, nrows = batch_from_arrow(t), t.num_rows
+                    self.num_output_rows.add(nrows)
+                    yield self._count_output(b)
 
     def _parquet_batches(self):
         """Device decode per ROW GROUP with per-row-group host fallback.
