@@ -25,6 +25,62 @@ class GroupedData:
         return DataFrame(self.df.session,
                          N.CpuHashAggregateExec(self.keys, aggs, self.df.plan))
 
+    def _key_names(self):
+        names = []
+        for k in self.keys:
+            if not isinstance(k, AttributeReference):
+                raise ValueError("pandas group operations require plain "
+                                 "column keys")
+            names.append(k.col_name)
+        return names
+
+    def apply_in_pandas(self, fn, schema) -> "DataFrame":
+        """fn(group pd.DataFrame) -> pd.DataFrame with `schema` (Spark's
+        GroupedData.applyInPandas; GpuFlatMapGroupsInPandasExec)."""
+        from .udf.pandas_execs import CpuFlatMapGroupsInPandasExec
+        return DataFrame(self.df.session, CpuFlatMapGroupsInPandasExec(
+            self._key_names(), fn, _as_schema(schema), self.df.plan))
+
+    def agg_in_pandas(self, **named) -> "DataFrame":
+        """Grouped pandas-UDF aggregation: each kwarg is
+        (fn, return_type, *arg_columns); fn(*pd.Series) -> scalar
+        (Spark's series-to-scalar pandas_udf; GpuAggregateInPandasExec)."""
+        from .udf.pandas_execs import CpuAggregateInPandasExec, PandasAgg
+        aggs = [PandasAgg(name, spec[0], spec[1], list(spec[2:]))
+                for name, spec in named.items()]
+        return DataFrame(self.df.session, CpuAggregateInPandasExec(
+            self._key_names(), aggs, self.df.plan))
+
+    def cogroup(self, other: "GroupedData") -> "CoGroupedData":
+        return CoGroupedData(self, other)
+
+
+class CoGroupedData:
+    def __init__(self, left: GroupedData, right: GroupedData):
+        self.left = left
+        self.right = right
+
+    def apply_in_pandas(self, fn, schema) -> "DataFrame":
+        """fn(left_group_df, right_group_df) -> pd.DataFrame with `schema`
+        (Spark's cogrouped applyInPandas; GpuFlatMapCoGroupsInPandasExec).
+        Co-groups form over the union of both sides' key values."""
+        from .udf.pandas_execs import CpuCoGroupsInPandasExec
+        return DataFrame(self.left.df.session, CpuCoGroupsInPandasExec(
+            self.left._key_names(), self.right._key_names(), fn,
+            _as_schema(schema), self.left.df.plan, self.right.df.plan))
+
+
+def _as_schema(schema):
+    from .columnar.batch import Schema
+    if isinstance(schema, Schema):
+        return schema
+    import pyarrow as pa
+    if isinstance(schema, pa.Schema):
+        return Schema.from_arrow(schema)
+    # [(name, DataType), ...]
+    return Schema(tuple(n for n, _ in schema),
+                  tuple(t for _, t in schema))
+
 
 class DataFrame:
     def __init__(self, session, plan: N.PhysicalPlan):
@@ -53,6 +109,27 @@ class DataFrame:
 
     def group_by(self, *keys: Union[str, Expression]) -> GroupedData:
         return GroupedData(self, [_as_expr(k) for k in keys])
+
+    def map_in_pandas(self, fn, schema) -> "DataFrame":
+        """fn(iterator of pd.DataFrame) -> iterator of pd.DataFrame with
+        `schema` (Spark's DataFrame.mapInPandas; GpuMapInPandasExec).
+        Input re-chunks to spark.rapids.sql.batchSizeRows."""
+        from .udf.pandas_execs import CpuMapInPandasExec
+        return DataFrame(self.session, CpuMapInPandasExec(
+            fn, _as_schema(schema), self.plan,
+            conf=getattr(self.session, "conf", None)))
+
+    def window_in_pandas(self, partition_by=(), **named) -> "DataFrame":
+        """Each kwarg is (fn, return_type, *arg_columns); fn(*pd.Series)
+        -> scalar broadcast over its partition (Spark's windowInPandas
+        with an unbounded frame; GpuWindowInPandasExecBase)."""
+        from .udf.pandas_execs import CpuWindowInPandasExec, PandasAgg
+        keys = [partition_by] if isinstance(partition_by, str) \
+            else list(partition_by)
+        aggs = [PandasAgg(name, spec[0], spec[1], list(spec[2:]))
+                for name, spec in named.items()]
+        return DataFrame(self.session, CpuWindowInPandasExec(
+            keys, aggs, self.plan))
 
     def agg(self, **named_aggs: AggregateFunction) -> "DataFrame":
         return GroupedData(self, []).agg(**named_aggs)

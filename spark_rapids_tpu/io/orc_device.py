@@ -773,6 +773,33 @@ def _int_values_device(cs: _ColStreams, ndef: int, signed: bool):
     return _rlev2_device_from_buf(data, ndef, signed)
 
 
+def _byte_runs_device(runs, cap: int, as_bits: bool):
+    import jax.numpy as jnp
+    arrs = [jnp.asarray(a) for a in runs]
+    fn = _expand_present_device if as_bits else _expand_bytes_device
+    return fn(*arrs, cap)
+
+
+def _fixed_column(vals, dt, defined, cap: int, out_dtype=None):
+    """Shared tail for every fixed-width branch: pad the dense non-null
+    value vector to cap, scatter to row slots by null rank, wrap."""
+    import jax.numpy as jnp
+    from ..columnar.column import Column
+    if vals.shape[0] < cap:
+        vals = jnp.pad(vals, (0, cap - vals.shape[0]))
+    data, validity = _scatter_values(vals[:cap], defined)
+    if out_dtype is not None and data.dtype != out_dtype:
+        data = data.astype(out_dtype)
+    return Column(dt, data, validity)
+
+
+def _require_data(cs: _ColStreams) -> bytes:
+    raw = cs.streams.get(_S_DATA)
+    if raw is None:
+        raise DeviceDecodeUnsupported("missing DATA stream")
+    return raw
+
+
 def decode_stripe(info: OrcFileInfo, f, si: int, schema):
     """Decode ONE stripe on the TPU -> (device ColumnarBatch, row count).
     Encoding surprises the footer can't reveal (RLEv1 integer runs,
@@ -781,7 +808,6 @@ def decode_stripe(info: OrcFileInfo, f, si: int, schema):
     the parquet path's per-row-group discipline."""
     import jax.numpy as jnp
     from ..columnar.batch import ColumnarBatch
-    from ..columnar.column import Column
     from ..columnar.padding import width_bucket
     from ..config import get_default_conf
 
@@ -798,59 +824,37 @@ def decode_stripe(info: OrcFileInfo, f, si: int, schema):
         defined, ndef = _defined_and_count(cs, nrows, cap)
         if kind in (_K_SHORT, _K_INT, _K_LONG, _K_DATE):
             vals = _int_values_device(cs, ndef, signed=True)
-            if vals.shape[0] < cap:
-                vals = jnp.pad(vals, (0, cap - vals.shape[0]))
-            data, validity = _scatter_values(vals[:cap], defined)
-            out_cols.append(Column(dt, data.astype(dt.np_dtype), validity))
+            out_cols.append(_fixed_column(vals, dt, defined, cap,
+                                          dt.np_dtype))
         elif kind in (_K_FLOAT, _K_DOUBLE):
-            raw = cs.streams.get(_S_DATA)
-            if raw is None:
-                raise DeviceDecodeUnsupported("missing DATA stream")
+            raw = _require_data(cs)
             npdt = np.float32 if kind == _K_FLOAT else np.float64
             try:
                 host = np.frombuffer(raw, npdt, count=ndef)
             except ValueError as e:
                 raise DeviceDecodeUnsupported(
                     f"short float stream: {e}") from e
-            vals = jnp.asarray(host)
-            if vals.shape[0] < cap:
-                vals = jnp.pad(vals, (0, cap - vals.shape[0]))
-            data, validity = _scatter_values(vals[:cap], defined)
-            out_cols.append(Column(dt, data.astype(dt.np_dtype), validity))
+            out_cols.append(_fixed_column(jnp.asarray(host), dt, defined,
+                                          cap, dt.np_dtype))
         elif kind == _K_BOOLEAN:
-            raw = cs.streams.get(_S_DATA)
-            if raw is None:
-                raise DeviceDecodeUnsupported("missing DATA stream")
+            raw = _require_data(cs)
             if ndef == 0:
                 vals = jnp.zeros(1, bool)
             else:
                 runs = _byte_rle_runs(raw, (ndef + 7) // 8)
-                bits = _expand_present_device(
-                    jnp.asarray(runs[0]), jnp.asarray(runs[1]),
-                    jnp.asarray(runs[2]), jnp.asarray(runs[3]),
-                    jnp.asarray(runs[4]), row_bucket(ndef))
-                vals = bits[:ndef]
-            if vals.shape[0] < cap:
-                vals = jnp.pad(vals, (0, cap - vals.shape[0]))
-            data, validity = _scatter_values(vals[:cap], defined)
-            out_cols.append(Column(dt, data, validity))
+                vals = _byte_runs_device(runs, row_bucket(ndef),
+                                         as_bits=True)[:ndef]
+            out_cols.append(_fixed_column(vals, dt, defined, cap))
         elif kind == _K_BYTE:
-            raw = cs.streams.get(_S_DATA)
-            if raw is None:
-                raise DeviceDecodeUnsupported("missing DATA stream")
+            raw = _require_data(cs)
             if ndef == 0:
                 vals = jnp.zeros(1, jnp.uint8)
             else:
                 runs = _byte_rle_runs(raw, ndef)
-                vals = _expand_bytes_device(
-                    jnp.asarray(runs[0]), jnp.asarray(runs[1]),
-                    jnp.asarray(runs[2]), jnp.asarray(runs[3]),
-                    jnp.asarray(runs[4]), row_bucket(ndef))
-                vals = jnp.asarray(vals, jnp.uint8)[:ndef]
-            if vals.shape[0] < cap:
-                vals = jnp.pad(vals, (0, cap - vals.shape[0]))
-            data, validity = _scatter_values(vals[:cap], defined)
-            out_cols.append(Column(dt, data.astype(jnp.int8), validity))
+                vals = _byte_runs_device(runs, row_bucket(ndef),
+                                         as_bits=False)[:ndef]
+            out_cols.append(_fixed_column(vals, dt, defined, cap,
+                                          jnp.int8))
         elif kind in (_K_STRING, _K_VARCHAR, _K_CHAR):
             out_cols.append(_assemble_strings_orc(
                 cs, dt, defined, ndef, cap, width_bucket,

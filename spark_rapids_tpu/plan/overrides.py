@@ -1089,8 +1089,63 @@ def _do_register_cache():
 _register_cache_rule = _lazy_rule_group(
     "spark_rapids_tpu.datasources.cache", "CpuCachedExec", _do_register_cache)
 
+
+def _c_map_in_pandas(plan, children, conf):
+    from ..udf.pandas_execs import TpuMapInPandasExec
+    return TpuMapInPandasExec(plan, children[0], conf)
+
+
+def _c_flat_map_groups(plan, children, conf):
+    from ..udf.pandas_execs import TpuFlatMapGroupsInPandasExec
+    return TpuFlatMapGroupsInPandasExec(plan, children[0], conf)
+
+
+def _c_agg_in_pandas(plan, children, conf):
+    from ..udf.pandas_execs import TpuAggregateInPandasExec
+    return TpuAggregateInPandasExec(plan, children[0], conf)
+
+
+def _c_window_in_pandas(plan, children, conf):
+    from ..udf.pandas_execs import TpuWindowInPandasExec
+    return TpuWindowInPandasExec(plan, children[0], conf)
+
+
+def _c_cogroups_in_pandas(plan, children, conf):
+    from ..udf.pandas_execs import TpuCoGroupsInPandasExec
+    return TpuCoGroupsInPandasExec(plan, children[0], children[1], conf)
+
+
+def _do_register_pandas_execs():
+    from ..udf.pandas_execs import (CpuAggregateInPandasExec,
+                                    CpuCoGroupsInPandasExec,
+                                    CpuFlatMapGroupsInPandasExec,
+                                    CpuMapInPandasExec,
+                                    CpuWindowInPandasExec)
+    sig = TypeSig.all_basic()
+    exec_rule(CpuMapInPandasExec, sig, _c_map_in_pandas,
+              doc="Enable TPU execution of mapInPandas "
+                  "(GpuMapInPandasExec analog).")
+    exec_rule(CpuFlatMapGroupsInPandasExec, sig, _c_flat_map_groups,
+              doc="Enable TPU execution of grouped applyInPandas "
+                  "(GpuFlatMapGroupsInPandasExec analog).")
+    exec_rule(CpuAggregateInPandasExec, sig, _c_agg_in_pandas,
+              doc="Enable TPU execution of grouped pandas-UDF aggregation "
+                  "(GpuAggregateInPandasExec analog).")
+    exec_rule(CpuWindowInPandasExec, sig, _c_window_in_pandas,
+              doc="Enable TPU execution of windowInPandas "
+                  "(GpuWindowInPandasExecBase analog).")
+    exec_rule(CpuCoGroupsInPandasExec, sig, _c_cogroups_in_pandas,
+              doc="Enable TPU execution of cogrouped applyInPandas "
+                  "(GpuFlatMapCoGroupsInPandasExec analog).")
+
+
+_register_pandas_exec_rules = _lazy_rule_group(
+    "spark_rapids_tpu.udf.pandas_execs", "CpuMapInPandasExec",
+    _do_register_pandas_execs)
+
 _register_cache_rule()
 _register_file_scan_rules()
+_register_pandas_exec_rules()
 
 
 # ----------------------------------------------------------------------------
@@ -1135,6 +1190,7 @@ class Overrides:
         the full tagging picture first."""
         _register_file_scan_rules()  # lazy retry if module import was cyclic
         _register_cache_rule()
+        _register_pandas_exec_rules()
         rule = _EXEC_RULES.get(type(plan))
         meta = PlanMeta(plan, self.conf, rule)
         for c in plan.children:

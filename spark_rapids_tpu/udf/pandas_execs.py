@@ -1,0 +1,554 @@
+"""Python-UDF exec variants over the Arrow bridge (round-3 verdict #7).
+
+Reference counterparts (~4k LoC across `GpuMapInPandasExec.scala`,
+`GpuFlatMapGroupsInPandasExec.scala`, `GpuAggregateInPandasExec.scala`,
+`GpuWindowInPandasExecBase.scala`, `GpuFlatMapCoGroupsInPandasExec.scala`,
+`python/rapids/daemon.py`): the reference moves GPU batches over Arrow IPC
+into forked python workers and back, with PythonWorkerSemaphore bounding
+worker concurrency. This framework is already python, so the "worker hop"
+is the device->host Arrow boundary around the user function, bounded by
+the same PythonWorkerSemaphore; the device side does everything around it
+(scan, projection, padding, downstream ops).
+
+Five variants, each a CPU plan node (independent oracle path, pandas
+mechanics) + a TPU exec (device batches -> Arrow -> pandas -> device):
+
+  * MapInPandas         fn(iter[pd.DataFrame]) -> iter[pd.DataFrame];
+                        input re-chunked to batchSizeRows so the UDF sees
+                        the same roundoff the reference's
+                        maxRecordsPerBatch produces
+  * FlatMapGroupsInPandas (applyInPandas) fn(group_df) -> df per group
+  * AggregateInPandas   fn(*series) -> scalar, one output row per group
+  * WindowInPandas      fn(*series) -> scalar broadcast over its
+                        UNBOUNDED partition frame (the common
+                        windowInPandas shape)
+  * CoGroupsInPandas    fn(left_df, right_df) -> df per key co-group
+
+Group iteration is key-sorted on BOTH engines — Spark leaves group order
+unspecified, so the deterministic order is a free choice that makes the
+differential harness exact."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterator, List, Sequence, Tuple
+
+import numpy as np
+
+from .. import types as T
+from ..columnar.batch import Schema
+from ..plan.nodes import PhysicalPlan
+from .pandas_udf import PythonWorkerSemaphore
+
+__all__ = [
+    "CpuMapInPandasExec", "TpuMapInPandasExec",
+    "CpuFlatMapGroupsInPandasExec", "TpuFlatMapGroupsInPandasExec",
+    "CpuAggregateInPandasExec", "TpuAggregateInPandasExec",
+    "CpuWindowInPandasExec", "TpuWindowInPandasExec",
+    "CpuCoGroupsInPandasExec", "TpuCoGroupsInPandasExec",
+    "PandasAgg",
+]
+
+
+class PandasAgg:
+    """One named pandas aggregation: fn(*pd.Series) -> scalar."""
+
+    def __init__(self, name: str, fn: Callable, return_type: T.DataType,
+                 arg_cols: Sequence[str]):
+        self.name = name
+        self.fn = fn
+        self.return_type = return_type
+        self.arg_cols = list(arg_cols)
+
+
+# ----------------------------------------------------------------------------
+# Shared host mechanics
+# ----------------------------------------------------------------------------
+
+def _hb_to_pandas(hb):
+    from ..cpu.hostbatch import host_batch_to_arrow
+    return host_batch_to_arrow(hb).to_pandas()
+
+
+def _pandas_to_hb(df, schema: Schema):
+    import pyarrow as pa
+    from ..cpu.hostbatch import host_batch_from_arrow
+    table = pa.Table.from_pandas(df, schema=schema.to_arrow(),
+                                 preserve_index=False)
+    return host_batch_from_arrow(table)
+
+
+def _pandas_to_device(df, schema: Schema):
+    import pyarrow as pa
+    from ..columnar.batch import batch_from_arrow
+    table = pa.Table.from_pandas(df, schema=schema.to_arrow(),
+                                 preserve_index=False)
+    return batch_from_arrow(table), table.num_rows
+
+
+def _device_to_pandas(batch):
+    from ..columnar.batch import batch_to_arrow
+    return batch_to_arrow(batch).to_pandas()
+
+
+def _chunks(df, max_rows: int):
+    if len(df) <= max_rows:
+        yield df
+        return
+    for lo in range(0, len(df), max_rows):
+        yield df.iloc[lo:lo + max_rows]
+
+
+def _sorted_groups(df, keys: List[str]):
+    """Yield (key_df_one_row, group_df) in key-sorted order (deterministic
+    on both engines; Spark does not pin an order)."""
+    grouped = df.groupby(keys, sort=True, dropna=False)
+    for _, g in grouped:
+        yield g
+
+
+def _check_output_columns(df, schema: Schema, what: str):
+    missing = [c for c in schema.names if c not in df.columns]
+    if missing:
+        raise ValueError(f"{what} result is missing declared output "
+                         f"columns {missing}")
+    return df[list(schema.names)]
+
+
+# ----------------------------------------------------------------------------
+# mapInPandas
+# ----------------------------------------------------------------------------
+
+class CpuMapInPandasExec(PhysicalPlan):
+    """fn(iterator of pd.DataFrame) -> iterator of pd.DataFrame
+    (`GpuMapInPandasExec.scala:1`; output row count is unconstrained)."""
+
+    def __init__(self, fn: Callable, schema: Schema, child: PhysicalPlan,
+                 conf=None):
+        super().__init__([child])
+        self.fn = fn
+        self._schema = schema
+        self._conf = conf
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def _input_frames(self, max_rows: int):
+        for hb in self.children[0].execute_cpu():
+            yield from _chunks(_hb_to_pandas(hb), max_rows)
+
+    def execute_cpu(self):
+        from ..config import get_default_conf
+        conf = self._conf or get_default_conf()
+        max_rows = conf.get("spark.rapids.sql.batchSizeRows")
+        with PythonWorkerSemaphore.get():
+            for out in self.fn(self._input_frames(max_rows)):
+                if len(out):
+                    yield _pandas_to_hb(
+                        _check_output_columns(out, self._schema,
+                                              "mapInPandas"), self._schema)
+
+    def _arg_string(self):
+        return f"[{getattr(self.fn, '__name__', '<fn>')}]"
+
+
+from ..exec.base import TpuExec as _TpuExec  # noqa: E402
+
+
+class TpuMapInPandasExec(_TpuExec):
+    """Device batches stream to host Arrow, through the user iterator fn,
+    and back to device — the python-worker hop of the reference with the
+    IPC pipe collapsed to the D2H/H2D boundary."""
+
+    def __init__(self, plan: CpuMapInPandasExec, child, conf):
+        super().__init__([child], conf)
+        self.fn = plan.fn
+        self._schema = plan.output
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def _input_frames(self):
+        max_rows = self.conf.get("spark.rapids.sql.batchSizeRows")
+        for batch in self.children[0].execute():
+            yield from _chunks(_device_to_pandas(batch), max_rows)
+
+    def do_execute(self):
+        with PythonWorkerSemaphore.get(
+                self.conf.get("spark.rapids.sql.concurrentGpuTasks")):
+            for out in self.fn(self._input_frames()):
+                if not len(out):
+                    continue
+                b, nrows = _pandas_to_device(
+                    _check_output_columns(out, self._schema,
+                                          "mapInPandas"), self._schema)
+                self.num_output_rows.add(nrows)
+                yield self._count_output(b)
+
+
+# ----------------------------------------------------------------------------
+# flatMapGroupsInPandas (applyInPandas)
+# ----------------------------------------------------------------------------
+
+class CpuFlatMapGroupsInPandasExec(PhysicalPlan):
+    """fn(one group's pd.DataFrame) -> pd.DataFrame
+    (`GpuFlatMapGroupsInPandasExec.scala:1`). The whole child input is
+    materialized to group (same as the reference's requirement that a
+    group fits in one batch)."""
+
+    def __init__(self, keys: Sequence[str], fn: Callable, schema: Schema,
+                 child: PhysicalPlan):
+        super().__init__([child])
+        self.keys = list(keys)
+        self.fn = fn
+        self._schema = schema
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def execute_cpu(self):
+        import pandas as pd
+        frames = [_hb_to_pandas(hb)
+                  for hb in self.children[0].execute_cpu()]
+        if not frames:
+            return
+        df = pd.concat(frames, ignore_index=True)
+        with PythonWorkerSemaphore.get():
+            for g in _sorted_groups(df, self.keys):
+                out = self.fn(g.reset_index(drop=True))
+                if len(out):
+                    yield _pandas_to_hb(
+                        _check_output_columns(out, self._schema,
+                                              "applyInPandas"),
+                        self._schema)
+
+    def _arg_string(self):
+        return f"[{self.keys}, {getattr(self.fn, '__name__', '<fn>')}]"
+
+
+class TpuFlatMapGroupsInPandasExec(_TpuExec):
+    def __init__(self, plan: CpuFlatMapGroupsInPandasExec, child, conf):
+        super().__init__([child], conf)
+        self.keys = plan.keys
+        self.fn = plan.fn
+        self._schema = plan.output
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def do_execute(self):
+        import pandas as pd
+        frames = [_device_to_pandas(b) for b in self.children[0].execute()]
+        frames = [f for f in frames if len(f)]
+        if not frames:
+            return
+        df = pd.concat(frames, ignore_index=True)
+        with PythonWorkerSemaphore.get(
+                self.conf.get("spark.rapids.sql.concurrentGpuTasks")):
+            outs = []
+            for g in _sorted_groups(df, self.keys):
+                out = self.fn(g.reset_index(drop=True))
+                if len(out):
+                    outs.append(_check_output_columns(
+                        out, self._schema, "applyInPandas"))
+        if not outs:
+            return
+        # one H2D per input batch worth of results, not one per group
+        b, nrows = _pandas_to_device(
+            pd.concat(outs, ignore_index=True), self._schema)
+        self.num_output_rows.add(nrows)
+        yield self._count_output(b)
+
+
+# ----------------------------------------------------------------------------
+# aggregateInPandas
+# ----------------------------------------------------------------------------
+
+def _agg_output_schema(keys: List[str], child_schema: Schema,
+                       aggs: Sequence[PandasAgg]) -> Schema:
+    names: List[str] = []
+    dts: List[T.DataType] = []
+    for k in keys:
+        names.append(k)
+        dts.append(child_schema.types[child_schema.index_of(k)])
+    for a in aggs:
+        names.append(a.name)
+        dts.append(a.return_type)
+    return Schema(tuple(names), tuple(dts))
+
+
+def _run_pandas_aggs(df, keys: List[str], aggs: Sequence[PandasAgg],
+                     schema: Schema):
+    """Shared grouped-agg mechanics: one output row per key group."""
+    import pandas as pd
+    rows: Dict[str, list] = {n: [] for n in schema.names}
+    for g in _sorted_groups(df, keys):
+        for k in keys:
+            rows[k].append(g[k].iloc[0])
+        for a in aggs:
+            rows[a.name].append(a.fn(*[g[c].reset_index(drop=True)
+                                       for c in a.arg_cols]))
+    return pd.DataFrame(rows, columns=list(schema.names))
+
+
+class CpuAggregateInPandasExec(PhysicalPlan):
+    """Grouped SERIES->SCALAR pandas UDF aggregation
+    (`GpuAggregateInPandasExec.scala:1`): output = keys + one value per
+    agg per group."""
+
+    def __init__(self, keys: Sequence[str], aggs: Sequence[PandasAgg],
+                 child: PhysicalPlan):
+        super().__init__([child])
+        self.keys = list(keys)
+        self.aggs = list(aggs)
+        self._schema = _agg_output_schema(self.keys, child.output,
+                                          self.aggs)
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def execute_cpu(self):
+        import pandas as pd
+        frames = [_hb_to_pandas(hb)
+                  for hb in self.children[0].execute_cpu()]
+        if not frames:
+            return
+        df = pd.concat(frames, ignore_index=True)
+        with PythonWorkerSemaphore.get():
+            out = _run_pandas_aggs(df, self.keys, self.aggs, self._schema)
+        if len(out):
+            yield _pandas_to_hb(out, self._schema)
+
+    def _arg_string(self):
+        return f"[{self.keys}, {[a.name for a in self.aggs]}]"
+
+
+class TpuAggregateInPandasExec(_TpuExec):
+    def __init__(self, plan: CpuAggregateInPandasExec, child, conf):
+        super().__init__([child], conf)
+        self.keys = plan.keys
+        self.aggs = plan.aggs
+        self._schema = plan.output
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def do_execute(self):
+        import pandas as pd
+        frames = [_device_to_pandas(b) for b in self.children[0].execute()]
+        frames = [f for f in frames if len(f)]
+        if not frames:
+            return
+        df = pd.concat(frames, ignore_index=True)
+        with PythonWorkerSemaphore.get(
+                self.conf.get("spark.rapids.sql.concurrentGpuTasks")):
+            out = _run_pandas_aggs(df, self.keys, self.aggs, self._schema)
+        if not len(out):
+            return
+        b, nrows = _pandas_to_device(out, self._schema)
+        self.num_output_rows.add(nrows)
+        yield self._count_output(b)
+
+
+# ----------------------------------------------------------------------------
+# windowInPandas (unbounded partition frame)
+# ----------------------------------------------------------------------------
+
+def _window_output_schema(child_schema: Schema,
+                          aggs: Sequence[PandasAgg]) -> Schema:
+    return Schema(child_schema.names + tuple(a.name for a in aggs),
+                  child_schema.types + tuple(a.return_type for a in aggs))
+
+
+def _run_pandas_window(df, keys: List[str], aggs: Sequence[PandasAgg]):
+    """Each agg computes one scalar per partition, broadcast to the
+    partition's rows (the UNBOUNDED-to-UNBOUNDED frame windowInPandas
+    shape)."""
+    for a in aggs:
+        if keys:
+            vals = df.groupby(keys, sort=False, dropna=False)[
+                a.arg_cols].apply(
+                lambda g, a=a: a.fn(*[g[c].reset_index(drop=True)
+                                      for c in a.arg_cols]))
+            merged = df[keys].merge(vals.rename(a.name), left_on=keys,
+                                    right_index=True, how="left")
+            df[a.name] = merged[a.name].to_numpy()
+        else:
+            df[a.name] = a.fn(*[df[c].reset_index(drop=True)
+                                for c in a.arg_cols])
+    return df
+
+
+class CpuWindowInPandasExec(PhysicalPlan):
+    """`GpuWindowInPandasExecBase.scala:1`: pandas UDF evaluated once per
+    partition, result broadcast over the partition rows; child columns
+    pass through."""
+
+    def __init__(self, keys: Sequence[str], aggs: Sequence[PandasAgg],
+                 child: PhysicalPlan):
+        super().__init__([child])
+        self.keys = list(keys)
+        self.aggs = list(aggs)
+        self._schema = _window_output_schema(child.output, self.aggs)
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def execute_cpu(self):
+        import pandas as pd
+        frames = [_hb_to_pandas(hb)
+                  for hb in self.children[0].execute_cpu()]
+        if not frames:
+            return
+        df = pd.concat(frames, ignore_index=True)
+        with PythonWorkerSemaphore.get():
+            out = _run_pandas_window(df, self.keys, self.aggs)
+        if len(out):
+            yield _pandas_to_hb(out[list(self._schema.names)],
+                                self._schema)
+
+    def _arg_string(self):
+        return f"[{self.keys}, {[a.name for a in self.aggs]}]"
+
+
+class TpuWindowInPandasExec(_TpuExec):
+    def __init__(self, plan: CpuWindowInPandasExec, child, conf):
+        super().__init__([child], conf)
+        self.keys = plan.keys
+        self.aggs = plan.aggs
+        self._schema = plan.output
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def do_execute(self):
+        import pandas as pd
+        frames = [_device_to_pandas(b) for b in self.children[0].execute()]
+        frames = [f for f in frames if len(f)]
+        if not frames:
+            return
+        df = pd.concat(frames, ignore_index=True)
+        with PythonWorkerSemaphore.get(
+                self.conf.get("spark.rapids.sql.concurrentGpuTasks")):
+            out = _run_pandas_window(df, self.keys, self.aggs)
+        if not len(out):
+            return
+        b, nrows = _pandas_to_device(out[list(self._schema.names)],
+                                     self._schema)
+        self.num_output_rows.add(nrows)
+        yield self._count_output(b)
+
+
+# ----------------------------------------------------------------------------
+# cogrouped applyInPandas
+# ----------------------------------------------------------------------------
+
+class CpuCoGroupsInPandasExec(PhysicalPlan):
+    """fn(left_group_df, right_group_df) -> pd.DataFrame per co-group over
+    the UNION of both sides' key values
+    (`GpuFlatMapCoGroupsInPandasExec.scala:1`); a side with no rows for a
+    key contributes an empty frame with its full schema."""
+
+    def __init__(self, left_keys: Sequence[str], right_keys: Sequence[str],
+                 fn: Callable, schema: Schema, left: PhysicalPlan,
+                 right: PhysicalPlan):
+        super().__init__([left, right])
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.fn = fn
+        self._schema = schema
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def _cogroups(self, ldf, rdf):
+        def canon(key):
+            """Null keys group together (Spark grouping semantics): NaN
+            never equals NaN, so normalize every missing value to None
+            before the two sides' key sets are unioned."""
+            def c1(x):
+                return None if x is None or x != x else x
+            return tuple(c1(x) for x in key) if isinstance(key, tuple) \
+                else c1(key)
+
+        lg = {canon(k): g for k, g in ldf.groupby(
+            self.left_keys, sort=True, dropna=False)}
+        rg = {canon(k): g for k, g in rdf.groupby(
+            self.right_keys, sort=True, dropna=False)}
+        for key in sorted(set(lg) | set(rg), key=repr):
+            lpart = lg.get(key)
+            rpart = rg.get(key)
+            if lpart is None:
+                lpart = ldf.iloc[0:0]
+            if rpart is None:
+                rpart = rdf.iloc[0:0]
+            yield (lpart.reset_index(drop=True),
+                   rpart.reset_index(drop=True))
+
+    def execute_cpu(self):
+        import pandas as pd
+        lf = [_hb_to_pandas(hb) for hb in self.children[0].execute_cpu()]
+        rf = [_hb_to_pandas(hb) for hb in self.children[1].execute_cpu()]
+        ldf = pd.concat(lf, ignore_index=True) if lf else \
+            _empty_frame(self.children[0].output)
+        rdf = pd.concat(rf, ignore_index=True) if rf else \
+            _empty_frame(self.children[1].output)
+        with PythonWorkerSemaphore.get():
+            for lpart, rpart in self._cogroups(ldf, rdf):
+                out = self.fn(lpart, rpart)
+                if len(out):
+                    yield _pandas_to_hb(
+                        _check_output_columns(out, self._schema,
+                                              "cogrouped applyInPandas"),
+                        self._schema)
+
+    def _arg_string(self):
+        return f"[{self.left_keys}|{self.right_keys}]"
+
+
+def _empty_frame(schema: Schema):
+    return schema.to_arrow().empty_table().to_pandas()
+
+
+class TpuCoGroupsInPandasExec(_TpuExec):
+    def __init__(self, plan: CpuCoGroupsInPandasExec, left, right, conf):
+        super().__init__([left, right], conf)
+        self.plan = plan
+        self._schema = plan.output
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def do_execute(self):
+        import pandas as pd
+        lf = [_device_to_pandas(b) for b in self.children[0].execute()]
+        rf = [_device_to_pandas(b) for b in self.children[1].execute()]
+        lf = [f for f in lf if len(f)]
+        rf = [f for f in rf if len(f)]
+        ldf = pd.concat(lf, ignore_index=True) if lf else \
+            _empty_frame(self.plan.children[0].output)
+        rdf = pd.concat(rf, ignore_index=True) if rf else \
+            _empty_frame(self.plan.children[1].output)
+        outs = []
+        with PythonWorkerSemaphore.get(
+                self.conf.get("spark.rapids.sql.concurrentGpuTasks")):
+            for lpart, rpart in self.plan._cogroups(ldf, rdf):
+                out = self.plan.fn(lpart, rpart)
+                if len(out):
+                    outs.append(_check_output_columns(
+                        out, self._schema, "cogrouped applyInPandas"))
+        if not outs:
+            return
+        b, nrows = _pandas_to_device(
+            pd.concat(outs, ignore_index=True), self._schema)
+        self.num_output_rows.add(nrows)
+        yield self._count_output(b)

@@ -26,7 +26,11 @@ __all__ = ["PandasUDF", "pandas_udf", "PythonWorkerSemaphore"]
 
 class PythonWorkerSemaphore:
     """Bounds concurrent python UDF evaluations (PythonWorkerSemaphore.scala:
-    limits how many workers share the device)."""
+    limits how many workers share the device). REENTRANT per thread: a
+    task already holding a permit re-enters freely, so stacked pandas
+    execs (map_in_pandas over map_in_pandas, a scalar PandasUDF inside a
+    grouped fn) pulling their child iterators inside the outer's permit
+    cannot deadlock — the nesting is one task, one worker."""
 
     _instance: Optional["PythonWorkerSemaphore"] = None
     _lock = threading.Lock()
@@ -34,6 +38,7 @@ class PythonWorkerSemaphore:
     def __init__(self, permits: int):
         self._sem = threading.Semaphore(permits)
         self.permits = permits
+        self._tls = threading.local()
 
     @classmethod
     def get(cls, permits: Optional[int] = None) -> "PythonWorkerSemaphore":
@@ -48,11 +53,16 @@ class PythonWorkerSemaphore:
             return cls._instance
 
     def __enter__(self):
-        self._sem.acquire()
+        depth = getattr(self._tls, "depth", 0)
+        if depth == 0:
+            self._sem.acquire()
+        self._tls.depth = depth + 1
         return self
 
     def __exit__(self, *exc):
-        self._sem.release()
+        self._tls.depth -= 1
+        if self._tls.depth == 0:
+            self._sem.release()
 
 
 class PandasUDF(Expression):

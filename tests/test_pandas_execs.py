@@ -1,0 +1,310 @@
+"""Python-UDF exec variants (udf/pandas_execs.py): mapInPandas, grouped
+applyInPandas, pandas-UDF aggregation, windowInPandas, cogrouped
+applyInPandas — differential device-vs-CPU plus independent pandas
+oracles computed in the tests (reference `GpuMapInPandasExec.scala`,
+`GpuFlatMapGroupsInPandasExec.scala`, `GpuAggregateInPandasExec.scala`,
+`GpuWindowInPandasExecBase.scala`,
+`GpuFlatMapCoGroupsInPandasExec.scala`)."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.plugin import TpuSession
+
+from test_queries import assert_same
+
+
+@pytest.fixture()
+def session():
+    return TpuSession({"spark.rapids.sql.enabled": True,
+                       "spark.rapids.sql.explain": "NONE"})
+
+
+def make_table(rng, n=4000):
+    keys = rng.integers(0, 23, n).astype(np.int64)
+    return pa.table({
+        "k": pa.array(keys),
+        "v": pa.array(rng.normal(size=n)),
+        "w": pa.array(rng.uniform(0.1, 1.0, n)),
+        "s": pa.array([f"g{k % 5}" for k in keys]),
+    })
+
+
+OUT_SCHEMA = [("k", T.LongType()), ("doubled", T.DoubleType())]
+
+
+class TestMapInPandas:
+    def test_row_preserving_fn(self, session, rng):
+        t = make_table(rng)
+
+        def doubler(frames):
+            for f in frames:
+                yield pd.DataFrame({"k": f["k"], "doubled": f["v"] * 2})
+
+        df = session.from_arrow(t).map_in_pandas(doubler, OUT_SCHEMA)
+        assert_same(df, sort_by=["k", "doubled"], approx_cols=("doubled",))
+        # independent oracle
+        got = df.collect().sort_by([("k", "ascending"),
+                                    ("doubled", "ascending")])
+        exp = pd.DataFrame({"k": t.column("k").to_numpy(),
+                            "doubled": t.column("v").to_numpy() * 2}) \
+            .sort_values(["k", "doubled"])
+        assert np.allclose(got.column("doubled").to_numpy(),
+                           exp["doubled"].to_numpy())
+
+    def test_row_count_changing_fn(self, session, rng):
+        t = make_table(rng)
+
+        def filter_expand(frames):
+            for f in frames:
+                kept = f[f["v"] > 0.5]
+                out = pd.DataFrame({"k": np.repeat(kept["k"].to_numpy(), 2),
+                                    "doubled": np.repeat(
+                                        kept["v"].to_numpy(), 2)})
+                yield out
+
+        df = session.from_arrow(t).map_in_pandas(filter_expand, OUT_SCHEMA)
+        got = df.collect()
+        exp_n = 2 * int((t.column("v").to_numpy() > 0.5).sum())
+        assert got.num_rows == exp_n
+        assert_same(df, sort_by=["k", "doubled"], approx_cols=("doubled",))
+
+    def test_batch_size_roundoff(self, rng):
+        """With batchSizeRows=300 over 1000 rows the UDF iterator must see
+        ceil-chunked frames never larger than the limit, and the tail
+        chunk carries the roundoff."""
+        sess = TpuSession({"spark.rapids.sql.enabled": True,
+                           "spark.rapids.sql.batchSizeRows": 300,
+                           "spark.rapids.sql.explain": "NONE"})
+        t = pa.table({"k": pa.array(np.arange(1000, dtype=np.int64)),
+                      "v": pa.array(rng.normal(size=1000)),
+                      "w": pa.array(np.ones(1000)),
+                      "s": pa.array(["x"] * 1000)})
+        sizes = []
+
+        def spy(frames):
+            for f in frames:
+                sizes.append(len(f))
+                yield pd.DataFrame({"k": f["k"], "doubled": f["v"]})
+
+        got = sess.from_arrow(t).map_in_pandas(spy, OUT_SCHEMA).collect()
+        assert got.num_rows == 1000
+        assert max(sizes) <= 300
+        assert sum(sizes) == 1000
+        assert any(s == 100 for s in sizes)  # the roundoff tail
+
+    def test_empty_input(self, session):
+        t = pa.table({"k": pa.array([], pa.int64()),
+                      "v": pa.array([], pa.float64()),
+                      "w": pa.array([], pa.float64()),
+                      "s": pa.array([], pa.string())})
+
+        def ident(frames):
+            for f in frames:
+                yield pd.DataFrame({"k": f["k"], "doubled": f["v"]})
+
+        assert session.from_arrow(t).map_in_pandas(
+            ident, OUT_SCHEMA).collect().num_rows == 0
+
+    def test_missing_output_column_raises(self, session, rng):
+        t = make_table(rng, n=100)
+
+        def bad(frames):
+            for f in frames:
+                yield pd.DataFrame({"k": f["k"]})  # no "doubled"
+
+        with pytest.raises((ValueError, RuntimeError),
+                           match="missing declared output"):
+            session.from_arrow(t).map_in_pandas(bad, OUT_SCHEMA).collect()
+
+
+class TestSemaphoreReentrancy:
+    def test_nested_map_in_pandas_one_permit(self, rng):
+        """Stacked pandas execs pull their child iterator while holding
+        the worker permit; with ONE permit this deadlocks unless the
+        semaphore is reentrant per thread."""
+        sess = TpuSession({"spark.rapids.sql.enabled": True,
+                           "spark.rapids.sql.concurrentGpuTasks": 1,
+                           "spark.rapids.sql.explain": "NONE"})
+        t = make_table(rng, n=200)
+
+        def double(frames):
+            for f in frames:
+                yield pd.DataFrame({"k": f["k"], "doubled": f["v"] * 2})
+
+        def halve(frames):
+            for f in frames:
+                yield pd.DataFrame({"k": f["k"],
+                                    "doubled": f["doubled"] / 2})
+
+        df = sess.from_arrow(t).map_in_pandas(double, OUT_SCHEMA) \
+            .map_in_pandas(halve, OUT_SCHEMA)
+        got = df.collect()
+        assert got.num_rows == 200
+        assert np.allclose(np.sort(got.column("doubled").to_numpy()),
+                           np.sort(t.column("v").to_numpy()))
+
+
+class TestApplyInPandas:
+    def test_group_normalize(self, session, rng):
+        t = make_table(rng)
+
+        def center(g):
+            return pd.DataFrame({"k": g["k"],
+                                 "centered": g["v"] - g["v"].mean()})
+
+        df = session.from_arrow(t).group_by("k").apply_in_pandas(
+            center, [("k", T.LongType()), ("centered", T.DoubleType())])
+        assert_same(df, sort_by=["k", "centered"],
+                    approx_cols=("centered",))
+        # independent oracle: per-group mean via pandas on raw data
+        got = df.collect()
+        pdf = t.to_pandas()
+        exp = pdf.groupby("k")["v"].transform("mean")
+        assert abs(float(np.sort(got.column("centered").to_numpy()).sum()
+                         - np.sort((pdf["v"] - exp).to_numpy()).sum())
+                   ) < 1e-9
+
+    def test_row_count_changing_group_fn(self, session, rng):
+        t = make_table(rng)
+
+        def top2(g):
+            top = g.nlargest(2, "v")
+            return pd.DataFrame({"k": top["k"], "centered": top["v"]})
+
+        df = session.from_arrow(t).group_by("k").apply_in_pandas(
+            top2, [("k", T.LongType()), ("centered", T.DoubleType())])
+        assert_same(df, sort_by=["k", "centered"],
+                    approx_cols=("centered",))
+        assert df.collect().num_rows == 2 * 23
+
+    def test_string_group_keys(self, session, rng):
+        t = make_table(rng)
+
+        def count_rows(g):
+            return pd.DataFrame({"s": [g["s"].iloc[0]], "n": [len(g)]})
+
+        df = session.from_arrow(t).group_by("s").apply_in_pandas(
+            count_rows, [("s", T.StringType()), ("n", T.LongType())])
+        assert_same(df, sort_by=["s"])
+
+
+class TestAggregateInPandas:
+    def test_weighted_mean(self, session, rng):
+        t = make_table(rng)
+
+        def wmean(v, w):
+            return float((v * w).sum() / w.sum())
+
+        df = session.from_arrow(t).group_by("k").agg_in_pandas(
+            wm=(wmean, T.DoubleType(), "v", "w"),
+            n=(lambda v: int(len(v)), T.LongType(), "v"))
+        assert_same(df, sort_by=["k"], approx_cols=("wm",))
+        # independent oracle
+        got = {r["k"]: r for r in df.collect().to_pylist()}
+        pdf = t.to_pandas()
+        for k, g in pdf.groupby("k"):
+            exp = (g["v"] * g["w"]).sum() / g["w"].sum()
+            assert abs(got[k]["wm"] - exp) < 1e-9
+            assert got[k]["n"] == len(g)
+
+
+class TestWindowInPandas:
+    def test_partition_mean_broadcast(self, session, rng):
+        t = make_table(rng)
+
+        def pmean(v):
+            return float(v.mean())
+
+        df = session.from_arrow(t).window_in_pandas(
+            partition_by="k", m=(pmean, T.DoubleType(), "v"))
+        assert_same(df, sort_by=["k", "v"], approx_cols=("m", "v", "w"))
+        # row count must be preserved and every row must carry its
+        # partition's mean
+        got = df.collect().to_pandas()
+        assert len(got) == t.num_rows
+        oracle = got.groupby("k")["v"].transform("mean")
+        assert np.allclose(got["m"], oracle)
+
+    def test_global_window(self, session, rng):
+        t = make_table(rng, n=500)
+        df = session.from_arrow(t).window_in_pandas(
+            m=(lambda v: float(v.max()), T.DoubleType(), "v"))
+        got = df.collect()
+        assert got.num_rows == 500
+        assert np.allclose(got.column("m").to_numpy(),
+                           t.column("v").to_numpy().max())
+
+
+class TestCoGroupsInPandas:
+    def test_asof_style_cogroup(self, session, rng):
+        n = 1000
+        left = pa.table({
+            "k": pa.array(rng.integers(0, 10, n).astype(np.int64)),
+            "v": pa.array(rng.normal(size=n))})
+        right = pa.table({
+            "k": pa.array(rng.integers(3, 13, 200).astype(np.int64)),
+            "adj": pa.array(rng.uniform(size=200))})
+
+        def merge_stats(lg, rg):
+            return pd.DataFrame({
+                "k": [lg["k"].iloc[0] if len(lg) else rg["k"].iloc[0]],
+                "lsum": [float(lg["v"].sum())],
+                "rmean": [float(rg["adj"].mean()) if len(rg)
+                          else float("nan")]})
+
+        out_schema = [("k", T.LongType()), ("lsum", T.DoubleType()),
+                      ("rmean", T.DoubleType())]
+        dfl = session.from_arrow(left).group_by("k")
+        dfr = session.from_arrow(right).group_by("k")
+        df = dfl.cogroup(dfr).apply_in_pandas(merge_stats, out_schema)
+        assert_same(df, sort_by=["k"], approx_cols=("lsum", "rmean"))
+        # keys present on only one side still produce a co-group
+        got = {r["k"] for r in df.collect().to_pylist()}
+        assert got == set(range(0, 13))
+
+    def test_null_keys_form_one_cogroup(self, session):
+        """A null key on both sides is ONE co-group (Spark grouping
+        semantics: null == null for grouping), not two half-empty ones."""
+        left = pa.table({"k": pa.array([1.0, None, None]),
+                         "v": pa.array([10.0, 20.0, 30.0])})
+        right = pa.table({"k": pa.array([None, 2.0]),
+                          "adj": pa.array([5.0, 6.0])})
+
+        def counts(lg, rg):
+            return pd.DataFrame({"ln": [len(lg)], "rn": [len(rg)]})
+
+        df = session.from_arrow(left).group_by("k").cogroup(
+            session.from_arrow(right).group_by("k")).apply_in_pandas(
+            counts, [("ln", T.LongType()), ("rn", T.LongType())])
+        rows = sorted((r["ln"], r["rn"]) for r in df.collect().to_pylist())
+        # co-groups: k=1.0 -> (1, 0); k=2.0 -> (0, 1); k=null -> (2, 1)
+        assert rows == [(0, 1), (1, 0), (2, 1)]
+        assert_same(df, sort_by=["ln", "rn"])
+
+
+class TestCpuPathConfParity:
+    def test_cpu_engine_honors_session_batch_size(self, rng):
+        """The CPU oracle path must chunk mapInPandas input by the SAME
+        session batchSizeRows as the device path, or chunk-sensitive UDFs
+        silently diverge between engines."""
+        sess = TpuSession({"spark.rapids.sql.enabled": True,
+                           "spark.rapids.sql.batchSizeRows": 250,
+                           "spark.rapids.sql.explain": "NONE"})
+        t = pa.table({"k": pa.array(np.arange(1000, dtype=np.int64)),
+                      "v": pa.array(rng.normal(size=1000)),
+                      "w": pa.array(np.ones(1000)),
+                      "s": pa.array(["x"] * 1000)})
+
+        def chunk_sizes(frames):
+            for f in frames:
+                yield pd.DataFrame({"k": f["k"].iloc[:1],
+                                    "doubled": [float(len(f))]})
+
+        df = sess.from_arrow(t).map_in_pandas(chunk_sizes, OUT_SCHEMA)
+        cpu = sorted(r["doubled"] for r in df.collect_cpu().to_pylist())
+        tpu = sorted(r["doubled"] for r in df.collect().to_pylist())
+        assert cpu == tpu == [250.0, 250.0, 250.0, 250.0]
