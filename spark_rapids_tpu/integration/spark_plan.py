@@ -19,7 +19,11 @@ load-bearing part.
 Supported nodes: FileSourceScanExec (parquet), ProjectExec, FilterExec,
 HashAggregateExec (partial/final pairs collapse into one engine
 aggregate), SortMergeJoin/ShuffledHashJoin/BroadcastHashJoinExec,
-SortExec, TakeOrderedAndProjectExec, *LimitExec, ShuffleExchangeExec /
+BroadcastNestedLoopJoinExec, CartesianProductExec, SortExec,
+TakeOrderedAndProjectExec, *LimitExec, UnionExec, RangeExec, ExpandExec,
+GenerateExec (explode/posexplode +outer), WindowExec (rank family,
+lead/lag, nth_value, framed aggregates), DataWritingCommandExec
+(InsertIntoHadoopFsRelationCommand -> write exec), ShuffleExchangeExec /
 AdaptiveSparkPlan / WholeStageCodegen / InputAdapter / ReusedExchange
 (transparent). Unknown nodes raise UnsupportedSparkPlan with the class
 name, mirroring the reference's explain-style honesty."""
@@ -113,8 +117,21 @@ _DEC_RE = re.compile(r"decimal\((\d+),(\d+)\)")
 
 
 def _data_type(s) -> T.DataType:
-    if isinstance(s, dict):  # struct/array/map json form — not needed yet
-        raise UnsupportedSparkPlan(f"nested dataType {s}")
+    if isinstance(s, dict):  # nested types serialize as json objects
+        kind = s.get("type")
+        if kind == "array":
+            return T.ArrayType(_data_type(s.get("elementType")),
+                               bool(s.get("containsNull", True)))
+        if kind == "struct":
+            return T.StructType(tuple(
+                T.StructField(f["name"], _data_type(f["type"]),
+                              bool(f.get("nullable", True)))
+                for f in s.get("fields", [])))
+        if kind == "map":
+            return T.MapType(_data_type(s.get("keyType")),
+                             _data_type(s.get("valueType")),
+                             bool(s.get("valueContainsNull", True)))
+        raise UnsupportedSparkPlan(f"dataType {s}")
     m = _DEC_RE.match(str(s))
     if m:
         return T.DecimalType(int(m.group(1)), int(m.group(2)))
@@ -272,12 +289,212 @@ def _translate(node: _Node, conf, paths: Dict[str, Sequence[str]]
     if c in ("LocalLimitExec", "GlobalLimitExec"):
         child = _translate(node.children[0], conf, paths)
         return N.CpuLimitExec(int(node.fields.get("limit", 0)), child)
+    if c == "UnionExec":
+        return N.CpuUnionExec([_translate(ch, conf, paths)
+                               for ch in node.children])
+    if c == "RangeExec":
+        return N.CpuRangeExec(int(node.fields.get("start", 0)),
+                              int(node.fields.get("end", 0)),
+                              int(node.fields.get("step", 1)))
+    if c in ("BroadcastNestedLoopJoinExec", "CartesianProductExec"):
+        left = _translate(node.children[0], conf, paths)
+        right = _translate(node.children[1], conf, paths)
+        cond = _expr_tree(node.fields.get("condition"))
+        how = _join_type(node.fields.get("joinType", "inner")) \
+            if c == "BroadcastNestedLoopJoinExec" else "cross"
+        if cond is None and how == "inner":
+            how = "cross"
+        return N.CpuHashJoinExec(
+            left, right, [], [], how,
+            condition=None if cond is None else _translate_expr(cond))
+    if c == "ExpandExec":
+        return _expand(node, conf, paths)
+    if c == "GenerateExec":
+        return _generate(node, conf, paths)
+    if c == "WindowExec":
+        return _window(node, conf, paths)
+    if c == "DataWritingCommandExec":
+        return _write_command(node, conf, paths)
     raise UnsupportedSparkPlan(f"plan node {c}")
 
 
-def _sort_orders(node: _Node) -> List[Tuple[Any, bool, bool]]:
+def _expand(node: _Node, conf, paths):
+    """ExpandExec: N projections per input row (rollup/cube lowering)."""
+    child = _translate(node.children[0], conf, paths)
+    projections = []
+    for proj in node.fields.get("projections") or []:
+        projections.append([_translate_expr(e) for e in _expr_list(proj)])
+    names = [e.fields["name"] for e in _expr_list(node.fields.get("output"))
+             if e.cls == "AttributeReference"]
+    if not projections or not names:
+        raise UnsupportedSparkPlan("ExpandExec without projections/output")
+    return N.CpuExpandExec(projections, names, child)
+
+
+def _generate(node: _Node, conf, paths):
+    """GenerateExec: explode/posexplode (+_outer via the `outer` field).
+    The engine appends generator columns after ALL child columns with its
+    own names, so a projection restores Spark's requiredChildOutput +
+    generatorOutput shape and names."""
+    from ..expr import base as EB
+    from ..expr.collections import Explode
+    child = _translate(node.children[0], conf, paths)
+    gen = _expr_tree(node.fields.get("generator"))
+    if gen is None:
+        raise UnsupportedSparkPlan("GenerateExec without generator")
+    position = gen.cls == "PosExplode"
+    if gen.cls not in ("Explode", "PosExplode"):
+        raise UnsupportedSparkPlan(f"generator {gen.cls}")
+    outer = str(node.fields.get("outer", False)).lower() == "true"
+    generator = Explode(_translate_expr(gen.children[0]),
+                        position=position, outer=outer)
+    plan = N.CpuGenerateExec(generator, child)
+    gen_names = [e.fields["name"]
+                 for e in _expr_list(node.fields.get("generatorOutput"))
+                 if e.cls == "AttributeReference"]
+    keep = [e.fields["name"]
+            for e in _expr_list(node.fields.get("requiredChildOutput"))
+            if e.cls == "AttributeReference"]
+    n_child = len(child.output.names)
+    n_gen = len(plan.output.names) - n_child
+    if gen_names and len(gen_names) == n_gen:
+        projs = []
+        for nm in keep:
+            projs.append(EB.AttributeReference(nm))
+        for i, nm in enumerate(gen_names):
+            projs.append(EB.Alias(
+                EB.BoundReference(n_child + i,
+                                  plan.output.types[n_child + i]), nm))
+        return N.CpuProjectExec(projs, plan)
+    return plan
+
+
+def _frame_bound(b: _Node):
+    name = b.cls
+    if "UnboundedPreceding" in name or "UnboundedFollowing" in name:
+        return None
+    if "CurrentRow" in name:
+        return 0
+    if name == "Literal":
+        v, _ = _literal_value(b)
+        return int(v)
+    raise UnsupportedSparkPlan(f"window frame bound {name}")
+
+
+def _translate_window_fn(fn_node: _Node, spec_node: Optional[_Node]):
+    from ..expr import windowexprs as WE
+    frame = None
+    if spec_node is not None and spec_node.children:
+        last = spec_node.children[-1]
+        if last.cls == "SpecifiedWindowFrame" and len(last.children) == 2:
+            lo = _frame_bound(last.children[0])
+            hi = _frame_bound(last.children[1])
+            ftype = str(last.fields.get("frameType", "RowFrame"))
+            frame = WE.RowFrame(lo, hi) if "Row" in ftype \
+                else WE.RangeFrame(lo, hi)
+    c = fn_node.cls
+    if c == "RowNumber":
+        return WE.RowNumber()
+    if c == "Rank":
+        return WE.Rank()
+    if c == "DenseRank":
+        return WE.DenseRank()
+    if c == "PercentRank":
+        return WE.PercentRank()
+    if c == "CumeDist":
+        return WE.CumeDist()
+    if c == "NTile":
+        v, _ = _literal_value(fn_node.children[0])
+        return WE.NTile(int(v))
+    if c in ("Lead", "Lag"):
+        if str(fn_node.fields.get("ignoreNulls", False)).lower() == "true":
+            raise UnsupportedSparkPlan(f"{c} IGNORE NULLS")
+        expr = _translate_expr(fn_node.children[0])
+        off = 1
+        default = None
+        if len(fn_node.children) > 1:
+            if fn_node.children[1].cls != "Literal":
+                raise UnsupportedSparkPlan(f"{c} non-literal offset")
+            v, _ = _literal_value(fn_node.children[1])
+            off = int(v)
+        if len(fn_node.children) > 2:
+            d = fn_node.children[2]
+            if d.cls == "Literal":
+                default, _ = _literal_value(d)
+            else:  # silent null-default would be a wrong answer
+                raise UnsupportedSparkPlan(f"{c} non-literal default")
+        cls = WE.Lead if c == "Lead" else WE.Lag
+        return cls(expr, off, default)
+    if c == "NthValue":
+        expr = _translate_expr(fn_node.children[0])
+        v, _ = _literal_value(fn_node.children[1])
+        ign = str(fn_node.fields.get("ignoreNulls", False)).lower() \
+            == "true"
+        return WE.NthValue(expr, int(v), ignore_nulls=ign, frame=frame)
+    if c == "AggregateExpression":
+        return WE.WindowAggregate(_translate_agg_fn(fn_node), frame)
+    raise UnsupportedSparkPlan(f"window function {c}")
+
+
+def _window(node: _Node, conf, paths):
+    """WindowExec: each windowExpression is Alias(WindowExpression(fn,
+    WindowSpecDefinition(..., frame)))."""
+    child = _translate(node.children[0], conf, paths)
+    fns = []
+    for i, we in enumerate(_expr_list(node.fields.get("windowExpression"))):
+        name = f"w{i}"
+        inner = we
+        if we.cls == "Alias":
+            name = we.fields.get("name", name)
+            inner = we.children[0]
+        if inner.cls != "WindowExpression" or not inner.children:
+            raise UnsupportedSparkPlan(
+                f"window expression {inner.cls}")
+        fn_node = inner.children[0]
+        spec = inner.children[1] if len(inner.children) > 1 else None
+        fns.append((_translate_window_fn(fn_node, spec), name))
+    part = [_translate_expr(e)
+            for e in _expr_list(node.fields.get("partitionSpec"))]
+    orders = _sort_orders(node, field="orderSpec")
+    return N.CpuWindowExec(fns, part, orders, child)
+
+
+def _write_command(node: _Node, conf, paths):
+    """DataWritingCommandExec(InsertIntoHadoopFsRelationCommand): the
+    write-command exec (`GpuDataWritingCommandExec.scala` analog). The
+    output path maps through path_overrides under key 'output' when
+    present (tests write to tmp dirs)."""
+    from ..io.writer import CpuWriteFilesExec
+    cmd = _expr_tree(node.fields.get("cmd"))
+    if cmd is None or cmd.cls != "InsertIntoHadoopFsRelationCommand":
+        raise UnsupportedSparkPlan(
+            f"write command {None if cmd is None else cmd.cls}")
+    child = _translate(node.children[0], conf, paths)
+    fmt = str(cmd.fields.get("fileFormat", "parquet")).lower()
+    for known in ("parquet", "orc", "csv"):  # the engine's writer formats
+        if known in fmt:
+            fmt = known
+            break
+    else:
+        raise UnsupportedSparkPlan(f"write format {fmt}")
+    out = paths.get("output")
+    out_path = out[0] if out else cmd.fields.get("outputPath")
+    if not out_path:
+        raise UnsupportedSparkPlan("write command without outputPath")
+    part_cols = [e.fields["name"] for e in
+                 _expr_list(cmd.fields.get("partitionColumns"))
+                 if e.cls == "AttributeReference"]
+    mode = str(cmd.fields.get("mode", "ErrorIfExists"))
+    mode = {"append": "append", "overwrite": "overwrite",
+            "ignore": "ignore"}.get(mode.lower(), "error")
+    return CpuWriteFilesExec(str(out_path), fmt, part_cols, mode, child,
+                             conf)
+
+
+def _sort_orders(node: _Node, field: str = "sortOrder"
+                 ) -> List[Tuple[Any, bool, bool]]:
     orders = []
-    for so in _expr_list(node.fields.get("sortOrder")):
+    for so in _expr_list(node.fields.get(field)):
         # SortOrder(child, direction, nullOrdering)
         e = _translate_expr(so.children[0])
         asc = "Asc" in str(so.fields.get("direction", "Ascending"))

@@ -200,6 +200,12 @@ def _pack_kernel():
             weights = jnp.left_shift(jnp.ones(8, jnp.uint8),
                                      jnp.arange(8, dtype=jnp.uint8))
             return (bits * weights[None, :]).sum(axis=1).astype(jnp.uint8)
+        if compacted.dtype.itemsize == 8:
+            # 64-bit bitcasts hit the X64-rewriting wall on TPU ("HLO for
+            # which this rewriting is not implemented: bitcast-convert
+            # u64[...]"); the compacted values D2H as-is and numpy's
+            # little-endian buffer view IS the parquet PLAIN layout
+            return compacted
         return jax.lax.bitcast_convert_type(
             compacted, jnp.uint8).reshape(-1)
 

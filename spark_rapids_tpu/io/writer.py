@@ -89,6 +89,97 @@ def write_device_parquet(batches, schema, path: str, mode: str = "error",
     return stats
 
 
+from ..plan.nodes import PhysicalPlan as _PhysicalPlan  # noqa: E402
+
+
+class CpuWriteFilesExec(_PhysicalPlan):
+    """Write-command plan node (`GpuDataWritingCommandExec.scala` /
+    InsertIntoHadoopFsRelationCommand analog): executes the child and
+    writes its rows; yields ONE summary row (path, rows written) like
+    Spark's command output. Created by the Spark-plan adapter and the
+    overrides registry (exec-rule surface for write commands)."""
+
+    def __init__(self, path: str, fmt: str, partition_by, mode: str,
+                 child, conf=None):
+        super().__init__([child])
+        self.path = path
+        self.fmt = fmt
+        self.partition_by = list(partition_by or [])
+        self.mode = mode
+        self.conf = conf
+
+    @property
+    def output(self):
+        from .. import types as T
+        from ..columnar.batch import Schema
+        return Schema(("path", "rows"), (T.STRING, T.LONG))
+
+    def _arg_string(self):
+        return f"[{self.fmt}, {self.path}]"
+
+    def _summary_batch(self, rows: int):
+        import pyarrow as _pa
+        from ..cpu.hostbatch import host_batch_from_arrow
+        return host_batch_from_arrow(_pa.table(
+            {"path": [self.path], "rows": [rows]},
+            schema=self.output.to_arrow()))
+
+    def execute_cpu(self):
+        from ..plan.nodes import _concat_host
+        from ..cpu.hostbatch import host_batch_to_arrow
+        merged = _concat_host(list(self.children[0].execute_cpu()),
+                              self.children[0].output)
+        table = host_batch_to_arrow(merged)
+        stats = write_table(table, self.path, self.fmt,
+                            self.partition_by or None, self.mode)
+        yield self._summary_batch(stats.num_rows)
+
+
+from ..exec.base import TpuExec as _TpuExec  # noqa: E402
+
+
+class TpuWriteFilesExec(_TpuExec):
+    """Device-side write exec: parquet without partitioning takes the
+    device encoder straight from device batches; everything else crosses
+    to Arrow at the boundary and uses the host writers."""
+
+    def __init__(self, plan: CpuWriteFilesExec, child, conf):
+        super().__init__([child], conf)
+        self.plan = plan
+
+    @property
+    def output(self):
+        return self.plan.output
+
+    def do_execute(self):
+        from ..columnar.batch import batch_from_arrow, batch_to_arrow
+        plan = self.plan
+        batches = list(self.children[0].execute())
+        stats = None
+        if plan.fmt == "parquet" and not plan.partition_by:
+            from .parquet_device_write import schema_supported
+            if schema_supported(self.children[0].output):
+                stats = write_device_parquet(
+                    batches, self.children[0].output, plan.path,
+                    plan.mode)
+        if stats is None:
+            tables = [batch_to_arrow(b) for b in batches]
+            tables = [t for t in tables if t.num_rows]
+            table = pa.concat_tables(tables) if tables else \
+                self.children[0].output.to_arrow().empty_table()
+            stats = write_table(table, plan.path, plan.fmt,
+                                plan.partition_by or None, plan.mode)
+        from ..cpu.hostbatch import host_batch_to_arrow
+        summary = plan._summary_batch(stats.num_rows)
+        b = batch_from_arrow(host_batch_to_arrow(summary))
+        self.num_output_rows.add(1)
+        yield self._count_output(b)
+
+
+def make_tpu_write_files(plan: CpuWriteFilesExec, child, conf):
+    return TpuWriteFilesExec(plan, child, conf)
+
+
 def write_table(table: pa.Table, path: str, fmt: str = "parquet",
                 partition_by: Optional[Sequence[str]] = None,
                 mode: str = "error", **options) -> WriteStats:

@@ -1143,9 +1143,29 @@ _register_pandas_exec_rules = _lazy_rule_group(
     "spark_rapids_tpu.udf.pandas_execs", "CpuMapInPandasExec",
     _do_register_pandas_execs)
 
+
+def _c_write_files(plan, children, conf):
+    from ..io.writer import make_tpu_write_files
+    return make_tpu_write_files(plan, children[0], conf)
+
+
+def _do_register_write_files():
+    from ..io.writer import CpuWriteFilesExec
+    exec_rule(CpuWriteFilesExec, TypeSig.all_basic(), _c_write_files,
+              doc="Enable TPU execution of file write commands "
+                  "(GpuDataWritingCommandExec analog; parquet takes the "
+                  "device encoder, other formats write at the host "
+                  "boundary).")
+
+
+_register_write_files_rule = _lazy_rule_group(
+    "spark_rapids_tpu.io.writer", "CpuWriteFilesExec",
+    _do_register_write_files)
+
 _register_cache_rule()
 _register_file_scan_rules()
 _register_pandas_exec_rules()
+_register_write_files_rule()
 
 
 # ----------------------------------------------------------------------------
@@ -1191,6 +1211,7 @@ class Overrides:
         _register_file_scan_rules()  # lazy retry if module import was cyclic
         _register_cache_rule()
         _register_pandas_exec_rules()
+        _register_write_files_rule()
         rule = _EXEC_RULES.get(type(plan))
         meta = PlanMeta(plan, self.conf, rule)
         for c in plan.children:

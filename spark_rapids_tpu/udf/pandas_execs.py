@@ -98,6 +98,21 @@ def _chunks(df, max_rows: int):
         yield df.iloc[lo:lo + max_rows]
 
 
+def _permit_per_step(it, sem):
+    """Advance a user-fn iterator one step per semaphore permit. The
+    permit is NEVER held across a yield to the consumer (a generator
+    advanced on one thread and closed on another must not strand a
+    permit), and each step's acquire/release pair runs on one thread, so
+    the semaphore's per-thread reentrancy is sound for nested execs."""
+    while True:
+        with sem:
+            try:
+                out = next(it)
+            except StopIteration:
+                return
+        yield out
+
+
 def _sorted_groups(df, keys: List[str]):
     """Yield (key_df_one_row, group_df) in key-sorted order (deterministic
     on both engines; Spark does not pin an order)."""
@@ -141,12 +156,13 @@ class CpuMapInPandasExec(PhysicalPlan):
         from ..config import get_default_conf
         conf = self._conf or get_default_conf()
         max_rows = conf.get("spark.rapids.sql.batchSizeRows")
-        with PythonWorkerSemaphore.get():
-            for out in self.fn(self._input_frames(max_rows)):
-                if len(out):
-                    yield _pandas_to_hb(
-                        _check_output_columns(out, self._schema,
-                                              "mapInPandas"), self._schema)
+        for out in _permit_per_step(
+                self.fn(self._input_frames(max_rows)),
+                PythonWorkerSemaphore.get()):
+            if len(out):
+                yield _pandas_to_hb(
+                    _check_output_columns(out, self._schema,
+                                          "mapInPandas"), self._schema)
 
     def _arg_string(self):
         return f"[{getattr(self.fn, '__name__', '<fn>')}]"
@@ -175,16 +191,16 @@ class TpuMapInPandasExec(_TpuExec):
             yield from _chunks(_device_to_pandas(batch), max_rows)
 
     def do_execute(self):
-        with PythonWorkerSemaphore.get(
-                self.conf.get("spark.rapids.sql.concurrentGpuTasks")):
-            for out in self.fn(self._input_frames()):
-                if not len(out):
-                    continue
-                b, nrows = _pandas_to_device(
-                    _check_output_columns(out, self._schema,
-                                          "mapInPandas"), self._schema)
-                self.num_output_rows.add(nrows)
-                yield self._count_output(b)
+        sem = PythonWorkerSemaphore.get(
+            self.conf.get("spark.rapids.sql.concurrentGpuTasks"))
+        for out in _permit_per_step(self.fn(self._input_frames()), sem):
+            if not len(out):
+                continue
+            b, nrows = _pandas_to_device(
+                _check_output_columns(out, self._schema,
+                                      "mapInPandas"), self._schema)
+            self.num_output_rows.add(nrows)
+            yield self._count_output(b)
 
 
 # ----------------------------------------------------------------------------
@@ -215,14 +231,15 @@ class CpuFlatMapGroupsInPandasExec(PhysicalPlan):
         if not frames:
             return
         df = pd.concat(frames, ignore_index=True)
-        with PythonWorkerSemaphore.get():
-            for g in _sorted_groups(df, self.keys):
+        sem = PythonWorkerSemaphore.get()
+        for g in _sorted_groups(df, self.keys):
+            with sem:  # not held across the yield below
                 out = self.fn(g.reset_index(drop=True))
-                if len(out):
-                    yield _pandas_to_hb(
-                        _check_output_columns(out, self._schema,
-                                              "applyInPandas"),
-                        self._schema)
+            if len(out):
+                yield _pandas_to_hb(
+                    _check_output_columns(out, self._schema,
+                                          "applyInPandas"),
+                    self._schema)
 
     def _arg_string(self):
         return f"[{self.keys}, {getattr(self.fn, '__name__', '<fn>')}]"
@@ -501,14 +518,15 @@ class CpuCoGroupsInPandasExec(PhysicalPlan):
             _empty_frame(self.children[0].output)
         rdf = pd.concat(rf, ignore_index=True) if rf else \
             _empty_frame(self.children[1].output)
-        with PythonWorkerSemaphore.get():
-            for lpart, rpart in self._cogroups(ldf, rdf):
+        sem = PythonWorkerSemaphore.get()
+        for lpart, rpart in self._cogroups(ldf, rdf):
+            with sem:  # not held across the yield below
                 out = self.fn(lpart, rpart)
-                if len(out):
-                    yield _pandas_to_hb(
-                        _check_output_columns(out, self._schema,
-                                              "cogrouped applyInPandas"),
-                        self._schema)
+            if len(out):
+                yield _pandas_to_hb(
+                    _check_output_columns(out, self._schema,
+                                          "cogrouped applyInPandas"),
+                    self._schema)
 
     def _arg_string(self):
         return f"[{self.left_keys}|{self.right_keys}]"

@@ -60,9 +60,13 @@ class PythonWorkerSemaphore:
         return self
 
     def __exit__(self, *exc):
-        self._tls.depth -= 1
-        if self._tls.depth == 0:
-            self._sem.release()
+        depth = getattr(self._tls, "depth", 0)
+        if depth <= 1:
+            self._tls.depth = 0
+            if depth == 1:  # a foreign-thread exit never acquired: no-op
+                self._sem.release()
+        else:
+            self._tls.depth = depth - 1
 
 
 class PandasUDF(Expression):

@@ -204,9 +204,12 @@ class TestSparkPlanTranslation:
         assert out.column("v").to_pylist() == want
 
     def test_unknown_node_raises_with_name(self, session):
-        bad = [{"class": "org.apache.spark.sql.execution.window."
-                "WindowExec", "num-children": 0}]
-        with pytest.raises(UnsupportedSparkPlan, match="WindowExec"):
+        # WindowExec graduated to supported in round 4; use a node class
+        # that genuinely doesn't exist to probe the honesty contract
+        bad = [{"class": "org.apache.spark.sql.execution.exotic."
+                "FlumeCapacitorExec", "num-children": 0}]
+        with pytest.raises(UnsupportedSparkPlan,
+                           match="FlumeCapacitorExec"):
             translate_spark_plan(json.dumps(bad), session.conf, {})
 
     def test_missing_path_mapping_raises(self, session):
