@@ -357,16 +357,20 @@ def _generate(node: _Node, conf, paths):
             if e.cls == "AttributeReference"]
     n_child = len(child.output.names)
     n_gen = len(plan.output.names) - n_child
-    if gen_names and len(gen_names) == n_gen:
-        projs = []
-        for nm in keep:
-            projs.append(EB.AttributeReference(nm))
-        for i, nm in enumerate(gen_names):
-            projs.append(EB.Alias(
-                EB.BoundReference(n_child + i,
-                                  plan.output.types[n_child + i]), nm))
-        return N.CpuProjectExec(projs, plan)
-    return plan
+    if not gen_names or len(gen_names) != n_gen:
+        # a silent fall-through would expose engine-internal column names
+        # ('pos'/'col') to the parent plan's attribute binding
+        raise UnsupportedSparkPlan(
+            f"GenerateExec generatorOutput has {len(gen_names)} names "
+            f"for {n_gen} generated columns")
+    projs = []
+    for nm in keep:
+        projs.append(EB.AttributeReference(nm))
+    for i, nm in enumerate(gen_names):
+        projs.append(EB.Alias(
+            EB.BoundReference(n_child + i,
+                              plan.output.types[n_child + i]), nm))
+    return N.CpuProjectExec(projs, plan)
 
 
 def _frame_bound(b: _Node):
@@ -462,8 +466,9 @@ def _window(node: _Node, conf, paths):
 def _write_command(node: _Node, conf, paths):
     """DataWritingCommandExec(InsertIntoHadoopFsRelationCommand): the
     write-command exec (`GpuDataWritingCommandExec.scala` analog). The
-    output path maps through path_overrides under key 'output' when
-    present (tests write to tmp dirs)."""
+    destination can be remapped through path_overrides under the
+    reserved key '__write_output__' — a dunder name no relation
+    identifier can collide with (relation ids share the same dict)."""
     from ..io.writer import CpuWriteFilesExec
     cmd = _expr_tree(node.fields.get("cmd"))
     if cmd is None or cmd.cls != "InsertIntoHadoopFsRelationCommand":
@@ -477,7 +482,7 @@ def _write_command(node: _Node, conf, paths):
             break
     else:
         raise UnsupportedSparkPlan(f"write format {fmt}")
-    out = paths.get("output")
+    out = paths.get("__write_output__")
     out_path = out[0] if out else cmd.fields.get("outputPath")
     if not out_path:
         raise UnsupportedSparkPlan("write command without outputPath")

@@ -1,0 +1,218 @@
+"""Concrete TCP shuffle transport (round-3 verdict #9).
+
+The reference ships two concrete transports — UCX RDMA
+(`shuffle-plugin/.../UCX.scala:1-1118`) and the netty multithreaded path —
+under the same pull-based SPI its mocked tests exercise. On TPU the
+intra-host data plane is ICI collectives (`parallel/collective.py`); THIS
+is the inter-host/DCN concrete transport: the existing
+server/client/windowed/bounce state machines (`transport.py`) run
+unchanged over real sockets between OS processes.
+
+Wire protocol: the device-service framing (`service/protocol.py` —
+length-framed JSON header + binary body), deliberately shared: any
+channel that can move those two buffers can carry either service.
+
+  list   {shuffle_id, reduce_id}            -> {blocks: [[s,m,r]...]}
+  meta   {blocks: [[s,m,r]...]}             -> {metas: [...]}, body =
+                                               concatenated encode_meta
+  fetch  {block, offset, length, total}     -> {}, body = the byte range
+
+One server thread per connection (the reference's netty boss/worker
+split collapsed to the thread-per-peer model its UCX path uses);
+deadline-bounded client requests surface wedged peers as errors instead
+of hangs."""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..service.protocol import recv_msg, send_msg
+from .metadata import TableMeta, decode_meta, encode_meta
+from .transport import (BlockId, BlockRange, ClientConnection,
+                        ShuffleServer, ShuffleTransport)
+
+__all__ = ["TcpShuffleServer", "TcpTransport"]
+
+
+def _bid(b: BlockId) -> list:
+    return [b.shuffle_id, b.map_id, b.reduce_id]
+
+
+def _unbid(v) -> BlockId:
+    return BlockId(int(v[0]), int(v[1]), int(v[2]))
+
+
+class TcpShuffleServer:
+    """Serve one executor's shuffle blocks over TCP: a thin wire shim
+    around the transport-agnostic ShuffleServer state machine."""
+
+    def __init__(self, server: ShuffleServer, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.server = server
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(64)
+        self._listener.settimeout(0.5)
+        self.address = self._listener.getsockname()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "TcpShuffleServer":
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        return self
+
+    def _serve(self) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    conn, _ = self._listener.accept()
+                except socket.timeout:
+                    continue
+                threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True).start()
+        finally:
+            self._listener.close()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                try:
+                    header, _ = recv_msg(conn)
+                except (ConnectionError, OSError):
+                    return
+                try:
+                    self._handle(conn, header)
+                except (ConnectionError, OSError):
+                    return
+                except Exception as e:  # per-request errors cross the wire
+                    send_msg(conn, {"ok": False,
+                                    "error": f"{type(e).__name__}: {e}"})
+        finally:
+            conn.close()
+
+    def _handle(self, conn: socket.socket, header: dict) -> None:
+        op = header.get("op")
+        if op == "list":
+            blocks = self.server.handle_list_blocks(
+                int(header["shuffle_id"]), int(header["reduce_id"]))
+            send_msg(conn, {"ok": True,
+                            "blocks": [_bid(b) for b in blocks]})
+        elif op == "meta":
+            metas = self.server.handle_metadata_request(
+                [_unbid(v) for v in header["blocks"]])
+            body = bytearray()
+            rows = []
+            for bid, meta, total in metas:
+                mb = encode_meta(meta)
+                rows.append([_bid(bid), len(mb), int(total)])
+                body += mb
+            send_msg(conn, {"ok": True, "metas": rows}, bytes(body))
+        elif op == "fetch":
+            r = BlockRange(_unbid(header["block"]), int(header["offset"]),
+                           int(header["length"]), int(header["total"]))
+            data = self.server.handle_fetch(r)
+            send_msg(conn, {"ok": True}, data)
+        else:
+            send_msg(conn, {"ok": False, "error": f"unknown op {op!r}"})
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+class _TcpConnection(ClientConnection):
+    """ClientConnection over one TCP socket; every request is
+    deadline-bounded so a wedged peer surfaces as an error, not a hang."""
+
+    def __init__(self, address: Tuple[str, int], deadline_s: float):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.settimeout(deadline_s)
+        self._sock.connect(tuple(address))
+        self._deadline = deadline_s
+        self._lock = threading.Lock()
+        self._dead = False
+
+    def close(self) -> None:
+        self._dead = True
+        self._sock.close()
+
+    def _request(self, header: dict) -> Tuple[dict, bytes]:
+        with self._lock:  # one in-flight request per connection
+            if self._dead:
+                raise IOError("shuffle connection is closed (a previous "
+                              "request timed out; replies would desync)")
+            try:
+                send_msg(self._sock, header)
+                rep, body = recv_msg(self._sock)
+            except socket.timeout as e:
+                # POISON the socket: a late reply for this request would
+                # otherwise be read as the NEXT request's response and
+                # silently corrupt a block
+                self._dead = True
+                self._sock.close()
+                raise IOError(
+                    f"shuffle peer did not answer {header.get('op')!r} "
+                    f"within {self._deadline}s") from e
+            except (ConnectionError, OSError):
+                self._dead = True
+                raise
+        if not rep.get("ok"):
+            raise IOError(rep.get("error", "shuffle request failed"))
+        return rep, body
+
+    def list_blocks(self, shuffle_id: int, reduce_id: int) -> List[BlockId]:
+        rep, _ = self._request({"op": "list", "shuffle_id": shuffle_id,
+                                "reduce_id": reduce_id})
+        return [_unbid(v) for v in rep["blocks"]]
+
+    def request_metadata(self, block_ids: Sequence[BlockId]
+                         ) -> List[Tuple[BlockId, TableMeta, int]]:
+        rep, body = self._request(
+            {"op": "meta", "blocks": [_bid(b) for b in block_ids]})
+        out = []
+        off = 0
+        for bid_v, mlen, total in rep["metas"]:
+            meta, _ = decode_meta(body[off:off + int(mlen)])
+            off += int(mlen)
+            out.append((_unbid(bid_v), meta, int(total)))
+        return out
+
+    def fetch_range(self, r: BlockRange) -> bytes:
+        _, body = self._request(
+            {"op": "fetch", "block": _bid(r.block), "offset": r.offset,
+             "length": r.length, "total": r.total_length})
+        return body
+
+
+class TcpTransport(ShuffleTransport):
+    """Peers are (host, port) addresses published out of band (the
+    reference publishes UCX worker addresses through the heartbeat/peer
+    registry — `shuffle/heartbeat.py` here)."""
+
+    def __init__(self, deadline_s: float = 30.0):
+        self._peers: Dict[str, Tuple[str, int]] = {}
+        self._deadline = deadline_s
+        self._conns: List[_TcpConnection] = []
+
+    def register_peer(self, executor_id: str,
+                      address: Tuple[str, int]) -> None:
+        self._peers[executor_id] = tuple(address)
+
+    def connect(self, peer_executor_id: str) -> ClientConnection:
+        addr = self._peers.get(peer_executor_id)
+        if addr is None:
+            raise ConnectionError(f"unknown peer {peer_executor_id}")
+        conn = _TcpConnection(addr, self._deadline)
+        self._conns.append(conn)
+        return conn
+
+    def shutdown(self) -> None:
+        for c in self._conns:
+            c.close()
+        self._conns.clear()

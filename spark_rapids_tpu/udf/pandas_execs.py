@@ -98,14 +98,19 @@ def _chunks(df, max_rows: int):
         yield df.iloc[lo:lo + max_rows]
 
 
-def _permit_per_step(it, sem):
-    """Advance a user-fn iterator one step per semaphore permit. The
+def _permit_per_step(make_it, sem):
+    """Advance a user-fn iterable one step per semaphore permit. The
     permit is NEVER held across a yield to the consumer (a generator
     advanced on one thread and closed on another must not strand a
     permit), and each step's acquire/release pair runs on one thread, so
-    the semaphore's per-thread reentrancy is sound for nested execs."""
+    the semaphore's per-thread reentrancy is sound for nested execs.
+    `make_it` is a thunk: an EAGER fn (one returning a list rather than a
+    generator) does all its work inside the first permit."""
+    it = None
     while True:
         with sem:
+            if it is None:
+                it = iter(make_it())
             try:
                 out = next(it)
             except StopIteration:
@@ -157,7 +162,7 @@ class CpuMapInPandasExec(PhysicalPlan):
         conf = self._conf or get_default_conf()
         max_rows = conf.get("spark.rapids.sql.batchSizeRows")
         for out in _permit_per_step(
-                self.fn(self._input_frames(max_rows)),
+                lambda: self.fn(self._input_frames(max_rows)),
                 PythonWorkerSemaphore.get()):
             if len(out):
                 yield _pandas_to_hb(
@@ -193,7 +198,8 @@ class TpuMapInPandasExec(_TpuExec):
     def do_execute(self):
         sem = PythonWorkerSemaphore.get(
             self.conf.get("spark.rapids.sql.concurrentGpuTasks"))
-        for out in _permit_per_step(self.fn(self._input_frames()), sem):
+        for out in _permit_per_step(
+                lambda: self.fn(self._input_frames()), sem):
             if not len(out):
                 continue
             b, nrows = _pandas_to_device(

@@ -1,0 +1,75 @@
+"""Peer-process entry for the TCP shuffle transport test: a REAL second
+OS process that serializes columnar batches into a ShuffleBlockStore with
+a tiny host budget (disk tier engaged), serves them over
+TcpShuffleServer, prints its port + per-block row sums as one JSON line,
+then serves until killed — the role a remote executor plays for
+`RapidsShuffleServer.scala`."""
+
+import hashlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pyarrow as pa  # noqa: E402
+
+
+def main() -> int:
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shuffle-id", type=int, default=7)
+    ap.add_argument("--maps", type=int, default=4)
+    ap.add_argument("--reduces", type=int, default=2)
+    ap.add_argument("--rows", type=int, default=3000)
+    ap.add_argument("--host-budget", type=int, default=16 * 1024,
+                    help="tiny: most blocks overflow to the disk tier")
+    args = ap.parse_args()
+
+    from spark_rapids_tpu.columnar.batch import batch_from_arrow
+    from spark_rapids_tpu.shuffle.manager import ShuffleBlockStore
+    from spark_rapids_tpu.shuffle.serializer import serialize_batch
+    from spark_rapids_tpu.shuffle.tcp_transport import TcpShuffleServer
+    from spark_rapids_tpu.shuffle.transport import BlockId, ShuffleServer
+
+    rng = np.random.default_rng(99)
+    store = ShuffleBlockStore(host_budget=args.host_budget)
+    sums = {}
+    for m in range(args.maps):
+        for r in range(args.reduces):
+            n = args.rows + 137 * m + r  # uneven block sizes
+            vals = rng.integers(-10**6, 10**6, n).astype(np.int64)
+            tags = np.array([f"m{m}r{r}x{i % 50}" for i in range(n)],
+                            dtype=object)
+            t = pa.table({"v": pa.array(vals), "s": pa.array(tags)})
+            blob = serialize_batch(batch_from_arrow(t), "zstd")
+            bid = BlockId(args.shuffle_id, m, r)
+            store.put(bid, blob)
+            sums[f"{m}:{r}"] = {"rows": n, "vsum": int(vals.sum()),
+                                "ssha": hashlib.sha256(
+                                    "".join(tags).encode()).hexdigest()}
+
+    srv = ShuffleServer("peer-1", store.get, store.blocks_for_reduce)
+    tcp = TcpShuffleServer(srv).start()
+    print(json.dumps({"port": tcp.address[1],
+                      "disk_blocks": store.disk_block_count(),
+                      "sums": sums}), flush=True)
+    try:
+        while True:
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        tcp.close()
+        store.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

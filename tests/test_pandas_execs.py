@@ -96,6 +96,18 @@ class TestMapInPandas:
         assert sum(sizes) == 1000
         assert any(s == 100 for s in sizes)  # the roundoff tail
 
+    def test_eager_list_returning_fn(self, session, rng):
+        """A fn returning a LIST of frames (not a generator) must work —
+        iter() semantics, the shape plain-python users write."""
+        t = make_table(rng, n=300)
+
+        def eager(frames):
+            return [pd.DataFrame({"k": f["k"], "doubled": f["v"] * 2})
+                    for f in frames]
+
+        df = session.from_arrow(t).map_in_pandas(eager, OUT_SCHEMA)
+        assert_same(df, sort_by=["k", "doubled"], approx_cols=("doubled",))
+
     def test_empty_input(self, session):
         t = pa.table({"k": pa.array([], pa.int64()),
                       "v": pa.array([], pa.float64()),

@@ -1,0 +1,135 @@
+"""Cross-process TCP shuffle transport (round-3 verdict #9): the
+server/client/windowed/bounce state machines run between two REAL OS
+processes over sockets, fetching a multi-block shuffle with the disk
+tier engaged on the serving side (reference `RapidsShuffleClient.scala:89`,
+`RapidsShuffleServer.scala:70`, UCX/netty concrete transports)."""
+
+import hashlib
+import json
+import os
+import socket as socketmod
+import subprocess
+import sys
+
+import pytest
+
+from spark_rapids_tpu.shuffle.serializer import deserialize_table
+from spark_rapids_tpu.shuffle.tcp_transport import TcpTransport
+from spark_rapids_tpu.shuffle.transport import (BlockId,
+                                                BounceBufferManager,
+                                                ShuffleClient)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PEER = os.path.join(REPO, "tests", "shuffle_peer.py")
+SHUFFLE_ID = 7
+
+
+@pytest.fixture(scope="module")
+def peer():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen([sys.executable, PEER], cwd=REPO, env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True)
+    line = proc.stdout.readline()
+    try:
+        info = json.loads(line)
+    except Exception:
+        proc.kill()
+        raise
+    yield info
+    proc.kill()
+    proc.wait(timeout=10)
+
+
+def _client(info, window_bytes=8192, buffers=2, deadline=30.0):
+    transport = TcpTransport(deadline_s=deadline)
+    transport.register_peer("peer-1", ("127.0.0.1", info["port"]))
+    conn = transport.connect("peer-1")
+    return ShuffleClient(conn, BounceBufferManager(buffers, window_bytes)), \
+        transport
+
+
+class TestTcpShuffle:
+    def test_disk_tier_engaged_on_server(self, peer):
+        """The serving process's tiny host budget must have pushed blocks
+        to its disk tier — the fetch crosses BOTH the wire and the tier."""
+        assert peer["disk_blocks"] > 0
+
+    def test_fetch_multiblock_partition_across_processes(self, peer):
+        """Pull every block of reduce partition 0 from the peer process
+        through windowed bounce-buffer transfers; windows (8KB) are much
+        smaller than blocks (~100KB), so each block spans many fetches."""
+        client, transport = _client(peer)
+        got = {}
+
+        def on_block(bid, data):
+            table, _ = deserialize_table(data)
+            got[bid] = table
+
+        n = client.fetch_partition(SHUFFLE_ID, 0, on_block)
+        transport.shutdown()
+        assert n == 4  # four map outputs
+        import numpy as np
+        for bid, table in got.items():
+            key = f"{bid.map_id}:{bid.reduce_id}"
+            exp = peer["sums"][key]
+            assert table.num_rows == exp["rows"], key
+            arrays = dict(zip(table.schema.names, table.arrays))
+            vdata, _, _ = arrays["v"]
+            assert int(np.asarray(vdata)[:exp["rows"]].sum()) \
+                == exp["vsum"], key
+            chars, _, lens = arrays["s"]
+            chars = np.asarray(chars)
+            lens = np.asarray(lens)
+            strings = "".join(
+                bytes(chars[i, :lens[i]]).decode()
+                for i in range(exp["rows"]))
+            assert hashlib.sha256(
+                strings.encode()).hexdigest() == exp["ssha"], key
+
+    def test_both_partitions_complete(self, peer):
+        client, transport = _client(peer, window_bytes=64 * 1024)
+        rows = []
+        total = 0
+        for rid in (0, 1):
+            n = client.fetch_partition(
+                SHUFFLE_ID, rid,
+                lambda bid, data: rows.append(
+                    deserialize_table(data)[0].num_rows))
+            total += n
+        transport.shutdown()
+        assert total == 8
+        assert sum(rows) == sum(v["rows"] for v in peer["sums"].values())
+
+    def test_missing_block_is_an_error_not_silence(self, peer):
+        client, transport = _client(peer)
+        errors = []
+        n = client.fetch_blocks(
+            [BlockId(SHUFFLE_ID, 0, 0), BlockId(SHUFFLE_ID, 99, 0)],
+            on_block=lambda bid, data: None,
+            on_error=lambda bid, e: errors.append((bid, e)))
+        transport.shutdown()
+        assert n == 1
+        assert len(errors) == 1 and errors[0][0].map_id == 99
+
+    def test_wedged_peer_times_out(self):
+        """A peer that accepts but never answers surfaces an IOError
+        under the deadline instead of hanging the fetch."""
+        srv = socketmod.socket(socketmod.AF_INET, socketmod.SOCK_STREAM)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        try:
+            transport = TcpTransport(deadline_s=1.0)
+            transport.register_peer("wedged", srv.getsockname())
+            conn = transport.connect("wedged")
+            with pytest.raises(IOError, match="did not answer"):
+                conn.list_blocks(1, 0)
+            # the connection is POISONED after a timeout: a late reply
+            # must never be read as the next request's response
+            with pytest.raises(IOError, match="closed"):
+                conn.list_blocks(1, 0)
+            transport.shutdown()
+        finally:
+            srv.close()
