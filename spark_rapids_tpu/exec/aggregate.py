@@ -131,16 +131,23 @@ class TpuHashAggregateExec(UnaryTpuExec):
                 ptps.append(pt)
         self._partial_schema = Schema(tuple(pnames), tuple(ptps))
 
+        # ANSI error-message boxes: each kernel variant gets its OWN box —
+        # a shared one would be clobbered by whichever kernel traced last,
+        # truncating another kernel's flag tuple in raise_kernel_errors.
+        # self._err_msgs serves the single-pass kernels (one expression
+        # tree shared by every fanout-bucket specialization).
+        self._err_msgs: list = []
+        self._kernel_boxes: dict = {}
         raw_in = mode in ("complete", "partial")
-        self._kernel = jax.jit(self._make_kernel(
+        self._kernel = self._make_kernel(
             input_partial=not raw_in,
-            output_partial=(mode == "partial")))
+            output_partial=(mode == "partial"))
         # multi-batch machinery: raw->partial for the first pass,
         # partial->partial for merge passes, partial->final to finish
-        self._partial_kernel = jax.jit(self._make_kernel(False, True)) \
+        self._partial_kernel = self._make_kernel(False, True) \
             if raw_in else None
-        self._merge_kernel = jax.jit(self._make_kernel(True, True))
-        self._final_kernel = jax.jit(self._make_kernel(True, False)) \
+        self._merge_kernel = self._make_kernel(True, True)
+        self._final_kernel = self._make_kernel(True, False) \
             if mode != "partial" else None
 
     @property
@@ -149,9 +156,11 @@ class TpuHashAggregateExec(UnaryTpuExec):
 
     # ------------------------------------------------------------------
     def _make_kernel(self, input_partial: bool, output_partial: bool):
+        from .base import kernel_errors
         bound_groups = self._bound_groups
         bound_aggs = self._bound_aggs
         out_schema = self._partial_schema if output_partial else self._schema
+        msgs_box: list = []
 
         def kernel(batch: ColumnarBatch):
             xp = jnp
@@ -208,17 +217,32 @@ class TpuHashAggregateExec(UnaryTpuExec):
             for a in bound_aggs:
                 out_vecs.extend(self._agg_one(xp, a.func, sbufs, bi, gid, cap,
                                               sorted_mask, input_partial,
-                                              output_partial))
+                                              output_partial, ctx=ctx))
                 bi += len(a.func.partial_types()) if input_partial else 1
-            return vecs_to_batch(out_schema, out_vecs, ng)
+            return vecs_to_batch(out_schema, out_vecs, ng), \
+                kernel_errors(ctx, msgs_box)
 
-        return kernel
+        jitted = jax.jit(kernel)
+        self._kernel_boxes[jitted] = msgs_box
+        return jitted
+
+    def _run(self, kernel, batch: ColumnarBatch) -> ColumnarBatch:
+        """Invoke an aggregation kernel and surface its ANSI error flags
+        (single-pass kernels share self._err_msgs; see __init__)."""
+        from .base import raise_kernel_errors
+        out, errs = kernel(batch)
+        raise_kernel_errors(errs, self._kernel_boxes.get(kernel,
+                                                         self._err_msgs))
+        return out
 
     def _agg_one(self, xp, func: AggregateFunction, sbufs: List[Vec], bi: int,
                  gid, cap: int, row_mask, input_partial: bool,
-                 output_partial: bool) -> List[Vec]:
+                 output_partial: bool, ctx=None) -> List[Vec]:
         """Produce output vecs for one aggregate (list of partial buffers when
-        output_partial, single final value otherwise)."""
+        output_partial, single final value otherwise). `ctx` (when given)
+        carries the ANSI error channel: integral SUM accumulation overflow
+        reports through it (Spark ANSI raises on BIGINT sum overflow; the
+        reference checks the accumulator the same way)."""
         merging = input_partial
 
         def seg(op, v: Vec, acc_dtype=None):
@@ -262,6 +286,20 @@ class TpuHashAggregateExec(UnaryTpuExec):
             out_t = func.data_type if not merging else v.dtype
             acc = np.float64 if T.is_floating(out_t) else np.int64
             data, has = seg("sum", v, acc)
+            if ctx is not None and ctx.ansi and T.is_integral(out_t):
+                # int64 accumulation wraps silently; a parallel float64 sum
+                # tracks the true magnitude to ~2^10 ulp, so a wrap (error
+                # ~k*2^64) separates cleanly from rounding at the 2^62 line
+                from ..expr.base import ansi_raise
+                fsum, _ = seg("sum", Vec(T.DOUBLE,
+                                         v.data.astype(np.float64),
+                                         v.validity), np.float64)
+                wrapped = xp.abs(fsum - data.astype(np.float64)) \
+                    > np.float64(2 ** 62)
+                saved, ctx.row_mask = ctx.row_mask, None
+                ansi_raise(ctx, wrapped & has,
+                           "[ARITHMETIC_OVERFLOW] long overflow")
+                ctx.row_mask = saved
             return [Vec(func.data_type if not output_partial else
                         func.partial_types()[0],
                         data.astype(func.data_type.np_dtype), has)]
@@ -498,7 +536,7 @@ class TpuHashAggregateExec(UnaryTpuExec):
                 import functools
                 kern = jax.jit(functools.partial(self._sp_kernel, ks=ks))
                 self._sp_kernel_jit[ks] = kern
-            out = kern(b)
+            out = self._run(kern, b)
         self.num_output_rows.add(out.row_count())
         yield self._count_output(out)
 
@@ -506,7 +544,7 @@ class TpuHashAggregateExec(UnaryTpuExec):
         """Phase 1: max per-group valid count for each single-pass aggregate
         (host picks the fanout bucket from these)."""
         xp = jnp
-        _, svals, gid, ng, starts, smask = self._sp_prepare(xp, batch)
+        _, svals, gid, ng, starts, smask, _ = self._sp_prepare(xp, batch)
         cap = batch.capacity
         out = []
         for a, v in zip(self._bound_aggs, svals):
@@ -521,8 +559,10 @@ class TpuHashAggregateExec(UnaryTpuExec):
     def _sp_kernel(self, batch: ColumnarBatch, ks: tuple):
         """Phase 2: full output kernel with static fanout buckets per
         single-pass aggregate; normal aggregates ride along."""
+        from .base import kernel_errors
         xp = jnp
-        skeys, svals, gid, ng, starts, smask = self._sp_prepare(xp, batch)
+        skeys, svals, gid, ng, starts, smask, ctx = \
+            self._sp_prepare(xp, batch)
         cap = batch.capacity
         out_vecs: List[Vec] = []
         if skeys:
@@ -538,8 +578,9 @@ class TpuHashAggregateExec(UnaryTpuExec):
                 buf = [v] if v is not None else \
                     [Vec(T.LONG, xp.ones(cap, dtype=np.int64), smask)]
                 out_vecs.extend(self._agg_one(xp, a.func, buf, 0, gid, cap,
-                                              smask, False, False))
-        return vecs_to_batch(self._schema, out_vecs, ng)
+                                              smask, False, False, ctx=ctx))
+        return vecs_to_batch(self._schema, out_vecs, ng), \
+            kernel_errors(ctx, self._err_msgs)
 
     def _sp_prepare(self, xp, batch: ColumnarBatch):
         """Evaluate keys + agg children and sort everything by the keys; the
@@ -565,7 +606,7 @@ class TpuHashAggregateExec(UnaryTpuExec):
             gid = xp.zeros(cap, dtype=np.int32)
             ng = xp.asarray(1, dtype=np.int32)
             starts = xp.arange(cap) == 0
-        return skeys, svals, gid, ng, starts, sorted_mask
+        return skeys, svals, gid, ng, starts, sorted_mask, ctx
 
     def _sp_agg_one(self, xp, func, v: Vec, gid, cap, row_mask, k: int):
         """One single-pass aggregate over key-sorted rows: re-sort its rows by
@@ -660,7 +701,7 @@ class TpuHashAggregateExec(UnaryTpuExec):
                 for b in batches:
                     if len(batches) > 1 and int(b.row_count()) == 0:
                         continue
-                    out = self._kernel(b)
+                    out = self._run(self._kernel, b)
                     self.num_output_rows.add(out.row_count())
                     yield self._count_output(out)
             return
@@ -671,13 +712,13 @@ class TpuHashAggregateExec(UnaryTpuExec):
                 for b in batches:
                     if int(b.row_count()) == 0:
                         continue
-                    out = self._kernel(b)
+                    out = self._run(self._kernel, b)
                     self.num_output_rows.add(out.row_count())
                     yield self._count_output(out)
             return
         if len(batches) == 1:
             with self.agg_time.timed():
-                out = self._kernel(batches[0])
+                out = self._run(self._kernel, batches[0])
             self.num_output_rows.add(out.row_count())
             yield self._count_output(out)
             return
@@ -706,7 +747,7 @@ class TpuHashAggregateExec(UnaryTpuExec):
             MemoryBudget.get().reserve(0)  # pre-flight / injection point
             if self.mode == "final":
                 return b  # child already produced partial buffers
-            return self._partial_kernel(b)
+            return self._run(self._partial_kernel, b)
 
         pending: List[SpillableColumnarBatch] = []
         with self.agg_time.timed():
@@ -720,7 +761,7 @@ class TpuHashAggregateExec(UnaryTpuExec):
                 b = sp.get_batch()
                 MemoryBudget.get().reserve(b.device_memory_size())
                 try:
-                    return self._merge_kernel(b)
+                    return self._run(self._merge_kernel, b)
                 finally:
                     MemoryBudget.get().release(b.device_memory_size())
 
@@ -738,7 +779,7 @@ class TpuHashAggregateExec(UnaryTpuExec):
             result = last.get_batch()
             last.close()
             if self.mode != "partial":
-                result = self._final_kernel(result)
+                result = self._run(self._final_kernel, result)
         self.num_output_rows.add(result.row_count())
         yield self._count_output(result)
 

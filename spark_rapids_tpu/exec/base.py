@@ -67,11 +67,14 @@ class TpuExec:
 class StaticExpr:
     """Identity-keyed wrapper so a bound Expression can ride as a jit static
     argument: Expression overloads __eq__/__gt__/… to BUILD expression trees,
-    which breaks jax's static-argument hashing."""
-    __slots__ = ("expr",)
+    which breaks jax's static-argument hashing. `err_msgs` is the host-side
+    message box paired with the traced ANSI error flags a kernel evaluating
+    this expression returns (see kernel_errors)."""
+    __slots__ = ("expr", "err_msgs")
 
     def __init__(self, expr):
         self.expr = expr
+        self.err_msgs: list = []
 
     def __hash__(self):
         return id(self.expr)
@@ -111,6 +114,15 @@ def kernel_errors(ctx: EvalContext, msgs_box: list):
 def raise_kernel_errors(flags, msgs_box: list) -> None:
     """Host-side: raise the first ANSI violation a kernel reported."""
     for f, m in zip(flags, msgs_box):
+        if bool(f):
+            from ..errors import AnsiViolation
+            raise AnsiViolation(m)
+
+
+def raise_eager_errors(ctx: EvalContext) -> None:
+    """After un-jitted (eager) device evaluation the error flags in
+    ctx.errors are concrete — check and raise them in place."""
+    for f, m in ctx.errors or ():
         if bool(f):
             from ..errors import AnsiViolation
             raise AnsiViolation(m)

@@ -27,16 +27,22 @@ from .base import (StaticExpr as _StaticExpr, TpuExec, UnaryTpuExec,
                    batch_vecs, vecs_to_batch)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2))
-def _gen_counts(batch: ColumnarBatch, gen, outer: bool):
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def _gen_counts(batch: ColumnarBatch, gen, outer: bool, ansi: bool = False):
     from ..expr.base import EvalContext
+    from .base import kernel_errors
     xp = jnp
-    arr = gen.expr.children[0].eval(EvalContext(xp), batch_vecs(batch))
+    # row_mask keeps padding-tail garbage (compact_vecs leaves it
+    # unspecified) out of the ANSI flags; non-ANSI traces write a throwaway
+    # box so they cannot clobber the messages the ANSI trace recorded
+    ctx = EvalContext(xp, ansi=ansi, errors=[], row_mask=batch.row_mask())
+    arr = gen.expr.children[0].eval(ctx, batch_vecs(batch))
     sizes = xp.where(arr.validity & batch.row_mask(), arr.data, 0) \
         .astype(np.int32)
     slots = xp.maximum(sizes, 1) if outer else sizes
     slots = xp.where(batch.row_mask(), slots, 0)
-    return sizes, slots, xp.sum(slots).astype(np.int32)
+    return sizes, slots, xp.sum(slots).astype(np.int32), \
+        kernel_errors(ctx, gen.err_msgs if ansi else [])
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
@@ -47,7 +53,7 @@ def _gen_expand(batch: ColumnarBatch, gen, out_cap: int, outer: bool,
     arr = gen.expr.children[0].eval(EvalContext(xp), batch_vecs(batch))
     elem = arr.children[0]
     k = elem.data.shape[1]
-    sizes, slots, total = _gen_counts(batch, gen, outer)
+    sizes, slots, total, _ = _gen_counts(batch, gen, outer)
     cap = batch.capacity
     offsets = xp.cumsum(slots)
     j = xp.arange(out_cap, dtype=np.int32)
@@ -86,10 +92,14 @@ class TpuGenerateExec(UnaryTpuExec):
         return self._schema
 
     def do_execute(self) -> Iterator[ColumnarBatch]:
+        from .base import raise_kernel_errors
         g = self._bound.expr
+        ansi = self.conf.is_ansi
         for b in self.child.execute():
             with self.gen_time.timed():
-                _, _, total = _gen_counts(b, self._bound, g.outer)
+                _, _, total, errs = _gen_counts(b, self._bound, g.outer,
+                                                ansi)
+                raise_kernel_errors(errs, self._bound.err_msgs)
                 n_total = int(total)
                 if n_total == 0:
                     continue

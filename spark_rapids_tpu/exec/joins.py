@@ -81,13 +81,14 @@ def _probe_counts(probe: ColumnarBatch, build: ColumnarBatch,
     return counts, lo.astype(np.int32), order.astype(np.int32), pvalid, bvalid
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6))
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7))
 def _expand_join(probe: ColumnarBatch, build: ColumnarBatch,
                  probe_key_ix: Tuple[int, ...], build_key_ix: Tuple[int, ...],
-                 out_cap: int, join_type: str, condition=None):
+                 out_cap: int, join_type: str, condition=None,
+                 ansi: bool = False):
     """Phase 2: expand candidate ranges to pairs, equality-check (plus the
     optional non-equi join condition evaluated on the gathered pair), compact;
-    attach outer rows. Returns (out_vecs, n, bmatched)."""
+    attach outer rows. Returns (out_vecs, n, bmatched, cond_errs)."""
     xp = jnp
     counts, lo, order, pvalid, bvalid = _probe_counts(
         probe, build, probe_key_ix, build_key_ix)
@@ -123,11 +124,20 @@ def _expand_join(probe: ColumnarBatch, build: ColumnarBatch,
     left_out = gather_vecs(xp, pvecs, pi)
     right_out = gather_vecs(xp, bvecs, bi)
 
+    cond_errs = ()
     if condition is not None:
-        # join condition over the combined row; NULL counts as no-match
+        # join condition over the combined row; NULL counts as no-match.
+        # ANSI arithmetic inside the condition reports through the same
+        # traced-flag channel projections use; rows outside live candidate
+        # pairs are masked out of the flags (they're gather artifacts).
         from ..expr.base import EvalContext
-        cvec = condition.expr.eval(EvalContext(xp), left_out + right_out)
+        from .base import kernel_errors
+        cctx = EvalContext(xp, ansi=ansi, errors=[],
+                           row_mask=eq & live)
+        cvec = condition.expr.eval(cctx, left_out + right_out)
         eq = eq & cvec.data.astype(bool) & cvec.validity
+        cond_errs = kernel_errors(cctx,
+                                  condition.err_msgs if ansi else [])
 
     matched = eq & live
     # per-probe-row "any true match" — candidate ranges can be pure hash
@@ -153,14 +163,14 @@ def _expand_join(probe: ColumnarBatch, build: ColumnarBatch,
             exists = Vec(T.BooleanType(), pmatched,
                          xp.ones(pcap, dtype=bool))
             out_vecs, n = compact_vecs(xp, pvecs + [exists], pmask)
-            return out_vecs, n, bmatched
+            return out_vecs, n, bmatched, cond_errs
         want = pmatched if join_type == "semi" else (~pmatched & pmask)
         out_vecs, n = compact_vecs(xp, pvecs, want & pmask)
-        return out_vecs, n, bmatched
+        return out_vecs, n, bmatched, cond_errs
 
     out_vecs = left_out + right_out
     compacted, n = compact_vecs(xp, out_vecs, keep)
-    return compacted, n, bmatched
+    return compacted, n, bmatched, cond_errs
 
 
 @functools.partial(jax.jit, static_argnums=(1,))
@@ -391,9 +401,12 @@ class TpuShuffledHashJoinExec(TpuExec):
                 out_cap = max(row_bucket(max(total, 1)), probe.capacity)
             else:
                 out_cap = row_bucket(max(total, 1))
-            out_vecs, n, bmatched = _expand_join(
+            out_vecs, n, bmatched, cond_errs = _expand_join(
                 probe, build, self._lk_ix, self._rk_ix, out_cap,
-                self.join_type, self._bcond)
+                self.join_type, self._bcond, self.conf.is_ansi)
+            if self._bcond is not None:
+                from .base import raise_kernel_errors
+                raise_kernel_errors(cond_errs, self._bcond.err_msgs)
             out = vecs_to_batch(self._schema, out_vecs, n)
         if self.join_type not in ("right", "full"):
             bmatched = None
@@ -492,23 +505,30 @@ def _null_vecs(schema: Schema, cap: int) -> List[Vec]:
     return [zero_vec(jnp, dt, (cap,)) for dt in schema.types]
 
 
-@functools.partial(jax.jit, static_argnums=(2,))
-def _nl_matched(probe: ColumnarBatch, bchunk: ColumnarBatch, cond):
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _nl_matched(probe: ColumnarBatch, bchunk: ColumnarBatch, cond,
+                ansi: bool = False):
     """All-pairs tile: matched mask over the P x C grid (flattened row-major),
-    plus per-probe-row / per-build-row any-match and the total."""
+    plus per-probe-row / per-build-row any-match, the total, and the ANSI
+    error flags from the condition (live pairs only)."""
     xp = jnp
     P, C = probe.capacity, bchunk.capacity
     pi = xp.repeat(xp.arange(P, dtype=np.int32), C)
     bi = xp.tile(xp.arange(C, dtype=np.int32), P)
     m = probe.row_mask()[pi] & bchunk.row_mask()[bi]
+    cond_errs = ()
     if cond is not None:
         from ..expr.base import EvalContext
+        from .base import kernel_errors
         gp = gather_vecs(xp, batch_vecs(probe), pi)
         gb = gather_vecs(xp, batch_vecs(bchunk), bi)
-        cv = cond.expr.eval(EvalContext(xp), gp + gb)
+        cctx = EvalContext(xp, ansi=ansi, errors=[], row_mask=m)
+        cv = cond.expr.eval(cctx, gp + gb)
         m = m & cv.data.astype(bool) & cv.validity
+        cond_errs = kernel_errors(cctx, cond.err_msgs if ansi else [])
     grid = m.reshape(P, C)
-    return m, grid.any(axis=1), grid.any(axis=0), xp.sum(m).astype(np.int32)
+    return m, grid.any(axis=1), grid.any(axis=0), \
+        xp.sum(m).astype(np.int32), cond_errs
 
 
 @functools.partial(jax.jit, static_argnums=(2,))
@@ -595,8 +615,13 @@ class TpuNestedLoopJoinExec(TpuExec):
                     for ci, sp in enumerate(chunks):
                         bchunk = sp.get_batch()
                         with self.join_time.timed():
-                            m, pm, bm, total = _nl_matched(probe, bchunk,
-                                                           self._bcond)
+                            m, pm, bm, total, cerrs = _nl_matched(
+                                probe, bchunk, self._bcond,
+                                self.conf.is_ansi)
+                            if self._bcond is not None:
+                                from .base import raise_kernel_errors
+                                raise_kernel_errors(cerrs,
+                                                    self._bcond.err_msgs)
                             pmatched = pm if pmatched is None \
                                 else (pmatched | pm)
                             if jt in ("right", "full"):

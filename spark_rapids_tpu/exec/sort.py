@@ -39,9 +39,12 @@ class TpuSortExec(UnaryTpuExec):
                        for e, a, nf in self.orders]
         self.sort_time = self.metrics.create(M.SORT_TIME, M.MODERATE)
         bound = self._bound
+        self._err_msgs: list = []
+        msgs_box = self._err_msgs
 
         @jax.jit
         def kernel(batch: ColumnarBatch):
+            from .base import kernel_errors
             ctx = device_ctx(batch, self.conf)
             vecs = batch_vecs(batch)
             mask = batch.row_mask()
@@ -50,13 +53,17 @@ class TpuSortExec(UnaryTpuExec):
                 groups.append(sort_keys_for(jnp, e.eval(ctx, vecs), asc, nf))
             order = lexsort_indices(jnp, groups, batch.capacity)
             out = gather_vecs(jnp, vecs, order)
-            return vecs_to_batch(batch.schema, out, batch.num_rows)
+            return vecs_to_batch(batch.schema, out, batch.num_rows), \
+                kernel_errors(ctx, msgs_box)
 
         self._kernel = kernel
 
     def sort_single_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
+        from .base import raise_kernel_errors
         with self.sort_time.timed():
-            return self._kernel(batch)
+            out, errs = self._kernel(batch)
+        raise_kernel_errors(errs, self._err_msgs)
+        return out
 
     def do_execute(self) -> Iterator[ColumnarBatch]:
         if self.each_batch:
@@ -80,12 +87,14 @@ class TpuSortExec(UnaryTpuExec):
     # -- out-of-core merge path (GpuOutOfCoreSortIterator analog) ----------
     def _host_key_groups(self, batch: ColumnarBatch) -> List[np.ndarray]:
         """D2H the sort-key arrays of a (sorted) run, host-comparable form."""
+        from .base import raise_eager_errors
         ctx = device_ctx(batch, self.conf)
         vecs = batch_vecs(batch)
         n = int(batch.row_count())
         flat: List[np.ndarray] = []
         for e, asc, nf in self._bound:
             v = e.eval(ctx, vecs)
+            raise_eager_errors(ctx)
             hv = Vec(v.dtype, np.asarray(v.data)[:n],
                      np.asarray(v.validity)[:n],
                      None if v.lengths is None else np.asarray(v.lengths)[:n])
@@ -207,9 +216,12 @@ class TpuTopKExec(UnaryTpuExec):
         from ..columnar.padding import row_bucket
         kcap = row_bucket(max(self._k, 1))
         k = self._k
+        self._err_msgs: list = []
+        msgs_box = self._err_msgs
 
         @jax.jit
         def topk(batch: ColumnarBatch):
+            from .base import kernel_errors
             ctx = device_ctx(batch, self.conf)
             vecs = batch_vecs(batch)
             mask = batch.row_mask()
@@ -222,9 +234,16 @@ class TpuTopKExec(UnaryTpuExec):
                 order, (0, kcap - batch.capacity))
             out = gather_vecs(jnp, vecs, take)
             new_n = jnp.minimum(batch.num_rows, k)
-            return vecs_to_batch(batch.schema, out, new_n)
+            return vecs_to_batch(batch.schema, out, new_n), \
+                kernel_errors(ctx, msgs_box)
 
-        self._topk = topk
+        self._topk_kernel = topk
+
+    def _topk(self, batch: ColumnarBatch) -> ColumnarBatch:
+        from .base import raise_kernel_errors
+        out, errs = self._topk_kernel(batch)
+        raise_kernel_errors(errs, self._err_msgs)
+        return out
 
     @property
     def output(self) -> Schema:

@@ -237,9 +237,12 @@ class TpuWindowExec(UnaryTpuExec):
         bound_part, bound_order = self._bound_part, self._bound_order
         bound_fns = self._bound_fns
         has_order = bool(order_spec)
+        self._err_msgs: list = []
+        msgs_box = self._err_msgs
 
         @jax.jit
         def kernel(batch: ColumnarBatch):
+            from .base import kernel_errors
             ctx = device_ctx(batch, self.conf)
             vecs = batch_vecs(batch)
             mask = batch.row_mask()
@@ -283,7 +286,8 @@ class TpuWindowExec(UnaryTpuExec):
             out = list(svecs)
             for fn, _ in bound_fns:
                 out.append(_eval_device(fn, env))
-            return vecs_to_batch(self._schema, out, batch.num_rows)
+            return vecs_to_batch(self._schema, out, batch.num_rows), \
+                kernel_errors(ctx, msgs_box)
 
         self._kernel = kernel
 
@@ -296,8 +300,10 @@ class TpuWindowExec(UnaryTpuExec):
         if not batches:
             return
         merged = concat_batches(batches)
+        from .base import raise_kernel_errors
         with self.window_time.timed():
-            out = self._kernel(merged)
+            out, errs = self._kernel(merged)
+        raise_kernel_errors(errs, self._err_msgs)
         self.num_output_rows.add(out.row_count())
         yield self._count_output(out)
 

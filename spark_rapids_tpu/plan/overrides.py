@@ -75,27 +75,11 @@ def _tag_cast(meta: ExprMeta) -> None:
                 "on TPU yet")
 
 
-# ANSI arithmetic raises host-side from error flags the project/filter
-# kernels return; contexts whose kernels don't plumb the flags (agg, sort,
-# window, generate, join conditions) fall back instead (see _ansi_context_tag)
-_ANSI_RISKY = (EA.Add, EA.Subtract, EA.Multiply, EA.Divide,
-               EA.IntegralDivide, EA.Remainder, EA.Pmod, EA.UnaryMinus,
-               EA.Abs, EC.Cast)
-
-
-def _ansi_context_tag(label, exprs_of):
-    def tag(m: PlanMeta) -> None:
-        if not m.conf.is_ansi:
-            return
-        for e in exprs_of(m.plan):
-            if e is None:
-                continue
-            if e.collect(lambda x: isinstance(x, _ANSI_RISKY)):
-                m.will_not_work(
-                    f"ANSI-mode arithmetic inside {label} is not plumbed for "
-                    "error surfacing on TPU (runs on CPU)")
-                return
-    return tag
+# ANSI arithmetic raises host-side from error flags the kernels return;
+# every expression-evaluating context (project, filter, agg, sort, window,
+# generate, join conditions) plumbs the traced flags back through
+# kernel_errors/raise_kernel_errors (exec/base.py), so no context-based
+# ANSI fallback remains.
 
 
 _basic = TypeSig.all_basic()
@@ -688,12 +672,7 @@ def _exprs_expand(m: PlanMeta):
             m.add_expr(e)
 
 
-_join_cond_ansi = _ansi_context_tag("join conditions",
-                                    lambda p: [p._bcond])
-
-
 def _tag_join(m: PlanMeta):
-    _join_cond_ansi(m)
     from ..expr.base import AttributeReference
     for e in m.plan.left_keys + m.plan.right_keys:
         if not isinstance(e, AttributeReference):
@@ -902,9 +881,6 @@ def _exprs_window(m: PlanMeta):
 
 def _tag_window(m: PlanMeta):
     from ..expr import windowexprs as WX
-    _ansi_context_tag("window", lambda p: [
-        f.children[0] if f.children else None
-        for f, _ in p._bound_fns])(m)
     has_order = bool(m.plan.order_spec)
     for f, name in m.plan._bound_fns:
         if f.requires_order and not has_order:
@@ -1017,24 +993,7 @@ exec_rule(N.CpuProjectExec, _nested38, _c_project,
           expr_fn=_exprs_project)
 exec_rule(N.CpuFilterExec, _nested38, _c_filter,
           expr_fn=_exprs_filter)
-_agg_ansi = _ansi_context_tag(
-    "aggregation", lambda p: list(p._bound_groups) +
-    [a.func.child for a in p._bound_aggs])
-
-
 def _tag_agg(m: PlanMeta) -> None:
-    _agg_ansi(m)
-    if m.conf.is_ansi:
-        # the ACCUMULATION itself can overflow under ANSI (SUM over BIGINT);
-        # the aggregation kernel doesn't surface error flags, so fall back —
-        # the CPU oracle detects accumulator overflow exactly
-        for a in m.plan._bound_aggs:
-            if isinstance(a.func, Sum) and T.is_integral(a.func.data_type):
-                m.will_not_work(
-                    "ANSI-mode integral SUM can overflow during "
-                    "accumulation; not plumbed for error surfacing on TPU "
-                    "(runs on CPU)")
-                break
     # nested types may only appear as collect_* OUTPUTS; nested group keys
     # and nested aggregate inputs stay on CPU
     for e in m.plan._bound_groups:
@@ -1057,16 +1016,13 @@ exec_rule(N.CpuHashAggregateExec, _nested38, _c_agg,
           expr_fn=_exprs_agg, tag_fn=_tag_agg)
 exec_rule(N.CpuHashJoinExec, TypeSig.all_with_nested(), _c_join,
           tag_fn=_tag_join, expr_fn=_exprs_join)
-_sort_ansi = _ansi_context_tag("sort keys",
-                               lambda p: [e for e, _, _ in p._bound])
-exec_rule(N.CpuSortExec, TypeSig.orderable(decimal_max=38), _c_sort, expr_fn=_exprs_sort,
-          tag_fn=_sort_ansi)
+exec_rule(N.CpuSortExec, TypeSig.orderable(decimal_max=38), _c_sort,
+          expr_fn=_exprs_sort)
 exec_rule(N.CpuLimitExec, _nested38, _c_limit)
 exec_rule(N.CpuSampleExec, _nested38, _c_sample)
 exec_rule(N.CpuUnionExec, _nested38, _c_union)
-_gen_ansi = _ansi_context_tag("generate", lambda p: [p._bound])
 exec_rule(N.CpuGenerateExec, TypeSig.all_with_nested(), _c_generate,
-          expr_fn=_exprs_generate, tag_fn=_gen_ansi)
+          expr_fn=_exprs_generate)
 exec_rule(N.CpuRangeExec, TypeSig.all_basic(), _c_range)
 exec_rule(N.CpuExpandExec, TypeSig.all_basic(), _c_expand,
           expr_fn=_exprs_expand)

@@ -144,9 +144,9 @@ class TestAnsiLazyBranches:
         q = df.select(r=TruncDate(col("d"), "DD"))
         assert q.collect().column("r").to_pylist() == [None]
 
-    def test_ansi_cast_in_agg_falls_back(self, ansi_session):
-        # Cast is ANSI-risky: inside an aggregation it must fall back (the
-        # agg kernel does not surface error flags) yet stay correct
+    def test_ansi_cast_in_agg(self, ansi_session):
+        # ANSI cast inside an aggregation: the agg kernel surfaces the cast
+        # overflow flags on device (and stays correct when in range)
         df = ansi_session.from_arrow(pa.table({"k": I(1, 1),
                                                "a": L(5, 6)}))
         q = df.group_by("k").agg(s=Sum(Cast(col("a"), T.INT)))
@@ -187,18 +187,177 @@ class TestAnsiMoreContexts:
 
 
 class TestAnsiContextFallback:
-    def test_agg_with_arithmetic_falls_back_but_correct(self, ansi_session):
-        # arithmetic inside an aggregation is not plumbed for device error
-        # flags: the planner keeps it on CPU, results still correct
+    def test_agg_with_arithmetic_on_device_correct(self, ansi_session):
+        # arithmetic inside an aggregation runs on device with its error
+        # flags plumbed back through the agg kernel
         df = ansi_session.from_arrow(pa.table({"k": I(1, 1, 2),
                                                "a": L(1, 2, 3)}))
         q = df.group_by("k").agg(s=Sum(Add(col("a"), lit(1))))
         tpu = q.collect().sort_by("k")
         assert tpu.column("s").to_pylist() == [5, 4]
 
-    def test_agg_arithmetic_raises_on_cpu_path(self, ansi_session):
+    def test_agg_arithmetic_overflow_raises(self, ansi_session):
         df = ansi_session.from_arrow(pa.table({"k": I(1, 1), "a": L(2**62,
                                                                     2**62)}))
         q = df.group_by("k").agg(s=Sum(Add(col("a"), col("a"))))
+        _raises_both(ansi_session, q)
+
+
+class TestAnsiPlumbedContexts:
+    """Round-4 (r3 verdict #10): every expression-evaluating exec kernel
+    returns its ANSI error flags — sort keys, window, generate, join
+    conditions — instead of tagging the whole exec back to CPU."""
+
+    def test_sort_key_overflow_raises(self, ansi_session):
+        df = ansi_session.from_arrow(pa.table({"a": L(2**62, 1)}))
+        _raises_both(ansi_session, df.sort(Add(col("a"), col("a"))))
+
+    def test_sort_key_arithmetic_ok_on_device(self, ansi_session):
+        df = ansi_session.from_arrow(pa.table({"a": L(3, 1, 2)}))
+        q = df.sort(Add(col("a"), lit(1)))
+        assert q.collect().column("a").to_pylist() == \
+            q.collect_cpu().column("a").to_pylist() == [1, 2, 3]
+
+    def test_topk_key_overflow_raises(self, ansi_session):
+        df = ansi_session.from_arrow(pa.table({"a": L(2**62, 1)}))
+        _raises_both(ansi_session,
+                     df.sort(Add(col("a"), col("a"))).limit(1))
+
+    def test_window_order_key_overflow_raises(self, ansi_session):
+        from spark_rapids_tpu.expr import RowNumber
+        df = ansi_session.from_arrow(pa.table({"k": I(1, 1),
+                                               "a": L(2**62, 1)}))
+        q = df.window(partition_by=["k"],
+                      order_by=[(Add(col("a"), col("a")), True, True)],
+                      rnk=RowNumber())
+        _raises_both(ansi_session, q)
+
+    def test_window_agg_input_overflow_raises(self, ansi_session):
+        df = ansi_session.from_arrow(pa.table({"k": I(1, 1),
+                                               "a": L(2**62, 7)}))
+        q = df.window(partition_by=["k"], s=Sum(Add(col("a"), col("a"))))
+        _raises_both(ansi_session, q)
+
+    def test_window_ok_on_device(self, ansi_session):
+        df = ansi_session.from_arrow(pa.table({"k": I(1, 1, 2),
+                                               "a": L(1, 2, 3)}))
+        q = df.window(partition_by=["k"], s=Sum(Add(col("a"), lit(1))))
+        assert sorted(q.collect().column("s").to_pylist()) == \
+            sorted(q.collect_cpu().column("s").to_pylist()) == [4, 5, 5]
+
+    def test_generate_overflow_raises(self, ansi_session):
+        from spark_rapids_tpu.expr.collections import CreateArray
+        df = ansi_session.from_arrow(pa.table({"a": L(2**62)}))
+        q = df.explode(CreateArray([Add(col("a"), col("a"))]))
+        _raises_both(ansi_session, q)
+
+    def test_join_condition_overflow_raises(self, ansi_session):
+        left = ansi_session.from_arrow(pa.table({"k": L(1, 2),
+                                                 "a": L(2**62, 1)}))
+        right = ansi_session.from_arrow(pa.table({"k": L(1, 2),
+                                                  "b": L(1, 2)}))
+        q = left.join(right, on="k",
+                      condition=Add(col("a"), col("a")) > col("b"))
+        _raises_both(ansi_session, q)
+
+    def test_join_condition_nonmatching_pairs_do_not_raise(self,
+                                                           ansi_session):
+        # the overflow row's key never matches: its pair is a gather
+        # artifact, masked out of the error flags (Spark never evaluates it)
+        left = ansi_session.from_arrow(pa.table({"k": L(1, 99),
+                                                 "a": L(5, 2**62)}))
+        right = ansi_session.from_arrow(pa.table({"k": L(1, 2),
+                                                  "b": L(1, 2)}))
+        q = left.join(right, on="k",
+                      condition=Add(col("a"), col("a")) > col("b"))
+        assert q.collect().column("a").to_pylist() == [5]
+
+    def test_nested_loop_join_condition_overflow_raises(self, ansi_session):
+        left = ansi_session.from_arrow(pa.table({"a": L(2**62)}))
+        right = ansi_session.from_arrow(pa.table({"b": L(1)}))
+        q = left.join(right, condition=Add(col("a"), col("a")) > col("b"))
+        _raises_both(ansi_session, q)
+
+
+class _BatchSource:
+    """A leaf exec yielding preset batches — drives multi-batch kernel paths
+    (merge passes, per-batch generate) that from_arrow's single batch never
+    reaches."""
+
+    def __new__(cls, tables, conf):
+        from spark_rapids_tpu.columnar.batch import batch_from_arrow
+        from spark_rapids_tpu.exec.base import TpuExec
+
+        class Src(TpuExec):
+            def __init__(self):
+                super().__init__([], conf)
+                self._batches = [batch_from_arrow(t) for t in tables]
+
+            @property
+            def output(self):
+                return self._batches[0].schema
+
+            def do_execute(self):
+                yield from self._batches
+
+        return Src()
+
+
+class TestAnsiMultiBatchKernels:
+    """Each kernel variant owns its error-message box: a second kernel's
+    trace must not clobber the messages a first kernel's cached flags zip
+    against (code-review regression, round 4)."""
+
+    def test_agg_merge_pass_batch2_overflow_raises(self, ansi_session):
+        from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+        from spark_rapids_tpu.plan.nodes import AggExpr
+        src = _BatchSource(
+            [pa.table({"k": I(1, 1), "a": L(1, 2)}),
+             pa.table({"k": I(1, 2), "a": L(2**62, 3)})],
+            ansi_session.conf)
+        agg = TpuHashAggregateExec([col("k")],
+                                   [AggExpr(Sum(Add(col("a"), col("a"))),
+                                            "s")],
+                                   src, ansi_session.conf, mode="complete")
         with pytest.raises(AnsiViolation):
-            q.collect()  # falls back to the CPU path, which raises eagerly
+            list(agg.execute())
+
+    def test_agg_merge_pass_no_overflow_correct(self, ansi_session):
+        from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+        from spark_rapids_tpu.plan.nodes import AggExpr
+        from spark_rapids_tpu.columnar.batch import batch_to_arrow
+        src = _BatchSource(
+            [pa.table({"k": I(1, 1), "a": L(1, 2)}),
+             pa.table({"k": I(1, 2), "a": L(5, 3)})],
+            ansi_session.conf)
+        agg = TpuHashAggregateExec([col("k")],
+                                   [AggExpr(Sum(Add(col("a"), col("a"))),
+                                            "s")],
+                                   src, ansi_session.conf, mode="complete")
+        out = pa.concat_tables([batch_to_arrow(b) for b in agg.execute()])
+        rows = dict(zip(out.column("k").to_pylist(),
+                        out.column("s").to_pylist()))
+        assert rows == {1: 16, 2: 6}
+
+    def test_generate_batch2_overflow_raises(self, ansi_session):
+        from spark_rapids_tpu.exec.generate import TpuGenerateExec
+        from spark_rapids_tpu.expr.collections import CreateArray, Explode
+        src = _BatchSource([pa.table({"a": L(1, 2)}),
+                            pa.table({"a": L(2**62)})],
+                           ansi_session.conf)
+        gen = TpuGenerateExec(Explode(CreateArray([Add(col("a"),
+                                                       col("a"))])),
+                              src, ansi_session.conf)
+        with pytest.raises(AnsiViolation):
+            list(gen.execute())
+
+    def test_generate_padding_tail_does_not_raise(self, ansi_session):
+        # a filtered-out overflow row lives on in the padding tail
+        # (compact_vecs leaves tail contents unspecified): the generate
+        # kernel's flags must be row-masked so Spark-never-evaluated rows
+        # cannot raise
+        from spark_rapids_tpu.expr.collections import CreateArray
+        df = ansi_session.from_arrow(pa.table({"a": L(2**62, 3)}))
+        q = df.filter(col("a") < lit(10)) \
+              .explode(CreateArray([Add(col("a"), col("a"))]))
+        assert q.collect().column("col").to_pylist() == [6]
