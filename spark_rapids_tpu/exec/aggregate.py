@@ -138,6 +138,13 @@ class TpuHashAggregateExec(UnaryTpuExec):
         # tree shared by every fanout-bucket specialization).
         self._err_msgs: list = []
         self._kernel_boxes: dict = {}
+        # eager-fanout group keys / agg inputs (split, str_to_map, pandas
+        # UDFs) cannot be traced: run the kernels un-jitted, like
+        # TpuProjectExec's black-box mode — jnp ops still hit the device
+        from .basic import has_host_black_box
+        self._eager = has_host_black_box(
+            list(self._bound_groups) +
+            [a.func.child for a in self._bound_aggs])
         raw_in = mode in ("complete", "partial")
         self._kernel = self._make_kernel(
             input_partial=not raw_in,
@@ -222,7 +229,11 @@ class TpuHashAggregateExec(UnaryTpuExec):
             return vecs_to_batch(out_schema, out_vecs, ng), \
                 kernel_errors(ctx, msgs_box)
 
-        jitted = jax.jit(kernel)
+        # merge/final kernels (input_partial) only read partial buffers —
+        # never the black-box expressions — so they stay jitted even in
+        # eager mode
+        jitted = kernel if (self._eager and not input_partial) \
+            else jax.jit(kernel)
         self._kernel_boxes[jitted] = msgs_box
         return jitted
 
@@ -522,7 +533,8 @@ class TpuHashAggregateExec(UnaryTpuExec):
             # jit caches live on the instance so they die with the exec (a
             # module-level cache keyed by self would pin every exec forever)
             if self._sp_maxes_jit is None:
-                self._sp_maxes_jit = jax.jit(self._sp_group_maxes)
+                self._sp_maxes_jit = self._sp_group_maxes if self._eager \
+                    else jax.jit(self._sp_group_maxes)
             maxes = self._sp_maxes_jit(b)
             ks = tuple(
                 width_bucket(max(int(m), 1)) if isinstance(
@@ -534,7 +546,9 @@ class TpuHashAggregateExec(UnaryTpuExec):
             kern = self._sp_kernel_jit.get(ks)
             if kern is None:
                 import functools
-                kern = jax.jit(functools.partial(self._sp_kernel, ks=ks))
+                kern = functools.partial(self._sp_kernel, ks=ks)
+                if not self._eager:
+                    kern = jax.jit(kern)
                 self._sp_kernel_jit[ks] = kern
             out = self._run(kern, b)
         self.num_output_rows.add(out.row_count())

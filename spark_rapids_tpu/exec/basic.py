@@ -48,6 +48,18 @@ class TpuScanExec(TpuExec):
                 break
 
 
+def has_host_black_box(exprs) -> bool:
+    """True when any expression is a host black box (pandas UDF) or needs
+    eager evaluation (data-dependent fanout, e.g. str_to_map/split): the
+    enclosing kernel then runs un-jitted — jnp ops still execute on device,
+    and the black box sees concrete arrays at the host hop."""
+    from ..udf.pandas_udf import PandasUDF
+    return any(e is not None and
+               e.collect(lambda x: isinstance(x, PandasUDF) or
+                         getattr(x, "needs_eager", False))
+               for e in exprs)
+
+
 class TpuProjectExec(UnaryTpuExec):
     def __init__(self, exprs: Sequence[Expression], child: TpuExec, conf=None):
         super().__init__([child], conf)
@@ -78,10 +90,7 @@ class TpuProjectExec(UnaryTpuExec):
             jax.jit(kernel)
 
     def _has_host_black_box(self) -> bool:
-        from ..udf.pandas_udf import PandasUDF
-        return any(e.collect(lambda x: isinstance(x, PandasUDF) or
-                             getattr(x, "needs_eager", False))
-                   for e in self._bound)
+        return has_host_black_box(self._bound)
 
     @property
     def output(self) -> Schema:
@@ -114,7 +123,6 @@ class TpuFilterExec(UnaryTpuExec):
         self._err_msgs: list = []
         msgs_box = self._err_msgs
 
-        @jax.jit
         def kernel(batch: ColumnarBatch):
             from .base import kernel_errors
             ctx = device_ctx(batch, self.conf)
@@ -125,7 +133,10 @@ class TpuFilterExec(UnaryTpuExec):
             return vecs_to_batch(batch.schema, out_vecs, new_n), \
                 kernel_errors(ctx, msgs_box)
 
-        self._kernel = kernel
+        # a condition containing a host black box (pandas UDF / eager
+        # fanout expr) runs the kernel eagerly, like TpuProjectExec
+        self._kernel = kernel if has_host_black_box([self._bound]) else \
+            jax.jit(kernel)
 
     def do_execute(self):
         from .base import raise_kernel_errors

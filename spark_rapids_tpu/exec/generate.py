@@ -33,8 +33,10 @@ def _gen_counts(batch: ColumnarBatch, gen, outer: bool, ansi: bool = False):
     from .base import kernel_errors
     xp = jnp
     # row_mask keeps padding-tail garbage (compact_vecs leaves it
-    # unspecified) out of the ANSI flags; non-ANSI traces write a throwaway
-    # box so they cannot clobber the messages the ANSI trace recorded
+    # unspecified) out of the ANSI flags. Every caller passes the SAME
+    # conf-derived `ansi` (do_execute and _gen_expand alike), so the shared
+    # message box stays consistent across traces; non-ANSI traces still
+    # record unconditional signals (raise_error/assert_true)
     ctx = EvalContext(xp, ansi=ansi, errors=[], row_mask=batch.row_mask())
     arr = gen.expr.children[0].eval(ctx, batch_vecs(batch))
     sizes = xp.where(arr.validity & batch.row_mask(), arr.data, 0) \
@@ -42,18 +44,18 @@ def _gen_counts(batch: ColumnarBatch, gen, outer: bool, ansi: bool = False):
     slots = xp.maximum(sizes, 1) if outer else sizes
     slots = xp.where(batch.row_mask(), slots, 0)
     return sizes, slots, xp.sum(slots).astype(np.int32), \
-        kernel_errors(ctx, gen.err_msgs if ansi else [])
+        kernel_errors(ctx, gen.err_msgs)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5))
 def _gen_expand(batch: ColumnarBatch, gen, out_cap: int, outer: bool,
-                position: bool):
+                position: bool, ansi: bool = False):
     from ..expr.base import EvalContext
     xp = jnp
     arr = gen.expr.children[0].eval(EvalContext(xp), batch_vecs(batch))
     elem = arr.children[0]
     k = elem.data.shape[1]
-    sizes, slots, total, _ = _gen_counts(batch, gen, outer)
+    sizes, slots, total, _ = _gen_counts(batch, gen, outer, ansi)
     cap = batch.capacity
     offsets = xp.cumsum(slots)
     j = xp.arange(out_cap, dtype=np.int32)
@@ -105,7 +107,7 @@ class TpuGenerateExec(UnaryTpuExec):
                     continue
                 out_vecs, n = _gen_expand(b, self._bound,
                                           row_bucket(n_total), g.outer,
-                                          g.position)
+                                          g.position, ansi)
                 out = vecs_to_batch(self._schema, out_vecs, n)
             self.num_output_rows.add(out.row_count())
             yield self._count_output(out)

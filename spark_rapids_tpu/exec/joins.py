@@ -132,12 +132,14 @@ def _expand_join(probe: ColumnarBatch, build: ColumnarBatch,
         # pairs are masked out of the flags (they're gather artifacts).
         from ..expr.base import EvalContext
         from .base import kernel_errors
+        # the box is safe to share across traces: `ansi` is constant for a
+        # given exec instance (conf-derived), and non-ANSI traces still
+        # record unconditional signals (raise_error/assert_true)
         cctx = EvalContext(xp, ansi=ansi, errors=[],
                            row_mask=eq & live)
         cvec = condition.expr.eval(cctx, left_out + right_out)
         eq = eq & cvec.data.astype(bool) & cvec.validity
-        cond_errs = kernel_errors(cctx,
-                                  condition.err_msgs if ansi else [])
+        cond_errs = kernel_errors(cctx, condition.err_msgs)
 
     matched = eq & live
     # per-probe-row "any true match" — candidate ranges can be pure hash
@@ -525,7 +527,7 @@ def _nl_matched(probe: ColumnarBatch, bchunk: ColumnarBatch, cond,
         cctx = EvalContext(xp, ansi=ansi, errors=[], row_mask=m)
         cv = cond.expr.eval(cctx, gp + gb)
         m = m & cv.data.astype(bool) & cv.validity
-        cond_errs = kernel_errors(cctx, cond.err_msgs if ansi else [])
+        cond_errs = kernel_errors(cctx, cond.err_msgs)
     grid = m.reshape(P, C)
     return m, grid.any(axis=1), grid.any(axis=0), \
         xp.sum(m).astype(np.int32), cond_errs

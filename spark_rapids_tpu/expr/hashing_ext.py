@@ -19,7 +19,6 @@ from typing import List, Sequence
 import numpy as np
 
 from .. import types as T
-from ..columnar.padding import width_bucket
 from .base import EvalContext, Expression, Vec, all_valid
 
 __all__ = ["Md5", "Sha1", "Sha2", "Crc32", "XxHash64", "HiveHash"]
@@ -321,6 +320,117 @@ def _sha2_digest(xp, data, lens, init, out_words: int):
     return out
 
 
+def _gen_sha512_consts():
+    """SHA-384/512 round and init constants, derived from the FIPS 180-4
+    definitions (frac parts of prime roots) at import time — 50-digit
+    Decimal precision covers the 64 fraction bits exactly."""
+    from decimal import Decimal, getcontext
+    getcontext().prec = 60
+    primes, c = [], 2
+    while len(primes) < 80:
+        if all(c % p for p in primes):
+            primes.append(c)
+        c += 1
+    two64 = 1 << 64
+
+    def frac_bits(x: "Decimal") -> int:
+        return int((x - int(x)) * two64) & (two64 - 1)
+
+    k = [frac_bits(Decimal(p) ** (Decimal(1) / 3)) for p in primes]
+    h512 = [frac_bits(Decimal(p).sqrt()) for p in primes[:8]]
+    h384 = [frac_bits(Decimal(p).sqrt()) for p in primes[8:16]]
+    return (np.array(k, np.uint64), tuple(np.uint64(v) for v in h512),
+            tuple(np.uint64(v) for v in h384))
+
+
+_SHA512_K, _SHA512_H, _SHA384_H = _gen_sha512_consts()
+
+
+def _rotr64(x, r):
+    return (x >> _U64(r)) | (x << _U64(64 - r))
+
+
+def _padded_message_128(xp, data, lens):
+    """SHA-512 padding: 128-byte blocks, 16-byte big-endian bit length
+    (top 8 bytes are always zero for any in-memory string)."""
+    n, w = data.shape
+    pw = ((w + 16) // 128 + 1) * 128
+    pos = xp.arange(pw, dtype=np.int32)[None, :]
+    lens32 = lens[:, None].astype(np.int32)
+    msg = xp.concatenate([data, xp.zeros((n, pw - w), np.uint8)], axis=1) \
+        if w != pw else data
+    msg = xp.where(pos < lens32, msg, 0).astype(np.uint8)
+    msg = xp.where(pos == lens32, np.uint8(0x80), msg)
+    nblocks = (lens.astype(np.int64) + 16) // 128 + 1
+    pad_start = (nblocks * 128 - 8)[:, None]  # low half of the length field
+    bitlen = (lens.astype(np.int64) * 8)[:, None]
+    k = pos - pad_start
+    in_len = (k >= 0) & (k < 8)
+    shift = xp.clip(7 - k, 0, 7).astype(np.int64) * 8
+    lb = ((bitlen >> shift) & 0xFF).astype(np.uint8)
+    msg = xp.where(in_len, lb, msg)
+    return msg, nblocks, pw // 128
+
+
+def _u64_words_be(msg, xp, b):
+    blk = msg[:, b * 128:(b + 1) * 128].astype(np.uint64)
+    return [sum_or64(xp, [blk[:, j * 8 + t] << _U64(8 * (7 - t))
+                          for t in range(8)]) for j in range(16)]
+
+
+def sum_or64(xp, parts):
+    out = parts[0]
+    for p in parts[1:]:
+        out = out | p
+    return out
+
+
+def _sha512_digest(xp, data, lens, init, out_words: int):
+    msg, nblocks, total = _padded_message_128(xp, data, lens)
+    n = data.shape[0]
+    state0 = tuple(xp.full(n, v, np.uint64) for v in init)
+    KT = xp.asarray(_SHA512_K)
+
+    def round512(a, bb, c, d, e, f, g, hh, k_i, w_i):
+        S1 = _rotr64(e, 14) ^ _rotr64(e, 18) ^ _rotr64(e, 41)
+        ch = (e & f) ^ (~e & g)
+        t1 = hh + S1 + ch + k_i + w_i
+        S0 = _rotr64(a, 28) ^ _rotr64(a, 34) ^ _rotr64(a, 39)
+        t2 = S0 + ((a & bb) ^ (a & c) ^ (bb & c))
+        return t1 + t2, a, bb, c, d + t1, e, f, g
+
+    def compress(state, b):
+        w = _u64_words_be(msg, xp, b)
+        for i in range(16, 80):  # schedule unrolled: cheap shifts/xors
+            s0 = _rotr64(w[i - 15], 1) ^ _rotr64(w[i - 15], 8) ^ \
+                (w[i - 15] >> _U64(7))
+            s1 = _rotr64(w[i - 2], 19) ^ _rotr64(w[i - 2], 61) ^ \
+                (w[i - 2] >> _U64(6))
+            w.append(w[i - 16] + s0 + w[i - 7] + s1)
+        if xp is np:
+            a, bb, c, d, e, f, g, hh = state
+            for i in range(80):
+                a, bb, c, d, e, f, g, hh = round512(
+                    a, bb, c, d, e, f, g, hh, KT[i], w[i])
+        else:
+            from jax import lax
+            W = xp.stack(w)  # [80, n]
+
+            def body(i, st):
+                return round512(*st, KT[i], W[i])
+
+            a, bb, c, d, e, f, g, hh = lax.fori_loop(0, 80, body, state)
+        return tuple(s + v for s, v in
+                     zip(state, (a, bb, c, d, e, f, g, hh)))
+
+    out_state = _blocks_fold(xp, msg, nblocks, total, state0, compress)
+    out = []
+    for word in out_state[:out_words]:
+        for k in (7, 6, 5, 4, 3, 2, 1, 0):
+            out.append(((word >> _U64(8 * k)) & _U64(0xFF)).astype(np.uint8))
+    return out
+
+
 class Sha1(Expression):
     """sha1/sha(string) -> 40-char hex."""
 
@@ -338,8 +448,10 @@ class Sha1(Expression):
 
 
 class Sha2(Expression):
-    """sha2(string, bits) for bits in (0, 224, 256) — 0 means 256, like
-    Spark. 384/512 need 64-bit words (tagged to CPU)."""
+    """sha2(string, bits) for bits in (0, 224, 256, 384, 512) — 0 means
+    256, like Spark. 384/512 run the 64-bit-word schedule (x64 is on
+    package-wide, so uint64 lowers natively; TPUs emulate i64 with 32-bit
+    pairs, which XLA handles)."""
 
     def __init__(self, child: Expression, bits: int = 256):
         super().__init__([child])
@@ -361,27 +473,10 @@ class Sha2(Expression):
             out = _sha2_digest(xp, data, lens, _SHA224_H, 7)
         elif bits == 256:
             out = _sha2_digest(xp, data, lens, _SHA256_H, 8)
-        elif bits in (384, 512):
-            # 64-bit-word variants: host hashlib on the CPU engine, the
-            # planner tags them off device
-            from ..errors import CpuFallbackRequired
-            if xp is not np:
-                raise CpuFallbackRequired("sha2 384/512 runs on CPU")
-            import hashlib
-            n = data.shape[0]
-            outs = []
-            for i in range(n):
-                b = bytes(np.asarray(data[i, :int(lens[i])]))
-                h = hashlib.sha384(b) if bits == 384 else hashlib.sha512(b)
-                outs.append(h.hexdigest())
-            w = width_bucket(bits // 4)
-            dm = np.zeros((n, w), np.uint8)
-            lv = np.zeros(n, np.int32)
-            for i, hx in enumerate(outs):
-                eb = hx.encode()
-                dm[i, :len(eb)] = np.frombuffer(eb, np.uint8)
-                lv[i] = len(eb)
-            return Vec(T.STRING, dm, s.validity, lv)
+        elif bits == 384:
+            out = _sha512_digest(xp, data, lens, _SHA384_H, 6)
+        elif bits == 512:
+            out = _sha512_digest(xp, data, lens, _SHA512_H, 8)
         else:  # invalid bit width -> null (Spark semantics)
             n = data.shape[0]
             return Vec(T.STRING, xp.zeros((n, 8), np.uint8),

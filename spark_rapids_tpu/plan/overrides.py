@@ -231,14 +231,10 @@ from ..expr import splits as ESP  # noqa: E402
 
 _long_sig = TypeSig((T.LongType,))
 
-def _tag_sha2_bits(meta: ExprMeta) -> None:
-    if meta.expr.bits in (384, 512):
-        meta.will_not_work("sha2 384/512 needs 64-bit words (CPU only)")
-
-
 for cls in (EHX.Md5, EHX.Sha1):
     expr_rule(cls, _str)
-expr_rule(EHX.Sha2, _str, tag_fn=_tag_sha2_bits)
+# every Spark sha2 bit width (0/224/256/384/512) runs on device
+expr_rule(EHX.Sha2, _str)
 expr_rule(EHX.Crc32, _long_sig)
 expr_rule(EHX.XxHash64, _long_sig)
 expr_rule(EHX.HiveHash, _int)
@@ -1174,20 +1170,21 @@ class Overrides:
             meta.child_metas.append(self._tag_tree(c))
         if rule is not None and rule.expr_fn is not None:
             rule.expr_fn(meta)
-        if rule is not None and not isinstance(plan, N.CpuProjectExec):
+        if rule is not None and not isinstance(
+                plan, (N.CpuProjectExec, N.CpuFilterExec,
+                       N.CpuHashAggregateExec)):
             # a pandas UDF is a host black box, and needs_eager exprs
             # (data-dependent output fanout, e.g. str_to_map) cannot be
-            # traced: only TpuProjectExec knows to run its kernel eagerly
-            # (GpuArrowEvalPythonExec analog); any other exec would trace
-            # them inside jit and crash
-            from ..udf.pandas_udf import PandasUDF
+            # traced: the Project/Filter/HashAggregate execs run their
+            # kernels eagerly when one is present (GpuArrowEvalPythonExec
+            # analog); any other exec would trace them inside jit and crash
+            from ..exec.basic import has_host_black_box
             for em in meta.expr_metas:
-                if em.expr.collect(lambda x: isinstance(x, PandasUDF) or
-                                   getattr(x, "needs_eager", False)):
+                if has_host_black_box([em.expr]):
                     meta.will_not_work(
                         "host-eager expressions (pandas UDFs, str_to_map) "
-                        "are only supported in projections on TPU (project "
-                        "into a column first)")
+                        "are only supported in projections, filters, and "
+                        "aggregations on TPU (project into a column first)")
                     break
         if rule is not None and not isinstance(
                 plan, (N.CpuProjectExec, N.CpuFilterExec)):

@@ -61,13 +61,29 @@ class TestDigests:
             assert r["z"] == hashlib.sha256(b).hexdigest()  # 0 -> 256
             assert r["bad"] is None
 
-    def test_sha2_512_cpu_fallback(self, str_df):
-        q = str_df.select("i", h=Sha2(col("s"), 512))
-        got = q.collect()  # device plan falls back cleanly
-        for r, s in zip(got.sort_by([("i", "ascending")]).to_pylist(),
-                        STRS):
-            if s is not None:
-                assert r["h"] == hashlib.sha512(s.encode()).hexdigest()
+    def test_sha2_384_512_on_device(self, str_df):
+        # 64-bit-word schedule (SHA-512 family) runs on device; bit-exact
+        # vs hashlib on both engines, incl. lengths straddling the
+        # 112-byte single-block padding boundary (covered by STRS widths)
+        q = str_df.select("i", h384=Sha2(col("s"), 384),
+                          h512=Sha2(col("s"), 512))
+        out = assert_same(q, sort_by=["i"]).sort_by([("i", "ascending")])
+        for r, s in zip(out.to_pylist(), STRS):
+            if s is None:
+                continue
+            assert r["h384"] == hashlib.sha384(s.encode()).hexdigest()
+            assert r["h512"] == hashlib.sha512(s.encode()).hexdigest()
+
+    def test_sha2_512_block_boundaries(self, session):
+        # exact 111/112/127/128/129-byte messages: the 16-byte length field
+        # forces a second block starting at 112
+        strs = ["q" * n for n in (111, 112, 127, 128, 129, 240)]
+        t = pa.table({"s": pa.array(strs),
+                      "i": pa.array(range(len(strs)), type=pa.int64())})
+        q = session.from_arrow(t).select("i", h=Sha2(col("s"), 512))
+        out = assert_same(q, sort_by=["i"]).sort_by([("i", "ascending")])
+        for r, s in zip(out.to_pylist(), strs):
+            assert r["h"] == hashlib.sha512(s.encode()).hexdigest()
 
     def test_crc32(self, str_df):
         q = str_df.select("i", c=Crc32(col("s")))
@@ -151,6 +167,33 @@ class TestSplitAndZip:
         assert rows[1]["two"] == ["q", ""]
         assert rows[0]["zero"] == ["a", "b", "c", "d"]
         assert rows[1]["zero"] == ["q"]  # limit 0 drops trailing empty
+
+    def test_split_in_where_clause_on_device(self, session):
+        # needs_eager split() inside a FILTER condition: the kernel runs
+        # un-jitted on device instead of tagging the exec to CPU
+        t = pa.table({"s": pa.array(["a,b,c", "x", "p,q", None]),
+                      "i": pa.array(range(4), type=pa.int64())})
+        df = session.from_arrow(t)
+        from spark_rapids_tpu.expr import Size
+        q = df.filter(Size(StringSplit(col("s"), ",")) > lit(1)) \
+              .select("i")
+        assert sorted(q.collect().column("i").to_pylist()) == [0, 2]
+        assert sorted(q.collect_cpu().column("i").to_pylist()) == [0, 2]
+
+    def test_split_in_aggregation_on_device(self, session):
+        # needs_eager split() as an agg input / group key: eager kernels
+        from spark_rapids_tpu.expr import GetArrayItem, Size, Sum
+        from spark_rapids_tpu.expr.base import Alias
+        t = pa.table({"s": pa.array(["a,b", "a,b,c", "z", "a,b"]),
+                      "v": pa.array([1, 2, 3, 4], type=pa.int64())})
+        df = session.from_arrow(t)
+        q = df.group_by(
+            Alias(GetArrayItem(StringSplit(col("s"), ","), lit(0)),
+                  "k")).agg(
+            n=Sum(Size(StringSplit(col("s"), ","))))
+        tpu = {r["k"]: r["n"] for r in q.collect().to_pylist()}
+        cpu = {r["k"]: r["n"] for r in q.collect_cpu().to_pylist()}
+        assert tpu == cpu == {"a": 7, "z": 1}
 
     def test_split_regex_falls_back(self, session):
         t = pa.table({"s": pa.array(["a1b22c333d"])})

@@ -328,9 +328,9 @@ class TestReviewRegressions:
         assert out[0]["ars"] == [[1], [2, 3]]
         assert out[1]["ms"] == [[]]
 
-    def test_str_to_map_in_filter_falls_back(self, session):
-        # needs_eager exprs cannot live in jitted filter kernels: the
-        # planner must keep them off device there, answers stay correct
+    def test_str_to_map_in_filter_runs_eagerly(self, session):
+        # needs_eager exprs in a filter condition run the filter kernel
+        # un-jitted on device (round 4, r3 verdict #10)
         t = pa.table({"s": pa.array(["a:1,b:2", "x:9"]),
                       "i": pa.array(range(2), type=pa.int64())})
         df = session.from_arrow(t)

@@ -144,16 +144,16 @@ class TestUdfCompilerRegressions:
         q = df.select(col("x"), g(col("x")).alias("r"))
         assert_same(q, sort_by=["x", "r"])
 
-    def test_pandas_udf_in_filter_falls_back(self, session, rng):
+    def test_pandas_udf_in_filter_runs_eagerly(self, session, rng):
         @pandas_udf(T.DOUBLE)
         def ident(y):
             return y
 
         df = session.from_arrow(udf_table(rng, n=50))
         q = df.filter(ident(col("y")) > lit(0.0))
-        out = q.collect()  # must not crash: planner keeps the filter on CPU
+        out = q.collect()  # eager filter kernel hosts the UDF hop on device
         assert all(v > 0 for v in out.column("y").to_pylist())
-        assert "only supported in projections" in q.explain()
+        assert "only supported in projections" not in q.explain()
 
 
 class TestColumnarUdfSpi:
