@@ -146,11 +146,10 @@ def _search_value_range(env, frame, key: Vec, ascending: bool,
     # at null rows, whose raw bytes are garbage. Replace them with the
     # extreme matching their SORTED position so (gid, kd) stays monotone for
     # the binary search; the [first_valid, last_valid] clamp below then
-    # drops them from every frame. NOTE the engine's sort convention
-    # (ops/rowops.py sort_keys_for): null_key = ~validity when nulls_first,
-    # which places null rows at the END of the run — so nulls_first=True
-    # means the LARGEST sentinel here.
-    nulls_at_end = nulls_first
+    # drops them from every frame. Sort convention (ops/rowops.py
+    # sort_keys_for): nulls_first=True places null rows at the START of
+    # the run, so they need the SMALLEST sentinel here.
+    nulls_at_end = not nulls_first
     in_frame = valid  # rows eligible to appear in any value frame
     if jnp.issubdtype(kd.dtype, jnp.integer):
         info = np.iinfo(np.int64)

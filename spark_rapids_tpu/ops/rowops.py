@@ -52,7 +52,11 @@ def sort_keys_for(xp, v: Vec, ascending: bool, nulls_first: bool) -> List:
     from ..expr.base import require_flat_strings
     require_flat_strings(v, "sort key over string")
     dt = v.dtype
-    null_key = (~v.validity if nulls_first else v.validity).astype(np.int8)
+    # ascending lexsort: nulls-first wants null rows to carry the SMALLER
+    # key (valid=1 > null=0); nulls-last the larger (round-4 golden-oracle
+    # fix — the flag was inverted identically on both engines, which the
+    # differential harness cannot see)
+    null_key = (v.validity if nulls_first else ~v.validity).astype(np.int8)
     keys: List = [null_key]
     if v.is_string:
         lens = v.lengths.astype(np.int32)

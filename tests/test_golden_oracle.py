@@ -1,0 +1,523 @@
+"""Independent-oracle golden tests (r3 verdict directive #4, mirroring the
+role of reference `integration_tests/src/main/python/asserts.py:261-536`,
+which diffs the accelerator against *real Spark*).
+
+Every expected value here is computed by pandas / numpy / python
+`decimal` / `datetime` code written directly in the test — sharing NO
+code with the engine's expression or exec implementations — so a
+wrong-but-consistent Spark-semantics bug in the shared-xp kernels cannot
+cancel out the way it can in the `assert_same` device-vs-CPU harness.
+Coverage targets the highest-divergence-risk areas named by the verdict:
+decimal aggregation, datetime extraction, window frames, null ordering,
+plus the full TPC-DS-shaped corpus and the mortgage app end to end.
+
+The engine side always runs `.collect()` (the device engine)."""
+
+import datetime as dt
+import decimal
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr import (Average, CaseWhen, Count, If, Max, Min,
+                                   RowNumber, Sum, col, lit)
+from spark_rapids_tpu.plugin import TpuSession
+
+D = decimal.Decimal
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession({"spark.rapids.sql.enabled": True,
+                       "spark.rapids.sql.explain": "NONE",
+                       "spark.rapids.sql.adaptive.enabled": True,
+                       "spark.rapids.sql.optimizer.enabled": True})
+
+
+# ---------------------------------------------------------------------------
+# star schema (same shapes as test_tpcds_shapes, independently generated)
+# ---------------------------------------------------------------------------
+
+N_DATES, N_ITEMS, N_STORES, N_CUSTOMERS, N_SALES = 365, 60, 8, 150, 4000
+
+
+@pytest.fixture(scope="module")
+def star_tables():
+    rng = np.random.default_rng(7)
+    date_dim = pa.table({
+        "d_date_sk": pa.array(np.arange(N_DATES, dtype=np.int64)),
+        "d_year": pa.array((2020 + np.arange(N_DATES) // 365)
+                           .astype(np.int32)),
+        "d_moy": pa.array((np.arange(N_DATES) % 365 // 31 + 1)
+                          .astype(np.int32)),
+        "d_dow": pa.array((np.arange(N_DATES) % 7).astype(np.int32)),
+    })
+    item = pa.table({
+        "i_item_sk": pa.array(np.arange(N_ITEMS, dtype=np.int64)),
+        "i_brand": pa.array([f"brand{i % 9}" for i in range(N_ITEMS)]),
+        "i_category": pa.array([f"cat{i % 5}" for i in range(N_ITEMS)]),
+        "i_price": pa.array(rng.uniform(1, 200, N_ITEMS).round(2)),
+    })
+    store = pa.table({
+        "s_store_sk": pa.array(np.arange(N_STORES, dtype=np.int64)),
+        "s_state": pa.array([f"ST{i % 3}" for i in range(N_STORES)]),
+    })
+    customer = pa.table({
+        "c_customer_sk": pa.array(np.arange(N_CUSTOMERS, dtype=np.int64)),
+        "c_band": pa.array((np.arange(N_CUSTOMERS) % 10).astype(np.int32)),
+    })
+    nulls = rng.random(N_SALES) < 0.03
+    store_sales = pa.table({
+        "ss_sold_date_sk": pa.array(
+            rng.integers(0, N_DATES, N_SALES).astype(np.int64)),
+        "ss_item_sk": pa.array(
+            rng.integers(0, N_ITEMS, N_SALES).astype(np.int64)),
+        "ss_store_sk": pa.array(
+            rng.integers(0, N_STORES, N_SALES).astype(np.int64)),
+        "ss_customer_sk": pa.array(
+            rng.integers(0, N_CUSTOMERS, N_SALES).astype(np.int64)),
+        "ss_quantity": pa.array(
+            rng.integers(1, 20, N_SALES).astype(np.int32)),
+        "ss_sales_price": pa.array(
+            np.where(nulls, 0.0, rng.uniform(1, 250, N_SALES).round(2)),
+            mask=nulls),
+    })
+    return {"date_dim": date_dim, "item": item, "store": store,
+            "customer": customer, "store_sales": store_sales}
+
+
+@pytest.fixture(scope="module")
+def star(session, star_tables):
+    return {k: session.from_arrow(v, label=k)
+            for k, v in star_tables.items()}
+
+
+@pytest.fixture(scope="module")
+def pdf(star_tables):
+    return {k: v.to_pandas() for k, v in star_tables.items()}
+
+
+def _rows(table: pa.Table, keys):
+    """Engine output -> {key tuple: row dict} (keys as python values)."""
+    out = {}
+    for r in table.to_pylist():
+        out[tuple(r[k] for k in keys)] = r
+    return out
+
+
+class TestTpcdsGolden:
+    def test_q3_brand_report(self, star, pdf):
+        got = (star["store_sales"]
+               .join(star["date_dim"],
+                     condition=col("ss_sold_date_sk") == col("d_date_sk"),
+                     how="inner")
+               .filter(col("d_moy") == lit(11))
+               .join(star["item"],
+                     condition=col("ss_item_sk") == col("i_item_sk"),
+                     how="inner")
+               .group_by("d_year", "i_brand")
+               .agg(s=Sum(col("ss_sales_price")))).collect()
+        m = (pdf["store_sales"]
+             .merge(pdf["date_dim"], left_on="ss_sold_date_sk",
+                    right_on="d_date_sk"))
+        m = m[m.d_moy == 11].merge(pdf["item"], left_on="ss_item_sk",
+                                   right_on="i_item_sk")
+        exp = m.groupby(["d_year", "i_brand"])["ss_sales_price"] \
+            .sum(min_count=1)
+        rows = _rows(got, ("d_year", "i_brand"))
+        assert set(rows) == set(exp.index)
+        for k, v in exp.items():
+            gv = rows[k]["s"]
+            if pd.isna(v):
+                assert gv is None
+            else:
+                assert gv == pytest.approx(v, rel=1e-9)
+
+    def test_q7_category_averages(self, star, pdf):
+        got = (star["store_sales"]
+               .join(star["item"],
+                     condition=col("ss_item_sk") == col("i_item_sk"),
+                     how="inner")
+               .join(star["store"],
+                     condition=col("ss_store_sk") == col("s_store_sk"),
+                     how="inner")
+               .filter(col("s_state") == lit("ST1"))
+               .group_by("i_category")
+               .agg(q=Average(col("ss_quantity")),
+                    p=Average(col("ss_sales_price")),
+                    n=Count(lit(1)))).collect()
+        m = (pdf["store_sales"]
+             .merge(pdf["item"], left_on="ss_item_sk",
+                    right_on="i_item_sk")
+             .merge(pdf["store"], left_on="ss_store_sk",
+                    right_on="s_store_sk"))
+        m = m[m.s_state == "ST1"]
+        g = m.groupby("i_category")
+        rows = _rows(got, ("i_category",))
+        assert set(k for (k,) in rows) == set(g.groups)
+        for cat, grp in g:
+            r = rows[(cat,)]
+            assert r["q"] == pytest.approx(grp.ss_quantity.mean(), rel=1e-9)
+            assert r["p"] == pytest.approx(grp.ss_sales_price.mean(skipna=True),
+                                           rel=1e-9)
+            assert r["n"] == len(grp)
+
+    def test_q68_customer_rollup_with_rank(self, star, pdf):
+        per_cust = (star["store_sales"]
+                    .join(star["customer"],
+                          condition=col("ss_customer_sk")
+                          == col("c_customer_sk"), how="inner")
+                    .group_by("c_customer_sk", "c_band")
+                    .agg(spend=Sum(col("ss_sales_price")),
+                         qty=Sum(col("ss_quantity"))))
+        got = per_cust.window(partition_by=["c_band"],
+                              order_by=[(col("spend"), False, False)],
+                              rnk=RowNumber()).collect()
+        m = (pdf["store_sales"]
+             .merge(pdf["customer"], left_on="ss_customer_sk",
+                    right_on="c_customer_sk"))
+        exp = m.groupby(["c_customer_sk", "c_band"]).agg(
+            spend=("ss_sales_price", lambda s: s.sum(min_count=1)),
+            qty=("ss_quantity", "sum")).reset_index()
+        rows = _rows(got, ("c_customer_sk",))
+        assert len(rows) == len(exp)
+        for _, e in exp.iterrows():
+            r = rows[(e.c_customer_sk,)]
+            assert r["qty"] == e.qty
+            if pd.isna(e.spend):
+                assert r["spend"] is None
+            else:
+                assert r["spend"] == pytest.approx(e.spend, rel=1e-9)
+        # row_number semantics per band: spends listed by rank must equal
+        # spends sorted descending (nulls last — Spark desc NULLS LAST)
+        gdf = got.to_pandas()
+        for band, grp in gdf.groupby("c_band"):
+            by_rank = grp.sort_values("rnk")["spend"].tolist()
+            want = sorted([s for s in by_rank if not pd.isna(s)],
+                          reverse=True) + [s for s in by_rank if pd.isna(s)]
+            assert [s if not pd.isna(s) else None for s in by_rank] == \
+                [s if not pd.isna(s) else None for s in want]
+            assert sorted(grp["rnk"]) == list(range(1, len(grp) + 1))
+
+    def test_q96_selective_count(self, star, pdf):
+        got = (star["store_sales"]
+               .join(star["date_dim"],
+                     condition=col("ss_sold_date_sk") == col("d_date_sk"),
+                     how="inner")
+               .filter((col("d_dow") == lit(6)) &
+                       (col("ss_quantity") > lit(10)))
+               .join(star["store"],
+                     condition=col("ss_store_sk") == col("s_store_sk"),
+                     how="inner")
+               .agg(cnt=Count(lit(1)))).collect()
+        m = pdf["store_sales"].merge(
+            pdf["date_dim"], left_on="ss_sold_date_sk", right_on="d_date_sk")
+        m = m[(m.d_dow == 6) & (m.ss_quantity > 10)]
+        m = m.merge(pdf["store"], left_on="ss_store_sk",
+                    right_on="s_store_sk")
+        assert got.column("cnt").to_pylist() == [len(m)]
+
+    def test_q19_semi_anti(self, star, pdf):
+        nov = star["date_dim"].filter(col("d_moy") == lit(11))
+        sold_nov = star["store_sales"].join(
+            nov, condition=col("ss_sold_date_sk") == col("d_date_sk"),
+            how="semi")
+        got = (sold_nov.group_by("ss_store_sk")
+               .agg(n=Count(lit(1)), s=Sum(col("ss_sales_price")))).collect()
+        nov_dates = set(pdf["date_dim"][pdf["date_dim"].d_moy == 11]
+                        .d_date_sk)
+        sold = pdf["store_sales"][
+            pdf["store_sales"].ss_sold_date_sk.isin(nov_dates)]
+        g = sold.groupby("ss_store_sk")
+        rows = _rows(got, ("ss_store_sk",))
+        assert set(k for (k,) in rows) == set(g.groups)
+        for sk, grp in g:
+            assert rows[(sk,)]["n"] == len(grp)
+            assert rows[(sk,)]["s"] == pytest.approx(
+                grp.ss_sales_price.sum(min_count=1), rel=1e-9)
+        # anti: items never sold in november
+        never = star["item"].join(
+            star["store_sales"].join(
+                nov, condition=col("ss_sold_date_sk") == col("d_date_sk"),
+                how="semi"),
+            condition=col("i_item_sk") == col("ss_item_sk"), how="anti")
+        got2 = never.agg(n=Count(lit(1))).collect()
+        sold_items = set(sold.ss_item_sk)
+        exp_n = (~pdf["item"].i_item_sk.isin(sold_items)).sum()
+        assert got2.column("n").to_pylist() == [int(exp_n)]
+
+    def test_q36_case_rollup(self, star, pdf):
+        got = (star["store_sales"]
+               .join(star["item"],
+                     condition=col("ss_item_sk") == col("i_item_sk"),
+                     how="inner")
+               .select("i_category", "ss_quantity",
+                       margin=col("ss_sales_price") - col("i_price"),
+                       bucket=CaseWhen(
+                           [(col("ss_sales_price") > lit(200), lit("lux")),
+                            (col("ss_sales_price") > lit(50), lit("mid"))],
+                           lit("base")))
+               .group_by("i_category", "bucket")
+               .agg(m=Average(col("margin")), n=Count(lit(1)),
+                    hi=Max(col("margin")), lo=Min(col("margin")))).collect()
+        m = pdf["store_sales"].merge(pdf["item"], left_on="ss_item_sk",
+                                     right_on="i_item_sk")
+        price = m.ss_sales_price
+        m = m.assign(
+            margin=price - m.i_price,
+            bucket=np.select([price > 200, price > 50], ["lux", "mid"],
+                             "base"))
+        g = m.groupby(["i_category", "bucket"])
+        rows = _rows(got, ("i_category", "bucket"))
+        assert set(rows) == set(g.groups)
+        for k, grp in g:
+            r = rows[k]
+            assert r["n"] == len(grp)
+            if grp.margin.notna().any():
+                assert r["m"] == pytest.approx(grp.margin.mean(), rel=1e-9)
+                assert r["hi"] == pytest.approx(grp.margin.max(), rel=1e-9)
+                assert r["lo"] == pytest.approx(grp.margin.min(), rel=1e-9)
+            else:
+                assert r["m"] is None and r["hi"] is None and r["lo"] is None
+
+    def test_q65_join_of_aggregates(self, star, pdf):
+        per_si = (star["store_sales"]
+                  .group_by("ss_store_sk", "ss_item_sk")
+                  .agg(rev=Sum(col("ss_sales_price"))))
+        per_s = (per_si.group_by("ss_store_sk")
+                 .agg(avg_rev=Average(col("rev"))))
+        got = (per_si.join(per_s, on="ss_store_sk", how="inner")
+               .filter(col("rev") > col("avg_rev"))
+               .agg(n=Count(lit(1)), tot=Sum(col("rev")))).collect()
+        si = pdf["store_sales"].groupby(["ss_store_sk", "ss_item_sk"])[
+            "ss_sales_price"].sum(min_count=1).rename("rev").reset_index()
+        s = si.groupby("ss_store_sk")["rev"].mean().rename(
+            "avg_rev").reset_index()
+        j = si.merge(s, on="ss_store_sk")
+        j = j[j.rev > j.avg_rev]
+        assert got.column("n").to_pylist() == [len(j)]
+        assert got.column("tot").to_pylist()[0] == \
+            pytest.approx(j.rev.sum(), rel=1e-9)
+
+
+# ---------------------------------------------------------------------------
+# mortgage app golden
+# ---------------------------------------------------------------------------
+
+class TestMortgageGolden:
+    @pytest.fixture(scope="class")
+    def data(self):
+        from apps.mortgage import gen_acquisition, gen_performance
+        rng = np.random.default_rng(42)
+        return gen_performance(rng), gen_acquisition(rng)
+
+    def test_etl_golden(self, session, data):
+        from apps.mortgage import NAME_MAP, mortgage_etl
+        perf, acq = data
+        got = mortgage_etl(session, session.from_arrow(perf),
+                           session.from_arrow(acq)).collect()
+        p = perf.to_pandas()
+        a = acq.to_pandas()
+        summary = p.groupby("loan_id").agg(
+            months=("period", "count"),
+            max_dlq=("dlq_status", "max"),
+            ever_30=("dlq_status", lambda s: int((s >= 1).any())),
+            ever_90=("dlq_status", lambda s: int((s >= 3).any())),
+            ever_180=("dlq_status", lambda s: int((s >= 6).any())),
+            min_upb=("upb", "min"),
+            avg_rate=("interest_rate", "mean")).reset_index()
+        a = a.assign(seller=a.seller_name.map(NAME_MAP).fillna("Unknown"))
+        j = summary.merge(a, on="loan_id")
+        j = j.assign(
+            rate_spread=j.avg_rate - j.orig_rate,
+            risk=np.select([j.ever_180 == 1, j.ever_90 == 1,
+                            j.ever_30 == 1],
+                           ["severe", "high", "watch"], "performing"))
+        rows = _rows(got, ("loan_id",))
+        assert len(rows) == len(j)
+        for _, e in j.iterrows():
+            r = rows[(e.loan_id,)]
+            assert r["months"] == e.months
+            assert r["max_dlq"] == e.max_dlq
+            assert (r["ever_30"], r["ever_90"], r["ever_180"]) == \
+                (e.ever_30, e.ever_90, e.ever_180)
+            assert r["risk"] == e.risk
+            assert r["min_upb"] == pytest.approx(e.min_upb, rel=1e-9)
+            if pd.isna(e.avg_rate):
+                assert r["avg_rate"] is None
+            else:
+                assert r["avg_rate"] == pytest.approx(e.avg_rate, rel=1e-9)
+                assert r["rate_spread"] == pytest.approx(e.rate_spread,
+                                                         rel=1e-9)
+
+    def test_simple_aggregates_golden(self, session, data):
+        from apps.mortgage import simple_aggregates
+        perf, _ = data
+        got = simple_aggregates(session,
+                                session.from_arrow(perf)).collect()
+        p = perf.to_pandas()
+        g = p.groupby("servicer")
+        rows = _rows(got, ("servicer",))
+        assert set(k for (k,) in rows) == set(g.groups)
+        for sv, grp in g:
+            r = rows[(sv,)]
+            assert r["loans"] == len(grp)
+            assert r["avg_upb"] == pytest.approx(grp.upb.mean(), rel=1e-9)
+            assert r["total_upb"] == pytest.approx(grp.upb.sum(), rel=1e-9)
+            assert r["worst"] == grp.dlq_status.max()
+            assert r["d30"] == int((grp.dlq_status >= 1).sum())
+            assert r["d90"] == int((grp.dlq_status >= 3).sum())
+
+
+# ---------------------------------------------------------------------------
+# targeted high-divergence-risk areas
+# ---------------------------------------------------------------------------
+
+class TestDecimalAggGolden:
+    def test_decimal_sum_exact_vs_python_decimal(self, session):
+        # decimal(25,3): wide enough for the 128-bit limb path; exact sums
+        # computed with python decimal, no float in the oracle
+        rng = np.random.default_rng(11)
+        n = 500
+        vals = [D(int(rng.integers(-10**12, 10**12))).scaleb(-3)
+                for _ in range(n)]
+        keys = rng.integers(0, 7, n).astype(np.int32)
+        t = pa.table({"k": pa.array(keys),
+                      "d": pa.array(vals, type=pa.decimal128(25, 3))})
+        got = (session.from_arrow(t).group_by("k")
+               .agg(s=Sum(col("d")))).collect()
+        exp = {}
+        for k, v in zip(keys.tolist(), vals):
+            exp[k] = exp.get(k, D(0)) + v
+        rows = _rows(got, ("k",))
+        assert set(k for (k,) in rows) == set(exp)
+        for k, v in exp.items():
+            assert rows[(k,)]["s"] == v  # exact decimal equality
+
+    def test_decimal_sum_with_nulls(self, session):
+        t = pa.table({"k": pa.array([1, 1, 2, 2], type=pa.int32()),
+                      "d": pa.array([D("1.5"), None, None, None],
+                                    type=pa.decimal128(20, 2))})
+        got = (session.from_arrow(t).group_by("k")
+               .agg(s=Sum(col("d")))).collect()
+        rows = _rows(got, ("k",))
+        assert rows[(1,)]["s"] == D("1.50")
+        assert rows[(2,)]["s"] is None  # all-null group sums to NULL
+
+
+class TestDatetimeGolden:
+    def test_extract_fields_vs_python_datetime(self, session):
+        from spark_rapids_tpu.expr import (DayOfMonth, DayOfWeek, DayOfYear,
+                                           Month, Quarter, Year)
+        dates = [dt.date(1970, 1, 1), dt.date(2000, 2, 29),
+                 dt.date(2020, 12, 31), dt.date(1969, 7, 20),
+                 dt.date(2024, 2, 29), dt.date(1900, 3, 1),
+                 dt.date(2038, 1, 19)]
+        t = pa.table({"d": pa.array(dates, type=pa.date32()),
+                      "i": pa.array(range(len(dates)), type=pa.int64())})
+        got = session.from_arrow(t).select(
+            "i", y=Year(col("d")), m=Month(col("d")),
+            dom=DayOfMonth(col("d")), doy=DayOfYear(col("d")),
+            q=Quarter(col("d")), dow=DayOfWeek(col("d"))).collect()
+        rows = _rows(got, ("i",))
+        for i, d in enumerate(dates):
+            r = rows[(i,)]
+            assert r["y"] == d.year
+            assert r["m"] == d.month
+            assert r["dom"] == d.day
+            assert r["doy"] == d.timetuple().tm_yday
+            assert r["q"] == (d.month - 1) // 3 + 1
+            # Spark dayofweek: 1 = Sunday ... 7 = Saturday
+            assert r["dow"] == d.isoweekday() % 7 + 1
+
+
+class TestWindowFrameGolden:
+    def test_running_sum_rows_frame_vs_pandas_cumsum(self, session):
+        from spark_rapids_tpu.expr.windowexprs import (RowFrame,
+                                                       WindowAggregate)
+        rng = np.random.default_rng(3)
+        n = 200
+        t = pa.table({
+            "g": pa.array(rng.integers(0, 5, n).astype(np.int32)),
+            "o": pa.array(np.arange(n, dtype=np.int64)),
+            "v": pa.array(rng.integers(-50, 50, n).astype(np.int64)),
+        })
+        got = session.from_arrow(t).window(
+            partition_by=["g"], order_by=[(col("o"), True, True)],
+            run=WindowAggregate(Sum(col("v")), RowFrame(None, 0)),
+            last3=WindowAggregate(Sum(col("v")), RowFrame(-2, 0)),
+            center=WindowAggregate(Min(col("v")), RowFrame(-1, 1))).collect()
+        p = t.to_pandas().sort_values(["g", "o"])
+        p["run"] = p.groupby("g")["v"].cumsum()
+        p["last3"] = p.groupby("g")["v"].transform(
+            lambda s: s.rolling(3, min_periods=1).sum())
+        p["center"] = p.groupby("g")["v"].transform(
+            lambda s: s.rolling(3, min_periods=1, center=True).min())
+        rows = _rows(got, ("o",))
+        for _, e in p.iterrows():
+            r = rows[(e.o,)]
+            assert r["run"] == e.run
+            assert r["last3"] == e.last3
+            assert r["center"] == e.center
+
+    def test_rank_vs_pandas_rank(self, session):
+        from spark_rapids_tpu.expr import DenseRank, Rank
+        t = pa.table({
+            "g": pa.array([1, 1, 1, 1, 2, 2, 2], type=pa.int32()),
+            "v": pa.array([10, 10, 20, 30, 5, 5, 5], type=pa.int64()),
+            "i": pa.array(range(7), type=pa.int64()),
+        })
+        got = session.from_arrow(t).window(
+            partition_by=["g"], order_by=[(col("v"), True, True)],
+            r=Rank(), dr=DenseRank()).collect()
+        p = t.to_pandas()
+        p["r"] = p.groupby("g")["v"].rank(method="min").astype(int)
+        p["dr"] = p.groupby("g")["v"].rank(method="dense").astype(int)
+        rows = _rows(got, ("i",))
+        for _, e in p.iterrows():
+            assert rows[(e.i,)]["r"] == e.r
+            assert rows[(e.i,)]["dr"] == e.dr
+
+
+class TestNullOrderingGolden:
+    def test_sort_null_placement_explicit(self, session):
+        t = pa.table({"v": pa.array([3, None, 1, None, 2],
+                                    type=pa.int64()),
+                      "i": pa.array(range(5), type=pa.int64())})
+        df = session.from_arrow(t)
+        # asc nulls first (Spark default for asc)
+        got = df.sort((col("v"), True, True)).collect()
+        assert got.column("v").to_pylist() == [None, None, 1, 2, 3]
+        # asc nulls last
+        got = df.sort((col("v"), True, False)).collect()
+        assert got.column("v").to_pylist() == [1, 2, 3, None, None]
+        # desc nulls last (Spark default for desc)
+        got = df.sort((col("v"), False, False)).collect()
+        assert got.column("v").to_pylist() == [3, 2, 1, None, None]
+        # desc nulls first
+        got = df.sort((col("v"), False, True)).collect()
+        assert got.column("v").to_pylist() == [None, None, 3, 2, 1]
+
+    def test_sort_string_nulls_and_ties_stable_keys(self, session):
+        t = pa.table({"s": pa.array(["b", None, "a", "", None, "b"]),
+                      "i": pa.array(range(6), type=pa.int64())})
+        got = session.from_arrow(t).sort((col("s"), True, True),
+                                         (col("i"), True, True)).collect()
+        assert got.column("s").to_pylist() == \
+            [None, None, "", "a", "b", "b"]
+        assert got.column("i").to_pylist() == [1, 4, 3, 2, 0, 5]
+
+    def test_groupby_null_key_is_a_group(self, session):
+        t = pa.table({"k": pa.array([1, None, 1, None, 2],
+                                    type=pa.int64()),
+                      "v": pa.array([10, 20, 30, 40, 50],
+                                    type=pa.int64())})
+        got = (session.from_arrow(t).group_by("k")
+               .agg(s=Sum(col("v")), n=Count(col("v")))).collect()
+        rows = _rows(got, ("k",))
+        assert rows[(1,)] == {"k": 1, "s": 40, "n": 2}
+        assert rows[(2,)] == {"k": 2, "s": 50, "n": 1}
+        assert rows[(None,)] == {"k": None, "s": 60, "n": 2}
