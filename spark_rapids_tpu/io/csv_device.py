@@ -35,42 +35,99 @@ from .. import types as T
 from ..columnar.padding import row_bucket, width_bucket
 from .parquet_device import DeviceDecodeUnsupported
 
-__all__ = ["device_decode_csv_file", "csv_device_supported"]
+__all__ = ["device_decode_csv_file", "csv_device_supported",
+           "device_decode_hive_file", "hive_device_supported"]
+
+_SUPPORTED_TYPES = (T.StringType, T.BooleanType, T.ByteType, T.ShortType,
+                    T.IntegerType, T.LongType, T.FloatType, T.DoubleType,
+                    T.DateType)
 
 
-def csv_device_supported(scan) -> bool:
-    sep = scan.options.get("sep", ",")
+def _delimited_supported(scan, default_sep: str) -> bool:
+    sep = scan.options.get("sep", default_sep)
     if len(sep) != 1 or ord(sep) > 127:
         return False
     if scan.options.get("schema") is None:
         return False  # typed output needs a declared schema
-    for dt in scan.options["schema"].types:
-        if not isinstance(dt, (T.StringType, T.BooleanType, T.ByteType,
-                               T.ShortType, T.IntegerType, T.LongType,
-                               T.FloatType, T.DoubleType, T.DateType)):
-            return False
-    return True
+    return all(isinstance(dt, _SUPPORTED_TYPES)
+               for dt in scan.options["schema"].types)
+
+
+def csv_device_supported(scan) -> bool:
+    return _delimited_supported(scan, ",")
+
+
+def hive_device_supported(scan) -> bool:
+    return _delimited_supported(scan, "\x01")
 
 
 def device_decode_csv_file(scan, path: str
                            ) -> Iterator[Tuple[object, int]]:
-    """Yield (device ColumnarBatch, nrows) for one file, parsing fields
-    and types on device. Raises DeviceDecodeUnsupported for shapes the
-    vectorized parser can't honor (caller keeps the host path)."""
+    """Yield (device ColumnarBatch, nrows) for one CSV file, parsing
+    fields and types on device. Raises DeviceDecodeUnsupported for shapes
+    the vectorized parser can't honor (caller keeps the host path)."""
+    return _device_decode_delimited(
+        scan, path,
+        sep=np.uint8(ord(scan.options.get("sep", ","))),
+        header=scan.options.get("header", True),
+        null_markers=scan.options.get("null_values",
+                                      ["", "null", "NULL"]),
+        keep_empty=False,
+        reject_quote=np.uint8(ord(scan.options.get("quote", '"'))))
+
+
+def device_decode_hive_file(scan, path: str
+                            ) -> Iterator[Tuple[object, int]]:
+    """Hive LazySimpleSerDe on device: \\x01 splits, \\N nulls, NO
+    quoting (quote bytes are data), blank lines ARE rows (first column
+    empty string, the rest null), short rows null-padded, extra fields
+    dropped — the same device parse parameterized for the serde
+    (reference GpuHiveTableScanExec + hive text serde)."""
+    return _device_decode_delimited(
+        scan, path,
+        sep=np.uint8(ord(scan.options.get("sep", "\x01"))),
+        header=False, null_markers=["\\N"], keep_empty=True,
+        reject_quote=None)
+
+
+def _device_decode_delimited(scan, path, *, sep, header, null_markers,
+                             keep_empty, reject_quote
+                             ) -> Iterator[Tuple[object, int]]:
     import jax.numpy as jnp
     from ..config import get_default_conf
 
     schema = scan.options["schema"]
-    sep = np.uint8(ord(scan.options.get("sep", ",")))
-    quote = np.uint8(ord(scan.options.get("quote", '"')))
-    header = scan.options.get("header", True)
-
     blob = np.fromfile(path, np.uint8)
     if blob.size == 0:
         return  # empty file: zero rows
-    if (blob == quote).any():
+    if reject_quote is not None and (blob == reject_quote).any():
         raise DeviceDecodeUnsupported("quoted CSV falls back to host")
-    # host newline scan: the single sequential-ish step, fully vectorized
+    row_starts, row_ends = frame_lines(blob, keep_empty)
+    if header and row_starts.size:
+        row_starts, row_ends = row_starts[1:], row_ends[1:]
+    total_rows = int(row_starts.size)
+    if total_rows == 0:
+        return
+    conf = get_default_conf()
+    # EVERY fallback condition validates here, before the first yield, so
+    # the caller can stream chunks without materializing the whole file
+    check_row_width(row_starts, row_ends, conf)
+    chunk_rows = max(int(conf.get("spark.rapids.sql.batchSizeRows")), 1)
+    blob_dev = jnp.asarray(blob)
+    for at in range(0, total_rows, chunk_rows):
+        yield _decode_rows(scan, schema,
+                           row_starts[at:at + chunk_rows],
+                           row_ends[at:at + chunk_rows], blob_dev, sep,
+                           null_markers)
+
+
+def frame_lines(blob: np.ndarray, keep_empty: bool = False):
+    """Host newline scan (the single sequential-ish step, fully
+    vectorized) -> per-row [start, end) with \\r stripped. Shared by the
+    CSV/hive/json device parsers. keep_empty=False drops empty lines and
+    the phantom chunk after a trailing newline; keep_empty=True keeps
+    interior empty lines as rows (serde semantics), dropping only the
+    trailing phantom (start == file size)."""
     nl = np.flatnonzero(blob == np.uint8(ord("\n")))
     row_starts = np.concatenate(([0], nl + 1)).astype(np.int64)
     row_ends = np.concatenate((nl, [blob.shape[0]])).astype(np.int64)
@@ -81,28 +138,21 @@ def device_decode_csv_file(scan, path: str
         cr = (blob[np.minimum(safe_e, blob.size - 1)]
               == np.uint8(ord("\r"))) & (row_ends > row_starts)
         row_ends = row_ends - cr.astype(np.int64)
-    keep = row_starts < row_ends  # empty lines + trailing-\n chunk
-    row_starts, row_ends = row_starts[keep], row_ends[keep]
-    if header and row_starts.size:
-        row_starts, row_ends = row_starts[1:], row_ends[1:]
-    total_rows = int(row_starts.size)
-    if total_rows == 0:
-        return
-    conf = get_default_conf()
-    # EVERY fallback condition validates here, before the first yield, so
-    # the caller can stream chunks without materializing the whole file
-    max_len = int((row_ends - row_starts).max()) if total_rows else 1
+    keep = (row_starts < blob.shape[0]) if keep_empty \
+        else (row_starts < row_ends)
+    return row_starts[keep], row_ends[keep]
+
+
+def check_row_width(row_starts, row_ends, conf) -> None:
+    """Raise the host-fallback signal when any row exceeds the device
+    string layout (shared pre-yield check of the text device parsers)."""
+    max_len = int((row_ends - row_starts).max()) if row_starts.size else 1
     if width_bucket(max(max_len, 1)) > conf.string_max_width:
         raise DeviceDecodeUnsupported("row wider than the device layout")
-    chunk_rows = max(int(conf.get("spark.rapids.sql.batchSizeRows")), 1)
-    blob_dev = jnp.asarray(blob)
-    for at in range(0, total_rows, chunk_rows):
-        yield _decode_rows(scan, schema,
-                           row_starts[at:at + chunk_rows],
-                           row_ends[at:at + chunk_rows], blob_dev, sep)
 
 
-def _decode_rows(scan, schema, row_starts, row_ends, blob_dev, sep):
+def _decode_rows(scan, schema, row_starts, row_ends, blob_dev, sep,
+                 null_markers):
     import jax.numpy as jnp
     from ..columnar.batch import ColumnarBatch
     from ..columnar.column import Column
@@ -146,7 +196,6 @@ def _decode_rows(scan, schema, row_starts, row_ends, blob_dev, sep):
 
     # one string Vec per SELECTED schema column (pruned columns never
     # pay the null-marker compare or the cast kernels)
-    null_markers = scan.options.get("null_values", ["", "null", "NULL"])
     ctx = EvalContext(jnp, row_mask=defined)
     out_schema = scan.output
     selected = [list(schema.names).index(nm) for nm in out_schema.names]
@@ -157,7 +206,7 @@ def _decode_rows(scan, schema, row_starts, row_ends, blob_dev, sep):
         dt = schema.types[ci]
         sv = Vec(T.STRING, fields.data[:, ci], fields.validity[:, ci],
                  fields.lengths[:, ci])
-        # null markers: empty always; literal markers byte-compare
+        # null markers byte-compare (csv: empty/null/NULL; hive: \\N)
         is_null = jnp.zeros(cap, bool)
         for mk in null_markers:
             mb = mk.encode()

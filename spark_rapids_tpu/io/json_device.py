@@ -1,0 +1,249 @@
+"""Device-side JSON-lines parse (reference: the GPU JSON reader under
+`catalyst/json/rapids` riding `GpuTextBasedPartitionReader.scala:1` —
+host frames lines, device parses structure and types; the reference's
+GPU JSON path carries a comparable unsupported-shape list).
+
+TPU shape, composed from the same kernels as the device CSV parse:
+
+  host (control plane): one newline scan frames rows; one vectorized
+  structural pass (cumulative quote parity per row) proves the file is
+  FLAT json-lines — no escapes, no arrays, exactly one object per line —
+  or falls the whole file back to the pyarrow host reader.
+  device: the blob ships once; rows gather into a [R, W] byte matrix;
+  quote parity (a cumsum along the row axis) classifies every byte as
+  structural or in-string, structural commas split fields with the same
+  delimiter-position sort split() uses, per-slot masked min/max reduces
+  locate key span / colon / value span, key bytes match schema names
+  positionally-independently (JSON keys carry no order), and the
+  engine's Spark-grammar device casts type the value strings. Rows
+  never exist row-wise on the host.
+
+Unsupported shapes raise DeviceDecodeUnsupported BEFORE the first yield
+(per-file host fallback): backslash escapes anywhere, arrays, nested or
+multiple objects per line, unsupported schema types. Missing keys and
+JSON `null` yield SQL NULL; keys absent from the schema are ignored —
+both matching Spark's permissive JSON mode."""
+
+from __future__ import annotations
+
+from typing import Iterator, Tuple
+
+import numpy as np
+
+from .. import types as T
+from ..columnar.padding import row_bucket, width_bucket
+from .csv_device import _SUPPORTED_TYPES as _SUPPORTED
+from .parquet_device import DeviceDecodeUnsupported
+
+__all__ = ["device_decode_json_file", "json_device_supported"]
+
+
+def json_device_supported(scan) -> bool:
+    return all(isinstance(dt, _SUPPORTED) for dt in scan.output.types)
+
+
+def _structural_precheck(blob, starts, ends):
+    """Whole-file vectorized proof of flat json-lines; returns the kept
+    row frames (whitespace-only rows dropped) or raises. Memory budget:
+    one uint8 + one int32 + a few bool temporaries per file byte — no
+    per-byte int64 arrays, no searchsorted (rowid comes from np.repeat
+    over row lengths; per-row quote parity from the quote cumsum at row
+    starts)."""
+    if (blob == np.uint8(ord("\\"))).any():
+        raise DeviceDecodeUnsupported("escape sequences fall back to host")
+    nrows = len(starts)
+    lens = (ends - starts).astype(np.int64)
+    # compacted in-row byte domain: row r contributes bytes
+    # [starts[r], ends[r]) in order
+    rowid = np.repeat(np.arange(nrows, dtype=np.int32), lens)
+    byte_ix = np.repeat(starts, lens) + _segment_arange(lens)
+    bv = blob[byte_ix]
+    isq = (blob == np.uint8(ord('"'))).astype(np.int32)
+    qcs0 = np.concatenate(([0], np.cumsum(isq, dtype=np.int32)))
+    # quotes strictly before each in-row byte, relative to its row start:
+    # a non-quote byte is inside a string iff odd; quote bytes are never
+    # the structural chars we test below
+    parity = (qcs0[byte_ix] - qcs0[starts][rowid]) & 1
+    structural = parity == 0
+    for ch in "[]":
+        if (structural & (bv == np.uint8(ord(ch)))).any():
+            raise DeviceDecodeUnsupported("json arrays fall back to host")
+    nonws = np.bincount(
+        rowid[(bv != np.uint8(ord(" "))) & (bv != np.uint8(ord("\t")))],
+        minlength=nrows)
+    live_rows = nonws > 0
+    opens = np.bincount(rowid[structural & (bv == np.uint8(ord("{")))],
+                        minlength=nrows)
+    closes = np.bincount(rowid[structural & (bv == np.uint8(ord("}")))],
+                         minlength=nrows)
+    if ((opens[live_rows] != 1) | (closes[live_rows] != 1)).any():
+        raise DeviceDecodeUnsupported(
+            "nested/multiple objects per line fall back to host")
+    return starts[live_rows], ends[live_rows]
+
+
+def _segment_arange(lens):
+    """[0..lens[0]), [0..lens[1]), ... concatenated (vectorized)."""
+    total = int(lens.sum())
+    out = np.arange(total, dtype=np.int64)
+    seg_starts = np.concatenate(([0], np.cumsum(lens)[:-1]))
+    return out - np.repeat(seg_starts, lens)
+
+
+def device_decode_json_file(scan, path: str
+                            ) -> Iterator[Tuple[object, int]]:
+    """Yield (device ColumnarBatch, nrows) for one json-lines file.
+    Raises DeviceDecodeUnsupported before the first yield for shapes the
+    vectorized parser can't honor (caller keeps the host path)."""
+    import jax.numpy as jnp
+    from ..config import get_default_conf
+
+    from .csv_device import check_row_width, frame_lines
+    blob = np.fromfile(path, np.uint8)
+    if blob.size == 0:
+        return
+    starts, ends = frame_lines(blob)
+    if starts.size == 0:
+        return
+    starts, ends = _structural_precheck(blob, starts, ends)
+    total_rows = int(starts.size)
+    if total_rows == 0:
+        return
+    conf = get_default_conf()
+    check_row_width(starts, ends, conf)
+    chunk_rows = max(int(conf.get("spark.rapids.sql.batchSizeRows")), 1)
+    blob_dev = jnp.asarray(blob)
+    for at in range(0, total_rows, chunk_rows):
+        yield _decode_rows(scan, starts[at:at + chunk_rows],
+                           ends[at:at + chunk_rows], blob_dev)
+
+
+def _first_at_least(xp, mask, pos, big):
+    """Per-row smallest position where mask holds (big when none)."""
+    return xp.where(mask, pos, big).min(axis=1)
+
+
+def _decode_rows(scan, row_starts, row_ends, blob_dev):
+    import jax.numpy as jnp
+    from ..columnar.batch import ColumnarBatch
+    from ..columnar.column import Column
+    from ..expr.base import BoundReference, EvalContext, Vec
+    from ..expr.cast import Cast
+    from ..expr.maps import _extract_spans
+    from .parquet_device import _gather_strings
+
+    nrows = int(row_starts.size)
+    lens = (row_ends - row_starts).astype(np.int32)
+    w = width_bucket(max(int(lens.max()), 1))
+    cap = row_bucket(nrows)
+    starts_d = jnp.asarray(np.pad(row_starts, (0, cap - nrows)))
+    lens_d = jnp.asarray(np.pad(lens, (0, cap - nrows)))
+    defined = jnp.arange(cap) < nrows
+    rows_mx, row_lens = _gather_strings(blob_dev, starts_d, lens_d,
+                                        defined, w)
+
+    pos = jnp.arange(w, dtype=np.int32)[None, :]
+    live = pos < row_lens[:, None]
+    big = np.int32(w + 1)
+    isq = (rows_mx == np.uint8(ord('"'))) & live
+    # quotes strictly before each byte: non-quote byte p is inside a
+    # string iff odd; an OPENING quote itself sees even (structural)
+    cq_before = jnp.cumsum(isq.astype(np.int32), axis=1) - isq
+    struct = live & (cq_before % 2 == 0)
+
+    def s_is(ch):
+        return struct & (rows_mx == np.uint8(ord(ch)))
+
+    obr = _first_at_least(jnp, s_is("{"), pos, big)
+    cbr = jnp.where(s_is("}"), pos, np.int32(-1)).max(axis=1)
+    content = (pos > obr[:, None]) & (pos < cbr[:, None])
+    scom = s_is(",") & content
+    # empty objects `{}` have zero fields
+    ws = (rows_mx == np.uint8(ord(" "))) | (rows_mx == np.uint8(ord("\t")))
+    has_field = (content & ~ws).any(axis=1) & defined
+    nfields = jnp.where(has_field, scom.sum(axis=1) + 1, 0)
+    k = int(max(int(nfields.max()), 1))
+
+    # field spans via the delimiter-position sort (split() kernel shape)
+    dpos = jnp.where(scom, pos, big)
+    dsorted = jnp.sort(dpos, axis=1)[:, :k]
+    if dsorted.shape[1] < k:
+        dsorted = jnp.pad(dsorted, ((0, 0), (0, k - dsorted.shape[1])),
+                          constant_values=big)
+    fends = jnp.minimum(dsorted, cbr[:, None].astype(np.int32))
+    fstarts = jnp.concatenate(
+        [(obr + 1)[:, None].astype(np.int32), dsorted[:, :k - 1] + 1],
+        axis=1)
+    fstarts = jnp.minimum(fstarts, cbr[:, None].astype(np.int32))
+    slot_live = (jnp.arange(k, dtype=np.int32)[None, :]
+                 < nfields[:, None]) & defined[:, None]
+
+    # per-slot key span, colon, value span (masked min/max reduces)
+    kq1 = jnp.full((cap, k), big, np.int32)
+    kq2 = jnp.full((cap, k), big, np.int32)
+    cps = jnp.full((cap, k), big, np.int32)
+    vss = jnp.full((cap, k), big, np.int32)
+    ves = jnp.full((cap, k), np.int32(-1), np.int32)
+    for j in range(k):
+        inspan = (pos >= fstarts[:, j][:, None]) & \
+            (pos < fends[:, j][:, None])
+        q1 = _first_at_least(jnp, isq & inspan, pos, big)
+        q2 = _first_at_least(jnp, isq & inspan & (pos > q1[:, None]),
+                             pos, big)
+        cp = _first_at_least(jnp, s_is(":") & inspan & (pos > q2[:, None]),
+                             pos, big)
+        vmask = inspan & ~ws & (pos > cp[:, None])
+        vs = _first_at_least(jnp, vmask, pos, big)
+        ve = jnp.where(vmask, pos, np.int32(-1)).max(axis=1) + 1
+        kq1 = kq1.at[:, j].set(q1)
+        kq2 = kq2.at[:, j].set(q2)
+        cps = cps.at[:, j].set(cp)
+        vss = vss.at[:, j].set(vs)
+        ves = ves.at[:, j].set(ve)
+    slot_ok = slot_live & (kq2 < big) & (cps < big) & (vss < big) & \
+        (ves > vss)
+
+    # key bytes vs schema names (order-independent match)
+    klen = kq2 - kq1 - 1
+    out_schema = scan.output
+    null_word = np.frombuffer(b"null", np.uint8)
+    ctx = EvalContext(jnp, row_mask=defined)
+    cols = []
+    for ci, (nm, dt) in enumerate(zip(out_schema.names, out_schema.types)):
+        nb = np.frombuffer(nm.encode(), np.uint8)
+        match = slot_ok & (klen == len(nb))
+        for t, byte in enumerate(nb):
+            at = jnp.clip(kq1 + 1 + t, 0, w - 1)
+            match = match & (jnp.take_along_axis(rows_mx, at, axis=1)
+                             == byte)
+        present = match.any(axis=1)
+        # duplicate keys resolve LAST-wins like Spark's Jackson parser
+        slot = (k - 1) - jnp.argmax(match[:, ::-1], axis=1)
+        ar = jnp.arange(cap)
+        vs = vss[ar, slot]
+        ve = ves[ar, slot]
+        # quoted values strip their quotes; bare `null` (exactly) is NULL
+        opening = jnp.take_along_axis(
+            rows_mx, jnp.clip(vs, 0, w - 1)[:, None], axis=1)[:, 0]
+        quoted = present & (opening == np.uint8(ord('"')))
+        vs = jnp.where(quoted, vs + 1, vs)
+        ve = jnp.where(quoted, ve - 1, ve)
+        is_null = present & ~quoted & (ve - vs == 4)
+        for t, byte in enumerate(null_word):
+            at = jnp.clip(vs + t, 0, w - 1)
+            is_null = is_null & (jnp.take_along_axis(
+                rows_mx, at[:, None], axis=1)[:, 0] == byte)
+        valid = present & ~is_null & defined
+        sv = _extract_spans(jnp, rows_mx, vs[:, None], ve[:, None],
+                            valid[:, None])
+        svec = Vec(T.STRING, sv.data[:, 0], sv.validity[:, 0],
+                   sv.lengths[:, 0])
+        if isinstance(dt, T.StringType):
+            out = svec
+        else:
+            typed = Cast(BoundReference(0, T.STRING), dt).eval(ctx, [svec])
+            out = Vec(dt, typed.data, typed.validity & valid, typed.lengths)
+        cols.append(Column(out.dtype, out.data, out.validity, out.lengths))
+    batch = ColumnarBatch(out_schema, tuple(cols),
+                          jnp.asarray(nrows, jnp.int32))
+    return batch, nrows

@@ -205,25 +205,42 @@ class TpuFileScanExec(_TpuExec):
             return
         if self.cpu_scan.format_name == "csv" and self.conf.get(
                 "spark.rapids.sql.format.csv.deviceDecode.enabled"):
-            from .csv_device import csv_device_supported
+            from .csv_device import (csv_device_supported,
+                                     device_decode_csv_file)
             if csv_device_supported(self.cpu_scan):
-                yield from self._csv_device_batches()
+                yield from self._text_device_batches(device_decode_csv_file)
+                return
+        if self.cpu_scan.format_name == "hiveText" and self.conf.get(
+                "spark.rapids.sql.format.hiveText.deviceDecode.enabled"):
+            from .csv_device import (device_decode_hive_file,
+                                     hive_device_supported)
+            if hive_device_supported(self.cpu_scan):
+                yield from self._text_device_batches(
+                    device_decode_hive_file)
+                return
+        if self.cpu_scan.format_name == "json" and self.conf.get(
+                "spark.rapids.sql.format.json.deviceDecode.enabled"):
+            from .json_device import (device_decode_json_file,
+                                      json_device_supported)
+            if json_device_supported(self.cpu_scan):
+                yield from self._text_device_batches(
+                    device_decode_json_file)
                 return
         for t in self.cpu_scan.host_tables(self._effective_paths()):
             b = batch_from_arrow(t)
             self.num_output_rows.add(t.num_rows)
             yield self._count_output(b)
 
-    def _csv_device_batches(self):
-        """Device CSV parse with PER-FILE host fallback: every fallback
-        condition validates before the generator's FIRST yield, so pulling
-        one chunk decides the path and the rest stream one batch at a
-        time (no whole-file materialization, no double-yield)."""
-        from .csv_device import device_decode_csv_file
+    def _text_device_batches(self, decode_file):
+        """Device text parse (csv / hive text / json-lines) with PER-FILE
+        host fallback: every fallback condition validates before the
+        generator's FIRST yield, so pulling one chunk decides the path and
+        the rest stream one batch at a time (no whole-file
+        materialization, no double-yield)."""
         from .parquet_device import DeviceDecodeUnsupported
         scan = self.cpu_scan
         for path in scan.paths:
-            gen = device_decode_csv_file(scan, path)
+            gen = decode_file(scan, path)
             try:
                 first = next(gen, None)
             except (DeviceDecodeUnsupported, OSError):

@@ -1,0 +1,191 @@
+"""Device JSON-lines and Hive-text parse (json_device.py + the hive
+parameterization of csv_device.py): host frames lines, device splits
+structure and types fields through the cast kernels — closing the
+"JSON and Hive-text scans still parse rows on host" gap (r3 verdict,
+component #42; reference `GpuTextBasedPartitionReader.scala`)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.plugin import TpuSession
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession({"spark.rapids.sql.enabled": True,
+                       "spark.rapids.sql.explain": "NONE"})
+
+
+def _write(tmp_path, text, name):
+    p = str(tmp_path / name)
+    with open(p, "w") as f:
+        f.write(text)
+    return p
+
+
+class TestJsonDeviceDecode:
+    def test_device_parse_flat_lines(self, session, tmp_path):
+        text = ('{"id": 1, "name": "alpha", "score": 1.5, "ok": true}\n'
+                '{"id": 2, "score": 2.25, "ok": false, "name": "beta"}\n'
+                '{"id": 3, "name": null, "ok": true}\n'
+                '{"id": 4, "name": "d,elta", "score": -0.5}\n')
+        p = _write(tmp_path, text, "t.json")
+        schema = Schema(("id", "name", "score", "ok"),
+                        (T.LONG, T.STRING, T.DOUBLE, T.BOOLEAN))
+        df = session.read_json(p, schema=schema)
+        from spark_rapids_tpu.io.json_device import (
+            device_decode_json_file, json_device_supported)
+        assert json_device_supported(df.plan)
+        got = list(device_decode_json_file(df.plan, p))
+        assert got and int(got[0][1]) == 4  # device path actually used
+        rows = df.collect().sort_by([("id", "ascending")]).to_pylist()
+        assert rows[0] == {"id": 1, "name": "alpha", "score": 1.5,
+                           "ok": True}
+        # key order is irrelevant; missing key and json null are SQL NULL
+        assert rows[1] == {"id": 2, "name": "beta", "score": 2.25,
+                           "ok": False}
+        assert rows[2]["name"] is None
+        assert rows[2]["score"] is None
+        assert rows[3]["name"] == "d,elta"  # comma inside a string value
+        assert rows[3]["ok"] is None
+
+    def test_device_matches_host_reader(self, session, tmp_path):
+        rng = np.random.default_rng(5)
+        lines = []
+        for i in range(500):
+            sc = round(float(rng.normal()), 4)
+            lines.append('{"id": %d, "name": "n%d", "score": %s}'
+                         % (i, i, sc))
+        p = _write(tmp_path, "\n".join(lines) + "\n", "m.json")
+        schema = Schema(("id", "name", "score"),
+                        (T.LONG, T.STRING, T.DOUBLE))
+        df = session.read_json(p, schema=schema)
+        dev = df.collect().sort_by([("id", "ascending")])
+        import pyarrow.json as pajson
+        host = pajson.read_json(p).sort_by([("id", "ascending")])
+        assert dev.column("id").to_pylist() == \
+            host.column("id").to_pylist()
+        assert dev.column("name").to_pylist() == \
+            host.column("name").to_pylist()
+        for a, b in zip(dev.column("score").to_pylist(),
+                        host.column("score").to_pylist()):
+            assert a == pytest.approx(b, rel=1e-12)
+
+    def test_escapes_fall_back_to_host(self, session, tmp_path):
+        text = '{"id": 1, "name": "a\\"b"}\n'
+        p = _write(tmp_path, text, "esc.json")
+        schema = Schema(("id", "name"), (T.LONG, T.STRING))
+        df = session.read_json(p, schema=schema)
+        from spark_rapids_tpu.io.json_device import device_decode_json_file
+        from spark_rapids_tpu.io.parquet_device import \
+            DeviceDecodeUnsupported
+        with pytest.raises(DeviceDecodeUnsupported):
+            list(device_decode_json_file(df.plan, p))
+        assert df.collect().column("name").to_pylist() == ['a"b']
+
+    def test_arrays_and_nesting_fall_back(self, session, tmp_path):
+        from spark_rapids_tpu.io.json_device import device_decode_json_file
+        from spark_rapids_tpu.io.parquet_device import \
+            DeviceDecodeUnsupported
+        schema = Schema(("id",), (T.LONG,))
+        p1 = _write(tmp_path, '{"id": 1, "xs": [1, 2]}\n', "arr.json")
+        df1 = session.read_json(p1, schema=schema)
+        with pytest.raises(DeviceDecodeUnsupported):
+            list(device_decode_json_file(df1.plan, p1))
+        p2 = _write(tmp_path, '{"id": 2, "o": {"x": 1}}\n', "nest.json")
+        df2 = session.read_json(p2, schema=schema)
+        with pytest.raises(DeviceDecodeUnsupported):
+            list(device_decode_json_file(df2.plan, p2))
+        # the scan itself still answers via the host reader
+        assert df1.collect().column("id").to_pylist() == [1]
+        assert df2.collect().column("id").to_pylist() == [2]
+
+    def test_blank_lines_spaces_and_braces_in_strings(self, session,
+                                                      tmp_path):
+        text = ('\n'
+                '{ "id" : 1 , "name" : "br{ce}" }\n'
+                '   \n'
+                '{"id": 2, "name": ": , {"}\n')
+        p = _write(tmp_path, text, "tricky.json")
+        schema = Schema(("id", "name"), (T.LONG, T.STRING))
+        df = session.read_json(p, schema=schema)
+        from spark_rapids_tpu.io.json_device import device_decode_json_file
+        got = list(device_decode_json_file(df.plan, p))
+        assert int(sum(n for _, n in got)) == 2
+        rows = df.collect().sort_by([("id", "ascending")]).to_pylist()
+        assert rows[0]["name"] == "br{ce}"
+        assert rows[1]["name"] == ": , {"
+
+    def test_ignored_extra_keys_and_date(self, session, tmp_path):
+        text = ('{"d": "2020-02-29", "junk": 9, "id": 1}\n'
+                '{"id": 2, "d": "1970-01-01"}\n')
+        p = _write(tmp_path, text, "d.json")
+        schema = Schema(("id", "d"), (T.LONG, T.DATE))
+        df = session.read_json(p, schema=schema)
+        import datetime as dt
+        rows = df.collect().sort_by([("id", "ascending")]).to_pylist()
+        assert rows[0]["d"] == dt.date(2020, 2, 29)
+        assert rows[1]["d"] == dt.date(1970, 1, 1)
+
+
+class TestHiveTextDeviceDecode:
+    def _schema(self):
+        return Schema(("id", "name", "score"),
+                      (T.LONG, T.STRING, T.DOUBLE))
+
+    def test_device_parse_serde_semantics(self, session, tmp_path):
+        # \x01 splits, \N nulls, short row null-padded, extra field
+        # dropped, blank line IS a row (first col empty string -> cast
+        # null for LONG), quote bytes are data
+        text = ("1\x01al\"pha\x011.5\n"
+                "2\x01\\N\x012.5\x01extra\n"
+                "3\x01short\n"
+                "\n"
+                "4\x01last\x014.0")
+        p = _write(tmp_path, text, "t.hive")
+        df = session.read_hive_text(p, schema=self._schema())
+        from spark_rapids_tpu.io.csv_device import (
+            device_decode_hive_file, hive_device_supported)
+        assert hive_device_supported(df.plan)
+        got = list(device_decode_hive_file(df.plan, p))
+        assert got and int(sum(n for _, n in got)) == 5
+        rows = df.collect().to_pylist()
+        by_id = {r["id"]: r for r in rows}
+        assert by_id[1]["name"] == 'al"pha'
+        assert by_id[1]["score"] == 1.5
+        assert by_id[2]["name"] is None          # \N marker
+        assert by_id[2]["score"] == 2.5          # extra field dropped
+        assert by_id[3]["name"] == "short"
+        assert by_id[3]["score"] is None         # short row padded
+        assert by_id[4]["score"] == 4.0          # no trailing newline
+        blank = [r for r in rows if r["id"] is None]
+        assert len(blank) == 1                   # blank line row
+        assert blank[0]["name"] is None and blank[0]["score"] is None
+
+    def test_device_matches_host_reader(self, session, tmp_path):
+        rng = np.random.default_rng(9)
+        lines = []
+        for i in range(400):
+            sc = round(float(rng.normal()), 4)
+            nm = f"n{i}" if i % 7 else "\\N"
+            lines.append(f"{i}\x01{nm}\x01{sc}")
+        p = _write(tmp_path, "\n".join(lines) + "\n", "m.hive")
+        df = session.read_hive_text(p, schema=self._schema())
+        dev = df.collect().sort_by([("id", "ascending")])
+        cpu = df.collect_cpu().sort_by([("id", "ascending")])
+        assert dev.column("id").to_pylist() == cpu.column("id").to_pylist()
+        assert dev.column("name").to_pylist() == \
+            cpu.column("name").to_pylist()
+        for a, b in zip(dev.column("score").to_pylist(),
+                        cpu.column("score").to_pylist()):
+            assert a == pytest.approx(b, rel=1e-12)
+
+    def test_empty_string_is_not_null_for_strings(self, session, tmp_path):
+        text = "1\x01\x012.0\n"
+        p = _write(tmp_path, text, "e.hive")
+        df = session.read_hive_text(p, schema=self._schema())
+        rows = df.collect().to_pylist()
+        assert rows[0]["name"] == ""  # empty != \N for string columns
