@@ -231,6 +231,33 @@ register("spark.rapids.sql.format.parquet.deviceDecode.enabled", "bool", True,
          "Decode PLAIN-encoded flat numeric parquet pages on device (RLE "
          "def-level expansion + byte bitcast); unsupported chunks fall back "
          "to the pyarrow host path per file.")
+register("spark.rapids.sql.format.orc.deviceWrite.enabled", "bool", True,
+         "Encode ORC on device (GpuOrcFileFormat analog): PRESENT bitmaps, "
+         "RLEv2 DIRECT integer/length runs, IEEE754 lanes and string "
+         "blobs render with device kernels; the host writes protobuf "
+         "scaffolding only. Unsupported schemas keep the pyarrow writer.")
+register("spark.rapids.sql.format.csv.deviceWrite.enabled", "bool", True,
+         "Format CSV on device: columns render through the cast-to-string "
+         "kernels, rows assemble and flatten with positional gathers, one "
+         "D2H ships the finished blob. Cells needing quoting and float "
+         "columns keep the host writer.")
+register("spark.rapids.delta.checkpointInterval", "int", 10,
+         "Write a parquet checkpoint + _last_checkpoint pointer every Nth "
+         "Delta commit so log replay is O(commits since checkpoint); 0 "
+         "disables periodic checkpointing.")
+register("spark.rapids.sql.format.json.deviceDecode.enabled", "bool", True,
+         "Parse flat json-lines on device: host frames lines and proves "
+         "flatness (no escapes/arrays/nesting) with one vectorized quote-"
+         "parity pass, the device splits fields on structural commas, "
+         "matches keys to schema names order-independently, and types the "
+         "value spans through the device cast kernels (GPU JSON reader "
+         "analog). Unsupported files keep the pyarrow host reader.")
+register("spark.rapids.sql.format.hiveText.deviceDecode.enabled", "bool",
+         True,
+         "Parse Hive delimited text on device with LazySimpleSerDe "
+         "semantics: \\x01 field splits, \\N nulls, blank lines as rows, "
+         "short rows null-padded — the device CSV parse parameterized for "
+         "the serde (GpuHiveTableScanExec analog).")
 register("spark.rapids.sql.format.orc.enabled", "bool", True, "Enable TPU ORC scan.")
 register("spark.rapids.sql.format.orc.deviceDecode.enabled", "bool", True,
          "Decode flat ORC stripes on device: RLEv2 runs expand via "

@@ -340,14 +340,54 @@ class DataFrame:
 
     def write_orc(self, path: str, partition_by=None, mode: str = "error",
                   **options):
-        from .io.writer import write_table
-        return write_table(self.collect(), path, "orc", partition_by, mode,
-                           **options)
+        return self._write_text_format("orc", path, partition_by, mode,
+                                       **options)
 
     def write_csv(self, path: str, partition_by=None, mode: str = "error",
                   **options):
-        from .io.writer import write_table
-        return write_table(self.collect(), path, "csv", partition_by, mode,
+        return self._write_text_format("csv", path, partition_by, mode,
+                                       **options)
+
+    def _write_text_format(self, fmt, path, partition_by, mode, **options):
+        """Device-encoded ORC/CSV write with a single plan execution:
+        when the device encoder declines (quoting, unsupported types) the
+        already-materialized device batches convert to Arrow for the host
+        writer — the plan never runs twice."""
+        from .errors import PlanNotFullyOnDevice
+        from .io.parquet_device import DeviceDecodeUnsupported
+        from .io.writer import write_blob, write_table
+        batches = None
+        if not partition_by and self.session.conf.get(
+                f"spark.rapids.sql.format.{fmt}.deviceWrite.enabled"):
+            if fmt == "orc":
+                from .io.orc_device_write import (
+                    device_encode_orc as encode,
+                    orc_write_schema_supported as supported)
+            else:
+                from .io.csv_device_write import (
+                    csv_write_schema_supported as supported,
+                    device_encode_csv as encode)
+            if supported(self.schema):
+                try:
+                    batches = self.session.execute_plan_device_batches(
+                        self.plan)
+                    blob = encode(batches, self.schema)
+                    rows = sum(int(b.row_count()) for b in batches)
+                    return write_blob(path, mode, blob, fmt, rows)
+                except PlanNotFullyOnDevice:
+                    batches = None  # CPU sections: host path executes
+                except DeviceDecodeUnsupported:
+                    pass  # keep the batches for the host writer
+        if batches is not None:
+            import pyarrow as pa
+            from .columnar.batch import batch_to_arrow
+            tables = [batch_to_arrow(b) for b in batches
+                      if int(b.row_count())]
+            table = pa.concat_tables(tables) if tables else \
+                self.schema.to_arrow().empty_table()
+        else:
+            table = self.collect()
+        return write_table(table, path, fmt, partition_by, mode,
                            **options)
 
     def cache(self) -> "DataFrame":

@@ -1,0 +1,137 @@
+"""Device-side CSV encode — the write direction of `csv_device.py`
+(reference `GpuCSVFileFormat` posture: columnar data is formatted by
+device kernels; the host only writes the final byte blob).
+
+TPU shape: every column renders to the string byte-matrix layout ON
+DEVICE via the engine's cast-to-string kernels (ints/bools/dates; string
+columns pass through), fields and their separators assemble into per-row
+byte runs with a positional field-index gather, rows flatten into one
+file blob with a second positional gather, and a single D2H ships the
+finished bytes. Host work is the final `write()` call.
+
+Unsupported shapes fall back to the host pyarrow writer BEFORE any
+bytes render: float columns (Java float text is host-formatted, see
+cast.py `_java_double_str`), nested types, and batches whose string
+cells contain the separator / quote / CR / LF (the device path writes
+unquoted fields, matching Spark's quote-only-when-needed output)."""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from .. import types as T
+from .parquet_device import DeviceDecodeUnsupported
+
+__all__ = ["device_encode_csv", "csv_write_schema_supported"]
+
+_WRITABLE = (T.StringType, T.BooleanType, T.ByteType, T.ShortType,
+             T.IntegerType, T.LongType, T.DateType)
+
+
+def csv_write_schema_supported(schema) -> bool:
+    return all(isinstance(dt, _WRITABLE) for dt in schema.types)
+
+
+def _field_strings(batch) -> List:
+    """Render every column of a device batch to string Vecs on device."""
+    from ..expr.base import Vec
+    from ..expr.cast import _to_string
+    import jax.numpy as jnp
+    out = []
+    for col, dt in zip(batch.columns, batch.schema.types):
+        v = Vec.from_column(col)
+        if isinstance(dt, T.StringType):
+            out.append(v)
+        else:
+            out.append(_to_string(jnp, v))
+    return out
+
+
+def _concat_fields(xp, fields, row_mask, sep: int, newline: int):
+    """[cap, Wr] row byte matrix + row lengths from per-field string
+    matrices: each field is followed by `sep` (the last by `newline`);
+    NULL fields render empty (Spark's default nullValue)."""
+    cap = row_mask.shape[0]
+    k = len(fields)
+    flens = xp.stack([xp.where(f.validity, f.lengths, 0)
+                      for f in fields], axis=1).astype(np.int32)
+    cell = flens + 1  # +1 for the trailing sep / newline
+    offs = xp.concatenate([xp.zeros((cap, 1), np.int32),
+                           xp.cumsum(cell, axis=1).astype(np.int32)],
+                          axis=1)
+    rlen = xp.where(row_mask, offs[:, k], 0)
+    wr = int(rlen.max()) if cap else 1
+    wr = max(wr, 1)
+    pos = xp.arange(wr, dtype=np.int32)[None, :]
+    # which field does output position p belong to?
+    fi = (pos[:, :, None] >= offs[:, None, 1:]).sum(axis=2) \
+        .astype(np.int32)  # [cap, wr] in 0..k-1 (clamped by use below)
+    fi = xp.minimum(fi, k - 1)
+    local = pos - xp.take_along_axis(offs, fi, axis=1)
+    # byte: field content while local < len, separator at local == len
+    wmax = max(f.data.shape[1] for f in fields)
+    stacked = xp.stack(
+        [xp.pad(f.data, ((0, 0), (0, wmax - f.data.shape[1])))
+         for f in fields], axis=1)  # [cap, k, wmax]
+    content = stacked[xp.arange(cap)[:, None], fi,
+                      xp.clip(local, 0, wmax - 1)]  # [cap, wr]
+    cur_len = xp.take_along_axis(flens, fi, axis=1)
+    is_sep = local == cur_len
+    sep_byte = xp.where(fi == k - 1, np.uint8(newline), np.uint8(sep))
+    out = xp.where(is_sep, sep_byte, content).astype(np.uint8)
+    out = xp.where((pos < rlen[:, None]), out, np.uint8(0))
+    return out, rlen
+
+
+def _flatten_rows(xp, rows_mx, rlen):
+    """[cap, Wr] + per-row lengths -> one flat byte blob (device)."""
+    cap, wr = rows_mx.shape
+    offs = xp.concatenate([xp.zeros(1, np.int64),
+                           xp.cumsum(rlen.astype(np.int64))])
+    total = int(offs[cap])
+    if total == 0:
+        return xp.zeros(0, np.uint8)
+    g = xp.arange(total, dtype=np.int64)
+    rid = xp.searchsorted(offs[1:], g, side="right").astype(np.int32)
+    rid = xp.minimum(rid, cap - 1)
+    local = (g - offs[rid]).astype(np.int32)
+    return rows_mx[rid, xp.minimum(local, wr - 1)]
+
+
+def device_encode_csv(batches, schema, sep: str = ",",
+                      header: bool = True) -> bytes:
+    """Encode device batches to one CSV byte blob (header included)."""
+    import jax.numpy as jnp
+    if not csv_write_schema_supported(schema):
+        raise DeviceDecodeUnsupported(
+            "csv device write: unsupported column type")
+    sep_b = ord(sep)
+    parts: List[bytes] = []
+    if header:
+        parts.append((sep.join(schema.names) + "\n").encode())
+    for b in batches:
+        if int(b.row_count()) == 0:
+            continue
+        fields = _field_strings(b)
+        # unquoted output: cells containing sep/quote/newline need the
+        # host writer's quoting machinery
+        for f, dt in zip(fields, schema.types):
+            if isinstance(dt, T.StringType):
+                w = f.data.shape[1]
+                j = jnp.arange(w, dtype=np.int32)[None, :]
+                inb = j < f.lengths[:, None]
+                bad = inb & (
+                    (f.data == np.uint8(sep_b)) |
+                    (f.data == np.uint8(ord('"'))) |
+                    (f.data == np.uint8(ord("\n"))) |
+                    (f.data == np.uint8(ord("\r"))))
+                if bool(bad.any()):
+                    raise DeviceDecodeUnsupported(
+                        "csv device write: cell needs quoting")
+        rows_mx, rlen = _concat_fields(jnp, fields, b.row_mask(),
+                                       sep_b, ord("\n"))
+        blob = _flatten_rows(jnp, rows_mx, rlen)
+        parts.append(bytes(np.asarray(blob)))
+    return b"".join(parts)

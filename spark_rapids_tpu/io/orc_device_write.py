@@ -1,0 +1,301 @@
+"""Device-side ORC encode (reference `GpuOrcFileFormat.scala` — cudf's
+GPU ORC writer encodes the column streams on device, the host frames the
+file). Mirror of `orc_device.py`'s read direction.
+
+TPU shape: each column's streams render on device — PRESENT bitmaps
+bit-pack msb-first via a power-of-two dot, integer/date DATA packs
+RLEv2 DIRECT runs (zigzag + big-endian bit windows, the exact encoding
+the reader's run tables consume), doubles bitcast to little-endian byte
+lanes, strings flatten their byte matrices with the csv-writer's
+positional gather and carry RLEv2 lengths — then single D2H per stream.
+The host writes only protobuf scaffolding: stripe footer, file footer
+(types / stripes / rowIndexStride=0), postscript, magic.
+
+Compression NONE (a legal ORC CompressionKind pyarrow reads natively);
+unsupported schema shapes raise DeviceDecodeUnsupported before any IO
+so the caller keeps the pyarrow host writer."""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from .. import types as T
+from .parquet_device import DeviceDecodeUnsupported
+
+__all__ = ["device_encode_orc", "orc_write_schema_supported"]
+
+# orc_proto constants (shared convention with orc_device.py's reader)
+_K = {T.BooleanType: 0, T.ByteType: 1, T.ShortType: 2, T.IntegerType: 3,
+      T.LongType: 4, T.FloatType: 5, T.DoubleType: 6, T.StringType: 7,
+      T.DateType: 15}
+_K_STRUCT = 12
+_S_PRESENT, _S_DATA, _S_LENGTH = 0, 1, 2
+_E_DIRECT, _E_DIRECT_V2 = 0, 2
+
+
+def orc_write_schema_supported(schema) -> bool:
+    return all(type(dt) in _K for dt in schema.types)
+
+
+# ---------------------------------------------------------------------------
+# protobuf encode (write direction of orc_device._pb_fields)
+# ---------------------------------------------------------------------------
+
+def _varint(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _pb_u(fno: int, v: int) -> bytes:
+    return _varint(fno << 3) + _varint(v)
+
+
+def _pb_len(fno: int, payload: bytes) -> bytes:
+    return _varint(fno << 3 | 2) + _varint(len(payload)) + payload
+
+
+def _pb_packed_u(fno: int, vals) -> bytes:
+    return _pb_len(fno, b"".join(_varint(v) for v in vals))
+
+
+# ---------------------------------------------------------------------------
+# device stream encoders
+# ---------------------------------------------------------------------------
+
+_POW2 = np.array([128, 64, 32, 16, 8, 4, 2, 1], np.uint8)
+
+
+def _packbits_device(xp, bits) -> bytes:
+    """bool[n] -> msb-first packed bytes (device dot with bit weights)."""
+    n = bits.shape[0]
+    pad = (-n) % 8
+    b = xp.concatenate([bits.astype(np.uint8),
+                        xp.zeros(pad, np.uint8)]) if pad else \
+        bits.astype(np.uint8)
+    return bytes(np.asarray(b.reshape(-1, 8) @ xp.asarray(_POW2)
+                            ).astype(np.uint8))
+
+
+def _byte_rle(data: bytes) -> bytes:
+    """ORC byte-RLE: repeat runs (3..130 equal bytes) else literal groups
+    of <=128 (control 256-len). Vectorized boundary scan on host bytes —
+    the payload was produced on device."""
+    if not data:
+        return b""
+    a = np.frombuffer(data, np.uint8)
+    # run starts where the value changes
+    change = np.flatnonzero(np.concatenate(([True], a[1:] != a[:-1])))
+    lens = np.diff(np.concatenate((change, [len(a)])))
+    out = bytearray()
+    lit_start, lit_len = 0, 0  # pending contiguous literal span
+
+    def flush():
+        nonlocal lit_start, lit_len
+        s, ln = lit_start, lit_len
+        while ln > 0:
+            take = min(ln, 128)
+            out.append(256 - take)
+            out.extend(a[s:s + take].tobytes())
+            s += take
+            ln -= take
+        lit_len = 0
+
+    for s, ln in zip(change.tolist(), lens.tolist()):
+        if ln >= 3:
+            flush()
+            while ln >= 3:
+                take = min(ln, 130)
+                out.append(take - 3)
+                out.append(int(a[s]))
+                s += take
+                ln -= take
+        if ln > 0:  # short runs / repeat leftovers join the literal span
+            if lit_len == 0:
+                lit_start = s
+            lit_len += ln
+    flush()
+    return bytes(out)
+
+
+def _encode_width(w: int) -> int:
+    """Inverse of orc_device._decode_width."""
+    if w <= 24:
+        return w - 1
+    return {26: 24, 28: 25, 30: 26, 32: 27,
+            40: 28, 48: 29, 56: 30, 64: 31}[w]
+
+
+def _round_width(w: int) -> int:
+    if w <= 24:
+        return max(w, 1)
+    for c in (26, 28, 30, 32, 40, 48, 56, 64):
+        if w <= c:
+            return c
+    return 64
+
+
+def _rlev2_direct(xp, vals, signed: bool) -> bytes:
+    """Encode int64 device values as RLEv2 DIRECT runs of <=512 (zigzag
+    for signed; big-endian bit windows packed with the device bit dot)."""
+    n = int(vals.shape[0])
+    if n == 0:
+        return b""
+    v = vals.astype(np.int64)
+    if signed:
+        u = ((v << 1) ^ (v >> 63)).astype(np.uint64)  # zigzag
+    else:
+        u = v.astype(np.uint64)
+    out = bytearray()
+    for at in range(0, n, 512):
+        run = u[at:at + 512]
+        cnt = int(run.shape[0])
+        hi = int(xp.max(run))
+        width = _round_width(max(hi.bit_length(), 1))
+        shifts = xp.asarray(
+            np.arange(width - 1, -1, -1, dtype=np.uint64))
+        bits = ((run[:, None] >> shifts[None, :]) &
+                np.uint64(1)).astype(np.uint8).reshape(-1)
+        payload = _packbits_device(xp, bits)
+        b0 = 0x40 | (_encode_width(width) << 1) | ((cnt - 1) >> 8 & 1)
+        out.append(b0)
+        out.append((cnt - 1) & 0xFF)
+        out += payload
+    return bytes(out)
+
+
+def _compact_valid(xp, data, valid, n: int):
+    """Non-null rows of the first n slots, in order (device compact)."""
+    live = valid & (xp.arange(valid.shape[0]) < n)
+    order = xp.argsort(~live, stable=True)
+    ndef = int(live.sum())
+    return xp.take(data, order, axis=0)[:ndef], ndef, live
+
+
+def _double_bytes(xp, vals, is_float: bool) -> bytes:
+    """IEEE754 little-endian bytes. f32 bitcasts to u32 lanes on device;
+    f64 D2Hs the compacted values as-is — 64-bit bitcasts hit the TPU
+    X64-rewrite wall, and numpy's little-endian buffer view IS the ORC
+    DATA layout (same resolution as parquet_device_write.py:204)."""
+    import jax
+    if is_float:
+        u = jax.lax.bitcast_convert_type(vals.astype(np.float32),
+                                         np.uint32)
+        lanes = [((u >> np.uint32(8 * k)) & np.uint32(0xFF))
+                 .astype(np.uint8) for k in range(4)]
+        return bytes(np.asarray(xp.stack(lanes, axis=1)).reshape(-1))
+    return np.asarray(vals.astype(np.float64)).astype("<f8").tobytes()
+
+
+def _string_blob(xp, data, lengths) -> bytes:
+    """Concatenate the byte-matrix rows (already compacted) on device."""
+    from .csv_device_write import _flatten_rows
+    if data.shape[0] == 0:
+        return b""
+    return bytes(np.asarray(_flatten_rows(xp, data, lengths)))
+
+
+# ---------------------------------------------------------------------------
+# file assembly
+# ---------------------------------------------------------------------------
+
+def device_encode_orc(batches, schema) -> bytes:
+    """Encode device batches into one uncompressed ORC file blob."""
+    import jax.numpy as jnp
+    from ..expr.base import Vec
+    if not orc_write_schema_supported(schema):
+        raise DeviceDecodeUnsupported(
+            "orc device write: unsupported column type")
+    batches = [b for b in batches if int(b.row_count())]
+    ncols = len(schema.names)
+    out = bytearray(b"ORC")
+    stripe_infos = []
+    total_rows = 0
+
+    for b in batches:  # one stripe per batch (the writer's natural unit)
+        nrows = int(b.row_count())
+        total_rows += nrows
+        streams = []        # (kind, column_id, payload)
+        encodings = [_E_DIRECT]  # root struct
+        for ci, dt in enumerate(schema.types):
+            v = Vec.from_column(b.columns[ci])
+            valid = v.validity & (jnp.arange(v.validity.shape[0]) < nrows)
+            has_null = bool((~valid[:nrows]).any())
+            if has_null:
+                pres = _byte_rle(_packbits_device(jnp, valid[:nrows]))
+                streams.append((_S_PRESENT, ci + 1, pres))
+            if isinstance(dt, T.StringType):
+                cdata, ndef, live = _compact_valid(jnp, v.data, valid,
+                                                   nrows)
+                clens, _, _ = _compact_valid(jnp, v.lengths, valid, nrows)
+                streams.append((_S_DATA, ci + 1,
+                                _string_blob(jnp, cdata, clens)))
+                streams.append((_S_LENGTH, ci + 1,
+                                _rlev2_direct(jnp, clens, signed=False)))
+                encodings.append(_E_DIRECT_V2)
+            elif isinstance(dt, T.BooleanType):
+                cdata, ndef, _ = _compact_valid(jnp, v.data, valid, nrows)
+                streams.append((_S_DATA, ci + 1, _byte_rle(
+                    _packbits_device(jnp, cdata[:ndef].astype(bool)))))
+                encodings.append(_E_DIRECT)
+            elif T.is_floating(dt):
+                cdata, ndef, _ = _compact_valid(jnp, v.data, valid, nrows)
+                streams.append((_S_DATA, ci + 1, _double_bytes(
+                    jnp, cdata[:ndef], isinstance(dt, T.FloatType))))
+                encodings.append(_E_DIRECT)
+            else:  # integral / date
+                cdata, ndef, _ = _compact_valid(jnp, v.data, valid, nrows)
+                streams.append((_S_DATA, ci + 1, _rlev2_direct(
+                    jnp, cdata[:ndef].astype(np.int64), signed=True)))
+                encodings.append(_E_DIRECT_V2)
+
+        offset = len(out)
+        data_len = 0
+        sf = bytearray()
+        for kind, cid, payload in streams:
+            out += payload
+            data_len += len(payload)
+            sf += _pb_len(1, _pb_u(1, kind) + _pb_u(2, cid) +
+                          _pb_u(3, len(payload)))
+        for enc in encodings:
+            sf += _pb_len(2, _pb_u(1, enc))
+        out += bytes(sf)
+        stripe_infos.append((offset, 0, data_len, len(sf), nrows))
+
+    content_len = len(out) - 3
+    # footer: types (root struct + children), stripes, numberOfRows,
+    # rowIndexStride=0 (no row indexes written)
+    foot = bytearray()
+    foot += _pb_u(1, 3)             # headerLength ("ORC")
+    foot += _pb_u(2, content_len)   # contentLength
+    for off, ilen, dlen, flen, nr in stripe_infos:
+        foot += _pb_len(3, _pb_u(1, off) + _pb_u(2, ilen) +
+                        _pb_u(3, dlen) + _pb_u(4, flen) + _pb_u(5, nr))
+    root = _pb_u(1, _K_STRUCT) + \
+        _pb_packed_u(2, range(1, ncols + 1)) + \
+        b"".join(_pb_len(3, nm.encode()) for nm in schema.names)
+    foot += _pb_len(4, root)
+    for dt in schema.types:
+        foot += _pb_len(4, _pb_u(1, _K[type(dt)]))
+    foot += _pb_u(6, total_rows)
+    foot += _pb_u(8, 0)             # rowIndexStride: no indexes
+    out += bytes(foot)
+
+    ps = _pb_u(1, len(foot))        # footerLength
+    ps += _pb_u(2, 0)               # compression NONE
+    ps += _pb_u(3, 256 * 1024)      # compressionBlockSize
+    ps += _pb_packed_u(4, (0, 12))  # version
+    ps += _pb_u(5, 0)               # metadataLength
+    ps += _pb_u(6, 6)               # writerVersion
+    ps += _pb_len(8000, b"ORC")     # magic
+    out += ps
+    out.append(len(ps))
+    return bytes(out)

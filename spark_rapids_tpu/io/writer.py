@@ -43,7 +43,10 @@ def _write_one(table: pa.Table, path: str, fmt: str, **options) -> None:
         orc.write_table(table, path)
     elif fmt == "csv":
         import pyarrow.csv as pacsv
-        pacsv.write_csv(table, path)
+        # quote-only-when-needed matches Spark's writer AND the device
+        # CSV encoder, so both paths emit the same dialect
+        pacsv.write_csv(table, path, write_options=pacsv.WriteOptions(
+            quoting_style="needed"))
     else:
         raise ValueError(f"unknown write format {fmt}")
 
@@ -72,21 +75,29 @@ def _prepare_output_path(path: str, mode: str) -> bool:
     return True
 
 
+def write_blob(path: str, mode: str, blob: bytes, ext: str,
+               rows: int) -> WriteStats:
+    """Shared tail of every device-encoded write: prepare the output dir,
+    drop one part file, record stats."""
+    stats = WriteStats(partitions=[])
+    if not _prepare_output_path(path, mode):
+        return stats
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{uuid.uuid4().hex[:12]}.{ext}")
+    with open(out, "wb") as f:
+        f.write(blob)
+    stats.record(out, rows)
+    return stats
+
+
 def write_device_parquet(batches, schema, path: str, mode: str = "error",
                          codec: str = "SNAPPY") -> WriteStats:
     """Write DEVICE batches straight to parquet via the device encoder —
     no arrow materialization (the GPU-writer path, GpuParquetFileFormat)."""
     from .parquet_device_write import device_encode_table
-    stats = WriteStats(partitions=[])
-    if not _prepare_output_path(path, mode):
-        return stats
-    os.makedirs(path, exist_ok=True)
-    out = os.path.join(path, f"part-{uuid.uuid4().hex[:12]}.parquet")
     blob = device_encode_table(batches, schema, codec=codec)
-    with open(out, "wb") as f:
-        f.write(blob)
-    stats.record(out, sum(int(b.row_count()) for b in batches))
-    return stats
+    return write_blob(path, mode, blob, "parquet",
+                      sum(int(b.row_count()) for b in batches))
 
 
 from ..plan.nodes import PhysicalPlan as _PhysicalPlan  # noqa: E402
@@ -162,6 +173,10 @@ class TpuWriteFilesExec(_TpuExec):
                 stats = write_device_parquet(
                     batches, self.children[0].output, plan.path,
                     plan.mode)
+        if plan.fmt == "csv" and not plan.partition_by:
+            stats = self._try_device_text(batches, "csv")
+        if plan.fmt == "orc" and not plan.partition_by:
+            stats = self._try_device_text(batches, "orc")
         if stats is None:
             tables = [batch_to_arrow(b) for b in batches]
             tables = [t for t in tables if t.num_rows]
@@ -174,6 +189,35 @@ class TpuWriteFilesExec(_TpuExec):
         b = batch_from_arrow(host_batch_to_arrow(summary))
         self.num_output_rows.add(1)
         yield self._count_output(b)
+
+
+    def _try_device_text(self, batches, fmt: str) -> Optional[WriteStats]:
+        """Device-encoded CSV/ORC write; None -> caller takes the host
+        path (per-batch fallback conditions raise before any file IO).
+        Honors the per-format deviceWrite.enabled kill switch."""
+        from .parquet_device import DeviceDecodeUnsupported
+        plan = self.plan
+        schema = self.children[0].output
+        if not self.conf.get(
+                f"spark.rapids.sql.format.{fmt}.deviceWrite.enabled"):
+            return None
+        try:
+            if fmt == "csv":
+                from .csv_device_write import (csv_write_schema_supported,
+                                               device_encode_csv)
+                if not csv_write_schema_supported(schema):
+                    return None
+                blob = device_encode_csv(batches, schema)
+            else:
+                from .orc_device_write import (device_encode_orc,
+                                               orc_write_schema_supported)
+                if not orc_write_schema_supported(schema):
+                    return None
+                blob = device_encode_orc(batches, schema)
+        except DeviceDecodeUnsupported:
+            return None
+        return write_blob(plan.path, plan.mode, blob, fmt,
+                          sum(int(b.row_count()) for b in batches))
 
 
 def make_tpu_write_files(plan: CpuWriteFilesExec, child, conf):

