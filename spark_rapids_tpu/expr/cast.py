@@ -82,7 +82,17 @@ class Cast(Expression):
         if isinstance(dst, T.StringType):
             return _to_string(xp, c)
         if isinstance(src, T.StringType):
-            return _from_string(xp, c, dst, self.ansi)
+            out = _from_string(xp, c, dst, self.ansi)
+            if ctx is not None and ctx.ansi:
+                # ANSI string-parse casts raise on malformed/overflow input
+                # (a non-null input that parsed to null) through the same
+                # traced-flag channel as arithmetic. Text scans parse with
+                # a non-ANSI ctx, so file reads keep null-on-malformed.
+                from .base import ansi_raise
+                ansi_raise(ctx, c.validity & ~out.validity,
+                           "[CAST_INVALID_INPUT] value cannot be cast to "
+                           f"{dst.simple_string()}")
+            return out
         if isinstance(src, T.DateType) and isinstance(dst, T.TimestampType):
             return Vec(dst, c.data.astype(np.int64) * _US_PER_DAY, c.validity)
         if isinstance(src, T.TimestampType) and isinstance(dst, T.DateType):
@@ -473,17 +483,24 @@ def _parse_bool(xp, c: Vec, first, last, any_c):
 
 
 def _parse_date(xp, c: Vec, first, last, any_c):
-    """ISO yyyy-MM-dd (also yyyy-M-d); invalid -> null."""
+    """Spark DateTimeUtils.stringToDate grammar: yyyy | yyyy-[m]m |
+    yyyy-[m]m-[d]d, where the full form may trail a 'T' or space segment
+    (time-of-day text, ignored); invalid -> null."""
     chars = c.data
     n, w = chars.shape
 
-    def at(i):
-        return xp.take_along_axis(chars, xp.clip(i, 0, w - 1)[:, None],
-                                  axis=1)[:, 0]
-
-    # find the two dashes
+    # a trailing 'T'/space segment truncates the token (only legal after
+    # the full y-m-d form, enforced below)
     j = xp.arange(w, dtype=np.int32)[None, :]
     in_tok = (j >= first[:, None]) & (j <= last[:, None])
+    sep = ((chars == np.uint8(ord("T"))) |
+           (chars == np.uint8(ord(" ")))) & in_tok
+    has_sep = xp.any(sep, axis=1)
+    sep_at = xp.where(has_sep, xp.argmax(sep, axis=1).astype(np.int32),
+                      np.int32(w))
+    last = xp.minimum(last, sep_at - 1)
+    in_tok = (j >= first[:, None]) & (j <= last[:, None])
+
     dash = (chars == np.uint8(ord("-"))) & in_tok
     # exclude a leading sign position
     dash = dash & (j != first[:, None])
@@ -503,10 +520,16 @@ def _parse_date(xp, c: Vec, first, last, any_c):
             acc = xp.where(inside & good, acc * 10 + dig.astype(np.int64), acc)
         return acc, good
 
-    y, gy = parse_num(first, d1 - 1)
-    m, gm = parse_num(d1 + 1, d2 - 1)
-    d, gd = parse_num(d2 + 1, last)
-    ok = any_c & (ndash == 2) & gy & gm & gd & \
+    one = xp.ones(n, dtype=np.int64)
+    y, gy = parse_num(first, xp.where(ndash >= 1, d1 - 1, last))
+    m_p, gm_p = parse_num(d1 + 1, xp.where(ndash == 2, d2 - 1, last))
+    d_p, gd_p = parse_num(d2 + 1, last)
+    m = xp.where(ndash >= 1, m_p, one)
+    gm = xp.where(ndash >= 1, gm_p, True)
+    d = xp.where(ndash == 2, d_p, one)
+    gd = xp.where(ndash == 2, gd_p, True)
+    ok = any_c & (ndash <= 2) & (~has_sep | (ndash == 2)) & \
+        gy & gm & gd & \
         (m >= 1) & (m <= 12) & (d >= 1) & (d <= 31) & (y >= 1) & (y <= 9999)
     days = days_from_civil(xp, xp.where(ok, y, 1970), xp.where(ok, m, 1),
                            xp.where(ok, d, 1))

@@ -343,7 +343,12 @@ class JsonToStructs(Expression):
             if isinstance(f.data_type, T.StringType):
                 kids.append(raw)
             else:
+                # PERMISSIVE-mode field casts null malformed values and
+                # never throw, even under ANSI (Spark's from_json ignores
+                # spark.sql.ansi.enabled for field conversion)
+                import dataclasses as _dc
+                pctx = _dc.replace(ctx, ansi=False) if ctx.ansi else ctx
                 cast = Cast(self.children[0], f.data_type)
-                kids.append(cast._compute(ctx, raw))
+                kids.append(cast._compute(pctx, raw))
         n = s.data.shape[0]
         return Vec(self.schema, s.validity, s.validity, None, tuple(kids))

@@ -24,6 +24,7 @@ class MemoryBudget:
     def __init__(self, total: int, conf: TpuConf):
         self.total = total
         self.used = 0
+        self.conf = conf
         self._lock = threading.Lock()
         self._alloc_count = 0
         self.inject_retry_at = conf.get("spark.rapids.sql.test.injectRetryOOM")
@@ -65,9 +66,37 @@ class MemoryBudget:
                 raise RetryOOM(
                     f"device memory pressure: need {nbytes}, "
                     f"used {self.used}/{self.total} (spilled {freed})")
-            raise SplitAndRetryOOM(
-                f"device memory exhausted: need {nbytes}, "
-                f"used {self.used}/{self.total}, nothing left to spill")
+            used = self.used
+        # terminal OOM: dump OUTSIDE the lock (file IO must not stall
+        # concurrent reserve/release), then raise
+        self._maybe_oom_dump(nbytes)
+        raise SplitAndRetryOOM(
+            f"device memory exhausted: need {nbytes}, "
+            f"used {used}/{self.total}, nothing left to spill")
+
+    def _maybe_oom_dump(self, need: int) -> None:
+        """Write the allocator state to spark.rapids.memory.gpu.oomDumpDir
+        on a terminal OOM (the reference dumps RMM state the same way) —
+        best-effort, the OOM itself still raises."""
+        try:
+            d = self.conf.get("spark.rapids.memory.gpu.oomDumpDir")
+            if not d:
+                return
+            import os
+            import time as _t
+            import uuid as _uuid
+            from .catalog import BufferCatalog
+            os.makedirs(d, exist_ok=True)
+            ts = _t.strftime("%Y%m%dT%H%M%S")
+            path = os.path.join(
+                d, f"oom_dump_{ts}_{os.getpid()}_"
+                   f"{_uuid.uuid4().hex[:6]}.txt")
+            with open(path, "w") as f:
+                f.write(f"MemoryBudget: need={need} used={self.used} "
+                        f"total={self.total}\n")
+                f.write(BufferCatalog.get().debug_dump() + "\n")
+        except Exception:
+            pass
 
     def release(self, nbytes: int) -> None:
         with self._lock:

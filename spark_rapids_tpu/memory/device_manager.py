@@ -130,6 +130,27 @@ class DeviceManager:
 
     @classmethod
     def shutdown(cls) -> None:
+        """Tear down device state; buffers still registered in the spill
+        catalog are leaks (an unclosed SpillableColumnarBatch) and log a
+        warning with the allocator state, like the reference's
+        shutdown-time RMM leak logging (GpuDeviceManager.scala:295-305,
+        MemoryCleaner leak log)."""
+        import logging
+        try:
+            from .catalog import BufferCatalog
+            # guard on the existing instance: get() would lazily build a
+            # catalog (and its spill temp dir) as a teardown side effect
+            leaks = BufferCatalog.get().leak_report() \
+                if BufferCatalog._instance is not None else []
+            if leaks:
+                log = logging.getLogger("spark_rapids_tpu.memory")
+                log.warning(
+                    "device shutdown with %d leaked buffer handle(s) "
+                    "(%d bytes) — close() every SpillableColumnarBatch:\n%s",
+                    len(leaks), sum(e["nbytes"] for e in leaks),
+                    BufferCatalog.get().debug_dump())
+        except Exception:
+            pass
         with cls._lock:
             cls._initialized = False
             cls.device = None

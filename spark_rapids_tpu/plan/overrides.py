@@ -64,14 +64,19 @@ def _tag_cast(meta: ExprMeta) -> None:
             f"cast {src.simple_string()} -> {e.to.simple_string()} is not "
             "supported on TPU")
     if meta.conf.is_ansi:
-        # numeric<->numeric ANSI casts report overflow via the kernel error
-        # flags; everything else (string parse, decimal) still falls back
+        # numeric<->numeric ANSI casts report overflow, and string-parse
+        # casts report malformed input, via the kernel error flags;
+        # decimal ANSI casts still fall back
         def plain_numeric(dt):
             return T.is_integral(dt) or T.is_floating(dt) or \
                 isinstance(dt, T.BooleanType)
-        if not (plain_numeric(src) and plain_numeric(e.to)):
+        ok = plain_numeric(src) and plain_numeric(e.to)
+        ok = ok or (isinstance(src, T.StringType) and
+                    (T.is_integral(e.to) or
+                     isinstance(e.to, (T.BooleanType, T.DateType))))
+        if not ok:
             meta.will_not_work(
-                "ANSI-mode cast beyond plain numeric types is not supported "
+                "ANSI-mode decimal/string-to-float casts are not supported "
                 "on TPU yet")
 
 

@@ -110,6 +110,40 @@ class TestAnsiCast:
         assert q.collect().column("x").to_pylist() == \
             q.collect_cpu().column("x").to_pylist() == [1, -2, None]
 
+    def test_string_to_int_malformed_raises(self, ansi_session):
+        df = ansi_session.from_arrow(pa.table({"s": pa.array(["12",
+                                                              "junk"])}))
+        _raises_both(ansi_session, df.select(x=Cast(col("s"), T.LONG)))
+
+    def test_string_to_int_overflow_raises(self, ansi_session):
+        df = ansi_session.from_arrow(pa.table(
+            {"s": pa.array(["99999999999999999999"])}))
+        _raises_both(ansi_session, df.select(x=Cast(col("s"), T.LONG)))
+
+    def test_string_parse_casts_ok_and_null_passthrough(self, ansi_session):
+        import datetime as dtm
+        df = ansi_session.from_arrow(pa.table(
+            {"s": pa.array([" 42 ", None]),
+             "d": pa.array(["2020-02-29", None]),
+             "b": pa.array(["true", None])}))
+        q = df.select(x=Cast(col("s"), T.INT),
+                      y=Cast(col("d"), T.DATE),
+                      z=Cast(col("b"), T.BOOLEAN))
+        got = q.collect()
+        assert got.column("x").to_pylist() == [42, None]
+        assert got.column("y").to_pylist() == [dtm.date(2020, 2, 29), None]
+        assert got.column("z").to_pylist() == [True, None]
+
+    def test_string_to_date_malformed_raises(self, ansi_session):
+        df = ansi_session.from_arrow(pa.table(
+            {"d": pa.array(["2020-13-45"])}))
+        _raises_both(ansi_session, df.select(x=Cast(col("d"), T.DATE)))
+
+    def test_string_cast_in_filter_raises(self, ansi_session):
+        df = ansi_session.from_arrow(pa.table({"s": pa.array(["nope"])}))
+        _raises_both(ansi_session,
+                     df.filter(Cast(col("s"), T.LONG) > lit(0)))
+
 
 class TestAnsiLazyBranches:
     def test_guarded_division_in_if_does_not_raise(self, ansi_session):

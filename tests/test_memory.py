@@ -132,6 +132,42 @@ class TestBudget:
         b.release(900)
         MemoryBudget.initialize(1 << 40)
 
+    def test_oom_dump_dir_writes_allocator_state(self, tmp_path):
+        # spark.rapids.memory.gpu.oomDumpDir analog: terminal OOM drops a
+        # debug-dump file before raising
+        import os
+        from spark_rapids_tpu.config import TpuConf
+        conf = TpuConf({"spark.rapids.memory.gpu.oomDumpDir":
+                        str(tmp_path / "dumps")})
+        MemoryBudget._instance = MemoryBudget(1000, conf)
+        cat = BufferCatalog()
+        BufferCatalog._instance = cat
+        h = cat.add_batch(_batch(), label="suspect")
+        cat.synchronous_spill(1 << 40)  # already host-tier: nothing frees
+        b = MemoryBudget.get()
+        with pytest.raises(SplitAndRetryOOM):
+            b.reserve(5000)
+        files = os.listdir(str(tmp_path / "dumps"))
+        assert len(files) == 1 and files[0].startswith("oom_dump_")
+        text = open(str(tmp_path / "dumps" / files[0])).read()
+        assert "MemoryBudget: need=5000" in text
+        assert "suspect" in text and "BufferCatalog" in text
+        cat.remove(h)
+        MemoryBudget.initialize(1 << 40)
+
+    def test_shutdown_logs_leaked_handles(self, caplog):
+        import logging
+        from spark_rapids_tpu.memory.device_manager import DeviceManager
+        cat = BufferCatalog()
+        BufferCatalog._instance = cat
+        h = cat.add_batch(_batch(), label="leaky")
+        with caplog.at_level(logging.WARNING, "spark_rapids_tpu.memory"):
+            DeviceManager.shutdown()
+        assert any("leaked buffer handle" in r.message and
+                   "leaky" in r.message for r in caplog.records)
+        cat.remove(h)
+        BufferCatalog._instance = BufferCatalog()
+
     def test_pressure_spills_catalog(self):
         MemoryBudget.initialize(1 << 40)
         cat = BufferCatalog(host_limit=1 << 30)
