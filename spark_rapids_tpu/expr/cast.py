@@ -521,15 +521,24 @@ def _parse_date(xp, c: Vec, first, last, any_c):
         return acc, good
 
     one = xp.ones(n, dtype=np.int64)
-    y, gy = parse_num(first, xp.where(ndash >= 1, d1 - 1, last))
-    m_p, gm_p = parse_num(d1 + 1, xp.where(ndash == 2, d2 - 1, last))
+    y_end = xp.where(ndash >= 1, d1 - 1, last)
+    m_end = xp.where(ndash == 2, d2 - 1, last)
+    y, gy = parse_num(first, y_end)
+    m_p, gm_p = parse_num(d1 + 1, m_end)
     d_p, gd_p = parse_num(d2 + 1, last)
     m = xp.where(ndash >= 1, m_p, one)
     gm = xp.where(ndash >= 1, gm_p, True)
     d = xp.where(ndash == 2, d_p, one)
     gd = xp.where(ndash == 2, gd_p, True)
+    # Spark isValidDigits: the year segment is 4-7 digits, month/day 1-2
+    # (so '99' and '2020-012-01' are NULL, not dates)
+    y_len = y_end - first + 1
+    m_len = xp.where(ndash >= 1, m_end - d1, np.int32(1))
+    d_len = xp.where(ndash == 2, last - d2, np.int32(1))
+    digits_ok = (y_len >= 4) & (y_len <= 7) & \
+        (m_len >= 1) & (m_len <= 2) & (d_len >= 1) & (d_len <= 2)
     ok = any_c & (ndash <= 2) & (~has_sep | (ndash == 2)) & \
-        gy & gm & gd & \
+        gy & gm & gd & digits_ok & \
         (m >= 1) & (m <= 12) & (d >= 1) & (d <= 31) & (y >= 1) & (y <= 9999)
     days = days_from_civil(xp, xp.where(ok, y, 1970), xp.where(ok, m, 1),
                            xp.where(ok, d, 1))

@@ -334,6 +334,11 @@ class JsonToStructs(Expression):
         xp = ctx.xp
         # one structural scan shared by every field extraction
         structure = _structure(xp, s.data, s.lengths.astype(np.int32))
+        # PERMISSIVE-mode field casts null malformed values and never
+        # throw, even under ANSI (Spark's from_json ignores ansi mode
+        # for field conversion)
+        import dataclasses as _dc
+        pctx = _dc.replace(ctx, ansi=False) if ctx.ansi else ctx
         kids = []
         for f in self.schema.fields:
             vs, ve, ok, is_quoted = _json_value_spans(xp, s, [f.name],
@@ -343,11 +348,6 @@ class JsonToStructs(Expression):
             if isinstance(f.data_type, T.StringType):
                 kids.append(raw)
             else:
-                # PERMISSIVE-mode field casts null malformed values and
-                # never throw, even under ANSI (Spark's from_json ignores
-                # spark.sql.ansi.enabled for field conversion)
-                import dataclasses as _dc
-                pctx = _dc.replace(ctx, ansi=False) if ctx.ansi else ctx
                 cast = Cast(self.children[0], f.data_type)
                 kids.append(cast._compute(pctx, raw))
         n = s.data.shape[0]

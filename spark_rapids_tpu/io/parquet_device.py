@@ -583,6 +583,45 @@ def _expand_indices(page: _Page, dict_count: int):
     return jnp.clip(idx, 0, max(dict_count - 1, 0))
 
 
+def _merged_dict_indices(pages, dict_count: int):
+    """All dict pages of a chunk -> ONE u32 device index stream [total].
+
+    The per-page path costs ~15 eager dispatches per page (search-sorted
+    expansion, clip, gather) — hundreds of ops (and tunnel RPCs) for a
+    many-page chunk. Pages whose index streams share a bit width merge
+    into one run table on host (cheap numpy concatenation; bit offsets
+    shift by each page's packed-blob base) and expand in ONE jitted call
+    per bit-width segment; bw only grows as the dictionary fills, so
+    segments are rare (typically one)."""
+    import jax.numpy as jnp
+    segs = []  # (bw, [pages]) with consecutive equal bw
+    for p in pages:
+        bw = 0 if p.payload is None else int(p.bw)
+        if segs and segs[-1][0] == bw:
+            segs[-1][1].append(p)
+        else:
+            segs.append((bw, [p]))
+    outs = []
+    for bw, ps in segs:
+        ndef = sum(p.ndef for p in ps)
+        if ndef == 0:
+            continue
+        if bw == 0:
+            outs.append(jnp.zeros(ndef, jnp.uint32))
+            continue
+        kinds, counts, values, bitoffs, packed = _merge_runs(
+            [p.payload for p in ps])
+        idx = _expand_rle_u32(
+            jnp.asarray(kinds), jnp.asarray(counts), jnp.asarray(values),
+            jnp.asarray(bitoffs), jnp.asarray(packed),
+            row_bucket(ndef), bw)[:ndef]
+        outs.append(idx)
+    if not outs:
+        return jnp.zeros(0, jnp.uint32)
+    merged = outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+    return jnp.clip(merged, 0, max(dict_count - 1, 0))
+
+
 def _assemble_fixed(chunk: _Chunk, phys: str, dt, defined, cap: int):
     """Fixed-width column: per-page non-null value streams (PLAIN bitcast
     or dictionary gather) concatenated in page order, then scattered to row
@@ -599,6 +638,53 @@ def _assemble_fixed(chunk: _Chunk, phys: str, dt, defined, cap: int):
                 chunk.dict_raw, np_dt, count=chunk.dict_count))
         except ValueError as e:  # short dict blob: malformed, not a crash
             raise DeviceDecodeUnsupported(f"truncated dict page: {e}") from e
+    # fast path for the layouts parquet writers actually produce: a run
+    # of dict pages optionally followed by plain pages (the writer falls
+    # back to PLAIN exactly once, when the dictionary overflows). The
+    # dict prefix expands as ONE merged run table + ONE gather; the plain
+    # suffix ships as ONE host buffer — instead of ~15 eager dispatches
+    # (tunnel RPCs on the real chip) per page.
+    def plain_values(p):
+        if is_bool:
+            return p.payload.astype(np.bool_)
+        try:
+            return np.frombuffer(p.payload, np_dt, count=p.ndef)
+        except ValueError as e:  # short value payload
+            raise DeviceDecodeUnsupported(
+                f"truncated value page: {e}") from e
+
+    def finish(vals):
+        """Shared tail: pad to cap, scatter by null rank, logical dtype."""
+        if vals.shape[0] == 0:
+            vals = jnp.zeros(0, np.bool_ if is_bool else np_dt)
+        if vals.shape[0] < cap:
+            vals = jnp.pad(vals, (0, cap - vals.shape[0]))
+        data, validity = _scatter_values(vals[:cap], defined)
+        if isinstance(dt, T.DateType):
+            data = data.astype(jnp.int32)
+        elif data.dtype != dt.np_dtype:
+            data = data.astype(dt.np_dtype)
+        return Column(dt, data, validity)
+
+    kinds_seq = [p.kind for p in chunk.pages]
+    ndict = 0
+    while ndict < len(kinds_seq) and kinds_seq[ndict] == "dict":
+        ndict += 1
+    if chunk.pages and all(k == "plain" for k in kinds_seq[ndict:]):
+        pieces = []
+        if ndict:
+            if dict_vals is None:
+                raise DeviceDecodeUnsupported("dict page missing values")
+            dv = dict_vals[_merged_dict_indices(chunk.pages[:ndict],
+                                                chunk.dict_count)]
+            pieces.append(dv.astype(np.bool_) if is_bool else dv)
+        plain = [plain_values(p) for p in chunk.pages[ndict:]]
+        if plain:
+            pieces.append(jnp.asarray(np.concatenate(plain)))
+        vals = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+        return finish(vals)
+
+    # arbitrary page interleavings (not seen from real writers, but legal)
     parts = []
     host_run: List[np.ndarray] = []  # coalesce consecutive host parts
 
@@ -609,15 +695,7 @@ def _assemble_fixed(chunk: _Chunk, phys: str, dt, defined, cap: int):
 
     for p in chunk.pages:
         if p.kind == "plain":
-            if is_bool:
-                host_run.append(p.payload.astype(np.bool_))
-            else:
-                try:
-                    host_run.append(np.frombuffer(p.payload, np_dt,
-                                                  count=p.ndef))
-                except ValueError as e:  # short value payload
-                    raise DeviceDecodeUnsupported(
-                        f"truncated value page: {e}") from e
+            host_run.append(plain_values(p))
         else:
             if dict_vals is None:
                 raise DeviceDecodeUnsupported("dict page missing values")
@@ -629,14 +707,7 @@ def _assemble_fixed(chunk: _Chunk, phys: str, dt, defined, cap: int):
         vals = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
     else:
         vals = jnp.zeros(0, np.bool_ if is_bool else np_dt)
-    if vals.shape[0] < cap:
-        vals = jnp.pad(vals, (0, cap - vals.shape[0]))
-    data, validity = _scatter_values(vals[:cap], defined)
-    if isinstance(dt, T.DateType):
-        data = data.astype(jnp.int32)
-    elif data.dtype != dt.np_dtype:
-        data = data.astype(dt.np_dtype)
-    return Column(dt, data, validity)
+    return finish(vals)
 
 
 def _assemble_strings(chunk: _Chunk, dt, defined, cap: int):

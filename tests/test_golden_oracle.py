@@ -437,17 +437,20 @@ class TestDatetimeGolden:
 class TestStringToDateGolden:
     def test_spark_stringtodate_grammar(self, session):
         # Spark DateTimeUtils.stringToDate: yyyy | yyyy-[m]m |
-        # yyyy-[m]m-[d]d (+ optional 'T'/space tail after the full form)
+        # yyyy-[m]m-[d]d (+ optional 'T'/space tail after the full form),
+        # with isValidDigits segment rules (year 4-7 digits, month/day
+        # 1-2) — '99' and '2020-012-01' are NULL, '02020-1-1' is a date
         from spark_rapids_tpu.expr import Cast
         cases = ["2020", "2020-03", "2020-3-7", "2020-01-01",
                  "2020-01-01T12:30:00", "2020-01-01 12:30", "2020T12",
                  "2020-1", "abc", "2020-13-01", "2020-02-30",
-                 " 2021-06-05 ", "99", "2020-01-01Trubbish", None]
+                 " 2021-06-05 ", "99", "2020-012-01", "02020-1-1",
+                 "2020-01-01Trubbish", None]
         exp = [dt.date(2020, 1, 1), dt.date(2020, 3, 1),
                dt.date(2020, 3, 7), dt.date(2020, 1, 1),
                dt.date(2020, 1, 1), dt.date(2020, 1, 1), None,
                dt.date(2020, 1, 1), None, None, None,
-               dt.date(2021, 6, 5), dt.date(99, 1, 1),
+               dt.date(2021, 6, 5), None, None, dt.date(2020, 1, 1),
                dt.date(2020, 1, 1), None]
         df = session.from_arrow(pa.table({"s": pa.array(cases)}))
         q = df.select(d=Cast(col("s"), T.DATE))
