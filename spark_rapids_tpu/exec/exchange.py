@@ -163,14 +163,6 @@ class TpuShuffleExchangeExec(UnaryTpuExec):
         ndev batches, one per device partition, empties included so downstream
         zipped execs stay positionally aligned. Slot overflow is detected ON
         DEVICE and retried with a doubled slot_cap — rows are never dropped."""
-        from ..errors import CpuFallbackRequired
-        for b in batches:
-            for c in b.columns:
-                if c.overflow is not None:
-                    # the collective moves row-aligned leaves; a shared
-                    # long-string blob is not row-sliceable across devices
-                    raise CpuFallbackRequired(
-                        "mesh exchange over a long-string overflow column")
         from jax.sharding import NamedSharding, PartitionSpec as P
         from ..columnar.column import Column
         from ..columnar.padding import row_bucket
@@ -197,6 +189,20 @@ class TpuShuffleExchangeExec(UnaryTpuExec):
         sh = NamedSharding(mesh, P(SHUFFLE_AXIS))
         leaves = [jax.device_put(l, sh) for l in leaves]
         pid = jax.device_put(pid.astype(jnp.int32), sh)
+
+        # long-string overflow columns: the head/lengths move with the row
+        # plane above; the row-UNALIGNED tail blobs move through a second
+        # BYTE-plane all_to_all (tail bytes of each device's row segment,
+        # in row order, with a per-byte destination id) — same collective,
+        # different unit
+        ovf_ix = [ci for ci, c in enumerate(g.columns)
+                  if c.overflow is not None]
+        ovf_results = {}
+        if ovf_ix:
+            pid_np = np.asarray(pid)
+            for ci in ovf_ix:
+                ovf_results[ci] = self._exchange_tail_bytes(
+                    mesh, ndev, cap, g.columns[ci], pid_np, sh)
 
         conf_slot = self.conf.get("spark.rapids.shuffle.ici.slotRows")
         slot_cap = min(conf_slot, cap) if conf_slot > 0 else cap
@@ -227,14 +233,94 @@ class TpuShuffleExchangeExec(UnaryTpuExec):
                 if has_lengths[ci]:
                     lengths = out_leaves[i][lo:lo + out_cap]
                     i += 1
-                cols.append(Column(c.dtype, data, validity, lengths))
+                overflow = None
+                if ci in ovf_results:
+                    overflow = self._partition_overflow(
+                        ovf_results[ci], p, lengths,
+                        c.data.shape[1], int(counts[p]), out_cap)
+                cols.append(Column(c.dtype, data, validity, lengths,
+                                   overflow=overflow))
             out = ColumnarBatch(batch.schema, tuple(cols),
                                 jnp.asarray(counts[p], jnp.int32))
             self.num_output_rows.add(int(counts[p]))
             yield self._count_output(out)
 
+    def _exchange_tail_bytes(self, mesh, ndev: int, cap: int, col,
+                             pid_np: np.ndarray, sh):
+        """Byte-plane all_to_all for one overflow column: each device's
+        segment contributes its live rows' tail bytes IN ROW ORDER with a
+        per-byte destination id. The collective's stable per-destination
+        ordering then guarantees the arriving byte stream is the arriving
+        row stream expanded — tail_start realigns with one cumsum.
+        Returns (global byte leaf, per-device byte counts, byte out_cap)."""
+        from ..columnar.padding import row_bucket
+        from ..parallel.collective import build_exchange_fn
+        blob = np.asarray(col.overflow[0])
+        tstart = np.asarray(col.overflow[1]).astype(np.int64)
+        lens = np.asarray(col.lengths).astype(np.int64)
+        hw = col.data.shape[1]
+        tlen = np.maximum(lens - hw, 0)
+        tlen[pid_np < 0] = 0  # padding rows carry no bytes
+        per_dev = []
+        max_bytes = 1
+        for d in range(ndev):
+            sl = slice(d * cap, (d + 1) * cap)
+            tl = tlen[sl]
+            idx = np.repeat(tstart[sl], tl) + _segment_arange(tl)
+            per_dev.append((blob[np.clip(idx, 0, blob.size - 1)],
+                            np.repeat(pid_np[sl], tl).astype(np.int32)))
+            max_bytes = max(max_bytes, per_dev[-1][0].size)
+        bcap = row_bucket(max_bytes)
+        stream = np.zeros(ndev * bcap, np.uint8)
+        bpid = np.full(ndev * bcap, -1, np.int32)
+        for d, (b, p) in enumerate(per_dev):
+            stream[d * bcap:d * bcap + b.size] = b
+            bpid[d * bcap:d * bcap + p.size] = p
+        sleaf = jax.device_put(jnp.asarray(stream), sh)
+        bp = jax.device_put(jnp.asarray(bpid), sh)
+        # slot_cap == per-device byte capacity can never overflow (a source
+        # holds at most bcap bytes total), so a single exchange suffices —
+        # assert rather than retry so a broken invariant fails loud
+        fn = build_exchange_fn(mesh, ndev, slot_cap=bcap)
+        out, bcounts, ov = fn([sleaf], bp)
+        if bool(ov):
+            raise RuntimeError(
+                "byte-plane exchange overflowed its provably-safe slot "
+                "capacity (collective slotting invariant broken)")
+        return out[0], np.asarray(bcounts), ndev * bcap
+
+    @staticmethod
+    def _partition_overflow(ovf_result, p: int, lengths, hw: int,
+                            nrows: int, out_cap: int):
+        """Rebuild one partition's (blob, tail_start) from the exchanged
+        byte plane: arriving rows and bytes share the (source, row) order,
+        so tail offsets are the exclusive cumsum of the arriving rows'
+        tail lengths."""
+        from ..columnar.strings import blob_bucket
+        byte_leaf, bcounts, bcap_out = ovf_result
+        nbytes = int(bcounts[p])
+        seg = np.asarray(byte_leaf[p * bcap_out:p * bcap_out + nbytes])
+        blob = np.zeros(blob_bucket(max(nbytes, 1)), np.uint8)
+        blob[:nbytes] = seg
+        lens = np.asarray(lengths[:out_cap]).astype(np.int64)
+        tlen = np.maximum(lens - hw, 0)
+        tlen[nrows:] = 0  # dead tail rows carry garbage lengths
+        tail_start = np.zeros(out_cap, np.int32)
+        if out_cap > 1:
+            tail_start[1:] = np.cumsum(tlen[:-1]).astype(np.int32)
+        import jax.numpy as _jnp
+        return (_jnp.asarray(blob), _jnp.asarray(tail_start))
+
     def _arg_string(self):
         return f"[{self.spec}]"
+
+
+def _segment_arange(lens: np.ndarray) -> np.ndarray:
+    """[0..lens[0]), [0..lens[1]), ... concatenated (vectorized)."""
+    total = int(lens.sum())
+    out = np.arange(total, dtype=np.int64)
+    seg_starts = np.concatenate(([0], np.cumsum(lens)[:-1]))
+    return out - np.repeat(seg_starts, lens)
 
 
 @jax.jit

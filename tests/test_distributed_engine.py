@@ -95,6 +95,42 @@ class TestMeshAggregate:
         assert_same(q, sort_by=["tag"], approx_cols=("s",))
 
 
+class TestMeshLongStrings:
+    def test_long_string_column_rides_the_collective(self, session, rng):
+        """Round-4: overflow (chunked long-string) columns no longer fall
+        the whole exchange back to host — heads/lengths move with the row
+        plane and tail blobs through a second byte-plane all_to_all; the
+        arriving stream realigns by cumsum (exec/exchange.py
+        _exchange_tail_bytes)."""
+        n = 600
+        ids = rng.integers(0, 40, n)
+        payload = [("L%d-" % i) + "x" * int(rng.integers(300, 2500))
+                   if i % 5 == 0 else f"short-{i}" for i in range(n)]
+        fact = session.from_arrow(pa.table({
+            "id": pa.array(ids, type=pa.int64()),
+            "s": pa.array(payload),
+        }))
+        dim = session.from_arrow(make_dim(rng, n=40))
+        q = fact.join(dim, on="id", how="inner")
+        out = assert_same(q, sort_by=["id", "s"])
+        # the long payloads really crossed the wire intact
+        longs = [s for s in out.column("s").to_pylist() if len(s) > 256]
+        assert longs and all(s.startswith("L") and s.endswith("x")
+                             for s in longs)
+
+    def test_long_string_groupby_key_exchange(self, session, rng):
+        n = 400
+        payload = ["k%d" % (i % 7) + "y" * int(rng.integers(400, 1200))
+                   for i in range(n)]
+        df = session.from_arrow(pa.table({
+            "g": pa.array((np.arange(n) % 7).astype(np.int64)),
+            "s": pa.array(payload),
+            "v": pa.array(rng.integers(0, 100, n), type=pa.int64()),
+        }))
+        q = df.group_by("g").agg(n=Count(col("s")), s=Sum(col("v")))
+        assert_same(q, sort_by=["g"])
+
+
 class TestOverflowRetry:
     def test_skewed_slot_overflow_retries_not_drops(self, rng):
         """All rows share one key -> they all land on one device. A bounded
