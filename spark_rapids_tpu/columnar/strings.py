@@ -41,7 +41,16 @@ from .padding import width_bucket
 
 __all__ = ["head_width", "blob_bucket", "build_string_leaves",
            "assemble_matrix", "compact_tails", "tails_from_matrix",
-           "flatten_live_bytes"]
+           "flatten_live_bytes", "segment_arange"]
+
+
+def segment_arange(lens: "np.ndarray") -> "np.ndarray":
+    """[0..lens[0]), [0..lens[1]), ... concatenated — the within-segment
+    position stream every blob gather/scatter in this layout uses."""
+    total = int(lens.sum())
+    out = np.arange(total, dtype=np.int64)
+    seg_starts = np.concatenate(([0], np.cumsum(lens)[:-1]))
+    return out - np.repeat(seg_starts, lens)
 
 
 def head_width(conf=None) -> int:

@@ -254,6 +254,7 @@ class TpuShuffleExchangeExec(UnaryTpuExec):
         row stream expanded — tail_start realigns with one cumsum.
         Returns (global byte leaf, per-device byte counts, byte out_cap)."""
         from ..columnar.padding import row_bucket
+        from ..columnar.strings import segment_arange
         from ..parallel.collective import build_exchange_fn
         blob = np.asarray(col.overflow[0])
         tstart = np.asarray(col.overflow[1]).astype(np.int64)
@@ -266,7 +267,7 @@ class TpuShuffleExchangeExec(UnaryTpuExec):
         for d in range(ndev):
             sl = slice(d * cap, (d + 1) * cap)
             tl = tlen[sl]
-            idx = np.repeat(tstart[sl], tl) + _segment_arange(tl)
+            idx = np.repeat(tstart[sl], tl) + segment_arange(tl)
             per_dev.append((blob[np.clip(idx, 0, blob.size - 1)],
                             np.repeat(pid_np[sl], tl).astype(np.int32)))
             max_bytes = max(max_bytes, per_dev[-1][0].size)
@@ -308,19 +309,10 @@ class TpuShuffleExchangeExec(UnaryTpuExec):
         tail_start = np.zeros(out_cap, np.int32)
         if out_cap > 1:
             tail_start[1:] = np.cumsum(tlen[:-1]).astype(np.int32)
-        import jax.numpy as _jnp
-        return (_jnp.asarray(blob), _jnp.asarray(tail_start))
+        return (jnp.asarray(blob), jnp.asarray(tail_start))
 
     def _arg_string(self):
         return f"[{self.spec}]"
-
-
-def _segment_arange(lens: np.ndarray) -> np.ndarray:
-    """[0..lens[0]), [0..lens[1]), ... concatenated (vectorized)."""
-    total = int(lens.sum())
-    out = np.arange(total, dtype=np.int64)
-    seg_starts = np.concatenate(([0], np.cumsum(lens)[:-1]))
-    return out - np.repeat(seg_starts, lens)
 
 
 @jax.jit

@@ -55,8 +55,9 @@ def _structural_precheck(blob, starts, ends):
     lens = (ends - starts).astype(np.int64)
     # compacted in-row byte domain: row r contributes bytes
     # [starts[r], ends[r]) in order
+    from ..columnar.strings import segment_arange
     rowid = np.repeat(np.arange(nrows, dtype=np.int32), lens)
-    byte_ix = np.repeat(starts, lens) + _segment_arange(lens)
+    byte_ix = np.repeat(starts, lens) + segment_arange(lens)
     bv = blob[byte_ix]
     isq = (blob == np.uint8(ord('"'))).astype(np.int32)
     qcs0 = np.concatenate(([0], np.cumsum(isq, dtype=np.int32)))
@@ -80,14 +81,6 @@ def _structural_precheck(blob, starts, ends):
         raise DeviceDecodeUnsupported(
             "nested/multiple objects per line fall back to host")
     return starts[live_rows], ends[live_rows]
-
-
-def _segment_arange(lens):
-    """[0..lens[0]), [0..lens[1]), ... concatenated (vectorized)."""
-    total = int(lens.sum())
-    out = np.arange(total, dtype=np.int64)
-    seg_starts = np.concatenate(([0], np.cumsum(lens)[:-1]))
-    return out - np.repeat(seg_starts, lens)
 
 
 def device_decode_json_file(scan, path: str

@@ -783,9 +783,6 @@ def _assemble_strings(chunk: _Chunk, dt, defined, cap: int):
 
     from ..columnar.padding import width_bucket
     width = width_bucket(max_len)
-    if width > get_default_conf().string_max_width:
-        raise DeviceDecodeUnsupported(
-            f"string width {max_len} exceeds device layout limit")
     if st_parts:
         starts = st_parts[0] if len(st_parts) == 1 else \
             jnp.concatenate(st_parts)
@@ -799,9 +796,54 @@ def _assemble_strings(chunk: _Chunk, dt, defined, cap: int):
         lens = jnp.pad(lens, (0, cap - lens.shape[0]))
     blob = jnp.asarray(np.concatenate(blob_np_parts) if blob_np_parts
                        else np.zeros(1, np.uint8))
+    if width > get_default_conf().string_max_width:
+        # over-wide values build the CHUNKED long-string layout on device
+        # (head matrix + shared tail blob) instead of host-falling-back —
+        # the same representation from_arrow would build after a host
+        # decode, so downstream behavior is identical, minus the fallback
+        return _assemble_long_strings(jnp, dt, blob, starts, lens,
+                                      defined, cap)
     matrix, lengths = _gather_strings(blob, starts[:cap], lens[:cap],
                                       defined, width)
     return Column(dt, matrix, defined, lengths)
+
+
+def _assemble_long_strings(jnp, dt, blob, starts, lens, defined, cap: int):
+    """Chunked layout from per-value blob spans: head bytes gather through
+    the standard matrix kernel at the head width; tail bytes (beyond the
+    head) flatten into the shared blob with a positional gather; offsets
+    are one exclusive cumsum (columnar/strings.py layout).
+
+    starts/lens are VALUE-dense (one entry per non-null value, like every
+    parquet value stream) — rows map to values by null rank, the same
+    mapping _gather_strings applies for the head."""
+    from ..columnar.column import Column
+    from ..columnar.strings import blob_bucket, head_width
+    hw = head_width()
+    head, lengths = _gather_strings(blob, starts[:cap], lens[:cap],
+                                    defined, hw)
+    rank = jnp.cumsum(defined.astype(jnp.int32)) - 1
+    safe = jnp.clip(rank, 0, cap - 1)
+    row_starts = starts[:cap][safe]
+    row_lens = jnp.where(defined, lens[:cap][safe], 0)
+    tail_lens = jnp.maximum(row_lens.astype(jnp.int64) - hw, 0)
+    offs = jnp.cumsum(tail_lens)
+    total = int(offs[cap - 1]) if cap else 0
+    bb = blob_bucket(max(total, 1))
+    if total == 0:
+        tail_blob = jnp.zeros(bb, jnp.uint8)
+    else:
+        g = jnp.arange(total, dtype=jnp.int64)
+        rid = jnp.searchsorted(offs, g, side="right").astype(jnp.int32)
+        rid = jnp.minimum(rid, cap - 1)
+        base = jnp.where(rid > 0, offs[jnp.maximum(rid - 1, 0)], 0)
+        src = row_starts[rid] + hw + (g - base)
+        tail_blob = jnp.pad(
+            blob[jnp.clip(src, 0, blob.shape[0] - 1)], (0, bb - total))
+    tail_start = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), offs[:-1].astype(jnp.int32)])
+    return Column(dt, head, defined, lengths,
+                  overflow=(tail_blob, tail_start))
 
 
 def device_decode_file(pf, path: str, schema) -> Iterator:

@@ -51,6 +51,15 @@ def _used_device_decode(session, path):
     return True, batches[0][0] if batches else None
 
 
+def _col_strings(col, nrows: int):
+    """Decode a (possibly chunked-layout) string column to python strings."""
+    import numpy as np
+    from spark_rapids_tpu.columnar.strings import assemble_matrix
+    mat, lens = assemble_matrix(col.data, col.lengths, col.overflow, nrows)
+    return [bytes(np.asarray(mat[i, :int(lens[i])])).decode()
+            for i in range(nrows)]
+
+
 class TestDeviceParquetDecode:
     @pytest.mark.parametrize("compression", ["snappy", "none", "zstd"])
     def test_plain_roundtrip(self, session, rng, tmp_path, compression):
@@ -159,16 +168,42 @@ class TestDeviceParquetDecode:
         assert got.column("v").to_pylist() == exact.column("v").to_pylist()
         assert got.column("f").to_pylist() == exact.column("f").to_pylist()
 
-    def test_overwide_strings_fall_back(self, session, rng, tmp_path):
-        # exceeds spark.rapids.tpu.string.maxWidth: the DEVICE-planned
-        # query must still answer (runtime CpuFallbackRequired -> host
-        # re-run), not crash with StringWidthExceeded
+    def test_overwide_strings_decode_to_chunked_layout(self, session, rng,
+                                                       tmp_path):
+        # beyond spark.rapids.tpu.string.maxWidth the decoder builds the
+        # CHUNKED long-string layout ON DEVICE (round-4; previously a
+        # per-row-group host fallback): the device path stays in use and
+        # the column carries a head matrix + shared tail blob
         wide = "w" * 20000
         t = pa.table({"s": pa.array(["a", wide, "b"])})
         path = write_plain(tmp_path, t)
+        used, _ = _used_device_decode(session, path)
+        assert used
         df = session.read_parquet(path)
         assert df.collect().column("s").to_pylist() == ["a", wide, "b"]
         assert df.collect_cpu().column("s").to_pylist() == ["a", wide, "b"]
+
+    def test_megabyte_string_bounded_memory(self, session, rng, tmp_path):
+        # a 1MB value must cost ~its own bytes on device, not cap * 1MB
+        import numpy as np
+        from spark_rapids_tpu.io.parquet_device import (device_decode_file,
+                                                        file_supported)
+        big = "Z" * (1 << 20)
+        vals = [f"v{i}" for i in range(500)] + [big]
+        t = pa.table({"s": pa.array(vals)})
+        path = write_plain(tmp_path, t)
+        schema = session.read_parquet(path).plan.output
+        pf = file_supported(path, schema)
+        batches = list(device_decode_file(pf, path, schema))
+        total_bytes = sum(
+            int(c.data.size) + (int(c.overflow[0].size)
+                                if c.overflow is not None else 0)
+            for b, _ in batches for c in b.columns)
+        # head matrix (512*256) + blob (~1MB bucket) << cap * 1MB
+        assert total_bytes < 4 * (1 << 20)
+        got = [s for b, nr in batches
+               for s in _col_strings(b.columns[0], int(nr))]
+        assert got == vals
 
     def test_bool_across_many_small_pages(self, session, rng, tmp_path):
         # page bit-packing restarts per page: misalignment regression test
