@@ -102,7 +102,16 @@ class Cast(Expression):
         if isinstance(src, T.LongType) and isinstance(dst, T.TimestampType):
             return Vec(dst, c.data * 1_000_000, c.validity)
         if isinstance(src, T.DecimalType) or isinstance(dst, T.DecimalType):
-            return _decimal_cast(xp, c, dst, self.ansi)
+            out = _decimal_cast(xp, c, dst, self.ansi)
+            if ctx is not None and ctx.ansi:
+                # every decimal-cast null-from-non-null is an overflow /
+                # out-of-range (rescale, precision, int bounds) — exactly
+                # the cases Spark ANSI raises on
+                from .base import ansi_raise
+                ansi_raise(ctx, c.validity & ~out.validity,
+                           "[NUMERIC_VALUE_OUT_OF_RANGE] value out of "
+                           f"range for {dst.simple_string()}")
+            return out
         return _numeric_cast(xp, c, dst, ctx)
 
     def __repr__(self):

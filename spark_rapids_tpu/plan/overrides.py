@@ -64,19 +64,22 @@ def _tag_cast(meta: ExprMeta) -> None:
             f"cast {src.simple_string()} -> {e.to.simple_string()} is not "
             "supported on TPU")
     if meta.conf.is_ansi:
-        # numeric<->numeric ANSI casts report overflow, and string-parse
-        # casts report malformed input, via the kernel error flags;
-        # decimal ANSI casts still fall back
+        # numeric<->numeric and decimal ANSI casts report overflow, and
+        # string-parse casts report malformed input, via the kernel error
+        # flags; string->float is the one remaining fallback (its device
+        # parse is ~1 ulp off the JVM, see device_supported)
         def plain_numeric(dt):
             return T.is_integral(dt) or T.is_floating(dt) or \
                 isinstance(dt, T.BooleanType)
         ok = plain_numeric(src) and plain_numeric(e.to)
+        ok = ok or isinstance(src, T.DecimalType) or \
+            isinstance(e.to, T.DecimalType)
         ok = ok or (isinstance(src, T.StringType) and
                     (T.is_integral(e.to) or
                      isinstance(e.to, (T.BooleanType, T.DateType))))
         if not ok:
             meta.will_not_work(
-                "ANSI-mode decimal/string-to-float casts are not supported "
+                "ANSI-mode string-to-float casts are not supported "
                 "on TPU yet")
 
 

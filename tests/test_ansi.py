@@ -144,6 +144,37 @@ class TestAnsiCast:
         _raises_both(ansi_session,
                      df.filter(Cast(col("s"), T.LONG) > lit(0)))
 
+    def test_decimal_rescale_overflow_raises(self, ansi_session):
+        import decimal
+        dec = T.DecimalType(6, 1)
+        df = ansi_session.from_arrow(pa.table(
+            {"d": pa.array([decimal.Decimal("99999.5")],
+                           type=pa.decimal128(6, 1))}))
+        # rescale to (6, 3): 99999.500 needs 8 digits -> ANSI overflow
+        _raises_both(ansi_session,
+                     df.select(x=Cast(col("d"), T.DecimalType(6, 3))))
+
+    def test_decimal_to_int_out_of_range_raises(self, ansi_session):
+        import decimal
+        df = ansi_session.from_arrow(pa.table(
+            {"d": pa.array([decimal.Decimal("99999999999.00")],
+                           type=pa.decimal128(13, 2))}))
+        _raises_both(ansi_session, df.select(x=Cast(col("d"), T.INT)))
+
+    def test_decimal_casts_in_range_ok(self, ansi_session):
+        import decimal
+        D_ = decimal.Decimal
+        df = ansi_session.from_arrow(pa.table(
+            {"d": pa.array([D_("12.50"), None], type=pa.decimal128(10, 2)),
+             "i": pa.array([7, None], type=pa.int64())}))
+        q = df.select(a=Cast(col("d"), T.DecimalType(12, 4)),
+                      b=Cast(col("d"), T.INT),
+                      c=Cast(col("i"), T.DecimalType(10, 2)))
+        got = q.collect()
+        assert got.column("a").to_pylist() == [D_("12.5000"), None]
+        assert got.column("b").to_pylist() == [12, None]
+        assert got.column("c").to_pylist() == [D_("7.00"), None]
+
 
 class TestAnsiLazyBranches:
     def test_guarded_division_in_if_does_not_raise(self, ansi_session):
