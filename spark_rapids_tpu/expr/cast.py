@@ -102,15 +102,20 @@ class Cast(Expression):
         if isinstance(src, T.LongType) and isinstance(dst, T.TimestampType):
             return Vec(dst, c.data * 1_000_000, c.validity)
         if isinstance(src, T.DecimalType) or isinstance(dst, T.DecimalType):
-            out = _decimal_cast(xp, c, dst, self.ansi)
+            out = _decimal_cast(xp, c, dst)
             if ctx is not None and ctx.ansi:
                 # every decimal-cast null-from-non-null is an overflow /
                 # out-of-range (rescale, precision, int bounds) — exactly
-                # the cases Spark ANSI raises on
+                # the cases Spark ANSI raises on. Spark's error class is
+                # CAST_OVERFLOW for decimal->integral, NUMERIC_VALUE_OUT_
+                # OF_RANGE for decimal rescale/precision overflow.
                 from .base import ansi_raise
-                ansi_raise(ctx, c.validity & ~out.validity,
-                           "[NUMERIC_VALUE_OUT_OF_RANGE] value out of "
-                           f"range for {dst.simple_string()}")
+                msg = ("[CAST_OVERFLOW] value cannot be cast to "
+                       f"{dst.simple_string()} due to an overflow"
+                       if T.is_integral(dst) else
+                       "[NUMERIC_VALUE_OUT_OF_RANGE] value out of "
+                       f"range for {dst.simple_string()}")
+                ansi_raise(ctx, c.validity & ~out.validity, msg)
             return out
         return _numeric_cast(xp, c, dst, ctx)
 
@@ -558,7 +563,7 @@ def _parse_date(xp, c: Vec, first, last, any_c):
     return Vec(T.DATE, days.astype(np.int32), c.validity & ok)
 
 
-def _decimal_cast(xp, c: Vec, dst: T.DataType, ansi: bool) -> Vec:
+def _decimal_cast(xp, c: Vec, dst: T.DataType) -> Vec:
     src = c.dtype
     from .decimal128 import is_dec128
     if (isinstance(src, T.DecimalType) and is_dec128(src)) or \
@@ -593,13 +598,20 @@ def _decimal_cast(xp, c: Vec, dst: T.DataType, ansi: bool) -> Vec:
         scaled = xp.where(ok, a, 0) * (10 ** dst.scale)
         return Vec(dst, scaled, c.validity & ok)
     # decimal -> numeric
-    a = c.data.astype(np.float64) / (10 ** src.scale)
+    if isinstance(dst, T.BooleanType):
+        return Vec(dst, c.data.astype(np.int64) != 0, c.validity)
     if T.is_floating(dst):
+        a = c.data.astype(np.float64) / (10 ** src.scale)
         return Vec(dst, a.astype(dst.np_dtype), c.validity)
-    t = xp.trunc(a).astype(np.int64)
+    # integral targets truncate exactly in int64 (float64 can't represent
+    # all 18-digit values, mis-truncating near boundaries)
+    a = c.data.astype(np.int64)
+    p = np.int64(10 ** src.scale)
+    q = xp.where(a < 0, -((-a) // p), a // p)
     lo, hi = _INT_BOUNDS[dst.np_dtype]
-    return Vec(dst, xp.clip(t, lo, hi).astype(dst.np_dtype),
-               c.validity & (t >= lo) & (t <= hi))
+    ok = (q >= lo) & (q <= hi)
+    return Vec(dst, xp.where(ok, q, 0).astype(dst.np_dtype),
+               c.validity & ok)
 
 
 def _decimal128_cast(xp, c: Vec, dst: T.DataType) -> Vec:
@@ -633,15 +645,25 @@ def _decimal128_cast(xp, c: Vec, dst: T.DataType) -> Vec:
         hi, lo, fits = wide_to128(xp, w)
         ok = fits & in_bounds(xp, hi, lo, dst.precision)
         return Vec(dst, pack_limbs(xp, hi, lo), c.validity & ok)
-    # decimal128 -> numeric: via float64 (lossy, same contract as dec64)
+    # decimal128 -> numeric
     hi, lo = widen_operand(xp, c)
-    from .decimal128 import _u
-    val = hi.astype(np.float64) * (2.0 ** 64) + \
-        _u(xp, lo).astype(np.float64)
-    a = val / (10 ** src.scale)
+    if isinstance(dst, T.BooleanType):
+        return Vec(dst, (hi != 0) | (lo != 0), c.validity)
     if T.is_floating(dst):
-        return Vec(dst, a.astype(dst.np_dtype), c.validity)
-    t = xp.trunc(a).astype(np.int64)
+        # float targets go through float64 (lossy, documented contract)
+        from .decimal128 import _u
+        val = hi.astype(np.float64) * (2.0 ** 64) + \
+            _u(xp, lo).astype(np.float64)
+        return Vec(dst, (val / (10 ** src.scale)).astype(dst.np_dtype),
+                   c.validity)
+    # integral targets truncate EXACTLY through the limbs — a float64
+    # round-trip wraps at 2^63 (wrong wrapped value, not a null) and
+    # mis-truncates near-boundary 18-digit values
+    from .decimal128 import div_pow10_trunc
+    qhi, qlo = div_pow10_trunc(xp, hi, lo, src.scale)
+    fits64 = qhi == (qlo >> np.int64(63))  # sign-extension match
+    t = qlo.astype(np.int64)
     lo_b, hi_b = _INT_BOUNDS[dst.np_dtype]
-    return Vec(dst, xp.clip(t, lo_b, hi_b).astype(dst.np_dtype),
-               c.validity & (t >= lo_b) & (t <= hi_b))
+    ok = fits64 & (t >= lo_b) & (t <= hi_b)
+    return Vec(dst, xp.where(ok, t, 0).astype(dst.np_dtype),
+               c.validity & ok)

@@ -186,6 +186,21 @@ def div_pow10_half_up(xp, hi, lo, k: int):
     return out_hi, out_lo
 
 
+def div_pow10_trunc(xp, hi, lo, k: int):
+    """(hi, lo) / 10^k truncated toward zero (Spark Decimal.toLong
+    semantics for decimal -> integral casts)."""
+    neg = hi < 0
+    mhi, mlo = neg128(xp, hi, lo)
+    mhi = xp.where(neg, mhi, hi)
+    mlo = xp.where(neg, mlo, lo)
+    limbs = list(_split32(xp, mhi, mlo))
+    for _ in range(k):
+        limbs, _ = _divmod_u32(xp, limbs, 10)
+    qhi, qlo = _join32(xp, *limbs)
+    nhi, nlo = neg128(xp, qhi, qlo)
+    return xp.where(neg, nhi, qhi), xp.where(neg, nlo, qlo)
+
+
 def in_bounds(xp, hi, lo, precision: int):
     """|value| <= 10^precision - 1 (Spark overflow check)."""
     bound = 10 ** precision - 1

@@ -161,6 +161,44 @@ class TestAnsiCast:
                            type=pa.decimal128(13, 2))}))
         _raises_both(ansi_session, df.select(x=Cast(col("d"), T.INT)))
 
+    def test_decimal128_to_long_2pow63_raises_not_wraps(self, ansi_session):
+        # code-review repro: Decimal(2**63) -> LONG previously WRAPPED to
+        # int64-min through a float64 round-trip on both engines; the limb
+        # trunc-division must null it -> ANSI raises
+        import decimal
+        df = ansi_session.from_arrow(pa.table(
+            {"d": pa.array([decimal.Decimal(2 ** 63)],
+                           type=pa.decimal128(20, 0))}))
+        _raises_both(ansi_session, df.select(x=Cast(col("d"), T.LONG)))
+
+    def test_decimal_near_boundary_truncates_exactly(self, ansi_session):
+        # 18-digit values are not float64-representable; the exact int64
+        # path must not round 999999999999999999 up to 1e18
+        import decimal
+        v = decimal.Decimal("999999999999999999")
+        df = ansi_session.from_arrow(pa.table(
+            {"d": pa.array([v], type=pa.decimal128(18, 0)),
+             "w": pa.array([decimal.Decimal(2 ** 63 - 512)],
+                           type=pa.decimal128(20, 0))}))
+        q = df.select(x=Cast(col("d"), T.LONG), y=Cast(col("w"), T.LONG))
+        got = q.collect()
+        assert got.column("x").to_pylist() == [999999999999999999]
+        assert got.column("y").to_pylist() == [2 ** 63 - 512]
+
+    def test_decimal_to_boolean(self, ansi_session):
+        import decimal
+        D_ = decimal.Decimal
+        df = ansi_session.from_arrow(pa.table(
+            {"d": pa.array([D_("1.50"), D_("0.00"), None],
+                           type=pa.decimal128(10, 2)),
+             "w": pa.array([D_(2) ** 70, D_(0), None],
+                           type=pa.decimal128(25, 0))}))
+        q = df.select(a=Cast(col("d"), T.BOOLEAN),
+                      b=Cast(col("w"), T.BOOLEAN))
+        got = q.collect()
+        assert got.column("a").to_pylist() == [True, False, None]
+        assert got.column("b").to_pylist() == [True, False, None]
+
     def test_decimal_casts_in_range_ok(self, ansi_session):
         import decimal
         D_ = decimal.Decimal
