@@ -506,6 +506,58 @@ class TestWindowFrameGolden:
             assert rows[(e.i,)]["dr"] == e.dr
 
 
+class TestJoinSemanticsGolden:
+    def test_null_keys_never_match(self, session):
+        # SQL: NULL = NULL is not true, so null keys match nothing —
+        # including other null keys. NOTE pandas merge MATCHES NaN keys
+        # to each other (non-SQL semantics), so the expectations here are
+        # hand-written, not pandas-derived.
+        left = session.from_arrow(pa.table(
+            {"k": pa.array([1, None, 2, None], type=pa.int64()),
+             "a": pa.array([10, 20, 30, 40], type=pa.int64())}))
+        right = session.from_arrow(pa.table(
+            {"k": pa.array([1, None, 3], type=pa.int64()),
+             "b": pa.array([100, 200, 300], type=pa.int64())}))
+        inner = left.join(right, on="k", how="inner").collect()
+        assert inner.to_pylist() == [{"k": 1, "a": 10, "b": 100}]
+        louter = left.join(right, on="k", how="left").collect() \
+            .sort_by([("a", "ascending")]).to_pylist()
+        assert [r["b"] for r in louter] == [100, None, None, None]
+        anti = left.join(right, on="k", how="anti").collect() \
+            .sort_by([("a", "ascending")]).to_pylist()
+        # null-key left rows survive an anti join (they match nothing)
+        assert [r["a"] for r in anti] == [20, 30, 40]
+
+    def test_full_outer_vs_pandas(self, session):
+        rng = np.random.default_rng(13)
+        lk = rng.integers(0, 30, 120)
+        rk = rng.integers(10, 40, 80)
+        left = pa.table({"k": pa.array(lk, type=pa.int64()),
+                         "a": pa.array(np.arange(120), type=pa.int64())})
+        right = pa.table({"k": pa.array(rk, type=pa.int64()),
+                          "b": pa.array(np.arange(80), type=pa.int64())})
+        q = session.from_arrow(left).join(session.from_arrow(right),
+                                          on="k", how="full")
+        t = q.collect()
+        # ON-join semantics: BOTH key columns survive (read positionally —
+        # to_pylist() dicts would collapse the duplicate names)
+        lk_c, a_c, rk_c, b_c = (t.column(i).to_pylist() for i in range(4))
+
+        def key(tup):
+            return tuple(-1 if v is None else v + 1 for v in tup)
+
+        got = sorted(zip(lk_c, a_c, rk_c, b_c), key=key)
+        exp = left.to_pandas().merge(right.to_pandas(), on="k",
+                                     how="outer")
+        want = sorted(
+            ((None if pd.isna(r.a) else int(r.k),
+              None if pd.isna(r.a) else int(r.a),
+              None if pd.isna(r.b) else int(r.k),
+              None if pd.isna(r.b) else int(r.b))
+             for r in exp.itertuples()), key=key)
+        assert got == want
+
+
 class TestNullOrderingGolden:
     def test_sort_null_placement_explicit(self, session):
         t = pa.table({"v": pa.array([3, None, 1, None, 2],
