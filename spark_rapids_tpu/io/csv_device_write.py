@@ -34,6 +34,17 @@ def csv_write_schema_supported(schema) -> bool:
     return all(isinstance(dt, _WRITABLE) for dt in schema.types)
 
 
+def reject_overflow_columns(batches, fmt: str) -> None:
+    """Chunked long-string columns keep tails in a shared blob the
+    byte-matrix renders below can't see; the host writers reassemble full
+    values, so send the whole write there before any device work."""
+    for b in batches:
+        for col in b.columns:
+            if col.overflow is not None:
+                raise DeviceDecodeUnsupported(
+                    f"{fmt} device write: long-string overflow column")
+
+
 def _field_strings(batch) -> List:
     """Render every column of a device batch to string Vecs on device."""
     from ..expr.base import Vec
@@ -111,9 +122,9 @@ def device_encode_csv(batches, schema, sep: str = ",",
     parts: List[bytes] = []
     if header:
         parts.append((sep.join(schema.names) + "\n").encode())
+    batches = [b for b in batches if int(b.row_count())]
+    reject_overflow_columns(batches, "csv")
     for b in batches:
-        if int(b.row_count()) == 0:
-            continue
         fields = _field_strings(b)
         # unquoted output: cells containing sep/quote/newline need the
         # host writer's quoting machinery

@@ -214,7 +214,9 @@ def device_encode_orc(batches, schema) -> bytes:
     if not orc_write_schema_supported(schema):
         raise DeviceDecodeUnsupported(
             "orc device write: unsupported column type")
+    from .csv_device_write import reject_overflow_columns
     batches = [b for b in batches if int(b.row_count())]
+    reject_overflow_columns(batches, "orc")
     ncols = len(schema.names)
     out = bytearray(b"ORC")
     stripe_infos = []

@@ -79,8 +79,8 @@ def _tag_cast(meta: ExprMeta) -> None:
                      isinstance(e.to, (T.BooleanType, T.DateType))))
         if not ok:
             meta.will_not_work(
-                "ANSI-mode string-to-float casts are not supported "
-                "on TPU yet")
+                f"ANSI-mode cast {src.simple_string()} -> "
+                f"{e.to.simple_string()} is not supported on TPU yet")
 
 
 # ANSI arithmetic raises host-side from error flags the kernels return;

@@ -171,6 +171,55 @@ class TestCsvDeviceWrite:
         assert back.column("d").to_pylist() == [1.5, None, -2.25]
 
 
+class TestLongStringOverflowFallback:
+    """Chunked long-string columns (head matrix + tail blob) must NOT take
+    the device text writers — the byte-matrix render only sees head bytes
+    and would silently write repeated-head-byte garbage tails (advisor
+    r4 high findings). The host writers reassemble full values."""
+
+    def _long_table(self):
+        long = "x" * 9000 + "TAIL"
+        return pa.table({"i": pa.array([1, 2], type=pa.int64()),
+                         "s": pa.array(["short", long])}), long
+
+    def test_orc_encoder_rejects_overflow(self):
+        from spark_rapids_tpu.io.orc_device_write import device_encode_orc
+        from spark_rapids_tpu.io.parquet_device import \
+            DeviceDecodeUnsupported
+        t, _ = self._long_table()
+        b = batch_from_arrow(t)
+        assert b.columns[1].overflow is not None  # layout sanity
+        with pytest.raises(DeviceDecodeUnsupported):
+            device_encode_orc([b], Schema.from_arrow(t.schema))
+
+    def test_csv_encoder_rejects_overflow(self):
+        from spark_rapids_tpu.io.csv_device_write import device_encode_csv
+        from spark_rapids_tpu.io.parquet_device import \
+            DeviceDecodeUnsupported
+        t, _ = self._long_table()
+        with pytest.raises(DeviceDecodeUnsupported):
+            device_encode_csv([batch_from_arrow(t)],
+                              Schema.from_arrow(t.schema))
+
+    def test_write_orc_long_string_roundtrips(self, session, tmp_path):
+        t, long = self._long_table()
+        session.from_arrow(t).write_orc(str(tmp_path / "o"))
+        from pyarrow import orc
+        files = os.listdir(str(tmp_path / "o"))
+        back = orc.read_table(str(tmp_path / "o" / files[0]))
+        assert sorted(back.column("s").to_pylist()) == \
+            sorted(["short", long])
+
+    def test_write_csv_long_string_roundtrips(self, session, tmp_path):
+        t, long = self._long_table()
+        session.from_arrow(t).write_csv(str(tmp_path / "o"))
+        import pyarrow.csv as pacsv
+        files = os.listdir(str(tmp_path / "o"))
+        back = pacsv.read_csv(str(tmp_path / "o" / files[0]))
+        assert sorted(back.column("s").to_pylist()) == \
+            sorted(["short", long])
+
+
 class TestWriteFilesExecDevicePath:
     def test_write_command_exec_csv_device(self, session, tmp_path):
         # the plan-level write exec (CpuWriteFilesExec -> TpuWriteFilesExec)
