@@ -48,10 +48,10 @@ import numpy as np
 from .. import types as T
 from ..columnar.padding import row_bucket
 from .parquet_device import (DeviceDecodeUnsupported, _gather_strings,
-                             _scatter_values)
+                             _host_cols_to_device, _scatter_values)
 
-__all__ = ["OrcFileInfo", "decode_stripe", "device_decode_file",
-           "file_supported"]
+__all__ = ["OrcFileInfo", "columns_supported", "decode_stripe",
+           "device_decode_file", "file_supported"]
 
 
 # ----------------------------------------------------------------------------
@@ -112,6 +112,7 @@ def _pb_packed_u32(v: bytes) -> List[int]:
 _K_BOOLEAN, _K_BYTE, _K_SHORT, _K_INT, _K_LONG = 0, 1, 2, 3, 4
 _K_FLOAT, _K_DOUBLE, _K_STRING, _K_DATE = 5, 6, 7, 15
 _K_VARCHAR, _K_CHAR = 16, 17
+_K_TIMESTAMP, _K_DECIMAL, _K_TIMESTAMP_INSTANT = 9, 14, 18
 
 _KIND_FOR_DT = {
     T.BooleanType: (_K_BOOLEAN,),
@@ -123,7 +124,15 @@ _KIND_FOR_DT = {
     T.DoubleType: (_K_DOUBLE,),
     T.StringType: (_K_STRING, _K_VARCHAR, _K_CHAR),
     T.DateType: (_K_DATE,),
+    T.TimestampType: (_K_TIMESTAMP, _K_TIMESTAMP_INSTANT),
+    T.DecimalType: (_K_DECIMAL,),
 }
+
+# seconds from the unix epoch to the ORC timestamp epoch (2015-01-01 UTC)
+_ORC_TS_BASE = 1420070400
+
+# writer timezones the device timestamp decode accepts as "UTC wall clock"
+_UTC_TZ = {"", "UTC", "GMT", "Etc/UTC", "Etc/GMT", "Universal", "Zulu"}
 
 # CompressionKind
 _COMP_NONE, _COMP_ZLIB, _COMP_SNAPPY = 0, 1, 2
@@ -154,6 +163,8 @@ class OrcFileInfo:
     col_ids: Dict[str, int]       # flat field name -> ORC column id
     col_kinds: Dict[int, int]     # ORC column id -> Type.Kind
     num_rows: int
+    # ORC column id -> (precision, scale) for DECIMAL columns
+    col_decimals: Dict[int, Tuple[int, int]] = field(default_factory=dict)
 
 
 def _parse_footer(raw: bytes) -> OrcFileInfo:
@@ -189,6 +200,7 @@ def _parse_footer(raw: bytes) -> OrcFileInfo:
             stripes.append(_Stripe(s[1], s[2], s[3], s[4], s[5]))
         elif fno == 4:
             kind = 0
+            prec = scale = 0
             subs: List[int] = []
             names: List[str] = []
             for f2, _, v2 in _pb_fields(v):
@@ -198,22 +210,31 @@ def _parse_footer(raw: bytes) -> OrcFileInfo:
                     subs = _pb_packed_u32(v2)
                 elif f2 == 3:
                     names.append(v2.decode("utf-8"))
-            types.append((kind, subs, names))
+                elif f2 == 5:
+                    prec = v2
+                elif f2 == 6:
+                    scale = v2
+            types.append((kind, subs, names, prec, scale))
         elif fno == 6:
             num_rows = v
     if not types or types[0][0] != 12:  # root must be a STRUCT
         raise DeviceDecodeUnsupported("root type is not a struct")
-    root_kind, subs, names = types[0]
+    root_kind, subs, names = types[0][:3]
     col_ids = {nm: cid for nm, cid in zip(names, subs)}
     col_kinds = {cid: types[cid][0] for cid in subs if cid < len(types)}
+    col_decimals = {cid: (types[cid][3], types[cid][4])
+                    for cid in subs
+                    if cid < len(types) and types[cid][0] == _K_DECIMAL}
     return OrcFileInfo("", comp, block, stripes, col_ids, col_kinds,
-                       num_rows)
+                       num_rows, col_decimals)
 
 
-def file_supported(path: str, schema) -> OrcFileInfo:
-    """Footer-only supportability check — raises DeviceDecodeUnsupported
-    BEFORE any stripe bytes are decoded. Returns the parsed footer so the
-    decode pass doesn't re-parse it."""
+def columns_supported(path: str, schema):
+    """Footer-only PER-COLUMN supportability check — no stripe bytes
+    decoded. Returns (OrcFileInfo, {column name: reason}) where the dict
+    holds columns that must host-decode (pyarrow read_stripe) while their
+    siblings take the device path. File-level problems (bad footer,
+    unsupported compression) raise."""
     try:
         with open(path, "rb") as f:
             f.seek(0, 2)
@@ -246,16 +267,62 @@ def file_supported(path: str, schema) -> OrcFileInfo:
     # size pyarrow will accept, so those files take the host path honestly
     if info.compression not in (_COMP_NONE, _COMP_ZLIB, _COMP_SNAPPY):
         raise DeviceDecodeUnsupported(f"compression {info.compression}")
+    # the writer timezone lives in the stripe footers; read the FIRST
+    # stripe's once so non-UTC TIMESTAMP columns route to the host at the
+    # footer sweep (per column) instead of failing every stripe after its
+    # streams were already read — decode_stripe still re-checks per stripe
+    # as the correctness net for mixed-tz files
+    tz_reason = None
+    needs_tz = any(isinstance(dt, T.TimestampType) and
+                   info.col_kinds.get(info.col_ids.get(nm)) == _K_TIMESTAMP
+                   for nm, dt in zip(schema.names, schema.types))
+    if needs_tz and info.stripes:
+        try:
+            with open(path, "rb") as f:
+                tz = _stripe_writer_tz(info, f, info.stripes[0])
+        except (OSError, struct.error, DeviceDecodeUnsupported):
+            tz = None
+        if tz not in _UTC_TZ:
+            tz_reason = f"writer timezone {tz}"
+    bad = {}
     for name, dt in zip(schema.names, schema.types):
-        cid = info.col_ids.get(name)
-        if cid is None:
-            raise DeviceDecodeUnsupported(f"column {name} not flat")
-        ok = _KIND_FOR_DT.get(type(dt))
-        if ok is None:
-            raise DeviceDecodeUnsupported(f"logical type {dt}")
-        if info.col_kinds.get(cid) not in ok:
-            raise DeviceDecodeUnsupported(
-                f"ORC kind {info.col_kinds.get(cid)} for {dt}")
+        try:
+            cid = info.col_ids.get(name)
+            if cid is None:
+                raise DeviceDecodeUnsupported(f"column {name} not flat")
+            ok = _KIND_FOR_DT.get(type(dt))
+            if ok is None:
+                raise DeviceDecodeUnsupported(f"logical type {dt}")
+            if info.col_kinds.get(cid) not in ok:
+                raise DeviceDecodeUnsupported(
+                    f"ORC kind {info.col_kinds.get(cid)} for {dt}")
+            if tz_reason is not None and \
+                    info.col_kinds.get(cid) == _K_TIMESTAMP:
+                raise DeviceDecodeUnsupported(tz_reason)
+            if isinstance(dt, T.DecimalType):
+                prec, scale = info.col_decimals.get(cid, (0, 0))
+                if scale != dt.scale or prec > dt.precision:
+                    raise DeviceDecodeUnsupported(
+                        f"decimal({prec},{scale}) in file vs "
+                        f"{dt.simple_string()} in schema")
+                if dt.precision > T.DecimalType.MAX_LONG_DIGITS:
+                    # 128-bit mantissa varints would need carry-safe limb
+                    # accumulation; host-decode just this column
+                    raise DeviceDecodeUnsupported(
+                        f"{dt.simple_string()} mantissa wider than 64-bit")
+        except DeviceDecodeUnsupported as e:
+            bad[name] = str(e)
+    return info, bad
+
+
+def file_supported(path: str, schema) -> OrcFileInfo:
+    """All-or-nothing wrapper over columns_supported: raises
+    DeviceDecodeUnsupported if ANY column needs the host path. Returns the
+    parsed footer so the decode pass doesn't re-parse it."""
+    info, bad = columns_supported(path, schema)
+    if bad:
+        name, reason = next(iter(bad.items()))
+        raise DeviceDecodeUnsupported(f"{name}: {reason}")
     return info
 
 
@@ -691,6 +758,53 @@ def _expand_bytes_device(kinds, counts, values, offs, blob, cap: int):
     return jnp.where(j < ends[-1], byte, 0)
 
 
+@functools.partial(__import__("jax").jit, static_argnums=(1,))
+def _varint_zigzag_device(stream, cap: int):
+    """Signed-varint (zigzag base-128) value stream -> i64[cap] values on
+    device — the ORC DECIMAL mantissa encoding. Each byte's 7 payload bits
+    shift into place by its within-value position and a segment-sum folds
+    them per value; value boundaries come from the continuation bits.
+    Values wider than 64 bits never reach here (columns_supported keeps
+    precision > 18 on the host path)."""
+    import jax
+    import jax.numpy as jnp
+    b = stream.astype(jnp.uint64)
+    term = stream < 128  # last byte of its value
+    n = stream.shape[0]
+    i = jnp.arange(n, dtype=jnp.int64)
+    # value id of each byte: exclusive cumsum of terminators
+    vid = jnp.cumsum(term.astype(jnp.int64)) - term.astype(jnp.int64)
+    # within-value position: distance from the value's first byte
+    is_start = jnp.concatenate([jnp.ones(1, bool), term[:-1]])
+    seg_start = jax.lax.cummax(jnp.where(is_start, i, -1))
+    within = (i - seg_start).astype(jnp.uint64)
+    contrib = (b & jnp.uint64(0x7F)) << (jnp.uint64(7) *
+                                         jnp.minimum(within, jnp.uint64(9)))
+    u = jax.ops.segment_sum(contrib, vid, num_segments=cap)
+    return ((u >> jnp.uint64(1)) ^
+            (jnp.uint64(0) - (u & jnp.uint64(1)))).astype(jnp.int64)
+
+
+# nanos trailing-zero expansion table: encoded low 3 bits z -> 10^(z+1)
+# multiplier (z=0 means no zeros were removed)
+_NANO_MULT = np.array([1, 100, 1000, 10_000, 100_000, 1_000_000,
+                       10_000_000, 100_000_000], np.int64)
+
+
+@__import__("jax").jit
+def _orc_timestamp_micros(secs, nanos_enc):
+    """ORC timestamp streams -> Spark micros since the unix epoch.
+    secs counts from 2015-01-01; nanos carry their trailing-zero count in
+    the low 3 bits (TimestampTreeReader.parseNanos). The sum is plain
+    SIGNED addition: the C++ writer emits truncated seconds with a
+    negative nanos remainder for pre-1970 values, the Java writer floored
+    seconds with positive nanos — both reconstruct exactly this way
+    (verified against pyarrow's reader on boundary values)."""
+    import jax.numpy as jnp
+    nanos = (nanos_enc >> 3) * jnp.asarray(_NANO_MULT)[nanos_enc & 7]
+    return (secs + _ORC_TS_BASE) * 1_000_000 + nanos // 1000
+
+
 # ----------------------------------------------------------------------------
 # Stripe decode
 # ----------------------------------------------------------------------------
@@ -702,14 +816,27 @@ class _ColStreams:
     streams: Dict[int, bytes] = field(default_factory=dict)
 
 
+def _stripe_writer_tz(info: OrcFileInfo, f, st: _Stripe) -> str:
+    """Read ONLY a stripe's footer and return its writerTimezone."""
+    f.seek(st.offset + st.index_len + st.data_len)
+    sf_raw = _deframe(f.read(st.footer_len), info.compression,
+                      info.block_size)
+    for fno, _, v in _pb_fields(sf_raw):
+        if fno == 3:
+            return v.decode("utf-8", "replace")
+    return ""
+
+
 def _read_stripe_streams(info: OrcFileInfo, f, st: _Stripe,
-                         want_cols) -> Dict[int, _ColStreams]:
-    """Read + deframe the stripe footer and the wanted columns' streams."""
+                         want_cols):
+    """Read + deframe the stripe footer and the wanted columns' streams.
+    Returns ({col id: _ColStreams}, writer timezone string)."""
     f.seek(st.offset + st.index_len + st.data_len)
     sf_raw = _deframe(f.read(st.footer_len), info.compression,
                       info.block_size)
     streams: List[Tuple[int, int, int]] = []  # (kind, col, length)
     encodings: List[Tuple[int, int]] = []
+    writer_tz = ""
     for fno, _, v in _pb_fields(sf_raw):
         if fno == 1:
             s = {1: 0, 2: 0, 3: 0}
@@ -721,6 +848,8 @@ def _read_stripe_streams(info: OrcFileInfo, f, st: _Stripe,
             for f2, _, v2 in _pb_fields(v):
                 e[f2] = v2
             encodings.append((e[1], e[2]))
+        elif fno == 3:
+            writer_tz = v.decode("utf-8", "replace")
     cols: Dict[int, _ColStreams] = {}
     for cid in want_cols:
         cs = _ColStreams()
@@ -730,13 +859,13 @@ def _read_stripe_streams(info: OrcFileInfo, f, st: _Stripe,
     pos = st.offset
     for kind, col, length in streams:
         if col in cols and kind in (_S_PRESENT, _S_DATA, _S_LENGTH,
-                                    _S_DICT_DATA) \
+                                    _S_DICT_DATA, _S_SECONDARY) \
                 and pos >= st.offset + st.index_len:
             f.seek(pos)
             cols[col].streams[kind] = _deframe(
                 f.read(length), info.compression, info.block_size)
         pos += length
-    return cols
+    return cols, writer_tz
 
 
 def _defined_and_count(cs: _ColStreams, nrows: int, cap: int):
@@ -800,12 +929,17 @@ def _require_data(cs: _ColStreams) -> bytes:
     return raw
 
 
-def decode_stripe(info: OrcFileInfo, f, si: int, schema):
+def decode_stripe(info: OrcFileInfo, f, si: int, schema, host_cols=None):
     """Decode ONE stripe on the TPU -> (device ColumnarBatch, row count).
-    Encoding surprises the footer can't reveal (RLEv1 integer runs,
-    missing streams) raise DeviceDecodeUnsupported so the caller falls
-    just THIS stripe back to the host reader — per-stripe granularity,
-    the parquet path's per-row-group discipline."""
+    `host_cols` names columns the support check routed to the host: they
+    decode via ONE pyarrow read_stripe and merge into the batch at
+    assembly — an unsupported column costs itself, not the stripe
+    (reference decodes the full type matrix per column,
+    `GpuOrcScan.scala:826`). Encoding surprises the footer can't reveal
+    (RLEv1 integer runs, missing streams, non-UTC writer timezones) raise
+    DeviceDecodeUnsupported so the caller falls just THIS stripe back to
+    the host reader — per-stripe granularity, the parquet path's
+    per-row-group discipline."""
     import jax.numpy as jnp
     from ..columnar.batch import ColumnarBatch
     from ..columnar.padding import width_bucket
@@ -814,15 +948,43 @@ def decode_stripe(info: OrcFileInfo, f, si: int, schema):
     st = info.stripes[si]
     nrows = st.num_rows
     cap = row_bucket(nrows)
-    want = {info.col_ids[name] for name in schema.names}
-    cols_streams = _read_stripe_streams(info, f, st, want)
+    host_cols = set(host_cols or ())
+    host_decoded = _host_decode_stripe_cols(info, si, schema, host_cols,
+                                            cap, nrows)
+    want = {info.col_ids[name] for name in schema.names
+            if name not in host_cols}
+    cols_streams, writer_tz = _read_stripe_streams(info, f, st, want)
     out_cols = []
     for name, dt in zip(schema.names, schema.types):
+        if name in host_decoded:
+            out_cols.append(host_decoded[name])
+            continue
         cid = info.col_ids[name]
         kind = info.col_kinds[cid]
         cs = cols_streams[cid]
         defined, ndef = _defined_and_count(cs, nrows, cap)
-        if kind in (_K_SHORT, _K_INT, _K_LONG, _K_DATE):
+        if kind in (_K_TIMESTAMP, _K_TIMESTAMP_INSTANT):
+            if kind == _K_TIMESTAMP and writer_tz not in _UTC_TZ:
+                # local-time semantics in a non-UTC zone need tz-rule
+                # arithmetic; the host reader owns that
+                raise DeviceDecodeUnsupported(
+                    f"writer timezone {writer_tz}")
+            if cs.encoding != _E_DIRECT_V2:
+                raise DeviceDecodeUnsupported(
+                    f"timestamp encoding {cs.encoding}")
+            secondary = cs.streams.get(_S_SECONDARY)
+            if secondary is None:
+                raise DeviceDecodeUnsupported("missing SECONDARY stream")
+            secs = _rlev2_device_from_buf(_require_data(cs), ndef,
+                                          signed=True)
+            nanos_enc = _rlev2_device_from_buf(secondary, ndef,
+                                               signed=False)
+            vals = _orc_timestamp_micros(secs, nanos_enc)
+            out_cols.append(_fixed_column(vals, dt, defined, cap,
+                                          dt.np_dtype))
+        elif kind == _K_DECIMAL:
+            out_cols.append(_decimal_column(cs, dt, defined, ndef, cap))
+        elif kind in (_K_SHORT, _K_INT, _K_LONG, _K_DATE):
             vals = _int_values_device(cs, ndef, signed=True)
             out_cols.append(_fixed_column(vals, dt, defined, cap,
                                           dt.np_dtype))
@@ -863,6 +1025,65 @@ def decode_stripe(info: OrcFileInfo, f, si: int, schema):
             raise DeviceDecodeUnsupported(f"ORC kind {kind}")
     return ColumnarBatch(schema, tuple(out_cols),
                          jnp.asarray(nrows, jnp.int32)), nrows
+
+
+def _decimal_column(cs: _ColStreams, dt, defined, ndef: int, cap: int):
+    """DECIMAL column (precision <= 18): the zigzag-varint mantissa
+    stream expands per value with the device segment-sum kernel; the
+    SECONDARY per-value scale stream must equal the declared scale
+    (writers emit a constant run) or the stripe host-falls-back rather
+    than rescale."""
+    import jax.numpy as jnp
+    raw = _require_data(cs)
+    if cs.encoding != _E_DIRECT_V2:
+        # DIRECT (Hive 0.11-era) pairs the mantissas with an RLEv1 scale
+        # stream this parser would misread — like the integer path, only
+        # the v2 encoding decodes here
+        raise DeviceDecodeUnsupported(f"decimal encoding {cs.encoding}")
+    scale_raw = cs.streams.get(_S_SECONDARY)
+    if scale_raw is None:
+        raise DeviceDecodeUnsupported("missing decimal scale stream")
+    scales = _expand_runs_host(_rlev2_runs(scale_raw, ndef, True),
+                               ndef, True)
+    if ndef and not (scales == dt.scale).all():
+        raise DeviceDecodeUnsupported("per-value decimal rescale")
+    stream = np.frombuffer(raw, np.uint8)
+    if int(np.count_nonzero(stream < 128)) < ndef:
+        raise DeviceDecodeUnsupported("short decimal mantissa stream")
+    # a <=18-digit mantissa zigzags into <=63 bits -> <=9 varint bytes
+    if ndef:
+        widths = np.diff(np.concatenate(
+            ([-1], np.nonzero(stream < 128)[0][:ndef])))
+        if int(widths.max()) > 9:
+            raise DeviceDecodeUnsupported("mantissa varint wider than 64")
+    vals = _varint_zigzag_device(jnp.asarray(stream), cap)[:max(ndef, 1)]
+    return _fixed_column(vals, dt, defined, cap, dt.np_dtype)
+
+
+def _host_decode_stripe_cols(info: OrcFileInfo, si: int, schema,
+                             host_cols, cap: int, nrows: int):
+    """Host (pyarrow) decode of the fallback columns of one stripe ->
+    {name: device Column} at the shared capacity bucket. Timestamps
+    normalize to us/UTC exactly as the whole-file host path does."""
+    names = [n for n in schema.names if n in host_cols]
+    if not names:
+        return {}
+    import pyarrow as pa
+    from pyarrow import orc as pa_orc
+    # one pyarrow ORCFile per FILE (footer parse is not free), cached on
+    # the info object the whole scan already threads through
+    pf = getattr(info, "_pa_file", None)
+    if pf is None:
+        pf = pa_orc.ORCFile(info.path)
+        info._pa_file = pf
+    try:
+        rb = pf.read_stripe(si, columns=names)
+    except (OSError, pa.ArrowInvalid) as e:
+        raise DeviceDecodeUnsupported(f"host column decode: {e}") from e
+    t = pa.Table.from_batches([rb])
+    if t.num_rows != nrows:
+        raise DeviceDecodeUnsupported("host column row-count mismatch")
+    return _host_cols_to_device(t, schema, names, cap)
 
 
 def _assemble_strings_orc(cs: _ColStreams, dt, defined, ndef: int,

@@ -169,6 +169,11 @@ class TpuFileScanExec(_TpuExec):
         self.dynamic_filters: list = []
         from ..utils import metrics as M
         self.files_pruned = self.metrics.create("filesPruned", M.MODERATE)
+        # per-column host fallbacks chosen by the footer sweep (one count
+        # per file x column) — makes silent device-path disengagement
+        # visible in explain/metrics
+        self.cols_host_decoded = self.metrics.create("colsHostDecoded",
+                                                     M.MODERATE)
 
     @property
     def output(self) -> Schema:
@@ -268,29 +273,36 @@ class TpuFileScanExec(_TpuExec):
             yield batch_from_arrow(t), t.num_rows
 
     def _orc_batches(self):
-        """Device decode per STRIPE with per-stripe host fallback —
-        the parquet path's per-row-group discipline applied to ORC's
-        stripe unit. Footer-gated per file; a stripe-level surprise
-        (RLEv1 runs, missing streams, over-wide strings) falls just THAT
-        stripe back to pyarrow's read_stripe."""
+        """Device decode per STRIPE with per-COLUMN and per-stripe host
+        fallback — the parquet path's discipline applied to ORC's stripe
+        unit. The footer decides per column (an exotic column host-decodes
+        and merges while its siblings ride the device path); a stripe-level
+        surprise (RLEv1 runs, missing streams, over-wide strings, non-UTC
+        writer timezones) falls just THAT stripe back to pyarrow's
+        read_stripe."""
         from ..columnar.batch import batch_from_arrow
-        from .orc_device import (DeviceDecodeUnsupported, decode_stripe,
-                                 file_supported)
+        from .orc_device import (DeviceDecodeUnsupported, columns_supported,
+                                 decode_stripe)
         scan = self.cpu_scan
         for path in scan.paths:
             try:
-                info = file_supported(path, scan.output)
+                info, bad = columns_supported(path, scan.output)
+                if len(bad) >= len(scan.output.names):
+                    raise DeviceDecodeUnsupported("no device column")
             except (DeviceDecodeUnsupported, OSError, struct_error):
                 for b, nrows in self._host_file_batches(path):
                     self.num_output_rows.add(nrows)
                     yield self._count_output(b)
                 continue
+            if bad:
+                self.cols_host_decoded.add(len(bad))
             from pyarrow import orc as pa_orc
             ofile = None
             with open(path, "rb") as f:
                 for si in range(len(info.stripes)):
                     try:
-                        b, nrows = decode_stripe(info, f, si, scan.output)
+                        b, nrows = decode_stripe(info, f, si, scan.output,
+                                                 host_cols=bad)
                     except (DeviceDecodeUnsupported, OSError,
                             struct_error):
                         if ofile is None:
@@ -303,14 +315,19 @@ class TpuFileScanExec(_TpuExec):
                     yield self._count_output(b)
 
     def _parquet_batches(self):
-        """Device decode per ROW GROUP with per-row-group host fallback.
+        """Device decode per ROW GROUP with per-COLUMN and per-row-group
+        host fallback.
 
         The footer gates each file cheaply up front (its ParquetFile is
-        reused by the decode). Supported files stream one row group at a
-        time — one device batch live at once — and a page-level surprise the
-        footer can't reveal (e.g. v2 pages) falls just THAT row group back
-        to pyarrow (pf.read_row_group), so nothing is ever decoded twice or
-        yielded twice. If NO file passes the footer check, the whole scan
+        reused by the decode) and decides PER COLUMN: an unsupported column
+        (exotic physical type, nested, unknown codec) host-decodes via one
+        pyarrow read and merges into the device batch, while its siblings
+        still decode on device — one odd column no longer evicts the file.
+        Supported files stream one row group at a time — one device batch
+        live at once — and a page-level surprise the footer can't reveal
+        (e.g. v2 pages) falls just THAT row group back to pyarrow
+        (pf.read_row_group), so nothing is ever decoded twice or yielded
+        twice. If NO file has any device-decodable column, the whole scan
         delegates to the plain host path, preserving the COALESCING /
         MULTITHREADED multi-file strategies. The fallback net is narrow by
         design: only DeviceDecodeUnsupported (incl. malformed page streams,
@@ -318,27 +335,37 @@ class TpuFileScanExec(_TpuExec):
         the decoder must crash, not silently degrade to the host path."""
         from ..columnar.batch import batch_from_arrow
         from .parquet_device import (DeviceDecodeUnsupported,
-                                     decode_row_group, file_supported)
+                                     columns_supported, decode_row_group)
         scan = self.cpu_scan
 
         import pyarrow.parquet as pq
         scan_names = list(scan.output.names)
 
-        def check(path) -> bool:
-            """Footer support sweep, run ONCE per file; only a flag is kept,
-            so no fd outlives its file (a scan over more files than
-            ulimit -n must not exhaust descriptors)."""
+        def check(path):
+            """Footer support sweep, run ONCE per file; only the fallback
+            column-name set is kept, so no fd outlives its file (a scan
+            over more files than ulimit -n must not exhaust descriptors).
+            Returns the host-column set, or None when nothing in the file
+            can device-decode (whole-file host path)."""
             try:
-                pf = file_supported(path, scan.output)
+                pf, bad = columns_supported(path, scan.output)
             except (DeviceDecodeUnsupported, OSError, struct_error):
-                return False
+                return None
             close = getattr(pf, "close", None)
             if close is not None:
                 close()
-            return True
+            if len(bad) >= len(scan.output.names):
+                return None
+            return frozenset(bad)
 
         paths = self._effective_paths()
-        supported = {p for p in paths if check(p)}
+        supported = {}
+        for p in paths:
+            host_cols = check(p)
+            if host_cols is not None:
+                supported[p] = host_cols
+                if host_cols:
+                    self.cols_host_decoded.add(len(host_cols))
         if not supported:
             # nothing is device-decodable: the plain host path keeps the
             # COALESCING / MULTITHREADED multi-file strategies
@@ -369,8 +396,9 @@ class TpuFileScanExec(_TpuExec):
                         if keep_rgs is not None and rg not in keep_rgs:
                             continue  # stats prove no build key in range
                         try:
-                            b, nrows = decode_row_group(pf, f, rg,
-                                                        scan.output)
+                            b, nrows = decode_row_group(
+                                pf, f, rg, scan.output,
+                                host_cols=supported[path])
                         except (DeviceDecodeUnsupported, OSError,
                                 struct_error):
                             t = scan._postprocess(pf.read_row_group(

@@ -147,22 +147,64 @@ class TestDeviceOrcDecode:
         with pytest.raises(DeviceDecodeUnsupported):
             _rlev2_runs(bytes([0xC4, 0x00, 0x02, 0x02, 0xFF]), 1, True)
 
-    def test_timestamp_falls_back_cleanly(self, session, rng, tmp_path):
-        """Timestamps use a SECONDARY stream — not device-decoded yet;
-        the scan must still answer correctly via the host path."""
-        n = 1000
+    def test_timestamps_take_device_path(self, session, rng, tmp_path):
+        """INVERTED (was a fallback test): DATA seconds + SECONDARY nanos
+        streams now decode on device, including pre-1970 values where the
+        C++ writer stores negative nanos remainders."""
+        n = 3000
+        micros = np.concatenate([
+            rng.integers(-4 * 10**15, 4 * 10**15, n - 4),
+            np.array([0, -1, -999_995, 1_420_070_399_999_999])])
         t = pa.table({
-            "ts": pa.array(rng.integers(0, 2**40, n),
-                           pa.timestamp("us", tz="UTC")),
+            "ts": pa.array(micros, pa.timestamp("us", tz="UTC")),
             "v": pa.array(rng.normal(size=n))})
         path = write_orc(tmp_path, t)
         f = orc.ORCFile(path)
-        with pytest.raises(DeviceDecodeUnsupported):
-            file_supported(path, Schema.from_arrow(f.schema))
+        schema = Schema.from_arrow(pa.schema(
+            [("ts", pa.timestamp("us", tz="UTC")), ("v", pa.float64())]))
+        file_supported(path, schema)  # no raise: fully device-decodable
+        expected = orc.read_table(path).cast(pa.schema(
+            [("ts", pa.timestamp("us", tz="UTC")), ("v", pa.float64())]))
+        assert_device_matches(path, expected, columns=["ts", "v"])
+
+    def test_decimal64_takes_device_path(self, rng, tmp_path):
+        """decimal(p<=18): zigzag-varint mantissas decode on device via
+        the segment-sum kernel; values diff against pyarrow exactly."""
+        import decimal
+        n = 4000
+        mask = rng.random(n) < 0.15
+        vals = [None if mask[i] else
+                decimal.Decimal(int(rng.integers(-10**14, 10**14)))
+                .scaleb(-2) for i in range(n)]
+        t = pa.table({"d": pa.array(vals, type=pa.decimal128(16, 2)),
+                      "k": pa.array(np.arange(n, dtype=np.int64))})
+        path = write_orc(tmp_path, t)
+        expected = orc.read_table(path)
+        assert_device_matches(path, expected)
+
+    def test_decimal128_column_falls_back_siblings_on_device(
+            self, session, rng, tmp_path):
+        """Per-column fallback: a decimal(30,8) column host-decodes while
+        its siblings still ride the device path, and the merged batch
+        matches pyarrow."""
+        import decimal
+        from spark_rapids_tpu.io.orc_device import columns_supported
+        n = 2000
+        wide = [decimal.Decimal(int(rng.integers(-10**18, 10**18)))
+                .scaleb(-8) * 10**9 for i in range(n)]
+        t = pa.table({
+            "wide": pa.array(wide, type=pa.decimal128(30, 8)),
+            "l": pa.array(rng.integers(-10**12, 10**12, n)),
+            "s": pa.array([f"r{i % 37}" for i in range(n)])})
+        path = write_orc(tmp_path, t)
+        schema = Schema.from_arrow(orc.ORCFile(path).schema)
+        info, bad = columns_supported(path, schema)
+        assert set(bad) == {"wide"}
         got = session.read_orc(path).collect()
-        assert got.num_rows == n
-        assert got.column("ts").to_pylist() == \
-            orc.read_table(path).column("ts").to_pylist()
+        exact = orc.read_table(path)
+        for c in t.schema.names:
+            assert got.column(c).to_pylist() == \
+                exact.column(c).to_pylist(), c
 
     def test_query_over_device_decoded_scan(self, session, rng, tmp_path):
         """End to end: the planner's ORC scan feeds the device engine and

@@ -256,3 +256,157 @@ class TestDeviceParquetDecode:
         cpu = q.collect_cpu().sort_by([("b", "ascending")])
         assert tpu.column("c").to_pylist() == cpu.column("c").to_pylist()
         assert tpu.column("s").to_pylist() == cpu.column("s").to_pylist()
+
+
+def tpcds_like_table(rng, n=6000, nulls=True):
+    """TPC-DS fact-table shape: decimal(7,2) money columns, surrogate-key
+    longs, a date and a timestamp — the columns round-4's verdict said
+    were evicting whole files from the device path."""
+    import datetime
+    import decimal
+
+    def mk(vals, typ=None):
+        mask = rng.random(n) < 0.1 if nulls else np.zeros(n, bool)
+        if typ is not None and pa.types.is_decimal(typ):
+            py = [None if mask[i] else
+                  decimal.Decimal(int(vals[i])).scaleb(-typ.scale)
+                  for i in range(n)]
+            return pa.array(py, type=typ)
+        return pa.array(vals, mask=mask, type=typ)
+
+    epoch = datetime.date(1970, 1, 1)
+    return pa.table({
+        "ss_item_sk": pa.array(rng.integers(1, 200_000, n)),
+        "ss_quantity": mk(rng.integers(1, 100, n).astype(np.int32)),
+        "ss_sales_price": mk(rng.integers(0, 10**6, n),
+                             pa.decimal128(7, 2)),
+        "ss_ext_sales_price": mk(rng.integers(0, 10**8, n),
+                                 pa.decimal128(9, 2)),
+        "ss_net_paid_wide": mk(rng.integers(-10**18, 10**18, n),
+                               pa.decimal128(30, 8)),
+        "ss_sold_date": mk(np.array(
+            [epoch + datetime.timedelta(days=int(x))
+             for x in rng.integers(10_000, 12_000, n)]),
+            pa.date32()),
+        "ss_sold_ts": mk(rng.integers(-4 * 10**15, 4 * 10**15, n),
+                         pa.timestamp("us")),
+    })
+
+
+class TestDecimalTimestampDeviceDecode:
+    """Round-5 verdict item 1: decimal + date/timestamp device decode with
+    PER-COLUMN fallback. The INVERTED tests assert TPC-DS-shaped columns
+    now take the device path (decimal(7,2) FLBA, decimal(30,8) limb pairs,
+    INT64 timestamps both units, INT96); golden oracle is pyarrow."""
+
+    def _expected(self, path):
+        from spark_rapids_tpu.io.scanbase import normalize_timestamps
+        return normalize_timestamps(pq.read_table(path))
+
+    def _assert_scan_matches(self, session, path):
+        got = session.read_parquet(path).collect()
+        exp = self._expected(path)
+        for name in exp.schema.names:
+            assert got.column(name).to_pylist() == \
+                exp.column(name).to_pylist(), name
+
+    def test_tpcds_shaped_file_fully_device_decoded(self, session, rng,
+                                                    tmp_path):
+        from spark_rapids_tpu.io.parquet_device import columns_supported
+        t = tpcds_like_table(rng)
+        path = str(tmp_path / "fact.parquet")
+        pq.write_table(t, path, version="2.6")
+        df = session.read_parquet(path)
+        pf, bad = columns_supported(path, df.plan.output)
+        assert bad == {}, bad  # INVERTED: nothing host-decodes
+        self._assert_scan_matches(session, path)
+
+    @pytest.mark.parametrize("use_dict", [True, False])
+    def test_flba_decimals_plain_and_dict(self, session, rng, tmp_path,
+                                          use_dict):
+        import decimal
+        n = 4000
+        small = rng.integers(-10**6, 10**6, n)
+        if use_dict:  # low cardinality so the dictionary engages
+            small = rng.integers(0, 50, n) * 7 - 100
+        vals = [decimal.Decimal(int(x)).scaleb(-2) for x in small]
+        t = pa.table({"d": pa.array(vals, type=pa.decimal128(7, 2)),
+                      "w": pa.array(
+                          [decimal.Decimal(int(x)).scaleb(-8) * 10**9
+                           for x in small], type=pa.decimal128(30, 8))})
+        path = str(tmp_path / "d.parquet")
+        pq.write_table(t, path, use_dictionary=use_dict)
+        used, _ = _used_device_decode(session, path)
+        assert used
+        self._assert_scan_matches(session, path)
+
+    def test_timestamp_millis_unit(self, session, rng, tmp_path):
+        n = 2000
+        t = pa.table({"ts": pa.array(rng.integers(-4 * 10**12,
+                                                  4 * 10**12, n),
+                                     pa.timestamp("ms"))})
+        path = str(tmp_path / "ms.parquet")
+        pq.write_table(t, path, version="2.4")
+        used, _ = _used_device_decode(session, path)
+        assert used
+        self._assert_scan_matches(session, path)
+
+    def test_int96_timestamps(self, session, rng, tmp_path):
+        n = 2000
+        micros = np.concatenate([
+            rng.integers(-4 * 10**15, 4 * 10**15, n - 2),
+            np.array([0, -1])])
+        t = pa.table({"ts": pa.array(micros, pa.timestamp("us")),
+                      "v": pa.array(rng.normal(size=n))})
+        path = str(tmp_path / "i96.parquet")
+        pq.write_table(t, path, use_deprecated_int96_timestamps=True)
+        used, _ = _used_device_decode(session, path)
+        assert used
+        self._assert_scan_matches(session, path)
+
+    def test_nanos_column_falls_back_siblings_on_device(
+            self, session, rng, tmp_path):
+        """PER-COLUMN fallback: a TIMESTAMP(NANOS) column host-decodes
+        (Spark rejects NANOS outright) while its siblings still ride the
+        device path; the merged batch matches pyarrow."""
+        from spark_rapids_tpu.io.parquet_device import columns_supported
+        n = 1500
+        t = pa.table({
+            "ns": pa.array(rng.integers(0, 10**15, n) * 1000,
+                           pa.timestamp("ns")),
+            "l": pa.array(rng.integers(-10**12, 10**12, n)),
+            "s": pa.array([f"r{i % 53}" for i in range(n)])})
+        path = str(tmp_path / "ns.parquet")
+        pq.write_table(t, path, version="2.6")
+        df = session.read_parquet(path)
+        pf, bad = columns_supported(path, df.plan.output)
+        assert set(bad) == {"ns"}
+        self._assert_scan_matches(session, path)
+
+    def test_file_decimal_scale_mismatch_falls_back(self, session, rng,
+                                                    tmp_path):
+        """A file whose decimal scale differs from the read schema must
+        NOT silently decode with the wrong scale — that column host-falls
+        back (where pyarrow casts), siblings stay on device."""
+        import decimal
+        from spark_rapids_tpu import types as T
+        from spark_rapids_tpu.columnar.batch import Schema
+        from spark_rapids_tpu.io.parquet_device import columns_supported
+        t = pa.table({"d": pa.array([decimal.Decimal("1.50")],
+                                    type=pa.decimal128(7, 2)),
+                      "l": pa.array([3], type=pa.int64())})
+        path = str(tmp_path / "mm.parquet")
+        pq.write_table(t, path)
+        schema = Schema(("d", "l"), (T.DecimalType(7, 3), T.LongType()))
+        pf, bad = columns_supported(path, schema)
+        assert set(bad) == {"d"}
+        # the merged batch must carry the SCAN schema's scale: 1.50 read
+        # at decimal(7,3) is still 1.50 (unscaled 1500), not 0.150
+        from spark_rapids_tpu.columnar.batch import batch_to_arrow
+        from spark_rapids_tpu.io.parquet_device import decode_row_group
+        with open(path, "rb") as f:
+            b, _ = decode_row_group(pf, f, 0, schema, host_cols=bad)
+        assert b.columns[0].dtype == T.DecimalType(7, 3)
+        back = batch_to_arrow(b)
+        assert back.column("d").to_pylist() == [decimal.Decimal("1.500")]
+        assert back.column("l").to_pylist() == [3]
