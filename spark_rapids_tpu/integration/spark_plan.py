@@ -155,13 +155,239 @@ def _literal_value(node: _Node):
     if isinstance(dt, T.DecimalType):
         import decimal
         return decimal.Decimal(str(v)), dt
+    if isinstance(dt, (T.DateType, T.TimestampType)):
+        # Catalyst serializes the INTERNAL value (days / micros since
+        # epoch); accept an ISO string too for hand-written fixtures
+        s = str(v)
+        try:
+            return int(s), dt
+        except ValueError:
+            pass
+        import datetime
+        if isinstance(dt, T.DateType):
+            return (datetime.date.fromisoformat(s) -
+                    datetime.date(1970, 1, 1)).days, dt
+        d = datetime.datetime.fromisoformat(s)
+        if d.tzinfo is None:
+            d = d.replace(tzinfo=datetime.timezone.utc)
+        return int(d.timestamp() * 1_000_000), dt
     return str(v), dt
+
+
+# -- generic expression registry ---------------------------------------------
+# The engine's expression classes deliberately carry Catalyst's names with
+# children in Catalyst's order, so MOST of the surface translates
+# generically: EngineClass(*translated_children). The registry below maps
+# name -> class from the expr modules; classes whose constructors take
+# literal python parameters (fmt: str, scale: int, ...) are handled by the
+# _SPECIAL builders and EXCLUDED from the generic path (a signature sweep
+# refuses anything with a non-Expression parameter rather than construct
+# garbage). Reference surface: GpuOverrides.scala:866-3475.
+
+_EXPR_MODULES = (
+    "arithmetic", "bitwise", "collections", "collections_ext",
+    "conditional", "datetime_", "hashing", "hashing_ext", "json_",
+    "maps", "math_", "misc", "nullexprs", "predicates", "regex",
+    "splits", "strings", "strings_ext", "strings_more",
+)
+
+# Catalyst physical class name -> engine class name where they differ
+# (None = explicitly unsupported); classes also in _SPECIAL don't belong
+# here — the special builders are consulted first
+_CATALYST_ALIASES = {
+    "EulerNumber": "Euler",
+    "Rand": None,  # non-deterministic: explicitly unsupported
+}
+
+# Catalyst wrapper nodes that are semantic no-ops for this engine: the
+# decimal type arithmetic promotes exactly (256-bit limbs), floats are
+# already IEEE-normalized on device
+_PASSTHROUGH = {"PromotePrecision", "KnownNotNull", "KnownNonNullable",
+                "NormalizeNaNAndZero", "KnownFloatingPointNormalized"}
+
+
+def _engine_expr_classes() -> Dict[str, type]:
+    global _EXPR_REGISTRY
+    if _EXPR_REGISTRY is not None:
+        return _EXPR_REGISTRY
+    import importlib
+    from ..expr.base import Expression
+    reg: Dict[str, type] = {}
+    for m in _EXPR_MODULES:
+        mod = importlib.import_module(f"spark_rapids_tpu.expr.{m}")
+        for nm in dir(mod):
+            obj = getattr(mod, nm)
+            if isinstance(obj, type) and issubclass(obj, Expression) \
+                    and obj.__module__ == mod.__name__:
+                reg.setdefault(nm, obj)
+    _EXPR_REGISTRY = reg
+    return reg
+
+
+_EXPR_REGISTRY: Optional[Dict[str, type]] = None
+_GENERIC_OK_CACHE: Dict[str, bool] = {}
+
+
+def _generic_applicable(name: str, cls: type) -> bool:
+    """True when every constructor parameter is Expression-shaped (safe to
+    feed translated children positionally)."""
+    ok = _GENERIC_OK_CACHE.get(name)
+    if ok is not None:
+        return ok
+    import inspect
+    try:
+        sig = inspect.signature(cls.__init__)
+    except (TypeError, ValueError):
+        _GENERIC_OK_CACHE[name] = False
+        return False
+    ok = True
+    for p in list(sig.parameters.values())[1:]:  # skip self
+        ann = str(p.annotation)
+        if p.kind == p.VAR_POSITIONAL:
+            continue
+        if p.annotation is inspect.Parameter.empty or "Expression" in ann:
+            continue
+        ok = False
+        break
+    _GENERIC_OK_CACHE[name] = ok
+    return ok
+
+
+def _lit(node: _Node):
+    """Require a Literal child and return its python value."""
+    if node.cls != "Literal":
+        raise UnsupportedSparkPlan(f"non-literal argument {node.cls}")
+    v, _ = _literal_value(node)
+    return v
+
+
+def _tx(node: _Node):
+    return _translate_expr(node)
+
+
+def _in_set(node: _Node):
+    """InSet serializes the value set in the `hset` field as raw values
+    typed by the child expression."""
+    from ..expr import predicates as EP
+    value = _tx(node.children[0])
+    dt = _data_type(node.children[0].fields.get("dataType")) \
+        if node.children[0].fields.get("dataType") else None
+    hs = node.fields.get("hset")
+    if not isinstance(hs, list):
+        raise UnsupportedSparkPlan("InSet without hset")
+    items = []
+    for v in hs:  # In takes raw python values, typed by the child
+        if v is None or dt is None:
+            items.append(v)
+        elif isinstance(dt, (T.ByteType, T.ShortType, T.IntegerType,
+                             T.LongType, T.DateType, T.TimestampType)):
+            items.append(int(v))  # date/timestamp hsets hold internal ints
+        elif isinstance(dt, (T.FloatType, T.DoubleType)):
+            items.append(float(v))
+        elif isinstance(dt, T.BooleanType):
+            items.append(v if isinstance(v, bool)
+                         else str(v).lower() == "true")
+        elif isinstance(dt, T.DecimalType):
+            import decimal
+            items.append(decimal.Decimal(str(v)))
+        elif isinstance(dt, T.StringType):
+            items.append(str(v))
+        else:
+            raise UnsupportedSparkPlan(f"InSet over {dt}")
+    return EP.In(value, items)
+
+
+def _case_when(kids: List[_Node]):
+    """CaseWhen children: (cond, value)* + optional else."""
+    from ..expr.conditional import CaseWhen
+    pairs = [(_tx(kids[i]), _tx(kids[i + 1]))
+             for i in range(0, len(kids) - len(kids) % 2, 2)]
+    else_e = _tx(kids[-1]) if len(kids) % 2 else None
+    return CaseWhen(pairs, else_e)
+
+
+def _named_struct(kids: List[_Node]):
+    from ..expr.collections import CreateNamedStruct
+    names = [str(_lit(kids[i])) for i in range(0, len(kids), 2)]
+    values = [_tx(kids[i]) for i in range(1, len(kids), 2)]
+    return CreateNamedStruct(names, values)
+
+
+def _special_builders():
+    """Catalyst class name -> builder(children, fields). Covers the
+    classes whose engine constructors take literal python parameters (or
+    whose Catalyst serialization needs field access)."""
+    global _SPECIAL
+    if _SPECIAL is not None:
+        return _SPECIAL
+    from ..expr import predicates as EP
+    from ..expr import (collections as CO, datetime_ as DT, hashing as HA,
+                        hashing_ext as HX, maps as MP, math_ as MA,
+                        regex as RX, splits as SP)
+
+    _SPECIAL = {
+        # In's item list is raw python values in the engine
+        "In": lambda k, f: EP.In(_tx(k[0]), [_lit(x) for x in k[1:]]),
+        # InSet needs the whole node (hset field) — handled before the
+        # special lookup in _translate_expr
+        "CaseWhen": lambda k, f: _case_when(k),
+        "CreateNamedStruct": lambda k, f: _named_struct(k),
+        "CreateArray": lambda k, f: CO.CreateArray([_tx(x) for x in k]),
+        "CreateMap": lambda k, f: MP.CreateMap([_tx(x) for x in k]),
+        "GetStructField": lambda k, f: CO.GetStructField(
+            _tx(k[0]), ordinal=f.get("ordinal"), name=f.get("name")),
+        "Round": lambda k, f: MA.Round(_tx(k[0]), int(_lit(k[1]))),
+        "BRound": lambda k, f: MA.BRound(_tx(k[0]), int(_lit(k[1]))),
+        "Sha2": lambda k, f: HX.Sha2(_tx(k[0]), int(_lit(k[1]))),
+        "Like": lambda k, f: RX.Like(_tx(k[0]), _tx(k[1]),
+                                     str(f.get("escapeChar", "\\"))),
+        "RegExpExtract": lambda k, f: RX.RegExpExtract(
+            _tx(k[0]), _tx(k[1]), int(_lit(k[2])) if len(k) > 2 else 1),
+        "RegExpExtractAll": lambda k, f: RX.RegExpExtractAll(
+            _tx(k[0]), _tx(k[1]), int(_lit(k[2])) if len(k) > 2 else 1),
+        "StringSplit": lambda k, f: SP.StringSplit(
+            _tx(k[0]), str(_lit(k[1])),
+            int(_lit(k[2])) if len(k) > 2 else -1),
+        "StringToMap": lambda k, f: MP.StringToMap(
+            _tx(k[0]),
+            str(_lit(k[1])) if len(k) > 1 else ",",
+            str(_lit(k[2])) if len(k) > 2 else ":"),
+        "SortArray": lambda k, f: CO.SortArray(
+            _tx(k[0]), bool(_lit(k[1])) if len(k) > 1 else True),
+        "UnixTimestamp": lambda k, f: DT.UnixTimestamp(
+            _tx(k[0]), str(_lit(k[1])) if len(k) > 1
+            else "yyyy-MM-dd HH:mm:ss"),
+        "ToUnixTimestamp": lambda k, f: DT.ToUnixTimestamp(
+            _tx(k[0]), str(_lit(k[1])) if len(k) > 1
+            else "yyyy-MM-dd HH:mm:ss"),
+        "FromUnixTime": lambda k, f: DT.FromUnixTime(
+            _tx(k[0]), str(_lit(k[1])) if len(k) > 1
+            else "yyyy-MM-dd HH:mm:ss"),
+        "DateFormatClass": lambda k, f: DT.DateFormat(_tx(k[0]),
+                                                      str(_lit(k[1]))),
+        "TruncDate": lambda k, f: DT.TruncDate(_tx(k[0]),
+                                               str(_lit(k[1]))),
+        "TruncTimestamp": lambda k, f: DT.TruncTimestamp(
+            str(_lit(k[0])), _tx(k[1])),
+        "NextDay": lambda k, f: DT.NextDay(_tx(k[0]), str(_lit(k[1]))),
+        "MonthsBetween": lambda k, f: DT.MonthsBetween(
+            _tx(k[0]), _tx(k[1]),
+            bool(_lit(k[2])) if len(k) > 2 else True),
+        "Murmur3Hash": lambda k, f: HA.Murmur3Hash(
+            *[_tx(x) for x in k], seed=int(f.get("seed", 42))),
+        "HiveHash": lambda k, f: HA.HiveHash(*[_tx(x) for x in k]),
+        "XxHash64": lambda k, f: HX.XxHash64(
+            *[_tx(x) for x in k], seed=int(f.get("seed", 42))),
+    }
+    return _SPECIAL
+
+
+_SPECIAL: Optional[dict] = None
 
 
 def _translate_expr(node: _Node):
     from ..expr import base as EB
-    from ..expr import (arithmetic as EA, cast as EC, nullexprs as EN,
-                        predicates as EP)
+    from ..expr import cast as EC
     c = node.cls
     kids = node.children
     if c == "AttributeReference":
@@ -172,40 +398,82 @@ def _translate_expr(node: _Node):
         return EB.Literal(v, dt)
     if c == "Alias":
         return EB.Alias(_translate_expr(kids[0]), node.fields["name"])
-    if c == "Cast":
+    if c in ("Cast", "AnsiCast", "TryCast"):
         return EC.Cast(_translate_expr(kids[0]),
                        _data_type(node.fields["dataType"]))
-    binops = {"Add": EA.Add, "Subtract": EA.Subtract,
-              "Multiply": EA.Multiply, "Divide": EA.Divide,
-              "Remainder": EA.Remainder, "EqualTo": EP.EqualTo,
-              "LessThan": EP.LessThan, "LessThanOrEqual": EP.LessThanOrEqual,
-              "GreaterThan": EP.GreaterThan,
-              "GreaterThanOrEqual": EP.GreaterThanOrEqual,
-              "And": EP.And, "Or": EP.Or}
-    if c in binops:
-        return binops[c](_translate_expr(kids[0]), _translate_expr(kids[1]))
-    if c == "Not":
-        return EP.Not(_translate_expr(kids[0]))
-    if c == "IsNotNull":
-        return EN.IsNotNull(_translate_expr(kids[0]))
-    if c == "IsNull":
-        return EN.IsNull(_translate_expr(kids[0]))
+    if c == "CheckOverflow":
+        # round/overflow-check to the target decimal type — the engine's
+        # decimal cast has exactly those semantics
+        return EC.Cast(_translate_expr(kids[0]),
+                       _data_type(node.fields["dataType"]))
+    if c in _PASSTHROUGH and kids:
+        return _translate_expr(kids[0])
+    if c == "InSet":
+        return _in_set(node)
+    special = _special_builders().get(c)
+    if special is not None:
+        return special(kids, node.fields)
+    name = _CATALYST_ALIASES.get(c, c)
+    if name is None:
+        raise UnsupportedSparkPlan(f"expression {c}")
+    cls = _engine_expr_classes().get(name)
+    if cls is not None and _generic_applicable(name, cls):
+        try:
+            return cls(*[_translate_expr(k) for k in kids])
+        except (TypeError, ValueError) as e:
+            # constructors validate literal-ness/ranges with ValueError
+            # (e.g. Conv bases); both mean "this shape isn't supported",
+            # which must surface as fallback, not a crash
+            raise UnsupportedSparkPlan(f"expression {c}: {e}") from e
     raise UnsupportedSparkPlan(f"expression {c}")
+
+
+def translatable_expr_classes() -> set:
+    """Catalyst class names this adapter can translate (the coverage test
+    diffs this against the engine's override registry)."""
+    names = {"AttributeReference", "Literal", "Alias", "Cast", "AnsiCast",
+             "TryCast", "CheckOverflow", "InSet"}
+    names |= _PASSTHROUGH
+    names |= set(_special_builders())
+    for nm, cls in _engine_expr_classes().items():
+        if _generic_applicable(nm, cls):
+            names.add(nm)
+    names |= {c for c, tgt in _CATALYST_ALIASES.items() if tgt}
+    return names
 
 
 def _translate_agg_fn(node: _Node):
     """AggregateExpression(aggregateFunction=...) -> engine aggregate."""
     from ..expr import aggregates as AG
     if node.cls == "AggregateExpression":
+        if str(node.fields.get("isDistinct", False)).lower() == "true":
+            raise UnsupportedSparkPlan("DISTINCT aggregate")
+        if node.fields.get("filter"):
+            # dropping FILTER (WHERE ...) would silently aggregate
+            # unfiltered rows
+            raise UnsupportedSparkPlan("FILTER clause on aggregate")
         fn = _expr_tree(node.fields.get("aggregateFunction"))
         if fn is None and node.children:
             fn = node.children[0]
         return _translate_agg_fn(fn)
     fns = {"Sum": AG.Sum, "Min": AG.Min, "Max": AG.Max,
            "Average": AG.Average, "Count": AG.Count,
-           "First": AG.First, "Last": AG.Last}
+           "First": AG.First, "Last": AG.Last,
+           "StddevPop": AG.StddevPop, "StddevSamp": AG.StddevSamp,
+           "VariancePop": AG.VariancePop, "VarianceSamp": AG.VarianceSamp,
+           "Skewness": AG.Skewness, "Kurtosis": AG.Kurtosis,
+           "CollectList": AG.CollectList, "CollectSet": AG.CollectSet,
+           "BoolAnd": AG.BoolAnd, "BoolOr": AG.BoolOr,
+           "BitAndAgg": AG.BitAndAgg, "BitOrAgg": AG.BitOrAgg,
+           "BitXorAgg": AG.BitXorAgg, "CountIf": AG.CountIf}
     if node.cls in fns:
         return fns[node.cls](_translate_expr(node.children[0]))
+    if node.cls == "ApproximatePercentile":
+        pct = _lit(node.children[1])
+        acc = int(_lit(node.children[2])) if len(node.children) > 2 \
+            else 10000
+        return AG.ApproximatePercentile(_translate_expr(node.children[0]),
+                                        pct, acc)
     raise UnsupportedSparkPlan(f"aggregate {node.cls}")
 
 
