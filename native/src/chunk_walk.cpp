@@ -1,0 +1,595 @@
+// Parquet column-chunk page walk, host side.
+//
+// Role: one native call replaces the per-page python loop in
+// io/parquet_device._decode_chunk for the common layout (v1 pages,
+// snappy/uncompressed, RLE def levels): thrift page-header parse, snappy
+// block decode (from scratch — the format is a public LZ77 variant, like
+// the lz4block.cpp codec), def-level and dictionary-index RLE run scans,
+// and PLAIN payload concatenation all happen in C++ with the GIL
+// released. Run bit-offsets are rebased to ONE global packed blob per
+// chunk so consecutive same-bit-width pages form contiguous run-table
+// slices (no python-side merge copies). Anything outside the fast shape
+// returns an error code and the python walk handles it (the fallback and
+// the semantic spec).
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+// ---------------------------------------------------------------- growable
+struct Buf {
+  uint8_t* p = nullptr;
+  int64_t len = 0, cap = 0;
+  bool reserve(int64_t need) {
+    if (len + need <= cap) return true;
+    int64_t ncap = cap ? cap * 2 : 4096;
+    while (ncap < len + need) ncap *= 2;
+    uint8_t* np_ = static_cast<uint8_t*>(std::realloc(p, ncap));
+    if (!np_) return false;
+    p = np_;
+    cap = ncap;
+    return true;
+  }
+  bool append(const uint8_t* src, int64_t n) {
+    if (!reserve(n)) return false;
+    std::memcpy(p + len, src, n);
+    len += n;
+    return true;
+  }
+};
+
+template <typename T>
+struct Vec {
+  T* p = nullptr;
+  int64_t len = 0, cap = 0;
+  bool push(T v) {
+    if (len == cap) {
+      int64_t ncap = cap ? cap * 2 : 256;
+      T* np_ = static_cast<T*>(std::realloc(p, ncap * sizeof(T)));
+      if (!np_) return false;
+      p = np_;
+      cap = ncap;
+    }
+    p[len++] = v;
+    return true;
+  }
+};
+
+// ---------------------------------------------------------------- varints
+static bool uvarint(const uint8_t* b, int64_t n, int64_t* pos, uint64_t* out) {
+  uint64_t v = 0;
+  int shift = 0;
+  while (*pos < n) {
+    uint8_t c = b[(*pos)++];
+    v |= static_cast<uint64_t>(c & 0x7F) << shift;
+    if (!(c & 0x80)) {
+      *out = v;
+      return true;
+    }
+    shift += 7;
+    if (shift > 63) return false;
+  }
+  return false;
+}
+
+static int64_t zigzag(uint64_t v) {
+  return static_cast<int64_t>(v >> 1) ^ -static_cast<int64_t>(v & 1);
+}
+
+// ------------------------------------------------------- thrift (compact)
+// just enough to parse parquet PageHeader, mirroring the python parser
+struct FieldIter {
+  const uint8_t* b;
+  int64_t n, pos;
+  int16_t fid = 0;
+  bool ok = true;
+};
+
+static bool skip_field(FieldIter* it, int ftype);
+
+static bool skip_struct(FieldIter* it) {
+  int16_t fid = 0;
+  for (;;) {
+    if (it->pos >= it->n) return false;
+    uint8_t head = it->b[it->pos++];
+    if (head == 0) return true;
+    int delta = head >> 4;
+    int ftype = head & 0x0F;
+    if (delta) {
+      fid = static_cast<int16_t>(fid + delta);
+    } else {
+      uint64_t raw;
+      if (!uvarint(it->b, it->n, &it->pos, &raw)) return false;
+      fid = static_cast<int16_t>(zigzag(raw));
+    }
+    (void)fid;
+    if (!skip_field(it, ftype)) return false;
+  }
+}
+
+static bool skip_field(FieldIter* it, int ftype) {
+  uint64_t tmp;
+  switch (ftype) {
+    case 1:
+    case 2:
+      return true;
+    case 3:
+      it->pos += 1;
+      return it->pos <= it->n;
+    case 4:
+    case 5:
+    case 6:
+      return uvarint(it->b, it->n, &it->pos, &tmp);
+    case 7:
+      it->pos += 8;
+      return it->pos <= it->n;
+    case 8:
+      if (!uvarint(it->b, it->n, &it->pos, &tmp)) return false;
+      it->pos += static_cast<int64_t>(tmp);
+      return it->pos <= it->n;
+    case 9: {  // list
+      if (it->pos >= it->n) return false;
+      uint8_t head = it->b[it->pos++];
+      uint64_t cnt = head >> 4;
+      int etype = head & 0x0F;
+      if (cnt == 15 && !uvarint(it->b, it->n, &it->pos, &cnt)) return false;
+      for (uint64_t i = 0; i < cnt; ++i)
+        if (!skip_field(it, etype)) return false;
+      return true;
+    }
+    case 12:
+      return skip_struct(it);
+    default:
+      return false;
+  }
+}
+
+struct PageHeader {
+  int type = -1;
+  int64_t uncompressed = -1, compressed = -1;
+  int64_t num_values = -1;
+  int encoding = -1, def_encoding = -1;
+  int64_t header_len = 0;
+};
+
+static bool parse_nested(FieldIter* it, int64_t end, PageHeader* h) {
+  int16_t fid = 0;
+  while (it->pos < end) {
+    uint8_t head = it->b[it->pos++];
+    if (head == 0) return true;
+    int delta = head >> 4;
+    int ftype = head & 0x0F;
+    if (delta) {
+      fid = static_cast<int16_t>(fid + delta);
+    } else {
+      uint64_t raw;
+      if (!uvarint(it->b, it->n, &it->pos, &raw)) return false;
+      fid = static_cast<int16_t>(zigzag(raw));
+    }
+    if (ftype == 4 || ftype == 5 || ftype == 6) {
+      uint64_t raw;
+      if (!uvarint(it->b, it->n, &it->pos, &raw)) return false;
+      int64_t v = zigzag(raw);
+      if (fid == 1) h->num_values = v;
+      if (fid == 2) h->encoding = static_cast<int>(v);
+      if (fid == 3) h->def_encoding = static_cast<int>(v);
+    } else if (!skip_field(it, ftype)) {
+      return false;
+    }
+  }
+  return true;
+}
+
+static bool parse_page_header(const uint8_t* b, int64_t n, int64_t pos,
+                              PageHeader* h) {
+  FieldIter it{b, n, pos};
+  int64_t start = pos;
+  int16_t fid = 0;
+  for (;;) {
+    if (it.pos >= it.n) return false;
+    uint8_t head = it.b[it.pos++];
+    if (head == 0) break;
+    int delta = head >> 4;
+    int ftype = head & 0x0F;
+    if (delta) {
+      fid = static_cast<int16_t>(fid + delta);
+    } else {
+      uint64_t raw;
+      if (!uvarint(it.b, it.n, &it.pos, &raw)) return false;
+      fid = static_cast<int16_t>(zigzag(raw));
+    }
+    if (ftype == 4 || ftype == 5 || ftype == 6) {
+      uint64_t raw;
+      if (!uvarint(it.b, it.n, &it.pos, &raw)) return false;
+      int64_t v = zigzag(raw);
+      if (fid == 1) h->type = static_cast<int>(v);
+      if (fid == 2) h->uncompressed = v;
+      if (fid == 3) h->compressed = v;
+    } else if ((fid == 5 || fid == 7) && ftype == 12) {
+      int64_t sub = it.pos;
+      FieldIter probe = it;
+      if (!skip_struct(&probe)) return false;
+      FieldIter nested{it.b, it.n, sub};
+      if (!parse_nested(&nested, probe.pos, h)) return false;
+      it.pos = probe.pos;
+    } else if (!skip_field(&it, ftype)) {
+      return false;
+    }
+  }
+  h->header_len = it.pos - start;
+  return true;
+}
+
+// ------------------------------------------------------------- snappy raw
+static bool snappy_decompress(const uint8_t* src, int64_t slen, uint8_t* dst,
+                              int64_t dlen) {
+  int64_t pos = 0;
+  uint64_t ulen;
+  if (!uvarint(src, slen, &pos, &ulen)) return false;
+  if (static_cast<int64_t>(ulen) != dlen) return false;
+  int64_t out = 0;
+  while (pos < slen) {
+    uint8_t tag = src[pos++];
+    int kind = tag & 3;
+    if (kind == 0) {  // literal
+      int64_t len = (tag >> 2) + 1;
+      if (len > 60) {
+        int nb = static_cast<int>(len) - 60;  // 1..4 length bytes
+        if (pos + nb > slen) return false;
+        int64_t l = 0;
+        for (int i = 0; i < nb; ++i)
+          l |= static_cast<int64_t>(src[pos + i]) << (8 * i);
+        len = l + 1;
+        pos += nb;
+      }
+      if (pos + len > slen || out + len > dlen) return false;
+      std::memcpy(dst + out, src + pos, len);
+      pos += len;
+      out += len;
+    } else {
+      int64_t len, off;
+      if (kind == 1) {
+        len = ((tag >> 2) & 7) + 4;
+        if (pos >= slen) return false;
+        off = (static_cast<int64_t>(tag >> 5) << 8) | src[pos];
+        pos += 1;
+      } else if (kind == 2) {
+        len = (tag >> 2) + 1;
+        if (pos + 2 > slen) return false;
+        off = src[pos] | (static_cast<int64_t>(src[pos + 1]) << 8);
+        pos += 2;
+      } else {
+        len = (tag >> 2) + 1;
+        if (pos + 4 > slen) return false;
+        off = src[pos] | (static_cast<int64_t>(src[pos + 1]) << 8) |
+              (static_cast<int64_t>(src[pos + 2]) << 16) |
+              (static_cast<int64_t>(src[pos + 3]) << 24);
+        pos += 4;
+      }
+      if (off <= 0 || off > out || out + len > dlen) return false;
+      const uint8_t* from = dst + out - off;
+      if (off >= len) {
+        std::memcpy(dst + out, from, len);
+      } else {  // overlapping copy replicates the pattern byte-wise
+        for (int64_t i = 0; i < len; ++i) dst[out + i] = from[i];
+      }
+      out += len;
+    }
+  }
+  return out == dlen;
+}
+
+// --------------------------------------------------------------- rle scan
+struct RunTable {
+  Vec<uint8_t> kinds;
+  Vec<int64_t> counts;
+  Vec<uint32_t> values;
+  Vec<int64_t> bitoffs;
+};
+
+// scan into rt with packed bytes appended to the SHARED blob (bit offsets
+// are global); mirrors srtpu_rle_scan / python _rle_runs
+static bool rle_scan_into(const uint8_t* buf, int64_t len, int64_t num_values,
+                          int bit_width, RunTable* rt, Buf* packed) {
+  const int vbytes = (bit_width + 7) / 8;
+  const uint32_t vmask =
+      bit_width >= 32 ? 0xFFFFFFFFu : ((1u << bit_width) - 1u);
+  int64_t pos = 0, out = 0;
+  while (out < num_values && pos < len) {
+    uint64_t header;
+    if (!uvarint(buf, len, &pos, &header)) return false;
+    if (header & 1) {
+      int64_t groups = static_cast<int64_t>(header >> 1);
+      if (groups == 0) continue;  // empty group: emit nothing
+      int64_t n = groups * 8;
+      int64_t nbytes = groups * bit_width;
+      int64_t kept = n < num_values - out ? n : num_values - out;
+      if (pos + (kept * bit_width + 7) / 8 > len) return false;
+      if (!rt->kinds.push(1) || !rt->counts.push(kept) ||
+          !rt->values.push(0) || !rt->bitoffs.push(packed->len * 8))
+        return false;
+      int64_t copy = nbytes <= len - pos ? nbytes : len - pos;
+      if (!packed->append(buf + pos, copy)) return false;
+      pos += nbytes;
+      out += kept;
+    } else {
+      int64_t n = static_cast<int64_t>(header >> 1);
+      if (n == 0) {  // empty run: skip its value byte(s), emit nothing
+        pos += vbytes;
+        continue;
+      }
+      if (pos + vbytes > len) return false;
+      uint32_t v = 0;
+      for (int i = 0; i < vbytes; ++i)
+        v |= static_cast<uint32_t>(buf[pos + i]) << (8 * i);
+      pos += vbytes;
+      int64_t kept = n < num_values - out ? n : num_values - out;
+      if (!rt->kinds.push(0) || !rt->counts.push(kept) ||
+          !rt->values.push(v & vmask) || !rt->bitoffs.push(0))
+        return false;
+      out += kept;
+    }
+  }
+  return out >= num_values;
+}
+
+}  // namespace
+
+extern "C" {
+
+// direct snappy-block entry (tests + other callers); returns 0 on
+// success, -1 on malformed input
+int32_t srtpu_snappy_decompress(const uint8_t* src, int64_t slen,
+                                uint8_t* dst, int64_t dlen) {
+  return snappy_decompress(src, slen, dst, dlen) ? 0 : -1;
+}
+
+// Result of one chunk walk. All pointers are malloc'd; free with
+// srtpu_chunk_free. Bit offsets in def/idx run tables index the GLOBAL
+// def_packed / idx_packed blobs, so any consecutive page range is a
+// contiguous run-table slice over the shared blob.
+struct SrtpuChunk {
+  // pages (data pages only, in file order)
+  int64_t num_pages;
+  uint8_t* page_kind;        // 0=plain 1=dict-indexed
+  int32_t* page_bw;          // index bit width (dict pages)
+  int64_t* page_num_values;  // declared values incl. nulls
+  int64_t* page_ndef;        // non-null values
+  int64_t* page_plain_off;   // byte offset of this page's payload in plain
+  int64_t* page_idx_run_off; // first idx-run index of this page
+  int64_t* page_idx_packed_off;  // first idx-packed byte of this page
+  // def-level runs, merged across pages, global bit offsets
+  int64_t def_nruns;
+  uint8_t* def_kinds;
+  int64_t* def_counts;
+  uint32_t* def_values;
+  int64_t* def_bitoffs;
+  uint8_t* def_packed;
+  int64_t def_packed_len;
+  // dictionary-index runs, concatenated in page order, global bit offsets
+  int64_t idx_nruns;
+  uint8_t* idx_kinds;
+  int64_t* idx_counts;
+  uint32_t* idx_values;
+  int64_t* idx_bitoffs;
+  uint8_t* idx_packed;
+  int64_t idx_packed_len;
+  // PLAIN payloads concatenated in page order
+  uint8_t* plain;
+  int64_t plain_len;
+  // decompressed dictionary page
+  uint8_t* dict_raw;
+  int64_t dict_len;
+  int64_t dict_count;
+  int64_t total_values;
+};
+
+void srtpu_chunk_free(SrtpuChunk* c) {
+  if (!c) return;
+  std::free(c->page_kind);
+  std::free(c->page_bw);
+  std::free(c->page_num_values);
+  std::free(c->page_ndef);
+  std::free(c->page_plain_off);
+  std::free(c->page_idx_run_off);
+  std::free(c->page_idx_packed_off);
+  std::free(c->def_kinds);
+  std::free(c->def_counts);
+  std::free(c->def_values);
+  std::free(c->def_bitoffs);
+  std::free(c->def_packed);
+  std::free(c->idx_kinds);
+  std::free(c->idx_counts);
+  std::free(c->idx_values);
+  std::free(c->idx_bitoffs);
+  std::free(c->idx_packed);
+  std::free(c->plain);
+  std::free(c->dict_raw);
+  std::free(c);
+}
+
+// codec: 0=uncompressed, 1=snappy. optional: column has def levels.
+// Returns the chunk (caller frees) or nullptr; *err is a small code for
+// diagnostics: 1 alloc, 2 header, 3 page type/encoding outside the fast
+// shape (v2, gzip...), 4 malformed stream. The python walk is the
+// fallback for every non-zero err.
+SrtpuChunk* srtpu_chunk_walk(const uint8_t* buf, int64_t len, int codec,
+                             int optional, int is_bool, int32_t* err) {
+  *err = 0;
+  SrtpuChunk* c = static_cast<SrtpuChunk*>(std::calloc(1, sizeof(SrtpuChunk)));
+  if (!c) {
+    *err = 1;
+    return nullptr;
+  }
+  Vec<uint8_t> pkind;
+  Vec<int32_t> pbw;
+  Vec<int64_t> pnum, pndef, pplain, pidxrun, pidxpacked;
+  RunTable def, idx;
+  Buf def_packed, idx_packed, plain, scratch;
+  uint8_t* dict_raw = nullptr;
+  int64_t dict_len = 0, dict_count = 0, total = 0;
+  int64_t pos = 0;
+
+#define FAIL(code)            \
+  do {                        \
+    *err = (code);            \
+    goto fail;                \
+  } while (0)
+
+  while (pos < len) {
+    PageHeader h;
+    if (!parse_page_header(buf, len, pos, &h)) FAIL(2);
+    if (h.type < 0 || h.compressed < 0 || h.uncompressed < 0) FAIL(2);
+    pos += h.header_len;
+    if (pos + h.compressed > len) FAIL(4);
+    // decompress into scratch (or point at the raw bytes)
+    const uint8_t* body;
+    int64_t body_len = h.uncompressed;
+    if (codec == 0) {
+      if (h.compressed != h.uncompressed) FAIL(4);
+      body = buf + pos;
+    } else {
+      scratch.len = 0;
+      if (!scratch.reserve(h.uncompressed)) FAIL(1);
+      if (!snappy_decompress(buf + pos, h.compressed, scratch.p,
+                             h.uncompressed))
+        FAIL(4);
+      body = scratch.p;
+    }
+    pos += h.compressed;
+
+    if (h.type == 2) {  // dictionary page
+      if (pkind.len || dict_raw) FAIL(3);
+      if (h.encoding != 0 && h.encoding != 2) FAIL(3);
+      dict_raw = static_cast<uint8_t*>(std::malloc(body_len ? body_len : 1));
+      if (!dict_raw) FAIL(1);
+      std::memcpy(dict_raw, body, body_len);
+      dict_len = body_len;
+      // absent num_values parses as -1; clamp so python sees the same
+      // "no dict count" it would from its own walk (-> clean fallback)
+      dict_count = h.num_values < 0 ? 0 : h.num_values;
+      continue;
+    }
+    if (h.type != 0) FAIL(3);  // v2 pages etc.: python path
+
+    int64_t ndef = h.num_values;
+    int64_t off = 0;
+    if (optional) {
+      if (h.def_encoding != 3) FAIL(3);
+      if (body_len < 4) FAIL(4);
+      int64_t dlen = body[0] | (static_cast<int64_t>(body[1]) << 8) |
+                     (static_cast<int64_t>(body[2]) << 16) |
+                     (static_cast<int64_t>(body[3]) << 24);
+      if (4 + dlen > body_len) FAIL(4);
+      int64_t run_start = def.kinds.len;
+      if (!rle_scan_into(body + 4, dlen, h.num_values, 1, &def,
+                         &def_packed))
+        FAIL(4);
+      // non-null count from the new runs; packed runs start byte-aligned
+      // in the global blob, so whole bytes popcount via the builtin
+      ndef = 0;
+      for (int64_t r = run_start; r < def.kinds.len; ++r) {
+        if (def.kinds.p[r] == 0) {
+          ndef += def.values.p[r] ? def.counts.p[r] : 0;
+        } else {
+          const uint8_t* base = def_packed.p + (def.bitoffs.p[r] >> 3);
+          int64_t cnt = def.counts.p[r];
+          int64_t full = cnt >> 3;
+          for (int64_t i = 0; i < full; ++i)
+            ndef += __builtin_popcount(base[i]);
+          int tail = static_cast<int>(cnt & 7);
+          if (tail)
+            ndef += __builtin_popcount(base[full] & ((1 << tail) - 1));
+        }
+      }
+      off = 4 + dlen;
+    }
+    total += h.num_values;
+
+    if (!pnum.push(h.num_values) || !pndef.push(ndef)) FAIL(1);
+    if (h.encoding == 0) {  // PLAIN
+      if (!pkind.push(0) || !pbw.push(0) || !pplain.push(plain.len) ||
+          !pidxrun.push(idx.kinds.len) || !pidxpacked.push(idx_packed.len))
+        FAIL(1);
+      if (is_bool) {
+        // bit-packing restarts per page; python unpacks per page via
+        // the plain offsets, so raw bytes concat is still correct
+        if ((body_len - off) * 8 < ndef) FAIL(4);
+      }
+      if (!plain.append(body + off, body_len - off)) FAIL(1);
+    } else if (h.encoding == 2 || h.encoding == 8) {  // dict indexed
+      if (!dict_raw) FAIL(3);
+      int bw = off < body_len ? body[off] : 0;
+      if (bw > 32) FAIL(4);
+      if (!pkind.push(1) || !pbw.push(bw) || !pplain.push(plain.len) ||
+          !pidxrun.push(idx.kinds.len) || !pidxpacked.push(idx_packed.len))
+        FAIL(1);
+      if (bw && ndef) {
+        if (!rle_scan_into(body + off + 1, body_len - off - 1, ndef, bw,
+                           &idx, &idx_packed))
+          FAIL(4);
+      }
+    } else {
+      FAIL(3);
+    }
+  }
+
+  c->num_pages = pkind.len;
+  c->page_kind = pkind.p;
+  c->page_bw = pbw.p;
+  c->page_num_values = pnum.p;
+  c->page_ndef = pndef.p;
+  c->page_plain_off = pplain.p;
+  c->page_idx_run_off = pidxrun.p;
+  c->page_idx_packed_off = pidxpacked.p;
+  c->def_nruns = def.kinds.len;
+  c->def_kinds = def.kinds.p;
+  c->def_counts = def.counts.p;
+  c->def_values = def.values.p;
+  c->def_bitoffs = def.bitoffs.p;
+  c->def_packed = def_packed.p;
+  c->def_packed_len = def_packed.len;
+  c->idx_nruns = idx.kinds.len;
+  c->idx_kinds = idx.kinds.p;
+  c->idx_counts = idx.counts.p;
+  c->idx_values = idx.values.p;
+  c->idx_bitoffs = idx.bitoffs.p;
+  c->idx_packed = idx_packed.p;
+  c->idx_packed_len = idx_packed.len;
+  c->plain = plain.p;
+  c->plain_len = plain.len;
+  c->dict_raw = dict_raw;
+  c->dict_len = dict_len;
+  c->dict_count = dict_count;
+  c->total_values = total;
+  std::free(scratch.p);
+  return c;
+
+fail:
+  std::free(pkind.p);
+  std::free(pbw.p);
+  std::free(pnum.p);
+  std::free(pndef.p);
+  std::free(pplain.p);
+  std::free(pidxrun.p);
+  std::free(pidxpacked.p);
+  std::free(def.kinds.p);
+  std::free(def.counts.p);
+  std::free(def.values.p);
+  std::free(def.bitoffs.p);
+  std::free(def_packed.p);
+  std::free(idx.kinds.p);
+  std::free(idx.counts.p);
+  std::free(idx.values.p);
+  std::free(idx.bitoffs.p);
+  std::free(idx_packed.p);
+  std::free(plain.p);
+  std::free(scratch.p);
+  std::free(dict_raw);
+  std::free(c);
+  return nullptr;
+}
+
+}  // extern "C"

@@ -1,0 +1,84 @@
+// Parquet RLE/bit-packed hybrid run scan, host side.
+//
+// Role: the device parquet decode (io/parquet_device.py) splits every
+// def-level and dictionary-index stream into a small run table the device
+// expands with searchsorted + vector shifts. The scan itself is a serial
+// varint walk — the pure-python loop measured ~30ms per 2M-row file, a
+// third of the whole decode — so it gets a native implementation (the
+// python loop in _rle_runs remains the fallback and the semantic spec).
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Scan an RLE/bit-packed hybrid stream of `num_values` values at
+// `bit_width` bits. Output arrays must be sized for the worst case of
+// one run per 2 input bytes plus one: kinds u8 (0=rle 1=packed),
+// counts i64, values u32, bitoffs i64 (bit offset into `packed` for
+// packed runs), packed u8 (payload bytes, at most `len`).
+// Returns the run count, writes the packed byte count to *packed_len,
+// or returns -1 on a truncated stream.
+int64_t srtpu_rle_scan(const uint8_t* buf, int64_t len, int64_t num_values,
+                       int32_t bit_width, uint8_t* kinds, int64_t* counts,
+                       uint32_t* values, int64_t* bitoffs, uint8_t* packed,
+                       int64_t* packed_len) {
+  const int vbytes = (bit_width + 7) / 8;
+  const uint32_t vmask =
+      bit_width >= 32 ? 0xFFFFFFFFu : ((1u << bit_width) - 1u);
+  int64_t pos = 0, out = 0, nruns = 0, plen = 0;
+  while (out < num_values && pos < len) {
+    uint64_t header = 0;
+    int shift = 0;
+    for (;;) {
+      if (pos >= len) return -1;
+      uint8_t b = buf[pos++];
+      header |= static_cast<uint64_t>(b & 0x7F) << shift;
+      if (!(b & 0x80)) break;
+      shift += 7;
+    }
+    if (header & 1) {  // bit-packed group of (header>>1)*8 values
+      int64_t groups = static_cast<int64_t>(header >> 1);
+      if (groups == 0) continue;  // empty group: nothing to emit — and
+      // emitting would break the one-run-per-2-bytes output sizing
+      int64_t n = groups * 8;
+      int64_t nbytes = groups * bit_width;
+      int64_t kept = n < num_values - out ? n : num_values - out;
+      if (pos + (kept * bit_width + 7) / 8 > len) return -1;
+      kinds[nruns] = 1;
+      counts[nruns] = kept;
+      values[nruns] = 0;
+      bitoffs[nruns] = plen * 8;
+      // the final group may be declared longer than the buffer holds;
+      // only the bytes covering `kept` values are required to exist
+      int64_t copy = nbytes <= len - pos ? nbytes : len - pos;
+      std::memcpy(packed + plen, buf + pos, static_cast<size_t>(copy));
+      plen += copy;
+      pos += nbytes;
+      out += kept;
+      ++nruns;
+    } else {  // RLE run of header>>1 copies of a vbytes-wide LE value
+      int64_t n = static_cast<int64_t>(header >> 1);
+      if (n == 0) {  // empty run: skip its value byte(s), emit nothing
+        pos += vbytes;
+        continue;
+      }
+      if (pos + vbytes > len) return -1;
+      uint32_t v = 0;
+      for (int i = 0; i < vbytes; ++i)
+        v |= static_cast<uint32_t>(buf[pos + i]) << (8 * i);
+      pos += vbytes;
+      kinds[nruns] = 0;
+      counts[nruns] = n < num_values - out ? n : num_values - out;
+      values[nruns] = v & vmask;
+      bitoffs[nruns] = 0;
+      out += counts[nruns];
+      ++nruns;
+    }
+  }
+  if (out < num_values) return -1;
+  *packed_len = plen;
+  return nruns;
+}
+
+}  // extern "C"

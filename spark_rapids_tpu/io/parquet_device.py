@@ -53,6 +53,7 @@ the host path."""
 from __future__ import annotations
 
 import functools
+import os
 import struct
 from typing import Iterator, List, Optional, Tuple
 
@@ -203,7 +204,19 @@ def _rle_runs(payload: memoryview, num_values: int, bit_width: int = 1):
     Returns (kinds u8 [R] 0=rle 1=packed, counts i64, values u32, bitoffs i64)
     where bitoffs indexes into the packed byte blob for packed runs.
     bit_width=1 is the def-level stream; dictionary index streams carry
-    their width in the page payload's first byte (up to 32 bits)."""
+    their width in the page payload's first byte (up to 32 bits).
+
+    The native scanner (native/src/rle_scan.cpp) runs when built — the
+    python loop below is the fallback and the semantic spec."""
+    from ..native import runtime as _native
+    if _native.available():
+        try:
+            native = _native.rle_scan(
+                np.frombuffer(payload, np.uint8), num_values, bit_width)
+        except ValueError as e:
+            raise DeviceDecodeUnsupported("truncated RLE stream") from e
+        if native is not None:
+            return native
     vbytes = (bit_width + 7) // 8
     kinds: List[int] = []
     counts: List[int] = []
@@ -416,18 +429,94 @@ class _Page:
 
 
 class _Chunk:
-    __slots__ = ("pages", "dict_raw", "dict_count", "total")
+    # def_runs_merged: whole-chunk def-level run table with GLOBAL bit
+    # offsets, produced by the native walk (pages then carry runs=None);
+    # python-walk chunks leave it None and _host_phase merges per page.
+    # plain_all: the native walk's ALREADY-concatenated plain payload
+    # (page payloads are consecutive views into it, so the fast-path prep
+    # can pass a slice through instead of re-concatenating).
+    # hold: owner of the native allocation every view points into — must
+    # outlive the chunk (see native/runtime._ChunkHold).
+    __slots__ = ("pages", "dict_raw", "dict_count", "total",
+                 "def_runs_merged", "plain_all", "hold")
+
+
+_NATIVE_CODEC = {"UNCOMPRESSED": 0, "SNAPPY": 1}
 
 
 def _decode_chunk(buf: bytes, col_meta, optional: bool) -> _Chunk:
-    """One column chunk -> _Chunk page descriptors. Malformed page streams
-    surface as DeviceDecodeUnsupported (not raw IndexError/struct.error) so
-    callers can keep a NARROW fallback net — a genuine code bug elsewhere
-    must not be silently swallowed into the host path."""
+    """One column chunk -> _Chunk page descriptors. The native page walk
+    (native/src/chunk_walk.cpp: headers + snappy + RLE scans in one
+    GIL-free call) handles the common shape; the python walk below is the
+    fallback and the semantic spec. Malformed page streams surface as
+    DeviceDecodeUnsupported (not raw IndexError/struct.error) so callers
+    can keep a NARROW fallback net — a genuine code bug elsewhere must
+    not be silently swallowed into the host path."""
+    codec = _NATIVE_CODEC.get(col_meta.compression)
+    if codec is not None:
+        from ..native import runtime as _native
+        if _native.available():
+            is_bool = col_meta.physical_type == "BOOLEAN"
+            res = _native.chunk_walk(buf, codec, optional, is_bool)
+            if res is not None:
+                return _chunk_from_native(res, is_bool)
     try:
         return _decode_chunk_inner(buf, col_meta, optional)
     except (IndexError, struct.error) as e:
         raise DeviceDecodeUnsupported(f"malformed page stream: {e}") from e
+
+
+def _chunk_from_native(res: dict, is_bool: bool) -> _Chunk:
+    """Native walk result -> the python walk's exact _Chunk shape. Dict
+    pages get LOCAL run-table slices (bit offsets rebased per page) so
+    every downstream consumer — _dict_segments, _merge_runs,
+    _expand_indices — behaves identically; the merged def-level table
+    keeps its global offsets and rides _Chunk.def_runs_merged."""
+    chunk = _Chunk()
+    chunk.dict_raw = res["dict_raw"]
+    chunk.dict_count = res["dict_count"]
+    chunk.total = res["total_values"]
+    chunk.def_runs_merged = res["def_runs"] \
+        if res["def_runs"][0].shape[0] else None
+    chunk.plain_all = res["plain"] if not is_bool else None
+    chunk.hold = res["_hold"]
+    chunk.pages = []
+    npages = res["page_kind"].shape[0]
+    plain = res["plain"]
+    ik, ic, iv, ib, ip = res["idx_runs"]
+    for i in range(npages):
+        p = _Page()
+        p.num_values = int(res["page_num_values"][i])
+        p.ndef = int(res["page_ndef"][i])
+        p.runs = None  # merged def table carries the levels
+        if res["page_kind"][i] == 0:
+            p.kind = "plain"
+            p.bw = 0
+            lo = int(res["page_plain_off"][i])
+            hi = int(res["page_plain_off"][i + 1]) if i + 1 < npages \
+                else plain.shape[0]
+            pay = plain[lo:hi]
+            p.payload = np.unpackbits(
+                pay, bitorder="little")[:p.ndef] if is_bool else pay
+        else:
+            p.kind = "dict"
+            p.bw = int(res["page_bw"][i])
+            rlo = int(res["page_idx_run_off"][i])
+            rhi = int(res["page_idx_run_off"][i + 1]) if i + 1 < npages \
+                else ik.shape[0]
+            plo = int(res["page_idx_packed_off"][i])
+            phi = int(res["page_idx_packed_off"][i + 1]) \
+                if i + 1 < npages else res["idx_packed_len"]
+            if p.bw and p.ndef:
+                packed = ip[plo:phi]
+                if packed.shape[0] == 0:
+                    packed = np.zeros(1, np.uint8)
+                p.payload = (ik[rlo:rhi], ic[rlo:rhi], iv[rlo:rhi],
+                             ib[rlo:rhi] - plo * 8, packed)
+            else:
+                p.payload = None
+        chunk.pages.append(p)
+    return chunk
 
 
 def _decode_chunk_inner(buf: bytes, col_meta, optional: bool) -> _Chunk:
@@ -443,6 +532,9 @@ def _decode_chunk_inner(buf: bytes, col_meta, optional: bool) -> _Chunk:
     chunk.dict_raw = None
     chunk.dict_count = 0
     chunk.total = 0
+    chunk.def_runs_merged = None
+    chunk.plain_all = None
+    chunk.hold = None
     while pos < len(mv):
         h = _parse_page_header(mv, pos)
         if h.type is None or h.compressed is None or h.uncompressed is None:
@@ -657,6 +749,177 @@ def file_supported(path, schema):
     return pf
 
 
+class _ColWork:
+    """One column's host-phase product: the parsed chunk + merged
+    def-level run table (numpy), plus the fast-path ship list/meta when
+    the page layout allows the batched-transfer path (ship None -> the
+    device phase uses the general eager assemble)."""
+    __slots__ = ("name", "dt", "spec", "phys", "optional", "chunk",
+                 "defruns", "ship", "meta")
+
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def _pad_runs(runs):
+    """Pad a run table to power-of-two lengths (zero-count runs append
+    harmlessly past the cumsum; packed pads with dead bytes) so repeated
+    row groups hit the same fused-program shape instead of retracing."""
+    kinds, counts, values, bitoffs, packed = runs
+    rb = _pow2(max(len(kinds), 1))
+    pad = rb - len(kinds)
+    if pad:
+        kinds = np.pad(kinds, (0, pad))
+        counts = np.pad(counts, (0, pad))
+        values = np.pad(values, (0, pad))
+        bitoffs = np.pad(bitoffs, (0, pad))
+    pb = _pow2(max(len(packed), 1))
+    if pb > len(packed):
+        packed = np.pad(packed, (0, pb - len(packed)))
+    return kinds, counts, values, bitoffs, packed
+
+
+def _host_phase(pf, f, rg: int, schema, host_cols=None):
+    """HOST half of a row-group decode: chunk reads, page parsing,
+    decompression and RLE run scans — numpy/bytes only, no device work.
+    Columns prepare SERIALLY: this image runs on a single CPU core, where
+    thread pools and prefetch threads measured as pure context-switch
+    overhead (the C++ walk already minimizes the python-side cost)."""
+    meta = pf.metadata
+    pq_schema = meta.schema
+    col_index = {pq_schema.column(i).path: i
+                 for i in range(len(pq_schema))}
+    rgm = meta.row_group(rg)
+    nrows = rgm.num_rows
+    host_cols = set(host_cols or ())
+    dev_names = [n for n in schema.names if n not in host_cols]
+    cis = {}
+    for name in dev_names:
+        ci = col_index.get(name)
+        if ci is None:
+            # file changed on disk since the footer support check
+            raise DeviceDecodeUnsupported(f"column {name} missing from file")
+        cis[name] = ci
+    try:
+        fd = f.fileno()
+    except (OSError, ValueError, AttributeError):
+        fd = None  # BytesIO and friends (the cache exec) seek instead
+
+    def read_chunk(ci):
+        cm = rgm.column(ci)
+        start = cm.dictionary_page_offset or cm.data_page_offset
+        want = cm.total_compressed_size
+        if fd is not None:
+            # positional reads leave the handle's offset alone; loop
+            # because one pread may return short (2GiB syscall cap, NFS)
+            parts = []
+            got = 0
+            while got < want:
+                part = os.pread(fd, want - got, start + got)
+                if not part:
+                    break  # EOF: the decode raises on the short buffer
+                parts.append(part)
+                got += len(part)
+            return parts[0] if len(parts) == 1 else b"".join(parts)
+        f.seek(start)
+        return f.read(want)
+
+    def prep(name, dt) -> _ColWork:
+        ci = cis[name]
+        buf = read_chunk(ci)
+        cm = rgm.column(ci)
+        pqcol = pq_schema.column(ci)
+        w = _ColWork()
+        w.name, w.dt = name, dt
+        w.spec = _column_spec(pqcol, dt)
+        w.phys = cm.physical_type
+        w.optional = pqcol.max_definition_level > 0
+        if pqcol.max_repetition_level > 0:
+            raise DeviceDecodeUnsupported("repeated column")
+        w.chunk = _decode_chunk(buf, cm, w.optional)
+        if w.chunk.total != nrows:
+            raise DeviceDecodeUnsupported("page/row-group mismatch")
+        if w.chunk.def_runs_merged is not None:
+            w.defruns = _pad_runs(w.chunk.def_runs_merged)
+        else:
+            run_parts = [p.runs for p in w.chunk.pages
+                         if p.runs is not None]
+            w.defruns = _pad_runs(_merge_runs(run_parts)) \
+                if w.optional and run_parts else None
+        w.ship = w.meta = None
+        if w.spec.kind == "prim":
+            prepped = _prep_fixed(w.chunk, w.phys)
+            if prepped is not None:
+                w.ship, w.meta = prepped
+        elif w.spec.kind == "flba":
+            prepped = _prep_flba(w.chunk, w.spec.flen)
+            if prepped is not None:
+                w.ship, w.meta = prepped
+        return w
+
+    by_name = dict(zip(schema.names, schema.types))
+    works = [prep(nm, by_name[nm]) for nm in dev_names]
+    return {w.name: w for w in works}, nrows
+
+
+def _device_phase(pf, rg: int, schema, works, nrows: int, host_cols=None):
+    """DEVICE half: ship every column's control-plane arrays in ONE
+    batched transfer (the tunnel charges per call, not per byte), then
+    run the jitted expansion kernels."""
+    import jax
+    import jax.numpy as jnp
+    from ..columnar.batch import ColumnarBatch
+    cap = row_bucket(nrows)
+    host_decoded = _host_decode_cols(pf, rg, schema, host_cols or (),
+                                     cap, nrows)
+
+    from ..columnar.column import Column
+    # fast-path (prim/flba) columns fuse into ONE jitted program fed by
+    # ONE batched H2D; strings and odd page layouts run their eager
+    # assembles afterwards
+    fused = [w for w in works.values() if w.ship is not None]
+    fused_cols = {}
+    if fused:
+        flat: List[np.ndarray] = []
+        for w in fused:
+            if w.defruns is not None:
+                flat.extend(w.defruns)
+            flat.extend(w.ship)
+        sig = tuple(_col_sig(w) for w in fused)
+        program = _fused_decode_program(sig, cap, nrows)
+        outs = program(*jax.device_put(flat))
+        for w, (data, validity) in zip(fused, outs):
+            fused_cols[w.name] = Column(w.dt, data, validity)
+
+    cols = []
+    for name, dt in zip(schema.names, schema.types):
+        if name in host_decoded:
+            cols.append(host_decoded[name])
+            continue
+        if name in fused_cols:
+            cols.append(fused_cols[name])
+            continue
+        w = works[name]
+        if w.defruns is not None:
+            defined = _expand_def_levels(
+                *[jnp.asarray(a) for a in w.defruns], cap)
+        else:  # required column, or a 0-row row group (no pages)
+            defined = jnp.arange(cap) < nrows
+        if w.spec.kind == "string":
+            cols.append(_assemble_strings(w.chunk, dt, defined, cap))
+        elif w.spec.kind == "flba":
+            cols.append(_assemble_flba(w.chunk, w.spec, dt, defined, cap))
+        else:
+            cols.append(_assemble_fixed(w.chunk, w.phys, dt, defined,
+                                        cap, w.spec.post))
+    return ColumnarBatch(schema, tuple(cols),
+                         jnp.asarray(nrows, jnp.int32)), nrows
+
+
 def decode_row_group(pf, f, rg: int, schema, host_cols=None):
     """Decode ONE row group on the TPU -> (device ColumnarBatch, row count).
     `pf` is a parsed ParquetFile whose supportability columns_supported()
@@ -670,57 +933,8 @@ def decode_row_group(pf, f, rg: int, schema, host_cols=None):
     (pf.read_row_group) — per-row-group granularity keeps the stream lazy
     (one device batch live at a time, the reference's chunked-reader
     discipline) with no double decode."""
-    import jax.numpy as jnp
-    from ..columnar.batch import ColumnarBatch
-
-    meta = pf.metadata
-    pq_schema = meta.schema
-    col_index = {pq_schema.column(i).path: i
-                 for i in range(len(pq_schema))}
-    rgm = meta.row_group(rg)
-    nrows = rgm.num_rows
-    cap = row_bucket(nrows)
-    host_cols = host_cols or ()
-    host_decoded = _host_decode_cols(pf, rg, schema, host_cols, cap, nrows)
-    cols = []
-    for name, dt in zip(schema.names, schema.types):
-        if name in host_decoded:
-            cols.append(host_decoded[name])
-            continue
-        ci = col_index.get(name)
-        if ci is None:
-            # file changed on disk since the footer support check
-            raise DeviceDecodeUnsupported(f"column {name} missing from file")
-        cm = rgm.column(ci)
-        pqcol = pq_schema.column(ci)
-        spec = _column_spec(pqcol, dt)
-        optional = pqcol.max_definition_level > 0
-        if pqcol.max_repetition_level > 0:
-            raise DeviceDecodeUnsupported("repeated column")
-        start = cm.dictionary_page_offset or cm.data_page_offset
-        f.seek(start)
-        buf = f.read(cm.total_compressed_size)
-        chunk = _decode_chunk(buf, cm, optional)
-        if chunk.total != nrows:
-            raise DeviceDecodeUnsupported("page/row-group mismatch")
-        run_parts = [p.runs for p in chunk.pages if p.runs is not None]
-        if optional and run_parts:
-            kinds, counts, values, bitoffs, packed = _merge_runs(run_parts)
-            defined = _expand_def_levels(
-                jnp.asarray(kinds), jnp.asarray(counts),
-                jnp.asarray(values), jnp.asarray(bitoffs),
-                jnp.asarray(packed), cap)
-        else:  # required column, or a 0-row row group (no pages)
-            defined = jnp.arange(cap) < nrows
-        if spec.kind == "string":
-            cols.append(_assemble_strings(chunk, dt, defined, cap))
-        elif spec.kind == "flba":
-            cols.append(_assemble_flba(chunk, spec, dt, defined, cap))
-        else:
-            cols.append(_assemble_fixed(chunk, cm.physical_type, dt,
-                                        defined, cap, spec.post))
-    return ColumnarBatch(schema, tuple(cols),
-                         jnp.asarray(nrows, jnp.int32)), nrows
+    works, nrows = _host_phase(pf, f, rg, schema, host_cols)
+    return _device_phase(pf, rg, schema, works, nrows, host_cols)
 
 
 def _host_cols_to_device(t, schema, names, cap: int):
@@ -817,6 +1031,233 @@ def _merged_dict_indices(pages, dict_count: int):
         return jnp.zeros(0, jnp.uint32)
     merged = outs[0] if len(outs) == 1 else jnp.concatenate(outs)
     return jnp.clip(merged, 0, max(dict_count - 1, 0))
+
+
+def _dict_segments(pages, dict_count: int):
+    """Consecutive equal-bit-width dict pages -> [(bw, ndef, runs|None)]
+    with runs the 5 merged numpy arrays (None for bw==0)."""
+    segs = []
+    for p in pages:
+        bw = 0 if p.payload is None else int(p.bw)
+        if segs and segs[-1][0] == bw:
+            segs[-1][1].append(p)
+        else:
+            segs.append((bw, [p]))
+    out = []
+    for bw, ps in segs:
+        ndef = sum(p.ndef for p in ps)
+        if ndef == 0:
+            continue
+        runs = _merge_runs([p.payload for p in ps]) if bw else None
+        out.append((bw, ndef, runs))
+    return out
+
+
+def _prep_fast_path(chunk: _Chunk, meta: dict, build_dict_vals,
+                    build_plain, passthrough):
+    """Shared HOST half of the dict-prefix + plain-suffix fast path:
+    returns (ship list of numpy arrays, meta) or None when the page
+    layout needs the general eager path. The ship list joins the row
+    group's single batched H2D; the fused decode program consumes the
+    device arrays in the same order. Value materialization is supplied
+    by the type-specific callbacks: build_dict_vals(chunk) -> array,
+    build_plain(page) -> array, passthrough(total_plain_values) -> the
+    native walk's pre-concatenated buffer or None."""
+    kinds_seq = [p.kind for p in chunk.pages]
+    ndict = 0
+    while ndict < len(kinds_seq) and kinds_seq[ndict] == "dict":
+        ndict += 1
+    if not chunk.pages or \
+            not all(k == "plain" for k in kinds_seq[ndict:]):
+        return None
+    ship: List[np.ndarray] = []
+    meta.update({"segs": [], "dict_count": chunk.dict_count,
+                 "has_dict_vals": False, "has_plain": False})
+    if ndict:
+        if chunk.dict_raw is None or not chunk.dict_count:
+            raise DeviceDecodeUnsupported("dict page missing values")
+        ship.append(build_dict_vals(chunk))
+        meta["has_dict_vals"] = True
+        for bw, ndef, runs in _dict_segments(chunk.pages[:ndict],
+                                             chunk.dict_count):
+            meta["segs"].append((bw, ndef, runs is not None))
+            if runs is not None:
+                ship.extend(_pad_runs(runs))
+    plain_pages = [p for p in chunk.pages[ndict:] if p.ndef]
+    if plain_pages:
+        total = sum(p.ndef for p in plain_pages)
+        whole = passthrough(total)
+        if whole is not None:
+            # the native walk already concatenated the plain suffix
+            # (dict pages contribute no plain bytes) — pass it through
+            # instead of re-copying page by page
+            ship.append(whole)
+        else:
+            plain = [build_plain(p) for p in plain_pages]
+            ship.append(plain[0] if len(plain) == 1
+                        else np.concatenate(plain))
+        meta["has_plain"] = True
+    return ship, meta
+
+
+def _prep_fixed(chunk: _Chunk, phys: str):
+    """Fixed-width fast-path prep (see _prep_fast_path)."""
+    np_dt = np.dtype(_PHYS_TO_NP[phys])
+    is_bool = phys == "BOOLEAN"
+
+    def dict_vals(c):
+        try:
+            return np.frombuffer(c.dict_raw, np_dt, count=c.dict_count)
+        except ValueError as e:
+            raise DeviceDecodeUnsupported(
+                f"truncated dict page: {e}") from e
+
+    def plain_values(p):
+        if is_bool:
+            return p.payload.astype(np.bool_)
+        try:
+            return np.frombuffer(p.payload, np_dt, count=p.ndef)
+        except ValueError as e:
+            raise DeviceDecodeUnsupported(
+                f"truncated value page: {e}") from e
+
+    def passthrough(total):
+        if chunk.plain_all is not None and not is_bool and \
+                chunk.plain_all.nbytes == total * np_dt.itemsize:
+            return chunk.plain_all.view(np_dt)
+        return None
+
+    return _prep_fast_path(chunk, {"np_dt": np_dt, "is_bool": is_bool},
+                           dict_vals, plain_values, passthrough)
+
+
+def _prep_flba(chunk: _Chunk, flen: int):
+    """FLBA (byte-matrix values) fast-path prep (see _prep_fast_path)."""
+
+    def dict_vals(c):
+        need = c.dict_count * flen
+        if len(c.dict_raw) < need:
+            raise DeviceDecodeUnsupported("truncated dict page")
+        return np.frombuffer(c.dict_raw, np.uint8,
+                             count=need).reshape(-1, flen)
+
+    def plain_mat(p):
+        try:
+            return np.frombuffer(p.payload, np.uint8,
+                                 count=p.ndef * flen).reshape(-1, flen)
+        except ValueError as e:
+            raise DeviceDecodeUnsupported(
+                f"truncated value page: {e}") from e
+
+    def passthrough(total):
+        if chunk.plain_all is not None and \
+                chunk.plain_all.nbytes == total * flen:
+            return chunk.plain_all.reshape(-1, flen)
+        return None
+
+    return _prep_fast_path(chunk, {"flen": flen}, dict_vals, plain_mat,
+                           passthrough)
+
+
+# -- fused multi-column decode ------------------------------------------------
+# One jitted program decodes EVERY fast-path column of a row group in a
+# single dispatch: def-level expansion, dictionary-index expansion,
+# gathers, null scatter and dtype conversion all fuse under XLA instead of
+# costing ~18 eager tunnel round-trips per column (the round-4 verdict's
+# "merge per-column programs into one jitted multi-column decode"). The
+# program is cached by structural signature; run tables pad to
+# power-of-two shapes (_pad_runs) so uniform row groups share one trace.
+
+def _col_sig(w):
+    m = w.meta
+    return (w.spec.kind, w.phys, w.spec.post, w.spec.flen,
+            w.defruns is not None, m["has_dict_vals"], m["dict_count"],
+            tuple(m["segs"]), m["has_plain"],
+            str(w.dt.np_dtype) if w.spec.kind == "prim" else "",
+            isinstance(w.dt, T.DateType))
+
+
+@functools.lru_cache(maxsize=256)
+def _fused_decode_program(sig_tuple, cap: int, nrows: int):
+    """Build + jit the fused decoder for one structural signature.
+    Takes the flat array list in _device_phase's ship order and returns
+    (data, validity) per column."""
+    import jax
+    import jax.numpy as jnp
+
+    def fn(*arrays):
+        it = iter(arrays)
+        outs = []
+        for (kind, phys, post, flen, has_def, has_dict, dict_count,
+             segs, has_plain, np_dt_str, is_date) in sig_tuple:
+            if has_def:
+                runs = [next(it) for _ in range(5)]
+                defined = _expand_def_levels(*runs, cap)
+            else:
+                defined = jnp.arange(cap) < nrows
+            is_bool = phys == "BOOLEAN"
+            dict_vals = next(it) if has_dict else None
+            idx_parts = []
+            for bw, ndef, has_runs in segs:
+                if not has_runs:
+                    idx_parts.append(jnp.zeros(ndef, jnp.uint32))
+                    continue
+                runs = [next(it) for _ in range(5)]
+                idx_parts.append(_expand_rle_u32(
+                    *runs, row_bucket(ndef), bw)[:ndef])
+            pieces = []
+            if idx_parts:
+                idx = idx_parts[0] if len(idx_parts) == 1 \
+                    else jnp.concatenate(idx_parts)
+                idx = jnp.clip(idx, 0, max(dict_count - 1, 0))
+                dv = dict_vals[idx]
+                pieces.append(dv.astype(np.bool_) if is_bool else dv)
+            if has_plain:
+                pieces.append(next(it))
+            if kind == "flba":
+                if pieces:
+                    mat = pieces[0] if len(pieces) == 1 \
+                        else jnp.concatenate(pieces)
+                else:
+                    mat = jnp.zeros((0, flen), jnp.uint8)
+                if mat.shape[0] < cap:
+                    mat = jnp.pad(mat, ((0, cap - mat.shape[0]), (0, 0)))
+                mat = mat[:cap]
+                if post == "int96":
+                    data, validity = _scatter_values(
+                        _int96_to_micros(mat), defined)
+                    outs.append((data, validity))
+                    continue
+                hi, lo = _flba_to_limbs(mat, flen)
+                if post == "dec64":
+                    data, validity = _scatter_values(lo, defined)
+                    outs.append((data, validity))
+                else:
+                    hi_s, validity = _scatter_values(hi, defined)
+                    lo_s, _ = _scatter_values(lo, defined)
+                    outs.append((jnp.stack([hi_s, lo_s], axis=1),
+                                 validity))
+                continue
+            np_dt = np.dtype(np_dt_str)
+            if pieces:
+                vals = pieces[0] if len(pieces) == 1 \
+                    else jnp.concatenate(pieces)
+            else:
+                vals = jnp.zeros(0, np.bool_ if is_bool
+                                 else np.dtype(_PHYS_TO_NP[phys]))
+            if vals.shape[0] < cap:
+                vals = jnp.pad(vals, (0, cap - vals.shape[0]))
+            data, validity = _scatter_values(vals[:cap], defined)
+            if is_date:
+                data = data.astype(jnp.int32)
+            elif data.dtype != np_dt:
+                data = data.astype(np_dt)
+            if post == "ts_ms":
+                data = data * 1000
+            outs.append((data, validity))
+        return tuple(outs)
+
+    return jax.jit(fn)
 
 
 def _assemble_fixed(chunk: _Chunk, phys: str, dt, defined, cap: int,
@@ -977,8 +1418,14 @@ def _assemble_flba(chunk: _Chunk, spec: _ColSpec, dt, defined, cap: int):
         mat = jnp.zeros((0, flen), jnp.uint8)
     if mat.shape[0] < cap:
         mat = jnp.pad(mat, ((0, cap - mat.shape[0]), (0, 0)))
-    mat = mat[:cap]
+    return _flba_column_from_matrix(mat[:cap], spec, dt, defined, flen)
 
+
+def _flba_column_from_matrix(mat, spec: _ColSpec, dt, defined, flen: int):
+    """Value-dense byte matrix [cap, flen] -> typed Column (shared tail
+    of the eager and batched FLBA paths)."""
+    import jax.numpy as jnp
+    from ..columnar.column import Column
     if spec.post == "int96":
         vals, validity = _scatter_values(_int96_to_micros(mat), defined)
         return Column(dt, vals, validity)
@@ -1129,8 +1576,12 @@ def _assemble_long_strings(jnp, dt, blob, starts, lens, defined, cap: int):
                   overflow=(tail_blob, tail_start))
 
 
-def device_decode_file(pf, path: str, schema) -> Iterator:
-    """Yield (device ColumnarBatch, row count) per row group, streaming."""
+def device_decode_file(pf, path: str, schema, host_cols=None) -> Iterator:
+    """Yield (device ColumnarBatch, row count) per row group, streaming —
+    one batch live at a time. Host and device phases alternate serially:
+    on this image's single CPU core a prefetch thread measured ~2x SLOWER
+    than the serial loop (context-switch thrash against the tunnel
+    dispatch), so the double-buffer is deliberately absent."""
     with open(path, "rb") as f:
         for rg in range(pf.metadata.num_row_groups):
-            yield decode_row_group(pf, f, rg, schema)
+            yield decode_row_group(pf, f, rg, schema, host_cols)

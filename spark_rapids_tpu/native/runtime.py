@@ -70,6 +70,17 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.srtpu_byte_array_scan.restype = ctypes.c_int64
     lib.srtpu_byte_array_scan.argtypes = [u8p, ctypes.c_int64,
                                           ctypes.c_int64, i64p, i32p]
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    lib.srtpu_rle_scan.restype = ctypes.c_int64
+    lib.srtpu_rle_scan.argtypes = [u8p, ctypes.c_int64, ctypes.c_int64,
+                                   ctypes.c_int32, u8p, i64p, u32p, i64p,
+                                   u8p, i64p]
+    lib.srtpu_chunk_walk.restype = ctypes.POINTER(_SrtpuChunk)
+    lib.srtpu_chunk_walk.argtypes = [u8p, ctypes.c_int64, ctypes.c_int32,
+                                     ctypes.c_int32, ctypes.c_int32,
+                                     ctypes.POINTER(ctypes.c_int32)]
+    lib.srtpu_chunk_free.restype = None
+    lib.srtpu_chunk_free.argtypes = [ctypes.POINTER(_SrtpuChunk)]
     lib.srtpu_arena_init.restype = ctypes.c_int32
     lib.srtpu_arena_init.argtypes = [ctypes.c_int64]
     lib.srtpu_arena_alloc.restype = ctypes.c_void_p
@@ -80,6 +91,39 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.srtpu_arena_peak.restype = ctypes.c_int64
     lib.srtpu_arena_capacity.restype = ctypes.c_int64
     lib.srtpu_arena_destroy.restype = None
+
+
+class _SrtpuChunk(ctypes.Structure):
+    _fields_ = [
+        ("num_pages", ctypes.c_int64),
+        ("page_kind", ctypes.POINTER(ctypes.c_uint8)),
+        ("page_bw", ctypes.POINTER(ctypes.c_int32)),
+        ("page_num_values", ctypes.POINTER(ctypes.c_int64)),
+        ("page_ndef", ctypes.POINTER(ctypes.c_int64)),
+        ("page_plain_off", ctypes.POINTER(ctypes.c_int64)),
+        ("page_idx_run_off", ctypes.POINTER(ctypes.c_int64)),
+        ("page_idx_packed_off", ctypes.POINTER(ctypes.c_int64)),
+        ("def_nruns", ctypes.c_int64),
+        ("def_kinds", ctypes.POINTER(ctypes.c_uint8)),
+        ("def_counts", ctypes.POINTER(ctypes.c_int64)),
+        ("def_values", ctypes.POINTER(ctypes.c_uint32)),
+        ("def_bitoffs", ctypes.POINTER(ctypes.c_int64)),
+        ("def_packed", ctypes.POINTER(ctypes.c_uint8)),
+        ("def_packed_len", ctypes.c_int64),
+        ("idx_nruns", ctypes.c_int64),
+        ("idx_kinds", ctypes.POINTER(ctypes.c_uint8)),
+        ("idx_counts", ctypes.POINTER(ctypes.c_int64)),
+        ("idx_values", ctypes.POINTER(ctypes.c_uint32)),
+        ("idx_bitoffs", ctypes.POINTER(ctypes.c_int64)),
+        ("idx_packed", ctypes.POINTER(ctypes.c_uint8)),
+        ("idx_packed_len", ctypes.c_int64),
+        ("plain", ctypes.POINTER(ctypes.c_uint8)),
+        ("plain_len", ctypes.c_int64),
+        ("dict_raw", ctypes.POINTER(ctypes.c_uint8)),
+        ("dict_len", ctypes.c_int64),
+        ("dict_count", ctypes.c_int64),
+        ("total_values", ctypes.c_int64),
+    ]
 
 
 def available() -> bool:
@@ -180,6 +224,128 @@ def byte_array_scan(blob: np.ndarray, n: int) -> tuple:
         mx = max(mx, ln)
         pos += ln
     return starts, lens, mx
+
+
+_RLE_SCRATCH = threading.local()
+
+
+def rle_scan(payload: np.ndarray, num_values: int, bit_width: int):
+    """Parquet RLE/bit-packed hybrid stream -> run table
+    (kinds u8[R], counts i64[R], values u32[R], bitoffs i64[R],
+    packed u8[...]); None when the native lib is absent (caller runs the
+    python loop in io/parquet_device._rle_runs). Raises ValueError on a
+    truncated stream — same contract as the fallback.
+
+    The worst-case output arrays (one run per 2 stream bytes) are
+    THREAD-LOCAL scratch reused across calls — allocating them fresh per
+    page measured as the dominant scan cost; only the run-count-sized
+    results are copied out."""
+    lib = _load()
+    if lib is None:
+        return None
+    payload = np.ascontiguousarray(payload, np.uint8)
+    n = payload.shape[0]
+    cap = n // 2 + 2  # a run consumes >= 2 stream bytes
+    s = _RLE_SCRATCH
+    if getattr(s, "cap", 0) < cap:
+        s.cap = max(cap, 1 << 16)
+        s.kinds = np.empty(s.cap, np.uint8)
+        s.counts = np.empty(s.cap, np.int64)
+        s.values = np.empty(s.cap, np.uint32)
+        s.bitoffs = np.empty(s.cap, np.int64)
+        s.packed = np.empty(max(s.cap * 2, 1), np.uint8)
+    if s.packed.shape[0] < n:
+        s.packed = np.empty(n, np.uint8)
+    plen = ctypes.c_int64(0)
+    i64 = ctypes.POINTER(ctypes.c_int64)
+    nruns = lib.srtpu_rle_scan(
+        _u8(payload), n, num_values, bit_width, _u8(s.kinds),
+        s.counts.ctypes.data_as(i64),
+        s.values.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        s.bitoffs.ctypes.data_as(i64), _u8(s.packed), ctypes.byref(plen))
+    if nruns < 0:
+        raise ValueError("truncated RLE stream")
+    pl = max(plen.value, 1)
+    return (s.kinds[:nruns].copy(), s.counts[:nruns].copy(),
+            s.values[:nruns].copy(), s.bitoffs[:nruns].copy(),
+            s.packed[:pl].copy())
+
+
+class _ChunkHold:
+    """Owns the native SrtpuChunk allocation: every array in the walk
+    result is a zero-copy VIEW into it, so the holder must stay
+    referenced as long as any view does (the result dict carries it, and
+    the decode keeps the dict alive on the _Chunk)."""
+
+    def __init__(self, lib, cp):
+        self._lib = lib
+        self._cp = cp
+
+    def __del__(self):
+        try:
+            self._lib.srtpu_chunk_free(self._cp)
+        except Exception:
+            pass
+
+
+_CTYPE_NP = {ctypes.c_uint8: np.uint8, ctypes.c_int32: np.int32,
+             ctypes.c_int64: np.int64, ctypes.c_uint32: np.uint32}
+
+
+def _view(ptr, n):
+    """Zero-copy numpy view over a C pointer (dtype from the pointer)."""
+    np_dt = _CTYPE_NP[ptr._type_]
+    if n <= 0 or not ptr:
+        return np.zeros(max(n, 0), np_dt)
+    return np.ctypeslib.as_array(ptr, shape=(n,))
+
+
+def chunk_walk(buf, codec: int, optional: bool, is_bool: bool):
+    """Full parquet column-chunk page walk in C++ (headers, snappy, RLE
+    scans, PLAIN concat — native/src/chunk_walk.cpp). Returns a dict of
+    numpy VIEWS into one native allocation (plus the '_hold' owner —
+    callers must keep the dict alive while using the arrays), or None
+    when the lib is absent / the chunk is outside the fast shape (caller
+    runs the python walk). codec: 0 uncompressed, 1 snappy."""
+    lib = _load()
+    if lib is None:
+        return None
+    src = np.frombuffer(buf, np.uint8)
+    err = ctypes.c_int32(0)
+    cp = lib.srtpu_chunk_walk(_u8(src), src.shape[0], codec,
+                              int(optional), int(is_bool),
+                              ctypes.byref(err))
+    if not cp:
+        return None  # err codes 2/3/4: python walk decides/diagnoses
+    hold = _ChunkHold(lib, cp)
+    c = cp.contents
+    npages = c.num_pages
+    return {
+        "_hold": hold,
+        "page_kind": _view(c.page_kind, npages),
+        "page_bw": _view(c.page_bw, npages),
+        "page_num_values": _view(c.page_num_values, npages),
+        "page_ndef": _view(c.page_ndef, npages),
+        "page_plain_off": _view(c.page_plain_off, npages),
+        "page_idx_run_off": _view(c.page_idx_run_off, npages),
+        "page_idx_packed_off": _view(c.page_idx_packed_off, npages),
+        "def_runs": (_view(c.def_kinds, c.def_nruns),
+                     _view(c.def_counts, c.def_nruns),
+                     _view(c.def_values, c.def_nruns),
+                     _view(c.def_bitoffs, c.def_nruns),
+                     _view(c.def_packed, max(c.def_packed_len, 1))),
+        "idx_runs": (_view(c.idx_kinds, c.idx_nruns),
+                     _view(c.idx_counts, c.idx_nruns),
+                     _view(c.idx_values, c.idx_nruns),
+                     _view(c.idx_bitoffs, c.idx_nruns),
+                     _view(c.idx_packed, max(c.idx_packed_len, 1))),
+        "idx_packed_len": int(c.idx_packed_len),
+        "plain": _view(c.plain, c.plain_len),
+        "dict_raw": (_view(c.dict_raw, c.dict_len)
+                     if c.dict_len or c.dict_count else None),
+        "dict_count": int(c.dict_count),
+        "total_values": int(c.total_values),
+    }
 
 
 def matrix_to_offsets(matrix: np.ndarray,
