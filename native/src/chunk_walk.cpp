@@ -303,6 +303,10 @@ static bool rle_scan_into(const uint8_t* buf, int64_t len, int64_t num_values,
     if (header & 1) {
       int64_t groups = static_cast<int64_t>(header >> 1);
       if (groups == 0) continue;  // empty group: emit nothing
+      // a group carries bit_width >= 1 bytes, so any valid count is
+      // bounded by the stream length — larger values are malformed and
+      // would overflow the size arithmetic below
+      if (groups > len) return false;
       int64_t n = groups * 8;
       int64_t nbytes = groups * bit_width;
       int64_t kept = n < num_values - out ? n : num_values - out;
@@ -344,6 +348,38 @@ extern "C" {
 int32_t srtpu_snappy_decompress(const uint8_t* src, int64_t slen,
                                 uint8_t* dst, int64_t dlen) {
   return snappy_decompress(src, slen, dst, dlen) ? 0 : -1;
+}
+
+// Standalone RLE/bit-packed hybrid scan over caller-provided output
+// arrays (sized for one run per 2 stream bytes; see runtime.rle_scan) —
+// a thin shell over rle_scan_into so there is exactly ONE scanner
+// implementation. Returns the run count, writes the packed byte count
+// to *packed_len, or returns -1 on a malformed stream.
+int64_t srtpu_rle_scan(const uint8_t* buf, int64_t len, int64_t num_values,
+                       int32_t bit_width, uint8_t* kinds, int64_t* counts,
+                       uint32_t* values, int64_t* bitoffs, uint8_t* packed,
+                       int64_t* packed_len) {
+  RunTable rt;
+  Buf pk;
+  bool ok = rle_scan_into(buf, len, num_values, bit_width, &rt, &pk);
+  int64_t nruns = -1;
+  if (ok) {
+    nruns = rt.kinds.len;
+    if (nruns > 0) {
+      std::memcpy(kinds, rt.kinds.p, nruns * sizeof(uint8_t));
+      std::memcpy(counts, rt.counts.p, nruns * sizeof(int64_t));
+      std::memcpy(values, rt.values.p, nruns * sizeof(uint32_t));
+      std::memcpy(bitoffs, rt.bitoffs.p, nruns * sizeof(int64_t));
+    }
+    if (pk.len > 0) std::memcpy(packed, pk.p, pk.len);
+    *packed_len = pk.len;
+  }
+  std::free(rt.kinds.p);
+  std::free(rt.counts.p);
+  std::free(rt.values.p);
+  std::free(rt.bitoffs.p);
+  std::free(pk.p);
+  return nruns;
 }
 
 // Result of one chunk walk. All pointers are malloc'd; free with

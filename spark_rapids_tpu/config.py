@@ -280,6 +280,25 @@ register("spark.rapids.cloudSchemes", "string", "s3,s3a,s3n,wasbs,gs,abfs,abfss"
 register("spark.rapids.sql.adaptive.enabled", "bool", False,
          "AQE analog: materialize each exchange stage, observe its row count, "
          "and re-run the override planning (and CBO) on the remaining plan.")
+register("spark.rapids.sql.adaptive.coalescePartitions.enabled", "bool", True,
+         "Under AQE, shrink a staged exchange's partition count toward "
+         "advisoryPartitionSizeInBytes using the OBSERVED stage size "
+         "(Spark's post-shuffle partition coalescing).")
+register("spark.rapids.sql.adaptive.advisoryPartitionSizeInBytes", "bytes",
+         64 << 20,
+         "Target size of one post-shuffle partition for AQE coalescing and "
+         "skew-join splitting.")
+register("spark.rapids.sql.adaptive.skewJoin.enabled", "bool", True,
+         "Under AQE, split a skewed probe-side hash partition of a staged "
+         "join into chunks joined pairwise against the matching build "
+         "partition (Spark's OptimizeSkewedJoin).")
+register("spark.rapids.sql.adaptive.skewJoin.skewedPartitionFactor",
+         "double", 5.0,
+         "A partition is skewed when its rows exceed this multiple of the "
+         "median partition's rows (and the row threshold).")
+register("spark.rapids.sql.adaptive.skewJoin.skewedPartitionRowThreshold",
+         "int", 100_000,
+         "Minimum rows before a partition can be considered skewed.")
 register("spark.rapids.sql.optimizer.enabled", "bool", False,
          "Cost-based optimizer: may move plan sections back to CPU to avoid "
          "transition thrash (reference CostBasedOptimizer).")

@@ -206,7 +206,8 @@ def _rle_runs(payload: memoryview, num_values: int, bit_width: int = 1):
     bit_width=1 is the def-level stream; dictionary index streams carry
     their width in the page payload's first byte (up to 32 bits).
 
-    The native scanner (native/src/rle_scan.cpp) runs when built — the
+    The native scanner (srtpu_rle_scan, native/src/chunk_walk.cpp) runs
+    when built — the
     python loop below is the fallback and the semantic spec."""
     from ..native import runtime as _native
     if _native.available():
@@ -890,8 +891,8 @@ def _device_phase(pf, rg: int, schema, works, nrows: int, host_cols=None):
                 flat.extend(w.defruns)
             flat.extend(w.ship)
         sig = tuple(_col_sig(w) for w in fused)
-        program = _fused_decode_program(sig, cap, nrows)
-        outs = program(*jax.device_put(flat))
+        program = _fused_decode_program(sig, cap)
+        outs = program(np.int64(nrows), *jax.device_put(flat))
         for w, (data, validity) in zip(fused, outs):
             fused_cols[w.name] = Column(w.dt, data, validity)
 
@@ -992,45 +993,6 @@ def _expand_indices(page: _Page, dict_count: int):
                           jnp.asarray(packed), row_bucket(page.ndef),
                           int(page.bw))[:page.ndef]
     return jnp.clip(idx, 0, max(dict_count - 1, 0))
-
-
-def _merged_dict_indices(pages, dict_count: int):
-    """All dict pages of a chunk -> ONE u32 device index stream [total].
-
-    The per-page path costs ~15 eager dispatches per page (search-sorted
-    expansion, clip, gather) — hundreds of ops (and tunnel RPCs) for a
-    many-page chunk. Pages whose index streams share a bit width merge
-    into one run table on host (cheap numpy concatenation; bit offsets
-    shift by each page's packed-blob base) and expand in ONE jitted call
-    per bit-width segment; bw only grows as the dictionary fills, so
-    segments are rare (typically one)."""
-    import jax.numpy as jnp
-    segs = []  # (bw, [pages]) with consecutive equal bw
-    for p in pages:
-        bw = 0 if p.payload is None else int(p.bw)
-        if segs and segs[-1][0] == bw:
-            segs[-1][1].append(p)
-        else:
-            segs.append((bw, [p]))
-    outs = []
-    for bw, ps in segs:
-        ndef = sum(p.ndef for p in ps)
-        if ndef == 0:
-            continue
-        if bw == 0:
-            outs.append(jnp.zeros(ndef, jnp.uint32))
-            continue
-        kinds, counts, values, bitoffs, packed = _merge_runs(
-            [p.payload for p in ps])
-        idx = _expand_rle_u32(
-            jnp.asarray(kinds), jnp.asarray(counts), jnp.asarray(values),
-            jnp.asarray(bitoffs), jnp.asarray(packed),
-            row_bucket(ndef), bw)[:ndef]
-        outs.append(idx)
-    if not outs:
-        return jnp.zeros(0, jnp.uint32)
-    merged = outs[0] if len(outs) == 1 else jnp.concatenate(outs)
-    return jnp.clip(merged, 0, max(dict_count - 1, 0))
 
 
 def _dict_segments(pages, dict_count: int):
@@ -1178,14 +1140,16 @@ def _col_sig(w):
 
 
 @functools.lru_cache(maxsize=256)
-def _fused_decode_program(sig_tuple, cap: int, nrows: int):
+def _fused_decode_program(sig_tuple, cap: int):
     """Build + jit the fused decoder for one structural signature.
-    Takes the flat array list in _device_phase's ship order and returns
-    (data, validity) per column."""
+    Takes the (traced) logical row count plus the flat array list in
+    _device_phase's ship order and returns (data, validity) per column.
+    nrows rides as a traced scalar so varied tail-row-group sizes share
+    one compiled program per (signature, capacity bucket)."""
     import jax
     import jax.numpy as jnp
 
-    def fn(*arrays):
+    def fn(nrows, *arrays):
         it = iter(arrays)
         outs = []
         for (kind, phys, post, flen, has_def, has_dict, dict_count,
@@ -1278,12 +1242,6 @@ def _assemble_fixed(chunk: _Chunk, phys: str, dt, defined, cap: int,
                 chunk.dict_raw, np_dt, count=chunk.dict_count))
         except ValueError as e:  # short dict blob: malformed, not a crash
             raise DeviceDecodeUnsupported(f"truncated dict page: {e}") from e
-    # fast path for the layouts parquet writers actually produce: a run
-    # of dict pages optionally followed by plain pages (the writer falls
-    # back to PLAIN exactly once, when the dictionary overflows). The
-    # dict prefix expands as ONE merged run table + ONE gather; the plain
-    # suffix ships as ONE host buffer — instead of ~15 eager dispatches
-    # (tunnel RPCs on the real chip) per page.
     def plain_values(p):
         if is_bool:
             return p.payload.astype(np.bool_)
@@ -1308,25 +1266,9 @@ def _assemble_fixed(chunk: _Chunk, phys: str, dt, defined, cap: int,
             data = data * 1000
         return Column(dt, data, validity)
 
-    kinds_seq = [p.kind for p in chunk.pages]
-    ndict = 0
-    while ndict < len(kinds_seq) and kinds_seq[ndict] == "dict":
-        ndict += 1
-    if chunk.pages and all(k == "plain" for k in kinds_seq[ndict:]):
-        pieces = []
-        if ndict:
-            if dict_vals is None:
-                raise DeviceDecodeUnsupported("dict page missing values")
-            dv = dict_vals[_merged_dict_indices(chunk.pages[:ndict],
-                                                chunk.dict_count)]
-            pieces.append(dv.astype(np.bool_) if is_bool else dv)
-        plain = [plain_values(p) for p in chunk.pages[ndict:]]
-        if plain:
-            pieces.append(jnp.asarray(np.concatenate(plain)))
-        vals = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
-        return finish(vals)
-
-    # arbitrary page interleavings (not seen from real writers, but legal)
+    # this eager assemble now serves only the page interleavings the
+    # fast-path prep declines (not seen from real writers, but legal) —
+    # uniform layouts ride the fused decode program instead
     parts = []
     host_run: List[np.ndarray] = []  # coalesce consecutive host parts
 
@@ -1378,40 +1320,25 @@ def _assemble_flba(chunk: _Chunk, spec: _ColSpec, dt, defined, cap: int):
             raise DeviceDecodeUnsupported(
                 f"truncated value page: {e}") from e
 
-    # dict-prefix + plain-suffix fast path (what real writers emit), with
-    # the general interleave as fallback — same shape as _assemble_fixed
-    kinds_seq = [p.kind for p in chunk.pages]
-    ndict = 0
-    while ndict < len(kinds_seq) and kinds_seq[ndict] == "dict":
-        ndict += 1
+    # serves only the page interleavings the fast-path prep declines —
+    # uniform layouts ride the fused decode program instead
     pieces = []
-    if chunk.pages and all(k == "plain" for k in kinds_seq[ndict:]):
-        if ndict:
+    host_run: List[np.ndarray] = []
+    for p in chunk.pages:
+        if p.ndef == 0:
+            continue
+        if p.kind == "plain":
+            host_run.append(plain_mat(p))
+        else:
             if dict_mat is None:
                 raise DeviceDecodeUnsupported("dict page missing values")
-            pieces.append(dict_mat[_merged_dict_indices(
-                chunk.pages[:ndict], chunk.dict_count)])
-        plain = [plain_mat(p) for p in chunk.pages[ndict:] if p.ndef]
-        if plain:
-            pieces.append(jnp.asarray(np.concatenate(plain)))
-    else:
-        host_run: List[np.ndarray] = []
-        for p in chunk.pages:
-            if p.ndef == 0:
-                continue
-            if p.kind == "plain":
-                host_run.append(plain_mat(p))
-            else:
-                if dict_mat is None:
-                    raise DeviceDecodeUnsupported(
-                        "dict page missing values")
-                if host_run:
-                    pieces.append(jnp.asarray(np.concatenate(host_run)))
-                    host_run.clear()
-                pieces.append(
-                    dict_mat[_expand_indices(p, chunk.dict_count)])
-        if host_run:
-            pieces.append(jnp.asarray(np.concatenate(host_run)))
+            if host_run:
+                pieces.append(jnp.asarray(np.concatenate(host_run)))
+                host_run.clear()
+            pieces.append(
+                dict_mat[_expand_indices(p, chunk.dict_count)])
+    if host_run:
+        pieces.append(jnp.asarray(np.concatenate(host_run)))
     if pieces:
         mat = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
     else:

@@ -1,17 +1,34 @@
 """Adaptive query execution analog (reference: AQE query stages re-planned
 per exchange, `GpuTransitionOverrides.optimizeAdaptiveTransitions`
-`GpuTransitionOverrides.scala:80`, `GpuCustomShuffleReaderExec.scala`).
+`GpuTransitionOverrides.scala:80`, `GpuCustomShuffleReaderExec.scala`,
+skew handling per Spark's OptimizeSkewedJoin).
 
 Spark's AQE materializes each shuffle stage, observes its statistics, and
-re-optimizes the remaining plan. The analog here: execute the deepest
-exchange's child as its own query stage, replace it with an in-memory scan
-carrying the OBSERVED rows, and re-run the override planning (including the
-cost-based optimizer, whose row estimates are now exact at that boundary).
-Loop until no unstaged exchange remains."""
+re-optimizes the remaining plan. The analog here, stage-at-a-time:
+
+  * execute the deepest exchange's child as its own query stage and
+    replace it with an in-memory scan carrying the OBSERVED rows, then
+    re-run the override planning (the CBO's row estimates are now exact
+    at that boundary);
+  * POST-SHUFFLE COALESCING: the staged scan's partition count shrinks
+    toward advisoryPartitionSizeInBytes using the observed stage bytes —
+    the staged table then streams as that many batches, so downstream
+    execs see coalesced partitions instead of the static count
+    (`GpuCustomShuffleReaderExec`'s CoalescedPartitionSpec);
+  * SKEW-JOIN SPLITTING: once both inputs of a hash join are staged,
+    hash-partition both by the join keys; a probe-side partition holding
+    far more than the median splits into chunks, each joined pairwise
+    against the matching build partition, and the results union — the
+    hot shard becomes N bounded sub-joins (OptimizeSkewedJoin's
+    PartialReducerPartitionSpec).
+
+Decisions are recorded on the session as `_adaptive_log` (explain/tests).
+"""
 
 from __future__ import annotations
 
 import copy
+import math
 
 from . import nodes as N
 
@@ -38,15 +55,185 @@ def _find_deepest_exchange(plan, staged: set):
     return None
 
 
+def _staged_scan(exch, table, conf, log):
+    """Replace a materialized exchange with an in-memory scan whose batch
+    granularity is the COALESCED partition count: ceil(observed bytes /
+    advisory size), never more than the static count."""
+    orig = getattr(exch.partitioning, "num_partitions", 1) or 1
+    slices = 1
+    if conf.get("spark.rapids.sql.adaptive.coalescePartitions.enabled"):
+        advisory = conf.get(
+            "spark.rapids.sql.adaptive.advisoryPartitionSizeInBytes")
+        want = max(1, math.ceil(table.nbytes / max(advisory, 1)))
+        slices = min(orig, want)
+        if slices != orig:
+            log.append({"rule": "coalescePartitions", "from": orig,
+                        "to": slices, "bytes": table.nbytes})
+    scan = N.CpuScanExec(table, label="query-stage", slices=slices)
+    scan.staged_partitioning = exch.partitioning
+    return scan
+
+
+def _hash_pids(table, key_names, key_types, num_partitions: int):
+    """Deterministic per-row partition ids over the key columns — ANY
+    function works for skew splitting as long as both join sides use the
+    same one (matching keys must land in matching partitions). The
+    columns therefore CANONICALIZE before hashing: both sides cast to
+    the shared arrow key types, and each column hashes through a
+    null-stable numpy representation — a raw to_pandas() hash would
+    diverge between sides when only one carries nulls (int64-with-null
+    becomes float64 and equal keys hash differently). Returns None when
+    a key type has no canonical form (caller skips the rewrite)."""
+    import pandas as pd
+    import pyarrow as pa
+    import pyarrow.compute as pc
+    import numpy as np
+    acc = np.zeros(table.num_rows, np.uint64)
+    for name, at in zip(key_names, key_types):
+        col = table.column(name)
+        if col.type != at:
+            try:
+                col = col.cast(at)
+            except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+                return None
+        col = col.combine_chunks() if isinstance(col, pa.ChunkedArray) \
+            else col
+        valid = np.asarray(pc.is_valid(col))
+        if pa.types.is_integer(at) or pa.types.is_date(at) or \
+                pa.types.is_timestamp(at) or pa.types.is_boolean(at):
+            vals = np.asarray(col.cast(pa.int64()).fill_null(0))
+        elif pa.types.is_floating(at):
+            vals = np.nan_to_num(
+                np.asarray(col.cast(pa.float64()).fill_null(0.0))) \
+                .view(np.uint64).astype(np.int64, copy=False)
+        elif pa.types.is_string(at) or pa.types.is_large_string(at):
+            vals = pd.util.hash_array(
+                np.asarray(col.fill_null("").to_pandas(), dtype=object)
+            ).astype(np.int64, copy=False)
+        else:
+            return None  # decimals/nested: no canonical form here
+        h = vals.astype(np.uint64, copy=False)
+        # null keys never match anything, but give them a stable slot
+        h = np.where(valid, h, np.uint64(0x9E3779B97F4A7C15))
+        acc = acc * np.uint64(31) + (h ^ (h >> np.uint64(33))) * \
+            np.uint64(0xFF51AFD7ED558CCD)
+    return (acc % np.uint64(num_partitions)).astype("int64")
+
+
+def _key_names(keys, schema):
+    """Join keys as plain column names, or None when any key is a
+    computed expression (skew handling then stays out of the way)."""
+    names = []
+    for k in keys:
+        name = getattr(k, "col_name", None)
+        if name is None or name not in schema.names:
+            return None
+        names.append(name)
+    return names
+
+
+# probe-side splitting is only sound when each LEFT row's output is
+# independent of the other left rows and no unmatched-RIGHT rows are
+# emitted (a per-chunk emission would duplicate them)
+_SPLITTABLE = {"inner", "left", "semi", "anti", "existence"}
+
+
+def _optimize_skew_joins(plan, conf, log):
+    """Rewrite hash joins over two staged scans whose probe side carries
+    a skewed partition into a union of bounded pair joins."""
+    plan.children = [_optimize_skew_joins(c, conf, log)
+                     for c in plan.children]
+    if not isinstance(plan, N.CpuHashJoinExec) or \
+            plan.join_type not in _SPLITTABLE or not plan.left_keys:
+        return plan
+    def staged_scan_of(node):
+        # a staged exchange is a pass-through wrapper over its scan
+        while isinstance(node, N.CpuShuffleExchangeExec) and node.children:
+            node = node.children[0]
+        if isinstance(node, N.CpuScanExec) and \
+                getattr(node, "staged_partitioning", None) is not None:
+            return node
+        return None
+
+    left = staged_scan_of(plan.children[0])
+    right = staged_scan_of(plan.children[1])
+    if left is None or right is None:
+        return plan
+    part = left.staged_partitioning
+    p = getattr(part, "num_partitions", 1) or 1
+    if p <= 1:
+        return plan
+    lnames = _key_names(plan.left_keys, left.output)
+    rnames = _key_names(plan.right_keys, right.output)
+    if lnames is None or rnames is None:
+        return plan
+    # both sides hash at the LEFT side's arrow key types so equal keys
+    # land in equal partitions regardless of each side's physical type
+    key_types = [left.table.schema.field(nm).type for nm in lnames]
+
+    import numpy as np
+    lpids = _hash_pids(left.table, lnames, key_types, p)
+    if lpids is None:
+        return plan
+    sizes = np.bincount(lpids, minlength=p)
+    factor = conf.get(
+        "spark.rapids.sql.adaptive.skewJoin.skewedPartitionFactor")
+    threshold = conf.get(
+        "spark.rapids.sql.adaptive.skewJoin.skewedPartitionRowThreshold")
+    median = float(np.median(sizes))
+    hot = [int(i) for i in np.nonzero(
+        (sizes > threshold) & (sizes > factor * max(median, 1.0)))[0]]
+    if not hot:
+        return plan
+
+    rpids = _hash_pids(right.table, rnames, key_types, p)
+    if rpids is None:
+        return plan
+    advisory = conf.get(
+        "spark.rapids.sql.adaptive.advisoryPartitionSizeInBytes")
+    row_bytes = max(left.table.nbytes / max(left.table.num_rows, 1), 1.0)
+    chunk_rows = max(int(advisory / row_bytes), 1)
+
+    def sub_join(ltbl, rtbl, label):
+        return N.CpuHashJoinExec(
+            N.CpuScanExec(ltbl, label=f"skew-{label}-probe"),
+            N.CpuScanExec(rtbl, label=f"skew-{label}-build"),
+            plan.left_keys, plan.right_keys, plan.join_type,
+            plan.condition)
+
+    rest_l = left.table.take(
+        np.nonzero(~np.isin(lpids, hot))[0])
+    rest_r = right.table.take(
+        np.nonzero(~np.isin(rpids, hot))[0])
+    joins = [sub_join(rest_l, rest_r, "rest")]
+    for pid in hot:
+        lp = left.table.take(np.nonzero(lpids == pid)[0])
+        rp = right.table.take(np.nonzero(rpids == pid)[0])
+        chunks = max(1, math.ceil(lp.num_rows / chunk_rows))
+        per = math.ceil(lp.num_rows / chunks)
+        for c in range(chunks):
+            joins.append(sub_join(lp.slice(c * per, per), rp,
+                                  f"p{pid}c{c}"))
+        log.append({"rule": "skewJoin", "partition": pid,
+                    "rows": int(sizes[pid]), "chunks": chunks,
+                    "median": median})
+    return N.CpuUnionExec(joins)
+
+
 def adaptive_execute(session, plan, use_device=None):
     """Stage-at-a-time execution; returns the final pyarrow Table."""
     plan = _clone_plan(plan)
     staged: set = set()
+    log: list = []
+    session._adaptive_log = log
+    conf = session.conf
     while True:
         exch = _find_deepest_exchange(plan, staged)
         if exch is None:
+            if conf.get("spark.rapids.sql.adaptive.skewJoin.enabled"):
+                plan = _optimize_skew_joins(plan, conf, log)
             return session._execute_rewritten(plan, use_device)
         stage_result = session._execute_rewritten(exch.children[0],
                                                   use_device)
-        exch.children = [N.CpuScanExec(stage_result, label="query-stage")]
+        exch.children = [_staged_scan(exch, stage_result, conf, log)]
         staged.add(id(exch))

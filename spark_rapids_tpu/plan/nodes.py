@@ -114,12 +114,16 @@ def _empty_vec(dt: T.DataType, shape: tuple = (0,)) -> Vec:
 
 
 class CpuScanExec(PhysicalPlan):
-    """In-memory Arrow table scan (file scans live in io/ and produce this shape)."""
+    """In-memory Arrow table scan (file scans live in io/ and produce this
+    shape). `slices` > 1 streams the table as that many row slices — the
+    AQE coalescer uses it so a staged exchange's output flows downstream
+    at the COALESCED partition granularity."""
 
-    def __init__(self, table, label: str = "memory"):
+    def __init__(self, table, label: str = "memory", slices: int = 1):
         super().__init__([])
         self.table = table
         self.label = label
+        self.slices = max(1, int(slices))
         self._schema = Schema.from_arrow(table.schema)
 
     @property
@@ -128,7 +132,14 @@ class CpuScanExec(PhysicalPlan):
 
     def execute_cpu(self):
         from ..cpu.hostbatch import host_batch_from_arrow
-        yield host_batch_from_arrow(self.table)
+        if self.slices == 1 or self.table.num_rows == 0:
+            yield host_batch_from_arrow(self.table)
+            return
+        per = -(-self.table.num_rows // self.slices)
+        for s in range(self.slices):
+            part = self.table.slice(s * per, per)
+            if part.num_rows:
+                yield host_batch_from_arrow(part)
 
     def _arg_string(self):
         return f"[{self.label}, {self.table.num_rows} rows]"
