@@ -1,0 +1,229 @@
+"""Bit-exact decimal -> float64 composition for the device string cast
+(reference `GpuCast.scala` castStringToFloat; round-5 verdict item 7).
+
+The parse loop (cast.py `_parse_float_device`) accumulates the mantissa
+as a 128-bit integer M (up to 38 significant digits exact, a sticky bit
+for any dropped nonzero tail) and a decimal exponent E. This module
+rounds M x 10^E to the nearest float64 with integer arithmetic only —
+the Eisel-Lemire shape, widened:
+
+  * 10^E is precomputed as a TRUNCATED 192-bit normalized significand P
+    with binary exponent B (10^E = P x 2^(B-191), 2^191 <= P < 2^192)
+    for E in [-360, 310], plus a per-entry sticky for the truncation;
+  * the full 128x192-bit product M_norm x P is computed exactly in u64
+    limbs (320 bits), so the only error is the power truncation
+    (< 2^-191 relative) and the >38-digit mantissa sticky (< 2^-126);
+  * the top 53 bits round with guard/sticky, subnormals keep fewer bits
+    (built by integer shifts, so XLA's subnormal-flush never applies),
+    overflow goes to +/-inf, and the bits assemble with the standard
+    carry-into-exponent trick before one bitcast.
+
+Exactness: correctly rounded for every input whose value is not within
+2^-125 relative of a rounding boundary — i.e. everything except decimal
+spellings that hit an EXACT tie between two doubles with more than 38
+significant digits or a truncated power (those round half-away instead
+of half-even; a deliberate construction, vanishingly improbable in
+data — the reference documents comparable float-parse incompat for its
+GPU text reads)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["compose_float64", "mul10_add", "POW10_MIN_E", "POW10_MAX_E"]
+
+# M carries up to 38 digits, so the smallest e10 that can still reach
+# the subnormal range is ~-(324+38); everything below composes to zero
+POW10_MIN_E = -365
+POW10_MAX_E = 310
+
+_TABLE = None
+
+
+def _build_table():
+    n = POW10_MAX_E - POW10_MIN_E + 1
+    p0 = np.zeros(n, np.uint64)  # least significant limb
+    p1 = np.zeros(n, np.uint64)
+    p2 = np.zeros(n, np.uint64)  # most significant limb (bit 191 set)
+    b = np.zeros(n, np.int32)
+    sticky = np.zeros(n, bool)
+    mask = (1 << 64) - 1
+    for i, e in enumerate(range(POW10_MIN_E, POW10_MAX_E + 1)):
+        if e >= 0:
+            v = 10 ** e
+            bl = v.bit_length()
+            if bl <= 192:
+                p = v << (192 - bl)
+                st = False
+            else:
+                p = v >> (bl - 192)
+                st = (v & ((1 << (bl - 192)) - 1)) != 0
+            be = bl - 1
+        else:
+            den = 10 ** (-e)
+            bl = den.bit_length()
+            num = 1 << (191 + bl)
+            p = num // den
+            st = (num % den) != 0
+            be = -bl
+        assert (1 << 191) <= p < (1 << 192), e
+        p0[i] = p & mask
+        p1[i] = (p >> 64) & mask
+        p2[i] = p >> 128
+        b[i] = be
+        sticky[i] = st
+    return p0, p1, p2, b, sticky
+
+
+def _table():
+    global _TABLE
+    if _TABLE is None:
+        _TABLE = _build_table()
+    return _TABLE
+
+
+def _u64(xp, x):
+    return x.astype(np.uint64)
+
+
+def _mulhilo(xp, a, b):
+    """u64 x u64 -> (hi, lo) exact, via 32-bit splits."""
+    m32 = np.uint64(0xFFFFFFFF)
+    s32 = np.uint64(32)
+    a0, a1 = a & m32, a >> s32
+    b0, b1 = b & m32, b >> s32
+    p00 = a0 * b0
+    p01 = a0 * b1
+    p10 = a1 * b0
+    p11 = a1 * b1
+    mid = (p00 >> s32) + (p01 & m32) + (p10 & m32)
+    lo = (p00 & m32) | (mid << s32)
+    hi = p11 + (p01 >> s32) + (p10 >> s32) + (mid >> s32)
+    return hi, lo
+
+
+def _clz64(xp, x):
+    """Count leading zeros of u64 (x == 0 -> 64), by binary search."""
+    n = xp.zeros(x.shape, np.uint64)
+    for shift in (32, 16, 8, 4, 2, 1):
+        s = np.uint64(shift)
+        big = (x >> s) != 0
+        n = xp.where(big, n, n + s)
+        x = xp.where(big, x >> s, x)
+    return xp.where(x == 0, np.uint64(64), n)
+
+
+def _shl128(xp, hi, lo, k):
+    """(hi, lo) << k for 0 <= k < 128 (per-element k as u64)."""
+    k = _u64(xp, k)
+    k64 = np.uint64(64)
+    small = k < k64
+    ks = xp.where(small, k, k - k64)
+    # shifts by 64 are avoided via the where-split; ks in [0, 64)
+    inv = xp.where(ks == 0, np.uint64(0), k64 - ks)
+    carry = xp.where(ks == 0, xp.zeros_like(lo), lo >> inv)
+    hi_s = (hi << ks) | carry
+    lo_s = lo << ks
+    return (xp.where(small, hi_s, lo << ks),
+            xp.where(small, lo_s, xp.zeros_like(lo)))
+
+
+def mul10_add(xp, hi, lo, d):
+    """(hi, lo) * 10 + d in 128-bit (d: u64 digit)."""
+    chi, clo = _mulhilo(xp, lo, xp.full(lo.shape, 10, np.uint64))
+    nhi = hi * np.uint64(10) + chi
+    nlo = clo + d
+    nhi = nhi + (nlo < d).astype(np.uint64)
+    return nhi, nlo
+
+
+def compose_float64(xp, mhi, mlo, sticky_digits, e10, neg):
+    """Round M x 10^E to float64 bits (see module docstring).
+    mhi/mlo: u64 limbs of M; sticky_digits: bool, nonzero digits were
+    dropped past 38; e10: int32 decimal exponent; neg: bool sign.
+    Returns f64 values (M == 0 composes signed zero; the caller layers
+    nan/inf words and validity)."""
+    zero = (mhi == np.uint64(0)) & (mlo == np.uint64(0))
+    under = e10 < POW10_MIN_E
+    over = e10 > POW10_MAX_E
+    idx = xp.clip(e10 - POW10_MIN_E, 0,
+                  POW10_MAX_E - POW10_MIN_E).astype(np.int32)
+    p0t, p1t, p2t, bt, st = _table()
+    b0 = xp.asarray(p0t)[idx]
+    b1 = xp.asarray(p1t)[idx]
+    b2 = xp.asarray(p2t)[idx]
+    pb = xp.asarray(bt)[idx]
+    psticky = xp.asarray(st)[idx]
+
+    # normalize M to [2^127, 2^128)
+    lzh = _clz64(xp, mhi)
+    lz = xp.where(mhi == 0, np.uint64(64) + _clz64(xp, mlo), lzh)
+    lz = xp.where(zero, np.uint64(0), lz)
+    a1, a0 = _shl128(xp, mhi, mlo, lz)
+
+    # exact 128 x 192 multiply -> 320-bit R in limbs r0..r4 (LE)
+    r = [xp.zeros_like(mlo) for _ in range(5)]
+
+    def add_at(k, val):
+        for i in range(k, 5):
+            r[i] = r[i] + val
+            carry = (r[i] < val).astype(np.uint64)
+            if i + 1 == 5:
+                break
+            val = carry
+            # stop propagating when no carry (values stay correct: adding
+            # zero is a no-op, so the loop simply continues cheaply)
+
+    for i, a in ((0, a0), (1, a1)):
+        for j, bb in ((0, b0), (1, b1), (2, b2)):
+            hi, lo = _mulhilo(xp, a, bb)
+            add_at(i + j, lo)
+            add_at(i + j + 1, hi)
+
+    # normalize R to bit 319 (R in [2^318, 2^320) for nonzero M)
+    top = (r[4] >> np.uint64(63)) & np.uint64(1)
+    s = np.uint64(1) - top  # 0 or 1
+    r4n = xp.where(s == 1,
+                   (r[4] << np.uint64(1)) | (r[3] >> np.uint64(63)),
+                   r[4])
+    sticky_low = ((r[0] | r[1] | r[2] | r[3]) != 0) | psticky | \
+        sticky_digits
+
+    # binary exponent: value = (r4n/2^63 ...) x 2^e2 with 1.xxx mantissa
+    e2 = np.int32(128) + pb - lz.astype(np.int32) - s.astype(np.int32)
+    biased = e2 + np.int32(1023)
+
+    # subnormal: keep fewer bits; k extra shift (0 for normal)
+    k = xp.clip(np.int32(1) - biased, 0, 120).astype(np.uint64)
+    sh = np.uint64(11) + k          # bits dropped from r4n
+    shc = xp.clip(sh, None, np.uint64(63))
+    mant = xp.where(sh > np.uint64(63), xp.zeros_like(r4n), r4n >> shc)
+    g_pos = sh - np.uint64(1)
+    g_posc = xp.clip(g_pos, None, np.uint64(63))
+    guard = xp.where(g_pos > np.uint64(63), xp.zeros_like(r4n),
+                     (r4n >> g_posc) & np.uint64(1))
+    below_mask = xp.where(
+        g_pos > np.uint64(63), ~xp.zeros_like(r4n),
+        (np.uint64(1) << g_posc) - np.uint64(1))
+    sticky = sticky_low | ((r4n & below_mask) != 0)
+    mant = mant + (guard & (sticky.astype(np.uint64) |
+                            (mant & np.uint64(1))))
+
+    biased_c = xp.maximum(biased, np.int32(0))
+    # normal numbers carry an implicit leading 1 in mant (53 bits);
+    # bits = (biased << 52) + (mant - 2^52); a rounding carry to 2^53
+    # lands in the exponent field automatically. Subnormal mant (< 2^52,
+    # no implicit bit) adds onto exponent field 0 the same way.
+    adj = xp.where(biased > 0, mant - (np.uint64(1) << np.uint64(52)),
+                   mant)
+    bits = (biased_c.astype(np.uint64) << np.uint64(52)) + adj
+    inf_bits = np.uint64(0x7FF0000000000000)
+    bits = xp.where((biased >= np.int32(2047)) |
+                    (bits >= inf_bits), inf_bits, bits)
+    bits = xp.where(zero | under, xp.zeros_like(bits), bits)
+    bits = xp.where(over & ~zero, inf_bits, bits)
+    bits = bits | (neg.astype(np.uint64) << np.uint64(63))
+    if xp is np:
+        return bits.view(np.float64)
+    import jax
+    return jax.lax.bitcast_convert_type(bits, np.float64)

@@ -66,8 +66,8 @@ def _tag_cast(meta: ExprMeta) -> None:
     if meta.conf.is_ansi:
         # numeric<->numeric and decimal ANSI casts report overflow, and
         # string-parse casts report malformed input, via the kernel error
-        # flags; string->float is the one remaining fallback (its device
-        # parse is ~1 ulp off the JVM, see device_supported)
+        # flags; string->float now parses bit-exactly on device
+        # (expr/floatparse.py), closing the last cast fallback
         def plain_numeric(dt):
             return T.is_integral(dt) or T.is_floating(dt) or \
                 isinstance(dt, T.BooleanType)
@@ -75,7 +75,7 @@ def _tag_cast(meta: ExprMeta) -> None:
         ok = ok or isinstance(src, T.DecimalType) or \
             isinstance(e.to, T.DecimalType)
         ok = ok or (isinstance(src, T.StringType) and
-                    (T.is_integral(e.to) or
+                    (T.is_integral(e.to) or T.is_floating(e.to) or
                      isinstance(e.to, (T.BooleanType, T.DateType))))
         if not ok:
             meta.will_not_work(
