@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Run the deterministic fault-injection matrix (tests marked `faults`).
+#
+# The matrix drives full queries and subsystem flows through every named
+# injection point in spark_rapids_tpu/faults.py (alloc OOM, spill I/O,
+# shuffle corruption, peer death, TCP reset/delay, admission timeout,
+# wedged backend) and asserts the documented recovery contract. Schedules
+# are seeded (SRTPU_FAULT_SEED, default 42) so failures reproduce exactly.
+#
+# The same tests run as part of tier-1 (`-m 'not slow'`); this script is
+# the focused entry point for CI shards and local debugging.
+#
+# Usage: scripts/fault_matrix.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+SEED="${SRTPU_FAULT_SEED:-42}"
+TIMEOUT="${SRTPU_FAULT_TIMEOUT:-600}"
+
+exec timeout -k 10 "$TIMEOUT" env \
+    JAX_PLATFORMS=cpu \
+    SPARK_RAPIDS_TPU_TEST_FAULTS_SEED="$SEED" \
+    python -m pytest tests/test_faults.py -m faults -q \
+    -p no:cacheprovider "$@"

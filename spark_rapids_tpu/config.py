@@ -142,6 +142,14 @@ register("spark.rapids.sql.test.injectRetryOOM", "int", 0,
 register("spark.rapids.sql.test.injectSplitAndRetryOOM", "int", 0,
          "Fault injection: force a SplitAndRetryOOM on the Nth tracked allocation.",
          internal=True)
+register("spark.rapids.tpu.test.faults", "string", "",
+         "Fault-injection rule specs, ';'-separated `point:kind,k=v...` "
+         "(see faults.py for the point catalog and grammar). Installed by "
+         "TpuSession.initialize_device; empty disables injection.",
+         internal=True)
+register("spark.rapids.tpu.test.faults.seed", "int", 42,
+         "Seed for probabilistic fault-injection rules, so fault schedules "
+         "are reproducible.", internal=True)
 
 # Memory runtime --------------------------------------------------------------------
 register("spark.rapids.memory.gpu.allocFraction", "double", 0.9,
@@ -190,6 +198,17 @@ register("spark.rapids.shuffle.multiThreaded.reader.threads", "int", 4,
 register("spark.rapids.shuffle.compression.codec", "string", "zstd",
          "Batch compression codec for shuffle buffers: none, zstd, lz4xla (native).",
          check_values=("none", "zstd", "lz4xla"))
+register("spark.rapids.shuffle.checksum.enabled", "bool", True,
+         "Frame every shuffle block with a CRC32C over its payload, verified "
+         "on fetch; a corrupt frame raises ShuffleCorruptionError and is "
+         "refetched once before failing the task.")
+register("spark.rapids.shuffle.fetch.maxRetries", "int", 3,
+         "Retries per peer for a failed remote shuffle fetch (exponential "
+         "backoff between attempts) before failing over to another live "
+         "peer or raising ShuffleFetchFailedError.")
+register("spark.rapids.shuffle.fetch.retryWaitMs", "int", 10,
+         "Base backoff between shuffle fetch retries; attempt k waits "
+         "2^k times this (capped at 1s).")
 register("spark.rapids.shuffle.ici.chunkBytes", "bytes", 64 << 20,
          "Per-step all-to-all chunk size over ICI.")
 register("spark.rapids.shuffle.ici.slotRows", "int", 0,

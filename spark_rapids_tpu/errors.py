@@ -62,3 +62,48 @@ class AnsiViolation(RapidsTpuError):
     def __init__(self, message: str):
         super().__init__(message)
         self.message = message
+
+
+class InjectedFault(RapidsTpuError, IOError):
+    """Raised by the fault-injection subsystem (faults.py) when a rule fires
+    without a more specific exception type configured. Also an IOError so
+    injection points on I/O seams are caught by existing handlers."""
+
+
+class ShuffleCorruptionError(RapidsTpuError):
+    """A shuffle block frame failed its CRC32C integrity check (or its
+    framing was unreadable). Carries the block and where the bytes came from;
+    the fetch path refetches once before letting this fail the task."""
+
+    def __init__(self, message: str, block=None, source: str = ""):
+        super().__init__(message)
+        self.block = block
+        self.source = source
+
+
+class ShuffleFetchFailedError(RapidsTpuError):
+    """A remote shuffle fetch exhausted its retry budget (and any failover
+    peers). Carries peer/block diagnostics for the task-level error report
+    (the reference's RapidsShuffleFetchFailedException analog)."""
+
+    def __init__(self, message: str, peer: str = "", blocks=(),
+                 attempts: int = 0, cause: Exception = None):
+        super().__init__(message)
+        self.peer = peer
+        self.blocks = tuple(blocks)
+        self.attempts = attempts
+        self.cause = cause
+
+
+class AdmissionTimeoutError(RapidsTpuError, TimeoutError):
+    """The device-service admission semaphore did not grant a token within
+    the requested timeout. Carries the server's held/waiting diagnostics
+    (GpuSemaphore contention made visible). Also a TimeoutError so callers
+    written against the old stringly reply keep working."""
+
+    def __init__(self, message: str, held: int = -1, waiting: int = -1,
+                 timeout_s=None):
+        super().__init__(message)
+        self.held = held
+        self.waiting = waiting
+        self.timeout_s = timeout_s

@@ -731,8 +731,17 @@ class TpuHashAggregateExec(UnaryTpuExec):
                     yield self._count_output(out)
             return
         if len(batches) == 1:
-            with self.agg_time.timed():
-                out = self._run(self._kernel, batches[0])
+            from ..errors import SplitAndRetryOOM
+            from ..memory.retry import with_retry_no_split_spillable
+            try:
+                with self.agg_time.timed():
+                    out = with_retry_no_split_spillable(
+                        batches[0], lambda b: self._run(self._kernel, b))
+            except SplitAndRetryOOM:
+                # one batch too big to aggregate in a single device pass:
+                # the multi-batch partial/merge/final machinery splits it
+                yield from self._multi_batch(batches)
+                return
             self.num_output_rows.add(out.row_count())
             yield self._count_output(out)
             return

@@ -54,7 +54,11 @@ class TpuBroadcastExchangeExec(UnaryTpuExec):
                 batch = concat_batches(batches)
                 del batches
                 codec = self.conf.get("spark.rapids.shuffle.compression.codec")
-                self._blob = serialize_batch(batch, codec)
+                from ..shuffle.codec import checksum_supported
+                self._blob = serialize_batch(
+                    batch, codec, checksum=checksum_supported()
+                    and self.conf.get(
+                        "spark.rapids.shuffle.checksum.enabled"))
             self.data_size.add(len(self._blob))
 
     def do_execute(self) -> Iterator[ColumnarBatch]:
@@ -62,7 +66,9 @@ class TpuBroadcastExchangeExec(UnaryTpuExec):
         if self._empty:
             return
         from ..shuffle.serializer import concat_host_tables, deserialize_table
-        table, _ = deserialize_table(self._blob)
+        # verify=False: the blob was serialized in this process and never
+        # left memory; re-hashing it for every consuming task buys nothing
+        table, _ = deserialize_table(self._blob, verify=False)
         out = concat_host_tables([table])
         self.num_output_rows.add(out.row_count())
         yield self._count_output(out)

@@ -105,11 +105,11 @@ class TpuShuffleExchangeExec(UnaryTpuExec):
         batch = concat_batches(batches)
         part = make_partitioner(self.spec, self.child.output, batch)
         n_parts = part.num_partitions
+        if mode in ("MULTITHREADED", "CACHE_ONLY") and n_parts > 1:
+            yield from self._shuffle_via_manager(batch, part, n_parts, mode)
+            return
         with self.partition_time.timed():
             pid = part.ids_for_batch(jnp, batch)
-        if mode in ("MULTITHREADED", "CACHE_ONLY") and n_parts > 1:
-            yield from self._shuffle_via_manager(batch, pid, n_parts, mode)
-            return
         # ICI mode in-process: device-resident slicing (the distributed data
         # plane is the compiled all_to_all in parallel/collective.py)
         for p in range(n_parts):
@@ -120,29 +120,62 @@ class TpuShuffleExchangeExec(UnaryTpuExec):
             self.num_output_rows.add(out.row_count())
             yield self._count_output(out)
 
-    def _shuffle_via_manager(self, batch, pid, n_parts, mode):
+    def _shuffle_via_manager(self, batch, part, n_parts, mode):
         """Write every partition through the shuffle manager (serialize/
         compress on writer threads or device-resident cache), then read each
         reduce partition back — the full reference write/read path
-        (`RapidsShuffleInternalManagerBase` getWriter/getReader), in-process."""
+        (`RapidsShuffleInternalManagerBase` getWriter/getReader), in-process.
+
+        The write side runs under the OOM-retry seam: memory pressure while
+        slicing/serializing splits the input and writes each piece under its
+        own map id (the read side concats across map ids, so more, smaller
+        map outputs are transparent). A failed attempt discards its partial
+        map output before retrying — rows land exactly once."""
+        import itertools
+        from ..memory.budget import MemoryBudget
+        from ..memory.retry import split_batch_halves, with_retry
+        from ..memory.spillable import SpillableColumnarBatch
         from ..shuffle.manager import TpuShuffleManager, next_shuffle_id
         mgr = TpuShuffleManager.get(self.conf)
         codec = self.conf.get("spark.rapids.shuffle.compression.codec")
         sid = next_shuffle_id()
-        writer = mgr.get_writer(sid, map_id=0, mode=mode, codec=codec)
-        try:
+        next_map = itertools.count()
+
+        def write_piece(sp: SpillableColumnarBatch) -> int:
+            MemoryBudget.get().reserve(0)  # pre-flight / injection point
+            b = sp.get_batch()
+            mid = next(next_map)
+            writer = mgr.get_writer(sid, map_id=mid, mode=mode, codec=codec)
             try:
-                for p in range(n_parts):
+                try:
                     with self.partition_time.timed():
-                        out = _slice_partition(batch, pid, p)
-                    if int(out.row_count()) == 0:
-                        continue
-                    writer.write(p, out)
+                        pid = part.ids_for_batch(jnp, b)
+                    for p in range(n_parts):
+                        with self.partition_time.timed():
+                            out = _slice_partition(b, pid, p)
+                        if int(out.row_count()) == 0:
+                            continue
+                        writer.write(p, out)
+                finally:
+                    # drain in-flight writer futures BEFORE any cleanup — a
+                    # late store.put after cleanup would leak blocks forever
+                    # in the process-singleton store
+                    writer.close()
+            except BaseException:
+                mgr.discard_map_output(sid, mid, n_parts)
+                raise
+            sp.close()
+            return mid
+
+        try:
+            sp0 = SpillableColumnarBatch(batch)
+            # hand ownership to the spillable wrapper so a spill during the
+            # OOM-retry loop can actually free the device arrays
+            del batch
+            try:
+                list(with_retry(sp0, write_piece, split_batch_halves))
             finally:
-                # drain in-flight writer futures BEFORE any unregister — a
-                # late store.put after cleanup would leak blocks forever in
-                # the process-singleton store
-                writer.close()
+                sp0.close()  # no-op on success (write_piece closed it)
             # release=True drops each partition's blocks as they are consumed,
             # bounding block-store retention to one partition at a time
             for p in range(n_parts):

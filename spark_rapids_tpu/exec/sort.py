@@ -79,8 +79,19 @@ class TpuSortExec(UnaryTpuExec):
         if len(batches) > 1 and total > self.conf.batch_size_rows:
             yield from self._out_of_core(batches)
             return
-        merged = concat_batches(batches)
-        out = self.sort_single_batch(merged)
+        from ..errors import SplitAndRetryOOM
+        from ..memory.retry import with_retry_no_split_spillable
+        try:
+            # the merged copy is passed as a temporary: the spillable wrapper
+            # takes the only reference, so a spill under pressure frees it
+            out = with_retry_no_split_spillable(concat_batches(batches),
+                                                self.sort_single_batch)
+        except SplitAndRetryOOM:
+            # too big to sort in one device pass: the out-of-core merge
+            # sorts arbitrary sub-batches into runs and merges globally,
+            # so splitting degrades instead of dying
+            yield from self._out_of_core(batches)
+            return
         self.num_output_rows.add(out.row_count())
         yield self._count_output(out)
 
@@ -104,15 +115,31 @@ class TpuSortExec(UnaryTpuExec):
 
     def _out_of_core(self, batches: List[ColumnarBatch]
                      ) -> Iterator[ColumnarBatch]:
+        from ..memory.budget import MemoryBudget
+        from ..memory.retry import split_batch_halves, with_retry
         from ..memory.spillable import SpillableColumnarBatch
-        # phase 1: device-sort each batch into a run; park spillable
+
+        def run_sort(sp: SpillableColumnarBatch) -> ColumnarBatch:
+            MemoryBudget.get().reserve(0)  # pre-flight / injection point
+            out = self.sort_single_batch(sp.get_batch())
+            sp.close()
+            return out
+
+        # phase 1: device-sort each batch into a run; park spillable. Each
+        # batch sorts under the OOM-retry seam — a split just yields more,
+        # smaller runs, which the global key merge below handles unchanged.
         runs: List[SpillableColumnarBatch] = []
         host_keys: List[List[np.ndarray]] = []
         with self.sort_time.timed():
             for b in batches:
-                sorted_b = self.sort_single_batch(b)
-                host_keys.append(self._host_key_groups(sorted_b))
-                runs.append(SpillableColumnarBatch(sorted_b))
+                sp0 = SpillableColumnarBatch(b)
+                try:
+                    for sorted_b in with_retry(sp0, run_sort,
+                                               split_batch_halves):
+                        host_keys.append(self._host_key_groups(sorted_b))
+                        runs.append(SpillableColumnarBatch(sorted_b))
+                finally:
+                    sp0.close()  # no-op on success (run_sort closed it)
 
             # phase 2: host merge of the key streams (keys only; payload
             # stays on device inside the spill catalog)

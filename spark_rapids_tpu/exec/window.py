@@ -298,11 +298,26 @@ class TpuWindowExec(UnaryTpuExec):
         batches = list(self.child.execute())
         if not batches:
             return
-        merged = concat_batches(batches)
+        from ..memory.retry import with_retry_no_split_spillable
         from .base import raise_kernel_errors
-        with self.window_time.timed():
-            out, errs = self._kernel(merged)
-        raise_kernel_errors(errs, self._err_msgs)
+
+        def run(b: ColumnarBatch) -> ColumnarBatch:
+            # retry-only (no split): an arbitrary row split would sever
+            # window partitions — frames span a whole partition — so memory
+            # pressure here spills/blocks and re-runs instead of splitting
+            with self.window_time.timed():
+                out, errs = self._kernel(b)
+            raise_kernel_errors(errs, self._err_msgs)
+            return out
+
+        # full ownership transfer: popping from the holder hands the source
+        # list to concat (freed as soon as the copy exists) and the merged
+        # temporary is owned solely by the spillable wrapper — nothing in
+        # this frame pins device memory while the retry seam spills
+        holder = [batches]
+        del batches
+        out = with_retry_no_split_spillable(
+            concat_batches(holder.pop()), run)
         self.num_output_rows.add(out.row_count())
         yield self._count_output(out)
 

@@ -1,0 +1,307 @@
+"""Deterministic fault-injection subsystem.
+
+The reference bakes injection hooks into its memory runtime
+(`RapidsConf.scala:1250` injectRetryOOM counters consumed by RmmSpark) and
+drives its shuffle client/server suites through a mocked transport that can
+drop, delay, and corrupt traffic (`RapidsShuffleTestHelper.scala`). This
+module generalizes both: NAMED INJECTION POINTS registered at the engine's
+seams, each programmable with a seeded, deterministic schedule, so a full
+query can be driven through any failure an operator will meet in production
+and the recovery path asserted — not assumed.
+
+Injection points (the catalog; call sites reference these constants):
+
+  memory.alloc        memory/budget.py     pre-flight device reservation
+  spill.write         memory/catalog.py    host->disk spill file write
+  spill.read          memory/catalog.py    disk->host unspill read
+  shuffle.block.write shuffle/manager.py   block store put
+  shuffle.block.read  shuffle/manager.py   block store get (corruptible)
+  shuffle.fetch       shuffle/transport.py client fetch_range (corruptible)
+  tcp.send            shuffle/tcp_transport.py request send
+  tcp.recv            shuffle/tcp_transport.py reply receive
+  service.admission   service/server.py    admission token grant
+  device.init         memory/device_manager.py backend first touch
+
+A rule fires on the Nth eligible call (`nth`), or with seeded probability
+(`probability`), at most `times` times (0 = unlimited). Kinds:
+
+  error    raise `error` (class or instance; default InjectedFault)
+  delay    sleep `delay_s`, then proceed
+  corrupt  pass the payload through `corrupt_fn` (default: flip one byte)
+  wedge    sleep `delay_s` (default 3600s) — simulates a hang; the caller's
+           deadline machinery must convert it into a typed error
+
+Rules come from the scoped `inject(...)` context manager (tests) or from
+`spark.rapids.tpu.test.faults` (config spec, see `FaultRule.parse`), with
+`spark.rapids.tpu.test.faults.seed` seeding the probability coin. When no
+rule is installed the per-call overhead is one module-global bool check.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import random
+import threading
+from typing import Callable, Dict, List, Optional
+
+from .errors import InjectedFault, RetryOOM, SplitAndRetryOOM
+
+__all__ = ["FaultRule", "FaultInjector", "fire", "inject",
+           "install_from_conf", "ALL_POINTS",
+           "ALLOC", "SPILL_WRITE", "SPILL_READ", "BLOCK_WRITE", "BLOCK_READ",
+           "FETCH", "TCP_SEND", "TCP_RECV", "ADMISSION", "DEVICE_INIT"]
+
+ALLOC = "memory.alloc"
+SPILL_WRITE = "spill.write"
+SPILL_READ = "spill.read"
+BLOCK_WRITE = "shuffle.block.write"
+BLOCK_READ = "shuffle.block.read"
+FETCH = "shuffle.fetch"
+TCP_SEND = "tcp.send"
+TCP_RECV = "tcp.recv"
+ADMISSION = "service.admission"
+DEVICE_INIT = "device.init"
+
+ALL_POINTS = (ALLOC, SPILL_WRITE, SPILL_READ, BLOCK_WRITE, BLOCK_READ,
+              FETCH, TCP_SEND, TCP_RECV, ADMISSION, DEVICE_INIT)
+
+# named exception factories for the config-spec grammar
+_ERROR_NAMES: Dict[str, Callable[[str], Exception]] = {
+    "fault": InjectedFault,
+    "io": IOError,
+    "conn": ConnectionResetError,
+    "key": KeyError,
+    "oom": RetryOOM,
+    "splitoom": SplitAndRetryOOM,
+}
+
+# flipped on install/clear so disabled-path fire() costs one bool check
+_ACTIVE = False
+
+
+def _default_corrupt(payload):
+    """Flip one byte in the middle of the payload (bytes-like)."""
+    if payload is None or len(payload) == 0:
+        return payload
+    buf = bytearray(payload)
+    buf[len(buf) // 2] ^= 0xFF
+    return bytes(buf)
+
+
+@dataclasses.dataclass
+class FaultRule:
+    """One programmable fault schedule at one injection point."""
+
+    kind: str = "error"            # error | delay | corrupt | wedge
+    nth: int = 1                   # fire on the Nth eligible call (1-based);
+    #                                0 = every call (subject to `times`)
+    probability: float = 0.0       # alternative trigger: seeded coin flip
+    times: int = 1                 # max fires (0 = unlimited)
+    error: object = None           # exception class/instance for kind=error
+    delay_s: float = 0.0           # sleep for delay/wedge
+    corrupt_fn: Optional[Callable] = None
+    point: str = ""                # set on install (diagnostics)
+    calls: int = 0                 # eligible calls observed
+    fired: int = 0                 # times this rule actually fired
+
+    def _should_fire(self, rng: random.Random) -> bool:
+        if self.times and self.fired >= self.times:
+            return False
+        if self.probability > 0.0:
+            return rng.random() < self.probability
+        if self.nth == 0:
+            return True
+        return self.calls == self.nth
+
+    def _make_error(self) -> Exception:
+        err = self.error
+        if err is None:
+            return InjectedFault(
+                f"injected fault at {self.point} (call #{self.calls})")
+        if isinstance(err, Exception):
+            return err
+        return err(f"injected {getattr(err, '__name__', err)} at "
+                   f"{self.point} (call #{self.calls})")
+
+    @staticmethod
+    def parse(spec: str) -> "FaultRule":
+        """Parse one `point:kind[,k=v...]` rule; returns the rule with
+        `.point` set. Grammar (comma-separated after the kind):
+          nth=N  p=F  times=N  delay=F  err=fault|io|conn|key|oom|splitoom
+        Examples: `shuffle.fetch:error,nth=2,err=conn`
+                  `shuffle.block.read:corrupt,nth=1`
+                  `tcp.recv:delay,nth=0,times=0,delay=0.01`
+                  `service.admission:wedge,delay=5`."""
+        point, _, rest = spec.strip().partition(":")
+        if not point or not rest:
+            raise ValueError(f"bad fault spec {spec!r} (want point:kind,...)")
+        parts = rest.split(",")
+        rule = FaultRule(kind=parts[0].strip(), point=point)
+        if rule.kind not in ("error", "delay", "corrupt", "wedge"):
+            raise ValueError(f"unknown fault kind {rule.kind!r} in {spec!r}")
+        if rule.kind == "wedge" and rule.delay_s == 0.0:
+            rule.delay_s = 3600.0
+        for kv in parts[1:]:
+            k, _, v = kv.strip().partition("=")
+            if k == "nth":
+                rule.nth = int(v)
+            elif k == "p":
+                rule.probability = float(v)
+            elif k == "times":
+                rule.times = int(v)
+            elif k == "delay":
+                rule.delay_s = float(v)
+            elif k == "err":
+                if v not in _ERROR_NAMES:
+                    raise ValueError(f"unknown fault error name {v!r}")
+                rule.error = _ERROR_NAMES[v]
+            else:
+                raise ValueError(f"unknown fault rule field {k!r} in {spec!r}")
+        return rule
+
+
+class FaultInjector:
+    """Process-wide registry of installed fault rules."""
+
+    _instance: Optional["FaultInjector"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, seed: int = 42):
+        self._rules: Dict[str, List[FaultRule]] = {}
+        self._rng = random.Random(seed)
+        self._mu = threading.Lock()
+
+    @classmethod
+    def get(cls) -> "FaultInjector":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = FaultInjector()
+            return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        global _ACTIVE
+        with cls._lock:
+            cls._instance = None
+            _ACTIVE = False
+
+    def reseed(self, seed: int) -> None:
+        with self._mu:
+            self._rng = random.Random(seed)
+
+    # ------------------------------------------------------------------
+    def install(self, point: str, rule: FaultRule) -> FaultRule:
+        global _ACTIVE
+        rule.point = point
+        with self._mu:
+            self._rules.setdefault(point, []).append(rule)
+            _ACTIVE = True
+        return rule
+
+    def remove(self, point: str, rule: FaultRule) -> None:
+        global _ACTIVE
+        with self._mu:
+            rules = self._rules.get(point, [])
+            if rule in rules:
+                rules.remove(rule)
+            if not rules:
+                self._rules.pop(point, None)
+            if not self._rules:
+                _ACTIVE = False
+
+    def clear(self, point: Optional[str] = None) -> None:
+        global _ACTIVE
+        with self._mu:
+            if point is None:
+                self._rules.clear()
+            else:
+                self._rules.pop(point, None)
+            if not self._rules:
+                _ACTIVE = False
+
+    def stats(self, point: str):
+        """(eligible_calls, fires) summed over the point's rules."""
+        with self._mu:
+            rules = self._rules.get(point, [])
+            return (sum(r.calls for r in rules),
+                    sum(r.fired for r in rules))
+
+    # ------------------------------------------------------------------
+    def _fire(self, point: str, payload):
+        sleeps: List[float] = []
+        raise_err: Optional[Exception] = None
+        with self._mu:
+            for rule in self._rules.get(point, []):
+                rule.calls += 1
+                if not rule._should_fire(self._rng):
+                    continue
+                rule.fired += 1
+                if rule.kind in ("delay", "wedge"):
+                    sleeps.append(rule.delay_s)
+                elif rule.kind == "corrupt":
+                    fn = rule.corrupt_fn or _default_corrupt
+                    payload = fn(payload)
+                elif raise_err is None:
+                    raise_err = rule._make_error()
+        # sleeps outside the lock: a wedge must not block other points
+        if sleeps:
+            import time
+            for s in sleeps:
+                time.sleep(s)
+        if raise_err is not None:
+            raise raise_err
+        return payload
+
+
+def fire(point: str, payload=None):
+    """Injection-point call site hook: returns the (possibly corrupted)
+    payload, sleeps, or raises, per the installed rules. Near-free when no
+    rules are installed."""
+    if not _ACTIVE:
+        return payload
+    return FaultInjector.get()._fire(point, payload)
+
+
+@contextlib.contextmanager
+def inject(point: str, kind: str = "error", **kw):
+    """Scoped rule installation for tests:
+        with inject(faults.FETCH, "error", nth=1, error=ConnectionResetError):
+            ... run query ...
+    Yields the rule so callers can assert `.fired`/`.calls`."""
+    if kind == "wedge" and "delay_s" not in kw:
+        kw["delay_s"] = 3600.0
+    inj = FaultInjector.get()
+    rule = inj.install(point, FaultRule(kind=kind, **kw))
+    try:
+        yield rule
+    finally:
+        inj.remove(point, rule)
+
+
+# rules installed by install_from_conf, so the next call (a new session in
+# the same process) replaces rather than accumulates them — two sessions
+# with the same spec must not double a rule's fire budget
+_CONF_RULES: List[FaultRule] = []
+
+
+def install_from_conf(conf) -> List[FaultRule]:
+    """Install rules from `spark.rapids.tpu.test.faults` (`;`-separated
+    rule specs) with the seed from `spark.rapids.tpu.test.faults.seed`,
+    REPLACING any rules a previous call installed (an empty spec therefore
+    clears them). Returns the installed rules."""
+    inj = FaultInjector.get()
+    for old in _CONF_RULES:
+        inj.remove(old.point, old)
+    _CONF_RULES.clear()
+    spec = conf.get("spark.rapids.tpu.test.faults") or ""
+    if not spec.strip():
+        return []
+    inj.reseed(conf.get("spark.rapids.tpu.test.faults.seed"))
+    out = []
+    for one in spec.split(";"):
+        if one.strip():
+            rule = FaultRule.parse(one)
+            out.append(inj.install(rule.point, rule))
+    _CONF_RULES.extend(out)
+    return out

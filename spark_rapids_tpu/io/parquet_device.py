@@ -474,17 +474,30 @@ def _chunk_from_native(res: dict, is_bool: bool) -> _Chunk:
     _expand_indices — behaves identically; the merged def-level table
     keeps its global offsets and rides _Chunk.def_runs_merged."""
     chunk = _Chunk()
-    chunk.dict_raw = res["dict_raw"]
+    # Own every array that outlives this call: the walk returns zero-copy
+    # views into ONE native allocation freed by _ChunkHold.__del__, while
+    # the decode programs consume these arrays ASYNCHRONOUSLY — jax keeps
+    # refcounted numpy inputs alive until a dispatched program has read
+    # them, but a refcount on a view cannot keep a ctypes allocation
+    # alive, so a view reaching jax after the hold dies reads freed
+    # memory (wrong values / all-null validity once the allocator reuses
+    # it). One memcpy per chunk here is far cheaper than fencing the
+    # async pipeline per row group.
+    dict_raw = res["dict_raw"]
+    chunk.dict_raw = None if dict_raw is None else dict_raw.copy()
     chunk.dict_count = res["dict_count"]
     chunk.total = res["total_values"]
-    chunk.def_runs_merged = res["def_runs"] \
+    chunk.def_runs_merged = tuple(a.copy() for a in res["def_runs"]) \
         if res["def_runs"][0].shape[0] else None
-    chunk.plain_all = res["plain"] if not is_bool else None
+    plain = res["plain"].copy()
+    chunk.plain_all = plain if not is_bool else None
+    # the copies above make the native block unreferenced by anything that
+    # escapes this call; the hold rides along only as the "native walk
+    # engaged" marker and dies with the chunk
     chunk.hold = res["_hold"]
     chunk.pages = []
     npages = res["page_kind"].shape[0]
-    plain = res["plain"]
-    ik, ic, iv, ib, ip = res["idx_runs"]
+    ik, ic, iv, ib, ip = (a.copy() for a in res["idx_runs"])
     for i in range(npages):
         p = _Page()
         p.num_values = int(res["page_num_values"][i])
@@ -917,6 +930,10 @@ def _device_phase(pf, rg: int, schema, works, nrows: int, host_cols=None):
         else:
             cols.append(_assemble_fixed(w.chunk, w.phys, dt, defined,
                                         cap, w.spec.post))
+    # Buffer-lifetime note: everything shipped to the (asynchronous) decode
+    # programs above is an owning, refcounted numpy array — _chunk_from_native
+    # copies the native walk's views out of the _ChunkHold allocation — so the
+    # programs can consume their inputs after this frame returns.
     return ColumnarBatch(schema, tuple(cols),
                          jnp.asarray(nrows, jnp.int32)), nrows
 

@@ -45,6 +45,8 @@ class MemoryBudget:
     def reserve(self, nbytes: int) -> None:
         """Pre-flight reservation; raises RetryOOM / SplitAndRetryOOM under
         pressure (after attempting synchronous spill)."""
+        from .. import faults
+        faults.fire(faults.ALLOC)
         with self._lock:
             self._alloc_count += 1
             n = self._alloc_count

@@ -219,11 +219,20 @@ class BufferCatalog:
             from .budget import MemoryBudget
             MemoryBudget.get().release(e.nbytes)
             if self.host_used > self.host_limit:
-                self._host_to_disk(e)
+                try:
+                    self._host_to_disk(e)
+                except OSError:
+                    # disk tier unavailable (full disk / injected I/O fault):
+                    # the buffer is intact at HOST — run over the soft host
+                    # limit instead of failing the spill that was freeing
+                    # device memory for someone else's reserve()
+                    pass
             return e.nbytes
 
     def _host_to_disk(self, e: _Entry) -> None:
         import pickle
+        from .. import faults
+        faults.fire(faults.SPILL_WRITE)
         t0 = time.monotonic_ns()
         path = os.path.join(self._spill_dir, f"buf{e.handle}.spill")
         payload = ("blobs", e.host_blobs) if e.host_blobs is not None \
@@ -239,8 +248,17 @@ class BufferCatalog:
 
     def _disk_to_host(self, e: _Entry) -> None:
         import pickle
-        with open(e.disk_path, "rb") as f:
-            kind, payload = pickle.load(f)
+        from .. import faults
+        try:
+            faults.fire(faults.SPILL_READ)
+            with open(e.disk_path, "rb") as f:
+                kind, payload = pickle.load(f)
+        except OSError:
+            # transient disk hiccup: one retry before surfacing — the spill
+            # file is the only copy, so a persistent failure is terminal
+            faults.fire(faults.SPILL_READ)
+            with open(e.disk_path, "rb") as f:
+                kind, payload = pickle.load(f)
         if kind == "blobs":
             e.host_blobs = payload
         else:

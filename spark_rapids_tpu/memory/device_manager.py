@@ -22,7 +22,12 @@ _DEFAULT_HBM = 16 << 30  # v5e has 16 GiB/chip; used when the backend won't say
 
 def _backend_touch():
     """The first backend touch — client init + device enumeration. Split out
-    so tests can substitute a hanging/failing backend."""
+    so tests can substitute a hanging/failing backend. The injection point
+    sits INSIDE the touch (it runs on the deadline-guarded worker thread),
+    so an injected wedge exercises the same hang path a wedged device
+    tunnel does."""
+    from .. import faults
+    faults.fire(faults.DEVICE_INIT)
     import jax
     return jax.devices()
 

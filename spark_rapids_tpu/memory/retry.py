@@ -9,7 +9,8 @@ the engine's memory-pressure elasticity, identical control flow to the reference
 from __future__ import annotations
 
 import time
-from typing import Callable, Iterator, List, TypeVar
+from collections import deque
+from typing import Callable, Deque, Iterator, List, TypeVar
 
 from ..errors import RetryOOM, SplitAndRetryOOM
 from ..utils.metrics import TaskMetrics
@@ -42,30 +43,79 @@ def split_batch_halves(spillable):
 def with_retry(value: A, fn: Callable[[A], R],
                split_fn: Callable[[A], List[A]] = None) -> Iterator[R]:
     """Yield fn(x) for x in the (possibly split) inputs."""
-    pending: List[A] = [value]
-    while pending:
-        x = pending.pop(0)
-        attempts = 0
-        while True:
-            try:
-                yield fn(x)
-                break
-            except RetryOOM:
-                attempts += 1
-                TaskMetrics.get().retry_count += 1
-                if attempts > MAX_RETRIES:
-                    raise
-                t0 = time.monotonic_ns()
-                time.sleep(min(0.001 * (2 ** attempts), 0.25))
-                TaskMetrics.get().retry_block_ns += time.monotonic_ns() - t0
-            except SplitAndRetryOOM:
-                TaskMetrics.get().split_retry_count += 1
-                if split_fn is None:
-                    raise
-                halves = split_fn(x)
-                pending = halves + pending
-                break
+    pending: Deque[A] = deque([value])
+    x: A = value
+    try:
+        while pending:
+            x = pending.popleft()
+            attempts = 0
+            while True:
+                try:
+                    yield fn(x)
+                    break
+                except RetryOOM:
+                    attempts += 1
+                    tm = TaskMetrics.get()
+                    tm.retry_count += 1
+                    if attempts > MAX_RETRIES:
+                        raise
+                    backoff_s = min(0.001 * (2 ** attempts), 0.25)
+                    tm.retry_backoff_ms.append(backoff_s * 1000.0)
+                    t0 = time.monotonic_ns()
+                    time.sleep(backoff_s)
+                    tm.retry_block_ns += time.monotonic_ns() - t0
+                except SplitAndRetryOOM:
+                    TaskMetrics.get().split_retry_count += 1
+                    if split_fn is None:
+                        raise
+                    # splits land at the FRONT so processing stays
+                    # depth-first (bounded live set), without the O(n)
+                    # cost of list.pop(0) on every dequeue
+                    pending.extendleft(reversed(split_fn(x)))
+                    break
+    except BaseException:
+        # terminal failure with split halves still queued: close the
+        # current item and everything pending, or their catalog handles
+        # (process singleton, strong device refs) leak for the session.
+        # close() is idempotent, so callers' own finally-close is safe.
+        for item in [x, *pending]:
+            close = getattr(item, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:
+                    pass
+        raise
 
 
 def with_retry_no_split(value: A, fn: Callable[[A], R]) -> R:
     return next(with_retry(value, fn))
+
+
+def with_retry_no_split_spillable(batch, fn):
+    """Run `fn(batch)` under the OOM-retry seam with the input parked
+    spillable (the shared shape of every retry-only operator: sort, window,
+    single-batch aggregate): a pre-flight `reserve(0)` gives the budget a
+    chance to raise under pressure, `RetryOOM` re-runs `fn` after backoff,
+    and `SplitAndRetryOOM` propagates for callers with a degradation path
+    (out-of-core sort, multi-batch aggregate). The spillable wrapper is
+    closed on every exit path."""
+    from .budget import MemoryBudget
+    from .spillable import SpillableColumnarBatch
+
+    def run(sp):
+        MemoryBudget.get().reserve(0)  # pre-flight / injection point
+        out = fn(sp.get_batch())
+        sp.close()
+        return out
+
+    sp0 = SpillableColumnarBatch(batch)
+    # ownership transfer: drop the only other strong reference so a spill
+    # during the retry backoff actually frees the device arrays (callers
+    # should pass a temporary, e.g. the concat_batches(...) expression,
+    # for the same reason)
+    del batch
+    try:
+        return with_retry_no_split(sp0, run)
+    finally:
+        sp0.close()  # no-op when run() already closed it

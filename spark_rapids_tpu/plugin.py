@@ -27,9 +27,12 @@ class TpuSession:
     # ------------------------------------------------------------------ device
     def initialize_device(self) -> None:
         """Executor-side init (GpuDeviceManager.initializeGpuAndMemory analog):
-        binds the device, sizes the memory budget, creates the semaphore."""
+        binds the device, sizes the memory budget, creates the semaphore, and
+        installs any configured fault-injection rules (faults.py)."""
         if self._device_initialized:
             return
+        from . import faults
+        faults.install_from_conf(self.conf)
         from .memory.device_manager import DeviceManager
         DeviceManager.initialize(self.conf)
         self._device_initialized = True
@@ -132,9 +135,20 @@ class TpuSession:
 
         if isinstance(result, TpuExec):
             from .errors import CpuFallbackRequired
+            from .utils.metrics import TaskMetrics
+            # fresh counters per query: the explain line below must report
+            # THIS query's retries, not the session's accumulated history
+            TaskMetrics.reset()
             try:
                 host_batches = [device_batch_to_host(b)
                                 for b in result.execute()]
+                # retry-storm visibility: when explain is on, surface the
+                # task's OOM-retry/shuffle-recovery counters (incl. the
+                # per-attempt backoff schedule) next to the plan output
+                if self.conf.explain != "NONE":
+                    tm_line = TaskMetrics.get().explain_string()
+                    if tm_line:
+                        print(tm_line)
             except CpuFallbackRequired:
                 # the device layout cannot represent this data (e.g. a
                 # string wider than the byte-matrix limit surfacing

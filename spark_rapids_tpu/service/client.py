@@ -12,7 +12,7 @@ import socket
 import time
 from typing import Dict, Optional, Sequence
 
-from ..errors import DeviceStartupError
+from ..errors import AdmissionTimeoutError, DeviceStartupError
 from .protocol import ipc_to_table, recv_msg, send_msg
 
 __all__ = ["TpuServiceClient"]
@@ -76,9 +76,18 @@ class TpuServiceClient:
 
     # ------------------------------------------------------------------
     def acquire(self, timeout: Optional[float] = None) -> int:
-        """Block until admitted; returns the global admission order."""
+        """Block until admitted; returns the global admission order. A
+        server-side admission timeout raises AdmissionTimeoutError with the
+        held/waiting contention diagnostics from the reply."""
         rep, _ = self._request({"op": "acquire", "timeout": timeout})
         if not rep.get("ok"):
+            if rep.get("error_type") == "admission_timeout":
+                raise AdmissionTimeoutError(
+                    f"device admission not granted within {timeout}s "
+                    f"(tokens held: {rep.get('held')}, queue depth: "
+                    f"{rep.get('waiting')})",
+                    held=rep.get("held", -1), waiting=rep.get("waiting", -1),
+                    timeout_s=rep.get("timeout_s"))
             raise TimeoutError(rep.get("error", "admission failed"))
         return rep["order"]
 

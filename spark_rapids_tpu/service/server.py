@@ -121,11 +121,30 @@ class TpuDeviceService:
                     send_msg(conn, {"ok": True,
                                     "device": self._device_name()})
                 elif op == "acquire":
-                    seq = self.admission.acquire(
-                        timeout=header.get("timeout"))
+                    try:
+                        from .. import faults
+                        faults.fire(faults.ADMISSION)
+                    except Exception:  # injected admission fault => timeout
+                        seq = None
+                    else:
+                        # real acquire errors must NOT masquerade as
+                        # contention — they propagate to the connection
+                        # handler like any other server bug
+                        seq = self.admission.acquire(
+                            timeout=header.get("timeout"))
                     if seq is None:
-                        send_msg(conn, {"ok": False,
-                                        "error": "admission timeout"})
+                        # typed protocol error (errors.py conventions): the
+                        # client raises AdmissionTimeoutError carrying the
+                        # contention diagnostics captured here
+                        with self.admission.cv:
+                            n_held = len(self.admission.holders)
+                            n_wait = len(self.admission.queue)
+                        send_msg(conn, {
+                            "ok": False,
+                            "error": "admission timeout",
+                            "error_type": "admission_timeout",
+                            "held": n_held, "waiting": n_wait,
+                            "timeout_s": header.get("timeout")})
                     else:
                         held += 1
                         send_msg(conn, {"ok": True, "order": seq})

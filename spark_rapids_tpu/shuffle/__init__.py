@@ -8,8 +8,8 @@ TableMeta framing (metadata.py), pull-based client/server transport
 
 from .metadata import TableMeta, ColumnMeta, encode_meta, decode_meta  # noqa: F401
 from .serializer import (serialize_batch, deserialize_table,  # noqa: F401
-                         concat_host_tables, HostTable)
-from .codec import get_codec  # noqa: F401
+                         concat_host_tables, HostTable, verify_frame)
+from .codec import get_codec, crc32c  # noqa: F401
 from .transport import (BlockId, BlockRange, WindowedBlockIterator,  # noqa: F401
                         BounceBufferManager, ShuffleClient, ShuffleServer,
                         LocalTransport, ShuffleTransport, ClientConnection)

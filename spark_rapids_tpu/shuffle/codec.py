@@ -10,7 +10,51 @@ reports unavailable otherwise."""
 
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, Optional
+
+# ---------------------------------------------------------------------------
+# CRC32C (Castagnoli) — shuffle frame integrity (the reference transports
+# get this from UCX/netty; the host wire here checks its own frames).
+# google-crc32c (C) when present; table-driven software fallback otherwise.
+# ---------------------------------------------------------------------------
+
+_CRC32C_TABLE: Optional[list] = None
+
+
+def _crc32c_soft(data: bytes, crc: int = 0) -> int:
+    global _CRC32C_TABLE
+    if _CRC32C_TABLE is None:
+        poly = 0x82F63B78  # reversed Castagnoli
+        table = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            table.append(c)
+        _CRC32C_TABLE = table
+    crc ^= 0xFFFFFFFF
+    for b in data:
+        crc = _CRC32C_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+try:
+    import google_crc32c as _gcrc
+
+    def crc32c(data: bytes) -> int:
+        """CRC32C of data as an unsigned 32-bit int."""
+        return int(_gcrc.value(bytes(data)))
+except ImportError:  # pragma: no cover - environment-dependent
+    crc32c = _crc32c_soft
+
+
+def checksum_supported() -> bool:
+    """True when a C-speed CRC32C is available. The pure-Python fallback
+    runs at a few MiB/s — far too slow for the default-on shuffle checksum
+    hot path — so callers gate the checksum DEFAULT on this (frames then
+    carry checksum=0 = unchecked, which every reader accepts; integrity
+    checking degrades gracefully instead of throttling the shuffle)."""
+    return crc32c is not _crc32c_soft
 
 
 class Codec:
@@ -48,6 +92,30 @@ class ZstdCodec(Codec):
         return self._d.decompress(data, max_output_size=uncompressed_len)
 
 
+class ZlibCodec(Codec):
+    """Stdlib fallback when the zstandard wheel is absent (missing deps are
+    gated, not fatal). Frames stamp the ACTUAL codec name — never the
+    requested one — so a cross-host peer that does have zstd still reads a
+    zlib frame correctly instead of feeding zlib bytes to zstd."""
+
+    name = "zlib"
+
+    def __init__(self, level: int = 1):
+        import zlib
+        self._zlib = zlib
+        self._level = level
+
+    def compress(self, data: bytes) -> bytes:
+        return self._zlib.compress(data, self._level)
+
+    def decompress(self, data: bytes, uncompressed_len: int) -> bytes:
+        d = self._zlib.decompressobj()
+        out = d.decompress(data, uncompressed_len)
+        if d.unconsumed_tail:
+            raise ValueError("zlib payload exceeds declared length")
+        return out
+
+
 class NativeLz4Codec(Codec):
     """LZ4 block codec from the native runtime (native/libsrtpu.so)."""
 
@@ -76,7 +144,12 @@ def get_codec(name: str) -> Codec:
         if name == "none":
             _CACHE[name] = CopyCodec()
         elif name == "zstd":
-            _CACHE[name] = ZstdCodec()
+            try:
+                _CACHE[name] = ZstdCodec()
+            except ImportError:  # no zstandard wheel: honest stdlib fallback
+                _CACHE[name] = ZlibCodec()
+        elif name == "zlib":
+            _CACHE[name] = ZlibCodec()
         elif name == "lz4xla":
             _CACHE[name] = NativeLz4Codec()
         else:

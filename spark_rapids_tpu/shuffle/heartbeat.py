@@ -32,6 +32,7 @@ class HeartbeatManager:
     def __init__(self, expiry_seconds: float = 60.0,
                  clock=time.monotonic):
         self._peers: Dict[str, PeerInfo] = {}
+        self._expired: set = set()  # ids that aged out and never came back
         self._order = 0
         self._expiry = expiry_seconds
         self._clock = clock
@@ -41,6 +42,7 @@ class HeartbeatManager:
                           endpoint: str) -> List[PeerInfo]:
         with self._lock:
             self._expire_locked()
+            self._expired.discard(executor_id)
             self._peers[executor_id] = PeerInfo(executor_id, endpoint,
                                                 self._clock(), self._order)
             self._order += 1
@@ -62,6 +64,16 @@ class HeartbeatManager:
             return sorted(self._peers.values(),
                           key=lambda p: p.registration_order)
 
+    def is_aged_out(self, executor_id: str) -> bool:
+        """True only for a peer that WAS registered and has since expired
+        without re-registering. Unknown ids return False: the registry
+        cannot vouch for a peer it never saw, so callers must not treat
+        'not registered' as 'dead' (dropping an explicitly requested peer
+        on that basis would silently lose its rows)."""
+        with self._lock:
+            self._expire_locked()
+            return executor_id in self._expired
+
     def _others_locked(self, executor_id: str) -> List[PeerInfo]:
         return sorted((p for p in self._peers.values()
                        if p.executor_id != executor_id),
@@ -73,3 +85,4 @@ class HeartbeatManager:
                 if now - p.last_seen > self._expiry]
         for k in dead:
             del self._peers[k]
+            self._expired.add(k)

@@ -17,14 +17,19 @@ Modes here:
 from __future__ import annotations
 
 import threading
+import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
+from .. import faults
 from ..columnar.batch import ColumnarBatch
 from ..config import TpuConf, get_default_conf
+from ..errors import ShuffleCorruptionError, ShuffleFetchFailedError
 from ..memory.catalog import BufferCatalog, SpillPriority
+from ..utils.metrics import TaskMetrics
+from .heartbeat import HeartbeatManager
 from .serializer import (HostTable, concat_host_tables, deserialize_table,
-                         serialize_batch)
+                         serialize_batch, verify_frame)
 from .transport import (BlockId, BounceBufferManager, LocalTransport,
                         ShuffleClient, ShuffleServer, ShuffleTransport)
 
@@ -99,6 +104,7 @@ class ShuffleBlockStore:
 
     def put(self, bid: BlockId, data: bytes) -> None:
         import os
+        faults.fire(faults.BLOCK_WRITE)
         evict = []
         with self._lock:
             old = self._blocks.pop(bid, None)
@@ -143,6 +149,15 @@ class ShuffleBlockStore:
                         pass
 
     def get(self, bid: BlockId) -> Optional[bytes]:
+        data = self._get_impl(bid)
+        if data is None:
+            return None
+        # the injection point can corrupt or fail the read (disk-tier I/O
+        # analog); it sits OUTSIDE the lock so a delay rule cannot stall
+        # concurrent writers
+        return faults.fire(faults.BLOCK_READ, data)
+
+    def _get_impl(self, bid: BlockId) -> Optional[bytes]:
         with self._lock:
             data = self._blocks.get(bid)
             if data is None:
@@ -249,18 +264,34 @@ class _MultithreadedWriter:
     def write(self, reduce_id: int, batch: ColumnarBatch) -> None:
         codec = self._codec
         store = self._mgr.block_store
+        checksum = self._mgr.checksum_enabled
         bid = BlockId(self._sid, self._mid, reduce_id)
 
         def job():
-            store.put(bid, serialize_batch(batch, codec))
+            data = serialize_batch(batch, codec, checksum=checksum)
+            try:
+                store.put(bid, data)
+            except OSError:
+                store.put(bid, data)  # one retry: transient store hiccup
 
         self._futures.append(self._mgr.writer_pool.submit(job))
 
     def close(self) -> None:
-        """Block until all partition writes land (task commit point)."""
+        """Block until all partition writes land (task commit point). Every
+        future is drained even when one fails: the caller's cleanup
+        (discard_map_output) must not run while sibling puts are still in
+        flight — a late put would resurrect a block under the discarded
+        map id (duplicated rows on read) or leak it in the singleton store."""
+        first: Optional[BaseException] = None
         for f in self._futures:
-            f.result()
+            try:
+                f.result()
+            except BaseException as e:  # noqa: BLE001 - drain them all
+                if first is None:
+                    first = e
         self._futures.clear()
+        if first is not None:
+            raise first
 
 
 class _CachingWriter:
@@ -289,11 +320,28 @@ class TpuShuffleManager:
 
     def __init__(self, conf: Optional[TpuConf] = None,
                  executor_id: str = "exec-0",
-                 transport: Optional[ShuffleTransport] = None):
+                 transport: Optional[ShuffleTransport] = None,
+                 heartbeat: Optional[HeartbeatManager] = None):
         self.conf = conf or get_default_conf()
         self.mode = self.conf.get("spark.rapids.shuffle.mode")
         self.codec_name = self.conf.get(
             "spark.rapids.shuffle.compression.codec")
+        from .codec import checksum_supported
+        self.checksum_enabled = self.conf.get(
+            "spark.rapids.shuffle.checksum.enabled") and checksum_supported()
+        if self.conf.get("spark.rapids.shuffle.checksum.enabled") \
+                and not self.checksum_enabled:
+            import warnings
+            warnings.warn(
+                "shuffle frame checksums disabled: no C-speed CRC32C "
+                "available (install google-crc32c); the pure-Python "
+                "fallback would throttle the shuffle to a few MiB/s",
+                RuntimeWarning, stacklevel=2)
+        self.fetch_max_retries = self.conf.get(
+            "spark.rapids.shuffle.fetch.maxRetries")
+        self.fetch_retry_wait_ms = self.conf.get(
+            "spark.rapids.shuffle.fetch.retryWaitMs")
+        self.heartbeat = heartbeat
         self.executor_id = executor_id
         self.block_store = ShuffleBlockStore(
             host_budget=self.conf.get("spark.rapids.shuffle.hostStoreSize"),
@@ -340,6 +388,24 @@ class TpuShuffleManager:
     def register_cached(self, bid: BlockId, handle: int) -> None:
         self._cached[bid] = handle
 
+    # -- peer liveness ------------------------------------------------------
+    def register_with_heartbeat(self, heartbeat: HeartbeatManager,
+                                endpoint: str = "") -> None:
+        """Join the peer registry (the executor-side half of the reference's
+        heartbeat handshake, Plugin.scala:227-239): register once here, then
+        call heartbeat.executor_heartbeat periodically. The fetch path uses
+        the registry's liveness to skip aged-out peers and to pick failover
+        candidates."""
+        self.heartbeat = heartbeat
+        heartbeat.register_executor(self.executor_id,
+                                    endpoint or self.executor_id)
+
+    def _live_peer_ids(self) -> List[str]:
+        if self.heartbeat is None:
+            return []
+        return [p.executor_id for p in self.heartbeat.known_peers()
+                if p.executor_id != self.executor_id]
+
     # -- read side ----------------------------------------------------------
     def read_partition(self, shuffle_id: int, reduce_id: int,
                        remote_peers: Sequence[str] = (),
@@ -363,23 +429,198 @@ class TpuShuffleManager:
                     cat.remove(handle)
                     self._cached.pop(bid, None)
             return
-        raw: List[bytes] = []
+        # frames keyed by BlockId: a block replicated on several peers (or
+        # refetched through failover) contributes its rows exactly once
+        frames: Dict[BlockId, bytes] = {}
         local = self.block_store.blocks_for_reduce(shuffle_id, reduce_id)
         for bid in local:
-            raw.append(self.block_store.get(bid))
-        for peer in remote_peers:
-            client = ShuffleClient(self.transport.connect(peer),
-                                   self.bounce_buffers)
-            client.fetch_partition(shuffle_id, reduce_id,
-                                   lambda bid, data: raw.append(data))
+            data = self._read_local_block(bid)
+            if data is None:
+                # the store LISTED this block but no longer holds it — a
+                # concurrent release (speculative/retried reduce task) ate
+                # it. Silently yielding without its rows would be a wrong
+                # result; fail loudly and typed instead.
+                raise ShuffleFetchFailedError(
+                    f"local shuffle block {bid} vanished from the store "
+                    f"mid-read (concurrent release of "
+                    f"shuffle={shuffle_id} reduce={reduce_id}?)",
+                    peer="local", blocks=[bid], attempts=1)
+            frames[bid] = data
+        peers = list(remote_peers)
+        live = self._live_peer_ids() if self.heartbeat is not None else []
+        if self.heartbeat is not None:
+            for p in peers:
+                if self.heartbeat.is_aged_out(p):
+                    # a peer the registry WATCHED DIE gets no fetch attempt
+                    # (it would only time out) — but it may hold rows we
+                    # cannot enumerate, so the read fails fast and typed
+                    # rather than silently returning without its blocks.
+                    # Peers the registry never saw are attempted normally:
+                    # "not registered" is not evidence of death.
+                    raise ShuffleFetchFailedError(
+                        f"shuffle fetch peer {p!r} aged out of the "
+                        f"heartbeat registry (no heartbeat within the "
+                        f"expiry window) for shuffle={shuffle_id} "
+                        f"reduce={reduce_id}; failing fast instead of "
+                        f"timing out against a dead executor",
+                        peer=p, attempts=0)
+        for peer in peers:
+            # failover candidates: the other requested peers plus any live
+            # registered peer the request didn't name (heartbeat liveness
+            # widens recovery, never narrows the requested set)
+            alternates = [p for p in peers if p != peer] + \
+                [p for p in live if p not in peers]
+            for bid, data in self._fetch_peer_with_retry(
+                    shuffle_id, reduce_id, peer, alternates):
+                frames.setdefault(bid, data)
         if release:
             for bid in local:
                 self.block_store.remove(bid)
-        if not raw:
+        if not frames:
             return
-        futures = [self.reader_pool.submit(deserialize_table, r) for r in raw]
+        ordered = [frames[k] for k in sorted(frames, key=lambda b:
+                                             (b.map_id, b.shuffle_id))]
+        # verify=False: every frame in `frames` already passed its CRC32C
+        # check on the fetch/local-read path above (per checksum config);
+        # re-hashing the same bytes here would double the checksum cost
+        futures = [self.reader_pool.submit(deserialize_table, r, 0, False)
+                   for r in ordered]
         tables: List[HostTable] = [f.result()[0] for f in futures]
         yield concat_host_tables(tables)
+
+    # -- fetch robustness ---------------------------------------------------
+    def _read_local_block(self, bid: BlockId) -> Optional[bytes]:
+        """Local store read with integrity check: a corrupt frame gets ONE
+        re-read (the store may satisfy it from a clean tier) before raising
+        the typed error."""
+        data = self.block_store.get(bid)
+        if data is None:
+            return None  # concurrently removed: same contract as the store
+        if not self.checksum_enabled:
+            return data
+        try:
+            verify_frame(data, bid, "local store")
+            return data
+        except ShuffleCorruptionError:
+            TaskMetrics.get().shuffle_refetch_count += 1
+            data = self.block_store.get(bid)
+            if data is None:
+                raise
+            verify_frame(data, bid, "local store (refetch)")
+            return data
+
+    def _fetch_once(self, peer: str, shuffle_id: int, reduce_id: int,
+                    wanted_out: List[BlockId],
+                    wanted: Optional[Sequence[BlockId]] = None
+                    ) -> List[Tuple[BlockId, bytes]]:
+        """One fetch attempt against one peer: discover (or take `wanted`),
+        pull, and integrity-check every frame; corrupt frames get ONE
+        refetch over a fresh connection before the typed error propagates.
+        `wanted_out` receives the peer's block listing as soon as it is
+        known, so a mid-transfer failure still leaves the caller knowing
+        what to recover from failover peers."""
+        conn = self.transport.connect(peer)
+        client = ShuffleClient(conn, self.bounce_buffers)
+        if wanted is None:
+            wanted = conn.list_blocks(shuffle_id, reduce_id)
+        wanted_out[:] = list(wanted)
+        if not wanted:
+            return []
+        got: Dict[BlockId, bytes] = {}
+        corrupt: List[BlockId] = []
+
+        def on_block(bid: BlockId, data: bytes) -> None:
+            if self.checksum_enabled:
+                try:
+                    verify_frame(data, bid, peer)
+                except ShuffleCorruptionError:
+                    corrupt.append(bid)
+                    return
+            got[bid] = data
+
+        client.fetch_blocks(list(wanted), on_block)
+        if corrupt:
+            TaskMetrics.get().shuffle_refetch_count += len(corrupt)
+            refetch = ShuffleClient(self.transport.connect(peer),
+                                    self.bounce_buffers)
+
+            def on_refetched(bid: BlockId, data: bytes) -> None:
+                verify_frame(data, bid, f"{peer} (refetch)")  # raises typed
+                got[bid] = data
+
+            refetch.fetch_blocks(corrupt, on_refetched)
+        return sorted(got.items(), key=lambda kv: kv[0].map_id)
+
+    def _fetch_peer_with_retry(self, shuffle_id: int, reduce_id: int,
+                               peer: str, alternates: Sequence[str] = ()
+                               ) -> List[Tuple[BlockId, bytes]]:
+        """Fetch one peer's blocks for a reduce partition, surviving
+        transient failures: exponential-backoff retries against the peer,
+        then failover to live alternates for the blocks the dead peer was
+        known to hold, then — only with the retry budget spent and no
+        recovery path left — a typed ShuffleFetchFailedError carrying the
+        peer/block diagnostics."""
+        wanted: List[BlockId] = []
+        base_s = self.fetch_retry_wait_ms / 1000.0
+        last_exc: Optional[Exception] = None
+        attempts = 0
+        for attempt in range(self.fetch_max_retries + 1):
+            attempts = attempt + 1
+            try:
+                return self._fetch_once(peer, shuffle_id, reduce_id, wanted,
+                                        wanted or None)
+            except ShuffleCorruptionError:
+                raise  # already had its one refetch; permanently corrupt
+            except Exception as e:  # noqa: BLE001 - transport errors vary
+                last_exc = e
+                if attempt < self.fetch_max_retries:
+                    TaskMetrics.get().shuffle_retry_count += 1
+                    time.sleep(min(base_s * (2 ** attempt), 1.0))
+        # retry budget exhausted: failover. Recovery is only claimed when
+        # the dead peer's block list is KNOWN and alternates cover all of
+        # it — guessing would risk silently dropping rows.
+        if wanted:
+            missing = list(wanted)
+            recovered: List[Tuple[BlockId, bytes]] = []
+            for alt in alternates:
+                if not missing:
+                    break
+                try:
+                    scratch: List[BlockId] = []
+                    held = set(self.transport.connect(alt).list_blocks(
+                        shuffle_id, reduce_id))
+                    ask = [b for b in missing if b in held]
+                    if not ask:
+                        continue
+                    for bid, data in self._fetch_once(
+                            alt, shuffle_id, reduce_id, scratch, ask):
+                        recovered.append((bid, data))
+                        missing.remove(bid)
+                except Exception:  # noqa: BLE001 - a dead alternate is fine
+                    continue
+            if not missing:
+                TaskMetrics.get().shuffle_failover_count += 1
+                return recovered
+        raise ShuffleFetchFailedError(
+            f"shuffle fetch from peer {peer!r} failed after {attempts} "
+            f"attempt(s) for shuffle={shuffle_id} reduce={reduce_id} "
+            f"blocks={wanted or 'unknown'} (no failover peer could supply "
+            f"the missing blocks): {type(last_exc).__name__}: {last_exc}",
+            peer=peer, blocks=wanted, attempts=attempts, cause=last_exc)
+
+    def discard_map_output(self, shuffle_id: int, map_id: int,
+                           n_parts: int) -> None:
+        """Drop every block one map attempt wrote (task-retry cleanup): a
+        failed write attempt must not leave partial output that a retried
+        attempt — writing under a fresh map id — would then duplicate,
+        because the read side concatenates ALL blocks for (shuffle, reduce)."""
+        cat = BufferCatalog.get()
+        for p in range(n_parts):
+            bid = BlockId(shuffle_id, map_id, p)
+            self.block_store.remove(bid)
+            h = self._cached.pop(bid, None)
+            if h is not None:
+                cat.remove(h)
 
     # -- lifecycle ----------------------------------------------------------
     def unregister_shuffle(self, shuffle_id: int) -> None:

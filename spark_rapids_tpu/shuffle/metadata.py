@@ -9,9 +9,13 @@ and python reads it zero-copy with memoryview slices).
 
 Layout (all little-endian):
   magic "SRTM" | u16 version | u16 codec_id | u32 num_rows | u32 num_cols |
-  u64 uncompressed_len | u64 compressed_len |
+  u64 uncompressed_len | u64 compressed_len | u32 payload_crc32c |
   per column: u16 name_len | name utf8 | u16 type_len | type utf8 |
               u32 string_width | u64 data_len | u64 validity_len | u64 lens_len
+
+payload_crc32c (version 2+) is the CRC32C of the compressed payload bytes
+that follow the header; 0 means "not checksummed"
+(spark.rapids.shuffle.checksum.enabled=false).
 
 Buffer payload order per column: data, validity, lengths — concatenated across
 columns in column order. This is the TPU analog of the packed contiguous-split
@@ -26,14 +30,14 @@ from typing import List, Tuple
 from .. import types as T
 
 MAGIC = b"SRTM"
-VERSION = 1
+VERSION = 2
 # string_width sentinel: the column's string bytes are EXACT varlen
 # (lengths + concatenated bytes, no padding) instead of a padded matrix —
 # used for long-string overflow columns so the wire never carries the
 # cap x width blow-up
 VARLEN_WIDTH = 0xFFFFFFFF
 
-CODEC_IDS = {"none": 0, "zstd": 1, "lz4xla": 2}
+CODEC_IDS = {"none": 0, "zstd": 1, "lz4xla": 2, "zlib": 3}
 CODEC_NAMES = {v: k for k, v in CODEC_IDS.items()}
 
 
@@ -54,6 +58,7 @@ class TableMeta:
     uncompressed_len: int
     compressed_len: int
     columns: List[ColumnMeta]
+    checksum: int = 0  # CRC32C of the compressed payload; 0 = unchecksummed
 
     @property
     def num_cols(self) -> int:
@@ -64,13 +69,13 @@ class TableMeta:
                    for c in self.columns)
 
 
-_HEAD = struct.Struct("<4sHHII QQ")
+_HEAD = struct.Struct("<4sHHII QQI")
 
 
 def encode_meta(meta: TableMeta) -> bytes:
     out = [_HEAD.pack(MAGIC, VERSION, CODEC_IDS[meta.codec], meta.num_rows,
                       meta.num_cols, meta.uncompressed_len,
-                      meta.compressed_len)]
+                      meta.compressed_len, meta.checksum)]
     for c in meta.columns:
         nb = c.name.encode("utf-8")
         tb = c.dtype.simple_string().encode("utf-8")
@@ -86,10 +91,17 @@ def encode_meta(meta: TableMeta) -> bytes:
 def decode_meta(buf: bytes, offset: int = 0) -> Tuple[TableMeta, int]:
     """Returns (meta, bytes_consumed_from_offset)."""
     view = memoryview(buf)
-    magic, version, codec_id, num_rows, num_cols, ulen, clen = \
+    magic, version, codec_id, num_rows, num_cols, ulen, clen, cksum = \
         _HEAD.unpack_from(view, offset)
     if magic != MAGIC:
         raise ValueError(f"bad shuffle metadata magic {magic!r}")
+    if version != VERSION:
+        # the v2 header grew by the checksum word, so a v1 frame CANNOT be
+        # parsed by this struct — reject version skew explicitly instead of
+        # misreading column metadata as garbage
+        raise ValueError(
+            f"unsupported shuffle metadata version {version} "
+            f"(this build reads version {VERSION})")
     if version != VERSION:
         raise ValueError(f"unsupported shuffle metadata version {version}")
     pos = offset + _HEAD.size
@@ -107,5 +119,6 @@ def decode_meta(buf: bytes, offset: int = 0) -> Tuple[TableMeta, int]:
         pos += struct.calcsize("<IQQQ")
         cols.append(ColumnMeta(name, T.parse_type(tname), width, dlen, vlen,
                                llen))
-    return TableMeta(num_rows, CODEC_NAMES[codec_id], ulen, clen, cols), \
+    return TableMeta(num_rows, CODEC_NAMES[codec_id], ulen, clen, cols,
+                     cksum), \
         pos - offset

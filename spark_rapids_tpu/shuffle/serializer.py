@@ -20,7 +20,8 @@ from .. import types as T
 from ..columnar.batch import ColumnarBatch, Schema
 from ..columnar.column import Column
 from ..columnar.padding import row_bucket, width_bucket
-from .codec import get_codec
+from ..errors import ShuffleCorruptionError
+from .codec import crc32c, get_codec
 from .metadata import (VARLEN_WIDTH, ColumnMeta, TableMeta, decode_meta,
                        encode_meta)
 
@@ -33,8 +34,10 @@ class HostTable:
     num_rows: int
 
 
-def serialize_batch(batch: ColumnarBatch, codec_name: str = "none") -> bytes:
-    """Device batch -> framed bytes (header + compressed payload)."""
+def serialize_batch(batch: ColumnarBatch, codec_name: str = "none",
+                    checksum: bool = True) -> bytes:
+    """Device batch -> framed bytes (header + compressed payload). The frame
+    carries a CRC32C of the payload (checksum=False writes 0 = unchecked)."""
     n = int(batch.row_count())
     cols: List[ColumnMeta] = []
     parts: List[bytes] = []
@@ -70,15 +73,53 @@ def serialize_batch(batch: ColumnarBatch, codec_name: str = "none") -> bytes:
     payload = b"".join(parts)
     codec = get_codec(codec_name)
     compressed = codec.compress(payload)
-    meta = TableMeta(n, codec_name, len(payload), len(compressed), cols)
+    # stamp the ACTUAL codec (get_codec may substitute a fallback, e.g.
+    # zlib for a missing zstandard wheel): a reader that resolves the
+    # requested name differently must still decode this frame correctly
+    meta = TableMeta(n, codec.name, len(payload), len(compressed), cols,
+                     crc32c(compressed) if checksum else 0)
     return encode_meta(meta) + compressed
 
 
-def deserialize_table(buf: bytes, offset: int = 0) -> Tuple[HostTable, int]:
-    """Framed bytes -> host table. Returns (table, total_bytes_consumed)."""
+def verify_frame(buf: bytes, block=None, source: str = "") -> None:
+    """Integrity-check one framed block without decompressing it: the header
+    must decode and the payload must match its CRC32C (when the frame carries
+    one). Raises ShuffleCorruptionError with block/source diagnostics."""
+    try:
+        meta, head_len = decode_meta(buf)
+    except Exception as e:
+        raise ShuffleCorruptionError(
+            f"unreadable shuffle frame header for block {block} "
+            f"from {source or 'local store'}: {e}", block, source) from e
+    payload = memoryview(buf)[head_len:head_len + meta.compressed_len]
+    if len(payload) != meta.compressed_len:
+        raise ShuffleCorruptionError(
+            f"truncated shuffle frame for block {block} from "
+            f"{source or 'local store'}: have {len(payload)} payload bytes, "
+            f"header says {meta.compressed_len}", block, source)
+    if meta.checksum:
+        actual = crc32c(payload)
+        if actual != meta.checksum:
+            raise ShuffleCorruptionError(
+                f"shuffle frame CRC32C mismatch for block {block} from "
+                f"{source or 'local store'}: stored {meta.checksum:#010x}, "
+                f"computed {actual:#010x}", block, source)
+
+
+def deserialize_table(buf: bytes, offset: int = 0,
+                      verify: bool = True) -> Tuple[HostTable, int]:
+    """Framed bytes -> host table. Returns (table, total_bytes_consumed).
+    Verifies the payload CRC32C when the frame carries one; pass
+    verify=False for frames the caller already integrity-checked."""
     meta, head_len = decode_meta(buf, offset)
     start = offset + head_len
     compressed = bytes(memoryview(buf)[start:start + meta.compressed_len])
+    if verify and meta.checksum:
+        actual = crc32c(compressed)
+        if actual != meta.checksum:
+            raise ShuffleCorruptionError(
+                f"shuffle frame CRC32C mismatch: stored "
+                f"{meta.checksum:#010x}, computed {actual:#010x}")
     payload = get_codec(meta.codec).decompress(compressed,
                                                meta.uncompressed_len)
     view = memoryview(payload)

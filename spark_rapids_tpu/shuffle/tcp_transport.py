@@ -143,13 +143,19 @@ class _TcpConnection(ClientConnection):
         self._sock.close()
 
     def _request(self, header: dict) -> Tuple[dict, bytes]:
+        from .. import faults
         with self._lock:  # one in-flight request per connection
             if self._dead:
                 raise IOError("shuffle connection is closed (a previous "
                               "request timed out; replies would desync)")
             try:
+                # injected connection resets / delays at the wire seams:
+                # tcp.send fires before the request leaves, tcp.recv after
+                # the peer answered (a reply lost in flight)
+                faults.fire(faults.TCP_SEND)
                 send_msg(self._sock, header)
                 rep, body = recv_msg(self._sock)
+                faults.fire(faults.TCP_RECV)
             except socket.timeout as e:
                 # POISON the socket: a late reply for this request would
                 # otherwise be read as the NEXT request's response and

@@ -247,7 +247,9 @@ class ShuffleClient:
                     if r.block in failed:
                         continue  # a lost prefix poisons the whole block
                     try:
-                        chunk = self._conn.fetch_range(r)
+                        from .. import faults
+                        chunk = faults.fire(faults.FETCH,
+                                            self._conn.fetch_range(r))
                         if len(chunk) != r.length:
                             raise IOError(
                                 f"short read for {r.block}: "

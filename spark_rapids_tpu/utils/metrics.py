@@ -120,9 +120,17 @@ class TaskMetrics:
         self.retry_count = 0
         self.split_retry_count = 0
         self.retry_block_ns = 0
+        # per-attempt OOM-retry backoff (ms), in attempt order: a retry STORM
+        # (many attempts, growing waits) is visible at a glance instead of
+        # hiding inside one aggregate nanosecond counter
+        self.retry_backoff_ms: list = []
         self.spill_to_host_ns = 0
         self.spill_to_disk_ns = 0
         self.read_spill_ns = 0
+        # shuffle fetch robustness counters (retry/refetch/failover path)
+        self.shuffle_retry_count = 0
+        self.shuffle_refetch_count = 0
+        self.shuffle_failover_count = 0
 
     @classmethod
     def get(cls) -> "TaskMetrics":
@@ -135,3 +143,22 @@ class TaskMetrics:
     @classmethod
     def reset(cls) -> None:
         cls._tls.metrics = TaskMetrics()
+
+    def explain_string(self) -> str:
+        """Retry/recovery summary for explain output; empty when the task
+        saw no memory-pressure retries and no shuffle recovery events."""
+        parts = []
+        if self.retry_count or self.split_retry_count:
+            backoffs = ", ".join(f"{b:.1f}" for b in self.retry_backoff_ms)
+            parts.append(
+                f"oomRetries={self.retry_count} "
+                f"splitRetries={self.split_retry_count} "
+                f"retryBlockedMs={self.retry_block_ns / 1e6:.1f} "
+                f"backoffsMs=[{backoffs}]")
+        if self.shuffle_retry_count or self.shuffle_refetch_count or \
+                self.shuffle_failover_count:
+            parts.append(
+                f"shuffleFetchRetries={self.shuffle_retry_count} "
+                f"shuffleRefetches={self.shuffle_refetch_count} "
+                f"shuffleFailovers={self.shuffle_failover_count}")
+        return "" if not parts else "TaskMetrics: " + "; ".join(parts)

@@ -23,6 +23,15 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from tier-1")
+    config.addinivalue_line(
+        "markers",
+        "faults: deterministic fault-injection matrix "
+        "(scripts/fault_matrix.sh runs these standalone)")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

@@ -57,7 +57,9 @@ class TestSerializer:
         blob = serialize_batch(batch_from_arrow(t), "zstd")
         meta, _ = decode_meta(blob)
         assert meta.num_rows == 50
-        assert meta.codec == "zstd"
+        # the frame stamps the ACTUAL codec: zstd, or the zlib fallback
+        # when the zstandard wheel is absent in this environment
+        assert meta.codec == get_codec("zstd").name
         assert [c.name for c in meta.columns] == ["a", "b", "s", "c"]
         assert isinstance(meta.columns[2].dtype, T.StringType)
         assert meta.columns[2].string_width > 0
