@@ -133,7 +133,7 @@ class ColumnarBatch:
 def batch_from_arrow(table, capacity: Optional[int] = None) -> ColumnarBatch:
     """pyarrow Table/RecordBatch -> device ColumnarBatch (the H2D boundary)."""
     n = table.num_rows
-    cap = capacity or row_bucket(n)
+    cap = capacity or row_bucket(n, op="scan")
     cols: List[Column] = []
     for name in table.schema.names:
         col, _ = col_from_arrow(table.column(name), capacity=cap)
@@ -147,7 +147,7 @@ def batch_from_dict(data: dict, types_map: Optional[dict] = None,
     """Convenience constructor from {name: np.ndarray/list} (tests, data_gen)."""
     names = tuple(data.keys())
     n = len(next(iter(data.values()))) if data else 0
-    cap = capacity or row_bucket(n)
+    cap = capacity or row_bucket(n, op="scan")
     cols = []
     tps = []
     for name in names:

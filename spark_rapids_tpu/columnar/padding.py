@@ -4,23 +4,98 @@ XLA compiles one program per shape, so device batches are padded to a small set 
 capacity buckets; the logical row count travels as a traced scalar. This keeps the number
 of distinct compiled programs logarithmic in batch-size range (the reference has no such
 concern — CUDA kernels take runtime sizes — making this the first genuinely TPU-specific
-design point, see ARCHITECTURE.md #1)."""
+design point, see ARCHITECTURE.md #1).
+
+Hot-path discipline: `row_bucket` sits under every batch materialization, so
+the conf reads (minRows/growth) are memoized per conf-generation instead of
+re-walking the registry per call; `invalidate_cache()` is the hook the
+compile service's bucket tuner (and `TpuConf.set` on padding keys) uses to
+drop the memo. The tuner can also install a LEARNED ladder
+(`install_tuned_buckets`): observed-workload capacities that replace the
+geometric ladder within their range — fewer distinct buckets (fewer XLA
+programs) with waste bounded by the observed clusters. Sizes beyond the
+ladder fall back to geometric growth from its top rung."""
 
 from __future__ import annotations
+
+import threading
+from typing import Callable, Optional, Tuple
 
 from ..config import get_default_conf
 
 LANE = 128
 
+_lock = threading.Lock()
+_generation = 0
+_cached_params: Optional[Tuple[int, float, int]] = None  # (minRows, growth, gen)
+_tuned_ladder: Tuple[int, ...] = ()
+# compile-service tuner hook: called (op, n) per bucket decision when set
+_observer: Optional[Callable[[Optional[str], int], None]] = None
 
-def row_bucket(n: int, min_rows: int = 0) -> int:
-    """Smallest capacity bucket >= n: buckets start at max(minRows, LANE) and grow by
-    spark.rapids.tpu.padding.growth (lane-aligned), default 2x."""
+
+def invalidate_cache() -> None:
+    """Drop the memoized padding conf (conf change / tuner retune)."""
+    global _generation, _cached_params
+    with _lock:
+        _generation += 1
+        _cached_params = None
+
+
+def install_tuned_buckets(caps) -> None:
+    """Install a learned capacity ladder (ascending, lane-aligned; empty
+    clears back to the pure geometric ladder). Compile-service tuner entry
+    point."""
+    global _tuned_ladder
+    aligned = sorted({((int(c) + LANE - 1) // LANE) * LANE
+                      for c in caps if int(c) > 0})
+    with _lock:
+        _tuned_ladder = tuple(aligned)
+    invalidate_cache()
+
+
+def tuned_buckets() -> Tuple[int, ...]:
+    return _tuned_ladder
+
+
+def set_bucket_observer(fn: Optional[Callable]) -> None:
+    """Register the tuner's observation hook (None disables)."""
+    global _observer
+    _observer = fn
+
+
+def _params() -> Tuple[int, float]:
+    global _cached_params
+    p = _cached_params
+    if p is not None and p[2] == _generation:
+        return p[0], p[1]
     conf = get_default_conf()
+    p = (conf.get("spark.rapids.tpu.padding.minRows"),
+         max(1.25, conf.get("spark.rapids.tpu.padding.growth")),
+         _generation)
+    with _lock:
+        _cached_params = p
+    return p[0], p[1]
+
+
+def row_bucket(n: int, min_rows: int = 0, op: str = None) -> int:
+    """Smallest capacity bucket >= n. With a tuned ladder installed, the
+    first ladder rung >= n wins; otherwise (and beyond the ladder) buckets
+    start at max(minRows, LANE) and grow by spark.rapids.tpu.padding.growth
+    (lane-aligned), default 2x. `op` attributes the observation to an
+    operator for the bucket tuner."""
+    obs = _observer
+    if obs is not None:
+        obs(op, n)
+    conf_min, growth = _params()
     if min_rows <= 0:
-        min_rows = conf.get("spark.rapids.tpu.padding.minRows")
-    growth = max(1.25, conf.get("spark.rapids.tpu.padding.growth"))
-    cap = max(min_rows, LANE)
+        min_rows = conf_min
+    floor = max(min_rows, LANE)
+    for rung in _tuned_ladder:
+        if rung >= n and rung >= floor:
+            return rung
+    cap = floor
+    if _tuned_ladder and _tuned_ladder[-1] > cap:
+        cap = _tuned_ladder[-1]
     while cap < n:
         cap = ((int(cap * growth) + LANE - 1) // LANE) * LANE
     return cap

@@ -1,0 +1,19 @@
+"""Compile service package: centralized XLA program cache, AOT warmup and
+adaptive bucket tuning (see service.py for the design narrative).
+
+Public surface:
+  * `sjit` — decorator replacing module-level `jax.jit` kernels.
+  * `instance_jit` + `kernel_key` — per-exec-instance kernels (closure
+    contents digested into the cache key).
+  * `CompileService.get()` — cache control + stats.
+  * `BucketTuner.get()` — observed-row-count histogram + ladder retune.
+"""
+
+from .service import (CompileService, CompileStats, ServiceJit, instance_jit,
+                      kernel_key, sjit)
+from .tuner import BucketTuner
+from .warmup import run_warmup, start_warmup
+
+__all__ = ["CompileService", "CompileStats", "ServiceJit", "sjit",
+           "instance_jit", "kernel_key", "BucketTuner", "run_warmup",
+           "start_warmup"]

@@ -1,0 +1,160 @@
+"""Adaptive bucket tuner: learn the padding-bucket ladder from observed
+batch row counts.
+
+The bucketed-padding discipline (columnar/padding.py) keeps the compiled-
+program population logarithmic in batch-size range, but the default
+geometric ladder is workload-blind: a serving workload whose batches
+cluster at, say, 48k and 300k rows pays both recompiles (sizes straddling
+a power-of-two boundary) and padding waste (a 300k batch padded to 512k).
+The tuner records the row counts the engine actually buckets (per
+operator), then derives a small ladder of lane-aligned capacities that
+covers the observed distribution — cutting recompiles (fewer distinct
+buckets hit) without inflating waste (boundaries sit just above observed
+cluster maxima). `retune()` installs the ladder into
+`columnar.padding.install_tuned_buckets`, which also invalidates padding's
+memoized conf so the change takes effect immediately.
+
+Ladder derivation: observed sizes are lane-quantized and histogrammed;
+boundaries are the sizes at evenly spaced cumulative-count quantiles
+(always including the max), capped at ``tuner.maxBuckets``. Each observed
+batch then pads to the next boundary at or above it, so per-batch waste is
+bounded by the gap to the next learned cluster rather than by the
+geometric growth factor.
+
+Auto mode (``spark.rapids.tpu.compile.tuner.enabled=true``) re-tunes every
+``tuner.interval`` observations once ``tuner.minSamples`` have been seen.
+Retuning changes shapes, which costs one recompile wave per changed
+bucket, so auto mode is opt-in; `retune()` can always be driven manually
+(e.g. after a representative warmup query)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["BucketTuner"]
+
+LANE = 128
+
+
+class BucketTuner:
+    _instance: Optional["BucketTuner"] = None
+    _cls_lock = threading.Lock()
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        # op -> lane-quantized row count -> observations
+        self._hist: Dict[str, Dict[int, int]] = {}
+        self._total = 0
+        self._enabled = False
+        self._max_buckets = 8
+        self._min_samples = 64
+        self._interval = 256
+        self._installed: Tuple[int, ...] = ()
+
+    @classmethod
+    def get(cls) -> "BucketTuner":
+        with cls._cls_lock:
+            if cls._instance is None:
+                cls._instance = BucketTuner()
+            return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        from ..columnar import padding
+        with cls._cls_lock:
+            cls._instance = None
+        padding.install_tuned_buckets(())
+        padding.set_bucket_observer(None)
+
+    # ------------------------------------------------------------------
+    def configure(self, conf) -> None:
+        from ..columnar import padding
+        with self._mu:
+            self._enabled = bool(
+                conf.get("spark.rapids.tpu.compile.tuner.enabled"))
+            self._max_buckets = int(
+                conf.get("spark.rapids.tpu.compile.tuner.maxBuckets"))
+            self._min_samples = int(
+                conf.get("spark.rapids.tpu.compile.tuner.minSamples"))
+            self._interval = int(
+                conf.get("spark.rapids.tpu.compile.tuner.interval"))
+        # observation is always on (a dict bump per bucketed batch);
+        # LADDER application is what the enable flag gates
+        padding.set_bucket_observer(self.record)
+
+    # ------------------------------------------------------------------
+    def record(self, op: Optional[str], n: int) -> None:
+        """One observed batch row count for `op` (None = unattributed)."""
+        if n <= 0:
+            return
+        q = ((int(n) + LANE - 1) // LANE) * LANE
+        retune = False
+        with self._mu:
+            self._hist.setdefault(op or "?", {}).setdefault(q, 0)
+            self._hist[op or "?"][q] += 1
+            self._total += 1
+            retune = (self._enabled and self._total >= self._min_samples
+                      and self._total % self._interval == 0)
+        if retune:
+            self.retune()
+
+    def observations(self) -> Dict[str, Dict[int, int]]:
+        with self._mu:
+            return {op: dict(h) for op, h in self._hist.items()}
+
+    def total_observations(self) -> int:
+        with self._mu:
+            return self._total
+
+    @property
+    def installed(self) -> Tuple[int, ...]:
+        return self._installed
+
+    # ------------------------------------------------------------------
+    def suggest(self) -> Tuple[int, ...]:
+        """Derive the ladder from the pooled histogram (empty = no data)."""
+        with self._mu:
+            pooled: Dict[int, int] = {}
+            for h in self._hist.values():
+                for q, c in h.items():
+                    pooled[q] = pooled.get(q, 0) + c
+            k = self._max_buckets
+        if not pooled:
+            return ()
+        sizes = sorted(pooled)
+        total = sum(pooled.values())
+        cum, acc = [], 0
+        for s in sizes:
+            acc += pooled[s]
+            cum.append(acc)
+        ladder: List[int] = []
+        for i in range(1, k + 1):
+            target = total * i / k
+            # smallest size covering the i/k-th quantile of observations
+            for s, c in zip(sizes, cum):
+                if c >= target:
+                    if not ladder or s > ladder[-1]:
+                        ladder.append(s)
+                    break
+        if ladder[-1] != sizes[-1]:
+            ladder.append(sizes[-1])
+        return tuple(ladder)
+
+    def retune(self) -> Tuple[int, ...]:
+        """Compute and install the learned ladder; returns it (empty tuple
+        = nothing installed, geometric ladder stays)."""
+        from ..columnar import padding
+        ladder = self.suggest()
+        if ladder:
+            padding.install_tuned_buckets(ladder)
+            self._installed = ladder
+        return ladder
+
+    def clear(self) -> None:
+        from ..columnar import padding
+        with self._mu:
+            self._hist.clear()
+            self._total = 0
+            self._installed = ()
+        padding.install_tuned_buckets(())

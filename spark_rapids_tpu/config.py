@@ -379,6 +379,48 @@ register("spark.rapids.tpu.mesh.shape", "string", "",
          "Logical device mesh as 'name=N,name=M' (empty = single device).",
          startup_only=True)
 
+# Compile service --------------------------------------------------------------------
+register("spark.rapids.tpu.compile.enabled", "bool", True,
+         "Route every kernel compile through the centralized compile "
+         "service (keyed program cache + single-flight dedup + compile "
+         "accounting). Off = direct per-call-site jax.jit, no caching "
+         "policy or metrics.")
+register("spark.rapids.tpu.compile.cache.maxPrograms", "int", 512,
+         "In-memory LRU capacity of the compile service's program cache "
+         "(one entry per op x static-args x input-shape signature).")
+register("spark.rapids.tpu.compile.cache.dir", "string", "",
+         "Directory for the persistent compile-cache tier (serialized "
+         "programs, CRC32C-framed; a corrupt entry is a miss + delete). "
+         "Empty disables persistence; the in-memory tier still runs.")
+register("spark.rapids.tpu.compile.warmup.enabled", "bool", False,
+         "Precompile hot operator programs on a background thread at "
+         "device init: preload every persistent-tier entry, then compile "
+         "the generic row-movement kernels over warmup.schema x the "
+         "padding bucket ladder, so the first query hits warm "
+         "executables.")
+register("spark.rapids.tpu.compile.warmup.ops", "string",
+         "concat,sortpos,slice",
+         "Synthetic warmup kernel families: concat (coalesce/exchange "
+         "batch concat), sortpos (out-of-core merge position sort), "
+         "slice (partition slice).")
+register("spark.rapids.tpu.compile.warmup.schema", "string", "long,double",
+         "Schema template for synthetic warmup batches (csv of "
+         "long,int,double,float,bool,string).")
+register("spark.rapids.tpu.compile.warmup.maxRows", "int", 1 << 20,
+         "Top of the padding-bucket ladder the synthetic warmup walks.")
+register("spark.rapids.tpu.compile.tuner.enabled", "bool", False,
+         "Adaptive bucket tuner auto mode: learn a padding-bucket ladder "
+         "from observed batch row counts and re-install it every "
+         "tuner.interval observations (observation/manual retune() is "
+         "always available; auto mode costs one recompile wave per ladder "
+         "change).")
+register("spark.rapids.tpu.compile.tuner.maxBuckets", "int", 8,
+         "Maximum rungs in the learned bucket ladder.")
+register("spark.rapids.tpu.compile.tuner.minSamples", "int", 64,
+         "Observations required before the tuner's auto mode may retune.")
+register("spark.rapids.tpu.compile.tuner.interval", "int", 256,
+         "Auto-mode retune cadence (every N observed batches).")
+
 
 class TpuConf:
     """Instance view over a settings dict, with typed accessors (reference
@@ -403,6 +445,11 @@ class TpuConf:
 
     def set(self, key: str, value: Any) -> "TpuConf":
         self._settings[key] = value
+        if key.startswith("spark.rapids.tpu.padding."):
+            # padding params are memoized on the hot bucket path; drop the
+            # memo so the next row_bucket sees the new value
+            from .columnar import padding
+            padding.invalidate_cache()
         return self
 
     def get_bool(self, key: str, default: bool = True) -> bool:

@@ -70,6 +70,14 @@ class InjectedFault(RapidsTpuError, IOError):
     injection points on I/O seams are caught by existing handlers."""
 
 
+class CompileServiceWarning(RuntimeWarning):
+    """The compile service degraded — a compile failed/was injected to
+    fail, a persisted entry was poisoned, or a cached executable rejected a
+    call — and the affected kernel fell back to a direct `jax.jit`. The
+    query's RESULT is unaffected (the direct path traces the identical
+    function); only caching/latency is."""
+
+
 class ShuffleCorruptionError(RapidsTpuError):
     """A shuffle block frame failed its CRC32C integrity check (or its
     framing was unreadable). Carries the block and where the bytes came from;

@@ -18,6 +18,7 @@ import numpy as np
 
 from .. import types as T
 from ..columnar.batch import ColumnarBatch, Schema
+from ..compile import instance_jit, kernel_key
 from ..expr.base import Expression, Vec, bind_references, output_name
 from ..expr.aggregates import (AggregateFunction, ApproximatePercentile,
                                Average, CollectList, CollectSet, Count, First,
@@ -233,9 +234,20 @@ class TpuHashAggregateExec(UnaryTpuExec):
         # never the black-box expressions — so they stay jitted even in
         # eager mode
         jitted = kernel if (self._eager and not input_partial) \
-            else jax.jit(kernel)
+            else instance_jit(
+                kernel, op="exec.aggregate",
+                key=self._agg_kernel_key(input_partial, output_partial),
+                msgs_box=msgs_box)
         self._kernel_boxes[jitted] = msgs_box
         return jitted
+
+    def _agg_kernel_key(self, input_partial: bool,
+                        output_partial: bool) -> str:
+        return kernel_key(
+            input_partial, output_partial,
+            [repr(e) for e in self._bound_groups],
+            [(repr(a.func), a.name) for a in self._bound_aggs],
+            self._schema, self._partial_schema, conf=self.conf)
 
     def _run(self, kernel, batch: ColumnarBatch) -> ColumnarBatch:
         """Invoke an aggregation kernel and surface its ANSI error flags
@@ -534,7 +546,9 @@ class TpuHashAggregateExec(UnaryTpuExec):
             # module-level cache keyed by self would pin every exec forever)
             if self._sp_maxes_jit is None:
                 self._sp_maxes_jit = self._sp_group_maxes if self._eager \
-                    else jax.jit(self._sp_group_maxes)
+                    else instance_jit(
+                        self._sp_group_maxes, op="exec.aggregate.sp_maxes",
+                        key=self._agg_kernel_key(False, False))
             maxes = self._sp_maxes_jit(b)
             ks = tuple(
                 width_bucket(max(int(m), 1)) if isinstance(
@@ -548,7 +562,11 @@ class TpuHashAggregateExec(UnaryTpuExec):
                 import functools
                 kern = functools.partial(self._sp_kernel, ks=ks)
                 if not self._eager:
-                    kern = jax.jit(kern)
+                    kern = instance_jit(
+                        kern, op="exec.aggregate.single_pass",
+                        key=kernel_key(self._agg_kernel_key(False, False),
+                                       ks),
+                        msgs_box=self._err_msgs)
                 self._sp_kernel_jit[ks] = kern
             out = self._run(kern, b)
         self.num_output_rows.add(out.row_count())

@@ -6,13 +6,13 @@ from __future__ import annotations
 
 from typing import Iterator, List, Sequence
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .. import types as T
 from ..columnar.batch import ColumnarBatch, Schema
 from ..columnar.padding import row_bucket
+from ..compile import instance_jit, kernel_key
 from ..expr.base import (EvalContext, Expression, Vec, bind_references,
                          output_name)
 from ..ops.rowops import compact_vecs
@@ -87,7 +87,10 @@ class TpuProjectExec(UnaryTpuExec):
         # equivalent of the reference splitting ArrowEvalPython into its own
         # exec (GpuArrowEvalPythonExec.scala:235).
         self._kernel = kernel if self._has_host_black_box() else \
-            jax.jit(kernel)
+            instance_jit(kernel, op="exec.project",
+                         key=kernel_key(self._bound, self._schema,
+                                        conf=self.conf),
+                         msgs_box=self._err_msgs)
 
     def _has_host_black_box(self) -> bool:
         return has_host_black_box(self._bound)
@@ -136,7 +139,10 @@ class TpuFilterExec(UnaryTpuExec):
         # a condition containing a host black box (pandas UDF / eager
         # fanout expr) runs the kernel eagerly, like TpuProjectExec
         self._kernel = kernel if has_host_black_box([self._bound]) else \
-            jax.jit(kernel)
+            instance_jit(kernel, op="exec.filter",
+                         key=kernel_key(self._bound, child.output,
+                                        conf=self.conf),
+                         msgs_box=self._err_msgs)
 
     def do_execute(self):
         from .base import raise_kernel_errors
@@ -168,7 +174,7 @@ class TpuRangeExec(TpuExec):
         done = 0
         while done < total or (total == 0 and done == 0):
             count = min(self.batch_rows, total - done)
-            cap = row_bucket(count)
+            cap = row_bucket(count, op="range")
             base = self.start + done * self.step
             data = jnp.arange(cap, dtype=jnp.int64) * self.step + base
             col = Vec(T.LONG, data, jnp.ones(cap, dtype=bool))
@@ -208,7 +214,6 @@ class TpuExpandExec(UnaryTpuExec):
         self._err_msgs: list = []
         msgs_box = self._err_msgs
 
-        @jax.jit
         def kernel(batch: ColumnarBatch):
             from .base import kernel_errors
             ctx = device_ctx(batch, self.conf)
@@ -219,7 +224,10 @@ class TpuExpandExec(UnaryTpuExec):
                     for proj in bound]
             return outs, kernel_errors(ctx, msgs_box)
 
-        self._kernel = kernel
+        self._kernel = instance_jit(
+            kernel, op="exec.expand",
+            key=kernel_key(self._bound, self._schema, conf=self.conf),
+            msgs_box=self._err_msgs)
 
     @property
     def output(self) -> Schema:
@@ -282,7 +290,6 @@ class TpuSampleExec(UnaryTpuExec):
         self.seed = int(seed)
         frac, seed_v = self.fraction, self.seed
 
-        @jax.jit
         def kernel(batch: ColumnarBatch, row_offset):
             from ..ops.rowops import sample_mask
             vecs = batch_vecs(batch)
@@ -292,7 +299,9 @@ class TpuSampleExec(UnaryTpuExec):
             out_vecs, new_n = compact_vecs(jnp, vecs, keep)
             return vecs_to_batch(batch.schema, out_vecs, new_n)
 
-        self._kernel = kernel
+        self._kernel = instance_jit(
+            kernel, op="exec.sample",
+            key=kernel_key(self.fraction, self.seed, conf=self.conf))
 
     @property
     def output(self) -> Schema:

@@ -7,15 +7,14 @@ One fused kernel per (input shapes, out_cap) signature."""
 
 from __future__ import annotations
 
-import functools
 from typing import Iterator, List, Sequence
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..columnar.batch import ColumnarBatch, Schema
 from ..columnar.padding import row_bucket
+from ..compile import sjit
 from ..expr.base import Vec
 from ..ops.rowops import compact_vecs
 from ..utils import metrics as M
@@ -107,7 +106,7 @@ def _concat_overflow_strings(vs: List[Vec]) -> Vec:
                 jnp.zeros(0, jnp.uint8), jnp.concatenate(starts)))
 
 
-@functools.partial(jax.jit, static_argnums=(1,))
+@sjit(op="exec.coalesce.concat", static_argnums=(1,))
 def _concat_kernel(batches: List[ColumnarBatch], out_cap: int) -> ColumnarBatch:
     schema = batches[0].schema
     ncols = len(schema.types)
@@ -126,7 +125,7 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
     if len(batches) == 1:
         return batches[0]
     total = sum(b.row_count() for b in batches)
-    out_cap = row_bucket(total)
+    out_cap = row_bucket(total, op="coalesce")
     return _concat_kernel(batches, out_cap)
 
 

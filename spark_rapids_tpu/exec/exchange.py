@@ -23,6 +23,7 @@ import numpy as np
 
 from .. import types as T
 from ..columnar.batch import ColumnarBatch, Schema
+from ..compile import sjit
 from ..expr.base import Vec
 from ..ops.rowops import compact_vecs
 from ..parallel.partitioning import (HashPartitioning, RangePartitioning,
@@ -348,7 +349,7 @@ class TpuShuffleExchangeExec(UnaryTpuExec):
         return f"[{self.spec}]"
 
 
-@jax.jit
+@sjit(op="exec.exchange.slice")
 def _slice_vecs(vecs, pid, p):
     keep = pid == p
     return compact_vecs(jnp, vecs, keep)

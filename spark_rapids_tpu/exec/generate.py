@@ -9,16 +9,15 @@ the slot-count prefix sum, and to element pos k = j - base[pi]."""
 
 from __future__ import annotations
 
-import functools
 from typing import Iterator
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .. import types as T
 from ..columnar.batch import ColumnarBatch, Schema
 from ..columnar.padding import row_bucket
+from ..compile import sjit
 from ..expr.base import (Expression, Vec, bind_references,
                          vec_map_arrays as _map_elem)
 from ..expr.collections import Explode
@@ -27,7 +26,7 @@ from .base import (StaticExpr as _StaticExpr, TpuExec, UnaryTpuExec,
                    batch_vecs, vecs_to_batch)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+@sjit(op="exec.generate.counts", static_argnums=(1, 2, 3))
 def _gen_counts(batch: ColumnarBatch, gen, outer: bool, ansi: bool = False):
     from ..expr.base import EvalContext
     from .base import kernel_errors
@@ -47,7 +46,7 @@ def _gen_counts(batch: ColumnarBatch, gen, outer: bool, ansi: bool = False):
         kernel_errors(ctx, gen.err_msgs)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5))
+@sjit(op="exec.generate.expand", static_argnums=(1, 2, 3, 4, 5))
 def _gen_expand(batch: ColumnarBatch, gen, out_cap: int, outer: bool,
                 position: bool, ansi: bool = False):
     from ..expr.base import EvalContext
@@ -106,8 +105,9 @@ class TpuGenerateExec(UnaryTpuExec):
                 if n_total == 0:
                     continue
                 out_vecs, n = _gen_expand(b, self._bound,
-                                          row_bucket(n_total), g.outer,
-                                          g.position, ansi)
+                                          row_bucket(n_total,
+                                                     op="generate"),
+                                          g.outer, g.position, ansi)
                 out = vecs_to_batch(self._schema, out_vecs, n)
             self.num_output_rows.add(out.row_count())
             yield self._count_output(out)

@@ -15,16 +15,15 @@ reference's GpuShuffledHashJoinExec with BuildRight."""
 
 from __future__ import annotations
 
-import functools
 from typing import Iterator, List, Sequence, Tuple
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .. import types as T
 from ..columnar.batch import ColumnarBatch, Schema, join_output_schema
 from ..columnar.padding import row_bucket
+from ..compile import sjit
 from ..expr.base import Expression, Vec, bind_references
 from ..expr.hashing import hash_vecs
 from ..expr.predicates import string_equal
@@ -55,7 +54,7 @@ def _keys_equal(xp, a: List[Vec], b: List[Vec]):
     return eq
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3))
+@sjit(op="exec.join.probe_counts", static_argnums=(2, 3))
 def _probe_counts(probe: ColumnarBatch, build: ColumnarBatch,
                   probe_key_ix: Tuple[int, ...], build_key_ix: Tuple[int, ...]):
     """Phase 1: per-probe candidate counts (by hash range) + sorted build order."""
@@ -81,7 +80,7 @@ def _probe_counts(probe: ColumnarBatch, build: ColumnarBatch,
     return counts, lo.astype(np.int32), order.astype(np.int32), pvalid, bvalid
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7))
+@sjit(op="exec.join.expand", static_argnums=(2, 3, 4, 5, 6, 7))
 def _expand_join(probe: ColumnarBatch, build: ColumnarBatch,
                  probe_key_ix: Tuple[int, ...], build_key_ix: Tuple[int, ...],
                  out_cap: int, join_type: str, condition=None,
@@ -175,7 +174,7 @@ def _expand_join(probe: ColumnarBatch, build: ColumnarBatch,
     return compacted, n, bmatched, cond_errs
 
 
-@functools.partial(jax.jit, static_argnums=(1,))
+@sjit(op="exec.join.unmatched_build", static_argnums=(1,))
 def _unmatched_build(build: ColumnarBatch, ncols_left: int, bmatched):
     """full/right outer: build rows never matched -> rows with null left side."""
     xp = jnp
@@ -400,9 +399,9 @@ class TpuShuffledHashJoinExec(TpuExec):
                              jnp.maximum(counts, 1) if outer_left else counts, 0)
             total = int(jnp.sum(slot))
             if self.join_type in ("semi", "anti", "existence"):
-                out_cap = max(row_bucket(max(total, 1)), probe.capacity)
+                out_cap = max(row_bucket(max(total, 1), op="join"), probe.capacity)
             else:
-                out_cap = row_bucket(max(total, 1))
+                out_cap = row_bucket(max(total, 1), op="join")
             out_vecs, n, bmatched, cond_errs = _expand_join(
                 probe, build, self._lk_ix, self._rk_ix, out_cap,
                 self.join_type, self._bcond, self.conf.is_ansi)
@@ -478,7 +477,7 @@ class TpuShuffledHashJoinExec(TpuExec):
         return f"[{self.join_type}, keys={[repr(e) for e in self.left_keys]}]"
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2))
+@sjit(op="exec.join.hash_pid", static_argnums=(1, 2))
 def _hash_pid(batch: ColumnarBatch, key_ix: Tuple[int, ...], p: int):
     vecs = batch_vecs(batch)
     keys = [vecs[i] for i in key_ix]
@@ -507,7 +506,7 @@ def _null_vecs(schema: Schema, cap: int) -> List[Vec]:
     return [zero_vec(jnp, dt, (cap,)) for dt in schema.types]
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3))
+@sjit(op="exec.join.nl_matched", static_argnums=(2, 3))
 def _nl_matched(probe: ColumnarBatch, bchunk: ColumnarBatch, cond,
                 ansi: bool = False):
     """All-pairs tile: matched mask over the P x C grid (flattened row-major),
@@ -533,7 +532,7 @@ def _nl_matched(probe: ColumnarBatch, bchunk: ColumnarBatch, cond,
         xp.sum(m).astype(np.int32), cond_errs
 
 
-@functools.partial(jax.jit, static_argnums=(2,))
+@sjit(op="exec.join.nl_expand", static_argnums=(2,))
 def _nl_expand(probe: ColumnarBatch, bchunk: ColumnarBatch, out_cap: int,
                matched):
     """Gather the surviving pairs of an all-pairs tile into output columns."""
@@ -548,7 +547,7 @@ def _nl_expand(probe: ColumnarBatch, bchunk: ColumnarBatch, out_cap: int,
     return left_out + right_out, n
 
 
-@jax.jit
+@sjit(op="exec.join.compact_rows")
 def _compact_rows(batch: ColumnarBatch, want):
     return compact_vecs(jnp, batch_vecs(batch), want & batch.row_mask())
 
@@ -635,7 +634,7 @@ class TpuNestedLoopJoinExec(TpuExec):
                             if n_total == 0:
                                 continue
                             out_vecs, n = _nl_expand(probe, bchunk,
-                                                     row_bucket(n_total), m)
+                                                     row_bucket(n_total, op="join"), m)
                         yield self._emit(vecs_to_batch(self._schema,
                                                        out_vecs, n))
                     yield from self._emit_probe_tail(probe, pmatched)

@@ -11,15 +11,14 @@ one chunk; payloads never visit the host."""
 
 from __future__ import annotations
 
-import functools
 from typing import Iterator, List, Sequence, Tuple
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .. import types as T
 from ..columnar.batch import ColumnarBatch, Schema
+from ..compile import instance_jit, kernel_key, sjit
 from ..expr.base import Expression, Vec, bind_references
 from ..ops.rowops import gather_vecs, lexsort_indices, sort_keys_for
 from ..utils import metrics as M
@@ -42,7 +41,6 @@ class TpuSortExec(UnaryTpuExec):
         self._err_msgs: list = []
         msgs_box = self._err_msgs
 
-        @jax.jit
         def kernel(batch: ColumnarBatch):
             from .base import kernel_errors
             ctx = device_ctx(batch, self.conf)
@@ -56,7 +54,11 @@ class TpuSortExec(UnaryTpuExec):
             return vecs_to_batch(batch.schema, out, batch.num_rows), \
                 kernel_errors(ctx, msgs_box)
 
-        self._kernel = kernel
+        self._kernel = instance_jit(
+            kernel, op="exec.sort",
+            key=kernel_key([(repr(e), a, nf) for e, a, nf in bound],
+                           conf=self.conf),
+            msgs_box=self._err_msgs)
 
     def sort_single_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
         from .base import raise_kernel_errors
@@ -203,7 +205,7 @@ class TpuSortExec(UnaryTpuExec):
         return f"[{[(repr(e), a, nf) for e, a, nf in self.orders]}]"
 
 
-@functools.partial(jax.jit, static_argnums=(4,))
+@sjit(op="exec.sort.gather_pos", static_argnums=(4,))
 def _gather_rows_with_pos(batch: ColumnarBatch, idx, pos, count,
                           pos_schema: Schema):
     vecs = gather_vecs(jnp, batch_vecs(batch), idx)
@@ -211,7 +213,7 @@ def _gather_rows_with_pos(batch: ColumnarBatch, idx, pos, count,
     return vecs_to_batch(pos_schema, vecs, count)
 
 
-@jax.jit
+@sjit(op="exec.sort.by_pos")
 def _sort_by_pos(batch: ColumnarBatch) -> ColumnarBatch:
     vecs = batch_vecs(batch)
     mask = batch.row_mask()
@@ -246,7 +248,6 @@ class TpuTopKExec(UnaryTpuExec):
         self._err_msgs: list = []
         msgs_box = self._err_msgs
 
-        @jax.jit
         def topk(batch: ColumnarBatch):
             from .base import kernel_errors
             ctx = device_ctx(batch, self.conf)
@@ -264,7 +265,11 @@ class TpuTopKExec(UnaryTpuExec):
             return vecs_to_batch(batch.schema, out, new_n), \
                 kernel_errors(ctx, msgs_box)
 
-        self._topk_kernel = topk
+        self._topk_kernel = instance_jit(
+            topk, op="exec.topk",
+            key=kernel_key([(repr(e), a, nf) for e, a, nf in bound],
+                           kcap, k, conf=self.conf),
+            msgs_box=self._err_msgs)
 
     def _topk(self, batch: ColumnarBatch) -> ColumnarBatch:
         from .base import raise_kernel_errors

@@ -24,7 +24,7 @@ from .base import TpuExec, batch_vecs
 
 def host_batch_to_device(hb: HostBatch) -> ColumnarBatch:
     n = hb.num_rows
-    cap = row_bucket(n)
+    cap = row_bucket(n, op="transition")
     cols = []
     for v in hb.vecs:
         if v.is_nested:

@@ -29,6 +29,7 @@ import numpy as np
 
 from .. import types as T
 from ..columnar.batch import ColumnarBatch, Schema
+from ..compile import instance_jit, kernel_key
 from ..expr.base import Expression, Vec, bind_references
 from ..expr.windowexprs import (CumeDist, DenseRank, Lag, Lead, NTile,
                                 PercentRank, RangeFrame, Rank, RowFrame,
@@ -239,7 +240,6 @@ class TpuWindowExec(UnaryTpuExec):
         self._err_msgs: list = []
         msgs_box = self._err_msgs
 
-        @jax.jit
         def kernel(batch: ColumnarBatch):
             from .base import kernel_errors
             ctx = device_ctx(batch, self.conf)
@@ -288,7 +288,13 @@ class TpuWindowExec(UnaryTpuExec):
             return vecs_to_batch(self._schema, out, batch.num_rows), \
                 kernel_errors(ctx, msgs_box)
 
-        self._kernel = kernel
+        self._kernel = instance_jit(
+            kernel, op="exec.window",
+            key=kernel_key([(repr(f), n) for f, n in self._bound_fns],
+                           [repr(e) for e in bound_part],
+                           [(repr(e), a, nf) for e, a, nf in bound_order],
+                           self._schema, conf=self.conf),
+            msgs_box=self._err_msgs)
 
     @property
     def output(self) -> Schema:

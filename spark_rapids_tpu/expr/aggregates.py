@@ -150,6 +150,12 @@ class First(AggregateFunction):
     update_ops = ["first"]
     merge_ops = ["first"]
 
+    def __repr__(self):
+        # ignore_nulls changes the traced program, so it must be visible to
+        # repr-derived compile-cache keys (compile/service.py)
+        extra = ", ignore_nulls" if self.ignore_nulls else ""
+        return f"{self.name}({self.children[0]!r}{extra})"
+
     @property
     def data_type(self):
         return self.child.data_type
@@ -248,6 +254,12 @@ class ApproximatePercentile(AggregateFunction):
         self.scalar = not isinstance(percentages, (list, tuple))
         self.percentages = [percentages] if self.scalar else list(percentages)
         self.accuracy = accuracy
+
+    def __repr__(self):
+        # percentages select output ranks inside the traced kernel: keep
+        # them in repr so compile-cache keys can't alias two configurations
+        return (f"{self.name}({self.children[0]!r}, "
+                f"{self.percentages}, {self.accuracy})")
 
     @property
     def data_type(self):

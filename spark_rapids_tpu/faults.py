@@ -21,6 +21,8 @@ Injection points (the catalog; call sites reference these constants):
   tcp.recv            shuffle/tcp_transport.py reply receive
   service.admission   service/server.py    admission token grant
   device.init         memory/device_manager.py backend first touch
+  compile             compile/service.py   XLA compile + persisted-entry
+                                           read (corruptible payload)
 
 A rule fires on the Nth eligible call (`nth`), or with seeded probability
 (`probability`), at most `times` times (0 = unlimited). Kinds:
@@ -50,7 +52,8 @@ from .errors import InjectedFault, RetryOOM, SplitAndRetryOOM
 __all__ = ["FaultRule", "FaultInjector", "fire", "inject",
            "install_from_conf", "ALL_POINTS",
            "ALLOC", "SPILL_WRITE", "SPILL_READ", "BLOCK_WRITE", "BLOCK_READ",
-           "FETCH", "TCP_SEND", "TCP_RECV", "ADMISSION", "DEVICE_INIT"]
+           "FETCH", "TCP_SEND", "TCP_RECV", "ADMISSION", "DEVICE_INIT",
+           "COMPILE"]
 
 ALLOC = "memory.alloc"
 SPILL_WRITE = "spill.write"
@@ -62,9 +65,10 @@ TCP_SEND = "tcp.send"
 TCP_RECV = "tcp.recv"
 ADMISSION = "service.admission"
 DEVICE_INIT = "device.init"
+COMPILE = "compile"
 
 ALL_POINTS = (ALLOC, SPILL_WRITE, SPILL_READ, BLOCK_WRITE, BLOCK_READ,
-              FETCH, TCP_SEND, TCP_RECV, ADMISSION, DEVICE_INIT)
+              FETCH, TCP_SEND, TCP_RECV, ADMISSION, DEVICE_INIT, COMPILE)
 
 # named exception factories for the config-spec grammar
 _ERROR_NAMES: Dict[str, Callable[[str], Exception]] = {

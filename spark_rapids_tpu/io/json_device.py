@@ -128,7 +128,7 @@ def _decode_rows(scan, row_starts, row_ends, blob_dev):
     nrows = int(row_starts.size)
     lens = (row_ends - row_starts).astype(np.int32)
     w = width_bucket(max(int(lens.max()), 1))
-    cap = row_bucket(nrows)
+    cap = row_bucket(nrows, op="scan.json")
     starts_d = jnp.asarray(np.pad(row_starts, (0, cap - nrows)))
     lens_d = jnp.asarray(np.pad(lens, (0, cap - nrows)))
     defined = jnp.arange(cap) < nrows

@@ -947,7 +947,7 @@ def decode_stripe(info: OrcFileInfo, f, si: int, schema, host_cols=None):
 
     st = info.stripes[si]
     nrows = st.num_rows
-    cap = row_bucket(nrows)
+    cap = row_bucket(nrows, op="scan.orc")
     host_cols = set(host_cols or ())
     host_decoded = _host_decode_stripe_cols(info, si, schema, host_cols,
                                             cap, nrows)

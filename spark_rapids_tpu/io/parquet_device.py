@@ -887,7 +887,7 @@ def _device_phase(pf, rg: int, schema, works, nrows: int, host_cols=None):
     import jax
     import jax.numpy as jnp
     from ..columnar.batch import ColumnarBatch
-    cap = row_bucket(nrows)
+    cap = row_bucket(nrows, op="scan.parquet")
     host_decoded = _host_decode_cols(pf, rg, schema, host_cols or (),
                                      cap, nrows)
 
@@ -1238,7 +1238,9 @@ def _fused_decode_program(sig_tuple, cap: int):
             outs.append((data, validity))
         return tuple(outs)
 
-    return jax.jit(fn)
+    from ..compile import sjit
+    return sjit(fn, op="io.parquet.fused_decode",
+                key=repr((sig_tuple, cap)))
 
 
 def _assemble_fixed(chunk: _Chunk, phys: str, dt, defined, cap: int,

@@ -186,7 +186,9 @@ def _pack_kernel():
     import jax
     import jax.numpy as jnp
 
-    @jax.jit
+    from ..compile import sjit
+
+    @sjit(op="io.parquet.pack")
     def pack(data, validity):
         # stable compaction: k-th non-null value lands at slot k
         order = jnp.argsort(~validity, stable=True)

@@ -38,6 +38,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..compile import sjit
+
 __all__ = ["segment_sum_f64", "MAX_SEGMENTS"]
 
 SUB = 8        # sublanes per DMA block
@@ -118,7 +120,7 @@ def _make_kernel(n_blocks: int, g: int):
     return kernel
 
 
-@functools.partial(jax.jit, static_argnums=(2,))
+@sjit(op="ops.segment_sum_f64", static_argnums=(2,))
 def segment_sum_f64(values, segment_ids, num_segments: int):
     """f64 segmented sum of `values` by int32 `segment_ids` (unsorted).
     num_segments must be static and <= MAX_SEGMENTS. Rows with ids outside

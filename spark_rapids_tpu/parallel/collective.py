@@ -177,4 +177,7 @@ def build_exchange_fn(mesh: Mesh, ndev: int, slot_cap: Optional[int] = None,
         in_specs=(P(axis), P(axis)),
         out_specs=(P(axis), P(axis), P()),
     )
-    return jax.jit(sharded)
+    from ..compile import instance_jit, kernel_key
+    return instance_jit(
+        sharded, op="parallel.exchange",
+        key=kernel_key(repr(mesh), ndev, slot_cap, axis))

@@ -102,12 +102,14 @@ def make_distributed_query_step(mesh: Mesh, ndev: int, n_groups: int,
         total_rows = jax.lax.psum(rows, axis)
         return total, total_rows
 
-    fn = jax.jit(shard_map(
+    from ..compile import instance_jit, kernel_key
+    fn = instance_jit(shard_map(
         device_step, mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis),
                   P(axis), P(axis), P(axis)),
         out_specs=(P(), P()),
-    ))
+    ), op="parallel.query_step",
+        key=kernel_key(repr(mesh), axis, n_groups))
 
     def shard_fn(inputs: QueryStepInputs) -> QueryStepInputs:
         sh = NamedSharding(mesh, P(axis))

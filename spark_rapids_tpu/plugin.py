@@ -33,6 +33,10 @@ class TpuSession:
             return
         from . import faults
         faults.install_from_conf(self.conf)
+        from .compile import CompileService
+        # compile service first: warmup precompiles on a background thread
+        # while the rest of init (and the first plan rewrite) proceeds
+        CompileService.get().configure(self.conf)
         from .memory.device_manager import DeviceManager
         DeviceManager.initialize(self.conf)
         self._device_initialized = True

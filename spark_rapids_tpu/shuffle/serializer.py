@@ -199,7 +199,7 @@ def concat_host_tables(tables: Sequence[HostTable]) -> ColumnarBatch:
         raise ValueError("no tables to concatenate")
     schema = tables[0].schema
     total = sum(t.num_rows for t in tables)
-    cap = row_bucket(total)
+    cap = row_bucket(total, op="shuffle")
     cols = []
     for i, dt in enumerate(schema.types):
         if isinstance(dt, T.StringType):

@@ -131,6 +131,15 @@ class TaskMetrics:
         self.shuffle_retry_count = 0
         self.shuffle_refetch_count = 0
         self.shuffle_failover_count = 0
+        # compile-service counters (compile/service.py): real XLA compiles
+        # this task triggered, wall ns inside them, program-cache traffic,
+        # persistent-tier loads, and degraded direct-jit fallbacks
+        self.compile_count = 0
+        self.compile_ns = 0
+        self.compile_cache_hits = 0
+        self.compile_cache_misses = 0
+        self.compile_persist_hits = 0
+        self.compile_fallbacks = 0
 
     @classmethod
     def get(cls) -> "TaskMetrics":
@@ -161,4 +170,14 @@ class TaskMetrics:
                 f"shuffleFetchRetries={self.shuffle_retry_count} "
                 f"shuffleRefetches={self.shuffle_refetch_count} "
                 f"shuffleFailovers={self.shuffle_failover_count}")
+        if self.compile_count or self.compile_cache_hits or \
+                self.compile_cache_misses or self.compile_persist_hits or \
+                self.compile_fallbacks:
+            parts.append(
+                f"compiles={self.compile_count} "
+                f"compileMs={self.compile_ns / 1e6:.1f} "
+                f"compileCacheHits={self.compile_cache_hits} "
+                f"compileCacheMisses={self.compile_cache_misses} "
+                f"compilePersistHits={self.compile_persist_hits} "
+                f"compileFallbacks={self.compile_fallbacks}")
         return "" if not parts else "TaskMetrics: " + "; ".join(parts)

@@ -30,6 +30,11 @@ def pytest_configure(config):
         "markers",
         "faults: deterministic fault-injection matrix "
         "(scripts/fault_matrix.sh runs these standalone)")
+    config.addinivalue_line(
+        "markers",
+        "compile: compile-service suite (program cache / persistent tier / "
+        "warmup / bucket tuner; scripts/compile_cache_matrix.sh runs these "
+        "standalone)")
 
 
 @pytest.fixture

@@ -1,0 +1,404 @@
+"""Compile service matrix (spark_rapids_tpu/compile/): keyed program cache,
+persistent tier, single-flight, fault degradation, warmup, bucket tuner,
+and the padding-conf memoization satellite.
+
+Acceptance contract (ISSUE 3):
+  * the same query run twice in one session shows cache hits and ZERO new
+    compiles on the second run (asserted via service stats + TaskMetrics);
+  * clearing the in-memory tier (simulated process restart) reloads
+    executables from the persistent tier without recompiling;
+  * injected `compile` faults degrade to direct jax.jit with a typed
+    warning (CompileServiceWarning) — never a wrong result;
+  * a poisoned persistent entry is a miss + delete, never a wrong program.
+"""
+
+import os
+import threading
+import warnings
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import faults
+from spark_rapids_tpu.columnar import padding
+from spark_rapids_tpu.compile import BucketTuner, CompileService, run_warmup
+from spark_rapids_tpu.config import get_default_conf
+from spark_rapids_tpu.errors import CompileServiceWarning
+from spark_rapids_tpu.expr import Count, Sum, col, lit
+from spark_rapids_tpu.plugin import TpuSession
+from spark_rapids_tpu.utils.metrics import TaskMetrics
+
+pytestmark = pytest.mark.compile
+
+
+@pytest.fixture
+def service():
+    """A fresh CompileService singleton per test (and restore after)."""
+    CompileService.reset()
+    svc = CompileService.get()
+    yield svc
+    CompileService.reset()
+    BucketTuner.reset()
+
+
+@pytest.fixture
+def session(service, tmp_path):
+    s = TpuSession({"spark.rapids.sql.enabled": True,
+                    "spark.rapids.sql.explain": "NONE",
+                    "spark.rapids.tpu.compile.cache.dir":
+                        str(tmp_path / "xla_cache")})
+    s.initialize_device()
+    return s
+
+
+def _table(rows=800, seed=0):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": pa.array((np.arange(rows) % 11).astype(np.int64)),
+        "v": pa.array(rng.uniform(0.0, 10.0, rows)),
+    })
+
+
+def _query(session, t):
+    df = session.from_arrow(t)
+    return (df.filter(col("k") > 2)
+              .group_by("k")
+              .agg(total=Sum(col("v")), n=Count(col("v")))
+              .collect()
+              .sort_by([("k", "ascending")]))
+
+
+class TestWarmVsCold:
+    def test_second_identical_query_zero_new_compiles(self, session,
+                                                      service):
+        t = _table()
+        r1 = _query(session, t)
+        after_cold = service.stats.totals()
+        assert after_cold["compiles"] > 0
+        assert TaskMetrics.get().compile_count > 0  # per-query counter
+
+        r2 = _query(session, t)
+        after_warm = service.stats.totals()
+        assert r1.equals(r2)
+        assert after_warm["compiles"] == after_cold["compiles"], \
+            "second identical query must not compile anything new"
+        assert after_warm["hits"] > after_cold["hits"]
+        # TaskMetrics resets per query: the warm query saw hits, no compiles
+        tm = TaskMetrics.get()
+        assert tm.compile_count == 0
+        assert tm.compile_cache_hits > 0
+        assert "compileCacheHits" in tm.explain_string()
+
+    def test_restart_reloads_from_persistent_tier(self, session, service):
+        t = _table()
+        r1 = _query(session, t)
+        warm = service.stats.totals()
+        assert warm["persist_stores"] > 0
+        assert len(os.listdir(service.persistent_dir)) == \
+            warm["persist_stores"]
+
+        service.clear_memory()  # simulated process restart
+        r2 = _query(session, t)
+        cold = service.stats.totals()
+        assert r1.equals(r2)
+        assert cold["compiles"] == warm["compiles"], \
+            "restart must reload persisted executables, not recompile"
+        assert cold["persist_hits"] > 0
+        assert TaskMetrics.get().compile_persist_hits > 0
+
+    def test_stats_tracked_per_op(self, session, service):
+        _query(session, _table())
+        per_op = service.stats.per_op()
+        assert any(op.startswith("exec.filter") for op in per_op)
+        assert any(op.startswith("exec.aggregate") for op in per_op)
+        for d in per_op.values():
+            assert d["compile_ns"] >= 0
+
+
+@pytest.mark.faults
+class TestCompileFaults:
+    def test_compile_fault_degrades_to_direct_jit(self, session, service):
+        t = _table(seed=3)
+        with faults.inject(faults.COMPILE, "error", nth=1) as rule:
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                r_fault = _query(session, t)
+        assert rule.fired == 1
+        assert any(isinstance(w.message, CompileServiceWarning)
+                   for w in caught), \
+            "degradation must surface a typed warning"
+        assert service.stats.totals()["fallbacks"] >= 1
+        # the direct-jit path computes the identical program
+        assert r_fault.equals(_query(session, t))
+
+    def test_compile_delay_fault_still_succeeds(self, session, service):
+        t = _table(seed=4)
+        with faults.inject(faults.COMPILE, "delay", nth=1,
+                           delay_s=0.05) as rule:
+            r = _query(session, t)
+        assert rule.fired == 1
+        assert r.equals(_query(session, t))
+
+    def test_injected_corruption_is_miss_plus_delete(self, session,
+                                                     service):
+        t = _table(seed=5)
+        r1 = _query(session, t)
+        baseline = service.stats.totals()
+        service.clear_memory()
+        # every persisted read returns flipped bytes -> CRC mismatch
+        with faults.inject(faults.COMPILE, "corrupt", nth=0, times=0):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                r2 = _query(session, t)
+        assert r2.equals(r1), "corruption must never produce wrong rows"
+        tot = service.stats.totals()
+        assert tot["poisoned"] >= 1
+        assert tot["compiles"] > baseline["compiles"], \
+            "poisoned entries recompile"
+        # deleted-then-repersisted: the tier stays usable
+        service.clear_memory()
+        r3 = _query(session, t)
+        assert r3.equals(r1)
+        assert service.stats.totals()["persist_hits"] > \
+            tot["persist_hits"]
+
+    def test_on_disk_garbage_is_rejected(self, session, service):
+        t = _table(seed=6)
+        r1 = _query(session, t)
+        # scribble over every persisted entry directly (torn write /
+        # truncation / foreign bytes)
+        for f in os.listdir(service.persistent_dir):
+            with open(os.path.join(service.persistent_dir, f), "wb") as fh:
+                fh.write(b"not a program")
+        service.clear_memory()
+        r2 = _query(session, t)
+        assert r2.equals(r1)
+        assert service.stats.totals()["poisoned"] >= 1
+
+
+class TestServiceMechanics:
+    def test_single_flight_dedups_concurrent_compiles(self, service):
+        import jax.numpy as jnp
+
+        from spark_rapids_tpu.compile import sjit
+
+        @sjit(op="test.single_flight")
+        def kernel(x):
+            return (x * 3 + 1).sum()
+
+        x = jnp.arange(4096, dtype=jnp.float64)
+        barrier = threading.Barrier(4)
+        results = []
+
+        def worker():
+            barrier.wait()
+            results.append(float(kernel(x)))
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert len(set(results)) == 1
+        st = service.stats.per_op()["test.single_flight"]
+        assert st["compiles"] == 1, \
+            f"concurrent callers must share one compile, saw {st}"
+
+    def test_distinct_shapes_get_distinct_programs(self, service):
+        import jax.numpy as jnp
+
+        from spark_rapids_tpu.compile import sjit
+
+        @sjit(op="test.shapes")
+        def kernel(x):
+            return x + 1
+
+        kernel(jnp.zeros(128))
+        kernel(jnp.zeros(256))
+        kernel(jnp.zeros(128))  # hit
+        st = service.stats.per_op()["test.shapes"]
+        assert st["compiles"] == 2
+        assert st["hits"] == 1
+
+    def test_static_args_key_the_program(self, service):
+        import jax.numpy as jnp
+
+        from spark_rapids_tpu.compile import sjit
+
+        @sjit(op="test.statics", static_argnums=(1,))
+        def kernel(x, k: int):
+            return x[:k].sum()
+
+        x = jnp.arange(512, dtype=jnp.float64)
+        assert float(kernel(x, 4)) == 6.0
+        assert float(kernel(x, 8)) == 28.0
+        assert float(kernel(x, 4)) == 6.0
+        st = service.stats.per_op()["test.statics"]
+        assert st["compiles"] == 2 and st["hits"] == 1
+
+    def test_disabled_service_is_direct_passthrough(self, tmp_path):
+        CompileService.reset()
+        svc = CompileService.get()
+        s = TpuSession({"spark.rapids.sql.enabled": True,
+                        "spark.rapids.sql.explain": "NONE",
+                        "spark.rapids.tpu.compile.enabled": False})
+        s.initialize_device()
+        t = _table(seed=7)
+        r = _query(s, t)
+        assert r.num_rows > 0
+        assert svc.stats.totals()["compiles"] == 0, \
+            "disabled service must not account compiles"
+        CompileService.reset()
+
+    def test_lru_bounds_memory_tier(self, service):
+        import jax.numpy as jnp
+
+        from spark_rapids_tpu.compile import sjit
+        service._max_programs = 2
+
+        @sjit(op="test.lru")
+        def kernel(x):
+            return x * 2
+
+        for n in (128, 256, 384):
+            kernel(jnp.zeros(n))
+        assert service.cached_programs() <= 2
+
+
+class TestWarmup:
+    def test_warmup_precompiles_generic_kernels(self, service, tmp_path):
+        from spark_rapids_tpu.config import TpuConf
+        conf = TpuConf({
+            "spark.rapids.tpu.compile.cache.dir": str(tmp_path / "wc"),
+            "spark.rapids.tpu.compile.warmup.maxRows": 1024,
+            "spark.rapids.tpu.compile.warmup.schema": "long,double",
+        })
+        service.configure(conf)  # warmup.enabled stays False: run inline
+        stats = run_warmup(conf, service)
+        assert stats["synthetic"] > 0
+        warm = service.stats.totals()["compiles"]
+        assert warm > 0
+        # a real concat at a warmed shape is now a pure cache hit
+        from spark_rapids_tpu.compile.warmup import make_warmup_batch
+        from spark_rapids_tpu.exec.coalesce import concat_batches
+        b = make_warmup_batch(["long", "double"], 128, 64)
+        concat_batches([b, b])
+        assert service.stats.totals()["compiles"] == warm, \
+            "warmed shape must not recompile"
+
+    def test_warmup_preloads_persistent_tier(self, session, service):
+        t = _table(seed=8)
+        _query(session, t)
+        service.clear_memory()
+        assert service.cached_programs() == 0
+        stats = run_warmup(session.conf, service)
+        assert stats["preloaded"] > 0
+        assert service.cached_programs() >= stats["preloaded"]
+        before = service.stats.totals()["compiles"]
+        _query(session, t)
+        assert service.stats.totals()["compiles"] == before
+
+    def test_background_warmup_thread_starts(self, tmp_path):
+        CompileService.reset()
+        svc = CompileService.get()
+        s = TpuSession({
+            "spark.rapids.sql.enabled": True,
+            "spark.rapids.tpu.compile.warmup.enabled": True,
+            "spark.rapids.tpu.compile.warmup.maxRows": 256,
+            "spark.rapids.tpu.compile.cache.dir": str(tmp_path / "bg"),
+        })
+        s.initialize_device()
+        assert svc.warmup_thread is not None
+        svc.warmup_thread.join(timeout=120)
+        assert not svc.warmup_thread.is_alive()
+        assert svc.stats.totals()["compiles"] > 0
+        CompileService.reset()
+
+
+class TestBucketTuner:
+    def test_observations_are_attributed(self, session, service):
+        tuner = BucketTuner.get()
+        tuner.clear()
+        _query(session, _table())
+        obs = tuner.observations()
+        assert sum(sum(h.values()) for h in obs.values()) > 0
+
+    def test_retune_installs_learned_ladder(self, service):
+        tuner = BucketTuner.get()
+        tuner.clear()
+        try:
+            # workload clustered at ~3000 and ~50000 rows
+            for _ in range(40):
+                tuner.record("scan", 3000)
+            for _ in range(10):
+                tuner.record("scan", 50_000)
+            ladder = tuner.retune()
+            assert ladder, "clustered observations must yield a ladder"
+            assert padding.tuned_buckets() == ladder
+            # observed sizes land exactly on a rung (no geometric slack)
+            assert padding.row_bucket(3000) == 3072
+            assert padding.row_bucket(50_000) == 50_048
+            # sizes beyond the ladder still grow geometrically
+            assert padding.row_bucket(200_000) >= 200_000
+        finally:
+            tuner.clear()
+
+    def test_retuned_buckets_cut_waste_vs_geometric(self, service):
+        tuner = BucketTuner.get()
+        tuner.clear()
+        try:
+            n = 33_000  # just past the 32768 geometric rung -> 2x waste
+            geometric_cap = padding.row_bucket(n)
+            for _ in range(32):
+                tuner.record("scan", n)
+            tuner.retune()
+            tuned_cap = padding.row_bucket(n)
+            assert tuned_cap < geometric_cap
+            assert (tuned_cap - n) / n < 0.01
+        finally:
+            tuner.clear()
+
+    def test_ladder_clears_back_to_geometric(self, service):
+        tuner = BucketTuner.get()
+        tuner.record("x", 5000)
+        tuner.retune()
+        tuner.clear()
+        assert padding.tuned_buckets() == ()
+        assert padding.row_bucket(129) == 256
+
+
+class TestPaddingMemoization:
+    def test_conf_change_invalidates_memo(self):
+        conf = get_default_conf()
+        orig = conf._settings.get("spark.rapids.tpu.padding.minRows")
+        try:
+            assert padding.row_bucket(1) == 128
+            conf.set("spark.rapids.tpu.padding.minRows", 512)
+            # TpuConf.set on a padding key must drop the memo immediately
+            assert padding.row_bucket(1) == 512
+        finally:
+            if orig is None:
+                conf._settings.pop("spark.rapids.tpu.padding.minRows",
+                                   None)
+            else:
+                conf._settings["spark.rapids.tpu.padding.minRows"] = orig
+            padding.invalidate_cache()
+            assert padding.row_bucket(1) == 128
+
+    def test_hot_path_skips_conf_registry(self, monkeypatch):
+        """row_bucket must not consult TpuConf.get per call once memoized."""
+        import spark_rapids_tpu.columnar.padding as pad
+        pad.row_bucket(100)  # prime the memo
+        calls = {"n": 0}
+        conf = get_default_conf()
+        real_get = conf.get
+
+        def counting_get(key):
+            calls["n"] += 1
+            return real_get(key)
+
+        monkeypatch.setattr(conf, "get", counting_get)
+        for _ in range(50):
+            pad.row_bucket(1000)
+        assert calls["n"] == 0, "memoized params must bypass conf.get"
