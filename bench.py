@@ -364,6 +364,106 @@ def scan_only() -> None:
         print(_MARK + json.dumps(scan_decode_bench(td)), flush=True)
 
 
+PROFILE_ROWS = 32_768
+PROFILE_DIM = 512
+PROFILE_GROUPS = 16
+
+
+def profile_query(log_dir: str, force_spill: bool = True) -> dict:
+    """Run two representative engine queries with the profiler's JSONL
+    event log enabled (ISSUE-4 flag: `--profile-query DIR`):
+
+      1. scan -> filter -> shuffle repartition -> hash join -> ORDER BY a
+         detail column — small batches force the out-of-core sort (runs
+         parked spillable) and, with `force_spill`, a tight device budget
+         makes parked runs spill to host for real;
+      2. scan -> grouped aggregation.
+
+    Together the emitted profile exercises every phase the report tool
+    breaks down (op/sort/join/agg/spill/shuffle timers all nonzero) and
+    gives the per-query comparison table two rows. Returns a summary
+    dict; the caller prints it as one JSON line."""
+    _apply_platform_override()
+    import pyarrow as pa
+    from spark_rapids_tpu.expr import Sum, col
+    from spark_rapids_tpu.plugin import TpuSession
+    from spark_rapids_tpu.utils.spans import validate_record
+
+    rng = np.random.default_rng(11)
+    n = PROFILE_ROWS
+    fact = pa.table({
+        "k": pa.array(rng.integers(0, PROFILE_DIM, n)),
+        "g": pa.array(rng.integers(0, PROFILE_GROUPS, n).astype(np.int32)),
+        "v": pa.array(rng.uniform(0.0, 1.0, n)),
+    })
+    dim = pa.table({
+        "k": pa.array(np.arange(PROFILE_DIM)),
+        "w": pa.array(rng.uniform(0.0, 1.0, PROFILE_DIM)),
+    })
+    session = TpuSession({
+        "spark.rapids.sql.enabled": True,
+        "spark.rapids.sql.explain": "NONE",
+        "spark.rapids.sql.metrics.level": "DEBUG",
+        "spark.rapids.tpu.metrics.eventLog.dir": log_dir,
+        # many small batches: the sort takes its out-of-core path (runs
+        # parked spillable) and the exchange really partitions
+        "spark.rapids.sql.batchSizeRows": 4096,
+        "spark.rapids.sql.batchSizeBytes": 1 << 20,
+    })
+    session.initialize_device()
+    if force_spill:
+        # tight budget: parked sort runs / join builds exceed it, so the
+        # park-time accounting (MemoryBudget.note_parked) spills older
+        # runs to host — spillTime/readSpill are real measurements
+        from spark_rapids_tpu.memory.budget import MemoryBudget
+        MemoryBudget.initialize(1 << 20, session.conf)
+
+    q1 = (session.from_arrow(fact)
+          .filter(col("v") > 0.1)
+          .repartition(4, "k")
+          .join(session.from_arrow(dim), on="k")
+          .sort("v"))
+    out1 = q1.collect()
+    prof1 = session.last_profile
+
+    q2 = (session.from_arrow(fact)
+          .group_by("g").agg(total=Sum(col("v"))))
+    out2 = q2.collect()
+    prof2 = session.last_profile
+
+    timers: dict = {}
+    bad = 0
+    n_recs = 0
+    spilled_ns = 0
+    for prof in (prof1, prof2):
+        if prof is None:
+            continue
+        recs = prof.to_records()
+        n_recs += len(recs)
+        bad += sum(1 for r in recs if validate_record(r))
+        for r in recs:
+            if r["type"] == "operator":
+                for k, v in r["metrics"].items():
+                    if k.lower().endswith("time") and v:
+                        timers[k] = timers.get(k, 0) + v
+        tm = prof.task_metrics
+        spilled_ns += tm.get("spill_to_host_ns", 0) + \
+            tm.get("spill_to_disk_ns", 0)
+    return {
+        "metric": "profile_query",
+        "rows_out": out1.num_rows + out2.num_rows,
+        "event_log_dir": log_dir,
+        "records": n_recs,
+        "invalid_records": bad,
+        "wall_ms": round(sum((p.wall_ns if p else 0)
+                             for p in (prof1, prof2)) / 1e6, 1),
+        "spill_ms": round(spilled_ns / 1e6, 3),
+        "nonzero_timers": sorted(timers),
+        "task_metrics": {k: v for k, v in (prof2.task_metrics if prof2
+                                           else {}).items() if v},
+    }
+
+
 PROBE_TIMEOUT_S = 35
 PROBE_ATTEMPTS = 2
 
@@ -455,7 +555,19 @@ def supervise() -> int:
 
 
 if __name__ == "__main__":
-    if "--scan-only" in sys.argv:
+    if "--profile-query" in sys.argv:
+        # bench flag (ISSUE-4): emit the JSONL profile event log for one
+        # engine query into the given dir and print a one-line summary
+        ix = sys.argv.index("--profile-query")
+        if ix + 1 >= len(sys.argv):
+            print("usage: bench.py --profile-query LOG_DIR [--no-spill]",
+                  file=sys.stderr)
+            sys.exit(2)
+        _enable_compilation_cache()
+        print(json.dumps(profile_query(
+            sys.argv[ix + 1],
+            force_spill="--no-spill" not in sys.argv)), flush=True)
+    elif "--scan-only" in sys.argv:
         scan_only()
     elif os.environ.get(_CHILD_ENV):
         main()

@@ -1,0 +1,73 @@
+#!/usr/bin/env bash
+# Observability matrix (ISSUE-4 CI gate):
+#   1. run the observability test suite (marker `observability`);
+#   2. run the bench profile queries WITH the event log enabled, then
+#      schema-validate every emitted record with the report tool;
+#   3. run the same queries with profiling DISABLED and assert the run
+#      emits zero event-log records (the disabled path must stay silent).
+#
+# Usage: scripts/profile_matrix.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TIMEOUT="${SRTPU_PROFILE_TIMEOUT:-600}"
+
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_observability.py -m observability -q \
+    -p no:cacheprovider "$@"
+
+LOG_DIR="$(mktemp -d)"
+trap 'rm -rf "$LOG_DIR"' EXIT
+
+echo "== profiled run (event log -> $LOG_DIR) =="
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+    SPARK_RAPIDS_TPU_BENCH_PLATFORM=cpu \
+    python bench.py --profile-query "$LOG_DIR/on"
+
+echo "== validating emitted records against the schema =="
+python -m spark_rapids_tpu.tools.profile_report "$LOG_DIR/on" --validate \
+    > /dev/null
+RECORDS=$(cat "$LOG_DIR"/on/*.jsonl | wc -l)
+if [ "$RECORDS" -lt 10 ]; then
+    echo "FAIL: profiled run emitted only $RECORDS records" >&2
+    exit 1
+fi
+# the emitted profile must show the core operator timers (acceptance bar:
+# nonzero op/sort/join/spill timers and shuffle activity in the log)
+for timer in sortTime joinTime spillTime opTime partitionTime; do
+    if ! grep -q "\"$timer\":" "$LOG_DIR"/on/*.jsonl; then
+        echo "FAIL: $timer missing from the emitted profile" >&2
+        exit 1
+    fi
+done
+
+echo "== disabled run (no event log conf) =="
+mkdir -p "$LOG_DIR/off"
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+    SPARK_RAPIDS_TPU_BENCH_PLATFORM=cpu \
+    SPARK_RAPIDS_TPU_PROFILE_DISABLED_DIR="$LOG_DIR/off" \
+    python - <<'EOF'
+# same queries, profiling off: must produce NO records anywhere
+import os
+import numpy as np, pyarrow as pa
+import bench
+from spark_rapids_tpu.plugin import TpuSession
+from spark_rapids_tpu.expr import Sum, col
+
+rng = np.random.default_rng(11)
+n = 8192
+fact = pa.table({"k": pa.array(rng.integers(0, 64, n)),
+                 "g": pa.array(rng.integers(0, 8, n).astype(np.int32)),
+                 "v": pa.array(rng.uniform(0.0, 1.0, n))})
+s = TpuSession({"spark.rapids.sql.explain": "NONE"})
+out = s.from_arrow(fact).filter(col("v") > 0.1) \
+    .group_by("g").agg(total=Sum(col("v"))).collect()
+assert out.num_rows > 0
+assert s.last_profile is None, "profile collected with profiling off"
+d = os.environ["SPARK_RAPIDS_TPU_PROFILE_DISABLED_DIR"]
+leftovers = [f for f in os.listdir(d) if f.endswith(".jsonl")]
+assert not leftovers, f"disabled run wrote event-log files: {leftovers}"
+print("disabled path: zero records, no profile object")
+EOF
+
+echo "profile_matrix: OK"

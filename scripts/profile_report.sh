@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Offline profile report over JSONL event logs written by the query
+# profiler (spark.rapids.tpu.metrics.eventLog.dir) — the reference
+# profiling-tool analog.
+#
+# Usage: scripts/profile_report.sh LOG_OR_DIR... [--validate] [--top N] [--json]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# the report tool is engine-free (no jax import), so no platform env needed
+exec python -m spark_rapids_tpu.tools.profile_report "$@"

@@ -291,6 +291,9 @@ class CompileService:
         self._enabled = True
         self._max_programs = 512
         self._dir = ""
+        # per-invocation kernel spans (spark.rapids.tpu.metrics.spans.
+        # kernel.enabled): off by default — one span per batch per kernel
+        self._kernel_spans = False
         self.stats = CompileStats()
         self._warned_persist = False
         self.warmup_thread: Optional[threading.Thread] = None
@@ -319,6 +322,8 @@ class CompileService:
             self._max_programs = int(
                 conf.get("spark.rapids.tpu.compile.cache.maxPrograms"))
             self._dir = conf.get("spark.rapids.tpu.compile.cache.dir") or ""
+            self._kernel_spans = bool(conf.get(
+                "spark.rapids.tpu.metrics.spans.kernel.enabled"))
         if self._dir:
             try:
                 os.makedirs(self._dir, exist_ok=True)
@@ -374,6 +379,11 @@ class CompileService:
                 return sj.direct(*args)
         self._restore_boxes(entry, boxes)
         try:
+            if self._kernel_spans:
+                from ..utils import spans
+                with spans.span(f"kernel:{sj.op}", kind=spans.KIND_KERNEL,
+                                op=sj.op):
+                    return entry.compiled(*dyn)
             return entry.compiled(*dyn)
         except Exception as e:
             # a stale/poisoned executable must never fail the query: evict
@@ -476,11 +486,14 @@ class CompileService:
         import jax
 
         from .. import faults
+        from ..utils import spans
         from ..utils.tracing import trace_range
         try:
             faults.fire(faults.COMPILE)
             t0 = time.monotonic_ns()
-            with trace_range(f"compile:{sj.op}"):
+            with trace_range(f"compile:{sj.op}"), \
+                    spans.span(f"compile:{sj.op}", kind=spans.KIND_COMPILE,
+                               op=sj.op):
                 jitted = jax.jit(self._dyn_fn(sj, statics))
                 compiled = jitted.lower(*dyn).compile()
             dt = time.monotonic_ns() - t0

@@ -142,7 +142,15 @@ def run_warmup(conf, service) -> dict:
 
 def start_warmup(conf, service) -> threading.Thread:
     """Launch warmup on a daemon thread (plugin init path)."""
-    t = threading.Thread(target=run_warmup, args=(conf, service),
-                         name="srtpu-compile-warmup", daemon=True)
+    def target():
+        # warmup overlaps queries by design: its compile spans must not
+        # land in whichever query profile is active (thread-local
+        # TaskMetrics already keeps its counters out)
+        from ..utils import spans
+        spans.suppress_in_thread()
+        run_warmup(conf, service)
+
+    t = threading.Thread(target=target, name="srtpu-compile-warmup",
+                         daemon=True)
     t.start()
     return t

@@ -116,6 +116,20 @@ register("spark.rapids.sql.concurrentGpuTasks", "int", 2,
 register("spark.rapids.sql.metrics.level", "string", "MODERATE",
          "Operator metric verbosity: ESSENTIAL, MODERATE, DEBUG.",
          check_values=("ESSENTIAL", "MODERATE", "DEBUG"))
+register("spark.rapids.tpu.metrics.eventLog.dir", "string", "",
+         "Directory for the per-query JSONL profile event log (one "
+         "schema-versioned record per query/operator/span, append-only). "
+         "Setting it activates the query profiler; empty disables both "
+         "the log and all span overhead. scripts/profile_report.sh "
+         "consumes these logs offline.")
+register("spark.rapids.tpu.metrics.profile.enabled", "bool", False,
+         "Collect the in-memory query profile (span tree + per-operator "
+         "metric deltas, TpuSession.explain_profile()) without writing an "
+         "event log. Implied by spark.rapids.tpu.metrics.eventLog.dir.")
+register("spark.rapids.tpu.metrics.spans.kernel.enabled", "bool", False,
+         "Also record one span per compiled-kernel invocation (kind="
+         "'kernel'). High-cardinality: one record per batch per kernel; "
+         "meant for deep dives, not steady-state profiling.")
 register("spark.rapids.sql.castFloatToString.enabled", "bool", True,
          "Enable float->string cast (Spark-format float printing on host path).")
 register("spark.rapids.sql.castStringToFloat.enabled", "bool", True,

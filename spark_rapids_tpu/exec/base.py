@@ -20,6 +20,7 @@ from ..columnar.batch import ColumnarBatch, Schema
 from ..config import TpuConf, get_default_conf
 from ..expr.base import EvalContext, Vec
 from ..utils import metrics as M
+from ..utils import spans
 from ..utils.tracing import trace_range
 
 
@@ -32,6 +33,15 @@ class TpuExec:
         self.num_output_batches = self.metrics.create(M.NUM_OUTPUT_BATCHES,
                                                       M.MODERATE)
         self.op_time = self.metrics.create(M.OP_TIME, M.MODERATE)
+        # task-metric slices attributed to this operator's pulls (inclusive
+        # of children, like every wall-time tree metric): spill wall time,
+        # admission wait, and the device-budget watermark observed while
+        # this operator was producing (GpuTaskMetrics surfaced per-op)
+        self.spill_time = self.metrics.create(M.SPILL_TIME, M.DEBUG)
+        self.semaphore_wait_time = self.metrics.create(
+            M.SEMAPHORE_WAIT_TIME, M.DEBUG)
+        self.peak_dev_memory = self.metrics.create(
+            M.PEAK_DEVICE_MEMORY, M.DEBUG)
 
     @property
     def output(self) -> Schema:
@@ -44,8 +54,53 @@ class TpuExec:
     def execute(self) -> Iterator[ColumnarBatch]:
         """Produce output batches (single-partition stream; exchange operators
         introduce partitioned streams)."""
-        with trace_range(self.name):
-            yield from self.do_execute()
+        prof = spans.current_profile()
+        if prof is None and not (self.spill_time.live
+                                 or self.semaphore_wait_time.live
+                                 or self.peak_dev_memory.live):
+            # disabled path: one global read + three attribute reads per
+            # operator per query — no span objects, no per-batch syncs
+            with trace_range(self.name):
+                yield from self.do_execute()
+            return
+        yield from self._instrumented_execute(prof)
+
+    def _instrumented_execute(self, prof) -> Iterator[ColumnarBatch]:
+        """Profiling/DEBUG-metrics path: an operator span wraps the whole
+        stream and per-pull deltas of the task-level accumulators are
+        charged to this operator (inclusive of children, like opTime)."""
+        from ..memory.budget import MemoryBudget
+        tm = M.TaskMetrics.get()
+        budget = MemoryBudget.get()
+        sp_cm = spans.NOOP_SPAN
+        if prof is not None:
+            op_id = prof.ensure_operator(self)
+            sp_cm = spans.span(self.name, kind=spans.KIND_OPERATOR,
+                               op_id=op_id)
+        with trace_range(self.name), sp_cm as sp:
+            it = self.do_execute()
+            while True:
+                spill0 = (tm.spill_to_host_ns + tm.spill_to_disk_ns
+                          + tm.read_spill_ns)
+                sem0 = tm.semaphore_wait_ns
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return
+                finally:
+                    self.spill_time.add(tm.spill_to_host_ns
+                                        + tm.spill_to_disk_ns
+                                        + tm.read_spill_ns - spill0)
+                    self.semaphore_wait_time.add(
+                        tm.semaphore_wait_ns - sem0)
+                    # the watermark, not used: a transient reserve/release
+                    # inside the pull must still register (the budget
+                    # resets its peak at query start)
+                    self.peak_dev_memory.set_max(budget.peak_used)
+                if prof is not None:  # attr computation syncs; skip if off
+                    sp.inc(batches=1, rows=int(batch.row_count()),
+                           bytes=int(batch.device_memory_size()))
+                yield batch
 
     def do_execute(self) -> Iterator[ColumnarBatch]:
         raise NotImplementedError

@@ -120,6 +120,7 @@ class TpuFilterExec(UnaryTpuExec):
     def __init__(self, condition: Expression, child: TpuExec, conf=None):
         super().__init__([child], conf)
         self.condition = condition
+        self.filter_time = self.metrics.create(M.FILTER_TIME, M.MODERATE)
         self._bound = bind_references(condition, child.output)
         bound = self._bound
 
@@ -147,7 +148,7 @@ class TpuFilterExec(UnaryTpuExec):
     def do_execute(self):
         from .base import raise_kernel_errors
         for b in self.child.execute():
-            with self.op_time.timed():
+            with self.op_time.timed(), self.filter_time.timed():
                 out, errs = self._kernel(b)
             raise_kernel_errors(errs, self._err_msgs)
             self.num_output_rows.add(out.row_count())

@@ -35,6 +35,9 @@ class TpuBroadcastExchangeExec(UnaryTpuExec):
         self.collect_time = self.metrics.create(M.COLLECT_TIME, M.ESSENTIAL)
         self.build_time = self.metrics.create(M.BUILD_TIME, M.MODERATE)
         self.data_size = self.metrics.create(M.DATA_SIZE, M.ESSENTIAL)
+        # per-consumer re-materialization cost (blob -> device batch)
+        self.broadcast_time = self.metrics.create(M.BROADCAST_TIME,
+                                                  M.MODERATE)
 
     @property
     def output(self) -> Schema:
@@ -68,8 +71,9 @@ class TpuBroadcastExchangeExec(UnaryTpuExec):
         from ..shuffle.serializer import concat_host_tables, deserialize_table
         # verify=False: the blob was serialized in this process and never
         # left memory; re-hashing it for every consuming task buys nothing
-        table, _ = deserialize_table(self._blob, verify=False)
-        out = concat_host_tables([table])
+        with self.broadcast_time.timed():
+            table, _ = deserialize_table(self._blob, verify=False)
+            out = concat_host_tables([table])
         self.num_output_rows.add(out.row_count())
         yield self._count_output(out)
 

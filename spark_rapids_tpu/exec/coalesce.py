@@ -225,6 +225,12 @@ class TpuCoalesceBatchesExec(UnaryTpuExec):
         super().__init__([child], conf)
         self.goal = goal or TargetSize(self.conf.batch_size_bytes)
         self.concat_time = self.metrics.create(M.CONCAT_TIME, M.MODERATE)
+        # input-side accounting: batches-in vs batches-out is THE coalesce
+        # effectiveness signal (reference numInputRows/numInputBatches)
+        self.num_input_rows = self.metrics.create(M.NUM_INPUT_ROWS,
+                                                  M.MODERATE)
+        self.num_input_batches = self.metrics.create(M.NUM_INPUT_BATCHES,
+                                                     M.MODERATE)
 
     def do_execute(self) -> Iterator[ColumnarBatch]:
         pending: List[ColumnarBatch] = []
@@ -232,6 +238,8 @@ class TpuCoalesceBatchesExec(UnaryTpuExec):
         target = None if isinstance(self.goal, RequireSingleBatch) else \
             self.goal.bytes_target
         for b in self.child.execute():
+            self.num_input_batches.add(1)
+            self.num_input_rows.add(b.row_count())
             pending.append(b)
             pending_bytes += b.device_memory_size()
             if target is not None and pending_bytes >= target:

@@ -83,6 +83,10 @@ class TpuShuffleExchangeExec(UnaryTpuExec):
         super().__init__([child], conf)
         self.spec = spec
         self.partition_time = self.metrics.create(M.PARTITION_TIME, M.ESSENTIAL)
+        self.num_partitions = self.metrics.create(M.NUM_PARTITIONS,
+                                                  M.ESSENTIAL)
+        self.write_time = self.metrics.create(M.WRITE_TIME, M.MODERATE)
+        self.read_time = self.metrics.create(M.READ_TIME, M.MODERATE)
 
     def do_execute(self) -> Iterator[ColumnarBatch]:
         batches = list(self.child.execute())
@@ -106,6 +110,7 @@ class TpuShuffleExchangeExec(UnaryTpuExec):
         batch = concat_batches(batches)
         part = make_partitioner(self.spec, self.child.output, batch)
         n_parts = part.num_partitions
+        self.num_partitions.set(n_parts)
         if mode in ("MULTITHREADED", "CACHE_ONLY") and n_parts > 1:
             yield from self._shuffle_via_manager(batch, part, n_parts, mode)
             return
@@ -156,31 +161,38 @@ class TpuShuffleExchangeExec(UnaryTpuExec):
                             out = _slice_partition(b, pid, p)
                         if int(out.row_count()) == 0:
                             continue
-                        writer.write(p, out)
+                        with self.write_time.timed():
+                            writer.write(p, out)
                 finally:
                     # drain in-flight writer futures BEFORE any cleanup — a
                     # late store.put after cleanup would leak blocks forever
                     # in the process-singleton store
-                    writer.close()
+                    with self.write_time.timed():
+                        writer.close()
             except BaseException:
                 mgr.discard_map_output(sid, mid, n_parts)
                 raise
             sp.close()
             return mid
 
+        from ..utils import spans
         try:
             sp0 = SpillableColumnarBatch(batch)
             # hand ownership to the spillable wrapper so a spill during the
             # OOM-retry loop can actually free the device arrays
             del batch
-            try:
-                list(with_retry(sp0, write_piece, split_batch_halves))
-            finally:
-                sp0.close()  # no-op on success (write_piece closed it)
+            with spans.span("shuffle:write", kind=spans.KIND_SHUFFLE,
+                            shuffle_id=sid, partitions=n_parts):
+                try:
+                    list(with_retry(sp0, write_piece, split_batch_halves))
+                finally:
+                    sp0.close()  # no-op on success (write_piece closed it)
             # release=True drops each partition's blocks as they are consumed,
             # bounding block-store retention to one partition at a time
             for p in range(n_parts):
-                for b in mgr.read_partition(sid, p, mode=mode, release=True):
+                for b in M.timed_pulls(
+                        mgr.read_partition(sid, p, mode=mode, release=True),
+                        self.read_time):
                     if int(b.row_count()) == 0:
                         continue
                     self.num_output_rows.add(b.row_count())

@@ -208,6 +208,13 @@ class TpuShuffledHashJoinExec(TpuExec):
                             Schema(lo.names + ro.names, lo.types + ro.types)))
         self.join_time = self.metrics.create(M.JOIN_TIME, M.ESSENTIAL)
         self.build_time = self.metrics.create(M.BUILD_TIME, M.MODERATE)
+        # probe-side stream accounting (reference streamTime /
+        # numInputRows on the streamed side of a hash join)
+        self.stream_time = self.metrics.create(M.STREAM_TIME, M.MODERATE)
+        self.num_input_rows = self.metrics.create(M.NUM_INPUT_ROWS,
+                                                  M.MODERATE)
+        self.num_input_batches = self.metrics.create(M.NUM_INPUT_BATCHES,
+                                                     M.MODERATE)
         # keys must be simple column refs after planning; planner projects
         # complex keys into columns first (reference does the same)
         self._lk_ix = tuple(self._key_ordinal(e, left.output)
@@ -262,6 +269,16 @@ class TpuShuffledHashJoinExec(TpuExec):
         else:
             yield from self._streamed_join(build)
 
+    def _stream_batches(self) -> Iterator[ColumnarBatch]:
+        """Probe-side stream with streamTime/numInput accounting: the wait
+        for each upstream batch is the streamed side's cost, distinct from
+        joinTime (the probe kernels)."""
+        for b in M.timed_pulls(self.children[0].execute(),
+                               self.stream_time):
+            self.num_input_batches.add(1)
+            self.num_input_rows.add(b.row_count())
+            yield b
+
     def _streamed_join(self, build: ColumnarBatch) -> Iterator[ColumnarBatch]:
         """Stream probe batches against the built table (`GpuHashJoin.doJoin`
         `GpuHashJoin.scala:950`): only one probe batch is device-resident at a
@@ -273,7 +290,7 @@ class TpuShuffledHashJoinExec(TpuExec):
         del build
         bmatched = None
         try:
-            for probe in self.children[0].execute():
+            for probe in self._stream_batches():
                 if int(probe.row_count()) == 0:
                     continue
 
@@ -321,7 +338,7 @@ class TpuShuffledHashJoinExec(TpuExec):
         del build
         bmatched = [None] * p
         try:
-            for probe in self.children[0].execute():
+            for probe in self._stream_batches():
                 if int(probe.row_count()) == 0:
                     continue
                 for i, pp in enumerate(_hash_split(probe, self._lk_ix, p)):
@@ -357,7 +374,7 @@ class TpuShuffledHashJoinExec(TpuExec):
         import itertools
         _END = object()
         threshold = self.conf.get("spark.rapids.sql.join.subPartition.rows")
-        probe_it = self.children[0].execute()
+        probe_it = self._stream_batches()
 
         def timed_build():
             it = self.children[1].execute()

@@ -233,7 +233,7 @@ class TpuWindowExec(UnaryTpuExec):
         names = schema.names + tuple(n for _, n in self.window_exprs)
         tps = schema.types + tuple(f.data_type for f, _ in self._bound_fns)
         self._schema = Schema(names, tps)
-        self.window_time = self.metrics.create(M.OP_TIME, M.MODERATE)
+        self.window_time = self.metrics.create(M.WINDOW_TIME, M.MODERATE)
         bound_part, bound_order = self._bound_part, self._bound_order
         bound_fns = self._bound_fns
         has_order = bool(order_spec)

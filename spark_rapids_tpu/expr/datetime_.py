@@ -623,6 +623,13 @@ class _PatternExpr(Expression):
         self.fmt = fmt
         self.parts, self.width = compile_dt_pattern(fmt)
 
+    def __repr__(self):
+        # the pattern bakes into the traced program (token layout, output
+        # width), so it must be visible to repr-derived compile-cache keys
+        # (compile/service.py) — without it two date_format calls with
+        # different literal patterns alias to one cached executable
+        return f"{self.name}({self.children[0]!r}, {self.fmt!r})"
+
 
 def _ts_components(xp, us):
     """us since epoch -> (y, M, d, H, m, s) int vectors (UTC)."""

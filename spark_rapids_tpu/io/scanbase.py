@@ -174,6 +174,8 @@ class TpuFileScanExec(_TpuExec):
         # visible in explain/metrics
         self.cols_host_decoded = self.metrics.create("colsHostDecoded",
                                                      M.MODERATE)
+        # decode/read wall time per produced batch (host or device path)
+        self.read_time = self.metrics.create(M.READ_TIME, M.MODERATE)
 
     @property
     def output(self) -> Schema:
@@ -197,6 +199,29 @@ class TpuFileScanExec(_TpuExec):
         return kept
 
     def do_execute(self):
+        """Time every batch-producing pull into readTime, each under its
+        own io span: a span per PULL, not per stream, so time the scan
+        iterator spends suspended (downstream sort/join work) never
+        inflates the profile's io phase and downstream spans cannot
+        mis-parent under a long-lived scan span. The format-specific
+        generators below stay untouched."""
+        from ..utils import spans
+        fmt = self.cpu_scan.format_name
+        it = self._decode_batches()
+        live = spans.current_profile() is not None
+        while True:
+            with self.read_time.timed(), \
+                    spans.span(f"scan:{fmt}", kind=spans.KIND_IO) as sp:
+                b = next(it, None)
+                if b is not None and live:
+                    # attr computation syncs; skip when disabled
+                    sp.inc(batches=1, rows=int(b.row_count()),
+                           bytes=int(b.device_memory_size()))
+            if b is None:
+                return
+            yield b
+
+    def _decode_batches(self):
         from ..columnar.batch import batch_from_arrow
         if self.cpu_scan.format_name == "parquet" and \
                 not self.cpu_scan.options.get("filters") and \

@@ -24,6 +24,9 @@ class MemoryBudget:
     def __init__(self, total: int, conf: TpuConf):
         self.total = total
         self.used = 0
+        # high-water mark of `used` since init/reset_peak: feeds the
+        # peakDevMemory operator metric and the query profile
+        self.peak_used = 0
         self.conf = conf
         self._lock = threading.Lock()
         self._alloc_count = 0
@@ -56,6 +59,7 @@ class MemoryBudget:
                 raise SplitAndRetryOOM("injected SplitAndRetryOOM")
             if self.used + nbytes <= self.total:
                 self.used += nbytes
+                self.peak_used = max(self.peak_used, self.used)
                 return
         # pressure: try to spill synchronously, then re-check
         from .catalog import BufferCatalog
@@ -63,6 +67,7 @@ class MemoryBudget:
         with self._lock:
             if self.used + nbytes <= self.total:
                 self.used += nbytes
+                self.peak_used = max(self.peak_used, self.used)
                 return
             if freed > 0:
                 raise RetryOOM(
@@ -103,6 +108,29 @@ class MemoryBudget:
     def release(self, nbytes: int) -> None:
         with self._lock:
             self.used = max(0, self.used - nbytes)
+
+    def note_parked(self, nbytes: int) -> None:
+        """Account a parked spillable batch's device residency (the
+        SpillableColumnarBatch park path). Unlike `reserve()` this never
+        raises and never counts toward fault-injection allocation
+        schedules: over-budget parking asks the catalog to spill the
+        overage down (oldest/lowest-priority parked buffers go to host),
+        which is exactly the reference's bounded-device-residency behavior
+        for pending sort runs / join builds. The caller pairs it with
+        `release()` on close while the entry is still device-resident
+        (the catalog's spill/unspill transitions keep the accounting
+        balanced in between)."""
+        with self._lock:
+            self.used += nbytes
+            self.peak_used = max(self.peak_used, self.used)
+            over = self.used - self.total
+        if over > 0:
+            from .catalog import BufferCatalog
+            BufferCatalog.get().synchronous_spill(over)
+
+    def reset_peak(self) -> None:
+        with self._lock:
+            self.peak_used = self.used
 
     def reset_injection(self, retry_at: int = 0, split_at: int = 0) -> None:
         with self._lock:

@@ -23,6 +23,7 @@ import numpy as np
 
 from ..columnar.batch import ColumnarBatch, Schema
 from ..columnar.column import Column
+from ..utils import spans
 from ..utils.metrics import TaskMetrics
 
 
@@ -108,9 +109,11 @@ class BufferCatalog:
             if e.tier == StorageTier.DEVICE:
                 return e.device_batch
             t0 = time.monotonic_ns()
-            if e.tier == StorageTier.DISK:
-                self._disk_to_host(e)
-            batch = self._host_to_device(e)
+            with spans.span("spill:read", kind=spans.KIND_SPILL,
+                            bytes=e.nbytes, tier=e.tier.name):
+                if e.tier == StorageTier.DISK:
+                    self._disk_to_host(e)
+                batch = self._host_to_device(e)
             TaskMetrics.get().read_spill_ns += time.monotonic_ns() - t0
             e.device_batch = batch
             e.host_arrays = None
@@ -192,29 +195,32 @@ class BufferCatalog:
             if e.tier != StorageTier.DEVICE:
                 return 0
             t0 = time.monotonic_ns()
-            batch = e.device_batch
-            # the batch is a pytree: flattening covers every buffer including
-            # nested children and the traced row count
-            leaves, e.treedef = jax.tree_util.tree_flatten(batch)
-            host = [np.asarray(x) for x in leaves]
-            if self.spill_codec != "none":
-                # compressed device-batch representation for spill (reference
-                # TableCompressionCodec over shuffle/spill buffers): leaves
-                # are stored as codec blobs, host accounting uses the
-                # COMPRESSED size so more batches fit under the host limit
-                from ..shuffle.codec import get_codec
-                codec = get_codec(self.spill_codec)
-                e.host_blobs = [
-                    (a.dtype.str, a.shape, codec.compress(
-                        np.ascontiguousarray(a).tobytes()), a.nbytes)
-                    for a in host]
-                e.host_bytes = sum(len(b[2]) for b in e.host_blobs)
-            else:
-                e.host_arrays = host
-                e.host_bytes = e.nbytes
-            e.device_batch = None  # drop device refs -> XLA frees HBM
-            e.tier = StorageTier.HOST
-            self.host_used += e.host_bytes
+            with spans.span("spill:to_host", kind=spans.KIND_SPILL,
+                            bytes=e.nbytes):
+                batch = e.device_batch
+                # the batch is a pytree: flattening covers every buffer
+                # including nested children and the traced row count
+                leaves, e.treedef = jax.tree_util.tree_flatten(batch)
+                host = [np.asarray(x) for x in leaves]
+                if self.spill_codec != "none":
+                    # compressed device-batch representation for spill
+                    # (reference TableCompressionCodec over shuffle/spill
+                    # buffers): leaves are stored as codec blobs, host
+                    # accounting uses the COMPRESSED size so more batches
+                    # fit under the host limit
+                    from ..shuffle.codec import get_codec
+                    codec = get_codec(self.spill_codec)
+                    e.host_blobs = [
+                        (a.dtype.str, a.shape, codec.compress(
+                            np.ascontiguousarray(a).tobytes()), a.nbytes)
+                        for a in host]
+                    e.host_bytes = sum(len(b[2]) for b in e.host_blobs)
+                else:
+                    e.host_arrays = host
+                    e.host_bytes = e.nbytes
+                e.device_batch = None  # drop device refs -> XLA frees HBM
+                e.tier = StorageTier.HOST
+                self.host_used += e.host_bytes
             TaskMetrics.get().spill_to_host_ns += time.monotonic_ns() - t0
             from .budget import MemoryBudget
             MemoryBudget.get().release(e.nbytes)
@@ -234,16 +240,18 @@ class BufferCatalog:
         from .. import faults
         faults.fire(faults.SPILL_WRITE)
         t0 = time.monotonic_ns()
-        path = os.path.join(self._spill_dir, f"buf{e.handle}.spill")
-        payload = ("blobs", e.host_blobs) if e.host_blobs is not None \
-            else ("arrays", e.host_arrays)
-        with open(path, "wb") as f:
-            pickle.dump(payload, f, protocol=4)
-        e.disk_path = path
-        e.host_arrays = None
-        e.host_blobs = None
-        e.tier = StorageTier.DISK
-        self.host_used -= e.host_bytes
+        with spans.span("spill:to_disk", kind=spans.KIND_SPILL,
+                        bytes=e.host_bytes):
+            path = os.path.join(self._spill_dir, f"buf{e.handle}.spill")
+            payload = ("blobs", e.host_blobs) if e.host_blobs is not None \
+                else ("arrays", e.host_arrays)
+            with open(path, "wb") as f:
+                pickle.dump(payload, f, protocol=4)
+            e.disk_path = path
+            e.host_arrays = None
+            e.host_blobs = None
+            e.tier = StorageTier.DISK
+            self.host_used -= e.host_bytes
         TaskMetrics.get().spill_to_disk_ns += time.monotonic_ns() - t0
 
     def _disk_to_host(self, e: _Entry) -> None:

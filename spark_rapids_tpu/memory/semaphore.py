@@ -37,8 +37,10 @@ class TpuSemaphore:
         if getattr(self._held, "count", 0) > 0:
             self._held.count += 1
             return
+        from ..utils import spans
         t0 = time.monotonic_ns()
-        self._sem.acquire()
+        with spans.span("semaphore:wait", kind=spans.KIND_SEMAPHORE):
+            self._sem.acquire()
         TaskMetrics.get().semaphore_wait_ns += time.monotonic_ns() - t0
         self._held.count = 1
 

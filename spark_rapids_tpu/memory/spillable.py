@@ -18,6 +18,13 @@ class SpillableColumnarBatch:
         self._handle: Optional[int] = self._catalog.add_batch(batch, priority)
         self.num_rows = batch.row_count()
         self.size_bytes = batch.device_memory_size()
+        # parked device bytes are budget-visible: under a tight budget,
+        # parking the Nth run/build spills older parked buffers to host
+        # (bounded device residency; see MemoryBudget.note_parked). The
+        # catalog's spill (release) / unspill (reserve) transitions keep
+        # the accounting balanced until close().
+        from .budget import MemoryBudget
+        MemoryBudget.get().note_parked(self.size_bytes)
 
     def get_batch(self) -> ColumnarBatch:
         if self._handle is None:
@@ -33,6 +40,16 @@ class SpillableColumnarBatch:
 
     def close(self) -> None:
         if self._handle is not None:
+            from .budget import MemoryBudget
+            from .catalog import StorageTier
+            try:
+                tier = self._catalog.tier_of(self._handle)
+            except KeyError:  # entry already gone: keep close() tolerant
+                tier = None
+            if tier == StorageTier.DEVICE:
+                # device-resident: undo the park-time accounting (a spilled
+                # entry already released it; an unspilled one re-reserved)
+                MemoryBudget.get().release(self.size_bytes)
             self._catalog.remove(self._handle)
             self._handle = None
 

@@ -22,6 +22,7 @@ class TpuSession:
     def __init__(self, conf: Optional[Dict] = None):
         self.conf = TpuConf(conf)
         self._device_initialized = False
+        self._last_profile = None
         TpuSession._active = self
 
     # ------------------------------------------------------------------ device
@@ -139,10 +140,21 @@ class TpuSession:
 
         if isinstance(result, TpuExec):
             from .errors import CpuFallbackRequired
+            from .utils import spans
             from .utils.metrics import TaskMetrics
             # fresh counters per query: the explain line below must report
             # THIS query's retries, not the session's accumulated history
             TaskMetrics.reset()
+            from .memory.budget import MemoryBudget
+            MemoryBudget.get().reset_peak()
+            # query profiler: activated by the event-log dir or the
+            # profile switch; otherwise zero overhead (spans stay no-ops)
+            log_dir = self.conf.get("spark.rapids.tpu.metrics.eventLog.dir")
+            prof = None
+            if log_dir or self.conf.get(
+                    "spark.rapids.tpu.metrics.profile.enabled"):
+                prof = spans.begin_profile(label=result.name)
+                prof.attach_plan(result)
             try:
                 host_batches = [device_batch_to_host(b)
                                 for b in result.execute()]
@@ -161,6 +173,20 @@ class TpuSession:
                 # safe (the reference's whole-plan willNotWork fallback,
                 # applied at runtime)
                 host_batches = list(plan.execute_cpu())
+            finally:
+                if prof is not None:
+                    spans.end_profile(prof)
+                    prof.finish(TaskMetrics.get())
+                    self._last_profile = prof
+                    if log_dir:
+                        try:
+                            spans.write_event_log(prof, log_dir)
+                        except OSError as e:
+                            # the profiler must never fail the query
+                            import warnings
+                            warnings.warn(
+                                f"profile event log write failed: {e}",
+                                RuntimeWarning, stacklevel=2)
         else:
             host_batches = list(result.execute_cpu())
         merged = _concat_host(host_batches, plan.output)
@@ -203,6 +229,22 @@ class TpuSession:
         return self.from_arrow(
             host_batch_to_arrow(device_batch_to_host(batch)),
             label="device-handoff")
+
+    @property
+    def last_profile(self):
+        """The QueryProfile of the most recent profiled query (None when
+        profiling was off). See utils/spans.py."""
+        return self._last_profile
+
+    def explain_profile(self) -> str:
+        """Render the last profiled query's operator tree with its live
+        metrics inline (the SQL-UI metrics analogue). Empty string when no
+        profiled query has run — turn on
+        spark.rapids.tpu.metrics.profile.enabled or set
+        spark.rapids.tpu.metrics.eventLog.dir first."""
+        if self._last_profile is None:
+            return ""
+        return self._last_profile.explain_profile()
 
     def explain_plan(self, plan: PhysicalPlan) -> str:
         ov = Overrides(self.conf)

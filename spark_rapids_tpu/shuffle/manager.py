@@ -273,6 +273,7 @@ class _MultithreadedWriter:
                 store.put(bid, data)
             except OSError:
                 store.put(bid, data)  # one retry: transient store hiccup
+            return len(data)
 
         self._futures.append(self._mgr.writer_pool.submit(job))
 
@@ -281,15 +282,19 @@ class _MultithreadedWriter:
         future is drained even when one fails: the caller's cleanup
         (discard_map_output) must not run while sibling puts are still in
         flight — a late put would resurrect a block under the discarded
-        map id (duplicated rows on read) or leak it in the singleton store."""
+        map id (duplicated rows on read) or leak it in the singleton store.
+        Serialized bytes are summed HERE, on the task thread, because
+        TaskMetrics is thread-local and the jobs ran on pool threads."""
         first: Optional[BaseException] = None
+        nbytes = 0
         for f in self._futures:
             try:
-                f.result()
+                nbytes += f.result()
             except BaseException as e:  # noqa: BLE001 - drain them all
                 if first is None:
                     first = e
         self._futures.clear()
+        TaskMetrics.get().shuffle_bytes_written += nbytes
         if first is not None:
             raise first
 
@@ -431,6 +436,40 @@ class TpuShuffleManager:
             return
         # frames keyed by BlockId: a block replicated on several peers (or
         # refetched through failover) contributes its rows exactly once
+        from ..utils import spans
+        t0 = time.monotonic_ns()
+        with spans.span("shuffle:fetch", kind=spans.KIND_SHUFFLE,
+                        shuffle_id=shuffle_id, reduce_id=reduce_id) as sp:
+            tm = TaskMetrics.get()
+            try:
+                frames, local = self._collect_frames(shuffle_id, reduce_id,
+                                                     remote_peers)
+            finally:
+                tm.shuffle_fetch_wait_ns += time.monotonic_ns() - t0
+            nbytes = sum(len(d) for d in frames.values())
+            tm.shuffle_bytes_read += nbytes
+            sp.inc(bytes=nbytes, blocks=len(frames))
+        if release:
+            for bid in local:
+                self.block_store.remove(bid)
+        if not frames:
+            return
+        ordered = [frames[k] for k in sorted(frames, key=lambda b:
+                                             (b.map_id, b.shuffle_id))]
+        # verify=False: every frame in `frames` already passed its CRC32C
+        # check on the fetch/local-read path above (per checksum config);
+        # re-hashing the same bytes here would double the checksum cost
+        futures = [self.reader_pool.submit(deserialize_table, r, 0, False)
+                   for r in ordered]
+        tables: List[HostTable] = [f.result()[0] for f in futures]
+        yield concat_host_tables(tables)
+
+    def _collect_frames(self, shuffle_id: int, reduce_id: int,
+                        remote_peers: Sequence[str]
+                        ) -> Tuple[Dict[BlockId, bytes], List[BlockId]]:
+        """Gather every frame for one reduce partition: local store reads
+        plus remote fetches with retry/failover. Returns (frames, the local
+        block ids) so the caller can release local blocks after use."""
         frames: Dict[BlockId, bytes] = {}
         local = self.block_store.blocks_for_reduce(shuffle_id, reduce_id)
         for bid in local:
@@ -473,20 +512,7 @@ class TpuShuffleManager:
             for bid, data in self._fetch_peer_with_retry(
                     shuffle_id, reduce_id, peer, alternates):
                 frames.setdefault(bid, data)
-        if release:
-            for bid in local:
-                self.block_store.remove(bid)
-        if not frames:
-            return
-        ordered = [frames[k] for k in sorted(frames, key=lambda b:
-                                             (b.map_id, b.shuffle_id))]
-        # verify=False: every frame in `frames` already passed its CRC32C
-        # check on the fetch/local-read path above (per checksum config);
-        # re-hashing the same bytes here would double the checksum cost
-        futures = [self.reader_pool.submit(deserialize_table, r, 0, False)
-                   for r in ordered]
-        tables: List[HostTable] = [f.result()[0] for f in futures]
-        yield concat_host_tables(tables)
+        return frames, local
 
     # -- fetch robustness ---------------------------------------------------
     def _read_local_block(self, bid: BlockId) -> Optional[bytes]:

@@ -1,0 +1,2 @@
+"""Offline tooling (reference `tools/` profiling-tool analog): consumers of
+the JSONL profile event log written by utils/spans.py."""

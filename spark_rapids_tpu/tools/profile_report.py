@@ -1,0 +1,264 @@
+"""Offline profile report — the reference profiling-tool analog.
+
+Consumes the JSONL event log the query profiler writes
+(`spark.rapids.tpu.metrics.eventLog.dir`, schema in utils/spans.py) and
+prints, per log set:
+
+  * per-query summary and (with several queries) a comparison table;
+  * top operators by attributed time, with rows/batches inline;
+  * the compile / execute / spill / shuffle-fetch / semaphore-wait
+    breakdown — the data-movement-vs-kernel split Theseus-class engines
+    show decides accelerator SQL performance;
+  * shuffle/retry storm surfacing from the task counters (OOM retries with
+    their backoff schedule, fetch retries/refetches/failovers).
+
+Usage:
+    python -m spark_rapids_tpu.tools.profile_report LOG_OR_DIR...
+        [--validate] [--top N] [--json]
+
+`--validate` checks every record against the schema and exits nonzero on
+the first malformed file (profile_matrix.sh gates CI on it). `--json`
+emits the aggregated model as one JSON object for downstream tooling.
+
+No engine (or jax) import happens here: the tool must run anywhere the
+log files land.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, Iterator, List, Tuple
+
+from ..utils.spans import SCHEMA_VERSION, validate_record
+
+__all__ = ["load_records", "build_model", "render_report", "main"]
+
+
+def _iter_files(paths: List[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isdir(p):
+            for name in sorted(os.listdir(p)):
+                if name.endswith(".jsonl"):
+                    yield os.path.join(p, name)
+        else:
+            yield p
+
+
+def load_records(paths: List[str], validate: bool = False
+                 ) -> Tuple[List[Dict[str, Any]], List[str]]:
+    """Parse every record from the given files/dirs. Returns (records,
+    problems). A torn final line (crash mid-append) is tolerated and
+    reported as a problem only under --validate; any other malformed
+    content is always a problem."""
+    records: List[Dict[str, Any]] = []
+    problems: List[str] = []
+    for path in _iter_files(paths):
+        try:
+            with open(path) as f:
+                lines = f.read().splitlines()
+        except OSError as e:
+            problems.append(f"{path}: unreadable: {e}")
+            continue
+        for i, line in enumerate(lines):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                if i == len(lines) - 1:
+                    # torn tail line: the append-only contract's one
+                    # expected damage mode
+                    if validate:
+                        problems.append(f"{path}:{i + 1}: torn tail: {e}")
+                else:
+                    problems.append(f"{path}:{i + 1}: bad json: {e}")
+                continue
+            if validate:
+                errs = validate_record(rec)
+                if errs:
+                    problems.append(f"{path}:{i + 1}: " + "; ".join(errs))
+                    continue
+            records.append(rec)
+    return records, problems
+
+
+def build_model(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Aggregate raw records into the report model: one entry per query
+    with its operator table and phase breakdown."""
+    queries: Dict[str, Dict[str, Any]] = {}
+    for rec in records:
+        if rec.get("type") != "query":
+            continue
+        queries[rec["query_id"]] = {
+            "query_id": rec["query_id"], "label": rec.get("label", ""),
+            "wall_ns": rec.get("wall_ns", 0),
+            "task_metrics": rec.get("task_metrics", {}),
+            "operators": [], "phases": {},
+        }
+    for rec in records:
+        q = queries.get(rec.get("query_id"))
+        if q is None:
+            continue
+        if rec["type"] == "operator":
+            metrics = rec.get("metrics", {})
+            # rank by the DOMINANT timer, not the sum: several timers can
+            # cover the same region (opTime + filterTime), and the DEBUG
+            # task-slice metrics (spillTime/semaphoreWaitTime) are charged
+            # inclusively to every operator on the pull path — summing
+            # would double/multiply count both
+            time_ns = max((v for k, v in metrics.items()
+                           if k.lower().endswith("time")
+                           and k not in ("spillTime", "semaphoreWaitTime")),
+                          default=0)
+            q["operators"].append({
+                "op_id": rec.get("op_id"), "parent_id": rec.get("parent_id"),
+                "name": rec.get("name", "?"), "args": rec.get("args", ""),
+                "metrics": metrics, "time_ns": time_ns,
+                "rows": metrics.get("numOutputRows", 0),
+                "batches": metrics.get("numOutputBatches", 0),
+            })
+        elif rec["type"] == "span" and rec.get("kind") not in (
+                "query", "operator"):
+            d = q["phases"].setdefault(
+                rec.get("kind", "phase"),
+                {"count": 0, "dur_ns": 0, "bytes": 0})
+            d["count"] += 1
+            d["dur_ns"] += rec.get("dur_ns", 0)
+            d["bytes"] += int(rec.get("attrs", {}).get("bytes", 0))
+    return {"v": SCHEMA_VERSION, "queries": list(queries.values())}
+
+
+def _ms(ns: int) -> str:
+    return f"{ns / 1e6:.1f}"
+
+
+def _fmt_table(rows: List[List[str]], header: List[str]) -> str:
+    cols = [header] + rows
+    widths = [max(len(str(r[i])) for r in cols) for i in range(len(header))]
+    out = ["  ".join(str(h).ljust(w) for h, w in zip(header, widths))]
+    out.append("  ".join("-" * w for w in widths))
+    for r in rows:
+        out.append("  ".join(str(c).ljust(w) for c, w in zip(r, widths)))
+    return "\n".join(out)
+
+
+def render_report(model: Dict[str, Any], top: int = 10) -> str:
+    queries = model["queries"]
+    if not queries:
+        return "no query records found"
+    lines: List[str] = []
+    for q in queries:
+        lines.append(f"=== query {q['query_id']} [{q['label']}] "
+                     f"wall={_ms(q['wall_ns'])}ms ===")
+        # top operators by attributed time
+        ops = sorted(q["operators"], key=lambda o: -o["time_ns"])[:top]
+        if ops:
+            lines.append("top operators:")
+            lines.append(_fmt_table(
+                [[o["name"], _ms(o["time_ns"]), str(o["rows"]),
+                  str(o["batches"]),
+                  ", ".join(f"{k}={_ms(v)}ms"
+                            for k, v in sorted(o["metrics"].items())
+                            if k.lower().endswith("time") and v)]
+                 for o in ops],
+                ["operator", "time_ms", "rows", "batches", "timers"]))
+        # compile vs execute vs data-movement breakdown
+        ph = q["phases"]
+        compile_ns = ph.get("compile", {}).get("dur_ns", 0)
+        spill_ns = ph.get("spill", {}).get("dur_ns", 0)
+        shuffle_ns = ph.get("shuffle", {}).get("dur_ns", 0)
+        sem_ns = ph.get("semaphore", {}).get("dur_ns", 0)
+        io_ns = ph.get("io", {}).get("dur_ns", 0)
+        execute_ns = max(q["wall_ns"] - compile_ns, 0)
+        lines.append("breakdown:")
+        lines.append(_fmt_table(
+            [["compile", _ms(compile_ns),
+              str(ph.get("compile", {}).get("count", 0)), ""],
+             ["execute (wall - compile)", _ms(execute_ns), "", ""],
+             ["spill", _ms(spill_ns),
+              str(ph.get("spill", {}).get("count", 0)),
+              str(ph.get("spill", {}).get("bytes", 0))],
+             ["shuffle", _ms(shuffle_ns),
+              str(ph.get("shuffle", {}).get("count", 0)),
+              str(ph.get("shuffle", {}).get("bytes", 0))],
+             ["scan io", _ms(io_ns),
+              str(ph.get("io", {}).get("count", 0)),
+              str(ph.get("io", {}).get("bytes", 0))],
+             ["semaphore wait", _ms(sem_ns),
+              str(ph.get("semaphore", {}).get("count", 0)), ""]],
+            ["phase", "time_ms", "events", "bytes"]))
+        # retry storms
+        tm = q["task_metrics"]
+        storm = []
+        if tm.get("retry_count") or tm.get("split_retry_count"):
+            backoffs = tm.get("retry_backoff_ms", [])
+            storm.append(
+                f"OOM retries={tm.get('retry_count', 0)} "
+                f"splits={tm.get('split_retry_count', 0)} "
+                f"blockedMs={tm.get('retry_block_ns', 0) / 1e6:.1f} "
+                f"backoffsMs={[round(b, 1) for b in backoffs]}")
+        if tm.get("shuffle_retry_count") or tm.get("shuffle_refetch_count") \
+                or tm.get("shuffle_failover_count"):
+            storm.append(
+                f"shuffle fetch retries={tm.get('shuffle_retry_count', 0)} "
+                f"refetches={tm.get('shuffle_refetch_count', 0)} "
+                f"failovers={tm.get('shuffle_failover_count', 0)}")
+        if storm:
+            lines.append("retry storms:")
+            lines.extend("  " + s for s in storm)
+        if tm.get("shuffle_bytes_written") or tm.get("shuffle_bytes_read"):
+            lines.append(
+                f"shuffle volume: written={tm.get('shuffle_bytes_written', 0)}"
+                f"B read={tm.get('shuffle_bytes_read', 0)}B "
+                f"fetchWaitMs={tm.get('shuffle_fetch_wait_ns', 0) / 1e6:.1f}")
+        lines.append("")
+    if len(queries) > 1:
+        lines.append("=== per-query comparison ===")
+        lines.append(_fmt_table(
+            [[q["query_id"], q["label"], _ms(q["wall_ns"]),
+              _ms(q["phases"].get("compile", {}).get("dur_ns", 0)),
+              _ms(q["phases"].get("spill", {}).get("dur_ns", 0)),
+              _ms(q["phases"].get("shuffle", {}).get("dur_ns", 0)),
+              str(sum(o["rows"] for o in q["operators"]
+                      if o["parent_id"] is None))]
+             for q in queries],
+            ["query", "label", "wall_ms", "compile_ms", "spill_ms",
+             "shuffle_ms", "rows_out"]))
+    return "\n".join(lines)
+
+
+def main(argv: List[str] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="profile_report",
+        description="Report over spark_rapids_tpu JSONL profile event logs")
+    ap.add_argument("paths", nargs="+",
+                    help="event-log .jsonl files or directories of them")
+    ap.add_argument("--validate", action="store_true",
+                    help="schema-check every record; nonzero exit on any "
+                         "malformed record")
+    ap.add_argument("--top", type=int, default=10,
+                    help="operators to show per query (default 10)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the aggregated model as JSON instead of text")
+    args = ap.parse_args(argv)
+
+    records, problems = load_records(args.paths, validate=args.validate)
+    for p in problems:
+        print(f"INVALID: {p}", file=sys.stderr)
+    if args.validate and problems:
+        return 1
+    model = build_model(records)
+    if args.json:
+        print(json.dumps(model, indent=2))
+    else:
+        print(render_report(model, top=args.top))
+    if args.validate:
+        print(f"validated {len(records)} records: OK", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -87,26 +87,47 @@ class Metric:
 
 NOOP = Metric("noop", live=False)
 
+_END = object()
+
+
+def timed_pulls(it, metric: "Metric"):
+    """Drive iterator `it`, charging the wait for each item to `metric` —
+    the shared shape of stream-side timing (join probe streamTime,
+    exchange read side): upstream wait is the consumer's cost, distinct
+    from the consumer's own kernel timers."""
+    while True:
+        with metric.timed():
+            item = next(it, _END)
+        if item is _END:
+            return
+        yield item
+
 
 class MetricsSet:
-    """Per-exec metric dictionary filtered by the session metrics level."""
+    """Per-exec metric dictionary filtered by the session metrics level.
+    Thread-safe: exchange and shuffle paths create/snapshot against the
+    same set from worker threads."""
 
     def __init__(self, session_level: str = "MODERATE"):
         self._max_level = _LEVELS[session_level]
         self._metrics: Dict[str, Metric] = {}
+        self._lock = threading.Lock()
 
     def create(self, name: str, level: int = MODERATE) -> Metric:
-        if name in self._metrics:
-            return self._metrics[name]
-        m = Metric(name, level, live=(level <= self._max_level))
-        self._metrics[name] = m
-        return m
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Metric(name, level, live=(level <= self._max_level))
+                self._metrics[name] = m
+            return m
 
     def __getitem__(self, name: str) -> Metric:
-        return self._metrics.get(name, NOOP)
+        with self._lock:
+            return self._metrics.get(name, NOOP)
 
     def snapshot(self) -> Dict[str, int]:
-        return {k: m.value for k, m in self._metrics.items() if m.live}
+        with self._lock:
+            return {k: m.value for k, m in self._metrics.items() if m.live}
 
 
 class TaskMetrics:
@@ -131,6 +152,13 @@ class TaskMetrics:
         self.shuffle_retry_count = 0
         self.shuffle_refetch_count = 0
         self.shuffle_failover_count = 0
+        # shuffle data-plane accounting: serialized bytes written to the
+        # block store, frame bytes read back, and wall ns spent waiting on
+        # block fetch/read (the data-movement signal Theseus-class engines
+        # show dominates accelerator SQL)
+        self.shuffle_bytes_written = 0
+        self.shuffle_bytes_read = 0
+        self.shuffle_fetch_wait_ns = 0
         # compile-service counters (compile/service.py): real XLA compiles
         # this task triggered, wall ns inside them, program-cache traffic,
         # persistent-tier loads, and degraded direct-jit fallbacks
@@ -170,6 +198,11 @@ class TaskMetrics:
                 f"shuffleFetchRetries={self.shuffle_retry_count} "
                 f"shuffleRefetches={self.shuffle_refetch_count} "
                 f"shuffleFailovers={self.shuffle_failover_count}")
+        if self.shuffle_bytes_written or self.shuffle_bytes_read:
+            parts.append(
+                f"shuffleBytesWritten={self.shuffle_bytes_written} "
+                f"shuffleBytesRead={self.shuffle_bytes_read} "
+                f"shuffleFetchWaitMs={self.shuffle_fetch_wait_ns / 1e6:.1f}")
         if self.compile_count or self.compile_cache_hits or \
                 self.compile_cache_misses or self.compile_persist_hits or \
                 self.compile_fallbacks:

@@ -1,0 +1,480 @@
+"""Hierarchical query-profile span tracer (reference observability stack:
+`GpuMetric`/`GpuTaskMetrics` + NVTX ranges + SQL-UI metrics + the offline
+profiling tool, here folded into one per-query subsystem).
+
+Three layers:
+
+  * **Spans** — nested wall-clock regions, query -> operator -> phase
+    (kernel / compile / spill / shuffle-fetch / semaphore-wait), each
+    carrying counters (rows, batches, bytes, …). Nesting comes from a
+    per-thread stack; a span opened on a worker thread with no enclosing
+    span parents to the query root.
+  * **QueryProfile** — thread-safe per-query registry: the operator tree
+    (registered from the exec plan before execution, so even never-pulled
+    operators appear), a per-operator `MetricsSet` baseline/final snapshot
+    pair (reused exec instances — e.g. cached broadcasts — report only
+    THIS query's deltas), the finished span list, and the task-level
+    `TaskMetrics` snapshot.
+  * **Exporters** — a schema-versioned JSONL event log (append-only, one
+    self-contained record per line so a torn tail line never poisons the
+    file) and `explain_profile()`, the SQL-UI analogue: the operator tree
+    rendered with live metric values inline plus a phase rollup.
+
+Disabled-path contract: when no profile is active, `span()` returns a
+shared no-op object (one module-global read, no allocation) and
+`TpuExec.execute` takes its untraced fast path — profiling costs nothing
+until `spark.rapids.tpu.metrics.eventLog.dir` or
+`spark.rapids.tpu.metrics.profile.enabled` turns it on.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["SCHEMA_VERSION", "Span", "QueryProfile", "span",
+           "current_profile", "begin_profile", "end_profile",
+           "write_event_log", "validate_record", "task_metrics_dict"]
+
+SCHEMA_VERSION = 1
+
+# span kinds — the phase taxonomy the report tool aggregates by
+KIND_QUERY = "query"
+KIND_OPERATOR = "operator"
+KIND_COMPILE = "compile"
+KIND_SPILL = "spill"
+KIND_SHUFFLE = "shuffle"
+KIND_SEMAPHORE = "semaphore"
+KIND_KERNEL = "kernel"
+KIND_IO = "io"
+KIND_PHASE = "phase"
+
+_KINDS = (KIND_QUERY, KIND_OPERATOR, KIND_COMPILE, KIND_SPILL, KIND_SHUFFLE,
+          KIND_SEMAPHORE, KIND_KERNEL, KIND_IO, KIND_PHASE)
+
+
+class Span:
+    """One finished (or open) trace region."""
+
+    __slots__ = ("span_id", "parent_id", "name", "kind", "start_ns",
+                 "end_ns", "attrs")
+
+    def __init__(self, span_id: int, parent_id: int, name: str, kind: str,
+                 attrs: Optional[Dict[str, Any]] = None):
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.kind = kind
+        self.start_ns = time.monotonic_ns()
+        self.end_ns: Optional[int] = None
+        self.attrs: Dict[str, Any] = dict(attrs) if attrs else {}
+
+    @property
+    def dur_ns(self) -> int:
+        end = self.end_ns if self.end_ns is not None else time.monotonic_ns()
+        return end - self.start_ns
+
+    def inc(self, **counters: int) -> None:
+        a = self.attrs
+        for k, v in counters.items():
+            a[k] = a.get(k, 0) + v
+
+    def put(self, **attrs: Any) -> None:
+        self.attrs.update(attrs)
+
+
+class _NoopSpan:
+    """Shared do-nothing span: the entire disabled-path surface."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def inc(self, **counters) -> None:
+        pass
+
+    def put(self, **attrs) -> None:
+        pass
+
+
+NOOP_SPAN = _NoopSpan()
+
+_tls = threading.local()
+_current: Optional["QueryProfile"] = None
+_mu = threading.Lock()
+
+
+def _stack() -> list:
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
+
+
+class _LiveSpan:
+    """Context manager creating a real Span inside the active profile."""
+
+    __slots__ = ("_prof", "_name", "_kind", "_attrs", "_span")
+
+    def __init__(self, prof: "QueryProfile", name: str, kind: str,
+                 attrs: Dict[str, Any]):
+        self._prof = prof
+        self._name = name
+        self._kind = kind
+        self._attrs = attrs
+        self._span: Optional[Span] = None
+
+    def __enter__(self) -> Span:
+        stack = _stack()
+        parent = stack[-1].span_id if stack else QueryProfile.ROOT_SPAN_ID
+        self._span = self._prof._open_span(self._name, self._kind, parent,
+                                           self._attrs)
+        stack.append(self._span)
+        return self._span
+
+    def __exit__(self, *exc) -> bool:
+        sp = self._span
+        sp.end_ns = time.monotonic_ns()
+        stack = _stack()
+        # tolerate interleaved generator frames: pop this span wherever it is
+        if stack and stack[-1] is sp:
+            stack.pop()
+        elif sp in stack:
+            stack.remove(sp)
+        self._prof._record(sp)
+        return False
+
+
+def span(name: str, kind: str = KIND_PHASE, **attrs):
+    """Open a span under the active query profile; a no-op when none is
+    active. Usage: ``with span("spill:to_host", kind="spill") as sp: ...``"""
+    prof = _current
+    if prof is None or prof.closed or getattr(_tls, "suppress", False):
+        return NOOP_SPAN
+    return _LiveSpan(prof, name, kind, attrs)
+
+
+def suppress_in_thread() -> None:
+    """Turn spans off for the CURRENT thread. Background engine work that
+    overlaps queries by design (the AOT warmup thread) calls this so its
+    compile spans never pollute whichever query profile happens to be
+    active — TaskMetrics, being thread-local, already excludes it."""
+    _tls.suppress = True
+
+
+def current_profile() -> Optional["QueryProfile"]:
+    return _current
+
+
+def begin_profile(label: str = "query") -> "QueryProfile":
+    """Activate a fresh QueryProfile as the process-wide current profile
+    (queries execute serially per session; worker threads inherit it)."""
+    global _current
+    prof = QueryProfile(label)
+    with _mu:
+        _current = prof
+    return prof
+
+
+def end_profile(prof: "QueryProfile") -> None:
+    """Deactivate `prof` if it is still current (mismatches are ignored so
+    an exception-unwound nested begin cannot clear someone else's profile)."""
+    global _current
+    with _mu:
+        if _current is prof:
+            _current = None
+
+
+def task_metrics_dict(tm) -> Dict[str, Any]:
+    """Flatten a TaskMetrics instance to a JSON-safe dict (ints + the
+    backoff list)."""
+    out: Dict[str, Any] = {}
+    for k in dir(tm):
+        if k.startswith("_"):
+            continue
+        v = getattr(tm, k)
+        if isinstance(v, bool) or callable(v):
+            continue
+        if isinstance(v, int):
+            out[k] = v
+        elif isinstance(v, list):
+            out[k] = [float(x) for x in v]
+    return out
+
+
+class QueryProfile:
+    """Per-query aggregation of spans, operator metrics, and task metrics."""
+
+    ROOT_SPAN_ID = 0
+    _qid_counter = itertools.count(1)
+
+    def __init__(self, label: str = "query"):
+        self.query_id = f"{os.getpid()}-{next(QueryProfile._qid_counter)}"
+        self.label = label
+        self.start_ns = time.monotonic_ns()
+        self.end_ns: Optional[int] = None
+        self.closed = False
+        self.task_metrics: Dict[str, Any] = {}
+        self._mu = threading.RLock()
+        self._next_span = itertools.count(1)  # 0 is the query root
+        self._spans: List[Span] = []
+        self._op_ids: Dict[int, int] = {}     # id(exec) -> op_id
+        self._op_meta: List[Dict[str, Any]] = []
+
+    # ------------------------------------------------------------- spans
+    def _open_span(self, name: str, kind: str, parent_id: int,
+                   attrs: Dict[str, Any]) -> Span:
+        with self._mu:
+            sid = next(self._next_span)
+        return Span(sid, parent_id, name, kind, attrs)
+
+    def _record(self, sp: Span) -> None:
+        with self._mu:
+            if not self.closed:
+                self._spans.append(sp)
+
+    @property
+    def spans(self) -> List[Span]:
+        with self._mu:
+            return list(self._spans)
+
+    # --------------------------------------------------------- operators
+    def attach_plan(self, root) -> None:
+        """Register an exec tree (TpuExec) before execution: the profile
+        then knows the full operator topology even for operators whose
+        iterators are never pulled."""
+        def walk(node, parent_id):
+            oid = self._register(node, parent_id)
+            for child in getattr(node, "children", ()):
+                if hasattr(child, "metrics"):
+                    walk(child, oid)
+        walk(root, None)
+
+    def _register(self, node, parent_id) -> int:
+        with self._mu:
+            key = id(node)
+            if key in self._op_ids:
+                return self._op_ids[key]
+            oid = len(self._op_meta)
+            self._op_ids[key] = oid
+            try:
+                args = node._arg_string()
+            except Exception:
+                args = ""
+            self._op_meta.append({
+                "op_id": oid,
+                "parent_id": parent_id,
+                "name": node.name,
+                "args": args,
+                "metrics_set": node.metrics,
+                "baseline": node.metrics.snapshot(),
+                "values": {},
+            })
+            return oid
+
+    def ensure_operator(self, node) -> int:
+        """op_id for `node`, registering it under the root on the fly if
+        the plan walk never saw it (dynamically created execs)."""
+        with self._mu:
+            oid = self._op_ids.get(id(node))
+        if oid is not None:
+            return oid
+        return self._register(node, None)
+
+    # ------------------------------------------------------------ finish
+    def finish(self, task_metrics=None) -> None:
+        """Close the profile: snapshot every operator's metrics as deltas
+        against its registration baseline, capture TaskMetrics, end the
+        query span. Idempotent."""
+        with self._mu:
+            if self.closed:
+                return
+            self.end_ns = time.monotonic_ns()
+            for meta in self._op_meta:
+                final = meta["metrics_set"].snapshot()
+                base = meta["baseline"]
+                meta["values"] = {k: v - base.get(k, 0)
+                                  for k, v in final.items()}
+                meta.pop("metrics_set", None)
+            if task_metrics is not None:
+                self.task_metrics = task_metrics_dict(task_metrics)
+            self.closed = True
+
+    @property
+    def wall_ns(self) -> int:
+        end = self.end_ns if self.end_ns is not None else time.monotonic_ns()
+        return end - self.start_ns
+
+    # --------------------------------------------------------- exporters
+    def operator_table(self) -> List[Dict[str, Any]]:
+        with self._mu:
+            return [{k: v for k, v in m.items() if k not in
+                     ("metrics_set", "baseline")} for m in self._op_meta]
+
+    def phase_totals(self) -> Dict[str, Dict[str, int]]:
+        """Aggregate finished spans by kind: {kind: {count, dur_ns, bytes}}."""
+        out: Dict[str, Dict[str, int]] = {}
+        for sp in self.spans:
+            d = out.setdefault(sp.kind, {"count": 0, "dur_ns": 0, "bytes": 0})
+            d["count"] += 1
+            d["dur_ns"] += sp.dur_ns
+            d["bytes"] += int(sp.attrs.get("bytes", 0))
+        return out
+
+    def to_records(self) -> List[Dict[str, Any]]:
+        """One schema-versioned JSON record per query/operator/span."""
+        recs: List[Dict[str, Any]] = [{
+            "v": SCHEMA_VERSION, "type": "query",
+            "query_id": self.query_id, "label": self.label,
+            "wall_ns": self.wall_ns,
+            "task_metrics": dict(self.task_metrics),
+            "n_operators": len(self._op_meta),
+            "n_spans": len(self._spans) + 1,
+        }]
+        for m in self.operator_table():
+            recs.append({
+                "v": SCHEMA_VERSION, "type": "operator",
+                "query_id": self.query_id, "op_id": m["op_id"],
+                "parent_id": m["parent_id"], "name": m["name"],
+                "args": m["args"], "metrics": dict(m["values"]),
+            })
+        recs.append({
+            "v": SCHEMA_VERSION, "type": "span",
+            "query_id": self.query_id, "span_id": self.ROOT_SPAN_ID,
+            "parent_id": None, "name": self.label, "kind": KIND_QUERY,
+            "start_ns": self.start_ns, "dur_ns": self.wall_ns, "attrs": {},
+        })
+        for sp in self.spans:
+            recs.append({
+                "v": SCHEMA_VERSION, "type": "span",
+                "query_id": self.query_id, "span_id": sp.span_id,
+                "parent_id": sp.parent_id, "name": sp.name, "kind": sp.kind,
+                "start_ns": sp.start_ns, "dur_ns": sp.dur_ns,
+                "attrs": dict(sp.attrs),
+            })
+        return recs
+
+    def explain_profile(self) -> str:
+        """Operator tree with live metrics inline plus the phase rollup —
+        the SQL-UI metrics analogue, as text."""
+        table = self.operator_table()
+        children: Dict[Optional[int], List[Dict[str, Any]]] = {}
+        for m in table:
+            children.setdefault(m["parent_id"], []).append(m)
+        lines = [f"QueryProfile[{self.query_id}] {self.label} "
+                 f"wall={_fmt_ns(self.wall_ns)}"]
+
+        def fmt_metrics(vals: Dict[str, int]) -> str:
+            parts = []
+            for k in sorted(vals):
+                v = vals[k]
+                if not v:
+                    continue
+                parts.append(f"{k}={_fmt_ns(v)}" if k.lower().endswith("time")
+                             else f"{k}={v}")
+            return ", ".join(parts)
+
+        def walk(m, depth):
+            ms = fmt_metrics(m["values"])
+            lines.append("  " * (depth + 1) + m["name"] + m["args"]
+                         + (f": {ms}" if ms else ""))
+            for c in children.get(m["op_id"], ()):
+                walk(c, depth + 1)
+
+        for root in children.get(None, ()):
+            walk(root, 0)
+        totals = self.phase_totals()
+        if totals:
+            lines.append("  phases:")
+            for kind in sorted(totals):
+                d = totals[kind]
+                extra = f" bytes={d['bytes']}" if d["bytes"] else ""
+                lines.append(f"    {kind}: n={d['count']} "
+                             f"time={_fmt_ns(d['dur_ns'])}{extra}")
+        if self.task_metrics:
+            hot = {k: v for k, v in self.task_metrics.items()
+                   if v and not isinstance(v, list)}
+            if hot:
+                lines.append("  task: " + ", ".join(
+                    f"{k}={v}" for k, v in sorted(hot.items())))
+        return "\n".join(lines)
+
+
+def _fmt_ns(ns: int) -> str:
+    if abs(ns) >= 1_000_000:
+        return f"{ns / 1e6:.1f}ms"
+    if abs(ns) >= 1_000:
+        return f"{ns / 1e3:.1f}us"
+    return f"{ns}ns"
+
+
+# ------------------------------------------------------------------ event log
+def write_event_log(prof: QueryProfile, log_dir: str) -> str:
+    """Append the profile's records to the per-process JSONL event log under
+    `log_dir` (created if missing). Append-only, one self-contained record
+    per line: a torn final line (crash mid-write) damages only itself, and
+    concatenating logs from many executors is just `cat`."""
+    os.makedirs(log_dir, exist_ok=True)
+    path = os.path.join(log_dir, f"events-{os.getpid()}.jsonl")
+    payload = "".join(json.dumps(r, separators=(",", ":"),
+                                 default=_json_default) + "\n"
+                      for r in prof.to_records())
+    with open(path, "a") as f:
+        f.write(payload)
+    return path
+
+
+def _json_default(o):
+    try:
+        import numpy as _np
+        if isinstance(o, _np.integer):
+            return int(o)
+        if isinstance(o, _np.floating):
+            return float(o)
+    except Exception:
+        pass
+    return str(o)
+
+
+# ----------------------------------------------------------------- validation
+_REQUIRED: Dict[str, Dict[str, type]] = {
+    "query": {"query_id": str, "label": str, "wall_ns": int,
+              "task_metrics": dict, "n_operators": int, "n_spans": int},
+    "operator": {"query_id": str, "op_id": int, "name": str,
+                 "args": str, "metrics": dict},
+    "span": {"query_id": str, "span_id": int, "name": str, "kind": str,
+             "start_ns": int, "dur_ns": int, "attrs": dict},
+}
+
+
+def validate_record(rec: Any) -> List[str]:
+    """Schema check of one event-log record; returns a list of problems
+    (empty = valid). Shared by the report tool, profile_matrix.sh and the
+    tests so 'valid' means one thing."""
+    errs: List[str] = []
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, not an object"]
+    if rec.get("v") != SCHEMA_VERSION:
+        errs.append(f"schema version {rec.get('v')!r} != {SCHEMA_VERSION}")
+    rtype = rec.get("type")
+    req = _REQUIRED.get(rtype)
+    if req is None:
+        errs.append(f"unknown record type {rtype!r}")
+        return errs
+    for field, typ in req.items():
+        if field not in rec:
+            errs.append(f"{rtype}: missing field {field!r}")
+        elif not isinstance(rec[field], typ):
+            errs.append(f"{rtype}.{field}: expected {typ.__name__}, "
+                        f"got {type(rec[field]).__name__}")
+    if rtype == "span" and rec.get("kind") not in _KINDS:
+        errs.append(f"span.kind {rec.get('kind')!r} not in {_KINDS}")
+    return errs

@@ -20,13 +20,17 @@ except Exception:  # pragma: no cover
 @contextlib.contextmanager
 def trace_range(name: str, metric=None):
     t0 = time.monotonic_ns() if metric is not None else 0
-    if _HAVE_PROFILER:
-        with _profiler.TraceAnnotation(name):
+    try:
+        if _HAVE_PROFILER:
+            with _profiler.TraceAnnotation(name):
+                yield
+        else:  # pragma: no cover
             yield
-    else:  # pragma: no cover
-        yield
-    if metric is not None:
-        metric.add(time.monotonic_ns() - t0)
+    finally:
+        # in a finally: an exception inside the region (ANSI violation,
+        # OOM-retry) must still charge the elapsed time to the metric
+        if metric is not None:
+            metric.add(time.monotonic_ns() - t0)
 
 
 def start_profile(logdir: str) -> None:

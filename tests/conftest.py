@@ -35,6 +35,11 @@ def pytest_configure(config):
         "compile: compile-service suite (program cache / persistent tier / "
         "warmup / bucket tuner; scripts/compile_cache_matrix.sh runs these "
         "standalone)")
+    config.addinivalue_line(
+        "markers",
+        "observability: query-profiler suite (span tracer / metrics "
+        "wiring / event log / report tool; scripts/profile_matrix.sh runs "
+        "these standalone)")
 
 
 @pytest.fixture
