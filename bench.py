@@ -121,13 +121,50 @@ def tpu_many_steps():
     return many
 
 
-def cpu_pipeline(fact_key, fact_grp, fact_val, dim_key, dim_w):
-    keep = fact_val > 0.6
-    ix = np.clip(np.searchsorted(dim_key, fact_key), 0, len(dim_key) - 1)
-    matched = (dim_key[ix] == fact_key) & keep
-    contrib = np.where(matched, fact_val * dim_w[ix], 0.0)
-    sums = np.bincount(fact_grp, weights=contrib, minlength=N_GROUPS)
+def cpu_pipeline(fact_key, fact_grp, fact_val, dim_key, dim_w,
+                 lo: int = 0, hi: int = None):
+    fk = fact_key[lo:hi]
+    keep = fact_val[lo:hi] > 0.6
+    ix = np.clip(np.searchsorted(dim_key, fk), 0, len(dim_key) - 1)
+    matched = (dim_key[ix] == fk) & keep
+    contrib = np.where(matched, fact_val[lo:hi] * dim_w[ix], 0.0)
+    sums = np.bincount(fact_grp[lo:hi], weights=contrib,
+                       minlength=N_GROUPS)
     return sums, int(matched.sum())
+
+
+# fork-inherited by oracle worker processes (copy-on-write, no pickling)
+_ORACLE_DATA = None
+
+
+def _oracle_shard(bounds):
+    lo, hi = bounds
+    return cpu_pipeline(*_ORACLE_DATA, lo=lo, hi=hi)
+
+
+def cpu_oracle_parallel(data, workers: int):
+    """Row-sharded CPU oracle across `workers` forked processes — the
+    honest multi-core CPU baseline (round-4 verdict weak #3: the single-
+    process oracle slows with machine load, swinging the headline 23.9 ->
+    56.4). Returns (sums, rows, best wall seconds of 3 timed parallel
+    runs); pool spin-up and a warm pass are excluded, per-map scatter/
+    gather overhead is included (it is part of a real parallel oracle)."""
+    import multiprocessing as mp
+    global _ORACLE_DATA
+    _ORACLE_DATA = data
+    bounds = np.linspace(0, N_FACT, workers + 1).astype(int)
+    shards = list(zip(bounds[:-1], bounds[1:]))
+    ctx = mp.get_context("fork")
+    with ctx.Pool(workers) as pool:
+        parts = pool.map(_oracle_shard, shards)  # warm: faults, imports
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            parts = pool.map(_oracle_shard, shards)
+            best = min(best, time.perf_counter() - t0)
+    sums = np.sum([p[0] for p in parts], axis=0)
+    rows = sum(p[1] for p in parts)
+    return sums, rows, best
 
 
 def _force(x):
@@ -135,6 +172,8 @@ def _force(x):
 
 
 SCAN_ROWS = 2_097_152
+SCAN_ROW_GROUP = SCAN_ROWS // 8   # 8 chunks: the multi-chunk fusion unit
+SCAN_CHUNKS_PER_DISPATCH = 4
 
 
 def scan_decode_bench(tmpdir: str):
@@ -143,14 +182,21 @@ def scan_decode_bench(tmpdir: str):
     round-4 verdict item 2 ("prove the device path beats the thing it
     replaced"). Two corpora: snappy (decompression-bound for any decoder
     — both paths pay it) and uncompressed (the decode paths themselves).
-    GB/s are file-relative; raw decoded bytes ride along. May raise; the
-    caller guards (main() prints the primary metric line first)."""
+    Both device paths are measured: the serial per-row-group decode (the
+    r05 unit, `_serial` keys) and the pipelined fused MULTI-CHUNK decode
+    (packed single-transfer, N row groups per dispatch) that is the
+    headline — with TaskMetrics dispatch accounting beside each so the
+    dispatch amortization (dispatches-per-scan-batch, ISSUE-6 acceptance)
+    is in the JSON, not inferred. GB/s are file-relative; raw decoded
+    bytes ride along. May raise; the caller guards (main() prints the
+    primary metric line first)."""
     import jax
     import pyarrow as pa
     import pyarrow.parquet as pq
     from spark_rapids_tpu.io.parquet_device import (
         device_decode_file, file_supported)
     from spark_rapids_tpu.plugin import TpuSession
+    from spark_rapids_tpu.utils.metrics import TaskMetrics
 
     rng = np.random.default_rng(7)
     n = SCAN_ROWS
@@ -163,51 +209,149 @@ def scan_decode_bench(tmpdir: str):
     session = TpuSession({"spark.rapids.sql.enabled": True,
                           "spark.rapids.sql.explain": "NONE"})
     session.initialize_device()
-    out = {"scan_rows": n}
+    out = {"scan_rows": n, "scan_row_groups": n // SCAN_ROW_GROUP,
+           "scan_chunks_per_dispatch": SCAN_CHUNKS_PER_DISPATCH}
 
     for tag, comp in (("", "snappy"), ("_plain", "none")):
         path = os.path.join(tmpdir, f"scanbench{tag}.parquet")
-        pq.write_table(t, path, compression=comp)
+        pq.write_table(t, path, compression=comp,
+                       row_group_size=SCAN_ROW_GROUP)
         file_bytes = os.path.getsize(path)
         schema = session.read_parquet(path).plan.output
 
-        def run():
+        def run(chunks):
+            tm = TaskMetrics.get()
+            tm.scan_dispatches = tm.scan_chunks = 0
             leaves = []
+            batches = 0
             pf = file_supported(path, schema)
-            for batch, _rows in device_decode_file(pf, path, schema):
+            for batch, _rows in device_decode_file(
+                    pf, path, schema, chunks_per_dispatch=chunks):
+                batches += 1
                 for col in batch.columns:
                     leaves.append(col.data)
             jax.block_until_ready(leaves)
+            return tm.scan_dispatches, tm.scan_chunks, batches
 
-        # compile separated from execute: the first call pays trace+compile
-        # (or a persistent-cache load on a warm process); steady-state
-        # execute is measured on the warm program. BENCH json carries both
-        # so warm-path wins (compile-cache hits) are trackable per round.
-        t0 = time.perf_counter()
-        run()  # compile + warm
-        compile_s = time.perf_counter() - t0
-        best = float("inf")
-        for _ in range(3):
+        def measure(chunks):
+            # compile separated from execute: the first call pays
+            # trace+compile (or a persistent-cache load on a warm
+            # process); steady-state execute is measured warm. BENCH json
+            # carries both so warm-path wins stay trackable per round.
             t0 = time.perf_counter()
-            run()
-            best = min(best, time.perf_counter() - t0)
+            dispatches, chnks, batches = run(chunks)
+            compile_s = time.perf_counter() - t0
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                run(chunks)
+                best = min(best, time.perf_counter() - t0)
+            return compile_s, best, dispatches, chnks, batches
+
+        comp_m, best_m, disp_m, chnk_m, batch_m = \
+            measure(SCAN_CHUNKS_PER_DISPATCH)
+        comp_s, best_s, disp_s, chnk_s, batch_s = measure(1)
         host = float("inf")
         for _ in range(3):
             t0 = time.perf_counter()
             pq.read_table(path)
             host = min(host, time.perf_counter() - t0)
         out.update({
-            f"scan_compile_s{tag}": round(max(compile_s - best, 0.0), 5),
-            f"scan_decode_gbps_raw{tag}": round(raw_bytes / best / 1e9, 3),
+            # headline: the pipelined fused multi-chunk path
+            f"scan_compile_s{tag}": round(max(comp_m - best_m, 0.0), 5),
+            f"scan_decode_gbps_raw{tag}": round(raw_bytes / best_m / 1e9,
+                                                3),
             f"scan_decode_gbps_file{tag}":
-                round(file_bytes / best / 1e9, 3),
-            f"scan_decode_s{tag}": round(best, 5),
+                round(file_bytes / best_m / 1e9, 3),
+            f"scan_decode_s{tag}": round(best_m, 5),
+            f"dispatches_per_scan_batch{tag}":
+                round(disp_m / max(batch_m, 1), 2),
+            f"dispatches_per_chunk{tag}":
+                round(disp_m / max(chnk_m, 1), 2),
+            # the r05 serial per-row-group unit, same file, same process
+            f"scan_decode_gbps_file_serial{tag}":
+                round(file_bytes / best_s / 1e9, 3),
+            f"scan_decode_s_serial{tag}": round(best_s, 5),
+            f"dispatches_per_scan_batch_serial{tag}":
+                round(disp_s / max(batch_s, 1), 2),
+            f"dispatch_reduction_x{tag}":
+                round((disp_s / max(chnk_s, 1))
+                      / (disp_m / max(chnk_m, 1)), 2),
+            # the thing the device path replaced
             f"host_pyarrow_gbps_file{tag}":
                 round(file_bytes / host / 1e9, 3),
             f"host_pyarrow_s{tag}": round(host, 5),
-            f"scan_vs_host{tag}": round(host / best, 3),
+            f"scan_vs_host{tag}": round(host / best_m, 3),
         })
+    try:
+        out.update(pipeline_query_bench(tmpdir))
+    except Exception as e:  # must not sink the scan numbers
+        out["pipeline_bench_error"] = f"{type(e).__name__}: {e}"
     return out
+
+
+PIPE_DIM = 4096
+
+
+def pipeline_query_bench(tmpdir: str) -> dict:
+    """End-to-end pipeline-on vs pipeline-off on the scan+join bench
+    (ISSUE-6 acceptance): the SAME engine query — parquet scan -> filter
+    -> hash join -> grouped agg — runs with pipelined execution on and
+    off, results must be bit-identical, and both wall times land in the
+    JSON. The aggregation sums an INTEGER column and counts rows so the
+    equality gate is exact: f64 sums regroup across the pipeline's larger
+    merged batches (the documented variableFloatAgg grouping caveat) and
+    would reduce the gate to approx."""
+    import pyarrow as pa
+    from spark_rapids_tpu.expr import Count, Sum, col
+    from spark_rapids_tpu.plugin import TpuSession
+
+    rng = np.random.default_rng(11)
+    path = os.path.join(tmpdir, "pipebench.parquet")
+    if not os.path.exists(path):
+        import pyarrow.parquet as pq
+        n = SCAN_ROWS // 2
+        t = pa.table({
+            "k": pa.array(rng.integers(0, PIPE_DIM, n)),
+            "g": pa.array(rng.integers(0, 1024, n).astype(np.int32)),
+            "v": pa.array(rng.uniform(0.0, 1.0, n)),
+            "c": pa.array(rng.integers(0, 1 << 30, n)),
+        })
+        pq.write_table(t, path, row_group_size=SCAN_ROW_GROUP)
+    dim = pa.table({
+        "k": pa.array(np.arange(PIPE_DIM)),
+        "w": pa.array(rng.integers(0, 1000, PIPE_DIM)),
+    })
+
+    def run(pipeline: bool):
+        sess = TpuSession({
+            "spark.rapids.sql.enabled": True,
+            "spark.rapids.sql.explain": "NONE",
+            "spark.rapids.tpu.pipeline.enabled": pipeline,
+        })
+        sess.initialize_device()
+        q = (sess.read_parquet(path)
+             .filter(col("v") > 0.25)
+             .join(sess.from_arrow(dim), on="k")
+             .group_by("g").agg(total=Sum(col("c") + col("w")),
+                                cnt=Count(col("v"))))
+        q.collect()  # warm (compiles)
+        best = float("inf")
+        res = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            res = q.collect()
+            best = min(best, time.perf_counter() - t0)
+        return res.sort_by("g"), best
+
+    res_off, t_off = run(False)
+    res_on, t_on = run(True)
+    return {
+        "pipeline_on_s": round(t_on, 5),
+        "pipeline_off_s": round(t_off, 5),
+        "pipeline_speedup": round(t_off / t_on, 3),
+        "pipeline_identical": bool(res_on.equals(res_off)),
+    }
 
 
 ATTEMPTS = 3
@@ -276,11 +420,27 @@ def main():
         best = min(best, time.perf_counter() - t0)
     t_tpu = max((best - overhead) / K_STEPS, 1e-9)
 
-    t_cpu = float("inf")
+    t_cpu_1p = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
         cpu_sums, cpu_rows = cpu_pipeline(*data)
-        t_cpu = min(t_cpu, time.perf_counter() - t0)
+        t_cpu_1p = min(t_cpu_1p, time.perf_counter() - t0)
+    # headline oracle: multi-process (all cores), so `vs_baseline` stops
+    # swinging with machine load starving one python process; the
+    # single-process number rides along for cross-round continuity
+    workers = min(os.cpu_count() or 1, 8)
+    if workers > 1:
+        try:
+            par_sums, par_rows, t_cpu = cpu_oracle_parallel(data, workers)
+        except OSError:  # fork-hostile environment: single-proc oracle
+            workers, t_cpu = 1, t_cpu_1p
+        else:
+            # correctness of the parallel oracle must fail LOUDLY — only
+            # environment errors above may downgrade to single-process
+            assert par_rows == cpu_rows, (par_rows, cpu_rows)
+            np.testing.assert_allclose(par_sums, cpu_sums, rtol=1e-9)
+    else:
+        t_cpu = t_cpu_1p
     assert int(rows) == cpu_rows, (int(rows), cpu_rows)
     # K-step accumulate/divide reorders f64 additions; this is a sanity check,
     # exactness is the differential suite's job
@@ -296,8 +456,15 @@ def main():
     # steady-state execution — ~0 on a warm persistent cache, tens of
     # seconds cold over the tunnel — so BENCH rounds can track warm-path
     # wins separately from kernel-time regressions.
+    try:  # per-attempt machine-load context (VERDICT weak #3: the
+        loadavg = [round(x, 2) for x in os.getloadavg()]  # oracle swings
+    except OSError:                                       # with load)
+        loadavg = None
     detail = {"device": str(dev), "device_kind": kind,
               "tpu_step_s": round(t_tpu, 5), "cpu_s": round(t_cpu, 5),
+              "cpu_s_singleproc": round(t_cpu_1p, 5),
+              "cpu_oracle_workers": workers,
+              "loadavg": loadavg,
               "compile_s": round(max(t_compile_wall - best, 0.0), 4),
               "execute_s": round(best, 5),
               "pipeline_gbps": round(gbps, 3), "rows": N_FACT,
@@ -329,7 +496,7 @@ def main():
     emit(detail)
 
 
-SCAN_CHILD_TIMEOUT_S = 180
+SCAN_CHILD_TIMEOUT_S = 240
 
 
 def _scan_bench_subprocess(t_attempt_start: float) -> dict:

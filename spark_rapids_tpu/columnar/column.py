@@ -253,11 +253,11 @@ def from_arrow(arr, capacity: Optional[int] = None) -> Tuple[Column, int]:
     if isinstance(dtype, T.DecimalType) and \
             dtype.precision > T.DecimalType.MAX_LONG_DIGITS:
         # decimal128: two int64 limbs per row, [cap, 2] (see expr/decimal128)
-        from ..expr.decimal128 import split_int
+        from ..expr.decimal128 import split_int, unscaled_int
         limbs = np.zeros((n, 2), np.int64)
         for i, v in enumerate(arr):
             if v.is_valid:
-                limbs[i] = split_int(int(v.as_py().scaleb(dtype.scale)))
+                limbs[i] = split_int(unscaled_int(v.as_py(), dtype.scale))
         limbs = _pad_to(limbs, cap)
         return Column(dtype, jnp.asarray(limbs),
                       jnp.asarray(_pad_to(valid, cap))), n
@@ -277,7 +277,9 @@ def from_arrow(arr, capacity: Optional[int] = None) -> Tuple[Column, int]:
             f"type not yet device-backed: {arr.type} "
             "(binary needs the string byte-matrix path)")
     if isinstance(dtype, T.DecimalType):
-        vals = np.array([int(v.as_py().scaleb(dtype.scale)) if v.is_valid else 0
+        from ..expr.decimal128 import unscaled_int
+        vals = np.array([unscaled_int(v.as_py(), dtype.scale)
+                         if v.is_valid else 0
                          for v in arr], dtype=np.int64)
     elif isinstance(dtype, (T.TimestampType, T.DateType)):
         ints = arr.cast(pa.int64() if isinstance(dtype, T.TimestampType)
@@ -326,14 +328,13 @@ def to_arrow(col: Column, num_rows: int):
     vals = np.asarray(col.data[:num_rows])
     at = T.to_arrow(col.dtype)
     if isinstance(col.dtype, T.DecimalType):
-        import decimal as _d
+        from ..expr.decimal128 import join_int, to_decimal
         if col.dtype.precision > T.DecimalType.MAX_LONG_DIGITS:
-            from ..expr.decimal128 import join_int
-            py = [(_d.Decimal(join_int(int(v[0]), int(v[1])))
-                   .scaleb(-col.dtype.scale) if m else None)
+            py = [(to_decimal(join_int(int(v[0]), int(v[1])),
+                              col.dtype.scale) if m else None)
                   for v, m in zip(vals, valid)]
             return pa.array(py, type=at)
-        py = [(_d.Decimal(int(v)).scaleb(-col.dtype.scale) if m else None)
+        py = [(to_decimal(int(v), col.dtype.scale) if m else None)
               for v, m in zip(vals, valid)]
         return pa.array(py, type=at)
     return pa.array(vals, type=at, mask=mask if mask.any() else None)

@@ -393,6 +393,29 @@ register("spark.rapids.tpu.mesh.shape", "string", "",
          "Logical device mesh as 'name=N,name=M' (empty = single device).",
          startup_only=True)
 
+# Pipelined execution ----------------------------------------------------------------
+register("spark.rapids.tpu.pipeline.enabled", "bool", True,
+         "Pipelined execution: bounded-depth background prefetch of "
+         "upstream batches at the scan, coalesce-input and result-sink "
+         "seams (host-side work overlaps device execution) plus the "
+         "fused multi-chunk scan decode. Off restores the strictly "
+         "serial pre-pipeline paths — zero prefetch threads, one decode "
+         "dispatch group per row-group chunk.")
+register("spark.rapids.tpu.pipeline.prefetch.depth", "int", 2,
+         "Max batches a pipeline prefetch thread may run ahead of its "
+         "consumer. Prefetched batches are parked as spillable (budget-"
+         "visible, spillable under pressure) until the consumer "
+         "materializes them, so depth bounds device residency, not just "
+         "queue length.")
+register("spark.rapids.tpu.pipeline.scan.chunksPerDispatch", "int", 4,
+         "Row-group chunks the device parquet scan decodes per fused "
+         "dispatch: their control-plane arrays pack into ONE host "
+         "buffer, ship in ONE transfer, and expand in ONE compiled "
+         "program that emits one merged batch — O(1) dispatches per "
+         "scan batch instead of O(columns x chunks). 1 disables chunk "
+         "batching (per-row-group decode, the pre-pipeline unit); "
+         "ignored when spark.rapids.tpu.pipeline.enabled is false.")
+
 # Compile service --------------------------------------------------------------------
 register("spark.rapids.tpu.compile.enabled", "bool", True,
          "Route every kernel compile through the centralized compile "

@@ -86,17 +86,19 @@ def host_vec_from_arrow(arr) -> Vec:
         return Vec(dtype, chars, valid, lens)
     if isinstance(dtype, T.DecimalType) and \
             dtype.precision > T.DecimalType.MAX_LONG_DIGITS:
-        from ..expr.decimal128 import split_int
+        from ..expr.decimal128 import split_int, unscaled_int
         limbs = np.zeros((n, 2), np.int64)
         for i, v in enumerate(arr):
             if v.is_valid:
-                limbs[i] = split_int(int(v.as_py().scaleb(dtype.scale)))
+                limbs[i] = split_int(unscaled_int(v.as_py(), dtype.scale))
         return Vec(dtype, limbs, valid)
     npdt = dtype.np_dtype
     if npdt is None:
         raise TypeError(f"type not host-vec-backed: {arr.type}")
     if isinstance(dtype, T.DecimalType):
-        vals = np.array([int(v.as_py().scaleb(dtype.scale)) if v.is_valid else 0
+        from ..expr.decimal128 import unscaled_int
+        vals = np.array([unscaled_int(v.as_py(), dtype.scale)
+                         if v.is_valid else 0
                          for v in arr], dtype=np.int64)
     elif isinstance(dtype, (T.TimestampType, T.DateType)):
         ints = arr.cast(pa.int64() if isinstance(dtype, T.TimestampType)
@@ -217,14 +219,13 @@ def host_vec_to_arrow(v: Vec, num_rows: Optional[int] = None):
     vals = np.asarray(v.data[:n])
     at = T.to_arrow(v.dtype)
     if isinstance(v.dtype, T.DecimalType):
-        import decimal as _d
+        from ..expr.decimal128 import join_int, to_decimal
         if v.dtype.precision > T.DecimalType.MAX_LONG_DIGITS:
-            from ..expr.decimal128 import join_int
-            py = [(_d.Decimal(join_int(int(x[0]), int(x[1])))
-                   .scaleb(-v.dtype.scale) if m else None)
+            py = [(to_decimal(join_int(int(x[0]), int(x[1])),
+                              v.dtype.scale) if m else None)
                   for x, m in zip(vals, valid)]
             return pa.array(py, type=at)
-        py = [(_d.Decimal(int(x)).scaleb(-v.dtype.scale) if m else None)
+        py = [(to_decimal(int(x), v.dtype.scale) if m else None)
               for x, m in zip(vals, valid)]
         return pa.array(py, type=at)
     return pa.array(vals, type=at, mask=mask if mask.any() else None)

@@ -10,7 +10,9 @@ Scala around cudf kernel launches."""
 
 from __future__ import annotations
 
-from typing import Iterator, List, Sequence
+import queue as _queue
+import threading
+from typing import Iterator, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -117,6 +119,190 @@ class TpuExec:
 
     def _arg_string(self) -> str:
         return ""
+
+
+# ----------------------------------------------------------------------------
+# Pipelined execution: bounded async batch prefetch
+# ----------------------------------------------------------------------------
+
+# process-wide count of prefetch threads ever spawned — the pipeline-off CI
+# gate asserts this stays ZERO when spark.rapids.tpu.pipeline.enabled=false
+# (scripts/pipeline_matrix.sh)
+PREFETCH_THREADS_STARTED = 0
+
+_PREFETCH_END = object()
+
+
+class _PrefetchError:
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class PrefetchIterator:
+    """Bounded-depth async prefetch of an upstream batch iterator.
+
+    A background thread pulls upstream batches while the consumer computes,
+    overlapping host-side work (parquet page prep, shuffle fetch, coalesce
+    input, D2H of the previous result) with device execution. Discipline:
+
+      * bounded depth: the queue holds at most `depth` parked batches, so
+        the producer can never run away from the consumer;
+      * budget-visible parking: each prefetched batch parks as a
+        SpillableColumnarBatch (MemoryBudget.note_parked accounting), so a
+        tight budget spills prefetched batches to host instead of letting
+        the pipeline inflate device residency invisibly;
+      * semaphore order: the prefetch is part of the CONSUMER's task and
+        adds no admission traffic of its own — the producer ADOPTS the
+        task's standing (adopt_task_hold; with concurrentGpuTasks=1 a
+        producer-owned permit would deadlock against the task thread's,
+        and a dead producer could leak one), and the consumer
+        materializes parked batches without re-admission (they are the
+        task's own in-flight stream, held live on device by the serial
+        path with no admission either);
+      * typed error propagation: any producer-side exception (including
+        CpuFallbackRequired and injected faults) crosses the queue and
+        re-raises in the consumer with its original type; the producer
+        thread always terminates — a consumer that stops early (LIMIT,
+        downstream error) drains and closes parked batches and joins the
+        thread, so no deadlock and no leaked catalog handles;
+      * shared task accounting: the producer adopts the spawning thread's
+        TaskMetrics instance, so spill/retry/compile counters keep landing
+        in the query's task like the serial path.
+
+    The faults.PREFETCH injection point fires once per upstream pull on
+    the producer thread (scripts/pipeline_matrix.sh drives it)."""
+
+    _PUT_POLL_S = 0.02
+
+    def __init__(self, inner: Iterator[ColumnarBatch], depth: int,
+                 name: str = "prefetch"):
+        from ..memory.semaphore import TpuSemaphore
+        from ..utils.metrics import TaskMetrics
+        global PREFETCH_THREADS_STARTED
+        self._inner = inner
+        self._name = name
+        self._q: _queue.Queue = _queue.Queue(maxsize=max(depth, 1))
+        self._stop = threading.Event()
+        self._tm = TaskMetrics.get()  # the consumer's (task's) metrics
+        self._sem = TpuSemaphore.get()
+        self._tm.prefetch_threads += 1
+        PREFETCH_THREADS_STARTED += 1
+        self._thread = threading.Thread(
+            target=self._produce, name=f"srtpu-{name}", daemon=True)
+        self._thread.start()
+
+    # -- producer thread ---------------------------------------------------
+    def _produce(self) -> None:
+        from .. import faults
+        from ..memory.spillable import SpillableColumnarBatch
+        from ..utils.metrics import TaskMetrics
+        TaskMetrics._tls.metrics = self._tm  # share the task's counters
+        self._sem.adopt_task_hold()  # ride the task's admission permit
+        try:
+            while not self._stop.is_set():
+                with spans.span("pipeline:prefetch",
+                                kind=spans.KIND_IO) as sp:
+                    faults.fire(faults.PREFETCH)
+                    batch = next(self._inner, _PREFETCH_END)
+                    if batch is _PREFETCH_END:
+                        break
+                    sp.inc(batches=1, rows=int(batch.row_count()))
+                item = SpillableColumnarBatch(batch)
+                del batch
+                self._tm.prefetch_batches += 1
+                if not self._put(item):
+                    item.close()  # consumer is gone
+                    return
+            self._put(_PREFETCH_END)
+        except BaseException as e:  # noqa: BLE001 — crosses the queue
+            self._put(_PrefetchError(e))
+        finally:
+            # unwind this thread's reentrant counts; the adopted (task's)
+            # permit is NOT released — it belongs to the consumer
+            self._sem.complete_task()
+
+    def _put(self, item) -> bool:
+        """Queue put that gives up when the consumer has stopped (a full
+        queue with a dead consumer must not wedge the thread)."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=self._PUT_POLL_S)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    # -- consumer side -----------------------------------------------------
+    def _get(self):
+        """Dequeue with a producer-liveness guard: a producer that died
+        without its terminal token (a bug, every exit path posts one) must
+        surface as a loud error, never an indefinite consumer block."""
+        while True:
+            try:
+                return self._q.get(timeout=1.0)
+            except _queue.Empty:
+                if not self._thread.is_alive():
+                    try:  # terminal token may have landed just before death
+                        return self._q.get_nowait()
+                    except _queue.Empty:
+                        raise RuntimeError(
+                            f"prefetch producer '{self._name}' died "
+                            "without a result") from None
+
+    def __iter__(self) -> Iterator[ColumnarBatch]:
+        import time
+        try:
+            while True:
+                t0 = time.monotonic_ns()
+                item = self._get()
+                self._tm.prefetch_stall_ns += time.monotonic_ns() - t0
+                if item is _PREFETCH_END:
+                    return
+                if isinstance(item, _PrefetchError):
+                    raise item.exc
+                try:
+                    # no re-admission: this batch is the task's own
+                    # in-flight stream (see SpillableColumnarBatch.get_batch)
+                    batch = item.get_batch(acquire_semaphore=False)
+                finally:
+                    item.close()
+                yield batch
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        """Stop the producer, drain + close parked batches, join. Drains
+        once more AFTER the join: a producer blocked in put() when the
+        first drain freed queue space lands its item between drain and
+        exit — that straggler must be closed too, not leaked."""
+        self._stop.set()
+        for _ in range(2):
+            while True:
+                try:
+                    item = self._q.get_nowait()
+                except _queue.Empty:
+                    break
+                if item is not _PREFETCH_END and \
+                        not isinstance(item, _PrefetchError):
+                    item.close()
+            self._thread.join(timeout=10.0)
+
+
+def maybe_prefetch(inner: Iterator[ColumnarBatch],
+                   conf: Optional[TpuConf],
+                   name: str = "prefetch") -> Iterator[ColumnarBatch]:
+    """Wrap `inner` in a PrefetchIterator when pipelined execution is on;
+    pipeline-off returns `inner` UNCHANGED (the exact serial path, zero
+    threads spawned)."""
+    conf = conf or get_default_conf()
+    if not conf.get("spark.rapids.tpu.pipeline.enabled"):
+        return inner
+    depth = conf.get("spark.rapids.tpu.pipeline.prefetch.depth")
+    if depth < 1:
+        return inner
+    return iter(PrefetchIterator(inner, depth, name))
 
 
 class StaticExpr:

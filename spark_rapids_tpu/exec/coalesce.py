@@ -233,11 +233,23 @@ class TpuCoalesceBatchesExec(UnaryTpuExec):
                                                      M.MODERATE)
 
     def do_execute(self) -> Iterator[ColumnarBatch]:
+        from .base import maybe_prefetch
         pending: List[ColumnarBatch] = []
         pending_bytes = 0
         target = None if isinstance(self.goal, RequireSingleBatch) else \
             self.goal.bytes_target
-        for b in self.child.execute():
+        # pipelined execution: the child produces on a bounded prefetch
+        # thread while this thread concatenates — the coalesce-input
+        # overlap seam; pipeline-off iterates the child directly (exact
+        # serial path). A file scan already prefetches its own output, so
+        # stacking a second seam on that edge would only re-park every
+        # batch (catalog + budget traffic) for no added overlap.
+        from ..io.scanbase import TpuFileScanExec
+        it = self.child.execute() if isinstance(self.child,
+                                                TpuFileScanExec) \
+            else maybe_prefetch(self.child.execute(), self.conf,
+                                name="coalesce")
+        for b in it:
             self.num_input_batches.add(1)
             self.num_input_rows.add(b.row_count())
             pending.append(b)

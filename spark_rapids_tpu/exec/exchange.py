@@ -37,6 +37,9 @@ __all__ = ["TpuShuffleExchangeExec", "make_partitioner"]
 
 # process-wide count of executed mesh collectives (test/observability hook)
 MESH_EXCHANGES = 0
+# process-wide count of slot-overflow grow-and-rerun rounds (a bounded ICI
+# slot overflowed on a skewed partition and the exchange retried larger)
+SLOT_OVERFLOW_RETRIES = 0
 
 
 def make_partitioner(spec, schema: Schema,
@@ -260,6 +263,8 @@ class TpuShuffleExchangeExec(UnaryTpuExec):
                 break
             # a skewed partition overflowed the bounded slot: grow and rerun
             # (slot_cap == cap can never overflow, so this terminates)
+            global SLOT_OVERFLOW_RETRIES
+            SLOT_OVERFLOW_RETRIES += 1
             slot_cap = min(slot_cap * 2, cap)
         global MESH_EXCHANGES
         MESH_EXCHANGES += 1

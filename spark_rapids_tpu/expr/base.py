@@ -461,7 +461,8 @@ class Literal(LeafExpression):
         if isinstance(dt, T.DecimalType):
             import decimal as _d
             if isinstance(v, _d.Decimal):
-                v = int(v.scaleb(dt.scale))
+                from .decimal128 import unscaled_int
+                v = unscaled_int(v, dt.scale)
             if dt.precision > T.DecimalType.MAX_LONG_DIGITS:
                 from .decimal128 import split_int
                 hi, lo = split_int(int(v))

@@ -54,6 +54,29 @@ def join_int(hi: int, lo: int) -> int:
     return u - (1 << 128) if u >= (1 << 127) else u
 
 
+# Exact context for host-boundary Decimal<->unscaled-int conversion.
+# `Decimal.scaleb` (like all Decimal ARITHMETIC) rounds to the ambient
+# thread-local context precision — default 28, silently corrupting >28-digit
+# decimal(38) values on any engine worker thread (shuffle writers, pipeline
+# prefetch); the main thread only looked safe because the test harness set
+# its context wide. 80 digits covers any decimal(38) at any engine scale
+# shift, so these helpers are exact everywhere, on every thread.
+import decimal as _decimal
+
+_EXACT_CTX = _decimal.Context(prec=80)
+
+
+def unscaled_int(d: "_decimal.Decimal", scale: int) -> int:
+    """Decimal value -> exact unscaled int at `scale`, independent of the
+    caller's thread-local decimal context."""
+    return int(_EXACT_CTX.scaleb(d, scale))
+
+
+def to_decimal(unscaled: int, scale: int) -> "_decimal.Decimal":
+    """Exact unscaled int at `scale` -> Decimal, context-independent."""
+    return _EXACT_CTX.scaleb(_decimal.Decimal(unscaled), -scale)
+
+
 def _u(xp, x):
     return x.astype(np.uint64)
 

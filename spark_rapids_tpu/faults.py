@@ -23,6 +23,10 @@ Injection points (the catalog; call sites reference these constants):
   device.init         memory/device_manager.py backend first touch
   compile             compile/service.py   XLA compile + persisted-entry
                                            read (corruptible payload)
+  pipeline.prefetch   exec/base.py         one upstream pull on a pipeline
+                                           prefetch thread (the typed error
+                                           must cross the queue to the
+                                           consumer without deadlocking)
 
 A rule fires on the Nth eligible call (`nth`), or with seeded probability
 (`probability`), at most `times` times (0 = unlimited). Kinds:
@@ -53,7 +57,7 @@ __all__ = ["FaultRule", "FaultInjector", "fire", "inject",
            "install_from_conf", "ALL_POINTS",
            "ALLOC", "SPILL_WRITE", "SPILL_READ", "BLOCK_WRITE", "BLOCK_READ",
            "FETCH", "TCP_SEND", "TCP_RECV", "ADMISSION", "DEVICE_INIT",
-           "COMPILE"]
+           "COMPILE", "PREFETCH"]
 
 ALLOC = "memory.alloc"
 SPILL_WRITE = "spill.write"
@@ -66,9 +70,11 @@ TCP_RECV = "tcp.recv"
 ADMISSION = "service.admission"
 DEVICE_INIT = "device.init"
 COMPILE = "compile"
+PREFETCH = "pipeline.prefetch"
 
 ALL_POINTS = (ALLOC, SPILL_WRITE, SPILL_READ, BLOCK_WRITE, BLOCK_READ,
-              FETCH, TCP_SEND, TCP_RECV, ADMISSION, DEVICE_INIT, COMPILE)
+              FETCH, TCP_SEND, TCP_RECV, ADMISSION, DEVICE_INIT, COMPILE,
+              PREFETCH)
 
 # named exception factories for the config-spec grammar
 _ERROR_NAMES: Dict[str, Callable[[str], Exception]] = {

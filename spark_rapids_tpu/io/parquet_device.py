@@ -63,7 +63,17 @@ from .. import types as T
 from ..columnar.padding import row_bucket
 
 __all__ = ["DeviceDecodeUnsupported", "columns_supported",
-           "decode_row_group", "device_decode_file", "file_supported"]
+           "decode_row_group", "decode_row_groups_fused",
+           "device_decode_file", "file_supported"]
+
+
+def _note_dispatches(n: int = 1) -> None:
+    """Count device dispatch events the scan initiates: one per host->device
+    buffer shipped plus one per program invocation — an (approximate, lower
+    bound) proxy for tunnel round-trips. Feeds TaskMetrics.scan_dispatches;
+    bench.py reports dispatches-per-scan-batch from it."""
+    from ..utils.metrics import TaskMetrics
+    TaskMetrics.get().scan_dispatches += n
 
 
 class DeviceDecodeUnsupported(Exception):
@@ -906,6 +916,8 @@ def _device_phase(pf, rg: int, schema, works, nrows: int, host_cols=None):
         sig = tuple(_col_sig(w) for w in fused)
         program = _fused_decode_program(sig, cap)
         outs = program(np.int64(nrows), *jax.device_put(flat))
+        # one buffer per flat array + the nrows scalar + one program
+        _note_dispatches(len(flat) + 2)
         for w, (data, validity) in zip(fused, outs):
             fused_cols[w.name] = Column(w.dt, data, validity)
 
@@ -918,6 +930,11 @@ def _device_phase(pf, rg: int, schema, works, nrows: int, host_cols=None):
             cols.append(fused_cols[name])
             continue
         w = works[name]
+        # eager (non-fast-path) column: charge a coarse per-column floor —
+        # the eager assembles below issue at least a handful of transfers
+        # and program dispatches each (exact counts live on the fused path,
+        # the one the bench compares)
+        _note_dispatches(4)
         if w.defruns is not None:
             defined = _expand_def_levels(
                 *[jnp.asarray(a) for a in w.defruns], cap)
@@ -952,7 +969,10 @@ def decode_row_group(pf, f, rg: int, schema, host_cols=None):
     (one device batch live at a time, the reference's chunked-reader
     discipline) with no double decode."""
     works, nrows = _host_phase(pf, f, rg, schema, host_cols)
-    return _device_phase(pf, rg, schema, works, nrows, host_cols)
+    out = _device_phase(pf, rg, schema, works, nrows, host_cols)
+    from ..utils.metrics import TaskMetrics
+    TaskMetrics.get().scan_chunks += 1
+    return out
 
 
 def _host_cols_to_device(t, schema, names, cap: int):
@@ -1156,6 +1176,133 @@ def _col_sig(w):
             isinstance(w.dt, T.DateType))
 
 
+def _traced_decode_col(colsig, cap: int, nrows, it):
+    """Decode ONE column (traced) from the ship-order array iterator `it`.
+    Shared by the per-row-group fused program and the packed multi-chunk
+    program. `colsig` is `_col_sig`'s tuple for prim/flba columns or
+    `_string_sig`'s for the string fast path. Returns
+    (data, validity, lengths_or_None)."""
+    import jax.numpy as jnp
+    if colsig[0] == "string":
+        return _traced_decode_string(colsig, cap, nrows, it)
+    (kind, phys, post, flen, has_def, has_dict, dict_count,
+     segs, has_plain, np_dt_str, is_date) = colsig
+    if has_def:
+        runs = [next(it) for _ in range(5)]
+        defined = _expand_def_levels(*runs, cap)
+    else:
+        defined = jnp.arange(cap) < nrows
+    is_bool = phys == "BOOLEAN"
+    dict_vals = next(it) if has_dict else None
+    idx_parts = []
+    for bw, ndef, has_runs in segs:
+        if not has_runs:
+            idx_parts.append(jnp.zeros(ndef, jnp.uint32))
+            continue
+        runs = [next(it) for _ in range(5)]
+        idx_parts.append(_expand_rle_u32(
+            *runs, row_bucket(ndef), bw)[:ndef])
+    pieces = []
+    if idx_parts:
+        idx = idx_parts[0] if len(idx_parts) == 1 \
+            else jnp.concatenate(idx_parts)
+        idx = jnp.clip(idx, 0, max(dict_count - 1, 0))
+        dv = dict_vals[idx]
+        pieces.append(dv.astype(np.bool_) if is_bool else dv)
+    if has_plain:
+        pieces.append(next(it))
+    if kind == "flba":
+        if pieces:
+            mat = pieces[0] if len(pieces) == 1 \
+                else jnp.concatenate(pieces)
+        else:
+            mat = jnp.zeros((0, flen), jnp.uint8)
+        if mat.shape[0] < cap:
+            mat = jnp.pad(mat, ((0, cap - mat.shape[0]), (0, 0)))
+        mat = mat[:cap]
+        if post == "int96":
+            data, validity = _scatter_values(
+                _int96_to_micros(mat), defined)
+            return data, validity, None
+        hi, lo = _flba_to_limbs(mat, flen)
+        if post == "dec64":
+            data, validity = _scatter_values(lo, defined)
+            return data, validity, None
+        hi_s, validity = _scatter_values(hi, defined)
+        lo_s, _ = _scatter_values(lo, defined)
+        return jnp.stack([hi_s, lo_s], axis=1), validity, None
+    np_dt = np.dtype(np_dt_str)
+    if pieces:
+        vals = pieces[0] if len(pieces) == 1 \
+            else jnp.concatenate(pieces)
+    else:
+        vals = jnp.zeros(0, np.bool_ if is_bool
+                         else np.dtype(_PHYS_TO_NP[phys]))
+    if vals.shape[0] < cap:
+        vals = jnp.pad(vals, (0, cap - vals.shape[0]))
+    data, validity = _scatter_values(vals[:cap], defined)
+    if is_date:
+        data = data.astype(jnp.int32)
+    elif data.dtype != np_dt:
+        data = data.astype(np_dt)
+    if post == "ts_ms":
+        data = data * 1000
+    return data, validity, None
+
+
+def _traced_decode_string(colsig, cap: int, nrows, it):
+    """String fast path (traced): dictionary-index expansion gathers
+    per-value (start, len) spans out of the dictionary span tables, the
+    plain suffix's spans arrive host-scanned; one `_gather_strings` builds
+    the byte matrix from the shipped blob — the multi-chunk analog of
+    `_assemble_strings`, restricted to the dict-prefix + plain-suffix page
+    layout the fast path accepts."""
+    import jax.numpy as jnp
+    (_, has_def, has_dict, dict_count, segs, has_plain,
+     plain_ndef, width) = colsig
+    if has_def:
+        runs = [next(it) for _ in range(5)]
+        defined = _expand_def_levels(*runs, cap)
+    else:
+        defined = jnp.arange(cap) < nrows
+    st_parts, ln_parts = [], []
+    if has_dict:
+        dst = next(it)
+        dln = next(it)
+        idx_parts = []
+        for bw, ndef, has_runs in segs:
+            if not has_runs:
+                idx_parts.append(jnp.zeros(ndef, jnp.uint32))
+                continue
+            runs = [next(it) for _ in range(5)]
+            idx_parts.append(_expand_rle_u32(
+                *runs, row_bucket(ndef), bw)[:ndef])
+        if idx_parts:
+            idx = idx_parts[0] if len(idx_parts) == 1 \
+                else jnp.concatenate(idx_parts)
+            idx = jnp.clip(idx, 0, max(dict_count - 1, 0))
+            st_parts.append(dst[idx])
+            ln_parts.append(dln[idx])
+    if has_plain:
+        st_parts.append(next(it))
+        ln_parts.append(next(it))
+    blob = next(it)
+    if st_parts:
+        starts = st_parts[0] if len(st_parts) == 1 \
+            else jnp.concatenate(st_parts)
+        lens = ln_parts[0] if len(ln_parts) == 1 \
+            else jnp.concatenate(ln_parts)
+    else:
+        starts = jnp.zeros(0, jnp.int64)
+        lens = jnp.zeros(0, jnp.int32)
+    if starts.shape[0] < cap:
+        starts = jnp.pad(starts, (0, cap - starts.shape[0]))
+        lens = jnp.pad(lens, (0, cap - lens.shape[0]))
+    matrix, lengths = _gather_strings(blob, starts[:cap], lens[:cap],
+                                      defined, width)
+    return matrix, defined, lengths
+
+
 @functools.lru_cache(maxsize=256)
 def _fused_decode_program(sig_tuple, cap: int):
     """Build + jit the fused decoder for one structural signature.
@@ -1163,78 +1310,12 @@ def _fused_decode_program(sig_tuple, cap: int):
     _device_phase's ship order and returns (data, validity) per column.
     nrows rides as a traced scalar so varied tail-row-group sizes share
     one compiled program per (signature, capacity bucket)."""
-    import jax
-    import jax.numpy as jnp
 
     def fn(nrows, *arrays):
         it = iter(arrays)
         outs = []
-        for (kind, phys, post, flen, has_def, has_dict, dict_count,
-             segs, has_plain, np_dt_str, is_date) in sig_tuple:
-            if has_def:
-                runs = [next(it) for _ in range(5)]
-                defined = _expand_def_levels(*runs, cap)
-            else:
-                defined = jnp.arange(cap) < nrows
-            is_bool = phys == "BOOLEAN"
-            dict_vals = next(it) if has_dict else None
-            idx_parts = []
-            for bw, ndef, has_runs in segs:
-                if not has_runs:
-                    idx_parts.append(jnp.zeros(ndef, jnp.uint32))
-                    continue
-                runs = [next(it) for _ in range(5)]
-                idx_parts.append(_expand_rle_u32(
-                    *runs, row_bucket(ndef), bw)[:ndef])
-            pieces = []
-            if idx_parts:
-                idx = idx_parts[0] if len(idx_parts) == 1 \
-                    else jnp.concatenate(idx_parts)
-                idx = jnp.clip(idx, 0, max(dict_count - 1, 0))
-                dv = dict_vals[idx]
-                pieces.append(dv.astype(np.bool_) if is_bool else dv)
-            if has_plain:
-                pieces.append(next(it))
-            if kind == "flba":
-                if pieces:
-                    mat = pieces[0] if len(pieces) == 1 \
-                        else jnp.concatenate(pieces)
-                else:
-                    mat = jnp.zeros((0, flen), jnp.uint8)
-                if mat.shape[0] < cap:
-                    mat = jnp.pad(mat, ((0, cap - mat.shape[0]), (0, 0)))
-                mat = mat[:cap]
-                if post == "int96":
-                    data, validity = _scatter_values(
-                        _int96_to_micros(mat), defined)
-                    outs.append((data, validity))
-                    continue
-                hi, lo = _flba_to_limbs(mat, flen)
-                if post == "dec64":
-                    data, validity = _scatter_values(lo, defined)
-                    outs.append((data, validity))
-                else:
-                    hi_s, validity = _scatter_values(hi, defined)
-                    lo_s, _ = _scatter_values(lo, defined)
-                    outs.append((jnp.stack([hi_s, lo_s], axis=1),
-                                 validity))
-                continue
-            np_dt = np.dtype(np_dt_str)
-            if pieces:
-                vals = pieces[0] if len(pieces) == 1 \
-                    else jnp.concatenate(pieces)
-            else:
-                vals = jnp.zeros(0, np.bool_ if is_bool
-                                 else np.dtype(_PHYS_TO_NP[phys]))
-            if vals.shape[0] < cap:
-                vals = jnp.pad(vals, (0, cap - vals.shape[0]))
-            data, validity = _scatter_values(vals[:cap], defined)
-            if is_date:
-                data = data.astype(jnp.int32)
-            elif data.dtype != np_dt:
-                data = data.astype(np_dt)
-            if post == "ts_ms":
-                data = data * 1000
+        for colsig in sig_tuple:
+            data, validity, _ = _traced_decode_col(colsig, cap, nrows, it)
             outs.append((data, validity))
         return tuple(outs)
 
@@ -1522,12 +1603,324 @@ def _assemble_long_strings(jnp, dt, blob, starts, lens, defined, cap: int):
                   overflow=(tail_blob, tail_start))
 
 
-def device_decode_file(pf, path: str, schema, host_cols=None) -> Iterator:
-    """Yield (device ColumnarBatch, row count) per row group, streaming —
-    one batch live at a time. Host and device phases alternate serially:
-    on this image's single CPU core a prefetch thread measured ~2x SLOWER
-    than the serial loop (context-switch thrash against the tunnel
-    dispatch), so the double-buffer is deliberately absent."""
+# -- fused MULTI-CHUNK decode -------------------------------------------------
+# The pipelined scan batches several row-group chunks per dispatch: every
+# column's control-plane arrays (run tables, value payloads, string span
+# tables, blobs) PACK into one contiguous host buffer, ship in ONE
+# host->device transfer, and expand inside ONE compiled program that merges
+# the chunks into one batch — O(1) dispatches per scan batch instead of
+# O(columns x chunks) (the round-4 verdict's dispatch-amortization item).
+# Offsets/shapes are static (part of the program signature); uniform row
+# groups therefore share one compiled program, with at most one extra
+# signature for the tail row group.
+
+def _string_sig_from(meta: dict, w) -> tuple:
+    return ("string", w.defruns is not None, meta["has_dict_vals"],
+            meta["dict_count"], tuple(meta["segs"]), meta["has_plain"],
+            meta["plain_ndef"], meta["width"])
+
+
+def _prep_string(chunk: _Chunk):
+    """HOST half of the string fast path (multi-chunk decode): dict-prefix
+    + plain-suffix page layouts only (what real writers emit). The blob
+    lays out plain page payloads in page order with the dictionary blob at
+    the end (same layout as `_assemble_strings`); span tables come from the
+    native byte_array_scan. Returns (ship, meta) or None when the page
+    interleaving (or an over-wide value) needs the general eager path."""
+    from ..columnar.padding import width_bucket
+    from ..config import get_default_conf
+    from ..native import runtime as _native
+    kinds_seq = [p.kind for p in chunk.pages]
+    ndict = 0
+    while ndict < len(kinds_seq) and kinds_seq[ndict] == "dict":
+        ndict += 1
+    if not chunk.pages or not all(k == "plain" for k in kinds_seq[ndict:]):
+        return None
+    plain_pages = [p for p in chunk.pages[ndict:] if p.ndef]
+    blob_parts = [np.frombuffer(p.payload, np.uint8) for p in plain_pages]
+    plain_bases = []
+    base = 0
+    for p in plain_pages:
+        plain_bases.append(base)
+        base += len(p.payload)
+    dict_base = base
+    max_len = 1
+    ship: List[np.ndarray] = []
+    meta = {"segs": [], "dict_count": chunk.dict_count,
+            "has_dict_vals": False, "has_plain": False, "plain_ndef": 0}
+    if ndict:
+        if chunk.dict_raw is None or not chunk.dict_count:
+            raise DeviceDecodeUnsupported("dict page missing values")
+        dict_blob = np.frombuffer(chunk.dict_raw, np.uint8)
+        try:
+            dst, dln, dmx = _native.byte_array_scan(dict_blob,
+                                                    chunk.dict_count)
+        except ValueError as e:
+            raise DeviceDecodeUnsupported(str(e)) from e
+        blob_parts.append(dict_blob)
+        max_len = max(max_len, dmx)
+        ship.append((dst + dict_base).astype(np.int64))
+        ship.append(dln.astype(np.int32))
+        meta["has_dict_vals"] = True
+        for bw, ndef, runs in _dict_segments(chunk.pages[:ndict],
+                                             chunk.dict_count):
+            meta["segs"].append((bw, ndef, runs is not None))
+            if runs is not None:
+                ship.extend(_pad_runs(runs))
+    if plain_pages:
+        st_parts, ln_parts = [], []
+        for p, pb in zip(plain_pages, plain_bases):
+            pl = np.frombuffer(p.payload, np.uint8)
+            try:
+                st, ln, mx = _native.byte_array_scan(pl, p.ndef)
+            except ValueError as e:
+                raise DeviceDecodeUnsupported(str(e)) from e
+            max_len = max(max_len, mx)
+            st_parts.append(st + pb)
+            ln_parts.append(ln)
+        ship.append(np.concatenate(st_parts).astype(np.int64))
+        ship.append(np.concatenate(ln_parts).astype(np.int32))
+        meta["has_plain"] = True
+        meta["plain_ndef"] = sum(p.ndef for p in plain_pages)
+    ship.append(np.concatenate(blob_parts) if blob_parts
+                else np.zeros(1, np.uint8))
+    width = width_bucket(max_len)
+    if width > get_default_conf().string_max_width:
+        return None  # over-wide: the eager path builds the chunked layout
+    meta["width"] = width
+    return ship, meta
+
+
+def _pack_arrays(arrays: List[np.ndarray]):
+    """Flatten heterogeneous host arrays into ONE contiguous uint8 buffer
+    (one H2D instead of one per array). Returns (packed uint8[n], metas)
+    where each meta is (dtype str, shape, byte offset) — static, so it
+    rides the program signature and the device side reconstructs each
+    array with slices + bitcasts."""
+    metas = []
+    parts = []
+    off = 0
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        raw = a.view(np.uint8).reshape(-1) if a.dtype != np.bool_ \
+            else a.astype(np.uint8).reshape(-1)
+        metas.append((str(a.dtype), a.shape, off))
+        parts.append(raw)
+        off += raw.size
+    packed = np.concatenate(parts) if parts else np.zeros(1, np.uint8)
+    return packed, tuple(metas)
+
+
+def _unpack_traced(packed, meta):
+    """Device side of `_pack_arrays`: slice + bitcast one array back out
+    of the packed buffer (traced; offsets/shapes are static)."""
+    import jax.numpy as jnp
+    from jax import lax
+    dt_str, shape, off = meta
+    dt = np.dtype(dt_str)
+    n = int(np.prod(shape)) if shape else 1
+    seg = packed[off:off + n * dt.itemsize]
+    if dt == np.bool_:
+        return seg.astype(jnp.bool_).reshape(shape)
+    if dt.itemsize == 1:
+        return seg.reshape(shape)
+    arr = lax.bitcast_convert_type(seg.reshape(-1, dt.itemsize),
+                                   jnp.dtype(dt))
+    return arr.reshape(shape)
+
+
+@functools.lru_cache(maxsize=64)
+def _fused_multi_program(groups_sig, caps, cap_total: int):
+    """One compiled program decoding SEVERAL row-group chunks and merging
+    them into one batch. `groups_sig` is, per chunk, (per-column sig
+    tuple, packed-array metas); `caps` the per-chunk capacity buckets.
+    Takes (nrows int64[nchunks], packed uint8) — two buffers, one program:
+    the whole dispatch group costs 3 dispatch events regardless of column
+    or chunk count. Chunk results merge by a rank gather: global row j
+    maps to (chunk, within) via searchsorted over the traced cumulative
+    row counts, so tail chunks of any size share the program."""
+    import jax.numpy as jnp
+    nchunks = len(groups_sig)
+    ncols = len(groups_sig[0][0])
+    chunk_base = np.concatenate(([0], np.cumsum(caps)[:-1])).astype(np.int64)
+
+    def fn(nrows_arr, packed):
+        per_col = [[] for _ in range(ncols)]
+        for c_i, (colsigs, metas) in enumerate(groups_sig):
+            arrays = [_unpack_traced(packed, m) for m in metas]
+            it = iter(arrays)
+            for ci, colsig in enumerate(colsigs):
+                per_col[ci].append(_traced_decode_col(
+                    colsig, caps[c_i], nrows_arr[c_i], it))
+        cum = jnp.cumsum(nrows_arr)
+        total = cum[-1]
+        j = jnp.arange(cap_total, dtype=jnp.int64)
+        c_of_j = jnp.clip(jnp.searchsorted(cum, j, side="right"),
+                          0, nchunks - 1)
+        base = jnp.where(c_of_j > 0, cum[jnp.maximum(c_of_j - 1, 0)], 0)
+        src = jnp.asarray(chunk_base)[c_of_j] + (j - base)
+        live = j < total
+        outs = []
+        for ci in range(ncols):
+            datas = [d for d, _, _ in per_col[ci]]
+            valids = [v for _, v, _ in per_col[ci]]
+            lens = [l for _, _, l in per_col[ci]]
+            if datas[0].ndim == 2:
+                w = max(d.shape[1] for d in datas)
+                datas = [jnp.pad(d, ((0, 0), (0, w - d.shape[1])))
+                         if d.shape[1] < w else d for d in datas]
+            data = jnp.concatenate(datas) if nchunks > 1 else datas[0]
+            valid = jnp.concatenate(valids) if nchunks > 1 else valids[0]
+            gsrc = jnp.clip(src, 0, data.shape[0] - 1)
+            d = data[gsrc]
+            v = valid[gsrc] & live
+            if lens[0] is not None:
+                ln = jnp.concatenate(lens) if nchunks > 1 else lens[0]
+                outs.append((d, v, jnp.where(live, ln[gsrc], 0)))
+            else:
+                outs.append((d, v, None))
+        return tuple(outs)
+
+    from ..compile import sjit
+    return sjit(fn, op="io.parquet.fused_multi_decode",
+                key=repr((groups_sig, caps, cap_total)))
+
+
+def decode_row_groups_fused(pf, f, rgs, schema, host_cols=None):
+    """Decode SEVERAL row groups as one dispatch group -> list of
+    (device ColumnarBatch, rows). When every device column of every chunk
+    takes a fast-path prep (prim/flba ship or the string span-table prep)
+    the whole group decodes in ONE packed transfer + ONE program and the
+    list holds one merged batch; a column that DECLINES the fast path
+    (odd page interleaving, over-wide strings) degrades to per-row-group
+    decode REUSING the already-computed host-phase products — host work
+    (chunk reads, decompression, RLE scans) is never repeated. Only
+    failures the per-row-group device path could not absorb either
+    (malformed row groups, host-column read errors) raise
+    DeviceDecodeUnsupported for the caller's pyarrow fallback.
+    Host-fallback columns decode once via pyarrow's read_row_groups and
+    merge at the total capacity."""
+    import jax
+    import jax.numpy as jnp
+    from ..columnar.batch import ColumnarBatch
+    from ..columnar.column import Column
+    from ..utils.metrics import TaskMetrics
+    chunks = []
+    total = 0
+    for rg in rgs:
+        works, nrows = _host_phase(pf, f, rg, schema, host_cols)
+        chunks.append((rg, works, nrows))
+        total += nrows
+
+    def per_rg_batches():
+        """Per-row-group decode from the SAME works — no second host
+        phase. String works keep ship=None here, so `_device_phase`
+        routes them through the eager assembles."""
+        out = []
+        for rg, works, nrows in chunks:
+            out.append(_device_phase(pf, rg, schema, works, nrows,
+                                     host_cols))
+            TaskMetrics.get().scan_chunks += 1
+        return out
+
+    host_set = set(host_cols or ())
+    dev_names = [n for n in schema.names if n not in host_set]
+    if not dev_names or total == 0:
+        return per_rg_batches()
+    cap_total = row_bucket(total, op="scan.parquet")
+
+    groups_sig = []
+    caps = []
+    all_arrays: List[np.ndarray] = []
+    bounds = []
+    for _, works, nrows in chunks:
+        # same op attribution as the serial path: the bucket tuner's scan
+        # histogram must see the default-on chunk shapes too
+        cap = row_bucket(nrows, op="scan.parquet")
+        caps.append(cap)
+        colsigs = []
+        arrays: List[np.ndarray] = []
+        for name in dev_names:
+            w = works[name]
+            ship, meta = w.ship, w.meta
+            if ship is None and w.spec.kind == "string":
+                # local only — `works` stays pristine so the per-rg
+                # fallback's `_device_phase` eager-assembles strings
+                # (its fused branch cannot consume a string ship)
+                prepped = _prep_string(w.chunk)
+                if prepped is not None:
+                    ship, meta = prepped
+            if ship is None:
+                return per_rg_batches()  # fast path declined: degrade
+            if w.spec.kind == "string":
+                colsigs.append(_string_sig_from(meta, w))
+            else:
+                colsigs.append(_col_sig(w))
+            if w.defruns is not None:
+                arrays.extend(w.defruns)
+            arrays.extend(ship)
+        bounds.append(len(all_arrays))
+        all_arrays.extend(arrays)
+        groups_sig.append([tuple(colsigs), None])  # metas filled below
+    packed, metas = _pack_arrays(all_arrays)
+    bounds.append(len(all_arrays))
+    for i, g in enumerate(groups_sig):
+        g[1] = metas[bounds[i]:bounds[i + 1]]
+    groups_sig = tuple((cs, m) for cs, m in groups_sig)
+
+    program = _fused_multi_program(groups_sig, tuple(caps), cap_total)
+    nrows_arr = np.asarray([n for _, _, n in chunks], np.int64)
+    outs = program(nrows_arr, jax.device_put(packed))
+    _note_dispatches(3)  # nrows buffer + packed buffer + one program
+    TaskMetrics.get().scan_chunks += len(rgs)
+
+    host_decoded = {}
+    if host_set:
+        names = [n for n in schema.names if n in host_set]
+        import pyarrow as pa
+        try:
+            t = pf.read_row_groups(list(rgs), columns=names)
+        except (OSError, pa.ArrowInvalid, KeyError) as e:
+            raise DeviceDecodeUnsupported(
+                f"host column decode: {e}") from e
+        if t.num_rows != total:
+            raise DeviceDecodeUnsupported("host column row-count mismatch")
+        host_decoded = _host_cols_to_device(t, schema, names, cap_total)
+
+    by_name = dict(zip(schema.names, schema.types))
+    dev_out = dict(zip(dev_names, outs))
+    cols = []
+    for name in schema.names:
+        if name in host_decoded:
+            cols.append(host_decoded[name])
+            continue
+        data, validity, lengths = dev_out[name]
+        cols.append(Column(by_name[name], data, validity, lengths))
+    return [(ColumnarBatch(schema, tuple(cols),
+                           jnp.asarray(total, jnp.int32)), total)]
+
+
+def device_decode_file(pf, path: str, schema, host_cols=None,
+                       chunks_per_dispatch: int = 1) -> Iterator:
+    """Yield (device ColumnarBatch, row count), streaming — one dispatch
+    group live at a time. `chunks_per_dispatch` > 1 batches that many row
+    groups per fused dispatch (packed single-transfer decode); a group the
+    fast path declines falls back to per-row-group decode, preserving the
+    narrow fallback net. 1 reproduces the pre-pipeline per-row-group
+    unit."""
+    group = max(int(chunks_per_dispatch), 1)
     with open(path, "rb") as f:
-        for rg in range(pf.metadata.num_row_groups):
-            yield decode_row_group(pf, f, rg, schema, host_cols)
+        rgs = list(range(pf.metadata.num_row_groups))
+        i = 0
+        while i < len(rgs):
+            chunk_rgs = rgs[i:i + group]
+            i += len(chunk_rgs)
+            if len(chunk_rgs) > 1:
+                try:
+                    yield from decode_row_groups_fused(pf, f, chunk_rgs,
+                                                       schema, host_cols)
+                    continue
+                except DeviceDecodeUnsupported:
+                    pass  # per-row-group decode below
+            for rg in chunk_rgs:
+                yield decode_row_group(pf, f, rg, schema, host_cols)

@@ -204,10 +204,19 @@ class TpuFileScanExec(_TpuExec):
         iterator spends suspended (downstream sort/join work) never
         inflates the profile's io phase and downstream spans cannot
         mis-parent under a long-lived scan span. The format-specific
-        generators below stay untouched."""
+        generators below stay untouched.
+
+        Pipelined execution wraps the decode stream in the bounded
+        prefetch iterator (exec/base.py): a background thread runs the
+        host half of the NEXT batch's decode (page prep, RLE scans,
+        pyarrow fallbacks) while downstream operators compute — the
+        host<->device overlap half of the pipeline; pipeline-off keeps
+        the exact serial stream."""
+        from ..exec.base import maybe_prefetch
         from ..utils import spans
         fmt = self.cpu_scan.format_name
-        it = self._decode_batches()
+        it = maybe_prefetch(self._decode_batches(), self.conf,
+                            name=f"scan-{fmt}")
         live = spans.current_profile() is not None
         while True:
             with self.read_time.timed(), \
@@ -427,14 +436,23 @@ class TpuFileScanExec(_TpuExec):
 
     def _decode_rgs_pipelined(self, pf, path, rgs, host_cols, scan,
                               scan_names):
-        """Stream row groups, one device batch live at a time. Host and
-        device phases run serially (a prefetch thread measured ~2x
-        SLOWER on this image's single CPU core); host- or device-phase
-        surprises fall just that row group back to pyarrow — the same
-        narrow net as before."""
+        """Stream row groups, one dispatch group live at a time. With
+        pipelining on, `spark.rapids.tpu.pipeline.scan.chunksPerDispatch`
+        row-group chunks decode per FUSED dispatch (packed
+        single-transfer, one compiled program, one merged batch —
+        O(1) dispatches per scan batch); a group the fast path declines,
+        and pipeline-off entirely, take the per-row-group path. Host- or
+        device-phase surprises fall just that row group back to pyarrow —
+        the same narrow net as before."""
         from ..columnar.batch import batch_from_arrow
+        from ..utils.metrics import TaskMetrics
         from .parquet_device import (DeviceDecodeUnsupported, _device_phase,
-                                     _host_phase)
+                                     _host_phase, decode_row_groups_fused)
+        tm = TaskMetrics.get()
+        group = 1
+        if self.conf.get("spark.rapids.tpu.pipeline.enabled"):
+            group = max(self.conf.get(
+                "spark.rapids.tpu.pipeline.scan.chunksPerDispatch"), 1)
 
         def host_fallback(rg):
             t = scan._postprocess(pf.read_row_group(rg,
@@ -442,16 +460,35 @@ class TpuFileScanExec(_TpuExec):
             return batch_from_arrow(t), t.num_rows
 
         with open(path, "rb") as f:
-            for rg in rgs:
-                try:
-                    works, nrows = _host_phase(pf, f, rg, scan.output,
-                                               host_cols)
-                    b, nrows = _device_phase(pf, rg, scan.output, works,
-                                             nrows, host_cols)
-                except (DeviceDecodeUnsupported, OSError, struct_error):
-                    b, nrows = host_fallback(rg)
-                self.num_output_rows.add(nrows)
-                yield self._count_output(b)
+            i = 0
+            while i < len(rgs):
+                chunk_rgs = rgs[i:i + group]
+                i += len(chunk_rgs)
+                if len(chunk_rgs) > 1:
+                    try:
+                        outs = decode_row_groups_fused(
+                            pf, f, chunk_rgs, scan.output, host_cols)
+                    except (DeviceDecodeUnsupported, OSError,
+                            struct_error):
+                        pass  # per-row-group decode below
+                    else:
+                        for b, nrows in outs:
+                            tm.scan_batches += 1
+                            self.num_output_rows.add(nrows)
+                            yield self._count_output(b)
+                        continue
+                for rg in chunk_rgs:
+                    try:
+                        works, nrows = _host_phase(pf, f, rg, scan.output,
+                                                   host_cols)
+                        b, nrows = _device_phase(pf, rg, scan.output,
+                                                 works, nrows, host_cols)
+                        tm.scan_batches += 1
+                    except (DeviceDecodeUnsupported, OSError,
+                            struct_error):
+                        b, nrows = host_fallback(rg)
+                    self.num_output_rows.add(nrows)
+                    yield self._count_output(b)
 
 
 def make_tpu_file_scan(plan: CpuFileScanExec, conf: TpuConf) -> TpuFileScanExec:

@@ -43,6 +43,20 @@ class TpuSemaphore:
             self._sem.acquire()
         TaskMetrics.get().semaphore_wait_ns += time.monotonic_ns() - t0
         self._held.count = 1
+        self._held.borrowed = False
+
+    def adopt_task_hold(self) -> None:
+        """Mark the CURRENT thread as sharing its task's admission: a
+        pipeline prefetch producer works on behalf of the consumer's task
+        (which holds the real permit), so device work on this thread must
+        be reentrant against that hold, not consume a second permit — with
+        `concurrentGpuTasks=1` a producer taking its own permit while the
+        task thread holds the only one would deadlock the engine. Acquires
+        nothing; `release_if_held`/`complete_task` on this thread unwind
+        the count without releasing the task's permit."""
+        if getattr(self._held, "count", 0) == 0:
+            self._held.count = 1
+            self._held.borrowed = True
 
     def release_if_held(self) -> None:
         count = getattr(self._held, "count", 0)
@@ -50,7 +64,9 @@ class TpuSemaphore:
             self._held.count -= 1
         elif count == 1:
             self._held.count = 0
-            self._sem.release()
+            if not getattr(self._held, "borrowed", False):
+                self._sem.release()
+            self._held.borrowed = False
 
     def complete_task(self) -> None:
         while getattr(self._held, "count", 0) > 0:

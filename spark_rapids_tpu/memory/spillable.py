@@ -26,10 +26,19 @@ class SpillableColumnarBatch:
         from .budget import MemoryBudget
         MemoryBudget.get().note_parked(self.size_bytes)
 
-    def get_batch(self) -> ColumnarBatch:
+    def get_batch(self, acquire_semaphore: bool = True) -> ColumnarBatch:
+        """Materialize on device. `acquire_semaphore=False` is for the
+        pipeline prefetch consumer: a parked batch there is part of the
+        task's own in-flight stream (the serial path holds exactly these
+        batches live on device with no re-admission), so materializing it
+        must not consume an admission permit — on a service handler
+        thread that never calls complete_task, a per-thread acquire here
+        would pin a permit forever and wedge `concurrentGpuTasks=1`
+        deployments."""
         if self._handle is None:
             raise ValueError("spillable batch already closed")
-        TpuSemaphore.get().acquire_if_necessary()
+        if acquire_semaphore:
+            TpuSemaphore.get().acquire_if_necessary()
         return self._catalog.acquire_batch(self._handle)
 
     @property

@@ -156,8 +156,23 @@ class TpuSession:
                 prof = spans.begin_profile(label=result.name)
                 prof.attach_plan(result)
             try:
+                # pipelined execution: the plan's stream produces on a
+                # bounded prefetch thread while this thread converts
+                # results D2H — device compute overlaps the host sink.
+                # Roots that already prefetch their own output (file
+                # scans, coalesce inputs) are not wrapped again: a second
+                # seam on the same edge re-parks every batch for no
+                # added overlap.
+                from .exec.base import maybe_prefetch
+                from .exec.coalesce import TpuCoalesceBatchesExec
+                from .io.scanbase import TpuFileScanExec
+                stream = result.execute()
+                if not isinstance(result, (TpuFileScanExec,
+                                           TpuCoalesceBatchesExec)):
+                    stream = maybe_prefetch(stream, self.conf,
+                                            name="sink")
                 host_batches = [device_batch_to_host(b)
-                                for b in result.execute()]
+                                for b in stream]
                 # retry-storm visibility: when explain is on, surface the
                 # task's OOM-retry/shuffle-recovery counters (incl. the
                 # per-attempt backoff schedule) next to the plan output
@@ -171,8 +186,15 @@ class TpuSession:
                 # mid-stream): re-run the stage on the host engine — plan
                 # sources are idempotent, so a from-scratch CPU pass is
                 # safe (the reference's whole-plan willNotWork fallback,
-                # applied at runtime)
+                # applied at runtime). Counted: these re-runs are silent
+                # by design, so TaskMetrics must make them visible
+                # (explain_string + profile report).
+                TaskMetrics.get().cpu_fallback_reruns += 1
                 host_batches = list(plan.execute_cpu())
+                if self.conf.explain != "NONE":
+                    tm_line = TaskMetrics.get().explain_string()
+                    if tm_line:
+                        print(tm_line)
             finally:
                 if prof is not None:
                     spans.end_profile(prof)

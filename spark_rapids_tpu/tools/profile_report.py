@@ -206,9 +206,27 @@ def render_report(model: Dict[str, Any], top: int = 10) -> str:
                 f"shuffle fetch retries={tm.get('shuffle_retry_count', 0)} "
                 f"refetches={tm.get('shuffle_refetch_count', 0)} "
                 f"failovers={tm.get('shuffle_failover_count', 0)}")
+        if tm.get("cpu_fallback_reruns"):
+            # silent by design at runtime — loud here: each re-run threw
+            # away the device stage's work and re-ran it on the host
+            storm.append(
+                f"CPU fallback stage re-runs="
+                f"{tm.get('cpu_fallback_reruns', 0)} "
+                "(device layout could not represent the data, e.g. a "
+                ">headWidth string key)")
         if storm:
             lines.append("retry storms:")
             lines.extend("  " + s for s in storm)
+        if tm.get("prefetch_threads") or tm.get("scan_dispatches"):
+            per_batch = tm.get("scan_dispatches", 0) / \
+                max(tm.get("scan_batches", 0), 1)
+            lines.append(
+                f"pipeline: prefetchThreads={tm.get('prefetch_threads', 0)} "
+                f"prefetchBatches={tm.get('prefetch_batches', 0)} "
+                f"prefetchStallMs="
+                f"{tm.get('prefetch_stall_ns', 0) / 1e6:.1f} "
+                f"scanDispatches={tm.get('scan_dispatches', 0)} "
+                f"dispatchesPerScanBatch={per_batch:.2f}")
         if tm.get("shuffle_bytes_written") or tm.get("shuffle_bytes_read"):
             lines.append(
                 f"shuffle volume: written={tm.get('shuffle_bytes_written', 0)}"

@@ -168,6 +168,23 @@ class TaskMetrics:
         self.compile_cache_misses = 0
         self.compile_persist_hits = 0
         self.compile_fallbacks = 0
+        # pipelined-execution counters (exec/base.py PrefetchIterator +
+        # io/parquet_device.py fused multi-chunk decode): prefetch threads
+        # spawned for this task, batches they parked, wall ns the CONSUMER
+        # spent stalled on an empty prefetch queue (the pipeline's residual
+        # serial cost), and the scan decode's dispatch accounting — device
+        # dispatch events (program executions + H2D transfer calls) vs
+        # row-group chunks vs produced batches, the amortization signal
+        self.prefetch_threads = 0
+        self.prefetch_batches = 0
+        self.prefetch_stall_ns = 0
+        self.scan_dispatches = 0
+        self.scan_chunks = 0
+        self.scan_batches = 0
+        # CPU-fallback stage re-runs: a device-side CpuFallbackRequired
+        # (e.g. require_flat_strings on a >headWidth key) silently re-ran
+        # the whole stage on the host engine this many times
+        self.cpu_fallback_reruns = 0
 
     @classmethod
     def get(cls) -> "TaskMetrics":
@@ -213,4 +230,18 @@ class TaskMetrics:
                 f"compileCacheMisses={self.compile_cache_misses} "
                 f"compilePersistHits={self.compile_persist_hits} "
                 f"compileFallbacks={self.compile_fallbacks}")
+        if self.prefetch_threads or self.prefetch_batches:
+            parts.append(
+                f"prefetchThreads={self.prefetch_threads} "
+                f"prefetchBatches={self.prefetch_batches} "
+                f"prefetchStallMs={self.prefetch_stall_ns / 1e6:.1f}")
+        if self.scan_dispatches:
+            per_batch = self.scan_dispatches / max(self.scan_batches, 1)
+            parts.append(
+                f"scanDispatches={self.scan_dispatches} "
+                f"scanChunks={self.scan_chunks} "
+                f"scanBatches={self.scan_batches} "
+                f"dispatchesPerScanBatch={per_batch:.2f}")
+        if self.cpu_fallback_reruns:
+            parts.append(f"cpuFallbackReruns={self.cpu_fallback_reruns}")
         return "" if not parts else "TaskMetrics: " + "; ".join(parts)

@@ -40,6 +40,11 @@ def pytest_configure(config):
         "observability: query-profiler suite (span tracer / metrics "
         "wiring / event log / report tool; scripts/profile_matrix.sh runs "
         "these standalone)")
+    config.addinivalue_line(
+        "markers",
+        "pipeline: pipelined-execution suite (bounded async prefetch / "
+        "fused multi-chunk scan decode / pipeline on-off equality; "
+        "scripts/pipeline_matrix.sh runs these standalone)")
 
 
 @pytest.fixture
