@@ -494,33 +494,58 @@ def main():
     except Exception as e:  # scan bench must not sink the primary metric
         detail["scan_decode_error"] = f"{type(e).__name__}: {e}"
     emit(detail)
+    # scheduler scenario (ISSUE-7): appended to the BENCH detail when the
+    # attempt budget allows; a failure/timeout records the error and keeps
+    # every number already emitted
+    try:
+        detail["sched_bench"] = _sched_bench_subprocess(t_start)
+    except Exception as e:
+        detail["sched_bench_error"] = f"{type(e).__name__}: {e}"
+    emit(detail)
 
 
 SCAN_CHILD_TIMEOUT_S = 240
 
 
-def _scan_bench_subprocess(t_attempt_start: float) -> dict:
-    """Run scan_decode_bench in a FRESH process. After a large compiled
-    program executes, the axon tunnel drops out of its fast dispatch path
-    (eager per-op latency measured 0.04ms -> 3.7ms, H2D goes synchronous),
-    which buries the scan measurement under ~8x inflated transfer time; a
-    real scan runs in its own executor process, so a fresh child is the
-    faithful measurement. The child's timeout is clamped to the REMAINING
-    attempt budget (minus margin for the final emit) so the attempt
-    watchdog can never fire while the grandchild runs and orphan it."""
+def _child_bench_subprocess(flag: str, t_attempt_start: float,
+                            marker: str = _MARK,
+                            keep_marker: bool = False) -> dict:
+    """Run one bench scenario in a FRESH child process, its timeout
+    clamped to the REMAINING attempt budget (minus margin for the final
+    emit) so the attempt watchdog can never fire while the grandchild
+    runs and orphan it. Returns the last `marker`-prefixed JSON line
+    (`keep_marker` when the marker is part of the JSON itself)."""
     elapsed = time.perf_counter() - t_attempt_start
     budget = min(SCAN_CHILD_TIMEOUT_S, ATTEMPT_TIMEOUT_S - elapsed - 20)
     if budget <= 5:
-        raise RuntimeError("no attempt budget left for the scan child")
+        raise RuntimeError(f"no attempt budget left for the {flag} child")
     proc = subprocess.run(
-        [sys.executable, os.path.abspath(__file__), "--scan-only"],
+        [sys.executable, os.path.abspath(__file__), flag],
         capture_output=True, text=True, timeout=budget)
     for line in reversed((proc.stdout or "").splitlines()):
-        if line.startswith(_MARK):
-            return json.loads(line[len(_MARK):])
+        if line.startswith(marker):
+            return json.loads(line if keep_marker else line[len(marker):])
     raise RuntimeError(
-        f"scan child rc={proc.returncode}: "
+        f"{flag} child rc={proc.returncode}: "
         f"{(proc.stderr or '')[-300:]}")
+
+
+def _scan_bench_subprocess(t_attempt_start: float) -> dict:
+    """Scan bench in its own process. After a large compiled program
+    executes, the axon tunnel drops out of its fast dispatch path (eager
+    per-op latency measured 0.04ms -> 3.7ms, H2D goes synchronous),
+    which buries the scan measurement under ~8x inflated transfer time; a
+    real scan runs in its own executor process, so a fresh child is the
+    faithful measurement."""
+    return _child_bench_subprocess("--scan-only", t_attempt_start)
+
+
+def _sched_bench_subprocess(t_attempt_start: float) -> dict:
+    """Sched scenario in a fresh process (same rationale as the scan
+    child: engine state from the main measurement must not skew it).
+    --sched prints bare JSON, so the marker is the opening brace."""
+    return _child_bench_subprocess("--sched", t_attempt_start, marker="{",
+                                   keep_marker=True)
 
 
 def scan_only() -> None:
@@ -631,6 +656,116 @@ def profile_query(log_dir: str, force_spill: bool = True) -> dict:
     }
 
 
+SCHED_LOW_QUERIES = 8
+SCHED_HIGH_QUERIES = 2
+SCHED_ROWS = 200_000
+
+
+def sched_bench() -> dict:
+    """Overloaded mixed-priority workload (ISSUE-7 flag: `bench.py
+    --sched`): N_low low-priority queries flood a concurrentGpuTasks=1
+    engine, then N_high high-priority queries arrive late. The SAME
+    workload runs twice — FIFO baseline (sched.enabled=false; queries
+    still carry contexts so admission is per-query and waits are
+    measurable) and scheduler-on (strict priority + fair share) — and the
+    JSON reports per-mode admission-wait p50/p99 and the high-priority
+    latency the scheduler exists to protect. Acceptance: sched-on
+    high-pri p99 < FIFO high-pri p99 under overload."""
+    _apply_platform_override()
+    import pyarrow as pa
+    from spark_rapids_tpu.expr import Count, Sum, col
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+    from spark_rapids_tpu.plugin import TpuSession
+    from spark_rapids_tpu.sched import QueryContext
+    from spark_rapids_tpu.utils.metrics import TaskMetrics
+
+    from spark_rapids_tpu.tools.profile_report import _percentile
+
+    rng = np.random.default_rng(17)
+    n = SCHED_ROWS
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 4096, n)),
+        "g": pa.array(rng.integers(0, 256, n).astype(np.int32)),
+        "v": pa.array(rng.uniform(size=n)),
+    })
+
+    def percentile(vals, p):
+        return _percentile(sorted(vals), p)
+
+    def run_mode(sched_on: bool) -> dict:
+        import threading
+        import time as _t
+        sess = TpuSession({
+            "spark.rapids.sql.enabled": True,
+            "spark.rapids.sql.explain": "NONE",
+            "spark.rapids.sql.concurrentGpuTasks": 1,
+            "spark.rapids.tpu.sched.enabled": sched_on,
+        })
+        sess.initialize_device()
+        TpuSemaphore.initialize(1, sess.conf)
+
+        def make_plan():
+            return (sess.from_arrow(t).filter(col("v") > 0.2)
+                    .group_by("g").agg(total=Sum(col("v")),
+                                       cnt=Count(col("k")))).plan
+
+        # warm: compiles out of the measurement
+        sess.execute_plan(make_plan(), sched_ctx=QueryContext())
+        lat = {}
+        wait = {}
+        errs = []
+
+        def worker(name, priority):
+            try:
+                ctx = QueryContext(priority=priority)
+                t0 = _t.perf_counter()
+                sess.execute_plan(make_plan(), sched_ctx=ctx)
+                lat[name] = _t.perf_counter() - t0
+                wait[name] = TaskMetrics.get().semaphore_wait_ns / 1e9
+            except Exception as e:  # noqa: BLE001 — reported in JSON
+                errs.append(f"{name}: {type(e).__name__}: {e}")
+
+        low = [threading.Thread(target=worker, args=(f"low{i}", 0))
+               for i in range(SCHED_LOW_QUERIES)]
+        high = [threading.Thread(target=worker, args=(f"high{i}", 10))
+                for i in range(SCHED_HIGH_QUERIES)]
+        for th in low:
+            th.start()
+        _t.sleep(0.05)  # the overload is standing when high-pri arrives
+        for th in high:
+            th.start()
+        for th in low + high:
+            th.join(timeout=600)
+        TpuSemaphore._instance = None
+        waits = list(wait.values())
+        high_lat = [lat[k] for k in lat if k.startswith("high")]
+        return {
+            "queries": len(lat),
+            "errors": errs,
+            "wait_p50_s": round(percentile(waits, 50), 4),
+            "wait_p99_s": round(percentile(waits, 99), 4),
+            "highpri_mean_s": round(float(np.mean(high_lat)), 4)
+            if high_lat else None,
+            "highpri_p99_s": round(percentile(high_lat, 99), 4)
+            if high_lat else None,
+        }
+
+    fifo = run_mode(False)
+    sched = run_mode(True)
+    out = {
+        "metric": "sched_bench",
+        "low_queries": SCHED_LOW_QUERIES,
+        "high_queries": SCHED_HIGH_QUERIES,
+        "rows_per_query": SCHED_ROWS,
+        "fifo": fifo,
+        "sched": sched,
+    }
+    if fifo.get("highpri_p99_s") and sched.get("highpri_p99_s"):
+        out["highpri_p99_speedup_x"] = round(
+            fifo["highpri_p99_s"] / sched["highpri_p99_s"], 3)
+    return out
+
+
 PROBE_TIMEOUT_S = 35
 PROBE_ATTEMPTS = 2
 
@@ -734,6 +869,11 @@ if __name__ == "__main__":
         print(json.dumps(profile_query(
             sys.argv[ix + 1],
             force_spill="--no-spill" not in sys.argv)), flush=True)
+    elif "--sched" in sys.argv:
+        # bench flag (ISSUE-7): overloaded mixed-priority workload, FIFO
+        # baseline vs scheduler, one JSON line (appended to BENCH detail)
+        _enable_compilation_cache()
+        print(json.dumps(sched_bench()), flush=True)
     elif "--scan-only" in sys.argv:
         scan_only()
     elif os.environ.get(_CHILD_ENV):

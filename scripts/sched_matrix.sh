@@ -1,0 +1,145 @@
+#!/usr/bin/env bash
+# Query-scheduler matrix (ISSUE-7 CI gate):
+#   1. run the scheduler test suite (marker `sched`);
+#   2. scheduler-OFF gate: with spark.rapids.tpu.sched.enabled=false a
+#      query takes the exact pre-scheduler FIFO paths — no QueryScheduler
+#      object exists, ZERO new threads are spawned, results match the
+#      scheduler-on run bit-for-bit, and the service _Admission grants in
+#      strict FIFO order ignoring priority fields;
+#   3. cancelled-query profile gate: a query cancelled mid-run emits a
+#      profile record with status=cancelled and the sched queue-wait
+#      counter present, and the report tool renders its scheduler section.
+#
+# Usage: scripts/sched_matrix.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TIMEOUT="${SRTPU_SCHED_TIMEOUT:-900}"
+
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_sched.py -m sched -q \
+    -p no:cacheprovider "$@"
+
+echo "== scheduler-off gate (no sched state, zero threads, FIFO, identical) =="
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu python - <<'EOF'
+import threading, time
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.expr import Count, Sum, col
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+from spark_rapids_tpu.plugin import TpuSession
+
+rng = np.random.default_rng(29)
+n = 30_000
+t = pa.table({"k": pa.array(rng.integers(0, 128, n)),
+              "g": pa.array(rng.integers(0, 32, n).astype(np.int32)),
+              "v": pa.array(rng.uniform(size=n))})
+
+def run(sched_on):
+    sess = TpuSession({"spark.rapids.sql.enabled": True,
+                       "spark.rapids.sql.explain": "NONE",
+                       "spark.rapids.tpu.sched.enabled": sched_on})
+    sess.initialize_device()
+    TpuSemaphore.initialize(sess.conf.concurrent_tpu_tasks, sess.conf)
+    q = (sess.from_arrow(t).filter(col("v") > 0.3)
+         .group_by("g").agg(total=Sum(col("v")), cnt=Count(col("k"))))
+    return sess, q.collect().sort_by("g")
+
+threads0 = threading.active_count()
+sess_off, off = run(False)
+assert TpuSemaphore.get().scheduler is None, \
+    "FAIL: scheduler state exists with sched disabled"
+assert threading.active_count() <= threads0, \
+    f"FAIL: sched-off spawned {threading.active_count() - threads0} threads"
+print("sched-off: no scheduler object, zero new threads OK")
+
+# service admission stays strict-FIFO with the scheduler disabled, even
+# when acquire ops CLAIM priorities
+from spark_rapids_tpu.service.server import _Admission
+adm = _Admission(1, sess_off.conf)
+assert not adm.sched_enabled
+assert adm.acquire() == 1
+got = []
+ths = []
+for i, prio in enumerate([0, 50, 99]):
+    th = threading.Thread(
+        target=lambda i=i, p=prio: got.append((adm.acquire(priority=p), i)))
+    th.start(); time.sleep(0.05); ths.append(th)
+for _ in range(3):
+    adm.release_one()
+for th in ths:
+    th.join(timeout=10)
+adm.release_one()
+assert [i for _, i in sorted(got)] == [0, 1, 2], \
+    f"FAIL: FIFO order violated with scheduler off: {sorted(got)}"
+print("sched-off service admission: strict FIFO, priorities ignored OK")
+
+sess_on, on = run(True)
+assert TpuSemaphore.get().scheduler is not None, \
+    "FAIL: no scheduler with sched enabled"
+assert on.equals(off), "FAIL: sched-on result differs from sched-off"
+print("sched-on: identical results OK")
+TpuSemaphore._instance = None
+EOF
+
+echo "== cancelled-query profile gate (queue-wait + cancelled status) =="
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu python - <<'EOF'
+import tempfile
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.errors import QueryCancelledError
+from spark_rapids_tpu.expr import Sum, col
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+from spark_rapids_tpu.plugin import TpuSession
+from spark_rapids_tpu.sched import QueryContext
+from spark_rapids_tpu.tools.profile_report import (build_model, load_records,
+                                                   render_report,
+                                                   sched_summary)
+
+log_dir = tempfile.mkdtemp(prefix="srtpu-sched-gate-")
+sess = TpuSession({"spark.rapids.sql.enabled": True,
+                   "spark.rapids.sql.explain": "NONE",
+                   "spark.rapids.tpu.sched.enabled": True,
+                   "spark.rapids.tpu.metrics.eventLog.dir": log_dir})
+sess.initialize_device()
+TpuSemaphore.initialize(sess.conf.concurrent_tpu_tasks, sess.conf)
+
+rng = np.random.default_rng(31)
+t = pa.table({"g": pa.array(rng.integers(0, 16, 20_000).astype(np.int32)),
+              "v": pa.array(rng.uniform(size=20_000))})
+plan = sess.from_arrow(t).group_by("g").agg(s=Sum(col("v"))).plan
+
+ctx = QueryContext()
+ctx.token.cancel("matrix kill")
+try:
+    sess.execute_plan(plan, sched_ctx=ctx)
+    raise SystemExit("FAIL: cancelled query returned a result")
+except QueryCancelledError:
+    pass
+prof = sess.last_profile
+assert prof is not None and prof.status == "cancelled", \
+    f"FAIL: profile status {prof and prof.status!r}"
+qrec = [r for r in prof.to_records() if r["type"] == "query"][0]
+assert qrec["status"] == "cancelled"
+assert "sched_queue_wait_ns" in qrec["task_metrics"], \
+    "FAIL: no queue-wait counter in the cancelled profile record"
+
+# a clean run beside it, then the report's scheduler section over the log
+out = sess.execute_plan(plan, sched_ctx=QueryContext(tenant="gate"))
+assert out.num_rows > 0
+records, problems = load_records([log_dir], validate=True)
+assert not problems, problems
+model = build_model(records)
+summary = sched_summary(model)
+assert summary.get("query_statuses", {}).get("cancelled") == 1, summary
+assert summary["admissions"] >= 1, summary
+report = render_report(model)
+assert "=== scheduler ===" in report and "status=cancelled" in report
+print("cancelled-query profile record + report scheduler section OK")
+print(report.splitlines()[0])
+TpuSemaphore._instance = None
+EOF
+
+echo "sched matrix: all gates passed"

@@ -416,6 +416,47 @@ register("spark.rapids.tpu.pipeline.scan.chunksPerDispatch", "int", 4,
          "batching (per-row-group decode, the pre-pipeline unit); "
          "ignored when spark.rapids.tpu.pipeline.enabled is false.")
 
+# Query scheduler --------------------------------------------------------------------
+register("spark.rapids.tpu.sched.enabled", "bool", False,
+         "Query scheduler: route device admission (TpuSemaphore and the "
+         "device-service token pool) through the priority-weighted fair "
+         "admission queue (sched/) with load shedding, per-tenant "
+         "weights, deadlines and cooperative cancellation. Off keeps the "
+         "exact FIFO paths: a bare BoundedSemaphore in process, FIFO "
+         "token grants in the service, zero scheduler state.")
+register("spark.rapids.tpu.sched.priority", "int", 0,
+         "Default priority for this session's queries (higher = admitted "
+         "first under contention; strict priority across levels). "
+         "Per-query contexts and the service run_plan header override it.")
+register("spark.rapids.tpu.sched.tenant", "string", "default",
+         "Tenant id this session's queries are accounted under (fair-"
+         "share weights, memory sub-quotas).")
+register("spark.rapids.tpu.sched.deadlineMs", "int", 0,
+         "Default per-query deadline. A query running (or queued, or "
+         "sleeping in a retry backoff) past it unwinds with the typed "
+         "DeadlineExceededError; 0 = no deadline.")
+register("spark.rapids.tpu.sched.maxQueueDepth", "int", 0,
+         "Admission load shedding: a query arriving when this many are "
+         "already queued is rejected immediately with QueryRejectedError "
+         "(it never touches the device); 0 = unbounded queue.")
+register("spark.rapids.tpu.sched.maxQueueWaitMs", "int", 0,
+         "Admission load shedding: a query queued longer than this is "
+         "rejected in place with QueryRejectedError; 0 = unbounded wait.")
+register("spark.rapids.tpu.sched.tenant.weights", "string", "",
+         "Per-tenant fair-share weights as 'tenantA=4,tenantB=1' (unlisted "
+         "tenants weigh 1). Within a priority level, admission grants are "
+         "proportional to weight under sustained contention (stride "
+         "scheduling over a per-tenant virtual pass).")
+register("spark.rapids.tpu.sched.tenant.quotas", "string", "",
+         "Per-tenant device-memory sub-quotas as fractions of the budget, "
+         "'tenantA=0.5,tenantB=0.25'. The quota is a hard sub-limit: a "
+         "tenant reserving beyond it gets SplitAndRetryOOM immediately — "
+         "no spill, since spilling frees other tenants' buffers without "
+         "shrinking this tenant's pinned ledger — even while the global "
+         "budget has room, so one tenant's out-of-core sort splits down "
+         "to its share instead of evicting another tenant's working set. "
+         "Empty = no sub-quotas (global budget only).")
+
 # Compile service --------------------------------------------------------------------
 register("spark.rapids.tpu.compile.enabled", "bool", True,
          "Route every kernel compile through the centralized compile "

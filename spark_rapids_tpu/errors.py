@@ -103,6 +103,44 @@ class ShuffleFetchFailedError(RapidsTpuError):
         self.cause = cause
 
 
+class QueryRejectedError(RapidsTpuError):
+    """The admission layer shed this query under overload (queue depth or
+    queue wait beyond the spark.rapids.tpu.sched.* bounds, or an injected
+    sched.admit fault). Raised BEFORE admission: the query never touched
+    the device, so the client can safely retry elsewhere/later."""
+
+    def __init__(self, message: str, depth: int = -1, waited_s=None,
+                 tenant: str = "", priority: int = 0):
+        super().__init__(message)
+        self.depth = depth
+        self.waited_s = waited_s
+        self.tenant = tenant
+        self.priority = priority
+
+
+class QueryCancelledError(RapidsTpuError):
+    """The query's CancelToken was cancelled (client `cancel` op or an
+    in-process cancel()); every cooperative cancellation point
+    (sched.context.checkpoint) unwinds with this so admission tokens,
+    budget reservations, parked batches and prefetch threads are
+    reclaimed on the normal finally paths."""
+
+    def __init__(self, message: str, query_id: str = ""):
+        super().__init__(message)
+        self.query_id = query_id
+
+
+class DeadlineExceededError(RapidsTpuError, TimeoutError):
+    """The query ran (or would sleep) past its deadline. Retry/backoff
+    seams compute their next sleep as min(backoff, remaining deadline)
+    and raise this instead of sleeping past it. Also a TimeoutError so
+    generic timeout handlers keep working."""
+
+    def __init__(self, message: str, deadline_s=None):
+        super().__init__(message)
+        self.deadline_s = deadline_s
+
+
 class AdmissionTimeoutError(RapidsTpuError, TimeoutError):
     """The device-service admission semaphore did not grant a token within
     the requested timeout. Carries the server's held/waiting diagnostics

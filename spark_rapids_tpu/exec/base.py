@@ -21,6 +21,7 @@ import numpy as np
 from ..columnar.batch import ColumnarBatch, Schema
 from ..config import TpuConf, get_default_conf
 from ..expr.base import EvalContext, Vec
+from ..sched import context as _qctx
 from ..utils import metrics as M
 from ..utils import spans
 from ..utils.tracing import trace_range
@@ -61,9 +62,15 @@ class TpuExec:
                                  or self.semaphore_wait_time.live
                                  or self.peak_dev_memory.live):
             # disabled path: one global read + three attribute reads per
-            # operator per query — no span objects, no per-batch syncs
+            # operator per query — no span objects, no per-batch syncs.
+            # Each pull is a cancellation point (sched.context.checkpoint
+            # is one module-global read with no context active): a
+            # cancelled/deadline-exceeded query unwinds between batches
+            # with the typed error, through every operator's finally.
             with trace_range(self.name):
-                yield from self.do_execute()
+                for batch in self.do_execute():
+                    _qctx.checkpoint()
+                    yield batch
             return
         yield from self._instrumented_execute(prof)
 
@@ -82,6 +89,7 @@ class TpuExec:
         with trace_range(self.name), sp_cm as sp:
             it = self.do_execute()
             while True:
+                _qctx.checkpoint()  # per-pull cancellation point
                 spill0 = (tm.spill_to_host_ns + tm.spill_to_disk_ns
                           + tm.read_spill_ns)
                 sem0 = tm.semaphore_wait_ns
@@ -187,6 +195,7 @@ class PrefetchIterator:
         self._stop = threading.Event()
         self._tm = TaskMetrics.get()  # the consumer's (task's) metrics
         self._sem = TpuSemaphore.get()
+        self._ctx = _qctx.current()  # the consumer's query context
         self._tm.prefetch_threads += 1
         PREFETCH_THREADS_STARTED += 1
         self._thread = threading.Thread(
@@ -200,8 +209,10 @@ class PrefetchIterator:
         from ..utils.metrics import TaskMetrics
         TaskMetrics._tls.metrics = self._tm  # share the task's counters
         self._sem.adopt_task_hold()  # ride the task's admission permit
+        _qctx.adopt(self._ctx)  # observe the consumer's cancel token
         try:
             while not self._stop.is_set():
+                _qctx.checkpoint()  # typed cancel crosses the queue below
                 with spans.span("pipeline:prefetch",
                                 kind=spans.KIND_IO) as sp:
                     faults.fire(faults.PREFETCH)
@@ -255,6 +266,7 @@ class PrefetchIterator:
         import time
         try:
             while True:
+                _qctx.checkpoint()  # consumer-side cancellation point
                 t0 = time.monotonic_ns()
                 item = self._get()
                 self._tm.prefetch_stall_ns += time.monotonic_ns() - t0

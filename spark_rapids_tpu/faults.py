@@ -27,6 +27,11 @@ Injection points (the catalog; call sites reference these constants):
                                            prefetch thread (the typed error
                                            must cross the queue to the
                                            consumer without deadlocking)
+  sched.admit         sched/scheduler.py   admission-queue acquire (both the
+                                           in-process TpuSemaphore door and
+                                           the service _Admission); injected
+                                           failures degrade to the typed
+                                           QueryRejectedError
 
 A rule fires on the Nth eligible call (`nth`), or with seeded probability
 (`probability`), at most `times` times (0 = unlimited). Kinds:
@@ -57,7 +62,7 @@ __all__ = ["FaultRule", "FaultInjector", "fire", "inject",
            "install_from_conf", "ALL_POINTS",
            "ALLOC", "SPILL_WRITE", "SPILL_READ", "BLOCK_WRITE", "BLOCK_READ",
            "FETCH", "TCP_SEND", "TCP_RECV", "ADMISSION", "DEVICE_INIT",
-           "COMPILE", "PREFETCH"]
+           "COMPILE", "PREFETCH", "SCHED_ADMIT"]
 
 ALLOC = "memory.alloc"
 SPILL_WRITE = "spill.write"
@@ -71,10 +76,11 @@ ADMISSION = "service.admission"
 DEVICE_INIT = "device.init"
 COMPILE = "compile"
 PREFETCH = "pipeline.prefetch"
+SCHED_ADMIT = "sched.admit"
 
 ALL_POINTS = (ALLOC, SPILL_WRITE, SPILL_READ, BLOCK_WRITE, BLOCK_READ,
               FETCH, TCP_SEND, TCP_RECV, ADMISSION, DEVICE_INIT, COMPILE,
-              PREFETCH)
+              PREFETCH, SCHED_ADMIT)
 
 # named exception factories for the config-spec grammar
 _ERROR_NAMES: Dict[str, Callable[[str], Exception]] = {

@@ -33,6 +33,19 @@ class MemoryBudget:
         self.inject_retry_at = conf.get("spark.rapids.sql.test.injectRetryOOM")
         self.inject_split_at = conf.get(
             "spark.rapids.sql.test.injectSplitAndRetryOOM")
+        # per-tenant sub-quotas (spark.rapids.tpu.sched.tenant.quotas):
+        # fractions of `total`, enforced against the reservations made
+        # while that tenant's QueryContext is active. Best-effort ledger:
+        # releases from threads with no active context credit nobody, so
+        # quota pressure can only be conservative, never lost. Empty dict
+        # = no sub-quotas, zero per-reserve overhead beyond one `if`.
+        self.tenant_quotas: dict = {}
+        self.tenant_used: dict = {}
+        spec = conf.get("spark.rapids.tpu.sched.tenant.quotas") or ""
+        if spec.strip():
+            from ..sched.scheduler import parse_tenant_map
+            self.tenant_quotas = {t: int(f * total)
+                                  for t, f in parse_tenant_map(spec).items()}
 
     @classmethod
     def initialize(cls, total: int, conf: Optional[TpuConf] = None) -> None:
@@ -45,11 +58,63 @@ class MemoryBudget:
         return cls._instance
 
     # ------------------------------------------------------------------
-    def reserve(self, nbytes: int) -> None:
+    def _quota_tenant(self) -> Optional[str]:
+        """The active context's tenant when sub-quotas are configured and
+        one applies to it; else None (no per-reserve tenant work)."""
+        if not self.tenant_quotas:
+            return None
+        from ..sched import context as _qctx
+        t = _qctx.current_tenant()
+        return t if t in self.tenant_quotas else None
+
+    def _check_quota_locked(self, tenant: Optional[str],
+                            nbytes: int) -> None:
+        """Raise SplitAndRetryOOM when the charge would breach the
+        tenant's sub-quota. The quota is a HARD sub-limit: the tenant's
+        ledger only shrinks when the tenant itself releases/closes (the
+        charge is pinned park→close), so spilling — which would evict
+        OTHER tenants' globally-lowest-priority buffers without moving
+        this ledger at all — can never relieve it. Split immediately so
+        the step shrinks to fit the quota; no neighbour eviction."""
+        if tenant is not None and \
+                self.tenant_used.get(tenant, 0) + nbytes > \
+                self.tenant_quotas[tenant]:
+            raise SplitAndRetryOOM(
+                f"tenant {tenant!r} over its device sub-quota: need "
+                f"{nbytes}, tenant used "
+                f"{self.tenant_used.get(tenant, 0)}/"
+                f"{self.tenant_quotas[tenant]} "
+                "(spark.rapids.tpu.sched.tenant.quotas)")
+
+    def _try_charge_locked(self, tenant: Optional[str], nbytes: int) -> bool:
+        """Charge `nbytes` if the global budget has room (the tenant
+        quota was already enforced). Caller holds the lock."""
+        if self.used + nbytes > self.total:
+            return False
+        self.used += nbytes
+        self.peak_used = max(self.peak_used, self.used)
+        if tenant is not None:
+            self.tenant_used[tenant] = \
+                self.tenant_used.get(tenant, 0) + nbytes
+        return True
+
+    def reserve(self, nbytes: int, tenant_delta: bool = True) -> None:
         """Pre-flight reservation; raises RetryOOM / SplitAndRetryOOM under
-        pressure (after attempting synchronous spill)."""
+        pressure (after attempting synchronous spill). With tenant
+        sub-quotas configured, the active tenant's quota is a hard
+        sub-limit checked FIRST: an over-quota reservation raises
+        SplitAndRetryOOM immediately (no spill — see _check_quota_locked)
+        so the tenant's own step splits down to its share instead of
+        evicting a neighbour's working set.
+
+        `tenant_delta=False` moves the GLOBAL ledger only — the catalog's
+        tier transitions (spill frees device, unspill re-reserves) use it
+        because the buffer they move belongs to whoever PARKED it, not to
+        whatever context happens to be active on the spilling thread; the
+        owner's tenant charge is held from park to close (spillable.py)."""
         from .. import faults
         faults.fire(faults.ALLOC)
+        tenant = self._quota_tenant() if tenant_delta else None
         with self._lock:
             self._alloc_count += 1
             n = self._alloc_count
@@ -57,17 +122,15 @@ class MemoryBudget:
                 raise RetryOOM("injected RetryOOM")
             if self.inject_split_at and n == self.inject_split_at:
                 raise SplitAndRetryOOM("injected SplitAndRetryOOM")
-            if self.used + nbytes <= self.total:
-                self.used += nbytes
-                self.peak_used = max(self.peak_used, self.used)
+            self._check_quota_locked(tenant, nbytes)
+            if self._try_charge_locked(tenant, nbytes):
                 return
-        # pressure: try to spill synchronously, then re-check
+        # GLOBAL pressure: try to spill synchronously, then re-check
         from .catalog import BufferCatalog
         freed = BufferCatalog.get().synchronous_spill(nbytes)
         with self._lock:
-            if self.used + nbytes <= self.total:
-                self.used += nbytes
-                self.peak_used = max(self.peak_used, self.used)
+            self._check_quota_locked(tenant, nbytes)
+            if self._try_charge_locked(tenant, nbytes):
                 return
             if freed > 0:
                 raise RetryOOM(
@@ -105,11 +168,26 @@ class MemoryBudget:
         except Exception:
             pass
 
-    def release(self, nbytes: int) -> None:
+    def release(self, nbytes: int, tenant_delta: bool = True) -> None:
+        tenant = self._quota_tenant() if tenant_delta else None
         with self._lock:
             self.used = max(0, self.used - nbytes)
+            if tenant is not None:
+                self.tenant_used[tenant] = max(
+                    0, self.tenant_used.get(tenant, 0) - nbytes)
 
-    def note_parked(self, nbytes: int) -> None:
+    def credit_tenant(self, tenant: Optional[str], nbytes: int) -> None:
+        """Return `nbytes` to `tenant`'s sub-quota ledger only (no global
+        movement): the close() half of a park-time charge whose buffer may
+        since have spilled off-device (the global half followed the tier
+        transitions; the tenant half is pinned park→close)."""
+        if tenant is None:
+            return
+        with self._lock:
+            self.tenant_used[tenant] = max(
+                0, self.tenant_used.get(tenant, 0) - nbytes)
+
+    def note_parked(self, nbytes: int) -> Optional[str]:
         """Account a parked spillable batch's device residency (the
         SpillableColumnarBatch park path). Unlike `reserve()` this never
         raises and never counts toward fault-injection allocation
@@ -119,14 +197,29 @@ class MemoryBudget:
         for pending sort runs / join builds. The caller pairs it with
         `release()` on close while the entry is still device-resident
         (the catalog's spill/unspill transitions keep the accounting
-        balanced in between)."""
+        balanced in between).
+
+        Returns the tenant charged (None without an applicable sub-quota)
+        so the parking owner can pin it and `credit_tenant` the SAME
+        tenant at close, however many tier transitions (on whichever
+        threads) happened in between."""
+        tenant = self._quota_tenant()
         with self._lock:
             self.used += nbytes
             self.peak_used = max(self.peak_used, self.used)
+            # GLOBAL overage only drives the spill: a tenant parking past
+            # its sub-quota is surfaced at its next reserve() pre-flight
+            # (SplitAndRetryOOM, _check_quota_locked) — spilling here
+            # would evict whichever tenant's buffers are globally lowest
+            # priority without shrinking this tenant's pinned ledger
             over = self.used - self.total
+            if tenant is not None:
+                self.tenant_used[tenant] = \
+                    self.tenant_used.get(tenant, 0) + nbytes
         if over > 0:
             from .catalog import BufferCatalog
             BufferCatalog.get().synchronous_spill(over)
+        return tenant
 
     def reset_peak(self) -> None:
         with self._lock:

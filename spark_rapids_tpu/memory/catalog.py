@@ -223,7 +223,10 @@ class BufferCatalog:
                 self.host_used += e.host_bytes
             TaskMetrics.get().spill_to_host_ns += time.monotonic_ns() - t0
             from .budget import MemoryBudget
-            MemoryBudget.get().release(e.nbytes)
+            # global only: the buffer belongs to whoever parked it, not
+            # to the context active on the spilling thread (its tenant
+            # sub-quota charge is pinned park->close in spillable.py)
+            MemoryBudget.get().release(e.nbytes, tenant_delta=False)
             if self.host_used > self.host_limit:
                 try:
                     self._host_to_disk(e)
@@ -291,7 +294,9 @@ class BufferCatalog:
         import jax
         import jax.numpy as jnp
         from .budget import MemoryBudget
-        MemoryBudget.get().reserve(e.nbytes)
+        # global only (see _spill_entry): the unspilling context does not
+        # own this buffer's tenant charge, which never left the ledger
+        MemoryBudget.get().reserve(e.nbytes, tenant_delta=False)
         leaves = self._host_leaves(e)
         e.host_blobs = None
         return jax.tree_util.tree_unflatten(

@@ -115,7 +115,7 @@ class DeviceManager:
             from .budget import MemoryBudget
             MemoryBudget.initialize(budget, conf)
             from .semaphore import TpuSemaphore
-            TpuSemaphore.initialize(conf.concurrent_tpu_tasks)
+            TpuSemaphore.initialize(conf.concurrent_tpu_tasks, conf)
             cls._initialized = True
 
     @staticmethod

@@ -12,13 +12,30 @@ import time
 from collections import deque
 from typing import Callable, Deque, Iterator, List, TypeVar
 
-from ..errors import RetryOOM, SplitAndRetryOOM
+from ..errors import DeadlineExceededError, RetryOOM, SplitAndRetryOOM
+from ..sched import context as _qctx
 from ..utils.metrics import TaskMetrics
 
 A = TypeVar("A")
 R = TypeVar("R")
 
 MAX_RETRIES = 8
+
+
+def deadline_backoff(backoff_s: float) -> float:
+    """Deadline-aware backoff: sleep the full backoff only when it FITS
+    inside the remaining deadline; otherwise fail fast with the typed
+    error — sleeping a truncated slice would just burn the rest of the
+    deadline before failing anyway. Also a cancellation point (a
+    cancelled query must not sit out a backoff before noticing)."""
+    _qctx.checkpoint()
+    rem = _qctx.remaining_deadline_s()
+    if rem is not None and rem <= backoff_s:
+        raise DeadlineExceededError(
+            f"retry backoff of {backoff_s * 1e3:.1f}ms would outlive the "
+            f"query deadline ({rem * 1e3:.1f}ms remaining); failing fast",
+            deadline_s=rem)
+    return backoff_s
 
 
 def split_batch_halves(spillable):
@@ -59,7 +76,8 @@ def with_retry(value: A, fn: Callable[[A], R],
                     tm.retry_count += 1
                     if attempts > MAX_RETRIES:
                         raise
-                    backoff_s = min(0.001 * (2 ** attempts), 0.25)
+                    backoff_s = deadline_backoff(
+                        min(0.001 * (2 ** attempts), 0.25))
                     tm.retry_backoff_ms.append(backoff_s * 1000.0)
                     t0 = time.monotonic_ns()
                     time.sleep(backoff_s)

@@ -3,7 +3,12 @@ completeTask `:173`).
 
 Limits how many tasks may have live device batches simultaneously
 (spark.rapids.sql.concurrentGpuTasks). Same role as the reference; per-thread
-reentrancy so an operator chain acquires once per task."""
+reentrancy so an operator chain acquires once per task.
+
+With `spark.rapids.tpu.sched.enabled=true` the blocking acquire is delegated
+to the query scheduler (sched/scheduler.py): priority-weighted fair admission
+with load shedding, deadlines and cancellation instead of bare FIFO. Off (the
+default) keeps the exact BoundedSemaphore path — no scheduler object exists."""
 
 from __future__ import annotations
 
@@ -17,15 +22,26 @@ from ..utils.metrics import TaskMetrics
 class TpuSemaphore:
     _instance: Optional["TpuSemaphore"] = None
 
-    def __init__(self, permits: int):
+    def __init__(self, permits: int, conf=None):
         self.permits = permits
         self._sem = threading.BoundedSemaphore(permits)
         self._held = threading.local()
+        self._sched = None
+        if conf is not None and conf.get("spark.rapids.tpu.sched.enabled"):
+            from ..sched.scheduler import QueryScheduler
+            self._sched = QueryScheduler(permits, conf)
 
     @classmethod
-    def initialize(cls, permits: int) -> None:
-        if cls._instance is None or cls._instance.permits != permits:
-            cls._instance = TpuSemaphore(permits)
+    def initialize(cls, permits: int, conf=None) -> None:
+        sched_sig = None
+        if conf is not None and conf.get("spark.rapids.tpu.sched.enabled"):
+            from ..sched.scheduler import QueryScheduler
+            sched_sig = QueryScheduler.signature_for(permits, conf)
+        cur = cls._instance
+        cur_sig = cur._sched.signature() if cur is not None and \
+            cur._sched is not None else None
+        if cur is None or cur.permits != permits or cur_sig != sched_sig:
+            cls._instance = TpuSemaphore(permits, conf)
 
     @classmethod
     def get(cls) -> "TpuSemaphore":
@@ -33,15 +49,46 @@ class TpuSemaphore:
             cls.initialize(2)
         return cls._instance
 
+    @property
+    def scheduler(self):
+        """The QueryScheduler when sched mode is on, else None (tests and
+        the matrix scripts assert the off path has NO scheduler state)."""
+        return self._sched
+
     def acquire_if_necessary(self) -> None:
         if getattr(self._held, "count", 0) > 0:
             self._held.count += 1
             return
-        from ..utils import spans
-        t0 = time.monotonic_ns()
-        with spans.span("semaphore:wait", kind=spans.KIND_SEMAPHORE):
-            self._sem.acquire()
-        TaskMetrics.get().semaphore_wait_ns += time.monotonic_ns() - t0
+        if self._sched is not None:
+            # scheduler door: priority/fair-share/shedding/deadline-aware;
+            # raises typed errors BEFORE any hold is recorded. Queue wait
+            # still lands in semaphore_wait_ns (it IS admission wait) and
+            # the sched:admit span replaces the semaphore:wait span.
+            t0 = time.monotonic_ns()
+            try:
+                self._sched.admit()
+            finally:
+                TaskMetrics.get().semaphore_wait_ns += \
+                    time.monotonic_ns() - t0
+        else:
+            from ..sched import context as _qctx
+            from ..utils import spans
+            ctx = _qctx.current()
+            token = ctx.token if ctx is not None else None
+            t0 = time.monotonic_ns()
+            with spans.span("semaphore:wait", kind=spans.KIND_SEMAPHORE):
+                if token is None:
+                    self._sem.acquire()  # the untouched pre-sched path
+                else:
+                    # a query that opted into a context (deadline/cancel)
+                    # but not the full scheduler still honors its token
+                    # while parked at the FIFO door: poll in slices so
+                    # cancel()/deadline unwind typed instead of blocking
+                    # until a permit frees (threading semaphores give no
+                    # strict FIFO order to displace)
+                    while not self._sem.acquire(timeout=0.05):
+                        token.check()
+            TaskMetrics.get().semaphore_wait_ns += time.monotonic_ns() - t0
         self._held.count = 1
         self._held.borrowed = False
 
@@ -65,7 +112,10 @@ class TpuSemaphore:
         elif count == 1:
             self._held.count = 0
             if not getattr(self._held, "borrowed", False):
-                self._sem.release()
+                if self._sched is not None:
+                    self._sched.release()
+                else:
+                    self._sem.release()
             self._held.borrowed = False
 
     def complete_task(self) -> None:

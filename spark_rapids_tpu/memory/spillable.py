@@ -22,9 +22,12 @@ class SpillableColumnarBatch:
         # parking the Nth run/build spills older parked buffers to host
         # (bounded device residency; see MemoryBudget.note_parked). The
         # catalog's spill (release) / unspill (reserve) transitions keep
-        # the accounting balanced until close().
+        # the GLOBAL accounting balanced until close(); the tenant
+        # sub-quota charge is pinned here and credited back at close —
+        # tier transitions run on arbitrary threads under arbitrary
+        # contexts and must not re-attribute it.
         from .budget import MemoryBudget
-        MemoryBudget.get().note_parked(self.size_bytes)
+        self._park_tenant = MemoryBudget.get().note_parked(self.size_bytes)
 
     def get_batch(self, acquire_semaphore: bool = True) -> ColumnarBatch:
         """Materialize on device. `acquire_semaphore=False` is for the
@@ -51,14 +54,19 @@ class SpillableColumnarBatch:
         if self._handle is not None:
             from .budget import MemoryBudget
             from .catalog import StorageTier
+            budget = MemoryBudget.get()
             try:
                 tier = self._catalog.tier_of(self._handle)
             except KeyError:  # entry already gone: keep close() tolerant
                 tier = None
             if tier == StorageTier.DEVICE:
-                # device-resident: undo the park-time accounting (a spilled
-                # entry already released it; an unspilled one re-reserved)
-                MemoryBudget.get().release(self.size_bytes)
+                # device-resident: undo the park-time GLOBAL accounting (a
+                # spilled entry already released it; an unspilled one
+                # re-reserved) — tenant-free, the pinned charge below is
+                # the tenant half
+                budget.release(self.size_bytes, tenant_delta=False)
+            budget.credit_tenant(self._park_tenant, self.size_bytes)
+            self._park_tenant = None  # close() is idempotent
             self._catalog.remove(self._handle)
             self._handle = None
 

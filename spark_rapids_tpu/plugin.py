@@ -100,9 +100,30 @@ class TpuSession:
             columns, snapshot_id, as_of_timestamp_ms)
 
     # --------------------------------------------------------------- execution
-    def execute_plan(self, plan: PhysicalPlan, use_device: Optional[bool] = None):
+    def _sched_context(self):
+        """Build a QueryContext from the session conf, or None when no
+        sched key opts in — the None path is byte-for-byte the
+        pre-scheduler engine (no activation, no cancellation checks, no
+        admission release at query end)."""
+        c = self.conf
+        deadline_ms = c.get("spark.rapids.tpu.sched.deadlineMs")
+        tenant = c.get("spark.rapids.tpu.sched.tenant") or "default"
+        priority = c.get("spark.rapids.tpu.sched.priority")
+        if not (c.get("spark.rapids.tpu.sched.enabled") or deadline_ms > 0
+                or tenant != "default" or priority != 0):
+            return None
+        from .sched import QueryContext
+        return QueryContext(tenant=tenant, priority=priority,
+                            deadline_s=deadline_ms / 1000.0
+                            if deadline_ms > 0 else None)
+
+    def execute_plan(self, plan: PhysicalPlan,
+                     use_device: Optional[bool] = None, sched_ctx=None):
         """Run a CPU plan through the override rewrite and execute; returns a
-        pyarrow Table."""
+        pyarrow Table. `sched_ctx` (sched.QueryContext) carries an explicit
+        tenant/priority/deadline/cancel-token for this query (the device
+        service builds one per run_plan); otherwise the session conf's
+        spark.rapids.tpu.sched.* keys apply."""
         import pyarrow as pa
         from .cpu.hostbatch import host_batch_to_arrow
         from .exec.base import TpuExec
@@ -112,10 +133,19 @@ class TpuSession:
         from .plan import nodes as _nodes
         _nodes.set_ansi_mode(self.conf.is_ansi)
         enabled = self.conf.is_sql_enabled if use_device is None else use_device
-        if enabled and self.conf.get("spark.rapids.sql.adaptive.enabled"):
-            from .plan.adaptive import adaptive_execute
-            return adaptive_execute(self, plan, use_device=enabled)
-        return self._execute_rewritten(plan, enabled)
+
+        def run():
+            if enabled and self.conf.get("spark.rapids.sql.adaptive.enabled"):
+                from .plan.adaptive import adaptive_execute
+                return adaptive_execute(self, plan, use_device=enabled)
+            return self._execute_rewritten(plan, enabled)
+
+        ctx = sched_ctx or self._sched_context()
+        if ctx is None:
+            return run()
+        from .sched import activate
+        with activate(ctx):
+            return run()
 
     def _execute_rewritten(self, plan: PhysicalPlan,
                            use_device: Optional[bool] = None):
@@ -139,7 +169,8 @@ class TpuSession:
             result = plan
 
         if isinstance(result, TpuExec):
-            from .errors import CpuFallbackRequired
+            from .errors import (CpuFallbackRequired, DeadlineExceededError,
+                                 QueryCancelledError, QueryRejectedError)
             from .utils import spans
             from .utils.metrics import TaskMetrics
             # fresh counters per query: the explain line below must report
@@ -156,6 +187,15 @@ class TpuSession:
                 prof = spans.begin_profile(label=result.name)
                 prof.attach_plan(result)
             try:
+                from .sched import context as _qctx
+                if _qctx.current() is not None:
+                    # scheduled queries pass the admission door at query
+                    # start (the scheduler must own every path onto the
+                    # device — lazy spillable acquisition alone would let
+                    # small queries skip admission entirely); shed/
+                    # deadline/cancel raise typed BEFORE any device work.
+                    from .memory.semaphore import TpuSemaphore
+                    TpuSemaphore.get().acquire_if_necessary()
                 # pipelined execution: the plan's stream produces on a
                 # bounded prefetch thread while this thread converts
                 # results D2H — device compute overlaps the host sink.
@@ -195,7 +235,29 @@ class TpuSession:
                     tm_line = TaskMetrics.get().explain_string()
                     if tm_line:
                         print(tm_line)
+            except (QueryCancelledError, DeadlineExceededError,
+                    QueryRejectedError) as e:
+                # scheduler-typed unwinds: stamp the profile record so a
+                # killed/shed query's event log says so, then re-raise —
+                # the finally below still reclaims admission and closes
+                # the profile
+                if prof is not None:
+                    prof.status = (
+                        "cancelled" if isinstance(e, QueryCancelledError)
+                        else "deadline"
+                        if isinstance(e, DeadlineExceededError)
+                        else "rejected")
+                raise
             finally:
+                from .sched import context as _qctx
+                if _qctx.current() is not None:
+                    # scheduled queries hold admission per QUERY, not per
+                    # thread-lifetime: release every reentrant hold so the
+                    # next queued query (possibly on another thread) gets
+                    # the token. Unscheduled queries keep the historical
+                    # per-thread hold semantics untouched.
+                    from .memory.semaphore import TpuSemaphore
+                    TpuSemaphore.get().complete_task()
                 if prof is not None:
                     spans.end_profile(prof)
                     prof.finish(TaskMetrics.get())

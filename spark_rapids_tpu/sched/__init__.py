@@ -1,0 +1,41 @@
+"""Query scheduler — multi-tenant admission control, priorities, deadlines,
+and cooperative cancellation (ARCHITECTURE.md "Query scheduler").
+
+The reference's only concurrency control is a fixed-width FIFO
+(`GpuSemaphore.scala`, spark.rapids.sql.concurrentGpuTasks). Serving-scale
+engines (Theseus, arXiv:2508.05029; "Rethinking Analytical Processing in
+the GPU Era", arXiv:2508.04701) are gated by scheduling policy, not
+kernels: the engine needs to decide *which* query gets the device, for
+*how long*, and what happens to everyone else under overload. This package
+owns every path onto the device:
+
+  * `context.py` — `QueryContext`/`CancelToken`: per-query tenant,
+    priority, deadline and cooperative cancellation, threaded through the
+    exec pull loops, prefetch threads, OOM-retry backoff and shuffle fetch
+    retry via the near-free `checkpoint()` hook.
+  * `scheduler.py` — `AdmissionQueue`: the priority + weighted-fair
+    admission core shared by the in-process `TpuSemaphore` and the
+    cross-process service `_Admission`, with queue-depth/wait load
+    shedding (`QueryRejectedError`) and the `sched.admit` fault point.
+
+`spark.rapids.tpu.sched.enabled=false` (the default) keeps the exact FIFO
+paths: `TpuSemaphore` stays on its `threading.BoundedSemaphore`, no
+contexts activate, no new threads exist anywhere in this package (the
+scheduler never spawns any), and `checkpoint()` is one module-global int
+read."""
+
+from .context import (CancelToken, QueryContext, activate, adopt,
+                      checkpoint, current, current_tenant,
+                      remaining_deadline_s)
+from .scheduler import (ABANDONED, AdmissionQueue, QueryScheduler,
+                        parse_tenant_map)
+
+PRIORITY_LOW = -10
+PRIORITY_NORMAL = 0
+PRIORITY_HIGH = 10
+
+__all__ = ["CancelToken", "QueryContext", "activate", "adopt", "checkpoint",
+           "current", "current_tenant", "remaining_deadline_s",
+           "AdmissionQueue", "QueryScheduler", "ABANDONED",
+           "parse_tenant_map",
+           "PRIORITY_LOW", "PRIORITY_NORMAL", "PRIORITY_HIGH"]

@@ -1,0 +1,205 @@
+"""Per-query scheduling context: tenant, priority, deadline, cancellation.
+
+A `QueryContext` is installed for the duration of one query
+(`plugin.TpuSession` activates it; the device service builds one per
+`run_plan` from the request header). Its `CancelToken` is the single
+cooperative-cancellation channel: every blocking or long-running seam in
+the engine — exec batch pulls, prefetch producer loops, OOM-retry
+backoff, shuffle fetch retry sleeps, admission queue waits — calls
+`checkpoint()` (or checks the token directly) and unwinds with a typed
+`QueryCancelledError`/`DeadlineExceededError` when the query was
+cancelled or ran past its deadline.
+
+Disabled-path contract (mirrors faults._ACTIVE): when no context is
+active anywhere in the process, `checkpoint()` is ONE module-global int
+read — queries that never opt into scheduling pay nothing."""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Callable, List, Optional
+
+from ..errors import DeadlineExceededError, QueryCancelledError
+
+__all__ = ["CancelToken", "QueryContext", "activate", "adopt", "checkpoint",
+           "current", "current_tenant", "remaining_deadline_s"]
+
+_tls = threading.local()
+_lock = threading.Lock()
+# count of activate() scopes currently open process-wide; 0 => checkpoint()
+# and current() return immediately (one global read, no thread-local touch)
+_ACTIVE = 0
+
+
+class CancelToken:
+    """Cooperative cancellation + deadline for one query.
+
+    `cancel()` may be called from ANY thread (another connection's
+    `cancel` op, a timeout supervisor); the query's own threads observe it
+    at their next `check()`. Registered waiters (the admission queue) are
+    poked so a parked query wakes immediately instead of at its next wait
+    slice."""
+
+    __slots__ = ("deadline_s", "deadline_ns", "_cancelled", "_reason",
+                 "_mu", "_waiters")
+
+    def __init__(self, deadline_s: Optional[float] = None):
+        # the configured DURATION, kept for diagnostics (deadline_ns is an
+        # absolute monotonic instant, meaningless outside this process)
+        self.deadline_s = (float(deadline_s)
+                           if deadline_s and deadline_s > 0 else None)
+        self.deadline_ns = (time.monotonic_ns() + int(deadline_s * 1e9)
+                            if deadline_s and deadline_s > 0 else None)
+        self._cancelled = False
+        self._reason = ""
+        self._mu = threading.Lock()
+        self._waiters: List[Callable[[], None]] = []
+
+    # -- cancel side -------------------------------------------------------
+    def cancel(self, reason: str = "cancelled") -> None:
+        with self._mu:
+            if self._cancelled:
+                return
+            self._cancelled = True
+            self._reason = reason
+            waiters = list(self._waiters)
+        for wake in waiters:
+            try:
+                wake()
+            except Exception:
+                pass
+
+    def add_waiter(self, wake: Callable[[], None]) -> None:
+        with self._mu:
+            self._waiters.append(wake)
+
+    def remove_waiter(self, wake: Callable[[], None]) -> None:
+        with self._mu:
+            if wake in self._waiters:
+                self._waiters.remove(wake)
+
+    # -- observe side ------------------------------------------------------
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+    @property
+    def reason(self) -> str:
+        return self._reason
+
+    @property
+    def expired(self) -> bool:
+        return (self.deadline_ns is not None
+                and time.monotonic_ns() >= self.deadline_ns)
+
+    def remaining_s(self) -> Optional[float]:
+        """Seconds until the deadline (None = no deadline; 0.0 = expired)."""
+        if self.deadline_ns is None:
+            return None
+        return max((self.deadline_ns - time.monotonic_ns()) / 1e9, 0.0)
+
+    @property
+    def status(self) -> str:
+        """'ok' | 'cancelled' | 'deadline' — the profile-record status."""
+        if self._cancelled:
+            return "cancelled"
+        if self.expired:
+            return "deadline"
+        return "ok"
+
+    def check(self) -> None:
+        """Raise the typed error if cancelled or past the deadline."""
+        if self._cancelled:
+            raise QueryCancelledError(
+                f"query cancelled: {self._reason}")
+        if self.expired:
+            raise DeadlineExceededError(
+                f"query deadline of {self.deadline_s}s exceeded",
+                deadline_s=self.deadline_s)
+
+
+class QueryContext:
+    """One query's scheduling identity: tenant, priority, deadline, token."""
+
+    _qid_counter = itertools.count(1)
+
+    def __init__(self, tenant: str = "default", priority: int = 0,
+                 deadline_s: Optional[float] = None,
+                 token: Optional[CancelToken] = None,
+                 query_id: Optional[str] = None):
+        self.tenant = tenant or "default"
+        self.priority = int(priority)
+        self.token = token or CancelToken(deadline_s)
+        self.query_id = query_id or f"q{next(QueryContext._qid_counter)}"
+
+
+def current() -> Optional[QueryContext]:
+    if not _ACTIVE:
+        return None
+    return getattr(_tls, "ctx", None)
+
+
+def current_tenant() -> Optional[str]:
+    """Tenant of the active context, None when no context is active (the
+    budget's tenant ledger stays untouched for unscheduled work)."""
+    ctx = current()
+    return ctx.tenant if ctx is not None else None
+
+
+def checkpoint() -> None:
+    """The engine-wide cancellation point: raises the active context's
+    typed error, or returns immediately (one global read) when no context
+    is active."""
+    if not _ACTIVE:
+        return
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is not None:
+        ctx.token.check()
+
+
+def remaining_deadline_s() -> Optional[float]:
+    """Remaining seconds of the active context's deadline; None when no
+    context or no deadline. Backoff sleeps clamp to this (a retrying fetch
+    must not outlive its query's deadline)."""
+    ctx = current()
+    if ctx is None:
+        return None
+    return ctx.token.remaining_s()
+
+
+class activate:
+    """Install `ctx` as this thread's query context for a scope.
+
+    Re-entrant across nested execute_plan calls (adaptive stages): the
+    previous context is restored on exit."""
+
+    def __init__(self, ctx: QueryContext):
+        self._ctx = ctx
+        self._prev: Optional[QueryContext] = None
+
+    def __enter__(self) -> QueryContext:
+        global _ACTIVE
+        self._prev = getattr(_tls, "ctx", None)
+        _tls.ctx = self._ctx
+        with _lock:
+            _ACTIVE += 1
+        return self._ctx
+
+    def __exit__(self, *exc) -> bool:
+        global _ACTIVE
+        _tls.ctx = self._prev
+        with _lock:
+            _ACTIVE -= 1
+        return False
+
+
+def adopt(ctx: Optional[QueryContext]) -> None:
+    """Attach an existing context to the CURRENT thread without opening a
+    new activation scope — the prefetch-producer pattern (the owning
+    consumer thread holds the activation; the producer merely observes the
+    same token, exactly like it adopts the task's TaskMetrics and
+    semaphore hold). No-op for None."""
+    if ctx is not None:
+        _tls.ctx = ctx

@@ -1,0 +1,397 @@
+"""Priority-weighted fair admission queue + the process query scheduler.
+
+`AdmissionQueue` is the one admission policy engine for both device
+doors: `memory/semaphore.py TpuSemaphore` (in-process task admission,
+the GpuSemaphore analog) and `service/server.py _Admission` (the
+cross-process token pool). Policy:
+
+  * **Priority first** — a waiter with higher `priority` is always
+    granted before any lower-priority waiter, regardless of arrival
+    order (strict priority; the stress suite asserts no inversion).
+  * **Weighted fair within a priority** — stride scheduling over
+    tenants: each grant advances the tenant's virtual pass by
+    `STRIDE / weight`, and the waiter whose tenant has the LOWEST pass
+    wins, so a tenant with weight 4 is admitted ~4x as often as a
+    weight-1 tenant under sustained contention. A tenant joining (or
+    rejoining) starts at the current queue-minimum pass, never at 0 —
+    an idle tenant cannot bank credit.
+  * **FIFO as the degenerate case** — equal priorities and weights
+    reduce selection to arrival order, which is how the queue serves
+    the scheduler-disabled service path byte-for-byte.
+  * **Load shedding** — depth beyond `max_depth` rejects at enqueue;
+    waiting past `max_wait_s` rejects in place; both raise the typed
+    `QueryRejectedError` and the query never touches the device.
+  * **Deadlines + cancellation** — a waiter parked past its token's
+    deadline (or cancelled from another thread) unwinds with the typed
+    error; `cancel()` pokes the condition so the wake is immediate.
+  * **Abandonment** — an optional `alive` callback (the service's
+    socket-EOF probe) is polled per wait slice so a DEAD client's
+    queued waiter is REMOVED instead of being granted a token nobody
+    will return (the release-on-disconnect fix for queued waiters).
+
+The `sched.admit` fault point fires on every acquire; an injected
+failure degrades to the typed `QueryRejectedError` — admission faults
+must shed, never crash the server loop or grant untracked tokens."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from .. import faults
+from ..errors import (DeadlineExceededError, QueryCancelledError,
+                      QueryRejectedError)
+from . import context as _ctx
+
+__all__ = ["AdmissionQueue", "QueryScheduler", "ABANDONED",
+           "parse_tenant_map"]
+
+# returned by acquire() when the `alive` probe said the caller is gone
+ABANDONED = object()
+
+_STRIDE = 1 << 20
+
+
+def parse_tenant_map(spec: str) -> Dict[str, float]:
+    """Parse `tenantA=4,tenantB=1` specs (weights and quota fractions share
+    the grammar)."""
+    out: Dict[str, float] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        k, sep, v = part.partition("=")
+        if not sep or not k.strip():
+            raise ValueError(f"bad tenant map entry {part!r} (want k=v)")
+        out[k.strip()] = float(v)
+    return out
+
+
+class _Waiter:
+    __slots__ = ("seq", "priority", "tenant", "granted", "order")
+
+    def __init__(self, seq: int, priority: int, tenant: str):
+        self.seq = seq
+        self.priority = priority
+        self.tenant = tenant
+        self.granted = False
+        self.order = 0
+
+
+class AdmissionQueue:
+    """Admission token pool with the policy above. Thread-safe; spawns no
+    threads of its own (waiters park on one condition variable)."""
+
+    # wait slice while an `alive` liveness probe must be polled (the probe
+    # has no callback channel, unlike cancel tokens which wake the cv
+    # directly). Coarse on purpose: every parked waiter wakes and issues
+    # one MSG_PEEK syscall per slice under the queue lock, so the slice
+    # trades dead-client detection latency against lock churn at depth.
+    # Plain waits (no probe) block for the full computed timeout.
+    ALIVE_POLL_S = 0.25
+
+    def __init__(self, tokens: int,
+                 weights: Optional[Dict[str, float]] = None,
+                 max_depth: int = 0, max_wait_s: float = 0.0):
+        self.tokens = tokens
+        self.weights = dict(weights or {})
+        self.max_depth = max_depth
+        self.max_wait_s = max_wait_s
+        self.cv = threading.Condition()
+        self.holders = 0
+        self.order = 0            # global admission sequence (diagnostics)
+        self._seq = 0
+        self._waiters: List[_Waiter] = []
+        self._tenant_pass: Dict[str, float] = {}
+        # observability: deepest queue ever seen + lifetime shed count
+        self.peak_depth = 0
+        self.shed_count = 0
+
+    # ------------------------------------------------------------------
+    def _depth_locked(self) -> int:
+        """Waiters actually QUEUED: granted ones still in the list are
+        merely between their grant and their thread waking to depart —
+        counting them would shed arrivals below the configured depth and
+        inflate every depth diagnostic."""
+        return sum(1 for w in self._waiters if not w.granted)
+
+    def depth(self) -> int:
+        with self.cv:
+            return self._depth_locked()
+
+    def _weight(self, tenant: str) -> float:
+        w = self.weights.get(tenant, 1.0)
+        return w if w > 0 else 1.0
+
+    def _select_locked(self) -> Optional[_Waiter]:
+        """Best ungranted waiter: max priority, then min tenant pass, then
+        arrival order."""
+        best: Optional[_Waiter] = None
+        for w in self._waiters:
+            if w.granted:
+                continue
+            if best is None:
+                best = w
+                continue
+            if w.priority != best.priority:
+                if w.priority > best.priority:
+                    best = w
+                continue
+            wp = self._tenant_pass.get(w.tenant, 0.0)
+            bp = self._tenant_pass.get(best.tenant, 0.0)
+            if wp != bp:
+                if wp < bp:
+                    best = w
+                continue
+            if w.seq < best.seq:
+                best = w
+        return best
+
+    def _grant_locked(self) -> None:
+        granted_any = False
+        while self.holders < self.tokens:
+            w = self._select_locked()
+            if w is None:
+                break
+            w.granted = True
+            self.holders += 1
+            self.order += 1
+            w.order = self.order
+            self._tenant_pass[w.tenant] = (
+                self._tenant_pass.get(w.tenant, 0.0)
+                + _STRIDE / self._weight(w.tenant))
+            granted_any = True
+        if granted_any:
+            self.cv.notify_all()
+
+    def _remove_locked(self, w: _Waiter) -> None:
+        if w in self._waiters:
+            self._waiters.remove(w)
+        if w.granted:  # granted but unconsumed: return the token
+            self.holders -= 1
+            w.granted = False
+        self._grant_locked()
+
+    # ------------------------------------------------------------------
+    def acquire(self, priority: int = 0, tenant: str = "default",
+                timeout: Optional[float] = None,
+                token=None,
+                alive: Optional[Callable[[], bool]] = None,
+                apply_shed: bool = True):
+        """Block until admitted.
+
+        Returns the global admission order on grant, None on a plain
+        `timeout` expiry (the service maps that to its admission-timeout
+        reply), or ABANDONED when `alive` reported the caller gone.
+        Raises QueryRejectedError (shed / injected fault),
+        QueryCancelledError, or DeadlineExceededError (typed, query never
+        admitted).
+
+        `apply_shed=False` exempts this waiter from the depth/wait
+        load-shedding limits: a context-less LAZY acquire (the historical
+        mid-query path preserved in sched mode) must never be shed —
+        QueryRejectedError promises the query never touched the device,
+        and a mid-query acquire has already done scan/shuffle work."""
+        try:
+            faults.fire(faults.SCHED_ADMIT)
+        except (QueryRejectedError, QueryCancelledError,
+                DeadlineExceededError):
+            raise
+        except Exception as e:  # degrade, never crash the admission door
+            with self.cv:
+                self.shed_count += 1
+            raise QueryRejectedError(
+                f"admission degraded by injected fault: "
+                f"{type(e).__name__}: {e}",
+                tenant=tenant, priority=priority) from e
+        if token is not None:
+            token.check()
+
+        def wake() -> None:  # cancel() pokes parked waiters via this
+            with self.cv:
+                self.cv.notify_all()
+
+        with self.cv:
+            depth = self._depth_locked()
+            if apply_shed and self.max_depth and depth >= self.max_depth:
+                self.shed_count += 1
+                raise QueryRejectedError(
+                    f"admission queue full: depth {depth} >= max "
+                    f"{self.max_depth} "
+                    f"(spark.rapids.tpu.sched.maxQueueDepth)",
+                    depth=depth, tenant=tenant, priority=priority)
+            self._seq += 1
+            w = _Waiter(self._seq, priority, tenant)
+            # a (re)joining tenant starts at the current floor, so idling
+            # never banks fair-share credit. The floor is the min pass of
+            # tenants with waiters CURRENTLY queued (the runnable set) —
+            # an idle tenant's stale pass must not pin it, or that tenant
+            # (and any newcomer) would rejoin with exactly the banked
+            # credit this rule exists to deny. With nothing queued, the
+            # MAX pass ever reached is the floor: a solo arrival competes
+            # with no one, and the next contender starts level with it.
+            if self._tenant_pass:
+                queued = {ww.tenant for ww in self._waiters}
+                pool = [p for t, p in self._tenant_pass.items()
+                        if t in queued]
+                floor = min(pool) if pool else \
+                    max(self._tenant_pass.values())
+                cur = self._tenant_pass.get(w.tenant)
+                self._tenant_pass[w.tenant] = (
+                    floor if cur is None else max(cur, floor))
+            self._waiters.append(w)
+            self.peak_depth = max(self.peak_depth, self._depth_locked())
+            self._grant_locked()
+        t0 = time.monotonic()
+        try:
+            if token is not None:
+                token.add_waiter(wake)
+                if token.cancelled:
+                    # a cancel that completed BEFORE the registration
+                    # will never fire wake(); observed here, or the
+                    # un-clamped wait below could park forever
+                    token.check()
+            with self.cv:
+                while not w.granted:
+                    waited = time.monotonic() - t0
+                    limits = []
+                    if timeout is not None:
+                        limits.append(timeout - waited)
+                    if apply_shed and self.max_wait_s:
+                        limits.append(self.max_wait_s - waited)
+                    if token is not None:
+                        rem = token.remaining_s()
+                        if rem is not None:
+                            limits.append(rem)
+                    if limits and min(limits) <= 0:
+                        if timeout is not None and waited >= timeout:
+                            self._remove_locked(w)
+                            return None
+                        if token is not None and token.expired:
+                            self._remove_locked(w)
+                            raise DeadlineExceededError(
+                                f"query deadline of {token.deadline_s}s "
+                                f"expired after {waited:.3f}s in the "
+                                f"admission queue",
+                                deadline_s=token.deadline_s)
+                        self.shed_count += 1
+                        self._remove_locked(w)
+                        raise QueryRejectedError(
+                            f"admission queue wait {waited * 1e3:.0f}ms "
+                            f"exceeded max "
+                            f"{self.max_wait_s * 1e3:.0f}ms "
+                            f"(spark.rapids.tpu.sched.maxQueueWaitMs)",
+                            depth=self._depth_locked(), waited_s=waited,
+                            tenant=tenant, priority=priority)
+                    # tokens need no poll slice: cancel() wakes the cv
+                    # through the registered waiter and the deadline
+                    # remainder is already in `limits`; only the `alive`
+                    # probe (no callback channel) needs polling
+                    slice_s = min(limits) if limits else None
+                    if alive is not None:
+                        slice_s = (self.ALIVE_POLL_S if slice_s is None
+                                   else min(slice_s, self.ALIVE_POLL_S))
+                    self.cv.wait(slice_s)
+                    if token is not None and \
+                            (token.cancelled or token.expired):
+                        self._remove_locked(w)
+                        token.check()  # raises the matching typed error
+                    if alive is not None and not alive():
+                        self._remove_locked(w)
+                        return ABANDONED
+                self._waiters.remove(w)
+                return w.order
+        except BaseException:
+            with self.cv:
+                # cover exits taken outside the cv block (token.check
+                # raising after _remove_locked already ran is fine: the
+                # remove is idempotent and the grant was returned there)
+                self._remove_locked(w)
+            raise
+        finally:
+            if token is not None:
+                token.remove_waiter(wake)
+
+    def release(self, count: int = 1) -> None:
+        with self.cv:
+            self.holders = max(0, self.holders - count)
+            self._grant_locked()
+            self.cv.notify_all()
+
+
+class QueryScheduler:
+    """Process-wide scheduler the in-process `TpuSemaphore` delegates to
+    when `spark.rapids.tpu.sched.enabled=true`. Wraps one AdmissionQueue
+    with the conf-derived policy and the observability wiring (queue-wait
+    span + TaskMetrics counters)."""
+
+    def __init__(self, permits: int, conf):
+        # ONE reading of the policy keys: the signature tuple is both the
+        # rebuild-detection identity and the source every field below is
+        # unpacked from, so the two can never drift
+        self._signature = self.signature_for(permits, conf)
+        (self.permits, self.default_priority, self.default_tenant,
+         weights, max_depth, max_wait_s) = self._signature
+        self.queue = AdmissionQueue(
+            permits, weights=dict(weights),
+            max_depth=max_depth, max_wait_s=max_wait_s)
+
+    @staticmethod
+    def signature_for(permits: int, conf) -> tuple:
+        """Policy identity as a pure function of (permits, conf) —
+        TpuSemaphore.initialize compares it to decide whether to rebuild
+        without constructing a throwaway scheduler."""
+        wait_ms = conf.get("spark.rapids.tpu.sched.maxQueueWaitMs")
+        return (permits,
+                int(conf.get("spark.rapids.tpu.sched.priority")),
+                conf.get("spark.rapids.tpu.sched.tenant") or "default",
+                tuple(sorted(parse_tenant_map(
+                    conf.get("spark.rapids.tpu.sched.tenant.weights"))
+                    .items())),
+                conf.get("spark.rapids.tpu.sched.maxQueueDepth"),
+                wait_ms / 1000.0 if wait_ms else 0.0)
+
+    def signature(self) -> tuple:
+        """Policy identity — TpuSemaphore.initialize rebuilds on change."""
+        return self._signature
+
+    def admit(self) -> int:
+        """Admit the current thread's query (context-aware); returns the
+        admission order. Raises the typed shed/cancel/deadline errors."""
+        from ..utils import spans
+        from ..utils.metrics import TaskMetrics
+        ctx = _ctx.current()
+        priority = ctx.priority if ctx is not None else self.default_priority
+        tenant = ctx.tenant if ctx is not None else self.default_tenant
+        token = ctx.token if ctx is not None else None
+        tm = TaskMetrics.get()
+        depth = self.queue.depth()
+        tm.sched_queue_depth = max(tm.sched_queue_depth, depth)
+        t0 = time.monotonic_ns()
+        try:
+            with spans.span("sched:admit", kind=spans.KIND_SEMAPHORE,
+                            tenant=tenant, priority=priority,
+                            depth=depth):
+                # shedding applies to SCHEDULED queries only (admitted
+                # once, at query start); a context-less lazy acquire is
+                # mid-query and must wait, not shed (see acquire())
+                order = self.queue.acquire(priority=priority, tenant=tenant,
+                                           token=token,
+                                           apply_shed=ctx is not None)
+        except QueryRejectedError:
+            tm.sched_rejected += 1
+            raise
+        except QueryCancelledError:
+            tm.sched_cancelled += 1
+            raise
+        except DeadlineExceededError:
+            tm.sched_deadline_exceeded += 1
+            raise
+        finally:
+            tm.sched_queue_wait_ns += time.monotonic_ns() - t0
+        tm.sched_admissions += 1
+        return order
+
+    def release(self) -> None:
+        self.queue.release()

@@ -12,7 +12,9 @@ import socket
 import time
 from typing import Dict, Optional, Sequence
 
-from ..errors import AdmissionTimeoutError, DeviceStartupError
+from ..errors import (AdmissionTimeoutError, DeadlineExceededError,
+                      DeviceStartupError, QueryCancelledError,
+                      QueryRejectedError)
 from .protocol import ipc_to_table, recv_msg, send_msg
 
 __all__ = ["TpuServiceClient"]
@@ -75,12 +77,38 @@ class TpuServiceClient:
                 f"within {self.deadline_s}s (wedged service)")
 
     # ------------------------------------------------------------------
-    def acquire(self, timeout: Optional[float] = None) -> int:
+    @staticmethod
+    def _raise_typed(rep: dict) -> None:
+        """Map a typed error reply onto its exception (errors.py)."""
+        et = rep.get("error_type")
+        msg = rep.get("error", "service error")
+        if et == "rejected":
+            raise QueryRejectedError(msg, depth=rep.get("depth", -1))
+        if et == "cancelled":
+            raise QueryCancelledError(msg,
+                                      query_id=rep.get("query_id") or "")
+        if et == "deadline":
+            raise DeadlineExceededError(msg)
+
+    def acquire(self, timeout: Optional[float] = None,
+                priority: int = 0, tenant: Optional[str] = None,
+                deadline_s: Optional[float] = None) -> int:
         """Block until admitted; returns the global admission order. A
         server-side admission timeout raises AdmissionTimeoutError with the
-        held/waiting contention diagnostics from the reply."""
-        rep, _ = self._request({"op": "acquire", "timeout": timeout})
+        held/waiting contention diagnostics from the reply; a scheduler
+        shed/deadline reply raises the matching typed error. priority/
+        tenant/deadline_s take effect only on a scheduler-enabled server
+        (FIFO servers ignore them)."""
+        hdr = {"op": "acquire", "timeout": timeout}
+        if priority:
+            hdr["priority"] = priority
+        if tenant:
+            hdr["tenant"] = tenant
+        if deadline_s:
+            hdr["deadline_s"] = deadline_s
+        rep, _ = self._request(hdr)
         if not rep.get("ok"):
+            self._raise_typed(rep)
             if rep.get("error_type") == "admission_timeout":
                 raise AdmissionTimeoutError(
                     f"device admission not granted within {timeout}s "
@@ -95,14 +123,46 @@ class TpuServiceClient:
         self._request({"op": "release"})
 
     def run_plan(self, plan_json, paths: Optional[Dict[str, Sequence[str]]]
-                 = None, use_device: bool = True):
-        """Submit a Spark executedPlan.toJSON; returns a pyarrow Table."""
-        rep, body = self._request({"op": "run_plan", "plan": plan_json,
-                                   "paths": paths or {},
-                                   "use_device": use_device})
+                 = None, use_device: bool = True,
+                 query_id: Optional[str] = None, priority: int = 0,
+                 tenant: Optional[str] = None,
+                 deadline_s: Optional[float] = None):
+        """Submit a Spark executedPlan.toJSON; returns a pyarrow Table.
+        `query_id` registers the run for the `cancel` op (issued from a
+        DIFFERENT connection); priority/tenant/deadline_s attach the
+        scheduling context the engine enforces (typed errors on
+        cancel/deadline/shed)."""
+        hdr = {"op": "run_plan", "plan": plan_json, "paths": paths or {},
+               "use_device": use_device}
+        if query_id:
+            hdr["query_id"] = query_id
+        if priority:
+            hdr["priority"] = priority
+        if tenant:
+            hdr["tenant"] = tenant
+        if deadline_s:
+            hdr["deadline_s"] = deadline_s
+        rep, body = self._request(hdr)
         if not rep.get("ok"):
+            self._raise_typed(rep)
             raise RuntimeError(rep.get("unsupported") or rep.get("error"))
         return ipc_to_table(body)
+
+    def cancel(self, query_id: str, priority: Optional[int] = None,
+               reason: str = "") -> dict:
+        """Kill (default) or — with `priority` — deprioritize an in-flight
+        run_plan submitted with that query_id on another connection.
+        Returns the server's ack dict; raises on unknown query ids."""
+        hdr: dict = {"op": "cancel", "query_id": query_id}
+        if priority is not None:
+            hdr["priority"] = priority
+            hdr["kill"] = False
+        if reason:
+            hdr["reason"] = reason
+        rep, _ = self._request(hdr)
+        if not rep.get("ok"):
+            raise KeyError(rep.get("error", f"cancel {query_id!r} failed"))
+        return rep
 
     def shutdown(self) -> None:
         self._request({"op": "shutdown"})

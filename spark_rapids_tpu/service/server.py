@@ -5,17 +5,31 @@ unix-domain socket:
 
   ping      -> liveness + device identity (clients' fail-fast probe)
   acquire   -> blocks until a cross-process admission token is granted
-               (FIFO; `spark.rapids.sql.concurrentGpuTasks` tokens — the
+               (`spark.rapids.sql.concurrentGpuTasks` tokens — the
                GpuSemaphore analog across process boundaries,
                `GpuSemaphore.scala:67,125`); reply carries the global
-               admission sequence number so tests can assert ordering
+               admission sequence number so tests can assert ordering.
+               FIFO by default; with spark.rapids.tpu.sched.enabled the
+               header's priority/tenant/deadline_s drive the priority-
+               weighted fair queue (sched/scheduler.py) with load
+               shedding. Queued waiters whose client dies are REMOVED
+               (socket-EOF probe per wait slice) — a dead client must
+               not be granted a token nobody will return.
   release   -> returns the token (also implicit on disconnect, so a dead
                worker can never leak admission capacity)
   run_plan  -> Spark executedPlan.toJSON + path overrides, executed through
                translate_spark_plan -> Overrides -> engine; result returns
-               as an Arrow IPC stream body. This op is the LIVE transport
-               seam: any external Spark can ship its executed plan here
-               with no code changes on this side.
+               as an Arrow IPC stream body. Optional header fields
+               query_id/priority/tenant/deadline_s attach a scheduling
+               context; a cancelled/expired query replies with the typed
+               error_type instead of a result. This op is the LIVE
+               transport seam: any external Spark can ship its executed
+               plan here with no code changes on this side.
+  cancel    -> kill or deprioritize an in-flight (or queued) run_plan by
+               query_id from ANOTHER connection: `kill` (default) cancels
+               its CancelToken — the engine unwinds at the next
+               cooperative cancellation point; `priority` reassigns the
+               context's priority for its future admissions.
   shutdown  -> stop serving (tests; production uses process supervision)
 """
 
@@ -28,47 +42,69 @@ import socket
 import threading
 from typing import Dict, Optional
 
-from .protocol import ipc_to_table, recv_msg, send_msg, table_to_ipc
+from ..errors import (DeadlineExceededError, QueryCancelledError,
+                      QueryRejectedError)
+from ..sched import (ABANDONED, AdmissionQueue, QueryContext,
+                     parse_tenant_map)
+from .protocol import recv_msg, send_msg, table_to_ipc
 
 __all__ = ["TpuDeviceService"]
 
 
 class _Admission:
-    """FIFO cross-process admission semaphore state (server side)."""
+    """Cross-process admission semaphore state (server side), backed by the
+    shared sched.AdmissionQueue. With the scheduler disabled every request
+    enters at equal priority/weight, which the queue serves in strict
+    arrival order — the original FIFO contract, byte-for-byte."""
 
-    def __init__(self, tokens: int):
-        self.tokens = tokens
-        self.cv = threading.Condition()
-        self.queue = []          # ticket ids, FIFO
-        self.holders = set()     # ticket ids currently admitted
-        self.order = 0           # global admission sequence
-        self.next_ticket = 0
+    def __init__(self, tokens: int, conf=None):
+        sched_on = bool(conf is not None and
+                        conf.get("spark.rapids.tpu.sched.enabled"))
+        weights = parse_tenant_map(
+            conf.get("spark.rapids.tpu.sched.tenant.weights")) \
+            if sched_on else None
+        wait_ms = conf.get("spark.rapids.tpu.sched.maxQueueWaitMs") \
+            if sched_on else 0
+        self.sched_enabled = sched_on
+        self.queue = AdmissionQueue(
+            tokens,
+            weights=weights,
+            max_depth=(conf.get("spark.rapids.tpu.sched.maxQueueDepth")
+                       if sched_on else 0),
+            max_wait_s=wait_ms / 1000.0 if wait_ms else 0.0)
 
-    def acquire(self, timeout: Optional[float] = None) -> Optional[int]:
-        """Block until admitted; returns the admission sequence number."""
-        with self.cv:
-            me = self.next_ticket
-            self.next_ticket += 1
-            self.queue.append(me)
-            ok = self.cv.wait_for(
-                lambda: self.queue[0] == me and
-                len(self.holders) < self.tokens, timeout)
-            if not ok:
-                self.queue.remove(me)
-                self.cv.notify_all()  # unblock whoever is now at the head
-                return None
-            self.queue.pop(0)
-            self.holders.add(me)
-            self.order += 1
-            self.cv.notify_all()
-            return self.order
+    def acquire(self, timeout: Optional[float] = None, priority: int = 0,
+                tenant: str = "default", token=None, alive=None):
+        """Block until admitted; returns the admission sequence number,
+        None on timeout, ABANDONED when the client died while queued.
+        Scheduler-off forces FIFO inputs so policy cannot leak in."""
+        if not self.sched_enabled:
+            priority, tenant, token = 0, "default", None
+        return self.queue.acquire(priority=priority, tenant=tenant,
+                                  timeout=timeout, token=token, alive=alive)
 
     def release_one(self, count: int = 1) -> None:
-        with self.cv:
-            for _ in range(count):
-                if self.holders:
-                    self.holders.pop()
-            self.cv.notify_all()
+        self.queue.release(count)
+
+    def snapshot(self):
+        """(held, waiting) contention diagnostics for error replies."""
+        with self.queue.cv:
+            return self.queue.holders, self.queue._depth_locked()
+
+
+def _conn_alive(conn: socket.socket) -> bool:
+    """Non-consuming liveness probe: a queued waiter polls this per wait
+    slice so a client that died while PARKED in the admission queue is
+    removed instead of eventually being granted a token to a closed
+    socket. MSG_PEEK never consumes — a pipelined next request (data
+    present) still reads normally afterwards."""
+    try:
+        data = conn.recv(1, socket.MSG_PEEK | socket.MSG_DONTWAIT)
+    except (BlockingIOError, InterruptedError):
+        return True  # alive, nothing buffered
+    except OSError:
+        return False
+    return len(data) > 0  # b'' = orderly shutdown
 
 
 class TpuDeviceService:
@@ -80,16 +116,28 @@ class TpuDeviceService:
         base.update(conf or {})
         self.session = TpuSession(base)
         self.socket_path = socket_path
-        self.admission = _Admission(self.session.conf.concurrent_tpu_tasks)
+        self.admission = _Admission(self.session.conf.concurrent_tpu_tasks,
+                                    self.session.conf)
         self._stop = threading.Event()
         self._exec_lock = threading.Lock()
         self._listener: Optional[socket.socket] = None
+        # in-flight/queued run_plan contexts by query_id (cancel op target)
+        self._queries: Dict[str, QueryContext] = {}
+        self._queries_mu = threading.Lock()
 
     # ------------------------------------------------------------------
     def serve_forever(self) -> None:
         if os.path.exists(self.socket_path):
             os.unlink(self.socket_path)
         self.session.initialize_device()
+        # arm the in-process admission door with THIS service's conf:
+        # DeviceManager.initialize is once-per-process, so a process that
+        # already initialized a device through another session would
+        # otherwise leave a sched-enabled service silently admitting
+        # run_plans through a stale FIFO semaphore
+        from ..memory.semaphore import TpuSemaphore
+        TpuSemaphore.initialize(self.session.conf.concurrent_tpu_tasks,
+                                self.session.conf)
         srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         srv.bind(self.socket_path)
         srv.listen(64)
@@ -121,31 +169,13 @@ class TpuDeviceService:
                     send_msg(conn, {"ok": True,
                                     "device": self._device_name()})
                 elif op == "acquire":
-                    try:
-                        from .. import faults
-                        faults.fire(faults.ADMISSION)
-                    except Exception:  # injected admission fault => timeout
-                        seq = None
-                    else:
-                        # real acquire errors must NOT masquerade as
-                        # contention — they propagate to the connection
-                        # handler like any other server bug
-                        seq = self.admission.acquire(
-                            timeout=header.get("timeout"))
-                    if seq is None:
-                        # typed protocol error (errors.py conventions): the
-                        # client raises AdmissionTimeoutError carrying the
-                        # contention diagnostics captured here
-                        with self.admission.cv:
-                            n_held = len(self.admission.holders)
-                            n_wait = len(self.admission.queue)
-                        send_msg(conn, {
-                            "ok": False,
-                            "error": "admission timeout",
-                            "error_type": "admission_timeout",
-                            "held": n_held, "waiting": n_wait,
-                            "timeout_s": header.get("timeout")})
-                    else:
+                    seq = self._handle_acquire(conn, header)
+                    if seq is ABANDONED:
+                        return  # client died while queued
+                    if seq is not None:
+                        # count the hold BEFORE the reply: a send that
+                        # fails to a just-dead client must still release
+                        # this token in the finally below
                         held += 1
                         send_msg(conn, {"ok": True, "order": seq})
                 elif op == "release":
@@ -155,6 +185,8 @@ class TpuDeviceService:
                     send_msg(conn, {"ok": True})
                 elif op == "run_plan":
                     self._run_plan(conn, header)
+                elif op == "cancel":
+                    self._handle_cancel(conn, header)
                 elif op == "shutdown":
                     send_msg(conn, {"ok": True})
                     self._stop.set()
@@ -168,6 +200,95 @@ class TpuDeviceService:
                 self.admission.release_one(held)
             conn.close()
 
+    def _handle_acquire(self, conn: socket.socket, header: dict):
+        """One acquire op. Returns the admission order on grant (caller
+        records the hold, then replies), ABANDONED when the client died
+        while queued (caller unwinds), or None after a non-grant reply
+        (timeout/shed/deadline) was already sent."""
+        from .. import faults
+        token = None
+        deadline_s = header.get("deadline_s")
+        if deadline_s:
+            from ..sched import CancelToken
+            token = CancelToken(deadline_s)
+        try:
+            try:
+                faults.fire(faults.ADMISSION)
+            except Exception:  # injected admission fault => timeout
+                seq = None
+            else:
+                # real acquire errors must NOT masquerade as contention —
+                # they propagate to the connection handler like any other
+                # server bug (the typed shed/deadline errors are caught
+                # below and become typed protocol replies)
+                seq = self.admission.acquire(
+                    timeout=header.get("timeout"),
+                    priority=int(header.get("priority") or 0),
+                    tenant=header.get("tenant") or "default",
+                    token=token,
+                    alive=lambda: _conn_alive(conn))
+        except QueryRejectedError as e:
+            held, waiting = self.admission.snapshot()
+            send_msg(conn, {"ok": False, "error": str(e),
+                            "error_type": "rejected",
+                            "depth": e.depth, "held": held,
+                            "waiting": waiting})
+            return None
+        except DeadlineExceededError as e:
+            send_msg(conn, {"ok": False, "error": str(e),
+                            "error_type": "deadline"})
+            return None
+        if seq is ABANDONED:
+            return ABANDONED
+        if seq is None:
+            # typed protocol error (errors.py conventions): the client
+            # raises AdmissionTimeoutError carrying the contention
+            # diagnostics captured here
+            held, waiting = self.admission.snapshot()
+            send_msg(conn, {
+                "ok": False,
+                "error": "admission timeout",
+                "error_type": "admission_timeout",
+                "held": held, "waiting": waiting,
+                "timeout_s": header.get("timeout")})
+            return None
+        return seq
+
+    def _handle_cancel(self, conn: socket.socket, header: dict) -> None:
+        qid = header.get("query_id")
+        with self._queries_mu:
+            ctx = self._queries.get(qid)
+        if ctx is None:
+            send_msg(conn, {"ok": False,
+                            "error": f"unknown query_id {qid!r}",
+                            "error_type": "unknown_query"})
+            return
+        new_priority = header.get("priority")
+        if new_priority is not None:
+            ctx.priority = int(new_priority)  # deprioritize, keep running
+        if header.get("kill", new_priority is None):
+            ctx.token.cancel(header.get("reason")
+                             or f"cancel op for {qid}")
+        send_msg(conn, {"ok": True, "query_id": qid,
+                        "killed": bool(header.get(
+                            "kill", new_priority is None)),
+                        "priority": ctx.priority})
+
+    def _concurrent_ok(self) -> bool:
+        """Scheduled run_plans may execute concurrently only when the
+        server conf runs the scheduler (the admission door that orders
+        them) AND the per-query observability that assumes serial
+        execution is off: the query profiler (QueryProfile's active slot
+        is process-wide, utils/spans.py) and DEBUG metrics (the
+        peakDevMemory watermark is a per-query reset of the process
+        MemoryBudget — overlapping queries would erase/inflate each
+        other's peaks)."""
+        conf = self.session.conf
+        return self.admission.sched_enabled and not (
+            conf.get("spark.rapids.tpu.metrics.eventLog.dir")
+            or conf.get("spark.rapids.tpu.metrics.profile.enabled")
+            or conf.get("spark.rapids.sql.metrics.level") == "DEBUG")
+
     # ------------------------------------------------------------------
     def _device_name(self) -> str:
         try:
@@ -179,20 +300,74 @@ class TpuDeviceService:
     def _run_plan(self, conn: socket.socket, header: dict) -> None:
         from ..integration.spark_plan import (UnsupportedSparkPlan,
                                               translate_spark_plan)
+        ctx = None
+        qid = header.get("query_id")
+        if qid or header.get("priority") or header.get("tenant") \
+                or header.get("deadline_s"):
+            ctx = QueryContext(
+                tenant=header.get("tenant") or "default",
+                priority=int(header.get("priority") or 0),
+                deadline_s=header.get("deadline_s"),
+                query_id=qid)
+            if qid:
+                with self._queries_mu:
+                    self._queries[qid] = ctx
         try:
             plan = translate_spark_plan(header["plan"], self.session.conf,
                                         header.get("paths") or {})
             use_device = bool(header.get("use_device", True))
-            with self._exec_lock:
+            if ctx is not None:
+                ctx.token.check()  # cancelled while translating?
+            if ctx is not None and self._concurrent_ok():
+                # a SCHEDULER-ENABLED server does not serialize scheduled
+                # run_plans on _exec_lock: a plain lock is scheduler-blind
+                # (arbitrary wakeup order would bury a high-priority query
+                # behind queued low-priority ones and park cancels/
+                # deadlines until the lock was won). The engine admits the
+                # query at its start through the scheduler door (priority/
+                # fair-share/shed, cancel-aware waits) and releases at
+                # query end, so concurrency stays bounded by
+                # concurrentGpuTasks.
                 table = self.session.execute_plan(plan,
-                                                  use_device=use_device)
+                                                  use_device=use_device,
+                                                  sched_ctx=ctx)
+            else:
+                # scheduler-off servers keep the historical one-at-a-time
+                # execution even for context-carrying requests ('FIFO
+                # servers ignore them' — the scheduling fields only add
+                # cancelability/deadlines, observed before the lock and
+                # at every engine checkpoint once running). Ditto when
+                # the profiler is active: its per-query state is a
+                # process-wide single slot, so overlapping queries would
+                # cross-attribute spans.
+                with self._exec_lock:
+                    table = self.session.execute_plan(
+                        plan, use_device=use_device, sched_ctx=ctx)
             send_msg(conn, {"ok": True, "num_rows": table.num_rows},
                      table_to_ipc(table))
         except UnsupportedSparkPlan as e:
             send_msg(conn, {"ok": False, "unsupported": str(e)})
+        except QueryCancelledError as e:
+            send_msg(conn, {"ok": False, "error": str(e),
+                            "error_type": "cancelled", "query_id": qid})
+        except DeadlineExceededError as e:
+            send_msg(conn, {"ok": False, "error": str(e),
+                            "error_type": "deadline", "query_id": qid})
+        except QueryRejectedError as e:
+            send_msg(conn, {"ok": False, "error": str(e),
+                            "error_type": "rejected", "query_id": qid})
         except Exception as e:
             send_msg(conn, {"ok": False,
                             "error": f"{type(e).__name__}: {e}"})
+        finally:
+            if qid:
+                with self._queries_mu:
+                    # only unregister OUR context: a resubmitted run_plan
+                    # reusing the query_id overwrote the entry, and the
+                    # first finisher must not strip the survivor's cancel
+                    # handle
+                    if self._queries.get(qid) is ctx:
+                        del self._queries[qid]
 
 
 def main(argv=None) -> int:

@@ -601,7 +601,15 @@ class TpuShuffleManager:
                 last_exc = e
                 if attempt < self.fetch_max_retries:
                     TaskMetrics.get().shuffle_retry_count += 1
-                    time.sleep(min(base_s * (2 ** attempt), 1.0))
+                    # deadline-aware: a retrying fetch must not outlive
+                    # its query's deadline — the backoff sleeps only
+                    # when it fits in the remaining deadline and fails
+                    # fast (typed DeadlineExceededError /
+                    # QueryCancelledError) otherwise; no sched context =
+                    # plain backoff
+                    from ..memory.retry import deadline_backoff
+                    time.sleep(deadline_backoff(
+                        min(base_s * (2 ** attempt), 1.0)))
         # retry budget exhausted: failover. Recovery is only claimed when
         # the dead peer's block list is KNOWN and alternates cover all of
         # it — guessing would risk silently dropping rows.

@@ -34,7 +34,8 @@ from typing import Any, Dict, Iterator, List, Tuple
 
 from ..utils.spans import SCHEMA_VERSION, validate_record
 
-__all__ = ["load_records", "build_model", "render_report", "main"]
+__all__ = ["load_records", "build_model", "render_report", "sched_summary",
+           "main"]
 
 
 def _iter_files(paths: List[str]) -> Iterator[str]:
@@ -94,9 +95,10 @@ def build_model(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             continue
         queries[rec["query_id"]] = {
             "query_id": rec["query_id"], "label": rec.get("label", ""),
+            "status": rec.get("status", "ok"),
             "wall_ns": rec.get("wall_ns", 0),
             "task_metrics": rec.get("task_metrics", {}),
-            "operators": [], "phases": {},
+            "operators": [], "phases": {}, "sched_waits": [],
         }
     for rec in records:
         q = queries.get(rec.get("query_id"))
@@ -128,7 +130,65 @@ def build_model(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             d["count"] += 1
             d["dur_ns"] += rec.get("dur_ns", 0)
             d["bytes"] += int(rec.get("attrs", {}).get("bytes", 0))
+            if rec.get("name") == "sched:admit":
+                q["sched_waits"].append({
+                    "dur_ns": rec.get("dur_ns", 0),
+                    "depth": int(rec.get("attrs", {}).get("depth", 0)),
+                    "tenant": rec.get("attrs", {}).get("tenant", ""),
+                    "priority": rec.get("attrs", {}).get("priority", 0),
+                })
     return {"v": SCHEMA_VERSION, "queries": list(queries.values())}
+
+
+def _percentile(sorted_vals: List[int], p: float) -> int:
+    """Nearest-rank percentile of an ascending list (empty -> 0)."""
+    if not sorted_vals:
+        return 0
+    ix = min(int(round(p / 100.0 * (len(sorted_vals) - 1))),
+             len(sorted_vals) - 1)
+    return sorted_vals[ix]
+
+
+def sched_summary(model: Dict[str, Any]) -> Dict[str, Any]:
+    """Aggregate the scheduler signal across all queries: admission-wait
+    p50/p99, deepest queue observed, and the shed/cancel/deadline counts —
+    empty dict when no query saw the scheduler."""
+    waits: List[int] = []
+    depth_max = 0
+    rejected = cancelled = deadline = admissions = 0
+    statuses: Dict[str, int] = {}
+    for q in model["queries"]:
+        q_waits = [w["dur_ns"] for w in q.get("sched_waits", ())]
+        for w in q.get("sched_waits", ()):
+            depth_max = max(depth_max, w["depth"])
+        tm = q["task_metrics"]
+        admissions += tm.get("sched_admissions", 0)
+        rejected += tm.get("sched_rejected", 0)
+        cancelled += tm.get("sched_cancelled", 0)
+        deadline += tm.get("sched_deadline_exceeded", 0)
+        depth_max = max(depth_max, tm.get("sched_queue_depth", 0))
+        if not q_waits and tm.get("sched_queue_wait_ns", 0):
+            # THIS query logged no sched:admit spans (spans disabled):
+            # fall back to its task-metrics aggregate
+            q_waits = [tm["sched_queue_wait_ns"]]
+        waits.extend(q_waits)
+        st = q.get("status", "ok")
+        if st != "ok":
+            statuses[st] = statuses.get(st, 0) + 1
+    if not (waits or admissions or rejected or cancelled or deadline
+            or statuses):
+        return {}
+    waits.sort()
+    return {
+        "admissions": admissions,
+        "wait_p50_ms": round(_percentile(waits, 50) / 1e6, 3),
+        "wait_p99_ms": round(_percentile(waits, 99) / 1e6, 3),
+        "queue_depth_max": depth_max,
+        "rejected": rejected,
+        "cancelled": cancelled,
+        "deadline_exceeded": deadline,
+        "query_statuses": statuses,
+    }
 
 
 def _ms(ns: int) -> str:
@@ -151,8 +211,10 @@ def render_report(model: Dict[str, Any], top: int = 10) -> str:
         return "no query records found"
     lines: List[str] = []
     for q in queries:
+        status = q.get("status", "ok")
+        tag = f" status={status}" if status != "ok" else ""
         lines.append(f"=== query {q['query_id']} [{q['label']}] "
-                     f"wall={_ms(q['wall_ns'])}ms ===")
+                     f"wall={_ms(q['wall_ns'])}ms{tag} ===")
         # top operators by attributed time
         ops = sorted(q["operators"], key=lambda o: -o["time_ns"])[:top]
         if ops:
@@ -233,6 +295,22 @@ def render_report(model: Dict[str, Any], top: int = 10) -> str:
                 f"B read={tm.get('shuffle_bytes_read', 0)}B "
                 f"fetchWaitMs={tm.get('shuffle_fetch_wait_ns', 0) / 1e6:.1f}")
         lines.append("")
+    sched = sched_summary(model)
+    if sched:
+        lines.append("=== scheduler ===")
+        lines.append(
+            f"admissions={sched['admissions']} "
+            f"queueWait p50={sched['wait_p50_ms']}ms "
+            f"p99={sched['wait_p99_ms']}ms "
+            f"maxQueueDepth={sched['queue_depth_max']}")
+        lines.append(
+            f"shed={sched['rejected']} cancelled={sched['cancelled']} "
+            f"deadlineExceeded={sched['deadline_exceeded']}"
+            + ("" if not sched["query_statuses"] else
+               " statuses=" + ",".join(
+                   f"{k}:{v}" for k, v in
+                   sorted(sched["query_statuses"].items()))))
+        lines.append("")
     if len(queries) > 1:
         lines.append("=== per-query comparison ===")
         lines.append(_fmt_table(
@@ -270,6 +348,7 @@ def main(argv: List[str] = None) -> int:
         return 1
     model = build_model(records)
     if args.json:
+        model["scheduler"] = sched_summary(model)
         print(json.dumps(model, indent=2))
     else:
         print(render_report(model, top=args.top))

@@ -185,6 +185,16 @@ class TaskMetrics:
         # (e.g. require_flat_strings on a >headWidth key) silently re-ran
         # the whole stage on the host engine this many times
         self.cpu_fallback_reruns = 0
+        # query-scheduler counters (sched/): wall ns queued for admission,
+        # grants, load-shed rejections, cooperative cancellations and
+        # deadline expiries observed by this task, and the deepest
+        # admission queue it saw on arrival (overload signal)
+        self.sched_queue_wait_ns = 0
+        self.sched_admissions = 0
+        self.sched_rejected = 0
+        self.sched_cancelled = 0
+        self.sched_deadline_exceeded = 0
+        self.sched_queue_depth = 0
 
     @classmethod
     def get(cls) -> "TaskMetrics":
@@ -244,4 +254,13 @@ class TaskMetrics:
                 f"dispatchesPerScanBatch={per_batch:.2f}")
         if self.cpu_fallback_reruns:
             parts.append(f"cpuFallbackReruns={self.cpu_fallback_reruns}")
+        if self.sched_admissions or self.sched_rejected or \
+                self.sched_cancelled or self.sched_deadline_exceeded:
+            parts.append(
+                f"schedAdmissions={self.sched_admissions} "
+                f"schedQueueWaitMs={self.sched_queue_wait_ns / 1e6:.1f} "
+                f"schedQueueDepth={self.sched_queue_depth} "
+                f"schedRejected={self.sched_rejected} "
+                f"schedCancelled={self.sched_cancelled} "
+                f"schedDeadlineExceeded={self.sched_deadline_exceeded}")
         return "" if not parts else "TaskMetrics: " + "; ".join(parts)

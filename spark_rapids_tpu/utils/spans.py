@@ -222,6 +222,10 @@ class QueryProfile:
         self.start_ns = time.monotonic_ns()
         self.end_ns: Optional[int] = None
         self.closed = False
+        # 'ok' | 'cancelled' | 'deadline' | 'rejected' — set by the
+        # session when a query unwinds with a scheduler-typed error, so a
+        # killed query's profile record says so (sched_matrix.sh gates it)
+        self.status = "ok"
         self.task_metrics: Dict[str, Any] = {}
         self._mu = threading.RLock()
         self._next_span = itertools.count(1)  # 0 is the query root
@@ -334,6 +338,7 @@ class QueryProfile:
         recs: List[Dict[str, Any]] = [{
             "v": SCHEMA_VERSION, "type": "query",
             "query_id": self.query_id, "label": self.label,
+            "status": self.status,
             "wall_ns": self.wall_ns,
             "task_metrics": dict(self.task_metrics),
             "n_operators": len(self._op_meta),

@@ -45,6 +45,11 @@ def pytest_configure(config):
         "pipeline: pipelined-execution suite (bounded async prefetch / "
         "fused multi-chunk scan decode / pipeline on-off equality; "
         "scripts/pipeline_matrix.sh runs these standalone)")
+    config.addinivalue_line(
+        "markers",
+        "sched: query-scheduler suite (priority-weighted fair admission / "
+        "deadlines / cooperative cancellation / tenant quotas; "
+        "scripts/sched_matrix.sh runs these standalone)")
 
 
 @pytest.fixture

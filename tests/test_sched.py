@@ -1,0 +1,821 @@
+"""Query-scheduler suite (marker `sched`; scripts/sched_matrix.sh runs it
+standalone).
+
+Covers the ISSUE-7 acceptance surface: mixed-priority queries racing on
+`concurrentGpuTasks=1` with golden CPU-engine equality per query, strict
+priority ordering under contention (no inversion), cooperative
+cancellation mid-scan/mid-shuffle reclaiming the admission token with no
+leaked threads or catalog handles, load shedding with the typed
+`QueryRejectedError` before any device touch, the `sched.admit` fault
+point degrading typed, deadline-aware retry/fetch backoff, per-tenant
+memory sub-quotas, the service admission queue's dead-waiter removal, and
+the scheduler-off FIFO equivalence gate."""
+
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import faults
+from spark_rapids_tpu.errors import (DeadlineExceededError,
+                                     QueryCancelledError,
+                                     QueryRejectedError, RetryOOM,
+                                     SplitAndRetryOOM)
+from spark_rapids_tpu.expr import Count, Sum, col
+from spark_rapids_tpu.memory.budget import MemoryBudget
+from spark_rapids_tpu.memory.catalog import BufferCatalog
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+from spark_rapids_tpu.plugin import TpuSession
+from spark_rapids_tpu.sched import (ABANDONED, AdmissionQueue, CancelToken,
+                                    QueryContext, activate, checkpoint,
+                                    parse_tenant_map)
+
+pytestmark = pytest.mark.sched
+
+
+def make_table(seed=7, n=20_000):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": pa.array(rng.integers(0, 64, n)),
+        "g": pa.array(rng.integers(0, 16, n).astype(np.int32)),
+        "v": pa.array(rng.uniform(size=n)),
+    })
+
+
+def sched_session(**extra):
+    conf = {"spark.rapids.sql.enabled": True,
+            "spark.rapids.sql.explain": "NONE",
+            "spark.rapids.sql.concurrentGpuTasks": 1,
+            "spark.rapids.tpu.sched.enabled": True}
+    conf.update(extra)
+    sess = TpuSession(conf)
+    sess.initialize_device()
+    # DeviceManager.initialize is once-per-process: re-arm the semaphore
+    # for THIS conf (permits + sched policy signature)
+    TpuSemaphore.initialize(sess.conf.concurrent_tpu_tasks, sess.conf)
+    return sess
+
+
+@pytest.fixture
+def restore_semaphore():
+    """Every test here re-initializes the process semaphore; hand the next
+    suite a fresh default instance (and assert we leaked no holders)."""
+    yield
+    sem = TpuSemaphore._instance
+    if sem is not None and sem.scheduler is not None:
+        assert sem.scheduler.queue.holders == 0, \
+            "test left admission tokens held"
+    TpuSemaphore._instance = None
+
+
+def agg_query(sess, t):
+    return (sess.from_arrow(t).filter(col("v") > 0.2)
+            .group_by("g").agg(total=Sum(col("v")), cnt=Count(col("k"))))
+
+
+class TestAdmissionQueueUnit:
+    def test_fifo_when_unweighted(self):
+        q = AdmissionQueue(1)
+        assert q.acquire() == 1
+        orders = []
+        ths = []
+        for i in range(4):
+            th = threading.Thread(
+                target=lambda i=i: orders.append((q.acquire(), i)))
+            th.start()
+            time.sleep(0.05)  # deterministic arrival order
+            ths.append(th)
+        for _ in range(4):
+            q.release()
+        for th in ths:
+            th.join(timeout=5)
+        q.release()
+        assert [i for _, i in sorted(orders)] == [0, 1, 2, 3]
+
+    def test_priority_beats_arrival(self):
+        q = AdmissionQueue(1)
+        q.acquire()
+        got = []
+
+        def worker(name, prio):
+            got.append((q.acquire(priority=prio), name))
+            q.release()
+
+        lo = threading.Thread(target=worker, args=("low", 0))
+        lo.start()
+        time.sleep(0.05)
+        hi = threading.Thread(target=worker, args=("high", 10))
+        hi.start()
+        time.sleep(0.05)
+        q.release()  # high must go first despite arriving second
+        lo.join(timeout=5)
+        hi.join(timeout=5)
+        assert sorted(got)[0][1] == "high"
+
+    def test_weighted_fair_share(self):
+        q = AdmissionQueue(1, weights={"a": 3.0, "b": 1.0})
+        q.acquire()
+        grants = []
+
+        def worker(tenant):
+            q.acquire(tenant=tenant)
+            grants.append(tenant)
+            q.release()
+
+        ths = [threading.Thread(target=worker, args=(t,))
+               for t in ["a"] * 9 + ["b"] * 9]
+        for th in ths:
+            th.start()
+        time.sleep(0.2)
+        q.release()
+        for th in ths:
+            th.join(timeout=10)
+        # 3:1 stride => among the first 8 grants, 'a' gets ~6
+        assert grants[:8].count("a") >= 5, grants
+
+    def test_depth_shed(self):
+        q = AdmissionQueue(1, max_depth=1)
+        q.acquire()
+        th = threading.Thread(target=q.acquire)
+        th.start()
+        time.sleep(0.05)
+        with pytest.raises(QueryRejectedError) as ei:
+            q.acquire()
+        assert ei.value.depth == 1
+        q.release()
+        th.join(timeout=5)
+        q.release()
+
+    def test_wait_shed(self):
+        q = AdmissionQueue(1, max_wait_s=0.1)
+        q.acquire()
+        t0 = time.monotonic()
+        with pytest.raises(QueryRejectedError):
+            q.acquire()
+        assert 0.05 < time.monotonic() - t0 < 5.0
+        q.release()
+
+    def test_deadline_while_queued(self):
+        q = AdmissionQueue(1)
+        q.acquire()
+        with pytest.raises(DeadlineExceededError):
+            q.acquire(token=CancelToken(deadline_s=0.1))
+        q.release()
+
+    def test_cancel_wakes_parked_waiter(self):
+        q = AdmissionQueue(1)
+        q.acquire()
+        tok = CancelToken()
+        res = {}
+
+        def park():
+            try:
+                q.acquire(token=tok)
+            except QueryCancelledError:
+                res["t"] = time.monotonic()
+
+        th = threading.Thread(target=park)
+        th.start()
+        time.sleep(0.1)
+        t0 = time.monotonic()
+        tok.cancel("test")
+        th.join(timeout=5)
+        assert res["t"] - t0 < 1.0, "cancel did not wake the waiter"
+        # the abandoned waiter must not have consumed the token
+        q.release()
+        assert q.acquire(timeout=1.0) is not None
+        q.release()
+
+    def test_dead_waiter_removed_not_granted(self):
+        """The release-on-disconnect satellite: a queued waiter whose
+        liveness probe goes false is REMOVED; the token goes to the next
+        live waiter, never to the dead one."""
+        q = AdmissionQueue(1)
+        q.acquire()
+        alive = {"dead_client": True}
+        res = {}
+
+        def dead():
+            res["dead"] = q.acquire(alive=lambda: alive["dead_client"])
+
+        def live():
+            res["live"] = q.acquire()
+            q.release()
+
+        td = threading.Thread(target=dead)
+        td.start()
+        time.sleep(0.05)
+        tl = threading.Thread(target=live)
+        tl.start()
+        time.sleep(0.05)
+        alive["dead_client"] = False  # client dies while parked FIRST in line
+        td.join(timeout=5)
+        assert res["dead"] is ABANDONED
+        assert q.depth() == 1  # only the live waiter remains
+        q.release()
+        tl.join(timeout=5)
+        assert "live" in res
+
+    def test_fault_point_degrades_typed(self):
+        q = AdmissionQueue(2)
+        with faults.inject(faults.SCHED_ADMIT, "error", nth=1,
+                           error=ConnectionResetError) as rule:
+            with pytest.raises(QueryRejectedError):
+                q.acquire()
+            assert rule.fired == 1
+        assert q.acquire() is not None  # next admit is clean
+        q.release()
+        assert q.holders == 0
+
+    def test_idle_tenant_banks_no_credit(self):
+        """A tenant that idles while another advances its pass must NOT
+        rejoin with banked fair-share credit: the floor tracks queued
+        tenants (or the max pass when nothing queues), not every tenant
+        ever seen."""
+        q = AdmissionQueue(1, weights={"a": 1.0, "b": 1.0})
+        # b runs once early, then idles
+        assert q.acquire(tenant="b") is not None
+        q.release()
+        # a runs many solo queries, advancing its pass far past b's
+        for _ in range(20):
+            q.acquire(tenant="a")
+            q.release()
+        # contention: one of each queued behind a held token — b must not
+        # sweep ahead on its stale low pass beyond one fair turn
+        q.acquire(tenant="hold")
+        grants = []
+
+        def worker(tenant):
+            q.acquire(tenant=tenant)
+            grants.append(tenant)
+            q.release()
+
+        ths = [threading.Thread(target=worker, args=(t,))
+               for t in ["b", "a"] * 4]
+        for th in ths:
+            th.start()
+        time.sleep(0.2)
+        q.release()
+        for th in ths:
+            th.join(timeout=10)
+        # equal weights from a level floor => near-alternation, not a
+        # b-burst: within any prefix b leads a by at most ~1 grant
+        for i in range(1, len(grants) + 1):
+            lead = grants[:i].count("b") - grants[:i].count("a")
+            assert lead <= 2, f"idle tenant swept ahead: {grants}"
+
+    def test_parse_tenant_map(self):
+        assert parse_tenant_map("a=4, b=1.5") == {"a": 4.0, "b": 1.5}
+        assert parse_tenant_map("") == {}
+        with pytest.raises(ValueError):
+            parse_tenant_map("justakey")
+
+
+class TestEngineScheduling:
+    def test_mixed_priority_race_golden(self, restore_semaphore):
+        """N mixed-priority queries race on concurrentGpuTasks=1; every
+        result must equal the CPU engine's for the same plan."""
+        sess = sched_session()
+        tables = [make_table(seed=100 + i, n=8_000) for i in range(6)]
+        expected = [agg_query(sess, t).plan for t in tables]
+        golden = [sess.execute_plan(p, use_device=False).sort_by("g")
+                  for p in expected]
+        results = [None] * len(tables)
+        errors = []
+
+        def run(i):
+            try:
+                ctx = QueryContext(tenant=f"t{i % 2}",
+                                   priority=(10 if i % 3 == 0 else 0))
+                plan = agg_query(sess, tables[i]).plan
+                results[i] = sess.execute_plan(
+                    plan, sched_ctx=ctx).sort_by("g")
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append((i, e))
+
+        ths = [threading.Thread(target=run, args=(i,))
+               for i in range(len(tables))]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join(timeout=120)
+        assert not errors, errors
+        for i, (res, gold) in enumerate(zip(results, golden)):
+            assert res is not None, f"query {i} produced nothing"
+            assert res.equals(gold), f"query {i} diverged from CPU engine"
+        assert TpuSemaphore.get().scheduler.queue.holders == 0
+
+    def test_no_priority_inversion(self, restore_semaphore):
+        """With the single token held, a high-priority query submitted
+        AFTER a queued low-priority one is admitted first."""
+        sess = sched_session()
+        sched = TpuSemaphore.get().scheduler
+        t = make_table(n=4_000)
+        plan_lo = agg_query(sess, t).plan
+        plan_hi = agg_query(sess, t).plan
+        sched.queue.acquire()  # hold the only token
+        admitted = []
+        orig_admit = sched.admit
+
+        def spy_admit():
+            order = orig_admit()
+            admitted.append(threading.current_thread().name)
+            return order
+
+        sched.admit = spy_admit
+        try:
+            lo = threading.Thread(
+                name="lowpri", target=lambda: sess.execute_plan(
+                    plan_lo, sched_ctx=QueryContext(priority=0)))
+            lo.start()
+            # low-pri must be PARKED in the queue before high-pri arrives
+            for _ in range(200):
+                if sched.queue.depth() >= 1:
+                    break
+                time.sleep(0.01)
+            assert sched.queue.depth() >= 1, "low-pri never queued"
+            hi = threading.Thread(
+                name="highpri", target=lambda: sess.execute_plan(
+                    plan_hi, sched_ctx=QueryContext(priority=10)))
+            hi.start()
+            for _ in range(200):
+                if sched.queue.depth() >= 2:
+                    break
+                time.sleep(0.01)
+            assert sched.queue.depth() >= 2, "high-pri never queued"
+            sched.queue.release()  # free the held token: who gets it?
+            lo.join(timeout=60)
+            hi.join(timeout=60)
+        finally:
+            sched.admit = orig_admit
+        assert admitted[0] == "highpri", admitted
+
+    def test_shed_query_rejects_before_device(self, restore_semaphore):
+        sess = sched_session(**{"spark.rapids.tpu.sched.maxQueueDepth": 1})
+        sched = TpuSemaphore.get().scheduler
+        sched.queue.acquire()          # token busy
+        parked = threading.Thread(target=sched.queue.acquire)
+        parked.start()                 # queue at max depth
+        time.sleep(0.05)
+        cat0 = BufferCatalog.get().live_count
+        t = make_table(n=4_000)
+        plan = agg_query(sess, t).plan
+        with pytest.raises(QueryRejectedError):
+            sess.execute_plan(plan, sched_ctx=QueryContext())
+        # shed before admission: nothing parked on device, token not taken
+        assert BufferCatalog.get().live_count == cat0
+        sched.queue.release()
+        parked.join(timeout=5)
+        sched.queue.release()
+        assert sched.queue.holders == 0
+
+    def test_cancel_mid_scan_reclaims_everything(self, restore_semaphore,
+                                                 tmp_path):
+        """Cancel a parquet-scan query mid-stream (pipeline prefetch on):
+        typed error, admission token returned, no leaked prefetch
+        threads, no leaked catalog handles."""
+        import pyarrow.parquet as pq
+        sess = sched_session(**{
+            "spark.rapids.sql.batchSizeRows": 1024,
+            "spark.rapids.tpu.pipeline.enabled": True})
+        path = str(tmp_path / "scan.parquet")
+        pq.write_table(make_table(n=40_000), path, row_group_size=1024)
+        cat0 = BufferCatalog.get().live_count
+        threads0 = threading.active_count()
+        ctx = QueryContext(tenant="a")
+        plan = (sess.read_parquet(path).filter(col("v") > 0.1)
+                .group_by("g").agg(total=Sum(col("v")))).plan
+
+        def killer():
+            time.sleep(0.05)
+            ctx.token.cancel("mid-scan kill")
+
+        th = threading.Thread(target=killer)
+        th.start()
+        try:
+            sess.execute_plan(plan, sched_ctx=ctx)
+        except QueryCancelledError:
+            pass  # fast machines may finish first; both are legal
+        th.join()
+        # the admission token must be back regardless of outcome
+        assert TpuSemaphore.get().scheduler.queue.holders == 0
+        # prefetch producers joined: thread count returns to baseline
+        for _ in range(100):
+            if threading.active_count() <= threads0:
+                break
+            time.sleep(0.02)
+        assert threading.active_count() <= threads0, \
+            "leaked prefetch thread(s)"
+        assert BufferCatalog.get().live_count == cat0, "leaked catalog handles"
+
+    def test_cancel_mid_shuffle_backoff(self, restore_semaphore):
+        """A fetch stuck in retry backoff observes the cancel (typed
+        error) instead of sleeping out its schedule."""
+        from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+        mgr = TpuShuffleManager.get()
+        ctx = QueryContext()
+        ctx.token.cancel("shuffle kill")
+        with activate(ctx):
+            t0 = time.monotonic()
+            with pytest.raises(QueryCancelledError):
+                # unknown peer => transport error => retry backoff path
+                mgr._fetch_peer_with_retry(999, 0, "no-such-peer")
+            assert time.monotonic() - t0 < 2.0
+
+    def test_deadline_bounds_fetch_retries(self, restore_semaphore):
+        from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+        mgr = TpuShuffleManager.get()
+        ctx = QueryContext(deadline_s=0.02)
+        with activate(ctx):
+            with pytest.raises(DeadlineExceededError):
+                mgr._fetch_peer_with_retry(999, 0, "no-such-peer")
+
+    def test_fault_sched_admit_engine(self, restore_semaphore):
+        sess = sched_session()
+        t = make_table(n=4_000)
+        plan = agg_query(sess, t).plan
+        with faults.inject(faults.SCHED_ADMIT, "error", nth=1) as rule:
+            with pytest.raises(QueryRejectedError):
+                sess.execute_plan(plan, sched_ctx=QueryContext())
+            assert rule.fired == 1
+        assert TpuSemaphore.get().scheduler.queue.holders == 0
+        # next query admits cleanly
+        out = sess.execute_plan(plan, sched_ctx=QueryContext())
+        assert out.num_rows > 0
+
+
+class TestDeadlineBackoff:
+    def test_with_retry_fails_fast_past_deadline(self):
+        from spark_rapids_tpu.memory.retry import with_retry_no_split
+        calls = []
+
+        def always_oom(_):
+            calls.append(1)
+            raise RetryOOM("pressure")
+
+        ctx = QueryContext(deadline_s=0.005)
+        with activate(ctx):
+            t0 = time.monotonic()
+            with pytest.raises(DeadlineExceededError):
+                with_retry_no_split(object(), always_oom)
+            # fail fast: no 8-attempt 250ms-capped backoff ladder
+            assert time.monotonic() - t0 < 1.0
+        assert len(calls) <= 4
+
+    def test_backoff_clamps_to_remaining(self):
+        from spark_rapids_tpu.memory.retry import deadline_backoff
+        ctx = QueryContext(deadline_s=10.0)
+        with activate(ctx):
+            assert deadline_backoff(0.001) == 0.001
+        with activate(QueryContext(deadline_s=0.001)):
+            time.sleep(0.002)
+            with pytest.raises(DeadlineExceededError):
+                deadline_backoff(0.25)
+
+    def test_no_context_no_change(self):
+        from spark_rapids_tpu.memory.retry import deadline_backoff
+        assert deadline_backoff(0.25) == 0.25
+
+
+class TestTenantQuotas:
+    def test_over_quota_tenant_splits_not_neighbour(self):
+        """An over-quota reserve raises SplitAndRetryOOM WITHOUT spilling:
+        spilling frees neighbours' buffers by global priority while the
+        offender's pinned ledger would not move — the futile-eviction
+        storm the review of this PR caught."""
+        import pyarrow as pa
+        from spark_rapids_tpu.columnar import batch_from_arrow
+        from spark_rapids_tpu.memory.spillable import SpillableColumnarBatch
+        conf = TpuSession({"spark.rapids.tpu.sched.tenant.quotas":
+                           "small=0.001,big=0.9"}).conf
+        budget = MemoryBudget(1 << 30, conf)
+        MemoryBudget._instance, saved = budget, MemoryBudget._instance
+        try:
+            with activate(QueryContext(tenant="big")):
+                neighbour = SpillableColumnarBatch(batch_from_arrow(
+                    pa.table({"a": pa.array(
+                        np.arange(1024, dtype=np.int64))})))
+            quota = budget.tenant_quotas["small"]
+            with activate(QueryContext(tenant="small")):
+                with pytest.raises(SplitAndRetryOOM):
+                    budget.reserve(quota + 1)  # over quota, global fine
+            assert not neighbour.spilled, \
+                "over-quota tenant evicted a neighbour's buffer"
+            with activate(QueryContext(tenant="big")):
+                neighbour.close()
+            assert budget.tenant_used.get("small", 0) == 0
+            assert budget.tenant_used.get("big", 0) == 0
+        finally:
+            MemoryBudget._instance = saved
+
+    def test_spill_does_not_reattribute_tenant_charge(self):
+        """Regression: a tier transition (spill/unspill) on a thread with
+        SOME tenant's context active must move the GLOBAL ledger only —
+        the parked buffer's tenant charge is pinned park→close, so a
+        neighbour's eviction can neither credit the evictor nor
+        double-charge the owner on unspill."""
+        import pyarrow as pa
+        from spark_rapids_tpu.columnar import batch_from_arrow
+        from spark_rapids_tpu.memory.spillable import SpillableColumnarBatch
+        conf = TpuSession({"spark.rapids.tpu.sched.tenant.quotas":
+                           "owner=0.5,evictor=0.5"}).conf
+        budget = MemoryBudget(1 << 30, conf)
+        MemoryBudget._instance, saved = budget, MemoryBudget._instance
+        try:
+            with activate(QueryContext(tenant="owner")):
+                sp = SpillableColumnarBatch(batch_from_arrow(pa.table(
+                    {"a": pa.array(np.arange(1024, dtype=np.int64))})))
+            owner0 = budget.tenant_used.get("owner", 0)
+            assert owner0 >= sp.size_bytes
+            # spill + unspill under the EVICTOR's context
+            with activate(QueryContext(tenant="evictor")):
+                BufferCatalog.get().synchronous_spill(sp.size_bytes)
+                assert sp.spilled
+                assert budget.tenant_used.get("evictor", 0) == 0, \
+                    "evictor was credited for the owner's buffer"
+                assert budget.tenant_used.get("owner", 0) == owner0, \
+                    "owner's pinned charge moved on spill"
+                sp.get_batch(acquire_semaphore=False)  # unspill
+                assert budget.tenant_used.get("owner", 0) == owner0, \
+                    "owner double-charged on unspill"
+                sp.close()
+            assert budget.tenant_used.get("owner", 0) == 0, \
+                "close did not credit the pinned owner charge"
+        finally:
+            MemoryBudget._instance = saved
+
+    def test_unquotad_tenant_sees_global_only(self):
+        conf = TpuSession({"spark.rapids.tpu.sched.tenant.quotas":
+                           "small=0.1"}).conf
+        budget = MemoryBudget(1000, conf)
+        MemoryBudget._instance, saved = budget, MemoryBudget._instance
+        try:
+            with activate(QueryContext(tenant="other")):
+                budget.reserve(900)  # no sub-quota for 'other'
+                budget.release(900)
+        finally:
+            MemoryBudget._instance = saved
+
+
+class TestSchedulerOffFifo:
+    def test_off_has_no_scheduler_state(self, restore_semaphore):
+        sess = TpuSession({"spark.rapids.sql.enabled": True,
+                           "spark.rapids.sql.explain": "NONE"})
+        TpuSemaphore.initialize(sess.conf.concurrent_tpu_tasks, sess.conf)
+        assert TpuSemaphore.get().scheduler is None
+        t = make_table(n=4_000)
+        out = agg_query(sess, t).collect()
+        assert out.num_rows > 0
+
+    def test_off_server_admission_is_fifo(self):
+        """The service _Admission with sched disabled grants in strict
+        arrival order and ignores priorities in the header path."""
+        from spark_rapids_tpu.service.server import _Admission
+        conf = TpuSession({}).conf
+        adm = _Admission(1, conf)
+        assert not adm.sched_enabled
+        assert adm.acquire() == 1
+        got = []
+        ths = []
+        for i, prio in enumerate([0, 10, 99]):
+            th = threading.Thread(
+                target=lambda i=i, p=prio: got.append(
+                    (adm.acquire(priority=p), i)))
+            th.start()
+            time.sleep(0.05)
+            ths.append(th)
+        for _ in range(3):
+            adm.release_one()
+        for th in ths:
+            th.join(timeout=5)
+        adm.release_one()
+        # arrival order wins even though later arrivals claimed higher
+        # priority: the disabled door strips policy inputs
+        assert [i for _, i in sorted(got)] == [0, 1, 2]
+
+    def test_fifo_door_honors_token(self, restore_semaphore):
+        """sched.enabled=false + a context with a deadline/cancel: a
+        query parked at the plain FIFO semaphore must still unwind typed
+        instead of blocking until a permit frees."""
+        sem = TpuSemaphore(1)  # no conf: the FIFO door
+        TpuSemaphore._instance = sem
+        holder = threading.Thread(target=sem.acquire_if_necessary)
+        holder.start()
+        holder.join(timeout=5)  # holder thread keeps the only permit
+        with activate(QueryContext(deadline_s=0.15)):
+            t0 = time.monotonic()
+            with pytest.raises(DeadlineExceededError):
+                sem.acquire_if_necessary()
+            assert time.monotonic() - t0 < 2.0
+        tok = CancelToken()
+        with activate(QueryContext(token=tok)):
+            res = {}
+
+            def park():
+                try:
+                    sem.acquire_if_necessary()
+                except QueryCancelledError:
+                    res["cancelled"] = True
+
+            # the context is thread-local: adopt it on the parked thread
+            from spark_rapids_tpu.sched import adopt, current
+            ctx = current()
+
+            def park_with_ctx():
+                adopt(ctx)
+                park()
+
+            th = threading.Thread(target=park_with_ctx)
+            th.start()
+            time.sleep(0.1)
+            tok.cancel("fifo-door test")
+            th.join(timeout=5)
+            assert res.get("cancelled"), "cancel did not unwind FIFO wait"
+
+    def test_on_off_results_identical(self, restore_semaphore):
+        t = make_table(n=8_000)
+        sess_off = TpuSession({"spark.rapids.sql.enabled": True,
+                               "spark.rapids.sql.explain": "NONE"})
+        TpuSemaphore.initialize(sess_off.conf.concurrent_tpu_tasks,
+                                sess_off.conf)
+        off = agg_query(sess_off, t).collect().sort_by("g")
+        sess_on = sched_session()
+        on = agg_query(sess_on, t).collect().sort_by("g")
+        assert on.equals(off)
+
+
+class TestServiceCancelOp:
+    @pytest.fixture
+    def service(self, tmp_path):
+        """In-process device service on a tmp socket (subprocess startup
+        is test_service.py's job; the protocol seams are the target here).
+        Scheduler ON with one token so tests can park a run_plan
+        deterministically by holding the token."""
+        from spark_rapids_tpu.service.server import TpuDeviceService
+        svc = TpuDeviceService({"spark.rapids.sql.enabled": True,
+                                "spark.rapids.sql.concurrentGpuTasks": 1,
+                                "spark.rapids.tpu.sched.enabled": True},
+                               str(tmp_path / "svc.sock"))
+        th = threading.Thread(target=svc.serve_forever, daemon=True)
+        th.start()
+        for _ in range(200):
+            if svc._listener is not None:
+                break
+            time.sleep(0.01)
+        # DeviceManager init is once-per-process: arm the semaphore for
+        # the service conf (1 token, scheduler on)
+        TpuSemaphore.initialize(1, svc.session.conf)
+        yield svc
+        svc._stop.set()
+        th.join(timeout=10)
+        TpuSemaphore._instance = None
+
+    @staticmethod
+    def _plan_json(tmp_path):
+        """Minimal FileSourceScanExec plan + its parquet file."""
+        import json
+        import pyarrow.parquet as pq
+        rng = np.random.default_rng(5)
+        t = pa.table({
+            "k": pa.array(rng.integers(0, 50, 2_000).astype(np.int64)),
+            "v": pa.array(rng.normal(0.1, 1.0, 2_000))})
+        path = str(tmp_path / "t.parquet")
+        pq.write_table(t, path)
+
+        def attr(name, dt):
+            return [{"class": "org.apache.spark.sql.catalyst.expressions."
+                     "AttributeReference", "num-children": 0, "name": name,
+                     "dataType": dt, "nullable": True, "metadata": {},
+                     "exprId": {"id": 1, "jvmId": "x"}, "qualifier": []}]
+
+        scan = {"class": "org.apache.spark.sql.execution."
+                "FileSourceScanExec", "num-children": 0,
+                "relation": "HadoopFsRelation(parquet)",
+                "output": [attr("k", "long"), attr("v", "double")],
+                "tableIdentifier": "t"}
+        return json.dumps([scan]), {"t": [path]}
+
+    def test_cancel_inflight_run_plan(self, service, tmp_path):
+        from spark_rapids_tpu.service import TpuServiceClient
+        plan, paths = self._plan_json(tmp_path)
+        sock = service.socket_path
+        # hold the one admission token: the run_plan parks in the
+        # ADMISSION QUEUE (not a scheduler-blind lock), where the cancel
+        # must reach it
+        sched = TpuSemaphore.get().scheduler
+        sched.queue.acquire()
+        res = {}
+
+        def submit():
+            with TpuServiceClient(sock, deadline_s=60) as cli:
+                try:
+                    res["out"] = cli.run_plan(plan, paths=paths,
+                                              query_id="q-kill")
+                except Exception as e:  # noqa: BLE001 — asserted below
+                    res["err"] = e
+
+        th = threading.Thread(target=submit)
+        th.start()
+        # the query must be REGISTERED (parked in admission) before cancel
+        for _ in range(300):
+            if "q-kill" in service._queries and sched.queue.depth() >= 1:
+                break
+            time.sleep(0.01)
+        assert "q-kill" in service._queries, "run_plan never registered"
+        with TpuServiceClient(sock, deadline_s=60) as cli2:
+            ack = cli2.cancel("q-kill", reason="test")
+            assert ack["killed"]
+        th.join(timeout=60)
+        sched.queue.release()
+        assert isinstance(res.get("err"), QueryCancelledError), res
+        # the registry must not leak the cancelled query (the reply is
+        # sent a beat before the handler's finally pops it)
+        for _ in range(200):
+            if "q-kill" not in service._queries:
+                break
+            time.sleep(0.01)
+        assert "q-kill" not in service._queries
+
+    def test_cancel_unknown_query(self, service):
+        from spark_rapids_tpu.service import TpuServiceClient
+        with TpuServiceClient(service.socket_path, deadline_s=60) as cli:
+            with pytest.raises(KeyError):
+                cli.cancel("nope")
+
+    def test_deprioritize_inflight(self, service, tmp_path):
+        from spark_rapids_tpu.service import TpuServiceClient
+        plan, paths = self._plan_json(tmp_path)
+        sock = service.socket_path
+        sched = TpuSemaphore.get().scheduler
+        sched.queue.acquire()  # park the run_plan in admission
+        res = {}
+
+        def submit():
+            with TpuServiceClient(sock, deadline_s=60) as cli:
+                res["out"] = cli.run_plan(plan, paths=paths,
+                                          query_id="q-deprio", priority=10)
+
+        th = threading.Thread(target=submit)
+        th.start()
+        for _ in range(300):
+            if "q-deprio" in service._queries:
+                break
+            time.sleep(0.01)
+        with TpuServiceClient(sock, deadline_s=60) as cli2:
+            ack = cli2.cancel("q-deprio", priority=-5)
+            assert not ack["killed"] and ack["priority"] == -5
+        assert service._queries["q-deprio"].priority == -5
+        sched.queue.release()
+        th.join(timeout=60)
+        assert "out" in res and res["out"].num_rows == 2_000
+
+
+class TestProfileAndMetrics:
+    def test_cancelled_query_profile_status(self, restore_semaphore,
+                                            tmp_path):
+        sess = sched_session(**{
+            "spark.rapids.tpu.metrics.eventLog.dir": str(tmp_path)})
+        t = make_table(n=8_000)
+        ctx = QueryContext()
+        ctx.token.cancel("pre-cancelled")
+        with pytest.raises(QueryCancelledError):
+            sess.execute_plan(agg_query(sess, t).plan, sched_ctx=ctx)
+        prof = sess.last_profile
+        assert prof is not None and prof.status == "cancelled"
+        recs = prof.to_records()
+        qrec = [r for r in recs if r["type"] == "query"][0]
+        assert qrec["status"] == "cancelled"
+        assert "sched_queue_wait_ns" in qrec["task_metrics"]
+
+    def test_sched_counters_and_report_section(self, restore_semaphore,
+                                               tmp_path):
+        from spark_rapids_tpu.tools.profile_report import (build_model,
+                                                           load_records,
+                                                           render_report,
+                                                           sched_summary)
+        log_dir = str(tmp_path / "events")
+        sess = sched_session(**{
+            "spark.rapids.tpu.metrics.eventLog.dir": log_dir})
+        t = make_table(n=8_000)
+        out = sess.execute_plan(agg_query(sess, t).plan,
+                                sched_ctx=QueryContext(tenant="rpt"))
+        assert out.num_rows > 0
+        tm = sess.last_profile.task_metrics
+        assert tm.get("sched_admissions", 0) >= 1
+        records, problems = load_records([log_dir], validate=True)
+        assert not problems
+        model = build_model(records)
+        summary = sched_summary(model)
+        assert summary and summary["admissions"] >= 1
+        report = render_report(model)
+        assert "=== scheduler ===" in report
+
+    def test_explain_string_has_sched_line(self, restore_semaphore):
+        from spark_rapids_tpu.utils.metrics import TaskMetrics
+        tm = TaskMetrics()
+        tm.sched_admissions = 2
+        tm.sched_rejected = 1
+        s = tm.explain_string()
+        assert "schedAdmissions=2" in s and "schedRejected=1" in s
