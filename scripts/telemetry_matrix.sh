@@ -1,0 +1,274 @@
+#!/usr/bin/env bash
+# Live-telemetry matrix (ISSUE-8 CI gate):
+#   1. run the telemetry test suite (marker `telemetry`);
+#   2. telemetry-OFF gate: with spark.rapids.tpu.telemetry.enabled=false
+#      a query spawns ZERO new threads, no registry/recorder/HTTP object
+#      exists, every facade hook is a no-op, and the hook cost is in the
+#      noise (off-vs-on wall time on a pipeline-style query);
+#   3. scrape-golden gate: a sched-enabled TpuDeviceService under
+#      admission load serves /metrics (HTTP + the `stats` service op,
+#      identical families) and /healthz — every registered family renders
+#      in Prometheus text format and parses back, with live scheduler
+#      depth/admission, memory, compile-cache, and query families;
+#   4. flight-recorder gate: an injected terminal OOM produces a
+#      schema-validated incident dump;
+#   5. trace-correlation gate: a cross-process run_plan against a server
+#      OS process yields client AND server event-log records sharing one
+#      trace id, stitched by `profile_report.py --trace`.
+#
+# Usage: scripts/telemetry_matrix.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TIMEOUT="${SRTPU_TELEMETRY_TIMEOUT:-900}"
+
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_telemetry.py -m telemetry -q \
+    -p no:cacheprovider "$@"
+
+echo "== telemetry-off gate (zero threads, zero state, hook cost in the noise) =="
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu python - <<'EOF'
+import threading, time
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu import telemetry
+from spark_rapids_tpu.expr import Sum, col
+from spark_rapids_tpu.plugin import TpuSession
+
+rng = np.random.default_rng(17)
+n = 60_000
+t = pa.table({"k": pa.array(rng.integers(0, 256, n)),
+              "g": pa.array(rng.integers(0, 64, n).astype(np.int32)),
+              "v": pa.array(rng.uniform(size=n))})
+
+def run(sess):
+    q = (sess.from_arrow(t).filter(col("v") > 0.25)
+         .group_by("g").agg(total=Sum(col("v"))))
+    return q.collect()
+
+threads0 = threading.active_count()
+off = TpuSession({"spark.rapids.sql.explain": "NONE"})
+run(off)  # warm compile caches
+assert not telemetry.is_enabled(), "FAIL: telemetry active without opt-in"
+assert telemetry.registry() is None and telemetry.flight_recorder() is None \
+    and telemetry.http_server() is None, "FAIL: telemetry-off state exists"
+assert threading.active_count() <= threads0, \
+    f"FAIL: telemetry-off spawned {threading.active_count() - threads0} threads"
+
+REPS = 5
+t0 = time.monotonic()
+for _ in range(REPS):
+    off_res = run(off)
+off_s = time.monotonic() - t0
+
+on = TpuSession({"spark.rapids.sql.explain": "NONE",
+                 "spark.rapids.tpu.telemetry.enabled": True})
+on.initialize_device()
+run(on)  # warm
+t0 = time.monotonic()
+for _ in range(REPS):
+    on_res = run(on)
+on_s = time.monotonic() - t0
+assert on_res.sort_by("g").equals(off_res.sort_by("g")), \
+    "FAIL: telemetry-on result differs"
+# the on-path (counters + flight events live) must stay within noise of
+# off; the off-path hooks are strictly cheaper than the on-path, so this
+# bounds the off overhead from above far tighter than the 2% contract
+ratio = on_s / max(off_s, 1e-9)
+print(f"telemetry off={off_s:.3f}s on={on_s:.3f}s ratio={ratio:.3f}")
+assert ratio < 1.25, f"FAIL: telemetry-on overhead ratio {ratio:.3f}"
+telemetry.shutdown()
+print("telemetry-off gate OK")
+EOF
+
+echo "== scrape-golden gate (families render + parse; live sched/memory/compile) =="
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu python - <<'EOF'
+import json, threading, time, urllib.request
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu import telemetry
+from spark_rapids_tpu.expr import Sum, col
+from spark_rapids_tpu.plugin import TpuSession
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+from spark_rapids_tpu.telemetry import parse_prometheus
+
+sess = TpuSession({"spark.rapids.sql.explain": "NONE",
+                   "spark.rapids.tpu.sched.enabled": True,
+                   "spark.rapids.tpu.telemetry.enabled": True,
+                   "spark.rapids.tpu.telemetry.http.port": 0})
+sess.initialize_device()
+TpuSemaphore.initialize(sess.conf.concurrent_tpu_tasks, sess.conf)
+
+rng = np.random.default_rng(23)
+n = 20_000
+t = pa.table({"g": pa.array(rng.integers(0, 32, n).astype(np.int32)),
+              "v": pa.array(rng.uniform(size=n))})
+
+# overload mix: several scheduled queries through the admission door
+from spark_rapids_tpu.sched import QueryContext
+def one(i):
+    sess.execute_plan(
+        sess.from_arrow(t).filter(col("v") > 0.2)
+            .group_by("g").agg(s=Sum(col("v"))).plan,
+        sched_ctx=QueryContext(tenant=f"t{i % 2}", priority=i % 3))
+threads = [threading.Thread(target=one, args=(i,)) for i in range(6)]
+for th in threads: th.start()
+for th in threads: th.join()
+
+reg = telemetry.registry()
+text = reg.render()
+parsed = parse_prometheus(text)
+for fam in reg.families():
+    assert any(k == fam or k.startswith(fam + "_") for k in parsed), \
+        f"FAIL: family {fam} missing from the scrape"
+assert sum(parsed["tpu_queries_total"].values()) >= 6, parsed["tpu_queries_total"]
+assert sum(parsed["tpu_sched_admissions_total"].values()) >= 6
+assert sum(parsed["tpu_sched_admission_wait_seconds_count"].values()) >= 6
+assert parsed["tpu_memory_budget_bytes"]['kind="total"'] > 0
+assert sum(parsed["tpu_compile_stats"].values()) > 0
+assert sum(parsed["tpu_op_output_rows_total"].values()) > 0
+
+# HTTP /metrics serves the same families; /healthz answers ok
+port = telemetry.http_server().port
+http_text = urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/metrics").read().decode()
+assert set(parse_prometheus(http_text)) == set(parsed), \
+    "FAIL: HTTP scrape families differ from in-process render"
+health = json.loads(urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/healthz").read())
+assert health["ok"] and health["device"]["initialized"], health
+assert health["scheduler"]["queues"] >= 1 and health["scheduler"]["alive"]
+print(f"scrape-golden gate OK ({len(reg.families())} families, "
+      f"admissions={int(sum(parsed['tpu_sched_admissions_total'].values()))})")
+telemetry.shutdown()
+TpuSemaphore._instance = None
+EOF
+
+echo "== flight-recorder gate (injected terminal OOM -> schema-valid dump) =="
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu python - <<'EOF'
+import json, os, tempfile
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu import faults, telemetry
+from spark_rapids_tpu.errors import RetryOOM
+from spark_rapids_tpu.expr import Sum, col
+from spark_rapids_tpu.plugin import TpuSession
+from spark_rapids_tpu.utils.spans import validate_record
+
+d = tempfile.mkdtemp(prefix="srtpu-telemetry-gate-")
+sess = TpuSession({"spark.rapids.sql.explain": "NONE",
+                   "spark.rapids.tpu.telemetry.enabled": True,
+                   "spark.rapids.tpu.telemetry.flightRecorder.dir": d})
+t = pa.table({"g": pa.array(np.arange(4000) % 8),
+              "v": pa.array(np.ones(4000))})
+try:
+    with faults.inject(faults.ALLOC, "error", nth=0, times=0,
+                       error=RetryOOM):
+        sess.from_arrow(t).group_by("g").agg(s=Sum(col("v"))).collect()
+    raise SystemExit("FAIL: injected OOM did not raise")
+except RetryOOM:
+    pass
+dumps = [f for f in os.listdir(d) if f.startswith("incident-")
+         and "terminal_oom" in f]
+assert dumps, f"FAIL: no incident dump in {d}: {os.listdir(d)}"
+recs = [json.loads(l) for l in open(os.path.join(d, dumps[0]))]
+assert recs[0]["type"] == "incident" and recs[0]["reason"] == "terminal_oom"
+assert recs[0]["trace_id"], "FAIL: incident not trace-stamped"
+bad = [(r, validate_record(r)) for r in recs if validate_record(r)]
+assert not bad, f"FAIL: invalid incident records: {bad[:2]}"
+assert any(r["type"] == "event" for r in recs), "FAIL: empty ring dumped"
+print(f"flight-recorder gate OK ({len(recs) - 1} events in {dumps[0]})")
+telemetry.shutdown()
+EOF
+
+echo "== trace-correlation gate (client+server run_plan share one trace id) =="
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu python - <<'EOF'
+import json, os, subprocess, sys, tempfile, time
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+REPO = os.getcwd()
+d = tempfile.mkdtemp(prefix="srtpu-trace-gate-")
+server_logs = os.path.join(d, "server")
+client_logs = os.path.join(d, "client")
+os.makedirs(server_logs); os.makedirs(client_logs)
+sock = os.path.join(d, "tpu.sock")
+
+# data + a FilterExec(v > 0) over FileSourceScanExec plan (test_service idiom)
+rng = np.random.default_rng(7)
+n = 2000
+t = pa.table({"k": pa.array(rng.integers(0, 50, n).astype(np.int64)),
+              "v": pa.array(rng.normal(0.1, 1.0, n))})
+data_path = os.path.join(d, "t.parquet")
+pq.write_table(t, data_path)
+attr = lambda name, dt: [
+    {"class": "org.apache.spark.sql.catalyst.expressions."
+     "AttributeReference", "num-children": 0, "name": name,
+     "dataType": dt, "nullable": True, "metadata": {},
+     "exprId": {"id": 1, "jvmId": "x"}, "qualifier": []}]
+plan = json.dumps([
+    {"class": "org.apache.spark.sql.execution.FilterExec",
+     "num-children": 1,
+     "condition": [{"class": "org.apache.spark.sql.catalyst.expressions."
+                    "GreaterThan", "num-children": 2}]
+     + attr("v", "double")
+     + [{"class": "org.apache.spark.sql.catalyst.expressions.Literal",
+         "num-children": 0, "value": "0.0", "dataType": "double"}]},
+    {"class": "org.apache.spark.sql.execution.FileSourceScanExec",
+     "num-children": 0, "relation": "HadoopFsRelation(parquet)",
+     "output": [attr("k", "long"), attr("v", "double")],
+     "tableIdentifier": "t"}])
+
+env = dict(os.environ, JAX_PLATFORMS="cpu",
+           PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+env.pop("XLA_FLAGS", None)
+server = subprocess.Popen(
+    [sys.executable, "-m", "spark_rapids_tpu.service.server",
+     "--socket", sock, "--platform", "cpu",
+     "--conf", "spark.rapids.tpu.telemetry.enabled=true",
+     "--conf", f"spark.rapids.tpu.metrics.eventLog.dir={server_logs}"],
+    cwd=REPO, env=env,
+    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+try:
+    from spark_rapids_tpu.service import TpuServiceClient
+    cli = TpuServiceClient(sock, deadline_s=60.0,
+                           event_log_dir=client_logs).connect()
+    table = cli.run_plan(plan, {"t": [data_path]}, query_id="trace-gate-q")
+    trace = cli.last_trace_id
+    assert table.num_rows > 0 and trace, (table.num_rows, trace)
+    # server health + stats over the socket while it is live
+    health = cli.health()
+    assert health["ok"] and health["device"]["initialized"], health
+    stats = cli.stats()
+    assert "tpu_queries_total" in stats
+    cli.shutdown()
+    cli.close()
+finally:
+    try:
+        server.wait(timeout=15)
+    except subprocess.TimeoutExpired:
+        server.kill(); server.wait()
+
+# both processes' logs exist and share the trace id
+from spark_rapids_tpu.tools.profile_report import load_records, trace_view
+records, problems = load_records([server_logs, client_logs], validate=True)
+assert not problems, problems
+traced = [r for r in records if r.get("trace_id") == trace]
+types = {r["type"] for r in traced}
+assert "query" in types, f"FAIL: no server query record for trace {trace}"
+assert any(r["type"] == "span" and r.get("kind") == "service"
+           for r in traced), "FAIL: no client-side record for the trace"
+view = trace_view(records, trace=trace)
+assert "client:run_plan" in view and "server query" in view, view
+procs = {l.split()[1] for l in view.splitlines()
+         if l.startswith("+") or l.startswith("-")}
+assert len(procs) >= 2, f"FAIL: one process in the stitched view:\n{view}"
+print(view)
+print("trace-correlation gate OK")
+EOF
+
+echo "telemetry matrix: all gates passed"

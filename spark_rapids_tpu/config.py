@@ -130,6 +130,55 @@ register("spark.rapids.tpu.metrics.spans.kernel.enabled", "bool", False,
          "Also record one span per compiled-kernel invocation (kind="
          "'kernel'). High-cardinality: one record per batch per kernel; "
          "meant for deep dives, not steady-state profiling.")
+register("spark.rapids.tpu.metrics.eventLog.maxBytes", "bytes", 0,
+         "Size cap for the live per-process event-log file: an append "
+         "that would push it past this rotates the file to '.1' "
+         "(shifting older generations to '.2', ...), bounding a long-"
+         "lived server's log on disk. 0 (default) keeps the historical "
+         "unbounded append. profile_report reads rotated generations "
+         "alongside live files.")
+register("spark.rapids.tpu.metrics.eventLog.maxFiles", "int", 10,
+         "Rotated event-log generations kept per process ('.1'..'.N'); "
+         "the oldest falls off at the next rotation.")
+
+# Live telemetry ---------------------------------------------------------------------
+register("spark.rapids.tpu.telemetry.enabled", "bool", False,
+         "Live telemetry: the process-wide metrics registry (scheduler "
+         "depth/wait, memory, spill tiers, compile cache, shuffle data "
+         "plane, per-op throughput), the /metrics + /healthz surface "
+         "(HTTP and the service-protocol stats/health ops), and the "
+         "incident flight recorder. Off (default) spawns zero threads "
+         "and keeps every hot-path hook at one module-global check "
+         "(scripts/telemetry_matrix.sh gates it).")
+register("spark.rapids.tpu.telemetry.http.port", "int", -1,
+         "Port for the stdlib HTTP scrape thread serving /metrics "
+         "(Prometheus text) and /healthz (JSON). -1 (default) disables "
+         "the HTTP thread entirely — socket-only deployments use the "
+         "service-protocol stats/health ops instead; 0 binds an "
+         "ephemeral port (tests read it back).")
+register("spark.rapids.tpu.telemetry.http.host", "string", "127.0.0.1",
+         "Bind address for the telemetry HTTP thread.")
+register("spark.rapids.tpu.telemetry.labels.maxCardinality", "int", 64,
+         "Max distinct label sets per metric family; further label "
+         "values collapse into the '__overflow__' series (totals stay "
+         "exact, attribution coarsens) so no label feed can grow the "
+         "registry without bound.")
+register("spark.rapids.tpu.telemetry.flightRecorder.capacity", "int", 2048,
+         "Events held in the incident flight-recorder ring (the most "
+         "recent N engine events dumped when a query dies terminally).")
+register("spark.rapids.tpu.telemetry.flightRecorder.dir", "string", "",
+         "Directory for incident dumps (schema-validated JSONL, one "
+         "'incident' header + the ring's 'event' records). Empty falls "
+         "back to spark.rapids.tpu.metrics.eventLog.dir; with neither "
+         "set, dumps are disabled (the ring still records).")
+register("spark.rapids.tpu.telemetry.flightRecorder.rejectStormThreshold",
+         "int", 8,
+         "Admission rejections within rejectStormWindowSec that count as "
+         "a storm and trigger an incident dump (shed queries die without "
+         "profiles; the storm dump is their evidence).")
+register("spark.rapids.tpu.telemetry.flightRecorder.rejectStormWindowSec",
+         "double", 10.0,
+         "Sliding window for rejection-storm detection.")
 register("spark.rapids.sql.castFloatToString.enabled", "bool", True,
          "Enable float->string cast (Spark-format float printing on host path).")
 register("spark.rapids.sql.castStringToFloat.enabled", "bool", True,

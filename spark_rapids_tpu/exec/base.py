@@ -198,6 +198,8 @@ class PrefetchIterator:
         self._ctx = _qctx.current()  # the consumer's query context
         self._tm.prefetch_threads += 1
         PREFETCH_THREADS_STARTED += 1
+        from .. import telemetry
+        telemetry.register_prefetch(self)  # queue-occupancy gauge
         self._thread = threading.Thread(
             target=self._produce, name=f"srtpu-{name}", daemon=True)
         self._thread.start()
@@ -223,6 +225,8 @@ class PrefetchIterator:
                 item = SpillableColumnarBatch(batch)
                 del batch
                 self._tm.prefetch_batches += 1
+                from .. import telemetry
+                telemetry.inc("tpu_prefetch_batches_total")
                 if not self._put(item):
                     item.close()  # consumer is gone
                     return

@@ -133,13 +133,22 @@ class MemoryBudget:
             if self._try_charge_locked(tenant, nbytes):
                 return
             if freed > 0:
+                from .. import telemetry
+                telemetry.flight("memory", "oom_pressure", need=nbytes,
+                                 used=self.used, spilled=freed)
                 raise RetryOOM(
                     f"device memory pressure: need {nbytes}, "
                     f"used {self.used}/{self.total} (spilled {freed})")
             used = self.used
         # terminal OOM: dump OUTSIDE the lock (file IO must not stall
-        # concurrent reserve/release), then raise
+        # concurrent reserve/release), then raise. The flight event is the
+        # lead-up evidence; the INCIDENT dump fires only if the OOM
+        # escapes the query (plugin.py) — a split/degrade recovery here
+        # must not spam incident files.
         self._maybe_oom_dump(nbytes)
+        from .. import telemetry
+        telemetry.flight("memory", "oom_exhausted", need=nbytes, used=used,
+                         total=self.total, spilled=freed)
         raise SplitAndRetryOOM(
             f"device memory exhausted: need {nbytes}, "
             f"used {used}/{self.total}, nothing left to spill")

@@ -222,6 +222,8 @@ class BufferCatalog:
                 e.tier = StorageTier.HOST
                 self.host_used += e.host_bytes
             TaskMetrics.get().spill_to_host_ns += time.monotonic_ns() - t0
+            from .. import telemetry
+            telemetry.inc("tpu_spill_bytes_total", e.nbytes, tier="host")
             from .budget import MemoryBudget
             # global only: the buffer belongs to whoever parked it, not
             # to the context active on the spilling thread (its tenant
@@ -256,6 +258,8 @@ class BufferCatalog:
             e.tier = StorageTier.DISK
             self.host_used -= e.host_bytes
         TaskMetrics.get().spill_to_disk_ns += time.monotonic_ns() - t0
+        from .. import telemetry
+        telemetry.inc("tpu_spill_bytes_total", e.host_bytes, tier="disk")
 
     def _disk_to_host(self, e: _Entry) -> None:
         import pickle

@@ -34,6 +34,11 @@ class TpuSession:
             return
         from . import faults
         faults.install_from_conf(self.conf)
+        from . import telemetry
+        # live telemetry (registry/exporter/flight recorder): a no-op
+        # unless spark.rapids.tpu.telemetry.enabled — the off path must
+        # create no state and spawn no threads (telemetry_matrix.sh gate)
+        telemetry.configure(self.conf)
         from .compile import CompileService
         # compile service first: warmup precompiles on a background thread
         # while the rest of init (and the first plan rewrite) proceeds
@@ -118,17 +123,21 @@ class TpuSession:
                             if deadline_ms > 0 else None)
 
     def execute_plan(self, plan: PhysicalPlan,
-                     use_device: Optional[bool] = None, sched_ctx=None):
+                     use_device: Optional[bool] = None, sched_ctx=None,
+                     trace_id: Optional[str] = None):
         """Run a CPU plan through the override rewrite and execute; returns a
         pyarrow Table. `sched_ctx` (sched.QueryContext) carries an explicit
         tenant/priority/deadline/cancel-token for this query (the device
         service builds one per run_plan); otherwise the session conf's
-        spark.rapids.tpu.sched.* keys apply."""
+        spark.rapids.tpu.sched.* keys apply. `trace_id` (or the context's)
+        correlates this query's profile/flight records with the peer
+        process that submitted it; absent, one is minted at query start."""
         import pyarrow as pa
         from .cpu.hostbatch import host_batch_to_arrow
         from .exec.base import TpuExec
         from .exec.transitions import device_batch_to_host
         from .plan.nodes import _concat_host
+        from .utils import spans
 
         from .plan import nodes as _nodes
         _nodes.set_ansi_mode(self.conf.is_ansi)
@@ -141,11 +150,16 @@ class TpuSession:
             return self._execute_rewritten(plan, enabled)
 
         ctx = sched_ctx or self._sched_context()
-        if ctx is None:
-            return run()
-        from .sched import activate
-        with activate(ctx):
-            return run()
+        tid = trace_id or (ctx.trace_id if ctx is not None else None) \
+            or spans.new_trace_id()
+        if ctx is not None and ctx.trace_id is None:
+            ctx.trace_id = tid
+        with spans.trace_scope(tid):
+            if ctx is None:
+                return run()
+            from .sched import activate
+            with activate(ctx):
+                return run()
 
     def _execute_rewritten(self, plan: PhysicalPlan,
                            use_device: Optional[bool] = None):
@@ -169,8 +183,11 @@ class TpuSession:
             result = plan
 
         if isinstance(result, TpuExec):
+            from . import telemetry
             from .errors import (CpuFallbackRequired, DeadlineExceededError,
-                                 QueryCancelledError, QueryRejectedError)
+                                 InjectedFault, QueryCancelledError,
+                                 QueryRejectedError, RetryOOM,
+                                 SplitAndRetryOOM)
             from .utils import spans
             from .utils.metrics import TaskMetrics
             # fresh counters per query: the explain line below must report
@@ -186,6 +203,12 @@ class TpuSession:
                     "spark.rapids.tpu.metrics.profile.enabled"):
                 prof = spans.begin_profile(label=result.name)
                 prof.attach_plan(result)
+            # live telemetry: per-op MetricsSet baselines (throughput
+            # deltas fed at query end) + the query flight event; both are
+            # one branch when telemetry is off
+            op_baselines = telemetry.ops_baseline(result)
+            q_status = "ok"
+            telemetry.flight("query", "begin", label=result.name)
             try:
                 from .sched import context as _qctx
                 if _qctx.current() is not None:
@@ -230,7 +253,17 @@ class TpuSession:
                 # by design, so TaskMetrics must make them visible
                 # (explain_string + profile report).
                 TaskMetrics.get().cpu_fallback_reruns += 1
-                host_batches = list(plan.execute_cpu())
+                telemetry.inc("tpu_cpu_fallback_reruns_total")
+                telemetry.flight("query", "cpu_fallback_rerun",
+                                 label=result.name)
+                try:
+                    host_batches = list(plan.execute_cpu())
+                except BaseException:
+                    # the rescue re-run ITSELF failed: exceptions inside
+                    # this handler bypass the status-stamping clauses
+                    # below, so stamp here or the finally records "ok"
+                    q_status = "error"
+                    raise
                 if self.conf.explain != "NONE":
                     tm_line = TaskMetrics.get().explain_string()
                     if tm_line:
@@ -241,12 +274,37 @@ class TpuSession:
                 # killed/shed query's event log says so, then re-raise —
                 # the finally below still reclaims admission and closes
                 # the profile
+                q_status = (
+                    "cancelled" if isinstance(e, QueryCancelledError)
+                    else "deadline"
+                    if isinstance(e, DeadlineExceededError)
+                    else "rejected")
                 if prof is not None:
-                    prof.status = (
-                        "cancelled" if isinstance(e, QueryCancelledError)
-                        else "deadline"
-                        if isinstance(e, DeadlineExceededError)
-                        else "rejected")
+                    prof.status = q_status
+                # flight-recorder evidence for queries that died without a
+                # profile: deadline/cancel dump immediately; rejections
+                # count toward the storm detector (count_rejection at the
+                # admission queue), not one dump per shed query
+                if q_status in ("cancelled", "deadline"):
+                    telemetry.incident(q_status, label=result.name,
+                                       message=str(e))
+                raise
+            except (RetryOOM, SplitAndRetryOOM) as e:
+                # a memory-pressure error ESCAPING the query is terminal:
+                # every retry/split/spill rung below it gave up. This is
+                # the black-box moment — the profile never lands because
+                # the query never finishes
+                q_status = "oom"
+                telemetry.incident("terminal_oom", label=result.name,
+                                   error=type(e).__name__, message=str(e))
+                raise
+            except InjectedFault as e:
+                q_status = "error"
+                telemetry.incident("injected_fault", label=result.name,
+                                   message=str(e))
+                raise
+            except BaseException:
+                q_status = "error"
                 raise
             finally:
                 from .sched import context as _qctx
@@ -258,13 +316,24 @@ class TpuSession:
                     # per-thread hold semantics untouched.
                     from .memory.semaphore import TpuSemaphore
                     TpuSemaphore.get().complete_task()
+                telemetry.ops_finish(op_baselines)
+                telemetry.inc("tpu_queries_total", status=q_status)
+                telemetry.flight("query", "end", label=result.name,
+                                 status=q_status)
                 if prof is not None:
                     spans.end_profile(prof)
                     prof.finish(TaskMetrics.get())
                     self._last_profile = prof
                     if log_dir:
                         try:
-                            spans.write_event_log(prof, log_dir)
+                            spans.write_event_log(
+                                prof, log_dir,
+                                max_bytes=self.conf.get(
+                                    "spark.rapids.tpu.metrics.eventLog."
+                                    "maxBytes"),
+                                max_files=self.conf.get(
+                                    "spark.rapids.tpu.metrics.eventLog."
+                                    "maxFiles"))
                         except OSError as e:
                             # the profiler must never fail the query
                             import warnings

@@ -128,11 +128,17 @@ class QueryContext:
     def __init__(self, tenant: str = "default", priority: int = 0,
                  deadline_s: Optional[float] = None,
                  token: Optional[CancelToken] = None,
-                 query_id: Optional[str] = None):
+                 query_id: Optional[str] = None,
+                 trace_id: Optional[str] = None):
         self.tenant = tenant or "default"
         self.priority = int(priority)
         self.token = token or CancelToken(deadline_s)
         self.query_id = query_id or f"q{next(QueryContext._qid_counter)}"
+        # cross-process trace correlation: a service run_plan header's
+        # trace id lands here and plugin.TpuSession scopes it around the
+        # query, so server-side profile/flight records share the client's
+        # id. None = the session mints one at query start.
+        self.trace_id = trace_id
 
 
 def current() -> Optional[QueryContext]:

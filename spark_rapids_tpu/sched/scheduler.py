@@ -37,20 +37,31 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from typing import Callable, Dict, List, Optional
 
-from .. import faults
+from .. import faults, telemetry
 from ..errors import (DeadlineExceededError, QueryCancelledError,
                       QueryRejectedError)
 from . import context as _ctx
 
 __all__ = ["AdmissionQueue", "QueryScheduler", "ABANDONED",
-           "parse_tenant_map"]
+           "parse_tenant_map", "live_admission_queues"]
 
 # returned by acquire() when the `alive` probe said the caller is gone
 ABANDONED = object()
 
 _STRIDE = 1 << 20
+
+# every constructed AdmissionQueue, weakly — the telemetry depth/holders
+# gauges and the healthz alive probe walk the LIVE ones without the
+# telemetry layer having to know which doors exist (in-process semaphore,
+# service _Admission, tests)
+_LIVE_QUEUES: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def live_admission_queues() -> List["AdmissionQueue"]:
+    return list(_LIVE_QUEUES)
 
 
 def parse_tenant_map(spec: str) -> Dict[str, float]:
@@ -107,6 +118,7 @@ class AdmissionQueue:
         # observability: deepest queue ever seen + lifetime shed count
         self.peak_depth = 0
         self.shed_count = 0
+        _LIVE_QUEUES.add(self)
 
     # ------------------------------------------------------------------
     def _depth_locked(self) -> int:
@@ -201,6 +213,7 @@ class AdmissionQueue:
         except Exception as e:  # degrade, never crash the admission door
             with self.cv:
                 self.shed_count += 1
+            telemetry.count_rejection(tenant)
             raise QueryRejectedError(
                 f"admission degraded by injected fault: "
                 f"{type(e).__name__}: {e}",
@@ -216,6 +229,7 @@ class AdmissionQueue:
             depth = self._depth_locked()
             if apply_shed and self.max_depth and depth >= self.max_depth:
                 self.shed_count += 1
+                telemetry.count_rejection(tenant)
                 raise QueryRejectedError(
                     f"admission queue full: depth {depth} >= max "
                     f"{self.max_depth} "
@@ -270,6 +284,11 @@ class AdmissionQueue:
                             return None
                         if token is not None and token.expired:
                             self._remove_locked(w)
+                            telemetry.inc("tpu_sched_deadline_total",
+                                          tenant=tenant)
+                            telemetry.observe(
+                                "tpu_sched_admission_wait_seconds",
+                                waited, tenant=tenant)
                             raise DeadlineExceededError(
                                 f"query deadline of {token.deadline_s}s "
                                 f"expired after {waited:.3f}s in the "
@@ -277,6 +296,7 @@ class AdmissionQueue:
                                 deadline_s=token.deadline_s)
                         self.shed_count += 1
                         self._remove_locked(w)
+                        telemetry.count_rejection(tenant)
                         raise QueryRejectedError(
                             f"admission queue wait {waited * 1e3:.0f}ms "
                             f"exceeded max "
@@ -296,11 +316,20 @@ class AdmissionQueue:
                     if token is not None and \
                             (token.cancelled or token.expired):
                         self._remove_locked(w)
+                        telemetry.inc(
+                            "tpu_sched_cancelled_total" if token.cancelled
+                            else "tpu_sched_deadline_total", tenant=tenant)
+                        telemetry.observe(
+                            "tpu_sched_admission_wait_seconds",
+                            time.monotonic() - t0, tenant=tenant)
                         token.check()  # raises the matching typed error
                     if alive is not None and not alive():
                         self._remove_locked(w)
                         return ABANDONED
                 self._waiters.remove(w)
+                telemetry.inc("tpu_sched_admissions_total", tenant=tenant)
+                telemetry.observe("tpu_sched_admission_wait_seconds",
+                                  time.monotonic() - t0, tenant=tenant)
                 return w.order
         except BaseException:
             with self.cv:

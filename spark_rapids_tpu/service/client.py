@@ -8,6 +8,7 @@ instead of hanging the worker, reusing the round-3 fail-fast contract
 
 from __future__ import annotations
 
+import os
 import socket
 import time
 from typing import Dict, Optional, Sequence
@@ -21,9 +22,27 @@ __all__ = ["TpuServiceClient"]
 
 
 class TpuServiceClient:
-    def __init__(self, socket_path: str, deadline_s: float = 60.0):
+    """`event_log_dir` (or SPARK_RAPIDS_TPU_CLIENT_EVENTLOG_DIR) makes the
+    client write one v2 event-log record per run_plan — the CLIENT half of
+    cross-process trace correlation: the record carries the same trace id
+    the request header shipped to the server, so
+    `profile_report.py --trace` over both processes' logs stitches the
+    round trip into one timeline."""
+
+    def __init__(self, socket_path: str, deadline_s: float = 60.0,
+                 event_log_dir: Optional[str] = None,
+                 event_log_max_bytes: int = 0,
+                 event_log_max_files: int = 10):
         self.socket_path = socket_path
         self.deadline_s = deadline_s
+        self.event_log_dir = event_log_dir or os.environ.get(
+            "SPARK_RAPIDS_TPU_CLIENT_EVENTLOG_DIR") or None
+        # same rotation contract as the server's event log (a long-lived
+        # worker's log is the same unbounded-growth problem)
+        self.event_log_max_bytes = event_log_max_bytes or int(os.environ.get(
+            "SPARK_RAPIDS_TPU_CLIENT_EVENTLOG_MAX_BYTES", "0") or 0)
+        self.event_log_max_files = event_log_max_files
+        self.last_trace_id: Optional[str] = None
         self._sock: Optional[socket.socket] = None
 
     # ------------------------------------------------------------------
@@ -92,7 +111,8 @@ class TpuServiceClient:
 
     def acquire(self, timeout: Optional[float] = None,
                 priority: int = 0, tenant: Optional[str] = None,
-                deadline_s: Optional[float] = None) -> int:
+                deadline_s: Optional[float] = None,
+                trace_id: Optional[str] = None) -> int:
         """Block until admitted; returns the global admission order. A
         server-side admission timeout raises AdmissionTimeoutError with the
         held/waiting contention diagnostics from the reply; a scheduler
@@ -106,6 +126,8 @@ class TpuServiceClient:
             hdr["tenant"] = tenant
         if deadline_s:
             hdr["deadline_s"] = deadline_s
+        if trace_id:
+            hdr["trace"] = trace_id
         rep, _ = self._request(hdr)
         if not rep.get("ok"):
             self._raise_typed(rep)
@@ -126,14 +148,20 @@ class TpuServiceClient:
                  = None, use_device: bool = True,
                  query_id: Optional[str] = None, priority: int = 0,
                  tenant: Optional[str] = None,
-                 deadline_s: Optional[float] = None):
+                 deadline_s: Optional[float] = None,
+                 trace_id: Optional[str] = None):
         """Submit a Spark executedPlan.toJSON; returns a pyarrow Table.
         `query_id` registers the run for the `cancel` op (issued from a
         DIFFERENT connection); priority/tenant/deadline_s attach the
         scheduling context the engine enforces (typed errors on
-        cancel/deadline/shed)."""
+        cancel/deadline/shed). A trace id (given or minted, see
+        `last_trace_id`) rides the header so the server's profile/flight
+        records correlate with this call."""
+        from ..utils import spans
+        trace = trace_id or spans.current_trace() or spans.new_trace_id()
+        self.last_trace_id = trace
         hdr = {"op": "run_plan", "plan": plan_json, "paths": paths or {},
-               "use_device": use_device}
+               "use_device": use_device, "trace": trace}
         if query_id:
             hdr["query_id"] = query_id
         if priority:
@@ -142,11 +170,41 @@ class TpuServiceClient:
             hdr["tenant"] = tenant
         if deadline_s:
             hdr["deadline_s"] = deadline_s
-        rep, body = self._request(hdr)
-        if not rep.get("ok"):
-            self._raise_typed(rep)
-            raise RuntimeError(rep.get("unsupported") or rep.get("error"))
-        return ipc_to_table(body)
+        t0 = time.monotonic_ns()
+        status = "ok"
+        try:
+            rep, body = self._request(hdr)
+            if not rep.get("ok"):
+                status = rep.get("error_type") or "error"
+                self._raise_typed(rep)
+                raise RuntimeError(rep.get("unsupported")
+                                   or rep.get("error"))
+            return ipc_to_table(body)
+        except BaseException:
+            if status == "ok":
+                status = "error"
+            raise
+        finally:
+            self._log_client_op("run_plan", trace,
+                                time.monotonic_ns() - t0, status,
+                                query_id=query_id or "")
+
+    def _log_client_op(self, op: str, trace: str, dur_ns: int,
+                       status: str, **attrs) -> None:
+        """Best-effort client-side event-log record (no event_log_dir =
+        no-op; a logging failure never fails the call)."""
+        if not self.event_log_dir:
+            return
+        try:
+            from ..utils import spans
+            spans.write_client_record(
+                self.event_log_dir,
+                spans.client_op_record(op, trace, dur_ns, status=status,
+                                       socket=self.socket_path, **attrs),
+                max_bytes=self.event_log_max_bytes,
+                max_files=self.event_log_max_files)
+        except Exception:
+            pass
 
     def cancel(self, query_id: str, priority: Optional[int] = None,
                reason: str = "") -> dict:
@@ -163,6 +221,24 @@ class TpuServiceClient:
         if not rep.get("ok"):
             raise KeyError(rep.get("error", f"cancel {query_id!r} failed"))
         return rep
+
+    def stats(self) -> str:
+        """Scrape the server's metrics registry over the socket: returns
+        the same Prometheus text the HTTP /metrics endpoint serves.
+        Raises RuntimeError when the server runs with telemetry off."""
+        rep, body = self._request({"op": "stats"})
+        if not rep.get("ok"):
+            raise RuntimeError(rep.get("error", "stats unavailable"))
+        return body.decode("utf-8")
+
+    def health(self) -> dict:
+        """The server's /healthz snapshot (device init state, admission
+        alive probe, heartbeat peers, event-log writability). Works
+        regardless of the server's telemetry switch."""
+        rep, _ = self._request({"op": "health"})
+        if not rep.get("ok"):
+            raise RuntimeError(rep.get("error", "health unavailable"))
+        return rep["health"]
 
     def shutdown(self) -> None:
         self._request({"op": "shutdown"})

@@ -187,6 +187,10 @@ class TpuDeviceService:
                     self._run_plan(conn, header)
                 elif op == "cancel":
                     self._handle_cancel(conn, header)
+                elif op == "stats":
+                    self._handle_stats(conn)
+                elif op == "health":
+                    self._handle_health(conn)
                 elif op == "shutdown":
                     send_msg(conn, {"ok": True})
                     self._stop.set()
@@ -205,12 +209,16 @@ class TpuDeviceService:
         records the hold, then replies), ABANDONED when the client died
         while queued (caller unwinds), or None after a non-grant reply
         (timeout/shed/deadline) was already sent."""
-        from .. import faults
+        from .. import faults, telemetry
         token = None
         deadline_s = header.get("deadline_s")
         if deadline_s:
             from ..sched import CancelToken
             token = CancelToken(deadline_s)
+        telemetry.flight("service", "acquire",
+                         trace_id=header.get("trace") or "",
+                         tenant=header.get("tenant") or "default",
+                         priority=int(header.get("priority") or 0))
         try:
             try:
                 faults.fire(faults.ADMISSION)
@@ -274,6 +282,30 @@ class TpuDeviceService:
                             "kill", new_priority is None)),
                         "priority": ctx.priority})
 
+    def _handle_stats(self, conn: socket.socket) -> None:
+        """`stats` op: the Prometheus text scrape as the reply BODY — a
+        client that only reaches the server by socket polls the same
+        families the HTTP /metrics endpoint serves."""
+        from .. import telemetry
+        if not telemetry.is_enabled():
+            send_msg(conn, {
+                "ok": False,
+                "error": "telemetry disabled "
+                         "(spark.rapids.tpu.telemetry.enabled)",
+                "error_type": "telemetry_disabled"})
+            return
+        body = telemetry.render_prometheus().encode("utf-8")
+        send_msg(conn, {"ok": True, "lines": len(body.splitlines())}, body)
+
+    def _handle_health(self, conn: socket.socket) -> None:
+        """`health` op: the /healthz snapshot (device init state,
+        admission-door alive probe, heartbeat-known peers, event-log
+        writability). Answers regardless of the telemetry switch — a
+        liveness probe that needs a conf flag to answer is useless."""
+        from ..telemetry import health_snapshot
+        snap = health_snapshot(self.session.conf)
+        send_msg(conn, {"ok": True, "health": snap})
+
     def _concurrent_ok(self) -> bool:
         """Scheduled run_plans may execute concurrently only when the
         server conf runs the scheduler (the admission door that orders
@@ -302,13 +334,15 @@ class TpuDeviceService:
                                               translate_spark_plan)
         ctx = None
         qid = header.get("query_id")
+        trace = header.get("trace") or None
         if qid or header.get("priority") or header.get("tenant") \
                 or header.get("deadline_s"):
             ctx = QueryContext(
                 tenant=header.get("tenant") or "default",
                 priority=int(header.get("priority") or 0),
                 deadline_s=header.get("deadline_s"),
-                query_id=qid)
+                query_id=qid,
+                trace_id=trace)
             if qid:
                 with self._queries_mu:
                     self._queries[qid] = ctx
@@ -330,7 +364,8 @@ class TpuDeviceService:
                 # concurrentGpuTasks.
                 table = self.session.execute_plan(plan,
                                                   use_device=use_device,
-                                                  sched_ctx=ctx)
+                                                  sched_ctx=ctx,
+                                                  trace_id=trace)
             else:
                 # scheduler-off servers keep the historical one-at-a-time
                 # execution even for context-carrying requests ('FIFO
@@ -342,7 +377,8 @@ class TpuDeviceService:
                 # cross-attribute spans.
                 with self._exec_lock:
                     table = self.session.execute_plan(
-                        plan, use_device=use_device, sched_ctx=ctx)
+                        plan, use_device=use_device, sched_ctx=ctx,
+                        trace_id=trace)
             send_msg(conn, {"ok": True, "num_rows": table.num_rows},
                      table_to_ipc(table))
         except UnsupportedSparkPlan as e:

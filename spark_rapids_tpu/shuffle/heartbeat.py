@@ -11,7 +11,16 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
+import weakref
 from typing import Dict, List, Optional
+
+# live registries, weakly — the telemetry /healthz probe reports
+# heartbeat-known live peers without owning a manager reference
+_LIVE_MANAGERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def live_heartbeat_managers() -> List["HeartbeatManager"]:
+    return list(_LIVE_MANAGERS)
 
 
 @dataclasses.dataclass
@@ -37,6 +46,7 @@ class HeartbeatManager:
         self._expiry = expiry_seconds
         self._clock = clock
         self._lock = threading.Lock()
+        _LIVE_MANAGERS.add(self)
 
     def register_executor(self, executor_id: str,
                           endpoint: str) -> List[PeerInfo]:

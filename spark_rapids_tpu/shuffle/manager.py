@@ -295,6 +295,8 @@ class _MultithreadedWriter:
                     first = e
         self._futures.clear()
         TaskMetrics.get().shuffle_bytes_written += nbytes
+        from .. import telemetry
+        telemetry.inc("tpu_shuffle_write_bytes_total", nbytes)
         if first is not None:
             raise first
 
@@ -448,6 +450,8 @@ class TpuShuffleManager:
                 tm.shuffle_fetch_wait_ns += time.monotonic_ns() - t0
             nbytes = sum(len(d) for d in frames.values())
             tm.shuffle_bytes_read += nbytes
+            from .. import telemetry
+            telemetry.inc("tpu_shuffle_fetch_bytes_total", nbytes)
             sp.inc(bytes=nbytes, blocks=len(frames))
         if release:
             for bid in local:
@@ -529,6 +533,8 @@ class TpuShuffleManager:
             return data
         except ShuffleCorruptionError:
             TaskMetrics.get().shuffle_refetch_count += 1
+            from .. import telemetry
+            telemetry.inc("tpu_shuffle_fetch_refetches_total")
             data = self.block_store.get(bid)
             if data is None:
                 raise
@@ -567,6 +573,9 @@ class TpuShuffleManager:
         client.fetch_blocks(list(wanted), on_block)
         if corrupt:
             TaskMetrics.get().shuffle_refetch_count += len(corrupt)
+            from .. import telemetry
+            telemetry.inc("tpu_shuffle_fetch_refetches_total",
+                          len(corrupt))
             refetch = ShuffleClient(self.transport.connect(peer),
                                     self.bounce_buffers)
 
@@ -601,6 +610,10 @@ class TpuShuffleManager:
                 last_exc = e
                 if attempt < self.fetch_max_retries:
                     TaskMetrics.get().shuffle_retry_count += 1
+                    from .. import telemetry
+                    telemetry.inc("tpu_shuffle_fetch_retries_total")
+                    telemetry.flight("shuffle", "fetch_retry",
+                                     peer=peer, attempt=attempt + 1)
                     # deadline-aware: a retrying fetch must not outlive
                     # its query's deadline — the backoff sleeps only
                     # when it fits in the remaining deadline and fails
@@ -634,6 +647,10 @@ class TpuShuffleManager:
                     continue
             if not missing:
                 TaskMetrics.get().shuffle_failover_count += 1
+                from .. import telemetry
+                telemetry.inc("tpu_shuffle_fetch_failovers_total")
+                telemetry.flight("shuffle", "fetch_failover",
+                                 peer=peer)
                 return recovered
         raise ShuffleFetchFailedError(
             f"shuffle fetch from peer {peer!r} failed after {attempts} "

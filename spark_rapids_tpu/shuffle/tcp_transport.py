@@ -97,6 +97,13 @@ class TcpShuffleServer:
 
     def _handle(self, conn: socket.socket, header: dict) -> None:
         op = header.get("op")
+        if header.get("trace"):
+            # cross-process correlation: the requesting query's trace id
+            # rides the fetch metadata; the serving side's flight recorder
+            # keeps it so an incident here names the query it served
+            from .. import telemetry
+            telemetry.flight("shuffle", f"serve:{op}",
+                             trace_id=header["trace"])
         if op == "list":
             blocks = self.server.handle_list_blocks(
                 int(header["shuffle_id"]), int(header["reduce_id"]))
@@ -144,6 +151,10 @@ class _TcpConnection(ClientConnection):
 
     def _request(self, header: dict) -> Tuple[dict, bytes]:
         from .. import faults
+        from ..utils import spans
+        trace = spans.current_trace()
+        if trace:
+            header = dict(header, trace=trace)
         with self._lock:  # one in-flight request per connection
             if self._dead:
                 raise IOError("shuffle connection is closed (a previous "

@@ -1,0 +1,458 @@
+"""Live telemetry: process-wide metrics registry, health/scrape surface,
+and the incident flight recorder (ARCHITECTURE.md "Live telemetry").
+
+The query profiler (PR 4, utils/spans.py) answers "what did THAT query
+do" after it finishes; this package answers "what is the ENGINE doing
+right now" for a long-lived multi-tenant `TpuDeviceService`: scrapeable
+counters/gauges/histograms fed from the existing seams, `/metrics` +
+`/healthz` over HTTP and the service protocol, and a black-box ring that
+dumps evidence when a query dies instead of finishing.
+
+Layout:
+  * `registry.py`  — counters/gauges/bounded-label histograms, Prometheus
+    text render + parse-back.
+  * `exporter.py`  — health snapshot + opt-in stdlib HTTP thread.
+  * `recorder.py`  — flight-recorder ring + schema-validated incident
+    dumps.
+  * this module    — the facade the engine seams call. Off-path contract
+    (mirrors faults._ACTIVE / sched.context._ACTIVE): with
+    `spark.rapids.tpu.telemetry.enabled=false` (default) every hook below
+    is one module-global bool check, no registry/recorder/HTTP objects
+    exist, and zero threads are spawned — scripts/telemetry_matrix.sh
+    gates it.
+
+`configure(conf)` only ever ENABLES (idempotent); `shutdown()` tears
+down explicitly (tests) — a second session with telemetry off must not
+yank the surface out from under the session that turned it on.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any, Dict, List, Optional
+
+from .exporter import TelemetryHttpServer, health_snapshot
+from .recorder import FlightRecorder
+from .registry import (DEFAULT_BUCKETS, OVERFLOW_LABEL, MetricsRegistry,
+                       parse_prometheus)
+
+__all__ = ["configure", "shutdown", "is_enabled", "registry",
+           "flight_recorder", "http_server", "render_prometheus",
+           "health_snapshot", "inc", "set_gauge", "observe", "flight",
+           "count_rejection", "incident", "ops_baseline", "ops_finish",
+           "register_prefetch", "MetricsRegistry", "FlightRecorder",
+           "TelemetryHttpServer", "parse_prometheus", "OVERFLOW_LABEL",
+       ]
+
+_ACTIVE = False
+_mu = threading.Lock()
+_registry: Optional[MetricsRegistry] = None
+_recorder: Optional[FlightRecorder] = None
+_http: Optional[TelemetryHttpServer] = None
+_conf = None
+
+# live PrefetchIterators (exec/base.py registers when telemetry is on) for
+# the queue-occupancy gauge; weak so a leaked iterator cannot pin batches
+_prefetch_iters: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def is_enabled() -> bool:
+    return _ACTIVE
+
+
+def registry() -> Optional[MetricsRegistry]:
+    return _registry
+
+
+def flight_recorder() -> Optional[FlightRecorder]:
+    return _recorder
+
+
+def http_server() -> Optional[TelemetryHttpServer]:
+    return _http
+
+
+def render_prometheus() -> str:
+    reg = _registry
+    return reg.render() if reg is not None else ""
+
+
+# --------------------------------------------------------------- lifecycle
+def configure(conf) -> None:
+    """Enable telemetry per `spark.rapids.tpu.telemetry.*` (no-op when the
+    switch is off or telemetry is already up). Called from
+    TpuSession.initialize_device."""
+    global _ACTIVE, _registry, _recorder, _http, _conf
+    if not conf.get("spark.rapids.tpu.telemetry.enabled"):
+        return
+    with _mu:
+        if _ACTIVE:
+            _conf = conf
+            return
+        reg = MetricsRegistry(max_series_per_family=conf.get(
+            "spark.rapids.tpu.telemetry.labels.maxCardinality"))
+        _install_families(reg)
+        dump_dir = conf.get(
+            "spark.rapids.tpu.telemetry.flightRecorder.dir") or conf.get(
+            "spark.rapids.tpu.metrics.eventLog.dir") or ""
+        rec = FlightRecorder(
+            capacity=conf.get(
+                "spark.rapids.tpu.telemetry.flightRecorder.capacity"),
+            dump_dir=dump_dir,
+            reject_storm_threshold=conf.get(
+                "spark.rapids.tpu.telemetry.flightRecorder."
+                "rejectStormThreshold"),
+            reject_storm_window_s=conf.get(
+                "spark.rapids.tpu.telemetry.flightRecorder."
+                "rejectStormWindowSec"))
+        _registry, _recorder, _conf = reg, rec, conf
+        _ACTIVE = True
+        from ..utils import spans as _spans
+        _spans.set_flight_hook(_span_flight_hook)
+        port = conf.get("spark.rapids.tpu.telemetry.http.port")
+        if port is not None and port >= 0:
+            try:
+                _http = TelemetryHttpServer(
+                    reg, conf,
+                    host=conf.get("spark.rapids.tpu.telemetry.http.host"),
+                    port=port).start()
+            except OSError:
+                _http = None  # a taken port must not fail device init
+
+
+def shutdown() -> None:
+    """Tear the telemetry surface down (tests / process exit)."""
+    global _ACTIVE, _registry, _recorder, _http, _conf
+    with _mu:
+        _ACTIVE = False
+        from ..utils import spans as _spans
+        _spans.set_flight_hook(None)
+        if _http is not None:
+            _http.stop()
+        _registry = _recorder = _http = _conf = None
+        _prefetch_iters.clear()
+
+
+def _span_flight_hook(sp, prof) -> None:
+    """Every finished profiler span also lands in the incident ring (the
+    'recent span/metric events' half of the flight recorder)."""
+    rec = _recorder
+    if rec is not None:
+        rec.record(sp.kind, sp.name,
+                   trace_id=getattr(prof, "trace_id", "") or "",
+                   attrs=dict(sp.attrs) if sp.attrs else None)
+
+
+# ----------------------------------------------------------- hot-path hooks
+def inc(name: str, value: float = 1.0, **labels: Any) -> None:
+    if not _ACTIVE:
+        return
+    reg = _registry
+    if reg is not None:
+        reg.inc(name, value, **labels)
+
+
+def set_gauge(name: str, value: float, **labels: Any) -> None:
+    if not _ACTIVE:
+        return
+    reg = _registry
+    if reg is not None:
+        reg.set(name, value, **labels)
+
+
+def observe(name: str, value: float, **labels: Any) -> None:
+    if not _ACTIVE:
+        return
+    reg = _registry
+    if reg is not None:
+        reg.observe(name, value, **labels)
+
+
+def flight(kind: str, name: str, trace_id: Optional[str] = None,
+           **attrs: Any) -> None:
+    """Record one flight-recorder event, stamped with `trace_id` or the
+    current trace (spans.current_trace) when one is active."""
+    if not _ACTIVE:
+        return
+    rec = _recorder
+    if rec is not None:
+        if trace_id is None:
+            from ..utils import spans
+            trace_id = spans.current_trace() or ""
+        rec.record(kind, name, trace_id=trace_id, attrs=attrs or None)
+
+
+def count_rejection(tenant: str = "default") -> None:
+    """One admission rejection: counter + flight event + storm detection
+    (threshold crossings dump an incident — overload evidence survives
+    even though every shed query dies without a profile). Callers hold
+    the admission queue's condition variable, so everything here is
+    lock-light; the storm DUMP (file IO) runs on a one-shot thread."""
+    if not _ACTIVE:
+        return
+    reg, rec = _registry, _recorder
+    if reg is not None:
+        reg.inc("tpu_sched_rejected_total", 1, tenant=tenant)
+    if rec is not None:
+        from ..utils import spans
+        rec.record("sched", "reject", trace_id=spans.current_trace() or "",
+                   attrs={"tenant": tenant})
+        if rec.note_rejection():
+            threading.Thread(
+                target=incident, args=("reject_storm",),
+                kwargs={"tenant": tenant}, daemon=True,
+                name="tpu-telemetry-incident").start()
+
+
+def incident(reason: str, **attrs: Any) -> Optional[str]:
+    """Terminal-failure hook: record the event, bump the incident counter,
+    and dump the flight recorder. Returns the dump path (None when
+    disabled/rate-limited)."""
+    if not _ACTIVE:
+        return None
+    from ..utils import spans
+    trace = spans.current_trace() or ""
+    reg, rec = _registry, _recorder
+    if reg is not None:
+        reg.inc("tpu_incidents_total", 1, reason=reason)
+    if rec is None:
+        return None
+    rec.record("incident", reason, trace_id=trace, attrs=attrs or None)
+    return rec.dump(reason, trace_id=trace, attrs=attrs)
+
+
+def register_prefetch(it) -> None:
+    """Track a live PrefetchIterator for the queue-occupancy gauge."""
+    if _ACTIVE:
+        _prefetch_iters.add(it)
+
+
+# -------------------------------------------- per-op throughput (MetricsSet)
+def ops_baseline(root) -> Optional[List[tuple]]:
+    """Snapshot every operator's MetricsSet before execution so
+    `ops_finish` can feed THIS query's deltas (reused exec instances carry
+    prior queries' values) into the per-op throughput counters."""
+    if not _ACTIVE:
+        return None
+    out: List[tuple] = []
+
+    def walk(node):
+        ms = getattr(node, "metrics", None)
+        if ms is not None and hasattr(ms, "snapshot"):
+            out.append((getattr(node, "name", type(node).__name__), ms,
+                        ms.snapshot()))
+        for child in getattr(node, "children", ()):
+            walk(child)
+
+    try:
+        walk(root)
+    except Exception:
+        return None
+    return out
+
+
+def ops_finish(baselines: Optional[List[tuple]]) -> None:
+    if not _ACTIVE or not baselines:
+        return
+    reg = _registry
+    if reg is None:
+        return
+    for name, ms, base in baselines:
+        try:
+            final = ms.snapshot()
+        except Exception:
+            continue
+        rows = final.get("numOutputRows", 0) - base.get("numOutputRows", 0)
+        batches = final.get("numOutputBatches", 0) - \
+            base.get("numOutputBatches", 0)
+        if rows > 0:
+            reg.inc("tpu_op_output_rows_total", rows, op=name)
+        if batches > 0:
+            reg.inc("tpu_op_output_batches_total", batches, op=name)
+
+
+# ------------------------------------------------------------ family setup
+def _install_families(reg: MetricsRegistry) -> None:
+    """Register every metric family once, with gauges sampling the engine
+    singletons at scrape time (guarded reads: a singleton that does not
+    exist yet samples as absent, never constructs)."""
+    # queries / fallback
+    reg.counter("tpu_queries_total",
+                "Queries finished, by terminal status.", ["status"])
+    reg.counter("tpu_cpu_fallback_reruns_total",
+                "Silent CpuFallbackRequired whole-stage re-runs on the "
+                "host engine.")
+    reg.counter("tpu_op_output_rows_total",
+                "Rows produced per operator family (MetricsSet deltas, "
+                "fed at query end).", ["op"])
+    reg.counter("tpu_op_output_batches_total",
+                "Batches produced per operator family.", ["op"])
+    reg.counter("tpu_incidents_total",
+                "Flight-recorder incident dumps triggered, by reason.",
+                ["reason"])
+
+    # scheduler / admission
+    reg.counter("tpu_sched_admissions_total",
+                "Admission grants through any device door.", ["tenant"])
+    reg.counter("tpu_sched_rejected_total",
+                "Load-shed admission rejections (QueryRejectedError).",
+                ["tenant"])
+    reg.counter("tpu_sched_cancelled_total",
+                "Queries cancelled while queued for admission.", ["tenant"])
+    reg.counter("tpu_sched_deadline_total",
+                "Deadline expiries while queued for admission.", ["tenant"])
+    reg.histogram("tpu_sched_admission_wait_seconds",
+                  "Wall time parked in the admission queue before grant "
+                  "or typed unwind.", ["tenant"], buckets=DEFAULT_BUCKETS)
+    reg.gauge("tpu_sched_queue_depth",
+              "Waiters currently queued across live admission queues.",
+              callback=_sched_gauge("depth"))
+    reg.gauge("tpu_sched_holders",
+              "Admission tokens currently held across live queues.",
+              callback=_sched_gauge("holders"))
+    reg.gauge("tpu_sched_peak_depth",
+              "Deepest admission queue ever observed.",
+              callback=_sched_gauge("peak"))
+    reg.gauge("tpu_sched_shed_total",
+              "Lifetime load-shed count across live admission queues.",
+              callback=_sched_gauge("shed"))
+
+    # memory budget + tenant quotas
+    reg.gauge("tpu_memory_budget_bytes",
+              "Device memory budget accounting: total/used/peak bytes.",
+              ["kind"], callback=_budget_gauge)
+    reg.gauge("tpu_memory_tenant_used_bytes",
+              "Per-tenant device sub-quota ledger usage.", ["tenant"],
+              callback=lambda: _tenant_gauge("tenant_used"))
+    reg.gauge("tpu_memory_tenant_quota_bytes",
+              "Per-tenant device sub-quota limits.", ["tenant"],
+              callback=lambda: _tenant_gauge("tenant_quotas"))
+
+    # spill catalog
+    reg.gauge("tpu_catalog_bytes",
+              "Live spillable-buffer bytes by storage tier.", ["tier"],
+              callback=_catalog_tier_gauge)
+    reg.gauge("tpu_catalog_handles",
+              "Live spillable-buffer handles.", callback=_catalog_gauge(
+                  lambda c: c.live_count))
+    reg.gauge("tpu_catalog_host_used_bytes",
+              "Host spill-store bytes in use.", callback=_catalog_gauge(
+                  lambda c: c.host_used))
+    reg.counter("tpu_spill_bytes_total",
+                "Bytes spilled, by destination tier.", ["tier"])
+
+    # compile service
+    reg.gauge("tpu_compile_stats",
+              "Compile-service lifetime accounting "
+              "(hits/misses/compiles/...).", ["event"],
+              callback=_compile_stats_gauge)
+    reg.gauge("tpu_compile_cache_programs",
+              "Programs resident in the in-memory compile cache.",
+              callback=_compile_cache_gauge)
+
+    # shuffle data plane
+    reg.counter("tpu_shuffle_fetch_bytes_total",
+                "Shuffle frame bytes read (local + remote fetch).")
+    reg.counter("tpu_shuffle_write_bytes_total",
+                "Serialized shuffle bytes written to the block store.")
+    reg.counter("tpu_shuffle_fetch_retries_total",
+                "Shuffle fetch retry attempts.")
+    reg.counter("tpu_shuffle_fetch_refetches_total",
+                "Corrupt-frame refetches.")
+    reg.counter("tpu_shuffle_fetch_failovers_total",
+                "Fetches recovered via failover peers.")
+
+    # pipeline
+    reg.counter("tpu_prefetch_batches_total",
+                "Batches moved through pipeline prefetch queues.")
+    reg.gauge("tpu_prefetch_queue_occupancy",
+              "Batches currently parked across live prefetch queues.",
+              callback=_prefetch_gauge)
+
+
+# gauge callbacks: read singletons WITHOUT constructing them ----------------
+def _budget_gauge():
+    from ..memory.budget import MemoryBudget
+    b = MemoryBudget._instance
+    if b is None:
+        return {}
+    return {("total",): b.total, ("used",): b.used, ("peak",): b.peak_used}
+
+
+def _tenant_gauge(field: str):
+    from ..memory.budget import MemoryBudget
+    b = MemoryBudget._instance
+    if b is None:
+        return {}
+    with b._lock:  # concurrent reserve/release mutate the ledgers
+        return {(t,): v for t, v in getattr(b, field).items()}
+
+
+def _catalog_gauge(fn):
+    def cb():
+        from ..memory.catalog import BufferCatalog
+        c = BufferCatalog._instance
+        return fn(c) if c is not None else None
+    return cb
+
+
+def _catalog_tier_gauge():
+    from ..memory.catalog import BufferCatalog
+    c = BufferCatalog._instance
+    if c is None:
+        return {}
+    with c._lock:  # register/remove mutate the dict concurrently
+        entries = list(c._entries.values())
+    per_tier: Dict[tuple, int] = {}
+    for e in entries:
+        key = (e.tier.name,)
+        per_tier[key] = per_tier.get(key, 0) + e.nbytes
+    return per_tier
+
+
+def _compile_stats_gauge():
+    from ..compile.service import CompileService
+    svc = CompileService._instance
+    if svc is None:
+        return {}
+    return {(k,): v for k, v in svc.stats.totals().items()}
+
+
+def _compile_cache_gauge():
+    from ..compile.service import CompileService
+    svc = CompileService._instance
+    return svc.cached_programs() if svc is not None else None
+
+
+def _sched_gauge(which: str):
+    # time-bounded cv acquire: a wedged admission queue (the failure
+    # healthz exists to catch) must skew one sample, never hang every
+    # scrape thread forever on an untimed lock
+    def cb():
+        from ..sched.scheduler import live_admission_queues
+        total = 0
+        for q in live_admission_queues():
+            if which == "peak":
+                total = max(total, q.peak_depth)
+            elif which == "shed":
+                total += q.shed_count
+            elif q.cv.acquire(timeout=0.5):
+                try:
+                    if which == "depth":
+                        total += q._depth_locked()
+                    else:  # holders
+                        total += q.holders
+                finally:
+                    q.cv.release()
+        return total
+    return cb
+
+
+def _prefetch_gauge():
+    total = 0
+    for it in list(_prefetch_iters):
+        q = getattr(it, "_q", None)
+        if q is not None:
+            total += q.qsize()
+    return total

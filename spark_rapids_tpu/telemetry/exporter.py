@@ -1,0 +1,176 @@
+"""Telemetry scrape surface: `/metrics` (Prometheus text) + `/healthz`
+(JSON liveness) on an opt-in stdlib HTTP thread, and the shared health
+snapshot the service-protocol `health` op returns to socket-only clients.
+
+The HTTP server exists only when `spark.rapids.tpu.telemetry.http.port`
+is >= 0 AND telemetry is enabled — the telemetry-off path spawns zero
+threads (CI-gated). Port 0 binds ephemerally (tests read `.port` after
+start); production sets a fixed port.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+__all__ = ["health_snapshot", "TelemetryHttpServer"]
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def health_snapshot(conf=None) -> Dict[str, Any]:
+    """Liveness/readiness snapshot over the engine singletons. Read-only
+    and exception-hardened: a health probe must answer even while the
+    engine is on fire (that is when it matters). `ok` means: the device
+    runtime did not fail startup, every admission queue's lock is
+    acquirable (a scheduler wedged on its own condition variable is the
+    one failure a depth gauge cannot show), and the configured event-log
+    directory is writable."""
+    out: Dict[str, Any] = {"ok": True, "pid": os.getpid()}
+
+    # device init state -------------------------------------------------
+    dev: Dict[str, Any] = {"initialized": False, "name": None,
+                           "startup_error": None}
+    try:
+        from ..memory.device_manager import DeviceManager
+        dev["initialized"] = bool(DeviceManager._initialized)
+        dev["name"] = str(DeviceManager.device) if DeviceManager.device \
+            else None
+        if DeviceManager._startup_error is not None:
+            dev["startup_error"] = str(DeviceManager._startup_error)
+            out["ok"] = False
+    except Exception as e:
+        dev["startup_error"] = f"probe failed: {e}"
+    out["device"] = dev
+
+    # scheduler / admission-door alive probe ----------------------------
+    sched: Dict[str, Any] = {"queues": 0, "alive": True, "depth": 0,
+                             "holders": 0}
+    try:
+        from ..sched.scheduler import live_admission_queues
+        for q in live_admission_queues():
+            sched["queues"] += 1
+            if q.cv.acquire(timeout=0.5):
+                try:
+                    sched["depth"] += q._depth_locked()
+                    sched["holders"] += q.holders
+                finally:
+                    q.cv.release()
+            else:
+                sched["alive"] = False
+                out["ok"] = False
+    except Exception:
+        pass
+    out["scheduler"] = sched
+
+    # heartbeat-known live peers ----------------------------------------
+    hb: Dict[str, Any] = {"managers": 0, "live_peers": 0}
+    try:
+        from ..shuffle.heartbeat import live_heartbeat_managers
+        for mgr in live_heartbeat_managers():
+            hb["managers"] += 1
+            hb["live_peers"] += len(mgr.known_peers())
+    except Exception:
+        pass
+    out["heartbeat"] = hb
+
+    # event-log writability ---------------------------------------------
+    ev: Dict[str, Any] = {"dir": "", "writable": None}
+    try:
+        log_dir = conf.get("spark.rapids.tpu.metrics.eventLog.dir") \
+            if conf is not None else ""
+        if log_dir:
+            ev["dir"] = log_dir
+            try:
+                os.makedirs(log_dir, exist_ok=True)
+                probe = os.path.join(log_dir,
+                                     f".healthz-{os.getpid()}.probe")
+                with open(probe, "w") as f:
+                    f.write("ok")
+                os.unlink(probe)
+                ev["writable"] = True
+            except OSError:
+                ev["writable"] = False
+                out["ok"] = False
+    except Exception:
+        pass
+    out["event_log"] = ev
+
+    # telemetry self-state ----------------------------------------------
+    from . import flight_recorder, is_enabled
+    rec = flight_recorder()
+    out["telemetry"] = {
+        "enabled": is_enabled(),
+        "flight_recorder_events": rec.events_recorded if rec else 0,
+        "incident_dumps": len(rec.dumps) if rec else 0,
+    }
+    return out
+
+
+class TelemetryHttpServer:
+    """`/metrics` + `/healthz` on one daemon thread (stdlib only).
+
+    Responses are computed per request from the live registry/singletons;
+    /healthz answers 200 when `ok` else 503 so a k8s-style probe needs no
+    body parsing."""
+
+    def __init__(self, registry, conf=None, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.registry = registry
+        self.conf = conf
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — stdlib API name
+                try:
+                    if self.path.startswith("/metrics"):
+                        body = outer.registry.render().encode()
+                        self._reply(200, PROM_CONTENT_TYPE, body)
+                    elif self.path.startswith("/healthz"):
+                        snap = health_snapshot(outer.conf)
+                        body = json.dumps(snap, indent=1).encode()
+                        self._reply(200 if snap.get("ok") else 503,
+                                    "application/json", body)
+                    else:
+                        self._reply(404, "text/plain", b"not found\n")
+                except Exception as e:  # the exporter must never die
+                    try:
+                        self._reply(500, "text/plain",
+                                    f"exporter error: {e}\n".encode())
+                    except Exception:
+                        pass
+
+            def _reply(self, code: int, ctype: str, body: bytes) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # silence per-request stderr spam
+                pass
+
+        self._srv = ThreadingHTTPServer((host, port), _Handler)
+        self._srv.daemon_threads = True
+        self.host, self.port = self._srv.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "TelemetryHttpServer":
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True,
+            name="tpu-telemetry-http")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        try:
+            self._srv.shutdown()
+            self._srv.server_close()
+        except Exception:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

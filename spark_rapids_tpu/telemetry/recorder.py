@@ -1,0 +1,149 @@
+"""Incident flight recorder: a fixed-size ring of recent engine events,
+dumped as a schema-validated JSONL incident file when something terminal
+happens.
+
+The query profiler (utils/spans.py) is post-hoc: it exports when a query
+FINISHES. The failures that need explaining most — terminal OOM after
+the spill framework gave up, a deadline expiry deep in a retry loop, an
+admission-rejection storm under overload, a fault-injected terminal
+error — are exactly the ones where the query never finishes, so the
+profile never lands. The recorder is the black box for those: seams feed
+it tiny events continuously (query begin/end, admission, spill, shuffle
+retry, OOM), the ring keeps the most recent `capacity`, and `dump()`
+writes them with an incident header record that
+`spans.validate_record` accepts (type `incident` + type `event`, schema
+v2), so the same report tooling reads crash evidence and profiles.
+
+Cost contract: when telemetry is off the recorder object does not exist
+(the facade's `flight()` is one module-global check). When on, `record`
+takes one small lock, writes one preallocated slot, allocates nothing
+but the attrs tuple the caller already built. Dumps are rate-limited per
+reason so an OOM loop cannot flood the incident directory.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["FlightRecorder"]
+
+_DUMP_MIN_INTERVAL_S = 5.0
+
+
+class FlightRecorder:
+    def __init__(self, capacity: int = 2048, dump_dir: str = "",
+                 reject_storm_threshold: int = 8,
+                 reject_storm_window_s: float = 10.0):
+        self.capacity = max(int(capacity), 16)
+        self.dump_dir = dump_dir
+        self.reject_storm_threshold = reject_storm_threshold
+        self.reject_storm_window_s = reject_storm_window_s
+        self._mu = threading.Lock()
+        self._ring: List[Optional[tuple]] = [None] * self.capacity
+        self._seq = 0
+        self._reject_ts: List[float] = []
+        self._last_storm = -1e18
+        self._last_dump: Dict[str, float] = {}
+        self.dumps: List[str] = []   # incident files written (diagnostics)
+        self.events_recorded = 0
+
+    # ------------------------------------------------------------------
+    def record(self, kind: str, name: str, trace_id: str = "",
+               attrs: Optional[Dict[str, Any]] = None) -> None:
+        """Append one event to the ring. Never raises."""
+        slot = (time.time(), time.monotonic_ns(), kind, name, trace_id,
+                attrs)
+        with self._mu:
+            self._ring[self._seq % self.capacity] = slot
+            self._seq += 1
+            self.events_recorded += 1
+
+    def note_rejection(self) -> bool:
+        """Track an admission rejection; True when the storm threshold is
+        crossed inside the window (caller then dumps). Reports at most one
+        storm per window — a sustained storm keeps shedding far faster
+        than anyone wants incident files (or dump threads). The timestamp
+        list is pruned to the window, so memory stays bounded."""
+        now = time.monotonic()
+        with self._mu:
+            self._reject_ts.append(now)
+            cutoff = now - self.reject_storm_window_s
+            self._reject_ts = [t for t in self._reject_ts if t >= cutoff]
+            if len(self._reject_ts) < self.reject_storm_threshold or \
+                    now - self._last_storm < self.reject_storm_window_s:
+                return False
+            self._last_storm = now
+            return True
+
+    def snapshot(self) -> List[tuple]:
+        """Events oldest-first (the ring's current contents)."""
+        with self._mu:
+            n = min(self._seq, self.capacity)
+            start = self._seq - n
+            return [self._ring[i % self.capacity]
+                    for i in range(start, self._seq)]
+
+    # ------------------------------------------------------------------
+    def dump(self, reason: str, trace_id: str = "",
+             attrs: Optional[Dict[str, Any]] = None) -> Optional[str]:
+        """Write the incident file: one `incident` header record followed
+        by one `event` record per ring entry, every line valid under
+        `spans.validate_record` (schema v2). Returns the path, or None
+        when no dump directory is configured / the per-reason rate limit
+        suppressed it. Never raises — the recorder must not worsen the
+        failure it is documenting."""
+        try:
+            return self._dump(reason, trace_id, attrs)
+        except Exception:
+            return None
+
+    def _dump(self, reason: str, trace_id: str,
+              attrs: Optional[Dict[str, Any]]) -> Optional[str]:
+        if not self.dump_dir:
+            return None
+        now = time.monotonic()
+        with self._mu:
+            last = self._last_dump.get(reason, -1e18)
+            if now - last < _DUMP_MIN_INTERVAL_S:
+                return None
+            self._last_dump[reason] = now
+        events = self.snapshot()
+        from ..utils import spans
+        from ..utils.spans import _json_default
+        os.makedirs(self.dump_dir, exist_ok=True)
+        ts = time.strftime("%Y%m%dT%H%M%S")
+        path = os.path.join(
+            self.dump_dir,
+            f"incident-{ts}-{os.getpid()}-{_slug(reason)}.jsonl")
+        header = {
+            "v": spans.SCHEMA_VERSION, "type": "incident",
+            "reason": reason, "trace_id": trace_id or "",
+            "ts": time.time(), "pid": os.getpid(),
+            "n_events": len(events),
+            "attrs": dict(attrs or {}),
+        }
+        lines = [json.dumps(header, separators=(",", ":"),
+                            default=_json_default)]
+        for i, ev in enumerate(events):
+            ev_ts, t_ns, kind, name, ev_trace, ev_attrs = ev
+            lines.append(json.dumps({
+                "v": spans.SCHEMA_VERSION, "type": "event",
+                "seq": i, "ts": ev_ts, "t_ns": t_ns,
+                "kind": kind, "name": name,
+                "trace_id": ev_trace or "",
+                "attrs": dict(ev_attrs or {}),
+            }, separators=(",", ":"), default=_json_default))
+        with open(path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        with self._mu:
+            self.dumps.append(path)
+        return path
+
+
+def _slug(s: str) -> str:
+    return "".join(c if c.isalnum() or c in "-_" else "_" for c in s)[:48]
+

@@ -1,0 +1,318 @@
+"""Process-wide metrics registry: counters, gauges, bounded-label
+histograms, rendered in the Prometheus text exposition format.
+
+This is the live-telemetry half of observability: `utils/metrics.py`
+MetricsSet values belong to one operator of one query and reset with it,
+while the families here accumulate for the PROCESS lifetime so a scraper
+polling a long-lived `TpuDeviceService` sees monotone counters and
+instantaneous gauges — the Spark metrics-system analog the reference
+plugin reports GpuSemaphore/RMM/shuffle state through.
+
+Design constraints (CI-gated by scripts/telemetry_matrix.sh):
+
+  * **Thread-safe, exact** — every mutation holds the family lock, so a
+    scrape concurrent with N writer threads renders a consistent value
+    and totals are never lost (test_telemetry.py hammers this).
+  * **Bounded label cardinality** — each family holds at most
+    `max_series` distinct label sets; the overflow series (every label
+    value `"__overflow__"`) absorbs the rest, so a hostile/buggy label
+    feed (per-query ids, raw paths) can never grow the registry without
+    bound. Overflowed increments are still counted — totals stay exact,
+    only attribution coarsens.
+  * **Gauges may be callbacks** — sampled at scrape time from the engine
+    singletons (MemoryBudget, BufferCatalog, CompileService, admission
+    queues), costing the hot path nothing.
+
+`parse_prometheus` is the inverse of `render` for the scrape-golden CI
+gate: every family rendered must parse back to the same samples.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["MetricsRegistry", "parse_prometheus", "OVERFLOW_LABEL",
+           "DEFAULT_BUCKETS"]
+
+OVERFLOW_LABEL = "__overflow__"
+
+# seconds-scale latency buckets (admission wait, fetch wait)
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
+                   30.0, 60.0)
+
+_COUNTER = "counter"
+_GAUGE = "gauge"
+_HISTOGRAM = "histogram"
+
+
+def _escape_help(s: str) -> str:
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label(s: str) -> str:
+    return (str(s).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _fmt_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    f = float(v)
+    if f.is_integer() and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class _Histo:
+    __slots__ = ("bucket_counts", "total", "count")
+
+    def __init__(self, n_buckets: int):
+        self.bucket_counts = [0] * (n_buckets + 1)  # + the +Inf bucket
+        self.total = 0.0
+        self.count = 0
+
+
+class _Family:
+    """One metric family: a kind, a label schema, and its series map."""
+
+    def __init__(self, name: str, kind: str, help_text: str,
+                 labelnames: Sequence[str], max_series: int,
+                 buckets: Sequence[float] = DEFAULT_BUCKETS,
+                 callback: Optional[Callable[[], Any]] = None):
+        self.name = name
+        self.kind = kind
+        self.help = help_text
+        self.labelnames = tuple(labelnames)
+        self.max_series = max_series
+        self.buckets = tuple(buckets)
+        self.callback = callback
+        self._mu = threading.Lock()
+        self._series: Dict[Tuple[str, ...], Any] = {}
+
+    # ---------------------------------------------------------------- keys
+    def _key(self, labels: Dict[str, Any]) -> Tuple[str, ...]:
+        key = tuple(str(labels.get(n, "")) for n in self.labelnames)
+        if key in self._series or len(self._series) < self.max_series:
+            return key
+        return (OVERFLOW_LABEL,) * len(self.labelnames)
+
+    # ------------------------------------------------------------- writes
+    def inc(self, value: float, labels: Dict[str, Any]) -> None:
+        with self._mu:
+            key = self._key(labels)
+            self._series[key] = self._series.get(key, 0.0) + value
+
+    def set(self, value: float, labels: Dict[str, Any]) -> None:
+        with self._mu:
+            self._series[self._key(labels)] = float(value)
+
+    def observe(self, value: float, labels: Dict[str, Any]) -> None:
+        with self._mu:
+            key = self._key(labels)
+            h = self._series.get(key)
+            if h is None:
+                h = self._series[key] = _Histo(len(self.buckets))
+            ix = len(self.buckets)
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    ix = i
+                    break
+            h.bucket_counts[ix] += 1
+            h.total += value
+            h.count += 1
+
+    # ------------------------------------------------------------- reads
+    def _callback_samples(self) -> List[Tuple[Tuple[str, ...], float]]:
+        """Evaluate a gauge callback: a scalar (unlabelled family) or a
+        {labels_dict_or_value_tuple: value} mapping. A failing callback
+        yields no samples — a scrape must never throw."""
+        try:
+            out = self.callback()
+        except Exception:
+            return []
+        if isinstance(out, dict):
+            samples = []
+            for k, v in out.items():
+                if isinstance(k, dict):
+                    key = tuple(str(k.get(n, "")) for n in self.labelnames)
+                elif isinstance(k, tuple):
+                    key = tuple(str(x) for x in k)
+                else:
+                    key = (str(k),)
+                samples.append((key, float(v)))
+            return samples
+        if out is None:
+            return []
+        return [((), float(out))]
+
+    def samples(self) -> List[Tuple[Tuple[str, ...], Any]]:
+        if self.kind == _GAUGE and self.callback is not None:
+            return self._callback_samples()
+        with self._mu:
+            return list(self._series.items())
+
+    def _labelstr(self, key: Tuple[str, ...],
+                  extra: Sequence[Tuple[str, str]] = ()) -> str:
+        pairs = [(n, v) for n, v in zip(self.labelnames, key)]
+        pairs.extend(extra)
+        if not pairs:
+            return ""
+        return "{" + ",".join(f'{n}="{_escape_label(v)}"'
+                              for n, v in pairs) + "}"
+
+    def render(self) -> List[str]:
+        lines = [f"# HELP {self.name} {_escape_help(self.help)}",
+                 f"# TYPE {self.name} {self.kind}"]
+        sams = self.samples()
+        if self.kind == _HISTOGRAM:
+            for key, h in sorted(sams):
+                cum = 0
+                for b, c in zip(self.buckets, h.bucket_counts):
+                    cum += c
+                    lines.append(
+                        f"{self.name}_bucket"
+                        f"{self._labelstr(key, [('le', _fmt_value(b))])}"
+                        f" {cum}")
+                cum += h.bucket_counts[-1]
+                lines.append(
+                    f"{self.name}_bucket"
+                    f"{self._labelstr(key, [('le', '+Inf')])} {cum}")
+                lines.append(
+                    f"{self.name}_sum{self._labelstr(key)} "
+                    f"{_fmt_value(h.total)}")
+                lines.append(
+                    f"{self.name}_count{self._labelstr(key)} {h.count}")
+            if not sams:
+                # an empty histogram still renders its zero series so the
+                # scrape-golden gate sees every registered family
+                lines.append(f"{self.name}_bucket{{le=\"+Inf\"}} 0")
+                lines.append(f"{self.name}_sum 0")
+                lines.append(f"{self.name}_count 0")
+            return lines
+        if not sams:
+            lines.append(f"{self.name} 0")
+            return lines
+        for key, v in sorted(sams):
+            lines.append(f"{self.name}{self._labelstr(key)} {_fmt_value(v)}")
+        return lines
+
+
+class MetricsRegistry:
+    """Named metric families with typed registration and write helpers.
+    Registration is idempotent (same name returns the family); writing to
+    an unregistered name is a silent no-op — telemetry must never fail
+    engine work."""
+
+    def __init__(self, max_series_per_family: int = 64):
+        self.max_series = max_series_per_family
+        self._mu = threading.Lock()
+        self._families: Dict[str, _Family] = {}
+
+    # -------------------------------------------------------- registration
+    def _register(self, name: str, kind: str, help_text: str,
+                  labelnames: Sequence[str], **kw) -> _Family:
+        with self._mu:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = _Family(name, kind, help_text, labelnames,
+                              self.max_series, **kw)
+                self._families[name] = fam
+            return fam
+
+    def counter(self, name: str, help_text: str,
+                labelnames: Sequence[str] = ()) -> _Family:
+        return self._register(name, _COUNTER, help_text, labelnames)
+
+    def gauge(self, name: str, help_text: str,
+              labelnames: Sequence[str] = (),
+              callback: Optional[Callable[[], Any]] = None) -> _Family:
+        return self._register(name, _GAUGE, help_text, labelnames,
+                              callback=callback)
+
+    def histogram(self, name: str, help_text: str,
+                  labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> _Family:
+        return self._register(name, _HISTOGRAM, help_text, labelnames,
+                              buckets=buckets)
+
+    # -------------------------------------------------------------- writes
+    def inc(self, name: str, value: float = 1.0, **labels: Any) -> None:
+        fam = self._families.get(name)
+        if fam is not None:
+            fam.inc(value, labels)
+
+    def set(self, name: str, value: float, **labels: Any) -> None:
+        fam = self._families.get(name)
+        if fam is not None:
+            fam.set(value, labels)
+
+    def observe(self, name: str, value: float, **labels: Any) -> None:
+        fam = self._families.get(name)
+        if fam is not None:
+            fam.observe(value, labels)
+
+    # --------------------------------------------------------------- reads
+    def families(self) -> List[str]:
+        with self._mu:
+            return sorted(self._families)
+
+    def get_value(self, name: str, **labels: Any) -> float:
+        """Current value of one counter/gauge series (0.0 when absent) —
+        test/assertion helper, not a scrape path."""
+        fam = self._families.get(name)
+        if fam is None:
+            return 0.0
+        key = tuple(str(labels.get(n, "")) for n in fam.labelnames)
+        for k, v in fam.samples():
+            if k == key and not isinstance(v, _Histo):
+                return float(v)
+        return 0.0
+
+    def render(self) -> str:
+        with self._mu:
+            fams = [self._families[n] for n in sorted(self._families)]
+        out: List[str] = []
+        for fam in fams:
+            out.extend(fam.render())
+        return "\n".join(out) + "\n"
+
+
+def parse_prometheus(text: str) -> Dict[str, Dict[str, float]]:
+    """Parse Prometheus text exposition back into
+    {sample_name: {label_string: value}} — the scrape-golden gate's
+    round-trip check (and a convenience for tests). Raises ValueError on
+    a malformed sample line."""
+    out: Dict[str, Dict[str, float]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        # name{labels} value  |  name value. Split on the LAST '}' — the
+        # value is numeric/+Inf and cannot contain one, while label VALUES
+        # can (tenant names arrive verbatim from service headers)
+        if "}" in line:
+            idx = line.rfind("}")
+            head = line[:idx]
+            name, _, labels = head.partition("{")
+            value = line[idx + 1:].strip()
+            labelstr = labels
+        else:
+            parts = line.split()
+            if len(parts) != 2:
+                raise ValueError(f"malformed sample line: {line!r}")
+            name, value = parts
+            labelstr = ""
+        name = name.strip()
+        if not name:
+            raise ValueError(f"malformed sample line: {line!r}")
+        try:
+            v = float(value)
+        except ValueError:
+            if value.strip() == "+Inf":
+                v = math.inf
+            else:
+                raise ValueError(f"bad sample value in line: {line!r}")
+        out.setdefault(name, {})[labelstr] = v
+    return out

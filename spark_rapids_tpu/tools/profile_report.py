@@ -29,20 +29,25 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
-from typing import Any, Dict, Iterator, List, Tuple
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 from ..utils.spans import SCHEMA_VERSION, validate_record
 
 __all__ = ["load_records", "build_model", "render_report", "sched_summary",
-           "main"]
+           "trace_view", "main"]
+
+# live logs plus size-capped rotation generations (events-PID.jsonl.1, .2,
+# ...) and the flight recorder's incident dumps — all the same schema
+_LOG_RE = re.compile(r"\.jsonl(\.\d+)?$")
 
 
 def _iter_files(paths: List[str]) -> Iterator[str]:
     for p in paths:
         if os.path.isdir(p):
             for name in sorted(os.listdir(p)):
-                if name.endswith(".jsonl"):
+                if _LOG_RE.search(name):
                     yield os.path.join(p, name)
         else:
             yield p
@@ -96,6 +101,8 @@ def build_model(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         queries[rec["query_id"]] = {
             "query_id": rec["query_id"], "label": rec.get("label", ""),
             "status": rec.get("status", "ok"),
+            "trace_id": rec.get("trace_id", ""),
+            "ts": rec.get("ts"),
             "wall_ns": rec.get("wall_ns", 0),
             "task_metrics": rec.get("task_metrics", {}),
             "operators": [], "phases": {}, "sched_waits": [],
@@ -189,6 +196,77 @@ def sched_summary(model: Dict[str, Any]) -> Dict[str, Any]:
         "deadline_exceeded": deadline,
         "query_statuses": statuses,
     }
+
+
+def trace_view(records: List[Dict[str, Any]],
+               trace: Optional[str] = None) -> str:
+    """Cross-process trace timeline: group every record carrying a trace
+    id (server query profiles, client-side service-op records, incident
+    headers) and render each trace's events ordered by wall-clock `ts`
+    where present. One `run_plan` shows as two rows — the client op in
+    the worker process and the server query in the device-owner process —
+    sharing the trace id, which is the whole point: which client call
+    produced which server-side work. `trace` (a full id or unique prefix)
+    narrows to one trace."""
+    traces: Dict[str, List[Dict[str, Any]]] = {}
+    for rec in records:
+        tid = rec.get("trace_id") or ""
+        if not tid:
+            continue
+        row: Optional[Dict[str, Any]] = None
+        if rec.get("type") == "query":
+            row = {"ts": rec.get("ts"),
+                   "process": _pid_of(rec.get("query_id", "")),
+                   "what": f"server query {rec.get('query_id')}",
+                   "detail": f"[{rec.get('label', '')}] "
+                             f"status={rec.get('status', 'ok')}",
+                   "dur_ms": rec.get("wall_ns", 0) / 1e6}
+        elif rec.get("type") == "span" and rec.get("kind") == "service":
+            attrs = rec.get("attrs", {})
+            row = {"ts": rec.get("ts"),
+                   "process": str(attrs.get("pid", "?")),
+                   "what": rec.get("name", "client op"),
+                   "detail": f"status={attrs.get('status', 'ok')}"
+                             + (f" query_id={rec.get('query_id')}"
+                                if rec.get("query_id") else ""),
+                   "dur_ms": rec.get("dur_ns", 0) / 1e6}
+        elif rec.get("type") == "incident":
+            row = {"ts": rec.get("ts"),
+                   "process": str(rec.get("pid", "?")),
+                   "what": f"incident {rec.get('reason', '')}",
+                   "detail": f"n_events={rec.get('n_events', 0)}",
+                   "dur_ms": 0.0}
+        if row is not None:
+            traces.setdefault(tid, []).append(row)
+    if trace is not None:
+        matches = [t for t in traces if t == trace or t.startswith(trace)]
+        if not matches:
+            return f"no records for trace {trace!r} " \
+                   f"({len(traces)} trace(s) in the logs)"
+        traces = {t: traces[t] for t in matches}
+    if not traces:
+        return "no trace-stamped records found (schema v2 logs required)"
+    lines: List[str] = []
+    for tid in sorted(traces):
+        rows = traces[tid]
+        known_ts = [r["ts"] for r in rows if r["ts"] is not None]
+        t0 = min(known_ts) if known_ts else None
+        rows.sort(key=lambda r: (r["ts"] is None,
+                                 r["ts"] if r["ts"] is not None else 0))
+        lines.append(f"=== trace {tid} ({len(rows)} record(s), "
+                     f"{len({r['process'] for r in rows})} process(es)) ===")
+        lines.append(_fmt_table(
+            [[("-" if r["ts"] is None or t0 is None
+               else f"+{(r['ts'] - t0) * 1e3:.1f}"),
+              r["process"], r["what"], f"{r['dur_ms']:.1f}", r["detail"]]
+             for r in rows],
+            ["t_offset_ms", "pid", "record", "dur_ms", "detail"]))
+        lines.append("")
+    return "\n".join(lines)
+
+
+def _pid_of(query_id: str) -> str:
+    return query_id.split("-", 1)[0] if "-" in query_id else "?"
 
 
 def _ms(ns: int) -> str:
@@ -339,6 +417,12 @@ def main(argv: List[str] = None) -> int:
                     help="operators to show per query (default 10)")
     ap.add_argument("--json", action="store_true",
                     help="emit the aggregated model as JSON instead of text")
+    ap.add_argument("--trace", nargs="?", const="", default=None,
+                    metavar="TRACE_ID",
+                    help="cross-process trace timeline: stitch client- and "
+                         "server-process records sharing a trace id (bare "
+                         "--trace shows every trace; an id/prefix narrows "
+                         "to one)")
     args = ap.parse_args(argv)
 
     records, problems = load_records(args.paths, validate=args.validate)
@@ -346,6 +430,11 @@ def main(argv: List[str] = None) -> int:
         print(f"INVALID: {p}", file=sys.stderr)
     if args.validate and problems:
         return 1
+    if args.trace is not None:
+        print(trace_view(records, trace=args.trace or None))
+        if args.validate:
+            _print_validated(records)
+        return 0
     model = build_model(records)
     if args.json:
         model["scheduler"] = sched_summary(model)
@@ -353,8 +442,19 @@ def main(argv: List[str] = None) -> int:
     else:
         print(render_report(model, top=args.top))
     if args.validate:
-        print(f"validated {len(records)} records: OK", file=sys.stderr)
+        _print_validated(records)
     return 0
+
+
+def _print_validated(records: List[Dict[str, Any]]) -> None:
+    """Per-schema-version record counts: mixed v1/v2 logs (an old
+    executor's files beside a new one's) are expected, not an error."""
+    by_v: Dict[Any, int] = {}
+    for r in records:
+        by_v[r.get("v")] = by_v.get(r.get("v"), 0) + 1
+    detail = ", ".join(f"v{v}: {n}" for v, n in sorted(by_v.items()))
+    print(f"validated {len(records)} records ({detail or 'none'}): OK",
+          file=sys.stderr)
 
 
 if __name__ == "__main__":

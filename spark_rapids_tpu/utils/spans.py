@@ -34,13 +34,23 @@ import json
 import os
 import threading
 import time
+import uuid
 from typing import Any, Dict, List, Optional
 
 __all__ = ["SCHEMA_VERSION", "Span", "QueryProfile", "span",
            "current_profile", "begin_profile", "end_profile",
-           "write_event_log", "validate_record", "task_metrics_dict"]
+           "write_event_log", "validate_record", "task_metrics_dict",
+           "new_trace_id", "current_trace", "trace_scope",
+           "write_client_record", "client_op_record", "append_jsonl"]
 
-SCHEMA_VERSION = 1
+# v2 (live telemetry): every record carries `trace_id` (cross-process
+# correlation — the id minted at query start rides the service headers
+# and shuffle fetch metadata) and query records add a wall-clock `ts`
+# (epoch seconds) so `profile_report.py --trace` can stitch client- and
+# server-process records into one timeline (per-process monotonic
+# start_ns values are incomparable across processes). v1 records remain
+# valid: `validate_record` accepts both versions.
+SCHEMA_VERSION = 2
 
 # span kinds — the phase taxonomy the report tool aggregates by
 KIND_QUERY = "query"
@@ -52,9 +62,16 @@ KIND_SEMAPHORE = "semaphore"
 KIND_KERNEL = "kernel"
 KIND_IO = "io"
 KIND_PHASE = "phase"
+KIND_SERVICE = "service"   # cross-process service ops (client-side records)
 
 _KINDS = (KIND_QUERY, KIND_OPERATOR, KIND_COMPILE, KIND_SPILL, KIND_SHUFFLE,
-          KIND_SEMAPHORE, KIND_KERNEL, KIND_IO, KIND_PHASE)
+          KIND_SEMAPHORE, KIND_KERNEL, KIND_IO, KIND_PHASE, KIND_SERVICE)
+
+
+def new_trace_id() -> str:
+    """Mint a trace id (16 hex chars): one per query, shared by every
+    process that touches it."""
+    return uuid.uuid4().hex[:16]
 
 
 class Span:
@@ -111,6 +128,17 @@ _tls = threading.local()
 _current: Optional["QueryProfile"] = None
 _mu = threading.Lock()
 
+# telemetry's flight recorder registers here so every FINISHED span also
+# lands in the incident ring ((span, profile) -> None). None (default)
+# costs one module-global read per span exit; telemetry.configure sets it,
+# telemetry.shutdown clears it.
+_flight_hook = None
+
+
+def set_flight_hook(hook) -> None:
+    global _flight_hook
+    _flight_hook = hook
+
 
 def _stack() -> list:
     s = getattr(_tls, "stack", None)
@@ -150,6 +178,9 @@ class _LiveSpan:
         elif sp in stack:
             stack.remove(sp)
         self._prof._record(sp)
+        hook = _flight_hook
+        if hook is not None:  # telemetry flight recorder (late-bound)
+            hook(sp, self._prof)
         return False
 
 
@@ -174,11 +205,45 @@ def current_profile() -> Optional["QueryProfile"]:
     return _current
 
 
-def begin_profile(label: str = "query") -> "QueryProfile":
+class trace_scope:
+    """Bind a trace id to the CURRENT thread for a scope (the query's
+    engine-side lifetime). `begin_profile` adopts it, and telemetry
+    flight-recorder events stamp it, so one id correlates the profile,
+    incident evidence, and the peer process that carried it here in a
+    service header. Nests (adaptive stages restore the outer id)."""
+
+    def __init__(self, trace_id: Optional[str]):
+        self._tid = trace_id
+        self._prev: Optional[str] = None
+
+    def __enter__(self) -> Optional[str]:
+        self._prev = getattr(_tls, "trace", None)
+        _tls.trace = self._tid
+        return self._tid
+
+    def __exit__(self, *exc) -> bool:
+        _tls.trace = self._prev
+        return False
+
+
+def current_trace() -> Optional[str]:
+    """The active trace id: this thread's trace scope, else the active
+    profile's (worker threads with no scope still correlate)."""
+    tid = getattr(_tls, "trace", None)
+    if tid:
+        return tid
+    prof = _current
+    return prof.trace_id if prof is not None else None
+
+
+def begin_profile(label: str = "query",
+                  trace_id: Optional[str] = None) -> "QueryProfile":
     """Activate a fresh QueryProfile as the process-wide current profile
-    (queries execute serially per session; worker threads inherit it)."""
+    (queries execute serially per session; worker threads inherit it).
+    `trace_id` defaults to the thread's trace scope, else a fresh mint."""
     global _current
-    prof = QueryProfile(label)
+    prof = QueryProfile(label,
+                        trace_id=trace_id or getattr(_tls, "trace", None))
     with _mu:
         _current = prof
     return prof
@@ -216,9 +281,12 @@ class QueryProfile:
     ROOT_SPAN_ID = 0
     _qid_counter = itertools.count(1)
 
-    def __init__(self, label: str = "query"):
+    def __init__(self, label: str = "query",
+                 trace_id: Optional[str] = None):
         self.query_id = f"{os.getpid()}-{next(QueryProfile._qid_counter)}"
         self.label = label
+        self.trace_id = trace_id or new_trace_id()
+        self.start_ts = time.time()   # wall clock, cross-process alignable
         self.start_ns = time.monotonic_ns()
         self.end_ns: Optional[int] = None
         self.closed = False
@@ -337,8 +405,10 @@ class QueryProfile:
         """One schema-versioned JSON record per query/operator/span."""
         recs: List[Dict[str, Any]] = [{
             "v": SCHEMA_VERSION, "type": "query",
-            "query_id": self.query_id, "label": self.label,
+            "query_id": self.query_id, "trace_id": self.trace_id,
+            "label": self.label,
             "status": self.status,
+            "ts": self.start_ts,
             "wall_ns": self.wall_ns,
             "task_metrics": dict(self.task_metrics),
             "n_operators": len(self._op_meta),
@@ -347,20 +417,23 @@ class QueryProfile:
         for m in self.operator_table():
             recs.append({
                 "v": SCHEMA_VERSION, "type": "operator",
-                "query_id": self.query_id, "op_id": m["op_id"],
+                "query_id": self.query_id, "trace_id": self.trace_id,
+                "op_id": m["op_id"],
                 "parent_id": m["parent_id"], "name": m["name"],
                 "args": m["args"], "metrics": dict(m["values"]),
             })
         recs.append({
             "v": SCHEMA_VERSION, "type": "span",
-            "query_id": self.query_id, "span_id": self.ROOT_SPAN_ID,
+            "query_id": self.query_id, "trace_id": self.trace_id,
+            "span_id": self.ROOT_SPAN_ID,
             "parent_id": None, "name": self.label, "kind": KIND_QUERY,
             "start_ns": self.start_ns, "dur_ns": self.wall_ns, "attrs": {},
         })
         for sp in self.spans:
             recs.append({
                 "v": SCHEMA_VERSION, "type": "span",
-                "query_id": self.query_id, "span_id": sp.span_id,
+                "query_id": self.query_id, "trace_id": self.trace_id,
+                "span_id": sp.span_id,
                 "parent_id": sp.parent_id, "name": sp.name, "kind": sp.kind,
                 "start_ns": sp.start_ns, "dur_ns": sp.dur_ns,
                 "attrs": dict(sp.attrs),
@@ -422,19 +495,101 @@ def _fmt_ns(ns: int) -> str:
 
 
 # ------------------------------------------------------------------ event log
-def write_event_log(prof: QueryProfile, log_dir: str) -> str:
+def _rotate(path: str, max_files: int) -> None:
+    """Shift `path` -> `.1`, `.1` -> `.2`, ... keeping at most `max_files`
+    rotated generations (the oldest falls off). Best-effort: rotation
+    failure must not lose the append."""
+    try:
+        oldest = f"{path}.{max_files}"
+        if os.path.exists(oldest):
+            os.unlink(oldest)
+        for i in range(max_files - 1, 0, -1):
+            src = f"{path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{path}.{i + 1}")
+        if os.path.exists(path):
+            os.replace(path, f"{path}.1")
+    except OSError:
+        pass
+
+
+# serializes size-check + rotate + append: concurrent scheduled queries
+# finishing together on one per-process file must not BOTH see the cap
+# crossed and double-rotate (which would shift a fresh generation up and
+# drop the oldest retained log early)
+_append_mu = threading.Lock()
+
+
+def append_jsonl(path: str, payload: str, max_bytes: int = 0,
+                 max_files: int = 10) -> str:
+    """Append `payload` to a JSONL file with size-capped rotation: when
+    `max_bytes` > 0 and the append would push the live file past it, the
+    live file rotates to `.1` (shifting older generations up) first, so a
+    long-lived server's event log is bounded at roughly
+    `max_bytes * (max_files + 1)` on disk. The report tool reads rotated
+    generations alongside live files."""
+    with _append_mu:
+        if max_bytes > 0:
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                size = 0
+            if size > 0 and size + len(payload) > max_bytes:
+                _rotate(path, max_files)
+        with open(path, "a") as f:
+            f.write(payload)
+    return path
+
+
+def write_event_log(prof: QueryProfile, log_dir: str,
+                    max_bytes: int = 0, max_files: int = 10) -> str:
     """Append the profile's records to the per-process JSONL event log under
     `log_dir` (created if missing). Append-only, one self-contained record
     per line: a torn final line (crash mid-write) damages only itself, and
-    concatenating logs from many executors is just `cat`."""
+    concatenating logs from many executors is just `cat`. `max_bytes`
+    (spark.rapids.tpu.metrics.eventLog.maxBytes) bounds the live file via
+    `.1`/`.2`/... rotation; 0 keeps the historical unbounded append."""
     os.makedirs(log_dir, exist_ok=True)
     path = os.path.join(log_dir, f"events-{os.getpid()}.jsonl")
     payload = "".join(json.dumps(r, separators=(",", ":"),
                                  default=_json_default) + "\n"
                       for r in prof.to_records())
-    with open(path, "a") as f:
-        f.write(payload)
-    return path
+    return append_jsonl(path, payload, max_bytes, max_files)
+
+
+def client_op_record(op: str, trace_id: str, dur_ns: int, status: str = "ok",
+                     query_id: str = "", **attrs: Any) -> Dict[str, Any]:
+    """A v2 span record describing one client-side service op (run_plan /
+    acquire): what the CLIENT process contributes to a cross-process
+    trace. `profile_report.py --trace` stitches these against the server
+    profile records sharing the trace id."""
+    a = {"status": status, "pid": os.getpid()}
+    a.update(attrs)
+    return {
+        "v": SCHEMA_VERSION, "type": "span",
+        "query_id": query_id or f"client-{os.getpid()}",
+        "trace_id": trace_id,
+        "span_id": 0, "parent_id": None,
+        "name": f"client:{op}", "kind": KIND_SERVICE,
+        "start_ns": time.monotonic_ns() - dur_ns, "dur_ns": dur_ns,
+        # `ts` is the op START (records are built in the caller's finally,
+        # i.e. at op end): every `ts` in the schema marks a beginning, and
+        # the --trace timeline sorts by it — stamping the end here would
+        # render the submitting client op AFTER the server query it caused
+        "ts": time.time() - dur_ns / 1e9,
+        "attrs": a,
+    }
+
+
+def write_client_record(log_dir: str, record: Dict[str, Any],
+                        max_bytes: int = 0, max_files: int = 10) -> str:
+    """Append one record to this process's event log (the client-side half
+    of trace correlation; same file naming/rotation as write_event_log)."""
+    os.makedirs(log_dir, exist_ok=True)
+    path = os.path.join(log_dir, f"events-{os.getpid()}.jsonl")
+    payload = json.dumps(record, separators=(",", ":"),
+                         default=_json_default) + "\n"
+    return append_jsonl(path, payload, max_bytes, max_files)
 
 
 def _json_default(o):
@@ -450,7 +605,7 @@ def _json_default(o):
 
 
 # ----------------------------------------------------------------- validation
-_REQUIRED: Dict[str, Dict[str, type]] = {
+_REQUIRED: Dict[str, Dict[str, Any]] = {
     "query": {"query_id": str, "label": str, "wall_ns": int,
               "task_metrics": dict, "n_operators": int, "n_spans": int},
     "operator": {"query_id": str, "op_id": int, "name": str,
@@ -459,26 +614,61 @@ _REQUIRED: Dict[str, Dict[str, type]] = {
              "start_ns": int, "dur_ns": int, "attrs": dict},
 }
 
+# v2 additions: trace correlation on the profile record types, plus the
+# flight-recorder incident-file types (recorder dumps validate with the
+# same authority as event logs — one definition of "valid")
+_REQUIRED_V2_EXTRA: Dict[str, Dict[str, Any]] = {
+    "query": {"trace_id": str, "ts": (int, float)},
+    "operator": {"trace_id": str},
+    "span": {"trace_id": str},
+}
+_REQUIRED_V2_ONLY: Dict[str, Dict[str, Any]] = {
+    "incident": {"reason": str, "trace_id": str, "ts": (int, float),
+                 "pid": int, "n_events": int, "attrs": dict},
+    "event": {"seq": int, "ts": (int, float), "t_ns": int, "kind": str,
+              "name": str, "trace_id": str, "attrs": dict},
+}
+
+_VALID_VERSIONS = (1, 2)
+
+
+def _type_name(typ) -> str:
+    if isinstance(typ, tuple):
+        return "/".join(t.__name__ for t in typ)
+    return typ.__name__
+
 
 def validate_record(rec: Any) -> List[str]:
-    """Schema check of one event-log record; returns a list of problems
-    (empty = valid). Shared by the report tool, profile_matrix.sh and the
-    tests so 'valid' means one thing."""
+    """Schema check of one event-log / incident-file record; returns a
+    list of problems (empty = valid). Shared by the report tool, the
+    matrix scripts and the tests so 'valid' means one thing. Accepts both
+    schema versions: v1 (pre-trace) records stay valid forever — mixed
+    logs from old and new processes validate together — while v2 records
+    additionally require `trace_id` (and `ts` on query records)."""
     errs: List[str] = []
     if not isinstance(rec, dict):
         return [f"record is {type(rec).__name__}, not an object"]
-    if rec.get("v") != SCHEMA_VERSION:
-        errs.append(f"schema version {rec.get('v')!r} != {SCHEMA_VERSION}")
+    v = rec.get("v")
+    if v not in _VALID_VERSIONS:
+        errs.append(f"schema version {v!r} not in {_VALID_VERSIONS}")
+        v = SCHEMA_VERSION
     rtype = rec.get("type")
-    req = _REQUIRED.get(rtype)
-    if req is None:
-        errs.append(f"unknown record type {rtype!r}")
+    req = dict(_REQUIRED.get(rtype, ()))
+    if v >= 2:
+        req.update(_REQUIRED_V2_EXTRA.get(rtype, ()))
+        if not req:
+            req = dict(_REQUIRED_V2_ONLY.get(rtype, ()))
+    if not req:
+        errs.append(f"unknown record type {rtype!r}"
+                    + (" (v2-only type in a v1 record)"
+                       if rtype in _REQUIRED_V2_ONLY else ""))
         return errs
     for field, typ in req.items():
         if field not in rec:
             errs.append(f"{rtype}: missing field {field!r}")
-        elif not isinstance(rec[field], typ):
-            errs.append(f"{rtype}.{field}: expected {typ.__name__}, "
+        elif isinstance(rec[field], bool) or \
+                not isinstance(rec[field], typ):
+            errs.append(f"{rtype}.{field}: expected {_type_name(typ)}, "
                         f"got {type(rec[field]).__name__}")
     if rtype == "span" and rec.get("kind") not in _KINDS:
         errs.append(f"span.kind {rec.get('kind')!r} not in {_KINDS}")

@@ -47,6 +47,11 @@ def pytest_configure(config):
         "scripts/pipeline_matrix.sh runs these standalone)")
     config.addinivalue_line(
         "markers",
+        "telemetry: live-telemetry suite (metrics registry / scrape "
+        "surface / flight recorder / trace correlation; "
+        "scripts/telemetry_matrix.sh runs these standalone)")
+    config.addinivalue_line(
+        "markers",
         "sched: query-scheduler suite (priority-weighted fair admission / "
         "deadlines / cooperative cancellation / tenant quotas; "
         "scripts/sched_matrix.sh runs these standalone)")

@@ -1,0 +1,474 @@
+"""Live-telemetry suite (ISSUE-8, marker `telemetry`): metrics registry
+(concurrency-exact totals, bounded label cardinality, Prometheus render/
+parse round-trip), engine gauge feeds, flight-recorder ring + incident
+dumps, health snapshot, schema-v2 trace correlation, event-log rotation,
+and the telemetry-off zero-state contract.
+
+scripts/telemetry_matrix.sh runs these standalone plus the off-gate /
+scrape-golden / dump-on-OOM / cross-process trace gates."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import faults, telemetry
+from spark_rapids_tpu.telemetry import (FlightRecorder, MetricsRegistry,
+                                        OVERFLOW_LABEL, parse_prometheus)
+from spark_rapids_tpu.utils import spans
+from spark_rapids_tpu.utils.spans import validate_record
+
+pytestmark = pytest.mark.telemetry
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_teardown():
+    """Every test leaves telemetry OFF (no registry, no HTTP thread) so
+    suites sharing this process keep their zero-thread assumptions."""
+    yield
+    telemetry.shutdown()
+    assert not telemetry.is_enabled()
+    assert telemetry.registry() is None
+
+
+def _conf(tmp_path=None, **extra):
+    base = {"spark.rapids.sql.enabled": True,
+            "spark.rapids.sql.explain": "NONE",
+            "spark.rapids.tpu.telemetry.enabled": True}
+    if tmp_path is not None:
+        base["spark.rapids.tpu.telemetry.flightRecorder.dir"] = str(tmp_path)
+    base.update(extra)
+    return base
+
+
+# ---------------------------------------------------------------------------
+# registry: exact totals under concurrency, cardinality cap, round-trip
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_counter_gauge_histogram_round_trip(self):
+        reg = MetricsRegistry()
+        reg.counter("t_requests_total", "requests", ["code"])
+        reg.gauge("t_depth", "queue depth")
+        reg.histogram("t_wait_seconds", "wait", buckets=(0.01, 0.1, 1.0))
+        reg.inc("t_requests_total", 3, code="200")
+        reg.inc("t_requests_total", 1, code="500")
+        reg.set("t_depth", 7)
+        for v in (0.005, 0.05, 0.5, 5.0):
+            reg.observe("t_wait_seconds", v)
+        parsed = parse_prometheus(reg.render())
+        assert parsed["t_requests_total"]['code="200"'] == 3
+        assert parsed["t_requests_total"]['code="500"'] == 1
+        assert parsed["t_depth"][""] == 7
+        assert parsed["t_wait_seconds_count"][""] == 4
+        assert parsed["t_wait_seconds_bucket"]['le="0.01"'] == 1
+        assert parsed["t_wait_seconds_bucket"]['le="+Inf"'] == 4
+        assert abs(parsed["t_wait_seconds_sum"][""] - 5.555) < 1e-9
+
+    def test_concurrent_hammer_totals_exact_and_scrape_never_throws(self):
+        """ISSUE-8 satellite: N writer threads vs a continuous scrape —
+        totals exact, render never raises, histogram count conserved."""
+        reg = MetricsRegistry()
+        reg.counter("h_total", "hammered", ["worker"])
+        reg.histogram("h_wait", "hammered waits", buckets=(0.5,))
+        N, PER = 8, 2000
+        stop = threading.Event()
+        scrape_errors = []
+
+        def scrape():
+            while not stop.is_set():
+                try:
+                    parse_prometheus(reg.render())
+                except Exception as e:  # pragma: no cover - the assertion
+                    scrape_errors.append(e)
+
+        def hammer(i):
+            for k in range(PER):
+                reg.inc("h_total", 1, worker=str(i % 4))
+                reg.observe("h_wait", 0.1 if k % 2 else 0.9)
+
+        scraper = threading.Thread(target=scrape)
+        scraper.start()
+        workers = [threading.Thread(target=hammer, args=(i,))
+                   for i in range(N)]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
+        stop.set()
+        scraper.join()
+        assert not scrape_errors
+        parsed = parse_prometheus(reg.render())
+        total = sum(parsed["h_total"].values())
+        assert total == N * PER
+        assert parsed["h_wait_count"][""] == N * PER
+        assert parsed["h_wait_bucket"]['le="0.5"'] == N * PER // 2
+
+    def test_label_cardinality_cap_bucketed_not_unbounded(self):
+        reg = MetricsRegistry(max_series_per_family=4)
+        reg.counter("c_total", "capped", ["q"])
+        for i in range(100):
+            reg.inc("c_total", 1, q=f"query-{i}")
+        parsed = parse_prometheus(reg.render())
+        series = parsed["c_total"]
+        assert len(series) == 5  # 4 real + the overflow bucket
+        assert series[f'q="{OVERFLOW_LABEL}"'] == 96
+        assert sum(series.values()) == 100  # totals stay exact
+
+    def test_failing_gauge_callback_yields_no_sample_not_a_throw(self):
+        reg = MetricsRegistry()
+        reg.gauge("g_bad", "boom", callback=lambda: 1 / 0)
+        reg.gauge("g_ok", "fine", callback=lambda: 5)
+        parsed = parse_prometheus(reg.render())
+        assert parsed["g_ok"][""] == 5
+        assert parsed["g_bad"][""] == 0  # renders the zero series
+
+    def test_unregistered_write_is_noop(self):
+        reg = MetricsRegistry()
+        reg.inc("never_registered", 1)  # must not raise
+        reg.observe("never_registered", 1.0)
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_ring_wraps_at_capacity(self):
+        rec = FlightRecorder(capacity=16)
+        for i in range(50):
+            rec.record("k", f"ev{i}")
+        evs = rec.snapshot()
+        assert len(evs) == 16
+        assert evs[0][3] == "ev34" and evs[-1][3] == "ev49"
+
+    def test_dump_is_schema_valid_and_rate_limited(self, tmp_path):
+        rec = FlightRecorder(capacity=32, dump_dir=str(tmp_path))
+        for i in range(5):
+            rec.record("memory", "oom_pressure", trace_id="t1",
+                       attrs={"need": i})
+        p = rec.dump("terminal_oom", trace_id="t1", attrs={"need": 99})
+        assert p and os.path.exists(p)
+        lines = [json.loads(l) for l in open(p)]
+        assert lines[0]["type"] == "incident"
+        assert lines[0]["reason"] == "terminal_oom"
+        assert lines[0]["n_events"] == 5
+        assert [l["type"] for l in lines[1:]] == ["event"] * 5
+        for rec_ in lines:
+            assert validate_record(rec_) == [], rec_
+        # same reason again inside the rate window: suppressed
+        assert rec.dump("terminal_oom") is None
+        # a different reason is its own budget
+        assert rec.dump("cancelled") is not None
+
+    def test_no_dump_dir_means_no_file(self):
+        rec = FlightRecorder(capacity=8, dump_dir="")
+        rec.record("k", "e")
+        assert rec.dump("whatever") is None
+
+    def test_reject_storm_threshold(self):
+        rec = FlightRecorder(reject_storm_threshold=3,
+                             reject_storm_window_s=60.0)
+        assert not rec.note_rejection()
+        assert not rec.note_rejection()
+        assert rec.note_rejection()  # third inside the window
+
+
+# ---------------------------------------------------------------------------
+# off-path contract
+# ---------------------------------------------------------------------------
+
+
+class TestTelemetryOff:
+    def test_off_is_zero_state_zero_threads(self):
+        from spark_rapids_tpu.expr import Sum, col
+        from spark_rapids_tpu.plugin import TpuSession
+        threads0 = threading.active_count()
+        sess = TpuSession({"spark.rapids.sql.enabled": True,
+                           "spark.rapids.sql.explain": "NONE"})
+        t = pa.table({"g": pa.array(np.arange(500) % 4),
+                      "v": pa.array(np.ones(500))})
+        out = sess.from_arrow(t).group_by("g").agg(s=Sum(col("v"))).collect()
+        assert out.num_rows == 4
+        assert not telemetry.is_enabled()
+        assert telemetry.registry() is None
+        assert telemetry.flight_recorder() is None
+        assert telemetry.http_server() is None
+        assert threading.active_count() <= threads0
+        # hooks are no-ops, not errors
+        telemetry.inc("tpu_queries_total")
+        telemetry.flight("query", "begin")
+        assert telemetry.incident("nope") is None
+        assert telemetry.render_prometheus() == ""
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: engine feeds, health, HTTP, incidents
+# ---------------------------------------------------------------------------
+
+
+class TestEngineFeeds:
+    def _run_query(self, sess, n=800, groups=8):
+        from spark_rapids_tpu.expr import Sum, col
+        t = pa.table({"g": pa.array(np.arange(n) % groups,
+                                    type=pa.int32()),
+                      "v": pa.array(np.ones(n))})
+        return sess.from_arrow(t).group_by("g").agg(s=Sum(col("v"))) \
+            .collect()
+
+    def test_query_and_op_counters_move(self, tmp_path):
+        from spark_rapids_tpu.plugin import TpuSession
+        sess = TpuSession(_conf(tmp_path))
+        out = self._run_query(sess)
+        assert out.num_rows == 8
+        reg = telemetry.registry()
+        assert reg.get_value("tpu_queries_total", status="ok") >= 1
+        assert reg.get_value("tpu_op_output_rows_total",
+                             op="TpuScanExec") >= 800
+        # every registered family renders and parses back (scrape golden)
+        parsed = parse_prometheus(reg.render())
+        for fam in reg.families():
+            assert any(k == fam or k.startswith(fam + "_")
+                       for k in parsed), f"family {fam} not rendered"
+
+    def test_cpu_fallback_rerun_counter_moves(self, tmp_path):
+        """ISSUE-8 satellite: silent CpuFallbackRequired re-runs are
+        visible on the scrape surface."""
+        from spark_rapids_tpu.expr import Count, col
+        from spark_rapids_tpu.plugin import TpuSession
+        sess = TpuSession(_conf(tmp_path))
+        sess.initialize_device()  # telemetry comes up with the device
+        n = 120
+        keys = [("K%03d" % (i % 3)) * 120 for i in range(n)]  # >headWidth
+        t = pa.table({"s": pa.array(keys), "v": pa.array(np.ones(n))})
+        before = telemetry.registry().get_value(
+            "tpu_cpu_fallback_reruns_total")
+        out = sess.from_arrow(t).group_by("s").agg(n_=Count(col("v"))) \
+            .collect()
+        assert out.num_rows == 3
+        assert telemetry.registry().get_value(
+            "tpu_cpu_fallback_reruns_total") >= before + 1
+
+    def test_sched_rejection_and_deadline_counters_move(self, tmp_path):
+        """ISSUE-8 satellite: overload statuses land in the registry from
+        BOTH admission outcomes (shed + deadline)."""
+        from spark_rapids_tpu.plugin import TpuSession
+        from spark_rapids_tpu.sched import CancelToken
+        from spark_rapids_tpu.sched.scheduler import AdmissionQueue
+        from spark_rapids_tpu.errors import (DeadlineExceededError,
+                                             QueryRejectedError)
+        TpuSession(_conf(tmp_path)).initialize_device()
+        reg = telemetry.registry()
+        q = AdmissionQueue(1, max_depth=1)
+        assert q.acquire(tenant="tA") == 1  # token taken
+        th = threading.Thread(
+            target=lambda: q.acquire(tenant="tA", timeout=5))
+        th.start()
+        time.sleep(0.1)  # parked waiter fills the depth-1 queue
+        with pytest.raises(QueryRejectedError):
+            q.acquire(tenant="tA")  # arrival beyond max_depth sheds
+        assert reg.get_value("tpu_sched_rejected_total", tenant="tA") >= 1
+        q2 = AdmissionQueue(0)  # zero tokens: tB can only park
+        with pytest.raises(DeadlineExceededError):
+            q2.acquire(tenant="tB", token=CancelToken(0.05))
+        assert reg.get_value("tpu_sched_deadline_total", tenant="tB") >= 1
+        q.release()
+        th.join(timeout=10)
+        assert reg.get_value("tpu_sched_admissions_total", tenant="tA") >= 2
+        q.release()
+        # wait histogram observed the grants
+        parsed = parse_prometheus(reg.render())
+        counts = {k: v for k, v in
+                  parsed["tpu_sched_admission_wait_seconds_count"].items()}
+        assert sum(counts.values()) >= 2
+
+    def test_health_snapshot_and_http(self, tmp_path):
+        import urllib.request
+        from spark_rapids_tpu.plugin import TpuSession
+        sess = TpuSession(_conf(
+            tmp_path, **{"spark.rapids.tpu.telemetry.http.port": 0,
+                         "spark.rapids.tpu.metrics.eventLog.dir":
+                             str(tmp_path)}))
+        self._run_query(sess)
+        snap = telemetry.health_snapshot(sess.conf)
+        assert snap["ok"] is True
+        assert snap["device"]["initialized"] is True
+        assert snap["event_log"]["writable"] is True
+        srv = telemetry.http_server()
+        assert srv is not None
+        base = f"http://127.0.0.1:{srv.port}"
+        body = urllib.request.urlopen(base + "/metrics").read().decode()
+        assert "tpu_queries_total" in body
+        parse_prometheus(body)
+        h = json.loads(urllib.request.urlopen(base + "/healthz").read())
+        assert h["ok"] is True
+
+    def test_injected_terminal_oom_dumps_incident(self, tmp_path):
+        from spark_rapids_tpu.errors import RetryOOM
+        from spark_rapids_tpu.plugin import TpuSession
+        sess = TpuSession(_conf(tmp_path))
+        with faults.inject(faults.ALLOC, "error", nth=0, times=0,
+                           error=RetryOOM):
+            with pytest.raises(RetryOOM):
+                self._run_query(sess)
+        dumps = [f for f in os.listdir(tmp_path)
+                 if f.startswith("incident-") and "terminal_oom" in f]
+        assert dumps, os.listdir(tmp_path)
+        recs = [json.loads(l) for l in open(tmp_path / dumps[0])]
+        assert recs[0]["type"] == "incident"
+        assert recs[0]["trace_id"]  # stamped with the dying query's trace
+        for r in recs:
+            assert validate_record(r) == [], r
+        assert telemetry.registry().get_value(
+            "tpu_incidents_total", reason="terminal_oom") >= 1
+        assert telemetry.registry().get_value(
+            "tpu_queries_total", status="oom") >= 1
+
+
+# ---------------------------------------------------------------------------
+# schema v2 + trace correlation
+# ---------------------------------------------------------------------------
+
+
+class TestTraceCorrelation:
+    def test_v1_and_v2_records_both_validate(self):
+        v1 = {"v": 1, "type": "query", "query_id": "1-1", "label": "q",
+              "wall_ns": 5, "task_metrics": {}, "n_operators": 0,
+              "n_spans": 1}
+        assert validate_record(v1) == []
+        v2 = dict(v1, v=2, trace_id="abc", ts=1.5)
+        assert validate_record(v2) == []
+        # v2 without a trace id is invalid; v1 never needed one
+        missing = dict(v1, v=2, ts=1.5)
+        assert any("trace_id" in e for e in validate_record(missing))
+
+    def test_profile_stamps_scope_trace(self, tmp_path):
+        with spans.trace_scope("feedbeefcafe0001"):
+            prof = spans.begin_profile("traced")
+            with spans.span("phase"):
+                pass
+            spans.end_profile(prof)
+            prof.finish()
+        recs = prof.to_records()
+        assert all(r["trace_id"] == "feedbeefcafe0001" for r in recs)
+        assert all(validate_record(r) == [] for r in recs)
+        assert spans.current_trace() is None
+
+    def test_cross_process_style_stitch(self, tmp_path):
+        """Client record (this 'process') + server profile sharing one
+        trace id stitch into one --trace timeline."""
+        from spark_rapids_tpu.tools.profile_report import (load_records,
+                                                           trace_view)
+        tid = spans.new_trace_id()
+        rec = spans.client_op_record("run_plan", tid, 7_000_000,
+                                     status="ok", query_id="q-77")
+        spans.write_client_record(str(tmp_path), rec)
+        with spans.trace_scope(tid):
+            prof = spans.begin_profile("served")
+            spans.end_profile(prof)
+            prof.finish()
+        spans.write_event_log(prof, str(tmp_path))
+        records, problems = load_records([str(tmp_path)], validate=True)
+        assert not problems
+        view = trace_view(records, trace=tid)
+        assert "client:run_plan" in view
+        assert "server query" in view
+        assert tid in view
+
+    def test_query_context_carries_trace(self):
+        from spark_rapids_tpu.sched import QueryContext
+        ctx = QueryContext(trace_id="aa11bb22cc33dd44")
+        assert ctx.trace_id == "aa11bb22cc33dd44"
+        assert QueryContext().trace_id is None  # session mints at start
+
+
+# ---------------------------------------------------------------------------
+# event-log rotation
+# ---------------------------------------------------------------------------
+
+
+class TestEventLogRotation:
+    def _profile(self):
+        prof = spans.begin_profile("rot")
+        spans.end_profile(prof)
+        prof.finish()
+        return prof
+
+    def test_rotation_caps_live_file_and_keeps_generations(self, tmp_path):
+        d = str(tmp_path)
+        prof = self._profile()
+        one = len("".join(json.dumps(r) + "\n" for r in prof.to_records()))
+        cap = int(one * 1.5)  # fits one profile, not two
+        paths = set()
+        for _ in range(4):
+            p = self._profile()
+            paths.add(spans.write_event_log(p, d, max_bytes=cap,
+                                            max_files=2))
+        (live,) = paths
+        assert os.path.getsize(live) <= cap
+        gens = sorted(f for f in os.listdir(d) if ".jsonl." in f)
+        assert gens == [os.path.basename(live) + ".1",
+                        os.path.basename(live) + ".2"]
+
+    def test_report_tool_reads_rotated_generations(self, tmp_path):
+        from spark_rapids_tpu.tools.profile_report import (build_model,
+                                                           load_records)
+        d = str(tmp_path)
+        prof = self._profile()
+        one = len("".join(json.dumps(r) + "\n" for r in prof.to_records()))
+        for _ in range(3):
+            spans.write_event_log(self._profile(), d,
+                                  max_bytes=int(one * 1.5), max_files=5)
+        records, problems = load_records([d], validate=True)
+        assert not problems
+        model = build_model(records)
+        assert len(model["queries"]) == 3  # live + rotated all read
+
+    def test_rotation_off_by_default_appends_unbounded(self, tmp_path):
+        d = str(tmp_path)
+        p1 = spans.write_event_log(self._profile(), d)
+        p2 = spans.write_event_log(self._profile(), d)
+        assert p1 == p2
+        assert not [f for f in os.listdir(d) if ".jsonl." in f]
+
+
+# ---------------------------------------------------------------------------
+# service ops (in-process server plumbing)
+# ---------------------------------------------------------------------------
+
+
+class TestServiceOps:
+    def test_stats_and_health_ops_over_socket(self, tmp_path):
+        import socket as socketmod
+        from spark_rapids_tpu.service.server import TpuDeviceService
+        from spark_rapids_tpu.service import TpuServiceClient
+        sock = str(tmp_path / "svc.sock")
+        svc = TpuDeviceService(_conf(tmp_path), sock)
+        th = threading.Thread(target=svc.serve_forever, daemon=True)
+        th.start()
+        try:
+            cli = TpuServiceClient(sock, deadline_s=60.0).connect()
+            try:
+                text = cli.stats()
+                parsed = parse_prometheus(text)
+                assert "tpu_queries_total" in parsed
+                health = cli.health()
+                assert health["ok"] is True
+                assert health["device"]["initialized"] is True
+            finally:
+                cli.close()
+        finally:
+            try:
+                with TpuServiceClient(sock, deadline_s=5.0) as c2:
+                    c2.shutdown()
+            except Exception:
+                pass
+            th.join(timeout=10)
+            from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+            TpuSemaphore._instance = None
