@@ -766,6 +766,110 @@ def sched_bench() -> dict:
     return out
 
 
+RESCACHE_ROWS = 400_000
+RESCACHE_REPEATS = 21  # 1 cold + 20 warm => 20/21 ≈ 0.95 hit rate
+
+
+def rescache_bench() -> dict:
+    """Repeated-dashboard-query workload (ISSUE-9 flag: `bench.py
+    --rescache`): the SAME scan->filter->aggregate query over a parquet
+    file runs RESCACHE_REPEATS times with the result cache on. Reports
+    the whole-query hit rate, cold-vs-warm latency (a warm hit is a host
+    reply — no decode, no kernels, no admission), the bit-identical gate
+    across every repetition, and the no-admission-token assertion
+    (scheduler enabled; warm runs must record sched_admissions == 0).
+    Acceptance: hit rate > 0.9 and measured warm speedup with identical
+    results."""
+    _apply_platform_override()
+    import tempfile
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu import rescache
+    from spark_rapids_tpu.expr import Count, Sum, col
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+    from spark_rapids_tpu.plugin import TpuSession
+    from spark_rapids_tpu.utils.metrics import TaskMetrics
+
+    rng = np.random.default_rng(23)
+    n = RESCACHE_ROWS
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 4096, n)),
+        "g": pa.array(rng.integers(0, 256, n).astype(np.int32)),
+        "v": pa.array(rng.uniform(size=n)),
+    })
+    tmp = tempfile.mkdtemp(prefix="srtpu_rescache_bench_")
+    path = os.path.join(tmp, "fact.parquet")
+    pq.write_table(t, path, row_group_size=65_536)
+
+    sess = TpuSession({
+        "spark.rapids.sql.enabled": True,
+        "spark.rapids.sql.explain": "NONE",
+        "spark.rapids.tpu.rescache.enabled": True,
+        "spark.rapids.tpu.sched.enabled": True,
+    })
+    sess.initialize_device()
+    TpuSemaphore.initialize(sess.conf.concurrent_tpu_tasks, sess.conf)
+
+    def q():
+        return (sess.read_parquet(path).filter(col("v") > 0.25)
+                .group_by("g").agg(total=Sum(col("v")),
+                                   cnt=Count(col("k")))
+                ).collect().sort_by("g")
+
+    # one throwaway compile-warm pass on a DIFFERENT (uncached) shape so
+    # the cold measurement is decode+execute, not XLA compilation
+    (sess.from_arrow(t.slice(0, 8192)).filter(col("v") > 0.25)
+     .group_by("g").agg(total=Sum(col("v")),
+                        cnt=Count(col("k")))).collect()
+
+    lat = []
+    admissions = []
+    hits = []
+    reference = None
+    identical = True
+    for _ in range(RESCACHE_REPEATS):
+        t0 = time.perf_counter()
+        r = q()
+        lat.append(time.perf_counter() - t0)
+        tm = TaskMetrics.get()
+        admissions.append(tm.sched_admissions)
+        hits.append(tm.rescache_hits)
+        if reference is None:
+            reference = r
+        elif not r.equals(reference):
+            identical = False
+    stats = rescache.stats() or {}
+    cold_s = lat[0]
+    warm = lat[1:]
+    warm_mean = float(np.mean(warm)) if warm else None
+    hit_runs = sum(1 for h in hits[1:] if h >= 1)
+    hit_rate = hit_runs / max(len(lat) - 1, 1)
+    warm_admissions = sum(admissions[1:])
+    TpuSemaphore._instance = None
+    out = {
+        "metric": "rescache_bench",
+        "rows": n,
+        "repeats": RESCACHE_REPEATS,
+        "cold_s": round(cold_s, 5),
+        "warm_mean_s": round(warm_mean, 6) if warm_mean else None,
+        "warm_p50_s": round(sorted(warm)[len(warm) // 2], 6)
+        if warm else None,
+        "speedup_warm_vs_cold_x": round(cold_s / warm_mean, 2)
+        if warm_mean else None,
+        "hit_rate": round(hit_rate, 4),
+        "bit_identical": identical,
+        "warm_admissions_total": warm_admissions,
+        "cache_stats": {k: stats.get(k) for k in
+                        ("entries", "bytes", "hits", "misses", "stores",
+                         "evictions")},
+        "ok": bool(identical and hit_rate > 0.9
+                   and warm_admissions == 0),
+    }
+    return out
+
+
 PROBE_TIMEOUT_S = 35
 PROBE_ATTEMPTS = 2
 
@@ -874,6 +978,12 @@ if __name__ == "__main__":
         # baseline vs scheduler, one JSON line (appended to BENCH detail)
         _enable_compilation_cache()
         print(json.dumps(sched_bench()), flush=True)
+    elif "--rescache" in sys.argv:
+        # bench flag (ISSUE-9): repeated-query workload through the
+        # result cache — hit rate, warm-vs-cold speedup, bit-identical
+        # gate, zero-admission warm runs; one JSON line
+        _enable_compilation_cache()
+        print(json.dumps(rescache_bench()), flush=True)
     elif "--scan-only" in sys.argv:
         scan_only()
     elif os.environ.get(_CHILD_ENV):

@@ -1,0 +1,257 @@
+#!/usr/bin/env bash
+# Result & fragment cache matrix (ISSUE-9 CI gate):
+#   1. run the rescache test suite (marker `rescache`);
+#   2. cache-OFF gate: with spark.rapids.tpu.rescache.enabled=false the
+#      engine takes the exact pre-cache paths — no ResultCache object
+#      exists, ZERO new threads are spawned, and results are
+#      byte-for-byte identical to a cache-on run;
+#   3. hit-equality gate: a sweep of representative query shapes (scan /
+#      filter / agg / sort / join / window / repartition) runs cold then
+#      warm with the cache on — every warm result must be bit-identical
+#      to its cold run AND to the cache-off oracle;
+#   4. invalidation gate: rewriting a source parquet file and committing
+#      a delta version each force a recompute (stale entries unreachable);
+#   5. single-flight gate: N concurrent identical queries execute ONCE
+#      (one store, N-1 hits);
+#   6. eviction gate: a capacity far below the working set evicts
+#      (cost-aware LRU) while every query stays correct.
+#
+# Usage: scripts/rescache_matrix.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TIMEOUT="${SRTPU_RESCACHE_TIMEOUT:-900}"
+
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_rescache.py -m rescache -q \
+    -p no:cacheprovider "$@"
+
+echo "== cache-off gate (no cache state, zero threads, identical) =="
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu python - <<'EOF'
+import threading
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu import rescache
+from spark_rapids_tpu.expr import Count, Sum, col
+from spark_rapids_tpu.plugin import TpuSession
+
+rng = np.random.default_rng(29)
+n = 30_000
+t = pa.table({"k": pa.array(rng.integers(0, 128, n)),
+              "g": pa.array(rng.integers(0, 32, n).astype(np.int32)),
+              "v": pa.array(rng.uniform(size=n))})
+
+def run(cache_on):
+    sess = TpuSession({"spark.rapids.sql.enabled": True,
+                       "spark.rapids.sql.explain": "NONE",
+                       "spark.rapids.tpu.rescache.enabled": cache_on})
+    q = (sess.from_arrow(t).filter(col("v") > 0.3)
+         .group_by("g").agg(total=Sum(col("v")), cnt=Count(col("k"))))
+    return q.collect().sort_by("g")
+
+threads0 = threading.active_count()
+off = run(False)
+assert not rescache.is_enabled() and rescache.get() is None, \
+    "FAIL: cache state exists with rescache disabled"
+assert rescache.stats() is None
+assert threading.active_count() <= threads0, \
+    f"FAIL: cache-off spawned {threading.active_count() - threads0} threads"
+print("cache-off: no cache object, zero new threads OK")
+
+on = run(True)
+on2 = run(True)
+assert on.equals(off) and on2.equals(off), \
+    "FAIL: cache-on results differ from cache-off"
+s = rescache.stats()
+assert s["hits"].get("query", 0) >= 1, s
+print(f"cache-on identical to off; warm hit served OK ({s['hits']})")
+rescache.shutdown()
+EOF
+
+echo "== hit-equality gate (golden query sweep: warm == cold == off) =="
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu python - <<'EOF'
+import os, tempfile
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from spark_rapids_tpu import rescache
+from spark_rapids_tpu.expr import Count, Sum, col, lit
+from spark_rapids_tpu.plugin import TpuSession
+
+rng = np.random.default_rng(31)
+n = 40_000
+fact = pa.table({"k": pa.array(rng.integers(0, 256, n)),
+                 "g": pa.array(rng.integers(0, 64, n).astype(np.int32)),
+                 "v": pa.array(rng.uniform(size=n))})
+dim = pa.table({"k": pa.array(np.arange(256)),
+                "w": pa.array(rng.uniform(size=256))})
+tmp = tempfile.mkdtemp(prefix="srtpu_rescache_gate_")
+path = os.path.join(tmp, "fact.parquet")
+pq.write_table(fact, path, row_group_size=8192)
+
+def queries(sess):
+    f = sess.read_parquet(path)
+    m = sess.from_arrow(fact)
+    d = sess.from_arrow(dim)
+    return {
+        "scan_filter_agg": lambda: (
+            f.filter(col("v") > 0.4).group_by("g")
+            .agg(total=Sum(col("v")), cnt=Count(col("k")))
+        ).collect().sort_by("g"),
+        "sort_limit": lambda: f.sort(col("v"), ascending=False)
+            .limit(50).collect(),
+        "broadcast_join": lambda: (
+            m.join(d, on="k").group_by("g")
+            .agg(total=Sum(col("v") * col("w")))).collect().sort_by("g"),
+        "repartition_agg": lambda: (
+            m.repartition(4, "k").group_by("k")
+            .agg(c=Count(col("v")))).collect().sort_by("k"),
+        "project": lambda: m.select(
+            (col("v") * 2 + lit(1)).alias("x")).collect(),
+    }
+
+def sweep(cache_on):
+    sess = TpuSession({"spark.rapids.sql.enabled": True,
+                       "spark.rapids.sql.explain": "NONE",
+                       "spark.rapids.tpu.rescache.enabled": cache_on})
+    qs = queries(sess)
+    cold = {name: q() for name, q in qs.items()}
+    warm = {name: q() for name, q in qs.items()}
+    return cold, warm
+
+oracle, _ = sweep(False)
+cold, warm = sweep(True)
+for name in oracle:
+    assert cold[name].equals(oracle[name]), f"FAIL: {name} cold != oracle"
+    assert warm[name].equals(oracle[name]), f"FAIL: {name} warm != oracle"
+s = rescache.stats()
+total_hits = sum(s["hits"].values())
+assert total_hits >= len(oracle), s
+print(f"hit-equality: {len(oracle)} query shapes bit-identical "
+      f"(hits={s['hits']}) OK")
+rescache.shutdown()
+EOF
+
+echo "== invalidation gate (file rewrite + delta commit => recompute) =="
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu python - <<'EOF'
+import os, tempfile, time
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from spark_rapids_tpu import rescache
+from spark_rapids_tpu.datasources.delta.table import DeltaTable
+from spark_rapids_tpu.expr import Count, col, lit
+from spark_rapids_tpu.plugin import TpuSession
+
+tmp = tempfile.mkdtemp(prefix="srtpu_rescache_inv_")
+path = os.path.join(tmp, "f.parquet")
+rng = np.random.default_rng(5)
+def fresh(seed):
+    r = np.random.default_rng(seed)
+    return pa.table({"k": pa.array(r.integers(0, 16, 10_000)),
+                     "v": pa.array(r.uniform(size=10_000))})
+pq.write_table(fresh(1), path)
+sess = TpuSession({"spark.rapids.sql.enabled": True,
+                   "spark.rapids.sql.explain": "NONE",
+                   "spark.rapids.tpu.rescache.enabled": True})
+def q():
+    return (sess.read_parquet(path).group_by("k")
+            .agg(c=Count(col("v")))).collect().sort_by("k")
+r1 = q(); r1b = q()
+assert r1b.equals(r1)
+time.sleep(0.02)
+pq.write_table(fresh(2), path)
+r2 = q()
+assert not r2.equals(r1), "FAIL: rewritten file served stale cache"
+print("file-rewrite invalidation OK")
+
+dt = DeltaTable.create(sess, os.path.join(tmp, "dt"), fresh(3))
+d1 = dt.to_df().collect()
+d1b = dt.to_df().collect()
+assert d1b.equals(d1)
+deleted = dt.delete(col("k") < lit(8))
+d2 = dt.to_df().collect()
+assert d2.num_rows == d1.num_rows - deleted, \
+    "FAIL: delta commit served stale cache"
+print("delta-commit invalidation OK")
+rescache.shutdown()
+EOF
+
+echo "== single-flight + eviction gates =="
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu python - <<'EOF'
+import threading
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu import rescache
+from spark_rapids_tpu.expr import Count, Sum, col
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+from spark_rapids_tpu.plugin import TpuSession
+
+rng = np.random.default_rng(41)
+t = pa.table({"g": pa.array(rng.integers(0, 64, 50_000).astype(np.int32)),
+              "v": pa.array(rng.uniform(size=50_000))})
+sess = TpuSession({"spark.rapids.sql.enabled": True,
+                   "spark.rapids.sql.explain": "NONE",
+                   "spark.rapids.tpu.rescache.enabled": True,
+                   "spark.rapids.tpu.sched.enabled": True})
+sess.initialize_device()
+TpuSemaphore.initialize(sess.conf.concurrent_tpu_tasks, sess.conf)
+df = sess.from_arrow(t).group_by("g").agg(s=Sum(col("v")),
+                                          c=Count(col("v")))
+results, errs = [], []
+def w():
+    try:
+        results.append(df.collect())
+    except Exception as e:
+        errs.append(f"{type(e).__name__}: {e}")
+threads = [threading.Thread(target=w) for _ in range(8)]
+for th in threads: th.start()
+for th in threads: th.join(120)
+assert not errs, errs
+assert all(r.equals(results[0]) for r in results)
+s = rescache.stats()
+assert s["stores"]["query"] == 1, \
+    f"FAIL: {s['stores']['query']} executions for 8 identical queries"
+assert s["hits"]["query"] == 7, s
+print(f"single-flight: 8 concurrent identical queries => 1 execution OK "
+      f"(waits={s['singleflight_waits']})")
+TpuSemaphore._instance = None
+rescache.shutdown()
+
+# eviction under a tight budget: SCAN fragments (megabytes each) against
+# a 1MiB capacity — entries churn while every query stays correct
+import os, tempfile
+import pyarrow.parquet as pq
+tmp = tempfile.mkdtemp(prefix="srtpu_rescache_evict_")
+sess2 = TpuSession({"spark.rapids.sql.enabled": True,
+                    "spark.rapids.sql.explain": "NONE",
+                    "spark.rapids.tpu.rescache.enabled": True,
+                    "spark.rapids.tpu.rescache.query.enabled": False,
+                    "spark.rapids.tpu.rescache.maxBytes": 1 << 20})
+paths = []
+for i in range(4):
+    r = np.random.default_rng(100 + i)
+    f = pa.table({"k": pa.array(r.integers(0, 64, 30_000)),
+                  "v": pa.array(r.uniform(size=30_000))})
+    p = os.path.join(tmp, f"f{i}.parquet")
+    pq.write_table(f, p, row_group_size=8192)
+    paths.append(p)
+def agg(p):
+    return (sess2.read_parquet(p).group_by("k")
+            .agg(s=Sum(col("v")))).collect().sort_by("k")
+expected = {p: agg(p) for p in paths}
+for p in paths:
+    assert agg(p).equals(expected[p]), "FAIL: eviction churn corrupted"
+s = rescache.stats()
+assert s["evictions"] >= 1, f"FAIL: no evictions under 1MiB cap: {s}"
+assert s["bytes"] <= (1 << 20), s
+print(f"eviction: capacity held ({s['bytes']}B <= 1MiB, "
+      f"evictions={s['evictions']}), results correct OK")
+rescache.shutdown()
+EOF
+
+echo "rescache matrix: ALL GATES PASSED"

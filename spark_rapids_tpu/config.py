@@ -506,6 +506,48 @@ register("spark.rapids.tpu.sched.tenant.quotas", "string", "",
          "to its share instead of evicting another tenant's working set. "
          "Empty = no sub-quotas (global budget only).")
 
+# Result & fragment cache ------------------------------------------------------------
+register("spark.rapids.tpu.rescache.enabled", "bool", False,
+         "Result & fragment cache: transparently reuse materialized "
+         "columnar fragments (scan output, shuffle-exchange output, "
+         "broadcast payloads) and whole-query results across queries, "
+         "keyed by a canonical plan fingerprint (exec tree + bound-"
+         "expression reprs + output schema + source-file identity + "
+         "result-affecting confs). A whole-query hit answers without "
+         "touching the device (no admission token). Off (default) keeps "
+         "every execution path byte-for-byte pre-cache: zero threads, "
+         "zero state (scripts/rescache_matrix.sh gates it).")
+register("spark.rapids.tpu.rescache.maxBytes", "bytes", 512 << 20,
+         "Cache capacity across all entries (device fragments count "
+         "their batch bytes, host results/blobs their host bytes). "
+         "Inserting past it evicts by cost-aware LRU: lowest "
+         "(recompute-time x (1+hits)) / bytes goes first, so cheap-to-"
+         "recompute bulk leaves before expensive small results. Device "
+         "fragments additionally ride the spill catalog's device->host->"
+         "disk tiers under memory pressure, independent of this cap.")
+register("spark.rapids.tpu.rescache.query.enabled", "bool", True,
+         "Cache whole-query results (TpuSession.execute_plan seam). A "
+         "hit takes the fast path: the reply is served from the host "
+         "copy without device admission.")
+register("spark.rapids.tpu.rescache.scan.enabled", "bool", True,
+         "Cache file-scan output fragments (TpuFileScanExec seam), "
+         "keyed by (path, mtime, size) per file so a rewritten source "
+         "recomputes. Scans carrying runtime dynamic-pruning filters "
+         "are never cached (their output depends on the join's build "
+         "keys).")
+register("spark.rapids.tpu.rescache.exchange.enabled", "bool", True,
+         "Cache shuffle-exchange output fragments (TpuShuffleExchange"
+         "Exec seam; local shuffle modes only — ICI mesh exchanges "
+         "produce sharded arrays the spill catalog cannot own).")
+register("spark.rapids.tpu.rescache.broadcast.enabled", "bool", True,
+         "Cache broadcast payload blobs (TpuBroadcastExchangeExec "
+         "seam): the host-serialized build side is reused across "
+         "queries, skipping child re-execution and re-serialization.")
+register("spark.rapids.tpu.rescache.minRecomputeMs", "double", 0.0,
+         "Only store a fragment/result whose recompute cost was at "
+         "least this many milliseconds — keeps trivially cheap "
+         "fragments from churning the capacity. 0 stores everything.")
+
 # Compile service --------------------------------------------------------------------
 register("spark.rapids.tpu.compile.enabled", "bool", True,
          "Route every kernel compile through the centralized compile "

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import io
 import threading
+import weakref
 from typing import Iterator, List, Optional
 
 import pyarrow as pa
@@ -23,6 +24,17 @@ import pyarrow.parquet as pq
 from ..columnar.batch import Schema
 from ..config import register
 from ..plan.nodes import PhysicalPlan
+
+# every CpuCachedExec that ever MATERIALIZED a relation, weakly — the
+# telemetry gauge tpu_cached_relation_bytes sums live blob bytes over
+# these, so explicit df.cache() memory shows on the scrape surface and
+# drops to zero on unpersist() (host RAM held by the serializer was
+# previously invisible to operators)
+_LIVE_CACHED: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def live_cached_execs():
+    return list(_LIVE_CACHED)
 
 register("spark.rapids.sql.cache.compression", "string", "zstd",
          "Parquet compression codec for cached batches "
@@ -75,6 +87,9 @@ class CpuCachedExec(PhysicalPlan):
 
     def unpersist(self) -> None:
         with self.lock:
+            # dropping the relation releases the parquet blob bytes (the
+            # only strong reference); the cached-relation telemetry gauge
+            # reads 0 for this node from here on
             self.relation = None
 
     def store_tables(self, tables: List[pa.Table]) -> CachedRelation:
@@ -86,6 +101,7 @@ class CpuCachedExec(PhysicalPlan):
                 blobs = [encode_table(tables[0], self.codec)]
             self.relation = CachedRelation(
                 blobs, self.output, sum(t.num_rows for t in tables))
+            _LIVE_CACHED.add(self)  # blob bytes become gauge-visible
             return self.relation
 
     def execute_cpu(self):

@@ -216,7 +216,17 @@ class DeltaTable:
         return pa.concat_tables([pq.read_table(f) for f in files])
 
     def to_df(self, version: Optional[int] = None):
-        return self.session.from_arrow(self.read(version), label="delta")
+        df = self.session.from_arrow(self.read(version), label="delta")
+        # stable cross-query rescache identity: a delta version's content
+        # is immutable, so (table root, version) keys the scan — two
+        # to_df() calls at the same version share cache entries even
+        # though each materializes a fresh arrow table, and a new commit
+        # (version bump) re-keys everything downstream (invalidation by
+        # construction)
+        df.plan.fingerprint_token = (
+            "delta", os.path.abspath(self.path),
+            self.version if version is None else int(version))
+        return df
 
     # ------------------------------------------------------------- DML
     def delete(self, condition: Expression) -> int:

@@ -44,25 +44,39 @@ class TpuBroadcastExchangeExec(UnaryTpuExec):
         return self.child.output
 
     def _materialize_blob(self) -> None:
-        from ..shuffle.serializer import serialize_batch
         with self._lock:
             if self._blob is not None or self._empty:
                 return
-            with self.collect_time.timed():
-                batches = list(self.child.execute())
-            if not batches:
+            # broadcast rescache seam: an identical build subtree's
+            # host-serialized payload is reused across queries (instance
+            # caching already dedups consumers WITHIN one query; the
+            # fragment cache extends it across rebuilt exec trees). The
+            # blob is host bytes, so a hit costs no device work.
+            from .. import rescache
+            blob = rescache.cached_blob(self, self._build_blob)
+            if blob is None:
                 self._empty = True
                 return
-            with self.build_time.timed():
-                batch = concat_batches(batches)
-                del batches
-                codec = self.conf.get("spark.rapids.shuffle.compression.codec")
-                from ..shuffle.codec import checksum_supported
-                self._blob = serialize_batch(
-                    batch, codec, checksum=checksum_supported()
-                    and self.conf.get(
-                        "spark.rapids.shuffle.checksum.enabled"))
-            self.data_size.add(len(self._blob))
+            self._blob = blob
+            self.data_size.add(len(blob))
+
+    def _build_blob(self) -> Optional[bytes]:
+        """Execute the child once and serialize the concatenated build
+        side to one host blob (None = empty build side)."""
+        from ..shuffle.serializer import serialize_batch
+        with self.collect_time.timed():
+            batches = list(self.child.execute())
+        if not batches:
+            return None
+        with self.build_time.timed():
+            batch = concat_batches(batches)
+            del batches
+            codec = self.conf.get("spark.rapids.shuffle.compression.codec")
+            from ..shuffle.codec import checksum_supported
+            return serialize_batch(
+                batch, codec, checksum=checksum_supported()
+                and self.conf.get(
+                    "spark.rapids.shuffle.checksum.enabled"))
 
     def do_execute(self) -> Iterator[ColumnarBatch]:
         self._materialize_blob()

@@ -92,6 +92,16 @@ class TpuShuffleExchangeExec(UnaryTpuExec):
         self.read_time = self.metrics.create(M.READ_TIME, M.MODERATE)
 
     def do_execute(self) -> Iterator[ColumnarBatch]:
+        """Exchange-output rescache seam: an identical subplan's
+        partitioned output replays from the cached fragments instead of
+        re-executing the child and re-shuffling (local shuffle modes
+        only; the ICI mesh path is gated off in rescache). Off (default)
+        this is the produce path verbatim."""
+        from .. import rescache
+        yield from rescache.fragment_stream(self, "exchange",
+                                            self._do_execute_produce)
+
+    def _do_execute_produce(self) -> Iterator[ColumnarBatch]:
         batches = list(self.child.execute())
         mode = self.conf.get("spark.rapids.shuffle.mode")
         if mode == "ICI":

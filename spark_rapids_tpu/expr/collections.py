@@ -260,6 +260,11 @@ class CreateNamedStruct(Expression):
         super().__init__(list(values))
         self.names = list(names)
 
+    def __repr__(self):
+        pairs = ", ".join(f"{n!r}: {v!r}"
+                          for n, v in zip(self.names, self.children))
+        return f"{self.name}({pairs})"
+
     @property
     def data_type(self):
         return T.StructType(tuple(
@@ -341,6 +346,11 @@ class SortArray(Expression):
     def __init__(self, child: Expression, ascending: bool = True):
         super().__init__([child])
         self.ascending = ascending
+
+    def __repr__(self):
+        # sort direction changes the traced program; repr-derived cache
+        # keys must not alias ascending with descending
+        return f"{self.name}({self.children[0]!r}, {self.ascending})"
 
     @property
     def data_type(self):

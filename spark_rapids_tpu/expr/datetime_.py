@@ -278,6 +278,12 @@ class MonthsBetween(Expression):
         super().__init__([end, start])
         self.round_off = round_off
 
+    def __repr__(self):
+        # round_off changes the traced program; repr-derived cache keys
+        # must see it (compile service / rescache fingerprints)
+        return (f"{self.name}({self.children[0]!r}, {self.children[1]!r}, "
+                f"{self.round_off})")
+
     @property
     def data_type(self):
         return T.DOUBLE
@@ -320,6 +326,12 @@ class TruncDate(Expression):
         super().__init__([date])
         self.fmt = fmt.upper()
 
+    def __repr__(self):
+        # the trunc unit bakes into the traced program; without it in the
+        # repr two trunc(date, ...) calls with different units alias in
+        # repr-derived cache keys (the PR-3/PR-4 aliasing bug class)
+        return f"{self.name}({self.children[0]!r}, {self.fmt!r})"
+
     @property
     def data_type(self):
         return T.DATE
@@ -357,6 +369,9 @@ class NextDay(Expression):
         super().__init__([date])
         self.day_name = day_name
         self.target = self._DOW.get(day_name.strip().upper()[:2])
+
+    def __repr__(self):
+        return f"{self.name}({self.children[0]!r}, {self.day_name!r})"
 
     @property
     def data_type(self):
@@ -529,6 +544,9 @@ class TruncTimestamp(Expression):
     def __init__(self, fmt: str, child):
         super().__init__([child])
         self.fmt = fmt.upper()
+
+    def __repr__(self):
+        return f"{self.name}({self.fmt!r}, {self.children[0]!r})"
 
     @property
     def data_type(self):

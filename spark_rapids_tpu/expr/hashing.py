@@ -183,6 +183,12 @@ class Murmur3Hash(Expression):
         super().__init__(list(children))
         self.seed = seed
 
+    def __repr__(self):
+        # the seed bakes into the traced program; repr-derived cache keys
+        # must not alias hashes with different seeds
+        kids = ", ".join(map(repr, self.children))
+        return f"{self.name}({kids}, seed={self.seed})"
+
     @property
     def data_type(self):
         return T.INT

@@ -457,6 +457,11 @@ class Sha2(Expression):
         super().__init__([child])
         self.bits = int(bits)
 
+    def __repr__(self):
+        # bits selects the digest algorithm AND output width; repr-derived
+        # cache keys must not alias sha2(x, 256) with sha2(x, 512)
+        return f"{self.name}({self.children[0]!r}, {self.bits})"
+
     @property
     def data_type(self):
         return T.STRING
@@ -658,6 +663,10 @@ class XxHash64(Expression):
     def __init__(self, children: Sequence[Expression], seed: int = 42):
         super().__init__(list(children))
         self.seed = seed
+
+    def __repr__(self):
+        kids = ", ".join(map(repr, self.children))
+        return f"{self.name}({kids}, seed={self.seed})"
 
     @property
     def data_type(self):

@@ -325,6 +325,12 @@ class JsonToStructs(Expression):
                     "from_json supports flat structs of primitives only")
         self.schema = schema
 
+    def __repr__(self):
+        # the target schema selects the parse program and output layout;
+        # repr-derived cache keys must not alias different schemas
+        return (f"{self.name}({self.children[0]!r}, "
+                f"{self.schema.simple_string()})")
+
     @property
     def data_type(self):
         return self.schema

@@ -487,6 +487,10 @@ class StringToMap(Expression):
         self.pair_delim = pair_delim
         self.kv_delim = kv_delim
 
+    def __repr__(self):
+        return (f"{self.name}({self.children[0]!r}, {self.pair_delim!r}, "
+                f"{self.kv_delim!r})")
+
     @property
     def data_type(self):
         return T.MapType(T.STRING, T.STRING)

@@ -203,6 +203,12 @@ class Round(Expression):
         super().__init__([child])
         self.scale = scale
 
+    def __repr__(self):
+        # scale bakes into the traced program: repr-derived cache keys
+        # (compile service, rescache fingerprints) must not alias
+        # round(x, 0) with round(x, 2)
+        return f"{self.name}({self.children[0]!r}, {self.scale})"
+
     @property
     def data_type(self):
         return self.children[0].data_type
@@ -303,6 +309,12 @@ class BRound(Expression):
     def __init__(self, child, scale: int = 0):
         super().__init__([child])
         self.scale = scale
+
+    def __repr__(self):
+        # scale bakes into the traced program: repr-derived cache keys
+        # (compile service, rescache fingerprints) must not alias
+        # round(x, 0) with round(x, 2)
+        return f"{self.name}({self.children[0]!r}, {self.scale})"
 
     @property
     def data_type(self):

@@ -28,6 +28,10 @@ class SparkPartitionID(LeafExpression):
     it) — same contract as the reference's per-task constant."""
 
     has_side_effects = False
+    # execution-placement dependent, like Spark's SparkPartitionID
+    # (nondeterministic): a subtree containing it must never be cached
+    # or reused across plans (rescache/fingerprint.py gates on this)
+    deterministic = False
 
     @property
     def data_type(self):
@@ -49,6 +53,10 @@ class MonotonicallyIncreasingID(LeafExpression):
     """monotonically_increasing_id(): (partition << 33) + row ordinal within
     the partition; single-partition engine -> plain row ordinal per batch
     stream (the exec's batch offset rides ctx.partition_row_offset)."""
+
+    # ids depend on batch arrival order (Spark marks it nondeterministic);
+    # uncacheable for rescache fingerprints
+    deterministic = False
 
     @property
     def data_type(self):
@@ -75,6 +83,10 @@ class MonotonicallyIncreasingID(LeafExpression):
 class InputFileName(LeafExpression):
     """input_file_name(): empty string outside a file-scan task (Spark
     contract); scans don't thread the path into expression context yet."""
+
+    # task-placement dependent (which file fed the row), like the
+    # reference's InputFileName: never cacheable across plans
+    deterministic = False
 
     @property
     def data_type(self):

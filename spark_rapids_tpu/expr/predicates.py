@@ -251,6 +251,11 @@ class In(Expression):
         super().__init__([value])
         self.items = list(items)
 
+    def __repr__(self):
+        # the item list bakes into the traced program: repr-derived cache
+        # keys must not alias `x IN (1)` with `x IN (2, 3)`
+        return f"{self.name}({self.children[0]!r}, {self.items!r})"
+
     @property
     def data_type(self):
         return T.BOOLEAN

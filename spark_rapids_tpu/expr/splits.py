@@ -41,6 +41,10 @@ class StringSplit(Expression):
         self.pattern = pattern
         self.limit = int(limit)
 
+    def __repr__(self):
+        return (f"{self.name}({self.children[0]!r}, {self.pattern!r}, "
+                f"{self.limit})")
+
     @property
     def data_type(self):
         return T.ArrayType(T.STRING, contains_null=False)
@@ -166,6 +170,10 @@ class RegExpExtractAll(Expression):
         self.idx = int(idx)
         check_group_index(self.pattern, self.idx)
 
+    def __repr__(self):
+        return (f"{self.name}({self.children[0]!r}, {self.pattern!r}, "
+                f"{self.idx})")
+
     @property
     def data_type(self):
         return T.ArrayType(T.STRING, contains_null=False)
@@ -209,6 +217,10 @@ class ArraysZip(Expression):
         super().__init__(list(children))
         self.names = list(names) or [str(i) for i in
                                      range(len(self.children))]
+
+    def __repr__(self):
+        kids = ", ".join(map(repr, self.children))
+        return f"{self.name}({kids}, names={self.names!r})"
 
     @property
     def data_type(self):

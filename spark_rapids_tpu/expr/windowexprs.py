@@ -176,7 +176,10 @@ class _OffsetFunction(WindowFunction):
         return True
 
     def __repr__(self):
-        return f"{self.name}({self.children[0]!r}, {self.offset})"
+        # default fills out-of-partition slots in the traced program, so
+        # repr-derived cache keys must see it alongside the offset
+        return f"{self.name}({self.children[0]!r}, {self.offset}, " \
+               f"{self.default!r})"
 
 
 class Lead(_OffsetFunction):

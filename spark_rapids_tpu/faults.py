@@ -32,6 +32,12 @@ Injection points (the catalog; call sites reference these constants):
                                            the service _Admission); injected
                                            failures degrade to the typed
                                            QueryRejectedError
+  cache.fragment      rescache/            result/fragment-cache lookup and
+                                           store; ANY injected failure
+                                           degrades to recompute (miss /
+                                           skipped store) — the cache may
+                                           never turn a fault into a wrong
+                                           or missing result
 
 A rule fires on the Nth eligible call (`nth`), or with seeded probability
 (`probability`), at most `times` times (0 = unlimited). Kinds:
@@ -62,7 +68,7 @@ __all__ = ["FaultRule", "FaultInjector", "fire", "inject",
            "install_from_conf", "ALL_POINTS",
            "ALLOC", "SPILL_WRITE", "SPILL_READ", "BLOCK_WRITE", "BLOCK_READ",
            "FETCH", "TCP_SEND", "TCP_RECV", "ADMISSION", "DEVICE_INIT",
-           "COMPILE", "PREFETCH", "SCHED_ADMIT"]
+           "COMPILE", "PREFETCH", "SCHED_ADMIT", "CACHE_FRAGMENT"]
 
 ALLOC = "memory.alloc"
 SPILL_WRITE = "spill.write"
@@ -77,10 +83,11 @@ DEVICE_INIT = "device.init"
 COMPILE = "compile"
 PREFETCH = "pipeline.prefetch"
 SCHED_ADMIT = "sched.admit"
+CACHE_FRAGMENT = "cache.fragment"
 
 ALL_POINTS = (ALLOC, SPILL_WRITE, SPILL_READ, BLOCK_WRITE, BLOCK_READ,
               FETCH, TCP_SEND, TCP_RECV, ADMISSION, DEVICE_INIT, COMPILE,
-              PREFETCH, SCHED_ADMIT)
+              PREFETCH, SCHED_ADMIT, CACHE_FRAGMENT)
 
 # named exception factories for the config-spec grammar
 _ERROR_NAMES: Dict[str, Callable[[str], Exception]] = {

@@ -79,6 +79,20 @@ def _stat_bounds(cm, column_phys_type):
     return st.min, st.max
 
 
+def _note_footer_error(where: str, exc: BaseException,
+                       path: str = "") -> None:
+    """A footer/statistics read failed: the file/row group is KEPT (never
+    a correctness gate), but silent degradation would hide that pruning
+    stopped working — count it and drop a span event so the profile and
+    the scrape surface both show the optimization disengaging."""
+    from .. import telemetry
+    from ..utils import spans
+    telemetry.inc("tpu_dpp_footer_errors_total")
+    with spans.span("dpp:footer_error", kind=spans.KIND_IO) as sp:
+        sp.put(where=where, error=f"{type(exc).__name__}: {exc}",
+               **({"path": path} if path else {}))
+
+
 def row_group_overlaps(meta, ci: int, rg: int,
                        filt: DynamicKeyFilter) -> bool:
     """True if row group rg MIGHT contain one of the filter's keys (i.e.
@@ -90,7 +104,8 @@ def row_group_overlaps(meta, ci: int, rg: int,
         if b is None:
             return True
         return filt._range_has_key(b[0], b[1])
-    except Exception:
+    except Exception as e:
+        _note_footer_error("row_group_overlaps", e)
         return True
 
 
@@ -125,7 +140,10 @@ def prune_parquet_paths(paths: Sequence[str],
                            for rg in range(meta.num_row_groups)):
                     keep = False
                     break
-        except Exception:
+        except Exception as e:
+            # unreadable footer: keep the file, but never silently — the
+            # counter + span event make the pruning degradation visible
+            _note_footer_error("prune_parquet_paths", e, path=str(p))
             keep = True
         if keep:
             kept.append(p)
@@ -149,5 +167,6 @@ def row_group_filter(meta, col_index: dict,
                    for f, ci in active):
                 keep.add(rg)
         return keep
-    except Exception:
+    except Exception as e:
+        _note_footer_error("row_group_filter", e)
         return None

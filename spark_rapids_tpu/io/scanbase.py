@@ -199,6 +199,17 @@ class TpuFileScanExec(_TpuExec):
         return kept
 
     def do_execute(self):
+        """Scan-output rescache seam: with the fragment cache on, an
+        identical scan (same files at the same (mtime, size), columns,
+        options and decode confs) streams the cached fragments back from
+        the spill catalog instead of re-reading and re-decoding; scans
+        carrying dynamic-pruning filters never cache. Off (default) this
+        is the produce path verbatim."""
+        from .. import rescache
+        yield from rescache.fragment_stream(self, "scan",
+                                            self._do_execute_produce)
+
+    def _do_execute_produce(self):
         """Time every batch-producing pull into readTime, each under its
         own io span: a span per PULL, not per stream, so time the scan
         iterator spends suspended (downstream sort/join work) never

@@ -39,6 +39,11 @@ class TpuSession:
         # unless spark.rapids.tpu.telemetry.enabled — the off path must
         # create no state and spawn no threads (telemetry_matrix.sh gate)
         telemetry.configure(self.conf)
+        from . import rescache
+        # result & fragment cache: a no-op unless
+        # spark.rapids.tpu.rescache.enabled — the off path must create no
+        # state and spawn no threads (rescache_matrix.sh gate)
+        rescache.configure(self.conf)
         from .compile import CompileService
         # compile service first: warmup precompiles on a background thread
         # while the rest of init (and the first plan rewrite) proceeds
@@ -164,14 +169,47 @@ class TpuSession:
     def _execute_rewritten(self, plan: PhysicalPlan,
                            use_device: Optional[bool] = None):
         """Plan-rewrite + run one (sub)plan; returns a pyarrow Table. The
-        adaptive loop calls this once per query stage."""
+        adaptive loop calls this once per query stage.
+
+        Whole-query rescache seam: with the result cache on, a plan whose
+        fingerprint matches a stored result is answered from the host
+        copy IMMEDIATELY — before the override rewrite and before any
+        admission (a hit consumes no semaphore token and no scheduler
+        grant; TaskMetrics.sched_admissions stays 0). Concurrent
+        identical queries single-flight behind the first execution."""
+        enabled = self.conf.is_sql_enabled if use_device is None else \
+            use_device
+        qh = None
+        if enabled:
+            self.initialize_device()
+            from .utils.metrics import TaskMetrics
+            # fresh counters per query, BEFORE the cache lookup: a hit's
+            # rescache counters (and its zero admissions) must describe
+            # THIS query, not whatever ran last on this thread
+            TaskMetrics.reset()
+            from . import rescache
+            if rescache.is_enabled():
+                qh = rescache.begin_query(plan, self.conf)
+                if qh is not None and qh.hit is not None:
+                    return qh.hit
+        try:
+            out = self._run_rewritten(plan, enabled)
+        except BaseException:
+            if qh is not None:
+                # release the single-flight marker so a parked identical
+                # query takes over as the next owner
+                qh.abort()
+            raise
+        if qh is not None:
+            qh.complete(out)
+        return out
+
+    def _run_rewritten(self, plan: PhysicalPlan, enabled: bool):
         from .cpu.hostbatch import host_batch_to_arrow
         from .exec.base import TpuExec
         from .exec.transitions import device_batch_to_host
         from .plan.nodes import _concat_host
 
-        enabled = self.conf.is_sql_enabled if use_device is None else \
-            use_device
         if enabled:
             self.initialize_device()
             ov = Overrides(self.conf)
@@ -190,9 +228,10 @@ class TpuSession:
                                  SplitAndRetryOOM)
             from .utils import spans
             from .utils.metrics import TaskMetrics
-            # fresh counters per query: the explain line below must report
-            # THIS query's retries, not the session's accumulated history
-            TaskMetrics.reset()
+            # per-query counter reset happens in _execute_rewritten, BEFORE
+            # the rescache lookup (a TpuExec result implies enabled, which
+            # implies the reset ran) — the explain line below still reports
+            # only THIS query's retries
             from .memory.budget import MemoryBudget
             MemoryBudget.get().reset_peak()
             # query profiler: activated by the event-log dir or the

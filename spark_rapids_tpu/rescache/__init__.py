@@ -1,0 +1,432 @@
+"""Result & fragment cache — transparent cross-query materialized reuse.
+
+The reference engine reuses work within ONE query (`ReusedExchangeExec`
+instance caching) or on explicit request (`df.cache()`); a multi-tenant
+`TpuServer` re-executes an identical dashboard query from scratch for
+every client. This package adds the serving-layer multiplier the Presto
+acceleration work leans on: a transparent cache of materialized columnar
+fragments keyed by a canonical plan fingerprint (fingerprint.py), with
+caching seams at the engine's natural fragment boundaries —
+
+  * whole-query results  — plugin.TpuSession._execute_rewritten: a hit
+    answers from the host copy WITHOUT device admission (no semaphore
+    token, no scheduler grant — the cache-hit fast path);
+  * scan output          — io/scanbase.TpuFileScanExec;
+  * shuffle-exchange out — exec/exchange.TpuShuffleExchangeExec;
+  * broadcast payloads   — exec/broadcast.TpuBroadcastExchangeExec.
+
+Correctness gates: nondeterministic subtrees never get a key
+(fingerprint.py fail-closed), the `cache.fragment` fault point degrades
+ANY cache failure to recompute (never a wrong result), mid-flight
+eviction under a streaming hit re-produces and skips already-served
+batches, and single-flight per fingerprint dedups concurrent identical
+queries across tenants.
+
+Off-path contract (mirrors faults/telemetry/sched): with
+`spark.rapids.tpu.rescache.enabled=false` (default) every hook below is
+one module-global bool check, no cache object exists, and zero threads
+are spawned — scripts/rescache_matrix.sh gates it."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Iterator, Optional
+
+from .cache import Entry, ResultCache
+from .fingerprint import RESULT_CONF_KEYS, Fingerprint, fingerprint
+
+__all__ = ["configure", "shutdown", "is_enabled", "get", "stats",
+           "invalidate", "begin_query", "QueryCacheHandle",
+           "fragment_stream", "cached_blob", "fingerprint",
+           "ResultCache", "RESULT_CONF_KEYS"]
+
+_ACTIVE = False
+_mu = threading.Lock()
+_cache: Optional[ResultCache] = None
+
+# fragment seams bound their single-flight wait: a mid-query seam must
+# not park forever behind another query's producer (whole-query waits are
+# unbounded — the wait IS the dedup win)
+FRAGMENT_WAIT_S = 30.0
+
+
+def is_enabled() -> bool:
+    return _ACTIVE
+
+
+def get() -> Optional[ResultCache]:
+    return _cache
+
+
+def configure(conf) -> None:
+    """Enable per `spark.rapids.tpu.rescache.*` (no-op when the switch is
+    off or the cache is already up). Called from
+    TpuSession.initialize_device, like telemetry.configure."""
+    global _ACTIVE, _cache
+    if not conf.get("spark.rapids.tpu.rescache.enabled"):
+        return
+    with _mu:
+        if _ACTIVE:
+            return
+        _cache = ResultCache(
+            max_bytes=conf.get("spark.rapids.tpu.rescache.maxBytes"),
+            min_recompute_ms=conf.get(
+                "spark.rapids.tpu.rescache.minRecomputeMs"))
+        _ACTIVE = True
+
+
+def shutdown() -> None:
+    """Tear the cache down (tests / process exit): close every entry,
+    drop all state."""
+    global _ACTIVE, _cache
+    with _mu:
+        _ACTIVE = False
+        cache, _cache = _cache, None
+    if cache is not None:
+        cache.invalidate()
+
+
+def stats() -> Optional[dict]:
+    cache = _cache
+    return cache.stats() if cache is not None else None
+
+
+def invalidate() -> int:
+    cache = _cache
+    return cache.invalidate() if cache is not None else 0
+
+
+# ---------------------------------------------------------------- helpers
+def _tenant() -> str:
+    from ..sched import context as _qctx
+    return _qctx.current_tenant() or "default"
+
+
+def _count_degraded(where: str, **attrs) -> None:
+    """The ONE degrade-to-recompute accounting sequence (task counter,
+    cache lifetime counter, telemetry counter, flight event) — every
+    degrade path must report identically or the scrape surface and
+    cache_stats drift apart."""
+    from .. import telemetry
+    from ..utils.metrics import TaskMetrics
+    TaskMetrics.get().rescache_degraded += 1
+    cache = _cache
+    if cache is not None:
+        cache.degraded_count += 1
+    telemetry.inc("tpu_rescache_degraded_total")
+    telemetry.flight("cache", "degraded", where=where, **attrs)
+
+
+def _fault_gate(where: str) -> bool:
+    """Fire the cache.fragment fault point; True = proceed, False =
+    degrade (skip the cache this time — recompute, never a wrong or
+    missing result)."""
+    from .. import faults
+    try:
+        faults.fire(faults.CACHE_FRAGMENT)
+        return True
+    except Exception as e:
+        _count_degraded(where, error=f"{type(e).__name__}: {e}")
+        return False
+
+
+def _count_hit(seam: str) -> None:
+    from .. import telemetry
+    from ..utils.metrics import TaskMetrics
+    TaskMetrics.get().rescache_hits += 1
+    telemetry.inc("tpu_rescache_hits_total", seam=seam, tenant=_tenant())
+
+
+def _count_miss(seam: str) -> None:
+    from .. import telemetry
+    from ..utils.metrics import TaskMetrics
+    TaskMetrics.get().rescache_misses += 1
+    telemetry.inc("tpu_rescache_misses_total", seam=seam, tenant=_tenant())
+
+
+# ----------------------------------------------------------- query seam
+class QueryCacheHandle:
+    """Owner-side handle for the whole-query seam: plugin.py calls
+    complete(table) on success or abort() on any unwind, so parked
+    single-flight waiters are always released."""
+
+    __slots__ = ("_key", "_validators", "_t0", "hit", "_done")
+
+    def __init__(self, key: str, validators, hit=None):
+        self._key = key
+        self._validators = validators
+        self._t0 = time.monotonic_ns()
+        self.hit = hit  # pyarrow Table on a cache hit, else None
+        self._done = hit is not None
+
+    def complete(self, table) -> None:
+        if self._done:
+            return
+        self._done = True
+        cache = _cache
+        if cache is None:
+            return
+        if not _fault_gate("query.store"):
+            cache.abort(self._key)
+            return
+        try:
+            nbytes = int(table.nbytes)
+        except Exception:
+            nbytes = 0
+        cache.complete(self._key, "query", "table", table, nbytes,
+                       time.monotonic_ns() - self._t0,
+                       validators=self._validators)
+
+    def abort(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        cache = _cache
+        if cache is not None:
+            cache.abort(self._key)
+
+
+def begin_query(plan, conf) -> Optional[QueryCacheHandle]:
+    """Whole-query seam entry. Returns None when the cache is off or the
+    plan is uncacheable; a handle with `.hit` set (serve it — no device
+    admission happens); or an owner handle (execute, then complete/abort).
+    Concurrent identical queries park here (single-flight) and come back
+    as hits when the owner finishes."""
+    if not _ACTIVE:
+        return None
+    if not conf.get("spark.rapids.tpu.rescache.query.enabled"):
+        return None
+    cache = _cache
+    if cache is None:
+        return None
+    if not _fault_gate("query.lookup"):
+        return None
+    fp = fingerprint(plan, conf, extra="query|")
+    if fp is None:
+        return None
+    from ..utils import spans
+    status, entry = cache.begin(fp.digest, "query")
+    if status == "hit":
+        table = entry.value
+        cache.unpin(entry)  # host tables are immutable; safe past unpin
+        if table is None:
+            # entry closed between begin() and here (concurrent
+            # invalidate): recompute WITHOUT a handle — this path was
+            # never made owner, so a complete() would pop someone
+            # else's in-flight marker
+            _count_degraded("query.hit_closed")
+            return None
+        _count_hit("query")
+        with spans.span("rescache:query", kind=spans.KIND_CACHE, hit=1,
+                        bytes=entry.nbytes):
+            pass
+        from .. import telemetry
+        telemetry.flight("cache", "query_hit", bytes=entry.nbytes)
+        return QueryCacheHandle(fp.digest, fp.validators, hit=table)
+    _count_miss("query")
+    with spans.span("rescache:query", kind=spans.KIND_CACHE, hit=0):
+        pass
+    if status != "owner":
+        # bypass (unstorable fingerprint): compute WITHOUT a handle — a
+        # complete() here would pop another owner's in-flight marker
+        return None
+    return QueryCacheHandle(fp.digest, fp.validators)
+
+
+# -------------------------------------------------------- fragment seams
+def fragment_stream(node, seam: str,
+                    produce: Callable[[], Iterator]) -> Iterator:
+    """Wrap a fragment-producing exec seam (scan / exchange).
+
+    Miss: stream `produce()` through, parking a spillable copy of every
+    batch; the completed list becomes the cache entry. Hit: materialize
+    the stored fragments back onto the device; any failure mid-stream
+    (eviction, injected fault, spill-read error) degrades to a fresh
+    `produce()` that skips the batches already served — in-process
+    producers are deterministic, so batch boundaries repeat."""
+    if not _ACTIVE:
+        yield from produce()
+        return
+    conf = node.conf
+    if not conf.get(f"spark.rapids.tpu.rescache.{seam}.enabled"):
+        yield from produce()
+        return
+    if seam == "exchange" and conf.get("spark.rapids.shuffle.mode") == "ICI":
+        # mesh exchanges can yield sharded arrays the spill catalog
+        # cannot own; the conservative gate is the mode, not the topology
+        yield from produce()
+        return
+    cache = _cache
+    if cache is None or not _fault_gate(f"{seam}.lookup"):
+        yield from produce()
+        return
+    fp = fingerprint(node, conf, extra=f"{seam}|")
+    if fp is None:
+        yield from produce()
+        return
+    from ..utils import spans
+    status, entry = cache.begin(fp.digest, seam,
+                                max_wait_s=FRAGMENT_WAIT_S)
+    if status == "hit":
+        _count_hit(seam)
+        with spans.span(f"rescache:{seam}", kind=spans.KIND_CACHE, hit=1,
+                        bytes=entry.nbytes):
+            pass
+        try:
+            yield from _serve_fragments(node, entry, produce)
+        finally:
+            cache.unpin(entry)
+        return
+    _count_miss(seam)
+    with spans.span(f"rescache:{seam}", kind=spans.KIND_CACHE, hit=0):
+        pass
+    if status != "owner":  # bypass: compute without storing
+        yield from produce()
+        return
+    yield from _produce_and_store(node, seam, fp, produce)
+
+
+def _serve_fragments(node, entry: Entry, produce) -> Iterator:
+    from ..errors import (DeadlineExceededError, QueryCancelledError,
+                          QueryRejectedError)
+    value = entry.value
+    if value is None:
+        # entry closed between begin() and here (invalidate/shutdown runs
+        # regardless of pins): recompute from scratch — an empty tuple
+        # here would silently serve ZERO batches as the "result"
+        _count_degraded("fragment.hit_closed", seam=entry.seam)
+        yield from produce()
+        return
+    frags = tuple(value)
+    served = 0
+    served_rows = 0
+    try:
+        for sb in frags:
+            batch = sb.get_batch()
+            rows = int(batch.row_count())
+            node.num_output_rows.add(rows)
+            served_rows += rows
+            yield node._count_output(batch)
+            served += 1
+        return
+    except (QueryCancelledError, DeadlineExceededError,
+            QueryRejectedError):
+        raise  # typed unwinds are the query's, not the cache's
+    except GeneratorExit:
+        raise
+    except Exception as e:
+        # mid-flight eviction / injected fault / spill-read failure:
+        # degrade to recompute, skipping what already went downstream
+        _count_degraded("fragment.hit_midflight", seam=entry.seam,
+                        served=served, error=f"{type(e).__name__}: {e}")
+    # the fresh produce() recounts EVERY batch it yields — including the
+    # skipped prefix this stream already counted above — so pre-credit
+    # the served prefix or the operator's output metrics double-count
+    # exactly on the incident runs where accurate numbers matter
+    node.num_output_rows.add(-served_rows)
+    node.num_output_batches.add(-served)
+    it = produce()
+    skipped = 0
+    for batch in it:
+        if skipped < served:
+            skipped += 1
+            continue
+        yield batch
+
+
+def _produce_and_store(node, seam: str, fp: Fingerprint,
+                       produce) -> Iterator:
+    from ..memory.catalog import SpillPriority
+    from ..memory.spillable import SpillableColumnarBatch
+    from ..sched import context as _qctx
+    cache = _cache
+    frags = []
+    total = 0
+    t0 = time.monotonic_ns()
+    try:
+        for batch in produce():
+            # park a handle on the SAME immutable device arrays (no copy)
+            # under NO tenant context: a shared cache entry must not pin
+            # one query's sub-quota ledger until eviction
+            with _qctx.suspend():
+                frags.append(SpillableColumnarBatch(
+                    batch, priority=SpillPriority.BUFFERED))
+            total += int(batch.device_memory_size())
+            yield batch
+    except BaseException:
+        for sb in frags:
+            try:
+                sb.close()
+            except Exception:
+                pass
+        if cache is not None:
+            cache.abort(fp.digest)
+        raise
+    if cache is None or not _fault_gate(f"{seam}.store"):
+        for sb in frags:
+            sb.close()
+        if cache is not None:
+            cache.abort(fp.digest)
+        return
+    if not cache.complete(fp.digest, seam, "frags", frags, total,
+                          time.monotonic_ns() - t0,
+                          validators=fp.validators):
+        for sb in frags:
+            sb.close()
+
+
+# -------------------------------------------------------- broadcast seam
+def cached_blob(node, compute: Callable[[], Optional[bytes]]
+                ) -> Optional[bytes]:
+    """Broadcast-payload seam: returns the cached host blob, or runs
+    `compute()` and stores its result. None (empty build side) is never
+    cached — the exec's own `_empty` latch handles it."""
+    if not _ACTIVE:
+        return compute()
+    conf = node.conf
+    if not conf.get("spark.rapids.tpu.rescache.broadcast.enabled"):
+        return compute()
+    cache = _cache
+    if cache is None or not _fault_gate("broadcast.lookup"):
+        return compute()
+    # the stored bytes are a serialized frame: codec + checksum framing
+    # are part of the VALUE's format, so they join the key namespace
+    codec = conf.get("spark.rapids.shuffle.compression.codec")
+    crc = conf.get("spark.rapids.shuffle.checksum.enabled")
+    fp = fingerprint(node, conf, extra=f"broadcast|{codec}|{crc}|")
+    if fp is None:
+        return compute()
+    from ..utils import spans
+    status, entry = cache.begin(fp.digest, "broadcast",
+                                max_wait_s=FRAGMENT_WAIT_S)
+    if status == "hit":
+        blob = entry.value
+        cache.unpin(entry)  # bytes are immutable; safe past unpin
+        if blob is None:
+            # entry closed under us (concurrent invalidate): degrade to
+            # recompute like every other seam, never crash the query
+            _count_degraded("broadcast.hit_closed")
+            return compute()
+        _count_hit("broadcast")
+        with spans.span("rescache:broadcast", kind=spans.KIND_CACHE,
+                        hit=1, bytes=len(blob)):
+            pass
+        return blob
+    _count_miss("broadcast")
+    with spans.span("rescache:broadcast", kind=spans.KIND_CACHE, hit=0):
+        pass
+    if status != "owner":
+        return compute()
+    t0 = time.monotonic_ns()
+    try:
+        blob = compute()
+    except BaseException:
+        cache.abort(fp.digest)
+        raise
+    if blob is None or not _fault_gate("broadcast.store"):
+        cache.abort(fp.digest)
+        return blob
+    cache.complete(fp.digest, "broadcast", "blob", blob, len(blob),
+                   time.monotonic_ns() - t0, validators=fp.validators)
+    return blob

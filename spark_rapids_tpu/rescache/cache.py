@@ -1,0 +1,335 @@
+"""ResultCache — the process-wide store behind the result/fragment cache.
+
+Three entry kinds share one capacity and one eviction policy:
+
+  * ``table`` — a whole-query result (host pyarrow Table; a hit serves
+    straight from host memory, no device work);
+  * ``blob``  — a broadcast payload (the host-serialized build side);
+  * ``frags`` — a list of device fragments held as budget-visible
+    ``SpillableColumnarBatch``es in the spill catalog, so cached data
+    rides the device->host->disk tiers and is evicted from HBM under
+    memory pressure exactly like any parked batch — the cache can never
+    cause an OOM the engine could not already spill its way out of.
+
+Eviction is cost-aware LRU: when an insert pushes the cache past
+``spark.rapids.tpu.rescache.maxBytes`` the entry with the lowest
+``recompute_seconds x (1 + hits) / bytes`` score leaves first (cheap
+bulk before expensive small results), age as the tiebreak.
+
+Single-flight: the first query to miss a fingerprint becomes the OWNER
+and computes; concurrent identical queries (any tenant) park on the
+in-flight marker and are served the stored entry when the owner
+completes — N identical dashboard queries cost ONE execution. An owner
+that fails aborts the marker so a waiter takes over (no livelock on a
+poisoned key).
+
+Thread-safety: one lock guards the maps; fragment materialization and
+entry close run outside it (device transfers must not serialize the
+whole cache)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["ResultCache", "Entry"]
+
+
+class Entry:
+    __slots__ = ("key", "kind", "seam", "value", "nbytes", "recompute_ns",
+                 "hits", "created", "last_used", "validators", "pins",
+                 "closed")
+
+    def __init__(self, key: str, kind: str, seam: str, value: Any,
+                 nbytes: int, recompute_ns: int, validators=()):
+        self.key = key
+        self.kind = kind          # "table" | "blob" | "frags"
+        self.seam = seam          # "query" | "scan" | "exchange" | "broadcast"
+        self.value = value
+        self.nbytes = int(nbytes)
+        self.recompute_ns = int(recompute_ns)
+        self.hits = 0
+        self.created = time.monotonic()
+        self.last_used = self.created
+        self.validators = tuple(validators)
+        self.pins = 0             # hits currently streaming from this entry
+        self.closed = False
+
+    def score(self) -> float:
+        """Eviction priority: higher = keep. Recompute seconds saved per
+        byte held, amplified by observed reuse."""
+        return (self.recompute_ns / 1e9) * (1 + self.hits) \
+            / max(self.nbytes, 1)
+
+    def close(self) -> None:
+        """Release owned storage. Fragments are catalog handles that must
+        be closed exactly once; host tables/blobs just drop."""
+        if self.closed:
+            return
+        self.closed = True
+        if self.kind == "frags":
+            for sb in self.value:
+                try:
+                    sb.close()
+                except Exception:
+                    pass
+        self.value = None
+
+
+class _InFlight:
+    __slots__ = ("cv", "done", "failed")
+
+    def __init__(self):
+        self.cv = threading.Condition()
+        self.done = False
+        self.failed = False
+
+
+class ResultCache:
+    """See module docstring. Constructed only by rescache.configure()."""
+
+    # single-flight waiters poll in slices so cooperative cancellation
+    # (sched CancelToken) can unwind a parked waiter with its typed error
+    WAIT_SLICE_S = 0.05
+
+    # fingerprints whose results proved unstorable (empty, over-capacity,
+    # below the recompute floor) latch here so later identical queries run
+    # CONCURRENTLY instead of serializing behind a single-flight owner
+    # whose store will never land; bounded, and cleared on invalidate
+    UNSTORABLE_CAP = 4096
+
+    def __init__(self, max_bytes: int, min_recompute_ms: float = 0.0):
+        self.max_bytes = int(max_bytes)
+        self.min_recompute_ns = int(min_recompute_ms * 1e6)
+        self._mu = threading.Lock()
+        self._entries: Dict[str, Entry] = {}
+        self._inflight: Dict[str, _InFlight] = {}
+        self._unstorable: set = set()
+        # lifetime stats (cache_stats service op / telemetry gauges)
+        self.hit_count: Dict[str, int] = {}
+        self.miss_count: Dict[str, int] = {}
+        self.store_count: Dict[str, int] = {}
+        self.eviction_count = 0
+        self.invalidation_count = 0
+        self.singleflight_waits = 0
+        self.degraded_count = 0
+
+    # ------------------------------------------------------------- lookup
+    def begin(self, key: str, seam: str,
+              max_wait_s: Optional[float] = None
+              ) -> Tuple[str, Optional[Entry]]:
+        """Returns ("hit", entry) with the entry PINNED (caller must
+        unpin()), ("owner", None) — the caller computes and must call
+        complete() or abort() — or ("bypass", None): compute without
+        storing (this fingerprint's results proved unstorable, or the
+        caller waited past max_wait_s for an owner that has not
+        finished; a mid-query fragment seam must not park forever
+        behind another query)."""
+        from ..sched import context as _qctx
+        from ..utils.metrics import TaskMetrics
+        waited_ns = 0
+        while True:
+            stale: Optional[Entry] = None
+            with self._mu:
+                e = self._entries.get(key)
+                if e is not None:
+                    if self._valid_locked(e):
+                        e.hits += 1
+                        e.last_used = time.monotonic()
+                        e.pins += 1
+                        self.hit_count[seam] = \
+                            self.hit_count.get(seam, 0) + 1
+                        if waited_ns:
+                            TaskMetrics.get() \
+                                .rescache_singleflight_wait_ns += waited_ns
+                        return "hit", e
+                    # stale (source table freed): drop, close OUTSIDE
+                    # the lock, and re-examine
+                    stale = self._entries.pop(key, None)
+            if stale is not None:
+                stale.close()
+                continue
+            with self._mu:
+                if key in self._unstorable:
+                    # this result can never land (empty / over capacity /
+                    # below the recompute floor): run concurrently, never
+                    # serialize a burst behind an owner whose store is
+                    # known to be declined
+                    self.miss_count[seam] = self.miss_count.get(seam, 0) + 1
+                    if waited_ns:
+                        TaskMetrics.get() \
+                            .rescache_singleflight_wait_ns += waited_ns
+                    return "bypass", None
+                fl = self._inflight.get(key)
+                if fl is None:
+                    self._inflight[key] = _InFlight()
+                    self.miss_count[seam] = self.miss_count.get(seam, 0) + 1
+                    if waited_ns:
+                        TaskMetrics.get() \
+                            .rescache_singleflight_wait_ns += waited_ns
+                    return "owner", None
+                if waited_ns == 0:
+                    self.singleflight_waits += 1
+                    from .. import telemetry
+                    telemetry.inc("tpu_rescache_singleflight_waits_total",
+                                  tenant=_qctx.current_tenant() or "default")
+            t0 = time.monotonic_ns()
+            with fl.cv:
+                if not fl.done:
+                    fl.cv.wait(self.WAIT_SLICE_S)
+            waited_ns += time.monotonic_ns() - t0
+            _qctx.checkpoint()  # typed cancel/deadline unwind while parked
+            if max_wait_s is not None and waited_ns / 1e9 >= max_wait_s:
+                with self._mu:
+                    self.miss_count[seam] = self.miss_count.get(seam, 0) + 1
+                TaskMetrics.get().rescache_singleflight_wait_ns += waited_ns
+                return "bypass", None
+
+    def unpin(self, entry: Entry) -> None:
+        with self._mu:
+            entry.pins = max(0, entry.pins - 1)
+
+    def _valid_locked(self, e: Entry) -> bool:
+        if e.closed:
+            return False
+        try:
+            return all(v() for v in e.validators)
+        except Exception:
+            return False
+
+    # -------------------------------------------------------------- store
+    def complete(self, key: str, seam: str, kind: str, value: Any,
+                 nbytes: int, recompute_ns: int, validators=()) -> bool:
+        """Owner path: publish the computed entry and wake waiters.
+        Returns False when the entry was not stored (below the
+        min-recompute floor or zero-capacity) — waiters then recompute
+        for themselves."""
+        stored = False
+        to_close: List[Entry] = []
+        with self._mu:
+            keep = (recompute_ns >= self.min_recompute_ns
+                    and 0 < nbytes <= self.max_bytes)
+            if keep:
+                old = self._entries.pop(key, None)
+                if old is not None:
+                    to_close.append(old)
+                e = Entry(key, kind, seam, value, nbytes, recompute_ns,
+                          validators)
+                self._entries[key] = e
+                self.store_count[seam] = self.store_count.get(seam, 0) + 1
+                to_close.extend(self._evict_over_capacity_locked())
+                stored = key in self._entries
+            else:
+                # INHERENTLY unstorable (not capacity churn — an entry
+                # evicted after insert may well land next time): latch so
+                # concurrent identical queries stop single-flighting
+                if len(self._unstorable) >= self.UNSTORABLE_CAP:
+                    self._unstorable.clear()
+                self._unstorable.add(key)
+            fl = self._inflight.pop(key, None)
+        if fl is not None:
+            with fl.cv:
+                fl.done = True
+                fl.failed = not stored
+                fl.cv.notify_all()
+        for e in to_close:
+            e.close()
+        if stored:
+            from ..utils.metrics import TaskMetrics
+            TaskMetrics.get().rescache_stores += 1
+        return stored
+
+    def abort(self, key: str) -> None:
+        """Owner path on failure: release the in-flight marker so a parked
+        waiter can take over as the next owner."""
+        with self._mu:
+            fl = self._inflight.pop(key, None)
+        if fl is not None:
+            with fl.cv:
+                fl.done = True
+                fl.failed = True
+                fl.cv.notify_all()
+
+    # ----------------------------------------------------------- eviction
+    def _evict_over_capacity_locked(self) -> List[Entry]:
+        """Pop lowest-score entries until under max_bytes; pinned entries
+        (a hit currently streaming from them) are skipped this round.
+        Returns the popped entries for the caller to close OUTSIDE the
+        lock."""
+        out: List[Entry] = []
+        total = sum(e.nbytes for e in self._entries.values())
+        while total > self.max_bytes:
+            victims = [e for e in self._entries.values() if e.pins == 0]
+            if not victims:
+                break
+            v = min(victims, key=lambda e: (e.score(), e.last_used))
+            self._entries.pop(v.key, None)
+            total -= v.nbytes
+            self.eviction_count += 1
+            from .. import telemetry
+            telemetry.inc("tpu_rescache_evictions_total", reason="capacity")
+            out.append(v)
+        return out
+
+    # ------------------------------------------------------- invalidation
+    def invalidate(self) -> int:
+        """Drop every entry (service cache_invalidate op / tests); queries
+        currently streaming a pinned entry keep their reference — the
+        degrade-to-recompute path covers any fragment closed under them."""
+        with self._mu:
+            entries = list(self._entries.values())
+            self._entries.clear()
+            self._unstorable.clear()
+            self.invalidation_count += 1
+            from .. import telemetry
+            for _ in entries:
+                telemetry.inc("tpu_rescache_evictions_total",
+                              reason="invalidate")
+        for e in entries:
+            e.close()
+        return len(entries)
+
+    # ----------------------------------------------------------- stats
+    def total_bytes(self, kinds: Optional[Tuple[str, ...]] = None) -> int:
+        with self._mu:
+            return sum(e.nbytes for e in self._entries.values()
+                       if kinds is None or e.kind in kinds)
+
+    def bytes_by_kind(self) -> Dict[str, int]:
+        """One locked pass for the telemetry gauge (a scrape must not
+        take the hot-path lock three times per sample)."""
+        out = {"frags": 0, "table": 0, "blob": 0}
+        with self._mu:
+            for e in self._entries.values():
+                out[e.kind] = out.get(e.kind, 0) + e.nbytes
+        return out
+
+    @property
+    def entry_count(self) -> int:
+        return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._mu:
+            per_seam = {}
+            for e in self._entries.values():
+                s = per_seam.setdefault(e.seam,
+                                        {"entries": 0, "bytes": 0,
+                                         "hits": 0})
+                s["entries"] += 1
+                s["bytes"] += e.nbytes
+                s["hits"] += e.hits
+            return {
+                "entries": len(self._entries),
+                "bytes": sum(e.nbytes for e in self._entries.values()),
+                "max_bytes": self.max_bytes,
+                "hits": dict(self.hit_count),
+                "misses": dict(self.miss_count),
+                "stores": dict(self.store_count),
+                "evictions": self.eviction_count,
+                "invalidations": self.invalidation_count,
+                "unstorable": len(self._unstorable),
+                "singleflight_waits": self.singleflight_waits,
+                "degraded": self.degraded_count,
+                "per_seam": per_seam,
+            }

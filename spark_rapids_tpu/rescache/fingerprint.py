@@ -1,0 +1,338 @@
+"""Canonical plan fingerprints — the result/fragment cache's key discipline.
+
+A fingerprint is a sha256 over a CANONICAL STRING of the (sub)plan: node
+class names, each node's param-faithful argument rendering (`_arg_string`
+plus every expression/primitive attribute — the same repr discipline the
+compile service's program keys ride, so an alias here is a wrong-results
+bug twice over), output schema, source identity (per-file
+`(path, mtime_ns, size)`, in-memory table object identity, or an explicit
+`fingerprint_token` such as a delta `(path, version)`), and the conf keys
+that change results.
+
+Fail-closed contract: anything this module cannot PROVE it renders
+faithfully yields no key (None = uncacheable), never a lossy key —
+
+  * node classes outside the explicit whitelist (UDF execs hold opaque
+    python callables; a future exec is uncacheable until audited here);
+  * any expression with `deterministic=False` (rand/uuid/current-time
+    style, pandas UDFs, partition-id family) anywhere in the subtree;
+  * attribute values of types this module does not know how to render
+    (over-inclusion only lowers the hit rate; silent omission would
+    serve query A's bytes to query B);
+  * scans carrying runtime dynamic-pruning filters (their output depends
+    on a join's build keys, which are not part of the plan).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import weakref
+from typing import Any, Callable, List, Optional, Tuple
+
+__all__ = ["Fingerprint", "fingerprint", "RESULT_CONF_KEYS"]
+
+
+# conf keys whose value changes RESULTS (not just placement/performance):
+# two queries differing in any of these must never share a cache entry.
+RESULT_CONF_KEYS = (
+    "spark.rapids.sql.enabled",
+    "spark.rapids.sql.mode",
+    "spark.rapids.sql.ansi.enabled",
+    "spark.sql.ansi.enabled",
+    "spark.rapids.sql.variableFloatAgg.enabled",
+    "spark.rapids.sql.improvedFloatOps.enabled",
+    "spark.rapids.sql.hasNans",
+    "spark.rapids.sql.incompatibleOps.enabled",
+    "spark.rapids.sql.incompatibleDateFormats.enabled",
+    "spark.rapids.sql.regexp.enabled",
+    "spark.rapids.sql.castFloatToString.enabled",
+    "spark.rapids.sql.castStringToFloat.enabled",
+    "spark.rapids.tpu.f64.emulation",
+    "spark.rapids.tpu.string.maxWidth",
+    "spark.rapids.tpu.string.headWidth",
+    "spark.rapids.shuffle.mode",
+)
+
+# explicitly-set per-op/per-expression enable keys also move subtrees
+# between engines (ULP-level result differences for incompat ops), so any
+# set key under these prefixes joins the conf section of the fingerprint
+_CONF_PREFIXES = ("spark.rapids.sql.expression.", "spark.rapids.sql.exec.",
+                  "spark.rapids.sql.format.")
+
+
+@dataclasses.dataclass
+class Fingerprint:
+    """digest: the cache key. validators: zero-arg callables that must ALL
+    return True at hit time (weakref identity checks for in-memory
+    sources — a freed table's id() could be reassigned to different
+    data, so id-in-the-key alone is not enough)."""
+    digest: str
+    validators: Tuple[Callable[[], bool], ...] = ()
+
+    def valid(self) -> bool:
+        try:
+            return all(v() for v in self.validators)
+        except Exception:
+            return False
+
+
+class _Uncacheable(Exception):
+    """Internal control flow: some part of the subtree cannot be rendered
+    faithfully (carries the reason for diagnostics)."""
+
+
+# ---------------------------------------------------------------------------
+# node whitelist: every class named here has been audited — its
+# `_arg_string` + public attributes render its full result-relevant
+# identity. Names, not classes, to avoid import cycles at module load.
+_PLAN_NODES = frozenset({
+    # plan/nodes.py (CPU plan)
+    "CpuScanExec", "CpuProjectExec", "CpuFilterExec", "CpuHashAggregateExec",
+    "CpuGenerateExec", "CpuHashJoinExec", "CpuSortExec", "CpuSampleExec",
+    "CpuLimitExec", "CpuUnionExec", "CpuRangeExec", "CpuExpandExec",
+    "CpuWindowExec", "CpuShuffleExchangeExec",
+    # io/ format scans (CpuFileScanExec subclasses get the source-identity
+    # handler below)
+    "CpuParquetScanExec", "CpuCsvScanExec", "CpuJsonScanExec",
+    "CpuOrcScanExec", "CpuAvroScanExec", "CpuHiveTextScanExec",
+    # datasources/cache.py — output identical to the child's
+    "CpuCachedExec",
+    # exec/ (TPU operators; fragment seams fingerprint these subtrees)
+    "TpuScanExec", "TpuProjectExec", "TpuFilterExec", "TpuHashAggregateExec",
+    "TpuGenerateExec", "TpuSortExec", "TpuTopKExec", "TpuSampleExec",
+    "TpuLimitExec", "TpuUnionExec", "TpuRangeExec", "TpuExpandExec",
+    "TpuWindowExec", "TpuCoalesceBatchesExec", "TpuShuffleExchangeExec",
+    "TpuBroadcastExchangeExec", "TpuBroadcastHashJoinExec",
+    "TpuShuffledHashJoinExec", "TpuNestedLoopJoinExec", "TpuFileScanExec",
+    "TpuInMemoryTableScanExec", "TpuFromCpuExec",
+})
+
+# attribute names that are runtime machinery, never result identity
+_IGNORED_ATTRS = frozenset({
+    "children", "conf", "metrics", "session", "cpu_scan", "cpu_node",
+    "cpu_plan", "tpu_exec", "table", "relation", "lock",
+    "dynamic_filters", "dpp_filters", "fingerprint_token",
+    "paths", "options", "columns",
+})
+
+# attr value types that are runtime machinery (rendered as nothing)
+_IGNORED_TYPE_NAMES = frozenset({
+    "Metric", "MetricsSet", "TpuConf", "lock", "RLock", "Event",
+    "Condition", "DynamicKeyFilter",
+})
+
+
+def _render(value: Any, out: List[str]) -> None:
+    """Render one attribute value into the canonical string, or raise
+    _Uncacheable for anything not provably faithful."""
+    from ..expr.base import Expression
+    if value is None or isinstance(value, (bool, int, float, str, bytes)):
+        out.append(repr(value))
+        return
+    if isinstance(value, Expression):
+        out.append(repr(value))
+        return
+    if isinstance(value, (list, tuple)):
+        out.append("[")
+        for v in value:
+            _render(v, out)
+            out.append(",")
+        out.append("]")
+        return
+    if isinstance(value, dict):
+        out.append("{")
+        for k in sorted(value, key=repr):
+            out.append(repr(k))
+            out.append(":")
+            _render(value[k], out)
+            out.append(",")
+        out.append("}")
+        return
+    tname = type(value).__name__
+    if tname in _IGNORED_TYPE_NAMES:
+        return
+    if callable(value) and not isinstance(value, type):
+        raise _Uncacheable(f"opaque callable of type {tname}")
+    # schema / dtype objects render via simple_string (stable, canonical)
+    if hasattr(value, "simple_string"):
+        out.append(value.simple_string())
+        return
+    # Schema (columnar/batch.py): names + types
+    if hasattr(value, "names") and hasattr(value, "types"):
+        out.append(repr(tuple(value.names)))
+        for t in value.types:
+            out.append(t.simple_string())
+        return
+    if dataclasses.is_dataclass(value):
+        # partition specs, AggExpr, window frames: dataclass/custom reprs
+        # are param-faithful by construction
+        out.append(repr(value))
+        return
+    # windowexprs frames and similar small param carriers define __repr__
+    if type(value).__repr__ is not object.__repr__:
+        out.append(repr(value))
+        return
+    # plain param-carrier objects (e.g. coalesce TargetSize): class name +
+    # every attribute, recursively — fails closed on anything nested that
+    # this renderer does not understand
+    d = getattr(value, "__dict__", None)
+    if d is not None:
+        out.append(tname)
+        out.append("{")
+        for k in sorted(d):
+            out.append(k)
+            out.append("=")
+            _render(d[k], out)
+            out.append(",")
+        out.append("}")
+        return
+    raise _Uncacheable(f"unrenderable attr value of type {tname}")
+
+
+# expression classes that wrap an opaque user callable: their __repr__
+# cannot render the function body, so two different UDFs registered under
+# the same name would alias — fail closed even when the SPI marks them
+# deterministic (PandasUDF is deterministic=False already; ColumnarUDFExpr
+# defaults to deterministic=True)
+_OPAQUE_EXPRS = frozenset({"ColumnarUDFExpr", "PandasUDF"})
+
+
+def _check_deterministic(node: Any) -> None:
+    """Any Expression reachable from this node's attributes must be
+    deterministic AND repr-renderable — rand/uuid/current-time style
+    expressions and UDF black boxes poison the whole subtree."""
+    from ..expr.base import Expression
+
+    def walk_value(v):
+        if isinstance(v, Expression):
+            if v.collect(lambda e: not e.deterministic
+                         or type(e).__name__ in _OPAQUE_EXPRS):
+                raise _Uncacheable(
+                    f"nondeterministic or opaque-callable expression in "
+                    f"{type(node).__name__}")
+            return
+        if isinstance(v, (list, tuple)):
+            for x in v:
+                walk_value(x)
+        elif dataclasses.is_dataclass(v) and not isinstance(v, type):
+            for f in dataclasses.fields(v):
+                walk_value(getattr(v, f.name))
+
+    for name, v in vars(node).items():
+        if name == "children":
+            continue
+        walk_value(v)
+
+
+def _file_identity(paths) -> List[Tuple[str, int, int]]:
+    """(path, mtime_ns, size) per source file — a rewritten file (content
+    or timestamp) changes the key, so stale entries become unreachable
+    and the query recomputes."""
+    out = []
+    for p in paths:
+        st = os.stat(p)  # OSError -> caught by fingerprint() = uncacheable
+        out.append((str(p), st.st_mtime_ns, st.st_size))
+    return out
+
+
+def _node_string(node: Any, out: List[str],
+                 validators: List[Callable[[], bool]]) -> None:
+    name = type(node).__name__
+    if name == "CpuFromTpuExec":
+        # host bridge: identity is the wrapped device subtree
+        out.append("CpuFromTpuExec(")
+        _node_string(node.tpu_exec, out, validators)
+        out.append(")")
+        return
+    if name not in _PLAN_NODES:
+        raise _Uncacheable(f"node class {name} is not fingerprint-audited")
+    _check_deterministic(node)
+    out.append(name)
+    out.append("(")
+
+    # ---- source identity ------------------------------------------------
+    scan = getattr(node, "cpu_scan", None)  # TpuFileScanExec wraps one
+    if scan is None and hasattr(node, "paths") and hasattr(node,
+                                                           "decode_file"):
+        scan = node  # a CpuFileScanExec itself
+    if scan is not None:
+        if getattr(node, "dynamic_filters", None):
+            raise _Uncacheable(
+                "scan output depends on runtime dynamic-pruning filters")
+        out.append(getattr(scan, "format_name", "file"))
+        _render(_file_identity(scan.paths), out)
+        _render(scan.columns, out)
+        _render(scan.options, out)
+    table = getattr(node, "table", None)
+    if table is not None and hasattr(table, "num_rows"):
+        token = getattr(node, "fingerprint_token", None)
+        if token is not None:
+            # explicit stable identity (e.g. delta (path, version)): the
+            # datasource re-reads the same versioned content for it
+            _render(tuple(token), out)
+        else:
+            # in-memory table: object identity IS the identity (pyarrow
+            # tables are immutable), valid only while that very object is
+            # alive — the weakref validator turns a freed/reused id into
+            # a miss instead of a wrong hit
+            out.append(f"table@{id(table)}")
+            ref = weakref.ref(table)
+            validators.append(
+                lambda ref=ref, tid=id(table):
+                (lambda t: t is not None and id(t) == tid)(ref()))
+    cached = getattr(node, "cpu_node", None)  # TpuInMemoryTableScanExec
+    if cached is not None:
+        _node_string(cached.children[0], out, validators)
+    cpu_plan = getattr(node, "cpu_plan", None)  # TpuFromCpuExec bridge
+    if cpu_plan is not None:
+        _node_string(cpu_plan, out, validators)
+
+    # ---- param-faithful argument rendering ------------------------------
+    out.append(node._arg_string())
+    for attr in sorted(vars(node)):
+        if attr.startswith("_") or attr in _IGNORED_ATTRS:
+            continue
+        v = vars(node)[attr]
+        if callable(v) and not isinstance(v, type):
+            raise _Uncacheable(f"{name}.{attr} is an opaque callable")
+        out.append(attr)
+        out.append("=")
+        _render(v, out)
+        out.append(";")
+
+    # ---- output schema + children ---------------------------------------
+    try:
+        _render(node.output, out)
+    except Exception as e:
+        raise _Uncacheable(f"{name}.output unavailable: {e}")
+    for c in node.children:
+        _node_string(c, out, validators)
+    out.append(")")
+
+
+def _conf_string(conf, out: List[str]) -> None:
+    for k in RESULT_CONF_KEYS:
+        out.append(f"{k}={conf.get(k)!r};")
+    settings = getattr(conf, "_settings", {})
+    for k in sorted(settings):
+        if k.startswith(_CONF_PREFIXES):
+            out.append(f"{k}={settings[k]!r};")
+
+
+def fingerprint(node: Any, conf, extra: str = "") -> Optional[Fingerprint]:
+    """Fingerprint of the subplan rooted at `node` (a CPU PhysicalPlan or
+    a TPU exec), or None when any part of it is uncacheable. `extra`
+    distinguishes seam namespaces (a whole-query entry and a fragment
+    entry over the same subtree hold different value kinds)."""
+    out: List[str] = [extra, "|v1|"]
+    validators: List[Callable[[], bool]] = []
+    try:
+        _node_string(node, out, validators)
+        _conf_string(conf, out)
+    except (_Uncacheable, OSError, ValueError, AttributeError):
+        return None
+    digest = hashlib.sha256("".join(out).encode(
+        "utf-8", "backslashreplace")).hexdigest()
+    return Fingerprint(digest, tuple(validators))

@@ -24,7 +24,7 @@ from typing import Callable, List, Optional
 from ..errors import DeadlineExceededError, QueryCancelledError
 
 __all__ = ["CancelToken", "QueryContext", "activate", "adopt", "checkpoint",
-           "current", "current_tenant", "remaining_deadline_s"]
+           "current", "current_tenant", "remaining_deadline_s", "suspend"]
 
 _tls = threading.local()
 _lock = threading.Lock()
@@ -209,3 +209,21 @@ def adopt(ctx: Optional[QueryContext]) -> None:
     semaphore hold). No-op for None."""
     if ctx is not None:
         _tls.ctx = ctx
+
+
+class suspend:
+    """Detach this thread's context for a scope: work inside runs with NO
+    active tenant/token attribution, restored on exit. The rescache parks
+    shared fragments under this — a cross-query cache entry belongs to no
+    tenant, so its park-time charge must not pin one query's sub-quota
+    ledger until some later eviction. Does not change the _ACTIVE scope
+    count (other threads' contexts are untouched)."""
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "ctx", None)
+        _tls.ctx = None
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        _tls.ctx = self._prev
+        return False

@@ -231,6 +231,24 @@ class TpuServiceClient:
             raise RuntimeError(rep.get("error", "stats unavailable"))
         return body.decode("utf-8")
 
+    def cache_stats(self) -> dict:
+        """The server's result/fragment-cache accounting (entries, bytes,
+        hits/misses/stores per seam, evictions, single-flight waits).
+        Raises RuntimeError when the server runs with the cache off."""
+        rep, _ = self._request({"op": "cache_stats"})
+        if not rep.get("ok"):
+            raise RuntimeError(rep.get("error", "cache stats unavailable"))
+        return rep["stats"]
+
+    def cache_invalidate(self) -> int:
+        """Drop every entry in the server's result/fragment cache;
+        returns the number dropped. Raises RuntimeError when the server
+        runs with the cache off."""
+        rep, _ = self._request({"op": "cache_invalidate"})
+        if not rep.get("ok"):
+            raise RuntimeError(rep.get("error", "cache invalidate failed"))
+        return rep["dropped"]
+
     def health(self) -> dict:
         """The server's /healthz snapshot (device init state, admission
         alive probe, heartbeat peers, event-log writability). Works

@@ -30,6 +30,9 @@ unix-domain socket:
                its CancelToken — the engine unwinds at the next
                cooperative cancellation point; `priority` reassigns the
                context's priority for its future admissions.
+  cache_stats      -> result/fragment-cache accounting (rescache.stats())
+  cache_invalidate -> drop every cached result/fragment (out-of-band data
+               rewrites the file-identity keys cannot observe)
   shutdown  -> stop serving (tests; production uses process supervision)
 """
 
@@ -191,6 +194,10 @@ class TpuDeviceService:
                     self._handle_stats(conn)
                 elif op == "health":
                     self._handle_health(conn)
+                elif op == "cache_stats":
+                    self._handle_cache_stats(conn)
+                elif op == "cache_invalidate":
+                    self._handle_cache_invalidate(conn)
                 elif op == "shutdown":
                     send_msg(conn, {"ok": True})
                     self._stop.set()
@@ -305,6 +312,35 @@ class TpuDeviceService:
         from ..telemetry import health_snapshot
         snap = health_snapshot(self.session.conf)
         send_msg(conn, {"ok": True, "health": snap})
+
+    def _handle_cache_stats(self, conn: socket.socket) -> None:
+        """`cache_stats` op: the result/fragment cache's lifetime
+        accounting (entries/bytes/hits/misses/evictions per seam)."""
+        from .. import rescache
+        snap = rescache.stats()
+        if snap is None:
+            send_msg(conn, {
+                "ok": False,
+                "error": "result cache disabled "
+                         "(spark.rapids.tpu.rescache.enabled)",
+                "error_type": "rescache_disabled"})
+            return
+        send_msg(conn, {"ok": True, "stats": snap})
+
+    def _handle_cache_invalidate(self, conn: socket.socket) -> None:
+        """`cache_invalidate` op: drop every cached result/fragment (an
+        operator's big hammer after an out-of-band data rewrite the
+        file-identity keys cannot see, e.g. an in-place object-store
+        overwrite preserving mtime)."""
+        from .. import rescache
+        if not rescache.is_enabled():
+            send_msg(conn, {
+                "ok": False,
+                "error": "result cache disabled "
+                         "(spark.rapids.tpu.rescache.enabled)",
+                "error_type": "rescache_disabled"})
+            return
+        send_msg(conn, {"ok": True, "dropped": rescache.invalidate()})
 
     def _concurrent_ok(self) -> bool:
         """Scheduled run_plans may execute concurrently only when the

@@ -370,6 +370,44 @@ def _install_families(reg: MetricsRegistry) -> None:
               "Batches currently parked across live prefetch queues.",
               callback=_prefetch_gauge)
 
+    # result & fragment cache (rescache/)
+    reg.counter("tpu_rescache_hits_total",
+                "Result/fragment-cache hits, by seam and tenant.",
+                ["seam", "tenant"])
+    reg.counter("tpu_rescache_misses_total",
+                "Result/fragment-cache misses, by seam and tenant.",
+                ["seam", "tenant"])
+    reg.counter("tpu_rescache_evictions_total",
+                "Cache entries evicted, by reason (capacity/invalidate).",
+                ["reason"])
+    reg.counter("tpu_rescache_singleflight_waits_total",
+                "Queries that parked behind another query computing the "
+                "same fingerprint.", ["tenant"])
+    reg.counter("tpu_rescache_degraded_total",
+                "Cache operations degraded to recompute (cache.fragment "
+                "faults, mid-flight evictions).")
+    reg.gauge("tpu_rescache_bytes",
+              "Bytes held by the result/fragment cache, by entry kind "
+              "(frags ride the spill catalog tiers; table/blob are host).",
+              ["kind"], callback=_rescache_bytes_gauge)
+    reg.gauge("tpu_rescache_entries",
+              "Live result/fragment-cache entries.",
+              callback=_rescache_gauge(lambda c: c.entry_count))
+
+    # explicit df.cache() relations (datasources/cache.py): blob bytes
+    # held by live CachedRelations — released on unpersist()
+    reg.gauge("tpu_cached_relation_bytes",
+              "Parquet-blob bytes held by materialized df.cache() "
+              "relations (drops to 0 on unpersist).",
+              callback=_cached_relation_gauge)
+
+    # dynamic file pruning (io/dynamic_pruning.py): footer-read errors
+    # keep the file (never a correctness gate) but degrade pruning — a
+    # rising counter means the optimization is silently disengaging
+    reg.counter("tpu_dpp_footer_errors_total",
+                "Parquet footer/statistics read errors during dynamic "
+                "pruning (file/row group kept unpruned).")
+
 
 # gauge callbacks: read singletons WITHOUT constructing them ----------------
 def _budget_gauge():
@@ -455,4 +493,30 @@ def _prefetch_gauge():
         q = getattr(it, "_q", None)
         if q is not None:
             total += q.qsize()
+    return total
+
+
+def _rescache_gauge(fn):
+    def cb():
+        from .. import rescache
+        c = rescache.get()
+        return fn(c) if c is not None else None
+    return cb
+
+
+def _rescache_bytes_gauge():
+    from .. import rescache
+    c = rescache.get()
+    if c is None:
+        return {}
+    return {(kind,): v for kind, v in c.bytes_by_kind().items()}
+
+
+def _cached_relation_gauge():
+    from ..datasources import cache as _dscache
+    total = 0
+    for node in list(_dscache.live_cached_execs()):
+        rel = node.relation
+        if rel is not None:
+            total += rel.size_bytes
     return total

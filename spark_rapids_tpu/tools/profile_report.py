@@ -36,7 +36,7 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 from ..utils.spans import SCHEMA_VERSION, validate_record
 
 __all__ = ["load_records", "build_model", "render_report", "sched_summary",
-           "trace_view", "main"]
+           "cache_summary", "trace_view", "main"]
 
 # live logs plus size-capped rotation generations (events-PID.jsonl.1, .2,
 # ...) and the flight recorder's incident dumps — all the same schema
@@ -144,6 +144,12 @@ def build_model(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                     "tenant": rec.get("attrs", {}).get("tenant", ""),
                     "priority": rec.get("attrs", {}).get("priority", 0),
                 })
+            if str(rec.get("name", "")).startswith("rescache:"):
+                q.setdefault("cache_spans", []).append({
+                    "seam": rec["name"].split(":", 1)[1],
+                    "hit": int(rec.get("attrs", {}).get("hit", 0)),
+                    "bytes": int(rec.get("attrs", {}).get("bytes", 0)),
+                })
     return {"v": SCHEMA_VERSION, "queries": list(queries.values())}
 
 
@@ -195,6 +201,43 @@ def sched_summary(model: Dict[str, Any]) -> Dict[str, Any]:
         "cancelled": cancelled,
         "deadline_exceeded": deadline,
         "query_statuses": statuses,
+    }
+
+
+def cache_summary(model: Dict[str, Any]) -> Dict[str, Any]:
+    """Result/fragment-cache signal across all queries: per-seam hit and
+    miss counts (from the rescache:<seam> spans), hit bytes served, plus
+    the task-metrics totals (stores, single-flight waits, degraded-to-
+    recompute events). Empty dict when no query touched the cache. Note:
+    whole-query HITS answer on the fast path before the profiler starts,
+    so they appear in the live telemetry counters, not in event logs —
+    what shows here is the fragment seams plus each miss-side store."""
+    per_seam: Dict[str, Dict[str, int]] = {}
+    hits = misses = stores = degraded = 0
+    wait_ns = 0
+    for q in model["queries"]:
+        for sp in q.get("cache_spans", ()):
+            d = per_seam.setdefault(sp["seam"],
+                                    {"hits": 0, "misses": 0,
+                                     "hit_bytes": 0})
+            if sp["hit"]:
+                d["hits"] += 1
+                d["hit_bytes"] += sp["bytes"]
+            else:
+                d["misses"] += 1
+        tm = q["task_metrics"]
+        hits += tm.get("rescache_hits", 0)
+        misses += tm.get("rescache_misses", 0)
+        stores += tm.get("rescache_stores", 0)
+        degraded += tm.get("rescache_degraded", 0)
+        wait_ns += tm.get("rescache_singleflight_wait_ns", 0)
+    if not (per_seam or hits or misses or stores or degraded):
+        return {}
+    return {
+        "hits": hits, "misses": misses, "stores": stores,
+        "degraded": degraded,
+        "singleflight_wait_ms": round(wait_ns / 1e6, 3),
+        "per_seam": per_seam,
     }
 
 
@@ -373,6 +416,20 @@ def render_report(model: Dict[str, Any], top: int = 10) -> str:
                 f"B read={tm.get('shuffle_bytes_read', 0)}B "
                 f"fetchWaitMs={tm.get('shuffle_fetch_wait_ns', 0) / 1e6:.1f}")
         lines.append("")
+    cache = cache_summary(model)
+    if cache:
+        lines.append("=== result/fragment cache ===")
+        lines.append(
+            f"hits={cache['hits']} misses={cache['misses']} "
+            f"stores={cache['stores']} degraded={cache['degraded']} "
+            f"singleFlightWaitMs={cache['singleflight_wait_ms']}")
+        if cache["per_seam"]:
+            lines.append(_fmt_table(
+                [[seam, str(d["hits"]), str(d["misses"]),
+                  str(d["hit_bytes"])]
+                 for seam, d in sorted(cache["per_seam"].items())],
+                ["seam", "hits", "misses", "hit_bytes"]))
+        lines.append("")
     sched = sched_summary(model)
     if sched:
         lines.append("=== scheduler ===")
@@ -438,6 +495,7 @@ def main(argv: List[str] = None) -> int:
     model = build_model(records)
     if args.json:
         model["scheduler"] = sched_summary(model)
+        model["cache"] = cache_summary(model)
         print(json.dumps(model, indent=2))
     else:
         print(render_report(model, top=args.top))

@@ -185,6 +185,15 @@ class TaskMetrics:
         # (e.g. require_flat_strings on a >headWidth key) silently re-ran
         # the whole stage on the host engine this many times
         self.cpu_fallback_reruns = 0
+        # result/fragment-cache counters (rescache/): hits and misses this
+        # task saw across the seams, entries it stored, wall ns it spent
+        # parked behind another query computing the same fingerprint
+        # (single-flight dedup), and faults degraded to recompute
+        self.rescache_hits = 0
+        self.rescache_misses = 0
+        self.rescache_stores = 0
+        self.rescache_singleflight_wait_ns = 0
+        self.rescache_degraded = 0
         # query-scheduler counters (sched/): wall ns queued for admission,
         # grants, load-shed rejections, cooperative cancellations and
         # deadline expiries observed by this task, and the deepest
@@ -254,6 +263,15 @@ class TaskMetrics:
                 f"dispatchesPerScanBatch={per_batch:.2f}")
         if self.cpu_fallback_reruns:
             parts.append(f"cpuFallbackReruns={self.cpu_fallback_reruns}")
+        if self.rescache_hits or self.rescache_misses or \
+                self.rescache_stores or self.rescache_degraded:
+            parts.append(
+                f"rescacheHits={self.rescache_hits} "
+                f"rescacheMisses={self.rescache_misses} "
+                f"rescacheStores={self.rescache_stores} "
+                f"rescacheSingleFlightWaitMs="
+                f"{self.rescache_singleflight_wait_ns / 1e6:.1f} "
+                f"rescacheDegraded={self.rescache_degraded}")
         if self.sched_admissions or self.sched_rejected or \
                 self.sched_cancelled or self.sched_deadline_exceeded:
             parts.append(

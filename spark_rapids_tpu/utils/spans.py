@@ -63,9 +63,11 @@ KIND_KERNEL = "kernel"
 KIND_IO = "io"
 KIND_PHASE = "phase"
 KIND_SERVICE = "service"   # cross-process service ops (client-side records)
+KIND_CACHE = "cache"       # result/fragment cache seams (rescache/)
 
 _KINDS = (KIND_QUERY, KIND_OPERATOR, KIND_COMPILE, KIND_SPILL, KIND_SHUFFLE,
-          KIND_SEMAPHORE, KIND_KERNEL, KIND_IO, KIND_PHASE, KIND_SERVICE)
+          KIND_SEMAPHORE, KIND_KERNEL, KIND_IO, KIND_PHASE, KIND_SERVICE,
+          KIND_CACHE)
 
 
 def new_trace_id() -> str:

@@ -55,6 +55,11 @@ def pytest_configure(config):
         "sched: query-scheduler suite (priority-weighted fair admission / "
         "deadlines / cooperative cancellation / tenant quotas; "
         "scripts/sched_matrix.sh runs these standalone)")
+    config.addinivalue_line(
+        "markers",
+        "rescache: result/fragment-cache suite (plan fingerprints / "
+        "cross-query reuse seams / single-flight / eviction / fault "
+        "degrade; scripts/rescache_matrix.sh runs these standalone)")
 
 
 @pytest.fixture
