@@ -870,6 +870,179 @@ def rescache_bench() -> dict:
     return out
 
 
+FLEET_WORKERS = 3
+FLEET_PLANS = 4          # distinct dashboard queries in the mix
+FLEET_ROUNDS = 7         # repeats of the mix: 4 cold + 24 warm chances
+FLEET_ROWS = 200_000
+
+
+def fleet_bench() -> dict:
+    """Fleet-gateway routing bench (ISSUE-10 flag: `bench.py --fleet`):
+    a repeated mixed dashboard workload (FLEET_PLANS distinct queries x
+    FLEET_ROUNDS) dispatched through a gateway over FLEET_WORKERS real
+    `TpuDeviceService` processes, once with forced-random routing and
+    once with cache-affinity routing. Workers run the result cache; XLA
+    compiles are pre-warmed on every worker so the two modes differ only
+    in PLACEMENT. Reports per-mode warm hit rate and p50/p99 latency —
+    affinity should approach hit_rate 1.0 where random sits near 1/N.
+    Workers are pinned to the CPU backend (N processes cannot share one
+    TPU); the routing/caching effects measured here are
+    placement-layer."""
+    import tempfile
+    import threading
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.fleet.gateway import FleetGateway
+    from spark_rapids_tpu.service import TpuServiceClient
+    from spark_rapids_tpu.tools.profile_report import _percentile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    d = tempfile.mkdtemp(prefix="srtpu_fleet_bench_")
+    rng = np.random.default_rng(13)
+    t = pa.table({"k": pa.array(rng.integers(0, 4096, FLEET_ROWS)),
+                  "v": pa.array(rng.uniform(size=FLEET_ROWS))})
+    path = os.path.join(d, "fact.parquet")
+    pq.write_table(t, path, row_group_size=65_536)
+    paths = {"t": [path]}
+
+    def attr(name, dt):
+        return [{"class": "org.apache.spark.sql.catalyst.expressions."
+                 "AttributeReference", "num-children": 0, "name": name,
+                 "dataType": dt, "nullable": True, "metadata": {},
+                 "exprId": {"id": 1, "jvmId": "x"}, "qualifier": []}]
+
+    def plan(thr):
+        filt = {"class": "org.apache.spark.sql.execution.FilterExec",
+                "num-children": 1,
+                "condition": [
+                    {"class": "org.apache.spark.sql.catalyst.expressions."
+                     "GreaterThan", "num-children": 2}]
+                + attr("v", "double")
+                + [{"class": "org.apache.spark.sql.catalyst.expressions."
+                    "Literal", "num-children": 0, "value": str(thr),
+                    "dataType": "double"}]}
+        scan = {"class": "org.apache.spark.sql.execution."
+                "FileSourceScanExec", "num-children": 0,
+                "relation": "HadoopFsRelation(parquet)",
+                "output": [attr("k", "long"), attr("v", "double")],
+                "tableIdentifier": "t"}
+        return json.dumps([filt, scan])
+
+    plans = [plan(0.1 + 0.17 * i) for i in range(FLEET_PLANS)]
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    env.pop("XLA_FLAGS", None)
+    socks = {f"w{i}": os.path.join(d, f"w{i}.sock")
+             for i in range(FLEET_WORKERS)}
+    procs = {n: subprocess.Popen(
+        [sys.executable, "-m", "spark_rapids_tpu.service.server",
+         "--socket", s, "--platform", "cpu",
+         "--conf", "spark.rapids.tpu.rescache.enabled=true"],
+        cwd=repo, env=env, stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL) for n, s in socks.items()}
+    try:
+        for s in socks.values():
+            TpuServiceClient(s, deadline_s=120.0).connect().close()
+        # compile-warm EVERY plan on EVERY worker so random's extra XLA
+        # compiles don't masquerade as routing cost
+        for s in socks.values():
+            with TpuServiceClient(s, deadline_s=300.0) as cli:
+                for p in plans:
+                    cli.run_plan(p, paths)
+
+        def pool_hits(cli) -> int:
+            stats = cli.cache_stats()
+            return sum(w.get("hits", {}).get("query", 0)
+                       for w in stats.values() if isinstance(w, dict))
+
+        def pool_entries(cli) -> int:
+            stats = cli.cache_stats()
+            return sum(w.get("entries", 0)
+                       for w in stats.values() if isinstance(w, dict))
+
+        def run_mode(routing: str) -> dict:
+            for s in socks.values():
+                with TpuServiceClient(s, deadline_s=30.0) as cli:
+                    cli.cache_invalidate()
+            gw_sock = os.path.join(d, f"gw_{routing}.sock")
+            gw = FleetGateway(
+                list(socks.items()),
+                {"spark.rapids.tpu.fleet.routing": routing,
+                 "spark.rapids.tpu.fleet.probe.intervalMs": 500},
+                gw_sock)
+            th = threading.Thread(target=gw.serve_forever, daemon=True)
+            th.start()
+            lat = []
+            reference = [None] * len(plans)
+            identical = True
+            with TpuServiceClient(gw_sock, deadline_s=300.0) as cli:
+                hits0 = pool_hits(cli)   # lifetime counters: delta them
+                hits_round2 = None
+                for rnd_ix in range(FLEET_ROUNDS):
+                    for i, p in enumerate(plans):
+                        t0 = time.perf_counter()
+                        r = cli.run_plan(p, paths)
+                        lat.append(time.perf_counter() - t0)
+                        if reference[i] is None:
+                            reference[i] = r
+                        elif not r.equals(reference[i]):
+                            identical = False
+                    if rnd_ix == 1:
+                        hits_round2 = pool_hits(cli) - hits0
+                hits = pool_hits(cli) - hits0
+                entries = pool_entries(cli)
+                cli.shutdown()
+            th.join(timeout=10)
+            warm_chances = len(plans) * (FLEET_ROUNDS - 1)
+            lat_sorted = sorted(lat)
+            return {
+                "queries": len(lat),
+                "warm_hit_rate": round(hits / warm_chances, 4),
+                # round 2 isolates the 1/N story: under random routing a
+                # repeat only hits when it lands on the one worker that
+                # saw it; affinity pins it there by construction
+                "round2_hit_rate": round((hits_round2 or 0) / len(plans),
+                                         4),
+                "p50_s": round(_percentile(lat_sorted, 50), 5),
+                "p99_s": round(_percentile(lat_sorted, 99), 5),
+                "bit_identical": identical,
+                "cache_entries_pool": entries,
+                "route_decisions": gw._fleet_stats()["route_decisions"],
+            }
+
+        rnd = run_mode("random")
+        aff = run_mode("affinity")
+    finally:
+        for n, p in procs.items():
+            try:
+                with TpuServiceClient(socks[n], deadline_s=3.0) as cli:
+                    cli.shutdown()
+            except Exception:
+                pass
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    out = {
+        "metric": "fleet_bench",
+        "workers": FLEET_WORKERS,
+        "plans": FLEET_PLANS,
+        "rounds": FLEET_ROUNDS,
+        "rows": FLEET_ROWS,
+        "random": rnd,
+        "affinity": aff,
+        "ok": bool(aff["bit_identical"] and rnd["bit_identical"]
+                   and aff["warm_hit_rate"] > rnd["warm_hit_rate"]),
+    }
+    if rnd["p50_s"]:
+        out["p50_speedup_affinity_vs_random_x"] = round(
+            rnd["p50_s"] / max(aff["p50_s"], 1e-9), 2)
+    return out
+
+
 PROBE_TIMEOUT_S = 35
 PROBE_ATTEMPTS = 2
 
@@ -978,6 +1151,11 @@ if __name__ == "__main__":
         # baseline vs scheduler, one JSON line (appended to BENCH detail)
         _enable_compilation_cache()
         print(json.dumps(sched_bench()), flush=True)
+    elif "--fleet" in sys.argv:
+        # bench flag (ISSUE-10): repeated mixed workload over a worker
+        # pool — affinity vs forced-random routing: warm hit rate and
+        # p50/p99 latency per mode; one JSON line
+        print(json.dumps(fleet_bench()), flush=True)
     elif "--rescache" in sys.argv:
         # bench flag (ISSUE-9): repeated-query workload through the
         # result cache — hit rate, warm-vs-cold speedup, bit-identical

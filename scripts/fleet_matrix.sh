@@ -1,0 +1,222 @@
+#!/usr/bin/env bash
+# Fleet gateway matrix (ISSUE-10 CI gate):
+#   1. run the fleet test suite (marker `fleet`, slow lifecycle tests
+#      included) plus the repr-audit lint — worker-killed-mid-query
+#      failover with bit-identical rows, breaker half-open recovery,
+#      cache-affinity placement with a worker-local rescache hit,
+#      drain/undrain, cancel-through-gateway, fleet-door backpressure,
+#      cross-process trace stitching;
+#   2. fleet-OFF gate: a process using the engine and the DIRECT
+#      client->service path imports zero fleet modules, runs zero fleet
+#      threads, and the single-socket exchange works unchanged;
+#   3. affinity gate: the same plan dispatched repeatedly through a live
+#      gateway lands on ONE worker and warm runs hit that worker's
+#      result cache, vs forced-random routing spreading it (~1/N).
+#
+# Usage: scripts/fleet_matrix.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TIMEOUT="${SRTPU_FLEET_TIMEOUT:-900}"
+
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_fleet.py tests/test_repr_audit.py \
+    -m fleet -q -p no:cacheprovider "$@"
+
+echo "== fleet-off gate (zero fleet imports/threads, direct path works) =="
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu python - <<'EOF'
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+
+# the ENGINE side: a full in-process query must pull in no fleet module
+from spark_rapids_tpu.expr import Sum, col
+from spark_rapids_tpu.plugin import TpuSession
+
+t = pa.table({"g": pa.array(np.arange(1000) % 8),
+              "v": pa.array(np.random.default_rng(3).uniform(size=1000))})
+sess = TpuSession({"spark.rapids.sql.enabled": True,
+                   "spark.rapids.sql.explain": "NONE"})
+r = (sess.from_arrow(t).group_by("g").agg(s=Sum(col("v")))).collect()
+assert r.num_rows == 8
+leaked = [m for m in sys.modules if m.startswith("spark_rapids_tpu.fleet")]
+assert not leaked, f"FAIL: engine query imported fleet modules: {leaked}"
+fleet_threads = [th.name for th in threading.enumerate()
+                 if th.name.startswith("fleet-")]
+assert not fleet_threads, f"FAIL: fleet threads exist: {fleet_threads}"
+print("engine path: zero fleet imports, zero fleet threads OK")
+
+# the DIRECT client->service path: unchanged single-socket exchange
+import json
+import os
+from spark_rapids_tpu.service import TpuServiceClient
+
+REPO = os.getcwd()
+sock = tempfile.mktemp(suffix=".sock", prefix="srtpu_direct_")
+env = dict(os.environ, JAX_PLATFORMS="cpu",
+           PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+env.pop("XLA_FLAGS", None)
+proc = subprocess.Popen(
+    [sys.executable, "-m", "spark_rapids_tpu.service.server",
+     "--socket", sock, "--platform", "cpu"],
+    env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+try:
+    with TpuServiceClient(sock, deadline_s=90.0) as cli:
+        assert cli.acquire(timeout=10.0) >= 1
+        cli.release()
+        assert cli.health()["device"]["initialized"] in (True, False)
+    leaked = [m for m in sys.modules
+              if m.startswith("spark_rapids_tpu.fleet")]
+    assert not leaked, f"FAIL: direct client imported fleet: {leaked}"
+    print("direct client->service path: works, still fleet-free OK")
+finally:
+    try:
+        with TpuServiceClient(sock, deadline_s=5.0) as cli:
+            cli.shutdown()
+    except Exception:
+        pass
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+EOF
+
+echo "== affinity gate (same plan -> same worker + warm hits; random ~1/N) =="
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from spark_rapids_tpu.fleet.gateway import FleetGateway
+from spark_rapids_tpu.service import TpuServiceClient
+
+REPO = os.getcwd()
+d = tempfile.mkdtemp(prefix="srtpu_fleet_gate_")
+rng = np.random.default_rng(9)
+t = pa.table({"k": pa.array(rng.integers(0, 32, 10_000)),
+              "v": pa.array(rng.uniform(size=10_000))})
+path = os.path.join(d, "t.parquet")
+pq.write_table(t, path)
+paths = {"t": [path]}
+
+
+def plan(thr):
+    attr = lambda name, dt: [  # noqa: E731
+        {"class": "org.apache.spark.sql.catalyst.expressions."
+         "AttributeReference", "num-children": 0, "name": name,
+         "dataType": dt, "nullable": True, "metadata": {},
+         "exprId": {"id": 1, "jvmId": "x"}, "qualifier": []}]
+    filt = {"class": "org.apache.spark.sql.execution.FilterExec",
+            "num-children": 1,
+            "condition": [{"class": "org.apache.spark.sql.catalyst."
+                           "expressions.GreaterThan", "num-children": 2}]
+            + attr("v", "double")
+            + [{"class": "org.apache.spark.sql.catalyst.expressions."
+                "Literal", "num-children": 0, "value": str(thr),
+                "dataType": "double"}]}
+    scan = {"class": "org.apache.spark.sql.execution.FileSourceScanExec",
+            "num-children": 0, "relation": "HadoopFsRelation(parquet)",
+            "output": [attr("k", "long"), attr("v", "double")],
+            "tableIdentifier": "t"}
+    return json.dumps([filt, scan])
+
+
+env = dict(os.environ, JAX_PLATFORMS="cpu",
+           PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+env.pop("XLA_FLAGS", None)
+socks, procs = {}, {}
+for i in range(3):
+    s = os.path.join(d, f"w{i}.sock")
+    socks[f"w{i}"] = s
+    procs[f"w{i}"] = subprocess.Popen(
+        [sys.executable, "-m", "spark_rapids_tpu.service.server",
+         "--socket", s, "--platform", "cpu",
+         "--conf", "spark.rapids.tpu.rescache.enabled=true"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+try:
+    for n, s in socks.items():
+        TpuServiceClient(s, deadline_s=90.0).connect().close()
+
+    def run_gateway(routing, gw_sock):
+        gw = FleetGateway(
+            list(socks.items()),
+            {"spark.rapids.tpu.fleet.routing": routing,
+             "spark.rapids.tpu.fleet.probe.intervalMs": 500},
+            gw_sock)
+        th = threading.Thread(target=gw.serve_forever, daemon=True)
+        th.start()
+        TpuServiceClient(gw_sock, deadline_s=30.0).connect().close()
+        return gw, th
+
+    def stop_gateway(gw_sock, gw, th):
+        with TpuServiceClient(gw_sock, deadline_s=5.0) as cli:
+            cli.shutdown()
+        th.join(timeout=10)
+
+    # affinity: 1 cold + 5 warm of the SAME plan -> one worker, >=5 hits
+    gsock = os.path.join(d, "gw_aff.sock")
+    gw, th = run_gateway("affinity", gsock)
+    with TpuServiceClient(gsock, deadline_s=180.0) as cli:
+        ref = None
+        for _ in range(6):
+            r = cli.run_plan(plan(0.37), paths)
+            assert ref is None or r.equals(ref)
+            ref = r
+        stats = cli.cache_stats()
+    snap = gw._fleet_stats()
+    dispatched = {n: w["dispatches"] for n, w in snap["workers"].items()
+                  if w["dispatches"]}
+    assert len(dispatched) == 1, \
+        f"FAIL: affinity spread one plan over {dispatched}"
+    winner = next(iter(dispatched))
+    hits = stats[winner].get("hits", {}).get("query", 0)
+    assert hits >= 5, f"FAIL: warm runs missed the worker cache: {stats}"
+    assert snap["route_decisions"].get("affinity", 0) == 6
+    stop_gateway(gsock, gw, th)
+    print(f"affinity: 6 identical plans -> 1 worker ({winner}), "
+          f"{hits} warm cache hits OK")
+
+    # forced random: the same 6 dispatches SPREAD (>=2 workers touched)
+    for n, s in socks.items():
+        with TpuServiceClient(s, deadline_s=30.0) as cli:
+            cli.cache_invalidate()
+    gsock = os.path.join(d, "gw_rnd.sock")
+    gw, th = run_gateway("random", gsock)
+    with TpuServiceClient(gsock, deadline_s=180.0) as cli:
+        for _ in range(6):
+            r = cli.run_plan(plan(0.37), paths)
+            assert r.equals(ref), "FAIL: random-routing result differs"
+    snap = gw._fleet_stats()
+    dispatched = {n: w["dispatches"] for n, w in snap["workers"].items()
+                  if w["dispatches"]}
+    assert len(dispatched) >= 2, \
+        f"FAIL: forced-random routing stuck to one worker: {dispatched}"
+    stop_gateway(gsock, gw, th)
+    print(f"random baseline: same 6 dispatches spread over "
+          f"{len(dispatched)} workers OK (affinity is what pins them)")
+finally:
+    for n, p in procs.items():
+        try:
+            with TpuServiceClient(socks[n], deadline_s=3.0) as cli:
+                cli.shutdown()
+        except Exception:
+            pass
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+EOF
+
+echo "fleet matrix: ALL GATES PASSED"

@@ -590,6 +590,57 @@ register("spark.rapids.tpu.compile.tuner.minSamples", "int", 64,
 register("spark.rapids.tpu.compile.tuner.interval", "int", 256,
          "Auto-mode retune cadence (every N observed batches).")
 
+# ---- fleet gateway (spark_rapids_tpu/fleet/) -----------------------------
+register("spark.rapids.tpu.fleet.probe.intervalMs", "int", 1000,
+         "Fleet gateway: background health-probe cadence per worker. A "
+         "crashed worker trips its circuit breaker within roughly this "
+         "interval even with zero query traffic; a restarted one is "
+         "re-admitted through the breaker's half-open trial probe.")
+register("spark.rapids.tpu.fleet.probe.timeoutSec", "double", 2.0,
+         "Fleet gateway: per-probe (and per-dispatch connect) socket "
+         "timeout. A worker that accepts but never answers within this "
+         "counts as a probe failure.")
+register("spark.rapids.tpu.fleet.breaker.failures", "int", 3,
+         "Fleet gateway: consecutive probe/dispatch failures that trip a "
+         "worker's circuit breaker OPEN (no traffic until the cooldown "
+         "elapses and a half-open trial succeeds).")
+register("spark.rapids.tpu.fleet.breaker.cooldownMs", "int", 5000,
+         "Fleet gateway: how long an OPEN breaker blocks all traffic to "
+         "its worker before admitting one half-open trial.")
+register("spark.rapids.tpu.fleet.maxOutstanding", "int", 0,
+         "Fleet gateway: per-worker cap on concurrently dispatched "
+         "queries. When EVERY routable worker is at the cap the gateway "
+         "sheds at its own door (typed rejected reply) before touching "
+         "any worker socket. 0 = uncapped.")
+register("spark.rapids.tpu.fleet.failover.maxAttempts", "int", 3,
+         "Fleet gateway: total workers tried per run_plan (first "
+         "dispatch + failovers) within the caller's deadline. Write "
+         "plans never failover once a request may have started "
+         "executing, regardless of this budget.")
+register("spark.rapids.tpu.fleet.dispatch.timeoutSec", "double", 600.0,
+         "Fleet gateway: upstream wait bound for a dispatched run_plan "
+         "when the caller supplied no deadline; expiry counts as a "
+         "worker connection failure (wedged worker).")
+register("spark.rapids.tpu.fleet.routing", "string", "affinity",
+         "Fleet gateway routing policy: 'affinity' (default) rendezvous-"
+         "hashes the plan fingerprint to a preferred worker, falling "
+         "back to power-of-two-choices load routing for "
+         "unfingerprintable plans; 'random' disables affinity entirely "
+         "(load-only — the CI/bench baseline that shows what affinity "
+         "buys).", check_values=("affinity", "random"))
+register("spark.rapids.tpu.fleet.drain.timeoutSec", "double", 30.0,
+         "Fleet gateway: upper bound on how long a `drain` op with "
+         "wait_s may block for the worker's in-flight queries to "
+         "finish.")
+register("spark.rapids.tpu.fleet.failoverStorm.threshold", "int", 5,
+         "Fleet gateway: failovers within failoverStorm.windowSec that "
+         "dump one flight-recorder incident (a flapping worker churning "
+         "the pool leaves evidence even though individual queries "
+         "succeed).")
+register("spark.rapids.tpu.fleet.failoverStorm.windowSec", "double", 10.0,
+         "Fleet gateway: sliding window for failover-storm detection; "
+         "also the per-window incident rate limit.")
+
 
 class TpuConf:
     """Instance view over a settings dict, with typed accessors (reference

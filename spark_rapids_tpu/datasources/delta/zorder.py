@@ -35,6 +35,12 @@ class InterleaveBits(Expression):
         k = max(len(self.children), 1)
         self.bits = max(min(int(bits), 63 // k), 1)
 
+    def __repr__(self):
+        # bits is unrolled into the traced program: repr-derived cache
+        # keys must not alias different widths over the same children
+        return f"InterleaveBits({', '.join(map(repr, self.children))}; " \
+               f"bits={self.bits})"
+
     @property
     def data_type(self):
         return T.LONG

@@ -141,6 +141,29 @@ class DeadlineExceededError(RapidsTpuError, TimeoutError):
         self.deadline_s = deadline_s
 
 
+class ServiceConnectionError(RapidsTpuError, ConnectionError):
+    """A device-service connection died mid-request (worker crash, socket
+    EOF, reset). Carries the endpoint and op so callers — the fleet
+    gateway's failover loop above all — can decide whether the request is
+    safe to re-dispatch: `phase` is "connect" when the request never
+    reached the peer (always retryable), "send"/"recv" when it may have
+    started executing (write plans must NOT be auto-retried then). Also a
+    ConnectionError so pre-existing handlers keep working."""
+
+    def __init__(self, message: str, endpoint: str = "", op: str = "",
+                 phase: str = "recv", cause: Exception = None):
+        super().__init__(message)
+        self.endpoint = endpoint
+        self.op = op
+        self.phase = phase
+        self.cause = cause
+
+    @property
+    def maybe_executed(self) -> bool:
+        """True when the peer may have begun executing the request."""
+        return self.phase != "connect"
+
+
 class AdmissionTimeoutError(RapidsTpuError, TimeoutError):
     """The device-service admission semaphore did not grant a token within
     the requested timeout. Carries the server's held/waiting diagnostics

@@ -473,7 +473,16 @@ class Literal(LeafExpression):
         return Vec(dt, data, xp.ones(n, dtype=bool))
 
     def __repr__(self):
-        return f"lit({self.value!r})"
+        # an explicit dtype beyond what the value infers is part of the
+        # literal's identity: lit(1) as INT and as LONG trace different
+        # programs, so repr-derived cache keys must not alias them
+        try:
+            inferred = self._dtype == _infer_literal_type(self.value)
+        except Exception:
+            inferred = False
+        if inferred:
+            return f"lit({self.value!r})"
+        return f"lit({self.value!r}:{self._dtype.simple_string()})"
 
 
 def _infer_literal_type(v) -> T.DataType:

@@ -121,7 +121,11 @@ class Cast(Expression):
         return _numeric_cast(xp, c, dst, ctx)
 
     def __repr__(self):
-        return f"cast({self.children[0]!r} as {self.to.simple_string()})"
+        # ansi flips overflow/parse failures from null to raise — a
+        # different traced program, so it must show in cache keys
+        extra = ", ansi" if self.ansi else ""
+        return f"cast({self.children[0]!r} as " \
+               f"{self.to.simple_string()}{extra})"
 
 
 def _numeric_cast(xp, c: Vec, dst: T.DataType, ctx=None) -> Vec:

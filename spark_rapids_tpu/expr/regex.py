@@ -705,7 +705,10 @@ class Like(Expression):
         return Vec(T.BOOLEAN, ok, s.validity)
 
     def __repr__(self):
-        return f"Like({self.children[0]!r}, {self.pattern!r})"
+        # a non-default escape char rewrites the derived regex: two LIKEs
+        # over the same pattern must not alias across escapes
+        extra = f", escape={self.escape!r}" if self.escape != "\\" else ""
+        return f"Like({self.children[0]!r}, {self.pattern!r}{extra})"
 
 
 class RegExpReplace(Expression):
@@ -784,7 +787,10 @@ class RegExpExtract(Expression):
         return _strings_to_vec(ctx.xp, out, s.validity)
 
     def __repr__(self):
-        return f"RegExpExtract({self.children[0]!r}, {self.pattern!r})"
+        # group index selects WHICH capture comes back (RegExpExtractAll
+        # renders it already; this one dropped it — the aliasing class)
+        return f"RegExpExtract({self.children[0]!r}, {self.pattern!r}, " \
+               f"{self.idx})"
 
 
 def _strings_to_vec(xp, rows: List[Optional[str]], validity) -> Vec:

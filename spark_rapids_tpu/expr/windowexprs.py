@@ -211,6 +211,10 @@ class NthValue(WindowFunction):
 
     def __repr__(self):
         extra = ", ignore_nulls" if self.ignore_nulls else ""
+        if self.frame is not None:
+            # an explicit frame narrows which rows the nth comes from —
+            # WindowAggregate renders its frame, this one must too
+            extra += f" FRAME {self.frame!r}"
         return f"nth_value({self.children[0]!r}, {self.n}{extra})"
 
 

@@ -1,0 +1,31 @@
+"""Fleet gateway — health-aware query routing over a TPU worker pool.
+
+The serving tier the single-process `TpuDeviceService` lacks: a gateway
+process fronts N workers behind the unchanged wire protocol, with
+cache-affinity placement (rescache fingerprints rendezvous-hashed to the
+worker whose result/compile caches are warm), power-of-two-choices load
+routing for unfingerprintable plans, per-worker circuit breakers fed by
+background health probes, deadline-aware failover with a no-auto-retry
+rule for write plans, admin drain/undrain for rolling restarts, and
+fleet-door load shedding (ARCHITECTURE.md "Fleet gateway").
+
+  * `registry.py` — worker pool state: breakers, health prober,
+    outstanding depth, drain flags, query placements.
+  * `router.py`   — affinity digest (reuses rescache/fingerprint.py,
+    fail-closed), rendezvous order, power-of-two choice, write-plan
+    classification.
+  * `gateway.py`  — the protocol server + routing/failover core;
+    `python -m spark_rapids_tpu.fleet.gateway --worker name=sock ...`.
+
+Off-path contract: NOTHING in the engine imports this package. A process
+that never starts a gateway has zero fleet threads and zero fleet state,
+and the direct client->service path is byte-for-byte the pre-fleet
+exchange (scripts/fleet_matrix.sh gates it). Telemetry gauge callbacks
+observe the pool through `sys.modules` lookups only — they never import
+this package either."""
+
+from .gateway import FleetGateway
+from .registry import CircuitBreaker, WorkerRegistry, live_registries
+
+__all__ = ["FleetGateway", "WorkerRegistry", "CircuitBreaker",
+           "live_registries"]

@@ -1,0 +1,773 @@
+"""Fleet gateway: health-aware query routing over a TPU worker pool.
+
+Fronts N `TpuDeviceService` workers behind the EXISTING wire protocol —
+Spark-side clients keep speaking `ping`/`acquire`/`release`/`run_plan`/
+`cancel` against one socket and never learn the pool exists. Per
+request:
+
+  run_plan   routed affinity -> load -> failover -> shed (router.py):
+             the plan's canonical fingerprint rendezvous-hashes to the
+             worker whose result/compile caches are already warm;
+             unfingerprintable plans take power-of-two-choices on live
+             outstanding depth. A worker that dies or trips its breaker
+             mid-flight is failed over within the caller's remaining
+             deadline — except write plans, which are never auto-retried
+             once the request may have started executing. When no worker
+             is routable the gateway sheds at its OWN door (typed
+             `rejected` reply) before any worker socket work.
+  acquire    pins this client connection to one worker chosen by load;
+             release and subsequent run_plans on the connection follow
+             the pin (the admission token and the work it gates must
+             land on the same worker). A client that dies holding a
+             token tears down the pinned upstream connection, and the
+             worker's existing disconnect-releases-token semantics
+             reclaim it — the guarantee composes through the hop.
+  cancel     routed via the registry's query placements to whichever
+             worker is actually running that query id; unknown or
+             already-finished ids get a clean `found: false` reply.
+  drain /    admin ops for rolling restarts: a draining worker finishes
+  undrain    its in-flight queries but receives zero new placements.
+  fleet_stats  registry snapshot (breakers, outstanding, placements,
+             route-decision counters).
+  stats / health / cache_stats / cache_invalidate  gateway-local scrape,
+             fleet health view, and per-worker cache fan-outs.
+
+Observability rides PR-7: route-decision counters and per-worker
+breaker/outstanding gauges in the telemetry registry, trace ids
+propagated through the hop (plus a gateway-side v2 event-log record per
+run_plan) so `profile_report --trace` stitches client -> gateway ->
+worker, and a flight-recorder incident on failover storms.
+
+Gateway OFF is the default and costs nothing: no engine module imports
+this package, so a process that never starts a gateway has zero fleet
+threads and zero fleet state, and the direct client -> TpuDeviceService
+path is byte-for-byte the pre-fleet wire exchange
+(scripts/fleet_matrix.sh gates it)."""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..config import TpuConf
+from ..errors import ServiceConnectionError
+from ..service.protocol import recv_msg, request, send_msg
+from . import router
+from .registry import WorkerRegistry
+
+__all__ = ["FleetGateway"]
+
+# route decisions (counter label values + fleet_stats keys)
+DECISION_AFFINITY = "affinity"
+DECISION_LOAD = "load"
+DECISION_FAILOVER = "failover"
+DECISION_SHED = "shed"
+DECISION_PINNED = "pinned"
+
+
+class _WorkerLink:
+    """One upstream socket to a worker, raw-frame level: the gateway
+    forwards reply headers/bodies byte-for-byte instead of parsing Arrow
+    tables it would immediately re-serialize."""
+
+    def __init__(self, name: str, socket_path: str,
+                 connect_timeout_s: float):
+        self.name = name
+        self.socket_path = socket_path
+        self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self.sock.settimeout(max(connect_timeout_s, 0.05))
+        try:
+            self.sock.connect(socket_path)
+        except OSError as e:
+            self.sock.close()
+            raise ServiceConnectionError(
+                f"worker {name} ({socket_path}) refused connection: {e}",
+                endpoint=socket_path, op="connect", phase="connect",
+                cause=e) from e
+
+    def request(self, header: dict, body: bytes = b"",
+                timeout_s: Optional[float] = None) -> Tuple[dict, bytes]:
+        self.sock.settimeout(timeout_s)
+        op = header.get("op", "")
+        try:
+            return request(self.sock, header, body)
+        except socket.timeout as e:
+            # a wedged worker is indistinguishable from a dead one from
+            # out here; phase "recv" keeps write plans from re-dispatching
+            raise ServiceConnectionError(
+                f"worker {self.name} did not answer {op!r} within "
+                f"{timeout_s}s", endpoint=self.socket_path, op=op,
+                phase="recv", cause=e) from e
+        except (ConnectionError, OSError) as e:
+            raise ServiceConnectionError(
+                f"worker {self.name} connection lost during {op!r} "
+                f"({type(e).__name__}: {e})", endpoint=self.socket_path,
+                op=op, phase=getattr(e, "_wire_phase", "recv"),
+                cause=e) from e
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class FleetGateway:
+    def __init__(self, workers: List[Tuple[str, str]],
+                 conf: Optional[Dict] = None,
+                 socket_path: str = "/tmp/spark_rapids_tpu_fleet.sock"):
+        self.conf = conf if isinstance(conf, TpuConf) else TpuConf(conf)
+        self.socket_path = socket_path
+        c = self.conf
+        self.max_outstanding = c.get("spark.rapids.tpu.fleet.maxOutstanding")
+        self.max_attempts = max(
+            1, c.get("spark.rapids.tpu.fleet.failover.maxAttempts"))
+        self.dispatch_timeout_s = c.get(
+            "spark.rapids.tpu.fleet.dispatch.timeoutSec")
+        self.connect_timeout_s = c.get(
+            "spark.rapids.tpu.fleet.probe.timeoutSec")
+        self.routing = c.get("spark.rapids.tpu.fleet.routing")
+        self.drain_timeout_s = c.get(
+            "spark.rapids.tpu.fleet.drain.timeoutSec")
+        self._storm_threshold = c.get(
+            "spark.rapids.tpu.fleet.failoverStorm.threshold")
+        self._storm_window_s = c.get(
+            "spark.rapids.tpu.fleet.failoverStorm.windowSec")
+        self._storm_times: "collections.deque[float]" = collections.deque()
+        self._storm_last_incident = 0.0
+        self._storm_mu = threading.Lock()
+        self.registry = WorkerRegistry(
+            workers,
+            probe_interval_s=c.get(
+                "spark.rapids.tpu.fleet.probe.intervalMs") / 1000.0,
+            probe_timeout_s=self.connect_timeout_s,
+            breaker_failures=c.get(
+                "spark.rapids.tpu.fleet.breaker.failures"),
+            breaker_cooldown_s=c.get(
+                "spark.rapids.tpu.fleet.breaker.cooldownMs") / 1000.0,
+            on_transition=self._on_breaker_transition)
+        self.route_counts: Dict[str, int] = collections.defaultdict(int)
+        self._counts_mu = threading.Lock()
+        # plan-text -> (digest, is_write) LRU: a hot dashboard repeats the
+        # same plan JSON hundreds of times, and translating + fingerprint
+        # per request duplicates work the worker redoes anyway. Staleness
+        # (a source file rewritten under an unchanged plan text) only
+        # mis-PLACES — the worker's own fingerprint still keys on fresh
+        # file identity, so correctness is untouched.
+        self._digest_cache: "collections.OrderedDict[str, tuple]" = \
+            collections.OrderedDict()
+        self._digest_mu = threading.Lock()
+        self.event_log_dir = c.get(
+            "spark.rapids.tpu.metrics.eventLog.dir") or None
+        self._stop = threading.Event()
+        self._listener: Optional[socket.socket] = None
+        from .. import telemetry
+        telemetry.configure(self.conf)
+
+    # ------------------------------------------------------------ lifecycle
+    def serve_forever(self) -> None:
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        self.registry.start()
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        srv.bind(self.socket_path)
+        srv.listen(128)
+        srv.settimeout(0.5)
+        self._listener = srv
+        try:
+            while not self._stop.is_set():
+                try:
+                    conn, _ = srv.accept()
+                except socket.timeout:
+                    continue
+                threading.Thread(target=self._serve_conn, args=(conn,),
+                                 name="fleet-conn", daemon=True).start()
+        finally:
+            srv.close()
+            self.registry.stop()
+            if os.path.exists(self.socket_path):
+                os.unlink(self.socket_path)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # ------------------------------------------------------- per-connection
+    def _serve_conn(self, conn: socket.socket) -> None:
+        pinned: Optional[_WorkerLink] = None
+        held = 0  # tokens this connection holds on the pinned worker
+        try:
+            while True:
+                try:
+                    header, body = recv_msg(conn)
+                except (ConnectionError, OSError):
+                    return
+                op = header.get("op")
+                if op == "ping":
+                    send_msg(conn, {
+                        "ok": True, "gateway": True,
+                        "workers": len(self.registry.workers),
+                        "device": f"fleet[{len(self.registry.workers)}]"})
+                elif op == "acquire":
+                    pinned, granted = self._handle_acquire(conn, header,
+                                                           pinned)
+                    held = (held + 1) if granted else \
+                        (held if pinned is not None else 0)
+                elif op == "release":
+                    pinned = self._forward_pinned(
+                        conn, header, pinned,
+                        {"ok": True, "released": False})
+                    if pinned is None:
+                        held = 0  # worker died: its holds died with it
+                    elif held:
+                        held -= 1
+                        if held == 0:
+                            # last token returned: drop the pin so later
+                            # run_plans regain affinity routing+failover
+                            pinned.close()
+                            pinned = None
+                elif op == "run_plan":
+                    pinned = self._handle_run_plan(conn, header, pinned)
+                    if pinned is None:
+                        held = 0
+                elif op == "cancel":
+                    self._handle_cancel(conn, header)
+                elif op == "drain":
+                    self._handle_drain(conn, header, drain=True)
+                elif op == "undrain":
+                    self._handle_drain(conn, header, drain=False)
+                elif op == "fleet_stats":
+                    send_msg(conn, {"ok": True, "fleet": self._fleet_stats()})
+                elif op == "health":
+                    send_msg(conn, {"ok": True, "health": self._health()})
+                elif op == "stats":
+                    self._handle_stats(conn)
+                elif op in ("cache_stats", "cache_invalidate"):
+                    self._handle_cache_fanout(conn, op)
+                elif op == "shutdown":
+                    send_msg(conn, {"ok": True})
+                    self._stop.set()
+                    return
+                else:
+                    send_msg(conn, {"ok": False,
+                                    "error": f"unknown op {op!r}"})
+        finally:
+            # the pinned upstream carries this client's admission holds;
+            # closing it makes the worker's disconnect-releases-token
+            # guarantee fire for clients that die holding tokens
+            if pinned is not None:
+                pinned.close()
+            conn.close()
+
+    # ----------------------------------------------------- acquire/release
+    def _handle_acquire(self, conn: socket.socket, header: dict,
+                        pinned: Optional[_WorkerLink]
+                        ) -> Tuple[Optional[_WorkerLink], bool]:
+        """Forward acquire to the pinned worker (or pin the least-loaded
+        routable one) and relay the reply. Returns (pin, granted): the
+        pin outlives a GRANTED op — the token and the run_plans it gates
+        must hit the same worker — and the caller drops it once the last
+        token is released."""
+        was_pinned = pinned is not None
+        if pinned is None:
+            cands = self.registry.routable(self.max_outstanding)
+            if not cands:
+                self._count(DECISION_SHED)
+                send_msg(conn, {
+                    "ok": False, "error_type": "rejected",
+                    "error": "fleet gateway: no routable worker for "
+                             "acquire (all draining/tripped/at capacity)"})
+                return None, False
+            # connect-phase failures never reached a worker — always safe
+            # to try the next load-preference candidate
+            last: Optional[ServiceConnectionError] = None
+            for target in router.pick_two_choices(cands):
+                try:
+                    pinned = _WorkerLink(target.name, target.socket_path,
+                                         self.connect_timeout_s)
+                    break
+                except ServiceConnectionError as e:
+                    self.registry.note_failure(target.name, str(e))
+                    last = e
+            if pinned is None:
+                send_msg(conn, {"ok": False, "error_type": "connection",
+                                "error": str(last), "endpoint": last.endpoint,
+                                "op": "acquire", "phase": last.phase})
+                return None, False
+        try:
+            # acquire may park in the worker's admission queue for as long
+            # as the caller asked (timeout=None = forever): no socket
+            # timeout of our own on top
+            t = header.get("timeout")
+            rep, rbody = pinned.request(
+                header, timeout_s=(t + 5.0) if t is not None else None)
+        except ServiceConnectionError as e:
+            self.registry.note_failure(pinned.name, str(e))
+            pinned.close()
+            send_msg(conn, {"ok": False, "error_type": "connection",
+                            "error": str(e), "endpoint": e.endpoint,
+                            "op": "acquire", "phase": e.phase})
+            return None, False
+        send_msg(conn, rep, rbody)
+        if not rep.get("ok") and not was_pinned:
+            # a shed/timed-out acquire granted nothing: keeping the fresh
+            # pin would silently route every later run_plan on this
+            # connection to one worker with no affinity and no failover
+            pinned.close()
+            return None, False
+        return pinned, bool(rep.get("ok"))
+
+    def _forward_pinned(self, conn: socket.socket, header: dict,
+                        pinned: Optional[_WorkerLink],
+                        fallback_reply: dict) -> Optional[_WorkerLink]:
+        """Forward one op to the pinned worker. Returns the surviving pin:
+        a link that errored is CLOSED and dropped — reusing a socket after
+        a recv failure/timeout could hand the NEXT request the previous
+        op's late reply (frame-stream desync = wrong results)."""
+        if pinned is None:
+            send_msg(conn, fallback_reply)
+            return None
+        try:
+            rep, rbody = pinned.request(header,
+                                        timeout_s=self.dispatch_timeout_s)
+        except ServiceConnectionError as e:
+            self.registry.note_failure(pinned.name, str(e))
+            pinned.close()
+            send_msg(conn, {"ok": False, "error_type": "connection",
+                            "error": str(e), "endpoint": e.endpoint,
+                            "op": header.get("op", ""), "phase": e.phase})
+            return None
+        send_msg(conn, rep, rbody)
+        return pinned
+
+    # ------------------------------------------------------------ run_plan
+    def _handle_run_plan(self, conn: socket.socket, header: dict,
+                         pinned: Optional[_WorkerLink]
+                         ) -> Optional[_WorkerLink]:
+        """Returns the surviving pin (a pinned link that errored is
+        closed and dropped — see _forward_pinned)."""
+        t0 = time.monotonic()
+        qid = header.get("query_id") or None
+        trace = header.get("trace") or ""
+        deadline_s = header.get("deadline_s") or None
+        status = "ok"
+        decision = DECISION_PINNED if pinned is not None else "?"
+        worker_names: List[str] = []
+        failovers = 0
+        try:
+            if pinned is not None:
+                # token-holding connection: the work belongs to the worker
+                # holding the token — no routing, no failover
+                self._count(DECISION_PINNED)
+                worker_names.append(pinned.name)
+                status, pinned = self._dispatch_pinned(conn, header,
+                                                       pinned, qid)
+                return pinned
+            digest, is_write = self._analyze_cached(
+                header.get("plan", ""), header.get("paths") or {})
+            status, decision, worker_names, failovers = self._route(
+                conn, header, digest, is_write, t0, deadline_s, qid)
+            return None
+        finally:
+            self._log_gateway_op(trace, time.monotonic() - t0, status,
+                                 qid, decision, worker_names, failovers)
+
+    _DIGEST_CACHE_MAX = 256
+
+    def _analyze_cached(self, plan_json, paths) -> tuple:
+        """(affinity_digest | None, is_write), memoized on the raw plan
+        text + path overrides. routing='random' skips the digest (load-
+        only baseline) but still classifies writes off the raw JSON."""
+        if self.routing == "random":
+            return None, router.plan_is_write(plan_json)
+        key = plan_json if isinstance(plan_json, str) \
+            else json.dumps(plan_json, sort_keys=True)
+        if paths:
+            key += "|" + json.dumps(paths, sort_keys=True, default=str)
+        with self._digest_mu:
+            hit = self._digest_cache.get(key)
+            if hit is not None:
+                self._digest_cache.move_to_end(key)
+                return hit
+        result = router.analyze(plan_json, paths, self.conf)
+        with self._digest_mu:
+            self._digest_cache[key] = result
+            self._digest_cache.move_to_end(key)
+            while len(self._digest_cache) > self._DIGEST_CACHE_MAX:
+                self._digest_cache.popitem(last=False)
+        return result
+
+    def _dispatch_pinned(self, conn: socket.socket, header: dict,
+                         pinned: _WorkerLink, qid: Optional[str]
+                         ) -> Tuple[str, Optional[_WorkerLink]]:
+        self.registry.note_dispatch(pinned.name, qid)
+        try:
+            rep, rbody = pinned.request(header,
+                                        timeout_s=self.dispatch_timeout_s)
+        except ServiceConnectionError as e:
+            self.registry.note_failure(pinned.name, str(e), dispatch=True)
+            # drop the pin: the socket may still receive the timed-out
+            # query's late reply, which a reused link would hand to the
+            # NEXT request as its result
+            pinned.close()
+            send_msg(conn, {"ok": False, "error_type": "connection",
+                            "error": str(e), "endpoint": e.endpoint,
+                            "op": "run_plan", "phase": e.phase})
+            return "connection", None
+        finally:
+            self.registry.note_done(pinned.name, qid)
+        self.registry.note_success(pinned.name)
+        send_msg(conn, rep, rbody)
+        return (rep.get("error_type")
+                or ("ok" if rep.get("ok") else "error")), pinned
+
+    def _route(self, conn: socket.socket, header: dict,
+               digest: Optional[str], is_write: bool, t0: float,
+               deadline_s: Optional[float], qid: Optional[str]
+               ) -> Tuple[str, str, List[str], int]:
+        """The routing core. Returns (status, first_decision,
+        workers_attempted, failover_count); the reply has been sent."""
+        from .. import telemetry
+        first_decision = DECISION_AFFINITY if digest else DECISION_LOAD
+        attempted: List[str] = []
+        causes: List[str] = []
+        rejected_reply: Optional[dict] = None
+        failovers = 0
+
+        for attempt in range(self.max_attempts):
+            cands = [w for w in self.registry.routable(self.max_outstanding)
+                     if w.name not in attempted]
+            if not cands:
+                break
+            if digest:
+                order = router.rendezvous_order(
+                    digest, [w.name for w in cands])
+                target = next(w for w in cands if w.name == order[0])
+            else:
+                target = router.pick_two_choices(cands)[0]
+            remaining = None
+            if deadline_s is not None:
+                remaining = deadline_s - (time.monotonic() - t0)
+                if remaining <= 0:
+                    self._reply_deadline(conn, deadline_s, causes, qid)
+                    return ("deadline", first_decision, attempted,
+                            failovers)
+            if attempt > 0:
+                failovers += 1
+                self._count(DECISION_FAILOVER)
+                telemetry.inc("tpu_fleet_failover_total",
+                              worker=attempted[-1])
+                telemetry.flight("fleet", "failover",
+                                 trace_id=header.get("trace") or "",
+                                 from_worker=attempted[-1],
+                                 to_worker=target.name,
+                                 query_id=qid or "")
+                self._note_failover_storm()
+            else:
+                self._count(first_decision)
+            attempted.append(target.name)
+            fwd = dict(header)
+            if remaining is not None:
+                fwd["deadline_s"] = remaining
+            self.registry.note_dispatch(target.name, qid)
+            try:
+                link = _WorkerLink(
+                    target.name, target.socket_path,
+                    min(self.connect_timeout_s, remaining)
+                    if remaining is not None else self.connect_timeout_s)
+                try:
+                    # +grace over the forwarded deadline: the WORKER owns
+                    # deadline enforcement (its clock starts after ours)
+                    # and must get to reply the typed `deadline` error —
+                    # a socket timeout at exactly `remaining` would
+                    # misread every expiry as a worker connection failure
+                    # and feed healthy workers' breakers
+                    rep, rbody = link.request(
+                        fwd, timeout_s=remaining + 5.0
+                        if remaining is not None
+                        else self.dispatch_timeout_s)
+                finally:
+                    link.close()
+            except ServiceConnectionError as e:
+                self.registry.note_failure(target.name, str(e),
+                                           dispatch=True)
+                causes.append(f"{target.name}: {e}")
+                if is_write and e.maybe_executed:
+                    # the worker may have begun mutating external state:
+                    # surfacing beats double-writing, always
+                    send_msg(conn, {
+                        "ok": False, "error_type": "connection",
+                        "error": "write plan not auto-retried after "
+                                 f"connection loss mid-request ({e})",
+                        "endpoint": e.endpoint, "op": "run_plan",
+                        "phase": e.phase, "query_id": qid})
+                    return ("connection", first_decision, attempted,
+                            failovers)
+                continue
+            finally:
+                self.registry.note_done(target.name, qid)
+            et = rep.get("error_type")
+            if et == "rejected":
+                # this worker shed under ITS overload policy; another may
+                # have headroom — keep the reply in case everyone sheds
+                causes.append(f"{target.name}: shed ({rep.get('error')})")
+                rejected_reply = rep
+                continue
+            self.registry.note_success(target.name)
+            send_msg(conn, rep, rbody)
+            return (et or ("ok" if rep.get("ok") else "error"),
+                    first_decision, attempted, failovers)
+
+        # nothing routable / every attempt failed
+        if deadline_s is not None and \
+                deadline_s - (time.monotonic() - t0) <= 0:
+            self._reply_deadline(conn, deadline_s, causes, qid)
+            return "deadline", first_decision, attempted, failovers
+        if rejected_reply is not None:
+            rep = dict(rejected_reply)
+            rep["error"] = ("fleet gateway: every routable worker shed "
+                            "this query; " + "; ".join(causes))
+            self._count(DECISION_SHED)
+            send_msg(conn, rep)
+            return "rejected", first_decision, attempted, failovers
+        if causes:
+            send_msg(conn, {
+                "ok": False, "error_type": "connection",
+                "error": "fleet gateway: no worker completed the query "
+                         "(causes: " + "; ".join(causes) + ")",
+                "endpoint": self.socket_path, "op": "run_plan",
+                "phase": "recv", "query_id": qid})
+            return "connection", first_decision, attempted, failovers
+        self._count(DECISION_SHED)
+        telemetry.flight("fleet", "shed", trace_id=header.get("trace")
+                         or "", query_id=qid or "")
+        send_msg(conn, {
+            "ok": False, "error_type": "rejected",
+            "error": "fleet gateway: no routable worker (all draining, "
+                     "breaker-tripped, or at maxOutstanding)",
+            "query_id": qid})
+        return "rejected", first_decision, attempted, failovers
+
+    def _reply_deadline(self, conn: socket.socket, deadline_s: float,
+                        causes: List[str], qid: Optional[str]) -> None:
+        msg = f"fleet gateway: deadline of {deadline_s}s exhausted"
+        if causes:
+            msg += " after worker failures (causes: " \
+                   + "; ".join(causes) + ")"
+        send_msg(conn, {"ok": False, "error_type": "deadline",
+                        "error": msg, "query_id": qid})
+
+    # -------------------------------------------------------------- cancel
+    def _handle_cancel(self, conn: socket.socket, header: dict) -> None:
+        """Route a cancel to the worker running the query. Unknown /
+        already-finished ids reply cleanly (`found: false`) — a cancel is
+        a request for a state ('not running'), and that state holds."""
+        qid = header.get("query_id")
+        clean = {"ok": True, "query_id": qid, "found": False,
+                 "killed": False}
+        if not qid:
+            send_msg(conn, clean)
+            return
+        # a cancel racing the run_plan dispatch can beat the plan to the
+        # gateway's placement table (the submitting thread is still
+        # translating) or to the worker's query registry; brief retry on
+        # BOTH miss shapes before declaring the id unknown
+        for _ in range(4):
+            w = self.registry.placement_of(qid)
+            if w is None:
+                time.sleep(0.05)
+                continue
+            try:
+                link = _WorkerLink(w.name, w.socket_path,
+                                   self.connect_timeout_s)
+                try:
+                    rep, _ = link.request(
+                        header, timeout_s=self.connect_timeout_s + 5.0)
+                finally:
+                    link.close()
+            except ServiceConnectionError:
+                # the worker died — its query is as cancelled as it gets
+                send_msg(conn, clean)
+                return
+            if rep.get("ok"):
+                rep.setdefault("found", True)
+                send_msg(conn, rep)
+                return
+            if rep.get("error_type") != "unknown_query":
+                send_msg(conn, rep)
+                return
+            time.sleep(0.05)
+        send_msg(conn, clean)
+
+    # --------------------------------------------------------------- admin
+    def _handle_drain(self, conn: socket.socket, header: dict,
+                      drain: bool) -> None:
+        name = header.get("worker")
+        if name not in self.registry.workers:
+            send_msg(conn, {"ok": False, "error_type": "unknown_worker",
+                            "error": f"unknown worker {name!r} "
+                                     f"(have {sorted(self.registry.workers)})"})
+            return
+        if drain:
+            self.registry.drain(name)
+            wait_s = header.get("wait_s")
+            if wait_s:
+                t_end = time.monotonic() + min(float(wait_s),
+                                               self.drain_timeout_s)
+                while self.registry.outstanding_of(name) > 0 and \
+                        time.monotonic() < t_end:
+                    time.sleep(0.02)
+        else:
+            self.registry.undrain(name)
+        send_msg(conn, {"ok": True, "worker": name, "draining": drain,
+                        "outstanding": self.registry.outstanding_of(name)})
+
+    def _fleet_stats(self) -> dict:
+        snap = self.registry.snapshot()
+        with self._counts_mu:
+            snap["route_decisions"] = dict(self.route_counts)
+        return snap
+
+    def _health(self) -> dict:
+        snap = self.registry.snapshot()
+        workers = snap["workers"]
+        routable = sum(1 for w in workers.values()
+                       if not w["draining"] and w["breaker"] != "open")
+        return {"role": "gateway", "socket": self.socket_path,
+                "workers": workers, "routable": routable,
+                "ok": routable > 0}
+
+    def _handle_stats(self, conn: socket.socket) -> None:
+        from .. import telemetry
+        if not telemetry.is_enabled():
+            send_msg(conn, {
+                "ok": False,
+                "error": "telemetry disabled "
+                         "(spark.rapids.tpu.telemetry.enabled)",
+                "error_type": "telemetry_disabled"})
+            return
+        body = telemetry.render_prometheus().encode("utf-8")
+        send_msg(conn, {"ok": True, "lines": len(body.splitlines())}, body)
+
+    def _handle_cache_fanout(self, conn: socket.socket, op: str) -> None:
+        """cache_stats/cache_invalidate fan out to every worker; one dead
+        worker degrades its slot in the reply, never the whole op."""
+        out: Dict[str, object] = {}
+        dropped = 0
+        for name, w in list(self.registry.workers.items()):
+            try:
+                link = _WorkerLink(name, w.socket_path,
+                                   self.connect_timeout_s)
+                try:
+                    rep, _ = link.request(
+                        {"op": op}, timeout_s=self.connect_timeout_s + 5.0)
+                finally:
+                    link.close()
+            except ServiceConnectionError as e:
+                out[name] = {"error": str(e)}
+                continue
+            if rep.get("ok"):
+                out[name] = rep.get("stats", rep.get("dropped"))
+                dropped += int(rep.get("dropped") or 0)
+            else:
+                out[name] = {"error": rep.get("error")}
+        if op == "cache_stats":
+            send_msg(conn, {"ok": True, "stats": out})
+        else:
+            send_msg(conn, {"ok": True, "dropped": dropped,
+                            "workers": out})
+
+    # -------------------------------------------------------- observability
+    def _count(self, decision: str) -> None:
+        from .. import telemetry
+        with self._counts_mu:
+            self.route_counts[decision] += 1
+        telemetry.inc("tpu_fleet_route_total", decision=decision)
+
+    def _on_breaker_transition(self, worker: str, state: str) -> None:
+        from .. import telemetry
+        telemetry.flight("fleet", f"breaker_{state}", worker=worker)
+
+    def _note_failover_storm(self) -> None:
+        """Failover burst detection: > threshold failovers inside the
+        window dumps ONE flight-recorder incident per window — the
+        evidence trail for 'a worker is flapping and the pool is
+        churning' that individual failed queries cannot leave."""
+        from .. import telemetry
+        now = time.monotonic()
+        with self._storm_mu:
+            self._storm_times.append(now)
+            while self._storm_times and \
+                    now - self._storm_times[0] > self._storm_window_s:
+                self._storm_times.popleft()
+            storm = (len(self._storm_times) >= self._storm_threshold and
+                     now - self._storm_last_incident > self._storm_window_s)
+            if storm:
+                self._storm_last_incident = now
+                count = len(self._storm_times)
+        if storm:
+            threading.Thread(
+                target=telemetry.incident, args=("failover_storm",),
+                kwargs={"count": count,
+                        "window_s": self._storm_window_s},
+                name="fleet-incident", daemon=True).start()
+
+    def _log_gateway_op(self, trace: str, dur_s: float, status: str,
+                        qid: Optional[str], decision: str,
+                        workers: List[str], failovers: int) -> None:
+        """One v2 event-log record per routed run_plan — the GATEWAY hop
+        of the cross-process trace (`profile_report --trace` renders
+        client -> gateway -> worker from the shared trace id)."""
+        if not self.event_log_dir or not trace:
+            return
+        try:
+            from ..utils import spans
+            rec = spans.client_op_record(
+                "run_plan", trace, int(dur_s * 1e9), status=status,
+                query_id=qid or "", role="gateway",
+                decision=decision, worker=",".join(workers),
+                failovers=failovers)
+            rec["name"] = "gateway:run_plan"
+            spans.write_client_record(self.event_log_dir, rec)
+        except Exception:
+            pass  # a logging failure never fails routing
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--socket", default="/tmp/spark_rapids_tpu_fleet.sock")
+    ap.add_argument("--worker", action="append", default=[],
+                    metavar="NAME=SOCKET_PATH", required=False,
+                    help="one TpuDeviceService worker (repeatable)")
+    ap.add_argument("--conf", action="append", default=[], metavar="K=V")
+    args = ap.parse_args(argv)
+    if not args.worker:
+        ap.error("at least one --worker NAME=SOCKET_PATH is required")
+    workers = []
+    for w in args.worker:
+        name, _, path = w.partition("=")
+        if not path:
+            name, path = f"w{len(workers)}", name
+        workers.append((name, path))
+    conf = {}
+    for kv in args.conf:
+        k, _, v = kv.partition("=")
+        if v and v[0] in "[{0123456789tf-":
+            try:
+                conf[k] = json.loads(v)
+            except ValueError:
+                conf[k] = v  # e.g. tp=4-style strings: pass through raw
+        else:
+            conf[k] = v
+    gw = FleetGateway(workers, conf, args.socket)
+    gw.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

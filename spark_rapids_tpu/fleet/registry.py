@@ -1,0 +1,299 @@
+"""Worker registry: the gateway's live view of the TPU worker pool.
+
+One `WorkerState` per `TpuDeviceService` socket, holding a per-worker
+circuit breaker (trip on consecutive failures, half-open re-probe after a
+cooldown), the gateway-local outstanding-query depth (the load signal
+power-of-two-choices routing reads), the draining flag (admin
+`drain`/`undrain` for rolling restarts: finish in-flight, route nothing
+new), and lifetime dispatch/failure accounting. A background prober
+thread pings every worker on a fixed interval so a crashed worker trips
+its breaker within ~`probe.intervalMs` even with zero query traffic, and
+a restarted worker is re-admitted through the breaker's half-open trial
+without operator action.
+
+The registry also owns PLACEMENTS — query_id -> worker for every
+in-flight `run_plan` — which is what lets a `cancel(query_id)` arriving
+on a different gateway connection find the worker actually running the
+query.
+
+Module state is one WeakSet of live registries (telemetry gauge
+callbacks aggregate over it, guarded by a sys.modules check so a process
+that never started a gateway never imports this module)."""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+import weakref
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..errors import ServiceConnectionError
+from ..service.protocol import request
+
+__all__ = ["BREAKER_CLOSED", "BREAKER_OPEN", "BREAKER_HALF_OPEN",
+           "CircuitBreaker", "WorkerState", "WorkerRegistry",
+           "live_registries"]
+
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half_open"
+
+# numeric encoding for the telemetry gauge (alerts key off > 0)
+BREAKER_GAUGE = {BREAKER_CLOSED: 0, BREAKER_HALF_OPEN: 1, BREAKER_OPEN: 2}
+
+_LIVE_REGISTRIES: "weakref.WeakSet[WorkerRegistry]" = weakref.WeakSet()
+
+
+def live_registries() -> List["WorkerRegistry"]:
+    return list(_LIVE_REGISTRIES)
+
+
+class CircuitBreaker:
+    """Per-worker breaker. Not thread-safe on its own — every transition
+    happens under the owning registry's lock."""
+
+    def __init__(self, failure_threshold: int = 3,
+                 cooldown_s: float = 5.0):
+        self.failure_threshold = max(1, failure_threshold)
+        self.cooldown_s = cooldown_s
+        self.state = BREAKER_CLOSED
+        self.consecutive_failures = 0
+        self.opened_at = 0.0
+
+    def allows(self, now: Optional[float] = None) -> bool:
+        """May traffic (queries or probes) be sent? An OPEN breaker whose
+        cooldown elapsed transitions to HALF_OPEN and admits ONE class of
+        trial traffic; a trial failure re-opens (fresh cooldown), a trial
+        success closes."""
+        if self.state == BREAKER_OPEN:
+            if (now or time.monotonic()) - self.opened_at >= self.cooldown_s:
+                self.state = BREAKER_HALF_OPEN
+                return True
+            return False
+        return True
+
+    def success(self) -> None:
+        self.state = BREAKER_CLOSED
+        self.consecutive_failures = 0
+
+    def failure(self, now: Optional[float] = None) -> None:
+        self.consecutive_failures += 1
+        if self.state == BREAKER_HALF_OPEN or \
+                self.consecutive_failures >= self.failure_threshold:
+            self.state = BREAKER_OPEN
+            self.opened_at = now or time.monotonic()
+
+
+class WorkerState:
+    def __init__(self, name: str, socket_path: str,
+                 breaker: CircuitBreaker):
+        self.name = name
+        self.socket_path = socket_path
+        self.breaker = breaker
+        self.draining = False
+        self.outstanding = 0
+        self.healthy = False          # last probe verdict
+        self.last_probe_ts = 0.0
+        self.last_error = ""
+        self.device = ""
+        self.dispatches = 0           # lifetime run_plan dispatches
+        self.dispatch_failures = 0    # connection-level dispatch failures
+
+    def snapshot(self) -> dict:
+        return {
+            "socket": self.socket_path,
+            "breaker": self.breaker.state,
+            "consecutive_failures": self.breaker.consecutive_failures,
+            "draining": self.draining,
+            "outstanding": self.outstanding,
+            "healthy": self.healthy,
+            "device": self.device,
+            "dispatches": self.dispatches,
+            "dispatch_failures": self.dispatch_failures,
+            "last_error": self.last_error,
+        }
+
+
+def _probe_once(socket_path: str, timeout_s: float) -> str:
+    """One liveness probe: connect + ping on a fresh socket; returns the
+    worker's device identity. Raises ServiceConnectionError on any
+    failure (the breaker feed)."""
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.settimeout(timeout_s)
+    try:
+        try:
+            s.connect(socket_path)
+        except OSError as e:
+            raise ServiceConnectionError(
+                f"probe connect to {socket_path} failed: {e}",
+                endpoint=socket_path, op="ping", phase="connect", cause=e)
+        try:
+            rep, _ = request(s, {"op": "ping"})
+        except (ConnectionError, OSError) as e:
+            raise ServiceConnectionError(
+                f"probe ping to {socket_path} failed: {e}",
+                endpoint=socket_path, op="ping",
+                phase=getattr(e, "_wire_phase", "recv"), cause=e)
+        if not rep.get("ok"):
+            raise ServiceConnectionError(
+                f"probe ping to {socket_path} rejected: {rep}",
+                endpoint=socket_path, op="ping")
+        return str(rep.get("device", ""))
+    finally:
+        s.close()
+
+
+class WorkerRegistry:
+    """Thread-safe pool state + the background health prober."""
+
+    def __init__(self, workers: List[Tuple[str, str]],
+                 probe_interval_s: float = 1.0,
+                 probe_timeout_s: float = 2.0,
+                 breaker_failures: int = 3,
+                 breaker_cooldown_s: float = 5.0,
+                 on_transition: Optional[Callable[[str, str], None]] = None):
+        self._mu = threading.RLock()
+        self.workers: Dict[str, WorkerState] = {}
+        for name, path in workers:
+            if name in self.workers:
+                raise ValueError(f"duplicate worker name {name!r}")
+            self.workers[name] = WorkerState(
+                name, path, CircuitBreaker(breaker_failures,
+                                           breaker_cooldown_s))
+        self.placements: Dict[str, str] = {}   # query_id -> worker name
+        self.probe_interval_s = probe_interval_s
+        self.probe_timeout_s = probe_timeout_s
+        self._on_transition = on_transition    # (worker, new_state) hook
+        self._stop = threading.Event()
+        self._prober: Optional[threading.Thread] = None
+        _LIVE_REGISTRIES.add(self)
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> "WorkerRegistry":
+        """Probe every worker once synchronously (so the gateway starts
+        with a real view, not all-unhealthy), then launch the prober."""
+        for w in list(self.workers.values()):
+            self._probe_worker(w)
+        self._prober = threading.Thread(target=self._probe_loop,
+                                        name="fleet-prober", daemon=True)
+        self._prober.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._prober is not None:
+            self._prober.join(timeout=self.probe_timeout_s + 1.0)
+            self._prober = None
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.probe_interval_s):
+            for w in list(self.workers.values()):
+                if self._stop.is_set():
+                    return
+                self._probe_worker(w)
+
+    def _probe_worker(self, w: WorkerState) -> None:
+        # OPEN breakers inside their cooldown are left alone (that is the
+        # point of the cooldown: stop hammering a dead socket); allows()
+        # flips cooldown-elapsed OPEN to HALF_OPEN and this probe is the
+        # half-open trial that re-admits a restarted worker.
+        with self._mu:
+            if not w.breaker.allows():
+                w.healthy = False
+                return
+        try:
+            device = _probe_once(w.socket_path, self.probe_timeout_s)
+        except ServiceConnectionError as e:
+            self.note_failure(w.name, str(e))
+            return
+        with self._mu:
+            prev = w.breaker.state
+            w.breaker.success()
+            w.healthy = True
+            w.device = device
+            w.last_probe_ts = time.time()
+            w.last_error = ""
+            if prev != BREAKER_CLOSED and self._on_transition:
+                self._on_transition(w.name, BREAKER_CLOSED)
+
+    # ------------------------------------------------------------- routing
+    def routable(self, max_outstanding: int = 0) -> List[WorkerState]:
+        """Workers eligible for NEW placements right now: not draining,
+        breaker admits traffic, and under the per-worker outstanding cap
+        (0 = uncapped). Half-open workers are eligible — query traffic is
+        trial traffic too, and a pool whose only survivor is half-open
+        must not shed everything."""
+        now = time.monotonic()
+        with self._mu:
+            return [w for w in self.workers.values()
+                    if not w.draining and w.breaker.allows(now)
+                    and (max_outstanding <= 0
+                         or w.outstanding < max_outstanding)]
+
+    def note_dispatch(self, name: str, query_id: Optional[str]) -> None:
+        with self._mu:
+            w = self.workers[name]
+            w.outstanding += 1
+            w.dispatches += 1
+            if query_id:
+                self.placements[query_id] = name
+
+    def note_done(self, name: str, query_id: Optional[str]) -> None:
+        with self._mu:
+            w = self.workers.get(name)
+            if w is not None and w.outstanding > 0:
+                w.outstanding -= 1
+            if query_id and self.placements.get(query_id) == name:
+                del self.placements[query_id]
+
+    def note_success(self, name: str) -> None:
+        with self._mu:
+            self.workers[name].breaker.success()
+            self.workers[name].healthy = True
+
+    def note_failure(self, name: str, error: str,
+                     dispatch: bool = False) -> None:
+        with self._mu:
+            w = self.workers[name]
+            prev = w.breaker.state
+            w.breaker.failure()
+            w.healthy = False
+            w.last_error = error
+            if dispatch:
+                w.dispatch_failures += 1
+            tripped = prev != BREAKER_OPEN and \
+                w.breaker.state == BREAKER_OPEN
+            hook = self._on_transition if tripped else None
+        if hook:
+            hook(name, BREAKER_OPEN)
+
+    def placement_of(self, query_id: str) -> Optional[WorkerState]:
+        with self._mu:
+            name = self.placements.get(query_id)
+            return self.workers.get(name) if name else None
+
+    # --------------------------------------------------------------- admin
+    def drain(self, name: str) -> WorkerState:
+        with self._mu:
+            w = self.workers[name]
+            w.draining = True
+            return w
+
+    def undrain(self, name: str) -> WorkerState:
+        with self._mu:
+            w = self.workers[name]
+            w.draining = False
+            return w
+
+    def outstanding_of(self, name: str) -> int:
+        with self._mu:
+            return self.workers[name].outstanding
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {
+                "workers": {n: w.snapshot()
+                            for n, w in self.workers.items()},
+                "placements": dict(self.placements),
+            }

@@ -1,0 +1,105 @@
+"""Routing policy: cache-affinity placement with load-based fallback.
+
+Routing order (ARCHITECTURE.md "Fleet gateway"): **affinity -> load ->
+failover -> shed**.
+
+Affinity reuses `rescache/fingerprint.py` — the SAME fail-closed
+canonical-plan fingerprint the per-worker result cache keys on — so a
+plan the workers can cache is exactly a plan the gateway can pin:
+repeated dashboard queries rendezvous-hash to one preferred worker,
+where the PR-8 result cache and PR-3 compile cache are already warm. A
+plan the fingerprinter refuses (nondeterministic expressions, unaudited
+nodes, dynamic pruning...) routes by LOAD instead — power-of-two-choices
+over the gateway's live outstanding-query depth — never an error
+(fail-closed fingerprints degrade placement quality, not availability).
+
+Rendezvous (highest-random-weight) hashing rather than a mod-N ring:
+removing a dead/drained worker remaps ONLY the queries that preferred
+it; everyone else's cache affinity survives the membership change.
+
+`analyze` also classifies WRITE plans (DataWritingCommandExec ->
+CpuWriteFilesExec subtrees): a write that may have started mutating
+external state must never be auto-retried on another worker, so the
+gateway's failover loop needs the verdict before first dispatch."""
+
+from __future__ import annotations
+
+import hashlib
+import random
+from typing import Any, List, Optional, Sequence, Tuple
+
+__all__ = ["analyze", "rendezvous_order", "pick_two_choices",
+           "plan_is_write"]
+
+# raw executedPlan-JSON markers that mean "this plan mutates external
+# state" even when translation fails (fail CLOSED on retries: an
+# untranslatable plan that smells like a write is treated as one)
+_WRITE_JSON_MARKERS = ("DataWritingCommand", "InsertInto", "WriteFiles",
+                       "SaveIntoDataSource", "CreateTable", "DeleteFrom",
+                       "MergeInto", "OverwriteByExpression")
+
+
+def _tree_has_write(node: Any) -> bool:
+    if "Write" in type(node).__name__:
+        return True
+    return any(_tree_has_write(c) for c in getattr(node, "children", ()))
+
+
+def plan_is_write(plan_json: Any, translated: Any = None) -> bool:
+    if translated is not None and _tree_has_write(translated):
+        return True
+    text = plan_json if isinstance(plan_json, str) else repr(plan_json)
+    return any(m in text for m in _WRITE_JSON_MARKERS)
+
+
+def analyze(plan_json: Any, paths, conf) -> Tuple[Optional[str], bool]:
+    """(affinity_digest | None, is_write) for one incoming run_plan.
+
+    The digest comes from translating the Spark plan JSON exactly as the
+    worker will and fingerprinting the CPU plan tree (namespace "fleet"
+    so gateway keys can never collide with worker cache entries even in
+    shared storage). ANY failure — untranslatable plan, missing files,
+    uncacheable subtree — yields (None, ...): route by load."""
+    translated = None
+    digest: Optional[str] = None
+    try:
+        from ..integration.spark_plan import translate_spark_plan
+        from ..rescache.fingerprint import fingerprint
+        translated = translate_spark_plan(plan_json, conf, paths or {})
+        fp = fingerprint(translated, conf, extra="fleet")
+        if fp is not None:
+            digest = fp.digest
+    except Exception:
+        pass  # fail-closed: no affinity key, write check falls to the JSON
+    return digest, plan_is_write(plan_json, translated)
+
+
+def rendezvous_order(digest: str, names: Sequence[str]) -> List[str]:
+    """Worker names by descending rendezvous weight for this digest: the
+    head is the affinity-preferred worker, the tail is the failover
+    order. Stable for a given (digest, membership) set regardless of
+    `names` ordering."""
+    def weight(name: str) -> bytes:
+        return hashlib.sha256(
+            f"{digest}|{name}".encode("utf-8", "backslashreplace")).digest()
+    return sorted(names, key=weight, reverse=True)
+
+
+def pick_two_choices(workers: Sequence[Any],
+                     rng: Optional[random.Random] = None) -> List[Any]:
+    """Power-of-two-choices over live outstanding depth: sample two
+    distinct workers uniformly, lead with the less-loaded one, then
+    append the rest by load — the full list doubles as the failover
+    order for unfingerprintable plans."""
+    if not workers:
+        return []
+    rng = rng or random
+    pool = list(workers)
+    if len(pool) <= 2:
+        pair = pool
+    else:
+        pair = rng.sample(pool, 2)
+    pair.sort(key=lambda w: (w.outstanding, w.name))
+    rest = [w for w in sorted(pool, key=lambda w: (w.outstanding, w.name))
+            if w not in pair]
+    return pair + rest

@@ -15,8 +15,8 @@ from typing import Dict, Optional, Sequence
 
 from ..errors import (AdmissionTimeoutError, DeadlineExceededError,
                       DeviceStartupError, QueryCancelledError,
-                      QueryRejectedError)
-from .protocol import ipc_to_table, recv_msg, send_msg
+                      QueryRejectedError, ServiceConnectionError)
+from .protocol import ipc_to_table, request
 
 __all__ = ["TpuServiceClient"]
 
@@ -87,13 +87,25 @@ class TpuServiceClient:
         if self._sock is None:
             raise DeviceStartupError("client not connected")
         self._sock.settimeout(self.deadline_s)
+        op = header.get("op")
         try:
-            send_msg(self._sock, header, body)
-            return recv_msg(self._sock)
+            return request(self._sock, header, body)
         except socket.timeout:
             raise DeviceStartupError(
-                f"device service did not answer {header.get('op')!r} "
+                f"device service did not answer {op!r} "
                 f"within {self.deadline_s}s (wedged service)")
+        except (ConnectionError, OSError) as e:
+            # the connection died MID-REQUEST: typed, with the endpoint and
+            # op, so failover logic (fleet gateway) and external callers
+            # can catch it without pattern-matching raw socket errors. The
+            # server releases this connection's admission tokens on the
+            # disconnect it just observed — nothing to clean up here.
+            self.close()
+            raise ServiceConnectionError(
+                f"service connection to {self.socket_path} lost during "
+                f"{op!r} ({type(e).__name__}: {e})",
+                endpoint=self.socket_path, op=op or "",
+                phase=getattr(e, "_wire_phase", "recv"), cause=e) from e
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -108,6 +120,12 @@ class TpuServiceClient:
                                       query_id=rep.get("query_id") or "")
         if et == "deadline":
             raise DeadlineExceededError(msg)
+        if et == "connection":
+            # a fleet gateway reporting that the worker connection died
+            # mid-request and the request was not safe to re-dispatch
+            raise ServiceConnectionError(
+                msg, endpoint=rep.get("endpoint", ""),
+                op=rep.get("op", ""), phase=rep.get("phase", "recv"))
 
     def acquire(self, timeout: Optional[float] = None,
                 priority: int = 0, tenant: Optional[str] = None,
@@ -257,6 +275,36 @@ class TpuServiceClient:
         if not rep.get("ok"):
             raise RuntimeError(rep.get("error", "health unavailable"))
         return rep["health"]
+
+    # ------------------------------------------------ fleet gateway admin
+    def drain(self, worker: str, wait_s: Optional[float] = None) -> dict:
+        """Mark a fleet worker draining (finish in-flight, route nothing
+        new — rolling-restart prep). With `wait_s` the gateway blocks up
+        to that long for the worker's in-flight queries to finish and the
+        reply reports the remaining count. Gateway-only op."""
+        hdr: dict = {"op": "drain", "worker": worker}
+        if wait_s is not None:
+            hdr["wait_s"] = wait_s
+        rep, _ = self._request(hdr)
+        if not rep.get("ok"):
+            raise KeyError(rep.get("error", f"drain {worker!r} failed"))
+        return rep
+
+    def undrain(self, worker: str) -> dict:
+        """Return a drained fleet worker to the routable pool."""
+        rep, _ = self._request({"op": "undrain", "worker": worker})
+        if not rep.get("ok"):
+            raise KeyError(rep.get("error", f"undrain {worker!r} failed"))
+        return rep
+
+    def fleet_stats(self) -> dict:
+        """The gateway's registry snapshot: per-worker breaker state,
+        outstanding depth, dispatch/failure counts, draining flags, route
+        decisions, and live query placements. Gateway-only op."""
+        rep, _ = self._request({"op": "fleet_stats"})
+        if not rep.get("ok"):
+            raise RuntimeError(rep.get("error", "fleet stats unavailable"))
+        return rep["fleet"]
 
     def shutdown(self) -> None:
         self._request({"op": "shutdown"})

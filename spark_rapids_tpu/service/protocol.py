@@ -54,6 +54,25 @@ def recv_msg(sock: socket.socket) -> Tuple[dict, bytes]:
     return header, body
 
 
+def request(sock: socket.socket, header: dict,
+            body: bytes = b"") -> Tuple[dict, bytes]:
+    """One request/reply round trip (send + recv). Shared by the worker
+    client and the fleet gateway's dispatch path so both surface the same
+    failure anatomy: OSError/ConnectionError from inside is tagged with
+    the phase it died in (`e._wire_phase` = "send"/"recv") — the gateway's
+    retry-safety rule for write plans hangs off that distinction."""
+    try:
+        send_msg(sock, header, body)
+    except (ConnectionError, OSError) as e:
+        e._wire_phase = "send"
+        raise
+    try:
+        return recv_msg(sock)
+    except (ConnectionError, OSError) as e:
+        e._wire_phase = "recv"
+        raise
+
+
 def table_to_ipc(table) -> bytes:
     import pyarrow as pa
     sink = pa.BufferOutputStream()

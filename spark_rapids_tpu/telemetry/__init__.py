@@ -408,6 +408,28 @@ def _install_families(reg: MetricsRegistry) -> None:
                 "Parquet footer/statistics read errors during dynamic "
                 "pruning (file/row group kept unpruned).")
 
+    # fleet gateway (fleet/): route decisions + per-worker pool gauges.
+    # Callbacks observe live WorkerRegistries through sys.modules ONLY —
+    # a process that never started a gateway never imports the package
+    # (the fleet-off zero-state contract).
+    reg.counter("tpu_fleet_route_total",
+                "Gateway routing decisions (affinity / load / failover / "
+                "shed / pinned).", ["decision"])
+    reg.counter("tpu_fleet_failover_total",
+                "run_plan dispatches failed over AWAY from a worker "
+                "(connection loss / breaker trip mid-flight).", ["worker"])
+    reg.gauge("tpu_fleet_breaker_state",
+              "Per-worker circuit breaker (0=closed, 1=half-open, "
+              "2=open).", ["worker"], callback=_fleet_gauge("breaker"))
+    reg.gauge("tpu_fleet_outstanding",
+              "Queries currently dispatched per worker (the gateway's "
+              "power-of-two load signal).", ["worker"],
+              callback=_fleet_gauge("outstanding"))
+    reg.gauge("tpu_fleet_draining",
+              "1 while a worker is admin-drained (in-flight finishes, "
+              "nothing new routes).", ["worker"],
+              callback=_fleet_gauge("draining"))
+
 
 # gauge callbacks: read singletons WITHOUT constructing them ----------------
 def _budget_gauge():
@@ -510,6 +532,26 @@ def _rescache_bytes_gauge():
     if c is None:
         return {}
     return {(kind,): v for kind, v in c.bytes_by_kind().items()}
+
+
+def _fleet_gauge(which: str):
+    def cb():
+        import sys
+        mod = sys.modules.get("spark_rapids_tpu.fleet.registry")
+        if mod is None:
+            return {}  # no gateway in this process — and never import one
+        out: Dict[tuple, float] = {}
+        for reg in mod.live_registries():
+            for name, w in list(reg.workers.items()):
+                if which == "breaker":
+                    v = mod.BREAKER_GAUGE.get(w.breaker.state, 0)
+                elif which == "outstanding":
+                    v = w.outstanding
+                else:  # draining
+                    v = 1 if w.draining else 0
+                out[(name,)] = out.get((name,), 0) + v
+        return out
+    return cb
 
 
 def _cached_relation_gauge():

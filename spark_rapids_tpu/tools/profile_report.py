@@ -266,12 +266,20 @@ def trace_view(records: List[Dict[str, Any]],
                    "dur_ms": rec.get("wall_ns", 0) / 1e6}
         elif rec.get("type") == "span" and rec.get("kind") == "service":
             attrs = rec.get("attrs", {})
+            detail = f"status={attrs.get('status', 'ok')}"
+            if attrs.get("role") == "gateway":
+                # the fleet-gateway hop: which worker the query landed on
+                # and why (affinity/load), plus any mid-flight failovers
+                detail += f" decision={attrs.get('decision', '?')}" \
+                          f" worker={attrs.get('worker', '?')}"
+                if attrs.get("failovers"):
+                    detail += f" failovers={attrs['failovers']}"
+            if rec.get("query_id"):
+                detail += f" query_id={rec.get('query_id')}"
             row = {"ts": rec.get("ts"),
                    "process": str(attrs.get("pid", "?")),
                    "what": rec.get("name", "client op"),
-                   "detail": f"status={attrs.get('status', 'ok')}"
-                             + (f" query_id={rec.get('query_id')}"
-                                if rec.get("query_id") else ""),
+                   "detail": detail,
                    "dur_ms": rec.get("dur_ns", 0) / 1e6}
         elif rec.get("type") == "incident":
             row = {"ts": rec.get("ts"),

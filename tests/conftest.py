@@ -60,6 +60,11 @@ def pytest_configure(config):
         "rescache: result/fragment-cache suite (plan fingerprints / "
         "cross-query reuse seams / single-flight / eviction / fault "
         "degrade; scripts/rescache_matrix.sh runs these standalone)")
+    config.addinivalue_line(
+        "markers",
+        "fleet: fleet-gateway suite (worker registry / breakers / "
+        "affinity routing / failover / drain / cancel-through-gateway; "
+        "scripts/fleet_matrix.sh runs these standalone)")
 
 
 @pytest.fixture

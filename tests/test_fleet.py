@@ -1,0 +1,824 @@
+"""Fleet gateway (ISSUE-10): health-aware routing over a TPU worker pool.
+
+Two tiers:
+
+  * FAST (no subprocesses, no engine queries): router/breaker/registry
+    units, typed ServiceConnectionError anatomy, gateway failover and
+    write-plan retry-safety against FAKE workers (thread servers that
+    speak the wire protocol and die on cue), shed-at-the-door, and the
+    fleet-off import gate.
+  * SLOW (marker `slow`, run by scripts/fleet_matrix.sh): REAL
+    TpuDeviceService worker processes behind an in-process gateway —
+    kill -9 mid-run_plan failover with bit-identical rows, breaker
+    half-open recovery after worker restart, cache-affinity placement
+    with a worker-local rescache hit, drain/undrain, cancel-by-query-id
+    through the gateway, fleet-door backpressure, and cross-process
+    trace stitching (client -> gateway -> worker)."""
+
+import json
+import os
+import signal
+import socket as socketmod
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.errors import (QueryCancelledError,
+                                     QueryRejectedError,
+                                     ServiceConnectionError)
+from spark_rapids_tpu.fleet import router
+from spark_rapids_tpu.fleet.gateway import FleetGateway
+from spark_rapids_tpu.fleet.registry import (BREAKER_CLOSED, BREAKER_OPEN,
+                                             CircuitBreaker,
+                                             WorkerRegistry)
+from spark_rapids_tpu.service import TpuServiceClient
+from spark_rapids_tpu.service.protocol import (recv_msg, send_msg,
+                                               table_to_ipc)
+
+pytestmark = pytest.mark.fleet
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# plan JSON builders (the service-protocol Spark executedPlan shape)
+def _attr(name, dt):
+    return [{"class": "org.apache.spark.sql.catalyst.expressions."
+             "AttributeReference", "num-children": 0, "name": name,
+             "dataType": dt, "nullable": True, "metadata": {},
+             "exprId": {"id": 1, "jvmId": "x"}, "qualifier": []}]
+
+
+def filter_plan(threshold: float, marker: str = "") -> str:
+    """FilterExec(v > threshold) over FileSourceScanExec('t'). Distinct
+    thresholds give distinct plan fingerprints (affinity spreads them
+    over the pool). `marker` plants a raw-JSON write marker without
+    changing translation (unknown fields are ignored) — the write-plan
+    retry-safety tests ride it."""
+    filt = {"class": "org.apache.spark.sql.execution.FilterExec",
+            "num-children": 1,
+            "condition": [{"class": "org.apache.spark.sql.catalyst."
+                           "expressions.GreaterThan", "num-children": 2}]
+            + _attr("v", "double")
+            + [{"class": "org.apache.spark.sql.catalyst.expressions."
+                "Literal", "num-children": 0, "value": str(threshold),
+                "dataType": "double"}]}
+    if marker:
+        filt["comment"] = marker
+    scan = {"class": "org.apache.spark.sql.execution.FileSourceScanExec",
+            "num-children": 0, "relation": "HadoopFsRelation(parquet)",
+            "output": [_attr("k", "long"), _attr("v", "double")],
+            "tableIdentifier": "t"}
+    return json.dumps([filt, scan])
+
+
+# ---------------------------------------------------------------------------
+# FAST: router / breaker units
+class TestRouterUnits:
+    def test_rendezvous_stable_and_minimal_remap(self):
+        names = [f"w{i}" for i in range(5)]
+        digests = [f"d{i}" for i in range(200)]
+        first = {d: router.rendezvous_order(d, names)[0] for d in digests}
+        # stable under list reordering
+        for d in digests[:20]:
+            assert router.rendezvous_order(d, list(reversed(names)))[0] \
+                == first[d]
+        # removing one worker remaps ONLY the digests that preferred it
+        gone = "w2"
+        rest = [n for n in names if n != gone]
+        for d in digests:
+            now = router.rendezvous_order(d, rest)[0]
+            if first[d] != gone:
+                assert now == first[d], d
+            else:
+                assert now in rest
+        # and the load is roughly spread (no degenerate hash)
+        from collections import Counter
+        counts = Counter(first.values())
+        assert len(counts) == 5
+        assert max(counts.values()) < 200 * 0.5
+
+    def test_rendezvous_tail_is_failover_order(self):
+        order = router.rendezvous_order("digest", ["a", "b", "c"])
+        assert sorted(order) == ["a", "b", "c"]
+        assert len(set(order)) == 3
+
+    def test_power_of_two_prefers_less_loaded(self):
+        class W:
+            def __init__(self, name, outstanding):
+                self.name, self.outstanding = name, outstanding
+        import random
+        rng = random.Random(7)
+        ws = [W("a", 5), W("b", 0), W("c", 2)]
+        picks = [router.pick_two_choices(ws, rng)[0].name
+                 for _ in range(100)]
+        # the loaded worker is picked first only when the sample misses
+        # both lighter ones — never more often than either of them
+        assert picks.count("a") < picks.count("b")
+        assert all(router.pick_two_choices([ws[0]], rng)[0].name == "a"
+                   for _ in range(3))
+
+    def test_write_plan_detection(self):
+        assert router.plan_is_write(filter_plan(0.5, marker="InsertInto"))
+        assert not router.plan_is_write(filter_plan(0.5))
+        assert router.plan_is_write(
+            '{"class": "...DataWritingCommandExec", "num-children": 1}')
+
+    def test_analyze_fail_closed_routes_by_load(self):
+        from spark_rapids_tpu.config import TpuConf
+        conf = TpuConf({"spark.rapids.sql.enabled": True})
+        # untranslatable plan: no digest, no error
+        digest, is_write = router.analyze(
+            '[{"class": "org.apache.spark.NoSuchExec", "num-children": 0}]',
+            {}, conf)
+        assert digest is None and not is_write
+
+    def test_analyze_digest_is_stable_and_param_sensitive(self, tmp_path):
+        from spark_rapids_tpu.config import TpuConf
+        conf = TpuConf({"spark.rapids.sql.enabled": True})
+        t = pa.table({"k": pa.array(np.arange(10)),
+                      "v": pa.array(np.linspace(0, 1, 10))})
+        path = str(tmp_path / "t.parquet")
+        pq.write_table(t, path)
+        paths = {"t": [path]}
+        d1, w1 = router.analyze(filter_plan(0.25), paths, conf)
+        d2, _ = router.analyze(filter_plan(0.25), paths, conf)
+        d3, _ = router.analyze(filter_plan(0.75), paths, conf)
+        assert d1 is not None and d1 == d2
+        assert d3 is not None and d3 != d1
+        assert not w1
+
+
+class TestCircuitBreaker:
+    def test_trip_cooldown_halfopen_recover(self):
+        b = CircuitBreaker(failure_threshold=2, cooldown_s=0.2)
+        assert b.allows() and b.state == BREAKER_CLOSED
+        b.failure()
+        assert b.state == BREAKER_CLOSED and b.allows()
+        b.failure()
+        assert b.state == BREAKER_OPEN and not b.allows()
+        time.sleep(0.25)
+        assert b.allows()                  # cooldown elapsed -> half-open
+        assert b.state == "half_open"
+        b.success()
+        assert b.state == BREAKER_CLOSED
+        assert b.consecutive_failures == 0
+
+    def test_halfopen_failure_reopens(self):
+        b = CircuitBreaker(failure_threshold=3, cooldown_s=0.1)
+        for _ in range(3):
+            b.failure()
+        assert b.state == BREAKER_OPEN
+        time.sleep(0.15)
+        assert b.allows()
+        b.failure()                        # trial failed
+        assert b.state == BREAKER_OPEN and not b.allows()
+
+
+class TestRegistryBookkeeping:
+    def _registry(self):
+        return WorkerRegistry([("a", "/nope/a"), ("b", "/nope/b")],
+                              probe_interval_s=999, breaker_failures=3)
+
+    def test_dispatch_placement_drain(self):
+        r = self._registry()
+        r.note_dispatch("a", "q1")
+        assert r.placement_of("q1").name == "a"
+        assert r.outstanding_of("a") == 1
+        r.drain("a")
+        assert [w.name for w in r.routable()] == ["b"]
+        # in-flight bookkeeping survives the drain
+        r.note_done("a", "q1")
+        assert r.placement_of("q1") is None
+        assert r.outstanding_of("a") == 0
+        r.undrain("a")
+        assert sorted(w.name for w in r.routable()) == ["a", "b"]
+
+    def test_max_outstanding_cap(self):
+        r = self._registry()
+        r.note_dispatch("a", None)
+        r.note_dispatch("a", None)
+        assert [w.name for w in r.routable(max_outstanding=2)] == ["b"]
+        assert len(r.routable(max_outstanding=0)) == 2
+
+    def test_breaker_feed_and_snapshot(self):
+        r = self._registry()
+        for _ in range(3):
+            r.note_failure("b", "boom", dispatch=True)
+        assert [w.name for w in r.routable()] == ["a"]
+        snap = r.snapshot()
+        assert snap["workers"]["b"]["breaker"] == BREAKER_OPEN
+        assert snap["workers"]["b"]["dispatch_failures"] == 3
+        r.note_success("b")
+        assert snap["workers"]["b"]["breaker"] == BREAKER_OPEN  # snapshot
+        assert r.snapshot()["workers"]["b"]["breaker"] == BREAKER_CLOSED
+
+
+# ---------------------------------------------------------------------------
+# FAST: typed connection error from the direct client
+class _HalfDeadServer(threading.Thread):
+    """Answers the connect-time ping, then kills the connection mid-way
+    through the next request — the worker-crash shape the typed
+    ServiceConnectionError exists for."""
+
+    def __init__(self, sock_path):
+        super().__init__(daemon=True)
+        self.sock_path = sock_path
+        self.srv = socketmod.socket(socketmod.AF_UNIX,
+                                    socketmod.SOCK_STREAM)
+        self.srv.bind(sock_path)
+        self.srv.listen(4)
+
+    def run(self):
+        try:
+            conn, _ = self.srv.accept()
+            header, _ = recv_msg(conn)
+            assert header["op"] == "ping"
+            send_msg(conn, {"ok": True, "device": "fake"})
+            recv_msg(conn)       # the doomed request...
+            conn.close()         # ...dies without a reply
+        except Exception:
+            pass
+
+    def close(self):
+        self.srv.close()
+
+
+class TestServiceConnectionError:
+    def test_mid_request_eof_is_typed(self, tmp_path):
+        sock = str(tmp_path / "halfdead.sock")
+        srv = _HalfDeadServer(sock)
+        srv.start()
+        try:
+            cli = TpuServiceClient(sock, deadline_s=5.0).connect()
+            with pytest.raises(ServiceConnectionError) as ei:
+                cli.run_plan(filter_plan(0.5), {})
+            e = ei.value
+            assert e.endpoint == sock
+            assert e.op == "run_plan"
+            assert e.phase in ("send", "recv")
+            assert e.maybe_executed
+            assert isinstance(e, ConnectionError)  # legacy handlers
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# FAST: gateway routing against fake wire-protocol workers
+class _FakeWorker(threading.Thread):
+    """Thread server speaking the service wire protocol. mode:
+    'ok'    — answers run_plan with a one-row Arrow body;
+    'close' — reads the run_plan then drops the connection (crash);
+    'shed'  — replies the typed rejected error."""
+
+    def __init__(self, sock_path, mode="ok"):
+        super().__init__(daemon=True)
+        self.sock_path = sock_path
+        self.mode = mode
+        self.run_plans = 0
+        self.srv = socketmod.socket(socketmod.AF_UNIX,
+                                    socketmod.SOCK_STREAM)
+        self.srv.bind(sock_path)
+        self.srv.listen(16)
+        self.srv.settimeout(0.2)
+        self._stop = threading.Event()
+        self._table = pa.table({"x": pa.array([1])})
+
+    def run(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self.srv.accept()
+            except socketmod.timeout:
+                continue
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+        self.srv.close()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                header, _ = recv_msg(conn)
+                op = header.get("op")
+                if op == "ping":
+                    send_msg(conn, {"ok": True, "device": "fake"})
+                elif op == "run_plan":
+                    self.run_plans += 1
+                    if self.mode == "close":
+                        conn.close()
+                        return
+                    if self.mode == "shed":
+                        send_msg(conn, {"ok": False,
+                                        "error_type": "rejected",
+                                        "error": "overload"})
+                        continue
+                    send_msg(conn, {"ok": True, "num_rows": 1},
+                             table_to_ipc(self._table))
+                elif op == "acquire":
+                    if self.mode == "acquire_timeout":
+                        send_msg(conn, {"ok": False,
+                                        "error_type": "admission_timeout",
+                                        "error": "admission timeout",
+                                        "held": 1, "waiting": 1})
+                    else:
+                        send_msg(conn, {"ok": True, "order": 1})
+                elif op == "release":
+                    send_msg(conn, {"ok": True})
+                else:
+                    send_msg(conn, {"ok": False, "error": "nope"})
+        except Exception:
+            pass
+        finally:
+            conn.close()
+
+    def close(self):
+        self._stop.set()
+
+
+def _fake_fleet(tmp_path, modes, conf=None):
+    """(gateway_socket, gateway, [fake workers], serve_thread)."""
+    fakes = []
+    specs = []
+    for i, mode in enumerate(modes):
+        sock = str(tmp_path / f"fake{i}.sock")
+        fw = _FakeWorker(sock, mode)
+        fw.start()
+        fakes.append(fw)
+        specs.append((f"f{i}", sock))
+    gw_sock = str(tmp_path / "gw.sock")
+    base = {"spark.rapids.tpu.fleet.probe.intervalMs": 60_000,
+            "spark.rapids.tpu.fleet.probe.timeoutSec": 2.0,
+            "spark.rapids.tpu.fleet.dispatch.timeoutSec": 5.0}
+    base.update(conf or {})
+    gw = FleetGateway(specs, base, gw_sock)
+    th = threading.Thread(target=gw.serve_forever, daemon=True)
+    th.start()
+    cli = TpuServiceClient(gw_sock, deadline_s=10.0).connect()
+    cli.close()
+    return gw_sock, gw, fakes, th
+
+
+def _teardown_fleet(gw_sock, gw, fakes, th):
+    try:
+        with TpuServiceClient(gw_sock, deadline_s=5.0) as cli:
+            cli.shutdown()
+    except Exception:
+        gw.stop()
+    th.join(timeout=10)
+    for fw in fakes:
+        fw.close()
+
+
+class TestGatewayFakeWorkers:
+    def test_read_plan_fails_over_to_next_worker(self, tmp_path):
+        gw_sock, gw, fakes, th = _fake_fleet(tmp_path, ["close", "ok"])
+        try:
+            with TpuServiceClient(gw_sock, deadline_s=10.0) as cli:
+                t = cli.run_plan(filter_plan(0.5), {})
+            assert t.num_rows == 1
+            assert sum(f.run_plans for f in fakes) == 2  # crash + retry
+            stats = gw._fleet_stats()
+            assert stats["route_decisions"].get("failover", 0) >= 1
+        finally:
+            _teardown_fleet(gw_sock, gw, fakes, th)
+
+    def test_write_plan_never_auto_retried(self, tmp_path):
+        gw_sock, gw, fakes, th = _fake_fleet(tmp_path, ["close", "ok"])
+        try:
+            # force the crashing worker first: it is the only one with
+            # zero outstanding history, but routing samples — so drain
+            # the healthy one to pin the first dispatch, then undrain
+            # is not needed: one routable worker, one attempt.
+            gw.registry.drain("f1")
+            with TpuServiceClient(gw_sock, deadline_s=10.0) as cli:
+                with pytest.raises(ServiceConnectionError) as ei:
+                    cli.run_plan(filter_plan(0.5, marker="InsertInto"), {})
+            assert "not auto-retried" in str(ei.value)
+            gw.registry.undrain("f1")
+            assert fakes[0].run_plans == 1
+            assert fakes[1].run_plans == 0  # the write never moved
+        finally:
+            _teardown_fleet(gw_sock, gw, fakes, th)
+
+    def test_all_workers_shed_bubbles_typed_rejection(self, tmp_path):
+        gw_sock, gw, fakes, th = _fake_fleet(tmp_path, ["shed", "shed"])
+        try:
+            with TpuServiceClient(gw_sock, deadline_s=10.0) as cli:
+                with pytest.raises(QueryRejectedError) as ei:
+                    cli.run_plan(filter_plan(0.5), {})
+            # original cause chained into the gateway's reply
+            assert "shed" in str(ei.value)
+        finally:
+            _teardown_fleet(gw_sock, gw, fakes, th)
+
+    def test_shed_at_the_door_before_worker_sockets(self, tmp_path):
+        gw_sock, gw, fakes, th = _fake_fleet(tmp_path, ["ok", "ok"])
+        try:
+            gw.registry.drain("f0")
+            gw.registry.drain("f1")
+            with TpuServiceClient(gw_sock, deadline_s=10.0) as cli:
+                with pytest.raises(QueryRejectedError):
+                    cli.run_plan(filter_plan(0.5), {})
+            assert all(f.run_plans == 0 for f in fakes)
+            assert gw._fleet_stats()["route_decisions"].get("shed") == 1
+        finally:
+            _teardown_fleet(gw_sock, gw, fakes, th)
+
+    def test_acquire_pins_connection_and_run_follows(self, tmp_path):
+        gw_sock, gw, fakes, th = _fake_fleet(tmp_path, ["ok", "ok"])
+        try:
+            with TpuServiceClient(gw_sock, deadline_s=10.0) as cli:
+                assert cli.acquire(timeout=5.0) == 1
+                cli.run_plan(filter_plan(0.5), {})
+                cli.release()
+            served = [f.run_plans for f in fakes]
+            assert sorted(served) == [0, 1]  # pinned, not load-balanced
+            assert gw._fleet_stats()["route_decisions"].get("pinned") == 1
+        finally:
+            _teardown_fleet(gw_sock, gw, fakes, th)
+
+    def test_failed_acquire_does_not_pin_the_connection(self, tmp_path):
+        """An acquire that granted nothing (admission timeout/shed) must
+        not leave the connection pinned — later run_plans on it keep
+        affinity routing and failover."""
+        from spark_rapids_tpu.errors import AdmissionTimeoutError
+        gw_sock, gw, fakes, th = _fake_fleet(
+            tmp_path, ["acquire_timeout", "acquire_timeout"])
+        try:
+            with TpuServiceClient(gw_sock, deadline_s=10.0) as cli:
+                with pytest.raises(AdmissionTimeoutError):
+                    cli.acquire(timeout=0.1)
+                t = cli.run_plan(filter_plan(0.5), {})
+            assert t.num_rows == 1
+            # routed (affinity/load), NOT the pinned fast path
+            decisions = gw._fleet_stats()["route_decisions"]
+            assert decisions.get("pinned", 0) == 0, decisions
+        finally:
+            _teardown_fleet(gw_sock, gw, fakes, th)
+
+    def test_cancel_unknown_id_replies_cleanly(self, tmp_path):
+        gw_sock, gw, fakes, th = _fake_fleet(tmp_path, ["ok"])
+        try:
+            with TpuServiceClient(gw_sock, deadline_s=10.0) as cli:
+                rep = cli.cancel("no-such-query")
+            assert rep["ok"] and rep["found"] is False
+            assert rep["killed"] is False
+        finally:
+            _teardown_fleet(gw_sock, gw, fakes, th)
+
+    def test_deadline_exhausted_reports_causes(self, tmp_path):
+        gw_sock, gw, fakes, th = _fake_fleet(
+            tmp_path, ["close", "close"],
+            conf={"spark.rapids.tpu.fleet.failover.maxAttempts": 4})
+        try:
+            from spark_rapids_tpu.errors import DeadlineExceededError
+            t0 = time.monotonic()
+            with TpuServiceClient(gw_sock, deadline_s=30.0) as cli:
+                with pytest.raises((DeadlineExceededError,
+                                    ServiceConnectionError)) as ei:
+                    cli.run_plan(filter_plan(0.5), {}, deadline_s=1.5)
+            assert time.monotonic() - t0 < 15.0
+            assert "f0" in str(ei.value) or "f1" in str(ei.value)
+        finally:
+            _teardown_fleet(gw_sock, gw, fakes, th)
+
+
+class TestFleetOffInert:
+    def test_engine_modules_do_not_import_fleet(self):
+        """The off-path contract's import half: the service layer (the
+        direct single-socket path) must never pull the fleet package in.
+        scripts/fleet_matrix.sh runs the full zero-thread gate."""
+        code = ("import sys; "
+                "import spark_rapids_tpu.service.client, "
+                "spark_rapids_tpu.service.server, "
+                "spark_rapids_tpu.telemetry, spark_rapids_tpu.config; "
+                "assert not [m for m in sys.modules "
+                "if m.startswith('spark_rapids_tpu.fleet')], 'leaked'; "
+                "print('inert')")
+        out = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                             capture_output=True, text=True, timeout=120,
+                             env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert "inert" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# SLOW: real worker processes behind an in-process gateway
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _start_worker(sock, log_dir):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "spark_rapids_tpu.service.server",
+         "--socket", sock, "--platform", "cpu",
+         "--conf", "spark.rapids.sql.concurrentGpuTasks=1",
+         "--conf", "spark.rapids.tpu.rescache.enabled=true",
+         "--conf", f"spark.rapids.tpu.metrics.eventLog.dir={log_dir}"],
+        cwd=REPO, env=_env(),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    return proc
+
+
+def _await_worker(sock, proc, deadline_s=90.0):
+    try:
+        TpuServiceClient(sock, deadline_s=deadline_s).connect().close()
+    except BaseException:
+        proc.kill()
+        proc.wait()
+        raise
+    return proc
+
+
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory):
+    """3 real worker processes + an in-process gateway. Yields a dict the
+    tests mutate (worker restarts swap Popen handles)."""
+    d = tmp_path_factory.mktemp("fleet")
+    log_dir = str(d / "events")
+    socks = {f"w{i}": str(d / f"w{i}.sock") for i in range(3)}
+    procs = {n: _start_worker(s, log_dir) for n, s in socks.items()}
+    for n, s in socks.items():
+        _await_worker(s, procs[n])
+    gw_sock = str(d / "gateway.sock")
+    gw = FleetGateway(
+        [(n, s) for n, s in socks.items()],
+        {"spark.rapids.tpu.fleet.probe.intervalMs": 200,
+         "spark.rapids.tpu.fleet.probe.timeoutSec": 3.0,
+         "spark.rapids.tpu.fleet.breaker.failures": 2,
+         "spark.rapids.tpu.fleet.breaker.cooldownMs": 1000,
+         "spark.rapids.tpu.metrics.eventLog.dir": log_dir},
+        gw_sock)
+    th = threading.Thread(target=gw.serve_forever, daemon=True)
+    th.start()
+    TpuServiceClient(gw_sock, deadline_s=30.0).connect().close()
+    env = {"gw": gw, "gw_sock": gw_sock, "socks": socks, "procs": procs,
+           "log_dir": log_dir, "dir": d}
+    yield env
+    try:
+        with TpuServiceClient(gw_sock, deadline_s=5.0) as cli:
+            cli.shutdown()
+    except Exception:
+        gw.stop()
+    th.join(timeout=10)
+    for n, p in env["procs"].items():
+        try:
+            with TpuServiceClient(socks[n], deadline_s=3.0) as cli:
+                cli.shutdown()
+        except Exception:
+            pass
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
+
+
+@pytest.fixture(scope="module")
+def fleet_data(tmp_path_factory):
+    d = tmp_path_factory.mktemp("fleetdata")
+    rng = np.random.default_rng(11)
+    n = 20_000
+    t = pa.table({"k": pa.array(rng.integers(0, 64, n).astype(np.int64)),
+                  "v": pa.array(rng.uniform(size=n))})
+    path = str(d / "t.parquet")
+    pq.write_table(t, path)
+    return {"table": t, "paths": {"t": [path]}}
+
+
+def _expected(t: pa.Table, threshold: float) -> pa.Table:
+    mask = np.asarray(t.column("v")) > threshold
+    return t.filter(pa.array(mask))
+
+
+def _sorted(t: pa.Table) -> pa.Table:
+    return t.sort_by([("k", "ascending"), ("v", "ascending")])
+
+
+def _dispatches(gw) -> dict:
+    return {n: w["dispatches"]
+            for n, w in gw._fleet_stats()["workers"].items()}
+
+
+@pytest.mark.slow
+class TestFleetLifecycle:
+    def _run(self, env, plan, paths, **kw):
+        with TpuServiceClient(env["gw_sock"], deadline_s=180.0) as cli:
+            return cli.run_plan(plan, paths, **kw)
+
+    def test_route_basic_rows_identical_to_direct(self, fleet, fleet_data):
+        plan = filter_plan(0.5)
+        got = self._run(fleet, plan, fleet_data["paths"])
+        exp = _expected(fleet_data["table"], 0.5)
+        assert got.num_rows == exp.num_rows
+        # bit-identical to a DIRECT single-worker run of the same plan
+        any_sock = next(iter(fleet["socks"].values()))
+        with TpuServiceClient(any_sock, deadline_s=180.0) as cli:
+            direct = cli.run_plan(plan, fleet_data["paths"])
+        assert _sorted(got).equals(_sorted(direct))
+        assert _sorted(got).equals(_sorted(exp.select(["k", "v"])))
+
+    def test_affinity_same_worker_second_run_rescache_hit(
+            self, fleet, fleet_data):
+        plan = filter_plan(0.31)
+        before = _dispatches(fleet["gw"])
+        r1 = self._run(fleet, plan, fleet_data["paths"])
+        mid = _dispatches(fleet["gw"])
+        target = [n for n in mid if mid[n] > before[n]]
+        assert len(target) == 1, (before, mid)
+        r2 = self._run(fleet, plan, fleet_data["paths"])
+        after = _dispatches(fleet["gw"])
+        target2 = [n for n in after if after[n] > mid[n]]
+        assert target2 == target, "affinity moved between identical plans"
+        assert _sorted(r1).equals(_sorted(r2))
+        # the second run answered from THAT worker's result cache
+        with TpuServiceClient(fleet["gw_sock"], deadline_s=30.0) as cli:
+            stats = cli.cache_stats()
+        s = stats[target[0]]
+        assert isinstance(s, dict) and s.get("hits", {}).get("query", 0) \
+            >= 1, s
+        assert fleet["gw"]._fleet_stats()["route_decisions"].get(
+            "affinity", 0) >= 2
+
+    def test_kill_worker_mid_run_plan_fails_over_bit_identical(
+            self, fleet, fleet_data):
+        thr = 0.77
+        plan = filter_plan(thr)
+        qid = "kill-me-1"
+        # affinity is deterministic: predict the target, FREEZE it so the
+        # dispatched run_plan is provably in flight when the kill lands
+        digest, _ = router.analyze(plan, fleet_data["paths"],
+                                   fleet["gw"].conf)
+        assert digest is not None
+        target = router.rendezvous_order(digest,
+                                         list(fleet["socks"]))[0]
+        fleet["procs"][target].send_signal(signal.SIGSTOP)
+        out = {}
+
+        def run():
+            try:
+                out["table"] = self._run(fleet, plan, fleet_data["paths"],
+                                         query_id=qid)
+            except Exception as e:  # pragma: no cover - surfaced below
+                out["error"] = e
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+        t0 = time.time()
+        placed = None
+        while time.time() - t0 < 60:
+            placed = fleet["gw"]._fleet_stats()["placements"].get(qid)
+            if placed:
+                break
+            time.sleep(0.01)
+        assert placed == target, f"placed on {placed}, expected {target}"
+        time.sleep(0.3)  # the request is parked inside the frozen worker
+        fleet["procs"][target].send_signal(signal.SIGKILL)
+        fleet["procs"][target].wait(timeout=10)
+        th.join(timeout=240)
+        assert not th.is_alive(), "failover never completed"
+        assert "error" not in out, out.get("error")
+        exp = _expected(fleet_data["table"], thr).select(["k", "v"])
+        assert _sorted(out["table"]).equals(_sorted(exp))
+        stats = fleet["gw"]._fleet_stats()
+        assert stats["route_decisions"].get("failover", 0) >= 1
+        # ---- breaker half-open recovery: restart the worker in place
+        t0 = time.time()
+        while time.time() - t0 < 15:
+            if stats["workers"][target]["breaker"] == BREAKER_OPEN:
+                break
+            time.sleep(0.1)
+            stats = fleet["gw"]._fleet_stats()
+        fleet["procs"][target] = _await_worker(
+            fleet["socks"][target],
+            _start_worker(fleet["socks"][target], fleet["log_dir"]))
+        t0 = time.time()
+        while time.time() - t0 < 30:
+            w = fleet["gw"]._fleet_stats()["workers"][target]
+            if w["breaker"] == BREAKER_CLOSED and w["healthy"]:
+                break
+            time.sleep(0.1)
+        w = fleet["gw"]._fleet_stats()["workers"][target]
+        assert w["breaker"] == BREAKER_CLOSED and w["healthy"], \
+            "restarted worker never re-admitted through half-open probe"
+
+    def test_drain_zero_new_placements_then_undrain(self, fleet,
+                                                    fleet_data):
+        gw = fleet["gw"]
+        victim = "w1"
+        with TpuServiceClient(fleet["gw_sock"], deadline_s=30.0) as cli:
+            rep = cli.drain(victim)
+        assert rep["draining"] is True
+        before = _dispatches(gw)
+        for i in range(5):
+            self._run(fleet, filter_plan(0.40 + i * 0.01),
+                      fleet_data["paths"])
+        after = _dispatches(gw)
+        assert after[victim] == before[victim], \
+            "drained worker received new placements"
+        assert sum(after.values()) - sum(before.values()) == 5
+        with TpuServiceClient(fleet["gw_sock"], deadline_s=30.0) as cli:
+            rep = cli.undrain(victim)
+        assert rep["draining"] is False
+        assert victim in [w.name for w in gw.registry.routable()]
+
+    def test_drain_lets_in_flight_complete(self, fleet, fleet_data):
+        thr = 0.88  # fresh compile window again
+        qid = "drain-inflight"
+        out = {}
+
+        def run():
+            out["table"] = self._run(fleet, filter_plan(thr),
+                                     fleet_data["paths"], query_id=qid)
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+        t0 = time.time()
+        target = None
+        while time.time() - t0 < 60:
+            target = fleet["gw"]._fleet_stats()["placements"].get(qid)
+            if target:
+                break
+            time.sleep(0.01)
+        assert target
+        with TpuServiceClient(fleet["gw_sock"], deadline_s=30.0) as cli:
+            cli.drain(target)
+        th.join(timeout=240)
+        assert "table" in out, "in-flight query did not survive drain"
+        exp = _expected(fleet_data["table"], thr).select(["k", "v"])
+        assert _sorted(out["table"]).equals(_sorted(exp))
+        with TpuServiceClient(fleet["gw_sock"], deadline_s=30.0) as cli:
+            cli.undrain(target)
+
+    def test_cancel_through_gateway_finds_the_worker(self, fleet,
+                                                     fleet_data):
+        thr = 0.93
+        qid = "cancel-me-1"
+        out = {}
+
+        def run():
+            try:
+                out["table"] = self._run(fleet, filter_plan(thr),
+                                         fleet_data["paths"],
+                                         query_id=qid)
+            except Exception as e:
+                out["error"] = e
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+        t0 = time.time()
+        while time.time() - t0 < 60:
+            if fleet["gw"]._fleet_stats()["placements"].get(qid):
+                break
+            time.sleep(0.01)
+        time.sleep(0.3)
+        with TpuServiceClient(fleet["gw_sock"], deadline_s=30.0) as cli:
+            rep = cli.cancel(qid, reason="test cancel")
+        assert rep["ok"]
+        th.join(timeout=240)
+        assert not th.is_alive()
+        # either the cancel landed mid-flight (typed error) or the query
+        # finished first (tiny race) — both are clean outcomes; the
+        # gateway must have routed the cancel without erroring
+        if "error" in out:
+            assert isinstance(out["error"], QueryCancelledError), \
+                out["error"]
+            assert rep.get("found", True)
+
+    def test_backpressure_all_drained_sheds_at_gateway(self, fleet,
+                                                       fleet_data):
+        gw = fleet["gw"]
+        with TpuServiceClient(fleet["gw_sock"], deadline_s=30.0) as cli:
+            for n in fleet["socks"]:
+                cli.drain(n)
+            with pytest.raises(QueryRejectedError):
+                cli.run_plan(filter_plan(0.5), fleet_data["paths"])
+            for n in fleet["socks"]:
+                cli.undrain(n)
+        assert gw._fleet_stats()["route_decisions"].get("shed", 0) >= 1
+
+    def test_trace_stitches_client_gateway_worker(self, fleet,
+                                                  fleet_data):
+        from spark_rapids_tpu.tools.profile_report import (load_records,
+                                                           trace_view)
+        cli = TpuServiceClient(fleet["gw_sock"], deadline_s=180.0,
+                               event_log_dir=fleet["log_dir"])
+        with cli:
+            cli.run_plan(filter_plan(0.66), fleet_data["paths"])
+        trace = cli.last_trace_id
+        assert trace
+        records, _ = load_records([fleet["log_dir"]])
+        view = trace_view(records, trace=trace)
+        assert "gateway:run_plan" in view
+        assert "client:run_plan" in view
+        assert "server query" in view
+        assert "decision=" in view and "worker=" in view

@@ -1,0 +1,202 @@
+"""Repr-audit lint (ISSUE-10 satellite): kill the param-dropping-__repr__
+bug class wholesale.
+
+PRs 3-8 fixed 19+ expression classes one by one whose `__repr__` dropped
+`__init__` params — each a latent compile-cache AND rescache-fingerprint
+aliasing bug (two semantically different expressions rendering the same
+string share one cached executable / one cached result = silently wrong
+rows). This test introspects EVERY `Expression` (and `StaticExpr`)
+subclass in the package and statically verifies each constructor param is
+reflected in the repr surface, so the next expression with a forgotten
+param fails CI instead of corrupting a dashboard three PRs later.
+
+A param counts as covered when:
+  * it is routed into `super().__init__(...)` — the parent renders it
+    (parents are audited for their OWN params, so delegation chains
+    bottom out at `Expression.__init__(children)`, which the base
+    `__repr__` renders);
+  * its name — or the `self.<attr>` it is assigned to — appears in the
+    class's resolved repr surface (`__repr__` + `_arg_string` along the
+    MRO);
+  * it is explicitly allowlisted below, with a justification.
+"""
+
+import importlib
+import inspect
+import pkgutil
+import re
+
+import pytest
+
+from spark_rapids_tpu.exec.base import StaticExpr
+from spark_rapids_tpu.expr.base import Expression
+
+pytestmark = pytest.mark.fleet  # rides the fleet matrix (ISSUE-10)
+
+# The WHOLE package is walked (not just expr/) so stragglers defined
+# beside their feature — delta zorder's InterleaveBits, pandas UDFs —
+# are audited too, and so the collected set does not depend on which
+# other test modules happened to import first in a full-suite run.
+
+# (ClassName, param) pairs that genuinely do NOT belong in __repr__.
+# Every entry needs a reason. Two legitimate reasons exist:
+#   * schema-derived — the param is resolution metadata recomputed from
+#     the input schema, which BOTH cache layers capture independently
+#     (compile keys include avals; plan fingerprints render every node's
+#     output schema), so repr omission cannot alias distinct programs;
+#   * children-routed — __init__ funnels the param into the children
+#     list through a local (so the static super()-delegation check can't
+#     see it) and the base __repr__ renders children; the reconstruction
+#     from children is unambiguous.
+ALLOWLIST = {
+    # schema-derived type/nullability metadata:
+    ("AttributeReference", "dtype"), ("AttributeReference", "nullable"),
+    ("BoundReference", "dtype"), ("BoundReference", "nullable"),
+    ("NamedLambdaVariable", "dtype"), ("NamedLambdaVariable", "nullable"),
+    # PandasUDF is deterministic=False AND in fingerprint._OPAQUE_EXPRS:
+    # both caches fail closed on the whole subtree by design, and the
+    # return type is schema-derived for the plan fingerprint
+    ("PandasUDF", "return_type"),
+    # children-routed via a local list (unambiguous reconstruction):
+    ("CaseWhen", "branches"), ("CaseWhen", "else_expr"),
+    # ArrayJoin validates delim/null_replacement as Literal children and
+    # copies their .value; the literals render in children
+    ("ArrayJoin", "child"), ("ArrayJoin", "delim"),
+    ("ArrayJoin", "null_replacement"),
+    ("AssertTrue", "condition"), ("AssertTrue", "message"),
+    ("Sequence", "start"), ("Sequence", "stop"), ("Sequence", "step"),
+    ("Overlay", "child"), ("Overlay", "replace"), ("Overlay", "pos"),
+    ("Overlay", "length"),
+    # higher-order fns: the lambda BODY (fn applied to the lambda vars)
+    # becomes a child and renders; the callable itself is not identity
+    # beyond its body, and with_index/has_finish fall out of the child
+    # count
+    ("ArrayTransform", "fn"),
+    ("ArrayAggregate", "child"), ("ArrayAggregate", "zero"),
+    ("ArrayAggregate", "merge"), ("ArrayAggregate", "finish"),
+}
+
+
+def _iter_expression_classes():
+    import spark_rapids_tpu
+    for info in pkgutil.walk_packages(spark_rapids_tpu.__path__,
+                                      prefix="spark_rapids_tpu."):
+        try:
+            importlib.import_module(info.name)
+        except Exception:
+            # a module that cannot import in the test env (optional dep)
+            # cannot contribute cached programs either
+            pass
+
+    seen = set()
+
+    def walk(cls):
+        for sub in cls.__subclasses__():
+            if sub not in seen:
+                seen.add(sub)
+                yield sub
+                yield from walk(sub)
+
+    yield from walk(Expression)
+    yield from walk(StaticExpr)
+
+
+def _source_of(func) -> str:
+    try:
+        return inspect.getsource(func)
+    except (OSError, TypeError):
+        return ""
+
+
+def _repr_surface(cls) -> str:
+    """Source of the repr machinery this class actually resolves to:
+    `__repr__` plus any `_arg_string` helper, walked up the MRO."""
+    parts = []
+    rfunc = cls.__repr__
+    if rfunc is not object.__repr__:
+        parts.append(_source_of(rfunc))
+    arg_string = getattr(cls, "_arg_string", None)
+    if arg_string is not None:
+        parts.append(_source_of(arg_string))
+    return "\n".join(parts)
+
+
+def _own_init(cls):
+    """The __init__ DEFINED on this class (None when inherited)."""
+    return cls.__dict__.get("__init__")
+
+
+_SUPER_RE = re.compile(
+    r"(?:super\(\)|super\(\s*\w+\s*,\s*self\s*\)|[A-Za-z_][\w.]*)"
+    r"\.__init__\s*\((?P<args>[^)]*(?:\([^)]*\)[^)]*)*)\)", re.S)
+
+
+def _delegated_names(init_src: str) -> str:
+    """Concatenated argument text of every *.__init__(...) call."""
+    return "\n".join(m.group("args") for m in _SUPER_RE.finditer(init_src))
+
+
+def _assigned_attrs(init_src: str, param: str) -> list:
+    """Attribute names assigned (directly or via expression) from the
+    param inside __init__: `self.X = ... param ...`."""
+    out = []
+    for m in re.finditer(r"self\.(\w+)\s*=\s*(.+)", init_src):
+        if re.search(rf"\b{re.escape(param)}\b", m.group(2)):
+            out.append(m.group(1))
+    return out
+
+
+def _audit(cls) -> list:
+    init = _own_init(cls)
+    if init is None:
+        return []  # inherited ctor: params audited on the definer
+    try:
+        sig = inspect.signature(init)
+    except (ValueError, TypeError):
+        return []
+    init_src = _source_of(init)
+    surface = _repr_surface(cls)
+    delegated = _delegated_names(init_src)
+    problems = []
+    for name, p in sig.parameters.items():
+        if name == "self" or p.kind == p.VAR_KEYWORD:
+            continue
+        pname = name.lstrip("*")
+        if (cls.__name__, pname) in ALLOWLIST:
+            continue
+        if re.search(rf"\b{re.escape(pname)}\b", delegated):
+            continue  # parent renders it (parent audited separately)
+        if re.search(rf"\b{re.escape(pname)}\b", surface):
+            continue
+        attrs = _assigned_attrs(init_src, pname)
+        if any(re.search(rf"\b{re.escape(a)}\b", surface) for a in attrs):
+            continue
+        problems.append(
+            f"{cls.__module__}.{cls.__name__}: __init__ param {pname!r} "
+            f"is not reflected in __repr__/_arg_string (assigned attrs: "
+            f"{attrs or 'none found'}) — a compile-cache/rescache "
+            f"aliasing hazard; render it or allowlist with justification")
+    return problems
+
+
+def test_every_expression_param_is_repr_faithful():
+    problems = []
+    n = 0
+    for cls in _iter_expression_classes():
+        n += 1
+        problems.extend(_audit(cls))
+    assert n > 100, f"audit walked only {n} classes — collection broke?"
+    assert not problems, (
+        f"{len(problems)} param-dropping repr(s):\n" + "\n".join(problems))
+
+
+def test_allowlist_entries_are_real():
+    """Every allowlist entry must still name an existing class+param —
+    stale entries would silently re-open the hole they documented."""
+    classes = {c.__name__: c for c in _iter_expression_classes()}
+    for clsname, param in ALLOWLIST:
+        assert clsname in classes, f"allowlisted class {clsname} is gone"
+        init = _own_init(classes[clsname])
+        assert init is not None, f"{clsname} no longer defines __init__"
+        assert param in inspect.signature(init).parameters, \
+            f"{clsname}.{param} is no longer an __init__ param"
