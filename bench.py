@@ -870,6 +870,130 @@ def rescache_bench() -> dict:
     return out
 
 
+STATS_ROWS = 300_000
+
+
+def stats_bench() -> dict:
+    """Runtime-statistics feedback bench (ISSUE-11 flag: `bench.py
+    --stats`): a deliberately misestimate-prone join — the build side is
+    an equality filter whose static selectivity heuristic (5%) is ~3000x
+    off — runs cold (static estimates) then warm (history feedback).
+    Reports the worst per-operator q-error before/after feedback, the
+    plan-choice flip (shuffled join cold -> broadcast join warm, since
+    the build side's OBSERVED size sits under the broadcast threshold),
+    and the adaptive coalesce decision flipping from observed-bytes to
+    history (picked before the stage runs). Acceptance: cold q-error
+    >= 10, warm q-error <= 1.5, both flips happen, results identical."""
+    _apply_platform_override()
+    import tempfile
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu import stats
+    from spark_rapids_tpu.expr import Sum, col, lit
+    from spark_rapids_tpu.plugin import TpuSession
+
+    rng = np.random.default_rng(41)
+    n = STATS_ROWS
+    b = rng.integers(0, 10_000_000, n)
+    b[:100] = 777  # the filter's ACTUAL survivors
+    rng.shuffle(b)
+    tmp = tempfile.mkdtemp(prefix="srtpu_stats_bench_")
+    fpath = os.path.join(tmp, "fact.parquet")
+    dpath = os.path.join(tmp, "dim.parquet")
+    pq.write_table(pa.table({
+        "k": pa.array(rng.integers(0, 4096, n)),
+        "v": pa.array(rng.uniform(size=n))}), fpath,
+        row_group_size=65_536)
+    pq.write_table(pa.table({
+        "k": pa.array(rng.integers(0, 4096, n)),
+        "b": pa.array(b)}), dpath, row_group_size=65_536)
+
+    sess = TpuSession({
+        "spark.rapids.sql.enabled": True,
+        "spark.rapids.sql.explain": "NONE",
+        "spark.rapids.tpu.stats.enabled": True,
+        "spark.rapids.tpu.stats.feedback.enabled": True,
+        # between the ACTUAL filtered build side (~2KB) and the static
+        # 5%-selectivity estimate (~250KB)
+        "spark.rapids.sql.autoBroadcastJoinThreshold": 64 << 10,
+    })
+
+    def q():
+        f = sess.read_parquet(fpath)
+        d = sess.read_parquet(dpath).filter(col("b") == lit(777))
+        return (f.join(d, on="k").group_by("k")
+                .agg(s=Sum(col("v")))).collect().sort_by("k")
+
+    def run():
+        t0 = time.perf_counter()
+        r = q()
+        dt = time.perf_counter() - t0
+        worst = sess.last_stats.worst()
+        joins = [o["name"] for o in sess.last_stats.ops if "Join" in
+                 o["name"]]
+        return r, dt, worst, joins
+
+    r_cold, t_cold, worst_cold, joins_cold = run()
+    r_warm, t_warm, worst_warm, joins_warm = run()
+    flip = "TpuShuffledHashJoinExec" in joins_cold and \
+        "TpuBroadcastHashJoinExec" in joins_warm
+
+    # adaptive coalesce: observed-bytes cold, history warm (decided
+    # before the stage executes)
+    sess2 = TpuSession({
+        "spark.rapids.sql.enabled": True,
+        "spark.rapids.sql.explain": "NONE",
+        "spark.rapids.sql.adaptive.enabled": True,
+        "spark.rapids.tpu.stats.enabled": True,
+        "spark.rapids.tpu.stats.feedback.enabled": True,
+    })
+    t2 = pa.table({
+        "k": pa.array(rng.integers(0, 512, 100_000)),
+        "v": pa.array(rng.uniform(size=100_000))})
+    aq = sess2.from_arrow(t2).repartition(32, "k") \
+        .group_by("k").agg(s=Sum(col("v")))
+    a1 = aq.collect().sort_by("k")
+    co_cold = [e for e in sess2._adaptive_log
+               if e["rule"] == "coalescePartitions"]
+    a2 = aq.collect().sort_by("k")
+    co_warm = [e for e in sess2._adaptive_log
+               if e["rule"] == "coalescePartitions"]
+    coalesce_flip = bool(
+        co_cold and co_cold[0]["source"] == "observed"
+        and co_warm and co_warm[0]["source"] == "history"
+        and co_cold[0]["to"] == co_warm[0]["to"])
+
+    hist = stats.stats() or {}
+    out = {
+        "metric": "stats_bench",
+        "rows": n,
+        "cold_s": round(t_cold, 4),
+        "warm_s": round(t_warm, 4),
+        "q_error_cold": round(float(worst_cold["q_error"]), 2)
+        if worst_cold else None,
+        "q_error_warm": round(float(worst_warm["q_error"]), 2)
+        if worst_warm else None,
+        "join_cold": joins_cold,
+        "join_warm": joins_warm,
+        "broadcast_flip": flip,
+        "coalesce_cold": co_cold[0] if co_cold else None,
+        "coalesce_warm": co_warm[0] if co_warm else None,
+        "coalesce_flip": coalesce_flip,
+        "bit_identical": bool(r_cold.equals(r_warm)
+                              and a1.equals(a2)),
+        "history": {k: hist.get(k) for k in
+                    ("entries", "hits", "misses", "records")},
+        "ok": bool(worst_cold and worst_warm
+                   and worst_cold["q_error"] >= 10
+                   and worst_warm["q_error"] <= 1.5
+                   and flip and coalesce_flip
+                   and r_cold.equals(r_warm) and a1.equals(a2)),
+    }
+    return out
+
+
 FLEET_WORKERS = 3
 FLEET_PLANS = 4          # distinct dashboard queries in the mix
 FLEET_ROUNDS = 7         # repeats of the mix: 4 cold + 24 warm chances
@@ -1156,6 +1280,12 @@ if __name__ == "__main__":
         # pool — affinity vs forced-random routing: warm hit rate and
         # p50/p99 latency per mode; one JSON line
         print(json.dumps(fleet_bench()), flush=True)
+    elif "--stats" in sys.argv:
+        # bench flag (ISSUE-11): misestimate-prone join cold vs warm-
+        # history — q-error before/after feedback, broadcast-vs-shuffle
+        # and coalesce-count plan flips; one JSON line
+        _enable_compilation_cache()
+        print(json.dumps(stats_bench()), flush=True)
     elif "--rescache" in sys.argv:
         # bench flag (ISSUE-9): repeated-query workload through the
         # result cache — hit rate, warm-vs-cold speedup, bit-identical

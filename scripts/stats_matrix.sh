@@ -1,0 +1,120 @@
+#!/usr/bin/env bash
+# Runtime-statistics matrix (ISSUE-11 CI gate):
+#   1. run the stats test suite (marker `stats`);
+#   2. stats-OFF gate: with spark.rapids.tpu.stats.enabled=false the
+#      engine takes the exact pre-stats paths — no history object
+#      exists, ZERO new threads are spawned, explain output and results
+#      are byte-for-byte identical to a stats-on (feedback-off) run;
+#   3. warm-history-changes-estimates gate: with feedback on, a query
+#      whose static estimate is >=10x wrong runs cold then warm — the
+#      warm estimate must come from history (q-error drops to ~1) and
+#      the build side must flip shuffled -> broadcast.
+#
+# Usage: scripts/stats_matrix.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TIMEOUT="${SRTPU_STATS_TIMEOUT:-900}"
+
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_stats.py -m stats -q \
+    -p no:cacheprovider "$@"
+
+echo "== stats-off gate (no state, zero threads, byte-identical) =="
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu python - <<'EOF'
+import threading
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu import stats
+from spark_rapids_tpu.expr import Count, Sum, col, lit
+from spark_rapids_tpu.plugin import TpuSession
+
+rng = np.random.default_rng(29)
+n = 30_000
+t = pa.table({"k": pa.array(rng.integers(0, 128, n)),
+              "g": pa.array(rng.integers(0, 32, n).astype(np.int32)),
+              "v": pa.array(rng.uniform(size=n))})
+
+def run(stats_on):
+    sess = TpuSession({"spark.rapids.sql.enabled": True,
+                       "spark.rapids.sql.explain": "NONE",
+                       "spark.rapids.tpu.stats.enabled": stats_on})
+    q = (sess.from_arrow(t).filter(col("v") > lit(0.3))
+         .group_by("g").agg(total=Sum(col("v")), cnt=Count(col("k"))))
+    explain = sess.explain_plan(q.plan)
+    return q.collect().sort_by("g"), explain, sess
+
+threads0 = threading.active_count()
+off, explain_off, sess_off = run(False)
+assert not stats.is_enabled() and stats.get() is None, \
+    "FAIL: stats state exists with stats disabled"
+assert stats.stats() is None and sess_off.last_stats is None
+assert threading.active_count() <= threads0, \
+    f"FAIL: stats-off spawned {threading.active_count() - threads0} threads"
+print("stats-off: no history object, zero new threads OK")
+
+on, explain_on, sess_on = run(True)
+assert on.equals(off), "FAIL: stats-on results differ from stats-off"
+assert explain_on == explain_off, \
+    "FAIL: stats-on (feedback-off) plan differs from stats-off"
+assert sess_on.last_stats is not None
+print("stats-on identical plans + results; ledger collected OK")
+stats.shutdown()
+EOF
+
+echo "== warm-history-changes-estimates gate (q-error drop + plan flip) =="
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu python - <<'EOF'
+import os, tempfile
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from spark_rapids_tpu import stats
+from spark_rapids_tpu.expr import Sum, col, lit
+from spark_rapids_tpu.plugin import TpuSession
+
+rng = np.random.default_rng(17)
+n = 60_000
+b = rng.integers(0, 1_000_000, n)
+b[:10] = 500
+rng.shuffle(b)
+tmp = tempfile.mkdtemp(prefix="srtpu_stats_gate_")
+fpath = os.path.join(tmp, "fact.parquet")
+dpath = os.path.join(tmp, "dim.parquet")
+pq.write_table(pa.table({"k": pa.array(rng.integers(0, 1000, n)),
+                         "v": pa.array(rng.uniform(size=n))}), fpath)
+pq.write_table(pa.table({"k": pa.array(rng.integers(0, 1000, n)),
+                         "b": pa.array(b)}), dpath)
+
+sess = TpuSession({"spark.rapids.sql.enabled": True,
+                   "spark.rapids.sql.explain": "NONE",
+                   "spark.rapids.tpu.stats.enabled": True,
+                   "spark.rapids.tpu.stats.feedback.enabled": True,
+                   "spark.rapids.sql.autoBroadcastJoinThreshold": 4096})
+def q():
+    f = sess.read_parquet(fpath)
+    d = sess.read_parquet(dpath).filter(col("b") == lit(500))
+    return (f.join(d, on="k").group_by("k")
+            .agg(s=Sum(col("v")))).collect().sort_by("k")
+
+r1 = q()
+cold = sess.last_stats.worst()
+joins_cold = [o["name"] for o in sess.last_stats.ops if "Join" in o["name"]]
+r2 = q()
+warm = sess.last_stats.worst()
+joins_warm = [o["name"] for o in sess.last_stats.ops if "Join" in o["name"]]
+assert cold["q_error"] >= 10, f"FAIL: cold q-error only {cold['q_error']}"
+assert warm["q_error"] <= 1.5, f"FAIL: warm q-error {warm['q_error']}"
+assert "TpuShuffledHashJoinExec" in joins_cold, joins_cold
+assert "TpuBroadcastHashJoinExec" in joins_warm, \
+    f"FAIL: no broadcast flip ({joins_warm})"
+assert r1.equals(r2), "FAIL: feedback changed the RESULT"
+h = stats.stats()
+assert h["hits"] >= 1, h
+print(f"q-error {cold['q_error']:.1f} -> {warm['q_error']:.2f}; "
+      f"join flip {joins_cold} -> {joins_warm}; results identical OK")
+stats.shutdown()
+EOF
+
+echo "stats matrix: ALL GATES PASSED"

@@ -548,6 +548,44 @@ register("spark.rapids.tpu.rescache.minRecomputeMs", "double", 0.0,
          "least this many milliseconds — keeps trivially cheap "
          "fragments from churning the capacity. 0 stores everything.")
 
+# Runtime statistics -----------------------------------------------------------------
+register("spark.rapids.tpu.stats.enabled", "bool", False,
+         "Runtime query statistics: a per-query observer derives per-"
+         "operator actuals (output rows/batches, filter selectivity, "
+         "join build size and fan-out, per-partition exchange bytes) "
+         "from the existing metrics seams, pairs each with the CBO's "
+         "plan-time estimate (q-error), and records actuals into a "
+         "cardinality history keyed by canonical subplan fingerprints. "
+         "Enables TpuSession.explain_analyze() and the profile_report "
+         "--stats section. Off (default) creates zero state, spawns "
+         "zero threads, and leaves planning byte-identical "
+         "(scripts/stats_matrix.sh gates it).")
+register("spark.rapids.tpu.stats.feedback.enabled", "bool", False,
+         "Optimizer feedback from the statistics history: "
+         "cbo.row_estimate / filter selectivity consult observed "
+         "actuals before falling back to heuristics (broadcast-vs-"
+         "shuffle decisions track real build sizes), and adaptive "
+         "execution picks post-shuffle coalesce counts and pre-flags "
+         "skewed joins from historical stage sizes without first "
+         "staging. Requires spark.rapids.tpu.stats.enabled; off keeps "
+         "estimates byte-identical to the static heuristics.")
+register("spark.rapids.tpu.stats.history.maxEntries", "int", 4096,
+         "In-memory LRU capacity of the cardinality history (one entry "
+         "per fingerprinted subtree).")
+register("spark.rapids.tpu.stats.history.dir", "string", "",
+         "Directory for the persistent statistics tier (CRC32C-framed "
+         "JSONL, one record per line; a torn or corrupt line is a miss, "
+         "never a wrong stat) so a restarted worker keeps its learned "
+         "cardinalities. Only fingerprints without process-local "
+         "identity (no in-memory table ids) persist. Empty disables "
+         "persistence; the in-memory tier still runs.")
+register("spark.rapids.tpu.stats.misestimate.incidentThreshold", "double",
+         100.0,
+         "q-error at or above which the worst misestimate of a query "
+         "dumps a flight-recorder incident (reason 'misestimate') — "
+         "evidence for plans that ran with catastrophically wrong "
+         "cardinalities. 0 disables the incident hook.")
+
 # Compile service --------------------------------------------------------------------
 register("spark.rapids.tpu.compile.enabled", "bool", True,
          "Route every kernel compile through the centralized compile "

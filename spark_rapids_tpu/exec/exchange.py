@@ -131,9 +131,18 @@ class TpuShuffleExchangeExec(UnaryTpuExec):
             pid = part.ids_for_batch(jnp, batch)
         # ICI mode in-process: device-resident slicing (the distributed data
         # plane is the compiled all_to_all in parallel/collective.py)
+        from .. import stats, telemetry
+        note_parts = (stats.is_enabled() or telemetry.is_enabled()) \
+            and n_parts > 1
         for p in range(n_parts):
             with self.partition_time.timed():
                 out = _slice_partition(batch, pid, p)
+            if note_parts:
+                # in-process slicing has no shuffle-write close; device
+                # bytes of the sliced partition are the skew signal here
+                pbytes = int(out.device_memory_size())
+                telemetry.observe("tpu_exchange_partition_bytes", pbytes)
+                stats.note_partition_bytes(self, {p: pbytes})
             if int(out.row_count()) == 0 and n_parts > 1:
                 continue
             self.num_output_rows.add(out.row_count())
@@ -159,6 +168,10 @@ class TpuShuffleExchangeExec(UnaryTpuExec):
         codec = self.conf.get("spark.rapids.shuffle.compression.codec")
         sid = next_shuffle_id()
         next_map = itertools.count()
+        # per-partition byte totals across pieces, kept locally so the
+        # telemetry skew histogram samples each partition ONCE per
+        # committed write (failed attempts never reach the fold below)
+        part_totals: dict = {}
 
         def write_piece(sp: SpillableColumnarBatch) -> int:
             MemoryBudget.get().reserve(0)  # pre-flight / injection point
@@ -185,6 +198,12 @@ class TpuShuffleExchangeExec(UnaryTpuExec):
             except BaseException:
                 mgr.discard_map_output(sid, mid, n_parts)
                 raise
+            # runtime statistics: fold this piece's per-partition bytes
+            # into the exec's skew histogram (one bool when stats is off)
+            from .. import stats
+            stats.note_partition_bytes(self, writer.partition_bytes)
+            for p, nb in writer.partition_bytes.items():
+                part_totals[p] = part_totals.get(p, 0) + nb
             sp.close()
             return mid
 
@@ -200,6 +219,9 @@ class TpuShuffleExchangeExec(UnaryTpuExec):
                     list(with_retry(sp0, write_piece, split_batch_halves))
                 finally:
                     sp0.close()  # no-op on success (write_piece closed it)
+            from .. import telemetry
+            for nb in part_totals.values():
+                telemetry.observe("tpu_exchange_partition_bytes", nb)
             # release=True drops each partition's blocks as they are consumed,
             # bounding block-store retention to one partition at a time
             for p in range(n_parts):

@@ -55,22 +55,86 @@ def _find_deepest_exchange(plan, staged: set):
     return None
 
 
+def _attach_history_hints(plan, conf, log):
+    """Runtime-statistics feedback (stats/): stamp every exchange in the
+    CLONED plan with its stats fingerprints (the exchange subtree and
+    its child — the recording keys survive the staging mutation this
+    loop performs), and, with `spark.rapids.tpu.stats.feedback.enabled`,
+    pre-decide from history what staging would otherwise have to
+    observe: the post-shuffle coalesce count from the stage's historical
+    bytes, and a skew pre-flag from the exchange's historical per-
+    partition byte histogram. One module-global check when stats is
+    off — the plan is untouched."""
+    from .. import stats
+    if not stats.is_enabled():
+        return
+    feedback = conf.get("spark.rapids.tpu.stats.feedback.enabled")
+    factor = conf.get(
+        "spark.rapids.sql.adaptive.skewJoin.skewedPartitionFactor")
+    advisory = conf.get(
+        "spark.rapids.sql.adaptive.advisoryPartitionSizeInBytes")
+
+    def walk(node):
+        for c in node.children:
+            walk(c)
+        if not isinstance(node, N.CpuShuffleExchangeExec):
+            return
+        node._stats_digest, node._stats_persistable = \
+            stats.make_digest(node, conf)
+        node._stats_child_digest, node._stats_child_persistable = \
+            stats.make_digest(node.children[0], conf)
+        if not feedback:
+            return
+        hint = stats.lookup_entry(node._stats_child_digest, kind="stage")
+        if hint is not None and hint.bytes > 0 and conf.get(
+                "spark.rapids.sql.adaptive.coalescePartitions.enabled"):
+            node._stats_slices = max(
+                1, math.ceil(hint.bytes / max(advisory, 1)))
+        ex_hint = stats.lookup_entry(node._stats_digest, kind="skew")
+        if ex_hint is not None and ex_hint.part_bytes:
+            med = stats.nz_lower_median(ex_hint.part_bytes)
+            if med > 0 and max(ex_hint.part_bytes) > factor * med:
+                node._stats_skew = True
+                log.append({"rule": "skewPreflag", "source": "history",
+                            "partitions": len(ex_hint.part_bytes),
+                            "max_bytes": int(max(ex_hint.part_bytes)),
+                            "median_bytes": med})
+
+    # nested exchanges share subtrees: the pass memo dedups their
+    # fingerprint work exactly as it does for the override conversion
+    from .cbo import estimate_pass
+    with estimate_pass():
+        walk(plan)
+
+
 def _staged_scan(exch, table, conf, log):
     """Replace a materialized exchange with an in-memory scan whose batch
     granularity is the COALESCED partition count: ceil(observed bytes /
-    advisory size), never more than the static count."""
+    advisory size), never more than the static count. With warm runtime-
+    statistics history the count was already picked from HISTORICAL
+    stage bytes before this stage ran (`_attach_history_hints`) — the
+    log entry's `source` says which signal decided."""
     orig = getattr(exch.partitioning, "num_partitions", 1) or 1
     slices = 1
     if conf.get("spark.rapids.sql.adaptive.coalescePartitions.enabled"):
         advisory = conf.get(
             "spark.rapids.sql.adaptive.advisoryPartitionSizeInBytes")
-        want = max(1, math.ceil(table.nbytes / max(advisory, 1)))
-        slices = min(orig, want)
+        hist_slices = getattr(exch, "_stats_slices", None)
+        if hist_slices is not None:
+            slices = min(orig, hist_slices)
+            source = "history"
+        else:
+            slices = min(orig, max(1, math.ceil(
+                table.nbytes / max(advisory, 1))))
+            source = "observed"
         if slices != orig:
             log.append({"rule": "coalescePartitions", "from": orig,
-                        "to": slices, "bytes": table.nbytes})
+                        "to": slices, "bytes": table.nbytes,
+                        "source": source})
     scan = N.CpuScanExec(table, label="query-stage", slices=slices)
     scan.staged_partitioning = exch.partitioning
+    if getattr(exch, "_stats_skew", False):
+        scan._stats_skew = True
     return scan
 
 
@@ -159,6 +223,11 @@ def _optimize_skew_joins(plan, conf, log):
     right = staged_scan_of(plan.children[1])
     if left is None or right is None:
         return plan
+    # runtime-statistics pre-flag: history saw this exchange skew, so
+    # factor-over-median alone qualifies a partition — the absolute row
+    # threshold (which guards against splitting small stages on noise)
+    # is waived when prior runs supplied the evidence
+    preflag = bool(getattr(left, "_stats_skew", False))
     part = left.staged_partitioning
     p = getattr(part, "num_partitions", 1) or 1
     if p <= 1:
@@ -181,8 +250,19 @@ def _optimize_skew_joins(plan, conf, log):
     threshold = conf.get(
         "spark.rapids.sql.adaptive.skewJoin.skewedPartitionRowThreshold")
     median = float(np.median(sizes))
-    hot = [int(i) for i in np.nonzero(
-        (sizes > threshold) & (sizes > factor * max(median, 1.0)))[0]]
+    hot_mask = (sizes > threshold) & (sizes > factor * max(median, 1.0))
+    if preflag:
+        # the preflag waives the row threshold, so it must not also
+        # inherit the zero-filled median: with most partitions empty
+        # that floor-to-1 would shred every non-trivial partition of a
+        # uniform stage. Qualify preflagged splits against the shared
+        # skew baseline instead (nz_lower_median, as collect.py flags).
+        from ..stats import nz_lower_median
+        nz_med = float(nz_lower_median(sizes.tolist()))
+        if nz_med > 0:
+            median = nz_med
+            hot_mask |= sizes > factor * median
+    hot = [int(i) for i in np.nonzero(hot_mask)[0]]
     if not hot:
         return plan
 
@@ -216,7 +296,7 @@ def _optimize_skew_joins(plan, conf, log):
                                   f"p{pid}c{c}"))
         log.append({"rule": "skewJoin", "partition": pid,
                     "rows": int(sizes[pid]), "chunks": chunks,
-                    "median": median})
+                    "median": median, "preflag": preflag})
     return N.CpuUnionExec(joins)
 
 
@@ -226,14 +306,35 @@ def adaptive_execute(session, plan, use_device=None):
     staged: set = set()
     log: list = []
     session._adaptive_log = log
+    # scoped marker: while set, query profiles attach the decision log
+    # (explain_profile / event-log query records); cleared on exit so a
+    # later non-adaptive query cannot inherit a stale log — unlike
+    # `_adaptive_log`, which deliberately persists for tests/explain
+    session._adaptive_active = log
     conf = session.conf
-    while True:
-        exch = _find_deepest_exchange(plan, staged)
-        if exch is None:
-            if conf.get("spark.rapids.sql.adaptive.skewJoin.enabled"):
-                plan = _optimize_skew_joins(plan, conf, log)
-            return session._execute_rewritten(plan, use_device)
-        stage_result = session._execute_rewritten(exch.children[0],
-                                                  use_device)
-        exch.children = [_staged_scan(exch, stage_result, conf, log)]
-        staged.add(id(exch))
+    # stats/telemetry/cache configure at device init — normally reached
+    # inside the first stage's _execute_rewritten, which is AFTER the
+    # history-hint pass needs the store up
+    session.initialize_device()
+    _attach_history_hints(plan, conf, log)
+    from .. import stats
+    try:
+        while True:
+            exch = _find_deepest_exchange(plan, staged)
+            if exch is None:
+                if conf.get("spark.rapids.sql.adaptive.skewJoin.enabled"):
+                    plan = _optimize_skew_joins(plan, conf, log)
+                return session._execute_rewritten(plan, use_device)
+            stage_result = session._execute_rewritten(exch.children[0],
+                                                      use_device)
+            # record the OBSERVED stage size under the pristine child
+            # fingerprint — the next run's coalesce hint (rows AND bytes)
+            stats.record_stage(
+                getattr(exch, "_stats_child_digest", None),
+                getattr(exch, "_stats_child_persistable", False),
+                type(exch.children[0]).__name__,
+                rows=stage_result.num_rows, nbytes=stage_result.nbytes)
+            exch.children = [_staged_scan(exch, stage_result, conf, log)]
+            staged.add(id(exch))
+    finally:
+        session._adaptive_active = None

@@ -17,14 +17,42 @@ reference's `costPreventsRunningOnGpu`."""
 
 from __future__ import annotations
 
+import contextlib
 import math
+import threading
 from typing import Dict, Tuple
 
 from ..config import TpuConf
 from . import nodes as N
 from .meta import PlanMeta
 
-__all__ = ["optimize", "row_estimate"]
+__all__ = ["optimize", "row_estimate", "estimate_pass"]
+
+# Per-planning-pass memo (estimates AND stats fingerprints, keyed by
+# ("est", id(plan)) / ("fp", id(plan), ns)). Within one pass nothing an
+# estimate depends on can change (history updates only at query finish),
+# so memoizing is pure dedup: without it every `stats.annotate` call
+# re-recursed the full subtree — O(n^2) estimate frames and, with
+# feedback on, a fresh whole-subtree fingerprint per history probe.
+# Thread-local because concurrent queries plan from their own threads.
+_tls = threading.local()
+
+
+def _pass_memo() -> Dict | None:
+    return getattr(_tls, "memo", None)
+
+
+@contextlib.contextmanager
+def estimate_pass():
+    """Scope one planning pass (Overrides.apply). Nested passes get a
+    FRESH memo — adaptive staging runs queries between plannings, so an
+    inner re-plan must re-consult history."""
+    prev = getattr(_tls, "memo", None)
+    _tls.memo = {}
+    try:
+        yield
+    finally:
+        _tls.memo = prev
 
 _COST_REASON = ("the cost-based optimizer kept this on CPU "
                 "(transition cost dominates the device speedup)")
@@ -78,11 +106,18 @@ def _selectivity(cond, stats: dict) -> float:
     return 0.5
 
 
-def _estimate_from(plan, kids) -> float:
+def _estimate_from(plan, kids, conf=None) -> float:
     """Cardinality of one node given its children's estimates — EXACT at
     in-memory scans and (via footers) file scans; footer min/max drives
     filter selectivity directly over a scan (CostBasedOptimizer.scala:284
-    keeps per-op row counts the same way)."""
+    keeps per-op row counts the same way).
+
+    With `spark.rapids.tpu.stats.feedback.enabled` (and a conf in hand),
+    the runtime-statistics history is consulted FIRST for every
+    non-exact node: an observed actual for this exact subtree beats any
+    heuristic below, and a filter whose subtree missed still reuses the
+    OBSERVED selectivity of its (condition, child schema). Stats off =
+    one module-global check, estimates byte-identical."""
     from ..io.scanbase import CpuFileScanExec
     if isinstance(plan, N.CpuScanExec):
         return float(plan.table.num_rows)
@@ -90,11 +125,20 @@ def _estimate_from(plan, kids) -> float:
         return float(max(0, (plan.end - plan.start) // max(plan.step, 1)))
     if isinstance(plan, CpuFileScanExec):
         nrows = plan.footer_row_count()
-        return float(nrows) if nrows is not None \
-            else 1000.0 * max(len(plan.paths), 1)
+        if nrows is not None:
+            return float(nrows)
+    from .. import stats
+    hist_rows = stats.lookup_rows(plan, conf)
+    if hist_rows is not None:
+        return hist_rows
+    if isinstance(plan, CpuFileScanExec):
+        return 1000.0 * max(len(plan.paths), 1)
     if not kids:
         return 1000.0
     if isinstance(plan, N.CpuFilterExec):
+        hist_sel = stats.lookup_selectivity(plan, conf)
+        if hist_sel is not None:
+            return kids[0] * max(min(hist_sel, 1.0), 0.0)
         child = plan.children[0]
         if isinstance(child, CpuFileScanExec):
             sel = _selectivity(plan.condition, child.column_stats())
@@ -115,9 +159,21 @@ def _estimate_from(plan, kids) -> float:
     return kids[0]
 
 
-def row_estimate(plan) -> float:
-    """Heuristic output cardinality (exact for in-memory scans)."""
-    return _estimate_from(plan, [row_estimate(c) for c in plan.children])
+def row_estimate(plan, conf=None) -> float:
+    """Heuristic output cardinality (exact for in-memory scans; history-
+    corrected when `conf` is given and stats feedback is enabled).
+    Memoized per node inside an `estimate_pass` scope."""
+    memo = _pass_memo()
+    if memo is None:
+        return _estimate_from(plan, [row_estimate(c, conf)
+                                     for c in plan.children], conf)
+    key = ("est", id(plan))
+    v = memo.get(key)
+    if v is None:
+        v = _estimate_from(plan, [row_estimate(c, conf)
+                                  for c in plan.children], conf)
+        memo[key] = v
+    return v
 
 
 def optimize(root: PlanMeta, conf: TpuConf) -> None:
@@ -139,7 +195,12 @@ def optimize(root: PlanMeta, conf: TpuConf) -> None:
         # whole cost pass stays O(n) in plan size
         kids = [(costs(c), memo[id(c)][2]) for c in m.child_metas]
         rows = _estimate_from(m.plan, [memo[id(c)][2]
-                                       for c in m.child_metas])
+                                       for c in m.child_metas], conf)
+        pm = _pass_memo()
+        if pm is not None:
+            # seed the pass memo so the later annotate/convert walk (and
+            # its history probes / hit counters) reuses this value
+            pm.setdefault(("est", id(m.plan)), rows)
         cpu = cpu_w * rows + sum(
             min(cc, tc + trans_w * cr) for (cc, tc), cr in kids)
         if m.can_run_on_device:

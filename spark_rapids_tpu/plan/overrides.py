@@ -713,14 +713,17 @@ def _c_agg(plan, children, conf):
     return TpuHashAggregateExec(plan.group_exprs, plan.aggs, children[0], conf)
 
 
-def _estimated_bytes(plan) -> float:
-    """Heuristic output size in bytes: CBO cardinality x schema row width."""
+def _estimated_bytes(plan, conf=None) -> float:
+    """Heuristic output size in bytes: CBO cardinality x schema row width
+    (history-corrected cardinality when stats feedback is enabled — a
+    build side that turned out broadcast-sized flips to broadcast on the
+    next run)."""
     from .cbo import row_estimate
     width = 0
     for dt in plan.output.types:
         npdt = getattr(dt, "np_dtype", None)
         width += 20 if npdt is None else npdt.itemsize + 1  # +validity
-    return row_estimate(plan) * max(width, 1)
+    return row_estimate(plan, conf) * max(width, 1)
 
 
 # join types whose BUILD (right) side may be replicated: every probe shard
@@ -740,7 +743,7 @@ def _c_join(plan, children, conf):
     no_nested = not any(getattr(dt, "is_nested", False)
                         for dt in plan.children[1].output.types)
     small_build = (threshold >= 0 and no_nested and
-                   _estimated_bytes(plan.children[1]) <= threshold and
+                   _estimated_bytes(plan.children[1], conf) <= threshold and
                    plan.join_type in _BROADCASTABLE)
     if not plan.left_keys:
         # keyless: cartesian product / pure-condition nested loop join; a
@@ -1146,10 +1149,15 @@ class Overrides:
         if not self.conf.is_sql_enabled:
             return plan
         meta = self._tag_tree(plan)
-        if self.conf.get("spark.rapids.sql.optimizer.enabled"):
-            from .cbo import optimize
-            optimize(meta, self.conf)
-        result = self._convert_tagged(plan, meta)
+        # one estimate/fingerprint memo spans the CBO pass and the convert
+        # walk: per-node annotate + per-join _estimated_bytes collapse to
+        # one _estimate_from frame (and one history probe) per node
+        from .cbo import estimate_pass
+        with estimate_pass():
+            if self.conf.get("spark.rapids.sql.optimizer.enabled"):
+                from .cbo import optimize
+                optimize(meta, self.conf)
+            result = self._convert_tagged(plan, meta)
         explain = self.conf.explain
         if explain != "NONE":
             lines = meta.explain_lines()
@@ -1233,7 +1241,14 @@ class Overrides:
             device_children = [
                 c if isinstance(c, TpuExec) else TpuFromCpuExec(c, self.conf)
                 for c in converted_children]
-            return meta.rule.convert_fn(plan, device_children, self.conf)
+            result = meta.rule.convert_fn(plan, device_children, self.conf)
+            # runtime statistics: pair the converted exec with its plan-
+            # time identity (CBO estimate + stats fingerprint) so the
+            # per-query observer can compute estimate-vs-actual q-error
+            # and key actuals for the history store. One bool when off.
+            from .. import stats
+            stats.annotate(plan, result, self.conf)
+            return result
         # stay on CPU; bridge any device children back to host
         host_children = [
             c if not isinstance(c, TpuExec) else CpuFromTpuExec(c)

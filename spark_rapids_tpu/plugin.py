@@ -23,6 +23,7 @@ class TpuSession:
         self.conf = TpuConf(conf)
         self._device_initialized = False
         self._last_profile = None
+        self._last_stats = None
         TpuSession._active = self
 
     # ------------------------------------------------------------------ device
@@ -44,6 +45,12 @@ class TpuSession:
         # spark.rapids.tpu.rescache.enabled — the off path must create no
         # state and spawn no threads (rescache_matrix.sh gate)
         rescache.configure(self.conf)
+        from . import stats
+        # runtime statistics (cardinality history + optimizer feedback):
+        # a no-op unless spark.rapids.tpu.stats.enabled — the off path
+        # must create no state, spawn no threads, and leave planning
+        # byte-identical (stats_matrix.sh gate)
+        stats.configure(self.conf)
         from .compile import CompileService
         # compile service first: warmup precompiles on a background thread
         # while the rest of init (and the first plan rewrite) proceeds
@@ -246,6 +253,10 @@ class TpuSession:
             # deltas fed at query end) + the query flight event; both are
             # one branch when telemetry is off
             op_baselines = telemetry.ops_baseline(result)
+            # runtime statistics: per-operator MetricsSet baselines for
+            # the estimate-vs-actual ledger (one bool when stats is off)
+            from . import stats as _stats
+            st_obs = _stats.begin(result, self.conf)
             q_status = "ok"
             telemetry.flight("query", "begin", label=result.name)
             try:
@@ -295,6 +306,11 @@ class TpuSession:
                 telemetry.inc("tpu_cpu_fallback_reruns_total")
                 telemetry.flight("query", "cpu_fallback_rerun",
                                  label=result.name)
+                # the device stream aborted mid-way: its MetricsSet
+                # deltas are PARTIAL actuals — recording them would
+                # poison the cardinality history even though the query
+                # (via the CPU rerun) ends "ok". Drop the observer.
+                st_obs = None
                 try:
                     host_batches = list(plan.execute_cpu())
                 except BaseException:
@@ -359,7 +375,20 @@ class TpuSession:
                 telemetry.inc("tpu_queries_total", status=q_status)
                 telemetry.flight("query", "end", label=result.name,
                                  status=q_status)
+                # runtime statistics: derive actuals, record history,
+                # keep the ledger for explain_analyze (discarded on a
+                # non-ok unwind — partial actuals must not poison)
+                summary = _stats.finish(st_obs, q_status)
+                if summary is not None:
+                    self._last_stats = summary
                 if prof is not None:
+                    # adaptive decisions ride the query record so the
+                    # report tool and explain_profile surface them —
+                    # `_adaptive_active` is scoped to the adaptive loop,
+                    # so a later non-adaptive query cannot pick up a
+                    # stale session-attribute log
+                    prof.adaptive = list(
+                        getattr(self, "_adaptive_active", None) or ())
                     spans.end_profile(prof)
                     prof.finish(TaskMetrics.get())
                     self._last_profile = prof
@@ -373,6 +402,16 @@ class TpuSession:
                                 max_files=self.conf.get(
                                     "spark.rapids.tpu.metrics.eventLog."
                                     "maxFiles"))
+                            if summary is not None:
+                                _stats.write_records(
+                                    summary, log_dir, prof.query_id,
+                                    prof.trace_id,
+                                    max_bytes=self.conf.get(
+                                        "spark.rapids.tpu.metrics."
+                                        "eventLog.maxBytes"),
+                                    max_files=self.conf.get(
+                                        "spark.rapids.tpu.metrics."
+                                        "eventLog.maxFiles"))
                         except OSError as e:
                             # the profiler must never fail the query
                             import warnings
@@ -437,6 +476,34 @@ class TpuSession:
         if self._last_profile is None:
             return ""
         return self._last_profile.explain_profile()
+
+    @property
+    def last_stats(self):
+        """The RuntimeStats ledger of the most recent stats-observed
+        query (None when spark.rapids.tpu.stats.enabled is off)."""
+        return self._last_stats
+
+    def explain_analyze(self, plan: Optional[PhysicalPlan] = None,
+                        use_device: Optional[bool] = None) -> str:
+        """Execute `plan` (when given) and render the estimate-vs-actual
+        operator tree: per-operator CBO estimate, observed rows, q-error,
+        plus observed selectivity/fan-out/skew — the EXPLAIN ANALYZE
+        analogue over the runtime-statistics ledger. With no plan, the
+        last stats-observed query renders. Requires
+        spark.rapids.tpu.stats.enabled (collection is the ledger)."""
+        if plan is not None:
+            if not self.conf.get("spark.rapids.tpu.stats.enabled"):
+                raise ValueError(
+                    "explain_analyze needs spark.rapids.tpu.stats.enabled"
+                    "=true (runtime-statistics collection is the ledger "
+                    "it renders)")
+            # a run whose observer silently failed must render nothing,
+            # not the PREVIOUS query's ledger labeled as this plan's
+            self._last_stats = None
+            self.execute_plan(plan, use_device=use_device)
+        if self._last_stats is None:
+            return ""
+        return self._last_stats.render()
 
     def explain_plan(self, plan: PhysicalPlan) -> str:
         ov = Overrides(self.conf)

@@ -259,7 +259,11 @@ class _MultithreadedWriter:
         self._sid = shuffle_id
         self._mid = map_id
         self._codec = codec or mgr.codec_name
-        self._futures: List[Future] = []
+        self._futures: List[tuple] = []   # (reduce_id, Future)
+        # serialized bytes per reduce partition, filled at close() — the
+        # per-partition skew signal (telemetry histogram + runtime-stats
+        # exchange histograms) aggregate byte counters cannot show
+        self.partition_bytes: Dict[int, int] = {}
 
     def write(self, reduce_id: int, batch: ColumnarBatch) -> None:
         codec = self._codec
@@ -275,7 +279,7 @@ class _MultithreadedWriter:
                 store.put(bid, data)  # one retry: transient store hiccup
             return len(data)
 
-        self._futures.append(self._mgr.writer_pool.submit(job))
+        self._futures.append((reduce_id, self._mgr.writer_pool.submit(job)))
 
     def close(self) -> None:
         """Block until all partition writes land (task commit point). Every
@@ -287,16 +291,25 @@ class _MultithreadedWriter:
         TaskMetrics is thread-local and the jobs ran on pool threads."""
         first: Optional[BaseException] = None
         nbytes = 0
-        for f in self._futures:
+        per_part: Dict[int, int] = {}
+        for rid, f in self._futures:
             try:
-                nbytes += f.result()
+                n = f.result()
             except BaseException as e:  # noqa: BLE001 - drain them all
                 if first is None:
                     first = e
+                continue
+            nbytes += n
+            per_part[rid] = per_part.get(rid, 0) + n
         self._futures.clear()
+        self.partition_bytes = per_part
         TaskMetrics.get().shuffle_bytes_written += nbytes
         from .. import telemetry
         telemetry.inc("tpu_shuffle_write_bytes_total", nbytes)
+        # tpu_exchange_partition_bytes is fed by the EXCHANGE once the
+        # whole write commits: a per-piece feed here would sample a
+        # split partition as several smaller writes (diluting the skew
+        # signal) and re-sample the survivors of a failed attempt
         if first is not None:
             raise first
 

@@ -1,0 +1,351 @@
+"""Runtime query statistics — fingerprint-keyed cardinality history,
+estimate-vs-actual diagnostics, and optimizer feedback.
+
+The engine already holds both halves of a re-planning loop: a CBO
+(`plan/cbo.py`) running on static footer-derived estimates, and an AQE
+(`plan/adaptive.py`) that only learns by expensively staging exchanges.
+This package closes the loop:
+
+  * **Collection** (`collect.py`) — a per-query `RuntimeStats` observer
+    riding the existing MetricsSet baseline/final snapshot seams (no new
+    hot-path instrumentation) derives per-operator actuals and pairs
+    each with the estimate `cbo.row_estimate` produced at plan time
+    (attached by `annotate()` during the override conversion),
+    computing per-operator q-error.
+  * **History** (`history.py`) — actuals keyed by per-subtree canonical
+    fingerprints (rescache/fingerprint.py under the `"stats"`
+    namespace; fail-closed subtrees simply never record), in-memory LRU
+    plus a persistent CRC-framed JSONL tier so a restarted worker keeps
+    its learned cardinalities.
+  * **Feedback** — under `spark.rapids.tpu.stats.feedback.enabled`,
+    `cbo.row_estimate`/`_selectivity` consult history before falling
+    back to heuristics, and `plan/adaptive.py` picks post-shuffle
+    coalesce counts and pre-flags skewed joins from historical stage
+    sizes without first staging.
+
+Off-path contract (mirrors telemetry/rescache): with
+`spark.rapids.tpu.stats.enabled=false` (default) every hook below is
+one module-global bool check, no history object exists, zero threads
+are spawned, and planning output is byte-identical —
+scripts/stats_matrix.sh gates it. `configure(conf)` only ever ENABLES
+(idempotent); `shutdown()` tears down explicitly (tests)."""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from .collect import RuntimeStats
+from .history import OpStats, StatsHistory, nz_lower_median, q_error
+
+__all__ = ["configure", "shutdown", "is_enabled", "get", "stats",
+           "annotate", "begin", "finish", "write_records",
+           "lookup_rows", "lookup_selectivity", "lookup_entry",
+           "make_digest", "record_stage", "note_partition_bytes",
+           "selectivity_digest", "RuntimeStats", "StatsHistory",
+           "OpStats", "nz_lower_median", "q_error"]
+
+_ACTIVE = False
+_mu = threading.Lock()
+_history: Optional[StatsHistory] = None
+
+
+def is_enabled() -> bool:
+    return _ACTIVE
+
+
+def get() -> Optional[StatsHistory]:
+    return _history
+
+
+def stats() -> Optional[dict]:
+    hist = _history
+    return hist.stats() if hist is not None else None
+
+
+# --------------------------------------------------------------- lifecycle
+def configure(conf) -> None:
+    """Enable per `spark.rapids.tpu.stats.*` (no-op when the switch is
+    off or the store is already up). Called from
+    TpuSession.initialize_device, like telemetry/rescache."""
+    global _ACTIVE, _history
+    if not conf.get("spark.rapids.tpu.stats.enabled"):
+        return
+    with _mu:
+        if _ACTIVE:
+            return
+        _history = StatsHistory(
+            max_entries=conf.get(
+                "spark.rapids.tpu.stats.history.maxEntries"),
+            persist_dir=conf.get("spark.rapids.tpu.stats.history.dir"))
+        _ACTIVE = True
+
+
+def shutdown() -> None:
+    """Tear the stats store down (tests / process exit)."""
+    global _ACTIVE, _history
+    with _mu:
+        _ACTIVE = False
+        _history = None
+
+
+# ---------------------------------------------------------- plan-time hooks
+def make_digest(plan, conf, extra: str = "stats|"
+                ) -> Tuple[Optional[str], bool]:
+    """(digest, persistable) for one subplan under the stats namespace —
+    (None, False) when stats is off or the subtree is fail-closed.
+    `persistable` is False when the fingerprint carries validators
+    (process-local in-memory identity): such digests stay in the memory
+    tier only, since a recycled id() in a fresh process could alias
+    different data."""
+    if not _ACTIVE:
+        return None, False
+    from ..plan.cbo import _pass_memo
+    memo = _pass_memo()
+    key = None
+    if memo is not None:
+        key = ("fp", id(plan), extra)
+        hit = memo.get(key)
+        if hit is not None:
+            return hit
+    from ..rescache.fingerprint import fingerprint
+    try:
+        fp = fingerprint(plan, conf, extra=extra)
+    except Exception:
+        fp = None
+    out = (None, False) if fp is None else (fp.digest, not fp.validators)
+    if key is not None:
+        memo[key] = out
+    return out
+
+
+def selectivity_digest(plan) -> Optional[str]:
+    """Key for the observed-selectivity store: the filter CONDITION plus
+    the child's output schema — deliberately independent of the child
+    subtree, so the same predicate over the same shape reuses its
+    observed selectivity even when the source changed (exactly where
+    row-count history misses). Fail-closed on nondeterministic or
+    opaque-callable predicates (their reprs could alias)."""
+    cond = getattr(plan, "condition", None)
+    children = getattr(plan, "children", ())
+    if cond is None or not children:
+        return None
+    try:
+        from ..expr.base import Expression
+        from ..rescache.fingerprint import _OPAQUE_EXPRS
+        if not isinstance(cond, Expression):
+            return None
+        if cond.collect(lambda e: not e.deterministic
+                        or type(e).__name__ in _OPAQUE_EXPRS):
+            return None
+        schema = children[0].output
+        payload = "statssel|" + repr(cond) + "|" + \
+            repr(tuple(schema.names)) + "|" + \
+            ",".join(t.simple_string() for t in schema.types)
+    except Exception:
+        return None
+    return hashlib.sha256(
+        payload.encode("utf-8", "backslashreplace")).hexdigest()
+
+
+def annotate(plan, node, conf) -> None:
+    """Pair a converted exec with its plan-time identity: the CBO row
+    estimate that was current during planning (history-corrected when
+    feedback is on — q-error then measures the estimate actually used)
+    and the subtree's stats fingerprint. Called per node from the
+    override conversion; one bool check when stats is off."""
+    if not _ACTIVE:
+        return
+    from ..plan import cbo
+    try:
+        est = cbo.row_estimate(plan, conf)
+    except Exception:
+        est = None
+    digest = getattr(plan, "_stats_digest", None)
+    if digest is not None:
+        persistable = bool(getattr(plan, "_stats_persistable", False))
+    else:
+        digest, persistable = make_digest(plan, conf)
+    node._stats_est = est
+    node._stats_digest = digest
+    node._stats_persistable = persistable
+    if type(plan).__name__ == "CpuFilterExec":
+        node._stats_sel_digest = selectivity_digest(plan)
+
+
+# ------------------------------------------------------------ query hooks
+def begin(root, conf) -> Optional[RuntimeStats]:
+    """Open the per-query observer over an exec tree (baselines snapshot
+    here); None when stats is off."""
+    if not _ACTIVE:
+        return None
+    try:
+        return RuntimeStats(root, conf)
+    except Exception:
+        return None
+
+
+def finish(obs: Optional[RuntimeStats],
+           status: str = "ok") -> Optional[RuntimeStats]:
+    """Close the observer: derive actuals, record them into history,
+    feed the telemetry families, and raise a flight-recorder incident on
+    a catastrophic misestimate. Returns the observer (for
+    explain_analyze) or None when nothing was recorded."""
+    if obs is None or not _ACTIVE:
+        return None
+    try:
+        if not obs.finish(status):
+            return None
+    except Exception:
+        return None
+    hist = _history
+    from .. import telemetry
+    for op in obs.ops:
+        if not op["executed"]:
+            continue
+        qe = op.get("q_error")
+        if qe is not None:
+            telemetry.observe("tpu_stats_qerror", qe, op=op["name"])
+        if op.get("skewed"):
+            telemetry.inc("tpu_stats_skew_detections_total")
+            telemetry.flight("stats", "skew_detected", op=op["name"],
+                             part_bytes=op.get("part_bytes"))
+        if hist is None:
+            continue
+        digest = op.get("digest")
+        if digest:
+            hist.record(OpStats(
+                digest=digest, op=op["name"], rows=float(op["rows"]),
+                batches=op["batches"],
+                selectivity=op.get("selectivity"),
+                fanout=op.get("fanout"),
+                build_rows=op.get("build_rows"),
+                part_bytes=op.get("part_bytes"),
+                est_rows=float(op["est"] or 0.0),
+                q_err=float(qe or 1.0)),
+                persistable=op.get("persistable", False))
+            telemetry.inc("tpu_stats_records_total")
+        sel_digest = op.get("sel_digest")
+        if sel_digest and op.get("selectivity") is not None:
+            hist.record(OpStats(
+                digest=sel_digest, op="selectivity",
+                rows=float(op["rows"]),
+                selectivity=op["selectivity"]), persistable=True)
+    worst = obs.worst()
+    if worst is not None:
+        threshold = float(obs.conf.get(
+            "spark.rapids.tpu.stats.misestimate.incidentThreshold"))
+        if threshold > 0 and worst["q_error"] >= threshold:
+            telemetry.incident(
+                "misestimate", op=worst["name"],
+                est_rows=float(worst["est"]),
+                actual_rows=int(worst["rows"]),
+                q_error=float(worst["q_error"]))
+    return obs
+
+
+def write_records(obs: RuntimeStats, log_dir: str, query_id: str,
+                  trace_id: str, max_bytes: int = 0,
+                  max_files: int = 10) -> None:
+    """Append the observer's `stats` records to this process's event log
+    (same file/rotation as the query profiler)."""
+    import json
+    from ..utils import spans
+    recs = obs.to_records(query_id, trace_id)
+    if not recs:
+        return
+    path = os.path.join(log_dir, f"events-{os.getpid()}.jsonl")
+    payload = "".join(json.dumps(r, separators=(",", ":")) + "\n"
+                      for r in recs)
+    spans.append_jsonl(path, payload, max_bytes, max_files)
+
+
+# --------------------------------------------------------- feedback lookups
+def _feedback_on(conf) -> bool:
+    return conf is not None and \
+        conf.get("spark.rapids.tpu.stats.feedback.enabled")
+
+
+def _count_lookup(kind: str, hit: bool) -> None:
+    from .. import telemetry
+    telemetry.inc("tpu_stats_history_hits_total" if hit
+                  else "tpu_stats_history_misses_total", kind=kind)
+
+
+def lookup_rows(plan, conf) -> Optional[float]:
+    """History-corrected output cardinality for a subplan, or None
+    (stats/feedback off, fail-closed subtree, or no history yet) —
+    `cbo._estimate_from` consults this before its heuristics."""
+    if not _ACTIVE or not _feedback_on(conf):
+        return None
+    hist = _history
+    if hist is None:
+        return None
+    digest, _ = make_digest(plan, conf)
+    if digest is None:
+        return None
+    e = hist.lookup(digest)
+    _count_lookup("rows", e is not None)
+    return float(e.rows) if e is not None else None
+
+
+def lookup_selectivity(plan, conf) -> Optional[float]:
+    """Observed selectivity for a filter's (condition, child schema), or
+    None — consulted when whole-subtree row history misses (e.g. the
+    same predicate over a rewritten source)."""
+    if not _ACTIVE or not _feedback_on(conf):
+        return None
+    hist = _history
+    if hist is None:
+        return None
+    digest = selectivity_digest(plan)
+    if digest is None:
+        return None
+    e = hist.lookup(digest)
+    _count_lookup("selectivity", e is not None)
+    return e.selectivity if e is not None else None
+
+
+def lookup_entry(digest: Optional[str],
+                 kind: str = "stage") -> Optional[OpStats]:
+    """Raw history probe by digest (adaptive's stage-size hints)."""
+    if not _ACTIVE or not digest:
+        return None
+    hist = _history
+    if hist is None:
+        return None
+    e = hist.lookup(digest)
+    _count_lookup(kind, e is not None)
+    return e
+
+
+# --------------------------------------------------------- recording seams
+def record_stage(digest: Optional[str], persistable: bool, op: str,
+                 rows: float, nbytes: int, est_rows: float = 0.0) -> None:
+    """Record one materialized adaptive stage's observed size (the
+    exchange child's rows AND bytes — bytes are what the coalesce
+    decision needs next time)."""
+    if not _ACTIVE or not digest:
+        return
+    hist = _history
+    if hist is None:
+        return
+    hist.record(OpStats(digest=digest, op=op, rows=float(rows),
+                        bytes=int(nbytes), est_rows=float(est_rows),
+                        q_err=q_error(est_rows, rows) if est_rows else 1.0),
+                persistable=persistable)
+    from .. import telemetry
+    telemetry.inc("tpu_stats_records_total")
+
+
+def note_partition_bytes(node, part_bytes: Dict[int, int]) -> None:
+    """Accumulate per-partition exchange bytes onto the exec (fed at
+    shuffle-write close); RuntimeStats.finish folds them into the
+    operator's skew histogram."""
+    if not _ACTIVE or not part_bytes:
+        return
+    acc = node.__dict__.setdefault("_stats_part_bytes", {})
+    for p, b in part_bytes.items():
+        acc[int(p)] = acc.get(int(p), 0) + int(b)

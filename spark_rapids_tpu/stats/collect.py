@@ -1,0 +1,229 @@
+"""Per-query runtime-statistics observer.
+
+`RuntimeStats` rides the seams observability already owns — each
+operator's `MetricsSet` (baseline snapshot before execution, final
+snapshot after, exactly the QueryProfile discipline so reused exec
+instances report only THIS query's deltas) — and derives per-operator
+actuals: output rows/bytes/batches, observed filter selectivity, join
+build-side size and fan-out, and the per-partition exchange byte
+histogram the shuffle-write seam accumulated. Each actual pairs with
+the estimate `plan/cbo.py` produced at plan time (attached by
+`stats.annotate` during the override conversion), yielding a per-
+operator q-error. No new hot-path instrumentation: everything here is
+two snapshots per operator per query.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .history import nz_lower_median, q_error
+
+__all__ = ["RuntimeStats"]
+
+_JOIN_NAMES = ("TpuBroadcastHashJoinExec", "TpuShuffledHashJoinExec",
+               "TpuNestedLoopJoinExec")
+_EXCHANGE_NAMES = ("TpuShuffleExchangeExec",)
+
+
+def _subtree_rows(ops: List[Dict[str, Any]], ix: int) -> float:
+    """Output rows of the exec at `ix` — the rows its PARENT consumed."""
+    return float(ops[ix]["rows"])
+
+
+class RuntimeStats:
+    """One query's estimate-vs-actual ledger."""
+
+    def __init__(self, root, conf):
+        self.conf = conf
+        self.label = getattr(root, "name", type(root).__name__)
+        self.closed = False
+        self.ops: List[Dict[str, Any]] = []
+        self._nodes: List[Dict[str, Any]] = []
+
+        def walk(node, depth: int, parent_ix: Optional[int]):
+            ms = getattr(node, "metrics", None)
+            ix, d = parent_ix, depth
+            if ms is not None and hasattr(ms, "snapshot"):
+                ix = len(self._nodes)
+                rec = {"node": node, "depth": depth, "parent": parent_ix,
+                       "children": [], "base": ms.snapshot()}
+                self._nodes.append(rec)
+                if parent_ix is not None:
+                    self._nodes[parent_ix]["children"].append(ix)
+                # stale per-partition accumulators from a prior query on
+                # a reused exec instance must not leak into this one
+                node.__dict__.pop("_stats_part_bytes", None)
+                d = depth + 1
+            # metric-less nodes (CPU plan sections of a mixed plan) pass
+            # through: their device descendants attach to the nearest
+            # observed ancestor — and the CPU<->TPU bridges hide their
+            # subtrees in attrs, not children (CpuFromTpuExec.tpu_exec,
+            # TpuFromCpuExec.cpu_plan)
+            kids = list(getattr(node, "children", ()))
+            bridge = getattr(node, "tpu_exec", None)
+            if bridge is not None:
+                kids.append(bridge)
+            bridge = getattr(node, "cpu_plan", None)
+            if bridge is not None:
+                kids.append(bridge)
+            for c in kids:
+                walk(c, d, ix)
+
+        walk(root, 0, None)
+
+    # ------------------------------------------------------------- finish
+    def finish(self, status: str = "ok") -> bool:
+        """Snapshot finals and derive the per-operator ledger. A query
+        that did not finish cleanly is discarded (partial actuals would
+        poison the history); returns False when discarded."""
+        if self.closed:
+            return bool(self.ops)
+        self.closed = True
+        if status != "ok":
+            self._nodes = []
+            return False
+        skew_factor = float(self.conf.get(
+            "spark.rapids.sql.adaptive.skewJoin.skewedPartitionFactor"))
+        for ix, rec in enumerate(self._nodes):
+            node = rec["node"]
+            try:
+                final = node.metrics.snapshot()
+            except Exception:
+                final = {}
+            base = rec["base"]
+            delta = {k: v - base.get(k, 0) for k, v in final.items()}
+            rec["rows"] = max(int(delta.get("numOutputRows", 0)), 0)
+            rec["batches"] = max(int(delta.get("numOutputBatches", 0)), 0)
+        # executed propagates bottom-up (preorder: children index higher):
+        # "produced nothing" != "never ran" — a filter that matched zero
+        # rows over a scanned child DID run, and its est-vs-0 is exactly
+        # the catastrophic misestimate history/incidents must see
+        for ix in range(len(self._nodes) - 1, -1, -1):
+            rec = self._nodes[ix]
+            rec["executed"] = rec["rows"] > 0 or rec["batches"] > 0 or \
+                any(self._nodes[k]["executed"] for k in rec["children"])
+        for ix, rec in enumerate(self._nodes):
+            node = rec["node"]
+            name = type(node).__name__
+            est = getattr(node, "_stats_est", None)
+            rows = rec["rows"]
+            op: Dict[str, Any] = {
+                "name": name,
+                "args": self._args_of(node),
+                "depth": rec["depth"],
+                "rows": rows,
+                "batches": rec["batches"],
+                "est": None if est is None else float(est),
+                "digest": getattr(node, "_stats_digest", None),
+                "persistable": bool(getattr(node, "_stats_persistable",
+                                            False)),
+                "sel_digest": getattr(node, "_stats_sel_digest", None),
+                "executed": rec["executed"],
+            }
+            if est is not None:
+                op["q_error"] = q_error(est, rows)
+            kids = rec["children"]
+            if name == "TpuFilterExec" and kids:
+                child_rows = _subtree_rows(self._nodes, kids[0])
+                if child_rows > 0:
+                    op["selectivity"] = min(rows / child_rows, 1.0)
+            if name in _JOIN_NAMES and len(kids) >= 2:
+                probe_rows = _subtree_rows(self._nodes, kids[0])
+                op["build_rows"] = _subtree_rows(self._nodes, kids[1])
+                if probe_rows > 0:
+                    op["fanout"] = rows / probe_rows
+            pb = node.__dict__.pop("_stats_part_bytes", None)
+            if pb:
+                # size by the CONFIGURED partition count: the write seam
+                # skips empty partitions, so keying off the highest
+                # written id would silently drop trailing empties
+                n_conf = int(getattr(getattr(node, "spec", None),
+                                     "num_partitions", 0) or 0)
+                hist = [int(pb.get(p, 0))
+                        for p in range(max(max(pb) + 1, n_conf))]
+                op["part_bytes"] = hist
+                med = nz_lower_median(hist)
+                op["skewed"] = med > 0 and max(hist) > skew_factor * med
+            self.ops.append(op)
+        self._nodes = []
+        return True
+
+    @staticmethod
+    def _args_of(node) -> str:
+        try:
+            return node._arg_string()
+        except Exception:
+            return ""
+
+    # ------------------------------------------------------------ queries
+    def worst(self) -> Optional[Dict[str, Any]]:
+        """The executed operator with the largest q-error (None when no
+        operator carried an estimate)."""
+        scored = [o for o in self.ops
+                  if o.get("q_error") is not None and o["executed"]]
+        return max(scored, key=lambda o: o["q_error"]) if scored else None
+
+    # ---------------------------------------------------------- rendering
+    def render(self) -> str:
+        """The explain_analyze operator tree: estimate vs actual with a
+        q-error column, plus observed selectivity/fan-out/skew inline."""
+        lines = [f"RuntimeStats[{self.label}] operators={len(self.ops)}"]
+        name_w = max([len("  " * o["depth"] + o["name"] + o["args"])
+                      for o in self.ops] + [8])
+        header = f"  {'operator'.ljust(name_w)}  {'est':>12}  " \
+                 f"{'actual':>12}  {'q_err':>8}"
+        lines.append(header)
+        for o in self.ops:
+            label = "  " * o["depth"] + o["name"] + o["args"]
+            est = "-" if o["est"] is None else f"{o['est']:.0f}"
+            qe = "-" if o.get("q_error") is None else f"{o['q_error']:.2f}"
+            extra = []
+            if o.get("selectivity") is not None:
+                extra.append(f"sel={o['selectivity']:.3f}")
+            if o.get("fanout") is not None:
+                extra.append(f"fanout={o['fanout']:.2f}")
+            if o.get("build_rows") is not None:
+                extra.append(f"buildRows={o['build_rows']:.0f}")
+            if o.get("skewed"):
+                pb = o.get("part_bytes", ())
+                extra.append(f"SKEW(maxPart={max(pb)}B "
+                             f"parts={len(pb)})")
+            if not o["executed"]:
+                extra.append("not-executed")
+            lines.append(f"  {label.ljust(name_w)}  {est:>12}  "
+                         f"{o['rows']:>12}  {qe:>8}"
+                         + ("  " + " ".join(extra) if extra else ""))
+        w = self.worst()
+        if w is not None:
+            lines.append(f"  worst misestimate: {w['name']} "
+                         f"est={w['est']:.0f} actual={w['rows']} "
+                         f"q_err={w['q_error']:.2f}")
+        return "\n".join(lines)
+
+    # ------------------------------------------------------- event records
+    def to_records(self, query_id: str, trace_id: str) -> List[Dict]:
+        """Schema-v2 `stats` records (one per estimated operator) for the
+        JSONL event log — `profile_report --stats` ranks misestimates
+        across queries from these."""
+        recs: List[Dict] = []
+        for o in self.ops:
+            if o.get("est") is None:
+                continue
+            attrs: Dict[str, Any] = {"batches": o["batches"],
+                                     "executed": o["executed"]}
+            for k in ("selectivity", "fanout", "build_rows", "skewed"):
+                if o.get(k) is not None:
+                    attrs[k] = o[k]
+            if o.get("part_bytes"):
+                attrs["part_bytes"] = o["part_bytes"]
+            recs.append({
+                "v": 2, "type": "stats",
+                "query_id": query_id, "trace_id": trace_id,
+                "op": o["name"], "digest": o.get("digest") or "",
+                "est_rows": float(o["est"]),
+                "actual_rows": int(o["rows"]),
+                "q_error": float(o.get("q_error", 1.0)),
+                "attrs": attrs,
+            })
+        return recs

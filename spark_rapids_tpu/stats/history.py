@@ -1,0 +1,240 @@
+"""Fingerprint-keyed cardinality history — the statistics store.
+
+One entry per canonical subplan fingerprint (rescache/fingerprint.py
+under the `"stats"` namespace): the last OBSERVED output cardinality of
+that subtree (rows/batches/bytes), its observed filter selectivity or
+join fan-out where applicable, a per-partition exchange byte histogram
+for skew detection, and the estimate that was current when the actuals
+landed (so the store itself documents how wrong the optimizer was —
+q-error rides along as a diagnostic).
+
+Two tiers, modelled on the compile cache (compile/service.py):
+
+  * in-memory LRU (`spark.rapids.tpu.stats.history.maxEntries`) — the
+    hot lookup path, one dict probe under a lock;
+  * persistent CRC-framed JSONL (`spark.rapids.tpu.stats.history.dir`)
+    — one `CRC32C_HEX<space>JSON` line per record, append-only, so a
+    restarted worker keeps its learned cardinalities. A torn tail line,
+    a bit-flipped payload (CRC mismatch), or undecodable JSON is a MISS
+    — skipped on load, never a wrong stat. Later lines for the same
+    digest override earlier ones; the file compacts on load once the
+    dead-line ratio grows.
+
+Only entries whose fingerprint carried NO validators persist: a
+validator means process-local identity (an in-memory table keyed by
+`id()`), and a recycled id in a fresh process could alias different
+data — exactly the wrong-stat the fail-closed contract forbids.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+__all__ = ["OpStats", "StatsHistory", "nz_lower_median", "q_error"]
+
+
+def q_error(est: float, actual: float) -> float:
+    """The q-error of an estimate: max(est/actual, actual/est) with both
+    sides floored at one row (the standard cardinality-estimation error
+    measure — symmetric, >= 1.0, 1.0 = perfect)."""
+    e = max(float(est), 1.0)
+    a = max(float(actual), 1.0)
+    return max(e / a, a / e)
+
+
+def nz_lower_median(values) -> int:
+    """LOWER median of the non-empty entries, 0 when fewer than two are
+    non-empty — the ONE skew baseline shared by collection, the history
+    pre-flag, and the split site. Non-empty: a low-cardinality key
+    leaving most partitions empty must not drag the median to zero
+    (every populated partition would then read as skewed); lower
+    middle: with only a couple of populated partitions, the upper
+    middle IS the hot partition, hiding it from a factor-over-median
+    test."""
+    nz = sorted(v for v in values if v > 0)
+    if len(nz) < 2:
+        return 0
+    return int(nz[(len(nz) - 1) // 2])
+
+
+@dataclasses.dataclass
+class OpStats:
+    """Observed actuals for one fingerprinted subtree."""
+    digest: str
+    op: str                       # node class name at record time
+    rows: float = 0.0             # observed output rows
+    batches: int = 0
+    bytes: int = 0                # observed output bytes (0 = unknown)
+    selectivity: Optional[float] = None   # filters: rows_out / rows_in
+    fanout: Optional[float] = None        # joins: rows_out / probe rows
+    build_rows: Optional[float] = None    # joins: build-side input rows
+    part_bytes: Optional[List[int]] = None  # exchange per-partition bytes
+    est_rows: float = 0.0         # the estimate current when recorded
+    q_err: float = 1.0            # q_error(est_rows, rows) at record time
+    seen: int = 1                 # observations folded into this entry
+
+    def to_json(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        return {k: v for k, v in d.items() if v is not None}
+
+    @classmethod
+    def from_json(cls, d: Dict[str, Any]) -> "OpStats":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+
+class StatsHistory:
+    """In-memory LRU over OpStats + the optional persistent JSONL tier."""
+
+    def __init__(self, max_entries: int = 4096, persist_dir: str = ""):
+        self._mu = threading.Lock()
+        # file appends serialize on their OWN lock: the store mutex is
+        # the hot feedback-lookup path and must never wait on disk
+        self._fmu = threading.Lock()
+        self._max = max(int(max_entries), 1)
+        self._entries: "OrderedDict[str, OpStats]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.records = 0
+        self.persist_loaded = 0
+        self.persist_skipped = 0
+        self._path = os.path.join(persist_dir, "stats_history.jsonl") \
+            if persist_dir else ""
+        if self._path:
+            self._load()
+
+    # ------------------------------------------------------------- queries
+    @property
+    def entry_count(self) -> int:
+        with self._mu:
+            return len(self._entries)
+
+    def lookup(self, digest: Optional[str]) -> Optional[OpStats]:
+        """One LRU probe; counts hit/miss. None digest (fail-closed
+        fingerprint) is always a miss."""
+        if not digest:
+            with self._mu:
+                self.misses += 1
+            return None
+        with self._mu:
+            e = self._entries.get(digest)
+            if e is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(digest)
+            self.hits += 1
+            return e
+
+    def stats(self) -> Dict[str, Any]:
+        with self._mu:
+            return {"entries": len(self._entries), "hits": self.hits,
+                    "misses": self.misses, "records": self.records,
+                    "persist_loaded": self.persist_loaded,
+                    "persist_skipped": self.persist_skipped}
+
+    # -------------------------------------------------------------- writes
+    def record(self, entry: OpStats, persistable: bool = False) -> None:
+        """Upsert one entry (latest observation wins; `seen` accumulates)
+        and append it to the persistent tier when eligible."""
+        changed = True
+        with self._mu:
+            prev = self._entries.get(entry.digest)
+            if prev is not None:
+                entry.seen = prev.seen + 1
+                # merge: an update that did not observe an optional facet
+                # (a stage record has bytes but no selectivity; a per-op
+                # record may lack partition bytes) keeps the prior one
+                if entry.part_bytes is None:
+                    entry.part_bytes = prev.part_bytes
+                if entry.selectivity is None:
+                    entry.selectivity = prev.selectivity
+                if entry.fanout is None:
+                    entry.fanout = prev.fanout
+                if entry.build_rows is None:
+                    entry.build_rows = prev.build_rows
+                if entry.bytes == 0:
+                    entry.bytes = prev.bytes
+                # persist churn guard: a steady-state entry (same rows
+                # within 1%) re-appends nothing — dashboards re-running
+                # the same query must not grow the file without bound
+                changed = abs(prev.rows - entry.rows) > \
+                    0.01 * max(prev.rows, 1.0) or \
+                    (prev.part_bytes is None) != (entry.part_bytes is None)
+            self._entries[entry.digest] = entry
+            self._entries.move_to_end(entry.digest)
+            while len(self._entries) > self._max:
+                self._entries.popitem(last=False)
+            self.records += 1
+        if persistable and changed and self._path:
+            self._append(entry)
+
+    # --------------------------------------------------------- persistence
+    @staticmethod
+    def _frame(entry: OpStats) -> str:
+        from ..shuffle.codec import crc32c
+        payload = json.dumps(entry.to_json(), separators=(",", ":"),
+                             sort_keys=True)
+        return f"{crc32c(payload.encode('utf-8')):08x} {payload}\n"
+
+    def _append(self, entry: OpStats) -> None:
+        try:
+            line = self._frame(entry)
+            with self._fmu:
+                os.makedirs(os.path.dirname(self._path), exist_ok=True)
+                with open(self._path, "a") as f:
+                    f.write(line)
+        except (OSError, ValueError, TypeError):
+            pass  # persistence is best-effort; the memory tier still has it
+
+    def _load(self) -> None:
+        """Replay the JSONL tier into the LRU. Any line that fails its
+        CRC frame or JSON decode is skipped (a miss, never a wrong
+        stat); later lines override earlier ones for the same digest."""
+        from ..shuffle.codec import crc32c
+        try:
+            with open(self._path) as f:
+                lines = f.read().splitlines()
+        except OSError:
+            return
+        live: "OrderedDict[str, OpStats]" = OrderedDict()
+        for line in lines:
+            if not line.strip():
+                continue
+            crc_hex, _, payload = line.partition(" ")
+            try:
+                if int(crc_hex, 16) != crc32c(payload.encode("utf-8")):
+                    self.persist_skipped += 1
+                    continue
+                rec = json.loads(payload)
+                entry = OpStats.from_json(rec)
+                if not entry.digest:
+                    raise ValueError("empty digest")
+            except (ValueError, TypeError, KeyError):
+                self.persist_skipped += 1
+                continue
+            live[entry.digest] = entry
+            live.move_to_end(entry.digest)
+        while len(live) > self._max:
+            live.popitem(last=False)
+        with self._mu:
+            self._entries = live
+            self.persist_loaded = len(live)
+        # compact once superseded/corrupt lines dominate, so the file
+        # stays O(entries) across restarts (append-only otherwise)
+        if len(lines) > 2 * max(len(live), 16):
+            self._compact(live)
+
+    def _compact(self, live: "OrderedDict[str, OpStats]") -> None:
+        try:
+            tmp = self._path + ".tmp"
+            with open(tmp, "w") as f:
+                for entry in live.values():
+                    f.write(self._frame(entry))
+            os.replace(tmp, self._path)
+        except OSError:
+            pass

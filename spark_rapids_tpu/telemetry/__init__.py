@@ -56,6 +56,14 @@ _conf = None
 # the queue-occupancy gauge; weak so a leaked iterator cannot pin batches
 _prefetch_iters: "weakref.WeakSet" = weakref.WeakSet()
 
+# q-error factors (1 = perfect estimate) and per-partition byte sizes —
+# the two histogram families live telemetry's seconds-scale DEFAULT_BUCKETS
+# cannot serve
+QERROR_BUCKETS = (1.0, 1.5, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
+                  512.0, 2048.0)
+BYTE_BUCKETS = (1 << 10, 4 << 10, 16 << 10, 64 << 10, 256 << 10, 1 << 20,
+                4 << 20, 16 << 20, 64 << 20, 256 << 20, 1 << 30)
+
 
 def is_enabled() -> bool:
     return _ACTIVE
@@ -408,6 +416,34 @@ def _install_families(reg: MetricsRegistry) -> None:
                 "Parquet footer/statistics read errors during dynamic "
                 "pruning (file/row group kept unpruned).")
 
+    # runtime statistics (stats/): history traffic, estimate quality,
+    # skew evidence. The q-error histogram buckets are error FACTORS
+    # (1 = perfect), the partition-bytes histogram buckets are BYTES —
+    # a wide spread there is the skew signal aggregate shuffle byte
+    # counters cannot show
+    reg.counter("tpu_stats_history_hits_total",
+                "Cardinality-history lookups answered, by lookup kind "
+                "(rows / selectivity / stage / skew).", ["kind"])
+    reg.counter("tpu_stats_history_misses_total",
+                "Cardinality-history lookups missed, by lookup kind.",
+                ["kind"])
+    reg.counter("tpu_stats_records_total",
+                "Operator actuals recorded into the statistics history.")
+    reg.counter("tpu_stats_skew_detections_total",
+                "Exchanges whose observed per-partition bytes crossed "
+                "the skew factor.")
+    reg.histogram("tpu_stats_qerror",
+                  "Per-operator q-error distribution (max(est/actual, "
+                  "actual/est); 1 = perfect estimate).", ["op"],
+                  buckets=QERROR_BUCKETS)
+    reg.gauge("tpu_stats_history_entries",
+              "Entries resident in the statistics history LRU.",
+              callback=_stats_history_gauge)
+    reg.histogram("tpu_exchange_partition_bytes",
+                  "Serialized bytes per exchange output partition, fed "
+                  "at shuffle-write close (spread across buckets = "
+                  "partition skew).", buckets=BYTE_BUCKETS)
+
     # fleet gateway (fleet/): route decisions + per-worker pool gauges.
     # Callbacks observe live WorkerRegistries through sys.modules ONLY —
     # a process that never started a gateway never imports the package
@@ -532,6 +568,12 @@ def _rescache_bytes_gauge():
     if c is None:
         return {}
     return {(kind,): v for kind, v in c.bytes_by_kind().items()}
+
+
+def _stats_history_gauge():
+    from .. import stats
+    h = stats.get()
+    return h.entry_count if h is not None else None
 
 
 def _fleet_gauge(which: str):

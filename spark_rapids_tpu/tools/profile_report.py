@@ -33,10 +33,11 @@ import re
 import sys
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
-from ..utils.spans import SCHEMA_VERSION, validate_record
+from ..utils.spans import (SCHEMA_VERSION, format_adaptive_decision,
+                           validate_record)
 
 __all__ = ["load_records", "build_model", "render_report", "sched_summary",
-           "cache_summary", "trace_view", "main"]
+           "cache_summary", "stats_summary", "trace_view", "main"]
 
 # live logs plus size-capped rotation generations (events-PID.jsonl.1, .2,
 # ...) and the flight recorder's incident dumps — all the same schema
@@ -105,7 +106,9 @@ def build_model(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             "ts": rec.get("ts"),
             "wall_ns": rec.get("wall_ns", 0),
             "task_metrics": rec.get("task_metrics", {}),
+            "adaptive": rec.get("adaptive", []),
             "operators": [], "phases": {}, "sched_waits": [],
+            "op_stats": [],
         }
     for rec in records:
         q = queries.get(rec.get("query_id"))
@@ -128,6 +131,16 @@ def build_model(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                 "metrics": metrics, "time_ns": time_ns,
                 "rows": metrics.get("numOutputRows", 0),
                 "batches": metrics.get("numOutputBatches", 0),
+            })
+        elif rec["type"] == "stats":
+            # runtime-statistics estimate-vs-actual records (stats/)
+            q["op_stats"].append({
+                "op": rec.get("op", "?"),
+                "digest": rec.get("digest", ""),
+                "est_rows": rec.get("est_rows", 0),
+                "actual_rows": rec.get("actual_rows", 0),
+                "q_error": rec.get("q_error", 1.0),
+                "attrs": rec.get("attrs", {}),
             })
         elif rec["type"] == "span" and rec.get("kind") not in (
                 "query", "operator"):
@@ -241,6 +254,26 @@ def cache_summary(model: Dict[str, Any]) -> Dict[str, Any]:
     }
 
 
+def stats_summary(model: Dict[str, Any], top: int = 15) -> Dict[str, Any]:
+    """Runtime-statistics signal across all queries: the worst per-
+    operator misestimates (by q-error, descending) plus skew evidence —
+    empty dict when no query carried stats records (`spark.rapids.tpu.
+    stats.enabled` off or logs predate it)."""
+    rows: List[Dict[str, Any]] = []
+    skews = 0
+    for q in model["queries"]:
+        for s in q.get("op_stats", ()):
+            rows.append({"query_id": q["query_id"], "label": q["label"],
+                         **s})
+            if s.get("attrs", {}).get("skewed"):
+                skews += 1
+    if not rows:
+        return {}
+    rows.sort(key=lambda r: -float(r.get("q_error", 1.0)))
+    return {"operators": len(rows), "skew_detections": skews,
+            "worst": rows[:top]}
+
+
 def trace_view(records: List[Dict[str, Any]],
                trace: Optional[str] = None) -> str:
     """Cross-process trace timeline: group every record carrying a trace
@@ -334,7 +367,8 @@ def _fmt_table(rows: List[List[str]], header: List[str]) -> str:
     return "\n".join(out)
 
 
-def render_report(model: Dict[str, Any], top: int = 10) -> str:
+def render_report(model: Dict[str, Any], top: int = 10,
+                  stats: bool = False) -> str:
     queries = model["queries"]
     if not queries:
         return "no query records found"
@@ -423,6 +457,30 @@ def render_report(model: Dict[str, Any], top: int = 10) -> str:
                 f"shuffle volume: written={tm.get('shuffle_bytes_written', 0)}"
                 f"B read={tm.get('shuffle_bytes_read', 0)}B "
                 f"fetchWaitMs={tm.get('shuffle_fetch_wait_ns', 0) / 1e6:.1f}")
+        if q.get("adaptive"):
+            # AQE's actual decisions (staging coalesces, skew splits,
+            # history pre-flags) — previously only a session attribute
+            lines.append("adaptive decisions:")
+            for d in q["adaptive"]:
+                lines.append("  " + format_adaptive_decision(d))
+        lines.append("")
+    if stats:
+        st = stats_summary(model, top=top)
+        lines.append("=== runtime statistics (worst misestimates) ===")
+        if not st:
+            lines.append("no stats records found (enable "
+                         "spark.rapids.tpu.stats.enabled)")
+        else:
+            lines.append(f"estimated operators={st['operators']} "
+                         f"skewDetections={st['skew_detections']}")
+            lines.append(_fmt_table(
+                [[r["query_id"], r["label"], r["op"],
+                  f"{r['est_rows']:.0f}", str(r["actual_rows"]),
+                  f"{r['q_error']:.2f}",
+                  "skew" if r.get("attrs", {}).get("skewed") else ""]
+                 for r in st["worst"]],
+                ["query", "label", "operator", "est_rows", "actual_rows",
+                 "q_error", "flags"]))
         lines.append("")
     cache = cache_summary(model)
     if cache:
@@ -482,6 +540,10 @@ def main(argv: List[str] = None) -> int:
                     help="operators to show per query (default 10)")
     ap.add_argument("--json", action="store_true",
                     help="emit the aggregated model as JSON instead of text")
+    ap.add_argument("--stats", action="store_true",
+                    help="runtime-statistics section: worst estimate-vs-"
+                         "actual misestimates across queries (needs logs "
+                         "written with spark.rapids.tpu.stats.enabled)")
     ap.add_argument("--trace", nargs="?", const="", default=None,
                     metavar="TRACE_ID",
                     help="cross-process trace timeline: stitch client- and "
@@ -504,9 +566,10 @@ def main(argv: List[str] = None) -> int:
     if args.json:
         model["scheduler"] = sched_summary(model)
         model["cache"] = cache_summary(model)
+        model["stats"] = stats_summary(model, top=args.top)
         print(json.dumps(model, indent=2))
     else:
-        print(render_report(model, top=args.top))
+        print(render_report(model, top=args.top, stats=args.stats))
     if args.validate:
         _print_validated(records)
     return 0

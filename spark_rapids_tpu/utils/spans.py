@@ -41,7 +41,8 @@ __all__ = ["SCHEMA_VERSION", "Span", "QueryProfile", "span",
            "current_profile", "begin_profile", "end_profile",
            "write_event_log", "validate_record", "task_metrics_dict",
            "new_trace_id", "current_trace", "trace_scope",
-           "write_client_record", "client_op_record", "append_jsonl"]
+           "write_client_record", "client_op_record", "append_jsonl",
+           "format_adaptive_decision"]
 
 # v2 (live telemetry): every record carries `trace_id` (cross-process
 # correlation — the id minted at query start rides the service headers
@@ -260,6 +261,15 @@ def end_profile(prof: "QueryProfile") -> None:
             _current = None
 
 
+def format_adaptive_decision(d: Dict[str, Any]) -> str:
+    """One `rule: k=v ...` line for an AQE decision — the single
+    formatter behind explain_profile and profile_report, so the two
+    renderings of the same decision log cannot drift apart."""
+    rule = d.get("rule", "?")
+    rest = " ".join(f"{k}={d[k]}" for k in sorted(d) if k != "rule")
+    return f"{rule}: {rest}"
+
+
 def task_metrics_dict(tm) -> Dict[str, Any]:
     """Flatten a TaskMetrics instance to a JSON-safe dict (ints + the
     backoff list)."""
@@ -296,6 +306,11 @@ class QueryProfile:
         # session when a query unwinds with a scheduler-typed error, so a
         # killed query's profile record says so (sched_matrix.sh gates it)
         self.status = "ok"
+        # adaptive-execution decisions (plan/adaptive.py `_adaptive_log`:
+        # staging coalesces, skew splits, history pre-flags) — attached
+        # by the session so explain_profile and the event-log query
+        # record surface what AQE actually did, not just its effects
+        self.adaptive: List[Dict[str, Any]] = []
         self.task_metrics: Dict[str, Any] = {}
         self._mu = threading.RLock()
         self._next_span = itertools.count(1)  # 0 is the query root
@@ -415,6 +430,7 @@ class QueryProfile:
             "task_metrics": dict(self.task_metrics),
             "n_operators": len(self._op_meta),
             "n_spans": len(self._spans) + 1,
+            "adaptive": list(self.adaptive),
         }]
         for m in self.operator_table():
             recs.append({
@@ -485,6 +501,10 @@ class QueryProfile:
             if hot:
                 lines.append("  task: " + ", ".join(
                     f"{k}={v}" for k, v in sorted(hot.items())))
+        if self.adaptive:
+            lines.append("  adaptive:")
+            for d in self.adaptive:
+                lines.append("    " + format_adaptive_decision(d))
         return "\n".join(lines)
 
 
@@ -629,6 +649,12 @@ _REQUIRED_V2_ONLY: Dict[str, Dict[str, Any]] = {
                  "pid": int, "n_events": int, "attrs": dict},
     "event": {"seq": int, "ts": (int, float), "t_ns": int, "kind": str,
               "name": str, "trace_id": str, "attrs": dict},
+    # runtime statistics (stats/): one estimate-vs-actual record per
+    # estimated operator per query — profile_report --stats ranks the
+    # worst misestimates across queries from these
+    "stats": {"query_id": str, "trace_id": str, "op": str, "digest": str,
+              "est_rows": (int, float), "actual_rows": int,
+              "q_error": (int, float), "attrs": dict},
 }
 
 _VALID_VERSIONS = (1, 2)

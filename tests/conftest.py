@@ -65,6 +65,11 @@ def pytest_configure(config):
         "fleet: fleet-gateway suite (worker registry / breakers / "
         "affinity routing / failover / drain / cancel-through-gateway; "
         "scripts/fleet_matrix.sh runs these standalone)")
+    config.addinivalue_line(
+        "markers",
+        "stats: runtime-statistics suite (cardinality history / "
+        "estimate-vs-actual q-error / optimizer feedback / skew "
+        "histograms; scripts/stats_matrix.sh runs these standalone)")
 
 
 @pytest.fixture

@@ -1,0 +1,613 @@
+"""Runtime-statistics suite (marker `stats`; scripts/stats_matrix.sh runs
+these standalone).
+
+Covers: q-error math, the history store (LRU, merge, CRC-framed JSONL
+persistence, corrupt-entry degrade-to-miss), golden stats fingerprints,
+estimate-vs-actual collection with warm-history correction (the ≥10×
+misestimate dropping to ~1), observed-selectivity reuse, the
+feedback-off byte-identical-plan gate, adaptive coalesce-from-history
+and skew pre-flag, the per-partition exchange skew histogram (stats +
+telemetry), broadcast-vs-shuffle plan flips from history, cross-process
+persistence round-trip, event-log stats records + profile_report
+--stats, adaptive-decision surfacing, the misestimate incident, and the
+off-path zero-state contract."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu import stats, telemetry
+from spark_rapids_tpu.expr import Count, Sum, col, lit
+from spark_rapids_tpu.plugin import TpuSession
+from spark_rapids_tpu.stats.history import OpStats, StatsHistory, q_error
+from spark_rapids_tpu.utils import spans
+
+pytestmark = pytest.mark.stats
+
+GOLDEN_PATH = os.path.join(os.path.dirname(__file__),
+                           "golden_stats_fingerprints.json")
+
+
+@pytest.fixture(autouse=True)
+def _clean_stats():
+    yield
+    stats.shutdown()
+    telemetry.shutdown()
+
+
+def _session(**conf):
+    base = {"spark.rapids.sql.enabled": True,
+            "spark.rapids.sql.explain": "NONE",
+            "spark.rapids.tpu.stats.enabled": True}
+    base.update(conf)
+    return TpuSession(base)
+
+
+def _table(n=20_000, seed=7):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": pa.array(rng.integers(0, 64, n)),
+        "g": pa.array(rng.integers(0, 16, n).astype(np.int32)),
+        "v": pa.array(rng.uniform(size=n)),
+    })
+
+
+# ---------------------------------------------------------------------------
+# q-error math
+# ---------------------------------------------------------------------------
+
+class TestQError:
+    def test_perfect(self):
+        assert q_error(100, 100) == 1.0
+
+    def test_symmetric(self):
+        assert q_error(10, 1000) == q_error(1000, 10) == 100.0
+
+    def test_floors_at_one_row(self):
+        # both sides floor at 1 row: a 0-row actual against a 0.4-row
+        # estimate is a perfect estimate, not a division by zero
+        assert q_error(0.0, 0) == 1.0
+        assert q_error(0.4, 0) == 1.0
+        assert q_error(0, 50) == 50.0
+
+    def test_at_least_one(self):
+        assert q_error(3, 4) == 4 / 3
+
+
+# ---------------------------------------------------------------------------
+# history store
+# ---------------------------------------------------------------------------
+
+class TestHistory:
+    def test_lru_eviction(self):
+        h = StatsHistory(max_entries=3)
+        for i in range(5):
+            h.record(OpStats(digest=f"d{i}", op="x", rows=i))
+        assert h.entry_count == 3
+        assert h.lookup("d0") is None and h.lookup("d1") is None
+        assert h.lookup("d4").rows == 4
+
+    def test_lookup_moves_to_front(self):
+        h = StatsHistory(max_entries=2)
+        h.record(OpStats(digest="a", op="x", rows=1))
+        h.record(OpStats(digest="b", op="x", rows=2))
+        assert h.lookup("a") is not None      # refresh a
+        h.record(OpStats(digest="c", op="x", rows=3))
+        assert h.lookup("a") is not None      # b evicted, not a
+        assert h.lookup("b") is None
+
+    def test_merge_keeps_optional_facets(self):
+        h = StatsHistory()
+        h.record(OpStats(digest="d", op="x", rows=10,
+                         part_bytes=[5, 100], selectivity=0.25))
+        h.record(OpStats(digest="d", op="x", rows=12, bytes=640))
+        e = h.lookup("d")
+        assert e.rows == 12 and e.bytes == 640
+        assert e.part_bytes == [5, 100] and e.selectivity == 0.25
+        assert e.seen == 2
+
+    def test_persistence_round_trip(self, tmp_path):
+        h = StatsHistory(persist_dir=str(tmp_path))
+        h.record(OpStats(digest="d1", op="scan", rows=123, bytes=456),
+                 persistable=True)
+        h.record(OpStats(digest="d2", op="filter", rows=7,
+                         selectivity=0.01, part_bytes=[1, 2, 3]),
+                 persistable=True)
+        h2 = StatsHistory(persist_dir=str(tmp_path))
+        assert h2.persist_loaded == 2
+        assert h2.lookup("d1").rows == 123
+        e2 = h2.lookup("d2")
+        assert e2.selectivity == 0.01 and e2.part_bytes == [1, 2, 3]
+
+    def test_non_persistable_stays_memory_only(self, tmp_path):
+        h = StatsHistory(persist_dir=str(tmp_path))
+        h.record(OpStats(digest="mem", op="scan", rows=9),
+                 persistable=False)
+        h.record(OpStats(digest="disk", op="scan", rows=8),
+                 persistable=True)
+        h2 = StatsHistory(persist_dir=str(tmp_path))
+        assert h2.lookup("disk") is not None
+        assert h2.lookup("mem") is None
+
+    def test_corrupt_entries_degrade_to_miss(self, tmp_path):
+        h = StatsHistory(persist_dir=str(tmp_path))
+        h.record(OpStats(digest="good", op="scan", rows=5),
+                 persistable=True)
+        path = os.path.join(str(tmp_path), "stats_history.jsonl")
+        with open(path) as f:
+            good_line = f.read()
+        with open(path, "w") as f:
+            f.write("not a framed line at all\n")
+            f.write("deadbeef {\"digest\": \"poisoned\", \"op\": \"x\", "
+                    "\"rows\": 1e9}\n")       # CRC mismatch
+            f.write(good_line)
+            f.write("00000000 {broken json\n")
+            f.write(good_line[: len(good_line) // 2])  # torn tail
+        h2 = StatsHistory(persist_dir=str(tmp_path))
+        assert h2.lookup("good").rows == 5
+        assert h2.lookup("poisoned") is None
+        assert h2.persist_skipped >= 3
+
+    def test_steady_state_does_not_grow_file(self, tmp_path):
+        h = StatsHistory(persist_dir=str(tmp_path))
+        for _ in range(10):
+            h.record(OpStats(digest="d", op="scan", rows=100),
+                     persistable=True)
+        path = os.path.join(str(tmp_path), "stats_history.jsonl")
+        with open(path) as f:
+            assert len(f.read().splitlines()) == 1
+
+
+# ---------------------------------------------------------------------------
+# fingerprints (stats namespace)
+# ---------------------------------------------------------------------------
+
+def _golden_plans(sess):
+    """Range-rooted plans only: no in-memory identity, no file stat —
+    stable across processes AND regenerations (same discipline as
+    tests/golden_fingerprints.json for rescache)."""
+    r = sess.range(1000)
+    return {
+        "range": r.plan,
+        "filter": r.filter(col("id") % 7 == lit(3)).plan,
+        "agg": r.select((col("id") % 10).alias("g"), col("id").alias("v"))
+               .group_by("g").agg(total=Sum(col("v")),
+                                  cnt=Count(col("v"))).plan,
+        "repartition": r.repartition(4, "id").plan,
+    }
+
+
+class TestStatsFingerprints:
+    def test_golden_stats_fingerprints(self):
+        """Stats digests pinned — regenerate deliberately with
+        SRTPU_REGEN_GOLDEN_STATS_FP=1 when the fingerprint recipe
+        changes (a silent change orphans every persisted history; an
+        ALIAS would feed one subtree's actuals to another's estimates)."""
+        sess = _session()
+        sess.initialize_device()
+        digests = {}
+        for name, plan in _golden_plans(sess).items():
+            d, persistable = stats.make_digest(plan, sess.conf)
+            assert d is not None and persistable, name
+            digests[name] = d
+        if os.environ.get("SRTPU_REGEN_GOLDEN_STATS_FP") or \
+                not os.path.exists(GOLDEN_PATH):
+            with open(GOLDEN_PATH, "w") as f:
+                json.dump(digests, f, indent=2, sort_keys=True)
+        with open(GOLDEN_PATH) as f:
+            golden = json.load(f)
+        assert digests == golden
+
+    def test_namespace_separation_from_rescache(self):
+        """A stats digest must never collide with a rescache digest for
+        the same subtree — the namespaces hold different value kinds."""
+        from spark_rapids_tpu.rescache.fingerprint import fingerprint
+        sess = _session()
+        sess.initialize_device()
+        plan = _golden_plans(sess)["agg"]
+        d, _ = stats.make_digest(plan, sess.conf)
+        assert d != fingerprint(plan, sess.conf, extra="query|").digest
+
+    def test_fail_closed_nondeterministic(self):
+        from spark_rapids_tpu.expr.misc import SparkPartitionID
+        sess = _session()
+        sess.initialize_device()
+        plan = sess.range(100).filter(SparkPartitionID() == lit(0)).plan
+        d, _ = stats.make_digest(plan, sess.conf)
+        assert d is None
+        assert stats.selectivity_digest(plan) is None
+
+
+# ---------------------------------------------------------------------------
+# collection + feedback
+# ---------------------------------------------------------------------------
+
+class TestCollection:
+    def test_misestimate_corrected_from_history(self):
+        """The acceptance criterion: a repeated query whose static
+        estimate is wrong by >=10x gets a corrected estimate from
+        history — q-error drops to ~1 in explain_analyze."""
+        sess = _session(**{"spark.rapids.tpu.stats.feedback.enabled": True})
+        t = _table()
+        # heuristic: agg over filter estimates rows/2/8; actual: 16 groups
+        q = (sess.from_arrow(t).filter(col("v") > lit(0.9))
+             .group_by("g").agg(total=Sum(col("v"))))
+        q.collect()
+        cold = sess.last_stats.worst()
+        assert cold["q_error"] >= 10, cold
+        q.collect()
+        warm = sess.last_stats.worst()
+        assert warm["q_error"] <= 1.5, warm
+        text = sess.explain_analyze()
+        assert "q_err" in text and "TpuHashAggregateExec" in text
+
+    def test_observed_selectivity(self):
+        sess = _session()
+        t = _table()
+        sess.from_arrow(t).filter(col("v") > lit(0.75)).collect()
+        ops = {o["name"]: o for o in sess.last_stats.ops}
+        sel = ops["TpuFilterExec"].get("selectivity")
+        assert sel is not None and 0.2 < sel < 0.3
+
+    def test_selectivity_reused_across_sources(self, tmp_path):
+        """The (condition, child schema) selectivity key generalizes:
+        the same predicate over a DIFFERENT file reuses the observed
+        selectivity where whole-subtree row history must miss."""
+        rng = np.random.default_rng(3)
+
+        def write(path, n):
+            pq.write_table(pa.table({
+                "v": pa.array(np.where(rng.uniform(size=n) < 0.01,
+                                       5.0, 0.0))}), path)
+        p1 = str(tmp_path / "a.parquet")
+        p2 = str(tmp_path / "b.parquet")
+        write(p1, 20_000)
+        write(p2, 20_000)
+        sess = _session(**{"spark.rapids.tpu.stats.feedback.enabled": True})
+        sess.read_parquet(p1).filter(col("v") > lit(1.0)).collect()
+        from spark_rapids_tpu.plan.cbo import row_estimate
+        plan2 = sess.read_parquet(p2).filter(col("v") > lit(1.0)).plan
+        est = row_estimate(plan2, sess.conf)
+        # static heuristic (no footer range hit): 0.5 * 20k = 10k;
+        # observed selectivity ~0.01 predicts ~200
+        assert est < 1000, est
+
+    def test_feedback_off_estimates_unchanged(self):
+        """Warm history with feedback OFF must not move a single
+        estimate — the byte-identical-plan gate rides on this."""
+        from spark_rapids_tpu.plan.cbo import row_estimate
+        sess = _session()  # stats on, feedback off (default)
+        t = _table()
+        q = (sess.from_arrow(t).filter(col("v") > lit(0.9))
+             .group_by("g").agg(total=Sum(col("v"))))
+        static = row_estimate(q.plan, sess.conf)
+        q.collect()  # history now warm
+        assert row_estimate(q.plan, sess.conf) == static
+        assert row_estimate(q.plan) == static
+
+    def test_failed_query_records_nothing(self):
+        sess = _session()
+        sess.from_arrow(_table(n=2000)).collect()
+        before = stats.stats()["records"]
+        from spark_rapids_tpu import faults
+        with faults.inject(faults.PREFETCH, "error", nth=1):
+            with pytest.raises(Exception):
+                sess.from_arrow(_table(n=2000, seed=9)) \
+                    .group_by("g").agg(c=Count(col("v"))).collect()
+        # the failed query's partial actuals must not have landed
+        assert stats.stats()["records"] == before
+
+    def test_incident_on_catastrophic_misestimate(self, tmp_path):
+        sess = _session(**{
+            "spark.rapids.tpu.telemetry.enabled": True,
+            "spark.rapids.tpu.telemetry.flightRecorder.dir":
+                str(tmp_path),
+            "spark.rapids.tpu.stats.misestimate.incidentThreshold": 10.0})
+        t = _table()
+        (sess.from_arrow(t).filter(col("v") > lit(0.9))
+         .group_by("g").agg(total=Sum(col("v")))).collect()
+        reg = telemetry.registry()
+        assert reg.get_value("tpu_incidents_total",
+                             reason="misestimate") >= 1
+        dumps = [p for p in os.listdir(str(tmp_path))
+                 if p.endswith(".jsonl")]
+        assert dumps, "misestimate incident should have dumped"
+
+
+# ---------------------------------------------------------------------------
+# off-path contract
+# ---------------------------------------------------------------------------
+
+class TestOffPath:
+    def test_off_no_state_no_threads_same_plan(self):
+        threads0 = threading.active_count()
+        sess = TpuSession({"spark.rapids.sql.enabled": True,
+                           "spark.rapids.sql.explain": "NONE"})
+        t = _table(n=4000)
+        q = sess.from_arrow(t).group_by("g").agg(c=Count(col("v")))
+        explain_off = sess.explain_plan(q.plan)
+        q.collect()
+        assert not stats.is_enabled() and stats.get() is None
+        assert stats.stats() is None
+        assert sess.last_stats is None
+        assert threading.active_count() <= threads0
+        # same session shapes WITH stats on (feedback off): identical plan
+        sess_on = _session()
+        q_on = sess_on.from_arrow(t).group_by("g").agg(c=Count(col("v")))
+        assert sess_on.explain_plan(q_on.plan) == explain_off
+
+    def test_explain_analyze_requires_stats(self):
+        sess = TpuSession({"spark.rapids.sql.enabled": True})
+        with pytest.raises(ValueError, match="stats.enabled"):
+            sess.explain_analyze(sess.range(10).plan)
+
+
+# ---------------------------------------------------------------------------
+# adaptive feedback
+# ---------------------------------------------------------------------------
+
+class TestAdaptiveFeedback:
+    def test_coalesce_count_from_history_without_staging(self, rng):
+        """Acceptance criterion: the warm run's coalesce count comes
+        from HISTORY (decided before the stage ran) and equals what the
+        observed bytes chose cold."""
+        t = pa.table({"k": pa.array(rng.integers(0, 64, 4000)),
+                      "v": pa.array(rng.uniform(size=4000))})
+        sess = _session(**{
+            "spark.rapids.sql.adaptive.enabled": True,
+            "spark.rapids.tpu.stats.feedback.enabled": True,
+            "spark.rapids.sql.adaptive.advisoryPartitionSizeInBytes":
+                16 * 1024})
+        q = sess.from_arrow(t).repartition(8, "k") \
+            .group_by("k").agg(s=Sum(col("v")))
+        r1 = q.collect().sort_by("k")
+        log1 = [e for e in sess._adaptive_log
+                if e["rule"] == "coalescePartitions"]
+        r2 = q.collect().sort_by("k")
+        log2 = [e for e in sess._adaptive_log
+                if e["rule"] == "coalescePartitions"]
+        assert log1 and log1[0]["source"] == "observed"
+        assert log2 and log2[0]["source"] == "history"
+        assert log1[0]["to"] == log2[0]["to"] < log1[0]["from"]
+        assert r1.equals(r2)
+
+    def test_skew_preflag_splits_below_row_threshold(self, rng):
+        """History evidence waives the absolute row threshold: a hot
+        partition the static detector ignores (below the threshold)
+        splits on the warm run, bit-matching the CPU engine's rows."""
+        n = 6000
+        keys = np.concatenate([np.full(3 * n // 4, 7, np.int64),
+                               rng.integers(1, 100, n - 3 * n // 4)])
+        rng.shuffle(keys)
+        probe = pa.table({"k": pa.array(keys),
+                          "v": pa.array(rng.normal(size=n))})
+        build = pa.table({"k": pa.array(np.arange(100)),
+                          "w": pa.array(rng.uniform(size=100))})
+        sess = _session(**{
+            "spark.rapids.sql.adaptive.enabled": True,
+            "spark.rapids.tpu.stats.feedback.enabled": True,
+            "spark.rapids.sql.adaptive.skewJoin."
+            "skewedPartitionRowThreshold": 100_000,
+            "spark.rapids.sql.adaptive.advisoryPartitionSizeInBytes":
+                16 * 1024,
+            "spark.rapids.sql.autoBroadcastJoinThreshold": -1})
+        lf = sess.from_arrow(probe).repartition(6, "k")
+        rf = sess.from_arrow(build).repartition(6, "k")
+        q = lf.join(rf, on="k").group_by("k") \
+            .agg(s=Sum(col("v") * col("w")))
+        r1 = q.collect().sort_by("k")
+        assert not [e for e in sess._adaptive_log
+                    if e["rule"] == "skewJoin"]
+        r2 = q.collect().sort_by("k")
+        pre = [e for e in sess._adaptive_log if e["rule"] == "skewPreflag"]
+        splits = [e for e in sess._adaptive_log if e["rule"] == "skewJoin"]
+        assert pre and splits and all(e["preflag"] for e in splits), \
+            sess._adaptive_log
+        assert r1.column("k").to_pylist() == r2.column("k").to_pylist()
+        assert np.allclose(r1.column("s").to_numpy(),
+                           r2.column("s").to_numpy())
+
+
+# ---------------------------------------------------------------------------
+# exchange skew histogram
+# ---------------------------------------------------------------------------
+
+class TestExchangeSkew:
+    def test_partition_bytes_recorded_and_skew_flagged(self, rng):
+        n = 4000
+        keys = np.concatenate([np.zeros(3 * n // 4, np.int64),
+                               rng.integers(1, 64, n // 4)])
+        t = pa.table({"k": pa.array(keys),
+                      "v": pa.array(rng.uniform(size=n))})
+        sess = _session(**{"spark.rapids.tpu.telemetry.enabled": True})
+        sess.from_arrow(t).repartition(4, "k").collect()
+        ops = {o["name"]: o for o in sess.last_stats.ops}
+        ex = ops["TpuShuffleExchangeExec"]
+        pb = ex.get("part_bytes")
+        assert pb and len(pb) == 4
+        # one hot partition holds the bulk of the bytes
+        assert max(pb) > 3 * sorted(pb)[len(pb) // 2]
+        assert ex.get("skewed") is True
+        assert stats.get().lookup(ex["digest"]).part_bytes == pb
+        # telemetry satellite: the histogram family observed every
+        # written partition and round-trips through the text format
+        reg = telemetry.registry()
+        from spark_rapids_tpu.telemetry import parse_prometheus
+        parsed = parse_prometheus(reg.render())
+        count = parsed["tpu_exchange_partition_bytes_count"][""]
+        assert count >= len([b for b in pb if b > 0])
+        assert reg.get_value("tpu_stats_skew_detections_total") >= 1
+
+    def test_uniform_partitions_not_flagged(self, rng):
+        t = pa.table({"k": pa.array(rng.integers(0, 64, 4000)),
+                      "v": pa.array(rng.uniform(size=4000))})
+        sess = _session()
+        sess.from_arrow(t).repartition(4, "k").collect()
+        ops = {o["name"]: o for o in sess.last_stats.ops}
+        assert not ops["TpuShuffleExchangeExec"].get("skewed")
+
+
+# ---------------------------------------------------------------------------
+# plan-choice feedback (broadcast flip)
+# ---------------------------------------------------------------------------
+
+class TestPlanFlip:
+    def test_broadcast_vs_shuffle_flips_on_history(self, tmp_path, rng):
+        n = 50_000
+        b = rng.integers(0, 1_000_000, n)
+        b[:5] = 500  # exactly 5 rows survive the filter
+        rng.shuffle(b)
+        fpath = str(tmp_path / "fact.parquet")
+        dpath = str(tmp_path / "dim.parquet")
+        pq.write_table(pa.table({
+            "k": pa.array(rng.integers(0, 1000, n)),
+            "v": pa.array(rng.uniform(size=n))}), fpath)
+        pq.write_table(pa.table({
+            "k": pa.array(rng.integers(0, 1000, n)),
+            "b": pa.array(b)}), dpath)
+        sess = _session(**{
+            "spark.rapids.tpu.stats.feedback.enabled": True,
+            # between actual build bytes (~90B) and the static estimate
+            # (EqualTo => 5% of 50k rows)
+            "spark.rapids.sql.autoBroadcastJoinThreshold": 4096})
+        f = sess.read_parquet(fpath)
+        d = sess.read_parquet(dpath).filter(col("b") == lit(500))
+        q = f.join(d, on="k").group_by("k").agg(s=Sum(col("v")))
+        r1 = q.collect().sort_by("k")
+        ops1 = [o["name"] for o in sess.last_stats.ops]
+        r2 = q.collect().sort_by("k")
+        ops2 = [o["name"] for o in sess.last_stats.ops]
+        assert "TpuShuffledHashJoinExec" in ops1, ops1
+        assert "TpuBroadcastHashJoinExec" in ops2, ops2
+        assert r1.equals(r2)
+
+
+# ---------------------------------------------------------------------------
+# cross-process persistence
+# ---------------------------------------------------------------------------
+
+_CHILD = r"""
+import sys
+import numpy as np
+import pyarrow as pa
+from spark_rapids_tpu.expr import Sum, col, lit
+from spark_rapids_tpu.plugin import TpuSession
+
+path, hist_dir, phase = sys.argv[1], sys.argv[2], sys.argv[3]
+sess = TpuSession({
+    "spark.rapids.sql.enabled": True,
+    "spark.rapids.sql.explain": "NONE",
+    "spark.rapids.tpu.stats.enabled": True,
+    "spark.rapids.tpu.stats.feedback.enabled": True,
+    "spark.rapids.tpu.stats.history.dir": hist_dir})
+q = (sess.read_parquet(path).filter(col("v") > lit(0.9))
+     .group_by("g").agg(total=Sum(col("v"))))
+q.collect()
+print("WORST_QERR", sess.last_stats.worst()["q_error"])
+"""
+
+
+class TestCrossProcessPersistence:
+    def test_restarted_worker_keeps_learned_cardinalities(self, tmp_path,
+                                                          rng):
+        """A fresh process with the same history dir answers the same
+        query with history-corrected estimates — q-error ~1 on its very
+        first run."""
+        path = str(tmp_path / "t.parquet")
+        hist = str(tmp_path / "hist")
+        t = pa.table({
+            "g": pa.array(rng.integers(0, 16, 20_000).astype(np.int32)),
+            "v": pa.array(rng.uniform(size=20_000))})
+        pq.write_table(t, path)
+
+        def run():
+            out = subprocess.run(
+                [sys.executable, "-c", _CHILD, path, hist, "x"],
+                capture_output=True, text=True, timeout=300,
+                env=dict(os.environ, JAX_PLATFORMS="cpu"))
+            assert out.returncode == 0, out.stderr
+            line = [l for l in out.stdout.splitlines()
+                    if l.startswith("WORST_QERR")][0]
+            return float(line.split()[1])
+
+        cold = run()
+        warm = run()
+        assert cold >= 10, cold
+        assert warm <= 1.5, warm
+        # the history file is CRC-framed JSONL with persistable entries
+        hist_file = os.path.join(hist, "stats_history.jsonl")
+        assert os.path.exists(hist_file)
+
+
+# ---------------------------------------------------------------------------
+# event log + report + explain_profile surfacing
+# ---------------------------------------------------------------------------
+
+class TestDiagnostics:
+    def test_stats_records_validate_and_report(self, tmp_path):
+        log_dir = str(tmp_path / "events")
+        sess = _session(**{
+            "spark.rapids.tpu.metrics.eventLog.dir": log_dir})
+        t = _table()
+        (sess.from_arrow(t).filter(col("v") > lit(0.9))
+         .group_by("g").agg(total=Sum(col("v")))).collect()
+        recs = []
+        for name in os.listdir(log_dir):
+            with open(os.path.join(log_dir, name)) as f:
+                for line in f:
+                    recs.append(json.loads(line))
+        st = [r for r in recs if r.get("type") == "stats"]
+        assert st, "stats records must land in the event log"
+        for r in recs:
+            assert spans.validate_record(r) == [], r
+        from spark_rapids_tpu.tools.profile_report import (
+            build_model, render_report, stats_summary)
+        model = build_model(recs)
+        summary = stats_summary(model)
+        assert summary and summary["worst"][0]["q_error"] >= 10
+        text = render_report(model, stats=True)
+        assert "runtime statistics" in text and "q_error" in text
+
+    def test_adaptive_decisions_in_profile_and_report(self, tmp_path, rng):
+        log_dir = str(tmp_path / "events")
+        t = pa.table({"k": pa.array(rng.integers(0, 64, 4000)),
+                      "v": pa.array(rng.uniform(size=4000))})
+        sess = _session(**{
+            "spark.rapids.sql.adaptive.enabled": True,
+            "spark.rapids.tpu.metrics.eventLog.dir": log_dir,
+            "spark.rapids.sql.adaptive.advisoryPartitionSizeInBytes":
+                16 * 1024})
+        sess.from_arrow(t).repartition(8, "k") \
+            .group_by("k").agg(s=Sum(col("v"))).collect()
+        assert [e for e in sess._adaptive_log
+                if e["rule"] == "coalescePartitions"]
+        # explain_profile surfaces the decisions (satellite: they used
+        # to live only on the session attribute)
+        text = sess.explain_profile()
+        assert "adaptive:" in text and "coalescePartitions" in text
+        recs = []
+        for name in os.listdir(log_dir):
+            with open(os.path.join(log_dir, name)) as f:
+                recs.extend(json.loads(l) for l in f)
+        q_recs = [r for r in recs if r.get("type") == "query"
+                  and r.get("adaptive")]
+        assert q_recs, "query record must carry the adaptive log"
+        from spark_rapids_tpu.tools.profile_report import (build_model,
+                                                           render_report)
+        out = render_report(build_model(recs))
+        assert "adaptive decisions:" in out
+        assert "coalescePartitions" in out
+
+    def test_explain_analyze_executes_plan(self):
+        sess = _session()
+        t = _table(n=2000)
+        q = sess.from_arrow(t).group_by("g").agg(c=Count(col("v")))
+        text = sess.explain_analyze(q.plan)
+        assert "RuntimeStats" in text and "actual" in text
