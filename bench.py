@@ -287,6 +287,10 @@ def scan_decode_bench(tmpdir: str):
         out.update(pipeline_query_bench(tmpdir))
     except Exception as e:  # must not sink the scan numbers
         out["pipeline_bench_error"] = f"{type(e).__name__}: {e}"
+    try:
+        out.update(scan_pushdown_bench(tmpdir))
+    except Exception as e:  # must not sink the scan numbers
+        out["pushdown_bench_error"] = f"{type(e).__name__}: {e}"
     return out
 
 
@@ -352,6 +356,92 @@ def pipeline_query_bench(tmpdir: str) -> dict:
         "pipeline_speedup": round(t_off / t_on, 3),
         "pipeline_identical": bool(res_on.equals(res_off)),
     }
+
+
+def scan_pushdown_bench(tmpdir: str, full: bool = False) -> dict:
+    """Scan-pushdown sweep (ISSUE-12): the SAME engine query — parquet
+    scan -> filter (-> aggregate) — with pushdown on vs off, across
+    selectivity x predicate type, reporting file-relative GB/s, device
+    ROW-DATA bytes materialised and rows pruned pre-materialisation (the
+    machine-independent proxies), plus the aggregate-only shape that must
+    materialise zero row data. Results are equality-gated per shape.
+    Footer row-group pruning stays ON (it is part of the shipped path);
+    the uniformly-shuffled string column defeats it, so `str_eq` isolates
+    the in-dispatch dictionary-domain win while `int_*` shapes also bank
+    clustered-predicate row-group skips — both appear in real scans.
+    `full=False` keeps the sweep inside the --scan-only child budget."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.expr import Count, Max, Min, Sum, col
+    from spark_rapids_tpu.plugin import TpuSession
+    from spark_rapids_tpu.utils.metrics import TaskMetrics
+
+    rng = np.random.default_rng(19)
+    n = SCAN_ROWS // 2
+    path = os.path.join(tmpdir, "pdbench.parquet")
+    if not os.path.exists(path):
+        t = pa.table({
+            "k": pa.array(np.arange(n, dtype=np.int64)),
+            "g": pa.array(rng.integers(0, 1024, n).astype(np.int32)),
+            "s": pa.array([f"name{v:03d}" for v in
+                           rng.integers(0, 100, n)]),
+            "v": pa.array(rng.uniform(0.0, 1.0, n)),
+        })
+        pq.write_table(t, path, row_group_size=SCAN_ROW_GROUP)
+    file_bytes = os.path.getsize(path)
+
+    shapes = [
+        ("int_sel1", lambda df: df.filter(col("k") < n // 100), None),
+        ("str_eq", lambda df: df.filter(col("s") == "name007"), None),
+        ("agg_only", lambda df: df.filter(col("k") < n // 20).agg(
+            cnt=Count(), mn=Min(col("k")), mx=Max(col("g")),
+            sm=Sum(col("k"))), "k"),
+    ]
+    if full:
+        shapes[1:1] = [
+            ("int_sel50", lambda df: df.filter(col("k") < n // 2), None),
+            ("int_sel100", lambda df: df.filter(col("k") >= 0), None),
+        ]
+
+    out = {"pushdown_rows": n, "pushdown_file_bytes": file_bytes}
+
+    def run(build, pushdown):
+        sess = TpuSession({
+            "spark.rapids.sql.enabled": True,
+            "spark.rapids.sql.explain": "NONE",
+            "spark.rapids.tpu.scan.pushdown.enabled": pushdown,
+        })
+        sess.initialize_device()
+        q = build(sess.read_parquet(path))
+        q.collect()  # warm (compiles)
+        best, res = float("inf"), None
+        for _ in range(3):
+            TaskMetrics.reset()  # metrics report ONE run, not the sum
+            t0 = time.perf_counter()
+            res = q.collect()
+            best = min(best, time.perf_counter() - t0)
+        tm = TaskMetrics.get()
+        return res, best, tm.scan_bytes_materialized, tm.scan_rows_pruned
+
+    for name, build, sort_col in shapes:
+        res_on, t_on, bytes_on, pruned_on = run(build, True)
+        res_off, t_off, _, _ = run(build, False)
+        a, b = res_on, res_off
+        if sort_col is None and a.num_rows and "k" in a.schema.names:
+            a = a.sort_by([("k", "ascending")])
+            b = b.sort_by([("k", "ascending")])
+        out.update({
+            f"pushdown_{name}_gbps_on": round(file_bytes / t_on / 1e9, 3),
+            f"pushdown_{name}_gbps_off": round(file_bytes / t_off / 1e9,
+                                               3),
+            f"pushdown_{name}_s_on": round(t_on, 5),
+            f"pushdown_{name}_s_off": round(t_off, 5),
+            f"pushdown_{name}_speedup": round(t_off / t_on, 3),
+            f"pushdown_{name}_bytes_materialized": int(bytes_on),
+            f"pushdown_{name}_rows_pruned": int(pruned_on),
+            f"pushdown_{name}_identical": bool(a.equals(b)),
+        })
+    return out
 
 
 ATTEMPTS = 3
@@ -1292,6 +1382,16 @@ if __name__ == "__main__":
         # gate, zero-admission warm runs; one JSON line
         _enable_compilation_cache()
         print(json.dumps(rescache_bench()), flush=True)
+    elif "--scan-pushdown" in sys.argv:
+        # bench flag (ISSUE-12): full pushdown sweep (selectivity x
+        # predicate type + aggregate-only), GB/s + bytes-materialised +
+        # rows-pruned per shape; one JSON line
+        _enable_compilation_cache()
+        _apply_platform_override()
+        import tempfile
+        with tempfile.TemporaryDirectory() as td:
+            print(json.dumps(scan_pushdown_bench(td, full=True)),
+                  flush=True)
     elif "--scan-only" in sys.argv:
         scan_only()
     elif os.environ.get(_CHILD_ENV):

@@ -465,6 +465,35 @@ register("spark.rapids.tpu.pipeline.scan.chunksPerDispatch", "int", 4,
          "batching (per-row-group decode, the pre-pipeline unit); "
          "ignored when spark.rapids.tpu.pipeline.enabled is false.")
 
+# Scan pushdown ----------------------------------------------------------------------
+register("spark.rapids.tpu.scan.pushdown.enabled", "bool", False,
+         "Compute on compressed data: fuse supported filter predicates, "
+         "pure column projections and global count/min/max/sum aggregates "
+         "from the plan into the file scan. The device parquet decode "
+         "evaluates pushed predicates directly on dictionary values and "
+         "RLE-expanded indices inside the fused multi-chunk program and "
+         "late-materializes only surviving rows of projected columns "
+         "(aggregate-only queries materialize no row data at all); every "
+         "other decode path applies the same predicate/projection exactly "
+         "on the decoded batch before emitting. Off (default) leaves "
+         "plans byte-identical to the non-pushdown planner with zero "
+         "extra state.")
+register("spark.rapids.tpu.scan.pushdown.aggregate.enabled", "bool", True,
+         "Allow pushing global (non-grouped) count/min/max/sum "
+         "aggregates over scan columns into the scan as per-dispatch "
+         "partial values merged by a rewritten upstream aggregate. "
+         "Integral/date/timestamp/boolean min/max and integral sums "
+         "only (exact, order-independent merges); disabled automatically "
+         "under ANSI mode. Ignored unless "
+         "spark.rapids.tpu.scan.pushdown.enabled is on.")
+register("spark.rapids.tpu.scan.pushdown.rowgroup.enabled", "bool", True,
+         "Prune whole parquet row groups on the device decode path by "
+         "testing the pushed predicate against footer min/max/null-count "
+         "statistics before any page bytes are read (conservative: a row "
+         "group is skipped only when provably no row can match). Counted "
+         "on tpu_scan_rowgroups_pruned_total. Ignored unless "
+         "spark.rapids.tpu.scan.pushdown.enabled is on.")
+
 # Query scheduler --------------------------------------------------------------------
 register("spark.rapids.tpu.sched.enabled", "bool", False,
          "Query scheduler: route device admission (TpuSemaphore and the "

@@ -61,11 +61,16 @@ def hive_device_supported(scan) -> bool:
     return _delimited_supported(scan, "\x01")
 
 
-def device_decode_csv_file(scan, path: str
+def device_decode_csv_file(scan, path: str, pushed=None
                            ) -> Iterator[Tuple[object, int]]:
     """Yield (device ColumnarBatch, nrows) for one CSV file, parsing
     fields and types on device. Raises DeviceDecodeUnsupported for shapes
-    the vectorized parser can't honor (caller keeps the host path)."""
+    the vectorized parser can't honor (caller keeps the host path).
+    `pushed` is the scan-pushdown seam (plan/scan_pushdown.py): a
+    callback applied per decoded chunk that filters/projects/aggregates
+    with the engine's exact kernels (mask + compact in one program) and
+    returns the (pushed batch, output rows) pair — never a silently
+    different result from the un-pushed plan."""
     return _device_decode_delimited(
         scan, path,
         sep=np.uint8(ord(scan.options.get("sep", ","))),
@@ -73,10 +78,11 @@ def device_decode_csv_file(scan, path: str
         null_markers=scan.options.get("null_values",
                                       ["", "null", "NULL"]),
         keep_empty=False,
-        reject_quote=np.uint8(ord(scan.options.get("quote", '"'))))
+        reject_quote=np.uint8(ord(scan.options.get("quote", '"'))),
+        pushed=pushed)
 
 
-def device_decode_hive_file(scan, path: str
+def device_decode_hive_file(scan, path: str, pushed=None
                             ) -> Iterator[Tuple[object, int]]:
     """Hive LazySimpleSerDe on device: \\x01 splits, \\N nulls, NO
     quoting (quote bytes are data), blank lines ARE rows (first column
@@ -87,11 +93,11 @@ def device_decode_hive_file(scan, path: str
         scan, path,
         sep=np.uint8(ord(scan.options.get("sep", "\x01"))),
         header=False, null_markers=["\\N"], keep_empty=True,
-        reject_quote=None)
+        reject_quote=None, pushed=pushed)
 
 
 def _device_decode_delimited(scan, path, *, sep, header, null_markers,
-                             keep_empty, reject_quote
+                             keep_empty, reject_quote, pushed=None
                              ) -> Iterator[Tuple[object, int]]:
     import jax.numpy as jnp
     from ..config import get_default_conf
@@ -115,10 +121,11 @@ def _device_decode_delimited(scan, path, *, sep, header, null_markers,
     chunk_rows = max(int(conf.get("spark.rapids.sql.batchSizeRows")), 1)
     blob_dev = jnp.asarray(blob)
     for at in range(0, total_rows, chunk_rows):
-        yield _decode_rows(scan, schema,
-                           row_starts[at:at + chunk_rows],
-                           row_ends[at:at + chunk_rows], blob_dev, sep,
-                           null_markers)
+        b, n = _decode_rows(scan, schema,
+                            row_starts[at:at + chunk_rows],
+                            row_ends[at:at + chunk_rows], blob_dev, sep,
+                            null_markers)
+        yield pushed(b, n) if pushed is not None else (b, n)
 
 
 def frame_lines(blob: np.ndarray, keep_empty: bool = False):

@@ -83,11 +83,13 @@ def _structural_precheck(blob, starts, ends):
     return starts[live_rows], ends[live_rows]
 
 
-def device_decode_json_file(scan, path: str
+def device_decode_json_file(scan, path: str, pushed=None
                             ) -> Iterator[Tuple[object, int]]:
     """Yield (device ColumnarBatch, nrows) for one json-lines file.
     Raises DeviceDecodeUnsupported before the first yield for shapes the
-    vectorized parser can't honor (caller keeps the host path)."""
+    vectorized parser can't honor (caller keeps the host path). `pushed`
+    is the scan-pushdown seam: applied per decoded chunk with the
+    engine's exact kernels (see csv_device.device_decode_csv_file)."""
     import jax.numpy as jnp
     from ..config import get_default_conf
 
@@ -107,8 +109,9 @@ def device_decode_json_file(scan, path: str
     chunk_rows = max(int(conf.get("spark.rapids.sql.batchSizeRows")), 1)
     blob_dev = jnp.asarray(blob)
     for at in range(0, total_rows, chunk_rows):
-        yield _decode_rows(scan, starts[at:at + chunk_rows],
-                           ends[at:at + chunk_rows], blob_dev)
+        b, n = _decode_rows(scan, starts[at:at + chunk_rows],
+                            ends[at:at + chunk_rows], blob_dev)
+        yield pushed(b, n) if pushed is not None else (b, n)
 
 
 def _first_at_least(xp, mask, pos, big):

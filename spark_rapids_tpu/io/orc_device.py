@@ -929,8 +929,14 @@ def _require_data(cs: _ColStreams) -> bytes:
     return raw
 
 
-def decode_stripe(info: OrcFileInfo, f, si: int, schema, host_cols=None):
+def decode_stripe(info: OrcFileInfo, f, si: int, schema, host_cols=None,
+                  pushed=None):
     """Decode ONE stripe on the TPU -> (device ColumnarBatch, row count).
+    `pushed` is the scan-pushdown seam (plan/scan_pushdown.py): applied
+    to the decoded stripe batch with the engine's exact kernels (mask +
+    compact in one program), returning (pushed batch, output rows) —
+    mask-based late materialisation at the stripe unit, never a silently
+    different result.
     `host_cols` names columns the support check routed to the host: they
     decode via ONE pyarrow read_stripe and merge into the batch at
     assembly — an unsupported column costs itself, not the stripe
@@ -1023,8 +1029,11 @@ def decode_stripe(info: OrcFileInfo, f, si: int, schema, host_cols=None):
                 get_default_conf().string_max_width))
         else:
             raise DeviceDecodeUnsupported(f"ORC kind {kind}")
-    return ColumnarBatch(schema, tuple(out_cols),
-                         jnp.asarray(nrows, jnp.int32)), nrows
+    batch = ColumnarBatch(schema, tuple(out_cols),
+                          jnp.asarray(nrows, jnp.int32))
+    if pushed is not None:
+        return pushed(batch, nrows)
+    return batch, nrows
 
 
 def _decimal_column(cs: _ColStreams, dt, defined, ndef: int, cap: int):

@@ -341,12 +341,20 @@ def _gather_strings(blob, starts, lens, defined, width: int):
     cap = defined.shape[0]
     rank = jnp.cumsum(defined.astype(jnp.int32)) - 1
     safe = jnp.clip(rank, 0, cap - 1)
-    st = starts[safe]
-    ln = jnp.where(defined, lens[safe], 0).astype(jnp.int32)
+    return _string_matrix_tail(blob, starts[safe], lens[safe], defined,
+                               width)
+
+
+def _string_matrix_tail(blob, starts, lens, valid, width: int):
+    """Row-aligned span read shared by `_gather_strings` (after its rank
+    gather) and the pushdown survivor gather: uint8[cap, width] byte
+    matrix + int32 lengths out of `blob`, invalid rows zeroed."""
+    import jax.numpy as jnp
+    ln = jnp.where(valid, lens, 0).astype(jnp.int32)
     j = jnp.arange(width)
-    idx = st[:, None] + j[None, :]
+    idx = starts[:, None] + j[None, :]
     mat = blob[jnp.clip(idx, 0, blob.shape[0] - 1)]
-    keep = (j[None, :] < ln[:, None]) & defined[:, None]
+    keep = (j[None, :] < ln[:, None]) & valid[:, None]
     return jnp.where(keep, mat, 0).astype(jnp.uint8), ln
 
 
@@ -1176,6 +1184,24 @@ def _col_sig(w):
             isinstance(w.dt, T.DateType))
 
 
+def _read_idx_traced(it, segs):
+    """Dictionary-index expansion over a column's segments (traced);
+    None when the column has no dictionary-coded values. Shared by the
+    full decode, the deferred string-span decode and the pushdown
+    predicate path — one copy of the segs/bit-width handling."""
+    import jax.numpy as jnp
+    idx_parts = []
+    for bw, ndef, has_runs in segs:
+        if not has_runs:
+            idx_parts.append(jnp.zeros(ndef, jnp.uint32))
+            continue
+        runs = [next(it) for _ in range(5)]
+        idx_parts.append(_expand_rle_u32(*runs, row_bucket(ndef), bw)[:ndef])
+    if not idx_parts:
+        return None
+    return idx_parts[0] if len(idx_parts) == 1 else jnp.concatenate(idx_parts)
+
+
 def _traced_decode_col(colsig, cap: int, nrows, it):
     """Decode ONE column (traced) from the ship-order array iterator `it`.
     Shared by the per-row-group fused program and the packed multi-chunk
@@ -1194,18 +1220,9 @@ def _traced_decode_col(colsig, cap: int, nrows, it):
         defined = jnp.arange(cap) < nrows
     is_bool = phys == "BOOLEAN"
     dict_vals = next(it) if has_dict else None
-    idx_parts = []
-    for bw, ndef, has_runs in segs:
-        if not has_runs:
-            idx_parts.append(jnp.zeros(ndef, jnp.uint32))
-            continue
-        runs = [next(it) for _ in range(5)]
-        idx_parts.append(_expand_rle_u32(
-            *runs, row_bucket(ndef), bw)[:ndef])
+    idx = _read_idx_traced(it, segs)
     pieces = []
-    if idx_parts:
-        idx = idx_parts[0] if len(idx_parts) == 1 \
-            else jnp.concatenate(idx_parts)
+    if idx is not None:
         idx = jnp.clip(idx, 0, max(dict_count - 1, 0))
         dv = dict_vals[idx]
         pieces.append(dv.astype(np.bool_) if is_bool else dv)
@@ -1269,17 +1286,8 @@ def _traced_decode_string(colsig, cap: int, nrows, it):
     if has_dict:
         dst = next(it)
         dln = next(it)
-        idx_parts = []
-        for bw, ndef, has_runs in segs:
-            if not has_runs:
-                idx_parts.append(jnp.zeros(ndef, jnp.uint32))
-                continue
-            runs = [next(it) for _ in range(5)]
-            idx_parts.append(_expand_rle_u32(
-                *runs, row_bucket(ndef), bw)[:ndef])
-        if idx_parts:
-            idx = idx_parts[0] if len(idx_parts) == 1 \
-                else jnp.concatenate(idx_parts)
+        idx = _read_idx_traced(it, segs)
+        if idx is not None:
             idx = jnp.clip(idx, 0, max(dict_count - 1, 0))
             st_parts.append(dst[idx])
             ln_parts.append(dln[idx])
@@ -1786,54 +1794,31 @@ def _fused_multi_program(groups_sig, caps, cap_total: int):
                 key=repr((groups_sig, caps, cap_total)))
 
 
-def decode_row_groups_fused(pf, f, rgs, schema, host_cols=None):
-    """Decode SEVERAL row groups as one dispatch group -> list of
-    (device ColumnarBatch, rows). When every device column of every chunk
-    takes a fast-path prep (prim/flba ship or the string span-table prep)
-    the whole group decodes in ONE packed transfer + ONE program and the
-    list holds one merged batch; a column that DECLINES the fast path
-    (odd page interleaving, over-wide strings) degrades to per-row-group
-    decode REUSING the already-computed host-phase products — host work
-    (chunk reads, decompression, RLE scans) is never repeated. Only
-    failures the per-row-group device path could not absorb either
-    (malformed row groups, host-column read errors) raise
-    DeviceDecodeUnsupported for the caller's pyarrow fallback.
-    Host-fallback columns decode once via pyarrow's read_row_groups and
-    merge at the total capacity."""
-    import jax
-    import jax.numpy as jnp
-    from ..columnar.batch import ColumnarBatch
-    from ..columnar.column import Column
-    from ..utils.metrics import TaskMetrics
+def _read_chunks(pf, f, rgs, schema, host_cols=None):
+    """HOST phase for a dispatch group: parse every row group's chunks
+    once -> ([(rg, works, nrows)], total rows)."""
     chunks = []
     total = 0
     for rg in rgs:
         works, nrows = _host_phase(pf, f, rg, schema, host_cols)
         chunks.append((rg, works, nrows))
         total += nrows
+    return chunks, total
 
-    def per_rg_batches():
-        """Per-row-group decode from the SAME works — no second host
-        phase. String works keep ship=None here, so `_device_phase`
-        routes them through the eager assembles."""
-        out = []
-        for rg, works, nrows in chunks:
-            out.append(_device_phase(pf, rg, schema, works, nrows,
-                                     host_cols))
-            TaskMetrics.get().scan_chunks += 1
-        return out
 
-    host_set = set(host_cols or ())
-    dev_names = [n for n in schema.names if n not in host_set]
-    if not dev_names or total == 0:
-        return per_rg_batches()
-    cap_total = row_bucket(total, op="scan.parquet")
-
+def _group_signatures(chunks, dev_names):
+    """Fast-path prep for a whole dispatch group: per-chunk column sigs +
+    the single packed transfer buffer. Returns (groups_sig, caps, packed,
+    str_blob_offs) where str_blob_offs maps (chunk index, column name) to
+    the string blob's byte offset inside the packed buffer (the pushdown
+    gather program reads value spans straight out of it), or None when
+    any column declines the fast path."""
     groups_sig = []
     caps = []
     all_arrays: List[np.ndarray] = []
     bounds = []
-    for _, works, nrows in chunks:
+    blob_pos = {}
+    for c_i, (_, works, nrows) in enumerate(chunks):
         # same op attribution as the serial path: the bucket tuner's scan
         # histogram must see the default-on chunk shapes too
         cap = row_bucket(nrows, op="scan.parquet")
@@ -1851,7 +1836,7 @@ def decode_row_groups_fused(pf, f, rgs, schema, host_cols=None):
                 if prepped is not None:
                     ship, meta = prepped
             if ship is None:
-                return per_rg_batches()  # fast path declined: degrade
+                return None  # fast path declined: degrade
             if w.spec.kind == "string":
                 colsigs.append(_string_sig_from(meta, w))
             else:
@@ -1859,6 +1844,8 @@ def decode_row_groups_fused(pf, f, rgs, schema, host_cols=None):
             if w.defruns is not None:
                 arrays.extend(w.defruns)
             arrays.extend(ship)
+            if w.spec.kind == "string":
+                blob_pos[(c_i, name)] = len(all_arrays) + len(arrays) - 1
         bounds.append(len(all_arrays))
         all_arrays.extend(arrays)
         groups_sig.append([tuple(colsigs), None])  # metas filled below
@@ -1867,6 +1854,59 @@ def decode_row_groups_fused(pf, f, rgs, schema, host_cols=None):
     for i, g in enumerate(groups_sig):
         g[1] = metas[bounds[i]:bounds[i + 1]]
     groups_sig = tuple((cs, m) for cs, m in groups_sig)
+    str_blob_offs = {k: metas[v][2] for k, v in blob_pos.items()}
+    return groups_sig, caps, packed, str_blob_offs
+
+
+def decode_row_groups_fused(pf, f, rgs, schema, host_cols=None):
+    """Decode SEVERAL row groups as one dispatch group -> list of
+    (device ColumnarBatch, rows). When every device column of every chunk
+    takes a fast-path prep (prim/flba ship or the string span-table prep)
+    the whole group decodes in ONE packed transfer + ONE program and the
+    list holds one merged batch; a column that DECLINES the fast path
+    (odd page interleaving, over-wide strings) degrades to per-row-group
+    decode REUSING the already-computed host-phase products — host work
+    (chunk reads, decompression, RLE scans) is never repeated. Only
+    failures the per-row-group device path could not absorb either
+    (malformed row groups, host-column read errors) raise
+    DeviceDecodeUnsupported for the caller's pyarrow fallback.
+    Host-fallback columns decode once via pyarrow's read_row_groups and
+    merge at the total capacity."""
+    chunks, total = _read_chunks(pf, f, rgs, schema, host_cols)
+    return _decode_chunks_fused(pf, rgs, schema, chunks, total, host_cols)
+
+
+def _per_rg_batches(pf, schema, chunks, host_cols):
+    """Per-row-group decode from the SAME works — no second host
+    phase. String works keep ship=None here, so `_device_phase`
+    routes them through the eager assembles."""
+    from ..utils.metrics import TaskMetrics
+    out = []
+    for rg, works, nrows in chunks:
+        out.append(_device_phase(pf, rg, schema, works, nrows,
+                                 host_cols))
+        TaskMetrics.get().scan_chunks += 1
+    return out
+
+
+def _decode_chunks_fused(pf, rgs, schema, chunks, total, host_cols=None):
+    """DEVICE half of decode_row_groups_fused over pre-read chunks."""
+    import jax
+    import jax.numpy as jnp
+    from ..columnar.batch import ColumnarBatch
+    from ..columnar.column import Column
+    from ..utils.metrics import TaskMetrics
+
+    host_set = set(host_cols or ())
+    dev_names = [n for n in schema.names if n not in host_set]
+    if not dev_names or total == 0:
+        return _per_rg_batches(pf, schema, chunks, host_cols)
+    cap_total = row_bucket(total, op="scan.parquet")
+
+    sig = _group_signatures(chunks, dev_names)
+    if sig is None:
+        return _per_rg_batches(pf, schema, chunks, host_cols)
+    groups_sig, caps, packed, _ = sig
 
     program = _fused_multi_program(groups_sig, tuple(caps), cap_total)
     nrows_arr = np.asarray([n for _, _, n in chunks], np.int64)
@@ -1898,6 +1938,568 @@ def decode_row_groups_fused(pf, f, rgs, schema, host_cols=None):
         cols.append(Column(by_name[name], data, validity, lengths))
     return [(ColumnarBatch(schema, tuple(cols),
                            jnp.asarray(total, jnp.int32)), total)]
+
+
+# -- pushdown: compute on compressed data --------------------------------------
+# Predicate, projection and aggregate evaluation INSIDE the packed
+# multi-chunk decode (plan/scan_pushdown.py carries the spec): pushed
+# predicates are tested once per DICTIONARY VALUE and the verdict mapped
+# over the RLE-expanded indices (and directly over PLAIN value streams),
+# producing a per-row selection mask without materialising any column; a
+# second program then gathers ONLY surviving rows of the projected columns
+# at the survivor-count capacity bucket — for a selective predicate the
+# big gathers (string byte matrices above all) run at a fraction of the
+# row-group capacity. Pushed count/min/max/sum aggregates reduce over the
+# mask inside the select program, so aggregate-only queries ship back a
+# handful of scalars and materialise no row data at all. Both programs'
+# compile keys include the pushed spec's param-faithful repr: two scans
+# differing only in their pushed predicate never share an executable.
+
+
+def _colsig_array_count(colsig) -> int:
+    """How many packed arrays one column consumes in ship order."""
+    if colsig[0] == "string":
+        (_, has_def, has_dict, _dc, segs, has_plain, _pn, _w) = colsig
+        n = 5 if has_def else 0
+        if has_dict:
+            n += 2
+        n += 5 * sum(1 for _, _, hr in segs if hr)
+        if has_plain:
+            n += 2
+        return n + 1  # + blob
+    (_kind, _phys, _post, _flen, has_def, has_dict, _dc, segs, has_plain,
+     _np_dt, _is_date) = colsig
+    n = 5 if has_def else 0
+    if has_dict:
+        n += 1
+    n += 5 * sum(1 for _, _, hr in segs if hr)
+    if has_plain:
+        n += 1
+    return n
+
+
+def _engine_values(colsig, arr):
+    """Raw shipped values (dictionary array or plain stream) -> the
+    engine-typed dense value stream, mirroring `_traced_decode_col`'s
+    post-scatter conversions (dtype widen, date int32, millis->micros,
+    FLBA limb/INT96 conversion) so predicate evaluation sees exactly what
+    the full decode would have produced."""
+    import jax.numpy as jnp
+    (kind, _phys, post, flen, _hd, _hdict, _dc, _segs, _hp, np_dt_str,
+     is_date) = colsig
+    if kind == "flba":
+        if post == "int96":
+            return _int96_to_micros(arr)
+        hi, lo = _flba_to_limbs(arr, flen)
+        if post == "dec64":
+            return lo
+        return jnp.stack([hi, lo], axis=1)
+    np_dt = np.dtype(np_dt_str)
+    v = arr
+    if is_date:
+        v = v.astype(jnp.int32)
+    elif v.dtype != np_dt:
+        v = v.astype(np_dt)
+    if post == "ts_ms":
+        v = v * 1000
+    return v
+
+
+def _eval_pushed_leaf(expr, dt, data, lengths=None):
+    """Evaluate one pushed predicate leaf over a DENSE (all-valid) value
+    stream using the engine's own expression kernels — comparison,
+    promotion, decimal, NaN and IN semantics are the very code the
+    un-pushed TpuFilterExec runs, so the compressed-domain path cannot
+    drift from it. Returns the is-true bool vector."""
+    import jax.numpy as jnp
+    from ..expr.base import EvalContext, Vec
+    n = data.shape[0]
+    ctx = EvalContext(jnp, row_mask=jnp.ones(n, dtype=bool), errors=[])
+    vec = Vec(dt, data, jnp.ones(n, dtype=bool), lengths)
+    res = expr.eval(ctx, [vec])
+    return (res.data & res.validity).astype(jnp.bool_)
+
+
+def _dense_to_rows(pieces, cap: int, defined):
+    """Dense per-value bool verdicts -> per-row is-true (null rows false),
+    the boolean analog of the value scatter."""
+    import jax.numpy as jnp
+    if pieces:
+        dense = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+    else:
+        dense = jnp.zeros(0, jnp.bool_)
+    if dense.shape[0] < cap:
+        dense = jnp.pad(dense, (0, cap - dense.shape[0]))
+    row_bool, _ = _scatter_values(dense[:cap], defined)
+    return row_bool & defined
+
+
+def _traced_predicate_col(colsig, dt, cap: int, nrows, arrays, leaves,
+                          lit_w: int = 1):
+    """Evaluate this column's pushed predicate leaves on the COMPRESSED
+    representation: the dictionary is tested ONCE (per leaf) and the
+    verdict gathered over the expanded indices; plain value streams are
+    tested densely; null checks read only the def-level mask. Returns
+    (defined bool[cap], {leaf index: is-true bool[cap]})."""
+    import jax.numpy as jnp
+    it = iter(arrays)
+    is_string = colsig[0] == "string"
+    if is_string:
+        (_, has_def, has_dict, dict_count, segs, has_plain, plain_ndef,
+         _w) = colsig
+    else:
+        (_kind, _phys, _post, _flen, has_def, has_dict, dict_count, segs,
+         has_plain, _np_dt, _is_date) = colsig
+    if has_def:
+        runs = [next(it) for _ in range(5)]
+        defined = _expand_def_levels(*runs, cap)
+    else:
+        defined = jnp.arange(cap) < nrows
+    out = {}
+    if not leaves:
+        return defined, out
+    if is_string:
+        dst = dln = None
+        if has_dict:
+            dst = next(it)
+            dln = next(it)
+        idx = _read_idx_traced(it, segs)
+        pst = pln = None
+        if has_plain:
+            pst = next(it)
+            pln = next(it)
+        blob = next(it)
+        # truncated-at-literal-width matrices are exact for literal
+        # comparisons: equality checks lengths, and ordering vs a literal
+        # of length <= lit_w is decided within the first lit_w bytes or by
+        # the length tiebreak (string_compare semantics)
+        dict_vals = plain_vals = None
+        if idx is not None:
+            dict_vals = _gather_strings(
+                blob, dst, dln, jnp.ones(dict_count, bool), lit_w)
+        if has_plain:
+            plain_vals = _gather_strings(
+                blob, pst, pln, jnp.ones(plain_ndef, bool), lit_w)
+        for li, expr in leaves:
+            pieces = []
+            if dict_vals is not None:
+                verdict = _eval_pushed_leaf(expr, dt, dict_vals[0],
+                                            dict_vals[1])
+                pieces.append(
+                    verdict[jnp.clip(idx, 0, max(dict_count - 1, 0))])
+            if plain_vals is not None:
+                pieces.append(_eval_pushed_leaf(expr, dt, plain_vals[0],
+                                                plain_vals[1]))
+            out[li] = _dense_to_rows(pieces, cap, defined)
+        return defined, out
+    dict_raw = next(it) if has_dict else None
+    idx = _read_idx_traced(it, segs)
+    plain_raw = next(it) if has_plain else None
+    dict_vals = _engine_values(colsig, dict_raw) \
+        if dict_raw is not None and idx is not None else None
+    plain_vals = _engine_values(colsig, plain_raw) if has_plain else None
+    for li, expr in leaves:
+        pieces = []
+        if dict_vals is not None:
+            verdict = _eval_pushed_leaf(expr, dt, dict_vals)
+            pieces.append(verdict[jnp.clip(idx, 0, max(dict_count - 1, 0))])
+        if plain_vals is not None:
+            pieces.append(_eval_pushed_leaf(expr, dt, plain_vals))
+        out[li] = _dense_to_rows(pieces, cap, defined)
+    return defined, out
+
+
+def _traced_string_spans(colsig, cap: int, nrows, it):
+    """Deferred string decode: per-ROW (start, len) spans + defined mask,
+    WITHOUT the byte-matrix gather — the pushdown gather program runs that
+    single big gather only over surviving rows, straight out of the packed
+    buffer. Start offsets are blob-relative; the caller adds the blob's
+    static byte offset inside the packed buffer."""
+    import jax.numpy as jnp
+    (_, has_def, has_dict, dict_count, segs, has_plain, _pn, _w) = colsig
+    if has_def:
+        runs = [next(it) for _ in range(5)]
+        defined = _expand_def_levels(*runs, cap)
+    else:
+        defined = jnp.arange(cap) < nrows
+    st_parts, ln_parts = [], []
+    if has_dict:
+        dst = next(it)
+        dln = next(it)
+        idx = _read_idx_traced(it, segs)
+        if idx is not None:
+            idxc = jnp.clip(idx, 0, max(dict_count - 1, 0))
+            st_parts.append(dst[idxc])
+            ln_parts.append(dln[idxc])
+    if has_plain:
+        st_parts.append(next(it))
+        ln_parts.append(next(it))
+    next(it)  # blob rides the packed buffer; spans index into it directly
+    if st_parts:
+        starts = st_parts[0] if len(st_parts) == 1 \
+            else jnp.concatenate(st_parts)
+        lens = ln_parts[0] if len(ln_parts) == 1 \
+            else jnp.concatenate(ln_parts)
+    else:
+        starts = jnp.zeros(0, jnp.int64)
+        lens = jnp.zeros(0, jnp.int32)
+    if starts.shape[0] < cap:
+        starts = jnp.pad(starts, (0, cap - starts.shape[0]))
+        lens = jnp.pad(lens, (0, cap - lens.shape[0]))
+    st_row, _ = _scatter_values(starts[:cap], defined)
+    ln_row, _ = _scatter_values(lens[:cap], defined)
+    return st_row, ln_row, defined
+
+
+def _comb_tree(tree, leaf_bools, defined_by):
+    if tree[0] == "and":
+        return _comb_tree(tree[1], leaf_bools, defined_by) & \
+            _comb_tree(tree[2], leaf_bools, defined_by)
+    if tree[0] == "or":
+        return _comb_tree(tree[1], leaf_bools, defined_by) | \
+            _comb_tree(tree[2], leaf_bools, defined_by)
+    if tree[0] == "leaf":
+        return leaf_bools[tree[1]]
+    if tree[0] == "isnull":  # root keep is &-ed with the live mask
+        return ~defined_by[tree[1]]
+    return defined_by[tree[1]]  # notnull
+
+
+def _null_check_cols(tree, out):
+    if tree is None:
+        return out
+    if tree[0] in ("and", "or"):
+        _null_check_cols(tree[1], out)
+        _null_check_cols(tree[2], out)
+    elif tree[0] in ("isnull", "notnull"):
+        out.add(tree[1])
+    return out
+
+
+def _string_lit_width(leaf_exprs) -> int:
+    """Matrix width sufficient for exact literal comparisons on this
+    column: the width bucket of the longest literal operand."""
+    from ..columnar.padding import width_bucket
+    from ..expr.base import Literal
+    mx = 1
+    for e in leaf_exprs:
+        for lit in e.collect(lambda x: isinstance(x, Literal)):
+            if isinstance(lit.value, str):
+                mx = max(mx, len(lit.value.encode("utf-8")))
+        for v in getattr(e, "items", ()) or ():
+            if isinstance(v, str):
+                mx = max(mx, len(v.encode("utf-8")))
+    return width_bucket(mx)
+
+
+def _pushdown_plan(dev, groups_sig, dev_names, dt_by_name):
+    """Static per-column predicate layout shared by both programs."""
+    leaves_by_col = {}
+    str_w = {}
+    for li, (cname, expr) in enumerate(dev.leaves):
+        leaves_by_col.setdefault(cname, []).append((li, expr))
+    for cname, lv in leaves_by_col.items():
+        if dt_by_name[cname] == T.STRING:
+            str_w[cname] = _string_lit_width([e for _, e in lv])
+    pred_cols = set(leaves_by_col) | _null_check_cols(dev.tree, set())
+    return leaves_by_col, pred_cols, str_w
+
+
+def _col_array_slices(colsigs, metas, dev_names, packed):
+    """Unpack the packed buffer and slice the arrays per column."""
+    arrays = [_unpack_traced(packed, m) for m in metas]
+    out = {}
+    off = 0
+    for name, cs in zip(dev_names, colsigs):
+        cnt = _colsig_array_count(cs)
+        out[name] = (cs, arrays[off:off + cnt])
+        off += cnt
+    # _colsig_array_count hand-mirrors the ship layout; drift must fail
+    # loudly here, not as wrong predicate results over shifted slices
+    assert off == len(arrays), (off, len(arrays))
+    return out
+
+
+def _pushdown_select_program(groups_sig, caps, cap_total: int, dev,
+                             dt_by_name, dev_names):
+    """Build + jit the SELECT program: evaluates the pushed predicate on
+    the compressed representation of every chunk and returns either the
+    merged selection mask + survivor count (row mode) or the pushed
+    aggregates' partial values (aggregate mode — no row data at all)."""
+    import functools as _ft
+    import jax.numpy as jnp
+    nchunks = len(groups_sig)
+    chunk_base = np.concatenate(([0], np.cumsum(caps)[:-1])).astype(np.int64)
+    leaves_by_col, pred_cols, str_w = _pushdown_plan(
+        dev, groups_sig, dev_names, dt_by_name)
+    aggs = dev.aggs
+    agg_full_cols = sorted({a.column for a in aggs
+                            if a.column is not None and a.op != "count"})
+    agg_count_cols = sorted({a.column for a in aggs
+                             if a.column is not None and a.op == "count"})
+    cap1 = row_bucket(1)
+
+    def fn(nrows_arr, packed):
+        keeps = []
+        chunk_vals = []   # per chunk: {col: (data, validity)}
+        chunk_defs = []   # per chunk: {col: defined} (count-only columns)
+        for c_i, (colsigs, metas) in enumerate(groups_sig):
+            cols = _col_array_slices(colsigs, metas, dev_names, packed)
+            defined_by = {}
+            leaf_bools = {}
+            for name in sorted(pred_cols):
+                cs, arrs = cols[name]
+                d, lb = _traced_predicate_col(
+                    cs, dt_by_name[name], caps[c_i], nrows_arr[c_i], arrs,
+                    tuple(leaves_by_col.get(name, ())),
+                    str_w.get(name, 1))
+                defined_by[name] = d
+                leaf_bools.update(lb)
+            live = jnp.arange(caps[c_i]) < nrows_arr[c_i]
+            if dev.tree is not None:
+                keep = _comb_tree(dev.tree, leaf_bools, defined_by) & live
+            else:
+                keep = live
+            keeps.append(keep)
+            if aggs:
+                vals = {}
+                for name in agg_full_cols:
+                    cs, arrs = cols[name]
+                    data, validity, _ = _traced_decode_col(
+                        cs, caps[c_i], nrows_arr[c_i], iter(arrs))
+                    vals[name] = (data, validity)
+                defs = {}
+                for name in agg_count_cols:
+                    if name in defined_by:
+                        defs[name] = defined_by[name]
+                    else:
+                        cs, arrs = cols[name]
+                        d, _ = _traced_predicate_col(
+                            cs, dt_by_name[name], caps[c_i],
+                            nrows_arr[c_i], arrs, ())
+                        defs[name] = d
+                chunk_vals.append(vals)
+                chunk_defs.append(defs)
+        kept_total = _ft.reduce(
+            lambda a, b: a + b,
+            [jnp.sum(k).astype(jnp.int64) for k in keeps])
+        if aggs:
+            outs = []
+            for a in aggs:
+                if a.op == "count":
+                    if a.column is None:
+                        val = kept_total
+                    else:
+                        val = _ft.reduce(lambda x, y: x + y, [
+                            jnp.sum(k & d[a.column]).astype(jnp.int64)
+                            for k, d in zip(keeps, chunk_defs)])
+                    data = jnp.zeros(cap1, jnp.int64).at[0].set(val)
+                    valid = jnp.zeros(cap1, bool).at[0].set(True)
+                    outs.append((data, valid))
+                    continue
+                npdt = dt_by_name[a.column].np_dtype
+                parts, anys = [], []
+                for k, v in zip(keeps, chunk_vals):
+                    data, validity = v[a.column]
+                    m = k & validity
+                    anys.append(jnp.any(m))
+                    if a.op == "sum":
+                        parts.append(jnp.sum(
+                            jnp.where(m, data.astype(jnp.int64), 0)))
+                    else:
+                        from ..plan.scan_pushdown import _minmax_sentinel
+                        sent = jnp.asarray(
+                            _minmax_sentinel(npdt, a.op), npdt)
+                        masked = jnp.where(m, data, sent)
+                        parts.append(jnp.min(masked) if a.op == "min"
+                                     else jnp.max(masked))
+                if a.op == "sum":
+                    val = _ft.reduce(lambda x, y: x + y, parts)
+                    out_dt = np.dtype(np.int64)
+                elif a.op == "min":
+                    val = _ft.reduce(jnp.minimum, parts)
+                    out_dt = npdt
+                else:
+                    val = _ft.reduce(jnp.maximum, parts)
+                    out_dt = npdt
+                anyv = _ft.reduce(lambda x, y: x | y, anys)
+                data = jnp.zeros(cap1, out_dt).at[0].set(val.astype(out_dt))
+                valid = jnp.zeros(cap1, bool).at[0].set(anyv)
+                outs.append((data, valid))
+            return kept_total, tuple(outs)
+        cum = jnp.cumsum(nrows_arr)
+        total = cum[-1]
+        j = jnp.arange(cap_total, dtype=jnp.int64)
+        c_of = jnp.clip(jnp.searchsorted(cum, j, side="right"),
+                        0, nchunks - 1)
+        base = jnp.where(c_of > 0, cum[jnp.maximum(c_of - 1, 0)], 0)
+        src = jnp.asarray(chunk_base)[c_of] + (j - base)
+        keep_cat = keeps[0] if nchunks == 1 else jnp.concatenate(keeps)
+        keep_g = keep_cat[jnp.clip(src, 0, keep_cat.shape[0] - 1)] & \
+            (j < total)
+        return keep_g, kept_total
+
+    from ..compile import sjit
+    return sjit(fn, op="io.parquet.pushdown_select",
+                key=repr((groups_sig, tuple(caps), cap_total, dev.key)))
+
+
+def _pushdown_gather_program(groups_sig, caps, cap_total: int, out_cap: int,
+                             dev, dt_by_name, dev_names, blob_offs):
+    """Build + jit the GATHER program: late-materialise ONLY surviving
+    rows of the projected columns at the survivor-count capacity bucket.
+    Prim/FLBA columns decode per chunk and gather through the selection;
+    string columns defer the byte-matrix gather until after selection and
+    read value spans straight out of the packed buffer — the dominant
+    byte cost scales with survivors, not scanned rows."""
+    import jax.numpy as jnp
+    nchunks = len(groups_sig)
+    chunk_base = np.concatenate(([0], np.cumsum(caps)[:-1])).astype(np.int64)
+    out_cols = dev.columns
+    need = sorted({s for _, s in out_cols})
+    str_cols = {n for n in need if dt_by_name[n] == T.STRING}
+    str_width = {}
+    for n in str_cols:
+        ci = dev_names.index(n)
+        str_width[n] = max(cs[ci][-1] for cs, _ in groups_sig)
+
+    def fn(nrows_arr, packed, keep):
+        count = jnp.sum(keep)
+        sel = jnp.nonzero(keep, size=out_cap, fill_value=0)[0]
+        live_out = jnp.arange(out_cap) < count
+        cum = jnp.cumsum(nrows_arr)
+        c_of = jnp.clip(jnp.searchsorted(cum, sel, side="right"),
+                        0, nchunks - 1)
+        base = jnp.where(c_of > 0, cum[jnp.maximum(c_of - 1, 0)], 0)
+        src_row = jnp.asarray(chunk_base)[c_of] + (sel - base)
+        per_src = {n: [] for n in need}
+        for c_i, (colsigs, metas) in enumerate(groups_sig):
+            cols = _col_array_slices(colsigs, metas, dev_names, packed)
+            for name in need:
+                cs, arrs = cols[name]
+                if name in str_cols:
+                    st, ln, d = _traced_string_spans(
+                        cs, caps[c_i], nrows_arr[c_i], iter(arrs))
+                    per_src[name].append(
+                        (st + blob_offs[(c_i, name)], ln, d))
+                else:
+                    data, validity, _ = _traced_decode_col(
+                        cs, caps[c_i], nrows_arr[c_i], iter(arrs))
+                    per_src[name].append((data, validity))
+        merged = {}
+        for name in need:
+            parts = per_src[name]
+            if name in str_cols:
+                st = jnp.concatenate([p[0] for p in parts]) \
+                    if nchunks > 1 else parts[0][0]
+                ln = jnp.concatenate([p[1] for p in parts]) \
+                    if nchunks > 1 else parts[0][1]
+                d = jnp.concatenate([p[2] for p in parts]) \
+                    if nchunks > 1 else parts[0][2]
+                gsrc = jnp.clip(src_row, 0, st.shape[0] - 1)
+                v = d[gsrc] & live_out
+                mat, lengths = _string_matrix_tail(
+                    packed, st[gsrc], ln[gsrc], v, str_width[name])
+                merged[name] = (mat, v, lengths)
+            else:
+                datas = [p[0] for p in parts]
+                valids = [p[1] for p in parts]
+                if datas[0].ndim == 2:
+                    w = max(dd.shape[1] for dd in datas)
+                    datas = [jnp.pad(dd, ((0, 0), (0, w - dd.shape[1])))
+                             if dd.shape[1] < w else dd for dd in datas]
+                data = jnp.concatenate(datas) if nchunks > 1 else datas[0]
+                valid = jnp.concatenate(valids) if nchunks > 1 else valids[0]
+                gsrc = jnp.clip(src_row, 0, data.shape[0] - 1)
+                merged[name] = (data[gsrc], valid[gsrc] & live_out, None)
+        return tuple(merged[s] for _, s in out_cols)
+
+    from ..compile import sjit
+    return sjit(fn, op="io.parquet.pushdown_gather",
+                key=repr((groups_sig, tuple(caps), cap_total, out_cap,
+                          dev.key)))
+
+
+def decode_row_groups_pushdown(pf, f, rgs, schema, host_cols, dev):
+    """Pushdown-aware dispatch-group decode. `schema` is the scan's RAW
+    column schema; `dev` a plan.scan_pushdown.DevicePushdown. Evaluates
+    the pushed predicate on the compressed representation and emits only
+    surviving rows of the projected columns (or aggregate partials) when
+    the whole group is fast-path eligible; otherwise decodes the group
+    fully (reusing the host phase) and applies the exact batch applier —
+    never a silently different result. Returns a list of
+    (batch, out_rows, in_rows, rows_kept, bytes_materialized); malformed
+    groups raise DeviceDecodeUnsupported for the caller's per-row-group
+    net."""
+    import jax
+    import jax.numpy as jnp
+    from ..columnar.batch import ColumnarBatch
+    from ..columnar.column import Column
+    from ..utils.metrics import TaskMetrics
+    chunks, total = _read_chunks(pf, f, rgs, schema, host_cols)
+    host_set = set(host_cols or ())
+    dev_names = [n for n in schema.names if n not in host_set]
+    sig = None
+    tried_sig = not host_set and dev.pred_device_ok and bool(dev_names) \
+        and total > 0
+    if tried_sig:
+        sig = _group_signatures(chunks, dev_names)
+    if sig is None:
+        return _pushdown_degrade(pf, rgs, schema, chunks, total,
+                                 host_cols, dev, sig_declined=tried_sig)
+    groups_sig, caps, packed, blob_offs = sig
+    cap_total = row_bucket(total, op="scan.parquet")
+    dt_by_name = dict(zip(schema.names, schema.types))
+    nrows_arr = np.asarray([n for _, _, n in chunks], np.int64)
+    packed_dev = jax.device_put(packed)
+    select = _pushdown_select_program(groups_sig, tuple(caps), cap_total,
+                                      dev, dt_by_name, tuple(dev_names))
+    TaskMetrics.get().scan_chunks += len(rgs)
+    if dev.aggs:
+        kept, agg_outs = select(nrows_arr, packed_dev)
+        _note_dispatches(3)  # nrows + packed buffers + select program
+        cols = [Column(dt, data, valid) for (data, valid), dt in
+                zip(agg_outs, dev.out_schema.types)]
+        batch = ColumnarBatch(dev.out_schema, tuple(cols),
+                              jnp.asarray(1, jnp.int32))
+        return [(batch, 1, total, int(kept), 0)]
+    keep, kept = select(nrows_arr, packed_dev)
+    kept_i = int(kept)
+    out_cap = row_bucket(max(kept_i, 1), op="scan.parquet")
+    gather = _pushdown_gather_program(groups_sig, tuple(caps), cap_total,
+                                      out_cap, dev, dt_by_name,
+                                      tuple(dev_names), blob_offs)
+    outs = gather(nrows_arr, packed_dev, keep)
+    _note_dispatches(4)  # 2 buffers + select + gather programs
+    cols = []
+    for (data, valid, lengths), dt in zip(outs, dev.out_schema.types):
+        cols.append(Column(dt, data, valid, lengths))
+    batch = ColumnarBatch(dev.out_schema, tuple(cols),
+                          jnp.asarray(kept_i, jnp.int32))
+    return [(batch, kept_i, total, kept_i,
+             int(batch.device_memory_size()))]
+
+
+def _pushdown_degrade(pf, rgs, schema, chunks, total, host_cols, dev,
+                      sig_declined=False):
+    """Full decode (fused or per-row-group, reusing the host phase) + the
+    exact batch applier — the pushed contract holds on every path.
+    `sig_declined` means the caller already computed _group_signatures and
+    got a decline: go straight to per-row-group decode rather than having
+    _decode_chunks_fused redo the signature pass to learn the same
+    answer."""
+    if sig_declined:
+        inner = _per_rg_batches(pf, schema, chunks, host_cols)
+    else:
+        inner = _decode_chunks_fused(pf, rgs, schema, chunks, total,
+                                     host_cols)
+    outs = []
+    for b, nrows in inner:
+        in_bytes = int(b.device_memory_size())
+        ob, kept = dev.applier.apply(b)
+        out_rows = 1 if dev.aggs else kept
+        outs.append((ob, out_rows, nrows, kept, in_bytes))
+    return outs
 
 
 def device_decode_file(pf, path: str, schema, host_cols=None,

@@ -161,6 +161,13 @@ from ..exec.base import TpuExec as _TpuExec  # noqa: E402
 class TpuFileScanExec(_TpuExec):
     """Device exec over a file scan (GpuFileSourceScanExec analog)."""
 
+    # Pushed-down predicate/projection/aggregates, set by
+    # plan/scan_pushdown.install_pushdown. CLASS attribute: un-pushed
+    # scans carry zero extra state and unchanged fingerprints; a pushed
+    # scan's instance attribute renders its param-faithful repr into the
+    # rescache/fleet fingerprint and every pushdown program key.
+    pushed = None
+
     def __init__(self, plan: CpuFileScanExec, conf: TpuConf):
         super().__init__([], conf)
         self.cpu_scan = plan
@@ -179,11 +186,76 @@ class TpuFileScanExec(_TpuExec):
 
     @property
     def output(self) -> Schema:
+        if self.pushed is not None:
+            return self._pushed_schema
         return self.cpu_scan.output
 
     @property
     def name(self):
         return f"TpuFileScanExec({self.cpu_scan.format_name})"
+
+    # -- scan pushdown (plan/scan_pushdown.py) -----------------------------
+    def _pushdown_applier(self):
+        """Exact batch-level applier, built lazily once per scan."""
+        ap = getattr(self, "_pd_applier", None)
+        if ap is None:
+            from ..plan.scan_pushdown import PushdownApplier
+            ap = PushdownApplier(self.cpu_scan.output, self.pushed,
+                                 self.conf)
+            self._pd_applier = ap
+        return ap
+
+    def _device_pushdown(self):
+        """Device-form spec for the parquet compressed-domain decode."""
+        if self.pushed is None:
+            return None
+        dev = getattr(self, "_pd_device", None)
+        if dev is None:
+            from ..plan.scan_pushdown import DevicePushdown
+            dev = DevicePushdown(self.pushed, self.cpu_scan.output,
+                                 self._pushdown_applier())
+            self._pd_device = dev
+        return dev
+
+    def _pd_record(self, in_rows: int, kept: int, bytes_mat: int) -> None:
+        """Per-unit pushdown accounting: rows pruned before downstream
+        operators, and ROW DATA bytes the decode actually materialized
+        on device (the machine-independent proxy for the decode-path
+        win)."""
+        from .. import telemetry
+        from ..utils.metrics import TaskMetrics
+        tm = TaskMetrics.get()
+        pruned = max(in_rows - kept, 0)
+        self.rows_pruned.add(pruned)
+        self.bytes_materialized.add(bytes_mat)
+        tm.scan_rows_pruned += pruned
+        tm.scan_bytes_materialized += bytes_mat
+        if pruned:
+            telemetry.inc("tpu_scan_pushdown_rows_pruned_total", pruned)
+
+    def _apply_pushdown(self, batch, in_rows: int):
+        """Exact fallback for any decode path that could not evaluate on
+        the compressed form: the fully materialized batch is counted,
+        then filtered/projected/aggregated with the engine's own kernels.
+        Returns (pushed-output batch, output row count)."""
+        bytes_mat = int(batch.device_memory_size())
+        out, kept = self._pushdown_applier().apply(batch)
+        self._pd_record(in_rows, kept, bytes_mat)
+        return out, (1 if self.pushed.aggs else kept)
+
+    def _agg_partial_guard(self, it):
+        """Aggregate-mode scans must emit at least one partial row even
+        when no decode unit produced one (empty file, every row group
+        pruned): counts 0 (valid), min/max/sum null — the merged
+        aggregate then matches the un-pushed plan's empty-input answer."""
+        any_out = False
+        for b in it:
+            any_out = True
+            yield b
+        if not any_out:
+            b = self._pushdown_applier().empty_partials()
+            self.num_output_rows.add(1)
+            yield self._count_output(b)
 
     def _effective_paths(self):
         """Apply ready dynamic filters to the file list (parquet footers);
@@ -226,8 +298,10 @@ class TpuFileScanExec(_TpuExec):
         from ..exec.base import maybe_prefetch
         from ..utils import spans
         fmt = self.cpu_scan.format_name
-        it = maybe_prefetch(self._decode_batches(), self.conf,
-                            name=f"scan-{fmt}")
+        inner = self._decode_batches()
+        if self.pushed is not None and self.pushed.aggs:
+            inner = self._agg_partial_guard(inner)
+        it = maybe_prefetch(inner, self.conf, name=f"scan-{fmt}")
         live = spans.current_profile() is not None
         while True:
             with self.read_time.timed(), \
@@ -278,7 +352,11 @@ class TpuFileScanExec(_TpuExec):
                 return
         for t in self.cpu_scan.host_tables(self._effective_paths()):
             b = batch_from_arrow(t)
-            self.num_output_rows.add(t.num_rows)
+            if self.pushed is not None:
+                b, n = self._apply_pushdown(b, t.num_rows)
+            else:
+                n = t.num_rows
+            self.num_output_rows.add(n)
             yield self._count_output(b)
 
     def _text_device_batches(self, decode_file):
@@ -286,11 +364,14 @@ class TpuFileScanExec(_TpuExec):
         host fallback: every fallback condition validates before the
         generator's FIRST yield, so pulling one chunk decides the path and
         the rest stream one batch at a time (no whole-file
-        materialization, no double-yield)."""
+        materialization, no double-yield). With a pushed spec the decoder
+        applies mask-based late materialization per chunk (the `pushed`
+        seam); host fallbacks apply the same spec post-decode."""
         from .parquet_device import DeviceDecodeUnsupported
         scan = self.cpu_scan
+        pushed_cb = self._apply_pushdown if self.pushed is not None else None
         for path in scan.paths:
-            gen = decode_file(scan, path)
+            gen = decode_file(scan, path, pushed=pushed_cb)
             try:
                 first = next(gen, None)
             except (DeviceDecodeUnsupported, OSError):
@@ -309,13 +390,19 @@ class TpuFileScanExec(_TpuExec):
 
     def _host_file_batches(self, path: str):
         """Host decode of ONE file through FileBatchIterator so batchSizeRows
-        slicing still applies (a multi-GB file must not become one batch)."""
+        slicing still applies (a multi-GB file must not become one batch).
+        Applies the pushed spec (exact batch applier) when present."""
         from ..columnar.batch import batch_from_arrow
         scan = self.cpu_scan
         for t in FileBatchIterator([path], scan.decode_file, scan.conf,
                                    format_name=scan.format_name):
             t = scan._postprocess(t)
-            yield batch_from_arrow(t), t.num_rows
+            b = batch_from_arrow(t)
+            if self.pushed is not None:
+                b, n = self._apply_pushdown(b, t.num_rows)
+            else:
+                n = t.num_rows
+            yield b, n
 
     def _orc_batches(self):
         """Device decode per STRIPE with per-COLUMN and per-stripe host
@@ -329,6 +416,7 @@ class TpuFileScanExec(_TpuExec):
         from .orc_device import (DeviceDecodeUnsupported, columns_supported,
                                  decode_stripe)
         scan = self.cpu_scan
+        pushed_cb = self._apply_pushdown if self.pushed is not None else None
         for path in scan.paths:
             try:
                 info, bad = columns_supported(path, scan.output)
@@ -347,7 +435,8 @@ class TpuFileScanExec(_TpuExec):
                 for si in range(len(info.stripes)):
                     try:
                         b, nrows = decode_stripe(info, f, si, scan.output,
-                                                 host_cols=bad)
+                                                 host_cols=bad,
+                                                 pushed=pushed_cb)
                     except (DeviceDecodeUnsupported, OSError,
                             struct_error):
                         if ofile is None:
@@ -356,6 +445,8 @@ class TpuFileScanExec(_TpuExec):
                             [ofile.read_stripe(
                                 si, columns=list(scan.output.names))]))
                         b, nrows = batch_from_arrow(t), t.num_rows
+                        if pushed_cb is not None:
+                            b, nrows = pushed_cb(b, nrows)
                     self.num_output_rows.add(nrows)
                     yield self._count_output(b)
 
@@ -416,7 +507,11 @@ class TpuFileScanExec(_TpuExec):
             # COALESCING / MULTITHREADED multi-file strategies
             for t in scan.host_tables(paths):
                 b = batch_from_arrow(t)
-                self.num_output_rows.add(t.num_rows)
+                if self.pushed is not None:
+                    b, n = self._apply_pushdown(b, t.num_rows)
+                else:
+                    n = t.num_rows
+                self.num_output_rows.add(n)
                 yield self._count_output(b)
             return
         from .dynamic_pruning import row_group_filter
@@ -438,12 +533,41 @@ class TpuFileScanExec(_TpuExec):
                     if self.dynamic_filters else None
                 rgs = [rg for rg in range(meta.num_row_groups)
                        if keep_rgs is None or rg in keep_rgs]
+                rgs = self._pushdown_prune_rgs(meta, rgs)
                 yield from self._decode_rgs_pipelined(
                     pf, path, rgs, supported[path], scan, scan_names)
             finally:
                 close = getattr(pf, "close", None)
                 if close is not None:
                     close()
+
+    def _pushdown_prune_rgs(self, meta, rgs):
+        """Device-path row-group pruning: drop whole row groups the pushed
+        predicate PROVABLY eliminates via footer min/max/null-count stats,
+        before any page bytes are read (the host pyarrow path has had this
+        via filters= all along; this closes the gap for the device
+        decode). Conservative by construction — see
+        plan/scan_pushdown.prune_row_groups."""
+        if self.pushed is None or self.pushed.predicate is None or \
+                not rgs or not self.conf.get(
+                    "spark.rapids.tpu.scan.pushdown.rowgroup.enabled"):
+            return rgs
+        from .. import telemetry
+        from ..plan.scan_pushdown import prune_row_groups
+        from ..utils.metrics import TaskMetrics
+        from .dynamic_pruning import schema_col_index
+        dead = prune_row_groups(meta, schema_col_index(meta),
+                                self.cpu_scan.output,
+                                self.pushed.predicate)
+        if not dead:
+            return rgs
+        kept = [rg for rg in rgs if rg not in dead]
+        n = len(rgs) - len(kept)
+        if n:
+            self.rowgroups_pruned.add(n)
+            TaskMetrics.get().scan_rowgroups_pruned += n
+            telemetry.inc("tpu_scan_rowgroups_pruned_total", n)
+        return kept
 
     def _decode_rgs_pipelined(self, pf, path, rgs, host_cols, scan,
                               scan_names):
@@ -470,12 +594,35 @@ class TpuFileScanExec(_TpuExec):
                                                     columns=scan_names))
             return batch_from_arrow(t), t.num_rows
 
+        dev = self._device_pushdown()
         with open(path, "rb") as f:
             i = 0
             while i < len(rgs):
                 chunk_rgs = rgs[i:i + group]
                 i += len(chunk_rgs)
-                if len(chunk_rgs) > 1:
+                if dev is not None:
+                    # compute on compressed data: predicate on dictionary
+                    # values / RLE indices inside the decode dispatch,
+                    # survivors-only late materialisation (or aggregate
+                    # partials with no row data at all); any decline
+                    # degrades to full decode + the exact batch applier
+                    # inside decode_row_groups_pushdown itself — the
+                    # except net here is only for malformed row groups
+                    from .parquet_device import decode_row_groups_pushdown
+                    try:
+                        outs = decode_row_groups_pushdown(
+                            pf, f, chunk_rgs, scan.output, host_cols, dev)
+                    except (DeviceDecodeUnsupported, OSError,
+                            struct_error):
+                        pass  # per-row-group decode below
+                    else:
+                        for b, out_rows, in_rows, kept, bytes_mat in outs:
+                            tm.scan_batches += 1
+                            self._pd_record(in_rows, kept, bytes_mat)
+                            self.num_output_rows.add(out_rows)
+                            yield self._count_output(b)
+                        continue
+                elif len(chunk_rgs) > 1:
                     try:
                         outs = decode_row_groups_fused(
                             pf, f, chunk_rgs, scan.output, host_cols)
@@ -498,6 +645,8 @@ class TpuFileScanExec(_TpuExec):
                     except (DeviceDecodeUnsupported, OSError,
                             struct_error):
                         b, nrows = host_fallback(rg)
+                    if dev is not None:
+                        b, nrows = self._apply_pushdown(b, nrows)
                     self.num_output_rows.add(nrows)
                     yield self._count_output(b)
 

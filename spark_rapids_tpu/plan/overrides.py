@@ -1166,6 +1166,11 @@ class Overrides:
                 self.explain_log.extend(lines)
         if self.conf.get("spark.rapids.sql.mode") == "explainOnly":
             return plan
+        # scan pushdown (plan/scan_pushdown.py): fold supported
+        # filter/project/aggregate chains into the file scans they sit on.
+        # Off (default) this is one conf read returning the tree untouched.
+        from .scan_pushdown import apply_scan_pushdown
+        result = apply_scan_pushdown(result, self.conf)
         from ..exec.base import TpuExec
         if isinstance(result, TpuExec):
             from ..exec.requirements import ensure_distribution

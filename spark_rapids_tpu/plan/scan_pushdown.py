@@ -1,0 +1,712 @@
+"""Scan pushdown: compute on compressed data (ROADMAP item 1).
+
+Planner pass over the CONVERTED device plan that recognises
+`TpuFileScanExec -> TpuFilterExec (-> TpuProjectExec -> TpuHashAggregateExec)`
+chains whose predicates / projections / aggregates are pushdown-supported
+and rewrites the scan to carry them, following "GPU Acceleration of SQL
+Analytics on Compressed Data" (arxiv 2506.10092) and "Data Path Fusion in
+GPU for Analytical Query Processing" (arxiv 2605.10511):
+
+  * supported filter conjuncts (comparison / IN / null-check leaves under
+    AND/OR over scan columns vs literals) move into the scan, where the
+    device parquet decode evaluates them directly on dictionary values and
+    RLE-expanded indices and late-materialises only surviving rows
+    (io/parquet_device.py `decode_row_groups_pushdown`); unsupported
+    conjuncts stay behind in a residual TpuFilterExec;
+  * a pure-pruning projection (attributes / aliased attributes) collapses
+    into the scan's output mapping, so predicate-only columns are never
+    materialised at all;
+  * global (non-grouped) count/min/max/sum aggregates over scan columns
+    rewrite to per-dispatch PARTIAL values computed inside the decode
+    (aggregate-only queries materialise zero row data) merged by a
+    rewritten upstream aggregate — restricted to exactly-mergeable shapes
+    (integral sums; integral/date/timestamp/boolean min/max; any count),
+    and disabled under ANSI (partial integer sums wrap, ANSI must raise).
+
+Every decode path that cannot evaluate on the compressed form (host
+pyarrow fallback, per-row-group degrade, ORC stripes, CSV/JSON/hive text)
+applies the SAME predicate/projection/aggregation exactly on the decoded
+batch via `PushdownApplier` before emitting — the engine's own expression
+kernels evaluate the pushed tree, so results are identical by
+construction and a fallback can never be silently wrong.
+
+Fingerprint/compile-key discipline: the pushed spec is an instance
+attribute (`TpuFileScanExec.pushed`) with a param-faithful dataclass repr,
+so rescache/fleet scan fingerprints and every compiled-program key derived
+from it distinguish two scans that differ only in their pushed predicate;
+with pushdown off the attribute is never set (class default None) and
+plans, fingerprints and state are byte-identical to the pre-pushdown
+engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import types as T
+from ..columnar.batch import Schema
+from ..expr import base as EB
+from ..expr import nullexprs as EN
+from ..expr import predicates as EP
+
+__all__ = ["ScanPushdown", "PushedAgg", "PushdownApplier", "DevicePushdown",
+           "apply_scan_pushdown", "prune_row_groups"]
+
+KEY_ENABLED = "spark.rapids.tpu.scan.pushdown.enabled"
+KEY_AGG = "spark.rapids.tpu.scan.pushdown.aggregate.enabled"
+KEY_ROWGROUP = "spark.rapids.tpu.scan.pushdown.rowgroup.enabled"
+
+
+@dataclasses.dataclass(frozen=True)
+class PushedAgg:
+    """One pushed global aggregate: op in count/min/max/sum, column None
+    for count(*), name = the partial column's name in the scan output."""
+    op: str
+    column: Optional[str]
+    name: str
+
+
+@dataclasses.dataclass
+class ScanPushdown:
+    """What the planner pushed into one file scan. `predicate` is over the
+    scan's RAW column names (unbound AttributeReferences); `columns` maps
+    (output name, source column) for a pushed projection (None = raw
+    schema); `aggs` non-empty turns the scan into a partial-aggregate
+    producer (one row per decode unit, no row data). The dataclass repr is
+    param-faithful — it joins the scan's rescache fingerprint and every
+    pushdown program/kernel key."""
+    predicate: Optional[EB.Expression]
+    columns: Optional[Tuple[Tuple[str, str], ...]] = None
+    aggs: Tuple[PushedAgg, ...] = ()
+
+    def output_schema(self, scan_schema: Schema) -> Schema:
+        if self.aggs:
+            names, tps = [], []
+            for a in self.aggs:
+                names.append(a.name)
+                tps.append(_partial_type(a, scan_schema))
+            return Schema(tuple(names), tuple(tps))
+        if self.columns is not None:
+            names = tuple(o for o, _ in self.columns)
+            tps = tuple(scan_schema.types[scan_schema.index_of(s)]
+                        for _, s in self.columns)
+            return Schema(names, tps)
+        return scan_schema
+
+
+def _partial_type(a: PushedAgg, schema: Schema) -> T.DataType:
+    if a.op == "count":
+        return T.LONG
+    src = schema.types[schema.index_of(a.column)]
+    if a.op == "sum":
+        return T.LONG  # integral-only sums; Sum(integral) widens to LONG
+    return src  # min/max preserve the column type
+
+
+# ---------------------------------------------------------------------------
+# predicate grammar
+# ---------------------------------------------------------------------------
+
+_CMP_CLASSES = (EP.EqualTo, EP.LessThan, EP.LessThanOrEqual, EP.GreaterThan,
+                EP.GreaterThanOrEqual, EP.EqualNullSafe)
+
+
+def split_conjuncts(e: EB.Expression) -> List[EB.Expression]:
+    if isinstance(e, EP.And):
+        return split_conjuncts(e.children[0]) + split_conjuncts(e.children[1])
+    return [e]
+
+
+def _and_combine(conjs: Sequence[EB.Expression]) -> EB.Expression:
+    out = conjs[0]
+    for c in conjs[1:]:
+        out = EP.And(out, c)
+    return out
+
+
+def _leaf_column(e: EB.Expression, schema: Schema) -> Optional[str]:
+    """The scan column a supported leaf tests, or None if unsupported."""
+    if isinstance(e, (EN.IsNull, EN.IsNotNull)):
+        c = e.children[0]
+        if isinstance(c, EB.AttributeReference) and c.col_name in schema.names:
+            return c.col_name
+        return None
+    if isinstance(e, EP.In):
+        c = e.children[0]
+        if not (isinstance(c, EB.AttributeReference)
+                and c.col_name in schema.names):
+            return None
+        if not all(i is None or isinstance(i, (bool, int, float, str))
+                   or type(i).__name__ == "Decimal" for i in e.items):
+            return None
+        return c.col_name
+    if isinstance(e, _CMP_CLASSES):
+        l, r = e.children
+        attr, lit = (l, r) if isinstance(l, EB.AttributeReference) else (r, l)
+        if not (isinstance(attr, EB.AttributeReference)
+                and isinstance(lit, EB.Literal)
+                and attr.col_name in schema.names):
+            return None
+        if lit.value is None:
+            # a null-literal comparison is constant-null (never true as a
+            # filter) and `<=> null` has row-level truth the compressed
+            # path cannot express — leave both to the engine
+            return None
+        return attr.col_name
+    return None
+
+
+def _pushable_pred(e: EB.Expression, schema: Schema) -> bool:
+    """True when the whole subtree is within the pushdown grammar over
+    non-nested scan columns — the engine applier can evaluate it exactly,
+    and the device decode can either evaluate it on the compressed form or
+    fall back to the applier."""
+    if isinstance(e, (EP.And, EP.Or)):
+        return _pushable_pred(e.children[0], schema) and \
+            _pushable_pred(e.children[1], schema)
+    col = _leaf_column(e, schema)
+    if col is None:
+        return False
+    dt = schema.types[schema.index_of(col)]
+    return not getattr(dt, "is_nested", False)
+
+
+def _remap_attrs(e: EB.Expression, mapping) -> EB.Expression:
+    """Rename AttributeReferences through a pushed projection's
+    (out, src) mapping — a filter above a collapsed project references the
+    project's output names, the scan predicate needs source names."""
+    by_out = {}
+    for o, s in mapping:
+        by_out.setdefault(o, s)  # duplicate outputs: first wins, like index_of
+
+    def fn(node):
+        if isinstance(node, EB.AttributeReference) and \
+                node.col_name in by_out:
+            return EB.AttributeReference(by_out[node.col_name],
+                                         node._dtype, node._nullable)
+        return node
+
+    return e.transform_up(fn)
+
+
+# ---------------------------------------------------------------------------
+# planner pass
+# ---------------------------------------------------------------------------
+
+
+def apply_scan_pushdown(root, conf):
+    """Entry point, hooked into Overrides.apply after conversion. Off
+    (default) this is one conf read returning the tree untouched — the
+    CI-gated byte-identical contract."""
+    if root is None or not conf.get(KEY_ENABLED):
+        return root
+    return _walk(root, conf)
+
+
+def _walk(node, conf):
+    from ..exec.transitions import CpuFromTpuExec
+    if isinstance(node, CpuFromTpuExec):
+        node.tpu_exec = _walk(node.tpu_exec, conf)
+        return node
+    inner = getattr(node, "cpu_plan", None)
+    if inner is not None:  # TpuFromCpuExec bridge: CPU subtree may nest
+        node.cpu_plan = _walk(inner, conf)
+    kids = getattr(node, "children", None)
+    if kids:
+        node.children = [_walk(c, conf) for c in kids]
+    from ..exec.aggregate import TpuHashAggregateExec
+    from ..exec.basic import TpuFilterExec, TpuProjectExec
+    if isinstance(node, TpuFilterExec):
+        out = _try_filter_pushdown(node, conf)
+        if out is not None:
+            return out
+    elif isinstance(node, TpuProjectExec):
+        out = _try_project_pushdown(node, conf)
+        if out is not None:
+            return out
+    elif isinstance(node, TpuHashAggregateExec):
+        out = _try_agg_pushdown(node, conf)
+        if out is not None:
+            return out
+    return node
+
+
+def _file_scan(node):
+    from ..io.scanbase import TpuFileScanExec
+    return node if isinstance(node, TpuFileScanExec) else None
+
+
+def install_pushdown(scan, spec: ScanPushdown) -> None:
+    """Attach a (new) pushed spec to a scan. The spec becomes an INSTANCE
+    attribute (the class default is None), so un-pushed scans carry zero
+    new state and their fingerprints are unchanged; pushed scans render
+    the spec's param-faithful repr into theirs."""
+    from ..utils import metrics as M
+    scan.pushed = spec
+    scan._pushed_schema = spec.output_schema(scan.cpu_scan.output)
+    scan._pd_applier = None
+    scan._pd_device = None
+    if not hasattr(scan, "rows_pruned"):
+        scan.rows_pruned = scan.metrics.create("rowsPruned", M.MODERATE)
+        scan.bytes_materialized = scan.metrics.create("bytesMaterialized",
+                                                      M.MODERATE)
+        scan.rowgroups_pruned = scan.metrics.create("rowgroupsPruned",
+                                                    M.MODERATE)
+
+
+def _try_filter_pushdown(f, conf):
+    scan = _file_scan(f.children[0])
+    if scan is None:
+        return None
+    cur = scan.pushed
+    if cur is not None and cur.aggs:
+        return None
+    raw = scan.cpu_scan.output
+    conjs = split_conjuncts(f.condition)
+    # a collapsed projection renamed the scan output: pushed predicates
+    # run pre-projection, so conjuncts remap to SOURCE names before the
+    # grammar check; residual conjuncts stay in their ORIGINAL form (they
+    # re-bind against the scan's projected output, which is the schema the
+    # filter was bound to)
+    if cur is not None and cur.columns is not None:
+        remapped = [_remap_attrs(c, cur.columns) for c in conjs]
+    else:
+        remapped = conjs
+    push = [rc for rc in remapped if _pushable_pred(rc, raw)]
+    if not push:
+        return None
+    residual = [c for c, rc in zip(conjs, remapped)
+                if not _pushable_pred(rc, raw)]
+    pred = _and_combine(push)
+    if cur is not None and cur.predicate is not None:
+        pred = EP.And(cur.predicate, pred)
+    cols = cur.columns if cur is not None else None
+    install_pushdown(scan, ScanPushdown(pred, cols))
+    if residual:
+        from ..exec.basic import TpuFilterExec
+        return TpuFilterExec(_and_combine(residual), scan, f.conf)
+    return scan
+
+
+def _try_project_pushdown(p, conf):
+    scan = _file_scan(p.children[0])
+    if scan is None:
+        return None
+    cur = scan.pushed
+    if cur is not None and (cur.columns is not None or cur.aggs):
+        return None
+    raw = scan.cpu_scan.output
+    mapping = []
+    for e in p.exprs:
+        src = e.children[0] if isinstance(e, EB.Alias) else e
+        if not isinstance(src, EB.AttributeReference):
+            return None
+        if src.col_name not in raw.names:
+            return None
+        mapping.append((EB.output_name(e, src.col_name), src.col_name))
+    pred = cur.predicate if cur is not None else None
+    install_pushdown(scan, ScanPushdown(pred, tuple(mapping)))
+    return scan
+
+
+_AGG_MINMAX_OK = (T.IntegralType, T.BooleanType, T.DateType, T.TimestampType)
+
+
+def _try_agg_pushdown(agg, conf):
+    from ..expr.aggregates import Count, Max, Min, Sum
+    if agg.mode != "complete" or agg.group_exprs:
+        return None
+    if not conf.get(KEY_AGG) or conf.is_ansi:
+        return None
+    scan = _file_scan(agg.children[0])
+    if scan is None:
+        return None
+    cur = scan.pushed
+    if cur is not None and cur.aggs:
+        return None
+    raw = scan.cpu_scan.output
+    out_names = scan.output.names  # pushed output (post-projection) names
+    mapping = None
+    if cur is not None and cur.columns is not None:
+        mapping = {}
+        for o, s in cur.columns:
+            mapping.setdefault(o, s)  # duplicate outs: first wins (index_of)
+    pushed_aggs: List[PushedAgg] = []
+    for i, a in enumerate(agg.aggs):
+        fn = a.func
+        if type(fn) not in (Count, Min, Max, Sum):
+            return None
+        if fn.child is None:
+            if not isinstance(fn, Count):
+                return None
+            pushed_aggs.append(PushedAgg("count", None, f"{a.name}__sp{i}"))
+            continue
+        if not isinstance(fn.child, EB.AttributeReference):
+            return None
+        name = fn.child.col_name
+        if name not in out_names:
+            return None
+        src = mapping[name] if mapping is not None else name
+        dt = raw.types[raw.index_of(src)]
+        if getattr(dt, "is_nested", False):
+            return None
+        if isinstance(fn, Count):
+            op = "count"
+        elif isinstance(fn, Sum):
+            if not T.is_integral(dt):
+                return None  # float/decimal sums are order-sensitive
+            op = "sum"
+        else:
+            if not isinstance(dt, _AGG_MINMAX_OK):
+                return None
+            op = "min" if isinstance(fn, Min) else "max"
+        pushed_aggs.append(PushedAgg(op, src, f"{a.name}__sp{i}"))
+    pred = cur.predicate if cur is not None else None
+    install_pushdown(scan, ScanPushdown(pred, None, tuple(pushed_aggs)))
+    # merge aggregate over the partial columns: count partials sum, sum
+    # partials sum (exact for integers), min/max partials min/max — the
+    # output schema (names AND types) is identical to the original
+    # aggregate's by construction
+    from ..exec.aggregate import TpuHashAggregateExec
+    from ..plan.nodes import AggExpr
+    merged = []
+    for a, pa in zip(agg.aggs, pushed_aggs):
+        ref = EB.AttributeReference(pa.name)
+        cls = {"count": Sum, "sum": Sum, "min": Min, "max": Max}[pa.op]
+        merged.append(AggExpr(cls(ref), a.name))
+    return TpuHashAggregateExec([], merged, scan, agg.conf, mode="complete")
+
+
+# ---------------------------------------------------------------------------
+# exact batch-level applier (the universal fallback path)
+# ---------------------------------------------------------------------------
+
+
+class PushdownApplier:
+    """Applies a pushed spec to a fully decoded batch using the engine's
+    own expression kernels — bit-identical to the un-pushed
+    filter/project/aggregate plan by construction. One jitted kernel per
+    (spec, schema, conf) keyed like every exec kernel, so two scans
+    differing only in pushed predicate never share a program."""
+
+    def __init__(self, scan_schema: Schema, spec: ScanPushdown, conf):
+        import jax.numpy as jnp
+        from ..columnar.padding import row_bucket
+        from ..compile import instance_jit, kernel_key
+        from ..exec.base import (batch_vecs, device_ctx, kernel_errors,
+                                 vecs_to_batch)
+        from ..ops.rowops import compact_vecs
+        self.scan_schema = scan_schema
+        self.spec = spec
+        self.conf = conf
+        self.out_schema = spec.output_schema(scan_schema)
+        bound = EB.bind_references(spec.predicate, scan_schema) \
+            if spec.predicate is not None else None
+        if spec.columns is not None:
+            src_idx = [scan_schema.index_of(s) for _, s in spec.columns]
+        else:
+            src_idx = list(range(len(scan_schema)))
+        aggs = spec.aggs
+        out_schema = self.out_schema
+        self._err_msgs: list = []
+        msgs_box = self._err_msgs
+        cap1 = row_bucket(1)
+
+        def kernel(batch):
+            ctx = device_ctx(batch, conf)
+            vecs = batch_vecs(batch)
+            if bound is not None:
+                pred = bound.eval(ctx, vecs)
+                keep = pred.data & pred.validity & batch.row_mask()
+            else:
+                keep = batch.row_mask()
+            kept = jnp.sum(keep).astype(jnp.int64)
+            if aggs:
+                out_vecs = [_agg_partial_vec(jnp, a, scan_schema, vecs,
+                                             keep, cap1) for a in aggs]
+                out = vecs_to_batch(out_schema, out_vecs, 1)
+            else:
+                sel = [vecs[i] for i in src_idx]
+                out_vecs, n = compact_vecs(jnp, sel, keep)
+                out = vecs_to_batch(out_schema, out_vecs, n)
+            return out, kept, kernel_errors(ctx, msgs_box)
+
+        self._kernel = instance_jit(
+            kernel, op="io.scan.pushdown_apply",
+            key=kernel_key(repr(spec), scan_schema, conf=conf),
+            msgs_box=self._err_msgs)
+
+    def apply(self, batch):
+        """-> (pushed-output batch, kept row count). Raises the engine's
+        typed errors (ANSI flags, CpuFallbackRequired) like any exec
+        kernel would."""
+        from ..exec.base import raise_kernel_errors
+        out, kept, errs = self._kernel(batch)
+        raise_kernel_errors(errs, self._err_msgs)
+        return out, int(kept)
+
+    def empty_partials(self):
+        """One partial-aggregate row for a scan that produced no decode
+        units (empty file / all row groups pruned): counts are 0 (valid),
+        min/max/sum are null — so the merged aggregate sees the same
+        answer the un-pushed plan computes over zero rows."""
+        import jax.numpy as jnp
+        from ..columnar.batch import ColumnarBatch
+        from ..columnar.column import Column
+        from ..columnar.padding import row_bucket
+        cap1 = row_bucket(1)
+        cols = []
+        for a, dt in zip(self.spec.aggs, self.out_schema.types):
+            npdt = dt.np_dtype
+            shape = (cap1, 2) if npdt is None else (cap1,)
+            data = np.zeros(shape, np.int64 if npdt is None else npdt)
+            valid = np.zeros(cap1, bool)
+            if a.op == "count":
+                valid[0] = True
+            cols.append(Column(dt, jnp.asarray(data), jnp.asarray(valid)))
+        return ColumnarBatch(self.out_schema, tuple(cols),
+                             jnp.asarray(1, jnp.int32))
+
+
+def _minmax_sentinel(npdt, op: str):
+    if npdt == np.bool_:
+        return op == "min"  # True for min (never smaller), False for max
+    if np.issubdtype(npdt, np.floating):
+        info = np.finfo(npdt)
+    else:
+        info = np.iinfo(npdt)
+    return info.max if op == "min" else info.min
+
+
+def _agg_partial_vec(jnp, a: PushedAgg, schema: Schema, vecs, keep,
+                     cap1: int):
+    """One pushed aggregate's partial value over a decoded batch, as a
+    1-row Vec at the minimal capacity bucket."""
+    from ..expr.base import Vec
+    if a.op == "count":
+        if a.column is None:
+            val = jnp.sum(keep).astype(jnp.int64)
+        else:
+            v = vecs[schema.index_of(a.column)]
+            val = jnp.sum(keep & v.validity).astype(jnp.int64)
+        return _one_row_vec(jnp, Vec, T.LONG, np.dtype(np.int64), val,
+                            jnp.asarray(True), cap1)
+    v = vecs[schema.index_of(a.column)]
+    m = keep & v.validity
+    any_v = jnp.any(m)
+    if a.op == "sum":
+        val = jnp.sum(jnp.where(m, v.data.astype(jnp.int64), 0))
+        return _one_row_vec(jnp, Vec, T.LONG, np.dtype(np.int64), val,
+                            any_v, cap1)
+    npdt = v.dtype.np_dtype
+    sent = _minmax_sentinel(npdt, a.op)
+    masked = jnp.where(m, v.data, jnp.asarray(sent, npdt))
+    val = jnp.min(masked) if a.op == "min" else jnp.max(masked)
+    return _one_row_vec(jnp, Vec, v.dtype, npdt, val, any_v, cap1)
+
+
+def _one_row_vec(jnp, Vec, dt, npdt, val, valid, cap1: int):
+    data = jnp.zeros(cap1, npdt).at[0].set(val.astype(npdt))
+    validity = jnp.zeros(cap1, bool).at[0].set(valid)
+    return Vec(dt, data, validity)
+
+
+# ---------------------------------------------------------------------------
+# device form (parquet fused decode)
+# ---------------------------------------------------------------------------
+
+
+class DevicePushdown:
+    """Static device-side view of a pushed spec for the parquet fused
+    decode: predicate leaves rebuilt over `BoundReference(0)` for dense
+    (value-domain) evaluation, the (out, src) projection list, the pushed
+    aggregates, and the batch applier used whenever the compressed-domain
+    path cannot engage. `key` is the param-faithful repr joined into the
+    select/gather program compile keys."""
+
+    def __init__(self, spec: ScanPushdown, scan_schema: Schema,
+                 applier: PushdownApplier):
+        self.spec = spec
+        self.schema = scan_schema
+        self.applier = applier
+        self.aggs = spec.aggs
+        if spec.aggs:
+            self.columns: Tuple[Tuple[str, str], ...] = ()
+        elif spec.columns is not None:
+            self.columns = spec.columns
+        else:
+            self.columns = tuple((n, n) for n in scan_schema.names)
+        self.tree, self.leaves = _device_pred(spec.predicate, scan_schema)
+        self.pred_device_ok = spec.predicate is None or self.tree is not None
+        self.out_schema = applier.out_schema
+        self.key = repr((repr(spec), tuple(scan_schema.names),
+                         tuple(t.simple_string() for t in scan_schema.types)))
+
+
+def _device_pred(pred, schema: Schema):
+    """Expression -> (tree, leaves) in device form, or (None, ()) when any
+    leaf falls outside what the compressed-domain evaluator handles.
+    tree: ("and"|"or", l, r) | ("leaf", i) | ("isnull", col) |
+    ("notnull", col); leaves[i] = (colname, leaf expression over
+    BoundReference(0))."""
+    if pred is None:
+        return None, ()
+    leaves: List[Tuple[str, EB.Expression]] = []
+
+    def conv(e):
+        if isinstance(e, EP.And) or isinstance(e, EP.Or):
+            l = conv(e.children[0])
+            if l is None:
+                return None
+            r = conv(e.children[1])
+            if r is None:
+                return None
+            return ("and" if isinstance(e, EP.And) else "or", l, r)
+        col = _leaf_column(e, schema)
+        if col is None:
+            return None
+        dt = schema.types[schema.index_of(col)]
+        if getattr(dt, "is_nested", False):
+            return None
+        if isinstance(e, EN.IsNull):
+            return ("isnull", col)
+        if isinstance(e, EN.IsNotNull):
+            return ("notnull", col)
+        bound = EB.BoundReference(0, dt, True)
+        kids = [bound if isinstance(c, EB.AttributeReference) else c
+                for c in e.children]
+        leaves.append((col, e.with_children(kids)))
+        return ("leaf", len(leaves) - 1)
+
+    tree = conv(pred)
+    if tree is None:
+        return None, ()
+    return tree, tuple(leaves)
+
+
+# ---------------------------------------------------------------------------
+# footer-statistics row-group pruning (device decode path satellite)
+# ---------------------------------------------------------------------------
+
+# stat domains where footer min/max compare reliably against our literals
+# without domain decoding: plain ints, floats and bools. Strings (writers
+# may truncate stats), decimals (unscaled vs logical), date/timestamp
+# (logical-type units) are excluded — wrong pruning DROPS ROWS, so this is
+# an allowlist, mirroring io/dynamic_pruning.py's caution.
+def _stat_comparable(dt, value) -> bool:
+    if isinstance(dt, T.BooleanType):
+        return isinstance(value, bool)
+    if T.is_integral(dt):
+        return isinstance(value, (int, np.integer)) \
+            and not isinstance(value, bool)
+    if T.is_floating(dt):
+        return isinstance(value, (int, float, np.integer, np.floating)) \
+            and not isinstance(value, bool)
+    return False
+
+
+def prune_row_groups(meta, col_index, schema: Schema, pred) -> set:
+    """Row groups the pushed predicate PROVABLY eliminates via footer
+    min/max/null-count statistics, before any page bytes are read.
+    Conservative: any uncertainty (missing stats, unsupported domain,
+    NaNs possible) keeps the row group. Returns the set of prunable row
+    group ordinals (possibly empty)."""
+    pruned = set()
+    for rg in range(meta.num_row_groups):
+        rgm = meta.row_group(rg)
+
+        def stats_of(colname):
+            ci = col_index.get(colname)
+            if ci is None:
+                return None
+            try:
+                st = rgm.column(ci).statistics
+            except Exception:
+                return None
+            if st is None:
+                return None
+            mn = mx = None
+            if st.has_min_max:
+                mn, mx = st.min, st.max
+            nulls = st.null_count if st.has_null_count else None
+            return mn, mx, nulls, rgm.num_rows
+
+        try:
+            if not _rg_maybe_match(pred, schema, stats_of):
+                pruned.add(rg)
+        except Exception:
+            continue  # estimation only; never a correctness gate
+    return pruned
+
+
+def _rg_maybe_match(e, schema: Schema, stats_of) -> bool:
+    """Could ANY row of the row group satisfy `e`? True on uncertainty."""
+    if isinstance(e, EP.And):
+        return _rg_maybe_match(e.children[0], schema, stats_of) and \
+            _rg_maybe_match(e.children[1], schema, stats_of)
+    if isinstance(e, EP.Or):
+        return _rg_maybe_match(e.children[0], schema, stats_of) or \
+            _rg_maybe_match(e.children[1], schema, stats_of)
+    col = _leaf_column(e, schema)
+    if col is None:
+        return True
+    st = stats_of(col)
+    if st is None:
+        return True
+    mn, mx, nulls, nrows = st
+    if isinstance(e, EN.IsNull):
+        return nulls is None or nulls > 0
+    if isinstance(e, EN.IsNotNull):
+        return nulls is None or nulls < nrows
+    dt = schema.types[schema.index_of(col)]
+    if mn is None or mx is None:
+        return True
+    if T.is_floating(dt) and (isinstance(mn, float) and np.isnan(mn)
+                              or isinstance(mx, float) and np.isnan(mx)):
+        return True  # NaN stats are not orderable evidence
+    if isinstance(e, EP.In):
+        vals = [v for v in e.items if v is not None]
+        return any(_stat_comparable(dt, v) and mn <= v <= mx or
+                   not _stat_comparable(dt, v) for v in vals)
+    l, r = e.children
+    flipped = not isinstance(l, EB.AttributeReference)
+    v = (l if flipped else r).value
+    if not _stat_comparable(dt, v):
+        return True
+    if isinstance(v, float) and np.isnan(v):
+        # NaN rows are invisible to min/max stats, and Spark's NaN==NaN /
+        # NaN-greatest ordering can satisfy these tests — never prune
+        return True
+    if T.is_floating(dt):
+        # footer float stats may not reflect NaN rows, and Spark orders
+        # NaN greatest: any > / >= / == NaN-reachable test stays unprunable
+        # unless stats prove the plain-number range excludes it AND the
+        # comparison cannot match NaN; conservatively keep when the
+        # literal-side test could be satisfied by a NaN row
+        could_nan = isinstance(e, (EP.GreaterThan, EP.GreaterThanOrEqual)) \
+            if not flipped else isinstance(e, (EP.LessThan,
+                                               EP.LessThanOrEqual))
+        if could_nan:
+            return True
+    if flipped:  # lit OP col -> col flipped-OP lit
+        flip = {EP.LessThan: EP.GreaterThan,
+                EP.LessThanOrEqual: EP.GreaterThanOrEqual,
+                EP.GreaterThan: EP.LessThan,
+                EP.GreaterThanOrEqual: EP.LessThanOrEqual}
+        cls = flip.get(type(e), type(e))
+    else:
+        cls = type(e)
+    if cls in (EP.EqualTo, EP.EqualNullSafe):
+        return mn <= v <= mx
+    if cls is EP.LessThan:
+        return mn < v
+    if cls is EP.LessThanOrEqual:
+        return mn <= v
+    if cls is EP.GreaterThan:
+        return mx > v
+    if cls is EP.GreaterThanOrEqual:
+        return mx >= v
+    return True

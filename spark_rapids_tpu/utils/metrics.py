@@ -181,6 +181,14 @@ class TaskMetrics:
         self.scan_dispatches = 0
         self.scan_chunks = 0
         self.scan_batches = 0
+        # scan pushdown (plan/scan_pushdown.py): rows the pushed predicate
+        # removed before downstream operators, ROW DATA bytes the decode
+        # actually materialized on device (with pushdown, survivors only —
+        # the machine-independent proxy for the decode-path win), and
+        # whole row groups skipped via footer stats before any page read
+        self.scan_rows_pruned = 0
+        self.scan_bytes_materialized = 0
+        self.scan_rowgroups_pruned = 0
         # CPU-fallback stage re-runs: a device-side CpuFallbackRequired
         # (e.g. require_flat_strings on a >headWidth key) silently re-ran
         # the whole stage on the host engine this many times
@@ -261,6 +269,11 @@ class TaskMetrics:
                 f"scanChunks={self.scan_chunks} "
                 f"scanBatches={self.scan_batches} "
                 f"dispatchesPerScanBatch={per_batch:.2f}")
+        if self.scan_rows_pruned or self.scan_rowgroups_pruned:
+            parts.append(
+                f"scanRowsPruned={self.scan_rows_pruned} "
+                f"scanRowGroupsPruned={self.scan_rowgroups_pruned} "
+                f"scanBytesMaterialized={self.scan_bytes_materialized}")
         if self.cpu_fallback_reruns:
             parts.append(f"cpuFallbackReruns={self.cpu_fallback_reruns}")
         if self.rescache_hits or self.rescache_misses or \

@@ -70,6 +70,12 @@ def pytest_configure(config):
         "stats: runtime-statistics suite (cardinality history / "
         "estimate-vs-actual q-error / optimizer feedback / skew "
         "histograms; scripts/stats_matrix.sh runs these standalone)")
+    config.addinivalue_line(
+        "markers",
+        "pushdown: scan-pushdown suite (compute on compressed data: "
+        "golden on/off equality / planner rewrites / key+fingerprint "
+        "non-aliasing / row-group pruning / aggregate-only shapes; "
+        "scripts/scan_pushdown_matrix.sh runs these standalone)")
 
 
 @pytest.fixture

@@ -1,0 +1,406 @@
+"""Scan pushdown (plan/scan_pushdown.py): compute on compressed data.
+
+Golden equality sweep (pushdown on vs off, bit-identical rows) across
+types, selectivities, dict-vs-plain pages and null-heavy columns; planner
+rewrite shapes; compile-key / rescache-fingerprint non-aliasing; footer
+row-group pruning; aggregate-only zero-materialisation; and the
+pushdown-off zero-state contract. scripts/scan_pushdown_matrix.sh runs
+these standalone plus the byte-identical / materialised-bytes gates."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.expr import (Count, In, Max, Min, Sum, col, lit)
+from spark_rapids_tpu.plugin import TpuSession
+from spark_rapids_tpu.utils.metrics import TaskMetrics
+
+pytestmark = pytest.mark.pushdown
+
+PD_KEY = "spark.rapids.tpu.scan.pushdown.enabled"
+
+
+@pytest.fixture(scope="module")
+def sess_on():
+    return TpuSession({"spark.rapids.sql.explain": "NONE", PD_KEY: True})
+
+
+@pytest.fixture(scope="module")
+def sess_off():
+    return TpuSession({"spark.rapids.sql.explain": "NONE"})
+
+
+def _mk_table(n=2000):
+    rng = np.random.default_rng(7)
+    import decimal
+    return pa.table({
+        "i32": pa.array([None if i % 13 == 0 else int(i % 500 - 250)
+                         for i in range(n)], pa.int32()),
+        "i64": pa.array(range(n), pa.int64()),
+        "f64": pa.array([None if i % 17 == 0 else float(i) * 0.25
+                         for i in range(n)], pa.float64()),
+        "s": pa.array([None if i % 11 == 0 else f"val{i % 23:02d}"
+                       for i in range(n)]),
+        "dec": pa.array([decimal.Decimal(int(v)).scaleb(-2) for v in
+                         rng.integers(-10**6, 10**6, n)],
+                        pa.decimal128(10, 2)),
+        "flag": pa.array([bool(i % 3 == 0) for i in range(n)]),
+        "d": pa.array([int(i % 1000) for i in range(n)], pa.date32()),
+        "nullheavy": pa.array([None if i % 4 != 0 else int(i)
+                               for i in range(n)], pa.int64()),
+    })
+
+
+@pytest.fixture(scope="module")
+def pq_dict(tmp_path_factory):
+    p = str(tmp_path_factory.mktemp("pd") / "dict.parquet")
+    pq.write_table(_mk_table(), p, row_group_size=500)
+    return p
+
+
+@pytest.fixture(scope="module")
+def pq_plain(tmp_path_factory):
+    p = str(tmp_path_factory.mktemp("pd") / "plain.parquet")
+    pq.write_table(_mk_table(), p, row_group_size=500,
+                   use_dictionary=False)
+    return p
+
+
+def _dec_lit(s):
+    import decimal
+
+    from spark_rapids_tpu import types as T
+    return lit(decimal.Decimal(s), T.DecimalType(10, 2))
+
+
+def _date_lit(days):
+    from spark_rapids_tpu import types as T
+    return lit(days, T.DATE)
+
+
+def _collect_sorted(df):
+    t = df.collect()
+    if t.num_rows and "i64" in t.schema.names:
+        return t.sort_by([("i64", "ascending")])
+    return t
+
+
+def _assert_on_off_equal(sess_on, sess_off, path, build):
+    a = _collect_sorted(build(sess_on.read_parquet(path)))
+    b = _collect_sorted(build(sess_off.read_parquet(path)))
+    assert a.schema.names == b.schema.names
+    assert a.num_rows == b.num_rows
+    assert a.equals(b), f"pushdown on/off mismatch:\nON:\n{a}\nOFF:\n{b}"
+    return a
+
+
+class TestGoldenEquality:
+    """Bit-identical rows with pushdown on vs off."""
+
+    # selectivity ~0%, ~1%, ~50%, 100% over the same int column; string
+    # equality rides the dictionary; IN and null checks; OR trees; and a
+    # residual (unsupported) conjunct left behind a pushed one
+    QUERIES = [
+        ("sel0", lambda df: df.filter(col("i64") < -1)),
+        ("sel1", lambda df: df.filter(col("i64") < 20)),
+        ("sel50", lambda df: df.filter(col("i64") < 1000)),
+        ("sel100", lambda df: df.filter(col("i64") >= 0)),
+        ("str_eq", lambda df: df.filter(col("s") == "val07")),
+        ("in_list", lambda df: df.filter(In(col("i32"), [1, 2, 3, 200]))),
+        ("null_check", lambda df: df.filter(col("nullheavy").is_not_null()
+                                            & col("s").is_null())),
+        ("or_tree", lambda df: df.filter((col("i64") < 100)
+                                         | (col("s") == "val03"))),
+        ("project", lambda df: df.filter(col("i64") < 300)
+         .select("s", "i64", "f64")),
+        ("residual", lambda df: df.filter((col("i64") < 500)
+                                          & (col("i64") + 0 < 400))),
+        ("flag_dec", lambda df: df.filter(col("flag") == True)  # noqa: E712
+         .select("i64", "dec", "d")),
+        ("dec_date_pred", lambda df: df.filter(
+            (col("dec") < _dec_lit("1.50")) & (col("d") >= _date_lit(100)))),
+    ]
+
+    @pytest.mark.parametrize("name,build",
+                             QUERIES, ids=[q[0] for q in QUERIES])
+    def test_dict_pages(self, sess_on, sess_off, pq_dict, name, build):
+        _assert_on_off_equal(sess_on, sess_off, pq_dict, build)
+
+    def test_plain_pages(self, sess_on, sess_off, pq_plain):
+        for name, build in self.QUERIES[1:6]:
+            _assert_on_off_equal(sess_on, sess_off, pq_plain, build)
+
+    def test_multi_file(self, sess_on, sess_off, tmp_path):
+        t = _mk_table(600)
+        paths = []
+        for i in range(3):
+            p = str(tmp_path / f"m{i}.parquet")
+            pq.write_table(t.slice(i * 200, 200), p, row_group_size=100)
+            paths.append(p)
+        a = _collect_sorted(sess_on.read_parquet(*paths)
+                            .filter(col("i64") < 300))
+        b = _collect_sorted(sess_off.read_parquet(*paths)
+                            .filter(col("i64") < 300))
+        assert a.equals(b)
+
+
+class TestPlanner:
+    def _apply(self, sess, df):
+        from spark_rapids_tpu.plan.overrides import Overrides
+        return Overrides(sess.conf).apply(df.plan)
+
+    def test_filter_folds_into_scan(self, sess_on, pq_dict):
+        from spark_rapids_tpu.io.scanbase import TpuFileScanExec
+        plan = self._apply(sess_on,
+                           sess_on.read_parquet(pq_dict)
+                           .filter(col("i64") < 10))
+        assert isinstance(plan, TpuFileScanExec)
+        assert plan.pushed is not None
+        assert plan.pushed.predicate is not None
+
+    def test_residual_filter_stays(self, sess_on, pq_dict):
+        from spark_rapids_tpu.exec.basic import TpuFilterExec
+        plan = self._apply(sess_on,
+                           sess_on.read_parquet(pq_dict)
+                           .filter((col("i64") < 10)
+                                   & (col("i64") + 0 < 5)))
+        assert isinstance(plan, TpuFilterExec)  # unsupported conjunct
+        assert plan.children[0].pushed is not None  # supported one pushed
+
+    def test_projection_collapses_with_rename(self, sess_on, sess_off,
+                                              pq_dict):
+        from spark_rapids_tpu.io.scanbase import TpuFileScanExec
+        df = sess_on.read_parquet(pq_dict) \
+            .select(col("i64").alias("k"), "s") \
+            .filter(col("k") < 50)
+        plan = self._apply(sess_on, df)
+        assert isinstance(plan, TpuFileScanExec)
+        assert plan.pushed.columns == (("k", "i64"), ("s", "s"))
+        assert plan.output.names == ("k", "s")
+        # the remapped predicate still evaluates over the SOURCE column
+        a = df.collect().sort_by([("k", "ascending")])
+        b = sess_off.read_parquet(pq_dict) \
+            .select(col("i64").alias("k"), "s") \
+            .filter(col("k") < 50).collect().sort_by([("k", "ascending")])
+        assert a.equals(b)
+
+    def test_aggregate_rewrites_to_merge(self, sess_on, pq_dict):
+        from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+        from spark_rapids_tpu.io.scanbase import TpuFileScanExec
+        plan = self._apply(sess_on,
+                           sess_on.read_parquet(pq_dict)
+                           .filter(col("i64") < 100)
+                           .agg(n=Count(), sm=Sum(col("i64"))))
+        assert isinstance(plan, TpuHashAggregateExec)
+        scan = plan.children[0]
+        assert isinstance(scan, TpuFileScanExec)
+        assert tuple(a.op for a in scan.pushed.aggs) == ("count", "sum")
+
+    def test_float_sum_not_pushed(self, sess_on, pq_dict):
+        from spark_rapids_tpu.io.scanbase import TpuFileScanExec
+        plan = self._apply(sess_on,
+                           sess_on.read_parquet(pq_dict)
+                           .agg(sm=Sum(col("f64"))))
+        scan = plan.children[0]
+        if isinstance(scan, TpuFileScanExec):
+            assert not scan.pushed  # order-sensitive sum must not push
+
+    def test_ansi_disables_agg_pushdown(self, pq_dict):
+        from spark_rapids_tpu.io.scanbase import TpuFileScanExec
+        s = TpuSession({"spark.rapids.sql.explain": "NONE", PD_KEY: True,
+                        "spark.sql.ansi.enabled": True})
+        plan = self._apply(s, s.read_parquet(pq_dict)
+                           .agg(sm=Sum(col("i64"))))
+        scan = plan.children[0]
+        if isinstance(scan, TpuFileScanExec):
+            assert scan.pushed is None or not scan.pushed.aggs
+
+
+class TestOffPathZeroState:
+    def test_off_plan_untouched(self, sess_off, pq_dict):
+        from spark_rapids_tpu.plan.overrides import Overrides
+        df = sess_off.read_parquet(pq_dict).filter(col("i64") < 10)
+        plan = Overrides(sess_off.conf).apply(df.plan)
+        from spark_rapids_tpu.exec.basic import TpuFilterExec
+        assert isinstance(plan, TpuFilterExec)
+        scan = plan.children[0]
+        # CLASS attribute only: an un-pushed scan carries zero instance
+        # state, so its rescache/compile fingerprints are unchanged
+        assert "pushed" not in vars(scan)
+        assert "rows_pruned" not in vars(scan)
+        assert scan.pushed is None
+
+    def test_off_no_metrics_motion(self, sess_off, pq_dict):
+        TaskMetrics.reset()
+        sess_off.read_parquet(pq_dict).filter(col("i64") < 10).collect()
+        tm = TaskMetrics.get()
+        assert tm.scan_rows_pruned == 0
+        assert tm.scan_bytes_materialized == 0
+        assert tm.scan_rowgroups_pruned == 0
+
+
+class TestRowGroupPruning:
+    def test_prunes_and_counts(self, sess_on, sess_off, tmp_path):
+        t = pa.table({"i64": pa.array(range(5000), pa.int64()),
+                      "s": pa.array([f"x{i%9}" for i in range(5000)])})
+        p = str(tmp_path / "rg.parquet")
+        pq.write_table(t, p, row_group_size=500)
+        TaskMetrics.reset()
+        a = sess_on.read_parquet(p).filter(col("i64") < 400).collect()
+        assert TaskMetrics.get().scan_rowgroups_pruned == 9
+        b = sess_off.read_parquet(p).filter(col("i64") < 400).collect()
+        assert a.sort_by([("i64", "ascending")]).equals(
+            b.sort_by([("i64", "ascending")]))
+
+    def test_string_stats_never_prune(self, sess_on, tmp_path):
+        # strings are outside the stat-comparable allowlist (writers may
+        # truncate stats): no pruning, but results stay exact
+        t = pa.table({"i64": pa.array(range(1000), pa.int64()),
+                      "s": pa.array([f"k{i:04d}" for i in range(1000)])})
+        p = str(tmp_path / "s.parquet")
+        pq.write_table(t, p, row_group_size=250)
+        TaskMetrics.reset()
+        out = sess_on.read_parquet(p).filter(col("s") == "k0900").collect()
+        assert TaskMetrics.get().scan_rowgroups_pruned == 0
+        assert out.num_rows == 1 and out.column("i64").to_pylist() == [900]
+
+    def test_all_groups_pruned_empty_result(self, sess_on, sess_off,
+                                            pq_dict):
+        a = sess_on.read_parquet(pq_dict).filter(col("i64") < -5).collect()
+        b = sess_off.read_parquet(pq_dict).filter(col("i64") < -5).collect()
+        assert a.num_rows == 0 == b.num_rows
+        assert a.schema.names == b.schema.names
+
+
+class TestAggregatePushdown:
+    def test_agg_only_materialises_no_rows(self, sess_on, pq_dict):
+        TaskMetrics.reset()
+        out = sess_on.read_parquet(pq_dict).filter(col("i64") >= 100) \
+            .agg(n=Count(), nn=Count(col("nullheavy")),
+                 mn=Min(col("i64")), mx=Max(col("i64")),
+                 sm=Sum(col("i32"))).collect()
+        tm = TaskMetrics.get()
+        assert tm.scan_bytes_materialized == 0  # zero row data shipped
+        assert out.column("n").to_pylist() == [1900]
+        assert out.column("mn").to_pylist() == [100]
+        assert out.column("mx").to_pylist() == [1999]
+
+    def test_agg_matches_off(self, sess_on, sess_off, pq_dict):
+        def q(s):
+            return s.read_parquet(pq_dict).filter(col("i64") < 700).agg(
+                n=Count(), nn=Count(col("s")), mn=Min(col("d")),
+                mx=Max(col("i32")), sm=Sum(col("i64"))).collect()
+        assert q(sess_on).equals(q(sess_off))
+
+    def test_empty_input_partials(self, sess_on, sess_off, pq_dict):
+        # every row group pruned: the partial guard must still produce
+        # the empty-input answer (count 0, min/max/sum null)
+        def q(s):
+            return s.read_parquet(pq_dict).filter(col("i64") < -5).agg(
+                n=Count(), mn=Min(col("i64")), sm=Sum(col("i64"))).collect()
+        a, b = q(sess_on), q(sess_off)
+        assert a.equals(b)
+        assert a.column("n").to_pylist() == [0]
+        assert a.column("mn").to_pylist() == [None]
+
+
+class TestKeysAndFingerprints:
+    def test_rescache_fingerprints_never_alias(self, sess_on, pq_dict):
+        from spark_rapids_tpu.plan.overrides import Overrides
+        from spark_rapids_tpu.rescache.fingerprint import fingerprint
+
+        def fp(build):
+            df = build(sess_on.read_parquet(pq_dict))
+            plan = Overrides(sess_on.conf).apply(df.plan)
+            f = fingerprint(plan, sess_on.conf)
+            assert f is not None
+            return f.digest
+
+        unpushed = fp(lambda df: df)
+        p1 = fp(lambda df: df.filter(col("i64") < 10))
+        p2 = fp(lambda df: df.filter(col("i64") < 20))
+        p3 = fp(lambda df: df.filter(In(col("i32"), [1])))
+        p4 = fp(lambda df: df.filter(In(col("i32"), [2])))
+        assert len({unpushed, p1, p2, p3, p4}) == 5
+
+    def test_applier_kernel_keys_differ(self, sess_on, pq_dict):
+        from spark_rapids_tpu.io.parquet import parquet_scan_plan
+        from spark_rapids_tpu.io.scanbase import TpuFileScanExec
+        from spark_rapids_tpu.plan.scan_pushdown import (ScanPushdown,
+                                                         install_pushdown)
+
+        def applier_key(pred):
+            scan = TpuFileScanExec(
+                parquet_scan_plan([pq_dict], sess_on.conf), sess_on.conf)
+            install_pushdown(scan, ScanPushdown(pred))
+            return scan._pushdown_applier()._kernel.key
+
+        k1 = applier_key(col("i64") < lit(10))
+        k2 = applier_key(col("i64") < lit(11))
+        assert k1 != k2
+
+    def test_device_keys_differ(self, sess_on, pq_dict):
+        from spark_rapids_tpu.io.parquet import parquet_scan_plan
+        from spark_rapids_tpu.io.scanbase import TpuFileScanExec
+        from spark_rapids_tpu.plan.scan_pushdown import (ScanPushdown,
+                                                         install_pushdown)
+
+        def dev_key(pred):
+            scan = TpuFileScanExec(
+                parquet_scan_plan([pq_dict], sess_on.conf), sess_on.conf)
+            install_pushdown(scan, ScanPushdown(pred))
+            return scan._device_pushdown().key
+
+        assert dev_key(col("i64") < lit(10)) != dev_key(col("i64") < lit(11))
+
+    def test_pushed_spec_repr_param_faithful(self):
+        from spark_rapids_tpu.plan.scan_pushdown import (PushedAgg,
+                                                         ScanPushdown)
+        a = ScanPushdown(col("x") < lit(1), (("y", "x"),),
+                         (PushedAgg("min", "x", "m"),))
+        b = ScanPushdown(col("x") < lit(2), (("y", "x"),),
+                         (PushedAgg("min", "x", "m"),))
+        c = ScanPushdown(col("x") < lit(1), (("y", "x"),),
+                         (PushedAgg("max", "x", "m"),))
+        assert len({repr(a), repr(b), repr(c)}) == 3
+
+
+class TestOtherFormats:
+    def test_csv_pushdown_equal(self, sess_on, sess_off, tmp_path):
+        import pyarrow.csv as pacsv
+        t = pa.table({"a": pa.array(range(300), pa.int64()),
+                      "s": pa.array([f"r{i%5}" for i in range(300)])})
+        p = str(tmp_path / "t.csv")
+        pacsv.write_csv(t, p)
+
+        def q(s):
+            return s.read_csv(p).filter(col("a") < 40).collect() \
+                .sort_by([("a", "ascending")])
+        assert q(sess_on).equals(q(sess_off))
+
+    def test_json_pushdown_equal(self, sess_on, sess_off, tmp_path):
+        p = str(tmp_path / "t.jsonl")
+        with open(p, "w") as f:
+            for i in range(200):
+                f.write('{"a": %d, "s": "j%d"}\n' % (i, i % 4))
+
+        def q(s):
+            return s.read_json(p).filter((col("a") >= 150)
+                                         | (col("s") == "j1")).collect() \
+                .sort_by([("a", "ascending")])
+        assert q(sess_on).equals(q(sess_off))
+
+    def test_orc_pushdown_equal(self, sess_on, sess_off, tmp_path):
+        from pyarrow import orc
+        t = pa.table({"a": pa.array(range(400), pa.int64()),
+                      "s": pa.array([None if i % 7 == 0 else f"o{i%6}"
+                                     for i in range(400)])})
+        p = str(tmp_path / "t.orc")
+        orc.write_table(t, p)
+
+        def q(s):
+            return s.read_orc(p).filter(col("s").is_not_null()
+                                        & (col("a") < 100)).collect() \
+                .sort_by([("a", "ascending")])
+        assert q(sess_on).equals(q(sess_off))
