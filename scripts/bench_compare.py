@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""bench_compare — offline diff of two or more BENCH_*.json runs.
+
+The bench harness (bench.py) emits one JSON object per run: a headline
+metric (`scan_join_agg_speedup_vs_cpu`), the CPU-oracle ratio
+(`vs_baseline`), and a `detail` block of per-stage throughputs
+(`*_gbps`), stage walls (`*_s`) and dispatch counts. Runs accumulate as
+BENCH_*.json files with nothing comparing them — this tool is the
+comparator: the FIRST file is the baseline, every later file diffs
+against it.
+
+    python scripts/bench_compare.py BASE.json RUN.json...
+        [--fail-below RATIO] [--json]
+
+Output: headline speedup ratio per run (new/old, >1 = faster), the
+per-stage GB/s table, and dispatch-count deltas. `--fail-below R` exits
+2 when any run's headline ratio falls below R — the CI regression gate
+(an errored run, headline null, always fails the gate). Engine-free:
+plain stdlib, runs anywhere the files land."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+FAIL_EXIT = 2
+
+
+def load_run(path: str) -> Dict[str, Any]:
+    """One normalized run. Tolerates both shapes on disk: the raw
+    bench.py object and the driver wrapper holding it under `parsed`."""
+    with open(path) as f:
+        raw = json.load(f)
+    if "parsed" in raw and isinstance(raw["parsed"], dict):
+        raw = raw["parsed"]
+    detail = raw.get("detail") or {}
+    return {
+        "path": path,
+        "name": os.path.basename(path),
+        "metric": raw.get("metric", "?"),
+        "value": raw.get("value"),          # None on an errored run
+        "unit": raw.get("unit", ""),
+        "vs_baseline": raw.get("vs_baseline"),
+        "error": raw.get("error"),
+        "detail": {k: v for k, v in detail.items()
+                   if isinstance(v, (int, float)) and v is not None},
+    }
+
+
+def _stage_keys(runs: List[Dict[str, Any]], suffix: str = "",
+                contains: str = "") -> List[str]:
+    keys = set()
+    for r in runs:
+        for k in r["detail"]:
+            if (suffix and k.endswith(suffix)) or \
+                    (contains and contains in k):
+                keys.add(k)
+    return sorted(keys)
+
+
+def _ratio(new: Optional[float], old: Optional[float],
+           higher_is_better: bool = True) -> Optional[float]:
+    """None means ABSENT (errored run / missing baseline) — a genuine
+    0.0 headline is a real measurement and must gate as 'speedup 0.000',
+    not masquerade as an errored run."""
+    if new is None or old is None or old == 0:
+        return None
+    return new / old if higher_is_better else old / new
+
+
+def _fmt(v: Optional[float], nd: int = 3) -> str:
+    return "n/a" if v is None else f"{v:.{nd}f}"
+
+
+def _fmt_table(rows: List[List[str]], header: List[str]) -> str:
+    cols = [header] + rows
+    widths = [max(len(str(r[i])) for r in cols)
+              for i in range(len(header))]
+    out = ["  ".join(str(h).ljust(w) for h, w in zip(header, widths))]
+    out.append("  ".join("-" * w for w in widths))
+    for r in rows:
+        out.append("  ".join(str(c).ljust(w) for c, w in zip(r, widths)))
+    return "\n".join(out)
+
+
+def compare(runs: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """The comparison model: headline ratios vs the first run plus
+    per-stage GB/s and dispatch tables."""
+    base = runs[0]
+    headline = []
+    for r in runs[1:]:
+        headline.append({
+            "run": r["name"],
+            "value": r["value"],
+            "speedup_vs_base": _ratio(r["value"], base["value"]),
+            "error": r.get("error"),
+        })
+    gbps_keys = _stage_keys(runs, suffix="_gbps")
+    dispatch_keys = _stage_keys(runs, contains="dispatch")
+    stages = {k: [r["detail"].get(k) for r in runs] for k in gbps_keys}
+    dispatches = {k: [r["detail"].get(k) for r in runs]
+                  for k in dispatch_keys}
+    return {"metric": base["metric"], "unit": base["unit"],
+            "base": {"run": base["name"], "value": base["value"],
+                     "error": base.get("error")},
+            "headline": headline, "gbps": stages,
+            "dispatches": dispatches,
+            "runs": [r["name"] for r in runs]}
+
+
+def render(model: Dict[str, Any]) -> str:
+    lines: List[str] = []
+    lines.append(f"=== bench comparison: {model['metric']} "
+                 f"({model['unit']}) ===")
+    base = model["base"]
+    rows = [[base["run"], _fmt(base["value"]), "1.000 (base)",
+             base.get("error") or ""]]
+    for h in model["headline"]:
+        rows.append([h["run"], _fmt(h["value"]),
+                     _fmt(h["speedup_vs_base"]), h.get("error") or ""])
+    lines.append(_fmt_table(rows, ["run", "headline", "speedup", "note"]))
+    if model["gbps"]:
+        lines.append("")
+        lines.append("per-stage GB/s:")
+        lines.append(_fmt_table(
+            [[k] + [_fmt(v) for v in vals]
+             for k, vals in sorted(model["gbps"].items())],
+            ["stage"] + model["runs"]))
+    if model["dispatches"]:
+        lines.append("")
+        lines.append("dispatch counts:")
+        lines.append(_fmt_table(
+            [[k] + [_fmt(v, 1) for v in vals]
+             for k, vals in sorted(model["dispatches"].items())],
+            ["counter"] + model["runs"]))
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_compare",
+        description="Diff two or more BENCH_*.json runs (first file is "
+                    "the baseline)")
+    ap.add_argument("paths", nargs="+", metavar="BENCH.json",
+                    help="bench result files, baseline first")
+    ap.add_argument("--fail-below", type=float, default=None,
+                    metavar="RATIO",
+                    help="exit 2 when any run's headline speedup vs the "
+                         "baseline is below RATIO (regression gate); an "
+                         "errored run always fails the gate")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the comparison model as JSON")
+    args = ap.parse_args(argv)
+    if len(args.paths) < 2:
+        ap.error("need at least two runs to compare "
+                 "(baseline + one candidate)")
+    runs = [load_run(p) for p in args.paths]
+    model = compare(runs)
+    if args.json:
+        print(json.dumps(model, indent=2))
+    else:
+        print(render(model))
+    if args.fail_below is not None:
+        failed = []
+        for h in model["headline"]:
+            r = h["speedup_vs_base"]
+            if r is None or r < args.fail_below:
+                failed.append(
+                    f"{h['run']}: "
+                    + ("no ratio (errored run or zero baseline)"
+                       if r is None else f"speedup {r:.3f}"))
+        if failed:
+            print(f"REGRESSION (below {args.fail_below}): "
+                  + "; ".join(failed), file=sys.stderr)
+            return FAIL_EXIT
+        print(f"gate OK (all runs >= {args.fail_below}x baseline)",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

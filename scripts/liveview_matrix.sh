@@ -1,0 +1,255 @@
+#!/usr/bin/env bash
+# Live query-introspection matrix (ISSUE-13 CI gate):
+#   1. run the live suite (marker `live`): registry lifecycle, progress/
+#      ETA from stats history, slow-query watchdog (incident + cancel),
+#      /queries + service-op + gateway fan-out surfaces, SIGUSR2 dump,
+#      tpu_top console, profile_report pushdown section, bench_compare;
+#   2. live-OFF gate: with spark.rapids.tpu.live.enabled=false a query
+#      spawns ZERO new threads, no registry/watchdog object exists,
+#      results are byte-identical, and the hook cost is in the noise
+#      (off-vs-on wall ratio < 1.25);
+#   3. bench_compare smoke: the offline run comparator diffs two bench
+#      JSONs, and the --fail-below regression gate trips on demand;
+#   4. real-subprocess gate: a TpuDeviceService OS process with live +
+#      stats + telemetry on serves the SAME in-flight query over HTTP
+#      /queries, the `queries` service op, and an in-process fleet
+#      gateway's fan-out — with a monotonically nondecreasing progress
+#      fraction and, once history exists, a finite ETA.
+#
+# Usage: scripts/liveview_matrix.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TIMEOUT="${SRTPU_LIVEVIEW_TIMEOUT:-900}"
+
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_liveview.py -m live -q \
+    -p no:cacheprovider "$@"
+
+echo "== live-off gate (zero threads, zero state, byte-identical, cost in the noise) =="
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu python - <<'EOF'
+import threading, time
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu import live
+from spark_rapids_tpu.expr import Sum, col
+from spark_rapids_tpu.plugin import TpuSession
+
+rng = np.random.default_rng(17)
+n = 60_000
+t = pa.table({"g": pa.array(rng.integers(0, 64, n).astype(np.int32)),
+              "v": pa.array(rng.uniform(size=n))})
+
+BASE = {"spark.rapids.sql.explain": "NONE",
+        "spark.rapids.sql.batchSizeRows": 8192}
+
+def run(sess):
+    q = (sess.from_arrow(t).filter(col("v") > 0.25)
+         .group_by("g").agg(total=Sum(col("v"))))
+    return q.collect()
+
+threads0 = threading.active_count()
+off = TpuSession(dict(BASE))
+run(off)  # warm compile caches
+assert not live.is_enabled(), "FAIL: live active without opt-in"
+assert live.get() is None and live.watchdog() is None, \
+    "FAIL: live-off state exists"
+assert threading.active_count() <= threads0, \
+    f"FAIL: live-off spawned {threading.active_count() - threads0} threads"
+snap = live.snapshot()
+assert snap["enabled"] is False and snap["queries"] == [] \
+    and snap["recent"] == [], f"FAIL: live-off snapshot not empty: {snap}"
+
+REPS = 5
+t0 = time.monotonic()
+for _ in range(REPS):
+    off_res = run(off)
+off_s = time.monotonic() - t0
+
+on = TpuSession(dict(BASE, **{"spark.rapids.tpu.live.enabled": True}))
+run(on)  # warm (configures live)
+assert live.is_enabled() and live.get() is not None
+t0 = time.monotonic()
+for _ in range(REPS):
+    on_res = run(on)
+on_s = time.monotonic() - t0
+assert on_res.sort_by("g").equals(off_res.sort_by("g")), \
+    "FAIL: live-on result differs"
+assert len(live.snapshot()["recent"]) >= REPS
+# the on-path (registry sampling + watchdog thread) must stay within
+# noise of off; the off-path hook is strictly cheaper, so this bounds
+# the off overhead from above
+ratio = on_s / max(off_s, 1e-9)
+print(f"live off={off_s:.3f}s on={on_s:.3f}s ratio={ratio:.3f}")
+assert ratio < 1.25, f"FAIL: live-on overhead ratio {ratio:.3f}"
+live.shutdown()
+print("live-off gate OK")
+EOF
+
+echo "== bench_compare smoke (diff + regression gate) =="
+timeout -k 10 "$TIMEOUT" python - <<'EOF'
+import json, os, subprocess, sys, tempfile
+
+d = tempfile.mkdtemp(prefix="srtpu-benchcmp-")
+base = os.path.join(d, "BENCH_base.json")
+new = os.path.join(d, "BENCH_new.json")
+json.dump({"metric": "scan_join_agg_speedup_vs_cpu", "value": 2.0,
+           "unit": "x", "detail": {"pipeline_gbps": 3.0,
+                                   "scan_dispatches": 48}},
+          open(base, "w"))
+json.dump({"n": 1, "parsed": {
+    "metric": "scan_join_agg_speedup_vs_cpu", "value": 4.0, "unit": "x",
+    "detail": {"pipeline_gbps": 6.0, "scan_dispatches": 4}}},
+    open(new, "w"))
+out = subprocess.run(
+    [sys.executable, "scripts/bench_compare.py", base, new,
+     "--fail-below", "1.5"], capture_output=True, text=True)
+assert out.returncode == 0, out.stderr
+assert "2.000" in out.stdout and "pipeline_gbps" in out.stdout, out.stdout
+bad = subprocess.run(
+    [sys.executable, "scripts/bench_compare.py", base, new,
+     "--fail-below", "3.0"], capture_output=True, text=True)
+assert bad.returncode == 2, f"regression gate did not trip: {bad.returncode}"
+assert "REGRESSION" in bad.stderr, bad.stderr
+print("bench_compare smoke OK")
+EOF
+
+echo "== real-subprocess gate (/queries + service op + gateway fan-out mid-query) =="
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu python - <<'EOF'
+import json, os, socket, subprocess, sys, tempfile, threading, time
+import urllib.request
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+d = tempfile.mkdtemp(prefix="srtpu-live-gate-")
+sock = os.path.join(d, "worker.sock")
+gw_sock = os.path.join(d, "gw.sock")
+
+# data + a FilterExec-over-scan plan (the service-protocol Spark shape)
+rng = np.random.default_rng(11)
+n = 200_000
+t = pa.table({"k": pa.array(rng.integers(0, 50, n).astype(np.int64)),
+              "v": pa.array(rng.normal(0.1, 1.0, n))})
+path = os.path.join(d, "t.parquet")
+pq.write_table(t, path)
+
+def attr(name, dt):
+    return [{"class": "org.apache.spark.sql.catalyst.expressions."
+             "AttributeReference", "num-children": 0, "name": name,
+             "dataType": dt, "nullable": True, "metadata": {},
+             "exprId": {"id": 1, "jvmId": "x"}, "qualifier": []}]
+
+plan = json.dumps([
+    {"class": "org.apache.spark.sql.execution.FilterExec",
+     "num-children": 1,
+     "condition": [{"class": "org.apache.spark.sql.catalyst.expressions."
+                    "GreaterThan", "num-children": 2}]
+     + attr("v", "double")
+     + [{"class": "org.apache.spark.sql.catalyst.expressions.Literal",
+         "num-children": 0, "value": "0.0", "dataType": "double"}]},
+    {"class": "org.apache.spark.sql.execution.FileSourceScanExec",
+     "num-children": 0, "relation": "HadoopFsRelation(parquet)",
+     "output": [attr("k", "long"), attr("v", "double")],
+     "tableIdentifier": "t"}])
+
+# pick a free HTTP port for the worker's telemetry server
+probe = socket.socket()
+probe.bind(("127.0.0.1", 0))
+port = probe.getsockname()[1]
+probe.close()
+
+worker = subprocess.Popen(
+    [sys.executable, "-m", "spark_rapids_tpu.service.server",
+     "--socket", sock, "--platform", "cpu",
+     "--conf", "spark.rapids.tpu.live.enabled=true",
+     "--conf", "spark.rapids.tpu.stats.enabled=true",
+     "--conf", "spark.rapids.tpu.telemetry.enabled=true",
+     "--conf", f"spark.rapids.tpu.telemetry.http.port={port}",
+     "--conf", "spark.rapids.sql.batchSizeRows=4096",
+     # every tracked device alloc sleeps: the query stays observably
+     # in-flight for the pollers below (unlimited fires)
+     "--conf",
+     "spark.rapids.tpu.test.faults=memory.alloc:delay,nth=0,times=0,delay=0.01"],
+    cwd=os.getcwd())
+
+from spark_rapids_tpu.fleet.gateway import FleetGateway
+from spark_rapids_tpu.service import TpuServiceClient
+
+gw = FleetGateway([("w0", sock)],
+                  {"spark.rapids.tpu.fleet.probe.intervalMs": 500,
+                   "spark.rapids.tpu.fleet.probe.timeoutSec": 5.0},
+                  gw_sock)
+gw_thread = None
+try:
+    cli = TpuServiceClient(sock, deadline_s=120.0).connect()
+    gw_thread = threading.Thread(target=gw.serve_forever, daemon=True)
+    gw_thread.start()
+    gcli = TpuServiceClient(gw_sock, deadline_s=120.0).connect()
+
+    # run 1: populates the worker's stats history (rows + wall)
+    r1 = cli.run_plan(plan, paths={"t": [path]}, query_id="live-q1")
+    assert r1.num_rows > 0
+
+    done = threading.Event()
+    result = {}
+    def submit():
+        c = TpuServiceClient(sock, deadline_s=300.0).connect()
+        result["table"] = c.run_plan(plan, paths={"t": [path]},
+                                     query_id="live-q2")
+        c.close()
+        done.set()
+    sub = threading.Thread(target=submit, daemon=True)
+    sub.start()
+
+    hits = {"http": False, "op": False, "gw": False}
+    progress_seq, etas = [], []
+    deadline = time.monotonic() + 240
+    while not done.is_set() and time.monotonic() < deadline:
+        body = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/queries", timeout=10).read())
+        for q in body["queries"]:
+            if q["query_id"] == "live-q2":
+                hits["http"] = True
+                if q["progress"] is not None:
+                    progress_seq.append(q["progress"])
+                if q["eta_s"] is not None:
+                    etas.append(q["eta_s"])
+        lv = cli.queries()
+        if any(q["query_id"] == "live-q2" for q in lv["queries"]):
+            hits["op"] = True
+        glv = gcli.queries()
+        for q in glv["queries"]:
+            if q["query_id"] == "live-q2":
+                assert q["worker"] == "w0", q
+                hits["gw"] = True
+        time.sleep(0.05)
+    sub.join(timeout=240)
+    assert done.is_set(), "FAIL: submitted query never finished"
+    assert result["table"].num_rows == r1.num_rows, "FAIL: rows differ"
+    assert all(hits.values()), f"FAIL: surfaces disagreed: {hits}"
+    assert progress_seq, "FAIL: no progress fractions observed"
+    assert progress_seq == sorted(progress_seq), \
+        f"FAIL: progress went backwards: {progress_seq}"
+    assert etas and all(e >= 0 for e in etas), \
+        f"FAIL: no finite ETA despite history: {etas}"
+    # terminal state: in-flight empty, the query in `recent`, fan-out
+    # annotated with worker state
+    lv = cli.queries()
+    assert any(r["query_id"] == "live-q2" for r in lv["recent"])
+    glv = gcli.queries()
+    assert glv["workers"]["w0"]["breaker"] == "closed", glv["workers"]
+    print(f"subprocess gate OK ({len(progress_seq)} progress samples, "
+          f"max={max(progress_seq):.3f}, eta range "
+          f"[{min(etas):.3f}, {max(etas):.3f}]s)")
+    gcli.close()
+    cli.shutdown()
+    cli.close()
+finally:
+    gw._stop.set()
+    worker.terminate()
+    worker.wait(timeout=20)
+EOF
+
+echo "liveview matrix OK"

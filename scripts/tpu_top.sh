@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Terminal ops console over the live query-introspection surface: polls
+# TpuDeviceService workers and/or a fleet gateway (queries/health/stats
+# service ops) and renders per-query progress bars, per-tenant admission
+# state, and per-worker breaker/cache/memory gauges.
+#
+# Usage: scripts/tpu_top.sh [NAME=]SOCKET... [--interval SEC] [--once]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# the console is engine-free (wire protocol only), no platform env needed
+exec python -m spark_rapids_tpu.tools.tpu_top "$@"

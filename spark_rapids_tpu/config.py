@@ -615,6 +615,43 @@ register("spark.rapids.tpu.stats.misestimate.incidentThreshold", "double",
          "evidence for plans that ran with catastrophically wrong "
          "cardinalities. 0 disables the incident hook.")
 
+# Live query introspection -----------------------------------------------------------
+register("spark.rapids.tpu.live.enabled", "bool", False,
+         "Live query introspection: a per-process registry of in-flight "
+         "queries (tenant, trace id, current operator, per-operator "
+         "rows/batches sampled from the existing metrics seams) with "
+         "progress/ETA estimated against the runtime-statistics history, "
+         "a slow-query watchdog thread, and exposure on /queries (HTTP), "
+         "the `queries` service op, the fleet-gateway fan-out, and the "
+         "tpu_live_* telemetry gauges. Off (default) spawns zero "
+         "threads, creates zero state, and keeps every hook at one "
+         "module-global check (scripts/liveview_matrix.sh gates it). "
+         "Progress fractions and ETAs need spark.rapids.tpu.stats."
+         "enabled so fingerprint history exists; without it queries "
+         "report rows-only progress.")
+register("spark.rapids.tpu.live.slowFactor", "double", 3.0,
+         "A query running longer than this multiple of its HISTORICAL "
+         "wall time (same statistics-history fingerprint) is flagged by "
+         "the watchdog as a flight-recorder `slow_query` incident "
+         "carrying the live operator snapshot. Queries with no history "
+         "are never flagged (fail-closed, no false positives).")
+register("spark.rapids.tpu.live.watchdog.intervalMs", "int", 500,
+         "Slow-query watchdog scan cadence over the in-flight registry.")
+register("spark.rapids.tpu.live.watchdog.cancel", "bool", False,
+         "Let the watchdog CANCEL a flagged slow query through its "
+         "CancelToken (the engine unwinds with the typed "
+         "QueryCancelledError at its next cooperative checkpoint). Off "
+         "(default) only flags and raises the incident.")
+register("spark.rapids.tpu.live.debugSignal", "bool", False,
+         "Install a SIGUSR2 handler that dumps the flight-recorder ring "
+         "plus the live query registry as a schema-valid JSONL incident "
+         "(reason `debug_signal`) — a wedged process becomes debuggable "
+         "without killing it. Requires the main thread to run "
+         "initialize_device.")
+register("spark.rapids.tpu.live.recentQueries", "int", 32,
+         "Recently finished queries kept (terminal snapshots) in the "
+         "live registry's ring for the /queries `recent` section.")
+
 # Compile service --------------------------------------------------------------------
 register("spark.rapids.tpu.compile.enabled", "bool", True,
          "Route every kernel compile through the centralized compile "

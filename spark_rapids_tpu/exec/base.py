@@ -22,6 +22,7 @@ from ..columnar.batch import ColumnarBatch, Schema
 from ..config import TpuConf, get_default_conf
 from ..expr.base import EvalContext, Vec
 from ..sched import context as _qctx
+from .. import live as _live
 from ..utils import metrics as M
 from ..utils import spans
 from ..utils.tracing import trace_range
@@ -70,6 +71,10 @@ class TpuExec:
             with trace_range(self.name):
                 for batch in self.do_execute():
                     _qctx.checkpoint()
+                    # live-introspection observer (one module-global bool
+                    # when off): stamps this op as the query's current
+                    # position — rows/batches come from the MetricsSet
+                    _live.note_pull(self)
                     yield batch
             return
         yield from self._instrumented_execute(prof)
@@ -107,6 +112,7 @@ class TpuExec:
                     # inside the pull must still register (the budget
                     # resets its peak at query start)
                     self.peak_dev_memory.set_max(budget.peak_used)
+                _live.note_pull(self)
                 if prof is not None:  # attr computation syncs; skip if off
                     sp.inc(batches=1, rows=int(batch.row_count()),
                            bytes=int(batch.device_memory_size()))
@@ -196,6 +202,7 @@ class PrefetchIterator:
         self._tm = TaskMetrics.get()  # the consumer's (task's) metrics
         self._sem = TpuSemaphore.get()
         self._ctx = _qctx.current()  # the consumer's query context
+        self._live_entry = _live.current_entry()  # the consumer's live view
         self._tm.prefetch_threads += 1
         PREFETCH_THREADS_STARTED += 1
         from .. import telemetry
@@ -212,6 +219,7 @@ class PrefetchIterator:
         TaskMetrics._tls.metrics = self._tm  # share the task's counters
         self._sem.adopt_task_hold()  # ride the task's admission permit
         _qctx.adopt(self._ctx)  # observe the consumer's cancel token
+        _live.adopt_entry(self._live_entry)  # pulls stay query-attributed
         try:
             while not self._stop.is_set():
                 _qctx.checkpoint()  # typed cancel crosses the queue below

@@ -31,6 +31,11 @@ request:
              route-decision counters).
   stats / health / cache_stats / cache_invalidate  gateway-local scrape,
              fleet health view, and per-worker cache fan-outs.
+  queries    live-introspection fan-out: every worker's in-flight query
+             view aggregated into one fleet answer, each query annotated
+             with its worker and each worker with breaker/draining/
+             outstanding state (partial on worker failure, never an
+             error).
 
 Observability rides PR-7: route-decision counters and per-worker
 breaker/outstanding gauges in the telemetry registry, trace ids
@@ -247,6 +252,8 @@ class FleetGateway:
                     send_msg(conn, {"ok": True, "health": self._health()})
                 elif op == "stats":
                     self._handle_stats(conn)
+                elif op == "queries":
+                    self._handle_queries_fanout(conn)
                 elif op in ("cache_stats", "cache_invalidate"):
                     self._handle_cache_fanout(conn, op)
                 elif op == "shutdown":
@@ -653,6 +660,98 @@ class FleetGateway:
             return
         body = telemetry.render_prometheus().encode("utf-8")
         send_msg(conn, {"ok": True, "lines": len(body.splitlines())}, body)
+
+    def _handle_queries_fanout(self, conn: socket.socket) -> None:
+        """`queries` fans out to every worker and aggregates one fleet
+        live view, each query annotated with the worker running it and
+        each worker slot with its breaker/draining/outstanding state.
+        PARTIAL by design, never an error: a breaker-OPEN worker is
+        skipped (its cooldown exists to stop hammering a dead socket)
+        and annotated, a worker that dies mid-poll degrades to an
+        `error` slot, a draining worker is still polled (its in-flight
+        queries are exactly what a rolling restart watches). Workers are
+        polled CONCURRENTLY (this is a 1-2s-cadence console surface; a
+        couple of stalled workers polled serially would stale every
+        frame by their summed timeouts), and a poll failure only
+        annotates its slot — monitoring traffic must never feed the
+        circuit breakers that route real queries (the background prober
+        owns dead-worker detection, exactly like the cache fan-out
+        below)."""
+        from ..errors import ServiceConnectionError as _SCE
+        workers_out: Dict[str, dict] = {}
+        queries: List[dict] = []
+        recent: List[dict] = []
+        out_mu = threading.Lock()
+        # flipped (under out_mu) once the reply is being assembled: a
+        # poller that outlived its join budget must DROP its result —
+        # writing into the dicts mid-serialization would error the op
+        # that is contractually partial-but-never-an-error
+        closed = [False]
+
+        def poll(name: str, w, state: dict) -> None:
+            try:
+                link = _WorkerLink(name, w.socket_path,
+                                   self.connect_timeout_s)
+                try:
+                    rep, _ = link.request(
+                        {"op": "queries"},
+                        timeout_s=self.connect_timeout_s + 5.0)
+                finally:
+                    link.close()
+            except _SCE as e:
+                with out_mu:
+                    if not closed[0]:
+                        workers_out[name] = {**state, "error": str(e)}
+                return
+            lv = rep.get("live") or {}
+            with out_mu:
+                if closed[0]:
+                    return
+                workers_out[name] = {
+                    **state, "enabled": bool(lv.get("enabled")),
+                    "queries": len(lv.get("queries") or ())}
+                for q in lv.get("queries") or ():
+                    q = dict(q)
+                    q["worker"] = name
+                    queries.append(q)
+                for q in lv.get("recent") or ():
+                    q = dict(q)
+                    q["worker"] = name
+                    recent.append(q)
+
+        pollers: List[threading.Thread] = []
+        for name, w in list(self.registry.workers.items()):
+            with self.registry._mu:
+                state = {"breaker": w.breaker.state,
+                         "draining": w.draining,
+                         "outstanding": w.outstanding}
+            if state["breaker"] == "open":
+                workers_out[name] = {**state, "skipped": "breaker_open"}
+                continue
+            th = threading.Thread(target=poll, args=(name, w, state),
+                                  name="fleet-queries-poll", daemon=True)
+            th.start()
+            pollers.append(th)
+        for th in pollers:
+            th.join(timeout=self.connect_timeout_s + 10.0)
+        with out_mu:
+            closed[0] = True  # late pollers drop their results from here
+            # a poller that outlived its join budget still gets an
+            # annotated slot
+            for name in list(self.registry.workers):
+                if name not in workers_out:
+                    workers_out[name] = {"error": "poll timed out"}
+        with self.registry._mu:
+            placements = dict(self.registry.placements)
+        send_msg(conn, {"ok": True, "live": {
+            "enabled": True, "role": "gateway",
+            "workers": workers_out,
+            "placements": placements,
+            "queries": sorted(queries,
+                              key=lambda q: q.get("started_ts", 0)),
+            "recent": sorted(recent,
+                             key=lambda q: q.get("ended_ts", 0)),
+        }})
 
     def _handle_cache_fanout(self, conn: socket.socket, op: str) -> None:
         """cache_stats/cache_invalidate fan out to every worker; one dead

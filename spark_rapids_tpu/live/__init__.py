@@ -1,0 +1,258 @@
+"""Live query introspection — what is running RIGHT NOW.
+
+Every observability layer before this one is retrospective: the query
+profiler (utils/spans.py) exports when a query finishes, telemetry
+(telemetry/) exposes aggregate counters, and the statistics history
+(stats/) feeds the NEXT run. This package is the in-flight view an
+operator of a long-lived serving tier needs — the Spark SQL UI's live
+stage page, as a registry plus wire surfaces:
+
+  * `registry.py` — per-process live query registry: tenant/priority/
+    trace id, current operator, per-operator rows/batches/bytes sampled
+    from the existing MetricsSet baselines, progress and ETA dividing
+    live actuals by the PR-11 stats-history expectations for the same
+    fingerprints (fail-closed: no history => rows-only progress, no
+    ETA).
+  * `watchdog.py` — background thread flagging queries that exceed
+    `live.slowFactor` x their historical runtime (or approach their
+    scheduler deadline) as flight-recorder `slow_query` incidents with
+    the live snapshot attached; `live.watchdog.cancel` additionally
+    cancels them through the PR-6 CancelToken.
+  * Exposure everywhere the engine already answers: `/queries` on the
+    telemetry HTTP server, the `queries` service op
+    (TpuServiceClient.queries()), a fleet-gateway fan-out aggregating
+    every worker's live view, `tpu_live_queries` /
+    `tpu_live_query_progress` telemetry gauges, and the
+    `tools/tpu_top.py` terminal console.
+
+Off-path contract (mirrors telemetry/rescache/stats): with
+`spark.rapids.tpu.live.enabled=false` (default) every hook below is one
+module-global bool check, no registry/watchdog object exists, zero
+threads are spawned, and results are byte-identical —
+scripts/liveview_matrix.sh gates it. `configure(conf)` only ever
+ENABLES (idempotent); `shutdown()` tears down explicitly (tests).
+
+`live.debugSignal` additionally installs a SIGUSR2 handler that dumps
+the flight-recorder ring plus the live registry as a schema-valid JSONL
+incident — a wedged process becomes debuggable without killing it."""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from .registry import LiveQuery, LiveQueryRegistry
+from .watchdog import Watchdog
+
+__all__ = ["configure", "shutdown", "is_enabled", "get", "watchdog",
+           "query_begin", "query_end", "note_pull", "current_entry",
+           "adopt_entry", "snapshot", "debug_dump", "LiveQuery",
+           "LiveQueryRegistry", "Watchdog"]
+
+_ACTIVE = False
+_mu = threading.Lock()
+_tls = threading.local()
+_registry: Optional[LiveQueryRegistry] = None
+_watchdog: Optional[Watchdog] = None
+_conf = None
+_prev_sigusr2 = None
+
+
+def is_enabled() -> bool:
+    return _ACTIVE
+
+
+def get() -> Optional[LiveQueryRegistry]:
+    return _registry
+
+
+def watchdog() -> Optional[Watchdog]:
+    return _watchdog
+
+
+# --------------------------------------------------------------- lifecycle
+def configure(conf) -> None:
+    """Enable per `spark.rapids.tpu.live.*` (no-op when the switch is off
+    or the registry is already up). Called from
+    TpuSession.initialize_device, like telemetry/rescache/stats."""
+    global _ACTIVE, _registry, _watchdog, _conf, _prev_sigusr2
+    if not conf.get("spark.rapids.tpu.live.enabled"):
+        return
+    with _mu:
+        if _ACTIVE:
+            _conf = conf
+            return
+        _registry = LiveQueryRegistry(
+            recent=conf.get("spark.rapids.tpu.live.recentQueries"))
+        _watchdog = Watchdog(
+            _registry,
+            interval_s=conf.get(
+                "spark.rapids.tpu.live.watchdog.intervalMs") / 1000.0,
+            slow_factor=conf.get("spark.rapids.tpu.live.slowFactor"),
+            cancel=conf.get("spark.rapids.tpu.live.watchdog.cancel"))
+        _watchdog.start()
+        _conf = conf
+        _ACTIVE = True
+        if conf.get("spark.rapids.tpu.live.debugSignal"):
+            try:
+                import signal
+                _prev_sigusr2 = signal.signal(signal.SIGUSR2,
+                                              _on_debug_signal)
+            except (ValueError, OSError, AttributeError):
+                # not the main thread / no SIGUSR2 on this platform: the
+                # registry still works, only the signal surface is lost
+                _prev_sigusr2 = None
+
+
+def shutdown() -> None:
+    """Tear the live surface down (tests / process exit)."""
+    global _ACTIVE, _registry, _watchdog, _conf, _prev_sigusr2
+    with _mu:
+        _ACTIVE = False
+        if _watchdog is not None:
+            _watchdog.stop()
+        if _prev_sigusr2 is not None:
+            try:
+                import signal
+                signal.signal(signal.SIGUSR2, _prev_sigusr2)
+            except (ValueError, OSError):
+                pass
+            _prev_sigusr2 = None
+        _registry = _watchdog = _conf = None
+    _tls.entry = None
+
+
+# ------------------------------------------------------------- query hooks
+def query_begin(root, conf, label: str = "query") -> Optional[LiveQuery]:
+    """Register one query's exec tree as in-flight (baselines snapshot
+    here) and bind the entry to this thread for the pull hook. None when
+    live is off; never raises."""
+    if not _ACTIVE:
+        return None
+    reg = _registry
+    if reg is None:
+        return None
+    try:
+        entry = reg.begin(root, conf, label)
+    except Exception:
+        return None
+    entry._prev_tls = getattr(_tls, "entry", None)
+    _tls.entry = entry
+    return entry
+
+
+def query_end(entry: Optional[LiveQuery], status: str = "ok") -> None:
+    """Retire an in-flight entry with its terminal status; restores the
+    outer entry for nested (adaptive-stage) begins. No-op for None."""
+    if entry is None:
+        return
+    _tls.entry = entry._prev_tls
+    reg = _registry
+    if reg is not None:
+        try:
+            reg.end(entry, status)
+        except Exception:
+            pass
+
+
+def note_pull(node) -> None:
+    """The ONE hot-path observer hook, called per exec batch pull
+    (exec/base.py). Off = one module-global bool check."""
+    if not _ACTIVE:
+        return
+    entry = getattr(_tls, "entry", None)
+    if entry is None:
+        # worker threads that did not adopt (shuffle pools) attribute
+        # through the query context they observe
+        from ..sched import context as _qctx
+        ctx = _qctx.current()
+        if ctx is None:
+            return
+        reg = _registry
+        if reg is None:
+            return
+        entry = reg.entry_for_ctx(ctx)
+        if entry is None:
+            return
+    entry.note(node)
+
+
+def current_entry() -> Optional[LiveQuery]:
+    """This thread's live entry (the prefetch producer captures it at
+    spawn, exactly like TaskMetrics and the query context)."""
+    if not _ACTIVE:
+        return None
+    return getattr(_tls, "entry", None)
+
+
+def adopt_entry(entry: Optional[LiveQuery]) -> None:
+    """Attach an existing entry to the CURRENT thread (prefetch-producer
+    pattern). No-op for None."""
+    if entry is not None:
+        _tls.entry = entry
+
+
+# ----------------------------------------------------------------- surface
+def snapshot() -> Dict[str, Any]:
+    """The wire shape every surface serves ({enabled, queries, recent});
+    answers even with live off so pollers need no conf knowledge."""
+    reg = _registry
+    if reg is None:
+        return {"enabled": False, "pid": os.getpid(), "queries": [],
+                "recent": []}
+    return reg.snapshot()
+
+
+# ------------------------------------------------------------ debug signal
+def _on_debug_signal(signum, frame) -> None:
+    """SIGUSR2 entry point. The dump itself runs on a one-shot thread:
+    the handler executes on the main thread between bytecodes, possibly
+    while that same thread holds the registry or flight-recorder lock —
+    taking those locks inline would deadlock the exact process this
+    signal exists to diagnose (same discipline as the rejection-storm
+    dump in telemetry.count_rejection)."""
+    try:
+        threading.Thread(target=debug_dump, daemon=True,
+                         name="tpu-live-debug-dump").start()
+    except Exception:
+        pass
+
+
+def debug_dump() -> Optional[str]:
+    """Dump the flight-recorder ring plus the live registry as one
+    schema-valid JSONL incident (reason `debug_signal`). With a
+    dump-capable flight recorder up, the recorder writes it (ring events
+    included, per-reason rate limit honored — a suppressed dump stays
+    suppressed); without one, a standalone header-only incident lands in
+    the configured event-log / flight-recorder directory. Returns the
+    path, or None when nothing could (or should) be written."""
+    snap = snapshot()
+    from .. import telemetry
+    rec = telemetry.flight_recorder()
+    if rec is not None and rec.dump_dir:
+        # None here means the per-reason rate limiter suppressed it:
+        # respect that (the limiter is the signal-flood guard), never
+        # fall through to an unlimited side channel
+        return rec.dump("debug_signal", attrs={"live": snap})
+    conf = _conf
+    dump_dir = ""
+    if conf is not None:
+        dump_dir = conf.get(
+            "spark.rapids.tpu.telemetry.flightRecorder.dir") or conf.get(
+            "spark.rapids.tpu.metrics.eventLog.dir") or ""
+    if not dump_dir:
+        return None
+    from ..utils import spans
+    os.makedirs(dump_dir, exist_ok=True)
+    # time_ns keeps two dumps in the same wall second from overwriting
+    path = os.path.join(
+        dump_dir, f"incident-{time.strftime('%Y%m%dT%H%M%S')}-"
+                  f"{os.getpid()}-{time.monotonic_ns() % 1_000_000}-"
+                  f"debug_signal.jsonl")
+    record = spans.incident_record("debug_signal",
+                                   attrs={"live": snap})
+    with open(path, "w") as f:
+        f.write(spans.to_json_line(record) + "\n")
+    return path

@@ -1,0 +1,339 @@
+"""Live query registry — the in-flight half of observability.
+
+One `LiveQuery` per executing query: its scheduling identity (tenant /
+priority / deadline / trace id), the operator it is currently pulling
+batches from, and per-operator rows/batches/bytes so far. All of it is
+SAMPLED from the existing MetricsSet seams — each operator's
+`numOutputRows`/`numOutputBatches`/`dataSize` metrics already exist and
+are already fed by the execs, so the registry records a baseline at
+query start and reads plain host integers afterwards: no new hot-path
+instrumentation, no device syncs, and the one observer hook
+(`live.note_pull`, exec/base.py) only stamps the current operator and
+bumps a pull counter.
+
+Progress and ETA divide live actuals by the PR-11 statistics history's
+expectations for the same fingerprints (`stats.annotate` attaches
+`_stats_digest` per exec node during conversion; `StatsHistory.peek`
+reads without distorting hit/miss accounting or LRU order). Fail-closed:
+a query with no history (stats off, fail-closed fingerprints, or a
+first-ever run) reports rows-only progress (`progress: null`) and no
+ETA — and the watchdog can never flag it slow. The historical RUNTIME an
+ETA needs rides the same history entries: `LiveQueryRegistry.end`
+records the root digest's observed wall seconds (`OpStats.wall_s`) on
+every ok query, so the SECOND run of a plan has both an expected
+cardinality per operator and an expected wall clock.
+
+The reported progress fraction is monotonically nondecreasing per query
+(a floor is kept across snapshots): pollers comparing successive scrapes
+never see progress move backwards even while per-operator row counters
+race the sampler."""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from ..sched import context as _qctx
+from ..utils import spans
+
+__all__ = ["LiveQuery", "LiveQueryRegistry"]
+
+
+class _OpSlot:
+    """One operator's live sampling state: Metric object references (host
+    ints, lock-free reads) plus the query-start baselines so reused exec
+    instances report only THIS query's deltas."""
+
+    __slots__ = ("name", "rows_m", "batches_m", "bytes_m", "base_rows",
+                 "base_batches", "base_bytes", "expected_rows")
+
+    def __init__(self, node, expected_rows: float):
+        self.name = getattr(node, "name", type(node).__name__)
+        ms = node.metrics
+        self.rows_m = ms["numOutputRows"]
+        self.batches_m = ms["numOutputBatches"]
+        self.bytes_m = ms["dataSize"]          # NOOP metric when absent
+        self.base_rows = self.rows_m.value
+        self.base_batches = self.batches_m.value
+        self.base_bytes = self.bytes_m.value
+        self.expected_rows = expected_rows
+
+    def sample(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "name": self.name,
+            "rows": int(self.rows_m.value - self.base_rows),
+            "batches": int(self.batches_m.value - self.base_batches),
+        }
+        b = int(self.bytes_m.value - self.base_bytes)
+        if b:
+            d["bytes"] = b
+        return d
+
+
+class LiveQuery:
+    """Live view of one in-flight query."""
+
+    def __init__(self, root, conf, label: str, query_id: str,
+                 ctx=None, trace_id: str = ""):
+        self.label = label
+        self.query_id = query_id
+        self.ctx = ctx
+        self.trace_id = trace_id
+        self.tenant = ctx.tenant if ctx is not None else "default"
+        self.priority = ctx.priority if ctx is not None else 0
+        self.deadline_s = (ctx.token.deadline_s
+                           if ctx is not None else None)
+        self.start_ts = time.time()
+        self.start_ns = time.monotonic_ns()
+        self.current_op = ""
+        self.pulls = 0
+        self.last_pull_ns = 0
+        self.slow = False
+        self.slow_reason = ""
+        self._progress_floor: Optional[float] = None
+        # guards the floor's read-modify-write: HTTP pollers, the
+        # service op, gauges, and the watchdog all sample concurrently,
+        # and an unsynchronized update could serve a fraction LOWER than
+        # one already reported — the exact regression the floor forbids
+        self._pmu = threading.Lock()
+        # the query thread's TaskMetrics: prefetch producers share it, so
+        # these counters describe the whole query regardless of threads
+        from ..utils.metrics import TaskMetrics
+        self._tm = TaskMetrics.get()
+        # restore slot for nested begins (adaptive stages) — the facade
+        # saves the outer thread-local entry here
+        self._prev_tls = None
+
+        hist = self._history()
+        self._slots: List[_OpSlot] = []
+        self._by_node: Dict[int, _OpSlot] = {}
+        self.root_digest = getattr(root, "_stats_digest", None)
+        self.root_persistable = bool(
+            getattr(root, "_stats_persistable", False))
+        self.root_op = getattr(root, "name", type(root).__name__)
+        self.expected_wall_s = 0.0
+        if hist is not None and self.root_digest:
+            e = hist.peek(self.root_digest)
+            if e is not None:
+                self.expected_wall_s = float(e.wall_s or 0.0)
+
+        def walk(node):
+            if not hasattr(node, "metrics"):
+                return
+            expected = 0.0
+            digest = getattr(node, "_stats_digest", None)
+            if hist is not None and digest:
+                e = hist.peek(digest)
+                if e is not None and e.rows > 0:
+                    expected = float(e.rows)
+            slot = _OpSlot(node, expected)
+            self._slots.append(slot)
+            self._by_node[id(node)] = slot
+            for child in getattr(node, "children", ()):
+                walk(child)
+
+        walk(root)
+
+    @staticmethod
+    def _history():
+        """The stats history when the stats subsystem is up, else None —
+        every expectation below fails closed through this."""
+        try:
+            from .. import stats as _stats
+            return _stats.get()
+        except Exception:
+            return None
+
+    # ------------------------------------------------------------ hot hook
+    def note(self, node) -> None:
+        """Per exec pull: stamp the current operator. The row/batch
+        actuals live in the operator's own metrics — nothing to count
+        here."""
+        slot = self._by_node.get(id(node))
+        if slot is not None:
+            self.current_op = slot.name
+        self.pulls += 1
+        self.last_pull_ns = time.monotonic_ns()
+
+    # ------------------------------------------------------------ sampling
+    def elapsed_s(self) -> float:
+        return (time.monotonic_ns() - self.start_ns) / 1e9
+
+    def remaining_s(self) -> Optional[float]:
+        if self.ctx is None:
+            return None
+        return self.ctx.token.remaining_s()
+
+    def progress(self) -> Optional[float]:
+        """Mean per-operator completion fraction over the operators with
+        a history expectation; None when no operator has one (rows-only
+        mode). Monotonically nondecreasing across calls."""
+        fracs = [min(s.rows_m.value - s.base_rows, s.expected_rows)
+                 / s.expected_rows
+                 for s in self._slots if s.expected_rows > 0]
+        if not fracs:
+            return self._progress_floor
+        p = sum(fracs) / len(fracs)
+        with self._pmu:
+            floor = self._progress_floor
+            if floor is None or p > floor:
+                self._progress_floor = p
+                return p
+            return floor
+
+    def snapshot(self) -> Dict[str, Any]:
+        """One JSON-safe dict: identity, per-operator actuals (and
+        expectations where history exists), progress, ETA."""
+        ops: List[Dict[str, Any]] = []
+        for s in self._slots:
+            d = s.sample()
+            if s.expected_rows > 0:
+                d["expected_rows"] = s.expected_rows
+                d["fraction"] = round(min(d["rows"] / s.expected_rows,
+                                          1.0), 4)
+            ops.append(d)
+        progress = self.progress()
+        elapsed = self.elapsed_s()
+        eta = None
+        if self.expected_wall_s > 0:
+            # history exists for the whole-query fingerprint: a finite
+            # ETA either way (progress-scaled when per-op expectations
+            # resolved, remaining-of-historical-wall otherwise)
+            if progress is not None:
+                eta = round(self.expected_wall_s * (1.0 - progress), 4)
+            else:
+                eta = round(max(self.expected_wall_s - elapsed, 0.0), 4)
+        tm = self._tm
+        out: Dict[str, Any] = {
+            "query_id": self.query_id,
+            "label": self.label,
+            "trace_id": self.trace_id,
+            "tenant": self.tenant,
+            "priority": self.priority,
+            "status": "running",
+            "started_ts": self.start_ts,
+            "elapsed_s": round(elapsed, 4),
+            "operator": self.current_op,
+            "pulls": self.pulls,
+            "rows": sum(o["rows"] for o in ops),
+            "progress": None if progress is None else round(progress, 4),
+            "eta_s": eta,
+            "expected_wall_s": self.expected_wall_s or None,
+            "slow": self.slow,
+            "ops": ops,
+            # the TaskMetrics slice an operator console cares about
+            "task": {
+                "sched_admissions": tm.sched_admissions,
+                "prefetch_batches": tm.prefetch_batches,
+                "scan_dispatches": tm.scan_dispatches,
+                "retry_count": tm.retry_count,
+                "scan_rows_pruned": tm.scan_rows_pruned,
+            },
+        }
+        if self.deadline_s:
+            out["deadline_s"] = self.deadline_s
+            out["remaining_s"] = self.remaining_s()
+        if self.slow:
+            out["slow_reason"] = self.slow_reason
+        return out
+
+
+class LiveQueryRegistry:
+    """Process-wide map of in-flight queries plus a bounded ring of
+    recently finished ones (their terminal snapshots)."""
+
+    _counter = itertools.count(1)
+
+    def __init__(self, recent: int = 32):
+        self._mu = threading.Lock()
+        self._inflight: Dict[str, LiveQuery] = {}
+        self._by_ctx: Dict[int, LiveQuery] = {}
+        self._recent: "deque[Dict[str, Any]]" = deque(
+            maxlen=max(int(recent), 1))
+
+    # ------------------------------------------------------------ lifecycle
+    def begin(self, root, conf, label: str) -> LiveQuery:
+        ctx = _qctx.current()
+        trace_id = spans.current_trace() or ""
+        qid = ctx.query_id if ctx is not None else \
+            f"lv-{os.getpid()}-{next(LiveQueryRegistry._counter)}"
+        entry = LiveQuery(root, conf, label, qid, ctx=ctx,
+                          trace_id=trace_id)
+        with self._mu:
+            # adaptive stages reuse the context's query_id: suffix so
+            # each stage stays individually visible
+            base, n = entry.query_id, 2
+            while entry.query_id in self._inflight:
+                entry.query_id = f"{base}#{n}"
+                n += 1
+            self._inflight[entry.query_id] = entry
+            if ctx is not None:
+                self._by_ctx[id(ctx)] = entry
+        return entry
+
+    def end(self, entry: LiveQuery, status: str = "ok") -> None:
+        snap = entry.snapshot()
+        snap["status"] = status
+        snap["ended_ts"] = time.time()
+        with self._mu:
+            self._inflight.pop(entry.query_id, None)
+            if entry.ctx is not None and \
+                    self._by_ctx.get(id(entry.ctx)) is entry:
+                del self._by_ctx[id(entry.ctx)]
+            self._recent.append(snap)
+        if status == "ok" and entry.root_digest:
+            self._record_wall(entry, snap)
+
+    @staticmethod
+    def _record_wall(entry: LiveQuery, snap: Dict[str, Any]) -> None:
+        """Feed the observed wall seconds for the root fingerprint into
+        the stats history — the expectation the NEXT run's ETA and the
+        watchdog's slow threshold divide by. Best-effort: live must never
+        fail a query."""
+        try:
+            hist = LiveQuery._history()
+            if hist is None:
+                return
+            from ..stats.history import OpStats
+            root_rows = snap["ops"][0]["rows"] if snap["ops"] else 0
+            hist.record(OpStats(digest=entry.root_digest,
+                                op=entry.root_op,
+                                rows=float(root_rows),
+                                wall_s=entry.elapsed_s()),
+                        persistable=entry.root_persistable)
+        except Exception:
+            pass
+
+    # -------------------------------------------------------------- queries
+    def entry_for_ctx(self, ctx) -> Optional[LiveQuery]:
+        with self._mu:
+            return self._by_ctx.get(id(ctx))
+
+    def inflight(self) -> List[LiveQuery]:
+        with self._mu:
+            return sorted(self._inflight.values(),
+                          key=lambda e: e.start_ns)
+
+    def flag_slow(self, entry: LiveQuery, reason: str) -> bool:
+        """Mark one entry slow (idempotent); True on the FIRST flag —
+        the watchdog raises exactly one incident per query."""
+        with self._mu:
+            if entry.slow:
+                return False
+            entry.slow = True
+            entry.slow_reason = reason
+            return True
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._mu:
+            recent = list(self._recent)
+        return {
+            "enabled": True,
+            "pid": os.getpid(),
+            "queries": [e.snapshot() for e in self.inflight()],
+            "recent": recent,
+        }

@@ -1,0 +1,113 @@
+"""Slow-query watchdog: the thread that turns the live registry into
+alerts.
+
+Every `live.watchdog.intervalMs` it scans the in-flight entries and
+flags as SLOW any query that
+
+  * has run longer than `live.slowFactor` x its historical wall time for
+    the same fingerprint (the expectation `LiveQueryRegistry.end`
+    records into the stats history), or
+  * is inside the last 10% of its scheduler deadline (it will be killed
+    by the deadline soon — the watchdog surfaces it while an operator
+    can still act).
+
+A flagged query raises ONE flight-recorder `slow_query` incident (under
+the query's own trace id, so the dump correlates with its profile and
+the client that submitted it) carrying the full live snapshot — the
+current operator and every per-operator actual at flag time. Under
+`live.watchdog.cancel` (default off) the watchdog additionally cancels
+the query's CancelToken: the engine unwinds with the typed
+QueryCancelledError at its next cooperative checkpoint.
+
+No-false-positive contract: a query with NO runtime history is never
+flagged on the slowFactor rule — there is nothing to be slow relative
+to. The deadline rule needs an explicit scheduler deadline. Both are
+fail-closed, mirroring the progress/ETA estimation."""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+__all__ = ["Watchdog"]
+
+# deadline-approaching threshold: remaining budget below this fraction of
+# the configured deadline flags the query
+_DEADLINE_FRACTION = 0.1
+
+
+class Watchdog(threading.Thread):
+    def __init__(self, registry, interval_s: float, slow_factor: float,
+                 cancel: bool = False):
+        super().__init__(name="tpu-live-watchdog", daemon=True)
+        self._registry = registry
+        self._interval_s = max(interval_s, 0.01)
+        self._slow_factor = slow_factor
+        self._cancel = cancel
+        self._halt = threading.Event()
+        self.flags = 0           # lifetime slow flags (diagnostics/tests)
+
+    def stop(self) -> None:
+        self._halt.set()
+        self.join(timeout=self._interval_s + 2.0)
+
+    def run(self) -> None:
+        while not self._halt.wait(self._interval_s):
+            try:
+                self.scan()
+            except Exception:
+                pass  # the watchdog must never die of a scan bug
+
+    # ------------------------------------------------------------------
+    def scan(self) -> int:
+        """One pass over the in-flight entries; returns how many were
+        newly flagged (tests call this directly for determinism)."""
+        flagged = 0
+        for entry in self._registry.inflight():
+            if entry.slow:
+                continue
+            reason = self._verdict(entry)
+            if reason is None:
+                continue
+            if self._registry.flag_slow(entry, reason):
+                flagged += 1
+                self.flags += 1
+                self._raise_incident(entry, reason)
+                if self._cancel and entry.ctx is not None:
+                    entry.ctx.token.cancel(
+                        f"slow-query watchdog: {reason}")
+        return flagged
+
+    def _verdict(self, entry) -> Optional[str]:
+        elapsed = entry.elapsed_s()
+        if entry.expected_wall_s > 0 and \
+                elapsed > self._slow_factor * entry.expected_wall_s:
+            return (f"elapsed {elapsed:.3f}s exceeds "
+                    f"{self._slow_factor:g}x historical wall "
+                    f"{entry.expected_wall_s:.3f}s")
+        if entry.deadline_s:
+            remaining = entry.remaining_s()
+            if remaining is not None and \
+                    remaining <= _DEADLINE_FRACTION * entry.deadline_s:
+                return (f"approaching deadline: {remaining:.3f}s of "
+                        f"{entry.deadline_s:g}s remaining")
+        return None
+
+    @staticmethod
+    def _raise_incident(entry, reason: str) -> None:
+        """One flight-recorder incident with the live operator snapshot
+        attached, stamped with the query's trace id (the watchdog thread
+        has no trace scope of its own)."""
+        try:
+            from .. import telemetry
+            from ..utils import spans
+            with spans.trace_scope(entry.trace_id or None):
+                telemetry.incident(
+                    "slow_query",
+                    query_id=entry.query_id,
+                    label=entry.label,
+                    tenant=entry.tenant,
+                    slow_reason=reason,
+                    live=entry.snapshot())
+        except Exception:
+            pass

@@ -51,6 +51,12 @@ class TpuSession:
         # must create no state, spawn no threads, and leave planning
         # byte-identical (stats_matrix.sh gate)
         stats.configure(self.conf)
+        from . import live
+        # live query introspection (in-flight registry + slow-query
+        # watchdog): a no-op unless spark.rapids.tpu.live.enabled — the
+        # off path must create no state, spawn no threads, and keep
+        # results byte-identical (liveview_matrix.sh gate)
+        live.configure(self.conf)
         from .compile import CompileService
         # compile service first: warmup precompiles on a background thread
         # while the rest of init (and the first plan rewrite) proceeds
@@ -257,6 +263,11 @@ class TpuSession:
             # the estimate-vs-actual ledger (one bool when stats is off)
             from . import stats as _stats
             st_obs = _stats.begin(result, self.conf)
+            # live query introspection: register this query as in-flight
+            # (one bool when live is off) — the registry samples the same
+            # MetricsSet baselines at each pull for progress/ETA
+            from . import live as _lq
+            lv = _lq.query_begin(result, self.conf, label=result.name)
             q_status = "ok"
             telemetry.flight("query", "begin", label=result.name)
             try:
@@ -375,6 +386,10 @@ class TpuSession:
                 telemetry.inc("tpu_queries_total", status=q_status)
                 telemetry.flight("query", "end", label=result.name,
                                  status=q_status)
+                # retire the live-registry entry (records this query's
+                # wall time into the stats history on ok — the runtime
+                # expectation the next run's ETA and the watchdog need)
+                _lq.query_end(lv, q_status)
                 # runtime statistics: derive actuals, record history,
                 # keep the ledger for explain_analyze (discarded on a
                 # non-ok unwind — partial actuals must not poison)

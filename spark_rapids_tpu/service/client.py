@@ -267,6 +267,18 @@ class TpuServiceClient:
             raise RuntimeError(rep.get("error", "cache invalidate failed"))
         return rep["dropped"]
 
+    def queries(self) -> dict:
+        """The server's live query-introspection snapshot: in-flight
+        queries (tenant, current operator, per-operator rows, progress/
+        ETA where statistics history exists) plus recently finished
+        ones. Against a fleet gateway this is the aggregated fleet view
+        with per-worker breaker/draining annotations. Always answers —
+        `enabled: false` when the server runs with live off."""
+        rep, _ = self._request({"op": "queries"})
+        if not rep.get("ok"):
+            raise RuntimeError(rep.get("error", "queries unavailable"))
+        return rep["live"]
+
     def health(self) -> dict:
         """The server's /healthz snapshot (device init state, admission
         alive probe, heartbeat peers, event-log writability). Works

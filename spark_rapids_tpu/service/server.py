@@ -30,6 +30,9 @@ unix-domain socket:
                its CancelToken — the engine unwinds at the next
                cooperative cancellation point; `priority` reassigns the
                context's priority for its future admissions.
+  queries   -> live query-introspection snapshot (live.snapshot()): the
+               in-flight registry with progress/ETA plus recent queries;
+               answers enabled:false when live introspection is off
   cache_stats      -> result/fragment-cache accounting (rescache.stats())
   cache_invalidate -> drop every cached result/fragment (out-of-band data
                rewrites the file-identity keys cannot observe)
@@ -194,6 +197,8 @@ class TpuDeviceService:
                     self._handle_stats(conn)
                 elif op == "health":
                     self._handle_health(conn)
+                elif op == "queries":
+                    self._handle_queries(conn)
                 elif op == "cache_stats":
                     self._handle_cache_stats(conn)
                 elif op == "cache_invalidate":
@@ -312,6 +317,15 @@ class TpuDeviceService:
         from ..telemetry import health_snapshot
         snap = health_snapshot(self.session.conf)
         send_msg(conn, {"ok": True, "health": snap})
+
+    def _handle_queries(self, conn: socket.socket) -> None:
+        """`queries` op: the live-introspection snapshot (in-flight
+        queries with progress/ETA plus the recent ring). Always answers
+        ok — `enabled: false` with empty lists when
+        spark.rapids.tpu.live.enabled is off, so a fleet fan-out over
+        mixed-config workers degrades per slot instead of erroring."""
+        from .. import live
+        send_msg(conn, {"ok": True, "live": live.snapshot()})
 
     def _handle_cache_stats(self, conn: socket.socket) -> None:
         """`cache_stats` op: the result/fragment cache's lifetime

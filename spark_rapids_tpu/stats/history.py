@@ -77,6 +77,10 @@ class OpStats:
     est_rows: float = 0.0         # the estimate current when recorded
     q_err: float = 1.0            # q_error(est_rows, rows) at record time
     seen: int = 1                 # observations folded into this entry
+    # observed whole-query wall seconds (root fingerprints only, fed by
+    # the live registry at query end) — the runtime expectation the live
+    # ETA and the slow-query watchdog divide by. 0 = never observed.
+    wall_s: float = 0.0
 
     def to_json(self) -> Dict[str, Any]:
         d = dataclasses.asdict(self)
@@ -130,6 +134,15 @@ class StatsHistory:
             self.hits += 1
             return e
 
+    def peek(self, digest: Optional[str]) -> Optional[OpStats]:
+        """Read-only probe: no hit/miss counting, no LRU reordering —
+        the live registry samples expectations through this so its
+        polling cannot distort feedback accounting or eviction order."""
+        if not digest:
+            return None
+        with self._mu:
+            return self._entries.get(digest)
+
     def stats(self) -> Dict[str, Any]:
         with self._mu:
             return {"entries": len(self._entries), "hits": self.hits,
@@ -159,12 +172,22 @@ class StatsHistory:
                     entry.build_rows = prev.build_rows
                 if entry.bytes == 0:
                     entry.bytes = prev.bytes
+                if entry.batches == 0:
+                    entry.batches = prev.batches
+                if entry.wall_s == 0.0:
+                    entry.wall_s = prev.wall_s
+                if entry.est_rows == 0.0:
+                    # a wall-only live record must not erase the stats
+                    # observer's estimate diagnostics for this digest
+                    entry.est_rows = prev.est_rows
+                    entry.q_err = prev.q_err
                 # persist churn guard: a steady-state entry (same rows
                 # within 1%) re-appends nothing — dashboards re-running
                 # the same query must not grow the file without bound
                 changed = abs(prev.rows - entry.rows) > \
                     0.01 * max(prev.rows, 1.0) or \
-                    (prev.part_bytes is None) != (entry.part_bytes is None)
+                    (prev.part_bytes is None) != (entry.part_bytes is None) \
+                    or (prev.wall_s == 0.0) != (entry.wall_s == 0.0)
             self._entries[entry.digest] = entry
             self._entries.move_to_end(entry.digest)
             while len(self._entries) > self._max:

@@ -444,6 +444,18 @@ def _install_families(reg: MetricsRegistry) -> None:
                   "at shuffle-write close (spread across buckets = "
                   "partition skew).", buckets=BYTE_BUCKETS)
 
+    # live query introspection (live/): the in-flight view. Cardinality
+    # is bounded by concurrent queries (itself bounded by admission), so
+    # per-query_id progress labels stay far under the registry cap; the
+    # callbacks read the live registry singleton without constructing it
+    reg.gauge("tpu_live_queries",
+              "In-flight queries tracked by the live registry, by "
+              "tenant.", ["tenant"], callback=_live_queries_gauge)
+    reg.gauge("tpu_live_query_progress",
+              "Progress fraction (0..1) per in-flight query with "
+              "statistics-history expectations; rows-only queries are "
+              "omitted.", ["query_id"], callback=_live_progress_gauge)
+
     # fleet gateway (fleet/): route decisions + per-worker pool gauges.
     # Callbacks observe live WorkerRegistries through sys.modules ONLY —
     # a process that never started a gateway never imports the package
@@ -574,6 +586,31 @@ def _stats_history_gauge():
     from .. import stats
     h = stats.get()
     return h.entry_count if h is not None else None
+
+
+def _live_queries_gauge():
+    from .. import live
+    reg = live.get()
+    if reg is None:
+        return {}
+    out: Dict[tuple, float] = {}
+    for e in reg.inflight():
+        key = (e.tenant,)
+        out[key] = out.get(key, 0) + 1
+    return out
+
+
+def _live_progress_gauge():
+    from .. import live
+    reg = live.get()
+    if reg is None:
+        return {}
+    out: Dict[tuple, float] = {}
+    for e in reg.inflight():
+        p = e.progress()
+        if p is not None:
+            out[(e.query_id,)] = p
+    return out
 
 
 def _fleet_gauge(which: str):

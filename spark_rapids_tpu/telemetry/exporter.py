@@ -111,7 +111,8 @@ def health_snapshot(conf=None) -> Dict[str, Any]:
 
 
 class TelemetryHttpServer:
-    """`/metrics` + `/healthz` on one daemon thread (stdlib only).
+    """`/metrics` + `/healthz` + `/queries` on one daemon thread
+    (stdlib only).
 
     Responses are computed per request from the live registry/singletons;
     /healthz answers 200 when `ok` else 503 so a k8s-style probe needs no
@@ -134,6 +135,14 @@ class TelemetryHttpServer:
                         body = json.dumps(snap, indent=1).encode()
                         self._reply(200 if snap.get("ok") else 503,
                                     "application/json", body)
+                    elif self.path.startswith("/queries"):
+                        # the live-introspection view; answers with
+                        # enabled=false when live/ was never configured,
+                        # so pollers need no conf knowledge
+                        from .. import live
+                        body = json.dumps(live.snapshot(),
+                                          indent=1).encode()
+                        self._reply(200, "application/json", body)
                     else:
                         self._reply(404, "text/plain", b"not found\n")
                 except Exception as e:  # the exporter must never die

@@ -23,7 +23,6 @@ reason so an OOM loop cannot flood the incident directory.
 
 from __future__ import annotations
 
-import json
 import os
 import threading
 import time
@@ -113,30 +112,23 @@ class FlightRecorder:
             self._last_dump[reason] = now
         events = self.snapshot()
         from ..utils import spans
-        from ..utils.spans import _json_default
         os.makedirs(self.dump_dir, exist_ok=True)
         ts = time.strftime("%Y%m%dT%H%M%S")
         path = os.path.join(
             self.dump_dir,
             f"incident-{ts}-{os.getpid()}-{_slug(reason)}.jsonl")
-        header = {
-            "v": spans.SCHEMA_VERSION, "type": "incident",
-            "reason": reason, "trace_id": trace_id or "",
-            "ts": time.time(), "pid": os.getpid(),
-            "n_events": len(events),
-            "attrs": dict(attrs or {}),
-        }
-        lines = [json.dumps(header, separators=(",", ":"),
-                            default=_json_default)]
+        lines = [spans.to_json_line(spans.incident_record(
+            reason, trace_id=trace_id, n_events=len(events),
+            attrs=attrs))]
         for i, ev in enumerate(events):
             ev_ts, t_ns, kind, name, ev_trace, ev_attrs = ev
-            lines.append(json.dumps({
+            lines.append(spans.to_json_line({
                 "v": spans.SCHEMA_VERSION, "type": "event",
                 "seq": i, "ts": ev_ts, "t_ns": t_ns,
                 "kind": kind, "name": name,
                 "trace_id": ev_trace or "",
                 "attrs": dict(ev_attrs or {}),
-            }, separators=(",", ":"), default=_json_default))
+            }))
         with open(path, "w") as f:
             f.write("\n".join(lines) + "\n")
         with self._mu:

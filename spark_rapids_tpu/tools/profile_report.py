@@ -37,7 +37,8 @@ from ..utils.spans import (SCHEMA_VERSION, format_adaptive_decision,
                            validate_record)
 
 __all__ = ["load_records", "build_model", "render_report", "sched_summary",
-           "cache_summary", "stats_summary", "trace_view", "main"]
+           "cache_summary", "stats_summary", "pushdown_summary",
+           "trace_view", "main"]
 
 # live logs plus size-capped rotation generations (events-PID.jsonl.1, .2,
 # ...) and the flight recorder's incident dumps — all the same schema
@@ -274,6 +275,32 @@ def stats_summary(model: Dict[str, Any], top: int = 15) -> Dict[str, Any]:
             "worst": rows[:top]}
 
 
+def pushdown_summary(model: Dict[str, Any]) -> Dict[str, Any]:
+    """Scan-pushdown signal across all queries (PR-12 compute-on-
+    compressed-data counters from the task metrics): rows the pushed
+    predicates removed before any downstream operator, whole row groups
+    skipped via footer statistics, and the row-data bytes the decode
+    actually materialized (survivors only under pushdown). Empty dict
+    when no query ran with pushdown engaged."""
+    rows_pruned = rowgroups_pruned = bytes_materialized = 0
+    queries = 0
+    for q in model["queries"]:
+        tm = q["task_metrics"]
+        rp = tm.get("scan_rows_pruned", 0)
+        rg = tm.get("scan_rowgroups_pruned", 0)
+        bm = tm.get("scan_bytes_materialized", 0)
+        if rp or rg or bm:
+            queries += 1
+            rows_pruned += rp
+            rowgroups_pruned += rg
+            bytes_materialized += bm
+    if not queries:
+        return {}
+    return {"queries": queries, "rows_pruned": rows_pruned,
+            "rowgroups_pruned": rowgroups_pruned,
+            "bytes_materialized": bytes_materialized}
+
+
 def trace_view(records: List[Dict[str, Any]],
                trace: Optional[str] = None) -> str:
     """Cross-process trace timeline: group every record carrying a trace
@@ -457,6 +484,15 @@ def render_report(model: Dict[str, Any], top: int = 10,
                 f"shuffle volume: written={tm.get('shuffle_bytes_written', 0)}"
                 f"B read={tm.get('shuffle_bytes_read', 0)}B "
                 f"fetchWaitMs={tm.get('shuffle_fetch_wait_ns', 0) / 1e6:.1f}")
+        if tm.get("scan_rows_pruned") or tm.get("scan_rowgroups_pruned") \
+                or tm.get("scan_bytes_materialized"):
+            # compute-on-compressed-data counters: how much the pushed
+            # predicate/aggregate kept off the materialization path
+            lines.append(
+                f"scan pushdown: rowsPruned={tm.get('scan_rows_pruned', 0)} "
+                f"rowGroupsPruned={tm.get('scan_rowgroups_pruned', 0)} "
+                f"bytesMaterialized="
+                f"{tm.get('scan_bytes_materialized', 0)}B")
         if q.get("adaptive"):
             # AQE's actual decisions (staging coalesces, skew splits,
             # history pre-flags) — previously only a session attribute
@@ -481,6 +517,14 @@ def render_report(model: Dict[str, Any], top: int = 10,
                  for r in st["worst"]],
                 ["query", "label", "operator", "est_rows", "actual_rows",
                  "q_error", "flags"]))
+        lines.append("")
+    pd = pushdown_summary(model)
+    if pd:
+        lines.append("=== scan pushdown ===")
+        lines.append(
+            f"queries={pd['queries']} rowsPruned={pd['rows_pruned']} "
+            f"rowGroupsPruned={pd['rowgroups_pruned']} "
+            f"bytesMaterialized={pd['bytes_materialized']}B")
         lines.append("")
     cache = cache_summary(model)
     if cache:
@@ -567,6 +611,7 @@ def main(argv: List[str] = None) -> int:
         model["scheduler"] = sched_summary(model)
         model["cache"] = cache_summary(model)
         model["stats"] = stats_summary(model, top=args.top)
+        model["pushdown"] = pushdown_summary(model)
         print(json.dumps(model, indent=2))
     else:
         print(render_report(model, top=args.top, stats=args.stats))

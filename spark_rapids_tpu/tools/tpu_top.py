@@ -1,0 +1,319 @@
+"""tpu_top — terminal ops console over the live-introspection surface.
+
+Polls one or more endpoints (TpuDeviceService workers or a fleet
+gateway, unix-socket paths) with the `queries` / `health` / `stats`
+service ops and renders a `top`-style refresh:
+
+  * per-worker gauges: health, admission queue depth/holders, device
+    memory used/total, result-cache bytes, breaker/draining state (from
+    the gateway's annotated fan-out when pointed at a gateway);
+  * per-query rows: worker, query id, tenant, status, current operator,
+    rows so far, a progress bar with ETA where statistics history
+    exists, elapsed wall;
+  * per-tenant admission state: live queries, lifetime admissions and
+    sheds from the telemetry scrape.
+
+Engine-free like profile_report: speaks only the wire protocol, never
+touches a device, so it runs from any box that can reach the sockets.
+
+Usage:
+    python -m spark_rapids_tpu.tools.tpu_top [NAME=]SOCKET...
+        [--interval SEC] [--once] [--plain] [--json] [--top N]
+
+`--once` prints a single frame (no screen clearing) — scripts and tests
+use it; `--json` dumps the raw poll instead of rendering."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import socket
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..service.protocol import request
+from ..telemetry.registry import parse_prometheus
+from .profile_report import _fmt_table
+
+__all__ = ["poll_endpoint", "poll_endpoints", "render", "progress_bar",
+           "main"]
+
+_BAR_WIDTH = 22
+
+
+def poll_endpoint(name: str, sock_path: str,
+                  timeout_s: float = 3.0) -> Dict[str, Any]:
+    """One poll of one endpoint: live queries + health, plus the
+    telemetry scrape when the endpoint runs with telemetry on — all
+    three ops over ONE connection per frame. A dead endpoint degrades
+    to an `error` slot, never a crash, and the FIRST socket failure
+    abandons the remaining ops on that connection (after a timeout the
+    frame stream may hold a late reply; reusing it would desync the
+    next request). The console keeps rendering the rest of the pool."""
+    out: Dict[str, Any] = {"name": name, "socket": sock_path, "ok": False}
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.settimeout(timeout_s)
+    try:
+        try:
+            s.connect(sock_path)
+            rep, _ = request(s, {"op": "queries"})
+            out["live"] = rep.get("live") or {}
+            out["ok"] = True
+        except Exception as e:
+            out["error"] = f"{type(e).__name__}: {e}"
+            return out
+        try:
+            rep, _ = request(s, {"op": "health"})
+            out["health"] = rep.get("health") or {}
+            rep, body = request(s, {"op": "stats"})
+            if rep.get("ok"):
+                out["metrics"] = parse_prometheus(body.decode("utf-8"))
+        except Exception:
+            pass  # queries answered: health/stats stay best-effort
+    finally:
+        s.close()
+    return out
+
+
+def poll_endpoints(endpoints: List[Tuple[str, str]],
+                   timeout_s: float = 3.0) -> List[Dict[str, Any]]:
+    """Poll every endpoint CONCURRENTLY: one wedged worker must cost the
+    frame its own timeout once, not once per healthy neighbour (serial
+    polling would stale the whole console by the summed timeouts)."""
+    results: List[Optional[Dict[str, Any]]] = [None] * len(endpoints)
+
+    def one(i: int, n: str, p: str) -> None:
+        results[i] = poll_endpoint(n, p, timeout_s)
+
+    threads = [threading.Thread(target=one, args=(i, n, p), daemon=True)
+               for i, (n, p) in enumerate(endpoints)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=3 * timeout_s + 5.0)
+    return [r if r is not None else
+            {"name": n, "socket": p, "ok": False,
+             "error": "poll timed out"}
+            for r, (n, p) in zip(results, endpoints)]
+
+
+def progress_bar(frac: Optional[float], width: int = _BAR_WIDTH) -> str:
+    """`[#######———————]  42%` — or a rows-only spinner band when no
+    history exists to divide by."""
+    if frac is None:
+        return "[" + "?" * width + "]   ?%"
+    frac = min(max(frac, 0.0), 1.0)
+    fill = int(round(frac * width))
+    return ("[" + "#" * fill + "-" * (width - fill)
+            + f"] {frac * 100:3.0f}%")
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}TiB"
+
+
+def _fmt_eta(eta: Optional[float]) -> str:
+    if eta is None:
+        return "-"
+    if eta >= 60:
+        return f"{eta / 60:.1f}m"
+    return f"{eta:.1f}s"
+
+
+def _metric_sum(metrics: Dict[str, Dict[str, float]], name: str) -> float:
+    return sum((metrics or {}).get(name, {}).values())
+
+
+def _metric_label(metrics: Dict[str, Dict[str, float]], name: str,
+                  **labels: str) -> float:
+    fam = (metrics or {}).get(name, {})
+    want = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return fam.get(want, 0.0)
+
+
+def _gather_queries(snap: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Flatten one endpoint's live view to query rows; a gateway's
+    fan-out already carries per-query `worker` annotations."""
+    live = snap.get("live") or {}
+    rows = []
+    for q in live.get("queries") or ():
+        q = dict(q)
+        q.setdefault("worker", snap["name"])
+        rows.append(q)
+    return rows
+
+
+def _worker_rows(snapshots: List[Dict[str, Any]]) -> List[List[str]]:
+    rows: List[List[str]] = []
+    for snap in snapshots:
+        live = snap.get("live") or {}
+        if live.get("role") == "gateway":
+            # render the gateway's annotated per-worker states
+            for wname, w in sorted((live.get("workers") or {}).items()):
+                status = "error" if "error" in w else \
+                    ("skipped" if "skipped" in w else "up")
+                rows.append([
+                    f"{snap['name']}/{wname}", status,
+                    w.get("breaker", "?"),
+                    "yes" if w.get("draining") else "no",
+                    str(w.get("outstanding", "?")),
+                    str(w.get("queries", "-")), "-", "-"])
+            continue
+        if not snap.get("ok"):
+            rows.append([snap["name"], "down", "-", "-", "-", "-", "-",
+                         snap.get("error", "")[:40]])
+            continue
+        m = snap.get("metrics") or {}
+        health = snap.get("health") or {}
+        used = _metric_label(m, "tpu_memory_budget_bytes", kind="used")
+        total = _metric_label(m, "tpu_memory_budget_bytes", kind="total")
+        mem = f"{_fmt_bytes(used)}/{_fmt_bytes(total)}" if total else "-"
+        depth = _metric_sum(m, "tpu_sched_queue_depth")
+        holders = _metric_sum(m, "tpu_sched_holders")
+        cache = _metric_sum(m, "tpu_rescache_bytes")
+        rows.append([
+            snap["name"],
+            "ok" if health.get("ok", True) else "DEGRADED",
+            "-", "-",
+            f"{int(depth)}q/{int(holders)}h" if m else "-",
+            str(len((snap.get("live") or {}).get("queries") or ())),
+            mem,
+            _fmt_bytes(cache) if cache else "-"])
+    return rows
+
+
+def render(snapshots: List[Dict[str, Any]], top: int = 20,
+           clock: Optional[float] = None) -> str:
+    """One console frame from a list of endpoint polls."""
+    lines: List[str] = []
+    ts = time.strftime("%H:%M:%S",
+                       time.localtime(clock if clock is not None
+                                      else time.time()))
+    queries: List[Dict[str, Any]] = []
+    recent: List[Dict[str, Any]] = []
+    for snap in snapshots:
+        queries.extend(_gather_queries(snap))
+        live = snap.get("live") or {}
+        for q in live.get("recent") or ():
+            q = dict(q)
+            q.setdefault("worker", snap["name"])
+            recent.append(q)
+    queries.sort(key=lambda q: q.get("started_ts", 0))
+    lines.append(f"tpu_top {ts} — {len(snapshots)} endpoint(s), "
+                 f"{len(queries)} in-flight")
+    lines.append("")
+    lines.append("workers:")
+    lines.append(_fmt_table(
+        _worker_rows(snapshots),
+        ["worker", "state", "breaker", "drain", "sched", "queries",
+         "mem", "cache"]))
+    lines.append("")
+    lines.append("in-flight queries:")
+    if queries:
+        lines.append(_fmt_table(
+            [[q.get("worker", "?"), q.get("query_id", "?"),
+              q.get("tenant", "?"),
+              ("SLOW" if q.get("slow") else q.get("status", "?")),
+              q.get("operator", "") or "-",
+              str(q.get("rows", 0)),
+              progress_bar(q.get("progress")),
+              _fmt_eta(q.get("eta_s")),
+              f"{q.get('elapsed_s', 0):.1f}s"]
+             for q in queries[:top]],
+            ["worker", "query", "tenant", "status", "operator", "rows",
+             "progress", "eta", "elapsed"]))
+    else:
+        lines.append("  (none)")
+    # per-tenant admission rollup: live in-flight + lifetime counters
+    tenants: Dict[str, Dict[str, float]] = {}
+    for q in queries:
+        t = tenants.setdefault(q.get("tenant", "default"),
+                               {"live": 0, "admissions": 0, "shed": 0})
+        t["live"] += 1
+    for snap in snapshots:
+        m = snap.get("metrics") or {}
+        for fam, key in (("tpu_sched_admissions_total", "admissions"),
+                         ("tpu_sched_rejected_total", "shed")):
+            for labels, v in m.get(fam, {}).items():
+                name = labels.split('"')[1] if '"' in labels else "default"
+                t = tenants.setdefault(
+                    name, {"live": 0, "admissions": 0, "shed": 0})
+                t[key] += v
+    if tenants:
+        lines.append("")
+        lines.append("tenants:")
+        lines.append(_fmt_table(
+            [[t, str(int(d["live"])), str(int(d["admissions"])),
+              str(int(d["shed"]))]
+             for t, d in sorted(tenants.items())],
+            ["tenant", "live", "admissions", "shed"]))
+    if recent:
+        recent.sort(key=lambda q: q.get("ended_ts", 0))
+        lines.append("")
+        lines.append("recent:")
+        lines.append(_fmt_table(
+            [[q.get("worker", "?"), q.get("query_id", "?"),
+              q.get("status", "?"), str(q.get("rows", 0)),
+              f"{q.get('elapsed_s', 0):.2f}s"]
+             for q in recent[-min(top, 8):]],
+            ["worker", "query", "status", "rows", "wall"]))
+    return "\n".join(lines)
+
+
+def _parse_endpoints(specs: List[str]) -> List[Tuple[str, str]]:
+    out = []
+    for i, spec in enumerate(specs):
+        name, _, path = spec.partition("=")
+        if not path:
+            name, path = f"w{i}", name
+        out.append((name, path))
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpu_top",
+        description="Live ops console over TPU worker / fleet-gateway "
+                    "sockets (queries/health/stats service ops)")
+    ap.add_argument("endpoints", nargs="+", metavar="[NAME=]SOCKET",
+                    help="worker or gateway unix-socket path(s)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period in seconds (default 2)")
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit (no screen clearing)")
+    ap.add_argument("--plain", action="store_true",
+                    help="never emit ANSI clear codes (append frames)")
+    ap.add_argument("--json", action="store_true",
+                    help="dump the raw poll as JSON instead of rendering")
+    ap.add_argument("--top", type=int, default=20,
+                    help="max query rows per frame (default 20)")
+    ap.add_argument("--timeout", type=float, default=3.0,
+                    help="per-op socket timeout (default 3s)")
+    args = ap.parse_args(argv)
+    endpoints = _parse_endpoints(args.endpoints)
+    try:
+        while True:
+            snaps = poll_endpoints(endpoints, args.timeout)
+            if args.json:
+                print(json.dumps(snaps, indent=1, default=str))
+            else:
+                frame = render(snaps, top=args.top)
+                if not (args.once or args.plain):
+                    sys.stdout.write("\x1b[2J\x1b[H")
+                print(frame)
+                sys.stdout.flush()
+            if args.once:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

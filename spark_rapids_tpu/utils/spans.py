@@ -42,7 +42,7 @@ __all__ = ["SCHEMA_VERSION", "Span", "QueryProfile", "span",
            "write_event_log", "validate_record", "task_metrics_dict",
            "new_trace_id", "current_trace", "trace_scope",
            "write_client_record", "client_op_record", "append_jsonl",
-           "format_adaptive_decision"]
+           "format_adaptive_decision", "incident_record", "to_json_line"]
 
 # v2 (live telemetry): every record carries `trace_id` (cross-process
 # correlation — the id minted at query start rides the service headers
@@ -624,6 +624,25 @@ def _json_default(o):
     except Exception:
         pass
     return str(o)
+
+
+def to_json_line(rec: Dict[str, Any]) -> str:
+    """One compact JSONL line with the shared numpy-tolerant fallback —
+    every incident/event writer serializes through this."""
+    return json.dumps(rec, separators=(",", ":"), default=_json_default)
+
+
+def incident_record(reason: str, trace_id: str = "", n_events: int = 0,
+                    attrs: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Any]:
+    """The schema-v2 incident HEADER record — the single composer behind
+    FlightRecorder.dump and live.debug_dump's recorder-less fallback, so
+    a schema change cannot make one writer's dumps invalid while the
+    other's stay current."""
+    return {"v": SCHEMA_VERSION, "type": "incident", "reason": reason,
+            "trace_id": trace_id or "", "ts": time.time(),
+            "pid": os.getpid(), "n_events": int(n_events),
+            "attrs": dict(attrs or {})}
 
 
 # ----------------------------------------------------------------- validation

@@ -76,6 +76,12 @@ def pytest_configure(config):
         "golden on/off equality / planner rewrites / key+fingerprint "
         "non-aliasing / row-group pruning / aggregate-only shapes; "
         "scripts/scan_pushdown_matrix.sh runs these standalone)")
+    config.addinivalue_line(
+        "markers",
+        "live: live query-introspection suite (in-flight registry / "
+        "progress+ETA from stats history / slow-query watchdog / "
+        "queries surfaces / gateway fan-out / tpu_top console; "
+        "scripts/liveview_matrix.sh runs these standalone)")
 
 
 @pytest.fixture
