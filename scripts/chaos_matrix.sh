@@ -1,0 +1,73 @@
+#!/usr/bin/env bash
+# Chaos campaign gate (ISSUE-14 CI gate):
+#   1. run the crash-recovery suite (marker `chaos`, campaign tests also
+#      `slow` so tier-1 is untouched): durable-tier degradation units,
+#      fleet-supervisor lifecycle, and the scripted campaigns from
+#      tools/chaos_campaign.py — SIGKILL a worker mid-dashboard-query
+#      (gateway fails over bit-identical, supervisor respawns, respawned
+#      worker answers the hot fingerprint from its persistent tier with
+#      sched_admissions == 0), restarts under load, disk-full persist
+#      degradation (typed warning + counter + incident, queries stay
+#      correct), corrupted persistent entries (miss + delete, never
+#      garbage), and a probabilistic fault storm — each ending in the
+#      shared invariant checker (typed-or-identical results, token
+#      round-trips, breaker recovery, thread/fd/catalog baselines);
+#   2. off-path gate: with supervisor + persist OFF (the defaults), an
+#      engine query spawns zero supervisor/warmup threads, creates zero
+#      durable-tier state, imports zero fleet modules, and produces
+#      byte-identical results across runs.
+#
+# Usage: scripts/chaos_matrix.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TIMEOUT="${SRTPU_CHAOS_TIMEOUT:-1200}"
+
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_chaos.py -m chaos -q \
+    -p no:cacheprovider "$@"
+
+echo "== chaos off-path gate (supervisor/persist off => zero threads, zero state, byte-identical) =="
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu python - <<'EOF'
+import sys
+import threading
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.expr import Sum, col
+from spark_rapids_tpu.plugin import TpuSession
+
+t = pa.table({"g": pa.array(np.arange(2000) % 16),
+              "v": pa.array(np.random.default_rng(5).uniform(size=2000))})
+sess = TpuSession({"spark.rapids.sql.enabled": True,
+                   "spark.rapids.sql.explain": "NONE"})
+df = sess.from_arrow(t).group_by("g").agg(s=Sum(col("v")))
+r1 = df.collect()
+r2 = df.collect()
+assert r1.equals(r2), "FAIL: repeated runs not byte-identical"
+
+# zero supervisor / warmup threads
+bad_threads = [th.name for th in threading.enumerate()
+               if th.name in ("fleet-supervisor", "rescache-warmup")
+               or th.name.startswith("fleet-")]
+assert not bad_threads, f"FAIL: crash-recovery threads exist: {bad_threads}"
+
+# zero durable-tier state: no persistent dir configured => no tiers
+from spark_rapids_tpu.utils import durable
+assert durable.states() == {}, \
+    f"FAIL: durable tiers materialized with persistence off: {durable.states()}"
+
+# fleet (incl. supervisor) never imported by the engine path
+leaked = [m for m in sys.modules if m.startswith("spark_rapids_tpu.fleet")]
+assert not leaked, f"FAIL: engine query imported fleet modules: {leaked}"
+
+# persistent result tier object absent
+from spark_rapids_tpu import rescache
+assert rescache.persist_tier() is None, \
+    "FAIL: persist tier exists without rescache.persist.dir"
+print("off-path: zero threads, zero durable state, zero fleet imports, "
+      "byte-identical results OK")
+EOF
+
+echo "chaos matrix: ALL GATES PASSED"

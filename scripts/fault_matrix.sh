@@ -1,11 +1,21 @@
 #!/usr/bin/env bash
-# Run the deterministic fault-injection matrix (tests marked `faults`).
+# Run the deterministic fault-injection matrix (tests marked `faults`)
+# plus the full fault-point SWEEP.
 #
 # The matrix drives full queries and subsystem flows through every named
 # injection point in spark_rapids_tpu/faults.py (alloc OOM, spill I/O,
 # shuffle corruption, peer death, TCP reset/delay, admission timeout,
-# wedged backend) and asserts the documented recovery contract. Schedules
-# are seeded (SRTPU_FAULT_SEED, default 42) so failures reproduce exactly.
+# wedged backend, compile failures, cache degradation, durable-dir
+# persistence faults) and asserts the documented recovery contract.
+# Schedules are seeded (SRTPU_FAULT_SEED, default 42) so failures
+# reproduce exactly.
+#
+# The sweep (scripts/fault_point_sweep.py) then drives EVERY point in
+# faults.ALL_POINTS — one fresh process per point — asserting each
+# degrades to a typed error or a correct fallback, never wrong rows,
+# and fails if a registered point has no sweep coverage (the staleness
+# gate ISSUE-14 added after the matrix went three PRs without covering
+# compile / cache.fragment / pipeline.prefetch / sched.admit).
 #
 # The same tests run as part of tier-1 (`-m 'not slow'`); this script is
 # the focused entry point for CI shards and local debugging.
@@ -17,8 +27,14 @@ cd "$(dirname "$0")/.."
 SEED="${SRTPU_FAULT_SEED:-42}"
 TIMEOUT="${SRTPU_FAULT_TIMEOUT:-600}"
 
-exec timeout -k 10 "$TIMEOUT" env \
+timeout -k 10 "$TIMEOUT" env \
     JAX_PLATFORMS=cpu \
     SPARK_RAPIDS_TPU_TEST_FAULTS_SEED="$SEED" \
     python -m pytest tests/test_faults.py -m faults -q \
     -p no:cacheprovider "$@"
+
+echo "== fault-point sweep (every registered point, fresh process each) =="
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+    python scripts/fault_point_sweep.py
+
+echo "fault matrix: ALL GATES PASSED"

@@ -1,0 +1,396 @@
+#!/usr/bin/env python
+"""Fault-point sweep: every registered injection point, one at a time,
+in a FRESH process each — asserting the documented degradation contract.
+
+`scripts/fault_matrix.sh` runs the curated pytest matrix; this sweep is
+the completeness backstop ISSUE-14 asked for: `faults.ALL_POINTS` is the
+source of truth, and a point added to the engine without a sweep entry
+here FAILS the run (the exact staleness this file exists to kill —
+fault_matrix.sh went three PRs without covering compile/cache.fragment/
+pipeline.prefetch/sched.admit).
+
+Per point the child process arms `nth=1` (or every-call for wedge-style
+points), drives a workload that provably reaches the point, and asserts:
+
+  * the rule FIRED (a sweep that never reaches its point proves
+    nothing), and
+  * the outcome is the contract: bit-identical rows after internal
+    recovery ("correct"), or a typed engine error ("typed:<Class>") —
+    NEVER wrong rows, never an untyped crash.
+
+Usage:
+    python scripts/fault_point_sweep.py             # sweep all points
+    python scripts/fault_point_sweep.py --point X   # one point, JSON out
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import warnings
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+# ---------------------------------------------------------------------------
+# shared workload helpers (child process only)
+# ---------------------------------------------------------------------------
+def _table(n=600):
+    import numpy as np
+    import pyarrow as pa
+    rng = np.random.default_rng(11)
+    return pa.table({
+        "id": pa.array(rng.integers(0, 40, n), type=pa.int64()),
+        "val": pa.array(rng.normal(0, 100, n), type=pa.float64()),
+    })
+
+
+def _session(extra=None):
+    from spark_rapids_tpu.plugin import TpuSession
+    conf = {"spark.rapids.sql.enabled": True,
+            "spark.rapids.sql.explain": "NONE"}
+    conf.update(extra or {})
+    return TpuSession(conf)
+
+
+def _agg_query(session):
+    from spark_rapids_tpu.expr import Count, Sum, col
+    t = _table()
+    return session.from_arrow(t).group_by("id").agg(
+        n=Count(col("val")), s=Sum(col("id")))
+
+
+def _repart_query(session):
+    return session.from_arrow(_table(400)).repartition(3, "id")
+
+
+def _run_df(point, df, sort_by, kind="error", **kw):
+    """CPU oracle first (no device work — a device-path oracle would WARM
+    the compile/result caches and the faulted run would never reach its
+    injection point), then the device query under the rule. Returns
+    (fired, outcome)."""
+    from spark_rapids_tpu import faults
+    order = [(k, "ascending") for k in sort_by]
+    oracle = df.collect_cpu().sort_by(order)
+    with faults.inject(point, kind, **kw) as rule:
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                got = df.collect().sort_by(order)
+        except Exception as e:
+            return rule.fired, _classify(e)
+    same = (got.num_rows == oracle.num_rows and
+            all(got.column(n).to_pylist() == oracle.column(n).to_pylist()
+                for n in oracle.schema.names))
+    return rule.fired, "correct" if same else "WRONG_ROWS"
+
+
+def _classify(e):
+    from spark_rapids_tpu.errors import RapidsTpuError
+    if isinstance(e, RapidsTpuError):
+        return f"typed:{type(e).__name__}"
+    return f"UNTYPED:{type(e).__name__}:{e}"
+
+
+# ---------------------------------------------------------------------------
+# per-point drivers: each returns (fired, outcome)
+# ---------------------------------------------------------------------------
+def run_memory_alloc():
+    from spark_rapids_tpu.errors import RetryOOM
+    return _run_df("memory.alloc", _agg_query(_session()), ["id"],
+                   nth=1, times=1, error=RetryOOM)
+
+
+def run_spill_write():
+    import pyarrow as pa
+    from spark_rapids_tpu import faults
+    from spark_rapids_tpu.columnar import batch_from_arrow, batch_to_arrow
+    from spark_rapids_tpu.memory.catalog import BufferCatalog, StorageTier
+    import numpy as np
+    cat = BufferCatalog(host_limit=1, spill_codec="none")
+    t = pa.table({"a": pa.array(np.arange(64, dtype=np.int64))})
+    h = cat.add_batch(batch_from_arrow(t))
+    with faults.inject(faults.SPILL_WRITE, "error", nth=1, times=1,
+                       error=IOError) as rule:
+        cat.synchronous_spill(1)  # disk write fails -> data stays HOST
+    ok = (cat.tier_of(h) == StorageTier.HOST
+          and batch_to_arrow(cat.acquire_batch(h)).equals(t))
+    cat.remove(h)
+    return rule.fired, "correct" if ok else "WRONG_ROWS"
+
+
+def run_spill_read():
+    import pyarrow as pa
+    from spark_rapids_tpu import faults
+    from spark_rapids_tpu.columnar import batch_from_arrow, batch_to_arrow
+    from spark_rapids_tpu.memory.catalog import BufferCatalog
+    import numpy as np
+    cat = BufferCatalog(host_limit=1, spill_codec="none")
+    t = pa.table({"a": pa.array(np.arange(64, dtype=np.int64))})
+    h = cat.add_batch(batch_from_arrow(t))
+    cat.synchronous_spill(1)
+    with faults.inject(faults.SPILL_READ, "error", nth=1, times=1,
+                       error=IOError) as rule:
+        try:
+            back = cat.acquire_batch(h)  # transient -> retried
+        except Exception as e:
+            cat.remove(h)
+            return rule.fired, _classify(e)
+    ok = batch_to_arrow(back).equals(t)
+    cat.remove(h)
+    return rule.fired, "correct" if ok else "WRONG_ROWS"
+
+
+def run_block_write():
+    return _run_df("shuffle.block.write", _repart_query(_session()),
+                   ["id", "val"], nth=1, times=1, error=IOError)
+
+
+def run_block_read():
+    return _run_df("shuffle.block.read", _repart_query(_session()),
+                   ["id", "val"], kind="corrupt", nth=1, times=1)
+
+
+def _tcp_rig(deadline_s=5.0):
+    from spark_rapids_tpu.columnar import batch_from_arrow
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.shuffle.manager import (ShuffleBlockStore,
+                                                  TpuShuffleManager)
+    from spark_rapids_tpu.shuffle.serializer import serialize_batch
+    from spark_rapids_tpu.shuffle.tcp_transport import (TcpShuffleServer,
+                                                        TcpTransport)
+    from spark_rapids_tpu.shuffle.transport import BlockId, ShuffleServer
+    store = ShuffleBlockStore()
+    expected = _table(200)
+    store.put(BlockId(21, 0, 0),
+              serialize_batch(batch_from_arrow(expected), "zstd"))
+    srv = TcpShuffleServer(ShuffleServer("exec-remote", store.get,
+                                         store.blocks_for_reduce)).start()
+    transport = TcpTransport(deadline_s=deadline_s)
+    transport.register_peer("exec-remote", srv.address)
+    conf = TpuConf({"spark.rapids.shuffle.fetch.retryWaitMs": 1,
+                    "spark.rapids.shuffle.fetch.maxRetries": 2})
+    mgr = TpuShuffleManager(conf, executor_id="exec-local",
+                            transport=transport)
+    return mgr, srv, store, expected
+
+
+def _run_tcp(point, **kw):
+    from spark_rapids_tpu import faults
+    from spark_rapids_tpu.columnar import batch_to_arrow
+    mgr, srv, store, expected = _tcp_rig()
+    try:
+        with faults.inject(point, kw.pop("kind", "error"), **kw) as rule:
+            try:
+                out = list(mgr.read_partition(
+                    21, 0, remote_peers=["exec-remote"]))
+            except Exception as e:
+                return rule.fired, _classify(e)
+        ok = batch_to_arrow(out[0]).equals(expected)
+        return rule.fired, "correct" if ok else "WRONG_ROWS"
+    finally:
+        mgr.shutdown()
+        srv.close()
+        store.close()
+
+
+def run_fetch():
+    return _run_tcp("shuffle.fetch", nth=1, times=1,
+                    error=ConnectionResetError)
+
+
+def run_tcp_send():
+    return _run_tcp("tcp.send", nth=1, times=1,
+                    error=ConnectionResetError)
+
+
+def run_tcp_recv():
+    return _run_tcp("tcp.recv", nth=1, times=1,
+                    error=ConnectionResetError)
+
+
+def run_service_admission():
+    """In-process TpuDeviceService + real client: the injected admission
+    fault must surface as the typed AdmissionTimeoutError."""
+    import tempfile
+    import threading
+    from spark_rapids_tpu import faults
+    from spark_rapids_tpu.errors import AdmissionTimeoutError
+    from spark_rapids_tpu.service import TpuServiceClient
+    from spark_rapids_tpu.service.server import TpuDeviceService
+    sock = tempfile.mktemp(suffix=".sock", prefix="srtpu_sweep_")
+    svc = TpuDeviceService({}, sock)
+    th = threading.Thread(target=svc.serve_forever, daemon=True)
+    th.start()
+    with faults.inject(faults.ADMISSION, "error", nth=1,
+                       times=1) as rule:
+        try:
+            with TpuServiceClient(sock, deadline_s=90.0) as cli:
+                try:
+                    cli.acquire(timeout=1.0)
+                    outcome = "NO_ERROR"
+                except AdmissionTimeoutError:
+                    outcome = "typed:AdmissionTimeoutError"
+                except Exception as e:
+                    outcome = _classify(e)
+        finally:
+            svc._stop.set()
+    return rule.fired, ("correct" if outcome ==
+                        "typed:AdmissionTimeoutError" else outcome)
+
+
+def run_device_init():
+    from spark_rapids_tpu import faults
+    from spark_rapids_tpu.errors import DeviceStartupError
+    with faults.inject(faults.DEVICE_INIT, "error", nth=1,
+                       times=1) as rule:
+        try:
+            _agg_query(_session()).collect()
+            return rule.fired, "NO_ERROR"
+        except DeviceStartupError:
+            return rule.fired, "correct"  # typed fail-fast IS the contract
+        except Exception as e:
+            return rule.fired, _classify(e)
+
+
+def run_compile():
+    return _run_df("compile", _agg_query(_session()), ["id"],
+                   nth=1, times=1)
+
+
+def run_prefetch():
+    # the typed error must cross the prefetch queue to the consumer —
+    # a typed InjectedFault from the query IS the contract
+    fired, outcome = _run_df(
+        "pipeline.prefetch",
+        _agg_query(_session({"spark.rapids.tpu.pipeline.enabled": True})),
+        ["id"], nth=1, times=1)
+    if outcome == "typed:InjectedFault":
+        outcome = "correct"
+    return fired, outcome
+
+
+def run_sched_admit():
+    fired, outcome = _run_df(
+        "sched.admit",
+        _agg_query(_session({"spark.rapids.tpu.sched.enabled": True})),
+        ["id"], nth=1, times=1)
+    if outcome == "typed:QueryRejectedError":
+        outcome = "correct"  # typed shed before device work
+    return fired, outcome
+
+
+def run_cache_fragment():
+    return _run_df(
+        "cache.fragment",
+        _agg_query(_session({"spark.rapids.tpu.rescache.enabled": True})),
+        ["id"], nth=1, times=1)
+
+
+def run_persist():
+    import tempfile
+    from spark_rapids_tpu.utils import durable
+    d = tempfile.mkdtemp(prefix="srtpu_sweep_persist_")
+    fired, outcome = _run_df(
+        "persist",
+        _agg_query(_session({
+            "spark.rapids.tpu.rescache.enabled": True,
+            "spark.rapids.tpu.rescache.persist.dir": d,
+            "spark.rapids.tpu.rescache.persist.warmup.enabled": False})),
+        ["id"], nth=1, times=1, error=IOError)
+    if outcome == "correct":
+        # the query succeeded AND the tier degraded loudly
+        degraded = any(s["degraded"] for s in durable.states().values())
+        if not degraded:
+            outcome = "NOT_DEGRADED"
+    elif outcome.startswith("typed:"):
+        # the persist contract is STRICTER than typed-or-correct: a
+        # durable-dir fault must never fail the query at all — a typed
+        # error here is a regression, not a pass
+        outcome = f"QUERY_FAILED_{outcome}"
+    return fired, outcome
+
+
+# point -> driver; ALL_POINTS membership is asserted by the parent sweep
+DRIVERS = {
+    "memory.alloc": run_memory_alloc,
+    "spill.write": run_spill_write,
+    "spill.read": run_spill_read,
+    "shuffle.block.write": run_block_write,
+    "shuffle.block.read": run_block_read,
+    "shuffle.fetch": run_fetch,
+    "tcp.send": run_tcp_send,
+    "tcp.recv": run_tcp_recv,
+    "service.admission": run_service_admission,
+    "device.init": run_device_init,
+    "compile": run_compile,
+    "pipeline.prefetch": run_prefetch,
+    "sched.admit": run_sched_admit,
+    "cache.fragment": run_cache_fragment,
+    "persist": run_persist,
+}
+
+
+def run_one(point: str) -> dict:
+    fired, outcome = DRIVERS[point]()
+    ok = fired >= 1 and (outcome == "correct"
+                         or outcome.startswith("typed:"))
+    return {"point": point, "fired": fired, "outcome": outcome, "ok": ok}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--point", default=None)
+    args = ap.parse_args(argv)
+    if args.point:
+        v = run_one(args.point)
+        print(json.dumps(v))
+        return 0 if v["ok"] else 1
+
+    from spark_rapids_tpu import faults
+    missing = [p for p in faults.ALL_POINTS if p not in DRIVERS]
+    if missing:
+        print(f"SWEEP STALE: registered fault points with no sweep "
+              f"driver: {missing}", file=sys.stderr)
+        return 2
+    stale = [p for p in DRIVERS if p not in faults.ALL_POINTS]
+    if stale:
+        print(f"SWEEP STALE: drivers for unregistered points: {stale}",
+              file=sys.stderr)
+        return 2
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    env.pop("XLA_FLAGS", None)
+    failed = 0
+    for point in faults.ALL_POINTS:
+        # fresh process per point: device.init / per-process latches /
+        # singleton state cannot leak between points
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--point", point],
+            env=env, capture_output=True, text=True, timeout=600)
+        line = (p.stdout.strip().splitlines() or ["{}"])[-1]
+        try:
+            v = json.loads(line)
+        except ValueError:
+            v = {"point": point, "ok": False,
+                 "outcome": f"CRASH rc={p.returncode}: "
+                            f"{p.stderr.strip()[-300:]}"}
+        status = "PASS" if v.get("ok") else "FAIL"
+        print(f"[sweep] {point:20s} {status}  fired={v.get('fired')} "
+              f"outcome={v.get('outcome')}")
+        if not v.get("ok"):
+            failed += 1
+    if failed:
+        print(f"fault sweep: {failed} point(s) violated the degradation "
+              f"contract", file=sys.stderr)
+        return 1
+    print(f"fault sweep: all {len(faults.ALL_POINTS)} points degrade "
+          f"typed-or-correct")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -296,6 +296,7 @@ class CompileService:
         self._kernel_spans = False
         self.stats = CompileStats()
         self._warned_persist = False
+        self._tier = None  # utils/durable.DurableTier once a dir is set
         self.warmup_thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------------
@@ -325,12 +326,14 @@ class CompileService:
             self._kernel_spans = bool(conf.get(
                 "spark.rapids.tpu.metrics.spans.kernel.enabled"))
         if self._dir:
-            try:
-                os.makedirs(self._dir, exist_ok=True)
-            except OSError as e:
-                self._persist_warn(f"cache dir unusable: {e}")
-                with self._mu:
-                    self._dir = ""
+            # durable-tier discipline (utils/durable.py): any IO failure —
+            # here or on a later store/load — degrades the persistent tier
+            # to memory-only under the shared warning/counter/incident
+            # sequence; the in-memory LRU keeps serving
+            from ..utils import durable
+            self._tier = durable.tier("compile", self._dir)
+            self._tier.run("mkdir", lambda: os.makedirs(self._dir,
+                                                        exist_ok=True))
         from .tuner import BucketTuner
         BucketTuner.get().configure(conf)
         if self._enabled and conf.get(
@@ -351,7 +354,16 @@ class CompileService:
 
     @property
     def persistent_dir(self) -> str:
-        return self._dir
+        return self._dir if self._persist_ok() else ""
+
+    def _persist_ok(self) -> bool:
+        if not self._dir:
+            return False
+        if self._tier is None or self._tier.path != self._dir:
+            # tests point _dir at a tmpdir directly; lazily bind its tier
+            from ..utils import durable
+            self._tier = durable.tier("compile", self._dir)
+        return self._tier.available()
 
     # ------------------------------------------------------------------
     def call(self, sj: ServiceJit, args: tuple):
@@ -534,9 +546,12 @@ class CompileService:
 
     def _persist(self, digest: str, sj: ServiceJit, jitted, dyn: tuple,
                  entry: _Entry) -> None:
-        if not self._dir:
+        if not self._persist_ok():
             return
         try:
+            # ENTRY-level serialization problems (an unexportable program)
+            # warn and skip this entry; only the file IO below is tier
+            # damage that degrades persistence as a whole
             import jax.export as jex
             _register_export_serialization()
             exported = jex.export(jitted)(*dyn)
@@ -547,26 +562,39 @@ class CompileService:
             body = meta + payload
             blob = _HDR.pack(_MAGIC, _FMT_EXPORT, crc32c(body),
                              len(meta)) + body
+        except Exception as e:
+            self.stats.bump(sj.op, persist_errors=1)
+            self._persist_warn(f"could not persist {sj.op}: "
+                               f"{type(e).__name__}: {e}")
+            return
+
+        def write() -> bool:
             path = self._entry_path(digest)
             tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
             with open(tmp, "wb") as f:
                 f.write(blob)
             os.replace(tmp, path)
+            return True
+
+        if self._tier.run("store", write):
             self.stats.bump(sj.op, persist_stores=1)
-        except Exception as e:
+        else:
             self.stats.bump(sj.op, persist_errors=1)
-            self._persist_warn(f"could not persist {sj.op}: "
-                               f"{type(e).__name__}: {e}")
 
     def _load_persistent(self, digest: str, sj: ServiceJit) \
             -> Optional[_Entry]:
-        if not self._dir:
+        if not self._persist_ok():
             return None
         path = self._entry_path(digest)
-        try:
+
+        def read():
             with open(path, "rb") as f:
-                blob = f.read()
-        except OSError:
+                return f.read()
+
+        # an absent entry is a plain miss; any other IO failure (EPERM,
+        # EIO, vanished mount) degrades the tier to memory-only
+        blob = self._tier.run("load", read, missing_ok=True)
+        if blob is None:
             return None
         from .. import faults
         try:
@@ -620,13 +648,12 @@ class CompileService:
     def persisted_entries(self) -> List[str]:
         """Digests present in the persistent tier (warmup preload walks
         these)."""
-        if not self._dir:
+        if not self._persist_ok():
             return []
-        try:
-            return [f[:-len(".xprog")] for f in os.listdir(self._dir)
-                    if f.endswith(".xprog")]
-        except OSError:
-            return []
+        return self._tier.run(
+            "list", lambda: [f[:-len(".xprog")]
+                             for f in os.listdir(self._dir)
+                             if f.endswith(".xprog")], default=[])
 
     def preload_persistent(self, digest: str) -> bool:
         """Pull one persisted entry into the memory tier (warmup). Returns

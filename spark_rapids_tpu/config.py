@@ -576,6 +576,28 @@ register("spark.rapids.tpu.rescache.minRecomputeMs", "double", 0.0,
          "Only store a fragment/result whose recompute cost was at "
          "least this many milliseconds — keeps trivially cheap "
          "fragments from churning the capacity. 0 stores everything.")
+register("spark.rapids.tpu.rescache.persist.dir", "string", "",
+         "Directory for the persistent whole-query result tier "
+         "(CRC32C-framed Arrow blobs, compile-cache discipline: a torn "
+         "or poisoned entry is a miss + delete, never a wrong result). "
+         "Only entries whose fingerprints carry pure file/delta "
+         "identity (no in-memory table ids) persist; staleness is "
+         "inside the fingerprint (file mtime/size, delta version), so "
+         "rewritten sources miss naturally. A restarted worker answers "
+         "previously-hot fingerprints from this tier with zero device "
+         "admissions. IO failures degrade the tier to memory-only "
+         "(typed PersistenceDegradedWarning + telemetry counter + "
+         "flight-recorder incident) — never a failed query. Empty "
+         "disables persistence; the in-memory cache still runs.")
+register("spark.rapids.tpu.rescache.persist.maxBytes", "bytes", 1 << 30,
+         "Capacity of the persistent result tier's directory; storing "
+         "past it deletes oldest entries (file mtime) first. One entry "
+         "larger than the whole budget is never persisted.")
+register("spark.rapids.tpu.rescache.persist.warmup.enabled", "bool", True,
+         "Background-reload every persisted result into the in-memory "
+         "cache at device init (one `rescache-warmup` thread), so the "
+         "first post-restart dashboard hit needs no disk read. Off, "
+         "persisted entries still serve lazily on first lookup.")
 
 # Runtime statistics -----------------------------------------------------------------
 register("spark.rapids.tpu.stats.enabled", "bool", False,
@@ -744,6 +766,27 @@ register("spark.rapids.tpu.fleet.failoverStorm.threshold", "int", 5,
 register("spark.rapids.tpu.fleet.failoverStorm.windowSec", "double", 10.0,
          "Fleet gateway: sliding window for failover-storm detection; "
          "also the per-window incident rate limit.")
+register("spark.rapids.tpu.fleet.supervisor.enabled", "bool", False,
+         "Fleet supervisor mode: the gateway process spawns and "
+         "SUPERVISES its workers — a crashed worker is respawned at the "
+         "same socket address with exponential backoff, the prober's "
+         "half-open trial re-admits it, and its persistent tiers "
+         "(compile cache, result tier, stats history) bring it back "
+         "warm. Off (default), the gateway only routes around dead "
+         "workers (external process management owns restarts).")
+register("spark.rapids.tpu.fleet.supervisor.maxRestarts", "int", 5,
+         "Fleet supervisor: lifetime respawn budget per worker. A "
+         "worker crashing past it is marked FAILED (flight-recorder "
+         "incident; no further respawns) — a crash loop must page "
+         "someone, not burn CPU forever.")
+register("spark.rapids.tpu.fleet.supervisor.backoffMs", "int", 200,
+         "Fleet supervisor: respawn backoff base; doubles per "
+         "consecutive restart up to supervisor.backoffMaxMs.")
+register("spark.rapids.tpu.fleet.supervisor.backoffMaxMs", "int", 5000,
+         "Fleet supervisor: respawn backoff ceiling.")
+register("spark.rapids.tpu.fleet.supervisor.checkIntervalMs", "int", 100,
+         "Fleet supervisor: how often the monitor thread polls worker "
+         "processes for unexpected exits.")
 
 
 class TpuConf:

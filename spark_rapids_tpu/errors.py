@@ -78,6 +78,15 @@ class CompileServiceWarning(RuntimeWarning):
     function); only caching/latency is."""
 
 
+class PersistenceDegradedWarning(RuntimeWarning):
+    """A durable tier (compile cache, statistics history, event log,
+    persistent result tier) hit an infrastructure-level IO failure —
+    disk full, EPERM, vanished mount, injected `persist` fault — and
+    degraded to memory-only for the rest of the process (utils/durable.py
+    latches it). Queries keep returning correct results; only the
+    warm-restart story for that tier is lost until the disk is fixed."""
+
+
 class ShuffleCorruptionError(RapidsTpuError):
     """A shuffle block frame failed its CRC32C integrity check (or its
     framing was unreadable). Carries the block and where the bytes came from;

@@ -38,6 +38,15 @@ Injection points (the catalog; call sites reference these constants):
                                            skipped store) — the cache may
                                            never turn a fault into a wrong
                                            or missing result
+  persist             utils/durable.py     every durable-dir IO (compile
+                                           cache, stats history, event log,
+                                           persistent result tier); an
+                                           injected failure degrades that
+                                           tier to memory-only (typed
+                                           warning + counter + incident),
+                                           never a failed query; corrupt
+                                           rules poison persisted payloads
+                                           on read (miss + delete)
 
 A rule fires on the Nth eligible call (`nth`), or with seeded probability
 (`probability`), at most `times` times (0 = unlimited). Kinds:
@@ -68,7 +77,8 @@ __all__ = ["FaultRule", "FaultInjector", "fire", "inject",
            "install_from_conf", "ALL_POINTS",
            "ALLOC", "SPILL_WRITE", "SPILL_READ", "BLOCK_WRITE", "BLOCK_READ",
            "FETCH", "TCP_SEND", "TCP_RECV", "ADMISSION", "DEVICE_INIT",
-           "COMPILE", "PREFETCH", "SCHED_ADMIT", "CACHE_FRAGMENT"]
+           "COMPILE", "PREFETCH", "SCHED_ADMIT", "CACHE_FRAGMENT",
+           "PERSIST"]
 
 ALLOC = "memory.alloc"
 SPILL_WRITE = "spill.write"
@@ -84,10 +94,11 @@ COMPILE = "compile"
 PREFETCH = "pipeline.prefetch"
 SCHED_ADMIT = "sched.admit"
 CACHE_FRAGMENT = "cache.fragment"
+PERSIST = "persist"
 
 ALL_POINTS = (ALLOC, SPILL_WRITE, SPILL_READ, BLOCK_WRITE, BLOCK_READ,
               FETCH, TCP_SEND, TCP_RECV, ADMISSION, DEVICE_INIT, COMPILE,
-              PREFETCH, SCHED_ADMIT, CACHE_FRAGMENT)
+              PREFETCH, SCHED_ADMIT, CACHE_FRAGMENT, PERSIST)
 
 # named exception factories for the config-spec grammar
 _ERROR_NAMES: Dict[str, Callable[[str], Exception]] = {

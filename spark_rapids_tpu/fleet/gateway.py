@@ -126,9 +126,14 @@ class _WorkerLink:
 class FleetGateway:
     def __init__(self, workers: List[Tuple[str, str]],
                  conf: Optional[Dict] = None,
-                 socket_path: str = "/tmp/spark_rapids_tpu_fleet.sock"):
+                 socket_path: str = "/tmp/spark_rapids_tpu_fleet.sock",
+                 supervisor=None):
         self.conf = conf if isinstance(conf, TpuConf) else TpuConf(conf)
         self.socket_path = socket_path
+        # optional WorkerSupervisor (fleet/supervisor.py): when attached,
+        # fleet_stats exposes its per-worker restart/state block and
+        # serve_forever owns its lifecycle
+        self.supervisor = supervisor
         c = self.conf
         self.max_outstanding = c.get("spark.rapids.tpu.fleet.maxOutstanding")
         self.max_attempts = max(
@@ -179,13 +184,22 @@ class FleetGateway:
     def serve_forever(self) -> None:
         if os.path.exists(self.socket_path):
             os.unlink(self.socket_path)
-        self.registry.start()
-        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        srv.bind(self.socket_path)
-        srv.listen(128)
-        srv.settimeout(0.5)
-        self._listener = srv
+        srv = None
         try:
+            if self.supervisor is not None:
+                # supervisor mode: the gateway owns the worker processes
+                # — spawn them before the first synchronous probe round
+                # so the pool starts routable, and respawn crashes from
+                # here on. Everything from here runs inside the
+                # try/finally: a bind failure below must still stop the
+                # supervisor, or it leaks live auto-respawning workers.
+                self.supervisor.start()
+            self.registry.start()
+            srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            srv.bind(self.socket_path)
+            srv.listen(128)
+            srv.settimeout(0.5)
+            self._listener = srv
             while not self._stop.is_set():
                 try:
                     conn, _ = srv.accept()
@@ -194,8 +208,11 @@ class FleetGateway:
                 threading.Thread(target=self._serve_conn, args=(conn,),
                                  name="fleet-conn", daemon=True).start()
         finally:
-            srv.close()
+            if srv is not None:
+                srv.close()
             self.registry.stop()
+            if self.supervisor is not None:
+                self.supervisor.stop()
             if os.path.exists(self.socket_path):
                 os.unlink(self.socket_path)
 
@@ -638,6 +655,8 @@ class FleetGateway:
         snap = self.registry.snapshot()
         with self._counts_mu:
             snap["route_decisions"] = dict(self.route_counts)
+        if self.supervisor is not None:
+            snap["supervisor"] = self.supervisor.snapshot()
         return snap
 
     def _health(self) -> dict:
@@ -844,6 +863,15 @@ def main(argv=None) -> int:
                     metavar="NAME=SOCKET_PATH", required=False,
                     help="one TpuDeviceService worker (repeatable)")
     ap.add_argument("--conf", action="append", default=[], metavar="K=V")
+    ap.add_argument("--supervise", action="store_true",
+                    help="spawn AND supervise the workers: a crashed "
+                         "worker is respawned at the same socket with "
+                         "backoff (fleet.supervisor.* keys)")
+    ap.add_argument("--worker-conf", action="append", default=[],
+                    metavar="K=V", help="conf for supervised workers "
+                                        "(repeatable; --supervise only)")
+    ap.add_argument("--worker-platform", default=None,
+                    help="jax platform for supervised workers")
     args = ap.parse_args(argv)
     if not args.worker:
         ap.error("at least one --worker NAME=SOCKET_PATH is required")
@@ -853,17 +881,31 @@ def main(argv=None) -> int:
         if not path:
             name, path = f"w{len(workers)}", name
         workers.append((name, path))
-    conf = {}
-    for kv in args.conf:
-        k, _, v = kv.partition("=")
-        if v and v[0] in "[{0123456789tf-":
-            try:
-                conf[k] = json.loads(v)
-            except ValueError:
-                conf[k] = v  # e.g. tp=4-style strings: pass through raw
-        else:
-            conf[k] = v
-    gw = FleetGateway(workers, conf, args.socket)
+
+    def parse_conf(pairs):
+        out = {}
+        for kv in pairs:
+            k, _, v = kv.partition("=")
+            if v and v[0] in "[{0123456789tf-":
+                try:
+                    out[k] = json.loads(v)
+                except ValueError:
+                    out[k] = v  # e.g. tp=4-style strings: pass through raw
+            else:
+                out[k] = v
+        return out
+
+    conf = parse_conf(args.conf)
+    sup = None
+    if args.supervise or TpuConf(conf).get(
+            "spark.rapids.tpu.fleet.supervisor.enabled"):
+        from .supervisor import WorkerSpec, WorkerSupervisor
+        wconf = parse_conf(args.worker_conf)
+        sup = WorkerSupervisor(
+            [WorkerSpec.service(n, p, conf=wconf,
+                                platform=args.worker_platform)
+             for n, p in workers], conf)
+    gw = FleetGateway(workers, conf, args.socket, supervisor=sup)
     gw.serve_forever()
     return 0
 

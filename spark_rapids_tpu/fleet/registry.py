@@ -99,6 +99,9 @@ class WorkerState:
         self.device = ""
         self.dispatches = 0           # lifetime run_plan dispatches
         self.dispatch_failures = 0    # connection-level dispatch failures
+        self.pid = None               # worker pid from its last ping reply
+        self.started_ts = None        # worker process start time (ping)
+        self.reincarnations = 0       # new processes observed (restarts)
 
     def snapshot(self) -> dict:
         return {
@@ -112,13 +115,19 @@ class WorkerState:
             "dispatches": self.dispatches,
             "dispatch_failures": self.dispatch_failures,
             "last_error": self.last_error,
+            "pid": self.pid,
+            "reincarnations": self.reincarnations,
         }
 
 
-def _probe_once(socket_path: str, timeout_s: float) -> str:
+def _probe_once(socket_path: str, timeout_s: float
+                ) -> Tuple[str, Optional[int], Optional[float]]:
     """One liveness probe: connect + ping on a fresh socket; returns the
-    worker's device identity. Raises ServiceConnectionError on any
-    failure (the breaker feed)."""
+    worker's (device identity, pid, process start ts). Raises
+    ServiceConnectionError on any failure (the breaker feed). The pid —
+    with the start ts catching pid REUSE — is what lets the registry
+    tell a RESTARTED worker from a recovered one; reincarnation
+    reconciliation hangs off it."""
     s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     s.settimeout(timeout_s)
     try:
@@ -139,7 +148,11 @@ def _probe_once(socket_path: str, timeout_s: float) -> str:
             raise ServiceConnectionError(
                 f"probe ping to {socket_path} rejected: {rep}",
                 endpoint=socket_path, op="ping")
-        return str(rep.get("device", ""))
+        pid = rep.get("pid")
+        ts = rep.get("started_ts")
+        return (str(rep.get("device", "")),
+                int(pid) if pid else None,
+                float(ts) if ts else None)
     finally:
         s.close()
 
@@ -203,12 +216,35 @@ class WorkerRegistry:
                 w.healthy = False
                 return
         try:
-            device = _probe_once(w.socket_path, self.probe_timeout_s)
+            device, pid, started_ts = _probe_once(w.socket_path,
+                                                  self.probe_timeout_s)
         except ServiceConnectionError as e:
             self.note_failure(w.name, str(e))
             return
+        stale_placements: List[str] = []
         with self._mu:
             prev = w.breaker.state
+            # pid change = new process; a LATER start ts at the same pid
+            # catches pid reuse (small containerized pid spaces)
+            reincarnated = (
+                (pid is not None and w.pid is not None and pid != w.pid)
+                or (started_ts is not None and w.started_ts is not None
+                    and started_ts > w.started_ts + 1e-6))
+            if reincarnated:
+                # same address, new process: every query the old process
+                # was running died with it. Purge its placements so a
+                # cancel for one of those ids gets the truthful typed
+                # `found: false` instead of being routed at a process
+                # that never heard of it.
+                w.reincarnations += 1
+                stale_placements = [qid for qid, name
+                                    in self.placements.items()
+                                    if name == w.name]
+                for qid in stale_placements:
+                    del self.placements[qid]
+            w.pid = pid if pid is not None else w.pid
+            w.started_ts = started_ts if started_ts is not None \
+                else w.started_ts
             w.breaker.success()
             w.healthy = True
             w.device = device
@@ -216,6 +252,11 @@ class WorkerRegistry:
             w.last_error = ""
             if prev != BREAKER_CLOSED and self._on_transition:
                 self._on_transition(w.name, BREAKER_CLOSED)
+        if reincarnated:
+            from .. import telemetry
+            telemetry.flight("fleet", "worker_reincarnated",
+                             worker=w.name, pid=pid,
+                             stale_placements=len(stale_placements))
 
     # ------------------------------------------------------------- routing
     def routable(self, max_outstanding: int = 0) -> List[WorkerState]:

@@ -1,0 +1,252 @@
+"""Worker supervision: crash → respawn → warm rejoin.
+
+PR 10's gateway routes AROUND a dead worker — the breaker trips, traffic
+fails over, and the process stays dead with its warm rescache/compile
+state lost. The reference engine never needed this piece because Spark's
+cluster manager relaunches executors and `Plugin` re-runs init; our
+serving tier has no cluster manager, so this module is it:
+
+  * `WorkerSupervisor` spawns each worker subprocess from a `WorkerSpec`
+    and a monitor thread polls for unexpected exits;
+  * a crashed worker is respawned AT THE SAME SOCKET ADDRESS with
+    exponential backoff (`fleet.supervisor.backoffMs` doubling up to
+    `backoffMaxMs`), so the gateway's registry sees the same worker name
+    reincarnate and the prober's half-open trial re-admits it with zero
+    operator action;
+  * a worker that crashes past `fleet.supervisor.maxRestarts` is marked
+    FAILED — no more respawns, one flight-recorder incident: a crash
+    loop must page someone, not burn CPU forever;
+  * restart counts feed `tpu_fleet_worker_restarts_total{worker=..}` and
+    the gateway's `fleet_stats` reply (`supervisor` block), alongside
+    the registry's own pid-observed `reincarnations` counter which works
+    even when something else (k8s, systemd) owns the respawning.
+
+The respawned process re-runs device init, which reloads every
+persistent tier (compile cache, statistics history, and the PR-14
+persistent result tier) — crash → restart → warm-again, the path
+scripts/chaos_matrix.sh drives under SIGKILL storms.
+
+Off-path: nothing imports this module unless a supervisor is
+constructed (same import-based contract as the rest of fleet/)."""
+
+from __future__ import annotations
+
+import dataclasses
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from ..config import TpuConf
+
+__all__ = ["WorkerSpec", "SupervisedWorker", "WorkerSupervisor"]
+
+STATE_RUNNING = "running"
+STATE_BACKOFF = "backoff"
+STATE_FAILED = "failed"     # restart cap exhausted
+STATE_STOPPED = "stopped"   # supervisor shut it down deliberately
+
+
+@dataclasses.dataclass
+class WorkerSpec:
+    """How to (re)spawn one worker. `argv` must bind the worker to
+    `socket_path` so a respawn reincarnates at the same address."""
+    name: str
+    socket_path: str
+    argv: List[str]
+    env: Optional[dict] = None
+    cwd: Optional[str] = None
+    log_path: Optional[str] = None
+
+    @staticmethod
+    def service(name: str, socket_path: str,
+                conf: Optional[dict] = None, platform: Optional[str] = None,
+                env: Optional[dict] = None, cwd: Optional[str] = None,
+                log_path: Optional[str] = None) -> "WorkerSpec":
+        """Spec for a stock `spark_rapids_tpu.service.server` worker."""
+        argv = [sys.executable, "-m", "spark_rapids_tpu.service.server",
+                "--socket", socket_path]
+        if platform:
+            argv += ["--platform", platform]
+        for k, v in (conf or {}).items():
+            if isinstance(v, bool):
+                v = "true" if v else "false"
+            argv += ["--conf", f"{k}={v}"]
+        return WorkerSpec(name, socket_path, argv, env=env, cwd=cwd,
+                          log_path=log_path)
+
+
+class SupervisedWorker:
+    def __init__(self, spec: WorkerSpec):
+        self.spec = spec
+        self.proc: Optional[subprocess.Popen] = None
+        self.state = STATE_STOPPED
+        self.restarts = 0
+        self.last_exit: Optional[int] = None
+        self.next_respawn_at = 0.0
+        self.started_at = 0.0
+        self._log_file = None
+
+    def snapshot(self) -> dict:
+        return {"state": self.state, "restarts": self.restarts,
+                "pid": self.proc.pid if self.proc is not None else None,
+                "last_exit": self.last_exit,
+                "socket": self.spec.socket_path}
+
+
+class WorkerSupervisor:
+    """Spawns and babysits a pool of worker subprocesses."""
+
+    def __init__(self, specs: Sequence[WorkerSpec],
+                 conf: Optional[dict] = None):
+        c = conf if isinstance(conf, TpuConf) else TpuConf(conf)
+        self.max_restarts = c.get(
+            "spark.rapids.tpu.fleet.supervisor.maxRestarts")
+        self.backoff_s = c.get(
+            "spark.rapids.tpu.fleet.supervisor.backoffMs") / 1000.0
+        self.backoff_max_s = c.get(
+            "spark.rapids.tpu.fleet.supervisor.backoffMaxMs") / 1000.0
+        self.check_interval_s = c.get(
+            "spark.rapids.tpu.fleet.supervisor.checkIntervalMs") / 1000.0
+        self._mu = threading.Lock()
+        self.workers: Dict[str, SupervisedWorker] = {}
+        for spec in specs:
+            if spec.name in self.workers:
+                raise ValueError(f"duplicate worker name {spec.name!r}")
+            self.workers[spec.name] = SupervisedWorker(spec)
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> "WorkerSupervisor":
+        for w in self.workers.values():
+            self._spawn(w)
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         name="fleet-supervisor",
+                                         daemon=True)
+        self._monitor.start()
+        return self
+
+    def stop(self, kill: bool = True, timeout_s: float = 10.0) -> None:
+        """Stop supervising; with `kill` also terminate the workers (a
+        drained rolling restart calls with kill=False and owns shutdown
+        itself)."""
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=self.check_interval_s + 5.0)
+            self._monitor = None
+        if not kill:
+            # workers keep running (caller owns their shutdown), but our
+            # copies of their log handles must not leak — each child
+            # holds its own inherited fd
+            for w in self.workers.values():
+                self._close_log(w)
+            return
+        with self._mu:
+            live = [w for w in self.workers.values()
+                    if w.proc is not None and w.proc.poll() is None]
+            for w in self.workers.values():
+                w.state = STATE_STOPPED
+        for w in live:
+            w.proc.terminate()
+        deadline = time.monotonic() + timeout_s
+        for w in live:
+            try:
+                w.proc.wait(timeout=max(deadline - time.monotonic(), 0.1))
+            except subprocess.TimeoutExpired:
+                w.proc.kill()
+                w.proc.wait()
+        for w in self.workers.values():
+            self._close_log(w)
+
+    # ------------------------------------------------------------- spawning
+    def _spawn(self, w: SupervisedWorker) -> None:
+        spec = w.spec
+        self._close_log(w)
+        if spec.log_path:
+            w._log_file = open(spec.log_path, "ab")
+            out = err = w._log_file
+        else:
+            out = err = subprocess.DEVNULL
+        w.proc = subprocess.Popen(spec.argv, env=spec.env, cwd=spec.cwd,
+                                  stdout=out, stderr=err)
+        w.state = STATE_RUNNING
+        w.started_at = time.monotonic()
+
+    @staticmethod
+    def _close_log(w: SupervisedWorker) -> None:
+        if w._log_file is not None:
+            try:
+                w._log_file.close()
+            except OSError:
+                pass
+            w._log_file = None
+
+    def _monitor_loop(self) -> None:
+        from .. import telemetry
+        while not self._stop.wait(self.check_interval_s):
+            now = time.monotonic()
+            for w in list(self.workers.values()):
+                with self._mu:
+                    if w.state == STATE_RUNNING and w.proc is not None \
+                            and w.proc.poll() is not None:
+                        # unexpected death
+                        w.last_exit = w.proc.returncode
+                        if w.restarts >= self.max_restarts:
+                            w.state = STATE_FAILED
+                            cap_hit = True
+                        else:
+                            w.state = STATE_BACKOFF
+                            w.next_respawn_at = now + min(
+                                self.backoff_s * (2 ** w.restarts),
+                                self.backoff_max_s)
+                            cap_hit = False
+                        died = True
+                    else:
+                        died = False
+                    respawn = (w.state == STATE_BACKOFF
+                               and now >= w.next_respawn_at
+                               and not self._stop.is_set())
+                    if respawn:
+                        w.restarts += 1
+                if died:
+                    telemetry.flight(
+                        "fleet", "worker_died", worker=w.spec.name,
+                        exit_code=w.last_exit, restarts=w.restarts)
+                    if cap_hit:
+                        telemetry.incident(
+                            "worker_restart_cap", worker=w.spec.name,
+                            restarts=w.restarts,
+                            max_restarts=self.max_restarts)
+                if respawn:
+                    self._spawn(w)
+                    telemetry.inc("tpu_fleet_worker_restarts_total",
+                                  worker=w.spec.name)
+                    telemetry.flight("fleet", "worker_respawn",
+                                     worker=w.spec.name,
+                                     restarts=w.restarts)
+
+    # ---------------------------------------------------------------- state
+    def worker(self, name: str) -> SupervisedWorker:
+        return self.workers[name]
+
+    def restart_counts(self) -> Dict[str, int]:
+        with self._mu:
+            return {n: w.restarts for n, w in self.workers.items()}
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {n: w.snapshot() for n, w in self.workers.items()}
+
+    def wait_all_running(self, timeout_s: float = 60.0) -> bool:
+        """Block until every non-failed worker is RUNNING (tests)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._mu:
+                pending = [w for w in self.workers.values()
+                           if w.state == STATE_BACKOFF]
+            if not pending:
+                return True
+            time.sleep(0.05)
+        return False

@@ -39,11 +39,15 @@ from .fingerprint import RESULT_CONF_KEYS, Fingerprint, fingerprint
 __all__ = ["configure", "shutdown", "is_enabled", "get", "stats",
            "invalidate", "begin_query", "QueryCacheHandle",
            "fragment_stream", "cached_blob", "fingerprint",
-           "ResultCache", "RESULT_CONF_KEYS"]
+           "ResultCache", "RESULT_CONF_KEYS", "persist_tier"]
 
 _ACTIVE = False
 _mu = threading.Lock()
 _cache: Optional[ResultCache] = None
+# persistent whole-query tier (persist.py); None unless
+# spark.rapids.tpu.rescache.persist.dir is configured
+_persist = None
+_warmup_thread: Optional[threading.Thread] = None
 
 # fragment seams bound their single-flight wait: a mid-query seam must
 # not park forever behind another query's producer (whole-query waits are
@@ -59,11 +63,20 @@ def get() -> Optional[ResultCache]:
     return _cache
 
 
+def persist_tier():
+    """The live PersistentResultTier, or None (tests / cache_stats)."""
+    return _persist
+
+
 def configure(conf) -> None:
     """Enable per `spark.rapids.tpu.rescache.*` (no-op when the switch is
     off or the cache is already up). Called from
-    TpuSession.initialize_device, like telemetry.configure."""
-    global _ACTIVE, _cache
+    TpuSession.initialize_device, like telemetry.configure. With
+    `rescache.persist.dir` set, the persistent whole-query tier comes up
+    too and (unless `persist.warmup.enabled` is off) a background thread
+    reloads the previous incarnation's results into the memory cache —
+    the crash-recovery warm path."""
+    global _ACTIVE, _cache, _persist, _warmup_thread
     if not conf.get("spark.rapids.tpu.rescache.enabled"):
         return
     with _mu:
@@ -73,26 +86,58 @@ def configure(conf) -> None:
             max_bytes=conf.get("spark.rapids.tpu.rescache.maxBytes"),
             min_recompute_ms=conf.get(
                 "spark.rapids.tpu.rescache.minRecomputeMs"))
+        persist_dir = conf.get("spark.rapids.tpu.rescache.persist.dir")
+        if persist_dir:
+            from .persist import PersistentResultTier
+            _persist = PersistentResultTier(
+                persist_dir,
+                conf.get("spark.rapids.tpu.rescache.persist.maxBytes"))
         _ACTIVE = True
+        if _persist is not None and _persist.available() and conf.get(
+                "spark.rapids.tpu.rescache.persist.warmup.enabled"):
+            cache, tier = _cache, _persist
+            _warmup_thread = threading.Thread(
+                target=tier.warmup_into,
+                args=(cache, lambda: _ACTIVE and _cache is cache),
+                name="rescache-warmup", daemon=True)
+            _warmup_thread.start()
 
 
 def shutdown() -> None:
     """Tear the cache down (tests / process exit): close every entry,
-    drop all state."""
-    global _ACTIVE, _cache
+    drop all state. Persisted entries stay on disk — surviving restart
+    is their entire purpose."""
+    global _ACTIVE, _cache, _persist, _warmup_thread
     with _mu:
         _ACTIVE = False
         cache, _cache = _cache, None
+        _persist = None
+        th, _warmup_thread = _warmup_thread, None
+    if th is not None and th.is_alive():
+        th.join(timeout=10.0)
     if cache is not None:
         cache.invalidate()
 
 
 def stats() -> Optional[dict]:
     cache = _cache
-    return cache.stats() if cache is not None else None
+    if cache is None:
+        return None
+    snap = cache.stats()
+    p = _persist
+    if p is not None:
+        snap["persist"] = p.stats_dict()
+    return snap
 
 
 def invalidate() -> int:
+    """Drop every entry — memory AND disk. The op exists for in-place
+    data rewrites the file-identity fingerprints cannot see; leaving the
+    persisted copies behind would resurrect exactly those stale results
+    at the next restart."""
+    p = _persist
+    if p is not None:
+        p.clear()
     cache = _cache
     return cache.invalidate() if cache is not None else 0
 
@@ -174,9 +219,17 @@ class QueryCacheHandle:
             nbytes = int(table.nbytes)
         except Exception:
             nbytes = 0
-        cache.complete(self._key, "query", "table", table, nbytes,
-                       time.monotonic_ns() - self._t0,
-                       validators=self._validators)
+        recompute_ns = time.monotonic_ns() - self._t0
+        stored = cache.complete(self._key, "query", "table", table, nbytes,
+                                recompute_ns,
+                                validators=self._validators)
+        # persistent tier: only results the memory cache judged storable,
+        # and only validator-free fingerprints — a validator means
+        # process-local identity (in-memory table id()) that a fresh
+        # process could alias to different data
+        p = _persist
+        if stored and p is not None and not self._validators:
+            p.store(self._key, table, "query", recompute_ns)
 
     def abort(self) -> None:
         if self._done:
@@ -231,6 +284,35 @@ def begin_query(plan, conf) -> Optional[QueryCacheHandle]:
         # bypass (unstorable fingerprint): compute WITHOUT a handle — a
         # complete() here would pop another owner's in-flight marker
         return None
+    # persistent-tier fallthrough: a restarted worker whose background
+    # warmup has not reached this digest yet (or runs warmup-off) still
+    # answers previously-hot fingerprints from disk — no device admission,
+    # no recompute. We ARE the single-flight owner here, so completing
+    # the cache with the loaded table also releases any parked waiters.
+    p = _persist
+    if p is not None and not fp.validators:
+        loaded = p.load(fp.digest)
+        if loaded is not None:
+            table, meta = loaded
+            try:
+                nbytes = int(meta.get("nbytes") or 0) or int(table.nbytes)
+            except Exception:
+                nbytes = 1
+            cache.complete(fp.digest, "query", "table", table,
+                           max(nbytes, 1),
+                           int(meta.get("recompute_ns", 0)),
+                           validators=())
+            p.count_hit()
+            from ..utils.metrics import TaskMetrics
+            TaskMetrics.get().rescache_persist_hits += 1
+            _count_hit("query")
+            with spans.span("rescache:query", kind=spans.KIND_CACHE,
+                            hit=1, persist=1):
+                pass
+            from .. import telemetry
+            telemetry.flight("cache", "persist_hit",
+                             bytes=int(meta.get("nbytes") or 0))
+            return QueryCacheHandle(fp.digest, fp.validators, hit=table)
     return QueryCacheHandle(fp.digest, fp.validators)
 
 

@@ -240,6 +240,27 @@ class ResultCache:
             TaskMetrics.get().rescache_stores += 1
         return stored
 
+    def adopt(self, key: str, seam: str, kind: str, value: Any,
+              nbytes: int, recompute_ns: int, validators=()) -> bool:
+        """Warmup path (rescache/persist.py): insert an entry reloaded
+        from the persistent tier, but only when the key is ABSENT — a
+        live entry or an in-flight owner is fresher than a disk copy and
+        must win. No unstorable latching, no waiter bookkeeping."""
+        to_close: List[Entry] = []
+        with self._mu:
+            if key in self._entries or key in self._inflight:
+                return False
+            if not (0 < nbytes <= self.max_bytes):
+                return False
+            e = Entry(key, kind, seam, value, nbytes, recompute_ns,
+                      validators)
+            self._entries[key] = e
+            to_close.extend(self._evict_over_capacity_locked())
+            stored = key in self._entries
+        for old in to_close:
+            old.close()
+        return stored
+
     def abort(self, key: str) -> None:
         """Owner path on failure: release the in-flight marker so a parked
         waiter can take over as the next owner."""

@@ -1,0 +1,270 @@
+"""Persistent whole-query result tier — crash recovery for the serving
+fleet's warm state.
+
+PR 10's gateway routes AROUND a dead worker; this tier is what makes the
+respawned worker worth routing BACK to: whole-query results whose
+fingerprints are validator-free (pure file/delta identity — no
+process-local object ids) persist to `spark.rapids.tpu.rescache.persist.
+dir` with the compile-cache discipline, and a restarted worker reloads
+them on device init, answering previously-hot dashboard fingerprints in
+milliseconds with ZERO device admissions instead of a ~7s cold
+recompute.
+
+Entry format (one `<digest>.qres` file per fingerprint):
+
+    magic "SRQR1" | u8 version | u32 crc32c(body) | u32 meta_len | body
+    body = meta JSON (seam, rows, nbytes, recompute_ns, ts)
+         + Arrow IPC stream of the result table
+
+A torn tail, a bit-flipped payload (CRC mismatch), or undecodable IPC is
+a MISS + DELETE — never a wrong result (the same contract as the
+compile cache's .xprog entries). Staleness needs no sidecar state: file
+mtime/size and delta versions are INSIDE the fingerprint, so an entry
+persisted against rewritten data is simply never looked up again
+(`rescache.invalidate()` additionally wipes the directory — its whole
+point is the in-place rewrite file identity cannot see, which a restart
+would otherwise resurrect from disk).
+
+IO failures degrade the tier through `utils/durable.py` (typed warning
++ `tpu_persist_degraded_total{tier="rescache"}` + one flight-recorder
+incident) and queries keep computing; the `persist` fault point drives
+that path, with `corrupt` rules poisoning loaded blobs."""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+import time
+from typing import List, Optional, Tuple
+
+__all__ = ["PersistentResultTier"]
+
+_MAGIC = b"SRQR1"
+_HDR = struct.Struct("<5sBII")  # magic, version, crc32c(body), meta_len
+_VERSION = 1
+_SUFFIX = ".qres"
+
+
+class PersistentResultTier:
+    """Constructed only by rescache.configure() when
+    `spark.rapids.tpu.rescache.persist.dir` is set."""
+
+    def __init__(self, dir_path: str, max_bytes: int):
+        self.dir = dir_path
+        self.max_bytes = int(max_bytes)
+        from ..utils import durable
+        self.tier = durable.tier("rescache", dir_path)
+        self._mu = threading.Lock()
+        self.stores = 0
+        self.hits = 0        # persisted entries served to a query
+        self.warmed = 0      # entries preloaded into memory at startup
+        self.poisoned = 0    # torn/corrupt entries deleted on load
+        self.gc_evictions = 0
+        self.tier.run("mkdir",
+                      lambda: os.makedirs(dir_path, exist_ok=True))
+
+    def available(self) -> bool:
+        return self.tier.available()
+
+    def _path(self, digest: str) -> str:
+        return os.path.join(self.dir, digest + _SUFFIX)
+
+    # ---------------------------------------------------------------- store
+    def store(self, digest: str, table, seam: str,
+              recompute_ns: int) -> bool:
+        """Persist one result table (tmp-write + atomic rename). Returns
+        True when the entry landed; any IO failure degrades the tier and
+        returns False — the in-memory entry still serves this process."""
+        if not self.available():
+            return False
+        from ..shuffle.codec import crc32c
+        try:
+            from ..service.protocol import table_to_ipc
+            payload = table_to_ipc(table)
+            meta = json.dumps({
+                "seam": seam, "rows": int(table.num_rows),
+                "nbytes": int(table.nbytes),
+                "recompute_ns": int(recompute_ns),
+                "ts": time.time()}).encode()
+        except Exception:
+            return False  # an unserializable ENTRY skips itself
+        body = meta + payload
+        blob = _HDR.pack(_MAGIC, _VERSION, crc32c(body), len(meta)) + body
+        if len(blob) > self.max_bytes:
+            return False  # one entry over the whole tier budget
+
+        def write() -> bool:
+            path = self._path(digest)
+            tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)
+            return True
+
+        if not self.tier.run("store", write):
+            return False
+        with self._mu:
+            self.stores += 1
+        from .. import telemetry
+        telemetry.inc("tpu_rescache_persist_total", event="store")
+        self._gc()
+        return True
+
+    # ----------------------------------------------------------------- load
+    def load(self, digest: str) -> Optional[Tuple[object, dict]]:
+        """(table, meta) for one persisted entry, or None. A torn or
+        poisoned entry is deleted and treated as a miss — the recompute
+        re-persists a good one."""
+        if not self.available():
+            return None
+        path = self._path(digest)
+
+        def read():
+            with open(path, "rb") as f:
+                return f.read()
+
+        blob = self.tier.run("load", read, missing_ok=True,
+                             corruptible=True)
+        if blob is None:
+            return None
+        decoded = self._decode(blob)
+        if decoded is None:
+            with self._mu:
+                self.poisoned += 1
+            from .. import telemetry
+            telemetry.inc("tpu_rescache_persist_total", event="poisoned")
+            telemetry.flight("persist", "poisoned_entry", tier="rescache",
+                             digest=digest)
+            self.tier.run("delete", lambda: os.unlink(path),
+                          missing_ok=True)
+            return None
+        return decoded
+
+    @staticmethod
+    def _decode(blob: bytes) -> Optional[Tuple[object, dict]]:
+        try:
+            if len(blob) < _HDR.size:
+                return None
+            magic, ver, crc, meta_len = _HDR.unpack_from(blob)
+            if magic != _MAGIC or ver != _VERSION:
+                return None
+            body = blob[_HDR.size:]
+            if len(body) < meta_len:
+                return None
+            from ..shuffle.codec import crc32c
+            if crc32c(body) != crc:
+                return None
+            meta = json.loads(body[:meta_len].decode())
+            from ..service.protocol import ipc_to_table
+            table = ipc_to_table(body[meta_len:])
+            if int(meta.get("rows", -1)) != int(table.num_rows):
+                return None
+            return table, meta
+        except Exception:
+            return None
+
+    # ------------------------------------------------------------- lifecycle
+    def entries(self) -> List[str]:
+        if not self.available():
+            return []
+        return self.tier.run(
+            "list", lambda: [f[:-len(_SUFFIX)]
+                             for f in os.listdir(self.dir)
+                             if f.endswith(_SUFFIX)], default=[])
+
+    def clear(self) -> int:
+        """Delete every persisted entry (the cache_invalidate hammer —
+        an in-place data rewrite the fingerprint's file identity cannot
+        see MUST not come back from disk on the next restart)."""
+        digests = self.entries()
+
+        def wipe() -> int:
+            n = 0
+            for d in digests:
+                try:
+                    os.unlink(self._path(d))
+                    n += 1
+                except FileNotFoundError:
+                    pass
+            return n
+
+        return self.tier.run("clear", wipe, default=0) or 0
+
+    def warmup_into(self, cache, is_active) -> int:
+        """Background warmup (rescache.configure spawns the thread): pull
+        every persisted entry into the in-memory cache so the first
+        post-restart dashboard hit needs no disk read. `is_active` is
+        polled per entry so shutdown() stops a half-done warmup cleanly.
+        Live entries/in-flight owners always win over warmed copies."""
+        n = 0
+        for digest in self.entries():
+            if not is_active():
+                break
+            loaded = self.load(digest)
+            if loaded is None:
+                continue
+            table, meta = loaded
+            if cache.adopt(digest, meta.get("seam", "query"), "table",
+                           table, int(meta.get("nbytes") or table.nbytes),
+                           int(meta.get("recompute_ns", 0))):
+                n += 1
+        with self._mu:
+            self.warmed += n
+        if n:
+            from .. import telemetry
+            telemetry.inc("tpu_rescache_persist_total", value=n,
+                          event="warmed")
+            telemetry.flight("persist", "warmup_done", tier="rescache",
+                             entries=n)
+        return n
+
+    def count_hit(self) -> None:
+        with self._mu:
+            self.hits += 1
+        from .. import telemetry
+        telemetry.inc("tpu_rescache_persist_total", event="hit")
+
+    # ----------------------------------------------------------------- gc
+    def _gc(self) -> None:
+        """Bound the directory at max_bytes: oldest entries (mtime) leave
+        first. Runs after each store; store traffic is per DISTINCT query,
+        so the listdir stays off any hot path."""
+        def collect():
+            out = []
+            for f in os.listdir(self.dir):
+                if not f.endswith(_SUFFIX):
+                    continue
+                p = os.path.join(self.dir, f)
+                try:
+                    st = os.stat(p)
+                except FileNotFoundError:
+                    continue
+                out.append((st.st_mtime_ns, st.st_size, p))
+            return out
+
+        files = self.tier.run("gc", collect, default=[])
+        if not files:
+            return
+        total = sum(sz for _, sz, _ in files)
+        if total <= self.max_bytes:
+            return
+        files.sort()
+        for _, sz, p in files:
+            if total <= self.max_bytes:
+                break
+            self.tier.run("gc", lambda p=p: os.unlink(p), missing_ok=True)
+            total -= sz
+            with self._mu:
+                self.gc_evictions += 1
+
+    # -------------------------------------------------------------- stats
+    def stats_dict(self) -> dict:
+        with self._mu:
+            return {"dir": self.dir, "available": self.available(),
+                    "degraded": self.tier.degraded,
+                    "stores": self.stores, "hits": self.hits,
+                    "warmed": self.warmed, "poisoned": self.poisoned,
+                    "gc_evictions": self.gc_evictions,
+                    "entries": len(self.entries())}

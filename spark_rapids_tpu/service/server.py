@@ -46,6 +46,7 @@ import json
 import os
 import socket
 import threading
+import time
 from typing import Dict, Optional
 
 from ..errors import (DeadlineExceededError, QueryCancelledError,
@@ -55,6 +56,8 @@ from ..sched import (ABANDONED, AdmissionQueue, QueryContext,
 from .protocol import recv_msg, send_msg, table_to_ipc
 
 __all__ = ["TpuDeviceService"]
+
+_PROC_START_TS = time.time()
 
 
 class _Admission:
@@ -172,8 +175,13 @@ class TpuDeviceService:
                     return
                 op = header.get("op")
                 if op == "ping":
+                    # pid + start time let the fleet registry tell a
+                    # RESTARTED worker from a recovered one (reincarnation
+                    # reconciliation: purge stale placements, count it)
                     send_msg(conn, {"ok": True,
-                                    "device": self._device_name()})
+                                    "device": self._device_name(),
+                                    "pid": os.getpid(),
+                                    "started_ts": _PROC_START_TS})
                 elif op == "acquire":
                     seq = self._handle_acquire(conn, header)
                     if seq is ABANDONED:

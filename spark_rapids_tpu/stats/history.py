@@ -109,7 +109,13 @@ class StatsHistory:
         self.persist_skipped = 0
         self._path = os.path.join(persist_dir, "stats_history.jsonl") \
             if persist_dir else ""
+        # durable-tier discipline (utils/durable.py): IO failures degrade
+        # persistence to memory-only (warning + counter + incident), never
+        # a failed lookup/record
+        self._tier = None
         if self._path:
+            from ..utils import durable
+            self._tier = durable.tier("stats", persist_dir)
             self._load()
 
     # ------------------------------------------------------------- queries
@@ -193,7 +199,8 @@ class StatsHistory:
             while len(self._entries) > self._max:
                 self._entries.popitem(last=False)
             self.records += 1
-        if persistable and changed and self._path:
+        if persistable and changed and self._path and \
+                self._tier is not None and self._tier.available():
             self._append(entry)
 
     # --------------------------------------------------------- persistence
@@ -207,22 +214,31 @@ class StatsHistory:
     def _append(self, entry: OpStats) -> None:
         try:
             line = self._frame(entry)
+        except (ValueError, TypeError):
+            return  # an unframeable ENTRY skips itself, not the tier
+
+        def write():
             with self._fmu:
                 os.makedirs(os.path.dirname(self._path), exist_ok=True)
                 with open(self._path, "a") as f:
                     f.write(line)
-        except (OSError, ValueError, TypeError):
-            pass  # persistence is best-effort; the memory tier still has it
+
+        # disk failure degrades the tier (memory keeps the entry)
+        self._tier.run("append", write)
 
     def _load(self) -> None:
         """Replay the JSONL tier into the LRU. Any line that fails its
         CRC frame or JSON decode is skipped (a miss, never a wrong
         stat); later lines override earlier ones for the same digest."""
         from ..shuffle.codec import crc32c
-        try:
+
+        def read():
             with open(self._path) as f:
-                lines = f.read().splitlines()
-        except OSError:
+                return f.read().splitlines()
+
+        # a missing file is a fresh store; other IO errors degrade
+        lines = self._tier.run("load", read, missing_ok=True)
+        if lines is None:
             return
         live: "OrderedDict[str, OpStats]" = OrderedDict()
         for line in lines:
@@ -253,11 +269,11 @@ class StatsHistory:
             self._compact(live)
 
     def _compact(self, live: "OrderedDict[str, OpStats]") -> None:
-        try:
+        def write():
             tmp = self._path + ".tmp"
             with open(tmp, "w") as f:
                 for entry in live.values():
                     f.write(self._frame(entry))
             os.replace(tmp, self._path)
-        except OSError:
-            pass
+
+        self._tier.run("compact", write)

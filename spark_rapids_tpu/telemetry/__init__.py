@@ -378,6 +378,23 @@ def _install_families(reg: MetricsRegistry) -> None:
               "Batches currently parked across live prefetch queues.",
               callback=_prefetch_gauge)
 
+    # durable persistence (utils/durable.py + rescache/persist.py): tier
+    # degradations and persistent result-tier traffic. A nonzero degraded
+    # counter means a worker lost its warm-restart story for that tier —
+    # the alert the chaos gate's disk-full campaign asserts fires.
+    reg.counter("tpu_persist_degraded_total",
+                "Durable tiers (compile cache / stats history / event log "
+                "/ persistent result tier) degraded to memory-only after "
+                "an IO failure.", ["tier"])
+    reg.counter("tpu_rescache_persist_total",
+                "Persistent result-tier operations (store / hit / warmed "
+                "/ poisoned).", ["event"])
+
+    # fleet supervisor (fleet/supervisor.py): respawns of crashed workers
+    reg.counter("tpu_fleet_worker_restarts_total",
+                "Worker processes respawned by the fleet supervisor.",
+                ["worker"])
+
     # result & fragment cache (rescache/)
     reg.counter("tpu_rescache_hits_total",
                 "Result/fragment-cache hits, by seam and tenant.",

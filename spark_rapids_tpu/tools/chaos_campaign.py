@@ -1,0 +1,720 @@
+"""Chaos campaign runner — scripted process-death drills with an
+invariant checker.
+
+Drives a REAL fleet (gateway in-process, N worker OS processes under
+the fleet supervisor) through the failures PR 14 claims to survive:
+
+  kill_failover_warm    SIGSTOP the affinity worker, SIGKILL it with a
+                        dashboard query provably in flight -> the
+                        gateway fails over with bit-identical rows, the
+                        supervisor respawns the worker at the same
+                        socket, the breaker's half-open probe re-admits
+                        it, and the respawned worker answers the same
+                        fingerprint from its persistent result tier
+                        with ZERO device admissions (telemetry delta).
+  restart_under_load    client threads hammer the pool while a worker
+                        is SIGKILLed repeatedly: every query returns
+                        bit-identical rows or a typed error, restart
+                        counts match, breakers recover.
+  disk_full_persist     an injected `persist` IO fault degrades the
+                        worker's durable tiers to memory-only (counter
+                        + incident) while every query stays correct.
+  corrupt_persist       persisted result entries are bit-flipped on
+                        disk; the respawned worker treats them as
+                        miss+delete (poisoned counter) and recomputes
+                        bit-identical rows — never serves garbage.
+  fault_storm           probabilistic alloc-OOM / spill-IO / cache /
+                        compile / tcp-delay faults rain on the workers;
+                        rows stay bit-identical or errors stay typed.
+
+Shared invariants after every campaign (check_invariants): admission
+tokens are still grantable on every worker (acquire/release round-trip),
+circuit breakers recover to CLOSED, the orchestrator's thread and fd
+counts return to their post-setup baseline, and worker catalog handles /
+budget bytes return to ~zero after a cache invalidate — a crash drill
+must not leak the resources it exercised.
+
+Engine-free: this process never initializes a device — it speaks the
+wire protocol to worker subprocesses, exactly like tpu_top.
+
+Usage:
+    python -m spark_rapids_tpu.tools.chaos_campaign [--campaign NAME]
+        [--workdir DIR] [--workers N] [--seed N] [--json]
+
+Exit 0 = every campaign's assertions and invariants held."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["ChaosRig", "run_campaign", "CAMPAIGNS", "check_invariants",
+           "is_typed_error"]
+
+# error shapes the wire contract blesses: typed client exceptions, plus
+# generic replies whose message names a typed engine error (the service
+# protocol collapses engine exceptions it has no error_type for into
+# plain `error` replies — the NAME survives and is asserted here)
+_TYPED_WIRE_NAMES = (
+    "InjectedFault", "RetryOOM", "SplitAndRetryOOM",
+    "ShuffleFetchFailedError", "ShuffleCorruptionError",
+    "DeadlineExceededError", "QueryRejectedError", "QueryCancelledError",
+    "AdmissionTimeoutError", "OSError", "IOError", "ConnectionResetError",
+)
+
+
+def is_typed_error(exc: BaseException) -> bool:
+    from ..errors import (AdmissionTimeoutError, DeadlineExceededError,
+                          DeviceStartupError, QueryCancelledError,
+                          QueryRejectedError, ServiceConnectionError)
+    if isinstance(exc, (ServiceConnectionError, QueryRejectedError,
+                        DeadlineExceededError, QueryCancelledError,
+                        AdmissionTimeoutError, DeviceStartupError)):
+        return True
+    if isinstance(exc, RuntimeError):
+        msg = str(exc)
+        return any(msg.startswith(n) or f" {n}" in msg[:80]
+                   for n in _TYPED_WIRE_NAMES)
+    return False
+
+
+def _scrape_counters(text: str) -> Dict[str, float]:
+    """Prometheus text -> {family{label=..}: value} for counters/gauges."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, _, val = line.rpartition(" ")
+        try:
+            out[name.strip()] = float(val)
+        except ValueError:
+            continue
+    return out
+
+
+def _family_total(counters: Dict[str, float], family: str) -> float:
+    return sum(v for k, v in counters.items()
+               if k == family or k.startswith(family + "{"))
+
+
+class ChaosRig:
+    """One fleet: parquet dataset + N supervised workers + gateway."""
+
+    def __init__(self, workdir: str, n_workers: int = 2,
+                 worker_conf: Optional[dict] = None,
+                 gateway_conf: Optional[dict] = None,
+                 seed: int = 7, rows: int = 20_000):
+        import numpy as np
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        self.workdir = workdir
+        os.makedirs(workdir, exist_ok=True)
+        self.events_dir = os.path.join(workdir, "events")
+        rng = np.random.default_rng(seed)
+        self.table = pa.table({
+            "k": pa.array(rng.integers(0, 64, rows).astype("int64")),
+            "v": pa.array(rng.uniform(size=rows))})
+        self.data_path = os.path.join(workdir, "t.parquet")
+        pq.write_table(self.table, self.data_path)
+        self.paths = {"t": [self.data_path]}
+
+        self.worker_names = [f"w{i}" for i in range(n_workers)]
+        self.socks = {n: os.path.join(workdir, f"{n}.sock")
+                      for n in self.worker_names}
+        self.persist_dirs = {n: os.path.join(workdir, "persist", n)
+                             for n in self.worker_names}
+        self.base_worker_conf = {
+            "spark.rapids.sql.concurrentGpuTasks": 2,
+            "spark.rapids.tpu.rescache.enabled": True,
+            "spark.rapids.tpu.telemetry.enabled": True,
+            "spark.rapids.tpu.sched.enabled": True,
+            "spark.rapids.tpu.metrics.eventLog.dir": self.events_dir,
+        }
+        self.base_worker_conf.update(worker_conf or {})
+        self.gateway_conf = {
+            "spark.rapids.tpu.fleet.probe.intervalMs": 200,
+            "spark.rapids.tpu.fleet.probe.timeoutSec": 3.0,
+            "spark.rapids.tpu.fleet.breaker.failures": 2,
+            "spark.rapids.tpu.fleet.breaker.cooldownMs": 800,
+            "spark.rapids.tpu.fleet.supervisor.backoffMs": 100,
+            "spark.rapids.tpu.fleet.supervisor.checkIntervalMs": 50,
+            "spark.rapids.tpu.fleet.supervisor.maxRestarts": 10,
+        }
+        self.gateway_conf.update(gateway_conf or {})
+        self.gw_sock = os.path.join(workdir, "gateway.sock")
+        self.supervisor = None
+        self.gateway = None
+        self._gw_thread: Optional[threading.Thread] = None
+        self._baseline_threads = 0
+        self._baseline_fds = 0
+
+    # -------------------------------------------------------------- lifecycle
+    def _env(self) -> dict:
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        return env
+
+    def start(self, await_workers: bool = True) -> "ChaosRig":
+        from ..fleet.gateway import FleetGateway
+        from ..fleet.supervisor import WorkerSpec, WorkerSupervisor
+        specs = []
+        for n in self.worker_names:
+            conf = dict(self.base_worker_conf)
+            conf["spark.rapids.tpu.rescache.persist.dir"] = \
+                self.persist_dirs[n]
+            specs.append(WorkerSpec.service(
+                n, self.socks[n], conf=conf, platform="cpu",
+                env=self._env(),
+                log_path=os.path.join(self.workdir, f"{n}.log")))
+        self.supervisor = WorkerSupervisor(specs, self.gateway_conf)
+        self.gateway = FleetGateway(
+            [(n, self.socks[n]) for n in self.worker_names],
+            self.gateway_conf, self.gw_sock, supervisor=self.supervisor)
+        self._gw_thread = threading.Thread(
+            target=self.gateway.serve_forever, name="chaos-gateway",
+            daemon=True)
+        self._gw_thread.start()
+        if await_workers:
+            for n in self.worker_names:
+                self.await_worker(n)
+        self.client(30.0).connect().close()  # gateway itself answers
+        if await_workers and not self.wait_breakers_closed(60.0):
+            # workers that came up slower than the first probe round
+            # tripped their breakers; campaigns start from a green pool
+            raise RuntimeError(
+                f"pool never converged: {self.fleet_stats()['workers']}")
+        return self
+
+    def await_worker(self, name: str, deadline_s: float = 120.0) -> None:
+        from ..service import TpuServiceClient
+        TpuServiceClient(self.socks[name],
+                         deadline_s=deadline_s).connect().close()
+
+    def stop(self) -> None:
+        from ..service import TpuServiceClient
+        try:
+            with TpuServiceClient(self.gw_sock, deadline_s=5.0) as cli:
+                cli.shutdown()
+        except Exception:
+            if self.gateway is not None:
+                self.gateway.stop()
+        if self._gw_thread is not None:
+            self._gw_thread.join(timeout=15)
+        # serve_forever's finally stops the supervisor (kills workers);
+        # belt-and-braces for an aborted startup:
+        if self.supervisor is not None:
+            self.supervisor.stop()
+
+    # ---------------------------------------------------------------- queries
+    def plan(self, threshold: float) -> str:
+        def attr(name, dt):
+            return [{"class": "org.apache.spark.sql.catalyst.expressions."
+                              "AttributeReference", "num-children": 0,
+                     "name": name, "dataType": dt, "nullable": True,
+                     "metadata": {}, "exprId": {"id": 1, "jvmId": "x"},
+                     "qualifier": []}]
+        filt = {"class": "org.apache.spark.sql.execution.FilterExec",
+                "num-children": 1,
+                "condition": [{"class": "org.apache.spark.sql.catalyst."
+                                        "expressions.GreaterThan",
+                               "num-children": 2}]
+                + attr("v", "double")
+                + [{"class": "org.apache.spark.sql.catalyst.expressions."
+                            "Literal", "num-children": 0,
+                    "value": str(threshold), "dataType": "double"}]}
+        scan = {"class": "org.apache.spark.sql.execution."
+                         "FileSourceScanExec",
+                "num-children": 0, "relation": "HadoopFsRelation(parquet)",
+                "output": [attr("k", "long"), attr("v", "double")],
+                "tableIdentifier": "t"}
+        return json.dumps([filt, scan])
+
+    def expected(self, threshold: float):
+        """Engine-free oracle: the same filter computed by pyarrow."""
+        import numpy as np
+        import pyarrow as pa
+        mask = np.asarray(self.table.column("v")) > threshold
+        return self.table.filter(pa.array(mask)).select(["k", "v"])
+
+    @staticmethod
+    def sorted_table(t):
+        return t.sort_by([("k", "ascending"), ("v", "ascending")])
+
+    def client(self, deadline_s: float = 120.0):
+        from ..service import TpuServiceClient
+        return TpuServiceClient(self.gw_sock, deadline_s=deadline_s)
+
+    def run_query(self, threshold: float, deadline_s: float = 120.0,
+                  **kw) -> Tuple[str, object]:
+        """("ok", table) | ("typed", exc) | ("UNTYPED", exc) — the third
+        is always an invariant violation."""
+        try:
+            with self.client(deadline_s) as cli:
+                t = cli.run_plan(self.plan(threshold), self.paths, **kw)
+            return "ok", t
+        except Exception as e:
+            return ("typed" if is_typed_error(e) else "UNTYPED"), e
+
+    def affinity_target(self, threshold: float) -> str:
+        from ..fleet import router
+        digest, _ = router.analyze(self.plan(threshold), self.paths,
+                                   self.gateway.conf)
+        assert digest is not None, "chaos plan must fingerprint"
+        return router.rendezvous_order(digest, self.worker_names)[0]
+
+    # ------------------------------------------------------------ inspection
+    def worker_counters(self, name: str) -> Dict[str, float]:
+        from ..service import TpuServiceClient
+        with TpuServiceClient(self.socks[name], deadline_s=30.0) as cli:
+            return _scrape_counters(cli.stats())
+
+    def worker_cache_stats(self, name: str) -> dict:
+        from ..service import TpuServiceClient
+        with TpuServiceClient(self.socks[name], deadline_s=30.0) as cli:
+            return cli.cache_stats()
+
+    def fleet_stats(self) -> dict:
+        with self.client(30.0) as cli:
+            return cli.fleet_stats()
+
+    def wait_breakers_closed(self, timeout_s: float = 60.0) -> bool:
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout_s:
+            snap = self.fleet_stats()["workers"]
+            if all(w["breaker"] == "closed" for w in snap.values()):
+                return True
+            time.sleep(0.2)
+        return False
+
+    def wait_respawned(self, name: str, old_pid: int,
+                       timeout_s: float = 120.0) -> bool:
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout_s:
+            w = self.supervisor.worker(name)
+            if w.proc is not None and w.proc.pid != old_pid \
+                    and w.proc.poll() is None:
+                try:
+                    self.await_worker(name, deadline_s=max(
+                        5.0, timeout_s - (time.monotonic() - t0)))
+                    return True
+                except Exception:
+                    return False
+            time.sleep(0.05)
+        return False
+
+    def take_baseline(self) -> None:
+        self._baseline_threads = threading.active_count()
+        self._baseline_fds = _fd_count()
+
+
+def _fd_count() -> int:
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return 0
+
+
+# --------------------------------------------------------------------------
+# invariant checker
+# --------------------------------------------------------------------------
+def check_invariants(rig: ChaosRig, results: List[Tuple[str, object]],
+                     expected=None) -> List[str]:
+    """Returns the violated invariants (empty = all held)."""
+    from ..service import TpuServiceClient
+    bad: List[str] = []
+    exp_sorted = rig.sorted_table(expected) if expected is not None else None
+    for i, (status, value) in enumerate(results):
+        if status == "ok":
+            if exp_sorted is not None and \
+                    not rig.sorted_table(value).equals(exp_sorted):
+                bad.append(f"result #{i}: rows differ from oracle")
+        elif status != "typed":
+            bad.append(f"result #{i}: UNTYPED error "
+                       f"{type(value).__name__}: {value}")
+    # breakers recover once every worker is back
+    if not rig.wait_breakers_closed():
+        snap = rig.fleet_stats()["workers"]
+        bad.append("breakers never recovered: "
+                   + str({n: w["breaker"] for n, w in snap.items()}))
+    # admission tokens still grantable on every live worker (a leaked
+    # token from a killed connection would wedge this forever)
+    for n in rig.worker_names:
+        try:
+            with TpuServiceClient(rig.socks[n], deadline_s=30.0) as cli:
+                cli.acquire(timeout=20.0)
+                cli.release()
+        except Exception as e:
+            bad.append(f"worker {n}: token round-trip failed: {e}")
+    # worker-side resource return: after dropping every cached entry the
+    # catalog holds no handles and the device budget reads ~empty
+    try:
+        with rig.client(30.0) as cli:
+            cli.cache_invalidate()
+        time.sleep(0.3)
+        for n in rig.worker_names:
+            c = rig.worker_counters(n)
+            handles = _family_total(c, "tpu_catalog_handles")
+            used = c.get('tpu_memory_budget_bytes{kind="used"}', 0.0)
+            if handles > 0:
+                bad.append(f"worker {n}: {handles:.0f} catalog handles "
+                           "leaked after invalidate")
+            if used > 0:
+                bad.append(f"worker {n}: budget used={used:.0f} bytes "
+                           "after quiesce")
+    except Exception as e:
+        bad.append(f"quiesce check failed: {e}")
+    # orchestrator-side: threads and fds back to the post-setup baseline
+    # (client sockets context-managed; poller threads joined)
+    if rig._baseline_threads:
+        for _ in range(100):
+            if threading.active_count() <= rig._baseline_threads:
+                break
+            time.sleep(0.05)
+        extra = threading.active_count() - rig._baseline_threads
+        if extra > 0:
+            names = sorted(t.name for t in threading.enumerate())
+            bad.append(f"{extra} orchestrator threads leaked: {names}")
+        fds = _fd_count()
+        if rig._baseline_fds and fds > rig._baseline_fds + 4:
+            bad.append(f"orchestrator fds grew {rig._baseline_fds} -> "
+                       f"{fds}")
+    return bad
+
+
+# --------------------------------------------------------------------------
+# campaigns
+# --------------------------------------------------------------------------
+def campaign_kill_failover_warm(workdir: str) -> dict:
+    """The acceptance-criteria drill (ISSUE 14): SIGKILL mid-query ->
+    failover bit-identical; supervisor respawn; respawned worker answers
+    the hot fingerprint from its persistent tier with zero admissions."""
+    rig = ChaosRig(os.path.join(workdir, "kill"), n_workers=2)
+    out = {"name": "kill_failover_warm"}
+    try:
+        rig.start()
+        thr = 0.47
+        target = rig.affinity_target(thr)
+        expected = rig.expected(thr)
+        # cold run lands + persists on the affinity worker
+        status, cold = rig.run_query(thr)
+        assert status == "ok", f"cold query failed: {cold}"
+        assert rig.sorted_table(cold).equals(rig.sorted_table(expected))
+        rig.take_baseline()
+
+        # freeze the winner so the next dispatch is provably in flight,
+        # then kill it mid-request
+        w = rig.supervisor.worker(target)
+        old_pid = w.proc.pid
+        w.proc.send_signal(signal.SIGSTOP)
+        res: dict = {}
+
+        def run():
+            res["r"] = rig.run_query(thr, query_id="chaos-kill-1")
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+        t0 = time.monotonic()
+        placed = None
+        while time.monotonic() - t0 < 60:
+            placed = rig.fleet_stats()["placements"].get("chaos-kill-1")
+            if placed:
+                break
+            time.sleep(0.01)
+        assert placed == target, f"placed on {placed}, want {target}"
+        time.sleep(0.3)
+        w.proc.send_signal(signal.SIGKILL)
+        th.join(timeout=240)
+        assert not th.is_alive(), "failover never completed"
+        status, table = res["r"]
+        assert status == "ok", f"failover query died: {table}"
+        assert rig.sorted_table(table).equals(rig.sorted_table(expected)), \
+            "failover rows differ"
+        out["failovers"] = \
+            rig.fleet_stats()["route_decisions"].get("failover", 0)
+        assert out["failovers"] >= 1
+
+        # supervisor respawn + breaker recovery
+        assert rig.wait_respawned(target, old_pid), "respawn never landed"
+        out["restarts"] = rig.supervisor.restart_counts()[target]
+        assert out["restarts"] >= 1
+        assert rig.wait_breakers_closed(), "breaker never re-closed"
+        snap = rig.fleet_stats()
+        out["reincarnations"] = \
+            snap["workers"][target]["reincarnations"]
+        assert out["reincarnations"] >= 1, \
+            "registry never observed the reincarnation"
+
+        # warm answer from the persistent tier with ZERO admissions
+        before = rig.worker_counters(target)
+        status, warm = rig.run_query(thr, query_id="chaos-warm-1")
+        assert status == "ok", f"warm query died: {warm}"
+        assert rig.sorted_table(warm).equals(rig.sorted_table(expected)), \
+            "warm rows differ"
+        after = rig.worker_counters(target)
+        adm = (_family_total(after, "tpu_sched_admissions_total")
+               - _family_total(before, "tpu_sched_admissions_total"))
+        out["warm_admissions_delta"] = adm
+        assert adm == 0, f"warm hit admitted {adm} times (want 0)"
+        cs = rig.worker_cache_stats(target)
+        out["persist"] = cs.get("persist", {})
+        assert out["persist"].get("hits", 0) + \
+            out["persist"].get("warmed", 0) >= 1, \
+            f"no persistent-tier warm hit: {cs}"
+
+        bad = check_invariants(rig, [res["r"]], expected)
+        assert not bad, f"invariants violated: {bad}"
+        out["ok"] = True
+        return out
+    finally:
+        rig.stop()
+
+
+def campaign_restart_under_load(workdir: str, n_queries: int = 18,
+                                kills: int = 2) -> dict:
+    """Supervisor restarts under live traffic: every query bit-identical
+    or typed, restart counts match, breakers recover."""
+    rig = ChaosRig(os.path.join(workdir, "load"), n_workers=3)
+    out = {"name": "restart_under_load"}
+    try:
+        rig.start()
+        thr = 0.61
+        expected = rig.expected(thr)
+        status, cold = rig.run_query(thr)
+        assert status == "ok", f"cold query failed: {cold}"
+        rig.take_baseline()
+        results: List[Tuple[str, object]] = []
+        res_mu = threading.Lock()
+        stop = threading.Event()
+
+        def worker_loop():
+            while not stop.is_set():
+                r = rig.run_query(thr, deadline_s=90.0)
+                with res_mu:
+                    results.append(r)
+
+        threads = [threading.Thread(target=worker_loop, daemon=True)
+                   for _ in range(3)]
+        for t in threads:
+            t.start()
+        victim = rig.affinity_target(thr)
+        killed = 0
+        # the kills are the campaign: finish them all even if the query
+        # quota fills first — the quota only bounds the tail
+        while killed < kills:
+            time.sleep(0.4)
+            w = rig.supervisor.worker(victim)
+            if w.proc is not None and w.proc.poll() is None:
+                old_pid = w.proc.pid
+                w.proc.send_signal(signal.SIGKILL)
+                killed += 1
+                rig.wait_respawned(victim, old_pid)
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 240:
+            with res_mu:
+                if len(results) >= n_queries:
+                    break
+            time.sleep(0.1)
+        stop.set()
+        for t in threads:
+            t.join(timeout=240)
+        assert not any(t.is_alive() for t in threads), "client loop hung"
+        out["queries"] = len(results)
+        out["ok_count"] = sum(1 for s, _ in results if s == "ok")
+        out["typed_count"] = sum(1 for s, _ in results if s == "typed")
+        out["restarts"] = rig.supervisor.restart_counts()[victim]
+        assert out["restarts"] >= kills
+        assert out["ok_count"] >= 1, "no query survived the storm"
+        bad = check_invariants(rig, results, expected)
+        assert not bad, f"invariants violated: {bad}"
+        out["ok"] = True
+        return out
+    finally:
+        rig.stop()
+
+
+def campaign_disk_full_persist(workdir: str) -> dict:
+    """Disk-full during persist: the durable tier degrades (counter +
+    incident) and every query still returns correct rows."""
+    rig = ChaosRig(
+        os.path.join(workdir, "diskfull"), n_workers=1,
+        worker_conf={
+            # first durable-dir op in the worker dies with EIO -> that
+            # tier latches memory-only; later ops on OTHER tiers keep
+            # working (times=1)
+            "spark.rapids.tpu.test.faults":
+                "persist:error,err=io,nth=1,times=1"})
+    out = {"name": "disk_full_persist"}
+    try:
+        rig.start()
+        rig.take_baseline()
+        thr = 0.52
+        expected = rig.expected(thr)
+        results = [rig.run_query(thr) for _ in range(3)]
+        for status, val in results:
+            assert status == "ok", f"query died under disk-full: {val}"
+        c = rig.worker_counters("w0")
+        out["degraded_total"] = _family_total(
+            c, "tpu_persist_degraded_total")
+        assert out["degraded_total"] >= 1, \
+            "no tier degraded under the injected persist fault"
+        out["incidents"] = _family_total(c, "tpu_incidents_total")
+        # the flight-recorder incident file landed in the events dir
+        incident_files = [f for f in os.listdir(rig.events_dir)
+                          if f.startswith("incident-")
+                          and "persist_degraded" in f] \
+            if os.path.isdir(rig.events_dir) else []
+        out["incident_files"] = len(incident_files)
+        assert incident_files, "no persist_degraded incident dumped"
+        bad = check_invariants(rig, results, expected)
+        assert not bad, f"invariants violated: {bad}"
+        out["ok"] = True
+        return out
+    finally:
+        rig.stop()
+
+
+def campaign_corrupt_persist(workdir: str) -> dict:
+    """Bit-flipped persisted entries: the restarted worker detects the
+    CRC mismatch (miss + delete + poisoned counter) and recomputes —
+    never serves garbage."""
+    rig = ChaosRig(os.path.join(workdir, "corrupt"), n_workers=1)
+    out = {"name": "corrupt_persist"}
+    try:
+        rig.start()
+        thr = 0.58
+        expected = rig.expected(thr)
+        status, cold = rig.run_query(thr)
+        assert status == "ok", f"cold query failed: {cold}"
+        rig.take_baseline()
+        pdir = rig.persist_dirs["w0"]
+        entries = [f for f in os.listdir(pdir) if f.endswith(".qres")]
+        assert entries, "cold query persisted nothing"
+        for f in entries:
+            p = os.path.join(pdir, f)
+            with open(p, "r+b") as fh:
+                fh.seek(os.path.getsize(p) // 2)
+                b = fh.read(1)
+                fh.seek(-1, os.SEEK_CUR)
+                fh.write(bytes([b[0] ^ 0xFF]))
+        out["corrupted"] = len(entries)
+        # crash + respawn: the new incarnation must not trust the blobs
+        w = rig.supervisor.worker("w0")
+        old_pid = w.proc.pid
+        w.proc.send_signal(signal.SIGKILL)
+        assert rig.wait_respawned("w0", old_pid), "respawn never landed"
+        assert rig.wait_breakers_closed()
+        status, warm = rig.run_query(thr)
+        assert status == "ok", f"post-corruption query died: {warm}"
+        assert rig.sorted_table(warm).equals(rig.sorted_table(expected)), \
+            "corrupted persist entry produced wrong rows"
+        cs = rig.worker_cache_stats("w0")
+        out["persist"] = cs.get("persist", {})
+        assert out["persist"].get("poisoned", 0) >= 1, \
+            f"poisoned entry not detected: {cs}"
+        # the recompute re-persisted a good entry
+        assert out["persist"].get("stores", 0) >= 1
+        bad = check_invariants(rig, [(status, warm)], expected)
+        assert not bad, f"invariants violated: {bad}"
+        out["ok"] = True
+        return out
+    finally:
+        rig.stop()
+
+
+def campaign_fault_storm(workdir: str, n_queries: int = 10) -> dict:
+    """Probabilistic fault rain across the engine's injection points:
+    every query returns bit-identical rows or a typed error."""
+    storm = ";".join([
+        "memory.alloc:error,err=oom,p=0.25,times=0",
+        "spill.write:error,err=io,p=0.2,times=0",
+        "cache.fragment:error,p=0.3,times=0",
+        "compile:error,p=0.15,times=0",
+        "tcp.recv:delay,p=0.2,times=0,delay=0.01",
+    ])
+    rig = ChaosRig(
+        os.path.join(workdir, "storm"), n_workers=2,
+        worker_conf={"spark.rapids.tpu.test.faults": storm,
+                     "spark.rapids.tpu.test.faults.seed": 1234})
+    out = {"name": "fault_storm"}
+    try:
+        rig.start()
+        thr = 0.33
+        expected = rig.expected(thr)
+        rig.take_baseline()
+        results = [rig.run_query(thr, deadline_s=120.0)
+                   for _ in range(n_queries)]
+        out["ok_count"] = sum(1 for s, _ in results if s == "ok")
+        out["typed_count"] = sum(1 for s, _ in results if s == "typed")
+        out["untyped"] = [f"{type(v).__name__}: {v}"
+                          for s, v in results if s == "UNTYPED"]
+        assert out["ok_count"] >= 1, "every query died under the storm"
+        bad = check_invariants(rig, results, expected)
+        assert not bad, f"invariants violated: {bad}"
+        out["ok"] = True
+        return out
+    finally:
+        rig.stop()
+
+
+CAMPAIGNS = {
+    "kill_failover_warm": campaign_kill_failover_warm,
+    "restart_under_load": campaign_restart_under_load,
+    "disk_full_persist": campaign_disk_full_persist,
+    "corrupt_persist": campaign_corrupt_persist,
+    "fault_storm": campaign_fault_storm,
+}
+
+
+def run_campaign(name: str, workdir: str) -> dict:
+    return CAMPAIGNS[name](workdir)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--campaign", default="all",
+                    choices=["all"] + sorted(CAMPAIGNS))
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    workdir = args.workdir or tempfile.mkdtemp(prefix="srtpu_chaos_")
+    names = sorted(CAMPAIGNS) if args.campaign == "all" \
+        else [args.campaign]
+    verdicts = []
+    failed = False
+    for name in names:
+        t0 = time.monotonic()
+        try:
+            v = run_campaign(name, workdir)
+        except BaseException as e:
+            v = {"name": name, "ok": False,
+                 "error": f"{type(e).__name__}: {e}"}
+            failed = True
+        v["wall_s"] = round(time.monotonic() - t0, 1)
+        verdicts.append(v)
+        if not args.json:
+            print(f"[chaos] {name}: "
+                  f"{'PASS' if v.get('ok') else 'FAIL'} "
+                  f"({v['wall_s']}s)"
+                  + ("" if v.get("ok") else f" -- {v.get('error')}"))
+    if args.json:
+        print(json.dumps({"campaigns": verdicts,
+                          "ok": not failed}, indent=2, default=str))
+    if not args.workdir:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

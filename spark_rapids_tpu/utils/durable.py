@@ -1,0 +1,142 @@
+"""Durable-directory degradation — the ONE policy behind every persistent
+tier.
+
+Four subsystems keep state on disk so a restarted worker comes back warm
+instead of cold: the compile cache (compile/service.py), the statistics
+history (stats/history.py), the event log (utils/spans.py), and the
+persistent result tier (rescache/persist.py). Before this module each
+invented its own answer to "the disk went away" (silent pass, warn-once,
+clear-the-dir); a chaos campaign injecting disk-full found the answers
+disagreed. Now every durable dir routes its IO through a `DurableTier`:
+
+  * an IO failure (disk full, EPERM, vanished mount, injected `persist`
+    fault) DEGRADES the tier to memory-only — the flag latches, later
+    operations no-op instantly, and the query that tripped it still
+    returns its correct result;
+  * degradation is LOUD exactly once per tier: a typed
+    `PersistenceDegradedWarning`, a `tpu_persist_degraded_total{tier=..}`
+    telemetry counter, and one rate-limited flight-recorder
+    `persist_degraded` incident — a fleet losing its warm-restart story
+    must page someone, not whisper into a except-pass;
+  * per-ENTRY damage is not tier damage: a missing file is a plain miss
+    (`missing_ok`), and a torn/poisoned blob stays the caller's
+    miss+delete business — only the infrastructure failing degrades.
+
+The `persist` fault point (faults.PERSIST) fires inside every guarded
+operation, so `persist:error,err=io` drives the whole degradation path
+from conf — scripts/chaos_matrix.sh and the fault sweep gate it.
+
+Tiers are cached per (name, path): two sessions pointing at the same dir
+share one degradation latch, while tests with per-tmpdir paths stay
+isolated. No state is created until a subsystem actually configures a
+durable dir — the off path is one dict probe at configure time, zero at
+query time."""
+
+from __future__ import annotations
+
+import threading
+import warnings
+from typing import Callable, Dict, Optional, Tuple, TypeVar
+
+from ..errors import PersistenceDegradedWarning
+
+__all__ = ["DurableTier", "tier", "states", "reset_for_tests"]
+
+T = TypeVar("T")
+
+_mu = threading.Lock()
+_tiers: Dict[Tuple[str, str], "DurableTier"] = {}
+
+
+class DurableTier:
+    """One persistent directory's health. Construct via `tier()`."""
+
+    def __init__(self, name: str, path: str):
+        self.name = name
+        self.path = path
+        self.degraded = False
+        self.reason = ""
+        self.failures = 0       # degradation triggers observed (first wins)
+        self._mu = threading.Lock()
+
+    def available(self) -> bool:
+        return bool(self.path) and not self.degraded
+
+    def run(self, what: str, fn: Callable[[], T],
+            default: Optional[T] = None,
+            missing_ok: bool = False,
+            corruptible: bool = False) -> Optional[T]:
+        """Run one durable-dir operation under the `persist` fault point.
+        Any OSError degrades the tier and returns `default` — the caller's
+        query proceeds memory-only, never fails. With `missing_ok` a
+        FileNotFoundError is a plain per-entry miss (returns `default`
+        without degrading): an absent blob is a cache miss, not a disk
+        fault. `corruptible` ops fire the fault point OVER fn's result
+        (persisted bytes a `corrupt` rule can poison — exactly one fire
+        either way, so nth schedules stay deterministic)."""
+        if not self.available():
+            return default
+        from .. import faults
+        try:
+            if corruptible:
+                return faults.fire(faults.PERSIST, fn())
+            faults.fire(faults.PERSIST)
+            return fn()
+        except FileNotFoundError:
+            if missing_ok:
+                return default
+            self.degrade(f"{what}: file vanished under the tier")
+            return default
+        except OSError as e:
+            self.degrade(f"{what}: {type(e).__name__}: {e}")
+            return default
+
+    def degrade(self, reason: str) -> None:
+        """Latch this tier to memory-only. Loud once: typed warning +
+        telemetry counter + one rate-limited flight-recorder incident."""
+        with self._mu:
+            self.failures += 1
+            if self.degraded:
+                return
+            self.degraded = True
+            self.reason = reason
+        warnings.warn(PersistenceDegradedWarning(
+            f"durable tier '{self.name}' ({self.path}) degraded to "
+            f"memory-only: {reason}"), stacklevel=3)
+        from .. import telemetry
+        telemetry.inc("tpu_persist_degraded_total", tier=self.name)
+        telemetry.flight("persist", "degraded", tier=self.name,
+                         reason=reason)
+        # attr key must not be `reason` — incident(reason, **attrs) would
+        # collide with its positional
+        telemetry.incident("persist_degraded", tier=self.name,
+                           path=self.path, cause=reason)
+
+    def snapshot(self) -> dict:
+        return {"name": self.name, "path": self.path,
+                "degraded": self.degraded, "reason": self.reason,
+                "failures": self.failures}
+
+
+def tier(name: str, path: str) -> DurableTier:
+    """The (name, path)-cached tier for one durable directory. Reusing the
+    instance across reconfigures keeps the degradation latch — a disk that
+    failed once is not trusted again just because a new session pointed at
+    it; a NEW path gets a fresh latch."""
+    key = (name, path)
+    with _mu:
+        t = _tiers.get(key)
+        if t is None:
+            t = _tiers[key] = DurableTier(name, path)
+        return t
+
+
+def states() -> Dict[str, dict]:
+    """Snapshot of every known tier, keyed `name:path` (tests, tooling)."""
+    with _mu:
+        return {f"{n}:{p}": t.snapshot() for (n, p), t in _tiers.items()}
+
+
+def reset_for_tests() -> None:
+    with _mu:
+        _tiers.clear()

@@ -202,6 +202,8 @@ class TaskMetrics:
         self.rescache_stores = 0
         self.rescache_singleflight_wait_ns = 0
         self.rescache_degraded = 0
+        # hits answered from the persistent result tier (restart warm path)
+        self.rescache_persist_hits = 0
         # query-scheduler counters (sched/): wall ns queued for admission,
         # grants, load-shed rejections, cooperative cancellations and
         # deadline expiries observed by this task, and the deepest
@@ -284,7 +286,9 @@ class TaskMetrics:
                 f"rescacheStores={self.rescache_stores} "
                 f"rescacheSingleFlightWaitMs="
                 f"{self.rescache_singleflight_wait_ns / 1e6:.1f} "
-                f"rescacheDegraded={self.rescache_degraded}")
+                f"rescacheDegraded={self.rescache_degraded}"
+                + (f" rescachePersistHits={self.rescache_persist_hits}"
+                   if self.rescache_persist_hits else ""))
         if self.sched_admissions or self.sched_rejected or \
                 self.sched_cancelled or self.sched_deadline_exceeded:
             parts.append(

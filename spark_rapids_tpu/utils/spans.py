@@ -571,12 +571,26 @@ def write_event_log(prof: QueryProfile, log_dir: str,
     concatenating logs from many executors is just `cat`. `max_bytes`
     (spark.rapids.tpu.metrics.eventLog.maxBytes) bounds the live file via
     `.1`/`.2`/... rotation; 0 keeps the historical unbounded append."""
-    os.makedirs(log_dir, exist_ok=True)
-    path = os.path.join(log_dir, f"events-{os.getpid()}.jsonl")
     payload = "".join(json.dumps(r, separators=(",", ":"),
                                  default=_json_default) + "\n"
                       for r in prof.to_records())
-    return append_jsonl(path, payload, max_bytes, max_files)
+    return _durable_append(log_dir, payload, max_bytes, max_files)
+
+
+def _durable_append(log_dir: str, payload: str, max_bytes: int,
+                    max_files: int) -> str:
+    """The event log is a durable tier (utils/durable.py): a dead disk
+    degrades logging to a no-op under the shared typed-warning/counter/
+    incident sequence instead of failing the query that tried to log."""
+    from . import durable
+    t = durable.tier("eventlog", log_dir)
+
+    def write():
+        os.makedirs(log_dir, exist_ok=True)
+        path = os.path.join(log_dir, f"events-{os.getpid()}.jsonl")
+        return append_jsonl(path, payload, max_bytes, max_files)
+
+    return t.run("append", write, default="")
 
 
 def client_op_record(op: str, trace_id: str, dur_ns: int, status: str = "ok",
@@ -607,11 +621,9 @@ def write_client_record(log_dir: str, record: Dict[str, Any],
                         max_bytes: int = 0, max_files: int = 10) -> str:
     """Append one record to this process's event log (the client-side half
     of trace correlation; same file naming/rotation as write_event_log)."""
-    os.makedirs(log_dir, exist_ok=True)
-    path = os.path.join(log_dir, f"events-{os.getpid()}.jsonl")
     payload = json.dumps(record, separators=(",", ":"),
                          default=_json_default) + "\n"
-    return append_jsonl(path, payload, max_bytes, max_files)
+    return _durable_append(log_dir, payload, max_bytes, max_files)
 
 
 def _json_default(o):

@@ -82,6 +82,12 @@ def pytest_configure(config):
         "progress+ETA from stats history / slow-query watchdog / "
         "queries surfaces / gateway fan-out / tpu_top console; "
         "scripts/liveview_matrix.sh runs these standalone)")
+    config.addinivalue_line(
+        "markers",
+        "chaos: crash-recovery suite (durable-tier degradation / fleet "
+        "supervisor / chaos campaigns over real gateway + supervised "
+        "worker processes; scripts/chaos_matrix.sh runs these "
+        "standalone — campaign tests are also `slow`)")
 
 
 @pytest.fixture

@@ -1,0 +1,259 @@
+"""Chaos campaign suite (ISSUE-14): crash -> restart -> warm-again as a
+tested, invariant-checked path.
+
+Two tiers:
+
+  * FAST (no subprocesses): durable-tier degradation units
+    (utils/durable.py — the ONE policy behind every persistent dir) and
+    fleet-supervisor lifecycle units against trivial sleep processes.
+  * SLOW (markers `chaos` + `slow`, run by scripts/chaos_matrix.sh): the
+    scripted campaigns from tools/chaos_campaign.py against a REAL
+    gateway + supervised worker OS processes — SIGKILL mid-query with
+    bit-identical failover and a zero-admission persistent-tier warm hit
+    after respawn, restarts under load, disk-full tier degradation,
+    corrupted persistent entries, and a probabilistic fault storm; every
+    campaign ends in the shared invariant checker (typed-or-identical
+    results, token round-trips, breaker recovery, thread/fd/catalog
+    baselines)."""
+
+import os
+import sys
+import time
+import warnings
+
+import pytest
+
+from spark_rapids_tpu import faults
+from spark_rapids_tpu.errors import PersistenceDegradedWarning
+from spark_rapids_tpu.faults import FaultInjector
+from spark_rapids_tpu.tools import chaos_campaign as cc
+from spark_rapids_tpu.utils import durable
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    FaultInjector.reset()
+    durable.reset_for_tests()
+    yield
+    FaultInjector.reset()
+    durable.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# FAST: durable-tier units — the shared degradation policy
+# ---------------------------------------------------------------------------
+class TestDurableTier:
+    def test_happy_path_runs_and_returns(self, tmp_path):
+        t = durable.tier("x", str(tmp_path))
+        assert t.run("op", lambda: 41) == 41
+        assert t.available() and not t.degraded
+
+    def test_oserror_degrades_once_loudly_then_noops(self, tmp_path):
+        t = durable.tier("y", str(tmp_path))
+        calls = []
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            assert t.run("op", lambda: (_ for _ in ()).throw(
+                OSError("disk full")), default="dflt") == "dflt"
+            # latched: later ops no-op without re-warning
+            assert t.run("op", lambda: calls.append(1)) is None
+        assert not calls, "a degraded tier must stop doing IO"
+        assert t.degraded and "disk full" in t.reason
+        degraded_warns = [w for w in caught if isinstance(
+            w.message, PersistenceDegradedWarning)]
+        assert len(degraded_warns) == 1, "loud exactly once"
+        assert durable.states()[f"y:{tmp_path}"]["degraded"]
+
+    def test_missing_file_is_a_miss_not_tier_damage(self, tmp_path):
+        t = durable.tier("z", str(tmp_path))
+
+        def read():
+            raise FileNotFoundError("no entry")
+
+        assert t.run("load", read, missing_ok=True) is None
+        assert not t.degraded
+        # without missing_ok a vanished file IS tier damage
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            t.run("load", read)
+        assert t.degraded
+
+    def test_persist_fault_point_drives_degradation(self, tmp_path):
+        t = durable.tier("f", str(tmp_path))
+        with faults.inject(faults.PERSIST, "error", nth=1,
+                           error=IOError) as rule:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                assert t.run("op", lambda: 1) is None
+        assert rule.fired == 1
+        assert t.degraded
+
+    def test_default_injected_fault_degrades_not_escapes(self, tmp_path):
+        """A conf-driven `persist:error` rule with NO err= qualifier
+        raises the default InjectedFault — which deliberately subclasses
+        IOError precisely so IO-seam handlers (this tier included) catch
+        it. Pin that: an InjectedFault here must degrade, never escape
+        to fail the query."""
+        t = durable.tier("fd", str(tmp_path))
+        with faults.inject(faults.PERSIST, "error", nth=1) as rule:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                assert t.run("op", lambda: 1, default="d") == "d"
+        assert rule.fired == 1
+        assert t.degraded and "InjectedFault" in t.reason
+
+    def test_corruptible_fires_over_payload(self, tmp_path):
+        t = durable.tier("c", str(tmp_path))
+        with faults.inject(faults.PERSIST, "corrupt", nth=1) as rule:
+            out = t.run("load", lambda: bytes(64), corruptible=True)
+        assert rule.fired == 1
+        assert out != bytes(64) and len(out) == 64
+        assert not t.degraded  # corruption is entry damage, not tier
+
+    def test_tier_cache_is_per_name_and_path(self, tmp_path):
+        a = durable.tier("t", str(tmp_path / "a"))
+        b = durable.tier("t", str(tmp_path / "b"))
+        assert a is not b
+        assert durable.tier("t", str(tmp_path / "a")) is a
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            a.degrade("test")
+        assert not b.degraded
+
+
+# ---------------------------------------------------------------------------
+# FAST: supervisor lifecycle against trivial sleep processes
+# ---------------------------------------------------------------------------
+def _sleep_spec(name):
+    from spark_rapids_tpu.fleet.supervisor import WorkerSpec
+    return WorkerSpec(name, f"/tmp/{name}.nosock",
+                      [sys.executable, "-c", "import time; time.sleep(600)"])
+
+
+def _supervisor(specs, **conf):
+    from spark_rapids_tpu.fleet.supervisor import WorkerSupervisor
+    base = {"spark.rapids.tpu.fleet.supervisor.maxRestarts": 2,
+            "spark.rapids.tpu.fleet.supervisor.backoffMs": 40,
+            "spark.rapids.tpu.fleet.supervisor.backoffMaxMs": 500,
+            "spark.rapids.tpu.fleet.supervisor.checkIntervalMs": 25}
+    base.update({f"spark.rapids.tpu.fleet.supervisor.{k}": v
+                 for k, v in conf.items()})
+    return WorkerSupervisor(specs, base)
+
+
+class TestSupervisorUnits:
+    def _wait(self, cond, timeout=15.0):
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout:
+            if cond():
+                return True
+            time.sleep(0.02)
+        return False
+
+    def test_crash_respawns_with_new_pid(self):
+        from spark_rapids_tpu.fleet.supervisor import STATE_RUNNING
+        sup = _supervisor([_sleep_spec("sw0")]).start()
+        try:
+            w = sup.worker("sw0")
+            pid0 = w.proc.pid
+            w.proc.kill()
+            assert self._wait(lambda: w.state == STATE_RUNNING
+                              and w.proc.pid != pid0)
+            assert w.restarts == 1
+            assert sup.restart_counts() == {"sw0": 1}
+        finally:
+            sup.stop()
+
+    def test_restart_cap_marks_failed_and_stops(self):
+        from spark_rapids_tpu.fleet.supervisor import (STATE_FAILED,
+                                                       STATE_RUNNING)
+        sup = _supervisor([_sleep_spec("sw1")], maxRestarts=1).start()
+        try:
+            w = sup.worker("sw1")
+            w.proc.kill()
+            assert self._wait(lambda: w.state == STATE_RUNNING
+                              and w.restarts == 1)
+            w.proc.kill()
+            assert self._wait(lambda: w.state == STATE_FAILED)
+            time.sleep(0.2)
+            assert w.restarts == 1, "FAILED worker must not respawn"
+        finally:
+            sup.stop()
+
+    def test_backoff_spacing_grows(self):
+        from spark_rapids_tpu.fleet.supervisor import STATE_RUNNING
+        sup = _supervisor([_sleep_spec("sw2")], maxRestarts=5,
+                          backoffMs=120).start()
+        try:
+            w = sup.worker("sw2")
+            gaps = []
+            for _ in range(2):
+                pid = w.proc.pid
+                t0 = time.monotonic()
+                w.proc.kill()
+                assert self._wait(lambda: w.state == STATE_RUNNING
+                                  and w.proc.pid != pid)
+                gaps.append(time.monotonic() - t0)
+            # second respawn waits ~2x the base backoff
+            assert gaps[1] > gaps[0] * 1.2, gaps
+        finally:
+            sup.stop()
+
+    def test_stop_kills_workers_and_joins_monitor(self):
+        import threading
+        sup = _supervisor([_sleep_spec("sw3"), _sleep_spec("sw4")]).start()
+        procs = [sup.worker(n).proc for n in ("sw3", "sw4")]
+        sup.stop()
+        assert all(p.poll() is not None for p in procs)
+        assert not any(t.name == "fleet-supervisor"
+                       for t in threading.enumerate())
+
+
+# ---------------------------------------------------------------------------
+# SLOW: the real-process campaigns (scripts/chaos_matrix.sh)
+# ---------------------------------------------------------------------------
+slow = pytest.mark.slow
+
+
+@slow
+class TestChaosCampaigns:
+    def test_kill_failover_and_persistent_warm(self, tmp_path):
+        """The acceptance-criteria drill: SIGKILL mid-dashboard-query ->
+        bit-identical failover; supervisor respawn; the respawned worker
+        answers the previously-hot fingerprint from its persistent tier
+        with sched_admissions == 0."""
+        v = cc.campaign_kill_failover_warm(str(tmp_path))
+        assert v["ok"]
+        assert v["failovers"] >= 1
+        assert v["restarts"] >= 1
+        assert v["reincarnations"] >= 1
+        assert v["warm_admissions_delta"] == 0
+        assert v["persist"]["hits"] + v["persist"]["warmed"] >= 1
+
+    def test_supervisor_restart_under_load(self, tmp_path):
+        v = cc.campaign_restart_under_load(str(tmp_path))
+        assert v["ok"]
+        assert v["restarts"] >= 2
+        assert v["ok_count"] >= 1
+        assert v["ok_count"] + v["typed_count"] == v["queries"]
+
+    def test_disk_full_degrades_tier_queries_stay_correct(self, tmp_path):
+        v = cc.campaign_disk_full_persist(str(tmp_path))
+        assert v["ok"]
+        assert v["degraded_total"] >= 1
+        assert v["incident_files"] >= 1
+
+    def test_corrupt_persist_entries_recompute_not_garbage(self, tmp_path):
+        v = cc.campaign_corrupt_persist(str(tmp_path))
+        assert v["ok"]
+        assert v["corrupted"] >= 1
+        assert v["persist"]["poisoned"] >= 1
+        assert v["persist"]["stores"] >= 1  # good entry re-persisted
+
+    def test_fault_storm_typed_or_identical(self, tmp_path):
+        v = cc.campaign_fault_storm(str(tmp_path))
+        assert v["ok"]
+        assert not v["untyped"]
+        assert v["ok_count"] >= 1

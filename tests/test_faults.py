@@ -738,3 +738,62 @@ class TestAdmissionFaults:
                 with pytest.raises(DeviceStartupError):
                     cli.acquire(timeout=10.0)
         assert time.monotonic() - t0 < 3.0
+
+
+# ---------------------------------------------------------------------------
+# persist point: durable-dir faults degrade tiers to memory-only (PR 14)
+# ---------------------------------------------------------------------------
+
+
+class TestPersistFaults:
+    def test_stats_history_append_fault_degrades_not_raises(self, tmp_path):
+        import os
+        import warnings
+        from spark_rapids_tpu.errors import PersistenceDegradedWarning
+        from spark_rapids_tpu.stats.history import OpStats, StatsHistory
+        from spark_rapids_tpu.utils import durable
+        durable.reset_for_tests()
+        try:
+            h = StatsHistory(max_entries=16, persist_dir=str(tmp_path))
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                with inject(faults.PERSIST, "error", nth=1, times=1,
+                            error=IOError) as rule:
+                    h.record(OpStats(digest="d1", op="Scan", rows=10.0),
+                             persistable=True)
+            assert rule.fired == 1
+            assert any(isinstance(w.message, PersistenceDegradedWarning)
+                       for w in caught)
+            # memory tier unharmed; later appends no-op instead of raising
+            assert h.lookup("d1").rows == 10.0
+            h.record(OpStats(digest="d2", op="Scan", rows=5.0),
+                     persistable=True)
+            assert h.lookup("d2").rows == 5.0
+            assert not os.listdir(str(tmp_path))
+        finally:
+            durable.reset_for_tests()
+
+    def test_event_log_append_fault_degrades_silently(self, tmp_path):
+        import os
+        import warnings
+        from spark_rapids_tpu.errors import PersistenceDegradedWarning
+        from spark_rapids_tpu.utils import durable, spans
+        durable.reset_for_tests()
+        try:
+            rec = spans.client_op_record("run_plan", "t" * 32, 1000)
+            log_dir = str(tmp_path / "events")
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                with inject(faults.PERSIST, "error", nth=1, times=1,
+                            error=IOError) as rule:
+                    spans.write_client_record(log_dir, rec)  # degrades
+                spans.write_client_record(log_dir, rec)      # no-ops
+            assert rule.fired == 1
+            assert any(isinstance(w.message, PersistenceDegradedWarning)
+                       for w in caught)
+            assert not os.path.isdir(log_dir) or not os.listdir(log_dir)
+        finally:
+            durable.reset_for_tests()
+
+    def test_persist_point_registered(self):
+        assert faults.PERSIST in faults.ALL_POINTS

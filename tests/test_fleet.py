@@ -275,6 +275,9 @@ class _FakeWorker(threading.Thread):
     """Thread server speaking the service wire protocol. mode:
     'ok'    — answers run_plan with a one-row Arrow body;
     'close' — reads the run_plan then drops the connection (crash);
+    'stall_close' — reads the run_plan, signals `stalled`, parks until
+              `release_event` (or 20s), then drops the connection — a
+              worker that dies with a request provably in flight;
     'shed'  — replies the typed rejected error."""
 
     def __init__(self, sock_path, mode="ok"):
@@ -288,6 +291,9 @@ class _FakeWorker(threading.Thread):
         self.srv.listen(16)
         self.srv.settimeout(0.2)
         self._stop = threading.Event()
+        self.stalled = threading.Event()
+        self.release_event = threading.Event()
+        self.fake_pid = None  # ping reply pid (reincarnation tests)
         self._table = pa.table({"x": pa.array([1])})
 
     def run(self):
@@ -306,10 +312,18 @@ class _FakeWorker(threading.Thread):
                 header, _ = recv_msg(conn)
                 op = header.get("op")
                 if op == "ping":
-                    send_msg(conn, {"ok": True, "device": "fake"})
+                    rep = {"ok": True, "device": "fake"}
+                    if self.fake_pid is not None:
+                        rep["pid"] = self.fake_pid
+                    send_msg(conn, rep)
                 elif op == "run_plan":
                     self.run_plans += 1
                     if self.mode == "close":
+                        conn.close()
+                        return
+                    if self.mode == "stall_close":
+                        self.stalled.set()
+                        self.release_event.wait(20)
                         conn.close()
                         return
                     if self.mode == "shed":
@@ -822,3 +836,313 @@ class TestFleetLifecycle:
         assert "client:run_plan" in view
         assert "server query" in view
         assert "decision=" in view and "worker=" in view
+
+
+# ---------------------------------------------------------------------------
+# PR 14 satellites: drain + crash combinations, gateway-observed death
+# releasing worker-side admission tokens, reincarnation reconciliation
+# ---------------------------------------------------------------------------
+
+
+def _affinity_order(plan):
+    """Deterministic dispatch order over two fake workers: empty-path
+    fake plans fail-closed to LOAD routing, and with both fakes idle the
+    power-of-two pair sorts by (outstanding, name) — f0 is provably
+    dispatched first (the same determinism TestGatewayFakeWorkers'
+    failover tests already lean on)."""
+    return ["f0", "f1"]
+
+
+class TestDrainCrashCombos:
+    def test_draining_worker_dies_midflight_read_fails_over(self,
+                                                            tmp_path):
+        """Drain lands while a READ is in flight on the worker, then the
+        worker dies: the query must fail over (typed machinery, correct
+        rows), and the drained corpse must receive nothing new."""
+        plan = filter_plan(0.5)
+        order = _affinity_order(plan)
+        modes = {order[0]: "stall_close", order[1]: "ok"}
+        gw_sock, gw, fakes, th = _fake_fleet(
+            tmp_path, [modes["f0"], modes["f1"]])
+        dying = fakes[int(order[0][1])]
+        healthy = fakes[int(order[1][1])]
+        try:
+            out = {}
+
+            def run():
+                try:
+                    with TpuServiceClient(gw_sock, deadline_s=30.0) as c:
+                        out["table"] = c.run_plan(plan, {})
+                except Exception as e:
+                    out["error"] = e
+
+            t = threading.Thread(target=run, daemon=True)
+            t.start()
+            assert dying.stalled.wait(10), "request never reached worker"
+            gw.registry.drain(order[0])         # drain WHILE in flight
+            dying.release_event.set()           # ... then it dies
+            t.join(timeout=60)
+            assert not t.is_alive()
+            assert "error" not in out, out.get("error")
+            assert out["table"].num_rows == 1   # failed over, rows intact
+            assert healthy.run_plans == 1
+            stats = gw._fleet_stats()
+            assert stats["route_decisions"].get("failover", 0) >= 1
+            # drained corpse gets zero NEW placements
+            with TpuServiceClient(gw_sock, deadline_s=30.0) as c:
+                c.run_plan(plan, {})
+            assert dying.run_plans == 1
+        finally:
+            _teardown_fleet(gw_sock, gw, fakes, th)
+
+    def test_draining_worker_dies_midflight_write_typed_no_retry(
+            self, tmp_path):
+        """Same crash, but a WRITE plan: the typed connection error must
+        surface with the no-retry contract intact — the surviving worker
+        never sees the write."""
+        plan = filter_plan(0.5, marker="InsertInto")
+        order = _affinity_order(plan)
+        modes = {order[0]: "stall_close", order[1]: "ok"}
+        gw_sock, gw, fakes, th = _fake_fleet(
+            tmp_path, [modes["f0"], modes["f1"]])
+        dying = fakes[int(order[0][1])]
+        healthy = fakes[int(order[1][1])]
+        try:
+            out = {}
+
+            def run():
+                try:
+                    with TpuServiceClient(gw_sock, deadline_s=30.0) as c:
+                        out["table"] = c.run_plan(plan, {})
+                except Exception as e:
+                    out["error"] = e
+
+            t = threading.Thread(target=run, daemon=True)
+            t.start()
+            assert dying.stalled.wait(10), "request never reached worker"
+            gw.registry.drain(order[0])
+            dying.release_event.set()
+            t.join(timeout=60)
+            assert not t.is_alive()
+            assert isinstance(out.get("error"), ServiceConnectionError), \
+                out
+            assert "not auto-retried" in str(out["error"])
+            assert healthy.run_plans == 0, \
+                "write plan moved to another worker after dispatch"
+        finally:
+            _teardown_fleet(gw_sock, gw, fakes, th)
+
+    def test_undrain_dead_worker_not_routable_until_probe_succeeds(
+            self, tmp_path):
+        """undrain must not resurrect a dead worker: its breaker stays
+        authoritative until a half-open PROBE actually succeeds against
+        the restarted process."""
+        plan = filter_plan(0.5)
+        order = _affinity_order(plan)
+        gw_sock, gw, fakes, th = _fake_fleet(
+            tmp_path, ["ok", "ok"],
+            conf={"spark.rapids.tpu.fleet.breaker.failures": 1,
+                  "spark.rapids.tpu.fleet.breaker.cooldownMs": 1500,
+                  "spark.rapids.tpu.fleet.probe.intervalMs": 100})
+        target = order[0]
+        tfake = fakes[int(target[1])]
+        try:
+            tfake.close()           # the affinity worker dies
+            time.sleep(0.3)         # accept loop exits, socket dead
+            with TpuServiceClient(gw_sock, deadline_s=30.0) as c:
+                t = c.run_plan(plan, {})     # fails over
+            assert t.num_rows == 1
+            w = gw._fleet_stats()["workers"][target]
+            assert w["breaker"] == BREAKER_OPEN
+            with TpuServiceClient(gw_sock, deadline_s=30.0) as c:
+                c.drain(target)
+                rep = c.undrain(target)
+            assert rep["draining"] is False
+            # undrained but DEAD: not routable inside the cooldown ...
+            assert target not in [x.name for x in gw.registry.routable()]
+            # ... and over the next seconds (cooldown expiries included)
+            # every query keeps landing on the survivor while the
+            # half-open probe trials keep failing against the corpse
+            dispatched_before = \
+                gw._fleet_stats()["workers"][target]["dispatches"]
+            t0 = time.time()
+            while time.time() - t0 < 3.0:
+                with TpuServiceClient(gw_sock, deadline_s=30.0) as c:
+                    assert c.run_plan(plan, {}).num_rows == 1
+                snap = gw._fleet_stats()["workers"][target]
+                assert not snap["healthy"]
+                time.sleep(0.2)
+            # a failed half-open TRIAL dispatch is allowed; a SUCCESSFUL
+            # placement on the corpse is not — nothing incremented
+            # run_plans on the dead fake (its socket is gone)
+            assert gw._fleet_stats()["workers"][target]["dispatches"] \
+                - dispatched_before <= 3
+            # restart the worker at the same address: the half-open
+            # probe re-admits it without operator action
+            os.unlink(tfake.sock_path)
+            revived = _FakeWorker(tfake.sock_path, "ok")
+            revived.start()
+            fakes.append(revived)
+            t0 = time.time()
+            while time.time() - t0 < 15:
+                w = gw._fleet_stats()["workers"][target]
+                if w["breaker"] == BREAKER_CLOSED and w["healthy"]:
+                    break
+                time.sleep(0.1)
+            w = gw._fleet_stats()["workers"][target]
+            assert w["breaker"] == BREAKER_CLOSED and w["healthy"]
+            assert target in [x.name for x in gw.registry.routable()]
+        finally:
+            _teardown_fleet(gw_sock, gw, fakes, th)
+
+
+class TestReincarnationReconciliation:
+    def test_pid_change_purges_placements_and_counts(self, tmp_path):
+        """A worker answering probes with a NEW pid is a new process:
+        the registry must count the reincarnation and purge placements
+        for queries that died with the old incarnation (cancel then
+        truthfully answers found:false)."""
+        sock = str(tmp_path / "w.sock")
+        fw = _FakeWorker(sock, "ok")
+        fw.fake_pid = 1111
+        fw.start()
+        reg = WorkerRegistry([("w0", sock)], probe_interval_s=3600,
+                             probe_timeout_s=2.0)
+        try:
+            reg._probe_worker(reg.workers["w0"])
+            assert reg.workers["w0"].pid == 1111
+            reg.note_dispatch("w0", "q-old")
+            assert reg.placement_of("q-old") is not None
+            fw.fake_pid = 2222          # the process "restarted"
+            reg._probe_worker(reg.workers["w0"])
+            w = reg.workers["w0"]
+            assert w.pid == 2222
+            assert w.reincarnations == 1
+            assert reg.placement_of("q-old") is None, \
+                "placement survived the worker's death"
+            snap = reg.snapshot()["workers"]["w0"]
+            assert snap["reincarnations"] == 1
+        finally:
+            fw.close()
+
+
+@pytest.mark.slow
+class TestGatewayObservedDeathTokenRelease:
+    def test_wedged_worker_token_released_after_gateway_drops_pin(
+            self, tmp_path, fleet_data):
+        """A client holds an admission token through the gateway (pinned
+        connection). The WORKER wedges; the GATEWAY observes the death
+        (dispatch timeout) and drops the pin. When the worker resumes,
+        the worker-side disconnect-releases-token path must fire off the
+        gateway's closed upstream socket — the token may not leak."""
+        sock = str(tmp_path / "w.sock")
+        log_dir = str(tmp_path / "events")
+        proc = _start_worker(sock, log_dir)
+        _await_worker(sock, proc)
+        gw_sock = str(tmp_path / "gw.sock")
+        gw = FleetGateway(
+            [("w0", sock)],
+            {"spark.rapids.tpu.fleet.probe.intervalMs": 60_000,
+             "spark.rapids.tpu.fleet.dispatch.timeoutSec": 2.0},
+            gw_sock)
+        th = threading.Thread(target=gw.serve_forever, daemon=True)
+        th.start()
+        TpuServiceClient(gw_sock, deadline_s=30.0).connect().close()
+        cliA = None
+        try:
+            cliA = TpuServiceClient(gw_sock, deadline_s=30.0).connect()
+            assert cliA.acquire(timeout=30.0) >= 1  # token held, pinned
+            proc.send_signal(signal.SIGSTOP)        # worker wedges
+            with pytest.raises(ServiceConnectionError):
+                # gateway times out at dispatch.timeoutSec, closes the
+                # pinned upstream, surfaces the typed connection error
+                cliA.run_plan(filter_plan(0.41), fleet_data["paths"])
+            proc.send_signal(signal.SIGCONT)        # worker resumes
+            # the resumed worker finds the gateway's socket closed and
+            # releases the dead connection's token; with
+            # concurrentGpuTasks=1 this acquire only succeeds if it did
+            with TpuServiceClient(sock, deadline_s=90.0) as cliB:
+                assert cliB.acquire(timeout=60.0) >= 1
+                cliB.release()
+        finally:
+            if cliA is not None:
+                cliA.close()
+            try:
+                with TpuServiceClient(gw_sock, deadline_s=5.0) as c:
+                    c.shutdown()
+            except Exception:
+                gw.stop()
+            th.join(timeout=10)
+            try:
+                proc.send_signal(signal.SIGCONT)
+            except OSError:
+                pass
+            try:
+                with TpuServiceClient(sock, deadline_s=5.0) as c:
+                    c.shutdown()
+            except Exception:
+                proc.kill()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+
+
+@pytest.mark.slow
+class TestDrainCrashLifecycle:
+    def test_draining_real_worker_killed_midflight_read_fails_over(
+            self, fleet, fleet_data):
+        """Real-process version of the drain+crash combo: drain lands
+        while the query is in flight, SIGKILL the worker, and the read
+        fails over with bit-identical rows."""
+        thr = 0.83
+        plan = filter_plan(thr)
+        qid = "drain-die-1"
+        digest, _ = router.analyze(plan, fleet_data["paths"],
+                                   fleet["gw"].conf)
+        target = router.rendezvous_order(digest,
+                                         list(fleet["socks"]))[0]
+        fleet["procs"][target].send_signal(signal.SIGSTOP)
+        out = {}
+
+        def run():
+            try:
+                out["table"] = TpuServiceClient(
+                    fleet["gw_sock"], deadline_s=240.0
+                ).connect().run_plan(plan, fleet_data["paths"],
+                                     query_id=qid)
+            except Exception as e:
+                out["error"] = e
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+        t0 = time.time()
+        while time.time() - t0 < 60:
+            if fleet["gw"]._fleet_stats()["placements"].get(qid):
+                break
+            time.sleep(0.01)
+        assert fleet["gw"]._fleet_stats()["placements"].get(qid) == target
+        with TpuServiceClient(fleet["gw_sock"], deadline_s=30.0) as cli:
+            cli.drain(target)               # drain the worker mid-flight
+        fleet["procs"][target].send_signal(signal.SIGKILL)
+        fleet["procs"][target].wait(timeout=10)
+        th.join(timeout=240)
+        assert not th.is_alive(), "failover never completed"
+        assert "error" not in out, out.get("error")
+        exp = _expected(fleet_data["table"], thr).select(["k", "v"])
+        assert _sorted(out["table"]).equals(_sorted(exp))
+        # restore the fixture: restart the worker, undrain, re-admit
+        fleet["procs"][target] = _await_worker(
+            fleet["socks"][target],
+            _start_worker(fleet["socks"][target], fleet["log_dir"]))
+        with TpuServiceClient(fleet["gw_sock"], deadline_s=30.0) as cli:
+            cli.undrain(target)
+        t0 = time.time()
+        while time.time() - t0 < 30:
+            w = fleet["gw"]._fleet_stats()["workers"][target]
+            if w["breaker"] == BREAKER_CLOSED and w["healthy"]:
+                break
+            time.sleep(0.1)
+        assert fleet["gw"]._fleet_stats()["workers"][target]["breaker"] \
+            == BREAKER_CLOSED

@@ -42,6 +42,8 @@ def _clean_cache():
     rescache.shutdown()
     telemetry.shutdown()
     TpuSemaphore._instance = None
+    from spark_rapids_tpu.utils import durable
+    durable.reset_for_tests()
 
 
 def _session(**conf):
@@ -718,3 +720,161 @@ class TestExprAudit:
         r2 = sess.from_arrow(t).select(Round(d, 2).alias("r")).collect()
         assert r0.column("r").to_pylist() == [1.0, 3.0, 3.0]
         assert r2.column("r").to_pylist() == [1.23, 2.72, 3.14]
+
+
+# ---------------------------------------------------------------------------
+# persistent whole-query result tier (PR 14: crash -> restart -> warm)
+# ---------------------------------------------------------------------------
+class TestPersistTier:
+    def _write_data(self, tmp_path, seed=3):
+        path = str(tmp_path / "t.parquet")
+        pq.write_table(_table(2000, seed=seed), path)
+        return path
+
+    def _conf(self, tmp_path, **extra):
+        base = {"spark.rapids.tpu.rescache.persist.dir":
+                str(tmp_path / "persist"),
+                "spark.rapids.tpu.rescache.persist.warmup.enabled": False}
+        base.update(extra)
+        return base
+
+    def _query(self, sess, path):
+        return sess.read_parquet(path).group_by("g").agg(s=Sum(col("v")))
+
+    def _restart(self, tmp_path, **extra):
+        """Simulate process restart: drop every in-memory cache object,
+        re-configure from a fresh session (the persisted files are what
+        survives)."""
+        rescache.shutdown()
+        return _session(**self._conf(tmp_path, **extra))
+
+    def test_cold_store_restart_warm_zero_admissions(self, tmp_path):
+        path = self._write_data(tmp_path)
+        sess = _session(**self._conf(tmp_path))
+        cold = self._query(sess, path).collect()
+        p = rescache.persist_tier()
+        assert p is not None and p.stats_dict()["stores"] == 1
+        assert len(os.listdir(str(tmp_path / "persist"))) == 1
+
+        sess2 = self._restart(tmp_path)
+        TaskMetrics.reset()
+        warm = self._query(sess2, path).collect()
+        assert warm.equals(cold)
+        tm = TaskMetrics.get()
+        assert tm.rescache_persist_hits == 1
+        assert tm.sched_admissions == 0, \
+            "persistent-tier hit must not touch the device doors"
+        assert rescache.persist_tier().stats_dict()["hits"] == 1
+        # now resident in memory: the next hit is a plain memory hit
+        warm2 = self._query(sess2, path).collect()
+        assert warm2.equals(cold)
+        assert rescache.persist_tier().stats_dict()["hits"] == 1
+
+    def test_background_warmup_preloads_memory(self, tmp_path):
+        path = self._write_data(tmp_path)
+        sess = _session(**self._conf(tmp_path))
+        self._query(sess, path).collect()
+        rescache.shutdown()
+        _session(**self._conf(
+            tmp_path,
+            **{"spark.rapids.tpu.rescache.persist.warmup.enabled": True})
+        ).initialize_device()
+        t0 = time.time()
+        while time.time() - t0 < 20:
+            if rescache.persist_tier().stats_dict()["warmed"] >= 1:
+                break
+            time.sleep(0.02)
+        assert rescache.persist_tier().stats_dict()["warmed"] == 1
+        assert rescache.get().entry_count == 1
+
+    def test_corrupt_entry_is_miss_delete_then_repersist(self, tmp_path):
+        path = self._write_data(tmp_path)
+        sess = _session(**self._conf(tmp_path))
+        cold = self._query(sess, path).collect()
+        pdir = str(tmp_path / "persist")
+        [entry] = os.listdir(pdir)
+        fp = os.path.join(pdir, entry)
+        with open(fp, "r+b") as f:
+            f.seek(os.path.getsize(fp) // 2)
+            b = f.read(1)
+            f.seek(-1, os.SEEK_CUR)
+            f.write(bytes([b[0] ^ 0xFF]))
+
+        sess2 = self._restart(tmp_path)
+        warm = self._query(sess2, path).collect()
+        assert warm.equals(cold), "poisoned entry must never serve bytes"
+        stats = rescache.persist_tier().stats_dict()
+        assert stats["poisoned"] == 1
+        assert stats["hits"] == 0
+        # the recompute re-persisted a good entry over the deleted one
+        assert stats["stores"] == 1
+        assert len(os.listdir(pdir)) == 1
+
+    def test_validator_fingerprints_never_persist(self, tmp_path):
+        sess = _session(**self._conf(tmp_path))
+        t = _table(500)
+        sess.from_arrow(t).group_by("g").agg(s=Sum(col("v"))).collect()
+        # in-memory table identity = weakref validator = process-local:
+        # nothing may reach disk
+        assert os.listdir(str(tmp_path / "persist")) == []
+
+    def test_invalidate_wipes_disk_too(self, tmp_path):
+        path = self._write_data(tmp_path)
+        sess = _session(**self._conf(tmp_path))
+        self._query(sess, path).collect()
+        assert len(os.listdir(str(tmp_path / "persist"))) == 1
+        rescache.invalidate()
+        # the invalidate hammer exists for in-place rewrites file
+        # identity can't see — a restart must not resurrect them
+        assert os.listdir(str(tmp_path / "persist")) == []
+
+    def test_io_failure_degrades_to_memory_only(self, tmp_path):
+        import warnings as _w
+        from spark_rapids_tpu.errors import PersistenceDegradedWarning
+        path = self._write_data(tmp_path)
+        sess = _session(**self._conf(tmp_path))
+        with _w.catch_warnings(record=True) as caught:
+            _w.simplefilter("always")
+            with faults.inject(faults.PERSIST, "error", nth=1, times=1,
+                               error=IOError) as rule:
+                cold = self._query(sess, path).collect()
+        assert rule.fired == 1
+        assert cold.num_rows > 0
+        assert any(isinstance(w.message, PersistenceDegradedWarning)
+                   for w in caught)
+        p = rescache.persist_tier()
+        assert p.stats_dict()["degraded"] and not p.available()
+        # memory tier still serves; the degraded tier stays silent
+        warm = self._query(sess, path).collect()
+        assert warm.equals(cold)
+        # nth=1 hit the tier's very first op (mkdir): the dir may not
+        # even exist — either way, nothing reached disk
+        pdir = str(tmp_path / "persist")
+        assert not os.path.isdir(pdir) or os.listdir(pdir) == []
+
+    def test_rewritten_source_misses_naturally(self, tmp_path):
+        path = self._write_data(tmp_path, seed=3)
+        sess = _session(**self._conf(tmp_path))
+        old = self._query(sess, path).collect()
+        # rewrite the source with DIFFERENT data: mtime/size/content all
+        # change, and they live INSIDE the fingerprint
+        pq.write_table(_table(2100, seed=9), path)
+        sess2 = self._restart(tmp_path)
+        new = self._query(sess2, path).collect()
+        assert not new.equals(old), "stale persisted result served"
+        assert rescache.persist_tier().stats_dict()["hits"] == 0
+
+    def test_gc_bounds_directory_bytes(self, tmp_path):
+        from spark_rapids_tpu.rescache.persist import PersistentResultTier
+        tier = PersistentResultTier(str(tmp_path / "p"), max_bytes=1)
+        # every stored entry exceeds 1 byte: nothing may persist
+        assert not tier.store("d" * 64, _table(100), "query", 10)
+        tier2 = PersistentResultTier(str(tmp_path / "p2"),
+                                     max_bytes=1 << 20)
+        for i in range(6):
+            assert tier2.store(f"{i:064x}", _table(3000, seed=i),
+                               "query", 10)
+            time.sleep(0.02)  # distinct mtimes for the GC ordering
+        total = sum(os.path.getsize(os.path.join(str(tmp_path / "p2"), f))
+                    for f in os.listdir(str(tmp_path / "p2")))
+        assert total <= 1 << 20
