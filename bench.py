@@ -960,6 +960,142 @@ def rescache_bench() -> dict:
     return out
 
 
+MULTICHIP_NDEV = 8
+MULTICHIP_ROWS = 400_000
+MULTICHIP_DIM = 4_096
+
+
+def multichip_bench() -> dict:
+    """Sharded mesh execution end-to-end (ISSUE-15 flag: `bench.py
+    --multichip`): the SAME scan->filter->exchange->join->agg query over
+    one parquet fact file runs three ways on the same data —
+
+      * single : one device, no exchanges (the BASELINE engine path);
+      * host   : explicit 8-way hash repartition of both join inputs
+                 through the MULTITHREADED shuffle manager (the host TCP
+                 data plane's serialized bytes);
+      * mesh   : `spark.rapids.tpu.mesh.*` sharded execution — scans
+                 sharded across the 8 chips, exchanges as ICI
+                 collectives, partitions device-resident between stages.
+
+    Reports per-stage wall (scan / scan+filter / full pipeline, warm of
+    two runs), bytes moved over ICI vs the host shuffle, collective and
+    shard counts, and the bit-identical gate across all three legs.
+    Acceptance: identical results, MESH_EXCHANGES > 0 on the mesh leg,
+    ZERO host-shuffle bytes on the mesh leg. Feeds the next TPU run
+    alongside MULTICHIP_rNN."""
+    _apply_platform_override()
+    import tempfile
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.exec import exchange as EX
+    from spark_rapids_tpu.expr import Count, Max, Min, Sum, col
+    from spark_rapids_tpu.plugin import TpuSession
+    from spark_rapids_tpu.utils.metrics import TaskMetrics
+
+    import jax
+    ndev = MULTICHIP_NDEV
+    if len(jax.devices()) < ndev:
+        return {"metric": "multichip_bench", "ndev": ndev,
+                "error": f"only {len(jax.devices())} devices present "
+                         "(hint: SPARK_RAPIDS_TPU_BENCH_PLATFORM=cpu "
+                         "forces the 8-virtual-device mesh)"}
+
+    rng = np.random.default_rng(15)
+    n = MULTICHIP_ROWS
+    fact = pa.table({
+        "id": pa.array(rng.integers(0, 50_000, n), type=pa.int64()),
+        "val": pa.array(rng.uniform(-1, 1, n), type=pa.float64()),
+        "small": pa.array(rng.integers(-100, 100, n).astype(np.int32)),
+    })
+    dim_keys = rng.permutation(50_000)[:MULTICHIP_DIM]
+    dim = pa.table({
+        "id": pa.array(dim_keys, type=pa.int64()),
+        "tag": pa.array([f"t{int(k) % 31}" for k in dim_keys]),
+    })
+    tmp = tempfile.mkdtemp(prefix="srtpu_multichip_bench_")
+    fact_path = os.path.join(tmp, "fact.parquet")
+    dim_path = os.path.join(tmp, "dim.parquet")
+    pq.write_table(fact, fact_path, row_group_size=n // 16)
+    pq.write_table(dim, dim_path)
+
+    base_conf = {
+        "spark.rapids.sql.enabled": True,
+        "spark.rapids.sql.explain": "NONE",
+        "spark.rapids.sql.autoBroadcastJoinThreshold": -1,
+    }
+    mesh_conf = dict(base_conf)
+    mesh_conf.update({
+        "spark.rapids.shuffle.mode": "ICI",
+        "spark.rapids.tpu.mesh.shape": f"shuffle={ndev}",
+        "spark.rapids.tpu.mesh.enabled": True,
+    })
+
+    def queries(sess, repartition):
+        scan = sess.read_parquet(fact_path)
+        filt = scan.filter(col("val") > -0.5)
+        left, right = filt, sess.read_parquet(dim_path)
+        if repartition:
+            left = left.repartition(ndev, "id")
+            right = right.repartition(ndev, "id")
+        full = (left.join(right, on="id", how="inner")
+                .group_by("tag").agg(n=Count(col("val")),
+                                     s=Sum(col("small")),
+                                     mx=Max(col("id")),
+                                     mn=Min(col("small"))))
+        return {"scan": scan, "scan_filter": filt, "full": full}
+
+    def run_leg(conf, repartition=False):
+        sess = TpuSession(dict(conf))
+        qs = queries(sess, repartition)
+        stages = {}
+        for name, q in qs.items():
+            walls = []
+            for _ in range(2):  # second run is compile-warm
+                t0 = time.perf_counter()
+                result = q.collect()
+                walls.append(time.perf_counter() - t0)
+            stages[name + "_s"] = round(min(walls), 4)
+            stages[name + "_cold_s"] = round(walls[0], 4)
+        TaskMetrics.reset()
+        before = EX.MESH_EXCHANGES
+        result = qs["full"].collect().sort_by("tag")
+        tm = TaskMetrics.get()
+        return result, stages, {
+            "mesh_exchanges": EX.MESH_EXCHANGES - before,
+            "mesh_shards": tm.mesh_shards,
+            "ici_bytes": tm.mesh_ici_bytes,
+            "host_shuffle_bytes": tm.shuffle_bytes_written,
+        }
+
+    r_single, st_single, m_single = run_leg(base_conf)
+    r_host, st_host, m_host = run_leg(base_conf, repartition=True)
+    r_mesh, st_mesh, m_mesh = run_leg(mesh_conf)
+
+    identical = r_single.equals(r_host) and r_single.equals(r_mesh)
+    out = {
+        "metric": "multichip_bench",
+        "ndev": ndev,
+        "rows": n,
+        "single": st_single,
+        "host_shuffle": {**st_host,
+                         "shuffle_bytes": m_host["host_shuffle_bytes"]},
+        "mesh": {**st_mesh, **m_mesh},
+        "bytes_over_ici": m_mesh["ici_bytes"],
+        "bytes_over_host_shuffle": m_host["host_shuffle_bytes"],
+        "speedup_mesh_vs_single_x": round(
+            st_single["full_s"] / st_mesh["full_s"], 3)
+        if st_mesh["full_s"] else None,
+        "bit_identical": bool(identical),
+        "ok": bool(identical and m_mesh["mesh_exchanges"] > 0
+                   and m_mesh["host_shuffle_bytes"] == 0
+                   and m_mesh["mesh_shards"] >= ndev),
+    }
+    return out
+
+
 STATS_ROWS = 300_000
 
 
@@ -1376,6 +1512,23 @@ if __name__ == "__main__":
         # and coalesce-count plan flips; one JSON line
         _enable_compilation_cache()
         print(json.dumps(stats_bench()), flush=True)
+    elif "--multichip" in sys.argv:
+        # bench flag (ISSUE-15): sharded mesh execution — single-device
+        # vs host-shuffle vs ICI-collective legs on the same data, with
+        # per-stage wall, bytes over ICI vs host shuffle, and the
+        # bit-identical gate; one JSON line
+        if os.environ.get("SPARK_RAPIDS_TPU_BENCH_PLATFORM") == "cpu":
+            # must land before jax initializes a backend
+            import re as _re
+            _f = os.environ.get("XLA_FLAGS", "")
+            _f = _re.sub(r"--xla_force_host_platform_device_count=\d+",
+                         "", _f)
+            os.environ["XLA_FLAGS"] = (
+                _f + f" --xla_force_host_platform_device_count="
+                     f"{MULTICHIP_NDEV}").strip()
+            os.environ["JAX_PLATFORMS"] = "cpu"
+        _enable_compilation_cache()
+        print(json.dumps(multichip_bench()), flush=True)
     elif "--rescache" in sys.argv:
         # bench flag (ISSUE-9): repeated-query workload through the
         # result cache — hit rate, warm-vs-cold speedup, bit-identical

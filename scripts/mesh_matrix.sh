@@ -1,0 +1,136 @@
+#!/usr/bin/env bash
+# Sharded-execution matrix (ISSUE-15 CI gate):
+#   1. run the mesh test suite (marker `mesh`) on the forced-8-device
+#      virtual CPU mesh;
+#   2. mesh-OFF gate: with spark.rapids.tpu.mesh.enabled=false the engine
+#      takes the exact pre-mesh paths — ZERO mesh modules imported on the
+#      engine path, plans byte-identical to a no-mesh session, results
+#      byte-identical, ZERO new threads;
+#   3. forced-8-device golden sweep: the flagship scan->filter->exchange->
+#      join->agg query runs mesh-on vs mesh-off on the same data —
+#      bit-identical results, MESH_EXCHANGES > 0, zero host-shuffle bytes
+#      on the mesh leg (the acceptance drill), plus the legacy ICI suite
+#      (test_distributed_engine) for the dryrun-era path.
+#
+# Usage: scripts/mesh_matrix.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TIMEOUT="${SRTPU_MESH_TIMEOUT:-900}"
+
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_mesh.py -m mesh -q \
+    -p no:cacheprovider "$@"
+
+echo "== mesh-off gate (zero mesh imports, identical plans/results, zero threads) =="
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" python - <<'EOF'
+import sys
+import threading
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.plan.overrides import Overrides
+from spark_rapids_tpu.plugin import TpuSession
+
+rng = np.random.default_rng(31)
+n = 20_000
+fact = pa.table({"id": pa.array(rng.integers(0, 200, n)),
+                 "val": pa.array(rng.uniform(-1, 1, n)),
+                 "small": pa.array(rng.integers(-50, 50, n).astype(np.int32))})
+dimk = rng.permutation(200)[:80]
+dim = pa.table({"id": pa.array(dimk),
+                "tag": pa.array([f"t{k % 5}" for k in dimk])})
+
+
+def build(extra):
+    sess = TpuSession({"spark.rapids.sql.enabled": True,
+                       "spark.rapids.sql.explain": "NONE", **extra})
+    from spark_rapids_tpu.expr import Count, Sum, col
+    q = (sess.from_arrow(fact).filter(col("val") > 0)
+         .join(sess.from_arrow(dim), on="id", how="inner")
+         .group_by("tag").agg(n=Count(col("val")), s=Sum(col("small"))))
+    return sess, q
+
+
+threads0 = threading.active_count()
+sess_plain, q_plain = build({})
+sess_off, q_off = build({"spark.rapids.tpu.mesh.shape": "shuffle=8",
+                         "spark.rapids.tpu.mesh.enabled": False})
+t_plain = Overrides(sess_plain.conf).apply(q_plain.plan).tree_string()
+t_off = Overrides(sess_off.conf).apply(q_off.plan).tree_string()
+assert t_plain == t_off, "FAIL: mesh-off plan differs from no-mesh plan"
+r_plain = q_plain.collect().sort_by("tag")
+r_off = q_off.collect().sort_by("tag")
+assert r_off.equals(r_plain), "FAIL: mesh-off results differ"
+mesh_mods = [m for m in sys.modules if m.startswith("spark_rapids_tpu.mesh")]
+assert not mesh_mods, f"FAIL: mesh modules imported on the off path: {mesh_mods}"
+assert threading.active_count() <= threads0, \
+    f"FAIL: mesh-off spawned {threading.active_count() - threads0} threads"
+print("mesh-off: zero mesh imports, identical plans/results, zero threads OK")
+EOF
+
+echo "== forced-8-device golden sweep (mesh-on bit-identical, collectives executed) =="
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" python - <<'EOF'
+import os
+import tempfile
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from spark_rapids_tpu.exec import exchange as EX
+from spark_rapids_tpu.expr import Count, Max, Min, Sum, col
+from spark_rapids_tpu.plugin import TpuSession
+from spark_rapids_tpu.utils.metrics import TaskMetrics
+
+rng = np.random.default_rng(33)
+n = 60_000
+fact = pa.table({"id": pa.array(rng.integers(0, 2000, n), type=pa.int64()),
+                 "val": pa.array(rng.uniform(-1, 1, n)),
+                 "small": pa.array(rng.integers(-50, 50, n).astype(np.int32))})
+dimk = rng.permutation(2000)[:600]
+dim = pa.table({"id": pa.array(dimk, type=pa.int64()),
+                "tag": pa.array([f"t{int(k) % 13}" for k in dimk])})
+tmp = tempfile.mkdtemp(prefix="srtpu_mesh_matrix_")
+path = os.path.join(tmp, "fact.parquet")
+pq.write_table(fact, path, row_group_size=4096)
+
+
+def run(mesh_on):
+    conf = {"spark.rapids.sql.enabled": True,
+            "spark.rapids.sql.explain": "NONE",
+            "spark.rapids.sql.autoBroadcastJoinThreshold": -1}
+    if mesh_on:
+        conf.update({"spark.rapids.shuffle.mode": "ICI",
+                     "spark.rapids.tpu.mesh.shape": "shuffle=8",
+                     "spark.rapids.tpu.mesh.enabled": True})
+    sess = TpuSession(conf)
+    q = (sess.read_parquet(path).filter(col("val") > -0.5)
+         .join(sess.from_arrow(dim), on="id", how="inner")
+         .group_by("tag").agg(n=Count(col("val")), s=Sum(col("small")),
+                              mx=Max(col("id")), mn=Min(col("small"))))
+    TaskMetrics.reset()
+    out = q.collect().sort_by("tag")
+    return out, TaskMetrics.get()
+
+
+before = EX.MESH_EXCHANGES
+r_off, _ = run(False)
+r_on, tm = run(True)
+assert r_on.equals(r_off), "FAIL: mesh run not bit-identical"
+assert EX.MESH_EXCHANGES > before, "FAIL: no mesh collective executed"
+assert tm.mesh_exchanges > 0 and tm.mesh_shards >= 8
+assert tm.shuffle_bytes_written == 0, \
+    "FAIL: mesh run moved bytes over the host shuffle"
+print(f"golden sweep: bit-identical, {tm.mesh_exchanges} collectives, "
+      f"{tm.mesh_shards} shards, {tm.mesh_ici_bytes} ICI bytes, "
+      "0 host-shuffle bytes OK")
+EOF
+
+echo "== legacy ICI suite (dryrun-era path unchanged) =="
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_distributed_engine.py -q \
+    -p no:cacheprovider
+
+echo "mesh_matrix: ALL GATES PASSED"

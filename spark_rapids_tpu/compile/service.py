@@ -95,10 +95,27 @@ def _register_export_serialization() -> None:
 
 
 def _leaf_sig(x) -> tuple:
-    """(shape, dtype) signature of one dynamic-argument leaf. Python
-    scalars trace weakly typed, so only their TYPE keys the program."""
+    """(shape, dtype, placement) signature of one dynamic-argument leaf.
+    Python scalars trace weakly typed, so only their TYPE keys the
+    program. Placement joins the key because an AOT executable is
+    compiled FOR its input shardings: mesh shard batches (mesh/shard.py)
+    are committed each to their own chip, and an executable compiled for
+    chip 0 rejects chip 3's inputs — without the placement component
+    every per-shard call would evict/fall back instead of getting its own
+    cached program. Uncommitted leaves (the entire single-device engine)
+    contribute an empty component, so their keys are placement-free."""
     if hasattr(x, "shape") and hasattr(x, "dtype"):
-        return (tuple(x.shape), str(x.dtype))
+        dev = ""
+        if getattr(x, "committed", False):
+            try:
+                ds = x.devices()
+                if len(ds) == 1:
+                    dev = f"d{next(iter(ds)).id}"
+                else:
+                    dev = str(x.sharding)
+            except Exception:
+                dev = ""
+        return (tuple(x.shape), str(x.dtype), dev)
     return ("py", type(x).__name__)
 
 

@@ -442,6 +442,39 @@ register("spark.rapids.tpu.mesh.shape", "string", "",
          "Logical device mesh as 'name=N,name=M' (empty = single device).",
          startup_only=True)
 
+# Sharded execution over the ICI mesh (spark_rapids_tpu/mesh/) ------------------------
+register("spark.rapids.tpu.mesh.enabled", "bool", False,
+         "Sharded execution subsystem (mesh/): with an active "
+         "spark.rapids.tpu.mesh.shape and spark.rapids.shuffle.mode=ICI, a "
+         "plan pass partitions file/in-memory scans across mesh positions "
+         "(row-group/file/row ranges per chip, riding the existing io/ "
+         "decoders per shard), resizes safe hash-exchange boundaries to the "
+         "mesh, and keeps post-exchange partitions resident on their own "
+         "device between pipeline stages (zero-copy per-chip shard handoff "
+         "instead of a host-side concat between exchange and join/agg). Off "
+         "(default): one conf read per plan, zero mesh modules imported, "
+         "byte-identical plans and results.")
+register("spark.rapids.tpu.mesh.resizeExchanges", "bool", True,
+         "With mesh execution enabled, rewrite plan-level HASH exchange "
+         "boundaries whose partition count differs from the mesh size to "
+         "mesh-sized exchanges so they ride the ICI collective (partition "
+         "count of an internal hash exchange is an engine knob, like AQE "
+         "coalescing). Round-robin/range/single specs are never resized — "
+         "a mismatched count degrades that exchange to the host data plane "
+         "(never a wrong split).")
+register("spark.rapids.tpu.mesh.scan.parallel", "bool", False,
+         "Decode mesh scan shards on concurrent worker threads (one per "
+         "shard). Workers adopt the query's ONE admission hold (the single "
+         "mesh-wide door) — they never take per-chip tokens of their own — "
+         "and park finished shards as budget-visible, chip-tagged "
+         "spillables until the consumer drains them in mesh order.")
+register("spark.rapids.tpu.mesh.hbmPerChip", "bytes", 0,
+         "Per-chip HBM sub-budget for mesh-resident shard buffers (0 "
+         "disables per-chip accounting). Chip-tagged parked buffers charge "
+         "their OWN chip's ledger; overflowing one chip spills only that "
+         "chip's buffers — a shard spilling on chip 3 never charges or "
+         "evicts chip 0.")
+
 # Pipelined execution ----------------------------------------------------------------
 register("spark.rapids.tpu.pipeline.enabled", "bool", True,
          "Pipelined execution: bounded-depth background prefetch of "
@@ -817,6 +850,17 @@ class TpuConf:
             # memo so the next row_bucket sees the new value
             from .columnar import padding
             padding.invalidate_cache()
+        elif key.startswith("spark.rapids.tpu.mesh."):
+            # the conf->Mesh memo in parallel/mesh.py must not serve a
+            # stale mesh after a mid-session conf change (same conf-
+            # generation discipline as the padding memo above). Guarded
+            # via sys.modules: if the module was never imported there is
+            # no cache to invalidate — and importing jax from a bare
+            # conf.set would be absurd
+            import sys
+            m = sys.modules.get("spark_rapids_tpu.parallel.mesh")
+            if m is not None:
+                m.invalidate_cache()
         return self
 
     def get_bool(self, key: str, default: bool = True) -> bool:

@@ -119,11 +119,44 @@ def _concat_kernel(batches: List[ColumnarBatch], out_cap: int) -> ColumnarBatch:
     return vecs_to_batch(schema, out_vecs, total)
 
 
+def colocate_batches(batches: List[ColumnarBatch]) -> List[ColumnarBatch]:
+    """Device-align batches before a multi-batch kernel: jit refuses
+    arguments committed to different devices ('incompatible devices'),
+    and mesh shard batches (mesh/shard.py) each live on their OWN chip.
+    Cross-shard combiners therefore transfer to one anchor device
+    explicitly — the single, visible point where per-chip residency ends.
+    Uniformly-placed inputs (the entire non-mesh engine) return untouched
+    after one cheap device probe per batch."""
+    keys = []
+    for b in batches:
+        try:
+            keys.append(frozenset(b.columns[0].data.devices())
+                        if b.columns else None)
+        except Exception:
+            keys.append(None)
+    base = next((k for k in keys if k is not None), None)
+    if base is None or all(k is None or k == base for k in keys):
+        return batches
+    target = None
+    for k in keys:
+        if k is not None and len(k) == 1:
+            target = next(iter(k))
+            break
+    if target is None:
+        return batches  # differing multi-device layouts; leave to jax
+    import jax
+    tset = frozenset((target,))
+    return [b if keys[i] is None or keys[i] == tset
+            else jax.device_put(b, target)
+            for i, b in enumerate(batches)]
+
+
 def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
     """Concatenate device batches (host decides the output bucket)."""
     batches = list(batches)
     if len(batches) == 1:
         return batches[0]
+    batches = colocate_batches(batches)
     total = sum(b.row_count() for b in batches)
     out_cap = row_bucket(total, op="coalesce")
     return _concat_kernel(batches, out_cap)

@@ -82,6 +82,13 @@ class TpuShuffleExchangeExec(UnaryTpuExec):
     once per (schema, capacity); all partitions reuse the same compaction
     program with the partition id as a traced scalar."""
 
+    # Set True by the sharded plan pass (mesh/plan.py) when the consumer
+    # is shard-wise (zipped join / per-shard final aggregate): exchanged
+    # partitions are handed downstream as zero-copy per-chip views
+    # (addressable_shards) instead of gathered replicated slices. CLASS
+    # attribute: mesh-off exchanges carry zero extra state.
+    mesh_resident_out = False
+
     def __init__(self, spec, child, conf=None):
         super().__init__([child], conf)
         self.spec = spec
@@ -118,6 +125,18 @@ class TpuShuffleExchangeExec(UnaryTpuExec):
                     return
                 yield from self._exchange_via_mesh(batches, mesh)
                 return
+            if mesh is not None and self.spec.num_partitions > 1 and \
+                    self.conf.get("spark.rapids.tpu.mesh.enabled"):
+                # shard-count vs partition-count mismatch the plan pass
+                # could not (or was told not to) resize: degrade cleanly
+                # to the host data plane below — never a wrong split.
+                # Single-partition exchanges (collect/sort sinks) are by
+                # design never mesh material and must not read as
+                # degrades on the alert counter.
+                from ..utils.metrics import TaskMetrics
+                TaskMetrics.get().mesh_degraded += 1
+                from .. import telemetry
+                telemetry.inc("tpu_mesh_degraded_total")
         if not batches:
             return
         batch = concat_batches(batches)
@@ -251,79 +270,151 @@ class TpuShuffleExchangeExec(UnaryTpuExec):
         from ..parallel.mesh import SHUFFLE_AXIS
 
         ndev = mesh.size
-        batch = concat_batches(batches)
-        total = int(batch.row_count())
-        cap = row_bucket(max((total + ndev - 1) // ndev, 1))
-        g = batch.repadded(ndev * cap)
-        part = make_partitioner(self.spec, self.child.output, batch)
-        with self.partition_time.timed():
-            pid = part.ids_for_batch(jnp, g)
-
-        leaves = []
-        has_lengths = []
-        for c in g.columns:
-            leaves.append(c.data)
-            leaves.append(c.validity)
-            has_lengths.append(c.lengths is not None)
-            if c.lengths is not None:
-                leaves.append(c.lengths)
-        sh = NamedSharding(mesh, P(SHUFFLE_AXIS))
-        leaves = [jax.device_put(l, sh) for l in leaves]
-        pid = jax.device_put(pid.astype(jnp.int32), sh)
-
-        # long-string overflow columns: the head/lengths move with the row
-        # plane above; the row-UNALIGNED tail blobs move through a second
-        # BYTE-plane all_to_all (tail bytes of each device's row segment,
-        # in row order, with a per-byte destination id) — same collective,
-        # different unit
-        ovf_ix = [ci for ci, c in enumerate(g.columns)
-                  if c.overflow is not None]
+        schema = self.child.output
+        mesh_on = self.conf.get("spark.rapids.tpu.mesh.enabled")
         ovf_results = {}
-        if ovf_ix:
-            pid_np = np.asarray(pid)
-            for ci in ovf_ix:
-                ovf_results[ci] = self._exchange_tail_bytes(
-                    mesh, ndev, cap, g.columns[ci], pid_np, sh)
+        aligned = None
+        if mesh_on:
+            # zero-copy input assembly: a child that already yielded one
+            # per-device shard per mesh position (sharded scan, zipped
+            # join, per-shard aggregate) skips the device-0 concat bounce
+            # entirely — each shard pads on ITS chip and the global array
+            # is stitched from the resident pieces (Theseus' keep-data-
+            # on-device discipline applied to the exchange input seam)
+            from ..plan.nodes import HashPartitionSpec
+            if isinstance(self.spec, HashPartitionSpec):
+                from ..mesh.shard import (aligned_device_shards,
+                                          assemble_exchange_input)
+                aligned = aligned_device_shards(batches, mesh)
+        if aligned is not None:
+            part = make_partitioner(self.spec, schema, None)
+            with self.partition_time.timed():
+                asm = assemble_exchange_input(aligned, mesh, part)
+            if asm is None:
+                aligned = None
+            else:
+                leaves, pid, has_lengths, cap = asm
+                schema = aligned[0].schema
+        if aligned is None:
+            batch = concat_batches(batches)
+            schema = batch.schema
+            total = int(batch.row_count())
+            cap = row_bucket(max((total + ndev - 1) // ndev, 1))
+            g = batch.repadded(ndev * cap)
+            part = make_partitioner(self.spec, self.child.output, batch)
+            with self.partition_time.timed():
+                pid = part.ids_for_batch(jnp, g)
+
+            leaves = []
+            has_lengths = []
+            for c in g.columns:
+                leaves.append(c.data)
+                leaves.append(c.validity)
+                has_lengths.append(c.lengths is not None)
+                if c.lengths is not None:
+                    leaves.append(c.lengths)
+            sh = NamedSharding(mesh, P(SHUFFLE_AXIS))
+            leaves = [jax.device_put(l, sh) for l in leaves]
+            pid = jax.device_put(pid.astype(jnp.int32), sh)
+
+            # long-string overflow columns: the head/lengths move with the
+            # row plane above; the row-UNALIGNED tail blobs move through a
+            # second BYTE-plane all_to_all (tail bytes of each device's row
+            # segment, in row order, with a per-byte destination id) —
+            # same collective, different unit
+            ovf_ix = [ci for ci, c in enumerate(g.columns)
+                      if c.overflow is not None]
+            if ovf_ix:
+                pid_np = np.asarray(pid)
+                for ci in ovf_ix:
+                    ovf_results[ci] = self._exchange_tail_bytes(
+                        mesh, ndev, cap, g.columns[ci], pid_np, sh)
+
+            ovf_heads = {ci: g.columns[ci].data.shape[1]
+                         for ci in ovf_results}
+        else:
+            ovf_heads = {}
 
         conf_slot = self.conf.get("spark.rapids.shuffle.ici.slotRows")
         slot_cap = min(conf_slot, cap) if conf_slot > 0 else cap
-        while True:
-            fn = build_exchange_fn(mesh, ndev, slot_cap=slot_cap)
-            with self.partition_time.timed():
-                out_leaves, counts, overflowed = fn(leaves, pid)
-            if not bool(overflowed):
-                break
-            # a skewed partition overflowed the bounded slot: grow and rerun
-            # (slot_cap == cap can never overflow, so this terminates)
-            global SLOT_OVERFLOW_RETRIES
-            SLOT_OVERFLOW_RETRIES += 1
-            slot_cap = min(slot_cap * 2, cap)
+        from ..utils import spans
+        with spans.span("exchange:ici", kind=spans.KIND_SHUFFLE,
+                        devices=ndev, aligned_input=int(aligned is not None)):
+            while True:
+                fn = build_exchange_fn(mesh, ndev, slot_cap=slot_cap)
+                with self.partition_time.timed():
+                    out_leaves, counts, overflowed = fn(leaves, pid)
+                if not bool(overflowed):
+                    break
+                # a skewed partition overflowed the bounded slot: grow and
+                # rerun (slot_cap == cap can never overflow, so this
+                # terminates)
+                global SLOT_OVERFLOW_RETRIES
+                SLOT_OVERFLOW_RETRIES += 1
+                slot_cap = min(slot_cap * 2, cap)
         global MESH_EXCHANGES
         MESH_EXCHANGES += 1
+        # surfacing (satellite of the sharded-execution issue): the bare
+        # process-wide global above stays as the historical test hook, but
+        # the collective also lands in TaskMetrics (explain_string line),
+        # telemetry counters, and the exchange's own metrics
+        ici_bytes = sum(int(l.size) * l.dtype.itemsize for l in out_leaves)
+        from ..utils.metrics import TaskMetrics
+        tm = TaskMetrics.get()
+        tm.mesh_exchanges += 1
+        tm.mesh_ici_bytes += ici_bytes
+        self.num_partitions.set(ndev)
+        from .. import telemetry
+        telemetry.inc("tpu_mesh_exchanges_total")
+        telemetry.inc("tpu_mesh_ici_bytes_total", ici_bytes)
 
         counts = np.asarray(counts)
         out_cap = ndev * slot_cap
+        # device-resident output: partitions hand downstream as zero-copy
+        # views of the collective's own per-chip shards — the shard-wise
+        # consumer (zipped join / per-shard final agg) computes on the
+        # chip the rows already live on. Without the mark (or when a
+        # shard is not addressable here) the historical gather-to-
+        # replicated slice keeps every consumer working unchanged.
+        resident = mesh_on and bool(self.mesh_resident_out)
+        if resident:
+            from ..mesh.shard import shard_view
+            if shard_view(out_leaves[0], ndev - 1, out_cap) is None:
+                resident = False
+        devs = list(mesh.devices.flat)
         for p in range(ndev):
             lo = p * out_cap
+
+            if resident:
+                def grab(leaf, _p=p):
+                    from ..mesh.shard import shard_view
+                    return shard_view(leaf, _p, out_cap)
+            else:
+                def grab(leaf, _lo=lo):
+                    return leaf[_lo:_lo + out_cap]
             cols = []
             i = 0
-            for ci, c in enumerate(g.columns):
-                data = out_leaves[i][lo:lo + out_cap]
+            for ci, dtype in enumerate(schema.types):
+                data = grab(out_leaves[i])
                 i += 1
-                validity = out_leaves[i][lo:lo + out_cap]
+                validity = grab(out_leaves[i])
                 i += 1
                 lengths = None
                 if has_lengths[ci]:
-                    lengths = out_leaves[i][lo:lo + out_cap]
+                    lengths = grab(out_leaves[i])
                     i += 1
                 overflow = None
                 if ci in ovf_results:
                     overflow = self._partition_overflow(
                         ovf_results[ci], p, lengths,
-                        c.data.shape[1], int(counts[p]), out_cap)
-                cols.append(Column(c.dtype, data, validity, lengths,
+                        ovf_heads[ci], int(counts[p]), out_cap)
+                    if resident:
+                        # the rebuilt tail plane is host-assembled; pin it
+                        # to the shard's chip so the batch stays one-device
+                        overflow = jax.device_put(overflow, devs[p])
+                cols.append(Column(dtype, data, validity, lengths,
                                    overflow=overflow))
-            out = ColumnarBatch(batch.schema, tuple(cols),
+            out = ColumnarBatch(schema, tuple(cols),
                                 jnp.asarray(counts[p], jnp.int32))
             self.num_output_rows.add(int(counts[p]))
             yield self._count_output(out)

@@ -408,6 +408,12 @@ class TpuShuffledHashJoinExec(TpuExec):
         """One probe batch vs the built table. Returns (out_batch, bmatched)
         where bmatched is the device build-row matched mask (None unless
         right/full) — callers accumulate it across the probe stream."""
+        # mesh shard batches are committed each to their own chip; a spill/
+        # unspill cycle (or a broadcast build) can leave the two sides on
+        # different devices, which jit rejects — align explicitly (no-op
+        # probe for uniformly-placed inputs)
+        from .coalesce import colocate_batches
+        build, probe = colocate_batches([build, probe])
         with self.join_time.timed():
             counts, lo, order, pvalid, bvalid = _probe_counts(
                 probe, build, self._lk_ix, self._rk_ix)

@@ -168,6 +168,12 @@ class TpuFileScanExec(_TpuExec):
     # rescache/fleet fingerprint and every pushdown program key.
     pushed = None
 
+    # Mesh shard restriction ({path: frozenset(row_group)}), set only on
+    # the per-shard clones mesh/shard.MeshShardedScanExec builds: each
+    # mesh position decodes its own row-group range of the file. CLASS
+    # attribute: ordinary scans carry zero extra state.
+    shard_rgs = None
+
     def __init__(self, plan: CpuFileScanExec, conf: TpuConf):
         super().__init__([], conf)
         self.cpu_scan = plan
@@ -350,6 +356,17 @@ class TpuFileScanExec(_TpuExec):
                 yield from self._text_device_batches(
                     device_decode_json_file)
                 return
+        if self.shard_rgs is not None and \
+                self.cpu_scan.format_name == "parquet":
+            # mesh shard clone forced off the device path (deviceDecode
+            # conf flipped since planning): the row-group restriction
+            # must still hold on host
+            for path in self._effective_paths():
+                for b, nrows in self._host_rg_batches(
+                        path, self.shard_rgs.get(path)):
+                    self.num_output_rows.add(nrows)
+                    yield self._count_output(b)
+            return
         for t in self.cpu_scan.host_tables(self._effective_paths()):
             b = batch_from_arrow(t)
             if self.pushed is not None:
@@ -387,6 +404,34 @@ class TpuFileScanExec(_TpuExec):
             for b, nrows in gen:
                 self.num_output_rows.add(nrows)
                 yield self._count_output(b)
+
+    def _host_rg_batches(self, path: str, allowed):
+        """Host (pyarrow) decode of ONE parquet file restricted to a mesh
+        shard's row groups — the host-path twin of the `shard_rgs` filter
+        in `_parquet_batches`. Every host fallback a shard clone can take
+        must honor the restriction: a clone decoding its WHOLE file would
+        duplicate rows across shards (a wrong split, not a slow one).
+        `allowed=None` means the shard owns the whole file."""
+        import pyarrow.parquet as pq
+        from ..columnar.batch import batch_from_arrow
+        scan = self.cpu_scan
+        pf = pq.ParquetFile(path)
+        try:
+            for rg in range(pf.metadata.num_row_groups):
+                if allowed is not None and rg not in allowed:
+                    continue
+                t = scan._postprocess(pf.read_row_group(
+                    rg, columns=list(scan.output.names)))
+                b = batch_from_arrow(t)
+                if self.pushed is not None:
+                    b, n = self._apply_pushdown(b, t.num_rows)
+                else:
+                    n = t.num_rows
+                yield b, n
+        finally:
+            close = getattr(pf, "close", None)
+            if close is not None:
+                close()
 
     def _host_file_batches(self, path: str):
         """Host decode of ONE file through FileBatchIterator so batchSizeRows
@@ -503,6 +548,16 @@ class TpuFileScanExec(_TpuExec):
                 if host_cols:
                     self.cols_host_decoded.add(len(host_cols))
         if not supported:
+            if self.shard_rgs is not None:
+                # mesh shard clone whose file lost device decodability
+                # since planning: the row-group restriction must still
+                # hold on host or every shard re-reads the whole file
+                for path in paths:
+                    for b, nrows in self._host_rg_batches(
+                            path, self.shard_rgs.get(path)):
+                        self.num_output_rows.add(nrows)
+                        yield self._count_output(b)
+                return
             # nothing is device-decodable: the plain host path keeps the
             # COALESCING / MULTITHREADED multi-file strategies
             for t in scan.host_tables(paths):
@@ -517,7 +572,12 @@ class TpuFileScanExec(_TpuExec):
         from .dynamic_pruning import row_group_filter
         for path in paths:
             if path not in supported:
-                for b, nrows in self._host_file_batches(path):
+                if self.shard_rgs is not None:
+                    it = self._host_rg_batches(path,
+                                               self.shard_rgs.get(path))
+                else:
+                    it = self._host_file_batches(path)
+                for b, nrows in it:
                     self.num_output_rows.add(nrows)
                     yield self._count_output(b)
                 continue
@@ -533,6 +593,10 @@ class TpuFileScanExec(_TpuExec):
                     if self.dynamic_filters else None
                 rgs = [rg for rg in range(meta.num_row_groups)
                        if keep_rgs is None or rg in keep_rgs]
+                if self.shard_rgs is not None:
+                    allowed = self.shard_rgs.get(path)
+                    if allowed is not None:
+                        rgs = [rg for rg in rgs if rg in allowed]
                 rgs = self._pushdown_prune_rgs(meta, rgs)
                 yield from self._decode_rgs_pipelined(
                     pf, path, rgs, supported[path], scan, scan_names)

@@ -46,6 +46,30 @@ class MemoryBudget:
             from ..sched.scheduler import parse_tenant_map
             self.tenant_quotas = {t: int(f * total)
                                   for t, f in parse_tenant_map(spec).items()}
+        # per-chip HBM sub-budgets for mesh-resident shard buffers
+        # (spark.rapids.tpu.mesh.hbmPerChip): chip-tagged catalog entries
+        # charge their OWN chip's ledger; overflowing one chip spills only
+        # that chip's buffers — a shard spilling on chip 3 never charges
+        # or evicts chip 0. Empty dict = mesh off / accounting disabled,
+        # zero per-reserve overhead beyond one `if`.
+        self.chip_budgets: dict = {}
+        self.chip_used: dict = {}
+        per_chip = int(conf.get("spark.rapids.tpu.mesh.hbmPerChip") or 0)
+        if per_chip > 0 and conf.get("spark.rapids.tpu.mesh.enabled"):
+            # ledger keys are the mesh's ACTUAL device ids — the same
+            # keyspace `mesh.chip_of` tags batches with — not a re-parse
+            # of the shape string (which would silently disagree on any
+            # non-prefix device assignment). A malformed/unsatisfiable
+            # mesh conf disables the ledgers instead of failing budget
+            # construction.
+            try:
+                from ..parallel.mesh import mesh_from_conf
+                mesh = mesh_from_conf(conf)
+            except Exception:
+                mesh = None
+            if mesh is not None:
+                self.chip_budgets = {int(d.id): per_chip
+                                     for d in mesh.devices.flat}
 
     @classmethod
     def initialize(cls, total: int, conf: Optional[TpuConf] = None) -> None:
@@ -229,6 +253,32 @@ class MemoryBudget:
             from .catalog import BufferCatalog
             BufferCatalog.get().synchronous_spill(over)
         return tenant
+
+    # -- per-chip HBM ledgers (mesh/) ----------------------------------
+    def note_chip(self, chip: Optional[int], nbytes: int) -> None:
+        """Charge a chip-tagged device-resident buffer to ITS chip's
+        sub-budget (catalog add). Never raises: overflowing a chip spills
+        that chip's lowest-priority buffers down a tier — and ONLY that
+        chip's (the whole point of per-chip accounting: pressure on chip
+        3 must not evict chip 0's working set). No-op without configured
+        chip budgets or for an unknown chip."""
+        if chip is None or chip not in self.chip_budgets:
+            return
+        with self._lock:
+            self.chip_used[chip] = self.chip_used.get(chip, 0) + nbytes
+            over = self.chip_used[chip] - self.chip_budgets[chip]
+        if over > 0:
+            from .catalog import BufferCatalog
+            BufferCatalog.get().synchronous_spill(over, chip=chip)
+
+    def release_chip(self, chip: Optional[int], nbytes: int) -> None:
+        """Return a chip-tagged buffer's bytes (spill off-device /
+        close while device-resident)."""
+        if chip is None or chip not in self.chip_budgets:
+            return
+        with self._lock:
+            self.chip_used[chip] = max(
+                0, self.chip_used.get(chip, 0) - nbytes)
 
     def reset_peak(self) -> None:
         with self._lock:

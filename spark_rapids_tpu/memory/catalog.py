@@ -43,10 +43,10 @@ class SpillPriority:
 class _Entry:
     __slots__ = ("handle", "tier", "device_batch", "host_arrays", "disk_path",
                  "schema", "num_rows", "nbytes", "priority", "lock", "treedef",
-                 "created_at", "label", "host_blobs", "host_bytes")
+                 "created_at", "label", "host_blobs", "host_bytes", "chip")
 
     def __init__(self, handle: int, batch: ColumnarBatch, nbytes: int,
-                 priority: int, label: str = ""):
+                 priority: int, label: str = "", chip=None):
         self.created_at = time.monotonic()
         self.label = label
         self.handle = handle
@@ -62,6 +62,12 @@ class _Entry:
         self.nbytes = nbytes
         self.priority = priority
         self.lock = threading.Lock()
+        # mesh chip (device id) the batch is resident on; feeds the
+        # per-chip HBM ledgers and chip-filtered spill. None = untagged
+        # (the entire non-mesh engine). Cleared when the entry leaves the
+        # device tier — an unspilled batch rematerializes on the default
+        # device, not its original chip.
+        self.chip = chip
 
 
 class BufferCatalog:
@@ -94,12 +100,16 @@ class BufferCatalog:
     # ------------------------------------------------------------------
     def add_batch(self, batch: ColumnarBatch,
                   priority: int = SpillPriority.BUFFERED,
-                  label: str = "") -> int:
+                  label: str = "", chip=None) -> int:
         nbytes = batch.device_memory_size()
         with self._lock:
             h = self._next_handle
             self._next_handle += 1
-            self._entries[h] = _Entry(h, batch, nbytes, priority, label)
+            self._entries[h] = _Entry(h, batch, nbytes, priority, label,
+                                      chip=chip)
+        if chip is not None:
+            from .budget import MemoryBudget
+            MemoryBudget.get().note_chip(chip, nbytes)
         return h
 
     def acquire_batch(self, handle: int) -> ColumnarBatch:
@@ -131,6 +141,9 @@ class BufferCatalog:
                 os.unlink(e.disk_path)
             if e.tier == StorageTier.HOST:
                 self.host_used -= e.host_bytes
+            if e.chip is not None and e.tier == StorageTier.DEVICE:
+                from .budget import MemoryBudget
+                MemoryBudget.get().release_chip(e.chip, e.nbytes)
 
     def tier_of(self, handle: int) -> StorageTier:
         return self._entries[handle].tier
@@ -175,12 +188,16 @@ class BufferCatalog:
         return len(self._entries)
 
     # ------------------------------------------------------------------
-    def synchronous_spill(self, need_bytes: int) -> int:
+    def synchronous_spill(self, need_bytes: int, chip=None) -> int:
         """Spill device buffers (lowest priority first) until need_bytes freed or
-        nothing left (DeviceMemoryEventHandler loop analog)."""
+        nothing left (DeviceMemoryEventHandler loop analog). With `chip`
+        set, ONLY that chip's tagged buffers are candidates — per-chip
+        HBM pressure (mesh/) must never evict another chip's working
+        set."""
         candidates = sorted(
             [e for e in list(self._entries.values())
-             if e.tier == StorageTier.DEVICE],
+             if e.tier == StorageTier.DEVICE
+             and (chip is None or e.chip == chip)],
             key=lambda e: e.priority)
         freed = 0
         for e in candidates:
@@ -229,6 +246,11 @@ class BufferCatalog:
             # to the context active on the spilling thread (its tenant
             # sub-quota charge is pinned park->close in spillable.py)
             MemoryBudget.get().release(e.nbytes, tenant_delta=False)
+            if e.chip is not None:
+                # the buffer left its chip; an eventual unspill lands on
+                # the default device, so the tag does not come back
+                MemoryBudget.get().release_chip(e.chip, e.nbytes)
+                e.chip = None
             if self.host_used > self.host_limit:
                 try:
                     self._host_to_disk(e)

@@ -13,9 +13,21 @@ from .semaphore import TpuSemaphore
 
 class SpillableColumnarBatch:
     def __init__(self, batch: ColumnarBatch,
-                 priority: int = SpillPriority.ACTIVE_ON_DECK):
+                 priority: int = SpillPriority.ACTIVE_ON_DECK,
+                 chip: Optional[int] = None):
+        if chip is None:
+            # mesh shard batches are committed each to their own chip;
+            # tag them so the per-chip HBM ledgers and chip-filtered
+            # spill see them. sys.modules guard: a process that never
+            # ran a mesh plan never imports the package (mesh-off
+            # zero-state contract) and pays one dict probe here.
+            import sys
+            m = sys.modules.get("spark_rapids_tpu.mesh")
+            if m is not None and m.is_active():
+                chip = m.chip_of(batch)
         self._catalog = BufferCatalog.get()
-        self._handle: Optional[int] = self._catalog.add_batch(batch, priority)
+        self._handle: Optional[int] = self._catalog.add_batch(batch, priority,
+                                                              chip=chip)
         self.num_rows = batch.row_count()
         self.size_bytes = batch.device_memory_size()
         # parked device bytes are budget-visible: under a tight budget,

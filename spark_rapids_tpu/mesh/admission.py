@@ -1,0 +1,57 @@
+"""One admission door per mesh.
+
+A mesh-active query fans out over N chips, and with
+`spark.rapids.tpu.mesh.scan.parallel` over N shard decode threads — but it
+is still ONE query holding ONE admission grant. Per-chip (or per-thread)
+token acquisition would storm the scheduler: with concurrentGpuTasks=1 a
+worker taking its own permit while the task thread holds the only one
+deadlocks outright (the exact trap PR-5's prefetch producer hit), and with
+more permits an 8-shard query would consume the whole pool and starve
+every other tenant.
+
+`shard_worker_scope` is therefore the single discipline every mesh worker
+thread runs under: it ADOPTS the consuming task's standing — TaskMetrics
+instance, semaphore hold (`adopt_task_hold`), cancel token, live-view
+entry — exactly like exec/base.py's PrefetchIterator producer, and unwinds
+its reentrant counts on exit without releasing the task's permit.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+
+class QueryScope:
+    """Snapshot of the consuming task's execution identity, captured on
+    the task thread BEFORE workers spawn."""
+
+    __slots__ = ("tm", "ctx", "live_entry")
+
+    def __init__(self):
+        from .. import live as _live
+        from ..sched import context as _qctx
+        from ..utils.metrics import TaskMetrics
+        self.tm = TaskMetrics.get()
+        self.ctx = _qctx.current()
+        self.live_entry = _live.current_entry()
+
+
+@contextlib.contextmanager
+def shard_worker_scope(scope: QueryScope):
+    """Run a mesh shard worker thread on behalf of the query that spawned
+    it: shared task counters, the task's ONE admission hold (reentrant,
+    never a second permit), the task's cancel token and live entry. The
+    finally unwinds only this thread's reentrant counts."""
+    from .. import live as _live
+    from ..memory.semaphore import TpuSemaphore
+    from ..sched import context as _qctx
+    from ..utils.metrics import TaskMetrics
+    TaskMetrics._tls.metrics = scope.tm
+    sem = TpuSemaphore.get()
+    sem.adopt_task_hold()
+    _qctx.adopt(scope.ctx)
+    _live.adopt_entry(scope.live_entry)
+    try:
+        yield
+    finally:
+        sem.complete_task()

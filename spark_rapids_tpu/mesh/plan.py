@@ -1,0 +1,120 @@
+"""The sharded plan pass — hooked in `Overrides.apply` after the
+distribution pass, the same way plan/scan_pushdown.py hooks after convert.
+
+Given a converted device plan under an active mesh (the distribution pass
+already wrapped join children in mesh-sized key exchanges and split
+grouped aggregates into partial -> exchange -> per-shard final), this
+pass:
+
+  1. RESIZES plan-carried hash-exchange boundaries whose partition count
+     differs from the mesh to mesh-sized exchanges (the un-gating of the
+     ICI path beyond `num_partitions == mesh.size`): an internal hash
+     exchange's partition count is an engine knob, exactly like AQE
+     coalescing, so `repartition(200, key)` under an 8-chip mesh becomes
+     an 8-way ICI collective instead of a host shuffle. Round-robin /
+     range / single specs are NEVER resized — a mismatched count there
+     degrades that exchange to the host data plane (never a wrong split);
+
+  2. marks each mesh-sized exchange whose consumer is shard-wise (zipped
+     join, per-shard final aggregate) for DEVICE-RESIDENT output: its
+     partitions are handed downstream as zero-copy per-chip views
+     (exec/exchange.py + shard.py shard_view) instead of gathered
+     replicated slices;
+
+  3. wraps the scans feeding each mesh exchange (through per-batch-
+     preserving operators: filter, project, partial aggregate) in
+     `MeshShardedScanExec`, partitioning their input across mesh
+     positions so the pipeline is sharded end to end.
+
+Off-path: `Overrides.apply` reads ONE conf bool before importing this
+module — mesh off means zero mesh imports and byte-identical plans.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+def apply_mesh_plan(root, conf, explain_log: Optional[List[str]] = None):
+    """Rewrite a converted device plan for sharded mesh execution.
+    Returns the (mutated) root; a non-TpuExec root or inactive mesh is
+    returned untouched."""
+    from ..exec.base import TpuExec
+    if not isinstance(root, TpuExec):
+        return root
+    from ..parallel.mesh import mesh_from_conf
+    mesh = mesh_from_conf(conf)
+    if mesh is None:
+        return root
+    import jax
+    me = jax.process_index()
+    if any(d.process_index != me for d in mesh.devices.flat):
+        # multi-host mesh: shard production commits batches with
+        # device_put, which requires every mesh device to be addressable
+        # from this process. The legacy concat data plane (which the
+        # un-sharded plan still takes under ICI mode) handles multi-host;
+        # the sharded pass stands down rather than crash at execute.
+        if explain_log is not None:
+            explain_log.append("mesh: multi-host mesh — sharded plan "
+                               "pass skipped (devices not all "
+                               "process-addressable)")
+        return root
+    from . import note_active
+    note_active()
+    log = explain_log if explain_log is not None else []
+    _walk(root, None, conf, mesh.size, log)
+    return root
+
+
+def _walk(node, parent, conf, ndev: int, log: List[str]) -> None:
+    from ..exec.aggregate import TpuHashAggregateExec
+    from ..exec.exchange import TpuShuffleExchangeExec
+    from ..exec.joins import TpuShuffledHashJoinExec
+    from ..plan.nodes import HashPartitionSpec
+    for c in list(node.children):
+        _walk(c, node, conf, ndev, log)
+    if not isinstance(node, TpuShuffleExchangeExec):
+        return
+    spec = node.spec
+    if isinstance(spec, HashPartitionSpec) and \
+            spec.num_partitions != ndev and \
+            conf.get("spark.rapids.tpu.mesh.resizeExchanges"):
+        node.spec = HashPartitionSpec(list(spec.keys), ndev)
+        log.append(f"mesh: resized hash exchange "
+                   f"{spec.num_partitions} -> {ndev} partitions (ICI)")
+        spec = node.spec
+    if spec.num_partitions != ndev:
+        log.append(f"mesh: exchange stays on the host data plane "
+                   f"(num_partitions={spec.num_partitions} != "
+                   f"mesh.size={ndev})")
+        return
+    resident = (isinstance(parent, TpuShuffledHashJoinExec)
+                and getattr(parent, "zip_partitions", False)) or \
+               (isinstance(parent, TpuHashAggregateExec)
+                and parent.mode == "final"
+                and getattr(parent, "partitioned_input", False))
+    node.mesh_resident_out = bool(resident)
+    _shard_scans(node.children[0], node, conf, ndev, log)
+
+
+# operators that preserve the one-batch-per-shard alignment (1:1 per input
+# batch) between a scan and its mesh exchange; coalesce merges batches and
+# is deliberately absent
+def _shard_scans(node, parent, conf, ndev: int, log: List[str]) -> None:
+    from ..exec.aggregate import TpuHashAggregateExec
+    from ..exec.basic import TpuFilterExec, TpuProjectExec, TpuScanExec
+    from ..io.scanbase import TpuFileScanExec
+    from .shard import MeshShardedScanExec
+    if isinstance(node, (TpuFileScanExec, TpuScanExec)):
+        wrapper = MeshShardedScanExec(node, conf)
+        for i, c in enumerate(parent.children):
+            if c is node:
+                parent.children[i] = wrapper
+                log.append(f"mesh: sharded {node.name} across {ndev} chips")
+                return
+        return
+    if isinstance(node, (TpuFilterExec, TpuProjectExec)) or \
+            (isinstance(node, TpuHashAggregateExec)
+             and node.mode == "partial"):
+        for c in list(node.children):
+            _shard_scans(c, node, conf, ndev, log)

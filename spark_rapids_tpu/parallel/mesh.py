@@ -43,12 +43,21 @@ def make_mesh(n_devices: Optional[int] = None,
 _CONF_MESH: dict = {}
 
 
+def invalidate_cache() -> None:
+    """Drop the conf->Mesh memo. Hooked into `TpuConf.set` for every
+    `spark.rapids.tpu.mesh.*` key (config.py), the same conf-generation
+    invalidation the padding memo got in PR 3: a mid-session conf change
+    must never serve a mesh built for the previous configuration."""
+    _CONF_MESH.clear()
+
+
 def mesh_from_conf(conf) -> Optional[Mesh]:
     """The session's active mesh, from `spark.rapids.tpu.mesh.shape`
     ('shuffle=8' or just '8'; empty/1 = single device, no mesh). The engine
     routes planned exchanges through ICI collectives when a mesh is active
     (plan-driven distributed execution, not a hand-built program). Cached per
-    shape — Mesh identity matters for jax's compilation cache."""
+    shape — Mesh identity matters for jax's compilation cache; the cache is
+    dropped by `invalidate_cache()` whenever a mesh conf key changes."""
     shape = (conf.get("spark.rapids.tpu.mesh.shape") or "").strip()
     if not shape:
         return None

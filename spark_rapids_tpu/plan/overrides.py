@@ -1175,6 +1175,17 @@ class Overrides:
         if isinstance(result, TpuExec):
             from ..exec.requirements import ensure_distribution
             result = ensure_distribution(result, self.conf)
+            # sharded mesh execution (mesh/plan.py): shard scans across
+            # mesh positions, resize safe hash-exchange boundaries to the
+            # mesh, mark device-resident exchange->consumer seams. Off
+            # (default) this is one conf read — zero mesh imports,
+            # byte-identical plans.
+            if self.conf.get("spark.rapids.tpu.mesh.enabled"):
+                from ..mesh import mesh_enabled
+                if mesh_enabled(self.conf):
+                    from ..mesh.plan import apply_mesh_plan
+                    result = apply_mesh_plan(result, self.conf,
+                                             self.explain_log)
         return result
 
     def _tag_tree(self, plan: N.PhysicalPlan) -> PlanMeta:

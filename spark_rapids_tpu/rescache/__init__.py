@@ -334,9 +334,17 @@ def fragment_stream(node, seam: str,
     if not conf.get(f"spark.rapids.tpu.rescache.{seam}.enabled"):
         yield from produce()
         return
-    if seam == "exchange" and conf.get("spark.rapids.shuffle.mode") == "ICI":
-        # mesh exchanges can yield sharded arrays the spill catalog
-        # cannot own; the conservative gate is the mode, not the topology
+    if seam == "exchange" and conf.get("spark.rapids.shuffle.mode") == "ICI" \
+            and not conf.get("spark.rapids.tpu.mesh.enabled"):
+        # the dryrun-era ICI gate, kept verbatim for legacy mode. Under
+        # the sharded-execution subsystem (mesh/) the seam is un-gated:
+        # resident exchanges hand out per-device shard batches that park
+        # as ordinary chip-tagged spillables, and non-resident outputs
+        # (replicated slices of the sharded global) round-trip the
+        # catalog's park->host->disk->unspill path exactly (verified in
+        # test_mesh's replay test + the PR-15 review probe) — a repeated
+        # subplan replays its mesh-exchanged partitions with positional
+        # alignment preserved (empties are stored too).
         yield from produce()
         return
     cache = _cache

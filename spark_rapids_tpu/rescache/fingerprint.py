@@ -107,6 +107,10 @@ _PLAN_NODES = frozenset({
     "TpuBroadcastExchangeExec", "TpuBroadcastHashJoinExec",
     "TpuShuffledHashJoinExec", "TpuNestedLoopJoinExec", "TpuFileScanExec",
     "TpuInMemoryTableScanExec", "TpuFromCpuExec",
+    # mesh/shard.py — a sharding wrapper: output identical to the wrapped
+    # scan's (the shard layout moves rows between chips, never changes
+    # them), so its identity is its child subtree
+    "MeshShardedScanExec",
 })
 
 # attribute names that are runtime machinery, never result identity

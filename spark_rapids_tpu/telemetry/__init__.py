@@ -473,6 +473,24 @@ def _install_families(reg: MetricsRegistry) -> None:
               "statistics-history expectations; rows-only queries are "
               "omitted.", ["query_id"], callback=_live_progress_gauge)
 
+    # sharded mesh execution (mesh/ + exec/exchange.py ICI path): the
+    # collective data plane's traffic, host-plane degrades, and the
+    # per-chip HBM ledgers (callback reads the budget singleton — the
+    # gauge never imports the mesh package)
+    reg.counter("tpu_mesh_exchanges_total",
+                "Mesh all-to-all collectives executed (the ICI shuffle "
+                "data plane).")
+    reg.counter("tpu_mesh_ici_bytes_total",
+                "Bytes moved over the ICI collective (post-exchange slot "
+                "plane) instead of the host shuffle.")
+    reg.counter("tpu_mesh_degraded_total",
+                "Mesh-active exchanges that degraded to the host data "
+                "plane on a shard-count vs partition-count mismatch.")
+    reg.gauge("tpu_mesh_chip_hbm_bytes",
+              "Chip-tagged device-resident bytes per mesh chip "
+              "(spark.rapids.tpu.mesh.hbmPerChip sub-budgets).", ["chip"],
+              callback=_mesh_chip_gauge)
+
     # fleet gateway (fleet/): route decisions + per-worker pool gauges.
     # Callbacks observe live WorkerRegistries through sys.modules ONLY —
     # a process that never started a gateway never imports the package
@@ -497,6 +515,15 @@ def _install_families(reg: MetricsRegistry) -> None:
 
 
 # gauge callbacks: read singletons WITHOUT constructing them ----------------
+def _mesh_chip_gauge():
+    from ..memory.budget import MemoryBudget
+    b = MemoryBudget._instance
+    if b is None or not getattr(b, "chip_budgets", None):
+        return {}
+    with b._lock:
+        return {(str(c),): v for c, v in b.chip_used.items()}
+
+
 def _budget_gauge():
     from ..memory.budget import MemoryBudget
     b = MemoryBudget._instance

@@ -38,6 +38,7 @@ from ..utils.spans import (SCHEMA_VERSION, format_adaptive_decision,
 
 __all__ = ["load_records", "build_model", "render_report", "sched_summary",
            "cache_summary", "stats_summary", "pushdown_summary",
+           "mesh_summary",
            "trace_view", "main"]
 
 # live logs plus size-capped rotation generations (events-PID.jsonl.1, .2,
@@ -301,6 +302,32 @@ def pushdown_summary(model: Dict[str, Any]) -> Dict[str, Any]:
             "bytes_materialized": bytes_materialized}
 
 
+def mesh_summary(model: Dict[str, Any]) -> Dict[str, Any]:
+    """Sharded-execution signal across all queries (mesh/ task-metric
+    counters): ICI collectives executed, bytes moved over the
+    interconnect instead of the host shuffle, scan shards produced, and
+    exchanges that degraded to the host data plane. Empty dict when no
+    query ran mesh-active."""
+    exchanges = ici_bytes = shards = degraded = 0
+    queries = 0
+    for q in model["queries"]:
+        tm = q["task_metrics"]
+        ex = tm.get("mesh_exchanges", 0)
+        sh = tm.get("mesh_shards", 0)
+        dg = tm.get("mesh_degraded", 0)
+        if ex or sh or dg:
+            queries += 1
+            exchanges += ex
+            ici_bytes += tm.get("mesh_ici_bytes", 0)
+            shards += sh
+            degraded += dg
+    if not queries:
+        return {}
+    return {"queries": queries, "exchanges": exchanges,
+            "ici_bytes": ici_bytes, "shards": shards,
+            "degraded": degraded}
+
+
 def trace_view(records: List[Dict[str, Any]],
                trace: Optional[str] = None) -> str:
     """Cross-process trace timeline: group every record carrying a trace
@@ -493,6 +520,14 @@ def render_report(model: Dict[str, Any], top: int = 10,
                 f"rowGroupsPruned={tm.get('scan_rowgroups_pruned', 0)} "
                 f"bytesMaterialized="
                 f"{tm.get('scan_bytes_materialized', 0)}B")
+        if tm.get("mesh_exchanges") or tm.get("mesh_shards") \
+                or tm.get("mesh_degraded"):
+            # sharded mesh execution: collectives + interconnect traffic
+            lines.append(
+                f"mesh: exchanges={tm.get('mesh_exchanges', 0)} "
+                f"shards={tm.get('mesh_shards', 0)} "
+                f"iciBytes={tm.get('mesh_ici_bytes', 0)}B "
+                f"degraded={tm.get('mesh_degraded', 0)}")
         if q.get("adaptive"):
             # AQE's actual decisions (staging coalesces, skew splits,
             # history pre-flags) — previously only a session attribute
@@ -525,6 +560,14 @@ def render_report(model: Dict[str, Any], top: int = 10,
             f"queries={pd['queries']} rowsPruned={pd['rows_pruned']} "
             f"rowGroupsPruned={pd['rowgroups_pruned']} "
             f"bytesMaterialized={pd['bytes_materialized']}B")
+        lines.append("")
+    mh = mesh_summary(model)
+    if mh:
+        lines.append("=== sharded mesh execution ===")
+        lines.append(
+            f"queries={mh['queries']} exchanges={mh['exchanges']} "
+            f"iciBytes={mh['ici_bytes']}B shards={mh['shards']} "
+            f"degraded={mh['degraded']}")
         lines.append("")
     cache = cache_summary(model)
     if cache:
@@ -612,6 +655,7 @@ def main(argv: List[str] = None) -> int:
         model["cache"] = cache_summary(model)
         model["stats"] = stats_summary(model, top=args.top)
         model["pushdown"] = pushdown_summary(model)
+        model["mesh"] = mesh_summary(model)
         print(json.dumps(model, indent=2))
     else:
         print(render_report(model, top=args.top, stats=args.stats))

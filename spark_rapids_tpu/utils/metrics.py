@@ -214,6 +214,16 @@ class TaskMetrics:
         self.sched_cancelled = 0
         self.sched_deadline_exceeded = 0
         self.sched_queue_depth = 0
+        # sharded mesh execution (mesh/ + exec/exchange.py ICI path):
+        # collectives executed, bytes moved over the interconnect (the
+        # post-exchange slot plane — the data that would otherwise ride
+        # the host shuffle), scan shards produced across mesh positions,
+        # and exchanges that degraded to the host data plane on a
+        # shard-count vs partition-count mismatch
+        self.mesh_exchanges = 0
+        self.mesh_ici_bytes = 0
+        self.mesh_shards = 0
+        self.mesh_degraded = 0
 
     @classmethod
     def get(cls) -> "TaskMetrics":
@@ -298,4 +308,11 @@ class TaskMetrics:
                 f"schedRejected={self.sched_rejected} "
                 f"schedCancelled={self.sched_cancelled} "
                 f"schedDeadlineExceeded={self.sched_deadline_exceeded}")
+        if self.mesh_exchanges or self.mesh_shards or self.mesh_degraded:
+            parts.append(
+                f"meshExchanges={self.mesh_exchanges} "
+                f"meshShards={self.mesh_shards} "
+                f"meshIciBytes={self.mesh_ici_bytes}"
+                + (f" meshDegraded={self.mesh_degraded}"
+                   if self.mesh_degraded else ""))
         return "" if not parts else "TaskMetrics: " + "; ".join(parts)

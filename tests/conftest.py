@@ -88,6 +88,13 @@ def pytest_configure(config):
         "supervisor / chaos campaigns over real gateway + supervised "
         "worker processes; scripts/chaos_matrix.sh runs these "
         "standalone — campaign tests are also `slow`)")
+    config.addinivalue_line(
+        "markers",
+        "mesh: sharded-execution suite (scan sharding across mesh "
+        "positions / device-resident exchange seams / partition-count "
+        "mismatch degrades / per-chip HBM ledgers / one admission door / "
+        "rescache ICI seam; scripts/mesh_matrix.sh runs these "
+        "standalone)")
 
 
 @pytest.fixture
