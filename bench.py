@@ -444,6 +444,100 @@ def scan_pushdown_bench(tmpdir: str, full: bool = False) -> dict:
     return out
 
 
+def fusion_query_bench() -> dict:
+    """Whole-stage fusion sweep (ISSUE-16): the SAME engine query with
+    fusion on vs off across three chain shapes — filter->project,
+    project->broadcast-probe->project, and an expression-heavy
+    filter + stacked-projection chain — reporting wall, device-dispatch
+    counts per run (the machine-independent win: one dispatch per fused
+    stage per batch) and the per-shape bit-identical gate. The gates the
+    matrix script enforces: >=2x fewer dispatches overall, wall no worse
+    on any shape, faster on the expression-heavy shape."""
+    import pyarrow as pa
+    from spark_rapids_tpu.expr import col
+    from spark_rapids_tpu.plugin import TpuSession
+    from spark_rapids_tpu.utils.metrics import TaskMetrics
+
+    rng = np.random.default_rng(23)
+    n = SCAN_ROWS // 4
+    fact = pa.table({
+        "k": pa.array(rng.integers(0, 4096, n).astype(np.int64)),
+        "a": pa.array(rng.integers(-1000, 1000, n).astype(np.int64)),
+        "b": pa.array(rng.integers(1, 100, n).astype(np.int64)),
+    })
+    dim = pa.table({
+        "k": pa.array(np.arange(4096, dtype=np.int64)),
+        "w": pa.array(rng.integers(1, 9, 4096).astype(np.int64)),
+    })
+
+    def fp(df, _):  # filter -> project
+        return df.filter(col("a") > 0).select(
+            (col("a") * 2 + col("b")).alias("x"), col("k"))
+
+    def join(df, sess):  # project -> broadcast probe -> project
+        d = sess.from_arrow(dim)
+        return df.select(col("k"), (col("a") + col("b")).alias("v")) \
+            .join(d, on="k", how="inner") \
+            .select((col("v") * col("w")).alias("x"), col("k"))
+
+    def exprheavy(df, _):  # long chain: per-op dispatch overhead dominates
+        q = df.filter(col("a") > -900)
+        for i in range(1, 7):
+            q = q.select(col("k"), (col("a") + i).alias("a"),
+                         (col("b") * 2 - col("a")).alias("b"))
+        return q.select((col("a") + col("b")).alias("x"), col("k"))
+
+    def prep(build, fusion):
+        sess = TpuSession({
+            "spark.rapids.sql.enabled": True,
+            "spark.rapids.sql.explain": "NONE",
+            "spark.rapids.tpu.fusion.enabled": fusion,
+        })
+        sess.initialize_device()
+        q = build(sess.from_arrow(fact), sess)
+        q.collect()  # warm (compiles)
+        return q
+
+    def measure(q):
+        TaskMetrics.reset()  # dispatches report ONE run, not the sum
+        t0 = time.perf_counter()
+        res = q.collect()
+        return res, time.perf_counter() - t0, \
+            TaskMetrics.get().device_dispatches
+
+    def run(build):
+        # interleave the on/off reps so clock-speed / cache drift within
+        # the process cancels instead of biasing whichever ran first
+        q_on, q_off = prep(build, True), prep(build, False)
+        t_on = t_off = float("inf")
+        for _ in range(5):
+            res_on, t, d_on = measure(q_on)
+            t_on = min(t_on, t)
+            res_off, t, d_off = measure(q_off)
+            t_off = min(t_off, t)
+        return res_on, t_on, d_on, res_off, t_off, d_off
+
+    out = {"fusion_rows": n}
+    tot_on = tot_off = 0
+    for name, build in [("fp", fp), ("join", join),
+                        ("exprheavy", exprheavy)]:
+        res_on, t_on, d_on, res_off, t_off, d_off = run(build)
+        a = res_on.sort_by([("k", "ascending"), ("x", "ascending")])
+        b = res_off.sort_by([("k", "ascending"), ("x", "ascending")])
+        tot_on += d_on
+        tot_off += d_off
+        out.update({
+            f"fusion_{name}_s_on": round(t_on, 5),
+            f"fusion_{name}_s_off": round(t_off, 5),
+            f"fusion_{name}_speedup": round(t_off / t_on, 3),
+            f"fusion_{name}_dispatches_on": int(d_on),
+            f"fusion_{name}_dispatches_off": int(d_off),
+            f"fusion_{name}_identical": bool(a.equals(b)),
+        })
+    out["fusion_dispatch_reduction_x"] = round(tot_off / max(tot_on, 1), 3)
+    return out
+
+
 ATTEMPTS = 3
 # First compile via the tunnel is ~20-40s per program and the measured
 # sections are seconds; a healthy cold run (pipeline + scan-decode compiles)
@@ -1545,6 +1639,14 @@ if __name__ == "__main__":
         with tempfile.TemporaryDirectory() as td:
             print(json.dumps(scan_pushdown_bench(td, full=True)),
                   flush=True)
+    elif "--fusion" in sys.argv:
+        # bench flag (ISSUE-16): whole-stage fusion sweep — the same
+        # chains with fusion on vs off: wall, device-dispatch counts and
+        # the overall dispatch-reduction factor, bit-identical gate per
+        # shape; one JSON line
+        _enable_compilation_cache()
+        _apply_platform_override()
+        print(json.dumps(fusion_query_bench()), flush=True)
     elif "--scan-only" in sys.argv:
         scan_only()
     elif os.environ.get(_CHILD_ENV):

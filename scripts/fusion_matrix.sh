@@ -1,0 +1,115 @@
+#!/usr/bin/env bash
+# Whole-stage fusion matrix (ISSUE-16 CI gate):
+#   1. run the fusion test suite (marker `fusion`): planner chains,
+#      golden fusion-on/off bit-identity across chain shapes x types,
+#      partial-agg heads, ANSI error parity through a fused stage,
+#      pallas kernel exactness, dispatch accounting, fused-first warmup;
+#   2. fusion-OFF purity gate: with the conf off (the default) a full
+#      plan+collect must import ZERO fusion modules (planner pass, fused
+#      exec node, pallas probe/groupby kernels), move none of the fusion
+#      metrics, compile no `exec.fused_stage` programs, and produce
+#      byte-identical plans AND results vs a never-had-the-feature run;
+#   3. dispatch-reduction gate (machine-independent proxy for the fusion
+#      win): the bench chains fused must dispatch >=2x fewer device
+#      programs than unfused, bit-identical per shape, wall no worse
+#      (10% noise floor) on every shape and strictly faster on the
+#      expression-heavy chain.
+#
+# Usage: scripts/fusion_matrix.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TIMEOUT="${SRTPU_FUSION_TIMEOUT:-900}"
+
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_fusion.py -m fusion -q \
+    -p no:cacheprovider "$@"
+
+echo "== fusion-off purity gate (zero imports, zero state, byte-identical) =="
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu python - <<'EOF'
+import sys
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.compile.service import CompileService
+from spark_rapids_tpu.expr import col
+from spark_rapids_tpu.plan.overrides import Overrides
+from spark_rapids_tpu.plugin import TpuSession
+from spark_rapids_tpu.utils.metrics import TaskMetrics
+
+rng = np.random.default_rng(16)
+n = 50_000
+t = pa.table({
+    "k": pa.array(rng.integers(0, 512, n).astype(np.int64)),
+    "a": pa.array(rng.integers(-1000, 1000, n).astype(np.int64)),
+})
+d = pa.table({
+    "k": pa.array(np.arange(512, dtype=np.int64)),
+    "w": pa.array(rng.integers(1, 9, 512).astype(np.int64)),
+})
+
+def build(sess):
+    return sess.from_arrow(t) \
+        .select(col("k"), (col("a") + 1).alias("v")) \
+        .join(sess.from_arrow(d), on="k", how="inner") \
+        .select((col("v") * col("w")).alias("x"), col("k"))
+
+sess = TpuSession({"spark.rapids.sql.explain": "NONE"})
+plan_default = Overrides(sess.conf).apply(build(sess).plan).tree_string()
+TaskMetrics.reset()
+out = build(sess).collect().sort_by(
+    [("k", "ascending"), ("x", "ascending")])
+tm = TaskMetrics.get()
+
+# 1. the fusion code paths must never even load on the off path
+bad = [m for m in sys.modules if m.startswith("spark_rapids_tpu") and (
+    "fusion" in m or "fused" in m or "pallas_probe" in m
+    or "pallas_groupby" in m)]
+assert not bad, f"fusion-off run imported fusion modules: {bad}"
+
+# 2. zero fusion state / metric motion / compiled fused programs
+assert tm.fused_stages == 0 and tm.fused_ops == 0, \
+    "fusion-off run moved fusion metrics"
+assert "TpuFusedStageExec" not in plan_default, \
+    "fusion-off plan contains a fused node"
+ops = CompileService.get().stats.per_op()
+bad_ops = [k for k in ops if "fused_stage" in k]
+assert not bad_ops, f"fusion-off compiled fused programs: {bad_ops}"
+
+# 3. byte-identical plans and results vs an explicit-off session
+sess_off = TpuSession({"spark.rapids.sql.explain": "NONE",
+                       "spark.rapids.tpu.fusion.enabled": False})
+plan_off = Overrides(sess_off.conf).apply(build(sess_off).plan)
+assert plan_off.tree_string() == plan_default, \
+    "explicit-off plan differs from default plan"
+out_off = build(sess_off).collect().sort_by(
+    [("k", "ascending"), ("x", "ascending")])
+assert out.equals(out_off), "explicit-off result differs from default"
+print("fusion-off: zero imports, zero state, byte-identical OK")
+EOF
+
+echo "== dispatch-reduction gate (>=2x fewer dispatches, wall no worse) =="
+SPARK_RAPIDS_TPU_BENCH_PLATFORM="${SPARK_RAPIDS_TPU_BENCH_PLATFORM:-cpu}" \
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+    python bench.py --fusion | tail -1 > /tmp/_fusion_bench.json
+timeout -k 10 60 python - <<'EOF'
+import json
+
+r = json.load(open("/tmp/_fusion_bench.json"))
+shapes = ("fp", "join", "exprheavy")
+for s in shapes:
+    assert r[f"fusion_{s}_identical"], f"shape {s}: results differ on/off"
+    # wall no worse at any shape, 10% noise floor for the short chains
+    assert r[f"fusion_{s}_speedup"] >= 0.9, \
+        f"shape {s}: fused wall regressed ({r[f'fusion_{s}_speedup']}x)"
+    assert r[f"fusion_{s}_dispatches_on"] < r[f"fusion_{s}_dispatches_off"]
+assert r["fusion_dispatch_reduction_x"] >= 2.0, \
+    f"dispatch reduction {r['fusion_dispatch_reduction_x']}x < 2x"
+assert r["fusion_exprheavy_speedup"] > 1.0, \
+    "expression-heavy chain not faster fused"
+print(f"dispatch reduction {r['fusion_dispatch_reduction_x']}x, "
+      f"exprheavy {r['fusion_exprheavy_speedup']}x faster OK")
+EOF
+
+echo "fusion matrix: all gates passed"

@@ -385,6 +385,7 @@ class CompileService:
     # ------------------------------------------------------------------
     def call(self, sj: ServiceJit, args: tuple):
         if not self._enabled:
+            self._count_dispatch(args)
             return sj.direct(*args)
         try:
             import jax
@@ -393,12 +394,15 @@ class CompileService:
             if any(isinstance(l, jax.core.Tracer) for l in leaves):
                 # nested call inside another kernel's trace: an AOT
                 # executable can't consume tracers — inline via plain jit
-                # (jax's own nested-jit semantics), no cache bookkeeping
+                # (jax's own nested-jit semantics), no cache bookkeeping.
+                # NOT a device dispatch: it inlines into the outer program.
                 return sj.direct(*args)
             digest = self._digest(sj, statics, leaves, treedef)
         except Exception:
             # unhashable/unsignable arguments: not service material
+            self._count_dispatch(args)
             return sj.direct(*args)
+        self._task_metrics().device_dispatches += 1
         entry = self._mem_get(sj, digest)
         if entry is None:
             entry = self._compile_or_wait(digest, sj, statics, dyn, boxes)
@@ -550,6 +554,18 @@ class CompileService:
         from ..utils.metrics import TaskMetrics
         return TaskMetrics.get()
 
+    def _count_dispatch(self, args: tuple) -> None:
+        """Count one host-side program launch UNLESS the call is nested in
+        another kernel's trace (it inlines — no launch of its own)."""
+        try:
+            import jax
+            leaves, _ = jax.tree_util.tree_flatten(args)
+            if any(isinstance(l, jax.core.Tracer) for l in leaves):
+                return
+        except Exception:
+            pass
+        self._task_metrics().device_dispatches += 1
+
     def _fallback(self, sj: ServiceJit, why: str) -> None:
         self.stats.bump(sj.op, fallbacks=1)
         self._task_metrics().compile_fallbacks += 1
@@ -671,6 +687,31 @@ class CompileService:
             "list", lambda: [f[:-len(".xprog")]
                              for f in os.listdir(self._dir)
                              if f.endswith(".xprog")], default=[])
+
+    def persisted_meta(self, digest: str) -> Optional[dict]:
+        """Cheap header+meta sniff of one persisted entry ({"op", "key",
+        "msgs"}) without deserializing the program — warmup uses it to
+        order fused-stage programs first. None on any damage."""
+        if not self._persist_ok():
+            return None
+
+        def read():
+            with open(self._entry_path(digest), "rb") as f:
+                head = f.read(_HDR.size)
+                if len(head) < _HDR.size:
+                    return None
+                magic, fmt, _crc, meta_len = _HDR.unpack_from(head)
+                if magic != _MAGIC or fmt != _FMT_EXPORT:
+                    return None
+                meta = f.read(meta_len)
+                if len(meta) < meta_len:
+                    return None
+                return json.loads(meta.decode())
+
+        try:
+            return self._tier.run("meta", read, missing_ok=True)
+        except Exception:
+            return None
 
     def preload_persistent(self, digest: str) -> bool:
         """Pull one persisted entry into the memory tier (warmup). Returns

@@ -84,9 +84,21 @@ def make_warmup_batch(dtypes: List[str], cap: int, rows: int):
 
 def run_warmup(conf, service) -> dict:
     """Synchronous warmup body; returns counters (tests call directly)."""
-    stats = {"preloaded": 0, "synthetic": 0, "errors": 0}
-    # phase 1: lift the persistent tier into memory
-    for digest in service.persisted_entries():
+    stats = {"preloaded": 0, "synthetic": 0, "errors": 0, "fused": 0}
+    # phase 1: lift the persistent tier into memory, fused-stage programs
+    # FIRST — they are the widest programs (a whole operator chain each),
+    # so a restarted worker's first fused query finds its stage warm even
+    # if a query interrupts warmup midway
+    digests = service.persisted_entries()
+    fused, rest = [], []
+    for digest in digests:
+        meta = service.persisted_meta(digest)
+        if meta is not None and meta.get("op") == "exec.fused_stage":
+            fused.append(digest)
+        else:
+            rest.append(digest)
+    stats["fused"] = len(fused)
+    for digest in fused + rest:
         try:
             if service.preload_persistent(digest):
                 stats["preloaded"] += 1

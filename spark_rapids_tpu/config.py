@@ -527,6 +527,35 @@ register("spark.rapids.tpu.scan.pushdown.rowgroup.enabled", "bool", True,
          "on tpu_scan_rowgroups_pruned_total. Ignored unless "
          "spark.rapids.tpu.scan.pushdown.enabled is on.")
 
+# Whole-stage fusion -----------------------------------------------------------------
+register("spark.rapids.tpu.fusion.enabled", "bool", False,
+         "Whole-stage fusion: a planner pass (plan/fusion.py) replaces "
+         "maximal chains of batch-shape-compatible operators — "
+         "expression-only project/filter, broadcast hash-join probe "
+         "(inner/left/semi/anti/existence, non-dpp, non-zip), and a "
+         "stage-terminal partial hash aggregate — with one fused stage "
+         "that compiles through the compile service as a SINGLE device "
+         "program: one dispatch per stage per batch, member "
+         "intermediates never materialise as ColumnarBatches. Sorts, "
+         "windows, exchanges, UDFs, right/full joins and chains under "
+         "mesh-resident exchanges break the chain and run unfused. Off "
+         "(default) never imports the fusion modules and leaves plans "
+         "and results byte-identical to the per-operator paths.")
+register("spark.rapids.tpu.fusion.minOps", "int", 2,
+         "Minimum member count for a chain to be worth fusing (clamped "
+         "to >= 2): shorter chains keep the per-operator kernels, whose "
+         "compile cache is warmer across queries. Ignored unless "
+         "spark.rapids.tpu.fusion.enabled is on.")
+register("spark.rapids.tpu.fusion.pallas.mode", "string", "auto",
+         "Backend for the fused stage's hot inner loops (hash-probe "
+         "sizing, group-by accumulate): auto uses the hand-written "
+         "Pallas kernels (ops/pallas_probe.py, ops/pallas_groupby.py) "
+         "on TPU backends and the stock jit lowerings elsewhere; off "
+         "forces the jit lowerings everywhere; force runs the Pallas "
+         "kernels in interpret mode off-TPU (testing). Both paths are "
+         "bit-identical by construction.",
+         check_values=("auto", "off", "force"))
+
 # Query scheduler --------------------------------------------------------------------
 register("spark.rapids.tpu.sched.enabled", "bool", False,
          "Query scheduler: route device admission (TpuSemaphore and the "

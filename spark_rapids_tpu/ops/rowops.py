@@ -151,6 +151,14 @@ def group_ids_from_sorted(xp, key_vecs: Sequence[Vec], row_mask):
     return gid, num_groups, starts
 
 
+# Whole-stage fusion hook (exec/fused.py): while a fused stage traces an
+# aggregate member with the pallas group-by enabled, this holds
+# ops.pallas_groupby.fused_segment_sum (bit-exact, self-fallback outside its
+# int64 window). None — always, outside that trace — means the plain paths
+# below run untouched.
+_FUSED_SEGMENT_SUM = None
+
+
 def segment_reduce(xp, op: str, data, gid, cap: int, valid=None):
     """Segmented reduction over rows with group ids. Invalid rows are excluded
     (null-skipping aggregate semantics). Returns per-group array of length cap."""
@@ -159,14 +167,19 @@ def segment_reduce(xp, op: str, data, gid, cap: int, valid=None):
         valid = xp.ones(data.shape[0], dtype=bool)
     if op == "count":
         ones = valid.astype(np.int64)
-        return jax.ops.segment_sum(ones, gid, num_segments=cap) if xp is not np \
-            else np.bincount(gid, weights=ones, minlength=cap).astype(np.int64)
+        if xp is np:
+            return np.bincount(gid, weights=ones, minlength=cap).astype(np.int64)
+        if _FUSED_SEGMENT_SUM is not None:
+            return _FUSED_SEGMENT_SUM(ones, gid, cap)
+        return jax.ops.segment_sum(ones, gid, num_segments=cap)
     if op == "sum":
         contrib = xp.where(valid, data, data.dtype.type(0))
         if xp is np:
             out = np.zeros(cap, dtype=data.dtype)
             np.add.at(out, gid, contrib)
             return out
+        if _FUSED_SEGMENT_SUM is not None and contrib.ndim == 1:
+            return _FUSED_SEGMENT_SUM(contrib, gid, cap)
         return jax.ops.segment_sum(contrib, gid, num_segments=cap)
     if op in ("min", "max"):
         if np.issubdtype(data.dtype, np.floating):

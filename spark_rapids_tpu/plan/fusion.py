@@ -1,0 +1,187 @@
+"""Whole-stage fusion planner pass (ISSUE-16 tentpole; reference analog:
+`GpuTieredProject` tiers + the codegen WholeStageCodegenExec boundary rules,
+generalised per "Data Path Fusion in GPU for Analytical Query Processing").
+
+Hooked into `Overrides.apply` after scan pushdown (and after the mesh
+pass, so mesh seams are visible), behind `spark.rapids.tpu.fusion.enabled`.
+The pass finds MAXIMAL chains of batch-shape-compatible operators —
+
+  * expression-only `TpuProjectExec` / `TpuFilterExec` (no pandas UDF /
+    eager host black box),
+  * the probe side of a `TpuBroadcastHashJoinExec` whose build child is a
+    `TpuBroadcastExchangeExec` (inner/left/semi/anti/existence: the join
+    types with no end-of-stream unmatched-build pass),
+  * a stage-TERMINAL partial `TpuHashAggregateExec` (complete/final modes
+    merge across the whole batch stream and cannot stream per-batch),
+
+— and replaces each chain with one `exec/fused.py TpuFusedStageExec` that
+compiles the member kernels into a SINGLE device program: a batch crosses
+the dispatch boundary once per stage, and member intermediates stay traced
+values (registers/HBM) instead of materialising as ColumnarBatches.
+
+Chain-break rules (the fusion grammar's complement): sort, window, limit,
+sample, expand, coalesce, exchanges, UDF/eager expressions, right/full
+joins, dpp- or zip-partition joins, non-partial aggregates, and any chain
+sitting directly under a mesh-resident exchange (its shard-wise consumer
+contract requires the exact per-member batch alignment) all end the chain;
+the non-fused remainder executes exactly as before.
+
+Off-path contract (CI-gated by scripts/fusion_matrix.sh): fusion off is
+ONE conf read in Overrides.apply — this module is never imported, no
+fusion state exists, plans and results are byte-identical.
+"""
+
+from __future__ import annotations
+
+KEY_ENABLED = "spark.rapids.tpu.fusion.enabled"
+KEY_MIN_OPS = "spark.rapids.tpu.fusion.minOps"
+KEY_PALLAS = "spark.rapids.tpu.fusion.pallas.mode"
+
+# join types a fused stage can stream per-batch: right/full need the
+# unmatched-build pass after the probe stream ends, which is a cross-batch
+# host loop by construction
+FUSIBLE_JOIN_TYPES = ("inner", "left", "semi", "anti", "existence")
+
+__all__ = ["apply_fusion", "FusedStageSpec", "KEY_ENABLED", "KEY_MIN_OPS",
+           "KEY_PALLAS", "FUSIBLE_JOIN_TYPES"]
+
+
+class FusedStageSpec:
+    """Param-faithful identity of one fused stage: the source schema plus
+    one signature string per member (bound-expression reprs, key ordinals,
+    join type/condition, schemas — everything baked into the fused trace).
+
+    The spec's repr IS the fused program's compile-cache key material and
+    the node's rescache-fingerprint rendering (PR-3/PR-9 repr discipline):
+    two stages differing in ANY member param must never alias one cached
+    executable or one cached result. Audited by tests/test_repr_audit.py.
+    """
+
+    __slots__ = ("source", "members")
+
+    def __init__(self, source: str, members):
+        self.source = source
+        self.members = tuple(members)
+
+    def __repr__(self):
+        return (f"FusedStageSpec(source={self.source}, "
+                f"members=[{'; '.join(self.members)}])")
+
+    def __eq__(self, other):
+        return (isinstance(other, FusedStageSpec)
+                and self.source == other.source
+                and self.members == other.members)
+
+    def __hash__(self):
+        return hash((self.source, self.members))
+
+
+def _schema_sig(schema) -> str:
+    return (f"{tuple(schema.names)!r}:"
+            f"{[t.simple_string() for t in schema.types]!r}")
+
+
+def _member_sig(m) -> str:
+    """One member's contribution to the stage spec. Bound-expression reprs
+    are the audited repr surface the per-op kernel keys already ride."""
+    from ..exec.aggregate import TpuHashAggregateExec
+    from ..exec.basic import TpuFilterExec, TpuProjectExec
+    from ..exec.joins import TpuBroadcastHashJoinExec
+    if isinstance(m, TpuProjectExec):
+        return f"Project[{m._bound!r} -> {_schema_sig(m._schema)}]"
+    if isinstance(m, TpuFilterExec):
+        return f"Filter[{m._bound!r} @ {_schema_sig(m.child.output)}]"
+    if isinstance(m, TpuBroadcastHashJoinExec):
+        cond = "None" if m._bcond is None else repr(m._bcond.expr)
+        return (f"BroadcastHashJoin[{m.join_type}, lk={m._lk_ix!r}, "
+                f"rk={m._rk_ix!r}, cond={cond}, "
+                f"build={_schema_sig(m.children[1].output)}, "
+                f"out={_schema_sig(m._schema)}, ansi={m.conf.is_ansi!r}]")
+    if isinstance(m, TpuHashAggregateExec):
+        # the agg kernel key already digests groups/aggs/schemas/conf
+        # param-faithfully for exactly this (input_partial, output_partial)
+        return f"PartialAgg[{m._agg_kernel_key(False, True)}]"
+    raise TypeError(f"not a fusible member: {type(m).__name__}")
+
+
+def apply_fusion(root, conf):
+    """Entry point, hooked into Overrides.apply after the mesh pass. Off
+    (default) the hook never imports this module — the CI-gated
+    byte-identical contract."""
+    if root is None or not conf.get(KEY_ENABLED):
+        return root
+    return _walk(root, conf, None)
+
+
+def _walk(node, conf, parent):
+    from ..exec.transitions import CpuFromTpuExec
+    if isinstance(node, CpuFromTpuExec):
+        node.tpu_exec = _walk(node.tpu_exec, conf, None)
+        return node
+    inner = getattr(node, "cpu_plan", None)
+    if inner is not None:  # TpuFromCpuExec bridge: CPU subtree may nest
+        node.cpu_plan = _walk(inner, conf, None)
+    fused = _try_fuse(node, conf, parent)
+    if fused is not None:
+        # recurse BELOW the stage only (source + build exchanges); member
+        # interiors are the chain itself
+        fused.children = [_walk(c, conf, fused) for c in fused.children]
+        return fused
+    kids = getattr(node, "children", None)
+    if kids:
+        node.children = [_walk(c, conf, node) for c in kids]
+    return node
+
+
+def _fusible(node, head: bool) -> bool:
+    from ..exec.aggregate import TpuHashAggregateExec
+    from ..exec.basic import (TpuFilterExec, TpuProjectExec,
+                              has_host_black_box)
+    from ..exec.broadcast import TpuBroadcastExchangeExec
+    from ..exec.joins import TpuBroadcastHashJoinExec
+    if isinstance(node, TpuProjectExec):
+        return not node._has_host_black_box()
+    if isinstance(node, TpuFilterExec):
+        return not has_host_black_box([node._bound])
+    if isinstance(node, TpuBroadcastHashJoinExec):
+        if node.join_type not in FUSIBLE_JOIN_TYPES:
+            return False
+        if node.zip_partitions or node.dpp_filters:
+            return False
+        if not isinstance(node.children[1], TpuBroadcastExchangeExec):
+            return False
+        if node._bcond is not None and \
+                has_host_black_box([node._bcond.expr]):
+            return False
+        return True
+    if isinstance(node, TpuHashAggregateExec):
+        # stage-terminal only; single-pass aggs (approx_percentile family)
+        # and eager (UDF-bearing) aggs keep their host loops
+        return (head and node.mode == "partial" and not node._eager
+                and not node._has_single_pass())
+    return False
+
+
+def _try_fuse(node, conf, parent):
+    """Replace the maximal fusible chain headed at `node` (if >= minOps
+    members) with a TpuFusedStageExec. Returns None when nothing fuses."""
+    from ..exec.base import TpuExec
+    if not isinstance(node, TpuExec):
+        return None
+    if getattr(parent, "mesh_resident_out", False):
+        # the exchange's shard-wise consumer contract needs the exact
+        # per-member batch alignment — never rewrite directly under it
+        return None
+    chain = []
+    cur = node
+    while _fusible(cur, head=cur is node):
+        chain.append(cur)
+        cur = cur.children[0]  # the probe/stream child for every member
+    min_ops = max(2, int(conf.get(KEY_MIN_OPS)))
+    if len(chain) < min_ops:
+        return None
+    members = list(reversed(chain))  # bottom-up (stream order)
+    spec = FusedStageSpec(source=_schema_sig(cur.output),
+                          members=tuple(_member_sig(m) for m in members))
+    from ..exec.fused import TpuFusedStageExec
+    return TpuFusedStageExec(members, spec, conf=conf)

@@ -1186,6 +1186,15 @@ class Overrides:
                     from ..mesh.plan import apply_mesh_plan
                     result = apply_mesh_plan(result, self.conf,
                                              self.explain_log)
+            # whole-stage fusion (plan/fusion.py): replace maximal
+            # project/filter/broadcast-probe/partial-agg chains with
+            # single-program fused stages. Runs after the mesh pass so
+            # mesh-resident seams are visible as chain breaks. Off
+            # (default) this is one conf read — zero fusion imports,
+            # byte-identical plans.
+            if self.conf.get("spark.rapids.tpu.fusion.enabled"):
+                from .fusion import apply_fusion
+                result = apply_fusion(result, self.conf)
         return result
 
     def _tag_tree(self, plan: N.PhysicalPlan) -> PlanMeta:

@@ -111,6 +111,11 @@ _PLAN_NODES = frozenset({
     # scan's (the shard layout moves rows between chips, never changes
     # them), so its identity is its child subtree
     "MeshShardedScanExec",
+    # exec/fused.py — identity is the audited FusedStageSpec repr (public
+    # `spec`) plus member_exprs (rendered AND determinism-checked, so a
+    # rand()/UDF member fails closed exactly like its unfused form) plus
+    # the source/build children
+    "TpuFusedStageExec",
 })
 
 # attribute names that are runtime machinery, never result identity

@@ -38,7 +38,7 @@ from ..utils.spans import (SCHEMA_VERSION, format_adaptive_decision,
 
 __all__ = ["load_records", "build_model", "render_report", "sched_summary",
            "cache_summary", "stats_summary", "pushdown_summary",
-           "mesh_summary",
+           "mesh_summary", "fusion_summary",
            "trace_view", "main"]
 
 # live logs plus size-capped rotation generations (events-PID.jsonl.1, .2,
@@ -328,6 +328,30 @@ def mesh_summary(model: Dict[str, Any]) -> Dict[str, Any]:
             "degraded": degraded}
 
 
+def fusion_summary(model: Dict[str, Any]) -> Dict[str, Any]:
+    """Whole-stage fusion signal across all queries (exec/fused.py +
+    compile-service task-metric counters): device program launches,
+    fused stages executed, the member operators they absorbed, and the
+    mean dispatch count per fusing query — dispatches-per-query is the
+    fusion gate metric. Empty dict when no query ran with fusion
+    engaged."""
+    dispatches = stages = ops = 0
+    queries = 0
+    for q in model["queries"]:
+        tm = q["task_metrics"]
+        fs = tm.get("fused_stages", 0)
+        if fs:
+            queries += 1
+            stages += fs
+            ops += tm.get("fused_ops", 0)
+            dispatches += tm.get("device_dispatches", 0)
+    if not queries:
+        return {}
+    return {"queries": queries, "fused_stages": stages, "fused_ops": ops,
+            "device_dispatches": dispatches,
+            "dispatches_per_query": round(dispatches / queries, 2)}
+
+
 def trace_view(records: List[Dict[str, Any]],
                trace: Optional[str] = None) -> str:
     """Cross-process trace timeline: group every record carrying a trace
@@ -569,6 +593,15 @@ def render_report(model: Dict[str, Any], top: int = 10,
             f"iciBytes={mh['ici_bytes']}B shards={mh['shards']} "
             f"degraded={mh['degraded']}")
         lines.append("")
+    fu = fusion_summary(model)
+    if fu:
+        lines.append("=== whole-stage fusion ===")
+        lines.append(
+            f"queries={fu['queries']} fusedStages={fu['fused_stages']} "
+            f"fusedOps={fu['fused_ops']} "
+            f"deviceDispatches={fu['device_dispatches']} "
+            f"dispatchesPerQuery={fu['dispatches_per_query']}")
+        lines.append("")
     cache = cache_summary(model)
     if cache:
         lines.append("=== result/fragment cache ===")
@@ -656,6 +689,7 @@ def main(argv: List[str] = None) -> int:
         model["stats"] = stats_summary(model, top=args.top)
         model["pushdown"] = pushdown_summary(model)
         model["mesh"] = mesh_summary(model)
+        model["fusion"] = fusion_summary(model)
         print(json.dumps(model, indent=2))
     else:
         print(render_report(model, top=args.top, stats=args.stats))

@@ -224,6 +224,16 @@ class TaskMetrics:
         self.mesh_ici_bytes = 0
         self.mesh_shards = 0
         self.mesh_degraded = 0
+        # whole-stage fusion (plan/fusion.py + exec/fused.py):
+        # device_dispatches counts every host-side program launch at the
+        # compile-service execute seam (cached-executable calls AND the
+        # direct/fallback jit paths; nested in-trace calls are free and
+        # not counted) — dispatches-per-query is THE fusion gate metric.
+        # fused_stages/fused_ops count fused stages executed and the
+        # member operators they absorbed.
+        self.device_dispatches = 0
+        self.fused_stages = 0
+        self.fused_ops = 0
 
     @classmethod
     def get(cls) -> "TaskMetrics":
@@ -315,4 +325,10 @@ class TaskMetrics:
                 f"meshIciBytes={self.mesh_ici_bytes}"
                 + (f" meshDegraded={self.mesh_degraded}"
                    if self.mesh_degraded else ""))
+        if self.device_dispatches or self.fused_stages:
+            parts.append(
+                f"deviceDispatches={self.device_dispatches}"
+                + (f" fusedStages={self.fused_stages} "
+                   f"fusedOps={self.fused_ops}"
+                   if self.fused_stages else ""))
         return "" if not parts else "TaskMetrics: " + "; ".join(parts)

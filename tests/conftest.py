@@ -95,6 +95,12 @@ def pytest_configure(config):
         "mismatch degrades / per-chip HBM ledgers / one admission door / "
         "rescache ICI seam; scripts/mesh_matrix.sh runs these "
         "standalone)")
+    config.addinivalue_line(
+        "markers",
+        "fusion: whole-stage fusion suite (planner chains / fused-stage "
+        "on-off bit-identity / ANSI parity / pallas kernel exactness / "
+        "dispatch accounting; scripts/fusion_matrix.sh runs these "
+        "standalone)")
 
 
 @pytest.fixture

@@ -1,0 +1,461 @@
+"""Whole-stage fusion suite (ISSUE-16): planner chains, golden fusion-
+on/off bit-identity across chain shapes x types, ANSI error parity through
+a fused stage, pallas kernel exactness, dispatch accounting, fused-first
+warmup. `scripts/fusion_matrix.sh` runs these standalone and adds the
+subprocess purity + dispatch-reduction gates."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.errors import AnsiViolation
+from spark_rapids_tpu.expr import Count, Divide, Sum, col, lit
+from spark_rapids_tpu.plan.overrides import Overrides
+from spark_rapids_tpu.plugin import TpuSession
+from spark_rapids_tpu.utils.metrics import TaskMetrics
+
+pytestmark = pytest.mark.fusion
+
+FU = "spark.rapids.tpu.fusion.enabled"
+PALLAS = "spark.rapids.tpu.fusion.pallas.mode"
+
+
+@pytest.fixture(scope="module")
+def sess_off():
+    return TpuSession({"spark.rapids.sql.explain": "NONE"})
+
+
+@pytest.fixture(scope="module")
+def sess_on():
+    return TpuSession({"spark.rapids.sql.explain": "NONE", FU: True})
+
+
+@pytest.fixture(scope="module")
+def sess_force():
+    return TpuSession({"spark.rapids.sql.explain": "NONE", FU: True,
+                       PALLAS: "force"})
+
+
+def _mk_table(n=1500):
+    import decimal
+    rng = np.random.default_rng(7)
+    return pa.table({
+        "i64": pa.array([None if i % 13 == 0 else int(i % 700 - 350)
+                         for i in range(n)], pa.int64()),
+        "k": pa.array((np.arange(n) % 37).astype(np.int64)),
+        "i32": pa.array(rng.integers(-100, 100, n), pa.int32()),
+        "f64": pa.array(rng.normal(0, 50, n), pa.float64()),
+        "s": pa.array([None if i % 11 == 0 else f"val{i % 23:02d}"
+                       for i in range(n)]),
+        "dec": pa.array([decimal.Decimal(int(v)).scaleb(-2) for v in
+                         rng.integers(-10**6, 10**6, n)],
+                        pa.decimal128(10, 2)),
+    })
+
+
+def _mk_dim(n=60):
+    rng = np.random.default_rng(11)
+    return pa.table({
+        "k": pa.array(rng.permutation(80)[:n], pa.int64()),
+        "w": pa.array(rng.integers(1, 9, n), pa.int64()),
+    })
+
+
+def _plan(sess, df):
+    return Overrides(sess.conf).apply(df.plan)
+
+
+def _sorted(t):
+    if t.num_rows == 0:
+        return t
+    keys = [(n, "ascending") for n in t.schema.names
+            if not pa.types.is_floating(t.schema.field(n).type)]
+    return t.sort_by(keys) if keys else t
+
+
+def _assert_on_off_equal(q_on, q_off, expect_fused=None):
+    a, b = _sorted(q_on.collect()), _sorted(q_off.collect())
+    assert a.schema.names == b.schema.names
+    assert a.num_rows == b.num_rows
+    assert a.equals(b), f"fusion on/off mismatch:\nON:\n{a}\nOFF:\n{b}"
+    if expect_fused is not None:
+        assert ("TpuFusedStageExec" in expect_fused) \
+            or not expect_fused, expect_fused
+    return a
+
+
+# --------------------------------------------------------------------------
+class TestPlanner:
+    def test_filter_project_fuses(self, sess_on, sess_off):
+        df = sess_on.from_arrow(_mk_table())
+        q = df.filter(col("i32") > 0).select(
+            (col("k") * 2).alias("k2"), col("f64"))
+        tree = _plan(sess_on, q).tree_string()
+        assert "TpuFusedStageExec" in tree
+        assert "TpuFilterExec" not in tree and "TpuProjectExec" not in tree
+        # members render in the spec (kernel-key/fingerprint surface)
+        assert "Filter[" in tree and "Project[" in tree
+
+    def test_fusion_off_plans_byte_identical(self, sess_off):
+        plain = TpuSession({"spark.rapids.sql.explain": "NONE"})
+        for s in (sess_off, plain):
+            assert not s.conf.get(FU)
+        t = _mk_table()
+        q = lambda s: s.from_arrow(t).filter(col("i32") > 0).select(  # noqa
+            (col("k") + 1).alias("k1"))
+        assert _plan(sess_off, q(sess_off)).tree_string() == \
+            _plan(plain, q(plain)).tree_string()
+
+    def test_min_ops_respected(self):
+        s = TpuSession({"spark.rapids.sql.explain": "NONE", FU: True,
+                        "spark.rapids.tpu.fusion.minOps": 3})
+        df = s.from_arrow(_mk_table())
+        q = df.filter(col("i32") > 0).select((col("k") * 2).alias("k2"))
+        assert "TpuFusedStageExec" not in _plan(s, q).tree_string()
+        q3 = df.filter(col("i32") > 0).filter(col("k") > 3).select(
+            (col("k") * 2).alias("k2"))
+        assert "TpuFusedStageExec" in _plan(s, q3).tree_string()
+
+    def test_sort_breaks_chain(self, sess_on):
+        df = sess_on.from_arrow(_mk_table())
+        q = df.filter(col("i32") > 0).select(col("k"), col("f64")) \
+            .sort("k").select((col("k") + 1).alias("k1"))
+        tree = _plan(sess_on, q).tree_string()
+        # below the sort: fused filter+project; above: a single project
+        # (too short) stays unfused
+        assert "TpuFusedStageExec" in tree
+        assert "TpuSortExec" in tree and "TpuProjectExec" in tree
+
+    def test_broadcast_join_chain_fuses(self, sess_on):
+        fact = sess_on.from_arrow(_mk_table())
+        dim = sess_on.from_arrow(_mk_dim())
+        q = fact.select(col("k"), (col("i32") + 1).alias("v")) \
+            .join(dim, on="k", how="inner") \
+            .select((col("v") + col("w")).alias("x"))
+        tree = _plan(sess_on, q).tree_string()
+        assert "TpuFusedStageExec" in tree
+        assert "BroadcastHashJoin[inner" in tree
+        assert "TpuBroadcastExchangeExec" in tree  # build stays a child
+
+    def test_spec_distinguishes_params(self, sess_on):
+        # two chains differing only in a literal must not alias (the
+        # PR-3/PR-9 repr discipline for the fused kernel key)
+        df = sess_on.from_arrow(_mk_table())
+        t1 = _plan(sess_on, df.filter(col("i32") > 0)
+                   .select((col("k") * 2).alias("k2")))
+        t2 = _plan(sess_on, df.filter(col("i32") > 1)
+                   .select((col("k") * 2).alias("k2")))
+        assert t1.spec != t2.spec
+        assert repr(t1.spec) != repr(t2.spec)
+
+
+# --------------------------------------------------------------------------
+class TestGoldenEquality:
+    """Bit-identical results with fusion on vs off across chain shapes
+    and types (int/decimal/string/nullable)."""
+
+    SHAPES = [
+        ("filter_project_int", lambda df: df.filter(col("i32") > 0)
+         .select((col("k") * 2).alias("k2"), (col("i64") + 1).alias("i"))),
+        ("filter_project_decimal", lambda df: df.filter(col("i32") > 0)
+         .select(col("dec"), col("k"))),
+        ("filter_project_string", lambda df: df.filter(col("s") == "val07")
+         .select(col("s"), col("k"))),
+        ("filter_project_nullable", lambda df: df.filter(
+            col("i64").is_not_null()).select(col("i64"), col("s"))),
+        ("double_filter", lambda df: df.filter(col("i32") > -50)
+         .filter(col("k") < 30).select(col("k"), col("i32"))),
+        ("empty_result", lambda df: df.filter(col("i32") > 1000)
+         .select((col("k") + 1).alias("k1"))),
+    ]
+
+    @pytest.mark.parametrize("name,build", SHAPES,
+                             ids=[s[0] for s in SHAPES])
+    def test_shapes(self, sess_on, sess_off, name, build):
+        t = _mk_table()
+        q_on = build(sess_on.from_arrow(t))
+        q_off = build(sess_off.from_arrow(t))
+        assert "TpuFusedStageExec" in _plan(sess_on, q_on).tree_string()
+        _assert_on_off_equal(q_on, q_off)
+
+    @pytest.mark.parametrize("how", ["inner", "left", "semi", "anti"])
+    def test_join_chain(self, sess_on, sess_off, how):
+        t, d = _mk_table(), _mk_dim()
+
+        def build(s):
+            fact = s.from_arrow(t)
+            dim = s.from_arrow(d)
+            q = fact.select(col("k"), (col("i32") + 1).alias("v")) \
+                .join(dim, on="k", how=how)
+            if how in ("semi", "anti"):
+                return q.select((col("v") * 2).alias("x"))
+            return q.select((col("v") + col("w")).alias("x"))
+
+        q_on = build(sess_on)
+        assert "TpuFusedStageExec" in _plan(sess_on, q_on).tree_string()
+        _assert_on_off_equal(q_on, build(sess_off))
+
+    def test_join_chain_pallas_force(self, sess_force, sess_off):
+        t, d = _mk_table(), _mk_dim()
+
+        def build(s):
+            return s.from_arrow(t) \
+                .select(col("k"), (col("i32") + 1).alias("v")) \
+                .join(s.from_arrow(d), on="k", how="inner") \
+                .select((col("v") + col("w")).alias("x"))
+
+        _assert_on_off_equal(build(sess_force), build(sess_off))
+
+    def test_residual_filter_after_pushdown(self, tmp_path, sess_off):
+        p = str(tmp_path / "t.parquet")
+        pq.write_table(_mk_table(), p, row_group_size=500)
+        pd_key = "spark.rapids.tpu.scan.pushdown.enabled"
+        s_on = TpuSession({"spark.rapids.sql.explain": "NONE", FU: True,
+                           pd_key: True})
+        s_off = TpuSession({"spark.rapids.sql.explain": "NONE"})
+
+        def build(s):
+            # one pushable conjunct + one residual, then a projection: the
+            # residual filter and the project fuse ABOVE the pushed scan
+            return s.read_parquet(p).filter(
+                (col("k") < 30) & (col("k") + 0 < 25)).select(
+                col("k"), (col("i64") * 2).alias("i2"))
+
+        tree = _plan(s_on, build(s_on)).tree_string()
+        assert "TpuFusedStageExec" in tree
+        _assert_on_off_equal(build(s_on), build(s_off))
+
+
+# --------------------------------------------------------------------------
+class TestPartialAggHead:
+    """A stage-terminal partial aggregate fuses; partial->final results
+    are identical to the unfused split (batch-level identity-partial
+    extras merge away in the final)."""
+
+    def _split_tree(self, s, t):
+        from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+        df = s.from_arrow(t)
+        q = df.filter(col("i32") > 0).group_by("k").agg(
+            sv=Sum(col("i64")), c=Count(col("i64")))
+        node = _plan(s, q)
+        assert isinstance(node, TpuHashAggregateExec) \
+            and node.mode == "complete"
+        child = node.children[0]
+        partial = TpuHashAggregateExec(node.group_exprs, node.aggs, child,
+                                       s.conf, mode="partial")
+        return TpuHashAggregateExec(node.group_exprs, node.aggs, partial,
+                                    s.conf, mode="final",
+                                    agg_bind_schema=child.output)
+
+    def _collect(self, tree):
+        from spark_rapids_tpu.columnar.batch import batch_to_arrow
+        return pa.concat_tables(
+            [batch_to_arrow(b) for b in tree.execute()]).sort_by(
+            [("k", "ascending")])
+
+    @pytest.mark.parametrize("pallas", ["off", "force"])
+    def test_fused_partial_agg_identical(self, pallas):
+        from spark_rapids_tpu.plan.fusion import apply_fusion
+        t = _mk_table()
+        base_s = TpuSession({"spark.rapids.sql.explain": "NONE"})
+        base = self._collect(self._split_tree(base_s, t))
+        s = TpuSession({"spark.rapids.sql.explain": "NONE", FU: True,
+                        PALLAS: pallas})
+        fused = apply_fusion(self._split_tree(s, t), s.conf)
+        ts = fused.tree_string()
+        assert "TpuFusedStageExec" in ts and "PartialAgg[" in ts
+        out = self._collect(fused)
+        assert out.equals(base), f"pallas={pallas}\n{out}\nvs\n{base}"
+
+
+# --------------------------------------------------------------------------
+class TestAnsiParity:
+    def test_fused_error_message_matches_unfused(self):
+        t = pa.table({"a": pa.array([4, 0, 7], pa.int64()),
+                      "b": pa.array([2, 3, 9], pa.int64())})
+        msgs = []
+        for extra in ({}, {FU: True}):
+            s = TpuSession(dict({"spark.rapids.sql.explain": "NONE",
+                                 "spark.sql.ansi.enabled": True}, **extra))
+            df = s.from_arrow(t)
+            q = df.filter(col("b") > 0).select(
+                Divide(lit(10), col("a")).alias("x"))
+            if extra:
+                assert "TpuFusedStageExec" in _plan(s, q).tree_string()
+            with pytest.raises(AnsiViolation) as ei:
+                q.collect()
+            msgs.append(str(ei.value))
+        assert msgs[0] == msgs[1], f"ANSI parity broke: {msgs}"
+
+    def test_fused_no_error_when_clean(self):
+        t = pa.table({"a": pa.array([4, 2, 7], pa.int64())})
+        s = TpuSession({"spark.rapids.sql.explain": "NONE",
+                        "spark.sql.ansi.enabled": True, FU: True})
+        out = s.from_arrow(t).filter(col("a") > 1).select(
+            Divide(lit(8), col("a")).alias("x")).collect()
+        assert out.num_rows == 3
+
+
+# --------------------------------------------------------------------------
+class TestPallasKernels:
+    """Bit-exactness of the two fused inner-loop kernels against their
+    stock jnp lowerings (interpret mode on CPU)."""
+
+    def test_hash_parity_int_long_nullable(self):
+        import jax.numpy as jnp
+
+        from spark_rapids_tpu.columnar import batch_from_arrow
+        from spark_rapids_tpu.exec.base import batch_vecs
+        from spark_rapids_tpu.expr.hashing import hash_vecs
+        from spark_rapids_tpu.ops.pallas_probe import hash_vecs_pallas
+        t = pa.table({
+            "i": pa.array([None if i % 7 == 0 else int(i * 31 - 4000)
+                           for i in range(300)], pa.int32()),
+            "l": pa.array([None if i % 5 == 0 else int(i * 10**14 - 2**50)
+                           for i in range(300)], pa.int64()),
+        })
+        vecs = batch_vecs(batch_from_arrow(t))
+        a = np.asarray(hash_vecs(jnp, vecs))
+        b = np.asarray(hash_vecs_pallas(jnp, vecs))
+        assert (a == b).all(), "pallas murmur3 diverged from expr.hashing"
+
+    def test_candidate_counts_match_probe_counts(self):
+        import jax.numpy as jnp
+
+        from spark_rapids_tpu.columnar import batch_from_arrow
+        from spark_rapids_tpu.exec.base import batch_vecs
+        from spark_rapids_tpu.exec.joins import _probe_counts
+        from spark_rapids_tpu.ops.pallas_probe import candidate_counts
+        rng = np.random.default_rng(3)
+        probe = batch_from_arrow(pa.table({
+            "k": pa.array([None if i % 9 == 0 else int(v) for i, v in
+                           enumerate(rng.integers(0, 50, 400))],
+                          pa.int64())}))
+        build = batch_from_arrow(pa.table({
+            "k": pa.array([None if i % 6 == 0 else int(v) for i, v in
+                           enumerate(rng.integers(0, 50, 80))],
+                          pa.int64())}))
+        ref = np.asarray(_probe_counts.fn(probe, build, (0,), (0,))[0])
+        got = np.asarray(candidate_counts(
+            jnp, batch_vecs(probe), batch_vecs(build),
+            probe.row_mask(), build.row_mask()))
+        assert (ref == got).all()
+
+    def test_segment_sum_exact_and_fallback(self):
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_tpu.ops.pallas_groupby import (MAX_SEGMENTS,
+                                                         fused_segment_sum)
+        rng = np.random.default_rng(4)
+        n, cap = 5000, 77
+        vals = jnp.asarray(rng.integers(-2**62, 2**62, n), jnp.int64)
+        gid = jnp.asarray(rng.integers(0, cap, n), jnp.int32)
+        ref = np.asarray(jax.ops.segment_sum(vals, gid, num_segments=cap))
+        got = np.asarray(fused_segment_sum(vals, gid, cap))
+        assert (ref == got).all(), "pallas segment sum diverged (wrap/exact)"
+        # above MAX_SEGMENTS the wrapper must fall back, still exact
+        big = MAX_SEGMENTS + 100
+        ref2 = np.asarray(jax.ops.segment_sum(vals, gid, num_segments=big))
+        got2 = np.asarray(fused_segment_sum(vals, gid, big))
+        assert (ref2 == got2).all()
+
+
+# --------------------------------------------------------------------------
+class TestDispatchAccounting:
+    def _run(self, extra, t, d):
+        s = TpuSession(dict({"spark.rapids.sql.explain": "NONE"}, **extra))
+        q = s.from_arrow(t) \
+            .select(col("k"), (col("i32") + 1).alias("v")) \
+            .join(s.from_arrow(d), on="k", how="inner") \
+            .select((col("v") + col("w")).alias("x"))
+        TaskMetrics.reset()
+        out = q.collect()
+        return out, TaskMetrics.get()
+
+    def test_fusion_reduces_dispatches(self):
+        t, d = _mk_table(), _mk_dim()
+        out_off, tm_off = self._run({}, t, d)
+        out_on, tm_on = self._run({FU: True}, t, d)
+        assert _sorted(out_on).equals(_sorted(out_off))
+        assert tm_off.device_dispatches > 0
+        assert tm_on.device_dispatches * 2 <= tm_off.device_dispatches, (
+            f"fused {tm_on.device_dispatches} vs "
+            f"unfused {tm_off.device_dispatches}")
+        assert tm_on.fused_stages >= 1
+        assert tm_on.fused_ops >= 3
+        assert tm_off.fused_stages == 0 and tm_off.fused_ops == 0
+        es = tm_on.explain_string()
+        assert "deviceDispatches=" in es and "fusedStages=" in es
+
+    def test_profile_fusion_summary(self):
+        from spark_rapids_tpu.tools.profile_report import fusion_summary
+        model = {"queries": [
+            {"task_metrics": {"device_dispatches": 4, "fused_stages": 2,
+                              "fused_ops": 6}},
+            {"task_metrics": {"device_dispatches": 9}},  # non-fusing query
+        ]}
+        fu = fusion_summary(model)
+        assert fu == {"queries": 1, "fused_stages": 2, "fused_ops": 6,
+                      "device_dispatches": 4, "dispatches_per_query": 4.0}
+        assert fusion_summary({"queries": []}) == {}
+
+
+# --------------------------------------------------------------------------
+class TestWarmupFused:
+    def test_fused_programs_preload_first(self, tmp_path):
+        from spark_rapids_tpu.compile import (CompileService, run_warmup)
+        CompileService.reset()
+        try:
+            s = TpuSession({"spark.rapids.sql.explain": "NONE", FU: True,
+                            "spark.rapids.tpu.compile.cache.dir":
+                                str(tmp_path / "xla_cache")})
+            s.initialize_device()
+            svc = CompileService.get()
+            df = s.from_arrow(_mk_table())
+            df.filter(col("i32") > 0).select(
+                (col("k") * 2).alias("k2")).collect()
+            metas = [svc.persisted_meta(dg) for dg in
+                     svc.persisted_entries()]
+            assert any(m and m.get("op") == "exec.fused_stage"
+                       for m in metas), "fused stage was not persisted"
+            svc.clear_memory()
+            stats = run_warmup(s.conf, svc)
+            assert stats["fused"] >= 1
+            assert stats["preloaded"] >= stats["fused"]
+        finally:
+            CompileService.reset()
+
+
+# --------------------------------------------------------------------------
+class TestOffPurity:
+    def test_fusion_off_imports_nothing(self):
+        """Fusion off must never import the fusion modules (subprocess:
+        this pytest process imports them for the other tests)."""
+        code = (
+            "import sys\n"
+            "import pyarrow as pa\n"
+            "from spark_rapids_tpu.plugin import TpuSession\n"
+            "from spark_rapids_tpu.expr import col\n"
+            "s = TpuSession({'spark.rapids.sql.explain': 'NONE'})\n"
+            "t = pa.table({'a': pa.array(range(100), pa.int64())})\n"
+            "out = s.from_arrow(t).filter(col('a') > 5)"
+            ".select((col('a') * 2).alias('b')).collect()\n"
+            "assert out.num_rows == 94\n"
+            "bad = [m for m in sys.modules if m.startswith("
+            "'spark_rapids_tpu') and ('fusion' in m or 'fused' in m"
+            " or 'pallas_probe' in m or 'pallas_groupby' in m)]\n"
+            "assert not bad, f'fusion modules leaked: {bad}'\n"
+            "print('PURE')\n")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=os.path.dirname(os.path.dirname(
+                       os.path.abspath(__file__))))
+        r = subprocess.run([sys.executable, "-c", code], env=env,
+                           capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "PURE" in r.stdout

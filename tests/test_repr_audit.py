@@ -99,6 +99,11 @@ def _iter_expression_classes():
 
     yield from walk(Expression)
     yield from walk(StaticExpr)
+    # the fused-stage spec is kernel-key AND fingerprint material (its repr
+    # is the whole fused program identity) — audit it like an expression
+    from spark_rapids_tpu.plan.fusion import FusedStageSpec
+    yield FusedStageSpec
+    yield from walk(FusedStageSpec)
 
 
 def _source_of(func) -> str:
